@@ -1,0 +1,6 @@
+"""L0 wire format: Thrift compact protocol + parquet.thrift structs."""
+from . import enums, metadata, thrift
+from .enums import (BoundaryOrder, CompressionCodec, ConvertedType, Encoding,
+                    FieldRepetitionType, PageType, Type)
+from .metadata import MAGIC, FileMetaData, PageHeader
+from .thrift import CompactReader, CompactWriter, deserialize, serialize

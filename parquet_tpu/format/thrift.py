@@ -1,0 +1,400 @@
+"""Thrift compact-protocol reader/writer (L0 wire format).
+
+Reference parity: the reference serializes its ``format/parquet.go`` structs with
+the Thrift compact protocol via ``segmentio/encoding/thrift`` (SURVEY.md §1 L0).
+This module is a from-scratch, spec-driven implementation: struct layouts are
+declared as ``_FIELDS`` tables on plain Python classes (see ``metadata.py``) and a
+single generic encoder/decoder walks them.  Unknown fields are skipped by wire
+type, which gives forward compatibility with newer parquet.thrift revisions for
+free.
+
+Compact protocol essentials implemented here:
+  - varint / zigzag-varint integers (i16/i32/i64)
+  - field headers: ``(delta << 4) | wire_type`` with zigzag field-id escape
+  - BOOLEAN_TRUE / BOOLEAN_FALSE encoded in the field header's type nibble
+  - binary/string: varint length prefix
+  - list/set: ``(size << 4) | elem_type`` with 0xF escape to varint size
+  - struct: recursive, terminated by a 0x00 stop byte
+  - double: 8 bytes little-endian
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+from typing import Any, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Wire types (compact protocol type nibble values)
+# ---------------------------------------------------------------------------
+CT_STOP = 0x00
+CT_BOOL_TRUE = 0x01
+CT_BOOL_FALSE = 0x02
+CT_I8 = 0x03
+CT_I16 = 0x04
+CT_I32 = 0x05
+CT_I64 = 0x06
+CT_DOUBLE = 0x07
+CT_BINARY = 0x08
+CT_LIST = 0x09
+CT_SET = 0x0A
+CT_MAP = 0x0B
+CT_STRUCT = 0x0C
+
+
+class TType:
+    """Logical field types used in ``_FIELDS`` specs.
+
+    A spec entry is ``(field_id, attr_name, type_spec)`` where ``type_spec`` is
+    one of the scalar constants below, ``(TType.LIST, elem_spec)``, or
+    ``(TType.STRUCT, cls)``.  Enums are declared as I32.
+    """
+
+    BOOL = "bool"
+    I8 = "i8"
+    I16 = "i16"
+    I32 = "i32"
+    I64 = "i64"
+    DOUBLE = "double"
+    BINARY = "binary"  # bytes
+    STRING = "string"  # str (utf-8)
+    LIST = "list"
+    STRUCT = "struct"
+
+
+_SCALAR_WIRE = {
+    TType.I8: CT_I8,
+    TType.I16: CT_I16,
+    TType.I32: CT_I32,
+    TType.I64: CT_I64,
+    TType.DOUBLE: CT_DOUBLE,
+    TType.BINARY: CT_BINARY,
+    TType.STRING: CT_BINARY,
+}
+
+
+class ThriftError(Exception):
+    pass
+
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+class CompactReader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def read_varint(self) -> int:
+        result = 0
+        shift = 0
+        buf = self.buf
+        pos = self.pos
+        while True:
+            b = buf[pos]
+            pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 70:
+                raise ThriftError("varint too long")
+        self.pos = pos
+        return result
+
+    def read_zigzag(self) -> int:
+        return _zigzag_decode(self.read_varint())
+
+    def read_bytes(self) -> bytes:
+        n = self.read_varint()
+        b = self.buf[self.pos : self.pos + n]
+        if len(b) != n:
+            raise ThriftError("truncated binary")
+        self.pos += n
+        return bytes(b)
+
+    def read_double(self) -> float:
+        (v,) = _struct.unpack_from("<d", self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    # -- generic struct decoding -------------------------------------------
+    def read_struct(self, cls):
+        obj = cls.__new__(cls)
+        fields = cls._FIELD_MAP  # {fid: (name, spec)}
+        for _fid, name, _spec in cls._FIELDS:
+            setattr(obj, name, None)
+        last_fid = 0
+        while True:
+            header = self.buf[self.pos]
+            self.pos += 1
+            if header == CT_STOP:
+                break
+            delta = header >> 4
+            wire = header & 0x0F
+            if delta:
+                fid = last_fid + delta
+            else:
+                fid = _zigzag_decode(self.read_varint())
+            last_fid = fid
+            entry = fields.get(fid)
+            if entry is None:
+                self._skip(wire)
+                continue
+            name, spec = entry
+            setattr(obj, name, self._read_value(wire, spec))
+        return obj
+
+    def _read_value(self, wire: int, spec) -> Any:
+        if wire == CT_BOOL_TRUE:
+            return True
+        if wire == CT_BOOL_FALSE:
+            return False
+        if wire == CT_I8:
+            v = self.buf[self.pos]
+            self.pos += 1
+            return v - 256 if v >= 128 else v
+        if wire in (CT_I16, CT_I32, CT_I64):
+            return self.read_zigzag()
+        if wire == CT_DOUBLE:
+            return self.read_double()
+        if wire == CT_BINARY:
+            raw = self.read_bytes()
+            if spec == TType.STRING:
+                return raw.decode("utf-8", errors="replace")
+            return raw
+        if wire == CT_STRUCT:
+            if not (isinstance(spec, tuple) and spec[0] == TType.STRUCT):
+                raise ThriftError(f"field declared {spec} but wire is struct")
+            return self.read_struct(spec[1])
+        if wire in (CT_LIST, CT_SET):
+            return self._read_list(spec)
+        if wire == CT_MAP:
+            self._skip(CT_MAP)  # parquet.thrift has no maps we care about
+            return None
+        raise ThriftError(f"unknown wire type {wire}")
+
+    def _read_list(self, spec) -> List[Any]:
+        header = self.buf[self.pos]
+        self.pos += 1
+        size = header >> 4
+        elem_wire = header & 0x0F
+        if size == 0xF:
+            size = self.read_varint()
+        if not (isinstance(spec, tuple) and spec[0] == TType.LIST):
+            # declared type mismatch: skip elements, return None
+            for _ in range(size):
+                self._skip_elem(elem_wire)
+            return None
+        elem_spec = spec[1]
+        out = []
+        if elem_wire in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+            # bool list elements are one byte each: 1 = true
+            for _ in range(size):
+                out.append(self.buf[self.pos] == 1)
+                self.pos += 1
+            return out
+        for _ in range(size):
+            out.append(self._read_value(elem_wire, elem_spec))
+        return out
+
+    # -- skipping unknown fields -------------------------------------------
+    def _skip(self, wire: int) -> None:
+        if wire in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+            return
+        if wire == CT_I8:
+            self.pos += 1
+        elif wire in (CT_I16, CT_I32, CT_I64):
+            self.read_varint()
+        elif wire == CT_DOUBLE:
+            self.pos += 8
+        elif wire == CT_BINARY:
+            self.pos += self.read_varint()
+        elif wire in (CT_LIST, CT_SET):
+            header = self.buf[self.pos]
+            self.pos += 1
+            size = header >> 4
+            elem_wire = header & 0x0F
+            if size == 0xF:
+                size = self.read_varint()
+            for _ in range(size):
+                self._skip_elem(elem_wire)
+        elif wire == CT_MAP:
+            size = self.read_varint()
+            if size:
+                kv = self.buf[self.pos]
+                self.pos += 1
+                for _ in range(size):
+                    self._skip_elem(kv >> 4)
+                    self._skip_elem(kv & 0x0F)
+        elif wire == CT_STRUCT:
+            last = 0
+            while True:
+                h = self.buf[self.pos]
+                self.pos += 1
+                if h == CT_STOP:
+                    return
+                delta = h >> 4
+                if delta == 0:
+                    self.read_zigzag()
+                self._skip(h & 0x0F)
+        else:
+            raise ThriftError(f"cannot skip wire type {wire}")
+
+    def _skip_elem(self, elem_wire: int) -> None:
+        # inside collections bools occupy one byte
+        if elem_wire in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+            self.pos += 1
+        else:
+            self._skip(elem_wire)
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+class CompactWriter:
+    __slots__ = ("out",)
+
+    def __init__(self):
+        self.out = bytearray()
+
+    def getvalue(self) -> bytes:
+        return bytes(self.out)
+
+    def write_varint(self, n: int) -> None:
+        out = self.out
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            if n:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return
+
+    def write_zigzag(self, n: int) -> None:
+        self.write_varint(_zigzag_encode(n))
+
+    def write_bytes(self, b: bytes) -> None:
+        self.write_varint(len(b))
+        self.out += b
+
+    # -- generic struct encoding -------------------------------------------
+    def write_struct(self, obj) -> None:
+        last_fid = 0
+        for fid, name, spec in type(obj)._FIELDS:
+            value = getattr(obj, name, None)
+            if value is None:
+                continue
+            wire = self._wire_of(spec, value)
+            delta = fid - last_fid
+            if 0 < delta <= 15:
+                self.out.append((delta << 4) | wire)
+            else:
+                self.out.append(wire)
+                self.write_zigzag(fid)
+            last_fid = fid
+            self._write_value(spec, value)
+        self.out.append(CT_STOP)
+
+    def _wire_of(self, spec, value) -> int:
+        if spec == TType.BOOL:
+            return CT_BOOL_TRUE if value else CT_BOOL_FALSE
+        if isinstance(spec, tuple):
+            if spec[0] == TType.LIST:
+                return CT_LIST
+            return CT_STRUCT
+        return _SCALAR_WIRE[spec]
+
+    def _write_value(self, spec, value) -> None:
+        if spec == TType.BOOL:
+            return  # encoded in the field header
+        if spec == TType.I8:
+            self.out.append(value & 0xFF)
+        elif spec in (TType.I16, TType.I32, TType.I64):
+            self.write_zigzag(int(value))
+        elif spec == TType.DOUBLE:
+            self.out += _struct.pack("<d", value)
+        elif spec == TType.BINARY:
+            self.write_bytes(bytes(value))
+        elif spec == TType.STRING:
+            self.write_bytes(value.encode("utf-8") if isinstance(value, str) else bytes(value))
+        elif isinstance(spec, tuple) and spec[0] == TType.LIST:
+            self._write_list(spec[1], value)
+        elif isinstance(spec, tuple) and spec[0] == TType.STRUCT:
+            self.write_struct(value)
+        else:
+            raise ThriftError(f"cannot encode spec {spec}")
+
+    def _write_list(self, elem_spec, values) -> None:
+        n = len(values)
+        if elem_spec == TType.BOOL:
+            elem_wire = CT_BOOL_TRUE
+        elif isinstance(elem_spec, tuple):
+            elem_wire = CT_LIST if elem_spec[0] == TType.LIST else CT_STRUCT
+        else:
+            elem_wire = _SCALAR_WIRE[elem_spec]
+        if n < 15:
+            self.out.append((n << 4) | elem_wire)
+        else:
+            self.out.append(0xF0 | elem_wire)
+            self.write_varint(n)
+        if elem_spec == TType.BOOL:
+            for v in values:
+                self.out.append(1 if v else 2)
+            return
+        for v in values:
+            self._write_value(elem_spec, v)
+
+
+def thrift_struct(cls):
+    """Class decorator: builds ``_FIELD_MAP`` and an __init__/__repr__ from ``_FIELDS``."""
+    cls._FIELD_MAP = {fid: (name, spec) for fid, name, spec in cls._FIELDS}
+    names = [name for _, name, _ in cls._FIELDS]
+
+    def __init__(self, **kwargs):
+        for n in names:
+            setattr(self, n, kwargs.pop(n, None))
+        if kwargs:
+            raise TypeError(f"unknown fields for {cls.__name__}: {sorted(kwargs)}")
+
+    def __repr__(self):
+        parts = ", ".join(
+            f"{n}={getattr(self, n)!r}" for n in names if getattr(self, n, None) is not None
+        )
+        return f"{cls.__name__}({parts})"
+
+    def __eq__(self, other):
+        if type(other) is not type(self):
+            return NotImplemented
+        return all(getattr(self, n) == getattr(other, n) for n in names)
+
+    cls.__init__ = __init__
+    cls.__repr__ = __repr__
+    cls.__eq__ = __eq__
+    cls.__hash__ = None
+    if "__slots__" not in cls.__dict__:
+        pass  # plain dict classes; metadata objects are few
+    return cls
+
+
+def serialize(obj) -> bytes:
+    w = CompactWriter()
+    w.write_struct(obj)
+    return w.getvalue()
+
+
+def deserialize(cls, buf: bytes, pos: int = 0) -> Tuple[Any, int]:
+    """Decode one struct; returns (obj, bytes_consumed_end_position)."""
+    r = CompactReader(buf, pos)
+    obj = r.read_struct(cls)
+    return obj, r.pos
