@@ -1,0 +1,602 @@
+"""numpy reference implementations of every Parquet encoding (the test oracle).
+
+Reference parity: the reference pairs each amd64 assembly kernel with a pure-Go
+``purego`` twin used as a correctness oracle (SURVEY.md §2.3).  This module is
+that twin for the new framework: plain numpy, no JAX, byte-exact against the
+Parquet spec.  The device kernels in ``ops/device.py`` / ``ops/pallas_kernels.py``
+are tested against these, and pyarrow round-trips pin both to the ecosystem.
+
+Encodings (SURVEY.md §2.2): PLAIN, RLE/bit-packed hybrid, BIT_PACKED (legacy),
+DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY, DELTA_BYTE_ARRAY,
+BYTE_STREAM_SPLIT, RLE_DICTIONARY index streams.
+
+Variable-length values use the Arrow-style (data: uint8[], offsets: int32[n+1])
+layout throughout — the flat buffers that cross the host→HBM boundary
+(reference analog: ``encoding/values.go — encoding.Values``).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..format.enums import Type
+
+# ---------------------------------------------------------------------------
+# varint / zigzag helpers (ULEB128, shared by delta + RLE headers)
+# ---------------------------------------------------------------------------
+
+
+def read_uvarint(buf, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = int(buf[pos])
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def write_uvarint(out: bytearray, n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+
+
+def unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+# ---------------------------------------------------------------------------
+# Bit packing (LSB-first, the parquet "RLE" bit order)
+# Reference analog: internal/bitpack — unpack_int32_amd64.s / unpack_int64_amd64.s
+# ---------------------------------------------------------------------------
+
+
+def unpack_bits(data, n: int, bit_width: int, offset_bits: int = 0) -> np.ndarray:
+    """Unpack ``n`` LSB-first ``bit_width``-bit integers from ``data`` starting
+    at bit ``offset_bits``.  Returns uint64 array.  Fully vectorized."""
+    if bit_width == 0:
+        return np.zeros(n, dtype=np.uint64)
+    if n == 0:
+        return np.empty(0, dtype=np.uint64)
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    starts = offset_bits + np.arange(n, dtype=np.int64) * bit_width
+    byte0 = starts >> 3
+    shift = (starts & 7).astype(np.uint64)
+    nbytes = (bit_width + 7 + 7) // 8  # enough bytes to cover shift + width
+    nbytes = min(nbytes, 9)
+    # gather up to 8 bytes into uint64 (+ 9th byte handled separately)
+    end = int(byte0[-1]) + nbytes
+    if end > len(buf):
+        buf = np.concatenate([buf, np.zeros(end - len(buf), dtype=np.uint8)])
+    acc = np.zeros(n, dtype=np.uint64)
+    for k in range(min(nbytes, 8)):
+        acc |= buf[byte0 + k].astype(np.uint64) << np.uint64(8 * k)
+    vals = acc >> shift
+    if bit_width + 7 > 64 and nbytes == 9:  # need the 9th byte's low bits
+        hi = buf[byte0 + 8].astype(np.uint64)
+        vals |= np.where(shift > 0, hi << (np.uint64(64) - shift), 0)
+    if bit_width < 64:
+        vals &= (np.uint64(1) << np.uint64(bit_width)) - np.uint64(1)
+    return vals
+
+
+def pack_bits(values: np.ndarray, bit_width: int) -> bytes:
+    """Pack integers LSB-first at ``bit_width`` bits each."""
+    n = len(values)
+    if bit_width == 0 or n == 0:
+        return b""
+    mask = np.uint64(0xFFFFFFFFFFFFFFFF) if bit_width >= 64 else np.uint64((1 << bit_width) - 1)
+    v = values.astype(np.uint64) & mask
+    total_bits = n * bit_width
+    nbytes = (total_bits + 7) // 8
+    # scatter each value's bits into a byte accumulator via per-byte OR
+    out = np.zeros(nbytes + 8, dtype=np.uint8)
+    starts = np.arange(n, dtype=np.int64) * bit_width
+    byte0 = starts >> 3
+    shift = (starts & 7).astype(np.uint64)
+    shifted = v << shift  # may need up to bit_width+7 bits ≤ 71 — handle 9th byte
+    for k in range(8):
+        np.bitwise_or.at(out, byte0 + k, ((shifted >> np.uint64(8 * k)) & np.uint64(0xFF)).astype(np.uint8))
+    if bit_width + 7 > 64:
+        hi = np.where(shift > 0, v >> (np.uint64(64) - shift), np.uint64(0))
+        np.bitwise_or.at(out, byte0 + 8, (hi & np.uint64(0xFF)).astype(np.uint8))
+    return out[:nbytes].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# PLAIN (encoding/plain — plain.go)
+# ---------------------------------------------------------------------------
+
+
+def decode_plain(data, num_values: int, physical: Type, type_length: Optional[int] = None):
+    """Decode PLAIN.  Fixed-width → typed array; BYTE_ARRAY → (values, offsets);
+    FLBA → (n, type_length) uint8; INT96 → (n, 3) int32; BOOLEAN → bool[]."""
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    if physical == Type.BOOLEAN:
+        bits = np.unpackbits(buf[: (num_values + 7) // 8], bitorder="little")
+        return bits[:num_values].astype(np.bool_)
+    if physical == Type.INT32:
+        return buf[: 4 * num_values].view(np.int32).copy()
+    if physical == Type.INT64:
+        return buf[: 8 * num_values].view(np.int64).copy()
+    if physical == Type.FLOAT:
+        return buf[: 4 * num_values].view(np.float32).copy()
+    if physical == Type.DOUBLE:
+        return buf[: 8 * num_values].view(np.float64).copy()
+    if physical == Type.INT96:
+        return buf[: 12 * num_values].view(np.int32).reshape(num_values, 3).copy()
+    if physical == Type.FIXED_LEN_BYTE_ARRAY:
+        w = type_length
+        return buf[: w * num_values].reshape(num_values, w).copy()
+    if physical == Type.BYTE_ARRAY:
+        return _decode_plain_byte_array(buf, num_values)
+    raise ValueError(f"unsupported physical type {physical}")
+
+
+def _decode_plain_byte_array(buf: np.ndarray, num_values: int):
+    """4-byte-length-prefixed strings → (values uint8[], offsets int32[n+1]).
+
+    The length prefixes sit at data-dependent positions (sequential scan in the
+    reference); here: iterative host scan.  The C++ shim (native/) and the
+    device two-pass variant replace this on hot paths."""
+    offsets = np.empty(num_values + 1, dtype=np.int64)
+    offsets[0] = 0
+    pos = 0
+    n = len(buf)
+    lens = np.empty(num_values, dtype=np.int64)
+    mv = buf
+    for i in range(num_values):
+        if pos + 4 > n:
+            raise ValueError("PLAIN BYTE_ARRAY truncated")
+        ln = int(mv[pos]) | int(mv[pos + 1]) << 8 | int(mv[pos + 2]) << 16 | int(mv[pos + 3]) << 24
+        lens[i] = ln
+        pos += 4 + ln
+    offsets[1:] = np.cumsum(lens)
+    total = int(offsets[-1])
+    values = np.empty(total, dtype=np.uint8)
+    # gather: positions of value bytes = 4*(i+1) + offsets[i] .. — vectorized copy
+    starts = 4 * np.arange(1, num_values + 1, dtype=np.int64) + offsets[:-1]
+    idx = np.repeat(starts, lens) + _ranges(lens)
+    values[:] = mv[idx] if total else values
+    return values, offsets.astype(np.int32)
+
+
+def _ranges(lengths: np.ndarray) -> np.ndarray:
+    """[0..l0), [0..l1), ... concatenated (segmented iota)."""
+    total = int(lengths.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    ends = np.cumsum(lengths)
+    out = np.ones(total, dtype=np.int64)
+    out[0] = 0
+    starts = ends[:-1]
+    nz = lengths[1:] > 0
+    out[starts[nz]] = 1 - lengths[:-1][nz]
+    return np.cumsum(out)
+
+
+def encode_plain(values, physical: Type, offsets: Optional[np.ndarray] = None) -> bytes:
+    if physical == Type.BOOLEAN:
+        return np.packbits(np.asarray(values, dtype=np.uint8), bitorder="little").tobytes()
+    if physical == Type.BYTE_ARRAY:
+        data = np.asarray(values, dtype=np.uint8)
+        offs = np.asarray(offsets, dtype=np.int64)
+        lens = (offs[1:] - offs[:-1]).astype(np.int64)
+        n = len(lens)
+        out = np.empty(len(data) + 4 * n, dtype=np.uint8)
+        # positions of the 4 length bytes + value bytes
+        dst_starts = offs[:-1] + 4 * np.arange(1, n + 1, dtype=np.int64)
+        lens32 = lens.astype(np.uint32)
+        hdr_pos = offs[:-1] + 4 * np.arange(n, dtype=np.int64)
+        for k in range(4):
+            out[hdr_pos + k] = ((lens32 >> (8 * k)) & 0xFF).astype(np.uint8)
+        if len(data):
+            idx = np.repeat(dst_starts, lens) + _ranges(lens)
+            out[idx] = data
+        return out.tobytes()
+    if physical == Type.INT96:
+        return np.ascontiguousarray(values, dtype=np.int32).tobytes()
+    if physical == Type.FIXED_LEN_BYTE_ARRAY:
+        return np.ascontiguousarray(values, dtype=np.uint8).tobytes()
+    dtype = {
+        Type.INT32: np.int32,
+        Type.INT64: np.int64,
+        Type.FLOAT: np.float32,
+        Type.DOUBLE: np.float64,
+    }[physical]
+    return np.ascontiguousarray(values, dtype=dtype).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-packed hybrid (encoding/rle — rle.go + rle_amd64.s)
+# ---------------------------------------------------------------------------
+
+
+def scan_rle_runs(data, num_values: int, bit_width: int, pos: int = 0):
+    """Parse hybrid run headers → run table (the host pre-scan of SURVEY.md §7).
+
+    Returns (kinds u8[k] (0=RLE,1=bitpacked), counts i64[k], payload i64[k],
+    byte_offsets i64[k], end_pos).  payload = repeated value for RLE runs,
+    unused for bit-packed (their bits start at byte_offsets)."""
+    kinds: List[int] = []
+    counts: List[int] = []
+    payloads: List[int] = []
+    offsets: List[int] = []
+    vbytes = (bit_width + 7) // 8
+    remaining = num_values
+    while remaining > 0:
+        header, pos = read_uvarint(data, pos)
+        if header & 1:
+            ngroups = header >> 1
+            count = ngroups * 8
+            kinds.append(1)
+            counts.append(min(count, remaining))
+            payloads.append(0)
+            offsets.append(pos)
+            pos += ngroups * bit_width
+        else:
+            count = header >> 1
+            value = 0
+            for k in range(vbytes):
+                value |= int(data[pos + k]) << (8 * k)
+            pos += vbytes
+            kinds.append(0)
+            counts.append(min(count, remaining))
+            payloads.append(value)
+            offsets.append(pos)
+        remaining -= count
+    return (
+        np.array(kinds, dtype=np.uint8),
+        np.array(counts, dtype=np.int64),
+        np.array(payloads, dtype=np.int64),
+        np.array(offsets, dtype=np.int64),
+        pos,
+    )
+
+
+def decode_rle(data, num_values: int, bit_width: int, pos: int = 0) -> np.ndarray:
+    """Decode an RLE/bit-packed hybrid stream (no length/width prefix)."""
+    if bit_width == 0:
+        return np.zeros(num_values, dtype=np.int64)
+    kinds, counts, payloads, offsets, _ = scan_rle_runs(data, num_values, bit_width, pos)
+    out = np.empty(num_values, dtype=np.int64)
+    w = 0
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    for i in range(len(kinds)):
+        c = int(counts[i])
+        if kinds[i] == 0:
+            out[w : w + c] = payloads[i]
+        else:
+            vals = unpack_bits(buf[offsets[i] :], c, bit_width)
+            out[w : w + c] = vals.astype(np.int64)
+        w += c
+    return out
+
+
+def decode_rle_len_prefixed(data, num_values: int, bit_width: int, pos: int = 0):
+    """v1 def/rep levels: 4-byte LE byte-length prefix, then hybrid stream."""
+    (length,) = struct.unpack_from("<I", data, pos)
+    vals = decode_rle(data, num_values, bit_width, pos + 4)
+    return vals, pos + 4 + length
+
+
+def decode_rle_dict_indices(data, num_values: int, pos: int = 0) -> np.ndarray:
+    """RLE_DICTIONARY data page payload: 1-byte bit width, then hybrid stream."""
+    bit_width = data[pos]
+    if bit_width == 0:
+        return np.zeros(num_values, dtype=np.int64)
+    return decode_rle(data, num_values, bit_width, pos + 1)
+
+
+def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8) -> bytes:
+    """Encode the hybrid stream (no prefix).
+
+    Invariant (required by the format): a bit-packed run encodes exactly
+    ``ngroups * 8`` values, all of which count toward num_values — so
+    mid-stream bit-packed spans must be whole groups of 8; only the final
+    group may be zero-padded (readers stop at num_values).  Runs of
+    >= ``min_repeat`` identical values switch to RLE runs, matching the
+    common writer heuristic."""
+    values = np.asarray(values, dtype=np.int64)
+    n = len(values)
+    out = bytearray()
+    if n == 0 or bit_width == 0:
+        return bytes(out)
+    vbytes = (bit_width + 7) // 8
+    # run-length decomposition
+    change = np.empty(n, dtype=bool)
+    change[0] = True
+    np.not_equal(values[1:], values[:-1], out=change[1:])
+    run_starts = np.flatnonzero(change)
+    run_lens = np.diff(np.append(run_starts, n))
+
+    def emit_rle(value: int, count: int):
+        write_uvarint(out, count << 1)
+        out.extend((value & ((1 << (8 * vbytes)) - 1)).to_bytes(vbytes, "little", signed=False))
+
+    packed: List[int] = []  # pending values for bit-packed groups
+
+    def flush_packed(final: bool = False):
+        if not packed:
+            return
+        cnt = len(packed)
+        assert final or cnt % 8 == 0
+        ngroups = (cnt + 7) // 8
+        padded = np.zeros(ngroups * 8, dtype=np.int64)
+        padded[:cnt] = packed
+        write_uvarint(out, (ngroups << 1) | 1)
+        out.extend(pack_bits(padded, bit_width))
+        packed.clear()
+
+    for s, l in zip(run_starts, run_lens):
+        val = int(values[s])
+        rem = int(l)
+        if len(packed) % 8:
+            take = min(8 - len(packed) % 8, rem)
+            packed.extend([val] * take)
+            rem -= take
+        if rem >= min_repeat:
+            flush_packed()
+            emit_rle(val, rem)
+        elif rem:
+            packed.extend([val] * rem)
+    flush_packed(final=True)
+    return bytes(out)
+
+
+def encode_rle_len_prefixed(values: np.ndarray, bit_width: int) -> bytes:
+    body = encode_rle(values, bit_width)
+    return struct.pack("<I", len(body)) + body
+
+
+def encode_rle_dict_indices(values: np.ndarray, bit_width: int) -> bytes:
+    return bytes([bit_width]) + encode_rle(values, bit_width)
+
+
+# ---------------------------------------------------------------------------
+# BIT_PACKED (deprecated levels encoding; MSB-first bit order)
+# Reference analog: encoding/bitpacked — bitpacked.go
+# ---------------------------------------------------------------------------
+
+
+def decode_bit_packed_levels(data, num_values: int, bit_width: int) -> np.ndarray:
+    if bit_width == 0:
+        return np.zeros(num_values, dtype=np.int64)
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    bits = np.unpackbits(buf, bitorder="big")
+    need = num_values * bit_width
+    bits = bits[:need].reshape(num_values, bit_width)
+    weights = (1 << np.arange(bit_width - 1, -1, -1)).astype(np.int64)
+    return bits.astype(np.int64) @ weights
+
+
+def encode_bit_packed_levels(values: np.ndarray, bit_width: int) -> bytes:
+    if bit_width == 0 or len(values) == 0:
+        return b""
+    v = np.asarray(values, dtype=np.int64)
+    bits = ((v[:, None] >> np.arange(bit_width - 1, -1, -1)) & 1).astype(np.uint8)
+    return np.packbits(bits.reshape(-1), bitorder="big").tobytes()
+
+
+# ---------------------------------------------------------------------------
+# DELTA_BINARY_PACKED (encoding/delta — binary_packed.go + asm)
+# ---------------------------------------------------------------------------
+
+
+def decode_delta_binary_packed(data, pos: int = 0) -> Tuple[np.ndarray, int]:
+    """Returns (int64 values, end position)."""
+    block_size, pos = read_uvarint(data, pos)
+    n_miniblocks, pos = read_uvarint(data, pos)
+    total, pos = read_uvarint(data, pos)
+    first_raw, pos = read_uvarint(data, pos)
+    first = unzigzag(first_raw)
+    out = np.empty(total, dtype=np.int64)
+    if total == 0:
+        return out, pos
+    out[0] = first
+    got = 1
+    vpm = block_size // n_miniblocks  # values per miniblock
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    while got < total:
+        min_delta_raw, pos = read_uvarint(data, pos)
+        min_delta = unzigzag(min_delta_raw)
+        widths = bytes(data[pos : pos + n_miniblocks])
+        pos += n_miniblocks
+        for m in range(n_miniblocks):
+            if got >= total:
+                break
+            w = widths[m]
+            take = min(vpm, total - got)
+            if w == 0:
+                deltas = np.zeros(take, dtype=np.int64)
+            else:
+                raw = unpack_bits(buf[pos:], vpm, w)[:take]
+                deltas = raw.astype(np.int64)
+                pos += vpm * w // 8
+            if w == 0:
+                pass
+            out[got : got + take] = deltas + min_delta
+            got += take
+    # prefix sum over deltas (out currently holds first, then deltas+min)
+    np.cumsum(out[: total], out=out[: total])
+    return out, pos
+
+
+def encode_delta_binary_packed(values: np.ndarray, block_size: int = 128,
+                               n_miniblocks: int = 4) -> bytes:
+    """Encode int32/int64 values.  block_size=128, 4 miniblocks of 32 — the
+    common writer layout (vpm=32, multiple of 32 as the spec requires)."""
+    v = np.asarray(values, dtype=np.int64)
+    total = len(v)
+    out = bytearray()
+    write_uvarint(out, block_size)
+    write_uvarint(out, n_miniblocks)
+    write_uvarint(out, total)
+    if total == 0:
+        write_uvarint(out, 0)
+        return bytes(out)
+    write_uvarint(out, zigzag(int(v[0])))
+    if total == 1:
+        return bytes(out)
+    deltas = (v[1:].astype(np.uint64) - v[:-1].astype(np.uint64)).astype(np.int64)
+    vpm = block_size // n_miniblocks
+    for bstart in range(0, len(deltas), block_size):
+        block = deltas[bstart : bstart + block_size]
+        min_delta = int(block.min())
+        write_uvarint(out, zigzag(min_delta))
+        adj = (block.astype(np.uint64) - np.uint64(min_delta & 0xFFFFFFFFFFFFFFFF)).astype(np.uint64)
+        widths = []
+        chunks = []
+        for m in range(n_miniblocks):
+            mb = adj[m * vpm : (m + 1) * vpm]
+            if len(mb) == 0:
+                widths.append(0)
+                chunks.append(b"")
+                continue
+            mx = int(mb.max())
+            w = mx.bit_length()
+            widths.append(w)
+            padded = np.zeros(vpm, dtype=np.uint64)
+            padded[: len(mb)] = mb
+            chunks.append(pack_bits(padded, w) if w else b"")
+        out += bytes(widths)
+        # trailing empty miniblocks are not written
+        last_nonempty = -1
+        for m in range(n_miniblocks):
+            if m * vpm < len(block):
+                last_nonempty = m
+        for m in range(last_nonempty + 1):
+            out += chunks[m]
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# DELTA_LENGTH_BYTE_ARRAY (encoding/delta — length_byte_array.go)
+# ---------------------------------------------------------------------------
+
+
+def decode_delta_length_byte_array(data, pos: int = 0):
+    lengths, pos = decode_delta_binary_packed(data, pos)
+    offsets = np.empty(len(lengths) + 1, dtype=np.int64)
+    offsets[0] = 0
+    np.cumsum(lengths, out=offsets[1:])
+    total = int(offsets[-1])
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    values = buf[pos : pos + total].copy()
+    return values, offsets.astype(np.int32), pos + total
+
+
+def encode_delta_length_byte_array(values: np.ndarray, offsets: np.ndarray) -> bytes:
+    offs = np.asarray(offsets, dtype=np.int64)
+    lengths = offs[1:] - offs[:-1]
+    out = bytearray(encode_delta_binary_packed(lengths))
+    out += np.asarray(values, dtype=np.uint8).tobytes()
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# DELTA_BYTE_ARRAY (encoding/delta — byte_array.go; incremental/front coding)
+# ---------------------------------------------------------------------------
+
+
+def decode_delta_byte_array(data, pos: int = 0):
+    prefix_lens, pos = decode_delta_binary_packed(data, pos)
+    suffixes, soffs, pos = decode_delta_length_byte_array(data, pos)
+    n = len(prefix_lens)
+    suffix_lens = (soffs[1:] - soffs[:-1]).astype(np.int64)
+    lens = prefix_lens + suffix_lens
+    offsets = np.empty(n + 1, dtype=np.int64)
+    offsets[0] = 0
+    np.cumsum(lens, out=offsets[1:])
+    values = np.empty(int(offsets[-1]), dtype=np.uint8)
+    # sequential prefix dependency (host oracle; device path uses scan variant)
+    prev_start = 0
+    prev_len = 0
+    for i in range(n):
+        pl = int(prefix_lens[i])
+        sl = int(suffix_lens[i])
+        o = int(offsets[i])
+        if pl:
+            values[o : o + pl] = values[prev_start : prev_start + pl]
+        if sl:
+            s = int(soffs[i])
+            values[o + pl : o + pl + sl] = suffixes[s : s + sl]
+        prev_start = o
+        prev_len = pl + sl
+    return values, offsets.astype(np.int32), pos
+
+
+def encode_delta_byte_array(values: np.ndarray, offsets: np.ndarray) -> bytes:
+    offs = np.asarray(offsets, dtype=np.int64)
+    vals = np.asarray(values, dtype=np.uint8)
+    n = len(offs) - 1
+    prefix_lens = np.zeros(n, dtype=np.int64)
+    prev = b""
+    suffix_parts = []
+    for i in range(n):
+        cur = vals[offs[i] : offs[i + 1]].tobytes()
+        p = 0
+        m = min(len(prev), len(cur))
+        while p < m and prev[p] == cur[p]:
+            p += 1
+        prefix_lens[i] = p
+        suffix_parts.append(cur[p:])
+        prev = cur
+    sdata = b"".join(suffix_parts)
+    soffs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(s) for s in suffix_parts], out=soffs[1:])
+    out = bytearray(encode_delta_binary_packed(prefix_lens))
+    out += encode_delta_length_byte_array(np.frombuffer(sdata, dtype=np.uint8), soffs)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# BYTE_STREAM_SPLIT (encoding/bytestreamsplit + asm)
+# ---------------------------------------------------------------------------
+
+
+def decode_byte_stream_split(data, num_values: int, width: int) -> np.ndarray:
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    planes = buf[: width * num_values].reshape(width, num_values)
+    return np.ascontiguousarray(planes.T)  # (n, width) bytes
+
+
+def encode_byte_stream_split(raw_le_bytes: np.ndarray, num_values: int, width: int) -> bytes:
+    b = np.asarray(raw_le_bytes, dtype=np.uint8).reshape(num_values, width)
+    return np.ascontiguousarray(b.T).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Dictionary gather (dictionary.go read side)
+# ---------------------------------------------------------------------------
+
+
+def gather_dictionary(dictionary, indices: np.ndarray):
+    """dictionary: typed array or (values, offsets) pair; indices int64."""
+    if isinstance(dictionary, tuple):
+        dvals, doffs = dictionary
+        lens = (doffs[1:] - doffs[:-1]).astype(np.int64)
+        out_lens = lens[indices]
+        out_offsets = np.empty(len(indices) + 1, dtype=np.int64)
+        out_offsets[0] = 0
+        np.cumsum(out_lens, out=out_offsets[1:])
+        total = int(out_offsets[-1])
+        idx = np.repeat(doffs[:-1][indices].astype(np.int64), out_lens) + _ranges(out_lens)
+        values = dvals[idx] if total else np.empty(0, dtype=np.uint8)
+        return values, out_offsets.astype(np.int32)
+    return np.asarray(dictionary)[indices]

@@ -1,0 +1,340 @@
+"""Schema tree + Dremel level math.
+
+Reference parity: ``schema.go — Schema, SchemaOf, Deconstruct, Reconstruct`` and
+``node.go — Node, Group, Optional/Repeated/Required`` (SURVEY.md §1 L5).  The
+flat ``FileMetaData.schema`` element list is parsed into a tree; each leaf gets
+its column ordinal, dotted path, and max definition/repetition levels — the
+inputs to the vectorized Dremel assembly in ``ops/levels.py`` (the reference
+does record-at-a-time Reconstruct; we do whole-column vector math instead,
+which is the TPU-friendly formulation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..format import enums, metadata as md
+from ..format.enums import FieldRepetitionType as Rep, Type
+from . import types as _types
+from .types import LogicalKind
+
+
+@dataclass
+class Node:
+    """One element of the schema tree (group or leaf)."""
+
+    name: str
+    repetition: Rep = Rep.REQUIRED
+    # leaf fields
+    physical_type: Optional[Type] = None
+    type_length: Optional[int] = None  # FIXED_LEN_BYTE_ARRAY width
+    logical_kind: str = LogicalKind.NONE
+    logical_params: dict = field(default_factory=dict)
+    # group fields
+    children: Optional[List["Node"]] = None
+    field_id: Optional[int] = None
+    element: Optional[md.SchemaElement] = None  # original, when read from a file
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.children is None
+
+    def __repr__(self):
+        if self.is_leaf:
+            return (
+                f"Leaf({self.name!r}, {Type(self.physical_type).name}, "
+                f"{Rep(self.repetition).name}, {self.logical_kind})"
+            )
+        return f"Group({self.name!r}, {Rep(self.repetition).name}, {len(self.children)} children)"
+
+
+@dataclass
+class Leaf:
+    """Flattened leaf info: everything the column decoder needs."""
+
+    column_index: int
+    path: Tuple[str, ...]
+    node: Node
+    max_definition_level: int
+    max_repetition_level: int
+    # definition level at which this leaf's *value* is present (== max_def)
+    # and the list of (def_level, rep_level) of each ancestor, for assembly
+    ancestors: Tuple[Node, ...] = ()
+
+    @property
+    def physical_type(self) -> Type:
+        return self.node.physical_type
+
+    @property
+    def type_length(self):
+        return self.node.type_length
+
+    @property
+    def logical_kind(self):
+        return self.node.logical_kind
+
+    @property
+    def logical_params(self):
+        return self.node.logical_params
+
+    @property
+    def dotted_path(self) -> str:
+        return ".".join(self.path)
+
+    def np_dtype(self):
+        return _types.logical_np_dtype(
+            self.node.physical_type,
+            self.node.logical_kind,
+            self.node.logical_params,
+            self.node.type_length,
+        )
+
+
+class Schema:
+    """Parsed schema tree with per-leaf Dremel levels.
+
+    Construct via :meth:`from_elements` (reading) or :meth:`from_node`
+    (writing), or the :func:`schema_of` builder helpers below.
+    """
+
+    def __init__(self, root: Node):
+        self.root = root
+        self.leaves: List[Leaf] = []
+        self._by_path: Dict[Tuple[str, ...], Leaf] = {}
+        self._walk(root, (), 0, 0, ())
+        for i, leaf in enumerate(self.leaves):
+            leaf.column_index = i
+            self._by_path[leaf.path] = leaf
+
+    def _walk(self, n: Node, path, def_level, rep_level, ancestors):
+        if n is not self.root:
+            if n.repetition == Rep.OPTIONAL:
+                def_level += 1
+            elif n.repetition == Rep.REPEATED:
+                def_level += 1
+                rep_level += 1
+            path = path + (n.name,)
+            ancestors = ancestors + (n,)
+        if n.is_leaf:
+            self.leaves.append(Leaf(-1, path, n, def_level, rep_level, ancestors))
+        else:
+            for c in n.children:
+                self._walk(c, path, def_level, rep_level, ancestors)
+
+    def leaf(self, path) -> Leaf:
+        if isinstance(path, str):
+            path = tuple(path.split("."))
+        return self._by_path[tuple(path)]
+
+    def __len__(self):
+        return len(self.leaves)
+
+    # ------------------------------------------------------------------ read
+    @classmethod
+    def from_elements(cls, elements: List[md.SchemaElement]) -> "Schema":
+        """Parse the flat, depth-first FileMetaData.schema list into a tree."""
+        pos = [0]
+
+        def build() -> Node:
+            el = elements[pos[0]]
+            pos[0] += 1
+            rep = Rep(el.repetition_type) if el.repetition_type is not None else Rep.REQUIRED
+            if el.num_children:
+                children = [build() for _ in range(el.num_children)]
+                kind, params = _types._logical_from_element(el)
+                return Node(
+                    name=el.name or "",
+                    repetition=rep,
+                    children=children,
+                    field_id=el.field_id,
+                    logical_kind=kind,
+                    logical_params=params,
+                    element=el,
+                )
+            kind, params = _types._logical_from_element(el)
+            return Node(
+                name=el.name or "",
+                repetition=rep,
+                physical_type=Type(el.type),
+                type_length=el.type_length,
+                logical_kind=kind,
+                logical_params=params,
+                field_id=el.field_id,
+                element=el,
+            )
+
+        root = build()
+        if pos[0] != len(elements):
+            raise ValueError(
+                f"schema element list malformed: consumed {pos[0]} of {len(elements)}"
+            )
+        return cls(root)
+
+    # ----------------------------------------------------------------- write
+    def to_elements(self) -> List[md.SchemaElement]:
+        out: List[md.SchemaElement] = []
+
+        def emit(n: Node, is_root: bool):
+            el = md.SchemaElement(name=n.name)
+            if not is_root:
+                el.repetition_type = int(n.repetition)
+            if n.field_id is not None:
+                el.field_id = n.field_id
+            if n.is_leaf:
+                el.type = int(n.physical_type)
+                if n.physical_type == Type.FIXED_LEN_BYTE_ARRAY:
+                    el.type_length = n.type_length
+                el.logicalType, el.converted_type, extra = _logical_to_thrift(
+                    n.logical_kind, n.logical_params
+                )
+                if extra:
+                    el.scale = extra.get("scale")
+                    el.precision = extra.get("precision")
+            else:
+                el.num_children = len(n.children)
+                el.logicalType, el.converted_type, _ = _logical_to_thrift(
+                    n.logical_kind, n.logical_params
+                )
+            out.append(el)
+            if not n.is_leaf:
+                for c in n.children:
+                    emit(c, False)
+
+        emit(self.root, True)
+        return out
+
+    def __repr__(self):
+        lines = []
+
+        def p(n, indent, is_root):
+            rep = "" if is_root else Rep(n.repetition).name.lower() + " "
+            if n.is_leaf:
+                lt = f" ({n.logical_kind})" if n.logical_kind != LogicalKind.NONE else ""
+                lines.append(f"{'  '*indent}{rep}{Type(n.physical_type).name} {n.name}{lt};")
+            else:
+                kw = "message" if is_root else "group"
+                lines.append(f"{'  '*indent}{rep}{kw} {n.name} {{")
+                for c in n.children:
+                    p(c, indent + 1, False)
+                lines.append(f"{'  '*indent}}}")
+
+        p(self.root, 0, True)
+        return "\n".join(lines)
+
+
+def _logical_to_thrift(kind: str, params: dict):
+    """Map normalized logical kind → (LogicalType, converted_type, extra)."""
+    L, C = md.LogicalType, enums.ConvertedType
+    K = LogicalKind
+    if kind == K.NONE:
+        return None, None, None
+    if kind == K.STRING:
+        return L(STRING=md.StringType()), int(C.UTF8), None
+    if kind == K.ENUM:
+        return L(ENUM=md.EnumType()), int(C.ENUM), None
+    if kind == K.JSON:
+        return L(JSON=md.JsonType()), int(C.JSON), None
+    if kind == K.BSON:
+        return L(BSON=md.BsonType()), int(C.BSON), None
+    if kind == K.UUID:
+        return L(UUID=md.UUIDType()), None, None
+    if kind == K.FLOAT16:
+        return L(FLOAT16=md.Float16Type()), None, None
+    if kind == K.DATE:
+        return L(DATE=md.DateType()), int(C.DATE), None
+    if kind == K.DECIMAL:
+        return (
+            L(DECIMAL=md.DecimalType(scale=params.get("scale", 0),
+                                     precision=params.get("precision", 0))),
+            int(C.DECIMAL),
+            {"scale": params.get("scale", 0), "precision": params.get("precision", 0)},
+        )
+    if kind == K.INTERVAL:
+        return None, int(C.INTERVAL), None
+    if kind == K.LIST:
+        return L(LIST=md.ListType()), int(C.LIST), None
+    if kind == K.MAP:
+        return L(MAP=md.MapType()), int(C.MAP), None
+    unit_map = {
+        "millis": md.TimeUnit(MILLIS=md.MilliSeconds()),
+        "micros": md.TimeUnit(MICROS=md.MicroSeconds()),
+        "nanos": md.TimeUnit(NANOS=md.NanoSeconds()),
+    }
+    if kind.startswith("time_"):
+        unit = kind.split("_", 1)[1]
+        utc = params.get("utc", True)
+        ct = {"millis": int(C.TIME_MILLIS), "micros": int(C.TIME_MICROS)}.get(unit)
+        return L(TIME=md.TimeType(isAdjustedToUTC=utc, unit=unit_map[unit])), ct, None
+    if kind.startswith("timestamp_"):
+        unit = kind.split("_", 1)[1]
+        utc = params.get("utc", True)
+        ct = {
+            "millis": int(C.TIMESTAMP_MILLIS),
+            "micros": int(C.TIMESTAMP_MICROS),
+        }.get(unit)
+        return L(TIMESTAMP=md.TimestampType(isAdjustedToUTC=utc, unit=unit_map[unit])), ct, None
+    if kind == K.INT:
+        bw = params.get("bit_width", 64)
+        signed = params.get("signed", True)
+        ct_map = {
+            (8, True): C.INT_8, (16, True): C.INT_16, (32, True): C.INT_32,
+            (64, True): C.INT_64, (8, False): C.UINT_8, (16, False): C.UINT_16,
+            (32, False): C.UINT_32, (64, False): C.UINT_64,
+        }
+        ct = ct_map.get((bw, signed))
+        return (
+            L(INTEGER=md.IntType(bitWidth=bw, isSigned=signed)),
+            int(ct) if ct is not None else None,
+            None,
+        )
+    return None, None, None
+
+
+# ---------------------------------------------------------------------------
+# Builder helpers — the analog of the reference's parquet.Group{...} /
+# Optional(...)/Repeated(...)/Required(...) node constructors (node.go).
+# ---------------------------------------------------------------------------
+def leaf(name: str, physical: Type, repetition: Rep = Rep.REQUIRED,
+         logical: str = LogicalKind.NONE, type_length=None, **params) -> Node:
+    return Node(name=name, repetition=repetition, physical_type=physical,
+                type_length=type_length, logical_kind=logical, logical_params=params)
+
+
+def group(name: str, children: List[Node], repetition: Rep = Rep.REQUIRED,
+          logical: str = LogicalKind.NONE) -> Node:
+    return Node(name=name, repetition=repetition, children=children,
+                logical_kind=logical)
+
+
+def optional(n: Node) -> Node:
+    n.repetition = Rep.OPTIONAL
+    return n
+
+
+def repeated(n: Node) -> Node:
+    n.repetition = Rep.REPEATED
+    return n
+
+
+def list_of(name: str, element: Node, repetition: Rep = Rep.OPTIONAL) -> Node:
+    """Standard 3-level LIST structure: ``<name> (LIST) { repeated group list { element } }``."""
+    element.name = "element"
+    inner = Node(name="list", repetition=Rep.REPEATED, children=[element])
+    return Node(name=name, repetition=repetition, children=[inner],
+                logical_kind=LogicalKind.LIST)
+
+
+def map_of(name: str, key: Node, value: Node, repetition: Rep = Rep.OPTIONAL) -> Node:
+    key.name = "key"
+    key.repetition = Rep.REQUIRED
+    value.name = "value"
+    inner = Node(name="key_value", repetition=Rep.REPEATED, children=[key, value])
+    return Node(name=name, repetition=repetition, children=[inner],
+                logical_kind=LogicalKind.MAP)
+
+
+def message(name: str, children: List[Node]) -> Schema:
+    return Schema(Node(name=name, children=children))

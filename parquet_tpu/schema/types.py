@@ -1,0 +1,170 @@
+"""Type system: physical + logical types over schema nodes.
+
+Reference parity: ``types.go — Type, Int/Uint/String/Decimal/Date/Time/Timestamp/
+UUID/Enum/JSON/BSON nodes`` and ``node.go — Node, Group, Optional/Repeated/
+Required/List/Map`` (SURVEY.md §2.1).  TPU-first difference: every leaf maps to a
+fixed-width numpy/JAX dtype plus (for BYTE_ARRAY) an Arrow-style values+offsets
+pair, so decoded columns are flat device arrays, never Python objects.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..format import enums, metadata as md
+from ..format.enums import ConvertedType, FieldRepetitionType, Type
+
+__all__ = [
+    "PHYSICAL_NP_DTYPE",
+    "PHYSICAL_WIDTH",
+    "LogicalKind",
+    "logical_np_dtype",
+    "node",
+]
+
+# numpy dtypes for fixed-width physical types.  BOOLEAN decodes to uint8 then
+# bool; INT96 decodes to a (n, 3) int32 view; BYTE_ARRAY / FLBA are byte blobs.
+PHYSICAL_NP_DTYPE = {
+    Type.BOOLEAN: np.dtype(np.bool_),
+    Type.INT32: np.dtype(np.int32),
+    Type.INT64: np.dtype(np.int64),
+    Type.FLOAT: np.dtype(np.float32),
+    Type.DOUBLE: np.dtype(np.float64),
+}
+
+# byte width of one value, None for variable / bit-packed
+PHYSICAL_WIDTH = {
+    Type.BOOLEAN: None,  # bit-packed in PLAIN
+    Type.INT32: 4,
+    Type.INT64: 8,
+    Type.INT96: 12,
+    Type.FLOAT: 4,
+    Type.DOUBLE: 8,
+    Type.BYTE_ARRAY: None,
+    Type.FIXED_LEN_BYTE_ARRAY: None,  # from type_length
+}
+
+
+class LogicalKind:
+    """Normalized logical annotation for a leaf (new LogicalType and legacy
+    ConvertedType collapse into one of these)."""
+
+    NONE = "none"
+    STRING = "string"
+    ENUM = "enum"
+    JSON = "json"
+    BSON = "bson"
+    UUID = "uuid"
+    DECIMAL = "decimal"
+    DATE = "date"
+    TIME_MILLIS = "time_millis"
+    TIME_MICROS = "time_micros"
+    TIME_NANOS = "time_nanos"
+    TIMESTAMP_MILLIS = "timestamp_millis"
+    TIMESTAMP_MICROS = "timestamp_micros"
+    TIMESTAMP_NANOS = "timestamp_nanos"
+    INT = "int"  # carries bit_width / signed
+    FLOAT16 = "float16"
+    INTERVAL = "interval"
+    LIST = "list"
+    MAP = "map"
+
+
+def _logical_from_element(el: md.SchemaElement):
+    """Normalize SchemaElement.{logicalType, converted_type} → (kind, params)."""
+    lt = el.logicalType
+    if lt is not None:
+        if lt.STRING is not None:
+            return LogicalKind.STRING, {}
+        if lt.ENUM is not None:
+            return LogicalKind.ENUM, {}
+        if lt.JSON is not None:
+            return LogicalKind.JSON, {}
+        if lt.BSON is not None:
+            return LogicalKind.BSON, {}
+        if lt.UUID is not None:
+            return LogicalKind.UUID, {}
+        if lt.FLOAT16 is not None:
+            return LogicalKind.FLOAT16, {}
+        if lt.DECIMAL is not None:
+            return LogicalKind.DECIMAL, {
+                "scale": lt.DECIMAL.scale or 0,
+                "precision": lt.DECIMAL.precision or 0,
+            }
+        if lt.DATE is not None:
+            return LogicalKind.DATE, {}
+        if lt.TIME is not None:
+            u = lt.TIME.unit
+            if u.MILLIS is not None:
+                return LogicalKind.TIME_MILLIS, {"utc": bool(lt.TIME.isAdjustedToUTC)}
+            if u.MICROS is not None:
+                return LogicalKind.TIME_MICROS, {"utc": bool(lt.TIME.isAdjustedToUTC)}
+            return LogicalKind.TIME_NANOS, {"utc": bool(lt.TIME.isAdjustedToUTC)}
+        if lt.TIMESTAMP is not None:
+            u = lt.TIMESTAMP.unit
+            utc = bool(lt.TIMESTAMP.isAdjustedToUTC)
+            if u.MILLIS is not None:
+                return LogicalKind.TIMESTAMP_MILLIS, {"utc": utc}
+            if u.MICROS is not None:
+                return LogicalKind.TIMESTAMP_MICROS, {"utc": utc}
+            return LogicalKind.TIMESTAMP_NANOS, {"utc": utc}
+        if lt.INTEGER is not None:
+            return LogicalKind.INT, {
+                "bit_width": lt.INTEGER.bitWidth or 64,
+                "signed": bool(lt.INTEGER.isSigned),
+            }
+        if lt.LIST is not None:
+            return LogicalKind.LIST, {}
+        if lt.MAP is not None:
+            return LogicalKind.MAP, {}
+    ct = el.converted_type
+    if ct is None:
+        return LogicalKind.NONE, {}
+    C = ConvertedType
+    table = {
+        C.UTF8: (LogicalKind.STRING, {}),
+        C.ENUM: (LogicalKind.ENUM, {}),
+        C.JSON: (LogicalKind.JSON, {}),
+        C.BSON: (LogicalKind.BSON, {}),
+        C.DATE: (LogicalKind.DATE, {}),
+        C.TIME_MILLIS: (LogicalKind.TIME_MILLIS, {"utc": True}),
+        C.TIME_MICROS: (LogicalKind.TIME_MICROS, {"utc": True}),
+        C.TIMESTAMP_MILLIS: (LogicalKind.TIMESTAMP_MILLIS, {"utc": True}),
+        C.TIMESTAMP_MICROS: (LogicalKind.TIMESTAMP_MICROS, {"utc": True}),
+        C.INTERVAL: (LogicalKind.INTERVAL, {}),
+        C.LIST: (LogicalKind.LIST, {}),
+        C.MAP: (LogicalKind.MAP, {}),
+        C.DECIMAL: (
+            LogicalKind.DECIMAL,
+            {"scale": el.scale or 0, "precision": el.precision or 0},
+        ),
+    }
+    if ct in table:
+        return table[ct]
+    if C.UINT_8 <= ct <= C.INT_64:
+        signed = ct >= C.INT_8
+        bit_width = {
+            C.UINT_8: 8, C.UINT_16: 16, C.UINT_32: 32, C.UINT_64: 64,
+            C.INT_8: 8, C.INT_16: 16, C.INT_32: 32, C.INT_64: 64,
+        }[ct]
+        return LogicalKind.INT, {"bit_width": bit_width, "signed": signed}
+    return LogicalKind.NONE, {}
+
+
+def logical_np_dtype(physical: Type, kind: str, params: dict, type_length=None):
+    """The user-facing numpy dtype a decoded leaf column is presented as."""
+    if physical == Type.INT32 and kind == LogicalKind.INT:
+        bw, signed = params["bit_width"], params["signed"]
+        return np.dtype(f"{'i' if signed else 'u'}{max(bw, 8) // 8}")
+    if physical == Type.INT64 and kind == LogicalKind.INT:
+        return np.dtype("i8" if params["signed"] else "u8")
+    if kind == LogicalKind.FLOAT16:
+        return np.dtype(np.float16)
+    if physical in PHYSICAL_NP_DTYPE:
+        return PHYSICAL_NP_DTYPE[physical]
+    return None  # variable width: values+offsets or fixed blob
+
+
+def node(el: md.SchemaElement):
+    kind, params = _logical_from_element(el)
+    return kind, params

@@ -1,0 +1,178 @@
+"""Oracle encoding tests: numpy reference implementations round-trip, plus
+pyarrow cross-checks where pyarrow exposes the encoding.
+
+Pattern per SURVEY.md §4(4): every device kernel is tested against these
+oracles; these oracles are themselves pinned by pyarrow interop in
+test_reader.py / test_writer.py.
+"""
+
+import numpy as np
+import pytest
+
+from parquet_tpu.format.enums import Type
+from parquet_tpu.ops import ref
+
+WIDTHS = [1, 2, 3, 4, 5, 7, 8, 9, 12, 15, 16, 17, 20, 24, 25, 31, 32, 33, 40, 47, 48, 57, 63, 64]
+
+
+@pytest.mark.parametrize("w", WIDTHS)
+def test_bitpack_roundtrip(w, rng):
+    n = 1013
+    hi = (1 << w) - 1
+    v = rng.integers(0, min(hi, 2**63 - 1), size=n, dtype=np.uint64, endpoint=True) & np.uint64(hi)
+    packed = ref.pack_bits(v, w)
+    assert len(packed) == (n * w + 7) // 8
+    u = ref.unpack_bits(np.frombuffer(packed, np.uint8), n, w)
+    np.testing.assert_array_equal(u, v)
+
+
+def test_bitpack_offset_bits(rng):
+    v = rng.integers(0, 8, size=64, dtype=np.uint64)
+    packed = np.frombuffer(ref.pack_bits(v, 3), np.uint8)
+    # read starting mid-stream
+    u = ref.unpack_bits(packed, 60, 3, offset_bits=4 * 3)
+    np.testing.assert_array_equal(u, v[4:])
+
+
+@pytest.mark.parametrize("w", [1, 2, 3, 5, 8, 12, 20, 31])
+@pytest.mark.parametrize("style", ["runs", "rand", "mixed", "alternating"])
+def test_rle_roundtrip(w, style, rng):
+    n = 3777
+    if style == "runs":
+        v = np.repeat(rng.integers(0, 1 << w, size=50), rng.integers(1, 200, size=50))[:n]
+    elif style == "rand":
+        v = rng.integers(0, 1 << w, size=n)
+    elif style == "alternating":
+        v = np.arange(n) % 2
+    else:
+        v = np.where(rng.random(n) < 0.5, 1, rng.integers(0, 1 << w, size=n))
+    n = len(v)
+    enc = ref.encode_rle(v, w)
+    dec = ref.decode_rle(np.frombuffer(enc, np.uint8), n, w)
+    np.testing.assert_array_equal(dec, v)
+
+
+def test_rle_len_prefixed_roundtrip(rng):
+    v = rng.integers(0, 4, size=999)
+    enc = ref.encode_rle_len_prefixed(v, 2)
+    dec, end = ref.decode_rle_len_prefixed(np.frombuffer(enc, np.uint8), 999, 2)
+    assert end == len(enc)
+    np.testing.assert_array_equal(dec, v)
+
+
+def test_rle_dict_indices_roundtrip(rng):
+    v = rng.integers(0, 1000, size=5000)
+    enc = ref.encode_rle_dict_indices(v, 10)
+    dec = ref.decode_rle_dict_indices(np.frombuffer(enc, np.uint8), 5000)
+    np.testing.assert_array_equal(dec, v)
+    # zero-width: single dictionary entry
+    z = np.zeros(100, dtype=np.int64)
+    enc = ref.encode_rle_dict_indices(z, 0)
+    dec = ref.decode_rle_dict_indices(np.frombuffer(enc, np.uint8), 100)
+    np.testing.assert_array_equal(dec, z)
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 31, 32, 33, 127, 128, 129, 1000])
+@pytest.mark.parametrize("kind", ["rand64", "rand32", "sorted", "const", "extremes"])
+def test_delta_binary_packed_roundtrip(n, kind, rng):
+    if kind == "rand64":
+        v = rng.integers(-(2**62), 2**62, size=n)
+    elif kind == "rand32":
+        v = rng.integers(-(2**31), 2**31, size=n)
+    elif kind == "sorted":
+        v = np.sort(rng.integers(0, 10**12, size=n))
+    elif kind == "const":
+        v = np.full(n, 42, dtype=np.int64)
+    else:
+        v = rng.choice(
+            np.array([np.iinfo(np.int64).min, np.iinfo(np.int64).max, 0, -1, 1]), size=n
+        )
+    enc = ref.encode_delta_binary_packed(v)
+    dec, end = ref.decode_delta_binary_packed(np.frombuffer(enc, np.uint8))
+    assert end == len(enc)
+    np.testing.assert_array_equal(dec, v)
+
+
+def _random_strings(rng, n):
+    parts = [
+        (f"value-{i % 97}" * int(rng.integers(0, 4))).encode() for i in range(n)
+    ]
+    data = np.frombuffer(b"".join(parts), np.uint8)
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum([len(p) for p in parts], out=offs[1:])
+    return data, offs, parts
+
+
+def test_plain_byte_array_roundtrip(rng):
+    data, offs, parts = _random_strings(rng, 500)
+    enc = ref.encode_plain(data, Type.BYTE_ARRAY, offsets=offs)
+    vals, o2 = ref._decode_plain_byte_array(np.frombuffer(enc, np.uint8), 500)
+    np.testing.assert_array_equal(o2, offs)
+    assert vals.tobytes() == data.tobytes()
+
+
+def test_delta_length_byte_array_roundtrip(rng):
+    data, offs, _ = _random_strings(rng, 500)
+    enc = ref.encode_delta_length_byte_array(data, offs)
+    v2, o2, end = ref.decode_delta_length_byte_array(np.frombuffer(enc, np.uint8))
+    assert end == len(enc)
+    np.testing.assert_array_equal(o2, offs)
+    assert v2.tobytes() == data.tobytes()
+
+
+def test_delta_byte_array_roundtrip(rng):
+    _, _, parts = _random_strings(rng, 400)
+    parts = sorted(parts)  # front-coding shines on sorted input
+    data = np.frombuffer(b"".join(parts), np.uint8)
+    offs = np.zeros(len(parts) + 1, np.int64)
+    np.cumsum([len(p) for p in parts], out=offs[1:])
+    enc = ref.encode_delta_byte_array(data, offs)
+    v2, o2, end = ref.decode_delta_byte_array(np.frombuffer(enc, np.uint8))
+    assert end == len(enc)
+    np.testing.assert_array_equal(o2, offs)
+    assert v2.tobytes() == data.tobytes()
+
+
+@pytest.mark.parametrize("dtype,width", [(np.float32, 4), (np.float64, 8)])
+def test_byte_stream_split_roundtrip(dtype, width, rng):
+    f = rng.random(777).astype(dtype)
+    raw = np.frombuffer(f.tobytes(), np.uint8)
+    enc = ref.encode_byte_stream_split(raw, 777, width)
+    dec = ref.decode_byte_stream_split(np.frombuffer(enc, np.uint8), 777, width)
+    assert dec.reshape(-1).tobytes() == f.tobytes()
+
+
+def test_plain_fixed_widths(rng):
+    for t, dt in [(Type.INT32, np.int32), (Type.INT64, np.int64),
+                  (Type.FLOAT, np.float32), (Type.DOUBLE, np.float64)]:
+        v = rng.integers(-1000, 1000, size=321).astype(dt)
+        enc = ref.encode_plain(v, t)
+        dec = ref.decode_plain(np.frombuffer(enc, np.uint8), 321, t)
+        np.testing.assert_array_equal(dec, v)
+    b = rng.random(1003) < 0.5
+    enc = ref.encode_plain(b, Type.BOOLEAN)
+    dec = ref.decode_plain(np.frombuffer(enc, np.uint8), 1003, Type.BOOLEAN)
+    np.testing.assert_array_equal(dec, b)
+    flba = rng.integers(0, 256, size=(57, 16)).astype(np.uint8)
+    enc = ref.encode_plain(flba, Type.FIXED_LEN_BYTE_ARRAY)
+    dec = ref.decode_plain(np.frombuffer(enc, np.uint8), 57, Type.FIXED_LEN_BYTE_ARRAY, type_length=16)
+    np.testing.assert_array_equal(dec, flba)
+
+
+def test_bit_packed_legacy_levels(rng):
+    v = rng.integers(0, 4, size=100)
+    enc = ref.encode_bit_packed_levels(v, 2)
+    dec = ref.decode_bit_packed_levels(np.frombuffer(enc, np.uint8), 100, 2)
+    np.testing.assert_array_equal(dec, v)
+
+
+def test_dictionary_gather(rng):
+    dict_vals = rng.integers(0, 10**9, size=1000).astype(np.int64)
+    idx = rng.integers(0, 1000, size=5000)
+    out = ref.gather_dictionary(dict_vals, idx)
+    np.testing.assert_array_equal(out, dict_vals[idx])
+    # byte-array dictionary
+    data, offs, parts = _random_strings(rng, 100)
+    vals, o2 = ref.gather_dictionary((data, offs), idx % 100)
+    expect = b"".join(parts[i] for i in (idx % 100))
+    assert vals.tobytes() == expect

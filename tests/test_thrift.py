@@ -1,0 +1,113 @@
+"""L0 tests: compact protocol against pyarrow-written footers + self round-trip."""
+
+import io
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.format import enums, metadata as md, thrift
+
+
+def _pyarrow_file_bytes(**write_kwargs) -> bytes:
+    t = pa.table(
+        {
+            "a": pa.array(np.arange(100, dtype=np.int64)),
+            "b": pa.array(np.linspace(0, 1, 100)),
+            "s": pa.array([f"s{i % 5}" for i in range(100)]),
+            "opt": pa.array([None if i % 3 == 0 else i for i in range(100)], type=pa.int32()),
+        }
+    )
+    buf = io.BytesIO()
+    pq.write_table(t, buf, **write_kwargs)
+    return buf.getvalue()
+
+
+def _footer(raw: bytes) -> md.FileMetaData:
+    flen = struct.unpack("<I", raw[-8:-4])[0]
+    fmd, consumed = thrift.deserialize(md.FileMetaData, raw[-8 - flen : -8])
+    assert consumed == flen  # every byte accounted for
+    return fmd
+
+
+@pytest.mark.parametrize("compression", ["none", "snappy", "zstd", "gzip"])
+def test_footer_parses(compression):
+    raw = _pyarrow_file_bytes(compression=compression)
+    fmd = _footer(raw)
+    assert fmd.num_rows == 100
+    assert len(fmd.row_groups) == 1
+    assert len(fmd.row_groups[0].columns) == 4
+    names = [s.name for s in fmd.schema[1:]]
+    assert names == ["a", "b", "s", "opt"]
+
+
+def test_footer_with_page_index():
+    raw = _pyarrow_file_bytes(write_page_index=True)
+    fmd = _footer(raw)
+    col = fmd.row_groups[0].columns[0]
+    assert col.column_index_offset is not None
+    ci, _ = thrift.deserialize(md.ColumnIndex, raw, col.column_index_offset)
+    assert ci.null_pages == [False]
+    assert ci.min_values is not None and ci.max_values is not None
+    oi, _ = thrift.deserialize(md.OffsetIndex, raw, col.offset_index_offset)
+    assert oi.page_locations[0].first_row_index == 0
+
+
+def test_page_header_parses():
+    raw = _pyarrow_file_bytes(compression="snappy")
+    fmd = _footer(raw)
+    m = fmd.row_groups[0].columns[0].meta_data
+    off = m.dictionary_page_offset if m.dictionary_page_offset is not None else m.data_page_offset
+    ph, _ = thrift.deserialize(md.PageHeader, raw, off)
+    assert ph.type in (int(enums.PageType.DATA_PAGE), int(enums.PageType.DICTIONARY_PAGE),
+                       int(enums.PageType.DATA_PAGE_V2))
+    assert ph.compressed_page_size > 0
+
+
+def test_roundtrip_serialize():
+    raw = _pyarrow_file_bytes(write_page_index=True, compression="zstd")
+    fmd = _footer(raw)
+    blob = thrift.serialize(fmd)
+    fmd2, consumed = thrift.deserialize(md.FileMetaData, blob)
+    assert consumed == len(blob)
+    assert fmd2.num_rows == fmd.num_rows
+    assert len(fmd2.schema) == len(fmd.schema)
+    for a, b in zip(fmd.schema, fmd2.schema):
+        assert (a.name, a.type, a.repetition_type, a.converted_type) == (
+            b.name, b.type, b.repetition_type, b.converted_type)
+    m1 = fmd.row_groups[0].columns[2].meta_data
+    m2 = fmd2.row_groups[0].columns[2].meta_data
+    assert m1.path_in_schema == m2.path_in_schema
+    assert m1.statistics.min_value == m2.statistics.min_value
+
+
+def test_unknown_fields_skipped():
+    # a struct with extra fields our spec doesn't know: craft KeyValue + extras
+    w = thrift.CompactWriter()
+    # field 1 (string "k"), unknown field 5 (i64), unknown field 6 (list<i32>), field 2 (string "v")
+    w.out.append((1 << 4) | 0x08)
+    w.write_bytes(b"k")
+    w.out.append((4 << 4) | 0x06)
+    w.write_zigzag(123456789)
+    w.out.append((1 << 4) | 0x09)
+    w.out.append((3 << 4) | 0x05)
+    for x in (1, 2, 3):
+        w.write_zigzag(x)
+    # field 2 via long-form header (delta 0 escape)
+    w.out.append(0x08)
+    w.write_zigzag(2)
+    w.write_bytes(b"v")
+    w.out.append(0x00)
+    kv, consumed = thrift.deserialize(md.KeyValue, w.getvalue())
+    assert consumed == len(w.getvalue())
+    assert kv.key == "k" and kv.value == "v"
+
+
+def test_zigzag_edge_values():
+    for n in [0, -1, 1, 2**31 - 1, -(2**31), 2**63 - 1, -(2**63)]:
+        w = thrift.CompactWriter()
+        w.write_zigzag(n)
+        r = thrift.CompactReader(w.getvalue())
+        assert r.read_zigzag() == n
