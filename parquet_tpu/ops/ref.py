@@ -297,7 +297,7 @@ def decode_rle_len_prefixed(data, num_values: int, bit_width: int, pos: int = 0)
 
 def decode_rle_dict_indices(data, num_values: int, pos: int = 0) -> np.ndarray:
     """RLE_DICTIONARY data page payload: 1-byte bit width, then hybrid stream."""
-    bit_width = data[pos]
+    bit_width = int(data[pos])
     if bit_width == 0:
         return np.zeros(num_values, dtype=np.int64)
     return decode_rle(data, num_values, bit_width, pos + 1)
