@@ -177,17 +177,14 @@ def _decode_plain_byte_array(buf: np.ndarray, num_values: int):
 
 
 def _ranges(lengths: np.ndarray) -> np.ndarray:
-    """[0..l0), [0..l1), ... concatenated (segmented iota)."""
+    """[0..l0), [0..l1), ... concatenated (segmented iota); zero lengths fine."""
     total = int(lengths.sum())
     if total == 0:
         return np.empty(0, dtype=np.int64)
-    ends = np.cumsum(lengths)
-    out = np.ones(total, dtype=np.int64)
-    out[0] = 0
-    starts = ends[:-1]
-    nz = lengths[1:] > 0
-    out[starts[nz]] = 1 - lengths[:-1][nz]
-    return np.cumsum(out)
+    starts = np.empty(len(lengths), dtype=np.int64)
+    starts[0] = 0
+    np.cumsum(lengths[:-1], out=starts[1:])
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)
 
 
 def encode_plain(values, physical: Type, offsets: Optional[np.ndarray] = None) -> bytes:
