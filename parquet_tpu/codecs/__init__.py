@@ -1,0 +1,291 @@
+"""Compression codec registry.
+
+Reference parity: ``compress/compress.go — Codec`` interface with stateless
+singleton implementations per ``format.CompressionCodec`` enum value
+(SURVEY.md §2.2).  The reference backs these with Go libraries
+(klauspost/compress etc.); here LZ-family codecs bind the system C libraries
+directly via ctypes (libsnappy / libzstd / liblz4 / libbrotli) — host-side by
+design: LZ77 back-references are sequential and do not vectorize onto the MXU,
+so the pipeline hides decompression behind H2D staging instead (SURVEY.md §7
+hard part 3).
+
+API: ``Codec.decode(data: bytes|memoryview, uncompressed_size: int) -> bytes``
+and ``Codec.encode(data) -> bytes``; look up singletons with :func:`get_codec`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import struct
+import zlib
+from typing import Dict, Optional
+
+from ..format.enums import CompressionCodec
+
+__all__ = ["Codec", "get_codec", "CODECS", "is_supported"]
+
+
+class Codec:
+    codec_id: CompressionCodec = None  # type: ignore
+    name: str = ""
+
+    def encode(self, data) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<Codec {self.name}>"
+
+
+class UncompressedCodec(Codec):
+    codec_id = CompressionCodec.UNCOMPRESSED
+    name = "UNCOMPRESSED"
+
+    def encode(self, data) -> bytes:
+        return bytes(data)
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        return bytes(data)
+
+
+# ---------------------------------------------------------------------------
+# Snappy (raw block format, as required by the Parquet spec)
+# ---------------------------------------------------------------------------
+def _load(libname: str) -> Optional[ctypes.CDLL]:
+    for cand in (libname, ctypes.util.find_library(libname.split(".")[0].replace("lib", ""))):
+        if not cand:
+            continue
+        try:
+            return ctypes.CDLL(cand)
+        except OSError:
+            continue
+    return None
+
+
+class SnappyCodec(Codec):
+    codec_id = CompressionCodec.SNAPPY
+    name = "SNAPPY"
+
+    def __init__(self):
+        lib = _load("libsnappy.so.1")
+        if lib is None:
+            raise RuntimeError("libsnappy not found")
+        lib.snappy_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.snappy_uncompress.argtypes = lib.snappy_compress.argtypes
+        lib.snappy_max_compressed_length.restype = ctypes.c_size_t
+        lib.snappy_max_compressed_length.argtypes = [ctypes.c_size_t]
+        lib.snappy_uncompressed_length.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_size_t)]
+        self._lib = lib
+
+    def encode(self, data) -> bytes:
+        data = bytes(data)
+        n = len(data)
+        cap = self._lib.snappy_max_compressed_length(n)
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_size_t(cap)
+        rc = self._lib.snappy_compress(data, n, out, ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"snappy_compress failed rc={rc}")
+        return out.raw[: out_len.value]
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        data = bytes(data)
+        out = ctypes.create_string_buffer(uncompressed_size) if uncompressed_size else ctypes.create_string_buffer(1)
+        out_len = ctypes.c_size_t(uncompressed_size)
+        rc = self._lib.snappy_uncompress(data, len(data), out, ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"snappy_uncompress failed rc={rc}")
+        return out.raw[: out_len.value]
+
+
+class GzipCodec(Codec):
+    """RFC 1952 gzip framing over deflate (parquet GZIP codec)."""
+
+    codec_id = CompressionCodec.GZIP
+    name = "GZIP"
+
+    def encode(self, data) -> bytes:
+        c = zlib.compressobj(6, zlib.DEFLATED, 16 + 15)
+        return c.compress(bytes(data)) + c.flush()
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        # 32+15: auto-detect gzip or zlib header (tolerant, like the reference's lib)
+        return zlib.decompress(bytes(data), 32 + 15)
+
+
+class ZstdCodec(Codec):
+    codec_id = CompressionCodec.ZSTD
+    name = "ZSTD"
+
+    def __init__(self, level: int = 3):
+        import zstandard
+
+        self._c = zstandard.ZstdCompressor(level=level)
+        self._d = zstandard.ZstdDecompressor()
+
+    def encode(self, data) -> bytes:
+        return self._c.compress(bytes(data))
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        return self._d.decompress(bytes(data), max_output_size=max(uncompressed_size, 1))
+
+
+class Lz4RawCodec(Codec):
+    """LZ4 block format (LZ4_RAW, the modern parquet lz4 codec)."""
+
+    codec_id = CompressionCodec.LZ4_RAW
+    name = "LZ4_RAW"
+
+    def __init__(self):
+        lib = _load("liblz4.so.1")
+        if lib is None:
+            raise RuntimeError("liblz4 not found")
+        lib.LZ4_compress_default.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.LZ4_compress_default.restype = ctypes.c_int
+        lib.LZ4_decompress_safe.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.LZ4_decompress_safe.restype = ctypes.c_int
+        lib.LZ4_compressBound.argtypes = [ctypes.c_int]
+        lib.LZ4_compressBound.restype = ctypes.c_int
+        self._lib = lib
+
+    def encode(self, data) -> bytes:
+        data = bytes(data)
+        cap = self._lib.LZ4_compressBound(len(data))
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.LZ4_compress_default(data, out, len(data), cap)
+        if n <= 0:
+            raise RuntimeError("LZ4_compress_default failed")
+        return out.raw[:n]
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        data = bytes(data)
+        out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+        n = self._lib.LZ4_decompress_safe(data, out, len(data), uncompressed_size)
+        if n < 0:
+            raise RuntimeError(f"LZ4_decompress_safe failed rc={n}")
+        return out.raw[:n]
+
+
+class Lz4HadoopCodec(Codec):
+    """Deprecated Hadoop-framed LZ4 (codec id LZ4): one or more
+    [4B BE uncompressed_len][4B BE compressed_len][lz4 block] frames.
+
+    Written by old parquet-mr; read support matters more than write.  Some
+    writers emitted plain lz4 blocks under this id too, so decode falls back.
+    """
+
+    codec_id = CompressionCodec.LZ4
+    name = "LZ4"
+
+    def __init__(self):
+        self._raw = Lz4RawCodec()
+
+    def encode(self, data) -> bytes:
+        data = bytes(data)
+        block = self._raw.encode(data)
+        return struct.pack(">II", len(data), len(block)) + block
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        data = bytes(data)
+        out = bytearray()
+        pos = 0
+        try:
+            while pos < len(data) and len(out) < uncompressed_size:
+                ulen, clen = struct.unpack_from(">II", data, pos)
+                if ulen > (1 << 31) or clen > len(data) - pos - 8:
+                    raise ValueError("implausible frame")
+                pos += 8
+                out += self._raw.decode(data[pos : pos + clen], ulen)
+                pos += clen
+            if len(out) != uncompressed_size:
+                raise ValueError("hadoop lz4 length mismatch")
+            return bytes(out)
+        except Exception:
+            # fallback: bare lz4 block
+            return self._raw.decode(data, uncompressed_size)
+
+
+class BrotliCodec(Codec):
+    codec_id = CompressionCodec.BROTLI
+    name = "BROTLI"
+
+    def __init__(self):
+        dec = _load("libbrotlidec.so.1")
+        enc = _load("libbrotlienc.so.1")
+        if dec is None or enc is None:
+            raise RuntimeError("libbrotli not found")
+        dec.BrotliDecoderDecompress.argtypes = [
+            ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p]
+        dec.BrotliDecoderDecompress.restype = ctypes.c_int
+        enc.BrotliEncoderCompress.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p]
+        enc.BrotliEncoderCompress.restype = ctypes.c_int
+        self._dec, self._enc = dec, enc
+
+    def encode(self, data) -> bytes:
+        data = bytes(data)
+        cap = len(data) + len(data) // 2 + 1024
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_size_t(cap)
+        # quality 5, lgwin 22, mode generic
+        rc = self._enc.BrotliEncoderCompress(5, 22, 0, len(data), data,
+                                             ctypes.byref(out_len), out)
+        if rc != 1:
+            raise RuntimeError("BrotliEncoderCompress failed")
+        return out.raw[: out_len.value]
+
+    def decode(self, data, uncompressed_size: int) -> bytes:
+        data = bytes(data)
+        out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+        out_len = ctypes.c_size_t(uncompressed_size)
+        rc = self._dec.BrotliDecoderDecompress(len(data), data,
+                                               ctypes.byref(out_len), out)
+        if rc != 1:
+            raise RuntimeError("BrotliDecoderDecompress failed")
+        return out.raw[: out_len.value]
+
+
+# ---------------------------------------------------------------------------
+# Registry (lazy singletons: a missing system lib disables one codec, not all)
+# ---------------------------------------------------------------------------
+_FACTORIES = {
+    CompressionCodec.UNCOMPRESSED: UncompressedCodec,
+    CompressionCodec.SNAPPY: SnappyCodec,
+    CompressionCodec.GZIP: GzipCodec,
+    CompressionCodec.ZSTD: ZstdCodec,
+    CompressionCodec.LZ4_RAW: Lz4RawCodec,
+    CompressionCodec.LZ4: Lz4HadoopCodec,
+    CompressionCodec.BROTLI: BrotliCodec,
+}
+
+CODECS: Dict[CompressionCodec, Codec] = {}
+
+
+def get_codec(codec_id) -> Codec:
+    codec_id = CompressionCodec(codec_id)
+    c = CODECS.get(codec_id)
+    if c is None:
+        factory = _FACTORIES.get(codec_id)
+        if factory is None:
+            raise ValueError(f"unsupported compression codec {codec_id!r}")
+        c = CODECS[codec_id] = factory()
+    return c
+
+
+def is_supported(codec_id) -> bool:
+    try:
+        get_codec(codec_id)
+        return True
+    except Exception:
+        return False
