@@ -1,0 +1,6 @@
+"""Split-block bloom filter (SBBF) — placeholder, full impl lands with writer.
+
+Reference parity: bloom.go — SplitBlockFilter + bloom/block_amd64.s.
+"""
+def read_bloom_filter(reader):
+    raise NotImplementedError("bloom filters land with the writer milestone")

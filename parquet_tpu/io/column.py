@@ -1,0 +1,256 @@
+"""Decoded column representation: flat device-friendly buffers.
+
+Reference parity: the reference's decoded page values flow through
+``page.Data() encoding.Values`` — a kind-tagged union of flat ``data []byte``
++ ``offsets []int32`` (SURVEY.md §2.2).  ``Column`` is the whole-chunk analog:
+dense value buffer + optional offsets (byte arrays) + validity/list structure
+from Dremel assembly.  ``to_arrow()`` reconstructs a pyarrow array (the interop
+boundary and test oracle); values/offsets/validity may live on device as
+jax.Arrays in the TPU path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..format.enums import Type
+from ..schema.schema import Leaf
+from ..schema.types import LogicalKind
+
+
+@dataclass
+class Column:
+    leaf: Leaf
+    values: Any  # np/jax array: dense present values (fixed width) or uint8 bytes
+    offsets: Optional[Any] = None  # int32[n+1] for BYTE_ARRAY values
+    validity: Optional[Any] = None  # bool per leaf slot (None = all valid)
+    list_offsets: List[Any] = field(default_factory=list)  # per repeated level
+    list_validity: List[Optional[Any]] = field(default_factory=list)
+    num_slots: int = 0  # leaf slot count (== num rows for flat columns)
+
+    @property
+    def num_values(self) -> int:
+        if self.offsets is not None:
+            return len(self.offsets) - 1
+        return len(self.values)
+
+    # ------------------------------------------------------------------
+    def to_numpy(self):
+        """Present values as numpy; nulls are NOT filled (dense values only)."""
+        return np.asarray(self.values)
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        leaf = self.leaf
+        values = np.asarray(self.values)
+        offsets = None if self.offsets is None else np.asarray(self.offsets)
+        validity = None if self.validity is None else np.asarray(self.validity)
+
+        arr = _leaf_to_arrow(leaf, values, offsets, validity)
+        # wrap in list layers, innermost last in list_offsets → build outside-in
+        for offs, lv in zip(reversed(self.list_offsets), reversed(self.list_validity)):
+            offs = np.asarray(offs).astype(np.int32)
+            if lv is not None and not bool(np.all(lv)):
+                mask = pa.array(~np.asarray(lv))
+                arr = pa.ListArray.from_arrays(pa.array(offs), arr, mask=mask)
+            else:
+                arr = pa.ListArray.from_arrays(pa.array(offs), arr)
+        return arr
+
+
+def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
+    import pyarrow as pa
+
+    k = leaf.logical_kind
+    pt = leaf.physical_type
+    n_slots = len(validity) if validity is not None else None
+
+    if pt == Type.BYTE_ARRAY:
+        # expand dense values to slot-aligned with validity
+        if validity is not None:
+            arr = _ragged_with_nulls(values, offsets, validity)
+        else:
+            arr = pa.Array.from_buffers(
+                pa.binary(), len(offsets) - 1,
+                [None, pa.py_buffer(offsets.astype(np.int32).tobytes()),
+                 pa.py_buffer(np.asarray(values, dtype=np.uint8).tobytes())])
+        if k in (LogicalKind.STRING, LogicalKind.ENUM, LogicalKind.JSON):
+            arr = arr.cast(pa.string())
+        elif k == LogicalKind.DECIMAL:
+            pass  # decimal-from-binary left as bytes
+        return arr
+
+    if pt == Type.FIXED_LEN_BYTE_ARRAY:
+        width = leaf.type_length
+        vals = np.asarray(values, dtype=np.uint8).reshape(-1, width)
+        if k == LogicalKind.FLOAT16:
+            flat = vals.reshape(-1).view(np.float16)
+            return _fixed_with_nulls(flat, validity, pa.float16())
+        if k == LogicalKind.DECIMAL:
+            p, s = leaf.logical_params.get("precision", 38), leaf.logical_params.get("scale", 0)
+            ints = _be_bytes_to_int(vals)
+            return _decimal_with_nulls(ints, validity, pa.decimal128(p, s))
+        if validity is None:
+            return pa.FixedSizeBinaryArray.from_buffers(
+                pa.binary(width), len(vals), [None, pa.py_buffer(vals.tobytes())])
+        return _fsb_with_nulls(vals, validity, width)
+
+    if pt == Type.INT96:
+        # legacy impala timestamp: (lo64 nanos-in-day, hi32 julian day) → ns timestamp
+        v = np.asarray(values).reshape(-1, 3)
+        nanos = v[:, 0].astype(np.uint32).astype(np.uint64) | (
+            v[:, 1].astype(np.uint32).astype(np.uint64) << np.uint64(32))
+        days = v[:, 2].astype(np.int64) - 2440588  # julian → unix epoch days
+        ts = days * 86400_000_000_000 + nanos.astype(np.int64)
+        return _fixed_with_nulls(ts, validity, pa.timestamp("ns"))
+
+    flat = np.asarray(values)
+    if k == LogicalKind.INT:
+        bw = leaf.logical_params.get("bit_width", 64)
+        signed = leaf.logical_params.get("signed", True)
+        dt = np.dtype(f"{'i' if signed else 'u'}{max(bw, 8) // 8}")
+        flat = flat.astype(dt) if pt == Type.INT32 else flat.view(dt) if flat.dtype.itemsize == dt.itemsize else flat.astype(dt)
+        return _fixed_with_nulls(flat, validity, pa.from_numpy_dtype(dt))
+    if k == LogicalKind.DATE:
+        return _fixed_with_nulls(flat.astype(np.int32), validity, pa.date32())
+    if k == LogicalKind.TIMESTAMP_MILLIS:
+        return _fixed_with_nulls(flat, validity, pa.timestamp("ms", tz="UTC" if leaf.logical_params.get("utc") else None))
+    if k == LogicalKind.TIMESTAMP_MICROS:
+        return _fixed_with_nulls(flat, validity, pa.timestamp("us", tz="UTC" if leaf.logical_params.get("utc") else None))
+    if k == LogicalKind.TIMESTAMP_NANOS:
+        return _fixed_with_nulls(flat, validity, pa.timestamp("ns", tz="UTC" if leaf.logical_params.get("utc") else None))
+    if k == LogicalKind.TIME_MILLIS:
+        return _fixed_with_nulls(flat.astype(np.int32), validity, pa.time32("ms"))
+    if k == LogicalKind.TIME_MICROS:
+        return _fixed_with_nulls(flat, validity, pa.time64("us"))
+    if k == LogicalKind.DECIMAL and pt in (Type.INT32, Type.INT64):
+        p, s = leaf.logical_params.get("precision", 18), leaf.logical_params.get("scale", 0)
+        return _decimal_with_nulls(flat.astype(np.int64), validity, pa.decimal128(p, s))
+
+    import pyarrow as pa  # noqa: F811
+    return _fixed_with_nulls(flat, validity, pa.from_numpy_dtype(flat.dtype))
+
+
+def concat_columns(parts: List[Column]) -> Column:
+    """Concatenate per-row-group chunks of the same leaf into one Column."""
+    if len(parts) == 1:
+        return parts[0]
+    first = parts[0]
+    if first.offsets is not None:
+        values = np.concatenate([np.asarray(p.values) for p in parts])
+        offs_parts = []
+        base = 0
+        for p in parts:
+            o = np.asarray(p.offsets).astype(np.int64)
+            offs_parts.append(o[:-1] + base)
+            base += int(o[-1])
+        offsets = np.concatenate(offs_parts + [np.array([base])]).astype(np.int32)
+    else:
+        values = np.concatenate([np.asarray(p.values) for p in parts])
+        offsets = None
+    if any(p.validity is not None for p in parts):
+        validity = np.concatenate([
+            np.asarray(p.validity) if p.validity is not None
+            else np.ones(p.num_slots or p.num_values, dtype=bool)
+            for p in parts])
+    else:
+        validity = None
+    nlev = len(first.list_offsets)
+    list_offsets, list_validity = [], []
+    for k in range(nlev):
+        base = 0
+        offs_parts = []
+        for p in parts:
+            o = np.asarray(p.list_offsets[k]).astype(np.int64)
+            offs_parts.append(o[:-1] + base)
+            base += int(o[-1])
+        list_offsets.append(np.concatenate(offs_parts + [np.array([base])]))
+        if any(p.list_validity[k] is not None for p in parts):
+            list_validity.append(np.concatenate([
+                np.asarray(p.list_validity[k]) if p.list_validity[k] is not None
+                else np.ones(len(p.list_offsets[k]) - 1, dtype=bool)
+                for p in parts]))
+        else:
+            list_validity.append(None)
+    return Column(leaf=first.leaf, values=values, offsets=offsets,
+                  validity=validity, list_offsets=list_offsets,
+                  list_validity=list_validity,
+                  num_slots=sum(p.num_slots for p in parts))
+
+
+def _be_bytes_to_int(vals: np.ndarray) -> np.ndarray:
+    """Big-endian two's-complement FLBA bytes → int64 (fits ≤ 8-byte decimals)."""
+    n, width = vals.shape
+    out = np.zeros(n, dtype=np.int64)
+    for k in range(width):
+        out = (out << 8) | vals[:, k].astype(np.int64)
+    # sign-extend from width*8 bits
+    bits = width * 8
+    if bits < 64:
+        sign = np.int64(1) << (bits - 1)
+        out = (out ^ sign) - sign
+    return out
+
+
+def _spread(values: np.ndarray, validity: np.ndarray, fill=0) -> np.ndarray:
+    """Scatter dense present values into slot-aligned array."""
+    out = np.full(len(validity), fill, dtype=values.dtype)
+    out[validity] = values
+    return out
+
+
+def _fixed_with_nulls(values: np.ndarray, validity, pa_type):
+    import pyarrow as pa
+
+    if validity is None:
+        arr = pa.array(values)
+    else:
+        slot_vals = _spread(values, validity)
+        arr = pa.array(slot_vals, mask=~np.asarray(validity))
+    if arr.type != pa_type:
+        arr = arr.cast(pa_type)
+    return arr
+
+
+def _decimal_with_nulls(ints: np.ndarray, validity, pa_type):
+    import pyarrow as pa
+
+    vals = ints if validity is None else _spread(ints, validity)
+    lo = vals.astype(np.uint64)
+    hi = (vals >> np.uint64(63) if vals.dtype == np.uint64 else (vals >> 63)).astype(np.int64)
+    raw = np.empty((len(vals), 2), dtype=np.uint64)
+    raw[:, 0] = lo
+    raw[:, 1] = hi.astype(np.uint64)
+    bufs = [None, pa.py_buffer(raw.tobytes())]
+    if validity is not None:
+        bufs[0] = pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+    return pa.Array.from_buffers(pa_type, len(vals), bufs)
+
+
+def _fsb_with_nulls(vals: np.ndarray, validity: np.ndarray, width: int):
+    import pyarrow as pa
+
+    out = np.zeros((len(validity), width), dtype=np.uint8)
+    out[validity] = vals
+    mask = pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+    return pa.Array.from_buffers(pa.binary(width), len(validity),
+                                 [mask, pa.py_buffer(out.tobytes())])
+
+
+def _ragged_with_nulls(values: np.ndarray, offsets: np.ndarray, validity: np.ndarray):
+    import pyarrow as pa
+
+    n = len(validity)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    slot_lens = np.zeros(n, dtype=np.int64)
+    slot_lens[validity] = lens
+    slot_offs = np.concatenate([[0], np.cumsum(slot_lens)]).astype(np.int32)
+    mask = pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+    return pa.Array.from_buffers(
+        pa.binary(), n,
+        [mask, pa.py_buffer(slot_offs.tobytes()),
+         pa.py_buffer(np.asarray(values, dtype=np.uint8).tobytes())])

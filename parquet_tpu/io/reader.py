@@ -1,0 +1,500 @@
+"""File / row-group / column-chunk / page readers (L3) + host decode loop.
+
+Reference parity (SURVEY.md §3.1): ``OpenFile`` validates the PAR1 magic at
+both ends, thrift-decodes the footer, and lazily exposes
+``RowGroup → ColumnChunk → Pages``; ``filePages.ReadPage`` is the per-page hot
+loop (header → raw bytes → CRC → decompress → levels → values).  Here the host
+path decodes with the numpy oracle in ``ops/ref.py``; the TPU path
+(``parallel/device_reader.py``) replaces step 5-6 with batched device kernels —
+the same rerouting point the north star names (``encoding.Encoding`` /
+``compress.Codec`` registries).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .. import codecs
+from ..format import enums, metadata as md, thrift
+from ..format.enums import Encoding, PageType, Type
+from ..ops import levels as levels_ops, ref
+from ..schema.schema import Leaf, Schema
+from ..utils.debug import counters, trace
+from .column import Column, concat_columns
+from .source import Source, as_source
+
+
+class CorruptedError(Exception):
+    """Reference parity: errors.go — ErrCorrupted."""
+
+
+@dataclass
+class ReadOptions:
+    """Reference parity: config.go — FileConfig/ReaderConfig functional options."""
+
+    skip_page_index: bool = True  # lazy: load on demand (reference: SkipPageIndex)
+    skip_bloom_filters: bool = True
+    verify_crc: bool = False
+    footer_read_size: int = 64 * 1024  # speculative tail read to avoid 2 IOs
+
+
+# ---------------------------------------------------------------------------
+# Pages
+# ---------------------------------------------------------------------------
+@dataclass
+class PageInfo:
+    """One parsed page: header + raw (still compressed) payload."""
+
+    header: md.PageHeader
+    payload: bytes  # compressed bytes as stored
+    offset: int  # absolute file offset of the page header
+
+    @property
+    def page_type(self) -> PageType:
+        return PageType(self.header.type)
+
+    @property
+    def num_values(self) -> int:
+        h = self.header
+        if h.data_page_header is not None:
+            return h.data_page_header.num_values
+        if h.data_page_header_v2 is not None:
+            return h.data_page_header_v2.num_values
+        if h.dictionary_page_header is not None:
+            return h.dictionary_page_header.num_values
+        return 0
+
+
+class ColumnChunkReader:
+    """Reference parity: column_chunk.go — ColumnChunk + file.go — filePages."""
+
+    def __init__(self, file: "ParquetFile", rg_index: int, chunk: md.ColumnChunk,
+                 leaf: Leaf):
+        self.file = file
+        self.rg_index = rg_index
+        self.chunk = chunk
+        self.leaf = leaf
+        self.meta = chunk.meta_data
+
+    @property
+    def codec(self) -> codecs.Codec:
+        return codecs.get_codec(self.meta.codec)
+
+    @property
+    def num_values(self) -> int:
+        return self.meta.num_values
+
+    @property
+    def byte_range(self) -> Tuple[int, int]:
+        """(start, size) of this chunk's page bytes in the file."""
+        m = self.meta
+        start = m.data_page_offset
+        if m.dictionary_page_offset is not None and 0 < m.dictionary_page_offset < start:
+            start = m.dictionary_page_offset
+        return start, m.total_compressed_size
+
+    def raw_bytes(self) -> bytes:
+        start, size = self.byte_range
+        return self.file.source.pread(start, size)
+
+    def pages(self, raw: Optional[bytes] = None) -> Iterator[PageInfo]:
+        """Parse the page stream.  One contiguous read for the whole chunk —
+        batching H2D-friendly (SURVEY.md §7 hard part 5) and 1 syscall."""
+        start, size = self.byte_range
+        if raw is None:
+            raw = self.file.source.pread(start, size)
+        pos = 0
+        values_seen = 0
+        total = self.meta.num_values
+        while values_seen < total and pos < size:
+            try:
+                header, data_pos = thrift.deserialize(md.PageHeader, raw, pos)
+            except Exception as e:
+                raise CorruptedError(f"bad page header at {start+pos}: {e}") from e
+            clen = header.compressed_page_size
+            payload = raw[data_pos : data_pos + clen]
+            if len(payload) != clen:
+                raise CorruptedError("truncated page payload")
+            page = PageInfo(header=header, payload=payload, offset=start + pos)
+            if page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+                values_seen += page.num_values
+            yield page
+            pos = data_pos + clen
+
+    # ------------------------------------------------------------------ decode
+    def read(self) -> Column:
+        """Decode the whole chunk on host (numpy oracle path)."""
+        return decode_chunk_host(self)
+
+    # ------------------------------------------------------- indexes / filters
+    def column_index(self) -> Optional[md.ColumnIndex]:
+        c = self.chunk
+        if c.column_index_offset is None:
+            return None
+        raw = self.file.source.pread(c.column_index_offset, c.column_index_length)
+        ci, _ = thrift.deserialize(md.ColumnIndex, raw)
+        return ci
+
+    def offset_index(self) -> Optional[md.OffsetIndex]:
+        c = self.chunk
+        if c.offset_index_offset is None:
+            return None
+        raw = self.file.source.pread(c.offset_index_offset, c.offset_index_length)
+        oi, _ = thrift.deserialize(md.OffsetIndex, raw)
+        return oi
+
+    def bloom_filter(self):
+        from .bloom import read_bloom_filter
+
+        return read_bloom_filter(self)
+
+    def statistics(self):
+        from .statistics import decode_statistics
+
+        return decode_statistics(self.meta.statistics, self.leaf)
+
+
+class RowGroupReader:
+    """Reference parity: row_group.go — RowGroup (file-backed)."""
+
+    def __init__(self, file: "ParquetFile", index: int, rg: md.RowGroup):
+        self.file = file
+        self.index = index
+        self.rg = rg
+
+    @property
+    def num_rows(self) -> int:
+        return self.rg.num_rows
+
+    @property
+    def sorting_columns(self):
+        return self.rg.sorting_columns
+
+    def column(self, which: Union[int, str, Tuple[str, ...]]) -> ColumnChunkReader:
+        if isinstance(which, int):
+            i = which
+        else:
+            i = self.file.schema.leaf(which).column_index
+        return ColumnChunkReader(self.file, self.index,
+                                 self.rg.columns[i], self.file.schema.leaves[i])
+
+    def columns(self) -> List[ColumnChunkReader]:
+        return [self.column(i) for i in range(len(self.rg.columns))]
+
+
+class ParquetFile:
+    """Reference parity: file.go — File/OpenFile (magic check both ends,
+    thrift footer decode, lazy page-index/bloom access)."""
+
+    def __init__(self, source, options: Optional[ReadOptions] = None):
+        self.options = options or ReadOptions()
+        self.source: Source = as_source(source)
+        size = self.source.size()
+        if size < 12:
+            raise CorruptedError(f"file too small ({size} bytes) to be parquet")
+        tail_len = min(self.options.footer_read_size, size)
+        tail = self.source.pread(size - tail_len, tail_len)
+        if tail[-4:] != md.MAGIC:
+            raise CorruptedError("missing PAR1 magic at end of file")
+        footer_len = struct.unpack("<I", tail[-8:-4])[0]
+        if footer_len + 8 > size:
+            raise CorruptedError(f"footer length {footer_len} exceeds file size {size}")
+        if footer_len + 8 <= tail_len:
+            footer = tail[-8 - footer_len : -8]
+        else:
+            footer = self.source.pread(size - 8 - footer_len, footer_len)
+        head = self.source.pread(0, 4)
+        if head != md.MAGIC:
+            raise CorruptedError("missing PAR1 magic at start of file")
+        try:
+            self.metadata, _ = thrift.deserialize(md.FileMetaData, footer)
+        except Exception as e:
+            raise CorruptedError(f"bad footer: {e}") from e
+        if self.metadata.schema in (None, []):
+            raise CorruptedError("footer has no schema")
+        self.schema = Schema.from_elements(self.metadata.schema)
+        counters.inc("files_opened")
+
+    # ------------------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self.metadata.num_rows or 0
+
+    @property
+    def created_by(self) -> Optional[str]:
+        return self.metadata.created_by
+
+    def key_value_metadata(self) -> Dict[str, str]:
+        return {kv.key: kv.value for kv in (self.metadata.key_value_metadata or [])}
+
+    @property
+    def row_groups(self) -> List[RowGroupReader]:
+        return [RowGroupReader(self, i, rg)
+                for i, rg in enumerate(self.metadata.row_groups or [])]
+
+    def row_group(self, i: int) -> RowGroupReader:
+        return RowGroupReader(self, i, self.metadata.row_groups[i])
+
+    # ------------------------------------------------------------------
+    def read(self, columns: Optional[Sequence[str]] = None) -> "Table":
+        """Read and decode the whole file on host (oracle path)."""
+        leaves = _select_leaves(self.schema, columns)
+        cols: Dict[str, Column] = {}
+        for leaf in leaves:
+            parts = [self.row_group(i).column(leaf.column_index).read()
+                     for i in range(len(self.metadata.row_groups or []))]
+            cols[leaf.dotted_path] = concat_columns(parts) if len(parts) != 1 else parts[0]
+        return Table(self.schema, cols, self.num_rows)
+
+    def close(self):
+        self.source.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _select_leaves(schema: Schema, columns) -> List[Leaf]:
+    if columns is None:
+        return list(schema.leaves)
+    out = []
+    for c in columns:
+        matches = [l for l in schema.leaves
+                   if l.dotted_path == c or l.path[0] == c]
+        if not matches:
+            raise KeyError(f"no column {c!r} in schema")
+        out.extend(matches)
+    return out
+
+
+class Table:
+    """A decoded set of columns (dict-like).  ``to_arrow`` → pyarrow.Table."""
+
+    def __init__(self, schema: Schema, columns: Dict[str, Column], num_rows: int):
+        self.schema = schema
+        self.columns = columns
+        self.num_rows = num_rows
+
+    def __getitem__(self, path: str) -> Column:
+        return self.columns[path]
+
+    def __contains__(self, path: str) -> bool:
+        return path in self.columns
+
+    def keys(self):
+        return self.columns.keys()
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        arrays = []
+        names = []
+        for path, col in self.columns.items():
+            arrays.append(col.to_arrow())
+            names.append(path.split(".")[0] if col.list_offsets else path)
+        return pa.table(dict(zip(names, arrays)))
+
+
+# ---------------------------------------------------------------------------
+# Host decode loop (the ★ HOT LOOP of SURVEY.md §3.1, oracle edition)
+# ---------------------------------------------------------------------------
+
+
+def _bit_width(maxval: int) -> int:
+    return int(maxval).bit_length()
+
+
+def decode_chunk_host(reader: ColumnChunkReader) -> Column:
+    leaf = reader.leaf
+    meta = reader.meta
+    codec = reader.codec
+    max_def = leaf.max_definition_level
+    max_rep = leaf.max_repetition_level
+    physical = Type(meta.type)
+    dictionary = None  # decoded dictionary values
+    all_def: List[np.ndarray] = []
+    all_rep: List[np.ndarray] = []
+    index_parts: List[np.ndarray] = []  # dict-encoded pages
+    value_parts: List = []  # directly decoded pages (arrays or (vals, offs))
+    part_order: List[Tuple[str, int]] = []  # ("idx"/"val", part index) per page
+
+    for page in reader.pages():
+        h = page.header
+        pt = page.page_type
+        if reader.file.options.verify_crc and h.crc is not None:
+            crc = zlib.crc32(page.payload) & 0xFFFFFFFF
+            if crc != (h.crc & 0xFFFFFFFF):
+                raise CorruptedError(f"page CRC mismatch at offset {page.offset}")
+        if pt == PageType.DICTIONARY_PAGE:
+            raw = codec.decode(page.payload, h.uncompressed_page_size)
+            dictionary = _decode_dictionary(raw, h.dictionary_page_header, leaf, physical)
+            counters.inc("dict_pages_decoded")
+            continue
+        if pt == PageType.DATA_PAGE:
+            dph = h.data_page_header
+            n = dph.num_values
+            raw = np.frombuffer(codec.decode(page.payload, h.uncompressed_page_size), np.uint8)
+            pos = 0
+            rep = defs = None
+            if max_rep > 0:
+                if Encoding(dph.repetition_level_encoding) == Encoding.BIT_PACKED:
+                    raise CorruptedError("BIT_PACKED rep levels with no length are unsupported in v1 pages")
+                rep, pos = ref.decode_rle_len_prefixed(raw, n, _bit_width(max_rep), pos)
+            if max_def > 0:
+                enc = Encoding(dph.definition_level_encoding)
+                if enc == Encoding.RLE:
+                    defs, pos = ref.decode_rle_len_prefixed(raw, n, _bit_width(max_def), pos)
+                else:  # legacy BIT_PACKED levels
+                    w = _bit_width(max_def)
+                    nbytes = (n * w + 7) // 8
+                    defs = ref.decode_bit_packed_levels(raw[pos:], n, w)
+                    pos += nbytes
+            nvals = n if defs is None else int(np.count_nonzero(defs == max_def))
+            encoding = Encoding(dph.encoding)
+            decoded = _decode_values(raw, pos, nvals, encoding, leaf, physical, dictionary)
+            counters.inc("data_pages_decoded")
+        elif pt == PageType.DATA_PAGE_V2:
+            dph2 = h.data_page_header_v2
+            n = dph2.num_values
+            rl = dph2.repetition_levels_byte_length or 0
+            dl = dph2.definition_levels_byte_length or 0
+            raw_levels = np.frombuffer(page.payload[: rl + dl], np.uint8)
+            rep = defs = None
+            if max_rep > 0:
+                rep = ref.decode_rle(raw_levels, n, _bit_width(max_rep), 0)
+            if max_def > 0:
+                defs = ref.decode_rle(raw_levels[rl:], n, _bit_width(max_def), 0)
+            body = page.payload[rl + dl :]
+            if dph2.is_compressed is not False:
+                body = codec.decode(body, h.uncompressed_page_size - rl - dl)
+            raw = np.frombuffer(body, np.uint8)
+            nvals = n - (dph2.num_nulls or 0)
+            encoding = Encoding(dph2.encoding)
+            decoded = _decode_values(raw, 0, nvals, encoding, leaf, physical, dictionary)
+            counters.inc("data_pages_decoded")
+        else:
+            continue  # index pages etc.
+
+        if rep is not None:
+            all_rep.append(rep)
+        if defs is not None:
+            all_def.append(defs)
+        if isinstance(decoded, _DictIndices):
+            part_order.append(("idx", len(index_parts)))
+            index_parts.append(decoded.indices)
+        else:
+            part_order.append(("val", len(value_parts)))
+            value_parts.append(decoded)
+
+    # ---- combine pages: single gather for dict-encoded chunks -------------
+    values, offsets = _combine_parts(part_order, index_parts, value_parts,
+                                     dictionary, leaf, physical)
+    def_levels = np.concatenate(all_def) if all_def else None
+    rep_levels = np.concatenate(all_rep) if all_rep else None
+    asm = levels_ops.assemble(def_levels, rep_levels, leaf)
+    num_slots = len(def_levels) if def_levels is not None else (
+        len(offsets) - 1 if offsets is not None else
+        (len(values) if np.ndim(values) else 0))
+    return Column(leaf=leaf, values=values, offsets=offsets,
+                  validity=asm.validity, list_offsets=asm.list_offsets,
+                  list_validity=asm.list_validity, num_slots=num_slots)
+
+
+class _DictIndices:
+    __slots__ = ("indices",)
+
+    def __init__(self, indices):
+        self.indices = indices
+
+
+def _decode_dictionary(raw: bytes, dph: md.DictionaryPageHeader, leaf: Leaf,
+                       physical: Type):
+    n = dph.num_values
+    buf = np.frombuffer(raw, np.uint8)
+    dec = ref.decode_plain(buf, n, physical, leaf.type_length)
+    if physical == Type.BYTE_ARRAY:
+        return dec  # (values, offsets)
+    return dec
+
+
+def _decode_values(raw: np.ndarray, pos: int, nvals: int, encoding: Encoding,
+                   leaf: Leaf, physical: Type, dictionary):
+    if encoding in (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY):
+        if dictionary is None:
+            raise CorruptedError("dictionary-encoded page before dictionary page")
+        return _DictIndices(ref.decode_rle_dict_indices(raw, nvals, pos))
+    if encoding == Encoding.PLAIN:
+        return ref.decode_plain(raw[pos:], nvals, physical, leaf.type_length)
+    if encoding == Encoding.DELTA_BINARY_PACKED:
+        vals, _ = ref.decode_delta_binary_packed(raw, pos)
+        vals = vals[:nvals]
+        return vals.astype(np.int32) if physical == Type.INT32 else vals
+    if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+        v, o, _ = ref.decode_delta_length_byte_array(raw, pos)
+        return v, o
+    if encoding == Encoding.DELTA_BYTE_ARRAY:
+        v, o, _ = ref.decode_delta_byte_array(raw, pos)
+        if physical == Type.FIXED_LEN_BYTE_ARRAY:
+            return v.reshape(nvals, leaf.type_length)
+        return v, o
+    if encoding == Encoding.BYTE_STREAM_SPLIT:
+        width = {Type.FLOAT: 4, Type.DOUBLE: 8,
+                 Type.INT32: 4, Type.INT64: 8}.get(physical, leaf.type_length)
+        b = ref.decode_byte_stream_split(raw[pos:], nvals, width)
+        if physical == Type.FLOAT:
+            return b.reshape(-1).view(np.float32)
+        if physical == Type.DOUBLE:
+            return b.reshape(-1).view(np.float64)
+        if physical == Type.INT32:
+            return b.reshape(-1).view(np.int32)
+        if physical == Type.INT64:
+            return b.reshape(-1).view(np.int64)
+        return b  # FLBA: (n, width) bytes
+    if encoding == Encoding.RLE and physical == Type.BOOLEAN:
+        # RLE-encoded booleans (v2): 4-byte length prefix, bit width 1
+        vals, _ = ref.decode_rle_len_prefixed(raw, nvals, 1, pos)
+        return vals.astype(np.bool_)
+    raise CorruptedError(f"unsupported encoding {encoding!r} for {physical!r}")
+
+
+def _combine_parts(part_order, index_parts, value_parts, dictionary, leaf, physical):
+    """Merge per-page results into one chunk array; dictionary chunks do ONE
+    gather over the concatenated index stream (TPU-friendly: a single big
+    gather instead of per-page gathers — SURVEY.md §2.2 RLE_DICTIONARY note)."""
+    if not part_order:
+        empty = np.empty(0, dtype=leaf.np_dtype() or np.uint8)
+        return (empty, np.zeros(1, np.int32)) if physical == Type.BYTE_ARRAY else (empty, None)
+    only_idx = all(kind == "idx" for kind, _ in part_order)
+    if only_idx:
+        idx = np.concatenate(index_parts) if len(index_parts) > 1 else index_parts[0]
+        gathered = ref.gather_dictionary(dictionary, idx)
+        if isinstance(gathered, tuple):
+            return gathered[0], gathered[1]
+        return gathered, None
+    # mixed or pure-plain: materialize each page, concatenate
+    mats = []
+    for kind, i in part_order:
+        if kind == "idx":
+            mats.append(ref.gather_dictionary(dictionary, index_parts[i]))
+        else:
+            mats.append(value_parts[i])
+    if isinstance(mats[0], tuple):  # byte arrays: (values, offsets) pairs
+        vals = np.concatenate([m[0] for m in mats])
+        offs_parts = []
+        base = 0
+        for m in mats:
+            o = m[1].astype(np.int64)
+            offs_parts.append(o[:-1] + base if len(offs_parts) else o[:-1] + base)
+            base += int(o[-1])
+        offs = np.concatenate(offs_parts + [np.array([base], dtype=np.int64)])
+        return vals, offs.astype(np.int32)
+    if len(mats) == 1:
+        return mats[0], None
+    return np.concatenate(mats), None

@@ -1,0 +1,92 @@
+"""Random-access byte sources (the ``io.ReaderAt`` analog, SURVEY.md §1 L0).
+
+Supports paths (os.pread — no whole-file buffering, scan-friendly), bytes, and
+file-like objects.  All reads are positional and thread-safe, matching the
+reference's documented concurrent-read guarantees (SURVEY.md §2.5a).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import Union
+
+
+class Source:
+    def pread(self, offset: int, size: int) -> bytes:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileSource(Source):
+    def __init__(self, path: str):
+        self.path = path
+        self._fd = os.open(path, os.O_RDONLY)
+        self._size = os.fstat(self._fd).st_size
+
+    def pread(self, offset: int, size: int) -> bytes:
+        out = os.pread(self._fd, size, offset)
+        if len(out) != size:
+            raise IOError(f"short read at {offset}: wanted {size}, got {len(out)}")
+        return out
+
+    def size(self) -> int:
+        return self._size
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+
+class BytesSource(Source):
+    def __init__(self, data: Union[bytes, bytearray, memoryview]):
+        self._data = memoryview(data)
+
+    def pread(self, offset: int, size: int) -> bytes:
+        out = self._data[offset : offset + size]
+        if len(out) != size:
+            raise IOError(f"short read at {offset}")
+        return bytes(out)
+
+    def size(self) -> int:
+        return len(self._data)
+
+
+class FileLikeSource(Source):
+    """Wraps a seekable file-like object; serializes seek+read."""
+
+    def __init__(self, f):
+        self._f = f
+        self._lock = threading.Lock()
+        f.seek(0, io.SEEK_END)
+        self._size = f.tell()
+
+    def pread(self, offset: int, size: int) -> bytes:
+        with self._lock:
+            self._f.seek(offset)
+            out = self._f.read(size)
+        if len(out) != size:
+            raise IOError(f"short read at {offset}")
+        return out
+
+    def size(self) -> int:
+        return self._size
+
+
+def as_source(obj) -> Source:
+    if isinstance(obj, Source):
+        return obj
+    if isinstance(obj, (str, os.PathLike)):
+        return FileSource(os.fspath(obj))
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return BytesSource(obj)
+    if hasattr(obj, "read") and hasattr(obj, "seek"):
+        return FileLikeSource(obj)
+    raise TypeError(f"cannot make a Source from {type(obj)!r}")

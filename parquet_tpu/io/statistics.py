@@ -1,0 +1,73 @@
+"""Typed statistics decode/encode (zone maps).
+
+Reference parity: ``format — Statistics`` + the typed min/max accessors on
+``ColumnChunk`` (SURVEY.md §2.1 Indexes row).  Parquet stores min/max as plain
+little-endian bytes of the physical type (logical order for
+min_value/max_value).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..format import metadata as md
+from ..format.enums import Type
+from ..schema.schema import Leaf
+
+
+@dataclass
+class TypedStatistics:
+    min_value: Any = None
+    max_value: Any = None
+    null_count: Optional[int] = None
+    distinct_count: Optional[int] = None
+
+
+def decode_stat_value(raw: Optional[bytes], leaf: Leaf):
+    if raw is None or raw == b"" and leaf.physical_type != Type.BYTE_ARRAY:
+        return None if raw is None else raw
+    t = leaf.physical_type
+    if t == Type.BOOLEAN:
+        return bool(raw[0])
+    if t == Type.INT32:
+        return int(np.frombuffer(raw[:4], np.int32)[0])
+    if t == Type.INT64:
+        return int(np.frombuffer(raw[:8], np.int64)[0])
+    if t == Type.FLOAT:
+        return float(np.frombuffer(raw[:4], np.float32)[0])
+    if t == Type.DOUBLE:
+        return float(np.frombuffer(raw[:8], np.float64)[0])
+    return bytes(raw)  # BYTE_ARRAY / FLBA / INT96: raw bytes
+
+
+def encode_stat_value(value, physical: Type) -> bytes:
+    if value is None:
+        return b""
+    if physical == Type.BOOLEAN:
+        return bytes([1 if value else 0])
+    if physical == Type.INT32:
+        return np.int32(value).tobytes()
+    if physical == Type.INT64:
+        return np.int64(value).tobytes()
+    if physical == Type.FLOAT:
+        return np.float32(value).tobytes()
+    if physical == Type.DOUBLE:
+        return np.float64(value).tobytes()
+    return bytes(value)
+
+
+def decode_statistics(stats: Optional[md.Statistics], leaf: Leaf
+                      ) -> Optional[TypedStatistics]:
+    if stats is None:
+        return None
+    mn = stats.min_value if stats.min_value is not None else stats.min
+    mx = stats.max_value if stats.max_value is not None else stats.max
+    return TypedStatistics(
+        min_value=decode_stat_value(mn, leaf),
+        max_value=decode_stat_value(mx, leaf),
+        null_count=stats.null_count,
+        distinct_count=stats.distinct_count,
+    )

@@ -1,0 +1,196 @@
+"""Dremel definition/repetition level math, vectorized.
+
+Reference parity: ``schema.go — Schema.Deconstruct / Schema.Reconstruct``
+(SURVEY.md §3.1/§3.2) performs record-at-a-time shredding/assembly.  The
+TPU-native formulation is whole-column vector math over the level streams
+(SURVEY.md §7 hard part 4): def/rep levels → (validity bitmap, Arrow list
+offsets) per nesting level, and the inverse for the write path.  Everything
+here is numpy (host oracle); ``ops/device.py`` mirrors the hot direction in
+jnp for on-device assembly.
+
+Level semantics (Parquet spec):
+  - each OPTIONAL ancestor adds 1 definition level; each REPEATED ancestor adds
+    1 definition level AND 1 repetition level.
+  - a leaf slot's def == max_def  ⇔ the value is present (non-null).
+  - rep == k means the slot starts a new element of the level-k repeated
+    ancestor's *innermost continuing* list; rep < k starts a new level-k list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..format.enums import FieldRepetitionType as Rep
+from ..schema.schema import Leaf
+
+
+@dataclass
+class LevelInfo:
+    """Per-nesting-level decode plan for one leaf column."""
+
+    rep_level: int  # repetition level of this repeated ancestor (1-based)
+    def_level: int  # definition level of this repeated ancestor
+
+
+def repeated_ancestors(leaf: Leaf) -> List[LevelInfo]:
+    """The repeated nodes on the leaf's path, outermost first."""
+    out = []
+    d = 0
+    r = 0
+    for node in leaf.ancestors:
+        if node.repetition == Rep.OPTIONAL:
+            d += 1
+        elif node.repetition == Rep.REPEATED:
+            d += 1
+            r += 1
+            out.append(LevelInfo(rep_level=r, def_level=d))
+    return out
+
+
+@dataclass
+class Assembled:
+    """Arrow-style assembly of one leaf column.
+
+    ``validity`` masks the *leaf value stream* (length = number of leaf slots
+    with def >= value-def-level... trimmed to value count for flat columns).
+    ``list_offsets[k]`` / ``list_validity[k]`` describe the k-th repeated
+    ancestor, outermost first.  For flat columns both lists are empty.
+    """
+
+    validity: Optional[np.ndarray]  # bool[num_leaf_slots] or None if no nulls possible
+    list_offsets: List[np.ndarray]
+    list_validity: List[Optional[np.ndarray]]
+    # map from leaf slot → dense value index is implicit: values are stored
+    # densely for slots with def == max_def, in slot order.
+
+
+def assemble(def_levels: Optional[np.ndarray], rep_levels: Optional[np.ndarray],
+             leaf: Leaf) -> Assembled:
+    """Turn level streams into per-level (offsets, validity) + leaf validity.
+
+    Semantics (derived in the module docstring; level-k repeated ancestor has
+    rep level k, def level d_k; innermost is level r):
+
+    - *instances* of level k (entries of the k-1 layer): slots with
+      ``rep < k`` and (for k>1) ``def >= d_{k-1}``.
+    - an instance is a non-null list iff ``def >= d_k - 1`` at its start slot.
+    - *elements* of level k: instances of level k+1; for the innermost level,
+      slots with ``def >= d_r``.
+    - leaf validity (over innermost elements): ``def == max_def``.
+
+    Structs between repeated levels add def levels; their per-layer nullness
+    is collapsed into the nearest list validity here (full struct reassembly is
+    a table-layer concern).
+    """
+    max_def = leaf.max_definition_level
+    max_rep = leaf.max_repetition_level
+    if max_def == 0:
+        return Assembled(validity=None, list_offsets=[], list_validity=[])
+    d = def_levels
+    if max_rep == 0:
+        return Assembled(validity=(d == max_def), list_offsets=[], list_validity=[])
+    r = rep_levels
+    infos = repeated_ancestors(leaf)
+    nlev = len(infos)
+    offsets: List[np.ndarray] = []
+    validities: List[Optional[np.ndarray]] = []
+    for i, info in enumerate(infos):
+        k, dk = info.rep_level, info.def_level
+        if i == 0:
+            inst_mask = r < k
+        else:
+            inst_mask = (r < k) & (d >= infos[i - 1].def_level)
+        inst_idx = np.flatnonzero(inst_mask)
+        if i + 1 < nlev:
+            knext, dknext = infos[i + 1].rep_level, infos[i + 1].def_level
+            elem = (r < knext) & (d >= dk)
+        else:
+            elem = d >= dk
+        cum = np.cumsum(elem)
+        offs = np.empty(len(inst_idx) + 1, dtype=np.int64)
+        offs[0] = 0
+        if len(inst_idx) > 1:
+            offs[1:-1] = cum[inst_idx[1:] - 1]
+        offs[-1] = cum[-1] if len(cum) else 0
+        valid = d[inst_idx] >= (dk - 1)
+        offsets.append(offs)
+        validities.append(valid)
+    # leaf validity over innermost elements only
+    inner_entries = d >= infos[-1].def_level
+    validity = (d == max_def)[inner_entries]
+    return Assembled(validity=validity, list_offsets=offsets, list_validity=validities)
+
+
+def leaf_slot_count_to_value_count(def_levels: np.ndarray, max_def: int) -> int:
+    return int(np.count_nonzero(def_levels == max_def))
+
+
+# ---------------------------------------------------------------------------
+# Write direction: arrays + offsets + validity → (def, rep) level streams
+# ---------------------------------------------------------------------------
+
+
+def levels_for_flat(validity: Optional[np.ndarray], num_values: int,
+                    max_def: int) -> Optional[np.ndarray]:
+    """Def levels for a flat (max_rep==0) column.  None when nothing to write."""
+    if max_def == 0:
+        return None
+    if validity is None:
+        return np.full(num_values, max_def, dtype=np.int32)
+    d = np.full(num_values, max_def, dtype=np.int32)
+    d[~validity] = max_def - 1
+    return d
+
+
+def levels_for_list(list_offsets: np.ndarray, list_validity: Optional[np.ndarray],
+                    elem_validity: Optional[np.ndarray], leaf: Leaf
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Def/rep levels for a single-level LIST column (the common case).
+
+    list_offsets: int[n_rows+1]; list_validity: bool[n_rows] or None;
+    elem_validity: bool[n_elems] or None.  Returns (def_levels, rep_levels)
+    over leaf slots (one slot per element, plus one per null/empty list).
+    """
+    infos = repeated_ancestors(leaf)
+    assert len(infos) == 1, "levels_for_list handles exactly one repeated level"
+    dk = infos[0].def_level
+    max_def = leaf.max_definition_level
+    n_rows = len(list_offsets) - 1
+    lens = (list_offsets[1:] - list_offsets[:-1]).astype(np.int64)
+    if list_validity is not None:
+        lens = np.where(list_validity, lens, 0)
+    slot_per_row = np.maximum(lens, 1)  # null/empty lists still occupy one slot
+    total = int(slot_per_row.sum())
+    rep = np.ones(total, dtype=np.int32)
+    row_starts = np.zeros(n_rows, dtype=np.int64)
+    np.cumsum(slot_per_row[:-1], out=row_starts[1:])
+    rep[row_starts] = 0
+    d = np.full(total, max_def, dtype=np.int32)
+    empty_rows = lens == 0
+    # def for empty/null list slots
+    if list_validity is not None:
+        null_rows = ~list_validity.astype(bool)
+        d[row_starts[null_rows]] = dk - 2  # list null (parent optional level absent)
+        d[row_starts[empty_rows & ~null_rows]] = dk - 1
+    else:
+        d[row_starts[empty_rows]] = dk - 1
+    # element nulls
+    if elem_validity is not None and max_def > dk:
+        # scatter element validity into slots occupied by real elements
+        elem_slots = np.repeat(row_starts, lens) + _ranges(lens)
+        nulls = ~elem_validity.astype(bool)
+        d[elem_slots[nulls]] = max_def - 1
+    return d, rep
+
+
+def _ranges(lengths: np.ndarray) -> np.ndarray:
+    total = int(lengths.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    starts = np.empty(len(lengths), dtype=np.int64)
+    starts[0] = 0
+    np.cumsum(lengths[:-1], out=starts[1:])
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)

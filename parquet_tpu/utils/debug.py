@@ -1,0 +1,61 @@
+"""Observability: counters + env-gated call tracing.
+
+Reference parity: ``internal/debug`` wraps readers/writers with call logging
+gated by an env var (SURVEY.md §5) — the reference's entire observability
+story.  New-framework additions per §5: lightweight counters (pages decoded,
+bytes H2D, kernel launches) behind ``PARQUET_TPU_DEBUG``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import threading
+import time
+from collections import defaultdict
+
+DEBUG = os.environ.get("PARQUET_TPU_DEBUG", "") not in ("", "0", "false")
+
+
+class Counters:
+    """Thread-safe named counters; cheap when unused."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = defaultdict(int)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += by
+
+    def get(self, name: str) -> int:
+        return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+counters = Counters()
+
+
+def trace(fn):
+    """Log calls + wall time when PARQUET_TPU_DEBUG is set (else zero-cost)."""
+    if not DEBUG:
+        return fn
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            dt = (time.perf_counter() - t0) * 1e3
+            print(f"[parquet-tpu] {fn.__qualname__} {dt:.3f}ms", file=sys.stderr)
+
+    return wrapper

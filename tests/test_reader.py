@@ -1,0 +1,299 @@
+"""Read-path interop: decode pyarrow-written files, compare to pyarrow's own
+read.  This is the golden-file strategy of SURVEY.md §4(3) with pyarrow as the
+live oracle."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.io.reader import CorruptedError, ParquetFile, ReadOptions
+
+
+def _roundtrip(table: pa.Table, **write_kwargs):
+    buf = io.BytesIO()
+    pq.write_table(table, buf, **write_kwargs)
+    return buf.getvalue()
+
+
+def _check_column(raw: bytes, table: pa.Table, name: str, path=None, **opts):
+    pf = ParquetFile(raw, ReadOptions(**opts))
+    tab = pf.read()
+    path = path or name
+    arr = tab[path].to_arrow()
+    expect = table[name].combine_chunks()
+    if arr.type != expect.type:
+        arr = arr.cast(expect.type)
+    assert arr.equals(expect), f"{name}: mismatch\nGot: {arr[:10]}\nWant: {expect[:10]}"
+
+
+PHYSICAL_TABLES = {
+    "i64": pa.array(np.arange(5000, dtype=np.int64) * 37 - 12345),
+    "i32": pa.array(np.arange(5000, dtype=np.int32) - 2500),
+    "f32": pa.array(np.linspace(-1, 1, 5000, dtype=np.float32)),
+    "f64": pa.array(np.linspace(-100, 100, 5000)),
+    "bool": pa.array((np.arange(5000) % 3 == 0)),
+    "str": pa.array([f"string-value-{i % 211}" for i in range(5000)]),
+    "bin": pa.array([f"b{i % 97}".encode() * (i % 4) for i in range(5000)], type=pa.binary()),
+}
+
+
+@pytest.mark.parametrize("compression", ["none", "snappy", "zstd", "gzip", "lz4", "brotli"])
+@pytest.mark.parametrize("dpv", ["1.0", "2.0"])
+def test_all_physical_types(compression, dpv):
+    t = pa.table(PHYSICAL_TABLES)
+    raw = _roundtrip(t, compression=compression, data_page_version=dpv)
+    for name in t.column_names:
+        _check_column(raw, t, name)
+
+
+@pytest.mark.parametrize("dpv", ["1.0", "2.0"])
+def test_nulls(dpv):
+    t = pa.table({
+        "oi": pa.array([None if i % 3 == 0 else i for i in range(3000)], type=pa.int64()),
+        "os": pa.array([None if i % 7 == 0 else f"s{i%13}" for i in range(3000)]),
+        "all_null": pa.array([None] * 3000, type=pa.int32()),
+        "no_null": pa.array(list(range(3000)), type=pa.int64()),
+    })
+    raw = _roundtrip(t, data_page_version=dpv)
+    for name in t.column_names:
+        _check_column(raw, t, name)
+
+
+@pytest.mark.parametrize("encoding", [
+    "PLAIN", "DELTA_BINARY_PACKED", "BYTE_STREAM_SPLIT",
+])
+def test_int_encodings(encoding):
+    t = pa.table({"x": pa.array(np.arange(10000, dtype=np.int64) * 13 + 7)})
+    raw = _roundtrip(t, use_dictionary=False, column_encoding={"x": encoding})
+    _check_column(raw, t, "x")
+
+
+@pytest.mark.parametrize("encoding", ["PLAIN", "DELTA_LENGTH_BYTE_ARRAY", "DELTA_BYTE_ARRAY"])
+def test_string_encodings(encoding):
+    t = pa.table({"s": pa.array([f"prefix-shared-{i//10:05d}-{i%10}" for i in range(5000)])})
+    raw = _roundtrip(t, use_dictionary=False, column_encoding={"s": encoding})
+    _check_column(raw, t, "s")
+
+
+def test_byte_stream_split_floats():
+    t = pa.table({"f": pa.array(np.random.default_rng(3).random(4000, dtype=np.float32)),
+                  "d": pa.array(np.random.default_rng(4).random(4000))})
+    raw = _roundtrip(t, use_dictionary=False,
+                     column_encoding={"f": "BYTE_STREAM_SPLIT", "d": "BYTE_STREAM_SPLIT"})
+    _check_column(raw, t, "f")
+    _check_column(raw, t, "d")
+
+
+def test_dictionary_strings_and_ints():
+    t = pa.table({
+        "s": pa.array([f"cat-{i % 17}" for i in range(20000)]),
+        "i": pa.array(np.arange(20000, dtype=np.int64) % 23),
+    })
+    raw = _roundtrip(t, use_dictionary=True, compression="snappy")
+    _check_column(raw, t, "s")
+    _check_column(raw, t, "i")
+
+
+def test_dictionary_fallback_mixed_pages():
+    """Low-cardinality start then high cardinality → pyarrow falls back from
+    dict to plain mid-chunk; decoder must handle mixed page encodings."""
+    vals = [f"v{i % 3}" for i in range(1000)] + [f"unique-{i}" for i in range(50000)]
+    t = pa.table({"s": pa.array(vals)})
+    raw = _roundtrip(t, use_dictionary=True, dictionary_pagesize_limit=10000)
+    _check_column(raw, t, "s")
+
+
+@pytest.mark.parametrize("dpv", ["1.0", "2.0"])
+def test_lists(dpv):
+    t = pa.table({
+        "lst": pa.array([[1, 2, 3] if i % 2 else None for i in range(1000)],
+                        type=pa.list_(pa.int64())),
+        "empties": pa.array([[] if i % 5 == 0 else list(range(i % 7)) for i in range(1000)],
+                            type=pa.list_(pa.int32())),
+        "elem_nulls": pa.array([[None, i, None] if i % 2 else [i] for i in range(1000)],
+                               type=pa.list_(pa.int64())),
+        "strs": pa.array([[f"a{i}", None] if i % 3 else [] for i in range(1000)],
+                         type=pa.list_(pa.string())),
+    })
+    raw = _roundtrip(t, data_page_version=dpv, compression="snappy")
+    for name in t.column_names:
+        _check_column(raw, t, name, path=f"{name}.list.element")
+
+
+@pytest.mark.parametrize("dpv", ["1.0", "2.0"])
+def test_nested_lists(dpv):
+    t = pa.table({
+        "n2": pa.array([[[1.5], [2.5, 3.5]] if i % 3 else None for i in range(500)],
+                       type=pa.list_(pa.list_(pa.float64()))),
+        "deep": pa.array([[[None], [], None] if i % 3 else [[i * 1.0]] for i in range(500)],
+                         type=pa.list_(pa.list_(pa.float64()))),
+    })
+    raw = _roundtrip(t, data_page_version=dpv)
+    for name in t.column_names:
+        _check_column(raw, t, name, path=f"{name}.list.element.list.element")
+
+
+def test_multiple_row_groups():
+    t = pa.table({"x": pa.array(np.arange(100000, dtype=np.int64))})
+    raw = _roundtrip(t, row_group_size=7000)
+    pf = ParquetFile(raw)
+    assert len(pf.row_groups) == 15
+    _check_column(raw, t, "x")
+
+
+def test_multiple_pages_per_chunk():
+    t = pa.table({"x": pa.array(np.arange(200000, dtype=np.int64)),
+                  "s": pa.array([f"padding-{i}" for i in range(200000)])})
+    raw = _roundtrip(t, data_page_size=4096, use_dictionary=False)
+    _check_column(raw, t, "x")
+    _check_column(raw, t, "s")
+
+
+def test_logical_types():
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "date": pa.array(np.arange(1000, dtype=np.int32), type=pa.date32()),
+        "ts_us": pa.array(rng.integers(0, 2**45, 1000), type=pa.timestamp("us")),
+        "ts_ms": pa.array(rng.integers(0, 2**41, 1000), type=pa.timestamp("ms")),
+        "ts_ns": pa.array(rng.integers(0, 2**60, 1000), type=pa.timestamp("ns")),
+        "t32": pa.array(rng.integers(0, 86399999, 1000, dtype=np.int64).astype(np.int32), type=pa.time32("ms")),
+        "t64": pa.array(rng.integers(0, 86399999999, 1000), type=pa.time64("us")),
+        "u8": pa.array(rng.integers(0, 255, 1000, dtype=np.uint8)),
+        "u16": pa.array(rng.integers(0, 65535, 1000, dtype=np.uint16)),
+        "u32": pa.array(rng.integers(0, 2**32 - 1, 1000, dtype=np.uint32)),
+        "u64": pa.array(rng.integers(0, 2**63, 1000).astype(np.uint64)),
+        "i8": pa.array(rng.integers(-128, 127, 1000, dtype=np.int8)),
+        "i16": pa.array(rng.integers(-2**15, 2**15 - 1, 1000, dtype=np.int16)),
+        "f16": pa.array(rng.random(1000).astype(np.float16)),
+    })
+    raw = _roundtrip(t)
+    for name in t.column_names:
+        _check_column(raw, t, name)
+
+
+def test_decimal():
+    import decimal
+
+    vals = [decimal.Decimal(f"{i}.{i % 100:02d}") for i in range(1000)]
+    t = pa.table({
+        "d128": pa.array(vals, type=pa.decimal128(20, 2)),
+        "d_small": pa.array(vals, type=pa.decimal128(9, 2)),  # fits int32
+        "d_mid": pa.array(vals, type=pa.decimal128(18, 2)),  # fits int64
+    })
+    raw = _roundtrip(t)
+    pf = ParquetFile(raw)
+    tab = pf.read()
+    for name in ["d_small", "d_mid"]:
+        arr = tab[name].to_arrow()
+        expect = t[name].combine_chunks()
+        assert arr.cast(expect.type).equals(expect), name
+
+
+def test_fixed_len_byte_array():
+    t = pa.table({"fsb": pa.array([bytes([i % 256] * 16) for i in range(500)],
+                                  type=pa.binary(16))})
+    raw = _roundtrip(t, use_dictionary=False)
+    _check_column(raw, t, "fsb")
+
+
+def test_int96_timestamps():
+    ts = pa.array(np.arange(0, 10**12, 10**9, dtype="int64"), type=pa.timestamp("ns"))
+    t = pa.table({"ts": ts})
+    raw = _roundtrip(t, use_deprecated_int96_timestamps=True)
+    pf = ParquetFile(raw)
+    tab = pf.read()
+    arr = tab["ts"].to_arrow()
+    assert arr.cast(pa.timestamp("ns")).equals(ts)
+
+
+def test_boolean_rle_v2():
+    t = pa.table({"b": pa.array([(i // 9) % 2 == 0 for i in range(5000)])})
+    raw = _roundtrip(t, data_page_version="2.0", use_dictionary=False)
+    _check_column(raw, t, "b")
+
+
+def test_corrupted_magic():
+    t = pa.table({"x": pa.array([1, 2, 3])})
+    raw = bytearray(_roundtrip(t))
+    raw[-4:] = b"XXXX"
+    with pytest.raises(CorruptedError):
+        ParquetFile(bytes(raw))
+
+
+def test_corrupted_footer_length():
+    t = pa.table({"x": pa.array([1, 2, 3])})
+    raw = bytearray(_roundtrip(t))
+    raw[-8:-4] = (2**30).to_bytes(4, "little")
+    with pytest.raises(CorruptedError):
+        ParquetFile(bytes(raw))
+
+
+def test_truncated_file():
+    t = pa.table({"x": pa.array([1, 2, 3])})
+    raw = _roundtrip(t)
+    with pytest.raises((CorruptedError, IOError)):
+        ParquetFile(raw[: len(raw) // 2])
+
+
+def test_crc_verification():
+    t = pa.table({"x": pa.array(np.arange(1000, dtype=np.int64))})
+    raw = _roundtrip(t, write_page_checksum=True)
+    pf = ParquetFile(raw, ReadOptions(verify_crc=True))
+    tab = pf.read()
+    np.testing.assert_array_equal(np.asarray(tab["x"].values), np.arange(1000))
+    # corrupt one payload byte inside the first page → CRC must trip
+    pf2 = ParquetFile(raw)
+    chunk = pf2.row_group(0).column(0)
+    page = next(chunk.pages())
+    body_off = page.offset + (len(raw) * 0)  # header length unknown; find body
+    # find the payload position: header bytes end where payload begins
+    # simplest: corrupt a byte in the middle of the chunk's byte range
+    start, size = chunk.byte_range
+    bad = bytearray(raw)
+    bad[start + size // 2] ^= 0xFF
+    pf3 = ParquetFile(bytes(bad), ReadOptions(verify_crc=True))
+    with pytest.raises((CorruptedError, Exception)):
+        pf3.read()
+
+
+def test_column_projection():
+    t = pa.table({"a": pa.array([1, 2, 3]), "b": pa.array(["x", "y", "z"])})
+    raw = _roundtrip(t)
+    pf = ParquetFile(raw)
+    tab = pf.read(columns=["b"])
+    assert list(tab.keys()) == ["b"]
+
+
+def test_key_value_metadata():
+    t = pa.table({"x": pa.array([1])})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    raw = buf.getvalue()
+    pf = ParquetFile(raw)
+    kv = pf.key_value_metadata()
+    assert any("schema" in k.lower() for k in kv)  # pyarrow writes ARROW:schema
+
+
+def test_statistics():
+    t = pa.table({"x": pa.array(np.arange(1000, dtype=np.int64)),
+                  "s": pa.array([f"k{i:04d}" for i in range(1000)])})
+    raw = _roundtrip(t)
+    pf = ParquetFile(raw)
+    st = pf.row_group(0).column(0).statistics()
+    assert st.min_value == 0 and st.max_value == 999 and st.null_count == 0
+    st = pf.row_group(0).column(1).statistics()
+    assert st.min_value == b"k0000" and st.max_value == b"k0999"
+
+
+def test_to_arrow_table_full():
+    t = pa.table({
+        "a": pa.array(np.arange(500, dtype=np.int64)),
+        "s": pa.array([None if i % 9 == 0 else f"s{i}" for i in range(500)]),
+    })
+    raw = _roundtrip(t)
+    out = ParquetFile(raw).read().to_arrow()
+    assert out["a"].combine_chunks().equals(t["a"].combine_chunks())
+    assert out["s"].combine_chunks().cast(pa.string()).equals(t["s"].combine_chunks())
