@@ -1,0 +1,341 @@
+"""Device (XLA/jnp) decode kernels — the TPU compute path.
+
+Reference parity: these replace the reference's amd64 assembly kernels
+(SURVEY.md §2.3: internal/bitpack, encoding/rle asm, delta asm,
+bytestreamsplit asm) at the same insertion point — the ``encoding.Encoding``
+registry.  Design per SURVEY.md §7:
+
+- All kernels are pure functions of flat uint8 buffers + small metadata
+  arrays, jit-compiled with static shapes (bucket-padded by the caller).
+- The inherently sequential work (run-header varint scans, miniblock header
+  walks) happens on host at *metadata* scale (bytes per run/miniblock), then
+  the device does the wide expansion at *data* scale — the two-pass split of
+  SURVEY.md §7 hard part 1.
+- Everything is a gather/shift/mask/cumsum — no data-dependent control flow,
+  so XLA fuses freely.  Pallas variants for the hottest kernels live in
+  ``pallas_kernels.py``.
+
+**32-bit-lane discipline (TPU-first):** TPU VPUs are 32-bit-lane machines and
+this stack's TPU compile path rewrites away 64-bit element types (64-bit
+``bitcast_convert_type`` is unimplemented there, and miscompiles on some CPU
+builds).  So device kernels NEVER bitcast 64-bit types: 64-bit columns live on
+device as ``(n, 2)`` uint32 pairs — byte-exact, converted to int64/float64 by
+a zero-copy ``.view()`` at host materialization — and all bit-unpacking is
+32-bit shift/mask arithmetic.  Only DELTA_BINARY_PACKED's int64 prefix-sum
+uses (emulated) s64 *arithmetic*, which the rewrite does support.
+
+int64 note: importing this module enables jax x64 (needed for s64 cumsum and
+wide bit offsets) unless PARQUET_TPU_NO_X64 is set.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+
+if not os.environ.get("PARQUET_TPU_NO_X64"):
+    jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from ..utils.debug import counters
+
+_U32 = jnp.uint32
+
+
+# ---------------------------------------------------------------------------
+# Byte gathers (arithmetic combine — no 64-bit bitcasts anywhere)
+# ---------------------------------------------------------------------------
+
+
+def _gather_word(buf: jax.Array, byte0: jax.Array) -> jax.Array:
+    """4 consecutive bytes at each (unaligned) position → uint32, little-endian."""
+    b = buf.astype(_U32)
+    return (
+        b[byte0]
+        | (b[byte0 + 1] << _U32(8))
+        | (b[byte0 + 2] << _U32(16))
+        | (b[byte0 + 3] << _U32(24))
+    )
+
+
+# ---------------------------------------------------------------------------
+# PLAIN fixed-width (the config[0] minimum slice: decode == reinterpret)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n", "dtype"))
+def bitcast_fixed32(buf: jax.Array, n: int, dtype: str) -> jax.Array:
+    """uint8 → {int32,uint32,float32}[n] (PLAIN 4-byte types)."""
+    return jax.lax.bitcast_convert_type(
+        buf[: n * 4].reshape(n, 4), jnp.dtype(dtype)).reshape(n)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def fixed64_pairs(buf: jax.Array, n: int) -> jax.Array:
+    """uint8 → uint32[n,2] lo/hi pairs (PLAIN 8-byte types, byte-exact)."""
+    return jax.lax.bitcast_convert_type(
+        buf[: n * 8].reshape(n, 2, 4), _U32).reshape(n, 2)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def unpack_bools(buf: jax.Array, n: int) -> jax.Array:
+    """PLAIN BOOLEAN: LSB-first bit-unpack."""
+    nbytes = (n + 7) // 8
+    bits = (buf[:nbytes, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return bits.reshape(-1)[:n].astype(jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# Generic bit-unpack: the single most load-bearing kernel (SURVEY.md §2.3)
+# ---------------------------------------------------------------------------
+
+
+def unpack_bits_at32(buf: jax.Array, bit_starts: jax.Array, widths) -> jax.Array:
+    """One ≤32-bit LSB-first integer per element at absolute bit positions.
+
+    ``widths`` may be scalar or per-element (mixed-width streams: a whole
+    chunk of differently-packed pages decodes in ONE call).  uint32 out.
+    Covers levels, dictionary indexes, and int32 deltas — the hot 99%.
+    """
+    byte0 = bit_starts >> 3
+    sh = (bit_starts & 7).astype(_U32)
+    w0 = _gather_word(buf, byte0)
+    w1 = _gather_word(buf, byte0 + 4)
+    lo = w0 >> sh
+    hi = jnp.where(sh > 0, w1 << (_U32(32) - sh), _U32(0))
+    val = lo | hi
+    w = jnp.asarray(widths)
+    w32 = w.astype(_U32)
+    mask = jnp.where(w32 >= 32, _U32(0xFFFFFFFF), (_U32(1) << w32) - _U32(1))
+    return val & mask
+
+
+def unpack_bits_at64(buf: jax.Array, bit_starts: jax.Array, widths
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Like :func:`unpack_bits_at32` for widths ≤ 64 → (lo, hi) uint32 pair."""
+    byte0 = bit_starts >> 3
+    sh = (bit_starts & 7).astype(_U32)
+    w0 = _gather_word(buf, byte0)
+    w1 = _gather_word(buf, byte0 + 4)
+    w2 = _gather_word(buf, byte0 + 8)
+    nz = sh > 0
+    inv = _U32(32) - sh
+    lo = (w0 >> sh) | jnp.where(nz, w1 << inv, _U32(0))
+    hi = (w1 >> sh) | jnp.where(nz, w2 << inv, _U32(0))
+    w32 = jnp.asarray(widths).astype(_U32)
+    lo_bits = jnp.minimum(w32, _U32(32))
+    hi_bits = jnp.maximum(w32, _U32(32)) - _U32(32)
+    lo_mask = jnp.where(lo_bits >= 32, _U32(0xFFFFFFFF), (_U32(1) << lo_bits) - _U32(1))
+    hi_mask = jnp.where(hi_bits >= 32, _U32(0xFFFFFFFF), (_U32(1) << hi_bits) - _U32(1))
+    return lo & lo_mask, hi & hi_mask
+
+
+@partial(jax.jit, static_argnames=("n", "width"))
+def unpack_bits(buf: jax.Array, n: int, width: int, offset_bits: int = 0) -> jax.Array:
+    """Dense LSB-first unpack of ``n`` ``width``-bit integers (≤32 → u32,
+    else → (n,2) u32 pairs)."""
+    starts = jnp.arange(n, dtype=jnp.int64) * width + offset_bits
+    if width <= 32:
+        return unpack_bits_at32(buf, starts, width)
+    lo, hi = unpack_bits_at64(buf, starts, width)
+    return jnp.stack([lo, hi], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-packed hybrid expansion (device half of the two-pass split)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n",))
+def rle_expand(
+    buf: jax.Array,  # uint8 payload (whole chunk, padded +12)
+    n: int,  # total output values (static, padded ok)
+    run_ends: jax.Array,  # int64[k] cumulative output counts per run
+    run_kinds: jax.Array,  # uint8[k] 0=RLE 1=bit-packed
+    run_payloads: jax.Array,  # int32[k] repeated value for RLE runs
+    run_bit_offsets: jax.Array,  # int64[k] absolute bit offset of packed data
+    run_widths: jax.Array,  # int32[k] bit width (per run: pages may differ!)
+) -> jax.Array:
+    """Expand a pre-scanned hybrid stream (levels / dict indexes, ≤32-bit):
+    one gather-driven pass, no sequential dependencies.  int32 out."""
+    idx = jnp.arange(n, dtype=jnp.int64)
+    run_id = jnp.searchsorted(run_ends, idx, side="right")
+    run_id = jnp.minimum(run_id, run_ends.shape[0] - 1)
+    counts = jnp.diff(run_ends, prepend=jnp.int64(0))
+    starts = run_ends[run_id] - counts[run_id]
+    within = idx - starts
+    w = run_widths[run_id]
+    bit_pos = run_bit_offsets[run_id] + within * w.astype(jnp.int64)
+    packed = unpack_bits_at32(buf, bit_pos, w).astype(jnp.int32)
+    return jnp.where(run_kinds[run_id] == 0, run_payloads[run_id], packed)
+
+
+# ---------------------------------------------------------------------------
+# DELTA_BINARY_PACKED (miniblock unpack + cumsum — SURVEY.md §2.2: "excellent fit")
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n", "vpm"))
+def delta_decode32(
+    buf: jax.Array, n: int, first_value: jax.Array,
+    mb_bit_offsets: jax.Array, mb_widths: jax.Array, mb_min_deltas: jax.Array,
+    vpm: int,
+) -> jax.Array:
+    """INT32 delta decode.  All arithmetic is mod-2^32 (two's complement
+    wrap), so 32-bit lanes suffice even though raw deltas span 33 bits."""
+    nd = n - 1
+    if nd <= 0:
+        return jnp.full((max(n, 0),), first_value.astype(jnp.int32))
+    i = jnp.arange(nd, dtype=jnp.int64)
+    mb = i // vpm
+    within = i % vpm
+    w = mb_widths[mb]
+    bit_pos = mb_bit_offsets[mb] + within * w.astype(jnp.int64)
+    raw = unpack_bits_at32(buf, bit_pos, w)
+    min32 = (mb_min_deltas & jnp.int64(0xFFFFFFFF)).astype(_U32)
+    deltas = raw + min32[mb]
+    first32 = (first_value.astype(jnp.int64) & jnp.int64(0xFFFFFFFF)).astype(_U32)
+    seq = jnp.concatenate([first32.reshape(1), deltas])
+    return jax.lax.bitcast_convert_type(jnp.cumsum(seq), jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n", "vpm"))
+def delta_decode64(
+    buf: jax.Array, n: int, first_value: jax.Array,
+    mb_bit_offsets: jax.Array, mb_widths: jax.Array, mb_min_deltas: jax.Array,
+    vpm: int,
+) -> jax.Array:
+    """INT64 delta decode → (n,2) uint32 pairs.  Unpack is 32-bit lane work;
+    only the prefix-sum runs in (emulated) s64 arithmetic."""
+    nd = n - 1
+    if nd <= 0:
+        v = first_value.astype(jnp.int64).reshape(1)
+        return _i64_to_pairs(jnp.broadcast_to(v, (max(n, 1),)))[:n]
+    i = jnp.arange(nd, dtype=jnp.int64)
+    mb = i // vpm
+    within = i % vpm
+    w = mb_widths[mb]
+    bit_pos = mb_bit_offsets[mb] + within * w.astype(jnp.int64)
+    lo, hi = unpack_bits_at64(buf, bit_pos, w)
+    raw = lo.astype(jnp.int64) | (hi.astype(jnp.int64) << 32)
+    deltas = raw + mb_min_deltas[mb]
+    seq = jnp.concatenate([first_value.astype(jnp.int64).reshape(1), deltas])
+    return _i64_to_pairs(jnp.cumsum(seq))
+
+
+def _i64_to_pairs(v: jax.Array) -> jax.Array:
+    lo = (v & jnp.int64(0xFFFFFFFF)).astype(_U32)
+    hi = ((v >> 32) & jnp.int64(0xFFFFFFFF)).astype(_U32)
+    return jnp.stack([lo, hi], axis=1)
+
+
+def delta_prescan(data: np.ndarray, pos: int = 0):
+    """Host pre-scan of a DELTA_BINARY_PACKED stream → device metadata.
+
+    Returns (first_value, total, vpm, mb_bit_offsets, mb_widths,
+    mb_min_deltas, end_pos).  O(miniblocks), not O(values)."""
+    from . import ref
+
+    block_size, pos = ref.read_uvarint(data, pos)
+    n_miniblocks, pos = ref.read_uvarint(data, pos)
+    total, pos = ref.read_uvarint(data, pos)
+    first_raw, pos = ref.read_uvarint(data, pos)
+    first = ref.unzigzag(first_raw)
+    vpm = block_size // n_miniblocks
+    offsets, widths, mins = [], [], []
+    got = 1
+    while got < total:
+        md_raw, pos = ref.read_uvarint(data, pos)
+        min_delta = ref.unzigzag(md_raw)
+        wbytes = data[pos : pos + n_miniblocks]
+        pos += n_miniblocks
+        for m in range(n_miniblocks):
+            if got >= total:
+                break
+            w = int(wbytes[m])
+            offsets.append(pos * 8)
+            widths.append(w)
+            mins.append(min_delta)
+            pos += vpm * w // 8
+            got += min(vpm, total - got)
+    return (
+        first, total, vpm,
+        np.asarray(offsets, dtype=np.int64),
+        np.asarray(widths, dtype=np.int32),
+        np.asarray(mins, dtype=np.int64),
+        pos,
+    )
+
+
+# ---------------------------------------------------------------------------
+# BYTE_STREAM_SPLIT (plane transpose; 64-bit types → u32 pairs)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n", "width", "out_dtype"))
+def byte_stream_split(buf: jax.Array, n: int, width: int,
+                      out_dtype: Optional[str] = None) -> jax.Array:
+    planes = buf[: width * n].reshape(width, n)
+    interleaved = planes.T  # (n, width) bytes
+    if out_dtype is None:
+        return interleaved
+    if width == 4:
+        return jax.lax.bitcast_convert_type(interleaved, jnp.dtype(out_dtype)).reshape(n)
+    assert width == 8
+    return jax.lax.bitcast_convert_type(
+        interleaved.reshape(n, 2, 4), _U32).reshape(n, 2)  # pairs; host views dtype
+
+
+# ---------------------------------------------------------------------------
+# Dictionary gather + level math (trivial but central)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def dict_gather(dictionary: jax.Array, indices: jax.Array) -> jax.Array:
+    return jnp.take(dictionary, indices, axis=0)
+
+
+@partial(jax.jit, static_argnames=("max_def",))
+def validity_from_def(def_levels: jax.Array, max_def: int) -> jax.Array:
+    return def_levels == max_def
+
+
+@jax.jit
+def cumsum_offsets(lengths: jax.Array) -> jax.Array:
+    return jnp.concatenate([jnp.zeros(1, jnp.int64),
+                            jnp.cumsum(lengths.astype(jnp.int64))])
+
+
+@jax.jit
+def scatter_valid(values: jax.Array, validity: jax.Array) -> jax.Array:
+    """Dense present values → slot-aligned array (nulls get 0)."""
+    slot_of_value = jnp.cumsum(validity.astype(jnp.int32)) - 1
+    gathered = values[jnp.clip(slot_of_value, 0, values.shape[0] - 1)]
+    zero = jnp.zeros((), dtype=values.dtype)
+    if values.ndim > 1:
+        return jnp.where(validity[:, None], gathered, zero)
+    return jnp.where(validity, gathered, zero)
+
+
+def pad_to_bucket(arr: np.ndarray, extra: int = 12) -> np.ndarray:
+    """Pad a host buffer to a power-of-two bucket (+slack for 12-byte gathers)
+    so jit specializations are reused across similarly-sized pages."""
+    n = len(arr) + extra
+    bucket = 1 << max(int(n - 1).bit_length(), 6)
+    if bucket == len(arr):
+        return arr
+    out = np.zeros(bucket, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def pairs_to_host(pairs, dtype) -> np.ndarray:
+    """(n,2) u32 device pairs → host int64/float64 array (zero-copy view)."""
+    return np.ascontiguousarray(np.asarray(pairs)).view(dtype).reshape(-1)

@@ -1,0 +1,161 @@
+"""Device (jnp/XLA) kernels vs the numpy oracle — the purego-equivalence
+pattern of SURVEY.md §4(4), run on the CPU backend (same XLA semantics as TPU;
+the driver's bench exercises the real chip).
+
+Note the 32-bit-lane discipline (see ops/device.py): 64-bit columns come back
+as (n,2) uint32 pairs and are viewed as int64/float64 on host.
+"""
+
+import numpy as np
+import pytest
+
+from parquet_tpu.ops import device, ref
+
+
+def _pad(b) -> np.ndarray:
+    return device.pad_to_bucket(np.frombuffer(b, np.uint8) if isinstance(b, bytes) else b)
+
+
+def test_bitcast_fixed32(rng):
+    for dtype in ["int32", "float32", "uint32"]:
+        v = rng.integers(0, 1000, size=777).astype(dtype)
+        out = device.bitcast_fixed32(_pad(v.tobytes()), 777, dtype)
+        np.testing.assert_array_equal(np.asarray(out), v)
+
+
+def test_fixed64_pairs(rng):
+    for dtype in ["int64", "float64"]:
+        v = (rng.integers(-(2**62), 2**62, size=777).astype(dtype)
+             if dtype == "int64" else rng.random(777))
+        out = device.fixed64_pairs(_pad(v.tobytes()), 777)
+        np.testing.assert_array_equal(device.pairs_to_host(out, dtype), v)
+
+
+def test_unpack_bools(rng):
+    b = rng.random(1003) < 0.3
+    enc = ref.encode_plain(b, ref.Type.BOOLEAN)
+    out = device.unpack_bools(_pad(enc), 1003)
+    np.testing.assert_array_equal(np.asarray(out), b)
+
+
+@pytest.mark.parametrize("w", [1, 2, 3, 5, 7, 8, 12, 16, 17, 24, 31, 32])
+def test_unpack_bits_32(w, rng):
+    n = 1013
+    hi = (1 << w) - 1
+    v = rng.integers(0, hi, size=n, dtype=np.uint64, endpoint=True)
+    packed = ref.pack_bits(v, w)
+    out = device.unpack_bits(_pad(packed), n, w)
+    np.testing.assert_array_equal(np.asarray(out), v.astype(np.uint32))
+
+
+@pytest.mark.parametrize("w", [33, 40, 47, 57, 63, 64])
+def test_unpack_bits_64(w, rng):
+    n = 1013
+    hi = (1 << w) - 1
+    v = rng.integers(0, min(hi, 2**63 - 1), size=n, dtype=np.uint64, endpoint=True) & np.uint64(hi)
+    packed = ref.pack_bits(v, w)
+    out = np.asarray(device.unpack_bits(_pad(packed), n, w))
+    got = out[:, 0].astype(np.uint64) | (out[:, 1].astype(np.uint64) << np.uint64(32))
+    np.testing.assert_array_equal(got, v)
+
+
+@pytest.mark.parametrize("w", [1, 3, 8, 12, 20, 31])
+@pytest.mark.parametrize("style", ["runs", "rand", "mixed"])
+def test_rle_expand(w, style, rng):
+    n = 3777
+    if style == "runs":
+        v = np.repeat(rng.integers(0, 1 << w, size=50), rng.integers(1, 200, size=50))[:n]
+    elif style == "rand":
+        v = rng.integers(0, 1 << w, size=n)
+    else:
+        v = np.where(rng.random(n) < 0.5, 1, rng.integers(0, 1 << w, size=n))
+    n = len(v)
+    enc = ref.encode_rle(v, w)
+    buf = np.frombuffer(enc, np.uint8)
+    kinds, counts, payloads, offsets, _ = ref.scan_rle_runs(buf, n, w)
+    out = device.rle_expand(
+        _pad(enc), n,
+        np.cumsum(counts).astype(np.int64), kinds,
+        payloads.astype(np.int32),
+        offsets * 8, np.full(len(kinds), w, dtype=np.int32))
+    np.testing.assert_array_equal(np.asarray(out), v)
+
+
+def test_rle_expand_mixed_widths(rng):
+    """Two pages with different bit widths decoded in ONE device call."""
+    v1 = rng.integers(0, 1 << 4, size=1000)
+    v2 = rng.integers(0, 1 << 9, size=1500)
+    e1, e2 = ref.encode_rle(v1, 4), ref.encode_rle(v2, 9)
+    buf = e1 + e2
+    k1, c1, p1, o1, _ = ref.scan_rle_runs(np.frombuffer(e1, np.uint8), 1000, 4)
+    k2, c2, p2, o2, _ = ref.scan_rle_runs(np.frombuffer(e2, np.uint8), 1500, 9)
+    kinds = np.concatenate([k1, k2])
+    ends = np.cumsum(np.concatenate([c1, c2])).astype(np.int64)
+    payloads = np.concatenate([p1, p2]).astype(np.int32)
+    offsets = np.concatenate([o1 * 8, (o2 + len(e1)) * 8])
+    widths = np.concatenate([np.full(len(k1), 4), np.full(len(k2), 9)]).astype(np.int32)
+    out = device.rle_expand(_pad(buf), 2500, ends, kinds, payloads, offsets, widths)
+    np.testing.assert_array_equal(np.asarray(out), np.concatenate([v1, v2]))
+
+
+@pytest.mark.parametrize("n", [1, 2, 33, 128, 129, 1000])
+@pytest.mark.parametrize("kind", ["rand", "sorted", "const"])
+def test_delta_decode32(n, kind, rng):
+    if kind == "rand":
+        v = rng.integers(-(2**31), 2**31, size=n).astype(np.int32)
+    elif kind == "sorted":
+        v = np.sort(rng.integers(0, 2**30, size=n)).astype(np.int32)
+    else:
+        v = np.full(n, 42, dtype=np.int32)
+    enc = ref.encode_delta_binary_packed(v.astype(np.int64))
+    buf = np.frombuffer(enc, np.uint8)
+    first, total, vpm, offs, widths, mins, _ = device.delta_prescan(buf)
+    out = device.delta_decode32(_pad(enc), n, np.int64(first), offs, widths, mins, vpm)
+    np.testing.assert_array_equal(np.asarray(out)[:n], v)
+
+
+@pytest.mark.parametrize("n", [1, 2, 33, 128, 129, 1000])
+@pytest.mark.parametrize("kind", ["rand64", "sorted", "const"])
+def test_delta_decode64(n, kind, rng):
+    if kind == "rand64":
+        v = rng.integers(-(2**62), 2**62, size=n)
+    elif kind == "sorted":
+        v = np.sort(rng.integers(0, 10**12, size=n))
+    else:
+        v = np.full(n, -7, dtype=np.int64)
+    enc = ref.encode_delta_binary_packed(v)
+    buf = np.frombuffer(enc, np.uint8)
+    first, total, vpm, offs, widths, mins, _ = device.delta_prescan(buf)
+    out = device.delta_decode64(_pad(enc), n, np.int64(first), offs, widths, mins, vpm)
+    np.testing.assert_array_equal(device.pairs_to_host(out, np.int64)[:n], v)
+
+
+def test_byte_stream_split_f32(rng):
+    f = rng.random(777).astype(np.float32)
+    enc = ref.encode_byte_stream_split(np.frombuffer(f.tobytes(), np.uint8), 777, 4)
+    out = device.byte_stream_split(_pad(enc), 777, 4, out_dtype="float32")
+    np.testing.assert_array_equal(np.asarray(out), f)
+
+
+def test_byte_stream_split_f64(rng):
+    f = rng.random(777)
+    enc = ref.encode_byte_stream_split(np.frombuffer(f.tobytes(), np.uint8), 777, 8)
+    out = device.byte_stream_split(_pad(enc), 777, 8, out_dtype="float64")
+    np.testing.assert_array_equal(device.pairs_to_host(out, np.float64), f)
+
+
+def test_dict_gather(rng):
+    d = rng.integers(0, 10**9, size=1000).astype(np.int64)
+    pairs = np.ascontiguousarray(np.frombuffer(d.tobytes(), np.uint32).reshape(-1, 2))
+    idx = rng.integers(0, 1000, size=5000).astype(np.int32)
+    out = device.dict_gather(pairs, idx)
+    np.testing.assert_array_equal(device.pairs_to_host(out, np.int64), d[idx])
+
+
+def test_scatter_valid(rng):
+    validity = rng.random(1000) < 0.7
+    vals = rng.integers(0, 100, size=int(validity.sum())).astype(np.int32)
+    out = np.asarray(device.scatter_valid(vals, validity))
+    expect = np.zeros(1000, dtype=np.int32)
+    expect[validity] = vals
+    np.testing.assert_array_equal(out, expect)
