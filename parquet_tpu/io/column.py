@@ -30,12 +30,34 @@ class Column:
     list_offsets: List[Any] = field(default_factory=list)  # per repeated level
     list_validity: List[Optional[Any]] = field(default_factory=list)
     num_slots: int = 0  # leaf slot count (== num rows for flat columns)
+    # dictionary-encoded representation (device path keeps chunks encoded:
+    # the Arrow DictionaryArray analog — reference dictionary.go read side)
+    dictionary: Any = None  # device dict values (or (values, offsets) pair)
+    dictionary_host: Any = None  # host numpy mirror
+    dict_indices: Any = None  # int32 indexes into the dictionary
 
     @property
     def num_values(self) -> int:
+        if self.values is None and self.dict_indices is not None:
+            return len(self.dict_indices)
         if self.offsets is not None:
             return len(self.offsets) - 1
         return len(self.values)
+
+    def is_dictionary_encoded(self) -> bool:
+        return self.values is None and self.dict_indices is not None
+
+    def materialize_host(self):
+        """Dense host (values, offsets) for dictionary-encoded byte arrays."""
+        from ..ops import ref as _ref
+
+        idx = np.asarray(self.dict_indices).astype(np.int64)
+        gathered = _ref.gather_dictionary(self.dictionary_host, idx)
+        if isinstance(gathered, tuple):
+            self.values, self.offsets = gathered
+        else:
+            self.values = gathered
+        return self
 
     # ------------------------------------------------------------------
     def to_numpy(self):
@@ -46,7 +68,17 @@ class Column:
         import pyarrow as pa
 
         leaf = self.leaf
+        if self.is_dictionary_encoded():
+            self.materialize_host()
         values = np.asarray(self.values)
+        # device pair representation → host 64-bit view (zero-copy)
+        if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
+            host_dt = {Type.INT64: np.int64, Type.DOUBLE: np.float64}.get(
+                leaf.physical_type, np.int64)
+            values = np.ascontiguousarray(values).view(host_dt).reshape(-1)
+        if (leaf.physical_type == Type.INT96 and values.ndim == 2
+                and values.dtype == np.uint32):
+            values = values.astype(np.uint32).view(np.int32)
         offsets = None if self.offsets is None else np.asarray(self.offsets)
         validity = None if self.validity is None else np.asarray(self.validity)
 
@@ -139,6 +171,9 @@ def concat_columns(parts: List[Column]) -> Column:
     """Concatenate per-row-group chunks of the same leaf into one Column."""
     if len(parts) == 1:
         return parts[0]
+    for p in parts:  # per-row-group dictionaries differ: materialize first
+        if p.is_dictionary_encoded():
+            p.materialize_host()
     first = parts[0]
     if first.offsets is not None:
         values = np.concatenate([np.asarray(p.values) for p in parts])

@@ -241,12 +241,21 @@ class ParquetFile:
         return RowGroupReader(self, i, self.metadata.row_groups[i])
 
     # ------------------------------------------------------------------
-    def read(self, columns: Optional[Sequence[str]] = None) -> "Table":
-        """Read and decode the whole file on host (oracle path)."""
+    def read(self, columns: Optional[Sequence[str]] = None,
+             device: bool = False) -> "Table":
+        """Read and decode the whole file.
+
+        ``device=False``: host numpy oracle path.  ``device=True``: the TPU
+        path — batched H2D staging + XLA kernels (parallel/device_reader.py).
+        """
+        if device:
+            from ..parallel.device_reader import decode_chunk_device as _dec
+        else:
+            _dec = decode_chunk_host
         leaves = _select_leaves(self.schema, columns)
         cols: Dict[str, Column] = {}
         for leaf in leaves:
-            parts = [self.row_group(i).column(leaf.column_index).read()
+            parts = [_dec(self.row_group(i).column(leaf.column_index))
                      for i in range(len(self.metadata.row_groups or []))]
             cols[leaf.dotted_path] = concat_columns(parts) if len(parts) != 1 else parts[0]
         return Table(self.schema, cols, self.num_rows)
