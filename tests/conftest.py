@@ -1,19 +1,27 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-The driver benches on a real TPU chip; tests run everywhere by simulating the
-8-chip v5e topology on host CPU (SURVEY.md §4: chex-style multi-device tests on
-``xla_force_host_platform_device_count=8``).  Must run before jax initializes.
+The driver benches on the real TPU chip; tests run everywhere by simulating
+the 8-chip v5e topology on host CPU (SURVEY.md §4: multi-device tests on
+``xla_force_host_platform_device_count=8``).
+
+Note: this environment's axon sitecustomize force-registers the TPU platform
+and overwrites ``jax_platforms`` to "axon,cpu" in every process, so env vars
+alone don't stick — we must update the jax config *after* import, before any
+backend initialization.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,135 @@
+"""Device read path vs pyarrow across the format matrix (CPU backend; the
+driver's bench runs the same path on the real chip)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.io.reader import ParquetFile
+
+
+def _write(t: pa.Table, **kw) -> bytes:
+    buf = io.BytesIO()
+    pq.write_table(t, buf, **kw)
+    return buf.getvalue()
+
+
+def _check(raw: bytes, t: pa.Table, names=None, paths=None):
+    tab = ParquetFile(raw).read(device=True)
+    names = names or t.column_names
+    for i, name in enumerate(names):
+        path = paths[i] if paths else name
+        arr = tab[path].to_arrow()
+        expect = t[name].combine_chunks()
+        if arr.type != expect.type:
+            arr = arr.cast(expect.type)
+        assert arr.equals(expect), f"{name} mismatch"
+
+
+def test_device_plain_types(rng):
+    t = pa.table({
+        "i64": pa.array(rng.integers(-(2**60), 2**60, 5000)),
+        "i32": pa.array(rng.integers(-(2**31), 2**31, 5000).astype(np.int32)),
+        "f32": pa.array(rng.random(5000, dtype=np.float32)),
+        "f64": pa.array(rng.random(5000)),
+        "b": pa.array(rng.random(5000) < 0.5),
+    })
+    _check(_write(t, use_dictionary=False), t)
+
+
+@pytest.mark.parametrize("compression", ["none", "snappy", "zstd"])
+def test_device_compressions(compression, rng):
+    t = pa.table({"x": pa.array(np.arange(20000, dtype=np.int64) % 997)})
+    _check(_write(t, compression=compression, use_dictionary=False), t)
+
+
+def test_device_nulls(rng):
+    t = pa.table({
+        "oi": pa.array([None if i % 3 == 0 else i for i in range(5000)], type=pa.int64()),
+        "of": pa.array([None if i % 7 == 0 else float(i) for i in range(5000)]),
+    })
+    _check(_write(t), t)
+
+
+def test_device_dictionary(rng):
+    t = pa.table({
+        "s": pa.array([f"cat-{i % 17}" for i in range(20000)]),
+        "i": pa.array(rng.integers(0, 23, 20000)),
+        "d": pa.array((rng.integers(0, 5, 20000) * 1.5)),
+    })
+    raw = _write(t, use_dictionary=True)
+    tab = ParquetFile(raw).read(device=True)
+    assert tab["s"].is_dictionary_encoded()  # strings stay encoded on device
+    _check(raw, t)
+
+
+def test_device_delta(rng):
+    t = pa.table({
+        "ts": pa.array(np.sort(rng.integers(0, 2**44, 10000)), type=pa.timestamp("us")),
+        "i32": pa.array(rng.integers(-(2**30), 2**30, 10000).astype(np.int32)),
+    })
+    raw = _write(t, use_dictionary=False,
+                 column_encoding={"ts": "DELTA_BINARY_PACKED", "i32": "DELTA_BINARY_PACKED"})
+    _check(raw, t)
+
+
+def test_device_delta_multipage(rng):
+    t = pa.table({"x": pa.array(rng.integers(-(2**50), 2**50, 100000))})
+    raw = _write(t, use_dictionary=False, data_page_size=4096,
+                 column_encoding={"x": "DELTA_BINARY_PACKED"})
+    _check(raw, t)
+
+
+def test_device_bss_multipage(rng):
+    t = pa.table({"f": pa.array(rng.random(50000, dtype=np.float32)),
+                  "d": pa.array(rng.random(50000))})
+    raw = _write(t, use_dictionary=False, data_page_size=8192,
+                 column_encoding={"f": "BYTE_STREAM_SPLIT", "d": "BYTE_STREAM_SPLIT"})
+    _check(raw, t)
+
+
+def test_device_multipage_plain_with_nulls(rng):
+    t = pa.table({"x": pa.array([None if i % 5 == 0 else i for i in range(60000)],
+                                type=pa.int64())})
+    raw = _write(t, use_dictionary=False, data_page_size=4096)
+    _check(raw, t)
+
+
+@pytest.mark.parametrize("dpv", ["1.0", "2.0"])
+def test_device_lists(dpv, rng):
+    t = pa.table({
+        "lst": pa.array([[1, 2, 3] if i % 2 else None for i in range(2000)],
+                        type=pa.list_(pa.int64())),
+    })
+    raw = _write(t, data_page_version=dpv)
+    _check(raw, t, names=["lst"], paths=["lst.list.element"])
+
+
+def test_device_strings_plain(rng):
+    t = pa.table({"s": pa.array([f"plain-string-{i}" for i in range(5000)])})
+    raw = _write(t, use_dictionary=False, column_encoding={"s": "PLAIN"})
+    _check(raw, t)
+
+
+def test_device_multi_row_groups(rng):
+    t = pa.table({"x": pa.array(np.arange(50000, dtype=np.int64))})
+    raw = _write(t, row_group_size=7000, use_dictionary=False)
+    _check(raw, t)
+
+
+def test_device_matches_host_exactly(rng):
+    t = pa.table({
+        "a": pa.array(rng.integers(0, 10**12, 10000)),
+        "s": pa.array([f"v{i % 29}" for i in range(10000)]),
+    })
+    raw = _write(t, compression="zstd")
+    pf = ParquetFile(raw)
+    host = pf.read()
+    devi = pf.read(device=True)
+    np.testing.assert_array_equal(
+        np.asarray(host["a"].values),
+        np.ascontiguousarray(np.asarray(devi["a"].values)).view(np.int64).reshape(-1))
+    assert devi["s"].to_arrow().cast(pa.string()).equals(host["s"].to_arrow().cast(pa.string()))
