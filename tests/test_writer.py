@@ -1,0 +1,239 @@
+"""Write-path tests: pyarrow reads our files; our reader round-trips; bloom,
+page index, statistics, CRC, multi-row-group, both page versions."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.format.enums import Encoding
+from parquet_tpu.io.reader import ParquetFile, ReadOptions
+from parquet_tpu.io.writer import (ColumnData, ParquetWriter, WriterOptions,
+                                   schema_from_arrow, write_table)
+
+
+def _write(t, **opt_kw) -> bytes:
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(**opt_kw) if opt_kw else None)
+    return buf.getvalue()
+
+
+def _pyarrow_equal(raw: bytes, t: pa.Table):
+    got = pq.read_table(io.BytesIO(raw))
+    for name in t.column_names:
+        g = got[name].combine_chunks()
+        e = t[name].combine_chunks()
+        if g.type != e.type:
+            g = g.cast(e.type)
+        assert g.equals(e), f"{name}: pyarrow readback mismatch"
+
+
+def _self_equal(raw: bytes, t: pa.Table, device=False):
+    tab = ParquetFile(raw).read(device=device)
+    for name in t.column_names:
+        leafpaths = [p for p in tab.keys() if p == name or p.startswith(name + ".")]
+        arr = tab[leafpaths[0]].to_arrow()
+        e = t[name].combine_chunks()
+        if arr.type != e.type:
+            arr = arr.cast(e.type)
+        assert arr.equals(e), f"{name}: self readback mismatch"
+
+
+def _basic_table(rng, n=5000):
+    return pa.table({
+        "i64": pa.array(rng.integers(-(2**60), 2**60, n)),
+        "i32": pa.array(rng.integers(-(2**31), 2**31, n).astype(np.int32)),
+        "f32": pa.array(rng.random(n, dtype=np.float32)),
+        "f64": pa.array(rng.random(n)),
+        "b": pa.array(rng.random(n) < 0.5),
+        "s": pa.array([f"string-{i % 211}" for i in range(n)]),
+        "opt": pa.array([None if i % 3 == 0 else i for i in range(n)], type=pa.int64()),
+    })
+
+
+@pytest.mark.parametrize("compression", ["none", "snappy", "zstd", "gzip", "brotli", "lz4"])
+def test_codecs_pyarrow_reads(compression, rng):
+    t = _basic_table(rng)
+    raw = _write(t, compression=compression)
+    _pyarrow_equal(raw, t)
+    _self_equal(raw, t)
+
+
+@pytest.mark.parametrize("dpv", [1, 2])
+def test_page_versions(dpv, rng):
+    t = _basic_table(rng)
+    raw = _write(t, data_page_version=dpv)
+    _pyarrow_equal(raw, t)
+    _self_equal(raw, t)
+    _self_equal(raw, t, device=True)
+
+
+def test_encodings(rng):
+    t = pa.table({
+        "delta": pa.array(np.sort(rng.integers(0, 2**44, 20000))),
+        "delta32": pa.array(rng.integers(-(2**30), 2**30, 20000).astype(np.int32)),
+        "bss": pa.array(rng.random(20000, dtype=np.float32)),
+        "dlba": pa.array([f"value-{i}" for i in range(20000)]),
+        "dba": pa.array([f"prefix-{i // 100:05d}-{i % 100}" for i in range(20000)]),
+    })
+    raw = _write(t, dictionary=False, column_encoding={
+        "delta": Encoding.DELTA_BINARY_PACKED,
+        "delta32": Encoding.DELTA_BINARY_PACKED,
+        "bss": Encoding.BYTE_STREAM_SPLIT,
+        "dlba": Encoding.DELTA_LENGTH_BYTE_ARRAY,
+        "dba": Encoding.DELTA_BYTE_ARRAY,
+    })
+    _pyarrow_equal(raw, t)
+    _self_equal(raw, t)
+
+
+def test_dictionary_encoding(rng):
+    t = pa.table({
+        "s": pa.array([f"cat-{i % 13}" for i in range(30000)]),
+        "i": pa.array(rng.integers(0, 29, 30000)),
+    })
+    raw = _write(t)
+    pf = ParquetFile(raw)
+    m = pf.metadata.row_groups[0].columns[0].meta_data
+    assert int(Encoding.RLE_DICTIONARY) in m.encodings
+    assert m.dictionary_page_offset is not None
+    _pyarrow_equal(raw, t)
+    _self_equal(raw, t)
+
+
+def test_dictionary_fallback_high_cardinality(rng):
+    t = pa.table({"s": pa.array([f"unique-value-{i}" for i in range(10000)])})
+    raw = _write(t)
+    pf = ParquetFile(raw)
+    m = pf.metadata.row_groups[0].columns[0].meta_data
+    assert int(Encoding.RLE_DICTIONARY) not in m.encodings  # fell back to plain
+    _pyarrow_equal(raw, t)
+
+
+def test_lists(rng):
+    t = pa.table({
+        "lst": pa.array([[1, 2, 3] if i % 2 else None for i in range(2000)],
+                        type=pa.list_(pa.int64())),
+        "empties": pa.array([[] if i % 5 == 0 else [None, i] for i in range(2000)],
+                            type=pa.list_(pa.int32())),
+        "strs": pa.array([[f"x{i}", None] if i % 3 else [] for i in range(2000)],
+                         type=pa.list_(pa.string())),
+    })
+    raw = _write(t)
+    _pyarrow_equal(raw, t)
+    _self_equal(raw, t)
+
+
+def test_multiple_pages_and_row_groups(rng):
+    t = pa.table({"x": pa.array(np.arange(100000, dtype=np.int64))})
+    buf = io.BytesIO()
+    schema = schema_from_arrow(t.schema)
+    opts = WriterOptions(data_page_size=16 * 1024, dictionary=False)
+    w = ParquetWriter(buf, schema, opts)
+    for start in range(0, 100000, 30000):
+        end = min(start + 30000, 100000)
+        w.write_row_group(
+            {"x": ColumnData(values=np.arange(start, end, dtype=np.int64))},
+            end - start)
+    w.close()
+    raw = buf.getvalue()
+    pf = ParquetFile(raw)
+    assert len(pf.row_groups) == 4
+    assert pf.num_rows == 100000
+    _pyarrow_equal(raw, t)
+    _self_equal(raw, t)
+
+
+def test_statistics_and_column_index(rng):
+    t = pa.table({"x": pa.array(np.arange(50000, dtype=np.int64))})
+    raw = _write(t, data_page_size=32 * 1024, dictionary=False)
+    pf = ParquetFile(raw)
+    chunk = pf.row_group(0).column(0)
+    st = chunk.statistics()
+    assert st.min_value == 0 and st.max_value == 49999 and st.null_count == 0
+    ci = chunk.column_index()
+    oi = chunk.offset_index()
+    assert ci is not None and oi is not None
+    assert len(ci.min_values) == len(oi.page_locations) > 1
+    # page mins must ascend for a sorted column
+    from parquet_tpu.format.enums import BoundaryOrder
+    assert ci.boundary_order == int(BoundaryOrder.ASCENDING)
+    # pyarrow agrees with our statistics
+    pam = pq.ParquetFile(io.BytesIO(raw)).metadata
+    pst = pam.row_group(0).column(0).statistics
+    assert pst.min == 0 and pst.max == 49999
+
+
+def test_crc_written_and_verified(rng):
+    t = pa.table({"x": pa.array(np.arange(1000, dtype=np.int64))})
+    raw = _write(t, write_crc=True, dictionary=False)
+    tab = ParquetFile(raw, ReadOptions(verify_crc=True)).read()
+    np.testing.assert_array_equal(np.asarray(tab["x"].values), np.arange(1000))
+
+
+def test_key_value_metadata_and_created_by(rng):
+    t = pa.table({"x": pa.array([1, 2])})
+    raw = _write(t, key_value_metadata={"origin": "unit-test"})
+    pf = ParquetFile(raw)
+    assert pf.key_value_metadata()["origin"] == "unit-test"
+    assert "parquet-tpu" in pf.created_by
+
+
+def test_sorting_columns_metadata(rng):
+    t = pa.table({"x": pa.array(np.sort(rng.integers(0, 100, 100)))})
+    raw = _write(t, sorting_columns=[("x", False, False)])
+    pf = ParquetFile(raw)
+    sc = pf.row_group(0).sorting_columns
+    assert sc and sc[0].column_idx == 0 and not sc[0].descending
+
+
+def test_bloom_filter_roundtrip(rng):
+    vals = rng.integers(0, 10**12, 5000)
+    t = pa.table({"x": pa.array(vals), "s": pa.array([f"k{i % 500}" for i in range(5000)])})
+    raw = _write(t, bloom_filters={"x": 10, "s": 10}, dictionary=["s"])
+    pf = ParquetFile(raw)
+    bf = pf.row_group(0).column(0).bloom_filter()
+    assert bf is not None
+    leaf = pf.schema.leaves[0]
+    # no false negatives
+    for v in vals[:200]:
+        assert bf.check(int(v), leaf)
+    # bounded false positives
+    probes = rng.integers(10**13, 10**14, 2000)
+    fp = sum(bf.check(int(v), leaf) for v in probes)
+    assert fp / len(probes) < 0.05
+    # string bloom
+    bfs = pf.row_group(0).column(1).bloom_filter()
+    sleaf = pf.schema.leaves[1]
+    assert bfs.check("k0", sleaf) and bfs.check("k499", sleaf)
+    misses = sum(bfs.check(f"nope-{i}", sleaf) for i in range(500))
+    assert misses / 500 < 0.05
+
+
+def test_logical_types_roundtrip(rng):
+    t = pa.table({
+        "date": pa.array(np.arange(500, dtype=np.int32), type=pa.date32()),
+        "ts": pa.array(rng.integers(0, 2**45, 500), type=pa.timestamp("us", tz="UTC")),
+        "u16": pa.array(rng.integers(0, 65535, 500, dtype=np.uint16)),
+        "dec": pa.array([__import__("decimal").Decimal(f"{i}.{i % 100:02d}")
+                         for i in range(500)], type=pa.decimal128(18, 2)),
+    })
+    raw = _write(t)
+    _pyarrow_equal(raw, t)
+
+
+def test_empty_table():
+    t = pa.table({"x": pa.array([], type=pa.int64())})
+    raw = _write(t)
+    got = pq.read_table(io.BytesIO(raw))
+    assert got.num_rows == 0
+
+
+def test_footer_last_atomicity(rng):
+    """Truncated write (no footer) must be invalid — SURVEY.md §5."""
+    t = pa.table({"x": pa.array(np.arange(100, dtype=np.int64))})
+    raw = _write(t)
+    with pytest.raises(Exception):
+        ParquetFile(raw[: len(raw) - 20])
