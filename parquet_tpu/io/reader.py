@@ -320,7 +320,9 @@ def _bit_width(maxval: int) -> int:
     return int(maxval).bit_length()
 
 
-def decode_chunk_host(reader: ColumnChunkReader) -> Column:
+def decode_chunk_host(reader: ColumnChunkReader, pages=None) -> Column:
+    """Decode a chunk (or, with ``pages``, a selected page subset — the
+    SeekToRow / pushdown path of io/search.py)."""
     leaf = reader.leaf
     meta = reader.meta
     codec = reader.codec
@@ -334,7 +336,7 @@ def decode_chunk_host(reader: ColumnChunkReader) -> Column:
     value_parts: List = []  # directly decoded pages (arrays or (vals, offs))
     part_order: List[Tuple[str, int]] = []  # ("idx"/"val", part index) per page
 
-    for page in reader.pages():
+    for page in (pages if pages is not None else reader.pages()):
         h = page.header
         pt = page.page_type
         if reader.file.options.verify_crc and h.crc is not None:

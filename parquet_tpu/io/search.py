@@ -1,0 +1,245 @@
+"""Predicate pushdown: Find/Search over page indexes, SeekToRow, pruning.
+
+Reference parity (SURVEY.md §3.3): ``parquet.Find`` binary-searches a
+ColumnIndex's page min/max for a value, ``OffsetIndex.Offset(page)`` maps to
+the first row, and ``Pages.SeekToRow`` skips to that page; chunk-level pruning
+uses ``Statistics`` and ``BloomFilter().Check`` before touching pages.
+
+TPU-first addition: :func:`plan_scan` produces a *batch* page plan for a
+predicate across row groups (the unit the device pipeline stages), instead of
+a cursor — pushdown selects H2D bytes, the chip scans what remains.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..format import metadata as md
+from ..format.enums import BoundaryOrder
+from ..schema.schema import Leaf
+from .reader import ColumnChunkReader, ParquetFile, RowGroupReader
+from .statistics import decode_stat_value
+
+
+def find(column_index: md.ColumnIndex, value, leaf: Leaf) -> int:
+    """First page ordinal whose [min,max] may contain ``value`` (== number of
+    pages when none can).  Binary search when boundary_order allows, else
+    linear scan — same contract as the reference's ``parquet.Find``."""
+    n = len(column_index.null_pages or [])
+    mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
+    maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
+    order = BoundaryOrder(column_index.boundary_order or 0)
+    nulls = column_index.null_pages or [False] * n
+
+    def may_contain(i: int) -> bool:
+        if nulls[i]:
+            return False
+        return mins[i] <= value <= maxs[i]
+
+    if order == BoundaryOrder.ASCENDING:
+        # first page with max >= value
+        lo, hi = 0, n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if nulls[mid] or maxs[mid] < value:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo if lo < n and may_contain(lo) else n
+    if order == BoundaryOrder.DESCENDING:
+        lo, hi = 0, n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if nulls[mid] or mins[mid] > value:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo if lo < n and may_contain(lo) else n
+    for i in range(n):
+        if may_contain(i):
+            return i
+    return n
+
+
+def pages_overlapping(column_index: md.ColumnIndex, leaf: Leaf,
+                      lo=None, hi=None) -> List[int]:
+    """All page ordinals whose [min,max] intersects [lo, hi] (None = open)."""
+    n = len(column_index.null_pages or [])
+    mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
+    maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
+    nulls = column_index.null_pages or [False] * n
+    out = []
+    for i in range(n):
+        if nulls[i]:
+            continue
+        if mins[i] is None or maxs[i] is None:
+            out.append(i)
+            continue
+        if lo is not None and maxs[i] < lo:
+            continue
+        if hi is not None and mins[i] > hi:
+            continue
+        out.append(i)
+    return out
+
+
+def prune_row_group(rg: RowGroupReader, path, lo=None, hi=None,
+                    use_bloom: bool = False, equals=None) -> bool:
+    """True if the row group may contain rows matching the range/equality.
+
+    Chunk-level pruning: Statistics first, optionally the bloom filter for
+    equality probes (SURVEY.md §3.3 last line)."""
+    chunk = rg.column(path)
+    st = chunk.statistics()
+    if st is not None and st.min_value is not None and st.max_value is not None:
+        if lo is not None and st.max_value < lo:
+            return False
+        if hi is not None and st.min_value > hi:
+            return False
+        if equals is not None and not (st.min_value <= equals <= st.max_value):
+            return False
+    if use_bloom and equals is not None:
+        bf = chunk.bloom_filter()
+        if bf is not None and not bf.check(equals, chunk.leaf):
+            return False
+    return True
+
+
+@dataclass
+class PagePlan:
+    """Selected pages of one chunk: which page ordinals to decode and the row
+    span they cover."""
+
+    rg_index: int
+    page_ordinals: List[int]
+    first_row: int  # global first row of first selected page (within rg)
+    row_count: int
+
+
+def plan_scan(pf: ParquetFile, path, lo=None, hi=None,
+              use_bloom: bool = False) -> List[PagePlan]:
+    """Batch pushdown plan: for each surviving row group, the page ordinals
+    whose zone maps intersect the predicate."""
+    leaf = pf.schema.leaf(path) if not hasattr(path, "column_index") else path
+    plans: List[PagePlan] = []
+    equals = lo if lo is not None and lo == hi else None
+    for rg in pf.row_groups:
+        if not prune_row_group(rg, leaf.column_index, lo, hi, use_bloom, equals):
+            continue
+        chunk = rg.column(leaf.column_index)
+        ci = chunk.column_index()
+        oi = chunk.offset_index()
+        if ci is None or oi is None:
+            plans.append(PagePlan(rg.index, list(range(_npages(oi))) if oi else [],
+                                  0, rg.num_rows))
+            continue
+        ords = pages_overlapping(ci, leaf, lo, hi)
+        if not ords:
+            continue
+        locs = oi.page_locations
+        first_row = locs[ords[0]].first_row_index
+        last = ords[-1]
+        end_row = (locs[last + 1].first_row_index if last + 1 < len(locs)
+                   else rg.num_rows)
+        plans.append(PagePlan(rg.index, ords, first_row, end_row - first_row))
+    return plans
+
+
+def _npages(oi) -> int:
+    return len(oi.page_locations) if oi and oi.page_locations else 0
+
+
+# ---------------------------------------------------------------------------
+# SeekToRow: decode a row range using the offset index
+# ---------------------------------------------------------------------------
+
+
+def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
+    """Yield the dictionary page (if any) + the data pages covering
+    [row_start, row_end) — reference's ``Pages.SeekToRow`` + read loop."""
+    oi = chunk.offset_index()
+    all_pages = list(chunk.pages())
+    data_pages = [p for p in all_pages if p.page_type.name.startswith("DATA")]
+    dict_pages = [p for p in all_pages if p.page_type.name == "DICTIONARY_PAGE"]
+    if oi is None or not oi.page_locations:
+        # no index: fall back to counting rows per page (flat columns: values)
+        yield from all_pages
+        return
+    locs = oi.page_locations
+    firsts = [pl.first_row_index for pl in locs]
+    i0 = max(bisect_right(firsts, row_start) - 1, 0)
+    i1 = bisect_left(firsts, row_end, lo=i0)
+    for p in dict_pages:
+        yield p
+    for i in range(i0, min(i1, len(data_pages))):
+        yield data_pages[i]
+
+
+def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
+                   device: bool = False):
+    """Decode only the pages covering [row_start, row_start+row_count) of one
+    column, trimming to the exact rows.  Returns a host numpy array (flat
+    columns) — the SeekToRow-then-read flow of SURVEY.md §3.3."""
+    from .reader import decode_chunk_host
+
+    leaf = pf.schema.leaf(path)
+    out_parts = []
+    remaining_start = row_start
+    remaining = row_count
+    for rg in pf.row_groups:
+        nrows = rg.num_rows
+        if remaining <= 0:
+            break
+        if remaining_start >= nrows:
+            remaining_start -= nrows
+            continue
+        take = min(nrows - remaining_start, remaining)
+        chunk = rg.column(leaf.column_index)
+        oi = chunk.offset_index()
+        pages = list(seek_pages(chunk, remaining_start, remaining_start + take))
+        first_row_of_pages = 0
+        if oi is not None and oi.page_locations:
+            firsts = [pl.first_row_index for pl in oi.page_locations]
+            i0 = max(bisect_right(firsts, remaining_start) - 1, 0)
+            first_row_of_pages = firsts[i0]
+        col = decode_chunk_host(chunk, pages=iter(pages))
+        vals = _trim_flat(col, remaining_start - first_row_of_pages, take)
+        out_parts.append(vals)
+        remaining_start = 0
+        remaining -= take
+    if not out_parts:
+        return np.empty(0)
+    return np.concatenate(out_parts) if len(out_parts) > 1 else out_parts[0]
+
+
+def _trim_flat(col, offset: int, count: int):
+    """Slice ``count`` rows starting at ``offset`` out of a decoded flat column."""
+    if col.leaf.max_repetition_level:
+        raise NotImplementedError("row-range reads on nested columns")
+    validity = None if col.validity is None else np.asarray(col.validity)
+    values = np.asarray(col.values)
+    if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
+        from ..format.enums import Type
+
+        dt = np.float64 if col.leaf.physical_type == Type.DOUBLE else np.int64
+        values = np.ascontiguousarray(values).view(dt).reshape(-1)
+    if validity is None:
+        if col.offsets is not None:
+            offs = np.asarray(col.offsets, np.int64)
+            return _substrings(values, offs, offset, count)
+        return values[offset : offset + count]
+    # dense values: map slots → value ordinals
+    vstart = int(np.count_nonzero(validity[:offset]))
+    vend = vstart + int(np.count_nonzero(validity[offset : offset + count]))
+    if col.offsets is not None:
+        offs = np.asarray(col.offsets, np.int64)
+        return _substrings(values, offs, vstart, vend - vstart)
+    return values[vstart:vend]
+
+
+def _substrings(values, offs, start, count):
+    return [values[offs[i] : offs[i + 1]].tobytes() for i in range(start, start + count)]

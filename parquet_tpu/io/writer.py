@@ -682,14 +682,21 @@ def write_table(table, sink, options: Optional[WriterOptions] = None,
         schema = schema_from_arrow(table.schema)
     options = options or WriterOptions()
     w = ParquetWriter(sink, schema, options)
-    cols: Dict[str, ColumnData] = {}
-    for leaf in schema.leaves:
-        name = leaf.path[0]
-        arr = table[name].combine_chunks() if hasattr(table[name], "combine_chunks") else table[name]
-        if isinstance(arr, pa.ChunkedArray):
-            arr = arr.combine_chunks()
-        cols[leaf.dotted_path] = _column_from_arrow(arr, leaf)
-    w.write_row_group(cols, table.num_rows)
+    n = table.num_rows
+    rg_size = min(options.row_group_size, n) if n else n
+    for start in range(0, max(n, 1), max(rg_size, 1)):
+        end = min(start + rg_size, n) if rg_size else n
+        part = table.slice(start, end - start) if (start or end < n) else table
+        cols: Dict[str, ColumnData] = {}
+        for leaf in schema.leaves:
+            name = leaf.path[0]
+            arr = part[name]
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            cols[leaf.dotted_path] = _column_from_arrow(arr, leaf)
+        w.write_row_group(cols, part.num_rows)
+        if n == 0:
+            break
     w.close()
     return w
 

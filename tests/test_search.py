@@ -1,0 +1,123 @@
+"""Pushdown tests: Find over column indexes, page planning, row-group
+pruning (stats + bloom), SeekToRow row-range reads."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.io.reader import ParquetFile
+from parquet_tpu.io.search import (find, pages_overlapping, plan_scan,
+                                   prune_row_group, read_row_range)
+from parquet_tpu.io.writer import WriterOptions, write_table
+
+
+def _sorted_file(n=100000, page=16 * 1024, rg=None, bloom=False) -> bytes:
+    t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64))})
+    buf = io.BytesIO()
+    opts = WriterOptions(data_page_size=page, dictionary=False,
+                         row_group_size=rg or n,
+                         bloom_filters={"x": 10} if bloom else {})
+    write_table(t, buf, opts)
+    return buf.getvalue()
+
+
+def test_find_ascending():
+    raw = _sorted_file()
+    pf = ParquetFile(raw)
+    chunk = pf.row_group(0).column(0)
+    ci = chunk.column_index()
+    oi = chunk.offset_index()
+    leaf = pf.schema.leaves[0]
+    n_pages = len(oi.page_locations)
+    assert n_pages > 10
+    # every probed value must land on the page whose range contains it
+    for v in [0, 1, 5000, 49999, 99999]:
+        p = find(ci, v, leaf)
+        assert p < n_pages
+        lo = oi.page_locations[p].first_row_index
+        hi = (oi.page_locations[p + 1].first_row_index
+              if p + 1 < n_pages else 100000)
+        assert lo <= v < hi  # values == row index for arange
+    assert find(ci, 100001, leaf) == n_pages  # beyond max → no page
+    assert find(ci, -5, leaf) == 0 or find(ci, -5, leaf) == n_pages
+
+
+def test_pages_overlapping_range():
+    raw = _sorted_file()
+    pf = ParquetFile(raw)
+    chunk = pf.row_group(0).column(0)
+    ci = chunk.column_index()
+    oi = chunk.offset_index()
+    leaf = pf.schema.leaves[0]
+    sel = pages_overlapping(ci, leaf, lo=30000, hi=30100)
+    assert 1 <= len(sel) <= 2
+    total = len(oi.page_locations)
+    assert len(pages_overlapping(ci, leaf)) == total
+
+
+def test_plan_scan_prunes_row_groups():
+    raw = _sorted_file(rg=20000)
+    pf = ParquetFile(raw)
+    assert len(pf.row_groups) == 5
+    plans = plan_scan(pf, "x", lo=45000, hi=47000)
+    assert len(plans) == 1
+    assert plans[0].rg_index == 2
+    rows_spanned = plans[0].row_count
+    assert rows_spanned < 20000  # page-level pruning inside the group
+
+
+def test_prune_row_group_with_bloom():
+    t = pa.table({"x": pa.array(np.arange(0, 100000, 2, dtype=np.int64))})  # evens
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(dictionary=False, bloom_filters={"x": 10}))
+    pf = ParquetFile(buf.getvalue())
+    rg = pf.row_group(0)
+    assert prune_row_group(rg, "x", lo=10, hi=10, use_bloom=True, equals=10)
+    # odd value in range but not present → bloom prunes (w.h.p.)
+    pruned = sum(
+        not prune_row_group(rg, "x", lo=v, hi=v, use_bloom=True, equals=v)
+        for v in range(1, 200, 2)
+    )
+    assert pruned > 90  # nearly all odd probes pruned
+
+
+def test_read_row_range():
+    raw = _sorted_file(rg=30000)
+    pf = ParquetFile(raw)
+    out = read_row_range(pf, "x", 12345, 678)
+    np.testing.assert_array_equal(out, np.arange(12345, 12345 + 678))
+    # crossing a row-group boundary
+    out = read_row_range(pf, "x", 29990, 30)
+    np.testing.assert_array_equal(out, np.arange(29990, 30020))
+    # strings
+    t = pa.table({"s": pa.array([f"v{i:06d}" for i in range(50000)])})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(data_page_size=8 * 1024, dictionary=False))
+    pf2 = ParquetFile(buf.getvalue())
+    got = read_row_range(pf2, "s", 40000, 5)
+    assert got == [f"v{i:06d}".encode() for i in range(40000, 40005)]
+
+
+def test_read_row_range_with_nulls():
+    vals = [None if i % 7 == 0 else i for i in range(20000)]
+    t = pa.table({"x": pa.array(vals, type=pa.int64())})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(data_page_size=8 * 1024, dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    got = read_row_range(pf, "x", 9995, 10)
+    expect = [v for v in vals[9995:10005] if v is not None]
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_pushdown_against_pyarrow_file():
+    """Our pushdown works on files written by pyarrow too."""
+    t = pa.table({"x": pa.array(np.arange(50000, dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, write_page_index=True, row_group_size=10000,
+                   data_page_size=8 * 1024, use_dictionary=False)
+    pf = ParquetFile(buf.getvalue())
+    plans = plan_scan(pf, "x", lo=23000, hi=23500)
+    assert len(plans) == 1 and plans[0].rg_index == 2
