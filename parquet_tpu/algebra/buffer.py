@@ -1,0 +1,192 @@
+"""In-memory row-group buffer with sorting.
+
+Reference parity: ``buffer.go — Buffer/GenericBuffer[T] (sort.Interface)``
+(SURVEY.md §3.5): rows accumulate into per-leaf column buffers; sorting
+permutes all columns row-wise by the sorting columns.  TPU-first: the sort is
+a vectorized argsort over key columns (np.lexsort on host, jnp.argsort on
+device for numeric keys) followed by one gather per column — no row-at-a-time
+``Less``/``Swap``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..format.enums import Type
+from ..io.writer import ColumnData, ParquetWriter, WriterOptions
+from ..schema.schema import Schema
+
+
+@dataclass
+class SortingColumn:
+    """Reference parity: sorting.go — SortingColumn/Ascending/Descending/
+    NullsFirst options."""
+
+    path: str
+    descending: bool = False
+    nulls_first: bool = False
+
+
+class TableBuffer:
+    """Columnar row buffer bound to a schema; sortable; writable.
+
+    Only flat leaf columns participate in sort keys (same constraint as the
+    reference's sorting columns)."""
+
+    def __init__(self, schema: Schema,
+                 sorting: Optional[Sequence[SortingColumn]] = None):
+        self.schema = schema
+        self.sorting = list(sorting or [])
+        self.columns: Dict[str, ColumnData] = {}
+        self.num_rows = 0
+
+    # ------------------------------------------------------------------
+    def write(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
+        from ..io.writer import _extend_cd  # reuse concat logic
+
+        if not self.columns:
+            self.columns = columns
+            self.num_rows = num_rows
+            return
+        for k, v in columns.items():
+            _extend_cd(self.columns[k], v)
+        self.num_rows += num_rows
+
+    def write_arrow(self, table) -> None:
+        from ..io.writer import _column_from_arrow
+
+        cols = {}
+        for leaf in self.schema.leaves:
+            arr = table[leaf.path[0]]
+            if hasattr(arr, "combine_chunks"):
+                arr = arr.combine_chunks()
+            cols[leaf.dotted_path] = _column_from_arrow(arr, leaf)
+        self.write(cols, table.num_rows)
+
+    # ------------------------------------------------------------------
+    def sort_indices(self) -> np.ndarray:
+        """Row permutation that orders the buffer by the sorting columns."""
+        if not self.sorting:
+            return np.arange(self.num_rows)
+        keys = []  # np.lexsort: LAST key is primary → reversed
+        for sc in reversed(self.sorting):
+            keys.append(self._sort_key(sc))
+        return np.lexsort(keys) if len(keys) > 1 else np.argsort(keys[0], kind="stable")
+
+    def _sort_key(self, sc: SortingColumn) -> np.ndarray:
+        leaf = self.schema.leaf(sc.path)
+        cd = self.columns[leaf.dotted_path]
+        n = self.num_rows
+        if leaf.max_repetition_level:
+            raise ValueError("cannot sort by a repeated column")
+        if leaf.physical_type == Type.BYTE_ARRAY:
+            vals = np.asarray(cd.values)
+            offs = np.asarray(cd.offsets, np.int64)
+            dense = [vals[offs[i]:offs[i + 1]].tobytes() for i in range(len(offs) - 1)]
+            key = np.empty(n, dtype=object)
+            if cd.validity is None:
+                key[:] = dense
+            else:
+                key[cd.validity] = dense
+                key[~cd.validity] = None
+            # object keys: rank them (argsort of object arrays with None fails)
+            present = key != None  # noqa: E711
+            order = np.argsort(key[present], kind="stable")
+            ranks = np.empty(n, dtype=np.int64)
+            pr = np.empty(int(present.sum()), dtype=np.int64)
+            pr[order] = np.arange(len(order))
+            ranks[present] = pr + 1
+            ranks[~present] = 0 if sc.nulls_first else len(order) + 1
+            return -ranks if sc.descending else ranks
+        vals = np.asarray(cd.values)
+        if cd.validity is None:
+            if sc.descending:
+                return -vals.astype(np.int64) if np.issubdtype(vals.dtype, np.integer) else -vals
+            return vals
+        # scatter dense to slots; nulls to ±inf rank
+        slot = np.zeros(n, dtype=np.float64)
+        slot[cd.validity] = vals.astype(np.float64)
+        if sc.descending:
+            slot = -slot
+        null_key = -np.inf if sc.nulls_first else np.inf
+        slot[~cd.validity] = null_key
+        return slot
+
+    def sort(self) -> None:
+        """Permute every column by the sort order (one gather per column)."""
+        perm = self.sort_indices()
+        for leaf in self.schema.leaves:
+            cd = self.columns[leaf.dotted_path]
+            self.columns[leaf.dotted_path] = permute_column(cd, perm, leaf)
+
+    # ------------------------------------------------------------------
+    def flush_to(self, writer: ParquetWriter) -> None:
+        if self.sorting:
+            self.sort()
+        writer.write_row_group(self.columns, self.num_rows)
+        self.columns = {}
+        self.num_rows = 0
+
+
+def permute_column(cd: ColumnData, perm: np.ndarray, leaf) -> ColumnData:
+    """Row-permute one leaf column (flat or single-level list)."""
+    if cd.list_offsets is not None:
+        lo = np.asarray(cd.list_offsets, np.int64)
+        lens = lo[1:] - lo[:-1]
+        new_lens = lens[perm]
+        new_lo = np.zeros(len(perm) + 1, np.int64)
+        np.cumsum(new_lens, out=new_lo[1:])
+        elem_perm = _gather_ranges(lo[:-1][perm], new_lens)
+        inner = ColumnData(values=cd.values, offsets=cd.offsets,
+                           validity=cd.validity)
+        # element-level structures permute by elem_perm; validity is per slot
+        # (slot == element for single-level lists of the supported writer)
+        pv = _permute_flat(inner, elem_perm, leaf)
+        pv.list_offsets = new_lo
+        pv.list_validity = None if cd.list_validity is None else cd.list_validity[perm]
+        return pv
+    return _permute_flat(cd, perm, leaf)
+
+
+def _permute_flat(cd: ColumnData, perm: np.ndarray, leaf) -> ColumnData:
+    validity = cd.validity
+    vals = np.asarray(cd.values)
+    if validity is None:
+        if cd.offsets is not None:
+            offs = np.asarray(cd.offsets, np.int64)
+            lens = offs[1:] - offs[:-1]
+            new_lens = lens[perm]
+            new_offs = np.zeros(len(perm) + 1, np.int64)
+            np.cumsum(new_lens, out=new_offs[1:])
+            idx = _gather_ranges(offs[:-1][perm], new_lens)
+            return ColumnData(values=vals[idx] if len(idx) else vals[:0],
+                              offsets=new_offs)
+        return ColumnData(values=vals[perm])
+    # dense values: build slot-aligned then re-densify in new order
+    new_valid = validity[perm]
+    slot_of_value = np.cumsum(validity) - 1
+    if cd.offsets is not None:
+        offs = np.asarray(cd.offsets, np.int64)
+        lens = offs[1:] - offs[:-1]
+        sel = slot_of_value[perm[new_valid]]
+        new_lens = lens[sel]
+        new_offs = np.zeros(int(new_valid.sum()) + 1, np.int64)
+        np.cumsum(new_lens, out=new_offs[1:])
+        idx = _gather_ranges(offs[:-1][sel], new_lens)
+        return ColumnData(values=vals[idx] if len(idx) else vals[:0],
+                          offsets=new_offs, validity=new_valid)
+    sel = slot_of_value[perm[new_valid]]
+    return ColumnData(values=vals[sel], validity=new_valid)
+
+
+def _gather_ranges(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    seg_starts = np.zeros(len(lens), np.int64)
+    np.cumsum(lens[:-1], out=seg_starts[1:])
+    return np.repeat(starts, lens) + (np.arange(total, dtype=np.int64)
+                                      - np.repeat(seg_starts, lens))

@@ -1,0 +1,113 @@
+"""Schema conversion (evolution).
+
+Reference parity: ``convert.go — Convert/ConvertRowGroup`` (SURVEY.md §2.1):
+column reordering, additions (nulls), drops, and numeric type widening
+between schemas.  Operates columnar: each target leaf either maps to a source
+leaf (by dotted path) and is widened, or is filled with nulls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..format.enums import Type
+from ..io.column import Column
+from ..io.reader import RowGroupReader
+from ..io.writer import ColumnData
+from ..schema.schema import Leaf, Schema
+
+# physical widenings the reference supports (smaller int → larger, float → double)
+_WIDEN_OK = {
+    (Type.INT32, Type.INT64),
+    (Type.FLOAT, Type.DOUBLE),
+    (Type.INT32, Type.DOUBLE),
+    (Type.INT64, Type.DOUBLE),
+}
+
+
+def can_convert(src: Leaf, dst: Leaf) -> bool:
+    if src.physical_type == dst.physical_type:
+        return True
+    return (src.physical_type, dst.physical_type) in _WIDEN_OK
+
+
+def convert_values(values: np.ndarray, src: Leaf, dst: Leaf) -> np.ndarray:
+    if src.physical_type == dst.physical_type:
+        return values
+    pair = (src.physical_type, dst.physical_type)
+    if pair not in _WIDEN_OK:
+        raise TypeError(
+            f"cannot convert {src.physical_type.name} → {dst.physical_type.name}")
+    target = {Type.INT64: np.int64, Type.DOUBLE: np.float64}[dst.physical_type]
+    # 64-bit pair representation → host view first
+    v = np.asarray(values)
+    if v.ndim == 2 and v.dtype == np.uint32 and v.shape[1] == 2:
+        host_dt = np.int64 if src.physical_type == Type.INT64 else np.float64
+        v = np.ascontiguousarray(v).view(host_dt).reshape(-1)
+    return v.astype(target)
+
+
+def convert_column_data(rg: RowGroupReader, dst_leaf: Leaf,
+                        src_schema: Schema) -> ColumnData:
+    """Decode one chunk of a source row group as the target leaf's type; a
+    missing source column becomes all nulls (requires dst optional)."""
+    try:
+        src_leaf = src_schema.leaf(dst_leaf.path)
+    except KeyError:
+        src_leaf = None
+    if src_leaf is None:
+        if dst_leaf.max_definition_level == 0:
+            raise TypeError(
+                f"source lacks required column {dst_leaf.dotted_path!r}")
+        n = rg.num_rows
+        empty = np.empty(0, dtype=dst_leaf.np_dtype() or np.uint8)
+        return ColumnData(values=empty,
+                          offsets=np.zeros(1, np.int64) if dst_leaf.physical_type == Type.BYTE_ARRAY else None,
+                          validity=np.zeros(n, dtype=bool))
+    col = rg.column(src_leaf.column_index).read()
+    return column_to_data(col, src_leaf, dst_leaf)
+
+
+def column_to_data(col: Column, src: Leaf, dst: Optional[Leaf] = None) -> ColumnData:
+    """Decoded Column → writable ColumnData (the read↔write bridge)."""
+    dst = dst or src
+    if col.is_dictionary_encoded():
+        col.materialize_host()
+    values = np.asarray(col.values)
+    offsets = None if col.offsets is None else np.asarray(col.offsets, np.int64)
+    validity = None if col.validity is None else np.asarray(col.validity)
+    if dst is not None and src.physical_type != dst.physical_type:
+        values = convert_values(values, src, dst)
+    elif values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
+        host_dt = np.float64 if src.physical_type == Type.DOUBLE else np.int64
+        values = np.ascontiguousarray(values).view(host_dt).reshape(-1)
+    list_offsets = list_validity = None
+    if col.list_offsets:
+        if len(col.list_offsets) > 1:
+            raise NotImplementedError("conversion of multi-level lists")
+        list_offsets = np.asarray(col.list_offsets[0], np.int64)
+        lv = col.list_validity[0]
+        list_validity = None if lv is None or bool(np.all(lv)) else np.asarray(lv)
+    return ColumnData(values=values, offsets=offsets, validity=validity,
+                      list_offsets=list_offsets, list_validity=list_validity)
+
+
+def convert_table(pf_or_rg, target: Schema):
+    """Reference parity: ``Convert(rowGroup, schema)`` — returns {path:
+    ColumnData} rows of the target schema for each source row group."""
+    from ..io.reader import ParquetFile
+
+    if isinstance(pf_or_rg, ParquetFile):
+        rgs = pf_or_rg.row_groups
+        src_schema = pf_or_rg.schema
+    else:
+        rgs = [pf_or_rg]
+        src_schema = pf_or_rg.file.schema
+    out = []
+    for rg in rgs:
+        cols = {leaf.dotted_path: convert_column_data(rg, leaf, src_schema)
+                for leaf in target.leaves}
+        out.append((cols, rg.num_rows))
+    return out

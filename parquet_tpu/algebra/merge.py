@@ -1,0 +1,61 @@
+"""Ordered merging of row groups.
+
+Reference parity: ``merge.go — MergeRowGroups/mergedRowGroup`` (SURVEY.md
+§3.4): a heap-based k-way ordered merge over RowGroup cursors.  TPU-first
+reformulation: k sorted runs are merged by *concatenate + stable argsort on
+the key columns* — one vectorized gather instead of a row-at-a-time heap.
+(O(n log n) vs O(n log k), but every op is a wide vector op that XLA/numpy
+executes orders of magnitude faster than a Python heap loop; this is the
+trade the whole framework makes.)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..io.reader import ParquetFile, RowGroupReader
+from ..io.writer import ColumnData, ParquetWriter, WriterOptions
+from ..schema.schema import Schema
+from .buffer import SortingColumn, TableBuffer, permute_column
+from .convert import convert_column_data
+
+
+def merge_row_groups(sources: Sequence[RowGroupReader],
+                     sorting: Sequence[SortingColumn],
+                     schema: Optional[Schema] = None) -> TableBuffer:
+    """Merge already-sorted row groups into one sorted buffer.
+
+    Schemas must be convertible (reference: merge validates via convert.go);
+    pass ``schema`` to convert all inputs to a target schema first."""
+    if not sources:
+        raise ValueError("no row groups to merge")
+    target = schema or sources[0].file.schema
+    buf = TableBuffer(target, sorting)
+    for rg in sources:
+        cols: Dict[str, ColumnData] = {}
+        for leaf in target.leaves:
+            src_schema = rg.file.schema
+            cols[leaf.dotted_path] = convert_column_data(rg, leaf, src_schema)
+        buf.write(cols, rg.num_rows)
+    # concat + stable argsort == k-way merge for pre-sorted inputs
+    buf.sort()
+    return buf
+
+
+def merge_files(paths_or_files, sorting: Sequence[SortingColumn], sink,
+                options: Optional[WriterOptions] = None) -> None:
+    """Compaction helper: merge whole files into one sorted output file."""
+    files = [p if isinstance(p, ParquetFile) else ParquetFile(p)
+             for p in paths_or_files]
+    rgs: List[RowGroupReader] = []
+    for f in files:
+        rgs.extend(f.row_groups)
+    schema = files[0].schema
+    merged = merge_row_groups(rgs, sorting, schema)
+    opts = options or WriterOptions(
+        sorting_columns=[(s.path, s.descending, s.nulls_first) for s in sorting])
+    w = ParquetWriter(sink, schema, opts)
+    merged.flush_to(w)
+    w.close()

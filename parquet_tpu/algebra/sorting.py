@@ -1,0 +1,86 @@
+"""SortingWriter: bounded-memory sorted writing with spill-and-merge.
+
+Reference parity: ``sorting.go — SortingWriter[T]`` (SURVEY.md §2.1 Buffer/
+sort row): rows buffer up to a limit, each full buffer is sorted and spilled
+as a row group (here: a temp parquet file — same "sorted runs on temp
+storage" design [SURVEY.md §5 checkpoint note]), and Close() merges the runs
+into the destination in sorted order.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+from ..io.writer import ColumnData, ParquetWriter, WriterOptions
+from ..schema.schema import Schema
+from .buffer import SortingColumn, TableBuffer
+from .merge import merge_files
+
+
+class SortingWriter:
+    def __init__(self, sink, schema: Schema, sorting: Sequence[SortingColumn],
+                 options: Optional[WriterOptions] = None,
+                 buffer_rows: int = 1 << 20):
+        self.sink = sink
+        self.schema = schema
+        self.sorting = list(sorting)
+        self.options = options or WriterOptions()
+        self.options.sorting_columns = [
+            (s.path, s.descending, s.nulls_first) for s in self.sorting]
+        self.buffer_rows = buffer_rows
+        self._buf = TableBuffer(schema, self.sorting)
+        self._spills: List[str] = []
+        self._tmpdir = tempfile.mkdtemp(prefix="parquet_tpu_sort_")
+        self._closed = False
+
+    def write(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
+        self._buf.write(columns, num_rows)
+        if self._buf.num_rows >= self.buffer_rows:
+            self._spill()
+
+    def write_arrow(self, table) -> None:
+        self._buf.write_arrow(table)
+        if self._buf.num_rows >= self.buffer_rows:
+            self._spill()
+
+    def _spill(self) -> None:
+        if self._buf.num_rows == 0:
+            return
+        path = os.path.join(self._tmpdir, f"run{len(self._spills):05d}.parquet")
+        w = ParquetWriter(path, self.schema,
+                          WriterOptions(compression="snappy",
+                                        write_page_index=False))
+        self._buf.flush_to(w)  # sorts, writes one row group
+        w.close()
+        self._spills.append(path)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        if not self._spills:
+            # everything fit in memory: sort + write directly
+            w = ParquetWriter(self.sink, self.schema, self.options)
+            if self._buf.num_rows:
+                self._buf.flush_to(w)
+            w.close()
+        else:
+            self._spill()
+            merge_files(self._spills, self.sorting, self.sink, self.options)
+        for p in self._spills:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        try:
+            os.rmdir(self._tmpdir)
+        except OSError:
+            pass
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
