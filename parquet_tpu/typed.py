@@ -1,0 +1,313 @@
+"""Typed front end: dataclasses ↔ Parquet (the GenericReader/Writer analog).
+
+Reference parity: ``reader.go — GenericReader[T]`` / ``writer.go —
+GenericWriter[T]`` + ``schema.go — SchemaOf`` (SURVEY.md §1 L6): the reference
+compiles Go struct types into column programs via reflection.  Here the same
+role is played by Python dataclasses + type hints: :func:`schema_of` derives a
+parquet schema from a dataclass, :class:`TypedWriter`/:func:`write_objects`
+shred instances into columns (vectorized, not per-field reflection at row
+scale), and :class:`TypedReader`/:func:`read_objects` assemble decoded columns
+back into instances.  ``read_pytree`` returns the columns as a pytree of
+device arrays — the jit-ready form (a "typed read" whose T is a pytree).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import types
+import typing
+from typing import Any, Dict, List, Optional, Sequence, Type as PyType
+
+import numpy as np
+
+from .format.enums import FieldRepetitionType as Rep, Type
+from .io.reader import ParquetFile
+from .io.writer import ColumnData, ParquetWriter, WriterOptions
+from .schema import schema as sch
+from .schema.schema import Schema
+from .schema.types import LogicalKind
+
+# Python type → (physical, logical kind, params)
+_SCALAR_MAP = {
+    bool: (Type.BOOLEAN, LogicalKind.NONE, {}),
+    int: (Type.INT64, LogicalKind.NONE, {}),
+    float: (Type.DOUBLE, LogicalKind.NONE, {}),
+    str: (Type.BYTE_ARRAY, LogicalKind.STRING, {}),
+    bytes: (Type.BYTE_ARRAY, LogicalKind.NONE, {}),
+    np.int8: (Type.INT32, LogicalKind.INT, {"bit_width": 8, "signed": True}),
+    np.int16: (Type.INT32, LogicalKind.INT, {"bit_width": 16, "signed": True}),
+    np.int32: (Type.INT32, LogicalKind.NONE, {}),
+    np.int64: (Type.INT64, LogicalKind.NONE, {}),
+    np.uint8: (Type.INT32, LogicalKind.INT, {"bit_width": 8, "signed": False}),
+    np.uint16: (Type.INT32, LogicalKind.INT, {"bit_width": 16, "signed": False}),
+    np.uint32: (Type.INT32, LogicalKind.INT, {"bit_width": 32, "signed": False}),
+    np.uint64: (Type.INT64, LogicalKind.INT, {"bit_width": 64, "signed": False}),
+    np.float32: (Type.FLOAT, LogicalKind.NONE, {}),
+    np.float64: (Type.DOUBLE, LogicalKind.NONE, {}),
+    datetime.date: (Type.INT32, LogicalKind.DATE, {}),
+    datetime.datetime: (Type.INT64, LogicalKind.TIMESTAMP_MICROS, {"utc": True}),
+}
+
+
+def _unwrap_optional(hint):
+    origin = typing.get_origin(hint)
+    if origin in (typing.Union, types.UnionType):
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(args) == 1 and type(None) in typing.get_args(hint):
+            return args[0], True
+    return hint, False
+
+
+def schema_of(cls: PyType) -> Schema:
+    """Reference parity: ``parquet.SchemaOf`` — dataclass → schema tree."""
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"{cls!r} is not a dataclass")
+    hints = typing.get_type_hints(cls)
+    children = []
+    for f in dataclasses.fields(cls):
+        children.append(_field_node(f.name, hints[f.name]))
+    return sch.message(cls.__name__, children)
+
+
+def _field_node(name: str, hint) -> sch.Node:
+    hint, is_opt = _unwrap_optional(hint)
+    rep = Rep.OPTIONAL if is_opt else Rep.REQUIRED
+    origin = typing.get_origin(hint)
+    if origin in (list, typing.List):
+        (elem_hint,) = typing.get_args(hint)
+        elem_hint, elem_opt = _unwrap_optional(elem_hint)
+        if dataclasses.is_dataclass(elem_hint):
+            raise TypeError("lists of dataclasses not supported yet")
+        phys, kind, params = _SCALAR_MAP[elem_hint]
+        elem = sch.leaf("element", phys,
+                        Rep.OPTIONAL if elem_opt else Rep.REQUIRED, kind, **params)
+        return sch.list_of(name, elem, rep)
+    if dataclasses.is_dataclass(hint):
+        hints = typing.get_type_hints(hint)
+        kids = [_field_node(f.name, hints[f.name]) for f in dataclasses.fields(hint)]
+        return sch.group(name, kids, rep)
+    if hint in _SCALAR_MAP:
+        phys, kind, params = _SCALAR_MAP[hint]
+        return sch.leaf(name, phys, rep, kind, **params)
+    raise TypeError(f"unsupported field type {hint!r} for {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# shredding: instances → ColumnData (vectorized per field)
+# ---------------------------------------------------------------------------
+
+
+def _shred(objs: Sequence[Any], schema: Schema) -> Dict[str, ColumnData]:
+    cols: Dict[str, ColumnData] = {}
+    for leaf in schema.leaves:
+        cols[leaf.dotted_path] = _shred_leaf(objs, leaf)
+    return cols
+
+
+def _getter(path):
+    def get(o):
+        for p in path:
+            if o is None:
+                return None
+            if p in ("list", "element"):  # 3-level list wrapper names
+                continue
+            o = getattr(o, p)
+        return o
+
+    return get
+
+
+def _shred_leaf(objs: Sequence[Any], leaf) -> ColumnData:
+    get = _getter(leaf.path)
+    raw = [get(o) for o in objs]
+    if leaf.max_repetition_level:
+        lens = [0 if v is None else len(v) for v in raw]
+        lo = np.zeros(len(raw) + 1, np.int64)
+        np.cumsum(lens, out=lo[1:])
+        lv = np.array([v is not None for v in raw]) if any(v is None for v in raw) else None
+        flat = [e for v in raw if v is not None for e in v]
+        ev = (np.array([e is not None for e in flat])
+              if any(e is None for e in flat) else None)
+        dense = [e for e in flat if e is not None]
+        cd = _scalars_to_cd(dense, leaf)
+        cd.validity = ev
+        cd.list_offsets = lo
+        cd.list_validity = lv
+        return cd
+    validity = None
+    if any(v is None for v in raw):
+        validity = np.array([v is not None for v in raw])
+        dense = [v for v in raw if v is not None]
+    else:
+        dense = raw
+    cd = _scalars_to_cd(dense, leaf)
+    cd.validity = validity
+    return cd
+
+
+def _scalars_to_cd(dense: list, leaf) -> ColumnData:
+    t = leaf.physical_type
+    if t == Type.BYTE_ARRAY:
+        bs = [v.encode() if isinstance(v, str) else bytes(v) for v in dense]
+        offs = np.zeros(len(bs) + 1, np.int64)
+        np.cumsum([len(b) for b in bs], out=offs[1:])
+        return ColumnData(values=np.frombuffer(b"".join(bs), np.uint8), offsets=offs)
+    if leaf.logical_kind == LogicalKind.DATE:
+        epoch = datetime.date(1970, 1, 1)
+        vals = np.array([(v - epoch).days if isinstance(v, datetime.date) else int(v)
+                         for v in dense], dtype=np.int32)
+        return ColumnData(values=vals)
+    if leaf.logical_kind == LogicalKind.TIMESTAMP_MICROS:
+        def to_us(v):
+            if isinstance(v, datetime.datetime):
+                if v.tzinfo is None:
+                    v = v.replace(tzinfo=datetime.timezone.utc)
+                return int(v.timestamp() * 1_000_000)
+            return int(v)
+
+        return ColumnData(values=np.array([to_us(v) for v in dense], dtype=np.int64))
+    dtype = {Type.BOOLEAN: np.bool_, Type.INT32: np.int32, Type.INT64: np.int64,
+             Type.FLOAT: np.float32, Type.DOUBLE: np.float64}[t]
+    return ColumnData(values=np.asarray(dense, dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# assembly: decoded columns → instances
+# ---------------------------------------------------------------------------
+
+
+def _leaf_pylist(col, leaf) -> list:
+    """One leaf column → per-row python values."""
+    arr = col.to_arrow()
+    out = arr.to_pylist()
+    if leaf.logical_kind == LogicalKind.NONE and leaf.physical_type == Type.BYTE_ARRAY:
+        pass
+    return out
+
+
+def _assemble(cls, schema: Schema, tab) -> list:
+    hints = typing.get_type_hints(cls)
+    field_values: Dict[str, list] = {}
+    for f in dataclasses.fields(cls):
+        hint, _ = _unwrap_optional(hints[f.name])
+        if dataclasses.is_dataclass(hint):
+            sub = _assemble_nested(hint, schema, tab, (f.name,))
+            field_values[f.name] = sub
+            continue
+        leaf_paths = [p for p in tab.keys()
+                      if p == f.name or p.startswith(f.name + ".")]
+        leaf = schema.leaf(tuple(leaf_paths[0].split(".")))
+        field_values[f.name] = _leaf_pylist(tab[leaf_paths[0]], leaf)
+    n = len(next(iter(field_values.values()))) if field_values else 0
+    names = list(field_values)
+    return [cls(**{k: field_values[k][i] for k in names}) for i in range(n)]
+
+
+def _assemble_nested(cls, schema, tab, prefix) -> list:
+    hints = typing.get_type_hints(cls)
+    field_values: Dict[str, list] = {}
+    for f in dataclasses.fields(cls):
+        path = ".".join(prefix + (f.name,))
+        leaf_paths = [p for p in tab.keys() if p == path or p.startswith(path + ".")]
+        leaf = schema.leaf(tuple(leaf_paths[0].split(".")))
+        field_values[f.name] = _leaf_pylist(tab[leaf_paths[0]], leaf)
+    n = len(next(iter(field_values.values()))) if field_values else 0
+    names = list(field_values)
+    return [cls(**{k: field_values[k][i] for k in names}) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+class TypedWriter:
+    """Reference parity: ``GenericWriter[T]`` — buffered typed writes."""
+
+    def __init__(self, sink, cls: PyType, options: Optional[WriterOptions] = None):
+        self.cls = cls
+        self.schema = schema_of(cls)
+        self.writer = ParquetWriter(sink, self.schema, options)
+        self._pending: List[Any] = []
+
+    def write(self, objs: Sequence[Any]) -> None:
+        self._pending.extend(objs)
+        if len(self._pending) >= self.writer.options.row_group_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        cols = _shred(self._pending, self.schema)
+        self.writer.write_row_group(cols, len(self._pending))
+        self._pending = []
+
+    def close(self) -> None:
+        self.flush()
+        self.writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TypedReader:
+    """Reference parity: ``GenericReader[T]`` — batched typed reads."""
+
+    def __init__(self, source, cls: PyType):
+        self.cls = cls
+        self.file = source if isinstance(source, ParquetFile) else ParquetFile(source)
+        self._objs: Optional[list] = None
+        self._pos = 0
+
+    def read_all(self) -> list:
+        tab = self.file.read()
+        return _assemble(self.cls, self.file.schema, tab)
+
+    def read(self, n: int) -> list:
+        if self._objs is None:
+            self._objs = self.read_all()
+        out = self._objs[self._pos : self._pos + n]
+        self._pos += len(out)
+        return out
+
+
+def write_objects(objs: Sequence[Any], sink, cls: Optional[PyType] = None,
+                  options: Optional[WriterOptions] = None) -> None:
+    """Reference parity: ``parquet.WriteFile[T]``."""
+    if cls is None:
+        if not objs:
+            raise ValueError("cannot infer type from zero objects")
+        cls = type(objs[0])
+    w = TypedWriter(sink, cls, options)
+    w.write(list(objs))
+    w.close()
+
+
+def read_objects(source, cls: PyType) -> list:
+    """Reference parity: ``parquet.ReadFile[T]``."""
+    return TypedReader(source, cls).read_all()
+
+
+def read_pytree(source, columns=None, device: bool = True):
+    """Columns as a pytree of (device) arrays — the jit-ready typed read.
+
+    64-bit columns come back as (n,2) uint32 pairs on device (see
+    ops/device.py); ragged columns as dicts with values/offsets."""
+    pf = source if isinstance(source, ParquetFile) else ParquetFile(source)
+    tab = pf.read(columns=columns, device=device)
+    out = {}
+    for path, col in tab.columns.items():
+        if col.is_dictionary_encoded():
+            out[path] = {
+                "dictionary": col.dictionary,
+                "indices": col.dict_indices,
+            }
+        elif col.offsets is not None:
+            out[path] = {"values": col.values, "offsets": col.offsets}
+        else:
+            out[path] = col.values
+    return out

@@ -1,0 +1,130 @@
+"""Typed API tests: dataclass schema inference, write/read round-trips
+(the reference's canonical random-struct round-trip pattern, SURVEY.md §4.1)."""
+
+import dataclasses
+import datetime
+import io
+from typing import List, Optional
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.format.enums import Type
+from parquet_tpu.typed import (TypedReader, TypedWriter, read_objects,
+                               read_pytree, schema_of, write_objects)
+
+
+@dataclasses.dataclass
+class Order:
+    order_id: int
+    price: float
+    comment: str
+    flagged: bool
+    discount: Optional[float]
+    quantities: List[int]
+    tag: Optional[str]
+
+
+@dataclasses.dataclass
+class Address:
+    city: str
+    zip_code: int
+
+
+@dataclasses.dataclass
+class Customer:
+    name: str
+    address: Address
+    score: Optional[int]
+
+
+def _orders(n=500):
+    rng = np.random.default_rng(5)
+    return [
+        Order(
+            order_id=int(i),
+            price=float(rng.random() * 100),
+            comment=f"comment-{i % 37}",
+            flagged=bool(i % 3 == 0),
+            discount=None if i % 4 == 0 else float(i % 10) / 10,
+            quantities=[int(x) for x in rng.integers(0, 50, i % 5)],
+            tag=None if i % 2 else f"tag{i % 7}",
+        )
+        for i in range(n)
+    ]
+
+
+def test_schema_of():
+    s = schema_of(Order)
+    assert [l.dotted_path for l in s.leaves] == [
+        "order_id", "price", "comment", "flagged", "discount",
+        "quantities.list.element", "tag"]
+    assert s.leaf("order_id").physical_type == Type.INT64
+    assert s.leaf("order_id").max_definition_level == 0  # required
+    assert s.leaf("discount").max_definition_level == 1
+    q = s.leaf("quantities.list.element")
+    # required list of required ints: one def level (the repeated node)
+    assert q.max_repetition_level == 1 and q.max_definition_level == 1
+
+
+def test_roundtrip_objects():
+    objs = _orders()
+    buf = io.BytesIO()
+    write_objects(objs, buf)
+    got = read_objects(buf.getvalue(), Order)
+    assert got == objs
+
+
+def test_typed_reader_batches():
+    objs = _orders(100)
+    buf = io.BytesIO()
+    write_objects(objs, buf)
+    r = TypedReader(buf.getvalue(), Order)
+    first = r.read(30)
+    rest = r.read(1000)
+    assert first == objs[:30] and rest == objs[30:]
+
+
+def test_nested_dataclass():
+    custs = [Customer(name=f"c{i}", address=Address(city=f"city{i % 5}",
+                                                    zip_code=10000 + i),
+                      score=None if i % 3 == 0 else i)
+             for i in range(50)]
+    buf = io.BytesIO()
+    write_objects(custs, buf)
+    got = read_objects(buf.getvalue(), Customer)
+    assert got == custs
+    # pyarrow can read the nested file too
+    t = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert t.num_rows == 50
+    assert t["address"][0].as_py() == {"city": "city0", "zip_code": 10000}
+
+
+def test_dates_and_datetimes():
+    @dataclasses.dataclass
+    class Event:
+        day: datetime.date
+        at: datetime.datetime
+
+    evs = [Event(day=datetime.date(2020, 1, 1) + datetime.timedelta(days=i),
+                 at=datetime.datetime(2020, 1, 1, 12, 0, i % 60,
+                                      tzinfo=datetime.timezone.utc))
+           for i in range(40)]
+    buf = io.BytesIO()
+    write_objects(evs, buf)
+    got = read_objects(buf.getvalue(), Event)
+    assert [e.day for e in got] == [e.day for e in evs]
+    assert [e.at for e in got] == [e.at for e in evs]
+
+
+def test_read_pytree():
+    objs = _orders(200)
+    buf = io.BytesIO()
+    write_objects(objs, buf)
+    tree = read_pytree(buf.getvalue(), device=False)
+    assert "order_id" in tree and "price" in tree
+    vals = np.asarray(tree["order_id"])
+    if vals.ndim == 2:  # device pair representation
+        vals = np.ascontiguousarray(vals).view(np.int64).reshape(-1)
+    np.testing.assert_array_equal(vals, np.arange(200))
