@@ -193,7 +193,12 @@ def hash_values(leaf: Leaf, values, offsets=None) -> np.ndarray:
     if t in (Type.INT32, Type.FLOAT):
         return xxh64_u32(vals.view(np.uint32))
     if t == Type.BYTE_ARRAY:
+        from .. import native as _native
+
         offs = np.asarray(offsets, dtype=np.int64)
+        nat = _native.xxh64_batch(vals, offs)
+        if nat is not None:
+            return nat
         b = vals.tobytes()
         return np.array([xxh64_bytes(b[offs[i]: offs[i + 1]])
                          for i in range(len(offs) - 1)], dtype=np.uint64)

@@ -528,14 +528,30 @@ def _build_dictionary(leaf: Leaf, data: ColumnData, limit_bytes: int):
     physical = leaf.physical_type
     vals = np.asarray(data.values)
     if physical == Type.BYTE_ARRAY:
+        from .. import native as _native
+
         offs = np.asarray(data.offsets, dtype=np.int64)
         n = len(offs) - 1
         if n == 0:
             return None, None, None
-        # hash-free dedup via sort over bytes objects (C++ hash table later)
+        max_unique = n // 2 + 16
+        nat = _native.dict_build_ba(vals, offs, max_unique)
+        if nat == "overflow":
+            return None, None, None
+        if nat is not None:
+            # C++ hash-table dedup (hashprobe analog); first-seen order
+            indices, first_rows = nat
+            lens = (offs[1:] - offs[:-1])[first_rows]
+            doffs = np.zeros(len(first_rows) + 1, np.int64)
+            np.cumsum(lens, out=doffs[1:])
+            if int(doffs[-1]) + 4 * len(first_rows) > limit_bytes:
+                return None, None, None
+            idx = np.repeat(offs[:-1][first_rows], lens) + _iota_segments(lens)
+            dvals = vals[idx] if len(idx) else vals[:0]
+            return dvals, doffs, indices
         items = [vals[offs[i]:offs[i + 1]].tobytes() for i in range(n)]
         uniq = sorted(set(items))
-        if sum(len(u) + 4 for u in uniq) > limit_bytes or len(uniq) > n // 2 + 16:
+        if sum(len(u) + 4 for u in uniq) > limit_bytes or len(uniq) > max_unique:
             return None, None, None
         lookup = {u: i for i, u in enumerate(uniq)}
         indices = np.fromiter((lookup[it] for it in items), dtype=np.int64, count=n)
@@ -851,3 +867,12 @@ def _storage_type(t):
     if pa.types.is_time32(t):
         return pa.int32()
     return t
+
+
+def _iota_segments(lengths: np.ndarray) -> np.ndarray:
+    total = int(lengths.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    seg_starts = np.zeros(len(lengths), np.int64)
+    np.cumsum(lengths[:-1], out=seg_starts[1:])
+    return np.arange(total, dtype=np.int64) - np.repeat(seg_starts, lengths)

@@ -1,0 +1,175 @@
+"""Native host shim loader: compiles native.cpp → _native.so on first use.
+
+Reference parity: stands in for the reference's amd64 assembly + unsafe Go
+host kernels (SURVEY.md §2.3).  Pure C ABI over ctypes (no pybind11 in this
+image).  Falls back silently to the numpy oracles when a compiler is missing
+— the exact ``purego`` build-tag pattern of the reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native.cpp")
+_SO = os.path.join(_HERE, "_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+_u8p_w = np.ctypeslib.ndpointer(np.uint8, flags=("C_CONTIGUOUS", "WRITEABLE"))
+
+
+def _build() -> bool:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+             _SRC, "-o", _SO + ".tmp"],
+            check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PARQUET_TPU_NO_NATIVE"):
+            return None
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.pq_plain_byte_array.restype = ctypes.c_int64
+        lib.pq_plain_byte_array.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _i64p, ctypes.c_void_p]
+        lib.pq_scan_rle_runs.restype = ctypes.c_int64
+        lib.pq_scan_rle_runs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            _u8p_w, _i64p, _i64p, _i64p]
+        lib.pq_xxh64.restype = ctypes.c_uint64
+        lib.pq_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+        lib.pq_xxh64_batch.restype = None
+        lib.pq_xxh64_batch.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64, _u64p]
+        lib.pq_delta_byte_array_expand.restype = ctypes.c_int64
+        lib.pq_delta_byte_array_expand.argtypes = [
+            _i64p, ctypes.c_void_p, _i64p, ctypes.c_int64, _u8p_w, _i64p]
+        lib.pq_dict_build_ba.restype = ctypes.c_int64
+        lib.pq_dict_build_ba.argtypes = [
+            ctypes.c_void_p, _i64p, ctypes.c_int64, _i64p, ctypes.c_int64]
+        lib.pq_dict_first_occurrence.restype = None
+        lib.pq_dict_first_occurrence.argtypes = [_i64p, ctypes.c_int64,
+                                                 ctypes.c_int64, _i64p]
+        _lib = lib
+        return _lib
+
+
+# ---------------------------------------------------------------------------
+# numpy-friendly wrappers (None return → caller falls back to the oracle)
+# ---------------------------------------------------------------------------
+
+
+def plain_byte_array(buf: np.ndarray, n: int):
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf)
+    offsets = np.empty(n + 1, dtype=np.int64)
+    total = lib.pq_plain_byte_array(buf.ctypes.data, len(buf), n, offsets, None)
+    if total < 0:
+        raise ValueError("PLAIN BYTE_ARRAY truncated")
+    values = np.empty(max(total, 1), dtype=np.uint8)
+    lib.pq_plain_byte_array(buf.ctypes.data, len(buf), n, offsets,
+                            values.ctypes.data)
+    return values[:total], offsets.astype(np.int32)
+
+
+def scan_rle_runs(buf: np.ndarray, n: int, bit_width: int):
+    lib = get_lib()
+    if lib is None or n == 0:
+        return None
+    buf = np.ascontiguousarray(buf)
+    cap = n + 1
+    kinds = np.empty(cap, dtype=np.uint8)
+    counts = np.empty(cap, dtype=np.int64)
+    payloads = np.empty(cap, dtype=np.int64)
+    offsets = np.empty(cap, dtype=np.int64)
+    k = lib.pq_scan_rle_runs(buf.ctypes.data, len(buf), n, bit_width,
+                             kinds, counts, payloads, offsets)
+    if k < 0:
+        raise ValueError("malformed RLE hybrid stream")
+    return kinds[:k], counts[:k], payloads[:k], offsets[:k]
+
+
+def xxh64(data, seed: int = 0):
+    lib = get_lib()
+    if lib is None:
+        return None
+    b = np.frombuffer(data, np.uint8) if not isinstance(data, np.ndarray) else data
+    b = np.ascontiguousarray(b)
+    return int(lib.pq_xxh64(b.ctypes.data if len(b) else None, len(b), seed))
+
+
+def xxh64_batch(data: np.ndarray, offsets: np.ndarray):
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    out = np.empty(n, dtype=np.uint64)
+    lib.pq_xxh64_batch(data.ctypes.data if len(data) else None, offsets, n, out)
+    return out
+
+
+def delta_byte_array_expand(prefix_lens, suffix_data, suffix_offsets, out_offsets):
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(prefix_lens)
+    prefix_lens = np.ascontiguousarray(prefix_lens, dtype=np.int64)
+    suffix_data = np.ascontiguousarray(suffix_data)
+    suffix_offsets = np.ascontiguousarray(suffix_offsets, dtype=np.int64)
+    out_offsets = np.ascontiguousarray(out_offsets, dtype=np.int64)
+    total = int(out_offsets[-1]) if n else 0
+    out = np.empty(max(total, 1), dtype=np.uint8)
+    lib.pq_delta_byte_array_expand(prefix_lens,
+                                   suffix_data.ctypes.data if len(suffix_data) else None,
+                                   suffix_offsets, n, out, out_offsets)
+    return out[:total]
+
+
+def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int):
+    """Returns (indices, first_occurrence_rows), "overflow", or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    indices = np.empty(max(n, 1), dtype=np.int64)
+    k = lib.pq_dict_build_ba(data.ctypes.data if len(data) else None,
+                             offsets, n, indices, max_unique)
+    if k < 0:
+        return "overflow"
+    first = np.empty(max(k, 1), dtype=np.int64)
+    lib.pq_dict_first_occurrence(indices, n, k, first)
+    return indices[:n], first[:k]

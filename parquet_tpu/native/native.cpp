@@ -1,0 +1,221 @@
+// Host-side native kernels (C++), loaded via ctypes.
+//
+// Reference parity: the reference backs its sequential host loops with amd64
+// assembly + unsafe Go (SURVEY.md §2.3: encoding/plain BYTE_ARRAY scan,
+// encoding/rle run parsing, bloom/xxhash, hashprobe dictionary dedup,
+// encoding/delta byte-array prefix reconstruction).  These are exactly the
+// loops that cannot vectorize onto TPU lanes (data-dependent byte walks), so
+// they get native host code here; everything data-parallel lives in the
+// XLA/Pallas kernels instead.
+//
+// Build: parquet_tpu/native/build.py → _native.so (g++ -O3).  Pure C ABI —
+// no pybind11 (not in this image); numpy arrays cross as raw pointers.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// PLAIN BYTE_ARRAY: walk [4B LE length][bytes]... building offsets, and
+// optionally compacting the value bytes (prefixes stripped) into out_values.
+// Returns total value bytes, or -1 on truncation.
+// ---------------------------------------------------------------------------
+int64_t pq_plain_byte_array(const uint8_t* data, int64_t size, int64_t n,
+                            int64_t* offsets /* n+1 */,
+                            uint8_t* out_values /* may be null */) {
+  int64_t pos = 0;
+  int64_t total = 0;
+  offsets[0] = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (pos + 4 > size) return -1;
+    uint32_t len;
+    std::memcpy(&len, data + pos, 4);
+    pos += 4;
+    if (pos + (int64_t)len > size) return -1;
+    if (out_values) std::memcpy(out_values + total, data + pos, len);
+    pos += len;
+    total += len;
+    offsets[i + 1] = total;
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// RLE/bit-packed hybrid run scan (the host half of the two-pass split).
+// Outputs one row per run; returns run count, or -1 on malformed input.
+// Caller sizes outputs to n (a run covers >= 1 value).
+// ---------------------------------------------------------------------------
+int64_t pq_scan_rle_runs(const uint8_t* data, int64_t size, int64_t n,
+                         int32_t bit_width, uint8_t* kinds, int64_t* counts,
+                         int64_t* payloads, int64_t* byte_offsets) {
+  int64_t pos = 0;
+  int64_t remaining = n;
+  int64_t k = 0;
+  const int vbytes = (bit_width + 7) / 8;
+  while (remaining > 0) {
+    // uvarint header
+    uint64_t header = 0;
+    int shift = 0;
+    while (true) {
+      if (pos >= size) return -1;
+      uint8_t b = data[pos++];
+      header |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) return -1;
+    }
+    if (header & 1) {
+      int64_t ngroups = (int64_t)(header >> 1);
+      int64_t count = ngroups * 8;
+      kinds[k] = 1;
+      counts[k] = count < remaining ? count : remaining;
+      payloads[k] = 0;
+      byte_offsets[k] = pos;
+      pos += ngroups * bit_width;
+      if (pos > size) return -1;
+      remaining -= count;
+    } else {
+      int64_t count = (int64_t)(header >> 1);
+      if (pos + vbytes > size) return -1;
+      uint64_t value = 0;
+      for (int j = 0; j < vbytes; j++) value |= (uint64_t)data[pos + j] << (8 * j);
+      pos += vbytes;
+      kinds[k] = 0;
+      counts[k] = count < remaining ? count : remaining;
+      payloads[k] = (int64_t)value;
+      byte_offsets[k] = pos;
+      remaining -= count;
+    }
+    k++;
+  }
+  return k;
+}
+
+// ---------------------------------------------------------------------------
+// xxhash64 (bloom filter hashing; spec-mandated XXH64 seed 0)
+// ---------------------------------------------------------------------------
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+uint64_t pq_xxh64(const uint8_t* p, int64_t len, uint64_t seed) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    do {
+      uint64_t k;
+      std::memcpy(&k, p, 8); v1 = rotl64(v1 + k * P2, 31) * P1; p += 8;
+      std::memcpy(&k, p, 8); v2 = rotl64(v2 + k * P2, 31) * P1; p += 8;
+      std::memcpy(&k, p, 8); v3 = rotl64(v3 + k * P2, 31) * P1; p += 8;
+      std::memcpy(&k, p, 8); v4 = rotl64(v4 + k * P2, 31) * P1; p += 8;
+    } while (p + 32 <= end);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = (h ^ (rotl64(v1 * P2, 31) * P1)) * P1 + P4;
+    h = (h ^ (rotl64(v2 * P2, 31) * P1)) * P1 + P4;
+    h = (h ^ (rotl64(v3 * P2, 31) * P1)) * P1 + P4;
+    h = (h ^ (rotl64(v4 * P2, 31) * P1)) * P1 + P4;
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    uint64_t k;
+    std::memcpy(&k, p, 8);
+    h ^= rotl64(k * P2, 31) * P1;
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    uint32_t k;
+    std::memcpy(&k, p, 4);
+    h ^= (uint64_t)k * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (uint64_t)(*p++) * P5;
+    h = rotl64(h, 11) * P1;
+  }
+  h ^= h >> 33; h *= P2; h ^= h >> 29; h *= P3; h ^= h >> 32;
+  return h;
+}
+
+void pq_xxh64_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
+                    uint64_t* out) {
+  for (int64_t i = 0; i < n; i++)
+    out[i] = pq_xxh64(data + offsets[i], offsets[i + 1] - offsets[i], 0);
+}
+
+// ---------------------------------------------------------------------------
+// DELTA_BYTE_ARRAY reconstruction: values[i] = values[i-1][:prefix[i]] + suffix[i]
+// (the inherently sequential front-coding chain — SURVEY.md §2.2)
+// ---------------------------------------------------------------------------
+int64_t pq_delta_byte_array_expand(const int64_t* prefix_lens,
+                                   const uint8_t* suffix_data,
+                                   const int64_t* suffix_offsets, int64_t n,
+                                   uint8_t* out_values,
+                                   const int64_t* out_offsets) {
+  int64_t prev = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const int64_t o = out_offsets[i];
+    const int64_t pl = prefix_lens[i];
+    const int64_t sl = suffix_offsets[i + 1] - suffix_offsets[i];
+    if (pl > 0) std::memmove(out_values + o, out_values + prev, pl);
+    if (sl > 0) std::memcpy(out_values + o + pl, suffix_data + suffix_offsets[i], sl);
+    prev = o;
+  }
+  return n ? out_offsets[n] : 0;
+}
+
+// ---------------------------------------------------------------------------
+// Byte-array dictionary build (hashprobe analog): dedup via hash map.
+// Returns unique count; fills indices[n] and, when out_* non-null, the
+// unique strings compacted in first-seen order.
+// ---------------------------------------------------------------------------
+struct DictState {
+  std::unordered_map<std::string, int64_t> map;
+  std::vector<std::string> uniques;
+};
+
+int64_t pq_dict_build_ba(const uint8_t* data, const int64_t* offsets,
+                         int64_t n, int64_t* indices, int64_t max_unique) {
+  std::unordered_map<std::string, int64_t> map;
+  map.reserve((size_t)(n / 4 + 8));
+  int64_t next = 0;
+  for (int64_t i = 0; i < n; i++) {
+    std::string key((const char*)data + offsets[i],
+                    (size_t)(offsets[i + 1] - offsets[i]));
+    auto it = map.find(key);
+    if (it == map.end()) {
+      if (next >= max_unique) return -(i + 1);  // cardinality blew the limit
+      it = map.emplace(std::move(key), next++).first;
+    }
+    indices[i] = it->second;
+  }
+  return next;
+}
+
+// second pass: caller uses indices to materialize uniques (first occurrence)
+void pq_dict_first_occurrence(const int64_t* indices, int64_t n,
+                              int64_t n_unique, int64_t* first_idx) {
+  for (int64_t u = 0; u < n_unique; u++) first_idx[u] = -1;
+  for (int64_t i = 0; i < n; i++)
+    if (first_idx[indices[i]] < 0) first_idx[indices[i]] = i;
+}
+
+// ---------------------------------------------------------------------------
+// Hadoop-framed LZ4 / generic frame walker is python-side; CRC32 via zlib.
+// ---------------------------------------------------------------------------
+
+}  // extern "C"

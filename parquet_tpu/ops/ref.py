@@ -151,9 +151,14 @@ def decode_plain(data, num_values: int, physical: Type, type_length: Optional[in
 def _decode_plain_byte_array(buf: np.ndarray, num_values: int):
     """4-byte-length-prefixed strings → (values uint8[], offsets int32[n+1]).
 
-    The length prefixes sit at data-dependent positions (sequential scan in the
-    reference); here: iterative host scan.  The C++ shim (native/) and the
-    device two-pass variant replace this on hot paths."""
+    The length prefixes sit at data-dependent positions (a sequential scan —
+    the same loop the reference does in Go); dispatches to the C++ shim when
+    built, with this numpy loop as the purego-style fallback."""
+    from .. import native as _native
+
+    res = _native.plain_byte_array(buf, num_values)
+    if res is not None:
+        return res
     offsets = np.empty(num_values + 1, dtype=np.int64)
     offsets[0] = 0
     pos = 0
@@ -229,7 +234,18 @@ def scan_rle_runs(data, num_values: int, bit_width: int, pos: int = 0):
 
     Returns (kinds u8[k] (0=RLE,1=bitpacked), counts i64[k], payload i64[k],
     byte_offsets i64[k], end_pos).  payload = repeated value for RLE runs,
-    unused for bit-packed (their bits start at byte_offsets)."""
+    unused for bit-packed (their bits start at byte_offsets).
+
+    Dispatches to the C++ shim (native/) when built; the Python loop below is
+    the purego-style fallback (end_pos is -1 on the native path — no caller
+    uses it)."""
+    from .. import native as _native
+
+    buf0 = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    res = _native.scan_rle_runs(buf0[pos:] if pos else buf0, num_values, bit_width)
+    if res is not None:
+        kinds, counts, payloads, offsets = res
+        return kinds, counts, payloads, offsets + pos, -1
     kinds: List[int] = []
     counts: List[int] = []
     payloads: List[int] = []
@@ -512,6 +528,8 @@ def encode_delta_length_byte_array(values: np.ndarray, offsets: np.ndarray) -> b
 
 
 def decode_delta_byte_array(data, pos: int = 0):
+    from .. import native as _native
+
     prefix_lens, pos = decode_delta_binary_packed(data, pos)
     suffixes, soffs, pos = decode_delta_length_byte_array(data, pos)
     n = len(prefix_lens)
@@ -520,6 +538,10 @@ def decode_delta_byte_array(data, pos: int = 0):
     offsets = np.empty(n + 1, dtype=np.int64)
     offsets[0] = 0
     np.cumsum(lens, out=offsets[1:])
+    nat = _native.delta_byte_array_expand(prefix_lens, suffixes,
+                                          soffs.astype(np.int64), offsets)
+    if nat is not None:
+        return nat, offsets.astype(np.int32), pos
     values = np.empty(int(offsets[-1]), dtype=np.uint8)
     # sequential prefix dependency (host oracle; device path uses scan variant)
     prev_start = 0
