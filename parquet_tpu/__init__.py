@@ -1,0 +1,48 @@
+"""parquet-tpu: a TPU-native Parquet framework (JAX/XLA/Pallas).
+
+Built from scratch with the capabilities of kmatt/parquet-go
+(segmentio/parquet-go lineage) — see SURVEY.md for the layer map this
+implements and README.md for the design.
+
+Public API (reference analog in parens):
+
+Reading
+  ParquetFile (parquet.File/OpenFile), read_table (parquet.Read),
+  ReadOptions (FileConfig), Table/Column, read_row_range (SeekToRow),
+  read_pytree — device-array pytrees for jit consumers
+Writing
+  ParquetWriter (parquet.Writer), write_table (parquet.WriteFile),
+  WriterOptions (WriterConfig)
+Typed
+  schema_of (SchemaOf), read_objects/write_objects (ReadFile/WriteFile[T]),
+  TypedReader/TypedWriter (GenericReader/GenericWriter[T])
+Algebra
+  TableBuffer (Buffer), SortingColumn, SortingWriter, merge_files/
+  merge_row_groups (MergeRowGroups), convert_table (Convert)
+Pushdown
+  find (parquet.Find), plan_scan, prune_row_group, pages_overlapping
+Schema
+  Schema, message/group/leaf/optional/repeated/list_of/map_of (node.go)
+"""
+
+from .errors import CorruptedError
+from .io.reader import ParquetFile, ReadOptions, RowGroupReader, Table
+from .io.column import Column
+from .io.writer import (ColumnData, ParquetWriter, WriterOptions,
+                        schema_from_arrow, write_table)
+from .io.search import find, pages_overlapping, plan_scan, prune_row_group, read_row_range
+from .algebra import (SortingColumn, SortingWriter, TableBuffer,
+                      convert_table, merge_files, merge_row_groups)
+from .schema.schema import (Schema, group, leaf, list_of, map_of, message,
+                            optional, repeated)
+from .typed import (TypedReader, TypedWriter, read_objects, read_pytree,
+                    schema_of, write_objects)
+from .utils.printer import print_file, print_schema
+from .utils.debug import counters
+
+__version__ = "0.1.0"
+
+
+def read_table(source, columns=None, device=False) -> Table:
+    """Open + decode in one call (the ``parquet.Read`` convenience)."""
+    return ParquetFile(source).read(columns=columns, device=device)

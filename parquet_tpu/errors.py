@@ -1,0 +1,27 @@
+"""Sentinel errors + hard format limits.
+
+Reference parity: ``errors.go — ErrCorrupted, ErrMissingRootColumn...`` and
+``limits.go — MaxColumnDepth, MaxColumnIndexSize...`` (SURVEY.md §2.1).
+"""
+
+from .io.reader import CorruptedError  # canonical corruption error
+
+# hard format limits (mirroring the reference's limits.go constants)
+MAX_COLUMN_DEPTH = 16
+MAX_COLUMN_INDEX_SIZE = 16 * 1024 * 1024
+MAX_PAGE_SIZE = 1 << 31 - 1
+MAX_ROW_GROUPS = 1 << 15  # RowGroup.ordinal is an i16
+MAX_DEFINITION_LEVEL = 255
+MAX_REPETITION_LEVEL = 255
+
+
+class MissingRootColumnError(CorruptedError):
+    """Schema has no root element."""
+
+
+class TooManyRowGroupsError(ValueError):
+    """More than MAX_ROW_GROUPS row groups."""
+
+
+class ColumnTooDeepError(ValueError):
+    """Schema nesting exceeds MAX_COLUMN_DEPTH."""

@@ -1,0 +1,196 @@
+"""Pallas TPU kernels for the decode hot loops.
+
+Reference parity: the role of ``internal/bitpack/unpack_int32_amd64.s`` etc.
+(SURVEY.md §2.3) — hand-tuned kernels under the same interfaces as the
+portable path.  Tested in interpret mode against the numpy oracle (the
+purego-equivalence pattern) and jit-compiled on the real chip by the bench.
+
+Design note (TPU-first): data-dependent gathers are the enemy on a TPU VPU —
+so the flagship kernel is a *gather-free* bit-unpack.  For a static width
+``w``, output lane ``j`` of every 32-value group always reads packed word
+``(j*w) >> 5`` at shift ``(j*w) & 31``: the access pattern is compile-time
+static, and the kernel is 32 unrolled vector shift/or/mask column writes over
+a (block, w)-word tile in VMEM.  The generic mixed-width path stays in
+ops/device.py (XLA gathers); chunks whose streams are single-width (dict
+indexes, most delta miniblocks after host bucketing) route here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _unpack_block_kernel(words_ref, out_ref, *, w: int):
+    """One VMEM block: (B, w) packed uint32 words → (B, 32) values."""
+    words = words_ref[:]
+    mask = jnp.uint32((1 << w) - 1 if w < 32 else _MASK32)
+    cols = []
+    for j in range(32):
+        bitpos = j * w
+        k = bitpos >> 5
+        sh = bitpos & 31
+        lo = words[:, k] >> jnp.uint32(sh)
+        if sh + w > 32:
+            hi = words[:, k + 1] << jnp.uint32(32 - sh)
+            val = lo | hi
+        else:
+            val = lo
+        cols.append((val & mask).reshape(-1, 1))
+    out_ref[:] = jnp.concatenate(cols, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "w", "block", "interpret"))
+def unpack_bits_dense(packed_words: jax.Array, n: int, w: int,
+                      block: int = 512, interpret: bool = False) -> jax.Array:
+    """Unpack ``n`` LSB-first ``w``-bit integers from a dense stream.
+
+    ``packed_words``: uint32[ceil(n/32)*w] (caller pads).  Returns uint32[n].
+    Grid over groups of 32 values; each grid step unpacks ``block`` groups.
+    """
+    if w == 32:
+        return packed_words[:n]
+    groups = (n + 31) // 32
+    gpad = (groups + block - 1) // block * block
+    need_words = gpad * w
+    if packed_words.shape[0] < need_words:
+        packed_words = jnp.pad(packed_words, (0, need_words - packed_words.shape[0]))
+    words2d = packed_words[: gpad * w].reshape(gpad, w)
+    out = pl.pallas_call(
+        functools.partial(_unpack_block_kernel, w=w),
+        out_shape=jax.ShapeDtypeStruct((gpad, 32), jnp.uint32),
+        grid=(gpad // block,),
+        in_specs=[pl.BlockSpec((block, w), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block, 32), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(words2d)
+    return out.reshape(-1)[:n]
+
+
+def unpack_bits_dense_jnp(packed_words: jax.Array, n: int, w: int) -> jax.Array:
+    """jnp twin of :func:`unpack_bits_dense` — identical static-select
+    formulation, no Pallas (runs anywhere; XLA fuses it to vector code)."""
+    if w == 32:
+        return packed_words[:n]
+    groups = (n + 31) // 32
+    need = groups * w
+    if packed_words.shape[0] < need:
+        packed_words = jnp.pad(packed_words, (0, need - packed_words.shape[0]))
+    words = packed_words[:need].reshape(groups, w)
+    mask = jnp.uint32((1 << w) - 1)
+    cols = []
+    for j in range(32):
+        bitpos = j * w
+        k = bitpos >> 5
+        sh = bitpos & 31
+        val = words[:, k] >> jnp.uint32(sh)
+        if sh + w > 32:
+            val = val | (words[:, k + 1] << jnp.uint32(32 - sh))
+        cols.append(val & mask)
+    return jnp.stack(cols, axis=1).reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Fused dictionary expand+gather for single-width bit-packed index streams
+# ---------------------------------------------------------------------------
+
+
+def _dict_unpack_gather_kernel(words_ref, dict_ref, out_ref, *, w: int):
+    """Unpack 32-bit-group indexes and gather from a VMEM-resident dictionary
+    via one-hot matmul (MXU-friendly for small dictionaries)."""
+    words = words_ref[:]
+    mask = jnp.uint32((1 << w) - 1 if w < 32 else _MASK32)
+    cols = []
+    for j in range(32):
+        bitpos = j * w
+        k = bitpos >> 5
+        sh = bitpos & 31
+        val = words[:, k] >> jnp.uint32(sh)
+        if sh + w > 32:
+            val = val | (words[:, k + 1] << jnp.uint32(32 - sh))
+        cols.append((val & mask).reshape(-1, 1))
+    idx = jnp.concatenate(cols, axis=1).astype(jnp.int32)  # (B, 32)
+    d = dict_ref[:]  # (D,) values in VMEM
+    flat = idx.reshape(-1)
+    onehot = (flat[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (flat.shape[0], d.shape[0]), 1))
+    vals = jnp.sum(jnp.where(onehot, d[None, :], 0), axis=1)
+    out_ref[:] = vals.reshape(idx.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "w", "block", "interpret"))
+def dict_unpack_gather(packed_words: jax.Array, dictionary: jax.Array, n: int,
+                       w: int, block: int = 128, interpret: bool = False
+                       ) -> jax.Array:
+    """Fused: bit-unpack dictionary indexes + gather values, one VMEM pass
+    (no HBM round-trip for the index stream).  For small dictionaries."""
+    groups = (n + 31) // 32
+    gpad = (groups + block - 1) // block * block
+    need_words = gpad * max(w, 1)
+    if packed_words.shape[0] < need_words:
+        packed_words = jnp.pad(packed_words, (0, need_words - packed_words.shape[0]))
+    words2d = packed_words[: gpad * w].reshape(gpad, w)
+    out = pl.pallas_call(
+        functools.partial(_dict_unpack_gather_kernel, w=w),
+        out_shape=jax.ShapeDtypeStruct((gpad, 32), dictionary.dtype),
+        grid=(gpad // block,),
+        in_specs=[
+            pl.BlockSpec((block, w), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((dictionary.shape[0],), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block, 32), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(words2d, dictionary)
+    return out.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# SBBF bloom block math (vector twin of bloom.py; probes a batch of hashes
+# against gathered blocks — the gather happens outside, the 8-salt block math
+# is the vector part, matching the reference's AVX2 block kernel split)
+# ---------------------------------------------------------------------------
+
+_SALT = np.array([
+    0x47B6137B, 0x44974D91, 0x8824AD5B, 0xA2B7289D,
+    0x705495C7, 0x2DF1424B, 0x9EFC4947, 0x5C6BFB31,
+], dtype=np.uint32)
+
+
+def _bloom_check_kernel(blocks_ref, low_ref, salts_ref, out_ref):
+    """blocks: (B, 8) gathered filter blocks; low: (B, 1) low-32 hash bits."""
+    low = low_ref[:][:, 0]
+    salts = salts_ref[:][0]
+    bit = (low[:, None] * salts[None, :]) >> jnp.uint32(27)
+    masks = jnp.uint32(1) << (bit & jnp.uint32(31))
+    hit = (blocks_ref[:] & masks) == masks
+    out_ref[:] = jnp.all(hit, axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bloom_check_blocks(blocks: jax.Array, low_bits: jax.Array,
+                       interpret: bool = False) -> jax.Array:
+    """Check pre-gathered SBBF blocks against hash low bits (vector part of
+    the probe; block gather by high bits happens in XLA)."""
+    n = blocks.shape[0]
+    return pl.pallas_call(
+        _bloom_check_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.bool_),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(blocks, low_bits.reshape(-1, 1), jnp.asarray(_SALT).reshape(1, 8)).reshape(-1)

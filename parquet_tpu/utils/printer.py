@@ -1,0 +1,39 @@
+"""Human-readable schema / file dumps.
+
+Reference parity: ``print.go — PrintSchema / PrintRowGroup`` (SURVEY.md §2.1)
+— parquet-tools style output.
+"""
+
+from __future__ import annotations
+
+from ..format.enums import CompressionCodec, Encoding, Type
+
+
+def print_schema(schema, file=None) -> str:
+    """parquet-tools style schema dump (also returned as a string)."""
+    out = repr(schema)
+    if file is not None:
+        print(out, file=file)
+    return out
+
+
+def print_file(pf, file=None) -> str:
+    """Summary of a ParquetFile: schema + per-row-group chunk table."""
+    lines = [repr(pf.schema), ""]
+    lines.append(f"num_rows: {pf.num_rows}")
+    lines.append(f"created_by: {pf.created_by}")
+    for rg in pf.row_groups:
+        lines.append(f"row group {rg.index}: {rg.num_rows} rows")
+        for i, chunk in enumerate(rg.rg.columns):
+            m = chunk.meta_data
+            encs = "/".join(Encoding(e).name for e in (m.encodings or []))
+            lines.append(
+                f"  {'.'.join(m.path_in_schema or [])}: {Type(m.type).name} "
+                f"{CompressionCodec(m.codec).name} [{encs}] "
+                f"values={m.num_values} "
+                f"compressed={m.total_compressed_size} "
+                f"uncompressed={m.total_uncompressed_size}")
+    out = "\n".join(lines)
+    if file is not None:
+        print(out, file=file)
+    return out

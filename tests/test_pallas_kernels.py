@@ -1,0 +1,59 @@
+"""Pallas kernels vs numpy oracle, interpret mode (CPU).  The driver's bench
+compiles the same kernels on the real chip."""
+
+import numpy as np
+import pytest
+
+from parquet_tpu.ops import pallas_kernels as pk, ref
+
+
+def _pack_words(v: np.ndarray, w: int) -> np.ndarray:
+    raw = ref.pack_bits(v, w)
+    pad = (-len(raw)) % 4
+    return np.frombuffer(raw + b"\0" * pad, dtype="<u4").copy()
+
+
+@pytest.mark.parametrize("w", [1, 2, 3, 5, 7, 8, 11, 13, 16, 17, 20, 24, 27, 31, 32])
+def test_unpack_bits_dense_pallas(w, rng):
+    n = 4099
+    v = rng.integers(0, 1 << min(w, 62), size=n, dtype=np.uint64) & np.uint64((1 << w) - 1)
+    words = _pack_words(v, w)
+    out = pk.unpack_bits_dense(words, n, w, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), v.astype(np.uint32))
+
+
+@pytest.mark.parametrize("w", [3, 8, 17, 31])
+def test_unpack_bits_dense_jnp_twin(w, rng):
+    n = 2000
+    v = rng.integers(0, 1 << w, size=n, dtype=np.uint64)
+    words = _pack_words(v, w)
+    out = pk.unpack_bits_dense_jnp(words, n, w)
+    np.testing.assert_array_equal(np.asarray(out), v.astype(np.uint32))
+
+
+def test_dict_unpack_gather(rng):
+    w = 5
+    d = rng.random(32, dtype=np.float32)
+    idx = rng.integers(0, 32, size=1000, dtype=np.uint64)
+    words = _pack_words(idx, w)
+    out = pk.dict_unpack_gather(words, d, 1000, w, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), d[idx])
+
+
+def test_bloom_check_blocks(rng):
+    from parquet_tpu.io import bloom
+
+    filt = bloom.SplitBlockFilter.for_ndv(1000, 10)
+    vals = rng.integers(0, 10**12, 500).astype(np.int64)
+    hashes = bloom.xxh64_u64(vals.view(np.uint64))
+    filt.insert_hashes(hashes)
+    # probe: half present, half absent
+    probe_vals = np.concatenate([vals[:250], rng.integers(10**13, 10**14, 250)])
+    probes = bloom.xxh64_u64(probe_vals.view(np.uint64))
+    block_idx, _ = filt._masks(probes)
+    blocks = filt.blocks[block_idx]
+    low = (probes & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out = np.asarray(pk.bloom_check_blocks(blocks, low, interpret=True))
+    expect = filt.check_hashes(probes)
+    np.testing.assert_array_equal(out, expect)
+    assert out[:250].all()  # no false negatives
