@@ -376,7 +376,8 @@ class ParquetWriter:
         # values
         if indices is not None:
             idx = indices[v0:v1]
-            width = _bw(max(self._dict_n - 1, 0))
+            # bit width ≥ 1: several readers reject zero-width index streams
+            width = max(_bw(max(self._dict_n - 1, 0)), 1)
             values = ref.encode_rle_dict_indices(idx, width)
         else:
             values = _encode_values(leaf, data, v0, v1, value_encoding)

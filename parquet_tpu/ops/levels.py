@@ -89,10 +89,10 @@ def assemble(def_levels: Optional[np.ndarray], rep_levels: Optional[np.ndarray],
     max_rep = leaf.max_repetition_level
     if max_def == 0:
         return Assembled(validity=None, list_offsets=[], list_validity=[])
-    d = def_levels
+    d = def_levels if def_levels is not None else np.zeros(0, dtype=np.int32)
     if max_rep == 0:
         return Assembled(validity=(d == max_def), list_offsets=[], list_validity=[])
-    r = rep_levels
+    r = rep_levels if rep_levels is not None else np.zeros(0, dtype=np.int32)
     infos = repeated_ancestors(leaf)
     nlev = len(infos)
     offsets: List[np.ndarray] = []

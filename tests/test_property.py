@@ -1,0 +1,130 @@
+"""Property-based round-trips (the reference's internal/quick + fuzz strategy,
+SURVEY.md §4.2): randomized tables of every type → write → read → equal, and
+corrupted-input robustness (truncations/bitflips must raise, never crash)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from parquet_tpu.io.reader import CorruptedError, ParquetFile
+from parquet_tpu.io.writer import WriterOptions, write_table
+
+_SCALARS = [
+    (pa.int64(), st.integers(-(2**63), 2**63 - 1)),
+    (pa.int32(), st.integers(-(2**31), 2**31 - 1)),
+    (pa.float64(), st.floats(allow_nan=False, width=64)),
+    (pa.float32(), st.floats(allow_nan=False, width=32)),
+    (pa.bool_(), st.booleans()),
+    (pa.string(), st.text(max_size=20)),
+    (pa.binary(), st.binary(max_size=20)),
+]
+
+
+@st.composite
+def tables(draw):
+    n_cols = draw(st.integers(1, 4))
+    n_rows = draw(st.integers(0, 200))
+    cols = {}
+    for c in range(n_cols):
+        typ, vals = draw(st.sampled_from(_SCALARS))
+        nullable = draw(st.booleans())
+        listy = draw(st.booleans()) and c == 0
+        if listy:
+            elem = st.lists(vals, max_size=4)
+            data = [draw(st.none() | elem) if nullable else draw(elem)
+                    for _ in range(n_rows)]
+            cols[f"c{c}"] = pa.array(data, type=pa.list_(typ))
+        else:
+            data = [draw(st.none() | vals) if nullable else draw(vals)
+                    for _ in range(n_rows)]
+            cols[f"c{c}"] = pa.array(data, type=typ)
+    return pa.table(cols)
+
+
+@settings(max_examples=30, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large])
+@given(t=tables(), compression=st.sampled_from(["none", "snappy", "zstd"]),
+       dpv=st.sampled_from([1, 2]))
+def test_random_roundtrip(t, compression, dpv):
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(compression=compression,
+                                      data_page_version=dpv))
+    raw = buf.getvalue()
+    # pyarrow readback
+    got = pq.read_table(io.BytesIO(raw))
+    for name in t.column_names:
+        g = got[name].combine_chunks()
+        e = t[name].combine_chunks()
+        if g.type != e.type:
+            g = g.cast(e.type)
+        assert g.equals(e), name
+    # self readback
+    tab = ParquetFile(raw).read()
+    for name in t.column_names:
+        paths = [p for p in tab.keys() if p == name or p.startswith(name + ".")]
+        arr = tab[paths[0]].to_arrow()
+        e = t[name].combine_chunks()
+        if arr.type != e.type:
+            arr = arr.cast(e.type)
+        assert arr.equals(e), name
+
+
+@settings(max_examples=50, deadline=None)
+@given(data=st.data())
+def test_corrupted_inputs_never_crash(data):
+    """Bitflips/truncations raise clean errors (ErrCorrupted analog), never
+    segfault or hang — the fuzz target of SURVEY.md §4.2."""
+    t = pa.table({"x": pa.array(np.arange(100, dtype=np.int64)),
+                  "s": pa.array([f"s{i}" for i in range(100)])})
+    buf = io.BytesIO()
+    write_table(t, buf)
+    raw = bytearray(buf.getvalue())
+    mode = data.draw(st.sampled_from(["truncate", "flip", "zero"]))
+    if mode == "truncate":
+        cut = data.draw(st.integers(0, len(raw) - 1))
+        raw = raw[:cut]
+    elif mode == "flip":
+        pos = data.draw(st.integers(0, len(raw) - 1))
+        raw[pos] ^= 0xFF
+    else:
+        pos = data.draw(st.integers(0, len(raw) - 9))
+        raw[pos : pos + 8] = b"\0" * 8
+    try:
+        pf = ParquetFile(bytes(raw))
+        pf.read()
+    except Exception:
+        pass  # any clean Python exception is acceptable
+
+
+def test_concurrent_reads():
+    """Documented goroutine-safety analog (SURVEY.md §2.5a): one ParquetFile,
+    many threads reading distinct row groups concurrently."""
+    import threading
+
+    t = pa.table({"x": pa.array(np.arange(80000, dtype=np.int64))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(row_group_size=10000, dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    results = [None] * 8
+    errors = []
+
+    def worker(i):
+        try:
+            col = pf.row_group(i).column(0).read()
+            results[i] = np.asarray(col.values)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    got = np.concatenate(results)
+    np.testing.assert_array_equal(got, np.arange(80000))
