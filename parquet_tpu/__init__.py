@@ -23,6 +23,10 @@ Pushdown
   find (parquet.Find), plan_scan, prune_row_group, pages_overlapping
 Schema
   Schema, message/group/leaf/optional/repeated/list_of/map_of (node.go)
+Rows
+  Value/Row (value.go/row.go), RowBuilder (row_builder.go), deconstruct/
+  reconstruct (Schema.Deconstruct/Reconstruct), copy_rows (CopyRows),
+  write_rows/read_rows — record-at-a-time nested transport
 """
 
 from .errors import CorruptedError
@@ -37,6 +41,8 @@ from .schema.schema import (Schema, group, leaf, list_of, map_of, message,
                             optional, repeated)
 from .typed import (TypedReader, TypedWriter, read_objects, read_pytree,
                     schema_of, write_objects)
+from .rows import (Row, RowBuilder, Value, copy_rows, deconstruct, read_rows,
+                   reconstruct, write_rows)
 from .utils.printer import print_file, print_schema
 from .utils.debug import counters
 

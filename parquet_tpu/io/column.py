@@ -35,6 +35,11 @@ class Column:
     dictionary: Any = None  # device dict values (or (values, offsets) pair)
     dictionary_host: Any = None  # host numpy mirror
     dict_indices: Any = None  # int32 indexes into the dictionary
+    # raw Dremel level streams (host decode keeps them for the row model —
+    # rows.py record-at-a-time Reconstruct needs struct-level null fidelity
+    # that the collapsed validity/list_offsets form cannot carry)
+    def_levels: Optional[Any] = None
+    rep_levels: Optional[Any] = None
 
     @property
     def num_values(self) -> int:

@@ -415,7 +415,8 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None) -> Column:
         (len(values) if np.ndim(values) else 0))
     return Column(leaf=leaf, values=values, offsets=offsets,
                   validity=asm.validity, list_offsets=asm.list_offsets,
-                  list_validity=asm.list_validity, num_slots=num_slots)
+                  list_validity=asm.list_validity, num_slots=num_slots,
+                  def_levels=def_levels, rep_levels=rep_levels)
 
 
 class _DictIndices:

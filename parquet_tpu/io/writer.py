@@ -88,6 +88,10 @@ class ColumnData:
     validity: Optional[np.ndarray] = None  # per slot
     list_offsets: Optional[np.ndarray] = None  # single-level list support
     list_validity: Optional[np.ndarray] = None
+    # raw Dremel level streams (rows.py row path); when set they bypass
+    # _build_levels, enabling arbitrary-depth nested writes
+    def_levels: Optional[np.ndarray] = None
+    rep_levels: Optional[np.ndarray] = None
 
 
 class ParquetWriter:
@@ -479,11 +483,22 @@ def _copy_cd(cd: ColumnData) -> ColumnData:
                       offsets=None if cd.offsets is None else cd.offsets.copy(),
                       validity=None if cd.validity is None else cd.validity.copy(),
                       list_offsets=None if cd.list_offsets is None else cd.list_offsets.copy(),
-                      list_validity=None if cd.list_validity is None else cd.list_validity.copy())
+                      list_validity=None if cd.list_validity is None else cd.list_validity.copy(),
+                      def_levels=None if cd.def_levels is None else cd.def_levels.copy(),
+                      rep_levels=None if cd.rep_levels is None else cd.rep_levels.copy())
 
 
 def _extend_cd(dst: ColumnData, src: ColumnData) -> None:
+    if (dst.def_levels is None) != (src.def_levels is None) or (
+            dst.rep_levels is None) != (src.rep_levels is None):
+        raise ValueError(
+            "cannot mix raw-level ColumnData (rows path) with vectorized "
+            "ColumnData in one buffered chunk; flush between them")
     dst.values = np.concatenate([np.asarray(dst.values), np.asarray(src.values)])
+    if dst.def_levels is not None:
+        dst.def_levels = np.concatenate([dst.def_levels, src.def_levels])
+    if dst.rep_levels is not None:
+        dst.rep_levels = np.concatenate([dst.rep_levels, src.rep_levels])
     if dst.offsets is not None:
         base = dst.offsets[-1]
         dst.offsets = np.concatenate([dst.offsets[:-1], src.offsets + base])
@@ -511,6 +526,8 @@ def _cd_len_v(cd: ColumnData) -> int:
 def _build_levels(leaf: Leaf, data: ColumnData, num_rows: int):
     max_def = leaf.max_definition_level
     max_rep = leaf.max_repetition_level
+    if data.def_levels is not None or data.rep_levels is not None:
+        return data.def_levels, data.rep_levels
     if max_rep == 0:
         if max_def == 0:
             return None, None

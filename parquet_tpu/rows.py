@@ -1,0 +1,753 @@
+"""Row model: Value / Row transport + full Dremel shredding and assembly.
+
+Reference parity (SURVEY.md §2.1): ``value.go — Value, ValueReader,
+ValueWriter, CopyValues`` (tagged scalar + def/rep levels + column index),
+``row.go — Row, CopyRows, RowReader/RowWriter``, ``row_builder.go —
+RowBuilder``, and the record-at-a-time ``schema.go — Schema.Deconstruct /
+Schema.Reconstruct`` pair (SURVEY.md §3.1/§3.2).
+
+The TPU framework is columnar-first: the vectorized level math in
+``ops/levels.py`` covers the hot path.  This module is the *row transport*
+layer on top of it — arbitrary-depth nested records (optional groups, lists
+of lists, maps) shredded to per-leaf slot streams and back, one record at a
+time, host-side.  ``columns_from_rows`` converts rows into the writer's
+columnar form carrying raw def/rep level streams, which is also the only
+write path for schemas deeper than one repeated level.
+
+Record representation is plain Python: dicts for groups, lists for repeated
+fields, ``None`` for nulls.  LIST/MAP logical wrappers accept/produce the
+natural Python forms (a list / a dict) instead of the 3-level strict shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .format.enums import FieldRepetitionType as Rep, Type
+from .schema.schema import Leaf, Node, Schema
+from .schema.types import LogicalKind
+
+
+# ---------------------------------------------------------------------------
+# Value / Row
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Value:
+    """One leaf slot: scalar payload + Dremel levels + column ordinal.
+
+    ``value is None`` for null/absent slots; ``definition_level`` then records
+    how deep the path was defined (which ancestor went null)."""
+
+    column: int
+    value: Any
+    definition_level: int = 0
+    repetition_level: int = 0
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def __repr__(self):
+        return (f"Value(col={self.column}, {self.value!r}, "
+                f"d={self.definition_level}, r={self.repetition_level})")
+
+
+class Row(list):
+    """A list of :class:`Value` slots, ordered by column then slot order."""
+
+    def for_column(self, column: int) -> List[Value]:
+        return [v for v in self if v.column == column]
+
+
+# ---------------------------------------------------------------------------
+# Chain math (per-leaf ancestor metadata)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Chain:
+    leaf: Leaf
+    nodes: Tuple[Node, ...]  # top-level field ... leaf (inclusive)
+    cum_def: Tuple[int, ...]  # def level after *entering* nodes[i]
+    cum_rep: Tuple[int, ...]  # rep level after entering nodes[i]
+    rep_positions: Tuple[int, ...]  # chain indexes of REPEATED nodes
+    rep_defs: Tuple[int, ...]  # cum_def at each repeated node (D_k)
+
+    @property
+    def max_def(self) -> int:
+        return self.leaf.max_definition_level
+
+    @property
+    def max_rep(self) -> int:
+        return self.leaf.max_repetition_level
+
+
+def _chain_of(leaf: Leaf) -> _Chain:
+    cd: List[int] = []
+    cr: List[int] = []
+    d = r = 0
+    reps: List[int] = []
+    rep_defs: List[int] = []
+    for i, n in enumerate(leaf.ancestors):
+        if n.repetition == Rep.OPTIONAL:
+            d += 1
+        elif n.repetition == Rep.REPEATED:
+            d += 1
+            r += 1
+            reps.append(i)
+            rep_defs.append(d)
+        cd.append(d)
+        cr.append(r)
+    return _Chain(leaf, leaf.ancestors, tuple(cd), tuple(cr), tuple(reps),
+                  tuple(rep_defs))
+
+
+def _chains(schema: Schema) -> List[_Chain]:
+    return [_chain_of(leaf) for leaf in schema.leaves]
+
+
+# ---------------------------------------------------------------------------
+# Deconstruct: record → per-leaf slot streams (Dremel shredding)
+# ---------------------------------------------------------------------------
+
+
+def _leaves_under(node: Node, schema: Schema) -> List[int]:
+    """Column ordinals of all leaves in node's subtree (by identity walk)."""
+    out: List[int] = []
+
+    def walk(n: Node):
+        if n.is_leaf:
+            for leaf in schema.leaves:
+                if leaf.node is n:
+                    out.append(leaf.column_index)
+                    return
+        else:
+            for c in n.children:
+                walk(c)
+
+    walk(node)
+    return out
+
+
+class _Shredder:
+    def __init__(self, schema: Schema):
+        self.schema = schema
+        self.leaf_of_node: Dict[int, int] = {
+            id(leaf.node): leaf.column_index for leaf in schema.leaves
+        }
+        self.subtree_leaves: Dict[int, List[int]] = {}
+        # rep level of each REPEATED node (for non-first elements)
+        self.rep_level_of: Dict[int, int] = {}
+        for leaf in schema.leaves:
+            chain = _chain_of(leaf)
+            for i, n in enumerate(chain.nodes):
+                if n.repetition == Rep.REPEATED:
+                    self.rep_level_of[id(n)] = chain.cum_rep[i]
+
+    def _subtree(self, node: Node) -> List[int]:
+        key = id(node)
+        if key not in self.subtree_leaves:
+            self.subtree_leaves[key] = _leaves_under(node, self.schema)
+        return self.subtree_leaves[key]
+
+    def shred(self, record: Any) -> List[List[Tuple[Any, int, int]]]:
+        out: List[List[Tuple[Any, int, int]]] = [[] for _ in self.schema.leaves]
+        self._walk_children(self.schema.root, record, 0, 0, out)
+        return out
+
+    # -- helpers ------------------------------------------------------------
+    def _emit_nulls(self, node: Node, d: int, r: int, out) -> None:
+        for col in self._subtree(node):
+            out[col].append((None, d, r))
+
+    def _strict(self, node: Node, value: Any) -> Any:
+        """Convert LIST/MAP Python sugar into the strict tree shape."""
+        if value is None or node.is_leaf:
+            return value
+        if node.logical_kind == LogicalKind.LIST and not isinstance(value, dict):
+            if not isinstance(value, (list, tuple)):
+                raise TypeError(
+                    f"LIST field {node.name!r} expects a list, "
+                    f"got {type(value).__name__}")
+            inner = node.children[0]  # repeated group "list" (or legacy)
+            if inner.repetition == Rep.REPEATED:
+                if inner.is_leaf or len(inner.children or ()) != 1:
+                    return {inner.name: list(value)}
+                elem = inner.children[0]
+                return {inner.name: [{elem.name: v} for v in value]}
+        if node.logical_kind == LogicalKind.MAP and isinstance(value, dict):
+            inner = node.children[0]  # repeated group key_value
+            if inner.repetition == Rep.REPEATED and not inner.is_leaf:
+                kname = inner.children[0].name
+                vname = inner.children[1].name if len(inner.children) > 1 else "value"
+                if set(value.keys()) == {inner.name} and isinstance(
+                        value[inner.name], (list, tuple)) and all(
+                        isinstance(e, dict) and kname in e
+                        for e in value[inner.name]):
+                    return value  # already the strict 3-level shape
+                return {inner.name: [{kname: k, vname: v} for k, v in value.items()]}
+        return value
+
+    def _walk_children(self, node: Node, value: Any, d: int, r: int, out):
+        value = self._strict(node, value)
+        if not isinstance(value, dict):
+            raise TypeError(
+                f"group {node.name!r} expects a dict record, got {type(value).__name__}")
+        for child in node.children:
+            cv = value.get(child.name)
+            if child.repetition == Rep.REPEATED:
+                self._shred_repeated(child, cv, d, r, out)
+            else:
+                self._shred_node(child, cv, d, r, out)
+
+    def _shred_node(self, node: Node, value: Any, d: int, r: int, out):
+        if node.repetition == Rep.OPTIONAL:
+            if value is None:
+                self._emit_nulls(node, d, r, out)
+                return
+            d += 1
+        elif value is None and node.is_leaf:
+            raise ValueError(f"required leaf {node.name!r} is None")
+        if node.is_leaf:
+            out[self.leaf_of_node[id(node)]].append((value, d, r))
+        else:
+            self._walk_children(node, value, d, r, out)
+
+    def _shred_repeated(self, node: Node, elems: Any, d: int, r: int, out):
+        if elems is None:
+            elems = []
+        if not isinstance(elems, (list, tuple)):
+            raise TypeError(
+                f"repeated field {node.name!r} expects a list, got {type(elems).__name__}")
+        if len(elems) == 0:
+            self._emit_nulls(node, d, r, out)
+            return
+        own_rep = self.rep_level_of[id(node)]
+        for i, e in enumerate(elems):
+            ri = r if i == 0 else own_rep
+            if node.is_leaf:
+                if e is None:
+                    raise ValueError(
+                        f"repeated leaf {node.name!r} cannot hold null elements")
+                out[self.leaf_of_node[id(node)]].append((e, d + 1, ri))
+            else:
+                self._walk_children(node, e, d + 1, ri, out)
+
+def _shredder_of(schema: Schema) -> _Shredder:
+    """Per-schema cached shredder (rebuilding caches per record is pure
+    overhead in the write hot path)."""
+    s = getattr(schema, "_row_shredder", None)
+    if s is None or s.schema is not schema:
+        s = _Shredder(schema)
+        schema._row_shredder = s
+    return s
+
+
+def deconstruct(schema: Schema, record: Any) -> Row:
+    """Shred one record into a :class:`Row` of leaf slots (Dremel encode)."""
+    slots = _shredder_of(schema).shred(record)
+    row = Row()
+    for col, lst in enumerate(slots):
+        for (v, d, r) in lst:
+            row.append(Value(column=col, value=v, definition_level=d,
+                             repetition_level=r))
+    return row
+
+
+# ---------------------------------------------------------------------------
+# Reconstruct: per-leaf slot streams → record (Dremel assembly)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _Null:
+    """Skeleton marker: path defined down to def level ``depth`` only."""
+
+    depth: int
+
+
+def _skeleton(chain: _Chain, slots: Sequence[Tuple[Any, int, int]]) -> Any:
+    """Assemble ONE row's slots of ONE leaf into a nested-list skeleton.
+
+    Lists appear only at REPEATED chain nodes; groups/optionals are collapsed
+    (their nullness is preserved in :class:`_Null` payload depths)."""
+    R = len(chain.rep_positions)
+    D = chain.rep_defs  # 1-based via D[k-1]
+    max_def = chain.max_def
+    holder: List[Any] = []
+    lists: List[Any] = [holder] + [None] * R
+    for (v, d, r) in slots:
+        k = r + 1
+        while True:
+            if k > R:
+                lists[R].append(v if d == max_def else _Null(d))
+                break
+            parent = lists[k - 1]
+            if d >= D[k - 1] - 1:
+                new: List[Any] = []
+                parent.append(new)
+                lists[k] = new
+                if d >= D[k - 1]:
+                    k += 1
+                    continue
+                break  # empty list
+            parent.append(_Null(d))
+            break
+    return holder[0] if holder else _Null(0)
+
+
+class _Assembler:
+    def __init__(self, schema: Schema):
+        self.schema = schema
+        self.chains = _chains(schema)
+        self._sub: Dict[int, List[int]] = {}
+
+    def _subtree(self, node: Node) -> List[int]:
+        key = id(node)
+        if key not in self._sub:
+            self._sub[key] = _leaves_under(node, self.schema)
+        return self._sub[key]
+
+    def assemble(self, row: Row) -> Dict[str, Any]:
+        by_col: List[List[Tuple[Any, int, int]]] = [[] for _ in self.chains]
+        for v in row:  # single pass, not a rescan per column
+            by_col[v.column].append(
+                (v.value, v.definition_level, v.repetition_level))
+        parts = {chain.leaf.column_index: _skeleton(chain, by_col[i])
+                 for i, chain in enumerate(self.chains)}
+        return self._merge_children(self.schema.root, parts, 0)
+
+    # -- merge --------------------------------------------------------------
+    def _merge_children(self, node: Node, parts: Dict[int, Any], d: int):
+        out: Dict[str, Any] = {}
+        for child in node.children:
+            cols = self._subtree(child)
+            cp = {c: parts[c] for c in cols}
+            if child.repetition == Rep.REPEATED:
+                out[child.name] = self._merge_repeated(child, cp, d)
+            elif child.is_leaf:
+                out[child.name] = _payload(cp[cols[0]], child)
+            else:
+                dc = d + (1 if child.repetition == Rep.OPTIONAL else 0)
+                if child.repetition == Rep.OPTIONAL and all(
+                        isinstance(s, _Null) and s.depth < dc
+                        for s in cp.values()):
+                    out[child.name] = None
+                else:
+                    out[child.name] = self._merge_children(child, cp, dc)
+        return self._sugar(node, out)
+
+    def _merge_repeated(self, child: Node, cp: Dict[int, Any], d: int):
+        skels = list(cp.values())
+        n = len(skels[0])
+        if any(len(s) != n for s in skels):
+            raise ValueError(
+                f"misaligned repetition under {child.name!r}: "
+                f"{[len(s) for s in skels]}")
+        cols = list(cp.keys())
+        if child.is_leaf:
+            return [_payload(e, child) for e in cp[cols[0]]]
+        dk = d + 1
+        out = []
+        for i in range(n):
+            ep = {c: cp[c][i] for c in cols}
+            if all(isinstance(s, _Null) for s in ep.values()):
+                # element exists but its content subtree is absent (an optional
+                # group directly under the repeated node went null)
+                out.append(self._null_element(child, ep, dk))
+            else:
+                out.append(self._merge_children(child, ep, dk))
+        return out
+
+    def _null_element(self, child: Node, ep: Dict[int, Any], dk: int):
+        # distinguish "element is an all-null group" from deeper nulls
+        if all(s.depth < dk for s in ep.values()):
+            return None
+        return self._merge_children(child, ep, dk)
+
+    def _sugar(self, node: Node, out: Dict[str, Any]):
+        if node.logical_kind == LogicalKind.LIST and len(out) == 1:
+            inner_node = node.children[0]
+            inner = next(iter(out.values()))
+            if inner_node.repetition == Rep.REPEATED and isinstance(inner, list):
+                if (not inner_node.is_leaf and inner_node.children is not None
+                        and len(inner_node.children) == 1):
+                    ename = inner_node.children[0].name
+                    return [None if e is None else e[ename] for e in inner]
+                return inner
+        if node.logical_kind == LogicalKind.MAP and len(out) == 1:
+            inner_node = node.children[0]
+            inner = next(iter(out.values()))
+            if (inner_node.repetition == Rep.REPEATED and isinstance(inner, list)
+                    and not inner_node.is_leaf and len(inner_node.children) >= 2):
+                kname = inner_node.children[0].name
+                vname = inner_node.children[1].name
+                return {e[kname]: e[vname] for e in inner if e is not None}
+        return out
+
+
+def _payload(skel: Any, node: Node):
+    if isinstance(skel, _Null):
+        return None
+    if isinstance(skel, (bytes, bytearray, np.bytes_)):
+        if node.logical_kind in (LogicalKind.STRING, LogicalKind.ENUM,
+                                 LogicalKind.JSON):
+            return bytes(skel).decode("utf-8")
+        return bytes(skel)
+    if isinstance(skel, np.generic):
+        return skel.item()
+    return skel
+
+
+def reconstruct(schema: Schema, row: Row) -> Dict[str, Any]:
+    """Assemble one :class:`Row` of leaf slots back into a record (Dremel
+    decode) — the inverse of :func:`deconstruct`."""
+    return _Assembler(schema).assemble(row)
+
+
+# ---------------------------------------------------------------------------
+# RowBuilder
+# ---------------------------------------------------------------------------
+
+
+class RowBuilder:
+    """Build rows field-by-field (reference: ``row_builder.go — RowBuilder``).
+
+    ``set`` accepts dotted paths for nested fields; ``row()`` shreds the
+    accumulated record and resets the builder."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+        self._record: Dict[str, Any] = {}
+
+    def set(self, path: str, value: Any) -> "RowBuilder":
+        parts = path.split(".")
+        cur = self._record
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = value
+        return self
+
+    def update(self, record: Dict[str, Any]) -> "RowBuilder":
+        self._record.update(record)
+        return self
+
+    def row(self) -> Row:
+        r = deconstruct(self.schema, self._record)
+        self._record = {}
+        return r
+
+
+# ---------------------------------------------------------------------------
+# Rows ↔ columnar conversion (bridge to the writer/reader)
+# ---------------------------------------------------------------------------
+
+
+def columns_from_rows(schema: Schema, rows: Iterable[Row]):
+    """Convert rows → per-leaf ``ColumnData`` with raw def/rep level streams.
+
+    Returns ``(columns: Dict[path, ColumnData], num_rows)``.  This is the
+    write path for arbitrarily nested schemas (the vectorized ColumnData
+    builders cover flat + single-level lists only)."""
+    from .io.writer import ColumnData
+    from .schema import types as _types
+
+    chains = _chains(schema)
+    per_leaf: List[List[Tuple[Any, int, int]]] = [[] for _ in schema.leaves]
+    num_rows = 0
+    for row in rows:
+        num_rows += 1
+        for v in row:
+            per_leaf[v.column].append(
+                (v.value, v.definition_level, v.repetition_level))
+    columns: Dict[str, ColumnData] = {}
+    for chain, slots in zip(chains, per_leaf):
+        leaf = chain.leaf
+        max_def, max_rep = chain.max_def, chain.max_rep
+        defs = np.fromiter((d for (_, d, _) in slots), np.int32, len(slots))
+        reps = np.fromiter((r for (_, _, r) in slots), np.int32, len(slots))
+        present = [v for (v, d, _) in slots if d == max_def]
+        values, offsets = _dense_values(leaf, present)
+        cd = ColumnData(values=values, offsets=offsets)
+        if max_def > 0:
+            cd.def_levels = defs
+        if max_rep > 0:
+            cd.rep_levels = reps
+        if max_def > 0 and max_rep == 0:
+            cd.validity = defs == max_def
+        columns[leaf.dotted_path] = cd
+    return columns, num_rows
+
+
+def _dense_values(leaf: Leaf, present: List[Any]):
+    phys = leaf.physical_type
+    if phys == Type.BYTE_ARRAY:
+        enc = [v.encode("utf-8") if isinstance(v, str) else bytes(v)
+               for v in present]
+        offsets = np.zeros(len(enc) + 1, np.int64)
+        if enc:
+            np.cumsum([len(b) for b in enc], out=offsets[1:])
+        values = np.frombuffer(b"".join(enc), np.uint8).copy()
+        return values, offsets
+    if phys == Type.FIXED_LEN_BYTE_ARRAY:
+        w = leaf.type_length or 0
+        buf = b"".join(
+            (v.encode("utf-8") if isinstance(v, str) else bytes(v)).ljust(w, b"\0")
+            for v in present)
+        return np.frombuffer(buf, np.uint8).reshape(-1, w).copy(), None
+    if phys == Type.INT96:
+        arr = np.zeros((len(present), 3), np.uint32)
+        for i, v in enumerate(present):
+            iv = int(v)
+            arr[i, 0] = iv & 0xFFFFFFFF
+            arr[i, 1] = (iv >> 32) & 0xFFFFFFFF
+            arr[i, 2] = (iv >> 64) & 0xFFFFFFFF
+        return arr, None
+    np_dt = {Type.BOOLEAN: np.bool_, Type.INT32: np.int32, Type.INT64: np.int64,
+             Type.FLOAT: np.float32, Type.DOUBLE: np.float64}[phys]
+    if leaf.logical_kind == LogicalKind.INT and not leaf.logical_params.get(
+            "signed", True):
+        np_dt = {Type.INT32: np.uint32, Type.INT64: np.uint64}.get(phys, np_dt)
+    return np.asarray(present, dtype=np_dt), None
+
+
+def rows_from_columns(schema: Schema, columns: Dict[str, "object"],
+                      num_rows: int) -> Iterator[Row]:
+    """Iterate rows out of decoded :class:`~parquet_tpu.io.column.Column`s.
+
+    Requires columns decoded with raw level streams attached (the host decode
+    path sets them); flat columns fall back to validity masks."""
+    per_leaf_slots: List[List[Tuple[Any, int, int]]] = []
+    chains = _chains(schema)
+    for chain in chains:
+        col = columns[chain.leaf.dotted_path]
+        per_leaf_slots.append(_column_slots(chain, col))
+    # row boundaries: slots with rep == 0 (or every slot for flat leaves)
+    cursors = [0] * len(chains)
+    for _ in range(num_rows):
+        row = Row()
+        for ci, (chain, slots) in enumerate(zip(chains, per_leaf_slots)):
+            i = cursors[ci]
+            n = len(slots)
+            j = i + 1
+            if chain.max_rep > 0:
+                while j < n and slots[j][2] != 0:
+                    j += 1
+            for (v, d, r) in slots[i:j]:
+                row.append(Value(column=chain.leaf.column_index, value=v,
+                                 definition_level=d, repetition_level=r))
+            cursors[ci] = j
+        yield row
+
+
+def _column_slots(chain: _Chain, col) -> List[Tuple[Any, int, int]]:
+    leaf = chain.leaf
+    defs = getattr(col, "def_levels", None)
+    reps = getattr(col, "rep_levels", None)
+    values = _host_values(col, leaf)
+    max_def = chain.max_def
+    if defs is None:
+        validity = None if col.validity is None else np.asarray(col.validity)
+        n = col.num_slots or (len(validity) if validity is not None else len(values))
+        out: List[Tuple[Any, int, int]] = []
+        vi = 0
+        for i in range(n):
+            if validity is None or validity[i]:
+                out.append((values[vi], max_def, 0))
+                vi += 1
+            else:
+                out.append((None, max_def - 1, 0))
+        return out
+    defs = np.asarray(defs)
+    reps = (np.asarray(reps) if reps is not None
+            else np.zeros(len(defs), np.int32))
+    out = []
+    vi = 0
+    for d, r in zip(defs.tolist(), reps.tolist()):
+        if d == max_def:
+            out.append((values[vi], int(d), int(r)))
+            vi += 1
+        else:
+            out.append((None, int(d), int(r)))
+    return out
+
+
+def _host_values(col, leaf: Leaf) -> List[Any]:
+    if col.is_dictionary_encoded():
+        col.materialize_host()
+    values = np.asarray(col.values)
+    if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
+        host_dt = {Type.INT64: np.int64, Type.DOUBLE: np.float64}.get(
+            leaf.physical_type, np.int64)
+        values = np.ascontiguousarray(values).view(host_dt).reshape(-1)
+    if (leaf.logical_kind == LogicalKind.INT
+            and not leaf.logical_params.get("signed", True)
+            and values.dtype in (np.int32, np.int64)):
+        values = values.view({np.dtype(np.int32): np.uint32,
+                              np.dtype(np.int64): np.uint64}[values.dtype])
+    if col.offsets is not None:
+        offs = np.asarray(col.offsets, np.int64)
+        raw = values
+        return [raw[offs[i]:offs[i + 1]].tobytes() for i in range(len(offs) - 1)]
+    if leaf.physical_type == Type.FIXED_LEN_BYTE_ARRAY and values.ndim == 2:
+        return [values[i].tobytes() for i in range(len(values))]
+    if leaf.physical_type == Type.INT96 and values.ndim == 2:
+        return [int(values[i, 0]) | (int(values[i, 1]) << 32)
+                | (int(values[i, 2]) << 64) for i in range(len(values))]
+    return list(values)
+
+
+# ---------------------------------------------------------------------------
+# RowReader / RowWriter transport (reference: row.go — CopyRows)
+# ---------------------------------------------------------------------------
+
+
+class RowReader:
+    """Anything with ``read_rows(n) -> List[Row]`` (empty list = EOF)."""
+
+    def read_rows(self, n: int) -> List[Row]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class RowWriter:
+    """Anything with ``write_rows(rows: List[Row]) -> int``."""
+
+    def write_rows(self, rows: List[Row]) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FileRows(RowReader):
+    """Row cursor over a ParquetFile (decodes row groups host-side)."""
+
+    def __init__(self, pf):
+        self.pf = pf
+        self.schema = pf.schema
+        self._rg = 0
+        self._iter: Optional[Iterator[Row]] = None
+
+    def _next_group(self) -> bool:
+        from .io.reader import decode_chunk_host
+
+        if self._rg >= len(self.pf.row_groups):
+            return False
+        rg = self.pf.row_group(self._rg)
+        self._rg += 1
+        cols = {}
+        for i, leaf in enumerate(self.schema.leaves):
+            cols[leaf.dotted_path] = decode_chunk_host(rg.column(i))
+        self._iter = rows_from_columns(self.schema, cols, rg.num_rows)
+        return True
+
+    def read_rows(self, n: int) -> List[Row]:
+        out: List[Row] = []
+        while len(out) < n:
+            if self._iter is None and not self._next_group():
+                break
+            assert self._iter is not None
+            got = False
+            for row in self._iter:
+                out.append(row)
+                got = True
+                if len(out) >= n:
+                    break
+            if len(out) < n or not got:
+                self._iter = None
+        return out
+
+    def __iter__(self) -> Iterator[Row]:
+        while True:
+            batch = self.read_rows(1024)
+            if not batch:
+                return
+            yield from batch
+
+
+class BufferRows(RowReader):
+    """RowReader over an in-memory list of rows."""
+
+    def __init__(self, rows: Sequence[Row]):
+        self._rows = list(rows)
+        self._pos = 0
+
+    def read_rows(self, n: int) -> List[Row]:
+        out = self._rows[self._pos:self._pos + n]
+        self._pos += len(out)
+        return list(out)
+
+
+class WriterRows(RowWriter):
+    """RowWriter adapter over a ParquetWriter: buffers rows, flushes row
+    groups at ``row_group_size`` (reference: GenericWriter[T].Write)."""
+
+    def __init__(self, writer, schema: Optional[Schema] = None):
+        self.writer = writer
+        self.schema = schema or writer.schema
+        self._rows: List[Row] = []
+
+    def write_rows(self, rows: List[Row]) -> int:
+        self._rows.extend(rows)
+        limit = self.writer.options.row_group_size
+        while len(self._rows) >= limit:
+            self._flush(self._rows[:limit])
+            self._rows = self._rows[limit:]
+        return len(rows)
+
+    def _flush(self, rows: List[Row]) -> None:
+        if not rows:
+            return
+        columns, n = columns_from_rows(self.schema, rows)
+        self.writer.write_row_group(columns, n)
+
+    def flush(self) -> None:
+        self._flush(self._rows)
+        self._rows = []
+
+    def close(self) -> None:
+        self.flush()
+        self.writer.close()
+
+
+def copy_rows(dst: RowWriter, src: RowReader, batch: int = 4096) -> int:
+    """Stream all rows from ``src`` into ``dst`` (reference: CopyRows)."""
+    total = 0
+    while True:
+        rows = src.read_rows(batch)
+        if not rows:
+            break
+        total += dst.write_rows(rows)
+    if hasattr(dst, "flush"):
+        dst.flush()
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Convenience front ends
+# ---------------------------------------------------------------------------
+
+
+def write_rows(sink, schema: Schema, records: Iterable[Dict[str, Any]],
+               options=None) -> None:
+    """Write an iterable of Python records to a Parquet file via the row
+    path (supports arbitrary nesting)."""
+    from .io.writer import ParquetWriter, WriterOptions
+
+    w = ParquetWriter(sink, schema, options or WriterOptions())
+    rw = WriterRows(w, schema)
+    for rec in records:
+        rw.write_rows([deconstruct(schema, rec)])
+    rw.close()
+
+
+def read_rows(source) -> Iterator[Dict[str, Any]]:
+    """Iterate records from a Parquet file via the row path."""
+    from .io.reader import ParquetFile
+
+    pf = source if hasattr(source, "row_group") else ParquetFile(source)
+    asm = _Assembler(pf.schema)
+    for row in FileRows(pf):
+        yield asm.assemble(row)

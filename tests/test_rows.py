@@ -1,0 +1,257 @@
+"""Row model tests: Dremel deconstruct/reconstruct + row transport.
+
+Covers SURVEY.md §2.1 Value/Row/RowBuilder rows: record shredding to leaf
+slots (def/rep levels) and assembly back, including the deep-nesting shapes
+the columnar path cannot write (lists of lists, optional groups, maps), with
+pyarrow as the interop oracle.
+"""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import rows as R
+from parquet_tpu.format.enums import FieldRepetitionType as Rep, Type
+from parquet_tpu.io.reader import ParquetFile
+from parquet_tpu.io.writer import ParquetWriter, WriterOptions
+from parquet_tpu.schema import schema as S
+from parquet_tpu.schema.types import LogicalKind
+
+
+def _schema_flat():
+    return S.message("row", [
+        S.leaf("a", Type.INT64),
+        S.optional(S.leaf("b", Type.DOUBLE)),
+        S.optional(S.leaf("s", Type.BYTE_ARRAY, logical=LogicalKind.STRING)),
+    ])
+
+
+def _schema_nested():
+    return S.message("row", [
+        S.leaf("id", Type.INT64),
+        S.optional(S.group("meta", [
+            S.optional(S.leaf("name", Type.BYTE_ARRAY, logical=LogicalKind.STRING)),
+            S.leaf("score", Type.DOUBLE),
+        ])),
+        S.list_of("tags", S.optional(S.leaf("t", Type.BYTE_ARRAY,
+                                            logical=LogicalKind.STRING))),
+    ])
+
+
+def _schema_deep():
+    # list of list of int — two repeated levels (not writable columnar-path)
+    inner = S.list_of("inner", S.leaf("e", Type.INT32), repetition=Rep.OPTIONAL)
+    inner.name = "element"
+    lol = S.group("outer_wrap", [], repetition=Rep.OPTIONAL)
+    lol = S.list_of("lol", inner)
+    return S.message("row", [
+        S.leaf("id", Type.INT32),
+        lol,
+        S.map_of("attrs", S.leaf("k", Type.BYTE_ARRAY, logical=LogicalKind.STRING),
+                 S.optional(S.leaf("v", Type.INT64))),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# deconstruct / reconstruct round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_flat_roundtrip():
+    sch = _schema_flat()
+    recs = [
+        {"a": 1, "b": 2.5, "s": "x"},
+        {"a": 2, "b": None, "s": None},
+        {"a": 3, "b": -1.0, "s": "hello"},
+    ]
+    for rec in recs:
+        row = R.deconstruct(sch, rec)
+        assert R.reconstruct(sch, row) == rec
+
+
+def test_nested_optional_group_fidelity():
+    sch = _schema_nested()
+    recs = [
+        {"id": 1, "meta": {"name": "a", "score": 0.5}, "tags": ["x", None, "y"]},
+        {"id": 2, "meta": None, "tags": []},
+        {"id": 3, "meta": {"name": None, "score": 1.0}, "tags": None},
+    ]
+    for rec in recs:
+        row = R.deconstruct(sch, rec)
+        back = R.reconstruct(sch, row)
+        want = dict(rec)
+        if want["tags"] is None:
+            want["tags"] = None
+        assert back["id"] == want["id"]
+        assert back["meta"] == want["meta"]
+        # tags: None (absent list) reconstructs as None; [] as []
+        assert back["tags"] == want["tags"]
+
+
+def test_deep_list_of_lists_and_map():
+    sch = _schema_deep()
+    recs = [
+        {"id": 1, "lol": [[1, 2], [], [3]], "attrs": {"a": 1, "b": None}},
+        {"id": 2, "lol": [], "attrs": {}},
+        {"id": 3, "lol": None, "attrs": {"z": 9}},
+        {"id": 4, "lol": [[], [7]], "attrs": {}},
+    ]
+    for rec in recs:
+        row = R.deconstruct(sch, rec)
+        back = R.reconstruct(sch, row)
+        assert back == rec, f"{rec} -> {back}"
+
+
+def test_levels_match_spec_example():
+    # The canonical Dremel example: optional group with repeated child.
+    sch = S.message("doc", [
+        S.list_of("xs", S.leaf("x", Type.INT32)),
+    ])
+    leaf = sch.leaves[0]
+    assert leaf.max_definition_level == 2  # optional list + repeated element
+    row = R.deconstruct(sch, {"xs": [10, 20]})
+    slots = [(v.value, v.definition_level, v.repetition_level) for v in row]
+    assert slots[0][2] == 0 and slots[1][2] == 1  # first slot rep 0, next rep 1
+
+
+def test_row_builder():
+    sch = _schema_nested()
+    b = R.RowBuilder(sch)
+    row = b.set("id", 7).set("meta.name", "n").set("meta.score", 2.0) \
+           .set("tags", ["a"]).row()
+    rec = R.reconstruct(sch, row)
+    assert rec == {"id": 7, "meta": {"name": "n", "score": 2.0}, "tags": ["a"]}
+
+
+# ---------------------------------------------------------------------------
+# file round-trips via the row path (incl. deep nesting) + pyarrow oracle
+# ---------------------------------------------------------------------------
+
+
+def test_write_rows_flat_pyarrow_oracle():
+    sch = _schema_flat()
+    recs = [{"a": i, "b": float(i) if i % 3 else None,
+             "s": f"s{i}" if i % 2 else None} for i in range(100)]
+    buf = io.BytesIO()
+    R.write_rows(buf, sch, recs, WriterOptions(compression="none"))
+    t = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert t.num_rows == 100
+    assert t.column("a").to_pylist() == [r["a"] for r in recs]
+    assert t.column("s").to_pylist() == [r["s"] for r in recs]
+
+
+def test_write_rows_deep_nesting_pyarrow_oracle():
+    sch = _schema_deep()
+    recs = [
+        {"id": 1, "lol": [[1, 2], [], [3]], "attrs": {"a": 1}},
+        {"id": 2, "lol": [], "attrs": {}},
+        {"id": 3, "lol": None, "attrs": {"z": 9, "w": None}},
+        {"id": 4, "lol": [[], [7, 8, 9]], "attrs": {}},
+    ]
+    buf = io.BytesIO()
+    R.write_rows(buf, sch, recs, WriterOptions(compression="none",
+                                               dictionary=False))
+    t = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert t.column("id").to_pylist() == [1, 2, 3, 4]
+    assert t.column("lol").to_pylist() == [
+        [[1, 2], [], [3]], [], None, [[], [7, 8, 9]]]
+    got_attrs = t.column("attrs").to_pylist()
+    assert got_attrs[0] == [("a", 1)]
+    assert got_attrs[2] == [("z", 9), ("w", None)] or \
+        got_attrs[2] == [("w", None), ("z", 9)]
+
+
+def test_read_rows_back_from_own_file():
+    sch = _schema_deep()
+    recs = [
+        {"id": 1, "lol": [[1, 2], [], [3]], "attrs": {"a": 1, "b": None}},
+        {"id": 2, "lol": [], "attrs": {}},
+        {"id": 3, "lol": None, "attrs": {"z": 9}},
+    ]
+    buf = io.BytesIO()
+    R.write_rows(buf, sch, recs, WriterOptions(compression="snappy"))
+    back = list(R.read_rows(buf.getvalue()))
+    assert back == recs
+
+
+def test_read_rows_from_pyarrow_file():
+    t = pa.table({
+        "x": pa.array([1, 2, None, 4], pa.int64()),
+        "name": pa.array(["a", None, "c", "d"]),
+        "xs": pa.array([[1, 2], None, [], [5]], pa.list_(pa.int32())),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression="snappy")
+    back = list(R.read_rows(buf.getvalue()))
+    assert [r["x"] for r in back] == [1, 2, None, 4]
+    assert [r["name"] for r in back] == ["a", None, "c", "d"]
+    assert [r["xs"] for r in back] == [[1, 2], None, [], [5]]
+
+
+def test_copy_rows_transport():
+    sch = _schema_flat()
+    recs = [{"a": i, "b": float(i), "s": str(i)} for i in range(2500)]
+    rows = [R.deconstruct(sch, r) for r in recs]
+    buf = io.BytesIO()
+    w = ParquetWriter(buf, sch, WriterOptions(row_group_size=1000,
+                                              compression="none"))
+    n = R.copy_rows(R.WriterRows(w), R.BufferRows(rows))
+    w.close()
+    assert n == 2500
+    pf = ParquetFile(buf.getvalue())
+    assert len(pf.row_groups) == 3  # 1000 + 1000 + 500
+    back = list(R.read_rows(pf))
+    assert [r["a"] for r in back] == list(range(2500))
+
+
+def test_file_rows_reader_batching():
+    sch = _schema_flat()
+    recs = [{"a": i, "b": None, "s": None} for i in range(50)]
+    buf = io.BytesIO()
+    R.write_rows(buf, sch, recs, WriterOptions(compression="none"))
+    fr = R.FileRows(ParquetFile(buf.getvalue()))
+    first = fr.read_rows(20)
+    rest = fr.read_rows(1000)
+    assert len(first) == 20 and len(rest) == 30
+    assert fr.read_rows(10) == []
+
+
+def test_unsigned_int_roundtrip():
+    # regression: read path must reinterpret INT(signed=False) as unsigned
+    sch = S.message("row", [
+        S.leaf("u32", Type.INT32, logical=LogicalKind.INT, bit_width=32,
+               signed=False),
+        S.leaf("u64", Type.INT64, logical=LogicalKind.INT, bit_width=64,
+               signed=False),
+    ])
+    recs = [{"u32": 3_000_000_000, "u64": 2**63 + 17},
+            {"u32": 0, "u64": 0}]
+    buf = io.BytesIO()
+    R.write_rows(buf, sch, recs, WriterOptions(compression="none",
+                                               dictionary=False))
+    assert list(R.read_rows(buf.getvalue())) == recs
+    t = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert t.column("u32").to_pylist() == [3_000_000_000, 0]
+
+
+def test_map_strict_form_accepted():
+    sch = _schema_deep()
+    strict = {"id": 1, "lol": [],
+              "attrs": {"key_value": [{"key": "a", "value": 5}]}}
+    sugar = {"id": 1, "lol": [], "attrs": {"a": 5}}
+    assert R.deconstruct(sch, strict) == R.deconstruct(sch, sugar)
+
+
+def test_value_model():
+    sch = _schema_flat()
+    row = R.deconstruct(sch, {"a": 5, "b": None, "s": "q"})
+    vals = row.for_column(1)
+    assert len(vals) == 1 and vals[0].is_null
+    assert vals[0].definition_level == 0
+    a = row.for_column(0)[0]
+    assert a.value == 5 and a.definition_level == 0 and a.repetition_level == 0
+    s = row.for_column(2)[0]
+    assert s.definition_level == 1
