@@ -57,6 +57,14 @@ def convert_column_data(rg: RowGroupReader, dst_leaf: Leaf,
         src_leaf = src_schema.leaf(dst_leaf.path)
     except KeyError:
         src_leaf = None
+    if (src_leaf is not None
+            and src_leaf.max_repetition_level != dst_leaf.max_repetition_level):
+        # same name but different nesting structure (e.g. list vs flat) is a
+        # conversion error, not a missing column
+        raise TypeError(
+            f"cannot convert {dst_leaf.dotted_path!r}: source is nested "
+            f"depth {src_leaf.max_repetition_level}, target depth "
+            f"{dst_leaf.max_repetition_level}")
     if src_leaf is None:
         if dst_leaf.max_definition_level == 0:
             raise TypeError(
@@ -84,14 +92,29 @@ def column_to_data(col: Column, src: Leaf, dst: Optional[Leaf] = None) -> Column
         host_dt = np.float64 if src.physical_type == Type.DOUBLE else np.int64
         values = np.ascontiguousarray(values).view(host_dt).reshape(-1)
     list_offsets = list_validity = None
+    def_levels = rep_levels = None
     if col.list_offsets:
         if len(col.list_offsets) > 1:
-            raise NotImplementedError("conversion of multi-level lists")
-        list_offsets = np.asarray(col.list_offsets[0], np.int64)
-        lv = col.list_validity[0]
-        list_validity = None if lv is None or bool(np.all(lv)) else np.asarray(lv)
+            # arbitrary-depth nesting: pass the Dremel level streams through
+            # verbatim (ColumnData's raw-level path bypasses _build_levels);
+            # widening never changes structure, so levels are reusable as-is
+            if (dst is not None
+                    and (src.max_definition_level != dst.max_definition_level
+                         or src.max_repetition_level != dst.max_repetition_level)):
+                raise TypeError(
+                    f"cannot convert {src.dotted_path!r}: nesting structure differs")
+            if col.def_levels is None or col.rep_levels is None:
+                raise ValueError(
+                    "multi-level list conversion needs raw def/rep levels on the Column")
+            def_levels = np.asarray(col.def_levels)
+            rep_levels = np.asarray(col.rep_levels)
+        else:
+            list_offsets = np.asarray(col.list_offsets[0], np.int64)
+            lv = col.list_validity[0]
+            list_validity = None if lv is None or bool(np.all(lv)) else np.asarray(lv)
     return ColumnData(values=values, offsets=offsets, validity=validity,
-                      list_offsets=list_offsets, list_validity=list_validity)
+                      list_offsets=list_offsets, list_validity=list_validity,
+                      def_levels=def_levels, rep_levels=rep_levels)
 
 
 def convert_table(pf_or_rg, target: Schema):

@@ -216,10 +216,16 @@ def concat_columns(parts: List[Column]) -> Column:
                 for p in parts]))
         else:
             list_validity.append(None)
+    def_levels = rep_levels = None
+    if all(p.def_levels is not None for p in parts):
+        def_levels = np.concatenate([np.asarray(p.def_levels) for p in parts])
+    if all(p.rep_levels is not None for p in parts):
+        rep_levels = np.concatenate([np.asarray(p.rep_levels) for p in parts])
     return Column(leaf=first.leaf, values=values, offsets=offsets,
                   validity=validity, list_offsets=list_offsets,
                   list_validity=list_validity,
-                  num_slots=sum(p.num_slots for p in parts))
+                  num_slots=sum(p.num_slots for p in parts),
+                  def_levels=def_levels, rep_levels=rep_levels)
 
 
 def _be_bytes_to_int(vals: np.ndarray) -> np.ndarray:

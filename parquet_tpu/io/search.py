@@ -19,7 +19,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..format import metadata as md
-from ..format.enums import BoundaryOrder
+from ..format.enums import BoundaryOrder, Type
 from ..schema.schema import Leaf
 from .reader import ColumnChunkReader, ParquetFile, RowGroupReader
 from .statistics import decode_stat_value
@@ -182,11 +182,15 @@ def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
 def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
                    device: bool = False):
     """Decode only the pages covering [row_start, row_start+row_count) of one
-    column, trimming to the exact rows.  Returns a host numpy array (flat
-    columns) — the SeekToRow-then-read flow of SURVEY.md §3.3."""
+    column, trimming to the exact rows — the SeekToRow-then-read flow of
+    SURVEY.md §3.3.  Flat columns return a host numpy array (or list of bytes
+    for BYTE_ARRAY); nested columns return a :class:`Column` whose
+    ``to_arrow()`` yields exactly the requested rows."""
+    from .column import concat_columns
     from .reader import decode_chunk_host
 
     leaf = pf.schema.leaf(path)
+    nested = leaf.max_repetition_level > 0
     out_parts = []
     remaining_start = row_start
     remaining = row_count
@@ -207,24 +211,74 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
             i0 = max(bisect_right(firsts, remaining_start) - 1, 0)
             first_row_of_pages = firsts[i0]
         col = decode_chunk_host(chunk, pages=iter(pages))
-        vals = _trim_flat(col, remaining_start - first_row_of_pages, take)
-        out_parts.append(vals)
+        trim = _trim_nested if nested else _trim_flat
+        out_parts.append(trim(col, remaining_start - first_row_of_pages, take))
         remaining_start = 0
         remaining -= take
     if not out_parts:
-        return np.empty(0)
-    return np.concatenate(out_parts) if len(out_parts) > 1 else out_parts[0]
+        if not nested:
+            return np.empty(0)
+        from ..ops import levels as levels_ops
+        from .column import Column
+
+        empty_lv = np.zeros(0, np.int32)
+        asm = levels_ops.assemble(empty_lv, empty_lv, leaf)
+        return Column(leaf=leaf, values=np.empty(0, leaf.np_dtype() or np.uint8),
+                      offsets=(np.zeros(1, np.int32)
+                               if leaf.physical_type == Type.BYTE_ARRAY else None),
+                      validity=asm.validity, list_offsets=asm.list_offsets,
+                      list_validity=asm.list_validity, num_slots=0,
+                      def_levels=empty_lv, rep_levels=empty_lv)
+    if nested:
+        return concat_columns(out_parts)
+    if len(out_parts) == 1:
+        return out_parts[0]
+    if isinstance(out_parts[0], list):  # BYTE_ARRAY rows come back as lists
+        return [v for part in out_parts for v in part]
+    return np.concatenate(out_parts)
+
+
+def _trim_nested(col, offset: int, count: int):
+    """Slice ``count`` rows starting at ``offset`` out of a decoded nested
+    column: rows begin where ``rep == 0``, so slice the Dremel level streams
+    at row boundaries, slice the dense values to the matching span, and
+    re-assemble list structure for just those rows."""
+    from ..ops import levels as levels_ops
+    from .column import Column
+
+    rep = np.asarray(col.rep_levels)
+    d = np.asarray(col.def_levels)
+    leaf = col.leaf
+    row_starts = np.flatnonzero(rep == 0)
+    nrows = len(row_starts)
+    s0 = int(row_starts[offset]) if offset < nrows else len(rep)
+    s1 = int(row_starts[offset + count]) if offset + count < nrows else len(rep)
+    present = d == leaf.max_definition_level
+    vstart = int(np.count_nonzero(present[:s0]))
+    vend = vstart + int(np.count_nonzero(present[s0:s1]))
+    if col.is_dictionary_encoded():
+        col.materialize_host()
+    values = np.asarray(col.values)
+    if col.offsets is not None:
+        offs = np.asarray(col.offsets, np.int64)
+        new_values = values[offs[vstart] : offs[vend]]
+        new_offsets = (offs[vstart : vend + 1] - offs[vstart]).astype(np.int32)
+    else:
+        new_values = values[vstart:vend]  # first axis is the value ordinal
+        new_offsets = None
+    dd, rr = d[s0:s1], rep[s0:s1]
+    asm = levels_ops.assemble(dd, rr, leaf)
+    return Column(leaf=leaf, values=new_values, offsets=new_offsets,
+                  validity=asm.validity, list_offsets=asm.list_offsets,
+                  list_validity=asm.list_validity, num_slots=len(dd),
+                  def_levels=dd, rep_levels=rr)
 
 
 def _trim_flat(col, offset: int, count: int):
     """Slice ``count`` rows starting at ``offset`` out of a decoded flat column."""
-    if col.leaf.max_repetition_level:
-        raise NotImplementedError("row-range reads on nested columns")
     validity = None if col.validity is None else np.asarray(col.validity)
     values = np.asarray(col.values)
     if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
-        from ..format.enums import Type
-
         dt = np.float64 if col.leaf.physical_type == Type.DOUBLE else np.int64
         values = np.ascontiguousarray(values).view(dt).reshape(-1)
     if validity is None:

@@ -820,15 +820,29 @@ def _column_from_arrow(arr, leaf: Leaf) -> ColumnData:
 
     t = arr.type
     if pa.types.is_list(t) or pa.types.is_large_list(t):
-        lv = None
-        if arr.null_count:
-            lv = ~np.asarray(arr.is_null())
-        offs = np.asarray(arr.offsets, dtype=np.int64)
-        # arrow offsets may not start at 0 after slicing; normalize via flatten
-        child = arr.values
-        inner = _column_from_arrow(child, leaf)
-        inner.list_offsets = offs - offs[0]
-        inner.list_validity = lv
+        # walk the (possibly multi-level) list chain collecting per-level
+        # offsets/validity, then emit either the single-level ColumnData form
+        # or raw Dremel levels (levels_for_nested) for depth > 1
+        offsets_per_level, validity_per_level = [], []
+        a = arr
+        while pa.types.is_list(a.type) or pa.types.is_large_list(a.type):
+            lv = ~np.asarray(a.is_null()) if a.null_count else None
+            raw = np.asarray(a.offsets, dtype=np.int64)
+            child = a.values
+            if raw[0] != 0 or len(child) != raw[-1]:  # sliced parent array
+                child = child.slice(raw[0], raw[-1] - raw[0])
+            offsets_per_level.append(raw - raw[0])
+            validity_per_level.append(lv)
+            a = child
+        inner = _column_from_arrow(a, leaf)
+        if len(offsets_per_level) == 1:
+            inner.list_offsets = offsets_per_level[0]
+            inner.list_validity = validity_per_level[0]
+            return inner
+        d, r = levels_ops.levels_for_nested(
+            offsets_per_level, validity_per_level, inner.validity, leaf)
+        inner.def_levels = d
+        inner.rep_levels = r
         return inner
     validity = None
     if arr.null_count:

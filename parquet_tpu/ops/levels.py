@@ -194,3 +194,68 @@ def _ranges(lengths: np.ndarray) -> np.ndarray:
     starts[0] = 0
     np.cumsum(lengths[:-1], out=starts[1:])
     return np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)
+
+
+def levels_for_nested(list_offsets: List[np.ndarray],
+                      list_validity: List[Optional[np.ndarray]],
+                      elem_validity: Optional[np.ndarray], leaf: Leaf
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Def/rep levels for an arbitrary-depth chain of LIST levels.
+
+    ``list_offsets[k]`` / ``list_validity[k]`` describe repeated level k,
+    outermost first (the layout :func:`assemble` produces and Arrow nested
+    ListArrays map to); ``elem_validity`` masks the innermost elements.
+    Built bottom-up: start from one slot per innermost element, then per list
+    level stitch element slot-streams together, synthesizing one slot for each
+    empty (def = d_k - 1) or null (def = d_k - 2) list and marking the first
+    slot of each *continuing* element with rep = r_k.  Assumes the standard
+    wrapper-group+repeated pattern ``list_of``/``map_of``/Arrow produce (no
+    extra optional struct layers between repeated levels).
+    """
+    infos = repeated_ancestors(leaf)
+    nlev = len(infos)
+    assert nlev == len(list_offsets) == len(list_validity)
+    max_def = leaf.max_definition_level
+    # innermost elements: one slot each (canonical layout: null lists have
+    # zero-length ranges, so the innermost offsets' end == element count)
+    n_inner = int(list_offsets[-1][-1]) if len(list_offsets[-1]) else 0
+    d = np.full(n_inner, max_def, dtype=np.int32)
+    if elem_validity is not None and max_def > infos[-1].def_level:
+        d[~np.asarray(elem_validity, dtype=bool)] = max_def - 1
+    r = np.full(n_inner, infos[-1].rep_level, dtype=np.int32)  # provisional
+    counts = np.ones(n_inner, dtype=np.int64)  # slots per element of this level
+    for k in range(nlev - 1, -1, -1):
+        rk, dk = infos[k].rep_level, infos[k].def_level
+        offs = np.asarray(list_offsets[k], dtype=np.int64)
+        lv = list_validity[k]
+        n_inst = len(offs) - 1
+        elem_starts = np.zeros(len(counts), dtype=np.int64)
+        if len(counts) > 1:
+            np.cumsum(counts[:-1], out=elem_starts[1:])
+        # every element's first slot continues the level-k list …
+        r[elem_starts] = rk
+        # … except the first element of each non-empty instance (parent sets it)
+        nonempty = offs[1:] > offs[:-1]
+        if lv is not None:
+            nonempty &= np.asarray(lv, dtype=bool)
+        # instance slot spans in the current stream
+        starts_ext = np.concatenate([elem_starts, [len(d)]])
+        inst_start = starts_ext[offs[:-1]]
+        inst_counts = starts_ext[offs[1:]] - inst_start
+        # synthesize one slot per empty/null instance
+        synth = ~nonempty
+        if synth.any():
+            pos = inst_start[synth]
+            sdef = np.full(int(synth.sum()), dk - 1, dtype=np.int32)
+            if lv is not None:
+                sdef[~np.asarray(lv, dtype=bool)[synth]] = dk - 2
+            d = np.insert(d, pos, sdef)
+            r = np.insert(r, pos, np.int32(rk))  # provisional; parent overwrites
+            inst_counts = np.where(synth, 1, inst_counts)
+        counts = inst_counts
+        if k == 0:
+            inst_firsts = np.zeros(n_inst, dtype=np.int64)
+            if n_inst > 1:
+                np.cumsum(counts[:-1], out=inst_firsts[1:])
+            r[inst_firsts] = 0
+    return d, r

@@ -404,10 +404,20 @@ def _payload(skel: Any, node: Node):
     return skel
 
 
+def _assembler_of(schema: Schema) -> "_Assembler":
+    """Per-schema cached assembler (mirror of :func:`_shredder_of` on the
+    read side — rebuilding the chains/subtree cache per record is overhead)."""
+    a = getattr(schema, "_row_assembler", None)
+    if a is None or a.schema is not schema:
+        a = _Assembler(schema)
+        schema._row_assembler = a
+    return a
+
+
 def reconstruct(schema: Schema, row: Row) -> Dict[str, Any]:
     """Assemble one :class:`Row` of leaf slots back into a record (Dremel
     decode) — the inverse of :func:`deconstruct`."""
-    return _Assembler(schema).assemble(row)
+    return _assembler_of(schema).assemble(row)
 
 
 # ---------------------------------------------------------------------------
@@ -496,9 +506,15 @@ def _dense_values(leaf: Leaf, present: List[Any]):
         return values, offsets
     if phys == Type.FIXED_LEN_BYTE_ARRAY:
         w = leaf.type_length or 0
-        buf = b"".join(
-            (v.encode("utf-8") if isinstance(v, str) else bytes(v)).ljust(w, b"\0")
-            for v in present)
+        parts = []
+        for v in present:
+            b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+            if len(b) > w:
+                raise ValueError(
+                    f"field {leaf.dotted_path!r}: FIXED_LEN_BYTE_ARRAY({w}) "
+                    f"value has {len(b)} bytes")
+            parts.append(b.ljust(w, b"\0"))
+        buf = b"".join(parts)
         return np.frombuffer(buf, np.uint8).reshape(-1, w).copy(), None
     if phys == Type.INT96:
         arr = np.zeros((len(present), 3), np.uint32)

@@ -127,7 +127,16 @@ class Schema:
     def leaf(self, path) -> Leaf:
         if isinstance(path, str):
             path = tuple(path.split("."))
-        return self._by_path[tuple(path)]
+        path = tuple(path)
+        hit = self._by_path.get(path)
+        if hit is not None:
+            return hit
+        # a group prefix (e.g. the list column name without ".list.element")
+        # resolves when it names exactly one leaf
+        under = [l for l in self.leaves if l.path[: len(path)] == path]
+        if len(under) == 1:
+            return under[0]
+        raise KeyError(path)
 
     def __len__(self):
         return len(self.leaves)

@@ -105,6 +105,42 @@ def test_sorting_writer_spill(rng):
     assert pf.row_group(0).sorting_columns[0].column_idx == 0
 
 
+def test_convert_multilevel_list_widen(rng):
+    rows = [None if i % 13 == 7
+            else [[int(v) for v in rng.integers(0, 50, j % 3)]
+                  if j % 5 != 4 else None
+                  for j in range(i % 4)]
+            for i in range(400)]
+    t = pa.table({"m": pa.array(rows, type=pa.list_(pa.list_(pa.int32())))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    target = sch.message("schema", [
+        sch.list_of("m", sch.list_of("list2", sch.leaf("element", Type.INT64,
+                                                       sch.Rep.OPTIONAL))),
+    ])
+    (cols, n), = convert_table(pf, target)
+    (path, cd), = cols.items()
+    assert cd.values.dtype == np.int64
+    assert cd.def_levels is not None and cd.rep_levels is not None
+    out = io.BytesIO()
+    w = ParquetWriter(out, target, WriterOptions())
+    w.write_row_group(cols, n)
+    w.close()
+    got = pq.read_table(io.BytesIO(out.getvalue()))
+    assert got.column(0).to_pylist() == rows
+
+
+def test_convert_structure_mismatch_raises(rng):
+    t = pa.table({"a": pa.array([[1, 2], [3]], type=pa.list_(pa.int64()))})
+    buf = io.BytesIO()
+    write_table(t, buf)
+    pf = ParquetFile(buf.getvalue())
+    target = sch.message("schema", [sch.leaf("a", Type.INT64, sch.Rep.OPTIONAL)])
+    with pytest.raises(TypeError, match="nested"):
+        convert_table(pf, target)
+
+
 def test_convert_widen_and_missing(rng):
     t = pa.table({"a": pa.array(rng.integers(0, 100, 500).astype(np.int32)),
                   "b": pa.array(rng.random(500, dtype=np.float32))})

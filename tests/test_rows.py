@@ -164,6 +164,15 @@ def test_write_rows_deep_nesting_pyarrow_oracle():
         got_attrs[2] == [("w", None), ("z", 9)]
 
 
+def test_write_rows_flba_overflow_rejected():
+    sch = S.message("m", [S.leaf("f", Type.FIXED_LEN_BYTE_ARRAY,
+                                 S.Rep.OPTIONAL, type_length=4)])
+    buf = io.BytesIO()
+    with pytest.raises(ValueError, match="'f'.*4"):
+        R.write_rows(buf, sch, [{"f": b"12345678"}, {"f": b"abcd"}],
+                     WriterOptions(compression="none"))
+
+
 def test_read_rows_back_from_own_file():
     sch = _schema_deep()
     recs = [

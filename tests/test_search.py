@@ -112,6 +112,37 @@ def test_read_row_range_with_nulls():
     np.testing.assert_array_equal(got, expect)
 
 
+def test_read_row_range_nested():
+    rows = [None if i % 11 == 3
+            else [j if j % 5 else None for j in range(i % 4)]
+            for i in range(30000)]
+    t = pa.table({"xs": pa.array(rows, type=pa.list_(pa.int64()))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(data_page_size=4 * 1024, dictionary=False,
+                                      row_group_size=12000))
+    pf = ParquetFile(buf.getvalue())
+    for start, count in [(0, 7), (12345, 678), (11990, 30), (29995, 5)]:
+        col = read_row_range(pf, "xs", start, count)
+        got = col.to_arrow().to_pylist()
+        assert got == rows[start : start + count]
+        # raw levels survive (incl. multi-row-group concat) for the row model
+        assert col.def_levels is not None and col.rep_levels is not None
+    # empty / past-EOF ranges still honor the Column contract
+    empty = read_row_range(pf, "xs", 10**9, 5)
+    assert empty.to_arrow().to_pylist() == []
+    assert read_row_range(pf, "xs", 5, 0).to_arrow().to_pylist() == []
+
+
+def test_read_row_range_nested_strings():
+    rows = [[f"s{i}-{j}" for j in range(i % 3)] for i in range(20000)]
+    t = pa.table({"ss": pa.array(rows, type=pa.list_(pa.string()))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(data_page_size=4 * 1024, dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    col = read_row_range(pf, "ss", 15000, 25)
+    assert col.to_arrow().to_pylist() == rows[15000:15025]
+
+
 def test_pushdown_against_pyarrow_file():
     """Our pushdown works on files written by pyarrow too."""
     t = pa.table({"x": pa.array(np.arange(50000, dtype=np.int64))})

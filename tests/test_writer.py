@@ -126,6 +126,27 @@ def test_lists(rng):
     _self_equal(raw, t)
 
 
+def test_nested_lists_deep(rng):
+    """Multi-level lists through the columnar write path (levels_for_nested)."""
+    def inner(i, j):
+        return [int(v) for v in range(j % 4)] if (i + j) % 7 else None
+
+    rows2 = [None if i % 11 == 3 else [inner(i, j) for j in range(i % 4)]
+             for i in range(3000)]
+    rows3 = [[[[f"d{i}-{k}"] * (k % 3) if k % 5 else None for k in range(j % 3)]
+              for j in range(i % 3)] if i % 9 else ([] if i % 2 else None)
+             for i in range(3000)]
+    t = pa.table({
+        "ll": pa.array(rows2, type=pa.list_(pa.list_(pa.int64()))),
+        "lll": pa.array(rows3, type=pa.list_(pa.list_(pa.list_(pa.string())))),
+    })
+    raw = _write(t)
+    _pyarrow_equal(raw, t)
+    # multiple row groups + small pages stress the slicing path too
+    raw = _write(t, row_group_size=700, data_page_size=2048, dictionary=False)
+    _pyarrow_equal(raw, t)
+
+
 def test_multiple_pages_and_row_groups(rng):
     t = pa.table({"x": pa.array(np.arange(100000, dtype=np.int64))})
     buf = io.BytesIO()
