@@ -26,6 +26,8 @@ _tried = False
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
 _u8p_w = np.ctypeslib.ndpointer(np.uint8, flags=("C_CONTIGUOUS", "WRITEABLE"))
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p_w = np.ctypeslib.ndpointer(np.int64, flags=("C_CONTIGUOUS", "WRITEABLE"))
 
 
 def _build() -> bool:
@@ -61,6 +63,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_plain_byte_array.restype = ctypes.c_int64
         lib.pq_plain_byte_array.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _i64p, ctypes.c_void_p]
+        lib.pq_assemble_levels.restype = ctypes.c_int64
+        lib.pq_assemble_levels.argtypes = [
+            _i32p, _i32p, ctypes.c_int64, _i32p, _i32p, ctypes.c_int32,
+            ctypes.c_int32, _i64p_w, _u8p_w, _i64p_w, _u8p_w]
+        lib.pq_expand_runs.restype = ctypes.c_int64
+        lib.pq_expand_runs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_void_p, _i64p,
+            _i64p, np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32, flags=("C_CONTIGUOUS", "WRITEABLE")),
+            ctypes.c_int64]
         lib.pq_scan_rle_runs.restype = ctypes.c_int64
         lib.pq_scan_rle_runs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
@@ -100,6 +113,51 @@ def plain_byte_array(buf: np.ndarray, n: int):
     lib.pq_plain_byte_array(buf.ctypes.data, len(buf), n, offsets,
                             values.ctypes.data)
     return values[:total], offsets.astype(np.int32)
+
+
+def assemble_levels(defs: np.ndarray, reps: np.ndarray, ks, dks, max_def: int):
+    """Dremel assembly: returns (list_offsets, list_validity, leaf_validity)
+    per repeated level, or None when the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(defs)
+    nlev = len(ks)
+    defs = np.ascontiguousarray(defs, np.int32)
+    reps = np.ascontiguousarray(reps, np.int32)
+    offsets_flat = np.empty(nlev * (n + 1), np.int64)
+    valid_flat = np.empty(max(nlev * n, 1), np.uint8)
+    inst_counts = np.empty(nlev, np.int64)
+    leaf_valid = np.empty(max(n, 1), np.uint8)
+    leaf_count = lib.pq_assemble_levels(
+        defs, reps, n, np.ascontiguousarray(ks, np.int32),
+        np.ascontiguousarray(dks, np.int32), nlev, max_def,
+        offsets_flat, valid_flat, inst_counts, leaf_valid)
+    offsets, validity = [], []
+    for i in range(nlev):
+        c = int(inst_counts[i])
+        offsets.append(offsets_flat[i * (n + 1) : i * (n + 1) + c + 1].copy())
+        validity.append(valid_flat[i * n : i * n + c].astype(bool))
+    return offsets, validity, leaf_valid[:leaf_count].astype(bool)
+
+
+def expand_runs(buf: np.ndarray, ends: np.ndarray, kinds: np.ndarray,
+                payloads: np.ndarray, bit_offsets: np.ndarray,
+                widths: np.ndarray, n: int):
+    """Expand a merged RLE/bit-packed run table to int32 values (host)."""
+    lib = get_lib()
+    if lib is None or n == 0:
+        return None
+    buf = np.ascontiguousarray(buf)
+    kinds = np.ascontiguousarray(kinds, dtype=np.uint8)
+    out = np.empty(n, dtype=np.int32)
+    wrote = lib.pq_expand_runs(
+        buf.ctypes.data if len(buf) else None, len(buf),
+        np.ascontiguousarray(ends, np.int64), kinds.ctypes.data,
+        np.ascontiguousarray(payloads, np.int64),
+        np.ascontiguousarray(bit_offsets, np.int64),
+        np.ascontiguousarray(widths, np.int32), len(kinds), out, n)
+    return out[:wrote]
 
 
 def scan_rle_runs(buf: np.ndarray, n: int, bit_width: int):

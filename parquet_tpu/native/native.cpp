@@ -45,6 +45,84 @@ int64_t pq_plain_byte_array(const uint8_t* data, int64_t size, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// Expand a merged run table (host twin of the device rle_expand kernel, used
+// for nested-column level streams that the host record assembler consumes).
+// Runs tile the output contiguously: run i covers [ends[i-1], ends[i]).
+// Returns values written.
+// ---------------------------------------------------------------------------
+int64_t pq_expand_runs(const uint8_t* buf, int64_t buf_len, const int64_t* ends,
+                       const uint8_t* kinds, const int64_t* payloads,
+                       const int64_t* bit_offsets, const int32_t* widths,
+                       int64_t nruns, int32_t* out, int64_t n) {
+  int64_t pos = 0;
+  for (int64_t i = 0; i < nruns && pos < n; ++i) {
+    int64_t cnt = ends[i] - pos;
+    if (cnt > n - pos) cnt = n - pos;
+    if (cnt <= 0) continue;
+    if (kinds[i] == 0) {
+      const int32_t v = (int32_t)payloads[i];
+      for (int64_t j = 0; j < cnt; ++j) out[pos + j] = v;
+    } else {
+      const int32_t w = widths[i];
+      const uint64_t mask = (w >= 64) ? ~0ull : ((1ull << w) - 1);
+      int64_t bit = bit_offsets[i];
+      for (int64_t j = 0; j < cnt; ++j) {
+        const int64_t byte0 = bit >> 3;
+        uint64_t word = 0;
+        if (byte0 + 8 <= buf_len) {
+          std::memcpy(&word, buf + byte0, 8);
+        } else {
+          for (int b = 0; b < 8 && byte0 + b < buf_len; ++b)
+            word |= (uint64_t)buf[byte0 + b] << (8 * b);
+        }
+        out[pos + j] = (int32_t)((word >> (bit & 7)) & mask);
+        bit += w;
+      }
+    }
+    pos += cnt;
+  }
+  return pos;
+}
+
+// ---------------------------------------------------------------------------
+// Dremel record assembly: def/rep level streams → per-repeated-level
+// (offsets, validity) + leaf validity, single pass per level.
+// ks/dks: rep and def level of each repeated ancestor, outermost first.
+// offsets_flat: nlev*(n+1) i64; valid_flat: nlev*n u8; inst_counts: nlev i64.
+// leaf_valid: n u8.  Returns leaf element count.
+// ---------------------------------------------------------------------------
+int64_t pq_assemble_levels(const int32_t* defs, const int32_t* reps, int64_t n,
+                           const int32_t* ks, const int32_t* dks, int32_t nlev,
+                           int32_t max_def, int64_t* offsets_flat,
+                           uint8_t* valid_flat, int64_t* inst_counts,
+                           uint8_t* leaf_valid) {
+  for (int32_t i = 0; i < nlev; ++i) {
+    const int32_t k = ks[i], dk = dks[i];
+    const int32_t dprev = (i > 0) ? dks[i - 1] : INT32_MIN;
+    const int32_t knext = (i + 1 < nlev) ? ks[i + 1] : INT32_MAX;
+    int64_t* offs = offsets_flat + (int64_t)i * (n + 1);
+    uint8_t* val = valid_flat + (int64_t)i * n;
+    int64_t ninst = 0, elems = 0;
+    for (int64_t j = 0; j < n; ++j) {
+      const int32_t dj = defs[j], rj = reps[j];
+      if (rj < k && dj >= dprev) {
+        offs[ninst] = elems;
+        val[ninst] = dj >= dk - 1;
+        ninst++;
+      }
+      if (rj < knext && dj >= dk) elems++;
+    }
+    offs[ninst] = elems;
+    inst_counts[i] = ninst;
+  }
+  const int32_t dr = dks[nlev - 1];
+  int64_t cnt = 0;
+  for (int64_t j = 0; j < n; ++j)
+    if (defs[j] >= dr) leaf_valid[cnt++] = defs[j] == max_def;
+  return cnt;
+}
+
+// ---------------------------------------------------------------------------
 // RLE/bit-packed hybrid run scan (the host half of the two-pass split).
 // Outputs one row per run; returns run count, or -1 on malformed input.
 // Caller sizes outputs to n (a run covers >= 1 value).

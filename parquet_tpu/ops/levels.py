@@ -95,6 +95,14 @@ def assemble(def_levels: Optional[np.ndarray], rep_levels: Optional[np.ndarray],
     r = rep_levels if rep_levels is not None else np.zeros(0, dtype=np.int32)
     infos = repeated_ancestors(leaf)
     nlev = len(infos)
+    if len(d) == len(r):
+        from .. import native
+
+        nat = native.assemble_levels(d, r, [i.rep_level for i in infos],
+                                     [i.def_level for i in infos], max_def)
+        if nat is not None:
+            return Assembled(validity=nat[2], list_offsets=nat[0],
+                             list_validity=nat[1])
     offsets: List[np.ndarray] = []
     validities: List[Optional[np.ndarray]] = []
     for i, info in enumerate(infos):

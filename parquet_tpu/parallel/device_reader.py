@@ -44,6 +44,7 @@ from ..io.column import Column
 from ..io.reader import ColumnChunkReader, CorruptedError, decode_chunk_host, _bit_width
 from ..ops import device as dev, levels as levels_ops, ref
 from ..utils.debug import counters
+from .. import native
 
 _FIXED_WIDTH = {Type.INT32: 4, Type.INT64: 8, Type.FLOAT: 4, Type.DOUBLE: 8,
                 Type.INT96: 12}
@@ -96,19 +97,87 @@ class _RunTable:
         widths = np.concatenate(self.widths)
         return dev.rle_expand(dbuf, n, ends, kinds, payloads, offs, widths)
 
+    def expand_host(self, buf: np.ndarray, n: Optional[int] = None) -> np.ndarray:
+        """Numpy twin of :meth:`expand` over the host copy of the byte stream.
+
+        Used for nested columns, whose level streams are consumed by the host
+        record assembler — expanding there avoids a D2H sync of data that is
+        metadata-sized to begin with."""
+        n = n or self.total
+        ends = np.concatenate(self.ends).astype(np.int64)
+        kinds = np.concatenate(self.kinds)
+        payloads = np.concatenate(self.payloads).astype(np.int64)
+        offs = np.concatenate(self.bit_offsets).astype(np.int64)
+        widths = np.concatenate(self.widths).astype(np.int64)
+        out = native.expand_runs(buf, ends, kinds, payloads, offs,
+                                 widths.astype(np.int32), n)
+        if out is not None:
+            return out
+        if len(widths) and widths.max() > 24:
+            # rare wide levels: per-run loop (a 4-byte gather window below
+            # only covers widths <= 25 at arbitrary bit phase)
+            out = np.empty(n, np.int32)
+            pos = 0
+            for i in range(len(kinds)):
+                cnt = min(int(ends[i]) - pos, n - pos)
+                if cnt <= 0:
+                    continue
+                if kinds[i] == 0:
+                    out[pos : pos + cnt] = payloads[i]
+                else:
+                    bit0 = int(offs[i])
+                    out[pos : pos + cnt] = ref.unpack_bits(
+                        buf[bit0 // 8 :], cnt, int(widths[i]), bit0 % 8)
+                pos += cnt
+            return out[:pos]
+        starts = np.concatenate([np.zeros(1, np.int64), ends[:-1]])
+        counts = np.maximum(np.minimum(ends, n) - starts, 0)
+        rid = np.repeat(np.arange(len(kinds)), counts)
+        pos = np.arange(int(counts.sum()), dtype=np.int64)
+        within = pos - np.repeat(starts, counts)
+        packed = kinds[rid] != 0
+        # RLE runs take their payload directly; gather position only matters
+        # for bit-packed runs (and would otherwise index past the stream)
+        bitpos = np.where(packed, offs[rid] + within * widths[rid], 0)
+        vals = _gather_bits(buf, bitpos, widths[rid])
+        return np.where(packed, vals, payloads[rid]).astype(np.int32)
+
+
+def _gather_bits(body: np.ndarray, bitpos: np.ndarray, widths) -> np.ndarray:
+    """Unpack one value per entry of ``bitpos`` (bit offsets into ``body``)
+    via a 4-byte little-endian gather window.  Valid for widths <= 24."""
+    pbuf = np.concatenate([np.asarray(body, np.uint8), np.zeros(8, np.uint8)])
+    b0 = bitpos >> 3
+    w32 = (pbuf[b0].astype(np.uint32)
+           | (pbuf[b0 + 1].astype(np.uint32) << 8)
+           | (pbuf[b0 + 2].astype(np.uint32) << 16)
+           | (pbuf[b0 + 3].astype(np.uint32) << 24))
+    mask = (np.uint32(1) << np.asarray(widths).astype(np.uint32)) - np.uint32(1)
+    return (w32 >> (bitpos & 7).astype(np.uint32)) & mask
+
 
 def _count_target_in_runs(kinds, cnts, payloads, offs, body, width, target) -> int:
-    """How many level values equal ``target`` (host, vectorized over the
-    bit-packed spans only — RLE runs are O(1))."""
-    total = 0
-    for k in range(len(kinds)):
-        if kinds[k] == 0:
-            if payloads[k] == target:
-                total += int(cnts[k])
-        else:
+    """How many level values equal ``target`` (host, vectorized)."""
+    kinds = np.asarray(kinds)
+    cnts = np.asarray(cnts, np.int64)
+    payloads = np.asarray(payloads, np.int64)
+    offs = np.asarray(offs, np.int64)
+    total = int(cnts[(kinds == 0) & (payloads == target)].sum())
+    packed = np.flatnonzero(kinds != 0)
+    if not len(packed):
+        return total
+    if width > 24:
+        for k in packed:
             vals = ref.unpack_bits(body[offs[k]:], int(cnts[k]), width)
             total += int(np.count_nonzero(vals == target))
-    return total
+        return total
+    pcnts = cnts[packed]
+    rid = np.repeat(packed, pcnts)
+    starts = np.zeros(len(packed), np.int64)
+    np.cumsum(pcnts[:-1], out=starts[1:])
+    within = np.arange(int(pcnts.sum()), dtype=np.int64) - np.repeat(starts, pcnts)
+    vals = _gather_bits(body, offs[rid] * 8 + within * width, width)
+    return total + int(np.count_nonzero(vals == target))
 
 
 @dataclass
@@ -368,23 +437,16 @@ def _bss_decode_multi(buf, n, page_ends, page_bases, width, pairs: bool):
 # ---------------------------------------------------------------------------
 
 
-def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
-                        fallback: bool = True) -> Column:
-    leaf = reader.leaf
-    physical = Type(reader.meta.type)
-    max_def = leaf.max_definition_level
-    max_rep = leaf.max_repetition_level
-    try:
-        plan = build_plan(reader)
-    except _Unsupported:
-        if not fallback:
-            raise
-        counters.inc("chunks_host_fallback")
-        return decode_chunk_host(reader)
+def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
+    """H2D: put the plan's concatenated level/value byte streams into HBM.
 
-    # ---- stage ------------------------------------------------------------
+    Split out of :func:`decode_chunk_device` so callers (and the benchmark)
+    can overlap staging with decode, or re-run the decode phase on buffers
+    already resident in HBM.  ``stage_levels=False`` skips the level stream
+    (nested columns assemble levels on host).
+    """
     lev_dbuf = None
-    if len(plan.levels):
+    if stage_levels and len(plan.levels):
         lev_dbuf = jax.device_put(dev.pad_to_bucket(
             np.frombuffer(bytes(plan.levels), np.uint8)))
         counters.inc("bytes_h2d", len(plan.levels))
@@ -393,16 +455,69 @@ def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
         val_dbuf = jax.device_put(dev.pad_to_bucket(
             np.frombuffer(bytes(plan.values), np.uint8)))
         counters.inc("bytes_h2d", len(plan.values))
-    counters.inc("chunks_device_decoded")
+    meta = None
+    if plan.value_kind == "delta":
+        page_ends = np.cumsum(plan.d_counts).astype(np.int64)
+        mb_base = np.zeros(len(plan.d_counts), np.int64)
+        np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
+        mb_offs = (np.concatenate(plan.d_mb_offs) if plan.d_mb_offs
+                   else np.zeros(1, np.int64)).astype(np.int64)
+        mb_widths = (np.concatenate(plan.d_mb_widths) if plan.d_mb_widths
+                     else np.ones(1, np.int32))
+        mb_mins = (np.concatenate(plan.d_mb_mins) if plan.d_mb_mins
+                   else np.zeros(1, np.int64))
+        firsts = np.asarray(plan.d_firsts, np.int64)
+        meta = jax.device_put((page_ends, firsts, mb_base, mb_offs,
+                               mb_widths, mb_mins))
+    return lev_dbuf, val_dbuf, meta
+
+
+def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
+                        fallback: bool = True) -> Column:
+    try:
+        plan = build_plan(reader)
+        staged = stage_plan(plan,
+                            stage_levels=reader.leaf.max_repetition_level == 0)
+        counters.inc("chunks_device_decoded")
+        return decode_staged(reader.leaf, Type(reader.meta.type), plan, staged,
+                             keep_dictionary=keep_dictionary)
+    except _Unsupported:
+        if not fallback:
+            raise
+        counters.inc("chunks_host_fallback")
+        return decode_chunk_host(reader)
+
+
+def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
+                  keep_dictionary: bool = True) -> Column:
+    """Device decode phase: staged HBM buffers → decoded :class:`Column`."""
+    max_def = leaf.max_definition_level
+    max_rep = leaf.max_repetition_level
+    lev_dbuf, val_dbuf, staged_meta = (staged if len(staged) == 3
+                                       else (*staged, None))
 
     # ---- levels -----------------------------------------------------------
-    def_levels = rep_levels = None
-    if plan.def_runs.total:
-        def_levels = plan.def_runs.expand(lev_dbuf)
-    elif plan.host_def:
-        def_levels = jnp.asarray(np.concatenate(plan.host_def).astype(np.int32))
-    if plan.rep_runs.total:
-        rep_levels = plan.rep_runs.expand(lev_dbuf)
+    # Flat optional columns: expand def levels on device (validity mask stays
+    # in HBM).  Nested columns: the record assembler consumes levels on host,
+    # so expand them there directly — no device work, no D2H sync.
+    def_levels = None
+    def_host = rep_host = None
+    if max_rep > 0:
+        lev_host = np.frombuffer(bytes(plan.levels), np.uint8)
+        if plan.def_runs.total:
+            def_host = plan.def_runs.expand_host(lev_host)
+        elif plan.host_def:
+            def_host = np.concatenate(plan.host_def).astype(np.int32)
+        if plan.rep_runs.total:
+            rep_host = plan.rep_runs.expand_host(lev_host)
+        else:
+            rep_host = np.zeros(len(def_host) if def_host is not None else 0,
+                                np.int32)
+    else:
+        if plan.def_runs.total:
+            def_levels = plan.def_runs.expand(lev_dbuf)
+        elif plan.host_def:
+            def_levels = jnp.asarray(np.concatenate(plan.host_def).astype(np.int32))
 
     validity = None
     if max_def > 0 and def_levels is not None:
@@ -433,22 +548,25 @@ def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
         dictionary = _stage_dictionary(plan.dictionary_host, physical, leaf)
         dict_indices = plan.vruns.expand(val_dbuf)
         if physical == Type.BYTE_ARRAY:
-            values = None  # stays encoded
-        elif keep_dictionary:
-            values = dev.dict_gather(dictionary, dict_indices)
+            values = None  # stays encoded (Arrow dictionary form)
         else:
             values = dev.dict_gather(dictionary, dict_indices)
     elif kind == "delta":
-        page_ends = np.cumsum(plan.d_counts).astype(np.int64)
-        mb_base = np.zeros(len(plan.d_counts), np.int64)
-        np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
-        mb_offs = np.concatenate(plan.d_mb_offs) if plan.d_mb_offs else np.zeros(1, np.int64)
-        mb_widths = np.concatenate(plan.d_mb_widths) if plan.d_mb_widths else np.ones(1, np.int32)
-        mb_mins = np.concatenate(plan.d_mb_mins) if plan.d_mb_mins else np.zeros(1, np.int64)
-        firsts = np.asarray(plan.d_firsts, np.int64)
+        if staged_meta is not None:
+            page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins = staged_meta
+        else:
+            page_ends = np.cumsum(plan.d_counts).astype(np.int64)
+            mb_base = np.zeros(len(plan.d_counts), np.int64)
+            np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
+            mb_offs = (np.concatenate(plan.d_mb_offs) if plan.d_mb_offs
+                       else np.zeros(1, np.int64)).astype(np.int64)
+            mb_widths = np.concatenate(plan.d_mb_widths) if plan.d_mb_widths else np.ones(1, np.int32)
+            mb_mins = np.concatenate(plan.d_mb_mins) if plan.d_mb_mins else np.zeros(1, np.int64)
+            firsts = np.asarray(plan.d_firsts, np.int64)
         pairs = physical != Type.INT32
-        values = _delta_decode_multi(val_dbuf, int(page_ends[-1]), page_ends,
-                                     firsts, mb_base, mb_offs.astype(np.int64),
+        n_total = int(np.cumsum(plan.d_counts)[-1])
+        values = _delta_decode_multi(val_dbuf, n_total, page_ends,
+                                     firsts, mb_base, mb_offs,
                                      mb_widths, mb_mins, plan.d_vpm, pairs)
     elif kind == "bss":
         w = _FIXED_WIDTH.get(physical, leaf.type_length)
@@ -479,8 +597,8 @@ def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
     list_offsets: List[np.ndarray] = []
     list_validity: List[Optional[np.ndarray]] = []
     leaf_validity = validity
-    if max_rep > 0 and def_levels is not None:
-        asm = levels_ops.assemble(np.asarray(def_levels), np.asarray(rep_levels), leaf)
+    if max_rep > 0 and def_host is not None:
+        asm = levels_ops.assemble(def_host, rep_host, leaf)
         list_offsets, list_validity = asm.list_offsets, asm.list_validity
         leaf_validity = asm.validity
     col = Column(leaf=leaf, values=values, offsets=offsets,
