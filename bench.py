@@ -163,9 +163,8 @@ def _cfg4(n):
 
 def _cfg5(n):
     """Mini lineitem: sorted multi-row-group file, pushdown range scan."""
-    import pyarrow.compute as pc
     from parquet_tpu.io.reader import ParquetFile
-    from parquet_tpu.io.search import plan_scan, read_row_range
+    from parquet_tpu.parallel.host_scan import scan_filtered
 
     rng = np.random.default_rng(17)
     ship = np.sort(rng.integers(8000, 12000, n).astype(np.int32))
@@ -177,24 +176,17 @@ def _cfg5(n):
     })
     buf = io.BytesIO()
     pq.write_table(t, buf, row_group_size=n // 8, data_page_size=1 << 17,
-                   compression="snappy", use_dictionary=False)
+                   compression="snappy", use_dictionary=False,
+                   write_page_index=True)
     raw = buf.getvalue()
     lo, hi = 9000, 9200  # ~5% selectivity
 
     pf = ParquetFile(raw)
-    rg_base = np.zeros(len(pf.row_groups), np.int64)
-    np.cumsum([rg.num_rows for rg in pf.row_groups[:-1]], out=rg_base[1:])
 
     def run_ours():
-        plans = plan_scan(pf, "l_shipdate", lo=lo, hi=hi)
-        out_rows = 0
-        for p in plans:
-            start = int(rg_base[p.rg_index]) + p.first_row
-            keys = read_row_range(pf, "l_shipdate", start, p.row_count)
-            vals = read_row_range(pf, "l_extendedprice", start, p.row_count)
-            mask = (keys >= lo) & (keys <= hi)
-            out_rows += len(vals[mask])
-        return out_rows
+        out = scan_filtered(pf, "l_shipdate", lo=lo, hi=hi,
+                            columns=["l_extendedprice"])
+        return len(out["l_extendedprice"])
 
     rows_out = run_ours()
     ours_s = _time_best(run_ours, reps=3)

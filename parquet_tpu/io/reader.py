@@ -70,6 +70,9 @@ class PageInfo:
         return 0
 
 
+_UNSET = object()  # lazy-memo sentinel (None is a valid cached value)
+
+
 class ColumnChunkReader:
     """Reference parity: column_chunk.go — ColumnChunk + file.go — filePages."""
 
@@ -80,6 +83,7 @@ class ColumnChunkReader:
         self.chunk = chunk
         self.leaf = leaf
         self.meta = chunk.meta_data
+        self._ci = self._oi = _UNSET
 
     @property
     def codec(self) -> codecs.Codec:
@@ -126,6 +130,26 @@ class ColumnChunkReader:
             yield page
             pos = data_pos + clen
 
+    def pages_at(self, offset: int, size: int,
+                 num_pages: Optional[int] = None) -> Iterator[PageInfo]:
+        """Parse pages from one byte span of the chunk (offset-index seek:
+        one pread covering just the selected pages)."""
+        raw = self.file.source.pread(offset, size)
+        pos = 0
+        yielded = 0
+        while pos < size and (num_pages is None or yielded < num_pages):
+            try:
+                header, data_pos = thrift.deserialize(md.PageHeader, raw, pos)
+            except Exception as e:
+                raise CorruptedError(f"bad page header at {offset+pos}: {e}") from e
+            clen = header.compressed_page_size
+            payload = raw[data_pos : data_pos + clen]
+            if len(payload) != clen:
+                raise CorruptedError("truncated page payload")
+            yield PageInfo(header=header, payload=payload, offset=offset + pos)
+            yielded += 1
+            pos = data_pos + clen
+
     # ------------------------------------------------------------------ decode
     def read(self) -> Column:
         """Decode the whole chunk on host (numpy oracle path)."""
@@ -133,19 +157,27 @@ class ColumnChunkReader:
 
     # ------------------------------------------------------- indexes / filters
     def column_index(self) -> Optional[md.ColumnIndex]:
+        if self._ci is not _UNSET:
+            return self._ci
         c = self.chunk
         if c.column_index_offset is None:
+            self._ci = None
             return None
         raw = self.file.source.pread(c.column_index_offset, c.column_index_length)
         ci, _ = thrift.deserialize(md.ColumnIndex, raw)
+        self._ci = ci
         return ci
 
     def offset_index(self) -> Optional[md.OffsetIndex]:
+        if self._oi is not _UNSET:
+            return self._oi
         c = self.chunk
         if c.offset_index_offset is None:
+            self._oi = None
             return None
         raw = self.file.source.pread(c.offset_index_offset, c.offset_index_length)
         oi, _ = thrift.deserialize(md.OffsetIndex, raw)
+        self._oi = oi
         return oi
 
     def bloom_filter(self):
@@ -180,8 +212,17 @@ class RowGroupReader:
             i = which
         else:
             i = self.file.schema.leaf(which).column_index
-        return ColumnChunkReader(self.file, self.index,
-                                 self.rg.columns[i], self.file.schema.leaves[i])
+        # memoized: the file is immutable after open (reference semantics), so
+        # chunk readers — and the index structures they lazily parse — are
+        # shared across repeated scans
+        key = (self.index, i)
+        reader = self.file._chunk_cache.get(key)
+        if reader is None:
+            reader = ColumnChunkReader(self.file, self.index,
+                                       self.rg.columns[i],
+                                       self.file.schema.leaves[i])
+            self.file._chunk_cache[key] = reader
+        return reader
 
     def columns(self) -> List[ColumnChunkReader]:
         return [self.column(i) for i in range(len(self.rg.columns))]
@@ -193,6 +234,7 @@ class ParquetFile:
 
     def __init__(self, source, options: Optional[ReadOptions] = None):
         self.options = options or ReadOptions()
+        self._chunk_cache = {}
         self.source: Source = as_source(source)
         size = self.source.size()
         if size < 12:

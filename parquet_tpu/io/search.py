@@ -19,7 +19,9 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..format import metadata as md
-from ..format.enums import BoundaryOrder, Type
+from ..format.enums import BoundaryOrder, Encoding, PageType, Type
+
+_DICT_ENCODINGS = {Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY}
 from ..schema.schema import Leaf
 from .reader import ColumnChunkReader, ParquetFile, RowGroupReader
 from .statistics import decode_stat_value
@@ -160,23 +162,37 @@ def _npages(oi) -> int:
 
 def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
     """Yield the dictionary page (if any) + the data pages covering
-    [row_start, row_end) — reference's ``Pages.SeekToRow`` + read loop."""
+    [row_start, row_end) — reference's ``Pages.SeekToRow`` + read loop.
+
+    With an offset index this seeks straight to the selected pages' byte
+    ranges (one pread per contiguous span) instead of parsing every page
+    header in the chunk."""
     oi = chunk.offset_index()
-    all_pages = list(chunk.pages())
-    data_pages = [p for p in all_pages if p.page_type.name.startswith("DATA")]
-    dict_pages = [p for p in all_pages if p.page_type.name == "DICTIONARY_PAGE"]
     if oi is None or not oi.page_locations:
         # no index: fall back to counting rows per page (flat columns: values)
-        yield from all_pages
+        yield from chunk.pages()
         return
     locs = oi.page_locations
     firsts = [pl.first_row_index for pl in locs]
     i0 = max(bisect_right(firsts, row_start) - 1, 0)
-    i1 = bisect_left(firsts, row_end, lo=i0)
-    for p in dict_pages:
-        yield p
-    for i in range(i0, min(i1, len(data_pages))):
-        yield data_pages[i]
+    i1 = min(bisect_left(firsts, row_end, lo=i0), len(locs))
+    if i1 <= i0:
+        return
+    m = chunk.meta
+    dict_off = m.dictionary_page_offset
+    if dict_off is not None and 0 < dict_off < locs[0].offset:
+        yield from chunk.pages_at(dict_off, locs[0].offset - dict_off)
+    elif dict_off is None and any(Encoding(e) in _DICT_ENCODINGS
+                                  for e in (m.encodings or [])):
+        # legacy writers may omit dictionary_page_offset: find the dictionary
+        # page the slow way (full header scan, old behavior)
+        for p in chunk.pages():
+            if p.page_type == PageType.DICTIONARY_PAGE:
+                yield p
+                break
+    span_start = locs[i0].offset
+    span_len = locs[i1 - 1].offset + locs[i1 - 1].compressed_page_size - span_start
+    yield from chunk.pages_at(span_start, span_len, num_pages=i1 - i0)
 
 
 def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,

@@ -103,22 +103,25 @@ int64_t pq_assemble_levels(const int32_t* defs, const int32_t* reps, int64_t n,
     int64_t* offs = offsets_flat + (int64_t)i * (n + 1);
     uint8_t* val = valid_flat + (int64_t)i * n;
     int64_t ninst = 0, elems = 0;
+    // branchless: always store at the cursor, advance conditionally (stale
+    // stores are overwritten by the next instance / the final sentinel)
     for (int64_t j = 0; j < n; ++j) {
       const int32_t dj = defs[j], rj = reps[j];
-      if (rj < k && dj >= dprev) {
-        offs[ninst] = elems;
-        val[ninst] = dj >= dk - 1;
-        ninst++;
-      }
-      if (rj < knext && dj >= dk) elems++;
+      offs[ninst] = elems;
+      val[ninst] = dj >= dk - 1;
+      ninst += (rj < k) & (dj >= dprev);
+      elems += (rj < knext) & (dj >= dk);
     }
     offs[ninst] = elems;
     inst_counts[i] = ninst;
   }
   const int32_t dr = dks[nlev - 1];
   int64_t cnt = 0;
-  for (int64_t j = 0; j < n; ++j)
-    if (defs[j] >= dr) leaf_valid[cnt++] = defs[j] == max_def;
+  for (int64_t j = 0; j < n; ++j) {
+    const int32_t dj = defs[j];
+    leaf_valid[cnt] = dj == max_def;
+    cnt += dj >= dr;
+  }
   return cnt;
 }
 

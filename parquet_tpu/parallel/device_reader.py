@@ -478,9 +478,10 @@ def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
         plan = build_plan(reader)
         staged = stage_plan(plan,
                             stage_levels=reader.leaf.max_repetition_level == 0)
+        col = decode_staged(reader.leaf, Type(reader.meta.type), plan, staged,
+                            keep_dictionary=keep_dictionary)
         counters.inc("chunks_device_decoded")
-        return decode_staged(reader.leaf, Type(reader.meta.type), plan, staged,
-                             keep_dictionary=keep_dictionary)
+        return col
     except _Unsupported:
         if not fallback:
             raise
@@ -564,7 +565,7 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             mb_mins = np.concatenate(plan.d_mb_mins) if plan.d_mb_mins else np.zeros(1, np.int64)
             firsts = np.asarray(plan.d_firsts, np.int64)
         pairs = physical != Type.INT32
-        n_total = int(np.cumsum(plan.d_counts)[-1])
+        n_total = int(sum(plan.d_counts))
         values = _delta_decode_multi(val_dbuf, n_total, page_ends,
                                      firsts, mb_base, mb_offs,
                                      mb_widths, mb_mins, plan.d_vpm, pairs)

@@ -1,0 +1,104 @@
+"""Threaded predicate-pushdown scan over row groups.
+
+Reference parity: the reference has no internal parallelism — its documented
+concurrency model is the *caller* fanning goroutines out over row groups /
+column chunks (SURVEY.md §2.5, "caller-driven goroutine fan-out"; the read
+path is immutable-after-open and goroutine-safe).  This module packages that
+fan-out as a first-class API: zone-map pruning picks the covering pages
+(io/search.py), a thread pool decodes the surviving (row-group, column)
+chunks concurrently — the host decoders spend their time in numpy / the C++
+shim / the codec libraries, all of which release the GIL — and the exact
+predicate is applied to the decoded keys.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..io.reader import ParquetFile
+from ..io.search import plan_scan, read_row_range
+
+__all__ = ["scan_filtered"]
+
+
+def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
+                  columns: Optional[Sequence[str]] = None,
+                  num_threads: Optional[int] = None,
+                  use_bloom: bool = False) -> Dict[str, np.ndarray]:
+    """Scan ``columns`` for rows where ``lo <= file[path] <= hi``.
+
+    Pushdown happens at three levels: row groups are pruned by chunk
+    statistics (and optionally bloom filters for point lookups), pages by
+    column-index zone maps, and finally the decoded key column is compared
+    exactly.  Only pages covering candidate rows are ever decompressed.
+
+    Returns ``{column: values}`` with the predicate applied.  Flat columns
+    only (nested columns have no single row-aligned array to mask; read them
+    via :func:`read_row_range` per surviving span instead).
+    """
+    leaves = {leaf.dotted_path for leaf in pf.schema.leaves}
+    if path not in leaves:
+        raise KeyError(f"unknown predicate column {path!r}")
+    out_cols = list(columns) if columns is not None else sorted(leaves - {path})
+    for c in [path] + out_cols:
+        if c not in leaves:
+            raise KeyError(f"unknown column {c!r}")
+        if pf.schema.leaf(c).max_repetition_level > 0:
+            raise ValueError(
+                f"column {c!r} is nested; scan_filtered returns row-aligned "
+                "arrays — use read_row_range per plan for nested columns")
+
+    plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom)
+    rg_base = np.zeros(len(pf.row_groups), np.int64)
+    np.cumsum([rg.num_rows for rg in pf.row_groups[:-1]], out=rg_base[1:])
+
+    read_cols = [path] + [c for c in out_cols if c != path]
+
+    def read_span(plan):
+        start = int(rg_base[plan.rg_index]) + plan.first_row
+        return {c: read_row_range(pf, c, start, plan.row_count)
+                for c in read_cols}
+
+    if num_threads == 1 or len(plans) <= 1:
+        spans = [read_span(p) for p in plans]
+    else:
+        with ThreadPoolExecutor(max_workers=num_threads) as pool:
+            spans = list(pool.map(read_span, plans))
+
+    parts: Dict[str, List[np.ndarray]] = {c: [] for c in out_cols}
+    for span in spans:
+        keys = span[path]
+        if isinstance(keys, list):  # BYTE_ARRAY keys: Python bytes comparisons
+            mask = np.fromiter(
+                ((lo is None or x >= lo) and (hi is None or x <= hi)
+                 for x in keys), bool, count=len(keys))
+        else:
+            mask = np.ones(len(keys), bool)
+            if lo is not None:
+                mask &= keys >= lo
+            if hi is not None:
+                mask &= keys <= hi
+        for c in out_cols:
+            vals = span[c]
+            if isinstance(vals, list):  # BYTE_ARRAY host form
+                idx = np.flatnonzero(mask)
+                parts[c].append([vals[i] for i in idx])
+            else:
+                parts[c].append(np.asarray(vals)[mask])
+    from ..format.enums import Type
+
+    out: Dict[str, np.ndarray] = {}
+    for c in out_cols:
+        if parts[c] and isinstance(parts[c][0], list):
+            out[c] = [v for chunk in parts[c] for v in chunk]
+        elif parts[c]:
+            out[c] = np.concatenate(parts[c])
+        elif pf.schema.leaf(c).physical_type == Type.BYTE_ARRAY:
+            out[c] = []  # same host form as the non-empty path
+        else:
+            dt = pf.schema.leaf(c).np_dtype()
+            out[c] = np.empty(0, dt or np.uint8)
+    return out

@@ -152,3 +152,110 @@ def test_pushdown_against_pyarrow_file():
     pf = ParquetFile(buf.getvalue())
     plans = plan_scan(pf, "x", lo=23000, hi=23500)
     assert len(plans) == 1 and plans[0].rg_index == 2
+
+
+# ---------------------------------------------------------------------------
+# scan_filtered (threaded pushdown scan)
+# ---------------------------------------------------------------------------
+
+
+def test_scan_filtered_matches_exact_filter():
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    rng = np.random.default_rng(5)
+    k = np.sort(rng.integers(0, 500, 40000).astype(np.int64))
+    v = rng.random(40000)
+    s = np.array([f"name{int(x) % 7}" for x in k])
+    t = pa.table({"k": pa.array(k), "v": pa.array(v), "s": pa.array(s)})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=5000, data_page_size=4 * 1024,
+                   compression="snappy", use_dictionary=False)
+    pf = ParquetFile(buf.getvalue())
+    for lo, hi in [(100, 120), (0, 0), (499, 499), (600, 700), (None, 50)]:
+        got = scan_filtered(pf, "k", lo=lo, hi=hi, columns=["k", "v", "s"])
+        mask = np.ones(len(k), bool)
+        if lo is not None:
+            mask &= k >= lo
+        if hi is not None:
+            mask &= k <= hi
+        np.testing.assert_array_equal(got["k"], k[mask])
+        np.testing.assert_allclose(got["v"], v[mask])
+        assert [b.decode() if isinstance(b, bytes) else b for b in got["s"]] \
+            == list(s[mask])
+
+
+def test_scan_filtered_single_thread_same_result():
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    k = np.arange(20000, dtype=np.int64) % 1000
+    t = pa.table({"k": pa.array(np.sort(k)), "v": pa.array(k * 2)})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=4000, use_dictionary=False)
+    pf = ParquetFile(buf.getvalue())
+    a = scan_filtered(pf, "k", lo=200, hi=300, num_threads=1)
+    b = scan_filtered(pf, "k", lo=200, hi=300, num_threads=4)
+    np.testing.assert_array_equal(a["v"], b["v"])
+
+
+def test_scan_filtered_rejects_nested_and_unknown():
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    t = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                  "xs": pa.array([[1], [2, 3]], type=pa.list_(pa.int64()))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    pf = ParquetFile(buf.getvalue())
+    with pytest.raises(ValueError, match="nested"):
+        scan_filtered(pf, "k", lo=1, hi=2, columns=["xs.list.element"])
+    with pytest.raises(KeyError, match="unknown"):
+        scan_filtered(pf, "nope", lo=1, hi=2)
+
+
+def test_scan_filtered_byte_array_predicate():
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    s = np.sort(np.array([f"id{i:04d}" for i in np.random.default_rng(2)
+                          .integers(0, 600, 20000)]))
+    t = pa.table({"s": pa.array(s), "v": pa.array(np.arange(20000))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=4000, use_dictionary=False,
+                   write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+    got = scan_filtered(pf, "s", lo=b"id0100", hi=b"id0120", columns=["s", "v"])
+    mask = (s >= "id0100") & (s <= "id0120")
+    assert [b.decode() for b in got["s"]] == list(s[mask])
+    np.testing.assert_array_equal(got["v"], np.arange(20000)[mask])
+    # fully-pruned string scan keeps the list form
+    empty = scan_filtered(pf, "s", lo=b"zz", hi=b"zz", columns=["s"])
+    assert empty["s"] == []
+
+
+def test_scan_filtered_nested_predicate_rejected():
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    t = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                  "xs": pa.array([[1], [2, 3]], type=pa.list_(pa.int64()))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    pf = ParquetFile(buf.getvalue())
+    with pytest.raises(ValueError, match="nested"):
+        scan_filtered(pf, "xs.list.element", lo=1, hi=4, columns=["k"])
+
+
+def test_seek_pages_dictionary_chunk_with_page_index():
+    """Dictionary page survives the offset-index fast path."""
+    from parquet_tpu.io.search import seek_pages
+
+    vals = np.array(["a", "b", "c", "d"])[
+        np.random.default_rng(1).integers(0, 4, 30000)]
+    t = pa.table({"s": pa.array(vals)})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=True, data_page_size=2048,
+                   write_page_index=True, row_group_size=30000)
+    pf = ParquetFile(buf.getvalue())
+    chunk = pf.row_group(0).column(0)
+    pages = list(seek_pages(chunk, 12000, 12100))
+    from parquet_tpu.format.enums import PageType
+    assert pages[0].page_type == PageType.DICTIONARY_PAGE
+    col = read_row_range(pf, "s", 12000, 100)
+    assert [b.decode() for b in col] == list(vals[12000:12100])
