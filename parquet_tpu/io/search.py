@@ -196,17 +196,24 @@ def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
 
 
 def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
-                   device: bool = False):
+                   device: bool = False, aligned: bool = False):
     """Decode only the pages covering [row_start, row_start+row_count) of one
     column, trimming to the exact rows — the SeekToRow-then-read flow of
     SURVEY.md §3.3.  Flat columns return a host numpy array (or list of bytes
     for BYTE_ARRAY); nested columns return a :class:`Column` whose
-    ``to_arrow()`` yields exactly the requested rows."""
+    ``to_arrow()`` yields exactly the requested rows.
+
+    ``aligned=True`` (flat columns only) returns ``(values, validity)`` with
+    one row-aligned entry per requested row — null slots hold a zero fill
+    (``None`` for byte arrays) and ``validity`` marks them (``None`` when the
+    column span has no nulls)."""
     from .column import concat_columns
     from .reader import decode_chunk_host
 
     leaf = pf.schema.leaf(path)
     nested = leaf.max_repetition_level > 0
+    if aligned and nested:
+        raise ValueError("aligned=True is only defined for flat columns")
     out_parts = []
     remaining_start = row_start
     remaining = row_count
@@ -227,7 +234,8 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
             i0 = max(bisect_right(firsts, remaining_start) - 1, 0)
             first_row_of_pages = firsts[i0]
         col = decode_chunk_host(chunk, pages=iter(pages))
-        trim = _trim_nested if nested else _trim_flat
+        trim = (_trim_flat_aligned if aligned
+                else _trim_nested if nested else _trim_flat)
         out_parts.append(trim(col, remaining_start - first_row_of_pages, take))
         remaining_start = 0
         remaining -= take
@@ -247,6 +255,20 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
                       def_levels=empty_lv, rep_levels=empty_lv)
     if nested:
         return concat_columns(out_parts)
+    if aligned:
+        vals_parts = [p[0] for p in out_parts]
+        val_parts = [p[1] for p in out_parts]
+        if isinstance(vals_parts[0], list):
+            vals = [v for part in vals_parts for v in part]
+        else:
+            vals = (vals_parts[0] if len(vals_parts) == 1
+                    else np.concatenate(vals_parts))
+        if all(v is None for v in val_parts):
+            return vals, None
+        validity = np.concatenate(
+            [v if v is not None else np.ones(len(p), bool)
+             for v, p in zip(val_parts, vals_parts)])
+        return vals, validity
     if len(out_parts) == 1:
         return out_parts[0]
     if isinstance(out_parts[0], list):  # BYTE_ARRAY rows come back as lists
@@ -313,3 +335,31 @@ def _trim_flat(col, offset: int, count: int):
 
 def _substrings(values, offs, start, count):
     return [values[offs[i] : offs[i + 1]].tobytes() for i in range(start, start + count)]
+
+
+def _trim_flat_aligned(col, offset: int, count: int):
+    """Like :func:`_trim_flat` but row-aligned: returns ``(values, validity)``
+    where ``values`` has exactly ``count`` entries (null slots hold a zero
+    fill / ``None`` for byte arrays) and ``validity`` is a bool mask, or
+    ``None`` for non-nullable columns."""
+    if col.validity is None:
+        return _trim_flat(col, offset, count), None
+    validity = np.asarray(col.validity, bool)
+    vmask = validity[offset : offset + count]
+    vstart = int(np.count_nonzero(validity[:offset]))
+    vend = vstart + int(np.count_nonzero(vmask))
+    values = np.asarray(col.values)
+    if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
+        dt = np.float64 if col.leaf.physical_type == Type.DOUBLE else np.int64
+        values = np.ascontiguousarray(values).view(dt).reshape(-1)
+    if col.offsets is not None:
+        offs = np.asarray(col.offsets, np.int64)
+        comp = _substrings(values, offs, vstart, vend - vstart)
+        out = [None] * int(count)
+        for p, v in zip(np.flatnonzero(vmask), comp):
+            out[p] = v
+        return out, vmask
+    comp = values[vstart:vend]
+    out = np.zeros(int(count), comp.dtype if len(comp) else values.dtype)
+    out[vmask] = comp
+    return out, vmask

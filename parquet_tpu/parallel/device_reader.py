@@ -88,14 +88,20 @@ class _RunTable:
         self.ends.append(np.array([self.total + n], np.int64))
         self.total += n
 
-    def expand(self, dbuf: jax.Array, n: Optional[int] = None) -> jax.Array:
-        n = n or self.total
-        ends = np.concatenate(self.ends).astype(np.int64)
-        kinds = np.concatenate(self.kinds)
-        payloads = np.concatenate(self.payloads).astype(np.int32)
-        offs = np.concatenate(self.bit_offsets).astype(np.int64)
-        widths = np.concatenate(self.widths)
-        return dev.rle_expand(dbuf, n, ends, kinds, payloads, offs, widths)
+    def run_arrays(self) -> tuple:
+        """(ends, kinds, payloads, bit_offsets, widths) as flat host arrays —
+        the rle_expand kernel operands, stageable to HBM ahead of decode."""
+        return (np.concatenate(self.ends).astype(np.int64),
+                np.concatenate(self.kinds),
+                np.concatenate(self.payloads).astype(np.int32),
+                np.concatenate(self.bit_offsets).astype(np.int64),
+                np.concatenate(self.widths))
+
+    def expand(self, dbuf: jax.Array, n: Optional[int] = None,
+               tables: Optional[tuple] = None) -> jax.Array:
+        return dev.rle_expand(dbuf, n or self.total,
+                              *(tables if tables is not None
+                                else self.run_arrays()))
 
     def expand_host(self, buf: np.ndarray, n: Optional[int] = None) -> np.ndarray:
         """Numpy twin of :meth:`expand` over the host copy of the byte stream.
@@ -455,7 +461,7 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         val_dbuf = jax.device_put(dev.pad_to_bucket(
             np.frombuffer(bytes(plan.values), np.uint8)))
         counters.inc("bytes_h2d", len(plan.values))
-    meta = None
+    meta = {}
     if plan.value_kind == "delta":
         page_ends = np.cumsum(plan.d_counts).astype(np.int64)
         mb_base = np.zeros(len(plan.d_counts), np.int64)
@@ -467,8 +473,12 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         mb_mins = (np.concatenate(plan.d_mb_mins) if plan.d_mb_mins
                    else np.zeros(1, np.int64))
         firsts = np.asarray(plan.d_firsts, np.int64)
-        meta = jax.device_put((page_ends, firsts, mb_base, mb_offs,
-                               mb_widths, mb_mins))
+        meta["delta"] = jax.device_put((page_ends, firsts, mb_base, mb_offs,
+                                        mb_widths, mb_mins))
+    if plan.vruns.total:
+        meta["vruns"] = jax.device_put(plan.vruns.run_arrays())
+    if stage_levels and plan.def_runs.total:
+        meta["def_runs"] = jax.device_put(plan.def_runs.run_arrays())
     return lev_dbuf, val_dbuf, meta
 
 
@@ -496,6 +506,9 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
     max_rep = leaf.max_repetition_level
     lev_dbuf, val_dbuf, staged_meta = (staged if len(staged) == 3
                                        else (*staged, None))
+    staged_meta = staged_meta or {}
+    if not isinstance(staged_meta, dict):  # pre-dict layout: the delta tuple
+        staged_meta = {"delta": staged_meta}
 
     # ---- levels -----------------------------------------------------------
     # Flat optional columns: expand def levels on device (validity mask stays
@@ -516,7 +529,8 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
                                 np.int32)
     else:
         if plan.def_runs.total:
-            def_levels = plan.def_runs.expand(lev_dbuf)
+            def_levels = plan.def_runs.expand(lev_dbuf,
+                                              tables=staged_meta.get("def_runs"))
         elif plan.host_def:
             def_levels = jnp.asarray(np.concatenate(plan.host_def).astype(np.int32))
 
@@ -544,17 +558,20 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
     elif kind == "plain_flba":
         values = val_dbuf[: nvals * leaf.type_length].reshape(nvals, leaf.type_length)
     elif kind == "bool":
-        values = plan.vruns.expand(val_dbuf).astype(jnp.bool_)
+        values = plan.vruns.expand(val_dbuf,
+                                    tables=staged_meta.get("vruns")).astype(jnp.bool_)
     elif kind == "dict":
         dictionary = _stage_dictionary(plan.dictionary_host, physical, leaf)
-        dict_indices = plan.vruns.expand(val_dbuf)
+        dict_indices = plan.vruns.expand(val_dbuf,
+                                         tables=staged_meta.get("vruns"))
         if physical == Type.BYTE_ARRAY:
             values = None  # stays encoded (Arrow dictionary form)
         else:
             values = dev.dict_gather(dictionary, dict_indices)
     elif kind == "delta":
-        if staged_meta is not None:
-            page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins = staged_meta
+        if staged_meta.get("delta") is not None:
+            page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins = \
+                staged_meta["delta"]
         else:
             page_ends = np.cumsum(plan.d_counts).astype(np.int64)
             mb_base = np.zeros(len(plan.d_counts), np.int64)

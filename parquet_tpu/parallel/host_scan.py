@@ -35,18 +35,26 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     column-index zone maps, and finally the decoded key column is compared
     exactly.  Only pages covering candidate rows are ever decompressed.
 
-    Returns ``{column: values}`` with the predicate applied.  Flat columns
-    only (nested columns have no single row-aligned array to mask; read them
-    via :func:`read_row_range` per surviving span instead).
+    Returns ``{column: values}`` with the predicate applied.  Rows where the
+    key is NULL never match (SQL comparison semantics).  Nullable numeric
+    output columns come back as ``np.ma.MaskedArray`` (mask=True at nulls);
+    BYTE_ARRAY columns as lists with ``None`` entries.  Flat columns only
+    (nested columns have no single row-aligned array to mask; read them via
+    :func:`read_row_range` per surviving span instead) — the default
+    selection takes every flat column.
     """
     leaves = {leaf.dotted_path for leaf in pf.schema.leaves}
+    flat = {leaf.dotted_path for leaf in pf.schema.leaves
+            if leaf.max_repetition_level == 0}
     if path not in leaves:
         raise KeyError(f"unknown predicate column {path!r}")
-    out_cols = list(columns) if columns is not None else sorted(leaves - {path})
+    # default selection: every flat column (nested ones have no single
+    # row-aligned array to mask — read them via read_row_range per plan)
+    out_cols = list(columns) if columns is not None else sorted(flat - {path})
     for c in [path] + out_cols:
         if c not in leaves:
             raise KeyError(f"unknown column {c!r}")
-        if pf.schema.leaf(c).max_repetition_level > 0:
+        if c not in flat:
             raise ValueError(
                 f"column {c!r} is nested; scan_filtered returns row-aligned "
                 "arrays — use read_row_range per plan for nested columns")
@@ -59,7 +67,7 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 
     def read_span(plan):
         start = int(rg_base[plan.rg_index]) + plan.first_row
-        return {c: read_row_range(pf, c, start, plan.row_count)
+        return {c: read_row_range(pf, c, start, plan.row_count, aligned=True)
                 for c in read_cols}
 
     if num_threads == 1 or len(plans) <= 1:
@@ -68,12 +76,14 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
         with ThreadPoolExecutor(max_workers=num_threads) as pool:
             spans = list(pool.map(read_span, plans))
 
-    parts: Dict[str, List[np.ndarray]] = {c: [] for c in out_cols}
+    parts: Dict[str, List] = {c: [] for c in out_cols}
+    vparts: Dict[str, List] = {c: [] for c in out_cols}
     for span in spans:
-        keys = span[path]
+        keys, key_valid = span[path]
         if isinstance(keys, list):  # BYTE_ARRAY keys: Python bytes comparisons
             mask = np.fromiter(
-                ((lo is None or x >= lo) and (hi is None or x <= hi)
+                ((x is not None
+                  and (lo is None or x >= lo) and (hi is None or x <= hi))
                  for x in keys), bool, count=len(keys))
         else:
             mask = np.ones(len(keys), bool)
@@ -81,13 +91,20 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                 mask &= keys >= lo
             if hi is not None:
                 mask &= keys <= hi
+            if key_valid is not None:  # SQL semantics: NULL fails the predicate
+                mask &= key_valid
         for c in out_cols:
-            vals = span[c]
-            if isinstance(vals, list):  # BYTE_ARRAY host form
+            vals, valid = span[c]
+            if isinstance(vals, list):
                 idx = np.flatnonzero(mask)
                 parts[c].append([vals[i] for i in idx])
             else:
                 parts[c].append(np.asarray(vals)[mask])
+                if valid is not None:
+                    vparts[c].append(valid[mask])
+                elif vparts[c]:  # earlier span had nulls: keep alignment
+                    vparts[c].append(np.ones(int(mask.sum()), bool))
+
     from ..format.enums import Type
 
     out: Dict[str, np.ndarray] = {}
@@ -95,7 +112,14 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
         if parts[c] and isinstance(parts[c][0], list):
             out[c] = [v for chunk in parts[c] for v in chunk]
         elif parts[c]:
-            out[c] = np.concatenate(parts[c])
+            vals = np.concatenate(parts[c])
+            if vparts[c]:
+                n_missing = len(vals) - sum(len(v) for v in vparts[c])
+                valid = np.concatenate(
+                    ([np.ones(n_missing, bool)] if n_missing else []) + vparts[c])
+                out[c] = np.ma.MaskedArray(vals, mask=~valid)
+            else:
+                out[c] = vals
         elif pf.schema.leaf(c).physical_type == Type.BYTE_ARRAY:
             out[c] = []  # same host form as the non-empty path
         else:

@@ -259,3 +259,61 @@ def test_seek_pages_dictionary_chunk_with_page_index():
     assert pages[0].page_type == PageType.DICTIONARY_PAGE
     col = read_row_range(pf, "s", 12000, 100)
     assert [b.decode() for b in col] == list(vals[12000:12100])
+
+
+def test_scan_filtered_nullable_columns():
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    rng = np.random.default_rng(8)
+    n = 30000
+    k = np.sort(rng.integers(0, 300, n).astype(np.int64))
+    v = rng.random(n)
+    v_null = rng.random(n) < 0.2
+    k_null = rng.random(n) < 0.1
+    t = pa.table({
+        "k": pa.array([None if kn else int(x) for x, kn in zip(k, k_null)],
+                      type=pa.int64()),
+        "v": pa.array([None if vn else float(x) for x, vn in zip(v, v_null)],
+                      type=pa.float64()),
+        "s": pa.array([None if vn else f"s{int(x)}" for x, vn in zip(k, v_null)]),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=5000, data_page_size=4 * 1024,
+                   use_dictionary=False, write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+    got = scan_filtered(pf, "k", lo=100, hi=110, columns=["k", "v", "s"])
+    # oracle: NULL keys never match
+    sel = [i for i in range(n) if not k_null[i] and 100 <= k[i] <= 110]
+    np.testing.assert_array_equal(np.asarray(got["k"]), k[sel])
+    gv = got["v"]
+    assert isinstance(gv, np.ma.MaskedArray)
+    np.testing.assert_array_equal(np.asarray(gv.mask), v_null[sel])
+    np.testing.assert_allclose(np.asarray(gv.data)[~v_null[sel]],
+                               v[sel][~v_null[sel]])
+    exp_s = [None if v_null[i] else f"s{int(k[i])}".encode() for i in sel]
+    assert got["s"] == exp_s
+
+
+def test_scan_filtered_default_columns_skip_nested():
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    t = pa.table({"k": pa.array([1, 2, 3], type=pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0]),
+                  "xs": pa.array([[1], [2, 3], []], type=pa.list_(pa.int64()))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    pf = ParquetFile(buf.getvalue())
+    got = scan_filtered(pf, "k", lo=2, hi=3)  # default columns: flat only
+    assert set(got.keys()) == {"v"}
+    np.testing.assert_allclose(got["v"], [2.0, 3.0])
+
+
+def test_read_row_range_aligned_flat():
+    t = pa.table({"x": pa.array([1, None, 3, None, 5, 6, None, 8],
+                                type=pa.int64())})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False)
+    pf = ParquetFile(buf.getvalue())
+    vals, valid = read_row_range(pf, "x", 1, 5, aligned=True)
+    np.testing.assert_array_equal(valid, [False, True, False, True, True])
+    np.testing.assert_array_equal(vals[valid], [3, 5, 6])
