@@ -39,15 +39,23 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 
-def _probe_tpu(timeout_s: int = 90) -> bool:
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); import sys; sys.exit(0 if d else 1)"],
-            timeout=timeout_s, capture_output=True)
-        return p.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+def _probe_tpu(timeout_s: int = 90, attempts: int = 3, retry_wait_s: int = 45) -> bool:
+    """Probe jax.devices() in a subprocess; retry a couple of times so a
+    transient tunnel outage doesn't demote the whole run to CPU numbers."""
+    for i in range(attempts):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); import sys; sys.exit(0 if d else 1)"],
+                timeout=timeout_s, capture_output=True)
+            return p.returncode == 0  # deterministic result: no retry
+        except subprocess.TimeoutExpired:
+            pass  # hung tunnel: worth retrying
+        if i + 1 < attempts:
+            print(f"bench: TPU probe timed out (attempt {i+1}/{attempts}), "
+                  f"retrying in {retry_wait_s}s", file=sys.stderr)
+            time.sleep(retry_wait_s)
+    return False
 
 
 def _time_best(fn, reps=5):

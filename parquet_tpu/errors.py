@@ -2,14 +2,19 @@
 
 Reference parity: ``errors.go — ErrCorrupted, ErrMissingRootColumn...`` and
 ``limits.go — MaxColumnDepth, MaxColumnIndexSize...`` (SURVEY.md §2.1).
+Defined here with no package imports so every layer (schema, io, parallel)
+can enforce them without cycles.
 """
 
-from .io.reader import CorruptedError  # canonical corruption error
+
+class CorruptedError(Exception):
+    """Reference parity: errors.go — ErrCorrupted."""
+
 
 # hard format limits (mirroring the reference's limits.go constants)
 MAX_COLUMN_DEPTH = 16
 MAX_COLUMN_INDEX_SIZE = 16 * 1024 * 1024
-MAX_PAGE_SIZE = 1 << 31 - 1
+MAX_PAGE_SIZE = (1 << 31) - 1  # page sizes are i32 in the thrift structs
 MAX_ROW_GROUPS = 1 << 15  # RowGroup.ordinal is an i16
 MAX_DEFINITION_LEVEL = 255
 MAX_REPETITION_LEVEL = 255

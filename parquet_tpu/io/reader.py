@@ -29,8 +29,8 @@ from .column import Column, concat_columns
 from .source import Source, as_source
 
 
-class CorruptedError(Exception):
-    """Reference parity: errors.go — ErrCorrupted."""
+from ..errors import (CorruptedError, MAX_COLUMN_INDEX_SIZE,  # noqa: F401
+                      MAX_PAGE_SIZE)  # re-exported: historical home of the class
 
 
 @dataclass
@@ -121,6 +121,9 @@ class ColumnChunkReader:
             except Exception as e:
                 raise CorruptedError(f"bad page header at {start+pos}: {e}") from e
             clen = header.compressed_page_size
+            if not 0 <= clen <= MAX_PAGE_SIZE:
+                raise CorruptedError(
+                    f"page at {start+pos}: compressed size {clen} out of range")
             payload = raw[data_pos : data_pos + clen]
             if len(payload) != clen:
                 raise CorruptedError("truncated page payload")
@@ -143,6 +146,9 @@ class ColumnChunkReader:
             except Exception as e:
                 raise CorruptedError(f"bad page header at {offset+pos}: {e}") from e
             clen = header.compressed_page_size
+            if not 0 <= clen <= MAX_PAGE_SIZE:
+                raise CorruptedError(
+                    f"page at {offset+pos}: compressed size {clen} out of range")
             payload = raw[data_pos : data_pos + clen]
             if len(payload) != clen:
                 raise CorruptedError("truncated page payload")
@@ -163,6 +169,9 @@ class ColumnChunkReader:
         if c.column_index_offset is None:
             self._ci = None
             return None
+        if not 0 <= (c.column_index_length or 0) <= MAX_COLUMN_INDEX_SIZE:
+            raise CorruptedError(
+                f"column index length {c.column_index_length} out of range")
         raw = self.file.source.pread(c.column_index_offset, c.column_index_length)
         ci, _ = thrift.deserialize(md.ColumnIndex, raw)
         self._ci = ci

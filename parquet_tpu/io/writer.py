@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from .. import codecs
+from ..errors import MAX_ROW_GROUPS, TooManyRowGroupsError
 from ..format import enums, metadata as md, thrift
 from ..format.enums import (CompressionCodec, ConvertedType, Encoding,
                             FieldRepetitionType as Rep, PageType, Type)
@@ -136,6 +137,10 @@ class ParquetWriter:
 
     # ------------------------------------------------------------------
     def write_row_group(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
+        if len(self._row_groups) >= MAX_ROW_GROUPS:
+            raise TooManyRowGroupsError(
+                f"file would exceed {MAX_ROW_GROUPS} row groups "
+                "(RowGroup.ordinal is an i16); raise row_group_size")
         opts = self.options
         chunks: List[md.ColumnChunk] = []
         cis: List[Optional[md.ColumnIndex]] = []

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..errors import ColumnTooDeepError, MAX_COLUMN_DEPTH
 from ..format import enums, metadata as md
 from ..format.enums import FieldRepetitionType as Rep, Type
 from . import types as _types
@@ -119,6 +120,10 @@ class Schema:
             path = path + (n.name,)
             ancestors = ancestors + (n,)
         if n.is_leaf:
+            if len(path) > MAX_COLUMN_DEPTH:
+                raise ColumnTooDeepError(
+                    f"column {'.'.join(path)!r} is {len(path)} levels deep "
+                    f"(limit {MAX_COLUMN_DEPTH})")
             self.leaves.append(Leaf(-1, path, n, def_level, rep_level, ancestors))
         else:
             for c in n.children:

@@ -258,3 +258,62 @@ def test_footer_last_atomicity(rng):
     raw = _write(t)
     with pytest.raises(Exception):
         ParquetFile(raw[: len(raw) - 20])
+
+
+def test_limits_enforced():
+    """errors.py limits are enforced, not just declared."""
+    import pytest
+
+    from parquet_tpu.errors import ColumnTooDeepError, MAX_COLUMN_DEPTH
+    from parquet_tpu.format.enums import Type
+    from parquet_tpu.schema import schema as sch
+
+    # column depth: nest groups past the limit
+    node = sch.leaf("x", Type.INT32)
+    for i in range(MAX_COLUMN_DEPTH + 1):
+        node = sch.group(f"g{i}", [node])
+    with pytest.raises(ColumnTooDeepError, match="levels deep"):
+        sch.message("root", [node])
+
+    # a schema at exactly the limit is fine
+    node = sch.leaf("x", Type.INT32)
+    for i in range(MAX_COLUMN_DEPTH - 1):
+        node = sch.group(f"g{i}", [node])
+    assert len(sch.message("root", [node]).leaves[0].path) == MAX_COLUMN_DEPTH
+
+
+def test_corrupted_page_size_rejected():
+    """The MAX_PAGE_SIZE guard rejects absurd compressed-size claims."""
+    from parquet_tpu.errors import CorruptedError
+    from parquet_tpu.format import metadata as md, thrift
+    from parquet_tpu.io.reader import ParquetFile
+
+    t = pa.table({"x": pa.array(np.arange(100, dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False, compression="none")
+    pf = ParquetFile(buf.getvalue())
+    chunk = pf.row_group(0).column(0)
+    # craft a page stream whose header claims a negative compressed size
+    bad_header = md.PageHeader(
+        type=int(Encoding.PLAIN) * 0,  # DATA_PAGE
+        uncompressed_page_size=800, compressed_page_size=-7,
+        data_page_header=md.DataPageHeader(
+            num_values=100, encoding=0,
+            definition_level_encoding=3, repetition_level_encoding=3))
+    raw = thrift.serialize(bad_header) + b"\x00" * 16
+    with pytest.raises(CorruptedError, match="out of range"):
+        list(chunk.pages(raw=raw))
+
+
+def test_corrupted_column_index_length_rejected():
+    from parquet_tpu.errors import CorruptedError
+    from parquet_tpu.io.reader import ParquetFile
+
+    t = pa.table({"x": pa.array(np.arange(1000, dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False, write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+    chunk = pf.row_group(0).column(0)
+    chunk.chunk.column_index_length = -5  # corrupt footer claim
+    with pytest.raises(CorruptedError, match="out of range"):
+        chunk.column_index()
