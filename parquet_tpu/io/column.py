@@ -300,3 +300,83 @@ def _ragged_with_nulls(values: np.ndarray, offsets: np.ndarray, validity: np.nda
         pa.binary(), n,
         [mask, pa.py_buffer(slot_offs.tobytes()),
          pa.py_buffer(np.asarray(values, dtype=np.uint8).tobytes())])
+
+
+# ---------------------------------------------------------------------------
+# Schema node → arrow type (used by Table.to_arrow for struct/map assembly)
+# ---------------------------------------------------------------------------
+
+
+def arrow_type_of(node):
+    """pyarrow DataType for a schema :class:`~parquet_tpu.schema.schema.Node`,
+    consistent with the arrays :func:`_leaf_to_arrow` produces."""
+    import pyarrow as pa
+
+    from ..format.enums import FieldRepetitionType as Rep
+
+    def base(n):
+        if n.is_leaf:
+            return _leaf_arrow_type(n)
+        k = n.logical_kind
+        if k == LogicalKind.LIST and len(n.children) == 1:
+            mid = n.children[0]
+            if mid.children is not None and len(mid.children) == 1:
+                return pa.list_(arrow_type_of(mid.children[0]))  # 3-level
+            return pa.list_(base(mid))  # 2-level legacy: repeated element
+        if k == LogicalKind.MAP and len(n.children) == 1:
+            kv = n.children[0]
+            if kv.children is not None and len(kv.children) == 2:
+                return pa.map_(base(kv.children[0]), arrow_type_of(kv.children[1]))
+        return pa.struct([(c.name, arrow_type_of(c)) for c in n.children])
+
+    t = base(node)
+    if node.repetition == Rep.REPEATED:  # legacy repeated field = list
+        t = pa.list_(t)
+    return t
+
+
+def _leaf_arrow_type(n):
+    import pyarrow as pa
+
+    k = n.logical_kind
+    pt = n.physical_type
+    p = n.logical_params
+    if pt == Type.BOOLEAN:
+        return pa.bool_()
+    if pt == Type.BYTE_ARRAY:
+        return (pa.string() if k in (LogicalKind.STRING, LogicalKind.ENUM,
+                                     LogicalKind.JSON) else pa.binary())
+    if pt == Type.FIXED_LEN_BYTE_ARRAY:
+        if k == LogicalKind.FLOAT16:
+            return pa.float16()
+        if k == LogicalKind.DECIMAL:
+            return pa.decimal128(p.get("precision", 38), p.get("scale", 0))
+        return pa.binary(n.type_length)
+    if pt == Type.INT96:
+        return pa.timestamp("ns")
+    if pt == Type.FLOAT:
+        return pa.float32()
+    if pt == Type.DOUBLE:
+        return pa.float64()
+    if k == LogicalKind.INT:
+        bw = max(p.get("bit_width", 64), 8)
+        return pa.from_numpy_dtype(
+            np.dtype(f"{'i' if p.get('signed', True) else 'u'}{bw // 8}"))
+    if k == LogicalKind.DATE:
+        return pa.date32()
+    if k == LogicalKind.DECIMAL:
+        return pa.decimal128(p.get("precision", 38), p.get("scale", 0))
+    tz = "UTC" if p.get("utc") else None
+    if k == LogicalKind.TIMESTAMP_MILLIS:
+        return pa.timestamp("ms", tz=tz)
+    if k == LogicalKind.TIMESTAMP_MICROS:
+        return pa.timestamp("us", tz=tz)
+    if k == LogicalKind.TIMESTAMP_NANOS:
+        return pa.timestamp("ns", tz=tz)
+    if k == LogicalKind.TIME_MILLIS:
+        return pa.time32("ms")
+    if k == LogicalKind.TIME_MICROS:
+        return pa.time64("us")
+    if k == LogicalKind.TIME_NANOS:
+        return pa.time64("ns")
+    return pa.int32() if pt == Type.INT32 else pa.int64()

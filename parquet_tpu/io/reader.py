@@ -352,14 +352,118 @@ class Table:
         return self.columns.keys()
 
     def to_arrow(self):
+        """Reassemble a pyarrow table, including structs and maps.
+
+        Three tiers per top-level field: plain leaves and pure list chains use
+        the vectorized :meth:`Column.to_arrow`; structs *above* any repetition
+        are zipped vectorized from their children with validity derived from
+        def levels; structs/maps *inside* lists go through the row model
+        (record-at-a-time Dremel assembly — correct, not the hot path)."""
         import pyarrow as pa
 
-        arrays = []
-        names = []
-        for path, col in self.columns.items():
-            arrays.append(col.to_arrow())
-            names.append(path.split(".")[0] if col.list_offsets else path)
-        return pa.table(dict(zip(names, arrays)))
+        names, arrays = [], []
+        for child in self.schema.root.children:
+            leaves = [l for l in self.schema.leaves if l.path[0] == child.name]
+            present = [l for l in leaves if l.dotted_path in self.columns]
+            if not present:
+                continue
+            if len(present) != len(leaves):
+                # partial column selection: emit present leaves flat
+                for l in present:
+                    col = self.columns[l.dotted_path]
+                    names.append(child.name if len(l.path) == 1 or col.list_offsets
+                                 else l.dotted_path)
+                    arrays.append(col.to_arrow())
+                continue
+            names.append(child.name)
+            arrays.append(self._field_to_arrow(child, leaves))
+        return pa.Table.from_arrays(arrays, names=names)
+
+    # -- to_arrow helpers ------------------------------------------------
+    def _field_to_arrow(self, node, leaves):
+        if self._needs_row_assembly(node, under_rep=False):
+            return self._field_via_rows(node)
+        return self._build_arrow(node, (node.name,), 0)
+
+    def _needs_row_assembly(self, node, under_rep: bool) -> bool:
+        """True if a plain (non-list-machinery) group sits under repetition —
+        structs/maps inside lists have no row-aligned child arrays to zip."""
+        from ..format.enums import FieldRepetitionType as Rep
+        from ..schema.types import LogicalKind
+
+        if node.is_leaf:
+            return False
+        rep_here = under_rep or node.repetition == Rep.REPEATED
+        if node.logical_kind == LogicalKind.LIST and len(node.children) == 1:
+            mid = node.children[0]
+            inner = (mid.children[0] if mid.children is not None
+                     and len(mid.children) == 1 else mid)
+            return self._needs_row_assembly(inner, under_rep=True) \
+                if not inner.is_leaf else False
+        if node.logical_kind == LogicalKind.MAP:
+            return True  # key_value struct is always under repetition
+        if rep_here:
+            return True  # plain repeated group / struct under a list
+        return any(self._needs_row_assembly(c, under_rep=False)
+                   for c in node.children if not c.is_leaf)
+
+    def _build_arrow(self, node, prefix, def_above: int):
+        """Vectorized tier: leaves / list chains via Column.to_arrow, struct
+        layers zipped with validity = (def_levels >= own def level)."""
+        import pyarrow as pa
+
+        from ..format.enums import FieldRepetitionType as Rep
+        from ..schema.types import LogicalKind
+
+        if node.is_leaf or node.logical_kind == LogicalKind.LIST:
+            sub = [l for l in self.schema.leaves
+                   if l.path[: len(prefix)] == prefix]
+            return self.columns[sub[0].dotted_path].to_arrow()
+        own_def = def_above + (1 if node.repetition != Rep.REQUIRED else 0)
+        children = [(c.name, self._build_arrow(c, prefix + (c.name,), own_def))
+                    for c in node.children]
+        arrs = [a for _, a in children]
+        names = [n for n, _ in children]
+        if node.repetition == Rep.REQUIRED:
+            return pa.StructArray.from_arrays(arrs, names)
+        # optional struct: null iff def level stops above own_def.  Prefer a
+        # flat leaf (def levels are per-row); a repeated leaf's levels are
+        # per-slot, so take the row-start slots (rep == 0) there.
+        subleaves = [l for l in self.schema.leaves
+                     if l.path[: len(prefix)] == prefix]
+        rep_leaf = min(subleaves, key=lambda l: l.max_repetition_level)
+        col = self.columns[rep_leaf.dotted_path]
+        if col.def_levels is None:
+            if rep_leaf.max_definition_level == own_def and col.validity is not None \
+                    and rep_leaf.max_repetition_level == 0:
+                valid = np.asarray(col.validity)
+            else:
+                return self._field_via_rows(node)  # no levels to derive nulls
+        else:
+            d = np.asarray(col.def_levels)
+            if rep_leaf.max_repetition_level > 0:
+                d = d[np.asarray(col.rep_levels) == 0]
+            valid = d >= own_def
+        if bool(np.all(valid)):
+            return pa.StructArray.from_arrays(arrs, names)
+        return pa.StructArray.from_arrays(arrs, names, mask=pa.array(~valid))
+
+    def _field_via_rows(self, node):
+        """Row-model tier: assemble this field's python objects row by row,
+        then build the arrow array with the schema-derived type."""
+        import pyarrow as pa
+
+        from ..rows import _Assembler, rows_from_columns
+        from ..schema.schema import Schema, message
+        from .column import arrow_type_of
+
+        sub_schema = message("root", [node])
+        cols = {l.dotted_path: self.columns[l.dotted_path]
+                for l in sub_schema.leaves}
+        asm = _Assembler(sub_schema)
+        objs = [asm.assemble(row)[node.name]
+                for row in rows_from_columns(sub_schema, cols, self.num_rows)]
+        return pa.array(objs, type=arrow_type_of(node))
 
 
 # ---------------------------------------------------------------------------

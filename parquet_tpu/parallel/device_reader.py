@@ -531,8 +531,12 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
         if plan.def_runs.total:
             def_levels = plan.def_runs.expand(lev_dbuf,
                                               tables=staged_meta.get("def_runs"))
+            if max_def > 1:  # struct layers: keep host levels for reassembly
+                def_host = plan.def_runs.expand_host(
+                    np.frombuffer(bytes(plan.levels), np.uint8))
         elif plan.host_def:
-            def_levels = jnp.asarray(np.concatenate(plan.host_def).astype(np.int32))
+            def_host = np.concatenate(plan.host_def).astype(np.int32)
+            def_levels = jnp.asarray(def_host)
 
     validity = None
     if max_def > 0 and def_levels is not None:
@@ -621,7 +625,8 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
         leaf_validity = asm.validity
     col = Column(leaf=leaf, values=values, offsets=offsets,
                  validity=leaf_validity, list_offsets=list_offsets,
-                 list_validity=list_validity, num_slots=plan.total_slots)
+                 list_validity=list_validity, num_slots=plan.total_slots,
+                 def_levels=def_host, rep_levels=rep_host)
     col.dictionary = dictionary
     col.dictionary_host = plan.dictionary_host
     col.dict_indices = dict_indices

@@ -297,3 +297,70 @@ def test_to_arrow_table_full():
     out = ParquetFile(raw).read().to_arrow()
     assert out["a"].combine_chunks().equals(t["a"].combine_chunks())
     assert out["s"].combine_chunks().cast(pa.string()).equals(t["s"].combine_chunks())
+
+
+# ---------------------------------------------------------------------------
+# Table.to_arrow struct / map reassembly
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip_to_arrow(t, device=False, **write_kw):
+    from parquet_tpu import read_table
+
+    buf = io.BytesIO()
+    pq.write_table(t, buf, **write_kw)
+    return read_table(buf.getvalue(), device=device).to_arrow()
+
+
+def test_to_arrow_flat_struct_nulls():
+    t = pa.table({"s": pa.array(
+        [{"a": 1, "b": "x"}, {"a": None, "b": "y"}, None] * 500,
+        type=pa.struct([("a", pa.int64()), ("b", pa.string())]))})
+    got = _roundtrip_to_arrow(t)
+    assert got["s"].to_pylist() == t["s"].to_pylist()  # null struct != struct of nulls
+
+
+def test_to_arrow_list_of_struct():
+    t = pa.table({"ls": pa.array(
+        [[{"a": 1, "b": 2.5}, {"a": None, "b": 0.5}], [], None, [{"a": 7, "b": 9.0}]],
+        type=pa.list_(pa.struct([("a", pa.int64()), ("b", pa.float64())])))})
+    got = _roundtrip_to_arrow(t)
+    assert got["ls"].to_pylist() == t["ls"].to_pylist()
+
+
+def test_to_arrow_map():
+    t = pa.table({"m": pa.array(
+        [[("k1", 1), ("k2", 2)], [], None, [("z", None)]],
+        type=pa.map_(pa.string(), pa.int64()))})
+    got = _roundtrip_to_arrow(t)
+    assert got["m"].to_pylist() == t["m"].to_pylist()
+
+
+def test_to_arrow_struct_containing_list():
+    t = pa.table({"s": pa.array(
+        [{"xs": [1, 2], "y": 5}, {"xs": [], "y": None}, None],
+        type=pa.struct([("xs", pa.list_(pa.int64())), ("y", pa.int64())]))})
+    got = _roundtrip_to_arrow(t)
+    assert got["s"].to_pylist() == t["s"].to_pylist()
+
+
+def test_to_arrow_nested_struct_struct():
+    inner = pa.struct([("p", pa.int64()), ("q", pa.string())])
+    t = pa.table({"o": pa.array(
+        [{"i": {"p": 1, "q": "a"}, "z": 1.0}, {"i": None, "z": 2.0}, None],
+        type=pa.struct([("i", inner), ("z", pa.float64())]))})
+    got = _roundtrip_to_arrow(t)
+    assert got["o"].to_pylist() == t["o"].to_pylist()
+
+
+def test_to_arrow_struct_device_path():
+    t = pa.table({
+        "s": pa.array([{"a": i, "b": f"v{i}"} if i % 5 else None
+                       for i in range(2000)],
+                      type=pa.struct([("a", pa.int64()), ("b", pa.string())])),
+        "ls": pa.array([[{"a": i}] if i % 3 else [] for i in range(2000)],
+                       type=pa.list_(pa.struct([("a", pa.int64())]))),
+    })
+    got = _roundtrip_to_arrow(t, device=True)
+    assert got["s"].to_pylist() == t["s"].to_pylist()
+    assert got["ls"].to_pylist() == t["ls"].to_pylist()
