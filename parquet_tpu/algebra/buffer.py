@@ -56,15 +56,9 @@ class TableBuffer:
         self.num_rows += num_rows
 
     def write_arrow(self, table) -> None:
-        from ..io.writer import _column_from_arrow
+        from ..io.writer import columns_from_arrow
 
-        cols = {}
-        for leaf in self.schema.leaves:
-            arr = table[leaf.path[0]]
-            if hasattr(arr, "combine_chunks"):
-                arr = arr.combine_chunks()
-            cols[leaf.dotted_path] = _column_from_arrow(arr, leaf)
-        self.write(cols, table.num_rows)
+        self.write(columns_from_arrow(table, self.schema), table.num_rows)
 
     # ------------------------------------------------------------------
     def sort_indices(self) -> np.ndarray:

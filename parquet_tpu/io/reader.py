@@ -162,6 +162,14 @@ class ColumnChunkReader:
         return decode_chunk_host(self)
 
     # ------------------------------------------------------- indexes / filters
+    def _read_index_blob(self, offset, length, what: str) -> bytes:
+        """pread an index structure with the shared length sanity guard
+        (limits.go MaxColumnIndexSize analog); a missing or out-of-range
+        length with the offset present is corruption, not a crash."""
+        if length is None or not 0 <= length <= MAX_COLUMN_INDEX_SIZE:
+            raise CorruptedError(f"{what} length {length} out of range")
+        return self.file.source.pread(offset, length)
+
     def column_index(self) -> Optional[md.ColumnIndex]:
         if self._ci is not _UNSET:
             return self._ci
@@ -169,10 +177,8 @@ class ColumnChunkReader:
         if c.column_index_offset is None:
             self._ci = None
             return None
-        if not 0 <= (c.column_index_length or 0) <= MAX_COLUMN_INDEX_SIZE:
-            raise CorruptedError(
-                f"column index length {c.column_index_length} out of range")
-        raw = self.file.source.pread(c.column_index_offset, c.column_index_length)
+        raw = self._read_index_blob(c.column_index_offset,
+                                    c.column_index_length, "column index")
         ci, _ = thrift.deserialize(md.ColumnIndex, raw)
         self._ci = ci
         return ci
@@ -184,7 +190,8 @@ class ColumnChunkReader:
         if c.offset_index_offset is None:
             self._oi = None
             return None
-        raw = self.file.source.pread(c.offset_index_offset, c.offset_index_length)
+        raw = self._read_index_blob(c.offset_index_offset,
+                                    c.offset_index_length, "offset index")
         oi, _ = thrift.deserialize(md.OffsetIndex, raw)
         self._oi = oi
         return oi
@@ -438,7 +445,9 @@ class Table:
                     and rep_leaf.max_repetition_level == 0:
                 valid = np.asarray(col.validity)
             else:
-                return self._field_via_rows(node)  # no levels to derive nulls
+                # no levels to derive nulls; fall back to row assembly with
+                # the full-path prefix so sub-schema leaves resolve
+                return self._field_via_rows(node, prefix, def_above)
         else:
             d = np.asarray(col.def_levels)
             if rep_leaf.max_repetition_level > 0:
@@ -448,18 +457,41 @@ class Table:
             return pa.StructArray.from_arrays(arrs, names)
         return pa.StructArray.from_arrays(arrs, names, mask=pa.array(~valid))
 
-    def _field_via_rows(self, node):
+    def _field_via_rows(self, node, prefix=None, def_above: int = 0):
         """Row-model tier: assemble this field's python objects row by row,
-        then build the arrow array with the schema-derived type."""
+        then build the arrow array with the schema-derived type.
+
+        ``prefix`` is the full dotted path of ``node`` in the table schema
+        (ending with ``node.name``); the sub-schema's leaf paths start at
+        ``node.name``, so table columns are looked up at
+        ``prefix + leaf.path[1:]``. Defaults to top-level (``(node.name,)``).
+        ``def_above`` is the def-level contribution of ancestors above
+        ``node``: the sub-schema roots the tree at ``node``, so absolute def
+        levels must shift down by it (rows whose level stops above ``node``
+        — a null ancestor — clamp to 0, i.e. null at the top of the
+        sub-tree; the enclosing struct's mask hides them anyway).
+        """
+        import dataclasses
+
         import pyarrow as pa
 
         from ..rows import _Assembler, rows_from_columns
         from ..schema.schema import Schema, message
         from .column import arrow_type_of
 
+        if prefix is None:
+            prefix = (node.name,)
         sub_schema = message("root", [node])
-        cols = {l.dotted_path: self.columns[l.dotted_path]
-                for l in sub_schema.leaves}
+
+        def _sub_col(leaf):
+            col = self.columns[".".join(prefix + leaf.path[1:])]
+            if def_above and col.def_levels is not None:
+                col = dataclasses.replace(
+                    col, def_levels=np.maximum(
+                        np.asarray(col.def_levels) - def_above, 0))
+            return col
+
+        cols = {l.dotted_path: _sub_col(l) for l in sub_schema.leaves}
         asm = _Assembler(sub_schema)
         objs = [asm.assemble(row)[node.name]
                 for row in rows_from_columns(sub_schema, cols, self.num_rows)]

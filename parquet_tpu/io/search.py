@@ -241,7 +241,13 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
         remaining -= take
     if not out_parts:
         if not nested:
-            return np.empty(0)
+            if leaf.physical_type == Type.BYTE_ARRAY:
+                empty = []
+            elif leaf.physical_type == Type.FIXED_LEN_BYTE_ARRAY:
+                empty = np.empty((0, leaf.type_length or 0), np.uint8)
+            else:
+                empty = np.empty(0, leaf.np_dtype() or np.uint8)
+            return (empty, None) if aligned else empty
         from ..ops import levels as levels_ops
         from .column import Column
 

@@ -726,13 +726,7 @@ def write_table(table, sink, options: Optional[WriterOptions] = None,
     for start in range(0, max(n, 1), max(rg_size, 1)):
         end = min(start + rg_size, n) if rg_size else n
         part = table.slice(start, end - start) if (start or end < n) else table
-        cols: Dict[str, ColumnData] = {}
-        for leaf in schema.leaves:
-            name = leaf.path[0]
-            arr = part[name]
-            if isinstance(arr, pa.ChunkedArray):
-                arr = arr.combine_chunks()
-            cols[leaf.dotted_path] = _column_from_arrow(arr, leaf)
+        cols = columns_from_arrow(part, schema)
         w.write_row_group(cols, part.num_rows)
         if n == 0:
             break
@@ -819,27 +813,125 @@ def _arrow_leaf_type(t):
     raise TypeError(f"unsupported arrow type {t!r}")
 
 
-def _column_from_arrow(arr, leaf: Leaf) -> ColumnData:
-    """Extract flat buffers from an arrow array for one leaf."""
+def columns_from_arrow(table, schema: Schema) -> Dict[str, ColumnData]:
+    """Per-leaf ColumnData from an arrow table (or slice) — the single arrow
+    ingestion entry point (used by write_table and TableBuffer.write_arrow),
+    so struct-null def-level fidelity is applied uniformly."""
     import pyarrow as pa
 
+    cols: Dict[str, ColumnData] = {}
+    for leaf in schema.leaves:
+        arr = table[leaf.path[0]]
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        cd = _column_from_arrow(arr, leaf)
+        if (len(leaf.path) > 1 and leaf.max_repetition_level == 0
+                and cd.def_levels is None
+                and _struct_chain_has_nulls(arr, leaf)):
+            # an intermediate struct layer is null somewhere: emit exact
+            # def levels so None-struct vs struct-of-None round-trips
+            cd.def_levels = _struct_def_levels(arr, schema, leaf)
+        cols[leaf.dotted_path] = cd
+    return cols
+
+
+def _struct_chain_has_nulls(arr, leaf: Leaf) -> bool:
+    """True if any non-leaf struct layer on the path to ``leaf`` has nulls."""
+    import pyarrow as pa
+
+    a = arr
+    for name in leaf.path[1:]:
+        if not pa.types.is_struct(a.type):
+            return False
+        if a.null_count:
+            return True
+        a = a.field(name)
+    return False
+
+
+def _struct_def_levels(arr, schema: Schema, leaf: Leaf) -> np.ndarray:
+    """Exact per-row def levels for a flat (max_rep == 0) struct chain.
+
+    Walks the schema nodes along ``leaf.path`` top-down, counting one def
+    level per OPTIONAL layer that is present, and stopping the count at the
+    first null ancestor (child slots under a null parent are unspecified in
+    arrow, so an ``alive`` mask gates deeper contributions).
+    """
+    import pyarrow as pa
+
+    node = schema.root
+    n = len(arr)
+    d = np.zeros(n, np.int32)
+    alive = np.ones(n, bool)
+    a = arr
+    for i, name in enumerate(leaf.path):
+        node = next(c for c in node.children if c.name == name)
+        if node.repetition == Rep.OPTIONAL:
+            if a.null_count:
+                ok = alive & ~np.asarray(a.is_null())
+            else:
+                ok = alive
+            d[ok] += 1
+            alive = ok
+        if i + 1 < len(leaf.path):
+            a = a.field(leaf.path[i + 1])
+    return d
+
+
+def _column_from_arrow(arr, leaf: Leaf, pos: int = 1) -> ColumnData:
+    """Extract flat buffers from an arrow array for one leaf.
+
+    ``arr`` is the top-level (or descended) arrow array; ``pos`` indexes the
+    next component of ``leaf.path`` still to resolve below it. Struct layers
+    descend by field name with parent-struct nulls folded into the child
+    (the v1 writer collapses intermediate struct nulls to leaf nulls — see
+    write_row_group); list/map machinery consumes its two path components
+    ('list'/'element', 'key_value'/'key|value') per level. Deeply mixed
+    chains (a list *below* a struct that is itself a list element) are not
+    expressible in the single-level ColumnData form and keep the pre-existing
+    pure-list-chain limitation.
+    """
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
     t = arr.type
-    if pa.types.is_list(t) or pa.types.is_large_list(t):
+    if pa.types.is_struct(t):
+        if arr.null_count and leaf.max_repetition_level > 0:
+            raise NotImplementedError(
+                f"column {leaf.dotted_path}: null struct values mixed with "
+                "repetition are not supported by the arrow ingestion path "
+                "(write via rows/typed API for exact def levels)")
+        # fold parent-struct nulls into the child so dense value extraction
+        # (drop_null below) excludes slots under a null ancestor; exact def
+        # levels for the chain are emitted separately (_struct_def_levels)
+        child = arr.field(leaf.path[pos])
+        if arr.null_count:
+            child = pc.if_else(pc.is_valid(arr), child,
+                               pa.scalar(None, type=child.type))
+        return _column_from_arrow(child, leaf, pos + 1)
+    if pa.types.is_list(t) or pa.types.is_large_list(t) or pa.types.is_map(t):
         # walk the (possibly multi-level) list chain collecting per-level
         # offsets/validity, then emit either the single-level ColumnData form
         # or raw Dremel levels (levels_for_nested) for depth > 1
         offsets_per_level, validity_per_level = [], []
         a = arr
-        while pa.types.is_list(a.type) or pa.types.is_large_list(a.type):
+        while True:
+            ty = a.type
+            if pa.types.is_map(ty):
+                child = a.keys if leaf.path[pos + 1] == "key" else a.items
+            elif pa.types.is_list(ty) or pa.types.is_large_list(ty):
+                child = a.values
+            else:
+                break
             lv = ~np.asarray(a.is_null()) if a.null_count else None
             raw = np.asarray(a.offsets, dtype=np.int64)
-            child = a.values
+            pos += 2
             if raw[0] != 0 or len(child) != raw[-1]:  # sliced parent array
                 child = child.slice(raw[0], raw[-1] - raw[0])
             offsets_per_level.append(raw - raw[0])
             validity_per_level.append(lv)
             a = child
-        inner = _column_from_arrow(a, leaf)
+        inner = _column_from_arrow(a, leaf, pos)
         if len(offsets_per_level) == 1:
             inner.list_offsets = offsets_per_level[0]
             inner.list_validity = validity_per_level[0]

@@ -364,3 +364,37 @@ def test_to_arrow_struct_device_path():
     got = _roundtrip_to_arrow(t, device=True)
     assert got["s"].to_pylist() == t["s"].to_pylist()
     assert got["ls"].to_pylist() == t["ls"].to_pylist()
+
+
+def test_corrupted_offset_index_length():
+    # a corrupt offset_index_length must raise CorruptedError, not reach pread
+    t = pa.table({"x": pa.array(np.arange(100, dtype=np.int64))})
+    raw = _roundtrip(t, write_page_index=True)
+    pf = ParquetFile(raw)
+    chunk = pf.row_group(0).column(0)
+    assert chunk.offset_index() is not None
+    for bad in (-5, 2**40):
+        pf2 = ParquetFile(raw)
+        c2 = pf2.row_group(0).column(0)
+        c2.chunk.offset_index_length = bad
+        with pytest.raises(CorruptedError):
+            c2.offset_index()
+
+
+def test_field_via_rows_mid_recursion_prefix():
+    # _field_via_rows called on a non-top-level node must remap the
+    # sub-schema's leaf paths to full-table column keys (ADVICE r1 KeyError)
+    inner = pa.struct([("p", pa.int64()), ("q", pa.string())])
+    outer = pa.struct([("i", inner), ("z", pa.int64())])
+    rows = [{"i": {"p": 1, "q": "a"}, "z": 10},
+            None,
+            {"i": None, "z": 30},
+            {"i": {"p": 4, "q": None}, "z": 40}]
+    t = pa.table({"o": pa.array(rows, type=outer)})
+    raw = _roundtrip(t, use_dictionary=False)
+    tab = ParquetFile(raw).read()
+    node_o = next(c for c in tab.schema.root.children if c.name == "o")
+    node_i = next(c for c in node_o.children if c.name == "i")
+    via_rows = tab._field_via_rows(node_i, ("o", "i"), def_above=1)
+    vectorized = tab._build_arrow(node_i, ("o", "i"), 1)
+    assert via_rows.to_pylist() == vectorized.to_pylist()

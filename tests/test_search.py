@@ -317,3 +317,23 @@ def test_read_row_range_aligned_flat():
     vals, valid = read_row_range(pf, "x", 1, 5, aligned=True)
     np.testing.assert_array_equal(valid, [False, True, False, True, True])
     np.testing.assert_array_equal(vals[valid], [3, 5, 6])
+
+
+def test_read_row_range_aligned_empty():
+    # fully out-of-range spans must keep the documented (values, validity)
+    # tuple shape, typed for the leaf (ADVICE r1: degenerate-plan crash)
+    t = pa.table({"x": pa.array([1, 2, 3], type=pa.int64()),
+                  "s": pa.array(["a", "b", "c"])})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    pf = ParquetFile(buf.getvalue())
+    vals, valid = read_row_range(pf, "x", 10**9, 5, aligned=True)
+    assert valid is None and len(vals) == 0
+    assert vals.dtype == np.int64
+    vals, valid = read_row_range(pf, "x", 0, 0, aligned=True)
+    assert valid is None and len(vals) == 0
+    svals, svalid = read_row_range(pf, "s", 10**9, 5, aligned=True)
+    assert svalid is None and svals == []
+    # non-aligned empties keep their unaligned shapes too
+    assert read_row_range(pf, "s", 10**9, 5) == []
+    assert read_row_range(pf, "x", 10**9, 5).dtype == np.int64
