@@ -1,5 +1,6 @@
 """L4 row-group algebra: buffers, sorting, merging, conversion (SURVEY.md §1 L4)."""
 from .buffer import SortingColumn, TableBuffer, permute_column
+from .compare import compare_func_of, min_max, normalize, sort_key
 from .convert import can_convert, column_to_data, convert_table, convert_values
 from .merge import merge_files, merge_row_groups
 from .sorting import SortingWriter

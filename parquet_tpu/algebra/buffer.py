@@ -71,43 +71,14 @@ class TableBuffer:
         return np.lexsort(keys) if len(keys) > 1 else np.argsort(keys[0], kind="stable")
 
     def _sort_key(self, sc: SortingColumn) -> np.ndarray:
+        from .compare import sort_key
+
         leaf = self.schema.leaf(sc.path)
         cd = self.columns[leaf.dotted_path]
-        n = self.num_rows
         if leaf.max_repetition_level:
             raise ValueError("cannot sort by a repeated column")
-        if leaf.physical_type == Type.BYTE_ARRAY:
-            vals = np.asarray(cd.values)
-            offs = np.asarray(cd.offsets, np.int64)
-            dense = [vals[offs[i]:offs[i + 1]].tobytes() for i in range(len(offs) - 1)]
-            key = np.empty(n, dtype=object)
-            if cd.validity is None:
-                key[:] = dense
-            else:
-                key[cd.validity] = dense
-                key[~cd.validity] = None
-            # object keys: rank them (argsort of object arrays with None fails)
-            present = key != None  # noqa: E711
-            order = np.argsort(key[present], kind="stable")
-            ranks = np.empty(n, dtype=np.int64)
-            pr = np.empty(int(present.sum()), dtype=np.int64)
-            pr[order] = np.arange(len(order))
-            ranks[present] = pr + 1
-            ranks[~present] = 0 if sc.nulls_first else len(order) + 1
-            return -ranks if sc.descending else ranks
-        vals = np.asarray(cd.values)
-        if cd.validity is None:
-            if sc.descending:
-                return -vals.astype(np.int64) if np.issubdtype(vals.dtype, np.integer) else -vals
-            return vals
-        # scatter dense to slots; nulls to ±inf rank
-        slot = np.zeros(n, dtype=np.float64)
-        slot[cd.validity] = vals.astype(np.float64)
-        if sc.descending:
-            slot = -slot
-        null_key = -np.inf if sc.nulls_first else np.inf
-        slot[~cd.validity] = null_key
-        return slot
+        return sort_key(leaf, cd, self.num_rows,
+                        descending=sc.descending, nulls_first=sc.nulls_first)
 
     def sort(self) -> None:
         """Permute every column by the sort order (one gather per column)."""

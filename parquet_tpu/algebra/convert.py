@@ -17,6 +17,8 @@ from ..io.column import Column
 from ..io.reader import RowGroupReader
 from ..io.writer import ColumnData
 from ..schema.schema import Leaf, Schema
+from ..schema.types import LogicalKind as LK
+from .compare import is_unsigned
 
 # physical widenings the reference supports (smaller int → larger, float → double)
 _WIDEN_OK = {
@@ -26,27 +28,80 @@ _WIDEN_OK = {
     (Type.INT64, Type.DOUBLE),
 }
 
+# time-like logical kinds: (family, ticks per second) — unit conversion is an
+# integer rescale (widening direction only: coarse → fine stays exact)
+_TIME_UNITS = {
+    LK.TIME_MILLIS: ("time", 10**3),
+    LK.TIME_MICROS: ("time", 10**6),
+    LK.TIME_NANOS: ("time", 10**9),
+    LK.TIMESTAMP_MILLIS: ("timestamp", 10**3),
+    LK.TIMESTAMP_MICROS: ("timestamp", 10**6),
+    LK.TIMESTAMP_NANOS: ("timestamp", 10**9),
+}
+
+
+def _time_rescale(src: Leaf, dst: Leaf) -> Optional[int]:
+    """Integer multiplier for a coarse→fine time/timestamp unit widening.
+
+    None when neither side is time-like or the units already match; a
+    non-positive value rejects the conversion: narrowing (fine→coarse) and
+    cross-family (TIME↔TIMESTAMP, or time-like↔plain int) are both lossy
+    reinterpretations, not widenings."""
+    s = _TIME_UNITS.get(src.logical_kind)
+    d = _TIME_UNITS.get(dst.logical_kind)
+    if s is None and d is None:
+        return None
+    if s is None or d is None or s[0] != d[0]:
+        return -1  # cross-family (incl. time-like <-> plain int)
+    if s[1] == d[1]:
+        return None
+    if d[1] % s[1] != 0:
+        return -1  # narrowing (fine → coarse): lossy, rejected
+    return d[1] // s[1]
+
 
 def can_convert(src: Leaf, dst: Leaf) -> bool:
+    scale = _time_rescale(src, dst)
+    if scale is not None:
+        return scale > 0 and (src.physical_type == dst.physical_type or
+                              (src.physical_type, dst.physical_type) in _WIDEN_OK)
     if src.physical_type == dst.physical_type:
         return True
     return (src.physical_type, dst.physical_type) in _WIDEN_OK
 
 
 def convert_values(values: np.ndarray, src: Leaf, dst: Leaf) -> np.ndarray:
-    if src.physical_type == dst.physical_type:
-        return values
+    """Widen a dense value array from src's type to dst's.
+
+    Covers the reference's numeric widening matrix (convert.go — Convert):
+    int32 → int64/double, int64 → double, float → double — plus logical-aware
+    cases: unsigned ints zero-extend (uint32 → int64 keeps 3e9 positive), and
+    time/timestamp coarse→fine unit conversions rescale exactly. Narrowing
+    and cross-family conversions raise TypeError.
+    """
     pair = (src.physical_type, dst.physical_type)
-    if pair not in _WIDEN_OK:
+    scale = _time_rescale(src, dst)
+    if scale is not None and scale <= 0:
+        raise TypeError(
+            f"cannot convert {src.logical_kind} → {dst.logical_kind}: "
+            "narrowing time unit is lossy")
+    if src.physical_type != dst.physical_type and pair not in _WIDEN_OK:
         raise TypeError(
             f"cannot convert {src.physical_type.name} → {dst.physical_type.name}")
-    target = {Type.INT64: np.int64, Type.DOUBLE: np.float64}[dst.physical_type]
     # 64-bit pair representation → host view first
     v = np.asarray(values)
     if v.ndim == 2 and v.dtype == np.uint32 and v.shape[1] == 2:
         host_dt = np.int64 if src.physical_type == Type.INT64 else np.float64
         v = np.ascontiguousarray(v).view(host_dt).reshape(-1)
-    return v.astype(target)
+    if src.physical_type != dst.physical_type:
+        if is_unsigned(src) and np.issubdtype(v.dtype, np.signedinteger):
+            # zero-extend: reinterpret the stored bits as unsigned first
+            v = v.view(np.uint32 if v.dtype == np.dtype(np.int32) else np.uint64)
+        target = {Type.INT64: np.int64, Type.DOUBLE: np.float64}[dst.physical_type]
+        v = v.astype(target)
+    if scale is not None and scale > 1:
+        v = v * np.asarray(scale, dtype=v.dtype)
+    return v
 
 
 def convert_column_data(rg: RowGroupReader, dst_leaf: Leaf,
@@ -86,7 +141,8 @@ def column_to_data(col: Column, src: Leaf, dst: Optional[Leaf] = None) -> Column
     values = np.asarray(col.values)
     offsets = None if col.offsets is None else np.asarray(col.offsets, np.int64)
     validity = None if col.validity is None else np.asarray(col.validity)
-    if dst is not None and src.physical_type != dst.physical_type:
+    if dst is not None and (src.physical_type != dst.physical_type
+                            or _time_rescale(src, dst) is not None):
         values = convert_values(values, src, dst)
     elif values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
         host_dt = np.float64 if src.physical_type == Type.DOUBLE else np.int64

@@ -211,17 +211,30 @@ def hash_values(leaf: Leaf, values, offsets=None) -> np.ndarray:
 
 
 def hash_values_single(value, leaf: Leaf) -> np.ndarray:
+    """Hash one probe value with the writer-side PLAIN byte encoding.
+
+    Accepts order-domain values from algebra/compare.normalize: unsigned-
+    logical ints may exceed the signed range (encoded via the uint view) and
+    decimal probes arrive as unscaled ints (re-encoded to the column's
+    storage bytes: fixed-width BE for FLBA, minimal BE for BYTE_ARRAY)."""
+    from ..algebra.compare import int_to_be_bytes, is_unsigned, normalize
+    from ..schema.types import LogicalKind
+
+    value = normalize(leaf, value)
     t = leaf.physical_type
     if t == Type.INT64:
-        return xxh64_u64(np.array([value], dtype=np.int64).view(np.uint64))
+        dt = np.uint64 if is_unsigned(leaf) else np.int64
+        return xxh64_u64(np.array([value], dtype=dt).view(np.uint64))
     if t == Type.DOUBLE:
         return xxh64_u64(np.array([value], dtype=np.float64).view(np.uint64))
     if t == Type.INT32:
-        return xxh64_u32(np.array([value], dtype=np.int32).view(np.uint32))
+        dt = np.uint32 if is_unsigned(leaf) else np.int32
+        return xxh64_u32(np.array([value], dtype=dt).view(np.uint32))
     if t == Type.FLOAT:
         return xxh64_u32(np.array([value], dtype=np.float32).view(np.uint32))
-    if isinstance(value, str):
-        value = value.encode()
+    if isinstance(value, int) and leaf.logical_kind == LogicalKind.DECIMAL:
+        width = leaf.type_length if t == Type.FIXED_LEN_BYTE_ARRAY else None
+        value = int_to_be_bytes(value, width)
     return np.array([xxh64_bytes(bytes(value))], dtype=np.uint64)
 
 

@@ -21,16 +21,19 @@ import numpy as np
 from ..format import metadata as md
 from ..format.enums import BoundaryOrder, Encoding, PageType, Type
 
-_DICT_ENCODINGS = {Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY}
+from ..algebra.compare import normalize
 from ..schema.schema import Leaf
 from .reader import ColumnChunkReader, ParquetFile, RowGroupReader
 from .statistics import decode_stat_value
+
+_DICT_ENCODINGS = {Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY}
 
 
 def find(column_index: md.ColumnIndex, value, leaf: Leaf) -> int:
     """First page ordinal whose [min,max] may contain ``value`` (== number of
     pages when none can).  Binary search when boundary_order allows, else
     linear scan — same contract as the reference's ``parquet.Find``."""
+    value = normalize(leaf, value)
     n = len(column_index.null_pages or [])
     mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
     maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
@@ -70,6 +73,7 @@ def find(column_index: md.ColumnIndex, value, leaf: Leaf) -> int:
 def pages_overlapping(column_index: md.ColumnIndex, leaf: Leaf,
                       lo=None, hi=None) -> List[int]:
     """All page ordinals whose [min,max] intersects [lo, hi] (None = open)."""
+    lo, hi = normalize(leaf, lo), normalize(leaf, hi)
     n = len(column_index.null_pages or [])
     mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
     maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
@@ -96,6 +100,8 @@ def prune_row_group(rg: RowGroupReader, path, lo=None, hi=None,
     Chunk-level pruning: Statistics first, optionally the bloom filter for
     equality probes (SURVEY.md §3.3 last line)."""
     chunk = rg.column(path)
+    lo, hi = normalize(chunk.leaf, lo), normalize(chunk.leaf, hi)
+    equals = normalize(chunk.leaf, equals)
     st = chunk.statistics()
     if st is not None and st.min_value is not None and st.max_value is not None:
         if lo is not None and st.max_value < lo:

@@ -27,20 +27,12 @@ class TypedStatistics:
 
 
 def decode_stat_value(raw: Optional[bytes], leaf: Leaf):
-    if raw is None or raw == b"" and leaf.physical_type != Type.BYTE_ARRAY:
-        return None if raw is None else raw
-    t = leaf.physical_type
-    if t == Type.BOOLEAN:
-        return bool(raw[0])
-    if t == Type.INT32:
-        return int(np.frombuffer(raw[:4], np.int32)[0])
-    if t == Type.INT64:
-        return int(np.frombuffer(raw[:8], np.int64)[0])
-    if t == Type.FLOAT:
-        return float(np.frombuffer(raw[:4], np.float32)[0])
-    if t == Type.DOUBLE:
-        return float(np.frombuffer(raw[:8], np.float64)[0])
-    return bytes(raw)  # BYTE_ARRAY / FLBA / INT96: raw bytes
+    """Decode statistics bytes into the leaf's order domain (delegates to
+    algebra/compare so pruning, Find, and boundary-order checks all use one
+    logical ordering — unsigned ints non-negative, decimals unscaled int)."""
+    from ..algebra.compare import decode_order_value
+
+    return decode_order_value(raw, leaf)
 
 
 def encode_stat_value(value, physical: Type) -> bytes:

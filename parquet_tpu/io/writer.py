@@ -661,25 +661,18 @@ def _compute_statistics(leaf, data: ColumnData, n_slots, nvalues):
 
 
 def _min_max(leaf: Leaf, data: ColumnData, v0: int, v1: int):
-    if v1 <= v0:
+    """Encoded (min, max) statistics bytes for a dense value span.
+
+    Ordering and encoding delegate to algebra/compare (reference
+    compare.go): unsigned logical ints compare and encode unsigned, decimals
+    compare by unscaled integer, FLBA emits bytewise min/max."""
+    from ..algebra import compare
+
+    mn, mx = compare.min_max(leaf, data, v0, v1)
+    if mn is None:
         return None, None
-    physical = leaf.physical_type
-    vals = np.asarray(data.values)
-    if physical == Type.BYTE_ARRAY:
-        offs = np.asarray(data.offsets, dtype=np.int64)
-        items = [vals[offs[i]:offs[i + 1]].tobytes() for i in range(v0, v1)]
-        return min(items), max(items)
-    if physical in (Type.INT96, Type.FIXED_LEN_BYTE_ARRAY):
-        return None, None
-    sub = vals[v0:v1]
-    if physical == Type.FLOAT or physical == Type.DOUBLE:
-        finite = sub[~np.isnan(sub)]
-        if len(finite) == 0:
-            return None, None
-        return (encode_stat_value(finite.min(), physical),
-                encode_stat_value(finite.max(), physical))
-    return (encode_stat_value(sub.min(), physical),
-            encode_stat_value(sub.max(), physical))
+    return (compare.encode_order_value(mn, leaf),
+            compare.encode_order_value(mx, leaf))
 
 
 def _boundary_order(mins: List[bytes], maxs: List[bytes], leaf: Leaf):

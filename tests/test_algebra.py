@@ -166,3 +166,43 @@ def test_convert_widen_and_missing(rng):
     np.testing.assert_array_equal(np.asarray(got["a"]),
                                   np.asarray(t["a"]).astype(np.int64))
     assert got["new"].null_count == 500
+
+
+def test_convert_unsigned_zero_extend():
+    """uint32 -> int64/uint64 widening must zero-extend (3e9 stays positive)."""
+    import pyarrow.parquet as _pq
+
+    t = pa.table({"u": pa.array(np.array([1, 3_000_000_000, 5], np.uint32))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    target = schema_from_arrow(pa.schema([("u", pa.uint64())]))
+    (cols, n), = convert_table(pf, target)
+    np.testing.assert_array_equal(cols["u"].values,
+                                  np.array([1, 3_000_000_000, 5], np.int64))
+    out = io.BytesIO()
+    w = ParquetWriter(out, target, WriterOptions(dictionary=False))
+    w.write_row_group(cols, n)
+    w.close()
+    assert _pq.read_table(io.BytesIO(out.getvalue())).column("u").to_pylist() \
+        == [1, 3_000_000_000, 5]
+
+
+def test_convert_timestamp_unit_widening():
+    ts = [1_700_000_000_123, 1_700_000_001_456]
+    t = pa.table({"ts": pa.array(ts, type=pa.timestamp("ms"))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False, coerce_timestamps=None)
+    pf = ParquetFile(buf.getvalue())
+    target = schema_from_arrow(pa.schema([("ts", pa.timestamp("us"))]))
+    (cols, n), = convert_table(pf, target)
+    np.testing.assert_array_equal(cols["ts"].values, np.array(ts) * 1000)
+    # narrowing (us -> ms) is lossy and must raise
+    back = schema_from_arrow(pa.schema([("ts", pa.timestamp("ms"))]))
+    src_pf = ParquetFile(buf.getvalue())
+    from parquet_tpu.algebra.convert import can_convert, convert_values
+    us_leaf = target.leaf("ts")
+    ms_leaf = back.leaf("ts")
+    assert not can_convert(us_leaf, ms_leaf)
+    with pytest.raises(TypeError):
+        convert_values(np.array(ts), us_leaf, ms_leaf)

@@ -1,0 +1,186 @@
+"""Consolidated ordering tests (algebra/compare — reference compare.go).
+
+Covers the three round-1 divergence bugs: unsigned-as-signed stats, int64
+sort keys through float64, and unique byte-array ranks breaking multi-key
+sorts — plus decimal ordering and compare_func_of semantics.
+"""
+
+import decimal
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.algebra.buffer import SortingColumn, TableBuffer
+from parquet_tpu.algebra.compare import (compare_func_of, decode_order_value,
+                                         encode_order_value, min_max,
+                                         normalize, sort_key)
+from parquet_tpu.io.reader import ParquetFile
+from parquet_tpu.io.search import find, pages_overlapping, prune_row_group
+from parquet_tpu.io.writer import WriterOptions, schema_from_arrow, write_table
+
+
+def _leaf_of(table, name):
+    return schema_from_arrow(table.schema).leaf(name)
+
+
+def test_multikey_sort_with_byte_array_ties():
+    # equal primary values must fall through to the secondary key
+    t = pa.table({"a": pa.array(["x", "x", "x", "y"]),
+                  "b": pa.array([3, 1, 2, 0], type=pa.int64())})
+    s = schema_from_arrow(t.schema)
+    buf = TableBuffer(s, [SortingColumn("a"), SortingColumn("b")])
+    buf.write_arrow(t)
+    idx = buf.sort_indices()
+    assert [t.column("b")[int(i)].as_py() for i in idx] == [1, 2, 3, 0]
+
+
+def test_unsigned_stats_roundtrip_and_prune():
+    vals = np.array([1, 3_000_000_000, 5], np.uint32)
+    t = pa.table({"u": pa.array(vals)})
+    b = io.BytesIO()
+    write_table(t, b, WriterOptions(dictionary=False))
+    pf = ParquetFile(b.getvalue())
+    st = pf.row_group(0).column(0).statistics()
+    assert (st.min_value, st.max_value) == (1, 3_000_000_000)
+    # pruning compares in the unsigned domain
+    assert prune_row_group(pf.row_group(0), 0, lo=2_999_999_999)
+    assert not prune_row_group(pf.row_group(0), 0, lo=3_000_000_001)
+
+    big = np.array([1, 2**63 + 5, 7], np.uint64)
+    t2 = pa.table({"u": pa.array(big)})
+    b2 = io.BytesIO()
+    write_table(t2, b2, WriterOptions(dictionary=False))
+    st2 = ParquetFile(b2.getvalue()).row_group(0).column(0).statistics()
+    assert (st2.min_value, st2.max_value) == (1, 2**63 + 5)
+
+
+def test_int64_sort_key_precision():
+    # keys beyond 2^53 must not collapse through a float64 scatter
+    a, bq = 2**60, 2**60 + 1
+    t = pa.table({"x": pa.array([bq, None, a], type=pa.int64())})
+    s = schema_from_arrow(t.schema)
+    buf = TableBuffer(s, [SortingColumn("x")])
+    buf.write_arrow(t)
+    idx = list(buf.sort_indices())
+    assert idx == [2, 0, 1]  # a < b < null(last)
+
+
+def test_sort_key_null_placement_independent_of_direction():
+    t = pa.table({"x": pa.array([5, None, 3], type=pa.int64())})
+    s = schema_from_arrow(t.schema)
+    leaf = s.leaf("x")
+    buf = TableBuffer(s, [])
+    buf.write_arrow(t)
+    cd = buf.columns["x"]
+    k_desc_nlast = sort_key(leaf, cd, 3, descending=True, nulls_first=False)
+    order = list(np.argsort(k_desc_nlast, kind="stable"))
+    assert order == [0, 2, 1]  # 5, 3, null
+    k_desc_nfirst = sort_key(leaf, cd, 3, descending=True, nulls_first=True)
+    assert list(np.argsort(k_desc_nfirst, kind="stable")) == [1, 0, 2]
+
+
+def test_flba_stats_now_emitted():
+    t = pa.table({"f": pa.array([b"bbbb", b"aaaa", b"cccc"],
+                                type=pa.binary(4))})
+    b = io.BytesIO()
+    write_table(t, b, WriterOptions(dictionary=False))
+    st = ParquetFile(b.getvalue()).row_group(0).column(0).statistics()
+    assert (st.min_value, st.max_value) == (b"aaaa", b"cccc")
+    # pyarrow agrees
+    pst = pq.ParquetFile(io.BytesIO(b.getvalue())).metadata.row_group(0).column(0).statistics
+    assert pst.min == b"aaaa" and pst.max == b"cccc"
+
+
+def test_decimal_order_and_find():
+    rows = [decimal.Decimal("-12.34"), decimal.Decimal("5.00"),
+            decimal.Decimal("99.99")]
+    t = pa.table({"d": pa.array(rows, type=pa.decimal128(6, 2))})
+    b = io.BytesIO()
+    pq.write_table(t, b, write_page_index=True, use_dictionary=False,
+                   store_decimal_as_integer=False)
+    pf = ParquetFile(b.getvalue())
+    leaf = pf.schema.leaf("d")
+    st = pf.row_group(0).column(0).statistics()
+    # order domain = unscaled int; -12.34 must be the min (BE two's complement)
+    assert st.min_value == -1234 and st.max_value == 9999
+    ci = pf.row_group(0).column(0).column_index()
+    if ci is not None:
+        assert find(ci, decimal.Decimal("5.00"), leaf) == 0
+        assert pages_overlapping(ci, leaf, lo=decimal.Decimal("100.00")) == []
+
+
+def test_compare_func_of_semantics():
+    t = pa.table({"x": pa.array([1], type=pa.int64())})
+    leaf = _leaf_of(t, "x")
+    cmp = compare_func_of(leaf)
+    assert cmp(1, 2) == -1 and cmp(2, 1) == 1 and cmp(1, 1) == 0
+    assert cmp(None, 5) == 1 and cmp(5, None) == -1  # nulls last by default
+    cmp_nf = compare_func_of(leaf, nulls_first=True)
+    assert cmp_nf(None, 5) == -1
+    cmp_desc = compare_func_of(leaf, descending=True, nulls_first=False)
+    assert cmp_desc(1, 2) == 1  # descending flips values
+    assert cmp_desc(None, 5) == 1  # ...but not null placement
+    # NaN after numbers
+    tf = pa.table({"f": pa.array([1.0])})
+    fcmp = compare_func_of(_leaf_of(tf, "f"))
+    assert fcmp(float("nan"), 1e300) == 1 and fcmp(1e300, float("nan")) == -1
+
+
+def test_normalize_and_encode_roundtrip():
+    t = pa.table({"s": pa.array(["a"]),
+                  "u": pa.array(np.array([1], np.uint64))})
+    sl, ul = _leaf_of(t, "s"), _leaf_of(t, "u")
+    assert normalize(sl, "héllo") == "héllo".encode("utf-8")
+    v = 2**63 + 123
+    assert decode_order_value(encode_order_value(v, ul), ul) == v
+
+
+def test_bloom_probe_unsigned_and_decimal():
+    """Bloom probes must hash the writer-side storage bytes for normalized
+    order-domain values (unsigned beyond int range, decimal unscaled ints)."""
+    from parquet_tpu.io.writer import write_table as wt
+
+    tu = pa.table({"u": pa.array(np.array([7, 3_000_000_000], np.uint32))})
+    bu = io.BytesIO()
+    wt(tu, bu, WriterOptions(dictionary=False, bloom_filters={"u": 10}))
+    pf = ParquetFile(bu.getvalue())
+    bf = pf.row_group(0).column(0).bloom_filter()
+    leaf = pf.schema.leaf("u")
+    assert bf.check(3_000_000_000, leaf)
+    assert not bf.check(8, leaf)
+
+    td = pa.table({"d": pa.array([decimal.Decimal("5.00"),
+                                  decimal.Decimal("7.25")],
+                                 type=pa.decimal128(6, 2))})
+    bd = io.BytesIO()
+    wt(td, bd, WriterOptions(dictionary=False, bloom_filters={"d": 10}))
+    pfd = ParquetFile(bd.getvalue())
+    bfd = pfd.row_group(0).column(0).bloom_filter()
+    dleaf = pfd.schema.leaf("d")
+    assert bfd.check(decimal.Decimal("5.00"), dleaf)
+    assert not bfd.check(decimal.Decimal("-1.00"), dleaf)  # no crash, miss
+
+
+def test_byte_array_decimal_stat_encode_roundtrip():
+    t = pa.table({"d": pa.array([decimal.Decimal("-12.34")],
+                                type=pa.decimal128(30, 2))})
+    leaf = schema_from_arrow(t.schema).leaf("d")
+    for v in (-1234, 9999, 0):
+        raw = encode_order_value(v, leaf)
+        assert decode_order_value(raw, leaf) == v
+
+
+def test_cross_family_time_conversion_rejected():
+    from parquet_tpu.algebra.convert import can_convert, convert_values
+
+    tt = pa.table({"t": pa.array([1], type=pa.time64("us")),
+                   "ts": pa.array([1], type=pa.timestamp("us"))})
+    s = schema_from_arrow(tt.schema)
+    t_leaf, ts_leaf = s.leaf("t"), s.leaf("ts")
+    assert not can_convert(t_leaf, ts_leaf)
+    assert not can_convert(ts_leaf, t_leaf)
+    with pytest.raises(TypeError):
+        convert_values(np.array([1], np.int64), t_leaf, ts_leaf)

@@ -128,3 +128,33 @@ def test_concurrent_reads():
     assert not errors
     got = np.concatenate(results)
     np.testing.assert_array_equal(got, np.arange(80000))
+
+
+@settings(deadline=None, max_examples=40,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(vals=st.lists(st.integers(min_value=-2**31, max_value=2**31 - 1),
+                     min_size=1, max_size=50),
+       dst_kind=st.sampled_from(["i64", "f64"]))
+def test_widening_roundtrip_property(vals, dst_kind):
+    """Every supported widening pair round-trips exactly through
+    convert_table + write + pyarrow read (hypothesis, VERDICT r1 #8)."""
+    import pyarrow.parquet as _pq
+
+    from parquet_tpu.algebra.convert import convert_table
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.io.writer import (ParquetWriter, WriterOptions,
+                                       schema_from_arrow, write_table)
+
+    t = pa.table({"x": pa.array(np.array(vals, np.int32))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    dst = pa.int64() if dst_kind == "i64" else pa.float64()
+    target = schema_from_arrow(pa.schema([("x", dst)]))
+    (cols, n), = convert_table(pf, target)
+    out = io.BytesIO()
+    w = ParquetWriter(out, target, WriterOptions(dictionary=False))
+    w.write_row_group(cols, n)
+    w.close()
+    got = _pq.read_table(io.BytesIO(out.getvalue())).column("x").to_pylist()
+    assert got == [float(v) if dst_kind == "f64" else v for v in vals]
