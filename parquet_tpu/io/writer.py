@@ -33,7 +33,6 @@ from ..ops import levels as levels_ops, ref
 from ..schema import schema as sch
 from ..schema.schema import Leaf, Schema
 from ..schema.types import LogicalKind
-from .statistics import encode_stat_value
 
 DEFAULT_CREATED_BY = "parquet-tpu version 0.1.0"
 

@@ -324,6 +324,49 @@ def scatter_valid(values: jax.Array, validity: jax.Array) -> jax.Array:
     return jnp.where(validity, gathered, zero)
 
 
+@partial(jax.jit, static_argnames=("is_float", "is_unsigned"))
+def pair_range_mask(pairs: jax.Array, lo_pair: jax.Array, hi_pair: jax.Array,
+                    has_lo: jax.Array, has_hi: jax.Array,
+                    is_float: bool = False,
+                    is_unsigned: bool = False) -> jax.Array:
+    """lo <= value <= hi over the (n, 2) uint32 pair representation of
+    64-bit values, without x64 mode.
+
+    Comparison is lexicographic on (high word as ordering key, low word
+    unsigned). For int64 the high word orders as *signed* int32 (unsigned
+    logical: plain uint32); for double the IEEE total order needs the
+    sign-magnitude flip (negative values order reversed), applied to both
+    words of value and bounds. NaN keys are not treated specially (a range
+    reaching +inf admits positive NaN bit patterns).
+    """
+    hw_dt = jnp.uint32 if is_unsigned else jnp.int32
+    lo_w = pairs[:, 0]
+    hi_w = pairs[:, 1].astype(hw_dt)
+    b_lo = lo_pair[0]
+    b_hi_lo = hi_pair[0]
+    b_lo_hi = lo_pair[1].astype(hw_dt)
+    b_hi_hi = hi_pair[1].astype(hw_dt)
+    if is_float:
+        # IEEE-754 total-order trick: flip all bits of negatives, flip only
+        # the sign bit of non-negatives → unsigned lexicographic order
+        def flip(h, l):
+            neg = h < 0
+            h_u = h.astype(jnp.uint32)
+            fh = jnp.where(neg, ~h_u, h_u ^ jnp.uint32(0x80000000))
+            fl = jnp.where(neg, ~l, l)
+            return fh, fl
+
+        hi_w_u, lo_w = flip(hi_w, lo_w)
+        b_lo_hi_u, b_lo = flip(b_lo_hi, b_lo)
+        b_hi_hi_u, b_hi_lo = flip(b_hi_hi, b_hi_lo)
+        ge_lo = (hi_w_u > b_lo_hi_u) | ((hi_w_u == b_lo_hi_u) & (lo_w >= b_lo))
+        le_hi = (hi_w_u < b_hi_hi_u) | ((hi_w_u == b_hi_hi_u) & (lo_w <= b_hi_lo))
+    else:
+        ge_lo = (hi_w > b_lo_hi) | ((hi_w == b_lo_hi) & (lo_w >= b_lo))
+        le_hi = (hi_w < b_hi_hi) | ((hi_w == b_hi_hi) & (lo_w <= b_hi_lo))
+    return (~has_lo | ge_lo) & (~has_hi | le_hi)
+
+
 def pad_to_bucket(arr: np.ndarray, extra: int = 12) -> np.ndarray:
     """Pad a host buffer to a power-of-two bucket (+slack for 12-byte gathers)
     so jit specializations are reused across similarly-sized pages."""

@@ -222,7 +222,12 @@ class _Plan:
             raise _Unsupported(f"mixed page encodings {self.value_kind}/{kind}")
 
 
-def build_plan(reader: ColumnChunkReader) -> _Plan:
+def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
+    """Host prescan of a chunk's pages into a staging plan.
+
+    ``pages`` (an iterator of PageInfo, e.g. from io/search.seek_pages)
+    restricts the plan to a page subset — the pushdown scan path; the
+    dictionary page must be included when the chunk is dict-encoded."""
     leaf = reader.leaf
     codec = reader.codec
     physical = Type(reader.meta.type)
@@ -230,7 +235,7 @@ def build_plan(reader: ColumnChunkReader) -> _Plan:
     max_rep = leaf.max_repetition_level
     plan = _Plan()
 
-    for page in reader.pages():
+    for page in (reader.pages() if pages is None else pages):
         h = page.header
         pt = page.page_type
         if pt == PageType.DICTIONARY_PAGE:

@@ -1,0 +1,192 @@
+"""Device pushdown scan tests (parallel/host_scan.scan_filtered_device) +
+bloom-filter chunk pruning in the scan planner (VERDICT r1 item 4)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.io.reader import ParquetFile
+from parquet_tpu.io.search import plan_scan
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.ops.device import pairs_to_host
+from parquet_tpu.parallel.host_scan import scan_filtered, scan_filtered_device
+
+
+def _lineitem(n=60000, rg=4):
+    rng = np.random.default_rng(17)
+    ship = np.sort(rng.integers(8000, 12000, n).astype(np.int32))
+    t = pa.table({
+        "l_shipdate": pa.array(ship),
+        "l_orderkey": pa.array(np.arange(n, dtype=np.int64)),
+        "l_extendedprice": pa.array(rng.random(n) * 1e5),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // rg, data_page_size=1 << 15,
+                   compression="snappy", use_dictionary=False,
+                   write_page_index=True)
+    return ParquetFile(buf.getvalue())
+
+
+def test_device_scan_matches_host_scan():
+    pf = _lineitem()
+    host = scan_filtered(pf, "l_shipdate", lo=9000, hi=9200,
+                         columns=["l_extendedprice", "l_orderkey"])
+    dev = scan_filtered_device(pf, "l_shipdate", lo=9000, hi=9200,
+                               columns=["l_extendedprice", "l_orderkey"])
+    np.testing.assert_allclose(pairs_to_host(dev["l_extendedprice"], np.float64),
+                               host["l_extendedprice"])
+    np.testing.assert_array_equal(pairs_to_host(dev["l_orderkey"], np.int64),
+                                  host["l_orderkey"])
+
+
+def test_device_scan_int64_pair_key_and_nullable_output():
+    rng = np.random.default_rng(3)
+    n = 40000
+    vals = np.arange(n, dtype=np.int64) * (2**40)  # beyond float64-exact ints
+    price = rng.random(n) * 2e5 - 1e5
+    pm = rng.random(n) < 0.02
+    t = pa.table({"k": pa.array(vals),
+                  "p": pa.array(np.where(pm, 0.0, price), mask=pm)})
+    b = io.BytesIO()
+    pq.write_table(t, b, row_group_size=n // 4, data_page_size=1 << 14,
+                   use_dictionary=False, write_page_index=True)
+    pf = ParquetFile(b.getvalue())
+    lo, hi = int(0.3 * n) * (2**40), int(0.32 * n) * (2**40)
+    host = scan_filtered(pf, "k", lo=lo, hi=hi, columns=["p"])
+    dev = scan_filtered_device(pf, "k", lo=lo, hi=hi, columns=["p"])
+    pv, pvalid = dev["p"] if isinstance(dev["p"], tuple) else (dev["p"], None)
+    pv = pairs_to_host(pv, np.float64)
+    hmask = np.ma.getmaskarray(host["p"])
+    assert pvalid is not None
+    np.testing.assert_array_equal(np.asarray(pvalid), ~hmask)
+    np.testing.assert_allclose(pv[~hmask], host["p"].compressed())
+
+
+def test_device_scan_negative_double_key_total_order():
+    rng = np.random.default_rng(5)
+    n = 30000
+    d = np.sort(rng.random(n) * 2e5 - 1e5)
+    t = pa.table({"d": pa.array(d), "v": pa.array(np.arange(n, dtype=np.int32))})
+    b = io.BytesIO()
+    pq.write_table(t, b, row_group_size=n // 4, data_page_size=1 << 14,
+                   use_dictionary=False, write_page_index=True)
+    pf = ParquetFile(b.getvalue())
+    host = scan_filtered(pf, "d", lo=-5000.0, hi=1000.0, columns=["v"])
+    dev = scan_filtered_device(pf, "d", lo=-5000.0, hi=1000.0, columns=["v"])
+    np.testing.assert_array_equal(np.asarray(dev["v"]), host["v"])
+
+
+def test_device_scan_dict_string_output():
+    rng = np.random.default_rng(9)
+    n = 20000
+    cats = np.array([f"cat_{i:02d}" for i in range(40)])
+    t = pa.table({"k": pa.array(np.sort(rng.integers(0, 1000, n).astype(np.int32))),
+                  "s": pa.array(cats[rng.integers(0, 40, n)]).dictionary_encode()})
+    b = io.BytesIO()
+    pq.write_table(t, b, row_group_size=n // 2, data_page_size=1 << 14,
+                   use_dictionary=True, write_page_index=True)
+    pf = ParquetFile(b.getvalue())
+    host = scan_filtered(pf, "k", lo=100, hi=150, columns=["s"])
+    dev = scan_filtered_device(pf, "k", lo=100, hi=150, columns=["s"])
+    dictionary, indices = dev["s"]
+    dvals, doffs = dictionary if isinstance(dictionary, tuple) else (dictionary, None)
+    dv = np.asarray(dvals)
+    do = np.asarray(doffs)
+    idx = np.asarray(indices)
+    got = [dv[do[i]:do[i + 1]].tobytes().decode() for i in idx]
+    assert got == [x.decode() if isinstance(x, bytes) else x for x in host["s"]]
+
+
+def test_bloom_pruned_chunk_is_never_read():
+    """A row group excluded by its bloom filter must not have any page read
+    (SURVEY.md §3.3: BloomFilter().Check before touching pages)."""
+    # two row groups with overlapping [min, max] but disjoint value sets:
+    # rg0 = evens 0..9998, rg1 = odds 1..9999 → stats cannot prune an even
+    # probe from rg1, only the bloom filter can
+    evens = np.arange(0, 10000, 2, dtype=np.int64)
+    odds = np.arange(1, 10000, 2, dtype=np.int64)
+    t = pa.table({"k": pa.array(np.concatenate([evens, odds])),
+                  "v": pa.array(np.arange(10000, dtype=np.float64))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(dictionary=False, row_group_size=5000,
+                                      bloom_filters={"k": 12},
+                                      write_page_index=True))
+    pf = ParquetFile(buf.getvalue())
+    assert len(pf.row_groups) == 2
+    # sanity: stats alone cannot prune rg1 for an even probe
+    st1 = pf.row_group(1).column(0).statistics()
+    assert st1.min_value <= 4242 <= st1.max_value
+
+    forbidden = pf.row_group(1).column("k")
+    calls = {"n": 0}
+    orig_pages, orig_pages_at = forbidden.pages, forbidden.pages_at
+
+    def trap(*a, **k):
+        calls["n"] += 1
+        raise AssertionError("bloom-pruned chunk was read")
+
+    forbidden.pages = trap
+    forbidden.pages_at = trap
+    try:
+        plans = plan_scan(pf, "k", lo=4242, hi=4242, use_bloom=True)
+        assert [p.rg_index for p in plans] == [0]
+        out = scan_filtered(pf, "k", lo=4242, hi=4242, columns=["v"],
+                            use_bloom=True)
+    finally:
+        forbidden.pages, forbidden.pages_at = orig_pages, orig_pages_at
+    assert calls["n"] == 0
+    assert len(out["v"]) == 1
+    # without bloom, rg1 is decoded (and still yields no rows)
+    out2 = scan_filtered(pf, "k", lo=4242, hi=4242, columns=["v"],
+                         use_bloom=False)
+    np.testing.assert_array_equal(out2["v"], out["v"])
+
+
+def test_device_scan_unsigned_key():
+    vals = np.array([7, 2_900_000_000, 3_000_000_000, 3_100_000_000], np.uint32)
+    t = pa.table({"u": pa.array(vals), "v": pa.array(np.arange(4, dtype=np.int32))})
+    b = io.BytesIO()
+    pq.write_table(t, b, use_dictionary=False, write_page_index=True)
+    pf = ParquetFile(b.getvalue())
+    host = scan_filtered(pf, "u", lo=2_950_000_000, hi=3_050_000_000,
+                         columns=["v"])
+    dev = scan_filtered_device(pf, "u", lo=2_950_000_000, hi=3_050_000_000,
+                               columns=["v"])
+    np.testing.assert_array_equal(np.asarray(dev["v"]), host["v"])
+    assert list(host["v"]) == [2]
+
+
+def test_device_scan_multi_rowgroup_dict_rebase():
+    """Dictionary outputs across row groups with different dictionaries must
+    rebase indices instead of reusing span 0's dictionary."""
+    n = 8000
+    k = np.sort(np.arange(n, dtype=np.int32))
+    # rg0 strings disjoint from rg1 strings → different dictionary pages
+    s = np.array([f"rg0_{i % 7}" for i in range(n // 2)]
+                 + [f"rg1_{i % 5}" for i in range(n // 2)])
+    t = pa.table({"k": pa.array(k), "s": pa.array(s).dictionary_encode()})
+    b = io.BytesIO()
+    pq.write_table(t, b, row_group_size=n // 2, use_dictionary=True,
+                   write_page_index=True, data_page_size=1 << 13)
+    pf = ParquetFile(b.getvalue())
+    lo, hi = n // 2 - 100, n // 2 + 100  # straddles the row-group boundary
+    host = scan_filtered(pf, "k", lo=lo, hi=hi, columns=["s"])
+    dev = scan_filtered_device(pf, "k", lo=lo, hi=hi, columns=["s"])
+    dictionary, indices = dev["s"]
+    dvals, doffs = dictionary
+    dv, do, idx = np.asarray(dvals), np.asarray(doffs), np.asarray(indices)
+    got = [dv[do[i]:do[i + 1]].tobytes().decode() for i in idx]
+    want = [x.decode() if isinstance(x, bytes) else x for x in host["s"]]
+    assert got == want
+
+
+def test_device_scan_empty_result_typed():
+    pf = _lineitem(n=4000, rg=2)
+    dev = scan_filtered_device(pf, "l_shipdate", lo=10**6, hi=2 * 10**6,
+                               columns=["l_extendedprice", "l_orderkey"])
+    ep = pairs_to_host(dev["l_extendedprice"], np.float64)
+    ok = pairs_to_host(dev["l_orderkey"], np.int64)
+    assert len(ep) == 0 and len(ok) == 0
