@@ -173,7 +173,8 @@ def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None):
     host_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    staged = dr.stage_plan(plan, stage_levels=chunk.leaf.max_repetition_level == 0)
+    staged = dr.stage_plan(plan,
+                           stage_levels=dr.stage_levels_on_device(chunk.leaf, plan))
     jax.block_until_ready([b for b in staged if b is not None])
     h2d_s = time.perf_counter() - t0
 
@@ -306,12 +307,16 @@ def _cfg5(n):
     return {
         "rows_selected": int(rows_out),
         "selectivity": round(rows_out / n, 4),
+        # vs_pyarrow keeps its original meaning: host scan WALL CLOCK vs
+        # pyarrow wall clock (apples to apples, trend-comparable across
+        # rounds); the device phase is reported separately under dev_*
+        # with the configs-1-4 kernel-time convention.
         "scan_s": round(ours_s, 4),
-        "host_vs_pyarrow": round(pa_s / ours_s, 2),
+        "vs_pyarrow": round(pa_s / ours_s, 2),
         "dev_kernel_s": round(dev_s, 4),
         "dev_stage_s": round(stage_s, 4),
+        "dev_vs_pyarrow": round(pa_s / dev_s, 2),
         "pyarrow_s": round(pa_s, 4),
-        "vs_pyarrow": round(pa_s / dev_s, 2),
     }
 
 

@@ -166,6 +166,19 @@ def _npages(oi) -> int:
 # ---------------------------------------------------------------------------
 
 
+def pages_and_base(chunk: ColumnChunkReader, row_start: int, row_end: int):
+    """Selected pages covering [row_start, row_end) plus the first row the
+    selection actually starts at (page-aligned trim base for callers that
+    decode whole pages). Shared by read_row_range and the device scan."""
+    pages = list(seek_pages(chunk, row_start, row_end))
+    first = 0
+    oi = chunk.offset_index()
+    if oi is not None and oi.page_locations:
+        firsts = [pl.first_row_index for pl in oi.page_locations]
+        first = firsts[max(bisect_right(firsts, row_start) - 1, 0)]
+    return pages, first
+
+
 def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
     """Yield the dictionary page (if any) + the data pages covering
     [row_start, row_end) — reference's ``Pages.SeekToRow`` + read loop.
@@ -232,13 +245,8 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
             continue
         take = min(nrows - remaining_start, remaining)
         chunk = rg.column(leaf.column_index)
-        oi = chunk.offset_index()
-        pages = list(seek_pages(chunk, remaining_start, remaining_start + take))
-        first_row_of_pages = 0
-        if oi is not None and oi.page_locations:
-            firsts = [pl.first_row_index for pl in oi.page_locations]
-            i0 = max(bisect_right(firsts, remaining_start) - 1, 0)
-            first_row_of_pages = firsts[i0]
+        pages, first_row_of_pages = pages_and_base(
+            chunk, remaining_start, remaining_start + take)
         col = decode_chunk_host(chunk, pages=iter(pages))
         trim = (_trim_flat_aligned if aligned
                 else _trim_nested if nested else _trim_flat)

@@ -367,6 +367,51 @@ def pair_range_mask(pairs: jax.Array, lo_pair: jax.Array, hi_pair: jax.Array,
     return (~has_lo | ge_lo) & (~has_hi | le_hi)
 
 
+def assemble_single_list(def_levels: jax.Array, rep_levels: jax.Array,
+                         dk: int, max_def: int):
+    """Device twin of ops/levels.assemble for ONE repeated ancestor
+    (SURVEY.md §7 hard part 4: level→(validity, offsets) as vector ops).
+
+    ``dk`` is the repeated ancestor's def level. Returns
+    ``(list_offsets, list_validity, leaf_validity)`` as device arrays — the
+    same semantics as the host assembler: instances are row starts
+    (``rep == 0``), elements are slots with ``def >= dk``, a row's list is
+    non-null iff its start slot has ``def >= dk - 1``, and leaf validity
+    (over elements) is ``def == max_def``.
+
+    Shapes are data-dependent (rows, elements), so two scalar D2H syncs fix
+    the sizes; all heavy math stays on device.
+    """
+    d = def_levels
+    r = rep_levels
+    inst_mask = r == 0
+    elem = d >= dk
+    cum, n_rows, n_elem = _asl_cums(d, r, dk)
+    n_rows = int(n_rows)
+    n_elem = int(n_elem)
+    return _asl_finish(d, cum, inst_mask, elem, n_rows, n_elem, dk, max_def)
+
+
+@jax.jit
+def _asl_cums(d: jax.Array, r: jax.Array, dk: int):
+    elem = d >= dk
+    cum = jnp.cumsum(elem.astype(jnp.int32))
+    return cum, jnp.sum((r == 0).astype(jnp.int32)), cum[-1] if d.shape[0] else jnp.int32(0)
+
+
+@partial(jax.jit, static_argnames=("n_rows", "n_elem", "dk", "max_def"))
+def _asl_finish(d, cum, inst_mask, elem, n_rows: int, n_elem: int,
+                dk: int, max_def: int):
+    inst_idx = jnp.nonzero(inst_mask, size=n_rows, fill_value=0)[0]
+    starts = cum[inst_idx] - elem[inst_idx].astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [starts, cum[-1:] if d.shape[0] else jnp.zeros(1, jnp.int32)])
+    list_validity = d[inst_idx] >= (dk - 1)
+    elem_idx = jnp.nonzero(elem, size=n_elem, fill_value=0)[0]
+    leaf_validity = (d == max_def)[elem_idx]
+    return offsets, list_validity, leaf_validity
+
+
 def pad_to_bucket(arr: np.ndarray, extra: int = 12) -> np.ndarray:
     """Pad a host buffer to a power-of-two bucket (+slack for 12-byte gathers)
     so jit specializations are reused across similarly-sized pages."""

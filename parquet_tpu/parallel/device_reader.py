@@ -484,7 +484,26 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         meta["vruns"] = jax.device_put(plan.vruns.run_arrays())
     if stage_levels and plan.def_runs.total:
         meta["def_runs"] = jax.device_put(plan.def_runs.run_arrays())
+    if stage_levels and plan.rep_runs.total:
+        meta["rep_runs"] = jax.device_put(plan.rep_runs.run_arrays())
     return lev_dbuf, val_dbuf, meta
+
+
+def stage_levels_on_device(leaf, plan: _Plan) -> bool:
+    """Whether the level streams should go to HBM: flat columns (def
+    validity on device) and *top-level* single-level lists (device
+    assembly). Lists under struct layers — and any deeper nesting — expand
+    levels on host instead: the table assembler needs host def levels to
+    derive struct nullness, which the device assembly does not keep."""
+    if leaf.max_repetition_level == 0:
+        return True
+    from ..format.enums import FieldRepetitionType as _Rep
+
+    anc = leaf.ancestors  # (list group, repeated node, leaf) for a top list
+    return (leaf.max_repetition_level == 1 and len(anc) == 3
+            and anc[1].repetition == _Rep.REPEATED
+            and bool(plan.def_runs.total) and bool(plan.rep_runs.total)
+            and not plan.host_def)
 
 
 def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
@@ -492,7 +511,7 @@ def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
     try:
         plan = build_plan(reader)
         staged = stage_plan(plan,
-                            stage_levels=reader.leaf.max_repetition_level == 0)
+                            stage_levels=stage_levels_on_device(reader.leaf, plan))
         col = decode_staged(reader.leaf, Type(reader.meta.type), plan, staged,
                             keep_dictionary=keep_dictionary)
         counters.inc("chunks_device_decoded")
@@ -517,33 +536,52 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
 
     # ---- levels -----------------------------------------------------------
     # Flat optional columns: expand def levels on device (validity mask stays
-    # in HBM).  Nested columns: the record assembler consumes levels on host,
-    # so expand them there directly — no device work, no D2H sync.
+    # in HBM).  Simple single-level lists: expand AND assemble on device
+    # (SURVEY.md §7 hard part 4 — config 4's shape).  Struct chains and
+    # deeper nesting: the record assembler consumes levels on host, so
+    # expand them there once — no device work, no double expansion.
     def_levels = None
     def_host = rep_host = None
+    device_asm = None
+    validity = None
     if max_rep > 0:
-        lev_host = np.frombuffer(bytes(plan.levels), np.uint8)
-        if plan.def_runs.total:
-            def_host = plan.def_runs.expand_host(lev_host)
-        elif plan.host_def:
-            def_host = np.concatenate(plan.host_def).astype(np.int32)
-        if plan.rep_runs.total:
-            rep_host = plan.rep_runs.expand_host(lev_host)
+        infos = levels_ops.repeated_ancestors(leaf)
+        if lev_dbuf is not None and stage_levels_on_device(leaf, plan):
+            d_dev = plan.def_runs.expand(lev_dbuf,
+                                         tables=staged_meta.get("def_runs"))
+            r_dev = plan.rep_runs.expand(lev_dbuf,
+                                         tables=staged_meta.get("rep_runs"))
+            device_asm = dev.assemble_single_list(
+                d_dev, r_dev, infos[0].def_level, max_def)
         else:
-            rep_host = np.zeros(len(def_host) if def_host is not None else 0,
-                                np.int32)
+            lev_host = np.frombuffer(bytes(plan.levels), np.uint8)
+            if plan.def_runs.total:
+                def_host = plan.def_runs.expand_host(lev_host)
+            elif plan.host_def:
+                def_host = np.concatenate(plan.host_def).astype(np.int32)
+            if plan.rep_runs.total:
+                rep_host = plan.rep_runs.expand_host(lev_host)
+            else:
+                rep_host = np.zeros(len(def_host) if def_host is not None else 0,
+                                    np.int32)
     else:
-        if plan.def_runs.total:
-            def_levels = plan.def_runs.expand(lev_dbuf,
-                                              tables=staged_meta.get("def_runs"))
-            if max_def > 1:  # struct layers: keep host levels for reassembly
+        if max_def > 1 and (plan.def_runs.total or plan.host_def):
+            # struct layers: the table assembler needs host def levels for
+            # struct-validity zips — expand once on host and derive the leaf
+            # validity from it (round 1 expanded on device AND host)
+            if plan.def_runs.total:
                 def_host = plan.def_runs.expand_host(
                     np.frombuffer(bytes(plan.levels), np.uint8))
+            else:
+                def_host = np.concatenate(plan.host_def).astype(np.int32)
+            validity = jax.device_put(def_host == max_def)
+        elif plan.def_runs.total:
+            def_levels = plan.def_runs.expand(lev_dbuf,
+                                              tables=staged_meta.get("def_runs"))
         elif plan.host_def:
             def_host = np.concatenate(plan.host_def).astype(np.int32)
             def_levels = jnp.asarray(def_host)
 
-    validity = None
     if max_def > 0 and def_levels is not None:
         validity = dev.validity_from_def(def_levels, max_def)
 
@@ -624,7 +662,10 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
     list_offsets: List[np.ndarray] = []
     list_validity: List[Optional[np.ndarray]] = []
     leaf_validity = validity
-    if max_rep > 0 and def_host is not None:
+    if device_asm is not None:
+        lofs, lval, leaf_validity = device_asm
+        list_offsets, list_validity = [lofs], [lval]
+    elif max_rep > 0 and def_host is not None:
         asm = levels_ops.assemble(def_host, rep_host, leaf)
         list_offsets, list_validity = asm.list_offsets, asm.list_validity
         leaf_validity = asm.validity

@@ -145,21 +145,6 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 # ---------------------------------------------------------------------------
 
 
-def _chunk_pages_for_span(chunk, row_start: int, row_end: int):
-    """Selected pages + the first row they cover (page-aligned trim base)."""
-    from bisect import bisect_right
-
-    from ..io.search import seek_pages
-
-    pages = list(seek_pages(chunk, row_start, row_end))
-    first = 0
-    oi = chunk.offset_index()
-    if oi is not None and oi.page_locations:
-        firsts = [pl.first_row_index for pl in oi.page_locations]
-        first = firsts[max(bisect_right(firsts, row_start) - 1, 0)]
-    return pages, first
-
-
 def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                columns: Optional[Sequence[str]] = None,
                use_bloom: bool = True):
@@ -171,6 +156,9 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
     """
     from . import device_reader as dr
 
+    from ..format.enums import Type
+    from ..io.search import pages_and_base
+
     flat = {leaf.dotted_path for leaf in pf.schema.leaves
             if leaf.max_repetition_level == 0}
     out_cols = list(columns) if columns is not None else sorted(flat - {path})
@@ -178,6 +166,11 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
         if c not in flat:
             raise ValueError(f"column {c!r} is nested or unknown; the device "
                              "scan handles flat columns")
+    key_leaf = pf.schema.leaf(path)
+    if key_leaf.physical_type in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY,
+                                  Type.INT96):
+        raise ValueError(f"device scan key {path!r} has physical type "
+                         f"{key_leaf.physical_type.name}; use the host scan")
     plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom)
     spans = []
     for plan in plans:
@@ -186,8 +179,13 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
         per_col = {}
         for c in [path] + out_cols:
             chunk = rg.column(c)
-            pages, first = _chunk_pages_for_span(chunk, row_start, row_end)
+            pages, first = pages_and_base(chunk, row_start, row_end)
             dplan = dr.build_plan(chunk, pages=iter(pages))
+            if (chunk.leaf.physical_type == Type.BYTE_ARRAY
+                    and dplan.value_kind != "dict"):
+                raise ValueError(
+                    f"device scan column {c!r}: plain-encoded BYTE_ARRAY has "
+                    "no row-aligned device form; use the host scan")
             staged = dr.stage_plan(dplan)
             per_col[c] = (chunk, dplan, staged, row_start - first)
         spans.append((plan, per_col))

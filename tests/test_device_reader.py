@@ -133,3 +133,57 @@ def test_device_matches_host_exactly(rng):
         np.asarray(host["a"].values),
         np.ascontiguousarray(np.asarray(devi["a"].values)).view(np.int64).reshape(-1))
     assert devi["s"].to_arrow().cast(pa.string()).equals(host["s"].to_arrow().cast(pa.string()))
+
+
+def test_single_list_assembles_on_device():
+    """Config-4 shape: one-level list columns expand levels AND assemble
+    (validity, list_offsets) on device (VERDICT r1 item 7)."""
+    import jax
+
+    from parquet_tpu.ops import levels as levels_ops
+    from parquet_tpu.parallel import device_reader as dr
+
+    rng = np.random.default_rng(13)
+    n_lists = 5000
+    lens = rng.integers(0, 8, n_lists)
+    lens[rng.random(n_lists) < 0.07] = 0
+    offs = np.zeros(n_lists + 1, np.int32)
+    np.cumsum(lens, out=offs[1:])
+    total = int(offs[-1])
+    base = np.cumsum(rng.integers(0, 1000, max(total, 1)).astype(np.int64))
+    # null lists included: list_validity (def >= dk-1 vs empty lists) matters
+    mask = rng.random(n_lists) < 0.05
+    arr = pa.ListArray.from_arrays(pa.array(offs), pa.array(base[:total]),
+                                   mask=pa.array(mask))
+    t = pa.table({"xs": arr})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False,
+                   column_encoding={"xs.list.element": "DELTA_BINARY_PACKED"},
+                   compression="none")
+    pf = ParquetFile(buf.getvalue())
+    chunk = pf.row_group(0).column(0)
+
+    plan = dr.build_plan(chunk)
+    assert dr.stage_levels_on_device(chunk.leaf, plan)
+    col = dr.decode_chunk_device(chunk, fallback=False)
+    # assembly outputs are device arrays, host level streams were never built
+    assert col.def_levels is None and col.rep_levels is None
+    assert isinstance(col.list_offsets[0], jax.Array)
+    # oracle: host decode
+    host = ParquetFile(buf.getvalue()).read()
+    got = col.to_arrow()
+    want = host.to_arrow().column("xs")
+    assert got.to_pylist() == want.to_pylist() == t.column("xs").to_pylist()
+
+
+def test_list_under_struct_keeps_host_levels_device_read():
+    """Lists below a struct layer must NOT take the device-assembly path:
+    the table assembler needs host def levels for struct nullness."""
+    rows = [{"xs": [1, 2]}, None, {"xs": None}, {"xs": [3]}] * 50
+    t = pa.table({"s": pa.array(rows,
+                                type=pa.struct([("xs", pa.list_(pa.int64()))]))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False)
+    got = ParquetFile(buf.getvalue()).read(device=True).to_arrow()
+    want = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert got.column("s").to_pylist() == want.column("s").to_pylist()

@@ -190,3 +190,16 @@ def test_device_scan_empty_result_typed():
     ep = pairs_to_host(dev["l_extendedprice"], np.float64)
     ok = pairs_to_host(dev["l_orderkey"], np.int64)
     assert len(ep) == 0 and len(ok) == 0
+
+
+def test_device_scan_rejects_plain_byte_array():
+    t = pa.table({"k": pa.array(np.arange(1000, dtype=np.int32)),
+                  "s": pa.array([f"str_{i:05d}" for i in range(1000)])})
+    b = io.BytesIO()
+    pq.write_table(t, b, use_dictionary=False, write_page_index=True)
+    pf = ParquetFile(b.getvalue())
+    with pytest.raises(ValueError, match="plain-encoded BYTE_ARRAY"):
+        scan_filtered_device(pf, "k", lo=100, hi=105, columns=["s"])
+    with pytest.raises(ValueError, match="use the host scan"):
+        scan_filtered_device(pf, "s", lo="str_00100", hi="str_00105",
+                             columns=["k"])
