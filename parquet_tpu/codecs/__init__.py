@@ -120,20 +120,37 @@ class GzipCodec(Codec):
 
 
 class ZstdCodec(Codec):
+    """zstd via python-zstandard.
+
+    ZstdCompressor/ZstdDecompressor each wrap ONE ZSTD_(C|D)Ctx and are NOT
+    thread-safe; codec singletons are shared by the threaded staging
+    pipeline, so contexts live in thread-local storage (heap corruption
+    otherwise — observed as malloc tcache aborts under concurrent decode).
+    """
+
     codec_id = CompressionCodec.ZSTD
     name = "ZSTD"
 
     def __init__(self, level: int = 3):
+        import threading
+
         import zstandard
 
-        self._c = zstandard.ZstdCompressor(level=level)
-        self._d = zstandard.ZstdDecompressor()
+        self._zstd = zstandard
+        self._level = level
+        self._tl = threading.local()
 
     def encode(self, data) -> bytes:
-        return self._c.compress(bytes(data))
+        c = getattr(self._tl, "c", None)
+        if c is None:
+            c = self._tl.c = self._zstd.ZstdCompressor(level=self._level)
+        return c.compress(bytes(data))
 
     def decode(self, data, uncompressed_size: int) -> bytes:
-        return self._d.decompress(bytes(data), max_output_size=max(uncompressed_size, 1))
+        d = getattr(self._tl, "d", None)
+        if d is None:
+            d = self._tl.d = self._zstd.ZstdDecompressor()
+        return d.decompress(bytes(data), max_output_size=max(uncompressed_size, 1))
 
 
 class Lz4RawCodec(Codec):

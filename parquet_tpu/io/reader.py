@@ -306,15 +306,26 @@ class ParquetFile:
         ``device=False``: host numpy oracle path.  ``device=True``: the TPU
         path — batched H2D staging + XLA kernels (parallel/device_reader.py).
         """
-        if device:
-            from ..parallel.device_reader import decode_chunk_device as _dec
-        else:
-            _dec = decode_chunk_host
         leaves = _select_leaves(self.schema, columns)
+        n_rg = len(self.metadata.row_groups or [])
         cols: Dict[str, Column] = {}
+        if device:
+            # double-buffered pipeline across every (leaf, row-group) chunk:
+            # host prescan + H2D of later chunks overlaps device decode of
+            # earlier ones (SURVEY.md §7 hard part 5)
+            from ..parallel.device_reader import decode_chunks_pipelined
+
+            chunks = [self.row_group(i).column(leaf.column_index)
+                      for leaf in leaves for i in range(n_rg)]
+            decoded = decode_chunks_pipelined(chunks)
+            for leaf in leaves:
+                parts = [next(decoded) for _ in range(n_rg)]
+                cols[leaf.dotted_path] = (concat_columns(parts)
+                                          if len(parts) != 1 else parts[0])
+            return Table(self.schema, cols, self.num_rows)
         for leaf in leaves:
-            parts = [_dec(self.row_group(i).column(leaf.column_index))
-                     for i in range(len(self.metadata.row_groups or []))]
+            parts = [decode_chunk_host(self.row_group(i).column(leaf.column_index))
+                     for i in range(n_rg)]
             cols[leaf.dotted_path] = concat_columns(parts) if len(parts) != 1 else parts[0]
         return Table(self.schema, cols, self.num_rows)
 

@@ -490,13 +490,14 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
 
 
 def stage_levels_on_device(leaf, plan: _Plan) -> bool:
-    """Whether the level streams should go to HBM: flat columns (def
-    validity on device) and *top-level* single-level lists (device
-    assembly). Lists under struct layers — and any deeper nesting — expand
-    levels on host instead: the table assembler needs host def levels to
-    derive struct nullness, which the device assembly does not keep."""
+    """Whether the level streams should go to HBM: flat single-def columns
+    (validity from device RLE expansion) and *top-level* single-level lists
+    (device assembly). Struct chains (flat, max_def > 1) and lists under
+    structs expand levels on host instead — the table assembler needs host
+    def levels for struct nullness — so staging their level bytes would be
+    wasted H2D."""
     if leaf.max_repetition_level == 0:
-        return True
+        return leaf.max_definition_level <= 1
     from ..format.enums import FieldRepetitionType as _Rep
 
     anc = leaf.ancestors  # (list group, repeated node, leaf) for a top list
@@ -504,6 +505,82 @@ def stage_levels_on_device(leaf, plan: _Plan) -> bool:
             and anc[1].repetition == _Rep.REPEATED
             and bool(plan.def_runs.total) and bool(plan.rep_runs.total)
             and not plan.host_def)
+
+
+def prepare_chunk(reader: ColumnChunkReader, device=None):
+    """Host phase of one chunk's device decode: prescan (pread + decompress +
+    run scan) and H2D staging. Safe to call from worker threads — the host
+    work releases the GIL in numpy/C++/codec calls, and ``device`` targets
+    the put at a specific mesh device."""
+    import contextlib
+
+    plan = build_plan(reader)
+    ctx = (jax.default_device(device) if device is not None
+           else contextlib.nullcontext())
+    with ctx:
+        staged = stage_plan(plan,
+                            stage_levels=stage_levels_on_device(reader.leaf, plan))
+    return plan, staged
+
+
+def decode_chunks_pipelined(chunks, keep_dictionary: bool = True,
+                            workers: int = 2):
+    """Double-buffered read: stage chunk N+1 while chunk N's kernels run.
+
+    SURVEY.md §7 hard part 5 — the host prep (decompress + prescan) and H2D
+    put of later chunks overlap the (asynchronously dispatched) device decode
+    of earlier ones. A bounded thread pool keeps at most ``workers`` chunks
+    in flight beyond the one decoding, bounding memory to O(workers · chunk).
+    Yields decoded Columns in chunk order; falls back to host decode per
+    chunk on unsupported shapes.
+    """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    chunks = list(chunks)
+    active = {"n": 0}
+    lock = threading.Lock()
+
+    def prep(reader):
+        with lock:
+            active["n"] += 1
+            counters.high_water("stage_concurrency_peak", active["n"])
+        try:
+            try:
+                return reader, prepare_chunk(reader), None
+            except _Unsupported as e:
+                return reader, None, e
+        finally:
+            with lock:
+                active["n"] -= 1
+    with ThreadPoolExecutor(max_workers=max(workers, 1)) as pool:
+        pending = []
+        it = iter(chunks)
+        for reader in it:
+            pending.append(pool.submit(prep, reader))
+            if len(pending) > workers:
+                break
+        i = 0
+        while i < len(pending):
+            reader, prepped, err = pending[i].result()
+            pending[i] = None  # release the future: keeps plan/staged memory
+            i += 1             # bounded to the in-flight window
+            nxt = next(it, None)
+            if nxt is not None:
+                pending.append(pool.submit(prep, nxt))
+            if err is not None:
+                counters.inc("chunks_host_fallback")
+                yield decode_chunk_host(reader)
+                continue
+            plan, staged = prepped
+            try:
+                col = decode_staged(reader.leaf, Type(reader.meta.type), plan,
+                                    staged, keep_dictionary=keep_dictionary)
+                counters.inc("chunks_device_decoded")
+                yield col
+            except _Unsupported:
+                counters.inc("chunks_host_fallback")
+                yield decode_chunk_host(reader)
 
 
 def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,

@@ -16,6 +16,7 @@ step over a mesh (the "training step" analog, exercised by the driver's
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -37,65 +38,184 @@ def default_mesh(n: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devs[:n]), (axis,))
 
 
+@dataclass(frozen=True)
+class ShardedTable:
+    """Row-sharded decode result over a mesh.
+
+    ``arrays[path]`` is a global jax.Array sharded on rows (leading axis)
+    over the mesh's first axis; every shard is padded to ``shard_rows`` so
+    the global array exists, and ``row_counts[i]`` gives shard i's REAL row
+    count (``row_mask()`` materializes the padding mask with the same
+    sharding). ``validity[path]`` (present only for columns with nulls) is a
+    row-aligned bool array sharded identically; padded and null slots hold
+    zero fill in ``arrays[path]``. 64-bit columns use the (n, 2) uint32 pair
+    representation (``ops.device.pairs_to_host``).
+    """
+
+    arrays: Dict[str, jax.Array]
+    validity: Dict[str, jax.Array]
+    row_counts: tuple
+    mesh: Mesh
+
+    @property
+    def shard_rows(self) -> int:
+        return max(self.row_counts) if self.row_counts else 0
+
+    @property
+    def num_rows(self) -> int:
+        return int(sum(self.row_counts))
+
+    def row_mask(self) -> jax.Array:
+        """Global bool array marking real (non-padding) rows."""
+        host = np.concatenate(
+            [np.arange(self.shard_rows) < c for c in self.row_counts]) \
+            if self.row_counts else np.zeros(0, bool)
+        sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        return jax.device_put(host, sharding)
+
+
+def _decode_prepped(reader, prep_out):
+    """Device-decode a prepared chunk, or fall back to host decode when the
+    prescan/decode hit an unsupported shape (mixed page encodings, missing
+    dictionary page, ...) — parity with decode_chunk_device(fallback=True).
+    Returns (Column, null count)."""
+    from ..format.enums import Type
+    from ..io.reader import decode_chunk_host
+    from .device_reader import _Unsupported, decode_staged
+
+    if prep_out is not None:
+        plan, staged = prep_out
+        try:
+            col = decode_staged(reader.leaf, Type(reader.meta.type), plan,
+                                staged)
+            counters.inc("chunks_device_decoded")
+            return col, plan.total_slots - plan.total_values
+        except _Unsupported:
+            pass
+    counters.inc("chunks_host_fallback")
+    col = decode_chunk_host(reader)
+    n_nulls = 0
+    if col.validity is not None:
+        v = np.asarray(col.validity)
+        n_nulls = int(len(v) - v.sum())
+    return col, n_nulls
+
+
 def read_table_sharded(source, mesh: Optional[Mesh] = None,
                        columns: Optional[Sequence[str]] = None,
-                       axis: str = "data") -> Dict[str, jax.Array]:
-    """Read fixed-width columns of a file as row-sharded global jax.Arrays.
+                       axis: str = "data",
+                       num_threads: Optional[int] = None) -> ShardedTable:
+    """Read fixed-width columns of a file as a :class:`ShardedTable`.
 
-    Row groups are assigned round-robin to mesh devices; each device's chunks
-    are decoded on that device (device_put targets the specific device), then
-    stitched into one global array sharded along rows.  Ragged (byte-array)
-    columns come back dictionary-encoded with sharded index arrays when
-    possible, else host-side.
+    Row groups are assigned round-robin to the mesh's devices. The host
+    phase (pread + decompress + prescan + H2D put targeted at each chunk's
+    device) fans out across a thread pool so all devices stage concurrently
+    (SURVEY.md §2.5 data-parallel row); decode dispatches are async, so
+    device work overlaps too. Columns must be flat and fixed-width
+    (BOOLEAN/INT32/INT64/FLOAT/DOUBLE/FLBA — 64-bit as (n, 2) uint32
+    pairs); BYTE_ARRAY and nested columns raise ValueError (read them with
+    ``ParquetFile.read(device=True)``, which keeps ragged forms).
     """
-    from .device_reader import decode_chunk_device
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..format.enums import Type
+    from .device_reader import _Unsupported, prepare_chunk
 
     mesh = mesh or default_mesh(axis=axis)
     devs = list(mesh.devices.reshape(-1))
     pf = source if isinstance(source, ParquetFile) else ParquetFile(source)
     leaves = (pf.schema.leaves if columns is None
               else [pf.schema.leaf(c) for c in columns])
-    n_rg = len(pf.metadata.row_groups or [])
-    out: Dict[str, jax.Array] = {}
-    row_counts: Dict[str, List[int]] = {}
     for leaf in leaves:
-        per_dev: Dict[int, List[np.ndarray]] = {i: [] for i in range(len(devs))}
-        for rg in range(n_rg):
+        if leaf.max_repetition_level > 0 or leaf.physical_type in (
+                Type.BYTE_ARRAY,):
+            raise ValueError(
+                f"read_table_sharded: column {leaf.dotted_path!r} is "
+                "nested or ragged; use ParquetFile.read(device=True)")
+    n_rg = len(pf.metadata.row_groups or [])
+    if n_rg == 0:
+        return ShardedTable(arrays={}, validity={},
+                            row_counts=(0,) * len(devs), mesh=mesh)
+    tasks = [(leaf, rg) for leaf in leaves for rg in range(n_rg)]
+
+    def prep(task):
+        leaf, rg = task
+        reader = pf.row_group(rg).column(leaf.column_index)
+        try:
+            return prepare_chunk(reader, device=devs[rg % len(devs)]), reader
+        except _Unsupported:
+            return None, reader  # host fallback at decode time
+
+    workers = num_threads or min(len(devs) * 2, 16)
+    with ThreadPoolExecutor(max_workers=max(workers, 1)) as pool:
+        prepped = list(pool.map(prep, tasks))
+
+    arrays: Dict[str, jax.Array] = {}
+    validities: Dict[str, jax.Array] = {}
+    rg_rows = [pf.row_group(i).num_rows for i in range(n_rg)]
+    shard_counts = [sum(rg_rows[rg] for rg in range(n_rg)
+                        if rg % len(devs) == d) for d in range(len(devs))]
+    maxlen = max(shard_counts) if shard_counts else 0
+    for leaf in leaves:
+        per_dev_vals: Dict[int, List[jax.Array]] = {}
+        per_dev_valid: Dict[int, List[jax.Array]] = {}
+        has_nulls = False
+        for (prep_out, reader), (l2, rg) in zip(prepped, tasks):
+            if l2 is not leaf:
+                continue
             d = rg % len(devs)
             with jax.default_device(devs[d]):
-                col = decode_chunk_device(pf.row_group(rg).column(leaf.column_index))
-            if col.is_dictionary_encoded():
-                col.materialize_host()
-            arr = col.values
-            per_dev[d].append(arr if isinstance(arr, jax.Array) else jnp.asarray(arr))
-        # per-device concat, then build the global sharded array
-        shards = []
-        for i in range(len(devs)):
-            if not per_dev[i]:
-                continue
-            parts = per_dev[i]
-            shard = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-            shards.append(jax.device_put(shard, devs[i]))
-        if not shards:
-            continue
-        lens = [s.shape[0] for s in shards]
-        maxlen = max(lens)
-        # pad shards to uniform length so a global sharded array exists;
-        # callers get (array, row_counts) semantics via out["#rows"]
-        padded = []
-        for s in shards:
-            if s.shape[0] < maxlen:
-                pad = [(0, maxlen - s.shape[0])] + [(0, 0)] * (s.ndim - 1)
-                s = jnp.pad(s, pad)
-            padded.append(s)
+                col, n_nulls = _decode_prepped(reader, prep_out)
+                vals = col.values
+                if col.is_dictionary_encoded():
+                    vals = dev.dict_gather(col.dictionary, col.dict_indices)
+                if not isinstance(vals, jax.Array):
+                    vals = jnp.asarray(vals)
+                valid = col.validity
+                if valid is not None and n_nulls:
+                    if not isinstance(valid, jax.Array):
+                        valid = jnp.asarray(valid)
+                    vals = dev.scatter_valid(vals, valid)  # row-align
+                    has_nulls = True
+                elif valid is not None:
+                    valid = None  # nullable schema, no actual nulls
+            per_dev_vals.setdefault(d, []).append(vals)
+            per_dev_valid.setdefault(d, []).append(valid)
+        template = next(p[0] for p in per_dev_vals.values() if p)
+        shard_arrays, shard_valid = [], []
+        for d in range(len(devs)):
+            parts = per_dev_vals.get(d, [])
+            with jax.default_device(devs[d]):
+                if parts:
+                    arr = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                else:  # more devices than row groups: typed empty shard
+                    arr = jnp.zeros((0,) + tuple(template.shape[1:]),
+                                    template.dtype)
+                if arr.shape[0] < maxlen:
+                    padw = [(0, maxlen - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                    arr = jnp.pad(arr, padw)
+                shard_arrays.append(jax.device_put(arr, devs[d]))
+                if has_nulls:
+                    vparts = [v if v is not None else jnp.ones(p.shape[0], bool)
+                              for v, p in zip(per_dev_valid.get(d, []), parts)]
+                    va = (jnp.concatenate(vparts) if len(vparts) > 1
+                          else vparts[0] if vparts else jnp.zeros(0, bool))
+                    if va.shape[0] < maxlen:
+                        va = jnp.pad(va, (0, maxlen - va.shape[0]))
+                    shard_valid.append(jax.device_put(va, devs[d]))
+        nd = shard_arrays[0].ndim
         sharding = NamedSharding(mesh, P(mesh.axis_names[0],
-                                         *(None,) * (padded[0].ndim - 1)))
-        global_shape = (maxlen * len(padded),) + tuple(padded[0].shape[1:])
-        arrs = [jax.device_put(p, d) for p, d in zip(padded, devs)]
-        out[leaf.dotted_path] = jax.make_array_from_single_device_arrays(
-            global_shape, sharding, arrs)
-        row_counts[leaf.dotted_path] = lens
-    return out, row_counts
+                                         *(None,) * (nd - 1)))
+        global_shape = (maxlen * len(shard_arrays),) + tuple(shard_arrays[0].shape[1:])
+        arrays[leaf.dotted_path] = jax.make_array_from_single_device_arrays(
+            global_shape, sharding, shard_arrays)
+        if has_nulls:
+            vsharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+            validities[leaf.dotted_path] = \
+                jax.make_array_from_single_device_arrays(
+                    (maxlen * len(shard_valid),), vsharding, shard_valid)
+    return ShardedTable(arrays=arrays, validity=validities,
+                        row_counts=tuple(shard_counts), mesh=mesh)
 
 
 # ---------------------------------------------------------------------------

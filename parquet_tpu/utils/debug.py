@@ -29,6 +29,12 @@ class Counters:
         with self._lock:
             self._counts[name] += by
 
+    def high_water(self, name: str, value: int) -> None:
+        """Record a peak (e.g. concurrent staging threads)."""
+        with self._lock:
+            if value > self._counts.get(name, 0):
+                self._counts[name] = value
+
     def get(self, name: str) -> int:
         return self._counts.get(name, 0)
 

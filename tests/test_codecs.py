@@ -72,3 +72,34 @@ def test_pyarrow_reads_our_compression(tmp_path, rng):
 def test_unsupported_codec():
     with pytest.raises(ValueError):
         codecs.get_codec(CC.LZO)
+
+
+def test_zstd_codec_thread_safety():
+    """Codec singletons are shared by the staging thread pool; zstd contexts
+    must be thread-local (shared ZSTD_DCtx corrupts the heap)."""
+    import threading
+
+    from parquet_tpu.codecs import get_codec
+    from parquet_tpu.format.enums import CompressionCodec
+
+    codec = get_codec(CompressionCodec.ZSTD)
+    rng = np.random.default_rng(0)
+    blobs = [rng.integers(0, 50, 200_000).astype(np.uint8).tobytes()
+             for _ in range(4)]
+    encoded = [codec.encode(b) for b in blobs]
+    errors = []
+
+    def worker(i):
+        try:
+            for _ in range(50):
+                got = codec.decode(encoded[i % 4], len(blobs[i % 4]))
+                assert got == blobs[i % 4]
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors

@@ -1,0 +1,135 @@
+"""Double-buffered chunk pipeline (VERDICT r1 item 5) and the redesigned
+read_table_sharded over an 8-device CPU mesh (VERDICT r1 item 6)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import jax
+
+from parquet_tpu.io.reader import ParquetFile
+from parquet_tpu.ops.device import pairs_to_host
+from parquet_tpu.parallel.mesh import ShardedTable, default_mesh, read_table_sharded
+from parquet_tpu.utils.debug import counters
+
+
+def _multi_rg_file(n=40000, rgs=6, with_nulls=False, extra_cols=True) -> bytes:
+    rng = np.random.default_rng(7)
+    cols = {"x": pa.array(rng.integers(0, 10**12, n))}
+    if extra_cols:
+        cols["f"] = pa.array(rng.random(n, dtype=np.float32))
+        cols["i"] = pa.array(rng.integers(-100, 100, n).astype(np.int32))
+    if with_nulls:
+        m = rng.random(n) < 0.05
+        cols["o"] = pa.array(np.where(m, 0, rng.integers(0, 50, n)), mask=m)
+    buf = io.BytesIO()
+    # uneven final row group: n not divisible by rgs
+    pq.write_table(pa.table(cols), buf, row_group_size=n // rgs + 13,
+                   use_dictionary=False, compression="snappy")
+    return buf.getvalue()
+
+
+def test_pipelined_read_equals_serial():
+    raw = _multi_rg_file(with_nulls=True)
+    pf = ParquetFile(raw)
+    counters.reset()
+    tab_dev = pf.read(device=True)  # pipelined
+    tab_host = ParquetFile(raw).read()
+    for path in ("x", "f", "i", "o"):
+        got = tab_dev[path].to_arrow()
+        want = tab_host[path].to_arrow()
+        assert got.equals(want), path
+    # staging genuinely overlapped: at least 2 chunks in flight at once
+    assert counters.get("stage_concurrency_peak") >= 2
+    assert counters.get("chunks_device_decoded") > 0
+
+
+def test_read_table_sharded_8dev_uneven():
+    mesh = default_mesh(8)
+    assert mesh.devices.size == 8
+    raw = _multi_rg_file(n=30000, rgs=6, with_nulls=True)
+    st = read_table_sharded(raw, mesh=mesh, columns=["x", "i", "o"])
+    assert isinstance(st, ShardedTable)
+    assert st.num_rows == 30000
+    assert len(st.row_counts) == 8  # one count per mesh device
+    assert min(st.row_counts) < max(st.row_counts)  # genuinely uneven
+
+    pf = ParquetFile(raw)
+    n_rg = len(pf.row_groups)
+    # shard d gets row groups {rg : rg % 8 == d} in order
+    want_x = {d: np.concatenate(
+        [np.asarray(ParquetFile(raw).row_group(rg).column("x").read().values)
+         for rg in range(n_rg) if rg % 8 == d] or [np.zeros(0, np.int64)])
+        for d in range(8)}
+
+    gx = st.arrays["x"]
+    assert gx.shape[0] == st.shard_rows * 8
+    # per-shard slices of the global array match the per-device row groups
+    for d in range(8):
+        shard = np.asarray(gx[d * st.shard_rows:(d + 1) * st.shard_rows])
+        vals = pairs_to_host(shard, np.int64)[: st.row_counts[d]]
+        np.testing.assert_array_equal(vals, want_x[d])
+    # row_mask marks exactly the real rows
+    mask = np.asarray(st.row_mask())
+    assert mask.sum() == 30000
+    for d in range(8):
+        np.testing.assert_array_equal(
+            mask[d * st.shard_rows:(d + 1) * st.shard_rows],
+            np.arange(st.shard_rows) < st.row_counts[d])
+    # nullable column carries sharded validity
+    assert "o" in st.validity
+    assert st.validity["o"].shape[0] == st.shard_rows * 8
+    # a pjit-style global computation runs on the sharded arrays directly
+    total = int(jax.numpy.where(st.row_mask(),
+                                np.asarray(st.arrays["i"]) * 0 + 1, 0).sum())
+    assert total == 30000
+
+
+def test_read_table_sharded_rejects_ragged():
+    t = pa.table({"s": pa.array(["a", "b", "c"]),
+                  "x": pa.array([1, 2, 3], type=pa.int64())})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    with pytest.raises(ValueError, match="nested or ragged"):
+        read_table_sharded(buf.getvalue(), mesh=default_mesh(8))
+    # explicit fixed-width selection works
+    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8),
+                            columns=["x"])
+    assert st.num_rows == 3
+
+
+def test_read_table_sharded_empty_file():
+    t = pa.table({"x": pa.array(np.zeros(0, np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8))
+    assert st.num_rows == 0
+
+
+def test_read_table_sharded_host_fallback_mixed_encodings():
+    """Chunks the device path cannot handle fall back to host decode but
+    still shard (parity with decode_chunk_device(fallback=True))."""
+    from parquet_tpu.format.enums import Encoding
+    from parquet_tpu.io.writer import WriterOptions, write_table
+
+    # BIT_PACKED legacy def levels force plan.host_def -> flat max_def==1
+    # stays device; instead use FLBA BYTE_STREAM_SPLIT (unsupported width)
+    rng = np.random.default_rng(2)
+    t = pa.table({"f": pa.array([rng.bytes(3) for _ in range(2000)],
+                                type=pa.binary(3)),
+                  "x": pa.array(np.arange(2000, dtype=np.int64))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(dictionary=False,
+                                      column_encoding={"f": Encoding.BYTE_STREAM_SPLIT}))
+    counters.reset()
+    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8),
+                            columns=["f", "x"])
+    assert st.num_rows == 2000
+    assert counters.get("chunks_host_fallback") >= 1
+    fv = np.asarray(st.arrays["f"])
+    mask = np.asarray(st.row_mask())
+    got = [bytes(r) for r in fv[mask][:5]]
+    assert got == t.column("f").to_pylist()[:5]
