@@ -187,3 +187,47 @@ def test_list_under_struct_keeps_host_levels_device_read():
     got = ParquetFile(buf.getvalue()).read(device=True).to_arrow()
     want = pq.read_table(io.BytesIO(buf.getvalue()))
     assert got.column("s").to_pylist() == want.column("s").to_pylist()
+
+
+@pytest.mark.parametrize("mode", ["off", "", "1"])
+def test_dense_dict_route_modes(mode, monkeypatch, rng):
+    """Single-width dict-index streams route through the compacted dense
+    stream (jnp twin by default, Pallas with PARQUET_TPU_PALLAS=1, legacy
+    gathers with =off) — all three agree with pyarrow (VERDICT r1 item 3)."""
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", mode)
+    n = 60000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 5000, n).astype(np.int64)),
+        "s": pa.array(np.array([f"c{i:03d}" for i in range(200)])[
+            rng.integers(0, 200, n)]).dictionary_encode(),
+        "small": pa.array(rng.integers(0, 7, n).astype(np.int32)),
+    })
+    raw = _write(t, compression="snappy", use_dictionary=True,
+                 data_page_size=1 << 14)  # many pages: alignment padding
+    _check(raw, t)
+
+
+def test_dense_dict_fused_small_dictionary(monkeypatch, rng):
+    """Pallas fused unpack+gather engages for small fixed-width dicts."""
+    from parquet_tpu.parallel import device_reader as dr
+
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "1")
+    n = 30000
+    t = pa.table({"v": pa.array((rng.integers(0, 50, n) * 3).astype(np.int32))})
+    raw = _write(t, use_dictionary=True, data_page_size=1 << 14)
+    pf = ParquetFile(raw)
+    chunk = pf.row_group(0).column(0)
+    col = dr.decode_chunk_device(chunk, fallback=False)
+    assert col.dict_indices is None and col.values is not None  # fused
+    np.testing.assert_array_equal(np.asarray(col.values),
+                                  t.column("v").to_numpy())
+
+
+def test_dense_stream_clamped_final_run(monkeypatch, rng):
+    """A final bit-packed run clamped mid-group must survive the 32-value
+    round-up (regression: floor() dropped the tail page)."""
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "")
+    for n in (9, 33, 777, 4099):
+        t = pa.table({"v": pa.array(rng.integers(0, 900, n).astype(np.int64))})
+        raw = _write(t, use_dictionary=True)
+        _check(raw, t)
