@@ -829,7 +829,9 @@ def _decode_dense_dict(plan: _Plan, dense_buf: jax.Array, dictionary,
     # round UP to whole 32-value groups: the final page's tail group may be
     # partial byte-wise; the unpack kernels zero-pad missing words
     total = -(-(len(plan.dense) * 8 // w) // 32) * 32
-    nwords = len(plan.dense) // 4
+    # round word count UP: the stream's byte length need not be 4-aligned and
+    # pad_to_bucket(extra=4) guarantees ≥4 zero bytes of slack past the end
+    nwords = (len(plan.dense) + 3) // 4
     words = jax.lax.bitcast_convert_type(
         dense_buf[: nwords * 4].reshape(nwords, 4), jnp.uint32)
     mode = _dense_mode()
