@@ -49,19 +49,34 @@ _U32 = jnp.uint32
 
 
 # ---------------------------------------------------------------------------
-# Byte gathers (arithmetic combine — no 64-bit bitcasts anywhere)
+# Word gathers (arithmetic combine — no 64-bit bitcasts anywhere)
+#
+# TPU-first: per-element loads are the expensive primitive, so an unaligned
+# 32-bit read is TWO aligned word gathers + shift-combine (not four byte
+# gathers), and all index math runs in int32 lanes — a chunk's staged buffer
+# is < 2^27 bytes (enforced at staging), so bit positions fit int32 and the
+# compiler never emits emulated-64-bit index vectors on the hot path.
 # ---------------------------------------------------------------------------
 
+#: staged buffers larger than this fall back to the host path: bit offsets
+#: must fit int32 (2^27 bytes → 2^30 bits), keeping index math in 32-bit lanes
+MAX_DEVICE_BUF = 1 << 27
 
-def _gather_word(buf: jax.Array, byte0: jax.Array) -> jax.Array:
-    """4 consecutive bytes at each (unaligned) position → uint32, little-endian."""
-    b = buf.astype(_U32)
-    return (
-        b[byte0]
-        | (b[byte0 + 1] << _U32(8))
-        | (b[byte0 + 2] << _U32(16))
-        | (b[byte0 + 3] << _U32(24))
-    )
+
+def _as_words(buf: jax.Array) -> jax.Array:
+    """uint8 staged buffer → uint32 little-endian word view (zero-padded to a
+    word boundary; out-of-range word gathers are clamped by XLA and the
+    garbage bits always fall outside the value mask)."""
+    if buf.shape[0] % 4:
+        buf = jnp.pad(buf, (0, 4 - buf.shape[0] % 4))
+    return jax.lax.bitcast_convert_type(buf.reshape(-1, 4), _U32)
+
+
+def _word_at(bit_starts: jax.Array):
+    """(aligned word index, in-word shift) for each unaligned bit position."""
+    wi = (bit_starts >> 5).astype(jnp.int32)
+    sh = (bit_starts.astype(jnp.int32) & 31).astype(_U32)
+    return wi, sh
 
 
 # ---------------------------------------------------------------------------
@@ -102,32 +117,33 @@ def unpack_bits_at32(buf: jax.Array, bit_starts: jax.Array, widths) -> jax.Array
     ``widths`` may be scalar or per-element (mixed-width streams: a whole
     chunk of differently-packed pages decodes in ONE call).  uint32 out.
     Covers levels, dictionary indexes, and int32 deltas — the hot 99%.
+    Two aligned word gathers per element; int32 index math throughout.
     """
-    byte0 = bit_starts >> 3
-    sh = (bit_starts & 7).astype(_U32)
-    w0 = _gather_word(buf, byte0)
-    w1 = _gather_word(buf, byte0 + 4)
-    lo = w0 >> sh
-    hi = jnp.where(sh > 0, w1 << (_U32(32) - sh), _U32(0))
-    val = lo | hi
-    w = jnp.asarray(widths)
-    w32 = w.astype(_U32)
+    words = _as_words(buf)
+    wi, sh = _word_at(bit_starts)
+    w0 = words[wi]
+    w1 = words[wi + 1]
+    # sh==0 must not shift by 32 (UB): force the hi word's contribution to 0
+    hi = jnp.where(sh > 0, w1 << ((_U32(32) - sh) & _U32(31)), _U32(0))
+    val = (w0 >> sh) | hi
+    w32 = jnp.asarray(widths).astype(_U32)
     mask = jnp.where(w32 >= 32, _U32(0xFFFFFFFF), (_U32(1) << w32) - _U32(1))
     return val & mask
 
 
 def unpack_bits_at64(buf: jax.Array, bit_starts: jax.Array, widths
                      ) -> Tuple[jax.Array, jax.Array]:
-    """Like :func:`unpack_bits_at32` for widths ≤ 64 → (lo, hi) uint32 pair."""
-    byte0 = bit_starts >> 3
-    sh = (bit_starts & 7).astype(_U32)
-    w0 = _gather_word(buf, byte0)
-    w1 = _gather_word(buf, byte0 + 4)
-    w2 = _gather_word(buf, byte0 + 8)
+    """Like :func:`unpack_bits_at32` for widths ≤ 64 → (lo, hi) uint32 pair.
+    Three aligned word gathers per element."""
+    words = _as_words(buf)
+    wi, sh = _word_at(bit_starts)
+    w0 = words[wi]
+    w1 = words[wi + 1]
+    w2 = words[wi + 2]
     nz = sh > 0
-    inv = _U32(32) - sh
+    inv = (_U32(32) - sh) & _U32(31)
     lo = (w0 >> sh) | jnp.where(nz, w1 << inv, _U32(0))
-    hi = (w1 >> sh) | jnp.where(nz, w2 << inv, _U32(0))
+    hi = jnp.where(nz, (w1 >> sh) | (w2 << inv), w1)
     w32 = jnp.asarray(widths).astype(_U32)
     lo_bits = jnp.minimum(w32, _U32(32))
     hi_bits = jnp.maximum(w32, _U32(32)) - _U32(32)
@@ -140,7 +156,7 @@ def unpack_bits_at64(buf: jax.Array, bit_starts: jax.Array, widths
 def unpack_bits(buf: jax.Array, n: int, width: int, offset_bits: int = 0) -> jax.Array:
     """Dense LSB-first unpack of ``n`` ``width``-bit integers (≤32 → u32,
     else → (n,2) u32 pairs)."""
-    starts = jnp.arange(n, dtype=jnp.int64) * width + offset_bits
+    starts = jnp.arange(n, dtype=jnp.int32) * width + offset_bits
     if width <= 32:
         return unpack_bits_at32(buf, starts, width)
     lo, hi = unpack_bits_at64(buf, starts, width)
@@ -156,22 +172,23 @@ def unpack_bits(buf: jax.Array, n: int, width: int, offset_bits: int = 0) -> jax
 def rle_expand(
     buf: jax.Array,  # uint8 payload (whole chunk, padded +12)
     n: int,  # total output values (static, padded ok)
-    run_ends: jax.Array,  # int64[k] cumulative output counts per run
+    run_ends: jax.Array,  # int32/int64[k] cumulative output counts per run
     run_kinds: jax.Array,  # uint8[k] 0=RLE 1=bit-packed
     run_payloads: jax.Array,  # int32[k] repeated value for RLE runs
-    run_bit_offsets: jax.Array,  # int64[k] absolute bit offset of packed data
+    run_bit_offsets: jax.Array,  # int32/int64[k] absolute bit offset of packed data
     run_widths: jax.Array,  # int32[k] bit width (per run: pages may differ!)
 ) -> jax.Array:
     """Expand a pre-scanned hybrid stream (levels / dict indexes, ≤32-bit):
     one gather-driven pass, no sequential dependencies.  int32 out."""
-    idx = jnp.arange(n, dtype=jnp.int64)
-    run_id = jnp.searchsorted(run_ends, idx, side="right")
-    run_id = jnp.minimum(run_id, run_ends.shape[0] - 1)
-    counts = jnp.diff(run_ends, prepend=jnp.int64(0))
-    starts = run_ends[run_id] - counts[run_id]
+    ends = run_ends.astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    run_id = jnp.searchsorted(ends, idx, side="right")
+    run_id = jnp.minimum(run_id, ends.shape[0] - 1).astype(jnp.int32)
+    counts = jnp.diff(ends, prepend=jnp.int32(0))
+    starts = ends[run_id] - counts[run_id]
     within = idx - starts
     w = run_widths[run_id]
-    bit_pos = run_bit_offsets[run_id] + within * w.astype(jnp.int64)
+    bit_pos = run_bit_offsets[run_id].astype(jnp.int32) + within * w
     packed = unpack_bits_at32(buf, bit_pos, w).astype(jnp.int32)
     return jnp.where(run_kinds[run_id] == 0, run_payloads[run_id], packed)
 
@@ -192,11 +209,11 @@ def delta_decode32(
     nd = n - 1
     if nd <= 0:
         return jnp.full((max(n, 0),), first_value.astype(jnp.int32))
-    i = jnp.arange(nd, dtype=jnp.int64)
+    i = jnp.arange(nd, dtype=jnp.int32)
     mb = i // vpm
     within = i % vpm
     w = mb_widths[mb]
-    bit_pos = mb_bit_offsets[mb] + within * w.astype(jnp.int64)
+    bit_pos = mb_bit_offsets[mb].astype(jnp.int32) + within * w
     raw = unpack_bits_at32(buf, bit_pos, w)
     min32 = (mb_min_deltas & jnp.int64(0xFFFFFFFF)).astype(_U32)
     deltas = raw + min32[mb]
@@ -217,11 +234,11 @@ def delta_decode64(
     if nd <= 0:
         v = first_value.astype(jnp.int64).reshape(1)
         return _i64_to_pairs(jnp.broadcast_to(v, (max(n, 1),)))[:n]
-    i = jnp.arange(nd, dtype=jnp.int64)
+    i = jnp.arange(nd, dtype=jnp.int32)
     mb = i // vpm
     within = i % vpm
     w = mb_widths[mb]
-    bit_pos = mb_bit_offsets[mb] + within * w.astype(jnp.int64)
+    bit_pos = mb_bit_offsets[mb].astype(jnp.int32) + within * w
     lo, hi = unpack_bits_at64(buf, bit_pos, w)
     raw = lo.astype(jnp.int64) | (hi.astype(jnp.int64) << 32)
     deltas = raw + mb_min_deltas[mb]
@@ -382,32 +399,29 @@ def assemble_single_list(def_levels: jax.Array, rep_levels: jax.Array,
     Shapes are data-dependent (rows, elements), so two scalar D2H syncs fix
     the sizes; all heavy math stays on device.
     """
-    d = def_levels
-    r = rep_levels
-    inst_mask = r == 0
-    elem = d >= dk
-    cum, n_rows, n_elem = _asl_cums(d, r, dk)
-    n_rows = int(n_rows)
-    n_elem = int(n_elem)
-    return _asl_finish(d, cum, inst_mask, elem, n_rows, n_elem, dk, max_def)
+    counts = _asl_cums(def_levels, rep_levels, dk)
+    n_rows, n_elem = (int(x) for x in counts)
+    return _asl_finish(def_levels, rep_levels, n_rows, n_elem, dk, max_def)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("dk",))
 def _asl_cums(d: jax.Array, r: jax.Array, dk: int):
-    elem = d >= dk
-    cum = jnp.cumsum(elem.astype(jnp.int32))
-    return cum, jnp.sum((r == 0).astype(jnp.int32)), cum[-1] if d.shape[0] else jnp.int32(0)
+    """One dispatch for the two data-dependent sizes (rows, elements)."""
+    n_elem = jnp.sum((d >= dk).astype(jnp.int32)) if d.shape[0] else jnp.int32(0)
+    return jnp.stack([jnp.sum((r == 0).astype(jnp.int32)), n_elem])
 
 
 @partial(jax.jit, static_argnames=("n_rows", "n_elem", "dk", "max_def"))
-def _asl_finish(d, cum, inst_mask, elem, n_rows: int, n_elem: int,
-                dk: int, max_def: int):
-    inst_idx = jnp.nonzero(inst_mask, size=n_rows, fill_value=0)[0]
+def _asl_finish(d, r, n_rows: int, n_elem: int, dk: int, max_def: int):
+    inst_mask = r == 0
+    elem = d >= dk
+    cum = jnp.cumsum(elem.astype(jnp.int32))
+    inst_idx = jnp.nonzero(inst_mask, size=n_rows, fill_value=0)[0].astype(jnp.int32)
     starts = cum[inst_idx] - elem[inst_idx].astype(jnp.int32)
     offsets = jnp.concatenate(
         [starts, cum[-1:] if d.shape[0] else jnp.zeros(1, jnp.int32)])
     list_validity = d[inst_idx] >= (dk - 1)
-    elem_idx = jnp.nonzero(elem, size=n_elem, fill_value=0)[0]
+    elem_idx = jnp.nonzero(elem, size=n_elem, fill_value=0)[0].astype(jnp.int32)
     leaf_validity = (d == max_def)[elem_idx]
     return offsets, list_validity, leaf_validity
 

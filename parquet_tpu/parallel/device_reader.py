@@ -90,11 +90,14 @@ class _RunTable:
 
     def run_arrays(self) -> tuple:
         """(ends, kinds, payloads, bit_offsets, widths) as flat host arrays —
-        the rle_expand kernel operands, stageable to HBM ahead of decode."""
-        return (np.concatenate(self.ends).astype(np.int64),
+        the rle_expand kernel operands, stageable to HBM ahead of decode.
+        int32 throughout: staged buffers are < 2^27 bytes (bit offsets fit)
+        and chunks hold < 2^31 values, keeping device index math in 32-bit
+        lanes."""
+        return (np.concatenate(self.ends).astype(np.int32),
                 np.concatenate(self.kinds),
                 np.concatenate(self.payloads).astype(np.int32),
-                np.concatenate(self.bit_offsets).astype(np.int64),
+                np.concatenate(self.bit_offsets).astype(np.int32),
                 np.concatenate(self.widths))
 
     def expand(self, dbuf: jax.Array, n: Optional[int] = None,
@@ -210,6 +213,10 @@ class _Plan:
     # delta
     d_firsts: List[int] = field(default_factory=list)
     d_counts: List[int] = field(default_factory=list)
+    d_vpms: List[int] = field(default_factory=list)
+    # static shape info for the dense (gather-free) delta kernel, set by
+    # stage_plan when the chunk is dense-eligible
+    d_dense_static: Optional[tuple] = None
     d_mb_offs: List[np.ndarray] = field(default_factory=list)
     d_mb_widths: List[np.ndarray] = field(default_factory=list)
     d_mb_mins: List[np.ndarray] = field(default_factory=list)
@@ -401,6 +408,7 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
         plan.d_mb_widths.append(widths)
         plan.d_mb_mins.append(mins)
         plan.d_vpm = vpm
+        plan.d_vpms.append(vpm)
         return
     if encoding == Encoding.BYTE_STREAM_SPLIT:
         plan.set_kind("bss")
@@ -438,6 +446,127 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
 # ---------------------------------------------------------------------------
 
 
+def _nonempty(parts, dtype, fill=0):
+    """Concatenate per-page metadata arrays; a zero-miniblock chunk (all
+    single-value pages) still needs 1-element tables so device gathers have a
+    non-empty operand."""
+    out = (np.concatenate(parts).astype(dtype) if parts
+           else np.empty(0, dtype))
+    return out if out.size else np.full(1, fill, dtype)
+
+
+def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
+    """Host half of the gather-free delta decode (the TPU-first path).
+
+    Compacts all miniblock payloads into per-width contiguous streams with
+    numpy fancy indexing (metadata-scale cost: the compacted bytes ARE the
+    compressed data), so the device kernel unpacks with static reshapes and
+    never gathers.  Returns False for shapes the dense kernel doesn't cover
+    (mixed vpm, >32-bit delta widths, >8 distinct widths) — those use the
+    gather kernel.
+    """
+    if not plan.d_counts:
+        return False
+    vpm = plan.d_vpm
+    if len(set(plan.d_vpms)) != 1 or vpm % 32:
+        return False
+    if len(plan.d_counts) > 512:
+        # static per-page slicing unrolls O(pages) into the graph; huge page
+        # counts use the O(1)-graph gather kernel instead
+        return False
+    widths_all = np.concatenate(plan.d_mb_widths)
+    uw = np.unique(widths_all)
+    n_mb = len(widths_all)
+    if n_mb == 0 or len(uw) > 8 or int(uw[-1]) > 32:
+        return False
+    vals_np = np.frombuffer(bytes(plan.values), np.uint8)
+    boffs = np.concatenate(plan.d_mb_offs) // 8
+    streams, groups = [], []
+    for w in uw:
+        g = np.where(widths_all == w)[0]
+        groups.append(g)
+        nb = vpm * int(w) // 8
+        idx = boffs[g][:, None] + np.arange(nb)
+        # the writer may truncate the final miniblock's payload: clip (the
+        # garbage lands in delta slots past the page's value count)
+        np.minimum(idx, len(vals_np) - 1, out=idx)
+        streams.append(jax.device_put(dev.pad_to_bucket(
+            vals_np[idx].reshape(-1), extra=4)))
+        counters.inc("bytes_h2d", idx.size)
+    if len(uw) == 1:
+        perm = None
+    else:
+        # d2 row j holds original miniblock concat_order[j]; restore original
+        # order with the inverse permutation
+        concat_order = np.concatenate(groups)
+        perm = jax.device_put(np.argsort(concat_order).astype(np.int32))
+    mins = jax.device_put(np.concatenate(plan.d_mb_mins).astype(np.int64))
+    firsts = jax.device_put(np.asarray(plan.d_firsts, np.int64))
+    meta["delta_dense"] = (tuple(streams), perm, mins, firsts)
+    plan.d_dense_static = (vpm, tuple(int(w) for w in uw),
+                           tuple(len(g) for g in groups),
+                           tuple(int(c) for c in plan.d_counts))
+    return True
+
+
+@partial(jax.jit, static_argnames=("vpm", "gw", "gk", "pcounts", "pairs"))
+def _delta_decode_dense(streams, perm, mins, firsts,
+                        vpm: int, gw: tuple, gk: tuple, pcounts: tuple,
+                        pairs: bool):
+    """Gather-free multi-page delta decode (device half).
+
+    Every access pattern is compile-time static: per-width dense unpack
+    (reshape + 32 unrolled shift/mask column ops), per-page reassembly by
+    static slicing (page structure is host metadata), and a segmented cumsum
+    whose page bases are static picks.  The only dynamic indexing is the
+    miniblock row permutation for mixed-width chunks (rare).
+    """
+    from ..ops import pallas_kernels as pk
+
+    parts = []
+    for buf, w, k in zip(streams, gw, gk):
+        if w == 0:
+            # constant/fixed-stride data: all deltas equal min_delta, payload
+            # is empty
+            parts.append(jnp.zeros((k, vpm), jnp.uint32))
+            continue
+        words = dev._as_words(buf)
+        parts.append(pk.unpack_bits_dense_jnp(words, k * vpm, w).reshape(k, vpm))
+    d2 = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    if perm is not None:
+        d2 = d2[perm]
+    if pairs:
+        deltas = (d2.astype(jnp.int64) + mins[:, None]).reshape(-1)
+        dt = jnp.int64
+        fvals = firsts
+    else:
+        # mod-2^32 arithmetic: two's-complement wrap matches the encoding
+        deltas = (d2 + (mins & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)[:, None]
+                  ).reshape(-1)
+        dt = jnp.uint32
+        fvals = (firsts & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    seq_parts = []
+    mbb = 0
+    for p, cnt in enumerate(pcounts):
+        seq_parts.append(fvals[p].astype(dt).reshape(1))
+        nd = cnt - 1
+        if nd > 0:
+            seq_parts.append(deltas[mbb * vpm: mbb * vpm + nd].astype(dt))
+        mbb += (nd + vpm - 1) // vpm
+    seq = jnp.concatenate(seq_parts) if len(seq_parts) > 1 else seq_parts[0]
+    gcum = jnp.cumsum(seq)
+    if len(pcounts) > 1:
+        pstarts = np.concatenate([[0], np.cumsum(pcounts)[:-1]])
+        base_parts = [
+            jnp.broadcast_to(gcum[int(ps) - 1] if ps else jnp.zeros((), dt),
+                             (int(cnt),))
+            for ps, cnt in zip(pstarts, pcounts)]
+        gcum = gcum - jnp.concatenate(base_parts)
+    if pairs:
+        return dev._i64_to_pairs(gcum)
+    return jax.lax.bitcast_convert_type(gcum, jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("n", "vpm", "pairs"))
 def _delta_decode_multi(buf, n, page_ends, firsts, mb_base, mb_offs, mb_widths,
                         mb_mins, vpm, pairs: bool):
@@ -446,51 +575,52 @@ def _delta_decode_multi(buf, n, page_ends, firsts, mb_base, mb_offs, mb_widths,
     seq[i] = first value of its page if i is a page start, else the unpacked
     delta.  out = cumsum(seq) - cumsum_base_of_page (segmented prefix sum).
     """
-    idx = jnp.arange(n, dtype=jnp.int64)
-    page = jnp.searchsorted(page_ends, idx, side="right")
-    page = jnp.minimum(page, page_ends.shape[0] - 1)
-    pcounts = jnp.diff(page_ends, prepend=jnp.int64(0))
-    pstart = page_ends[page] - pcounts[page]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ends = page_ends.astype(jnp.int32)
+    page = jnp.searchsorted(ends, idx, side="right")
+    page = jnp.minimum(page, ends.shape[0] - 1).astype(jnp.int32)
+    pcounts = jnp.diff(ends, prepend=jnp.int32(0))
+    pstart = ends[page] - pcounts[page]
     within = idx - pstart
-    j = within - 1  # delta ordinal within page (-1 for page-start slots)
-    jc = jnp.maximum(j, 0)
-    mb = mb_base[page] + jc // vpm
-    woff = (jc % vpm).astype(jnp.int64)
+    jc = jnp.maximum(within - 1, 0)  # delta ordinal (page-start slots unused)
+    mb = mb_base.astype(jnp.int32)[page] + jc // vpm
+    woff = jc % vpm
     w = mb_widths[mb]
-    bit_pos = mb_offs[mb] + woff * w.astype(jnp.int64)
+    bit_pos = mb_offs.astype(jnp.int32)[mb] + woff * w
     if pairs:
         lo, hi = dev.unpack_bits_at64(buf, bit_pos, w)
         raw = lo.astype(jnp.int64) | (hi.astype(jnp.int64) << 32)
-    else:
-        raw = dev.unpack_bits_at32(buf, bit_pos, w).astype(jnp.int64)
-    delta = raw + mb_mins[mb]
-    seq = jnp.where(within == 0, firsts[page], delta)
+        delta = raw + mb_mins[mb]
+        seq = jnp.where(within == 0, firsts[page], delta)
+        gcum = jnp.cumsum(seq)
+        base = gcum[pstart] - seq[pstart]  # exclusive cumsum at page start
+        return dev._i64_to_pairs(gcum - base)
+    # int32 values: mod-2^32 arithmetic keeps the whole pipeline in 32-bit
+    # lanes (two's-complement wrap matches the encoding's semantics)
+    raw = dev.unpack_bits_at32(buf, bit_pos, w)
+    min32 = (mb_mins & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    delta = raw + min32[mb]
+    first32 = (firsts & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    seq = jnp.where(within == 0, first32[page], delta)
     gcum = jnp.cumsum(seq)
-    base = gcum[pstart] - seq[pstart]  # exclusive cumsum at page start
-    out = gcum - base
-    if pairs:
-        return dev._i64_to_pairs(out)
-    return out.astype(jnp.int32)
+    base = gcum[pstart] - seq[pstart]
+    return jax.lax.bitcast_convert_type(gcum - base, jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("n", "width", "pairs"))
-def _bss_decode_multi(buf, n, page_ends, page_bases, width, pairs: bool):
-    """Page-aware BYTE_STREAM_SPLIT gather: byte k of value i lives at
-    page_base + k*page_count + within_page."""
-    idx = jnp.arange(n, dtype=jnp.int64)
-    page = jnp.searchsorted(page_ends, idx, side="right")
-    page = jnp.minimum(page, page_ends.shape[0] - 1)
-    pcounts = jnp.diff(page_ends, prepend=jnp.int64(0))
-    pstart = page_ends[page] - pcounts[page]
-    within = idx - pstart
-    cols = []
-    for k in range(width):
-        cols.append(buf[page_bases[page] + k * pcounts[page] + within])
-    bytes_ = jnp.stack(cols, axis=1)  # (n, width)
+@partial(jax.jit, static_argnames=("n", "pages", "width", "pairs"))
+def _bss_decode_multi(buf, n, pages: tuple, width: int, pairs: bool):
+    """Gather-free BYTE_STREAM_SPLIT: byte plane k of a page is the static
+    slice [base + k*count, base + (k+1)*count) — page structure is host
+    metadata, so every plane extraction is a compile-time slice and the
+    transpose is one reshape per page."""
+    per_page = []
+    for base, cnt in pages:
+        planes = buf[base: base + width * cnt].reshape(width, cnt)
+        per_page.append(planes.T)  # (cnt, width) bytes
+    bytes_ = per_page[0] if len(per_page) == 1 else jnp.concatenate(per_page)
     if width == 4:
-        dt = jnp.float32 if not pairs else jnp.uint32
-        return jax.lax.bitcast_convert_type(bytes_, jnp.uint32).reshape(n) if pairs else \
-            jax.lax.bitcast_convert_type(bytes_, dt).reshape(n)
+        dt = jnp.uint32 if pairs else jnp.float32
+        return jax.lax.bitcast_convert_type(bytes_, dt).reshape(n)
     return jax.lax.bitcast_convert_type(bytes_.reshape(n, 2, 4), jnp.uint32).reshape(n, 2)
 
 
@@ -507,6 +637,9 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
     already resident in HBM.  ``stage_levels=False`` skips the level stream
     (nested columns assemble levels on host).
     """
+    if max(len(plan.levels), len(plan.values)) > dev.MAX_DEVICE_BUF:
+        # device kernels index in 32-bit lanes; oversized chunks decode on host
+        raise _Unsupported("chunk stream exceeds 32-bit-lane bit addressing")
     lev_dbuf = None
     if stage_levels and len(plan.levels):
         lev_dbuf = jax.device_put(dev.pad_to_bucket(
@@ -514,30 +647,29 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         counters.inc("bytes_h2d", len(plan.levels))
     dense_route = (plan.value_kind == "dict" and plan.dense_ok
                    and plan.dense_pages and _dense_mode() != "off")
+    meta = {}
+    delta_dense = plan.value_kind == "delta" and _stage_delta_dense(plan, meta)
     val_dbuf = None
-    if len(plan.values) and not dense_route:
+    if len(plan.values) and not dense_route and not delta_dense:
         val_dbuf = jax.device_put(dev.pad_to_bucket(
             np.frombuffer(bytes(plan.values), np.uint8)))
         counters.inc("bytes_h2d", len(plan.values))
-    meta = {}
     if dense_route:
         # compacted single-width index stream replaces the raw bodies
         meta["dense"] = jax.device_put(dev.pad_to_bucket(
             np.frombuffer(bytes(plan.dense), np.uint8), extra=4))
         counters.inc("bytes_h2d", len(plan.dense))
     if plan.value_kind == "delta":
-        page_ends = np.cumsum(plan.d_counts).astype(np.int64)
-        mb_base = np.zeros(len(plan.d_counts), np.int64)
-        np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
-        mb_offs = (np.concatenate(plan.d_mb_offs) if plan.d_mb_offs
-                   else np.zeros(1, np.int64)).astype(np.int64)
-        mb_widths = (np.concatenate(plan.d_mb_widths) if plan.d_mb_widths
-                     else np.ones(1, np.int32))
-        mb_mins = (np.concatenate(plan.d_mb_mins) if plan.d_mb_mins
-                   else np.zeros(1, np.int64))
-        firsts = np.asarray(plan.d_firsts, np.int64)
-        meta["delta"] = jax.device_put((page_ends, firsts, mb_base, mb_offs,
-                                        mb_widths, mb_mins))
+        if not delta_dense:
+            page_ends = np.cumsum(plan.d_counts).astype(np.int32)
+            mb_base = np.zeros(len(plan.d_counts), np.int32)
+            np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
+            mb_offs = _nonempty(plan.d_mb_offs, np.int64).astype(np.int32)
+            mb_widths = _nonempty(plan.d_mb_widths, np.int32, fill=1)
+            mb_mins = _nonempty(plan.d_mb_mins, np.int64)
+            firsts = np.asarray(plan.d_firsts, np.int64)
+            meta["delta"] = jax.device_put((page_ends, firsts, mb_base, mb_offs,
+                                            mb_widths, mb_mins))
     if plan.vruns.total:
         meta["vruns"] = jax.device_put(plan.vruns.run_arrays())
     if stage_levels and plan.def_runs.total:
@@ -553,11 +685,23 @@ def stage_levels_on_device(leaf, plan: _Plan) -> bool:
     (device assembly). Struct chains (flat, max_def > 1) and lists under
     structs expand levels on host instead — the table assembler needs host
     def levels for struct nullness — so staging their level bytes would be
-    wasted H2D."""
+    wasted H2D.
+
+    List columns default to HOST assembly too: level streams are
+    metadata-scale (~bits per slot) and the C++ expand+assemble pass is two
+    orders of magnitude cheaper than the device compaction kernels, which
+    are scatter/sort-shaped — the wrong op class for a TPU.  The device
+    assembler (``dev.assemble_single_list``) stays available for pipelines
+    that need offsets/validity resident in HBM: set
+    ``PARQUET_TPU_DEVICE_ASM=1``."""
     if leaf.max_repetition_level == 0:
         return leaf.max_definition_level <= 1
+    import os
+
     from ..format.enums import FieldRepetitionType as _Rep
 
+    if os.environ.get("PARQUET_TPU_DEVICE_ASM") != "1":
+        return False
     anc = leaf.ancestors  # (list group, repeated node, leaf) for a top list
     return (leaf.max_repetition_level == 1 and len(anc) == 3
             and anc[1].repetition == _Rep.REPEATED
@@ -755,29 +899,42 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             else:
                 values = dev.dict_gather(dictionary, dict_indices)
     elif kind == "delta":
-        if staged_meta.get("delta") is not None:
-            page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins = \
-                staged_meta["delta"]
+        if staged_meta.get("delta_dense") is not None:
+            streams, perm, mins, firsts = staged_meta["delta_dense"]
+            vpm, gw, gk, pcounts = plan.d_dense_static
+            values = _delta_decode_dense(streams, perm, mins, firsts,
+                                         vpm, gw, gk, pcounts,
+                                         physical != Type.INT32)
         else:
-            page_ends = np.cumsum(plan.d_counts).astype(np.int64)
-            mb_base = np.zeros(len(plan.d_counts), np.int64)
-            np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
-            mb_offs = (np.concatenate(plan.d_mb_offs) if plan.d_mb_offs
-                       else np.zeros(1, np.int64)).astype(np.int64)
-            mb_widths = np.concatenate(plan.d_mb_widths) if plan.d_mb_widths else np.ones(1, np.int32)
-            mb_mins = np.concatenate(plan.d_mb_mins) if plan.d_mb_mins else np.zeros(1, np.int64)
-            firsts = np.asarray(plan.d_firsts, np.int64)
-        pairs = physical != Type.INT32
-        n_total = int(sum(plan.d_counts))
-        values = _delta_decode_multi(val_dbuf, n_total, page_ends,
-                                     firsts, mb_base, mb_offs,
-                                     mb_widths, mb_mins, plan.d_vpm, pairs)
+            if staged_meta.get("delta") is not None:
+                page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins = \
+                    staged_meta["delta"]
+            else:
+                page_ends = np.cumsum(plan.d_counts).astype(np.int64)
+                mb_base = np.zeros(len(plan.d_counts), np.int64)
+                np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
+                mb_offs = _nonempty(plan.d_mb_offs, np.int64)
+                mb_widths = _nonempty(plan.d_mb_widths, np.int32, fill=1)
+                mb_mins = _nonempty(plan.d_mb_mins, np.int64)
+                firsts = np.asarray(plan.d_firsts, np.int64)
+            if len(set(plan.d_vpms)) > 1:
+                # the gather kernel assumes one values-per-miniblock across
+                # all pages; mixed-vpm chunks decode on host
+                raise _Unsupported("mixed delta miniblock sizes across pages")
+            pairs = physical != Type.INT32
+            n_total = int(sum(plan.d_counts))
+            values = _delta_decode_multi(val_dbuf, n_total, page_ends,
+                                         firsts, mb_base, mb_offs,
+                                         mb_widths, mb_mins, plan.d_vpm, pairs)
     elif kind == "bss":
         w = _FIXED_WIDTH.get(physical, leaf.type_length)
-        page_ends = np.cumsum([n for _, n in plan.bss_pages]).astype(np.int64)
-        page_bases = np.asarray([b for b, _ in plan.bss_pages], np.int64)
+        if len(plan.bss_pages) > 512:
+            # static per-page slicing unrolls O(pages) into the graph
+            raise _Unsupported("byte-stream-split chunk with huge page count")
         if w in (4, 8):
-            values = _bss_decode_multi(val_dbuf, nvals, page_ends, page_bases,
+            values = _bss_decode_multi(val_dbuf, nvals,
+                                       tuple((int(b), int(n))
+                                             for b, n in plan.bss_pages),
                                        w, physical in _IS_PAIR)
         else:
             raise _Unsupported("FLBA byte-stream-split on device")

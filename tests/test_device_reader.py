@@ -135,13 +135,17 @@ def test_device_matches_host_exactly(rng):
     assert devi["s"].to_arrow().cast(pa.string()).equals(host["s"].to_arrow().cast(pa.string()))
 
 
-def test_single_list_assembles_on_device():
-    """Config-4 shape: one-level list columns expand levels AND assemble
-    (validity, list_offsets) on device (VERDICT r1 item 7)."""
+def test_single_list_assembles_on_device(monkeypatch):
+    """Config-4 shape: with PARQUET_TPU_DEVICE_ASM=1, one-level list columns
+    expand levels AND assemble (validity, list_offsets) on device (VERDICT r1
+    item 7). The default keeps levels on host (C++ expand+assemble is far
+    cheaper than device compaction kernels — measured on v5e)."""
     import jax
 
     from parquet_tpu.ops import levels as levels_ops
     from parquet_tpu.parallel import device_reader as dr
+
+    monkeypatch.setenv("PARQUET_TPU_DEVICE_ASM", "1")
 
     rng = np.random.default_rng(13)
     n_lists = 5000
@@ -230,4 +234,16 @@ def test_dense_stream_clamped_final_run(monkeypatch, rng):
     for n in (9, 33, 777, 4099):
         t = pa.table({"v": pa.array(rng.integers(0, 900, n).astype(np.int64))})
         raw = _write(t, use_dictionary=True)
+        _check(raw, t)
+
+
+def test_device_delta_constant_column():
+    """Width-0 miniblocks (constant / fixed-stride data → all-zero deltas
+    after min extraction) must decode on the dense path, not crash."""
+    for vals in (np.full(20000, 42, np.int64),
+                 np.arange(20000, dtype=np.int64) * 7 + 3,
+                 np.full(20000, -5, np.int32)):
+        t = pa.table({"x": pa.array(vals)})
+        raw = _write(t, use_dictionary=False, compression="none",
+                     column_encoding={"x": "DELTA_BINARY_PACKED"})
         _check(raw, t)
