@@ -559,7 +559,18 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None) -> Column:
             if max_def > 0:
                 enc = Encoding(dph.definition_level_encoding)
                 if enc == Encoding.RLE:
-                    defs, pos = ref.decode_rle_len_prefixed(raw, n, _bit_width(max_def), pos)
+                    if max_def == 1 and max_rep == 0:
+                        # flat optional: a page with no nulls is one RLE run
+                        # of 1s — skip the expansion (the common case)
+                        pv, end = ref.rle_len_prefixed_single_value(raw, n, pos)
+                        if pv == 1:
+                            defs, pos = None, end
+                        else:
+                            defs, pos = ref.decode_rle_len_prefixed(
+                                raw, n, 1, pos)
+                    else:
+                        defs, pos = ref.decode_rle_len_prefixed(
+                            raw, n, _bit_width(max_def), pos)
                 else:  # legacy BIT_PACKED levels
                     w = _bit_width(max_def)
                     nbytes = (n * w + 7) // 8
@@ -578,7 +589,10 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None) -> Column:
             rep = defs = None
             if max_rep > 0:
                 rep = ref.decode_rle(raw_levels, n, _bit_width(max_rep), 0)
-            if max_def > 0:
+            if max_def > 0 and not (max_def == 1 and max_rep == 0
+                                    and dph2.num_nulls == 0):
+                # v2 headers carry num_nulls: a null-free flat page skips the
+                # def expansion entirely
                 defs = ref.decode_rle(raw_levels[rl:], n, _bit_width(max_def), 0)
             body = page.payload[rl + dl :]
             if dph2.is_compressed is not False:
@@ -595,6 +609,10 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None) -> Column:
             all_rep.append(rep)
         if defs is not None:
             all_def.append(defs)
+        elif max_def > 0 and max_rep == 0:
+            # all-present fast path took this page: record the slot count so a
+            # later page WITH nulls still concatenates aligned def levels
+            all_def.append(n)
         if isinstance(decoded, _DictIndices):
             part_order.append(("idx", len(index_parts)))
             index_parts.append(decoded.indices)
@@ -605,7 +623,13 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None) -> Column:
     # ---- combine pages: single gather for dict-encoded chunks -------------
     values, offsets = _combine_parts(part_order, index_parts, value_parts,
                                      dictionary, leaf, physical)
-    def_levels = np.concatenate(all_def) if all_def else None
+    if all_def and not all(isinstance(d, (int, np.integer)) for d in all_def):
+        # mixed fast-path/expanded pages: back-fill the all-present ones
+        def_levels = np.concatenate(
+            [np.full(d, max_def, np.int32)
+             if isinstance(d, (int, np.integer)) else d for d in all_def])
+    else:
+        def_levels = None  # no def streams, or every page all-present
     rep_levels = np.concatenate(all_rep) if all_rep else None
     asm = levels_ops.assemble(def_levels, rep_levels, leaf)
     num_slots = len(def_levels) if def_levels is not None else (

@@ -89,6 +89,10 @@ def assemble(def_levels: Optional[np.ndarray], rep_levels: Optional[np.ndarray],
     max_rep = leaf.max_repetition_level
     if max_def == 0:
         return Assembled(validity=None, list_offsets=[], list_validity=[])
+    if def_levels is None and max_rep == 0:
+        # optional column whose pages were all all-present (the decoder's
+        # fast path skips the level expansion): no nulls
+        return Assembled(validity=None, list_offsets=[], list_validity=[])
     d = def_levels if def_levels is not None else np.zeros(0, dtype=np.int32)
     if max_rep == 0:
         return Assembled(validity=(d == max_def), list_offsets=[], list_validity=[])

@@ -308,6 +308,19 @@ def decode_rle_len_prefixed(data, num_values: int, bit_width: int, pos: int = 0)
     return vals, pos + 4 + length
 
 
+def rle_len_prefixed_single_value(data, num_values: int, pos: int = 0):
+    """Peek a v1 length-prefixed level stream: if it is ONE RLE run covering
+    every value, return (payload, end_pos) without expanding — the all-present
+    def-level fast path of the host scan.  Returns (None, end_pos) otherwise.
+    """
+    (length,) = struct.unpack_from("<I", data, pos)
+    end = pos + 4 + length
+    header, p = read_uvarint(data, pos + 4)
+    if header & 1 == 0 and (header >> 1) >= num_values:
+        return int(data[p]) if p < len(data) else 0, end
+    return None, end
+
+
 def decode_rle_dict_indices(data, num_values: int, pos: int = 0) -> np.ndarray:
     """RLE_DICTIONARY data page payload: 1-byte bit width, then hybrid stream."""
     bit_width = int(data[pos])

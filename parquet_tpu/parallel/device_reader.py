@@ -228,6 +228,10 @@ class _Plan:
     total_slots: int = 0
     total_values: int = 0
     dictionary_host = None
+    # leaf/physical recorded so stage_plan can stage the dictionary with the
+    # chunk instead of inside the decode phase
+    leaf = None
+    physical: Optional[Type] = None
 
     def set_kind(self, kind: str):
         if self.value_kind is None:
@@ -248,6 +252,8 @@ def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
     max_def = leaf.max_definition_level
     max_rep = leaf.max_repetition_level
     plan = _Plan()
+    plan.leaf = leaf
+    plan.physical = physical
 
     for page in (reader.pages() if pages is None else pages):
         h = page.header
@@ -455,6 +461,20 @@ def _nonempty(parts, dtype, fill=0):
     return out if out.size else np.full(1, fill, dtype)
 
 
+def _delta_gather_tables(plan: _Plan) -> tuple:
+    """Gather-kernel operands (page_ends, firsts, mb_base, mb_offs, mb_widths,
+    mb_mins) as int32 index tables (+ int64 value-domain tables), shared by
+    stage_plan and the unstaged decode fallback so the jit traces once."""
+    page_ends = np.cumsum(plan.d_counts).astype(np.int32)
+    mb_base = np.zeros(len(plan.d_counts), np.int32)
+    np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
+    mb_offs = _nonempty(plan.d_mb_offs, np.int64).astype(np.int32)
+    mb_widths = _nonempty(plan.d_mb_widths, np.int32, fill=1)
+    mb_mins = _nonempty(plan.d_mb_mins, np.int64)
+    firsts = np.asarray(plan.d_firsts, np.int64)
+    return page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins
+
+
 def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
     """Host half of the gather-free delta decode (the TPU-first path).
 
@@ -486,10 +506,12 @@ def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
         g = np.where(widths_all == w)[0]
         groups.append(g)
         nb = vpm * int(w) // 8
-        idx = boffs[g][:, None] + np.arange(nb)
+        # int32 index (staged buffers are < 2^27 bytes): the fancy index is a
+        # transient 4x the payload bytes, not 8x
+        idx = boffs[g].astype(np.int32)[:, None] + np.arange(nb, dtype=np.int32)
         # the writer may truncate the final miniblock's payload: clip (the
         # garbage lands in delta slots past the page's value count)
-        np.minimum(idx, len(vals_np) - 1, out=idx)
+        np.minimum(idx, np.int32(len(vals_np) - 1), out=idx)
         streams.append(jax.device_put(dev.pad_to_bucket(
             vals_np[idx].reshape(-1), extra=4)))
         counters.inc("bytes_h2d", idx.size)
@@ -661,15 +683,15 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         counters.inc("bytes_h2d", len(plan.dense))
     if plan.value_kind == "delta":
         if not delta_dense:
-            page_ends = np.cumsum(plan.d_counts).astype(np.int32)
-            mb_base = np.zeros(len(plan.d_counts), np.int32)
-            np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
-            mb_offs = _nonempty(plan.d_mb_offs, np.int64).astype(np.int32)
-            mb_widths = _nonempty(plan.d_mb_widths, np.int32, fill=1)
-            mb_mins = _nonempty(plan.d_mb_mins, np.int64)
-            firsts = np.asarray(plan.d_firsts, np.int64)
-            meta["delta"] = jax.device_put((page_ends, firsts, mb_base, mb_offs,
-                                            mb_widths, mb_mins))
+            if len(set(plan.d_vpms)) > 1:
+                # the gather kernel assumes one values-per-miniblock across
+                # all pages; reject before paying any H2D
+                raise _Unsupported("mixed delta miniblock sizes across pages")
+            meta["delta"] = jax.device_put(_delta_gather_tables(plan))
+    if plan.value_kind == "dict" and plan.dictionary_host is not None:
+        # dictionary pages stage with the chunk, not inside the decode phase
+        meta["dictionary"] = _stage_dictionary(plan.dictionary_host,
+                                               plan.physical, plan.leaf)
     if plan.vruns.total:
         meta["vruns"] = jax.device_put(plan.vruns.run_arrays())
     if stage_levels and plan.def_runs.total:
@@ -695,6 +717,8 @@ def stage_levels_on_device(leaf, plan: _Plan) -> bool:
     that need offsets/validity resident in HBM: set
     ``PARQUET_TPU_DEVICE_ASM=1``."""
     if leaf.max_repetition_level == 0:
+        if plan.total_values == plan.total_slots:
+            return False  # no nulls anywhere: validity is None, levels unused
         return leaf.max_definition_level <= 1
     import os
 
@@ -843,6 +867,8 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             else:
                 rep_host = np.zeros(len(def_host) if def_host is not None else 0,
                                     np.int32)
+    elif max_def > 0 and plan.total_values == plan.total_slots:
+        pass  # no nulls anywhere: validity stays None, levels never expand
     else:
         if max_def > 1 and (plan.def_runs.total or plan.host_def):
             # struct layers: the table assembler needs host def levels for
@@ -887,7 +913,9 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
         values = plan.vruns.expand(val_dbuf,
                                     tables=staged_meta.get("vruns")).astype(jnp.bool_)
     elif kind == "dict":
-        dictionary = _stage_dictionary(plan.dictionary_host, physical, leaf)
+        dictionary = staged_meta.get("dictionary")
+        if dictionary is None:
+            dictionary = _stage_dictionary(plan.dictionary_host, physical, leaf)
         if staged_meta.get("dense") is not None:
             dict_indices, values = _decode_dense_dict(plan, staged_meta["dense"],
                                                       dictionary, physical)
@@ -906,21 +934,12 @@ def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
                                          vpm, gw, gk, pcounts,
                                          physical != Type.INT32)
         else:
-            if staged_meta.get("delta") is not None:
-                page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins = \
-                    staged_meta["delta"]
-            else:
-                page_ends = np.cumsum(plan.d_counts).astype(np.int64)
-                mb_base = np.zeros(len(plan.d_counts), np.int64)
-                np.cumsum([len(w) for w in plan.d_mb_widths[:-1]], out=mb_base[1:])
-                mb_offs = _nonempty(plan.d_mb_offs, np.int64)
-                mb_widths = _nonempty(plan.d_mb_widths, np.int32, fill=1)
-                mb_mins = _nonempty(plan.d_mb_mins, np.int64)
-                firsts = np.asarray(plan.d_firsts, np.int64)
             if len(set(plan.d_vpms)) > 1:
-                # the gather kernel assumes one values-per-miniblock across
-                # all pages; mixed-vpm chunks decode on host
                 raise _Unsupported("mixed delta miniblock sizes across pages")
+            tables = staged_meta.get("delta")
+            if tables is None:
+                tables = _delta_gather_tables(plan)
+            page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins = tables
             pairs = physical != Type.INT32
             n_total = int(sum(plan.d_counts))
             values = _delta_decode_multi(val_dbuf, n_total, page_ends,
@@ -986,13 +1005,9 @@ def _decode_dense_dict(plan: _Plan, dense_buf: jax.Array, dictionary,
     # round UP to whole 32-value groups: the final page's tail group may be
     # partial byte-wise; the unpack kernels zero-pad missing words
     total = -(-(len(plan.dense) * 8 // w) // 32) * 32
-    # round word count UP: the stream's byte length need not be 4-aligned and
-    # pad_to_bucket(extra=4) guarantees ≥4 zero bytes of slack past the end
-    nwords = (len(plan.dense) + 3) // 4
-    words = jax.lax.bitcast_convert_type(
-        dense_buf[: nwords * 4].reshape(nwords, 4), jnp.uint32)
     mode = _dense_mode()
     interpret = jax.default_backend() != "tpu"
+    pages = tuple((int(s), int(n)) for s, n in plan.dense_pages)
     fused = (mode == "pallas" and physical != Type.BYTE_ARRAY
              and not isinstance(dictionary, tuple)
              and getattr(dictionary, "ndim", 0) == 1
@@ -1000,21 +1015,41 @@ def _decode_dense_dict(plan: _Plan, dense_buf: jax.Array, dictionary,
     if fused:
         # one VMEM pass: unpack + gather (small dictionaries only — the
         # one-hot matmul is O(n·D)); indices are not materialized
+        nwords = (len(plan.dense) + 3) // 4
+        words = jax.lax.bitcast_convert_type(
+            dense_buf[: nwords * 4].reshape(nwords, 4), jnp.uint32)
         allvals = pk.dict_unpack_gather(words, dictionary, total, w,
                                         interpret=interpret)
         parts = [allvals[s: s + n] for s, n in plan.dense_pages]
         values = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         return None, values
-    if mode == "pallas":
-        allidx = pk.unpack_bits_dense(words, total, w, interpret=interpret)
-    else:
-        allidx = pk.unpack_bits_dense_jnp(words, total, w)
-    parts = [allidx[s: s + n] for s, n in plan.dense_pages]
-    indices = (parts[0] if len(parts) == 1
-               else jnp.concatenate(parts)).astype(jnp.int32)
+    indices = _dense_unpack_pages(dense_buf, len(plan.dense), total, w, pages,
+                                  mode == "pallas", interpret)
     if physical == Type.BYTE_ARRAY:
         return indices, None
     return indices, dev.dict_gather(dictionary, indices)
+
+
+@partial(jax.jit, static_argnames=("nbytes", "total", "w", "pages", "pallas",
+                                   "interpret"))
+def _dense_unpack_pages(dense_buf, nbytes: int, total: int, w: int,
+                        pages: tuple, pallas: bool, interpret: bool):
+    """One dispatch for the dense dict-index decode: word view + unpack +
+    per-page compaction (static slices) + dtype cast, all fused."""
+    from ..ops import pallas_kernels as pk
+
+    # round word count UP: the stream's byte length need not be 4-aligned and
+    # pad_to_bucket(extra=4) guarantees ≥4 zero bytes of slack past the end
+    nwords = (nbytes + 3) // 4
+    words = jax.lax.bitcast_convert_type(
+        dense_buf[: nwords * 4].reshape(nwords, 4), jnp.uint32)
+    if pallas:
+        allidx = pk.unpack_bits_dense(words, total, w, interpret=interpret)
+    else:
+        allidx = pk.unpack_bits_dense_jnp(words, total, w)
+    parts = [allidx[s: s + n] for s, n in pages]
+    return (parts[0] if len(parts) == 1
+            else jnp.concatenate(parts)).astype(jnp.int32)
 
 
 def _stage_dictionary(dict_host, physical, leaf):

@@ -13,6 +13,7 @@ predicate is applied to the decoded keys.
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
@@ -22,6 +23,20 @@ from ..io.reader import ParquetFile
 from ..io.search import plan_scan, read_row_range
 
 __all__ = ["scan_filtered", "scan_filtered_device"]
+
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def _pool() -> ThreadPoolExecutor:
+    """Shared scan executor: pool construction costs ~1ms, which would
+    dominate small pushdown scans if paid per call."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(max_workers=16,
+                                       thread_name_prefix="pq-scan")
+        return _POOL
 
 
 def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
@@ -73,16 +88,28 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 
     read_cols = [path] + [c for c in out_cols if c != path]
 
-    def read_span(plan):
+    def read_one(task):
+        plan, c = task
         start = int(rg_base[plan.rg_index]) + plan.first_row
-        return {c: read_row_range(pf, c, start, plan.row_count, aligned=True)
-                for c in read_cols}
+        return read_row_range(pf, c, start, plan.row_count, aligned=True)
 
-    if num_threads == 1 or len(plans) <= 1:
-        spans = [read_span(p) for p in plans]
-    else:
+    tasks = [(p, c) for p in plans for c in read_cols]
+    # thread-pool dispatch costs ~100us/task: serial decode wins for small
+    # plans (measured crossover around a few hundred thousand cells)
+    cells = sum(p.row_count for p in plans) * len(read_cols)
+    if num_threads == 1 or len(tasks) <= 1 or (num_threads is None
+                                               and cells < 2_000_000):
+        results = [read_one(t) for t in tasks]
+    elif num_threads is None:
+        # fan out per (span, column): the decode work releases the GIL in
+        # numpy/C++/codec calls, so even a single surviving span uses all
+        # requested columns' worth of parallelism
+        results = list(_pool().map(read_one, tasks))
+    else:  # explicit bound: a dedicated pool honors the caller's limit
         with ThreadPoolExecutor(max_workers=num_threads) as pool:
-            spans = list(pool.map(read_span, plans))
+            results = list(pool.map(read_one, tasks))
+    spans = [{c: results[i * len(read_cols) + j] for j, c in enumerate(read_cols)}
+             for i in range(len(plans))]
 
     parts: Dict[str, List] = {c: [] for c in out_cols}
     vparts: Dict[str, List] = {c: [] for c in out_cols}
@@ -186,7 +213,12 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                 raise ValueError(
                     f"device scan column {c!r}: plain-encoded BYTE_ARRAY has "
                     "no row-aligned device form; use the host scan")
-            staged = dr.stage_plan(dplan)
+            try:
+                staged = dr.stage_plan(dplan)
+            except dr._Unsupported as e:
+                raise ValueError(
+                    f"device scan column {c!r}: {e}; use the host scan "
+                    "(scan_filtered)") from None
             per_col[c] = (chunk, dplan, staged, row_start - first)
         spans.append((plan, per_col))
     return {"path": path, "out_cols": out_cols, "lo": lo, "hi": hi,
