@@ -15,6 +15,7 @@ class CorruptedError(Exception):
 MAX_COLUMN_DEPTH = 16
 MAX_COLUMN_INDEX_SIZE = 16 * 1024 * 1024
 MAX_PAGE_SIZE = (1 << 31) - 1  # page sizes are i32 in the thrift structs
+MAX_PAGE_HEADER_SIZE = 1 << 20  # sanity cap for streamed header windows
 MAX_ROW_GROUPS = 1 << 15  # RowGroup.ordinal is an i16
 MAX_DEFINITION_LEVEL = 255
 MAX_REPETITION_LEVEL = 255

@@ -30,7 +30,7 @@ from .source import Source, as_source
 
 
 from ..errors import (CorruptedError, MAX_COLUMN_INDEX_SIZE,  # noqa: F401
-                      MAX_PAGE_SIZE)  # re-exported: historical home of the class
+                      MAX_PAGE_HEADER_SIZE, MAX_PAGE_SIZE)  # re-exported: historical home of the class
 
 
 @dataclass
@@ -68,6 +68,15 @@ class PageInfo:
         if h.dictionary_page_header is not None:
             return h.dictionary_page_header.num_values
         return 0
+
+
+def _checked_page_size(header: md.PageHeader, at: int) -> int:
+    """Shared page-size sanity check for the three page iterators."""
+    clen = header.compressed_page_size
+    if not 0 <= clen <= MAX_PAGE_SIZE:
+        raise CorruptedError(
+            f"page at {at}: compressed size {clen} out of range")
+    return clen
 
 
 _UNSET = object()  # lazy-memo sentinel (None is a valid cached value)
@@ -120,10 +129,7 @@ class ColumnChunkReader:
                 header, data_pos = thrift.deserialize(md.PageHeader, raw, pos)
             except Exception as e:
                 raise CorruptedError(f"bad page header at {start+pos}: {e}") from e
-            clen = header.compressed_page_size
-            if not 0 <= clen <= MAX_PAGE_SIZE:
-                raise CorruptedError(
-                    f"page at {start+pos}: compressed size {clen} out of range")
+            clen = _checked_page_size(header, start + pos)
             payload = raw[data_pos : data_pos + clen]
             if len(payload) != clen:
                 raise CorruptedError("truncated page payload")
@@ -132,6 +138,46 @@ class ColumnChunkReader:
                 values_seen += page.num_values
             yield page
             pos = data_pos + clen
+
+    def pages_streamed(self) -> Iterator[PageInfo]:
+        """O(page)-memory page iterator: small incremental preads instead of
+        one whole-chunk read — the bounded-memory analog of the reference's
+        ``PageBufferSize`` streaming (SURVEY.md §5). Consumers that stop early
+        (a row-range cursor mid-chunk) never touch the remaining bytes."""
+        start, size = self.byte_range
+        src = self.file.source
+        pos = 0
+        values_seen = 0
+        total = self.meta.num_values
+        window = 1 << 12
+        while values_seen < total and pos < size:
+            buf = src.pread(start + pos, min(window, size - pos))
+            while True:
+                try:
+                    header, data_pos = thrift.deserialize(md.PageHeader, buf, 0)
+                    break
+                except Exception as e:
+                    if len(buf) >= min(MAX_PAGE_HEADER_SIZE, size - pos):
+                        raise CorruptedError(
+                            f"bad page header at {start+pos}: {e}") from e
+                    buf = src.pread(start + pos,
+                                    min(len(buf) * 4, size - pos))
+            clen = _checked_page_size(header, start + pos)
+            if pos + data_pos + clen > size:
+                # a payload running past the chunk would silently read the
+                # NEXT chunk's bytes here — same corruption pages() detects
+                raise CorruptedError("truncated page payload")
+            if data_pos + clen <= len(buf):
+                payload = buf[data_pos : data_pos + clen]
+            else:
+                payload = src.pread(start + pos + data_pos, clen)
+            if len(payload) != clen:
+                raise CorruptedError("truncated page payload")
+            page = PageInfo(header=header, payload=payload, offset=start + pos)
+            if page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+                values_seen += page.num_values
+            yield page
+            pos += data_pos + clen
 
     def pages_at(self, offset: int, size: int,
                  num_pages: Optional[int] = None) -> Iterator[PageInfo]:
@@ -145,10 +191,7 @@ class ColumnChunkReader:
                 header, data_pos = thrift.deserialize(md.PageHeader, raw, pos)
             except Exception as e:
                 raise CorruptedError(f"bad page header at {offset+pos}: {e}") from e
-            clen = header.compressed_page_size
-            if not 0 <= clen <= MAX_PAGE_SIZE:
-                raise CorruptedError(
-                    f"page at {offset+pos}: compressed size {clen} out of range")
+            clen = _checked_page_size(header, offset + pos)
             payload = raw[data_pos : data_pos + clen]
             if len(payload) != clen:
                 raise CorruptedError("truncated page payload")
@@ -299,6 +342,16 @@ class ParquetFile:
         return RowGroupReader(self, i, self.metadata.row_groups[i])
 
     # ------------------------------------------------------------------
+    def iter_batches(self, columns: Optional[Sequence[str]] = None,
+                     batch_rows: int = 65536):
+        """Bounded-memory streaming read: yield row-aligned :class:`Table`
+        batches holding O(pages-per-batch) memory — the reference's
+        ``PageBufferSize`` + ``GenericReader.Read`` streaming mode
+        (see io/stream.py)."""
+        from .stream import iter_batches as _iter
+
+        return _iter(self, columns=columns, batch_rows=batch_rows)
+
     def read(self, columns: Optional[Sequence[str]] = None,
              device: bool = False) -> "Table":
         """Read and decode the whole file.
@@ -452,6 +505,10 @@ class Table:
         rep_leaf = min(subleaves, key=lambda l: l.max_repetition_level)
         col = self.columns[rep_leaf.dotted_path]
         if col.def_levels is None:
+            if col.validity is None and rep_leaf.max_repetition_level == 0:
+                # the no-null fast paths drop both levels and validity: every
+                # ancestor (this struct included) is fully present
+                return pa.StructArray.from_arrays(arrs, names)
             if rep_leaf.max_definition_level == own_def and col.validity is not None \
                     and rep_leaf.max_repetition_level == 0:
                 valid = np.asarray(col.validity)
@@ -518,16 +575,18 @@ def _bit_width(maxval: int) -> int:
     return int(maxval).bit_length()
 
 
-def decode_chunk_host(reader: ColumnChunkReader, pages=None) -> Column:
+def decode_chunk_host(reader: ColumnChunkReader, pages=None,
+                      dictionary=None) -> Column:
     """Decode a chunk (or, with ``pages``, a selected page subset — the
-    SeekToRow / pushdown path of io/search.py)."""
+    SeekToRow / pushdown path of io/search.py).  ``dictionary`` injects an
+    already-decoded dictionary so page-at-a-time streaming consumers don't
+    re-decode the dictionary page per batch."""
     leaf = reader.leaf
     meta = reader.meta
     codec = reader.codec
     max_def = leaf.max_definition_level
     max_rep = leaf.max_repetition_level
     physical = Type(meta.type)
-    dictionary = None  # decoded dictionary values
     all_def: List[np.ndarray] = []
     all_rep: List[np.ndarray] = []
     index_parts: List[np.ndarray] = []  # dict-encoded pages

@@ -1,0 +1,213 @@
+"""Bounded-memory streaming reads: O(pages-per-batch), never O(chunk).
+
+Reference parity: the reference reads with O(page) memory — ``config.go —
+PageBufferSize`` bounds what a reader holds, and ``GenericReader[T].Read``
+streams batches (SURVEY.md §5, "bounded-batch streaming").  This module is
+that mode for the new framework: :func:`iter_batches` yields row-aligned
+:class:`~parquet_tpu.io.reader.Table` batches while holding, per column, only
+the decoded pages that cover the current batch.
+
+Mechanics: each (row-group, column) gets a cursor over
+``ColumnChunkReader.pages_streamed()`` (incremental preads — the file is
+never read a whole chunk at a time), decoding one page per pull with the
+chunk's dictionary decoded once.  Batch boundaries rarely align with page
+boundaries, so rows are sliced out of decoded page columns by slicing the
+Dremel level streams and re-running the (linear, metadata-scale) level
+assembler on the slice — this handles flat, struct, and arbitrarily nested
+list columns with one rule.
+
+Pages are assumed record-aligned (a row never splits across pages), which
+every mainstream writer guarantees and DataPageV2 requires.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..format.enums import PageType
+from ..ops import levels as levels_ops
+from .column import Column, concat_columns
+from .reader import ParquetFile, Table, decode_chunk_host, _decode_dictionary
+
+__all__ = ["iter_batches"]
+
+
+@dataclass
+class _PagePiece:
+    col: Column
+    rows: int
+    # row → slot start positions within this piece (identity for flat)
+    row_starts: Optional[np.ndarray] = None
+
+
+@dataclass
+class _ChunkCursor:
+    """Incremental decoder for one column chunk: pulls pages on demand,
+    holds only decoded-but-unconsumed pieces."""
+
+    chunk: object  # ColumnChunkReader
+    pages: Iterator = None
+    dictionary: object = None
+    pieces: List[_PagePiece] = field(default_factory=list)
+    consumed: int = 0  # rows consumed from pieces[0]
+    exhausted: bool = False
+
+    def __post_init__(self):
+        self.pages = self.chunk.pages_streamed()
+
+    def _pull_page(self) -> bool:
+        for page in self.pages:
+            if page.page_type == PageType.DICTIONARY_PAGE:
+                h = page.header
+                from ..format.enums import Type
+
+                raw = self.chunk.codec.decode(page.payload,
+                                              h.uncompressed_page_size)
+                self.dictionary = _decode_dictionary(
+                    raw, h.dictionary_page_header, self.chunk.leaf,
+                    Type(self.chunk.meta.type))
+                continue
+            col = decode_chunk_host(self.chunk, pages=iter([page]),
+                                    dictionary=self.dictionary)
+            rep = col.rep_levels
+            if rep is not None:
+                starts = np.flatnonzero(np.asarray(rep) == 0)
+                rows = len(starts)
+            else:
+                starts = None
+                rows = col.num_slots or col.num_values
+            self.pieces.append(_PagePiece(col=col, rows=rows,
+                                          row_starts=starts))
+            return True
+        self.exhausted = True
+        return False
+
+    def take(self, n_rows: int):
+        """Consume up to ``n_rows`` rows → (sliced column pieces, rows)."""
+        out: List[Column] = []
+        need = n_rows
+        while need > 0:
+            if not self.pieces and not self._pull_page():
+                break
+            piece = self.pieces[0]
+            avail = piece.rows - self.consumed
+            if avail <= 0:
+                self.pieces.pop(0)
+                self.consumed = 0
+                continue
+            take = min(avail, need)
+            out.append(_slice_rows(piece, self.consumed,
+                                   self.consumed + take))
+            self.consumed += take
+            need -= take
+            if self.consumed >= piece.rows:
+                self.pieces.pop(0)
+                self.consumed = 0
+        return out, n_rows - need
+
+
+def _slice_rows(piece: _PagePiece, r0: int, r1: int) -> Column:
+    """Rows [r0, r1) of a decoded page column, as a self-contained Column.
+
+    Levels are sliced in slot space and re-assembled (linear in the slice);
+    values/indices/offsets are sliced in value space via the def levels.
+    """
+    col = piece.col
+    leaf = col.leaf
+    if r0 == 0 and r1 >= piece.rows:
+        return col
+    max_def = leaf.max_definition_level
+    d = None if col.def_levels is None else np.asarray(col.def_levels)
+    r = None if col.rep_levels is None else np.asarray(col.rep_levels)
+    if r is not None:
+        starts = piece.row_starts
+        s0 = int(starts[r0])
+        s1 = int(starts[r1]) if r1 < len(starts) else len(r)
+    else:
+        s0, s1 = r0, r1
+    if d is None:
+        v0, v1 = s0, s1  # required flat: slots == values
+        d_sl = r_sl = None
+    else:
+        present = d == max_def
+        v0 = int(np.count_nonzero(present[:s0]))
+        v1 = v0 + int(np.count_nonzero(present[s0:s1]))
+        d_sl = d[s0:s1]
+        r_sl = None if r is None else r[s0:s1]
+    asm = levels_ops.assemble(d_sl, r_sl, leaf)
+    values = col.values
+    offsets = None
+    dict_indices = None
+    if col.is_dictionary_encoded():
+        dict_indices = np.asarray(col.dict_indices)[v0:v1]
+        values = None
+    elif col.offsets is not None:
+        offs = np.asarray(col.offsets)
+        base = int(offs[v0])
+        offsets = (offs[v0 : v1 + 1] - base).astype(offs.dtype)
+        values = np.asarray(values)[base : int(offs[v1])]
+    elif values is not None:
+        values = np.asarray(values)[v0:v1]
+    return Column(leaf=leaf, values=values, offsets=offsets,
+                  validity=asm.validity, list_offsets=asm.list_offsets,
+                  list_validity=asm.list_validity, num_slots=s1 - s0,
+                  dictionary=col.dictionary,
+                  dictionary_host=col.dictionary_host,
+                  dict_indices=dict_indices,
+                  def_levels=d_sl, rep_levels=r_sl)
+
+
+def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
+                 batch_rows: int = 65536) -> Iterator[Table]:
+    """Stream the file as row-aligned :class:`Table` batches of at most
+    ``batch_rows`` rows, holding O(pages-per-batch) memory per column.
+
+    ``columns`` selects leaves by dotted path (default: all).  Batches span
+    row-group boundaries; concatenating every batch equals a full
+    :meth:`ParquetFile.read`.
+    """
+    if batch_rows <= 0:
+        raise ValueError("batch_rows must be positive")
+    leaves = [pf.schema.leaf(c) for c in columns] if columns is not None \
+        else list(pf.schema.leaves)
+    paths = [leaf.dotted_path for leaf in leaves]
+    rg_iter = iter(range(len(pf.row_groups)))
+    cursors: Optional[Dict[str, _ChunkCursor]] = None
+    rg_rows_left = 0
+    pending: Dict[str, List[Column]] = {p: [] for p in paths}
+    pending_rows = 0
+
+    def flush() -> Table:
+        nonlocal pending, pending_rows
+        cols = {p: concat_columns(parts) if len(parts) > 1 else parts[0]
+                for p, parts in pending.items()}
+        t = Table(pf.schema, cols, pending_rows)
+        pending = {p: [] for p in paths}
+        pending_rows = 0
+        return t
+
+    while True:
+        if rg_rows_left == 0:
+            rg_index = next(rg_iter, None)
+            if rg_index is None:
+                break
+            rg = pf.row_group(rg_index)
+            cursors = {p: _ChunkCursor(chunk=rg.column(p)) for p in paths}
+            rg_rows_left = rg.num_rows
+        take = min(batch_rows - pending_rows, rg_rows_left)
+        for p in paths:
+            pieces, got = cursors[p].take(take)
+            if got != take:
+                raise RuntimeError(
+                    f"column {p!r}: streaming cursor yielded {got} of {take} "
+                    "rows (page stream shorter than row-group metadata)")
+            pending[p].extend(pieces)
+        pending_rows += take
+        rg_rows_left -= take
+        if pending_rows >= batch_rows:
+            yield flush()
+    if pending_rows:
+        yield flush()

@@ -207,13 +207,13 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
         for c in [path] + out_cols:
             chunk = rg.column(c)
             pages, first = pages_and_base(chunk, row_start, row_end)
-            dplan = dr.build_plan(chunk, pages=iter(pages))
-            if (chunk.leaf.physical_type == Type.BYTE_ARRAY
-                    and dplan.value_kind != "dict"):
-                raise ValueError(
-                    f"device scan column {c!r}: plain-encoded BYTE_ARRAY has "
-                    "no row-aligned device form; use the host scan")
             try:
+                dplan = dr.build_plan(chunk, pages=iter(pages))
+                if (chunk.leaf.physical_type == Type.BYTE_ARRAY
+                        and dplan.value_kind != "dict"):
+                    raise ValueError(
+                        f"device scan column {c!r}: plain-encoded BYTE_ARRAY "
+                        "has no row-aligned device form; use the host scan")
                 staged = dr.stage_plan(dplan)
             except dr._Unsupported as e:
                 raise ValueError(

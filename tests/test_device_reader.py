@@ -247,3 +247,15 @@ def test_device_delta_constant_column():
         raw = _write(t, use_dictionary=False, compression="none",
                      column_encoding={"x": "DELTA_BINARY_PACKED"})
         _check(raw, t)
+
+
+def test_device_struct_no_nulls_vectorized_arrow():
+    """All-present struct chains drop levels AND validity on the no-null fast
+    path; to_arrow must still build the struct vectorized (not row-by-row)."""
+    n = 30000
+    t = pa.table({"st": pa.array(
+        [{"a": i, "b": float(i)} for i in range(n)],
+        type=pa.struct([("a", pa.int64()), ("b", pa.float64())]))})
+    raw = _write(t, use_dictionary=False, compression="none")
+    got = ParquetFile(raw).read(device=True).to_arrow()
+    assert got.column("st").combine_chunks().equals(t.column("st").combine_chunks())

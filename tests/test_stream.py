@@ -1,0 +1,128 @@
+"""Bounded-memory streaming reads (io/stream.py): batch correctness vs
+pyarrow across types/batch sizes, plus actual IO-boundedness — the reference
+streams O(page), not O(chunk) (SURVEY.md §5, PageBufferSize)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import ParquetFile, iter_batches
+
+
+def _write(t: pa.Table, **kw) -> bytes:
+    buf = io.BytesIO()
+    pq.write_table(t, buf, **kw)
+    return buf.getvalue()
+
+
+def _concat_batches(pf, **kw):
+    tables = [b.to_arrow() for b in iter_batches(pf, **kw)]
+    assert tables
+    return pa.concat_tables(tables)
+
+
+def _mixed_table(n, rng):
+    return pa.table({
+        "i": pa.array(rng.integers(-(2**50), 2**50, n)),
+        "oi": pa.array([None if i % 7 == 0 else i * 3 for i in range(n)],
+                       type=pa.int64()),
+        "f": pa.array(rng.random(n, dtype=np.float32)),
+        "s": pa.array([f"s{i % 113}" for i in range(n)]),
+        "lst": pa.array([None if i % 11 == 0 else
+                         [int(x) for x in range(i % 5)] for i in range(n)],
+                        type=pa.list_(pa.int64())),
+    })
+
+
+@pytest.mark.parametrize("batch_rows", [1, 7, 1000, 4096, 100000])
+def test_stream_batches_equal_full_read(batch_rows, rng):
+    n = 10000
+    t = _mixed_table(n, rng)
+    raw = _write(t, row_group_size=3000, data_page_size=2048)
+    got = _concat_batches(ParquetFile(raw), batch_rows=batch_rows)
+    want = pq.read_table(io.BytesIO(raw))
+    assert got.num_rows == n
+    for name in t.column_names:
+        assert got.column(name).combine_chunks().equals(
+            want.column(name).combine_chunks()), name
+
+
+def test_stream_batch_sizes_and_column_subset(rng):
+    n = 5000
+    t = _mixed_table(n, rng)
+    raw = _write(t, row_group_size=1700, data_page_size=4096)
+    pf = ParquetFile(raw)
+    sizes = []
+    for b in iter_batches(pf, columns=["i", "oi"], batch_rows=999):
+        sizes.append(b.num_rows)
+        assert np.asarray(b["i"].values).ndim == 1
+    assert sum(sizes) == n
+    assert all(s == 999 for s in sizes[:-1])
+
+
+def test_stream_struct_columns(rng):
+    rows = [None if i % 9 == 0 else {"a": i, "b": None if i % 4 == 0 else f"v{i}"}
+            for i in range(3000)]
+    t = pa.table({"st": pa.array(
+        rows, type=pa.struct([("a", pa.int64()), ("b", pa.string())]))})
+    raw = _write(t, row_group_size=1000, data_page_size=1024,
+                 use_dictionary=False)
+    got = _concat_batches(ParquetFile(raw), batch_rows=450)
+    assert got.column("st").to_pylist() == t.column("st").to_pylist()
+
+
+def test_stream_is_io_bounded(rng):
+    """The streaming path must never pread a whole chunk: with many pages per
+    chunk, the largest single read stays page-sized and the bytes touched by
+    the first batch are a small fraction of the file."""
+    n = 200_000
+    t = pa.table({"x": pa.array(rng.integers(0, 1 << 40, n)),
+                  "y": pa.array(rng.random(n))})
+    raw = _write(t, row_group_size=n, data_page_size=8192,
+                 use_dictionary=False, compression="none")
+    pf = ParquetFile(raw)
+
+    reads = []
+    orig = pf.source.pread
+
+    def spy(offset, size):
+        reads.append(size)
+        return orig(offset, size)
+
+    pf.source.pread = spy
+    it = iter_batches(pf, batch_rows=4096)
+    first = next(it)
+    assert first.num_rows == 4096
+    chunk_size = pf.row_group(0).column("x").meta.total_compressed_size
+    assert max(reads) < chunk_size / 10, (max(reads), chunk_size)
+    assert sum(reads) < len(raw) / 10, (sum(reads), len(raw))
+    # draining the iterator still reads everything correctly
+    total = first.num_rows + sum(b.num_rows for b in it)
+    assert total == n
+
+
+def test_stream_dictionary_decoded_once(rng):
+    n = 30000
+    t = pa.table({"s": pa.array([f"cat{i % 40}" for i in range(n)])})
+    raw = _write(t, data_page_size=2048)
+    pf = ParquetFile(raw)
+    from parquet_tpu.utils.debug import counters
+
+    before = counters.get("dict_pages_decoded")
+    got = _concat_batches(pf, batch_rows=1234)
+    assert got.column("s").combine_chunks().equals(
+        pq.read_table(io.BytesIO(raw)).column("s").combine_chunks())
+    # one dictionary decode per chunk, not one per page/batch
+    assert counters.get("dict_pages_decoded") - before <= len(pf.row_groups)
+
+
+def test_stream_empty_and_single_row(rng):
+    t = pa.table({"x": pa.array(np.arange(1, dtype=np.int64))})
+    raw = _write(t)
+    batches = list(iter_batches(ParquetFile(raw), batch_rows=10))
+    assert len(batches) == 1 and batches[0].num_rows == 1
+    with pytest.raises(ValueError):
+        list(iter_batches(ParquetFile(raw), batch_rows=0))
