@@ -320,6 +320,44 @@ def _cfg5(n):
     }
 
 
+def _cfg6(n):
+    """Write throughput (reference's asm-heaviest area: hashprobe dictionary
+    build + encoders). Wall-clock vs pyarrow writing the same mixed table."""
+    from parquet_tpu import WriterOptions, write_table
+
+    rng = np.random.default_rng(23)
+    t = pa.table({
+        "i64": pa.array((np.arange(n, dtype=np.int64) * 2654435761) % (1 << 60)),
+        "k": pa.array(rng.integers(0, 20_000, n).astype(np.int64)),
+        "s": pa.array(np.array([f"cat{i:03d}" for i in range(200)])[
+            rng.integers(0, 200, n)]),
+        "f": pa.array(rng.random(n)),
+    })
+
+    def run_ours():
+        buf = io.BytesIO()
+        write_table(t, buf, WriterOptions(compression="snappy"))
+        return buf.tell()
+
+    size = run_ours()
+    ours_s = _time_best(run_ours, reps=3)
+
+    def run_pyarrow():
+        buf = io.BytesIO()
+        pq.write_table(t, buf, compression="snappy")
+        return buf.tell()
+
+    run_pyarrow()
+    pa_s = _time_best(run_pyarrow, reps=3)
+    return {
+        "MBps": round(t.nbytes / ours_s / 1e6, 1),
+        "vs_pyarrow": round(pa_s / ours_s, 2),
+        "write_s": round(ours_s, 4),
+        "pyarrow_s": round(pa_s, 4),
+        "file_MB": round(size / 1e6, 1),
+    }
+
+
 def main():
     n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 8_000_000
     quick = os.environ.get("BENCH_QUICK", "") not in ("", "0")
@@ -340,6 +378,7 @@ def main():
     configs["3_string_dict_zstd"] = _cfg3(n_rows)
     configs["4_delta_ts_nested"] = _cfg4(n_rows)
     configs["5_pushdown_scan"] = _cfg5(max(n_rows // 4, 8))
+    configs["6_write_mixed"] = _cfg6(max(n_rows // 4, 8))
 
     head = configs["1_int64_plain"]
     print(json.dumps({

@@ -236,6 +236,16 @@ def min_max(leaf: Leaf, cd, v0: int, v1: int):
     t = leaf.physical_type
     if t == Type.INT96:
         return None, None
+    if t == Type.BYTE_ARRAY and not _is_decimal(leaf):
+        from .. import native
+
+        offs = np.asarray(cd.offsets, np.int64)
+        mm = native.minmax_ba(np.asarray(cd.values), offs, v0, v1)
+        if mm is not None:
+            mi, ma = mm
+            vals = np.asarray(cd.values)
+            return (vals[offs[mi]:offs[mi + 1]].tobytes(),
+                    vals[offs[ma]:offs[ma + 1]].tobytes())
     dense = _dense_order_values(leaf, cd, v0, v1)
     if t in (Type.FLOAT, Type.DOUBLE):
         finite = dense[~np.isnan(dense)]

@@ -150,8 +150,10 @@ class ColumnChunkReader:
         values_seen = 0
         total = self.meta.num_values
         window = 1 << 12
+        buf = b""
         while values_seen < total and pos < size:
-            buf = src.pread(start + pos, min(window, size - pos))
+            if not buf:
+                buf = src.pread(start + pos, min(window, size - pos))
             while True:
                 try:
                     header, data_pos = thrift.deserialize(md.PageHeader, buf, 0)
@@ -178,6 +180,9 @@ class ColumnChunkReader:
                 values_seen += page.num_values
             yield page
             pos += data_pos + clen
+            # carry the unconsumed window tail: small pages often fit several
+            # to a window, so the next header needs no fresh pread
+            buf = buf[data_pos + clen:] if data_pos + clen < len(buf) else b""
 
     def pages_at(self, offset: int, size: int,
                  num_pages: Optional[int] = None) -> Iterator[PageInfo]:

@@ -61,20 +61,30 @@ class _ChunkCursor:
     def _pull_page(self) -> bool:
         for page in self.pages:
             if page.page_type == PageType.DICTIONARY_PAGE:
-                h = page.header
-                from ..format.enums import Type
+                import zlib
 
+                h = page.header
+                from ..errors import CorruptedError
+                from ..format.enums import Type
+                from ..utils.debug import counters
+
+                if self.chunk.file.options.verify_crc and h.crc is not None:
+                    crc = zlib.crc32(page.payload) & 0xFFFFFFFF
+                    if crc != (h.crc & 0xFFFFFFFF):
+                        raise CorruptedError(
+                            f"page CRC mismatch at offset {page.offset}")
                 raw = self.chunk.codec.decode(page.payload,
                                               h.uncompressed_page_size)
                 self.dictionary = _decode_dictionary(
                     raw, h.dictionary_page_header, self.chunk.leaf,
                     Type(self.chunk.meta.type))
+                counters.inc("dict_pages_decoded")
                 continue
             col = decode_chunk_host(self.chunk, pages=iter([page]),
                                     dictionary=self.dictionary)
             rep = col.rep_levels
             if rep is not None:
-                starts = np.flatnonzero(np.asarray(rep) == 0)
+                starts = levels_ops.row_slot_starts(rep)
                 rows = len(starts)
             else:
                 starts = None
@@ -122,19 +132,14 @@ def _slice_rows(piece: _PagePiece, r0: int, r1: int) -> Column:
     max_def = leaf.max_definition_level
     d = None if col.def_levels is None else np.asarray(col.def_levels)
     r = None if col.rep_levels is None else np.asarray(col.rep_levels)
-    if r is not None:
-        starts = piece.row_starts
-        s0 = int(starts[r0])
-        s1 = int(starts[r1]) if r1 < len(starts) else len(r)
-    else:
-        s0, s1 = r0, r1
+    s0, s1 = levels_ops.slot_span(r, r0, r1, 0 if r is None else len(r),
+                                  row_starts=piece.row_starts)
     if d is None:
         v0, v1 = s0, s1  # required flat: slots == values
         d_sl = r_sl = None
     else:
-        present = d == max_def
-        v0 = int(np.count_nonzero(present[:s0]))
-        v1 = v0 + int(np.count_nonzero(present[s0:s1]))
+        v0 = levels_ops.present_count(d, 0, s0, max_def)
+        v1 = v0 + levels_ops.present_count(d, s0, s1, max_def)
         d_sl = d[s0:s1]
         r_sl = None if r is None else r[s0:s1]
     asm = levels_ops.assemble(d_sl, r_sl, leaf)

@@ -636,21 +636,14 @@ def _rows_per_page(leaf: Leaf, data: ColumnData, nvalues: int, n_slots: int,
 
 
 def _page_slice(leaf, data, def_levels, rep_levels, row0, nrows, s0, v0):
-    """Map a row range onto slot + value ranges."""
-    if rep_levels is None:
-        s1 = s0 + nrows
-        if def_levels is None:
-            return s0, s1, s0, s1
-        v1 = v0 + int(np.count_nonzero(
-            def_levels[s0:s1] == leaf.max_definition_level))
-        return s0, s1, v0, v1
-    # repeated: rows begin at rep==0; find the slot where row row0+nrows starts
-    zero_slots = np.flatnonzero(rep_levels == 0)
-    end_row = row0 + nrows
-    s1 = zero_slots[end_row] if end_row < len(zero_slots) else len(rep_levels)
-    v1 = v0 + int(np.count_nonzero(
-        def_levels[s0:s1] == leaf.max_definition_level))
-    return s0, int(s1), v0, v1
+    """Map a row range onto slot + value ranges.  The Dremel span arithmetic
+    is shared with the streaming reader (ops/levels: slot_span /
+    present_count); ``s0``/``v0`` are the caller's cursors, which advance in
+    lockstep with the row cursor."""
+    n_slots = len(rep_levels) if rep_levels is not None else 0
+    _, s1 = levels_ops.slot_span(rep_levels, row0, row0 + nrows, n_slots)
+    return s0, s1, v0, v0 + levels_ops.present_count(
+        def_levels, s0, s1, leaf.max_definition_level)
 
 
 def _compute_statistics(leaf, data: ColumnData, n_slots, nvalues):
@@ -938,14 +931,18 @@ def _column_from_arrow(arr, leaf: Leaf, pos: int = 1) -> ColumnData:
         validity = ~np.asarray(arr.is_null())
     if pa.types.is_string(t) or pa.types.is_binary(t) or \
             pa.types.is_large_string(t) or pa.types.is_large_binary(t):
-        # dense present values only
+        # dense present values, read straight from the arrow buffers
+        # (offsets + data) — no python bytes objects on the write hot path
         dense = arr.drop_null()
-        vals = dense.cast(pa.binary()) if not pa.types.is_binary(t) else dense
-        flat = b"".join(vals.to_pylist())
-        lens = np.asarray([len(x) for x in vals.to_pylist()], dtype=np.int64)
-        offs = np.zeros(len(lens) + 1, np.int64)
-        np.cumsum(lens, out=offs[1:])
-        return ColumnData(values=np.frombuffer(flat, np.uint8), offsets=offs,
+        large = pa.types.is_large_string(t) or pa.types.is_large_binary(t)
+        bufs = dense.buffers()
+        odt = np.int64 if large else np.int32
+        o0 = dense.offset
+        offs_raw = np.frombuffer(bufs[1], odt)[o0 : o0 + len(dense) + 1] \
+            .astype(np.int64)
+        data = np.frombuffer(bufs[2], np.uint8)[offs_raw[0] : offs_raw[-1]] \
+            if len(dense) else np.empty(0, np.uint8)
+        return ColumnData(values=data, offsets=offs_raw - offs_raw[0],
                           validity=validity)
     if pa.types.is_boolean(t):
         dense = arr.drop_null()
@@ -956,9 +953,9 @@ def _column_from_arrow(arr, leaf: Leaf, pos: int = 1) -> ColumnData:
     if pa.types.is_fixed_size_binary(t):
         dense = arr.drop_null()
         w = t.byte_width
-        flat = b"".join(dense.to_pylist())
-        return ColumnData(values=np.frombuffer(flat, np.uint8).reshape(-1, w),
-                          validity=validity)
+        flat = np.frombuffer(dense.buffers()[1], np.uint8)[
+            dense.offset * w : (dense.offset + len(dense)) * w]
+        return ColumnData(values=flat.reshape(-1, w), validity=validity)
     if pa.types.is_decimal(t):
         dense = arr.drop_null()
         ints = np.asarray([int(x.as_py().scaleb(t.scale)) for x in dense], dtype=np.int64)

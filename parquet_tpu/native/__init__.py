@@ -88,6 +88,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_dict_build_ba.restype = ctypes.c_int64
         lib.pq_dict_build_ba.argtypes = [
             ctypes.c_void_p, _i64p, ctypes.c_int64, _i64p, ctypes.c_int64]
+        lib.pq_minmax_ba.restype = None
+        lib.pq_minmax_ba.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64,
+                                     ctypes.c_int64, _i64p, _i64p]
         lib.pq_dict_first_occurrence.restype = None
         lib.pq_dict_first_occurrence.argtypes = [_i64p, ctypes.c_int64,
                                                  ctypes.c_int64, _i64p]
@@ -231,3 +234,17 @@ def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int):
     first = np.empty(max(k, 1), dtype=np.int64)
     lib.pq_dict_first_occurrence(indices, n, k, first)
     return indices[:n], first[:k]
+
+def minmax_ba(data: np.ndarray, offsets: np.ndarray, v0: int, v1: int):
+    """(min_idx, max_idx) over byte-string values [v0, v1) in unsigned
+    lexicographic order; None when the shim is unavailable."""
+    lib = get_lib()
+    if lib is None or v1 <= v0:
+        return None
+    data = np.ascontiguousarray(data)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    mi = np.zeros(1, np.int64)
+    ma = np.zeros(1, np.int64)
+    lib.pq_minmax_ba(data.ctypes.data if len(data) else None, offsets,
+                     v0, v1, mi, ma)
+    return int(mi[0]), int(ma[0])

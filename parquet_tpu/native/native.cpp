@@ -288,6 +288,29 @@ int64_t pq_dict_build_ba(const uint8_t* data, const int64_t* offsets,
 }
 
 // second pass: caller uses indices to materialize uniques (first occurrence)
+// min/max over a span of length-prefixed byte strings (unsigned
+// lexicographic — BYTE_ARRAY's order domain).  Writes the min and max VALUE
+// indexes; used by per-page statistics so the hot write path never
+// materializes python bytes objects.
+void pq_minmax_ba(const uint8_t* data, const int64_t* offsets, int64_t v0,
+                  int64_t v1, int64_t* out_min, int64_t* out_max) {
+  int64_t mi = v0, ma = v0;
+  for (int64_t i = v0 + 1; i < v1; i++) {
+    const uint8_t* a = data + offsets[i];
+    int64_t alen = offsets[i + 1] - offsets[i];
+    const uint8_t* m = data + offsets[mi];
+    int64_t mlen = offsets[mi + 1] - offsets[mi];
+    int cmp = memcmp(a, m, alen < mlen ? alen : mlen);
+    if (cmp < 0 || (cmp == 0 && alen < mlen)) mi = i;
+    const uint8_t* x = data + offsets[ma];
+    int64_t xlen = offsets[ma + 1] - offsets[ma];
+    cmp = memcmp(a, x, alen < xlen ? alen : xlen);
+    if (cmp > 0 || (cmp == 0 && alen > xlen)) ma = i;
+  }
+  *out_min = mi;
+  *out_max = ma;
+}
+
 void pq_dict_first_occurrence(const int64_t* indices, int64_t n,
                               int64_t n_unique, int64_t* first_idx) {
   for (int64_t u = 0; u < n_unique; u++) first_idx[u] = -1;

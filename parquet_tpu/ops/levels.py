@@ -136,6 +136,35 @@ def assemble(def_levels: Optional[np.ndarray], rep_levels: Optional[np.ndarray],
     return Assembled(validity=validity, list_offsets=offsets, list_validity=validities)
 
 
+def row_slot_starts(rep_levels: np.ndarray) -> np.ndarray:
+    """Slot index where each row begins (rows start at rep == 0) — the one
+    row→slot mapping shared by the writer's page slicer and the streaming
+    reader's batch slicer."""
+    return np.flatnonzero(np.asarray(rep_levels) == 0)
+
+
+def slot_span(rep_levels: Optional[np.ndarray], row0: int, row1: int,
+              n_slots: int, row_starts: Optional[np.ndarray] = None):
+    """Slot range [s0, s1) covering rows [row0, row1).  Flat columns map
+    1:1; repeated columns map through :func:`row_slot_starts` (pass a
+    precomputed ``row_starts`` to amortize it across calls)."""
+    if rep_levels is None:
+        return row0, row1
+    starts = row_starts if row_starts is not None \
+        else row_slot_starts(rep_levels)
+    s0 = int(starts[row0]) if row0 < len(starts) else n_slots
+    s1 = int(starts[row1]) if row1 < len(starts) else n_slots
+    return s0, s1
+
+
+def present_count(def_levels: Optional[np.ndarray], s0: int, s1: int,
+                  max_def: int) -> int:
+    """Number of present (non-null) leaf values in slot range [s0, s1)."""
+    if def_levels is None:
+        return s1 - s0
+    return int(np.count_nonzero(np.asarray(def_levels)[s0:s1] == max_def))
+
+
 def leaf_slot_count_to_value_count(def_levels: np.ndarray, max_def: int) -> int:
     return int(np.count_nonzero(def_levels == max_def))
 

@@ -344,7 +344,8 @@ def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8) -> bytes
     if n == 0 or bit_width == 0:
         return bytes(out)
     vbytes = (bit_width + 7) // 8
-    # run-length decomposition
+    vmask = (1 << (8 * vbytes)) - 1
+    # run-length decomposition (vectorized)
     change = np.empty(n, dtype=bool)
     change[0] = True
     np.not_equal(values[1:], values[:-1], out=change[1:])
@@ -353,35 +354,36 @@ def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8) -> bytes
 
     def emit_rle(value: int, count: int):
         write_uvarint(out, count << 1)
-        out.extend((value & ((1 << (8 * vbytes)) - 1)).to_bytes(vbytes, "little", signed=False))
+        out.extend((value & vmask).to_bytes(vbytes, "little", signed=False))
 
-    packed: List[int] = []  # pending values for bit-packed groups
-
-    def flush_packed(final: bool = False):
-        if not packed:
+    def emit_packed(span: np.ndarray, final: bool = False):
+        cnt = len(span)
+        if not cnt:
             return
-        cnt = len(packed)
         assert final or cnt % 8 == 0
         ngroups = (cnt + 7) // 8
-        padded = np.zeros(ngroups * 8, dtype=np.int64)
-        padded[:cnt] = packed
+        if cnt % 8:
+            span = np.concatenate([span, np.zeros(ngroups * 8 - cnt, np.int64)])
         write_uvarint(out, (ngroups << 1) | 1)
-        out.extend(pack_bits(padded, bit_width))
-        packed.clear()
+        out.extend(pack_bits(span, bit_width))
 
-    for s, l in zip(run_starts, run_lens):
-        val = int(values[s])
-        rem = int(l)
-        if len(packed) % 8:
-            take = min(8 - len(packed) % 8, rem)
-            packed.extend([val] * take)
-            rem -= take
-        if rem >= min_repeat:
-            flush_packed()
-            emit_rle(val, rem)
-        elif rem:
-            packed.extend([val] * rem)
-    flush_packed(final=True)
+    # The Python loop visits only RLE-eligible runs (>= min_repeat values),
+    # never individual values: everything between eligible runs becomes ONE
+    # bit-packed run.  Alignment: a mid-stream bit-packed run must cover
+    # whole groups of 8, so an eligible run donates its first (gap % -8)
+    # values to the preceding packed span (skipping RLE if that starves it).
+    pos = 0
+    thresh = max(min_repeat, 8)
+    for ri in np.flatnonzero(run_lens >= thresh):
+        s = int(run_starts[ri])
+        length = int(run_lens[ri])
+        pad = -(s - pos) % 8
+        if length - pad < min_repeat:
+            continue  # stays in the packed span
+        emit_packed(values[pos : s + pad])
+        emit_rle(int(values[s]), length - pad)
+        pos = s + length
+    emit_packed(values[pos:n], final=True)
     return bytes(out)
 
 
