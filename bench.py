@@ -367,6 +367,7 @@ def main():
     tpu_ok = probe["ok"]
     import jax
     from parquet_tpu import native as _native
+    from parquet_tpu.parallel.device_reader import _dense_mode
     _native.get_lib()  # pre-build the C++ shim so g++ time stays out of host_s
 
     if not tpu_ok:
@@ -382,11 +383,15 @@ def main():
 
     head = configs["1_int64_plain"]
     print(json.dumps({
-        "detail": "per-config breakdown (BASELINE.md configs 1-5)",
+        "detail": "per-config breakdown (BASELINE.md configs 1-5 + write)",
         "rows": n_rows,
         "backend": str(jax.devices()[0]),
         "tpu_available": tpu_ok,
         "tpu_probe": probe,
+        # PARQUET_TPU_PALLAS=1 routes single-width dense streams through the
+        # Pallas kernels instead of the jnp twins (VERDICT r1 item 3's
+        # pallas-vs-XLA comparison flag); "off" forces the gather path
+        "dense_kernel_mode": _dense_mode(),
         "configs": configs,
     }), file=sys.stderr)
     print(json.dumps({

@@ -163,7 +163,8 @@ class ColumnChunkReader:
                         raise CorruptedError(
                             f"bad page header at {start+pos}: {e}") from e
                     buf = src.pread(start + pos,
-                                    min(len(buf) * 4, size - pos))
+                                    min(max(window, len(buf) * 4),
+                                        size - pos))
             clen = _checked_page_size(header, start + pos)
             if pos + data_pos + clen > size:
                 # a payload running past the chunk would silently read the
@@ -580,6 +581,26 @@ def _bit_width(maxval: int) -> int:
     return int(maxval).bit_length()
 
 
+def verify_page_crc(reader: ColumnChunkReader, page: PageInfo) -> None:
+    """Optional page CRC32 check (reference: page read path, `verify_crc`)."""
+    h = page.header
+    if reader.file.options.verify_crc and h.crc is not None:
+        crc = zlib.crc32(page.payload) & 0xFFFFFFFF
+        if crc != (h.crc & 0xFFFFFFFF):
+            raise CorruptedError(f"page CRC mismatch at offset {page.offset}")
+
+
+def decode_dictionary_page(reader: ColumnChunkReader, page: PageInfo):
+    """Decompress + decode one dictionary page (shared by the chunk decoder
+    and the streaming cursor so CRC/decode semantics stay in one place)."""
+    h = page.header
+    raw = reader.codec.decode(page.payload, h.uncompressed_page_size)
+    dictionary = _decode_dictionary(raw, h.dictionary_page_header, reader.leaf,
+                                    Type(reader.meta.type))
+    counters.inc("dict_pages_decoded")
+    return dictionary
+
+
 def decode_chunk_host(reader: ColumnChunkReader, pages=None,
                       dictionary=None) -> Column:
     """Decode a chunk (or, with ``pages``, a selected page subset — the
@@ -601,14 +622,9 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
     for page in (pages if pages is not None else reader.pages()):
         h = page.header
         pt = page.page_type
-        if reader.file.options.verify_crc and h.crc is not None:
-            crc = zlib.crc32(page.payload) & 0xFFFFFFFF
-            if crc != (h.crc & 0xFFFFFFFF):
-                raise CorruptedError(f"page CRC mismatch at offset {page.offset}")
+        verify_page_crc(reader, page)
         if pt == PageType.DICTIONARY_PAGE:
-            raw = codec.decode(page.payload, h.uncompressed_page_size)
-            dictionary = _decode_dictionary(raw, h.dictionary_page_header, leaf, physical)
-            counters.inc("dict_pages_decoded")
+            dictionary = decode_dictionary_page(reader, page)
             continue
         if pt == PageType.DATA_PAGE:
             dph = h.data_page_header

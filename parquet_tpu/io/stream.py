@@ -30,7 +30,8 @@ import numpy as np
 from ..format.enums import PageType
 from ..ops import levels as levels_ops
 from .column import Column, concat_columns
-from .reader import ParquetFile, Table, decode_chunk_host, _decode_dictionary
+from .reader import (ParquetFile, Table, decode_chunk_host,
+                     decode_dictionary_page, verify_page_crc)
 
 __all__ = ["iter_batches"]
 
@@ -61,24 +62,8 @@ class _ChunkCursor:
     def _pull_page(self) -> bool:
         for page in self.pages:
             if page.page_type == PageType.DICTIONARY_PAGE:
-                import zlib
-
-                h = page.header
-                from ..errors import CorruptedError
-                from ..format.enums import Type
-                from ..utils.debug import counters
-
-                if self.chunk.file.options.verify_crc and h.crc is not None:
-                    crc = zlib.crc32(page.payload) & 0xFFFFFFFF
-                    if crc != (h.crc & 0xFFFFFFFF):
-                        raise CorruptedError(
-                            f"page CRC mismatch at offset {page.offset}")
-                raw = self.chunk.codec.decode(page.payload,
-                                              h.uncompressed_page_size)
-                self.dictionary = _decode_dictionary(
-                    raw, h.dictionary_page_header, self.chunk.leaf,
-                    Type(self.chunk.meta.type))
-                counters.inc("dict_pages_decoded")
+                verify_page_crc(self.chunk, page)
+                self.dictionary = decode_dictionary_page(self.chunk, page)
                 continue
             col = decode_chunk_host(self.chunk, pages=iter([page]),
                                     dictionary=self.dictionary)

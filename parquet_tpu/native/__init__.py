@@ -139,6 +139,8 @@ def assemble_levels(defs: np.ndarray, reps: np.ndarray, ks, dks, max_def: int):
     offsets, validity = [], []
     for i in range(nlev):
         c = int(inst_counts[i])
+        # copies, not views: a view would pin the whole nlev*n scratch buffer
+        # for the lifetime of the decoded Column
         offsets.append(offsets_flat[i * (n + 1) : i * (n + 1) + c + 1].copy())
         validity.append(valid_flat[i * n : i * n + c].astype(bool))
     return offsets, validity, leaf_valid[:leaf_count].astype(bool)

@@ -740,12 +740,15 @@ def prepare_chunk(reader: ColumnChunkReader, device=None):
     the put at a specific mesh device."""
     import contextlib
 
-    plan = build_plan(reader)
-    ctx = (jax.default_device(device) if device is not None
-           else contextlib.nullcontext())
-    with ctx:
-        staged = stage_plan(plan,
-                            stage_levels=stage_levels_on_device(reader.leaf, plan))
+    from ..utils.debug import annotate
+
+    with annotate("pq.prepare_chunk"):
+        plan = build_plan(reader)
+        ctx = (jax.default_device(device) if device is not None
+               else contextlib.nullcontext())
+        with ctx:
+            staged = stage_plan(
+                plan, stage_levels=stage_levels_on_device(reader.leaf, plan))
     return plan, staged
 
 
@@ -829,6 +832,14 @@ def decode_chunk_device(reader: ColumnChunkReader, keep_dictionary: bool = True,
 def decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
                   keep_dictionary: bool = True) -> Column:
     """Device decode phase: staged HBM buffers → decoded :class:`Column`."""
+    from ..utils.debug import annotate
+
+    with annotate(f"pq.decode_staged:{plan.value_kind}"):
+        return _decode_staged(leaf, physical, plan, staged, keep_dictionary)
+
+
+def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
+                   keep_dictionary: bool = True) -> Column:
     max_def = leaf.max_definition_level
     max_rep = leaf.max_repetition_level
     lev_dbuf, val_dbuf, staged_meta = (staged if len(staged) == 3

@@ -14,6 +14,7 @@ import sys
 import threading
 import time
 from collections import defaultdict
+from typing import Optional
 
 DEBUG = os.environ.get("PARQUET_TPU_DEBUG", "") not in ("", "0", "false")
 
@@ -65,3 +66,39 @@ def trace(fn):
             print(f"[parquet-tpu] {fn.__qualname__} {dt:.3f}ms", file=sys.stderr)
 
     return wrapper
+
+
+def profiler_trace(out_dir: Optional[str] = None):
+    """Context manager: capture a ``jax.profiler`` trace (Perfetto/XPlane)
+    around a decode/scan region — SURVEY.md §5's jax.profiler + Perfetto
+    integration.  ``out_dir`` defaults to $PARQUET_TPU_TRACE_DIR; when
+    neither is set the context is a no-op, so call sites can wrap hot
+    regions unconditionally.
+
+    Usage::
+
+        with profiler_trace("/tmp/pq_trace"):
+            table = pf.read(device=True)
+        # then: load the xplane/trace.json.gz in Perfetto or TensorBoard
+    """
+    import contextlib
+
+    out_dir = out_dir or os.environ.get("PARQUET_TPU_TRACE_DIR")
+    if not out_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(out_dir)
+
+
+def annotate(name: str):
+    """Named profiler region (jax.profiler.TraceAnnotation when available;
+    no-op otherwise) for attributing device work inside a profiler_trace."""
+    import contextlib
+
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
