@@ -94,6 +94,19 @@ class ColumnData:
     rep_levels: Optional[np.ndarray] = None
 
 
+@dataclass
+class _EncodedChunk:
+    """Offset-free result of the pure encode phase of one column chunk."""
+
+    leaf: Leaf
+    dict_page: Optional[tuple]  # (PageHeader, compressed bytes)
+    pages: List[tuple]  # (PageHeader, compressed body, rows, stats, n_vals)
+    stats: Optional[md.Statistics]
+    bloom_blob: Optional[bytes]
+    encodings_used: set
+    n_slots: int
+
+
 class ParquetWriter:
     """Streaming writer: accumulate columns, flush row groups, footer on close."""
 
@@ -148,11 +161,22 @@ class ParquetWriter:
         rg_start = self._pos
         total_bytes = 0
         total_comp = 0
-        for leaf in self.schema.leaves:
+        leaves = self.schema.leaves
+        datas = []
+        for leaf in leaves:
             data = columns.get(leaf.dotted_path) or columns.get(leaf.path[0])
             if data is None:
                 raise KeyError(f"missing column {leaf.dotted_path!r}")
-            chunk, ci, oi, bloom, ubytes, cbytes = self._write_chunk(leaf, data, num_rows)
+            datas.append(data)
+        # encode is pure per column and offset-free; emit is serial since
+        # page offsets depend on file position.  Encode also runs serially —
+        # the phase is many small numpy calls whose GIL'd dispatch dominates,
+        # so a thread pool measured ~15% SLOWER (2M-row mixed table) — and
+        # interleaves with emit so only ONE chunk's compressed pages are ever
+        # buffered.  The split keeps the door open for a native encoder.
+        for leaf, data in zip(leaves, datas):
+            enc = self._encode_chunk(leaf, data, num_rows)
+            chunk, ci, oi, bloom, ubytes, cbytes = self._emit_chunk(enc)
             chunks.append(chunk)
             cis.append(ci)
             ois.append(oi)
@@ -175,11 +199,13 @@ class ParquetWriter:
         self._num_rows += num_rows
 
     # ------------------------------------------------------------------
-    def _write_chunk(self, leaf: Leaf, data: ColumnData, num_rows: int):
+    def _encode_chunk(self, leaf: Leaf, data: ColumnData, num_rows: int):
+        """Pure encode phase of one chunk: levels, dictionary, page bodies,
+        statistics, bloom — no file offsets, so row-group columns encode
+        concurrently.  Returns an :class:`_EncodedChunk` for _emit_chunk."""
         opts = self.options
         physical = leaf.physical_type
         path = leaf.dotted_path
-        self._uncomp_acc = 0  # per-chunk uncompressed-bytes accumulator
 
         # ---- levels -------------------------------------------------------
         def_levels, rep_levels = _build_levels(leaf, data, num_rows)
@@ -209,18 +235,11 @@ class ParquetWriter:
             bloom_blob = build_split_block_filter(
                 leaf, data, dict_values, dict_offsets, opts.bloom_filters[path])
 
-        # ---- paginate -----------------------------------------------------
-        pages: List[bytes] = []
-        page_headers: List[md.PageHeader] = []
-        page_rows: List[int] = []
-        page_stats: List[Optional[md.Statistics]] = []
-        chunk_start = self._pos
-        dict_page_offset = None
         encodings_used = {Encoding.RLE}
-
+        dict_page = None
         if indices is not None:
-            self._dict_n = (len(dict_offsets) - 1 if dict_offsets is not None
-                            else len(dict_values))
+            dict_n = (len(dict_offsets) - 1 if dict_offsets is not None
+                      else len(dict_values))
             raw_dict = ref.encode_plain(
                 dict_values, physical,
                 offsets=dict_offsets) if physical == Type.BYTE_ARRAY else ref.encode_plain(
@@ -232,72 +251,87 @@ class ParquetWriter:
                 compressed_page_size=len(comp),
                 crc=(zlib.crc32(comp) & 0xFFFFFFFF) if opts.write_crc else None,
                 dictionary_page_header=md.DictionaryPageHeader(
-                    num_values=len(dict_offsets) - 1 if dict_offsets is not None
-                    else len(dict_values),
+                    num_values=dict_n,
                     encoding=int(Encoding.PLAIN), is_sorted=False))
-            dict_page_offset = self._pos
-            self._emit_page(hdr, comp)
+            dict_page = (hdr, comp)
             encodings_used.add(Encoding.PLAIN)
             encodings_used.add(Encoding.RLE_DICTIONARY)
         else:
+            dict_n = 0
             encodings_used.add(value_encoding)
 
-        data_page_offset = self._pos
+        # ---- paginate -----------------------------------------------------
         rows_per_page = _rows_per_page(leaf, data, nvalues, n_slots, opts.data_page_size)
-        first_row = 0
-        page_locs: List[md.PageLocation] = []
-        ci_nulls: List[bool] = []
-        ci_mins: List[bytes] = []
-        ci_maxs: List[bytes] = []
-        ci_null_counts: List[int] = []
-
+        pages: List[tuple] = []  # (hdr, comp_body, take_rows, pstat, n_vals)
         slot_cursor = 0
         value_cursor = 0
         row_cursor = 0
-        while row_cursor < num_rows or (num_rows == 0 and not page_locs):
+        while row_cursor < num_rows or (num_rows == 0 and not pages):
             take_rows = min(rows_per_page, num_rows - row_cursor) if num_rows else 0
             s0, s1, v0, v1 = _page_slice(leaf, data, def_levels, rep_levels,
                                          row_cursor, take_rows, slot_cursor,
                                          value_cursor)
             body, n_slot_page, n_val_page, pstat = self._encode_page(
                 leaf, data, def_levels, rep_levels, s0, s1, v0, v1,
-                value_encoding, indices, dict_values)
-            page_off = self._pos
+                value_encoding, indices, dict_values, dict_n)
             comp_body, hdr = self._page_header(leaf, body, n_slot_page,
                                                n_val_page, value_encoding,
                                                def_levels, rep_levels, s0, s1,
                                                pstat)
+            pages.append((hdr, comp_body, take_rows, pstat, n_val_page))
+            row_cursor += take_rows
+            slot_cursor = s1
+            value_cursor = v1
+            if num_rows == 0:
+                break
+        return _EncodedChunk(leaf=leaf, dict_page=dict_page, pages=pages,
+                             stats=stats, bloom_blob=bloom_blob,
+                             encodings_used=encodings_used, n_slots=n_slots)
+
+    def _emit_chunk(self, enc: "_EncodedChunk"):
+        """Serial emit phase: assign file offsets, write pages, build the
+        chunk metadata + page index."""
+        opts = self.options
+        leaf = enc.leaf
+        chunk_start = self._pos
+        self._uncomp_acc = 0
+        dict_page_offset = None
+        if enc.dict_page is not None:
+            dict_page_offset = self._pos
+            self._emit_page(*enc.dict_page)
+        data_page_offset = self._pos
+        first_row = 0
+        page_locs: List[md.PageLocation] = []
+        ci_nulls: List[bool] = []
+        ci_mins: List[bytes] = []
+        ci_maxs: List[bytes] = []
+        ci_null_counts: List[int] = []
+        for hdr, comp_body, take_rows, pstat, n_val_page in enc.pages:
+            page_off = self._pos
             self._emit_page(hdr, comp_body)
             page_locs.append(md.PageLocation(
                 offset=page_off,
                 compressed_page_size=self._pos - page_off,
                 first_row_index=first_row))
             if pstat is not None:
-                all_null = n_val_page == 0
-                ci_nulls.append(all_null)
+                ci_nulls.append(n_val_page == 0)
                 ci_mins.append(pstat.min_value or b"")
                 ci_maxs.append(pstat.max_value or b"")
                 ci_null_counts.append(pstat.null_count or 0)
             first_row += take_rows
-            row_cursor += take_rows
-            slot_cursor = s1
-            value_cursor = v1
-            if num_rows == 0:
-                break
 
-        # ---- chunk metadata ----------------------------------------------
         total_comp_size = self._pos - chunk_start
         meta = md.ColumnMetaData(
-            type=int(physical),
-            encodings=sorted({int(e) for e in encodings_used}),
+            type=int(leaf.physical_type),
+            encodings=sorted({int(e) for e in enc.encodings_used}),
             path_in_schema=list(leaf.path),
             codec=int(opts.codec_id()),
-            num_values=n_slots,
+            num_values=enc.n_slots,
             total_uncompressed_size=self._uncomp_acc,
             total_compressed_size=total_comp_size,
             data_page_offset=data_page_offset,
             dictionary_page_offset=dict_page_offset,
-            statistics=stats,
+            statistics=enc.stats,
         )
         chunk = md.ColumnChunk(file_offset=chunk_start, meta_data=meta)
         ci = oi = None
@@ -309,7 +343,7 @@ class ParquetWriter:
             oi = md.OffsetIndex(page_locations=page_locs)
         elif opts.write_page_index:
             oi = md.OffsetIndex(page_locations=page_locs)
-        return chunk, ci, oi, bloom_blob, self._uncomp_acc, total_comp_size
+        return chunk, ci, oi, enc.bloom_blob, self._uncomp_acc, total_comp_size
 
     # ------------------------------------------------------------------
     def _emit_page(self, header: md.PageHeader, comp_body: bytes) -> None:
@@ -364,7 +398,7 @@ class ParquetWriter:
         return int(np.count_nonzero(rep_levels[s0:s1] == 0))
 
     def _encode_page(self, leaf, data, def_levels, rep_levels, s0, s1, v0, v1,
-                     value_encoding, indices, dict_values):
+                     value_encoding, indices, dict_values, dict_n=0):
         """Encode one page → body (+counts, stats).  v1: bytes; v2: 3-tuple."""
         opts = self.options
         physical = leaf.physical_type
@@ -385,7 +419,7 @@ class ParquetWriter:
         if indices is not None:
             idx = indices[v0:v1]
             # bit width ≥ 1: several readers reject zero-width index streams
-            width = max(_bw(max(self._dict_n - 1, 0)), 1)
+            width = max(_bw(max(dict_n - 1, 0)), 1)
             values = ref.encode_rle_dict_indices(idx, width)
         else:
             values = _encode_values(leaf, data, v0, v1, value_encoding)

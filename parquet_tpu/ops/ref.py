@@ -96,26 +96,20 @@ def unpack_bits(data, n: int, bit_width: int, offset_bits: int = 0) -> np.ndarra
 
 
 def pack_bits(values: np.ndarray, bit_width: int) -> bytes:
-    """Pack integers LSB-first at ``bit_width`` bits each."""
+    """Pack integers LSB-first at ``bit_width`` bits each (fully vectorized:
+    per-value bit matrix → np.packbits little-endian; no scatter/ufunc.at)."""
     n = len(values)
     if bit_width == 0 or n == 0:
         return b""
     mask = np.uint64(0xFFFFFFFFFFFFFFFF) if bit_width >= 64 else np.uint64((1 << bit_width) - 1)
     v = values.astype(np.uint64) & mask
-    total_bits = n * bit_width
-    nbytes = (total_bits + 7) // 8
-    # scatter each value's bits into a byte accumulator via per-byte OR
-    out = np.zeros(nbytes + 8, dtype=np.uint8)
-    starts = np.arange(n, dtype=np.int64) * bit_width
-    byte0 = starts >> 3
-    shift = (starts & 7).astype(np.uint64)
-    shifted = v << shift  # may need up to bit_width+7 bits ≤ 71 — handle 9th byte
-    for k in range(8):
-        np.bitwise_or.at(out, byte0 + k, ((shifted >> np.uint64(8 * k)) & np.uint64(0xFF)).astype(np.uint8))
-    if bit_width + 7 > 64:
-        hi = np.where(shift > 0, v >> (np.uint64(64) - shift), np.uint64(0))
-        np.bitwise_or.at(out, byte0 + 8, (hi & np.uint64(0xFF)).astype(np.uint8))
-    return out[:nbytes].tobytes()
+    bits = ((v[:, None] >> np.arange(bit_width, dtype=np.uint64)) & 1) \
+        .astype(np.uint8)
+    flat = bits.reshape(-1)
+    pad = -len(flat) % 8
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.uint8)])
+    return np.packbits(flat.reshape(-1, 8), axis=1, bitorder="little").tobytes()
 
 
 # ---------------------------------------------------------------------------

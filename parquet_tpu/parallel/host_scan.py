@@ -13,7 +13,6 @@ predicate is applied to the decoded keys.
 
 from __future__ import annotations
 
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
@@ -24,19 +23,7 @@ from ..io.search import plan_scan, read_row_range
 
 __all__ = ["scan_filtered", "scan_filtered_device"]
 
-_POOL: Optional[ThreadPoolExecutor] = None
-_POOL_LOCK = threading.Lock()
-
-
-def _pool() -> ThreadPoolExecutor:
-    """Shared scan executor: pool construction costs ~1ms, which would
-    dominate small pushdown scans if paid per call."""
-    global _POOL
-    with _POOL_LOCK:
-        if _POOL is None:
-            _POOL = ThreadPoolExecutor(max_workers=16,
-                                       thread_name_prefix="pq-scan")
-        return _POOL
+from ..utils.pool import shared_pool as _pool
 
 
 def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,

@@ -1,0 +1,24 @@
+"""Process-wide worker pool for CPU-bound columnar work (encode, scan).
+
+One shared executor: pool construction costs ~1ms, which would dominate
+small operations if paid per call, and the numpy/C++/codec work it runs
+releases the GIL.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+_POOL: Optional[ThreadPoolExecutor] = None
+_LOCK = threading.Lock()
+
+
+def shared_pool() -> ThreadPoolExecutor:
+    global _POOL
+    with _LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(max_workers=16,
+                                       thread_name_prefix="pq-work")
+        return _POOL
