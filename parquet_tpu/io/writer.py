@@ -59,6 +59,14 @@ class WriterOptions:
     # (path, descending, nulls_first) — recorded in row-group metadata
     column_encoding: Dict[str, Encoding] = dc_field(default_factory=dict)
 
+    def __post_init__(self):
+        if self.row_group_size < 1:
+            raise ValueError("row_group_size must be >= 1")
+        if self.data_page_size < 1:
+            raise ValueError("data_page_size must be >= 1")
+        if self.data_page_version not in (1, 2):
+            raise ValueError("data_page_version must be 1 or 2")
+
     def codec_id(self) -> CompressionCodec:
         if isinstance(self.compression, str):
             return {
@@ -130,7 +138,9 @@ class ParquetWriter:
 
     # ------------------------------------------------------------------
     def write(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
-        """Buffer columnar data; flush when row_group_size is reached."""
+        """Buffer columnar data; full row groups are written as they fill
+        (MaxRowsPerRowGroup), the sub-group tail stays buffered so streaming
+        writes never fragment the file into tiny groups."""
         if self._buffer is None:
             self._buffer = {k: _copy_cd(v) for k, v in columns.items()}
         else:
@@ -138,14 +148,43 @@ class ParquetWriter:
                 _extend_cd(self._buffer[k], v)
         self._buffered_rows += num_rows
         if self._buffered_rows >= self.options.row_group_size:
-            self.flush()
+            self._drain(final=False)
 
     def flush(self) -> None:
+        """Write everything buffered, including the sub-group tail."""
+        self._drain(final=True)
+
+    def _drain(self, final: bool) -> None:
         if self._buffer is None or self._buffered_rows == 0:
             return
-        self.write_row_group(self._buffer, self._buffered_rows)
-        self._buffer = None
-        self._buffered_rows = 0
+        total = self._buffered_rows
+        rgs = self.options.row_group_size
+        emit = total if final else (total // rgs) * rgs
+        if emit == 0:
+            return
+        if emit == total and total <= rgs:
+            self.write_row_group(self._buffer, total)
+            self._buffer = None
+            self._buffered_rows = 0
+            return
+        key_leaf = {k: next((l for l in self.schema.leaves
+                             if l.dotted_path == k or l.path[0] == k), None)
+                    for k in self._buffer}
+        ctxs = {k: {} for k in self._buffer}  # per-column slice-table cache
+        for start in range(0, emit, rgs):
+            end = min(start + rgs, emit)
+            part = {k: _slice_cd(key_leaf[k], cd, start, end, ctxs[k])
+                    if key_leaf[k] is not None else cd
+                    for k, cd in self._buffer.items()}
+            self.write_row_group(part, end - start)
+        if emit == total:
+            self._buffer = None
+            self._buffered_rows = 0
+        else:  # retain the tail
+            self._buffer = {k: _slice_cd(key_leaf[k], cd, emit, total, ctxs[k])
+                            if key_leaf[k] is not None else cd
+                            for k, cd in self._buffer.items()}
+            self._buffered_rows = total - emit
 
     # ------------------------------------------------------------------
     def write_row_group(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
@@ -514,6 +553,73 @@ def _dict_size(dict_values) -> int:
     if isinstance(dict_values, tuple):
         return len(dict_values[1]) - 1
     return len(dict_values)
+
+
+def _slice_cd(leaf: Leaf, cd: ColumnData, r0: int, r1: int,
+              ctx: Optional[dict] = None) -> ColumnData:
+    """Rows [r0, r1) of buffered ColumnData (row-group splitting).  Uses the
+    shared Dremel span arithmetic (ops/levels); ``ctx`` (a mutable per-column
+    dict) caches the row-start and cumulative-present tables so splitting a
+    buffer into P parts is O(N), not O(N·P)."""
+    max_def = leaf.max_definition_level
+    ctx = ctx if ctx is not None else {}
+
+    def cum_present(mask_src) -> np.ndarray:
+        if "cum" not in ctx:
+            cum = np.zeros(len(mask_src) + 1, np.int64)
+            np.cumsum(mask_src, out=cum[1:])
+            ctx["cum"] = cum
+        return ctx["cum"]
+
+    def vals_span(v0, v1):
+        if cd.offsets is not None:
+            offs = np.asarray(cd.offsets)
+            base = int(offs[v0])
+            return (np.asarray(cd.values)[base : int(offs[v1])],
+                    offs[v0 : v1 + 1] - base)
+        return np.asarray(cd.values)[v0:v1], None
+
+    if cd.def_levels is not None or cd.rep_levels is not None:
+        d, r = cd.def_levels, cd.rep_levels
+        n_slots = len(d) if d is not None else len(r)
+        if r is not None and "starts" not in ctx:
+            ctx["starts"] = levels_ops.row_slot_starts(r)
+        s0, s1 = levels_ops.slot_span(r, r0, r1, n_slots,
+                                      row_starts=ctx.get("starts"))
+        if d is None:
+            v0, v1 = s0, s1
+        else:
+            cum = cum_present(np.asarray(d) == max_def)
+            v0, v1 = int(cum[s0]), int(cum[s1])
+        vals, offs = vals_span(v0, v1)
+        return ColumnData(values=vals, offsets=offs,
+                          def_levels=None if d is None else d[s0:s1],
+                          rep_levels=None if r is None else r[s0:s1])
+    if cd.list_offsets is not None:
+        lo = np.asarray(cd.list_offsets)
+        e0, e1 = int(lo[r0]), int(lo[r1])
+        validity = cd.validity
+        if validity is None:
+            v0, v1 = e0, e1
+        else:
+            validity = np.asarray(validity)
+            cum = cum_present(validity)
+            v0, v1 = int(cum[e0]), int(cum[e1])
+        vals, offs = vals_span(v0, v1)
+        return ColumnData(
+            values=vals, offsets=offs,
+            validity=None if cd.validity is None else validity[e0:e1],
+            list_offsets=lo[r0 : r1 + 1] - e0,
+            list_validity=None if cd.list_validity is None
+            else np.asarray(cd.list_validity)[r0:r1])
+    if cd.validity is None:
+        vals, offs = vals_span(r0, r1)
+        return ColumnData(values=vals, offsets=offs)
+    validity = np.asarray(cd.validity)
+    cum = cum_present(validity)
+    v0, v1 = int(cum[r0]), int(cum[r1])
+    vals, offs = vals_span(v0, v1)
+    return ColumnData(values=vals, offsets=offs, validity=validity[r0:r1])
 
 
 def _copy_cd(cd: ColumnData) -> ColumnData:

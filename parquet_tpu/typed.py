@@ -237,10 +237,12 @@ class TypedWriter:
             self.flush()
 
     def flush(self) -> None:
+        """Hand pending rows to the writer's buffered path (which writes
+        full row groups and keeps the tail buffered — close() drains it)."""
         if not self._pending:
             return
         cols = _shred(self._pending, self.schema)
-        self.writer.write_row_group(cols, len(self._pending))
+        self.writer.write(cols, len(self._pending))
         self._pending = []
 
     def close(self) -> None:
@@ -255,23 +257,42 @@ class TypedWriter:
 
 
 class TypedReader:
-    """Reference parity: ``GenericReader[T]`` — batched typed reads."""
+    """Reference parity: ``GenericReader[T]`` — batched typed reads.
 
-    def __init__(self, source, cls: PyType):
+    ``read(n)`` streams: it pulls row batches through the bounded-memory
+    iterator (io/stream.py) and assembles objects per batch, so memory stays
+    O(batch), not O(file) — the reference's ``Read([]T)`` + ``PageBufferSize``
+    behavior."""
+
+    def __init__(self, source, cls: PyType, batch_rows: int = 65536):
         self.cls = cls
         self.file = source if isinstance(source, ParquetFile) else ParquetFile(source)
-        self._objs: Optional[list] = None
-        self._pos = 0
+        self._batch_rows = batch_rows
+        self._it = None
+        self._buf: list = []
+        self._bpos = 0
 
     def read_all(self) -> list:
         tab = self.file.read()
         return _assemble(self.cls, self.file.schema, tab)
 
     def read(self, n: int) -> list:
-        if self._objs is None:
-            self._objs = self.read_all()
-        out = self._objs[self._pos : self._pos + n]
-        self._pos += len(out)
+        out: list = []
+        while len(out) < n:
+            avail = len(self._buf) - self._bpos
+            if avail > 0:
+                take = min(avail, n - len(out))
+                out.extend(self._buf[self._bpos : self._bpos + take])
+                self._bpos += take
+                continue
+            if self._it is None:
+                self._it = iter(self.file.iter_batches(
+                    batch_rows=self._batch_rows))
+            batch = next(self._it, None)
+            if batch is None:
+                break
+            self._buf = _assemble(self.cls, self.file.schema, batch)
+            self._bpos = 0
         return out
 
 

@@ -1,4 +1,5 @@
-"""Process-wide worker pool for CPU-bound columnar work (encode, scan).
+"""Process-wide worker pool for CPU-bound columnar work (currently the
+pushdown scan; the writer measured slower under threads and stays serial).
 
 One shared executor: pool construction costs ~1ms, which would dominate
 small operations if paid per call, and the numpy/C++/codec work it runs

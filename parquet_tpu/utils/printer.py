@@ -27,12 +27,25 @@ def print_file(pf, file=None) -> str:
         for i, chunk in enumerate(rg.rg.columns):
             m = chunk.meta_data
             encs = "/".join(Encoding(e).name for e in (m.encodings or []))
+            st = ""
+            if m.statistics is not None:
+                from ..io.statistics import decode_statistics
+
+                try:
+                    ts = decode_statistics(m.statistics, pf.schema.leaves[i])
+                except Exception:
+                    ts = None
+                if ts is not None:
+                    if ts.min_value is not None or ts.max_value is not None:
+                        st = f" min={ts.min_value!r} max={ts.max_value!r}"
+                    if ts.null_count is not None:
+                        st += f" nulls={ts.null_count}"
             lines.append(
                 f"  {'.'.join(m.path_in_schema or [])}: {Type(m.type).name} "
                 f"{CompressionCodec(m.codec).name} [{encs}] "
                 f"values={m.num_values} "
                 f"compressed={m.total_compressed_size} "
-                f"uncompressed={m.total_uncompressed_size}")
+                f"uncompressed={m.total_uncompressed_size}{st}")
     out = "\n".join(lines)
     if file is not None:
         print(out, file=file)

@@ -10,6 +10,7 @@ import numpy as np
 import pyarrow.parquet as pq
 import pytest
 
+from parquet_tpu import ParquetFile, WriterOptions
 from parquet_tpu.format.enums import Type
 from parquet_tpu.typed import (TypedReader, TypedWriter, read_objects,
                                read_pytree, schema_of, write_objects)
@@ -128,3 +129,43 @@ def test_read_pytree():
     if vals.ndim == 2:  # device pair representation
         vals = np.ascontiguousarray(vals).view(np.int64).reshape(-1)
     np.testing.assert_array_equal(vals, np.arange(200))
+
+
+def test_typed_reader_streams_batches():
+    """read(n) must stream through the bounded iterator, not materialize the
+    file: draining in odd-sized chunks equals read_all, and the first read
+    must not have touched the tail row group's pages."""
+    import dataclasses
+    import io as _io
+
+    @dataclasses.dataclass
+    class Rec:
+        a: int
+        b: str
+
+    objs = [Rec(a=i, b=f"s{i % 97}") for i in range(30000)]
+    buf = _io.BytesIO()
+    write_objects(objs, buf, options=WriterOptions(row_group_size=7000,
+                                                   data_page_size=4096))
+    raw = buf.getvalue()
+    assert len(ParquetFile(raw).row_groups) == 5  # write() splits groups
+
+    r = TypedReader(raw, Rec, batch_rows=1000)
+    got = []
+    while True:
+        part = r.read(777)
+        if not part:
+            break
+        got.append(part)
+    flat = [x for p in got for x in p]
+    assert flat == objs
+    assert all(len(p) == 777 for p in got[:-1])
+
+    # boundedness: after reading only 500 rows, later row groups untouched
+    pf = ParquetFile(raw)
+    reads = []
+    orig = pf.source.pread
+    pf.source.pread = lambda off, size: (reads.append(size), orig(off, size))[1]
+    r2 = TypedReader(pf, Rec, batch_rows=500)
+    assert len(r2.read(500)) == 500
+    assert sum(reads) < len(raw) / 4

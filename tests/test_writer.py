@@ -375,3 +375,58 @@ def test_write_table_struct_null_fidelity():
                                   type=pa.struct([("xs", pa.list_(pa.int64()))]))})
     with pytest.raises(NotImplementedError):
         write_table(t2, io.BytesIO(), WriterOptions())
+
+
+def test_buffered_write_splits_row_groups(rng):
+    """One oversized ParquetWriter.write() call still splits at
+    row_group_size (MaxRowsPerRowGroup), incl. nulls and byte arrays."""
+    from parquet_tpu.io.writer import ColumnData, ParquetWriter, WriterOptions
+    from parquet_tpu.io.writer import schema_from_arrow
+
+    n = 25000
+    t = pa.table({
+        "x": pa.array([None if i % 9 == 0 else i for i in range(n)],
+                      type=pa.int64()),
+        "s": pa.array([f"v{i % 13}" for i in range(n)]),
+    })
+    from parquet_tpu.io.writer import columns_from_arrow
+
+    schema = schema_from_arrow(t.schema)
+    buf = io.BytesIO()
+    w = ParquetWriter(buf, schema, WriterOptions(row_group_size=6000,
+                                                 compression="none"))
+    w.write(columns_from_arrow(t, schema), n)
+    w.close()
+    pf = ParquetFile(buf.getvalue())
+    assert [rg.num_rows for rg in pf.row_groups] == [6000, 6000, 6000, 6000, 1000]
+    _pyarrow_equal(buf.getvalue(), t)
+
+
+def test_writer_options_validated():
+    import pytest as _pytest
+
+    from parquet_tpu.io.writer import WriterOptions
+
+    for kw in ({"row_group_size": 0}, {"data_page_size": 0},
+               {"data_page_version": 3}):
+        with _pytest.raises(ValueError):
+            WriterOptions(**kw)
+
+
+def test_streaming_writes_keep_tail_buffered(rng):
+    """write() calls crossing the row-group boundary must not fragment the
+    file: full groups are emitted, the tail stays buffered until close."""
+    from parquet_tpu.io.writer import (ParquetWriter, WriterOptions,
+                                       columns_from_arrow, schema_from_arrow)
+
+    t = pa.table({"x": pa.array(list(range(7000)), type=pa.int64())})
+    schema = schema_from_arrow(t.schema)
+    buf = io.BytesIO()
+    w = ParquetWriter(buf, schema, WriterOptions(row_group_size=6000,
+                                                 compression="none"))
+    for _ in range(2):
+        w.write(columns_from_arrow(t, schema), 7000)
+    w.close()
+    pf = ParquetFile(buf.getvalue())
+    assert [rg.num_rows for rg in pf.row_groups] == [6000, 6000, 2000]
+    assert pf.read()["x"].to_arrow().to_pylist() == list(range(7000)) * 2
