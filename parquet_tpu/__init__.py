@@ -36,6 +36,7 @@ from .io.writer import (ColumnData, ParquetWriter, WriterOptions,
                         schema_from_arrow, write_table)
 from .io.search import find, pages_overlapping, plan_scan, prune_row_group, read_row_range
 from .io.stream import iter_batches
+from .io.source import RetryingSource, Source
 from .parallel.host_scan import scan_filtered
 from .algebra import (SortingColumn, SortingWriter, TableBuffer,
                       convert_table, merge_files, merge_row_groups)

@@ -31,10 +31,18 @@ class FileSource(Source):
         self._size = os.fstat(self._fd).st_size
 
     def pread(self, offset: int, size: int) -> bytes:
-        out = os.pread(self._fd, size, offset)
-        if len(out) != size:
-            raise IOError(f"short read at {offset}: wanted {size}, got {len(out)}")
-        return out
+        # POSIX pread may return fewer bytes than requested without being at
+        # EOF (signals, NFS): accumulate until full or truly short
+        parts = []
+        got = 0
+        while got < size:
+            chunk = os.pread(self._fd, size - got, offset + got)
+            if not chunk:
+                raise IOError(
+                    f"short read at {offset}: wanted {size}, got {got}")
+            parts.append(chunk)
+            got += len(chunk)
+        return parts[0] if len(parts) == 1 else b"".join(parts)
 
     def size(self) -> int:
         return self._size
@@ -78,6 +86,41 @@ class FileLikeSource(Source):
 
     def size(self) -> int:
         return self._size
+
+
+class RetryingSource(Source):
+    """Bounded-retry wrapper over any Source — the retryable-host-IO analog
+    of SURVEY.md §5 (flaky network filesystems / object-store FUSE mounts).
+
+    Retries transient ``OSError``s with exponential backoff; short reads at
+    true EOF are not transient and propagate immediately (``IOError`` raised
+    with "short read" is not retried to keep corruption loud)."""
+
+    def __init__(self, inner: Source, retries: int = 3,
+                 backoff_s: float = 0.05):
+        self.inner = inner
+        self.retries = retries
+        self.backoff_s = backoff_s
+
+    def pread(self, offset: int, size: int) -> bytes:
+        import time
+
+        delay = self.backoff_s
+        for attempt in range(self.retries + 1):
+            try:
+                return self.inner.pread(offset, size)
+            except OSError as e:
+                if attempt >= self.retries or "short read" in str(e):
+                    raise
+                time.sleep(delay)
+                delay *= 2
+        raise AssertionError("unreachable")
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def close(self) -> None:
+        self.inner.close()
 
 
 def as_source(obj) -> Source:

@@ -142,7 +142,11 @@ class ParquetWriter:
         (MaxRowsPerRowGroup), the sub-group tail stays buffered so streaming
         writes never fragment the file into tiny groups."""
         if self._buffer is None:
-            self._buffer = {k: _copy_cd(v) for k, v in columns.items()}
+            # shallow wrap: buffering never mutates array contents (extend
+            # rebinds via np.concatenate, slicing takes views), so sharing
+            # the caller's arrays is safe and avoids doubling peak memory on
+            # one-shot writes
+            self._buffer = {k: _shallow_cd(v) for k, v in columns.items()}
         else:
             for k, v in columns.items():
                 _extend_cd(self._buffer[k], v)
@@ -180,10 +184,11 @@ class ParquetWriter:
         if emit == total:
             self._buffer = None
             self._buffered_rows = 0
-        else:  # retain the tail
-            self._buffer = {k: _slice_cd(key_leaf[k], cd, emit, total, ctxs[k])
-                            if key_leaf[k] is not None else cd
-                            for k, cd in self._buffer.items()}
+        else:  # retain the tail — COPIED so the drained buffer's memory frees
+            self._buffer = {
+                k: _copy_cd(_slice_cd(key_leaf[k], cd, emit, total, ctxs[k]))
+                if key_leaf[k] is not None else cd
+                for k, cd in self._buffer.items()}
             self._buffered_rows = total - emit
 
     # ------------------------------------------------------------------
@@ -620,6 +625,14 @@ def _slice_cd(leaf: Leaf, cd: ColumnData, r0: int, r1: int,
     v0, v1 = int(cum[r0]), int(cum[r1])
     vals, offs = vals_span(v0, v1)
     return ColumnData(values=vals, offsets=offs, validity=validity[r0:r1])
+
+
+def _shallow_cd(cd: ColumnData) -> ColumnData:
+    """New ColumnData object sharing the caller's arrays (field rebinding in
+    the buffer must not reach the caller; array contents are never mutated)."""
+    import dataclasses
+
+    return dataclasses.replace(cd)
 
 
 def _copy_cd(cd: ColumnData) -> ColumnData:

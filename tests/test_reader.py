@@ -398,3 +398,37 @@ def test_field_via_rows_mid_recursion_prefix():
     via_rows = tab._field_via_rows(node_i, ("o", "i"), def_above=1)
     vectorized = tab._build_arrow(node_i, ("o", "i"), 1)
     assert via_rows.to_pylist() == vectorized.to_pylist()
+
+
+def test_retrying_source_recovers_transient_errors(rng):
+    """SURVEY §5 retryable host IO: transient OSErrors retry with backoff,
+    short reads (corruption) stay loud."""
+    from parquet_tpu.io.source import BytesSource, RetryingSource
+
+    t = pa.table({"x": pa.array(np.arange(1000, dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    raw = buf.getvalue()
+
+    class Flaky(BytesSource):
+        def __init__(self, data, fail_times):
+            super().__init__(data)
+            self.fails_left = fail_times
+            self.attempts = 0
+
+        def pread(self, offset, size):
+            self.attempts += 1
+            if self.fails_left > 0:
+                self.fails_left -= 1
+                raise OSError("transient: connection reset")
+            return super().pread(offset, size)
+
+    src = Flaky(raw, fail_times=2)
+    pf = ParquetFile(RetryingSource(src, retries=3, backoff_s=0.001))
+    assert pf.read()["x"].to_arrow().to_pylist() == list(range(1000))
+    assert src.attempts >= 3  # retried through the failures
+
+    import pytest as _pytest
+    exhausted = Flaky(raw, fail_times=100)
+    with _pytest.raises(OSError):
+        ParquetFile(RetryingSource(exhausted, retries=2, backoff_s=0.001))
