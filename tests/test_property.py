@@ -158,3 +158,49 @@ def test_widening_roundtrip_property(vals, dst_kind):
     w.close()
     got = _pq.read_table(io.BytesIO(out.getvalue())).column("x").to_pylist()
     assert got == [float(v) if dst_kind == "f64" else v for v in vals]
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(tables(), st.integers(1, 97), st.sampled_from([512, 4096]))
+def test_stream_batches_equal_full_read_property(t, batch_rows, page_size):
+    """iter_batches at any batch size over any page layout == full read."""
+    from parquet_tpu import iter_batches
+
+    buf = io.BytesIO()
+    pq.write_table(t, buf, data_page_size=page_size,
+                   row_group_size=max(len(t) // 3, 1))
+    pf = ParquetFile(buf.getvalue())
+    got = [b.to_arrow() for b in iter_batches(pf, batch_rows=batch_rows)]
+    want = pq.read_table(io.BytesIO(buf.getvalue()))
+    if not got:
+        assert t.num_rows == 0
+        return
+    merged = pa.concat_tables(got)
+    assert merged.num_rows == want.num_rows
+    for name in want.column_names:
+        assert merged.column(name).combine_chunks().equals(
+            want.column(name).combine_chunks()), name
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(st.integers(-(2**63), 2**63 - 1), min_size=1, max_size=3000),
+       st.sampled_from([pa.int64(), pa.int32()]),
+       st.sampled_from([1024, 65536]))
+def test_delta_dense_device_decode_property(vals, typ, page_size):
+    """DELTA_BINARY_PACKED device decode (dense kernel: per-width groups,
+    permutation, w=0, tail miniblocks, delta wraparound at the type
+    boundaries) equals pyarrow for the full value domain."""
+    import jax
+
+    if typ == pa.int32():
+        vals = [v % (2**32) - 2**31 for v in vals]
+    t = pa.table({"x": pa.array(vals, type=typ)})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False, compression="none",
+                   column_encoding={"x": "DELTA_BINARY_PACKED"},
+                   data_page_size=page_size)
+    tab = ParquetFile(buf.getvalue()).read(device=True)
+    got = tab["x"].to_arrow().cast(typ)
+    assert got.to_pylist() == vals
