@@ -204,3 +204,43 @@ def test_delta_dense_device_decode_property(vals, typ, page_size):
     tab = ParquetFile(buf.getvalue()).read(device=True)
     got = tab["x"].to_arrow().cast(typ)
     assert got.to_pylist() == vals
+
+
+_WIDENING_PAIRS = [
+    (pa.int32(), pa.int64(), st.integers(-(2**31), 2**31 - 1)),
+    (pa.float32(), pa.float64(),
+     st.floats(allow_nan=False, width=32)),
+    (pa.int32(), pa.float64(), st.integers(-(2**31), 2**31 - 1)),
+    (pa.int64(), pa.float64(), st.integers(-(2**52), 2**52)),
+    (pa.timestamp("ms"), pa.timestamp("us"),
+     st.integers(-(2**52), 2**52)),
+    (pa.time32("ms"), pa.time64("us"), st.integers(0, 86_399_999)),
+]
+
+
+@settings(max_examples=30, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.sampled_from(_WIDENING_PAIRS), st.data())
+def test_convert_widening_round_trip_property(pair, data):
+    """Every supported widening pair round-trips exactly: write src →
+    convert → write dst → pyarrow reads identical logical values
+    (VERDICT r1 item 8 / reference convert.go — Convert)."""
+    from parquet_tpu.algebra.convert import convert_table
+    from parquet_tpu.io.writer import (ParquetWriter, schema_from_arrow,
+                                       write_table)
+
+    src_t, dst_t, vals_st = pair
+    vals = data.draw(st.lists(vals_st, min_size=1, max_size=300))
+    src = pa.table({"x": pa.array(vals, type=src_t)})
+    buf = io.BytesIO()
+    write_table(src, buf, WriterOptions(dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    target = schema_from_arrow(pa.schema([("x", dst_t)]))
+    (cols, n), = convert_table(pf, target)
+    out = io.BytesIO()
+    w = ParquetWriter(out, target, WriterOptions(dictionary=False))
+    w.write_row_group(cols, n)
+    w.close()
+    got = pq.read_table(io.BytesIO(out.getvalue())).column("x")
+    want = src.column("x").cast(dst_t)
+    assert got.combine_chunks().equals(want.combine_chunks())
