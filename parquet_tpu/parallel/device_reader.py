@@ -672,7 +672,10 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
     meta = {}
     delta_dense = plan.value_kind == "delta" and _stage_delta_dense(plan, meta)
     val_dbuf = None
-    if len(plan.values) and not dense_route and not delta_dense:
+    if not dense_route and not delta_dense and plan.value_kind not in (
+            None, "host_ba"):
+        # staged even when empty (all-null chunks have no value bytes): the
+        # kernels need a real buffer operand to slice [:0] from
         val_dbuf = jax.device_put(dev.pad_to_bucket(
             np.frombuffer(bytes(plan.values), np.uint8)))
         counters.inc("bytes_h2d", len(plan.values))

@@ -259,3 +259,28 @@ def test_device_struct_no_nulls_vectorized_arrow():
     raw = _write(t, use_dictionary=False, compression="none")
     got = ParquetFile(raw).read(device=True).to_arrow()
     assert got.column("st").combine_chunks().equals(t.column("st").combine_chunks())
+
+
+@pytest.mark.parametrize("typ_kw", [
+    ("bool", {}), ("str", {}), ("i64", {}), ("f32", {}),
+    ("delta", {"use_dictionary": False,
+               "column_encoding": {"x": "DELTA_BINARY_PACKED"}}),
+    ("bss", {"use_dictionary": False,
+             "column_encoding": {"x": "BYTE_STREAM_SPLIT"}}),
+], ids=lambda p: p[0])
+def test_device_all_null_chunks(typ_kw):
+    """All-null chunks stage no value bytes; every device kind must decode
+    them (found by fuzzing: rle_expand crashed on the missing buffer)."""
+    from parquet_tpu.parallel import device_reader as dr
+    from parquet_tpu.format.enums import Type as _T
+
+    kind, kw = typ_kw
+    typ = {"bool": pa.bool_(), "str": pa.string(), "i64": pa.int64(),
+           "f32": pa.float32(), "delta": pa.int64(), "bss": pa.float64()}[kind]
+    t = pa.table({"x": pa.array([None] * 1500, type=typ)})
+    raw = _write(t, compression="none", **kw)
+    # pin the device path: no silent host fallback may hide a regression
+    chunk = ParquetFile(raw).row_group(0).column(0)
+    col = dr.decode_chunk_device(chunk, fallback=False)
+    arr = col.to_arrow()
+    assert len(arr) == 1500 and arr.null_count == 1500
