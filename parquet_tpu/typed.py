@@ -70,6 +70,26 @@ def schema_of(cls: PyType) -> Schema:
     return sch.message(cls.__name__, children)
 
 
+def _scalar_leaf(name: str, hint, rep: Rep) -> sch.Node:
+    phys, kind, params = _SCALAR_MAP[hint]
+    return sch.leaf(name, phys, rep, kind, **params)
+
+
+def _repeated_group(name: str, cls, rep: Rep = Rep.REQUIRED) -> sch.Node:
+    """Element/value group under a repeated wrapper: scalar fields only (one
+    repetition level — deeper nesting goes through the row-model API)."""
+    hints = typing.get_type_hints(cls)
+    kids = []
+    for f in dataclasses.fields(cls):
+        h, opt = _unwrap_optional(hints[f.name])
+        if h not in _SCALAR_MAP:
+            raise TypeError(
+                f"field {f.name!r} of repeated group {cls.__name__}: only "
+                "scalar fields are supported inside lists/maps of dataclasses")
+        kids.append(_scalar_leaf(f.name, h, Rep.OPTIONAL if opt else Rep.REQUIRED))
+    return sch.group(name, kids, rep)
+
+
 def _field_node(name: str, hint) -> sch.Node:
     hint, is_opt = _unwrap_optional(hint)
     rep = Rep.OPTIONAL if is_opt else Rep.REQUIRED
@@ -78,18 +98,39 @@ def _field_node(name: str, hint) -> sch.Node:
         (elem_hint,) = typing.get_args(hint)
         elem_hint, elem_opt = _unwrap_optional(elem_hint)
         if dataclasses.is_dataclass(elem_hint):
-            raise TypeError("lists of dataclasses not supported yet")
-        phys, kind, params = _SCALAR_MAP[elem_hint]
-        elem = sch.leaf("element", phys,
-                        Rep.OPTIONAL if elem_opt else Rep.REQUIRED, kind, **params)
+            # reference parity: []struct fields (Go slices hold struct values,
+            # never nil — so the element group is REQUIRED)
+            if elem_opt:
+                raise TypeError("Optional list elements of dataclass type are "
+                                "not supported (Go []T parity: values, not nil)")
+            return sch.list_of(name, _repeated_group("element", elem_hint), rep)
+        elem = _scalar_leaf("element", elem_hint,
+                            Rep.OPTIONAL if elem_opt else Rep.REQUIRED)
         return sch.list_of(name, elem, rep)
+    if origin in (dict, typing.Dict):
+        key_hint, val_hint = typing.get_args(hint)
+        if key_hint not in _SCALAR_MAP:
+            raise TypeError(f"map key type {key_hint!r} for {name!r} must be "
+                            "a scalar")
+        key = _scalar_leaf("key", key_hint, Rep.REQUIRED)
+        val_hint, val_opt = _unwrap_optional(val_hint)
+        if dataclasses.is_dataclass(val_hint):
+            if val_opt:
+                raise TypeError("Optional map values of dataclass type are "
+                                "not supported (map[K]V parity: values)")
+            value = _repeated_group("value", val_hint)
+        elif val_hint in _SCALAR_MAP:
+            value = _scalar_leaf("value", val_hint,
+                                 Rep.OPTIONAL if val_opt else Rep.REQUIRED)
+        else:
+            raise TypeError(f"unsupported map value type {val_hint!r} for {name!r}")
+        return sch.map_of(name, key, value, rep)
     if dataclasses.is_dataclass(hint):
         hints = typing.get_type_hints(hint)
         kids = [_field_node(f.name, hints[f.name]) for f in dataclasses.fields(hint)]
         return sch.group(name, kids, rep)
     if hint in _SCALAR_MAP:
-        phys, kind, params = _SCALAR_MAP[hint]
-        return sch.leaf(name, phys, rep, kind, **params)
+        return _scalar_leaf(name, hint, rep)
     raise TypeError(f"unsupported field type {hint!r} for {name!r}")
 
 
@@ -106,16 +147,33 @@ def _shred(objs: Sequence[Any], schema: Schema) -> Dict[str, ColumnData]:
 
 
 def _getter(path):
-    def get(o):
-        for p in path:
+    """Leaf-path walker over instances.
+
+    Wrapper names are disambiguated by the runtime value so user fields that
+    happen to be called ``list``/``key_value`` still resolve via getattr:
+    ``list`` consumes a Python list (remaining path applies per element),
+    ``key_value`` consumes a dict (``key``/``value`` select the item stream).
+    """
+
+    def walk(o, path):
+        for i, p in enumerate(path):
             if o is None:
                 return None
-            if p in ("list", "element"):  # 3-level list wrapper names
-                continue
+            if p == "list" and isinstance(o, (list, tuple, np.ndarray)):
+                rest = path[i + 2:]  # skip the "element" wrapper too
+                if not rest:
+                    return o
+                return [None if e is None else walk(e, rest) for e in o]
+            if p == "key_value" and isinstance(o, dict):
+                sel, rest = path[i + 1], path[i + 2:]
+                items = list(o.keys() if sel == "key" else o.values())
+                if not rest:
+                    return items
+                return [None if e is None else walk(e, rest) for e in items]
             o = getattr(o, p)
         return o
 
-    return get
+    return lambda o: walk(o, path)
 
 
 def _shred_leaf(objs: Sequence[Any], leaf) -> ColumnData:
@@ -187,34 +245,68 @@ def _leaf_pylist(col, leaf) -> list:
 
 
 def _assemble(cls, schema: Schema, tab) -> list:
+    return _assemble_rows(cls, schema, tab, ())
+
+
+def _assemble_rows(cls, schema: Schema, tab, prefix) -> list:
     hints = typing.get_type_hints(cls)
     field_values: Dict[str, list] = {}
     for f in dataclasses.fields(cls):
-        hint, _ = _unwrap_optional(hints[f.name])
-        if dataclasses.is_dataclass(hint):
-            sub = _assemble_nested(hint, schema, tab, (f.name,))
-            field_values[f.name] = sub
+        field_values[f.name] = _field_pylist(hints[f.name], f.name, schema, tab,
+                                             prefix)
+    n = max((len(v) for v in field_values.values()), default=0)
+    names = list(field_values)
+    return [cls(**{k: field_values[k][i] for k in names}) for i in range(n)]
+
+
+def _zip_structs_ragged(cls, schema: Schema, tab, base_path) -> list:
+    """Per-row lists of ``cls`` instances from scalar leaves under a repeated
+    group (``x.list.element.*`` / ``x.key_value.value.*``): each leaf shares
+    the group's offsets, so its pylist is already row-shaped."""
+    names = [f.name for f in dataclasses.fields(cls)]
+    per_field = []
+    for fname in names:
+        p = ".".join(base_path + (fname,))
+        per_field.append(_leaf_pylist(tab[p], schema.leaf(tuple(p.split(".")))))
+    out = []
+    for row_lists in zip(*per_field):
+        if row_lists[0] is None:
+            out.append(None)
             continue
-        leaf_paths = [p for p in tab.keys()
-                      if p == f.name or p.startswith(f.name + ".")]
-        leaf = schema.leaf(tuple(leaf_paths[0].split(".")))
-        field_values[f.name] = _leaf_pylist(tab[leaf_paths[0]], leaf)
-    n = len(next(iter(field_values.values()))) if field_values else 0
-    names = list(field_values)
-    return [cls(**{k: field_values[k][i] for k in names}) for i in range(n)]
+        out.append([cls(**dict(zip(names, elem))) for elem in zip(*row_lists)])
+    return out
 
 
-def _assemble_nested(cls, schema, tab, prefix) -> list:
-    hints = typing.get_type_hints(cls)
-    field_values: Dict[str, list] = {}
-    for f in dataclasses.fields(cls):
-        path = ".".join(prefix + (f.name,))
-        leaf_paths = [p for p in tab.keys() if p == path or p.startswith(path + ".")]
-        leaf = schema.leaf(tuple(leaf_paths[0].split(".")))
-        field_values[f.name] = _leaf_pylist(tab[leaf_paths[0]], leaf)
-    n = len(next(iter(field_values.values()))) if field_values else 0
-    names = list(field_values)
-    return [cls(**{k: field_values[k][i] for k in names}) for i in range(n)]
+def _field_pylist(hint, name: str, schema: Schema, tab, prefix) -> list:
+    hint, _ = _unwrap_optional(hint)
+    origin = typing.get_origin(hint)
+    path = prefix + (name,)
+    if origin in (dict, typing.Dict):
+        _, val_hint = typing.get_args(hint)
+        val_hint, _ = _unwrap_optional(val_hint)
+        kp = ".".join(path + ("key_value", "key"))
+        keys = _leaf_pylist(tab[kp], schema.leaf(tuple(kp.split("."))))
+        if dataclasses.is_dataclass(val_hint):
+            vals = _zip_structs_ragged(val_hint, schema, tab,
+                                       path + ("key_value", "value"))
+        else:
+            vp = ".".join(path + ("key_value", "value"))
+            vals = _leaf_pylist(tab[vp], schema.leaf(tuple(vp.split("."))))
+        return [None if k is None else dict(zip(k, v))
+                for k, v in zip(keys, vals)]
+    if origin in (list, typing.List):
+        (elem_hint,) = typing.get_args(hint)
+        elem_hint, _ = _unwrap_optional(elem_hint)
+        if dataclasses.is_dataclass(elem_hint):
+            return _zip_structs_ragged(elem_hint, schema, tab,
+                                       path + ("list", "element"))
+    if dataclasses.is_dataclass(hint):
+        return _assemble_rows(hint, schema, tab, path)
+    dotted = ".".join(path)
+    leaf_paths = [p for p in tab.keys()
+                  if p == dotted or p.startswith(dotted + ".")]
+    leaf = schema.leaf(tuple(leaf_paths[0].split(".")))
+    return _leaf_pylist(tab[leaf_paths[0]], leaf)
 
 
 # ---------------------------------------------------------------------------
