@@ -74,6 +74,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             np.ctypeslib.ndpointer(np.int32, flags=("C_CONTIGUOUS", "WRITEABLE")),
             ctypes.c_int64]
+        lib.pq_assemble_list_runs.restype = ctypes.c_int64
+        lib.pq_assemble_list_runs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_void_p, _i64p,
+            _i64p, _i32p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_void_p, _i64p,
+            _i64p, _i32p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            _i64p_w, _u8p_w, _u8p_w, _i64p_w]
         lib.pq_scan_rle_runs.restype = ctypes.c_int64
         lib.pq_scan_rle_runs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
@@ -144,6 +152,43 @@ def assemble_levels(defs: np.ndarray, reps: np.ndarray, ks, dks, max_def: int):
         offsets.append(offsets_flat[i * (n + 1) : i * (n + 1) + c + 1].copy())
         validity.append(valid_flat[i * n : i * n + c].astype(bool))
     return offsets, validity, leaf_valid[:leaf_count].astype(bool)
+
+
+def assemble_list_runs(buf: np.ndarray, def_tables: tuple, rep_tables: tuple,
+                       n: int, dk: int, max_def: int):
+    """Fused single-level list assembly from level run tables: returns
+    (list_offsets, list_validity, leaf_validity) without materializing
+    per-slot def/rep levels, or None when the native lib is unavailable.
+
+    ``def_tables``/``rep_tables`` are (ends, kinds, payloads, bit_offsets,
+    widths) over the shared level byte stream ``buf``.
+    """
+    lib = get_lib()
+    if lib is None or n == 0:
+        return None
+    buf = np.ascontiguousarray(buf)
+    # keep every coerced table alive by name for the duration of the C call
+    de, dkk, dp, db, dw = (np.ascontiguousarray(a, t) for a, t in
+                           zip(def_tables, (np.int64, np.uint8, np.int64,
+                                            np.int64, np.int32)))
+    re_, rk, rp, rb, rw = (np.ascontiguousarray(a, t) for a, t in
+                           zip(rep_tables, (np.int64, np.uint8, np.int64,
+                                            np.int64, np.int32)))
+    offsets = np.empty(n + 1, np.int64)
+    lvalid = np.empty(max(n, 1), np.uint8)
+    leaf_valid = np.empty(max(n, 1), np.uint8)
+    counts = np.empty(2, np.int64)
+    rc = lib.pq_assemble_list_runs(
+        buf.ctypes.data if len(buf) else None, len(buf),
+        de, dkk.ctypes.data, dp, db, dw, len(de),
+        buf.ctypes.data if len(buf) else None, len(buf),
+        re_, rk.ctypes.data, rp, rb, rw, len(re_),
+        n, dk, max_def, offsets, lvalid, leaf_valid, counts)
+    if rc != 0:
+        return None
+    ninst, nelem = int(counts[0]), int(counts[1])
+    return (offsets[: ninst + 1].copy(), lvalid[:ninst].astype(bool),
+            leaf_valid[:nelem].astype(bool))
 
 
 def expand_runs(buf: np.ndarray, ends: np.ndarray, kinds: np.ndarray,

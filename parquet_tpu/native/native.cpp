@@ -17,6 +17,90 @@
 #include <unordered_map>
 #include <vector>
 
+#if defined(__AVX512F__) && defined(__BMI2__)
+#include <immintrin.h>
+#define PQ_HAVE_AVX512 1
+#endif
+
+namespace {
+
+// Expand the low `k` bits of `bits` into k 0/1 bytes at dst (order-preserving).
+// The magic multiply spreads 8 bits across the 8 bytes of a u64 in one step.
+inline void expand_bits_to_bytes(uint64_t bits, int k, uint8_t* dst) {
+  int t = 0;
+  for (; t + 8 <= k; t += 8, bits >>= 8) {
+    // replicate the byte, isolate bit i in byte i, normalize to 0/1
+    uint64_t m = ((bits & 0xFF) * 0x0101010101010101ULL) & 0x8040201008040201ULL;
+    uint64_t spread = ((m + 0x7F7F7F7F7F7F7F7FULL) >> 7) & 0x0101010101010101ULL;
+    std::memcpy(dst + t, &spread, 8);
+  }
+  for (; t < k; ++t, bits >>= 1) dst[t] = (uint8_t)(bits & 1);
+}
+
+inline uint64_t load8_clamped(const uint8_t* buf, int64_t buf_len, int64_t byte0) {
+  uint64_t word = 0;
+  if (byte0 + 8 <= buf_len) {
+    std::memcpy(&word, buf + byte0, 8);
+  } else {
+    for (int b = 0; b < 8 && byte0 + b < buf_len; ++b)
+      word |= (uint64_t)buf[byte0 + b] << (8 * b);
+  }
+  return word;
+}
+
+// Unpack cnt w-bit values starting at bit offset `bit` into dst.  One 8-byte
+// load yields floor(57/w) values (57 = 64 minus the worst bit phase) — level
+// streams are 1-3 bits wide, so this is ~20-57 values per load.
+inline void unpack_bits_span(const uint8_t* buf, int64_t buf_len, int64_t bit,
+                             int32_t w, int64_t cnt, int32_t* dst) {
+  const uint64_t mask = (w >= 32) ? 0xFFFFFFFFull : ((1ull << w) - 1);
+  if (w <= 28) {
+    const int kper = 57 / w;
+    int64_t j = 0;
+    while (j < cnt) {
+      uint64_t word = load8_clamped(buf, buf_len, bit >> 3) >> (bit & 7);
+      int m = (int)((cnt - j < kper) ? (cnt - j) : kper);
+      for (int t = 0; t < m; ++t)
+        dst[j + t] = (int32_t)((word >> (t * w)) & mask);
+      j += m;
+      bit += (int64_t)m * w;
+    }
+  } else {
+    for (int64_t j = 0; j < cnt; ++j) {
+      uint64_t word = load8_clamped(buf, buf_len, bit >> 3);
+      dst[j] = (int32_t)((word >> (bit & 7)) & mask);
+      bit += w;
+    }
+  }
+}
+
+#ifdef PQ_HAVE_AVX512
+// 64-slot bitmap compaction shared by pq_assemble_levels and the fused list
+// assembler: write instance validity + leaf validity bytes via pext/spread,
+// and per-instance offsets (elements strictly before the instance bit) via a
+// tzcnt walk.  Advances *ninst/*elems.
+inline void compact_block64(uint64_t inst_w, uint64_t elem_w, uint64_t valge_w,
+                            uint64_t eq_w, int64_t* offsets, uint8_t* lvalid,
+                            uint8_t* leaf_valid /* may be null */,
+                            int64_t* ninst, int64_t* elems) {
+  expand_bits_to_bytes(_pext_u64(valge_w, inst_w),
+                       (int)_mm_popcnt_u64(inst_w), lvalid + *ninst);
+  if (leaf_valid)
+    expand_bits_to_bytes(_pext_u64(eq_w, elem_w), (int)_mm_popcnt_u64(elem_w),
+                         leaf_valid + *elems);
+  uint64_t iw = inst_w;
+  while (iw) {
+    const int p = (int)_tzcnt_u64(iw);
+    iw = _blsr_u64(iw);
+    offsets[(*ninst)++] =
+        *elems + _mm_popcnt_u64(elem_w & (((uint64_t)1 << p) - 1));
+  }
+  *elems += _mm_popcnt_u64(elem_w);
+}
+#endif
+
+}  // namespace
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -63,21 +147,7 @@ int64_t pq_expand_runs(const uint8_t* buf, int64_t buf_len, const int64_t* ends,
       const int32_t v = (int32_t)payloads[i];
       for (int64_t j = 0; j < cnt; ++j) out[pos + j] = v;
     } else {
-      const int32_t w = widths[i];
-      const uint64_t mask = (w >= 64) ? ~0ull : ((1ull << w) - 1);
-      int64_t bit = bit_offsets[i];
-      for (int64_t j = 0; j < cnt; ++j) {
-        const int64_t byte0 = bit >> 3;
-        uint64_t word = 0;
-        if (byte0 + 8 <= buf_len) {
-          std::memcpy(&word, buf + byte0, 8);
-        } else {
-          for (int b = 0; b < 8 && byte0 + b < buf_len; ++b)
-            word |= (uint64_t)buf[byte0 + b] << (8 * b);
-        }
-        out[pos + j] = (int32_t)((word >> (bit & 7)) & mask);
-        bit += w;
-      }
+      unpack_bits_span(buf, buf_len, bit_offsets[i], widths[i], cnt, out + pos);
     }
     pos += cnt;
   }
@@ -96,6 +166,73 @@ int64_t pq_assemble_levels(const int32_t* defs, const int32_t* reps, int64_t n,
                            int32_t max_def, int64_t* offsets_flat,
                            uint8_t* valid_flat, int64_t* inst_counts,
                            uint8_t* leaf_valid) {
+#ifdef PQ_HAVE_AVX512
+  // Vectorized: 64-slot bitmaps from AVX-512 compares, then per-word
+  // stream compaction — offsets via tzcnt walk over instance bits (instances
+  // are ~rows, far fewer than slots), validity bytes via pext + bit spread.
+  const int64_t nw = n / 64;
+  for (int32_t i = 0; i < nlev; ++i) {
+    const int32_t k = ks[i], dk = dks[i];
+    const int32_t dprev = (i > 0) ? dks[i - 1] : INT32_MIN;
+    const int32_t knext = (i + 1 < nlev) ? ks[i + 1] : INT32_MAX;
+    int64_t* offs = offsets_flat + (int64_t)i * (n + 1);
+    uint8_t* val = valid_flat + (int64_t)i * n;
+    int64_t ninst = 0, elems = 0;
+    const __m512i kv = _mm512_set1_epi32(k);
+    const __m512i dprevv = _mm512_set1_epi32(dprev);
+    const __m512i knextv = _mm512_set1_epi32(knext);
+    const __m512i dkv = _mm512_set1_epi32(dk);
+    const __m512i dkm1v = _mm512_set1_epi32(dk - 1);
+    for (int64_t wi = 0; wi < nw; ++wi) {
+      uint64_t inst_w = 0, elem_w = 0, valge_w = 0;
+      const int64_t j0 = wi * 64;
+      for (int g = 0; g < 4; ++g) {
+        const __m512i dv = _mm512_loadu_si512(defs + j0 + g * 16);
+        const __m512i rv = _mm512_loadu_si512(reps + j0 + g * 16);
+        uint64_t im = _mm512_cmplt_epi32_mask(rv, kv) &
+                      _mm512_cmple_epi32_mask(dprevv, dv);
+        uint64_t em = _mm512_cmplt_epi32_mask(rv, knextv) &
+                      _mm512_cmple_epi32_mask(dkv, dv);
+        uint64_t vm = _mm512_cmple_epi32_mask(dkm1v, dv);
+        inst_w |= im << (g * 16);
+        elem_w |= em << (g * 16);
+        valge_w |= vm << (g * 16);
+      }
+      compact_block64(inst_w, elem_w, valge_w, 0, offs, val, nullptr, &ninst,
+                      &elems);
+    }
+    for (int64_t j = nw * 64; j < n; ++j) {
+      const int32_t dj = defs[j], rj = reps[j];
+      offs[ninst] = elems;
+      val[ninst] = dj >= dk - 1;
+      ninst += (rj < k) & (dj >= dprev);
+      elems += (rj < knext) & (dj >= dk);
+    }
+    offs[ninst] = elems;
+    inst_counts[i] = ninst;
+  }
+  const int32_t dr = dks[nlev - 1];
+  const __m512i drv = _mm512_set1_epi32(dr);
+  const __m512i mdv = _mm512_set1_epi32(max_def);
+  int64_t cnt = 0;
+  for (int64_t wi = 0; wi < nw; ++wi) {
+    uint64_t ge_w = 0, eq_w = 0;
+    for (int g = 0; g < 4; ++g) {
+      const __m512i dv = _mm512_loadu_si512(defs + wi * 64 + g * 16);
+      ge_w |= (uint64_t)_mm512_cmple_epi32_mask(drv, dv) << (g * 16);
+      eq_w |= (uint64_t)_mm512_cmpeq_epi32_mask(dv, mdv) << (g * 16);
+    }
+    const int kk = (int)_mm_popcnt_u64(ge_w);
+    expand_bits_to_bytes(_pext_u64(eq_w, ge_w), kk, leaf_valid + cnt);
+    cnt += kk;
+  }
+  for (int64_t j = nw * 64; j < n; ++j) {
+    const int32_t dj = defs[j];
+    leaf_valid[cnt] = dj == max_def;
+    cnt += dj >= dr;
+  }
+  return cnt;
+#else
   for (int32_t i = 0; i < nlev; ++i) {
     const int32_t k = ks[i], dk = dks[i];
     const int32_t dprev = (i > 0) ? dks[i - 1] : INT32_MIN;
@@ -123,6 +260,152 @@ int64_t pq_assemble_levels(const int32_t* defs, const int32_t* reps, int64_t n,
     cnt += dj >= dr;
   }
   return cnt;
+#endif
+}
+
+// ---------------------------------------------------------------------------
+// Fused single-repetition-level list assembly straight from the two level
+// run tables (no per-slot def/rep materialization).  Host work stays
+// metadata-scale: RLE x RLE segments are handled with vector fills; only
+// bit-packed spans unpack per slot.  Semantics match pq_assemble_levels for
+// nlev == 1: instance iff rep == 0, element iff def >= dk, list non-null iff
+// def >= dk-1 at its start slot, leaf valid iff def == max_def.
+// out_counts = {ninst, nelems}; returns 0, or -1 on a run table that does
+// not tile [0, n).
+// ---------------------------------------------------------------------------
+struct RunCursor {
+  const uint8_t* buf;
+  int64_t buf_len;
+  const int64_t* ends;
+  const uint8_t* kinds;
+  const int64_t* pays;
+  const int64_t* bits;
+  const int32_t* widths;
+  int64_t nruns;
+  int64_t idx = 0;
+  int64_t start = 0;  // first slot of current run
+
+  bool advance_to(int64_t pos) {  // enter the run containing pos
+    while (idx < nruns && ends[idx] <= pos) {
+      start = ends[idx];
+      ++idx;
+    }
+    return idx < nruns;
+  }
+  // fill dst[0..cnt) with per-slot values of [pos, pos+cnt), walking runs
+  bool fill(int64_t pos, int64_t cnt, int32_t* dst) {
+    int64_t done = 0;
+    while (done < cnt) {
+      if (!advance_to(pos + done)) return false;
+      int64_t take = ends[idx] - (pos + done);
+      if (take > cnt - done) take = cnt - done;
+      if (kinds[idx] == 0) {
+        const int32_t v = (int32_t)pays[idx];
+        for (int64_t j = 0; j < take; ++j) dst[done + j] = v;
+      } else {
+        unpack_span(pos + done, take, dst + done);
+      }
+      done += take;
+    }
+    return true;
+  }
+  bool is_rle() const { return kinds[idx] == 0; }
+  int32_t value() const { return (int32_t)pays[idx]; }
+  int64_t end() const { return ends[idx]; }
+  // unpack [pos, pos+cnt) of a bit-packed run into dst
+  void unpack_span(int64_t pos, int64_t cnt, int32_t* dst) const {
+    const int32_t w = widths[idx];
+    unpack_bits_span(buf, buf_len, bits[idx] + (pos - start) * w, w, cnt, dst);
+  }
+};
+
+int64_t pq_assemble_list_runs(
+    const uint8_t* dbuf, int64_t dlen, const int64_t* d_ends,
+    const uint8_t* d_kinds, const int64_t* d_pays, const int64_t* d_bits,
+    const int32_t* d_widths, int64_t d_nruns, const uint8_t* rbuf, int64_t rlen,
+    const int64_t* r_ends, const uint8_t* r_kinds, const int64_t* r_pays,
+    const int64_t* r_bits, const int32_t* r_widths, int64_t r_nruns, int64_t n,
+    int32_t dk, int32_t max_def, int64_t* offsets, uint8_t* lvalid,
+    uint8_t* leaf_valid, int64_t* out_counts) {
+  RunCursor dc{dbuf, dlen, d_ends, d_kinds, d_pays, d_bits, d_widths, d_nruns};
+  RunCursor rc{rbuf, rlen, r_ends, r_kinds, r_pays, r_bits, r_widths, r_nruns};
+  int64_t pos = 0, ninst = 0, elems = 0;
+  while (pos < n) {
+    if (!dc.advance_to(pos) || !rc.advance_to(pos)) return -1;
+    int64_t end = dc.end() < rc.end() ? dc.end() : rc.end();
+    if (end > n) end = n;
+    const int64_t len = end - pos;
+    if (dc.is_rle() && rc.is_rle() && len >= 256) {
+      const int32_t dv = dc.value(), rv = rc.value();
+      const bool elem = dv >= dk;
+      if (rv == 0) {
+        if (elem) {
+          for (int64_t t = 0; t < len; ++t) offsets[ninst + t] = elems + t;
+        } else {
+          for (int64_t t = 0; t < len; ++t) offsets[ninst + t] = elems;
+        }
+        std::memset(lvalid + ninst, dv >= dk - 1 ? 1 : 0, len);
+        ninst += len;
+      }
+      if (elem) {
+        std::memset(leaf_valid + elems, dv == max_def ? 1 : 0, len);
+        elems += len;
+      }
+    } else {
+      // short/mixed span: run-table-driven fills into L1-resident chunks
+      // (continuous across run boundaries — per-run cost is just the fill
+      // switch), then compact via 64-slot bitmaps so stores happen only at
+      // instances/elements
+      alignas(64) int32_t dtmp[576], rtmp[576];
+      end = pos + 512 < n ? pos + 512 : n;
+      {
+        const int64_t seg = pos;
+        const int64_t cnt = end - seg;
+        if (!dc.fill(seg, cnt, dtmp) || !rc.fill(seg, cnt, rtmp)) return -1;
+#ifdef PQ_HAVE_AVX512
+        const __m512i zerov = _mm512_setzero_si512();
+        const __m512i dkv = _mm512_set1_epi32(dk);
+        const __m512i dkm1v = _mm512_set1_epi32(dk - 1);
+        const __m512i mdv = _mm512_set1_epi32(max_def);
+        for (int64_t j0 = 0; j0 < cnt; j0 += 64) {
+          uint64_t inst_w = 0, elem_w = 0, valge_w = 0, eq_w = 0;
+          for (int g = 0; g < 4; ++g) {
+            const __m512i dv = _mm512_loadu_si512(dtmp + j0 + g * 16);
+            const __m512i rv = _mm512_loadu_si512(rtmp + j0 + g * 16);
+            inst_w |= (uint64_t)_mm512_cmpeq_epi32_mask(rv, zerov) << (g * 16);
+            elem_w |= (uint64_t)_mm512_cmple_epi32_mask(dkv, dv) << (g * 16);
+            valge_w |= (uint64_t)_mm512_cmple_epi32_mask(dkm1v, dv) << (g * 16);
+            eq_w |= (uint64_t)_mm512_cmpeq_epi32_mask(dv, mdv) << (g * 16);
+          }
+          if (cnt - j0 < 64) {  // mask out the tail's garbage lanes
+            const uint64_t live = (~0ull) >> (64 - (cnt - j0));
+            inst_w &= live;
+            elem_w &= live;
+            valge_w &= live;
+            eq_w &= live;
+          }
+          compact_block64(inst_w, elem_w, valge_w, eq_w, offsets, lvalid,
+                          leaf_valid, &ninst, &elems);
+        }
+#else
+        // branchless: always store at the cursor, advance conditionally
+        for (int64_t j = 0; j < cnt; ++j) {
+          const int32_t dv = dtmp[j], rv = rtmp[j];
+          offsets[ninst] = elems;
+          lvalid[ninst] = dv >= dk - 1;
+          ninst += (rv == 0);
+          leaf_valid[elems] = dv == max_def;
+          elems += (dv >= dk);
+        }
+#endif
+      }
+    }
+    pos = end;
+  }
+  offsets[ninst] = elems;
+  out_counts[0] = ninst;
+  out_counts[1] = elems;
+  return 0;
 }
 
 // ---------------------------------------------------------------------------

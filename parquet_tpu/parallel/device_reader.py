@@ -55,6 +55,35 @@ class _Unsupported(Exception):
     """Internal: chunk shape the device path doesn't cover → host fallback."""
 
 
+class _LazyLevels:
+    """Per-slot level stream, materialized on first array access.
+
+    The fused list assembler (pq_assemble_list_runs) derives offsets/validity
+    straight from the run tables, so most reads never touch per-slot levels;
+    consumers that do (row-range trims, struct zips, batch streaming) get
+    them transparently via the numpy array protocol."""
+
+    __slots__ = ("_runs", "_buf", "_arr")
+
+    def __init__(self, runs: _RunTable, buf: np.ndarray):
+        self._runs, self._buf, self._arr = runs, buf, None
+
+    def _materialize(self) -> np.ndarray:
+        if self._arr is None:
+            self._arr = self._runs.expand_host(self._buf)
+        return self._arr
+
+    def __array__(self, dtype=None, copy=None):
+        a = self._materialize()
+        return np.asarray(a, dtype=dtype)
+
+    def __len__(self):
+        return self._runs.total
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+
 @dataclass
 class _RunTable:
     """Chunk-level merged RLE/bit-packed run table (host-scanned)."""
@@ -88,6 +117,15 @@ class _RunTable:
         self.ends.append(np.array([self.total + n], np.int64))
         self.total += n
 
+    def tables_host(self) -> tuple:
+        """(ends, kinds, payloads, bit_offsets, widths) as int64-domain host
+        arrays — operands of the fused C++ run-table consumers."""
+        return (np.concatenate(self.ends).astype(np.int64),
+                np.concatenate(self.kinds),
+                np.concatenate(self.payloads).astype(np.int64),
+                np.concatenate(self.bit_offsets).astype(np.int64),
+                np.concatenate(self.widths).astype(np.int32))
+
     def run_arrays(self) -> tuple:
         """(ends, kinds, payloads, bit_offsets, widths) as flat host arrays —
         the rle_expand kernel operands, stageable to HBM ahead of decode.
@@ -113,13 +151,9 @@ class _RunTable:
         record assembler — expanding there avoids a D2H sync of data that is
         metadata-sized to begin with."""
         n = n or self.total
-        ends = np.concatenate(self.ends).astype(np.int64)
-        kinds = np.concatenate(self.kinds)
-        payloads = np.concatenate(self.payloads).astype(np.int64)
-        offs = np.concatenate(self.bit_offsets).astype(np.int64)
-        widths = np.concatenate(self.widths).astype(np.int64)
-        out = native.expand_runs(buf, ends, kinds, payloads, offs,
-                                 widths.astype(np.int32), n)
+        ends, kinds, payloads, offs, widths32 = self.tables_host()
+        widths = widths32.astype(np.int64)
+        out = native.expand_runs(buf, ends, kinds, payloads, offs, widths32, n)
         if out is not None:
             return out
         if len(widths) and widths.max() > 24:
@@ -860,6 +894,7 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
     def_levels = None
     def_host = rep_host = None
     device_asm = None
+    fused_asm = None
     validity = None
     if max_rep > 0:
         infos = levels_ops.repeated_ancestors(leaf)
@@ -872,15 +907,28 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
                 d_dev, r_dev, infos[0].def_level, max_def)
         else:
             lev_host = np.frombuffer(bytes(plan.levels), np.uint8)
-            if plan.def_runs.total:
-                def_host = plan.def_runs.expand_host(lev_host)
-            elif plan.host_def:
-                def_host = np.concatenate(plan.host_def).astype(np.int32)
-            if plan.rep_runs.total:
-                rep_host = plan.rep_runs.expand_host(lev_host)
+            if (len(infos) == 1 and plan.def_runs.total and plan.rep_runs.total
+                    and plan.def_runs.total == plan.rep_runs.total
+                    and not plan.host_def):
+                # fused path: offsets/validity straight from the run tables —
+                # host work stays metadata-scale (per-run, not per-slot)
+                fused_asm = native.assemble_list_runs(
+                    lev_host, plan.def_runs.tables_host(),
+                    plan.rep_runs.tables_host(), plan.def_runs.total,
+                    infos[0].def_level, max_def)
+            if fused_asm is None:
+                if plan.def_runs.total:
+                    def_host = plan.def_runs.expand_host(lev_host)
+                elif plan.host_def:
+                    def_host = np.concatenate(plan.host_def).astype(np.int32)
+                if plan.rep_runs.total:
+                    rep_host = plan.rep_runs.expand_host(lev_host)
+                else:
+                    rep_host = np.zeros(
+                        len(def_host) if def_host is not None else 0, np.int32)
             else:
-                rep_host = np.zeros(len(def_host) if def_host is not None else 0,
-                                    np.int32)
+                def_host = _LazyLevels(plan.def_runs, lev_host)
+                rep_host = _LazyLevels(plan.rep_runs, lev_host)
     elif max_def > 0 and plan.total_values == plan.total_slots:
         pass  # no nulls anywhere: validity stays None, levels never expand
     else:
@@ -993,6 +1041,9 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
     leaf_validity = validity
     if device_asm is not None:
         lofs, lval, leaf_validity = device_asm
+        list_offsets, list_validity = [lofs], [lval]
+    elif fused_asm is not None:
+        lofs, lval, leaf_validity = fused_asm
         list_offsets, list_validity = [lofs], [lval]
     elif max_rep > 0 and def_host is not None:
         asm = levels_ops.assemble(def_host, rep_host, leaf)

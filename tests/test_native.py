@@ -84,3 +84,54 @@ def test_delta_byte_array_native_path(lib, rng):
     v, o, _ = ref.decode_delta_byte_array(np.frombuffer(enc, np.uint8))
     assert v.tobytes() == data.tobytes()
     np.testing.assert_array_equal(o, offs)
+
+
+def test_assemble_list_runs_matches_assemble_oracle(lib, rng):
+    """Fused run-table list assembly == per-slot expand + assemble, across
+    random level streams (incl. all-RLE, all-bit-packed, and mixed)."""
+    from parquet_tpu.ops import levels as levels_ops
+    from parquet_tpu.schema import schema as sch
+    from parquet_tpu.format.enums import FieldRepetitionType as Rep
+
+    elem = sch.leaf("element", Type.INT64, Rep.OPTIONAL)
+    node = sch.list_of("xs", elem, Rep.OPTIONAL)
+    schema = sch.message("M", [node])
+    leaf = schema.leaves[0]
+    max_def, dk = leaf.max_definition_level, None
+    infos = levels_ops.repeated_ancestors(leaf)
+    dk = infos[0].def_level
+
+    for trial in range(40):
+        n = int(rng.integers(1, 6000))
+        # def in [0, max_def]; rep in {0,1}; rep[0] must be 0
+        style = trial % 4
+        if style == 0:  # long constant spans -> RLE-heavy
+            d = np.repeat(rng.integers(0, max_def + 1, 20),
+                          rng.integers(1, 400, 20)).astype(np.int64)[:n]
+            if len(d) < n:
+                d = np.pad(d, (0, n - len(d)), constant_values=max_def)
+            r = np.zeros(n, np.int64)
+        elif style == 1:  # alternating -> bit-packed heavy
+            d = rng.integers(0, max_def + 1, n).astype(np.int64)
+            r = rng.integers(0, 2, n).astype(np.int64)
+        else:  # realistic lists: mostly-present elements, some null/empty
+            d = np.full(n, max_def, np.int64)
+            d[rng.random(n) < 0.1] = 0
+            r = (rng.random(n) < 0.7).astype(np.int64)
+        r[0] = 0
+        # encode the two streams RLE-hybrid, build run tables via the scanner
+        dw = max(1, int(max_def).bit_length())
+        denc = np.frombuffer(ref.encode_rle(d, dw), np.uint8)
+        renc = np.frombuffer(ref.encode_rle(r, 1), np.uint8)
+        buf = np.concatenate([denc, renc])
+        dk_, dc, dp, do, _ = ref.scan_rle_runs(denc, n, dw, 0)
+        rk_, rc_, rp, ro, _ = ref.scan_rle_runs(renc, n, 1, 0)
+        dtab = (np.cumsum(dc), dk_, dp, do * 8, np.full(len(dk_), dw, np.int32))
+        rtab = (np.cumsum(rc_), rk_, rp, (ro + len(denc)) * 8,
+                np.full(len(rk_), 1, np.int32))
+        got = native.assemble_list_runs(buf, dtab, rtab, n, dk, max_def)
+        assert got is not None
+        asm = levels_ops.assemble(d.astype(np.int32), r.astype(np.int32), leaf)
+        np.testing.assert_array_equal(got[0], asm.list_offsets[0], err_msg=f"t{trial}")
+        np.testing.assert_array_equal(got[1], asm.list_validity[0], err_msg=f"t{trial}")
+        np.testing.assert_array_equal(got[2], asm.validity, err_msg=f"t{trial}")
