@@ -738,10 +738,20 @@ def _build_dictionary(leaf: Leaf, data: ColumnData, limit_bytes: int):
         return None, None, None  # keep plain for v1
     if len(vals) == 0:
         return None, None, None
-    uniq, indices = np.unique(vals, return_inverse=True)
-    if uniq.nbytes > limit_bytes or len(uniq) > len(vals) // 2 + 16:
+    max_unique = len(vals) // 2 + 16
+    from .. import native as _native
+
+    nat = _native.dict_build_fixed(vals, max_unique)
+    if nat == "overflow":
         return None, None, None
-    return uniq, None, indices.astype(np.int64)
+    if nat is not None:
+        uniq, indices = nat  # C++ hash dedup, first-seen order
+    else:
+        uniq, indices = np.unique(vals, return_inverse=True)
+        indices = indices.astype(np.int64)
+    if uniq.nbytes > limit_bytes or len(uniq) > max_unique:
+        return None, None, None
+    return uniq, None, indices
 
 
 def _encode_values(leaf: Leaf, data: ColumnData, v0: int, v1: int,

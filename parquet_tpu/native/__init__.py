@@ -82,6 +82,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _i64p, _i32p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             _i64p_w, _u8p_w, _u8p_w, _i64p_w]
+        lib.pq_pack_bits.restype = ctypes.c_int64
+        lib.pq_pack_bits.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
+                                     _u8p_w]
+        lib.pq_dict_build_i64.restype = ctypes.c_int64
+        lib.pq_dict_build_i64.argtypes = [_i64p, ctypes.c_int64,
+                                          ctypes.c_int64, _i64p_w, _i64p_w]
         lib.pq_scan_rle_runs.restype = ctypes.c_int64
         lib.pq_scan_rle_runs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
@@ -189,6 +195,48 @@ def assemble_list_runs(buf: np.ndarray, def_tables: tuple, rep_tables: tuple,
     ninst, nelem = int(counts[0]), int(counts[1])
     return (offsets[: ninst + 1].copy(), lvalid[:ninst].astype(bool),
             leaf_valid[:nelem].astype(bool))
+
+
+def pack_bits(values: np.ndarray, bit_width: int) -> Optional[bytes]:
+    """LSB-first bit packing (write path), or None when unavailable/wide."""
+    lib = get_lib()
+    if lib is None or bit_width > 56:
+        return None
+    values = np.ascontiguousarray(values, np.int64)
+    out = np.empty((len(values) * bit_width + 7) // 8 + 8, np.uint8)
+    wrote = lib.pq_pack_bits(values, len(values), bit_width, out)
+    if wrote < 0:
+        return None
+    return out[:wrote].tobytes()
+
+
+def dict_build_fixed(vals: np.ndarray, max_unique: int):
+    """First-occurrence dedup of a fixed-width column (any 4/8-byte dtype,
+    compared bitwise).  Returns (uniques in vals.dtype, int64 indices),
+    "overflow" past max_unique, or None when the lib is unavailable."""
+    lib = get_lib()
+    if lib is None or len(vals) == 0:
+        return None
+    orig = vals.dtype
+    if vals.dtype.itemsize == 8:
+        keys = np.ascontiguousarray(vals).view(np.int64)
+    elif vals.dtype.itemsize == 4:
+        # widen via the 32-bit bit pattern so float32 NaNs stay bit-exact
+        keys = np.ascontiguousarray(vals).view(np.int32).astype(np.int64)
+    else:
+        return None
+    indices = np.empty(len(keys), np.int64)
+    uniques = np.empty(max(max_unique, 1), np.int64)
+    nu = lib.pq_dict_build_i64(np.ascontiguousarray(keys), len(keys),
+                               max_unique, indices, uniques)
+    if nu < 0:
+        return "overflow"
+    uniq = uniques[:nu]
+    if vals.dtype.itemsize == 4:
+        uniq = uniq.astype(np.int32).view(orig)
+    else:
+        uniq = uniq.view(orig)
+    return uniq.copy(), indices
 
 
 def expand_runs(buf: np.ndarray, ends: np.ndarray, kinds: np.ndarray,

@@ -264,6 +264,69 @@ int64_t pq_assemble_levels(const int32_t* defs, const int32_t* reps, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// LSB-first bit packing (write-path twin of unpack_bits_span; the hottest
+// loop of the RLE/dict encoder).  w <= 56 keeps acc|= from overflowing with
+// nb < 8 residual bits.  Returns bytes written, or -1 for unsupported width.
+// ---------------------------------------------------------------------------
+int64_t pq_pack_bits(const int64_t* vals, int64_t n, int32_t w, uint8_t* out) {
+  if (w <= 0) return 0;
+  if (w > 56) return -1;
+  const uint64_t mask = (1ull << w) - 1;
+  uint64_t acc = 0;
+  int nb = 0;
+  int64_t o = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    acc |= ((uint64_t)vals[i] & mask) << nb;
+    nb += w;
+    while (nb >= 8) {
+      out[o++] = (uint8_t)acc;
+      acc >>= 8;
+      nb -= 8;
+    }
+  }
+  if (nb) out[o++] = (uint8_t)acc;
+  return o;
+}
+
+// ---------------------------------------------------------------------------
+// Fixed-width dictionary build (hashprobe analog for INT32/INT64/FLOAT/DOUBLE
+// viewed as int64 bits): open-addressing first-occurrence dedup.
+// Returns unique count, or -1 when max_unique would be exceeded.
+// ---------------------------------------------------------------------------
+int64_t pq_dict_build_i64(const int64_t* vals, int64_t n, int64_t max_unique,
+                          int64_t* indices, int64_t* uniques) {
+  int64_t cap = 64;
+  while (cap < 2 * max_unique) cap <<= 1;
+  std::vector<int64_t> slot(cap, -1);
+  std::vector<int64_t> key(cap);
+  int64_t nu = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t v = vals[i];
+    uint64_t h = (uint64_t)v * 0x9E3779B97F4A7C15ull;
+    h ^= h >> 29;
+    int64_t p = (int64_t)(h & (uint64_t)(cap - 1));
+    while (true) {
+      const int64_t s = slot[p];
+      if (s < 0) {
+        if (nu >= max_unique) return -1;
+        slot[p] = nu;
+        key[p] = v;
+        uniques[nu] = v;
+        indices[i] = nu;
+        ++nu;
+        break;
+      }
+      if (key[p] == v) {
+        indices[i] = s;
+        break;
+      }
+      p = (p + 1) & (cap - 1);
+    }
+  }
+  return nu;
+}
+
+// ---------------------------------------------------------------------------
 // Fused single-repetition-level list assembly straight from the two level
 // run tables (no per-slot def/rep materialization).  Host work stays
 // metadata-scale: RLE x RLE segments are handled with vector fills; only

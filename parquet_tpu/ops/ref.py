@@ -96,8 +96,25 @@ def unpack_bits(data, n: int, bit_width: int, offset_bits: int = 0) -> np.ndarra
 
 
 def pack_bits(values: np.ndarray, bit_width: int) -> bytes:
-    """Pack integers LSB-first at ``bit_width`` bits each (fully vectorized:
-    per-value bit matrix → np.packbits little-endian; no scatter/ufunc.at)."""
+    """Pack integers LSB-first at ``bit_width`` bits each.
+
+    Routes through the C++ shim (the write path's hottest loop); the numpy
+    formulation below is the oracle/fallback (cross-tested in test_native)."""
+    n = len(values)
+    if bit_width == 0 or n == 0:
+        return b""
+    if bit_width <= 56:
+        from .. import native
+
+        out = native.pack_bits(np.asarray(values, np.int64), bit_width)
+        if out is not None:
+            return out
+    return pack_bits_np(values, bit_width)
+
+
+def pack_bits_np(values: np.ndarray, bit_width: int) -> bytes:
+    """Numpy oracle for :func:`pack_bits` (fully vectorized: per-value bit
+    matrix → np.packbits little-endian; no scatter/ufunc.at)."""
     n = len(values)
     if bit_width == 0 or n == 0:
         return b""

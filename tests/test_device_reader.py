@@ -193,7 +193,7 @@ def test_list_under_struct_keeps_host_levels_device_read():
     assert got.column("s").to_pylist() == want.column("s").to_pylist()
 
 
-@pytest.mark.parametrize("mode", ["off", "", "1"])
+@pytest.mark.parametrize("mode", ["off", "", "0", "1"])
 def test_dense_dict_route_modes(mode, monkeypatch, rng):
     """Single-width dict-index streams route through the compacted dense
     stream (jnp twin by default, Pallas with PARQUET_TPU_PALLAS=1, legacy
@@ -284,3 +284,21 @@ def test_device_all_null_chunks(typ_kw):
     col = dr.decode_chunk_device(chunk, fallback=False)
     arr = col.to_arrow()
     assert len(arr) == 1500 and arr.null_count == 1500
+
+
+def test_use_pallas_gate_blocks_wide_widths(monkeypatch):
+    """w >= 17 deterministically miscompiles under Mosaic on the real v5e
+    (sparse wrong values at word-straddling shift-16 lanes, measured round
+    2) — the router must refuse Pallas there even when forced."""
+    from parquet_tpu.parallel import device_reader as dr
+
+    monkeypatch.setattr(dr, "_pallas_broken", False)
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "1")
+    assert dr._use_pallas(16)
+    for w in (17, 20, 24, 31, 32):
+        assert not dr._use_pallas(w), w
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "0")
+    assert not dr._use_pallas(8)
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "")
+    # auto: CPU backend in tests -> jnp twin
+    assert not dr._use_pallas(8)

@@ -135,3 +135,26 @@ def test_assemble_list_runs_matches_assemble_oracle(lib, rng):
         np.testing.assert_array_equal(got[0], asm.list_offsets[0], err_msg=f"t{trial}")
         np.testing.assert_array_equal(got[1], asm.list_validity[0], err_msg=f"t{trial}")
         np.testing.assert_array_equal(got[2], asm.validity, err_msg=f"t{trial}")
+
+
+def test_pack_bits_native_matches_numpy_oracle(lib, rng):
+    for w in (1, 2, 3, 7, 8, 13, 15, 20, 31, 32, 40, 56):
+        n = int(rng.integers(1, 3000))
+        vals = rng.integers(0, 1 << min(w, 62), n, dtype=np.int64)
+        got = native.pack_bits(vals, w)
+        assert got is not None
+        assert got == ref.pack_bits_np(vals, w), f"w={w}"
+
+
+def test_dict_build_fixed_matches_unique(lib, rng):
+    for dt in (np.int64, np.int32, np.float64, np.float32):
+        vals = rng.integers(0, 500, 20000).astype(dt)
+        out = native.dict_build_fixed(vals, len(vals) // 2 + 16)
+        assert out is not None and out != "overflow"
+        uniq, idx = out
+        # first-occurrence order; gather must reproduce the input bitwise
+        np.testing.assert_array_equal(uniq[idx], vals)
+        assert len(np.unique(uniq)) == len(uniq)
+    # overflow: all-distinct column refuses dictionary
+    vals = np.arange(10000, dtype=np.int64)
+    assert native.dict_build_fixed(vals, 5016) == "overflow"
