@@ -1076,7 +1076,17 @@ def _column_from_arrow(arr, leaf: Leaf, pos: int = 1) -> ColumnData:
             pos += 2
             if raw[0] != 0 or len(child) != raw[-1]:  # sliced parent array
                 child = child.slice(raw[0], raw[-1] - raw[0])
-            offsets_per_level.append(raw - raw[0])
+            offs = raw - raw[0]
+            if lv is not None:
+                # arrow permits a NULL list's offset span to still cover
+                # child values; parquet has no slots for them — drop the
+                # spanned values and zero the null rows' lengths
+                lens = np.diff(offs)
+                if lens[~lv].any():
+                    child = child.filter(pa.array(np.repeat(lv, lens)))
+                    offs = np.zeros(len(offs), np.int64)
+                    np.cumsum(np.where(lv, lens, 0), out=offs[1:])
+            offsets_per_level.append(offs)
             validity_per_level.append(lv)
             a = child
         inner = _column_from_arrow(a, leaf, pos)

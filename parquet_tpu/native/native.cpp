@@ -300,11 +300,20 @@ int64_t pq_dict_build_i64(const int64_t* vals, int64_t n, int64_t max_unique,
   std::vector<int64_t> slot(cap, -1);
   std::vector<int64_t> key(cap);
   int64_t nu = 0;
-  for (int64_t i = 0; i < n; ++i) {
-    const int64_t v = vals[i];
+  const auto hash_of = [cap](int64_t v) {
     uint64_t h = (uint64_t)v * 0x9E3779B97F4A7C15ull;
     h ^= h >> 29;
-    int64_t p = (int64_t)(h & (uint64_t)(cap - 1));
+    return (int64_t)(h & (uint64_t)(cap - 1));
+  };
+  constexpr int64_t kAhead = 16;  // hide the random-probe cache miss
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + kAhead < n) {
+      const int64_t pf = hash_of(vals[i + kAhead]);
+      __builtin_prefetch(&slot[pf]);
+      __builtin_prefetch(&key[pf]);
+    }
+    const int64_t v = vals[i];
+    int64_t p = hash_of(v);
     while (true) {
       const int64_t s = slot[p];
       if (s < 0) {

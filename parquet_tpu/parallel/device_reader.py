@@ -1140,12 +1140,14 @@ def _decode_dense_dict(plan: _Plan, dense_buf: jax.Array, dictionary,
         try:
             allvals = pk.dict_unpack_gather(words, dictionary, total, w,
                                             interpret=interpret)
-            parts = [allvals[s: s + n] for s, n in plan.dense_pages]
-            values = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-            return None, values
         except Exception as e:
             _pallas_fallback(e)  # degrade to unfused unpack + gather below
             use_pk = False
+            allvals = None
+        if allvals is not None:
+            parts = [allvals[s: s + n] for s, n in plan.dense_pages]
+            values = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            return None, values
     try:
         indices = _dense_unpack_pages(dense_buf, len(plan.dense), total, w,
                                       pages, use_pk, interpret)

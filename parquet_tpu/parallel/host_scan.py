@@ -25,6 +25,10 @@ __all__ = ["scan_filtered", "scan_filtered_device"]
 
 from ..utils.pool import shared_pool as _pool
 
+# decoded_scan: spans between survivor-count syncs (bounds device residency
+# at ~_SYNC_EVERY spans of uncompacted output while amortizing the RTT)
+_SYNC_EVERY = 8
+
 
 def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                   columns: Optional[Sequence[str]] = None,
@@ -273,6 +277,7 @@ def decoded_scan(state) -> Dict[str, object]:
     dictionaries rebased into one; nullable columns wrap their form in a
     ``(form, validity)`` tuple.
     """
+    import jax
     import jax.numpy as jnp
 
     from ..format.enums import Type
@@ -283,13 +288,38 @@ def decoded_scan(state) -> Dict[str, object]:
     parts: Dict[str, List] = {c: [] for c in out_cols}
     vparts: Dict[str, List] = {c: [] for c in out_cols}
     any_valid = {c: False for c in out_cols}
+    # Phase A — dispatch with (almost) no syncs: per span, survivors are
+    # compacted to a prefix with one stable argsort of the predicate mask
+    # (device-shape-static; no data-dependent host round-trip per span).
+    # Counts are synced in batches of _SYNC_EVERY spans so device residency
+    # stays bounded by a few spans' worth of uncompacted output, not the
+    # whole scanned region.
+    counts: List = []
+    flushed = 0
+
+    def _flush(upto: int) -> None:
+        nonlocal flushed
+        if upto <= flushed:
+            return
+        ks = [int(k) for k in np.asarray(
+            jax.block_until_ready(jnp.stack(counts[flushed:upto])))]
+        for si, k in zip(range(flushed, upto), ks):
+            for c in out_cols:
+                p = parts[c][si]
+                parts[c][si] = ((p[0], p[1][:k]) if isinstance(p, tuple)
+                                else p[:k])
+                if vparts[c][si] is not None:
+                    vparts[c][si] = vparts[c][si][:k]
+        flushed = upto
+
     for plan, per_col in state["spans"]:
         chunk, dplan, staged, trim = per_col[path]
         key = dr.decode_staged(chunk.leaf, Type(chunk.meta.type), dplan, staged)
         n_rows = plan.row_count
         no_nulls = dplan.total_values == dplan.total_slots
         mask = _key_mask_device(chunk.leaf, key, lo, hi, trim, n_rows, no_nulls)
-        idx = jnp.asarray(np.flatnonzero(np.asarray(mask)))
+        perm = jnp.argsort(~mask, stable=True)  # survivors first, in order
+        counts.append(jnp.sum(mask.astype(jnp.int32)))
         for c in out_cols:
             chunk_c, dplan_c, staged_c, trim_c = per_col[c]
             col = dr.decode_staged(chunk_c.leaf, Type(chunk_c.meta.type),
@@ -299,14 +329,18 @@ def decoded_scan(state) -> Dict[str, object]:
                 no_nulls=dplan_c.total_values == dplan_c.total_slots)
             if isinstance(vals, tuple):  # dictionary form: gather indices
                 dictionary, indices = vals
-                parts[c].append((dictionary, jnp.take(indices, idx, axis=0)))
+                parts[c].append((dictionary, jnp.take(indices, perm, axis=0)))
             else:
-                parts[c].append(jnp.take(vals, idx, axis=0))
+                parts[c].append(jnp.take(vals, perm, axis=0))
             if valid is not None:
                 any_valid[c] = True
-                vparts[c].append(jnp.take(valid, idx, axis=0))
+                vparts[c].append(jnp.take(valid, perm, axis=0))
             else:
                 vparts[c].append(None)
+        if len(counts) - flushed >= _SYNC_EVERY:
+            _flush(len(counts))
+    # Phase B — sync any remaining counts, then cheap device slices.
+    _flush(len(counts))
     out: Dict[str, object] = {}
     for c in out_cols:
         if not parts[c]:

@@ -1,0 +1,96 @@
+"""Cross-implementation interaction matrix (SURVEY.md §4.3 golden interop).
+
+Individual features are covered by test_reader/test_writer; this sweeps the
+*combinations* (codec x data-page version x nullability x nesting x
+encoding) in both directions against pyarrow, host and device read paths,
+on one shared random dataset per cell.
+"""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import ParquetFile, WriterOptions, write_table
+
+
+def _data(rng, nested: bool, nullable: bool, n: int = 3000):
+    ints = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    floats = rng.random(n)
+    strs = np.array([f"v{i % 37:02d}" for i in range(n)])
+    if nullable:
+        m = rng.random(n) < 0.1
+        ints_a = pa.array([None if b else int(v) for b, v in zip(m, ints)],
+                          pa.int64())
+        floats_a = pa.array([None if b else float(v) for b, v in zip(m, floats)],
+                            pa.float64())
+        strs_a = pa.array([None if b else s for b, s in zip(m, strs)])
+    else:
+        ints_a, floats_a, strs_a = pa.array(ints), pa.array(floats), pa.array(strs)
+    cols = {"i": ints_a, "f": floats_a, "s": strs_a}
+    if nested:
+        lens = rng.integers(0, 5, n)
+        offs = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offs[1:])
+        vals = rng.integers(0, 1 << 30, int(lens.sum())).astype(np.int64)
+        mask = rng.random(n) < 0.05 if nullable else np.zeros(n, bool)
+        cols["xs"] = pa.ListArray.from_arrays(pa.array(offs),
+                                              pa.array(vals),
+                                              mask=pa.array(mask))
+    return pa.table(cols)
+
+
+def _assert_tables_equal(got: pa.Table, want: pa.Table):
+    for c in want.column_names:
+        assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+
+
+@pytest.mark.parametrize("codec", ["none", "snappy", "zstd", "gzip", "lz4",
+                                   "brotli"])
+@pytest.mark.parametrize("dpv", [1, 2])
+@pytest.mark.parametrize("nested,nullable", [(False, False), (False, True),
+                                             (True, True)])
+def test_pyarrow_to_ours_matrix(codec, dpv, nested, nullable, rng):
+    t = _data(rng, nested, nullable)
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression=codec if codec != "none" else "NONE",
+                   use_dictionary=True, data_page_version=f"{dpv}.0",
+                   data_page_size=1 << 13)
+    raw = buf.getvalue()
+    _assert_tables_equal(ParquetFile(raw).read().to_arrow(), t)
+    _assert_tables_equal(ParquetFile(raw).read(device=True).to_arrow(), t)
+
+
+@pytest.mark.parametrize("codec", ["none", "snappy", "zstd", "gzip", "lz4",
+                                   "brotli"])
+@pytest.mark.parametrize("dpv", [1, 2])
+@pytest.mark.parametrize("nested,nullable", [(False, False), (True, True)])
+def test_ours_to_pyarrow_matrix(codec, dpv, nested, nullable, rng):
+    t = _data(rng, nested, nullable)
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(compression=codec, data_page_version=dpv,
+                                      data_page_size=1 << 13))
+    raw = buf.getvalue()
+    _assert_tables_equal(pq.read_table(io.BytesIO(raw)), t)
+    # and back through our own host reader for the same cell
+    _assert_tables_equal(ParquetFile(raw).read().to_arrow(), t)
+
+
+@pytest.mark.parametrize("encoding", ["DELTA_BINARY_PACKED", "BYTE_STREAM_SPLIT"])
+@pytest.mark.parametrize("codec", ["snappy", "zstd"])
+def test_encoding_codec_interaction(encoding, codec, rng):
+    n = 4000
+    if encoding == "BYTE_STREAM_SPLIT":
+        t = pa.table({"x": pa.array(rng.random(n).astype(np.float32))})
+        col = "x"
+    else:
+        t = pa.table({"x": pa.array(np.cumsum(rng.integers(0, 100, n)).astype(np.int64))})
+        col = "x"
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression=codec, use_dictionary=False,
+                   column_encoding={col: encoding}, data_page_size=1 << 12)
+    raw = buf.getvalue()
+    _assert_tables_equal(ParquetFile(raw).read().to_arrow(), t)
+    _assert_tables_equal(ParquetFile(raw).read(device=True).to_arrow(), t)
