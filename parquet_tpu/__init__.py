@@ -46,7 +46,7 @@ from .typed import (TypedReader, TypedWriter, read_objects, read_pytree,
                     schema_of, write_objects)
 from .rows import (Row, RowBuilder, Value, copy_rows, deconstruct, read_rows,
                    reconstruct, write_rows)
-from .utils.printer import print_file, print_schema
+from .utils.printer import print_file, print_pages, print_schema
 from .utils.debug import counters
 
 __version__ = "0.1.0"

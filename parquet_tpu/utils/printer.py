@@ -18,10 +18,16 @@ def print_schema(schema, file=None) -> str:
 
 
 def print_file(pf, file=None) -> str:
-    """Summary of a ParquetFile: schema + per-row-group chunk table."""
+    """Summary of a ParquetFile: schema + per-row-group chunk table, with
+    index/bloom presence flags (parquet-tools ``meta`` style)."""
     lines = [repr(pf.schema), ""]
     lines.append(f"num_rows: {pf.num_rows}")
     lines.append(f"created_by: {pf.created_by}")
+    kv = pf.key_value_metadata() if hasattr(pf, "key_value_metadata") else None
+    if kv:
+        lines.append("key_value_metadata:")
+        for k, v in kv.items():
+            lines.append(f"  {k} = {v!r}")
     for rg in pf.row_groups:
         lines.append(f"row group {rg.index}: {rg.num_rows} rows")
         for i, chunk in enumerate(rg.rg.columns):
@@ -40,12 +46,53 @@ def print_file(pf, file=None) -> str:
                         st = f" min={ts.min_value!r} max={ts.max_value!r}"
                     if ts.null_count is not None:
                         st += f" nulls={ts.null_count}"
+            flags = []
+            if getattr(chunk, "column_index_offset", None):
+                flags.append("colidx")
+            if getattr(chunk, "offset_index_offset", None):
+                flags.append("offidx")
+            if getattr(m, "bloom_filter_offset", None):
+                flags.append("bloom")
+            fl = f" ({','.join(flags)})" if flags else ""
             lines.append(
                 f"  {'.'.join(m.path_in_schema or [])}: {Type(m.type).name} "
                 f"{CompressionCodec(m.codec).name} [{encs}] "
                 f"values={m.num_values} "
                 f"compressed={m.total_compressed_size} "
-                f"uncompressed={m.total_uncompressed_size}{st}")
+                f"uncompressed={m.total_uncompressed_size}{st}{fl}")
+    out = "\n".join(lines)
+    if file is not None:
+        print(out, file=file)
+    return out
+
+
+def print_pages(pf, rg_index: int = 0, column: int = 0, file=None) -> str:
+    """Page-level dump of one column chunk (parquet-tools ``dump`` analog):
+    per-page type, encoding, value count, and byte sizes."""
+    from ..format.enums import PageType
+
+    path = pf.schema.leaves[column].dotted_path  # display label only
+    reader = pf.row_group(rg_index).column(column)
+    lines = [f"row group {rg_index}, column {path!r}:"]
+    for i, page in enumerate(reader.pages()):
+        h = page.header
+        pt = PageType(h.type).name
+        if h.data_page_header is not None:
+            dph = h.data_page_header
+            detail = (f"values={dph.num_values} "
+                      f"enc={Encoding(dph.encoding).name}")
+        elif h.data_page_header_v2 is not None:
+            d2 = h.data_page_header_v2
+            detail = (f"values={d2.num_values} rows={d2.num_rows} "
+                      f"nulls={d2.num_nulls} enc={Encoding(d2.encoding).name}")
+        elif h.dictionary_page_header is not None:
+            dh = h.dictionary_page_header
+            detail = f"entries={dh.num_values}"
+        else:
+            detail = ""
+        lines.append(f"  page {i}: {pt} {detail} "
+                     f"compressed={h.compressed_page_size} "
+                     f"uncompressed={h.uncompressed_page_size}")
     out = "\n".join(lines)
     if file is not None:
         print(out, file=file)

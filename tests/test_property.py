@@ -244,3 +244,31 @@ def test_convert_widening_round_trip_property(pair, data):
     got = pq.read_table(io.BytesIO(out.getvalue())).column("x")
     want = src.column("x").cast(dst_t)
     assert got.combine_chunks().equals(want.combine_chunks())
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(data=st.data())
+def test_null_list_spans_roundtrip_any_page_size(data):
+    """Arrow ListArrays whose NULL rows still span child values (legal in
+    arrow, no parquet slots) must round-trip both directions at any page
+    size (regression: spanned values shifted all later lists)."""
+    n = data.draw(st.integers(1, 120))
+    page = data.draw(st.sampled_from([1 << 6, 1 << 9, 1 << 20]))
+    rng_seed = data.draw(st.integers(0, 2**31 - 1))
+    rng = np.random.default_rng(rng_seed)
+    lens = rng.integers(0, 5, n)
+    offs = np.zeros(n + 1, np.int32)
+    np.cumsum(lens, out=offs[1:])
+    vals = rng.integers(-(1 << 50), 1 << 50, int(lens.sum())).astype(np.int64)
+    mask = rng.random(n) < 0.25  # null rows KEEP their offset spans
+    arr = pa.ListArray.from_arrays(pa.array(offs), pa.array(vals),
+                                   mask=pa.array(mask))
+    t = pa.table({"xs": arr})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(compression="none",
+                                      data_page_size=page))
+    raw = buf.getvalue()
+    want = t.column("xs").to_pylist()
+    assert pq.read_table(io.BytesIO(raw)).column("xs").to_pylist() == want
+    assert ParquetFile(raw).read().to_arrow().column("xs").to_pylist() == want

@@ -432,3 +432,21 @@ def test_retrying_source_recovers_transient_errors(rng):
     exhausted = Flaky(raw, fail_times=100)
     with _pytest.raises(OSError):
         ParquetFile(RetryingSource(exhausted, retries=2, backoff_s=0.001))
+
+
+def test_print_file_and_pages_flags(rng):
+    """print_file surfaces index/bloom flags + kv metadata; print_pages dumps
+    per-page headers (print.go / parquet-tools parity)."""
+    import parquet_tpu as ptq
+
+    t = pa.table({"a": pa.array(np.arange(5000, dtype=np.int64)),
+                  "s": pa.array([f"x{i % 9}" for i in range(5000)])})
+    buf = io.BytesIO()
+    ptq.write_table(t, buf, ptq.WriterOptions(
+        compression="snappy", data_page_size=1 << 12,
+        bloom_filters={"s": 10}, key_value_metadata={"who": "t"}))
+    pf = ptq.ParquetFile(buf.getvalue())
+    out = ptq.print_file(pf)
+    assert "colidx" in out and "bloom" in out and "who = 't'" in out
+    pg = ptq.print_pages(pf, 0, 1)
+    assert "DICTIONARY_PAGE" in pg and "DATA_PAGE" in pg and "values=" in pg
