@@ -382,7 +382,8 @@ class ParquetWriter:
         if opts.write_page_index and ci_mins:
             ci = md.ColumnIndex(
                 null_pages=ci_nulls, min_values=ci_mins, max_values=ci_maxs,
-                boundary_order=int(_boundary_order(ci_mins, ci_maxs, leaf)),
+                boundary_order=int(_boundary_order(ci_mins, ci_maxs, leaf,
+                                                   ci_nulls)),
                 null_counts=ci_null_counts)
             oi = md.OffsetIndex(page_locations=page_locs)
         elif opts.write_page_index:
@@ -830,10 +831,16 @@ def _min_max(leaf: Leaf, data: ColumnData, v0: int, v1: int):
             compare.encode_order_value(mx, leaf))
 
 
-def _boundary_order(mins: List[bytes], maxs: List[bytes], leaf: Leaf):
+def _boundary_order(mins: List[bytes], maxs: List[bytes], leaf: Leaf,
+                    null_pages: Optional[List[bool]] = None):
     from ..format.enums import BoundaryOrder
     from .statistics import decode_stat_value
 
+    if null_pages is not None:
+        # all-null pages carry placeholder min/max (null_pages flags them);
+        # the ordering is defined over the remaining pages only
+        mins = [m for m, np_ in zip(mins, null_pages) if not np_]
+        maxs = [m for m, np_ in zip(maxs, null_pages) if not np_]
     if len(mins) <= 1:
         return BoundaryOrder.ASCENDING
     dmins = [decode_stat_value(m, leaf) for m in mins]

@@ -82,6 +82,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _i64p, _i32p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             _i64p_w, _u8p_w, _u8p_w, _i64p_w]
+        lib.pq_delta_prescan.restype = ctypes.c_int64
+        lib.pq_delta_prescan.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _i64p_w, _i64p_w,
+            np.ctypeslib.ndpointer(np.int32, flags=("C_CONTIGUOUS", "WRITEABLE")),
+            _i64p_w, ctypes.c_int64]
         lib.pq_pack_bits.restype = ctypes.c_int64
         lib.pq_pack_bits.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
                                      _u8p_w]
@@ -195,6 +200,47 @@ def assemble_list_runs(buf: np.ndarray, def_tables: tuple, rep_tables: tuple,
     ninst, nelem = int(counts[0]), int(counts[1])
     return (offsets[: ninst + 1].copy(), lvalid[:ninst].astype(bool),
             leaf_valid[:nelem].astype(bool))
+
+
+def delta_prescan(data: np.ndarray, pos: int = 0):
+    """Miniblock table of one DELTA_BINARY_PACKED stream, or None when the
+    lib is unavailable / the stream is malformed (caller uses the Python
+    scanner, which raises precise errors)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data)
+    header = np.empty(4, np.int64)
+    # exact miniblock bound from the stream header (4 uvarints, cheap):
+    # w=0 miniblocks occupy no payload, so a data-length bound would be wrong
+    from ..ops import ref as _ref
+
+    try:
+        bs, p = _ref.read_uvarint(data, pos)
+        nmb, p = _ref.read_uvarint(data, p)
+        total, _ = _ref.read_uvarint(data, p)
+    except Exception:
+        return None
+    if nmb == 0 or bs == 0 or bs % nmb:
+        return None
+    vpm = bs // nmb
+    if vpm == 0:
+        return None
+    # each miniblock consumes one width byte from the stream, so the count
+    # can never exceed the remaining bytes — bounds np.empty against absurd
+    # untrusted `total` values (header bytes are attacker-controlled)
+    cap = min(total // vpm + nmb + 2, len(data) - pos + 2)
+    offsets = np.empty(cap, np.int64)
+    widths = np.empty(cap, np.int32)
+    mins = np.empty(cap, np.int64)
+    k = lib.pq_delta_prescan(data.ctypes.data if len(data) else None,
+                             len(data), pos, header, offsets, widths, mins,
+                             cap)
+    if k < 0:
+        return None
+    return (int(header[0]), int(header[1]), int(header[2]),
+            offsets[:k].copy(), widths[:k].copy(), mins[:k].copy(),
+            int(header[3]))
 
 
 def pack_bits(values: np.ndarray, bit_width: int) -> Optional[bytes]:

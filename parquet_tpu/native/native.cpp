@@ -289,6 +289,68 @@ int64_t pq_pack_bits(const int64_t* vals, int64_t n, int32_t w, uint8_t* out) {
 }
 
 // ---------------------------------------------------------------------------
+// DELTA_BINARY_PACKED miniblock pre-scan (host half of the delta split):
+// walks uvarint headers once, O(miniblocks).  header_out = {first, total,
+// vpm, end_pos}; returns miniblock count, or -1 on truncation/overflow
+// (caller falls back to the Python scanner).
+// ---------------------------------------------------------------------------
+int64_t pq_delta_prescan(const uint8_t* data, int64_t size, int64_t pos,
+                         int64_t* header_out, int64_t* offsets,
+                         int32_t* widths, int64_t* mins, int64_t cap) {
+  const auto uvarint = [&](int64_t& p, uint64_t& v) -> bool {
+    v = 0;
+    int sh = 0;
+    while (true) {
+      if (p >= size || sh > 63) return false;
+      const uint8_t b = data[p++];
+      v |= (uint64_t)(b & 0x7F) << sh;
+      if (!(b & 0x80)) return true;
+      sh += 7;
+    }
+  };
+  const auto unzigzag = [](uint64_t r) {
+    return (int64_t)(r >> 1) ^ -(int64_t)(r & 1);
+  };
+  uint64_t bs, nmb, total, fraw;
+  if (!uvarint(pos, bs) || !uvarint(pos, nmb) || !uvarint(pos, total) ||
+      !uvarint(pos, fraw))
+    return -1;
+  // header values are untrusted file bytes: reject shapes whose payload
+  // arithmetic could overflow or never advance (bs=0 loops; vpm*w*... must
+  // stay far inside int64; a real vpm is <= a few hundred)
+  if (nmb == 0 || bs == 0 || bs % nmb || bs > (1u << 30)) return -1;
+  const int64_t vpm = (int64_t)(bs / nmb);
+  if (vpm == 0) return -1;
+  header_out[0] = unzigzag(fraw);
+  header_out[1] = (int64_t)total;
+  header_out[2] = vpm;
+  int64_t got = 1, k = 0;
+  while (got < (int64_t)total) {
+    uint64_t mdr;
+    if (!uvarint(pos, mdr)) return -1;
+    const int64_t mind = unzigzag(mdr);
+    if (pos + (int64_t)nmb > size) return -1;
+    const uint8_t* wb = data + pos;
+    pos += (int64_t)nmb;
+    for (uint64_t m = 0; m < nmb && got < (int64_t)total; ++m) {
+      if (k >= cap) return -1;
+      const int32_t w = wb[m];
+      if (w > 64) return -1;
+      offsets[k] = pos * 8;
+      widths[k] = w;
+      mins[k] = mind;
+      pos += vpm * w / 8;  // bounded: vpm <= 2^30, w <= 64
+      if (pos < 0 || pos > size + (int64_t)(bs * 8)) return -1;
+      ++k;
+      const int64_t rem = (int64_t)total - got;
+      got += rem < vpm ? rem : vpm;
+    }
+  }
+  header_out[3] = pos;
+  return k;
+}
+
+// ---------------------------------------------------------------------------
 // Fixed-width dictionary build (hashprobe analog for INT32/INT64/FLOAT/DOUBLE
 // viewed as int64 bits): open-addressing first-occurrence dedup.
 // Returns unique count, or -1 when max_unique would be exceeded.

@@ -256,14 +256,26 @@ def delta_prescan(data: np.ndarray, pos: int = 0):
     """Host pre-scan of a DELTA_BINARY_PACKED stream → device metadata.
 
     Returns (first_value, total, vpm, mb_bit_offsets, mb_widths,
-    mb_min_deltas, end_pos).  O(miniblocks), not O(values)."""
+    mb_min_deltas, end_pos).  O(miniblocks), not O(values).  Routes through
+    the C++ shim (one uvarint walk); this Python body is the oracle/fallback
+    and the precise-error path for malformed streams."""
     from . import ref
+    from .. import native
+
+    nat = native.delta_prescan(data, pos)
+    if nat is not None:
+        first, total, vpm, offsets, widths, mins, end = nat
+        return (first, total, vpm, offsets, widths, mins, end)
 
     block_size, pos = ref.read_uvarint(data, pos)
     n_miniblocks, pos = ref.read_uvarint(data, pos)
     total, pos = ref.read_uvarint(data, pos)
     first_raw, pos = ref.read_uvarint(data, pos)
     first = ref.unzigzag(first_raw)
+    if n_miniblocks == 0 or block_size == 0 or block_size % n_miniblocks:
+        raise ValueError(
+            f"malformed DELTA_BINARY_PACKED header: block_size={block_size}, "
+            f"miniblocks={n_miniblocks}")
     vpm = block_size // n_miniblocks
     offsets, widths, mins = [], [], []
     got = 1

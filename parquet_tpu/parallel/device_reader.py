@@ -568,7 +568,7 @@ def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
     n_mb = len(widths_all)
     if n_mb == 0 or len(uw) > 8 or int(uw[-1]) > 32:
         return False
-    vals_np = np.frombuffer(bytes(plan.values), np.uint8)
+    vals_np = np.frombuffer(plan.values, np.uint8)
     boffs = np.concatenate(plan.d_mb_offs) // 8
     streams, groups = [], []
     for w in uw:
@@ -740,7 +740,7 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
     lev_dbuf = None
     if stage_levels and len(plan.levels):
         lev_dbuf = jax.device_put(dev.pad_to_bucket(
-            np.frombuffer(bytes(plan.levels), np.uint8)))
+            np.frombuffer(plan.levels, np.uint8)))
         counters.inc("bytes_h2d", len(plan.levels))
     dense_route = (plan.value_kind == "dict" and plan.dense_ok
                    and plan.dense_pages and _dense_mode() != "off")
@@ -752,12 +752,12 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         # staged even when empty (all-null chunks have no value bytes): the
         # kernels need a real buffer operand to slice [:0] from
         val_dbuf = jax.device_put(dev.pad_to_bucket(
-            np.frombuffer(bytes(plan.values), np.uint8)))
+            np.frombuffer(plan.values, np.uint8)))
         counters.inc("bytes_h2d", len(plan.values))
     if dense_route:
         # compacted single-width index stream replaces the raw bodies
         meta["dense"] = jax.device_put(dev.pad_to_bucket(
-            np.frombuffer(bytes(plan.dense), np.uint8), extra=4))
+            np.frombuffer(plan.dense, np.uint8), extra=4))
         counters.inc("bytes_h2d", len(plan.dense))
     if plan.value_kind == "delta":
         if not delta_dense:
@@ -947,7 +947,7 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             device_asm = dev.assemble_single_list(
                 d_dev, r_dev, infos[0].def_level, max_def)
         else:
-            lev_host = np.frombuffer(bytes(plan.levels), np.uint8)
+            lev_host = np.frombuffer(plan.levels, np.uint8)
             if (len(infos) == 1 and plan.def_runs.total and plan.rep_runs.total
                     and plan.def_runs.total == plan.rep_runs.total
                     and not plan.host_def):
@@ -979,7 +979,7 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             # validity from it (round 1 expanded on device AND host)
             if plan.def_runs.total:
                 def_host = plan.def_runs.expand_host(
-                    np.frombuffer(bytes(plan.levels), np.uint8))
+                    np.frombuffer(plan.levels, np.uint8))
             else:
                 def_host = np.concatenate(plan.host_def).astype(np.int32)
             validity = jax.device_put(def_host == max_def)

@@ -158,3 +158,30 @@ def test_dict_build_fixed_matches_unique(lib, rng):
     # overflow: all-distinct column refuses dictionary
     vals = np.arange(10000, dtype=np.int64)
     assert native.dict_build_fixed(vals, 5016) == "overflow"
+
+
+def test_delta_prescan_malformed_streams_fail_cleanly(lib):
+    """Attacker-controlled DELTA_BINARY_PACKED headers must raise/refuse,
+    never segfault, hang, or attempt absurd allocations (review r2 PoCs)."""
+    from parquet_tpu.ops import device as dev
+    from parquet_tpu.ops.ref import write_uvarint
+
+    def stream(bs, nmb, total, first=0, widths=b""):
+        out = bytearray()
+        for v in (bs, nmb, total, first):
+            write_uvarint(out, v)
+        out += b"\x00"  # min_delta for the first block
+        out += widths
+        out += b"\x00" * 16
+        return np.frombuffer(bytes(out), np.uint8)
+
+    # int64-overflow driver: huge block_size with wide miniblocks
+    for data in (
+        stream(1 << 59, 1, (1 << 59) + 2, widths=bytes([31])),
+        stream(4, 4, 1 << 45, widths=bytes([1, 1, 1, 1])),  # absurd total
+        stream(0, 5, 100, widths=bytes([1] * 5)),           # bs=0 (vpm=0)
+        stream(5, 4, 100, widths=bytes([1] * 4)),           # bs % nmb != 0
+    ):
+        assert native.delta_prescan(data, 0) is None
+        with pytest.raises(Exception):
+            dev.delta_prescan(data, 0)
