@@ -87,6 +87,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _i64p_w, _i64p_w,
             np.ctypeslib.ndpointer(np.int32, flags=("C_CONTIGUOUS", "WRITEABLE")),
             _i64p_w, ctypes.c_int64]
+        lib.pq_encode_rle.restype = ctypes.c_int64
+        lib.pq_encode_rle.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
+                                      ctypes.c_int32, _u8p_w, ctypes.c_int64]
         lib.pq_pack_bits.restype = ctypes.c_int64
         lib.pq_pack_bits.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
                                      _u8p_w]
@@ -241,6 +244,24 @@ def delta_prescan(data: np.ndarray, pos: int = 0):
     return (int(header[0]), int(header[1]), int(header[2]),
             offsets[:k].copy(), widths[:k].copy(), mins[:k].copy(),
             int(header[3]))
+
+
+def encode_rle(values: np.ndarray, bit_width: int,
+               min_repeat: int = 8) -> Optional[bytes]:
+    """Hybrid RLE/bit-packed stream, byte-identical to ref.encode_rle, or
+    None when unavailable / the width is unsupported."""
+    lib = get_lib()
+    if lib is None or bit_width > 56 or len(values) == 0:
+        return None
+    values = np.ascontiguousarray(values, np.int64)
+    n = len(values)
+    vbytes = (bit_width + 7) // 8
+    cap = 64 + (n + 8) * bit_width // 8 + (n // 8 + 2) * (10 + vbytes)
+    out = np.empty(cap, np.uint8)
+    wrote = lib.pq_encode_rle(values, n, bit_width, min_repeat, out, cap)
+    if wrote < 0:
+        return None
+    return out[:wrote].tobytes()
 
 
 def pack_bits(values: np.ndarray, bit_width: int) -> Optional[bytes]:

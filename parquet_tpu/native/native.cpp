@@ -289,6 +289,75 @@ int64_t pq_pack_bits(const int64_t* vals, int64_t n, int32_t w, uint8_t* out) {
 }
 
 // ---------------------------------------------------------------------------
+// RLE/bit-packed hybrid encoder (write-path twin of pq_scan_rle_runs),
+// byte-identical to the Python oracle: runs >= max(min_repeat, 8) become RLE
+// runs (after donating alignment values to the preceding packed span);
+// everything between becomes one bit-packed span of whole 8-value groups.
+// Returns bytes written, -1 on insufficient cap, -2 for unsupported width.
+// ---------------------------------------------------------------------------
+int64_t pq_encode_rle(const int64_t* vals, int64_t n, int32_t w,
+                      int32_t min_repeat, uint8_t* out, int64_t cap) {
+  if (w <= 0 || w > 56 || n == 0) return -2;
+  int64_t o = 0;
+  const auto put_uvarint = [&](uint64_t v) -> bool {
+    while (v >= 0x80) {
+      if (o >= cap) return false;
+      out[o++] = (uint8_t)(v | 0x80);
+      v >>= 7;
+    }
+    if (o >= cap) return false;
+    out[o++] = (uint8_t)v;
+    return true;
+  };
+  const int vbytes = (w + 7) / 8;
+  const uint64_t vmask = (vbytes >= 8) ? ~0ull : ((1ull << (8 * vbytes)) - 1);
+  const uint64_t mask = (1ull << w) - 1;
+  const int64_t thresh = min_repeat < 8 ? 8 : min_repeat;
+  const auto emit_packed = [&](int64_t s, int64_t cnt) -> bool {
+    if (!cnt) return true;
+    const int64_t ngroups = (cnt + 7) / 8;
+    if (!put_uvarint(((uint64_t)ngroups << 1) | 1)) return false;
+    uint64_t acc = 0;
+    int nb = 0;
+    for (int64_t i = 0; i < ngroups * 8; ++i) {
+      const uint64_t v = (i < cnt) ? ((uint64_t)vals[s + i] & mask) : 0;
+      acc |= v << nb;
+      nb += w;
+      while (nb >= 8) {
+        if (o >= cap) return false;
+        out[o++] = (uint8_t)acc;
+        acc >>= 8;
+        nb -= 8;
+      }
+    }
+    return true;  // 8*w bits per group: nb always ends at 0
+  };
+  int64_t pos = 0, i = 0;
+  while (i < n) {
+    const int64_t v = vals[i];
+    int64_t j = i + 1;
+    while (j < n && vals[j] == v) ++j;
+    const int64_t len = j - i;
+    if (len >= thresh) {
+      const int64_t pad = (8 - ((i - pos) & 7)) & 7;
+      if (len - pad >= min_repeat) {
+        if (!emit_packed(pos, i + pad - pos)) return -1;
+        if (!put_uvarint((uint64_t)(len - pad) << 1)) return -1;
+        const uint64_t ev = (uint64_t)v & vmask;
+        for (int b = 0; b < vbytes; ++b) {
+          if (o >= cap) return -1;
+          out[o++] = (uint8_t)(ev >> (8 * b));
+        }
+        pos = j;
+      }
+    }
+    i = j;
+  }
+  if (!emit_packed(pos, n - pos)) return -1;
+  return o;
+}
+
+// ---------------------------------------------------------------------------
 // DELTA_BINARY_PACKED miniblock pre-scan (host half of the delta split):
 // walks uvarint headers once, O(miniblocks).  header_out = {first, total,
 // vpm, end_pos}; returns miniblock count, or -1 on truncation/overflow
@@ -303,6 +372,7 @@ int64_t pq_delta_prescan(const uint8_t* data, int64_t size, int64_t pos,
     while (true) {
       if (p >= size || sh > 63) return false;
       const uint8_t b = data[p++];
+      if (sh == 63 && (b & 0x7E)) return false;  // >= 2^64: reject, don't wrap
       v |= (uint64_t)(b & 0x7F) << sh;
       if (!(b & 0x80)) return true;
       sh += 7;
@@ -316,9 +386,11 @@ int64_t pq_delta_prescan(const uint8_t* data, int64_t size, int64_t pos,
       !uvarint(pos, fraw))
     return -1;
   // header values are untrusted file bytes: reject shapes whose payload
-  // arithmetic could overflow or never advance (bs=0 loops; vpm*w*... must
-  // stay far inside int64; a real vpm is <= a few hundred)
+  // arithmetic could overflow or never advance (bs=0 loops; a total with
+  // bit 63 set casts negative and would skip the scan loop as "success";
+  // vpm*w*... must stay far inside int64; a real vpm is <= a few hundred)
   if (nmb == 0 || bs == 0 || bs % nmb || bs > (1u << 30)) return -1;
+  if (total >> 63) return -1;
   const int64_t vpm = (int64_t)(bs / nmb);
   if (vpm == 0) return -1;
   header_out[0] = unzigzag(fraw);

@@ -340,7 +340,8 @@ def decode_rle_dict_indices(data, num_values: int, pos: int = 0) -> np.ndarray:
     return decode_rle(data, num_values, bit_width, pos + 1)
 
 
-def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8) -> bytes:
+def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8,
+               _native: bool = True) -> bytes:
     """Encode the hybrid stream (no prefix).
 
     Invariant (required by the format): a bit-packed run encodes exactly
@@ -354,6 +355,12 @@ def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8) -> bytes
     out = bytearray()
     if n == 0 or bit_width == 0:
         return bytes(out)
+    if _native:
+        from .. import native
+
+        nat = native.encode_rle(values, bit_width, min_repeat)
+        if nat is not None:
+            return nat
     vbytes = (bit_width + 7) // 8
     vmask = (1 << (8 * vbytes)) - 1
     # run-length decomposition (vectorized)

@@ -185,3 +185,56 @@ def test_delta_prescan_malformed_streams_fail_cleanly(lib):
         assert native.delta_prescan(data, 0) is None
         with pytest.raises(Exception):
             dev.delta_prescan(data, 0)
+
+
+def test_encode_rle_native_byte_identical_to_oracle(lib, rng):
+    """pq_encode_rle mirrors the Python encoder's run/span decisions exactly,
+    so the streams are byte-identical (and decode round-trips)."""
+    for w in (1, 2, 3, 7, 12, 15, 20, 33, 56):
+        for style in range(4):
+            n = int(rng.integers(1, 4000))
+            hi = 1 << min(w, 62)
+            if style == 0:  # long runs -> RLE-heavy
+                v = np.repeat(rng.integers(0, hi, 30),
+                              rng.integers(1, 200, 30))[:n]
+                if len(v) < n:
+                    v = np.pad(v, (0, n - len(v)))
+            elif style == 1:  # unique -> all bit-packed
+                v = rng.integers(0, hi, n)
+            elif style == 2:  # short runs around the min_repeat threshold
+                v = np.repeat(rng.integers(0, hi, n // 7 + 1), 7)[:n]
+            else:  # alternating run/noise
+                v = rng.integers(0, hi, n)
+                v[n // 3: 2 * n // 3] = v[n // 3] if n >= 3 else v[0]
+            v = v.astype(np.int64)
+            n = len(v)
+            got = native.encode_rle(v, w)
+            want = ref.encode_rle(v, w, _native=False)
+            assert got == want, f"w={w} style={style} n={n}"
+            np.testing.assert_array_equal(
+                ref.decode_rle(np.frombuffer(got, np.uint8), n, w), v)
+
+
+def test_delta_prescan_rejects_64bit_header_overflow(lib):
+    """uvarint values >= 2^63 in headers must be rejected, not wrapped
+    (a negative cast total previously returned 'success' with k=0)."""
+    import struct
+    from parquet_tpu.ops.ref import write_uvarint
+
+    def stream(bs_bytes, nmb, total_bytes):
+        out = bytearray()
+        out += bs_bytes
+        write_uvarint(out, nmb)
+        out += total_bytes
+        write_uvarint(out, 0)  # first value
+        out += b"\x00" * 16
+        return np.frombuffer(bytes(out), np.uint8)
+
+    uv = bytearray(); write_uvarint(uv, 4)
+    # total = 2^63 (10-byte uvarint)
+    t63 = bytes([0x80] * 9 + [0x01])
+    assert native.delta_prescan(stream(bytes(uv), 1, t63), 0) is None
+    # block_size = 2^64 + 64 (wraps to 64 if truncated)
+    bs_wrap = bytes([0xC0] + [0x80] * 8 + [0x02])
+    tv = bytearray(); write_uvarint(tv, 100)
+    assert native.delta_prescan(stream(bs_wrap, 1, bytes(tv)), 0) is None
