@@ -87,6 +87,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _i64p_w, _i64p_w,
             np.ctypeslib.ndpointer(np.int32, flags=("C_CONTIGUOUS", "WRITEABLE")),
             _i64p_w, ctypes.c_int64]
+        lib.pq_gather_ba.restype = ctypes.c_int64
+        lib.pq_gather_ba.argtypes = [
+            ctypes.c_void_p, _i64p, ctypes.c_int64, _i64p, ctypes.c_int64,
+            _i64p_w, ctypes.c_void_p]
         lib.pq_encode_rle.restype = ctypes.c_int64
         lib.pq_encode_rle.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
                                       ctypes.c_int32, _u8p_w, ctypes.c_int64]
@@ -244,6 +248,30 @@ def delta_prescan(data: np.ndarray, pos: int = 0):
     return (int(header[0]), int(header[1]), int(header[2]),
             offsets[:k].copy(), widths[:k].copy(), mins[:k].copy(),
             int(header[3]))
+
+
+def gather_ba(dvals: np.ndarray, doffs: np.ndarray, indices: np.ndarray):
+    """Dictionary gather for BYTE_ARRAY: (values, int64 offsets), or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    dvals = np.ascontiguousarray(dvals)
+    doffs = np.ascontiguousarray(doffs, np.int64)
+    indices = np.ascontiguousarray(indices, np.int64)
+    n = len(indices)
+    out_offs = np.empty(n + 1, np.int64)
+    total = lib.pq_gather_ba(dvals.ctypes.data if len(dvals) else None, doffs,
+                             len(doffs) - 1, indices, n, out_offs, None)
+    if total < 0:
+        # detected corruption, NOT unavailability: an out-of-range dictionary
+        # index must never fall back to numpy (whose fancy indexing would
+        # silently wrap negatives)
+        raise ValueError("dictionary index out of range")
+    out_vals = np.empty(max(total, 1), np.uint8)
+    lib.pq_gather_ba(dvals.ctypes.data if len(dvals) else None, doffs,
+                     len(doffs) - 1, indices, n, out_offs,
+                     out_vals.ctypes.data)
+    return out_vals[:total], out_offs
 
 
 def encode_rle(values: np.ndarray, bit_width: int,

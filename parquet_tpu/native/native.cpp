@@ -289,6 +289,33 @@ int64_t pq_pack_bits(const int64_t* vals, int64_t n, int32_t w, uint8_t* out) {
 }
 
 // ---------------------------------------------------------------------------
+// BYTE_ARRAY dictionary gather: indices -> concatenated value bytes +
+// offsets.  Two-call pattern: out_vals == null computes offsets and returns
+// the total byte count; second call memcpys the bytes.
+// ---------------------------------------------------------------------------
+int64_t pq_gather_ba(const uint8_t* dvals, const int64_t* doffs, int64_t ndict,
+                     const int64_t* indices, int64_t n, int64_t* out_offs,
+                     uint8_t* out_vals) {
+  int64_t total = 0;
+  if (!out_vals) {
+    out_offs[0] = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t d = indices[i];
+      if (d < 0 || d >= ndict) return -1;
+      total += doffs[d + 1] - doffs[d];
+      out_offs[i + 1] = total;
+    }
+    return total;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t d = indices[i];
+    std::memcpy(out_vals + out_offs[i], dvals + doffs[d],
+                (size_t)(doffs[d + 1] - doffs[d]));
+  }
+  return out_offs[n];
+}
+
+// ---------------------------------------------------------------------------
 // RLE/bit-packed hybrid encoder (write-path twin of pq_scan_rle_runs),
 // byte-identical to the Python oracle: runs >= max(min_repeat, 8) become RLE
 // runs (after donating alignment values to the preceding packed span);
@@ -653,6 +680,10 @@ int64_t pq_scan_rle_runs(const uint8_t* data, int64_t size, int64_t n,
       if (pos + vbytes > size) return -1;
       uint64_t value = 0;
       for (int j = 0; j < vbytes; j++) value |= (uint64_t)data[pos + j] << (8 * j);
+      // mask to the declared width: the padding bits of the vbytes payload
+      // are unspecified, and every consumer (incl. int32 expansion) must see
+      // the same value as the Python oracle
+      if (bit_width < 64) value &= (1ull << bit_width) - 1;
       pos += vbytes;
       kinds[k] = 0;
       counts[k] = count < remaining ? count : remaining;

@@ -278,6 +278,10 @@ def scan_rle_runs(data, num_values: int, bit_width: int, pos: int = 0):
             value = 0
             for k in range(vbytes):
                 value |= int(data[pos + k]) << (8 * k)
+            if bit_width < 64:
+                # padding bits of the vbytes payload are unspecified: mask so
+                # every consumer sees one value (C++ scanner does the same)
+                value &= (1 << bit_width) - 1
             pos += vbytes
             kinds.append(0)
             counts.append(min(count, remaining))
@@ -298,9 +302,21 @@ def decode_rle(data, num_values: int, bit_width: int, pos: int = 0) -> np.ndarra
     if bit_width == 0:
         return np.zeros(num_values, dtype=np.int64)
     kinds, counts, payloads, offsets, _ = scan_rle_runs(data, num_values, bit_width, pos)
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    from .. import native
+
+    if bit_width <= 31 and native.get_lib() is not None:
+        # values fit int32: one C expansion pass instead of a per-run loop
+        nat = native.expand_runs(buf, np.cumsum(counts).astype(np.int64),
+                                 kinds.astype(np.uint8),
+                                 payloads.astype(np.int64),
+                                 (offsets * 8).astype(np.int64),
+                                 np.full(len(kinds), bit_width, np.int32),
+                                 num_values)
+        if nat is not None:
+            return nat.astype(np.int64)
     out = np.empty(num_values, dtype=np.int64)
     w = 0
-    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
     for i in range(len(kinds)):
         c = int(counts[i])
         if kinds[i] == 0:
@@ -642,6 +658,15 @@ def gather_dictionary(dictionary, indices: np.ndarray):
     """dictionary: typed array or (values, offsets) pair; indices int64."""
     if isinstance(dictionary, tuple):
         dvals, doffs = dictionary
+        from .. import native
+
+        nat = native.gather_ba(dvals, doffs, indices)
+        if nat is not None:
+            return nat[0], nat[1].astype(np.int32)
+        indices = np.asarray(indices)
+        if len(indices) and (indices.min() < 0
+                             or indices.max() >= len(doffs) - 1):
+            raise ValueError("dictionary index out of range")
         lens = (doffs[1:] - doffs[:-1]).astype(np.int64)
         out_lens = lens[indices]
         out_offsets = np.empty(len(indices) + 1, dtype=np.int64)

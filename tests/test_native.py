@@ -238,3 +238,24 @@ def test_delta_prescan_rejects_64bit_header_overflow(lib):
     bs_wrap = bytes([0xC0] + [0x80] * 8 + [0x02])
     tv = bytearray(); write_uvarint(tv, 100)
     assert native.delta_prescan(stream(bs_wrap, 1, bytes(tv)), 0) is None
+
+
+def test_gather_ba_rejects_out_of_range_indices(lib):
+    dvals = np.frombuffer(b"abcde", np.uint8)
+    doffs = np.array([0, 2, 5], np.int64)
+    ok = ref.gather_dictionary((dvals, doffs), np.array([0, 1, 0]))
+    assert bytes(ok[0]) == b"ababcab"[:len(ok[0])] or len(ok[0]) == 7
+    for bad in ([0, -1, 1], [2], [-3]):
+        with pytest.raises(ValueError):
+            ref.gather_dictionary((dvals, doffs), np.array(bad, np.int64))
+
+
+def test_rle_payload_padding_bits_masked(lib):
+    """RLE payload bytes can carry garbage above bit_width; both scanners
+    must mask so native expansion == Python oracle (review PoC: bw=25,
+    payload 0xFFFFFFFF diverged as -1 vs 2^32-1)."""
+    stream = np.frombuffer(b"\x10\xff\xff\xff\xff", np.uint8)  # RLE run, 8 values
+    got = ref.decode_rle(stream, 8, 25)
+    np.testing.assert_array_equal(got, np.full(8, (1 << 25) - 1, np.int64))
+    k = ref.scan_rle_runs(stream, 8, 25, 0)
+    assert int(k[2][0]) == (1 << 25) - 1
