@@ -34,6 +34,7 @@ from ..schema.types import LogicalKind
 __all__ = [
     "is_unsigned", "decode_order_value", "encode_order_value", "normalize",
     "compare_func_of", "sort_key", "min_max",
+    "truncate_stat_min", "truncate_stat_max",
 ]
 
 
@@ -255,3 +256,24 @@ def min_max(leaf: Leaf, cd, v0: int, v1: int):
     if dense.dtype == object:
         return min(dense.tolist()), max(dense.tolist())
     return dense.min().item(), dense.max().item()
+
+
+def truncate_stat_min(raw: bytes, limit: int) -> bytes:
+    """Truncate a bytewise-ordered min to ``limit`` bytes: any prefix is
+    <= the full value in unsigned byte order (reference parity:
+    column-index size limiting, ``ColumnIndexSizeLimit``)."""
+    return raw if len(raw) <= limit else raw[:limit]
+
+
+def truncate_stat_max(raw: bytes, limit: int) -> Optional[bytes]:
+    """Shortest prefix, last byte incremented, that is >= the full value in
+    unsigned byte order — or None when no such prefix exists (all 0xFF:
+    caller keeps the untruncated value)."""
+    if len(raw) <= limit:
+        return raw
+    b = bytearray(raw[:limit])
+    for i in reversed(range(len(b))):
+        if b[i] != 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+    return None

@@ -58,12 +58,17 @@ class WriterOptions:
     sorting_columns: List[Tuple[str, bool, bool]] = dc_field(default_factory=list)
     # (path, descending, nulls_first) — recorded in row-group metadata
     column_encoding: Dict[str, Encoding] = dc_field(default_factory=dict)
+    # page-index min/max truncation for byte-ordered types (reference
+    # ColumnIndexSizeLimit; pyarrow's column_index_truncate_length). 0 = off.
+    column_index_truncate_length: int = 64
 
     def __post_init__(self):
         if self.row_group_size < 1:
             raise ValueError("row_group_size must be >= 1")
         if self.data_page_size < 1:
             raise ValueError("data_page_size must be >= 1")
+        if self.column_index_truncate_length < 0:
+            raise ValueError("column_index_truncate_length must be >= 0")
         if self.data_page_version not in (1, 2):
             raise ValueError("data_page_version must be 1 or 2")
 
@@ -337,6 +342,9 @@ class ParquetWriter:
         chunk metadata + page index."""
         opts = self.options
         leaf = enc.leaf
+        # deferred: algebra/__init__ imports back into io.writer (cycle)
+        from ..algebra.compare import truncate_stat_max, truncate_stat_min
+
         chunk_start = self._pos
         self._uncomp_acc = 0
         dict_page_offset = None
@@ -359,8 +367,18 @@ class ParquetWriter:
                 first_row_index=first_row))
             if pstat is not None:
                 ci_nulls.append(n_val_page == 0)
-                ci_mins.append(pstat.min_value or b"")
-                ci_maxs.append(pstat.max_value or b"")
+                mn, mx = pstat.min_value or b"", pstat.max_value or b""
+                lim = opts.column_index_truncate_length
+                if (lim and leaf.physical_type in (
+                        Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY)
+                        and leaf.logical_kind != LogicalKind.DECIMAL):
+                    # bytewise-ordered types only: decimals order by
+                    # two's-complement value, where a prefix is NOT a bound
+                    mn = truncate_stat_min(mn, lim)
+                    tmx = truncate_stat_max(mx, lim)
+                    mx = tmx if tmx is not None else mx
+                ci_mins.append(mn)
+                ci_maxs.append(mx)
                 ci_null_counts.append(pstat.null_count or 0)
             first_row += take_rows
 

@@ -430,3 +430,46 @@ def test_streaming_writes_keep_tail_buffered(rng):
     pf = ParquetFile(buf.getvalue())
     assert [rg.num_rows for rg in pf.row_groups] == [6000, 6000, 2000]
     assert pf.read()["x"].to_arrow().to_pylist() == list(range(7000)) * 2
+
+
+def test_column_index_truncation_long_strings(rng):
+    """Page-index min/max for long byte arrays truncate to the configured
+    limit (min = prefix, max = incremented prefix) and pushdown stays
+    correct+conservative (ColumnIndexSizeLimit parity)."""
+    import parquet_tpu as ptq
+    from parquet_tpu.io.search import pages_overlapping
+
+    long = ["p" * 200 + f"{i:04d}" for i in range(100)]
+    t = pa.table({"s": pa.array(sorted(long))})
+    buf = io.BytesIO()
+    ptq.write_table(t, buf, ptq.WriterOptions(
+        compression="none", data_page_size=1 << 10))
+    pf = ptq.ParquetFile(buf.getvalue())
+    chunk = pf.row_group(0).column("s")
+    ci = chunk.column_index()
+    assert ci is not None and len(ci.min_values) > 1
+    assert all(len(m) <= 64 for m in ci.min_values)
+    assert all(len(m) <= 65 for m in ci.max_values)
+    # truncated bounds bracket each page's true min/max (bytewise order)
+    from parquet_tpu.io.search import seek_pages
+    vals = sorted(long)
+    row = 0
+    for pg, (mn, mx) in enumerate(zip(ci.min_values, ci.max_values)):
+        locs = chunk.offset_index().page_locations
+        n_rows = ((locs[pg + 1].first_row_index if pg + 1 < len(locs)
+                   else len(vals)) - locs[pg].first_row_index)
+        page_vals = [v.encode() for v in vals[row: row + n_rows]]
+        row += n_rows
+        assert mn <= min(page_vals) and mx >= max(page_vals), pg
+    target = "p" * 200 + "0050"
+    pages = pages_overlapping(ci, chunk.leaf, target, target)
+    rows = pf.read().to_arrow().column("s").to_pylist()
+    assert target in rows
+    assert len(pages) >= 1  # the page holding the value always survives
+
+    # all-0xFF max cannot be incremented: full value is kept
+    t2 = pa.table({"b": pa.array([b"\xff" * 100, b"\x01"])})
+    b2 = io.BytesIO()
+    ptq.write_table(t2, b2, ptq.WriterOptions(compression="none"))
+    ci2 = ptq.ParquetFile(b2.getvalue()).row_group(0).column("b").column_index()
+    assert max(len(m) for m in ci2.max_values) == 100
