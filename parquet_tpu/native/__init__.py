@@ -320,10 +320,31 @@ def dict_build_fixed(vals: np.ndarray, max_unique: int):
         keys = np.ascontiguousarray(vals).view(np.int32).astype(np.int64)
     else:
         return None
-    indices = np.empty(len(keys), np.int64)
+    keys = np.ascontiguousarray(keys)
+    n = len(keys)
+    # Sample-based early bail: near-unique columns (the overflow case)
+    # otherwise pay a full hash pass just to discover they can't dictionary-
+    # encode.  Two windows — prefix AND middle — must BOTH be >= 7/8
+    # internally unique to predict overflow: data whose first occurrences
+    # cluster early (sorted keys, then repeats) shows repeats in the middle
+    # window and still gets its full build.  Heuristic only affects whether
+    # dictionary encoding is attempted, never correctness.
+    sample = 1 << 16
+    if n > 4 * sample and max_unique >= sample:
+        s_idx = np.empty(sample, np.int64)
+        s_uniq = np.empty(sample, np.int64)
+        thresh = sample * 7 // 8
+        nu_a = lib.pq_dict_build_i64(keys[:sample], sample, sample,
+                                     s_idx, s_uniq)
+        if nu_a >= thresh:
+            mid = n // 2
+            nu_b = lib.pq_dict_build_i64(keys[mid: mid + sample], sample,
+                                         sample, s_idx, s_uniq)
+            if nu_b >= thresh:
+                return "overflow"
+    indices = np.empty(n, np.int64)
     uniques = np.empty(max(max_unique, 1), np.int64)
-    nu = lib.pq_dict_build_i64(np.ascontiguousarray(keys), len(keys),
-                               max_unique, indices, uniques)
+    nu = lib.pq_dict_build_i64(keys, n, max_unique, indices, uniques)
     if nu < 0:
         return "overflow"
     uniq = uniques[:nu]

@@ -259,3 +259,18 @@ def test_rle_payload_padding_bits_masked(lib):
     np.testing.assert_array_equal(got, np.full(8, (1 << 25) - 1, np.int64))
     k = ref.scan_rle_runs(stream, 8, 25, 0)
     assert int(k[2][0]) == (1 << 25) - 1
+
+
+def test_dict_build_clustered_first_occurrences_still_encodes(lib, rng):
+    """Data whose unique values all appear in the prefix then repeat must
+    still dictionary-encode (the overflow bail samples prefix AND middle)."""
+    n = 1 << 19
+    uniq = rng.integers(0, 1 << 40, 1 << 16)
+    vals = np.concatenate([uniq, uniq[rng.integers(0, len(uniq), n - len(uniq))]])
+    out = native.dict_build_fixed(vals.astype(np.int64), n // 2 + 16)
+    assert out is not None and out != "overflow"
+    u, idx = out
+    np.testing.assert_array_equal(u[idx], vals)
+    # genuinely all-unique columns still bail
+    assert native.dict_build_fixed(
+        rng.permutation(np.arange(n, dtype=np.int64)), n // 2 + 16) == "overflow"

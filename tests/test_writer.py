@@ -451,7 +451,6 @@ def test_column_index_truncation_long_strings(rng):
     assert all(len(m) <= 64 for m in ci.min_values)
     assert all(len(m) <= 65 for m in ci.max_values)
     # truncated bounds bracket each page's true min/max (bytewise order)
-    from parquet_tpu.io.search import seek_pages
     vals = sorted(long)
     row = 0
     for pg, (mn, mx) in enumerate(zip(ci.min_values, ci.max_values)):
