@@ -1,0 +1,13 @@
+#!/bin/sh
+# Full verification matrix (SURVEY.md §4): the suite runs twice — native
+# C++ kernels and the pure-numpy oracles (the reference's purego dual-run) —
+# then the multi-chip sharding dry-runs on an 8-device CPU mesh.
+set -e
+cd "$(dirname "$0")/.."
+echo "=== pass 1: native kernels ==="
+python -m pytest tests/ -q
+echo "=== pass 2: PARQUET_TPU_NO_NATIVE=1 (numpy oracles) ==="
+PARQUET_TPU_NO_NATIVE=1 python -m pytest tests/ -q
+echo "=== multi-chip dryrun (8-device CPU mesh) ==="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "ALL CHECKS PASSED"
