@@ -21,7 +21,7 @@ import numpy as np
 from ..io.reader import ParquetFile
 from ..io.search import plan_scan, read_row_range
 
-__all__ = ["scan_filtered", "scan_filtered_device"]
+__all__ = ["scan_filtered", "scan_filtered_device", "scan_filtered_sharded"]
 
 from ..utils.pool import shared_pool as _pool
 
@@ -165,13 +165,20 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 
 def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                columns: Optional[Sequence[str]] = None,
-               use_bloom: bool = True):
+               use_bloom: bool = True, devices: Optional[Sequence] = None):
     """Pushdown plan + host prescan + H2D staging for a device scan.
 
     Split from :func:`scan_filtered_device` so callers (and the benchmark)
     can separate the host/transfer phase from on-device decode+filter.
     Returns an opaque staged-scan state consumed by :func:`decoded_scan`.
+    ``devices`` stages surviving span i onto ``devices[i % len(devices)]``
+    (the sharded scan's round-robin placement); default is jax's default
+    device for everything.
     """
+    import contextlib
+
+    import jax
+
     from . import device_reader as dr
 
     from ..format.enums import Type
@@ -191,26 +198,30 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                          f"{key_leaf.physical_type.name}; use the host scan")
     plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom)
     spans = []
-    for plan in plans:
+    for si, plan in enumerate(plans):
         rg = pf.row_group(plan.rg_index)
         row_start, row_end = plan.first_row, plan.first_row + plan.row_count
         per_col = {}
-        for c in [path] + out_cols:
-            chunk = rg.column(c)
-            pages, first = pages_and_base(chunk, row_start, row_end)
-            try:
-                dplan = dr.build_plan(chunk, pages=iter(pages))
-                if (chunk.leaf.physical_type == Type.BYTE_ARRAY
-                        and dplan.value_kind != "dict"):
+        ctx = (jax.default_device(devices[si % len(devices)]) if devices
+               else contextlib.nullcontext())
+        with ctx:
+            for c in [path] + out_cols:
+                chunk = rg.column(c)
+                pages, first = pages_and_base(chunk, row_start, row_end)
+                try:
+                    dplan = dr.build_plan(chunk, pages=iter(pages))
+                    if (chunk.leaf.physical_type == Type.BYTE_ARRAY
+                            and dplan.value_kind != "dict"):
+                        raise ValueError(
+                            f"device scan column {c!r}: plain-encoded "
+                            "BYTE_ARRAY has no row-aligned device form; use "
+                            "the host scan")
+                    staged = dr.stage_plan(dplan)
+                except dr._Unsupported as e:
                     raise ValueError(
-                        f"device scan column {c!r}: plain-encoded BYTE_ARRAY "
-                        "has no row-aligned device form; use the host scan")
-                staged = dr.stage_plan(dplan)
-            except dr._Unsupported as e:
-                raise ValueError(
-                    f"device scan column {c!r}: {e}; use the host scan "
-                    "(scan_filtered)") from None
-            per_col[c] = (chunk, dplan, staged, row_start - first)
+                        f"device scan column {c!r}: {e}; use the host scan "
+                        "(scan_filtered)") from None
+                per_col[c] = (chunk, dplan, staged, row_start - first)
         spans.append((plan, per_col))
     return {"path": path, "out_cols": out_cols, "lo": lo, "hi": hi,
             "spans": spans,
@@ -266,6 +277,111 @@ def _concat_dictionaries(parts):
     return (jnp.concatenate(vals_parts), offsets), indices
 
 
+class _ScanCarrier:
+    """In-flight per-span results between the dispatch and finalize phases."""
+
+    def __init__(self, out_cols):
+        self.parts: Dict[str, List] = {c: [] for c in out_cols}
+        self.vparts: Dict[str, List] = {c: [] for c in out_cols}
+        self.any_valid = {c: False for c in out_cols}
+        self.counts: List = []
+        self.ks_all: List[int] = []
+        self.flushed = 0
+
+    def flush(self, out_cols, upto: int) -> None:
+        """Sync survivor counts for spans [flushed, upto) — ONE blocking
+        stack — then trim each span's outputs with cheap device slices."""
+        import jax
+        import jax.numpy as jnp
+
+        if upto <= self.flushed:
+            return
+        ks = [int(k) for k in np.asarray(jax.block_until_ready(
+            jnp.stack(self.counts[self.flushed:upto])))]
+        self.ks_all.extend(ks)
+        for si, k in zip(range(self.flushed, upto), ks):
+            for c in out_cols:
+                p = self.parts[c][si]
+                self.parts[c][si] = ((p[0], p[1][:k]) if isinstance(p, tuple)
+                                     else p[:k])
+                if self.vparts[c][si] is not None:
+                    self.vparts[c][si] = self.vparts[c][si][:k]
+        self.flushed = upto
+
+
+def _scan_dispatch(state, carrier: _ScanCarrier,
+                   sync_every: Optional[int] = None) -> None:
+    """Phase A — dispatch with (almost) no syncs: per span, survivors are
+    compacted to a prefix with one stable argsort of the predicate mask
+    (device-shape-static; no data-dependent host round-trip per span).
+    With ``sync_every``, counts are synced in batches so device residency
+    stays bounded by a few spans' worth of uncompacted output."""
+    import jax.numpy as jnp
+
+    from ..format.enums import Type
+    from . import device_reader as dr
+
+    path, out_cols = state["path"], state["out_cols"]
+    lo, hi = state["lo"], state["hi"]
+    for plan, per_col in state["spans"]:
+        chunk, dplan, staged, trim = per_col[path]
+        key = dr.decode_staged(chunk.leaf, Type(chunk.meta.type), dplan, staged)
+        n_rows = plan.row_count
+        no_nulls = dplan.total_values == dplan.total_slots
+        mask = _key_mask_device(chunk.leaf, key, lo, hi, trim, n_rows, no_nulls)
+        perm = jnp.argsort(~mask, stable=True)  # survivors first, in order
+        carrier.counts.append(jnp.sum(mask.astype(jnp.int32)))
+        for c in out_cols:
+            chunk_c, dplan_c, staged_c, trim_c = per_col[c]
+            col = dr.decode_staged(chunk_c.leaf, Type(chunk_c.meta.type),
+                                   dplan_c, staged_c)
+            vals, valid = _row_aligned_device(
+                col, trim_c, n_rows,
+                no_nulls=dplan_c.total_values == dplan_c.total_slots)
+            if isinstance(vals, tuple):  # dictionary form: gather indices
+                dictionary, indices = vals
+                carrier.parts[c].append(
+                    (dictionary, jnp.take(indices, perm, axis=0)))
+            else:
+                carrier.parts[c].append(jnp.take(vals, perm, axis=0))
+            if valid is not None:
+                carrier.any_valid[c] = True
+                carrier.vparts[c].append(jnp.take(valid, perm, axis=0))
+            else:
+                carrier.vparts[c].append(None)
+        if sync_every and len(carrier.counts) - carrier.flushed >= sync_every:
+            carrier.flush(out_cols, len(carrier.counts))
+
+
+def _scan_assemble(state, carrier: _ScanCarrier) -> Dict[str, object]:
+    """Phase B — sync remaining counts, slice, concatenate across spans."""
+    import jax.numpy as jnp
+
+    out_cols = state["out_cols"]
+    carrier.flush(out_cols, len(carrier.counts))
+    parts, vparts = carrier.parts, carrier.vparts
+    out: Dict[str, object] = {}
+    for c in out_cols:
+        if not parts[c]:
+            out[c] = _empty_device_result(state["leaves"][c])
+            continue
+        if isinstance(parts[c][0], tuple):  # dictionary-encoded
+            form = _concat_dictionaries(parts[c])
+        else:
+            form = (parts[c][0] if len(parts[c]) == 1
+                    else jnp.concatenate(parts[c]))
+        if carrier.any_valid[c]:
+            lens = [(p[1] if isinstance(p, tuple) else p).shape[0]
+                    for p in parts[c]]
+            valid = jnp.concatenate(
+                [v if v is not None else jnp.ones(n, bool)
+                 for v, n in zip(vparts[c], lens)])
+            out[c] = (form, valid)
+        else:
+            out[c] = form
+    return out
+
+
 def decoded_scan(state) -> Dict[str, object]:
     """On-device phase of the pushdown scan: decode staged pages, evaluate
     the range predicate on the chip, and gather the surviving rows.
@@ -277,90 +393,9 @@ def decoded_scan(state) -> Dict[str, object]:
     dictionaries rebased into one; nullable columns wrap their form in a
     ``(form, validity)`` tuple.
     """
-    import jax
-    import jax.numpy as jnp
-
-    from ..format.enums import Type
-    from . import device_reader as dr
-
-    path, out_cols = state["path"], state["out_cols"]
-    lo, hi = state["lo"], state["hi"]
-    parts: Dict[str, List] = {c: [] for c in out_cols}
-    vparts: Dict[str, List] = {c: [] for c in out_cols}
-    any_valid = {c: False for c in out_cols}
-    # Phase A — dispatch with (almost) no syncs: per span, survivors are
-    # compacted to a prefix with one stable argsort of the predicate mask
-    # (device-shape-static; no data-dependent host round-trip per span).
-    # Counts are synced in batches of _SYNC_EVERY spans so device residency
-    # stays bounded by a few spans' worth of uncompacted output, not the
-    # whole scanned region.
-    counts: List = []
-    flushed = 0
-
-    def _flush(upto: int) -> None:
-        nonlocal flushed
-        if upto <= flushed:
-            return
-        ks = [int(k) for k in np.asarray(
-            jax.block_until_ready(jnp.stack(counts[flushed:upto])))]
-        for si, k in zip(range(flushed, upto), ks):
-            for c in out_cols:
-                p = parts[c][si]
-                parts[c][si] = ((p[0], p[1][:k]) if isinstance(p, tuple)
-                                else p[:k])
-                if vparts[c][si] is not None:
-                    vparts[c][si] = vparts[c][si][:k]
-        flushed = upto
-
-    for plan, per_col in state["spans"]:
-        chunk, dplan, staged, trim = per_col[path]
-        key = dr.decode_staged(chunk.leaf, Type(chunk.meta.type), dplan, staged)
-        n_rows = plan.row_count
-        no_nulls = dplan.total_values == dplan.total_slots
-        mask = _key_mask_device(chunk.leaf, key, lo, hi, trim, n_rows, no_nulls)
-        perm = jnp.argsort(~mask, stable=True)  # survivors first, in order
-        counts.append(jnp.sum(mask.astype(jnp.int32)))
-        for c in out_cols:
-            chunk_c, dplan_c, staged_c, trim_c = per_col[c]
-            col = dr.decode_staged(chunk_c.leaf, Type(chunk_c.meta.type),
-                                   dplan_c, staged_c)
-            vals, valid = _row_aligned_device(
-                col, trim_c, n_rows,
-                no_nulls=dplan_c.total_values == dplan_c.total_slots)
-            if isinstance(vals, tuple):  # dictionary form: gather indices
-                dictionary, indices = vals
-                parts[c].append((dictionary, jnp.take(indices, perm, axis=0)))
-            else:
-                parts[c].append(jnp.take(vals, perm, axis=0))
-            if valid is not None:
-                any_valid[c] = True
-                vparts[c].append(jnp.take(valid, perm, axis=0))
-            else:
-                vparts[c].append(None)
-        if len(counts) - flushed >= _SYNC_EVERY:
-            _flush(len(counts))
-    # Phase B — sync any remaining counts, then cheap device slices.
-    _flush(len(counts))
-    out: Dict[str, object] = {}
-    for c in out_cols:
-        if not parts[c]:
-            out[c] = _empty_device_result(state["leaves"][c])
-            continue
-        if isinstance(parts[c][0], tuple):  # dictionary-encoded
-            form = _concat_dictionaries(parts[c])
-        else:
-            form = (parts[c][0] if len(parts[c]) == 1
-                    else jnp.concatenate(parts[c]))
-        if any_valid[c]:
-            lens = [(p[1] if isinstance(p, tuple) else p).shape[0]
-                    for p in parts[c]]
-            valid = jnp.concatenate(
-                [v if v is not None else jnp.ones(n, bool)
-                 for v, n in zip(vparts[c], lens)])
-            out[c] = (form, valid)
-        else:
-            out[c] = form
-    return out
+    carrier = _ScanCarrier(state["out_cols"])
+    _scan_dispatch(state, carrier, sync_every=_SYNC_EVERY)
+    return _scan_assemble(state, carrier)
 
 
 def scan_filtered_device(pf: ParquetFile, path: str, lo=None, hi=None,
@@ -451,3 +486,57 @@ def _row_aligned_device(col, trim: int, n_rows: int, no_nulls: bool = False):
         return (vals[trim:trim + n_rows],
                 col.validity[trim:trim + n_rows])
     return vals[trim:trim + n_rows], None
+
+
+def scan_filtered_sharded(pf: ParquetFile, path: str, lo=None, hi=None,
+                          columns: Optional[Sequence[str]] = None,
+                          mesh=None, use_bloom: bool = True):
+    """Distributed pushdown scan: surviving row-group spans are staged
+    round-robin across the mesh's devices and decoded+filtered there —
+    BASELINE.md config 5 at v5e-8 scale (SURVEY.md §2.5 data parallelism
+    over row groups, applied to the §3.3 Find→decode flow).
+
+    Returns ``{column: [per-device results]}`` plus ``"#rows"`` (total
+    survivors).  Each per-device entry follows :func:`decoded_scan`'s
+    per-column forms and stays resident on its device; concatenation
+    across devices is the caller's choice (host gather or collectives).
+    """
+    import jax
+
+    from .mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    devs = list(mesh.devices.flat)
+    state = stage_scan(pf, path, lo=lo, hi=hi, columns=columns,
+                       use_bloom=use_bloom, devices=devs)
+    out_cols = state["out_cols"]
+    if "#rows" in out_cols:
+        raise ValueError('a column named "#rows" collides with the result '
+                         "total; select it via scan_filtered instead")
+    shards = []  # (device, sub-state, carrier)
+    for di, dev in enumerate(devs):
+        spans = [sp for si, sp in enumerate(state["spans"])
+                 if si % len(devs) == di]
+        if spans:
+            shards.append((dev, dict(state, spans=spans),
+                           _ScanCarrier(out_cols)))
+    # dispatch EVERY device's phase A before any sync, so the chips decode
+    # concurrently; the per-device finalize then only waits, it doesn't idle
+    # the rest of the mesh.  (Residency is bounded per device by its own
+    # span share — the single-device sync_every batching doesn't apply.)
+    for dev, sub, carrier in shards:
+        # staged bytes are uncommitted: pin this shard's execution (and its
+        # outputs) to its device
+        with jax.default_device(dev):
+            _scan_dispatch(sub, carrier)
+    per_dev: Dict[str, List] = {c: [] for c in out_cols}
+    total = 0
+    for dev, sub, carrier in shards:
+        with jax.default_device(dev):
+            got = _scan_assemble(sub, carrier)
+        for c in out_cols:
+            per_dev[c].append(got[c])
+        total += sum(carrier.ks_all)
+    result: Dict[str, object] = dict(per_dev)
+    result["#rows"] = total
+    return result
