@@ -1,0 +1,59 @@
+"""parquet-tools-style CLI: ``python -m parquet_tpu [meta|schema|pages|head]``.
+
+Reference parity: the reference ships ``print.go`` (PrintSchema) as a
+library; this front end makes the same dumps reachable from a shell.
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="parquet_tpu")
+    p.add_argument("command", choices=["meta", "schema", "pages", "head"],
+                   help="meta: file summary; schema: schema tree; pages: "
+                        "page-level dump; head: first rows as JSON lines")
+    p.add_argument("file", help="parquet file path")
+    p.add_argument("--row-group", type=int, default=0,
+                   help="pages: which row group")
+    p.add_argument("--column", type=int, default=0,
+                   help="pages: which leaf column (schema order)")
+    p.add_argument("-n", type=int, default=10, help="head: rows to print")
+    args = p.parse_args(argv)
+
+    from .io.reader import ParquetFile
+    from .utils.printer import print_file, print_pages, print_schema
+
+    try:
+        if args.n < 1:
+            raise ValueError("-n must be >= 1")
+        pf = ParquetFile(args.file)
+        if args.command == "meta":
+            print_file(pf, file=sys.stdout)
+        elif args.command == "schema":
+            print_schema(pf.schema, file=sys.stdout)
+        elif args.command == "pages":
+            if not 0 <= args.row_group < len(pf.row_groups):
+                raise ValueError(f"row group {args.row_group} out of range "
+                                 f"(file has {len(pf.row_groups)})")
+            if not 0 <= args.column < len(pf.schema.leaves):
+                raise ValueError(f"column {args.column} out of range "
+                                 f"(schema has {len(pf.schema.leaves)} leaves)")
+            print_pages(pf, args.row_group, args.column, file=sys.stdout)
+        elif args.command == "head":
+            import json
+
+            tab = pf.iter_batches(batch_rows=args.n)
+            batch = next(iter(tab), None)
+            if batch is not None:
+                rows = batch.to_arrow().to_pylist()[: args.n]
+                for r in rows:
+                    print(json.dumps(r, default=repr))
+    except (OSError, ValueError, KeyError) as e:
+        print(f"parquet_tpu: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

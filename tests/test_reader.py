@@ -450,3 +450,37 @@ def test_print_file_and_pages_flags(rng):
     assert "colidx" in out and "bloom" in out and "who = 't'" in out
     pg = ptq.print_pages(pf, 0, 1)
     assert "DICTIONARY_PAGE" in pg and "DATA_PAGE" in pg and "values=" in pg
+
+
+def test_cli_commands(tmp_path):
+    """python -m parquet_tpu meta/schema/pages/head smoke (print.go parity
+    made shell-reachable)."""
+    import contextlib
+
+    from parquet_tpu.__main__ import main
+
+    t = pa.table({"a": pa.array(np.arange(50, dtype=np.int64))})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p)
+    for cmd in (["meta", p], ["schema", p], ["pages", p],
+                ["head", p, "-n", "3"]):
+        cap = io.StringIO()
+        with contextlib.redirect_stdout(cap):
+            rc = main(cmd)
+        assert rc == 0 and cap.getvalue().strip(), cmd
+
+
+def test_cli_error_paths(tmp_path):
+    import contextlib
+
+    from parquet_tpu.__main__ import main
+
+    t = pa.table({"a": pa.array(np.arange(5, dtype=np.int64))})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p)
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        assert main(["meta", "/nonexistent.parquet"]) == 1
+        assert main(["pages", p, "--column", "9"]) == 1
+        assert main(["head", p, "-n", "0"]) == 1
+    assert "parquet_tpu:" in err.getvalue()
