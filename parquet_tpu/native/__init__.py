@@ -91,6 +91,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_gather_ba.argtypes = [
             ctypes.c_void_p, _i64p, ctypes.c_int64, _i64p, ctypes.c_int64,
             _i64p_w, ctypes.c_void_p]
+        lib.pq_encode_delta.restype = ctypes.c_int64
+        lib.pq_encode_delta.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
+                                        ctypes.c_int32, _u8p_w, ctypes.c_int64]
         lib.pq_encode_rle.restype = ctypes.c_int64
         lib.pq_encode_rle.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
                                       ctypes.c_int32, _u8p_w, ctypes.c_int64]
@@ -272,6 +275,25 @@ def gather_ba(dvals: np.ndarray, doffs: np.ndarray, indices: np.ndarray):
                      len(doffs) - 1, indices, n, out_offs,
                      out_vals.ctypes.data)
     return out_vals[:total], out_offs
+
+
+def encode_delta(values: np.ndarray, block_size: int = 128,
+                 n_miniblocks: int = 4) -> Optional[bytes]:
+    """DELTA_BINARY_PACKED stream, byte-identical to the Python oracle, or
+    None when the lib is unavailable / the layout is unsupported."""
+    lib = get_lib()
+    if lib is None or len(values) == 0:
+        return None
+    values = np.ascontiguousarray(values, np.int64)
+    n = len(values)
+    # worst case: every delta at 64 bits + headers per block
+    nblocks = (n + block_size - 1) // block_size + 1
+    cap = 64 + n * 8 + nblocks * (16 + n_miniblocks) + block_size * 8
+    out = np.empty(cap, np.uint8)
+    wrote = lib.pq_encode_delta(values, n, block_size, n_miniblocks, out, cap)
+    if wrote < 0:
+        return None
+    return out[:wrote].tobytes()
 
 
 def encode_rle(values: np.ndarray, bit_width: int,

@@ -37,6 +37,18 @@ inline void expand_bits_to_bytes(uint64_t bits, int k, uint8_t* dst) {
   for (; t < k; ++t, bits >>= 1) dst[t] = (uint8_t)(bits & 1);
 }
 
+// Bounds-checked LSB-first uvarint emit shared by the native encoders.
+inline bool put_uvarint(uint8_t* out, int64_t cap, int64_t& o, uint64_t v) {
+  while (v >= 0x80) {
+    if (o >= cap) return false;
+    out[o++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  if (o >= cap) return false;
+  out[o++] = (uint8_t)v;
+  return true;
+}
+
 inline uint64_t load8_clamped(const uint8_t* buf, int64_t buf_len, int64_t byte0) {
   uint64_t word = 0;
   if (byte0 + 8 <= buf_len) {
@@ -326,16 +338,7 @@ int64_t pq_encode_rle(const int64_t* vals, int64_t n, int32_t w,
                       int32_t min_repeat, uint8_t* out, int64_t cap) {
   if (w <= 0 || w > 56 || n == 0) return -2;
   int64_t o = 0;
-  const auto put_uvarint = [&](uint64_t v) -> bool {
-    while (v >= 0x80) {
-      if (o >= cap) return false;
-      out[o++] = (uint8_t)(v | 0x80);
-      v >>= 7;
-    }
-    if (o >= cap) return false;
-    out[o++] = (uint8_t)v;
-    return true;
-  };
+  const auto put_uv = [&](uint64_t v) { return put_uvarint(out, cap, o, v); };
   const int vbytes = (w + 7) / 8;
   const uint64_t vmask = (vbytes >= 8) ? ~0ull : ((1ull << (8 * vbytes)) - 1);
   const uint64_t mask = (1ull << w) - 1;
@@ -343,7 +346,7 @@ int64_t pq_encode_rle(const int64_t* vals, int64_t n, int32_t w,
   const auto emit_packed = [&](int64_t s, int64_t cnt) -> bool {
     if (!cnt) return true;
     const int64_t ngroups = (cnt + 7) / 8;
-    if (!put_uvarint(((uint64_t)ngroups << 1) | 1)) return false;
+    if (!put_uv(((uint64_t)ngroups << 1) | 1)) return false;
     uint64_t acc = 0;
     int nb = 0;
     for (int64_t i = 0; i < ngroups * 8; ++i) {
@@ -369,7 +372,7 @@ int64_t pq_encode_rle(const int64_t* vals, int64_t n, int32_t w,
       const int64_t pad = (8 - ((i - pos) & 7)) & 7;
       if (len - pad >= min_repeat) {
         if (!emit_packed(pos, i + pad - pos)) return -1;
-        if (!put_uvarint((uint64_t)(len - pad) << 1)) return -1;
+        if (!put_uv((uint64_t)(len - pad) << 1)) return -1;
         const uint64_t ev = (uint64_t)v & vmask;
         for (int b = 0; b < vbytes; ++b) {
           if (o >= cap) return -1;
@@ -381,6 +384,79 @@ int64_t pq_encode_rle(const int64_t* vals, int64_t n, int32_t w,
     i = j;
   }
   if (!emit_packed(pos, n - pos)) return -1;
+  return o;
+}
+
+// ---------------------------------------------------------------------------
+// DELTA_BINARY_PACKED encoder (write-path twin of pq_delta_prescan),
+// byte-identical to the Python oracle: per block, zigzag min delta, per-
+// miniblock bit widths, LSB-first packed adjusted deltas (128-bit
+// accumulator: widths reach 64).  Returns bytes, -1 on cap, -2 unsupported.
+// ---------------------------------------------------------------------------
+int64_t pq_encode_delta(const int64_t* vals, int64_t n, int32_t block_size,
+                        int32_t nmb, uint8_t* out, int64_t cap) {
+  if (block_size <= 0 || nmb <= 0 || nmb > 256 || block_size % nmb) return -2;
+  int64_t o = 0;
+  const auto put_uv = [&](uint64_t v) { return put_uvarint(out, cap, o, v); };
+  const auto zz = [](int64_t v) {
+    return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+  };
+  if (!put_uv((uint64_t)block_size) || !put_uv((uint64_t)nmb) ||
+      !put_uv((uint64_t)n))
+    return -1;
+  if (n == 0) return put_uv(0) ? o : -1;
+  if (!put_uv(zz(vals[0]))) return -1;
+  if (n == 1) return o;
+  const int vpm = block_size / nmb;
+  std::vector<uint64_t> adj(block_size);
+  for (int64_t bstart = 0; bstart < n - 1; bstart += block_size) {
+    const int64_t cnt =
+        (n - 1 - bstart < block_size) ? (n - 1 - bstart) : block_size;
+    int64_t mind = INT64_MAX;
+    for (int64_t i = 0; i < cnt; ++i) {
+      const int64_t d = (int64_t)((uint64_t)vals[bstart + i + 1] -
+                                  (uint64_t)vals[bstart + i]);
+      adj[i] = (uint64_t)d;
+      if (d < mind) mind = d;
+    }
+    if (!put_uv(zz(mind))) return -1;
+    for (int64_t i = 0; i < cnt; ++i) adj[i] -= (uint64_t)mind;
+    uint8_t widths[256];
+    for (int m = 0; m < nmb; ++m) {
+      const int64_t lo = (int64_t)m * vpm;
+      uint64_t mx = 0;
+      for (int64_t i = lo; i < lo + vpm && i < cnt; ++i)
+        mx |= adj[i];  // OR has the same MSB as max
+      widths[m] = (lo >= cnt || mx == 0) ? 0 : (uint8_t)(64 - __builtin_clzll(mx));
+    }
+    if (o + nmb > cap) return -1;
+    std::memcpy(out + o, widths, nmb);
+    o += nmb;
+    const int last_nonempty = (int)((cnt - 1) / vpm);
+    for (int m = 0; m <= last_nonempty; ++m) {
+      const int w = widths[m];
+      if (w == 0) continue;
+      const int64_t lo = (int64_t)m * vpm;
+      unsigned __int128 acc = 0;
+      int nb = 0;
+      const uint64_t mask = (w >= 64) ? ~0ull : ((1ull << w) - 1);
+      for (int i = 0; i < vpm; ++i) {
+        const uint64_t v = (lo + i < cnt) ? (adj[lo + i] & mask) : 0;
+        acc |= (unsigned __int128)v << nb;
+        nb += w;
+        while (nb >= 8) {
+          if (o >= cap) return -1;
+          out[o++] = (uint8_t)acc;
+          acc >>= 8;
+          nb -= 8;
+        }
+      }
+      if (nb) {
+        if (o >= cap) return -1;
+        out[o++] = (uint8_t)acc;
+      }
+    }
+  }
   return o;
 }
 

@@ -500,9 +500,17 @@ def decode_delta_binary_packed(data, pos: int = 0) -> Tuple[np.ndarray, int]:
 
 
 def encode_delta_binary_packed(values: np.ndarray, block_size: int = 128,
-                               n_miniblocks: int = 4) -> bytes:
+                               n_miniblocks: int = 4,
+                               _native: bool = True) -> bytes:
     """Encode int32/int64 values.  block_size=128, 4 miniblocks of 32 — the
-    common writer layout (vpm=32, multiple of 32 as the spec requires)."""
+    common writer layout (vpm=32, multiple of 32 as the spec requires).
+    Routes through the C++ shim; this body is the oracle/fallback."""
+    if _native and len(values):
+        from .. import native
+
+        nat = native.encode_delta(values, block_size, n_miniblocks)
+        if nat is not None:
+            return nat
     v = np.asarray(values, dtype=np.int64)
     total = len(v)
     out = bytearray()
