@@ -106,6 +106,10 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
     pt = leaf.physical_type
     n_slots = len(validity) if validity is not None else None
 
+    if k == LogicalKind.UNKNOWN:  # Null logical type: always-null column
+        n = n_slots if n_slots is not None else len(values)
+        return pa.nulls(n)
+
     if pt == Type.BYTE_ARRAY:
         # expand dense values to slot-aligned with validity
         if validity is not None:
@@ -341,6 +345,8 @@ def _leaf_arrow_type(n):
     k = n.logical_kind
     pt = n.physical_type
     p = n.logical_params
+    if k == LogicalKind.UNKNOWN:
+        return pa.null()
     if pt == Type.BOOLEAN:
         return pa.bool_()
     if pt == Type.BYTE_ARRAY:

@@ -936,6 +936,10 @@ def _arrow_leaf_type(t):
     import pyarrow as pa
 
     K = LogicalKind
+    if pa.types.is_null(t):
+        # arrow's untyped all-null column: parquet Null logical type over
+        # optional INT32 (pyarrow's mapping)
+        return Type.INT32, K.UNKNOWN, {}, None
     if pa.types.is_boolean(t):
         return Type.BOOLEAN, K.NONE, {}, None
     if pa.types.is_int8(t):
@@ -1124,6 +1128,9 @@ def _column_from_arrow(arr, leaf: Leaf, pos: int = 1) -> ColumnData:
         inner.def_levels = d
         inner.rep_levels = r
         return inner
+    if pa.types.is_null(t):  # untyped all-null column: zero dense values
+        return ColumnData(values=np.empty(0, np.int32),
+                          validity=np.zeros(len(arr), bool))
     validity = None
     if arr.null_count:
         validity = ~np.asarray(arr.is_null())

@@ -272,6 +272,8 @@ def _logical_to_thrift(kind: str, params: dict):
         return L(LIST=md.ListType()), int(C.LIST), None
     if kind == K.MAP:
         return L(MAP=md.MapType()), int(C.MAP), None
+    if kind == K.UNKNOWN:
+        return L(UNKNOWN=md.NullType()), None, None
     unit_map = {
         "millis": md.TimeUnit(MILLIS=md.MilliSeconds()),
         "micros": md.TimeUnit(MICROS=md.MicroSeconds()),

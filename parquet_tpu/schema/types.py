@@ -68,6 +68,7 @@ class LogicalKind:
     INTERVAL = "interval"
     LIST = "list"
     MAP = "map"
+    UNKNOWN = "unknown"  # Null logical type (always-null column)
 
 
 def _logical_from_element(el: md.SchemaElement):
@@ -117,6 +118,8 @@ def _logical_from_element(el: md.SchemaElement):
             return LogicalKind.LIST, {}
         if lt.MAP is not None:
             return LogicalKind.MAP, {}
+        if lt.UNKNOWN is not None:
+            return LogicalKind.UNKNOWN, {}
     ct = el.converted_type
     if ct is None:
         return LogicalKind.NONE, {}

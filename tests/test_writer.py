@@ -472,3 +472,24 @@ def test_column_index_truncation_long_strings(rng):
     ptq.write_table(t2, b2, ptq.WriterOptions(compression="none"))
     ci2 = ptq.ParquetFile(b2.getvalue()).row_group(0).column("b").column_index()
     assert max(len(m) for m in ci2.max_values) == 100
+
+
+def test_null_type_column_roundtrip(rng):
+    """Arrow's untyped all-null columns map to the parquet Null logical type
+    over optional INT32 (pyarrow's mapping) and round-trip both directions."""
+    import parquet_tpu as ptq
+
+    t = pa.table({"n": pa.array([None] * 500), "x": pa.array(np.arange(500))})
+    buf = io.BytesIO()
+    ptq.write_table(t, buf, ptq.WriterOptions(compression="none"))
+    raw = buf.getvalue()
+    got = pq.read_table(io.BytesIO(raw))
+    assert got.column("n").null_count == 500
+    assert got.column("x").to_pylist() == list(range(500))
+    back = ptq.ParquetFile(raw).read().to_arrow()
+    assert back.column("n").type == pa.null() and back.column("n").null_count == 500
+    # pyarrow-written null column reads back as null type too
+    b2 = io.BytesIO()
+    pq.write_table(t, b2)
+    back2 = ptq.ParquetFile(b2.getvalue()).read().to_arrow()
+    assert back2.column("n").type == pa.null() and back2.column("n").null_count == 500
