@@ -91,6 +91,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_gather_ba.argtypes = [
             ctypes.c_void_p, _i64p, ctypes.c_int64, _i64p, ctypes.c_int64,
             _i64p_w, ctypes.c_void_p]
+        lib.pq_encode_plain_ba.restype = ctypes.c_int64
+        lib.pq_encode_plain_ba.argtypes = [ctypes.c_void_p, _i64p,
+                                           ctypes.c_int64, ctypes.c_int64,
+                                           _u8p_w]
         lib.pq_encode_delta.restype = ctypes.c_int64
         lib.pq_encode_delta.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int32,
                                         ctypes.c_int32, _u8p_w, ctypes.c_int64]
@@ -275,6 +279,24 @@ def gather_ba(dvals: np.ndarray, doffs: np.ndarray, indices: np.ndarray):
                      len(doffs) - 1, indices, n, out_offs,
                      out_vals.ctypes.data)
     return out_vals[:total], out_offs
+
+
+def encode_plain_ba(vals: np.ndarray, offs: np.ndarray) -> Optional[bytes]:
+    """PLAIN BYTE_ARRAY stream ([4B LE length][bytes]...), or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals)
+    offs = np.ascontiguousarray(offs, np.int64)
+    n = len(offs) - 1
+    out = np.empty(max(int(offs[-1]), 0) + 4 * max(n, 0) + 1, np.uint8)
+    wrote = lib.pq_encode_plain_ba(vals.ctypes.data if len(vals) else None,
+                                   offs, n, len(vals), out)
+    if wrote < 0:
+        # detected corruption (non-monotonic / out-of-range offsets), NOT
+        # unavailability — never hand these to the numpy fallback
+        raise ValueError("malformed BYTE_ARRAY offsets")
+    return out[:wrote].tobytes()
 
 
 def encode_delta(values: np.ndarray, block_size: int = 128,

@@ -141,6 +141,28 @@ int64_t pq_plain_byte_array(const uint8_t* data, int64_t size, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// PLAIN BYTE_ARRAY encode: values+offsets -> [4B LE length][bytes]...
+// (write twin of pq_plain_byte_array).  Returns bytes written.
+// ---------------------------------------------------------------------------
+int64_t pq_encode_plain_ba(const uint8_t* vals, const int64_t* offs, int64_t n,
+                           int64_t vals_len, uint8_t* out) {
+  if (n > 0 && (offs[0] != 0 || offs[n] > vals_len)) return -1;
+  int64_t o = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t len = offs[i + 1] - offs[i];
+    // caller-supplied offsets are untrusted: a negative or oversized length
+    // would wrap the uint32 and memcpy far past both buffers
+    if (len < 0 || len > 0xFFFFFFFFll) return -1;
+    const uint32_t len32 = (uint32_t)len;
+    std::memcpy(out + o, &len32, 4);
+    o += 4;
+    std::memcpy(out + o, vals + offs[i], (size_t)len);
+    o += len;
+  }
+  return o;
+}
+
+// ---------------------------------------------------------------------------
 // Expand a merged run table (host twin of the device rle_expand kernel, used
 // for nested-column level streams that the host record assembler consumes).
 // Runs tile the output contiguously: run i covers [ends[i-1], ends[i]).

@@ -209,6 +209,11 @@ def encode_plain(values, physical: Type, offsets: Optional[np.ndarray] = None) -
     if physical == Type.BYTE_ARRAY:
         data = np.asarray(values, dtype=np.uint8)
         offs = np.asarray(offsets, dtype=np.int64)
+        from .. import native
+
+        nat = native.encode_plain_ba(data, offs)
+        if nat is not None:
+            return nat
         lens = (offs[1:] - offs[:-1]).astype(np.int64)
         n = len(lens)
         out = np.empty(len(data) + 4 * n, dtype=np.uint8)

@@ -295,3 +295,22 @@ def test_encode_delta_native_byte_identical_to_oracle(lib, rng):
             dec, _ = ref.decode_delta_binary_packed(
                 np.frombuffer(got, np.uint8))
             np.testing.assert_array_equal(dec, v)
+
+
+def test_encode_plain_ba_native_matches_numpy(lib, rng):
+    parts = [f"v{i % 57}".encode() * int(rng.integers(0, 4)) for i in range(3000)]
+    data = np.frombuffer(b"".join(parts), np.uint8)
+    offs = np.zeros(len(parts) + 1, np.int64)
+    np.cumsum([len(p) for p in parts], out=offs[1:])
+    got = native.encode_plain_ba(data, offs)
+    # decode side is the cross-check (and the numpy body is dual-run tested)
+    v, o = native.plain_byte_array(np.frombuffer(got, np.uint8), len(parts))
+    assert v.tobytes() == data.tobytes()
+    np.testing.assert_array_equal(o, offs)
+
+
+def test_encode_plain_ba_rejects_malformed_offsets(lib):
+    data = np.frombuffer(b"abcdef", np.uint8)
+    for bad in ([0, 10, 5, 6], [0, 3, 99], [1, 2, 6]):
+        with pytest.raises(ValueError):
+            native.encode_plain_ba(data, np.array(bad, np.int64))
