@@ -718,7 +718,10 @@ def _bss_decode_multi(buf, n, pages: tuple, width: int, pairs: bool):
     if width == 4:
         dt = jnp.uint32 if pairs else jnp.float32
         return jax.lax.bitcast_convert_type(bytes_, dt).reshape(n)
-    return jax.lax.bitcast_convert_type(bytes_.reshape(n, 2, 4), jnp.uint32).reshape(n, 2)
+    if width == 8:
+        return jax.lax.bitcast_convert_type(
+            bytes_.reshape(n, 2, 4), jnp.uint32).reshape(n, 2)
+    return bytes_  # FLBA (e.g. float16): (n, width) bytes, the plain_flba form
 
 
 # ---------------------------------------------------------------------------
@@ -1065,13 +1068,12 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
         if len(plan.bss_pages) > 512:
             # static per-page slicing unrolls O(pages) into the graph
             raise _Unsupported("byte-stream-split chunk with huge page count")
-        if w in (4, 8):
-            values = _bss_decode_multi(val_dbuf, nvals,
-                                       tuple((int(b), int(n))
-                                             for b, n in plan.bss_pages),
-                                       w, physical in _IS_PAIR)
-        else:
-            raise _Unsupported("FLBA byte-stream-split on device")
+        if not w:
+            raise _Unsupported("byte-stream-split without a fixed width")
+        values = _bss_decode_multi(val_dbuf, nvals,
+                                   tuple((int(b), int(n))
+                                         for b, n in plan.bss_pages),
+                                   w, physical in _IS_PAIR)
     elif kind == "host_ba":
         if plan.host_parts and isinstance(plan.host_parts[0], tuple):
             vals = np.concatenate([p[0] for p in plan.host_parts])

@@ -302,3 +302,22 @@ def test_use_pallas_gate_blocks_wide_widths(monkeypatch):
     monkeypatch.setenv("PARQUET_TPU_PALLAS", "")
     # auto: CPU backend in tests -> jnp twin
     assert not dr._use_pallas(8)
+
+
+def test_byte_stream_split_flba_float16_device(rng):
+    """BYTE_STREAM_SPLIT over FLBA(2) (float16) decodes on device as (n, 2)
+    byte rows — the plain_flba column form."""
+    from parquet_tpu.parallel import device_reader as dr
+
+    t = pa.table({"h": pa.array(rng.random(20000).astype(np.float16))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False, data_page_size=1 << 12,
+                   column_encoding={"h": "BYTE_STREAM_SPLIT"})
+    raw = buf.getvalue()
+    pf = ParquetFile(raw)
+    chunk = pf.row_group(0).column(0)
+    col = dr.decode_chunk_device(chunk, fallback=False)
+    got = np.asarray(col.values).view(np.float16).reshape(-1)
+    np.testing.assert_array_equal(got, t.column("h").to_numpy())
+    assert ParquetFile(raw).read(device=True).to_arrow().column("h").to_pylist() == \
+        t.column("h").to_pylist()
