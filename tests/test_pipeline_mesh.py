@@ -115,21 +115,25 @@ def test_read_table_sharded_host_fallback_mixed_encodings():
     from parquet_tpu.format.enums import Encoding
     from parquet_tpu.io.writer import WriterOptions, write_table
 
-    # BIT_PACKED legacy def levels force plan.host_def -> flat max_def==1
-    # stays device; instead use FLBA BYTE_STREAM_SPLIT (unsupported width)
+    # Mixed dict→plain pages within one chunk (pyarrow's mid-chunk
+    # dictionary fallback) are host-only for fixed-width columns; such
+    # chunks must fall back while the rest of the table stays on device.
+    # (FLBA BYTE_STREAM_SPLIT, the previous trigger, now decodes on device.)
     rng = np.random.default_rng(2)
-    t = pa.table({"f": pa.array([rng.bytes(3) for _ in range(2000)],
-                                type=pa.binary(3)),
-                  "x": pa.array(np.arange(2000, dtype=np.int64))})
+    vals = np.concatenate([rng.integers(0, 3, 2000),
+                           rng.integers(0, 1 << 40, 48000)]).astype(np.int64)
+    t = pa.table({"f": pa.array(vals),
+                  "x": pa.array(np.arange(50000, dtype=np.int64))})
     buf = io.BytesIO()
-    write_table(t, buf, WriterOptions(dictionary=False,
-                                      column_encoding={"f": Encoding.BYTE_STREAM_SPLIT}))
+    pq.write_table(t, buf, use_dictionary=["f"], data_page_size=4096,
+                   dictionary_pagesize_limit=4096)
     counters.reset()
     st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8),
                             columns=["f", "x"])
-    assert st.num_rows == 2000
+    assert st.num_rows == 50000
     assert counters.get("chunks_host_fallback") >= 1
     fv = np.asarray(st.arrays["f"])
     mask = np.asarray(st.row_mask())
-    got = [bytes(r) for r in fv[mask][:5]]
-    assert got == t.column("f").to_pylist()[:5]
+    from parquet_tpu.ops.device import pairs_to_host
+    got = pairs_to_host(fv[mask], np.dtype(np.int64))
+    np.testing.assert_array_equal(got, vals)
