@@ -488,6 +488,8 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
     if encoding == Encoding.BYTE_STREAM_SPLIT:
         plan.set_kind("bss")
         w = _FIXED_WIDTH.get(physical, leaf.type_length)
+        if not w:  # e.g. BYTE_ARRAY: no fixed width, no BSS plane layout
+            raise _Unsupported("byte-stream-split without a fixed width")
         base = len(plan.values)
         plan.values.extend(raw[pos : pos + nvals * w].tobytes())
         plan.bss_pages.append((base, nvals))
@@ -704,8 +706,9 @@ def _delta_decode_multi(buf, n, page_ends, firsts, mb_base, mb_offs, mb_widths,
     return jax.lax.bitcast_convert_type(gcum - base, jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("n", "pages", "width", "pairs"))
-def _bss_decode_multi(buf, n, pages: tuple, width: int, pairs: bool):
+@partial(jax.jit, static_argnames=("n", "pages", "width", "pairs", "flba"))
+def _bss_decode_multi(buf, n, pages: tuple, width: int, pairs: bool,
+                      flba: bool = False):
     """Gather-free BYTE_STREAM_SPLIT: byte plane k of a page is the static
     slice [base + k*count, base + (k+1)*count) — page structure is host
     metadata, so every plane extraction is a compile-time slice and the
@@ -715,13 +718,16 @@ def _bss_decode_multi(buf, n, pages: tuple, width: int, pairs: bool):
         planes = buf[base: base + width * cnt].reshape(width, cnt)
         per_page.append(planes.T)  # (cnt, width) bytes
     bytes_ = per_page[0] if len(per_page) == 1 else jnp.concatenate(per_page)
+    if flba:
+        # FLBA (float16, decimals, ...): ALWAYS the (n, width) byte-row
+        # plain_flba form — the output form follows the physical type, not
+        # the byte width (an FLBA(4) decimal is not a float32)
+        return bytes_
     if width == 4:
         dt = jnp.uint32 if pairs else jnp.float32
         return jax.lax.bitcast_convert_type(bytes_, dt).reshape(n)
-    if width == 8:
-        return jax.lax.bitcast_convert_type(
-            bytes_.reshape(n, 2, 4), jnp.uint32).reshape(n, 2)
-    return bytes_  # FLBA (e.g. float16): (n, width) bytes, the plain_flba form
+    return jax.lax.bitcast_convert_type(
+        bytes_.reshape(n, 2, 4), jnp.uint32).reshape(n, 2)
 
 
 # ---------------------------------------------------------------------------
@@ -1068,12 +1074,14 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
         if len(plan.bss_pages) > 512:
             # static per-page slicing unrolls O(pages) into the graph
             raise _Unsupported("byte-stream-split chunk with huge page count")
-        if not w:
-            raise _Unsupported("byte-stream-split without a fixed width")
+        flba = physical == Type.FIXED_LEN_BYTE_ARRAY
+        if not flba and w not in (4, 8):
+            # e.g. INT96: BSS is undefined for it — clean host fallback
+            raise _Unsupported("byte-stream-split over unsupported width")
         values = _bss_decode_multi(val_dbuf, nvals,
                                    tuple((int(b), int(n))
                                          for b, n in plan.bss_pages),
-                                   w, physical in _IS_PAIR)
+                                   w, physical in _IS_PAIR, flba)
     elif kind == "host_ba":
         if plan.host_parts and isinstance(plan.host_parts[0], tuple):
             vals = np.concatenate([p[0] for p in plan.host_parts])

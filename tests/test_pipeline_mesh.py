@@ -112,9 +112,6 @@ def test_read_table_sharded_empty_file():
 def test_read_table_sharded_host_fallback_mixed_encodings():
     """Chunks the device path cannot handle fall back to host decode but
     still shard (parity with decode_chunk_device(fallback=True))."""
-    from parquet_tpu.format.enums import Encoding
-    from parquet_tpu.io.writer import WriterOptions, write_table
-
     # Mixed dict→plain pages within one chunk (pyarrow's mid-chunk
     # dictionary fallback) are host-only for fixed-width columns; such
     # chunks must fall back while the rest of the table stays on device.
