@@ -37,7 +37,8 @@ from .io.writer import (ColumnData, ParquetWriter, WriterOptions,
 from .io.search import find, pages_overlapping, plan_scan, prune_row_group, read_row_range
 from .io.stream import iter_batches
 from .io.source import RetryingSource, Source
-from .parallel.host_scan import scan_filtered
+from .parallel.host_scan import (scan_filtered, scan_filtered_device,
+                                 scan_filtered_sharded)
 from .algebra import (SortingColumn, SortingWriter, TableBuffer,
                       convert_table, merge_files, merge_row_groups)
 from .schema.schema import (Schema, group, leaf, list_of, map_of, message,
