@@ -85,12 +85,19 @@ def _probe_tpu(timeout_s: int = 120):
     det_fails = 0
     deadline = time.time() + float(os.environ.get("BENCH_FORCE_TPU_MAX_S",
                                                   4 * 3600))
+    # without force, bound the whole probe phase: the driver runs this under
+    # its own timeout, and a CPU-fallback bench that never prints because the
+    # probe backoff ate the budget is worse than a fast CPU number
+    probe_deadline = time.time() + float(
+        os.environ.get("BENCH_PROBE_MAX_S", 600))
     i = 0
     while True:
-        if i < len(waits):
+        if force:
+            wait = waits[i] if i < len(waits) else 480
+        elif i < len(waits):
             wait = waits[i]
-        elif force:
-            wait = 480
+            if time.time() + wait > probe_deadline:
+                return art
         else:
             return art
         if wait and (art["attempts"] > 0):
