@@ -191,11 +191,21 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
         if c not in flat:
             raise ValueError(f"column {c!r} is nested or unknown; the device "
                              "scan handles flat columns")
+    from ..schema.types import LogicalKind
+
     key_leaf = pf.schema.leaf(path)
-    if key_leaf.physical_type in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY,
-                                  Type.INT96):
+    if key_leaf.physical_type in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
         raise ValueError(f"device scan key {path!r} has physical type "
                          f"{key_leaf.physical_type.name}; use the host scan")
+    if (key_leaf.physical_type == Type.BYTE_ARRAY
+            and key_leaf.logical_kind == LogicalKind.DECIMAL):
+        # decimal BYTE_ARRAY orders by unscaled two's-complement value, not
+        # by bytes — the per-entry bytewise predicate below would be wrong
+        raise ValueError(f"device scan key {path!r} is a decimal byte array; "
+                         "use the host scan")
+    # other BYTE_ARRAY keys are fine when dictionary-encoded (per-entry
+    # predicate + device gather); plain-encoded chunks are rejected per
+    # chunk below
     plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom)
     spans = []
     for si, plan in enumerate(plans):
@@ -423,8 +433,20 @@ def _key_mask_device(leaf, col, lo, hi, trim: int, n_rows: int,
     lo, hi = normalize(leaf, lo), normalize(leaf, hi)
     vals, valid = _row_aligned_device(col, trim, n_rows, no_nulls=no_nulls)
     if isinstance(vals, tuple):
-        raise ValueError(f"device scan key {leaf.dotted_path!r} is "
-                         "dictionary-encoded byte-array; use the host scan")
+        # dictionary-encoded byte-array key: evaluate the predicate once per
+        # dictionary entry on host (metadata-scale), then one device gather
+        # maps entry verdicts onto the index stream
+        dvals, doffs = col.dictionary_host
+        doffs = np.asarray(doffs, np.int64)
+        entries = [bytes(dvals[doffs[i]: doffs[i + 1]])
+                   for i in range(len(doffs) - 1)]
+        match = np.array([(lo is None or e >= lo) and (hi is None or e <= hi)
+                          for e in entries], bool)
+        _, indices = vals
+        mask = jnp.take(jnp.asarray(match), indices, axis=0)
+        if valid is not None:
+            mask &= valid
+        return mask
     physical = leaf.physical_type
     unsigned = is_unsigned(leaf)
     if vals.ndim == 2 and vals.shape[-1] == 2 and vals.dtype == jnp.uint32:
