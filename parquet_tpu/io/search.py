@@ -380,6 +380,8 @@ def _trim_flat_aligned(col, offset: int, count: int):
             out[p] = v
         return out, vmask
     comp = values[vstart:vend]
-    out = np.zeros(int(count), comp.dtype if len(comp) else values.dtype)
+    dt = comp.dtype if len(comp) else values.dtype
+    # FLBA columns are (n, width) byte rows: the null fill must match
+    out = np.zeros((int(count),) + tuple(values.shape[1:]), dt)
     out[vmask] = comp
     return out, vmask

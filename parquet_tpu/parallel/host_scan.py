@@ -104,9 +104,24 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 
     parts: Dict[str, List] = {c: [] for c in out_cols}
     vparts: Dict[str, List] = {c: [] for c in out_cols}
+    from ..algebra.compare import decode_order_value
+
     for span in spans:
         keys, key_valid = span[path]
-        if isinstance(keys, list):  # BYTE_ARRAY keys: Python bytes comparisons
+        flba_rows = (not isinstance(keys, list)
+                     and getattr(keys, "ndim", 1) == 2
+                     and keys.dtype == np.uint8)
+        if isinstance(keys, list) or flba_rows:
+            # BYTE_ARRAY / FLBA keys: Python comparisons in the order domain
+            # (decode_order_value handles decimal two's-complement ordering)
+            if flba_rows:
+                keys = [bytes(r) for r in np.asarray(keys)]
+                if key_valid is not None:
+                    keys = [k if v else None
+                            for k, v in zip(keys, key_valid)]
+            keys = [None if x is None
+                    else decode_order_value(bytes(x), key_leaf)
+                    for x in keys]
             mask = np.fromiter(
                 ((x is not None
                   and (lo is None or x >= lo) and (hi is None or x <= hi))

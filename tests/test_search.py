@@ -337,3 +337,79 @@ def test_read_row_range_aligned_empty():
     # non-aligned empties keep their unaligned shapes too
     assert read_row_range(pf, "s", 10**9, 5) == []
     assert read_row_range(pf, "x", 10**9, 5).dtype == np.int64
+
+
+def test_host_scan_decimal_byte_array_key():
+    """Decimal BYTE_ARRAY keys scan in the unscaled-value order domain (a
+    bytewise compare would both TypeError and mis-order minimal-length
+    encodings)."""
+    import decimal
+
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    vals = [decimal.Decimal(f"{i}.50") for i in range(400)]
+    t = pa.table({"d": pa.array(vals, type=pa.decimal128(30, 2)),
+                  "v": pa.array(np.arange(400, dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, store_decimal_as_integer=False,
+                   write_page_index=True)
+    raw = buf.getvalue()
+    pf = ParquetFile(raw)
+    lo, hi = decimal.Decimal("100.00"), decimal.Decimal("110.00")
+    out = scan_filtered(pf, "d", lo=lo, hi=hi, columns=["v"])
+    want = [i for i, v in enumerate(vals) if lo <= v <= hi]
+    np.testing.assert_array_equal(np.sort(np.asarray(out["v"])), want)
+
+
+def test_host_scan_decimal_flba_with_nulls():
+    """Nullable FLBA decimal keys: the aligned trim must fill 2-D byte rows
+    (review regression: 1-D zero fill crashed on any null)."""
+    import decimal
+
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    vals = [None if i % 7 == 0 else decimal.Decimal(f"{i}.25")
+            for i in range(300)]
+    t = pa.table({"d": pa.array(vals, type=pa.decimal128(25, 2)),
+                  "v": pa.array(np.arange(300, dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, store_decimal_as_integer=False,
+                   write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+    lo, hi = decimal.Decimal("50.00"), decimal.Decimal("60.00")
+    out = scan_filtered(pf, "d", lo=lo, hi=hi, columns=["v"])
+    want = [i for i, v in enumerate(vals) if v is not None and lo <= v <= hi]
+    np.testing.assert_array_equal(np.sort(np.asarray(out["v"])), want)
+
+
+def test_device_scan_rejects_byte_array_decimal_key():
+    """A decimal annotated over BYTE_ARRAY (legacy Hive/Spark layout) must
+    hit the dedicated 'decimal byte array' rejection, not bytewise compare
+    (pyarrow always writes FLBA, so build the schema with our writer)."""
+    from parquet_tpu.format.enums import Type as PT
+    from parquet_tpu.io.writer import ColumnData, ParquetWriter, WriterOptions
+    from parquet_tpu.parallel.host_scan import stage_scan
+    from parquet_tpu.schema import schema as sch
+    from parquet_tpu.schema.types import LogicalKind
+
+    root = sch.message("m", [
+        sch.leaf("d", PT.BYTE_ARRAY, logical=LogicalKind.DECIMAL,
+                 precision=20, scale=2),
+        sch.leaf("v", PT.INT64),
+    ])
+    # minimal-length big-endian two's complement values
+    raws = [bytes([i + 1]) for i in range(50)]
+    offs = np.zeros(51, np.int64)
+    np.cumsum([len(r) for r in raws], out=offs[1:])
+    buf = io.BytesIO()
+    w = ParquetWriter(buf, root, WriterOptions(dictionary=False))
+    w.write_row_group({
+        "d": ColumnData(values=np.frombuffer(b"".join(raws), np.uint8),
+                        offsets=offs),
+        "v": ColumnData(values=np.arange(50, dtype=np.int64)),
+    }, 50)
+    w.close()
+    pf = ParquetFile(buf.getvalue())
+    assert pf.schema.leaf("d").physical_type == PT.BYTE_ARRAY
+    with pytest.raises(ValueError, match="decimal byte array"):
+        stage_scan(pf, "d", lo=1, hi=9, columns=["v"])
