@@ -272,3 +272,43 @@ def test_null_list_spans_roundtrip_any_page_size(data):
     want = t.column("xs").to_pylist()
     assert pq.read_table(io.BytesIO(raw)).column("xs").to_pylist() == want
     assert ParquetFile(raw).read().to_arrow().column("xs").to_pylist() == want
+
+
+@settings(max_examples=30, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(data=st.data())
+def test_typed_maps_and_repeated_groups_roundtrip(data):
+    """Property: random Dict[str,int] + List[dataclass] instances round-trip
+    through the typed API (SchemaOf parity for Go maps/[]struct)."""
+    import dataclasses
+    from typing import Dict, List, Optional
+
+    from parquet_tpu.typed import read_objects, write_objects
+
+    @dataclasses.dataclass
+    class P:
+        x: int
+        tag: Optional[str]
+
+    @dataclasses.dataclass
+    class R:
+        rid: int
+        attrs: Dict[str, int]
+        pts: List[P]
+        opt: Optional[Dict[str, Optional[float]]]
+
+    keys = st.text(alphabet="abcdef", min_size=1, max_size=4)
+    objs = data.draw(st.lists(st.builds(
+        R,
+        rid=st.integers(-(2**60), 2**60),
+        attrs=st.dictionaries(keys, st.integers(-(2**60), 2**60), max_size=4),
+        pts=st.lists(st.builds(
+            P, x=st.integers(-(2**31), 2**31),
+            tag=st.none() | st.text(max_size=6)), max_size=3),
+        opt=st.none() | st.dictionaries(
+            keys, st.none() | st.floats(allow_nan=False, width=64),
+            max_size=3),
+    ), min_size=1, max_size=40))
+    buf = io.BytesIO()
+    write_objects(objs, buf, R)
+    assert read_objects(buf.getvalue(), R) == objs
