@@ -121,16 +121,56 @@ def convert_column_data(rg: RowGroupReader, dst_leaf: Leaf,
             f"depth {src_leaf.max_repetition_level}, target depth "
             f"{dst_leaf.max_repetition_level}")
     if src_leaf is None:
-        if dst_leaf.max_definition_level == 0:
+        if structural_conflict(src_schema, dst_leaf):
             raise TypeError(
-                f"source lacks required column {dst_leaf.dotted_path!r}")
-        n = rg.num_rows
-        empty = np.empty(0, dtype=dst_leaf.np_dtype() or np.uint8)
-        return ColumnData(values=empty,
-                          offsets=np.zeros(1, np.int64) if dst_leaf.physical_type == Type.BYTE_ARRAY else None,
-                          validity=np.zeros(n, dtype=bool))
+                f"cannot convert {dst_leaf.dotted_path!r}: source stores a "
+                "column of different nesting structure under the same name")
+        return null_fill_column(dst_leaf, rg.num_rows)
     col = rg.column(src_leaf.column_index).read()
     return column_to_data(col, src_leaf, dst_leaf)
+
+
+def structural_conflict(src_schema: Schema, dst_leaf: Leaf) -> bool:
+    """True when the source has a leaf whose path is a strict prefix of (or
+    is prefixed by) the target leaf's path — i.e. the same name holds a
+    different nesting structure.  Distinct from a genuinely missing column
+    (e.g. a new field inside an existing struct), which null-fills."""
+    d = tuple(dst_leaf.path)
+    for l in src_schema.leaves:
+        s = tuple(l.path)
+        if s == d:
+            return False  # same path: the normal convert path handles it
+        if s[:len(d)] == d or d[:len(s)] == s:
+            return True
+    return False
+
+
+def null_fill_column(leaf: Leaf, n: int) -> ColumnData:
+    """All-null ColumnData for a target leaf absent from a source (the leaf
+    must be nullable).  Shapes match decoded batches so the fill concatenates
+    with real chunks: BYTE_ARRAY gets empty offsets, FLBA/INT96 a (0, width)
+    2-D byte block, single-level lists become ``n`` null lists."""
+    if leaf.max_definition_level == 0:
+        raise TypeError(f"source lacks required column {leaf.dotted_path!r}")
+    t = leaf.physical_type
+    offsets = None
+    if t == Type.BYTE_ARRAY:
+        empty = np.empty(0, np.uint8)
+        offsets = np.zeros(1, np.int64)
+    elif t in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+        empty = np.empty((0, leaf.type_length or 12), np.uint8)
+    else:
+        empty = np.empty(0, dtype=leaf.np_dtype() or np.uint8)
+    if leaf.max_repetition_level:
+        if leaf.max_repetition_level > 1:
+            raise NotImplementedError(
+                f"cannot null-fill multi-level nested column "
+                f"{leaf.dotted_path!r}")
+        return ColumnData(values=empty, offsets=offsets,
+                          list_offsets=np.zeros(n + 1, np.int64),
+                          list_validity=np.zeros(n, dtype=bool))
+    return ColumnData(values=empty, offsets=offsets,
+                      validity=np.zeros(n, dtype=bool))
 
 
 def column_to_data(col: Column, src: Leaf, dst: Optional[Leaf] = None) -> ColumnData:

@@ -2,33 +2,44 @@
 
 Reference parity: ``merge.go — MergeRowGroups/mergedRowGroup`` (SURVEY.md
 §3.4): a heap-based k-way ordered merge over RowGroup cursors.  TPU-first
-reformulation: k sorted runs are merged by *concatenate + stable argsort on
-the key columns* — one vectorized gather instead of a row-at-a-time heap.
-(O(n log n) vs O(n log k), but every op is a wide vector op that XLA/numpy
-executes orders of magnitude faster than a Python heap loop; this is the
-trade the whole framework makes.)
+reformulation: instead of a row-at-a-time heap, sorted runs are merged with
+*bounded concat + stable argsort windows* — each iteration pulls one batch
+per run, sorts the window with one vectorized argsort, and emits every row
+that is provably ≤ the merge frontier (the smallest last-pulled key among
+runs that still have data).  Every op is a wide vector op; memory is
+O(k · batch_rows), matching the reference's streaming ``mergedRowGroup``
+discipline (it holds O(k) cursors; we hold O(k) batches).
+
+:func:`merge_row_groups` remains the small fully-in-memory variant (concat +
+one argsort == k-way merge for pre-sorted inputs); :func:`merge_files` and
+:func:`iter_merged` are the streaming path used by
+:class:`~parquet_tpu.algebra.sorting.SortingWriter`, whose ``close()`` must
+not re-materialize the spills it just bounded.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..io.reader import ParquetFile, RowGroupReader
-from ..io.writer import ColumnData, ParquetWriter, WriterOptions
+from ..io.writer import ColumnData, ParquetWriter, WriterOptions, _extend_cd
 from ..schema.schema import Schema
 from .buffer import SortingColumn, TableBuffer, permute_column
-from .convert import convert_column_data
+from .convert import (column_to_data, convert_column_data, null_fill_column,
+                      structural_conflict)
 
 
 def merge_row_groups(sources: Sequence[RowGroupReader],
                      sorting: Sequence[SortingColumn],
                      schema: Optional[Schema] = None) -> TableBuffer:
-    """Merge already-sorted row groups into one sorted buffer.
+    """Merge already-sorted row groups into one sorted in-memory buffer.
 
-    Schemas must be convertible (reference: merge validates via convert.go);
-    pass ``schema`` to convert all inputs to a target schema first."""
+    Materializes all inputs — use :func:`merge_files`/:func:`iter_merged`
+    when the combined size must stay bounded.  Schemas must be convertible
+    (reference: merge validates via convert.go); pass ``schema`` to convert
+    all inputs to a target schema first."""
     if not sources:
         raise ValueError("no row groups to merge")
     target = schema or sources[0].file.schema
@@ -44,18 +55,277 @@ def merge_row_groups(sources: Sequence[RowGroupReader],
     return buf
 
 
+# ----------------------------------------------------------------------
+# streaming merge
+
+
+class _RunCursor:
+    """Pulls row-aligned batches from one sorted source file, converted to
+    the target schema's ColumnData."""
+
+    def __init__(self, pf: ParquetFile, target: Schema, batch_rows: int):
+        from ..io.stream import iter_batches
+
+        self.pf = pf
+        self.target = target
+        self._same_schema = pf.schema is target or (
+            [l.dotted_path for l in pf.schema.leaves]
+            == [l.dotted_path for l in target.leaves])
+        cols = ([l.dotted_path for l in target.leaves
+                 if _has_leaf(pf.schema, l.dotted_path)]
+                if not self._same_schema else None)
+        self._it = iter_batches(pf, columns=cols, batch_rows=batch_rows)
+        self.exhausted = False
+
+    def pull(self) -> Optional[Tuple[Dict[str, ColumnData], int]]:
+        t = next(self._it, None)
+        if t is None:
+            self.exhausted = True
+            return None
+        cols: Dict[str, ColumnData] = {}
+        for leaf in self.target.leaves:
+            p = leaf.dotted_path
+            if p in t.columns:
+                src_leaf = self.pf.schema.leaf(p)
+                if src_leaf.max_repetition_level != leaf.max_repetition_level:
+                    # same validation as convert_column_data: a flat column
+                    # cannot silently stand in for a list (or vice versa)
+                    raise TypeError(
+                        f"cannot merge {p!r}: source is nested depth "
+                        f"{src_leaf.max_repetition_level}, target depth "
+                        f"{leaf.max_repetition_level}")
+                cd = column_to_data(t.columns[p], src_leaf, leaf)
+                if cd.def_levels is not None:
+                    raise NotImplementedError(
+                        f"streaming merge does not support multi-level nested "
+                        f"column {p!r} (depth > 1); use merge_row_groups")
+            else:
+                if structural_conflict(self.pf.schema, leaf):
+                    raise TypeError(
+                        f"cannot merge {p!r}: source stores a column of "
+                        "different nesting structure under the same name")
+                cd = null_fill_column(leaf, t.num_rows)
+            cols[p] = cd
+        return cols, t.num_rows
+
+
+def _open_files(paths_or_files) -> Tuple[List[ParquetFile], List[ParquetFile]]:
+    """(all files, the subset opened here — caller must close those).
+    A failed open closes everything opened so far before re-raising."""
+    files: List[ParquetFile] = []
+    opened: List[ParquetFile] = []
+    try:
+        for p in paths_or_files:
+            if isinstance(p, ParquetFile):
+                files.append(p)
+            else:
+                pf = ParquetFile(p)
+                files.append(pf)
+                opened.append(pf)
+    except BaseException:
+        for pf in opened:
+            pf.close()
+        raise
+    if not files:
+        raise ValueError("no files to merge")
+    return files, opened
+
+
+def _has_leaf(schema: Schema, path: str) -> bool:
+    try:
+        schema.leaf(path)
+        return True
+    except KeyError:
+        return False
+
+
+def _merge_keys(target: Schema, sorting: Sequence[SortingColumn],
+                cols: Dict[str, ColumnData], n: int) -> List[np.ndarray]:
+    """Per-row key columns for one window, primary first.
+
+    Rank-based keys (from :func:`compare.sort_key`) are consistent only
+    *within* the window — which is all the frontier test needs, since the
+    frontier row is itself a window row.  Float keys are split into
+    (nan→+inf value, isnan flag) pairs so NaN orders after all numbers under
+    plain ``<`` / ``==`` comparisons (compare.py semantics)."""
+    from .compare import sort_key
+
+    keys: List[np.ndarray] = []
+    for sc in sorting:
+        leaf = target.leaf(sc.path)
+        if leaf.max_repetition_level:
+            raise ValueError("cannot merge by a repeated column")
+        k = sort_key(leaf, cols[leaf.dotted_path], n,
+                     descending=sc.descending, nulls_first=sc.nulls_first)
+        k = np.asarray(k)
+        if k.dtype.kind == "f":
+            nan = np.isnan(k)
+            keys.append(np.where(nan, np.inf, k))
+            keys.append(nan.astype(np.int8))
+        else:
+            keys.append(k)
+    return keys
+
+
+def _check_runs_sorted(keys: List[np.ndarray], origin: np.ndarray,
+                       n: int) -> None:
+    """Loud failure on unsorted input runs: within the window, each run's
+    rows (in arrival order) must be non-decreasing under the merge key.
+    Covers within-batch disorder and batch-to-carryover boundaries — the
+    merge's correctness precondition (merge.go also assumes sorted runs,
+    but we can check vectorized at ~key-build cost)."""
+    if n < 2:
+        return
+    ordv = np.argsort(origin, kind="stable")   # group rows by run, in order
+    same = origin[ordv][1:] == origin[ordv][:-1]
+    if not same.any():
+        return
+    lt = np.zeros(n - 1, bool)    # next < prev lexicographically
+    eq = np.ones(n - 1, bool)
+    for k in keys:
+        a = k[ordv]
+        lt |= eq & (a[1:] < a[:-1])
+        eq &= a[1:] == a[:-1]
+    if (same & lt).any():
+        bad = int(origin[ordv][1:][same & lt][0])
+        raise ValueError(
+            f"merge input run {bad} is not sorted by the merge key; "
+            "merge requires pre-sorted runs (sort each input first)")
+
+
+def iter_merged(paths_or_files, sorting: Sequence[SortingColumn],
+                schema: Optional[Schema] = None,
+                batch_rows: int = 1 << 16,
+                ) -> Iterator[Tuple[Dict[str, ColumnData], int]]:
+    """Stream the k-way ordered merge of sorted files as sorted
+    ``(columns, num_rows)`` chunks, holding O(k · batch_rows) rows.
+
+    Reference parity: ``merge.go — mergedRowGroup.Rows()`` (SURVEY.md §3.4),
+    reformulated vectorized: per iteration, runs with no buffered rows pull
+    their next batch; the window (all buffered rows) is argsorted once; rows
+    whose key ≤ the frontier (min over last-pulled keys of runs that may
+    still produce data) are emitted, the rest carry over.  Each emitted chunk
+    is globally sorted and chunks concatenate to the full merge.
+
+    Files opened here (path/bytes inputs) are closed when the
+    generator finishes or is closed; caller-provided
+    :class:`ParquetFile` objects stay open."""
+    files, opened = _open_files(paths_or_files)
+    try:
+        yield from _iter_merged_open(files, sorting, schema, batch_rows)
+    finally:
+        for pf in opened:
+            pf.close()
+
+
+def _iter_merged_open(files: Sequence[ParquetFile],
+                      sorting: Sequence[SortingColumn],
+                      schema: Optional[Schema], batch_rows: int,
+                      ) -> Iterator[Tuple[Dict[str, ColumnData], int]]:
+    target = schema or files[0].schema
+    cursors = [_RunCursor(f, target, batch_rows) for f in files]
+    paths = [l.dotted_path for l in target.leaves]
+    leaves = {l.dotted_path: l for l in target.leaves}
+
+    if not sorting:
+        # unordered merge == concatenation in file order (reference:
+        # MergeRowGroups without sorting columns concatenates)
+        for cur in cursors:
+            while True:
+                got = cur.pull()
+                if got is None:
+                    break
+                yield got
+        return
+
+    window: Optional[Dict[str, ColumnData]] = None
+    win_n = 0
+    origin = np.empty(0, np.int32)
+
+    def append(cols: Dict[str, ColumnData], n: int, who: int) -> None:
+        nonlocal window, win_n, origin
+        if window is None:
+            window = cols
+        else:
+            for p in paths:
+                _extend_cd(window[p], cols[p])
+        win_n += n
+        origin = np.concatenate([origin, np.full(n, who, np.int32)])
+
+    while True:
+        counts = np.bincount(origin, minlength=len(cursors)) if win_n else \
+            np.zeros(len(cursors), np.int64)
+        for i, cur in enumerate(cursors):
+            if not cur.exhausted and counts[i] == 0:
+                got = cur.pull()
+                if got is not None:
+                    append(got[0], got[1], i)
+        if win_n == 0:
+            return
+        live = [i for i, c in enumerate(cursors) if not c.exhausted]
+        keys = _merge_keys(target, sorting, window, win_n)
+        _check_runs_sorted(keys, origin, win_n)
+        perm = (np.lexsort(tuple(reversed(keys))) if len(keys) > 1
+                else np.argsort(keys[0], kind="stable"))
+        if live:
+            pos = np.empty(win_n, np.int64)
+            pos[perm] = np.arange(win_n)
+            # frontier: the minimal-key last-buffered row among live runs;
+            # one vectorized pass (later writes win → last index per run)
+            lasts = np.full(len(cursors), -1, np.int64)
+            lasts[origin] = np.arange(win_n)
+            cands = [int(lasts[i]) for i in live if lasts[i] >= 0]
+            f = min(cands, key=lambda r: pos[r])  # every live run has rows
+            less = np.zeros(win_n, bool)
+            eq = np.ones(win_n, bool)
+            for k in keys:
+                fk = k[f]
+                less |= eq & (k < fk)
+                eq &= k == fk
+            emit = int((less | eq).sum())   # rows ≤ frontier == perm prefix
+        else:
+            emit = win_n                    # all runs done: drain everything
+        out_idx = perm[:emit]
+        out = {p: permute_column(window[p], out_idx, leaves[p]) for p in paths}
+        yield out, emit
+        if emit == win_n:
+            window, win_n, origin = None, 0, np.empty(0, np.int32)
+        else:
+            keep = np.sort(perm[emit:])
+            window = {p: permute_column(window[p], keep, leaves[p])
+                      for p in paths}
+            origin = origin[keep]
+            win_n -= emit
+
+
 def merge_files(paths_or_files, sorting: Sequence[SortingColumn], sink,
-                options: Optional[WriterOptions] = None) -> None:
-    """Compaction helper: merge whole files into one sorted output file."""
-    files = [p if isinstance(p, ParquetFile) else ParquetFile(p)
-             for p in paths_or_files]
-    rgs: List[RowGroupReader] = []
-    for f in files:
-        rgs.extend(f.row_groups)
-    schema = files[0].schema
-    merged = merge_row_groups(rgs, sorting, schema)
-    opts = options or WriterOptions(
-        sorting_columns=[(s.path, s.descending, s.nulls_first) for s in sorting])
-    w = ParquetWriter(sink, schema, opts)
-    merged.flush_to(w)
-    w.close()
+                options: Optional[WriterOptions] = None,
+                batch_rows: int = 1 << 16,
+                row_group_rows: int = 1 << 20,
+                schema: Optional[Schema] = None) -> None:
+    """Compaction helper: stream-merge whole sorted files into one sorted
+    output file with O(k · batch_rows + row_group_rows) memory.
+
+    Reference parity: ``MergeRowGroups`` + ``parquet.CopyRows`` compaction
+    (SURVEY.md §3.4).  Output row groups follow ``options.row_group_size``
+    when ``options`` is given, else ``row_group_rows``."""
+    files, opened = _open_files(paths_or_files)
+    try:
+        schema = schema or files[0].schema
+        if options is None:
+            opts = WriterOptions(
+                sorting_columns=[(s.path, s.descending, s.nulls_first)
+                                 for s in sorting],
+                row_group_size=row_group_rows)
+        else:
+            # the caller's writer options govern the output layout
+            # (row_group_rows applies only to the default options)
+            opts = options
+        w = ParquetWriter(sink, schema, opts)
+        for cols, n in iter_merged(files, sorting, schema,
+                                   batch_rows=batch_rows):
+            w.write(cols, n)   # writer buffers + drains at row_group_size
+        w.close()
+    finally:
+        for pf in opened:
+            pf.close()

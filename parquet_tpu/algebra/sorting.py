@@ -9,14 +9,24 @@ into the destination in sorted order.
 
 from __future__ import annotations
 
+import dataclasses
 import os
+import shutil
 import tempfile
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from ..io.writer import ColumnData, ParquetWriter, WriterOptions
 from ..schema.schema import Schema
 from .buffer import SortingColumn, TableBuffer
 from .merge import merge_files
+
+
+def _unlink_all(paths: Iterable[str]) -> None:
+    for p in paths:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
 
 
 class SortingWriter:
@@ -49,9 +59,12 @@ class SortingWriter:
         if self._buf.num_rows == 0:
             return
         path = os.path.join(self._tmpdir, f"run{len(self._spills):05d}.parquet")
+        # small pages: close()'s streaming merge holds one decoded page per
+        # run cursor, so spill page granularity bounds the merge window
         w = ParquetWriter(path, self.schema,
                           WriterOptions(compression="snappy",
-                                        write_page_index=False))
+                                        write_page_index=False,
+                                        data_page_size=1 << 16))
         self._buf.flush_to(w)  # sorts, writes one row group
         w.close()
         self._spills.append(path)
@@ -59,25 +72,60 @@ class SortingWriter:
     def close(self) -> None:
         if self._closed:
             return
-        if not self._spills:
-            # everything fit in memory: sort + write directly
-            w = ParquetWriter(self.sink, self.schema, self.options)
-            if self._buf.num_rows:
-                self._buf.flush_to(w)
-            w.close()
-        else:
-            self._spill()
-            merge_files(self._spills, self.sorting, self.sink, self.options)
-        for p in self._spills:
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
         try:
-            os.rmdir(self._tmpdir)
-        except OSError:
-            pass
-        self._closed = True
+            if not self._spills:
+                # everything fit in memory: sort + write directly
+                w = ParquetWriter(self.sink, self.schema, self.options)
+                if self._buf.num_rows:
+                    self._buf.flush_to(w)
+                w.close()
+            else:
+                self._spill()
+                self._merge_spills()
+        finally:
+            # every spill and intermediate generation lives in the tmpdir:
+            # one tree removal is exception-safe cleanup for all of them
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._closed = True
+
+    def _merge_spills(self) -> None:
+        # streaming k-way merge: the window is O(k · batch) rows, so the
+        # per-run batch scales as buffer_rows / k.  When k would push the
+        # batch under a useful floor, merge hierarchically (groups of
+        # max_fanin runs into intermediate runs) so every pass keeps
+        # k · batch ≤ buffer_rows — close() stays O(buffer_rows) no matter
+        # how many spills accumulated.
+        spill_opts = WriterOptions(compression="snappy",
+                                   write_page_index=False,
+                                   data_page_size=1 << 16,
+                                   row_group_size=self.buffer_rows)
+        # fd bound: each open run holds one descriptor, so fan-in is capped
+        # at 64 regardless of buffer_rows (hierarchy absorbs any spill count)
+        max_fanin = max(2, min(64, self.buffer_rows // 1024))
+        runs = list(self._spills)
+        gen = 0
+        while len(runs) > max_fanin:
+            nxt: List[str] = []
+            for gi in range(0, len(runs), max_fanin):
+                group = runs[gi:gi + max_fanin]
+                path = os.path.join(
+                    self._tmpdir, f"gen{gen}_{len(nxt):05d}.parquet")
+                merge_files(group, self.sorting, path, spill_opts,
+                            batch_rows=max(1024,
+                                           self.buffer_rows // len(group)))
+                nxt.append(path)
+            _unlink_all(runs)  # consumed: temp disk stays O(data), not O(gens)
+            runs = nxt
+            gen += 1
+        batch = max(1024, self.buffer_rows // max(1, len(runs)))
+        # the output writer buffers one full row group; clamp its size to
+        # buffer_rows so close() honors the bounded-memory contract
+        out_opts = self.options
+        if out_opts.row_group_size > self.buffer_rows:
+            out_opts = dataclasses.replace(out_opts,
+                                           row_group_size=self.buffer_rows)
+        merge_files(runs, self.sorting, self.sink, out_opts,
+                    batch_rows=batch)
 
     def __enter__(self):
         return self

@@ -382,10 +382,31 @@ class ParquetFile:
                 cols[leaf.dotted_path] = (concat_columns(parts)
                                           if len(parts) != 1 else parts[0])
             return Table(self.schema, cols, self.num_rows)
-        for leaf in leaves:
-            parts = [decode_chunk_host(self.row_group(i).column(leaf.column_index))
-                     for i in range(n_rg)]
-            cols[leaf.dotted_path] = concat_columns(parts) if len(parts) != 1 else parts[0]
+        # fan the (leaf, row-group) chunks across the shared pool — the
+        # reference's read path is goroutine-parallel by design (SURVEY.md
+        # §2.5a caller-driven fan-out); decompress/decode release the GIL in
+        # the codec and native layers, so threads scale on host.  Chunk
+        # readers are built serially (metadata memoization isn't locked).
+        chunks = [[self.row_group(i).column(leaf.column_index)
+                   for i in range(n_rg)] for leaf in leaves]
+        # same measured crossover as parallel/host_scan.py: under ~2M cells
+        # the per-task dispatch overhead beats the decode win
+        if n_rg * len(leaves) > 1 and self.num_rows * len(leaves) >= 2_000_000:
+            from ..utils.pool import shared_pool
+
+            pool = shared_pool()
+            futs = {leaf.dotted_path: [pool.submit(decode_chunk_host, c)
+                                       for c in per_leaf]
+                    for leaf, per_leaf in zip(leaves, chunks)}
+            for leaf in leaves:
+                parts = [f.result() for f in futs[leaf.dotted_path]]
+                cols[leaf.dotted_path] = (concat_columns(parts)
+                                          if len(parts) != 1 else parts[0])
+        else:
+            for leaf, per_leaf in zip(leaves, chunks):
+                parts = [decode_chunk_host(c) for c in per_leaf]
+                cols[leaf.dotted_path] = (concat_columns(parts)
+                                          if len(parts) != 1 else parts[0])
         return Table(self.schema, cols, self.num_rows)
 
     def close(self):

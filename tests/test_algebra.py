@@ -206,3 +206,224 @@ def test_convert_timestamp_unit_widening():
     assert not can_convert(us_leaf, ms_leaf)
     with pytest.raises(TypeError):
         convert_values(np.array(ts), us_leaf, ms_leaf)
+
+
+# ----------------------------------------------------------------------
+# streaming k-way merge (merge.go — mergedRowGroup parity: bounded memory)
+
+
+def _sorted_table_bytes(rng, n, with_nulls=False, with_nan=False,
+                        with_list=False, descending=False):
+    k = rng.integers(0, 10**6, n)
+    k = np.sort(k)[::-1].copy() if descending else np.sort(k)
+    cols = {"k": pa.array(k)}
+    if with_nulls:
+        s = [None if rng.random() < 0.2 else f"s{int(v):07d}" for v in k]
+        cols["s"] = pa.array(s)
+    if with_nan:
+        f = rng.random(n)
+        f[rng.random(n) < 0.1] = np.nan
+        cols["f"] = pa.array(f)
+    if with_list:
+        lists = [None if i % 11 == 3 else
+                 [int(x) for x in rng.integers(0, 99, i % 4)]
+                 for i in range(n)]
+        cols["l"] = pa.array(lists, type=pa.list_(pa.int64()))
+    buf = io.BytesIO()
+    write_table(pa.table(cols), buf, WriterOptions(dictionary=False))
+    return buf.getvalue()
+
+
+def test_iter_merged_matches_materialized(rng):
+    from parquet_tpu.algebra.merge import iter_merged
+
+    runs = [_sorted_table_bytes(rng, n, with_nulls=True, with_list=True)
+            for n in (3000, 1700, 4200, 10)]
+    files = [ParquetFile(r) for r in runs]
+    chunks = list(iter_merged(files, [SortingColumn("k")],
+                              batch_rows=512))
+    total = sum(n for _, n in chunks)
+    assert total == 3000 + 1700 + 4200 + 10
+    ks = np.concatenate([np.asarray(c["k"].values) for c, _ in chunks])
+    assert (np.diff(ks) >= 0).all()
+    expect = np.sort(np.concatenate(
+        [np.asarray(pq.read_table(io.BytesIO(r))["k"]) for r in runs]))
+    np.testing.assert_array_equal(ks, expect)
+    # payload stays row-aligned: string value encodes its key
+    for cols, n in chunks:
+        cd = cols["s"]
+        offs, vals, valid = cd.offsets, np.asarray(cd.values), cd.validity
+        kk = np.asarray(cols["k"].values)
+        vi = 0
+        for row in range(n):
+            if valid is None or valid[row]:
+                got = vals[offs[vi]:offs[vi + 1]].tobytes().decode()
+                assert got == f"s{int(kk[row]):07d}"
+                vi += 1
+
+
+def test_streaming_merge_files_multikey_nan_descending(rng):
+    runs = []
+    for n in (900, 1300, 400):
+        k = np.sort(rng.integers(0, 40, n))[::-1].copy()
+        f = rng.random(n)
+        f[rng.random(n) < 0.15] = np.nan
+        # secondary key unsorted within runs is fine for the merge only if
+        # runs are sorted by the full key — sort rows by (k desc, f asc)
+        order = np.lexsort((np.where(np.isnan(f), np.inf, f),
+                            np.isnan(f), -k))
+        buf = io.BytesIO()
+        write_table(pa.table({"k": pa.array(k[order]), "f": pa.array(f[order])}),
+                    buf, WriterOptions(dictionary=False))
+        runs.append(buf.getvalue())
+    out = io.BytesIO()
+    sorting = [SortingColumn("k", descending=True), SortingColumn("f")]
+    merge_files(runs, sorting, out, batch_rows=128, row_group_rows=700)
+    got = pq.read_table(io.BytesIO(out.getvalue()))
+    k = np.asarray(got["k"])
+    f = np.asarray(got["f"])
+    assert (np.diff(k) <= 0).all()
+    for kk in np.unique(k):
+        sub = f[k == kk]
+        fin = sub[~np.isnan(sub)]
+        assert (np.diff(fin) >= 0).all()
+        # NaNs rank after all numbers
+        first_nan = np.argmax(np.isnan(sub)) if np.isnan(sub).any() else len(sub)
+        assert not np.isnan(sub[:first_nan]).any()
+        assert np.isnan(sub[first_nan:]).all()
+    # multi-row-group output
+    assert len(ParquetFile(out.getvalue()).row_groups) >= 3
+
+
+def test_sorting_writer_bounded_close(rng):
+    """close() memory is O(buffer_rows), not O(total): 10× buffer_rows of
+    rows must merge without re-materializing every spill."""
+    import tracemalloc
+
+    t_schema = pa.schema([("k", pa.int64()), ("s", pa.string())])
+    schema = schema_from_arrow(t_schema)
+    buffer_rows = 20_000
+    n_total = 10 * buffer_rows
+    out = io.BytesIO()
+    w = SortingWriter(out, schema, [SortingColumn("k")],
+                      buffer_rows=buffer_rows)
+    all_k = []
+    for start in range(0, n_total, buffer_rows):
+        k = rng.integers(0, 10**9, buffer_rows)
+        all_k.append(k)
+        s = [f"payload-{int(v):012d}-xxxxxxxxxxxxxxxx" for v in k]
+        w.write_arrow(pa.table({"k": pa.array(k), "s": pa.array(s)}))
+    tracemalloc.start()
+    w.close()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    got = pq.read_table(io.BytesIO(out.getvalue()))
+    np.testing.assert_array_equal(np.asarray(got["k"]),
+                                  np.sort(np.concatenate(all_k)))
+    # full materialization held several O(n_total) copies (≥ 10 MB each, and
+    # far more on the no-native oracle paths); the bounded merge stays O(k·page
+    # + batch) — ~30 MB native, ~42 MB no-native. 60 MB is comfortably under
+    # any O(total) regression while tolerating oracle-path overhead.
+    assert peak < 60e6, f"close() peak {peak/1e6:.1f} MB — not bounded"
+
+
+def test_sorting_writer_hierarchical_merge(rng):
+    """Many small spills with a tiny buffer force the hierarchical
+    (multi-pass) merge in close(); output must still be the full sort."""
+    t_schema = pa.schema([("k", pa.int64()), ("v", pa.float64())])
+    schema = schema_from_arrow(t_schema)
+    out = io.BytesIO()
+    # buffer_rows=1500 → max_fanin=2 → 3 levels for ~5 spills
+    w = SortingWriter(out, schema, [SortingColumn("k")], buffer_rows=1500)
+    all_k = []
+    for _ in range(8):
+        k = rng.integers(0, 10**9, 900)
+        all_k.append(k)
+        w.write_arrow(pa.table({"k": pa.array(k),
+                                "v": pa.array(rng.random(900))}))
+    w.close()
+    got = pq.read_table(io.BytesIO(out.getvalue()))
+    np.testing.assert_array_equal(np.asarray(got["k"]),
+                                  np.sort(np.concatenate(all_k)))
+
+
+def test_iter_merged_missing_list_column(rng):
+    """A source lacking an optional list column merges as null lists."""
+    from parquet_tpu.algebra.merge import iter_merged
+
+    a_k = np.sort(rng.integers(0, 1000, 300))
+    lists = [[int(x) for x in rng.integers(0, 9, i % 4)] for i in range(300)]
+    ta = pa.table({"k": pa.array(a_k),
+                   "l": pa.array(lists, type=pa.list_(pa.int64()))})
+    buf_a = io.BytesIO()
+    write_table(ta, buf_a, WriterOptions(dictionary=False))
+    b_k = np.sort(rng.integers(0, 1000, 200))
+    buf_b = io.BytesIO()
+    write_table(pa.table({"k": pa.array(b_k)}), buf_b,
+                WriterOptions(dictionary=False))
+    schema = schema_from_arrow(ta.schema)
+    for order in ((buf_a.getvalue(), buf_b.getvalue()),
+                  (buf_b.getvalue(), buf_a.getvalue())):
+        files = [ParquetFile(x) for x in order]
+        out = io.BytesIO()
+        merge_files(files, [SortingColumn("k")], out, batch_rows=64,
+                    schema=schema)
+        got = pq.read_table(io.BytesIO(out.getvalue()))
+        np.testing.assert_array_equal(
+            np.asarray(got["k"]), np.sort(np.concatenate([a_k, b_k])))
+        assert got["l"].null_count == 200  # B's rows are null lists
+        # A's lists survive with elements intact
+        total_elems = sum(len(x) for x in lists)
+        assert sum(len(x) for x in got["l"].to_pylist() if x is not None) \
+            == total_elems
+
+
+def test_iter_merged_missing_flba_decimal_column(rng):
+    """Null-filling an FLBA (decimal128) column must match the 2-D decoded
+    value shape (reviewer repro: 1-D/2-D concat crash)."""
+    import decimal
+
+    a_k = np.sort(rng.integers(0, 1000, 120))
+    dec = [decimal.Decimal(int(v)) / 100 for v in a_k]
+    ta = pa.table({"k": pa.array(a_k),
+                   "d": pa.array(dec, type=pa.decimal128(20, 2))})
+    buf_a = io.BytesIO()
+    write_table(ta, buf_a, WriterOptions(dictionary=False))
+    b_k = np.sort(rng.integers(0, 1000, 80))
+    buf_b = io.BytesIO()
+    write_table(pa.table({"k": pa.array(b_k)}), buf_b,
+                WriterOptions(dictionary=False))
+    schema = schema_from_arrow(ta.schema)
+    out = io.BytesIO()
+    merge_files([buf_a.getvalue(), buf_b.getvalue()], [SortingColumn("k")],
+                out, batch_rows=32, schema=schema)
+    got = pq.read_table(io.BytesIO(out.getvalue()))
+    np.testing.assert_array_equal(np.asarray(got["k"]),
+                                  np.sort(np.concatenate([a_k, b_k])))
+    assert got["d"].null_count == 80
+
+
+def test_streaming_merge_depth_mismatch_raises(rng):
+    """A flat source column cannot silently stand in for a list column."""
+    k = np.sort(rng.integers(0, 100, 50))
+    t_list = pa.table({"k": pa.array(k),
+                       "l": pa.array([[1, 2]] * 50, type=pa.list_(pa.int64()))})
+    t_flat = pa.table({"k": pa.array(k), "l": pa.array(np.arange(50))})
+    ba, bb = io.BytesIO(), io.BytesIO()
+    write_table(t_list, ba, WriterOptions(dictionary=False))
+    write_table(t_flat, bb, WriterOptions(dictionary=False))
+    schema = schema_from_arrow(t_list.schema)
+    with pytest.raises(TypeError, match="depth|structure"):
+        merge_files([ba.getvalue(), bb.getvalue()], [SortingColumn("k")],
+                    io.BytesIO(), batch_rows=16, schema=schema)
+
+
+def test_merge_unsorted_input_raises(rng):
+    """Streaming merge validates its precondition loudly."""
+    k = rng.integers(0, 10**6, 5000)  # NOT sorted
+    buf = io.BytesIO()
+    write_table(pa.table({"k": pa.array(k)}), buf,
+                WriterOptions(dictionary=False))
+    with pytest.raises(ValueError, match="not sorted"):
+        merge_files([buf.getvalue()], [SortingColumn("k")], io.BytesIO(),
+                    batch_rows=256)
