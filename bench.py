@@ -34,6 +34,18 @@ import subprocess
 import sys
 import time
 
+# glibc returns every large free() to the kernel by default (mmap/munmap per
+# decode buffer), so steady-state decode refaults all its pages each rep —
+# measured 2x on the lineitem config.  The tunables are only read at process
+# start, so re-exec once with them set (pyarrow ships jemalloc and is immune;
+# without this the comparison measures allocators, not decoders).
+if os.environ.get("_BENCH_MALLOC_TUNED") != "1":
+    env = dict(os.environ,
+               _BENCH_MALLOC_TUNED="1",
+               MALLOC_MMAP_THRESHOLD_="17179869184",
+               MALLOC_TRIM_THRESHOLD_="17179869184")
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -147,6 +159,31 @@ def _time_best(fn, reps=5):
     return best
 
 
+# v5e HBM ~819 GB/s: any "decode" rate above this is not a measurement of
+# sustained work (tunnel result-cache hit / async artifact) — refuse it
+_HBM_BW_CEIL_GBPS = 850.0
+
+
+def _salted_plan(plan, salt: int):
+    """A structurally identical plan whose staged VALUE bytes are XOR-salted.
+
+    Level streams and host-computed run tables are untouched, so shapes,
+    bucketing, and the compiled program are shared with the original — but
+    every staged value buffer differs, so a content-keyed result cache
+    between timed dispatches cannot serve a hit.  Decoded values are garbage
+    (gathers clamp out-of-range), which is irrelevant for timing: the
+    compute is shape-static and data-independent under jit."""
+    import copy
+
+    p = copy.copy(plan)
+    s = np.uint8(salt & 0xFF)
+    if getattr(plan, "values", None):
+        p.values = bytes(np.frombuffer(plan.values, np.uint8) ^ s)
+    if getattr(plan, "dense", None):
+        p.dense = bytearray(np.frombuffer(bytes(plan.dense), np.uint8) ^ s)
+    return p
+
+
 def _write(table, **kw):
     buf = io.BytesIO()
     pq.write_table(table, buf, row_group_size=1 << 23, write_statistics=False,
@@ -165,8 +202,18 @@ def _block(col):
         d.block_until_ready()
 
 
-def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None):
-    """Configs 1-4 core: host plan -> stage once -> timed device decode."""
+def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None, reps=4):
+    """Configs 1-4 core: host plan -> stage -> timed device decode + e2e.
+
+    Cache-honesty protocol (VERDICT r2 item 1): the kernel phase times one
+    dispatch per XOR-salted plan variant — every timed dispatch carries
+    distinct staged bytes, so a tunnel/result cache cannot serve any of
+    them; compile is warmed on a separate salt that is never timed.  A
+    kernel rate above HBM bandwidth is refused (reported as null with
+    ``exceeds_physics``).  ``e2e_s`` is the sustained pipeline number: wall
+    clock of the full pread → decompress/prescan → H2D → decode chain via
+    decode_chunks_pipelined on a cold ParquetFile (compile warm, content
+    never dispatched before)."""
     import jax
     from parquet_tpu.io.reader import ParquetFile
     from parquet_tpu.parallel import device_reader as dr
@@ -179,36 +226,72 @@ def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None):
     plan = dr.build_plan(chunk)
     host_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    staged = dr.stage_plan(plan,
-                           stage_levels=dr.stage_levels_on_device(chunk.leaf, plan))
-    jax.block_until_ready([b for b in staged if b is not None])
-    h2d_s = time.perf_counter() - t0
-
     leaf, physical = chunk.leaf, Type(chunk.meta.type)
+    stage_levels = dr.stage_levels_on_device(chunk.leaf, plan)
 
-    def run():
-        col = dr.decode_staged(leaf, physical, plan, staged)
+    def decode(p, staged):
+        col = dr.decode_staged(leaf, physical, p, staged)
         _block(col)
         return col
 
-    run()  # jit warmup
-    kernel_s = _time_best(run)
+    # warmup/compile on a salt that never appears in a timed dispatch
+    warm_plan = _salted_plan(plan, 0xA5)
+    warm_staged = dr.stage_plan(warm_plan, stage_levels=stage_levels)
+    cache_defeat = True
+    try:
+        decode(warm_plan, warm_staged)
+    except Exception:
+        # a config whose decode rejects salted bytes falls back to the
+        # original plan for every rep (identical inputs: caching possible)
+        cache_defeat = False
+        warm_staged = dr.stage_plan(plan, stage_levels=stage_levels)
+        decode(plan, warm_staged)
+    del warm_staged
+
+    # e2e sustained pipeline on the ORIGINAL bytes (content not yet
+    # dispatched): cold file, wall clock includes pread + decompress +
+    # prescan + H2D + decode
+    t0 = time.perf_counter()
+    col = next(dr.decode_chunks_pipelined(
+        [ParquetFile(raw).row_group(0).column(0)]))
+    _block(col)
+    e2e_s = time.perf_counter() - t0
+
+    # timed kernel phase: one dispatch per distinct salted variant
+    kernel_s = float("inf")
+    h2d_s = float("inf")
+    for i in range(reps):
+        p_i = _salted_plan(plan, i + 1) if cache_defeat else plan
+        t0 = time.perf_counter()
+        staged_i = dr.stage_plan(p_i, stage_levels=stage_levels)
+        jax.block_until_ready([b for b in staged_i if b is not None])
+        h2d_s = min(h2d_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        decode(p_i, staged_i)
+        kernel_s = min(kernel_s, time.perf_counter() - t0)
+        del staged_i
 
     def run_pyarrow():
         pq.read_table(io.BytesIO(raw), use_threads=True, **(pa_read_kw or {}))
 
     run_pyarrow()
     pa_s = _time_best(run_pyarrow, reps=3)
-    return {
-        "GBps": round(arrow_nbytes / kernel_s / 1e9, 2),
+    gbps = arrow_nbytes / kernel_s / 1e9
+    out = {
+        "GBps": round(gbps, 2) if gbps <= _HBM_BW_CEIL_GBPS else None,
         "vs_pyarrow": round(pa_s / kernel_s, 2),
         "kernel_s": round(kernel_s, 5),
+        "e2e_s": round(e2e_s, 4),
+        "e2e_GBps": round(arrow_nbytes / e2e_s / 1e9, 3),
         "host_s": round(host_s, 4),
         "h2d_s": round(h2d_s, 4),
         "pyarrow_s": round(pa_s, 4),
         "arrow_MB": round(arrow_nbytes / 1e6, 1),
+        "distinct_inputs": cache_defeat,
     }
+    if gbps > _HBM_BW_CEIL_GBPS:
+        out["exceeds_physics"] = round(gbps, 2)
+    return out
 
 
 def _cfg1(n):
@@ -365,6 +448,117 @@ def _cfg6(n):
     }
 
 
+def _lineitem_path(n):
+    """Generate (once, cached on disk) a TPC-H lineitem-schema parquet file:
+    16 columns, snappy, multi-row-group — the BASELINE.md north-star shape.
+    Cached under $TMPDIR keyed by row count; ~2.3 GB at the default 24M rows."""
+    cache = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                         f"parquet_tpu_lineitem_{n}.parquet")
+    if os.path.exists(cache) and os.path.getsize(cache) > 0:
+        return cache
+    rng = np.random.default_rng(42)
+    letters = np.frombuffer(b"abcdefghijklmnopqrstuvwxyz ", np.uint8)
+    comment_w = 27
+    comments = letters[rng.integers(0, len(letters), n * comment_w)] \
+        .tobytes().decode()
+    comment_arr = pa.array([comments[i * comment_w:(i + 1) * comment_w]
+                            for i in range(n)])
+    flags = np.array(["A", "N", "R"])
+    status = np.array(["F", "O"])
+    instr = np.array(["DELIVER IN PERSON", "COLLECT COD", "NONE",
+                      "TAKE BACK RETURN"])
+    modes = np.array(["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP",
+                      "TRUCK"])
+    ship = rng.integers(8000, 12000, n).astype(np.int32)
+    t = pa.table({
+        "l_orderkey": pa.array(np.sort(rng.integers(1, n, n)).astype(np.int64)),
+        "l_partkey": pa.array(rng.integers(1, 200_000, n).astype(np.int64)),
+        "l_suppkey": pa.array(rng.integers(1, 10_000, n).astype(np.int64)),
+        "l_linenumber": pa.array(rng.integers(1, 8, n).astype(np.int32)),
+        "l_quantity": pa.array(rng.integers(1, 51, n).astype(np.int64)),
+        "l_extendedprice": pa.array(rng.random(n) * 1e5),
+        "l_discount": pa.array(np.round(rng.random(n) * 0.1, 2)),
+        "l_tax": pa.array(np.round(rng.random(n) * 0.08, 2)),
+        "l_returnflag": pa.array(flags[rng.integers(0, 3, n)]).dictionary_encode(),
+        "l_linestatus": pa.array(status[rng.integers(0, 2, n)]).dictionary_encode(),
+        "l_shipdate": pa.array(ship),
+        "l_commitdate": pa.array(ship + rng.integers(-30, 30, n).astype(np.int32)),
+        "l_receiptdate": pa.array(ship + rng.integers(1, 30, n).astype(np.int32)),
+        "l_shipinstruct": pa.array(instr[rng.integers(0, 4, n)]).dictionary_encode(),
+        "l_shipmode": pa.array(modes[rng.integers(0, 7, n)]).dictionary_encode(),
+        "l_comment": comment_arr,
+    })
+    tmp = cache + ".tmp"
+    pq.write_table(t, tmp, compression="snappy", row_group_size=4_000_000,
+                   data_page_size=1 << 20, write_page_index=True)
+    os.replace(tmp, cache)
+    return cache
+
+
+def _cfg7(n):
+    """Lineitem-scale sustained read (BASELINE.md north star): a multi-GB,
+    16-column, multi-row-group on-disk file, read end to end.
+
+    Reported as decoded-arrow-bytes / wall-clock for (a) the whole-file host
+    read, (b) the bounded-memory streaming read (iter_batches), and — when a
+    real accelerator backend is up — (c) the pipelined device read; all vs
+    pyarrow on the same file.  64 MB toys hide O(n) cliffs; this doesn't."""
+    from parquet_tpu.io.reader import ParquetFile
+
+    path = _lineitem_path(n)
+    file_mb = os.path.getsize(path) / 1e6
+
+    def run_pyarrow():
+        return pq.read_table(path, use_threads=True)
+
+    at = run_pyarrow()
+    arrow_nbytes = at.nbytes
+    del at
+    pa_s = _time_best(run_pyarrow, reps=2)
+
+    pf = ParquetFile(path)
+
+    def run_host():
+        # to the same endpoint pyarrow delivers: one pyarrow.Table
+        return pf.read().to_arrow()
+
+    run_host()
+    host_s = _time_best(run_host, reps=2)
+
+    t0 = time.perf_counter()
+    batches = 0
+    for b in pf.iter_batches(batch_rows=1 << 20):
+        b.to_arrow()
+        batches += 1
+    stream_s = time.perf_counter() - t0
+
+    out = {
+        "file_MB": round(file_mb, 1),
+        "arrow_GB": round(arrow_nbytes / 1e9, 3),
+        "read_s": round(host_s, 3),
+        "read_GBps": round(arrow_nbytes / host_s / 1e9, 3),
+        "stream_s": round(stream_s, 3),
+        "stream_GBps": round(arrow_nbytes / stream_s / 1e9, 3),
+        "pyarrow_s": round(pa_s, 3),
+        "vs_pyarrow": round(pa_s / host_s, 2),
+        "rows": n,
+    }
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        t0 = time.perf_counter()
+        pf2 = ParquetFile(path)
+        dt = pf2.read(device=True)
+        # force materialization + completion: async dispatch must not count
+        # as finished work (same honesty rule as the HBM-ceiling guard)
+        for col in dt.columns.values():
+            _block(col)
+        dev_s = time.perf_counter() - t0
+        out["device_e2e_s"] = round(dev_s, 3)
+        out["device_e2e_GBps"] = round(arrow_nbytes / dev_s / 1e9, 3)
+    return out
+
+
 def main():
     n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 8_000_000
     quick = os.environ.get("BENCH_QUICK", "") not in ("", "0")
@@ -387,10 +581,13 @@ def main():
     configs["4_delta_ts_nested"] = _cfg4(n_rows)
     configs["5_pushdown_scan"] = _cfg5(max(n_rows // 4, 8))
     configs["6_write_mixed"] = _cfg6(max(n_rows // 4, 8))
+    li_rows = int(os.environ.get("BENCH_LINEITEM_ROWS",
+                                 120_000 if quick else 40_000_000))
+    configs["7_lineitem_scale"] = _cfg7(li_rows)
 
     head = configs["1_int64_plain"]
     print(json.dumps({
-        "detail": "per-config breakdown (BASELINE.md configs 1-5 + write)",
+        "detail": "per-config breakdown (BASELINE.md configs 1-5 + write + scale)",
         "rows": n_rows,
         "backend": str(jax.devices()[0]),
         "tpu_available": tpu_ok,
@@ -402,11 +599,18 @@ def main():
         "configs": configs,
     }), file=sys.stderr)
     print(json.dumps({
-        "metric": "decoded GB/s on-chip, INT64 PLAIN scan (config 1)",
-        "value": head["GBps"],
+        # headline = the sustained end-to-end pipeline rate (pread +
+        # decompress/prescan + H2D + decode, wall clock), not the bare
+        # kernel dispatch: the kernel number rewards caches and hides H2D
+        # (VERDICT r2 items 1-2).  Kernel rates stay in "configs" and are
+        # refused outright above HBM bandwidth.
+        "metric": "sustained e2e decoded GB/s, INT64 PLAIN (config 1)",
+        "value": head["e2e_GBps"],
         "unit": "GB/s",
-        "vs_baseline": head["vs_pyarrow"],
-        "configs": {k: (v.get("GBps"), v.get("vs_pyarrow")) for k, v in configs.items()},
+        "vs_baseline": round(head["pyarrow_s"] / head["e2e_s"], 2),
+        "configs": {k: (v.get("GBps", v.get("read_GBps")),
+                        v.get("vs_pyarrow"))
+                    for k, v in configs.items()},
     }))
 
 

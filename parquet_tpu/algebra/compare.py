@@ -133,6 +133,22 @@ def normalize(leaf: Leaf, value):
     return value
 
 
+def in_type_range(leaf: Leaf, value) -> bool:
+    """Can ``value`` (order domain) be a value of this leaf's physical type?
+    Out-of-range IN-list probes can never match and must be dropped, not
+    overflow the numpy cast."""
+    t = leaf.physical_type
+    if t == Type.INT32:
+        return isinstance(value, (int, np.integer)) and (
+            0 <= value < 2**32 if is_unsigned(leaf)
+            else -(2**31) <= value < 2**31)
+    if t == Type.INT64:
+        return isinstance(value, (int, np.integer)) and (
+            0 <= value < 2**64 if is_unsigned(leaf)
+            else -(2**63) <= value < 2**63)
+    return True
+
+
 def compare_func_of(leaf: Leaf, descending: bool = False,
                     nulls_first: bool = False) -> Callable[[Any, Any], int]:
     """cmp(a, b) → -1/0/1 over order-domain values (None = null).

@@ -29,6 +29,20 @@ _SALT = np.array([
     0x705495C7, 0x2DF1424B, 0x9EFC4947, 0x5C6BFB31,
 ], dtype=np.uint64)
 
+_SALT_U32 = _SALT.astype(np.uint32)
+
+
+def _device_live() -> bool:
+    """True when a non-CPU jax backend is already initialized — probing must
+    never be the call that pays (or hangs on) accelerator bring-up."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return False
+
+
 _P1 = np.uint64(11400714785074694791)
 _P2 = np.uint64(14029467366897019727)
 _P3 = np.uint64(1609587929392839161)
@@ -156,6 +170,7 @@ class SplitBlockFilter:
     def insert_hashes(self, hashes: np.ndarray) -> None:
         block_idx, masks = self._masks(hashes)
         np.bitwise_or.at(self.blocks, block_idx, masks)
+        self._blocks_dev = None  # device mirror is stale after mutation
 
     def check_hashes(self, hashes: np.ndarray) -> np.ndarray:
         block_idx, masks = self._masks(hashes)
@@ -165,6 +180,55 @@ class SplitBlockFilter:
     def check(self, value, leaf: Leaf) -> bool:
         """Reference parity: ``ColumnChunk.BloomFilter().Check(value)``."""
         return bool(self.check_hashes(hash_values_single(value, leaf))[0])
+
+    # Design note (SURVEY.md §2.3 bloom row): planner probes are host work —
+    # a probe is metadata-scale and the filter lives in host memory next to
+    # the footer, so the numpy probe is the production default.  The device
+    # probe below exists for the batched case (large IN-lists / semi-join
+    # pre-filters, thousands of probes per filter), where one H2D of the
+    # filter + one fused gather/test dispatch beats k host probes.
+    _DEVICE_PROBE_MIN = 32_768
+
+    def check_hashes_device(self, hashes: np.ndarray):
+        """Batched probe on the accelerator: the high hash bits pick blocks
+        (computed host-side, O(k) metadata work), XLA gathers the selected
+        blocks from the HBM-resident filter, and the Pallas kernel (jnp twin
+        off-TPU / on compile failure) tests the salted bits.  Returns a bool
+        ``jax.Array`` of length ``len(hashes)``."""
+        import jax
+        import jax.numpy as jnp
+
+        z = np.uint64(self.blocks.shape[0])
+        block_idx = (((hashes >> np.uint64(32)) * z) >> np.uint64(32)) \
+            .astype(np.int32)
+        low = (hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        dev_blocks = getattr(self, "_blocks_dev", None)
+        if dev_blocks is None:
+            dev_blocks = self._blocks_dev = jax.device_put(self.blocks)
+        gathered = jnp.take(dev_blocks, jnp.asarray(block_idx), axis=0)
+        low_dev = jnp.asarray(low)
+        if jax.devices()[0].platform == "tpu":
+            try:
+                from ..ops import pallas_kernels as pk
+
+                return pk.bloom_check_blocks(gathered, low_dev)
+            except Exception:
+                pass  # Mosaic/remote-compile failure: jnp twin below
+        bit = ((low_dev[:, None] * jnp.asarray(_SALT_U32)[None, :])
+               >> jnp.uint32(27)) & jnp.uint32(31)
+        masks = jnp.uint32(1) << bit
+        return ((gathered & masks) == masks).all(axis=1)
+
+    def check_hashes_batch(self, hashes: np.ndarray,
+                           prefer_device: Optional[bool] = None) -> np.ndarray:
+        """Probe many hashes, routing large batches to the accelerator when
+        one is live (see design note above). Returns host bool numpy."""
+        use_dev = prefer_device
+        if use_dev is None:
+            use_dev = len(hashes) >= self._DEVICE_PROBE_MIN and _device_live()
+        if use_dev:
+            return np.asarray(self.check_hashes_device(hashes))
+        return self.check_hashes(hashes)
 
     # -- serialization ------------------------------------------------------
     def to_bytes(self) -> bytes:
@@ -210,6 +274,35 @@ def hash_values(leaf: Leaf, values, offsets=None) -> np.ndarray:
     raise ValueError(f"unsupported bloom type {t}")
 
 
+def hash_probe_values(leaf: Leaf, values) -> np.ndarray:
+    """Vectorized probe hashing for an IN-list: order-domain probe values →
+    uint64 xxh64 per value (writer-side PLAIN byte encoding), ready for
+    :meth:`SplitBlockFilter.check_hashes_batch`."""
+    from ..algebra.compare import int_to_be_bytes, is_unsigned, normalize
+    from ..schema.types import LogicalKind
+
+    t = leaf.physical_type
+    vals = [normalize(leaf, v) for v in values]
+    if t == Type.INT64:
+        dt = np.uint64 if is_unsigned(leaf) else np.int64
+        return xxh64_u64(np.array(vals, dtype=dt).view(np.uint64))
+    if t == Type.DOUBLE:
+        return xxh64_u64(np.array(vals, dtype=np.float64).view(np.uint64))
+    if t == Type.INT32:
+        dt = np.uint32 if is_unsigned(leaf) else np.int32
+        return xxh64_u32(np.array(vals, dtype=dt).view(np.uint32))
+    if t == Type.FLOAT:
+        return xxh64_u32(np.array(vals, dtype=np.float32).view(np.uint32))
+    if leaf.logical_kind == LogicalKind.DECIMAL:
+        width = leaf.type_length if t == Type.FIXED_LEN_BYTE_ARRAY else None
+        vals = [int_to_be_bytes(v, width) if isinstance(v, int) else v
+                for v in vals]
+    bs = [bytes(v) for v in vals]
+    offs = np.zeros(len(bs) + 1, np.int64)
+    np.cumsum([len(b) for b in bs], out=offs[1:])
+    return hash_values(leaf, np.frombuffer(b"".join(bs), np.uint8), offs)
+
+
 def hash_values_single(value, leaf: Leaf) -> np.ndarray:
     """Hash one probe value with the writer-side PLAIN byte encoding.
 
@@ -220,22 +313,7 @@ def hash_values_single(value, leaf: Leaf) -> np.ndarray:
     from ..algebra.compare import int_to_be_bytes, is_unsigned, normalize
     from ..schema.types import LogicalKind
 
-    value = normalize(leaf, value)
-    t = leaf.physical_type
-    if t == Type.INT64:
-        dt = np.uint64 if is_unsigned(leaf) else np.int64
-        return xxh64_u64(np.array([value], dtype=dt).view(np.uint64))
-    if t == Type.DOUBLE:
-        return xxh64_u64(np.array([value], dtype=np.float64).view(np.uint64))
-    if t == Type.INT32:
-        dt = np.uint32 if is_unsigned(leaf) else np.int32
-        return xxh64_u32(np.array([value], dtype=dt).view(np.uint32))
-    if t == Type.FLOAT:
-        return xxh64_u32(np.array([value], dtype=np.float32).view(np.uint32))
-    if isinstance(value, int) and leaf.logical_kind == LogicalKind.DECIMAL:
-        width = leaf.type_length if t == Type.FIXED_LEN_BYTE_ARRAY else None
-        value = int_to_be_bytes(value, width)
-    return np.array([xxh64_bytes(bytes(value))], dtype=np.uint64)
+    return hash_probe_values(leaf, [value])
 
 
 # ---------------------------------------------------------------------------

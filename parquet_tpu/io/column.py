@@ -52,12 +52,21 @@ class Column:
     def is_dictionary_encoded(self) -> bool:
         return self.values is None and self.dict_indices is not None
 
+    def _host_dictionary(self):
+        """Host numpy dictionary, mirroring the device form on demand."""
+        if self.dictionary_host is None and self.dictionary is not None:
+            d = self.dictionary
+            self.dictionary_host = (
+                (np.asarray(d[0]), np.asarray(d[1])) if isinstance(d, tuple)
+                else np.asarray(d))
+        return self.dictionary_host
+
     def materialize_host(self):
         """Dense host (values, offsets) for dictionary-encoded byte arrays."""
         from ..ops import ref as _ref
 
         idx = np.asarray(self.dict_indices).astype(np.int64)
-        gathered = _ref.gather_dictionary(self.dictionary_host, idx)
+        gathered = _ref.gather_dictionary(self._host_dictionary(), idx)
         if isinstance(gathered, tuple):
             self.values, self.offsets = gathered
         else:
@@ -69,25 +78,58 @@ class Column:
         """Present values as numpy; nulls are NOT filled (dense values only)."""
         return np.asarray(self.values)
 
+    def _dict_dense_arrow(self):
+        """Dictionary-encoded column → dense arrow via one arrow-C++ cast
+        (indices + dictionary → DictionaryArray → value type) instead of a
+        host gather over every value.  None = caller falls back."""
+        import pyarrow as pa
+
+        dh = self._host_dictionary()
+        if dh is None:
+            return None
+        try:
+            if isinstance(dh, tuple):
+                dict_arr = _leaf_to_arrow(self.leaf, np.asarray(dh[0]),
+                                          np.asarray(dh[1]), None)
+            else:
+                dict_arr = _leaf_to_arrow(self.leaf, np.asarray(dh), None,
+                                          None)
+            idx = np.asarray(self.dict_indices).astype(np.int32)
+            if self.validity is not None:
+                v = np.asarray(self.validity, bool)
+                slot = np.zeros(len(v), np.int32)
+                slot[v] = idx
+                ia = pa.array(slot, mask=~v)
+            else:
+                ia = pa.array(idx)
+            return pa.DictionaryArray.from_arrays(ia, dict_arr) \
+                .cast(dict_arr.type)
+        except Exception:
+            return None
+
     def to_arrow(self):
         import pyarrow as pa
 
         leaf = self.leaf
+        arr = None
         if self.is_dictionary_encoded():
-            self.materialize_host()
-        values = np.asarray(self.values)
-        # device pair representation → host 64-bit view (zero-copy)
-        if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
-            host_dt = {Type.INT64: np.int64, Type.DOUBLE: np.float64}.get(
-                leaf.physical_type, np.int64)
-            values = np.ascontiguousarray(values).view(host_dt).reshape(-1)
-        if (leaf.physical_type == Type.INT96 and values.ndim == 2
-                and values.dtype == np.uint32):
-            values = values.astype(np.uint32).view(np.int32)
-        offsets = None if self.offsets is None else np.asarray(self.offsets)
-        validity = None if self.validity is None else np.asarray(self.validity)
+            arr = self._dict_dense_arrow()
+            if arr is None:
+                self.materialize_host()
+        if arr is None:
+            values = np.asarray(self.values)
+            # device pair representation → host 64-bit view (zero-copy)
+            if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
+                host_dt = {Type.INT64: np.int64, Type.DOUBLE: np.float64}.get(
+                    leaf.physical_type, np.int64)
+                values = np.ascontiguousarray(values).view(host_dt).reshape(-1)
+            if (leaf.physical_type == Type.INT96 and values.ndim == 2
+                    and values.dtype == np.uint32):
+                values = values.astype(np.uint32).view(np.int32)
+            offsets = None if self.offsets is None else np.asarray(self.offsets)
+            validity = None if self.validity is None else np.asarray(self.validity)
 
-        arr = _leaf_to_arrow(leaf, values, offsets, validity)
+            arr = _leaf_to_arrow(leaf, values, offsets, validity)
         # wrap in list layers, innermost last in list_offsets → build outside-in
         for offs, lv in zip(reversed(self.list_offsets), reversed(self.list_validity)):
             offs = np.asarray(offs).astype(np.int32)
@@ -117,8 +159,8 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
         else:
             arr = pa.Array.from_buffers(
                 pa.binary(), len(offsets) - 1,
-                [None, pa.py_buffer(offsets.astype(np.int32).tobytes()),
-                 pa.py_buffer(np.asarray(values, dtype=np.uint8).tobytes())])
+                [None, pa.py_buffer(np.ascontiguousarray(offsets, dtype=np.int32)),
+                 pa.py_buffer(np.ascontiguousarray(np.asarray(values).view(np.uint8)))])
         if k in (LogicalKind.STRING, LogicalKind.ENUM, LogicalKind.JSON):
             arr = arr.cast(pa.string())
         elif k == LogicalKind.DECIMAL:
@@ -137,7 +179,7 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
             return _decimal_with_nulls(ints, validity, pa.decimal128(p, s))
         if validity is None:
             return pa.FixedSizeBinaryArray.from_buffers(
-                pa.binary(width), len(vals), [None, pa.py_buffer(vals.tobytes())])
+                pa.binary(width), len(vals), [None, pa.py_buffer(np.ascontiguousarray(vals))])
         return _fsb_with_nulls(vals, validity, width)
 
     if pt == Type.INT96:
@@ -177,10 +219,20 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
 
 
 def concat_columns(parts: List[Column]) -> Column:
-    """Concatenate per-row-group chunks of the same leaf into one Column."""
+    """Concatenate per-row-group chunks of the same leaf into one Column.
+
+    Dictionary-encoded chunks stay encoded: per-row-group dictionaries are
+    concatenated and the index streams rebased (the host twin of
+    host_scan._concat_dictionaries) — materializing 10s of millions of
+    strings per column just to concatenate them was the whole-file read's
+    biggest cost at lineitem scale."""
     if len(parts) == 1:
         return parts[0]
-    for p in parts:  # per-row-group dictionaries differ: materialize first
+    if all(p.is_dictionary_encoded() for p in parts):
+        merged = _concat_dict_parts(parts)
+        if merged is not None:
+            return merged
+    for p in parts:  # mixed encoded/plain chunks: materialize first
         if p.is_dictionary_encoded():
             p.materialize_host()
     first = parts[0]
@@ -196,6 +248,19 @@ def concat_columns(parts: List[Column]) -> Column:
     else:
         values = np.concatenate([np.asarray(p.values) for p in parts])
         offsets = None
+    validity, list_offsets, list_validity, def_levels, rep_levels = \
+        _concat_structure(parts)
+    return Column(leaf=first.leaf, values=values, offsets=offsets,
+                  validity=validity, list_offsets=list_offsets,
+                  list_validity=list_validity,
+                  num_slots=sum(p.num_slots for p in parts),
+                  def_levels=def_levels, rep_levels=rep_levels)
+
+
+def _concat_structure(parts: List[Column]):
+    """Validity / list structure / raw level concatenation shared by the
+    plain and dictionary-preserving concat paths."""
+    first = parts[0]
     if any(p.validity is not None for p in parts):
         validity = np.concatenate([
             np.asarray(p.validity) if p.validity is not None
@@ -225,10 +290,57 @@ def concat_columns(parts: List[Column]) -> Column:
         def_levels = np.concatenate([np.asarray(p.def_levels) for p in parts])
     if all(p.rep_levels is not None for p in parts):
         rep_levels = np.concatenate([np.asarray(p.rep_levels) for p in parts])
-    return Column(leaf=first.leaf, values=values, offsets=offsets,
+    return validity, list_offsets, list_validity, def_levels, rep_levels
+
+
+def _concat_dict_parts(parts: List[Column]) -> Optional[Column]:
+    """Dictionary-preserving concat: rebase each chunk's index stream by the
+    sizes of the dictionaries before it and concatenate the dictionaries
+    (duplicates across row groups kept — correctness over minimality).
+    Returns None when a part lacks a host dictionary (device-resident
+    chunks concatenate via the main path)."""
+    first = parts[0]
+    on_device = not isinstance(first.dict_indices, np.ndarray)
+    if on_device and all(p.dictionary is not None for p in parts):
+        # device-resident chunks: rebase with jnp ops, nothing leaves HBM
+        from ..parallel.host_scan import _concat_dictionaries
+
+        dictionary, indices = _concat_dictionaries(
+            [(p.dictionary, p.dict_indices) for p in parts])
+        dict_host = None
+    elif all(p.dictionary_host is not None for p in parts):
+        idx_parts, base = [], 0
+        ba = isinstance(first.dictionary_host, tuple)
+        for p in parts:
+            idx = np.asarray(p.dict_indices)
+            idx_parts.append(idx.astype(np.int32) + np.int32(base))
+            base += (len(p.dictionary_host[1]) - 1 if ba
+                     else len(p.dictionary_host))
+        indices = np.concatenate(idx_parts)
+        if ba:
+            off_parts, vbase = [], 0
+            for p in parts:
+                o = np.asarray(p.dictionary_host[1], np.int64)
+                off_parts.append(o[:-1] + vbase)
+                vbase += int(o[-1])
+            dict_host = (
+                np.concatenate([np.asarray(p.dictionary_host[0])
+                                for p in parts]),
+                np.concatenate(off_parts + [np.array([vbase], np.int64)]))
+        else:
+            dict_host = np.concatenate(
+                [np.asarray(p.dictionary_host) for p in parts])
+        dictionary = None
+    else:
+        return None
+    validity, list_offsets, list_validity, def_levels, rep_levels = \
+        _concat_structure(parts)
+    return Column(leaf=first.leaf, values=None, offsets=None,
                   validity=validity, list_offsets=list_offsets,
                   list_validity=list_validity,
                   num_slots=sum(p.num_slots for p in parts),
+                  dictionary=dictionary, dictionary_host=dict_host,
+                  dict_indices=indices,
                   def_levels=def_levels, rep_levels=rep_levels)
 
 
@@ -275,9 +387,9 @@ def _decimal_with_nulls(ints: np.ndarray, validity, pa_type):
     raw = np.empty((len(vals), 2), dtype=np.uint64)
     raw[:, 0] = lo
     raw[:, 1] = hi.astype(np.uint64)
-    bufs = [None, pa.py_buffer(raw.tobytes())]
+    bufs = [None, pa.py_buffer(raw)]
     if validity is not None:
-        bufs[0] = pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+        bufs[0] = pa.py_buffer(np.packbits(validity, bitorder="little"))
     return pa.Array.from_buffers(pa_type, len(vals), bufs)
 
 
@@ -286,9 +398,9 @@ def _fsb_with_nulls(vals: np.ndarray, validity: np.ndarray, width: int):
 
     out = np.zeros((len(validity), width), dtype=np.uint8)
     out[validity] = vals
-    mask = pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+    mask = pa.py_buffer(np.packbits(validity, bitorder="little"))
     return pa.Array.from_buffers(pa.binary(width), len(validity),
-                                 [mask, pa.py_buffer(out.tobytes())])
+                                 [mask, pa.py_buffer(out)])
 
 
 def _ragged_with_nulls(values: np.ndarray, offsets: np.ndarray, validity: np.ndarray):
@@ -299,11 +411,11 @@ def _ragged_with_nulls(values: np.ndarray, offsets: np.ndarray, validity: np.nda
     slot_lens = np.zeros(n, dtype=np.int64)
     slot_lens[validity] = lens
     slot_offs = np.concatenate([[0], np.cumsum(slot_lens)]).astype(np.int32)
-    mask = pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+    mask = pa.py_buffer(np.packbits(validity, bitorder="little"))
     return pa.Array.from_buffers(
         pa.binary(), n,
-        [mask, pa.py_buffer(slot_offs.tobytes()),
-         pa.py_buffer(np.asarray(values, dtype=np.uint8).tobytes())])
+        [mask, pa.py_buffer(slot_offs),
+         pa.py_buffer(np.ascontiguousarray(np.asarray(values).view(np.uint8)))])
 
 
 # ---------------------------------------------------------------------------

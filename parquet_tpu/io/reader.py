@@ -367,7 +367,6 @@ class ParquetFile:
         """
         leaves = _select_leaves(self.schema, columns)
         n_rg = len(self.metadata.row_groups or [])
-        cols: Dict[str, Column] = {}
         if device:
             # double-buffered pipeline across every (leaf, row-group) chunk:
             # host prescan + H2D of later chunks overlaps device decode of
@@ -377,11 +376,9 @@ class ParquetFile:
             chunks = [self.row_group(i).column(leaf.column_index)
                       for leaf in leaves for i in range(n_rg)]
             decoded = decode_chunks_pipelined(chunks)
-            for leaf in leaves:
-                parts = [next(decoded) for _ in range(n_rg)]
-                cols[leaf.dotted_path] = (concat_columns(parts)
-                                          if len(parts) != 1 else parts[0])
-            return Table(self.schema, cols, self.num_rows)
+            dparts = {leaf.dotted_path: [next(decoded) for _ in range(n_rg)]
+                      for leaf in leaves}
+            return Table(self.schema, None, self.num_rows, parts=dparts)
         # fan the (leaf, row-group) chunks across the shared pool — the
         # reference's read path is goroutine-parallel by design (SURVEY.md
         # §2.5a caller-driven fan-out); decompress/decode release the GIL in
@@ -390,24 +387,26 @@ class ParquetFile:
         chunks = [[self.row_group(i).column(leaf.column_index)
                    for i in range(n_rg)] for leaf in leaves]
         # same measured crossover as parallel/host_scan.py: under ~2M cells
-        # the per-task dispatch overhead beats the decode win
-        if n_rg * len(leaves) > 1 and self.num_rows * len(leaves) >= 2_000_000:
+        # the per-task dispatch overhead beats the decode win.  On a single
+        # core, threads are a pure loss for whole-chunk decode: per-thread
+        # malloc arenas defeat buffer reuse for the large decode buffers
+        # (measured 2x slower), so the fan-out needs real cores.
+        import os as _os
+
+        if (n_rg * len(leaves) > 1 and (_os.cpu_count() or 1) > 1
+                and self.num_rows * len(leaves) >= 2_000_000):
             from ..utils.pool import shared_pool
 
             pool = shared_pool()
             futs = {leaf.dotted_path: [pool.submit(decode_chunk_host, c)
                                        for c in per_leaf]
                     for leaf, per_leaf in zip(leaves, chunks)}
-            for leaf in leaves:
-                parts = [f.result() for f in futs[leaf.dotted_path]]
-                cols[leaf.dotted_path] = (concat_columns(parts)
-                                          if len(parts) != 1 else parts[0])
+            parts = {p: [f.result() for f in fs] for p, fs in futs.items()}
         else:
-            for leaf, per_leaf in zip(leaves, chunks):
-                parts = [decode_chunk_host(c) for c in per_leaf]
-                cols[leaf.dotted_path] = (concat_columns(parts)
-                                          if len(parts) != 1 else parts[0])
-        return Table(self.schema, cols, self.num_rows)
+            parts = {leaf.dotted_path: [decode_chunk_host(c)
+                                        for c in per_leaf]
+                     for leaf, per_leaf in zip(leaves, chunks)}
+        return Table(self.schema, None, self.num_rows, parts=parts)
 
     def close(self):
         self.source.close()
@@ -433,12 +432,29 @@ def _select_leaves(schema: Schema, columns) -> List[Leaf]:
 
 
 class Table:
-    """A decoded set of columns (dict-like).  ``to_arrow`` → pyarrow.Table."""
+    """A decoded set of columns (dict-like).  ``to_arrow`` → pyarrow.Table.
 
-    def __init__(self, schema: Schema, columns: Dict[str, Column], num_rows: int):
+    Multi-row-group reads may construct the table from per-row-group
+    ``parts``: per-leaf concatenation happens lazily on first ``columns``
+    access, and ``to_arrow`` emits pyarrow *chunked* arrays straight from the
+    parts (pyarrow's own layout) — the whole-file read then never pays a
+    values memcpy at all."""
+
+    def __init__(self, schema: Schema, columns: Optional[Dict[str, Column]],
+                 num_rows: int,
+                 parts: Optional[Dict[str, List[Column]]] = None):
         self.schema = schema
-        self.columns = columns
+        self._columns = columns
+        self._parts = parts if columns is None else None
         self.num_rows = num_rows
+
+    @property
+    def columns(self) -> Dict[str, Column]:
+        if self._columns is None:
+            self._columns = {p: (concat_columns(ps) if len(ps) != 1
+                                 else ps[0])
+                             for p, ps in self._parts.items()}
+        return self._columns
 
     def __getitem__(self, path: str) -> Column:
         return self.columns[path]
@@ -448,6 +464,30 @@ class Table:
 
     def keys(self):
         return self.columns.keys()
+
+    def _chunked_to_arrow(self):
+        """Chunked fast path: every selected top-level field is a plain leaf
+        or pure list chain → build one ChunkedArray per field from the
+        per-row-group parts, no concatenation.  None = caller falls back."""
+        import pyarrow as pa
+
+        from ..schema.types import LogicalKind
+
+        names, arrays = [], []
+        for child in self.schema.root.children:
+            leaves = [l for l in self.schema.leaves if l.path[0] == child.name]
+            present = [l for l in leaves if l.dotted_path in self._parts]
+            if not present:
+                continue
+            if (len(present) != 1 or not (
+                    child.is_leaf or child.logical_kind == LogicalKind.LIST)
+                    or self._needs_row_assembly(child, under_rep=False)):
+                return None
+            ps = self._parts[present[0].dotted_path]
+            names.append(child.name)
+            arrays.append(pa.chunked_array([p.to_arrow() for p in ps])
+                          if len(ps) > 1 else ps[0].to_arrow())
+        return pa.Table.from_arrays(arrays, names=names)
 
     def to_arrow(self):
         """Reassemble a pyarrow table, including structs and maps.
@@ -459,6 +499,10 @@ class Table:
         (record-at-a-time Dremel assembly — correct, not the hot path)."""
         import pyarrow as pa
 
+        if self._parts is not None and self._columns is None:
+            t = self._chunked_to_arrow()
+            if t is not None:
+                return t
         names, arrays = [], []
         for child in self.schema.root.children:
             leaves = [l for l in self.schema.leaves if l.path[0] == child.name]

@@ -117,6 +117,49 @@ def prune_row_group(rg: RowGroupReader, path, lo=None, hi=None,
     return True
 
 
+def _any_in_range(sorted_vals: List, lo, hi) -> bool:
+    """Does the sorted probe list intersect [lo, hi]?  (None bound = open.)"""
+    if not sorted_vals:
+        return False
+    i = 0 if lo is None else bisect_left(sorted_vals, lo)
+    return i < len(sorted_vals) and (hi is None or sorted_vals[i] <= hi)
+
+
+def prune_row_group_values(rg: RowGroupReader, path, sorted_vals: List,
+                           hashes: Optional[np.ndarray] = None) -> bool:
+    """IN-list pruning: the row group may hold SOME probe value.  Statistics
+    intersect the sorted probe list (one bisect); with ``hashes``, the bloom
+    filter is probed for the whole batch at once (large batches route to the
+    device probe — io/bloom.py design note)."""
+    chunk = rg.column(path)
+    st = chunk.statistics()
+    if st is not None and st.min_value is not None and st.max_value is not None:
+        if not _any_in_range(sorted_vals, st.min_value, st.max_value):
+            return False
+    if hashes is not None:
+        bf = chunk.bloom_filter()
+        if bf is not None and not bf.check_hashes_batch(hashes).any():
+            return False
+    return True
+
+
+def pages_overlapping_values(column_index: md.ColumnIndex, leaf: Leaf,
+                             sorted_vals: List) -> List[int]:
+    """Page ordinals whose [min,max] contains at least one probe value."""
+    n = len(column_index.null_pages or [])
+    mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
+    maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
+    nulls = column_index.null_pages or [False] * n
+    out = []
+    for i in range(n):
+        if nulls[i]:
+            continue
+        if mins[i] is None or maxs[i] is None or _any_in_range(
+                sorted_vals, mins[i], maxs[i]):
+            out.append(i)
+    return out
+
+
 @dataclass
 class PagePlan:
     """Selected pages of one chunk: which page ordinals to decode and the row
@@ -129,14 +172,44 @@ class PagePlan:
 
 
 def plan_scan(pf: ParquetFile, path, lo=None, hi=None,
-              use_bloom: bool = False) -> List[PagePlan]:
+              use_bloom: bool = False,
+              values: Optional[Sequence] = None) -> List[PagePlan]:
     """Batch pushdown plan: for each surviving row group, the page ordinals
-    whose zone maps intersect the predicate."""
+    whose zone maps intersect the predicate.
+
+    ``values`` switches to IN-list semantics (``file[path] ∈ values``):
+    statistics and zone maps prune against the sorted probe list, and with
+    ``use_bloom`` every chunk filter is probed with the whole hashed batch at
+    once (the batched-probe path of io/bloom.py)."""
     leaf = pf.schema.leaf(path) if not hasattr(path, "column_index") else path
     plans: List[PagePlan] = []
+    sorted_vals = hashes = None
+    if values is not None:
+        if lo is not None or hi is not None:
+            raise ValueError("pass either a range (lo/hi) or values, not both")
+        from ..algebra.compare import in_type_range
+
+        # out-of-range probes can never match: drop, don't overflow
+        sorted_vals = sorted({normalize(leaf, v) for v in values
+                              if v is not None
+                              and in_type_range(leaf, normalize(leaf, v))})
+        if not sorted_vals:
+            return []
+        if use_bloom:
+            from .bloom import hash_probe_values
+
+            try:
+                hashes = hash_probe_values(leaf, sorted_vals)
+            except ValueError:
+                hashes = None  # type has no bloom encoding (e.g. BOOLEAN)
     equals = lo if lo is not None and lo == hi else None
     for rg in pf.row_groups:
-        if not prune_row_group(rg, leaf.column_index, lo, hi, use_bloom, equals):
+        if sorted_vals is not None:
+            if not prune_row_group_values(rg, leaf.column_index, sorted_vals,
+                                          hashes):
+                continue
+        elif not prune_row_group(rg, leaf.column_index, lo, hi, use_bloom,
+                                 equals):
             continue
         chunk = rg.column(leaf.column_index)
         ci = chunk.column_index()
@@ -145,7 +218,9 @@ def plan_scan(pf: ParquetFile, path, lo=None, hi=None,
             plans.append(PagePlan(rg.index, list(range(_npages(oi))) if oi else [],
                                   0, rg.num_rows))
             continue
-        ords = pages_overlapping(ci, leaf, lo, hi)
+        ords = (pages_overlapping_values(ci, leaf, sorted_vals)
+                if sorted_vals is not None
+                else pages_overlapping(ci, leaf, lo, hi))
         if not ords:
             continue
         locs = oi.page_locations

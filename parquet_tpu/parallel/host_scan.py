@@ -33,8 +33,12 @@ _SYNC_EVERY = 8
 def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                   columns: Optional[Sequence[str]] = None,
                   num_threads: Optional[int] = None,
-                  use_bloom: bool = True) -> Dict[str, np.ndarray]:
-    """Scan ``columns`` for rows where ``lo <= file[path] <= hi``.
+                  use_bloom: bool = True,
+                  values: Optional[Sequence] = None) -> Dict[str, np.ndarray]:
+    """Scan ``columns`` for rows where ``lo <= file[path] <= hi`` — or, with
+    ``values``, where ``file[path] ∈ values`` (IN-list pushdown: statistics,
+    zone maps and bloom filters all prune against the probe set; bloom
+    probes batch, routing to the device prober for large IN-lists).
 
     Pushdown happens at three levels: row groups are pruned by chunk
     statistics (and optionally bloom filters for point lookups), pages by
@@ -65,7 +69,8 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                 f"column {c!r} is nested; scan_filtered returns row-aligned "
                 "arrays — use read_row_range per plan for nested columns")
 
-    plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom)
+    plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom,
+                      values=values)
     rg_base = np.zeros(len(pf.row_groups), np.int64)
     np.cumsum([rg.num_rows for rg in pf.row_groups[:-1]], out=rg_base[1:])
 
@@ -76,6 +81,13 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     key_leaf = pf.schema.leaf(path)
     lo, hi = normalize(key_leaf, lo), normalize(key_leaf, hi)
     key_unsigned = is_unsigned(key_leaf)
+    probe_set = None
+    if values is not None:
+        from ..algebra.compare import in_type_range
+
+        probe_set = {normalize(key_leaf, v) for v in values
+                     if v is not None
+                     and in_type_range(key_leaf, normalize(key_leaf, v))}
 
     read_cols = [path] + [c for c in out_cols if c != path]
 
@@ -122,20 +134,28 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
             keys = [None if x is None
                     else decode_order_value(bytes(x), key_leaf)
                     for x in keys]
-            mask = np.fromiter(
-                ((x is not None
-                  and (lo is None or x >= lo) and (hi is None or x <= hi))
-                 for x in keys), bool, count=len(keys))
+            if probe_set is not None:
+                mask = np.fromiter((x is not None and x in probe_set
+                                    for x in keys), bool, count=len(keys))
+            else:
+                mask = np.fromiter(
+                    ((x is not None
+                      and (lo is None or x >= lo) and (hi is None or x <= hi))
+                     for x in keys), bool, count=len(keys))
         else:
             if key_unsigned and keys.dtype in (np.dtype(np.int32),
                                                np.dtype(np.int64)):
                 keys = keys.view(np.uint32 if keys.dtype == np.dtype(np.int32)
                                  else np.uint64)
-            mask = np.ones(len(keys), bool)
-            if lo is not None:
-                mask &= keys >= lo
-            if hi is not None:
-                mask &= keys <= hi
+            if probe_set is not None:
+                probes = np.array(sorted(probe_set), dtype=keys.dtype)
+                mask = np.isin(keys, probes)
+            else:
+                mask = np.ones(len(keys), bool)
+                if lo is not None:
+                    mask &= keys >= lo
+                if hi is not None:
+                    mask &= keys <= hi
             if key_valid is not None:  # SQL semantics: NULL fails the predicate
                 mask &= key_valid
         for c in out_cols:
@@ -180,7 +200,8 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 
 def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                columns: Optional[Sequence[str]] = None,
-               use_bloom: bool = True, devices: Optional[Sequence] = None):
+               use_bloom: bool = True, devices: Optional[Sequence] = None,
+               values: Optional[Sequence] = None):
     """Pushdown plan + host prescan + H2D staging for a device scan.
 
     Split from :func:`scan_filtered_device` so callers (and the benchmark)
@@ -218,10 +239,17 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
         # by bytes — the per-entry bytewise predicate below would be wrong
         raise ValueError(f"device scan key {path!r} is a decimal byte array; "
                          "use the host scan")
+    if values is not None and key_leaf.physical_type in (Type.INT64,
+                                                         Type.DOUBLE):
+        # 64-bit keys travel as (n, 2) uint32 pairs; exact IN over pairs has
+        # no scalar order for the device searchsorted — use the host scan
+        raise ValueError(f"device scan IN-list on 64-bit key {path!r} is not "
+                         "supported; use the host scan (scan_filtered)")
     # other BYTE_ARRAY keys are fine when dictionary-encoded (per-entry
     # predicate + device gather); plain-encoded chunks are rejected per
     # chunk below
-    plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom)
+    plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom,
+                      values=values)
     spans = []
     for si, plan in enumerate(plans):
         rg = pf.row_group(plan.rg_index)
@@ -248,8 +276,14 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                         "(scan_filtered)") from None
                 per_col[c] = (chunk, dplan, staged, row_start - first)
         spans.append((plan, per_col))
+    from ..algebra.compare import in_type_range, normalize
+
+    probe = (sorted({normalize(key_leaf, v) for v in values
+                     if v is not None
+                     and in_type_range(key_leaf, normalize(key_leaf, v))})
+             if values is not None else None)
     return {"path": path, "out_cols": out_cols, "lo": lo, "hi": hi,
-            "spans": spans,
+            "values": probe, "spans": spans,
             "leaves": {c: pf.schema.leaf(c) for c in out_cols}}
 
 
@@ -334,11 +368,21 @@ class _ScanCarrier:
         self.flushed = upto
 
 
+def _compact(arr, tgt):
+    """Stable prefix-compaction by scatter: row i lands at tgt[i]; dropped
+    rows target index n (out of bounds, mode='drop').  O(n), an order of
+    magnitude cheaper than the argsort-permutation it replaces (the sort
+    lowers to an O(n log²n) network on TPU)."""
+    import jax.numpy as jnp
+
+    return jnp.zeros_like(arr).at[tgt].set(arr, mode="drop")
+
+
 def _scan_dispatch(state, carrier: _ScanCarrier,
                    sync_every: Optional[int] = None) -> None:
     """Phase A — dispatch with (almost) no syncs: per span, survivors are
-    compacted to a prefix with one stable argsort of the predicate mask
-    (device-shape-static; no data-dependent host round-trip per span).
+    compacted to a prefix with one cumsum + stable scatter of the predicate
+    mask (device-shape-static; no data-dependent host round-trip per span).
     With ``sync_every``, counts are synced in batches so device residency
     stays bounded by a few spans' worth of uncompacted output."""
     import jax.numpy as jnp
@@ -348,13 +392,16 @@ def _scan_dispatch(state, carrier: _ScanCarrier,
 
     path, out_cols = state["path"], state["out_cols"]
     lo, hi = state["lo"], state["hi"]
+    probe = state.get("values")
     for plan, per_col in state["spans"]:
         chunk, dplan, staged, trim = per_col[path]
         key = dr.decode_staged(chunk.leaf, Type(chunk.meta.type), dplan, staged)
         n_rows = plan.row_count
         no_nulls = dplan.total_values == dplan.total_slots
-        mask = _key_mask_device(chunk.leaf, key, lo, hi, trim, n_rows, no_nulls)
-        perm = jnp.argsort(~mask, stable=True)  # survivors first, in order
+        mask = _key_mask_device(chunk.leaf, key, lo, hi, trim, n_rows, no_nulls,
+                                values=probe)
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        tgt = jnp.where(mask, pos, n_rows)  # survivors → prefix, rest dropped
         carrier.counts.append(jnp.sum(mask.astype(jnp.int32)))
         for c in out_cols:
             chunk_c, dplan_c, staged_c, trim_c = per_col[c]
@@ -363,15 +410,14 @@ def _scan_dispatch(state, carrier: _ScanCarrier,
             vals, valid = _row_aligned_device(
                 col, trim_c, n_rows,
                 no_nulls=dplan_c.total_values == dplan_c.total_slots)
-            if isinstance(vals, tuple):  # dictionary form: gather indices
+            if isinstance(vals, tuple):  # dictionary form: compact indices
                 dictionary, indices = vals
-                carrier.parts[c].append(
-                    (dictionary, jnp.take(indices, perm, axis=0)))
+                carrier.parts[c].append((dictionary, _compact(indices, tgt)))
             else:
-                carrier.parts[c].append(jnp.take(vals, perm, axis=0))
+                carrier.parts[c].append(_compact(vals, tgt))
             if valid is not None:
                 carrier.any_valid[c] = True
-                carrier.vparts[c].append(jnp.take(valid, perm, axis=0))
+                carrier.vparts[c].append(_compact(valid, tgt))
             else:
                 carrier.vparts[c].append(None)
         if sync_every and len(carrier.counts) - carrier.flushed >= sync_every:
@@ -425,19 +471,21 @@ def decoded_scan(state) -> Dict[str, object]:
 
 def scan_filtered_device(pf: ParquetFile, path: str, lo=None, hi=None,
                          columns: Optional[Sequence[str]] = None,
-                         use_bloom: bool = True) -> Dict[str, object]:
+                         use_bloom: bool = True,
+                         values: Optional[Sequence] = None) -> Dict[str, object]:
     """Device-mode :func:`scan_filtered`: pushdown selects pages, the chip
-    decodes them, evaluates ``lo <= key <= hi``, and gathers survivors —
-    the TPU analog of SURVEY.md §3.3's Find→SeekToRow→decode flow."""
+    decodes them, evaluates ``lo <= key <= hi`` (or ``key ∈ values``), and
+    gathers survivors — the TPU analog of SURVEY.md §3.3's
+    Find→SeekToRow→decode flow."""
     return decoded_scan(stage_scan(pf, path, lo=lo, hi=hi, columns=columns,
-                                   use_bloom=use_bloom))
+                                   use_bloom=use_bloom, values=values))
 
 
 def _key_mask_device(leaf, col, lo, hi, trim: int, n_rows: int,
-                     no_nulls: bool = False):
-    """Row-aligned predicate mask on device for the key column; lo/hi are
-    normalized to the leaf's order domain (unsigned-logical keys compare in
-    the unsigned view, matching the zone-map pruning)."""
+                     no_nulls: bool = False, values=None):
+    """Row-aligned predicate mask on device for the key column; lo/hi (or an
+    IN-list ``values``) are normalized to the leaf's order domain (unsigned-
+    logical keys compare in the unsigned view, matching zone-map pruning)."""
     import jax
     import jax.numpy as jnp
 
@@ -455,10 +503,33 @@ def _key_mask_device(leaf, col, lo, hi, trim: int, n_rows: int,
         doffs = np.asarray(doffs, np.int64)
         entries = [bytes(dvals[doffs[i]: doffs[i + 1]])
                    for i in range(len(doffs) - 1)]
-        match = np.array([(lo is None or e >= lo) and (hi is None or e <= hi)
-                          for e in entries], bool)
+        if values is not None:
+            probe_set = set(values)
+            match = np.array([e in probe_set for e in entries], bool)
+        else:
+            match = np.array([(lo is None or e >= lo)
+                              and (hi is None or e <= hi)
+                              for e in entries], bool)
         _, indices = vals
         mask = jnp.take(jnp.asarray(match), indices, axis=0)
+        if valid is not None:
+            mask &= valid
+        return mask
+    if values is not None:
+        # single-word numeric key: exact IN via device searchsorted over the
+        # (host-sorted) probe array — O(n log k), no probabilistic filter
+        unsigned = is_unsigned(leaf)
+        np_dt = {Type.INT32: np.uint32 if unsigned else np.int32,
+                 Type.FLOAT: np.float32,
+                 Type.BOOLEAN: np.bool_}.get(leaf.physical_type)
+        if np_dt is None:
+            raise ValueError("device IN-list needs a single-word key")
+        probes = np.array(values, dtype=np_dt)
+        if unsigned and vals.dtype == jnp.int32:
+            vals = jax.lax.bitcast_convert_type(vals, jnp.uint32)
+        pv = jnp.asarray(np.sort(probes))
+        idx = jnp.clip(jnp.searchsorted(pv, vals), 0, len(pv) - 1)
+        mask = jnp.take(pv, idx) == vals
         if valid is not None:
             mask &= valid
         return mask

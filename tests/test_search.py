@@ -413,3 +413,142 @@ def test_device_scan_rejects_byte_array_decimal_key():
     assert pf.schema.leaf("d").physical_type == PT.BYTE_ARRAY
     with pytest.raises(ValueError, match="decimal byte array"):
         stage_scan(pf, "d", lo=1, hi=9, columns=["v"])
+
+
+# ----------------------------------------------------------------------
+# IN-list pushdown (values=) + batched bloom probing
+
+
+def _in_list_file(rng, n=40_000, with_bloom=True):
+    k = np.sort(rng.integers(0, 10**6, n)).astype(np.int64)
+    t = pa.table({"k": pa.array(k),
+                  "v": pa.array(rng.random(n))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(
+        compression="snappy", row_group_size=n // 8,
+        write_page_index=True, dictionary=False,
+        bloom_filters={"k": 10} if with_bloom else {}))
+    return buf.getvalue(), k
+
+
+def test_plan_scan_values_prunes(rng):
+    raw, k = _in_list_file(rng)
+    pf = ParquetFile(raw)
+    # probes clustered in one row group's range: others must prune
+    probes = [int(k[100]), int(k[105]), int(k[110])]
+    plans = plan_scan(pf, "k", values=probes, use_bloom=True)
+    assert len(plans) >= 1
+    total = sum(p.row_count for p in plans)
+    assert total < len(k)  # pruned below full scan
+    # absent probes prune everything via bloom
+    missing = [2_000_000, 3_000_000]
+    assert plan_scan(pf, "k", values=missing, use_bloom=True) == []
+
+
+def test_scan_filtered_values_exact(rng):
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    raw, k = _in_list_file(rng)
+    pf = ParquetFile(raw)
+    probes = [int(x) for x in rng.choice(k, 20)] + [999_999_999]
+    out = scan_filtered(pf, "k", values=probes, columns=["v"])
+    expect = int(np.isin(k, np.array(probes)).sum())
+    assert len(out["v"]) == expect
+
+
+def test_scan_filtered_values_strings(rng):
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    cats = np.array([f"cat{i:03d}" for i in range(50)])
+    s = cats[rng.integers(0, 50, 5000)]
+    t = pa.table({"s": pa.array(s), "i": pa.array(np.arange(5000))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(write_page_index=True))
+    pf = ParquetFile(buf.getvalue())
+    out = scan_filtered(pf, "s", values=["cat001", "cat007", "nope"],
+                        columns=["i"])
+    expect = int(np.isin(s, ["cat001", "cat007"]).sum())
+    assert len(out["i"]) == expect
+
+
+def test_scan_filtered_device_values(rng):
+    """Device IN-scan (int32 key via searchsorted; dict strings via
+    per-entry verdict) matches the host scan."""
+    import jax
+
+    from parquet_tpu.parallel.host_scan import (scan_filtered,
+                                                scan_filtered_device)
+    from parquet_tpu.ops.device import pairs_to_host
+
+    n = 20_000
+    k32 = np.sort(rng.integers(0, 100_000, n)).astype(np.int32)
+    t = pa.table({"k": pa.array(k32),
+                  "v": pa.array(rng.integers(0, 9, n).astype(np.int32))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(write_page_index=True,
+                                      row_group_size=n // 4,
+                                      dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    probes = [int(x) for x in rng.choice(k32, 9)] + [77_777_777]
+    host = scan_filtered(pf, "k", values=probes, columns=["v"])
+    dev = scan_filtered_device(pf, "k", values=probes, columns=["v"])
+    got = np.asarray(dev["v"])
+    np.testing.assert_array_equal(np.sort(got), np.sort(np.asarray(host["v"])))
+
+
+def test_bloom_batch_probe_matches_host(rng):
+    from parquet_tpu.io.bloom import (SplitBlockFilter, hash_probe_values,
+                                      hash_values)
+    from parquet_tpu.schema import schema as sch
+    from parquet_tpu.format.enums import Type as _T
+
+    schema = sch.message("m", [sch.leaf("x", _T.INT64)])
+    leaf = schema.leaves[0]
+    vals = rng.integers(0, 10**9, 5000)
+    f = SplitBlockFilter.for_ndv(5000)
+    f.insert_hashes(hash_values(leaf, vals.astype(np.int64)))
+    probes = np.concatenate([vals[:500], rng.integers(10**10, 10**11, 500)])
+    h = hash_probe_values(leaf, [int(x) for x in probes])
+    host = f.check_hashes(h)
+    dev = f.check_hashes_batch(h, prefer_device=True)
+    np.testing.assert_array_equal(host, dev)
+    assert host[:500].all()  # inserted values always hit
+
+
+def test_in_list_out_of_range_and_boolean(rng):
+    """Out-of-range probes no-match instead of overflowing; BOOLEAN keys
+    (no bloom encoding) work with use_bloom=True defaults."""
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    k = np.sort(rng.integers(0, 1000, 2000)).astype(np.int32)
+    t = pa.table({"k": pa.array(k), "v": pa.array(np.arange(2000))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(write_page_index=True, dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    out = scan_filtered(pf, "k", values=[int(k[5]), 2**40, -2**40],
+                        columns=["v"])
+    assert len(out["v"]) == int((k == k[5]).sum())
+
+    b = rng.random(500) < 0.5
+    tb = pa.table({"b": pa.array(b), "v": pa.array(np.arange(500))})
+    buf2 = io.BytesIO()
+    write_table(tb, buf2, WriterOptions(write_page_index=True,
+                                        dictionary=False))
+    pf2 = ParquetFile(buf2.getvalue())
+    out2 = scan_filtered(pf2, "b", values=[True], columns=["v"])
+    assert len(out2["v"]) == int(b.sum())
+
+
+def test_bloom_device_cache_invalidated_on_insert(rng):
+    from parquet_tpu.io.bloom import SplitBlockFilter, hash_probe_values
+    from parquet_tpu.schema import schema as sch
+    from parquet_tpu.format.enums import Type as _T
+
+    leaf = sch.message("m", [sch.leaf("x", _T.INT64)]).leaves[0]
+    f = SplitBlockFilter.for_ndv(100)
+    h1 = hash_probe_values(leaf, [1, 2, 3])
+    f.insert_hashes(h1)
+    assert f.check_hashes_batch(h1, prefer_device=True).all()
+    h2 = hash_probe_values(leaf, [777, 888])
+    f.insert_hashes(h2)  # must invalidate the device mirror
+    assert f.check_hashes_batch(h2, prefer_device=True).all()
