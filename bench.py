@@ -451,7 +451,9 @@ def _cfg6(n):
 def _lineitem_path(n):
     """Generate (once, cached on disk) a TPC-H lineitem-schema parquet file:
     16 columns, snappy, multi-row-group — the BASELINE.md north-star shape.
-    Cached under $TMPDIR keyed by row count; ~2.3 GB at the default 24M rows."""
+    Cached under $TMPDIR keyed by row count; ~2.2 GB on disk at the default
+    40M rows (decoded arrow ~4.8 GB — size $TMPDIR accordingly or lower
+    BENCH_LINEITEM_ROWS)."""
     cache = os.path.join(os.environ.get("TMPDIR", "/tmp"),
                          f"parquet_tpu_lineitem_{n}.parquet")
     if os.path.exists(cache) and os.path.getsize(cache) > 0:

@@ -36,6 +36,8 @@ from .io.writer import (ColumnData, ParquetWriter, WriterOptions,
                         schema_from_arrow, write_table)
 from .io.search import find, pages_overlapping, plan_scan, prune_row_group, read_row_range
 from .io.stream import iter_batches
+from .ops.encodings import (DictIndices, EncodingSpec, register_encoding,
+                            registered_encodings)
 from .io.source import RetryingSource, Source
 from .parallel.host_scan import (scan_filtered, scan_filtered_device,
                                  scan_filtered_sharded)

@@ -133,20 +133,30 @@ def normalize(leaf: Leaf, value):
     return value
 
 
-def in_type_range(leaf: Leaf, value) -> bool:
-    """Can ``value`` (order domain) be a value of this leaf's physical type?
-    Out-of-range IN-list probes can never match and must be dropped, not
-    overflow the numpy cast."""
+def normalize_probe(leaf: Leaf, value):
+    """Canonical order-domain form of an equality probe, or None when the
+    value can never equal a value of this leaf's type (non-integral float on
+    an int column, out of the type's range) — such probes are dropped rather
+    than overflowing the numpy cast or silently comparing unequal types."""
+    value = normalize(leaf, value)
+    if value is None:
+        return None
     t = leaf.physical_type
-    if t == Type.INT32:
-        return isinstance(value, (int, np.integer)) and (
-            0 <= value < 2**32 if is_unsigned(leaf)
-            else -(2**31) <= value < 2**31)
-    if t == Type.INT64:
-        return isinstance(value, (int, np.integer)) and (
-            0 <= value < 2**64 if is_unsigned(leaf)
-            else -(2**63) <= value < 2**63)
-    return True
+    if t in (Type.INT32, Type.INT64):
+        if isinstance(value, float):
+            if not value.is_integer():
+                return None
+            value = int(value)
+        if not isinstance(value, (int, np.integer)):
+            return None
+        value = int(value)
+        if is_unsigned(leaf):
+            lo, hi = 0, 2 ** (32 if t == Type.INT32 else 64)
+        else:
+            bits = 31 if t == Type.INT32 else 63
+            lo, hi = -(2 ** bits), 2 ** bits
+        return value if lo <= value < hi else None
+    return value
 
 
 def compare_func_of(leaf: Leaf, descending: bool = False,

@@ -304,15 +304,9 @@ def hash_probe_values(leaf: Leaf, values) -> np.ndarray:
 
 
 def hash_values_single(value, leaf: Leaf) -> np.ndarray:
-    """Hash one probe value with the writer-side PLAIN byte encoding.
-
-    Accepts order-domain values from algebra/compare.normalize: unsigned-
-    logical ints may exceed the signed range (encoded via the uint view) and
-    decimal probes arrive as unscaled ints (re-encoded to the column's
-    storage bytes: fixed-width BE for FLBA, minimal BE for BYTE_ARRAY)."""
-    from ..algebra.compare import int_to_be_bytes, is_unsigned, normalize
-    from ..schema.types import LogicalKind
-
+    """Hash one probe value (the batch-of-one case of
+    :func:`hash_probe_values`, which owns the writer-side PLAIN probe
+    encoding rules)."""
     return hash_probe_values(leaf, [value])
 
 

@@ -459,11 +459,14 @@ class Table:
     def __getitem__(self, path: str) -> Column:
         return self.columns[path]
 
+    # name queries must not force the per-leaf concatenation
     def __contains__(self, path: str) -> bool:
-        return path in self.columns
+        d = self._columns if self._columns is not None else self._parts
+        return path in d
 
     def keys(self):
-        return self.columns.keys()
+        d = self._columns if self._columns is not None else self._parts
+        return d.keys()
 
     def _chunked_to_arrow(self):
         """Chunked fast path: every selected top-level field is a plain leaf
@@ -786,11 +789,8 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
                   def_levels=def_levels, rep_levels=rep_levels)
 
 
-class _DictIndices:
-    __slots__ = ("indices",)
-
-    def __init__(self, indices):
-        self.indices = indices
+from ..ops.encodings import (DictIndices as _DictIndices, EncodingSpec,
+                             lookup as _lookup_encoding, register_encoding)
 
 
 def _decode_dictionary(raw: bytes, dph: md.DictionaryPageHeader, leaf: Leaf,
@@ -805,42 +805,86 @@ def _decode_dictionary(raw: bytes, dph: md.DictionaryPageHeader, leaf: Leaf,
 
 def _decode_values(raw: np.ndarray, pos: int, nvals: int, encoding: Encoding,
                    leaf: Leaf, physical: Type, dictionary):
-    if encoding in (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY):
-        if dictionary is None:
-            raise CorruptedError("dictionary-encoded page before dictionary page")
-        return _DictIndices(ref.decode_rle_dict_indices(raw, nvals, pos))
-    if encoding == Encoding.PLAIN:
-        return ref.decode_plain(raw[pos:], nvals, physical, leaf.type_length)
-    if encoding == Encoding.DELTA_BINARY_PACKED:
-        vals, _ = ref.decode_delta_binary_packed(raw, pos)
-        vals = vals[:nvals]
-        return vals.astype(np.int32) if physical == Type.INT32 else vals
-    if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
-        v, o, _ = ref.decode_delta_length_byte_array(raw, pos)
-        return v, o
-    if encoding == Encoding.DELTA_BYTE_ARRAY:
-        v, o, _ = ref.decode_delta_byte_array(raw, pos)
-        if physical == Type.FIXED_LEN_BYTE_ARRAY:
-            return v.reshape(nvals, leaf.type_length)
-        return v, o
-    if encoding == Encoding.BYTE_STREAM_SPLIT:
-        width = {Type.FLOAT: 4, Type.DOUBLE: 8,
-                 Type.INT32: 4, Type.INT64: 8}.get(physical, leaf.type_length)
-        b = ref.decode_byte_stream_split(raw[pos:], nvals, width)
-        if physical == Type.FLOAT:
-            return b.reshape(-1).view(np.float32)
-        if physical == Type.DOUBLE:
-            return b.reshape(-1).view(np.float64)
-        if physical == Type.INT32:
-            return b.reshape(-1).view(np.int32)
-        if physical == Type.INT64:
-            return b.reshape(-1).view(np.int64)
-        return b  # FLBA: (n, width) bytes
-    if encoding == Encoding.RLE and physical == Type.BOOLEAN:
-        # RLE-encoded booleans (v2): 4-byte length prefix, bit width 1
-        vals, _ = ref.decode_rle_len_prefixed(raw, nvals, 1, pos)
-        return vals.astype(np.bool_)
-    raise CorruptedError(f"unsupported encoding {encoding!r} for {physical!r}")
+    """Page value decode, dispatched through the pluggable encoding registry
+    (reference parity: ``encoding/encoding.go — Encoding`` lookup; the eight
+    spec encodings below are the registered defaults)."""
+    spec = _lookup_encoding(encoding)
+    if spec is None:
+        raise CorruptedError(
+            f"unsupported encoding {encoding!r} for {physical!r}")
+    return spec.decode(raw, pos, nvals, leaf, physical, dictionary)
+
+
+# -- built-in encodings: the registered defaults ---------------------------
+
+
+def _dec_dict(raw, pos, nvals, leaf, physical, dictionary):
+    if dictionary is None:
+        raise CorruptedError("dictionary-encoded page before dictionary page")
+    return _DictIndices(ref.decode_rle_dict_indices(raw, nvals, pos))
+
+
+def _dec_plain(raw, pos, nvals, leaf, physical, dictionary):
+    return ref.decode_plain(raw[pos:], nvals, physical, leaf.type_length)
+
+
+def _dec_delta(raw, pos, nvals, leaf, physical, dictionary):
+    vals, _ = ref.decode_delta_binary_packed(raw, pos)
+    vals = vals[:nvals]
+    return vals.astype(np.int32) if physical == Type.INT32 else vals
+
+
+def _dec_delta_len_ba(raw, pos, nvals, leaf, physical, dictionary):
+    v, o, _ = ref.decode_delta_length_byte_array(raw, pos)
+    return v, o
+
+
+def _dec_delta_ba(raw, pos, nvals, leaf, physical, dictionary):
+    v, o, _ = ref.decode_delta_byte_array(raw, pos)
+    if physical == Type.FIXED_LEN_BYTE_ARRAY:
+        return v.reshape(nvals, leaf.type_length)
+    return v, o
+
+
+def _dec_bss(raw, pos, nvals, leaf, physical, dictionary):
+    width = {Type.FLOAT: 4, Type.DOUBLE: 8,
+             Type.INT32: 4, Type.INT64: 8}.get(physical, leaf.type_length)
+    b = ref.decode_byte_stream_split(raw[pos:], nvals, width)
+    if physical == Type.FLOAT:
+        return b.reshape(-1).view(np.float32)
+    if physical == Type.DOUBLE:
+        return b.reshape(-1).view(np.float64)
+    if physical == Type.INT32:
+        return b.reshape(-1).view(np.int32)
+    if physical == Type.INT64:
+        return b.reshape(-1).view(np.int64)
+    return b  # FLBA: (n, width) bytes
+
+
+def _dec_rle_bool(raw, pos, nvals, leaf, physical, dictionary):
+    if physical != Type.BOOLEAN:
+        raise CorruptedError(
+            f"RLE value encoding is defined for BOOLEAN, not {physical!r}")
+    # RLE-encoded booleans (v2): 4-byte length prefix, bit width 1
+    vals, _ = ref.decode_rle_len_prefixed(raw, nvals, 1, pos)
+    return vals.astype(np.bool_)
+
+
+for _spec in (
+        EncodingSpec(Encoding.PLAIN, "PLAIN", _dec_plain),
+        EncodingSpec(Encoding.PLAIN_DICTIONARY, "PLAIN_DICTIONARY", _dec_dict),
+        EncodingSpec(Encoding.RLE_DICTIONARY, "RLE_DICTIONARY", _dec_dict),
+        EncodingSpec(Encoding.DELTA_BINARY_PACKED, "DELTA_BINARY_PACKED",
+                     _dec_delta),
+        EncodingSpec(Encoding.DELTA_LENGTH_BYTE_ARRAY,
+                     "DELTA_LENGTH_BYTE_ARRAY", _dec_delta_len_ba),
+        EncodingSpec(Encoding.DELTA_BYTE_ARRAY, "DELTA_BYTE_ARRAY",
+                     _dec_delta_ba),
+        EncodingSpec(Encoding.BYTE_STREAM_SPLIT, "BYTE_STREAM_SPLIT",
+                     _dec_bss),
+        EncodingSpec(Encoding.RLE, "RLE", _dec_rle_bool),
+):
+    register_encoding(_spec, _builtin=True)
 
 
 def _combine_parts(part_order, index_parts, value_parts, dictionary, leaf, physical):

@@ -187,12 +187,11 @@ def plan_scan(pf: ParquetFile, path, lo=None, hi=None,
     if values is not None:
         if lo is not None or hi is not None:
             raise ValueError("pass either a range (lo/hi) or values, not both")
-        from ..algebra.compare import in_type_range
+        from ..algebra.compare import normalize_probe
 
-        # out-of-range probes can never match: drop, don't overflow
-        sorted_vals = sorted({normalize(leaf, v) for v in values
-                              if v is not None
-                              and in_type_range(leaf, normalize(leaf, v))})
+        # unmatchable probes (out of range, fractional on int) drop here
+        probes = {normalize_probe(leaf, v) for v in values}
+        sorted_vals = sorted(probes - {None})
         if not sorted_vals:
             return []
         if use_bloom:

@@ -371,9 +371,11 @@ class ParquetWriter:
                 lim = opts.column_index_truncate_length
                 if (lim and leaf.physical_type in (
                         Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY)
-                        and leaf.logical_kind != LogicalKind.DECIMAL):
+                        and leaf.logical_kind not in (LogicalKind.DECIMAL,
+                                                      LogicalKind.FLOAT16)):
                     # bytewise-ordered types only: decimals order by
-                    # two's-complement value, where a prefix is NOT a bound
+                    # two's-complement value and float16 by float order,
+                    # where a byte prefix is NOT a bound
                     mn = truncate_stat_min(mn, lim)
                     tmx = truncate_stat_max(mx, lim)
                     mx = tmx if tmx is not None else mx

@@ -554,29 +554,49 @@ int64_t pq_delta_prescan(const uint8_t* data, int64_t size, int64_t pos,
 // ---------------------------------------------------------------------------
 int64_t pq_dict_build_i64(const int64_t* vals, int64_t n, int64_t max_unique,
                           int64_t* indices, int64_t* uniques) {
-  int64_t cap = 64;
-  while (cap < 2 * max_unique) cap <<= 1;
+  // grow geometrically from a small table (rebuilt from `uniques` at 50%
+  // load) instead of pre-sizing to 2*max_unique: a 100M-row mostly-duplicate
+  // column must not transiently allocate gigabytes before discovering its
+  // cardinality
+  int64_t cap = 1024;
   std::vector<int64_t> slot(cap, -1);
   std::vector<int64_t> key(cap);
   int64_t nu = 0;
-  const auto hash_of = [cap](int64_t v) {
+  const auto hash_full = [](int64_t v) {
     uint64_t h = (uint64_t)v * 0x9E3779B97F4A7C15ull;
     h ^= h >> 29;
-    return (int64_t)(h & (uint64_t)(cap - 1));
+    return h;
+  };
+  const auto grow = [&]() {
+    cap <<= 1;
+    slot.assign(cap, -1);
+    key.resize(cap);
+    for (int64_t u = 0; u < nu; ++u) {
+      int64_t p = (int64_t)(hash_full(uniques[u]) & (uint64_t)(cap - 1));
+      while (slot[p] >= 0) p = (p + 1) & (cap - 1);
+      slot[p] = u;
+      key[p] = uniques[u];
+    }
   };
   constexpr int64_t kAhead = 16;  // hide the random-probe cache miss
   for (int64_t i = 0; i < n; ++i) {
     if (i + kAhead < n) {
-      const int64_t pf = hash_of(vals[i + kAhead]);
+      const int64_t pf =
+          (int64_t)(hash_full(vals[i + kAhead]) & (uint64_t)(cap - 1));
       __builtin_prefetch(&slot[pf]);
       __builtin_prefetch(&key[pf]);
     }
     const int64_t v = vals[i];
-    int64_t p = hash_of(v);
+    int64_t p = (int64_t)(hash_full(v) & (uint64_t)(cap - 1));
     while (true) {
       const int64_t s = slot[p];
       if (s < 0) {
         if (nu >= max_unique) return -1;
+        if (2 * (nu + 1) > cap) {
+          grow();
+          p = (int64_t)(hash_full(v) & (uint64_t)(cap - 1));
+          continue;
+        }
         slot[p] = nu;
         key[p] = v;
         uniques[nu] = v;

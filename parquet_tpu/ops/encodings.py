@@ -1,0 +1,91 @@
+"""Pluggable encoding registry.
+
+Reference parity: ``encoding/encoding.go — Encoding`` (SURVEY.md §2.2, "the
+TPU insertion point"): every page encoding is an interface value looked up by
+id, so a third party can register one without editing the decoder.  This is
+that registry for the host decode path: the built-in eight encodings register
+themselves from ``io/reader.py`` at import, and
+:func:`parquet_tpu.register_encoding` adds (or, with ``overwrite=True``,
+replaces) entries — the page decoder dispatches purely through
+:func:`lookup`.
+
+A ``decode`` callable receives ``(raw, pos, nvals, leaf, physical,
+dictionary)``:
+
+- ``raw``: the uncompressed page body as a ``uint8`` numpy array,
+- ``pos``: byte offset where the values section starts,
+- ``nvals``: number of physical values to produce,
+- ``leaf`` / ``physical``: schema leaf and physical type,
+- ``dictionary``: the chunk's decoded dictionary (or None),
+
+and returns the decoded value form the assembler understands: a typed numpy
+array, a ``(values, offsets)`` pair for byte arrays, or a
+``DictIndices(indices)`` wrapper for dictionary index streams.
+
+The accelerated device path (parallel/device_reader.py) plans only the
+built-in encodings; a registered third-party encoding decodes on host and
+flows into the same Column/Table machinery (identical behavior to the
+reference, whose vectorized kernels also cover only the spec encodings).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["EncodingSpec", "DictIndices", "register_encoding", "lookup",
+           "registered_encodings"]
+
+
+class DictIndices:
+    """Marker wrapper: the decode produced dictionary indices, not values."""
+
+    __slots__ = ("indices",)
+
+    def __init__(self, indices):
+        self.indices = indices
+
+
+@dataclass(frozen=True)
+class EncodingSpec:
+    """One registered encoding: its wire id, a name for messages, and the
+    decode callable (see module docstring for the signature)."""
+
+    id: int
+    name: str
+    decode: Callable[..., Any]
+
+
+_REGISTRY: Dict[int, EncodingSpec] = {}
+_BUILTIN: Dict[int, EncodingSpec] = {}
+
+
+def register_encoding(spec: EncodingSpec, overwrite: bool = False,
+                      _builtin: bool = False) -> None:
+    """Add an encoding to the decode dispatch (``overwrite=True`` replaces a
+    built-in — the reference allows shadowing via its RowGroupOption list)."""
+    key = int(spec.id)
+    if not overwrite and key in _REGISTRY:
+        raise ValueError(
+            f"encoding id {key} ({_REGISTRY[key].name}) is already "
+            "registered; pass overwrite=True to replace it")
+    _REGISTRY[key] = spec
+    if _builtin:
+        _BUILTIN[key] = spec
+
+
+def is_builtin_decode(encoding_id) -> bool:
+    """True when the active decode for this id is the built-in one.  The
+    accelerated device planner checks this and routes shadowed encodings to
+    the host decoder, which dispatches through the registry."""
+    key = int(encoding_id)
+    return _REGISTRY.get(key) is _BUILTIN.get(key)
+
+
+def lookup(encoding_id) -> Optional[EncodingSpec]:
+    return _REGISTRY.get(int(encoding_id))
+
+
+def registered_encodings() -> Dict[int, str]:
+    """{id: name} of everything currently registered (builtins included)."""
+    return {k: v.name for k, v in sorted(_REGISTRY.items())}

@@ -675,7 +675,7 @@ def gather_dictionary(dictionary, indices: np.ndarray):
 
         nat = native.gather_ba(dvals, doffs, indices)
         if nat is not None:
-            return nat[0], nat[1].astype(np.int32)
+            return nat[0], _offsets32(nat[1])
         indices = np.asarray(indices)
         if len(indices) and (indices.min() < 0
                              or indices.max() >= len(doffs) - 1):
@@ -688,5 +688,15 @@ def gather_dictionary(dictionary, indices: np.ndarray):
         total = int(out_offsets[-1])
         idx = np.repeat(doffs[:-1][indices].astype(np.int64), out_lens) + _ranges(out_lens)
         values = dvals[idx] if total else np.empty(0, dtype=np.uint8)
-        return values, out_offsets.astype(np.int32)
+        return values, _offsets32(out_offsets)
     return np.asarray(dictionary)[indices]
+
+
+def _offsets32(offsets: np.ndarray) -> np.ndarray:
+    """int64 gather offsets → the int32 convention, refusing silent wrap
+    when the concatenated byte total exceeds INT32_MAX (advisor r2)."""
+    if len(offsets) and int(offsets[-1]) > np.iinfo(np.int32).max:
+        raise ValueError(
+            "gathered byte-array output exceeds 2 GiB; int32 offsets would "
+            "wrap — gather a narrower row range")
+    return offsets.astype(np.int32)

@@ -77,6 +77,22 @@ class _LazyLevels:
         a = self._materialize()
         return np.asarray(a, dtype=dtype)
 
+    # comparisons/arithmetic materialize and delegate, so a consumer writing
+    # `col.def_levels == x` gets elementwise semantics instead of a silent
+    # Python identity bool (advisor r2)
+    def __eq__(self, other):
+        return self._materialize() == np.asarray(other)
+
+    def __ne__(self, other):
+        return self._materialize() != np.asarray(other)
+
+    __hash__ = None  # elementwise __eq__: not hashable, like ndarray
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        inputs = tuple(np.asarray(x) if isinstance(x, _LazyLevels) else x
+                       for x in inputs)
+        return getattr(ufunc, method)(*inputs, **kwargs)
+
     def __len__(self):
         return self._runs.total
 
@@ -434,6 +450,14 @@ def _add_dense_page(plan: _Plan, body: np.ndarray, kinds, cnts, offs,
 
 def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
                   encoding: Encoding, physical: Type, leaf) -> None:
+    from ..ops.encodings import is_builtin_decode
+
+    if not is_builtin_decode(encoding):
+        # a third-party decode shadows this id (ops/encodings.py registry):
+        # the accelerated planner only understands the spec encodings, so the
+        # chunk must decode on host, where dispatch honors the registry
+        raise _Unsupported(
+            f"encoding {encoding!r} is overridden by a registered decoder")
     if encoding in (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY):
         plan.set_kind("dict")
         width = int(raw[pos]) if pos < len(raw) else 0

@@ -83,11 +83,9 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     key_unsigned = is_unsigned(key_leaf)
     probe_set = None
     if values is not None:
-        from ..algebra.compare import in_type_range
+        from ..algebra.compare import normalize_probe
 
-        probe_set = {normalize(key_leaf, v) for v in values
-                     if v is not None
-                     and in_type_range(key_leaf, normalize(key_leaf, v))}
+        probe_set = {normalize_probe(key_leaf, v) for v in values} - {None}
 
     read_cols = [path] + [c for c in out_cols if c != path]
 
@@ -276,11 +274,9 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                         "(scan_filtered)") from None
                 per_col[c] = (chunk, dplan, staged, row_start - first)
         spans.append((plan, per_col))
-    from ..algebra.compare import in_type_range, normalize
+    from ..algebra.compare import normalize_probe
 
-    probe = (sorted({normalize(key_leaf, v) for v in values
-                     if v is not None
-                     and in_type_range(key_leaf, normalize(key_leaf, v))})
+    probe = (sorted({normalize_probe(key_leaf, v) for v in values} - {None})
              if values is not None else None)
     return {"path": path, "out_cols": out_cols, "lo": lo, "hi": hi,
             "values": probe, "spans": spans,

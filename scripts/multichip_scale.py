@@ -1,0 +1,137 @@
+"""Multichip evidence at size: sharded read + sharded pushdown scan of a
+lineitem-class file on a real device mesh, verified against the host oracle.
+
+Replaces the 2,048-slot toy as the multichip artifact (VERDICT r2 item 8):
+the file is ≥100 MB on disk, multi-row-group, and the run reports per-shard
+row counts and phase timings.  On this environment the mesh is the virtual
+8-device CPU mesh (tests' conftest topology); on hardware the same script
+runs unmodified on real chips.
+
+Usage:  python scripts/multichip_scale.py [rows] [out.json]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+
+if os.environ.get("MULTICHIP_REAL_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def make_file(path: str, n: int) -> None:
+    rng = np.random.default_rng(3)
+    ship = np.sort(rng.integers(8000, 12000, n).astype(np.int32))
+    t = pa.table({
+        "l_shipdate": pa.array(ship),
+        "l_orderkey": pa.array(np.arange(n, dtype=np.int64)),
+        "l_partkey": pa.array(rng.integers(1, 200_000, n).astype(np.int64)),
+        "l_suppkey": pa.array(rng.integers(1, 10_000, n).astype(np.int64)),
+        "l_quantity": pa.array(rng.integers(1, 51, n).astype(np.int64)),
+        "l_extendedprice": pa.array(rng.random(n) * 1e5),
+        "l_discount": pa.array(np.round(rng.random(n) * 0.1, 2)),
+        "l_tax": pa.array(np.round(rng.random(n) * 0.08, 2)),
+    })
+    pq.write_table(t, path, compression="snappy", row_group_size=n // 16,
+                   data_page_size=1 << 20, write_page_index=True,
+                   use_dictionary=False)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 3_000_000
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "MULTICHIP_SCALE.json"
+    path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                        f"parquet_tpu_mcs_{n}.parquet")
+    if not os.path.exists(path):
+        make_file(path, n)
+    file_mb = os.path.getsize(path) / 1e6
+
+    from parquet_tpu import ParquetFile, scan_filtered
+    from parquet_tpu.ops.device import pairs_to_host
+    from parquet_tpu.parallel.host_scan import scan_filtered_sharded
+    from parquet_tpu.parallel.mesh import default_mesh, read_table_sharded
+
+    mesh = default_mesh()
+    n_dev = len(list(mesh.devices.flat))
+    pf = ParquetFile(path)
+    cols = ["l_orderkey", "l_quantity", "l_extendedprice"]
+
+    # --- sharded whole-table read vs host oracle --------------------------
+    t0 = time.perf_counter()
+    st = read_table_sharded(pf, mesh=mesh, columns=cols)
+    jax.block_until_ready(list(st.arrays.values()))
+    sharded_read_s = time.perf_counter() - t0
+
+    host = pf.read(columns=cols)
+    ok_read = True
+    mask = np.asarray(st.row_mask())
+    for c in cols:
+        got = np.asarray(st.arrays[c])
+        if got.ndim == 2 and got.shape[-1] == 2:
+            dt = (np.float64 if c == "l_extendedprice" else np.int64)
+            got = np.ascontiguousarray(got).view(dt).reshape(-1)
+        got = got[mask]
+        # shards are row-group round-robin: reorder the oracle the same way
+        rg_rows = [pf.row_group(i).num_rows
+                   for i in range(len(pf.row_groups))]
+        starts = np.concatenate([[0], np.cumsum(rg_rows)])
+        order = [rg for d in range(n_dev)
+                 for rg in range(len(rg_rows)) if rg % n_dev == d]
+        exp = np.concatenate([np.asarray(host[c].values)
+                              [starts[rg]:starts[rg + 1]] for rg in order])
+        if not np.array_equal(got, exp):
+            ok_read = False
+
+    # --- sharded pushdown scan vs host oracle -----------------------------
+    lo, hi = 9000, 9150
+    t0 = time.perf_counter()
+    sh = scan_filtered_sharded(pf, "l_shipdate", lo=lo, hi=hi,
+                               columns=["l_extendedprice"], mesh=mesh)
+    sharded_scan_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    oracle = scan_filtered(pf, "l_shipdate", lo=lo, hi=hi,
+                           columns=["l_extendedprice"])
+    host_scan_s = time.perf_counter() - t0
+    dev_vals = np.sort(np.concatenate(
+        [pairs_to_host(part, np.float64) for part in sh["l_extendedprice"]]))
+    ok_scan = (sh["#rows"] == len(oracle["l_extendedprice"])
+               and np.allclose(dev_vals,
+                               np.sort(np.asarray(oracle["l_extendedprice"]))))
+
+    art = {
+        "ok": bool(ok_read and ok_scan),
+        "rows": n,
+        "file_MB": round(file_mb, 1),
+        "devices": n_dev,
+        "backend": jax.devices()[0].platform,
+        "row_groups": len(pf.row_groups),
+        "sharded_read_s": round(sharded_read_s, 3),
+        "per_shard_rows": list(map(int, st.row_counts)),
+        "sharded_scan_s": round(sharded_scan_s, 3),
+        "host_scan_s": round(host_scan_s, 3),
+        "scan_rows_selected": int(sh["#rows"]),
+        "read_equal": bool(ok_read),
+        "scan_equal": bool(ok_scan),
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art))
+    sys.exit(0 if art["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

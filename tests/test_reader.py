@@ -10,6 +10,8 @@ import pyarrow.parquet as pq
 import pytest
 
 from parquet_tpu.io.reader import CorruptedError, ParquetFile, ReadOptions
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.format.enums import Encoding
 
 
 def _roundtrip(table: pa.Table, **write_kwargs):
@@ -484,3 +486,40 @@ def test_cli_error_paths(tmp_path):
         assert main(["pages", p, "--column", "9"]) == 1
         assert main(["head", p, "-n", "0"]) == 1
     assert "parquet_tpu:" in err.getvalue()
+
+
+def test_encoding_registry_custom_decode(rng):
+    """Third parties register an encoding without editing the decoder
+    (encoding/encoding.go — Encoding parity): shadow BYTE_STREAM_SPLIT with
+    an XOR-postprocessing variant, then restore the builtin."""
+    import parquet_tpu
+    from parquet_tpu import DictIndices, EncodingSpec, register_encoding
+    from parquet_tpu.ops.encodings import lookup
+
+    assert 0 in parquet_tpu.registered_encodings()  # PLAIN is a default
+
+    builtin = lookup(int(Encoding.BYTE_STREAM_SPLIT))
+    calls = {}
+
+    def xor_decode(raw, pos, nvals, leaf, physical, dictionary):
+        calls["hit"] = True
+        out = builtin.decode(raw, pos, nvals, leaf, physical, dictionary)
+        return out ^ np.int32(0xFF) if out.dtype == np.int32 else out
+
+    register_encoding(EncodingSpec(Encoding.BYTE_STREAM_SPLIT, "BSS_XOR",
+                                   xor_decode), overwrite=True)
+    try:
+        vals = rng.integers(0, 1000, 500).astype(np.int32)
+        t = pa.table({"x": pa.array(vals)})
+        buf = io.BytesIO()
+        write_table(t, buf, WriterOptions(
+            dictionary=False,
+            column_encoding={"x": Encoding.BYTE_STREAM_SPLIT}))
+        got = ParquetFile(buf.getvalue()).read()["x"].to_numpy()
+        assert calls.get("hit")
+        np.testing.assert_array_equal(got, vals ^ np.int32(0xFF))
+    finally:
+        register_encoding(builtin, overwrite=True)
+    # duplicate registration without overwrite is loud
+    with pytest.raises(ValueError, match="already registered"):
+        register_encoding(builtin)

@@ -552,3 +552,17 @@ def test_bloom_device_cache_invalidated_on_insert(rng):
     h2 = hash_probe_values(leaf, [777, 888])
     f.insert_hashes(h2)  # must invalidate the device mirror
     assert f.check_hashes_batch(h2, prefer_device=True).all()
+
+
+def test_in_list_float_probe_on_int_column(rng):
+    """Integral float probes match like their int equivalents; fractional
+    floats can never match and drop silently."""
+    from parquet_tpu.parallel.host_scan import scan_filtered
+
+    k = np.sort(rng.integers(0, 1000, 2000)).astype(np.int64)
+    t = pa.table({"k": pa.array(k), "v": pa.array(np.arange(2000))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(write_page_index=True, dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    out = scan_filtered(pf, "k", values=[float(k[7]), 1.5], columns=["v"])
+    assert len(out["v"]) == int((k == k[7]).sum())
