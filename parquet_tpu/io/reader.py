@@ -527,8 +527,172 @@ class Table:
     # -- to_arrow helpers ------------------------------------------------
     def _field_to_arrow(self, node, leaves):
         if self._needs_row_assembly(node, under_rep=False):
+            arr = self._field_nested_vectorized(node)
+            if arr is not None:
+                return arr
             return self._field_via_rows(node)
         return self._build_arrow(node, (node.name,), 0)
+
+    def _field_nested_vectorized(self, node):
+        """Vectorized tier for structs and maps INSIDE repetition (SURVEY.md
+        §7 hard part 4): every layer — list offsets, struct/map nullness,
+        leaf validity — is derived from the raw Dremel level streams with
+        whole-column vector ops and zipped bottom-up; no per-record python.
+
+        Works at "granularity" (k, d_elem): the element set of the k-th
+        repeated ancestor, i.e. leaf slots with ``rep <= k`` and
+        ``def >= d_elem`` (k=0 → rows).  All leaves under a node agree on
+        that element set because levels are shared up to the common ancestor.
+        Returns None when any leaf lacks raw levels (device-resident decode)
+        — the caller falls back to the row model."""
+        import pyarrow as pa
+
+        from ..format.enums import FieldRepetitionType as Rep
+        from ..schema.types import LogicalKind
+        from .column import _leaf_to_arrow
+
+        prefix = (node.name,)
+        sub = [l for l in self.schema.leaves if l.path[0] == node.name]
+        if not sub:
+            return None
+        for l in sub:
+            col = self.columns[l.dotted_path]
+            if col.def_levels is None or (l.max_repetition_level
+                                          and col.rep_levels is None):
+                return None
+
+        def levels_of(leaf):
+            col = self.columns[leaf.dotted_path]
+            d = np.asarray(col.def_levels)
+            r = (np.asarray(col.rep_levels) if col.rep_levels is not None
+                 else np.zeros(len(d), np.int32))
+            return d, r
+
+        def any_leaf(pfx):
+            return next(l for l in sub if l.path[: len(pfx)] == pfx)
+
+        def elem_mask(d, r, k, d_elem):
+            return (r <= k) & (d >= d_elem)
+
+        def list_layer(pfx, k, d_elem, d_list, d_mid, inner_arr,
+                       nullable_list):
+            """Offsets (+ null lists) for one repetition layer around
+            ``inner_arr`` (already at granularity (k+1, d_mid))."""
+            d, r = levels_of(any_leaf(pfx))
+            inst = elem_mask(d, r, k, d_elem)
+            elem2 = elem_mask(d, r, k + 1, d_mid)
+            cum = np.cumsum(elem2, dtype=np.int64)
+            inst_idx = np.flatnonzero(inst)
+            starts = (cum[inst_idx] - elem2[inst_idx]).astype(np.int32)
+            total = np.int32(cum[-1] if len(cum) else 0)
+            offs = np.concatenate([starts, [total]]).astype(np.int32)
+            if nullable_list:
+                valid = d[inst_idx] >= d_list
+                if not valid.all():
+                    # null-bearing offsets encode null lists/maps
+                    pa_offs = pa.array(offs, mask=np.concatenate(
+                        [~valid, [False]]))
+                    return pa_offs
+            return pa.array(offs)
+
+        def build(n, pfx, k, d_elem, d_par):
+            """Arrow array for ``n`` at granularity (k, d_elem)."""
+            own_def = d_par + (1 if n.repetition != Rep.REQUIRED else 0)
+            if n.is_leaf:
+                leaf = any_leaf(pfx)
+                col = self.columns[leaf.dotted_path]
+                if col.is_dictionary_encoded():
+                    col.materialize_host()
+                d, r = levels_of(leaf)
+                mask = elem_mask(d, r, k, d_elem)
+                d_sub = d[mask]
+                validity = (d_sub == leaf.max_definition_level
+                            if leaf.max_definition_level > d_elem else None)
+                if validity is not None and bool(validity.all()):
+                    validity = None
+                values = np.asarray(col.values)
+                if (values.ndim == 2 and values.dtype == np.uint32
+                        and values.shape[1] == 2):
+                    host_dt = {Type.INT64: np.int64,
+                               Type.DOUBLE: np.float64}.get(
+                                   leaf.physical_type, np.int64)
+                    values = np.ascontiguousarray(values).view(host_dt) \
+                        .reshape(-1)
+                offsets = (None if col.offsets is None
+                           else np.asarray(col.offsets))
+                return _leaf_to_arrow(leaf, values, offsets, validity)
+            kind = n.logical_kind
+            if kind == LogicalKind.LIST and len(n.children) == 1 \
+                    and n.children[0].repetition == Rep.REPEATED:
+                mid = n.children[0]
+                d_list = own_def
+                d_mid = d_list + 1
+                if mid.children is not None and len(mid.children) == 1:
+                    inner = mid.children[0]
+                    inner_pfx = pfx + (mid.name, inner.name)
+                else:
+                    inner = mid
+                    inner_pfx = pfx + (mid.name,)
+                if inner is mid:
+                    # 2-level list form: repeated element directly
+                    inner_arr = build_repeated_elem(mid, pfx + (mid.name,),
+                                                    k + 1, d_mid)
+                else:
+                    inner_arr = build(inner, inner_pfx, k + 1, d_mid, d_mid)
+                offs = list_layer(pfx, k, d_elem, d_list, d_mid, inner_arr,
+                                  n.repetition != Rep.REQUIRED)
+                return pa.ListArray.from_arrays(offs, inner_arr)
+            if kind == LogicalKind.MAP and len(n.children) == 1:
+                mid = n.children[0]  # repeated key_value
+                d_map = own_def
+                d_mid = d_map + 1
+                kv_pfx = pfx + (mid.name,)
+                keys = build(mid.children[0], kv_pfx + (mid.children[0].name,),
+                             k + 1, d_mid, d_mid)
+                items = build(mid.children[1],
+                              kv_pfx + (mid.children[1].name,),
+                              k + 1, d_mid, d_mid)
+                offs = list_layer(pfx, k, d_elem, d_map, d_mid, keys,
+                                  n.repetition != Rep.REQUIRED)
+                return pa.MapArray.from_arrays(offs, keys, items)
+            if n.repetition == Rep.REPEATED:
+                # legacy repeated group (list<struct> without LIST wrapper)
+                d_mid = d_par + 1
+                inner_arr = build_repeated_elem(n, pfx, k + 1, d_mid)
+                offs = list_layer(pfx, k, d_elem, d_mid, d_mid, inner_arr,
+                                  False)
+                return pa.ListArray.from_arrays(offs, inner_arr)
+            # plain struct at the current granularity
+            kids = [(c.name, build(c, pfx + (c.name,), k, d_elem, own_def))
+                    for c in n.children]
+            arrs = [a for _, a in kids]
+            names = [nm for nm, _ in kids]
+            if n.repetition == Rep.REQUIRED or own_def == d_elem:
+                return pa.StructArray.from_arrays(arrs, names)
+            d, r = levels_of(any_leaf(pfx))
+            valid = d[elem_mask(d, r, k, d_elem)] >= own_def
+            if bool(valid.all()):
+                return pa.StructArray.from_arrays(arrs, names)
+            return pa.StructArray.from_arrays(arrs, names,
+                                              mask=pa.array(~valid))
+
+        def build_repeated_elem(n, pfx, k, d_elem):
+            """The element of a repeated group: a struct of n's children (or
+            n's own leaf value) at the deeper granularity."""
+            if n.is_leaf:
+                return build(_required_view(n), pfx, k, d_elem, d_elem)
+            kids = [(c.name, build(c, pfx + (c.name,), k, d_elem, d_elem))
+                    for c in n.children]
+            return pa.StructArray.from_arrays([a for _, a in kids],
+                                              [nm for nm, _ in kids])
+
+        def _required_view(n):
+            return n
+
+        try:
+            return build(node, prefix, 0, 0, 0)
+        except NotImplementedError:
+            return None
 
     def _needs_row_assembly(self, node, under_rep: bool) -> bool:
         """True if a plain (non-list-machinery) group sits under repetition —
