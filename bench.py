@@ -39,7 +39,7 @@ import time
 # measured 2x on the lineitem config.  The tunables are only read at process
 # start, so re-exec once with them set (pyarrow ships jemalloc and is immune;
 # without this the comparison measures allocators, not decoders).
-if os.environ.get("_BENCH_MALLOC_TUNED") != "1":
+if __name__ == "__main__" and os.environ.get("_BENCH_MALLOC_TUNED") != "1":
     env = dict(os.environ,
                _BENCH_MALLOC_TUNED="1",
                MALLOC_MMAP_THRESHOLD_="17179869184",

@@ -31,14 +31,38 @@ _SALT = np.array([
 
 _SALT_U32 = _SALT.astype(np.uint32)
 
+_WARNED_NO_CACHE = False
+
 
 def _device_live() -> bool:
     """True when a non-CPU jax backend is already initialized — probing must
     never be the call that pays (or hangs on) accelerator bring-up."""
     try:
-        import jax
+        import sys
 
-        return jax.devices()[0].platform != "cpu"
+        if "jax" not in sys.modules:
+            return False
+        from jax._src import xla_bridge
+
+        # Inspect the backend cache without populating it: jax.devices()
+        # would INITIALIZE the backend, and on a dead accelerator tunnel the
+        # first bring-up hangs rather than raising.  The DEFAULT backend is
+        # what the device probe path actually executes on, so gate on that
+        # (a merely-cached non-default accelerator must not take the route).
+        default = getattr(xla_bridge, "_default_backend", None)
+        if default is not None:
+            return getattr(default, "platform", "cpu") != "cpu"
+        if not hasattr(xla_bridge, "_default_backend"):
+            global _WARNED_NO_CACHE
+            if not _WARNED_NO_CACHE:
+                _WARNED_NO_CACHE = True
+                import warnings
+
+                warnings.warn(
+                    "parquet_tpu: jax._src.xla_bridge._default_backend is "
+                    "missing in this jax version; device bloom probing is "
+                    "disabled (host path only)")
+        return False
     except Exception:
         return False
 
@@ -298,6 +322,12 @@ def hash_probe_values(leaf: Leaf, values) -> np.ndarray:
         vals = [int_to_be_bytes(v, width) if isinstance(v, int) else v
                 for v in vals]
     bs = [bytes(v) for v in vals]
+    if t == Type.FIXED_LEN_BYTE_ARRAY:
+        # hash_values reshapes to the column width, which would raise for a
+        # probe whose byte length differs; hash each probe's raw bytes
+        # instead — a wrong-width probe can never equal a stored value, and
+        # its raw-byte hash yields at worst a bloom false positive.
+        return np.array([xxh64_bytes(b) for b in bs], dtype=np.uint64)
     offs = np.zeros(len(bs) + 1, np.int64)
     np.cumsum([len(b) for b in bs], out=offs[1:])
     return hash_values(leaf, np.frombuffer(b"".join(bs), np.uint8), offs)

@@ -1048,7 +1048,13 @@ for _spec in (
                      _dec_bss),
         EncodingSpec(Encoding.RLE, "RLE", _dec_rle_bool),
 ):
-    register_encoding(_spec, _builtin=True)
+    # Idempotent under module re-execution (importlib.reload, or the module
+    # reached under two names) — but never clobber a user's registered
+    # shadow of a builtin id.
+    from ..ops.encodings import is_builtin_decode, lookup
+
+    if lookup(_spec.id) is None or is_builtin_decode(_spec.id):
+        register_encoding(_spec, overwrite=True, _builtin=True)
 
 
 def _combine_parts(part_order, index_parts, value_parts, dictionary, leaf, physical):

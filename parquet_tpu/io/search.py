@@ -104,16 +104,26 @@ def prune_row_group(rg: RowGroupReader, path, lo=None, hi=None,
     equals = normalize(chunk.leaf, equals)
     st = chunk.statistics()
     if st is not None and st.min_value is not None and st.max_value is not None:
-        if lo is not None and st.max_value < lo:
-            return False
-        if hi is not None and st.min_value > hi:
-            return False
-        if equals is not None and not (st.min_value <= equals <= st.max_value):
-            return False
+        try:
+            if lo is not None and st.max_value < lo:
+                return False
+            if hi is not None and st.min_value > hi:
+                return False
+            if equals is not None and not (st.min_value <= equals <= st.max_value):
+                return False
+        except TypeError:
+            # Probe not comparable with the decoded stats domain (e.g. raw
+            # bytes against a DECIMAL column): stats are inconclusive — fall
+            # through to the bloom filter, which hashes raw probe bytes.
+            pass
     if use_bloom and equals is not None:
         bf = chunk.bloom_filter()
-        if bf is not None and not bf.check(equals, chunk.leaf):
-            return False
+        if bf is not None:
+            try:
+                if not bf.check(equals, chunk.leaf):
+                    return False
+            except (TypeError, ValueError):
+                pass  # probe not encodable in the column's domain
     return True
 
 
