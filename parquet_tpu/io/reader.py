@@ -954,6 +954,7 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
 
 
 from ..ops.encodings import (DictIndices as _DictIndices, EncodingSpec,
+                             is_builtin_decode as _is_builtin_decode,
                              lookup as _lookup_encoding, register_encoding)
 
 
@@ -1051,9 +1052,7 @@ for _spec in (
     # Idempotent under module re-execution (importlib.reload, or the module
     # reached under two names) — but never clobber a user's registered
     # shadow of a builtin id.
-    from ..ops.encodings import is_builtin_decode, lookup
-
-    if lookup(_spec.id) is None or is_builtin_decode(_spec.id):
+    if _lookup_encoding(_spec.id) is None or _is_builtin_decode(_spec.id):
         register_encoding(_spec, overwrite=True, _builtin=True)
 
 

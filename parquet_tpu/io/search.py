@@ -122,7 +122,7 @@ def prune_row_group(rg: RowGroupReader, path, lo=None, hi=None,
             try:
                 if not bf.check(equals, chunk.leaf):
                     return False
-            except (TypeError, ValueError):
+            except (TypeError, ValueError, OverflowError):
                 pass  # probe not encodable in the column's domain
     return True
 

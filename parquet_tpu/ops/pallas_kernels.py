@@ -17,9 +17,17 @@ indexes, most delta miniblocks after host bucketing) route here.
 Measured on the real v5e (round 2, 8M values): ``unpack_bits_dense`` beats
 the jnp twin 2-4x (w=1: 73ms vs 283ms; w=8: 67ms vs 167ms; w=16: 67ms vs
 145ms), so it is the default TPU route for w ≤ 16 (device_reader._use_pallas).
-KNOWN MOSAIC BUG: for w ≥ 17 the compiled kernel deterministically corrupts
-the word-straddling columns whose shift is 16 (sparse wrong values; the jnp
-twin is correct at every width) — the router pins wide streams to jnp.
+KNOWN MOSAIC BUG: for w ≥ 17 the compiled shift-formulation kernel
+deterministically corrupts the word-straddling columns whose shift is 16
+(sparse wrong values; the jnp twin is correct at every width) — the router
+pins wide streams to jnp.  Minimized standalone repro:
+``scripts/mosaic_repro.py`` (run it on a real chip; interpret mode is
+correct everywhere).  The suspected-bad pattern is ``(lo >> 16) |
+(hi << 16)``; :func:`unpack_bits_dense` therefore reformulates the
+straddle as a MULTIPLY (``hi * 2**(32-sh)``) for w ≥ 17 — semantically
+identical, and a candidate dodge for the vector lowering bug.  The mul
+variant is opt-in on-chip via ``PARQUET_TPU_PALLAS=mul`` until a chip
+trial proves it (device_reader._use_pallas).
 """
 
 from __future__ import annotations
@@ -37,8 +45,13 @@ from jax.experimental.pallas import tpu as pltpu
 _MASK32 = 0xFFFFFFFF
 
 
-def _unpack_block_kernel(words_ref, out_ref, *, w: int):
-    """One VMEM block: (B, w) packed uint32 words → (B, 32) values."""
+def _unpack_block_kernel(words_ref, out_ref, *, w: int, straddle: str):
+    """One VMEM block: (B, w) packed uint32 words → (B, 32) values.
+
+    ``straddle`` picks the word-straddle formulation: ``"shift"`` is the
+    classic ``lo | (hi << (32-sh))``; ``"mul"`` replaces the left-shift with
+    an equivalent multiply (``hi * 2**(32-sh)``) to dodge the Mosaic w ≥ 17
+    shift-16 miscompile (scripts/mosaic_repro.py)."""
     words = words_ref[:]
     mask = jnp.uint32((1 << w) - 1 if w < 32 else _MASK32)
     cols = []
@@ -48,7 +61,10 @@ def _unpack_block_kernel(words_ref, out_ref, *, w: int):
         sh = bitpos & 31
         lo = words[:, k] >> jnp.uint32(sh)
         if sh + w > 32:
-            hi = words[:, k + 1] << jnp.uint32(32 - sh)
+            if straddle == "mul":
+                hi = words[:, k + 1] * jnp.uint32(1 << (32 - sh))
+            else:
+                hi = words[:, k + 1] << jnp.uint32(32 - sh)
             val = lo | hi
         else:
             val = lo
@@ -56,16 +72,22 @@ def _unpack_block_kernel(words_ref, out_ref, *, w: int):
     out_ref[:] = jnp.concatenate(cols, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "w", "block", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("n", "w", "block", "interpret", "straddle"))
 def unpack_bits_dense(packed_words: jax.Array, n: int, w: int,
-                      block: int = 512, interpret: bool = False) -> jax.Array:
+                      block: int = 512, interpret: bool = False,
+                      straddle: Optional[str] = None) -> jax.Array:
     """Unpack ``n`` LSB-first ``w``-bit integers from a dense stream.
 
     ``packed_words``: uint32[ceil(n/32)*w] (caller pads).  Returns uint32[n].
     Grid over groups of 32 values; each grid step unpacks ``block`` groups.
+    ``straddle`` defaults to ``"shift"`` for w ≤ 16 and ``"mul"`` for wider
+    widths (the Mosaic-miscompile dodge — module docstring).
     """
     if w == 32:
         return packed_words[:n]
+    if straddle is None:
+        straddle = "mul" if w >= 17 else "shift"
     groups = (n + 31) // 32
     gpad = (groups + block - 1) // block * block
     need_words = gpad * w
@@ -73,7 +95,7 @@ def unpack_bits_dense(packed_words: jax.Array, n: int, w: int,
         packed_words = jnp.pad(packed_words, (0, need_words - packed_words.shape[0]))
     words2d = packed_words[: gpad * w].reshape(gpad, w)
     out = pl.pallas_call(
-        functools.partial(_unpack_block_kernel, w=w),
+        functools.partial(_unpack_block_kernel, w=w, straddle=straddle),
         out_shape=jax.ShapeDtypeStruct((gpad, 32), jnp.uint32),
         grid=(gpad // block,),
         in_specs=[pl.BlockSpec((block, w), lambda i: (i, 0),

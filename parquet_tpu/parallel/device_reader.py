@@ -374,8 +374,11 @@ def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
 def _dense_mode() -> str:
     """Routing for single-width dense streams: 'auto' (default — the Pallas
     VMEM-tiled kernel on TPU for widths ≤ 16, the jnp twin elsewhere),
-    'pallas'/'jnp' to force a path, or 'off' (round-1 per-value gather
-    path). PARQUET_TPU_PALLAS=1 → pallas, =0 → jnp, =off → off."""
+    'pallas'/'jnp' to force a path, 'off' (round-1 per-value gather path),
+    or 'mul' — like auto but ALSO routes w ≥ 17 through the Pallas kernel's
+    multiply-straddle variant (the Mosaic-miscompile dodge; opt-in until a
+    chip trial proves it — scripts/mosaic_repro.py).
+    PARQUET_TPU_PALLAS=1 → pallas, =0 → jnp, =off → off, =mul → mul."""
     import os
 
     v = os.environ.get("PARQUET_TPU_PALLAS", "")
@@ -385,7 +388,7 @@ def _dense_mode() -> str:
         return "jnp"
     if v.lower() == "off":
         return "off"
-    if v.lower() in ("jnp", "pallas", "auto"):
+    if v.lower() in ("jnp", "pallas", "auto", "mul"):
         return v.lower()
     return "auto"
 
@@ -398,15 +401,23 @@ def _use_pallas(w: int) -> bool:
 
     Measured on the real v5e (round 2): Pallas wins 2-4x over the jnp twin
     for w ≤ 16 (8M values: ~67ms vs 140-280ms), but Mosaic DETERMINISTICALLY
-    MISCOMPILES the word-straddling columns for w ≥ 17 (sparse wrong values
-    at shift-16 lanes; the jnp twin is correct at every width) — so wide
-    streams always take the jnp path, even when forced."""
-    if w > 16 or _pallas_broken:
+    MISCOMPILES the word-straddling columns for w ≥ 17 in the shift
+    formulation (sparse wrong values at shift-16 lanes; the jnp twin is
+    correct at every width; minimized repro: scripts/mosaic_repro.py) — so
+    wide streams take the jnp path unless PARQUET_TPU_PALLAS=mul opts into
+    the multiply-straddle variant, which is semantically proven (interpret
+    tests) but awaiting an on-chip trial."""
+    if _pallas_broken:
         return False
     mode = _dense_mode()
+    if w > 16:
+        # unpack_bits_dense auto-selects the mul straddle for w ≥ 17, but
+        # the route itself stays opt-in until the chip trial passes
+        return mode == "mul"
     if mode == "pallas":
         return True  # forced (interpret mode covers non-TPU backends)
-    return mode == "auto" and jax.default_backend() == "tpu"
+    # 'mul' behaves like auto below the wide widths
+    return mode in ("auto", "mul") and jax.default_backend() == "tpu"
 
 
 def _pallas_fallback(exc: Exception) -> None:

@@ -1,0 +1,147 @@
+"""Minimized standalone repro: Mosaic TPU miscompile of the bit-unpack
+straddle pattern ``(w[:, k] >> 16) | (w[:, k+1] << 16)`` for widths >= 17.
+
+Self-contained on purpose (no parquet_tpu import) so it can be attached to
+an upstream JAX/Mosaic bug report as-is.
+
+Observed on a real TPU v5e (jax 0.9.0, 2026-07-30, parquet_tpu round 2):
+for a static bit width ``w >= 17``, the compiled Pallas kernel below
+("shift" variant) produces sparse wrong values, always and only at the
+word-straddling output lanes whose in-word shift is 16 — e.g. w=17 group
+position 16; w=20 positions 4 and 28.  Deterministic across runs (same bad
+indices every time).  The same kernel is correct:
+  - in interpret mode, at every width;
+  - compiled on-chip for every width <= 16;
+  - when the straddle's left-shift is reformulated as an equivalent
+    multiply (``hi * 2**(32-sh)`` — the "mul" variant below), in interpret
+    mode (on-chip trial pending; run this script on a chip to find out).
+
+Usage:  python scripts/mosaic_repro.py [--json OUT.json]
+Exit 0 always (it reports; the caller decides).  On a CPU/interpret backend
+everything should PASS — the bug needs the Mosaic compile path on a chip.
+"""
+
+import argparse
+import functools
+import json
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 512
+
+
+def _kernel(words_ref, out_ref, *, w: int, straddle: str):
+    """(B, w) packed uint32 words -> (B, 32) w-bit values, LSB-first."""
+    words = words_ref[:]
+    mask = jnp.uint32((1 << w) - 1 if w < 32 else 0xFFFFFFFF)
+    cols = []
+    for j in range(32):
+        bitpos = j * w
+        k, sh = bitpos >> 5, bitpos & 31
+        val = words[:, k] >> jnp.uint32(sh)
+        if sh + w > 32:
+            if straddle == "mul":
+                val = val | (words[:, k + 1] * jnp.uint32(1 << (32 - sh)))
+            else:  # the suspected-bad pattern
+                val = val | (words[:, k + 1] << jnp.uint32(32 - sh))
+        cols.append((val & mask).reshape(-1, 1))
+    out_ref[:] = jnp.concatenate(cols, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "w", "straddle", "interpret"))
+def unpack(packed_words, n, w, straddle, interpret):
+    groups = (n + 31) // 32
+    gpad = (groups + BLOCK - 1) // BLOCK * BLOCK
+    need = gpad * w
+    if packed_words.shape[0] < need:
+        packed_words = jnp.pad(packed_words, (0, need - packed_words.shape[0]))
+    words2d = packed_words[: gpad * w].reshape(gpad, w)
+    out = pl.pallas_call(
+        functools.partial(_kernel, w=w, straddle=straddle),
+        out_shape=jax.ShapeDtypeStruct((gpad, 32), jnp.uint32),
+        grid=(gpad // BLOCK,),
+        in_specs=[pl.BlockSpec((BLOCK, w), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((BLOCK, 32), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(words2d)
+    return out.reshape(-1)[:n]
+
+
+def pack_lsb_first(vals: np.ndarray, w: int) -> np.ndarray:
+    """Pack w-bit values LSB-first into a uint32 word stream (numpy oracle
+    of the parquet bit-packed layout, whole 32-value groups)."""
+    n = len(vals)
+    nbits = -(-n * w // 8) * 8  # pad to whole bytes for any (n, w)
+    bits = np.zeros(nbits, np.uint8)
+    for i in range(w):
+        bits[i:n * w:w] = (vals >> i) & 1
+    by = np.packbits(bits.reshape(-1, 8)[:, ::-1], axis=1).reshape(-1)
+    by = by.copy()
+    by.resize(((n + 31) // 32) * w * 4)
+    return by.view(np.uint32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None, help="write results artifact")
+    ap.add_argument("--n", type=int, default=200_000)
+    args = ap.parse_args()
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    report = {"jax": jax.__version__, "backend": backend,
+              "interpret": interpret, "widths": {}}
+    print(f"jax {jax.__version__}, backend={backend}, interpret={interpret}",
+          file=sys.stderr)
+
+    rng = np.random.default_rng(7)
+    for w in (16, 17, 20, 24, 31):
+        vals = rng.integers(0, 1 << w, args.n, dtype=np.uint64).astype(np.uint32)
+        words = jax.device_put(pack_lsb_first(vals, w))
+        row = {}
+        for variant in ("shift", "mul"):
+            got = np.asarray(unpack(words, args.n, w, variant, interpret))
+            bad = np.flatnonzero(got != vals)
+            row[variant] = {
+                "ok": bad.size == 0,
+                "nbad": int(bad.size),
+                # in-group lane positions of the corruption (the signature:
+                # exactly the lanes whose in-word shift is 16)
+                "bad_lanes": sorted(set((bad % 32).tolist()))[:8],
+            }
+            status = "PASS" if bad.size == 0 else f"FAIL nbad={bad.size} lanes={row[variant]['bad_lanes']}"
+            print(f"w={w:2d} {variant:5s}: {status}", file=sys.stderr)
+        report["widths"][w] = row
+
+    shift_bug = any(not r["shift"]["ok"] for r in report["widths"].values())
+    mul_ok = all(r["mul"]["ok"] for r in report["widths"].values())
+    report["shift_bug_reproduced"] = shift_bug
+    report["mul_variant_correct"] = mul_ok
+    if backend == "tpu":
+        verdict = ("BUG REPRODUCED on-chip; mul variant "
+                   + ("DODGES it — lift the w>=17 gate via PARQUET_TPU_PALLAS=mul"
+                      if mul_ok else "ALSO AFFECTED — keep the jnp pin"))if shift_bug else \
+            "bug NOT reproduced on this chip/jax version — gate may be liftable"
+    else:
+        verdict = ("interpret-mode semantics " +
+                   ("correct for both variants" if mul_ok and not shift_bug
+                    else "UNEXPECTEDLY WRONG — investigate"))
+    report["verdict"] = verdict
+    print(verdict, file=sys.stderr)
+    out = json.dumps(report)
+    print(out)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()

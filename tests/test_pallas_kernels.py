@@ -57,3 +57,35 @@ def test_bloom_check_blocks(rng):
     expect = filt.check_hashes(probes)
     np.testing.assert_array_equal(out, expect)
     assert out[:250].all()  # no false negatives
+
+
+@pytest.mark.parametrize("w", [17, 20, 24, 31])
+@pytest.mark.parametrize("straddle", ["shift", "mul"])
+def test_unpack_wide_straddle_variants(w, straddle, rng):
+    """Both straddle formulations agree with the oracle in interpret mode
+    (on-chip, 'shift' is Mosaic-miscompiled for w >= 17 — the 'mul' variant
+    is the candidate dodge; scripts/mosaic_repro.py)."""
+    n = 4099
+    v = rng.integers(0, 1 << w, size=n, dtype=np.uint64)
+    words = _pack_words(v, w)
+    out = pk.unpack_bits_dense(words, n, w, interpret=True, straddle=straddle)
+    np.testing.assert_array_equal(np.asarray(out), v.astype(np.uint32))
+
+
+def test_wide_width_routing(monkeypatch):
+    """w >= 17 stays jnp-pinned by default; PARQUET_TPU_PALLAS=mul opts the
+    wide widths into the Pallas multiply-straddle route."""
+    from parquet_tpu.parallel import device_reader as dr
+
+    monkeypatch.setattr(dr, "_pallas_broken", False)
+    monkeypatch.delenv("PARQUET_TPU_PALLAS", raising=False)
+    assert dr._use_pallas(20) is False
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "pallas")
+    assert dr._use_pallas(20) is False  # even forced, shift route refused
+    assert dr._use_pallas(8) is True
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "mul")
+    assert dr._use_pallas(20) is True   # explicit opt-in trial route
+    # below the wide widths 'mul' behaves like auto (Pallas only on TPU)
+    import jax
+
+    assert dr._use_pallas(8) is (jax.default_backend() == "tpu")
