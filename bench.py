@@ -175,12 +175,19 @@ def _salted_plan(plan, salt: int):
     compute is shape-static and data-independent under jit."""
     import copy
 
+    from parquet_tpu.parallel.device_reader import _ByteAccum
+
+    def _salted(accum, s):
+        out = _ByteAccum()
+        out.extend(accum.array() ^ s)
+        return out
+
     p = copy.copy(plan)
     s = np.uint8(salt & 0xFF)
-    if getattr(plan, "values", None):
-        p.values = bytes(np.frombuffer(plan.values, np.uint8) ^ s)
-    if getattr(plan, "dense", None):
-        p.dense = bytearray(np.frombuffer(bytes(plan.dense), np.uint8) ^ s)
+    if len(getattr(plan, "values", ())):
+        p.values = _salted(plan.values, s)
+    if len(getattr(plan, "dense", ())):
+        p.dense = _salted(plan.dense, s)
     return p
 
 
@@ -202,7 +209,7 @@ def _block(col):
         d.block_until_ready()
 
 
-def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None, reps=4):
+def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None, reps=4, warm_raw=None):
     """Configs 1-4 core: host plan -> stage -> timed device decode + e2e.
 
     Cache-honesty protocol (VERDICT r2 item 1): the kernel phase times one
@@ -250,7 +257,13 @@ def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None, reps=4):
 
     # e2e sustained pipeline on the ORIGINAL bytes (content not yet
     # dispatched): cold file, wall clock includes pread + decompress +
-    # prescan + H2D + decode
+    # prescan + H2D + decode.  The pipeline path (intra-chunk page batching)
+    # compiles shapes the kernel warmup above never touches, so it warms on
+    # a seed-shifted twin file — identical structure, distinct content —
+    # keeping the timed dispatch both compile-warm and cache-honest.
+    if warm_raw is not None:
+        _block(next(dr.decode_chunks_pipelined(
+            [ParquetFile(warm_raw).row_group(0).column(0)])))
     t0 = time.perf_counter()
     col = next(dr.decode_chunks_pipelined(
         [ParquetFile(raw).row_group(0).column(0)]))
@@ -294,43 +307,70 @@ def _bench_chunk(raw, arrow_nbytes, pa_read_kw=None, reps=4):
     return out
 
 
+def _build1(n, seed):
+    t = pa.table({"x": pa.array(
+        (np.arange(n, dtype=np.int64) * 2654435761 + seed * 40503) % (1 << 62))})
+    return _write(t, compression="none", use_dictionary=False,
+                  column_encoding={"x": "PLAIN"}), t.nbytes, None
+
+
 def _cfg1(n):
-    t = pa.table({"x": pa.array((np.arange(n, dtype=np.int64) * 2654435761) % (1 << 62))})
-    raw = _write(t, compression="none", use_dictionary=False,
-                 column_encoding={"x": "PLAIN"})
-    return _bench_chunk(raw, t.nbytes)
+    return _run_cfg(_build1, n)
+
+
+def _build2(n, seed):
+    rng = np.random.default_rng(7 + seed)
+    t = pa.table({"k": pa.array(rng.integers(0, 20_000, n).astype(np.int64))})
+    return _write(t, compression="snappy", use_dictionary=True), t.nbytes, None
 
 
 def _cfg2(n):
-    rng = np.random.default_rng(7)
-    t = pa.table({"k": pa.array(rng.integers(0, 20_000, n).astype(np.int64))})
-    raw = _write(t, compression="snappy", use_dictionary=True)
-    return _bench_chunk(raw, t.nbytes)
+    return _run_cfg(_build2, n)
 
 
-def _cfg3(n):
-    rng = np.random.default_rng(11)
+def _build3(n, seed):
+    rng = np.random.default_rng(11 + seed)
     cats = np.array([f"payment_type_{i:03d}" for i in range(200)])
     arr = pa.array(cats[rng.integers(0, 200, n)]).dictionary_encode()
     t = pa.table({"s": arr})
-    raw = _write(t, compression="zstd", use_dictionary=True)
-    return _bench_chunk(raw, t.nbytes, pa_read_kw={"read_dictionary": ["s"]})
+    return (_write(t, compression="zstd", use_dictionary=True), t.nbytes,
+            {"read_dictionary": ["s"]})
 
 
-def _cfg4(n):
+def _cfg3(n):
+    return _run_cfg(_build3, n)
+
+
+def _build4(n, seed):
+    # the warm twin (seed 1) shifts only the BASE timestamp: deltas — and so
+    # the content-derived static miniblock widths the jit specializes on —
+    # are identical, while the staged first-value bytes differ (distinct
+    # buffers, warm compile cache)
     rng = np.random.default_rng(13)
     lens = rng.integers(0, 8, max(n // 4, 1))
     lens[rng.random(len(lens)) < 0.05] = 0
     total = int(lens.sum())
     offs = np.zeros(len(lens) + 1, np.int32)
     np.cumsum(lens, out=offs[1:])
-    base = 1_700_000_000_000_000 + np.cumsum(
+    base = 1_700_000_000_000_000 + seed * 977_777 + np.cumsum(
         rng.integers(0, 1000, max(total, 1)).astype(np.int64))
     arr = pa.ListArray.from_arrays(pa.array(offs), pa.array(base[:total]))
     t = pa.table({"ts": arr})
-    raw = _write(t, compression="none", use_dictionary=False,
-                 column_encoding={"ts.list.element": "DELTA_BINARY_PACKED"})
-    return _bench_chunk(raw, t.nbytes)
+    return _write(t, compression="none", use_dictionary=False,
+                  column_encoding={"ts.list.element": "DELTA_BINARY_PACKED"}), \
+        t.nbytes, None
+
+
+def _cfg4(n):
+    return _run_cfg(_build4, n)
+
+
+def _run_cfg(build, n):
+    """Generate the timed file (seed 0) plus a seed-shifted warm twin for the
+    pipeline-path compile warmup (identical structure, distinct content)."""
+    raw, nbytes, pa_kw = build(n, 0)
+    warm_raw, _, _ = build(n, 1)
+    return _bench_chunk(raw, nbytes, pa_read_kw=pa_kw, warm_raw=warm_raw)
 
 
 def _cfg5(n):

@@ -12,10 +12,18 @@ import os
 import threading
 from typing import Union
 
+import numpy as np
+
 
 class Source:
     def pread(self, offset: int, size: int) -> bytes:
         raise NotImplementedError
+
+    def pread_view(self, offset: int, size: int):
+        """Like :meth:`pread`, but may return any zero-copy buffer (a
+        memoryview or numpy view) when the backing store allows it; callers
+        must treat the result as read-only.  Default: a plain bytes copy."""
+        return self.pread(offset, size)
 
     def size(self) -> int:
         raise NotImplementedError
@@ -44,6 +52,20 @@ class FileSource(Source):
             got += len(chunk)
         return parts[0] if len(parts) == 1 else b"".join(parts)
 
+    def pread_view(self, offset: int, size: int) -> np.ndarray:
+        """Read straight into a numpy buffer — one copy (kernel→array)
+        instead of pread's kernel→bytes→join."""
+        buf = np.empty(size, np.uint8)
+        mv = memoryview(buf)
+        got = 0
+        while got < size:
+            n = os.preadv(self._fd, [mv[got:]], offset + got)
+            if n <= 0:
+                raise IOError(
+                    f"short read at {offset}: wanted {size}, got {got}")
+            got += n
+        return buf
+
     def size(self) -> int:
         return self._size
 
@@ -62,6 +84,18 @@ class BytesSource(Source):
         if len(out) != size:
             raise IOError(f"short read at {offset}")
         return bytes(out)
+
+    def pread_view(self, offset: int, size: int):
+        out = self._data[offset : offset + size]
+        if len(out) != size:
+            raise IOError(f"short read at {offset}")
+        if not self._data.readonly:
+            # a bytearray-backed source: decoded columns may lazily reference
+            # chunk bytes, and a caller mutating its buffer after read()
+            # would silently corrupt them — zero-copy only from immutable
+            # backings
+            return bytes(out)
+        return out
 
     def size(self) -> int:
         return len(self._data)

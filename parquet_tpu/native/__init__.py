@@ -111,6 +111,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_scan_rle_runs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
             _u8p_w, _i64p, _i64p, _i64p]
+        lib.pq_scan_page_headers.restype = ctypes.c_int64
+        lib.pq_scan_page_headers.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _i64p_w]
         lib.pq_xxh64.restype = ctypes.c_uint64
         lib.pq_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         lib.pq_xxh64_batch.restype = None
@@ -416,6 +420,54 @@ def expand_runs(buf: np.ndarray, ends: np.ndarray, kinds: np.ndarray,
         np.ascontiguousarray(bit_offsets, np.int64),
         np.ascontiguousarray(widths, np.int32), len(kinds), out, n)
     return out[:wrote]
+
+
+# column indexes of a pq_scan_page_headers row — keep in sync with the
+# PG_* enum in native.cpp
+PG_HEADER_POS = 0
+PG_DATA_POS = 1
+PG_TYPE = 2
+PG_COMP = 3
+PG_UNCOMP = 4
+PG_CRC = 5
+PG_NVALS = 6
+PG_ENC = 7
+PG_DEF_ENC = 8
+PG_REP_ENC = 9
+PG_RL_BYTES = 10
+PG_DL_BYTES = 11
+PG_NNULLS = 12
+PG_IS_COMPRESSED = 13
+PG_DICT_NVALS = 14
+PG_NROWS = 15
+PG_NFIELDS = 16
+
+
+def scan_page_headers(buf, total_values: int):
+    """Batch-parse a chunk's PageHeader stream.  Returns an (npages,
+    PG_NFIELDS) int64 array, or None when the native library is unavailable
+    or the stream has a construct the fast scanner doesn't handle (caller
+    falls back to the Python thrift walk, which owns error reporting)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    b = buf if isinstance(buf, np.ndarray) else np.frombuffer(buf, np.uint8)
+    b = np.ascontiguousarray(b)
+    # worst realistic case is ~one value per page; grow geometrically from a
+    # generous page-size estimate instead of allocating total_values rows
+    cap = max(16, min(int(total_values), len(b) // 64 + 8))
+    while True:
+        out = np.empty((cap, PG_NFIELDS), dtype=np.int64)
+        k = lib.pq_scan_page_headers(b.ctypes.data if len(b) else None,
+                                     len(b), total_values, cap, out)
+        if k == -2:
+            if cap > int(total_values) + 8:
+                return None  # more pages than values: malformed; let Python raise
+            cap *= 4
+            continue
+        if k < 0:
+            return None
+        return out[:k]
 
 
 def scan_rle_runs(buf: np.ndarray, n: int, bit_width: int):

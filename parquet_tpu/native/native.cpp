@@ -815,6 +815,211 @@ int64_t pq_scan_rle_runs(const uint8_t* data, int64_t size, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// Batch page-header scan: walk a column chunk's compact-thrift PageHeader
+// stream in one native call (SURVEY.md §3.1 file walk — the reference's
+// ReadPageHeader loop; per-page Python thrift parsing was the measured
+// dominant cost of the e2e pipeline's host phase).  Only the PageHeader
+// subset the decoder needs is extracted; any malformed construct returns -1
+// and the caller falls back to the Python reader, which owns error wording.
+// ---------------------------------------------------------------------------
+
+}  // extern "C" (the thrift helpers below use templates — C++ linkage)
+
+namespace {
+
+struct TRd {
+  const uint8_t* p;
+  int64_t pos, size;
+  bool err;
+};
+
+inline uint64_t trd_uvarint(TRd& r) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (true) {
+    if (r.pos >= r.size || shift > 63) { r.err = true; return 0; }
+    uint8_t b = r.p[r.pos++];
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) return v;
+    shift += 7;
+  }
+}
+
+inline int64_t trd_zigzag(TRd& r) {
+  uint64_t v = trd_uvarint(r);
+  return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+}
+
+// compact-protocol wire types
+enum { CT_STOP = 0, CT_TRUE = 1, CT_FALSE = 2, CT_I8 = 3, CT_I16 = 4,
+       CT_I32 = 5, CT_I64 = 6, CT_DOUBLE = 7, CT_BINARY = 8, CT_LIST = 9,
+       CT_SET = 10, CT_MAP = 11, CT_STRUCT = 12 };
+
+void trd_skip(TRd& r, int wire, int depth) {
+  if (r.err || depth > 16) { r.err = true; return; }
+  switch (wire) {
+    case CT_TRUE: case CT_FALSE:
+      return;  // value lives in the type nibble
+    case CT_I8:
+      r.pos += 1; if (r.pos > r.size) r.err = true; return;
+    case CT_I16: case CT_I32: case CT_I64:
+      trd_uvarint(r); return;
+    case CT_DOUBLE:
+      r.pos += 8; if (r.pos > r.size) r.err = true; return;
+    case CT_BINARY: {
+      uint64_t n = trd_uvarint(r);
+      if (r.err || n > (uint64_t)(r.size - r.pos)) { r.err = true; return; }
+      r.pos += (int64_t)n; return;
+    }
+    case CT_LIST: case CT_SET: {
+      if (r.pos >= r.size) { r.err = true; return; }
+      uint8_t h = r.p[r.pos++];
+      uint64_t n = h >> 4;
+      int ew = h & 0x0F;
+      if (n == 0xF) n = trd_uvarint(r);
+      if (ew == CT_TRUE || ew == CT_FALSE) {  // bools: one byte per element
+        r.pos += (int64_t)n;
+        if (r.pos > r.size) r.err = true;
+        return;
+      }
+      for (uint64_t i = 0; i < n && !r.err; ++i) trd_skip(r, ew, depth + 1);
+      return;
+    }
+    case CT_MAP: {
+      uint64_t n = trd_uvarint(r);
+      if (r.err) return;
+      if (n == 0) return;
+      if (r.pos >= r.size) { r.err = true; return; }
+      uint8_t kv = r.p[r.pos++];
+      for (uint64_t i = 0; i < n && !r.err; ++i) {
+        trd_skip(r, kv >> 4, depth + 1);
+        trd_skip(r, kv & 0x0F, depth + 1);
+      }
+      return;
+    }
+    case CT_STRUCT: {
+      while (!r.err) {
+        if (r.pos >= r.size) { r.err = true; return; }
+        uint8_t h = r.p[r.pos++];
+        if (h == CT_STOP) return;
+        if (!(h >> 4)) trd_zigzag(r);  // long-form field id
+        trd_skip(r, h & 0x0F, depth + 1);
+      }
+      return;
+    }
+    default:
+      r.err = true;
+      return;
+  }
+}
+
+// Walk one struct, dispatching (field id, wire) to `fn`; unknown fields skip.
+template <typename F>
+inline void trd_struct(TRd& r, F&& fn) {
+  int64_t fid = 0;
+  while (!r.err) {
+    if (r.pos >= r.size) { r.err = true; return; }
+    uint8_t h = r.p[r.pos++];
+    if (h == CT_STOP) return;
+    int delta = h >> 4, wire = h & 0x0F;
+    fid = delta ? fid + delta : trd_zigzag(r);
+    if (!fn(fid, wire)) trd_skip(r, wire, 0);
+  }
+}
+
+}  // namespace
+
+// out columns per page (int64 each) — keep in sync with native/__init__.py
+enum { PG_HEADER_POS = 0, PG_DATA_POS, PG_TYPE, PG_COMP, PG_UNCOMP, PG_CRC,
+       PG_NVALS, PG_ENC, PG_DEF_ENC, PG_REP_ENC, PG_RL_BYTES, PG_DL_BYTES,
+       PG_NNULLS, PG_IS_COMPRESSED, PG_DICT_NVALS, PG_NROWS, PG_NFIELDS };
+
+extern "C" int64_t pq_scan_page_headers(const uint8_t* buf, int64_t size,
+                                        int64_t total_values,
+                                        int64_t max_pages, int64_t* out) {
+  int64_t pos = 0, values_seen = 0, k = 0;
+  while (values_seen < total_values && pos < size) {
+    if (k >= max_pages) return -2;
+    TRd r{buf, pos, size, false};
+    int64_t* row = out + k * PG_NFIELDS;
+    for (int i = 0; i < PG_NFIELDS; ++i) row[i] = -1;
+    row[PG_HEADER_POS] = pos;
+    trd_struct(r, [&](int64_t fid, int wire) -> bool {
+      switch (fid) {
+        case 1: if (wire != CT_I32) return false;
+                row[PG_TYPE] = trd_zigzag(r); return true;
+        case 2: if (wire != CT_I32) return false;
+                row[PG_UNCOMP] = trd_zigzag(r); return true;
+        case 3: if (wire != CT_I32) return false;
+                row[PG_COMP] = trd_zigzag(r); return true;
+        case 4: if (wire != CT_I32) return false;
+                // thrift i32 crc is signed; normalize to the u32 value
+                row[PG_CRC] = (int64_t)(uint32_t)trd_zigzag(r); return true;
+        case 5:  // data_page_header
+          if (wire != CT_STRUCT) return false;
+          trd_struct(r, [&](int64_t f2, int w2) -> bool {
+            if (w2 != CT_I32) return false;
+            switch (f2) {
+              case 1: row[PG_NVALS] = trd_zigzag(r); return true;
+              case 2: row[PG_ENC] = trd_zigzag(r); return true;
+              case 3: row[PG_DEF_ENC] = trd_zigzag(r); return true;
+              case 4: row[PG_REP_ENC] = trd_zigzag(r); return true;
+              default: return false;
+            }
+          });
+          return true;
+        case 7:  // dictionary_page_header
+          if (wire != CT_STRUCT) return false;
+          trd_struct(r, [&](int64_t f2, int w2) -> bool {
+            if (w2 != CT_I32) return false;
+            switch (f2) {
+              case 1: row[PG_DICT_NVALS] = trd_zigzag(r); return true;
+              case 2: row[PG_ENC] = trd_zigzag(r); return true;
+              default: return false;
+            }
+          });
+          return true;
+        case 8:  // data_page_header_v2
+          if (wire != CT_STRUCT) return false;
+          trd_struct(r, [&](int64_t f2, int w2) -> bool {
+            if (w2 == CT_TRUE || w2 == CT_FALSE) {
+              if (f2 == 7) { row[PG_IS_COMPRESSED] = (w2 == CT_TRUE); return true; }
+              return true;  // other bools carry no payload bytes
+            }
+            if (w2 != CT_I32) return false;
+            switch (f2) {
+              case 1: row[PG_NVALS] = trd_zigzag(r); return true;
+              case 2: row[PG_NNULLS] = trd_zigzag(r); return true;
+              case 3: row[PG_NROWS] = trd_zigzag(r); return true;
+              case 4: row[PG_ENC] = trd_zigzag(r); return true;
+              case 5: row[PG_DL_BYTES] = trd_zigzag(r); return true;
+              case 6: row[PG_RL_BYTES] = trd_zigzag(r); return true;
+              default: return false;
+            }
+          });
+          return true;
+        default:
+          return false;  // statistics / index page header / unknown: skip
+      }
+    });
+    if (r.err) return -1;
+    int64_t clen = row[PG_COMP];
+    if (clen < 0 || row[PG_TYPE] < 0 || row[PG_UNCOMP] < 0) return -1;
+    if (clen > size - r.pos) return -1;  // truncated payload (no overflow)
+    row[PG_DATA_POS] = r.pos;
+    if (row[PG_TYPE] == 0 || row[PG_TYPE] == 3) {  // DATA_PAGE / V2
+      if (row[PG_NVALS] < 0) return -1;
+      values_seen += row[PG_NVALS];
+    }
+    pos = r.pos + clen;
+    ++k;
+  }
+  return k;
+}
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
 // xxhash64 (bloom filter hashing; spec-mandated XXH64 seed 0)
 // ---------------------------------------------------------------------------
 static inline uint64_t rotl64(uint64_t x, int r) {

@@ -239,12 +239,62 @@ def _count_target_in_runs(kinds, cnts, payloads, offs, body, width, target) -> i
     return total + int(np.count_nonzero(vals == target))
 
 
+class _ByteAccum:
+    """Byte-stream accumulator holding zero-copy views, concatenated ONCE at
+    staging time (bytearray.extend copies every page body twice; this class
+    keeps the extend()/len() surface build_plan already uses but defers the
+    copy to :meth:`padded_array`, which writes straight into the final
+    bucket-padded staging buffer — one copy total per byte)."""
+
+    __slots__ = ("_parts", "_n")
+
+    def __init__(self):
+        self._parts = []
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def extend(self, b) -> None:
+        if not isinstance(b, np.ndarray):
+            b = np.frombuffer(b, np.uint8)
+        if len(b):
+            self._parts.append(b)
+            self._n += len(b)
+
+    def array(self) -> np.ndarray:
+        """Concatenated uint8 array (one copy; zero-copy for a single part)."""
+        if not self._parts:
+            return np.empty(0, np.uint8)
+        if len(self._parts) == 1:
+            return self._parts[0]
+        return np.concatenate(self._parts)
+
+    def padded_array(self, extra: int = 12) -> np.ndarray:
+        """Like ``dev.pad_to_bucket(self.array(), extra)`` without the
+        intermediate concatenation: parts copy directly into the padded
+        staging buffer."""
+        n = self._n + extra
+        bucket = 1 << max(int(n - 1).bit_length(), 6)
+        if len(self._parts) == 1 and bucket == self._n:
+            return self._parts[0]
+        out = np.zeros(bucket, dtype=np.uint8)
+        pos = 0
+        for p in self._parts:
+            out[pos : pos + len(p)] = p
+            pos += len(p)
+        return out
+
+    def tobytes(self) -> bytes:
+        return self.array().tobytes()
+
+
 @dataclass
 class _Plan:
     """Host-built staging plan for one chunk."""
 
-    levels: bytearray = field(default_factory=bytearray)
-    values: bytearray = field(default_factory=bytearray)
+    levels: _ByteAccum = field(default_factory=_ByteAccum)
+    values: _ByteAccum = field(default_factory=_ByteAccum)
     def_runs: _RunTable = field(default_factory=_RunTable)
     rep_runs: _RunTable = field(default_factory=_RunTable)
     host_def: List[np.ndarray] = field(default_factory=list)
@@ -256,7 +306,7 @@ class _Plan:
     # dense single-width dict-index stream (Pallas/jnp gather-free route):
     # bit-packed run payloads compacted into one LSB-first w-bit stream,
     # page-aligned to 32-value groups; (start_value, n_values) per page
-    dense: bytearray = field(default_factory=bytearray)
+    dense: _ByteAccum = field(default_factory=_ByteAccum)
     dense_w: Optional[int] = None
     dense_pages: List[Tuple[int, int]] = field(default_factory=list)
     dense_ok: bool = True
@@ -324,7 +374,7 @@ def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
                 (length,) = _struct.unpack_from("<I", raw, pos)
                 body = raw[pos + 4 : pos + 4 + length]
                 plan.rep_runs.add(body, n, _bit_width(max_rep), len(plan.levels))
-                plan.levels.extend(body.tobytes())
+                plan.levels.extend(body)
                 pos += 4 + length
             if max_def > 0:
                 enc = Encoding(dph.definition_level_encoding)
@@ -333,7 +383,7 @@ def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
                     (length,) = _struct.unpack_from("<I", raw, pos)
                     body = raw[pos + 4 : pos + 4 + length]
                     scanned = plan.def_runs.add(body, n, w, len(plan.levels))
-                    plan.levels.extend(body.tobytes())
+                    plan.levels.extend(body)
                     pos += 4 + length
                     n_present = _count_target_in_runs(*scanned, body, w, max_def)
                 else:  # legacy BIT_PACKED levels: host decode
@@ -452,7 +502,7 @@ def _add_dense_page(plan: _Plan, body: np.ndarray, kinds, cnts, offs,
     pad = -len(plan.dense) % group_bytes
     plan.dense.extend(b"\0" * pad)
     start_val = len(plan.dense) * 8 // width
-    bview = body.tobytes()
+    bview = np.asarray(body)
     for cnt, off in zip(np.asarray(cnts, np.int64), np.asarray(offs, np.int64)):
         ngroups = (int(cnt) + 7) // 8
         plan.dense.extend(bview[int(off): int(off) + ngroups * width])
@@ -474,7 +524,7 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
         width = int(raw[pos]) if pos < len(raw) else 0
         body = raw[pos + 1 :]
         base = len(plan.values)
-        plan.values.extend(body.tobytes())
+        plan.values.extend(body)
         if width == 0:  # single-entry dictionary
             plan.vruns.add_scanned(np.zeros(1, np.uint8), np.array([nvals]),
                                    np.zeros(1, np.int64), np.zeros(1, np.int64),
@@ -488,19 +538,19 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
         if physical == Type.BOOLEAN:
             plan.set_kind("bool")
             base = len(plan.values)
-            plan.values.extend(raw[pos:].tobytes())
+            plan.values.extend(raw[pos:])
             plan.vruns.add_bitpacked_span(nvals, 1, base)
             return
         if physical in _FIXED_WIDTH:
             plan.set_kind("plain_fixed")
             w = _FIXED_WIDTH[physical]
-            plan.values.extend(raw[pos : pos + nvals * w].tobytes())
+            plan.values.extend(raw[pos : pos + nvals * w])
             plan.plain_total += nvals
             return
         if physical == Type.FIXED_LEN_BYTE_ARRAY:
             plan.set_kind("plain_flba")
             w = leaf.type_length
-            plan.values.extend(raw[pos : pos + nvals * w].tobytes())
+            plan.values.extend(raw[pos : pos + nvals * w])
             plan.plain_total += nvals
             return
         plan.set_kind("host_ba")  # PLAIN BYTE_ARRAY: host offsets scan
@@ -510,7 +560,7 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
     if encoding == Encoding.DELTA_BINARY_PACKED:
         plan.set_kind("delta")
         base = len(plan.values)
-        plan.values.extend(raw[pos:].tobytes())
+        plan.values.extend(raw[pos:])
         first, total, vpm, offs, widths, mins, _ = dev.delta_prescan(raw, pos)
         plan.d_firsts.append(first)
         plan.d_counts.append(total)
@@ -526,7 +576,7 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
         if not w:  # e.g. BYTE_ARRAY: no fixed width, no BSS plane layout
             raise _Unsupported("byte-stream-split without a fixed width")
         base = len(plan.values)
-        plan.values.extend(raw[pos : pos + nvals * w].tobytes())
+        plan.values.extend(raw[pos : pos + nvals * w])
         plan.bss_pages.append((base, nvals))
         return
     if encoding == Encoding.RLE and physical == Type.BOOLEAN:
@@ -534,7 +584,7 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
         (length,) = _struct.unpack_from("<I", raw, pos)
         body = raw[pos + 4 : pos + 4 + length]
         base = len(plan.values)
-        plan.values.extend(body.tobytes())
+        plan.values.extend(body)
         plan.vruns.add(body, nvals, 1, base)
         return
     if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
@@ -605,7 +655,7 @@ def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
     n_mb = len(widths_all)
     if n_mb == 0 or len(uw) > 8 or int(uw[-1]) > 32:
         return False
-    vals_np = np.frombuffer(plan.values, np.uint8)
+    vals_np = plan.values.array()
     boffs = np.concatenate(plan.d_mb_offs) // 8
     streams, groups = [], []
     for w in uw:
@@ -783,8 +833,7 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         raise _Unsupported("chunk stream exceeds 32-bit-lane bit addressing")
     lev_dbuf = None
     if stage_levels and len(plan.levels):
-        lev_dbuf = jax.device_put(dev.pad_to_bucket(
-            np.frombuffer(plan.levels, np.uint8)))
+        lev_dbuf = jax.device_put(plan.levels.padded_array())
         counters.inc("bytes_h2d", len(plan.levels))
     dense_route = (plan.value_kind == "dict" and plan.dense_ok
                    and plan.dense_pages and _dense_mode() != "off")
@@ -795,13 +844,11 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
             None, "host_ba"):
         # staged even when empty (all-null chunks have no value bytes): the
         # kernels need a real buffer operand to slice [:0] from
-        val_dbuf = jax.device_put(dev.pad_to_bucket(
-            np.frombuffer(plan.values, np.uint8)))
+        val_dbuf = jax.device_put(plan.values.padded_array())
         counters.inc("bytes_h2d", len(plan.values))
     if dense_route:
         # compacted single-width index stream replaces the raw bodies
-        meta["dense"] = jax.device_put(dev.pad_to_bucket(
-            np.frombuffer(plan.dense, np.uint8), extra=4))
+        meta["dense"] = jax.device_put(plan.dense.padded_array(extra=4))
         counters.inc("bytes_h2d", len(plan.dense))
     if plan.value_kind == "delta":
         if not delta_dense:
@@ -874,6 +921,108 @@ def prepare_chunk(reader: ColumnChunkReader, device=None):
     return plan, staged
 
 
+def _concat_batch_columns(leaf, cols: List[Column]) -> Column:
+    """Concatenate per-page-batch Columns of ONE flat chunk (device decode).
+
+    Only shapes `decode_chunk_batched` admits reach here: max_rep == 0,
+    max_def <= 1.  Arrays concatenate in whatever domain the decode produced
+    (jnp for device arrays, numpy for host byte-array parts); the concat is
+    itself an async device op, so it overlaps later batches' staging."""
+    if len(cols) == 1:
+        return cols[0]
+    xp = jnp if isinstance(cols[0].values if cols[0].values is not None
+                           else cols[0].dict_indices, jax.Array) else np
+    num_slots = sum(c.num_slots for c in cols)
+    validity = None
+    if any(c.validity is not None for c in cols):
+        parts = [c.validity if c.validity is not None
+                 else xp.ones(c.num_slots, bool) for c in cols]
+        validity = xp.concatenate(parts)
+    if cols[0].is_dictionary_encoded():
+        idx = xp.concatenate([c.dict_indices for c in cols])
+        return Column(leaf=leaf, values=None, dictionary=cols[0].dictionary,
+                      dictionary_host=cols[0].dictionary_host,
+                      dict_indices=idx, validity=validity,
+                      num_slots=num_slots)
+    offsets = None
+    if cols[0].offsets is not None:
+        offs_parts = []
+        base = 0
+        for c in cols:
+            o = c.offsets
+            offs_parts.append((o[:-1] + base) if base else o[:-1])
+            base += int(o[-1])
+        xo = jnp if isinstance(cols[0].offsets, jax.Array) else np
+        offsets = xo.concatenate(
+            offs_parts + [xo.asarray([base], dtype=cols[0].offsets.dtype)])
+    values = xp.concatenate([c.values for c in cols])
+    return Column(leaf=leaf, values=values, offsets=offsets,
+                  validity=validity, num_slots=num_slots)
+
+
+def decode_chunk_batched(reader: ColumnChunkReader,
+                         keep_dictionary: bool = True, workers: int = 4,
+                         min_batches: int = 2, target_batches: int = 6
+                         ) -> Column:
+    """Intra-chunk pipelined decode: page batches plan on worker threads
+    while the main thread stages and (asynchronously) dispatches each
+    batch's decode — so host prescan, H2D staging, and device kernels of a
+    SINGLE large chunk overlap instead of running as one serial chain
+    (the measured e2e floor; SURVEY.md §7 hard part 5 applied within a
+    chunk, not just across chunks).
+
+    Flat columns only (max_rep == 0, max_def <= 1 — configs 1-3 shapes);
+    anything else, too few pages, or per-batch kind divergence (e.g. a
+    dict→plain fallback mid-chunk) raises _Unsupported and the caller uses
+    the single-plan path."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    leaf = reader.leaf
+    if leaf.max_repetition_level > 0 or leaf.max_definition_level > 1:
+        raise _Unsupported("batched decode: flat columns only")
+    pages = list(reader.pages())
+    dict_pages = [p for p in pages if p.page_type == PageType.DICTIONARY_PAGE]
+    data_pages = [p for p in pages
+                  if p.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2)]
+    per = max(8, -(-len(data_pages) // target_batches))
+    batches = [data_pages[i : i + per] for i in range(0, len(data_pages), per)]
+    if len(batches) < min_batches:
+        raise _Unsupported("batched decode: chunk too small to pipeline")
+    physical = Type(reader.meta.type)
+
+    def plan_batch(i: int, subset) -> _Plan:
+        return build_plan(reader,
+                          pages=iter(dict_pages + subset if i == 0 else subset))
+
+    cols: List[Column] = []
+    shared_dict_host = None
+    shared_dict_staged = None
+    kind0 = None
+    with ThreadPoolExecutor(max_workers=max(workers, 1)) as pool:
+        futs = [pool.submit(plan_batch, i, b) for i, b in enumerate(batches)]
+        for i, fut in enumerate(futs):
+            plan = fut.result()
+            futs[i] = None  # release: bounds live plan memory to in-flight
+            if i == 0:
+                kind0 = plan.value_kind
+                shared_dict_host = plan.dictionary_host
+            else:
+                if plan.value_kind != kind0:
+                    raise _Unsupported("batched decode: kind diverges across "
+                                       "pages (mid-chunk encoding fallback)")
+                plan.dictionary_host = None  # staged once, injected below
+            stage_levels = stage_levels_on_device(leaf, plan)
+            staged = stage_plan(plan, stage_levels=stage_levels)
+            if i == 0:
+                shared_dict_staged = (staged[2] or {}).get("dictionary")
+            elif shared_dict_host is not None:
+                plan.dictionary_host = shared_dict_host
+                staged[2]["dictionary"] = shared_dict_staged
+            cols.append(decode_staged(leaf, physical, plan, staged,
+                                      keep_dictionary=keep_dictionary))
+    return _concat_batch_columns(leaf, cols)
+
+
 def decode_chunks_pipelined(chunks, keep_dictionary: bool = True,
                             workers: int = 2):
     """Double-buffered read: stage chunk N+1 while chunk N's kernels run.
@@ -889,6 +1038,21 @@ def decode_chunks_pipelined(chunks, keep_dictionary: bool = True,
     from concurrent.futures import ThreadPoolExecutor
 
     chunks = list(chunks)
+    if len(chunks) == 1:
+        # nothing to overlap ACROSS chunks: pipeline WITHIN the chunk
+        # (page batches) instead — the single-large-chunk e2e shape
+        try:
+            col = decode_chunk_batched(chunks[0],
+                                       keep_dictionary=keep_dictionary)
+            counters.inc("chunks_device_decoded")
+            yield col
+            return
+        except _Unsupported:
+            pass
+        except Exception:
+            counters.inc("chunk_batched_fallback")
+            # any decode error falls through to the single-plan path, which
+            # owns error semantics (incl. host fallback)
     active = {"n": 0}
     lock = threading.Lock()
 
@@ -991,7 +1155,7 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             device_asm = dev.assemble_single_list(
                 d_dev, r_dev, infos[0].def_level, max_def)
         else:
-            lev_host = np.frombuffer(plan.levels, np.uint8)
+            lev_host = plan.levels.array()
             if (len(infos) == 1 and plan.def_runs.total and plan.rep_runs.total
                     and plan.def_runs.total == plan.rep_runs.total
                     and not plan.host_def):
@@ -1023,7 +1187,7 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             # validity from it (round 1 expanded on device AND host)
             if plan.def_runs.total:
                 def_host = plan.def_runs.expand_host(
-                    np.frombuffer(plan.levels, np.uint8))
+                    plan.levels.array())
             else:
                 def_host = np.concatenate(plan.host_def).astype(np.int32)
             validity = jax.device_put(def_host == max_def)

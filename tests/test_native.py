@@ -314,3 +314,63 @@ def test_encode_plain_ba_rejects_malformed_offsets(lib):
     for bad in ([0, 10, 5, 6], [0, 3, 99], [1, 2, 6]):
         with pytest.raises(ValueError):
             native.encode_plain_ba(data, np.array(bad, np.int64))
+
+
+def test_scan_page_headers_parity(lib, rng):
+    """Native batch header scan == the Python thrift walk, field by field."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+
+    n = 50_000
+    t = pa.table({"x": pa.array(rng.integers(0, 1 << 40, n))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression="snappy", data_page_size=16 * 1024)
+    ch = ParquetFile(buf.getvalue()).row_group(0).column(0)
+    start, size = ch.byte_range
+    raw = ch.file.source.pread(start, size)
+    desc = native.scan_page_headers(raw, ch.meta.num_values)
+    assert desc is not None
+    # python walk over the same bytes (bypass the fast path via raw=bytes +
+    # a monkeyless trick: call the fallback by feeding scan output through
+    # PageInfo comparison instead)
+    pages_fast = list(ch.pages())
+    import parquet_tpu.io.reader as rmod
+    from parquet_tpu.format import metadata as md, thrift
+
+    pos = 0
+    fields_py = []
+    while pos < size and len(fields_py) < len(pages_fast):
+        header, data_pos = thrift.deserialize(md.PageHeader, raw, pos)
+        clen = header.compressed_page_size
+        fields_py.append((pos, data_pos, header))
+        pos = data_pos + clen
+    assert len(pages_fast) == len(fields_py)
+    for page, (hpos, dpos, h) in zip(pages_fast, fields_py):
+        assert page.header.type == h.type
+        assert page.header.compressed_page_size == h.compressed_page_size
+        assert page.header.uncompressed_page_size == h.uncompressed_page_size
+        dph, dph2 = h.data_page_header, page.header.data_page_header
+        if dph is not None:
+            assert dph2.num_values == dph.num_values
+            assert dph2.encoding == dph.encoding
+            assert dph2.definition_level_encoding == dph.definition_level_encoding
+        assert bytes(page.payload) == raw[dpos : dpos + h.compressed_page_size]
+
+
+def test_scan_page_headers_huge_size_no_crash(lib):
+    """A compressed_page_size near INT64_MAX must return None (fallback),
+    not wrap the bounds check and segfault (review r4 finding)."""
+    from parquet_tpu.format import metadata as md, thrift
+
+    h = md.PageHeader(type=0, uncompressed_page_size=100,
+                      compressed_page_size=(1 << 62),
+                      data_page_header=md.DataPageHeader(
+                          num_values=10, encoding=0,
+                          definition_level_encoding=3,
+                          repetition_level_encoding=3))
+    raw = thrift.serialize(h) + b"\0" * 64
+    assert native.scan_page_headers(raw, 10) is None
