@@ -125,7 +125,12 @@ class ColumnChunkReader:
         below is the fallback and owns error reporting."""
         start, size = self.byte_range
         if raw is None:
-            raw = self.file.source.pread_view(start, size)
+            # without the native scanner, pread_view's numpy buffer would
+            # just be re-copied to bytes for the Python walk — read bytes
+            # directly in that case
+            raw = (self.file.source.pread_view(start, size)
+                   if _native.get_lib() is not None
+                   else self.file.source.pread(start, size))
         fast = _native.scan_page_headers(raw, self.meta.num_values)
         if fast is not None:
             yield from self._pages_from_scan(raw, start, fast)
@@ -182,8 +187,12 @@ class ColumnChunkReader:
                     num_nulls=row[PG_NNULLS] if row[PG_NNULLS] >= 0 else None,
                     num_rows=row[PG_NROWS] if row[PG_NROWS] >= 0 else None,
                     encoding=row[PG_ENC],
-                    definition_levels_byte_length=row[PG_DL_BYTES],
-                    repetition_levels_byte_length=row[PG_RL_BYTES],
+                    # -1 = field absent: map to None so consumers' `or 0`
+                    # lenience matches the Python walk exactly
+                    definition_levels_byte_length=(
+                        row[PG_DL_BYTES] if row[PG_DL_BYTES] >= 0 else None),
+                    repetition_levels_byte_length=(
+                        row[PG_RL_BYTES] if row[PG_RL_BYTES] >= 0 else None),
                     is_compressed=(None if row[PG_IS_COMPRESSED] < 0
                                    else bool(row[PG_IS_COMPRESSED])))
             elif pt == PageType.DICTIONARY_PAGE:

@@ -36,7 +36,7 @@ def _build() -> bool:
     try:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-             _SRC, "-o", _SO + ".tmp"],
+             "-pthread", _SRC, "-o", _SO + ".tmp"],
             check=True, capture_output=True, timeout=120)
         os.replace(_SO + ".tmp", _SO)
         return True
@@ -111,6 +111,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_scan_rle_runs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
             _u8p_w, _i64p, _i64p, _i64p]
+        lib.pq_expand_gather.restype = ctypes.c_int64
+        lib.pq_expand_gather.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_void_p, _i64p,
+            _i64p, _i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
         lib.pq_scan_page_headers.restype = ctypes.c_int64
         lib.pq_scan_page_headers.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -420,6 +425,40 @@ def expand_runs(buf: np.ndarray, ends: np.ndarray, kinds: np.ndarray,
         np.ascontiguousarray(bit_offsets, np.int64),
         np.ascontiguousarray(widths, np.int32), len(kinds), out, n)
     return out[:wrote]
+
+
+def expand_gather(buf: np.ndarray, tables: tuple, n: int,
+                  dictionary: np.ndarray, nthreads: int = 0):
+    """Fused RLE/bit-packed index expand + dictionary gather: run tables →
+    gathered values in one multithreaded native pass (no index stream).
+    ``tables`` = (ends, kinds, payloads, bit_offsets, widths) in the int64
+    host domain.  Returns the gathered array or None (unavailable shape →
+    caller uses expand + numpy gather)."""
+    lib = get_lib()
+    if lib is None or n == 0:
+        return None
+    elem = dictionary.dtype.itemsize
+    if elem not in (4, 8) or dictionary.ndim != 1:
+        return None
+    ends, kinds, payloads, offs, widths32 = tables
+    buf = np.ascontiguousarray(buf)
+    dvals = np.ascontiguousarray(dictionary)
+    out = np.empty(n, dtype=dictionary.dtype)
+    if not nthreads:
+        nthreads = min(os.cpu_count() or 1, 8)
+    rc = lib.pq_expand_gather(
+        buf.ctypes.data if len(buf) else None, len(buf),
+        np.ascontiguousarray(ends, np.int64),
+        np.ascontiguousarray(kinds, np.uint8).ctypes.data,
+        np.ascontiguousarray(payloads, np.int64),
+        np.ascontiguousarray(offs, np.int64),
+        np.ascontiguousarray(widths32, np.int32), len(ends), n,
+        dvals.ctypes.data, len(dvals), elem,
+        out.ctypes.data, nthreads)
+    if rc != 0:
+        raise ValueError("malformed dictionary run stream "
+                         "(index out of range or bad width)")
+    return out
 
 
 # column indexes of a pq_scan_page_headers row — keep in sync with the

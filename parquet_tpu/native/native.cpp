@@ -11,9 +11,11 @@
 // Build: parquet_tpu/native/build.py → _native.so (g++ -O3).  Pure C ABI —
 // no pybind11 (not in this image); numpy arrays cross as raw pointers.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -814,6 +816,117 @@ int64_t pq_scan_rle_runs(const uint8_t* data, int64_t size, int64_t n,
   return k;
 }
 
+}  // extern "C" (the helpers below use templates — C++ linkage)
+
+// ---------------------------------------------------------------------------
+// Fused RLE/bit-packed expand + dictionary gather (multithreaded).
+// The host route for mixed-run dictionary chunks (BASELINE config 2): one
+// pass from the run table straight to gathered values — no materialized
+// index stream, output-partitioned across threads at run boundaries.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+template <int ELEM>
+bool expand_gather_span(const uint8_t* buf, int64_t buf_len,
+                        const int64_t* ends, const uint8_t* kinds,
+                        const int64_t* payloads, const int64_t* bit_offsets,
+                        const int32_t* widths, int64_t nruns,
+                        const uint8_t* dict, int64_t dict_n,
+                        int64_t lo, int64_t hi, uint8_t* out) {
+  // first run containing value index `lo` (ends are cumulative counts)
+  int64_t r = std::upper_bound(ends, ends + nruns, lo) - ends;
+  int64_t v = lo;
+  while (v < hi && r < nruns) {
+    const int64_t run_start = r ? ends[r - 1] : 0;
+    const int64_t run_end = ends[r] < hi ? ends[r] : hi;
+    if (kinds[r] == 0) {  // RLE: one dictionary value fills the span
+      const int64_t idx = payloads[r];
+      if (idx < 0 || idx >= dict_n) return false;
+      const uint8_t* src = dict + idx * ELEM;
+      for (int64_t j = v; j < run_end; ++j)
+        std::memcpy(out + j * ELEM, src, ELEM);
+    } else {  // bit-packed span: unpack the index inline, gather
+      const int32_t w = widths[r];
+      if (w < 0 || w > 32) return false;
+      const uint64_t mask = (w >= 32) ? 0xFFFFFFFFull : ((1ull << w) - 1);
+      int64_t bit = bit_offsets[r] + (v - run_start) * (int64_t)w;
+      if (w <= 28) {
+        const int kper = w ? 57 / w : 1;
+        // every representable index is in range when the width's mask is
+        // below the dictionary size — hoist the per-value bounds check
+        const bool safe = (int64_t)mask < dict_n;
+        int64_t j = v;
+        while (j < run_end) {
+          uint64_t word = load8_clamped(buf, buf_len, bit >> 3) >> (bit & 7);
+          int m = (int)((run_end - j < kper) ? (run_end - j) : kper);
+          if (safe) {
+            for (int t = 0; t < m; ++t)
+              std::memcpy(out + (j + t) * ELEM,
+                          dict + ((word >> (t * w)) & mask) * ELEM, ELEM);
+          } else {
+            for (int t = 0; t < m; ++t) {
+              const int64_t idx = (int64_t)((word >> (t * w)) & mask);
+              if (idx >= dict_n) return false;
+              std::memcpy(out + (j + t) * ELEM, dict + idx * ELEM, ELEM);
+            }
+          }
+          j += m;
+          bit += (int64_t)m * w;
+        }
+      } else {
+        for (int64_t j = v; j < run_end; ++j) {
+          uint64_t word = load8_clamped(buf, buf_len, bit >> 3);
+          const int64_t idx = (int64_t)((word >> (bit & 7)) & mask);
+          if (idx >= dict_n) return false;
+          std::memcpy(out + j * ELEM, dict + idx * ELEM, ELEM);
+          bit += w;
+        }
+      }
+    }
+    v = run_end;
+    if (v >= ends[r]) ++r;
+  }
+  return v >= hi;
+}
+
+}  // namespace
+
+extern "C" int64_t pq_expand_gather(
+    const uint8_t* buf, int64_t buf_len, const int64_t* ends,
+    const uint8_t* kinds, const int64_t* payloads, const int64_t* bit_offsets,
+    const int32_t* widths, int64_t nruns, int64_t n, const uint8_t* dict,
+    int64_t dict_n, int32_t elem, uint8_t* out, int32_t nthreads) {
+  if (n <= 0) return 0;
+  if (elem != 4 && elem != 8) return -1;
+  auto span = [&](int64_t lo, int64_t hi) -> bool {
+    return elem == 4
+               ? expand_gather_span<4>(buf, buf_len, ends, kinds, payloads,
+                                       bit_offsets, widths, nruns, dict,
+                                       dict_n, lo, hi, out)
+               : expand_gather_span<8>(buf, buf_len, ends, kinds, payloads,
+                                       bit_offsets, widths, nruns, dict,
+                                       dict_n, lo, hi, out);
+  };
+  int T = nthreads;
+  if (T < 1) T = 1;
+  if (T > 16) T = 16;
+  if ((int64_t)T > n / 65536) T = (int)(n / 65536) ? (int)(n / 65536) : 1;
+  if (T == 1) return span(0, n) ? 0 : -1;
+  std::vector<std::thread> threads;
+  std::vector<char> ok((size_t)T, 1);
+  const int64_t per = (n + T - 1) / T;
+  for (int t = 1; t < T; ++t) {
+    const int64_t lo = per * t, hi = std::min(n, per * (t + 1));
+    threads.emplace_back([&, t, lo, hi] { ok[(size_t)t] = span(lo, hi); });
+  }
+  ok[0] = span(0, std::min(per, n));
+  for (auto& th : threads) th.join();
+  for (int t = 0; t < T; ++t)
+    if (!ok[(size_t)t]) return -1;
+  return 0;
+}
+
 // ---------------------------------------------------------------------------
 // Batch page-header scan: walk a column chunk's compact-thrift PageHeader
 // stream in one native call (SURVEY.md §3.1 file walk — the reference's
@@ -822,8 +935,6 @@ int64_t pq_scan_rle_runs(const uint8_t* data, int64_t size, int64_t n,
 // subset the decoder needs is extracted; any malformed construct returns -1
 // and the caller falls back to the Python reader, which owns error wording.
 // ---------------------------------------------------------------------------
-
-}  // extern "C" (the thrift helpers below use templates — C++ linkage)
 
 namespace {
 
@@ -877,9 +988,11 @@ void trd_skip(TRd& r, int wire, int depth) {
       uint64_t n = h >> 4;
       int ew = h & 0x0F;
       if (n == 0xF) n = trd_uvarint(r);
+      // any element consumes >= 1 byte, so a count beyond the remaining
+      // buffer is malformed — guards the unsigned->signed cast too
+      if (n > (uint64_t)(r.size - r.pos)) { r.err = true; return; }
       if (ew == CT_TRUE || ew == CT_FALSE) {  // bools: one byte per element
         r.pos += (int64_t)n;
-        if (r.pos > r.size) r.err = true;
         return;
       }
       for (uint64_t i = 0; i < n && !r.err; ++i) trd_skip(r, ew, depth + 1);
@@ -889,6 +1002,8 @@ void trd_skip(TRd& r, int wire, int depth) {
       uint64_t n = trd_uvarint(r);
       if (r.err) return;
       if (n == 0) return;
+      // each pair consumes >= 1 byte: bound the loop against the buffer
+      if (n > (uint64_t)(r.size - r.pos)) { r.err = true; return; }
       if (r.pos >= r.size) { r.err = true; return; }
       uint8_t kv = r.p[r.pos++];
       for (uint64_t i = 0; i < n && !r.err; ++i) {

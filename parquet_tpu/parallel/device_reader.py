@@ -443,6 +443,19 @@ def _dense_mode() -> str:
     return "auto"
 
 
+def _dict_run_route() -> str:
+    """Where mixed-run dictionary index streams decode: 'device' (the
+    rle_expand kernel) or 'host' (C++ run expand + native gather).  Auto:
+    device on a real TPU, host elsewhere — the emulated device route on CPU
+    is the measured pathological case (BASELINE config 2)."""
+    import os
+
+    v = os.environ.get("PARQUET_TPU_DICT_RUNS", "").lower()
+    if v in ("host", "device"):
+        return v
+    return "device" if jax.default_backend() == "tpu" else "host"
+
+
 _pallas_broken = False  # set when a Pallas compile fails; jnp from then on
 
 
@@ -837,11 +850,20 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         counters.inc("bytes_h2d", len(plan.levels))
     dense_route = (plan.value_kind == "dict" and plan.dense_ok
                    and plan.dense_pages and _dense_mode() != "off")
+    # mixed-run dict chunks decoding on the host route need no value-byte
+    # H2D at all (the C++ expand reads the host accum directly)
+    dict_host = (plan.value_kind == "dict" and not dense_route
+                 and _dict_run_route() == "host")
     meta = {}
+    if dict_host:
+        # record the route WITH the staged buffers: decode must not
+        # re-derive it from mutable env/backend state and disagree with
+        # what was (not) staged here
+        meta["dict_host"] = True
     delta_dense = plan.value_kind == "delta" and _stage_delta_dense(plan, meta)
     val_dbuf = None
-    if not dense_route and not delta_dense and plan.value_kind not in (
-            None, "host_ba"):
+    if not dense_route and not delta_dense and not dict_host and \
+            plan.value_kind not in (None, "host_ba"):
         # staged even when empty (all-null chunks have no value bytes): the
         # kernels need a real buffer operand to slice [:0] from
         val_dbuf = jax.device_put(plan.values.padded_array())
@@ -861,7 +883,7 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         # dictionary pages stage with the chunk, not inside the decode phase
         meta["dictionary"] = _stage_dictionary(plan.dictionary_host,
                                                plan.physical, plan.leaf)
-    if plan.vruns.total:
+    if plan.vruns.total and not dict_host:
         meta["vruns"] = jax.device_put(plan.vruns.run_arrays())
     if stage_levels and plan.def_runs.total:
         meta["def_runs"] = jax.device_put(plan.def_runs.run_arrays())
@@ -1230,6 +1252,37 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
         if staged_meta.get("dense") is not None:
             dict_indices, values = _decode_dense_dict(plan, staged_meta["dense"],
                                                       dictionary, physical)
+        elif staged_meta.get("dict_host"):
+            # Mixed RLE/bit-packed index runs on a NON-TPU backend: the
+            # run expand + gather is gather-shaped work the host C++ does
+            # ~8x faster than the XLA CPU emulation of the device kernels
+            # (BASELINE config 2 was 0.12 GB/s on the emulated route).
+            # The TPU keeps the device kernels; routing is per-backend,
+            # overridable via PARQUET_TPU_DICT_RUNS.
+            counters.inc("dict_host_route")
+            vals_host = plan.values.array()
+            dict_indices = None
+            values = None
+            if physical != Type.BYTE_ARRAY and isinstance(
+                    plan.dictionary_host, np.ndarray):
+                # fused one-pass expand+gather (no index stream); indices
+                # stay None — every consumer gates on is_dictionary_encoded
+                values = native.expand_gather(
+                    vals_host, plan.vruns.tables_host(), plan.vruns.total,
+                    plan.dictionary_host)
+            if values is None:
+                idx_host = plan.vruns.expand_host(vals_host)
+                dict_indices = idx_host.astype(np.int32, copy=False)
+                if physical != Type.BYTE_ARRAY:
+                    gathered = ref.gather_dictionary(
+                        plan.dictionary_host, idx_host)
+                    values = (gathered[0] if isinstance(gathered, tuple)
+                              else gathered)
+            if values is not None and physical in _IS_PAIR:
+                # keep the device-path representation invariant (64-bit
+                # values as (n,2) uint32 pairs) — zero-copy view
+                values = np.ascontiguousarray(values).view(
+                    np.uint32).reshape(-1, 2)
         else:
             dict_indices = plan.vruns.expand(val_dbuf,
                                              tables=staged_meta.get("vruns"))

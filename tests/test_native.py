@@ -374,3 +374,48 @@ def test_scan_page_headers_huge_size_no_crash(lib):
                           repetition_level_encoding=3))
     raw = thrift.serialize(h) + b"\0" * 64
     assert native.scan_page_headers(raw, 10) is None
+
+
+def test_expand_gather_fused_parity(lib, rng):
+    """Fused expand+gather == expand_host + numpy gather, mixed run kinds,
+    all thread counts."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.parallel import device_reader as dr
+
+    n = 300_000
+    # long repeats (RLE runs) + random spans (bit-packed runs)
+    v = rng.integers(0, 500, n)
+    v[: n // 3] = 7
+    v[n // 2 : n // 2 + n // 4] = 411
+    t = pa.table({"k": pa.array(v.astype(np.int64))})
+    b = io.BytesIO()
+    pq.write_table(t, b, compression="none", use_dictionary=True,
+                   row_group_size=1 << 30)
+    ch = ParquetFile(b.getvalue()).row_group(0).column(0)
+    plan = dr.build_plan(ch)
+    buf = plan.values.array()
+    idx = plan.vruns.expand_host(buf)
+    want = plan.dictionary_host[idx]
+    for nt in (1, 3, 8):
+        got = native.expand_gather(buf, plan.vruns.tables_host(),
+                                   plan.vruns.total, plan.dictionary_host,
+                                   nthreads=nt)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_expand_gather_rejects_oob_index(lib):
+    """An RLE run pointing past the dictionary must raise, not read OOB."""
+    ends = np.array([10], np.int64)
+    kinds = np.array([0], np.uint8)
+    payloads = np.array([99], np.int64)  # dict has 4 entries
+    offs = np.array([0], np.int64)
+    widths = np.array([7], np.int32)
+    d = np.arange(4, dtype=np.int64)
+    with pytest.raises(ValueError):
+        native.expand_gather(np.zeros(16, np.uint8),
+                             (ends, kinds, payloads, offs, widths), 10, d)
