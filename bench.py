@@ -184,7 +184,23 @@ def _salted_plan(plan, salt: int):
 
     p = copy.copy(plan)
     s = np.uint8(salt & 0xFF)
-    if len(getattr(plan, "values", ())):
+    if getattr(plan, "value_kind", None) == "dict":
+        # dictionary chunks: salt the DICTIONARY, not the index stream —
+        # XOR-salted index bytes can exceed the dictionary range, which the
+        # bounds-checked host route correctly rejects (and clamped device
+        # gathers would hide).  A distinct dictionary per dispatch defeats
+        # content-keyed caching just as well, on every route.
+        dh = plan.dictionary_host
+        if dh is not None:
+            if isinstance(dh, tuple):  # BYTE_ARRAY: (values, offsets)
+                vals = np.frombuffer(
+                    np.ascontiguousarray(dh[0]).tobytes(), np.uint8) ^ s
+                p.dictionary_host = (vals, dh[1])
+            else:
+                arr = np.ascontiguousarray(dh)
+                p.dictionary_host = (np.frombuffer(
+                    arr.tobytes(), np.uint8) ^ s).view(arr.dtype)
+    elif len(getattr(plan, "values", ())):
         p.values = _salted(plan.values, s)
     if len(getattr(plan, "dense", ())):
         p.dense = _salted(plan.dense, s)
@@ -488,14 +504,17 @@ def _cfg6(n):
     }
 
 
-def _lineitem_path(n):
+def _lineitem_path(n, row_group_size=4_000_000):
     """Generate (once, cached on disk) a TPC-H lineitem-schema parquet file:
     16 columns, snappy, multi-row-group — the BASELINE.md north-star shape.
     Cached under $TMPDIR keyed by row count; ~2.2 GB on disk at the default
     40M rows (decoded arrow ~4.8 GB — size $TMPDIR accordingly or lower
-    BENCH_LINEITEM_ROWS)."""
+    BENCH_LINEITEM_ROWS).  ``row_group_size`` feeds the multichip artifact
+    (scripts/multichip_scale.py needs ≥ one row group per device)."""
+    suffix = ("" if row_group_size == 4_000_000
+              else f"_rg{row_group_size}")
     cache = os.path.join(os.environ.get("TMPDIR", "/tmp"),
-                         f"parquet_tpu_lineitem_{n}.parquet")
+                         f"parquet_tpu_lineitem_v2_{n}{suffix}.parquet")
     if os.path.exists(cache) and os.path.getsize(cache) > 0:
         return cache
     rng = np.random.default_rng(42)
@@ -531,8 +550,14 @@ def _lineitem_path(n):
         "l_comment": comment_arr,
     })
     tmp = cache + ".tmp"
-    pq.write_table(t, tmp, compression="snappy", row_group_size=4_000_000,
-                   data_page_size=1 << 20, write_page_index=True)
+    # dictionary-encode only the low-cardinality categoricals (how real
+    # lineitem files are written); high-cardinality keys/prices as plain —
+    # at large row groups their dictionaries would overflow and fall back
+    # mid-chunk anyway
+    pq.write_table(t, tmp, compression="snappy", row_group_size=row_group_size,
+                   data_page_size=1 << 20, write_page_index=True,
+                   use_dictionary=["l_returnflag", "l_linestatus",
+                                   "l_shipinstruct", "l_shipmode"])
     os.replace(tmp, cache)
     return cache
 

@@ -217,14 +217,34 @@ class ParquetWriter:
             if data is None:
                 raise KeyError(f"missing column {leaf.dotted_path!r}")
             datas.append(data)
-        # encode is pure per column and offset-free; emit is serial since
-        # page offsets depend on file position.  Encode also runs serially —
-        # the phase is many small numpy calls whose GIL'd dispatch dominates,
-        # so a thread pool measured ~15% SLOWER (2M-row mixed table) — and
-        # interleaves with emit so only ONE chunk's compressed pages are ever
-        # buffered.  The split keeps the door open for a native encoder.
-        for leaf, data in zip(leaves, datas):
-            enc = self._encode_chunk(leaf, data, num_rows)
+        # encode is pure per column and offset-free (codecs are thread-safe:
+        # snappy is stateless, zstd contexts are thread-local); emit is
+        # serial since page offsets depend on file position.  On a
+        # multi-core host the encode phase fans out across columns — the
+        # native encoders and compressors release the GIL — at the cost of
+        # buffering the row group's compressed pages until emit.  On one
+        # core a pool measured ~15% SLOWER (GIL'd numpy dispatch), so the
+        # serial one-chunk-buffered interleave is kept there.
+        import os as _os
+
+        ncpu = _os.cpu_count() or 1
+        work_bytes = sum(getattr(np.asarray(d.values), "nbytes", 0)
+                         for d in datas)
+        # small row groups stay serial even on multi-core: pool setup plus
+        # GIL'd numpy dispatch beats the parallelism below ~8 MB of input
+        if ncpu > 1 and len(leaves) > 1 and work_bytes >= (8 << 20):
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(len(leaves), ncpu, 8)) as pool:
+                encs = list(pool.map(
+                    lambda pair: self._encode_chunk(pair[0], pair[1],
+                                                    num_rows),
+                    zip(leaves, datas)))
+        else:
+            encs = (self._encode_chunk(leaf, data, num_rows)
+                    for leaf, data in zip(leaves, datas))
+        for enc in encs:
             chunk, ci, oi, bloom, ubytes, cbytes = self._emit_chunk(enc)
             chunks.append(chunk)
             cis.append(ci)
@@ -276,7 +296,18 @@ class ParquetWriter:
             value_encoding = Encoding.PLAIN
 
         # ---- statistics / bloom ------------------------------------------
-        stats = _compute_statistics(leaf, data, n_slots, nvalues) if opts.write_statistics else None
+        stats = None
+        if opts.write_statistics:
+            if indices is not None and nvalues:
+                # every dictionary entry is referenced by construction:
+                # chunk min/max == dictionary min/max (O(dict), not O(rows))
+                mn, mx = _min_max_from_dict(leaf, dict_values, dict_offsets,
+                                            None, 0)
+                stats = md.Statistics(null_count=n_slots - nvalues,
+                                      min_value=mn, max_value=mx,
+                                      min=mn, max=mx)
+            else:
+                stats = _compute_statistics(leaf, data, n_slots, nvalues)
         bloom_blob = None
         if path in opts.bloom_filters:
             from .bloom import build_split_block_filter
@@ -322,7 +353,7 @@ class ParquetWriter:
                                          value_cursor)
             body, n_slot_page, n_val_page, pstat = self._encode_page(
                 leaf, data, def_levels, rep_levels, s0, s1, v0, v1,
-                value_encoding, indices, dict_values, dict_n)
+                value_encoding, indices, dict_values, dict_n, dict_offsets)
             comp_body, hdr = self._page_header(leaf, body, n_slot_page,
                                                n_val_page, value_encoding,
                                                def_levels, rep_levels, s0, s1,
@@ -463,7 +494,8 @@ class ParquetWriter:
         return int(np.count_nonzero(rep_levels[s0:s1] == 0))
 
     def _encode_page(self, leaf, data, def_levels, rep_levels, s0, s1, v0, v1,
-                     value_encoding, indices, dict_values, dict_n=0):
+                     value_encoding, indices, dict_values, dict_n=0,
+                     dict_offsets=None):
         """Encode one page → body (+counts, stats).  v1: bytes; v2: 3-tuple."""
         opts = self.options
         physical = leaf.physical_type
@@ -488,8 +520,22 @@ class ParquetWriter:
             values = ref.encode_rle_dict_indices(idx, width)
         else:
             values = _encode_values(leaf, data, v0, v1, value_encoding)
-        pstat = self._page_statistics(leaf, data, def_levels, s0, s1, v0, v1) \
-            if opts.write_statistics else None
+        pstat = None
+        if opts.write_statistics:
+            if indices is not None:
+                # dictionary-encoded page: min/max over the page's REFERENCED
+                # dictionary entries, not its materialized values — the stats
+                # pass drops from O(page values) to O(dict) (measured as the
+                # single largest cost of writing a categorical column)
+                mn, mx = _min_max_from_dict(
+                    leaf, dict_values, dict_offsets,
+                    indices[v0:v1], dict_n)
+                pstat = md.Statistics(
+                    null_count=(s1 - s0) - (v1 - v0),
+                    min_value=mn, max_value=mx, min=mn, max=mx)
+            else:
+                pstat = self._page_statistics(leaf, data, def_levels,
+                                              s0, s1, v0, v1)
         if opts.data_page_version == 2:
             return (rep_bytes, def_bytes, values), n_slot_page, n_val_page, pstat
         return rep_bytes + def_bytes + values, n_slot_page, n_val_page, pstat
@@ -834,6 +880,36 @@ def _compute_statistics(leaf, data: ColumnData, n_slots, nvalues):
     mn, mx = _min_max(leaf, data, 0, nvalues)
     return md.Statistics(null_count=n_slots - nvalues, min_value=mn,
                          max_value=mx, min=mn, max=mx)
+
+
+def _min_max_from_dict(leaf: Leaf, dict_values, dict_offsets, idx_span,
+                       dict_n: int):
+    """Encoded (min, max) for a dictionary-encoded span: select the
+    referenced dictionary entries (bincount over the index span; the whole
+    dictionary when ``idx_span`` is None) and min/max over THOSE — O(dict)
+    instead of O(values)."""
+    from ..algebra import compare
+
+    if idx_span is None:
+        sel_vals, sel_offs = dict_values, dict_offsets
+        count = (len(dict_offsets) - 1 if dict_offsets is not None
+                 else len(dict_values))
+    else:
+        if len(idx_span) == 0:
+            return None, None
+        ids = np.flatnonzero(np.bincount(idx_span, minlength=max(dict_n, 1)))
+        if dict_offsets is not None:
+            sel_vals, sel_offs = ref.gather_dictionary(
+                (dict_values, dict_offsets), ids.astype(np.int64))
+        else:
+            sel_vals, sel_offs = np.asarray(dict_values)[ids], None
+        count = len(ids)
+    mn, mx = compare.min_max(
+        leaf, ColumnData(values=sel_vals, offsets=sel_offs), 0, count)
+    if mn is None:
+        return None, None
+    return (compare.encode_order_value(mn, leaf),
+            compare.encode_order_value(mx, leaf))
 
 
 def _min_max(leaf: Leaf, data: ColumnData, v0: int, v1: int):

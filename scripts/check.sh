@@ -10,4 +10,28 @@ echo "=== pass 2: PARQUET_TPU_NO_NATIVE=1 (numpy oracles) ==="
 PARQUET_TPU_NO_NATIVE=1 python -m pytest tests/ -q
 echo "=== multi-chip dryrun (8-device CPU mesh) ==="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
+BENCH_QUICK=1 python bench.py 2>&1 | python -c "
+import json, sys
+# headline is stdout, the per-config detail JSON is stderr; stream merge
+# order is arbitrary, so select by content
+docs = []
+for l in sys.stdin.read().splitlines():
+    if l.strip().startswith('{'):
+        try:
+            docs.append(json.loads(l))
+        except ValueError:
+            pass
+d = next(x for x in docs if 'metric' in x)
+assert {'metric', 'value', 'unit', 'vs_baseline', 'configs'} <= d.keys(), d.keys()
+assert isinstance(d['value'], (int, float)) and d['value'] > 0, d['value']
+assert len(d['configs']) >= 7, sorted(d['configs'])
+detail = next((x for x in docs if 'detail' in x), {})
+for name, cfg in detail.get('configs', {}).items():
+    assert 'exceeds_physics' not in cfg, (name, 'impossible rate reported')
+    if name.startswith(('1_', '2_', '3_', '4_')):
+        assert 'e2e_GBps' in cfg, (name, 'e2e missing')
+        assert cfg.get('distinct_inputs'), (name, 'cache honesty lost')
+print('bench smoke ok:', d['metric'], d['value'], d['unit'])
+"
 echo "ALL CHECKS PASSED"

@@ -1,11 +1,19 @@
-"""Multichip evidence at size: sharded read + sharded pushdown scan of a
-lineitem-class file on a real device mesh, verified against the host oracle.
+"""Multichip evidence at size: sharded read + sharded pushdown scan of the
+REAL lineitem shape (bench._lineitem_path: 16 columns, strings, dictionary
+encodings, snappy, UNSORTED predicate column) on a device mesh, verified
+against the host oracle and timed against single-device comparators.
 
-Replaces the 2,048-slot toy as the multichip artifact (VERDICT r2 item 8):
-the file is ≥100 MB on disk, multi-row-group, and the run reports per-shard
-row counts and phase timings.  On this environment the mesh is the virtual
-8-device CPU mesh (tests' conftest topology); on hardware the same script
-runs unmodified on real chips.
+VERDICT r3 tasks 5+8: the artifact records `single_device_read_s` vs
+`sharded_read_s` and `host_scan_s` vs `sharded_scan_s`, with per-shard rows
+and per-shard assemble timings, plus `cpu_count` — on a 1-core host the
+virtual 8-device mesh cannot beat one device on compute (all devices share
+the core); the artifact exists to prove the distribution is correct and its
+overhead bounded, and runs unmodified on real multi-chip hardware where the
+same numbers become a genuine scaling measurement (MULTICHIP_REAL_TPU=1).
+
+The scan predicate ranges over l_shipdate, which this generator does NOT
+sort, so page/row-group pruning cannot trivialize the scan: every row group
+survives pruning and real decode work distributes across the mesh.
 
 Usage:  python scripts/multichip_scale.py [rows] [out.json]
 """
@@ -21,7 +29,8 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if os.environ.get("MULTICHIP_REAL_TPU") != "1":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax
 
@@ -29,103 +38,156 @@ if os.environ.get("MULTICHIP_REAL_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
-import pyarrow as pa
-import pyarrow.parquet as pq
 
 
-def make_file(path: str, n: int) -> None:
-    rng = np.random.default_rng(3)
-    ship = np.sort(rng.integers(8000, 12000, n).astype(np.int32))
-    t = pa.table({
-        "l_shipdate": pa.array(ship),
-        "l_orderkey": pa.array(np.arange(n, dtype=np.int64)),
-        "l_partkey": pa.array(rng.integers(1, 200_000, n).astype(np.int64)),
-        "l_suppkey": pa.array(rng.integers(1, 10_000, n).astype(np.int64)),
-        "l_quantity": pa.array(rng.integers(1, 51, n).astype(np.int64)),
-        "l_extendedprice": pa.array(rng.random(n) * 1e5),
-        "l_discount": pa.array(np.round(rng.random(n) * 0.1, 2)),
-        "l_tax": pa.array(np.round(rng.random(n) * 0.08, 2)),
-    })
-    pq.write_table(t, path, compression="snappy", row_group_size=n // 16,
-                   data_page_size=1 << 20, write_page_index=True,
-                   use_dictionary=False)
+# fixed-width lineitem columns (read_table_sharded's contract); the scan
+# below additionally exercises a dictionary-encoded string output column
+READ_COLS = ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
+             "l_extendedprice", "l_discount", "l_tax", "l_shipdate"]
+_PAIR_DTYPES = {"l_orderkey": np.int64, "l_partkey": np.int64,
+                "l_suppkey": np.int64, "l_quantity": np.int64,
+                "l_extendedprice": np.float64, "l_discount": np.float64,
+                "l_tax": np.float64}
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 3_000_000
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
     out_path = sys.argv[2] if len(sys.argv) > 2 else "MULTICHIP_SCALE.json"
-    path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
-                        f"parquet_tpu_mcs_{n}.parquet")
-    if not os.path.exists(path):
-        make_file(path, n)
+    import bench
+
+    # ≥ 2 row groups per mesh device so round-robin has real work everywhere
+    path = bench._lineitem_path(n, row_group_size=max(n // 16, 1))
     file_mb = os.path.getsize(path) / 1e6
 
     from parquet_tpu import ParquetFile, scan_filtered
     from parquet_tpu.ops.device import pairs_to_host
-    from parquet_tpu.parallel.host_scan import scan_filtered_sharded
+    from parquet_tpu.parallel.host_scan import (scan_filtered_device,
+                                                scan_filtered_sharded)
     from parquet_tpu.parallel.mesh import default_mesh, read_table_sharded
 
     mesh = default_mesh()
-    n_dev = len(list(mesh.devices.flat))
+    devs = list(mesh.devices.flat)
+    n_dev = len(devs)
     pf = ParquetFile(path)
-    cols = ["l_orderkey", "l_quantity", "l_extendedprice"]
 
-    # --- sharded whole-table read vs host oracle --------------------------
+    # --- sharded whole-table read ----------------------------------------
+    # warm: jax compiles one executable PER device sharding, so the first
+    # sharded pass pays n_dev compiles — steady state is what the artifact
+    # measures (on real chips the executable cache persists across runs)
+    jax.block_until_ready(list(read_table_sharded(
+        pf, mesh=mesh, columns=READ_COLS).arrays.values()))
     t0 = time.perf_counter()
-    st = read_table_sharded(pf, mesh=mesh, columns=cols)
+    st = read_table_sharded(pf, mesh=mesh, columns=READ_COLS)
     jax.block_until_ready(list(st.arrays.values()))
     sharded_read_s = time.perf_counter() - t0
 
-    host = pf.read(columns=cols)
+    # single-device comparator: the same code path on a 1-device mesh
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.array(devs[:1]), ("data",))
+    jax.block_until_ready(list(read_table_sharded(
+        pf, mesh=mesh1, columns=READ_COLS).arrays.values()))
+    t0 = time.perf_counter()
+    st1 = read_table_sharded(pf, mesh=mesh1, columns=READ_COLS)
+    jax.block_until_ready(list(st1.arrays.values()))
+    single_device_read_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    host = pf.read(columns=READ_COLS)
+    host_read_s = time.perf_counter() - t0
+
+    # correctness: sharded round-robin order vs host oracle
     ok_read = True
     mask = np.asarray(st.row_mask())
-    for c in cols:
+    rg_rows = [pf.row_group(i).num_rows for i in range(len(pf.row_groups))]
+    starts = np.concatenate([[0], np.cumsum(rg_rows)])
+    order = [rg for d in range(n_dev)
+             for rg in range(len(rg_rows)) if rg % n_dev == d]
+    for c in READ_COLS:
         got = np.asarray(st.arrays[c])
         if got.ndim == 2 and got.shape[-1] == 2:
-            dt = (np.float64 if c == "l_extendedprice" else np.int64)
-            got = np.ascontiguousarray(got).view(dt).reshape(-1)
+            got = np.ascontiguousarray(got).view(_PAIR_DTYPES[c]).reshape(-1)
         got = got[mask]
-        # shards are row-group round-robin: reorder the oracle the same way
-        rg_rows = [pf.row_group(i).num_rows
-                   for i in range(len(pf.row_groups))]
-        starts = np.concatenate([[0], np.cumsum(rg_rows)])
-        order = [rg for d in range(n_dev)
-                 for rg in range(len(rg_rows)) if rg % n_dev == d]
-        exp = np.concatenate([np.asarray(host[c].values)
-                              [starts[rg]:starts[rg + 1]] for rg in order])
+        hv = np.asarray(host[c].values)
+        exp = np.concatenate([hv[starts[rg]:starts[rg + 1]] for rg in order])
         if not np.array_equal(got, exp):
             ok_read = False
 
-    # --- sharded pushdown scan vs host oracle -----------------------------
-    lo, hi = 9000, 9150
+    # --- sharded pushdown scan (UNSORTED key: pruning can't trivialize) ---
+    lo, hi = 9000, 9400  # ~10% selectivity over the uniform 8000-12000 range
+    scan_cols = ["l_extendedprice", "l_shipmode"]
+
+    t0 = time.perf_counter()
+    oracle = scan_filtered(pf, "l_shipdate", lo=lo, hi=hi, columns=scan_cols)
+    host_scan_s = time.perf_counter() - t0
+
+    scan_filtered_device(pf, "l_shipdate", lo=lo, hi=hi, columns=scan_cols)
+    t0 = time.perf_counter()
+    single = scan_filtered_device(pf, "l_shipdate", lo=lo, hi=hi,
+                                  columns=scan_cols)
+    single_device_scan_s = time.perf_counter() - t0
+
+    scan_filtered_sharded(pf, "l_shipdate", lo=lo, hi=hi,
+                          columns=scan_cols, mesh=mesh)
     t0 = time.perf_counter()
     sh = scan_filtered_sharded(pf, "l_shipdate", lo=lo, hi=hi,
-                               columns=["l_extendedprice"], mesh=mesh)
+                               columns=scan_cols, mesh=mesh)
     sharded_scan_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    oracle = scan_filtered(pf, "l_shipdate", lo=lo, hi=hi,
-                           columns=["l_extendedprice"])
-    host_scan_s = time.perf_counter() - t0
-    dev_vals = np.sort(np.concatenate(
-        [pairs_to_host(part, np.float64) for part in sh["l_extendedprice"]]))
+
+    def _price(part):
+        if isinstance(part, tuple):  # (form, validity)
+            part = part[0]
+        return pairs_to_host(part, np.float64)
+
+    want_price = np.sort(np.asarray(oracle["l_extendedprice"]))
+    dev_price = np.sort(np.concatenate(
+        [_price(p) for p in sh["l_extendedprice"]]))
+
+    def _strings(part):
+        """Materialize one shard's dictionary-encoded string output."""
+        if isinstance(part, tuple) and len(part) == 2 and not isinstance(
+                part[0], tuple):
+            part = part[0]  # drop validity wrapper
+        dic, idx = part
+        dvals, doffs = (np.asarray(dic[0]), np.asarray(dic[1]))
+        idx = np.asarray(idx).astype(np.int64)
+        lens = doffs[1:] - doffs[:-1]
+        return [dvals[doffs[i]:doffs[i] + lens[i]].tobytes().decode()
+                for i in idx]
+
+    got_modes = sorted(s for p in sh["l_shipmode"] for s in _strings(p))
+    want_modes = sorted(s.decode() if isinstance(s, bytes) else str(s)
+                        for s in oracle["l_shipmode"])
     ok_scan = (sh["#rows"] == len(oracle["l_extendedprice"])
-               and np.allclose(dev_vals,
-                               np.sort(np.asarray(oracle["l_extendedprice"]))))
+               and np.allclose(dev_price, want_price)
+               and got_modes == want_modes)
 
     art = {
         "ok": bool(ok_read and ok_scan),
         "rows": n,
         "file_MB": round(file_mb, 1),
         "devices": n_dev,
+        "cpu_count": os.cpu_count(),
         "backend": jax.devices()[0].platform,
         "row_groups": len(pf.row_groups),
-        "sharded_read_s": round(sharded_read_s, 3),
-        "per_shard_rows": list(map(int, st.row_counts)),
-        "sharded_scan_s": round(sharded_scan_s, 3),
-        "host_scan_s": round(host_scan_s, 3),
-        "scan_rows_selected": int(sh["#rows"]),
-        "read_equal": bool(ok_read),
-        "scan_equal": bool(ok_scan),
+        "read": {
+            "sharded_s": round(sharded_read_s, 3),
+            "single_device_s": round(single_device_read_s, 3),
+            "host_s": round(host_read_s, 3),
+            "speedup_vs_single": round(single_device_read_s
+                                       / sharded_read_s, 2),
+            "per_shard_rows": list(map(int, st.row_counts)),
+            "equal": bool(ok_read),
+        },
+        "scan": {
+            "selectivity": round(sh["#rows"] / n, 4),
+            "sharded_s": round(sharded_scan_s, 3),
+            "single_device_s": round(single_device_scan_s, 3),
+            "host_s": round(host_scan_s, 3),
+            "sharded_over_host": round(sharded_scan_s / host_scan_s, 1),
+            "rows_selected": int(sh["#rows"]),
+            "equal": bool(ok_scan),
+        },
     }
     with open(out_path, "w") as f:
         json.dump(art, f, indent=1)
