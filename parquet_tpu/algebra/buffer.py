@@ -97,7 +97,10 @@ class TableBuffer:
 
 
 def permute_column(cd: ColumnData, perm: np.ndarray, leaf) -> ColumnData:
-    """Row-permute one leaf column (flat or single-level list)."""
+    """Row-permute one leaf column (flat, single-level list, or raw-level
+    Dremel form for arbitrary nesting depth)."""
+    if cd.def_levels is not None or cd.rep_levels is not None:
+        return _permute_raw_levels(cd, perm, leaf)
     if cd.list_offsets is not None:
         lo = np.asarray(cd.list_offsets, np.int64)
         lens = lo[1:] - lo[:-1]
@@ -114,6 +117,55 @@ def permute_column(cd: ColumnData, perm: np.ndarray, leaf) -> ColumnData:
         pv.list_validity = None if cd.list_validity is None else cd.list_validity[perm]
         return pv
     return _permute_flat(cd, perm, leaf)
+
+
+def _permute_raw_levels(cd: ColumnData, perm: np.ndarray, leaf) -> ColumnData:
+    """Row-permute a raw-level (Dremel) ColumnData of ANY nesting depth.
+
+    Rows are the spans between rep_level==0 slots (each record starts at
+    rep 0); values are dense present leaf values (def == max_def).  All
+    steps are whole-column vector ops: span gather for the level streams,
+    cumsum value indexing for the dense values — the streaming merge's
+    depth>1 window operations reduce to exactly this (merge.go —
+    mergedRowGroup over nested chunks)."""
+    de = np.asarray(cd.def_levels if cd.def_levels is not None
+                    else np.full(_rows_of_raw(cd), leaf.max_definition_level,
+                                 np.int32), np.int32)
+    rep = (np.asarray(cd.rep_levels, np.int32)
+           if cd.rep_levels is not None else None)
+    if rep is not None:
+        row_starts = np.flatnonzero(rep == 0)
+        row_ends = np.append(row_starts[1:], len(rep))
+    else:  # struct chain without repetition: one slot per row
+        row_starts = np.arange(len(de), dtype=np.int64)
+        row_ends = row_starts + 1
+    lens = row_ends - row_starts
+    new_lens = lens[perm]
+    slot_idx = _gather_ranges(row_starts[perm], new_lens)
+    new_def = de[slot_idx]
+    new_rep = rep[slot_idx] if rep is not None else None
+    present = de == leaf.max_definition_level
+    val_of_slot = np.cumsum(present) - 1
+    sel = slot_idx[present[slot_idx]]
+    vidx = val_of_slot[sel]
+    vals = np.asarray(cd.values)
+    if cd.offsets is not None:
+        offs = np.asarray(cd.offsets, np.int64)
+        blens = offs[1:] - offs[:-1]
+        new_blens = blens[vidx]
+        new_offs = np.zeros(len(vidx) + 1, np.int64)
+        np.cumsum(new_blens, out=new_offs[1:])
+        bidx = _gather_ranges(offs[:-1][vidx], new_blens)
+        return ColumnData(values=vals[bidx] if len(bidx) else vals[:0],
+                          offsets=new_offs, def_levels=new_def,
+                          rep_levels=new_rep)
+    return ColumnData(values=vals[vidx] if len(vidx) else vals[:0],
+                      def_levels=new_def, rep_levels=new_rep)
+
+
+def _rows_of_raw(cd: ColumnData) -> int:
+    return len(cd.rep_levels) if cd.rep_levels is not None else len(
+        np.asarray(cd.values))
 
 
 def _permute_flat(cd: ColumnData, perm: np.ndarray, leaf) -> ColumnData:

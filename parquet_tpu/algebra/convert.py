@@ -163,9 +163,21 @@ def null_fill_column(leaf: Leaf, n: int) -> ColumnData:
         empty = np.empty(0, dtype=leaf.np_dtype() or np.uint8)
     if leaf.max_repetition_level:
         if leaf.max_repetition_level > 1:
-            raise NotImplementedError(
-                f"cannot null-fill multi-level nested column "
-                f"{leaf.dotted_path!r}")
+            from ..format.enums import FieldRepetitionType as _Rep
+
+            anc = leaf.ancestors
+            if (leaf.max_definition_level == 0 or not anc
+                    or anc[0].repetition == _Rep.REQUIRED):
+                # def 0 would claim a REQUIRED outer field is absent —
+                # there is no valid all-null encoding for such a column
+                raise NotImplementedError(
+                    f"cannot null-fill required nested column "
+                    f"{leaf.dotted_path!r}")
+            # raw-level form: every row is null at the outermost level
+            # (def 0, one rep-0 slot per row, no values)
+            return ColumnData(values=empty, offsets=offsets,
+                              def_levels=np.zeros(n, np.int32),
+                              rep_levels=np.zeros(n, np.int32))
         return ColumnData(values=empty, offsets=offsets,
                           list_offsets=np.zeros(n + 1, np.int64),
                           list_validity=np.zeros(n, dtype=bool))

@@ -94,11 +94,9 @@ class _RunCursor:
                         f"cannot merge {p!r}: source is nested depth "
                         f"{src_leaf.max_repetition_level}, target depth "
                         f"{leaf.max_repetition_level}")
+                # depth > 1 nested columns arrive in raw-level (Dremel)
+                # form; the window ops (extend/permute) handle it natively
                 cd = column_to_data(t.columns[p], src_leaf, leaf)
-                if cd.def_levels is not None:
-                    raise NotImplementedError(
-                        f"streaming merge does not support multi-level nested "
-                        f"column {p!r} (depth > 1); use merge_row_groups")
             else:
                 if structural_conflict(self.pf.schema, leaf):
                     raise TypeError(

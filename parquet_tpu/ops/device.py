@@ -438,6 +438,69 @@ def _asl_finish(d, r, n_rows: int, n_elem: int, dk: int, max_def: int):
     return offsets, list_validity, leaf_validity
 
 
+def assemble_nested(def_levels: jax.Array, rep_levels: jax.Array,
+                    infos, max_def: int):
+    """Device twin of ``ops/levels.assemble`` for ANY repetition depth
+    (SURVEY.md §7 hard part 4, beyond the single-list case): per repeated
+    level k — instances, element counts, offsets, list validity — all as
+    whole-column vector ops over the expanded level streams, mirroring the
+    host assembler's exact semantics (instances of level k: ``rep < k`` and
+    ``def >= d_{k-1}``; elements: ``rep < k_next`` and ``def >= d_k``; a
+    list is non-null iff its start slot has ``def >= d_k - 1``).
+
+    ``infos`` is ``levels_ops.repeated_ancestors(leaf)``.  Returns
+    ``(list_offsets, list_validity, leaf_validity)`` where the first two are
+    LISTS with one device array per repeated level (outermost first) — the
+    multi-level Column layout.  Shapes are data-dependent, so ONE count
+    dispatch + D2H sync fixes every level's size; the finish pass is a
+    single fused dispatch."""
+    reps = tuple(int(i.rep_level) for i in infos)
+    defs = tuple(int(i.def_level) for i in infos)
+    counts = _an_counts(def_levels, rep_levels, reps, defs)
+    sizes = tuple(int(x) for x in np.asarray(counts))
+    return _an_finish(def_levels, rep_levels, sizes, reps, defs, max_def)
+
+
+@partial(jax.jit, static_argnames=("reps", "defs"))
+def _an_counts(d: jax.Array, r: jax.Array, reps, defs):
+    outs = []
+    if not d.shape[0]:
+        return jnp.zeros(len(reps) + 1, jnp.int32)
+    for i, k in enumerate(reps):
+        inst = (r < k) if i == 0 else ((r < k) & (d >= defs[i - 1]))
+        outs.append(jnp.sum(inst.astype(jnp.int32)))
+    outs.append(jnp.sum((d >= defs[-1]).astype(jnp.int32)))
+    return jnp.stack(outs)
+
+
+@partial(jax.jit, static_argnames=("sizes", "reps", "defs", "max_def"))
+def _an_finish(d, r, sizes, reps, defs, max_def: int):
+    offsets = []
+    validities = []
+    nlev = len(reps)
+    empty = not d.shape[0]
+    for i, (k, dk) in enumerate(zip(reps, defs)):
+        inst = (r < k) if i == 0 else ((r < k) & (d >= defs[i - 1]))
+        inst_idx = jnp.nonzero(inst, size=sizes[i],
+                               fill_value=0)[0].astype(jnp.int32)
+        if i + 1 < nlev:
+            elem = (r < reps[i + 1]) & (d >= dk)
+        else:
+            elem = d >= dk
+        cum = jnp.cumsum(elem.astype(jnp.int32))
+        starts = (jnp.where(inst_idx > 0, cum[jnp.maximum(inst_idx - 1, 0)], 0)
+                  if not empty else jnp.zeros(0, jnp.int32))
+        total = cum[-1:] if not empty else jnp.zeros(1, jnp.int32)
+        offsets.append(jnp.concatenate([starts, total]))
+        validities.append(d[inst_idx] >= (dk - 1) if not empty
+                          else jnp.zeros(0, bool))
+    elem_idx = jnp.nonzero(d >= defs[-1], size=sizes[-1],
+                           fill_value=0)[0].astype(jnp.int32)
+    leaf_validity = ((d == max_def)[elem_idx] if not empty
+                     else jnp.zeros(0, bool))
+    return offsets, validities, leaf_validity
+
+
 def pad_to_bucket(arr: np.ndarray, extra: int = 12) -> np.ndarray:
     """Pad a host buffer to a power-of-two bucket (+slack for 12-byte gathers)
     so jit specializations are reused across similarly-sized pages."""

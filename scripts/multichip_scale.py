@@ -144,9 +144,13 @@ def main():
         [_price(p) for p in sh["l_extendedprice"]]))
 
     def _strings(part):
-        """Materialize one shard's dictionary-encoded string output."""
-        if isinstance(part, tuple) and len(part) == 2 and not isinstance(
-                part[0], tuple):
+        """Materialize one shard's dictionary-encoded string output.
+
+        Forms (decoded_scan): ``(dictionary, indices)`` or, when nullable,
+        ``((dictionary, indices), validity)`` — dictionary itself is a
+        ``(values, offsets)`` pair, so the validity wrapper is present
+        exactly when part[0][0] is itself a tuple."""
+        if isinstance(part[0], tuple) and isinstance(part[0][0], tuple):
             part = part[0]  # drop validity wrapper
         dic, idx = part
         dvals, doffs = (np.asarray(dic[0]), np.asarray(dic[1]))

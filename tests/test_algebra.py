@@ -427,3 +427,71 @@ def test_merge_unsorted_input_raises(rng):
     with pytest.raises(ValueError, match="not sorted"):
         merge_files([buf.getvalue()], [SortingColumn("k")], io.BytesIO(),
                     batch_rows=256)
+
+
+def _doubly_nested_table(rng, n):
+    """rows of List[List[int64]] (depth 2) + a flat sort key."""
+    k = rng.integers(0, 10**9, n)
+    outer = []
+    for i in range(n):
+        m = int(rng.integers(0, 4))
+        if rng.random() < 0.07:
+            outer.append(None)
+        else:
+            outer.append([None if rng.random() < 0.1 else
+                          [int(v) for v in rng.integers(0, 1000,
+                                                        int(rng.integers(0, 3)))]
+                          for _ in range(m)])
+    t = pa.table({"k": pa.array(k),
+                  "vv": pa.array(outer, pa.list_(pa.list_(pa.int64())))})
+    return t, k
+
+
+def test_streaming_merge_depth2(rng):
+    """Depth-2 nested columns stream-merge correctly: chunks carry raw
+    Dremel level streams through the window ops (VERDICT r3 task 9)."""
+    from parquet_tpu.algebra.merge import merge_files
+
+    files = []
+    rows = []
+    for i in range(3):
+        t, k = _doubly_nested_table(rng, 700)
+        t = t.sort_by("k")
+        b = io.BytesIO()
+        write_table(t, b)
+        files.append(b.getvalue())
+        rows.extend(zip(t.column("k").to_pylist(),
+                        t.column("vv").to_pylist()))
+    out = io.BytesIO()
+    merge_files(files, [SortingColumn("k")], out, batch_rows=256)
+    got = pq.read_table(io.BytesIO(out.getvalue()))
+    want = sorted(rows, key=lambda r: r[0])
+    assert got.column("k").to_pylist() == [r[0] for r in want]
+    assert got.column("vv").to_pylist() == [r[1] for r in want]
+
+
+def test_sorting_writer_close_memory_depth2(rng):
+    """The bounded-memory guarantee holds for doubly-nested rows too
+    (VERDICT r3 task 9 'done =' bar)."""
+    import tracemalloc
+
+    t_schema = pa.schema([("k", pa.int64()),
+                          ("vv", pa.list_(pa.list_(pa.int64())))])
+    schema = schema_from_arrow(t_schema)
+    buffer_rows = 8_000
+    out = io.BytesIO()
+    w = SortingWriter(out, schema, [SortingColumn("k")],
+                      buffer_rows=buffer_rows)
+    all_k = []
+    for _ in range(10):
+        t, k = _doubly_nested_table(rng, buffer_rows)
+        all_k.append(k)
+        w.write_arrow(t)
+    tracemalloc.start()
+    w.close()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    got = pq.read_table(io.BytesIO(out.getvalue()))
+    np.testing.assert_array_equal(np.asarray(got["k"]),
+                                  np.sort(np.concatenate(all_k)))
+    assert peak < 60e6, f"close() peak {peak/1e6:.1f} MB — not bounded"

@@ -159,3 +159,112 @@ def test_scatter_valid(rng):
     expect = np.zeros(1000, dtype=np.int32)
     expect[validity] = vals
     np.testing.assert_array_equal(out, expect)
+
+
+class TestAssembleNested:
+    """dev.assemble_nested == host levels_ops.assemble, any depth."""
+
+    def _compare(self, t, col_name):
+        import io
+
+        import pyarrow.parquet as pq
+
+        from parquet_tpu.io.reader import ParquetFile
+        from parquet_tpu.ops import device as dev, levels as levels_ops
+
+        b = io.BytesIO()
+        pq.write_table(t, b, compression="none", use_dictionary=False)
+        pf = ParquetFile(b.getvalue())
+        col = pf.read().columns[next(
+            p for p in pf.read().columns if p.startswith(col_name))]
+        leaf = col.leaf
+        d = np.asarray(col.def_levels)
+        r = np.asarray(col.rep_levels)
+        infos = levels_ops.repeated_ancestors(leaf)
+        want = levels_ops.assemble(d, r, leaf)
+        import jax.numpy as jnp
+
+        got_offs, got_val, got_leaf = dev.assemble_nested(
+            jnp.asarray(d), jnp.asarray(r), infos, leaf.max_definition_level)
+        assert len(got_offs) == len(want.list_offsets)
+        for go, wo in zip(got_offs, want.list_offsets):
+            np.testing.assert_array_equal(np.asarray(go),
+                                          np.asarray(wo).astype(np.int32))
+        for gv, wv in zip(got_val, want.list_validity):
+            if wv is None:
+                assert bool(np.asarray(gv).all())
+            else:
+                np.testing.assert_array_equal(np.asarray(gv), np.asarray(wv))
+        if want.validity is None:
+            assert got_leaf is None or bool(np.asarray(got_leaf).all())
+        else:
+            np.testing.assert_array_equal(np.asarray(got_leaf),
+                                          np.asarray(want.validity))
+
+    def test_config4_shape(self, rng):
+        import pyarrow as pa
+
+        n = 4000
+        lens = rng.integers(0, 8, n)
+        lens[rng.random(n) < 0.05] = 0
+        offs = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offs[1:])
+        total = int(offs[-1])
+        base = 1_700_000_000 + np.cumsum(rng.integers(0, 1000, max(total, 1)))
+        arr = pa.ListArray.from_arrays(pa.array(offs),
+                                       pa.array(base[:total].astype(np.int64)))
+        self._compare(pa.table({"ts": arr}), "ts")
+
+    def test_depth2_nullable(self, rng):
+        import pyarrow as pa
+
+        n = 2500
+        rows = []
+        for _ in range(n):
+            if rng.random() < 0.08:
+                rows.append(None)
+            else:
+                rows.append([None if rng.random() < 0.12 else
+                             [int(v) for v in rng.integers(0, 99,
+                                                           int(rng.integers(0, 3)))]
+                             for _ in range(int(rng.integers(0, 4)))])
+        t = pa.table({"vv": pa.array(rows, pa.list_(pa.list_(pa.int64())))})
+        self._compare(t, "vv")
+
+    def test_device_route_end_to_end_depth2(self, rng, monkeypatch):
+        """Full device decode with PARQUET_TPU_DEVICE_ASM=1 equals the host
+        read for a depth-2 column (VERDICT r3 task 6 'done =' bar)."""
+        import io
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from parquet_tpu.io.reader import ParquetFile
+        from parquet_tpu.parallel import device_reader as dr
+
+        monkeypatch.setenv("PARQUET_TPU_DEVICE_ASM", "1")
+        n = 3000
+        rows = [[list(map(int, rng.integers(0, 50, int(rng.integers(0, 3)))))
+                 for _ in range(int(rng.integers(0, 4)))]
+                if rng.random() > 0.06 else None for _ in range(n)]
+        t = pa.table({"vv": pa.array(rows, pa.list_(pa.list_(pa.int64())))})
+        b = io.BytesIO()
+        pq.write_table(t, b, compression="none", use_dictionary=False)
+        ch = ParquetFile(b.getvalue()).row_group(0).column(0)
+        col = dr.decode_chunk_device(ch, fallback=False)
+        assert len(col.list_offsets) == 2  # device-assembled, both levels
+        import jax
+
+        assert isinstance(col.list_offsets[0], jax.Array)
+        ch2 = ParquetFile(b.getvalue()).row_group(0).column(0)
+        from parquet_tpu.io.reader import decode_chunk_host
+
+        host = decode_chunk_host(ch2)
+        for lv in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(col.list_offsets[lv]).astype(np.int64),
+                np.asarray(host.list_offsets[lv]).astype(np.int64))
+        got_vals = np.asarray(col.values)
+        if got_vals.ndim == 2 and got_vals.shape[-1] == 2:
+            got_vals = np.ascontiguousarray(got_vals).view(np.int64).reshape(-1)
+        np.testing.assert_array_equal(got_vals, np.asarray(host.values))
