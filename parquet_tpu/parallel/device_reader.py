@@ -443,17 +443,34 @@ def _dense_mode() -> str:
     return "auto"
 
 
-def _dict_run_route() -> str:
-    """Where mixed-run dictionary index streams decode: 'device' (the
-    rle_expand kernel) or 'host' (C++ run expand + native gather).  Auto:
-    device on a real TPU, host elsewhere — the emulated device route on CPU
-    is the measured pathological case (BASELINE config 2)."""
+def _backend_route(env_var: str) -> str:
+    """Shared host/device routing policy: an explicit env override wins,
+    else 'device' on a real TPU and 'host' on every other backend (where
+    the XLA emulation of gather/bitcast-shaped kernels is the measured
+    pathological case)."""
     import os
 
-    v = os.environ.get("PARQUET_TPU_DICT_RUNS", "").lower()
+    v = os.environ.get(env_var, "").lower()
     if v in ("host", "device"):
         return v
     return "device" if jax.default_backend() == "tpu" else "host"
+
+
+def _plain_run_route() -> str:
+    """Where PLAIN fixed-width chunks decode: 'device' (staged bitcast
+    kernels — the bytes are needed in HBM anyway) or 'host' (numpy
+    zero-copy views of the host accumulation; staging + an XLA bitcast
+    materialization are two pure memcpy passes for an op numpy does for
+    free).  PARQUET_TPU_PLAIN_RUNS overrides."""
+    return _backend_route("PARQUET_TPU_PLAIN_RUNS")
+
+
+def _dict_run_route() -> str:
+    """Where mixed-run dictionary index streams decode: 'device' (the
+    rle_expand kernel) or 'host' (C++ fused run expand + gather; BASELINE
+    config 2 was the emulated route's worst case).  PARQUET_TPU_DICT_RUNS
+    overrides."""
+    return _backend_route("PARQUET_TPU_DICT_RUNS")
 
 
 _pallas_broken = False  # set when a Pallas compile fails; jnp from then on
@@ -854,16 +871,20 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
     # H2D at all (the C++ expand reads the host accum directly)
     dict_host = (plan.value_kind == "dict" and not dense_route
                  and _dict_run_route() == "host")
+    plain_host = (plan.value_kind in ("plain_fixed", "plain_flba")
+                  and _plain_run_route() == "host")
     meta = {}
     if dict_host:
         # record the route WITH the staged buffers: decode must not
         # re-derive it from mutable env/backend state and disagree with
         # what was (not) staged here
         meta["dict_host"] = True
+    if plain_host:
+        meta["plain_host"] = True
     delta_dense = plan.value_kind == "delta" and _stage_delta_dense(plan, meta)
     val_dbuf = None
     if not dense_route and not delta_dense and not dict_host and \
-            plan.value_kind not in (None, "host_ba"):
+            not plain_host and plan.value_kind not in (None, "host_ba"):
         # staged even when empty (all-null chunks have no value bytes): the
         # kernels need a real buffer operand to slice [:0] from
         val_dbuf = jax.device_put(plan.values.padded_array())
@@ -1012,6 +1033,18 @@ def decode_chunk_batched(reader: ColumnChunkReader,
     if len(batches) < min_batches:
         raise _Unsupported("batched decode: chunk too small to pipeline")
     physical = Type(reader.meta.type)
+    first_hdr = data_pages[0].header if data_pages else None
+    first_enc = None
+    if first_hdr is not None:
+        dph = first_hdr.data_page_header or first_hdr.data_page_header_v2
+        if dph is not None and dph.encoding is not None:
+            first_enc = Encoding(dph.encoding)
+    if (first_enc == Encoding.PLAIN and _plain_run_route() == "host"
+            and (physical in _FIXED_WIDTH
+                 or physical == Type.FIXED_LEN_BYTE_ARRAY)):
+        # the plain host route decodes as a zero-copy view of ONE contiguous
+        # accumulation — per-batch splits would only re-buy the concat copy
+        raise _Unsupported("batched decode: plain host route is single-pass")
 
     def plan_batch(i: int, subset) -> _Plan:
         return build_plan(reader,
@@ -1232,7 +1265,20 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
     nvals = plan.total_values
 
     if kind == "plain_fixed":
-        if physical in _IS_PAIR:
+        if staged_meta.get("plain_host"):
+            # NON-TPU backend: PLAIN fixed-width decode is a pure bitcast,
+            # which numpy does as a zero-copy VIEW of the host accumulation
+            # buffer — no H2D staging, no XLA output materialization (two
+            # whole-chunk copies saved; see _plain_run_route)
+            arr = plan.values.array()
+            if physical in _IS_PAIR:
+                values = arr[: nvals * 8].view(np.uint32).reshape(nvals, 2)
+            elif physical == Type.INT96:
+                values = arr[: nvals * 12].view(np.uint32).reshape(nvals, 3)
+            else:
+                dt = np.int32 if physical == Type.INT32 else np.float32
+                values = arr[: nvals * 4].view(dt)
+        elif physical in _IS_PAIR:
             values = dev.fixed64_pairs(val_dbuf, nvals)
         elif physical == Type.INT96:
             values = jax.lax.bitcast_convert_type(
@@ -1241,7 +1287,12 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             dt = {Type.INT32: "int32", Type.FLOAT: "float32"}[physical]
             values = dev.bitcast_fixed32(val_dbuf, nvals, dt)
     elif kind == "plain_flba":
-        values = val_dbuf[: nvals * leaf.type_length].reshape(nvals, leaf.type_length)
+        if staged_meta.get("plain_host"):
+            values = plan.values.array()[: nvals * leaf.type_length].reshape(
+                nvals, leaf.type_length)
+        else:
+            values = val_dbuf[: nvals * leaf.type_length].reshape(
+                nvals, leaf.type_length)
     elif kind == "bool":
         values = plan.vruns.expand(val_dbuf,
                                     tables=staged_meta.get("vruns")).astype(jnp.bool_)
