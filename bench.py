@@ -178,8 +178,13 @@ def _salted_plan(plan, salt: int):
     from parquet_tpu.parallel.device_reader import _ByteAccum
 
     def _salted(accum, s):
+        # preserve the accumulator's PART structure: the zero-copy plain
+        # route's only per-chunk work is the multi-part concatenation, and
+        # collapsing to one part would make the timed "kernel" a free view
+        # (reported as an impossible >HBM rate)
         out = _ByteAccum()
-        out.extend(accum.array() ^ s)
+        for part in accum._parts:
+            out.extend(np.asarray(part) ^ s)
         return out
 
     p = copy.copy(plan)
