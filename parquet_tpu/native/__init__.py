@@ -116,6 +116,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_void_p, _i64p,
             _i64p, _i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
+        lib.pq_delta_decode.restype = ctypes.c_int64
+        lib.pq_delta_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _i64p, _i32p, _i64p, _i64p,
+            _i64p, _i64p, _i64p, _i64p, ctypes.c_int64, _i64p_w,
+            ctypes.c_int32]
         lib.pq_scan_page_headers.restype = ctypes.c_int64
         lib.pq_scan_page_headers.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -425,6 +430,38 @@ def expand_runs(buf: np.ndarray, ends: np.ndarray, kinds: np.ndarray,
         np.ascontiguousarray(bit_offsets, np.int64),
         np.ascontiguousarray(widths, np.int32), len(kinds), out, n)
     return out[:wrote]
+
+
+def delta_decode(buf: np.ndarray, mb_bitoffs, mb_widths, mb_mins,
+                 page_mb_start, page_first, page_count, page_vpm,
+                 nthreads: int = 0):
+    """Fused DELTA_BINARY_PACKED decode from prescan miniblock tables:
+    unpack + min-add + prefix sum in one multithreaded native pass (pages
+    are independent).  Returns int64 values or None when the native library
+    is unavailable; raises ValueError on malformed tables."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    counts = np.ascontiguousarray(page_count, np.int64)
+    out_start = np.zeros(len(counts) + 1, np.int64)
+    np.cumsum(counts, out=out_start[1:])
+    out = np.empty(int(out_start[-1]), np.int64)
+    buf = np.ascontiguousarray(buf)
+    if not nthreads:
+        nthreads = min(os.cpu_count() or 1, 8)
+    rc = lib.pq_delta_decode(
+        buf.ctypes.data if len(buf) else None, len(buf),
+        np.ascontiguousarray(mb_bitoffs, np.int64),
+        np.ascontiguousarray(mb_widths, np.int32),
+        np.ascontiguousarray(mb_mins, np.int64),
+        np.ascontiguousarray(page_mb_start, np.int64),
+        np.ascontiguousarray(page_first, np.int64),
+        counts, out_start,
+        np.ascontiguousarray(page_vpm, np.int64),
+        len(counts), out, nthreads)
+    if rc != 0:
+        raise ValueError("malformed DELTA_BINARY_PACKED miniblock tables")
+    return out
 
 
 def expand_gather(buf: np.ndarray, tables: tuple, n: int,

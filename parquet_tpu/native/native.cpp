@@ -816,6 +816,110 @@ int64_t pq_scan_rle_runs(const uint8_t* data, int64_t size, int64_t n,
   return k;
 }
 
+// ---------------------------------------------------------------------------
+// Fused DELTA_BINARY_PACKED decode (multithreaded, one pass): miniblock
+// tables (from pq_delta_prescan) → int64 values, unpack + min-add + prefix
+// sum inline.  The host route for delta chunks on non-TPU backends
+// (BASELINE config 4); pages are independent (each restarts at its own
+// first value), so the thread partition is per page.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t load_bits64(const uint8_t* buf, int64_t buf_len,
+                                   int64_t bit, int w) {
+  // w <= 64; value may span 9 bytes — combine two clamped 8-byte loads
+  const int64_t byte0 = bit >> 3;
+  const int sh = (int)(bit & 7);
+  uint64_t lo = load8_clamped(buf, buf_len, byte0) >> sh;
+  if (sh + w > 64) {
+    uint64_t hi = load8_clamped(buf, buf_len, byte0 + 8);
+    lo |= hi << (64 - sh);
+  }
+  return (w >= 64) ? lo : (lo & (((uint64_t)1 << w) - 1));
+}
+
+int64_t pq_delta_decode(const uint8_t* buf, int64_t buf_len,
+                        const int64_t* mb_bitoffs, const int32_t* mb_widths,
+                        const int64_t* mb_mins, const int64_t* page_mb_start,
+                        const int64_t* page_first, const int64_t* page_count,
+                        const int64_t* page_out_start, const int64_t* page_vpm,
+                        int64_t npages, int64_t* out, int32_t nthreads) {
+  auto decode_page = [&](int64_t p) -> bool {
+    const int64_t total = page_count[p];
+    if (total <= 0) return total == 0;
+    const int64_t vpm = page_vpm[p];
+    if (vpm <= 0) return false;
+    int64_t* o = out + page_out_start[p];
+    uint64_t v = (uint64_t)page_first[p];
+    o[0] = (int64_t)v;
+    int64_t got = 1;
+    for (int64_t m = page_mb_start[p]; m < page_mb_start[p + 1] && got < total;
+         ++m) {
+      const int w = mb_widths[m];
+      if (w < 0 || w > 64) return false;
+      const uint64_t mn = (uint64_t)mb_mins[m];
+      const int64_t take = (total - got < vpm) ? (total - got) : vpm;
+      if (w == 0) {
+        for (int64_t j = 0; j < take; ++j) {
+          v += mn;
+          o[got + j] = (int64_t)v;
+        }
+      } else {
+        int64_t bit = mb_bitoffs[m];
+        if (bit < 0 || bit + (int64_t)w * take > buf_len * 8) return false;
+        if (w <= 28) {
+          // narrow widths (the common case): batch-unpack via one 8-byte
+          // load per 57/w values, same scheme as unpack_bits_span
+          const int kper = 57 / w;
+          const uint64_t mask = ((uint64_t)1 << w) - 1;
+          int64_t j = 0;
+          while (j < take) {
+            uint64_t word =
+                load8_clamped(buf, buf_len, bit >> 3) >> (bit & 7);
+            int mcount = (int)((take - j < kper) ? (take - j) : kper);
+            for (int t = 0; t < mcount; ++t) {
+              v += ((word >> (t * w)) & mask) + mn;
+              o[got + j + t] = (int64_t)v;
+            }
+            j += mcount;
+            bit += (int64_t)mcount * w;
+          }
+        } else {
+          for (int64_t j = 0; j < take; ++j) {
+            v += load_bits64(buf, buf_len, bit, w) + mn;
+            o[got + j] = (int64_t)v;
+            bit += w;
+          }
+        }
+      }
+      got += take;
+    }
+    return got >= total;
+  };
+  int T = nthreads;
+  if (T < 1) T = 1;
+  if (T > 16) T = 16;
+  if ((int64_t)T > npages) T = (int)npages ? (int)npages : 1;
+  if (T == 1) {
+    for (int64_t p = 0; p < npages; ++p)
+      if (!decode_page(p)) return -1;
+    return 0;
+  }
+  std::vector<std::thread> threads;
+  std::vector<char> ok((size_t)T, 1);
+  const int64_t per = (npages + T - 1) / T;
+  auto run = [&](int t) {
+    const int64_t lo = per * t, hi = std::min(npages, per * (t + 1));
+    for (int64_t p = lo; p < hi; ++p)
+      if (!decode_page(p)) { ok[(size_t)t] = 0; return; }
+  };
+  for (int t = 1; t < T; ++t) threads.emplace_back(run, t);
+  run(0);
+  for (auto& th : threads) th.join();
+  for (int t = 0; t < T; ++t)
+    if (!ok[(size_t)t]) return -1;
+  return 0;
+}
+
 }  // extern "C" (the helpers below use templates — C++ linkage)
 
 // ---------------------------------------------------------------------------

@@ -473,6 +473,14 @@ def _dict_run_route() -> str:
     return _backend_route("PARQUET_TPU_DICT_RUNS")
 
 
+def _delta_run_route() -> str:
+    """Where DELTA_BINARY_PACKED chunks decode: 'device' (dense unpack +
+    segmented cumsum kernels) or 'host' (C++ fused unpack + prefix sum from
+    the prescan miniblock tables; BASELINE config 4).
+    PARQUET_TPU_DELTA_RUNS overrides."""
+    return _backend_route("PARQUET_TPU_DELTA_RUNS")
+
+
 _pallas_broken = False  # set when a Pallas compile fails; jnp from then on
 
 
@@ -858,33 +866,41 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
     already resident in HBM.  ``stage_levels=False`` skips the level stream
     (nested columns assemble levels on host).
     """
-    if max(len(plan.levels), len(plan.values)) > dev.MAX_DEVICE_BUF:
+    dense_route = (plan.value_kind == "dict" and plan.dense_ok
+                   and plan.dense_pages and _dense_mode() != "off")
+    # host value routes, decided BEFORE the device size guard (they read
+    # the host accumulation directly — no 32-bit-lane constraint) and
+    # recorded in the staged meta: decode must not re-derive routing from
+    # mutable env/backend state and disagree with what was (not) staged
+    dict_host = (plan.value_kind == "dict" and not dense_route
+                 and _dict_run_route() == "host")
+    plain_host = (plan.value_kind in ("plain_fixed", "plain_flba")
+                  and _plain_run_route() == "host")
+    delta_host = (plan.value_kind == "delta"
+                  and _delta_run_route() == "host"
+                  and native.get_lib() is not None)
+    host_value_route = dict_host or plain_host or delta_host
+    if (stage_levels and len(plan.levels) > dev.MAX_DEVICE_BUF) or (
+            not host_value_route and len(plan.values) > dev.MAX_DEVICE_BUF):
         # device kernels index in 32-bit lanes; oversized chunks decode on host
         raise _Unsupported("chunk stream exceeds 32-bit-lane bit addressing")
     lev_dbuf = None
     if stage_levels and len(plan.levels):
         lev_dbuf = jax.device_put(plan.levels.padded_array())
         counters.inc("bytes_h2d", len(plan.levels))
-    dense_route = (plan.value_kind == "dict" and plan.dense_ok
-                   and plan.dense_pages and _dense_mode() != "off")
-    # mixed-run dict chunks decoding on the host route need no value-byte
-    # H2D at all (the C++ expand reads the host accum directly)
-    dict_host = (plan.value_kind == "dict" and not dense_route
-                 and _dict_run_route() == "host")
-    plain_host = (plan.value_kind in ("plain_fixed", "plain_flba")
-                  and _plain_run_route() == "host")
     meta = {}
     if dict_host:
-        # record the route WITH the staged buffers: decode must not
-        # re-derive it from mutable env/backend state and disagree with
-        # what was (not) staged here
         meta["dict_host"] = True
     if plain_host:
         meta["plain_host"] = True
-    delta_dense = plan.value_kind == "delta" and _stage_delta_dense(plan, meta)
+    if delta_host:
+        meta["delta_host"] = True
+    delta_dense = (plan.value_kind == "delta" and not delta_host
+                   and _stage_delta_dense(plan, meta))
     val_dbuf = None
     if not dense_route and not delta_dense and not dict_host and \
-            not plain_host and plan.value_kind not in (None, "host_ba"):
+            not plain_host and not delta_host and \
+            plan.value_kind not in (None, "host_ba"):
         # staged even when empty (all-null chunks have no value bytes): the
         # kernels need a real buffer operand to slice [:0] from
         val_dbuf = jax.device_put(plan.values.padded_array())
@@ -893,7 +909,7 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         # compacted single-width index stream replaces the raw bodies
         meta["dense"] = jax.device_put(plan.dense.padded_array(extra=4))
         counters.inc("bytes_h2d", len(plan.dense))
-    if plan.value_kind == "delta":
+    if plan.value_kind == "delta" and not delta_host:
         if not delta_dense:
             if len(set(plan.d_vpms)) > 1:
                 # the gather kernel assumes one values-per-miniblock across
@@ -1342,7 +1358,30 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             else:
                 values = dev.dict_gather(dictionary, dict_indices)
     elif kind == "delta":
-        if staged_meta.get("delta_dense") is not None:
+        if staged_meta.get("delta_host"):
+            # NON-TPU backend: fused C++ unpack + min-add + prefix sum from
+            # the prescan miniblock tables, one threaded pass — the XLA CPU
+            # emulation of the dense delta kernels was BASELINE config 4's
+            # bottleneck.  Handles per-page vpm (no single-vpm constraint).
+            counters.inc("delta_host_route")
+            lens = [len(w) for w in plan.d_mb_widths]
+            page_mb_start = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=page_mb_start[1:])
+            vals = native.delta_decode(
+                plan.values.array(),
+                np.concatenate(plan.d_mb_offs) if plan.d_mb_offs
+                else np.zeros(0, np.int64),
+                np.concatenate(plan.d_mb_widths) if plan.d_mb_widths
+                else np.zeros(0, np.int32),
+                np.concatenate(plan.d_mb_mins) if plan.d_mb_mins
+                else np.zeros(0, np.int64),
+                page_mb_start, plan.d_firsts, plan.d_counts, plan.d_vpms)
+            if physical == Type.INT32:
+                values = vals.astype(np.int32)
+            else:
+                values = np.ascontiguousarray(vals).view(
+                    np.uint32).reshape(-1, 2)
+        elif staged_meta.get("delta_dense") is not None:
             streams, perm, mins, firsts = staged_meta["delta_dense"]
             vpm, gw, gk, pcounts = plan.d_dense_static
             use_pk = tuple(_use_pallas(w) for w in gw)

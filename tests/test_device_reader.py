@@ -431,3 +431,51 @@ def test_bytearray_source_mutation_safe(rng):
     buf[:] = b"\xff" * len(buf)  # caller reuses its buffer
     got = np.asarray(tbl.to_arrow().column("x").combine_chunks())
     np.testing.assert_array_equal(got, vals)
+
+
+@pytest.mark.parametrize("route_var,table_kind", [
+    ("PARQUET_TPU_DELTA_RUNS", "delta"),
+    ("PARQUET_TPU_DICT_RUNS", "dict"),
+    ("PARQUET_TPU_PLAIN_RUNS", "plain"),
+])
+def test_device_route_pinned_equals_host_route(route_var, table_kind, rng,
+                                               monkeypatch):
+    """The DEVICE value routes keep CPU coverage even though host routes are
+    the non-TPU default (review r4): pin each route to 'device' and assert
+    equality with the host-route decode."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.parallel import device_reader as dr
+
+    n = 150_000
+    if table_kind == "delta":
+        t = pa.table({"c": pa.array(
+            1_000_000 + np.cumsum(rng.integers(0, 500, n)))})
+        kw = dict(compression="none", use_dictionary=False,
+                  column_encoding={"c": "DELTA_BINARY_PACKED"})
+    elif table_kind == "dict":
+        v = rng.integers(0, 800, n)
+        v[: n // 5] = 13  # long RLE run + bit-packed spans
+        t = pa.table({"c": pa.array(v)})
+        kw = dict(compression="snappy", use_dictionary=True)
+    else:
+        t = pa.table({"c": pa.array(rng.integers(0, 1 << 50, n))})
+        kw = dict(compression="none", use_dictionary=False,
+                  column_encoding={"c": "PLAIN"})
+    b = io.BytesIO()
+    pq.write_table(t, b, row_group_size=1 << 30, **kw)
+    raw = b.getvalue()
+
+    monkeypatch.setenv(route_var, "device")
+    dev_col = dr.decode_chunk_device(
+        ParquetFile(raw).row_group(0).column(0), fallback=False)
+    monkeypatch.setenv(route_var, "host")
+    host_col = dr.decode_chunk_device(
+        ParquetFile(raw).row_group(0).column(0), fallback=False)
+    assert dev_col.to_arrow().equals(host_col.to_arrow())
+    oracle = t.column("c").combine_chunks()
+    assert dev_col.to_arrow().cast(oracle.type).equals(oracle)
