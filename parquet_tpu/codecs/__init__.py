@@ -9,8 +9,13 @@ design: LZ77 back-references are sequential and do not vectorize onto the MXU,
 so the pipeline hides decompression behind H2D staging instead (SURVEY.md §7
 hard part 3).
 
-API: ``Codec.decode(data: bytes|memoryview, uncompressed_size: int) -> bytes``
-and ``Codec.encode(data) -> bytes``; look up singletons with :func:`get_codec`.
+API: ``Codec.decode(data, uncompressed_size)`` takes any bytes-like buffer
+(bytes / memoryview / numpy uint8 view) and returns a BYTES-LIKE BUFFER —
+bytes or, for the zero-copy codecs (uncompressed, snappy, zstd), a
+contiguous numpy uint8 array.  Consume results through the buffer protocol
+(``np.frombuffer`` / ``len`` / slicing) and wrap in ``bytes()`` only where
+raw-bytes semantics (equality, hashing, dict keys) are required.
+``Codec.encode(data) -> bytes``; look up singletons with :func:`get_codec`.
 """
 
 from __future__ import annotations
@@ -20,6 +25,8 @@ import ctypes.util
 import struct
 import zlib
 from typing import Dict, Optional
+
+import numpy as np
 
 from ..format.enums import CompressionCodec
 
@@ -33,7 +40,13 @@ class Codec:
     def encode(self, data) -> bytes:
         raise NotImplementedError
 
-    def decode(self, data, uncompressed_size: int) -> bytes:
+    def decode(self, data, uncompressed_size: int):
+        """Decompress to a bytes-like buffer.
+
+        May return bytes OR a contiguous numpy uint8 array (the zero-copy
+        codecs) — consumers treat the result through the buffer protocol
+        (np.frombuffer / len / slicing); wrap in ``bytes()`` only when raw
+        bytes semantics (hashing, equality) are required."""
         raise NotImplementedError
 
     def __repr__(self):
@@ -80,7 +93,10 @@ class SnappyCodec(Codec):
         lib.snappy_compress.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_size_t)]
-        lib.snappy_uncompress.argtypes = lib.snappy_compress.argtypes
+        # decode takes raw pointers (zero-copy numpy views on both sides)
+        lib.snappy_uncompress.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t)]
         lib.snappy_max_compressed_length.restype = ctypes.c_size_t
         lib.snappy_max_compressed_length.argtypes = [ctypes.c_size_t]
         lib.snappy_uncompressed_length.argtypes = [
@@ -98,14 +114,21 @@ class SnappyCodec(Codec):
             raise RuntimeError(f"snappy_compress failed rc={rc}")
         return out.raw[: out_len.value]
 
-    def decode(self, data, uncompressed_size: int) -> bytes:
-        data = bytes(data)
-        out = ctypes.create_string_buffer(uncompressed_size) if uncompressed_size else ctypes.create_string_buffer(1)
+    def decode(self, data, uncompressed_size: int):
+        # zero-copy in AND out: page payloads arrive as numpy views, and the
+        # decompressed buffer is returned as the numpy array libsnappy wrote
+        # into (bytes(data) + out.raw sliced were two whole-page copies)
+        src = data if isinstance(data, np.ndarray) else np.frombuffer(
+            data, np.uint8)
+        src = np.ascontiguousarray(src)
+        out = np.empty(max(uncompressed_size, 1), np.uint8)
         out_len = ctypes.c_size_t(uncompressed_size)
-        rc = self._lib.snappy_uncompress(data, len(data), out, ctypes.byref(out_len))
+        rc = self._lib.snappy_uncompress(
+            src.ctypes.data if len(src) else None, len(src),
+            out.ctypes.data_as(ctypes.c_char_p), ctypes.byref(out_len))
         if rc != 0:
             raise RuntimeError(f"snappy_uncompress failed rc={rc}")
-        return out.raw[: out_len.value]
+        return out[: out_len.value]
 
 
 class GzipCodec(Codec):
@@ -154,7 +177,11 @@ class ZstdCodec(Codec):
         d = getattr(self._tl, "d", None)
         if d is None:
             d = self._tl.d = self._zstd.ZstdDecompressor()
-        return d.decompress(bytes(data), max_output_size=max(uncompressed_size, 1))
+        if isinstance(data, np.ndarray):
+            data = memoryview(np.ascontiguousarray(data))  # zero-copy
+        elif not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)
+        return d.decompress(data, max_output_size=max(uncompressed_size, 1))
 
 
 class Lz4RawCodec(Codec):

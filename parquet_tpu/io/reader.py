@@ -454,9 +454,9 @@ class ParquetFile:
         # core, threads are a pure loss for whole-chunk decode: per-thread
         # malloc arenas defeat buffer reuse for the large decode buffers
         # (measured 2x slower), so the fan-out needs real cores.
-        import os as _os
+        from ..utils.pool import available_cpus
 
-        if (n_rg * len(leaves) > 1 and (_os.cpu_count() or 1) > 1
+        if (n_rg * len(leaves) > 1 and available_cpus() > 1
                 and self.num_rows * len(leaves) >= 2_000_000):
             from ..utils.pool import shared_pool
 

@@ -225,9 +225,9 @@ class ParquetWriter:
         # buffering the row group's compressed pages until emit.  On one
         # core a pool measured ~15% SLOWER (GIL'd numpy dispatch), so the
         # serial one-chunk-buffered interleave is kept there.
-        import os as _os
+        from ..utils.pool import available_cpus
 
-        ncpu = _os.cpu_count() or 1
+        ncpu = available_cpus()
         work_bytes = sum(getattr(np.asarray(d.values), "nbytes", 0)
                          for d in datas)
         # small row groups stay serial even on multi-core: pool setup plus

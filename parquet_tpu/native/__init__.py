@@ -448,7 +448,9 @@ def delta_decode(buf: np.ndarray, mb_bitoffs, mb_widths, mb_mins,
     out = np.empty(int(out_start[-1]), np.int64)
     buf = np.ascontiguousarray(buf)
     if not nthreads:
-        nthreads = min(os.cpu_count() or 1, 8)
+        from ..utils.pool import available_cpus
+
+        nthreads = min(available_cpus(), 8)
     rc = lib.pq_delta_decode(
         buf.ctypes.data if len(buf) else None, len(buf),
         np.ascontiguousarray(mb_bitoffs, np.int64),
@@ -482,7 +484,9 @@ def expand_gather(buf: np.ndarray, tables: tuple, n: int,
     dvals = np.ascontiguousarray(dictionary)
     out = np.empty(n, dtype=dictionary.dtype)
     if not nthreads:
-        nthreads = min(os.cpu_count() or 1, 8)
+        from ..utils.pool import available_cpus
+
+        nthreads = min(available_cpus(), 8)
     rc = lib.pq_expand_gather(
         buf.ctypes.data if len(buf) else None, len(buf),
         np.ascontiguousarray(ends, np.int64),

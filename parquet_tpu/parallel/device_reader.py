@@ -310,6 +310,10 @@ class _Plan:
     dense_w: Optional[int] = None
     dense_pages: List[Tuple[int, int]] = field(default_factory=list)
     dense_ok: bool = True
+    # dict-chunk decode route, decided ONCE at plan time (build_plan) so a
+    # mid-flight env flip cannot make stage/decode disagree with the plan's
+    # dense accumulation decision
+    dict_route: Optional[str] = None
     # delta
     d_firsts: List[int] = field(default_factory=list)
     d_counts: List[int] = field(default_factory=list)
@@ -559,6 +563,13 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
             f"encoding {encoding!r} is overridden by a registered decoder")
     if encoding in (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY):
         plan.set_kind("dict")
+        if plan.dict_route is None:
+            plan.dict_route = _dict_run_route()
+        if plan.dict_route == "host":
+            # the fused C++ expand+gather outruns the emulated dense-unpack
+            # kernels off-TPU; don't pay the dense compaction accumulation
+            # for a stream that will decode from the run tables
+            plan.dense_ok = False
         width = int(raw[pos]) if pos < len(raw) else 0
         body = raw[pos + 1 :]
         base = len(plan.values)
@@ -866,14 +877,19 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
     already resident in HBM.  ``stage_levels=False`` skips the level stream
     (nested columns assemble levels on host).
     """
-    dense_route = (plan.value_kind == "dict" and plan.dense_ok
-                   and plan.dense_pages and _dense_mode() != "off")
     # host value routes, decided BEFORE the device size guard (they read
     # the host accumulation directly — no 32-bit-lane constraint) and
     # recorded in the staged meta: decode must not re-derive routing from
-    # mutable env/backend state and disagree with what was (not) staged
-    dict_host = (plan.value_kind == "dict" and not dense_route
-                 and _dict_run_route() == "host")
+    # mutable env/backend state and disagree with what was (not) staged.
+    # The host dict route outranks the dense device route off-TPU (measured
+    # 2.4x on the 200-entry-dictionary string config).  The route was fixed
+    # at plan time (plan.dict_route) — mid-flight env flips cannot make the
+    # stage disagree with the plan's dense accumulation decision.
+    dict_host = (plan.value_kind == "dict"
+                 and (plan.dict_route or _dict_run_route()) == "host")
+    dense_route = (plan.value_kind == "dict" and not dict_host
+                   and plan.dense_ok and plan.dense_pages
+                   and _dense_mode() != "off")
     plain_host = (plan.value_kind in ("plain_fixed", "plain_flba")
                   and _plain_run_route() == "host")
     delta_host = (plan.value_kind == "delta"
@@ -1109,10 +1125,15 @@ def decode_chunks_pipelined(chunks, keep_dictionary: bool = True,
     import threading
     from concurrent.futures import ThreadPoolExecutor
 
+    from ..utils.pool import available_cpus
+
     chunks = list(chunks)
-    if len(chunks) == 1:
+    if len(chunks) == 1 and (jax.default_backend() == "tpu"
+                             or available_cpus() > 1):
         # nothing to overlap ACROSS chunks: pipeline WITHIN the chunk
-        # (page batches) instead — the single-large-chunk e2e shape
+        # (page batches) instead — the single-large-chunk e2e shape.
+        # Only where overlap can pay: on one CPU core the batch concat
+        # and pool overheads are pure loss (measured 2x on dict chunks).
         try:
             col = decode_chunk_batched(chunks[0],
                                        keep_dictionary=keep_dictionary)

@@ -17,6 +17,16 @@ _POOL: Optional[ThreadPoolExecutor] = None
 _LOCK = threading.Lock()
 
 
+def available_cpus() -> int:
+    """CPUs actually available to THIS process (cgroup/affinity-aware —
+    os.cpu_count() reports physical cores and misfires in pinned
+    containers)."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
 def shared_pool() -> ThreadPoolExecutor:
     global _POOL
     with _LOCK:
@@ -25,7 +35,7 @@ def shared_pool() -> ThreadPoolExecutor:
             # the GIL on the python slices between the GIL-releasing numpy/
             # C++/codec calls (measured ~1.6x slowdown at 16 workers on one
             # core); 2 is the floor so IO still overlaps decode
-            workers = max(2, min(16, os.cpu_count() or 1))
+            workers = max(2, min(16, available_cpus()))
             _POOL = ThreadPoolExecutor(max_workers=workers,
                                        thread_name_prefix="pq-work")
         return _POOL
