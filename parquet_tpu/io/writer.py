@@ -448,15 +448,15 @@ class ParquetWriter:
         )
         chunk = md.ColumnChunk(file_offset=chunk_start, meta_data=meta)
         ci = oi = None
-        if opts.write_page_index and ci_mins:
-            ci = md.ColumnIndex(
-                null_pages=ci_nulls, min_values=ci_mins, max_values=ci_maxs,
-                boundary_order=int(_boundary_order(ci_mins, ci_maxs, leaf,
-                                                   ci_nulls)),
-                null_counts=ci_null_counts)
+        if opts.write_page_index:
             oi = md.OffsetIndex(page_locations=page_locs)
-        elif opts.write_page_index:
-            oi = md.OffsetIndex(page_locations=page_locs)
+            if ci_mins:
+                ci = md.ColumnIndex(
+                    null_pages=ci_nulls, min_values=ci_mins,
+                    max_values=ci_maxs,
+                    boundary_order=int(_boundary_order(ci_mins, ci_maxs, leaf,
+                                                       ci_nulls)),
+                    null_counts=ci_null_counts)
         return chunk, ci, oi, enc.bloom_blob, uncomp_acc, total_comp_size
 
     # ------------------------------------------------------------------
