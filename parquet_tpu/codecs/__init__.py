@@ -33,6 +33,23 @@ from ..format.enums import CompressionCodec
 __all__ = ["Codec", "get_codec", "CODECS", "is_supported"]
 
 
+def _as_contig_u8(data) -> np.ndarray:
+    """Flat uint8 view of any bytes-like buffer, in its full BYTE length
+    (typed arrays view their raw bytes, not their element count); copies
+    only when the input is non-contiguous or lacks a reinterpretable
+    layout."""
+    if isinstance(data, np.ndarray):
+        a = np.ascontiguousarray(data)
+        try:
+            return a.view(np.uint8).reshape(-1)
+        except (TypeError, ValueError):
+            return np.frombuffer(a.tobytes(), np.uint8)
+    try:
+        return np.frombuffer(data, np.uint8)
+    except (ValueError, BufferError, TypeError):
+        return np.frombuffer(bytes(data), np.uint8)
+
+
 class Codec:
     codec_id: CompressionCodec = None  # type: ignore
     name: str = ""
@@ -90,10 +107,10 @@ class SnappyCodec(Codec):
         lib = _load("libsnappy.so.1")
         if lib is None:
             raise RuntimeError("libsnappy not found")
+        # raw pointers both ways: encode/decode take zero-copy numpy views
         lib.snappy_compress.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_size_t)]
-        # decode takes raw pointers (zero-copy numpy views on both sides)
         lib.snappy_uncompress.argtypes = [
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_size_t)]
@@ -104,23 +121,25 @@ class SnappyCodec(Codec):
         self._lib = lib
 
     def encode(self, data) -> bytes:
-        data = bytes(data)
-        n = len(data)
+        # zero-copy in: page bodies arrive as bytes or numpy views; only the
+        # (necessarily fresh) compressed output is allocated
+        src = _as_contig_u8(data)
+        n = len(src)
         cap = self._lib.snappy_max_compressed_length(n)
-        out = ctypes.create_string_buffer(cap)
+        out = np.empty(cap, np.uint8)
         out_len = ctypes.c_size_t(cap)
-        rc = self._lib.snappy_compress(data, n, out, ctypes.byref(out_len))
+        rc = self._lib.snappy_compress(
+            src.ctypes.data if n else None, n,
+            out.ctypes.data_as(ctypes.c_char_p), ctypes.byref(out_len))
         if rc != 0:
             raise RuntimeError(f"snappy_compress failed rc={rc}")
-        return out.raw[: out_len.value]
+        return out[: out_len.value].tobytes()
 
     def decode(self, data, uncompressed_size: int):
         # zero-copy in AND out: page payloads arrive as numpy views, and the
         # decompressed buffer is returned as the numpy array libsnappy wrote
         # into (bytes(data) + out.raw sliced were two whole-page copies)
-        src = data if isinstance(data, np.ndarray) else np.frombuffer(
-            data, np.uint8)
-        src = np.ascontiguousarray(src)
+        src = _as_contig_u8(data)
         out = np.empty(max(uncompressed_size, 1), np.uint8)
         out_len = ctypes.c_size_t(uncompressed_size)
         rc = self._lib.snappy_uncompress(

@@ -103,3 +103,17 @@ def test_zstd_codec_thread_safety():
     for t in threads:
         t.join()
     assert not errors
+
+
+def test_snappy_typed_and_strided_inputs(rng):
+    """encode/decode accept typed arrays (full BYTE length, not element
+    count) and strided views (review r4: silent truncation repro)."""
+    codec = codecs.get_codec(CC.SNAPPY)
+    a = rng.integers(0, 1 << 60, 500).astype(np.int64)
+    enc = codec.encode(a)
+    assert bytes(codec.decode(enc, a.nbytes)) == a.tobytes()
+    m2 = np.arange(200, dtype=np.uint8).reshape(10, 20)[:, :13]  # strided
+    enc2 = codec.encode(memoryview(np.ascontiguousarray(m2)))
+    assert bytes(codec.decode(enc2, m2.size)) == np.ascontiguousarray(m2).tobytes()
+    enc3 = codec.encode(m2)  # non-contiguous ndarray
+    assert bytes(codec.decode(enc3, m2.size)) == np.ascontiguousarray(m2).tobytes()
