@@ -216,6 +216,9 @@ def test_dense_dict_fused_small_dictionary(monkeypatch, rng):
     from parquet_tpu.parallel import device_reader as dr
 
     monkeypatch.setenv("PARQUET_TPU_PALLAS", "1")
+    # pin the DEVICE dict route: off-TPU the host route outranks the dense
+    # path this test exists to exercise
+    monkeypatch.setenv("PARQUET_TPU_DICT_RUNS", "device")
     n = 30000
     t = pa.table({"v": pa.array((rng.integers(0, 50, n) * 3).astype(np.int32))})
     raw = _write(t, use_dictionary=True, data_page_size=1 << 14)
