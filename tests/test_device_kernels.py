@@ -268,3 +268,44 @@ class TestAssembleNested:
         if got_vals.ndim == 2 and got_vals.shape[-1] == 2:
             got_vals = np.ascontiguousarray(got_vals).view(np.int64).reshape(-1)
         np.testing.assert_array_equal(got_vals, np.asarray(host.values))
+
+
+def test_assemble_nested_depth3(rng):
+    """Device assembler equality at depth 3 (the 'ANY depth' claim)."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.ops import device as dev, levels as levels_ops
+    import jax.numpy as jnp
+
+    n = 1200
+    rows = [[[ [int(v) for v in rng.integers(0, 9, int(rng.integers(0, 3)))]
+               for _ in range(int(rng.integers(0, 2)))]
+             for _ in range(int(rng.integers(0, 3)))]
+            if rng.random() > 0.06 else None for _ in range(n)]
+    t = pa.table({"v": pa.array(rows, pa.list_(pa.list_(pa.list_(pa.int64()))))})
+    b = io.BytesIO()
+    pq.write_table(t, b, compression="none", use_dictionary=False)
+    tab = ParquetFile(b.getvalue()).read()
+    col = next(iter(tab.columns.values()))
+    leaf = col.leaf
+    d, r = np.asarray(col.def_levels), np.asarray(col.rep_levels)
+    infos = levels_ops.repeated_ancestors(leaf)
+    assert len(infos) == 3
+    want = levels_ops.assemble(d, r, leaf)
+    got_offs, got_val, got_leaf = dev.assemble_nested(
+        jnp.asarray(d), jnp.asarray(r), infos, leaf.max_definition_level)
+    for go, wo in zip(got_offs, want.list_offsets):
+        np.testing.assert_array_equal(np.asarray(go),
+                                      np.asarray(wo).astype(np.int32))
+    for gv, wv in zip(got_val, want.list_validity):
+        if wv is None:
+            assert bool(np.asarray(gv).all())
+        else:
+            np.testing.assert_array_equal(np.asarray(gv), np.asarray(wv))
+    if want.validity is not None:
+        np.testing.assert_array_equal(np.asarray(got_leaf),
+                                      np.asarray(want.validity))
