@@ -482,3 +482,43 @@ def test_device_route_pinned_equals_host_route(route_var, table_kind, rng,
     assert dev_col.to_arrow().equals(host_col.to_arrow())
     oracle = t.column("c").combine_chunks()
     assert dev_col.to_arrow().cast(oracle.type).equals(oracle)
+
+
+@pytest.mark.parametrize("dtype", ["f8", "f4", "i4", "f2"])
+def test_bss_route_pinned_equals_host_route(dtype, rng, monkeypatch):
+    """BSS device and host routes agree with each other and the oracle."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.parallel import device_reader as dr
+
+    n = 120_000
+    if dtype == "i4":
+        t = pa.table({"c": pa.array(
+            rng.integers(-(2**31), 2**31, n).astype(np.int32))})
+    elif dtype == "f2":  # FLOAT16 -> FLBA(2): the FLBA host-route branch
+        t = pa.table({"c": pa.array(rng.random(n).astype(np.float16))})
+    else:
+        t = pa.table({"c": pa.array(
+            rng.random(n).astype(np.float64 if dtype == "f8"
+                                 else np.float32))})
+    b = io.BytesIO()
+    try:
+        pq.write_table(t, b, compression="snappy", use_dictionary=False,
+                       column_encoding={"c": "BYTE_STREAM_SPLIT"},
+                       row_group_size=1 << 30, data_page_size=16 * 1024)
+    except Exception as e:  # pyarrow without extended-BSS support
+        pytest.skip(f"pyarrow cannot BSS-encode {dtype}: {e}")
+    raw = b.getvalue()
+    monkeypatch.setenv("PARQUET_TPU_BSS_RUNS", "device")
+    dev_col = dr.decode_chunk_device(
+        ParquetFile(raw).row_group(0).column(0), fallback=False)
+    monkeypatch.setenv("PARQUET_TPU_BSS_RUNS", "host")
+    host_col = dr.decode_chunk_device(
+        ParquetFile(raw).row_group(0).column(0), fallback=False)
+    assert dev_col.to_arrow().equals(host_col.to_arrow())
+    oracle = t.column("c").combine_chunks()
+    assert dev_col.to_arrow().cast(oracle.type).equals(oracle)
