@@ -28,6 +28,7 @@ _u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
 _u8p_w = np.ctypeslib.ndpointer(np.uint8, flags=("C_CONTIGUOUS", "WRITEABLE"))
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _i64p_w = np.ctypeslib.ndpointer(np.int64, flags=("C_CONTIGUOUS", "WRITEABLE"))
+_i32p_w = np.ctypeslib.ndpointer(np.int32, flags=("C_CONTIGUOUS", "WRITEABLE"))
 
 
 def _build() -> bool:
@@ -125,6 +126,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_scan_page_headers.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             _i64p_w]
+        lib.pq_dict_chunk_scan.restype = ctypes.c_int64
+        lib.pq_dict_chunk_scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            _u8p_w, ctypes.c_int64,
+            _i64p_w, _u8p_w, _i64p_w, _i64p_w, _i32p_w, ctypes.c_int64,
+            _i64p_w, ctypes.c_int32]
         lib.pq_xxh64.restype = ctypes.c_uint64
         lib.pq_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         lib.pq_xxh64_batch.restype = None
@@ -548,6 +556,51 @@ def scan_page_headers(buf, total_values: int):
         if k < 0:
             return None
         return out[:k]
+
+
+def dict_chunk_scan(buf, pages_rows: np.ndarray, codec_id: int,
+                    max_def: int, max_rep: int):
+    """Fused whole-chunk dictionary-index scan: decompress every data page
+    (UNCOMPRESSED/SNAPPY/ZSTD), verify all-present def levels, and scan the
+    index runs into one combined chunk-level run table in a single native
+    call (the per-page Python loop was ~60% of build_plan's host time at
+    64 MB / 400 pages).
+
+    Returns ``(ends, kinds, payloads, bit_offsets, widths, nvals, body)``
+    with offsets indexing ``body`` (the concatenated decompressed pages), or
+    None when the chunk needs the general Python planner (nulls, rep levels,
+    non-dict pages, foreign codec, no native lib)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    b = buf if isinstance(buf, np.ndarray) else np.frombuffer(buf, np.uint8)
+    b = np.ascontiguousarray(b)
+    rows = np.ascontiguousarray(pages_rows, np.int64)
+    n_pages = len(rows)
+    data = rows[(rows[:, PG_TYPE] == 0) | (rows[:, PG_TYPE] == 3)]
+    if not len(data):
+        return None
+    out_cap = int(data[:, PG_UNCOMP].sum()) + 8
+    nvals_cap = int(data[:, PG_NVALS].sum())
+    run_cap = nvals_cap + n_pages + 8
+    out_bytes = np.empty(out_cap, np.uint8)
+    ends = np.empty(run_cap, np.int64)
+    kinds = np.empty(run_cap, np.uint8)
+    payloads = np.empty(run_cap, np.int64)
+    boffs = np.empty(run_cap, np.int64)
+    widths = np.empty(run_cap, np.int32)
+    info = np.zeros(2, np.int64)
+    from ..utils.pool import available_cpus
+
+    k = lib.pq_dict_chunk_scan(
+        b.ctypes.data if len(b) else None, len(b), rows.reshape(-1),
+        n_pages, codec_id, max_def, max_rep,
+        out_bytes, out_cap, ends, kinds, payloads, boffs, widths, run_cap,
+        info, min(available_cpus(), 8))
+    if k < 0:
+        return None
+    return (ends[:k], kinds[:k], payloads[:k], boffs[:k] * 8, widths[:k],
+            int(info[0]), out_bytes[: info[1]])
 
 
 def scan_rle_runs(buf: np.ndarray, n: int, bit_width: int):

@@ -12,6 +12,7 @@
 // no pybind11 (not in this image); numpy arrays cross as raw pointers.
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -785,6 +786,11 @@ int64_t pq_scan_rle_runs(const uint8_t* data, int64_t size, int64_t n,
       shift += 7;
       if (shift > 63) return -1;
     }
+    // a zero-count run (header >> 1 == 0) covers no values: it never
+    // decrements `remaining`, so a crafted stream of them would grow the
+    // run table without bound (the caller sizes its arrays as n+1 on the
+    // guarantee every run covers >= 1 value) — reject as malformed
+    if ((header >> 1) == 0) return -1;
     if (header & 1) {
       int64_t ngroups = (int64_t)(header >> 1);
       int64_t count = ngroups * 8;
@@ -1383,5 +1389,293 @@ void pq_dict_first_occurrence(const int64_t* indices, int64_t n,
 // ---------------------------------------------------------------------------
 // Hadoop-framed LZ4 / generic frame walker is python-side; CRC32 via zlib.
 // ---------------------------------------------------------------------------
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fused whole-chunk dictionary-index scan (SURVEY.md §3.1 hot path): one
+// native call replaces the per-page Python loop of build_plan for the host
+// dict route — per page: decompress (snappy/zstd via dlopen'd system libs,
+// the same ones codecs/ uses from Python), verify the def-level stream is
+// all-present, and scan the RLE/bit-packed index runs into ONE combined
+// chunk-level run table whose byte offsets index the decompressed stream.
+// ~400 pages of a 64 MB chunk cost ~40 ms of Python/ctypes dispatch on the
+// per-page path; this pass is one call.  Any page this scan can't prove
+// simple (nulls, rep levels, non-dict encoding, foreign codec, legacy
+// BIT_PACKED levels) bails the WHOLE chunk back to the Python planner,
+// which owns the general semantics.
+// ---------------------------------------------------------------------------
+
+#include <dlfcn.h>
+
+namespace {
+
+typedef int (*snappy_fn)(const char*, size_t, char*, size_t*);
+typedef size_t (*zstd_fn)(void*, size_t, const void*, size_t);
+typedef unsigned (*zstd_err_fn)(size_t);
+
+inline void* dl_first(const char* a, const char* b) {
+  void* h = dlopen(a, RTLD_NOW);
+  return h ? h : dlopen(b, RTLD_NOW);
+}
+
+inline snappy_fn get_snappy_uncompress() {
+  static snappy_fn fn = [] {
+    void* h = dl_first("libsnappy.so.1", "libsnappy.so");
+    return h ? (snappy_fn)dlsym(h, "snappy_uncompress") : nullptr;
+  }();
+  return fn;
+}
+
+inline zstd_fn get_zstd_decompress() {
+  static zstd_fn fn = [] {
+    void* h = dl_first("libzstd.so.1", "libzstd.so");
+    return h ? (zstd_fn)dlsym(h, "ZSTD_decompress") : nullptr;
+  }();
+  return fn;
+}
+
+inline zstd_err_fn get_zstd_iserror() {
+  static zstd_err_fn fn = [] {
+    void* h = dl_first("libzstd.so.1", "libzstd.so");
+    return h ? (zstd_err_fn)dlsym(h, "ZSTD_isError") : nullptr;
+  }();
+  return fn;
+}
+
+// decompress `src` into `dst` (exactly dst_len bytes expected). codec is the
+// parquet CompressionCodec id: 0 UNCOMPRESSED, 1 SNAPPY, 6 ZSTD.
+inline bool page_decompress(int codec, const uint8_t* src, int64_t src_len,
+                            uint8_t* dst, int64_t dst_len) {
+  if (codec == 0) {
+    if (src_len != dst_len) return false;
+    std::memcpy(dst, src, (size_t)src_len);
+    return true;
+  }
+  if (codec == 1) {
+    snappy_fn fn = get_snappy_uncompress();
+    if (!fn) return false;
+    size_t out_len = (size_t)dst_len;
+    if (fn((const char*)src, (size_t)src_len, (char*)dst, &out_len) != 0)
+      return false;
+    return (int64_t)out_len == dst_len;
+  }
+  if (codec == 6) {
+    zstd_fn fn = get_zstd_decompress();
+    zstd_err_fn err = get_zstd_iserror();
+    if (!fn || !err) return false;
+    size_t r = fn(dst, (size_t)dst_len, src, (size_t)src_len);
+    if (err(r)) return false;
+    return (int64_t)r == dst_len;
+  }
+  return false;
+}
+
+inline int level_bit_width(int32_t max_level) {
+  int w = 0;
+  while ((1 << w) - 1 < max_level) ++w;
+  return w;
+}
+
+// Parse a def-level RLE stream and require it to be a single RLE run of
+// `max_def` covering >= nvals values (the all-present page). Returns false
+// for anything else (caller bails the chunk).
+inline bool def_stream_all_present(const uint8_t* p, int64_t len,
+                                   int64_t nvals, int32_t max_def) {
+  int w = level_bit_width(max_def);
+  int64_t pos = 0;
+  uint64_t header = 0;
+  int shift = 0;
+  while (true) {
+    if (pos >= len) return false;
+    uint8_t b = p[pos++];
+    header |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  if (header & 1) return false;  // bit-packed run: not the all-present shape
+  int64_t count = (int64_t)(header >> 1);
+  if (count < nvals) return false;
+  const int vbytes = (w + 7) / 8;
+  if (pos + vbytes > len) return false;
+  uint64_t value = 0;
+  for (int j = 0; j < vbytes; ++j) value |= (uint64_t)p[pos + j] << (8 * j);
+  if (w < 64) value &= (1ull << w) - 1;
+  return (int64_t)value == (int64_t)max_def;
+}
+
+struct DictPageScan {
+  int64_t nvals = 0;     // data values in this page
+  int64_t run_base = 0;  // first run slot in the shared output arrays
+  int64_t nruns = 0;     // runs written
+  int64_t out_base = 0;  // page body base in out_bytes
+  int ok = 1;            // 0 = bail the chunk
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns total run count (>= 0), or a bail code: -1 malformed, -2 page
+// shape outside the fused fast path (caller falls back to the Python
+// planner), -3 insufficient capacity.  out_info = {nvals_total, bytes_used}.
+// `pages` rows use the pq_scan_page_headers layout (PG_* columns).
+int64_t pq_dict_chunk_scan(const uint8_t* chunk, int64_t chunk_len,
+                           const int64_t* pages, int64_t n_pages,
+                           int32_t codec, int32_t max_def, int32_t max_rep,
+                           uint8_t* out_bytes, int64_t out_cap,
+                           int64_t* ends, uint8_t* kinds, int64_t* payloads,
+                           int64_t* boffs, int32_t* widths, int64_t run_cap,
+                           int64_t* out_info, int32_t nthreads) {
+  if (max_rep > 0) return -2;
+  if (codec != 0 && codec != 1 && codec != 6) return -2;
+  std::vector<DictPageScan> ps((size_t)n_pages);
+  // layout pass: per-page output/run bases so the parallel phase is
+  // write-disjoint. Run capacity per page = nvals + 1 (every run covers >= 1
+  // of the page's values, +1 for the width-0 synthetic run).
+  int64_t bytes_total = 0, runs_total_cap = 0, nvals_total = 0;
+  for (int64_t i = 0; i < n_pages; ++i) {
+    const int64_t* row = pages + i * PG_NFIELDS;
+    const int64_t pt = row[PG_TYPE];
+    DictPageScan& s = ps[(size_t)i];
+    if (pt != 0 && pt != 3) continue;  // dict page handled by caller
+    const int64_t enc = row[PG_ENC];
+    if (enc != 2 && enc != 8) return -2;  // not PLAIN_/RLE_DICTIONARY
+    if (pt == 0 && max_def > 0 && row[PG_DEF_ENC] != 3) return -2;  // legacy
+    if (pt == 3 && max_def > 0 && row[PG_NNULLS] != 0) return -2;
+    s.nvals = row[PG_NVALS];
+    if (s.nvals < 0) return -1;
+    s.out_base = bytes_total;
+    s.run_base = runs_total_cap;
+    int64_t body_uncomp = row[PG_UNCOMP];
+    if (pt == 3) {
+      const int64_t rl = row[PG_RL_BYTES] < 0 ? 0 : row[PG_RL_BYTES];
+      const int64_t dl = row[PG_DL_BYTES] < 0 ? 0 : row[PG_DL_BYTES];
+      body_uncomp -= rl + dl;
+    }
+    if (body_uncomp < 0) return -1;
+    bytes_total += body_uncomp;
+    runs_total_cap += s.nvals + 1;
+    nvals_total += s.nvals;
+  }
+  if (bytes_total > out_cap || runs_total_cap > run_cap) return -3;
+
+  auto scan_page = [&](int64_t i) {
+    const int64_t* row = pages + i * PG_NFIELDS;
+    const int64_t pt = row[PG_TYPE];
+    DictPageScan& s = ps[(size_t)i];
+    if (pt != 0 && pt != 3) return;
+    const int64_t dpos = row[PG_DATA_POS];
+    const int64_t clen = row[PG_COMP];
+    if (dpos < 0 || clen < 0 || dpos + clen > chunk_len) { s.ok = 0; return; }
+    const uint8_t* payload = chunk + dpos;
+    uint8_t* body = out_bytes + s.out_base;
+    int64_t body_len;
+    int64_t pos = 0;  // index-section start within body
+    if (pt == 0) {
+      body_len = row[PG_UNCOMP];
+      if (!page_decompress(codec, payload, clen, body, body_len)) {
+        s.ok = 0;
+        return;
+      }
+      if (max_def > 0) {
+        if (pos + 4 > body_len) { s.ok = 0; return; }
+        uint32_t dl;
+        std::memcpy(&dl, body + pos, 4);
+        if (pos + 4 + (int64_t)dl > body_len) { s.ok = 0; return; }
+        if (!def_stream_all_present(body + pos + 4, dl, s.nvals, max_def)) {
+          s.ok = 0;
+          return;
+        }
+        pos += 4 + dl;
+      }
+    } else {  // v2: levels sit uncompressed ahead of the body
+      const int64_t rl = row[PG_RL_BYTES] < 0 ? 0 : row[PG_RL_BYTES];
+      const int64_t dl = row[PG_DL_BYTES] < 0 ? 0 : row[PG_DL_BYTES];
+      if (rl + dl > clen) { s.ok = 0; return; }
+      body_len = row[PG_UNCOMP] - rl - dl;
+      if (row[PG_IS_COMPRESSED] == 0) {
+        if (!page_decompress(0, payload + rl + dl, clen - rl - dl, body,
+                             body_len)) { s.ok = 0; return; }
+      } else {
+        if (!page_decompress(codec, payload + rl + dl, clen - rl - dl, body,
+                             body_len)) { s.ok = 0; return; }
+      }
+    }
+    if (s.nvals == 0) { s.nruns = 0; return; }
+    if (pos >= body_len) { s.ok = 0; return; }
+    const int w = body[pos];
+    ++pos;
+    uint8_t* pk = kinds + s.run_base;
+    int64_t* pp = payloads + s.run_base;
+    int64_t* pb = boffs + s.run_base;
+    int32_t* pw = widths + s.run_base;
+    int64_t* pe = ends + s.run_base;  // holds per-run COUNTS until merge
+    if (w == 0) {  // single-entry dictionary: one synthetic RLE run
+      pk[0] = 0;
+      pp[0] = 0;
+      pb[0] = s.out_base;
+      pw[0] = 1;
+      pe[0] = s.nvals;
+      s.nruns = 1;
+      return;
+    }
+    if (w > 32) { s.ok = 0; return; }
+    int64_t k = pq_scan_rle_runs(body + pos, body_len - pos, s.nvals, w, pk,
+                                 pe, pp, pb);
+    if (k < 0 || k > s.nvals + 1) { s.ok = 0; return; }
+    for (int64_t r = 0; r < k; ++r) {
+      pb[r] += s.out_base + pos;  // relative -> absolute in out_bytes
+      pw[r] = w;
+    }
+    s.nruns = k;
+  };
+
+  int T = nthreads;
+  if (T < 1) T = 1;
+  if (T > 16) T = 16;
+  if ((int64_t)T > n_pages) T = (int)n_pages ? (int)n_pages : 1;
+  if (T <= 1) {
+    for (int64_t i = 0; i < n_pages; ++i) scan_page(i);
+  } else {
+    std::vector<std::thread> threads;
+    std::atomic<int64_t> next{0};
+    auto worker = [&] {
+      int64_t i;
+      while ((i = next.fetch_add(1)) < n_pages) scan_page(i);
+    };
+    for (int t = 1; t < T; ++t) threads.emplace_back(worker);
+    worker();
+    for (auto& th : threads) th.join();
+  }
+  for (int64_t i = 0; i < n_pages; ++i)
+    if (!ps[(size_t)i].ok) return -2;
+
+  // merge: compact the per-page run slices down to a contiguous table and
+  // turn per-run counts into cumulative ends.
+  int64_t nruns = 0, total = 0;
+  for (int64_t i = 0; i < n_pages; ++i) {
+    const DictPageScan& s = ps[(size_t)i];
+    if (!s.nruns) continue;
+    if (nruns != s.run_base) {
+      std::memmove(kinds + nruns, kinds + s.run_base, (size_t)s.nruns);
+      std::memmove(payloads + nruns, payloads + s.run_base,
+                   (size_t)s.nruns * 8);
+      std::memmove(boffs + nruns, boffs + s.run_base, (size_t)s.nruns * 8);
+      std::memmove(widths + nruns, widths + s.run_base, (size_t)s.nruns * 4);
+      std::memmove(ends + nruns, ends + s.run_base, (size_t)s.nruns * 8);
+    }
+    for (int64_t r = 0; r < s.nruns; ++r) {
+      total += ends[nruns + r];
+      ends[nruns + r] = total;
+    }
+    nruns += s.nruns;
+  }
+  if (total != nvals_total) return -1;
+  out_info[0] = nvals_total;
+  out_info[1] = bytes_total;
+  return nruns;
+}
 
 }  // extern "C"

@@ -19,15 +19,15 @@ the jnp twin 2-4x (w=1: 73ms vs 283ms; w=8: 67ms vs 167ms; w=16: 67ms vs
 145ms), so it is the default TPU route for w ≤ 16 (device_reader._use_pallas).
 KNOWN MOSAIC BUG: for w ≥ 17 the compiled shift-formulation kernel
 deterministically corrupts the word-straddling columns whose shift is 16
-(sparse wrong values; the jnp twin is correct at every width) — the router
-pins wide streams to jnp.  Minimized standalone repro:
-``scripts/mosaic_repro.py`` (run it on a real chip; interpret mode is
-correct everywhere).  The suspected-bad pattern is ``(lo >> 16) |
-(hi << 16)``; :func:`unpack_bits_dense` therefore reformulates the
-straddle as a MULTIPLY (``hi * 2**(32-sh)``) for w ≥ 17 — semantically
-identical, and a candidate dodge for the vector lowering bug.  The mul
-variant is opt-in on-chip via ``PARQUET_TPU_PALLAS=mul`` until a chip
-trial proves it (device_reader._use_pallas).
+(sparse wrong values; the jnp twin is correct at every width).  Minimized
+standalone repro: ``scripts/mosaic_repro.py``; on-chip confirmation
+2026-07-31 (``MOSAIC_REPRO_ONCHIP.json``): shift FAILS at w=17/20/24/31,
+always and only at the shift-16 lanes.  The bad pattern is ``(lo >> 16) |
+(hi << 16)``; :func:`unpack_bits_dense` reformulates the straddle as a
+MULTIPLY (``hi * 2**(32-sh)``) for w ≥ 17 — semantically identical, and
+the same trial proved it EXACT on-chip at w ∈ {16, 17, 20, 24, 31} (plus
+w = 27 in an 8M-value production-kernel run), so the router now takes the
+Pallas kernel at all widths on TPU (device_reader._use_pallas).
 """
 
 from __future__ import annotations

@@ -270,6 +270,11 @@ def scan_rle_runs(data, num_values: int, bit_width: int, pos: int = 0):
     remaining = num_values
     while remaining > 0:
         header, pos = read_uvarint(data, pos)
+        if (header >> 1) == 0:
+            # zero-count run: covers no values, never decrements remaining —
+            # a crafted stream of them loops forever / grows the run table
+            # without bound (C++ scanner rejects identically)
+            raise ValueError("malformed RLE hybrid stream: zero-count run")
         if header & 1:
             ngroups = header >> 1
             count = ngroups * 8

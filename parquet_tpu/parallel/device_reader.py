@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..format import metadata as md
-from ..format.enums import Encoding, PageType, Type
+from ..format.enums import CompressionCodec, Encoding, PageType, Type
 from ..io.column import Column
 from ..io.reader import ColumnChunkReader, CorruptedError, decode_chunk_host, _bit_width
 from ..ops import device as dev, levels as levels_ops, ref
@@ -389,12 +389,94 @@ def _single_rle_run(body, n: int, w: int):
     return value, i + vbytes
 
 
+def _fused_dict_plan(reader: ColumnChunkReader) -> Optional[_Plan]:
+    """One-native-call planner for the host dict route: whole-chunk
+    decompress + all-present level check + index-run scan fused in C++
+    (native.dict_chunk_scan).  Returns None whenever the chunk needs the
+    general per-page planner — nulls, rep levels, PLAIN-fallback pages,
+    codecs outside UNCOMPRESSED/SNAPPY/ZSTD, registry-shadowed encodings,
+    or no native lib — and the caller falls through to the Python loop."""
+    from ..ops.encodings import is_builtin_decode
+
+    leaf = reader.leaf
+    meta = reader.meta
+    if leaf.max_repetition_level != 0:
+        return None
+    if _dict_run_route() != "host":
+        return None
+    codec_id = int(meta.codec)
+    if codec_id not in (int(CompressionCodec.UNCOMPRESSED),
+                        int(CompressionCodec.SNAPPY),
+                        int(CompressionCodec.ZSTD)):
+        return None
+    from ..codecs import SnappyCodec, UncompressedCodec, ZstdCodec
+
+    if type(reader.codec) not in (UncompressedCodec, SnappyCodec, ZstdCodec):
+        # a substituted/subclassed codec (codecs.CODECS is an override
+        # point) must keep decoding through reader.codec, not the raw
+        # libsnappy/libzstd the native pass dlopens
+        return None
+    encs = set(meta.encodings or ())
+    if not ({int(Encoding.RLE_DICTIONARY), int(Encoding.PLAIN_DICTIONARY)}
+            & encs):
+        return None
+    if not (is_builtin_decode(Encoding.RLE_DICTIONARY)
+            and is_builtin_decode(Encoding.PLAIN_DICTIONARY)):
+        return None
+    start, size = reader.byte_range
+    raw = reader.file.source.pread_view(start, size)
+    rows = native.scan_page_headers(raw, meta.num_values)
+    if rows is None:
+        return None
+    res = native.dict_chunk_scan(raw, rows, codec_id,
+                                 leaf.max_definition_level,
+                                 leaf.max_repetition_level)
+    if res is None:
+        return None
+    ends, kinds, payloads, bit_offs, widths, nvals, body = res
+    physical = Type(meta.type)
+    plan = _Plan()
+    plan.leaf = leaf
+    plan.physical = physical
+    plan.set_kind("dict")
+    plan.dict_route = "host"
+    plan.dense_ok = False
+    # dictionary page decode stays in Python (one small page)
+    for row in rows:
+        if row[native.PG_TYPE] == PageType.DICTIONARY_PAGE:
+            rawv = raw if isinstance(raw, np.ndarray) else np.frombuffer(
+                raw, np.uint8)
+            payload = rawv[row[native.PG_DATA_POS]:
+                           row[native.PG_DATA_POS] + row[native.PG_COMP]]
+            dbody = reader.codec.decode(payload, int(row[native.PG_UNCOMP]))
+            plan.dictionary_host = ref.decode_plain(
+                np.frombuffer(dbody, np.uint8),
+                int(row[native.PG_DICT_NVALS]), physical, leaf.type_length)
+            break
+    v = plan.vruns
+    v.ends.append(ends)
+    v.kinds.append(kinds)
+    v.payloads.append(payloads)
+    v.bit_offsets.append(bit_offs)
+    v.widths.append(widths)
+    v.total = nvals
+    plan.values.extend(body)
+    plan.total_slots = nvals   # all-present proven by the native scan
+    plan.total_values = nvals
+    counters.inc("fused_dict_plans")
+    return plan
+
+
 def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
     """Host prescan of a chunk's pages into a staging plan.
 
     ``pages`` (an iterator of PageInfo, e.g. from io/search.seek_pages)
     restricts the plan to a page subset — the pushdown scan path; the
     dictionary page must be included when the chunk is dict-encoded."""
+    if pages is None:
+        fused = _fused_dict_plan(reader)
+        if fused is not None:
+            return fused
     leaf = reader.leaf
     codec = reader.codec
     physical = Type(reader.meta.type)
@@ -473,12 +555,12 @@ def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
 
 def _dense_mode() -> str:
     """Routing for single-width dense streams: 'auto' (default — the Pallas
-    VMEM-tiled kernel on TPU for widths ≤ 16, the jnp twin elsewhere),
-    'pallas'/'jnp' to force a path, 'off' (round-1 per-value gather path),
-    or 'mul' — like auto but ALSO routes w ≥ 17 through the Pallas kernel's
-    multiply-straddle variant (the Mosaic-miscompile dodge; opt-in until a
-    chip trial proves it — scripts/mosaic_repro.py).
-    PARQUET_TPU_PALLAS=1 → pallas, =0 → jnp, =off → off, =mul → mul."""
+    VMEM-tiled kernel on TPU at every width, the jnp twin elsewhere),
+    'pallas'/'jnp' to force a path, 'off' (round-1 per-value gather path).
+    'mul' is accepted for compatibility and equals 'auto' (the multiply-
+    straddle it used to opt into passed its on-chip trial and is now the
+    built-in w ≥ 17 formulation — scripts/mosaic_repro.py).
+    PARQUET_TPU_PALLAS=1 → pallas, =0 → jnp, =off → off."""
     import os
 
     v = os.environ.get("PARQUET_TPU_PALLAS", "")
@@ -545,23 +627,25 @@ def _use_pallas(w: int) -> bool:
     """Whether the dense unpack of a ``w``-bit stream runs the Pallas kernel.
 
     Measured on the real v5e (round 2): Pallas wins 2-4x over the jnp twin
-    for w ≤ 16 (8M values: ~67ms vs 140-280ms), but Mosaic DETERMINISTICALLY
+    for w ≤ 16 (8M values: ~67ms vs 140-280ms).  Mosaic DETERMINISTICALLY
     MISCOMPILES the word-straddling columns for w ≥ 17 in the shift
-    formulation (sparse wrong values at shift-16 lanes; the jnp twin is
-    correct at every width; minimized repro: scripts/mosaic_repro.py) — so
-    wide streams take the jnp path unless PARQUET_TPU_PALLAS=mul opts into
-    the multiply-straddle variant, which is semantically proven (interpret
-    tests) but awaiting an on-chip trial."""
+    formulation (sparse wrong values at shift-16 lanes; minimized repro:
+    scripts/mosaic_repro.py), so unpack_bits_dense uses the equivalent
+    multiply-straddle for those widths — proven exact on-chip at
+    w ∈ {17, 20, 24, 27, 31} with 8M-value streams (2026-07-31 trial,
+    MOSAIC_REPRO_ONCHIP.json) and since then the default TPU route at
+    every width."""
     if _pallas_broken:
         return False
     mode = _dense_mode()
-    if w > 16:
-        # unpack_bits_dense auto-selects the mul straddle for w ≥ 17, but
-        # the route itself stays opt-in until the chip trial passes
-        return mode == "mul"
     if mode == "pallas":
         return True  # forced (interpret mode covers non-TPU backends)
-    # 'mul' behaves like auto below the wide widths
+    # w ≥ 17: unpack_bits_dense auto-selects the multiply-straddle variant,
+    # proven correct on a real v5e at w=17..31 (2026-07-31 chip trial,
+    # MOSAIC_REPRO_ONCHIP.json: shift variant corrupts deterministic lanes,
+    # mul variant exact at every width) — so wide widths now route through
+    # Pallas by default on TPU like the narrow ones. 'mul' is kept as an
+    # accepted value for compatibility and behaves like 'auto'.
     return mode in ("auto", "mul") and jax.default_backend() == "tpu"
 
 
@@ -894,8 +978,8 @@ def _delta_decode_multi(buf, n, page_ends, firsts, mb_base, mb_offs, mb_widths,
 
 
 @partial(jax.jit,
-         static_argnames=("n", "pages", "width", "pairs", "flba", "dtype4"))
-def _bss_decode_multi(buf, n, pages: tuple, width: int, pairs: bool,
+         static_argnames=("n", "pages", "width", "flba", "dtype4"))
+def _bss_decode_multi(buf, n, pages: tuple, width: int,
                       flba: bool = False, dtype4: str = "float32"):
     """Gather-free BYTE_STREAM_SPLIT: byte plane k of a page is the static
     slice [base + k*count, base + (k+1)*count) — page structure is host
@@ -1521,7 +1605,7 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
             values = _bss_decode_multi(
                 val_dbuf, nvals,
                 tuple((int(b), int(n)) for b, n in plan.bss_pages),
-                w, physical in _IS_PAIR, flba,
+                w, flba,
                 # 4-byte output dtype follows the PHYSICAL type (an INT32
                 # BSS column is not a float32 — bug caught by the
                 # route-equality test)

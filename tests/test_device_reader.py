@@ -289,22 +289,24 @@ def test_device_all_null_chunks(typ_kw):
     assert len(arr) == 1500 and arr.null_count == 1500
 
 
-def test_use_pallas_gate_blocks_wide_widths(monkeypatch):
-    """w >= 17 deterministically miscompiles under Mosaic on the real v5e
-    (sparse wrong values at word-straddling shift-16 lanes, measured round
-    2) — the router must refuse Pallas there even when forced."""
+def test_use_pallas_gate_wide_widths(monkeypatch):
+    """Wide widths are no longer jnp-pinned: the multiply-straddle
+    formulation passed its on-chip trial (MOSAIC_REPRO_ONCHIP.json — shift
+    corrupts w >= 17, mul exact at every width), so forced Pallas admits
+    every width and 'auto' routes on backend alone."""
     from parquet_tpu.parallel import device_reader as dr
 
     monkeypatch.setattr(dr, "_pallas_broken", False)
     monkeypatch.setenv("PARQUET_TPU_PALLAS", "1")
-    assert dr._use_pallas(16)
-    for w in (17, 20, 24, 31, 32):
-        assert not dr._use_pallas(w), w
+    for w in (8, 16, 17, 20, 24, 31, 32):
+        assert dr._use_pallas(w), w
     monkeypatch.setenv("PARQUET_TPU_PALLAS", "0")
     assert not dr._use_pallas(8)
+    assert not dr._use_pallas(20)
     monkeypatch.setenv("PARQUET_TPU_PALLAS", "")
-    # auto: CPU backend in tests -> jnp twin
+    # auto: CPU backend in tests -> jnp twin at every width
     assert not dr._use_pallas(8)
+    assert not dr._use_pallas(20)
 
 
 def test_byte_stream_split_flba_float16_device(rng):

@@ -419,3 +419,75 @@ def test_expand_gather_rejects_oob_index(lib):
     with pytest.raises(ValueError):
         native.expand_gather(np.zeros(16, np.uint8),
                              (ends, kinds, payloads, offs, widths), 10, d)
+
+
+def test_scan_rle_runs_rejects_zero_count_runs(lib):
+    """A zero-count run header covers no values and never decrements the
+    scanner's remaining count — a crafted stream of them must fail fast
+    (bounded run table), not loop/overflow.  Both the C++ scanner and the
+    Python oracle reject identically."""
+    # uvarint 0x00 = RLE run with count 0, followed by its 1 payload byte
+    stream = np.frombuffer(b"\x00\x01" * 64, np.uint8)
+    with pytest.raises(ValueError):
+        native.scan_rle_runs(stream, 8, 3)
+    with pytest.raises(ValueError):
+        ref.scan_rle_runs(bytes(stream), 8, 3, 0)
+    # zero-group bit-packed header (uvarint 0x01) is equally malformed
+    stream2 = np.frombuffer(b"\x01" * 64, np.uint8)
+    with pytest.raises(ValueError):
+        native.scan_rle_runs(stream2, 8, 3)
+
+
+def test_dict_chunk_scan_matches_per_page_planner(lib, rng):
+    """The fused whole-chunk dict scan (one native call: decompress +
+    all-present level check + index-run scan) must produce a plan whose
+    decode equals the per-page Python planner's for the same chunk."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.format.enums import Type
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.parallel import device_reader as dr
+
+    n = 40_000
+    vals = rng.integers(0, 500, n)
+    for comp, pv in (("snappy", "1.0"), ("zstd", "2.4"), ("none", "1.0")):
+        t = pa.table({"k": pa.array(vals)})
+        buf = io.BytesIO()
+        pq.write_table(t, buf, compression=comp, use_dictionary=True,
+                       data_page_size=4096, version=pv)
+        chunk = ParquetFile(buf.getvalue()).row_group(0).column(0)
+        fused = dr._fused_dict_plan(chunk)
+        assert fused is not None, comp
+        staged = dr.stage_plan(fused)
+        col = dr.decode_staged(chunk.leaf, Type(chunk.meta.type), fused,
+                               staged)
+        got = np.asarray(col.values)
+        if got.dtype == np.uint32:
+            got = got.view(np.int64).reshape(-1)
+        np.testing.assert_array_equal(got, vals)
+
+
+def test_dict_chunk_scan_bails_to_python_on_nulls(lib, rng):
+    """Pages with real nulls are outside the fused fast path: the native
+    scan must bail (return None) and the general planner must handle the
+    chunk — not silently mis-handle validity."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.parallel import device_reader as dr
+
+    n = 10_000
+    vals = [None if i % 7 == 0 else int(i % 50) for i in range(n)]
+    t = pa.table({"k": pa.array(vals, type=pa.int64())})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression="snappy", use_dictionary=True)
+    chunk = ParquetFile(buf.getvalue()).row_group(0).column(0)
+    assert dr._fused_dict_plan(chunk) is None
+    plan = dr.build_plan(chunk)  # falls through to the per-page loop
+    assert plan.total_values < plan.total_slots

@@ -73,19 +73,18 @@ def test_unpack_wide_straddle_variants(w, straddle, rng):
 
 
 def test_wide_width_routing(monkeypatch):
-    """w >= 17 stays jnp-pinned by default; PARQUET_TPU_PALLAS=mul opts the
-    wide widths into the Pallas multiply-straddle route."""
+    """Wide widths route like narrow ones now that the multiply-straddle
+    passed its on-chip trial; 'mul' remains accepted and equals 'auto'."""
     from parquet_tpu.parallel import device_reader as dr
-
-    monkeypatch.setattr(dr, "_pallas_broken", False)
-    monkeypatch.delenv("PARQUET_TPU_PALLAS", raising=False)
-    assert dr._use_pallas(20) is False
-    monkeypatch.setenv("PARQUET_TPU_PALLAS", "pallas")
-    assert dr._use_pallas(20) is False  # even forced, shift route refused
-    assert dr._use_pallas(8) is True
-    monkeypatch.setenv("PARQUET_TPU_PALLAS", "mul")
-    assert dr._use_pallas(20) is True   # explicit opt-in trial route
-    # below the wide widths 'mul' behaves like auto (Pallas only on TPU)
     import jax
 
-    assert dr._use_pallas(8) is (jax.default_backend() == "tpu")
+    on_tpu = jax.default_backend() == "tpu"
+    monkeypatch.setattr(dr, "_pallas_broken", False)
+    monkeypatch.delenv("PARQUET_TPU_PALLAS", raising=False)
+    assert dr._use_pallas(20) is on_tpu
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "pallas")
+    assert dr._use_pallas(20) is True
+    assert dr._use_pallas(8) is True
+    monkeypatch.setenv("PARQUET_TPU_PALLAS", "mul")
+    assert dr._use_pallas(20) is on_tpu  # compat alias for 'auto'
+    assert dr._use_pallas(8) is on_tpu
