@@ -1089,7 +1089,10 @@ def columns_from_arrow(table, schema: Schema) -> Dict[str, ColumnData]:
     for leaf in schema.leaves:
         arr = table[leaf.path[0]]
         if isinstance(arr, pa.ChunkedArray):
-            arr = arr.combine_chunks()
+            # a single-chunk column (the common write_table slice) is a
+            # zero-copy view; combine_chunks would memcpy the whole slice
+            arr = (arr.chunk(0) if arr.num_chunks == 1
+                   else arr.combine_chunks())
         cd = _column_from_arrow(arr, leaf)
         if (len(leaf.path) > 1 and leaf.max_repetition_level == 0
                 and cd.def_levels is None

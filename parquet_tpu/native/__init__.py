@@ -126,6 +126,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_scan_page_headers.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             _i64p_w]
+        lib.pq_count_target_in_runs.restype = ctypes.c_int64
+        lib.pq_count_target_in_runs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, _i64p, _i64p,
+            _i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64]
         lib.pq_dict_chunk_scan.restype = ctypes.c_int64
         lib.pq_dict_chunk_scan.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_int64,
@@ -556,6 +560,23 @@ def scan_page_headers(buf, total_values: int):
         if k < 0:
             return None
         return out[:k]
+
+
+def count_target_in_runs(body: np.ndarray, kinds, cnts, payloads, offs,
+                         width: int, target: int):
+    """Count run-table values equal to ``target`` (def == max_def present
+    count) in one native pass, or None without the lib."""
+    lib = get_lib()
+    if lib is None or width <= 0 or width > 32:
+        return None
+    body = np.ascontiguousarray(body)
+    kinds = np.ascontiguousarray(kinds, np.uint8)
+    n = lib.pq_count_target_in_runs(
+        body.ctypes.data if len(body) else None, len(body),
+        kinds.ctypes.data, np.ascontiguousarray(cnts, np.int64),
+        np.ascontiguousarray(payloads, np.int64),
+        np.ascontiguousarray(offs, np.int64), len(kinds), width, target)
+    return None if n < 0 else int(n)
 
 
 def dict_chunk_scan(buf, pages_rows: np.ndarray, codec_id: int,

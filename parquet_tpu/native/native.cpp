@@ -1393,6 +1393,38 @@ void pq_dict_first_occurrence(const int64_t* indices, int64_t n,
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
+// Count level values equal to `target` across a scanned run table (the
+// per-page present-count of build_plan: def == max_def).  RLE runs are a
+// compare on the payload; bit-packed runs walk the packed bits once.  The
+// numpy twin (_count_target_in_runs' gather_bits) was half of config-4's
+// host phase at 64 MB.
+// ---------------------------------------------------------------------------
+extern "C" int64_t pq_count_target_in_runs(
+    const uint8_t* body, int64_t body_len, const uint8_t* kinds,
+    const int64_t* cnts, const int64_t* payloads, const int64_t* offs,
+    int64_t k, int32_t width, int64_t target) {
+  if (width <= 0 || width > 32) return -1;
+  const uint64_t mask = (width >= 64) ? ~0ull : ((1ull << width) - 1);
+  if ((uint64_t)target > mask) return 0;
+  int64_t total = 0;
+  for (int64_t r = 0; r < k; ++r) {
+    if (kinds[r] == 0) {
+      if (payloads[r] == target) total += cnts[r];
+      continue;
+    }
+    const int64_t n = cnts[r];
+    int64_t bit = offs[r] * 8;
+    for (int64_t i = 0; i < n; ++i, bit += width) {
+      const int64_t byte0 = bit >> 3;
+      const int sh = (int)(bit & 7);
+      uint64_t v = load8_clamped(body, body_len, byte0) >> sh;
+      if ((v & mask) == (uint64_t)target) ++total;
+    }
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
 // Fused whole-chunk dictionary-index scan (SURVEY.md §3.1 hot path): one
 // native call replaces the per-page Python loop of build_plan for the host
 // dict route — per page: decompress (snappy/zstd via dlopen'd system libs,
@@ -1561,50 +1593,44 @@ int64_t pq_dict_chunk_scan(const uint8_t* chunk, int64_t chunk_len,
   }
   if (bytes_total > out_cap || runs_total_cap > run_cap) return -3;
 
-  auto scan_page = [&](int64_t i) {
+  std::atomic<bool> bail{false};
+  auto scan_page_impl = [&](int64_t i) -> bool {
     const int64_t* row = pages + i * PG_NFIELDS;
     const int64_t pt = row[PG_TYPE];
     DictPageScan& s = ps[(size_t)i];
-    if (pt != 0 && pt != 3) return;
+    if (pt != 0 && pt != 3) return true;  // dict page handled by caller
     const int64_t dpos = row[PG_DATA_POS];
     const int64_t clen = row[PG_COMP];
-    if (dpos < 0 || clen < 0 || dpos + clen > chunk_len) { s.ok = 0; return; }
+    if (dpos < 0 || clen < 0 || dpos + clen > chunk_len) return false;
     const uint8_t* payload = chunk + dpos;
     uint8_t* body = out_bytes + s.out_base;
     int64_t body_len;
     int64_t pos = 0;  // index-section start within body
     if (pt == 0) {
       body_len = row[PG_UNCOMP];
-      if (!page_decompress(codec, payload, clen, body, body_len)) {
-        s.ok = 0;
-        return;
-      }
+      if (!page_decompress(codec, payload, clen, body, body_len))
+        return false;
       if (max_def > 0) {
-        if (pos + 4 > body_len) { s.ok = 0; return; }
+        if (pos + 4 > body_len) return false;
         uint32_t dl;
         std::memcpy(&dl, body + pos, 4);
-        if (pos + 4 + (int64_t)dl > body_len) { s.ok = 0; return; }
-        if (!def_stream_all_present(body + pos + 4, dl, s.nvals, max_def)) {
-          s.ok = 0;
-          return;
-        }
+        if (pos + 4 + (int64_t)dl > body_len) return false;
+        if (!def_stream_all_present(body + pos + 4, dl, s.nvals, max_def))
+          return false;
         pos += 4 + dl;
       }
     } else {  // v2: levels sit uncompressed ahead of the body
       const int64_t rl = row[PG_RL_BYTES] < 0 ? 0 : row[PG_RL_BYTES];
       const int64_t dl = row[PG_DL_BYTES] < 0 ? 0 : row[PG_DL_BYTES];
-      if (rl + dl > clen) { s.ok = 0; return; }
+      if (rl + dl > clen) return false;
       body_len = row[PG_UNCOMP] - rl - dl;
-      if (row[PG_IS_COMPRESSED] == 0) {
-        if (!page_decompress(0, payload + rl + dl, clen - rl - dl, body,
-                             body_len)) { s.ok = 0; return; }
-      } else {
-        if (!page_decompress(codec, payload + rl + dl, clen - rl - dl, body,
-                             body_len)) { s.ok = 0; return; }
-      }
+      const int page_codec = row[PG_IS_COMPRESSED] == 0 ? 0 : codec;
+      if (!page_decompress(page_codec, payload + rl + dl, clen - rl - dl,
+                           body, body_len))
+        return false;
     }
-    if (s.nvals == 0) { s.nruns = 0; return; }
-    if (pos >= body_len) { s.ok = 0; return; }
+    if (s.nvals == 0) { s.nruns = 0; return true; }
+    if (pos >= body_len) return false;
     const int w = body[pos];
     ++pos;
     uint8_t* pk = kinds + s.run_base;
@@ -1619,17 +1645,27 @@ int64_t pq_dict_chunk_scan(const uint8_t* chunk, int64_t chunk_len,
       pw[0] = 1;
       pe[0] = s.nvals;
       s.nruns = 1;
-      return;
+      return true;
     }
-    if (w > 32) { s.ok = 0; return; }
+    if (w > 32) return false;
     int64_t k = pq_scan_rle_runs(body + pos, body_len - pos, s.nvals, w, pk,
                                  pe, pp, pb);
-    if (k < 0 || k > s.nvals + 1) { s.ok = 0; return; }
+    if (k < 0 || k > s.nvals + 1) return false;
     for (int64_t r = 0; r < k; ++r) {
       pb[r] += s.out_base + pos;  // relative -> absolute in out_bytes
       pw[r] = w;
     }
     s.nruns = k;
+    return true;
+  };
+  // a single failed page bails the WHOLE chunk to the Python planner, so
+  // stop decompressing remaining pages as soon as any worker fails
+  auto scan_page = [&](int64_t i) {
+    if (bail.load(std::memory_order_relaxed)) return;
+    if (!scan_page_impl(i)) {
+      ps[(size_t)i].ok = 0;
+      bail.store(true, std::memory_order_relaxed);
+    }
   };
 
   int T = nthreads;

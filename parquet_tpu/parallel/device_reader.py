@@ -227,11 +227,17 @@ def _gather_bits(body: np.ndarray, bitpos: np.ndarray, widths) -> np.ndarray:
 
 
 def _count_target_in_runs(kinds, cnts, payloads, offs, body, width, target) -> int:
-    """How many level values equal ``target`` (host, vectorized)."""
+    """How many level values equal ``target`` (native pass, else vectorized
+    numpy — the per-page present count was half of config-4's host phase)."""
     kinds = np.asarray(kinds)
     cnts = np.asarray(cnts, np.int64)
     payloads = np.asarray(payloads, np.int64)
     offs = np.asarray(offs, np.int64)
+    fast = native.count_target_in_runs(
+        body if isinstance(body, np.ndarray) else np.frombuffer(body, np.uint8),
+        kinds, cnts, payloads, offs, width, target)
+    if fast is not None:
+        return fast
     total = int(cnts[(kinds == 0) & (payloads == target)].sum())
     packed = np.flatnonzero(kinds != 0)
     if not len(packed):
@@ -389,50 +395,52 @@ def _single_rle_run(body, n: int, w: int):
     return value, i + vbytes
 
 
-def _fused_dict_plan(reader: ColumnChunkReader) -> Optional[_Plan]:
+def _fused_dict_plan(reader: ColumnChunkReader):
     """One-native-call planner for the host dict route: whole-chunk
     decompress + all-present level check + index-run scan fused in C++
-    (native.dict_chunk_scan).  Returns None whenever the chunk needs the
-    general per-page planner — nulls, rep levels, PLAIN-fallback pages,
-    codecs outside UNCOMPRESSED/SNAPPY/ZSTD, registry-shadowed encodings,
-    or no native lib — and the caller falls through to the Python loop."""
+    (native.dict_chunk_scan).  Returns ``(plan, raw)`` on success and
+    ``(None, raw_or_None)`` whenever the chunk needs the general per-page
+    planner — nulls, rep levels, PLAIN-fallback pages, codecs outside
+    UNCOMPRESSED/SNAPPY/ZSTD, registry-shadowed encodings, or no native
+    lib; ``raw`` hands the already-read chunk buffer to the fallback so
+    the bail path doesn't pread the chunk twice."""
     from ..ops.encodings import is_builtin_decode
 
     leaf = reader.leaf
     meta = reader.meta
     if leaf.max_repetition_level != 0:
-        return None
+        return None, None
     if _dict_run_route() != "host":
-        return None
+        return None, None
     codec_id = int(meta.codec)
     if codec_id not in (int(CompressionCodec.UNCOMPRESSED),
                         int(CompressionCodec.SNAPPY),
                         int(CompressionCodec.ZSTD)):
-        return None
+        return None, None
     from ..codecs import SnappyCodec, UncompressedCodec, ZstdCodec
 
     if type(reader.codec) not in (UncompressedCodec, SnappyCodec, ZstdCodec):
         # a substituted/subclassed codec (codecs.CODECS is an override
         # point) must keep decoding through reader.codec, not the raw
         # libsnappy/libzstd the native pass dlopens
-        return None
+        return None, None
     encs = set(meta.encodings or ())
     if not ({int(Encoding.RLE_DICTIONARY), int(Encoding.PLAIN_DICTIONARY)}
             & encs):
-        return None
+        return None, None
     if not (is_builtin_decode(Encoding.RLE_DICTIONARY)
             and is_builtin_decode(Encoding.PLAIN_DICTIONARY)):
-        return None
+        return None, None
     start, size = reader.byte_range
     raw = reader.file.source.pread_view(start, size)
     rows = native.scan_page_headers(raw, meta.num_values)
     if rows is None:
-        return None
+        return None, raw
     res = native.dict_chunk_scan(raw, rows, codec_id,
                                  leaf.max_definition_level,
                                  leaf.max_repetition_level)
     if res is None:
-        return None
+        return None, raw
     ends, kinds, payloads, bit_offs, widths, nvals, body = res
     physical = Type(meta.type)
     plan = _Plan()
@@ -464,7 +472,7 @@ def _fused_dict_plan(reader: ColumnChunkReader) -> Optional[_Plan]:
     plan.total_slots = nvals   # all-present proven by the native scan
     plan.total_values = nvals
     counters.inc("fused_dict_plans")
-    return plan
+    return plan, raw
 
 
 def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
@@ -473,8 +481,9 @@ def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
     ``pages`` (an iterator of PageInfo, e.g. from io/search.seek_pages)
     restricts the plan to a page subset — the pushdown scan path; the
     dictionary page must be included when the chunk is dict-encoded."""
+    chunk_raw = None
     if pages is None:
-        fused = _fused_dict_plan(reader)
+        fused, chunk_raw = _fused_dict_plan(reader)
         if fused is not None:
             return fused
     leaf = reader.leaf
@@ -486,7 +495,7 @@ def build_plan(reader: ColumnChunkReader, pages=None) -> _Plan:
     plan.leaf = leaf
     plan.physical = physical
 
-    for page in (reader.pages() if pages is None else pages):
+    for page in (reader.pages(raw=chunk_raw) if pages is None else pages):
         h = page.header
         pt = page.page_type
         if pt == PageType.DICTIONARY_PAGE:

@@ -1,0 +1,184 @@
+"""Targeted on-chip trials that the main bench does not cover.
+
+Run on a live TPU (the axon tunnel must be up — probe with a subprocess
+timeout first, bench.py:_probe_tpu style).  Writes two artifacts at the
+repo root:
+
+- ``MOSAIC_REPRO_ONCHIP.json`` (extended): the production
+  ``unpack_bits_dense`` kernel checked against the numpy oracle at EVERY
+  width 17..32 (the multiply-straddle route the router now defaults to on
+  TPU — device_reader._use_pallas), plus per-width Pallas-vs-jnp timing.
+- ``DEVICE_ASM_ONCHIP.json``: the any-depth device nested assembler
+  (ops/device.assemble_nested) vs the host C++ assembler on the config-4
+  list shape — equality + kernel time (ROUND_NOTES round-4 item 6 queued
+  this trial; off-chip the host assembler wins ~20x, the question is
+  whether the on-chip compaction closes that).
+
+Usage: python scripts/onchip_trials.py  (exit 0 on success, 1 if any
+equality check fails, 2 if the backend is not a TPU).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def widths_trial(out: dict) -> bool:
+    from parquet_tpu.ops import ref
+    from parquet_tpu.ops.pallas_kernels import (unpack_bits_dense,
+                                                unpack_bits_dense_jnp)
+
+    rng = np.random.default_rng(5)
+    n = 4_000_000
+    res, ok_all = {}, True
+    for w in range(17, 33):
+        vals = rng.integers(0, 1 << w, n, dtype=np.uint64).astype(np.uint32)
+        packed = bytes(ref.pack_bits(vals, w))
+        words = np.frombuffer(packed + b"\0" * (-len(packed) % 4), np.uint32)
+        wd = jax.device_put(words)
+        got = np.asarray(unpack_bits_dense(wd, n, w))
+        ok = bool(np.array_equal(got, vals))
+        ok_all &= ok
+        f1 = jax.jit(lambda x, w=w: unpack_bits_dense(x, n, w))
+        f2 = jax.jit(lambda x, w=w: unpack_bits_dense_jnp(x, n, w))
+        for f in (f1, f2):
+            f(wd).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(3):
+            f1(wd).block_until_ready()
+        t_pl = (time.perf_counter() - t0) / 3
+        t0 = time.perf_counter()
+        for _ in range(3):
+            f2(wd).block_until_ready()
+        t_jnp = (time.perf_counter() - t0) / 3
+        res[w] = {"ok": ok, "pallas_ms": round(t_pl * 1e3, 1),
+                  "jnp_ms": round(t_jnp * 1e3, 1)}
+        print(f"w={w} {'PASS' if ok else 'FAIL'} "
+              f"pallas={t_pl*1e3:.1f}ms jnp={t_jnp*1e3:.1f}ms", flush=True)
+    out["production_kernel_all_widths"] = {
+        "trial": "unpack_bits_dense (mul straddle) vs numpy oracle, "
+                 f"n={n} per width, every width 17..32",
+        "jax": jax.__version__, "date": time.strftime("%Y-%m-%d"),
+        "widths": res, "all_pass": ok_all,
+    }
+    return ok_all
+
+
+def assembler_trial() -> dict:
+    """Config-4 shape: lists of timestamps, ~5% empty, nullable lists."""
+    from parquet_tpu.ops import device as dev
+    from parquet_tpu.ops import levels as levels_ops
+    import io as _io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.parallel import device_reader as dr
+    from parquet_tpu.format.enums import Type
+
+    rng = np.random.default_rng(13)
+    nlists = 2_000_000
+    lens = rng.integers(0, 8, nlists)
+    lens[rng.random(nlists) < 0.05] = 0
+    total = int(lens.sum())
+    offs = np.zeros(nlists + 1, np.int32)
+    np.cumsum(lens, out=offs[1:])
+    base = 1_700_000_000_000_000 + np.cumsum(
+        rng.integers(0, 1000, max(total, 1)).astype(np.int64))
+    arr = pa.ListArray.from_arrays(pa.array(offs), pa.array(base[:total]))
+    t = pa.table({"ts": arr})
+    buf = _io.BytesIO()
+    pq.write_table(t, buf, compression="none", use_dictionary=False,
+                   column_encoding={"ts.list.element": "DELTA_BINARY_PACKED"})
+    raw = buf.getvalue()
+
+    chunk = ParquetFile(raw).row_group(0).column(0)
+    plan = dr.build_plan(chunk)
+    leaf = chunk.leaf
+    infos = levels_ops.repeated_ancestors(leaf)
+    lev = plan.levels.array()
+    d_host = plan.def_runs.expand_host(lev)
+    r_host = plan.rep_runs.expand_host(lev)
+    d_dev = jax.device_put(d_host)
+    r_dev = jax.device_put(r_host)
+    max_def = leaf.max_definition_level
+
+    def run_dev():
+        res = dev.assemble_nested(d_dev, r_dev, infos, max_def)
+        jax.block_until_ready(res)
+        return res
+
+    got_offs, got_val, got_leaf = run_dev()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        run_dev()
+    dev_s = (time.perf_counter() - t0) / 3
+
+    t0 = time.perf_counter()
+    want = levels_ops.assemble(d_host, r_host, leaf)
+    host_s = time.perf_counter() - t0
+
+    # equality mirror of tests/test_device_kernels.TestAssembleNested
+    eq = len(got_offs) == len(want.list_offsets)
+    for go, wo in zip(got_offs, want.list_offsets):
+        eq &= np.array_equal(np.asarray(go), np.asarray(wo).astype(np.int32))
+    for gv, wv in zip(got_val, want.list_validity):
+        if wv is None:
+            eq &= bool(np.asarray(gv).all())
+        else:
+            eq &= np.array_equal(np.asarray(gv), np.asarray(wv))
+    if want.validity is None:
+        eq &= got_leaf is None or bool(np.asarray(got_leaf).all())
+    else:
+        eq &= np.array_equal(np.asarray(got_leaf), np.asarray(want.validity))
+    return {
+        "trial": "dev.assemble_nested vs host assembler, config-4 shape "
+                 f"({nlists} lists, {total} values)",
+        "equal": eq,
+        "device_kernel_s": round(dev_s, 4),
+        "host_cpp_s": round(host_s, 4),
+        "date": time.strftime("%Y-%m-%d"),
+        "jax": jax.__version__,
+    }
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print("not a TPU backend; refusing to write on-chip artifacts",
+              file=sys.stderr)
+        return 2
+    root = os.path.join(os.path.dirname(__file__), "..")
+    rc = 0
+
+    mosaic_path = os.path.join(root, "MOSAIC_REPRO_ONCHIP.json")
+    try:
+        with open(mosaic_path) as f:
+            mosaic = json.load(f)
+    except OSError:
+        mosaic = {}
+    if not widths_trial(mosaic):
+        rc = 1
+    with open(mosaic_path, "w") as f:
+        json.dump(mosaic, f, indent=1)
+    print("wrote", mosaic_path, flush=True)
+
+    asm = assembler_trial()
+    if not asm["equal"]:
+        rc = 1
+    with open(os.path.join(root, "DEVICE_ASM_ONCHIP.json"), "w") as f:
+        json.dump(asm, f, indent=1)
+    print("wrote DEVICE_ASM_ONCHIP.json:", asm, flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -459,7 +459,7 @@ def test_dict_chunk_scan_matches_per_page_planner(lib, rng):
         pq.write_table(t, buf, compression=comp, use_dictionary=True,
                        data_page_size=4096, version=pv)
         chunk = ParquetFile(buf.getvalue()).row_group(0).column(0)
-        fused = dr._fused_dict_plan(chunk)
+        fused, _raw = dr._fused_dict_plan(chunk)
         assert fused is not None, comp
         staged = dr.stage_plan(fused)
         col = dr.decode_staged(chunk.leaf, Type(chunk.meta.type), fused,
@@ -488,6 +488,8 @@ def test_dict_chunk_scan_bails_to_python_on_nulls(lib, rng):
     buf = io.BytesIO()
     pq.write_table(t, buf, compression="snappy", use_dictionary=True)
     chunk = ParquetFile(buf.getvalue()).row_group(0).column(0)
-    assert dr._fused_dict_plan(chunk) is None
+    fused, raw = dr._fused_dict_plan(chunk)
+    assert fused is None
+    assert raw is not None  # the bail hands the read buffer to the fallback
     plan = dr.build_plan(chunk)  # falls through to the per-page loop
     assert plan.total_values < plan.total_slots
