@@ -647,15 +647,33 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     configs = {}
-    configs["1_int64_plain"] = _cfg1(n_rows)
-    configs["2_int64_dict_snappy"] = _cfg2(n_rows)
-    configs["3_string_dict_zstd"] = _cfg3(n_rows)
-    configs["4_delta_ts_nested"] = _cfg4(n_rows)
-    configs["5_pushdown_scan"] = _cfg5(max(n_rows // 4, 8))
-    configs["6_write_mixed"] = _cfg6(max(n_rows // 4, 8))
+    # BENCH_CHECKPOINT=<path>: persist per-config partial results so a
+    # tunnel death mid-run (observed: a dispatch hung in block_until_ready
+    # with no timeout, r4) still leaves the completed configs on disk for
+    # the on-chip capture queue (scripts/onchip_capture.py).
+    ckpt = os.environ.get("BENCH_CHECKPOINT", "")
+
+    def _run(name, fn, *a):
+        t0 = time.time()
+        configs[name] = fn(*a)
+        print(f"bench: {name} done in {time.time() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+        if ckpt:
+            with open(ckpt + ".tmp", "w") as f:
+                json.dump({"backend": str(jax.devices()[0]),
+                           "tpu_available": tpu_ok, "rows": n_rows,
+                           "partial": True, "configs": configs}, f, indent=1)
+            os.replace(ckpt + ".tmp", ckpt)
+
+    _run("1_int64_plain", _cfg1, n_rows)
+    _run("2_int64_dict_snappy", _cfg2, n_rows)
+    _run("3_string_dict_zstd", _cfg3, n_rows)
+    _run("4_delta_ts_nested", _cfg4, n_rows)
+    _run("5_pushdown_scan", _cfg5, max(n_rows // 4, 8))
+    _run("6_write_mixed", _cfg6, max(n_rows // 4, 8))
     li_rows = int(os.environ.get("BENCH_LINEITEM_ROWS",
                                  120_000 if quick else 40_000_000))
-    configs["7_lineitem_scale"] = _cfg7(li_rows)
+    _run("7_lineitem_scale", _cfg7, li_rows)
 
     head = configs["1_int64_plain"]
     print(json.dumps({

@@ -1,0 +1,215 @@
+"""Standing on-chip bench capture queue (VERDICT r3 task 1).
+
+The axon tunnel to the TPU is flaky: it can come up for minutes and die
+mid-run, leaving a dispatch hung in ``block_until_ready`` forever (no
+timeout exists at that layer — observed r4).  ``BENCH_FORCE_TPU=1`` alone
+therefore cannot deliver an on-chip artifact: the retry loop only guards
+the *probe*, not the run.  This queue closes the gap:
+
+- probe the tunnel in a cheap subprocess (150 s timeout) every
+  ``--interval`` seconds (default 300);
+- when the tunnel is up, run ``bench.py`` with per-config checkpointing
+  (``BENCH_CHECKPOINT``) under a **stall watchdog**: if the bench process
+  makes no CPU progress for ``--stall`` seconds (default 420), it is
+  killed and the completed configs survive in the checkpoint;
+- a QUICK pass runs first (small sizes — minutes of tunnel time) so that
+  even a short tunnel window yields a complete on-chip artifact; a
+  successful quick pass escalates to the full-size run;
+- every completed (or partial) result is merged into
+  ``BENCH_TPU_R04.json`` at the repo root, newest-complete wins.
+
+Usage: python scripts/onchip_capture.py [--max-hours H] [--once]
+Exit 0 when a full-size on-chip artifact was captured, 3 when the budget
+expired first.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+ART = os.path.join(ROOT, "BENCH_TPU_R04.json")
+CKPT = os.path.join(ROOT, ".bench_tpu_partial.json")
+
+
+def log(*a):
+    print(f"[capture {time.strftime('%H:%M:%S')}]", *a, flush=True)
+
+
+def probe(timeout_s: int = 150) -> bool:
+    code = ("import jax,sys;"
+            "sys.exit(0 if jax.devices()[0].platform=='tpu' else 3)")
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _cpu_ticks(pid: int):
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().split()
+        return int(parts[13]) + int(parts[14])
+    except OSError:
+        return None
+
+
+def run_watched(argv, env, stall_s: int, tag: str) -> str:
+    """Run a command under the CPU-progress stall watchdog.
+    Returns 'ok', 'stall', or 'fail'."""
+    out_path = os.path.join(ROOT, f".capture_{tag}.out")
+    err_path = os.path.join(ROOT, f".capture_{tag}.err")
+    with open(out_path, "w") as out, open(err_path, "w") as err:
+        p = subprocess.Popen(argv, cwd=ROOT,
+                             env=env, stdout=out, stderr=err,
+                             start_new_session=True)
+        last_ticks, last_move = _cpu_ticks(p.pid), time.time()
+        while True:
+            rc = p.poll()
+            if rc is not None:
+                return "ok" if rc == 0 else "fail"
+            time.sleep(15)
+            t = _cpu_ticks(p.pid)
+            if t is not None and last_ticks is not None and t != last_ticks:
+                last_ticks, last_move = t, time.time()
+            elif time.time() - last_move > stall_s:
+                log(f"stall: no CPU progress for {stall_s}s, killing {tag}")
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    p.kill()
+                p.wait()
+                return "stall"
+
+
+def run_bench(quick: bool, stall_s: int) -> str:
+    env = dict(os.environ)
+    env["BENCH_CHECKPOINT"] = CKPT
+    env["BENCH_PROBE_MAX_S"] = "240"
+    if quick:
+        env["BENCH_QUICK"] = "1"
+    else:
+        env.pop("BENCH_QUICK", None)
+    return run_watched([sys.executable, "bench.py"], env, stall_s,
+                       "quick" if quick else "full")
+
+
+def merge_artifact(kind: str, status: str) -> bool:
+    """Fold the checkpoint + stdout headline into ART.  Returns True if a
+    COMPLETE full-size on-chip run is now recorded."""
+    try:
+        with open(CKPT) as f:
+            part = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if "tpu" not in str(part.get("backend", "")).lower():
+        log(f"{kind} run completed on {part.get('backend')} — not on-chip, "
+            "discarding")
+        return False
+    headline = None
+    try:
+        with open(os.path.join(ROOT, f".capture_{kind}.out")) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    headline = json.loads(line)
+    except (OSError, ValueError):
+        pass
+    try:
+        with open(ART) as f:
+            art = json.load(f)
+    except (OSError, ValueError):
+        art = {"note": "On-chip bench artifacts captured by "
+                       "scripts/onchip_capture.py (standing tunnel queue). "
+                       "All dispatches carry distinct salted inputs; rates "
+                       "above HBM physics are refused by bench.py itself."}
+    n_cfg = len(part.get("configs", {}))
+    art[kind] = {
+        "status": status, "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "backend": part.get("backend"), "configs_done": n_cfg,
+        "detail": part, "headline": headline,
+    }
+    with open(ART + ".tmp", "w") as f:
+        json.dump(art, f, indent=1)
+    os.replace(ART + ".tmp", ART)
+    log(f"merged {kind} ({status}, {n_cfg} configs) into {ART}")
+    return kind == "full" and status == "ok" and n_cfg >= 7
+
+
+def main() -> int:
+    max_h = 11.0
+    once = False
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--max-hours":
+            max_h = float(args.pop(0))
+        elif a == "--once":
+            once = True
+    deadline = time.time() + max_h * 3600
+    interval = int(os.environ.get("CAPTURE_INTERVAL_S", 300))
+    stall_s = int(os.environ.get("CAPTURE_STALL_S", 420))
+    # Work queue for a tunnel window, in value order: a complete small
+    # artifact first, then the full-size one, then the targeted trials and
+    # the randomized route soak.  Items re-run until they succeed.
+    done = {"quick": False, "full": False, "trials": False, "soak": False}
+    attempt = 0
+    while time.time() < deadline and not all(done.values()):
+        if not probe():
+            log("tunnel down")
+            if once:
+                return 3
+            time.sleep(interval)
+            continue
+        attempt += 1
+        item = next(k for k, v in done.items() if not v)
+        log(f"tunnel UP — attempt {attempt}: {item}")
+        if item in ("quick", "full"):
+            try:
+                os.remove(CKPT)
+            except OSError:
+                pass
+            status = run_bench(quick=item == "quick", stall_s=stall_s)
+            complete = merge_artifact(item, status)
+            if status == "ok" and (item == "quick" or complete):
+                done[item] = True
+                if complete:
+                    shutil.copy(ART,
+                                os.path.join(ROOT, "BENCH_TPU_SNAPSHOT.json"))
+                    log("full-size on-chip artifact captured")
+                continue  # escalate immediately while the tunnel is up
+        elif item == "trials":
+            status = run_watched(
+                [sys.executable, "scripts/onchip_trials.py"],
+                dict(os.environ), stall_s, "trials")
+            done[item] = status == "ok"
+            if done[item]:
+                continue
+        else:
+            status = run_watched(
+                [sys.executable, "scripts/route_soak.py", "150", "4"],
+                dict(os.environ), stall_s, "soak")
+            done[item] = status == "ok"
+            if done[item]:
+                continue
+        if once:
+            return 3
+        time.sleep(60 if status == "ok" else interval)
+    captured = ", ".join(k for k, v in done.items() if v) or "nothing"
+    if all(done.values()):
+        log("all on-chip work captured — done")
+        return 0
+    log(f"budget expired; captured: {captured}")
+    # contract: exit 0 iff the full-size on-chip artifact exists, even if
+    # the lower-priority trials/soak items never got a tunnel window
+    return 0 if done["full"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
