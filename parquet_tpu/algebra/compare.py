@@ -275,10 +275,18 @@ def min_max(leaf: Leaf, cd, v0: int, v1: int):
                     vals[offs[ma]:offs[ma + 1]].tobytes())
     dense = _dense_order_values(leaf, cd, v0, v1)
     if t in (Type.FLOAT, Type.DOUBLE):
-        finite = dense[~np.isnan(dense)]
-        if len(finite) == 0:
+        # skip NaNs without materializing a filtered copy (the per-page
+        # mask + fancy-index was a full column copy per page).  np.min
+        # propagates NaN, so a non-NaN min proves the span is NaN-free;
+        # nanmin/nanmax only run when some-but-not-all values are NaN, so
+        # they never hit the all-NaN RuntimeWarning (warnings.catch_warnings
+        # is not thread-safe and chunks encode concurrently).
+        mn = dense.min()
+        if not np.isnan(mn):
+            return mn.item(), dense.max().item()
+        if bool(np.isnan(dense).all()):
             return None, None
-        return finite.min().item(), finite.max().item()
+        return np.nanmin(dense).item(), np.nanmax(dense).item()
     if dense.dtype == object:
         return min(dense.tolist()), max(dense.tolist())
     return dense.min().item(), dense.max().item()

@@ -340,6 +340,14 @@ class ParquetWriter:
             dict_n = 0
             encodings_used.add(value_encoding)
 
+        # per-chunk order-domain ranks of the dictionary: page statistics
+        # become a rank gather + min/max instead of a bincount over the
+        # whole dictionary per page (local — chunks encode concurrently)
+        rank_cache = None
+        if opts.write_statistics and indices is not None and dict_n:
+            rank_cache = _dict_rank_cache(
+                leaf, dict_values, dict_offsets, dict_n)
+
         # ---- paginate -----------------------------------------------------
         rows_per_page = _rows_per_page(leaf, data, nvalues, n_slots, opts.data_page_size)
         pages: List[tuple] = []  # (hdr, comp_body, take_rows, pstat, n_vals)
@@ -353,7 +361,8 @@ class ParquetWriter:
                                          value_cursor)
             body, n_slot_page, n_val_page, pstat = self._encode_page(
                 leaf, data, def_levels, rep_levels, s0, s1, v0, v1,
-                value_encoding, indices, dict_values, dict_n, dict_offsets)
+                value_encoding, indices, dict_values, dict_n, dict_offsets,
+                rank_cache)
             comp_body, hdr = self._page_header(leaf, body, n_slot_page,
                                                n_val_page, value_encoding,
                                                def_levels, rep_levels, s0, s1,
@@ -506,7 +515,7 @@ class ParquetWriter:
 
     def _encode_page(self, leaf, data, def_levels, rep_levels, s0, s1, v0, v1,
                      value_encoding, indices, dict_values, dict_n=0,
-                     dict_offsets=None):
+                     dict_offsets=None, rank_cache=None):
         """Encode one page → body (+counts, stats).  v1: bytes; v2: 3-tuple."""
         opts = self.options
         physical = leaf.physical_type
@@ -538,9 +547,17 @@ class ParquetWriter:
                 # dictionary entries, not its materialized values — the stats
                 # pass drops from O(page values) to O(dict) (measured as the
                 # single largest cost of writing a categorical column)
-                mn, mx = _min_max_from_dict(
-                    leaf, dict_values, dict_offsets,
-                    indices[v0:v1], dict_n)
+                if rank_cache is not None and v1 > v0:
+                    ranks, sorted_ids = rank_cache
+                    r = ranks[indices[v0:v1]]
+                    sel = np.array([sorted_ids[r.min()], sorted_ids[r.max()]],
+                                   dtype=np.int64)
+                    mn, mx = _min_max_from_dict(
+                        leaf, dict_values, dict_offsets, sel, dict_n)
+                else:
+                    mn, mx = _min_max_from_dict(
+                        leaf, dict_values, dict_offsets,
+                        indices[v0:v1], dict_n)
                 pstat = md.Statistics(
                     null_count=(s1 - s0) - (v1 - v0),
                     min_value=mn, max_value=mx, min=mn, max=mx)
@@ -891,6 +908,31 @@ def _compute_statistics(leaf, data: ColumnData, n_slots, nvalues):
     mn, mx = _min_max(leaf, data, 0, nvalues)
     return md.Statistics(null_count=n_slots - nvalues, min_value=mn,
                          max_value=mx, min=mn, max=mx)
+
+
+def _dict_rank_cache(leaf: Leaf, dict_values, dict_offsets, dict_n: int):
+    """Order-domain ranks of the dictionary entries, computed once per
+    chunk: (ranks[id] -> rank, sorted_ids[rank] -> id).  Page statistics
+    then cost a rank gather + min/max over the page's index span instead of
+    a bincount over the whole dictionary per page.  None when entries are
+    not cleanly rankable (NaN floats, INT96) — callers fall back to the
+    bincount path."""
+    from ..algebra import compare
+
+    if leaf.physical_type == Type.INT96:
+        return None
+    try:
+        dense = compare._dense_order_values(
+            leaf, ColumnData(values=dict_values, offsets=dict_offsets),
+            0, dict_n)
+    except Exception:
+        return None
+    if dense.dtype.kind == "f" and np.isnan(dense).any():
+        return None
+    sorted_ids = np.argsort(dense, kind="stable")
+    ranks = np.empty(dict_n, np.int64)
+    ranks[sorted_ids] = np.arange(dict_n)
+    return ranks, sorted_ids
 
 
 def _min_max_from_dict(leaf: Leaf, dict_values, dict_offsets, idx_span,

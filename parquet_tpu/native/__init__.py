@@ -688,6 +688,26 @@ def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int):
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     n = len(offsets) - 1
     indices = np.empty(max(n, 1), dtype=np.int64)
+    # Sample-based early bail, mirroring dict_build_fixed: near-unique
+    # string columns should not pay a half-column hash build just to learn
+    # they overflow.  Both a prefix and a middle window must look >= 7/8
+    # unique to predict overflow (first occurrences clustering early would
+    # fool a prefix-only sample).  Affects only whether dictionary encoding
+    # is attempted, never correctness.
+    sample = 1 << 15
+    if n > 4 * sample and max_unique >= sample:
+        s_idx = np.empty(sample, np.int64)
+        # a window overflowing a 7/8*sample unique cap (negative return)
+        # means it is >= 7/8 internally unique
+        nu_a = lib.pq_dict_build_ba(data.ctypes.data, offsets,
+                                    sample, s_idx, sample * 7 // 8)
+        if nu_a < 0:
+            mid = n // 2
+            nu_b = lib.pq_dict_build_ba(data.ctypes.data,
+                                        offsets[mid:], sample, s_idx,
+                                        sample * 7 // 8)
+            if nu_b < 0:
+                return "overflow"
     k = lib.pq_dict_build_ba(data.ctypes.data if len(data) else None,
                              offsets, n, indices, max_unique)
     if k < 0:

@@ -1339,20 +1339,113 @@ struct DictState {
 
 int64_t pq_dict_build_ba(const uint8_t* data, const int64_t* offsets,
                          int64_t n, int64_t* indices, int64_t max_unique) {
-  std::unordered_map<std::string, int64_t> map;
-  map.reserve((size_t)(n / 4 + 8));
-  int64_t next = 0;
-  for (int64_t i = 0; i < n; i++) {
-    std::string key((const char*)data + offsets[i],
-                    (size_t)(offsets[i + 1] - offsets[i]));
-    auto it = map.find(key);
-    if (it == map.end()) {
-      if (next >= max_unique) return -(i + 1);  // cardinality blew the limit
-      it = map.emplace(std::move(key), next++).first;
+  // Open-addressing first-occurrence dedup, same scheme as
+  // pq_dict_build_i64: slots hold unique ids, keys are compared by memcmp
+  // against the FIRST occurrence's bytes (no per-value allocation — the
+  // previous unordered_map<string> build paid a heap string per value and
+  // was the single largest cost of writing a categorical string column).
+  // All loads are fixed-size 8-byte memcpy (a single inlined mov) — a
+  // variable-length memcpy is a real library call and dominated the
+  // per-value cost.  Loads near the end of the buffer fall back to the
+  // slow path so we never read past offsets[n].
+  const int64_t total = offsets[n];
+  constexpr uint64_t kMix = 0x9E3779B97F4A7C15ull;
+  const auto load_masked = [&](int64_t off, int64_t len) -> uint64_t {
+    // len in [0, 8]
+    if (off + 8 <= total) {
+      uint64_t w;
+      memcpy(&w, data + off, 8);
+      return len >= 8 ? w : w & ((1ull << (8 * len)) - 1);
     }
-    indices[i] = it->second;
+    uint64_t w = 0;
+    memcpy(&w, data + off, (size_t)len);
+    return w;
+  };
+  // hash of the full string; also yields the first 8 bytes zero-padded
+  // (k8) — with the length checked separately, k8 settles equality for
+  // len <= 8 without touching memcmp
+  const auto hkey = [&](int64_t i, uint64_t* k8) -> uint64_t {
+    int64_t o = offsets[i];
+    int64_t len = offsets[i + 1] - o;
+    uint64_t h = kMix ^ (uint64_t)len;
+    uint64_t w0 = 0;
+    bool first = true;
+    while (len >= 8) {
+      uint64_t w;
+      memcpy(&w, data + o, 8);
+      if (first) {
+        w0 = w;
+        first = false;
+      }
+      h = (h ^ w) * kMix;
+      h ^= h >> 29;
+      o += 8;
+      len -= 8;
+    }
+    if (len) {
+      uint64_t w = load_masked(o, len);
+      if (first) w0 = w;
+      h = (h ^ w) * kMix;
+      h ^= h >> 29;
+    }
+    *k8 = w0;
+    return h;
+  };
+  struct BaSlot {       // one cache-line-friendly 32-byte entry per slot
+    uint64_t h;         // full hash
+    uint64_t k8;        // first 8 bytes, zero-padded
+    int64_t len;        // byte length
+    int64_t id;         // unique id, -1 = empty
+  };
+  int64_t cap = 1024;
+  std::vector<BaSlot> slots(cap, BaSlot{0, 0, 0, -1});
+  std::vector<int64_t> first_i;  // unique id -> first value index
+  first_i.reserve(1024);
+  const auto grow = [&]() {
+    cap <<= 1;
+    slots.assign(cap, BaSlot{0, 0, 0, -1});
+    for (size_t u = 0; u < first_i.size(); ++u) {
+      const int64_t fi = first_i[u];
+      uint64_t k8;
+      uint64_t h = hkey(fi, &k8);
+      int64_t p = (int64_t)(h & (uint64_t)(cap - 1));
+      while (slots[p].id >= 0) p = (p + 1) & (cap - 1);
+      slots[p] = BaSlot{h, k8, offsets[fi + 1] - offsets[fi], (int64_t)u};
+    }
+  };
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t k8;
+    const uint64_t h = hkey(i, &k8);
+    const int64_t len = offsets[i + 1] - offsets[i];
+    int64_t p = (int64_t)(h & (uint64_t)(cap - 1));
+    while (true) {
+      const BaSlot& e = slots[p];
+      if (e.id < 0) {
+        if ((int64_t)first_i.size() >= max_unique)
+          return -(i + 1);  // cardinality blew the limit
+        if (2 * ((int64_t)first_i.size() + 1) > cap) {
+          grow();
+          p = (int64_t)(h & (uint64_t)(cap - 1));
+          continue;
+        }
+        slots[p] = BaSlot{h, k8, len, (int64_t)first_i.size()};
+        indices[i] = (int64_t)first_i.size();
+        first_i.push_back(i);
+        break;
+      }
+      if (e.h == h && e.len == len && e.k8 == k8) {
+        const int64_t fi = first_i[e.id];
+        if (len <= 8 ||
+            memcmp(data + offsets[fi] + 8, data + offsets[i] + 8,
+                   (size_t)(len - 8)) == 0) {
+          indices[i] = e.id;
+          break;
+        }
+      }
+      p = (p + 1) & (cap - 1);
+    }
   }
-  return next;
+  return (int64_t)first_i.size();
 }
 
 // second pass: caller uses indices to materialize uniques (first occurrence)

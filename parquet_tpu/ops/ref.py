@@ -376,8 +376,24 @@ def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8,
     group may be zero-padded (readers stop at num_values).  Runs of
     >= ``min_repeat`` identical values switch to RLE runs, matching the
     common writer heuristic."""
-    values = np.asarray(values, dtype=np.int64)
     n = len(values)
+    if n >= max(min_repeat, 8) and bit_width:
+        # constant stream → one RLE run, no scan.  Def-level streams of
+        # fully-present pages (the common case for optional columns without
+        # nulls) hit this on every page of the write path.  Gated on
+        # n >= max(min_repeat, 8) so every case where the scan encoders
+        # might bit-pack instead stays with them (byte identity), and
+        # masked like the scan path so out-of-range constants encode their
+        # low bytes instead of raising.
+        v = np.asarray(values)
+        v0 = v[0]
+        if v0 == v[-1] and not (v != v0).any():
+            vbytes = (bit_width + 7) // 8
+            vmask = (1 << (8 * vbytes)) - 1
+            hdr = bytearray()
+            write_uvarint(hdr, n << 1)
+            return bytes(hdr) + (int(v0) & vmask).to_bytes(vbytes, "little")
+    values = np.asarray(values, dtype=np.int64)
     out = bytearray()
     if n == 0 or bit_width == 0:
         return bytes(out)

@@ -100,18 +100,20 @@ def run_bench(quick: bool, stall_s: int) -> str:
                        "quick" if quick else "full")
 
 
-def merge_artifact(kind: str, status: str) -> bool:
-    """Fold the checkpoint + stdout headline into ART.  Returns True if a
-    COMPLETE full-size on-chip run is now recorded."""
+def merge_artifact(kind: str, status: str):
+    """Fold the checkpoint + stdout headline into ART.  Returns the number
+    of on-chip configs recorded, or None when the run was not on-chip (a
+    bench that silently fell back to CPU must not mark its queue item
+    done)."""
     try:
         with open(CKPT) as f:
             part = json.load(f)
     except (OSError, ValueError):
-        return False
+        return None
     if "tpu" not in str(part.get("backend", "")).lower():
         log(f"{kind} run completed on {part.get('backend')} — not on-chip, "
             "discarding")
-        return False
+        return None
     headline = None
     try:
         with open(os.path.join(ROOT, f".capture_{kind}.out")) as f:
@@ -139,7 +141,7 @@ def merge_artifact(kind: str, status: str) -> bool:
         json.dump(art, f, indent=1)
     os.replace(ART + ".tmp", ART)
     log(f"merged {kind} ({status}, {n_cfg} configs) into {ART}")
-    return kind == "full" and status == "ok" and n_cfg >= 7
+    return n_cfg
 
 
 def main() -> int:
@@ -176,8 +178,11 @@ def main() -> int:
             except OSError:
                 pass
             status = run_bench(quick=item == "quick", stall_s=stall_s)
-            complete = merge_artifact(item, status)
-            if status == "ok" and (item == "quick" or complete):
+            n_onchip = merge_artifact(item, status)
+            complete = (item == "full" and status == "ok"
+                        and (n_onchip or 0) >= 7)
+            if status == "ok" and n_onchip is not None and (
+                    item == "quick" or complete):
                 done[item] = True
                 if complete:
                     shutil.copy(ART,

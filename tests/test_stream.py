@@ -60,7 +60,11 @@ def test_stream_batch_sizes_and_column_subset(rng):
         sizes.append(b.num_rows)
         assert np.asarray(b["i"].values).ndim == 1
     assert sum(sizes) == n
-    assert all(s == 999 for s in sizes[:-1])
+    # batches are "at most batch_rows", snapped to row-group boundaries
+    # when at least half-full (pyarrow's iter_batches behaves the same);
+    # rg=1700 under batch_rows=999 → alternating 999 / 701 per row group
+    assert all(s <= 999 for s in sizes)
+    assert all(s == 999 or s * 2 >= 999 for s in sizes[:-1])
 
 
 def test_stream_struct_columns(rng):
