@@ -13,6 +13,7 @@ the same rerouting point the north star names (``encoding.Encoding`` /
 from __future__ import annotations
 
 import struct
+import threading
 import zlib
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
@@ -896,6 +897,48 @@ def decode_dictionary_page(reader: ColumnChunkReader, page: PageInfo):
     return dictionary
 
 
+def _batch_decompress(page_list, codec):
+    """Decompress every data page of ``page_list`` in one native call
+    (snappy/zstd — the codecs with a dlopen'd system lib in the shim).
+    Returns {page index -> decompressed uint8 view} or None to use the
+    per-page codec path (identity/other codecs, shim unavailable, or any
+    page failing — the per-page path then raises the precise error)."""
+    cid = getattr(codec, "codec_id", None)
+    if cid is None or int(cid) not in (1, 6):  # SNAPPY, ZSTD
+        return None
+    srcs, sizes, idxs = [], [], []
+    for i, page in enumerate(page_list):
+        h = page.header
+        if page.page_type == PageType.DATA_PAGE:
+            srcs.append(page.payload)
+            sizes.append(h.uncompressed_page_size)
+            idxs.append(i)
+        elif page.page_type == PageType.DATA_PAGE_V2:
+            dph2 = h.data_page_header_v2
+            if dph2.is_compressed is False:
+                continue
+            rl = dph2.repetition_levels_byte_length or 0
+            dl = dph2.definition_levels_byte_length or 0
+            srcs.append(page.payload[rl + dl:])
+            sizes.append(h.uncompressed_page_size - rl - dl)
+            idxs.append(i)
+    if len(srcs) < 2:  # a single page gains nothing over the direct call
+        return None
+    from .. import native as _nat
+    from ..utils.pool import available_cpus
+
+    # read() already fans chunks across the shared pool — a per-chunk
+    # thread split on top would oversubscribe (pool width x 8 native
+    # threads); keep the split for single-chunk/streaming callers only
+    pooled = threading.current_thread().name.startswith("ThreadPoolExecutor")
+    res = _nat.decompress_pages(srcs, sizes, int(cid),
+                                1 if pooled else min(available_cpus(), 8))
+    if res is None:
+        return None
+    buf, offs = res
+    return {idx: buf[offs[j]:offs[j + 1]] for j, idx in enumerate(idxs)}
+
+
 def decode_chunk_host(reader: ColumnChunkReader, pages=None,
                       dictionary=None) -> Column:
     """Decode a chunk (or, with ``pages``, a selected page subset — the
@@ -914,17 +957,22 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
     value_parts: List = []  # directly decoded pages (arrays or (vals, offs))
     part_order: List[Tuple[str, int]] = []  # ("idx"/"val", part index) per page
 
-    for page in (pages if pages is not None else reader.pages()):
+    page_list = list(pages) if pages is not None else list(reader.pages())
+    pre_dec = _batch_decompress(page_list, codec)
+
+    for page_i, page in enumerate(page_list):
         h = page.header
         pt = page.page_type
         verify_page_crc(reader, page)
         if pt == PageType.DICTIONARY_PAGE:
             dictionary = decode_dictionary_page(reader, page)
             continue
+        pre = pre_dec.get(page_i) if pre_dec is not None else None
         if pt == PageType.DATA_PAGE:
             dph = h.data_page_header
             n = dph.num_values
-            raw = np.frombuffer(codec.decode(page.payload, h.uncompressed_page_size), np.uint8)
+            raw = pre if pre is not None else np.frombuffer(
+                codec.decode(page.payload, h.uncompressed_page_size), np.uint8)
             pos = 0
             rep = defs = None
             if max_rep > 0:
@@ -971,7 +1019,8 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
                 defs = ref.decode_rle(raw_levels[rl:], n, _bit_width(max_def), 0)
             body = page.payload[rl + dl :]
             if dph2.is_compressed is not False:
-                body = codec.decode(body, h.uncompressed_page_size - rl - dl)
+                body = pre if pre is not None else codec.decode(
+                    body, h.uncompressed_page_size - rl - dl)
             raw = np.frombuffer(body, np.uint8)
             nvals = n - (dph2.num_nulls or 0)
             encoding = Encoding(dph2.encoding)
@@ -995,9 +1044,22 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
             part_order.append(("val", len(value_parts)))
             value_parts.append(decoded)
 
-    # ---- combine pages: single gather for dict-encoded chunks -------------
-    values, offsets = _combine_parts(part_order, index_parts, value_parts,
-                                     dictionary, leaf, physical)
+    # ---- combine pages: dictionary form for BYTE_ARRAY chunks -------------
+    # A fully dict-encoded byte-array chunk keeps (dictionary, indices) —
+    # no gather: Column consumers handle dictionary form everywhere (rows,
+    # scans, convert, concat), to_arrow emits a DictionaryArray zero-copy,
+    # and the gather for a 4M-row categorical column was the read path's
+    # second-largest cost after decompression.
+    dict_host = dict_idx = None
+    if (physical == Type.BYTE_ARRAY and dictionary is not None and part_order
+            and all(kind == "idx" for kind, _ in part_order)):
+        values, offsets = None, None
+        dict_host = dictionary
+        dict_idx = (np.concatenate(index_parts) if len(index_parts) > 1
+                    else index_parts[0])
+    else:
+        values, offsets = _combine_parts(part_order, index_parts, value_parts,
+                                         dictionary, leaf, physical)
     if all_def and not all(isinstance(d, (int, np.integer)) for d in all_def):
         # mixed fast-path/expanded pages: back-fill the all-present ones
         def_levels = np.concatenate(
@@ -1008,11 +1070,13 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
     rep_levels = np.concatenate(all_rep) if all_rep else None
     asm = levels_ops.assemble(def_levels, rep_levels, leaf)
     num_slots = len(def_levels) if def_levels is not None else (
+        len(dict_idx) if dict_idx is not None else
         len(offsets) - 1 if offsets is not None else
         (len(values) if np.ndim(values) else 0))
     return Column(leaf=leaf, values=values, offsets=offsets,
                   validity=asm.validity, list_offsets=asm.list_offsets,
                   list_validity=asm.list_validity, num_slots=num_slots,
+                  dictionary_host=dict_host, dict_indices=dict_idx,
                   def_levels=def_levels, rep_levels=rep_levels)
 
 

@@ -419,6 +419,11 @@ def _trim_nested(col, offset: int, count: int):
 def _trim_flat(col, offset: int, count: int):
     """Slice ``count`` rows starting at ``offset`` out of a decoded flat column."""
     validity = None if col.validity is None else np.asarray(col.validity)
+    if col.is_dictionary_encoded():
+        # host decode keeps byte-array chunks in dictionary form; the old
+        # behavior (whole-chunk gather during decode) moves here, where the
+        # nested trim already does the same
+        col.materialize_host()
     values = np.asarray(col.values)
     if values.ndim == 2 and values.dtype == np.uint32 and values.shape[1] == 2:
         dt = np.float64 if col.leaf.physical_type == Type.DOUBLE else np.int64
@@ -442,6 +447,12 @@ def _substrings(values, offs, start, count):
 
 
 def _trim_flat_aligned(col, offset: int, count: int):
+    if col.is_dictionary_encoded():
+        col.materialize_host()  # same gate as _trim_flat
+    return _trim_flat_aligned_impl(col, offset, count)
+
+
+def _trim_flat_aligned_impl(col, offset: int, count: int):
     """Like :func:`_trim_flat` but row-aligned: returns ``(values, validity)``
     where ``values`` has exactly ``count`` entries (null slots hold a zero
     fill / ``None`` for byte arrays) and ``validity`` is a bool mask, or

@@ -137,6 +137,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _u8p_w, ctypes.c_int64,
             _i64p_w, _u8p_w, _i64p_w, _i64p_w, _i32p_w, ctypes.c_int64,
             _i64p_w, ctypes.c_int32]
+        lib.pq_decompress_pages.restype = ctypes.c_int64
+        lib.pq_decompress_pages.argtypes = [
+            _i64p, _i64p, ctypes.c_int64, ctypes.c_int32, _u8p_w, _i64p,
+            ctypes.c_int32]
         lib.pq_xxh64.restype = ctypes.c_uint64
         lib.pq_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         lib.pq_xxh64_batch.restype = None
@@ -677,6 +681,46 @@ def delta_byte_array_expand(prefix_lens, suffix_data, suffix_offsets, out_offset
                                    suffix_data.ctypes.data if len(suffix_data) else None,
                                    suffix_offsets, n, out, out_offsets)
     return out[:total]
+
+
+def decompress_pages(srcs, out_sizes, codec_id: int, nthreads: int = 1):
+    """Decompress many page payloads in ONE native call (snappy/zstd via
+    the dlopen'd system libs; 0 = memcpy).  ``srcs`` is a sequence of
+    bytes-like payloads (any layout — pointers are taken per page),
+    ``out_sizes`` their expected uncompressed sizes.  Returns
+    ``(buffer, offsets)`` with page i at ``buffer[offsets[i]:offsets[i+1]]``,
+    or None when the shim/codec is unavailable or any page fails (callers
+    fall back to the per-page codec path, which raises the precise error)."""
+    lib = get_lib()
+    if lib is None or codec_id not in (0, 1, 6):
+        return None
+    n = len(srcs)
+    if n == 0:
+        return np.empty(0, np.uint8), np.zeros(1, np.int64)
+    # header-supplied sizes are UNTRUSTED: a negative size (e.g. v2's
+    # uncompressed - levels underflowing on a crafted header) would make
+    # the native call write before/past the output buffer
+    sizes_arr = np.asarray(out_sizes, np.int64)
+    if len(sizes_arr) != n or bool((sizes_arr < 0).any()):
+        return None
+    ptrs = np.empty(n, np.int64)
+    lens = np.empty(n, np.int64)
+    keep = []
+    for i, s in enumerate(srcs):
+        a = s if isinstance(s, np.ndarray) else np.frombuffer(s, np.uint8)
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        keep.append(a)  # hold refs: the C call reads raw pointers
+        ptrs[i] = a.ctypes.data if len(a) else 0
+        lens[i] = len(a)
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(sizes_arr, out=offs[1:])
+    out = np.empty(max(int(offs[-1]), 1), np.uint8)
+    rc = lib.pq_decompress_pages(ptrs, lens, n, codec_id, out, offs,
+                                 max(int(nthreads), 1))
+    if rc != 0:
+        return None
+    return out, offs
 
 
 def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int):

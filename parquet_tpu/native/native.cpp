@@ -1807,4 +1807,43 @@ int64_t pq_dict_chunk_scan(const uint8_t* chunk, int64_t chunk_len,
   return nruns;
 }
 
+// ---------------------------------------------------------------------------
+// Batched page decompression: one native call replaces a Python/ctypes
+// codec round-trip per page (~0.1 ms each; the 2.7 GB lineitem file has
+// ~6,400 pages, where the per-page overhead was the read path's single
+// largest cost).  Per-page SOURCE POINTERS so any payload layout works
+// (whole-chunk zero-copy views, streamed windows).  Output spans are
+// caller-laid-out in one buffer via out_offs.  Threaded across pages.
+// Codec ids as page_decompress: 0 UNCOMPRESSED, 1 SNAPPY, 6 ZSTD.
+// Returns 0, or -(i+1) for the first failing page.
+// ---------------------------------------------------------------------------
+extern "C" int64_t pq_decompress_pages(
+    const int64_t* src_ptrs, const int64_t* src_lens, int64_t n_pages,
+    int32_t codec, uint8_t* out, const int64_t* out_offs, int32_t nthreads) {
+  if (n_pages <= 0) return 0;
+  std::atomic<int64_t> fail{0};
+  auto run = [&](int t, int T) {
+    for (int64_t i = t; i < n_pages; i += T) {
+      if (!page_decompress(codec, (const uint8_t*)(uintptr_t)src_ptrs[i],
+                           src_lens[i], out + out_offs[i],
+                           out_offs[i + 1] - out_offs[i])) {
+        int64_t cur = 0;
+        fail.compare_exchange_strong(cur, -(i + 1));
+      }
+    }
+  };
+  int T = nthreads > 0 ? nthreads : 1;
+  if ((int64_t)T > n_pages) T = (int)n_pages;
+  if (T <= 1) {
+    run(0, 1);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve((size_t)(T - 1));
+    for (int t = 1; t < T; ++t) threads.emplace_back(run, t, T);
+    run(0, T);
+    for (auto& th : threads) th.join();
+  }
+  return fail.load();
+}
+
 }  // extern "C"

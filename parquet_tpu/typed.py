@@ -415,8 +415,11 @@ def read_pytree(source, columns=None, device: bool = True):
     out = {}
     for path, col in tab.columns.items():
         if col.is_dictionary_encoded():
+            # host decode carries the dictionary in dictionary_host (the
+            # device route in .dictionary) — emit whichever is populated
             out[path] = {
-                "dictionary": col.dictionary,
+                "dictionary": (col.dictionary if col.dictionary is not None
+                               else col._host_dictionary()),
                 "indices": col.dict_indices,
             }
         elif col.offsets is not None:

@@ -493,3 +493,27 @@ def test_dict_chunk_scan_bails_to_python_on_nulls(lib, rng):
     assert raw is not None  # the bail hands the read buffer to the fallback
     plan = dr.build_plan(chunk)  # falls through to the per-page loop
     assert plan.total_values < plan.total_slots
+
+
+def test_decompress_pages_rejects_negative_sizes(lib):
+    """Header-supplied sizes are untrusted: a negative size must be refused
+    before it reaches the raw-pointer native write (review r4 finding)."""
+    from parquet_tpu import native
+
+    assert native.decompress_pages([b"xx", b"yyy"], [-999, 1000], 1) is None
+
+
+def test_decompress_pages_batch_matches_codec(lib, rng):
+    from parquet_tpu import native
+    from parquet_tpu.codecs import get_codec
+    from parquet_tpu.format.enums import CompressionCodec
+
+    codec = get_codec(CompressionCodec.SNAPPY)
+    pages = [rng.integers(0, 255, rng.integers(10, 5000), np.uint8
+                          ).astype(np.uint8).tobytes() for _ in range(7)]
+    comp = [codec.encode(p) for p in pages]
+    res = native.decompress_pages(comp, [len(p) for p in pages], 1, 2)
+    assert res is not None
+    buf, offs = res
+    for i, p in enumerate(pages):
+        assert bytes(buf[offs[i]:offs[i + 1]]) == p
