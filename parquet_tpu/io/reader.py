@@ -929,8 +929,11 @@ def _batch_decompress(page_list, codec):
 
     # read() already fans chunks across the shared pool — a per-chunk
     # thread split on top would oversubscribe (pool width x 8 native
-    # threads); keep the split for single-chunk/streaming callers only
-    pooled = threading.current_thread().name.startswith("ThreadPoolExecutor")
+    # threads); keep the split for single-chunk/streaming callers only.
+    # The shared pool names its workers "pq-work_*" (utils/pool.py);
+    # "ThreadPoolExecutor*" covers ad-hoc executors.
+    tname = threading.current_thread().name
+    pooled = tname.startswith(("pq-work", "ThreadPoolExecutor"))
     res = _nat.decompress_pages(srcs, sizes, int(cid),
                                 1 if pooled else min(available_cpus(), 8))
     if res is None:
@@ -1206,14 +1209,17 @@ def _combine_parts(part_order, index_parts, value_parts, dictionary, leaf, physi
             mats.append(value_parts[i])
     if isinstance(mats[0], tuple):  # byte arrays: (values, offsets) pairs
         vals = np.concatenate([m[0] for m in mats])
+        # one vector add per page, no per-page astype (the add materializes
+        # a fresh array anyway; segmented np.repeat measured far slower)
         offs_parts = []
         base = 0
         for m in mats:
-            o = m[1].astype(np.int64)
-            offs_parts.append(o[:-1] + base if len(offs_parts) else o[:-1] + base)
+            o = m[1]
+            offs_parts.append(o[:-1] + np.int64(base))
             base += int(o[-1])
-        offs = np.concatenate(offs_parts + [np.array([base], dtype=np.int64)])
-        return vals, offs.astype(np.int32)
+        offs_parts.append(np.array([base], np.int64))
+        offs = np.concatenate(offs_parts).astype(np.int32, copy=False)
+        return vals, offs
     if len(mats) == 1:
         return mats[0], None
     return np.concatenate(mats), None

@@ -447,16 +447,12 @@ def _substrings(values, offs, start, count):
 
 
 def _trim_flat_aligned(col, offset: int, count: int):
-    if col.is_dictionary_encoded():
-        col.materialize_host()  # same gate as _trim_flat
-    return _trim_flat_aligned_impl(col, offset, count)
-
-
-def _trim_flat_aligned_impl(col, offset: int, count: int):
     """Like :func:`_trim_flat` but row-aligned: returns ``(values, validity)``
     where ``values`` has exactly ``count`` entries (null slots hold a zero
     fill / ``None`` for byte arrays) and ``validity`` is a bool mask, or
     ``None`` for non-nullable columns."""
+    if col.is_dictionary_encoded():
+        col.materialize_host()  # same gate as _trim_flat
     if col.validity is None:
         return _trim_flat(col, offset, count), None
     validity = np.asarray(col.validity, bool)
