@@ -897,6 +897,24 @@ def decode_dictionary_page(reader: ColumnChunkReader, page: PageInfo):
     return dictionary
 
 
+@dataclass
+class _PendingPlainBA:
+    """A PLAIN BYTE_ARRAY page deferred to the chunk-level batch parse."""
+    raw: np.ndarray
+    pos: int
+    nvals: int
+
+
+def _maybe_defer_plain_ba(raw, pos, nvals, encoding, physical):
+    """Defer a builtin-PLAIN BYTE_ARRAY page to one chunk-level native
+    parse (pq_plain_ba_batch).  None → decode through the registry."""
+    if (encoding == Encoding.PLAIN and physical == Type.BYTE_ARRAY
+            and _is_builtin_decode(Encoding.PLAIN)
+            and _native.get_lib() is not None):
+        return _PendingPlainBA(raw, pos, nvals)
+    return None
+
+
 def _batch_decompress(page_list, codec):
     """Decompress every data page of ``page_list`` in one native call
     (snappy/zstd — the codecs with a dlopen'd system lib in the shim).
@@ -1004,7 +1022,11 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
                     pos += nbytes
             nvals = n if defs is None else int(np.count_nonzero(defs == max_def))
             encoding = Encoding(dph.encoding)
-            decoded = _decode_values(raw, pos, nvals, encoding, leaf, physical, dictionary)
+            decoded = _maybe_defer_plain_ba(raw, pos, nvals, encoding,
+                                            physical)
+            if decoded is None:
+                decoded = _decode_values(raw, pos, nvals, encoding, leaf,
+                                         physical, dictionary)
             counters.inc("data_pages_decoded")
         elif pt == PageType.DATA_PAGE_V2:
             dph2 = h.data_page_header_v2
@@ -1027,7 +1049,11 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
             raw = np.frombuffer(body, np.uint8)
             nvals = n - (dph2.num_nulls or 0)
             encoding = Encoding(dph2.encoding)
-            decoded = _decode_values(raw, 0, nvals, encoding, leaf, physical, dictionary)
+            decoded = _maybe_defer_plain_ba(raw, 0, nvals, encoding,
+                                            physical)
+            if decoded is None:
+                decoded = _decode_values(raw, 0, nvals, encoding, leaf,
+                                         physical, dictionary)
             counters.inc("data_pages_decoded")
         else:
             continue  # index pages etc.
@@ -1047,6 +1073,24 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
             part_order.append(("val", len(value_parts)))
             value_parts.append(decoded)
 
+    # ---- deferred PLAIN BYTE_ARRAY pages: one native parse for the chunk --
+    pend = [(i, v) for i, v in enumerate(value_parts)
+            if isinstance(v, _PendingPlainBA)]
+    batched = None
+    if pend:
+        if len(pend) == len(value_parts) and not index_parts:
+            # pure plain-BA chunk: the batch call yields the final
+            # chunk-level (values, offsets) directly — _combine_parts is
+            # bypassed below (re-concatenating would copy the chunk again)
+            batched = _native.plain_ba_batch(
+                [v.raw[v.pos:] for _, v in pend],
+                [v.nvals for _, v in pend])
+        if batched is None:  # mixed with dict parts, or shim unavailable
+            for i, v in pend:
+                value_parts[i] = _decode_values(
+                    v.raw, v.pos, v.nvals, Encoding.PLAIN, leaf, physical,
+                    dictionary)
+
     # ---- combine pages: dictionary form for BYTE_ARRAY chunks -------------
     # A fully dict-encoded byte-array chunk keeps (dictionary, indices) —
     # no gather: Column consumers handle dictionary form everywhere (rows,
@@ -1054,7 +1098,10 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
     # and the gather for a 4M-row categorical column was the read path's
     # second-largest cost after decompression.
     dict_host = dict_idx = None
-    if (physical == Type.BYTE_ARRAY and dictionary is not None and part_order
+    if batched is not None:
+        values = batched[0]
+        offsets = batched[1].astype(np.int32, copy=False)
+    elif (physical == Type.BYTE_ARRAY and dictionary is not None and part_order
             and all(kind == "idx" for kind, _ in part_order)):
         values, offsets = None, None
         dict_host = dictionary

@@ -141,6 +141,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_decompress_pages.argtypes = [
             _i64p, _i64p, ctypes.c_int64, ctypes.c_int32, _u8p_w, _i64p,
             ctypes.c_int32]
+        lib.pq_plain_ba_batch.restype = ctypes.c_int64
+        lib.pq_plain_ba_batch.argtypes = [
+            _i64p, _i64p, _i64p, ctypes.c_int64, _i64p_w, _u8p_w]
         lib.pq_xxh64.restype = ctypes.c_uint64
         lib.pq_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         lib.pq_xxh64_batch.restype = None
@@ -179,6 +182,41 @@ def plain_byte_array(buf: np.ndarray, n: int):
     lib.pq_plain_byte_array(buf.ctypes.data, len(buf), n, offsets,
                             values.ctypes.data)
     return values[:total], offsets.astype(np.int32)
+
+
+def plain_ba_batch(srcs, counts):
+    """Parse many pages' PLAIN BYTE_ARRAY sections in one native call,
+    producing the CHUNK-level (values, int64 offsets) directly (offsets
+    rebased across pages — no python merge).  ``srcs`` are bytes-like page
+    value sections, ``counts`` the value count per page.  None when the
+    shim is unavailable; raises ValueError on truncation."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(srcs)
+    ptrs = np.empty(max(n, 1), np.int64)
+    lens = np.empty(max(n, 1), np.int64)
+    keep = []
+    total_src = 0
+    for i, s in enumerate(srcs):
+        a = s if isinstance(s, np.ndarray) else np.frombuffer(s, np.uint8)
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        keep.append(a)  # hold refs: the C call reads raw pointers
+        ptrs[i] = a.ctypes.data if len(a) else 0
+        lens[i] = len(a)
+        total_src += len(a)
+    cnts = np.ascontiguousarray(counts, np.int64)
+    if bool((cnts < 0).any()):
+        return None
+    n_vals = int(cnts.sum())
+    offsets = np.empty(n_vals + 1, np.int64)
+    values = np.empty(max(total_src, 1), np.uint8)
+    total = lib.pq_plain_ba_batch(ptrs, lens, cnts, n, offsets, values)
+    if total < 0:
+        raise ValueError(
+            f"PLAIN BYTE_ARRAY truncated in page {-int(total) - 1}")
+    return values[:total], offsets
 
 
 def assemble_levels(defs: np.ndarray, reps: np.ndarray, ks, dks, max_def: int):
