@@ -216,6 +216,11 @@ def plain_ba_batch(srcs, counts):
     if total < 0:
         raise ValueError(
             f"PLAIN BYTE_ARRAY truncated in page {-int(total) - 1}")
+    if total * 2 < len(values):
+        # short-string chunks: the worst-case buffer (raw section size,
+        # i.e. value bytes + 4 per string) would pin 2-5x the data for the
+        # column's lifetime — compact when the slack is half or more
+        return values[:total].copy(), offsets
     return values[:total], offsets
 
 
