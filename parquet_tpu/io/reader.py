@@ -480,6 +480,12 @@ class ParquetFile:
                     for leaf, per_leaf in zip(leaves, chunks)}
             parts = {p: [f.result() for f in fs] for p, fs in futs.items()}
         else:
+            # serial decode.  (A one-chunk IO-lookahead thread was tried
+            # here and REGRESSED on a single core: with the page cache
+            # mostly warm, pread is a CPU memcpy that competes with decode
+            # instead of overlapping disk wait — 15.0 s vs 10.3 s on the
+            # 2.7 GB lineitem read.  Multi-core hosts already overlap via
+            # the pool branch above.)
             parts = {leaf.dotted_path: [decode_chunk_host(c)
                                         for c in per_leaf]
                      for leaf, per_leaf in zip(leaves, chunks)}
