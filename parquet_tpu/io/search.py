@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from bisect import bisect_left, bisect_right
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -299,7 +299,8 @@ def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
 
 
 def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
-                   device: bool = False, aligned: bool = False):
+                   device: bool = False,
+                   aligned: "Union[bool, str]" = False):
     """Decode only the pages covering [row_start, row_start+row_count) of one
     column, trimming to the exact rows — the SeekToRow-then-read flow of
     SURVEY.md §3.3.  Flat columns return a host numpy array (or list of bytes
@@ -309,7 +310,11 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
     ``aligned=True`` (flat columns only) returns ``(values, validity)`` with
     one row-aligned entry per requested row — null slots hold a zero fill
     (``None`` for byte arrays) and ``validity`` marks them (``None`` when the
-    column span has no nulls)."""
+    column span has no nulls).  ``aligned="arrays"`` additionally keeps
+    BYTE_ARRAY spans columnar: ``values`` is ``("ba_arrays", uint8 bytes,
+    int64 offsets)`` over the DENSE present values (``validity`` maps rows
+    to value ordinals) — the no-python-objects form the scan path filters
+    before materializing."""
     from .column import concat_columns
     from .reader import decode_chunk_host
 
@@ -332,15 +337,22 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
         pages, first_row_of_pages = pages_and_base(
             chunk, remaining_start, remaining_start + take)
         col = decode_chunk_host(chunk, pages=iter(pages))
-        trim = (_trim_flat_aligned if aligned
-                else _trim_nested if nested else _trim_flat)
-        out_parts.append(trim(col, remaining_start - first_row_of_pages, take))
+        if aligned:
+            out_parts.append(_trim_flat_aligned(
+                col, remaining_start - first_row_of_pages, take,
+                arrays=aligned == "arrays"))
+        else:
+            trim = _trim_nested if nested else _trim_flat
+            out_parts.append(
+                trim(col, remaining_start - first_row_of_pages, take))
         remaining_start = 0
         remaining -= take
     if not out_parts:
         if not nested:
             if leaf.physical_type == Type.BYTE_ARRAY:
-                empty = []
+                empty = (("ba_arrays", np.empty(0, np.uint8),
+                          np.zeros(1, np.int64))
+                         if aligned == "arrays" else [])
             elif leaf.physical_type == Type.FIXED_LEN_BYTE_ARRAY:
                 empty = np.empty((0, leaf.type_length or 0), np.uint8)
             else:
@@ -364,13 +376,28 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
         val_parts = [p[1] for p in out_parts]
         if isinstance(vals_parts[0], list):
             vals = [v for part in vals_parts for v in part]
+        elif isinstance(vals_parts[0], tuple):  # ("ba_arrays", vals, offs)
+            if len(vals_parts) == 1:
+                vals = vals_parts[0]
+            else:
+                cat = np.concatenate([p[1] for p in vals_parts])
+                offs_parts, base = [], 0
+                for p in vals_parts:
+                    offs_parts.append(p[2][:-1] + base)
+                    base += int(p[2][-1])
+                offs_parts.append(np.array([base], np.int64))
+                vals = ("ba_arrays", cat, np.concatenate(offs_parts))
         else:
             vals = (vals_parts[0] if len(vals_parts) == 1
                     else np.concatenate(vals_parts))
         if all(v is None for v in val_parts):
             return vals, None
+
+        def _rows(p):  # row count of one aligned part
+            return len(p[2]) - 1 if isinstance(p, tuple) else len(p)
+
         validity = np.concatenate(
-            [v if v is not None else np.ones(len(p), bool)
+            [v if v is not None else np.ones(_rows(p), bool)
              for v, p in zip(val_parts, vals_parts)])
         return vals, validity
     if len(out_parts) == 1:
@@ -446,13 +473,32 @@ def _substrings(values, offs, start, count):
     return [values[offs[i] : offs[i + 1]].tobytes() for i in range(start, start + count)]
 
 
-def _trim_flat_aligned(col, offset: int, count: int):
+def _trim_flat_aligned(col, offset: int, count: int, arrays: bool = False):
     """Like :func:`_trim_flat` but row-aligned: returns ``(values, validity)``
     where ``values`` has exactly ``count`` entries (null slots hold a zero
     fill / ``None`` for byte arrays) and ``validity`` is a bool mask, or
-    ``None`` for non-nullable columns."""
+    ``None`` for non-nullable columns.
+
+    ``arrays=True`` keeps BYTE_ARRAY spans in columnar form — ``values``
+    becomes ``("ba_arrays", uint8 bytes, int64 offsets)`` over the DENSE
+    present values (validity maps rows to value ordinals).  Materializing a
+    python bytes object per row was the scan path's dominant cost; callers
+    that filter first only pay for selected rows."""
     if col.is_dictionary_encoded():
         col.materialize_host()  # same gate as _trim_flat
+    if arrays and col.offsets is not None:
+        offs = np.asarray(col.offsets, np.int64)
+        if col.validity is None:
+            vmask = None
+            v0, v1 = offset, offset + count
+        else:
+            validity = np.asarray(col.validity, bool)
+            vmask = validity[offset : offset + count]
+            v0 = int(np.count_nonzero(validity[:offset]))
+            v1 = v0 + int(np.count_nonzero(vmask))
+        base = int(offs[v0])
+        vals = np.asarray(col.values)[base : int(offs[v1])]
+        return ("ba_arrays", vals, offs[v0 : v1 + 1] - base), vmask
     if col.validity is None:
         return _trim_flat(col, offset, count), None
     validity = np.asarray(col.validity, bool)

@@ -30,6 +30,21 @@ from ..utils.pool import shared_pool as _pool
 _SYNC_EVERY = 8
 
 
+def _materialize_ba(values: np.ndarray, offs: np.ndarray,
+                    sel: np.ndarray) -> List[bytes]:
+    """Python bytes for the SELECTED value ordinals only (native gather of
+    the survivors, then one materialization pass)."""
+    if len(sel) == 0:
+        return []
+    from .. import native as _native
+
+    g = _native.gather_ba(values, offs, sel)
+    if g is None:  # shim unavailable: direct per-selected materialization
+        return [values[offs[i]:offs[i + 1]].tobytes() for i in sel]
+    gv, go = g
+    return [gv[go[i]:go[i + 1]].tobytes() for i in range(len(sel))]
+
+
 def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                   columns: Optional[Sequence[str]] = None,
                   num_threads: Optional[int] = None,
@@ -92,7 +107,13 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     def read_one(task):
         plan, c = task
         start = int(rg_base[plan.rg_index]) + plan.first_row
-        return read_row_range(pf, c, start, plan.row_count, aligned=True)
+        # output columns stay columnar ("arrays"): python bytes objects are
+        # materialized only for rows that survive the predicate below —
+        # per-row materialization of the full span was the scan's dominant
+        # cost on string output columns.  The key column keeps the
+        # materialized form (order-domain compares are per-value).
+        return read_row_range(pf, c, start, plan.row_count,
+                              aligned=True if c == path else "arrays")
 
     tasks = [(p, c) for p in plans for c in read_cols]
     # thread-pool dispatch costs ~100us/task: serial decode wins for small
@@ -158,7 +179,20 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                 mask &= key_valid
         for c in out_cols:
             vals, valid = span[c]
-            if isinstance(vals, list):
+            if isinstance(vals, tuple) and vals and vals[0] == "ba_arrays":
+                _, v_u8, offs = vals
+                idx = np.flatnonzero(mask)
+                if valid is None:
+                    parts[c].append(_materialize_ba(v_u8, offs, idx))
+                else:
+                    ords = np.cumsum(valid) - 1  # row -> dense value ordinal
+                    tv = np.asarray(valid, bool)[idx]
+                    got = _materialize_ba(v_u8, offs, ords[idx][tv])
+                    woven = [None] * len(idx)
+                    for p, v in zip(np.flatnonzero(tv), got):
+                        woven[p] = v
+                    parts[c].append(woven)
+            elif isinstance(vals, list):
                 idx = np.flatnonzero(mask)
                 parts[c].append([vals[i] for i in idx])
             else:
@@ -180,7 +214,10 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                 n_missing = len(vals) - sum(len(v) for v in vparts[c])
                 valid = np.concatenate(
                     ([np.ones(n_missing, bool)] if n_missing else []) + vparts[c])
-                out[c] = np.ma.MaskedArray(vals, mask=~valid)
+                mask = ~valid
+                if vals.ndim == 2:  # FLBA/INT96: (n, width) byte rows need a
+                    mask = np.broadcast_to(mask[:, None], vals.shape)
+                out[c] = np.ma.MaskedArray(vals, mask=mask)
             else:
                 out[c] = vals
         elif pf.schema.leaf(c).physical_type == Type.BYTE_ARRAY:

@@ -46,6 +46,7 @@ KINDS = [
     "plain_i64", "plain_i32", "plain_f8", "plain_f4", "plain_str",
     "dict_i64", "dict_str", "delta_i64", "delta_i32",
     "dlba_str", "dba_str", "bss_f8", "bss_f4", "bss_i4", "bss_f2",
+    "list_i64", "list_str",
 ]
 CODECS = ["none", "snappy", "zstd", "gzip", "lz4"]
 
@@ -94,6 +95,27 @@ def _make_table(kind: str, n: int, nullable: bool, rng):
         raw = np.sort(rng.integers(0, 1 << 30, n))
         raw = [f"pfx{int(x):08d}" for x in raw]
         enc = "DELTA_BYTE_ARRAY"
+    elif kind.startswith("list_"):
+        # repeated columns: def/rep level streams + the nested assemblers
+        lens = rng.integers(0, 7, n)
+        lens[rng.random(n) < 0.1] = 0
+        offs = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offs[1:])
+        total = int(offs[-1])
+        if kind == "list_i64":
+            inner = pa.array(rng.integers(0, int(rng.integers(2, 50_000)),
+                                          max(total, 1))[:total])
+            use_dict = bool(rng.random() < 0.5)
+        else:
+            card = int(rng.integers(2, 2000))
+            inner = pa.array([f"e{int(x)}" for x in
+                              rng.integers(0, card, total)])
+            use_dict = True
+        mask = (rng.random(n) < 0.15) if nullable else None
+        v = pa.ListArray.from_arrays(
+            pa.array(offs), inner,
+            mask=pa.array(mask) if mask is not None else None)
+        return pa.table({"c": v}), None, use_dict
     elif kind.startswith("bss_"):
         dt = {"f8": np.float64, "f4": np.float32,
               "i4": np.int32, "f2": np.float16}[kind[4:]]

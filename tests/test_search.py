@@ -587,3 +587,29 @@ def test_aligned_row_range_nullable_dict_strings(rng):
            else (vals[i] if isinstance(vals[i], str) else vals[i].decode())
            for i in range(200)]
     assert got == want
+
+
+def test_scan_nullable_flba_output_column(rng):
+    """Nullable FLBA (decimal) output columns: the (n, width) byte rows need
+    a broadcast mask (review r4: MaskError crash on 1-D mask vs 2-D data)."""
+    import decimal
+
+    n = 4000
+    k = np.sort(rng.integers(0, 100, n))
+    dec = [None if rng.random() < 0.3
+           else decimal.Decimal(int(rng.integers(0, 10**9))) / 100
+           for _ in range(n)]
+    t = pa.table({"k": pa.array(k),
+                  "d": pa.array(dec, type=pa.decimal128(20, 2))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression="snappy")
+    from parquet_tpu.parallel.host_scan import scan_filtered as _sf
+
+    out = _sf(ParquetFile(buf.getvalue()), "k", lo=50, hi=60,
+              columns=["d"])
+    import pyarrow.compute as pc
+
+    want = int(pc.sum(pc.and_(pc.greater_equal(t.column("k"), 50),
+                              pc.less_equal(t.column("k"), 60))).as_py())
+    assert len(out["d"]) == want
+    assert isinstance(out["d"], np.ma.MaskedArray)
