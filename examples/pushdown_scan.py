@@ -1,0 +1,57 @@
+"""Predicate-pushdown scan — Find/SeekToRow + zone maps + bloom filters
+(SURVEY.md §3.3): only pages whose statistics overlap the predicate are
+ever decompressed.
+
+Run: python examples/pushdown_scan.py
+"""
+
+import io
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from parquet_tpu import (ParquetFile, WriterOptions, scan_filtered,
+                         write_table)
+
+
+def main() -> None:
+    import pyarrow as pa
+
+    rng = np.random.default_rng(0)
+    n = 1_000_000
+    t = pa.table({
+        "ts": pa.array(np.sort(rng.integers(0, 10_000_000, n))),  # sorted key
+        "account": pa.array(rng.integers(0, 50_000, n)),
+        "amount": pa.array(rng.random(n) * 1e4),
+        "memo": pa.array(np.array([f"memo_{i:03d}" for i in range(500)])[
+            rng.integers(0, 500, n)]),
+    })
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(
+        compression="zstd", write_page_index=True,
+        bloom_filters={"account": 10}))  # bits per value
+    pf = ParquetFile(buf.getvalue())
+
+    # range predicate on the sorted key: the column index prunes pages
+    out = scan_filtered(pf, "ts", lo=5_000_000, hi=5_100_000,
+                        columns=["account", "amount"])
+    print(f"ts in [5.0M, 5.1M]: {len(out['account'])} rows, "
+          f"sum(amount) = {out['amount'].sum():.2f}")
+
+    # point lookup on an unsorted key: bloom filters + stats prune chunks
+    probe = int(t.column("account")[123].as_py())
+    out = scan_filtered(pf, "account", lo=probe, hi=probe, columns=["ts"])
+    print(f"account == {probe}: {len(out['ts'])} rows")
+
+    # IN-list pushdown
+    probes = [int(t.column("account")[i].as_py()) for i in (1, 99, 10_000)]
+    out = scan_filtered(pf, "account", values=probes, columns=["memo"])
+    print(f"account IN {probes}: {len(out['memo'])} rows, "
+          f"first memo = {out['memo'][0]!r}")
+
+
+if __name__ == "__main__":
+    main()

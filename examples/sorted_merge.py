@@ -1,0 +1,69 @@
+"""Sorted writing and k-way merge — SortingWriter + MergeRowGroups
+(SURVEY.md §3.4/§3.5): spill sorted runs with bounded memory, then merge
+many sorted files into one, streaming.
+
+Run: python examples/sorted_merge.py
+"""
+
+import io
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from parquet_tpu import (ParquetFile, SortingColumn, SortingWriter,
+                         WriterOptions, merge_files)
+from parquet_tpu.io.writer import schema_from_arrow
+
+
+def make_table(rng, n):
+    import pyarrow as pa
+
+    return pa.table({
+        "key": pa.array(rng.integers(0, 1 << 40, n)),
+        "payload": pa.array(rng.random(n)),
+    })
+
+
+def main() -> None:
+    rng = np.random.default_rng(1)
+    schema = schema_from_arrow(make_table(rng, 1).schema)
+    sorting = [SortingColumn("key")]
+
+    # 1) SortingWriter: feed unsorted rows, get a sorted file (spills
+    #    sorted runs, merges on close — bounded memory)
+    sw_buf = io.BytesIO()
+    with SortingWriter(sw_buf, schema, sorting,
+                       options=WriterOptions(compression="snappy"),
+                       buffer_rows=50_000) as sw:
+        for _ in range(8):
+            sw.write_arrow(make_table(rng, 100_000))
+    keys = np.asarray(
+        ParquetFile(sw_buf.getvalue()).read().to_arrow().column("key"))
+    assert np.all(keys[1:] >= keys[:-1]), "file must be globally sorted"
+    print(f"SortingWriter: {len(keys)} rows globally sorted, "
+          f"{sw_buf.tell()} bytes")
+
+    # 2) merge_files: k sorted inputs -> one sorted output, streaming
+    inputs = []
+    for _ in range(4):
+        b = io.BytesIO()
+        with SortingWriter(b, schema, sorting,
+                           options=WriterOptions(compression="snappy"),
+                           buffer_rows=50_000) as sw:
+            sw.write_arrow(make_table(rng, 50_000))
+        inputs.append(b.getvalue())
+    out = io.BytesIO()
+    merge_files(inputs, sorting, out)
+    merged = np.asarray(
+        ParquetFile(out.getvalue()).read().to_arrow().column("key"))
+    assert len(merged) == 200_000
+    assert np.all(merged[1:] >= merged[:-1])
+    print(f"merge_files: 4 x 50k rows -> {len(merged)} rows sorted, "
+          f"{out.tell()} bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""TPC-H Q1-style aggregation on the TPU path: decode lineitem columns to
+device arrays (`read_pytree`) and run the groupby-aggregate as one jitted
+XLA program — the "decode on device, compute on device" flow the
+framework exists for (BASELINE.md north star).
+
+On a real TPU the decode kernels and the aggregation share HBM with no
+host round trip; on CPU the same program runs on the XLA CPU backend.
+
+Run: python examples/tpch_q1_tpu.py [rows]
+"""
+
+import io
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from parquet_tpu import ParquetFile, read_pytree
+
+
+def make_lineitem(n: int) -> bytes:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    # TPU-native dtypes: f32/i32 decode straight to device arrays (64-bit
+    # columns come back as uint32 PAIRS on device — the x64-free design of
+    # ops/device.py — which suits filters/gathers, not float arithmetic)
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "l_returnflag": pa.array(rng.integers(0, 3, n).astype(np.int32)),
+        "l_linestatus": pa.array(rng.integers(0, 2, n).astype(np.int32)),
+        "l_quantity": pa.array(rng.integers(1, 51, n).astype(np.float32)),
+        "l_extendedprice": pa.array((rng.random(n) * 1e5).astype(np.float32)),
+        "l_discount": pa.array((rng.random(n) * 0.1).astype(np.float32)),
+        "l_tax": pa.array((rng.random(n) * 0.08).astype(np.float32)),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression="snappy")
+    return buf.getvalue()
+
+
+@jax.jit
+def q1(flag, status, qty, price, disc, tax):
+    """sum/avg aggregates per (returnflag, linestatus) group — segment_sum
+    over a static 6-group id space (3 flags x 2 statuses)."""
+    gid = flag * 2 + status
+    disc_price = price * (1.0 - disc)
+    charge = disc_price * (1.0 + tax)
+    ones = jnp.ones_like(qty)
+
+    def seg(x):
+        return jax.ops.segment_sum(x, gid, num_segments=6)
+
+    count = seg(ones)
+    safe = jnp.maximum(count, 1.0)
+    return {
+        "sum_qty": seg(qty),
+        "sum_base_price": seg(price),
+        "sum_disc_price": seg(disc_price),
+        "sum_charge": seg(charge),
+        "avg_qty": seg(qty) / safe,
+        "avg_price": seg(price) / safe,
+        "avg_disc": seg(disc) / safe,
+        "count": count,
+    }
+
+
+def main(n: int) -> None:
+    raw = make_lineitem(n)
+    cols = read_pytree(ParquetFile(raw), device=True)
+    out = q1(cols["l_returnflag"], cols["l_linestatus"],
+             cols["l_quantity"], cols["l_extendedprice"],
+             cols["l_discount"], cols["l_tax"])
+    out = jax.tree_util.tree_map(np.asarray, out)
+    print(f"backend={jax.default_backend()}  rows={n}")
+    for g in range(6):
+        if out["count"][g] == 0:
+            continue
+        print(f"  group flag={g//2} status={g%2}: count={out['count'][g]:.0f}"
+              f" sum_qty={out['sum_qty'][g]:.0f}"
+              f" avg_price={out['avg_price'][g]:.2f}"
+              f" sum_charge={out['sum_charge'][g]:.2f}")
+    # numpy oracle
+    flag = np.asarray(cols["l_returnflag"]).reshape(-1)
+    qty = np.asarray(cols["l_quantity"]).reshape(-1)
+    status = np.asarray(cols["l_linestatus"]).reshape(-1)
+    gid = flag * 2 + status
+    want = np.bincount(gid, weights=qty, minlength=6)
+    np.testing.assert_allclose(out["sum_qty"], want, rtol=1e-4)
+    print("sum_qty matches the numpy oracle")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)
