@@ -91,7 +91,10 @@ def main(n: int) -> None:
     status = np.asarray(cols["l_linestatus"]).reshape(-1)
     gid = flag * 2 + status
     want = np.bincount(gid, weights=qty, minlength=6)
-    np.testing.assert_allclose(out["sum_qty"], want, rtol=1e-4)
+    # f32 sequential accumulation error grows ~sqrt(group size) — scale
+    # the tolerance so large --rows runs don't fail on float noise
+    np.testing.assert_allclose(out["sum_qty"], want,
+                               rtol=max(1e-4, 3e-7 * float(np.sqrt(n))))
     print("sum_qty matches the numpy oracle")
 
 

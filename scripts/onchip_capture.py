@@ -105,10 +105,16 @@ def merge_artifact(kind: str, status: str):
     of on-chip configs recorded, or None when the run was not on-chip (a
     bench that silently fell back to CPU must not mark its queue item
     done)."""
-    try:
-        with open(CKPT) as f:
-            part = json.load(f)
-    except (OSError, ValueError):
+    # a bench that timed out on TPU mid-run preserves its completed on-chip
+    # configs at .tpu_partial before re-execing onto CPU — prefer that
+    for path in (CKPT + ".tpu_partial", CKPT):
+        try:
+            with open(path) as f:
+                part = json.load(f)
+            break
+        except (OSError, ValueError):
+            part = None
+    if part is None:
         return None
     if "tpu" not in str(part.get("backend", "")).lower():
         log(f"{kind} run completed on {part.get('backend')} — not on-chip, "
@@ -173,10 +179,11 @@ def main() -> int:
         item = next(k for k, v in done.items() if not v)
         log(f"tunnel UP — attempt {attempt}: {item}")
         if item in ("quick", "full"):
-            try:
-                os.remove(CKPT)
-            except OSError:
-                pass
+            for stale in (CKPT, CKPT + ".tpu_partial"):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
             status = run_bench(quick=item == "quick", stall_s=stall_s)
             n_onchip = merge_artifact(item, status)
             complete = (item == "full" and status == "ok"
