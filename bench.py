@@ -665,9 +665,9 @@ def main():
     # complete artifact.  CPU runs cannot hang; no thread wrapper there.
     cfg_timeout = float(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 900))
 
-    def _run(name, fn, *a):
-        import threading
+    import threading
 
+    def _run(name, fn, *a):
         t0 = time.time()
         if tpu_ok and cfg_timeout > 0:
             result = {}

@@ -950,7 +950,10 @@ def _min_max_from_dict(leaf: Leaf, dict_values, dict_offsets, idx_span,
     else:
         if len(idx_span) == 0:
             return None, None
-        ids = np.flatnonzero(np.bincount(idx_span, minlength=max(dict_n, 1)))
+        # tiny spans (the rank cache passes exactly {min_id, max_id}) skip
+        # the dict_n-sized bincount allocation
+        ids = (np.unique(idx_span) if len(idx_span) <= 64 else
+               np.flatnonzero(np.bincount(idx_span, minlength=max(dict_n, 1))))
         if dict_offsets is not None:
             sel_vals, sel_offs = ref.gather_dictionary(
                 (dict_values, dict_offsets), ids.astype(np.int64))

@@ -194,18 +194,8 @@ def plain_ba_batch(srcs, counts):
     if lib is None:
         return None
     n = len(srcs)
-    ptrs = np.empty(max(n, 1), np.int64)
-    lens = np.empty(max(n, 1), np.int64)
-    keep = []
-    total_src = 0
-    for i, s in enumerate(srcs):
-        a = s if isinstance(s, np.ndarray) else np.frombuffer(s, np.uint8)
-        if not a.flags.c_contiguous:
-            a = np.ascontiguousarray(a)
-        keep.append(a)  # hold refs: the C call reads raw pointers
-        ptrs[i] = a.ctypes.data if len(a) else 0
-        lens[i] = len(a)
-        total_src += len(a)
+    ptrs, lens, keep = _src_pointers(srcs)
+    total_src = int(lens[:n].sum()) if n else 0
     cnts = np.ascontiguousarray(counts, np.int64)
     if bool((cnts < 0).any()):
         return None
@@ -726,6 +716,24 @@ def delta_byte_array_expand(prefix_lens, suffix_data, suffix_offsets, out_offset
     return out[:total]
 
 
+def _src_pointers(srcs):
+    """Marshal bytes-like page payloads into (ptrs, lens, keep) for native
+    calls that read per-page raw pointers.  ``keep`` must stay referenced
+    for the duration of the call."""
+    n = len(srcs)
+    ptrs = np.empty(max(n, 1), np.int64)
+    lens = np.empty(max(n, 1), np.int64)
+    keep = []
+    for i, s in enumerate(srcs):
+        a = s if isinstance(s, np.ndarray) else np.frombuffer(s, np.uint8)
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        keep.append(a)
+        ptrs[i] = a.ctypes.data if len(a) else 0
+        lens[i] = len(a)
+    return ptrs, lens, keep
+
+
 def decompress_pages(srcs, out_sizes, codec_id: int, nthreads: int = 1):
     """Decompress many page payloads in ONE native call (snappy/zstd via
     the dlopen'd system libs; 0 = memcpy).  ``srcs`` is a sequence of
@@ -746,16 +754,7 @@ def decompress_pages(srcs, out_sizes, codec_id: int, nthreads: int = 1):
     sizes_arr = np.asarray(out_sizes, np.int64)
     if len(sizes_arr) != n or bool((sizes_arr < 0).any()):
         return None
-    ptrs = np.empty(n, np.int64)
-    lens = np.empty(n, np.int64)
-    keep = []
-    for i, s in enumerate(srcs):
-        a = s if isinstance(s, np.ndarray) else np.frombuffer(s, np.uint8)
-        if not a.flags.c_contiguous:
-            a = np.ascontiguousarray(a)
-        keep.append(a)  # hold refs: the C call reads raw pointers
-        ptrs[i] = a.ctypes.data if len(a) else 0
-        lens[i] = len(a)
+    ptrs, lens, keep = _src_pointers(srcs)
     offs = np.zeros(n + 1, np.int64)
     np.cumsum(sizes_arr, out=offs[1:])
     out = np.empty(max(int(offs[-1]), 1), np.uint8)
