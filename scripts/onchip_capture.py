@@ -197,16 +197,21 @@ def main() -> int:
                     log("full-size on-chip artifact captured")
                 continue  # escalate immediately while the tunnel is up
         elif item == "trials":
+            # remote Pallas compiles ride the tunnel with the local CPU
+            # idle — give compile-heavy items a much wider stall window
             status = run_watched(
                 [sys.executable, "scripts/onchip_trials.py"],
-                dict(os.environ), stall_s, "trials")
+                dict(os.environ), max(stall_s, 900), "trials")
             done[item] = status == "ok"
             if done[item]:
                 continue
         else:
+            # 60 trials: each on-chip trial pays tunnel round-trips and
+            # possible recompiles; enough for device-route evidence without
+            # eating the whole window
             status = run_watched(
-                [sys.executable, "scripts/route_soak.py", "150", "4"],
-                dict(os.environ), stall_s, "soak")
+                [sys.executable, "scripts/route_soak.py", "60", "4"],
+                dict(os.environ), max(stall_s, 900), "soak")
             done[item] = status == "ok"
             if done[item]:
                 continue
