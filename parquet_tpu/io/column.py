@@ -218,6 +218,32 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
     return _fixed_with_nulls(flat, validity, pa.from_numpy_dtype(flat.dtype))
 
 
+def empty_column(leaf: Leaf) -> Column:
+    """A valid zero-row Column for ``leaf`` (typed empty arrays; nested
+    leaves get empty level streams through the assembler) — the shape an
+    empty row-group selection or an empty page span decodes to."""
+    from ..ops import levels as levels_ops
+
+    nested = leaf.max_repetition_level > 0
+    empty_lv = np.zeros(0, np.int32)
+    asm = levels_ops.assemble(empty_lv if nested else None,
+                              empty_lv if nested else None, leaf)
+    if leaf.physical_type == Type.BYTE_ARRAY:
+        values = np.empty(0, np.uint8)
+        offsets = np.zeros(1, np.int32)
+    elif leaf.physical_type == Type.FIXED_LEN_BYTE_ARRAY:
+        values = np.empty((0, leaf.type_length or 0), np.uint8)
+        offsets = None
+    else:
+        values = np.empty(0, leaf.np_dtype() or np.uint8)
+        offsets = None
+    return Column(leaf=leaf, values=values, offsets=offsets,
+                  validity=asm.validity, list_offsets=asm.list_offsets,
+                  list_validity=asm.list_validity, num_slots=0,
+                  def_levels=empty_lv if nested else None,
+                  rep_levels=empty_lv if nested else None)
+
+
 def concat_columns(parts: List[Column]) -> Column:
     """Concatenate per-row-group chunks of the same leaf into one Column.
 

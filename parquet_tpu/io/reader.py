@@ -436,14 +436,36 @@ class ParquetFile:
         return _iter(self, columns=columns, batch_rows=batch_rows)
 
     def read(self, columns: Optional[Sequence[str]] = None,
-             device: bool = False) -> "Table":
+             device: bool = False,
+             row_groups: Optional[Sequence[int]] = None) -> "Table":
         """Read and decode the whole file.
 
         ``device=False``: host numpy oracle path.  ``device=True``: the TPU
         path — batched H2D staging + XLA kernels (parallel/device_reader.py).
+        ``row_groups`` selects a subset by index (reference parity: callers
+        of ``File.RowGroups()`` read chosen groups; also the unit the mesh
+        shards over).
         """
         leaves = _select_leaves(self.schema, columns)
-        n_rg = len(self.metadata.row_groups or [])
+        all_rg = range(len(self.metadata.row_groups or []))
+        if row_groups is None:
+            rg_sel = list(all_rg)
+            total_rows = self.num_rows
+        else:
+            rg_sel = list(row_groups)
+            for i in rg_sel:
+                if i not in all_rg:
+                    raise IndexError(
+                        f"row group {i} out of range [0, {len(all_rg)})")
+            total_rows = sum(self.metadata.row_groups[i].num_rows
+                             for i in rg_sel)
+        n_rg = len(rg_sel)
+        if not rg_sel:  # empty selection → a valid zero-row table
+            from .column import empty_column
+
+            return Table(self.schema,
+                         {leaf.dotted_path: empty_column(leaf)
+                          for leaf in leaves}, 0)
         if device:
             # double-buffered pipeline across every (leaf, row-group) chunk:
             # host prescan + H2D of later chunks overlaps device decode of
@@ -451,18 +473,18 @@ class ParquetFile:
             from ..parallel.device_reader import decode_chunks_pipelined
 
             chunks = [self.row_group(i).column(leaf.column_index)
-                      for leaf in leaves for i in range(n_rg)]
+                      for leaf in leaves for i in rg_sel]
             decoded = decode_chunks_pipelined(chunks)
             dparts = {leaf.dotted_path: [next(decoded) for _ in range(n_rg)]
                       for leaf in leaves}
-            return Table(self.schema, None, self.num_rows, parts=dparts)
+            return Table(self.schema, None, total_rows, parts=dparts)
         # fan the (leaf, row-group) chunks across the shared pool — the
         # reference's read path is goroutine-parallel by design (SURVEY.md
         # §2.5a caller-driven fan-out); decompress/decode release the GIL in
         # the codec and native layers, so threads scale on host.  Chunk
         # readers are built serially (metadata memoization isn't locked).
         chunks = [[self.row_group(i).column(leaf.column_index)
-                   for i in range(n_rg)] for leaf in leaves]
+                   for i in rg_sel] for leaf in leaves]
         # same measured crossover as parallel/host_scan.py: under ~2M cells
         # the per-task dispatch overhead beats the decode win.  On a single
         # core, threads are a pure loss for whole-chunk decode: per-thread
@@ -471,7 +493,7 @@ class ParquetFile:
         from ..utils.pool import available_cpus
 
         if (n_rg * len(leaves) > 1 and available_cpus() > 1
-                and self.num_rows * len(leaves) >= 2_000_000):
+                and total_rows * len(leaves) >= 2_000_000):
             from ..utils.pool import shared_pool
 
             pool = shared_pool()
@@ -489,7 +511,7 @@ class ParquetFile:
             parts = {leaf.dotted_path: [decode_chunk_host(c)
                                         for c in per_leaf]
                      for leaf, per_leaf in zip(leaves, chunks)}
-        return Table(self.schema, None, self.num_rows, parts=parts)
+        return Table(self.schema, None, total_rows, parts=parts)
 
     def close(self):
         self.source.close()
