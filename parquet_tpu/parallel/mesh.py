@@ -16,7 +16,7 @@ step over a mesh (the "training step" analog, exercised by the driver's
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..format.enums import Encoding
 from ..io.column import Column
 from ..io.reader import ParquetFile
 from ..ops import device as dev
@@ -50,12 +51,25 @@ class ShardedTable:
     row-aligned bool array sharded identically; padded and null slots hold
     zero fill in ``arrays[path]``. 64-bit columns use the (n, 2) uint32 pair
     representation (``ops.device.pairs_to_host``).
+
+    Dictionary-encoded BYTE_ARRAY columns shard their int32 INDEX stream in
+    ``arrays[path]``; the row-group dictionaries are UNIFIED (deduplicated
+    across groups — equal ids mean equal strings, so filters, group-bys and
+    joins on the index stream are exact on device) into one host
+    ``dictionaries[path] = (uint8 values, int64 offsets)`` shared by every
+    shard — ``lookup_strings(path, ids)`` materializes entries.
     """
 
     arrays: Dict[str, jax.Array]
     validity: Dict[str, jax.Array]
     row_counts: tuple
     mesh: Mesh
+    dictionaries: Dict[str, tuple] = field(default_factory=dict)
+
+    def lookup_strings(self, path: str, ids) -> list:
+        """Materialize dictionary entries for index values of ``path``."""
+        dvals, doffs = self.dictionaries[path]
+        return [bytes(dvals[doffs[i]:doffs[i + 1]]) for i in np.asarray(ids)]
 
     @property
     def shard_rows(self) -> int:
@@ -101,6 +115,48 @@ def _decode_prepped(reader, prep_out):
     return col, n_nulls
 
 
+def _unify_dictionaries(dv_parts: List[np.ndarray],
+                        do_parts: List[np.ndarray]):
+    """Deduplicate per-row-group dictionaries into one unified dictionary.
+
+    Returns ``(values, offsets, remap)`` where ``remap[concat_id] ->
+    unified id`` over the concatenation of the input dictionaries in order.
+    Unified ids are first-occurrence ordered, so equal ids ⇔ equal strings
+    across every row group — the property device-side filters/joins on the
+    sharded index stream rely on."""
+    from .. import native as _native
+    from ..ops import ref
+
+    cat_vals = np.concatenate(dv_parts)
+    offs_out, byte_base = [], 0
+    for o in do_parts:
+        offs_out.append(np.asarray(o[:-1], np.int64) + byte_base)
+        byte_base += int(o[-1])
+    offs_out.append(np.array([byte_base], np.int64))
+    cat_offs = np.concatenate(offs_out)
+    n = len(cat_offs) - 1
+    res = _native.dict_build_ba(cat_vals, cat_offs, n + 1)
+    if res is None or isinstance(res, str):
+        # shim unavailable, or the near-unique sampling bail fired (a
+        # mostly-disjoint dictionary set): python dedup, same semantics
+        seen: Dict[bytes, int] = {}
+        remap = np.empty(n, np.int64)
+        keep = []
+        for i in range(n):
+            key = bytes(cat_vals[cat_offs[i]:cat_offs[i + 1]])
+            uid = seen.setdefault(key, len(seen))
+            if uid == len(keep):
+                keep.append(i)
+            remap[i] = uid
+        first_rows = np.array(keep, np.int64)
+    else:
+        remap, first_rows = res
+        remap = np.asarray(remap, np.int64)
+    uvals, uoffs = ref.gather_dictionary((cat_vals, cat_offs),
+                                         np.asarray(first_rows, np.int64))
+    return uvals, np.asarray(uoffs, np.int64), remap
+
+
 def read_table_sharded(source, mesh: Optional[Mesh] = None,
                        columns: Optional[Sequence[str]] = None,
                        axis: str = "data",
@@ -111,10 +167,14 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
     phase (pread + decompress + prescan + H2D put targeted at each chunk's
     device) fans out across a thread pool so all devices stage concurrently
     (SURVEY.md §2.5 data-parallel row); decode dispatches are async, so
-    device work overlaps too. Columns must be flat and fixed-width
-    (BOOLEAN/INT32/INT64/FLOAT/DOUBLE/FLBA — 64-bit as (n, 2) uint32
-    pairs); BYTE_ARRAY and nested columns raise ValueError (read them with
-    ``ParquetFile.read(device=True)``, which keeps ragged forms).
+    device work overlaps too. Columns must be flat: fixed-width values
+    shard directly (BOOLEAN/INT32/INT64/FLOAT/DOUBLE/FLBA — 64-bit as
+    (n, 2) uint32 pairs), and dictionary-encoded BYTE_ARRAY columns shard
+    their int32 index stream with the per-row-group dictionaries
+    concatenated index-rebased into ``ShardedTable.dictionaries[path]``.
+    PLAIN-encoded (non-dictionary) string columns and nested columns raise
+    ValueError (read them with ``ParquetFile.read(device=True)``, which
+    keeps ragged forms).
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -126,16 +186,32 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
     pf = source if isinstance(source, ParquetFile) else ParquetFile(source)
     leaves = (pf.schema.leaves if columns is None
               else [pf.schema.leaf(c) for c in columns])
+    n_rg = len(pf.metadata.row_groups or [])
     for leaf in leaves:
-        if leaf.max_repetition_level > 0 or leaf.physical_type in (
-                Type.BYTE_ARRAY,):
+        if leaf.max_repetition_level > 0:
             raise ValueError(
                 f"read_table_sharded: column {leaf.dotted_path!r} is "
-                "nested or ragged; use ParquetFile.read(device=True)")
-    n_rg = len(pf.metadata.row_groups or [])
+                "nested; use ParquetFile.read(device=True)")
+        if leaf.physical_type == Type.BYTE_ARRAY:
+            # reject PLAIN string chunks UP FRONT from the chunk metadata —
+            # discovering it after the whole file was read and staged would
+            # waste the entire read on an error path
+            for rg in range(n_rg):
+                encs = (pf.metadata.row_groups[rg]
+                        .columns[leaf.column_index].meta_data.encodings
+                        or [])
+                if not any(int(e) in (int(Encoding.PLAIN_DICTIONARY),
+                                      int(Encoding.RLE_DICTIONARY))
+                           for e in encs):
+                    raise ValueError(
+                        f"read_table_sharded: column {leaf.dotted_path!r} "
+                        f"has a PLAIN-encoded (non-dictionary) string chunk "
+                        f"(row group {rg}) — ragged values cannot shard "
+                        "densely; use ParquetFile.read(device=True)")
     if n_rg == 0:
         return ShardedTable(arrays={}, validity={},
-                            row_counts=(0,) * len(devs), mesh=mesh)
+                            row_counts=(0,) * len(devs), mesh=mesh,
+                            dictionaries={})
     tasks = [(leaf, rg) for leaf in leaves for rg in range(n_rg)]
 
     def prep(task):
@@ -152,23 +228,46 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
 
     arrays: Dict[str, jax.Array] = {}
     validities: Dict[str, jax.Array] = {}
+    dictionaries: Dict[str, tuple] = {}
     rg_rows = [pf.row_group(i).num_rows for i in range(n_rg)]
     shard_counts = [sum(rg_rows[rg] for rg in range(n_rg)
                         if rg % len(devs) == d) for d in range(len(devs))]
     maxlen = max(shard_counts) if shard_counts else 0
     for leaf in leaves:
+        is_ba = leaf.physical_type == Type.BYTE_ARRAY
         per_dev_vals: Dict[int, List[jax.Array]] = {}
         per_dev_valid: Dict[int, List[jax.Array]] = {}
         has_nulls = False
+        ba_parts = []  # (device, indices, validity, n_nulls) per row group
+        dict_vals_parts: List[np.ndarray] = []
+        dict_offs_parts: List[np.ndarray] = []
         for (prep_out, reader), (l2, rg) in zip(prepped, tasks):
             if l2 is not leaf:
                 continue
             d = rg % len(devs)
             with jax.default_device(devs[d]):
                 col, n_nulls = _decode_prepped(reader, prep_out)
+                if is_ba:
+                    if not col.is_dictionary_encoded():
+                        raise ValueError(
+                            f"read_table_sharded: column "
+                            f"{leaf.dotted_path!r} has a PLAIN-encoded "
+                            "(non-dictionary) string chunk — ragged values "
+                            "cannot shard densely; use "
+                            "ParquetFile.read(device=True)")
+                    dvals, doffs = col._host_dictionary()
+                    dict_vals_parts.append(np.asarray(dvals))
+                    dict_offs_parts.append(np.asarray(doffs, np.int64))
+                    # index placement deferred until the dictionaries are
+                    # unified below (ids must mean the same string on
+                    # every shard for device-side filters/joins)
+                    ba_parts.append((d, col.dict_indices, col.validity,
+                                     n_nulls))
+                    continue
                 vals = col.values
                 if col.is_dictionary_encoded():
-                    vals = dev.dict_gather(col.dictionary, col.dict_indices)
+                    vals = dev.dict_gather(col.dictionary,
+                                           col.dict_indices)
                 if not isinstance(vals, jax.Array):
                     vals = jnp.asarray(vals)
                 valid = col.validity
@@ -181,6 +280,30 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
                     valid = None  # nullable schema, no actual nulls
             per_dev_vals.setdefault(d, []).append(vals)
             per_dev_valid.setdefault(d, []).append(valid)
+        if is_ba and dict_vals_parts:
+            uvals, uoffs, remap = _unify_dictionaries(dict_vals_parts,
+                                                      dict_offs_parts)
+            dictionaries[leaf.dotted_path] = (uvals, uoffs)
+            base = 0
+            for (d, idx, valid, n_nulls), doffs in zip(ba_parts,
+                                                       dict_offs_parts):
+                n_i = len(doffs) - 1
+                sub = remap[base:base + n_i].astype(np.int32)
+                base += n_i
+                with jax.default_device(devs[d]):
+                    if isinstance(idx, jax.Array):  # device route: gather
+                        vals = jnp.asarray(sub)[idx]
+                    else:
+                        vals = jnp.asarray(sub[np.asarray(idx, np.int64)])
+                    if valid is not None and n_nulls:
+                        if not isinstance(valid, jax.Array):
+                            valid = jnp.asarray(valid)
+                        vals = dev.scatter_valid(vals, valid)
+                        has_nulls = True
+                    else:
+                        valid = None
+                per_dev_vals.setdefault(d, []).append(vals)
+                per_dev_valid.setdefault(d, []).append(valid)
         template = next(p[0] for p in per_dev_vals.values() if p)
         shard_arrays, shard_valid = [], []
         for d in range(len(devs)):
@@ -215,7 +338,8 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
                 jax.make_array_from_single_device_arrays(
                     (maxlen * len(shard_valid),), vsharding, shard_valid)
     return ShardedTable(arrays=arrays, validity=validities,
-                        row_counts=tuple(shard_counts), mesh=mesh)
+                        row_counts=tuple(shard_counts), mesh=mesh,
+                        dictionaries=dictionaries)
 
 
 # ---------------------------------------------------------------------------

@@ -88,17 +88,75 @@ def test_read_table_sharded_8dev_uneven():
     assert total == 30000
 
 
-def test_read_table_sharded_rejects_ragged():
+def test_read_table_sharded_rejects_plain_strings_and_nested():
+    # PLAIN-encoded (non-dictionary) strings are ragged — no dense shard
     t = pa.table({"s": pa.array(["a", "b", "c"]),
                   "x": pa.array([1, 2, 3], type=pa.int64())})
     buf = io.BytesIO()
-    pq.write_table(t, buf)
-    with pytest.raises(ValueError, match="nested or ragged"):
+    pq.write_table(t, buf, use_dictionary=False)
+    with pytest.raises(ValueError, match="PLAIN-encoded"):
         read_table_sharded(buf.getvalue(), mesh=default_mesh(8))
     # explicit fixed-width selection works
     st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8),
                             columns=["x"])
     assert st.num_rows == 3
+    # nested columns always raise
+    tn = pa.table({"l": pa.array([[1], [2, 3], []])})
+    bufn = io.BytesIO()
+    pq.write_table(tn, bufn)
+    with pytest.raises(ValueError, match="nested"):
+        read_table_sharded(bufn.getvalue(), mesh=default_mesh(8))
+
+
+def test_read_table_sharded_dict_strings():
+    """Dictionary-encoded string columns shard their index stream; the
+    per-row-group dictionaries concatenate index-rebased (the sharded
+    scan's dictionary output layout)."""
+    rng = np.random.default_rng(5)
+    n, rgs = 24_000, 5
+    cats = np.array([f"mode_{i:02d}" for i in range(37)])
+    s = cats[rng.integers(0, 37, n)]
+    t = pa.table({
+        "s": pa.array(s),
+        "sn": pa.array(s, mask=rng.random(n) < 0.3),
+        "x": pa.array(np.arange(n, dtype=np.int64)),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=(n + rgs - 1) // rgs,
+                   compression="snappy")
+    mesh = default_mesh(8)
+    st = read_table_sharded(buf.getvalue(), mesh=mesh)
+    assert st.num_rows == n
+    assert "s" in st.dictionaries and "sn" in st.dictionaries
+    # dictionaries are UNIFIED across row groups: 37 entries, not 5x37 —
+    # device-side id equality means string equality
+    assert len(st.dictionaries["s"][1]) - 1 == 37
+
+    # reconstruct: per-shard indices -> dictionary entries == source rows
+    pf = ParquetFile(buf.getvalue())
+    n_rg = len(pf.row_groups)
+    want_rows = {d: np.concatenate(
+        [np.arange(rg * ((n + rgs - 1) // rgs),
+                   min((rg + 1) * ((n + rgs - 1) // rgs), n))
+         for rg in range(n_rg) if rg % 8 == d] or [np.zeros(0, np.int64)])
+        for d in range(8)}
+    gs = np.asarray(st.arrays["s"])
+    for d in range(8):
+        rows = want_rows[d]
+        ids = gs[d * st.shard_rows: d * st.shard_rows + len(rows)]
+        got = [x.decode() for x in st.lookup_strings("s", ids)]
+        assert got == list(s[rows]), f"shard {d}"
+    # nullable: validity masks nulls, present entries match
+    gn = np.asarray(st.arrays["sn"])
+    gv = np.asarray(st.validity["sn"])
+    src_mask = np.asarray(t.column("sn").is_valid())
+    for d in range(8):
+        rows = want_rows[d]
+        vmask = gv[d * st.shard_rows: d * st.shard_rows + len(rows)]
+        np.testing.assert_array_equal(vmask, src_mask[rows])
+        ids = gn[d * st.shard_rows: d * st.shard_rows + len(rows)][vmask]
+        got = [x.decode() for x in st.lookup_strings("sn", ids)]
+        assert got == list(s[rows][src_mask[rows]])
 
 
 def test_read_table_sharded_empty_file():
