@@ -1,12 +1,19 @@
-"""TPC-H Q1-style aggregation on the TPU path: decode lineitem columns to
-device arrays (`read_pytree`) and run the groupby-aggregate as one jitted
-XLA program — the "decode on device, compute on device" flow the
-framework exists for (BASELINE.md north star).
+"""TPC-H Q1 on the TPU path: decode lineitem columns to device arrays and
+run the groupby-aggregate as one jitted XLA program — the "decode on
+device, compute on device" flow the framework exists for (BASELINE.md
+north star).
 
-On a real TPU the decode kernels and the aggregation share HBM with no
-host round trip; on CPU the same program runs on the XLA CPU backend.
+Two modes:
+- single-device: ``read_pytree`` → jit ``segment_sum`` (f32/i32 columns —
+  the x64-free device dtype design of ops/device.py);
+- mesh-sharded (``--sharded``): ``read_table_sharded`` over an 8-device
+  mesh.  The STRING group keys (l_returnflag 'A'/'N'/'R', l_linestatus
+  'O'/'F' — real TPC-H categories) shard as int32 index streams whose
+  UNIFIED dictionaries make id equality string equality on every shard,
+  so the group-by runs on device ids with no string materialization; XLA
+  inserts the cross-shard reduction.
 
-Run: python examples/tpch_q1_tpu.py [rows]
+Run: python examples/tpch_q1_tpu.py [rows] [--sharded]
 """
 
 import io
@@ -27,38 +34,35 @@ def make_lineitem(n: int) -> bytes:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    # TPU-native dtypes: f32/i32 decode straight to device arrays (64-bit
-    # columns come back as uint32 PAIRS on device — the x64-free design of
-    # ops/device.py — which suits filters/gathers, not float arithmetic)
     rng = np.random.default_rng(7)
+    flags = np.array(["A", "N", "R"])[rng.integers(0, 3, n)]
+    status = np.array(["O", "F"])[rng.integers(0, 2, n)]
     t = pa.table({
-        "l_returnflag": pa.array(rng.integers(0, 3, n).astype(np.int32)),
-        "l_linestatus": pa.array(rng.integers(0, 2, n).astype(np.int32)),
+        "l_returnflag": pa.array(flags),
+        "l_linestatus": pa.array(status),
         "l_quantity": pa.array(rng.integers(1, 51, n).astype(np.float32)),
         "l_extendedprice": pa.array((rng.random(n) * 1e5).astype(np.float32)),
         "l_discount": pa.array((rng.random(n) * 0.1).astype(np.float32)),
         "l_tax": pa.array((rng.random(n) * 0.08).astype(np.float32)),
     })
     buf = io.BytesIO()
-    pq.write_table(t, buf, compression="snappy")
+    pq.write_table(t, buf, compression="snappy",
+                   row_group_size=max(n // 8, 1))
     return buf.getvalue()
 
 
-@jax.jit
-def q1(flag, status, qty, price, disc, tax):
-    """sum/avg aggregates per (returnflag, linestatus) group — segment_sum
-    over a static 6-group id space (3 flags x 2 statuses)."""
-    gid = flag * 2 + status
+def aggregates(gid, qty, price, disc, tax, valid, n_groups):
     disc_price = price * (1.0 - disc)
     charge = disc_price * (1.0 + tax)
-    ones = jnp.ones_like(qty)
 
     def seg(x):
-        return jax.ops.segment_sum(x, gid, num_segments=6)
+        return jax.ops.segment_sum(jnp.where(valid, x, 0.0), gid,
+                                   num_segments=n_groups)
 
-    count = seg(ones)
+    count = seg(jnp.ones_like(qty))
     safe = jnp.maximum(count, 1.0)
     return {
+        "count": count,
         "sum_qty": seg(qty),
         "sum_base_price": seg(price),
         "sum_disc_price": seg(disc_price),
@@ -66,37 +70,97 @@ def q1(flag, status, qty, price, disc, tax):
         "avg_qty": seg(qty) / safe,
         "avg_price": seg(price) / safe,
         "avg_disc": seg(disc) / safe,
-        "count": count,
     }
 
 
-def main(n: int) -> None:
-    raw = make_lineitem(n)
+def _entries(d) -> list:
+    v, o = np.asarray(d[0]), np.asarray(d[1], np.int64)
+    return [bytes(v[o[i]:o[i + 1]]).decode() for i in range(len(o) - 1)]
+
+
+def run_single(raw: bytes, n: int):
     cols = read_pytree(ParquetFile(raw), device=True)
-    out = q1(cols["l_returnflag"], cols["l_linestatus"],
-             cols["l_quantity"], cols["l_extendedprice"],
-             cols["l_discount"], cols["l_tax"])
+    # read_pytree keeps dictionary form; a multi-row-group file carries a
+    # rebased concat of the per-group dictionaries (duplicates kept), so
+    # raw ids are NOT canonical — map every dictionary entry to its group
+    # code on host (O(dict) work) and remap ids on device with one gather.
+    # (read_table_sharded's UNIFIED dictionaries make this step a no-op —
+    # see run_sharded.)
+    fmap = jnp.asarray(np.array(
+        ["ANR".index(x) for x in _entries(cols["l_returnflag"]["dictionary"])],
+        np.int32))
+    smap = jnp.asarray(np.array(
+        ["OF".index(x) for x in _entries(cols["l_linestatus"]["dictionary"])],
+        np.int32))
+    flag = fmap[cols["l_returnflag"]["indices"].astype(jnp.int32)]
+    status = smap[cols["l_linestatus"]["indices"].astype(jnp.int32)]
+    gid = flag * 2 + status
+    out = jax.jit(lambda *a: aggregates(*a, n_groups=6))(
+        gid, cols["l_quantity"], cols["l_extendedprice"],
+        cols["l_discount"], cols["l_tax"], jnp.ones(n, bool))
+    names = {f * 2 + s: ("ANR"[f], "OF"[s])
+             for f in range(3) for s in range(2)}
+    return out, names
+
+
+def run_sharded(raw: bytes, n: int):
+    from parquet_tpu.parallel.mesh import default_mesh, read_table_sharded
+
+    mesh = default_mesh()
+    st = read_table_sharded(raw, mesh=mesh)
+    flag = st.arrays["l_returnflag"]
+    status = st.arrays["l_linestatus"]
+    gid = flag * 2 + status
+    valid = st.row_mask()  # padding rows must not contribute
+    out = jax.jit(lambda *a: aggregates(*a, n_groups=6))(
+        gid, st.arrays["l_quantity"], st.arrays["l_extendedprice"],
+        st.arrays["l_discount"], st.arrays["l_tax"], valid)
+    # tiny --rows runs may not generate every category: name only the
+    # groups whose dictionary entries exist
+    nf = len(st.dictionaries["l_returnflag"][1]) - 1
+    ns = len(st.dictionaries["l_linestatus"][1]) - 1
+    names = {}
+    for f in range(nf):
+        for s in range(ns):
+            names[f * 2 + s] = (
+                st.lookup_strings("l_returnflag", [f])[0].decode(),
+                st.lookup_strings("l_linestatus", [s])[0].decode())
+    return out, names
+
+
+def main(n: int, sharded: bool) -> None:
+    raw = make_lineitem(n)
+    out, names = (run_sharded if sharded else run_single)(raw, n)
     out = jax.tree_util.tree_map(np.asarray, out)
-    print(f"backend={jax.default_backend()}  rows={n}")
-    for g in range(6):
+    mode = "mesh-sharded" if sharded else "single-device"
+    print(f"backend={jax.default_backend()}  mode={mode}  rows={n}")
+    for g in sorted(names):
         if out["count"][g] == 0:
             continue
-        print(f"  group flag={g//2} status={g%2}: count={out['count'][g]:.0f}"
+        f, s = names[g]
+        print(f"  {f} {s}: count={out['count'][g]:.0f}"
               f" sum_qty={out['sum_qty'][g]:.0f}"
               f" avg_price={out['avg_price'][g]:.2f}"
               f" sum_charge={out['sum_charge'][g]:.2f}")
-    # numpy oracle
-    flag = np.asarray(cols["l_returnflag"]).reshape(-1)
-    qty = np.asarray(cols["l_quantity"]).reshape(-1)
-    status = np.asarray(cols["l_linestatus"]).reshape(-1)
-    gid = flag * 2 + status
-    want = np.bincount(gid, weights=qty, minlength=6)
-    # f32 sequential accumulation error grows ~sqrt(group size) — scale
-    # the tolerance so large --rows runs don't fail on float noise
-    np.testing.assert_allclose(out["sum_qty"], want,
-                               rtol=max(1e-4, 3e-7 * float(np.sqrt(n))))
-    print("sum_qty matches the numpy oracle")
+    # numpy oracle over the same file through the host reader
+    import pyarrow.parquet as pq
+
+    t = pq.read_table(io.BytesIO(raw))
+    fl = np.asarray(t.column("l_returnflag").to_numpy(zero_copy_only=False))
+    stt = np.asarray(t.column("l_linestatus").to_numpy(zero_copy_only=False))
+    qty = t.column("l_quantity").to_numpy()
+    want = {}
+    for g, (f, s) in names.items():
+        want[g] = float(qty[(fl == f) & (stt == s)].sum())
+    got = {g: float(out["sum_qty"][g]) for g in names}
+    for g in names:
+        np.testing.assert_allclose(
+            got[g], want[g], rtol=max(1e-4, 3e-7 * float(np.sqrt(n))))
+    print("sum_qty matches the numpy oracle per (returnflag, linestatus)")
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)
+    args = [a for a in sys.argv[1:]]
+    sharded = "--sharded" in args
+    args = [a for a in args if a != "--sharded"]
+    main(int(args[0]) if args else 1_000_000, sharded)

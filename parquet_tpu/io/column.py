@@ -218,6 +218,20 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
     return _fixed_with_nulls(flat, validity, pa.from_numpy_dtype(flat.dtype))
 
 
+def concat_byte_arrays(values_parts, offsets_parts):
+    """Concatenate (values, offsets) byte-array pairs with the offsets
+    rebased to one buffer.  Offsets are assumed to start at 0 (every
+    producer in this codebase emits per-part offsets from 0).  Returns
+    (uint8 values, int64 offsets)."""
+    off_parts, vbase = [], 0
+    for o in offsets_parts:
+        o = np.asarray(o, np.int64)
+        off_parts.append(o[:-1] + vbase)
+        vbase += int(o[-1])
+    return (np.concatenate([np.asarray(v) for v in values_parts]),
+            np.concatenate(off_parts + [np.array([vbase], np.int64)]))
+
+
 def empty_column(leaf: Leaf) -> Column:
     """A valid zero-row Column for ``leaf`` (typed empty arrays; nested
     leaves get empty level streams through the assembler) — the shape an
@@ -344,15 +358,9 @@ def _concat_dict_parts(parts: List[Column]) -> Optional[Column]:
                      else len(p.dictionary_host))
         indices = np.concatenate(idx_parts)
         if ba:
-            off_parts, vbase = [], 0
-            for p in parts:
-                o = np.asarray(p.dictionary_host[1], np.int64)
-                off_parts.append(o[:-1] + vbase)
-                vbase += int(o[-1])
-            dict_host = (
-                np.concatenate([np.asarray(p.dictionary_host[0])
-                                for p in parts]),
-                np.concatenate(off_parts + [np.array([vbase], np.int64)]))
+            dict_host = concat_byte_arrays(
+                [p.dictionary_host[0] for p in parts],
+                [p.dictionary_host[1] for p in parts])
         else:
             dict_host = np.concatenate(
                 [np.asarray(p.dictionary_host) for p in parts])

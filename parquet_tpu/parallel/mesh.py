@@ -125,15 +125,10 @@ def _unify_dictionaries(dv_parts: List[np.ndarray],
     across every row group — the property device-side filters/joins on the
     sharded index stream rely on."""
     from .. import native as _native
+    from ..io.column import concat_byte_arrays
     from ..ops import ref
 
-    cat_vals = np.concatenate(dv_parts)
-    offs_out, byte_base = [], 0
-    for o in do_parts:
-        offs_out.append(np.asarray(o[:-1], np.int64) + byte_base)
-        byte_base += int(o[-1])
-    offs_out.append(np.array([byte_base], np.int64))
-    cat_offs = np.concatenate(offs_out)
+    cat_vals, cat_offs = concat_byte_arrays(dv_parts, do_parts)
     n = len(cat_offs) - 1
     res = _native.dict_build_ba(cat_vals, cat_offs, n + 1)
     if res is None or isinstance(res, str):
@@ -170,8 +165,9 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
     device work overlaps too. Columns must be flat: fixed-width values
     shard directly (BOOLEAN/INT32/INT64/FLOAT/DOUBLE/FLBA — 64-bit as
     (n, 2) uint32 pairs), and dictionary-encoded BYTE_ARRAY columns shard
-    their int32 index stream with the per-row-group dictionaries
-    concatenated index-rebased into ``ShardedTable.dictionaries[path]``.
+    their int32 index stream with the per-row-group dictionaries UNIFIED
+    (first-occurrence dedup — id equality is string equality on every
+    shard) into ``ShardedTable.dictionaries[path]``.
     PLAIN-encoded (non-dictionary) string columns and nested columns raise
     ValueError (read them with ``ParquetFile.read(device=True)``, which
     keeps ragged forms).

@@ -41,9 +41,12 @@ import numpy as np
 
 
 # fixed-width lineitem columns (read_table_sharded's contract); the scan
-# below additionally exercises a dictionary-encoded string output column
+# below additionally exercises a dictionary-encoded string output column.
+# l_returnflag / l_shipmode are dictionary-encoded strings: they shard as
+# int32 index streams with a unified dictionary (mesh.read_table_sharded)
 READ_COLS = ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
-             "l_extendedprice", "l_discount", "l_tax", "l_shipdate"]
+             "l_extendedprice", "l_discount", "l_tax", "l_shipdate",
+             "l_returnflag", "l_shipmode"]
 _PAIR_DTYPES = {"l_orderkey": np.int64, "l_partkey": np.int64,
                 "l_suppkey": np.int64, "l_quantity": np.int64,
                 "l_extendedprice": np.float64, "l_discount": np.float64,
@@ -105,6 +108,29 @@ def main():
              for rg in range(len(rg_rows)) if rg % n_dev == d]
     for c in READ_COLS:
         got = np.asarray(st.arrays[c])
+        if c in st.dictionaries:
+            # unified-dictionary string column: value-check a 100k-row
+            # stride sample (building python bytes for every row would
+            # dominate the artifact's runtime, not its evidence)
+            ids = got[mask]
+            hcol = host[c]
+            if hcol.is_dictionary_encoded():
+                hcol.materialize_host()
+            hv = np.asarray(hcol.values)
+            ho = np.asarray(hcol.offsets, np.int64)
+            exp_rows = np.concatenate(
+                [np.arange(starts[rg], starts[rg + 1]) for rg in order])
+            if len(ids) != len(exp_rows):  # before indexing ids[sel]
+                ok_read = False
+                continue
+            stride = max(len(exp_rows) // 100_000, 1)
+            sel = np.arange(0, len(exp_rows), stride)
+            got_s = st.lookup_strings(c, ids[sel])
+            exp_s = [hv[ho[r]:ho[r + 1]].tobytes()
+                     for r in exp_rows[sel]]
+            if got_s != exp_s:
+                ok_read = False
+            continue
         if got.ndim == 2 and got.shape[-1] == 2:
             got = np.ascontiguousarray(got).view(_PAIR_DTYPES[c]).reshape(-1)
         got = got[mask]

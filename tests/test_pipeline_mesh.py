@@ -110,8 +110,8 @@ def test_read_table_sharded_rejects_plain_strings_and_nested():
 
 def test_read_table_sharded_dict_strings():
     """Dictionary-encoded string columns shard their index stream; the
-    per-row-group dictionaries concatenate index-rebased (the sharded
-    scan's dictionary output layout)."""
+    per-row-group dictionaries UNIFY (first-occurrence dedup) so id
+    equality is string equality on every shard."""
     rng = np.random.default_rng(5)
     n, rgs = 24_000, 5
     cats = np.array([f"mode_{i:02d}" for i in range(37)])
