@@ -24,6 +24,14 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# --sharded on a CPU host: simulate the 8-chip mesh (must happen before
+# jax import; on a real TPU pod the flag is a no-op for the tpu backend)
+if "--sharded" in sys.argv:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax
 import jax.numpy as jnp
 

@@ -65,11 +65,59 @@ class ShardedTable:
     row_counts: tuple
     mesh: Mesh
     dictionaries: Dict[str, tuple] = field(default_factory=dict)
+    # schema leaves by path: to_arrow recombines 64-bit pairs and restores
+    # logical types (dates, timestamps, decimals, FLBA) through these
+    leaves: Dict[str, object] = field(default_factory=dict)
 
     def lookup_strings(self, path: str, ids) -> list:
         """Materialize dictionary entries for index values of ``path``."""
         dvals, doffs = self.dictionaries[path]
         return [bytes(dvals[doffs[i]:doffs[i + 1]]) for i in np.asarray(ids)]
+
+    def to_arrow(self):
+        """Gather every shard back to host as one pyarrow.Table (padding
+        rows dropped, 64-bit pairs recombined, dictionary-index columns as
+        DictionaryArray over the unified dictionary).  Conversion routes
+        through the leaf-aware ``_leaf_to_arrow`` so logical types (dates,
+        timestamps, decimals, FLBA, binary-vs-string) survive exactly as
+        in ``ParquetFile.read().to_arrow()``."""
+        import pyarrow as pa
+
+        from ..io.column import _leaf_to_arrow
+
+        mask = np.asarray(self.row_mask())
+        cols, names = [], []
+        for path, arr in self.arrays.items():
+            leaf = self.leaves.get(path)
+            host = np.asarray(arr)
+            valid = (np.asarray(self.validity[path])[mask]
+                     if path in self.validity else None)
+            if path in self.dictionaries:
+                dvals, doffs = self.dictionaries[path]
+                entries = _leaf_to_arrow(leaf, np.asarray(dvals),
+                                         np.asarray(doffs, np.int64), None)
+                ids = host[mask].astype(np.int32)
+                ia = (pa.array(ids, mask=~valid) if valid is not None
+                      else pa.array(ids))
+                a = pa.DictionaryArray.from_arrays(ia, entries)
+            else:
+                if host.ndim == 2 and host.dtype == np.uint32 \
+                        and host.shape[-1] == 2:
+                    host = dev.pairs_to_host(
+                        host, np.dtype(leaf.np_dtype()) if leaf is not None
+                        else np.int64)
+                rowvals = host[mask]
+                if leaf is None:  # externally built table: generic numpy
+                    a = (pa.array(rowvals, mask=~valid)
+                         if valid is not None else pa.array(rowvals))
+                elif valid is not None:
+                    # _leaf_to_arrow takes DENSE values + slot validity
+                    a = _leaf_to_arrow(leaf, rowvals[valid], None, valid)
+                else:
+                    a = _leaf_to_arrow(leaf, rowvals, None, None)
+            cols.append(a)
+            names.append(path)
+        return pa.table(dict(zip(names, cols)))
 
     @property
     def shard_rows(self) -> int:
@@ -335,7 +383,8 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
                     (maxlen * len(shard_valid),), vsharding, shard_valid)
     return ShardedTable(arrays=arrays, validity=validities,
                         row_counts=tuple(shard_counts), mesh=mesh,
-                        dictionaries=dictionaries)
+                        dictionaries=dictionaries,
+                        leaves={leaf.dotted_path: leaf for leaf in leaves})
 
 
 # ---------------------------------------------------------------------------

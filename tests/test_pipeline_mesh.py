@@ -246,3 +246,79 @@ def test_sharded_read_and_scan_at_size():
             [pairs_to_host(p, np.float64) for p in sh["l_extendedprice"]]))
         np.testing.assert_allclose(
             dev_vals, np.sort(np.asarray(oracle["l_extendedprice"])))
+
+
+def test_sharded_table_to_arrow_round_trip(rng):
+    """to_arrow gathers shards to host: padding dropped, pairs recombined,
+    dict strings as DictionaryArray — value-equal to pyarrow (row order is
+    the round-robin shard order)."""
+    n = 21_000
+    cats = np.array([f"c{i}" for i in range(12)])
+    s = cats[rng.integers(0, 12, n)]
+    t = pa.table({
+        "x": pa.array(rng.integers(0, 1 << 50, n)),
+        "d": pa.array(rng.random(n)),
+        "nn": pa.array(rng.integers(0, 100, n).astype(np.int64),
+                       mask=rng.random(n) < 0.2),
+        "s": pa.array(s),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=4000, compression="snappy")
+    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8))
+    out = st.to_arrow()
+    assert out.num_rows == n
+    # reconstruct the round-robin row order and compare all columns
+    n_rg = (n + 3999) // 4000
+    rg_rows = [min(4000, n - i * 4000) for i in range(n_rg)]
+    starts = np.cumsum([0] + rg_rows)
+    order = np.concatenate([np.arange(starts[rg], starts[rg + 1])
+                            for d in range(8)
+                            for rg in range(n_rg) if rg % 8 == d]).astype(int)
+    want = t.take(order)
+    for c in t.column_names:
+        gc = out.column(c).combine_chunks()
+        if pa.types.is_dictionary(gc.type):
+            gc = gc.cast(want.column(c).type)
+        assert gc.cast(want.column(c).type).equals(
+            want.column(c).combine_chunks()), c
+
+
+def test_sharded_table_to_arrow_preserves_logical_types(rng):
+    """to_arrow routes through the leaf-aware conversion: DATE stays
+    date32, dict BINARY without a string logical type stays binary
+    (review r4: blanket string cast crashed on non-UTF-8 dictionaries),
+    and FLBA columns convert instead of crashing."""
+    n = 6000
+    dates = rng.integers(10_000, 20_000, n).astype(np.int32)
+    blobs = [bytes([250, 251, i % 256]) for i in range(4)]  # not UTF-8
+    uuids = rng.integers(0, 256, (7, 16)).astype(np.uint8)
+    t = pa.table({
+        "day": pa.array(dates, type=pa.date32()),
+        "blob": pa.array([blobs[i % 4] for i in range(n)],
+                         type=pa.binary()),
+        "u": pa.array([uuids[i % 7].tobytes() for i in range(n)],
+                      type=pa.binary(16)),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=1500, use_dictionary=["blob"],
+                   store_schema=False)
+    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8))
+    out = st.to_arrow()
+    assert out.num_rows == n
+    assert pa.types.is_date32(out.schema.field("day").type)
+    bt = out.schema.field("blob").type
+    assert pa.types.is_dictionary(bt) and pa.types.is_binary(bt.value_type)
+    assert pa.types.is_fixed_size_binary(out.schema.field("u").type)
+    # value equality in round-robin order
+    n_rg = 4
+    starts = [0, 1500, 3000, 4500, 6000]
+    order = np.concatenate([np.arange(starts[rg], starts[rg + 1])
+                            for d in range(8) for rg in range(n_rg)
+                            if rg % 8 == d]).astype(int)
+    want = t.take(order)
+    for c in t.column_names:
+        gc = out.column(c).combine_chunks()
+        if pa.types.is_dictionary(gc.type):
+            gc = gc.cast(want.column(c).type)
+        assert gc.cast(want.column(c).type).equals(
+            want.column(c).combine_chunks()), c
