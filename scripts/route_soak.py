@@ -194,6 +194,51 @@ def one_trial(i: int, rng) -> dict:
     return {**desc, "status": "pass"}
 
 
+def one_write_trial(i: int, rng) -> dict:
+    """WRITE-side soak: random table → OUR writer under randomized options
+    → pyarrow reads it back (independent oracle) AND our reader re-reads
+    it (self-consistency).  The read-side trials above cover decode; this
+    covers encoders, statistics, indexes and page framing."""
+    from parquet_tpu import ParquetFile, WriterOptions, write_table
+
+    kind = KINDS[int(rng.integers(0, len(KINDS)))]
+    codec = CODECS[int(rng.integers(0, len(CODECS)))]
+    n = int(rng.integers(500, 80_000))
+    nullable = bool(rng.random() < 0.4)
+    v2 = bool(rng.random() < 0.5)
+    page_kb = int(rng.choice([4, 16, 64, 256]))
+    use_dict = bool(rng.random() < 0.6)
+    rg_rows = int(rng.choice([n + 1, max(n // 3, 1)]))
+    desc = dict(i=i, mode="write", kind=kind, codec=codec, n=n,
+                nullable=nullable, v2=v2, page_kb=page_kb,
+                use_dict=use_dict, rg_rows=rg_rows)
+    t, _, _ = _make_table(kind, n, nullable, rng)
+    try:
+        buf = io.BytesIO()
+        write_table(t, buf, WriterOptions(
+            compression=codec,
+            data_page_size=page_kb * 1024,
+            data_page_version=2 if v2 else 1,
+            dictionary=use_dict,
+            row_group_size=rg_rows,
+            write_page_index=bool(rng.random() < 0.7)))
+        raw = buf.getvalue()
+        oracle = t.column("c").combine_chunks()
+        got = pq.read_table(io.BytesIO(raw)).column("c").combine_chunks()
+        if not got.cast(oracle.type).equals(oracle):
+            return {**desc, "status": "FAIL", "stage": "pyarrow_readback"}
+        ours = (ParquetFile(raw).read().to_arrow().column("c")
+                .combine_chunks())
+        if pa.types.is_dictionary(ours.type):
+            ours = ours.cast(oracle.type)
+        if not ours.cast(oracle.type).equals(oracle):
+            return {**desc, "status": "FAIL", "stage": "self_readback"}
+    except Exception:
+        return {**desc, "status": "FAIL", "stage": "exception",
+                "trace": traceback.format_exc(limit=8)}
+    return {**desc, "status": "pass"}
+
+
 def main() -> int:
     import jax
 
@@ -204,15 +249,19 @@ def main() -> int:
     if os.environ.get("ROUTE_SOAK_CPU", "") not in ("", "0"):
         jax.config.update("jax_platforms", "cpu")
 
-    n_trials = int(sys.argv[1]) if len(sys.argv) > 1 else 200
-    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    args = [a for a in sys.argv[1:]]
+    write_mode = "--write" in args
+    args = [a for a in args if a != "--write"]
+    n_trials = int(args[0]) if args else 200
+    seed = int(args[1]) if len(args) > 1 else 0
     rng = np.random.default_rng(seed)
     backend = jax.default_backend()
+    trial = one_write_trial if write_mode else one_trial
 
     failures, skips, passed = [], 0, 0
     t0 = time.time()
     for i in range(n_trials):
-        r = one_trial(i, rng)
+        r = trial(i, rng)
         if r["status"] == "pass":
             passed += 1
         elif r["status"] == "skip":
@@ -232,8 +281,10 @@ def main() -> int:
         "seed": seed, "failures": failures,
         "wall_s": round(time.time() - t0, 1),
     }
+    art["mode"] = "write" if write_mode else "read"
     root = os.path.join(os.path.dirname(__file__), "..")
-    path = os.path.join(root, f"ROUTE_SOAK_{backend.upper()}.json")
+    suffix = "_WRITE" if write_mode else ""
+    path = os.path.join(root, f"ROUTE_SOAK_{backend.upper()}{suffix}.json")
     with open(path, "w") as f:
         json.dump(art, f, indent=1)
     print("wrote", path, ":", json.dumps({k: art[k] for k in
