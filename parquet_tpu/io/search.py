@@ -298,6 +298,11 @@ def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
     yield from chunk.pages_at(span_start, span_len, num_pages=i1 - i0)
 
 
+# tag for the columnar aligned BYTE_ARRAY form: ("ba_arrays", uint8
+# values, int64 offsets) — shared with parallel/host_scan.py
+BA_ARRAYS = "ba_arrays"
+
+
 def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
                    device: bool = False,
                    aligned: "Union[bool, str]" = False):
@@ -350,7 +355,7 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
     if not out_parts:
         if not nested:
             if leaf.physical_type == Type.BYTE_ARRAY:
-                empty = (("ba_arrays", np.empty(0, np.uint8),
+                empty = ((BA_ARRAYS, np.empty(0, np.uint8),
                           np.zeros(1, np.int64))
                          if aligned == "arrays" else [])
             elif leaf.physical_type == Type.FIXED_LEN_BYTE_ARRAY:
@@ -376,17 +381,16 @@ def read_row_range(pf: ParquetFile, path, row_start: int, row_count: int,
         val_parts = [p[1] for p in out_parts]
         if isinstance(vals_parts[0], list):
             vals = [v for part in vals_parts for v in part]
-        elif isinstance(vals_parts[0], tuple):  # ("ba_arrays", vals, offs)
+        elif isinstance(vals_parts[0], tuple):  # (BA_ARRAYS, vals, offs)
             if len(vals_parts) == 1:
                 vals = vals_parts[0]
             else:
-                cat = np.concatenate([p[1] for p in vals_parts])
-                offs_parts, base = [], 0
-                for p in vals_parts:
-                    offs_parts.append(p[2][:-1] + base)
-                    base += int(p[2][-1])
-                offs_parts.append(np.array([base], np.int64))
-                vals = ("ba_arrays", cat, np.concatenate(offs_parts))
+                from .column import concat_byte_arrays
+
+                cat, offs_cat = concat_byte_arrays(
+                    [p[1] for p in vals_parts],
+                    [p[2] for p in vals_parts])
+                vals = (BA_ARRAYS, cat, offs_cat)
         else:
             vals = (vals_parts[0] if len(vals_parts) == 1
                     else np.concatenate(vals_parts))
@@ -498,7 +502,7 @@ def _trim_flat_aligned(col, offset: int, count: int, arrays: bool = False):
             v1 = v0 + int(np.count_nonzero(vmask))
         base = int(offs[v0])
         vals = np.asarray(col.values)[base : int(offs[v1])]
-        return ("ba_arrays", vals, offs[v0 : v1 + 1] - base), vmask
+        return (BA_ARRAYS, vals, offs[v0 : v1 + 1] - base), vmask
     if col.validity is None:
         return _trim_flat(col, offset, count), None
     validity = np.asarray(col.validity, bool)

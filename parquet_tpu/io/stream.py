@@ -76,10 +76,11 @@ class _ChunkCursor:
                 self.dictionary = decode_dictionary_page(self.chunk, page)
                 continue
             batch.append(page)
-            h = page.header
-            v2 = getattr(h, "data_page_header_v2", None)
-            est += (v2.num_rows if v2 is not None
-                    else h.data_page_header.num_values)
+            v2 = getattr(page.header, "data_page_header_v2", None)
+            # num_values over-counts rows for repeated columns and is 0 for
+            # unknown page types (both only make the pull stop early or
+            # late by one page — take() pulls again)
+            est += v2.num_rows if v2 is not None else page.num_values
             if est >= need_rows:
                 break
         if not batch:

@@ -765,8 +765,15 @@ def decompress_pages(srcs, out_sizes, codec_id: int, nthreads: int = 1):
     return out, offs
 
 
-def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int):
-    """Returns (indices, first_occurrence_rows), "overflow", or None."""
+def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int,
+                  sample_bail: bool = True):
+    """Returns (indices, first_occurrence_rows), "overflow", or None.
+
+    ``sample_bail=False`` disables the near-unique early bail — required
+    when the input is a CONCATENATION of internally-unique sets (e.g.
+    unifying per-row-group dictionaries): every sample window then lies
+    inside one unique set and predicts overflow even though cross-set
+    duplicates abound."""
     lib = get_lib()
     if lib is None:
         return None
@@ -781,7 +788,7 @@ def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int):
     # fool a prefix-only sample).  Affects only whether dictionary encoding
     # is attempted, never correctness.
     sample = 1 << 15
-    if n > 4 * sample and max_unique >= sample:
+    if sample_bail and n > 4 * sample and max_unique >= sample:
         s_idx = np.empty(sample, np.int64)
         # a window overflowing a 7/8*sample unique cap (negative return)
         # means it is >= 7/8 internally unique

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..io.reader import ParquetFile
-from ..io.search import plan_scan, read_row_range
+from ..io.search import BA_ARRAYS, plan_scan, read_row_range
 
 __all__ = ["scan_filtered", "scan_filtered_device", "scan_filtered_sharded"]
 
@@ -179,7 +179,7 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                 mask &= key_valid
         for c in out_cols:
             vals, valid = span[c]
-            if isinstance(vals, tuple) and vals and vals[0] == "ba_arrays":
+            if isinstance(vals, tuple) and vals and vals[0] == BA_ARRAYS:
                 _, v_u8, offs = vals
                 idx = np.flatnonzero(mask)
                 if valid is None:

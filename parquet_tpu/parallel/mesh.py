@@ -178,10 +178,10 @@ def _unify_dictionaries(dv_parts: List[np.ndarray],
 
     cat_vals, cat_offs = concat_byte_arrays(dv_parts, do_parts)
     n = len(cat_offs) - 1
-    res = _native.dict_build_ba(cat_vals, cat_offs, n + 1)
+    res = _native.dict_build_ba(cat_vals, cat_offs, n + 1,
+                                sample_bail=False)
     if res is None or isinstance(res, str):
-        # shim unavailable, or the near-unique sampling bail fired (a
-        # mostly-disjoint dictionary set): python dedup, same semantics
+        # shim unavailable: python dedup, same semantics
         seen: Dict[bytes, int] = {}
         remap = np.empty(n, np.int64)
         keep = []

@@ -130,3 +130,37 @@ def test_stream_empty_and_single_row(rng):
     assert len(batches) == 1 and batches[0].num_rows == 1
     with pytest.raises(ValueError):
         list(iter_batches(ParquetFile(raw), batch_rows=0))
+
+
+def test_stream_tolerates_unknown_page_types(rng, monkeypatch):
+    """A non-data page inside the chunk stream (e.g. an index page) must not
+    crash the batched page pull (review r4: AttributeError on the row
+    estimate) — decode_chunk_host already skips such pages."""
+    from parquet_tpu.format.enums import PageType as PT
+    from parquet_tpu.io import reader as rd
+    from parquet_tpu.io import stream as sm
+
+    n = 4000
+    t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64))})
+    raw = _write(t, row_group_size=1 << 30, data_page_size=2048)
+    pf = ParquetFile(raw)
+
+    from parquet_tpu.format import metadata as md
+
+    idx_type = int(getattr(PT, "INDEX_PAGE", 4))
+    fake = rd.PageInfo(
+        header=md.PageHeader(type=idx_type, uncompressed_page_size=0,
+                             compressed_page_size=0),
+        payload=b"", offset=0)
+    assert fake.page_type not in (PT.DATA_PAGE, PT.DATA_PAGE_V2,
+                                  PT.DICTIONARY_PAGE)
+
+    orig = rd.ColumnChunkReader.pages_streamed
+
+    def with_fake(self, *a, **kw):
+        yield fake  # unknown page type first
+        yield from orig(self, *a, **kw)
+
+    monkeypatch.setattr(rd.ColumnChunkReader, "pages_streamed", with_fake)
+    got = [b for b in sm.iter_batches(pf, batch_rows=1500)]
+    assert sum(b.num_rows for b in got) == n
