@@ -162,7 +162,9 @@ def main() -> int:
             once = True
     deadline = time.time() + max_h * 3600
     interval = int(os.environ.get("CAPTURE_INTERVAL_S", 300))
-    stall_s = int(os.environ.get("CAPTURE_STALL_S", 420))
+    # remote Pallas/XLA compiles ride the tunnel with the local CPU idle —
+    # a 420 s window killed legitimate compile chains as "stalls"
+    stall_s = int(os.environ.get("CAPTURE_STALL_S", 900))
     # Work queue for a tunnel window, in value order: a complete small
     # artifact first, then the full-size one, then the targeted trials and
     # the randomized route soak.  Items re-run until they succeed.
