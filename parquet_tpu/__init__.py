@@ -41,6 +41,7 @@ from .ops.encodings import (DictIndices, EncodingSpec, register_encoding,
 from .io.source import RetryingSource, Source
 from .parallel.host_scan import (scan_filtered, scan_filtered_device,
                                  scan_filtered_sharded)
+from .parallel.mesh import ShardedTable, default_mesh, read_table_sharded
 from .algebra import (SortingColumn, SortingWriter, TableBuffer,
                       convert_table, merge_files, merge_row_groups)
 from .schema.schema import (Schema, group, leaf, list_of, map_of, message,
