@@ -167,14 +167,19 @@ def one_trial(i: int, rng) -> dict:
         got = ParquetFile(raw).read().to_arrow().column("c").combine_chunks()
         if not got.cast(oracle.type).equals(oracle):
             return {**desc, "status": "FAIL", "stage": "surface_read"}
-        # 2) device route, pinned, no fallback
+        # 2) device route, pinned, no fallback.  Nested kinds additionally
+        # opt into the any-depth DEVICE assembler (PARQUET_TPU_DEVICE_ASM)
+        # — the route whose on-chip correctness this soak exists to certify.
         for var in _ROUTE_VARS:
             os.environ[var] = "device"
+        if kind.startswith("list_"):
+            os.environ["PARQUET_TPU_DEVICE_ASM"] = "1"
         try:
             dev_col = dr.decode_chunk_device(
                 ParquetFile(raw).row_group(0).column(0), fallback=False)
             dev_arrow = dev_col.to_arrow()
         finally:
+            os.environ.pop("PARQUET_TPU_DEVICE_ASM", None)
             for var in _ROUTE_VARS:
                 os.environ[var] = "host"
         # 3) host route, same entry point
