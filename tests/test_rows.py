@@ -264,3 +264,34 @@ def test_value_model():
     assert a.value == 5 and a.definition_level == 0 and a.repetition_level == 0
     s = row.for_column(2)[0]
     assert s.definition_level == 1
+
+
+def test_file_rows_seek_to_row(rng):
+    """Rows.SeekToRow parity: position the row cursor at any global row,
+    across row-group boundaries; seeking past the end yields EOF."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu import ParquetFile
+    from parquet_tpu.rows import FileRows
+
+    n = 9000
+    t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64)),
+                  "s": pa.array([f"r{i}" for i in range(n)])})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=2500)
+    pf = ParquetFile(buf.getvalue())
+    for target in (0, 1, 2499, 2500, 5001, 8999):
+        cur = FileRows(pf)
+        cur.seek_to_row(target)
+        got = cur.read_rows(3)
+        vals = [r[0].value for r in got]
+        want = list(range(target, min(target + 3, n)))
+        assert vals == want, (target, vals)
+    cur = FileRows(pf)
+    cur.seek_to_row(n)
+    assert cur.read_rows(1) == []
+    cur.seek_to_row(n + 50)
+    assert cur.read_rows(1) == []
+    with pytest.raises(ValueError):
+        cur.seek_to_row(-1)
