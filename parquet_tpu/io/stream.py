@@ -44,6 +44,19 @@ class _PagePiece:
     row_starts: Optional[np.ndarray] = None
 
 
+def piece_from_column(col: Column) -> "_PagePiece":
+    """Wrap a decoded column (any page subset) as a sliceable piece: row
+    count and row→slot starts derived from the rep levels (identity for
+    flat columns).  Shared by the streaming cursor and the row cursor's
+    seek path."""
+    rep = col.rep_levels
+    if rep is not None:
+        starts = levels_ops.row_slot_starts(np.asarray(rep))
+        return _PagePiece(col=col, rows=len(starts), row_starts=starts)
+    return _PagePiece(col=col, rows=col.num_slots or col.num_values,
+                      row_starts=None)
+
+
 @dataclass
 class _ChunkCursor:
     """Incremental decoder for one column chunk: pulls pages on demand,
@@ -88,15 +101,7 @@ class _ChunkCursor:
             return False
         col = decode_chunk_host(self.chunk, pages=iter(batch),
                                 dictionary=self.dictionary)
-        rep = col.rep_levels
-        if rep is not None:
-            starts = levels_ops.row_slot_starts(rep)
-            rows = len(starts)
-        else:
-            starts = None
-            rows = col.num_slots or col.num_values
-        self.pieces.append(_PagePiece(col=col, rows=rows,
-                                      row_starts=starts))
+        self.pieces.append(piece_from_column(col))
         return True
 
     def take(self, n_rows: int):

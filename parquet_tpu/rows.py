@@ -661,11 +661,12 @@ class FileRows(RowReader):
 
     def seek_to_row(self, row: int) -> None:
         """Position the cursor at global row ``row`` (reference parity:
-        ``Rows.SeekToRow``).  Only the pages covering [row, end of its row
-        group) are decoded — page selection via the offset index and a
-        level-stream trim to the exact row (the SeekToRow-then-read flow of
-        SURVEY.md §3.3), never a whole-group decode-and-discard.  Seeking
-        at or past the end leaves the cursor at EOF."""
+        ``Rows.SeekToRow``).  Decodes the pages covering [row, end of its
+        row group) per column and trims level streams to the exact row —
+        with a page index, page selection skips everything before the
+        target; without one (pyarrow's write default) the whole group's
+        pages decode, since no page boundaries are known.  Seeking at or
+        past the end leaves the cursor at EOF."""
         if row < 0:
             raise ValueError("row must be >= 0")
         base = 0
@@ -674,31 +675,23 @@ class FileRows(RowReader):
             nr = rg.num_rows
             if row < base + nr:
                 offset = row - base
-                self._rg = i + 1  # read_rows resumes at the next group
                 if offset == 0:
-                    self._rg = i
+                    self._rg = i  # decode lazily at the first read_rows
                     self._iter = None
-                    self._next_group()
                     return
+                self._rg = i + 1  # read_rows resumes at the next group
                 from .io.reader import decode_chunk_host
                 from .io.search import pages_and_base
-                from .io.stream import _PagePiece, _slice_rows
-                from .ops import levels as levels_ops
+                from .io.stream import _slice_rows, piece_from_column
 
                 cols = {}
                 for j, leaf in enumerate(self.schema.leaves):
                     chunk = rg.column(j)
                     pages, first = pages_and_base(chunk, offset, nr)
-                    col = decode_chunk_host(chunk, pages=iter(pages))
-                    rep = col.rep_levels
-                    starts = (levels_ops.row_slot_starts(np.asarray(rep))
-                              if rep is not None else None)
-                    piece_rows = (len(starts) if starts is not None
-                                  else col.num_slots or col.num_values)
-                    piece = _PagePiece(col=col, rows=piece_rows,
-                                       row_starts=starts)
+                    piece = piece_from_column(
+                        decode_chunk_host(chunk, pages=iter(pages)))
                     cols[leaf.dotted_path] = _slice_rows(
-                        piece, offset - first, piece_rows)
+                        piece, offset - first, piece.rows)
                 self._iter = rows_from_columns(self.schema, cols,
                                                nr - offset)
                 return

@@ -172,6 +172,7 @@ def one_trial(i: int, rng) -> dict:
         # — the route whose on-chip correctness this soak exists to certify.
         for var in _ROUTE_VARS:
             os.environ[var] = "device"
+        prev_asm = os.environ.get("PARQUET_TPU_DEVICE_ASM")
         if kind.startswith("list_"):
             os.environ["PARQUET_TPU_DEVICE_ASM"] = "1"
         try:
@@ -179,7 +180,10 @@ def one_trial(i: int, rng) -> dict:
                 ParquetFile(raw).row_group(0).column(0), fallback=False)
             dev_arrow = dev_col.to_arrow()
         finally:
-            os.environ.pop("PARQUET_TPU_DEVICE_ASM", None)
+            if prev_asm is None:  # restore, don't clobber an ambient opt-in
+                os.environ.pop("PARQUET_TPU_DEVICE_ASM", None)
+            else:
+                os.environ["PARQUET_TPU_DEVICE_ASM"] = prev_asm
             for var in _ROUTE_VARS:
                 os.environ[var] = "host"
         # 3) host route, same entry point

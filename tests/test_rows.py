@@ -266,20 +266,28 @@ def test_value_model():
     assert s.definition_level == 1
 
 
-def test_file_rows_seek_to_row(rng):
+@pytest.mark.parametrize("page_index", [False, True])
+def test_file_rows_seek_to_row(page_index):
     """Rows.SeekToRow parity: position the row cursor at any global row,
-    across row-group boundaries; seeking past the end yields EOF."""
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    from parquet_tpu import ParquetFile
+    across row-group boundaries; seeking past the end yields EOF.  With
+    page_index=True (our writer's default) the seek takes the
+    offset-index page-selection branch; without one, the whole-group
+    fallback."""
+    from parquet_tpu import ParquetFile, WriterOptions, write_table
     from parquet_tpu.rows import FileRows
 
     n = 9000
     t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64)),
                   "s": pa.array([f"r{i}" for i in range(n)])})
     buf = io.BytesIO()
-    pq.write_table(t, buf, row_group_size=2500)
+    if page_index:
+        write_table(t, buf, WriterOptions(row_group_size=2500,
+                                          data_page_size=4096,
+                                          write_page_index=True))
+        assert ParquetFile(buf.getvalue()).row_group(0).column(0) \
+            .offset_index() is not None
+    else:
+        pq.write_table(t, buf, row_group_size=2500)
     pf = ParquetFile(buf.getvalue())
     for target in (0, 1, 2499, 2500, 5001, 8999):
         cur = FileRows(pf)
