@@ -550,6 +550,21 @@ int64_t pq_delta_prescan(const uint8_t* data, int64_t size, int64_t pos,
   return k;
 }
 
+// Full-avalanche 64-bit finalizer (splitmix64).  Hash-table indexes below
+// are taken from the LOW bits, so every input bit must reach them: a single
+// multiply+shift leaves the index a function of the key's low bits only, and
+// keys differing in mid/high bytes (dictionary strings packed to words,
+// varying in trailing characters) cluster into a few slots, degrading linear
+// probing to long chains (measured 5x slowdown on packed "catNNN" keys).
+static inline uint64_t pq_mix64(uint64_t h) {
+  h ^= h >> 30;
+  h *= 0xBF58476D1CE4E5B9ull;
+  h ^= h >> 27;
+  h *= 0x94D049BB133111EBull;
+  h ^= h >> 31;
+  return h;
+}
+
 // ---------------------------------------------------------------------------
 // Fixed-width dictionary build (hashprobe analog for INT32/INT64/FLOAT/DOUBLE
 // viewed as int64 bits): open-addressing first-occurrence dedup.
@@ -565,11 +580,7 @@ int64_t pq_dict_build_i64(const int64_t* vals, int64_t n, int64_t max_unique,
   std::vector<int64_t> slot(cap, -1);
   std::vector<int64_t> key(cap);
   int64_t nu = 0;
-  const auto hash_full = [](int64_t v) {
-    uint64_t h = (uint64_t)v * 0x9E3779B97F4A7C15ull;
-    h ^= h >> 29;
-    return h;
-  };
+  const auto hash_full = [](int64_t v) { return pq_mix64((uint64_t)v); };
   const auto grow = [&]() {
     cap <<= 1;
     slot.assign(cap, -1);
@@ -1351,7 +1362,9 @@ int64_t pq_dict_build_ba(const uint8_t* data, const int64_t* offsets,
   const int64_t total = offsets[n];
   constexpr uint64_t kMix = 0x9E3779B97F4A7C15ull;
   const auto load_masked = [&](int64_t off, int64_t len) -> uint64_t {
-    // len in [0, 8]
+    // len in [0, 8]; all-empty-string columns pass data == NULL, so never
+    // touch the pointer for a zero-length load
+    if (len == 0) return 0;
     if (off + 8 <= total) {
       uint64_t w;
       memcpy(&w, data + off, 8);
@@ -1388,9 +1401,94 @@ int64_t pq_dict_build_ba(const uint8_t* data, const int64_t* offsets,
       h = (h ^ w) * kMix;
       h ^= h >> 29;
     }
+    // final avalanche: the index comes from the LOW bits, and the per-word
+    // mix above does not push a word's high bytes down into them — strings
+    // differing only in trailing characters would otherwise cluster (see
+    // pq_mix64).
+    h = pq_mix64(h);
     *k8 = w0;
     return h;
   };
+  // Short-string fast path: when every value fits in 7 bytes, the whole
+  // (bytes, length) identity packs into one tagged word — bytes in the low
+  // 56 bits, length in the top byte — so probing is a single-word compare
+  // with no memcmp and 16-byte slots.  This is the dominant dictionary
+  // write shape (categorical/enum-like string columns: flags, codes,
+  // ship modes) and runs ~2x the general loop below.
+  {
+    int64_t maxlen = 0;
+    for (int64_t i = 0; i < n && maxlen <= 7; ++i) {
+      const int64_t l = offsets[i + 1] - offsets[i];
+      if (l > maxlen) maxlen = l;
+    }
+    if (maxlen <= 7) {
+      // Packed keys are computed on the fly (two loads + mask + tag) — no
+      // n-sized transient, so a 100M-row column costs only its table, which
+      // grows geometrically from 1024 like pq_dict_build_i64's.
+      const auto pack = [&](int64_t i) -> uint64_t {
+        const int64_t o = offsets[i];
+        const uint64_t len = (uint64_t)(offsets[i + 1] - o);
+        if (o + 8 <= total) {
+          uint64_t w;
+          memcpy(&w, data + o, 8);
+          return (w & (((uint64_t)1 << (8 * len)) - 1)) | (len << 56);
+        }
+        return load_masked(o, (int64_t)len) | (len << 56);
+      };
+      const auto hashw = pq_mix64;
+      int64_t cap = 1024;
+      std::vector<int64_t> slot(cap, -1);
+      std::vector<uint64_t> key(cap);
+      std::vector<uint64_t> ukey;  // unique id -> packed key, for rebuilds
+      ukey.reserve(1024);
+      int64_t nu = 0;
+      const auto grow = [&]() {
+        cap <<= 1;
+        slot.assign(cap, -1);
+        key.resize(cap);
+        for (int64_t u = 0; u < nu; ++u) {
+          int64_t p = (int64_t)(hashw(ukey[u]) & (uint64_t)(cap - 1));
+          while (slot[p] >= 0) p = (p + 1) & (cap - 1);
+          slot[p] = u;
+          key[p] = ukey[u];
+        }
+      };
+      constexpr int64_t kAhead = 16;  // hide the random-probe cache miss
+      for (int64_t i = 0; i < n; ++i) {
+        if (i + kAhead < n) {
+          const int64_t pf = (int64_t)(hashw(pack(i + kAhead)) &
+                                       (uint64_t)(cap - 1));
+          __builtin_prefetch(&slot[pf]);
+          __builtin_prefetch(&key[pf]);
+        }
+        const uint64_t v = pack(i);
+        int64_t p = (int64_t)(hashw(v) & (uint64_t)(cap - 1));
+        while (true) {
+          const int64_t s = slot[p];
+          if (s < 0) {
+            if (nu >= max_unique) return -(i + 1);
+            if (2 * (nu + 1) > cap) {
+              grow();
+              p = (int64_t)(hashw(v) & (uint64_t)(cap - 1));
+              continue;
+            }
+            slot[p] = nu;
+            key[p] = v;
+            ukey.push_back(v);
+            indices[i] = nu;
+            ++nu;
+            break;
+          }
+          if (key[p] == v) {
+            indices[i] = s;
+            break;
+          }
+          p = (p + 1) & (cap - 1);
+        }
+      }
+      return nu;
+    }
+  }
   struct BaSlot {       // one cache-line-friendly 32-byte entry per slot
     uint64_t h;         // full hash
     uint64_t k8;        // first 8 bytes, zero-padded

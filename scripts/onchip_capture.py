@@ -16,7 +16,7 @@ the *probe*, not the run.  This queue closes the gap:
   even a short tunnel window yields a complete on-chip artifact; a
   successful quick pass escalates to the full-size run;
 - every completed (or partial) result is merged into
-  ``BENCH_TPU_R04.json`` at the repo root, newest-complete wins.
+  ``BENCH_TPU_R05.json`` at the repo root, newest-complete wins.
 
 Usage: python scripts/onchip_capture.py [--max-hours H] [--once]
 Exit 0 when a full-size on-chip artifact was captured, 3 when the budget
@@ -32,7 +32,7 @@ import sys
 import time
 
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
-ART = os.path.join(ROOT, "BENCH_TPU_R04.json")
+ART = os.path.join(ROOT, "BENCH_TPU_R05.json")
 CKPT = os.path.join(ROOT, ".bench_tpu_partial.json")
 
 
