@@ -78,10 +78,9 @@ class Column:
         """Present values as numpy; nulls are NOT filled (dense values only)."""
         return np.asarray(self.values)
 
-    def _dict_dense_arrow(self):
-        """Dictionary-encoded column → dense arrow via one arrow-C++ cast
-        (indices + dictionary → DictionaryArray → value type) instead of a
-        host gather over every value.  None = caller falls back."""
+    def _dict_arrow(self):
+        """Dictionary-encoded column → pyarrow DictionaryArray (indices +
+        dictionary, both zero-gather).  None = caller falls back."""
         import pyarrow as pa
 
         dh = self._host_dictionary()
@@ -102,18 +101,31 @@ class Column:
                 ia = pa.array(slot, mask=~v)
             else:
                 ia = pa.array(idx)
-            return pa.DictionaryArray.from_arrays(ia, dict_arr) \
-                .cast(dict_arr.type)
+            return pa.DictionaryArray.from_arrays(ia, dict_arr)
         except Exception:
             return None
 
-    def to_arrow(self):
+    def _dict_dense_arrow(self):
+        """Dictionary-encoded column → dense arrow via one arrow-C++ cast
+        (indices + dictionary → DictionaryArray → value type) instead of a
+        host gather over every value.  None = caller falls back."""
+        arr = self._dict_arrow()
+        return None if arr is None else arr.cast(arr.type.value_type)
+
+    def to_arrow(self, prefer_dictionary: bool = False):
+        """pyarrow array for this column.  ``prefer_dictionary=True`` keeps
+        a dictionary-encoded flat column AS a DictionaryArray — no
+        densifying cast — matching pyarrow's own output for files whose
+        embedded arrow schema declares the field dictionary-typed."""
         import pyarrow as pa
 
         leaf = self.leaf
         arr = None
         if self.is_dictionary_encoded():
-            arr = self._dict_dense_arrow()
+            if prefer_dictionary and not self.list_offsets:
+                arr = self._dict_arrow()
+            if arr is None:
+                arr = self._dict_dense_arrow()
             if arr is None:
                 self.materialize_host()
         if arr is None:

@@ -417,6 +417,32 @@ class ParquetFile:
         return {kv.key: kv.value for kv in (self.metadata.key_value_metadata or [])}
 
     @property
+    def arrow_dictionary_fields(self) -> frozenset:
+        """Top-level field names the embedded ``ARROW:schema`` declares as
+        arrow dictionary type.  Readers use this to emit DictionaryArray
+        directly (indices + dictionary, pyarrow's own behavior for such
+        files) instead of densifying a column parquet stored
+        dictionary-encoded.  Empty when no arrow schema is embedded."""
+        got = getattr(self, "_arrow_dict_fields", None)
+        if got is None:
+            got = frozenset()
+            blob = self.key_value_metadata().get("ARROW:schema")
+            if blob:
+                try:
+                    import base64
+
+                    import pyarrow as pa
+
+                    schema = pa.ipc.read_schema(
+                        pa.BufferReader(base64.b64decode(blob)))
+                    got = frozenset(f.name for f in schema
+                                    if pa.types.is_dictionary(f.type))
+                except Exception:
+                    got = frozenset()
+            self._arrow_dict_fields = got
+        return got
+
+    @property
     def row_groups(self) -> List[RowGroupReader]:
         return [RowGroupReader(self, i, rg)
                 for i, rg in enumerate(self.metadata.row_groups or [])]
@@ -494,10 +520,9 @@ class ParquetFile:
 
         if (n_rg * len(leaves) > 1 and available_cpus() > 1
                 and total_rows * len(leaves) >= 2_000_000):
-            from ..utils.pool import shared_pool
+            from ..utils.pool import submit as pool_submit
 
-            pool = shared_pool()
-            futs = {leaf.dotted_path: [pool.submit(decode_chunk_host, c)
+            futs = {leaf.dotted_path: [pool_submit(decode_chunk_host, c)
                                        for c in per_leaf]
                     for leaf, per_leaf in zip(leaves, chunks)}
             parts = {p: [f.result() for f in fs] for p, fs in futs.items()}
@@ -511,7 +536,8 @@ class ParquetFile:
             parts = {leaf.dotted_path: [decode_chunk_host(c)
                                         for c in per_leaf]
                      for leaf, per_leaf in zip(leaves, chunks)}
-        return Table(self.schema, None, total_rows, parts=parts)
+        return Table(self.schema, None, total_rows, parts=parts,
+                     dict_fields=self.arrow_dictionary_fields)
 
     def close(self):
         self.source.close()
@@ -547,11 +573,15 @@ class Table:
 
     def __init__(self, schema: Schema, columns: Optional[Dict[str, Column]],
                  num_rows: int,
-                 parts: Optional[Dict[str, List[Column]]] = None):
+                 parts: Optional[Dict[str, List[Column]]] = None,
+                 dict_fields: frozenset = frozenset()):
         self.schema = schema
         self._columns = columns
         self._parts = parts if columns is None else None
         self.num_rows = num_rows
+        # fields the file's embedded arrow schema declares dictionary-typed:
+        # to_arrow preserves them as DictionaryArray (pyarrow's behavior)
+        self._dict_fields = dict_fields
 
     @property
     def columns(self) -> Dict[str, Column]:
@@ -593,8 +623,17 @@ class Table:
                 return None
             ps = self._parts[present[0].dotted_path]
             names.append(child.name)
-            arrays.append(pa.chunked_array([p.to_arrow() for p in ps])
-                          if len(ps) > 1 else ps[0].to_arrow())
+            prefer = child.name in self._dict_fields
+            arrs = [p.to_arrow(prefer_dictionary=prefer) for p in ps]
+            if prefer and any(not pa.types.is_dictionary(a.type)
+                              for a in arrs):
+                # a chunk fell back to dense (dictionary overflow mid-file):
+                # normalize every chunk dense so the types line up
+                arrs = [a.cast(a.type.value_type)
+                        if pa.types.is_dictionary(a.type) else a
+                        for a in arrs]
+            arrays.append(pa.chunked_array(arrs) if len(arrs) > 1
+                          else arrs[0])
         return pa.Table.from_arrays(arrays, names=names)
 
     def to_arrow(self):
@@ -996,21 +1035,123 @@ def _batch_decompress(page_list, codec):
     if len(srcs) < 2:  # a single page gains nothing over the direct call
         return None
     from .. import native as _nat
-    from ..utils.pool import available_cpus
+    from ..utils.pool import available_cpus, in_shared_pool
 
     # read() already fans chunks across the shared pool — a per-chunk
     # thread split on top would oversubscribe (pool width x 8 native
     # threads); keep the split for single-chunk/streaming callers only.
-    # The shared pool names its workers "pq-work_*" (utils/pool.py);
-    # "ThreadPoolExecutor*" covers ad-hoc executors.
-    tname = threading.current_thread().name
-    pooled = tname.startswith(("pq-work", "ThreadPoolExecutor"))
+    # The pool dispatch marks its workers explicitly (utils/pool.py submit).
     res = _nat.decompress_pages(srcs, sizes, int(cid),
-                                1 if pooled else min(available_cpus(), 8))
+                                1 if in_shared_pool()
+                                else min(available_cpus(), 8))
     if res is None:
         return None
     buf, offs = res
     return {idx: buf[offs[j]:offs[j + 1]] for j, idx in enumerate(idxs)}
+
+
+_PLAIN_FIXED_ITEM = {Type.INT32: np.int32, Type.INT64: np.int64,
+                     Type.FLOAT: np.float32, Type.DOUBLE: np.float64}
+
+
+def _plain_fixed_chunk_fast(reader: ColumnChunkReader, page_list, pre_dec,
+                            leaf: Leaf, physical: Type) -> Optional[Column]:
+    """Whole-chunk fast path for flat, all-present PLAIN fixed-width columns.
+
+    For such a chunk every data page's decompressed payload is a (possibly
+    empty) def-level prefix followed by raw value bytes, so the chunk array
+    is just the concatenation of the per-page value regions: one copy, or
+    ZERO copies when no page carries a prefix (required columns, or v2
+    pages whose levels live outside the compressed body) since the batched
+    decompressor already produced one contiguous buffer.  The general path
+    instead pays a per-page decode copy plus a chunk-level concatenate.
+    Returns None when any precondition fails (nulls present, mixed
+    encodings, dictionary pages, framing surprises); the general path then
+    runs on the same ``pre_dec`` without duplicated work."""
+    dtype = _PLAIN_FIXED_ITEM.get(physical)
+    if (dtype is None or leaf.max_repetition_level > 0
+            or leaf.max_definition_level > 1
+            or not _is_builtin_decode(Encoding.PLAIN)):
+        return None
+    max_def = leaf.max_definition_level
+    itemsize = np.dtype(dtype).itemsize
+    codec = reader.codec
+    slices: List[np.ndarray] = []
+    total_vals = 0
+    n_pages = 0
+    contiguous_base = None  # buffer all slices view into, when zero-copy-able
+    for page_i, page in enumerate(page_list):
+        h = page.header
+        pt = page.page_type
+        if pt == PageType.DICTIONARY_PAGE:
+            return None  # dict-encoded pages follow; not a pure-plain chunk
+        if pt not in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+            continue
+        verify_page_crc(reader, page)
+        pre = pre_dec.get(page_i) if pre_dec is not None else None
+        if pt == PageType.DATA_PAGE:
+            dph = h.data_page_header
+            if Encoding(dph.encoding) != Encoding.PLAIN:
+                return None
+            n = dph.num_values
+            raw = pre if pre is not None else np.frombuffer(
+                codec.decode(page.payload, h.uncompressed_page_size),
+                np.uint8)
+            pos = 0
+            if max_def > 0:
+                if Encoding(dph.definition_level_encoding) != Encoding.RLE:
+                    return None
+                pv, pos = ref.rle_len_prefixed_single_value(raw, n, 0)
+                if pv != 1:
+                    return None  # nulls (or multi-run levels): general path
+        else:
+            dph2 = h.data_page_header_v2
+            if (Encoding(dph2.encoding) != Encoding.PLAIN
+                    or (dph2.num_nulls or 0)
+                    or (dph2.repetition_levels_byte_length or 0)):
+                return None
+            n = dph2.num_values
+            dl = dph2.definition_levels_byte_length or 0
+            if dph2.is_compressed is not False:
+                body = pre if pre is not None else np.frombuffer(
+                    codec.decode(page.payload[dl:],
+                                 h.uncompressed_page_size - dl), np.uint8)
+            else:
+                body = np.frombuffer(page.payload, np.uint8)[dl:]
+            raw, pos = body, 0
+        if len(raw) - pos != n * itemsize:
+            return None  # unexpected framing — let the general path say why
+        sl = raw[pos:] if pos else raw
+        if n_pages == 0:
+            contiguous_base = sl.base if pos == 0 else None
+        elif pos != 0 or sl.base is None or sl.base is not contiguous_base:
+            contiguous_base = None
+        slices.append(sl)
+        total_vals += n
+        n_pages += 1
+    if not slices:
+        return None
+    values = None
+    if len(slices) == 1:
+        values = slices[0].view(dtype)
+    elif isinstance(contiguous_base, np.ndarray):
+        # all slices view one buffer; zero-copy iff they tile it end to end
+        ptr = slices[0].__array_interface__["data"][0]
+        for sl in slices:
+            if sl.__array_interface__["data"][0] != ptr:
+                break
+            ptr += sl.nbytes
+        else:
+            base0 = contiguous_base.__array_interface__["data"][0]
+            start = slices[0].__array_interface__["data"][0] - base0
+            values = contiguous_base[start:start + total_vals * itemsize] \
+                .view(dtype)
+    if values is None:
+        values = np.concatenate(slices).view(dtype)
+    counters.inc("data_pages_decoded", n_pages)
+    counters.inc("plain_fixed_chunk_fast")
+    return Column(leaf=leaf, values=values, offsets=None, validity=None,
+                  list_offsets=[], list_validity=[], num_slots=total_vals)
 
 
 def decode_chunk_host(reader: ColumnChunkReader, pages=None,
@@ -1033,6 +1174,11 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
 
     page_list = list(pages) if pages is not None else list(reader.pages())
     pre_dec = _batch_decompress(page_list, codec)
+    if dictionary is None:
+        fast = _plain_fixed_chunk_fast(reader, page_list, pre_dec, leaf,
+                                       physical)
+        if fast is not None:
+            return fast
 
     for page_i, page in enumerate(page_list):
         h = page.header

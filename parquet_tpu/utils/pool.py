@@ -15,6 +15,28 @@ from typing import Optional
 
 _POOL: Optional[ThreadPoolExecutor] = None
 _LOCK = threading.Lock()
+_IN_POOL = threading.local()
+
+
+def in_shared_pool() -> bool:
+    """True inside work dispatched via :func:`submit` — callees consult this
+    to keep their own native thread splits at 1 instead of oversubscribing
+    (pool width x native threads).  Explicit context, not thread-name
+    matching: user-named worker threads must not defeat the limit."""
+    return getattr(_IN_POOL, "flag", False)
+
+
+def submit(fn, *args, **kwargs):
+    """Submit to the shared pool, marking the worker for in_shared_pool()."""
+
+    def run():
+        _IN_POOL.flag = True
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _IN_POOL.flag = False
+
+    return shared_pool().submit(run)
 
 
 def available_cpus() -> int:
