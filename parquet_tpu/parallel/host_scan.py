@@ -23,7 +23,7 @@ from ..io.search import BA_ARRAYS, plan_scan, read_row_range
 
 __all__ = ["scan_filtered", "scan_filtered_device", "scan_filtered_sharded"]
 
-from ..utils.pool import shared_pool as _pool
+from ..utils.pool import mark_pooled as _mark_pooled, shared_pool as _pool
 
 # decoded_scan: spans between survivor-count syncs (bounds device residency
 # at ~_SYNC_EVERY spans of uncompacted output while amortizing the RTT)
@@ -125,11 +125,13 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     elif num_threads is None:
         # fan out per (span, column): the decode work releases the GIL in
         # numpy/C++/codec calls, so even a single surviving span uses all
-        # requested columns' worth of parallelism
-        results = list(_pool().map(read_one, tasks))
+        # requested columns' worth of parallelism.  mark_pooled keeps the
+        # per-worker native decompress split at 1 (no pool x native
+        # oversubscription).
+        results = list(_pool().map(_mark_pooled(read_one), tasks))
     else:  # explicit bound: a dedicated pool honors the caller's limit
         with ThreadPoolExecutor(max_workers=num_threads) as pool:
-            results = list(pool.map(read_one, tasks))
+            results = list(pool.map(_mark_pooled(read_one), tasks))
     spans = [{c: results[i * len(read_cols) + j] for j, c in enumerate(read_cols)}
              for i in range(len(plans))]
 

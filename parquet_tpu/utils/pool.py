@@ -26,17 +26,23 @@ def in_shared_pool() -> bool:
     return getattr(_IN_POOL, "flag", False)
 
 
-def submit(fn, *args, **kwargs):
-    """Submit to the shared pool, marking the worker for in_shared_pool()."""
+def mark_pooled(fn):
+    """Wrap ``fn`` so in_shared_pool() is True while it runs — for work
+    dispatched to ANY executor (the shared pool or a caller-bounded one)."""
 
-    def run():
+    def run(*args, **kwargs):
         _IN_POOL.flag = True
         try:
             return fn(*args, **kwargs)
         finally:
             _IN_POOL.flag = False
 
-    return shared_pool().submit(run)
+    return run
+
+
+def submit(fn, *args, **kwargs):
+    """Submit to the shared pool, marking the worker for in_shared_pool()."""
+    return shared_pool().submit(mark_pooled(fn), *args, **kwargs)
 
 
 def available_cpus() -> int:
