@@ -31,11 +31,12 @@ def mark_pooled(fn):
     dispatched to ANY executor (the shared pool or a caller-bounded one)."""
 
     def run(*args, **kwargs):
+        prev = getattr(_IN_POOL, "flag", False)
         _IN_POOL.flag = True
         try:
             return fn(*args, **kwargs)
         finally:
-            _IN_POOL.flag = False
+            _IN_POOL.flag = prev
 
     return run
 
