@@ -294,3 +294,52 @@ def test_device_scan_decimal_byte_array_key_rejected():
     # device scan must refuse a decimal key with a clear error
     with pytest.raises(ValueError, match="use the host scan"):
         stage_scan(pf, "d", lo=vals[10], hi=vals[20], columns=["v"])
+
+
+def test_fused_span_filter_activates_and_matches_eager():
+    """The fused (single-jit) span filter activates on the second
+    decoded_scan call over a staged state; its results must be identical to
+    the eager first call, including nullable outputs and IN-list keys."""
+    import jax
+
+    from parquet_tpu.parallel.host_scan import decoded_scan, stage_scan
+
+    n = 60000
+    rng = np.random.default_rng(5)
+    ship = np.sort(rng.integers(8000, 12000, n).astype(np.int32))
+    price = rng.random(n) * 1e5
+    mask = rng.random(n) < 0.1
+    t = pa.table({
+        "l_shipdate": pa.array(ship),
+        "l_extendedprice": pa.array(np.where(mask, None, price)),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 4, data_page_size=1 << 15,
+                   compression="snappy", use_dictionary=False,
+                   write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+
+    def snap(out):
+        form = out["l_extendedprice"]
+        form, valid = form if isinstance(form, tuple) else (form, None)
+        vals = pairs_to_host(form, np.float64)
+        v = np.asarray(valid) if valid is not None else np.ones(len(vals), bool)
+        return vals[v], v
+
+    state = stage_scan(pf, "l_shipdate", lo=9000, hi=9200,
+                       columns=["l_extendedprice"])
+    assert any(f is not None for _, _, f in state["spans"])
+    eager_vals, eager_valid = snap(decoded_scan(state))   # call 1: eager
+    fused_vals, fused_valid = snap(decoded_scan(state))   # call 2: fused
+    np.testing.assert_array_equal(eager_valid, fused_valid)
+    np.testing.assert_allclose(eager_vals, fused_vals)
+
+    # IN-list key through both paths
+    probes = [int(ship[10]), int(ship[n // 2]), 1]
+    st2 = stage_scan(pf, "l_shipdate", values=probes,
+                     columns=["l_extendedprice"])
+    e_vals, e_valid = snap(decoded_scan(st2))
+    f_vals, f_valid = snap(decoded_scan(st2))
+    np.testing.assert_array_equal(e_valid, f_valid)
+    np.testing.assert_allclose(e_vals, f_vals)
+    jax.block_until_ready([])
