@@ -1109,21 +1109,28 @@ def stage_levels_on_device(leaf, plan: _Plan) -> bool:
     repetition) always expand on host: the table assembler needs host def
     levels for struct nullness, so staging their bytes would be wasted H2D.
 
-    Repeated columns default to HOST assembly: level streams are
-    metadata-scale (~bits per slot) and the C++ expand+assemble pass is an
-    order of magnitude cheaper than the device compaction kernels emulated
-    on CPU (measured 8M slots: 31 ms C++ vs 555-815 ms emulated), which are
-    scatter/sort-shaped — the wrong op class for a TPU VPU too, though the
-    on-chip trial is still queued behind the tunnel.  The device assembler
-    exists for pipelines that need offsets/validity resident in HBM."""
+    Repeated columns assemble on device by DEFAULT on accelerator
+    backends (offsets/validity land in HBM via ``dev.assemble_nested`` —
+    no host round-trip in the decode pipeline) and on HOST on the cpu
+    backend, where the compaction kernels are emulated scatter/sort and
+    measured 10-25x slower than the C++ expand+assemble pass (8M slots:
+    31 ms C++ vs 555-815 ms emulated).  ``PARQUET_TPU_DEVICE_ASM=1``
+    forces device assembly everywhere (the route-soak's device leg);
+    ``=0`` forces host assembly everywhere."""
     if leaf.max_repetition_level == 0:
         if plan.total_values == plan.total_slots:
             return False  # no nulls anywhere: validity is None, levels unused
         return leaf.max_definition_level <= 1
     import os
 
-    if os.environ.get("PARQUET_TPU_DEVICE_ASM") != "1":
+    flag = os.environ.get("PARQUET_TPU_DEVICE_ASM")
+    if flag == "0":
         return False
+    if flag != "1":
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return False
     # any repetition depth: dev.assemble_nested mirrors the host assembler
     # over expanded level streams (struct layers between lists collapse into
     # the nearest list validity, same as the host semantics)

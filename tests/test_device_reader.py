@@ -524,3 +524,37 @@ def test_bss_route_pinned_equals_host_route(dtype, rng, monkeypatch):
     assert dev_col.to_arrow().equals(host_col.to_arrow())
     oracle = t.column("c").combine_chunks()
     assert dev_col.to_arrow().cast(oracle.type).equals(oracle)
+
+
+def test_device_asm_default_is_backend_aware(monkeypatch):
+    """Unset: device nested assembly is ON for accelerator backends, OFF on
+    the cpu backend (where the compaction kernels are emulated and measured
+    10-25x slower than the C++ host assembler).  "1"/"0" force either way."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io.reader import ParquetFile
+    from parquet_tpu.parallel import device_reader as dr
+
+    t = pa.table({"v": pa.array([[1, 2], [], None, [3]] * 64)})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False)
+    chunk = ParquetFile(buf.getvalue()).row_group(0).column(0)
+    plan = dr.build_plan(chunk)
+    leaf = chunk.leaf
+
+    monkeypatch.delenv("PARQUET_TPU_DEVICE_ASM", raising=False)
+    assert dr.stage_levels_on_device(leaf, plan) is False  # cpu backend
+    monkeypatch.setenv("PARQUET_TPU_DEVICE_ASM", "1")
+    assert dr.stage_levels_on_device(leaf, plan) is True
+    monkeypatch.setenv("PARQUET_TPU_DEVICE_ASM", "0")
+    assert dr.stage_levels_on_device(leaf, plan) is False
+
+    # unset + non-cpu backend reported -> device assembly is the default
+    monkeypatch.delenv("PARQUET_TPU_DEVICE_ASM", raising=False)
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert dr.stage_levels_on_device(leaf, plan) is True
