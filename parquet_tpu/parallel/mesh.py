@@ -58,6 +58,12 @@ class ShardedTable:
     joins on the index stream are exact on device) into one host
     ``dictionaries[path] = (uint8 values, int64 offsets)`` shared by every
     shard — ``lookup_strings(path, ids)`` materializes entries.
+
+    PLAIN (non-dictionary) BYTE_ARRAY columns shard as the arrow ragged
+    pair in ``ragged[path]`` (see field comment); a column whose chunks mix
+    dictionary and plain encodings (pyarrow's mid-file dictionary-overflow
+    fallback) densifies the dictionary chunks so the whole column ships
+    ragged.
     """
 
     arrays: Dict[str, jax.Array]
@@ -65,6 +71,12 @@ class ShardedTable:
     row_counts: tuple
     mesh: Mesh
     dictionaries: Dict[str, tuple] = field(default_factory=dict)
+    # PLAIN (non-dictionary) BYTE_ARRAY columns: ragged[path] =
+    # (bytes_global, offsets_global) — per-shard value bytes padded to the
+    # byte-widest shard, and per-shard slot-aligned int64 offsets (null
+    # slots zero-length) padded to shard_rows+1 entries, both sharded on
+    # the mesh's first axis like arrays[path]
+    ragged: Dict[str, tuple] = field(default_factory=dict)
     # schema leaves by path: to_arrow recombines 64-bit pairs and restores
     # logical types (dates, timestamps, decimals, FLBA) through these
     leaves: Dict[str, object] = field(default_factory=dict)
@@ -117,6 +129,47 @@ class ShardedTable:
                     a = _leaf_to_arrow(leaf, rowvals, None, None)
             cols.append(a)
             names.append(path)
+        R = self.shard_rows
+        nd = len(self.row_counts)
+
+        def _offs32(o):
+            if len(o) and int(o[-1]) > np.iinfo(np.int32).max:
+                raise NotImplementedError(
+                    "ragged shard holds more than 2 GiB of value bytes; "
+                    "int32 arrow offsets cannot address it — use smaller "
+                    "row groups or more shards")
+            return o.astype(np.int32)
+
+        for path, (b_g, o_g) in self.ragged.items():
+            leaf = self.leaves.get(path)
+            bh = np.asarray(b_g)
+            oh = np.asarray(o_g)
+            mb = len(bh) // nd if nd else 0
+            valid_all = (np.asarray(self.validity[path])
+                         if path in self.validity else None)
+            chunks = []
+            for d in range(nd):
+                rc = self.row_counts[d]
+                o = oh[d * (R + 1): d * (R + 1) + rc + 1].astype(np.int64)
+                seg = bh[d * mb: d * mb + (int(o[-1]) if rc else 0)]
+                if valid_all is not None:
+                    v = np.asarray(valid_all[d * R: d * R + rc], bool)
+                    # null slots are zero-length, so the dense offsets are
+                    # the slot offsets with null entries dropped
+                    dense_offs = np.concatenate([o[:-1][v], o[-1:]])
+                    chunks.append(_leaf_to_arrow(leaf, seg,
+                                                 _offs32(dense_offs), v))
+                else:
+                    chunks.append(_leaf_to_arrow(leaf, seg, _offs32(o),
+                                                 None))
+            cols.append(pa.chunked_array(chunks))
+            names.append(path)
+        # file schema order (self.leaves is insertion-ordered by schema)
+        if self.leaves:
+            want = [p for p in self.leaves if p in names]
+            want += [p for p in names if p not in self.leaves]
+            lookup = dict(zip(names, cols))
+            names, cols = want, [lookup[p] for p in want]
         return pa.table(dict(zip(names, cols)))
 
     @property
@@ -200,6 +253,21 @@ def _unify_dictionaries(dv_parts: List[np.ndarray],
     return uvals, np.asarray(uoffs, np.int64), remap
 
 
+def _slot_ragged(vals: np.ndarray, offs: np.ndarray, validity,
+                 n_nulls: int):
+    """Dense (values, offsets) → slot-aligned offsets where null slots are
+    zero-length entries (the arrow convention the sharded ragged form
+    uses); values are untouched."""
+    if validity is None or not n_nulls:
+        return vals, offs
+    valid = np.asarray(validity, bool)
+    lens = np.zeros(len(valid), np.int64)
+    lens[valid] = offs[1:] - offs[:-1]
+    so = np.zeros(len(valid) + 1, np.int64)
+    np.cumsum(lens, out=so[1:])
+    return vals, so
+
+
 def read_table_sharded(source, mesh: Optional[Mesh] = None,
                        columns: Optional[Sequence[str]] = None,
                        axis: str = "data",
@@ -216,9 +284,10 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
     their int32 index stream with the per-row-group dictionaries UNIFIED
     (first-occurrence dedup — id equality is string equality on every
     shard) into ``ShardedTable.dictionaries[path]``.
-    PLAIN-encoded (non-dictionary) string columns and nested columns raise
-    ValueError (read them with ``ParquetFile.read(device=True)``, which
-    keeps ragged forms).
+    PLAIN-encoded (non-dictionary) string columns shard as the ragged
+    (bytes, slot-offsets) pair in ``ShardedTable.ragged``; nested columns
+    raise ValueError (read them with ``ParquetFile.read(device=True)``,
+    which keeps ragged forms).
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -236,22 +305,6 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
             raise ValueError(
                 f"read_table_sharded: column {leaf.dotted_path!r} is "
                 "nested; use ParquetFile.read(device=True)")
-        if leaf.physical_type == Type.BYTE_ARRAY:
-            # reject PLAIN string chunks UP FRONT from the chunk metadata —
-            # discovering it after the whole file was read and staged would
-            # waste the entire read on an error path
-            for rg in range(n_rg):
-                encs = (pf.metadata.row_groups[rg]
-                        .columns[leaf.column_index].meta_data.encodings
-                        or [])
-                if not any(int(e) in (int(Encoding.PLAIN_DICTIONARY),
-                                      int(Encoding.RLE_DICTIONARY))
-                           for e in encs):
-                    raise ValueError(
-                        f"read_table_sharded: column {leaf.dotted_path!r} "
-                        f"has a PLAIN-encoded (non-dictionary) string chunk "
-                        f"(row group {rg}) — ragged values cannot shard "
-                        "densely; use ParquetFile.read(device=True)")
     if n_rg == 0:
         return ShardedTable(arrays={}, validity={},
                             row_counts=(0,) * len(devs), mesh=mesh,
@@ -261,6 +314,15 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
     def prep(task):
         leaf, rg = task
         reader = pf.row_group(rg).column(leaf.column_index)
+        if leaf.physical_type == Type.BYTE_ARRAY:
+            encs = reader.meta.encodings or []
+            if not any(int(e) in (int(Encoding.PLAIN_DICTIONARY),
+                                  int(Encoding.RLE_DICTIONARY))
+                       for e in encs):
+                # fully PLAIN chunk: it ships as the host-assembled ragged
+                # pair anyway — device-staging it first would be a wasted
+                # H2D+D2H round trip
+                return None, reader
         try:
             return prepare_chunk(reader, device=devs[rg % len(devs)]), reader
         except _Unsupported:
@@ -273,6 +335,7 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
     arrays: Dict[str, jax.Array] = {}
     validities: Dict[str, jax.Array] = {}
     dictionaries: Dict[str, tuple] = {}
+    ragged: Dict[str, tuple] = {}
     rg_rows = [pf.row_group(i).num_rows for i in range(n_rg)]
     shard_counts = [sum(rg_rows[rg] for rg in range(n_rg)
                         if rg % len(devs) == d) for d in range(len(devs))]
@@ -282,7 +345,8 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
         per_dev_vals: Dict[int, List[jax.Array]] = {}
         per_dev_valid: Dict[int, List[jax.Array]] = {}
         has_nulls = False
-        ba_parts = []  # (device, indices, validity, n_nulls) per row group
+        ba_parts = []  # (rg, device, indices, validity, n_nulls) per row group
+        ragged_parts = []  # (rg, device, bytes, slot_offsets, validity, n_nulls)
         dict_vals_parts: List[np.ndarray] = []
         dict_offs_parts: List[np.ndarray] = []
         for (prep_out, reader), (l2, rg) in zip(prepped, tasks):
@@ -293,19 +357,23 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
                 col, n_nulls = _decode_prepped(reader, prep_out)
                 if is_ba:
                     if not col.is_dictionary_encoded():
-                        raise ValueError(
-                            f"read_table_sharded: column "
-                            f"{leaf.dotted_path!r} has a PLAIN-encoded "
-                            "(non-dictionary) string chunk — ragged values "
-                            "cannot shard densely; use "
-                            "ParquetFile.read(device=True)")
+                        # PLAIN chunk: ship the arrow ragged pair; slot
+                        # alignment (nulls zero-length) happens on host at
+                        # staging scale
+                        ragged_parts.append(
+                            (rg, d) + _slot_ragged(
+                                np.asarray(col.values),
+                                np.asarray(col.offsets, np.int64),
+                                col.validity, n_nulls)
+                            + (col.validity, n_nulls))
+                        continue
                     dvals, doffs = col._host_dictionary()
                     dict_vals_parts.append(np.asarray(dvals))
                     dict_offs_parts.append(np.asarray(doffs, np.int64))
                     # index placement deferred until the dictionaries are
                     # unified below (ids must mean the same string on
                     # every shard for device-side filters/joins)
-                    ba_parts.append((d, col.dict_indices, col.validity,
+                    ba_parts.append((rg, d, col.dict_indices, col.validity,
                                      n_nulls))
                     continue
                 vals = col.values
@@ -324,13 +392,84 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
                     valid = None  # nullable schema, no actual nulls
             per_dev_vals.setdefault(d, []).append(vals)
             per_dev_valid.setdefault(d, []).append(valid)
+        if is_ba and ragged_parts:
+            if ba_parts:
+                # mixed dictionary/plain chunks (pyarrow's mid-file
+                # dictionary-overflow fallback): densify the dictionary
+                # chunks host-side so the whole column ships ragged
+                from ..ops import ref as _ref
+
+                for (rg, d, idx, valid, n_nulls), dvals, doffs in zip(
+                        ba_parts, dict_vals_parts, dict_offs_parts):
+                    g = _ref.gather_dictionary(
+                        (np.asarray(dvals), np.asarray(doffs, np.int64)),
+                        np.asarray(idx, np.int64))
+                    ragged_parts.append(
+                        (rg, d) + _slot_ragged(np.asarray(g[0]),
+                                               np.asarray(g[1], np.int64),
+                                               valid, n_nulls)
+                        + (valid, n_nulls))
+                ba_parts = []
+            per_dev_r: Dict[int, List[tuple]] = {}
+            col_has_nulls = any(nn and v is not None
+                                for *_, v, nn in ragged_parts)
+            for rg, d, vb, so, valid, nn in sorted(ragged_parts,
+                                                   key=lambda p: p[0]):
+                per_dev_r.setdefault(d, []).append((vb, so, valid, nn))
+            shard_bytes, shard_offs, shard_valids = [], [], []
+            for d in range(len(devs)):
+                parts = per_dev_r.get(d, [])
+                b = (np.concatenate([p[0] for p in parts]) if parts
+                     else np.zeros(0, np.uint8))
+                off_parts = [np.zeros(1, np.int64)]
+                base = 0
+                for vb, so, _, _ in parts:
+                    off_parts.append(so[1:] + base)
+                    base += int(so[-1])
+                o = np.concatenate(off_parts)
+                if len(o) < maxlen + 1:  # padding rows are zero-length
+                    o = np.concatenate(
+                        [o, np.full(maxlen + 1 - len(o), o[-1], np.int64)])
+                shard_bytes.append(b)
+                shard_offs.append(o)
+                if col_has_nulls:
+                    vps = [np.asarray(v, bool) if v is not None and nn
+                           else np.ones(len(so) - 1, bool)
+                           for vb, so, v, nn in parts]
+                    va = (np.concatenate(vps) if vps
+                          else np.zeros(0, bool))
+                    shard_valids.append(np.pad(va, (0, maxlen - len(va))))
+            max_bytes = max((len(b) for b in shard_bytes), default=0) or 1
+            gb, go, gv = [], [], []
+            for d in range(len(devs)):
+                with jax.default_device(devs[d]):
+                    b = shard_bytes[d]
+                    if len(b) < max_bytes:
+                        b = np.pad(b, (0, max_bytes - len(b)))
+                    gb.append(jax.device_put(jnp.asarray(b), devs[d]))
+                    go.append(jax.device_put(jnp.asarray(shard_offs[d]),
+                                             devs[d]))
+                    if col_has_nulls:
+                        gv.append(jax.device_put(
+                            jnp.asarray(shard_valids[d]), devs[d]))
+            sh1 = NamedSharding(mesh, P(mesh.axis_names[0]))
+            ragged[leaf.dotted_path] = (
+                jax.make_array_from_single_device_arrays(
+                    (max_bytes * len(devs),), sh1, gb),
+                jax.make_array_from_single_device_arrays(
+                    ((maxlen + 1) * len(devs),), sh1, go))
+            if col_has_nulls:
+                validities[leaf.dotted_path] = \
+                    jax.make_array_from_single_device_arrays(
+                        (maxlen * len(devs),), sh1, gv)
+            continue
         if is_ba and dict_vals_parts:
             uvals, uoffs, remap = _unify_dictionaries(dict_vals_parts,
                                                       dict_offs_parts)
             dictionaries[leaf.dotted_path] = (uvals, uoffs)
             base = 0
-            for (d, idx, valid, n_nulls), doffs in zip(ba_parts,
-                                                       dict_offs_parts):
+            for (rg, d, idx, valid, n_nulls), doffs in zip(ba_parts,
+                                                           dict_offs_parts):
                 n_i = len(doffs) - 1
                 sub = remap[base:base + n_i].astype(np.int32)
                 base += n_i
@@ -383,7 +522,7 @@ def read_table_sharded(source, mesh: Optional[Mesh] = None,
                     (maxlen * len(shard_valid),), vsharding, shard_valid)
     return ShardedTable(arrays=arrays, validity=validities,
                         row_counts=tuple(shard_counts), mesh=mesh,
-                        dictionaries=dictionaries,
+                        dictionaries=dictionaries, ragged=ragged,
                         leaves={leaf.dotted_path: leaf for leaf in leaves})
 
 

@@ -88,24 +88,52 @@ def test_read_table_sharded_8dev_uneven():
     assert total == 30000
 
 
-def test_read_table_sharded_rejects_plain_strings_and_nested():
-    # PLAIN-encoded (non-dictionary) strings are ragged — no dense shard
-    t = pa.table({"s": pa.array(["a", "b", "c"]),
-                  "x": pa.array([1, 2, 3], type=pa.int64())})
+def test_read_table_sharded_plain_strings_ragged():
+    """PLAIN (non-dictionary) strings shard as the ragged
+    (bytes, slot-offsets) pair — value-checked against pyarrow, nulls
+    included; nested columns still raise."""
+    rng = np.random.default_rng(11)
+    n = 9000
+    words = np.array(["alpha", "bee", "", "delta-delta", "e"])
+    s = words[rng.integers(0, 5, n)]
+    t = pa.table({"s": pa.array(s),
+                  "sn": pa.array(s, mask=rng.random(n) < 0.2),
+                  "x": pa.array(np.arange(n, dtype=np.int64))})
     buf = io.BytesIO()
-    pq.write_table(t, buf, use_dictionary=False)
-    with pytest.raises(ValueError, match="PLAIN-encoded"):
-        read_table_sharded(buf.getvalue(), mesh=default_mesh(8))
-    # explicit fixed-width selection works
-    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8),
-                            columns=["x"])
-    assert st.num_rows == 3
+    pq.write_table(t, buf, use_dictionary=False, row_group_size=n // 5)
+    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(8))
+    assert "s" in st.ragged and "sn" in st.ragged
+    at = st.to_arrow()
+    ref = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert at.column("s").to_pylist() == ref.column("s").to_pylist()
+    assert at.column("sn").to_pylist() == ref.column("sn").to_pylist()
+    assert at.column("x").to_pylist() == ref.column("x").to_pylist()
     # nested columns always raise
     tn = pa.table({"l": pa.array([[1], [2, 3], []])})
     bufn = io.BytesIO()
     pq.write_table(tn, bufn)
     with pytest.raises(ValueError, match="nested"):
         read_table_sharded(bufn.getvalue(), mesh=default_mesh(8))
+
+
+def test_read_table_sharded_mixed_dict_plain_chunks_densify():
+    """A column whose chunks mix dictionary and plain encodings (pyarrow's
+    mid-file dictionary-overflow fallback) ships whole as ragged."""
+    rng = np.random.default_rng(13)
+    n = 6000
+    # low-cardinality first half (dictionary sticks), near-unique second
+    # half with a tiny dictionary-size budget (falls back to plain)
+    s = np.array([f"v{i % 7}" for i in range(n // 2)]
+                 + [f"unique_{i:06d}" for i in range(n // 2)])
+    t = pa.table({"s": pa.array(s)})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 2, compression="snappy",
+                   use_dictionary=True, dictionary_pagesize_limit=4096)
+    st = read_table_sharded(buf.getvalue(), mesh=default_mesh(4))
+    assert "s" in st.ragged and "s" not in st.dictionaries
+    at = st.to_arrow()
+    ref = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert at.column("s").to_pylist() == ref.column("s").to_pylist()
 
 
 def test_read_table_sharded_dict_strings():
