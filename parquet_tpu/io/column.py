@@ -165,16 +165,21 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
         return pa.nulls(n)
 
     if pt == Type.BYTE_ARRAY:
+        # chunks past the int32 offset range arrive with int64 offsets and
+        # take the arrow LARGE layout (64-bit offsets) end to end
+        wide = getattr(offsets, "dtype", None) == np.int64
         # expand dense values to slot-aligned with validity
         if validity is not None:
             arr = _ragged_with_nulls(values, offsets, validity)
         else:
             arr = pa.Array.from_buffers(
-                pa.binary(), len(offsets) - 1,
-                [None, pa.py_buffer(np.ascontiguousarray(offsets, dtype=np.int32)),
+                pa.large_binary() if wide else pa.binary(),
+                len(offsets) - 1,
+                [None, pa.py_buffer(np.ascontiguousarray(
+                    offsets, dtype=np.int64 if wide else np.int32)),
                  pa.py_buffer(np.ascontiguousarray(np.asarray(values).view(np.uint8)))])
         if k in (LogicalKind.STRING, LogicalKind.ENUM, LogicalKind.JSON):
-            arr = arr.cast(pa.string())
+            arr = arr.cast(pa.large_string() if wide else pa.string())
         elif k == LogicalKind.DECIMAL:
             pass  # decimal-from-binary left as bytes
         return arr
@@ -456,10 +461,12 @@ def _ragged_with_nulls(values: np.ndarray, offsets: np.ndarray, validity: np.nda
     lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
     slot_lens = np.zeros(n, dtype=np.int64)
     slot_lens[validity] = lens
-    slot_offs = np.concatenate([[0], np.cumsum(slot_lens)]).astype(np.int32)
+    slot_offs = np.concatenate([[0], np.cumsum(slot_lens)])
+    wide = offsets.dtype == np.int64 and len(offsets) > 1
+    slot_offs = slot_offs.astype(np.int64 if wide else np.int32)
     mask = pa.py_buffer(np.packbits(validity, bitorder="little"))
     return pa.Array.from_buffers(
-        pa.binary(), n,
+        pa.large_binary() if wide else pa.binary(), n,
         [mask, pa.py_buffer(slot_offs),
          pa.py_buffer(np.ascontiguousarray(np.asarray(values).view(np.uint8)))])
 

@@ -977,15 +977,19 @@ def decode_dictionary_page(reader: ColumnChunkReader, page: PageInfo):
     return dictionary
 
 
+# int32 offsets address chunks up to this many value bytes; beyond it the
+# chunk keeps int64 offsets and converts to arrow large_binary/large_string.
+# Module-level so tests can lower it and exercise the wide path cheaply.
+_OFFSET32_LIMIT = int(np.iinfo(np.int32).max)
+
+
 def _offsets_int32(offs: np.ndarray) -> np.ndarray:
-    """Chunk-level byte-array offsets are int32 end-to-end (arrow binary
-    layout).  A chunk whose value bytes exceed the int32 range would wrap
-    silently — refuse it explicitly instead (the arrow large_binary layout
-    is the upgrade path if such chunks appear in practice)."""
-    if len(offs) and int(offs[-1]) > np.iinfo(np.int32).max:
-        raise NotImplementedError(
-            "BYTE_ARRAY column chunk holds more than 2 GiB of value bytes; "
-            "int32 offsets cannot address it — write smaller row groups")
+    """Chunk-level byte-array offsets: int32 (arrow binary layout) while the
+    value bytes fit; a chunk past ``_OFFSET32_LIMIT`` keeps int64 offsets —
+    ``to_arrow`` then emits the arrow large_binary/large_string layout
+    (``page.go — Page.Data`` imposes no such size limit upstream)."""
+    if len(offs) and int(offs[-1]) > _OFFSET32_LIMIT:
+        return offs.astype(np.int64, copy=False)
     return offs.astype(np.int32, copy=False)
 
 

@@ -3,6 +3,7 @@ read.  This is the golden-file strategy of SURVEY.md §4(3) with pyarrow as the
 live oracle."""
 
 import io
+import os
 
 import numpy as np
 import pyarrow as pa
@@ -609,3 +610,68 @@ def test_read_empty_row_group_selection(rng):
     assert sub.num_rows == 0
     arr = sub.to_arrow()
     assert arr.num_rows == 0 and set(arr.column_names) == {"x", "s"}
+
+
+def test_wide_byte_array_chunk_int64_offsets(monkeypatch):
+    """Chunks whose value bytes exceed the int32-offset range keep int64
+    offsets and convert to arrow large_binary/large_string (reference
+    `page.go — Page.Data` has no 2 GiB chunk limit).  The threshold is
+    lowered so the wide path runs at test scale; a real >2 GiB chunk is
+    covered by the PQ_BIG_TESTS-gated test below."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io import reader as rdr
+
+    monkeypatch.setattr(rdr, "_OFFSET32_LIMIT", 1000)
+    vals = [f"string_{i:04d}_{'x' * (i % 40)}" for i in range(500)]
+    nulls = [i % 7 == 3 for i in range(500)]
+    t = pa.table({"s": pa.array([None if nz else v
+                                 for v, nz in zip(vals, nulls)])})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False, data_page_size=1 << 10)
+    pf = rdr.ParquetFile(buf.getvalue())
+    col = pf.read()["s"]
+    assert np.asarray(col.offsets).dtype == np.int64
+    at = pf.read().to_arrow()
+    assert at.column("s").type in (pa.large_string(), pa.large_binary())
+    assert at.column("s").to_pylist() == t.column("s").to_pylist()
+    # no-null column too
+    t2 = pa.table({"s": pa.array(vals)})
+    buf2 = io.BytesIO()
+    pq.write_table(t2, buf2, use_dictionary=False, data_page_size=1 << 10)
+    at2 = rdr.ParquetFile(buf2.getvalue()).read().to_arrow()
+    assert at2.column("s").to_pylist() == vals
+    # streamed batches stay bounded and correct
+    got = []
+    for b in rdr.ParquetFile(buf2.getvalue()).iter_batches(batch_rows=100):
+        got.extend(b.to_arrow().column("s").to_pylist())
+    assert got == vals
+
+
+@pytest.mark.skipif(not os.environ.get("PQ_BIG_TESTS"),
+                    reason="generates a >2 GiB column chunk; PQ_BIG_TESTS=1")
+def test_wide_byte_array_chunk_real_2gib():
+    """A real single-chunk BYTE_ARRAY column holding >2 GiB of value bytes
+    reads correctly (spot-checked) through the int64-offset path."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.io import reader as rdr
+
+    n = 23_000
+    item = ("z" * 100_000)  # 100 kB per value -> ~2.3 GB chunk
+    t = pa.table({"s": pa.array([item] * n)})
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".parquet") as f:
+        pq.write_table(t, f.name, use_dictionary=False,
+                       row_group_size=n, compression="snappy")
+        pf = rdr.ParquetFile(f.name)
+        col = pf.read()["s"]
+        offs = np.asarray(col.offsets)
+        assert offs.dtype == np.int64 and int(offs[-1]) == n * 100_000
+        v = np.asarray(col.values)
+        for i in (0, n // 2, n - 1):
+            assert v[offs[i]:offs[i] + 16].tobytes() == b"z" * 16
+        assert len(offs) == n + 1
