@@ -167,7 +167,7 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
     if pt == Type.BYTE_ARRAY:
         # chunks past the int32 offset range arrive with int64 offsets and
         # take the arrow LARGE layout (64-bit offsets) end to end
-        wide = getattr(offsets, "dtype", None) == np.int64
+        wide = offsets is not None and _wide_offsets(offsets)
         # expand dense values to slot-aligned with validity
         if validity is not None:
             arr = _ragged_with_nulls(values, offsets, validity)
@@ -454,6 +454,18 @@ def _fsb_with_nulls(vals: np.ndarray, validity: np.ndarray, width: int):
                                  [mask, pa.py_buffer(out)])
 
 
+def _wide_offsets(offsets) -> bool:
+    """True when chunk offsets address more bytes than int32 allows — the
+    signal to take arrow's LARGE (64-bit-offset) layout.  Size-based, not
+    dtype-based: small int64 offsets (e.g. dictionary values) stay on the
+    standard layout."""
+    from .reader import _OFFSET32_LIMIT
+
+    offsets = np.asarray(offsets)
+    return (offsets.dtype == np.int64 and len(offsets) > 1
+            and int(offsets[-1]) > _OFFSET32_LIMIT)
+
+
 def _ragged_with_nulls(values: np.ndarray, offsets: np.ndarray, validity: np.ndarray):
     import pyarrow as pa
 
@@ -462,7 +474,7 @@ def _ragged_with_nulls(values: np.ndarray, offsets: np.ndarray, validity: np.nda
     slot_lens = np.zeros(n, dtype=np.int64)
     slot_lens[validity] = lens
     slot_offs = np.concatenate([[0], np.cumsum(slot_lens)])
-    wide = offsets.dtype == np.int64 and len(offsets) > 1
+    wide = _wide_offsets(offsets)
     slot_offs = slot_offs.astype(np.int64 if wide else np.int32)
     mask = pa.py_buffer(np.packbits(validity, bitorder="little"))
     return pa.Array.from_buffers(
