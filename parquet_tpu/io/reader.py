@@ -213,7 +213,13 @@ class ColumnChunkReader:
         tail-carry copying the buffer each page; the 1 MB window with an
         offset cursor keeps sequential readahead alive when many column
         cursors interleave (the at-scale streaming read was IO-pattern
-        bound) and yields zero-copy payload views."""
+        bound) and yields zero-copy payload views.
+
+        NOTE: each ``PageInfo.payload`` is a buffer-protocol view
+        (memoryview/ndarray), not ``bytes`` — wrap in ``bytes(...)`` before
+        concatenation/hashing/pickling — and a retained payload pins its
+        whole read window (~``window`` bytes); copy out pages you keep
+        past the iteration."""
         start, size = self.byte_range
         src = self.file.source
         pos = 0
@@ -452,14 +458,17 @@ class ParquetFile:
 
     # ------------------------------------------------------------------
     def iter_batches(self, columns: Optional[Sequence[str]] = None,
-                     batch_rows: int = 65536):
+                     batch_rows: int = 65536,
+                     strict_batch_rows: bool = False):
         """Bounded-memory streaming read: yield row-aligned :class:`Table`
         batches holding O(pages-per-batch) memory — the reference's
         ``PageBufferSize`` + ``GenericReader.Read`` streaming mode
-        (see io/stream.py)."""
+        (see io/stream.py; batch sizes vary at row-group boundaries unless
+        ``strict_batch_rows=True``)."""
         from .stream import iter_batches as _iter
 
-        return _iter(self, columns=columns, batch_rows=batch_rows)
+        return _iter(self, columns=columns, batch_rows=batch_rows,
+                     strict_batch_rows=strict_batch_rows)
 
     def read(self, columns: Optional[Sequence[str]] = None,
              device: bool = False,

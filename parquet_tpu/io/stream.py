@@ -175,7 +175,8 @@ def _slice_rows(piece: _PagePiece, r0: int, r1: int) -> Column:
 
 
 def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
-                 batch_rows: int = 65536) -> Iterator[Table]:
+                 batch_rows: int = 65536,
+                 strict_batch_rows: bool = False) -> Iterator[Table]:
     """Stream the file as row-aligned :class:`Table` batches of at most
     ``batch_rows`` rows, holding O(pages-per-batch) memory per column.
 
@@ -183,9 +184,12 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
     snapped to row-group boundaries when at least half of ``batch_rows``
     is pending (same behavior as pyarrow's ``iter_batches`` — avoids the
     cross-group column concat); only under-half remainders of small row
-    groups accumulate across the boundary.  Batch sizes therefore vary,
-    bounded by ``batch_rows``; concatenating every batch equals a full
-    :meth:`ParquetFile.read`.
+    groups accumulate across the boundary.  Batch sizes therefore VARY,
+    bounded by ``batch_rows`` (a behavior change in r4 — callers that
+    relied on fixed-size batches should pass ``strict_batch_rows=True``,
+    which restores exactly ``batch_rows`` rows per batch except the last
+    at the cost of cross-group concatenation).  Concatenating every batch
+    equals a full :meth:`ParquetFile.read`.
     """
     if batch_rows <= 0:
         raise ValueError("batch_rows must be positive")
@@ -232,7 +236,8 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
         # read's deficit vs the whole-file read.  Keep accumulating only
         # when the pending batch is under half target (tiny row groups).
         if pending_rows >= batch_rows or (
-                rg_rows_left == 0 and pending_rows * 2 >= batch_rows):
+                not strict_batch_rows and rg_rows_left == 0
+                and pending_rows * 2 >= batch_rows):
             yield flush()
     if pending_rows:
         yield flush()

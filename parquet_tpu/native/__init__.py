@@ -14,6 +14,8 @@ import subprocess
 import threading
 from typing import Optional
 
+import math
+
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -412,6 +414,33 @@ def pack_bits(values: np.ndarray, bit_width: int) -> Optional[bytes]:
     return out[:wrote].tobytes()
 
 
+def _window_predicts_overflow(distinct: int, window: int,
+                              max_unique: int) -> bool:
+    """Cardinality-estimator bail test: from one window's distinct count,
+    estimate global cardinality K via E[distinct] = K(1 - exp(-w/K))
+    (uniform-draw model) and predict overflow only when the estimate
+    clearly exceeds ``max_unique``.  The previous raw >= 7/8-unique test
+    falsely predicted overflow for columns whose cardinality is high in a
+    32k window yet still under max_unique (e.g. ~45%-of-n cardinality
+    against a n/2 budget) and silently disabled dictionary encoding
+    (advisor r4).  Skewed data biases K low, i.e. toward attempting the
+    build — the safe direction (a wasted build, never a wrong refusal)."""
+    if distinct >= window:  # all-unique window: the estimator diverges
+        return True
+    frac = distinct / window
+    if frac <= 0:
+        return False
+    lo_x, hi_x = 1e-9, 60.0  # solve (1 - e^-x)/x = frac for x = w/K
+    for _ in range(40):
+        mid = (lo_x + hi_x) / 2
+        if (1 - math.exp(-mid)) / mid > frac:
+            lo_x = mid
+        else:
+            hi_x = mid
+    est_k = window / ((lo_x + hi_x) / 2)
+    return est_k > 1.25 * max_unique
+
+
 def dict_build_fixed(vals: np.ndarray, max_unique: int):
     """First-occurrence dedup of a fixed-width column (any 4/8-byte dtype,
     compared bitwise).  Returns (uniques in vals.dtype, int64 indices),
@@ -431,23 +460,23 @@ def dict_build_fixed(vals: np.ndarray, max_unique: int):
     n = len(keys)
     # Sample-based early bail: near-unique columns (the overflow case)
     # otherwise pay a full hash pass just to discover they can't dictionary-
-    # encode.  Two windows — prefix AND middle — must BOTH be >= 7/8
-    # internally unique to predict overflow: data whose first occurrences
-    # cluster early (sorted keys, then repeats) shows repeats in the middle
-    # window and still gets its full build.  Heuristic only affects whether
-    # dictionary encoding is attempted, never correctness.
+    # encode.  Two windows — prefix AND middle — must BOTH estimate a
+    # cardinality clearly past max_unique (see _window_predicts_overflow):
+    # data whose first occurrences cluster early (sorted keys, then
+    # repeats) shows repeats in the middle window and still gets its full
+    # build.  Heuristic only affects whether dictionary encoding is
+    # attempted, never correctness.
     sample = 1 << 16
     if n > 4 * sample and max_unique >= sample:
         s_idx = np.empty(sample, np.int64)
         s_uniq = np.empty(sample, np.int64)
-        thresh = sample * 7 // 8
         nu_a = lib.pq_dict_build_i64(keys[:sample], sample, sample,
                                      s_idx, s_uniq)
-        if nu_a >= thresh:
+        if _window_predicts_overflow(nu_a, sample, max_unique):
             mid = n // 2
             nu_b = lib.pq_dict_build_i64(keys[mid: mid + sample], sample,
                                          sample, s_idx, s_uniq)
-            if nu_b >= thresh:
+            if _window_predicts_overflow(nu_b, sample, max_unique):
                 return "overflow"
     indices = np.empty(n, np.int64)
     uniques = np.empty(max(max_unique, 1), np.int64)
@@ -783,23 +812,22 @@ def dict_build_ba(data: np.ndarray, offsets: np.ndarray, max_unique: int,
     indices = np.empty(max(n, 1), dtype=np.int64)
     # Sample-based early bail, mirroring dict_build_fixed: near-unique
     # string columns should not pay a half-column hash build just to learn
-    # they overflow.  Both a prefix and a middle window must look >= 7/8
-    # unique to predict overflow (first occurrences clustering early would
-    # fool a prefix-only sample).  Affects only whether dictionary encoding
-    # is attempted, never correctness.
+    # they overflow.  Both a prefix and a middle window must ESTIMATE a
+    # cardinality clearly past max_unique (_window_predicts_overflow;
+    # first occurrences clustering early would fool a prefix-only sample).
+    # Affects only whether dictionary encoding is attempted, never
+    # correctness.
     sample = 1 << 15
     if sample_bail and n > 4 * sample and max_unique >= sample:
         s_idx = np.empty(sample, np.int64)
-        # a window overflowing a 7/8*sample unique cap (negative return)
-        # means it is >= 7/8 internally unique
         nu_a = lib.pq_dict_build_ba(data.ctypes.data, offsets,
-                                    sample, s_idx, sample * 7 // 8)
-        if nu_a < 0:
+                                    sample, s_idx, sample)
+        if _window_predicts_overflow(nu_a, sample, max_unique):
             mid = n // 2
             nu_b = lib.pq_dict_build_ba(data.ctypes.data,
                                         offsets[mid:], sample, s_idx,
-                                        sample * 7 // 8)
-            if nu_b < 0:
+                                        sample)
+            if _window_predicts_overflow(nu_b, sample, max_unique):
                 return "overflow"
     k = lib.pq_dict_build_ba(data.ctypes.data if len(data) else None,
                              offsets, n, indices, max_unique)

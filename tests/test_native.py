@@ -517,3 +517,32 @@ def test_decompress_pages_batch_matches_codec(lib, rng):
     buf, offs = res
     for i, p in enumerate(pages):
         assert bytes(buf[offs[i]:offs[i + 1]]) == p
+
+
+def test_dict_bail_estimates_cardinality_not_window_uniqueness():
+    """High-but-under-budget cardinality columns must BUILD their
+    dictionary (the raw 7/8-window-uniqueness bail falsely refused them —
+    advisor r4); truly near-unique columns still bail to overflow."""
+    import parquet_tpu.native as native
+
+    if native.get_lib() is None:
+        pytest.skip("native shim unavailable")
+    rng = np.random.default_rng(0)
+    n = 1_000_000
+    k = rng.integers(0, 450_000, n).astype(np.int64)  # ~36% < n/2 budget
+    r = native.dict_build_fixed(k, n // 2 + 16)
+    assert r is not None and r != "overflow"
+    assert native.dict_build_fixed(np.arange(n, dtype=np.int64),
+                                   n // 2 + 16) == "overflow"
+    s = np.array([f"s{int(v):06d}"
+                  for v in rng.integers(0, 90_000, 400_000)])
+    vals = np.ascontiguousarray(
+        np.frombuffer("".join(s.tolist()).encode(), np.uint8))
+    offs = np.arange(len(s) + 1, dtype=np.int64) * 7
+    r2 = native.dict_build_ba(vals, offs, len(s) // 2 + 16)
+    assert r2 is not None and r2 != "overflow"
+    u = np.array([f"u{i:06d}" for i in range(400_000)])
+    uvals = np.ascontiguousarray(
+        np.frombuffer("".join(u.tolist()).encode(), np.uint8))
+    assert native.dict_build_ba(uvals, offs,
+                                len(u) // 2 + 16) == "overflow"

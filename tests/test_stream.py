@@ -164,3 +164,20 @@ def test_stream_tolerates_unknown_page_types(rng, monkeypatch):
     monkeypatch.setattr(rd.ColumnChunkReader, "pages_streamed", with_fake)
     got = [b for b in sm.iter_batches(pf, batch_rows=1500)]
     assert sum(b.num_rows for b in got) == n
+
+
+def test_iter_batches_strict_batch_rows():
+    """strict_batch_rows=True restores fixed batch sizes (except the last)
+    even across row-group boundaries."""
+    n, rg = 10_000, 1500
+    t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=rg)
+    pf = ParquetFile(buf.getvalue())
+    sizes = [b.num_rows for b in pf.iter_batches(batch_rows=1000,
+                                                 strict_batch_rows=True)]
+    assert sizes == [1000] * 10
+    got = np.concatenate([np.asarray(b["x"].values) for b in
+                          pf.iter_batches(batch_rows=1000,
+                                          strict_batch_rows=True)])
+    np.testing.assert_array_equal(got, np.arange(n))
