@@ -28,6 +28,10 @@ MULTIPLY (``hi * 2**(32-sh)``) for w ≥ 17 — semantically identical, and
 the same trial proved it EXACT on-chip at w ∈ {16, 17, 20, 24, 31} (plus
 w = 27 in an 8M-value production-kernel run), so the router now takes the
 Pallas kernel at all widths on TPU (device_reader._use_pallas).
+Upstream report: the complete ready-to-file issue text is
+``UPSTREAM_ISSUE_mosaic.md`` at the repo root (zero-egress environment —
+paste into the JAX tracker with scripts/mosaic_repro.py +
+MOSAIC_REPRO_ONCHIP.json attached).
 """
 
 from __future__ import annotations
