@@ -44,8 +44,16 @@ def probe(timeout_s: int = 150) -> bool:
     code = ("import jax,sys;"
             "sys.exit(0 if jax.devices()[0].platform=='tpu' else 3)")
     try:
+        # DEVNULL, not pipes: with capture_output, a timeout kill of the
+        # child still leaves communicate() blocked on the pipe's write end
+        # if the child spawned a tunnel helper that inherited it — observed
+        # r5: one probe wedged the queue for ~2 h past its 150 s timeout.
+        # start_new_session puts child+helpers in one killable group.
         p = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
-                           capture_output=True)
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL,
+                           stdin=subprocess.DEVNULL,
+                           start_new_session=True)
         return p.returncode == 0
     except subprocess.TimeoutExpired:
         return False
