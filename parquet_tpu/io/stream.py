@@ -204,9 +204,13 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
 
     def flush() -> Table:
         nonlocal pending, pending_rows
-        cols = {p: concat_columns(parts) if len(parts) > 1 else parts[0]
-                for p, parts in pending.items()}
-        t = Table(pf.schema, cols, pending_rows)
+        # parts-form Table: per-leaf concat stays lazy, and to_arrow takes
+        # the chunked path (zero-concat chunked arrays + DictionaryArray
+        # passthrough for arrow-dictionary-typed fields) exactly like the
+        # whole-file read
+        t = Table(pf.schema, None, pending_rows,
+                  parts={p: list(parts) for p, parts in pending.items()},
+                  dict_fields=pf.arrow_dictionary_fields)
         pending = {p: [] for p in paths}
         pending_rows = 0
         return t
