@@ -27,6 +27,7 @@ _tried = False
 
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _u8p_w = np.ctypeslib.ndpointer(np.uint8, flags=("C_CONTIGUOUS", "WRITEABLE"))
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _i64p_w = np.ctypeslib.ndpointer(np.int64, flags=("C_CONTIGUOUS", "WRITEABLE"))
@@ -146,6 +147,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_plain_ba_batch.restype = ctypes.c_int64
         lib.pq_plain_ba_batch.argtypes = [
             _i64p, _i64p, _i64p, ctypes.c_int64, _i64p_w, _u8p_w]
+        lib.pq_rle_dict_batch.restype = ctypes.c_int64
+        lib.pq_rle_dict_batch.argtypes = [
+            _i64p, _i64p, _i64p, _u8p, ctypes.c_int64, _i32p_w]
         lib.pq_xxh64.restype = ctypes.c_uint64
         lib.pq_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         lib.pq_xxh64_batch.restype = None
@@ -214,6 +218,30 @@ def plain_ba_batch(srcs, counts):
         # column's lifetime — compact when the slack is half or more
         return values[:total].copy(), offsets
     return values[:total], offsets
+
+
+def rle_dict_batch(srcs, counts, prefixes):
+    """Decode many pages' RLE_DICTIONARY index sections in one native call
+    → one chunk-level int32 index array.  ``srcs`` are bytes-like page
+    payloads (post-decompression), ``counts`` values per page,
+    ``prefixes`` per-page bools: True = a v1 optional page whose payload
+    leads with a length-prefixed def-level stream (must be one all-1s RLE
+    run — all-present; otherwise the caller's python path handles nulls).
+    None when the shim is unavailable OR any page needs the fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(srcs)
+    ptrs, lens, keep = _src_pointers(srcs)
+    cnts = np.ascontiguousarray(counts, np.int64)
+    if bool((cnts < 0).any()):
+        return None
+    pref = np.ascontiguousarray(prefixes, np.uint8)
+    out = np.empty(max(int(cnts.sum()), 1), np.int32)
+    total = lib.pq_rle_dict_batch(ptrs, lens, cnts, pref, n, out)
+    if total < 0:
+        return None  # page with nulls / unexpected framing: python path
+    return out[:total]
 
 
 def assemble_levels(defs: np.ndarray, reps: np.ndarray, ks, dks, max_def: int):

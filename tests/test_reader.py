@@ -675,3 +675,42 @@ def test_wide_byte_array_chunk_real_2gib():
         for i in (0, n // 2, n - 1):
             assert v[offs[i]:offs[i] + 16].tobytes() == b"z" * 16
         assert len(offs) == n + 1
+
+
+def test_rle_dict_chunk_fast_and_mixed_fallback_uniform_types():
+    """The native batched dict-index decode matches pyarrow; a column whose
+    chunks mix dictionary and dense-fallback pages yields ONE arrow type
+    across iter_batches tables (dense chunks re-encode to the declared
+    dictionary type, pyarrow's behavior)."""
+    import parquet_tpu.native as native
+
+    n = 60000
+    s = np.array([f"v{i % 9}" for i in range(n // 2)]
+                 + [f"u_{i:07d}" for i in range(n // 2)])
+    t = pa.table({"s": pa.array(s).dictionary_encode()})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 4, compression="snappy",
+                   dictionary_pagesize_limit=4096)
+    pf = ParquetFile(buf.getvalue())
+    batches = [b.to_arrow() for b in pf.iter_batches(batch_rows=10000)]
+    assert len({str(b.schema.field("s").type) for b in batches}) == 1
+    cat = pa.concat_tables(batches)
+    ref = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert cat.column("s").to_pylist() == ref.column("s").to_pylist()
+    # clean dictionary column routes the batched native decode
+    t2 = pa.table({"c": pa.array(np.array(["a", "bb", "ccc"])[
+        np.random.default_rng(3).integers(0, 3, 20000)])})
+    buf2 = io.BytesIO()
+    pq.write_table(t2, buf2, compression="snappy", data_page_size=1 << 12)
+    pf2 = ParquetFile(buf2.getvalue())
+    from parquet_tpu.utils.debug import counters
+    before = counters.get("rle_dict_chunk_fast")
+    at = pf2.read().to_arrow()
+    if native.get_lib() is not None:
+        assert counters.get("rle_dict_chunk_fast") > before
+    assert at.column("c").to_pylist() == t2.column("c").to_pylist()
+    # corrupt bit-packed varint: clean refusal, no native crash
+    if native.get_lib() is not None:
+        bad = np.frombuffer(
+            bytes([4]) + b"\xff" * 8 + b"\x7f" + b"\x00" * 16, np.uint8)
+        assert native.rle_dict_batch([bad], [100], [0]) is None
