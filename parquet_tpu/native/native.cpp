@@ -20,8 +20,13 @@
 #include <unordered_map>
 #include <vector>
 
+#if defined(__SSSE3__)
+#include <immintrin.h>  // SSSE3 pshufb (snappy short-offset replication)
+#endif
 #if defined(__AVX512F__) && defined(__BMI2__)
+#ifndef __SSSE3__
 #include <immintrin.h>
+#endif
 #define PQ_HAVE_AVX512 1
 #endif
 
@@ -1666,6 +1671,149 @@ inline zstd_err_fn get_zstd_iserror() {
   return fn;
 }
 
+// ---------------------------------------------------------------------------
+// Fast snappy raw-stream decoder.  The dlopen'd system libsnappy measured
+// 0.5-0.6 GB/s on match-heavy pages (sorted int64 columns) on this class of
+// host; this decoder uses 16-byte blind copies for literals and long-offset
+// matches and a stack-staged doubled pattern for short-offset matches (the
+// RLE-like case that dominates compressible columns).  Falls back to byte
+// loops within 16 bytes of either buffer end, so it never writes past dst
+// or reads past src.  Returns false on any malformed input (caller then
+// retries with the system library, which owns precise error behavior).
+// Format per the public snappy spec: varint uncompressed length, then
+// literal/copy tags.
+inline bool snappy_fast_uncompress(const uint8_t* src, int64_t src_len,
+                                   uint8_t* dst, int64_t dst_len) {
+  const uint8_t* sp = src;
+  const uint8_t* send = src + src_len;
+  uint64_t ulen = 0;
+  int shift = 0;
+  while (true) {
+    if (sp >= send || shift > 28) return false;
+    const uint8_t b = *sp++;
+    ulen |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  if ((int64_t)ulen != dst_len) return false;
+  uint8_t* dp = dst;
+  uint8_t* dend = dst + dst_len;
+  while (sp < send) {
+    const uint8_t tag = *sp++;
+    if ((tag & 3) == 0) {  // literal
+      int64_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        const int nb = (int)len - 60;  // 1..4 length bytes
+        if (sp + nb > send) return false;
+        uint32_t l = 0;
+        memcpy(&l, sp, (size_t)nb);
+        sp += nb;
+        len = (int64_t)l + 1;
+      }
+      if (len > send - sp || len > dend - dp) return false;
+      if (len <= 16 && send - sp >= 16 && dend - dp >= 16) {
+        memcpy(dp, sp, 16);  // blind wide copy, bounds pre-checked
+      } else {
+        memcpy(dp, sp, (size_t)len);
+      }
+      sp += len;
+      dp += len;
+      continue;
+    }
+    int64_t len, off;
+    if ((tag & 3) == 1) {  // copy1: 4..11 bytes, 11-bit offset
+      if (sp >= send) return false;
+      len = ((tag >> 2) & 7) + 4;
+      off = ((int64_t)(tag & 0xE0) << 3) | *sp++;
+    } else if ((tag & 3) == 2) {  // copy2: 16-bit offset
+      if (send - sp < 2) return false;
+      uint16_t o;
+      memcpy(&o, sp, 2);
+      sp += 2;
+      len = (tag >> 2) + 1;
+      off = o;
+    } else {  // copy4: 32-bit offset
+      if (send - sp < 4) return false;
+      uint32_t o;
+      memcpy(&o, sp, 4);
+      sp += 4;
+      len = (tag >> 2) + 1;
+      off = o;
+    }
+    if (off <= 0 || off > dp - dst || len > dend - dp) return false;
+    const uint8_t* cp = dp - off;
+    if (off >= 16) {
+      if (dend - dp >= len + 16) {  // slack for blind 16-byte strides
+        uint8_t* o_ = dp;
+        const uint8_t* c_ = cp;
+        for (int64_t l = len; l > 0; l -= 16) {
+          memcpy(o_, c_, 16);
+          o_ += 16;
+          c_ += 16;
+        }
+      } else {
+        // no wide slack: forward chunks of `off` bytes — each chunk's
+        // source lies fully behind its destination, and later chunks see
+        // the bytes earlier ones wrote (the self-referencing semantics)
+        int64_t done = 0;
+        while (done < len) {
+          const int64_t n = off < len - done ? off : len - done;
+          memcpy(dp + done, cp + done, (size_t)n);
+          done += n;
+        }
+      }
+      dp += len;
+      continue;
+    }
+    // short offset: replicate the pattern to a full 16-byte vector with
+    // one pshufb (mask[i] = i % off), then blind 16-byte stores advancing
+    // by the largest multiple of off <= 16 so the phase stays aligned
+    if (dend - dp >= len + 16) {
+#if defined(__SSSE3__)
+      static const uint8_t kPatShuf[16][16] = {
+          {0}, {0,0,0,0,0,0,0,0,0,0,0,0,0,0,0,0},
+          {0,1,0,1,0,1,0,1,0,1,0,1,0,1,0,1},
+          {0,1,2,0,1,2,0,1,2,0,1,2,0,1,2,0},
+          {0,1,2,3,0,1,2,3,0,1,2,3,0,1,2,3},
+          {0,1,2,3,4,0,1,2,3,4,0,1,2,3,4,0},
+          {0,1,2,3,4,5,0,1,2,3,4,5,0,1,2,3},
+          {0,1,2,3,4,5,6,0,1,2,3,4,5,6,0,1},
+          {0,1,2,3,4,5,6,7,0,1,2,3,4,5,6,7},
+          {0,1,2,3,4,5,6,7,8,0,1,2,3,4,5,6},
+          {0,1,2,3,4,5,6,7,8,9,0,1,2,3,4,5},
+          {0,1,2,3,4,5,6,7,8,9,10,0,1,2,3,4},
+          {0,1,2,3,4,5,6,7,8,9,10,11,0,1,2,3},
+          {0,1,2,3,4,5,6,7,8,9,10,11,12,0,1,2},
+          {0,1,2,3,4,5,6,7,8,9,10,11,12,13,0,1},
+          {0,1,2,3,4,5,6,7,8,9,10,11,12,13,14,0}};
+      // cp+16 read is safe: cp = dp - off with off < 16 and dp has >= 16
+      // bytes of slack checked above
+      const __m128i v = _mm_shuffle_epi8(
+          _mm_loadu_si128((const __m128i*)cp),
+          _mm_loadu_si128((const __m128i*)kPatShuf[off]));
+      const int stride = (16 / (int)off) * (int)off;
+      for (int64_t w = 0; w < len; w += stride)
+        _mm_storeu_si128((__m128i*)(dp + w), v);
+#else
+      uint8_t pat[32];
+      for (int i = 0; i < (int)off; ++i) pat[i] = cp[i];
+      int plen = (int)off;
+      while (plen < 16) {
+        memcpy(pat + plen, pat, (size_t)plen);  // disjoint within pat
+        plen <<= 1;
+      }
+      const int stride = (16 / (int)off) * (int)off;
+      for (int64_t w = 0; w < len; w += stride) memcpy(dp + w, pat, 16);
+#endif
+      dp += len;
+    } else {
+      for (int64_t i = 0; i < len; ++i) dp[i] = cp[i];  // overlap-safe tail
+      dp += len;
+    }
+  }
+  return dp == dend;
+}
+
 // decompress `src` into `dst` (exactly dst_len bytes expected). codec is the
 // parquet CompressionCodec id: 0 UNCOMPRESSED, 1 SNAPPY, 6 ZSTD.
 inline bool page_decompress(int codec, const uint8_t* src, int64_t src_len,
@@ -1676,6 +1824,9 @@ inline bool page_decompress(int codec, const uint8_t* src, int64_t src_len,
     return true;
   }
   if (codec == 1) {
+    if (snappy_fast_uncompress(src, src_len, dst, dst_len)) return true;
+    // fast decoder refuses malformed streams; the system library settles
+    // whether the input is genuinely bad (and owns exotic cases)
     snappy_fn fn = get_snappy_uncompress();
     if (!fn) return false;
     size_t out_len = (size_t)dst_len;
