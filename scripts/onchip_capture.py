@@ -158,6 +158,31 @@ def merge_artifact(kind: str, status: str):
     return n_cfg
 
 
+def _foreign_bench_running() -> bool:
+    """True when a python bench.py / route_soak.py process outside this
+    queue's own process group is active (e.g. the driver's end-of-round
+    bench).  Inspects /proc argv ARRAYS — substring matching on full
+    command lines false-positives on processes whose arguments merely
+    mention the script names."""
+    me = os.getpgrp()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+            if not argv or b"python" not in os.path.basename(argv[0]):
+                continue
+            if not any(os.path.basename(a) in (b"bench.py", b"route_soak.py")
+                       for a in argv[1:3]):
+                continue
+            if os.getpgid(int(pid)) != me:
+                return True
+        except (OSError, ValueError):
+            continue
+    return False
+
+
 def main() -> int:
     max_h = 11.0
     once = False
@@ -179,6 +204,14 @@ def main() -> int:
     done = {"quick": False, "full": False, "trials": False, "soak": False}
     attempt = 0
     while time.time() < deadline and not all(done.values()):
+        if _foreign_bench_running():
+            # a bench/soak WE didn't start is timing on this 1-core box —
+            # our jax-import probe subprocess would distort it (the r4
+            # driver artifact's config-2 16x outlier was exactly this
+            # class of contention); yield the core and check again later
+            log("foreign bench running — yielding this probe cycle")
+            time.sleep(60)
+            continue
         if not probe():
             log("tunnel down")
             if once:
