@@ -137,6 +137,7 @@ class ParquetWriter:
         self._num_rows = 0
         self._closed = False
         self._codec = codecs.get_codec(self.options.codec_id())
+        self._dict_overflowed: set = set()  # sticky per-column fallback
         # buffered rows for write() accumulation
         self._buffer: Optional[Dict[str, ColumnData]] = None
         self._buffered_rows = 0
@@ -285,9 +286,17 @@ class ParquetWriter:
         # ---- choose encoding ---------------------------------------------
         forced = opts.column_encoding.get(path)
         dict_values = dict_offsets = indices = None
-        if forced is None and opts.use_dictionary(path) and physical != Type.BOOLEAN:
+        if (forced is None and opts.use_dictionary(path)
+                and physical != Type.BOOLEAN
+                and path not in self._dict_overflowed):
             dict_values, dict_offsets, indices = _build_dictionary(
                 leaf, data, opts.dictionary_page_limit)
+            if indices is None:
+                # overflow/limit: later row groups of this column carry the
+                # same distribution — skip their builds (and the sampling
+                # probes) instead of rediscovering the overflow per group;
+                # the sticky fallback mainstream writers use
+                self._dict_overflowed.add(path)
         if indices is not None:
             value_encoding = Encoding.RLE_DICTIONARY
         elif forced is not None:

@@ -494,7 +494,7 @@ def dict_build_fixed(vals: np.ndarray, max_unique: int):
     # repeats) shows repeats in the middle window and still gets its full
     # build.  Heuristic only affects whether dictionary encoding is
     # attempted, never correctness.
-    sample = 1 << 16
+    sample = 1 << 14
     if n > 4 * sample and max_unique >= sample:
         s_idx = np.empty(sample, np.int64)
         s_uniq = np.empty(sample, np.int64)
