@@ -507,7 +507,7 @@ def _cfg5(n):
 
     dev_rows = len(run_device()["l_extendedprice"])
     run_device()  # second call activates + compiles the fused span filter
-    dev_s = _time_best(run_device, reps=3)
+    dev_s = _time_best(run_device, reps=5)
     assert dev_rows == rows_out, (dev_rows, rows_out)
     return {
         "rows_selected": int(rows_out),

@@ -39,8 +39,8 @@ from .io.stream import iter_batches
 from .ops.encodings import (DictIndices, EncodingSpec, register_encoding,
                             registered_encodings)
 from .io.source import RetryingSource, Source
-from .parallel.host_scan import (scan_filtered, scan_filtered_device,
-                                 scan_filtered_sharded)
+from .parallel.host_scan import (scan, scan_filtered,
+                                 scan_filtered_device, scan_filtered_sharded)
 from .parallel.mesh import ShardedTable, default_mesh, read_table_sharded
 from .algebra import (SortingColumn, SortingWriter, TableBuffer,
                       convert_table, merge_files, merge_row_groups)

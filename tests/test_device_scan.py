@@ -343,3 +343,38 @@ def test_fused_span_filter_activates_and_matches_eager():
     np.testing.assert_array_equal(e_valid, f_valid)
     np.testing.assert_allclose(e_vals, f_vals)
     jax.block_until_ready([])
+
+
+def test_scan_auto_routes_by_backend(monkeypatch):
+    """parquet_tpu.scan picks the host route on cpu, the device route on
+    accelerators, and falls back to host for shapes the device refuses."""
+    import jax
+
+    import parquet_tpu
+    from parquet_tpu.parallel import host_scan as hs
+
+    pf = _lineitem(n=20000)
+    host = parquet_tpu.scan(pf, "l_shipdate", lo=9000, hi=9200,
+                            columns=["l_extendedprice"])
+    assert isinstance(host["l_extendedprice"], np.ndarray)  # host route form
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    calls = {}
+
+    def fake_device(pf_, path, **kw):
+        calls["device"] = True
+        return {"l_extendedprice": "device-result"}
+
+    monkeypatch.setattr(hs, "scan_filtered_device", fake_device)
+    out = parquet_tpu.scan(pf, "l_shipdate", lo=9000, hi=9200,
+                           columns=["l_extendedprice"])
+    assert calls.get("device") and out["l_extendedprice"] == "device-result"
+
+    def refusing_device(pf_, path, **kw):
+        raise ValueError("device scan key is nested; use the host scan")
+
+    monkeypatch.setattr(hs, "scan_filtered_device", refusing_device)
+    out2 = parquet_tpu.scan(pf, "l_shipdate", lo=9000, hi=9200,
+                            columns=["l_extendedprice"])
+    np.testing.assert_allclose(np.sort(out2["l_extendedprice"]),
+                               np.sort(host["l_extendedprice"]))
