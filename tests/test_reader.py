@@ -714,3 +714,38 @@ def test_rle_dict_chunk_fast_and_mixed_fallback_uniform_types():
         bad = np.frombuffer(
             bytes([4]) + b"\xff" * 8 + b"\x7f" + b"\x00" * 16, np.uint8)
         assert native.rle_dict_batch([bad], [100], [0]) is None
+
+
+def test_streamed_whole_file_read_route(monkeypatch):
+    """Above the size threshold, read() assembles from the streaming
+    cursors: values (nested lists, nulls, dict strings, selection) must be
+    identical to the chunk path and to pyarrow."""
+    from parquet_tpu.io import reader as rdr
+
+    rng = np.random.default_rng(9)
+    n = 30000
+    s = np.array(["AIR", "RAIL", "SHIP"])[rng.integers(0, 3, n)]
+    t = pa.table({
+        "x": pa.array(rng.integers(0, 10**6, n).astype(np.int64)),
+        "optional": pa.array(np.where(rng.random(n) < 0.1, None,
+                                      rng.random(n))),
+        "mode": pa.array(s).dictionary_encode(),
+        "lists": pa.array([[int(i), int(i) + 1] if i % 5 else None
+                           for i in range(n)]),
+        "plain_s": pa.array([f"p{i % 97:03d}" for i in range(n)]),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 4, compression="snappy",
+                   use_dictionary=["mode"])
+    monkeypatch.setattr(rdr, "_STREAMED_READ_BYTES", 0)
+    pf = rdr.ParquetFile(buf.getvalue())
+    at = pf.read().to_arrow()
+    ref = pq.read_table(io.BytesIO(buf.getvalue()))
+    for c in ref.column_names:
+        assert at.column(c).to_pylist() == ref.column(c).to_pylist(), c
+    sel = pf.read(columns=["x", "plain_s"]).to_arrow()
+    assert sel.column("x").to_pylist() == ref.column("x").to_pylist()
+    # chunk path still used with explicit row_groups (and stays equal)
+    rg = pf.read(row_groups=[1]).to_arrow()
+    assert rg.column("x").to_pylist() == \
+        ref.column("x").to_pylist()[n // 4: n // 2]
