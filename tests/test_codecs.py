@@ -117,3 +117,81 @@ def test_snappy_typed_and_strided_inputs(rng):
     assert bytes(codec.decode(enc2, m2.size)) == np.ascontiguousarray(m2).tobytes()
     enc3 = codec.encode(m2)  # non-contiguous ndarray
     assert bytes(codec.decode(enc3, m2.size)) == np.ascontiguousarray(m2).tobytes()
+
+
+def test_fast_snappy_handcrafted_tag_forms():
+    """Tag forms pyarrow's compressor never emits — copy-4 (32-bit offset),
+    2/3/4-byte literal lengths, len>off self-referencing matches at every
+    offset class, and end-of-buffer tails — decoded through the native
+    batched path and checked against the expected bytes."""
+    import struct
+
+    import parquet_tpu.native as native
+
+    if native.get_lib() is None:
+        pytest.skip("native shim unavailable")
+
+    def varint(n):
+        out = b""
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out += bytes([b | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def literal(data):
+        n = len(data) - 1
+        if n < 60:
+            return bytes([n << 2]) + data
+        if n < 1 << 8:
+            return bytes([60 << 2, n]) + data
+        if n < 1 << 16:
+            return bytes([61 << 2]) + struct.pack("<H", n) + data
+        if n < 1 << 24:
+            return bytes([62 << 2]) + struct.pack("<I", n)[:3] + data
+        return bytes([63 << 2]) + struct.pack("<I", n) + data
+
+    def copy1(length, off):  # 4..11, off < 2048
+        return bytes([1 | ((length - 4) << 2) | ((off >> 8) << 5),
+                      off & 0xFF])
+
+    def copy2(length, off):
+        return bytes([2 | ((length - 1) << 2)]) + struct.pack("<H", off)
+
+    def copy4(length, off):
+        return bytes([3 | ((length - 1) << 2)]) + struct.pack("<I", off)
+
+    def check(stream, expected):
+        comp = varint(len(expected)) + stream
+        res = native.decompress_pages([comp, comp], [len(expected)] * 2,
+                                      1, 1)
+        assert res is not None
+        buf, offs = res
+        assert buf[offs[0]:offs[1]].tobytes() == expected
+        assert buf[offs[1]:offs[2]].tobytes() == expected
+
+    # big literal via each extended length form
+    blob = bytes(range(256)) * 300  # 76800 bytes
+    check(literal(blob), blob)
+    small = b"0123456789abcdef" * 8  # 128 bytes -> 1-byte extended length
+    check(literal(small), small)
+
+    # copy1/copy2/copy4 with len > off (pattern extension), every off class
+    seed = b"ABCDEFG"  # 7 bytes
+    for mk, off in ((copy1, 7), (copy2, 7), (copy4, 7),
+                    (copy2, 300), (copy4, 300)):
+        pre = (b"x" * (off - len(seed))) + seed if off > len(seed) else seed[:off]
+        length = 11 if mk is copy1 else 40
+        stream = literal(pre) + mk(length, off)
+        pat = pre[-off:]
+        expected = pre + (pat * (length // off + 2))[:length]
+        check(stream, expected)
+
+    # tail: match ends exactly at the buffer end (no 16-byte slack)
+    pre = b"HELLOWORLD123456"  # 16
+    stream = literal(pre) + copy2(10, 16)
+    check(stream, pre + pre[:10])
+    # short-offset tail without slack
+    stream = literal(b"ab") + copy2(6, 2)
+    check(stream, b"ab" + (b"ab" * 3))
