@@ -1,0 +1,65 @@
+// Build & run (from scripts/):
+//   g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+//       -march=native -std=c++17 snappy_asan_fuzz.cpp -o /tmp/snappy_fuzz \
+//       -ldl -lpthread && /tmp/snappy_fuzz
+// Round-5 result: 24,000 corrupt decodes + 3,000 valid round-trips, zero
+// sanitizer findings.
+// ASAN fuzz harness: valid snappy streams (from libsnappy's compressor via
+// dlopen) are bit-flipped/truncated and fed to snappy_fast_uncompress.
+// Any OOB read/write trips ASAN; wrong-but-in-bounds results are fine for
+// corrupt input (the decoder returns false and the caller falls back).
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <vector>
+#define main native_main_unused
+#include "../parquet_tpu/native/native.cpp"
+#undef main
+
+typedef int (*comp_fn)(const char*, size_t, char*, size_t*);
+int main() {
+  void* h = dlopen("libsnappy.so.1", RTLD_NOW);
+  if (!h) { printf("no libsnappy\n"); return 2; }
+  auto comp = (comp_fn)dlsym(h, "snappy_compress");
+  auto maxlen = (size_t(*)(size_t))dlsym(h, "snappy_max_compressed_length");
+  std::mt19937_64 rng(7);
+  int ran = 0;
+  for (int trial = 0; trial < 3000; ++trial) {
+    // build a payload with matches + literals
+    size_t n = 1 + rng() % 60000;
+    std::vector<uint8_t> data(n);
+    int kind = trial % 4;
+    for (size_t i = 0; i < n; ++i) {
+      if (kind == 0) data[i] = (uint8_t)rng();
+      else if (kind == 1) data[i] = (uint8_t)(i % 7);
+      else if (kind == 2) data[i] = (uint8_t)((i / 50) & 0xFF);
+      else data[i] = (uint8_t)((i % 3) ? 'a' : (uint8_t)rng());
+    }
+    size_t cap = maxlen(n);
+    std::vector<uint8_t> cbuf(cap);
+    size_t clen = cap;
+    comp((const char*)data.data(), n, (char*)cbuf.data(), &clen);
+    std::vector<uint8_t> out(n);
+    // corrupt: flips, truncations, extensions
+    for (int c = 0; c < 8; ++c) {
+      std::vector<uint8_t> bad(cbuf.begin(), cbuf.begin() + clen);
+      int mode = c % 4;
+      if (mode == 0 && !bad.empty()) bad[rng() % bad.size()] ^= 1 << (rng() % 8);
+      else if (mode == 1 && bad.size() > 2) bad.resize(1 + rng() % (bad.size() - 1));
+      else if (mode == 2) { for (int k = 0; k < 4 && !bad.empty(); ++k) bad[rng() % bad.size()] = (uint8_t)rng(); }
+      else if (!bad.empty()) bad[0] ^= (uint8_t)rng();
+      snappy_fast_uncompress(bad.data(), (int64_t)bad.size(), out.data(), (int64_t)n);
+      ++ran;
+    }
+    // and the valid stream must round-trip
+    if (!snappy_fast_uncompress(cbuf.data(), (int64_t)clen, out.data(), (int64_t)n)
+        || memcmp(out.data(), data.data(), n) != 0) {
+      printf("VALID STREAM FAILED trial %d\n", trial);
+      return 1;
+    }
+  }
+  printf("fuzz ok: %d corrupt decodes, 3000 valid round-trips\n", ran);
+  return 0;
+}
