@@ -210,11 +210,10 @@ class ColumnChunkReader:
         ``PageBufferSize`` streaming (SURVEY.md §5).  Memory is O(window)
         per cursor (default 1 MB ≈ one data page).  Consumers that stop
         early (a row-range cursor mid-chunk) never touch the remaining
-        bytes.  A 4 KB window measured 2 preads per ~100 KB page with the
-        tail-carry copying the buffer each page; the 1 MB window with an
-        offset cursor keeps sequential readahead alive when many column
-        cursors interleave (the at-scale streaming read was IO-pattern
-        bound) and yields zero-copy payload views.
+        bytes.  Headers batch-parse per window through the native partial
+        scanner (the per-page Python thrift walk was 22% of the streamed
+        whole-file read); the Python walk below is the fallback and owns
+        precise error reporting.
 
         NOTE: each ``PageInfo.payload`` is a buffer-protocol view
         (memoryview/ndarray), not ``bytes`` — wrap in ``bytes(...)`` before
@@ -222,14 +221,47 @@ class ColumnChunkReader:
         whole read window (~``window`` bytes); copy out pages you keep
         past the iteration."""
         start, size = self.byte_range
-        src = self.file.source
-        pos = 0
-        values_seen = 0
-        total = self.meta.num_values
         # proportional bound: never pull more than 1/16 of the chunk per
         # pread (64 KB floor), so small chunks keep page-scale reads while
         # large chunks get full readahead windows
         window = max(min(window, size // 16), 1 << 16)
+        if _native.get_lib() is None:
+            yield from self._pages_streamed_python(window, 0, 0)
+            return
+        src_ = self.file.source
+        pos = 0
+        values_seen = 0
+        total = self.meta.num_values
+        win = window
+        while values_seen < total and pos < size:
+            view = src_.pread_view(start + pos, min(win, size - pos))
+            res = _native.scan_page_headers_partial(view,
+                                                    total - values_seen)
+            if res is None:  # scanner refused: python walk from here on
+                yield from self._pages_streamed_python(window, pos,
+                                                       values_seen)
+                return
+            rows, consumed, seen = res
+            if len(rows) == 0:
+                if len(view) >= size - pos:
+                    # whole remainder in view and nothing parses: let the
+                    # python walk raise its precise CorruptedError
+                    yield from self._pages_streamed_python(window, pos,
+                                                           values_seen)
+                    return
+                win = min(win * 4, size - pos)  # page larger than window
+                continue
+            yield from self._pages_from_scan(view, start + pos, rows)
+            pos += consumed
+            values_seen += seen
+            win = window
+
+    def _pages_streamed_python(self, window: int, pos: int,
+                               values_seen: int) -> Iterator[PageInfo]:
+        """Python thrift fallback for pages_streamed (precise errors)."""
+        start, size = self.byte_range
+        src = self.file.source
+        total = self.meta.num_values
         buf = b""
         boff = 0
         while values_seen < total and pos < size:

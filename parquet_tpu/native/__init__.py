@@ -129,6 +129,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pq_scan_page_headers.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             _i64p_w]
+        lib.pq_scan_page_headers_partial.restype = ctypes.c_int64
+        lib.pq_scan_page_headers_partial.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _i64p_w, _i64p_w]
         lib.pq_count_target_in_runs.restype = ctypes.c_int64
         lib.pq_count_target_in_runs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, _i64p, _i64p,
@@ -654,6 +658,30 @@ def scan_page_headers(buf, total_values: int):
         if k < 0:
             return None
         return out[:k]
+
+
+def scan_page_headers_partial(buf, total_values: int):
+    """Windowed header scan: parse as many complete pages as the buffer
+    holds.  Returns (rows, consumed_bytes, values_seen) — rows may be empty
+    when not even one header+payload fits — or None without the lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    b = buf if isinstance(buf, np.ndarray) else np.frombuffer(buf, np.uint8)
+    b = np.ascontiguousarray(b)
+    cap = max(16, min(int(total_values), len(b) // 64 + 8))
+    consumed = np.zeros(2, np.int64)
+    while True:
+        out = np.empty((cap, PG_NFIELDS), dtype=np.int64)
+        k = lib.pq_scan_page_headers_partial(
+            b.ctypes.data if len(b) else None, len(b), total_values, cap,
+            out, consumed)
+        if k == cap:  # may have stopped only for capacity: grow and retry
+            cap *= 4
+            continue
+        if k < 0:
+            return None
+        return out[:k], int(consumed[0]), int(consumed[1])
 
 
 def count_target_in_runs(body: np.ndarray, kinds, cnts, payloads, offs,

@@ -1175,12 +1175,16 @@ enum { PG_HEADER_POS = 0, PG_DATA_POS, PG_TYPE, PG_COMP, PG_UNCOMP, PG_CRC,
        PG_NVALS, PG_ENC, PG_DEF_ENC, PG_REP_ENC, PG_RL_BYTES, PG_DL_BYTES,
        PG_NNULLS, PG_IS_COMPRESSED, PG_DICT_NVALS, PG_NROWS, PG_NFIELDS };
 
-extern "C" int64_t pq_scan_page_headers(const uint8_t* buf, int64_t size,
-                                        int64_t total_values,
-                                        int64_t max_pages, int64_t* out) {
+static int64_t scan_page_headers_impl(const uint8_t* buf, int64_t size,
+                                      int64_t total_values,
+                                      int64_t max_pages, int64_t* out,
+                                      bool partial, int64_t* consumed_out) {
   int64_t pos = 0, values_seen = 0, k = 0;
   while (values_seen < total_values && pos < size) {
-    if (k >= max_pages) return -2;
+    if (k >= max_pages) {
+      if (partial) break;
+      return -2;
+    }
     TRd r{buf, pos, size, false};
     int64_t* row = out + k * PG_NFIELDS;
     for (int i = 0; i < PG_NFIELDS; ++i) row[i] = -1;
@@ -1243,19 +1247,55 @@ extern "C" int64_t pq_scan_page_headers(const uint8_t* buf, int64_t size,
           return false;  // statistics / index page header / unknown: skip
       }
     });
-    if (r.err) return -1;
+    if (r.err) {
+      // in partial mode a header running past the buffer is just the
+      // window edge: stop and report progress, the caller re-reads from
+      // `consumed` with a bigger window (true corruption surfaces there)
+      if (partial) break;
+      return -1;
+    }
     int64_t clen = row[PG_COMP];
-    if (clen < 0 || row[PG_TYPE] < 0 || row[PG_UNCOMP] < 0) return -1;
-    if (clen > size - r.pos) return -1;  // truncated payload (no overflow)
+    if (clen < 0 || row[PG_TYPE] < 0 || row[PG_UNCOMP] < 0) {
+      if (partial) break;
+      return -1;
+    }
+    if (clen > size - r.pos) {  // payload past the buffer (no overflow)
+      if (partial) break;
+      return -1;
+    }
     row[PG_DATA_POS] = r.pos;
     if (row[PG_TYPE] == 0 || row[PG_TYPE] == 3) {  // DATA_PAGE / V2
-      if (row[PG_NVALS] < 0) return -1;
+      if (row[PG_NVALS] < 0) {
+        if (partial) break;
+        return -1;
+      }
       values_seen += row[PG_NVALS];
     }
     pos = r.pos + clen;
     ++k;
   }
+  if (consumed_out) {
+    consumed_out[0] = pos;
+    consumed_out[1] = values_seen;
+  }
   return k;
+}
+
+extern "C" int64_t pq_scan_page_headers(const uint8_t* buf, int64_t size,
+                                        int64_t total_values,
+                                        int64_t max_pages, int64_t* out) {
+  return scan_page_headers_impl(buf, size, total_values, max_pages, out,
+                                false, nullptr);
+}
+
+// Partial/windowed variant: stops (instead of erroring) at the first page
+// whose header or payload runs past the buffer, reporting pages parsed and
+// consumed_out = {bytes consumed, data values seen}.
+extern "C" int64_t pq_scan_page_headers_partial(
+    const uint8_t* buf, int64_t size, int64_t total_values,
+    int64_t max_pages, int64_t* out, int64_t* consumed_out) {
+  return scan_page_headers_impl(buf, size, total_values, max_pages, out,
+                                true, consumed_out);
 }
 
 extern "C" {

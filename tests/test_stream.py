@@ -91,12 +91,18 @@ def test_stream_is_io_bounded(rng):
 
     reads = []
     orig = pf.source.pread
+    orig_view = pf.source.pread_view
 
     def spy(offset, size):
         reads.append(size)
         return orig(offset, size)
 
+    def spy_view(offset, size):
+        reads.append(size)
+        return orig_view(offset, size)
+
     pf.source.pread = spy
+    pf.source.pread_view = spy_view
     it = iter_batches(pf, batch_rows=4096)
     first = next(it)
     assert first.num_rows == 4096
