@@ -243,13 +243,25 @@ class ColumnChunkReader:
                 return
             rows, consumed, seen = res
             if len(rows) == 0:
-                if len(view) >= size - pos:
-                    # whole remainder in view and nothing parses: let the
-                    # python walk raise its precise CorruptedError
-                    yield from self._pages_streamed_python(window, pos,
-                                                           values_seen)
-                    return
-                win = min(win * 4, size - pos)  # page larger than window
+                if len(view) >= min(MAX_PAGE_HEADER_SIZE, size - pos):
+                    # the header must fit in this view: parse it once via
+                    # the python walk to either learn the blocking page's
+                    # true size (grow exactly, no doubling sweep over a
+                    # corrupt clen) or raise the precise CorruptedError
+                    try:
+                        header, data_pos = thrift.deserialize(
+                            md.PageHeader, bytes(view[:MAX_PAGE_HEADER_SIZE]),
+                            0)
+                    except Exception:
+                        yield from self._pages_streamed_python(
+                            window, pos, values_seen)
+                        return
+                    clen = _checked_page_size(header, start + pos)
+                    if pos + data_pos + clen > size:
+                        raise CorruptedError("truncated page payload")
+                    win = data_pos + clen  # exactly this oversized page
+                    continue
+                win = min(win * 4, size - pos)  # header larger than window
                 continue
             yield from self._pages_from_scan(view, start + pos, rows)
             pos += consumed

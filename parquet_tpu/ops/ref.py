@@ -486,28 +486,37 @@ def encode_bit_packed_levels(values: np.ndarray, bit_width: int) -> bytes:
 # ---------------------------------------------------------------------------
 
 
-def decode_delta_binary_packed(data, pos: int = 0) -> Tuple[np.ndarray, int]:
+def decode_delta_binary_packed(data, pos: int = 0,
+                               _native: bool = True) -> Tuple[np.ndarray, int]:
     """Returns (int64 values, end position).
 
     Routes through the fused native prescan+decode when available (one
     multithread-capable C pass: header walk, unpack, min-add, prefix sum) —
-    the per-miniblock Python loop below is the oracle and measured 60x
-    slower on config-4's 8M-value delta pages.  The native path returns
-    None on malformed streams; the oracle then raises the precise error."""
-    from .. import native as _native
+    the per-miniblock Python loop below is the oracle (``_native=False``
+    pins it, mirroring the encoder kwarg) and measured 60x slower on
+    config-4's 8M-value delta pages.  Streams the native path refuses at
+    either stage (prescan or the decoder's stricter bounds) fall back to
+    the oracle, which owns the precise error / lenient-truncation
+    semantics either way."""
+    if _native:
+        from .. import native
 
-    arr = (data if isinstance(data, np.ndarray)
-           else np.frombuffer(data, np.uint8))
-    pre = _native.delta_prescan(arr, pos)
-    if pre is not None:
-        first, total, vpm, offs, widths, mins, end = pre
-        out = _native.delta_decode(
-            arr, offs, widths, mins,
-            np.array([0, len(offs)], np.int64),
-            np.array([first], np.int64),
-            np.array([total], np.int64), np.array([vpm], np.int64))
-        if out is not None:
-            return out, end
+        arr = (data if isinstance(data, np.ndarray)
+               else np.frombuffer(data, np.uint8))
+        pre = native.delta_prescan(arr, pos)
+        if pre is not None:
+            first, total, vpm, offs, widths, mins, end = pre
+            try:
+                out = native.delta_decode(
+                    arr, offs, widths, mins,
+                    np.array([0, len(offs)], np.int64),
+                    np.array([first], np.int64),
+                    np.array([total], np.int64),
+                    np.array([vpm], np.int64))
+            except ValueError:
+                out = None  # decoder-stage refusal: oracle decides
+            if out is not None:
+                return out, end
     block_size, pos = read_uvarint(data, pos)
     n_miniblocks, pos = read_uvarint(data, pos)
     total, pos = read_uvarint(data, pos)

@@ -88,9 +88,19 @@ def test_delta_binary_packed_roundtrip(n, kind, rng):
             np.array([np.iinfo(np.int64).min, np.iinfo(np.int64).max, 0, -1, 1]), size=n
         )
     enc = ref.encode_delta_binary_packed(v, _native=False)  # pin the oracle
-    dec, end = ref.decode_delta_binary_packed(np.frombuffer(enc, np.uint8))
+    dec, end = ref.decode_delta_binary_packed(np.frombuffer(enc, np.uint8),
+                                              _native=False)
     assert end == len(enc)
     np.testing.assert_array_equal(dec, v)
+    # cross: native decode of the oracle's bytes, and oracle decode of the
+    # native encoder's bytes — the twins must agree both ways
+    dec_n, end_n = ref.decode_delta_binary_packed(np.frombuffer(enc, np.uint8))
+    assert end_n == len(enc)
+    np.testing.assert_array_equal(dec_n, v)
+    enc_n = ref.encode_delta_binary_packed(v)
+    dec_x, _ = ref.decode_delta_binary_packed(np.frombuffer(enc_n, np.uint8),
+                                              _native=False)
+    np.testing.assert_array_equal(dec_x, v)
 
 
 def _random_strings(rng, n):
