@@ -93,7 +93,8 @@ class Column:
             else:
                 dict_arr = _leaf_to_arrow(self.leaf, np.asarray(dh), None,
                                           None)
-            idx = np.asarray(self.dict_indices).astype(np.int32)
+            idx = np.asarray(self.dict_indices).astype(np.int32,
+                                                        copy=False)
             if self.validity is not None:
                 v = np.asarray(self.validity, bool)
                 slot = np.zeros(len(v), np.int32)
@@ -220,7 +221,8 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
         flat = flat.astype(dt) if pt == Type.INT32 else flat.view(dt) if flat.dtype.itemsize == dt.itemsize else flat.astype(dt)
         return _fixed_with_nulls(flat, validity, pa.from_numpy_dtype(dt))
     if k == LogicalKind.DATE:
-        return _fixed_with_nulls(flat.astype(np.int32), validity, pa.date32())
+        return _fixed_with_nulls(flat.astype(np.int32, copy=False),
+                                 validity, pa.date32())
     if k == LogicalKind.TIMESTAMP_MILLIS:
         return _fixed_with_nulls(flat, validity, pa.timestamp("ms", tz="UTC" if leaf.logical_params.get("utc") else None))
     if k == LogicalKind.TIMESTAMP_MICROS:
@@ -228,7 +230,8 @@ def _leaf_to_arrow(leaf: Leaf, values, offsets, validity):
     if k == LogicalKind.TIMESTAMP_NANOS:
         return _fixed_with_nulls(flat, validity, pa.timestamp("ns", tz="UTC" if leaf.logical_params.get("utc") else None))
     if k == LogicalKind.TIME_MILLIS:
-        return _fixed_with_nulls(flat.astype(np.int32), validity, pa.time32("ms"))
+        return _fixed_with_nulls(flat.astype(np.int32, copy=False),
+                                 validity, pa.time32("ms"))
     if k == LogicalKind.TIME_MICROS:
         return _fixed_with_nulls(flat, validity, pa.time64("us"))
     if k == LogicalKind.DECIMAL and pt in (Type.INT32, Type.INT64):
