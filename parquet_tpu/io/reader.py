@@ -259,6 +259,14 @@ class ColumnChunkReader:
                     clen = _checked_page_size(header, start + pos)
                     if pos + data_pos + clen > size:
                         raise CorruptedError("truncated page payload")
+                    if len(view) >= data_pos + clen:
+                        # the whole claimed page was visible and the
+                        # scanner still refused it (bad uncompressed size,
+                        # missing num_values, ...): the python walk owns
+                        # it — growing again would loop forever
+                        yield from self._pages_streamed_python(
+                            window, pos, values_seen)
+                        return
                     win = data_pos + clen  # exactly this oversized page
                     continue
                 win = min(win * 4, size - pos)  # header larger than window

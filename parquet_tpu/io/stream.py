@@ -29,7 +29,7 @@ import numpy as np
 
 from ..format.enums import PageType
 from ..ops import levels as levels_ops
-from .column import Column, concat_columns
+from .column import Column
 from .reader import (ParquetFile, Table, decode_chunk_host,
                      decode_dictionary_page, verify_page_crc)
 

@@ -43,19 +43,26 @@ def log(*a):
 def probe(timeout_s: int = 150) -> bool:
     code = ("import jax,sys;"
             "sys.exit(0 if jax.devices()[0].platform=='tpu' else 3)")
+    # DEVNULL, not pipes: with capture_output, a timeout kill of the
+    # child still leaves communicate() blocked on the pipe's write end
+    # if the child spawned a tunnel helper that inherited it — observed
+    # r5: one probe wedged the queue for ~2 h past its 150 s timeout.
+    # start_new_session puts child+helpers in one process group, and the
+    # timeout path kills the whole GROUP (subprocess.run's own timeout
+    # only kills the direct child, leaking helpers onto the 1-core box).
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL,
+                         stdin=subprocess.DEVNULL,
+                         start_new_session=True)
     try:
-        # DEVNULL, not pipes: with capture_output, a timeout kill of the
-        # child still leaves communicate() blocked on the pipe's write end
-        # if the child spawned a tunnel helper that inherited it — observed
-        # r5: one probe wedged the queue for ~2 h past its 150 s timeout.
-        # start_new_session puts child+helpers in one killable group.
-        p = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
-                           stdout=subprocess.DEVNULL,
-                           stderr=subprocess.DEVNULL,
-                           stdin=subprocess.DEVNULL,
-                           start_new_session=True)
-        return p.returncode == 0
+        return p.wait(timeout=timeout_s) == 0
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            p.kill()
+        p.wait()
         return False
 
 
