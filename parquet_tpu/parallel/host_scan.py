@@ -292,6 +292,8 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
 
     probe = (sorted({normalize_probe(key_leaf, v) for v in values} - {None})
              if values is not None else None)
+    rg_base = np.zeros(len(pf.row_groups), np.int64)
+    np.cumsum([rg.num_rows for rg in pf.row_groups[:-1]], out=rg_base[1:])
     spans = []
     jit_cache: Dict[tuple, object] = {}
     for si, plan in enumerate(plans):
@@ -308,10 +310,18 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                     dplan = dr.build_plan(chunk, pages=iter(pages))
                     if (chunk.leaf.physical_type == Type.BYTE_ARRAY
                             and dplan.value_kind != "dict"):
-                        raise ValueError(
-                            f"device scan column {c!r}: plain-encoded "
-                            "BYTE_ARRAY has no row-aligned device form; use "
-                            "the host scan")
+                        if c == path:
+                            raise ValueError(
+                                f"device scan key {c!r}: plain-encoded "
+                                "BYTE_ARRAY has no row-aligned device "
+                                "form; use the host scan")
+                        # plain-string OUTPUT column: keep it host-resident
+                        # (slot-aligned ragged pair); the device filters on
+                        # the key and only SURVIVORS' bytes materialize —
+                        # the same survivor-only rule as the host scan
+                        per_col[c] = ("host_ragged",) + _host_ragged_span(
+                            pf, c, rg_base, plan)
+                        continue
                     staged = dr.stage_plan(dplan)
                 except dr._Unsupported as e:
                     raise ValueError(
@@ -319,7 +329,9 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                         "(scan_filtered)") from None
                 per_col[c] = (chunk, dplan, staged, row_start - first)
         fused = None
-        if all(per_col[c][1].value_kind != "dict" for c in [path] + out_cols):
+        if all(per_col[c][0] != "host_ragged"
+               and per_col[c][1].value_kind != "dict"
+               for c in [path] + out_cols):
             # lazily-built fused program, shared across same-shape spans
             # via the signature cache; the jit is only constructed from the
             # second decoded_scan call on this state (use_count below), so
@@ -332,6 +344,21 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
             fused = _FusedFactory(jit_cache, sig, path, out_cols, per_col,
                                   lo, hi, probe, plan.row_count)
         spans.append((plan, per_col, fused))
+    # per-COLUMN form consistency: a column dict-encoded in one row group
+    # and plain in another must not mix device-dict and host-ragged parts
+    # (the assemble routes a column by its first part's shape) — demote
+    # every span of such a column to the host-ragged form
+    for c in out_cols:
+        kinds = {per_col[c][0] == "host_ragged"
+                 for _, per_col, _ in spans}
+        if kinds == {True, False}:
+            for plan, per_col, _f in spans:
+                if per_col[c][0] != "host_ragged":
+                    per_col[c] = ("host_ragged",) + _host_ragged_span(
+                        pf, c, rg_base, plan)
+            # fused programs were built against the device form: disable
+            # them (host_ragged spans run the eager path)
+            spans = [(plan, per_col, None) for plan, per_col, _f in spans]
     return {"path": path, "out_cols": out_cols, "lo": lo, "hi": hi,
             "values": probe, "spans": spans, "use_count": [0],
             "leaves": {c: pf.schema.leaf(c) for c in out_cols}}
@@ -411,8 +438,13 @@ class _ScanCarrier:
         for si, k in zip(range(self.flushed, upto), ks):
             for c in out_cols:
                 p = self.parts[c][si]
-                self.parts[c][si] = ((p[0], p[1][:k]) if isinstance(p, tuple)
-                                     else p[:k])
+                if isinstance(p, tuple) and p and p[0] == "host_ragged":
+                    # trim only the device index leg; host arrays stay
+                    self.parts[c][si] = p[:4] + (p[4][:k],)
+                elif isinstance(p, tuple):
+                    self.parts[c][si] = (p[0], p[1][:k])
+                else:
+                    self.parts[c][si] = p[:k]
                 if self.vparts[c][si] is not None:
                     self.vparts[c][si] = self.vparts[c][si][:k]
         self.flushed = upto
@@ -477,6 +509,20 @@ def _make_fused_span(path, out_cols, per_col, lo, hi, probe, n_rows):
     return jax.jit(run)
 
 
+def _host_ragged_span(pf, c, rg_base, plan):
+    """Host (dense values, dense offsets, validity) for one span of a
+    plain-string output column — aligned=\"arrays\" keeps it columnar:
+    offsets cover the DENSE present values and ``validity`` maps rows to
+    value ordinals (None when null-free)."""
+    start = int(rg_base[plan.rg_index]) + plan.first_row
+    vals_form, valid = read_row_range(pf, c, start, plan.row_count,
+                                      aligned="arrays")
+    tag, vals, offs = vals_form
+    assert tag == "ba_arrays", tag
+    return (np.asarray(vals), np.asarray(offs, np.int64),
+            None if valid is None else np.asarray(valid, bool))
+
+
 class _FusedFactory:
     """Builds (once) and returns the span's fused jitted program.  Spans
     with the same shape signature share one program via ``cache``."""
@@ -520,7 +566,11 @@ def _scan_dispatch(state, carrier: _ScanCarrier,
         chunk, dplan, staged, trim = per_col[path]
         key = dr.decode_staged(chunk.leaf, Type(chunk.meta.type), dplan, staged)
         cols = {}
+        ragged_cols = [c for c in out_cols
+                       if per_col[c][0] == "host_ragged"]
         for c in out_cols:
+            if per_col[c][0] == "host_ragged":
+                continue
             chunk_c, dplan_c, staged_c, trim_c = per_col[c]
             cols[c] = dr.decode_staged(chunk_c.leaf, Type(chunk_c.meta.type),
                                        dplan_c, staged_c)
@@ -535,8 +585,17 @@ def _scan_dispatch(state, carrier: _ScanCarrier,
             pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
             tgt = jnp.where(mask, pos, n_rows)  # survivors -> prefix
             cnt = jnp.sum(mask.astype(jnp.int32))
+            ragged_idx = (_compact(jnp.arange(n_rows, dtype=jnp.int32), tgt)
+                          if ragged_cols else None)
             outs, vouts = {}, {}
             for c in out_cols:
+                if per_col[c][0] == "host_ragged":
+                    # survivor ROW indices ride the device; byte gather
+                    # happens host-side at assemble (survivor-only)
+                    _, hv, ho, hvalid = per_col[c]
+                    outs[c] = ("host_ragged", hv, ho, hvalid, ragged_idx)
+                    vouts[c] = None
+                    continue
                 chunk_c, dplan_c, staged_c, trim_c = per_col[c]
                 vals, valid = _row_aligned_device(
                     cols[c], trim_c, n_rows,
@@ -557,6 +616,59 @@ def _scan_dispatch(state, carrier: _ScanCarrier,
             carrier.flush(out_cols, len(carrier.counts))
 
 
+def _assemble_host_ragged(col_parts, carrier):
+    """Host-side survivor gather for a plain-string output column: per
+    span, take the device-compacted row indices (already trimmed to the
+    synced counts), map rows → dense value ordinals through the span
+    validity, and emit ONE (uint8 values, int64 offsets) pair over all
+    survivors — null survivors are zero-length entries — wrapped as
+    ``(form, validity)`` when any null survives."""
+    from .. import native as _nat
+    from ..ops import ref as _ref
+
+    pieces = []
+    valid_parts = []
+    any_nulls = False
+    for i, part in enumerate(col_parts):
+        _, hv, ho, hvalid, idx_dev = part
+        k = int(carrier.ks_all[i])
+        rows = np.asarray(idx_dev)[:k].astype(np.int64)
+        if hvalid is None:
+            v = np.ones(k, bool)
+            ords = rows
+        else:
+            v = hvalid[rows]
+            ords = (np.cumsum(hvalid.astype(np.int64)) - 1)[rows]
+            any_nulls = any_nulls or not bool(v.all())
+        sel = ords[v]
+        got = _nat.gather_ba(hv, ho, sel)
+        if got is not None:
+            gvals = np.asarray(got[0])
+        else:  # shim unavailable: numpy gather
+            lens_d = ho[sel + 1] - ho[sel]
+            idx = np.repeat(ho[sel], lens_d) + _ref._ranges(lens_d)
+            gvals = np.asarray(hv)[idx]
+        lens = np.zeros(max(k, 1), np.int64)[:k]
+        lens[v] = ho[sel + 1] - ho[sel]
+        offs = np.zeros(k + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        pieces.append((gvals, offs))
+        valid_parts.append(v)
+    vals = (np.concatenate([p[0] for p in pieces])
+            if len(pieces) > 1 else pieces[0][0])
+    offs_parts = [pieces[0][1]]
+    base = int(pieces[0][1][-1])
+    for vo in pieces[1:]:
+        offs_parts.append(vo[1][1:] + base)
+        base += int(vo[1][-1])
+    offs = (np.concatenate(offs_parts) if len(offs_parts) > 1
+            else offs_parts[0])
+    form = (vals, offs)
+    if any_nulls:
+        return form, np.concatenate(valid_parts)
+    return form
+
+
 def _scan_assemble(state, carrier: _ScanCarrier) -> Dict[str, object]:
     """Phase B — sync remaining counts, slice, concatenate across spans."""
     import jax.numpy as jnp
@@ -568,6 +680,10 @@ def _scan_assemble(state, carrier: _ScanCarrier) -> Dict[str, object]:
     for c in out_cols:
         if not parts[c]:
             out[c] = _empty_device_result(state["leaves"][c])
+            continue
+        if (isinstance(parts[c][0], tuple)
+                and parts[c][0][0] == "host_ragged"):
+            out[c] = _assemble_host_ragged(parts[c], carrier)
             continue
         if isinstance(parts[c][0], tuple):  # dictionary-encoded
             form = _concat_dictionaries(parts[c])
@@ -594,8 +710,11 @@ def decoded_scan(state) -> Dict[str, object]:
     fixed-width → ``jax.Array`` (64-bit types in the (n, 2) uint32 pair
     representation — ``ops.device.pairs_to_host`` converts); dictionary-
     encoded byte arrays → ``(dictionary, indices)`` with per-row-group
-    dictionaries rebased into one; nullable columns wrap their form in a
-    ``(form, validity)`` tuple.
+    dictionaries rebased into one; PLAIN (non-dictionary) byte arrays →
+    a host ``(uint8 values, int64 offsets)`` pair over the survivors
+    (the chip filters on the key and compacts row indices; only
+    survivors' bytes materialize, host-side); nullable columns wrap
+    their form in a ``(form, validity)`` tuple.
     """
     state.setdefault("use_count", [0])[0] += 1
     carrier = _ScanCarrier(state["out_cols"])
@@ -611,9 +730,12 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
     amortizes across repeated scans); on the cpu backend the threaded host
     route wins (measured 1.8-2.7x pyarrow vs the device route's emulated
     kernels) and materialized host arrays are what callers want there.
-    Column shapes the device route refuses (nested keys, plain-string
-    outputs, decimal byte-array keys) fall back to the host route on any
-    backend — same values, host-resident forms."""
+    Column shapes the device route refuses (nested or plain-string KEYS,
+    decimal byte-array keys) fall back to the host route on any backend.
+    NOTE the two routes' output forms differ (decoded_scan device forms
+    vs scan_filtered host arrays / byte lists); plain-string OUTPUT
+    columns ride the device route as host (values, offsets) survivor
+    pairs."""
     import jax
 
     if jax.default_backend() != "cpu":

@@ -192,14 +192,19 @@ def test_device_scan_empty_result_typed():
     assert len(ep) == 0 and len(ok) == 0
 
 
-def test_device_scan_rejects_plain_byte_array():
+def test_device_scan_plain_byte_array_key_rejected_output_allowed():
     t = pa.table({"k": pa.array(np.arange(1000, dtype=np.int32)),
                   "s": pa.array([f"str_{i:05d}" for i in range(1000)])})
     b = io.BytesIO()
     pq.write_table(t, b, use_dictionary=False, write_page_index=True)
     pf = ParquetFile(b.getvalue())
-    with pytest.raises(ValueError, match="plain-encoded BYTE_ARRAY"):
-        scan_filtered_device(pf, "k", lo=100, hi=105, columns=["s"])
+    # plain-string OUTPUT columns ride the scan (host survivor gather)
+    out = scan_filtered_device(pf, "k", lo=100, hi=105, columns=["s"])
+    vals, offs = out["s"]
+    got = [vals[offs[i]:offs[i + 1]].tobytes().decode()
+           for i in range(len(offs) - 1)]
+    assert got == [f"str_{i:05d}" for i in range(100, 106)]
+    # a plain-string KEY still has no row-aligned device form
     with pytest.raises(ValueError, match="use the host scan"):
         scan_filtered_device(pf, "s", lo="str_00100", hi="str_00105",
                              columns=["k"])
@@ -378,3 +383,121 @@ def test_scan_auto_routes_by_backend(monkeypatch):
                             columns=["l_extendedprice"])
     np.testing.assert_allclose(np.sort(out2["l_extendedprice"]),
                                np.sort(host["l_extendedprice"]))
+
+
+def test_device_scan_plain_string_output_survivor_gather():
+    """PLAIN (non-dictionary) string OUTPUT columns ride the device scan:
+    the chip compacts survivor row indices and only survivors' bytes
+    materialize host-side — values (nulls included) equal the host scan."""
+    from parquet_tpu.parallel.host_scan import decoded_scan, stage_scan
+
+    n = 60000
+    rng = np.random.default_rng(23)
+    ship = np.sort(rng.integers(8000, 12000, n).astype(np.int32))
+    words = np.array([f"word_{i:04d}"[: 3 + i % 9] for i in range(200)])
+    comments = words[rng.integers(0, 200, n)]
+    nulls = rng.random(n) < 0.1
+    t = pa.table({
+        "l_shipdate": pa.array(ship),
+        "l_comment": pa.array(np.where(nulls, None, comments)),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 4, data_page_size=1 << 14,
+                   compression="snappy", use_dictionary=False,
+                   write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+    state = stage_scan(pf, "l_shipdate", lo=9000, hi=9200,
+                       columns=["l_comment"])
+    host = scan_filtered(pf, "l_shipdate", lo=9000, hi=9200,
+                         columns=["l_comment"])
+    exp = [None if e is None else (e if isinstance(e, bytes) else e.encode())
+           for e in host["l_comment"]]
+    for rep in range(2):  # second call re-runs the same eager route
+        out = decoded_scan(state)
+        form = out["l_comment"]
+        if (isinstance(form, tuple) and len(form) == 2
+                and getattr(form[1], "dtype", None) == np.bool_):
+            (vals, offs), valid = form
+        else:
+            vals, offs = form
+            valid = None
+        got = [None if (valid is not None and not valid[i])
+               else vals[offs[i]:offs[i + 1]].tobytes()
+               for i in range(len(offs) - 1)]
+        assert got == exp, rep
+    assert sum(e is None for e in exp) > 0  # nulls actually exercised
+
+
+def test_sharded_scan_plain_string_output():
+    """scan_filtered_sharded returns per-device host ragged pairs for plain
+    string outputs; union of shards equals the host scan."""
+    from parquet_tpu.parallel.host_scan import scan_filtered_sharded
+    from parquet_tpu.parallel.mesh import default_mesh
+
+    n = 48000
+    rng = np.random.default_rng(29)
+    ship = rng.integers(8000, 12000, n).astype(np.int32)  # unsorted
+    words = np.array([f"w{i:04d}" for i in range(150)])
+    t = pa.table({
+        "l_shipdate": pa.array(ship),
+        "l_comment": pa.array(words[rng.integers(0, 150, n)]),
+    })
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 8, data_page_size=1 << 14,
+                   compression="snappy", use_dictionary=False,
+                   write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+    res = scan_filtered_sharded(pf, "l_shipdate", lo=9000, hi=9400,
+                                columns=["l_comment"], mesh=default_mesh(8))
+    host = scan_filtered(pf, "l_shipdate", lo=9000, hi=9400,
+                         columns=["l_comment"])
+    got = []
+    for form in res["l_comment"]:
+        vals, offs = form
+        got += [vals[offs[i]:offs[i + 1]].tobytes()
+                for i in range(len(offs) - 1)]
+    exp = [e if isinstance(e, bytes) else e.encode()
+           for e in host["l_comment"]]
+    assert res["#rows"] == len(exp)
+    assert sorted(got) == sorted(exp)
+
+
+def test_device_scan_mixed_dict_plain_string_output_demotes_to_ragged():
+    """A string output column dict-encoded in one row group and plain in
+    another demotes EVERY span to the host-ragged form (mixed part shapes
+    would crash the assemble); values equal the host scan."""
+    from parquet_tpu.parallel.host_scan import decoded_scan, stage_scan
+
+    n = 40000
+    rng = np.random.default_rng(31)
+    ship = np.sort(rng.integers(8000, 12000, n).astype(np.int32))
+    # rg0 low-cardinality (dict sticks), rg1 near-unique: OUR writer's
+    # sticky fallback emits rg0 fully dict and rg1 fully PLAIN — the
+    # per-row-group mixed shape
+    from parquet_tpu.io.writer import WriterOptions, write_table
+
+    s = np.array([f"v{i % 5}" for i in range(n // 2)]
+                 + [f"u_{i:06d}" for i in range(n // 2)])
+    t = pa.table({"l_shipdate": pa.array(ship), "s": pa.array(s)})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(compression="snappy",
+                                      row_group_size=n // 2,
+                                      dictionary_page_limit=1 << 12))
+    pf = ParquetFile(buf.getvalue())
+    encs = [tuple(sorted(int(e) for e in pf.metadata.row_groups[i]
+                         .columns[1].meta_data.encodings))
+            for i in range(2)]
+    assert encs[0] != encs[1], encs  # genuinely mixed per-rg forms
+    # range straddles both row groups so both spans survive
+    lo, hi = 9800, 10200
+    state = stage_scan(pf, "l_shipdate", lo=lo, hi=hi, columns=["s"])
+    forms = {state["spans"][i][1]["s"][0] == "host_ragged"
+             for i in range(len(state["spans"]))}
+    assert forms == {True}  # demoted everywhere
+    out = decoded_scan(state)
+    host = scan_filtered(pf, "l_shipdate", lo=lo, hi=hi, columns=["s"])
+    vals, offs = out["s"]
+    got = [vals[offs[i]:offs[i + 1]].tobytes()
+           for i in range(len(offs) - 1)]
+    exp = [e if isinstance(e, bytes) else e.encode() for e in host["s"]]
+    assert got == exp and len(got) > 100
