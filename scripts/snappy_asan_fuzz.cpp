@@ -60,6 +60,22 @@ int main() {
       return 1;
     }
   }
-  printf("fuzz ok: %d corrupt decodes, 3000 valid round-trips\n", ran);
+  // second target: pq_rle_dict_batch on corrupt index pages
+  int ran2 = 0;
+  for (int trial = 0; trial < 4000; ++trial) {
+    size_t n = 1 + rng() % 4000;
+    std::vector<uint8_t> page(n);
+    for (size_t i = 0; i < n; ++i) page[i] = (uint8_t)rng();
+    if (trial % 3 == 0) page[0] = (uint8_t)(rng() % 36);  // plausible width
+    int64_t src_ptr = (int64_t)(uintptr_t)page.data();
+    int64_t len = (int64_t)n;
+    int64_t cnt = (int64_t)(1 + rng() % 5000);
+    uint8_t pref = (uint8_t)(trial & 1);
+    std::vector<int32_t> out((size_t)cnt);
+    pq_rle_dict_batch(&src_ptr, &len, &cnt, &pref, 1, out.data());
+    ++ran2;
+  }
+  printf("fuzz ok: %d corrupt snappy decodes + 3000 valid round-trips, "
+         "%d corrupt rle-dict pages\n", ran, ran2);
   return 0;
 }
