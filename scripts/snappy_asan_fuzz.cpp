@@ -75,7 +75,36 @@ int main() {
     pq_rle_dict_batch(&src_ptr, &len, &cnt, &pref, 1, out.data());
     ++ran2;
   }
-  printf("fuzz ok: %d corrupt snappy decodes + 3000 valid round-trips, "
-         "%d corrupt rle-dict pages\n", ran, ran2);
+  // third target: the page-header scanners (full + windowed/partial)
+  int ran3 = 0;
+  for (int trial = 0; trial < 4000; ++trial) {
+    size_t n = 1 + rng() % 3000;
+    std::vector<uint8_t> buf2(n);
+    for (size_t i = 0; i < n; ++i) buf2[i] = (uint8_t)rng();
+    std::vector<int64_t> rows(64 * PG_NFIELDS);
+    int64_t consumed[2] = {0, 0};
+    pq_scan_page_headers(buf2.data(), (int64_t)n, 1 + rng() % 100000, 64,
+                         rows.data());
+    pq_scan_page_headers_partial(buf2.data(), (int64_t)n,
+                                 1 + rng() % 100000, 64, rows.data(),
+                                 consumed);
+    ++ran3;
+  }
+  // fourth: pq_plain_ba_batch on corrupt sections
+  int ran4 = 0;
+  for (int trial = 0; trial < 4000; ++trial) {
+    size_t n = 1 + rng() % 3000;
+    std::vector<uint8_t> sec(n);
+    for (size_t i = 0; i < n; ++i) sec[i] = (uint8_t)rng();
+    int64_t ptr = (int64_t)(uintptr_t)sec.data();
+    int64_t len = (int64_t)n;
+    int64_t cnt = (int64_t)(1 + rng() % 500);
+    std::vector<int64_t> offs((size_t)cnt + 1);
+    std::vector<uint8_t> vals(n + 8);
+    pq_plain_ba_batch(&ptr, &len, &cnt, 1, offs.data(), vals.data());
+    ++ran4;
+  }
+  printf("fuzz ok: %d corrupt snappy + 3000 valid, %d rle-dict, "
+         "%d header-scans, %d plain-ba\n", ran, ran2, ran3, ran4);
   return 0;
 }
