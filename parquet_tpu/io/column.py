@@ -308,7 +308,14 @@ def concat_columns(parts: List[Column]) -> Column:
             o = np.asarray(p.offsets).astype(np.int64)
             offs_parts.append(o[:-1] + base)
             base += int(o[-1])
-        offsets = np.concatenate(offs_parts + [np.array([base])]).astype(np.int32)
+        offsets = np.concatenate(offs_parts + [np.array([base])])
+        # stay on int64 offsets when the concatenated chunk crosses the
+        # int32-offset limit (the arrow LARGE layout downstream) — a bare
+        # int32 cast would wrap silently
+        from .reader import _OFFSET32_LIMIT
+
+        if base <= _OFFSET32_LIMIT:
+            offsets = offsets.astype(np.int32)
     else:
         values = np.concatenate([np.asarray(p.values) for p in parts])
         offsets = None

@@ -729,6 +729,15 @@ class Table:
             names.append(child.name)
             prefer = child.name in self._dict_fields
             arrs = [p.to_arrow(prefer_dictionary=prefer) for p in ps]
+            if any(pa.types.is_large_string(a.type)
+                   or pa.types.is_large_binary(a.type) for a in arrs):
+                # a >2 GiB chunk took the LARGE layout: normalize the
+                # narrow chunks up so the chunked array is one type
+                wide_t = next(a.type for a in arrs
+                              if pa.types.is_large_string(a.type)
+                              or pa.types.is_large_binary(a.type))
+                arrs = [a if a.type == wide_t else a.cast(wide_t)
+                        for a in arrs]
             if prefer and any(not pa.types.is_dictionary(a.type)
                               for a in arrs):
                 # a chunk fell back to dense (dictionary overflow
@@ -1273,16 +1282,16 @@ def _rle_dict_chunk_fast(reader: ColumnChunkReader, page_list, pre_dec,
     Python scan/expand round-trip per page (~0.3 ms each; the dominant
     non-decompress cost of dictionary string columns at lineitem scale).
 
-    Returns ``(column, pre_dec)``: ``column`` is None when a precondition
-    fails (nulls, mixed encodings, repetition, shim unavailable) and the
-    general path should run.  Header-only checks run BEFORE any
-    decompression, and pages this path had to decompress itself (codecs
-    the batched decompressor doesn't cover) are handed back in the second
-    element so the fallback never decompresses a page twice."""
+    Returns ``(column, pre_dec, dictionary)``: ``column`` is None when a
+    precondition fails (nulls, mixed encodings, repetition, shim
+    unavailable) and the general path should run.  Header-only checks run
+    BEFORE any decompression; pages this path had to decompress itself
+    and the decoded dictionary are handed back so the fallback never
+    repeats that work."""
     if (leaf.max_repetition_level > 0 or leaf.max_definition_level > 1
             or not _is_builtin_decode(Encoding.RLE_DICTIONARY)
             or _native.get_lib() is None):
-        return None, pre_dec
+        return None, pre_dec, None
     max_def = leaf.max_definition_level
     codec = reader.codec
     # pass 1 — header-only preconditions: no decompression yet, so a mixed
@@ -1293,25 +1302,25 @@ def _rle_dict_chunk_fast(reader: ColumnChunkReader, page_list, pre_dec,
         h = page.header
         if pt == PageType.DICTIONARY_PAGE:
             if seen_data:
-                return None, pre_dec
+                return None, pre_dec, None
             continue
         if pt == PageType.DATA_PAGE:
             dph = h.data_page_header
             if Encoding(dph.encoding) != Encoding.RLE_DICTIONARY:
-                return None, pre_dec
+                return None, pre_dec, None
             if max_def and Encoding(dph.definition_level_encoding) \
                     != Encoding.RLE:
-                return None, pre_dec
+                return None, pre_dec, None
             seen_data = True
         elif pt == PageType.DATA_PAGE_V2:
             dph2 = h.data_page_header_v2
             if (Encoding(dph2.encoding) != Encoding.RLE_DICTIONARY
                     or (dph2.num_nulls or 0)
                     or (dph2.repetition_levels_byte_length or 0)):
-                return None, pre_dec
+                return None, pre_dec, None
             seen_data = True
     if not seen_data:
-        return None, pre_dec
+        return None, pre_dec, None
     # pass 2 — decompress (reusing pre_dec) and collect index sections
     srcs: List = []
     counts: List[int] = []
@@ -1359,17 +1368,19 @@ def _rle_dict_chunk_fast(reader: ColumnChunkReader, page_list, pre_dec,
         merged = dict(pre_dec or {})
         merged.update(own_dec)
     if dictionary is None:
-        return None, merged
+        return None, merged, None
     indices = _native.rle_dict_batch(srcs, counts, prefixes)
     if indices is None or len(indices) != sum(counts):
-        return None, merged  # e.g. a v1 page with nulls: python path, no rework
+        # e.g. a v1 page with nulls: python path — hand back the work
+        # already done (decompressed pages AND the decoded dictionary)
+        return None, merged, dictionary
     counters.inc("data_pages_decoded", len(srcs))
     counters.inc("rle_dict_chunk_fast")
     col = Column(leaf=leaf, values=None, offsets=None, validity=None,
                  list_offsets=[], list_validity=[],
                  num_slots=len(indices), dictionary_host=dictionary,
                  dict_indices=indices)
-    return col, merged
+    return col, merged, dictionary
 
 
 def decode_chunk_host(reader: ColumnChunkReader, pages=None,
@@ -1398,17 +1409,20 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
         if fast is not None:
             return fast
     if physical == Type.BYTE_ARRAY:
-        fast, pre_dec = _rle_dict_chunk_fast(reader, page_list, pre_dec,
-                                             leaf, dictionary)
+        fast, pre_dec, dict_out = _rle_dict_chunk_fast(
+            reader, page_list, pre_dec, leaf, dictionary)
         if fast is not None:
             return fast
+        if dict_out is not None:
+            dictionary = dict_out
 
     for page_i, page in enumerate(page_list):
         h = page.header
         pt = page.page_type
         verify_page_crc(reader, page)
         if pt == PageType.DICTIONARY_PAGE:
-            dictionary = decode_dictionary_page(reader, page)
+            if dictionary is None:
+                dictionary = decode_dictionary_page(reader, page)
             continue
         pre = pre_dec.get(page_i) if pre_dec is not None else None
         if pt == PageType.DATA_PAGE:

@@ -291,11 +291,13 @@ class ParquetWriter:
                 and path not in self._dict_overflowed):
             dict_values, dict_offsets, indices = _build_dictionary(
                 leaf, data, opts.dictionary_page_limit)
-            if indices is None:
-                # overflow/limit: later row groups of this column carry the
-                # same distribution — skip their builds (and the sampling
-                # probes) instead of rediscovering the overflow per group;
-                # the sticky fallback mainstream writers use
+            if indices is None and nvalues:
+                # overflow/limit on a chunk that HAD values: later row
+                # groups of this column carry the same distribution — skip
+                # their builds (and the sampling probes) instead of
+                # rediscovering the overflow per group; the sticky fallback
+                # mainstream writers use.  An empty/all-null chunk says
+                # nothing about cardinality and must not disable the column.
                 self._dict_overflowed.add(path)
         if indices is not None:
             value_encoding = Encoding.RLE_DICTIONARY

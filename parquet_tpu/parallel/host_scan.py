@@ -263,8 +263,9 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
     out_cols = list(columns) if columns is not None else sorted(flat - {path})
     for c in [path] + out_cols:
         if c not in flat:
-            raise ValueError(f"column {c!r} is nested or unknown; the device "
-                             "scan handles flat columns")
+            raise ValueError(f"column {c!r} is nested or unknown; the "
+                             "device scan handles flat columns — use the "
+                             "host scan")
     from ..schema.types import LogicalKind
 
     key_leaf = pf.schema.leaf(path)
@@ -743,8 +744,13 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
             return scan_filtered_device(pf, path, lo=lo, hi=hi,
                                         columns=columns, use_bloom=use_bloom,
                                         values=values)
-        except ValueError:
-            pass  # stated device-route refusals: host route covers them
+        except ValueError as e:
+            # only the DOCUMENTED device-route refusals fall back (their
+            # messages all direct to the host scan); any other ValueError
+            # is a real failure and must surface, not silently change the
+            # caller's result forms
+            if "use the host scan" not in str(e):
+                raise
     return scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
                          use_bloom=use_bloom, values=values)
 

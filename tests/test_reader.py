@@ -749,3 +749,29 @@ def test_streamed_whole_file_read_route(monkeypatch):
     rg = pf.read(row_groups=[1]).to_arrow()
     assert rg.column("x").to_pylist() == \
         ref.column("x").to_pylist()[n // 4: n // 2]
+
+
+def test_mixed_wide_narrow_chunks_normalize_to_large(monkeypatch):
+    """A file whose first chunk crosses the (lowered) int32-offset limit
+    while a tail chunk stays narrow must still read: narrow chunks
+    normalize up to the large layout, and multi-chunk concatenation via
+    Table.columns keeps int64 offsets instead of wrapping."""
+    from parquet_tpu.io import reader as rdr
+
+    monkeypatch.setattr(rdr, "_OFFSET32_LIMIT", 2000)
+    vals = [f"string_{i:04d}{'x' * 20}" for i in range(400)]
+    t = pa.table({"s": pa.array(vals)})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=False, row_group_size=300,
+                   data_page_size=1 << 10)
+    pf = rdr.ParquetFile(buf.getvalue())
+    at = pf.read().to_arrow()
+    assert at.column("s").to_pylist() == vals
+    assert at.schema.field("s").type in (pa.large_string(),
+                                         pa.large_binary())
+    col = pf.read()["s"]  # concat_columns path
+    offs = np.asarray(col.offsets)
+    assert offs.dtype == np.int64
+    got = [np.asarray(col.values)[offs[i]:offs[i + 1]].tobytes().decode()
+           for i in range(len(offs) - 1)]
+    assert got == vals

@@ -493,3 +493,20 @@ def test_null_type_column_roundtrip(rng):
     pq.write_table(t, b2)
     back2 = ptq.ParquetFile(b2.getvalue()).read().to_arrow()
     assert back2.column("n").type == pa.null() and back2.column("n").null_count == 500
+
+
+def test_sticky_dict_fallback_ignores_empty_chunks():
+    """An all-null first row group must not sticky-disable dictionary
+    encoding for later row groups of the column."""
+    n = 6000
+    s = np.array([None] * (n // 2) + [f"v{i % 5}" for i in range(n // 2)],
+                 dtype=object)
+    t = pa.table({"s": pa.array(s)})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(compression="snappy",
+                                      row_group_size=n // 2))
+    meta = pq.ParquetFile(io.BytesIO(buf.getvalue())).metadata
+    encs = [str(e) for e in meta.row_group(1).column(0).encodings]
+    assert any("DICTIONARY" in e for e in encs), encs
+    back = pq.read_table(io.BytesIO(buf.getvalue()))
+    assert back.column("s").to_pylist() == t.column("s").to_pylist()
