@@ -501,3 +501,24 @@ def test_device_scan_mixed_dict_plain_string_output_demotes_to_ragged():
            for i in range(len(offs) - 1)]
     exp = [e if isinstance(e, bytes) else e.encode() for e in host["s"]]
     assert got == exp and len(got) > 100
+
+
+def test_scan_fallback_only_for_documented_refusals(monkeypatch):
+    """scan() must surface device-route ValueErrors that are NOT the
+    documented 'use the host scan' refusals instead of silently switching
+    result forms."""
+    import jax
+
+    import parquet_tpu
+    from parquet_tpu.parallel import host_scan as hs
+
+    pf = _lineitem(n=4000)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def broken_device(pf_, path, **kw):
+        raise ValueError("some internal device-scan bug")
+
+    monkeypatch.setattr(hs, "scan_filtered_device", broken_device)
+    with pytest.raises(ValueError, match="internal device-scan bug"):
+        parquet_tpu.scan(pf, "l_shipdate", lo=9000, hi=9200,
+                         columns=["l_extendedprice"])
