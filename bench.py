@@ -123,11 +123,32 @@ def _probe_tpu(timeout_s: int = 120):
                   f"retrying in {wait}s", file=sys.stderr)
             time.sleep(wait)
         art["attempts"] += 1
+        # Popen + group kill, not subprocess.run(capture_output=...): a
+        # timed-out probe child can leave a tunnel-helper grandchild holding
+        # the stderr pipe, wedging the collect long past the timeout
+        # (observed wedging the capture queue ~2h in r5); killing the whole
+        # session group closes every writer.
+        timed_out = False
+        p = subprocess.Popen([sys.executable, "-c", _PROBE_SCRIPT],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.PIPE, text=True,
+                             stdin=subprocess.DEVNULL, start_new_session=True)
         try:
-            p = subprocess.run([sys.executable, "-c", _PROBE_SCRIPT],
-                               timeout=timeout_s, capture_output=True, text=True)
+            _, _err = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            import signal as _signal
+
+            try:
+                os.killpg(p.pid, _signal.SIGKILL)
+            except OSError:
+                p.kill()
+            _, _err = p.communicate()
+            timed_out = True
+        if timed_out:
+            art["last_rc"] = "timeout"
+        else:
             art["last_rc"] = p.returncode
-            art["stderr_tail"] = (p.stderr or "")[-800:]
+            art["stderr_tail"] = (_err or "")[-800:]
             if p.returncode == 0:
                 art["ok"] = True
                 state["last_success"] = time.time()
@@ -147,8 +168,6 @@ def _probe_tpu(timeout_s: int = 120):
             # prior success suggests the hardware exists
             if not force and (not prior or det_fails >= 3):
                 return art
-        except subprocess.TimeoutExpired:
-            art["last_rc"] = "timeout"
         if force and time.time() > deadline:
             print("bench: BENCH_FORCE_TPU deadline exceeded, giving up",
                   file=sys.stderr)
