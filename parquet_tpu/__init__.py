@@ -27,9 +27,15 @@ Rows
   Value/Row (value.go/row.go), RowBuilder (row_builder.go), deconstruct/
   reconstruct (Schema.Deconstruct/Reconstruct), copy_rows (CopyRows),
   write_rows/read_rows — record-at-a-time nested transport
+Resilience
+  FaultPolicy (retry/backoff+jitter, deadline, degraded-scan mode),
+  ReadReport, ReadError/ReadIOError/DeadlineError (located failures),
+  FaultInjectingSource (deterministic chaos wrapper), RetryingSource
 """
 
-from .errors import CorruptedError
+from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError)
+from .io.faults import (FaultInjectingSource, FaultPolicy, PolicySource,
+                        ReadReport)
 from .io.reader import ParquetFile, ReadOptions, RowGroupReader, Table
 from .io.column import Column
 from .io.writer import (ColumnData, ParquetWriter, WriterOptions,

@@ -21,6 +21,48 @@ MAX_DEFINITION_LEVEL = 255
 MAX_REPETITION_LEVEL = 255
 
 
+class ReadError(CorruptedError):
+    """A read-stack failure wrapped with location context: file path,
+    row-group ordinal, column dotted-path, and page offset — enough to find
+    the failing bytes from the message alone (SURVEY.md §5: flaky network
+    filesystems need locatable errors, not bare ``OSError``\\ s).
+
+    Raised by the resilience layer (io/faults.py ``read_context``) around
+    every chunk/page decode in reader.py, stream.py, and host_scan.py; the
+    original low-level failure rides as ``__cause__``.  Subclasses keep the
+    wrapped failure catchable under its conventional base:
+    :class:`ReadIOError` is also an ``OSError``, :class:`DeadlineError` also
+    a ``TimeoutError``."""
+
+    def __init__(self, message: str, path=None, row_group=None, column=None,
+                 page_offset=None):
+        loc = []
+        if path is not None:
+            loc.append(f"file={path}")
+        if row_group is not None:
+            loc.append(f"row-group={row_group}")
+        if column is not None:
+            loc.append(f"column={column}")
+        if page_offset is not None:
+            loc.append(f"page-offset={page_offset}")
+        super().__init__(f"[{' '.join(loc)}] {message}" if loc else message)
+        self.path = path
+        self.row_group = row_group
+        self.column = column
+        self.page_offset = page_offset
+
+
+class ReadIOError(ReadError, OSError):
+    """An ``OSError`` from the byte source, with read-location context.
+    Catchable as either ``OSError`` (existing callers) or ``ReadError``."""
+
+
+class DeadlineError(ReadError, TimeoutError):
+    """A read ran past its :class:`~parquet_tpu.io.faults.FaultPolicy`
+    ``deadline_s``.  Deadlines are checked between IO calls and before each
+    retry sleep (a truly hung syscall cannot be interrupted from Python)."""
+
+
 class MissingRootColumnError(CorruptedError):
     """Schema has no root element."""
 

@@ -1,0 +1,476 @@
+"""Resilient-read layer: fault policy, deadline clock, structured error
+context, and a deterministic fault injector (SURVEY.md §5 — the operating
+environment is flaky network filesystems and object-store FUSE mounts).
+
+Three pieces, threaded through the whole read stack
+(:meth:`~parquet_tpu.io.reader.ParquetFile.read`, ``iter_batches``,
+``scan_filtered``/``stage_scan``/sharded):
+
+- :class:`FaultPolicy` — retries with exponential backoff **with jitter**,
+  a per-operation ``deadline_s``, and ``on_corrupt`` degraded-scan mode
+  (``'skip_row_group'`` returns a valid partial Table plus a
+  :class:`ReadReport` instead of dying on one bad row group).
+- :func:`read_context` — wraps low-level failures into the
+  :class:`~parquet_tpu.errors.ReadError` hierarchy carrying file path,
+  row-group ordinal, column dotted-path, and page offset.
+- :class:`FaultInjectingSource` — a seedable chaos wrapper over any
+  :class:`~parquet_tpu.io.source.Source` (transient errors, added latency,
+  bit flips, truncation, short reads) so the degraded paths are testable
+  deterministically (tests/test_faults.py, scripts/check.sh chaos smoke).
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import CorruptedError, DeadlineError, ReadError, ReadIOError
+from .source import Source
+
+__all__ = ["FaultPolicy", "ReadReport", "Deadline", "PolicySource",
+           "FaultInjectingSource", "read_context", "resolve_policy"]
+
+
+# ---------------------------------------------------------------------------
+# Policy
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class FaultPolicy:
+    """How a read survives a hostile byte source.
+
+    ``max_retries`` / ``backoff_s`` / ``backoff_multiplier`` / ``jitter``
+    govern transient ``OSError`` retries at the source level (jitter is a
+    uniform ±fraction of each delay — decorrelates retry storms when many
+    readers hit the same flaky mount).  ``deadline_s`` bounds each
+    *top-level operation* (one ``read()`` / one ``iter_batches`` drain / one
+    scan): checked between IO calls and before every retry sleep, raising
+    :class:`~parquet_tpu.errors.DeadlineError`.  ``on_corrupt`` picks what a
+    non-transient failure inside one row group does: ``'raise'`` (default)
+    surfaces a :class:`~parquet_tpu.errors.ReadError` naming
+    file/row-group/column/page; ``'skip_row_group'`` drops that whole row
+    group, keeps reading, and accounts for the loss in a
+    :class:`ReadReport`."""
+
+    max_retries: int = 3
+    backoff_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    jitter: float = 0.25
+    deadline_s: Optional[float] = None
+    on_corrupt: str = "raise"  # or "skip_row_group"
+
+    def __post_init__(self):
+        if self.on_corrupt not in ("raise", "skip_row_group"):
+            raise ValueError(
+                f"on_corrupt must be 'raise' or 'skip_row_group', "
+                f"got {self.on_corrupt!r}")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+
+    @property
+    def skip_corrupt(self) -> bool:
+        return self.on_corrupt == "skip_row_group"
+
+    def delays(self):
+        """Yield the jittered backoff delay before each retry."""
+        delay = self.backoff_s
+        for _ in range(self.max_retries):
+            j = (1.0 + self.jitter * (2.0 * random.random() - 1.0)
+                 if self.jitter else 1.0)
+            yield max(0.0, delay * j)
+            delay *= self.backoff_multiplier
+
+
+@dataclass
+class ReadReport:
+    """Machine-readable account of a degraded read.
+
+    ``rows_read`` counts rows actually delivered; ``rows_dropped`` rows lost
+    to skipped row groups (for scans: *candidate* rows of the dropped spans
+    — rows pushdown had already pruned are never counted either way).
+    ``row_groups_skipped`` holds the ordinals, ``errors`` the stringified
+    :class:`~parquet_tpu.errors.ReadError` per skip (index-aligned), and
+    ``retries`` the transient retries the policy performed."""
+
+    path: Optional[str] = None
+    rows_read: int = 0
+    rows_dropped: int = 0
+    row_groups_skipped: List[int] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+    retries: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.row_groups_skipped
+
+    def bind(self, path: Optional[str]) -> "ReadReport":
+        """Backfill the file path on a caller-supplied blank report."""
+        if self.path is None:
+            self.path = path
+        return self
+
+    def record_skip(self, rg_index: int, rows: int, error) -> None:
+        # no dedup: every call site aggregates to one call per row group
+        # per operation, and a report reused across files/shards must
+        # account each file's skip (same ordinal or not)
+        self.row_groups_skipped.append(rg_index)
+        self.errors.append(str(error))
+        self.rows_dropped += rows
+
+    def merge(self, other: "ReadReport") -> "ReadReport":
+        """Fold another report's accounting into this one (aggregating
+        shards/files, or adopting a routing attempt's scratch report)."""
+        if self.path is None:
+            self.path = other.path
+        self.rows_read += other.rows_read
+        self.rows_dropped += other.rows_dropped
+        self.row_groups_skipped.extend(other.row_groups_skipped)
+        self.errors.extend(other.errors)
+        self.retries += other.retries
+        return self
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "rows_read": self.rows_read,
+                "rows_dropped": self.rows_dropped,
+                "row_groups_skipped": list(self.row_groups_skipped),
+                "errors": list(self.errors), "retries": self.retries}
+
+
+def resolve_policy(pf, policy: Optional[FaultPolicy],
+                   report: Optional[ReadReport]
+                   ) -> Tuple[Optional[FaultPolicy], Optional[ReadReport]]:
+    """The one policy/report resolution rule every read entry point
+    (``read``, ``iter_batches``, ``scan_filtered``, ``stage_scan``) applies:
+    a per-call ``policy`` overrides the file's open-time one; a
+    caller-supplied ``report`` is bound to the file path, and a policy read
+    without one gets a fresh report so skips are always accounted."""
+    pol = policy if policy is not None else pf.policy
+    if report is not None:
+        report.bind(pf._path)
+    elif pol is not None:
+        report = ReadReport(path=pf._path)
+    return pol, report
+
+
+class Deadline:
+    """Monotonic-clock budget for one top-level read operation."""
+
+    __slots__ = ("_expires",)
+
+    def __init__(self, seconds: Optional[float]):
+        self._expires = None if seconds is None else time.monotonic() + seconds
+
+    def remaining(self) -> Optional[float]:
+        return (None if self._expires is None
+                else self._expires - time.monotonic())
+
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0
+
+    def check(self, what: str = "read") -> None:
+        if self.expired():
+            raise DeadlineError(f"deadline exceeded during {what}")
+
+
+# ---------------------------------------------------------------------------
+# Structured error context
+# ---------------------------------------------------------------------------
+# Environment/resource failures are never data corruption: wrapping them
+# into the CorruptedError hierarchy would let skip_row_group silently drop
+# every row group over, say, a missing codec package (and would break
+# ``except ImportError`` callers).  They always propagate unwrapped.
+NON_DATA_ERRORS: Tuple[type, ...] = (ImportError, MemoryError,
+                                     RecursionError, NotImplementedError)
+
+
+def is_corrupt_oserror(e: OSError) -> bool:
+    """Short/invalid reads are corruption, not transience — the single
+    classifier both retry loops (PolicySource, RetryingSource) consult so
+    the decision can't drift between them."""
+    s = str(e)
+    return "short read" in s or "invalid read" in s
+
+
+@contextmanager
+def read_context(path=None, row_group=None, column=None, page_offset=None,
+                 kinds: Tuple[type, ...] = (Exception,)):
+    """Wrap failures escaping the block into the :class:`ReadError`
+    hierarchy with location context.  Already-contextualized ``ReadError``\\ s
+    (and deadline hits) pass through untouched, as do the
+    :data:`NON_DATA_ERRORS` (missing packages, OOM — not corruption); an
+    ``OSError`` cause becomes :class:`ReadIOError` so existing ``except
+    OSError`` callers keep working.  ``kinds`` narrows what gets wrapped
+    (e.g. the device staging path wraps only ``(CorruptedError, OSError)``
+    so its routing ``ValueError``\\ s stay catchable by type)."""
+    try:
+        yield
+    except ReadError:
+        raise
+    except NON_DATA_ERRORS:
+        raise
+    except kinds as e:
+        cls = ReadIOError if isinstance(e, OSError) else ReadError
+        raise cls(str(e) or type(e).__name__, path=path, row_group=row_group,
+                  column=column,
+                  page_offset=getattr(e, "page_offset", page_offset)) from e
+
+
+# ---------------------------------------------------------------------------
+# Policy-applying source wrapper
+# ---------------------------------------------------------------------------
+class PolicySource(Source):
+    """Applies a :class:`FaultPolicy`'s retry/deadline rules to every pread
+    of the wrapped source.  Installed by ``ParquetFile(..., policy=...)``
+    (or temporarily for per-call policies); the top-level read operations
+    open an :meth:`operation` scope that starts the deadline clock and
+    collects retry counts into the caller's :class:`ReadReport`.
+
+    Thread model: chunk decodes fan out over threads *within* one top-level
+    operation, all sharing that operation's deadline — the active
+    :class:`Deadline` therefore lives on the instance, not in TLS.  While
+    operations overlap (interleaved drains, threads), preads run under the
+    MOST RECENTLY started operation's clock; retries are attributed to the
+    operation whose clock was active when the pread began."""
+
+    def __init__(self, inner: Source, policy: FaultPolicy):
+        self.inner = inner
+        self.policy = policy
+        # stack, not a saved-value swap: interleaved operations (generators
+        # closed out of order, threads) each remove only their OWN clock,
+        # so a close never drops a live sibling deadline or leaves a stale
+        # one installed.  Reads use the most recently started operation's
+        # clock; every scope gets a fresh budget (an operation nested in a
+        # paused drain must not inherit the drain's part-spent deadline).
+        self._deadline_stack: List[Deadline] = []
+        self._op_retries: Dict[int, int] = {}  # id(Deadline) -> retries
+        self._lock = threading.Lock()
+        self.retries_performed = 0
+
+    @property
+    def path(self):
+        return getattr(self.inner, "path", None)
+
+    @property
+    def _deadline(self) -> Optional[Deadline]:
+        # slice snapshot: another thread's operation() may pop the last
+        # entry between a truthiness check and an index
+        st = self._deadline_stack[-1:]
+        return st[0] if st else None
+
+    @contextmanager
+    def operation(self, report: Optional[ReadReport] = None,
+                  what: str = "read"):
+        """Top-level operation scope: starts this operation's deadline clock
+        and accounts retries into ``report``.  Retries are counted per
+        operation (keyed by its clock), not by a shared before/after delta —
+        interleaved operations must not absorb each other's retries."""
+        dl = Deadline(self.policy.deadline_s)
+        self._deadline_stack.append(dl)
+        with self._lock:
+            self._op_retries[id(dl)] = 0
+        try:
+            yield dl
+        finally:
+            st = self._deadline_stack
+            if dl in st:
+                st.remove(dl)
+            with self._lock:
+                mine = self._op_retries.pop(id(dl), 0)
+            if report is not None:
+                report.retries += mine
+
+    def _call(self, fn, offset: int, size: int):
+        dl = self._deadline
+        pol = self.policy
+        delays = pol.delays()
+        while True:
+            if dl is not None:
+                dl.check(f"pread({offset}, {size})")
+            try:
+                return fn(offset, size)
+            except OSError as e:
+                if is_corrupt_oserror(e):
+                    raise  # corruption stays loud, never retried
+                delay = next(delays, None)
+                if delay is None:
+                    raise
+                if dl is not None:
+                    rem = dl.remaining()
+                    if rem is not None and delay >= rem:
+                        # the budget can't cover the backoff: the retry is
+                        # provably never attempted — fail now, don't sleep
+                        # the remaining budget first
+                        raise DeadlineError(
+                            "deadline exceeded during retry backoff for "
+                            f"pread({offset}, {size})") from e
+                with self._lock:
+                    self.retries_performed += 1
+                    if dl is not None and id(dl) in self._op_retries:
+                        self._op_retries[id(dl)] += 1
+                if delay > 0:
+                    time.sleep(delay)
+
+    def pread(self, offset: int, size: int) -> bytes:
+        return self._call(self.inner.pread, offset, size)
+
+    def pread_view(self, offset: int, size: int):
+        return self._call(self.inner.pread_view, offset, size)
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection
+# ---------------------------------------------------------------------------
+@dataclass
+class FaultStats:
+    """What the injector actually did (chaos-test assertions)."""
+
+    preads: int = 0
+    injected_errors: int = 0
+    injected_flips: int = 0
+    injected_short_reads: int = 0
+    delayed_s: float = 0.0
+
+
+class FaultInjectingSource(Source):
+    """Deterministic, seedable chaos wrapper over any Source.
+
+    Fault draws are keyed on ``(seed, offset, size, attempt#)`` — NOT on a
+    shared RNG stream — so injection is reproducible regardless of call
+    order (thread pools included) and each *retry* of the same pread
+    re-draws deterministically.  ``max_consecutive_errors`` bounds how many
+    times in a row one pread can fail, guaranteeing that a retry policy
+    with ``max_retries >= max_consecutive_errors`` always recovers.
+
+    Modes (all composable):
+
+    - ``error_rate`` — probability a pread raises a transient
+      ``OSError(EIO)`` before touching the inner source.
+    - ``latency_s`` — fixed sleep added to every pread (drive deadlines).
+    - ``flip_offsets`` / ``flip_mask`` — bytes at these absolute file
+      offsets come back XOR'd (targeted, persistent corruption: the
+      bit-flipped-row-group acceptance case).
+    - ``bit_flip_rate`` — probability a pread flips one deterministic bit
+      of its result (random corruption; persistent per (offset, size)).
+    - ``truncate_at`` — the file appears to end here: reads past it raise
+      the non-retryable ``short read`` IOError (torn upload / partial
+      object).
+    - ``short_read_rate`` — probability a pread returns *fewer bytes than
+      asked*, violating the Source contract the way a buggy FUSE layer
+      does; readers must detect it as corruption, not crash.
+    """
+
+    def __init__(self, inner: Source, seed: int = 0, error_rate: float = 0.0,
+                 max_consecutive_errors: Optional[int] = None,
+                 latency_s: float = 0.0,
+                 flip_offsets=(), flip_mask: int = 0xFF,
+                 bit_flip_rate: float = 0.0,
+                 truncate_at: Optional[int] = None,
+                 short_read_rate: float = 0.0):
+        self.inner = inner
+        self.seed = seed
+        self.error_rate = error_rate
+        self.max_consecutive_errors = max_consecutive_errors
+        self.latency_s = latency_s
+        self.flip_offsets = sorted(set(flip_offsets))
+        self.flip_mask = flip_mask
+        self.bit_flip_rate = bit_flip_rate
+        self.truncate_at = truncate_at
+        self.short_read_rate = short_read_rate
+        self.stats = FaultStats()
+        self._attempts: Dict[Tuple[int, int], int] = {}
+        self._consecutive: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def path(self):
+        return getattr(self.inner, "path", None)
+
+    def _rng(self, offset: int, size: int, attempt: int) -> random.Random:
+        # splitmix64-style mixing: similar (offset, size) keys must land on
+        # uncorrelated Mersenne states (tuple-hash seeding clusters badly —
+        # nearby seeds give nearby first draws), and tuple seeds are gone
+        # in Python 3.11 anyway
+        h = 0x9E3779B97F4A7C15
+        for p in (self.seed, offset, size, attempt):
+            h ^= p & 0xFFFFFFFFFFFFFFFF
+            h = (h * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+            h ^= h >> 31
+        return random.Random(h)
+
+    def _read(self, fn, offset: int, size: int):
+        with self._lock:
+            self.stats.preads += 1
+            key = (offset, size)
+            attempt = self._attempts.get(key, 0)
+            self._attempts[key] = attempt + 1
+            consecutive = self._consecutive.get(key, 0)
+        rng = self._rng(offset, size, attempt)
+        if self.latency_s:
+            time.sleep(self.latency_s)
+            with self._lock:
+                self.stats.delayed_s += self.latency_s
+        if (self.error_rate and rng.random() < self.error_rate
+                and (self.max_consecutive_errors is None
+                     or consecutive < self.max_consecutive_errors)):
+            with self._lock:
+                self.stats.injected_errors += 1
+                self._consecutive[key] = consecutive + 1
+            raise OSError(errno.EIO,
+                          f"injected transient I/O error (attempt {attempt})")
+        with self._lock:
+            self._consecutive[key] = 0
+        if self.truncate_at is not None and offset + size > self.truncate_at:
+            got = max(0, self.truncate_at - offset)
+            raise IOError(f"short read at {offset}: wanted {size}, got {got} "
+                          "(injected truncation)")
+        data = fn(offset, size)
+        flips = [o for o in self.flip_offsets if offset <= o < offset + size]
+        # random per-read flips are keyed on attempt 0 so re-reads of the
+        # same span see the SAME corruption (persistent, like real rot)
+        rng0 = self._rng(offset, size, 0)
+        rand_flip = (self.bit_flip_rate and size > 0
+                     and rng0.random() < self.bit_flip_rate)
+        if flips or rand_flip:
+            buf = bytearray(data)
+            for o in flips:
+                buf[o - offset] ^= self.flip_mask
+            if rand_flip:
+                buf[rng0.randrange(size)] ^= 1 << rng0.randrange(8)
+            with self._lock:
+                self.stats.injected_flips += len(flips) + bool(rand_flip)
+            data = bytes(buf)
+        if (self.short_read_rate and size > 1
+                and rng.random() < self.short_read_rate):
+            with self._lock:
+                self.stats.injected_short_reads += 1
+            data = data[:rng.randrange(1, size)]
+        return data
+
+    def pread(self, offset: int, size: int) -> bytes:
+        out = self._read(self.inner.pread, offset, size)
+        return bytes(out) if not isinstance(out, bytes) else out
+
+    def pread_view(self, offset: int, size: int):
+        # any byte-mutating mode forces the copying path (views would leak
+        # the pristine bytes); otherwise keep the inner zero-copy view
+        if (self.flip_offsets or self.bit_flip_rate or self.short_read_rate):
+            return self._read(self.inner.pread, offset, size)
+        return self._read(self.inner.pread_view, offset, size)
+
+    def size(self) -> int:
+        n = self.inner.size()
+        return n if self.truncate_at is None else min(n, self.truncate_at)
+
+    def close(self) -> None:
+        self.inner.close()

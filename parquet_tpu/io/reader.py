@@ -31,8 +31,21 @@ from .column import Column, concat_columns
 from .source import Source, as_source
 
 
-from ..errors import (CorruptedError, MAX_COLUMN_INDEX_SIZE,  # noqa: F401
-                      MAX_PAGE_HEADER_SIZE, MAX_PAGE_SIZE)  # re-exported: historical home of the class
+from ..errors import (CorruptedError, DeadlineError,  # noqa: F401
+                      MAX_COLUMN_INDEX_SIZE,  # re-exported: historical home
+                      MAX_PAGE_HEADER_SIZE, MAX_PAGE_SIZE, ReadError)
+from .faults import (FaultPolicy, PolicySource, ReadReport, read_context,
+                     resolve_policy)
+
+
+def _corrupt(msg: str, page_offset: Optional[int] = None) -> CorruptedError:
+    """CorruptedError tagged with the failing page's absolute offset; the
+    resilience layer's :func:`read_context` lifts the tag into the
+    :class:`ReadError` it raises, so every surfaced failure is locatable."""
+    e = CorruptedError(msg)
+    if page_offset is not None:
+        e.page_offset = page_offset
+    return e
 
 
 @dataclass
@@ -76,8 +89,8 @@ def _checked_page_size(header: md.PageHeader, at: int) -> int:
     """Shared page-size sanity check for the three page iterators."""
     clen = header.compressed_page_size
     if not 0 <= clen <= MAX_PAGE_SIZE:
-        raise CorruptedError(
-            f"page at {at}: compressed size {clen} out of range")
+        raise _corrupt(
+            f"page at {at}: compressed size {clen} out of range", at)
     return clen
 
 
@@ -146,11 +159,12 @@ class ColumnChunkReader:
             try:
                 header, data_pos = thrift.deserialize(md.PageHeader, raw, pos)
             except Exception as e:
-                raise CorruptedError(f"bad page header at {start+pos}: {e}") from e
+                raise _corrupt(f"bad page header at {start+pos}: {e}",
+                               start + pos) from e
             clen = _checked_page_size(header, start + pos)
             payload = raw[data_pos : data_pos + clen]
             if len(payload) != clen:
-                raise CorruptedError("truncated page payload")
+                raise _corrupt("truncated page payload", start + pos)
             page = PageInfo(header=header, payload=payload, offset=start + pos)
             if page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
                 values_seen += page.num_values
@@ -170,9 +184,10 @@ class ColumnChunkReader:
         for row in desc.tolist():
             clen = row[PG_COMP]
             if not 0 <= clen <= MAX_PAGE_SIZE:
-                raise CorruptedError(
+                raise _corrupt(
                     f"page at {start + row[PG_HEADER_POS]}: "
-                    f"compressed size {clen} out of range")
+                    f"compressed size {clen} out of range",
+                    start + row[PG_HEADER_POS])
             pt = row[PG_TYPE]
             h = md.PageHeader(
                 type=pt, uncompressed_page_size=row[PG_UNCOMP],
@@ -258,7 +273,7 @@ class ColumnChunkReader:
                         return
                     clen = _checked_page_size(header, start + pos)
                     if pos + data_pos + clen > size:
-                        raise CorruptedError("truncated page payload")
+                        raise _corrupt("truncated page payload", start + pos)
                     if len(view) >= data_pos + clen:
                         # the whole claimed page was visible and the
                         # scanner still refused it (bad uncompressed size,
@@ -296,8 +311,9 @@ class ColumnChunkReader:
                 except Exception as e:
                     if len(buf) - boff >= min(MAX_PAGE_HEADER_SIZE,
                                               size - pos):
-                        raise CorruptedError(
-                            f"bad page header at {start+pos}: {e}") from e
+                        raise _corrupt(
+                            f"bad page header at {start+pos}: {e}",
+                            start + pos) from e
                     buf = src.pread(start + pos,
                                     min(max(window, (len(buf) - boff) * 4),
                                         size - pos))
@@ -307,13 +323,13 @@ class ColumnChunkReader:
             if pos + hdr_len + clen > size:
                 # a payload running past the chunk would silently read the
                 # NEXT chunk's bytes here — same corruption pages() detects
-                raise CorruptedError("truncated page payload")
+                raise _corrupt("truncated page payload", start + pos)
             if data_pos + clen <= len(buf):
                 payload = memoryview(buf)[data_pos : data_pos + clen]
             else:
                 payload = src.pread(start + pos + hdr_len, clen)
             if len(payload) != clen:
-                raise CorruptedError("truncated page payload")
+                raise _corrupt("truncated page payload", start + pos)
             page = PageInfo(header=header, payload=payload, offset=start + pos)
             if page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
                 values_seen += page.num_values
@@ -332,11 +348,12 @@ class ColumnChunkReader:
             try:
                 header, data_pos = thrift.deserialize(md.PageHeader, raw, pos)
             except Exception as e:
-                raise CorruptedError(f"bad page header at {offset+pos}: {e}") from e
+                raise _corrupt(f"bad page header at {offset+pos}: {e}",
+                               offset + pos) from e
             clen = _checked_page_size(header, offset + pos)
             payload = raw[data_pos : data_pos + clen]
             if len(payload) != clen:
-                raise CorruptedError("truncated page payload")
+                raise _corrupt("truncated page payload", offset + pos)
             yield PageInfo(header=header, payload=payload, offset=offset + pos)
             yielded += 1
             pos = data_pos + clen
@@ -352,7 +369,7 @@ class ColumnChunkReader:
         (limits.go MaxColumnIndexSize analog); a missing or out-of-range
         length with the offset present is corruption, not a crash."""
         if length is None or not 0 <= length <= MAX_COLUMN_INDEX_SIZE:
-            raise CorruptedError(f"{what} length {length} out of range")
+            raise _corrupt(f"{what} length {length} out of range", offset)
         return self.file.source.pread(offset, length)
 
     def column_index(self) -> Optional[md.ColumnIndex]:
@@ -364,7 +381,11 @@ class ColumnChunkReader:
             return None
         raw = self._read_index_blob(c.column_index_offset,
                                     c.column_index_length, "column index")
-        ci, _ = thrift.deserialize(md.ColumnIndex, raw)
+        try:
+            ci, _ = thrift.deserialize(md.ColumnIndex, raw)
+        except Exception as e:
+            raise _corrupt(f"bad column index: {e}",
+                           c.column_index_offset) from e
         self._ci = ci
         return ci
 
@@ -377,7 +398,11 @@ class ColumnChunkReader:
             return None
         raw = self._read_index_blob(c.offset_index_offset,
                                     c.offset_index_length, "offset index")
-        oi, _ = thrift.deserialize(md.OffsetIndex, raw)
+        try:
+            oi, _ = thrift.deserialize(md.OffsetIndex, raw)
+        except Exception as e:
+            raise _corrupt(f"bad offset index: {e}",
+                           c.offset_index_offset) from e
         self._oi = oi
         return oi
 
@@ -439,10 +464,33 @@ class ParquetFile:
     """Reference parity: file.go — File/OpenFile (magic check both ends,
     thrift footer decode, lazy page-index/bloom access)."""
 
-    def __init__(self, source, options: Optional[ReadOptions] = None):
+    def __init__(self, source, options: Optional[ReadOptions] = None,
+                 policy: Optional[FaultPolicy] = None):
         self.options = options or ReadOptions()
+        self.policy = policy
         self._chunk_cache = {}
         self.source: Source = as_source(source)
+        if policy is not None:
+            # every pread from any layer (footer, page streams, indexes,
+            # blooms) now retries transient OSErrors per the policy and
+            # honors the active operation deadline
+            self.source = PolicySource(self.source, policy)
+        self._base_source = self.source  # per-call overrides revert to this
+        self._override_stack: List[Source] = []
+        try:
+            with self._resilient_op(None, None, "open"), \
+                    read_context(path=self._path,
+                                 kinds=(CorruptedError, OSError)):
+                self._open_footer()
+        except BaseException:
+            # a failed open must not leak the fd (FileSource has no
+            # finalizer, and the flaky-mount retry loops this layer exists
+            # for would otherwise exhaust the process fd limit)
+            self.source.close()
+            raise
+        counters.inc("files_opened")
+
+    def _open_footer(self) -> None:
         size = self.source.size()
         if size < 12:
             raise CorruptedError(f"file too small ({size} bytes) to be parquet")
@@ -467,7 +515,65 @@ class ParquetFile:
         if self.metadata.schema in (None, []):
             raise CorruptedError("footer has no schema")
         self.schema = Schema.from_elements(self.metadata.schema)
-        counters.inc("files_opened")
+
+    # ---------------------------------------------------------- resilience
+    @property
+    def _path(self) -> Optional[str]:
+        """File path for error context (None for in-memory sources)."""
+        return getattr(self.source, "path", None)
+
+    def _resilient_op(self, policy: Optional[FaultPolicy],
+                      report: Optional[ReadReport], what: str = "read"):
+        """Scope for one top-level read operation: ensures ``self.source``
+        applies the effective policy (the open-time one, or a per-call
+        override temporarily installed — chunk readers resolve
+        ``self.file.source`` at call time, so the install covers every
+        layer), starts the deadline clock, and collects retry counts into
+        ``report``.
+
+        Per-call overrides keep a stack (not a saved-source swap): two
+        interleaved operations — generators closed out of order, threads —
+        each remove only their own wrapper, so ``self.source`` always
+        reverts to a live wrapper or the open-time source, never to a stale
+        one.  While overrides overlap, reads of both operations run under
+        the most recently installed policy (instance-level by design)."""
+        import contextlib
+
+        pol = policy if policy is not None else self.policy
+
+        @contextlib.contextmanager
+        def scope():
+            if pol is None:
+                yield None
+                return
+            base = self._base_source
+            if isinstance(base, PolicySource) and base.policy is pol \
+                    and self.source is base:
+                with base.operation(report, what) as dl:
+                    yield dl
+                return
+            inner = base.inner if isinstance(base, PolicySource) else base
+            tmp = PolicySource(inner, pol)
+            self._override_stack.append(tmp)
+            self.source = tmp
+            try:
+                with tmp.operation(report, what) as dl:
+                    yield dl
+            finally:
+                st = self._override_stack
+                if tmp in st:
+                    st.remove(tmp)
+                self.source = st[-1] if st else base
+
+        return scope()
+
+    def _decode_chunk_ctx(self, chunk: "ColumnChunkReader") -> "Column":
+        """Host chunk decode with structured error context — any low-level
+        failure surfaces as a :class:`ReadError` naming file, row group,
+        column, and (when known) page offset."""
+        with read_context(path=self._path, row_group=chunk.rg_index,
+                          column=chunk.leaf.dotted_path):
+            return decode_chunk_host(chunk)
 
     # ------------------------------------------------------------------
     @property
@@ -518,20 +624,28 @@ class ParquetFile:
     # ------------------------------------------------------------------
     def iter_batches(self, columns: Optional[Sequence[str]] = None,
                      batch_rows: int = 65536,
-                     strict_batch_rows: bool = False):
+                     strict_batch_rows: bool = False,
+                     policy: Optional[FaultPolicy] = None,
+                     report: Optional[ReadReport] = None):
         """Bounded-memory streaming read: yield row-aligned :class:`Table`
         batches holding O(pages-per-batch) memory — the reference's
         ``PageBufferSize`` + ``GenericReader.Read`` streaming mode
         (see io/stream.py; batch sizes vary at row-group boundaries unless
-        ``strict_batch_rows=True``)."""
+        ``strict_batch_rows=True``).  ``policy``/``report`` thread the
+        resilience layer through the stream (io/faults.py): retries and the
+        drain-wide deadline at the source, ``skip_row_group`` dropping the
+        un-yielded remainder of a corrupt row group."""
         from .stream import iter_batches as _iter
 
         return _iter(self, columns=columns, batch_rows=batch_rows,
-                     strict_batch_rows=strict_batch_rows)
+                     strict_batch_rows=strict_batch_rows, policy=policy,
+                     report=report)
 
     def read(self, columns: Optional[Sequence[str]] = None,
              device: bool = False,
-             row_groups: Optional[Sequence[int]] = None) -> "Table":
+             row_groups: Optional[Sequence[int]] = None,
+             policy: Optional[FaultPolicy] = None,
+             report: Optional[ReadReport] = None) -> "Table":
         """Read and decode the whole file.
 
         ``device=False``: host numpy oracle path.  ``device=True``: the TPU
@@ -539,7 +653,27 @@ class ParquetFile:
         ``row_groups`` selects a subset by index (reference parity: callers
         of ``File.RowGroups()`` read chosen groups; also the unit the mesh
         shards over).
+
+        ``policy`` (default: the open-time policy) applies the resilience
+        layer: transient preads retry with jittered backoff, the whole call
+        runs under ``deadline_s``, and ``on_corrupt='skip_row_group'``
+        returns a valid partial Table of the intact row groups (host path;
+        the device pipeline raises on corruption).  Pass ``report`` (a
+        :class:`~parquet_tpu.io.faults.ReadReport`) to collect rows read/
+        dropped, skipped row-group ordinals, and retry counts.
         """
+        pol, report = resolve_policy(self, policy, report)
+        if pol is not None or report is not None:
+            with self._resilient_op(policy, report):
+                t = self._read_impl(columns, device, row_groups, pol, report)
+            report.rows_read += t.num_rows
+            t.report = report
+            return t
+        return self._read_impl(columns, device, row_groups, None, None)
+
+    def _read_impl(self, columns, device, row_groups,
+                   pol: Optional[FaultPolicy],
+                   report: Optional[ReadReport]) -> "Table":
         leaves = _select_leaves(self.schema, columns)
         all_rg = range(len(self.metadata.row_groups or []))
         if row_groups is None:
@@ -560,6 +694,15 @@ class ParquetFile:
             return Table(self.schema,
                          {leaf.dotted_path: empty_column(leaf)
                           for leaf in leaves}, 0)
+        if pol is not None and pol.skip_corrupt:
+            if device:
+                # the device pipeline's batched generator can't resume past
+                # a poisoned chunk — refuse loudly rather than silently
+                # downgrading a clean device read to the host decode path
+                raise ValueError(
+                    "on_corrupt='skip_row_group' is not supported with "
+                    "device=True; read on host, or use on_corrupt='raise'")
+            return self._read_degraded(leaves, rg_sel, report)
         if device:
             # double-buffered pipeline across every (leaf, row-group) chunk:
             # host prescan + H2D of later chunks overlaps device decode of
@@ -569,7 +712,14 @@ class ParquetFile:
             chunks = [self.row_group(i).column(leaf.column_index)
                       for leaf in leaves for i in rg_sel]
             decoded = decode_chunks_pipelined(chunks)
-            dparts = {leaf.dotted_path: [next(decoded) for _ in range(n_rg)]
+
+            def _pull(chunk):  # per-chunk error context for the pipeline
+                with read_context(path=self._path, row_group=chunk.rg_index,
+                                  column=chunk.leaf.dotted_path):
+                    return next(decoded)
+
+            it = iter(chunks)
+            dparts = {leaf.dotted_path: [_pull(next(it)) for _ in range(n_rg)]
                       for leaf in leaves}
             return Table(self.schema, None, total_rows, parts=dparts)
         # Large files route through the streaming cursors: windowed 1 MB
@@ -591,12 +741,19 @@ class ParquetFile:
         if (row_groups is None and total_sel > _STREAMED_READ_BYTES
                 and os.environ.get("PARQUET_TPU_READ_STREAMED", "1")
                 not in ("0",)):
+            # policy reads keep this route (the flaky-mount + big-file case
+            # is exactly what it exists for): the caller's operation scope
+            # is already active, so drive the stream internals directly —
+            # no nested deadline scope, no double rows_read accounting.
+            # (skip_corrupt was dispatched to _read_degraded above.)
+            from .stream import _iter_batches_impl
+
             paths = list(dict.fromkeys(leaf.dotted_path for leaf in leaves))
             parts: Dict[str, List[Column]] = {p: [] for p in paths}
             got_rows = 0
-            for batch in self.iter_batches(columns=paths
-                                           if columns is not None else None,
-                                           batch_rows=1 << 20):
+            for batch in _iter_batches_impl(self, paths, 1 << 20,
+                                            strict_batch_rows=False,
+                                            skip=False, report=None):
                 bp = batch._parts if batch._parts is not None else {
                     p: [c] for p, c in batch._columns.items()}
                 for p in paths:
@@ -626,7 +783,7 @@ class ParquetFile:
                 and total_rows * len(leaves) >= 2_000_000):
             from ..utils.pool import submit as pool_submit
 
-            futs = {leaf.dotted_path: [pool_submit(decode_chunk_host, c)
+            futs = {leaf.dotted_path: [pool_submit(self._decode_chunk_ctx, c)
                                        for c in per_leaf]
                     for leaf, per_leaf in zip(leaves, chunks)}
             parts = {p: [f.result() for f in fs] for p, fs in futs.items()}
@@ -637,10 +794,48 @@ class ParquetFile:
             # instead of overlapping disk wait — 15.0 s vs 10.3 s on the
             # 2.7 GB lineitem read.  Multi-core hosts already overlap via
             # the pool branch above.)
-            parts = {leaf.dotted_path: [decode_chunk_host(c)
+            parts = {leaf.dotted_path: [self._decode_chunk_ctx(c)
                                         for c in per_leaf]
                      for leaf, per_leaf in zip(leaves, chunks)}
         return Table(self.schema, None, total_rows, parts=parts,
+                     dict_fields=self.arrow_dictionary_fields)
+
+    def _read_degraded(self, leaves, rg_sel, report: ReadReport) -> "Table":
+        """``on_corrupt='skip_row_group'`` host read: decode row-group-major
+        so one corrupt group drops as a unit; intact groups' rows return
+        exactly (row groups are row-aligned across columns, so the partial
+        Table stays valid).  Deadline overruns still raise — a timeout is
+        not corruption."""
+        from ..utils.pool import available_cpus, submit as pool_submit
+
+        uniq = list({l.dotted_path: l for l in leaves}.values())
+        parts: Dict[str, List[Column]] = {l.dotted_path: [] for l in uniq}
+        kept_rows = 0
+        pooled = (len(uniq) > 1 and available_cpus() > 1)
+        for i in rg_sel:
+            rg = self.row_group(i)
+            try:
+                chunk_readers = [rg.column(l.column_index) for l in uniq]
+                if pooled:
+                    futs = [pool_submit(self._decode_chunk_ctx, c)
+                            for c in chunk_readers]
+                    cols = [f.result() for f in futs]
+                else:
+                    cols = [self._decode_chunk_ctx(c) for c in chunk_readers]
+            except DeadlineError:
+                raise
+            except CorruptedError as e:
+                report.record_skip(i, rows=rg.num_rows, error=e)
+                continue
+            for l, col in zip(uniq, cols):
+                parts[l.dotted_path].append(col)
+            kept_rows += rg.num_rows
+        if kept_rows == 0:
+            from .column import empty_column
+
+            return Table(self.schema,
+                         {l.dotted_path: empty_column(l) for l in uniq}, 0)
+        return Table(self.schema, None, kept_rows, parts=parts,
                      dict_fields=self.arrow_dictionary_fields)
 
     def close(self):
@@ -686,6 +881,9 @@ class Table:
         # fields the file's embedded arrow schema declares dictionary-typed:
         # to_arrow preserves them as DictionaryArray (pyarrow's behavior)
         self._dict_fields = dict_fields
+        # populated by policy/report reads (io/faults.py ReadReport):
+        # degraded reads record skipped row groups and retry counts here
+        self.report = None
 
     @property
     def columns(self) -> Dict[str, Column]:
@@ -1079,7 +1277,8 @@ def verify_page_crc(reader: ColumnChunkReader, page: PageInfo) -> None:
     if reader.file.options.verify_crc and h.crc is not None:
         crc = zlib.crc32(page.payload) & 0xFFFFFFFF
         if crc != (h.crc & 0xFFFFFFFF):
-            raise CorruptedError(f"page CRC mismatch at offset {page.offset}")
+            raise _corrupt(f"page CRC mismatch at offset {page.offset}",
+                           page.offset)
 
 
 def decode_dictionary_page(reader: ColumnChunkReader, page: PageInfo):

@@ -183,14 +183,25 @@ class PagePlan:
 
 def plan_scan(pf: ParquetFile, path, lo=None, hi=None,
               use_bloom: bool = False,
-              values: Optional[Sequence] = None) -> List[PagePlan]:
+              values: Optional[Sequence] = None,
+              policy=None, report=None) -> List[PagePlan]:
     """Batch pushdown plan: for each surviving row group, the page ordinals
     whose zone maps intersect the predicate.
 
     ``values`` switches to IN-list semantics (``file[path] ∈ values``):
     statistics and zone maps prune against the sorted probe list, and with
     ``use_bloom`` every chunk filter is probed with the whole hashed batch at
-    once (the batched-probe path of io/bloom.py)."""
+    once (the batched-probe path of io/bloom.py).
+
+    Planning itself does IO (column-index / offset-index / bloom preads),
+    so it participates in the resilience contract: failures carry
+    file/row-group/column context, and under
+    ``policy.on_corrupt='skip_row_group'`` a row group whose index
+    structures are corrupt is skipped (recorded in ``report`` with its full
+    row count as candidate rows) instead of failing the whole scan."""
+    from ..errors import CorruptedError, DeadlineError
+    from .faults import read_context
+
     leaf = pf.schema.leaf(path) if not hasattr(path, "column_index") else path
     plans: List[PagePlan] = []
     sorted_vals = hashes = None
@@ -212,32 +223,50 @@ def plan_scan(pf: ParquetFile, path, lo=None, hi=None,
             except ValueError:
                 hashes = None  # type has no bloom encoding (e.g. BOOLEAN)
     equals = lo if lo is not None and lo == hi else None
-    for rg in pf.row_groups:
+
+    def plan_one(rg) -> Optional[PagePlan]:
         if sorted_vals is not None:
             if not prune_row_group_values(rg, leaf.column_index, sorted_vals,
                                           hashes):
-                continue
+                return None
         elif not prune_row_group(rg, leaf.column_index, lo, hi, use_bloom,
                                  equals):
-            continue
+            return None
         chunk = rg.column(leaf.column_index)
         ci = chunk.column_index()
         oi = chunk.offset_index()
         if ci is None or oi is None:
-            plans.append(PagePlan(rg.index, list(range(_npages(oi))) if oi else [],
-                                  0, rg.num_rows))
-            continue
+            return PagePlan(rg.index,
+                            list(range(_npages(oi))) if oi else [],
+                            0, rg.num_rows)
         ords = (pages_overlapping_values(ci, leaf, sorted_vals)
                 if sorted_vals is not None
                 else pages_overlapping(ci, leaf, lo, hi))
         if not ords:
-            continue
+            return None
         locs = oi.page_locations
         first_row = locs[ords[0]].first_row_index
         last = ords[-1]
         end_row = (locs[last + 1].first_row_index if last + 1 < len(locs)
                    else rg.num_rows)
-        plans.append(PagePlan(rg.index, ords, first_row, end_row - first_row))
+        return PagePlan(rg.index, ords, first_row, end_row - first_row)
+
+    for rg in pf.row_groups:
+        try:
+            with read_context(path=pf._path, row_group=rg.index,
+                              column=leaf.dotted_path,
+                              kinds=(CorruptedError, OSError)):
+                plan = plan_one(rg)
+        except DeadlineError:
+            raise
+        except CorruptedError as e:
+            if policy is not None and policy.skip_corrupt:
+                if report is not None:
+                    report.record_skip(rg.index, rows=rg.num_rows, error=e)
+                continue
+            raise
+        if plan is not None:
+            plans.append(plan)
     return plans
 
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 import io
 import os
 import threading
+import time
 from typing import Union
 
 import numpy as np
@@ -38,13 +39,20 @@ class FileSource(Source):
         self._fd = os.open(path, os.O_RDONLY)
         self._size = os.fstat(self._fd).st_size
 
+    def _checked_fd(self) -> int:
+        fd = self._fd
+        if fd is None:
+            raise ValueError(f"read on closed source {self.path!r}")
+        return fd
+
     def pread(self, offset: int, size: int) -> bytes:
+        fd = self._checked_fd()
         # POSIX pread may return fewer bytes than requested without being at
         # EOF (signals, NFS): accumulate until full or truly short
         parts = []
         got = 0
         while got < size:
-            chunk = os.pread(self._fd, size - got, offset + got)
+            chunk = os.pread(fd, size - got, offset + got)
             if not chunk:
                 raise IOError(
                     f"short read at {offset}: wanted {size}, got {got}")
@@ -55,11 +63,12 @@ class FileSource(Source):
     def pread_view(self, offset: int, size: int) -> np.ndarray:
         """Read straight into a numpy buffer — one copy (kernel→array)
         instead of pread's kernel→bytes→join."""
+        fd = self._checked_fd()
         buf = np.empty(size, np.uint8)
         mv = memoryview(buf)
         got = 0
         while got < size:
-            n = os.preadv(self._fd, [mv[got:]], offset + got)
+            n = os.preadv(fd, [mv[got:]], offset + got)
             if n <= 0:
                 raise IOError(
                     f"short read at {offset}: wanted {size}, got {got}")
@@ -70,9 +79,18 @@ class FileSource(Source):
         return self._size
 
     def close(self) -> None:
+        # idempotent: double-close is a no-op, not an EBADF crash
         if self._fd is not None:
             os.close(self._fd)
             self._fd = None
+
+
+def _check_read_args(offset: int, size: int) -> None:
+    """Reject negative offsets/sizes: a negative offset silently slices from
+    the END of a python buffer and returns wrong bytes."""
+    if offset < 0 or size < 0:
+        raise IOError(f"invalid read: offset={offset} size={size} "
+                      "(negative offsets/sizes are corruption, not wrap-around)")
 
 
 class BytesSource(Source):
@@ -80,12 +98,14 @@ class BytesSource(Source):
         self._data = memoryview(data)
 
     def pread(self, offset: int, size: int) -> bytes:
+        _check_read_args(offset, size)
         out = self._data[offset : offset + size]
         if len(out) != size:
             raise IOError(f"short read at {offset}")
         return bytes(out)
 
     def pread_view(self, offset: int, size: int):
+        _check_read_args(offset, size)
         out = self._data[offset : offset + size]
         if len(out) != size:
             raise IOError(f"short read at {offset}")
@@ -111,9 +131,12 @@ class FileLikeSource(Source):
         self._size = f.tell()
 
     def pread(self, offset: int, size: int) -> bytes:
+        f = self._f
+        if f is None:
+            raise ValueError("read on closed source")
         with self._lock:
-            self._f.seek(offset)
-            out = self._f.read(size)
+            f.seek(offset)
+            out = f.read(size)
         if len(out) != size:
             raise IOError(f"short read at {offset}")
         return out
@@ -121,34 +144,69 @@ class FileLikeSource(Source):
     def size(self) -> int:
         return self._size
 
+    def close(self) -> None:
+        # idempotent; closes the wrapped file object (the wrapper owns the
+        # read position anyway — nobody else can use it concurrently).
+        # Taken under the lock so an in-flight pread finishes its seek+read
+        # before the underlying file goes away.
+        with self._lock:
+            f = self._f
+            if f is not None:
+                self._f = None
+                f.close()
+
 
 class RetryingSource(Source):
     """Bounded-retry wrapper over any Source — the retryable-host-IO analog
     of SURVEY.md §5 (flaky network filesystems / object-store FUSE mounts).
 
-    Retries transient ``OSError``s with exponential backoff; short reads at
-    true EOF are not transient and propagate immediately (``IOError`` raised
-    with "short read" is not retried to keep corruption loud)."""
+    Retries transient ``OSError``s with exponential backoff plus uniform
+    ±``jitter`` (decorrelates retry storms across concurrent readers); short
+    reads at true EOF are not transient and propagate immediately
+    (``IOError`` raised with "short read" is not retried to keep corruption
+    loud).  For retry + deadline + degraded-read semantics threaded through
+    the whole read stack, use :class:`~parquet_tpu.io.faults.FaultPolicy`
+    instead — this wrapper stays for bare-source callers.
+    """
 
     def __init__(self, inner: Source, retries: int = 3,
-                 backoff_s: float = 0.05):
+                 backoff_s: float = 0.05, jitter: float = 0.25):
         self.inner = inner
         self.retries = retries
         self.backoff_s = backoff_s
+        self.jitter = jitter
 
-    def pread(self, offset: int, size: int) -> bytes:
-        import time
+    @property
+    def path(self):
+        return getattr(self.inner, "path", None)
 
-        delay = self.backoff_s
-        for attempt in range(self.retries + 1):
+    def _retry(self, fn, offset: int, size: int):
+        from .faults import FaultPolicy, is_corrupt_oserror  # deferred:
+        # faults imports source
+
+        delays = None  # built lazily: the happy path never constructs one
+        while True:
             try:
-                return self.inner.pread(offset, size)
+                return fn(offset, size)
             except OSError as e:
-                if attempt >= self.retries or "short read" in str(e):
+                if is_corrupt_oserror(e):
+                    raise  # corruption, not transience
+                if delays is None:
+                    delays = FaultPolicy(max_retries=self.retries,
+                                         backoff_s=self.backoff_s,
+                                         jitter=self.jitter).delays()
+                delay = next(delays, None)
+                if delay is None:
                     raise
                 time.sleep(delay)
-                delay *= 2
-        raise AssertionError("unreachable")
+
+    def pread(self, offset: int, size: int) -> bytes:
+        return self._retry(self.inner.pread, offset, size)
+
+    def pread_view(self, offset: int, size: int):
+        # delegate (don't fall back to Source's copying default): keeps
+        # FileSource's zero-copy preadv path under retry
+        return self._retry(self.inner.pread_view, offset, size)
 
     def size(self) -> int:
         return self.inner.size()

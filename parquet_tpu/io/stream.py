@@ -27,9 +27,11 @@ from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..errors import CorruptedError, DeadlineError
 from ..format.enums import PageType
 from ..ops import levels as levels_ops
 from .column import Column
+from .faults import FaultPolicy, ReadReport, read_context, resolve_policy
 from .reader import (ParquetFile, Table, decode_chunk_host,
                      decode_dictionary_page, verify_page_crc)
 
@@ -176,7 +178,9 @@ def _slice_rows(piece: _PagePiece, r0: int, r1: int) -> Column:
 
 def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
                  batch_rows: int = 65536,
-                 strict_batch_rows: bool = False) -> Iterator[Table]:
+                 strict_batch_rows: bool = False,
+                 policy: Optional[FaultPolicy] = None,
+                 report: Optional[ReadReport] = None) -> Iterator[Table]:
     """Stream the file as row-aligned :class:`Table` batches of at most
     ``batch_rows`` rows, holding O(pages-per-batch) memory per column.
 
@@ -190,12 +194,28 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
     which restores exactly ``batch_rows`` rows per batch except the last
     at the cost of cross-group concatenation).  Concatenating every batch
     equals a full :meth:`ParquetFile.read`.
+
+    ``policy`` (default: the file's open-time policy) applies the
+    resilience layer (io/faults.py): source preads retry transient errors,
+    the whole drain runs under one ``deadline_s`` clock (started at the
+    first pull), and with ``on_corrupt='skip_row_group'`` a corrupt row
+    group's **un-yielded** rows are dropped — batches already yielded from
+    it stay valid — with the loss accounted in ``report``.
     """
     if batch_rows <= 0:
         raise ValueError("batch_rows must be positive")
+    pol, report = resolve_policy(pf, policy, report)
+    skip = pol is not None and pol.skip_corrupt
     leaves = [pf.schema.leaf(c) for c in columns] if columns is not None \
         else list(pf.schema.leaves)
     paths = [leaf.dotted_path for leaf in leaves]
+    with pf._resilient_op(policy, report, "iter_batches"):
+        yield from _iter_batches_impl(pf, paths, batch_rows,
+                                      strict_batch_rows, skip, report)
+
+
+def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
+                       report) -> Iterator[Table]:
     rg_iter = iter(range(len(pf.row_groups)))
     cursors: Optional[Dict[str, _ChunkCursor]] = None
     rg_rows_left = 0
@@ -211,6 +231,9 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
         t = Table(pf.schema, None, pending_rows,
                   parts={p: list(parts) for p, parts in pending.items()},
                   dict_fields=pf.arrow_dictionary_fields)
+        if report is not None:
+            report.rows_read += pending_rows
+            t.report = report
         pending = {p: [] for p in paths}
         pending_rows = 0
         return t
@@ -224,13 +247,31 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
             cursors = {p: _ChunkCursor(chunk=rg.column(p)) for p in paths}
             rg_rows_left = rg.num_rows
         take = min(batch_rows - pending_rows, rg_rows_left)
-        for p in paths:
-            pieces, got = cursors[p].take(take)
-            if got != take:
-                raise RuntimeError(
-                    f"column {p!r}: streaming cursor yielded {got} of {take} "
-                    "rows (page stream shorter than row-group metadata)")
-            pending[p].extend(pieces)
+        # snapshot so a mid-take corruption can roll back this step's
+        # partial, column-misaligned contributions
+        marks = {p: len(pending[p]) for p in paths}
+        try:
+            for p in paths:
+                with read_context(path=pf._path, row_group=rg_index,
+                                  column=p):
+                    pieces, got = cursors[p].take(take)
+                    if got != take:
+                        raise CorruptedError(
+                            f"streaming cursor yielded {got} of {take} rows "
+                            "(page stream shorter than row-group metadata)")
+                    pending[p].extend(pieces)
+        except DeadlineError:
+            raise
+        except CorruptedError as e:
+            if not skip:
+                raise
+            for p in paths:
+                del pending[p][marks[p]:]
+            # rows of this group already yielded (or aligned in pending from
+            # earlier steps) decoded fine and stay; only the remainder drops
+            report.record_skip(rg_index, rows=rg_rows_left, error=e)
+            rg_rows_left = 0
+            continue
         pending_rows += take
         rg_rows_left -= take
         # Flush at row-group boundaries too (batches are "at most

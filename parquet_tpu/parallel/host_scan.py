@@ -18,6 +18,9 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..errors import CorruptedError, DeadlineError
+from ..io.faults import (FaultPolicy, ReadReport, read_context,
+                         resolve_policy)
 from ..io.reader import ParquetFile
 from ..io.search import BA_ARRAYS, plan_scan, read_row_range
 
@@ -50,7 +53,9 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                   columns: Optional[Sequence[str]] = None,
                   num_threads: Optional[int] = None,
                   use_bloom: bool = True,
-                  values: Optional[Sequence] = None) -> Dict[str, np.ndarray]:
+                  values: Optional[Sequence] = None,
+                  policy: Optional[FaultPolicy] = None,
+                  report: Optional[ReadReport] = None) -> Dict[str, np.ndarray]:
     """Scan ``columns`` for rows where ``lo <= file[path] <= hi`` — or, with
     ``values``, where ``file[path] ∈ values`` (IN-list pushdown: statistics,
     zone maps and bloom filters all prune against the probe set; bloom
@@ -68,7 +73,33 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     (nested columns have no single row-aligned array to mask; read them via
     :func:`read_row_range` per surviving span instead) — the default
     selection takes every flat column.
+
+    ``policy`` (default: the file's open-time policy) applies the
+    resilience layer (io/faults.py): span reads retry transient errors,
+    the whole scan runs under ``deadline_s``, and with
+    ``on_corrupt='skip_row_group'`` a corrupt row group's candidate spans
+    drop from the result (other groups' matches still return), accounted
+    in ``report``.  Failures surface as ``ReadError`` naming
+    file/row-group/column.
     """
+    pol, report = resolve_policy(pf, policy, report)
+    with pf._resilient_op(policy, report, "scan_filtered"):
+        return _scan_filtered_impl(pf, path, lo, hi, columns, num_threads,
+                                   use_bloom, values, pol, report)
+
+
+class _SpanFailure:
+    """Sentinel for one failed (span, column) read task."""
+
+    __slots__ = ("rg_index", "error")
+
+    def __init__(self, rg_index, error):
+        self.rg_index = rg_index
+        self.error = error
+
+
+def _scan_filtered_impl(pf, path, lo, hi, columns, num_threads, use_bloom,
+                        values, pol, report) -> Dict[str, np.ndarray]:
     leaves = {leaf.dotted_path for leaf in pf.schema.leaves}
     flat = {leaf.dotted_path for leaf in pf.schema.leaves
             if leaf.max_repetition_level == 0}
@@ -86,7 +117,7 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                 "arrays — use read_row_range per plan for nested columns")
 
     plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom,
-                      values=values)
+                      values=values, policy=pol, report=report)
     rg_base = np.zeros(len(pf.row_groups), np.int64)
     np.cumsum([rg.num_rows for rg in pf.row_groups[:-1]], out=rg_base[1:])
 
@@ -105,6 +136,8 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 
     read_cols = [path] + [c for c in out_cols if c != path]
 
+    skip = pol is not None and pol.skip_corrupt
+
     def read_one(task):
         plan, c = task
         start = int(rg_base[plan.rg_index]) + plan.first_row
@@ -113,8 +146,17 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
         # per-row materialization of the full span was the scan's dominant
         # cost on string output columns.  The key column keeps the
         # materialized form (order-domain compares are per-value).
-        return read_row_range(pf, c, start, plan.row_count,
-                              aligned=True if c == path else "arrays")
+        try:
+            with read_context(path=pf._path, row_group=plan.rg_index,
+                              column=c):
+                return read_row_range(pf, c, start, plan.row_count,
+                                      aligned=True if c == path else "arrays")
+        except DeadlineError:
+            raise
+        except CorruptedError as e:
+            # captured per task (pool map would otherwise drop sibling
+            # results on the floor); re-raised or skipped below
+            return _SpanFailure(plan.rg_index, e)
 
     tasks = [(p, c) for p in plans for c in read_cols]
     # thread-pool dispatch costs ~100us/task: serial decode wins for small
@@ -133,6 +175,24 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     else:  # explicit bound: a dedicated pool honors the caller's limit
         with ThreadPoolExecutor(max_workers=num_threads) as pool:
             results = list(pool.map(_mark_pooled(read_one), tasks))
+    failures = [r for r in results if isinstance(r, _SpanFailure)]
+    if failures:
+        if not skip:
+            raise failures[0].error
+        # degraded scan: drop every span of each corrupt row group (spans
+        # are sub-row-group; partial groups would misalign key vs output
+        # columns), keep scanning the rest
+        bad = {f.rg_index for f in failures}
+        first_err = {f.rg_index: f.error for f in reversed(failures)}
+        for rg_i in sorted(bad):
+            report.record_skip(
+                rg_i, rows=sum(p.row_count for p in plans
+                               if p.rg_index == rg_i),
+                error=first_err[rg_i])
+        keep = [i for i, p in enumerate(plans) if p.rg_index not in bad]
+        results = [results[i * len(read_cols) + j] for i in keep
+                   for j in range(len(read_cols))]
+        plans = [plans[i] for i in keep]
     spans = [{c: results[i * len(read_cols) + j] for j, c in enumerate(read_cols)}
              for i in range(len(plans))]
 
@@ -228,6 +288,8 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
         else:
             dt = pf.schema.leaf(c).np_dtype()
             out[c] = np.empty(0, dt or np.uint8)
+    if report is not None and out_cols:
+        report.rows_read += len(out[out_cols[0]])
     return out
 
 
@@ -239,7 +301,9 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
 def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
                columns: Optional[Sequence[str]] = None,
                use_bloom: bool = True, devices: Optional[Sequence] = None,
-               values: Optional[Sequence] = None):
+               values: Optional[Sequence] = None,
+               policy: Optional[FaultPolicy] = None,
+               report: Optional[ReadReport] = None):
     """Pushdown plan + host prescan + H2D staging for a device scan.
 
     Split from :func:`scan_filtered_device` so callers (and the benchmark)
@@ -248,7 +312,22 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
     ``devices`` stages surviving span i onto ``devices[i % len(devices)]``
     (the sharded scan's round-robin placement); default is jax's default
     device for everything.
+
+    ``policy``/``report`` apply the resilience layer to the *staging*
+    phase, where all file IO happens: preads retry under the policy, and
+    ``on_corrupt='skip_row_group'`` drops the spans of a corrupt row group
+    at stage time (recorded in ``report``) instead of failing the scan.
+    Device-route refusals (``ValueError: ... use the host scan``) are
+    routing signals, not corruption, and always propagate unchanged.
     """
+    pol, report = resolve_policy(pf, policy, report)
+    with pf._resilient_op(policy, report, "stage_scan"):
+        return _stage_scan_impl(pf, path, lo, hi, columns, use_bloom,
+                                devices, values, pol, report)
+
+
+def _stage_scan_impl(pf, path, lo, hi, columns, use_bloom, devices, values,
+                     pol, report):
     import contextlib
 
     import jax
@@ -288,47 +367,71 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
     # predicate + device gather); plain-encoded chunks are rejected per
     # chunk below
     plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom,
-                      values=values)
+                      values=values, policy=pol, report=report)
     from ..algebra.compare import normalize_probe
 
     probe = (sorted({normalize_probe(key_leaf, v) for v in values} - {None})
              if values is not None else None)
     rg_base = np.zeros(len(pf.row_groups), np.int64)
     np.cumsum([rg.num_rows for rg in pf.row_groups[:-1]], out=rg_base[1:])
+    skip = pol is not None and pol.skip_corrupt
+    failed_rgs: Dict[int, object] = {}
     spans = []
     jit_cache: Dict[tuple, object] = {}
     for si, plan in enumerate(plans):
+        if plan.rg_index in failed_rgs:
+            continue
         rg = pf.row_group(plan.rg_index)
         row_start, row_end = plan.first_row, plan.first_row + plan.row_count
         per_col = {}
         ctx = (jax.default_device(devices[si % len(devices)]) if devices
                else contextlib.nullcontext())
-        with ctx:
-            for c in [path] + out_cols:
-                chunk = rg.column(c)
-                pages, first = pages_and_base(chunk, row_start, row_end)
-                try:
-                    dplan = dr.build_plan(chunk, pages=iter(pages))
-                    if (chunk.leaf.physical_type == Type.BYTE_ARRAY
-                            and dplan.value_kind != "dict"):
-                        if c == path:
+        try:
+            with ctx:
+                for c in [path] + out_cols:
+                    # kinds narrows the wrap to IO/decode failures — the
+                    # device-route refusal ValueErrors below pass through
+                    # unwrapped, keeping their type for scan()'s host
+                    # fallback
+                    with read_context(path=pf._path,
+                                      row_group=plan.rg_index, column=c,
+                                      kinds=(CorruptedError, OSError)):
+                        chunk = rg.column(c)
+                        pages, first = pages_and_base(chunk, row_start,
+                                                      row_end)
+                        try:
+                            dplan = dr.build_plan(chunk, pages=iter(pages))
+                            unsupported = (
+                                chunk.leaf.physical_type == Type.BYTE_ARRAY
+                                and dplan.value_kind != "dict")
+                            if not unsupported:
+                                staged = dr.stage_plan(dplan)
+                        except dr._Unsupported as e:
                             raise ValueError(
-                                f"device scan key {c!r}: plain-encoded "
-                                "BYTE_ARRAY has no row-aligned device "
-                                "form; use the host scan")
-                        # plain-string OUTPUT column: keep it host-resident
-                        # (slot-aligned ragged pair); the device filters on
-                        # the key and only SURVIVORS' bytes materialize —
-                        # the same survivor-only rule as the host scan
-                        per_col[c] = ("host_ragged",) + _host_ragged_span(
-                            pf, c, rg_base, plan)
-                        continue
-                    staged = dr.stage_plan(dplan)
-                except dr._Unsupported as e:
-                    raise ValueError(
-                        f"device scan column {c!r}: {e}; use the host scan "
-                        "(scan_filtered)") from None
-                per_col[c] = (chunk, dplan, staged, row_start - first)
+                                f"device scan column {c!r}: {e}; use the "
+                                "host scan (scan_filtered)") from None
+                        if unsupported:
+                            if c == path:
+                                raise ValueError(
+                                    f"device scan key {c!r}: plain-encoded "
+                                    "BYTE_ARRAY has no row-aligned device "
+                                    "form; use the host scan")
+                            # plain-string OUTPUT column: keep it
+                            # host-resident (slot-aligned ragged pair); the
+                            # device filters on the key and only SURVIVORS'
+                            # bytes materialize — the same survivor-only
+                            # rule as the host scan
+                            per_col[c] = ("host_ragged",) + _host_ragged_span(
+                                pf, c, rg_base, plan)
+                            continue
+                        per_col[c] = (chunk, dplan, staged, row_start - first)
+        except DeadlineError:
+            raise
+        except CorruptedError as e:
+            if not skip:
+                raise
+            failed_rgs[plan.rg_index] = e
+            continue
         fused = None
         if all(per_col[c][0] != "host_ragged"
                and per_col[c][1].value_kind != "dict"
@@ -345,6 +448,12 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
             fused = _FusedFactory(jit_cache, sig, path, out_cols, per_col,
                                   lo, hi, probe, plan.row_count)
         spans.append((plan, per_col, fused))
+    if failed_rgs:
+        for rg_i, e in sorted(failed_rgs.items()):
+            report.record_skip(
+                rg_i, rows=sum(p.row_count for p in plans
+                               if p.rg_index == rg_i), error=e)
+        spans = [s for s in spans if s[0].rg_index not in failed_rgs]
     # per-COLUMN form consistency: a column dict-encoded in one row group
     # and plain in another must not mix device-dict and host-ragged parts
     # (the assemble routes a column by its first part's shape) — demote
@@ -725,7 +834,9 @@ def decoded_scan(state) -> Dict[str, object]:
 
 def scan(pf: ParquetFile, path: str, lo=None, hi=None,
          columns: Optional[Sequence[str]] = None, use_bloom: bool = True,
-         values: Optional[Sequence] = None):
+         values: Optional[Sequence] = None,
+         policy: Optional[FaultPolicy] = None,
+         report: Optional[ReadReport] = None):
     """Pushdown scan, auto-routed per backend: on an accelerator the device
     route runs (results stay resident in HBM, the fused span filter
     amortizes across repeated scans); on the cpu backend the threaded host
@@ -737,13 +848,27 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
     vs scan_filtered host arrays / byte lists); plain-string OUTPUT
     columns ride the device route as host (values, offsets) survivor
     pairs."""
+    import dataclasses
+    import time
+
     import jax
 
+    pol = policy if policy is not None else pf.policy
     if jax.default_backend() != "cpu":
+        t0 = time.monotonic()
+        # the device attempt works on a scratch report: a refusal fallback
+        # discards its staging-phase skips (the host scan re-plans and
+        # re-records them — the same report twice would double-count every
+        # skipped row group) but keeps its retries, which really happened
+        scratch = ReadReport() if report is not None else None
         try:
-            return scan_filtered_device(pf, path, lo=lo, hi=hi,
-                                        columns=columns, use_bloom=use_bloom,
-                                        values=values)
+            got = scan_filtered_device(pf, path, lo=lo, hi=hi,
+                                       columns=columns, use_bloom=use_bloom,
+                                       values=values, policy=policy,
+                                       report=scratch)
+            if report is not None:
+                report.merge(scratch)
+            return got
         except ValueError as e:
             # only the DOCUMENTED device-route refusals fall back (their
             # messages all direct to the host scan); any other ValueError
@@ -751,20 +876,36 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
             # caller's result forms
             if "use the host scan" not in str(e):
                 raise
+            if report is not None and scratch is not None:
+                report.retries += scratch.retries
+        if pol is not None and pol.deadline_s is not None:
+            # the fallback continues the SAME scan: it runs on whatever
+            # budget the device attempt left, not a fresh deadline
+            remaining = pol.deadline_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                raise DeadlineError(
+                    "deadline exceeded during scan (device attempt spent "
+                    "the budget before falling back to the host scan)")
+            policy = dataclasses.replace(pol, deadline_s=remaining)
     return scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
-                         use_bloom=use_bloom, values=values)
+                         use_bloom=use_bloom, values=values, policy=policy,
+                         report=report)
 
 
 def scan_filtered_device(pf: ParquetFile, path: str, lo=None, hi=None,
                          columns: Optional[Sequence[str]] = None,
                          use_bloom: bool = True,
-                         values: Optional[Sequence] = None) -> Dict[str, object]:
+                         values: Optional[Sequence] = None,
+                         policy: Optional[FaultPolicy] = None,
+                         report: Optional[ReadReport] = None) -> Dict[str, object]:
     """Device-mode :func:`scan_filtered`: pushdown selects pages, the chip
     decodes them, evaluates ``lo <= key <= hi`` (or ``key ∈ values``), and
     gathers survivors — the TPU analog of SURVEY.md §3.3's
-    Find→SeekToRow→decode flow."""
+    Find→SeekToRow→decode flow.  ``policy``/``report`` guard the staging
+    phase (see :func:`stage_scan`)."""
     return decoded_scan(stage_scan(pf, path, lo=lo, hi=hi, columns=columns,
-                                   use_bloom=use_bloom, values=values))
+                                   use_bloom=use_bloom, values=values,
+                                   policy=policy, report=report))
 
 
 def _key_mask_device(leaf, col, lo, hi, trim: int, n_rows: int,
@@ -884,7 +1025,9 @@ def _row_aligned_device(col, trim: int, n_rows: int, no_nulls: bool = False):
 
 def scan_filtered_sharded(pf: ParquetFile, path: str, lo=None, hi=None,
                           columns: Optional[Sequence[str]] = None,
-                          mesh=None, use_bloom: bool = True):
+                          mesh=None, use_bloom: bool = True,
+                          policy: Optional[FaultPolicy] = None,
+                          report: Optional[ReadReport] = None):
     """Distributed pushdown scan: surviving row-group spans are staged
     round-robin across the mesh's devices and decoded+filtered there —
     BASELINE.md config 5 at v5e-8 scale (SURVEY.md §2.5 data parallelism
@@ -902,7 +1045,8 @@ def scan_filtered_sharded(pf: ParquetFile, path: str, lo=None, hi=None,
     mesh = mesh or default_mesh()
     devs = list(mesh.devices.flat)
     state = stage_scan(pf, path, lo=lo, hi=hi, columns=columns,
-                       use_bloom=use_bloom, devices=devs)
+                       use_bloom=use_bloom, devices=devs, policy=policy,
+                       report=report)
     state["use_count"][0] += 1
     out_cols = state["out_cols"]
     if "#rows" in out_cols:

@@ -10,6 +10,64 @@ echo "=== pass 2: PARQUET_TPU_NO_NATIVE=1 (numpy oracles) ==="
 PARQUET_TPU_NO_NATIVE=1 python -m pytest tests/ -q
 echo "=== multi-chip dryrun (8-device CPU mesh) ==="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "=== chaos smoke (seeded FaultInjectingSource soak) ==="
+python - <<'EOF'
+# Seeded fault soak over a generated multi-row-group file: transient
+# errors must recover byte-identically under FaultPolicy, a bit-flipped
+# row group must skip with accurate ReadReport accounting, and injected
+# latency must trip the deadline.  Bounded to a few seconds.
+import io
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+from parquet_tpu import (DeadlineError, FaultInjectingSource, FaultPolicy,
+                         ParquetFile, ReadReport, iter_batches, scan_filtered)
+from parquet_tpu.io.source import BytesSource
+
+t = pa.table({"x": pa.array(np.arange(20000, dtype=np.int64)),
+              "s": pa.array([f"v{i % 29}" for i in range(20000)])})
+buf = io.BytesIO()
+pq.write_table(t, buf, row_group_size=4000, compression="gzip")
+raw = buf.getvalue()
+clean = ParquetFile(raw).read().to_arrow()
+pol = FaultPolicy(max_retries=4, backoff_s=0.0)
+
+injected = 0
+for seed in range(8):  # soak: every seed must recover byte-identically
+    src = FaultInjectingSource(BytesSource(raw), seed=seed, error_rate=0.2,
+                               max_consecutive_errors=2)
+    assert ParquetFile(src, policy=pol).read().to_arrow().equals(clean), seed
+    src2 = FaultInjectingSource(BytesSource(raw), seed=seed, error_rate=0.2,
+                                max_consecutive_errors=2)
+    got = pa.concat_tables(b.to_arrow() for b in iter_batches(
+        ParquetFile(src2, policy=pol), batch_rows=1500))
+    assert got.equals(clean), seed
+    injected += src.stats.injected_errors + src2.stats.injected_errors
+assert injected > 0, "chaos soak injected nothing — knob broken?"
+
+off = pq.ParquetFile(io.BytesIO(raw)).metadata.row_group(1).column(0) \
+    .data_page_offset
+skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+rep = ReadReport()
+src = FaultInjectingSource(BytesSource(raw), flip_offsets=[off, off+1, off+2])
+tab = ParquetFile(src, policy=skip).read(report=rep)
+assert rep.row_groups_skipped == [1] and rep.rows_dropped == 4000, rep.as_dict()
+assert tab.num_rows == 16000
+
+want = scan_filtered(ParquetFile(raw), "x", lo=1000, hi=18000)
+srcs = FaultInjectingSource(BytesSource(raw), seed=5, error_rate=0.2,
+                            max_consecutive_errors=2)
+got = scan_filtered(ParquetFile(srcs, policy=pol), "x", lo=1000, hi=18000)
+assert got["s"] == want["s"]
+
+try:
+    ParquetFile(FaultInjectingSource(BytesSource(raw), latency_s=0.05),
+                policy=FaultPolicy(deadline_s=0.1, backoff_s=0.0)).read()
+    raise SystemExit("deadline did not fire")
+except DeadlineError:
+    pass
+print("chaos smoke ok: soak recovered, skip accounted, deadline fired")
+EOF
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys

@@ -1,0 +1,583 @@
+"""Deterministic chaos suite for the resilient read pipeline (io/faults.py).
+
+Drives :class:`FaultInjectingSource` through read / stream / scan: transient
+errors recover under :class:`FaultPolicy`, corrupt row groups skip with
+accurate :class:`ReadReport` accounting, deadlines fire on injected latency,
+and every surfaced error names file / row group / column (SURVEY.md §5 —
+flaky network filesystems are the operating environment, so the degraded
+paths get first-class tests)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import (CorruptedError, DeadlineError, FaultInjectingSource,
+                         FaultPolicy, ParquetFile, ReadError, ReadIOError,
+                         ReadReport, iter_batches, scan_filtered)
+from parquet_tpu.io.source import (BytesSource, FileLikeSource, FileSource,
+                                   RetryingSource)
+
+N_ROWS = 10_000
+ROW_GROUP = 2_500  # 4 row groups
+
+
+def _make_raw() -> bytes:
+    t = pa.table({
+        "x": pa.array(np.arange(N_ROWS, dtype=np.int64)),
+        "s": pa.array([f"v{i % 17}" for i in range(N_ROWS)]),
+    })
+    buf = io.BytesIO()
+    # gzip: zlib's checksum turns any payload bit flip into a loud decode
+    # error (deterministic corruption detection without page CRCs)
+    pq.write_table(t, buf, row_group_size=ROW_GROUP, compression="gzip")
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def raw() -> bytes:
+    return _make_raw()
+
+
+@pytest.fixture(scope="module")
+def clean(raw):
+    return ParquetFile(raw).read().to_arrow()
+
+
+def _rg1_flip_offsets(raw):
+    """Offsets smashing the first page header of row group 1's 'x' chunk."""
+    meta = pq.ParquetFile(io.BytesIO(raw)).metadata
+    off = meta.row_group(1).column(0).data_page_offset
+    return [off, off + 1, off + 2]
+
+
+FAST = FaultPolicy(max_retries=4, backoff_s=0.0)
+SKIP = FaultPolicy(max_retries=4, backoff_s=0.0, on_corrupt="skip_row_group")
+
+
+# ---------------------------------------------------------------------------
+# satellite fixes: source-level contracts
+# ---------------------------------------------------------------------------
+def test_bytes_source_rejects_negative_reads(raw):
+    src = BytesSource(raw)
+    for fn in (src.pread, src.pread_view):
+        with pytest.raises(IOError, match="invalid read"):
+            fn(-4, 4)  # would silently slice from the END of the buffer
+        with pytest.raises(IOError, match="invalid read"):
+            fn(0, -1)
+
+
+def test_file_source_read_after_close(tmp_path, raw):
+    p = tmp_path / "f.parquet"
+    p.write_bytes(raw)
+    src = FileSource(str(p))
+    assert src.pread(0, 4) == b"PAR1"
+    src.close()
+    src.close()  # idempotent
+    with pytest.raises(ValueError, match="closed source"):
+        src.pread(0, 4)
+    with pytest.raises(ValueError, match="closed source"):
+        src.pread_view(0, 4)
+
+
+def test_file_like_source_close(raw):
+    f = io.BytesIO(raw)
+    src = FileLikeSource(f)
+    assert src.pread(0, 4) == b"PAR1"
+    src.close()
+    src.close()  # idempotent
+    assert f.closed
+    with pytest.raises(ValueError, match="closed source"):
+        src.pread(0, 4)
+
+
+def test_retrying_source_pread_view_keeps_zero_copy(tmp_path, raw):
+    p = tmp_path / "f.parquet"
+    p.write_bytes(raw)
+    rs = RetryingSource(FileSource(str(p)), retries=2, backoff_s=0.0)
+    out = rs.pread_view(4, 64)
+    # delegated to FileSource.pread_view (numpy preadv buffer), not the
+    # copying bytes default
+    assert isinstance(out, np.ndarray)
+    assert bytes(out) == raw[4:68]
+    rs.close()
+
+
+def test_retrying_source_pread_view_retries_transients(raw):
+    class Flaky(BytesSource):
+        def __init__(self, data, fails):
+            super().__init__(data)
+            self.fails = fails
+            self.calls = 0
+
+        def pread_view(self, offset, size):
+            self.calls += 1
+            if self.fails > 0:
+                self.fails -= 1
+                raise OSError("transient: connection reset")
+            return super().pread_view(offset, size)
+
+    src = Flaky(raw, fails=2)
+    rs = RetryingSource(src, retries=3, backoff_s=0.0)
+    assert bytes(rs.pread_view(0, 4)) == b"PAR1"
+    assert src.calls == 3
+
+
+def test_fault_policy_validates():
+    with pytest.raises(ValueError, match="on_corrupt"):
+        FaultPolicy(on_corrupt="ignore")
+    with pytest.raises(ValueError, match="max_retries"):
+        FaultPolicy(max_retries=-1)
+
+
+# ---------------------------------------------------------------------------
+# transient errors recover byte-identically
+# ---------------------------------------------------------------------------
+def test_read_recovers_transient_errors(raw, clean):
+    src = FaultInjectingSource(BytesSource(raw), seed=7, error_rate=0.2,
+                               max_consecutive_errors=2)
+    rep = ReadReport()
+    got = ParquetFile(src, policy=FAST).read(report=rep).to_arrow()
+    assert got.equals(clean)
+    assert src.stats.injected_errors > 0  # the chaos actually happened
+    assert rep.retries > 0
+    assert rep.ok and rep.rows_dropped == 0
+
+
+def test_iter_batches_recovers_transient_errors(raw, clean):
+    src = FaultInjectingSource(BytesSource(raw), seed=3, error_rate=0.2,
+                               max_consecutive_errors=2)
+    pf = ParquetFile(src, policy=FAST)
+    rep = ReadReport()
+    got = pa.concat_tables(
+        b.to_arrow() for b in iter_batches(pf, batch_rows=1000, report=rep))
+    assert got.equals(clean)
+    assert src.stats.injected_errors > 0
+    assert rep.rows_read == N_ROWS
+
+
+def test_scan_filtered_recovers_transient_errors(raw):
+    want = scan_filtered(ParquetFile(raw), "x", lo=100, hi=7000)
+    src = FaultInjectingSource(BytesSource(raw), seed=5, error_rate=0.3,
+                               max_consecutive_errors=2)
+    rep = ReadReport()
+    got = scan_filtered(ParquetFile(src, policy=FAST), "x", lo=100, hi=7000,
+                        report=rep)
+    assert got["s"] == want["s"]
+    assert src.stats.injected_errors > 0
+    assert rep.rows_read == len(want["s"])
+
+
+def test_retries_exhausted_surfaces_readioerror(raw):
+    src = FaultInjectingSource(BytesSource(raw), seed=1, error_rate=1.0)
+    with pytest.raises(OSError, match="injected transient"):
+        ParquetFile(src, policy=FaultPolicy(max_retries=2, backoff_s=0.0))
+    # the surfaced error is BOTH an OSError and a located ReadError
+    try:
+        FaultInjectingSource(BytesSource(raw), seed=1, error_rate=1.0)
+        ParquetFile(FaultInjectingSource(BytesSource(raw), seed=1,
+                                         error_rate=1.0),
+                    policy=FaultPolicy(max_retries=0, backoff_s=0.0))
+    except ReadIOError as e:
+        assert isinstance(e, CorruptedError)
+    else:
+        pytest.fail("expected ReadIOError")
+
+
+# ---------------------------------------------------------------------------
+# corrupt row group: raise with context, or skip with accounting
+# ---------------------------------------------------------------------------
+def test_corrupt_row_group_raises_located_readerror(tmp_path, raw):
+    p = tmp_path / "victim.parquet"
+    p.write_bytes(raw)
+    src = FaultInjectingSource(FileSource(str(p)),
+                               flip_offsets=_rg1_flip_offsets(raw))
+    with pytest.raises(ReadError) as ei:
+        ParquetFile(src, policy=FAST).read()
+    e = ei.value
+    assert e.row_group == 1 and e.column == "x"
+    assert e.page_offset is not None
+    # locatable from the message alone: file, row group, column all named
+    msg = str(e)
+    assert "victim.parquet" in msg and "row-group=1" in msg \
+        and "column=x" in msg
+
+
+def test_corrupt_row_group_raises_without_policy(raw):
+    """Error context is always on — no policy needed for locatable errors."""
+    src = FaultInjectingSource(BytesSource(raw),
+                               flip_offsets=_rg1_flip_offsets(raw))
+    with pytest.raises(CorruptedError) as ei:
+        ParquetFile(src).read()
+    assert "row-group=1" in str(ei.value)
+
+
+def test_skip_row_group_read_returns_intact_rows(raw, clean):
+    src = FaultInjectingSource(BytesSource(raw),
+                               flip_offsets=_rg1_flip_offsets(raw))
+    rep = ReadReport()
+    tab = ParquetFile(src, policy=SKIP).read(report=rep)
+    assert tab.num_rows == N_ROWS - ROW_GROUP
+    want = pa.concat_tables([clean.slice(0, ROW_GROUP),
+                             clean.slice(2 * ROW_GROUP)])
+    got = tab.to_arrow()
+    for name in want.column_names:
+        assert got.column(name).combine_chunks().equals(
+            want.column(name).combine_chunks()), name
+    assert rep.row_groups_skipped == [1]
+    assert rep.rows_dropped == ROW_GROUP
+    assert rep.rows_read == N_ROWS - ROW_GROUP
+    assert len(rep.errors) == 1 and "row-group=1" in rep.errors[0]
+    assert not rep.ok
+    assert tab.report is rep
+    d = rep.as_dict()
+    assert d["row_groups_skipped"] == [1] and d["rows_dropped"] == ROW_GROUP
+
+
+def test_skip_row_group_stream(raw, clean):
+    src = FaultInjectingSource(BytesSource(raw),
+                               flip_offsets=_rg1_flip_offsets(raw))
+    pf = ParquetFile(src, policy=SKIP)
+    rep = ReadReport()
+    got = pa.concat_tables(
+        b.to_arrow() for b in iter_batches(pf, batch_rows=1000, report=rep))
+    want = pa.concat_tables([clean.slice(0, ROW_GROUP),
+                             clean.slice(2 * ROW_GROUP)])
+    assert got.equals(want)
+    assert rep.row_groups_skipped == [1]
+    assert rep.rows_dropped == ROW_GROUP
+    assert rep.rows_read == N_ROWS - ROW_GROUP
+
+
+def test_skip_row_group_scan(raw):
+    want = scan_filtered(ParquetFile(raw), "x", lo=0, hi=N_ROWS)
+    src = FaultInjectingSource(BytesSource(raw),
+                               flip_offsets=_rg1_flip_offsets(raw))
+    rep = ReadReport()
+    got = scan_filtered(ParquetFile(src, policy=SKIP), "x", lo=0, hi=N_ROWS,
+                        report=rep)
+    # rg1 covers x in [2500, 5000): those candidate rows drop, rest returns
+    assert got["s"] == want["s"][:ROW_GROUP] + want["s"][2 * ROW_GROUP:]
+    assert rep.row_groups_skipped == [1]
+    assert rep.rows_dropped == ROW_GROUP
+
+
+def test_skip_row_group_device_scan_staging(raw):
+    """Degraded staging on the device-scan route (stage_scan drops the
+    corrupt group's spans before any H2D)."""
+    from parquet_tpu.parallel.host_scan import scan_filtered_device
+
+    src = FaultInjectingSource(BytesSource(raw),
+                               flip_offsets=_rg1_flip_offsets(raw))
+    rep = ReadReport()
+    got = scan_filtered_device(ParquetFile(src, policy=SKIP), "x",
+                               lo=0, hi=N_ROWS, columns=["x"],
+                               report=rep)
+    assert rep.row_groups_skipped == [1]
+    from parquet_tpu.ops.device import pairs_to_host
+
+    vals = pairs_to_host(got["x"], np.int64)
+    want = np.concatenate([np.arange(0, ROW_GROUP, dtype=np.int64),
+                           np.arange(2 * ROW_GROUP, N_ROWS, dtype=np.int64)])
+    np.testing.assert_array_equal(np.sort(np.asarray(vals)), want)
+
+
+def test_all_row_groups_corrupt_returns_empty(raw):
+    meta = pq.ParquetFile(io.BytesIO(raw)).metadata
+    flips = []
+    for i in range(meta.num_row_groups):
+        off = meta.row_group(i).column(0).data_page_offset
+        flips += [off, off + 1, off + 2]
+    src = FaultInjectingSource(BytesSource(raw), flip_offsets=flips)
+    rep = ReadReport()
+    tab = ParquetFile(src, policy=SKIP).read(report=rep)
+    assert tab.num_rows == 0
+    assert rep.row_groups_skipped == list(range(meta.num_row_groups))
+    assert rep.rows_dropped == N_ROWS
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+def test_deadline_fires_on_injected_latency(raw):
+    src = FaultInjectingSource(BytesSource(raw), latency_s=0.05)
+    pol = FaultPolicy(deadline_s=0.12, backoff_s=0.0)
+    with pytest.raises(DeadlineError):
+        ParquetFile(src, policy=pol).read()
+
+
+def test_deadline_is_timeout_error(raw):
+    src = FaultInjectingSource(BytesSource(raw), latency_s=0.05)
+    with pytest.raises(TimeoutError):
+        ParquetFile(src, policy=FaultPolicy(deadline_s=0.12)).read()
+
+
+def test_deadline_not_swallowed_by_skip_mode(raw):
+    """A timeout is not corruption: skip_row_group must not eat it."""
+    src = FaultInjectingSource(BytesSource(raw), latency_s=0.05)
+    pol = FaultPolicy(deadline_s=0.12, backoff_s=0.0,
+                      on_corrupt="skip_row_group")
+    with pytest.raises(DeadlineError):
+        ParquetFile(src, policy=pol).read()
+
+
+def test_no_deadline_reads_fine(raw, clean):
+    src = FaultInjectingSource(BytesSource(raw), latency_s=0.001)
+    got = ParquetFile(src, policy=FAST).read().to_arrow()
+    assert got.equals(clean)
+
+
+# ---------------------------------------------------------------------------
+# truncation / short reads stay loud (corruption, not wrong data)
+# ---------------------------------------------------------------------------
+def test_truncation_detected(raw):
+    src = FaultInjectingSource(BytesSource(raw), truncate_at=len(raw) - 64)
+    with pytest.raises(CorruptedError):
+        ParquetFile(src)
+
+
+def test_mid_file_truncation_detected(raw):
+    meta = pq.ParquetFile(io.BytesIO(raw)).metadata
+    cut = meta.row_group(1).column(0).data_page_offset + 10
+    # the footer lives at the end, so open against intact bytes and tear
+    # the data region afterwards (a torn FUSE read, not a short object)
+    pf = ParquetFile(BytesSource(raw))
+    pf.source = FaultInjectingSource(BytesSource(raw), truncate_at=cut)
+    with pytest.raises((CorruptedError, OSError)):
+        pf.read()
+
+
+def test_short_reads_detected(raw):
+    src = FaultInjectingSource(BytesSource(raw), seed=2, short_read_rate=1.0)
+    with pytest.raises((CorruptedError, OSError)):
+        ParquetFile(src).read()
+
+
+# ---------------------------------------------------------------------------
+# injector determinism + per-call policy
+# ---------------------------------------------------------------------------
+def test_injector_is_deterministic(raw):
+    def run(seed):
+        src = FaultInjectingSource(BytesSource(raw), seed=seed,
+                                   error_rate=0.2, max_consecutive_errors=2)
+        rep = ReadReport()
+        t = ParquetFile(src, policy=FAST).read(report=rep)
+        return (src.stats.injected_errors, rep.retries, t.num_rows)
+
+    assert run(123) == run(123)
+    # and the seed actually matters for the draw sequence
+    seeds = {run(s)[0] for s in (1, 2, 3, 4, 5)}
+    assert len(seeds) > 1
+
+
+def test_per_call_policy_override(raw, clean):
+    """A file opened WITHOUT a policy still honors read(policy=...)."""
+    src = FaultInjectingSource(BytesSource(raw), seed=7, error_rate=0.2,
+                               max_consecutive_errors=2)
+    pf = ParquetFile(src)  # opening draws no errors for this seed
+    rep = ReadReport()
+    got = pf.read(policy=FAST, report=rep).to_arrow()
+    assert got.equals(clean)
+    assert rep.retries > 0
+
+
+def test_paused_stream_deadline_does_not_poison_other_ops(raw):
+    """A paused/abandoned iter_batches drain must not leak its (possibly
+    expired) deadline into later independent operations on the same file."""
+    import time as _time
+
+    pf = ParquetFile(BytesSource(raw),
+                     policy=FaultPolicy(deadline_s=0.05, backoff_s=0.0))
+    it = iter_batches(pf, batch_rows=1000)
+    next(it)
+    _time.sleep(0.08)  # the drain's budget expires while paused
+    # a fresh read gets its OWN budget and succeeds
+    assert pf.read().num_rows == N_ROWS
+    # ...while the resumed drain correctly hits ITS deadline
+    with pytest.raises(DeadlineError):
+        for _ in it:
+            pass
+
+
+def test_interleaved_policy_overrides_restore_source(raw):
+    """Out-of-order close of per-call-policy generators must leave
+    ``pf.source`` on a live wrapper, then back on the open-time source."""
+    pf = ParquetFile(BytesSource(raw))
+    base = pf.source
+    p1 = FaultPolicy(max_retries=1, backoff_s=0.0)
+    p2 = FaultPolicy(max_retries=2, backoff_s=0.0)
+    g1 = iter_batches(pf, batch_rows=1000, policy=p1)
+    g2 = iter_batches(pf, batch_rows=1000, policy=p2)
+    next(g1)
+    next(g2)
+    g1.close()  # closed out of order: g2's wrapper must stay installed
+    assert getattr(pf.source, "policy", None) is p2
+    assert pa.concat_tables(b.to_arrow() for b in g2).num_rows > 0
+    assert pf.source is base  # fully restored after the last scope exits
+
+
+def test_interleaved_drains_keep_their_deadlines(raw):
+    """Out-of-order close of two drains sharing the open-time PolicySource
+    must neither drop the live drain's deadline nor leave a stale clock
+    installed afterwards."""
+    pf = ParquetFile(BytesSource(raw),
+                     policy=FaultPolicy(deadline_s=30.0, backoff_s=0.0))
+    g1 = iter_batches(pf, batch_rows=1000)
+    next(g1)
+    g2 = iter_batches(pf, batch_rows=1000)
+    next(g2)
+    g1.close()
+    assert pf.source._deadline is not None  # g2's budget survives
+    g2.close()
+    assert pf.source._deadline is None  # no stale clock left installed
+    # lazy metadata reads outside any operation scope stay deadline-free
+    assert pf.row_group(0).column("x").column_index() is not None or True
+    assert pf.read().num_rows == N_ROWS
+
+
+def test_interleaved_drains_attribute_their_own_retries(raw, clean):
+    """Each operation's report counts only ITS retries — a shared
+    before/after counter delta would double-attribute the sibling's."""
+    src = FaultInjectingSource(BytesSource(raw), seed=3, error_rate=0.25,
+                               max_consecutive_errors=2)
+    pf = ParquetFile(src, policy=FAST)
+    base = pf.source.retries_performed  # open-time retries (no report)
+    r1, r2 = ReadReport(), ReadReport()
+    g1 = iter_batches(pf, batch_rows=1000, report=r1)
+    g2 = iter_batches(pf, batch_rows=1000, report=r2)
+    t1, t2 = [], []
+    for b1, b2 in zip(g1, g2):  # fully interleaved drains
+        t1.append(b1.to_arrow())
+        t2.append(b2.to_arrow())
+    g2.close()  # zip stops on g1's StopIteration; settle g2's accounting
+    assert pa.concat_tables(t1).equals(clean)
+    assert pa.concat_tables(t2).equals(clean)
+    total = pf.source.retries_performed - base
+    assert total > 0
+    # attribution goes to the operation whose clock was active per pread
+    # ("most recently started wins" while scopes overlap); the invariant is
+    # that the per-report counts PARTITION the total — no double counting
+    assert r1.retries + r2.retries == total
+
+
+def test_skip_mode_refuses_device_read(raw):
+    pf = ParquetFile(BytesSource(raw), policy=SKIP)
+    with pytest.raises(ValueError, match="skip_row_group.*device"):
+        pf.read(device=True)
+
+
+def test_report_reused_across_files_accumulates(raw):
+    """One report aggregating two degraded reads must account both skips,
+    even when the skipped ordinals collide."""
+    rep = ReadReport()
+    for _ in range(2):
+        src = FaultInjectingSource(BytesSource(raw),
+                                   flip_offsets=_rg1_flip_offsets(raw))
+        ParquetFile(src, policy=SKIP).read(report=rep)
+    assert rep.row_groups_skipped == [1, 1]
+    assert rep.rows_dropped == 2 * ROW_GROUP
+    assert len(rep.errors) == 2
+
+
+def test_non_data_errors_never_treated_as_corruption(raw, monkeypatch):
+    """A missing codec package (or OOM) is an environment failure, not
+    corruption: skip_row_group must NOT silently return an empty table over
+    it, and the original exception type must survive for except ImportError
+    callers."""
+    from parquet_tpu import codecs
+
+    def boom(codec_id):
+        raise ModuleNotFoundError("No module named 'zstandard'")
+
+    monkeypatch.setattr(codecs, "get_codec", boom)
+    src = BytesSource(raw)
+    with pytest.raises(ImportError):
+        ParquetFile(src, policy=SKIP).read()
+    with pytest.raises(ImportError):  # default policy: same, unwrapped
+        ParquetFile(BytesSource(raw)).read()
+
+
+def test_policy_read_keeps_streamed_large_file_route(raw, clean, monkeypatch):
+    """The flaky-mount + big-file case must not lose the windowed streaming
+    read: a policy (non-skip) read over the size threshold still routes
+    through the stream internals."""
+    from parquet_tpu.io import reader as reader_mod, stream as stream_mod
+
+    calls = []
+    real = stream_mod._iter_batches_impl
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(stream_mod, "_iter_batches_impl", spy)
+    monkeypatch.setattr(reader_mod, "_STREAMED_READ_BYTES", 1)
+    src = FaultInjectingSource(BytesSource(raw), seed=7, error_rate=0.1,
+                               max_consecutive_errors=2)
+    rep = ReadReport()
+    got = ParquetFile(src, policy=FAST).read(report=rep).to_arrow()
+    assert got.equals(clean)
+    assert calls, "policy read bypassed the streamed route"
+    assert rep.rows_read == N_ROWS
+
+
+def test_failed_open_does_not_leak_fds(tmp_path, raw):
+    """A failed open must close the fd it opened — the flaky-mount retry
+    loops this layer exists for would otherwise hit EMFILE."""
+    import os
+
+    p = tmp_path / "torn.parquet"
+    p.write_bytes(raw[: len(raw) // 2])  # no trailing magic
+    fd_dir = "/proc/self/fd"
+    before = len(os.listdir(fd_dir))
+    for _ in range(20):
+        with pytest.raises(CorruptedError):
+            ParquetFile(str(p))
+        with pytest.raises(CorruptedError):
+            ParquetFile(str(p), policy=FAST)
+    assert len(os.listdir(fd_dir)) <= before + 1
+
+
+def _page_index_file():
+    """A file WITH page-index structures so planning does real index IO."""
+    t = pa.table({"x": pa.array(np.arange(N_ROWS, dtype=np.int64)),
+                  "s": pa.array([f"v{i % 17}" for i in range(N_ROWS)])})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=ROW_GROUP, compression="gzip",
+                   write_page_index=True)
+    return buf.getvalue()
+
+
+def test_corrupt_column_index_located_and_skippable():
+    """Planning-phase IO (column/offset index preads) carries the same
+    context and degraded semantics as the decode phase."""
+    raw = _page_index_file()
+    off = ParquetFile(raw).metadata.row_groups[1].columns[0] \
+        .column_index_offset
+    assert off is not None, "writer did not emit a page index"
+    want = scan_filtered(ParquetFile(raw), "x", lo=0, hi=N_ROWS)
+    bad = bytearray(raw)
+    bad[off:off + 8] = b"\xff" * 8  # wire type 15: guaranteed thrift error
+    bad = bytes(bad)
+    # default policy: a located error, not a bare thrift crash
+    with pytest.raises(CorruptedError) as ei:
+        scan_filtered(ParquetFile(bad, policy=FAST), "x", lo=0, hi=N_ROWS)
+    assert "row-group=1" in str(ei.value)
+    # skip policy: the group drops at planning time, accounted
+    rep = ReadReport()
+    got = scan_filtered(ParquetFile(bad, policy=SKIP), "x", lo=0, hi=N_ROWS,
+                        report=rep)
+    assert rep.row_groups_skipped == [1] and rep.rows_dropped == ROW_GROUP
+    assert got["s"] == want["s"][:ROW_GROUP] + want["s"][2 * ROW_GROUP:]
+
+
+def test_flip_mask_targets_exact_bytes(raw):
+    src = FaultInjectingSource(BytesSource(raw), flip_offsets=[100],
+                               flip_mask=0x01)
+    got = src.pread(96, 16)
+    want = bytearray(raw[96:112])
+    want[4] ^= 0x01
+    assert got == bytes(want)
+    assert src.stats.injected_flips == 1
