@@ -31,11 +31,21 @@ Resilience
   FaultPolicy (retry/backoff+jitter, deadline, degraded-scan mode),
   ReadReport, ReadError/ReadIOError/DeadlineError (located failures),
   FaultInjectingSource (deterministic chaos wrapper), RetryingSource
+Durability & integrity
+  AtomicFileSink (fsync + atomic rename commit; path sinks default),
+  FileSink, WriteError, FaultInjectingSink/InjectedWriterCrash (write-side
+  chaos), crash_consistency_check (crash matrix harness),
+  verify_file/IntegrityReport/IntegrityIssue (end-to-end verification;
+  ``python -m parquet_tpu verify``)
 """
 
-from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError)
-from .io.faults import (FaultInjectingSource, FaultPolicy, PolicySource,
-                        ReadReport)
+from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
+                     WriteError)
+from .io.faults import (FaultInjectingSink, FaultInjectingSource, FaultPolicy,
+                        InjectedWriterCrash, PolicySource, ReadReport,
+                        SinkFaultStats, crash_consistency_check)
+from .io.integrity import IntegrityIssue, IntegrityReport, verify_file
+from .io.sink import AtomicFileSink, FileSink, Sink
 from .io.reader import ParquetFile, ReadOptions, RowGroupReader, Table
 from .io.column import Column
 from .io.writer import (ColumnData, ParquetWriter, WriterOptions,

@@ -1,7 +1,11 @@
-"""parquet-tools-style CLI: ``python -m parquet_tpu [meta|schema|pages|head]``.
+"""parquet-tools-style CLI:
+``python -m parquet_tpu [meta|schema|pages|head|verify]``.
 
 Reference parity: the reference ships ``print.go`` (PrintSchema) as a
 library; this front end makes the same dumps reachable from a shell.
+``verify`` runs the integrity subsystem (io/integrity.py) and exits 0 only
+when the file is provably clean — the operational check after an ingest or
+before trusting a checkpoint.
 """
 
 import argparse
@@ -10,16 +14,39 @@ import sys
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet_tpu")
-    p.add_argument("command", choices=["meta", "schema", "pages", "head"],
+    p.add_argument("command",
+                   choices=["meta", "schema", "pages", "head", "verify"],
                    help="meta: file summary; schema: schema tree; pages: "
-                        "page-level dump; head: first rows as JSON lines")
+                        "page-level dump; head: first rows as JSON lines; "
+                        "verify: end-to-end integrity check (exit 0 = clean, "
+                        "1 = corrupt)")
     p.add_argument("file", help="parquet file path")
     p.add_argument("--row-group", type=int, default=0,
                    help="pages: which row group")
     p.add_argument("--column", type=int, default=0,
                    help="pages: which leaf column (schema order)")
     p.add_argument("-n", type=int, default=10, help="head: rows to print")
+    p.add_argument("--decode", action="store_true",
+                   help="verify: additionally decode every column chunk "
+                        "(slowest, strongest check)")
+    p.add_argument("--json", action="store_true",
+                   help="verify: emit the IntegrityReport as JSON")
     args = p.parse_args(argv)
+
+    if args.command == "verify":
+        # never opens ParquetFile up front: a corrupt footer must yield a
+        # report and exit code, not a traceback
+        import json
+
+        from .io.integrity import verify_file
+
+        try:
+            rep = verify_file(args.file, decode=args.decode)
+        except OSError as e:
+            print(f"parquet_tpu: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(rep.as_dict()) if args.json else rep.summary())
+        return 0 if rep.ok else 1
 
     from .io.reader import ParquetFile
     from .utils.printer import print_file, print_pages, print_schema

@@ -320,10 +320,14 @@ def merge_files(paths_or_files, sorting: Sequence[SortingColumn], sink,
             # (row_group_rows applies only to the default options)
             opts = options
         w = ParquetWriter(sink, schema, opts)
-        for cols, n in iter_merged(files, sorting, schema,
-                                   batch_rows=batch_rows):
-            w.write(cols, n)   # writer buffers + drains at row_group_size
-        w.close()
+        try:
+            for cols, n in iter_merged(files, sorting, schema,
+                                       batch_rows=batch_rows):
+                w.write(cols, n)  # writer buffers + drains at row_group_size
+            w.close()
+        except BaseException:
+            w.abort()  # path sinks unlink their temp/partial file
+            raise
     finally:
         for pf in opened:
             pf.close()

@@ -61,12 +61,19 @@ class SortingWriter:
         path = os.path.join(self._tmpdir, f"run{len(self._spills):05d}.parquet")
         # small pages: close()'s streaming merge holds one decoded page per
         # run cursor, so spill page granularity bounds the merge window
+        # spill runs are transient (rmtree'd at close): skip atomic-commit
+        # fsyncs — durability only matters for the final output
         w = ParquetWriter(path, self.schema,
                           WriterOptions(compression="snappy",
                                         write_page_index=False,
-                                        data_page_size=1 << 16))
-        self._buf.flush_to(w)  # sorts, writes one row group
-        w.close()
+                                        data_page_size=1 << 16,
+                                        atomic_commit=False, fsync=False))
+        try:
+            self._buf.flush_to(w)  # sorts, writes one row group
+            w.close()
+        except BaseException:
+            w.abort()
+            raise
         self._spills.append(path)
 
     def close(self) -> None:
@@ -76,9 +83,13 @@ class SortingWriter:
             if not self._spills:
                 # everything fit in memory: sort + write directly
                 w = ParquetWriter(self.sink, self.schema, self.options)
-                if self._buf.num_rows:
-                    self._buf.flush_to(w)
-                w.close()
+                try:
+                    if self._buf.num_rows:
+                        self._buf.flush_to(w)
+                    w.close()
+                except BaseException:
+                    w.abort()
+                    raise
             else:
                 self._spill()
                 self._merge_spills()
@@ -98,7 +109,8 @@ class SortingWriter:
         spill_opts = WriterOptions(compression="snappy",
                                    write_page_index=False,
                                    data_page_size=1 << 16,
-                                   row_group_size=self.buffer_rows)
+                                   row_group_size=self.buffer_rows,
+                                   atomic_commit=False, fsync=False)
         # fd bound: each open run holds one descriptor, so fan-in is capped
         # at 64 regardless of buffer_rows (hierarchy absorbs any spill count)
         max_fanin = max(2, min(64, self.buffer_rows // 1024))

@@ -63,6 +63,27 @@ class DeadlineError(ReadError, TimeoutError):
     retry sleep (a truly hung syscall cannot be interrupted from Python)."""
 
 
+class WriteError(OSError):
+    """A write-stack failure with destination context: the target path and,
+    for atomic sinks, the temp file the bytes actually live in — the
+    write-side mirror of :class:`ReadError`'s locatability rule.  Raised by
+    :class:`~parquet_tpu.io.sink.AtomicFileSink` when the COMMIT (fsync /
+    rename) fails; plain data-write failures stay ordinary ``OSError``\\ s
+    so retry classifiers treat them uniformly.  Subclasses ``OSError`` so
+    existing ``except OSError`` callers keep working; the low-level failure
+    rides as ``__cause__``."""
+
+    def __init__(self, message: str, path=None, temp_path=None):
+        loc = []
+        if path is not None:
+            loc.append(f"dest={path}")
+        if temp_path is not None:
+            loc.append(f"temp={temp_path}")
+        super().__init__(f"[{' '.join(loc)}] {message}" if loc else message)
+        self.path = path
+        self.temp_path = temp_path
+
+
 class MissingRootColumnError(CorruptedError):
     """Schema has no root element."""
 

@@ -33,7 +33,9 @@ from ..errors import CorruptedError, DeadlineError, ReadError, ReadIOError
 from .source import Source
 
 __all__ = ["FaultPolicy", "ReadReport", "Deadline", "PolicySource",
-           "FaultInjectingSource", "read_context", "resolve_policy"]
+           "FaultInjectingSource", "read_context", "resolve_policy",
+           "FaultInjectingSink", "InjectedWriterCrash", "SinkFaultStats",
+           "crash_consistency_check"]
 
 
 # ---------------------------------------------------------------------------
@@ -474,3 +476,203 @@ class FaultInjectingSource(Source):
 
     def close(self) -> None:
         self.inner.close()
+
+
+# ---------------------------------------------------------------------------
+# Deterministic WRITE-side fault injection (mirror of FaultInjectingSource)
+# ---------------------------------------------------------------------------
+class InjectedWriterCrash(Exception):
+    """Simulated hard crash mid-write: bytes past the crash point were never
+    persisted, and the sink can no longer flush or commit — exactly what a
+    killed process or yanked power leaves behind.  Distinct from ``OSError``
+    so tests and the crash harness can tell "the environment failed" (which
+    the writer may surface) from "the machine died" (which it cannot)."""
+
+
+@dataclass
+class SinkFaultStats:
+    """What the write-side injector actually did (chaos-test assertions)."""
+
+    writes: int = 0
+    bytes_written: int = 0  # bytes that actually reached the inner sink
+    injected_errors: int = 0
+    injected_short_writes: int = 0
+    crashed: bool = False
+
+
+class FaultInjectingSink:
+    """Deterministic, seedable chaos wrapper over any write sink (an
+    :class:`~parquet_tpu.io.sink.Sink` or plain binary file object).
+
+    The writer is single-threaded, so injection draws come from one seeded
+    RNG in write order — same seed, same build, same faults.  Modes (all
+    composable):
+
+    - ``error_rate`` — probability a ``write()`` raises a transient
+      ``OSError(EIO)`` with NOTHING persisted (flaky network filesystem).
+    - ``short_write_rate`` — probability a ``write()`` persists only a
+      strict prefix of the buffer, then raises an ``OSError`` naming the
+      short write (torn NFS/FUSE write: the dangerous case where bytes ARE
+      on disk but fewer than the writer accounted for).
+    - ``enospc_at_byte`` — the disk has exactly this many bytes: the write
+      crossing the threshold persists up to it and raises
+      ``OSError(ENOSPC)``; so does every later write (the disk stays full).
+    - ``crash_at_byte`` — hard-crash simulation: bytes up to N persist, the
+      write crossing N raises :class:`InjectedWriterCrash`, and every
+      subsequent ``write``/``flush``/``close`` raises too (a dead process
+      cannot commit).  ``abort()`` still delegates so harnesses can sweep
+      temp files — the one piece of cleanup a *restarted* process would do.
+    """
+
+    def __init__(self, inner, seed: int = 0, error_rate: float = 0.0,
+                 short_write_rate: float = 0.0,
+                 enospc_at_byte: Optional[int] = None,
+                 crash_at_byte: Optional[int] = None):
+        self.inner = inner
+        self.seed = seed
+        self.error_rate = error_rate
+        self.short_write_rate = short_write_rate
+        self.enospc_at_byte = enospc_at_byte
+        self.crash_at_byte = crash_at_byte
+        self.stats = SinkFaultStats()
+        self._rng = random.Random(seed)
+        self._total = 0  # bytes persisted to the inner sink
+
+    def _check_alive(self, what: str) -> None:
+        if self.stats.crashed:
+            raise InjectedWriterCrash(
+                f"{what} after injected crash at byte {self.crash_at_byte}")
+
+    def _persist(self, data) -> None:
+        self.inner.write(data)
+        n = len(data)
+        self._total += n
+        self.stats.bytes_written += n
+
+    def write(self, data) -> int:
+        self._check_alive("write")
+        data = bytes(data) if not isinstance(data, (bytes, bytearray)) else data
+        n = len(data)
+        self.stats.writes += 1
+        if self.crash_at_byte is not None and self._total + n > self.crash_at_byte:
+            keep = self.crash_at_byte - self._total
+            if keep > 0:
+                self._persist(data[:keep])
+            self.stats.crashed = True
+            raise InjectedWriterCrash(
+                f"injected crash at byte {self.crash_at_byte}")
+        if (self.enospc_at_byte is not None
+                and self._total + n > self.enospc_at_byte):
+            keep = self.enospc_at_byte - self._total
+            if keep > 0:
+                self._persist(data[:keep])
+            self.stats.injected_errors += 1
+            raise OSError(errno.ENOSPC,
+                          f"injected ENOSPC at byte {self.enospc_at_byte}")
+        if self.error_rate and self._rng.random() < self.error_rate:
+            self.stats.injected_errors += 1
+            raise OSError(errno.EIO, "injected transient write error")
+        if (self.short_write_rate and n > 1
+                and self._rng.random() < self.short_write_rate):
+            keep = self._rng.randrange(1, n)
+            self._persist(data[:keep])
+            self.stats.injected_short_writes += 1
+            raise OSError(f"short write at {self._total - keep}: "
+                          f"wanted {n}, wrote {keep} (injected)")
+        self._persist(data)
+        return n
+
+    def writelines(self, parts) -> None:
+        for p in parts:
+            self.write(p)
+
+    def flush(self) -> None:
+        self._check_alive("flush")
+        self.inner.flush()
+
+    def close(self) -> None:
+        self._check_alive("close/commit")
+        if self.crash_at_byte is not None and self._total >= self.crash_at_byte:
+            # the crash point was the last byte written: the process died
+            # after the bytes but BEFORE the commit — the commit never runs
+            self.stats.crashed = True
+            raise InjectedWriterCrash(
+                f"injected crash at byte {self.crash_at_byte} (pre-commit)")
+        self.inner.close()
+
+    def abort(self) -> None:
+        ab = getattr(self.inner, "abort", None)
+        if ab is not None:
+            ab()
+        else:
+            try:
+                self.inner.close()
+            except OSError:
+                pass
+
+
+def crash_consistency_check(build, dest, samples: int = 12, seed: int = 0,
+                            offsets=None) -> List[dict]:
+    """Crash-consistency matrix over one atomic write.
+
+    ``build(sink)`` must perform a complete write to the given sink (e.g.
+    ``lambda s: write_table(table, s, options)``) WITHOUT committing it —
+    the harness owns the commit.  The harness first runs ``build``
+    uncrashed to learn the total byte count N, then for each sampled crash
+    offset in [0, N] replays the write against an
+    :class:`~parquet_tpu.io.sink.AtomicFileSink` for ``dest`` with a hard
+    crash injected at that byte, and asserts the crash invariant: ``dest``
+    either does not exist, or :func:`~parquet_tpu.io.integrity.verify_file`
+    reports it clean.  A final uncrashed run commits and must verify clean.
+
+    Returns one dict per run: ``{"offset", "outcome"}`` with outcome
+    ``"absent"`` or ``"clean"``.  Raises ``AssertionError`` (with the
+    offending offset and integrity issues) on any violation.
+    """
+    import os
+
+    from .integrity import verify_file  # deferred: integrity imports reader
+    from .sink import AtomicFileSink
+
+    if os.path.exists(dest):
+        raise FileExistsError(f"crash harness refuses to overwrite {dest!r}")
+
+    def run(crash_at):
+        sink = FaultInjectingSink(AtomicFileSink(dest),
+                                  crash_at_byte=crash_at)
+        try:
+            build(sink)
+            sink.close()  # commit (fsync + rename) — crash-free runs only
+        except InjectedWriterCrash:
+            # a real crash leaves the temp file stranded; the restarted
+            # process sweeps *.tmp — dest itself must never need recovery
+            sink.abort()
+        return sink
+
+    probe = run(None)
+    total = probe.stats.bytes_written
+    rep = verify_file(dest)
+    assert rep.ok, f"uncrashed write failed verification: {rep.summary()}"
+    os.unlink(dest)
+
+    if offsets is None:
+        rng = random.Random(seed)
+        pool = range(1, total)
+        picks = rng.sample(pool, min(max(samples - 2, 0), len(pool)))
+        offsets = sorted({0, *picks, total})
+    results = []
+    for off in offsets:
+        run(off)
+        if os.path.exists(dest):
+            rep = verify_file(dest)
+            assert rep.ok, (f"crash at byte {off} left a corrupt destination:"
+                            f" {rep.summary()}")
+            results.append({"offset": off, "outcome": "clean"})
+            os.unlink(dest)
+        else:
+            results.append({"offset": off, "outcome": "absent"})
+    run(None)  # uncrashed control: the committed file must verify clean
+    rep = verify_file(dest)
+    assert rep.ok, f"final write failed verification: {rep.summary()}"
+    results.append({"offset": None, "outcome": "clean"})
+    return results

@@ -1,0 +1,218 @@
+"""Durable write sinks: the write-side analog of ``source.py``.
+
+The read stack survives flaky storage (io/faults.py); this module makes the
+*write* stack survive crashes.  Parquet's footer-last layout means a torn
+write is detectable, but detection is not durability: a crashed writer that
+opened the destination path directly leaves a half-written file AT the
+destination, and a ``close()`` that never fsyncs leaves a "finished" file
+that the page cache can still lose.  The jax_graft north star (SURVEY.md §5
+checkpoint/resume) needs the standard stronger contract:
+
+- **Atomic commit** (:class:`AtomicFileSink`): bytes go to
+  ``<dest>.<rand>.tmp`` in the same directory; ``close()`` fsyncs the file,
+  renames it over the destination, and fsyncs the directory so the rename
+  itself is durable.  The destination path therefore either does not exist
+  or holds a complete, footer-terminated file — never a torn one.
+- **Abort** (:meth:`Sink.abort`): discard the write and remove the temp (or
+  partial) file.  ``ParquetWriter.__exit__`` aborts when an exception is in
+  flight instead of serializing a valid-looking footer over half-written
+  row groups.
+
+``ParquetWriter`` builds an :class:`AtomicFileSink` for every path sink by
+default (``WriterOptions(atomic_commit=False)`` opts into the old direct
+write via :class:`FileSink`, which still fsyncs and supports abort).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+from typing import Optional
+
+from ..errors import WriteError
+
+__all__ = ["Sink", "FileSink", "AtomicFileSink", "fsync_dir"]
+
+
+class Sink:
+    """Minimal write-side protocol the writer relies on.  Any binary
+    file-like object (``write``/``writelines``/``flush``/``close``) also
+    works; ``abort`` is what distinguishes a crash-safe sink."""
+
+    def write(self, data) -> int:
+        raise NotImplementedError
+
+    def writelines(self, parts) -> None:
+        for p in parts:
+            self.write(p)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        """Commit: make every written byte durable at the destination."""
+        raise NotImplementedError
+
+    def abort(self) -> None:
+        """Discard: release resources and leave no (partial) destination."""
+        raise NotImplementedError
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory holding ``path`` so a just-created or
+    just-renamed entry survives power loss.  Best-effort on filesystems or
+    platforms where directories cannot be opened/fsynced (the rename itself
+    already happened; only its durability ordering is at stake)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, getattr(os, "O_DIRECTORY", os.O_RDONLY))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class FileSink(Sink):
+    """Direct-to-destination path sink: no atomicity, but fsync-on-close and
+    abort-unlinks-the-partial-file.  The non-atomic mode of the writer
+    (``atomic_commit=False``) — appropriate when the destination directory
+    is not writable for siblings, or an external coordinator owns commit."""
+
+    def __init__(self, path, fsync: bool = True):
+        self.path = os.fspath(path)
+        self.fsync = fsync
+        self._f = open(self.path, "wb")
+
+    def write(self, data) -> int:
+        return self._f.write(data)
+
+    def writelines(self, parts) -> None:
+        self._f.writelines(parts)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        f, self._f = self._f, None
+        try:
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        except BaseException:
+            try:  # a failed flush/fsync must not leak the fd
+                f.close()
+            except OSError:
+                pass
+            raise
+        f.close()
+
+    def abort(self) -> None:
+        f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            # best-effort: abort usually runs inside an exception handler,
+            # and an unlink failure must not mask the original error
+            pass
+
+
+class AtomicFileSink(Sink):
+    """All-or-nothing path sink: write to ``<dest>.<rand>.tmp`` in the same
+    directory, then ``close()`` = flush → fsync(file) → rename over ``dest``
+    → fsync(dir).  Until close completes, the destination is untouched; a
+    crash at ANY byte offset leaves at most a stray ``*.tmp`` (cheap to
+    sweep — it can never be mistaken for data).  ``abort()`` unlinks the
+    temp file and is idempotent; close-after-abort raises (there is nothing
+    left to commit).
+
+    The temp file lives in the destination's directory, not ``$TMPDIR``,
+    because ``rename(2)`` is only atomic within one filesystem."""
+
+    def __init__(self, dest, fsync: bool = True):
+        self.dest = os.fspath(dest)
+        self.fsync = fsync
+        self.committed = False
+        self.temp_path: Optional[str] = \
+            f"{self.dest}.{secrets.token_hex(6)}.tmp"
+        self._f = open(self.temp_path, "wb")
+
+    def write(self, data) -> int:
+        if self._f is None:
+            raise ValueError(f"write on closed sink for {self.dest!r}")
+        return self._f.write(data)
+
+    def writelines(self, parts) -> None:
+        if self._f is None:
+            raise ValueError(f"write on closed sink for {self.dest!r}")
+        self._f.writelines(parts)
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        """Commit.  Any failure along the way aborts (the temp file is
+        removed) and re-raises — a half-committed state is never retained,
+        and the destination is never touched by a failed commit."""
+        if self.committed:
+            return
+        if self._f is None:
+            raise ValueError(
+                f"commit after abort for {self.dest!r} (nothing to commit)")
+        tp = self.temp_path
+        f, self._f = self._f, None
+        try:
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+            f.close()
+            os.replace(tp, self.dest)
+        except BaseException as e:
+            # release the fd, sweep the temp file, and surface the commit
+            # failure with both locations attached
+            try:
+                f.close()  # double-close of a file object is a no-op
+            except OSError:
+                pass
+            try:
+                os.unlink(tp)
+            except OSError:
+                pass
+            self.temp_path = None
+            if isinstance(e, OSError):
+                raise WriteError(f"atomic commit failed: {e}",
+                                 path=self.dest, temp_path=tp) from e
+            raise
+        self.temp_path = None
+        self.committed = True
+        if self.fsync:
+            # the rename is on disk only once the directory entry is:
+            # without this, a crash can resurrect the OLD destination
+            fsync_dir(self.dest)
+
+    def abort(self) -> None:
+        f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        tp, self.temp_path = self.temp_path, None
+        if tp is not None and not self.committed:
+            try:
+                os.unlink(tp)
+            except OSError:
+                # best-effort: abort usually runs inside an exception
+                # handler, and an unlink failure must not mask the original
+                pass

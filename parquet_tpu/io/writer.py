@@ -51,7 +51,14 @@ class WriterOptions:
     dictionary_page_limit: int = 1 << 20  # fall back to plain beyond this
     write_statistics: bool = True
     write_page_index: bool = True
-    write_crc: bool = False
+    # spec-standard and cheap (one zlib.crc32 per page); lets readers catch
+    # bit rot at the page that rotted instead of as a codec decode error
+    write_crc: bool = True
+    # path sinks write to <dest>.<rand>.tmp and fsync+rename on close(), so
+    # the destination is either absent or a complete committed file — never
+    # torn (io/sink.py).  False falls back to direct-to-path writes.
+    atomic_commit: bool = True
+    fsync: bool = True
     bloom_filters: Dict[str, int] = dc_field(default_factory=dict)  # path → bits/value
     created_by: str = DEFAULT_CREATED_BY
     key_value_metadata: Dict[str, str] = dc_field(default_factory=dict)
@@ -124,11 +131,27 @@ class ParquetWriter:
     """Streaming writer: accumulate columns, flush row groups, footer on close."""
 
     def __init__(self, sink, schema: Schema, options: Optional[WriterOptions] = None):
+        import os
+
         self.schema = schema
         self.options = options or WriterOptions()
-        self._own_sink = isinstance(sink, str)
-        self._f = open(sink, "wb") if isinstance(sink, str) else sink
-        self._f.write(md.MAGIC)
+        self._own_sink = isinstance(sink, (str, os.PathLike))
+        if self._own_sink:
+            from .sink import AtomicFileSink, FileSink
+
+            self._f = (AtomicFileSink(sink, fsync=self.options.fsync)
+                       if self.options.atomic_commit
+                       else FileSink(sink, fsync=self.options.fsync))
+        else:
+            self._f = sink
+        try:
+            self._f.write(md.MAGIC)
+        except BaseException:
+            # a failed first write must not leak the freshly opened file
+            # (or leave its temp/partial file behind on a path sink)
+            if self._own_sink:
+                self._f.abort()
+            raise
         self._pos = 4
         self._row_groups: List[md.RowGroup] = []
         self._column_indexes: List[List[Optional[md.ColumnIndex]]] = []
@@ -136,6 +159,7 @@ class ParquetWriter:
         self._bloom_blobs: List[List[Optional[bytes]]] = []
         self._num_rows = 0
         self._closed = False
+        self._aborted = False
         self._codec = codecs.get_codec(self.options.codec_id())
         self._dict_overflowed: set = set()  # sticky per-column fallback
         # buffered rows for write() accumulation
@@ -147,6 +171,7 @@ class ParquetWriter:
         """Buffer columnar data; full row groups are written as they fill
         (MaxRowsPerRowGroup), the sub-group tail stays buffered so streaming
         writes never fragment the file into tiny groups."""
+        self._check_open()
         if self._buffer is None:
             # shallow wrap: buffering never mutates array contents (extend
             # rebinds via np.concatenate, slicing takes views), so sharing
@@ -162,7 +187,16 @@ class ParquetWriter:
 
     def flush(self) -> None:
         """Write everything buffered, including the sub-group tail."""
+        self._check_open()
         self._drain(final=True)
+
+    def _check_open(self) -> None:
+        # buffering rows into a finalized writer would drop them silently —
+        # the buffer can never drain once close()/abort() ran
+        if self._closed or self._aborted:
+            raise ValueError("write on a "
+                             + ("closed" if self._closed else "aborted")
+                             + " writer")
 
     def _drain(self, final: bool) -> None:
         if self._buffer is None or self._buffered_rows == 0:
@@ -199,6 +233,7 @@ class ParquetWriter:
 
     # ------------------------------------------------------------------
     def write_row_group(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
+        self._check_open()
         if len(self._row_groups) >= MAX_ROW_GROUPS:
             raise TooManyRowGroupsError(
                 f"file would exceed {MAX_ROW_GROUPS} row groups "
@@ -589,8 +624,39 @@ class ParquetWriter:
 
     # ------------------------------------------------------------------
     def close(self) -> None:
+        """Finalize: drain buffers, write blooms / page index / footer, and
+        commit the sink.  ``_closed`` flips only after EVERYTHING — including
+        the path sink's fsync+rename — succeeded; a failure mid-footer
+        aborts the sink (no committed destination file is left behind) and
+        re-raises with the writer in the aborted state."""
         if self._closed:
             return
+        if self._aborted:
+            raise ValueError("cannot close an aborted writer")
+        try:
+            self._close_impl()
+        except BaseException:
+            self._aborted = True
+            if self._own_sink:
+                self._f.abort()
+            raise
+        self._closed = True
+
+    def abort(self) -> None:
+        """Discard the write: no footer is serialized, and a writer-owned
+        path sink removes its temp (or partial) file so no destination is
+        left behind.  Caller-owned sinks are left untouched (their bytes are
+        the caller's to clean up).  Idempotent; a no-op after a successful
+        :meth:`close`."""
+        if self._closed or self._aborted:
+            return
+        self._aborted = True
+        self._buffer = None
+        self._buffered_rows = 0
+        if self._own_sink:
+            self._f.abort()
+
+    def _close_impl(self) -> None:
         self.flush()
         opts = self.options
         # bloom filters (before page index, like common writers)
@@ -636,19 +702,25 @@ class ParquetWriter:
             column_orders=[md.ColumnOrder(TYPE_ORDER=md.TypeDefinedOrder())
                            for _ in self.schema.leaves])
         blob = thrift.serialize(fmd)
-        self._f.write(blob)
-        self._f.write(struct.pack("<I", len(blob)))
-        self._f.write(md.MAGIC)
+        # footer + length + magic in ONE write: a torn tail then lacks the
+        # terminal PAR1 and can never parse as a complete file
+        self._f.write(blob + struct.pack("<I", len(blob)) + md.MAGIC)
         self._f.flush()
         if self._own_sink:
-            self._f.close()
-        self._closed = True
+            self._f.close()  # sink commit: fsync (+ atomic rename)
 
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        # an in-flight exception means the stream is mid-row-group or
+        # mid-footer: serializing a footer now would produce a VALID-LOOKING
+        # file over torn data — abort (unlink temp / partial) instead.  A
+        # caller who already abort()ed inside the block gets a clean exit.
+        if exc_type is not None:
+            self.abort()
+        elif not self._aborted:
+            self.close()
 
 
 # ---------------------------------------------------------------------------
@@ -1039,16 +1111,22 @@ def write_table(table, sink, options: Optional[WriterOptions] = None,
         schema = schema_from_arrow(table.schema)
     options = options or WriterOptions()
     w = ParquetWriter(sink, schema, options)
-    n = table.num_rows
-    rg_size = min(options.row_group_size, n) if n else n
-    for start in range(0, max(n, 1), max(rg_size, 1)):
-        end = min(start + rg_size, n) if rg_size else n
-        part = table.slice(start, end - start) if (start or end < n) else table
-        cols = columns_from_arrow(part, schema)
-        w.write_row_group(cols, part.num_rows)
-        if n == 0:
-            break
-    w.close()
+    try:
+        n = table.num_rows
+        rg_size = min(options.row_group_size, n) if n else n
+        for start in range(0, max(n, 1), max(rg_size, 1)):
+            end = min(start + rg_size, n) if rg_size else n
+            part = table.slice(start, end - start) if (start or end < n) else table
+            cols = columns_from_arrow(part, schema)
+            w.write_row_group(cols, part.num_rows)
+            if n == 0:
+                break
+        w.close()
+    except BaseException:
+        # same contract as the context manager: a failed write aborts (path
+        # sinks unlink their temp/partial file) instead of leaking it
+        w.abort()
+        raise
     return w
 
 

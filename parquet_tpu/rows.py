@@ -793,10 +793,14 @@ def write_rows(sink, schema: Schema, records: Iterable[Dict[str, Any]],
     from .io.writer import ParquetWriter, WriterOptions
 
     w = ParquetWriter(sink, schema, options or WriterOptions())
-    rw = WriterRows(w, schema)
-    for rec in records:
-        rw.write_rows([deconstruct(schema, rec)])
-    rw.close()
+    try:
+        rw = WriterRows(w, schema)
+        for rec in records:
+            rw.write_rows([deconstruct(schema, rec)])
+        rw.close()
+    except BaseException:
+        w.abort()  # path sinks unlink their temp/partial file
+        raise
 
 
 def read_rows(source) -> Iterator[Dict[str, Any]]:

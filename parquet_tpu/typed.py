@@ -338,14 +338,30 @@ class TypedWriter:
         self._pending = []
 
     def close(self) -> None:
-        self.flush()
-        self.writer.close()
+        try:
+            self.flush()
+            self.writer.close()
+        except BaseException:
+            # the close-time drain can fail before writer.close() ever runs;
+            # abort so a path sink's temp file never leaks (idempotent if
+            # writer.close() already aborted)
+            self.writer.abort()
+            raise
+
+    def abort(self) -> None:
+        """Discard pending rows and abort the underlying writer (no footer;
+        path sinks leave no destination file)."""
+        self._pending = []
+        self.writer.abort()
 
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        elif not self.writer._aborted:  # caller may have abort()ed already
+            self.close()
 
 
 class TypedReader:
@@ -396,8 +412,12 @@ def write_objects(objs: Sequence[Any], sink, cls: Optional[PyType] = None,
             raise ValueError("cannot infer type from zero objects")
         cls = type(objs[0])
     w = TypedWriter(sink, cls, options)
-    w.write(list(objs))
-    w.close()
+    try:
+        w.write(list(objs))
+        w.close()
+    except BaseException:
+        w.abort()  # path sinks unlink their temp/partial file
+        raise
 
 
 def read_objects(source, cls: PyType) -> list:

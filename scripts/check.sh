@@ -68,6 +68,50 @@ except DeadlineError:
     pass
 print("chaos smoke ok: soak recovered, skip accounted, deadline fired")
 EOF
+echo "=== durability smoke (verify pass + seeded crash matrix) ==="
+python - <<'PYEOF'
+# Write fresh fixtures with OUR writer (atomic commit + CRC defaults), prove
+# them clean through verify_file AND the CLI, then run the crash-consistency
+# matrix: a hard crash at sampled byte offsets must leave the destination
+# either absent or verifying clean.  Bounded to a few seconds.
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import (WriterOptions, crash_consistency_check, verify_file,
+                         write_table)
+
+t = pa.table({"x": pa.array(np.arange(20000, dtype=np.int64)),
+              "s": pa.array([f"v{i % 29}" for i in range(20000)])})
+d = tempfile.mkdtemp(prefix="parquet_tpu_verify_")
+opts = WriterOptions(row_group_size=4000, bloom_filters={"s": 10})
+fix = os.path.join(d, "fixture.parquet")
+write_table(t, fix, opts)
+rep = verify_file(fix, decode=True)
+assert rep.ok and rep.crcs_checked > 0, rep.summary()
+rc = subprocess.run([sys.executable, "-m", "parquet_tpu", "verify", fix],
+                    capture_output=True).returncode
+assert rc == 0, f"CLI verify exit {rc} on a clean file"
+bad = bytearray(open(fix, "rb").read())
+bad[len(bad) // 2] ^= 0xFF
+badp = os.path.join(d, "bad.parquet")
+open(badp, "wb").write(bytes(bad))
+rc = subprocess.run([sys.executable, "-m", "parquet_tpu", "verify", badp],
+                    capture_output=True).returncode
+assert rc == 1, "CLI verify must fail on a corrupt file"
+res = crash_consistency_check(
+    lambda sink: write_table(t, sink, opts),
+    os.path.join(d, "crash.parquet"), samples=8, seed=0)
+absent = sum(r["outcome"] == "absent" for r in res)
+assert res[-1]["outcome"] == "clean", res
+assert not [f for f in os.listdir(d) if f.endswith(".tmp")], os.listdir(d)
+print(f"durability smoke ok: fixture verified (decode), CLI exit codes, "
+      f"{absent} crash offsets left no destination")
+PYEOF
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys

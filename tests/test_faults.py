@@ -581,3 +581,62 @@ def test_flip_mask_targets_exact_bytes(raw):
     want[4] ^= 0x01
     assert got == bytes(want)
     assert src.stats.injected_flips == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite (ISSUE 2): bit flips are caught by page CRC, not just by codec
+# decode luck — our writer now writes CRCs by default
+# ---------------------------------------------------------------------------
+def _our_raw_uncompressed() -> bytes:
+    """Written by OUR writer, uncompressed + plain-encoded: a payload bit
+    flip decodes 'fine' (to wrong values) unless the CRC catches it."""
+    import numpy as np
+    from parquet_tpu import WriterOptions, write_table
+
+    t = pa.table({"x": pa.array(np.arange(N_ROWS, dtype=np.int64))})
+    buf = io.BytesIO()
+    write_table(t, buf, WriterOptions(row_group_size=ROW_GROUP,
+                                      compression="none", dictionary=False))
+    return buf.getvalue()
+
+
+def test_crc_catches_bit_flip_in_chaos_read():
+    from parquet_tpu import ReadOptions
+
+    raw = _our_raw_uncompressed()
+    cm = ParquetFile(raw).metadata.row_groups[1].columns[0].meta_data
+    flip = cm.data_page_offset + cm.total_compressed_size // 2
+    src = FaultInjectingSource(BytesSource(raw), flip_offsets=[flip])
+    # without CRC verification the flip reads back as silently wrong data
+    quiet = ParquetFile(FaultInjectingSource(BytesSource(raw),
+                                             flip_offsets=[flip])).read()
+    clean_x = np.asarray(ParquetFile(raw).read()["x"].values)
+    # undetected corruption — the failure mode CRCs exist to close
+    assert (np.asarray(quiet["x"].values) != clean_x).any()
+    # with verify_crc the SAME flip is a located CRC error...
+    with pytest.raises(CorruptedError, match="CRC"):
+        ParquetFile(src, options=ReadOptions(verify_crc=True)).read()
+    # ...and under the skip policy it degrades to an accounted partial read
+    rep = ReadReport()
+    tab = ParquetFile(
+        FaultInjectingSource(BytesSource(raw), flip_offsets=[flip]),
+        options=ReadOptions(verify_crc=True), policy=SKIP).read(report=rep)
+    assert rep.row_groups_skipped == [1] and rep.rows_dropped == ROW_GROUP
+    assert tab.num_rows == N_ROWS - ROW_GROUP
+
+
+def test_crc_catches_bit_flip_in_streamed_read():
+    from parquet_tpu import ReadOptions
+
+    raw = _our_raw_uncompressed()
+    cm = ParquetFile(raw).metadata.row_groups[2].columns[0].meta_data
+    flip = cm.data_page_offset + cm.total_compressed_size // 2
+    src = FaultInjectingSource(BytesSource(raw), flip_offsets=[flip])
+    rep = ReadReport()
+    got = pa.concat_tables(
+        b.to_arrow() for b in iter_batches(
+            ParquetFile(src, options=ReadOptions(verify_crc=True),
+                        policy=SKIP),
+            batch_rows=500, report=rep))
+    assert rep.row_groups_skipped == [2]
+    assert got.num_rows == N_ROWS - ROW_GROUP

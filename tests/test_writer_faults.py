@@ -1,0 +1,466 @@
+"""Crash-safe write suite (ISSUE 2): atomic commit, abort-on-exception,
+write-side fault injection, the crash-consistency matrix, and end-to-end
+``verify_file`` integrity checks.
+
+The invariant under test is the write-side mirror of the chaos suite's
+(test_faults.py) read-side guarantees: whatever fault interrupts a write —
+transient I/O error, short write, full disk, or a hard crash at an arbitrary
+byte — the destination path afterwards either does not exist or holds a
+complete file that verifies clean."""
+
+import dataclasses
+import errno
+import io
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parquet_tpu import (AtomicFileSink, FaultInjectingSink, FileSink,
+                         InjectedWriterCrash, ParquetFile, ParquetWriter,
+                         TypedWriter, WriteError, WriterOptions,
+                         crash_consistency_check, schema_from_arrow,
+                         verify_file, write_table)
+from parquet_tpu.io.writer import columns_from_arrow
+
+N_ROWS = 6000
+RG = 2000  # 3 row groups
+
+
+def _make_table() -> "pa.Table":
+    return pa.table({
+        "x": pa.array(np.arange(N_ROWS, dtype=np.int64)),
+        "s": pa.array([f"v{i % 23}" for i in range(N_ROWS)]),
+    })
+
+
+@pytest.fixture(scope="module")
+def table():
+    return _make_table()
+
+
+@pytest.fixture(scope="module")
+def schema(table):
+    return schema_from_arrow(table.schema)
+
+
+def _no_temps(d) -> bool:
+    return not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# atomic commit on the happy path
+# ---------------------------------------------------------------------------
+def test_atomic_write_round_trips_and_verifies(tmp_path, table):
+    dest = tmp_path / "a.parquet"
+    write_table(table, str(dest), WriterOptions(row_group_size=RG))
+    assert _no_temps(tmp_path)
+    assert ParquetFile(str(dest)).read().to_arrow().equals(table)
+    rep = verify_file(str(dest))
+    assert rep.ok, rep.summary()
+    assert rep.crcs_checked > 0  # write_crc now defaults on
+
+
+def test_pathlike_sink_supported(tmp_path, table):
+    dest = tmp_path / "p.parquet"  # a PathLike, not a str
+    write_table(table, dest)
+    assert verify_file(dest).ok
+
+
+def test_atomic_commit_opt_out_still_cleans_on_abort(tmp_path, table, schema):
+    dest = tmp_path / "direct.parquet"
+    opts = WriterOptions(atomic_commit=False, row_group_size=RG)
+    with pytest.raises(RuntimeError):
+        with ParquetWriter(str(dest), schema, opts) as w:
+            w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+            raise RuntimeError("boom")
+    # non-atomic: bytes were going straight to dest — abort must unlink it
+    assert not dest.exists()
+
+
+# ---------------------------------------------------------------------------
+# satellite: __exit__ aborts, close is failure-safe, __init__ leaks nothing
+# ---------------------------------------------------------------------------
+def test_exit_aborts_on_exception_no_destination(tmp_path, table, schema):
+    dest = tmp_path / "b.parquet"
+    with pytest.raises(RuntimeError):
+        with ParquetWriter(str(dest), schema) as w:
+            w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+            raise RuntimeError("mid-write failure")
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+
+
+def test_abort_is_idempotent_and_blocks_close(tmp_path, schema):
+    w = ParquetWriter(str(tmp_path / "c.parquet"), schema)
+    w.abort()
+    w.abort()  # idempotent
+    with pytest.raises(ValueError, match="aborted"):
+        w.close()
+    assert _no_temps(tmp_path)
+
+
+def test_write_after_close_raises(tmp_path, table, schema):
+    dest = tmp_path / "d.parquet"
+    w = ParquetWriter(str(dest), schema)
+    cols = columns_from_arrow(table, schema)
+    w.write_row_group(cols, N_ROWS)
+    w.close()
+    with pytest.raises(ValueError, match="closed"):
+        w.write_row_group(cols, N_ROWS)
+    w.close()  # close-after-close stays a no-op
+    assert verify_file(str(dest)).ok
+
+
+def test_magic_write_failure_does_not_leak_temp(tmp_path, schema, monkeypatch):
+    def boom(self, data):
+        raise OSError(errno.EIO, "disk gone at open")
+
+    monkeypatch.setattr(AtomicFileSink, "write", boom)
+    with pytest.raises(OSError):
+        ParquetWriter(str(tmp_path / "e.parquet"), schema)
+    assert os.listdir(tmp_path) == []  # no temp file, no destination
+
+
+def test_close_commit_failure_aborts_and_raises_write_error(
+        tmp_path, table, schema, monkeypatch):
+    dest = tmp_path / "f.parquet"
+    w = ParquetWriter(str(dest), schema)
+    w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+
+    def no_replace(src, dst):
+        raise OSError(errno.EACCES, "rename denied")
+
+    monkeypatch.setattr(os, "replace", no_replace)
+    with pytest.raises(WriteError) as ei:
+        w.close()
+    assert ei.value.path == str(dest)  # located failure
+    assert not w._closed  # a failed close must not claim success
+    monkeypatch.undo()
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+    with pytest.raises(ValueError, match="aborted"):
+        w.close()
+
+
+def test_partial_footer_write_leaves_no_committed_file(tmp_path, table,
+                                                       schema):
+    # probe: how many bytes does the full write take?
+    probe = FaultInjectingSink(io.BytesIO())
+    with ParquetWriter(probe, schema) as w:
+        w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+    total = probe.stats.bytes_written
+    # replay with the disk filling up 30 bytes before the end: the footer
+    # write fails, the commit must never run
+    dest = tmp_path / "g.parquet"
+    sink = FaultInjectingSink(AtomicFileSink(str(dest)),
+                              enospc_at_byte=total - 30)
+    w = ParquetWriter(sink, schema)
+    w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+    with pytest.raises(OSError):
+        w.close()
+    assert not w._closed
+    sink.abort()  # the caller owns a non-path sink's cleanup
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+
+
+def test_typed_writer_exit_aborts(tmp_path):
+    @dataclasses.dataclass
+    class Rec:
+        x: int
+
+    dest = tmp_path / "typed.parquet"
+    with pytest.raises(RuntimeError):
+        with TypedWriter(str(dest), Rec) as tw:
+            tw.write([Rec(x=i) for i in range(100)])
+            raise RuntimeError("boom")
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# write-side fault injection
+# ---------------------------------------------------------------------------
+def test_enospc_mid_row_group(tmp_path, table, schema):
+    dest = tmp_path / "enospc.parquet"
+    sink = FaultInjectingSink(AtomicFileSink(str(dest)), enospc_at_byte=4096)
+    with pytest.raises(OSError) as ei:
+        with ParquetWriter(sink, schema,
+                           WriterOptions(row_group_size=RG)) as w:
+            w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+    assert ei.value.errno == errno.ENOSPC
+    assert sink.stats.bytes_written <= 4096  # nothing persisted past the cap
+    sink.abort()
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+
+
+def test_short_write_injection_surfaces(table, schema):
+    sink = FaultInjectingSink(io.BytesIO(), seed=3, short_write_rate=1.0)
+    with pytest.raises(OSError, match="short write"):
+        with ParquetWriter(sink, schema) as w:
+            w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+    assert sink.stats.injected_short_writes == 1
+
+
+def test_injection_is_deterministic(table, schema):
+    def run(seed):
+        sink = FaultInjectingSink(io.BytesIO(), seed=seed, error_rate=0.3)
+        try:
+            with ParquetWriter(sink, schema) as w:
+                w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+        except OSError:
+            pass
+        return (sink.stats.writes, sink.stats.bytes_written,
+                sink.stats.injected_errors)
+
+    assert run(11) == run(11)
+    assert run(11) != run(12)  # different seed, different fault schedule
+
+
+def test_crash_sink_kills_flush_and_commit():
+    sink = FaultInjectingSink(io.BytesIO(), crash_at_byte=2)
+    with pytest.raises(InjectedWriterCrash):
+        sink.write(b"PAR1")
+    assert sink.stats.crashed
+    with pytest.raises(InjectedWriterCrash):
+        sink.write(b"x")
+    with pytest.raises(InjectedWriterCrash):
+        sink.flush()
+    with pytest.raises(InjectedWriterCrash):
+        sink.close()
+
+
+def test_crash_leaves_temp_stranded_but_dest_absent(tmp_path, table, schema):
+    dest = tmp_path / "crash.parquet"
+    sink = FaultInjectingSink(AtomicFileSink(str(dest)), crash_at_byte=1000)
+    with pytest.raises(InjectedWriterCrash):
+        w = ParquetWriter(sink, schema)
+        w.write_row_group(columns_from_arrow(table, schema), N_ROWS)
+    # a dead process leaves its temp file; the destination is untouched
+    assert not dest.exists()
+    assert sink.inner.temp_path is not None
+    assert os.path.exists(sink.inner.temp_path)
+    sink.abort()  # the restarted process's *.tmp sweep
+    assert _no_temps(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: crash-consistency matrix
+# ---------------------------------------------------------------------------
+def test_crash_consistency_matrix(tmp_path, table):
+    dest = str(tmp_path / "matrix.parquet")
+    opts = WriterOptions(row_group_size=RG, bloom_filters={"s": 10})
+    results = crash_consistency_check(
+        lambda sink: write_table(table, sink, opts), dest,
+        samples=10, seed=42)
+    # every sampled crash offset left the destination absent (atomic rename
+    # means a clean-but-partial dest is impossible); the uncrashed control
+    # run committed and verified clean
+    assert [r["outcome"] for r in results[:-1]] == ["absent"] * (
+        len(results) - 1)
+    assert results[-1] == {"offset": None, "outcome": "clean"}
+    assert _no_temps(tmp_path)
+    rep = verify_file(dest, decode=True)
+    assert rep.ok and rep.chunks_decoded == 6, rep.summary()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: verify_file flags every injectable corruption class
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def clean_bytes(table):
+    buf = io.BytesIO()
+    write_table(table, buf, WriterOptions(row_group_size=RG,
+                                          bloom_filters={"s": 10}))
+    return buf.getvalue()
+
+
+def _payload_offset(raw: bytes) -> int:
+    cm = ParquetFile(raw).metadata.row_groups[0].columns[0].meta_data
+    return cm.data_page_offset + cm.total_compressed_size // 2
+
+
+def test_verify_clean_file(clean_bytes):
+    rep = verify_file(clean_bytes)
+    assert rep.ok, rep.summary()
+    assert rep.pages_checked > 0 and rep.crcs_checked > 0
+    d = rep.as_dict()
+    assert d["ok"] is True and d["issues"] == []
+
+
+def test_verify_flags_crcd_bit_flip(clean_bytes):
+    b = bytearray(clean_bytes)
+    b[_payload_offset(clean_bytes)] ^= 0x01  # single-bit rot in page body
+    rep = verify_file(bytes(b))
+    assert not rep.ok
+    assert any(i.kind == "crc" for i in rep.issues), rep.summary()
+    issue = next(i for i in rep.issues if i.kind == "crc")
+    assert issue.row_group == 0 and issue.column == "x"  # located
+
+
+def test_verify_flags_truncation(clean_bytes):
+    rep = verify_file(clean_bytes[:-500])
+    assert not rep.ok
+    assert rep.issues[0].kind in ("magic", "footer"), rep.summary()
+
+
+def test_verify_flags_bad_footer_length(clean_bytes):
+    b = bytearray(clean_bytes)
+    b[-8:-4] = (len(b) * 2).to_bytes(4, "little")
+    rep = verify_file(bytes(b))
+    assert not rep.ok and rep.issues[0].kind == "footer", rep.summary()
+
+
+def test_verify_flags_smashed_page_header(clean_bytes):
+    cm = ParquetFile(clean_bytes).metadata.row_groups[1].columns[0].meta_data
+    off = cm.dictionary_page_offset or cm.data_page_offset
+    b = bytearray(clean_bytes)
+    b[off : off + 4] = b"\xff\xff\xff\xff"
+    rep = verify_file(bytes(b))
+    assert not rep.ok
+    assert any(i.kind in ("page", "metadata") and i.row_group == 1
+               for i in rep.issues), rep.summary()
+
+
+def test_verify_decode_mode_counts_chunks(clean_bytes):
+    rep = verify_file(clean_bytes, decode=True)
+    assert rep.ok and rep.chunks_decoded == 6, rep.summary()
+
+
+def test_verify_report_is_machine_readable(clean_bytes):
+    b = bytearray(clean_bytes)
+    b[_payload_offset(clean_bytes)] ^= 0xFF
+    d = verify_file(bytes(b)).as_dict()
+    assert set(d) >= {"path", "ok", "file_size", "row_groups",
+                      "pages_checked", "crcs_checked", "issues"}
+    issue = d["issues"][0]
+    assert set(issue) == {"kind", "message", "row_group", "column", "offset"}
+
+
+def test_verify_pyarrow_written_file(table):
+    import pyarrow.parquet as pq
+
+    buf = io.BytesIO()
+    pq.write_table(table, buf, row_group_size=RG)
+    rep = verify_file(buf.getvalue())
+    assert rep.ok, rep.summary()
+
+
+# ---------------------------------------------------------------------------
+# review regressions: buffered-write guards and front-end abort
+# ---------------------------------------------------------------------------
+def test_buffered_write_and_flush_after_close_raise(tmp_path, table, schema):
+    dest = tmp_path / "h.parquet"
+    w = ParquetWriter(str(dest), schema)
+    w.write(columns_from_arrow(table, schema), N_ROWS)
+    w.close()
+    # write() buffers; without the guard these rows would vanish silently
+    with pytest.raises(ValueError, match="closed"):
+        w.write(columns_from_arrow(table, schema), N_ROWS)
+    with pytest.raises(ValueError, match="closed"):
+        w.flush()
+    w2 = ParquetWriter(str(tmp_path / "i.parquet"), schema)
+    w2.abort()
+    with pytest.raises(ValueError, match="aborted"):
+        w2.write(columns_from_arrow(table, schema), N_ROWS)
+
+
+def test_write_table_failure_aborts_path_sink(tmp_path, table):
+    from parquet_tpu.schema import schema as sch
+    from parquet_tpu.format.enums import FieldRepetitionType as Rep, Type
+    from parquet_tpu.schema.schema import Schema
+
+    dest = tmp_path / "j.parquet"
+    # schema names a column the table lacks: write_table fails mid-loop
+    bogus = Schema(sch.Node(name="schema", children=[
+        sch.leaf("missing", Type.INT64, Rep.OPTIONAL)]))
+    with pytest.raises(KeyError):
+        write_table(table, str(dest), schema=bogus)
+    assert not dest.exists()
+    assert _no_temps(tmp_path)  # the temp file was swept by abort()
+
+
+def test_commit_failure_releases_fd(tmp_path, monkeypatch):
+    import gc
+
+    def no_fsync(fd):
+        raise OSError(errno.EIO, "fsync failed")
+
+    fd_dir = "/proc/self/fd"
+    gc.collect()
+    before = len(os.listdir(fd_dir))
+    for i in range(20):
+        sink = AtomicFileSink(str(tmp_path / f"fd{i}.parquet"))
+        sink.write(b"PAR1")
+        monkeypatch.setattr(os, "fsync", no_fsync)
+        with pytest.raises(WriteError):
+            sink.close()
+        monkeypatch.undo()
+    assert len(os.listdir(fd_dir)) <= before + 1  # no fd accumulation
+    assert _no_temps(tmp_path)
+
+
+def test_intentional_abort_inside_cm_exits_cleanly(tmp_path, table, schema):
+    dest = tmp_path / "k.parquet"
+    with ParquetWriter(str(dest), schema) as w:
+        w.write(columns_from_arrow(table, schema), N_ROWS)
+        w.abort()  # caller decides to discard — must not turn into an error
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+    with TypedWriter(str(tmp_path / "l.parquet"), _Rec) as tw:
+        tw.write([_Rec(x=1)])
+        tw.abort()
+    assert _no_temps(tmp_path)
+
+
+@dataclasses.dataclass
+class _Rec:
+    x: int
+
+
+def test_typed_writer_close_drain_failure_aborts(tmp_path, monkeypatch):
+    dest = tmp_path / "m.parquet"
+    tw = TypedWriter(str(dest), _Rec)
+    tw.write([_Rec(x=i) for i in range(10)])  # stays pending
+
+    def boom(self, columns, num_rows):
+        raise OSError(errno.ENOSPC, "disk full during close-time drain")
+
+    monkeypatch.setattr(ParquetWriter, "write_row_group", boom)
+    with pytest.raises(OSError):
+        tw.close()
+    assert not dest.exists()
+    assert _no_temps(tmp_path)  # the drain failed before writer.close()
+
+
+def test_abort_unlink_failure_does_not_mask_original(tmp_path, table, schema,
+                                                     monkeypatch):
+    dest = tmp_path / "n.parquet"
+
+    def no_unlink(p):
+        raise OSError(errno.EACCES, "stale NFS handle")
+
+    with pytest.raises(RuntimeError, match="original"):
+        with ParquetWriter(str(dest), schema) as w:
+            w.write(columns_from_arrow(table, schema), N_ROWS)
+            monkeypatch.setattr(os, "unlink", no_unlink)
+            raise RuntimeError("original failure")
+    monkeypatch.undo()
+
+
+def test_sorting_spills_skip_atomic_commit(tmp_path, table):
+    from parquet_tpu import SortingColumn, SortingWriter
+
+    dest = tmp_path / "sorted.parquet"
+    with SortingWriter(str(dest), schema_from_arrow(table.schema),
+                       [SortingColumn("x", descending=True)],
+                       buffer_rows=1500) as sw:
+        sw.write_arrow(table)  # > buffer_rows: forces spills
+    # final output still verifies; spills never leaked temps anywhere
+    assert verify_file(str(dest)).ok
+    assert _no_temps(tmp_path)
+    got = np.asarray(ParquetFile(str(dest)).read()["x"].values)
+    assert (got == np.arange(N_ROWS, dtype=np.int64)[::-1]).all()
