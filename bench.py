@@ -662,10 +662,14 @@ def _cfg7(n):
     pa_s = _time_best(run_pyarrow, reps=2)
 
     pf = ParquetFile(path)
+    read_stats = {}
 
     def run_host():
         # to the same endpoint pyarrow delivers: one pyarrow.Table
-        return pf.read().to_arrow()
+        t = pf.read()
+        if t.read_stats is not None:
+            read_stats["read"] = t.read_stats.as_dict()
+        return t.to_arrow()
 
     run_host()
     host_s = _time_best(run_host, reps=2)
@@ -675,6 +679,8 @@ def _cfg7(n):
     for b in pf.iter_batches(batch_rows=1 << 20):
         b.to_arrow()
         batches += 1
+        if b.read_stats is not None:
+            read_stats["stream"] = b.read_stats.as_dict()
     stream_s = time.perf_counter() - t0
 
     out = {
@@ -687,6 +693,9 @@ def _cfg7(n):
         "pyarrow_s": round(pa_s, 3),
         "vs_pyarrow": round(pa_s / host_s, 2),
         "rows": n,
+        # io/prefetch.py observability: backend, hits/misses, bytes
+        # prefetched vs discarded, pool wait (the pipeline bubble meter)
+        "read_stats": read_stats,
     }
     import jax
 

@@ -31,6 +31,10 @@ Resilience
   FaultPolicy (retry/backoff+jitter, deadline, degraded-scan mode),
   ReadReport, ReadError/ReadIOError/DeadlineError (located failures),
   FaultInjectingSource (deterministic chaos wrapper), RetryingSource
+Read pipeline
+  PrefetchSource (ring/advise readahead over any Source), ReadStats
+  (prefetch hits/misses, bytes, pool wait — ``Table.read_stats``),
+  MmapSource (zero-copy page-cache views; default for path opens)
 Durability & integrity
   AtomicFileSink (fsync + atomic rename commit; path sinks default),
   FileSink, WriteError, FaultInjectingSink/InjectedWriterCrash (write-side
@@ -54,7 +58,8 @@ from .io.search import find, pages_overlapping, plan_scan, prune_row_group, read
 from .io.stream import iter_batches
 from .ops.encodings import (DictIndices, EncodingSpec, register_encoding,
                             registered_encodings)
-from .io.source import RetryingSource, Source
+from .io.prefetch import PrefetchSource, ReadStats
+from .io.source import MmapSource, RetryingSource, Source
 from .parallel.host_scan import (scan, scan_filtered,
                                  scan_filtered_device, scan_filtered_sharded)
 from .parallel.mesh import ShardedTable, default_mesh, read_table_sharded

@@ -36,6 +36,7 @@ Failures are *recorded*, not raised: a corrupt file yields a report whose
 
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from dataclasses import dataclass, field
@@ -131,7 +132,12 @@ def verify_file(source, crc: bool = True, indexes: bool = True,
     bytes, file-like, Source).  ``decode=True`` additionally decodes every
     column chunk (slow, strongest)."""
     src = as_source(source)
-    own = not hasattr(source, "pread")  # close only sources we constructed
+    # close only resources WE opened: paths (we opened the fd/map) and
+    # bytes (no-op).  A Source or file-like object is the caller's — a
+    # FileLikeSource wrapper's close() would close their handle out from
+    # under them.
+    own = isinstance(source, (str, os.PathLike, bytes, bytearray,
+                              memoryview))
     rep = IntegrityReport(path=getattr(src, "path", None))
     try:
         meta = _verify_envelope(src, rep)

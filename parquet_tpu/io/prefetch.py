@@ -1,0 +1,477 @@
+"""Prefetching I/O layer: overlap disk latency with decode (SURVEY.md §2.5).
+
+The streamed read path (io/stream.py) used to alternate one blocking pread
+with one page-batch decode, per cursor, per column — the disk idled during
+decode and the core idled during the pread.  This module packages readahead
+as a :class:`~parquet_tpu.io.source.Source` wrapper the stream layer (or any
+caller) installs for the duration of one drain:
+
+- **ring backend** (any inner source): planned ranges are carved into
+  coalesced windows and issued N windows ahead on the shared pool
+  (utils/pool.py); ``pread``/``pread_view`` are served zero-copy out of a
+  bounded ring of completed window buffers.  Because the background reads go
+  through the *wrapped* chain, the resilience stack composes: a
+  :class:`~parquet_tpu.io.faults.PolicySource` underneath retries transient
+  errors and enforces the operation deadline inside the worker, and any
+  surviving error is re-raised on the consuming thread at the ``pread`` that
+  needed the bytes — inside the caller's ``read_context``, so the surfaced
+  ``ReadError`` still names file/row-group/column.
+- **advise backend** (chain bottoming out at an
+  :class:`~parquet_tpu.io.source.MmapSource`): reads are already zero-copy
+  views of the page cache, so no buffers are staged; planned ranges are
+  instead hinted to the kernel with ``madvise(WILLNEED)`` N windows ahead of
+  the consumption frontier — asynchronous readahead by DMA, no threads, and
+  therefore profitable even on a single core.
+
+Env knobs (documented in README "Read pipeline"):
+
+- ``PARQUET_TPU_PREFETCH``: ``0`` off, ``1``/``auto`` (default) pick per
+  chain (advise for mmap-backed chains; ring when >1 CPU), ``ring`` force
+  the pool backend (chaos tests on small hosts), ``mmap`` advise-only.
+- ``PARQUET_TPU_PREFETCH_WINDOW``: window bytes (default 2 MiB).
+- ``PARQUET_TPU_PREFETCH_DEPTH``: windows issued ahead per planned range
+  (default 2).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import TimeoutError as _FutTimeout
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from ..errors import DeadlineError
+from .source import MmapSource, Source
+
+__all__ = ["ReadStats", "PrefetchSource", "prefetch_mode", "make_prefetcher"]
+
+DEFAULT_WINDOW_BYTES = 2 << 20
+DEFAULT_DEPTH = 2
+
+
+def prefetch_mode() -> str:
+    """Resolve ``PARQUET_TPU_PREFETCH`` to off | auto | ring | mmap."""
+    v = os.environ.get("PARQUET_TPU_PREFETCH", "1").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v in ("ring", "pool"):
+        return "ring"
+    if v in ("mmap", "advise"):
+        return "mmap"
+    return "auto"
+
+
+@dataclass
+class ReadStats:
+    """What the prefetching read actually did (observability; surfaced as
+    ``Table.read_stats`` and in bench.py's lineitem config).
+
+    ``prefetch_hits``/``prefetch_misses`` count preads served from (vs.
+    around) the readahead state; ``bytes_prefetched`` counts window bytes
+    issued ahead (ring: read into the ring; advise: hinted to the kernel),
+    ``bytes_discarded`` window bytes dropped unconsumed (evictions, close),
+    and ``pool_wait_s`` time the consuming thread blocked on a window whose
+    background read had not finished — the pipeline's bubble meter: ~0 means
+    IO fully hid behind decode."""
+
+    backend: str = ""
+    prefetch_hits: int = 0
+    prefetch_misses: int = 0
+    windows_issued: int = 0
+    bytes_prefetched: int = 0
+    bytes_discarded: int = 0
+    pool_wait_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {"backend": self.backend,
+                "prefetch_hits": self.prefetch_hits,
+                "prefetch_misses": self.prefetch_misses,
+                "windows_issued": self.windows_issued,
+                "bytes_prefetched": self.bytes_prefetched,
+                "bytes_discarded": self.bytes_discarded,
+                "pool_wait_s": round(self.pool_wait_s, 4)}
+
+
+class _Window:
+    """One in-flight or completed window read."""
+
+    __slots__ = ("offset", "end", "future", "plan")
+
+    def __init__(self, offset: int, end: int, future, plan):
+        self.offset = offset
+        self.end = end
+        self.future = future
+        self.plan = plan
+
+
+def _as_u8(buf) -> np.ndarray:
+    """Window buffer (ndarray, memoryview, or bytes — injector wrappers
+    return bytes) as a sliceable uint8 array, zero-copy where possible."""
+    if isinstance(buf, np.ndarray):
+        return buf
+    return np.frombuffer(buf, np.uint8)
+
+
+class _Plan:
+    """One registered sequential range [start, end); ``issue`` is the
+    readahead frontier — bytes below it are already issued/hinted."""
+
+    __slots__ = ("start", "issue", "end")
+
+    def __init__(self, start: int, end: int):
+        self.start = start
+        self.issue = start
+        self.end = end
+
+
+def _innermost(src: Source) -> Source:
+    seen = set()
+    while hasattr(src, "inner") and id(src) not in seen:
+        seen.add(id(src))
+        src = src.inner
+    return src
+
+
+class PrefetchSource(Source):
+    """Readahead wrapper over any :class:`Source` (see module docstring).
+
+    ``backend='ring'`` issues coalesced window reads on the shared pool and
+    serves from a bounded ring; ``backend='advise'`` (mmap-backed chains)
+    hints the kernel instead and reads through.  Callers declare upcoming
+    sequential ranges with :meth:`plan` (the stream layer plans the current
+    and next row group's chunk byte ranges — the row-group double buffer);
+    reads outside planned windows fall through to the inner source and are
+    counted as misses.
+
+    The wrapper is transient — one per drain — and does **not** own the
+    inner source unless ``owns_inner=True``: ``close()`` cancels outstanding
+    window reads and drops buffers, leaving the file open for the next
+    operation.
+    """
+
+    def __init__(self, inner: Source, backend: str = "ring",
+                 window_bytes: Optional[int] = None,
+                 depth: Optional[int] = None,
+                 max_windows: int = 32,
+                 stats: Optional[ReadStats] = None,
+                 owns_inner: bool = False):
+        if backend not in ("ring", "advise"):
+            raise ValueError(f"unknown prefetch backend {backend!r}")
+        self.inner = inner
+        self.backend = backend
+        self.window_bytes = int(window_bytes or os.environ.get(
+            "PARQUET_TPU_PREFETCH_WINDOW", DEFAULT_WINDOW_BYTES))
+        if self.window_bytes <= 0:
+            raise ValueError("window_bytes must be positive")
+        self.depth = int(depth or os.environ.get(
+            "PARQUET_TPU_PREFETCH_DEPTH", DEFAULT_DEPTH))
+        self.max_windows = max(2, int(max_windows))
+        self.stats = stats if stats is not None else ReadStats()
+        self.stats.backend = backend
+        self._owns_inner = owns_inner
+        self._lock = threading.Lock()
+        self._plans: List[_Plan] = []
+        self._ring: List[_Window] = []  # issue order (oldest first)
+        self._mmap = _innermost(inner) if backend == "advise" else None
+        if backend == "advise" and not isinstance(self._mmap, MmapSource):
+            raise ValueError("advise backend needs an MmapSource-backed chain")
+        self._closed = False
+
+    @property
+    def path(self):
+        return getattr(self.inner, "path", None)
+
+    # ------------------------------------------------------------- planning
+    def plan(self, offset: int, size: int) -> None:
+        """Declare an upcoming sequential read range; the prefetcher keeps
+        up to ``depth`` windows of each plan issued ahead of consumption."""
+        if size <= 0 or self._closed:
+            return
+        with self._lock:
+            self._plans.append(_Plan(offset, offset + size))
+            self._pump_locked()
+
+    def unplan(self, offset: int, size: int) -> None:
+        """Cancel the plan registered as (offset, size) and drop its
+        windows.  The stream layer calls this for every chunk of a row
+        group ``skip_row_group`` abandons — otherwise the dead plans would
+        pin their issued windows in the ring for the rest of the drain
+        (plans retire on consumption, which will never come) and later row
+        groups would prefetch nothing."""
+        end = offset + size
+        with self._lock:
+            dead = [p for p in self._plans
+                    if p.start == offset and p.end == end]
+            for p in dead:
+                self._plans.remove(p)
+            dropped = [w for w in self._ring if w.plan in dead]
+            for w in dropped:
+                w.future.cancel()
+                self._ring.remove(w)
+                self.stats.bytes_discarded += w.end - w.offset
+            if dropped:
+                self._pump_locked()
+
+    def _pump_locked(self) -> None:
+        """Keep windows issued ahead: round-robin across plans (consumption
+        interleaves across column chunks the same way), bounded by the ring
+        capacity and ``depth`` windows per plan beyond the oldest."""
+        if self.backend == "advise":
+            self._advise_locked()
+            return
+        from ..utils.pool import submit as pool_submit
+
+        progressed = True
+        while progressed and len(self._ring) < self.max_windows:
+            progressed = False
+            for plan in list(self._plans):
+                if plan.issue >= plan.end:
+                    self._plans.remove(plan)
+                    continue
+                # per-plan depth bound: at most `depth` un-consumed windows
+                # of this plan in the ring at a time (adjacent plans — the
+                # next chunk's byte range — must not absorb this plan's
+                # budget, so windows are tagged with their plan)
+                if sum(1 for w in self._ring
+                       if w.plan is plan) >= self.depth:
+                    continue
+                if len(self._ring) >= self.max_windows:
+                    break
+                end = min(plan.issue + self.window_bytes, plan.end)
+                fut = pool_submit(self.inner.pread_view, plan.issue,
+                                  end - plan.issue)
+                # retrieve abandoned errors so a window cancelled/failed
+                # after close never logs "exception was never retrieved";
+                # consumers still see the error through result()
+                fut.add_done_callback(
+                    lambda f: None if f.cancelled() else f.exception())
+                win = _Window(plan.issue, end, fut, plan)
+                self._ring.append(win)
+                self.stats.windows_issued += 1
+                self.stats.bytes_prefetched += end - plan.issue
+                plan.issue = end
+                progressed = True
+
+    def _advise_locked(self) -> None:
+        """Hint the kernel ``depth`` windows ahead of each plan's frontier.
+        Exhausted plans stay registered (they cost nothing and keep the
+        hit/miss classification of late re-reads honest)."""
+        for plan in self._plans:
+            ahead = min(plan.issue + self.depth * self.window_bytes,
+                        plan.end)
+            if ahead > plan.issue:
+                self._mmap.madvise_willneed(plan.issue, ahead - plan.issue)
+                self.stats.windows_issued += 1
+                self.stats.bytes_prefetched += ahead - plan.issue
+                plan.issue = ahead
+
+    def _advance_advise(self, upto: int) -> None:
+        """Consumption reached ``upto``: keep the willneed horizon ``depth``
+        windows ahead of it for the plan covering it."""
+        with self._lock:
+            for plan in self._plans:
+                if plan.start <= upto <= plan.end:
+                    ahead = min(upto + (self.depth + 1) * self.window_bytes,
+                                plan.end)
+                    if ahead > plan.issue:
+                        self._mmap.madvise_willneed(plan.issue,
+                                                    ahead - plan.issue)
+                        self.stats.windows_issued += 1
+                        self.stats.bytes_prefetched += ahead - plan.issue
+                        plan.issue = ahead
+                    break
+
+    # ------------------------------------------------------------- serving
+    def _deadline(self):
+        """The active operation deadline of a PolicySource underneath, if
+        any — waits on in-flight windows honor it so injected latency in a
+        queued prefetch cannot stall past ``deadline_s``."""
+        src = self.inner
+        seen = set()
+        while src is not None and id(src) not in seen:
+            seen.add(id(src))
+            dl = getattr(src, "_deadline", None)
+            if dl is not None:
+                return dl
+            src = getattr(src, "inner", None)
+        return None
+
+    def _await(self, win: _Window):
+        """Wait for a window's background read, deadline-aware: even with a
+        prefetch queued behind injected latency, ``deadline_s`` fires
+        promptly on the consuming thread instead of blocking until the
+        worker returns."""
+        fut = win.future
+        if fut.done():
+            return fut.result()
+        t0 = time.perf_counter()
+        try:
+            while True:
+                dl = self._deadline()
+                rem = dl.remaining() if dl is not None else None
+                if rem is not None and rem <= 0:
+                    raise DeadlineError(
+                        f"deadline exceeded waiting for prefetched window "
+                        f"at {win.offset}")
+                try:
+                    # bounded wait even with no deadline: re-check each lap
+                    # so a deadline INSTALLED after the wait began (a new
+                    # operation scope) still fires promptly
+                    return fut.result(timeout=min(rem, 0.05)
+                                      if rem is not None else 0.05)
+                except (_FutTimeout, TimeoutError):
+                    continue
+        finally:
+            waited = time.perf_counter() - t0
+            with self._lock:
+                self.stats.pool_wait_s += waited
+
+    def _serve(self, offset: int, size: int, want_view: bool):
+        end = offset + size
+        if self.backend == "advise":
+            with self._lock:
+                covered = any(p.start <= offset and end <= p.end
+                              and p.issue >= end for p in self._plans)
+                self.stats.prefetch_hits += covered
+                self.stats.prefetch_misses += not covered
+            out = (self.inner.pread_view(offset, size) if want_view
+                   else self.inner.pread(offset, size))
+            self._advance_advise(end)
+            return out
+        # ring: find a covering chain of windows (cursor reads rarely align
+        # with window boundaries, so a read often spans two)
+        with self._lock:
+            chain = sorted((w for w in self._ring
+                            if w.offset < end and w.end > offset),
+                           key=lambda w: w.offset)
+            covered = bool(chain) and chain[0].offset <= offset \
+                and chain[-1].end >= end
+            pos = offset
+            for w in chain:
+                if covered and w.offset > pos:
+                    covered = False
+                pos = w.end
+        from ..utils.pool import in_shared_pool
+
+        if covered and in_shared_pool():
+            # secure the chain: a window still QUEUED (not started) may sit
+            # behind our own caller's tasks on the shared pool — a pool
+            # worker waiting on it would deadlock (all workers blocked on
+            # futures none of them will run).  cancel() succeeds exactly
+            # for never-started futures; those bytes are read through
+            # instead (counted as a miss, not a stall).  Non-pool
+            # consumers wait normally — their windows always get a worker.
+            cancelled = [w for w in chain if w.future.cancel()]
+            if cancelled:
+                with self._lock:
+                    for w in cancelled:
+                        if w in self._ring:
+                            self._ring.remove(w)
+                        self.stats.bytes_discarded += w.end - w.offset
+                covered = False
+        if not covered:
+            with self._lock:
+                self.stats.prefetch_misses += 1
+            return (self.inner.pread_view(offset, size) if want_view
+                    else self.inner.pread(offset, size))
+        bufs = []
+        for w in chain:
+            try:
+                bufs.append(self._await(w))
+            except BaseException:
+                # a failed window must not be served (or waited on) again —
+                # drop it so retrying consumers read through / get fresh
+                # windows, and surface the error HERE, on the consuming
+                # thread, inside the caller's read_context
+                with self._lock:
+                    if w in self._ring:
+                        self._ring.remove(w)
+                    self._pump_locked()
+                raise
+        with self._lock:
+            self.stats.prefetch_hits += 1
+        if len(chain) == 1:
+            w = chain[0]
+            out = bufs[0][offset - w.offset : end - w.offset]
+        else:
+            out = np.concatenate(
+                [_as_u8(b)[max(offset - w.offset, 0)
+                           : min(end, w.end) - w.offset]
+                 for w, b in zip(chain, bufs)])
+        # consume windows the sequential reader has fully passed
+        with self._lock:
+            drop = [w for w in chain if w.end <= end]
+            for w in drop:
+                if w in self._ring:
+                    self._ring.remove(w)
+            if drop:
+                self._pump_locked()
+        if want_view:
+            return out
+        return out.tobytes() if hasattr(out, "tobytes") else bytes(out)
+
+    def pread(self, offset: int, size: int) -> bytes:
+        return self._serve(offset, size, want_view=False)
+
+    def pread_view(self, offset: int, size: int):
+        return self._serve(offset, size, want_view=True)
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._plans.clear()
+            for w in self._ring:
+                if not w.future.cancel() and w.future.done():
+                    try:
+                        w.future.result()
+                    except BaseException:
+                        pass
+                self.stats.bytes_discarded += w.end - w.offset
+            self._ring.clear()
+        if self._owns_inner:
+            self.inner.close()
+
+
+def make_prefetcher(source: Source,
+                    stats: Optional[ReadStats] = None,
+                    n_streams: int = 1) -> Optional[PrefetchSource]:
+    """Build the prefetcher the auto policy picks for ``source``, or None
+    when prefetching is off / cannot pay here.
+
+    advise for chains bottoming out at an :class:`MmapSource` (zero threads,
+    single-core-safe); ring when the host has cores to spare for background
+    IO (on one core a pread against a warm page cache is a memcpy that
+    *competes* with decode — measured regression, so auto never rings
+    there); ``PARQUET_TPU_PREFETCH=ring`` forces the pool backend anyway
+    (chaos tests, known-cold caches).  ``n_streams`` sizes the ring so
+    interleaved column cursors don't evict each other's windows.
+    """
+    from ..utils.pool import available_cpus, in_shared_pool
+    from .source import FileLikeSource, FileSource
+
+    mode = prefetch_mode()
+    if mode == "off":
+        return None
+    deepest = _innermost(source)
+    if mode in ("auto", "mmap") and isinstance(deepest, MmapSource):
+        return PrefetchSource(source, backend="advise", stats=stats)
+    if mode == "mmap":
+        return None
+    # auto rings only chains that bottom out in real IO: an in-memory
+    # BytesSource has no disk latency to hide, so background "reads" would
+    # be pure pool-dispatch overhead.  Forced ring mode skips the gate
+    # (chaos tests wrap BytesSource deliberately).
+    real_io = isinstance(deepest, (FileSource, FileLikeSource))
+    if mode == "ring" or (mode == "auto" and real_io
+                          and available_cpus() > 1
+                          and not in_shared_pool()):
+        return PrefetchSource(source, backend="ring", stats=stats,
+                              max_windows=max(8, 2 * n_streams))
+    return None

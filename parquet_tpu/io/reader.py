@@ -219,7 +219,8 @@ class ColumnChunkReader:
             yield PageInfo(header=h, payload=rawv[data_pos : data_pos + clen],
                            offset=start + row[PG_HEADER_POS])
 
-    def pages_streamed(self, window: int = 1 << 20) -> Iterator[PageInfo]:
+    def pages_streamed(self, window: int = 1 << 20,
+                       source: Optional[Source] = None) -> Iterator[PageInfo]:
         """Bounded-memory page iterator: windowed incremental preads instead
         of one whole-chunk read — the analog of the reference's
         ``PageBufferSize`` streaming (SURVEY.md §5).  Memory is O(window)
@@ -234,16 +235,21 @@ class ColumnChunkReader:
         (memoryview/ndarray), not ``bytes`` — wrap in ``bytes(...)`` before
         concatenation/hashing/pickling — and a retained payload pins its
         whole read window (~``window`` bytes); copy out pages you keep
-        past the iteration."""
+        past the iteration.
+
+        ``source`` overrides where the windowed preads go (the stream
+        layer passes its per-drain :class:`~parquet_tpu.io.prefetch.
+        PrefetchSource` here so windows are served from the readahead
+        ring/page cache); default is the file's source."""
         start, size = self.byte_range
         # proportional bound: never pull more than 1/16 of the chunk per
         # pread (64 KB floor), so small chunks keep page-scale reads while
         # large chunks get full readahead windows
         window = max(min(window, size // 16), 1 << 16)
         if _native.get_lib() is None:
-            yield from self._pages_streamed_python(window, 0, 0)
+            yield from self._pages_streamed_python(window, 0, 0, source)
             return
-        src_ = self.file.source
+        src_ = source if source is not None else self.file.source
         pos = 0
         values_seen = 0
         total = self.meta.num_values
@@ -254,7 +260,7 @@ class ColumnChunkReader:
                                                     total - values_seen)
             if res is None:  # scanner refused: python walk from here on
                 yield from self._pages_streamed_python(window, pos,
-                                                       values_seen)
+                                                       values_seen, source)
                 return
             rows, consumed, seen = res
             if len(rows) == 0:
@@ -269,7 +275,7 @@ class ColumnChunkReader:
                             0)
                     except Exception:
                         yield from self._pages_streamed_python(
-                            window, pos, values_seen)
+                            window, pos, values_seen, source)
                         return
                     clen = _checked_page_size(header, start + pos)
                     if pos + data_pos + clen > size:
@@ -280,7 +286,7 @@ class ColumnChunkReader:
                         # missing num_values, ...): the python walk owns
                         # it — growing again would loop forever
                         yield from self._pages_streamed_python(
-                            window, pos, values_seen)
+                            window, pos, values_seen, source)
                         return
                     win = data_pos + clen  # exactly this oversized page
                     continue
@@ -292,10 +298,12 @@ class ColumnChunkReader:
             win = window
 
     def _pages_streamed_python(self, window: int, pos: int,
-                               values_seen: int) -> Iterator[PageInfo]:
+                               values_seen: int,
+                               source: Optional[Source] = None
+                               ) -> Iterator[PageInfo]:
         """Python thrift fallback for pages_streamed (precise errors)."""
         start, size = self.byte_range
-        src = self.file.source
+        src = source if source is not None else self.file.source
         total = self.meta.num_values
         buf = b""
         boff = 0
@@ -751,6 +759,7 @@ class ParquetFile:
             paths = list(dict.fromkeys(leaf.dotted_path for leaf in leaves))
             parts: Dict[str, List[Column]] = {p: [] for p in paths}
             got_rows = 0
+            read_stats = None
             for batch in _iter_batches_impl(self, paths, 1 << 20,
                                             strict_batch_rows=False,
                                             skip=False, report=None):
@@ -759,9 +768,12 @@ class ParquetFile:
                 for p in paths:
                     parts[p].extend(bp[p])
                 got_rows += batch.num_rows
+                read_stats = batch.read_stats
             if got_rows == total_rows:
-                return Table(self.schema, None, total_rows, parts=parts,
-                             dict_fields=self.arrow_dictionary_fields)
+                t = Table(self.schema, None, total_rows, parts=parts,
+                          dict_fields=self.arrow_dictionary_fields)
+                t.read_stats = read_stats
+                return t
             # row count surprise (footer vs row-group metadata): release
             # the streamed copy, then let the chunk path report precisely
             del parts
@@ -884,6 +896,9 @@ class Table:
         # populated by policy/report reads (io/faults.py ReadReport):
         # degraded reads record skipped row groups and retry counts here
         self.report = None
+        # populated by prefetching reads (io/prefetch.py ReadStats):
+        # hits/misses, bytes prefetched vs discarded, pool wait time
+        self.read_stats = None
 
     @property
     def columns(self) -> Dict[str, Column]:
@@ -1354,15 +1369,12 @@ def _batch_decompress(page_list, codec):
     if len(srcs) < 2:  # a single page gains nothing over the direct call
         return None
     from .. import native as _nat
-    from ..utils.pool import available_cpus, in_shared_pool
 
     # read() already fans chunks across the shared pool — a per-chunk
     # thread split on top would oversubscribe (pool width x 8 native
     # threads); keep the split for single-chunk/streaming callers only.
     # The pool dispatch marks its workers explicitly (utils/pool.py submit).
-    res = _nat.decompress_pages(srcs, sizes, int(cid),
-                                1 if in_shared_pool()
-                                else min(available_cpus(), 8))
+    res = _nat.decompress_pages(srcs, sizes, int(cid), _nat._auto_threads())
     if res is None:
         return None
     buf, offs = res

@@ -22,6 +22,7 @@ every mainstream writer guarantees and DataPageV2 requires.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence
 
@@ -36,6 +37,10 @@ from .reader import (ParquetFile, Table, decode_chunk_host,
                      decode_dictionary_page, verify_page_crc)
 
 __all__ = ["iter_batches"]
+
+# same measured crossover as parallel/host_scan.py and the whole-file read:
+# below ~2M cells the per-task pool dispatch beats the decode win
+_PARALLEL_MIN_CELLS = 2_000_000
 
 
 @dataclass
@@ -62,9 +67,11 @@ def piece_from_column(col: Column) -> "_PagePiece":
 @dataclass
 class _ChunkCursor:
     """Incremental decoder for one column chunk: pulls pages on demand,
-    holds only decoded-but-unconsumed pieces."""
+    holds only decoded-but-unconsumed pieces.  ``source`` overrides where
+    the windowed preads go (the per-drain prefetcher)."""
 
     chunk: object  # ColumnChunkReader
+    source: object = None
     pages: Iterator = None
     dictionary: object = None
     pieces: List[_PagePiece] = field(default_factory=list)
@@ -72,7 +79,7 @@ class _ChunkCursor:
     exhausted: bool = False
 
     def __post_init__(self):
-        self.pages = self.chunk.pages_streamed()
+        self.pages = self.chunk.pages_streamed(source=self.source)
 
     def _pull_pages(self, need_rows: int) -> bool:
         """Pull the pages covering the next ``need_rows`` rows and decode
@@ -214,9 +221,53 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
                                       strict_batch_rows, skip, report)
 
 
+def _take_contextual(pf, cursor, path, rg_index, take):
+    """One column's take, wrapped in read_context so failures — on this
+    thread or a pool worker — surface as located ReadErrors."""
+    with read_context(path=pf._path, row_group=rg_index, column=path):
+        pieces, got = cursor.take(take)
+        if got != take:
+            raise CorruptedError(
+                f"streaming cursor yielded {got} of {take} rows "
+                "(page stream shorter than row-group metadata)")
+        return pieces
+
+
 def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
                        report) -> Iterator[Table]:
-    rg_iter = iter(range(len(pf.row_groups)))
+    from ..utils.pool import available_cpus, in_shared_pool
+    from .prefetch import make_prefetcher
+
+    n_rg = len(pf.row_groups)
+    # ---- layer 1: prefetching IO (io/prefetch.py).  One per drain; plans
+    # are registered per row group, double-buffered: when row group N's
+    # cursors are built, N+1's chunk ranges are planned too, so page decode
+    # of N overlaps readahead of N+1.
+    pre = make_prefetcher(pf.source, n_streams=len(paths))
+    stats = pre.stats if pre is not None else None
+    planned = -1
+
+    def plan_rg(i: int) -> None:
+        nonlocal planned
+        if pre is None or i >= n_rg or i <= planned:
+            return
+        planned = i
+        for p in paths:
+            pre.plan(*pf.row_group(i).column(p).byte_range)
+
+    # ---- layer 2: parallel streamed decode.  Per batch step, the
+    # per-column takes (pread + decompress + decode — all GIL-releasing in
+    # the codec/native layers) fan out across the shared pool.  Serial
+    # below the measured crossover, on one core (threads are a pure loss
+    # against a warm page cache there), and when already inside a pool
+    # worker (no nested-fanout deadlocks).
+    use_pool = (len(paths) > 1 and available_cpus() > 1
+                and not in_shared_pool()
+                and pf.num_rows * len(paths) >= _PARALLEL_MIN_CELLS
+                and os.environ.get("PARQUET_TPU_STREAM_PARALLEL", "1")
+                not in ("0",))
+
+    rg_iter = iter(range(n_rg))
     cursors: Optional[Dict[str, _ChunkCursor]] = None
     rg_rows_left = 0
     pending: Dict[str, List[Column]] = {p: [] for p in paths}
@@ -234,55 +285,88 @@ def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
         if report is not None:
             report.rows_read += pending_rows
             t.report = report
+        t.read_stats = stats
         pending = {p: [] for p in paths}
         pending_rows = 0
         return t
 
-    while True:
-        if rg_rows_left == 0:
-            rg_index = next(rg_iter, None)
-            if rg_index is None:
-                break
-            rg = pf.row_group(rg_index)
-            cursors = {p: _ChunkCursor(chunk=rg.column(p)) for p in paths}
-            rg_rows_left = rg.num_rows
-        take = min(batch_rows - pending_rows, rg_rows_left)
-        # snapshot so a mid-take corruption can roll back this step's
-        # partial, column-misaligned contributions
-        marks = {p: len(pending[p]) for p in paths}
-        try:
-            for p in paths:
-                with read_context(path=pf._path, row_group=rg_index,
-                                  column=p):
-                    pieces, got = cursors[p].take(take)
-                    if got != take:
-                        raise CorruptedError(
-                            f"streaming cursor yielded {got} of {take} rows "
-                            "(page stream shorter than row-group metadata)")
-                    pending[p].extend(pieces)
-        except DeadlineError:
-            raise
-        except CorruptedError as e:
-            if not skip:
+    def take_all(take: int) -> None:
+        """All columns' takes for one step, pooled or serial; extends
+        ``pending`` only after every column succeeded (order-stable)."""
+        if use_pool:
+            from ..utils.pool import submit as pool_submit
+
+            futs = [(p, pool_submit(_take_contextual, pf, cursors[p], p,
+                                    rg_index, take)) for p in paths]
+            results, first_err = {}, None
+            for p, f in futs:
+                try:
+                    results[p] = f.result()
+                except DeadlineError:
+                    raise
+                except Exception as e:
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
+        else:
+            results = {p: _take_contextual(pf, cursors[p], p, rg_index,
+                                           take) for p in paths}
+        for p in paths:
+            pending[p].extend(results[p])
+
+    try:
+        while True:
+            if rg_rows_left == 0:
+                rg_index = next(rg_iter, None)
+                if rg_index is None:
+                    break
+                rg = pf.row_group(rg_index)
+                plan_rg(rg_index)
+                plan_rg(rg_index + 1)  # double buffer: readahead of N+1
+                cursors = {p: _ChunkCursor(chunk=rg.column(p), source=pre)
+                           for p in paths}
+                rg_rows_left = rg.num_rows
+            take = min(batch_rows - pending_rows, rg_rows_left)
+            # snapshot so a mid-take corruption can roll back this step's
+            # partial, column-misaligned contributions
+            marks = {p: len(pending[p]) for p in paths}
+            try:
+                take_all(take)
+            except DeadlineError:
                 raise
-            for p in paths:
-                del pending[p][marks[p]:]
-            # rows of this group already yielded (or aligned in pending from
-            # earlier steps) decoded fine and stay; only the remainder drops
-            report.record_skip(rg_index, rows=rg_rows_left, error=e)
-            rg_rows_left = 0
-            continue
-        pending_rows += take
-        rg_rows_left -= take
-        # Flush at row-group boundaries too (batches are "at most
-        # batch_rows" — a snapped batch is legal and value-identical in
-        # concatenation): a batch spanning row groups would pay a full
-        # column concat at flush, the measured remainder of the streaming
-        # read's deficit vs the whole-file read.  Keep accumulating only
-        # when the pending batch is under half target (tiny row groups).
-        if pending_rows >= batch_rows or (
-                not strict_batch_rows and rg_rows_left == 0
-                and pending_rows * 2 >= batch_rows):
+            except CorruptedError as e:
+                if not skip:
+                    raise
+                for p in paths:
+                    del pending[p][marks[p]:]
+                # rows of this group already yielded (or aligned in pending
+                # from earlier steps) decoded fine and stay; only the
+                # remainder drops
+                report.record_skip(rg_index, rows=rg_rows_left, error=e)
+                rg_rows_left = 0
+                if pre is not None:
+                    # the abandoned group's plans would otherwise pin their
+                    # issued windows for the rest of the drain (they retire
+                    # on consumption, which will never come)
+                    for p in paths:
+                        pre.unplan(*rg.column(p).byte_range)
+                continue
+            pending_rows += take
+            rg_rows_left -= take
+            # Flush at row-group boundaries too (batches are "at most
+            # batch_rows" — a snapped batch is legal and value-identical in
+            # concatenation): a batch spanning row groups would pay a full
+            # column concat at flush, the measured remainder of the
+            # streaming read's deficit vs the whole-file read.  Keep
+            # accumulating only when the pending batch is under half target
+            # (tiny row groups).
+            if pending_rows >= batch_rows or (
+                    not strict_batch_rows and rg_rows_left == 0
+                    and pending_rows * 2 >= batch_rows):
+                yield flush()
+        if pending_rows:
             yield flush()
-    if pending_rows:
-        yield flush()
+    finally:
+        if pre is not None:
+            pre.close()  # cancel queued windows; the file stays open

@@ -261,22 +261,24 @@ class ParquetWriter:
         # buffering the row group's compressed pages until emit.  On one
         # core a pool measured ~15% SLOWER (GIL'd numpy dispatch), so the
         # serial one-chunk-buffered interleave is kept there.
-        from ..utils.pool import available_cpus
+        from ..utils.pool import (available_cpus, in_shared_pool, mark_pooled,
+                                  shared_pool)
 
         ncpu = available_cpus()
         work_bytes = sum(getattr(np.asarray(d.values), "nbytes", 0)
                          for d in datas)
-        # small row groups stay serial even on multi-core: pool setup plus
-        # GIL'd numpy dispatch beats the parallelism below ~8 MB of input
-        if ncpu > 1 and len(leaves) > 1 and work_bytes >= (8 << 20):
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(
-                    max_workers=min(len(leaves), ncpu, 8)) as pool:
-                encs = list(pool.map(
-                    lambda pair: self._encode_chunk(pair[0], pair[1],
-                                                    num_rows),
-                    zip(leaves, datas)))
+        # small row groups stay serial even on multi-core: GIL'd numpy
+        # dispatch beats the parallelism below ~8 MB of input.  The fan-out
+        # runs on the process-wide shared pool (utils/pool.py) — a fresh
+        # ThreadPoolExecutor here cost pool setup PER ROW GROUP on
+        # multi-row-group writes; mark_pooled keeps the workers' native
+        # thread splits at 1 (no pool x native oversubscription).
+        if ncpu > 1 and len(leaves) > 1 and work_bytes >= (8 << 20) \
+                and not in_shared_pool():
+            encs = list(shared_pool().map(
+                mark_pooled(lambda pair: self._encode_chunk(pair[0], pair[1],
+                                                            num_rows)),
+                zip(leaves, datas)))
         else:
             encs = (self._encode_chunk(leaf, data, num_rows)
                     for leaf, data in zip(leaves, datas))

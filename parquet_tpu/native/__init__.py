@@ -48,6 +48,16 @@ def _build() -> bool:
         return False
 
 
+def _auto_threads() -> int:
+    """Default native thread split: all cores, capped at 8 — but 1 inside a
+    shared-pool worker (the pool already owns the cores; pool width x native
+    threads would oversubscribe).  One rule for every threaded native entry
+    point so the guard can't drift per call site."""
+    from ..utils.pool import available_cpus, in_shared_pool
+
+    return 1 if in_shared_pool() else min(available_cpus(), 8)
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:
@@ -558,9 +568,7 @@ def delta_decode(buf: np.ndarray, mb_bitoffs, mb_widths, mb_mins,
     out = np.empty(int(out_start[-1]), np.int64)
     buf = np.ascontiguousarray(buf)
     if not nthreads:
-        from ..utils.pool import available_cpus
-
-        nthreads = min(available_cpus(), 8)
+        nthreads = _auto_threads()
     rc = lib.pq_delta_decode(
         buf.ctypes.data if len(buf) else None, len(buf),
         np.ascontiguousarray(mb_bitoffs, np.int64),
@@ -594,9 +602,7 @@ def expand_gather(buf: np.ndarray, tables: tuple, n: int,
     dvals = np.ascontiguousarray(dictionary)
     out = np.empty(n, dtype=dictionary.dtype)
     if not nthreads:
-        from ..utils.pool import available_cpus
-
-        nthreads = min(available_cpus(), 8)
+        nthreads = _auto_threads()
     rc = lib.pq_expand_gather(
         buf.ctypes.data if len(buf) else None, len(buf),
         np.ascontiguousarray(ends, np.int64),
@@ -733,13 +739,11 @@ def dict_chunk_scan(buf, pages_rows: np.ndarray, codec_id: int,
     boffs = np.empty(run_cap, np.int64)
     widths = np.empty(run_cap, np.int32)
     info = np.zeros(2, np.int64)
-    from ..utils.pool import available_cpus
-
     k = lib.pq_dict_chunk_scan(
         b.ctypes.data if len(b) else None, len(b), rows.reshape(-1),
         n_pages, codec_id, max_def, max_rep,
         out_bytes, out_cap, ends, kinds, payloads, boffs, widths, run_cap,
-        info, min(available_cpus(), 8))
+        info, _auto_threads())
     if k < 0:
         return None
     return (ends[:k], kinds[:k], payloads[:k], boffs[:k] * 8, widths[:k],

@@ -1,9 +1,12 @@
-"""Process-wide worker pool for CPU-bound columnar work (currently the
-pushdown scan; the writer measured slower under threads and stays serial).
+"""Process-wide worker pool for CPU-bound columnar work: the pushdown scan,
+the whole-file chunk fan-out, the streamed read's parallel column decode,
+the prefetcher's background window reads (io/prefetch.py), and the writer's
+≥8 MB parallel-encode path.
 
 One shared executor: pool construction costs ~1ms, which would dominate
 small operations if paid per call, and the numpy/C++/codec work it runs
-releases the GIL.
+releases the GIL.  ``PARQUET_TPU_POOL_WORKERS`` pins the width (equivalence
+smokes run width 1 vs N; results must be identical).
 """
 
 from __future__ import annotations
@@ -56,15 +59,23 @@ def available_cpus() -> int:
         return os.cpu_count() or 1
 
 
+def pool_width() -> int:
+    """Worker count the shared pool is (or will be) built with.
+    ``PARQUET_TPU_POOL_WORKERS`` overrides; read at first use."""
+    env = os.environ.get("PARQUET_TPU_POOL_WORKERS", "")
+    if env.isdigit() and int(env) > 0:
+        return int(env)
+    # size to the machine: far more workers than cores just thrashes the
+    # GIL on the python slices between the GIL-releasing numpy/C++/codec
+    # calls (measured ~1.6x slowdown at 16 workers on one core); 2 is the
+    # floor so IO still overlaps decode
+    return max(2, min(16, available_cpus()))
+
+
 def shared_pool() -> ThreadPoolExecutor:
     global _POOL
     with _LOCK:
         if _POOL is None:
-            # size to the machine: far more workers than cores just thrashes
-            # the GIL on the python slices between the GIL-releasing numpy/
-            # C++/codec calls (measured ~1.6x slowdown at 16 workers on one
-            # core); 2 is the floor so IO still overlaps decode
-            workers = max(2, min(16, available_cpus()))
-            _POOL = ThreadPoolExecutor(max_workers=workers,
+            _POOL = ThreadPoolExecutor(max_workers=pool_width(),
                                        thread_name_prefix="pq-work")
         return _POOL

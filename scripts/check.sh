@@ -112,6 +112,70 @@ assert not [f for f in os.listdir(d) if f.endswith(".tmp")], os.listdir(d)
 print(f"durability smoke ok: fixture verified (decode), CLI exit codes, "
       f"{absent} crash offsets left no destination")
 PYEOF
+echo "=== read-pipeline smoke (prefetch on/off x pool width equivalence) ==="
+python - <<'PIPEOF'
+# Streamed read of a multi-row-group NESTED file must be byte-identical
+# across every pipeline configuration: prefetch off vs on (both the mmap
+# advise backend via a path open and the forced ring backend), and shared
+# pool width 1 vs N.  Bounded to a few seconds.
+import io
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+rng = np.random.default_rng(11)
+n = 30000
+lens = rng.integers(0, 5, n)
+offs = np.zeros(n + 1, np.int32)
+np.cumsum(lens, out=offs[1:])
+t = pa.table({
+    "x": pa.array(np.arange(n, dtype=np.int64)),
+    "s": pa.array([f"v{i % 61}" for i in range(n)]),
+    "lst": pa.ListArray.from_arrays(
+        pa.array(offs), pa.array(rng.integers(0, 1000, int(offs[-1])))),
+})
+d = tempfile.mkdtemp(prefix="parquet_tpu_pipe_")
+path = os.path.join(d, "pipe.parquet")
+pq.write_table(t, path, row_group_size=n // 6, compression="snappy",
+               data_page_size=8192)
+
+PROG = r'''
+import sys
+import pyarrow as pa
+from parquet_tpu import ParquetFile
+pf = ParquetFile(sys.argv[1])
+tab = pa.concat_tables(b.to_arrow() for b in pf.iter_batches(batch_rows=4000))
+sys.stdout.buffer.write(tab.to_pandas().to_csv().encode())
+'''
+
+def run(env):
+    e = dict(os.environ, **env)
+    p = subprocess.run([sys.executable, "-c", PROG, path],
+                       capture_output=True, env=e)
+    assert p.returncode == 0, p.stderr.decode()[-2000:]
+    return p.stdout
+
+base = run({"PARQUET_TPU_PREFETCH": "0", "PARQUET_TPU_MMAP": "0"})
+cases = {
+    "prefetch=1 (advise)": {"PARQUET_TPU_PREFETCH": "1"},
+    "prefetch=ring": {"PARQUET_TPU_PREFETCH": "ring", "PARQUET_TPU_MMAP": "0"},
+    "ring, pool width 1": {"PARQUET_TPU_PREFETCH": "ring",
+                           "PARQUET_TPU_MMAP": "0",
+                           "PARQUET_TPU_POOL_WORKERS": "1"},
+    "ring, pool width 8": {"PARQUET_TPU_PREFETCH": "ring",
+                           "PARQUET_TPU_MMAP": "0",
+                           "PARQUET_TPU_POOL_WORKERS": "8"},
+    "parallel decode, width 8": {"PARQUET_TPU_POOL_WORKERS": "8"},
+}
+for name, env in cases.items():
+    assert run(env) == base, f"pipeline config {name!r} changed the bytes"
+print(f"read-pipeline smoke ok: {len(cases)} configs byte-identical")
+PIPEOF
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys

@@ -1,0 +1,412 @@
+"""Pipelined read path: prefetching IO layer (io/prefetch.py) — unit tests
+for the ring/advise backends plus the pipeline x resilience matrix
+(FaultInjectingSource under the prefetching streamed read)."""
+
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import (DeadlineError, FaultInjectingSource, FaultPolicy,
+                         MmapSource, ParquetFile, PrefetchSource, ReadReport,
+                         ReadStats, iter_batches)
+from parquet_tpu.io.prefetch import make_prefetcher, prefetch_mode
+from parquet_tpu.io.source import (BytesSource, FileLikeSource, FileSource,
+                                   as_source)
+from parquet_tpu.utils import pool as pool_mod
+
+
+def _file(n=20_000, row_groups=5, nested=True) -> bytes:
+    rng = np.random.default_rng(7)
+    cols = {"x": pa.array(np.arange(n, dtype=np.int64)),
+            "f": pa.array(rng.random(n)),
+            "s": pa.array([f"v{i % 97}" for i in range(n)])}
+    if nested:
+        lens = rng.integers(0, 4, n)
+        offs = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offs[1:])
+        cols["lst"] = pa.ListArray.from_arrays(
+            pa.array(offs), pa.array(np.arange(offs[-1], dtype=np.int64)))
+    t = pa.table(cols)
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // row_groups,
+                   compression="snappy", data_page_size=4096)
+    return buf.getvalue()
+
+
+def _drain(pf, batch_rows=1500):
+    return pa.concat_tables(b.to_arrow() for b in
+                            iter_batches(pf, batch_rows=batch_rows))
+
+
+# ---------------------------------------------------------------------------
+# PrefetchSource unit behavior
+# ---------------------------------------------------------------------------
+def test_ring_serves_planned_windows_and_accounts():
+    data = bytes(range(256)) * 4096  # 1 MiB
+    src = BytesSource(data)
+    pre = PrefetchSource(src, backend="ring", window_bytes=4096, depth=2,
+                         max_windows=8)
+    pre.plan(0, len(data))
+    # sequential aligned, partial, and window-spanning reads all serve
+    # correct bytes (windows are consumed once the reader passes them)
+    assert pre.pread(0, 4096) == data[:4096]
+    assert pre.pread(4096, 2048) == data[4096:6144]
+    assert pre.pread(6144, 4096) == data[6144:10240]  # spans two windows
+    assert bytes(pre.pread_view(10240, 2048)) == data[10240:12288]
+    st = pre.stats
+    assert st.backend == "ring"
+    assert st.prefetch_hits >= 3
+    assert st.windows_issued >= 2
+    assert st.bytes_prefetched > 0
+    # a read far outside the issued windows is a miss, served read-through
+    assert pre.pread(len(data) - 10, 10) == data[-10:]
+    assert st.prefetch_misses >= 1
+    pre.close()
+    # close() is not inner close by default: the source stays readable
+    assert src.pread(0, 4) == data[:4]
+
+
+def test_ring_spanning_read_over_bytes_windows():
+    """Injector wrappers return plain ``bytes`` from pread_view; a read
+    spanning two such windows must still assemble correctly (regression:
+    np.asarray(bytes) is 0-d and broke the chain concat)."""
+    data = bytes(range(256)) * 256  # 64 KiB
+    src = FaultInjectingSource(BytesSource(data), flip_offsets=[7],
+                               flip_mask=0xFF)
+    pre = PrefetchSource(src, backend="ring", window_bytes=4096, depth=3)
+    pre.plan(0, len(data))
+    want = bytearray(data)
+    want[7] ^= 0xFF
+    assert pre.pread(0, 4096) == bytes(want[:4096])
+    got = pre.pread(4096, 8192)  # spans two windows
+    assert got == bytes(want[4096:12288])
+    pre.close()
+
+
+def test_unplan_releases_ring_capacity():
+    """A skipped row group's plans must free their ring slots (a dead plan
+    retires on consumption, which never comes)."""
+    data = bytes(range(256)) * 4096
+    pre = PrefetchSource(BytesSource(data), backend="ring",
+                         window_bytes=4096, depth=2, max_windows=2)
+    pre.plan(0, 65536)  # fills both ring slots
+    pre.unplan(0, 65536)
+    assert pre.stats.bytes_discarded > 0
+    pre.plan(100_000, 65536)  # freed capacity: the new plan's windows issue
+    deadline = time.time() + 2.0
+    while not all(w.future.done() for w in pre._ring) \
+            and time.time() < deadline:
+        time.sleep(0.005)
+    assert pre.pread(100_000, 4096) == data[100_000:104_096]
+    assert pre.stats.prefetch_hits >= 1
+    pre.close()
+
+
+def test_ring_close_discards_unconsumed_windows():
+    data = b"ab" * (1 << 20)
+    pre = PrefetchSource(BytesSource(data), backend="ring",
+                         window_bytes=8192, depth=4, max_windows=16)
+    pre.plan(0, len(data))
+    time.sleep(0.05)  # let some windows complete
+    pre.close()
+    assert pre.stats.bytes_discarded > 0
+
+
+def test_ring_error_surfaces_on_consuming_thread():
+    class Boom(BytesSource):
+        def pread_view(self, offset, size):
+            raise OSError(5, "boom")
+
+        pread = pread_view
+
+    pre = PrefetchSource(Boom(b"x" * 65536), backend="ring",
+                         window_bytes=4096, depth=2)
+    pre.plan(0, 65536)
+    with pytest.raises(OSError, match="boom"):
+        pre.pread(0, 4096)
+    pre.close()
+
+
+def test_advise_backend_zero_copy(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(bytes(range(256)) * 1024)
+    src = as_source(str(p))
+    assert isinstance(src, MmapSource)
+    pre = make_prefetcher(src)
+    assert pre is not None and pre.backend == "advise"
+    pre.plan(0, src.size())
+    v = pre.pread_view(1000, 4096)
+    assert isinstance(v, np.ndarray)
+    assert bytes(v[:8]) == bytes(range(256))[1000 % 256:][:8]
+    assert pre.stats.prefetch_hits == 1
+    # un-planned region is a miss but still correct
+    assert pre.pread(0, 4) == bytes(range(4))
+    pre.close()
+    src.close()
+
+
+def test_make_prefetcher_gates(monkeypatch, tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"x" * 4096)
+    fsrc = FileSource(str(p))
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "0")
+    assert make_prefetcher(fsrc) is None
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    assert make_prefetcher(BytesSource(b"abc")).backend == "ring"
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "1")
+    # auto on one core, non-mmap chain: no prefetcher (pread against a warm
+    # page cache competes with decode on the only core)
+    monkeypatch.setattr(pool_mod, "available_cpus", lambda: 1)
+    assert make_prefetcher(fsrc) is None
+    monkeypatch.setattr(pool_mod, "available_cpus", lambda: 4)
+    got = make_prefetcher(fsrc)
+    assert got is not None and got.backend == "ring"
+    # in-memory chains never auto-ring: no disk latency to hide
+    assert make_prefetcher(BytesSource(b"abc")) is None
+    assert prefetch_mode() == "auto"
+    fsrc.close()
+
+
+# ---------------------------------------------------------------------------
+# MmapSource
+# ---------------------------------------------------------------------------
+def test_mmap_source_matches_file_source(tmp_path):
+    p = tmp_path / "f.bin"
+    data = os.urandom(100_000)
+    p.write_bytes(data)
+    ms, fs = MmapSource(str(p)), FileSource(str(p))
+    for off, size in [(0, 10), (99_990, 10), (12345, 54321), (0, 100_000)]:
+        assert ms.pread(off, size) == fs.pread(off, size)
+        assert bytes(ms.pread_view(off, size)) == fs.pread(off, size)
+    with pytest.raises(IOError):
+        ms.pread(99_999, 100)  # past EOF: short read, loud
+    with pytest.raises(IOError):
+        ms.pread(-5, 10)  # negative offset is corruption, not wrap-around
+    ms.madvise_willneed(0, 100_000)  # best-effort, never raises
+    view = ms.pread_view(0, 100)  # taken BEFORE close: stays valid after
+    ms.close()
+    ms.close()  # idempotent
+    assert bytes(view) == data[:100]
+    with pytest.raises(ValueError, match="closed"):
+        ms.pread(0, 4)
+    fs.close()
+
+
+def test_as_source_empty_file_falls_back(tmp_path):
+    p = tmp_path / "empty.bin"
+    p.write_bytes(b"")
+    src = as_source(str(p))  # mmap refuses empty maps; pread path steps in
+    assert isinstance(src, FileSource)
+    src.close()
+
+
+def test_mmap_env_opt_out(monkeypatch, tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"x" * 64)
+    monkeypatch.setenv("PARQUET_TPU_MMAP", "0")
+    assert isinstance(as_source(str(p)), FileSource)
+
+
+# ---------------------------------------------------------------------------
+# On/off equivalence through the real read paths
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["0", "1", "ring"])
+def test_stream_equivalence_across_prefetch_modes(monkeypatch, tmp_path,
+                                                  mode):
+    raw = _file()
+    p = tmp_path / "f.parquet"
+    p.write_bytes(raw)
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "0")
+    want = _drain(ParquetFile(raw))
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", mode)
+    got_mem = _drain(ParquetFile(raw))
+    got_path = _drain(ParquetFile(str(p)))
+    assert got_mem.equals(want)
+    assert got_path.equals(want)
+
+
+@pytest.mark.parametrize("width", ["1", "4"])
+def test_pool_width_equivalence(monkeypatch, width):
+    raw = _file()
+    monkeypatch.setenv("PARQUET_TPU_POOL_WORKERS", width)
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    monkeypatch.setattr(pool_mod, "_POOL", None)  # rebuild at new width
+    try:
+        monkeypatch.setattr(pool_mod, "available_cpus", lambda: 4)
+        got = _drain(ParquetFile(raw))
+        monkeypatch.setenv("PARQUET_TPU_PREFETCH", "0")
+        want = _drain(ParquetFile(raw))
+        assert got.equals(want)
+    finally:
+        pool_mod._POOL = None  # don't leak a 1-wide pool to other tests
+
+
+def test_parallel_streamed_decode_equivalence(monkeypatch):
+    """Layer 2: the pooled per-column take path must be value-identical to
+    the serial path (exercised by faking >1 CPU; pool width stays real)."""
+    import parquet_tpu.io.stream as stream_mod
+
+    raw = _file()
+    want = _drain(ParquetFile(raw))
+    monkeypatch.setattr(stream_mod, "_PARALLEL_MIN_CELLS", 1)
+    monkeypatch.setattr(pool_mod, "available_cpus", lambda: 4)
+    got = _drain(ParquetFile(raw))
+    assert got.equals(want)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline x resilience matrix
+# ---------------------------------------------------------------------------
+def test_prefetch_transient_errors_retry_and_account(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    raw = _file()
+    clean = _drain(ParquetFile(raw))
+    pol = FaultPolicy(max_retries=4, backoff_s=0.0)
+    for seed in range(4):
+        src = FaultInjectingSource(BytesSource(raw), seed=seed,
+                                   error_rate=0.25,
+                                   max_consecutive_errors=2)
+        rep = ReadReport()
+        pf = ParquetFile(src, policy=pol)
+        at_open = src.stats.injected_errors  # open-time retries aren't rep's
+        got = pa.concat_tables(
+            b.to_arrow() for b in iter_batches(pf, batch_rows=1500,
+                                               report=rep))
+        assert got.equals(clean), seed
+        drained = src.stats.injected_errors - at_open
+        if drained:
+            # retries that really happened in the BACKGROUND window reads
+            # must land in the consumer's report
+            assert rep.retries >= drained, seed
+
+
+def test_prefetch_deadline_fires_promptly_with_queued_windows(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    raw = _file()
+    src = FaultInjectingSource(BytesSource(raw), latency_s=0.05)
+    pf = ParquetFile(src, policy=FaultPolicy(deadline_s=0.25, backoff_s=0.0))
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineError):
+        _drain(pf)
+    # prompt: the wait on in-flight windows polls the deadline instead of
+    # blocking until every queued latency-injected pread drains
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_prefetch_corrupt_skip_matches_serial(monkeypatch):
+    raw = _file()
+    md = pq.ParquetFile(io.BytesIO(raw)).metadata
+    off = md.row_group(2).column(0).data_page_offset
+    flips = [off, off + 1, off + 2]
+    skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+
+    def degraded(mode):
+        monkeypatch.setenv("PARQUET_TPU_PREFETCH", mode)
+        rep = ReadReport()
+        src = FaultInjectingSource(BytesSource(raw), flip_offsets=flips)
+        t = pa.concat_tables(
+            b.to_arrow() for b in iter_batches(ParquetFile(src, policy=skip),
+                                               batch_rows=1500, report=rep))
+        return t, rep
+
+    want, want_rep = degraded("0")
+    got, got_rep = degraded("ring")
+    assert got.equals(want)
+    assert got_rep.row_groups_skipped == want_rep.row_groups_skipped == [2]
+    assert got_rep.rows_dropped == want_rep.rows_dropped > 0
+
+
+def test_read_stats_surfaced_on_table(monkeypatch, tmp_path):
+    raw = _file()
+    p = tmp_path / "f.parquet"
+    p.write_bytes(raw)
+    pf = ParquetFile(str(p))
+    last = None
+    for b in pf.iter_batches(batch_rows=4000):
+        last = b
+    assert isinstance(last.read_stats, ReadStats)
+    d = last.read_stats.as_dict()
+    assert d["backend"] == "advise" and d["prefetch_hits"] > 0
+    assert last.read_stats.bytes_prefetched > 0
+
+
+# ---------------------------------------------------------------------------
+# FileLikeSource under concurrency (satellite: the seek+read critical
+# section hammered from the shared pool)
+# ---------------------------------------------------------------------------
+def test_filelike_source_concurrent_pread_hammer():
+    data = bytes(range(256)) * 2048  # 512 KiB
+    src = FileLikeSource(io.BytesIO(data))
+    rng = np.random.default_rng(3)
+    spans = [(int(o), int(s)) for o, s in zip(
+        rng.integers(0, len(data) - 4096, 400), rng.integers(1, 4096, 400))]
+    errs = []
+
+    def worker(sl):
+        try:
+            for off, size in sl:
+                if src.pread(off, size) != data[off:off + size]:
+                    errs.append((off, size))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(e)
+
+    futs = [pool_mod.submit(worker, spans[i::8]) for i in range(8)]
+    for f in futs:
+        f.result()
+    assert not errs
+    src.close()
+    with pytest.raises(ValueError):
+        src.pread(0, 4)
+
+
+def test_filelike_close_during_preads_is_clean():
+    data = b"z" * 262144
+    src = FileLikeSource(io.BytesIO(data))
+    stop = threading.Event()
+    errs = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                src.pread(1000, 64)
+            except ValueError:
+                return  # the contract error — clean
+            except Exception as e:  # "seek of closed file" etc. would land here
+                errs.append(e)
+                return
+
+    ts = [threading.Thread(target=reader) for _ in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.02)
+    src.close()
+    stop.set()
+    for t in ts:
+        t.join()
+    assert not errs
+
+
+# ---------------------------------------------------------------------------
+# Writer satellite: the >=8 MB parallel-encode path rides the shared pool
+# ---------------------------------------------------------------------------
+def test_writer_parallel_encode_on_shared_pool(monkeypatch, tmp_path):
+    from parquet_tpu import WriterOptions, write_table
+    import parquet_tpu.io.writer as writer_mod  # noqa: F401 (import check)
+
+    monkeypatch.setattr(pool_mod, "available_cpus", lambda: 4)
+    n = 600_000  # ~14 MB of int64s + floats: over the 8 MB gate
+    t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64)),
+                  "b": pa.array(np.random.default_rng(5).random(n)),
+                  "c": pa.array((np.arange(n) % 1000).astype(np.int32))})
+    dest = tmp_path / "w.parquet"
+    write_table(t, str(dest), WriterOptions(row_group_size=200_000,
+                                            compression="snappy"))
+    got = ParquetFile(str(dest)).read().to_arrow()
+    assert got.equals(pq.read_table(str(dest)))
+    assert got.num_rows == n

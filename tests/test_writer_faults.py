@@ -68,6 +68,15 @@ def test_pathlike_sink_supported(tmp_path, table):
     assert verify_file(dest).ok
 
 
+def test_verify_file_leaves_caller_file_object_open(tmp_path, table):
+    dest = tmp_path / "v.parquet"
+    write_table(table, str(dest))
+    with open(dest, "rb") as f:
+        assert verify_file(f).ok
+        f.seek(0)
+        assert f.read(4) == b"PAR1"  # the caller's handle survives verify
+
+
 def test_atomic_commit_opt_out_still_cleans_on_abort(tmp_path, table, schema):
     dest = tmp_path / "direct.parquet"
     opts = WriterOptions(atomic_commit=False, row_group_size=RG)
