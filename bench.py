@@ -546,7 +546,15 @@ def _cfg5(n):
 
 def _cfg6(n):
     """Write throughput (reference's asm-heaviest area: hashprobe dictionary
-    build + encoders). Wall-clock vs pyarrow writing the same mixed table."""
+    build + encoders). Wall-clock vs pyarrow writing the same mixed table,
+    plus the write-PIPELINE A/B: serial vs double-buffered encode/emit
+    overlap vs overlap + buffered sink writeback, on a multi-row-group
+    on-disk file (the checkpoint/dataset-egress shape), with the
+    byte-identity of every configuration asserted and the overlapped run's
+    WriteStats (bubble meter) recorded."""
+    import shutil
+    import tempfile
+
     from parquet_tpu import WriterOptions, write_table
 
     rng = np.random.default_rng(23)
@@ -573,12 +581,65 @@ def _cfg6(n):
 
     run_pyarrow()
     pa_s = _time_best(run_pyarrow, reps=3)
+
+    # ---- write-pipeline A/B: multi-row-group file on disk ----------------
+    # fsync off so the A/B measures the pipeline, not the constant commit
+    # fsync; force mode so the comparison holds at BENCH_QUICK sizes too
+    d = tempfile.mkdtemp(prefix="parquet_tpu_bench_write_")
+    wopts = WriterOptions(compression="snappy",
+                          row_group_size=max(n // 6, 1), fsync=False)
+    dest = os.path.join(d, "ab.parquet")
+    stats = {}
+
+    def timed(tag, env):
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            def go():
+                if os.path.exists(dest):
+                    os.unlink(dest)
+                w = write_table(t, dest, wopts)
+                stats[tag] = w.write_stats
+                return dest
+
+            go()
+            best = _time_best(go, reps=3)
+            return best, open(dest, "rb").read()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    try:
+        serial_s, b_serial = timed("serial", {
+            "PARQUET_TPU_WRITE_OVERLAP": "0", "PARQUET_TPU_WRITE_BUFFER": "0"})
+        overlap_s, b_overlap = timed("overlap", {
+            "PARQUET_TPU_WRITE_OVERLAP": "force",
+            "PARQUET_TPU_WRITE_BUFFER": "0"})
+        buffered_s, b_buffered = timed("overlap_buffered", {
+            "PARQUET_TPU_WRITE_OVERLAP": "force"})
+        pipeline = {
+            "row_groups": stats["overlap"].row_groups,
+            "serial_s": round(serial_s, 4),
+            "overlap_s": round(overlap_s, 4),
+            "overlap_buffered_s": round(buffered_s, 4),
+            "overlap_vs_serial": round(serial_s / overlap_s, 2),
+            "buffered_vs_serial": round(serial_s / buffered_s, 2),
+            "byte_identical": b_serial == b_overlap == b_buffered,
+            "write_stats": stats["overlap_buffered"].as_dict(),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
     return {
         "MBps": round(t.nbytes / ours_s / 1e6, 1),
         "vs_pyarrow": round(pa_s / ours_s, 2),
         "write_s": round(ours_s, 4),
         "pyarrow_s": round(pa_s, 4),
         "file_MB": round(size / 1e6, 1),
+        "pipeline": pipeline,
     }
 
 

@@ -35,6 +35,11 @@ Read pipeline
   PrefetchSource (ring/advise readahead over any Source), ReadStats
   (prefetch hits/misses, bytes, pool wait — ``Table.read_stats``),
   MmapSource (zero-copy page-cache views; default for path opens)
+Write pipeline
+  BufferedSink (coalescing writeback over any sink; path sinks default),
+  WriteStats (encode/emit/pool-wait seconds, bytes buffered/flushed,
+  overlap ratio — ``ParquetWriter.write_stats``); the double-buffered
+  encode/emit overlap itself lives in ParquetWriter.write_row_group
 Durability & integrity
   AtomicFileSink (fsync + atomic rename commit; path sinks default),
   FileSink, WriteError, FaultInjectingSink/InjectedWriterCrash (write-side
@@ -49,7 +54,8 @@ from .io.faults import (FaultInjectingSink, FaultInjectingSource, FaultPolicy,
                         InjectedWriterCrash, PolicySource, ReadReport,
                         SinkFaultStats, crash_consistency_check)
 from .io.integrity import IntegrityIssue, IntegrityReport, verify_file
-from .io.sink import AtomicFileSink, FileSink, Sink
+from .io.sink import (AtomicFileSink, BufferedSink, FileSink, Sink,
+                      WriteStats)
 from .io.reader import ParquetFile, ReadOptions, RowGroupReader, Table
 from .io.column import Column
 from .io.writer import (ColumnData, ParquetWriter, WriterOptions,

@@ -300,7 +300,7 @@ def merge_files(paths_or_files, sorting: Sequence[SortingColumn], sink,
                 options: Optional[WriterOptions] = None,
                 batch_rows: int = 1 << 16,
                 row_group_rows: int = 1 << 20,
-                schema: Optional[Schema] = None) -> None:
+                schema: Optional[Schema] = None) -> "ParquetWriter":
     """Compaction helper: stream-merge whole sorted files into one sorted
     output file with O(k · batch_rows + row_group_rows) memory.
 
@@ -328,6 +328,7 @@ def merge_files(paths_or_files, sorting: Sequence[SortingColumn], sink,
         except BaseException:
             w.abort()  # path sinks unlink their temp/partial file
             raise
+        return w  # closed; exposes write_stats (the write-pipeline meter)
     finally:
         for pf in opened:
             pf.close()

@@ -44,6 +44,9 @@ class SortingWriter:
         self._spills: List[str] = []
         self._tmpdir = tempfile.mkdtemp(prefix="parquet_tpu_sort_")
         self._closed = False
+        # WriteStats of the writer that produced the FINAL output (the
+        # destination's pipeline meter; spill/intermediate runs not counted)
+        self.write_stats = None
 
     def write(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
         self._buf.write(columns, num_rows)
@@ -90,6 +93,7 @@ class SortingWriter:
                 except BaseException:
                     w.abort()
                     raise
+                self.write_stats = w.write_stats
             else:
                 self._spill()
                 self._merge_spills()
@@ -136,8 +140,9 @@ class SortingWriter:
         if out_opts.row_group_size > self.buffer_rows:
             out_opts = dataclasses.replace(out_opts,
                                            row_group_size=self.buffer_rows)
-        merge_files(runs, self.sorting, self.sink, out_opts,
-                    batch_rows=batch)
+        w = merge_files(runs, self.sorting, self.sink, out_opts,
+                        batch_rows=batch)
+        self.write_stats = w.write_stats
 
     def __enter__(self):
         return self

@@ -612,7 +612,7 @@ class FaultInjectingSink:
 
 
 def crash_consistency_check(build, dest, samples: int = 12, seed: int = 0,
-                            offsets=None) -> List[dict]:
+                            offsets=None, buffered: bool = False) -> List[dict]:
     """Crash-consistency matrix over one atomic write.
 
     ``build(sink)`` must perform a complete write to the given sink (e.g.
@@ -625,6 +625,12 @@ def crash_consistency_check(build, dest, samples: int = 12, seed: int = 0,
     either does not exist, or :func:`~parquet_tpu.io.integrity.verify_file`
     reports it clean.  A final uncrashed run commits and must verify clean.
 
+    ``buffered=True`` interposes a
+    :class:`~parquet_tpu.io.sink.BufferedSink` between the writer and the
+    injector, so crash offsets land inside the coalesced vectored flushes —
+    the write-pipeline configuration (overlap + writeback buffer) must
+    uphold the same invariant.
+
     Returns one dict per run: ``{"offset", "outcome"}`` with outcome
     ``"absent"`` or ``"clean"``.  Raises ``AssertionError`` (with the
     offending offset and integrity issues) on any violation.
@@ -632,14 +638,14 @@ def crash_consistency_check(build, dest, samples: int = 12, seed: int = 0,
     import os
 
     from .integrity import verify_file  # deferred: integrity imports reader
-    from .sink import AtomicFileSink
+    from .sink import AtomicFileSink, BufferedSink
 
     if os.path.exists(dest):
         raise FileExistsError(f"crash harness refuses to overwrite {dest!r}")
 
     def run(crash_at):
-        sink = FaultInjectingSink(AtomicFileSink(dest),
-                                  crash_at_byte=crash_at)
+        inj = FaultInjectingSink(AtomicFileSink(dest), crash_at_byte=crash_at)
+        sink = BufferedSink(inj) if buffered else inj
         try:
             build(sink)
             sink.close()  # commit (fsync + rename) — crash-free runs only
@@ -647,7 +653,7 @@ def crash_consistency_check(build, dest, samples: int = 12, seed: int = 0,
             # a real crash leaves the temp file stranded; the restarted
             # process sweeps *.tmp — dest itself must never need recovery
             sink.abort()
-        return sink
+        return inj
 
     probe = run(None)
     total = probe.stats.bytes_written

@@ -47,10 +47,15 @@ import numpy as np
 from ..errors import DeadlineError
 from .source import MmapSource, Source
 
-__all__ = ["ReadStats", "PrefetchSource", "prefetch_mode", "make_prefetcher"]
+__all__ = ["ReadStats", "PrefetchSource", "prefetch_mode", "make_prefetcher",
+           "make_chunk_prefetcher", "autotune_enabled", "prefetch_autotune"]
 
 DEFAULT_WINDOW_BYTES = 2 << 20
 DEFAULT_DEPTH = 2
+# ring windows fill slices of a shared per-plan segment buffer this many
+# windows long, so cursor reads spanning a window join inside one segment
+# serve zero-copy instead of concatenating the chain
+_SEG_WINDOWS = 4
 
 
 def prefetch_mode() -> str:
@@ -63,6 +68,85 @@ def prefetch_mode() -> str:
     if v in ("mmap", "advise"):
         return "mmap"
     return "auto"
+
+
+def autotune_enabled() -> bool:
+    """``PARQUET_TPU_PREFETCH_AUTOTUNE`` opt-out (default on)."""
+    return os.environ.get("PARQUET_TPU_PREFETCH_AUTOTUNE", "1") \
+        .strip().lower() not in ("0", "off", "false", "no")
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+# tuned knobs react to the bubble meter, normalized PER WINDOW so a long
+# drain doesn't ratchet the state just by accumulating wall time: a drain
+# whose average wait per issued window exceeds the raise threshold deepens
+# readahead; one under the decay threshold steps back toward the defaults
+_TUNE_RAISE_S_PER_WINDOW = 5e-3
+_TUNE_DECAY_S_PER_WINDOW = 5e-4
+_MAX_DEPTH = 8
+_MAX_WINDOW_BYTES = 16 << 20
+
+
+class _AutoTuneState:
+    """Process-wide feedback from observed :class:`ReadStats` to the next
+    drain's readahead defaults (ROADMAP follow-on: tune
+    ``PARQUET_TPU_PREFETCH_DEPTH``/``WINDOW`` from ``pool_wait_s`` instead
+    of fixed constants).  A drain whose average wait PER ISSUED WINDOW
+    exceeds :data:`_TUNE_RAISE_S_PER_WINDOW` deepens readahead — depth
+    first, then window size; one under the decay threshold steps back
+    toward the defaults.  Explicit env pins and
+    ``PARQUET_TPU_PREFETCH_AUTOTUNE=0`` bypass the state entirely."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth: Optional[int] = None
+        self.window: Optional[int] = None
+
+    def suggest(self):
+        with self._lock:
+            return self.depth, self.window
+
+    def observe(self, stats: "ReadStats") -> None:
+        if stats.windows_issued <= 0:
+            return
+        wait_per_window = stats.pool_wait_s / stats.windows_issued
+        with self._lock:
+            d = self.depth or DEFAULT_DEPTH
+            w = self.window or DEFAULT_WINDOW_BYTES
+            if wait_per_window > _TUNE_RAISE_S_PER_WINDOW:
+                if d < _MAX_DEPTH:
+                    self.depth = d + 1
+                elif w < _MAX_WINDOW_BYTES:
+                    self.window = w * 2
+            elif wait_per_window < _TUNE_DECAY_S_PER_WINDOW:
+                if w > DEFAULT_WINDOW_BYTES:
+                    w //= 2
+                    self.window = None if w <= DEFAULT_WINDOW_BYTES else w
+                elif d > DEFAULT_DEPTH:
+                    d -= 1
+                    self.depth = None if d <= DEFAULT_DEPTH else d
+
+    def reset(self) -> None:
+        with self._lock:
+            self.depth = None
+            self.window = None
+
+
+_AUTOTUNE = _AutoTuneState()
+
+
+def prefetch_autotune() -> _AutoTuneState:
+    """The process-wide auto-tune state (tests reset it between cases)."""
+    return _AUTOTUNE
 
 
 @dataclass
@@ -97,15 +181,21 @@ class ReadStats:
 
 
 class _Window:
-    """One in-flight or completed window read."""
+    """One in-flight or completed window read.  ``seg``/``seg_start`` name
+    the shared per-plan segment buffer this window fills a slice of (chunk-
+    aligned carving: reads spanning window joins inside one segment serve
+    zero-copy out of the segment)."""
 
-    __slots__ = ("offset", "end", "future", "plan")
+    __slots__ = ("offset", "end", "future", "plan", "seg", "seg_start")
 
-    def __init__(self, offset: int, end: int, future, plan):
+    def __init__(self, offset: int, end: int, future, plan,
+                 seg=None, seg_start: int = 0):
         self.offset = offset
         self.end = end
         self.future = future
         self.plan = plan
+        self.seg = seg
+        self.seg_start = seg_start
 
 
 def _as_u8(buf) -> np.ndarray:
@@ -118,14 +208,20 @@ def _as_u8(buf) -> np.ndarray:
 
 class _Plan:
     """One registered sequential range [start, end); ``issue`` is the
-    readahead frontier — bytes below it are already issued/hinted."""
+    readahead frontier — bytes below it are already issued/hinted.  Ring
+    plans carve their windows out of shared contiguous segment buffers
+    (``seg_buf`` spanning [seg_start, seg_end)) so intra-segment window
+    joins serve zero-copy."""
 
-    __slots__ = ("start", "issue", "end")
+    __slots__ = ("start", "issue", "end", "seg_buf", "seg_start", "seg_end")
 
     def __init__(self, start: int, end: int):
         self.start = start
         self.issue = start
         self.end = end
+        self.seg_buf = None
+        self.seg_start = 0
+        self.seg_end = 0
 
 
 def _innermost(src: Source) -> Source:
@@ -163,12 +259,20 @@ class PrefetchSource(Source):
             raise ValueError(f"unknown prefetch backend {backend!r}")
         self.inner = inner
         self.backend = backend
-        self.window_bytes = int(window_bytes or os.environ.get(
-            "PARQUET_TPU_PREFETCH_WINDOW", DEFAULT_WINDOW_BYTES))
+        env_window = _env_int("PARQUET_TPU_PREFETCH_WINDOW")
+        env_depth = _env_int("PARQUET_TPU_PREFETCH_DEPTH")
+        # explicit args and env pins beat the auto-tuner; with neither, the
+        # depth/window come from observed pool_wait_s of earlier drains
+        tuned_depth, tuned_window = ((None, None) if not autotune_enabled()
+                                     else _AUTOTUNE.suggest())
+        self._tunable = (autotune_enabled() and window_bytes is None
+                         and depth is None and env_window is None
+                         and env_depth is None)
+        self.window_bytes = int(window_bytes or env_window or tuned_window
+                                or DEFAULT_WINDOW_BYTES)
         if self.window_bytes <= 0:
             raise ValueError("window_bytes must be positive")
-        self.depth = int(depth or os.environ.get(
-            "PARQUET_TPU_PREFETCH_DEPTH", DEFAULT_DEPTH))
+        self.depth = int(depth or env_depth or tuned_depth or DEFAULT_DEPTH)
         self.max_windows = max(2, int(max_windows))
         self.stats = stats if stats is not None else ReadStats()
         self.stats.backend = backend
@@ -242,19 +346,43 @@ class PrefetchSource(Source):
                 if len(self._ring) >= self.max_windows:
                     break
                 end = min(plan.issue + self.window_bytes, plan.end)
-                fut = pool_submit(self.inner.pread_view, plan.issue,
+                if plan.seg_buf is None or plan.issue >= plan.seg_end:
+                    # chunk-aligned carving: the next few windows share one
+                    # contiguous segment buffer, so a cursor read spanning
+                    # a window join inside it stays a zero-copy view
+                    seg_len = min(_SEG_WINDOWS * self.window_bytes,
+                                  plan.end - plan.issue)
+                    plan.seg_buf = np.empty(seg_len, np.uint8)
+                    plan.seg_start = plan.issue
+                    plan.seg_end = plan.issue + seg_len
+                end = min(end, plan.seg_end)
+                fut = pool_submit(self._fill_window, plan.seg_buf,
+                                  plan.issue - plan.seg_start, plan.issue,
                                   end - plan.issue)
                 # retrieve abandoned errors so a window cancelled/failed
                 # after close never logs "exception was never retrieved";
                 # consumers still see the error through result()
                 fut.add_done_callback(
                     lambda f: None if f.cancelled() else f.exception())
-                win = _Window(plan.issue, end, fut, plan)
+                win = _Window(plan.issue, end, fut, plan,
+                              seg=plan.seg_buf, seg_start=plan.seg_start)
                 self._ring.append(win)
                 self.stats.windows_issued += 1
                 self.stats.bytes_prefetched += end - plan.issue
                 plan.issue = end
                 progressed = True
+
+    def _fill_window(self, seg: np.ndarray, rel: int, offset: int,
+                     size: int) -> np.ndarray:
+        """Background window read into its segment slice.  Returns the
+        FILLED slice — a short inner read yields a short slice, which the
+        serving path detects (the chain-covered fast path requires every
+        window full) so uninitialized segment bytes are never served."""
+        data = self.inner.pread_view(offset, size)
+        a = _as_u8(data)
+        n = min(len(a), size)
+        seg[rel : rel + n] = a[:n]
+        return seg[rel : rel + n]
 
     def _advise_locked(self) -> None:
         """Hint the kernel ``depth`` windows ahead of each plan's frontier.
@@ -394,9 +522,15 @@ class PrefetchSource(Source):
                 raise
         with self._lock:
             self.stats.prefetch_hits += 1
+        full = all(len(b) == (w.end - w.offset) for w, b in zip(chain, bufs))
         if len(chain) == 1:
             w = chain[0]
             out = bufs[0][offset - w.offset : end - w.offset]
+        elif full and all(w.seg is chain[0].seg for w in chain):
+            # the chain sits in one segment buffer: the join is already
+            # contiguous — serve a zero-copy view instead of concatenating
+            out = chain[0].seg[offset - chain[0].seg_start
+                               : end - chain[0].seg_start]
         else:
             out = np.concatenate(
                 [_as_u8(b)[max(offset - w.offset, 0)
@@ -435,6 +569,10 @@ class PrefetchSource(Source):
                         pass
                 self.stats.bytes_discarded += w.end - w.offset
             self._ring.clear()
+        if self.backend == "ring" and self._tunable:
+            # feed the drain's bubble meter back into the next drain's
+            # readahead defaults (no-op when env pins or opt-out disabled)
+            _AUTOTUNE.observe(self.stats)
         if self._owns_inner:
             self.inner.close()
 
@@ -474,4 +612,27 @@ def make_prefetcher(source: Source,
                           and not in_shared_pool()):
         return PrefetchSource(source, backend="ring", stats=stats,
                               max_windows=max(8, 2 * n_streams))
+    return None
+
+
+def make_chunk_prefetcher(source: Source,
+                          stats: Optional[ReadStats] = None,
+                          n_streams: int = 1) -> Optional[PrefetchSource]:
+    """Prefetcher for WHOLE-CHUNK pread consumers — the device staging
+    route (``decode_chunks_pipelined`` / ``stage_scan``), whose ``build_plan``
+    reads each column chunk in one pread.  A chunk-sized read arriving
+    before its ring windows are issued can never be covered (only ``depth``
+    windows are ever ahead), so the auto policy here uses only the advise
+    backend: plan the chunk ranges, let ``madvise(WILLNEED)`` run kernel
+    readahead under the prescan + H2D of earlier chunks, and serve the
+    preads as zero-copy mmap views.  ``PARQUET_TPU_PREFETCH=ring`` still
+    forces the ring (chaos tests exercise the read-through path); ``off``
+    disables as usual."""
+    mode = prefetch_mode()
+    if mode == "off":
+        return None
+    if mode == "ring":
+        return make_prefetcher(source, stats=stats, n_streams=n_streams)
+    if isinstance(_innermost(source), MmapSource):
+        return PrefetchSource(source, backend="advise", stats=stats)
     return None

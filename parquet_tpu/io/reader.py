@@ -575,6 +575,29 @@ class ParquetFile:
 
         return scope()
 
+    def _source_override(self, src: Source):
+        """Temporarily route every pread of this file through ``src`` (a
+        wrapper over the current source — e.g. the device staging route's
+        chunk prefetcher).  Shares the override stack with
+        :meth:`_resilient_op`, so LIFO-nested scopes always restore to a
+        live wrapper or the open-time source; the caller owns closing the
+        wrapper it installed."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            self._override_stack.append(src)
+            self.source = src
+            try:
+                yield src
+            finally:
+                st = self._override_stack
+                if src in st:
+                    st.remove(src)
+                self.source = st[-1] if st else self._base_source
+
+        return scope()
+
     def _decode_chunk_ctx(self, chunk: "ColumnChunkReader") -> "Column":
         """Host chunk decode with structured error context — any low-level
         failure surfaces as a :class:`ReadError` naming file, row group,

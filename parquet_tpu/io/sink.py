@@ -27,11 +27,73 @@ from __future__ import annotations
 
 import os
 import secrets
-from typing import Optional
+from dataclasses import dataclass
+from typing import List, Optional
 
 from ..errors import WriteError
 
-__all__ = ["Sink", "FileSink", "AtomicFileSink", "fsync_dir"]
+__all__ = ["Sink", "FileSink", "AtomicFileSink", "BufferedSink", "WriteStats",
+           "fsync_dir", "write_buffer_bytes"]
+
+# default writeback buffer: large enough that page-sized writes coalesce into
+# a handful of flushes per row group, small enough to stay cache-resident
+DEFAULT_WRITE_BUFFER = 4 << 20
+
+
+@dataclass
+class WriteStats:
+    """What the pipelined write actually did (observability; surfaced as
+    ``ParquetWriter.write_stats`` — the write-side mirror of
+    :class:`~parquet_tpu.io.prefetch.ReadStats`).
+
+    ``encode_s`` sums per-chunk encode wall time (wherever it ran),
+    ``emit_s`` the serial offset-assign + sink-write phase, and
+    ``pool_wait_s`` the time emit blocked on a background encode that had
+    not finished — the write pipeline's bubble meter: ~0 means encode fully
+    hid behind the previous group's emit.  ``bytes_buffered`` counts bytes
+    coalesced through a :class:`BufferedSink`, ``bytes_flushed`` bytes that
+    actually left toward the OS (equal to the file size for path sinks),
+    and ``sink_flushes`` how many vectored flushes carried them."""
+
+    row_groups: int = 0
+    overlapped_groups: int = 0
+    encode_s: float = 0.0
+    emit_s: float = 0.0
+    pool_wait_s: float = 0.0
+    bytes_buffered: int = 0
+    bytes_flushed: int = 0
+    sink_flushes: int = 0
+
+    def overlap_ratio(self) -> float:
+        """Fraction of background encode time that emit did NOT wait for —
+        1.0 means the pipeline fully hid encode behind emit, 0.0 means the
+        write was effectively serial."""
+        if not self.overlapped_groups or self.encode_s <= 0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - self.pool_wait_s / self.encode_s))
+
+    def as_dict(self) -> dict:
+        return {"row_groups": self.row_groups,
+                "overlapped_groups": self.overlapped_groups,
+                "encode_s": round(self.encode_s, 4),
+                "emit_s": round(self.emit_s, 4),
+                "pool_wait_s": round(self.pool_wait_s, 4),
+                "overlap_ratio": round(self.overlap_ratio(), 4),
+                "bytes_buffered": self.bytes_buffered,
+                "bytes_flushed": self.bytes_flushed,
+                "sink_flushes": self.sink_flushes}
+
+
+def write_buffer_bytes() -> int:
+    """Writeback buffer size: ``PARQUET_TPU_WRITE_BUFFER`` (bytes; ``0``
+    disables coalescing) or the 4 MiB default."""
+    v = os.environ.get("PARQUET_TPU_WRITE_BUFFER", "").strip()
+    if v:
+        try:
+            return max(0, int(v))
+        except ValueError:
+            pass
+    return DEFAULT_WRITE_BUFFER
 
 
 class Sink:
@@ -215,4 +277,98 @@ class AtomicFileSink(Sink):
             except OSError:
                 # best-effort: abort usually runs inside an exception
                 # handler, and an unlink failure must not mask the original
+                pass
+
+
+class BufferedSink(Sink):
+    """Coalescing writeback layer over any sink: page-sized writes
+    accumulate by reference (no join copy) and flush to the inner sink as
+    one vectored ``writelines`` once ``buffer_bytes`` is pending — the
+    write-side analog of the prefetcher's coalesced window reads.  The
+    per-page ``write()`` syscall overhead this removes is the emit phase's
+    residual cost once encode is pipelined (io/writer.py).
+
+    ``buffer_bytes=0`` is a counting pass-through (every write goes straight
+    to the inner sink); the default comes from ``PARQUET_TPU_WRITE_BUFFER``.
+    ``flush()``/``close()`` drain the buffer first, so the inner sink's
+    commit (fsync + atomic rename for :class:`AtomicFileSink`) always covers
+    every accepted byte; ``abort()`` drops the buffer and aborts the inner
+    sink.  Buffered parts are kept by reference — callers must not mutate a
+    buffer after writing it (the parquet writer only writes immutable
+    ``bytes``).  A ``stats`` :class:`WriteStats` accounts buffered vs
+    flushed bytes and flush counts."""
+
+    def __init__(self, inner: Sink, buffer_bytes: Optional[int] = None,
+                 stats: Optional[WriteStats] = None):
+        self.inner = inner
+        self.buffer_bytes = (write_buffer_bytes() if buffer_bytes is None
+                             else max(0, int(buffer_bytes)))
+        self.stats = stats
+        self._parts: List[bytes] = []
+        self._buffered = 0
+
+    def write(self, data) -> int:
+        n = len(data)
+        if self.buffer_bytes <= 0:
+            self.inner.write(data)
+            if self.stats is not None:
+                self.stats.bytes_flushed += n
+            return n
+        self._parts.append(data)
+        self._buffered += n
+        if self.stats is not None:
+            self.stats.bytes_buffered += n
+        if self._buffered >= self.buffer_bytes:
+            self._flush_buffer()
+        return n
+
+    def writelines(self, parts) -> None:
+        if self.buffer_bytes <= 0:
+            n = 0
+            parts = list(parts)
+            for p in parts:
+                n += len(p)
+            self.inner.writelines(parts)
+            if self.stats is not None:
+                self.stats.bytes_flushed += n
+            return
+        for p in parts:
+            self._parts.append(p)
+            self._buffered += len(p)
+            if self.stats is not None:
+                self.stats.bytes_buffered += len(p)
+        if self._buffered >= self.buffer_bytes:
+            self._flush_buffer()
+
+    def _flush_buffer(self) -> None:
+        if not self._parts:
+            return
+        # hand the parts over before writing: a failed flush must not be
+        # replayed (bytes may be partially down — the writer aborts on any
+        # write error, and a retry would double-write the prefix)
+        parts, self._parts = self._parts, []
+        n, self._buffered = self._buffered, 0
+        self.inner.writelines(parts)
+        if self.stats is not None:
+            self.stats.bytes_flushed += n
+            self.stats.sink_flushes += 1
+
+    def flush(self) -> None:
+        self._flush_buffer()
+        self.inner.flush()
+
+    def close(self) -> None:
+        self._flush_buffer()
+        self.inner.close()
+
+    def abort(self) -> None:
+        self._parts = []
+        self._buffered = 0
+        ab = getattr(self.inner, "abort", None)
+        if ab is not None:
+            ab()
+        else:
+            try:
+                self.inner.close()
+            except OSError:
                 pass

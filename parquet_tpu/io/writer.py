@@ -13,11 +13,26 @@ by the vectorized write-direction math in ops/levels.py), encoders are the
 vectorized numpy oracles (device encode is a later optimization — write is
 not the north-star hot path), and decoded 64-bit device pairs are accepted
 directly.
+
+Pipelining (the write-side twin of io/prefetch.py): the encode phase is
+pure and offset-free (:class:`_EncodedChunk`; offsets are assigned at emit
+time), so ``write_row_group`` double-buffers — group N+1 encodes on the
+shared pool while group N's chunks flush through ``_emit_chunk`` to the
+sink.  Group N+1's encode only STARTS after group N's encode finished
+(never concurrently with it), so the sticky dictionary-fallback state and
+therefore the output bytes are identical with overlap on or off.  Path
+sinks additionally ride a :class:`~parquet_tpu.io.sink.BufferedSink` that
+coalesces page writes into vectored flushes.  ``PARQUET_TPU_WRITE_OVERLAP``
+(``0`` off / auto / ``force``) and ``PARQUET_TPU_WRITE_BUFFER`` are the
+knobs; :class:`~parquet_tpu.io.sink.WriteStats` (``writer.write_stats``)
+meters the pipeline.
 """
 
 from __future__ import annotations
 
+import os
 import struct
+import time
 import zlib
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -35,6 +50,27 @@ from ..schema.schema import Leaf, Schema
 from ..schema.types import LogicalKind
 
 DEFAULT_CREATED_BY = "parquet-tpu version 0.1.0"
+
+# below this much input per row group, pool dispatch (and the deferred-emit
+# bookkeeping of the overlap pipeline) costs more than it hides — the same
+# measured crossover as the parallel-encode gate
+_PARALLEL_ENCODE_BYTES = 8 << 20
+
+
+def _overlap_mode() -> str:
+    """Resolve ``PARQUET_TPU_WRITE_OVERLAP`` to off | auto | force.
+
+    ``force`` pipelines every row group regardless of size (equivalence
+    tests, benches on small data); auto (the default) overlaps only where
+    it pays: >1 CPU and ≥ :data:`_PARALLEL_ENCODE_BYTES` of input per
+    group.  Inside a shared-pool worker the write always stays serial —
+    collecting a future from within the pool can deadlock the pool."""
+    v = os.environ.get("PARQUET_TPU_WRITE_OVERLAP", "1").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v == "force":
+        return "force"
+    return "auto"
 
 
 @dataclass
@@ -131,27 +167,36 @@ class ParquetWriter:
     """Streaming writer: accumulate columns, flush row groups, footer on close."""
 
     def __init__(self, sink, schema: Schema, options: Optional[WriterOptions] = None):
-        import os
+        from .sink import WriteStats
 
         self.schema = schema
         self.options = options or WriterOptions()
+        self.write_stats = WriteStats()
         self._own_sink = isinstance(sink, (str, os.PathLike))
         if self._own_sink:
-            from .sink import AtomicFileSink, FileSink
+            from .sink import AtomicFileSink, BufferedSink, FileSink
 
-            self._f = (AtomicFileSink(sink, fsync=self.options.fsync)
-                       if self.options.atomic_commit
-                       else FileSink(sink, fsync=self.options.fsync))
+            base = (AtomicFileSink(sink, fsync=self.options.fsync)
+                    if self.options.atomic_commit
+                    else FileSink(sink, fsync=self.options.fsync))
+            try:
+                # magic goes through the BASE sink, before the coalescing
+                # layer: fail fast on an unwritable sink instead of
+                # deferring the first write — and its error — into the
+                # first row group's flush
+                base.write(md.MAGIC)
+            except BaseException:
+                # a failed first write must not leak the freshly opened
+                # file or leave its temp/partial file behind
+                base.abort()
+                raise
+            # writeback coalescing for every path sink (buffer size 0 keeps
+            # a counting pass-through, so stats stay uniform)
+            self._f = BufferedSink(base, stats=self.write_stats)
+            self.write_stats.bytes_flushed += len(md.MAGIC)
         else:
             self._f = sink
-        try:
             self._f.write(md.MAGIC)
-        except BaseException:
-            # a failed first write must not leak the freshly opened file
-            # (or leave its temp/partial file behind on a path sink)
-            if self._own_sink:
-                self._f.abort()
-            raise
         self._pos = 4
         self._row_groups: List[md.RowGroup] = []
         self._column_indexes: List[List[Optional[md.ColumnIndex]]] = []
@@ -165,6 +210,11 @@ class ParquetWriter:
         # buffered rows for write() accumulation
         self._buffer: Optional[Dict[str, ColumnData]] = None
         self._buffered_rows = 0
+        # pipeline slot: (encode futures in leaf order, num_rows) of the one
+        # row group whose background encode may still be running while its
+        # predecessor's pages flush — emitted by the next write_row_group,
+        # flush(), or close()
+        self._inflight: Optional[Tuple[list, int]] = None
 
     # ------------------------------------------------------------------
     def write(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
@@ -186,9 +236,11 @@ class ParquetWriter:
             self._drain(final=False)
 
     def flush(self) -> None:
-        """Write everything buffered, including the sub-group tail."""
+        """Write everything buffered, including the sub-group tail and any
+        row group whose background encode is still in flight."""
         self._check_open()
         self._drain(final=True)
+        self._drain_inflight()
 
     def _check_open(self) -> None:
         # buffering rows into a finalized writer would drop them silently —
@@ -233,19 +285,30 @@ class ParquetWriter:
 
     # ------------------------------------------------------------------
     def write_row_group(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
+        """Encode + emit one row group, pipelined (module docstring):
+
+        1. wait for the PREVIOUS group's background encode (not its emit),
+        2. submit THIS group's encode to the shared pool,
+        3. emit the previous group's pages to the sink.
+
+        Step 3's sink IO overlaps step 2's encode compute; the strict
+        encode ordering (collect before submit) keeps the sticky
+        dictionary-fallback state — and the output bytes — identical to
+        the serial path.  The deferred group is emitted by the next call,
+        :meth:`flush`, or :meth:`close`.
+
+        Array ownership: the writer shares the caller's arrays without
+        copying (the same zero-copy contract :meth:`write` has always
+        had), and with overlap active this group's encode may still be
+        reading them after this call returns — do not mutate arrays handed
+        to the writer until it has flushed (rebinding fresh arrays per
+        group, as every built-in front end does, is always safe)."""
         self._check_open()
-        if len(self._row_groups) >= MAX_ROW_GROUPS:
+        if len(self._row_groups) + (1 if self._inflight is not None
+                                    else 0) >= MAX_ROW_GROUPS:
             raise TooManyRowGroupsError(
                 f"file would exceed {MAX_ROW_GROUPS} row groups "
                 "(RowGroup.ordinal is an i16); raise row_group_size")
-        opts = self.options
-        chunks: List[md.ColumnChunk] = []
-        cis: List[Optional[md.ColumnIndex]] = []
-        ois: List[Optional[md.OffsetIndex]] = []
-        blooms: List[Optional[bytes]] = []
-        rg_start = self._pos
-        total_bytes = 0
-        total_comp = 0
         leaves = self.schema.leaves
         datas = []
         for leaf in leaves:
@@ -261,8 +324,8 @@ class ParquetWriter:
         # buffering the row group's compressed pages until emit.  On one
         # core a pool measured ~15% SLOWER (GIL'd numpy dispatch), so the
         # serial one-chunk-buffered interleave is kept there.
-        from ..utils.pool import (available_cpus, in_shared_pool, mark_pooled,
-                                  shared_pool)
+        from ..utils.pool import available_cpus, in_shared_pool
+        from ..utils.pool import submit as pool_submit
 
         ncpu = available_cpus()
         work_bytes = sum(getattr(np.asarray(d.values), "nbytes", 0)
@@ -273,17 +336,103 @@ class ParquetWriter:
         # ThreadPoolExecutor here cost pool setup PER ROW GROUP on
         # multi-row-group writes; mark_pooled keeps the workers' native
         # thread splits at 1 (no pool x native oversubscription).
-        if ncpu > 1 and len(leaves) > 1 and work_bytes >= (8 << 20) \
-                and not in_shared_pool():
-            encs = list(shared_pool().map(
-                mark_pooled(lambda pair: self._encode_chunk(pair[0], pair[1],
-                                                            num_rows)),
-                zip(leaves, datas)))
+        mode = _overlap_mode()
+        pooled = (ncpu > 1 and len(leaves) > 1
+                  and work_bytes >= _PARALLEL_ENCODE_BYTES
+                  and not in_shared_pool())
+        overlap = mode != "off" and not in_shared_pool() and (
+            mode == "force"
+            or (ncpu > 1 and work_bytes >= _PARALLEL_ENCODE_BYTES))
+        # step 1: the previous group's encode must COMPLETE before this
+        # group's encode starts — concurrent encodes would race on the
+        # sticky dictionary-fallback state and make the bytes depend on
+        # scheduling.  Its results are held (not yet emitted) so this
+        # group's encode can be in flight behind its emit.
+        prev = self._inflight
+        self._inflight = None
+        if prev is not None:
+            prev = (self._collect(prev[0]), prev[1])
+        if overlap or pooled:
+            encs = [pool_submit(self._timed_encode, leaf, data, num_rows)
+                    for leaf, data in zip(leaves, datas)]
         else:
-            encs = (self._encode_chunk(leaf, data, num_rows)
-                    for leaf, data in zip(leaves, datas))
+            encs = self._timed_encode_iter(leaves, datas, num_rows)
+        if prev is not None:
+            try:
+                self._emit_group(*prev)
+            except BaseException:
+                # the previous group's emit failed with THIS group's encode
+                # already submitted: tear those futures down (abort() can't
+                # reach them — they were never stored in _inflight)
+                if overlap or pooled:
+                    from ..utils.pool import cancel_futures
+
+                    cancel_futures(encs)
+                raise
+        if overlap:
+            self._inflight = (encs, num_rows)
+            self.write_stats.overlapped_groups += 1
+        else:
+            self._emit_group(self._collect(encs) if pooled else encs,
+                             num_rows)
+
+    def _timed_encode(self, leaf: Leaf, data: ColumnData, num_rows: int):
+        t0 = time.perf_counter()
+        enc = self._encode_chunk(leaf, data, num_rows)
+        return enc, time.perf_counter() - t0
+
+    def _timed_encode_iter(self, leaves, datas, num_rows):
+        """Serial path: lazy per-chunk encode (consumed interleaved with
+        emit — the measured-fast one-chunk-buffered form on one core)."""
+        for leaf, data in zip(leaves, datas):
+            enc, dt = self._timed_encode(leaf, data, num_rows)
+            self.write_stats.encode_s += dt
+            yield enc
+
+    def _collect(self, futures) -> list:
+        """Resolve a submitted group's encode futures in leaf order; the
+        blocking portion is the pipeline bubble (``pool_wait_s``)."""
+        t0 = time.perf_counter()
+        out = []
+        try:
+            for i, f in enumerate(futures):
+                enc, dt = f.result()
+                self.write_stats.encode_s += dt
+                out.append(enc)
+        except BaseException:
+            # one chunk's encode failed: the siblings' results are dead —
+            # tear them down so no exception goes unretrieved
+            from ..utils.pool import cancel_futures
+
+            cancel_futures(futures[i + 1:])
+            raise
+        finally:
+            self.write_stats.pool_wait_s += time.perf_counter() - t0
+        return out
+
+    def _drain_inflight(self) -> None:
+        if self._inflight is None:
+            return
+        encs, num_rows = self._inflight
+        self._inflight = None
+        self._emit_group(self._collect(encs), num_rows)
+
+    def _emit_group(self, encs, num_rows: int) -> None:
+        """Serial emit of one fully-encoded row group: assign offsets,
+        write pages, append row-group metadata.  ``encs`` is a list (pooled
+        encodes) or the lazy serial generator."""
+        opts = self.options
+        chunks: List[md.ColumnChunk] = []
+        cis: List[Optional[md.ColumnIndex]] = []
+        ois: List[Optional[md.OffsetIndex]] = []
+        blooms: List[Optional[bytes]] = []
+        rg_start = self._pos
+        total_bytes = 0
+        total_comp = 0
         for enc in encs:
+            t0 = time.perf_counter()
             chunk, ci, oi, bloom, ubytes, cbytes = self._emit_chunk(enc)
+            self.write_stats.emit_s += time.perf_counter() - t0
             chunks.append(chunk)
             cis.append(ci)
             ois.append(oi)
@@ -304,6 +453,7 @@ class ParquetWriter:
         self._offset_indexes.append(ois)
         self._bloom_blobs.append(blooms)
         self._num_rows += num_rows
+        self.write_stats.row_groups += 1
 
     # ------------------------------------------------------------------
     def _encode_chunk(self, leaf: Leaf, data: ColumnData, num_rows: int):
@@ -645,16 +795,25 @@ class ParquetWriter:
         self._closed = True
 
     def abort(self) -> None:
-        """Discard the write: no footer is serialized, and a writer-owned
-        path sink removes its temp (or partial) file so no destination is
-        left behind.  Caller-owned sinks are left untouched (their bytes are
-        the caller's to clean up).  Idempotent; a no-op after a successful
+        """Discard the write: no footer is serialized, a writer-owned path
+        sink removes its temp (or partial) file so no destination is left
+        behind, and any background encode still in flight is cancelled
+        (queued tasks never run; a started one finishes into the void — it
+        is pure compute that touches neither the sink nor writer state).
+        Caller-owned sinks are left untouched (their bytes are the caller's
+        to clean up).  Idempotent; a no-op after a successful
         :meth:`close`."""
         if self._closed or self._aborted:
             return
         self._aborted = True
         self._buffer = None
         self._buffered_rows = 0
+        if self._inflight is not None:
+            from ..utils.pool import cancel_futures
+
+            encs, _ = self._inflight
+            self._inflight = None
+            cancel_futures(encs)
         if self._own_sink:
             self._f.abort()
 

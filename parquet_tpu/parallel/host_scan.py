@@ -320,14 +320,30 @@ def stage_scan(pf: ParquetFile, path: str, lo=None, hi=None,
     Device-route refusals (``ValueError: ... use the host scan``) are
     routing signals, not corruption, and always propagate unchanged.
     """
+    from ..io.prefetch import make_chunk_prefetcher
+
     pol, report = resolve_policy(pf, policy, report)
     with pf._resilient_op(policy, report, "stage_scan"):
-        return _stage_scan_impl(pf, path, lo, hi, columns, use_bloom,
-                                devices, values, pol, report)
+        # device-route prefetch (ROADMAP follow-on, PR 3): surviving spans'
+        # chunk ranges are planned through an advise-backed prefetcher so
+        # kernel readahead of later chunks overlaps prescan + H2D of
+        # earlier ones, instead of one cold serial pread per chunk
+        pre = make_chunk_prefetcher(
+            pf.source, n_streams=(len(columns) + 2 if columns else 4))
+        if pre is None:
+            return _stage_scan_impl(pf, path, lo, hi, columns, use_bloom,
+                                    devices, values, pol, report)
+        try:
+            with pf._source_override(pre):
+                return _stage_scan_impl(pf, path, lo, hi, columns, use_bloom,
+                                        devices, values, pol, report,
+                                        prefetcher=pre)
+        finally:
+            pre.close()
 
 
 def _stage_scan_impl(pf, path, lo, hi, columns, use_bloom, devices, values,
-                     pol, report):
+                     pol, report, prefetcher=None):
     import contextlib
 
     import jax
@@ -368,6 +384,16 @@ def _stage_scan_impl(pf, path, lo, hi, columns, use_bloom, devices, values,
     # chunk below
     plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom,
                       values=values, policy=pol, report=report)
+    if prefetcher is not None:
+        # pushdown already pruned: plan exactly the surviving spans' chunk
+        # byte ranges (deduped — several spans can share one row group)
+        seen_ranges = set()
+        for p0 in plans:
+            for c in [path] + out_cols:
+                br = pf.row_group(p0.rg_index).column(c).byte_range
+                if br not in seen_ranges:
+                    seen_ranges.add(br)
+                    prefetcher.plan(*br)
     from ..algebra.compare import normalize_probe
 
     probe = (sorted({normalize_probe(key_leaf, v) for v in values} - {None})

@@ -787,9 +787,12 @@ def copy_rows(dst: RowWriter, src: RowReader, batch: int = 4096) -> int:
 
 
 def write_rows(sink, schema: Schema, records: Iterable[Dict[str, Any]],
-               options=None) -> None:
+               options=None):
     """Write an iterable of Python records to a Parquet file via the row
-    path (supports arbitrary nesting)."""
+    path (supports arbitrary nesting).  Returns the closed writer (like
+    :func:`~parquet_tpu.io.writer.write_table`), whose ``write_stats``
+    meters the encode/emit pipeline — the row path rides the same
+    double-buffered ``write_row_group`` as the columnar front ends."""
     from .io.writer import ParquetWriter, WriterOptions
 
     w = ParquetWriter(sink, schema, options or WriterOptions())
@@ -801,6 +804,7 @@ def write_rows(sink, schema: Schema, records: Iterable[Dict[str, Any]],
     except BaseException:
         w.abort()  # path sinks unlink their temp/partial file
         raise
+    return w
 
 
 def read_rows(source) -> Iterator[Dict[str, Any]]:

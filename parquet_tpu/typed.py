@@ -354,6 +354,12 @@ class TypedWriter:
         self._pending = []
         self.writer.abort()
 
+    @property
+    def write_stats(self):
+        """The underlying writer's :class:`~parquet_tpu.io.sink.WriteStats`
+        (write-pipeline meter: encode/emit overlap, buffered writeback)."""
+        return self.writer.write_stats
+
     def __enter__(self):
         return self
 

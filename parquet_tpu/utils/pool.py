@@ -49,6 +49,18 @@ def submit(fn, *args, **kwargs):
     return shared_pool().submit(mark_pooled(fn), *args, **kwargs)
 
 
+def cancel_futures(futures) -> None:
+    """Best-effort teardown of abandoned background work: cancel what never
+    started, and attach an error-retrieving callback to the rest so a task
+    failing after its consumer gave up (writer abort, prefetcher close)
+    never logs "exception was never retrieved".  Does not wait — abandoned
+    work is pure compute whose results nobody reads."""
+    for f in futures:
+        if not f.cancel():
+            f.add_done_callback(
+                lambda g: None if g.cancelled() else g.exception())
+
+
 def available_cpus() -> int:
     """CPUs actually available to THIS process (cgroup/affinity-aware —
     os.cpu_count() reports physical cores and misfires in pinned

@@ -176,6 +176,72 @@ for name, env in cases.items():
     assert run(env) == base, f"pipeline config {name!r} changed the bytes"
 print(f"read-pipeline smoke ok: {len(cases)} configs byte-identical")
 PIPEOF
+echo "=== write-pipeline smoke (overlap on/off byte-identical + crash matrix) ==="
+python - <<'WPEOF'
+# The write-side twin of the read-pipeline smoke: a multi-row-group mixed
+# file must be byte-identical across every write-pipeline configuration
+# (overlap off / forced, writeback buffer off / on), the WriteStats meter
+# must account every flushed byte, and the seeded crash matrix must hold
+# with overlap + buffered sink enabled.  Bounded to a few seconds.
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import (WriterOptions, crash_consistency_check, verify_file,
+                         write_table)
+
+n = 24000
+rng = np.random.default_rng(3)
+lens = rng.integers(0, 4, n)
+offs = np.zeros(n + 1, np.int32)
+np.cumsum(lens, out=offs[1:])
+t = pa.table({
+    "x": pa.array(np.arange(n, dtype=np.int64)),
+    "s": pa.array([f"v{i % 61}" for i in range(n)]),
+    "lst": pa.ListArray.from_arrays(
+        pa.array(offs), pa.array(rng.integers(0, 1000, int(offs[-1])))),
+})
+d = tempfile.mkdtemp(prefix="parquet_tpu_wpipe_")
+opts = WriterOptions(row_group_size=n // 6, bloom_filters={"s": 10})
+
+def run(tag, env):
+    for k, v in env.items():
+        os.environ[k] = v
+    p = os.path.join(d, f"{tag}.parquet")
+    w = write_table(t, p, opts)
+    for k in env:
+        del os.environ[k]
+    return p, w.write_stats
+
+base, st0 = run("serial", {"PARQUET_TPU_WRITE_OVERLAP": "0",
+                           "PARQUET_TPU_WRITE_BUFFER": "0"})
+cases = {
+    "overlap=force": {"PARQUET_TPU_WRITE_OVERLAP": "force",
+                      "PARQUET_TPU_WRITE_BUFFER": "0"},
+    "overlap+buffered": {"PARQUET_TPU_WRITE_OVERLAP": "force"},
+    "buffered only": {"PARQUET_TPU_WRITE_OVERLAP": "0"},
+}
+raw = open(base, "rb").read()
+for name, env in cases.items():
+    p, st = run(name.replace(" ", "_").replace("=", "_"), env)
+    assert open(p, "rb").read() == raw, f"write config {name!r} changed bytes"
+    assert st.bytes_flushed == os.path.getsize(p), (name, st.as_dict())
+assert st0.overlapped_groups == 0 and st0.row_groups == 6, st0.as_dict()
+res = verify_file(base, decode=True)
+assert res.ok, res.summary()
+
+os.environ["PARQUET_TPU_WRITE_OVERLAP"] = "force"
+matrix = crash_consistency_check(
+    lambda sink: write_table(t, sink, opts),
+    os.path.join(d, "crash.parquet"), samples=6, seed=1, buffered=True)
+del os.environ["PARQUET_TPU_WRITE_OVERLAP"]
+assert matrix[-1]["outcome"] == "clean", matrix
+assert not [f for f in os.listdir(d) if f.endswith(".tmp")], os.listdir(d)
+print(f"write-pipeline smoke ok: {1 + len(cases)} configs byte-identical, "
+      f"crash matrix {len(matrix)} offsets clean/absent")
+WPEOF
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys
@@ -198,6 +264,10 @@ for name, cfg in detail.get('configs', {}).items():
     if name.startswith(('1_', '2_', '3_', '4_')):
         assert 'e2e_GBps' in cfg, (name, 'e2e missing')
         assert cfg.get('distinct_inputs'), (name, 'cache honesty lost')
+    if name.startswith('6_'):
+        pipe = cfg.get('pipeline', {})
+        assert pipe.get('byte_identical') is True, (name, pipe)
+        assert pipe.get('write_stats', {}).get('row_groups', 0) > 1, pipe
 print('bench smoke ok:', d['metric'], d['value'], d['unit'])
 "
 echo "ALL CHECKS PASSED"

@@ -410,3 +410,162 @@ def test_writer_parallel_encode_on_shared_pool(monkeypatch, tmp_path):
     got = ParquetFile(str(dest)).read().to_arrow()
     assert got.equals(pq.read_table(str(dest)))
     assert got.num_rows == n
+
+
+# ---------------------------------------------------------------------------
+# PR 4 satellites: auto-tuned readahead + chunk-aligned segment carving
+# ---------------------------------------------------------------------------
+def test_autotune_deepens_on_bubble_and_decays(monkeypatch):
+    from parquet_tpu.io import prefetch as pre_mod
+
+    tuner = pre_mod.prefetch_autotune()
+    tuner.reset()
+    try:
+        # a drain that blocked on in-flight windows deepens readahead
+        st = ReadStats(windows_issued=4, pool_wait_s=0.5)
+        tuner.observe(st)
+        assert tuner.suggest() == (pre_mod.DEFAULT_DEPTH + 1, None)
+        for _ in range(16):  # depth saturates, then window doubles
+            tuner.observe(st)
+        d, w = tuner.suggest()
+        assert d == pre_mod._MAX_DEPTH and w == pre_mod._MAX_WINDOW_BYTES
+        # bubble-free drains decay one step at a time back to the defaults
+        calm = ReadStats(windows_issued=4, pool_wait_s=0.0)
+        for _ in range(32):
+            tuner.observe(calm)
+        assert tuner.suggest() == (None, None)
+    finally:
+        tuner.reset()
+
+
+def test_autotune_feeds_next_prefetcher_defaults(monkeypatch):
+    from parquet_tpu.io import prefetch as pre_mod
+
+    tuner = pre_mod.prefetch_autotune()
+    tuner.reset()
+    monkeypatch.delenv("PARQUET_TPU_PREFETCH_DEPTH", raising=False)
+    monkeypatch.delenv("PARQUET_TPU_PREFETCH_WINDOW", raising=False)
+    try:
+        tuner.observe(ReadStats(windows_issued=4, pool_wait_s=0.5))
+        pre = PrefetchSource(BytesSource(b"x" * 4096), backend="ring")
+        assert pre.depth == pre_mod.DEFAULT_DEPTH + 1
+        pre.close()
+    finally:
+        tuner.reset()
+
+
+def test_autotune_env_opt_out_and_pins(monkeypatch):
+    from parquet_tpu.io import prefetch as pre_mod
+
+    tuner = pre_mod.prefetch_autotune()
+    tuner.reset()
+    try:
+        tuner.observe(ReadStats(windows_issued=4, pool_wait_s=0.5))
+        assert tuner.suggest()[0] == pre_mod.DEFAULT_DEPTH + 1
+        # opt-out: the tuned state is ignored AND no longer fed
+        monkeypatch.setenv("PARQUET_TPU_PREFETCH_AUTOTUNE", "0")
+        pre = PrefetchSource(BytesSource(b"x" * 4096), backend="ring")
+        assert pre.depth == pre_mod.DEFAULT_DEPTH
+        pre.close()
+        monkeypatch.delenv("PARQUET_TPU_PREFETCH_AUTOTUNE")
+        # an explicit env pin beats the tuned suggestion
+        monkeypatch.setenv("PARQUET_TPU_PREFETCH_DEPTH", "5")
+        pre = PrefetchSource(BytesSource(b"x" * 4096), backend="ring")
+        assert pre.depth == 5 and not pre._tunable
+        pre.close()
+    finally:
+        tuner.reset()
+
+
+def test_ring_segment_carving_zero_copy_join():
+    # windows of one plan share a contiguous segment buffer: a read
+    # spanning the join of two windows serves a zero-copy view of the
+    # segment instead of concatenating the chain
+    data = bytes(range(256)) * 256  # 64 KiB
+    pre = PrefetchSource(BytesSource(data), backend="ring",
+                         window_bytes=4096, depth=4, max_windows=16)
+    pre.plan(0, len(data))
+    for w in list(pre._ring)[:2]:
+        w.future.result()
+    w0, w1 = pre._ring[0], pre._ring[1]
+    assert w0.seg is w1.seg  # carved from one segment
+    out = pre.pread_view(2048, 4096)  # spans the 4096-byte window join
+    assert bytes(out) == data[2048:6144]
+    assert out.base is not None  # a view, not a concatenated copy
+    assert pre.stats.prefetch_hits >= 1
+    pre.close()
+
+
+def test_ring_segment_boundary_reads_still_correct():
+    # reads spanning SEGMENT joins (every _SEG_WINDOWS windows) take the
+    # copying fallback and must still serve exact bytes
+    from parquet_tpu.io import prefetch as pre_mod
+
+    data = np.random.default_rng(3).integers(
+        0, 256, 64 * 1024, dtype=np.uint8).tobytes()
+    seg_bytes = pre_mod._SEG_WINDOWS * 1024
+    pre = PrefetchSource(BytesSource(data), backend="ring",
+                         window_bytes=1024, depth=pre_mod._SEG_WINDOWS + 2,
+                         max_windows=32)
+    pre.plan(0, len(data))
+    pos = 0
+    sizes = [700, 1500, seg_bytes - 100, 3000, 1024, 997]
+    while pos < len(data):
+        take = min(sizes[pos % len(sizes)], len(data) - pos)
+        assert pre.pread(pos, take) == data[pos : pos + take], pos
+        pos += take
+    pre.close()
+
+
+def test_chunk_prefetcher_gates(monkeypatch, tmp_path):
+    from parquet_tpu.io.prefetch import make_chunk_prefetcher
+
+    raw = _file()
+    p = tmp_path / "c.parquet"
+    p.write_bytes(raw)
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "0")
+    assert make_chunk_prefetcher(BytesSource(raw)) is None
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "1")
+    # in-memory chains have nothing to hide: no prefetcher, route unchanged
+    assert make_chunk_prefetcher(BytesSource(raw)) is None
+    src = as_source(str(p))
+    try:
+        pre = make_chunk_prefetcher(src)
+        if isinstance(src, MmapSource) or isinstance(
+                getattr(src, "inner", None), MmapSource):
+            assert pre is not None and pre.backend == "advise"
+            pre.close()
+    finally:
+        src.close()
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    pre = make_chunk_prefetcher(BytesSource(raw))
+    assert pre is not None and pre.backend == "ring"  # chaos-test force
+    pre.close()
+
+
+def test_device_pipeline_routes_through_chunk_prefetcher(monkeypatch,
+                                                         tmp_path):
+    # decode_chunks_pipelined over a path-backed (mmap) file plans every
+    # chunk range through the advise prefetcher; decoded values match the
+    # in-memory route exactly
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from parquet_tpu.parallel import device_reader as dr
+
+    raw = _file(nested=False)
+    p = tmp_path / "d.parquet"
+    p.write_bytes(raw)
+    pf_mem = ParquetFile(raw)
+    pf_path = ParquetFile(str(p))
+    chunks_mem = [pf_mem.row_group(i).column("x")
+                  for i in range(len(pf_mem.row_groups))]
+    chunks_path = [pf_path.row_group(i).column("x")
+                   for i in range(len(pf_path.row_groups))]
+    want = [np.asarray(c.values) for c in dr.decode_chunks_pipelined(
+        chunks_mem)]
+    got = [np.asarray(c.values) for c in dr.decode_chunks_pipelined(
+        chunks_path)]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    # the override was popped: the file reads normally afterwards
+    assert pf_path.read().to_arrow().equals(pf_mem.read().to_arrow())

@@ -1,0 +1,440 @@
+"""Pipelined write path (ISSUE 4): double-buffered encode/emit overlap,
+BufferedSink writeback coalescing, WriteStats observability, and the
+overlap x writer-fault matrix.
+
+The invariants under test mirror the read pipeline's (test_prefetch.py):
+every pipeline configuration — overlap off/forced, writeback buffer off/on,
+pool width 1/N — must produce byte-identical files, and every injected
+write fault (ENOSPC, short write, hard crash) under overlap must leave the
+destination either absent or verifying clean, never torn."""
+
+import dataclasses
+import errno
+import io
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parquet_tpu import (AtomicFileSink, BufferedSink, FaultInjectingSink,
+                         InjectedWriterCrash, ParquetFile, ParquetWriter,
+                         SortingColumn, SortingWriter, TypedWriter,
+                         WriteStats, WriterOptions, crash_consistency_check,
+                         schema_from_arrow, verify_file, write_table)
+from parquet_tpu.io.writer import columns_from_arrow
+from parquet_tpu.rows import write_rows
+from parquet_tpu.utils import pool as pool_mod
+
+N_ROWS = 12000
+RG = 2000  # 6 row groups
+
+
+def _mixed_table(n=N_ROWS) -> "pa.Table":
+    rng = np.random.default_rng(5)
+    lens = rng.integers(0, 4, n)
+    offs = np.zeros(n + 1, np.int32)
+    np.cumsum(lens, out=offs[1:])
+    x = np.arange(n, dtype=np.int64)
+    return pa.table({
+        "x": pa.array(x),
+        "f": pa.array(rng.random(n)),
+        "s": pa.array([f"v{i % 37}" for i in range(n)]),
+        "ox": pa.array(np.where(x % 5 == 0, None, x), type=pa.int64()),
+        "lst": pa.ListArray.from_arrays(
+            pa.array(offs), pa.array(np.arange(offs[-1], dtype=np.int64))),
+    })
+
+
+@pytest.fixture(scope="module")
+def table():
+    return _mixed_table()
+
+
+@pytest.fixture(scope="module")
+def schema(table):
+    return schema_from_arrow(table.schema)
+
+
+def _no_temps(d) -> bool:
+    return not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+
+def _write_bytes(table, opts, monkeypatch=None, overlap="0", buffer="0",
+                 via_write=False):
+    if monkeypatch is not None:
+        monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", overlap)
+        monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", buffer)
+    buf = io.BytesIO()
+    schema = schema_from_arrow(table.schema)
+    w = ParquetWriter(buf, schema, opts)
+    if via_write:
+        # the write()-buffered front end: slabs that straddle group bounds
+        step = RG // 3 + 17
+        for start in range(0, table.num_rows, step):
+            part = table.slice(start, min(step, table.num_rows - start))
+            w.write(columns_from_arrow(part, schema), part.num_rows)
+    else:
+        for start in range(0, table.num_rows, RG):
+            part = table.slice(start, RG)
+            w.write_row_group(columns_from_arrow(part, schema),
+                              part.num_rows)
+    w.close()
+    return buf.getvalue(), w.write_stats
+
+
+# ---------------------------------------------------------------------------
+# equivalence: every pipeline configuration produces identical bytes
+# ---------------------------------------------------------------------------
+def test_overlap_on_off_byte_identical(table, monkeypatch):
+    opts = WriterOptions(row_group_size=RG)
+    base, st0 = _write_bytes(table, opts, monkeypatch, overlap="0")
+    forced, st1 = _write_bytes(table, opts, monkeypatch, overlap="force")
+    assert forced == base
+    assert st0.overlapped_groups == 0
+    assert st1.overlapped_groups == st1.row_groups == 6
+    assert ParquetFile(forced).read().to_arrow().equals(table)
+
+
+def test_overlap_equivalence_via_buffered_write_path(table, monkeypatch):
+    # write() accumulation (slab sizes straddling group boundaries) drains
+    # through the same pipelined write_row_group
+    opts = WriterOptions(row_group_size=RG)
+    base, _ = _write_bytes(table, opts, monkeypatch, overlap="0",
+                           via_write=True)
+    forced, st = _write_bytes(table, opts, monkeypatch, overlap="force",
+                              via_write=True)
+    assert forced == base
+    assert st.overlapped_groups > 0
+
+
+def test_overlap_equivalence_with_dict_overflow(monkeypatch):
+    # high-cardinality strings overflow the dictionary limit mid-file: the
+    # sticky fallback must engage at the same group with overlap on or off
+    # (encode N+1 only starts after encode N finished)
+    n = 6000
+    t = pa.table({"s": pa.array([f"unique-{i:08d}" for i in range(n)])})
+    opts = WriterOptions(row_group_size=1000, dictionary_page_limit=4096)
+    base, _ = _write_bytes(t, opts, monkeypatch, overlap="0")
+    forced, _ = _write_bytes(t, opts, monkeypatch, overlap="force")
+    assert forced == base
+
+
+@pytest.mark.parametrize("width", ["1", "8"])
+def test_overlap_pool_width_equivalence(table, monkeypatch, width):
+    opts = WriterOptions(row_group_size=RG)
+    base, _ = _write_bytes(table, opts, monkeypatch, overlap="0")
+    monkeypatch.setenv("PARQUET_TPU_POOL_WORKERS", width)
+    monkeypatch.setattr(pool_mod, "_POOL", None)  # rebuild at new width
+    try:
+        got, _ = _write_bytes(table, opts, monkeypatch, overlap="force")
+    finally:
+        monkeypatch.undo()
+        pool_mod._POOL = None  # next user rebuilds at the ambient width
+    assert got == base
+
+
+def test_rows_path_overlap_equivalence(monkeypatch):
+    from parquet_tpu import leaf, message
+    from parquet_tpu.format.enums import FieldRepetitionType as Rep, Type
+
+    schema = message("rec", [
+        leaf("a", Type.INT64),
+        leaf("b", Type.BYTE_ARRAY, Rep.OPTIONAL, logical="string")])
+    records = [{"a": i, "b": None if i % 7 == 0 else f"r{i % 13}"}
+               for i in range(5000)]
+    opts = WriterOptions(row_group_size=800)
+
+    def run(mode):
+        monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", mode)
+        buf = io.BytesIO()
+        w = write_rows(buf, schema, records, opts)
+        return buf.getvalue(), w.write_stats
+
+    base, _ = run("0")
+    forced, st = run("force")
+    assert forced == base
+    assert st.overlapped_groups > 0
+
+
+# ---------------------------------------------------------------------------
+# WriteStats observability
+# ---------------------------------------------------------------------------
+def test_write_stats_meters_the_pipeline(table, monkeypatch, tmp_path):
+    monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", "force")
+    monkeypatch.delenv("PARQUET_TPU_WRITE_BUFFER", raising=False)
+    dest = tmp_path / "stats.parquet"
+    w = write_table(table, str(dest), WriterOptions(row_group_size=RG))
+    st = w.write_stats
+    assert st.row_groups == 6 and st.overlapped_groups == 6
+    assert st.encode_s > 0 and st.emit_s > 0
+    # every byte that reached the OS is accounted, including magic + footer
+    assert st.bytes_flushed == os.path.getsize(dest)
+    assert 0.0 <= st.overlap_ratio() <= 1.0
+    d = st.as_dict()
+    assert set(d) == {"row_groups", "overlapped_groups", "encode_s",
+                      "emit_s", "pool_wait_s", "overlap_ratio",
+                      "bytes_buffered", "bytes_flushed", "sink_flushes"}
+
+
+def test_write_stats_serial_mode_zero_overlap(table, monkeypatch):
+    base, st = _write_bytes(table, WriterOptions(row_group_size=RG),
+                            monkeypatch, overlap="0")
+    assert st.overlapped_groups == 0 and st.pool_wait_s == 0.0
+    assert st.overlap_ratio() == 0.0
+    assert st.encode_s > 0  # serial encodes are still metered
+
+
+def test_typed_writer_surfaces_write_stats(tmp_path):
+    @dataclasses.dataclass
+    class Rec:
+        x: int
+
+    with TypedWriter(str(tmp_path / "t.parquet"), Rec) as tw:
+        tw.write([Rec(x=i) for i in range(100)])
+    assert isinstance(tw.write_stats, WriteStats)
+    assert tw.write_stats.row_groups == 1
+
+
+def test_sorting_writer_surfaces_write_stats(tmp_path, table):
+    dest = tmp_path / "sorted.parquet"
+    with SortingWriter(str(dest), schema_from_arrow(table.schema),
+                       [SortingColumn("x", descending=True)],
+                       buffer_rows=3000) as sw:
+        sw.write_arrow(table)  # > buffer_rows: forces the spill-merge path
+    assert verify_file(str(dest)).ok
+    assert sw.write_stats is not None and sw.write_stats.row_groups > 0
+
+
+# ---------------------------------------------------------------------------
+# the overlap actually overlaps: a blocking (GIL-releasing) sink
+# ---------------------------------------------------------------------------
+class _ThrottledSink:
+    """Simulated slow storage: writes block with the GIL released."""
+
+    def __init__(self, rate_bps=50e6):
+        self.buf = io.BytesIO()
+        self.rate = rate_bps
+
+    def write(self, d):
+        time.sleep(len(d) / self.rate)
+        return self.buf.write(d)
+
+    def writelines(self, parts):
+        for p in parts:
+            self.write(p)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_overlap_hides_encode_behind_blocking_sink(table, monkeypatch):
+    opts = WriterOptions(row_group_size=RG)
+    schema = schema_from_arrow(table.schema)
+
+    def run(mode):
+        monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", mode)
+        sink = _ThrottledSink()
+        w = ParquetWriter(sink, schema, opts)
+        for start in range(0, table.num_rows, RG):
+            part = table.slice(start, RG)
+            w.write_row_group(columns_from_arrow(part, schema),
+                              part.num_rows)
+        w.close()
+        return sink.buf.getvalue(), w.write_stats
+
+    base, _ = run("0")
+    forced, st = run("force")
+    assert forced == base
+    # while group N's pages sat in the sink's blocking writes, group N+1
+    # encoded in the background: emit never (materially) waited on encode
+    assert st.overlap_ratio() > 0.3, st.as_dict()
+
+
+# ---------------------------------------------------------------------------
+# BufferedSink unit behavior
+# ---------------------------------------------------------------------------
+class _CountingSink:
+    def __init__(self):
+        self.buf = io.BytesIO()
+        self.write_calls = 0
+        self.writelines_calls = 0
+        self.closed = False
+        self.aborted = False
+
+    def write(self, d):
+        self.write_calls += 1
+        return self.buf.write(d)
+
+    def writelines(self, parts):
+        self.writelines_calls += 1
+        for p in parts:
+            self.buf.write(p)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+    def abort(self):
+        self.aborted = True
+
+
+def test_buffered_sink_coalesces_small_writes():
+    inner = _CountingSink()
+    st = WriteStats()
+    b = BufferedSink(inner, buffer_bytes=1024, stats=st)
+    for i in range(64):
+        b.write(bytes([i]) * 100)  # 6400 bytes in 100-byte pages
+    assert inner.write_calls == 0
+    assert inner.writelines_calls == 5  # ~1.1 KB vectored flushes
+    b.flush()
+    assert inner.buf.getvalue() == b"".join(bytes([i]) * 100
+                                            for i in range(64))
+    assert st.bytes_buffered == 6400 and st.bytes_flushed == 6400
+    assert st.sink_flushes == 6
+
+
+def test_buffered_sink_close_drains_then_closes():
+    inner = _CountingSink()
+    b = BufferedSink(inner, buffer_bytes=1 << 20)
+    b.write(b"tail bytes")
+    b.close()
+    assert inner.closed and inner.buf.getvalue() == b"tail bytes"
+
+
+def test_buffered_sink_abort_drops_buffer():
+    inner = _CountingSink()
+    b = BufferedSink(inner, buffer_bytes=1 << 20)
+    b.write(b"never flushed")
+    b.abort()
+    assert inner.aborted and inner.buf.getvalue() == b""
+
+
+def test_buffered_sink_passthrough_mode_counts():
+    inner = _CountingSink()
+    st = WriteStats()
+    b = BufferedSink(inner, buffer_bytes=0, stats=st)
+    b.write(b"abc")
+    b.writelines([b"de", b"f"])
+    assert inner.buf.getvalue() == b"abcdef"
+    assert st.bytes_flushed == 6 and st.bytes_buffered == 0
+
+
+def test_write_buffer_env_knob(table, monkeypatch, tmp_path):
+    # PARQUET_TPU_WRITE_BUFFER=0 disables coalescing for path sinks; the
+    # bytes are identical either way
+    opts = WriterOptions(row_group_size=RG)
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", "0")
+    p0 = tmp_path / "nobuf.parquet"
+    w0 = write_table(table, str(p0), opts)
+    assert w0.write_stats.sink_flushes == 0
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", str(1 << 16))
+    p1 = tmp_path / "buf.parquet"
+    w1 = write_table(table, str(p1), opts)
+    assert w1.write_stats.sink_flushes > 0
+    assert p0.read_bytes() == p1.read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# overlap x writer faults: no torn destination, ever
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def force_overlap(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", "force")
+
+
+def test_enospc_with_overlap_aborts_clean(tmp_path, table, schema,
+                                          force_overlap):
+    dest = tmp_path / "enospc.parquet"
+    sink = FaultInjectingSink(AtomicFileSink(str(dest)), enospc_at_byte=8192)
+    with pytest.raises(OSError) as ei:
+        with ParquetWriter(sink, schema,
+                           WriterOptions(row_group_size=RG)) as w:
+            for start in range(0, table.num_rows, RG):
+                part = table.slice(start, RG)
+                w.write_row_group(columns_from_arrow(part, schema),
+                                  part.num_rows)
+    assert ei.value.errno == errno.ENOSPC
+    assert w._inflight is None  # abort cancelled the queued encode
+    sink.abort()
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+
+
+def test_crash_with_overlap_leaves_dest_absent(tmp_path, table, schema,
+                                               force_overlap):
+    dest = tmp_path / "crash.parquet"
+    sink = FaultInjectingSink(AtomicFileSink(str(dest)), crash_at_byte=3000)
+    with pytest.raises(InjectedWriterCrash):
+        with ParquetWriter(sink, schema,
+                           WriterOptions(row_group_size=RG)) as w:
+            for start in range(0, table.num_rows, RG):
+                part = table.slice(start, RG)
+                w.write_row_group(columns_from_arrow(part, schema),
+                                  part.num_rows)
+    assert w._inflight is None
+    assert not dest.exists()
+    sink.abort()
+    assert _no_temps(tmp_path)
+
+
+def test_short_write_with_overlap_and_buffer_surfaces(table, schema,
+                                                      force_overlap):
+    inj = FaultInjectingSink(io.BytesIO(), seed=3, short_write_rate=0.3)
+    sink = BufferedSink(inj, buffer_bytes=1 << 16)
+    with pytest.raises(OSError, match="short write"):
+        with ParquetWriter(sink, schema,
+                           WriterOptions(row_group_size=RG)) as w:
+            for start in range(0, table.num_rows, RG):
+                part = table.slice(start, RG)
+                w.write_row_group(columns_from_arrow(part, schema),
+                                  part.num_rows)
+    assert inj.stats.injected_short_writes >= 1
+
+
+def test_crash_matrix_with_overlap_and_buffered_sink(tmp_path, table,
+                                                     force_overlap):
+    dest = str(tmp_path / "matrix.parquet")
+    opts = WriterOptions(row_group_size=RG)
+    results = crash_consistency_check(
+        lambda sink: write_table(table, sink, opts), dest,
+        samples=8, seed=7, buffered=True)
+    assert [r["outcome"] for r in results[:-1]] == ["absent"] * (
+        len(results) - 1)
+    assert results[-1] == {"offset": None, "outcome": "clean"}
+    assert _no_temps(tmp_path)
+    assert verify_file(dest).ok
+
+
+def test_abort_mid_stream_cancels_inflight(tmp_path, table, schema,
+                                           force_overlap):
+    dest = tmp_path / "aborted.parquet"
+    w = ParquetWriter(str(dest), schema, WriterOptions(row_group_size=RG))
+    w.write_row_group(columns_from_arrow(table.slice(0, RG), schema), RG)
+    assert w._inflight is not None  # the group is pended, not yet emitted
+    w.abort()
+    assert w._inflight is None
+    assert not dest.exists()
+    assert _no_temps(tmp_path)
+    with pytest.raises(ValueError, match="aborted"):
+        w.write_row_group(columns_from_arrow(table.slice(0, RG), schema), RG)
+
+
+def test_flush_emits_the_pended_group(table, schema, force_overlap,
+                                      monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", "force")
+    buf = io.BytesIO()
+    w = ParquetWriter(buf, schema, WriterOptions(row_group_size=RG))
+    w.write_row_group(columns_from_arrow(table.slice(0, RG), schema), RG)
+    assert len(w._row_groups) == 0  # still in flight
+    w.flush()
+    assert len(w._row_groups) == 1 and w._inflight is None
+    w.close()
+    assert ParquetFile(buf.getvalue()).read().to_arrow().equals(
+        table.slice(0, RG))
