@@ -774,6 +774,83 @@ def _cfg7(n):
     return out
 
 
+def _cfg8(n):
+    """Dataset layer A/B (ISSUE 5): an 8-file corpus read three ways — a
+    serial per-file loop, the Dataset parallel multi-file read (both cold:
+    caches cleared per rep), and the warm re-open where the footer cache
+    and the bounded decoded-chunk LRU serve — byte-identity asserted
+    against the serial loop, warm-path cache hits recorded, and the LRU's
+    byte cap checked."""
+    import shutil
+    import tempfile
+
+    from parquet_tpu import Dataset, cache_stats, clear_caches
+    from parquet_tpu.io.reader import ParquetFile
+
+    rng = np.random.default_rng(31)
+    per = max(n // 8, 8)
+    d = tempfile.mkdtemp(prefix="parquet_tpu_bench_ds_")
+    paths = []
+    for i in range(8):
+        t = pa.table({
+            "k": pa.array((np.arange(per, dtype=np.int64) + i * per)),
+            "v": pa.array(rng.random(per)),
+            "s": pa.array([f"f{i}_{j % 97}" for j in range(per)]),
+        })
+        p = os.path.join(d, f"part-{i:02d}.parquet")
+        pq.write_table(t, p, compression="snappy",
+                       row_group_size=max(per // 2, 1))
+        paths.append(p)
+    try:
+        def serial():
+            clear_caches()
+            return pa.concat_tables(ParquetFile(p).read().to_arrow()
+                                    for p in paths)
+
+        ref = serial()
+        serial_s = _time_best(serial, reps=3)
+
+        def cold():
+            clear_caches()
+            with Dataset(paths) as ds:
+                return ds.read().to_arrow()
+
+        got = cold()
+        assert got.equals(ref), "dataset read differs from the serial loop"
+        cold_s = _time_best(cold, reps=3)
+
+        clear_caches()
+        with Dataset(paths) as ds:
+            ds.read()  # populate footer + chunk caches
+        c0 = cache_stats()
+
+        def warm():
+            with Dataset(paths) as ds:  # fresh opens: must hit the caches
+                return ds.read().to_arrow()
+
+        wgot = warm()
+        assert wgot.equals(ref), "warm dataset read changed values"
+        warm_s = _time_best(warm, reps=3)
+        c1 = cache_stats()
+        footer_hits = c1.footer_hits - c0.footer_hits
+        chunk_hits = c1.chunk_hits - c0.chunk_hits
+        assert footer_hits > 0, "warm open never hit the footer cache"
+        assert chunk_hits > 0, "warm read never hit the chunk cache"
+        assert c1.chunk_bytes <= c1.chunk_capacity, "LRU over its byte cap"
+        return {
+            "files": len(paths), "rows": per * 8,
+            "serial_s": round(serial_s, 4),
+            "parallel_s": round(cold_s, 4),
+            "warm_s": round(warm_s, 4),
+            "parallel_vs_serial": round(serial_s / cold_s, 2),
+            "warm_vs_serial": round(serial_s / warm_s, 2),
+            "byte_identical": True,
+            "cache": c1.as_dict(),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 _CAL0 = None
 
 
@@ -868,10 +945,12 @@ def main():
     li_rows = int(os.environ.get("BENCH_LINEITEM_ROWS",
                                  120_000 if quick else 40_000_000))
     _run("7_lineitem_scale", _cfg7, li_rows)
+    _run("8_dataset", _cfg8, max(n_rows // 4, 64))
 
     head = configs["1_int64_plain"]
     print(json.dumps({
-        "detail": "per-config breakdown (BASELINE.md configs 1-5 + write + scale)",
+        "detail": "per-config breakdown (BASELINE.md configs 1-5 + write "
+                  "+ scale + dataset)",
         "rows": n_rows,
         "backend": str(jax.devices()[0]),
         "tpu_available": tpu_ok,

@@ -36,10 +36,18 @@ Read pipeline
   (prefetch hits/misses, bytes, pool wait — ``Table.read_stats``),
   MmapSource (zero-copy page-cache views; default for path opens)
 Write pipeline
-  BufferedSink (coalescing writeback over any sink; path sinks default),
+  BufferedSink (coalescing writeback over any sink; path sinks default,
+  true vectored ``os.writev`` flushes on raw-fd sinks),
   WriteStats (encode/emit/pool-wait seconds, bytes buffered/flushed,
   overlap ratio — ``ParquetWriter.write_stats``); the double-buffered
   encode/emit overlap itself lives in ParquetWriter.write_row_group
+Datasets & caching
+  Dataset (parallel multi-file read/iter_batches/scan with footer-level
+  file pruning, deterministic file-ordered output, shard(i, n) for
+  multi-host meshes, skip-a-bad-file degraded reads), CacheStats/
+  cache_stats/clear_caches (shared footer cache keyed by open-time fstat
+  (path, inode, mtime_ns, size) + bounded decoded-chunk LRU,
+  ``PARQUET_TPU_CHUNK_CACHE`` bytes)
 Durability & integrity
   AtomicFileSink (fsync + atomic rename commit; path sinks default),
   FileSink, WriteError, FaultInjectingSink/InjectedWriterCrash (write-side
@@ -65,7 +73,9 @@ from .io.stream import iter_batches
 from .ops.encodings import (DictIndices, EncodingSpec, register_encoding,
                             registered_encodings)
 from .io.prefetch import PrefetchSource, ReadStats
+from .io.cache import CacheStats, cache_stats, clear_caches
 from .io.source import MmapSource, RetryingSource, Source
+from .dataset import Dataset
 from .parallel.host_scan import (scan, scan_filtered,
                                  scan_filtered_device, scan_filtered_sharded)
 from .parallel.mesh import ShardedTable, default_mesh, read_table_sharded

@@ -4,8 +4,10 @@
 Reference parity: the reference ships ``print.go`` (PrintSchema) as a
 library; this front end makes the same dumps reachable from a shell.
 ``verify`` runs the integrity subsystem (io/integrity.py) and exits 0 only
-when the file is provably clean — the operational check after an ingest or
-before trusting a checkpoint.
+when EVERY file is provably clean — the operational check after an ingest
+or before trusting a checkpoint.  It accepts multiple paths and shell-style
+globs, verifying files in parallel on the shared pool with a per-file
+report line; any corrupt or unreadable file makes the exit code 1.
 """
 
 import argparse
@@ -18,9 +20,11 @@ def main(argv=None) -> int:
                    choices=["meta", "schema", "pages", "head", "verify"],
                    help="meta: file summary; schema: schema tree; pages: "
                         "page-level dump; head: first rows as JSON lines; "
-                        "verify: end-to-end integrity check (exit 0 = clean, "
-                        "1 = corrupt)")
-    p.add_argument("file", help="parquet file path")
+                        "verify: end-to-end integrity check (exit 0 = every "
+                        "file clean, 1 = any corrupt)")
+    p.add_argument("file", nargs="+",
+                   help="parquet file path(s); verify accepts several and "
+                        "shell-style globs, checked in parallel")
     p.add_argument("--row-group", type=int, default=0,
                    help="pages: which row group")
     p.add_argument("--column", type=int, default=0,
@@ -30,7 +34,7 @@ def main(argv=None) -> int:
                    help="verify: additionally decode every column chunk "
                         "(slowest, strongest check)")
     p.add_argument("--json", action="store_true",
-                   help="verify: emit the IntegrityReport as JSON")
+                   help="verify: emit one IntegrityReport JSON per line")
     args = p.parse_args(argv)
 
     if args.command == "verify":
@@ -38,23 +42,45 @@ def main(argv=None) -> int:
         # report and exit code, not a traceback
         import json
 
+        from .dataset import expand_paths
         from .io.integrity import verify_file
+        from .utils.pool import map_in_order
 
-        try:
-            rep = verify_file(args.file, decode=args.decode)
-        except OSError as e:
-            print(f"parquet_tpu: {e}", file=sys.stderr)
+        missing: list = []
+        files = expand_paths(args.file, missing=missing)
+        for item in missing:
+            print(f"parquet_tpu: {item}: no files match", file=sys.stderr)
+        if not files:
             return 1
-        print(json.dumps(rep.as_dict()) if args.json else rep.summary())
-        return 0 if rep.ok else 1
+
+        def one(path):
+            try:
+                return verify_file(path, decode=args.decode)
+            except OSError as e:  # unreadable file: a failure, not a crash
+                return e
+
+        bad = len(missing)
+        for path, rep in zip(files, map_in_order(one, files)):
+            if isinstance(rep, Exception):
+                print(f"parquet_tpu: {path}: {rep}", file=sys.stderr)
+                bad += 1
+                continue
+            print(json.dumps(rep.as_dict()) if args.json else rep.summary())
+            if not rep.ok:
+                bad += 1
+        return 1 if bad else 0
 
     from .io.reader import ParquetFile
     from .utils.printer import print_file, print_pages, print_schema
 
+    if len(args.file) != 1:
+        print(f"parquet_tpu: {args.command} takes exactly one file",
+              file=sys.stderr)
+        return 1
     try:
         if args.n < 1:
             raise ValueError("-n must be >= 1")
-        pf = ParquetFile(args.file)
+        pf = ParquetFile(args.file[0])
         if args.command == "meta":
             print_file(pf, file=sys.stdout)
         elif args.command == "schema":

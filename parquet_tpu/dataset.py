@@ -1,0 +1,496 @@
+"""Multi-file dataset layer: plan, prune, and scan fleets of parquet files.
+
+Every fast path below PR 4 — prefetching reads, parallel streamed decode,
+pipelined writes — terminates at a single :class:`~parquet_tpu.io.reader.
+ParquetFile`.  Serving-scale workloads (the ROADMAP north star: heavy
+traffic, sharding, batching, caching) read *fleets*: a directory of
+part-files written by many workers, re-opened constantly, scanned with
+predicates that rule most files out before any byte moves.  ``Dataset`` is
+that layer:
+
+- **Planning before IO** — :meth:`Dataset.prune` rules whole files out with
+  footer-level min/max statistics (no chunk bytes touched; footers come
+  from the shared cache on hot re-opens), then
+  :func:`~parquet_tpu.io.search.plan_scan` plans pages per survivor.
+- **Parallel multi-file execution** — :meth:`read`, :meth:`iter_batches`,
+  and :meth:`scan` fan per-file work across the shared pool
+  (utils/pool.py) with deterministic, file-ordered output and global row
+  indexing (:meth:`row_offsets`); each file's own decode stays serial
+  inside its worker (nested fan-out would deadlock the pool), and
+  :class:`~parquet_tpu.io.prefetch.PrefetchSource` keeps working per file.
+- **Shared caches** — footers and whole-chunk decoded columns are served
+  from the process-wide caches in io/cache.py (hit/miss/eviction counters
+  via :meth:`cache_stats`), so hot files cost one parse and one decode no
+  matter how many times they are re-opened.
+- **Sharding** — :meth:`shard` splits files round-robin for multi-host
+  meshes (``parallel.mesh.dataset_process_shard`` picks this process's
+  shard).
+- **Resilience composes** — a :class:`~parquet_tpu.io.faults.FaultPolicy`
+  with ``on_corrupt='skip_row_group'`` extends to skip-a-bad-FILE degraded
+  reads: a file whose footer will not parse (or that vanished) drops as a
+  unit, recorded in the :class:`~parquet_tpu.io.faults.ReadReport` under
+  ``files_skipped``; row-group-level skips inside readable files keep their
+  existing per-file semantics.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .errors import CorruptedError, DeadlineError
+from .io.faults import NON_DATA_ERRORS, FaultPolicy, ReadReport
+from .io.reader import ParquetFile, ReadOptions, Table
+from .io.search import PagePlan, plan_scan, prune_file
+from .utils.pool import map_in_order
+
+__all__ = ["Dataset", "expand_paths"]
+
+_GLOB_CHARS = frozenset("*?[")
+
+
+def expand_paths(paths_or_glob, missing: Optional[list] = None) -> List[str]:
+    """Resolve paths-and-globs to a deterministic path list: glob patterns
+    expand sorted (``**`` recurses), explicit paths keep caller order,
+    duplicates keep their first position, and a literally-existing path is
+    never treated as a pattern.  An unmatched glob raises
+    ``FileNotFoundError`` — or,
+    when ``missing`` is a list, is appended there instead (the CLI collects
+    per-pattern failures and keeps going).  Shared by :class:`Dataset` and
+    ``python -m parquet_tpu verify``."""
+    if isinstance(paths_or_glob, (str, os.PathLike)):
+        items = [os.fspath(paths_or_glob)]
+    else:
+        items = [os.fspath(p) for p in paths_or_glob]
+    out: List[str] = []
+    seen = set()
+    for item in items:
+        # a path that literally exists is never treated as a pattern, even
+        # when its name contains glob metacharacters ("part[1].parquet")
+        if _GLOB_CHARS & set(item) and not os.path.lexists(item):
+            got = sorted(_glob.glob(item, recursive="**" in item))
+            if not got:
+                if missing is None:
+                    raise FileNotFoundError(f"glob {item!r} matched no files")
+                missing.append(item)
+                continue
+        else:
+            got = [item]
+        for p in got:
+            if p not in seen:
+                seen.add(p)
+                out.append(p)
+    return out
+
+
+def _leaf_signature(pf: ParquetFile):
+    """Full per-leaf type identity: physical type alone is not enough —
+    two files can share INT64 'amount' columns whose logical types (DECIMAL
+    scale, timestamp unit) or nesting levels differ, and merging them
+    under the first file's interpretation would silently mis-scale every
+    value of the other."""
+    return tuple((l.dotted_path, int(l.physical_type), l.type_length,
+                  l.logical_kind,
+                  tuple(sorted((l.logical_params or {}).items())),
+                  l.max_definition_level, l.max_repetition_level)
+                 for l in pf.schema.leaves)
+
+
+class Dataset:
+    """Many parquet files as one readable, scannable, shardable unit.
+
+    ``paths_or_glob`` is a path, a glob pattern, or a sequence mixing both
+    (globs expand sorted; explicit order is preserved; duplicates dropped).
+    Files open lazily and stay open until :meth:`close`; footers of hot
+    files come from the shared cache, so constructing a Dataset over a warm
+    corpus is metadata-cheap.  All files must share one leaf schema
+    (dotted paths + physical types) — checked on first multi-file access.
+
+    ``options``/``policy`` apply to every file (per-call ``policy``
+    overrides, same resolution rule as ``ParquetFile.read``).  ``open_fn``
+    overrides how a path becomes a ParquetFile — the chaos harness injects
+    per-file :class:`~parquet_tpu.io.faults.FaultInjectingSource` wrappers
+    through it.
+    """
+
+    def __init__(self, paths_or_glob, options: Optional[ReadOptions] = None,
+                 policy: Optional[FaultPolicy] = None, open_fn=None):
+        self.paths = expand_paths(paths_or_glob)
+        if not self.paths:
+            raise ValueError("Dataset needs at least one path")
+        self.options = options
+        self.policy = policy
+        self._open_fn = open_fn
+        self._files: Dict[int, ParquetFile] = {}
+        self._lock = threading.Lock()
+        self._schema_sig = None
+
+    # ------------------------------------------------------------- opening
+    @classmethod
+    def _from_paths(cls, paths: List[str], options, policy,
+                    open_fn) -> "Dataset":
+        obj = object.__new__(cls)
+        obj.paths = list(paths)
+        obj.options = options
+        obj.policy = policy
+        obj._open_fn = open_fn
+        obj._files = {}
+        obj._lock = threading.Lock()
+        obj._schema_sig = None
+        return obj
+
+    def file(self, i: int) -> ParquetFile:
+        """The i-th file, opened on first use and memoized."""
+        with self._lock:
+            pf = self._files.get(i)
+        if pf is not None:
+            return pf
+        path = self.paths[i]
+        pf = (self._open_fn(path) if self._open_fn is not None
+              else ParquetFile(path, options=self.options,
+                               policy=self.policy))
+        with self._lock:
+            cur = self._files.get(i)
+            if cur is None:
+                self._files[i] = pf
+                return pf
+        # another thread won the open race: keep theirs, close ours (an
+        # unclosed loser would leak its fd/mmap — FileSource has no
+        # finalizer, and the flaky-mount retry workloads this layer serves
+        # would exhaust the fd limit through repeated races)
+        pf.close()
+        return cur
+
+    @property
+    def files(self) -> List[ParquetFile]:
+        # cold corpora open in parallel on the shared pool (footer preads
+        # are the cost on network mounts); a fully-warm dataset skips the
+        # pool — num_rows/row_offsets are called repeatedly and must not
+        # pay n dispatches for n dict lookups
+        with self._lock:
+            cached = [self._files.get(i) for i in range(len(self.paths))]
+        if all(pf is not None for pf in cached):
+            return cached
+        return map_in_order(self.file, range(len(self.paths)))
+
+    @property
+    def num_files(self) -> int:
+        return len(self.paths)
+
+    @property
+    def num_rows(self) -> int:
+        return int(sum(pf.num_rows for pf in self.files))
+
+    @property
+    def schema(self):
+        if not self.paths:
+            raise ValueError("empty dataset shard has no schema; "
+                             "check num_files first")
+        return self.file(0).schema
+
+    def row_offsets(self) -> np.ndarray:
+        """Global row indexing: ``offsets[i]`` is the global ordinal of file
+        i's first row (``offsets[num_files]`` == total rows).  Output of
+        :meth:`read`/:meth:`iter_batches` is file-ordered, so global row g
+        of the dataset is local row ``g - offsets[i]`` of file
+        ``i = searchsorted(offsets, g, 'right') - 1``."""
+        offs = np.zeros(len(self.paths) + 1, np.int64)
+        np.cumsum([pf.num_rows for pf in self.files], out=offs[1:])
+        return offs
+
+    def shard(self, index: int, count: int) -> "Dataset":
+        """Deterministic file shard ``index`` of ``count``: files taken
+        round-robin (``paths[index::count]``), so shards are disjoint, their
+        union is the corpus, and sizes differ by at most one file — the
+        split a multi-host mesh reads with
+        :func:`~parquet_tpu.parallel.mesh.dataset_process_shard`.  A shard
+        may be empty when ``count`` exceeds the file count."""
+        if not 0 <= index < count:
+            raise ValueError(f"shard index {index} out of range [0, {count})")
+        return Dataset._from_paths(self.paths[index::count], self.options,
+                                   self.policy, self._open_fn)
+
+    # ---------------------------------------------------------- resilience
+    def _resolve(self, policy, report):
+        pol = policy if policy is not None else self.policy
+        if report is None and pol is not None:
+            report = ReadReport()
+        skip = pol is not None and pol.skip_corrupt
+        return pol, report, skip
+
+    def _check_schema(self, pf: ParquetFile, path: str) -> None:
+        sig = _leaf_signature(pf)
+        with self._lock:
+            if self._schema_sig is None:
+                self._schema_sig = (path, sig)
+                return
+            ref_path, ref_sig = self._schema_sig
+        if sig != ref_sig:
+            raise ValueError(
+                f"dataset schema mismatch: {path!r} does not match "
+                f"{ref_path!r} (leaf paths/types differ)")
+
+    # --------------------------------------------------------------- read
+    def read(self, columns: Optional[Sequence[str]] = None,
+             policy: Optional[FaultPolicy] = None,
+             report: Optional[ReadReport] = None) -> Table:
+        """Read and decode every file into one :class:`Table` — per-file
+        reads fan out on the shared pool, parts land in file order (byte-
+        identical to a serial per-file loop), and global row ordinals follow
+        :meth:`row_offsets`.  Under a degraded ``policy`` a file that cannot
+        be opened/read drops as a unit (``report.files_skipped``); row-group
+        skips inside readable files keep their per-file semantics."""
+        if not self.paths:
+            raise ValueError("read on an empty dataset shard (no schema to "
+                             "type an empty table by); check num_files first")
+        pol, report, skip = self._resolve(policy, report)
+
+        def read_one(i):
+            rows = 0
+            sub = ReadReport() if report is not None else None
+            try:
+                pf = self.file(i)
+                self._check_schema(pf, self.paths[i])
+                rows = pf.num_rows
+                return pf.read(columns=columns, policy=pol,
+                               report=sub), sub, rows, None
+            except DeadlineError:
+                raise
+            except NON_DATA_ERRORS:
+                raise
+            except (CorruptedError, OSError) as e:
+                if not skip:
+                    raise
+                # hand the partial sub-report back: its RETRIES really
+                # happened and must survive the skip (parity with
+                # iter_batches), even though its row accounting is moot
+                return None, sub, rows, e
+
+        results = map_in_order(read_one, range(len(self.paths)))
+        parts: Optional[Dict[str, List]] = None
+        total = 0
+        first_pf = None
+        for i, (t, sub, rows, err) in enumerate(results):
+            if t is None:
+                if sub is not None:
+                    report.retries += sub.retries  # only the retries: the
+                    # skip below owns ALL row accounting for this file
+                report.record_file_skip(self.paths[i], rows=rows, error=err)
+                continue
+            if first_pf is None:
+                first_pf = self.file(i)
+            if parts is None:
+                keys = (t._parts if t._parts is not None
+                        else t._columns).keys()
+                parts = {p: [] for p in keys}
+            bp = (t._parts if t._parts is not None
+                  else {p: [c] for p, c in t._columns.items()})
+            for p in parts:
+                parts[p].extend(bp[p])
+            total += t.num_rows
+            if report is not None and sub is not None:
+                report.merge(sub)
+        if parts is None:
+            # every file skipped: there is no schema to type an empty table
+            # by unless at least one footer parsed earlier
+            raise CorruptedError(
+                "dataset read: every file failed "
+                f"({', '.join(report.files_skipped) if report else 'no report'})")
+        out = Table(first_pf.schema, None, total, parts=parts,
+                    dict_fields=first_pf.arrow_dictionary_fields)
+        out.report = report
+        return out
+
+    def iter_batches(self, columns: Optional[Sequence[str]] = None,
+                     batch_rows: int = 65536,
+                     strict_batch_rows: bool = False,
+                     policy: Optional[FaultPolicy] = None,
+                     report: Optional[ReadReport] = None):
+        """Stream the dataset file by file (deterministic order) as
+        row-aligned Table batches; each file's drain keeps its own
+        prefetcher and bounded memory.  Degraded ``policy``: a file that
+        fails to open (or dies mid-drain beyond row-group skipping) is
+        dropped, already-yielded batches stay valid, and the loss is
+        recorded in ``report``."""
+        pol, report, skip = self._resolve(policy, report)
+        for i in range(len(self.paths)):
+            rows = 0
+            sub = ReadReport() if report is not None else None
+            try:
+                pf = self.file(i)
+                self._check_schema(pf, self.paths[i])
+                rows = pf.num_rows
+                yield from pf.iter_batches(
+                    columns=columns, batch_rows=batch_rows,
+                    strict_batch_rows=strict_batch_rows, policy=pol,
+                    report=sub)
+            except DeadlineError:
+                raise
+            except NON_DATA_ERRORS:
+                raise
+            except (CorruptedError, OSError) as e:
+                if not skip:
+                    raise
+                got = sub.rows_read if sub is not None else 0
+                dropped = sub.rows_dropped if sub is not None else 0
+                if report is not None and sub is not None:
+                    report.merge(sub)
+                # the file-skip remainder excludes rows the sub-report
+                # already delivered AND rows it already accounted as
+                # dropped (row-group skips before the fatal error) — they
+                # must not be counted lost twice
+                report.record_file_skip(
+                    self.paths[i], rows=max(rows - got - dropped, 0),
+                    error=e)
+                continue
+            if report is not None and sub is not None:
+                report.merge(sub)
+
+    # --------------------------------------------------------------- scan
+    def prune(self, path: str, lo=None, hi=None,
+              values: Optional[Sequence] = None,
+              policy: Optional[FaultPolicy] = None,
+              report: Optional[ReadReport] = None) -> List[str]:
+        """Paths of files that may contain matching rows, by footer-level
+        min/max statistics only (:func:`~parquet_tpu.io.search.prune_file` —
+        no chunk bytes are touched).  Degraded ``policy``: an unopenable
+        file is recorded in ``report`` and excluded."""
+        pol, report, skip = self._resolve(policy, report)
+        keep, _ = self._prune_indices(path, lo, hi, values, skip, report)
+        return [self.paths[i] for i in keep]
+
+    def _prune_indices(self, path, lo, hi, values, skip, report):
+        def check(i):
+            try:
+                pf = self.file(i)
+                self._check_schema(pf, self.paths[i])
+                return prune_file(pf, path, lo=lo, hi=hi, values=values)
+            except DeadlineError:
+                raise
+            except NON_DATA_ERRORS:
+                raise
+            except (CorruptedError, OSError) as e:
+                if not skip:
+                    raise
+                return e
+
+        results = map_in_order(check, range(len(self.paths)))
+        keep, skipped = [], []
+        for i, r in enumerate(results):
+            if r is True:
+                keep.append(i)
+            elif isinstance(r, Exception):
+                skipped.append(i)
+                if report is not None:
+                    report.record_file_skip(self.paths[i], rows=0, error=r)
+        return keep, skipped
+
+    def plan(self, path: str, lo=None, hi=None, use_bloom: bool = False,
+             values: Optional[Sequence] = None) -> Dict[str, List[PagePlan]]:
+        """Two-level pushdown plan: footer statistics prune whole files,
+        then :func:`~parquet_tpu.io.search.plan_scan` plans the surviving
+        pages per file.  Returns ``{path: [PagePlan, ...]}`` for files with
+        at least one surviving page."""
+        keep, _ = self._prune_indices(path, lo, hi, values, False, None)
+        out: Dict[str, List[PagePlan]] = {}
+        for i in keep:
+            plans = plan_scan(self.file(i), path, lo=lo, hi=hi,
+                              use_bloom=use_bloom, values=values)
+            if plans:
+                out[self.paths[i]] = plans
+        return out
+
+    def scan(self, path: str, lo=None, hi=None,
+             columns: Optional[Sequence[str]] = None,
+             use_bloom: bool = True,
+             values: Optional[Sequence] = None,
+             policy: Optional[FaultPolicy] = None,
+             report: Optional[ReadReport] = None) -> Dict[str, object]:
+        """Predicate-pushdown scan over the whole dataset: files are pruned
+        by footer statistics first, survivors scan in parallel on the
+        shared pool (each via
+        :func:`~parquet_tpu.parallel.host_scan.scan_filtered`), and results
+        merge in file order — same output forms as ``scan_filtered``, same
+        deterministic order as a serial per-file loop.  Degraded
+        ``policy``: unopenable files, files that fail mid-scan, and corrupt
+        row groups all drop with the loss accounted in ``report``."""
+        from .parallel.host_scan import scan_files
+
+        if not self.paths:
+            raise ValueError("scan on an empty dataset shard (no schema to "
+                             "type empty results by); check num_files first")
+        pol, report, skip = self._resolve(policy, report)
+        keep, skipped = self._prune_indices(path, lo, hi, values, skip,
+                                            report)
+        pfs = [self.file(i) for i in keep]
+        if pfs:
+            got = scan_files(pfs, path, lo=lo, hi=hi, columns=columns,
+                             use_bloom=use_bloom, values=values, policy=pol,
+                             report=report, skip_files=skip)
+            if got:
+                return got
+        # nothing survived pruning (or every survivor was skipped): typed
+        # empties in scan_filtered's forms, typed by any file whose footer
+        # parsed — pruned-out files did; only recorded skips did not
+        from .format.enums import Type
+
+        bad = set(skipped)
+        sig_i = next((i for i in range(len(self.paths)) if i not in bad),
+                     None)
+        if sig_i is None:
+            raise CorruptedError(
+                "dataset scan: every file failed "
+                f"({', '.join(report.files_skipped) if report else ''})")
+        pf0 = self.file(sig_i)
+        flat = {l.dotted_path for l in pf0.schema.leaves
+                if l.max_repetition_level == 0}
+        out_cols = (list(columns) if columns is not None
+                    else sorted(flat - {path}))
+        empty: Dict[str, object] = {}
+        for c in out_cols:
+            # same validation scan_filtered applies: a bad selection must
+            # raise whether or not pruning emptied the candidate set
+            if c not in {l.dotted_path for l in pf0.schema.leaves}:
+                raise KeyError(f"unknown column {c!r}")
+            if c not in flat:
+                raise ValueError(
+                    f"column {c!r} is nested; scan_filtered returns "
+                    "row-aligned arrays — use read_row_range per plan for "
+                    "nested columns")
+            leaf = pf0.schema.leaf(c)
+            if leaf.physical_type == Type.BYTE_ARRAY:
+                empty[c] = []
+            else:
+                empty[c] = np.empty(0, leaf.np_dtype() or np.uint8)
+        return empty
+
+    # -------------------------------------------------------------- misc
+    @staticmethod
+    def cache_stats():
+        """Snapshot of the shared footer/chunk cache counters
+        (:func:`parquet_tpu.io.cache.cache_stats`)."""
+        from .io.cache import cache_stats
+
+        return cache_stats()
+
+    def close(self) -> None:
+        with self._lock:
+            files, self._files = list(self._files.values()), {}
+        for pf in files:
+            pf.close()
+
+    def __enter__(self) -> "Dataset":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:
+        if not self.paths:
+            return "Dataset(0 files — empty shard)"
+        return (f"Dataset({len(self.paths)} file(s), "
+                f"first={self.paths[0]!r})")

@@ -1,0 +1,329 @@
+"""Shared open-path caches: footer/metadata + a bounded decoded-chunk LRU.
+
+The dataset layer (parquet_tpu/dataset.py) serves *fleets* of files where hot
+files are re-opened constantly — per-open footer thrift parses and per-read
+chunk decodes of the same bytes were pure waste.  Two process-wide caches fix
+that, both keyed by ``(absolute path, inode, mtime_ns, size)`` — the
+source's OPEN-TIME fstat (``FileSource.stat_key``), so a rewritten file can
+never serve stale entries (the inode catches same-size rename-replaces
+inside one coarse mtime tick) and a rename racing the open can never pair
+old bytes with the new identity:
+
+- :class:`FooterCache` — the parsed ``FileMetaData`` + ``Schema`` of a file.
+  Re-opening a hot file skips the tail preads and the thrift parse entirely
+  (``ParquetFile._open_footer`` probes it first).  Entry-count-bounded LRU
+  (``PARQUET_TPU_FOOTER_CACHE`` entries, default 256, ``0`` = off).
+- :class:`ChunkCache` — whole-chunk decoded :class:`~parquet_tpu.io.column.
+  Column` objects, keyed by ``(file key, row group, leaf path)``.  BYTES-
+  capped LRU (``PARQUET_TPU_CHUNK_CACHE`` bytes, default 256 MiB, ``0`` =
+  off) — the bounded replacement for an unbounded per-file decoded cache:
+  eviction is global and size-aware, so a scan over many files cannot grow
+  memory without bound.  Cached columns are FROZEN (read-only buffer views,
+  so in-place mutation of a read result raises instead of silently
+  poisoning later reads) and served as shallow dataclass copies (consumers
+  that materialize a dictionary-encoded column reassign fields on their
+  copy, never the cached master).
+
+Only plain path-backed opens (``FileSource``/``MmapSource``, optionally under
+a ``PolicySource``) are cached — wrapped sources (fault injectors, arbitrary
+``Source`` subclasses) may transform bytes and get no entries.  Hit/miss/
+eviction counters surface through :class:`CacheStats` (``cache_stats()``),
+the cache-side mirror of :class:`~parquet_tpu.io.prefetch.ReadStats`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["CacheStats", "FooterCache", "ChunkCache", "cache_stats",
+           "clear_caches", "chunk_cache_bytes", "footer_cache_entries",
+           "column_nbytes", "freeze_column", "invalidate_path",
+           "FOOTERS", "CHUNKS"]
+
+DEFAULT_CHUNK_CACHE_BYTES = 256 << 20
+DEFAULT_FOOTER_CACHE_ENTRIES = 256
+
+
+def _env_size(name: str, default: int) -> int:
+    v = os.environ.get(name, "").strip()
+    if v:
+        try:
+            return max(0, int(v))
+        except ValueError:
+            pass
+    return default
+
+
+def chunk_cache_bytes() -> int:
+    """Decoded-chunk cache capacity: ``PARQUET_TPU_CHUNK_CACHE`` (bytes;
+    ``0`` disables) or the 256 MiB default.  Read per call so tests can
+    repoint it without rebuilding the cache."""
+    return _env_size("PARQUET_TPU_CHUNK_CACHE", DEFAULT_CHUNK_CACHE_BYTES)
+
+
+def footer_cache_entries() -> int:
+    """Footer cache capacity: ``PARQUET_TPU_FOOTER_CACHE`` (entries; ``0``
+    disables) or the 256-entry default."""
+    return _env_size("PARQUET_TPU_FOOTER_CACHE", DEFAULT_FOOTER_CACHE_ENTRIES)
+
+
+@dataclass
+class CacheStats:
+    """What the open-path caches actually did (observability; the cache-side
+    mirror of :class:`~parquet_tpu.io.prefetch.ReadStats`).  Counters are
+    process-lifetime totals; diff two :func:`cache_stats` snapshots to
+    meter one operation."""
+
+    footer_hits: int = 0
+    footer_misses: int = 0
+    footer_entries: int = 0
+    chunk_hits: int = 0
+    chunk_misses: int = 0
+    chunk_evictions: int = 0
+    chunk_entries: int = 0
+    chunk_bytes: int = 0
+    chunk_capacity: int = 0
+
+    def as_dict(self) -> dict:
+        return {"footer_hits": self.footer_hits,
+                "footer_misses": self.footer_misses,
+                "footer_entries": self.footer_entries,
+                "chunk_hits": self.chunk_hits,
+                "chunk_misses": self.chunk_misses,
+                "chunk_evictions": self.chunk_evictions,
+                "chunk_entries": self.chunk_entries,
+                "chunk_bytes": self.chunk_bytes,
+                "chunk_capacity": self.chunk_capacity}
+
+
+def _buf_nbytes(a: Any) -> int:
+    if a is None:
+        return 0
+    if isinstance(a, tuple):
+        return sum(_buf_nbytes(x) for x in a)
+    if isinstance(a, list):
+        return sum(_buf_nbytes(x) for x in a)
+    nb = getattr(a, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if isinstance(a, (bytes, bytearray, memoryview)):
+        return len(a)
+    return 0
+
+
+def column_nbytes(col) -> int:
+    """Approximate resident bytes of a decoded Column (every buffer it
+    pins: values, offsets, validity, level streams, dictionary forms)."""
+    return (_buf_nbytes(col.values) + _buf_nbytes(col.offsets)
+            + _buf_nbytes(col.validity) + _buf_nbytes(col.def_levels)
+            + _buf_nbytes(col.rep_levels) + _buf_nbytes(col.dict_indices)
+            + _buf_nbytes(col.dictionary_host)
+            + _buf_nbytes(col.list_offsets) + _buf_nbytes(col.list_validity))
+
+
+class FooterCache:
+    """Entry-bounded LRU of parsed footers: key → (FileMetaData, Schema).
+    Metadata and Schema are immutable after open (reader semantics), so
+    sharing them across ParquetFile instances is safe."""
+
+    def __init__(self, stats: CacheStats):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Any]" = OrderedDict()
+        self.stats = stats
+
+    def get(self, key) -> Optional[Any]:
+        with self._lock:
+            got = self._entries.get(key)
+            if got is None:
+                self.stats.footer_misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.stats.footer_hits += 1
+            return got
+
+    def put(self, key, value) -> None:
+        cap = footer_cache_entries()
+        if cap <= 0:
+            return
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+            self.stats.footer_entries = len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.stats.footer_entries = 0
+
+
+def freeze_column(col):
+    """Shallow copy of a Column whose buffers are read-only numpy views —
+    the uniform mutability contract of whole-chunk read results: writing
+    into one raises, whether or not the chunk was (or could be) cached."""
+    return _frozen_column(col)
+
+
+def _readonly(a, own: bool = False):
+    """Read-only numpy view (recursing through tuple/list containers) —
+    cached buffers must not be writable through any handle the cache hands
+    out, or one consumer's in-place edit would silently corrupt every later
+    read of the file.  ``own=True`` additionally copies arrays that VIEW a
+    larger foreign buffer (``a.base is not None``): a cached zero-copy
+    slice of a whole-file mmap would otherwise pin the entire mapping —
+    unbounded real memory behind a tiny accounted ``nbytes``."""
+    if isinstance(a, np.ndarray):
+        if own and a.base is not None:
+            a = a.copy()
+        v = a.view()
+        v.flags.writeable = False
+        return v
+    if isinstance(a, tuple):
+        return tuple(_readonly(x, own) for x in a)
+    if isinstance(a, list):
+        return [_readonly(x, own) for x in a]
+    return a
+
+
+def _private_copy(col):
+    """Consumer-private shallow copy of a frozen Column: fields are
+    reassignable without touching the cached master, and the LIST
+    containers (list_offsets/list_validity) are copied too — element
+    assignment into a shared list would poison the cache even though the
+    numpy buffers inside are read-only."""
+    return dataclasses.replace(col, list_offsets=list(col.list_offsets),
+                               list_validity=list(col.list_validity))
+
+
+def _frozen_column(col, own: bool = False):
+    """Shallow copy of a Column whose buffers are read-only views.
+    ``own=True`` (the cached form) also materializes view-of-foreign-buffer
+    arrays so an entry never pins bytes beyond what the cap accounts."""
+    return dataclasses.replace(
+        col, values=_readonly(col.values, own),
+        offsets=_readonly(col.offsets, own),
+        validity=_readonly(col.validity, own),
+        def_levels=_readonly(col.def_levels, own),
+        rep_levels=_readonly(col.rep_levels, own),
+        dict_indices=_readonly(col.dict_indices, own),
+        dictionary_host=_readonly(col.dictionary_host, own),
+        list_offsets=_readonly(col.list_offsets, own),
+        list_validity=_readonly(col.list_validity, own))
+
+
+class ChunkCache:
+    """Bytes-capped LRU of whole-chunk decoded Columns.
+
+    Entries are FROZEN: every buffer is served through a read-only numpy
+    view (in-place mutation of a read result raises instead of silently
+    poisoning later reads of the file), and each get/put hands out a
+    private shallow dataclass copy so field reassignment
+    (``materialize_host``) never rewrites the cached master.
+    :meth:`put_and_freeze` returns the frozen instance for the miss caller
+    to use — the caller must drop its writable original, or the shared
+    buffers stay mutable through it.  An item larger than half the cap is
+    refused outright — one giant chunk must not evict the whole working
+    set for a single-use entry."""
+
+    def __init__(self, stats: CacheStats):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        self.stats = stats
+
+    def get(self, key) -> Optional[Any]:
+        with self._lock:
+            got = self._entries.get(key)
+            if got is None:
+                self.stats.chunk_misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.stats.chunk_hits += 1
+            return _private_copy(got[0])
+
+    def put_and_freeze(self, key, col) -> Optional[Any]:
+        """Store ``col`` frozen; returns the frozen instance (what the
+        caller should hand out instead of its writable original), or None
+        when the item was refused (cache off, oversized)."""
+        cap = chunk_cache_bytes()
+        if cap <= 0:
+            return None
+        nb = column_nbytes(col)
+        if nb > cap // 2:
+            return None
+        frozen = _frozen_column(col, own=True)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (frozen, nb)
+            self._bytes += nb
+            while self._bytes > cap and self._entries:
+                _, (_, evicted_nb) = self._entries.popitem(last=False)
+                self._bytes -= evicted_nb
+                self.stats.chunk_evictions += 1
+            self.stats.chunk_entries = len(self._entries)
+            self.stats.chunk_bytes = self._bytes
+            self.stats.chunk_capacity = cap
+        return _private_copy(frozen)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self.stats.chunk_entries = 0
+            self.stats.chunk_bytes = 0
+
+
+_STATS = CacheStats()
+FOOTERS = FooterCache(_STATS)
+CHUNKS = ChunkCache(_STATS)
+
+
+def invalidate_path(path: str) -> None:
+    """Drop every cached footer and decoded chunk of ``path`` — called by
+    the path sinks after a successful commit.  The fstat identity already
+    invalidates rename-replaces and any rewrite that moves mtime, but an
+    IN-PLACE same-size rewrite (non-atomic ``FileSink``) on a coarse-mtime
+    filesystem can land inside one clock tick with the same inode;
+    explicit invalidation on commit closes that hole for writes made
+    through this library."""
+    ap = os.path.abspath(path)
+    with FOOTERS._lock:
+        for key in [k for k in FOOTERS._entries if k[0] == ap]:
+            del FOOTERS._entries[key]
+        FOOTERS.stats.footer_entries = len(FOOTERS._entries)
+    with CHUNKS._lock:
+        for key in [k for k in CHUNKS._entries if k[0][0] == ap]:
+            _, nb = CHUNKS._entries.pop(key)
+            CHUNKS._bytes -= nb
+        CHUNKS.stats.chunk_entries = len(CHUNKS._entries)
+        CHUNKS.stats.chunk_bytes = CHUNKS._bytes
+
+
+def cache_stats() -> CacheStats:
+    """Snapshot of the process-wide cache counters (a copy — diff two
+    snapshots to meter one operation)."""
+    s = dataclasses.replace(_STATS)
+    s.chunk_capacity = chunk_cache_bytes()
+    return s
+
+
+def clear_caches(reset_stats: bool = False) -> None:
+    """Drop every cached footer and decoded chunk (tests, benchmarks, and
+    memory-pressure escape hatch).  ``reset_stats=True`` also zeroes the
+    lifetime counters."""
+    FOOTERS.clear()
+    CHUNKS.clear()
+    if reset_stats:
+        global _STATS
+        fresh = CacheStats()
+        _STATS.__dict__.update(fresh.__dict__)

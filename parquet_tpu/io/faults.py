@@ -96,7 +96,12 @@ class ReadReport:
     — rows pushdown had already pruned are never counted either way).
     ``row_groups_skipped`` holds the ordinals, ``errors`` the stringified
     :class:`~parquet_tpu.errors.ReadError` per skip (index-aligned), and
-    ``retries`` the transient retries the policy performed."""
+    ``retries`` the transient retries the policy performed.
+    ``files_skipped`` extends ``on_corrupt='skip_row_group'`` to the
+    dataset layer: a whole file that could not be opened or read at all
+    (bad footer, vanished path) is dropped as a unit, with its path here
+    and its candidate rows (0 when the footer never parsed) in
+    ``rows_dropped``."""
 
     path: Optional[str] = None
     rows_read: int = 0
@@ -104,10 +109,11 @@ class ReadReport:
     row_groups_skipped: List[int] = field(default_factory=list)
     errors: List[str] = field(default_factory=list)
     retries: int = 0
+    files_skipped: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
-        return not self.row_groups_skipped
+        return not self.row_groups_skipped and not self.files_skipped
 
     def bind(self, path: Optional[str]) -> "ReadReport":
         """Backfill the file path on a caller-supplied blank report."""
@@ -123,6 +129,14 @@ class ReadReport:
         self.errors.append(str(error))
         self.rows_dropped += rows
 
+    def record_file_skip(self, path: str, rows: int, error) -> None:
+        """One whole file dropped from a dataset-level degraded read.
+        ``rows`` is the candidate row count lost (0 when unknown — a footer
+        that never parsed has no row count to account)."""
+        self.files_skipped.append(str(path))
+        self.errors.append(str(error))
+        self.rows_dropped += rows
+
     def merge(self, other: "ReadReport") -> "ReadReport":
         """Fold another report's accounting into this one (aggregating
         shards/files, or adopting a routing attempt's scratch report)."""
@@ -133,13 +147,15 @@ class ReadReport:
         self.row_groups_skipped.extend(other.row_groups_skipped)
         self.errors.extend(other.errors)
         self.retries += other.retries
+        self.files_skipped.extend(other.files_skipped)
         return self
 
     def as_dict(self) -> dict:
         return {"path": self.path, "rows_read": self.rows_read,
                 "rows_dropped": self.rows_dropped,
                 "row_groups_skipped": list(self.row_groups_skipped),
-                "errors": list(self.errors), "retries": self.retries}
+                "errors": list(self.errors), "retries": self.retries,
+                "files_skipped": list(self.files_skipped)}
 
 
 def resolve_policy(pf, policy: Optional[FaultPolicy],

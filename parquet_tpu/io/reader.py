@@ -485,6 +485,21 @@ class ParquetFile:
             self.source = PolicySource(self.source, policy)
         self._base_source = self.source  # per-call overrides revert to this
         self._override_stack: List[Source] = []
+        # caching identity: only plain path-backed opens qualify — wrapped
+        # sources (fault injectors, arbitrary Source subclasses) may
+        # transform bytes, so their decodes must never populate or be
+        # served from the shared caches (io/cache.py).  The key is the
+        # source's open-time fstat (stat_key), pairing identity with the
+        # bytes this fd/map actually serves — a path re-stat here could
+        # race an atomic-rename replace and cache old bytes under the new
+        # file's identity
+        from .source import FileSource, MmapSource
+
+        inner = self.source.inner if isinstance(self.source, PolicySource) \
+            else self.source
+        self._cache_key = (inner.stat_key
+                           if isinstance(inner, (FileSource, MmapSource))
+                           else None)
         try:
             with self._resilient_op(None, None, "open"), \
                     read_context(path=self._path,
@@ -499,6 +514,17 @@ class ParquetFile:
         counters.inc("files_opened")
 
     def _open_footer(self) -> None:
+        from .cache import FOOTERS
+
+        if self._cache_key is not None:
+            hit = FOOTERS.get(self._cache_key)
+            if hit is not None:
+                # hot re-open: the footer (and schema) of these exact bytes
+                # was parsed before — skip the tail preads, magic checks,
+                # and thrift walk entirely (metadata is immutable after
+                # open, so sharing the parsed objects is safe)
+                self.metadata, self.schema = hit
+                return
         size = self.source.size()
         if size < 12:
             raise CorruptedError(f"file too small ({size} bytes) to be parquet")
@@ -523,6 +549,8 @@ class ParquetFile:
         if self.metadata.schema in (None, []):
             raise CorruptedError("footer has no schema")
         self.schema = Schema.from_elements(self.metadata.schema)
+        if self._cache_key is not None:
+            FOOTERS.put(self._cache_key, (self.metadata, self.schema))
 
     # ---------------------------------------------------------- resilience
     @property
@@ -601,10 +629,31 @@ class ParquetFile:
     def _decode_chunk_ctx(self, chunk: "ColumnChunkReader") -> "Column":
         """Host chunk decode with structured error context — any low-level
         failure surfaces as a :class:`ReadError` naming file, row group,
-        column, and (when known) page offset."""
+        column, and (when known) page offset.  Whole-chunk decodes of
+        path-backed files go through the shared bounded decoded-chunk LRU
+        (io/cache.py): a hot file re-read serves the Column without
+        touching chunk bytes."""
         with read_context(path=self._path, row_group=chunk.rg_index,
                           column=chunk.leaf.dotted_path):
-            return decode_chunk_host(chunk)
+            from .cache import CHUNKS, freeze_column
+
+            key = self._cache_key
+            if key is None:
+                # uniform mutability contract: whole-chunk read results
+                # are read-only whether or not this source is cacheable —
+                # code must not validate against a writable result in one
+                # configuration and break in another
+                return freeze_column(decode_chunk_host(chunk))
+            ck = (key, chunk.rg_index, chunk.leaf.dotted_path,
+                  self.options.verify_crc)
+            col = CHUNKS.get(ck)
+            if col is None:
+                col = decode_chunk_host(chunk)
+                # hand out the FROZEN instance (read-only buffers) so the
+                # miss caller cannot mutate what later hits will serve
+                frozen = CHUNKS.put_and_freeze(ck, col)
+                col = frozen if frozen is not None else freeze_column(col)
+            return col
 
     # ------------------------------------------------------------------
     @property
@@ -812,9 +861,13 @@ class ParquetFile:
         # core, threads are a pure loss for whole-chunk decode: per-thread
         # malloc arenas defeat buffer reuse for the large decode buffers
         # (measured 2x slower), so the fan-out needs real cores.
-        from ..utils.pool import available_cpus
+        # inside a pool worker (the dataset layer's per-file fan-out), keep
+        # the decode serial: nested submitters blocking on futures no free
+        # worker can run would deadlock the shared pool
+        from ..utils.pool import available_cpus, in_shared_pool
 
         if (n_rg * len(leaves) > 1 and available_cpus() > 1
+                and not in_shared_pool()
                 and total_rows * len(leaves) >= 2_000_000):
             from ..utils.pool import submit as pool_submit
 
@@ -841,12 +894,14 @@ class ParquetFile:
         exactly (row groups are row-aligned across columns, so the partial
         Table stays valid).  Deadline overruns still raise — a timeout is
         not corruption."""
-        from ..utils.pool import available_cpus, submit as pool_submit
+        from ..utils.pool import (available_cpus, in_shared_pool,
+                                  submit as pool_submit)
 
         uniq = list({l.dotted_path: l for l in leaves}.values())
         parts: Dict[str, List[Column]] = {l.dotted_path: [] for l in uniq}
         kept_rows = 0
-        pooled = (len(uniq) > 1 and available_cpus() > 1)
+        pooled = (len(uniq) > 1 and available_cpus() > 1
+                  and not in_shared_pool())
         for i in rg_sel:
             rg = self.row_group(i)
             try:

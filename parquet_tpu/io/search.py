@@ -127,6 +127,34 @@ def prune_row_group(rg: RowGroupReader, path, lo=None, hi=None,
     return True
 
 
+def prune_file(pf: ParquetFile, path, lo=None, hi=None,
+               values: Optional[Sequence] = None) -> bool:
+    """True if ANY row group of the file may contain matching rows —
+    footer-level pruning for the dataset layer: chunk statistics live in
+    the (already parsed, possibly footer-cached) metadata, so a whole file
+    is ruled out without touching chunk bytes or issuing any IO.  Bloom
+    filters are deliberately not consulted here (they cost preads; the
+    per-file :func:`plan_scan` probes them for survivors)."""
+    leaf = pf.schema.leaf(path) if not hasattr(path, "column_index") else path
+    sorted_vals = None
+    if values is not None:
+        if lo is not None or hi is not None:
+            raise ValueError("pass either a range (lo/hi) or values, not both")
+        from ..algebra.compare import normalize_probe
+
+        probes = {normalize_probe(leaf, v) for v in values}
+        sorted_vals = sorted(probes - {None})
+        if not sorted_vals:
+            return False
+    for rg in pf.row_groups:
+        if sorted_vals is not None:
+            if prune_row_group_values(rg, leaf.column_index, sorted_vals):
+                return True
+        elif prune_row_group(rg, leaf.column_index, lo, hi):
+            return True
+    return False
+
+
 def _any_in_range(sorted_vals: List, lo, hi) -> bool:
     """Does the sorted probe list intersect [lo, hi]?  (None bound = open.)"""
     if not sorted_vals:

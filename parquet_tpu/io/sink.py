@@ -27,17 +27,27 @@ from __future__ import annotations
 
 import os
 import secrets
+import threading
 from dataclasses import dataclass
 from typing import List, Optional
 
 from ..errors import WriteError
 
 __all__ = ["Sink", "FileSink", "AtomicFileSink", "BufferedSink", "WriteStats",
-           "fsync_dir", "write_buffer_bytes"]
+           "fsync_dir", "write_buffer_bytes", "write_autotune",
+           "write_autotune_enabled"]
 
 # default writeback buffer: large enough that page-sized writes coalesce into
 # a handful of flushes per row group, small enough to stay cache-resident
 DEFAULT_WRITE_BUFFER = 4 << 20
+
+_HAS_WRITEV = hasattr(os, "writev")
+try:
+    _IOV_MAX = os.sysconf("SC_IOV_MAX")
+    if _IOV_MAX <= 0:
+        _IOV_MAX = 1024
+except (AttributeError, OSError, ValueError):
+    _IOV_MAX = 1024
 
 
 @dataclass
@@ -53,7 +63,9 @@ class WriteStats:
     hid behind the previous group's emit.  ``bytes_buffered`` counts bytes
     coalesced through a :class:`BufferedSink`, ``bytes_flushed`` bytes that
     actually left toward the OS (equal to the file size for path sinks),
-    and ``sink_flushes`` how many vectored flushes carried them."""
+    ``sink_flushes`` how many coalesced flushes carried them, and
+    ``writev_flushes`` how many of those went through the true vectored
+    ``os.writev`` path (raw-fd sinks) instead of ``writelines``."""
 
     row_groups: int = 0
     overlapped_groups: int = 0
@@ -63,6 +75,7 @@ class WriteStats:
     bytes_buffered: int = 0
     bytes_flushed: int = 0
     sink_flushes: int = 0
+    writev_flushes: int = 0
 
     def overlap_ratio(self) -> float:
         """Fraction of background encode time that emit did NOT wait for —
@@ -81,18 +94,92 @@ class WriteStats:
                 "overlap_ratio": round(self.overlap_ratio(), 4),
                 "bytes_buffered": self.bytes_buffered,
                 "bytes_flushed": self.bytes_flushed,
-                "sink_flushes": self.sink_flushes}
+                "sink_flushes": self.sink_flushes,
+                "writev_flushes": self.writev_flushes}
+
+
+# write-side auto-tuner (the mirror of io/prefetch.py's depth/window tuner):
+# a writer that still needed many coalesced flushes PER ROW GROUP had a
+# buffer too small for its page sizes — grow it for the next writer; one
+# whose groups fit in a flush or two steps back toward the default
+_WRITE_TUNE_RAISE_FLUSHES_PER_RG = 8.0
+_WRITE_TUNE_DECAY_FLUSHES_PER_RG = 1.5
+_WRITE_TUNE_MAX_BUFFER = 64 << 20
+
+
+def write_autotune_enabled() -> bool:
+    """``PARQUET_TPU_WRITE_AUTOTUNE`` opt-out (default on)."""
+    return os.environ.get("PARQUET_TPU_WRITE_AUTOTUNE", "1") \
+        .strip().lower() not in ("0", "off", "false", "no")
+
+
+class _WriteAutoTuneState:
+    """Process-wide feedback from observed :class:`WriteStats` to the next
+    writer's writeback buffer size (ROADMAP follow-on: grow
+    ``PARQUET_TPU_WRITE_BUFFER`` when ``sink_flushes`` per row group stays
+    high).  An explicit env pin or ``PARQUET_TPU_WRITE_AUTOTUNE=0``
+    bypasses the state entirely."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.buffer = None  # None = default
+
+    def suggest(self):
+        with self._lock:
+            return self.buffer
+
+    def observe(self, stats: WriteStats) -> None:
+        if stats.row_groups <= 0 or stats.bytes_buffered <= 0:
+            return  # nothing buffered: pass-through writer, no signal
+        per_rg = stats.sink_flushes / stats.row_groups
+        with self._lock:
+            b = self.buffer or DEFAULT_WRITE_BUFFER
+            if per_rg > _WRITE_TUNE_RAISE_FLUSHES_PER_RG \
+                    and b < _WRITE_TUNE_MAX_BUFFER:
+                self.buffer = b * 2
+            elif per_rg < _WRITE_TUNE_DECAY_FLUSHES_PER_RG \
+                    and b > DEFAULT_WRITE_BUFFER:
+                b //= 2
+                self.buffer = None if b <= DEFAULT_WRITE_BUFFER else b
+
+    def reset(self) -> None:
+        with self._lock:
+            self.buffer = None
+
+
+_WRITE_AUTOTUNE = _WriteAutoTuneState()
+
+
+def write_autotune() -> _WriteAutoTuneState:
+    """The process-wide write auto-tune state (tests reset it)."""
+    return _WRITE_AUTOTUNE
+
+
+def _env_write_buffer() -> Optional[int]:
+    """``PARQUET_TPU_WRITE_BUFFER`` as a pin, or None when unset OR
+    unparseable — the single classifier both the size resolution and the
+    autotune-eligibility gate consult, so a garbage value cannot count as
+    "pinned" in one place while being ignored in the other."""
+    v = os.environ.get("PARQUET_TPU_WRITE_BUFFER", "").strip()
+    if not v:
+        return None
+    try:
+        return max(0, int(v))
+    except ValueError:
+        return None
 
 
 def write_buffer_bytes() -> int:
     """Writeback buffer size: ``PARQUET_TPU_WRITE_BUFFER`` (bytes; ``0``
-    disables coalescing) or the 4 MiB default."""
-    v = os.environ.get("PARQUET_TPU_WRITE_BUFFER", "").strip()
-    if v:
-        try:
-            return max(0, int(v))
-        except ValueError:
-            pass
+    disables coalescing) wins outright; otherwise the auto-tuned size from
+    observed flush rates, falling back to the 4 MiB default."""
+    pinned = _env_write_buffer()
+    if pinned is not None:
+        return pinned
+    if write_autotune_enabled():
+        tuned = _WRITE_AUTOTUNE.suggest()
+        if tuned:
+            return tuned
     return DEFAULT_WRITE_BUFFER
 
 
@@ -138,6 +225,25 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def _invalidate_dest(path) -> None:
+    """Drop any cached footers/chunks of a just-committed destination.
+    The caches' fstat identity handles rename-replaces and mtime-moving
+    rewrites on its own; this closes the residual in-place same-size
+    same-clock-tick window for writes made through this library."""
+    from .cache import invalidate_path
+
+    invalidate_path(path)
+
+
+def _flushed_fileno(f):
+    """Flush a file object's python-level buffer and return its fd (None
+    when closed) — the one raw_fd contract both path sinks share."""
+    if f is None:
+        return None
+    f.flush()
+    return f.fileno()
+
+
 class FileSink(Sink):
     """Direct-to-destination path sink: no atomicity, but fsync-on-close and
     abort-unlinks-the-partial-file.  The non-atomic mode of the writer
@@ -158,6 +264,12 @@ class FileSink(Sink):
     def flush(self) -> None:
         self._f.flush()
 
+    def raw_fd(self):
+        """OS-level fd for true vectored writes (the BufferedSink writev
+        path).  The python-level buffer is flushed first so byte order is
+        preserved across mixed fd/file-object writes; None when closed."""
+        return _flushed_fileno(self._f)
+
     def close(self) -> None:
         if self._f is None:
             return
@@ -173,6 +285,7 @@ class FileSink(Sink):
                 pass
             raise
         f.close()
+        _invalidate_dest(self.path)
 
     def abort(self) -> None:
         f, self._f = self._f, None
@@ -223,6 +336,11 @@ class AtomicFileSink(Sink):
         if self._f is not None:
             self._f.flush()
 
+    def raw_fd(self):
+        """OS-level fd of the TEMP file for true vectored writes (see
+        :meth:`FileSink.raw_fd`); None when closed or committed."""
+        return _flushed_fileno(self._f)
+
     def close(self) -> None:
         """Commit.  Any failure along the way aborts (the temp file is
         removed) and re-raises — a half-committed state is never retained,
@@ -262,6 +380,7 @@ class AtomicFileSink(Sink):
             # the rename is on disk only once the directory entry is:
             # without this, a crash can resurrect the OLD destination
             fsync_dir(self.dest)
+        _invalidate_dest(self.dest)
 
     def abort(self) -> None:
         f, self._f = self._f, None
@@ -280,10 +399,34 @@ class AtomicFileSink(Sink):
                 pass
 
 
+def _writev_all(fd, parts) -> None:
+    """Write every part to ``fd`` with ``os.writev`` — one syscall per
+    ``IOV_MAX`` group instead of one per part — resuming short (partial)
+    writes mid-part until every byte is down."""
+    queue = [memoryview(p) for p in parts if len(p)]
+    i = 0
+    while i < len(queue):
+        batch = queue[i:i + _IOV_MAX]
+        written = os.writev(fd, batch)
+        if written <= 0:
+            raise OSError(f"writev wrote {written} of "
+                          f"{sum(len(m) for m in batch)} bytes")
+        for mv in batch:
+            n = len(mv)
+            if written >= n:
+                written -= n
+                i += 1
+            else:
+                queue[i] = mv[written:]
+                break
+
+
 class BufferedSink(Sink):
     """Coalescing writeback layer over any sink: page-sized writes
     accumulate by reference (no join copy) and flush to the inner sink as
-    one vectored ``writelines`` once ``buffer_bytes`` is pending — the
+    one vectored write once ``buffer_bytes`` is pending — a true
+    ``os.writev`` when the inner sink exposes a raw fd (``raw_fd()``;
+    FileSink/AtomicFileSink do), a ``writelines`` fallback otherwise — the
     write-side analog of the prefetcher's coalesced window reads.  The
     per-page ``write()`` syscall overhead this removes is the emit phase's
     residual cost once encode is pipelined (io/writer.py).
@@ -304,6 +447,12 @@ class BufferedSink(Sink):
         self.buffer_bytes = (write_buffer_bytes() if buffer_bytes is None
                              else max(0, int(buffer_bytes)))
         self.stats = stats
+        # auto-tune eligibility: the writer observes this sink's WriteStats
+        # into the process tuner only when the size came from the tuner's
+        # own resolution path (no explicit arg, no env pin) — mirrors the
+        # prefetcher's _tunable gate
+        self._tunable = (buffer_bytes is None and write_autotune_enabled()
+                         and _env_write_buffer() is None)
         self._parts: List[bytes] = []
         self._buffered = 0
 
@@ -348,7 +497,17 @@ class BufferedSink(Sink):
         # write error, and a retry would double-write the prefix)
         parts, self._parts = self._parts, []
         n, self._buffered = self._buffered, 0
-        self.inner.writelines(parts)
+        fd = None
+        if _HAS_WRITEV:
+            raw = getattr(self.inner, "raw_fd", None)
+            if raw is not None:
+                fd = raw()
+        if fd is not None:
+            _writev_all(fd, parts)
+            if self.stats is not None:
+                self.stats.writev_flushes += 1
+        else:
+            self.inner.writelines(parts)
         if self.stats is not None:
             self.stats.bytes_flushed += n
             self.stats.sink_flushes += 1

@@ -38,7 +38,17 @@ class FileSource(Source):
     def __init__(self, path: str):
         self.path = path
         self._fd = os.open(path, os.O_RDONLY)
-        self._size = os.fstat(self._fd).st_size
+        st = os.fstat(self._fd)
+        self._size = st.st_size
+        # identity of the bytes THIS fd actually reads (io/cache.py key):
+        # fstat of the open fd, not a later path stat — a concurrent
+        # atomic-rename replace must not pair the old bytes with the new
+        # file's identity and poison the shared caches.  st_ino is part of
+        # the identity because a rename-replace lands a NEW inode whose
+        # mtime_ns can fall in the same coarse-clock tick with an equal
+        # size — mtime+size alone would alias the two files
+        self.stat_key = (os.path.abspath(path), st.st_ino, st.st_mtime_ns,
+                         st.st_size)
 
     def _checked_fd(self) -> int:
         fd = self._fd
@@ -108,9 +118,14 @@ class MmapSource(Source):
         self.path = path
         fd = os.open(path, os.O_RDONLY)
         try:
-            self._size = os.fstat(fd).st_size
+            st = os.fstat(fd)
+            self._size = st.st_size
             if self._size == 0:
                 raise IOError(f"cannot mmap empty file {path!r}")
+            # bytes-identity for the shared caches — fstat of the fd the
+            # map was built from (see FileSource.stat_key)
+            self.stat_key = (os.path.abspath(path), st.st_ino,
+                             st.st_mtime_ns, st.st_size)
             self._mm = _mmap.mmap(fd, self._size, prot=_mmap.PROT_READ)
         finally:
             os.close(fd)

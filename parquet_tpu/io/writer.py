@@ -22,10 +22,13 @@ sink.  Group N+1's encode only STARTS after group N's encode finished
 (never concurrently with it), so the sticky dictionary-fallback state and
 therefore the output bytes are identical with overlap on or off.  Path
 sinks additionally ride a :class:`~parquet_tpu.io.sink.BufferedSink` that
-coalesces page writes into vectored flushes.  ``PARQUET_TPU_WRITE_OVERLAP``
-(``0`` off / auto / ``force``) and ``PARQUET_TPU_WRITE_BUFFER`` are the
-knobs; :class:`~parquet_tpu.io.sink.WriteStats` (``writer.write_stats``)
-meters the pipeline.
+coalesces page writes into vectored flushes (``os.writev`` on raw-fd
+sinks).  ``PARQUET_TPU_WRITE_OVERLAP`` (``0`` off / auto / ``force``) and
+``PARQUET_TPU_WRITE_BUFFER`` are the knobs — with neither pinned, the
+buffer auto-tunes from observed ``sink_flushes`` per row group
+(``PARQUET_TPU_WRITE_AUTOTUNE=0`` opts out);
+:class:`~parquet_tpu.io.sink.WriteStats` (``writer.write_stats``) meters
+the pipeline.
 """
 
 from __future__ import annotations
@@ -793,6 +796,13 @@ class ParquetWriter:
                 self._f.abort()
             raise
         self._closed = True
+        if getattr(self._f, "_tunable", False):
+            # feed the flush rate back to the process-wide buffer tuner
+            # (sink.py): the NEXT writer's writeback buffer grows when this
+            # one still flushed many times per row group
+            from .sink import write_autotune
+
+            write_autotune().observe(self.write_stats)
 
     def abort(self) -> None:
         """Discard the write: no footer is serialized, a writer-owned path

@@ -25,9 +25,10 @@ from ..io.reader import ParquetFile
 from ..io.search import BA_ARRAYS, plan_scan, read_row_range
 
 __all__ = ["scan", "scan_filtered", "scan_filtered_device",
-           "scan_filtered_sharded"]
+           "scan_filtered_sharded", "scan_files", "merge_scan_results"]
 
-from ..utils.pool import mark_pooled as _mark_pooled, shared_pool as _pool
+from ..utils.pool import (in_shared_pool as _in_pool,
+                          mark_pooled as _mark_pooled, shared_pool as _pool)
 
 # decoded_scan: spans between survivor-count syncs (bounds device residency
 # at ~_SYNC_EVERY spans of uncompacted output while amortizing the RTT)
@@ -160,10 +161,14 @@ def _scan_filtered_impl(pf, path, lo, hi, columns, num_threads, use_bloom,
 
     tasks = [(p, c) for p in plans for c in read_cols]
     # thread-pool dispatch costs ~100us/task: serial decode wins for small
-    # plans (measured crossover around a few hundred thousand cells)
+    # plans (measured crossover around a few hundred thousand cells).
+    # Inside a pool worker (the dataset layer's per-FILE fan-out) the scan
+    # stays serial: a nested _pool().map blocking on futures no free worker
+    # can run would deadlock the shared pool.
     cells = sum(p.row_count for p in plans) * len(read_cols)
     if num_threads == 1 or len(tasks) <= 1 or (num_threads is None
-                                               and cells < 2_000_000):
+                                               and (cells < 2_000_000
+                                                    or _in_pool())):
         results = [read_one(t) for t in tasks]
     elif num_threads is None:
         # fan out per (span, column): the decode work releases the GIL in
@@ -291,6 +296,102 @@ def _scan_filtered_impl(pf, path, lo, hi, columns, num_threads, use_bloom,
     if report is not None and out_cols:
         report.rows_read += len(out[out_cols[0]])
     return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-file scan (the dataset layer's fan-out; parquet_tpu/dataset.py)
+# ---------------------------------------------------------------------------
+
+
+def merge_scan_results(parts: List[Dict[str, object]],
+                       out_cols: Sequence[str]) -> Dict[str, object]:
+    """Concatenate per-file :func:`scan_filtered` results in list order —
+    deterministic global output order for the dataset scan.  BYTE_ARRAY
+    columns (python lists) chain; numeric columns concatenate, promoting to
+    ``np.ma.MaskedArray`` when any file's span carried nulls.  Zero-row
+    parts are dropped before concatenation: a file whose pages all pruned
+    returns the 1-D typed empty even for (n, width)-shaped FLBA/INT96
+    columns, and concatenating the two ranks would raise."""
+    out: Dict[str, object] = {}
+    for c in out_cols:
+        vals = [p[c] for p in parts]
+        if any(isinstance(v, list) for v in vals):
+            out[c] = [x for v in vals for x in v]
+            continue
+        filled = [v for v in vals if len(v)]
+        if not filled:
+            out[c] = vals[0]
+        elif len(filled) == 1:
+            out[c] = filled[0]
+        elif any(isinstance(v, np.ma.MaskedArray) for v in filled):
+            out[c] = np.ma.concatenate(filled)
+        else:
+            out[c] = np.concatenate(filled)
+    return out
+
+
+def scan_files(pfs: Sequence[ParquetFile], path: str, lo=None, hi=None,
+               columns: Optional[Sequence[str]] = None,
+               use_bloom: bool = True,
+               values: Optional[Sequence] = None,
+               policy: Optional[FaultPolicy] = None,
+               report: Optional[ReadReport] = None,
+               skip_files: bool = False) -> Dict[str, object]:
+    """:func:`scan_filtered` across many already-opened files, fanned out on
+    the shared pool (each file's scan runs serial inside its worker — the
+    pool parallelism moves up a level) with results merged in file order.
+    Per-file row-group skips under a degraded ``policy`` are folded into
+    ``report``.  ``skip_files=True`` extends the degraded contract to whole
+    files: one whose scan fails outright (deleted mid-scan, footer fine but
+    chunks unreadable) drops as a unit, recorded with its full row count as
+    candidate rows — its partial row-group accounting is discarded so the
+    loss is not double-counted.  Returns ``{}`` when nothing (or no file)
+    survived.  Deadline overruns and environment errors always propagate."""
+    from ..io.faults import NON_DATA_ERRORS
+    from ..utils.pool import map_in_order
+
+    if skip_files and report is None:
+        # skipping whole files with nowhere to record them would be
+        # silent, unaccounted data loss — refuse up front
+        raise ValueError("skip_files=True requires a report to account "
+                         "the dropped files")
+    if not pfs:
+        return {}
+
+    def one(pf):
+        sub = ReadReport() if report is not None else None
+        try:
+            got = scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
+                                use_bloom=use_bloom, values=values,
+                                policy=policy, report=sub)
+        except DeadlineError:
+            raise
+        except NON_DATA_ERRORS:
+            raise
+        except (CorruptedError, OSError) as e:
+            if not skip_files:
+                raise
+            return None, sub, e
+        return got, sub, None
+
+    results = map_in_order(one, pfs)
+    oks = []
+    for pf, (got, sub, err) in zip(pfs, results):
+        if got is None:
+            if report is not None:
+                if sub is not None:
+                    # the skipped file's RETRIES really happened; only its
+                    # row accounting is superseded by the file skip below
+                    report.retries += sub.retries
+                report.record_file_skip(pf._path or "<memory>",
+                                        rows=pf.num_rows, error=err)
+            continue
+        if report is not None and sub is not None:
+            report.merge(sub)
+        oks.append(got)
+    if not oks:
+        return {}
+    return merge_scan_results(oks, list(oks[0]))
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,20 @@ def default_mesh(n: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devs[:n]), (axis,))
 
 
+def dataset_process_shard(dataset, process_index: Optional[int] = None,
+                          process_count: Optional[int] = None):
+    """This host's file shard of a multi-host dataset: files are
+    round-robined across JAX processes (``Dataset.shard(i, n)``), so every
+    process of a multi-controller mesh reads a disjoint, deterministic
+    subset and the union covers the corpus exactly once.  Defaults come
+    from the runtime (``jax.process_index()`` / ``jax.process_count()``);
+    pass both explicitly to shard by something other than processes (e.g.
+    one shard per chip for a caller-driven device fan-out)."""
+    i = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if process_count is None else process_count
+    return dataset.shard(i, n)
+
+
 @dataclass(frozen=True)
 class ShardedTable:
     """Row-sharded decode result over a mesh.

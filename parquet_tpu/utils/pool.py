@@ -61,6 +61,44 @@ def cancel_futures(futures) -> None:
                 lambda g: None if g.cancelled() else g.exception())
 
 
+def map_in_order(fn, items, parallel: "Optional[bool]" = None) -> list:
+    """Run ``fn`` over ``items`` and return results in input order.
+
+    Fans out on the shared pool unless parallelism cannot help (one item,
+    one CPU) or would deadlock (already inside a pool worker: a nested
+    submitter blocking on futures no free worker can run wedges the pool —
+    the same guard the stream layer applies).  On failure every task still
+    runs to completion (abandoned futures would warn and waste workers
+    anyway), then the FIRST failing item's exception is raised — callers
+    that want per-item failure isolation catch inside ``fn``.  Used by the
+    dataset layer's per-file fan-out and the CLI's parallel verify."""
+    items = list(items)
+    if parallel is None:
+        parallel = (len(items) > 1 and available_cpus() > 1
+                    and not in_shared_pool())
+    if not parallel:
+        return [fn(it) for it in items]
+    futs = [submit(fn, it) for it in items]
+    out, first_err = [], None
+    try:
+        for f in futs:
+            try:
+                out.append(f.result())
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+                out.append(None)
+    except BaseException:
+        # KeyboardInterrupt/SystemExit on the waiting thread: cancel what
+        # never started and get out NOW — blocking through the remaining
+        # futures would make Ctrl-C appear hung
+        cancel_futures(futs)
+        raise
+    if first_err is not None:
+        raise first_err
+    return out
+
+
 def available_cpus() -> int:
     """CPUs actually available to THIS process (cgroup/affinity-aware —
     os.cpu_count() reports physical cores and misfires in pinned

@@ -242,6 +242,53 @@ assert not [f for f in os.listdir(d) if f.endswith(".tmp")], os.listdir(d)
 print(f"write-pipeline smoke ok: {1 + len(cases)} configs byte-identical, "
       f"crash matrix {len(matrix)} offsets clean/absent")
 WPEOF
+echo "=== dataset smoke (multi-file parity + warm-cache hits + shards) ==="
+python - <<'DSEOF'
+# The dataset layer (ISSUE 5): a multi-file scan must be byte-identical to
+# a serial per-file loop, footer-level stats must prune whole files, a warm
+# re-open must hit both the footer cache and the decoded-chunk LRU, and
+# shards must partition the corpus.  Bounded to a few seconds.
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from parquet_tpu import Dataset, ParquetFile, cache_stats, clear_caches
+
+d = tempfile.mkdtemp(prefix="parquet_tpu_ds_")
+paths = []
+for i in range(6):
+    t = pa.table({"x": pa.array(np.arange(i * 5000, (i + 1) * 5000,
+                                          dtype=np.int64)),
+                  "s": pa.array([f"v{j % 31}" for j in range(5000)])})
+    p = os.path.join(d, f"part-{i}.parquet")
+    pq.write_table(t, p, row_group_size=1000, write_page_index=True)
+    paths.append(p)
+clear_caches(reset_stats=True)
+serial = pa.concat_tables(ParquetFile(p).read().to_arrow() for p in paths)
+ds = Dataset(os.path.join(d, "part-*.parquet"))
+assert ds.read().to_arrow().equals(serial), "dataset read != serial loop"
+batched = pa.concat_tables(b.to_arrow()
+                           for b in ds.iter_batches(batch_rows=1700))
+assert batched.equals(serial), "dataset iter_batches != serial loop"
+scan = ds.scan("x", lo=4000, hi=21000)
+assert len(scan["s"]) == 17001, len(scan["s"])
+assert ds.prune("x", lo=27000) == [paths[5]], "file pruning broken"
+c0 = cache_stats()
+ds2 = Dataset(paths)
+ds2.read()
+ds2.close()
+c1 = cache_stats()
+assert c1.footer_hits - c0.footer_hits == 6, "warm open missed footer cache"
+assert c1.chunk_hits > c0.chunk_hits, "warm read missed chunk cache"
+assert c1.chunk_bytes <= c1.chunk_capacity
+shards = [ds.shard(i, 3) for i in range(3)]
+assert sorted(p for s in shards for p in s.paths) == sorted(paths)
+ds.close()
+print("dataset smoke ok: parity, pruning, warm caches, shards")
+DSEOF
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys
@@ -257,7 +304,7 @@ for l in sys.stdin.read().splitlines():
 d = next(x for x in docs if 'metric' in x)
 assert {'metric', 'value', 'unit', 'vs_baseline', 'configs'} <= d.keys(), d.keys()
 assert isinstance(d['value'], (int, float)) and d['value'] > 0, d['value']
-assert len(d['configs']) >= 7, sorted(d['configs'])
+assert len(d['configs']) >= 8, sorted(d['configs'])
 detail = next((x for x in docs if 'detail' in x), {})
 for name, cfg in detail.get('configs', {}).items():
     assert 'exceeds_physics' not in cfg, (name, 'impossible rate reported')
@@ -268,6 +315,10 @@ for name, cfg in detail.get('configs', {}).items():
         pipe = cfg.get('pipeline', {})
         assert pipe.get('byte_identical') is True, (name, pipe)
         assert pipe.get('write_stats', {}).get('row_groups', 0) > 1, pipe
+    if name.startswith('8_'):
+        assert cfg.get('byte_identical') is True, (name, cfg)
+        assert cfg.get('cache', {}).get('footer_hits', 0) > 0, (name, cfg)
+        assert cfg.get('cache', {}).get('chunk_hits', 0) > 0, (name, cfg)
 print('bench smoke ok:', d['metric'], d['value'], d['unit'])
 "
 echo "ALL CHECKS PASSED"

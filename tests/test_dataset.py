@@ -1,0 +1,887 @@
+"""Dataset layer (parquet_tpu/dataset.py) + shared open-path caches
+(io/cache.py): multi-file parity, pruning, sharding, the dataset x faults
+matrix, and exact cache accounting under concurrency."""
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import (Dataset, FaultInjectingSource, FaultPolicy,
+                         ParquetFile, ReadReport, cache_stats, clear_caches)
+from parquet_tpu.errors import CorruptedError, DeadlineError, ReadError
+from parquet_tpu.io.cache import CHUNKS, column_nbytes
+from parquet_tpu.io.source import FileSource
+from parquet_tpu.parallel.host_scan import scan_filtered
+from parquet_tpu.parallel.mesh import dataset_process_shard
+
+N_FILES = 5
+ROWS_PER_FILE = 4000
+RG = 1000  # 4 row groups per file
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    clear_caches(reset_stats=True)
+    yield
+    clear_caches(reset_stats=True)
+
+
+def _corpus(tmp_path, n_files=N_FILES, rows=ROWS_PER_FILE):
+    """n_files part-files with disjoint, ascending key ranges (file i holds
+    x in [i*rows, (i+1)*rows)) — file-level pruning is decidable."""
+    paths = []
+    for i in range(n_files):
+        t = pa.table({
+            "x": pa.array(np.arange(i * rows, (i + 1) * rows,
+                                    dtype=np.int64)),
+            "f": pa.array(np.linspace(0.0, 1.0, rows) + i),
+            "s": pa.array([f"f{i}_v{j % 37}" for j in range(rows)]),
+        })
+        p = os.path.join(tmp_path, f"part-{i:02d}.parquet")
+        pq.write_table(t, p, row_group_size=RG, write_page_index=True)
+        paths.append(p)
+    return paths
+
+
+def _serial_concat(paths, columns=None):
+    return pa.concat_tables(
+        ParquetFile(p).read(columns=columns).to_arrow() for p in paths)
+
+
+# ---------------------------------------------------------------------------
+# construction / identity
+# ---------------------------------------------------------------------------
+def test_glob_and_list_expansion(tmp_path):
+    paths = _corpus(tmp_path)
+    ds = Dataset(os.path.join(tmp_path, "part-*.parquet"))
+    assert ds.paths == paths  # globs expand sorted
+    # mixed list keeps caller order, dedups, expands inner globs
+    ds2 = Dataset([paths[2], os.path.join(tmp_path, "part-*.parquet")])
+    assert ds2.paths[0] == paths[2] and sorted(ds2.paths) == paths
+    with pytest.raises(FileNotFoundError):
+        Dataset(os.path.join(tmp_path, "nope-*.parquet"))
+    with pytest.raises(ValueError):
+        Dataset([])
+
+
+def test_num_rows_and_row_offsets(tmp_path):
+    paths = _corpus(tmp_path)
+    with Dataset(paths) as ds:
+        assert ds.num_files == N_FILES
+        assert ds.num_rows == N_FILES * ROWS_PER_FILE
+        offs = ds.row_offsets()
+        assert list(offs) == [i * ROWS_PER_FILE for i in range(N_FILES + 1)]
+
+
+def test_schema_mismatch_raises(tmp_path):
+    paths = _corpus(tmp_path, n_files=2)
+    other = os.path.join(tmp_path, "zz-other.parquet")
+    pq.write_table(pa.table({"y": pa.array([1, 2, 3])}), other)
+    with pytest.raises(ValueError, match="schema mismatch"):
+        Dataset(paths + [other]).read()
+
+
+def test_schema_mismatch_catches_logical_type_drift(tmp_path):
+    # same dotted path, same PHYSICAL type, different logical types: a
+    # merge under the first file's interpretation would silently mis-scale
+    # every value — the signature must see logical identity too
+    a = os.path.join(tmp_path, "a.parquet")
+    b = os.path.join(tmp_path, "b.parquet")
+    pq.write_table(pa.table({"amount": pa.array(
+        [1, 2, 3], type=pa.decimal128(10, 2))}), a)
+    pq.write_table(pa.table({"amount": pa.array(
+        [1, 2, 3], type=pa.decimal128(10, 4))}), b)
+    with pytest.raises(ValueError, match="schema mismatch"):
+        Dataset([a, b]).read()
+
+
+def test_recursive_glob_spans_directory_levels(tmp_path):
+    paths = _corpus(tmp_path, n_files=2)
+    nested = os.path.join(tmp_path, "deep", "deeper")
+    os.makedirs(nested)
+    moved = os.path.join(nested, "part-09.parquet")
+    os.rename(paths[1], moved)
+    ds = Dataset(os.path.join(tmp_path, "**", "*.parquet"))
+    assert ds.paths == sorted([paths[0], moved])
+    assert ds.num_rows == 2 * ROWS_PER_FILE
+
+
+def test_cached_list_containers_are_private(monkeypatch):
+    # list_offsets is a python list: element assignment into a shared
+    # container would poison the cache even with read-only numpy buffers
+    from parquet_tpu.io.column import Column
+    from parquet_tpu.schema.schema import leaf as leaf_node, message
+
+    monkeypatch.delenv("PARQUET_TPU_CHUNK_CACHE", raising=False)
+    sch = message("root", [leaf_node("v", "INT64")])
+    col = Column(leaf=sch.leaves[0], values=np.arange(4, dtype=np.int64),
+                 list_offsets=[np.array([0, 2, 4], np.int32)], num_slots=4)
+    served = CHUNKS.put_and_freeze(("priv",), col)
+    served.list_offsets[0] = "poison"
+    hit = CHUNKS.get(("priv",))
+    assert isinstance(hit.list_offsets[0], np.ndarray)
+    hit.list_offsets[0] = "poison2"
+    assert isinstance(CHUNKS.get(("priv",)).list_offsets[0], np.ndarray)
+
+
+def test_degraded_read_keeps_retries_of_the_skipped_file(tmp_path):
+    # a file that retried transiently before dying must surface those
+    # retries in the dataset report even though the file itself skips —
+    # parity with iter_batches' accounting
+    paths = _corpus(tmp_path, n_files=2)
+    skip = FaultPolicy(backoff_s=0.0, max_retries=4,
+                       on_corrupt="skip_row_group")
+
+    class _RetriesThenDies:
+        def __init__(self, pf):
+            self._pf = pf
+
+        def __getattr__(self, name):
+            return getattr(self._pf, name)
+
+        def read(self, **kw):
+            rep = kw.get("report")
+            if rep is not None:
+                rep.retries += 3  # what PolicySource would have recorded
+            raise OSError("fatal after retries")
+
+    def open_fn(path):
+        pf = ParquetFile(path, policy=skip)
+        return _RetriesThenDies(pf) if path == paths[0] else pf
+
+    rep = ReadReport()
+    with Dataset(paths, policy=skip, open_fn=open_fn) as ds:
+        t = ds.read(report=rep)
+    assert t.num_rows == ROWS_PER_FILE
+    assert rep.files_skipped == [paths[0]]
+    assert rep.retries == 3  # the skipped file's retries survived
+    assert rep.rows_dropped == ROWS_PER_FILE  # no double count
+
+
+def test_literal_path_with_glob_metacharacters(tmp_path):
+    # a file whose NAME contains glob metacharacters must open literally
+    paths = _corpus(tmp_path, n_files=1)
+    weird = os.path.join(tmp_path, "part[1].parquet")
+    os.rename(paths[0], weird)
+    ds = Dataset(weird)
+    assert ds.paths == [weird]
+    assert ds.num_rows == ROWS_PER_FILE
+    from parquet_tpu.__main__ import main
+
+    assert main(["verify", weird]) == 0
+
+
+# ---------------------------------------------------------------------------
+# read / iter_batches parity
+# ---------------------------------------------------------------------------
+def test_read_matches_serial_loop(tmp_path):
+    paths = _corpus(tmp_path)
+    want = _serial_concat(paths)
+    with Dataset(paths) as ds:
+        got = ds.read().to_arrow()
+    assert got.equals(want)  # byte-identical, file-ordered
+
+
+def test_read_column_selection(tmp_path):
+    paths = _corpus(tmp_path)
+    want = _serial_concat(paths, columns=["x", "s"])
+    with Dataset(paths) as ds:
+        got = ds.read(columns=["x", "s"]).to_arrow()
+    assert got.equals(want)
+
+
+def test_iter_batches_matches_read(tmp_path):
+    paths = _corpus(tmp_path)
+    want = _serial_concat(paths)
+    with Dataset(paths) as ds:
+        got = pa.concat_tables(b.to_arrow()
+                               for b in ds.iter_batches(batch_rows=1700))
+    assert got.equals(want)
+
+
+def test_read_parallel_matches_forced_serial(tmp_path, monkeypatch):
+    paths = _corpus(tmp_path)
+    with Dataset(paths) as ds:
+        par = ds.read().to_arrow()
+    clear_caches()
+    monkeypatch.setenv("PARQUET_TPU_POOL_WORKERS", "1")
+    # width-1 pool: the fan-out degenerates to serial; results identical
+    with Dataset(paths) as ds:
+        ser = ds.read().to_arrow()
+    assert par.equals(ser)
+
+
+# ---------------------------------------------------------------------------
+# pruning / planning / scan
+# ---------------------------------------------------------------------------
+def test_prune_files_by_footer_stats(tmp_path):
+    paths = _corpus(tmp_path)
+    with Dataset(paths) as ds:
+        # file i holds [i*R, (i+1)*R): a range inside file 3 prunes the rest
+        lo = 3 * ROWS_PER_FILE + 10
+        assert ds.prune("x", lo=lo, hi=lo + 5) == [paths[3]]
+        assert ds.prune("x", lo=10 ** 9) == []
+        assert ds.prune("x") == paths  # no predicate: everything may match
+        assert ds.prune("x", values=[5, 3 * ROWS_PER_FILE + 1]) \
+            == [paths[0], paths[3]]
+        with pytest.raises(ValueError):
+            ds.prune("x", lo=1, values=[2])
+
+
+def test_plan_prunes_files_then_pages(tmp_path):
+    paths = _corpus(tmp_path)
+    with Dataset(paths) as ds:
+        lo = 2 * ROWS_PER_FILE + RG  # second row group of file 2, onward
+        plans = ds.plan("x", lo=lo, hi=lo + 10)
+        assert set(plans) == {paths[2]}
+        assert all(p.rg_index == 1 for p in plans[paths[2]])
+
+
+def test_scan_matches_per_file_scan(tmp_path):
+    paths = _corpus(tmp_path)
+    lo, hi = 3500, 9200  # spans files 0-2
+    want_s = []
+    want_f = []
+    for p in paths:
+        got = scan_filtered(ParquetFile(p), "x", lo=lo, hi=hi)
+        want_s.extend(got["s"])
+        want_f.append(got["f"])
+    with Dataset(paths) as ds:
+        got = ds.scan("x", lo=lo, hi=hi)
+    assert got["s"] == want_s
+    np.testing.assert_array_equal(got["f"], np.concatenate(want_f))
+    assert len(got["s"]) == hi - lo + 1
+
+
+def test_scan_in_list_and_empty_result(tmp_path):
+    paths = _corpus(tmp_path)
+    with Dataset(paths) as ds:
+        got = ds.scan("x", values=[7, ROWS_PER_FILE + 1, 10 ** 9])
+        assert len(got["s"]) == 2
+        empty = ds.scan("x", lo=10 ** 9)
+        assert empty["s"] == [] and len(empty["f"]) == 0
+        assert isinstance(empty["f"], np.ndarray)
+
+
+# ---------------------------------------------------------------------------
+# sharding
+# ---------------------------------------------------------------------------
+def test_shard_partitions_files(tmp_path):
+    paths = _corpus(tmp_path)
+    ds = Dataset(paths)
+    shards = [ds.shard(i, 3) for i in range(3)]
+    union = sorted(p for s in shards for p in s.paths)
+    assert union == sorted(paths)  # disjoint union == corpus
+    assert shards[0].paths == paths[0::3]  # deterministic round-robin
+    assert max(s.num_files for s in shards) \
+        - min(s.num_files for s in shards) <= 1
+    with pytest.raises(ValueError):
+        ds.shard(3, 3)
+    # more shards than files: later shards are legitimately empty —
+    # introspection stays safe, data access raises descriptively
+    empty = ds.shard(N_FILES, N_FILES + 1)
+    assert empty.num_files == 0
+    assert "empty shard" in repr(empty)
+    with pytest.raises(ValueError, match="empty dataset shard"):
+        empty.schema
+    with pytest.raises(ValueError):
+        empty.read()
+
+
+def test_shard_read_concat_equals_full(tmp_path):
+    paths = _corpus(tmp_path)
+    ds = Dataset(paths)
+    tabs = [ds.shard(i, 2).read().to_arrow() for i in range(2)]
+    full = ds.read().to_arrow().sort_by("x")
+    assert pa.concat_tables(tabs).sort_by("x").equals(full)
+
+
+def test_dataset_process_shard_explicit_indices(tmp_path):
+    paths = _corpus(tmp_path)
+    ds = Dataset(paths)
+    got = dataset_process_shard(ds, process_index=1, process_count=2)
+    assert got.paths == paths[1::2]
+
+
+# ---------------------------------------------------------------------------
+# dataset x faults matrix
+# ---------------------------------------------------------------------------
+def _injecting_open(paths, poisoned, policy, **fault_kw):
+    injectors = {}
+
+    def open_fn(path):
+        if path == poisoned:
+            src = FaultInjectingSource(FileSource(path), **fault_kw)
+            injectors[path] = src
+            return ParquetFile(src, policy=policy)
+        return ParquetFile(path, policy=policy)
+
+    return open_fn, injectors
+
+
+def test_transient_faults_recover_and_retries_account_per_file(tmp_path):
+    paths = _corpus(tmp_path)
+    want = _serial_concat(paths)
+    pol = FaultPolicy(max_retries=4, backoff_s=0.0)
+    open_fn, injectors = _injecting_open(paths, paths[2], pol, seed=7,
+                                         error_rate=0.3,
+                                         max_consecutive_errors=2)
+    rep = ReadReport()
+    with Dataset(paths, policy=pol, open_fn=open_fn) as ds:
+        got = ds.read(report=rep).to_arrow()
+    assert got.equals(want)  # every injected error recovered byte-identically
+    injected = injectors[paths[2]].stats.injected_errors
+    assert injected > 0, "injector never fired — knob broken?"
+    # retries aggregate from per-file reports: only the poisoned file's
+    # (open-time retries happen before the per-read operation scope, so the
+    # report sees at least the read-time ones)
+    assert 0 < rep.retries <= injected
+    assert rep.ok and not rep.files_skipped
+
+
+def test_degraded_read_skips_only_the_poisoned_file(tmp_path):
+    paths = _corpus(tmp_path)
+    bad = bytearray(open(paths[1], "rb").read())
+    bad[-1] ^= 0xFF  # break the tail magic: the footer never parses
+    open(paths[1], "wb").write(bytes(bad))
+    skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+    rep = ReadReport()
+    with Dataset(paths, policy=skip) as ds:
+        t = ds.read(report=rep)
+    assert t.num_rows == (N_FILES - 1) * ROWS_PER_FILE
+    assert rep.files_skipped == [paths[1]]
+    assert rep.row_groups_skipped == []  # other files untouched
+    assert not rep.ok
+    want = _serial_concat([p for p in paths if p != paths[1]])
+    assert t.to_arrow().equals(want)
+    # without the degraded policy the same corpus fails loudly
+    with pytest.raises(CorruptedError):
+        Dataset(paths).read()
+
+
+def test_degraded_read_skips_one_row_group_not_the_file(tmp_path):
+    paths = _corpus(tmp_path)
+    meta = pq.ParquetFile(paths[2]).metadata
+    off = meta.row_group(1).column(0).data_page_offset
+    raw = bytearray(open(paths[2], "rb").read())
+    for o in (off, off + 1, off + 2):
+        raw[o] ^= 0xFF
+    open(paths[2], "wb").write(bytes(raw))
+    skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+    rep = ReadReport()
+    with Dataset(paths, policy=skip) as ds:
+        t = ds.read(report=rep)
+    assert t.num_rows == N_FILES * ROWS_PER_FILE - RG
+    assert rep.files_skipped == []  # the FILE stays; one group drops
+    assert rep.row_groups_skipped == [1] and rep.rows_dropped == RG
+
+
+def test_degraded_iter_batches_skips_bad_file(tmp_path):
+    paths = _corpus(tmp_path)
+    open(paths[0], "wb").write(b"PAR1 not really a parquet file")
+    skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+    rep = ReadReport()
+    with Dataset(paths, policy=skip) as ds:
+        got = pa.concat_tables(b.to_arrow()
+                               for b in ds.iter_batches(batch_rows=1500,
+                                                        report=rep))
+    assert got.num_rows == (N_FILES - 1) * ROWS_PER_FILE
+    assert rep.files_skipped == [paths[0]]
+    assert got.equals(_serial_concat(paths[1:]))
+
+
+def test_degraded_iter_batches_accounting_never_double_counts(tmp_path):
+    # a file that dies mid-drain AFTER delivering rows and skipping a row
+    # group: the merged sub-report already accounts the delivered and
+    # dropped rows — the file-skip remainder must cover only the rest, so
+    # read + dropped == the corpus total exactly
+    paths = _corpus(tmp_path, n_files=2)
+
+    class _DiesMidDrain:
+        def __init__(self, pf):
+            self._pf = pf
+
+        def __getattr__(self, name):
+            return getattr(self._pf, name)
+
+        def iter_batches(self, **kw):
+            it = self._pf.iter_batches(**kw)
+            yield next(it)  # one good batch (1000 rows)
+            rep = kw.get("report")
+            if rep is not None:  # a row group skipped before the death
+                rep.record_skip(1, rows=RG, error="synthetic rg skip")
+            raise OSError("mount died mid-drain")
+
+    skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+
+    def open_fn(path):
+        pf = ParquetFile(path, policy=skip)
+        return _DiesMidDrain(pf) if path == paths[0] else pf
+
+    rep = ReadReport()
+    with Dataset(paths, policy=skip, open_fn=open_fn) as ds:
+        got = pa.concat_tables(b.to_arrow() for b in ds.iter_batches(
+            batch_rows=RG, report=rep))
+    assert got.num_rows == RG + ROWS_PER_FILE  # 1 batch + the clean file
+    assert rep.files_skipped == [paths[0]]
+    assert rep.row_groups_skipped == [1]
+    # exact conservation: every row of the corpus is either read or
+    # dropped, never both, never twice
+    assert rep.rows_read == got.num_rows
+    assert rep.rows_dropped == 2 * ROWS_PER_FILE - got.num_rows
+
+
+def test_deadline_propagates_not_skipped(tmp_path):
+    paths = _corpus(tmp_path)
+    pol = FaultPolicy(backoff_s=0.0, deadline_s=0.05,
+                      on_corrupt="skip_row_group")
+    open_fn, _ = _injecting_open(paths, paths[0], pol, latency_s=0.06)
+    with Dataset(paths, policy=pol, open_fn=open_fn) as ds:
+        with pytest.raises(DeadlineError):
+            ds.read()
+
+
+def test_degraded_scan_drops_poisoned_file_only(tmp_path):
+    paths = _corpus(tmp_path)
+    open(paths[3], "wb").write(b"garbage, not parquet at all")
+    skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+    rep = ReadReport()
+    with Dataset(paths, policy=skip) as ds:
+        got = ds.scan("x", lo=0, hi=10 ** 9, report=rep)
+    assert rep.files_skipped == [paths[3]]
+    assert len(got["s"]) == (N_FILES - 1) * ROWS_PER_FILE
+
+
+def test_scan_on_empty_shard_raises_cleanly(tmp_path):
+    paths = _corpus(tmp_path, n_files=2)
+    empty = Dataset(paths).shard(2, 3)
+    assert empty.num_files == 0
+    with pytest.raises(ValueError, match="empty dataset shard"):
+        empty.scan("x", lo=0, hi=5)
+
+
+def test_degraded_scan_typed_empties_when_first_file_is_the_corrupt_one(
+        tmp_path):
+    # file 0 corrupt (skipped at prune), file 1 pruned out by stats: the
+    # typed-empty fallback must come from a file whose footer parsed, not
+    # blindly from file 0
+    paths = _corpus(tmp_path, n_files=2)
+    open(paths[0], "wb").write(b"garbage, not parquet")
+    skip = FaultPolicy(backoff_s=0.0, on_corrupt="skip_row_group")
+    rep = ReadReport()
+    with Dataset(paths, policy=skip) as ds:
+        got = ds.scan("x", lo=10 ** 9, report=rep)
+    assert rep.files_skipped == [paths[0]]
+    assert got["s"] == [] and len(got["f"]) == 0
+
+
+def test_scan_files_skip_files_records_and_merges(tmp_path, monkeypatch):
+    from parquet_tpu.parallel import host_scan
+
+    paths = _corpus(tmp_path, n_files=2)
+    real = host_scan.scan_filtered
+
+    def flaky(pf, *a, **kw):
+        if pf._path == paths[0]:
+            raise OSError("mount vanished mid-scan")
+        return real(pf, *a, **kw)
+
+    monkeypatch.setattr(host_scan, "scan_filtered", flaky)
+    pfs = [ParquetFile(p) for p in paths]
+    rep = ReadReport()
+    got = host_scan.scan_files(pfs, "x", lo=0, hi=10 ** 9, report=rep,
+                               skip_files=True)
+    assert rep.files_skipped == [paths[0]]
+    assert rep.rows_dropped == ROWS_PER_FILE
+    assert len(got["s"]) == ROWS_PER_FILE  # the healthy file still returns
+    with pytest.raises(OSError):  # without skip_files the failure is loud
+        host_scan.scan_files(pfs, "x", lo=0, hi=10 ** 9,
+                             report=ReadReport(), skip_files=False)
+
+
+# ---------------------------------------------------------------------------
+# caches: footer + decoded chunk
+# ---------------------------------------------------------------------------
+def test_source_stat_key_is_open_time_identity(tmp_path):
+    from parquet_tpu.io.source import FileSource, MmapSource
+
+    [p] = _corpus(tmp_path, n_files=1)
+    fs, ms = FileSource(p), MmapSource(p)
+    st = os.stat(p)
+    assert fs.stat_key == ms.stat_key \
+        == (os.path.abspath(p), st.st_ino, st.st_mtime_ns, st.st_size)
+    fs.close(), ms.close()
+    # identity is pinned at OPEN: a replace racing the open must not pair
+    # the old bytes with the new file's stat (cache-poisoning TOCTOU)
+    fs2 = FileSource(p)
+    key_before = fs2.stat_key
+    t = pa.table({"x": pa.array(np.arange(3, dtype=np.int64)),
+                  "f": pa.array(np.zeros(3)),
+                  "s": pa.array(["a"] * 3)})
+    pq.write_table(t, p)
+    assert fs2.stat_key == key_before
+    fs2.close()
+def test_footer_cache_hits_on_reopen_and_invalidates_on_rewrite(tmp_path):
+    [p] = _corpus(tmp_path, n_files=1)
+    ParquetFile(p).read()
+    c0 = cache_stats()
+    assert c0.footer_misses == 1 and c0.footer_hits == 0
+    ParquetFile(p).read()
+    c1 = cache_stats()
+    assert c1.footer_hits == 1  # re-open skipped the thrift parse
+    # rewriting the file (new mtime/size identity) must invalidate
+    t = pa.table({"x": pa.array(np.arange(7, dtype=np.int64)),
+                  "f": pa.array(np.zeros(7)),
+                  "s": pa.array(["a"] * 7)})
+    pq.write_table(t, p)
+    pf = ParquetFile(p)
+    assert pf.num_rows == 7
+    c2 = cache_stats()
+    assert c2.footer_misses == 2 and c2.footer_hits == 1
+
+
+def test_chunk_cache_warm_read_hits_and_is_identical(tmp_path):
+    [p] = _corpus(tmp_path, n_files=1)
+    cold = ParquetFile(p).read().to_arrow()
+    c0 = cache_stats()
+    assert c0.chunk_misses > 0 and c0.chunk_hits == 0
+    warm = ParquetFile(p).read().to_arrow()
+    c1 = cache_stats()
+    assert warm.equals(cold)
+    assert c1.chunk_hits == c0.chunk_misses  # every chunk served warm
+    assert c1.chunk_misses == c0.chunk_misses
+    assert 0 < c1.chunk_bytes <= c1.chunk_capacity
+
+
+def test_chunk_cache_byte_cap_and_evictions(tmp_path, monkeypatch):
+    paths = _corpus(tmp_path, n_files=3)
+    cap = 64 * 1024  # tiny: the corpus cannot fit
+    monkeypatch.setenv("PARQUET_TPU_CHUNK_CACHE", str(cap))
+    for p in paths:
+        ParquetFile(p).read()
+    c = cache_stats()
+    assert c.chunk_bytes <= cap  # LRU stays under its byte cap
+    assert c.chunk_evictions > 0
+    # and the data that comes back (hit or miss) is still correct
+    assert ParquetFile(paths[0]).read().to_arrow().equals(
+        _serial_concat([paths[0]]))
+
+
+def test_commit_invalidates_cached_entries_for_the_destination(tmp_path):
+    # the fstat identity covers rename-replaces; the path sinks ALSO
+    # invalidate their destination on commit, closing the in-place
+    # same-size same-mtime-tick rewrite window for our own writers
+    from parquet_tpu import WriterOptions, write_table
+
+    [p] = _corpus(tmp_path, n_files=1)
+    ParquetFile(p).read()
+    assert cache_stats().chunk_entries > 0
+    t = pa.table({"z": pa.array(np.arange(10, dtype=np.int64))})
+    write_table(t, p, WriterOptions())  # atomic commit over the same path
+    c = cache_stats()
+    assert c.footer_entries == 0 and c.chunk_entries == 0
+    assert ParquetFile(p).num_rows == 10
+    # non-atomic FileSink rewrites in place: same contract
+    ParquetFile(p).read()
+    assert cache_stats().chunk_entries > 0
+    write_table(pa.table({"z": pa.array(np.arange(7, dtype=np.int64))}), p,
+                WriterOptions(atomic_commit=False))
+    assert cache_stats().chunk_entries == 0
+    assert ParquetFile(p).num_rows == 7
+
+
+def test_chunk_cache_disabled_by_env(tmp_path, monkeypatch):
+    [p] = _corpus(tmp_path, n_files=1)
+    monkeypatch.setenv("PARQUET_TPU_CHUNK_CACHE", "0")
+    ParquetFile(p).read()
+    ParquetFile(p).read()
+    c = cache_stats()
+    assert c.chunk_hits == 0 and c.chunk_entries == 0
+
+
+def test_wrapped_sources_never_cached(tmp_path):
+    [p] = _corpus(tmp_path, n_files=1)
+    src = FaultInjectingSource(FileSource(p), seed=0)
+    ParquetFile(src).read()
+    c = cache_stats()
+    # neither footer nor chunks of the injector-wrapped open may populate
+    # (its bytes are not trustworthy as the file's bytes)
+    assert c.footer_misses == 0 and c.chunk_entries == 0
+
+
+def test_cached_column_mutation_isolation(tmp_path):
+    # a consumer materializing a dictionary-encoded column in place must
+    # not rewrite the cached master: the next reader still sees dict form
+    [p] = _corpus(tmp_path, n_files=1)
+    t1 = ParquetFile(p).read()
+    col1 = t1["s"]
+    if not col1.is_dictionary_encoded():
+        pytest.skip("writer did not dictionary-encode 's'")
+    col1.materialize_host()
+    assert not col1.is_dictionary_encoded()
+    t2 = ParquetFile(p).read()  # warm: served from the cache
+    assert cache_stats().chunk_hits > 0
+    assert t2["s"].is_dictionary_encoded()
+
+
+def test_cached_reads_are_immune_to_inplace_mutation(tmp_path):
+    # cached buffers are read-only: where a read result IS the cached
+    # buffer (single row group — no concat copy), writing into it raises
+    # loudly instead of silently poisoning every later read of the file
+    p = os.path.join(tmp_path, "single-rg.parquet")
+    pq.write_table(pa.table({"x": pa.array(np.arange(500,
+                                                     dtype=np.int64))}), p)
+    t1 = ParquetFile(p).read()
+    want = t1.to_arrow()
+    arr = np.asarray(t1["x"].values)
+    with pytest.raises(ValueError):
+        arr[:] = -1
+    t2 = ParquetFile(p).read()
+    assert cache_stats().chunk_hits > 0
+    assert t2.to_arrow().equals(want)  # the file's true data, not -1s
+    # multi-row-group reads concatenate into fresh buffers: mutation of
+    # the COPY is allowed and must not leak into later reads either
+    [p2] = _corpus(tmp_path, n_files=1)
+    t3 = ParquetFile(p2).read()
+    want3 = t3.to_arrow()
+    np.asarray(t3["x"].values)[:] = -1
+    assert ParquetFile(p2).read().to_arrow().equals(want3)
+
+
+def test_merge_scan_results_mixed_empty_flba_shapes():
+    # a file whose pages all pruned returns the 1-D typed empty while a
+    # matching file returns (n, width) FLBA rows — the merge must not
+    # concatenate mismatched ranks
+    from parquet_tpu.parallel.host_scan import merge_scan_results
+
+    a = {"b": np.empty(0, np.uint8)}
+    b = {"b": np.arange(24, dtype=np.uint8).reshape(3, 8)}
+    got = merge_scan_results([a, b], ["b"])
+    assert got["b"].shape == (3, 8)
+    np.testing.assert_array_equal(got["b"], b["b"])
+    both_empty = merge_scan_results([a, {"b": np.empty(0, np.uint8)}], ["b"])
+    assert len(both_empty["b"]) == 0
+    masked = merge_scan_results(
+        [a, {"b": np.ma.MaskedArray(np.ones(2), mask=[True, False])},
+         {"b": np.ones(1)}], ["b"])
+    assert isinstance(masked["b"], np.ma.MaskedArray)
+    assert len(masked["b"]) == 3
+
+
+def test_cache_accounting_exact_under_concurrent_reads(tmp_path):
+    [p] = _corpus(tmp_path, n_files=1)
+    want = ParquetFile(p).read().to_arrow()  # warm the cache
+    c0 = cache_stats()
+    n_chunks = c0.chunk_misses
+    assert n_chunks == 4 * 3  # 4 row groups x 3 leaves
+    n_threads = 8
+
+    def read_one(_):
+        return ParquetFile(p).read().to_arrow()
+
+    with ThreadPoolExecutor(n_threads) as ex:
+        tabs = list(ex.map(read_one, range(n_threads)))
+    assert all(tb.equals(want) for tb in tabs)
+    c1 = cache_stats()
+    # exact accounting: every lookup of every concurrent read is a hit,
+    # no lookup is lost or double-counted
+    assert c1.chunk_hits - c0.chunk_hits == n_threads * n_chunks
+    assert c1.chunk_misses == c0.chunk_misses
+    assert c1.footer_hits - c0.footer_hits == n_threads
+
+
+def test_dataset_warm_open_uses_both_caches(tmp_path):
+    paths = _corpus(tmp_path)
+    with Dataset(paths) as ds:
+        want = ds.read().to_arrow()
+    c0 = cache_stats()
+    with Dataset(paths) as ds2:  # fresh Dataset, fresh ParquetFile opens
+        got = ds2.read().to_arrow()
+    c1 = cache_stats()
+    assert got.equals(want)
+    assert c1.footer_hits - c0.footer_hits == N_FILES
+    assert c1.chunk_hits - c0.chunk_hits == c0.chunk_misses
+    assert c1.chunk_misses == c0.chunk_misses
+
+
+def test_column_nbytes_counts_buffers():
+    from parquet_tpu.io.column import Column
+    from parquet_tpu.schema.schema import leaf as leaf_node, message
+
+    sch = message("root", [leaf_node("v", "INT64")])
+    col = Column(leaf=sch.leaves[0], values=np.zeros(100, np.int64),
+                 validity=np.ones(100, bool), num_slots=100)
+    assert column_nbytes(col) == 800 + 100
+
+
+def test_chunk_cache_refuses_oversized_items(monkeypatch):
+    from parquet_tpu.io.column import Column
+    from parquet_tpu.schema.schema import leaf as leaf_node, message
+
+    monkeypatch.setenv("PARQUET_TPU_CHUNK_CACHE", "1000")
+    sch = message("root", [leaf_node("v", "INT64")])
+    big = Column(leaf=sch.leaves[0], values=np.zeros(1000, np.int64),
+                 num_slots=1000)
+    # 8000 bytes > cap/2: refused (None), not evict-churned
+    assert CHUNKS.put_and_freeze(("k",), big) is None
+    assert cache_stats().chunk_entries == 0
+
+
+# ---------------------------------------------------------------------------
+# pool helper
+# ---------------------------------------------------------------------------
+def test_map_in_order_preserves_order_and_raises_first_error():
+    from parquet_tpu.utils.pool import map_in_order
+
+    got = map_in_order(lambda i: i * i, range(20))
+    assert got == [i * i for i in range(20)]
+
+    def boom(i):
+        if i in (3, 7):
+            raise RuntimeError(f"task {i}")
+        return i
+
+    with pytest.raises(RuntimeError, match="task 3"):
+        map_in_order(boom, range(10))
+
+
+def test_map_in_order_propagates_interrupts_immediately():
+    from parquet_tpu.utils.pool import map_in_order
+
+    # a KeyboardInterrupt must escape at once (cancelling what it can),
+    # not be swallowed as "first_err" while the loop blocks on the rest
+    def boom(i):
+        if i == 0:
+            raise KeyboardInterrupt
+        return i
+
+    with pytest.raises(KeyboardInterrupt):
+        map_in_order(boom, range(8))
+
+
+def test_cached_entries_own_their_buffers():
+    # caching a zero-copy SLICE of a big buffer (whole-file mmap, decode
+    # scratch) must not pin the backing buffer — the cap accounts nbytes,
+    # so entries must own exactly that many bytes
+    from parquet_tpu.io.column import Column
+    from parquet_tpu.schema.schema import leaf as leaf_node, message
+
+    backing = np.arange(100_000, dtype=np.int64)
+    sl = backing[:16]
+    sch = message("root", [leaf_node("v", "INT64")])
+    col = Column(leaf=sch.leaves[0], values=sl, num_slots=16)
+    served = CHUNKS.put_and_freeze(("own",), col)
+    hit = CHUNKS.get(("own",))
+    for arr in (served.values, hit.values):
+        np.testing.assert_array_equal(np.asarray(arr), sl)
+        base = arr.base if arr.base is not None else arr
+        assert base is not backing and base.base is not backing
+
+
+def test_scan_files_retries_survive_a_file_skip(tmp_path, monkeypatch):
+    from parquet_tpu.parallel import host_scan
+
+    paths = _corpus(tmp_path, n_files=2)
+    real = host_scan.scan_filtered
+
+    def flaky(pf, *a, **kw):
+        if pf._path == paths[0]:
+            rep = kw.get("report")
+            if rep is not None:
+                rep.retries += 5  # what PolicySource would have recorded
+            raise OSError("fatal after retries")
+        return real(pf, *a, **kw)
+
+    monkeypatch.setattr(host_scan, "scan_filtered", flaky)
+    pfs = [ParquetFile(p) for p in paths]
+    rep = ReadReport()
+    host_scan.scan_files(pfs, "x", lo=0, hi=10 ** 9, report=rep,
+                         skip_files=True)
+    assert rep.files_skipped == [paths[0]] and rep.retries == 5
+    # skip_files with no report would be silent unaccounted data loss
+    with pytest.raises(ValueError, match="requires a report"):
+        host_scan.scan_files(pfs, "x", lo=0, hi=10 ** 9, skip_files=True)
+
+
+def test_scan_empty_fallback_validates_columns_like_scan_filtered(tmp_path):
+    import pyarrow as _pa
+
+    p = os.path.join(tmp_path, "nested.parquet")
+    offs = _pa.array(np.arange(0, 22, 2, dtype=np.int32))
+    pq.write_table(_pa.table({
+        "x": _pa.array(np.arange(10, dtype=np.int64)),
+        "lst": _pa.ListArray.from_arrays(offs, _pa.array(range(20))),
+    }), p)
+    with Dataset([p]) as ds:
+        # pruned-empty and matching scans must agree on what is invalid
+        with pytest.raises(ValueError, match="nested"):
+            ds.scan("x", lo=10 ** 12, columns=["lst.list.element"])
+        with pytest.raises(KeyError):
+            ds.scan("x", lo=10 ** 12, columns=["nope"])
+
+
+def test_map_in_order_nested_in_pool_stays_serial():
+    from parquet_tpu.utils.pool import map_in_order, submit
+
+    seen = {}
+
+    def outer(_):
+        # nested call must take the serial path (no pool deadlock) and
+        # still return ordered results
+        seen["nested"] = map_in_order(lambda i: i + 1, range(5))
+        return True
+
+    assert submit(outer, 0).result(timeout=30) is True
+    assert seen["nested"] == [1, 2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# CLI: parallel multi-file verify
+# ---------------------------------------------------------------------------
+def test_cli_verify_multiple_paths_and_globs(tmp_path, capsys):
+    from parquet_tpu.__main__ import main
+
+    paths = _corpus(tmp_path, n_files=3)
+    assert main(["verify", os.path.join(tmp_path, "part-*.parquet")]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3 and all("OK" in line for line in out)
+    # one corrupt file of N -> per-file reports, exit 1 (the flip breaks
+    # the tail magic: detectable without CRCs, which pyarrow omits)
+    raw = bytearray(open(paths[1], "rb").read())
+    raw[-1] ^= 0xFF
+    open(paths[1], "wb").write(bytes(raw))
+    assert main(["verify"] + paths) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and out.count("OK") == 2
+    # unmatched glob is a failure, missing file too
+    assert main(["verify", os.path.join(tmp_path, "zz-*.parquet")]) == 1
+    assert main(["verify", paths[0],
+                 os.path.join(tmp_path, "missing.parquet")]) == 1
+    out = capsys.readouterr().out
+    assert "OK" in out  # the good file still got its report
+
+
+def test_cli_verify_json_lines(tmp_path, capsys):
+    import json
+
+    from parquet_tpu.__main__ import main
+
+    paths = _corpus(tmp_path, n_files=2)
+    assert main(["verify", "--json"] + paths) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    docs = [json.loads(line) for line in lines]
+    assert len(docs) == 2 and all(d["ok"] for d in docs)
+    assert [d["path"] for d in docs] == paths  # deterministic input order
+
+
+def test_cli_single_file_commands_still_single(tmp_path, capsys):
+    from parquet_tpu.__main__ import main
+
+    paths = _corpus(tmp_path, n_files=2)
+    assert main(["schema", paths[0]]) == 0
+    assert main(["schema"] + paths) == 1  # only verify is multi-file

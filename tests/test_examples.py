@@ -15,6 +15,7 @@ EX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 @pytest.mark.parametrize("script,argv", [
     ("typed_round_trip.py", ["{tmp}/trades.parquet"]),
     ("pushdown_scan.py", []),
+    ("dataset_scan.py", ["20000"]),
     ("sorted_merge.py", []),
     ("tpch_q1_tpu.py", ["50000"]),
 ])

@@ -175,7 +175,8 @@ def test_write_stats_meters_the_pipeline(table, monkeypatch, tmp_path):
     d = st.as_dict()
     assert set(d) == {"row_groups", "overlapped_groups", "encode_s",
                       "emit_s", "pool_wait_s", "overlap_ratio",
-                      "bytes_buffered", "bytes_flushed", "sink_flushes"}
+                      "bytes_buffered", "bytes_flushed", "sink_flushes",
+                      "writev_flushes"}
 
 
 def test_write_stats_serial_mode_zero_overlap(table, monkeypatch):
@@ -324,6 +325,157 @@ def test_buffered_sink_passthrough_mode_counts():
     b.writelines([b"de", b"f"])
     assert inner.buf.getvalue() == b"abcdef"
     assert st.bytes_flushed == 6 and st.bytes_buffered == 0
+
+
+def test_writev_vectored_flush_on_path_sinks(table, monkeypatch, tmp_path):
+    # raw-fd sinks (FileSink/AtomicFileSink under the writer's BufferedSink)
+    # take the true os.writev path; bytes are identical to the
+    # writelines-only pass-through
+    opts = WriterOptions(row_group_size=RG)
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", "0")
+    p0 = tmp_path / "plain.parquet"
+    write_table(table, str(p0), opts)
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", str(1 << 16))
+    p1 = tmp_path / "vectored.parquet"
+    w1 = write_table(table, str(p1), opts)
+    assert p0.read_bytes() == p1.read_bytes()
+    if hasattr(os, "writev"):
+        assert w1.write_stats.writev_flushes == w1.write_stats.sink_flushes
+    assert w1.write_stats.bytes_flushed == os.path.getsize(p1)
+
+
+def test_writev_falls_back_without_raw_fd():
+    # a sink with no raw_fd (in-memory, injector wrappers) keeps the
+    # writelines path and the same bytes
+    inner = _CountingSink()
+    st = WriteStats()
+    b = BufferedSink(inner, buffer_bytes=256, stats=st)
+    payload = [bytes([i]) * 100 for i in range(16)]
+    for part in payload:
+        b.write(part)
+    b.close()
+    assert inner.buf.getvalue() == b"".join(payload)
+    assert st.writev_flushes == 0 and st.sink_flushes > 0
+
+
+def test_writev_all_resumes_partial_and_batches_iov(monkeypatch, tmp_path):
+    from parquet_tpu.io import sink as sink_mod
+
+    if not hasattr(os, "writev"):
+        pytest.skip("no os.writev on this platform")
+    # IOV_MAX batching: more parts than the cap still all land, in order
+    monkeypatch.setattr(sink_mod, "_IOV_MAX", 4)
+    parts = [bytes([i]) * 13 for i in range(11)]
+    p = tmp_path / "iov.bin"
+    fd = os.open(str(p), os.O_WRONLY | os.O_CREAT)
+    try:
+        sink_mod._writev_all(fd, parts)
+    finally:
+        os.close(fd)
+    assert p.read_bytes() == b"".join(parts)
+    # partial writes resume mid-part
+    calls = []
+    real_writev = os.writev
+
+    def short_writev(fd_, bufs):
+        calls.append(len(bufs))
+        n = real_writev(fd_, [memoryview(bufs[0])[:5]])
+        return n
+
+    monkeypatch.setattr(os, "writev", short_writev)
+    p2 = tmp_path / "short.bin"
+    fd = os.open(str(p2), os.O_WRONLY | os.O_CREAT)
+    try:
+        sink_mod._writev_all(fd, parts)
+    finally:
+        os.close(fd)
+    assert p2.read_bytes() == b"".join(parts)
+    assert len(calls) > len(parts)  # every 13-byte part took >1 call
+
+
+@pytest.fixture
+def fresh_autotune():
+    from parquet_tpu.io.sink import write_autotune
+
+    write_autotune().reset()
+    yield write_autotune()
+    write_autotune().reset()
+
+
+def test_write_autotune_grows_then_decays(fresh_autotune, monkeypatch):
+    from parquet_tpu.io.sink import DEFAULT_WRITE_BUFFER, write_buffer_bytes
+
+    monkeypatch.delenv("PARQUET_TPU_WRITE_BUFFER", raising=False)
+    monkeypatch.delenv("PARQUET_TPU_WRITE_AUTOTUNE", raising=False)
+    hot = WriteStats(row_groups=6, sink_flushes=120, bytes_buffered=1)
+    fresh_autotune.observe(hot)
+    assert write_buffer_bytes() == DEFAULT_WRITE_BUFFER * 2
+    fresh_autotune.observe(hot)
+    assert write_buffer_bytes() == DEFAULT_WRITE_BUFFER * 4
+    cold = WriteStats(row_groups=6, sink_flushes=6, bytes_buffered=1)
+    fresh_autotune.observe(cold)
+    assert write_buffer_bytes() == DEFAULT_WRITE_BUFFER * 2
+    fresh_autotune.observe(cold)
+    fresh_autotune.observe(cold)
+    assert write_buffer_bytes() == DEFAULT_WRITE_BUFFER  # back to default
+    # pass-through writers (nothing buffered) are no signal either way
+    fresh_autotune.observe(WriteStats(row_groups=6, sink_flushes=0))
+    assert write_buffer_bytes() == DEFAULT_WRITE_BUFFER
+
+
+def test_write_buffer_garbage_env_is_unset_consistently(fresh_autotune,
+                                                        monkeypatch):
+    # an unparseable PARQUET_TPU_WRITE_BUFFER counts as unset in BOTH
+    # resolution paths: the size falls back to tuner/default AND the sink
+    # stays tunable (a half-pinned state would freeze a stale suggestion)
+    from parquet_tpu.io.sink import (DEFAULT_WRITE_BUFFER, BufferedSink,
+                                     write_buffer_bytes)
+
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", "4mb")
+    monkeypatch.delenv("PARQUET_TPU_WRITE_AUTOTUNE", raising=False)
+    assert write_buffer_bytes() == DEFAULT_WRITE_BUFFER
+    assert BufferedSink(_CountingSink())._tunable is True
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", "1024")
+    assert write_buffer_bytes() == 1024
+    assert BufferedSink(_CountingSink())._tunable is False
+
+
+def test_write_autotune_env_pin_wins(fresh_autotune, monkeypatch):
+    from parquet_tpu.io.sink import write_buffer_bytes
+
+    fresh_autotune.observe(WriteStats(row_groups=1, sink_flushes=100,
+                                      bytes_buffered=1))
+    assert fresh_autotune.suggest() is not None
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", "12345")
+    assert write_buffer_bytes() == 12345  # explicit pin beats the tuner
+    monkeypatch.delenv("PARQUET_TPU_WRITE_BUFFER", raising=False)
+    monkeypatch.setenv("PARQUET_TPU_WRITE_AUTOTUNE", "0")
+    from parquet_tpu.io.sink import DEFAULT_WRITE_BUFFER
+
+    assert write_buffer_bytes() == DEFAULT_WRITE_BUFFER  # opt-out ignores it
+
+
+def test_writer_close_feeds_the_autotuner(fresh_autotune, monkeypatch,
+                                          tmp_path):
+    monkeypatch.delenv("PARQUET_TPU_WRITE_BUFFER", raising=False)
+    monkeypatch.delenv("PARQUET_TPU_WRITE_AUTOTUNE", raising=False)
+    # a wide table against a tiny (tuner-suggested) buffer: every chunk's
+    # page write flushes on its own, so flushes-per-row-group is the column
+    # count — well past the raise threshold; close() must observe and grow
+    # the suggestion for the NEXT writer
+    wide = pa.table({f"c{i:02d}": pa.array(np.arange(2000, dtype=np.int64))
+                     for i in range(12)})
+    fresh_autotune.buffer = 1024  # as if tuned down; the writer reads it
+    dest = tmp_path / "tuned.parquet"
+    write_table(wide, str(dest), WriterOptions(row_group_size=500))
+    assert fresh_autotune.suggest() == 2048  # observe() grew it
+    # an env-pinned writer must NOT observe (the pin is authoritative)
+    fresh_autotune.reset()
+    monkeypatch.setenv("PARQUET_TPU_WRITE_BUFFER", "1024")
+    dest2 = tmp_path / "pinned.parquet"
+    write_table(wide, str(dest2), WriterOptions(row_group_size=500))
+    assert fresh_autotune.suggest() is None
+    assert dest.read_bytes() == dest2.read_bytes()  # size never changes bytes
 
 
 def test_write_buffer_env_knob(table, monkeypatch, tmp_path):
