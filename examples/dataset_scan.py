@@ -1,6 +1,8 @@
-"""Dataset layer (ISSUE 5): parallel multi-file scan over a part-file
-corpus, footer-level file pruning, shared footer/decoded-chunk caches on
-warm re-opens, and sharding for multi-host meshes.
+"""Dataset layer (ISSUE 5) + scan planner (ISSUE 6): parallel multi-file
+scan over a part-file corpus, two-column predicate trees planned by the
+unified cascade (stats -> page index -> bloom), footer-level file pruning,
+shared footer/decoded-chunk caches on warm re-opens, and sharding for
+multi-host meshes.
 
 Run: python examples/dataset_scan.py [rows_per_file]
 """
@@ -15,7 +17,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from parquet_tpu import (Dataset, FaultPolicy, ReadReport, WriterOptions,
-                         cache_stats, clear_caches, write_table)
+                         cache_stats, clear_caches, col, write_table)
 
 
 def main() -> None:
@@ -45,16 +47,23 @@ def main() -> None:
     print(f"corpus: {ds.num_files} files, {ds.num_rows} rows, "
           f"offsets {[int(x) for x in ds.row_offsets()]}")
 
-    # footer statistics prune whole files before any chunk byte moves
+    # a TWO-COLUMN predicate tree: the planner prunes whole files by the
+    # ts range (footer stats), then page-prunes survivors per column and
+    # only decodes payload pages for rows that pass the exact mask
     lo, hi = 3 * rows + 100, 3 * rows + 5000  # inside file 3
-    survivors = ds.prune("ts", lo=lo, hi=hi)
-    print(f"prune ts in [{lo}, {hi}]: {len(survivors)} of "
+    where = col("ts").between(lo, hi) & col("account").between(0, 25_000)
+    survivors = ds.prune(where=where)
+    print(f"prune {where!r}: {len(survivors)} of "
           f"{ds.num_files} files survive")
+    for path, plan in ds.plan(where=where).items():
+        print(f"-- plan for {os.path.basename(path)} --")
+        print(plan.explain())
 
-    # parallel multi-file scan, deterministic file-ordered output
+    # parallel multi-file scan, deterministic file-ordered output; the
+    # predicate tree is normalized ONCE for the whole dataset
     t0 = time.perf_counter()
-    out = ds.scan("ts", lo=lo, hi=hi, columns=["account", "amount"])
-    print(f"scan: {len(out['account'])} rows in "
+    out = ds.scan(where=where, columns=["amount"])
+    print(f"scan: {len(out['amount'])} rows in "
           f"{time.perf_counter() - t0:.3f}s, "
           f"sum(amount) = {out['amount'].sum():.2f}")
 

@@ -21,6 +21,12 @@ Algebra
   merge_row_groups (MergeRowGroups), convert_table (Convert)
 Pushdown
   find (parquet.Find), plan_scan, prune_row_group, pages_overlapping
+Scan planning
+  col/And/Or/Not (predicate trees over range/IN/equality/null leaves),
+  scan_expr (multi-column filtered reads with late materialization),
+  ScanPlanner/ScanPlan (cheapest-first stats → page-index → bloom
+  cascade, ``explain()``), CostInputs/choose_route/route_history
+  (cost-based host/device routing; PARQUET_TPU_ROUTE pin)
 Schema
   Schema, message/group/leaf/optional/repeated/list_of/map_of (node.go)
 Rows
@@ -76,7 +82,10 @@ from .io.prefetch import PrefetchSource, ReadStats
 from .io.cache import CacheStats, cache_stats, clear_caches
 from .io.source import MmapSource, RetryingSource, Source
 from .dataset import Dataset
-from .parallel.host_scan import (scan, scan_filtered,
+from .io.planner import (CostInputs, RouteDecision, ScanPlan, ScanPlanner,
+                         choose_route, route_history)
+from .algebra.expr import And, Col, Expr, Not, Or, col
+from .parallel.host_scan import (scan, scan_expr, scan_filtered,
                                  scan_filtered_device, scan_filtered_sharded)
 from .parallel.mesh import ShardedTable, default_mesh, read_table_sharded
 from .algebra import (SortingColumn, SortingWriter, TableBuffer,

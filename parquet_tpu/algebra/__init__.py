@@ -1,6 +1,9 @@
-"""L4 row-group algebra: buffers, sorting, merging, conversion (SURVEY.md §1 L4)."""
+"""L4 row-group algebra: buffers, sorting, merging, conversion (SURVEY.md
+§1 L4) — plus the predicate-tree algebra the scan planner evaluates."""
 from .buffer import SortingColumn, TableBuffer, permute_column
 from .compare import compare_func_of, min_max, normalize, sort_key
 from .convert import can_convert, column_to_data, convert_table, convert_values
+from .expr import (FALSE, TRUE, And, Col, Const, Expr, Not, Or, Pred, col,
+                   prepare)
 from .merge import merge_files, merge_row_groups
 from .sorting import SortingWriter

@@ -45,7 +45,7 @@ import numpy as np
 from .errors import CorruptedError, DeadlineError
 from .io.faults import NON_DATA_ERRORS, FaultPolicy, ReadReport
 from .io.reader import ParquetFile, ReadOptions, Table
-from .io.search import PagePlan, plan_scan, prune_file
+from .io.search import prune_file
 from .utils.pool import map_in_order
 
 __all__ = ["Dataset", "expand_paths"]
@@ -351,24 +351,55 @@ class Dataset:
                 report.merge(sub)
 
     # --------------------------------------------------------------- scan
-    def prune(self, path: str, lo=None, hi=None,
+    def _prepare_where(self, path, lo, hi, values, where):
+        """One predicate tree from either calling convention, normalized
+        ONCE for the whole dataset (schemas are checked identical, so one
+        file's leaves type every file): IN-list probe sets normalize and
+        sort once, range bounds normalize once, and the planner's
+        bloom-hash memoization rides the shared prepared leaves across
+        every file instead of re-hashing per file."""
+        from .algebra.expr import prepare
+        from .io.search import _as_expr
+
+        expr = _as_expr(path, lo, hi, values, where)
+        fcols = sorted(expr.columns())
+        for i in range(len(self.paths)):
+            try:
+                pf = self.file(i)
+            except DeadlineError:
+                raise
+            except NON_DATA_ERRORS:
+                raise
+            except (CorruptedError, OSError):
+                # recorded by the per-file prune/scan loops that follow;
+                # keep looking for a parsable footer to prepare against
+                continue
+            return prepare(expr, pf.schema), fcols
+        return expr, fcols  # nothing opened: the per-file loops will raise
+
+    def prune(self, path: Optional[str] = None, lo=None, hi=None,
               values: Optional[Sequence] = None,
               policy: Optional[FaultPolicy] = None,
-              report: Optional[ReadReport] = None) -> List[str]:
+              report: Optional[ReadReport] = None,
+              where=None) -> List[str]:
         """Paths of files that may contain matching rows, by footer-level
-        min/max statistics only (:func:`~parquet_tpu.io.search.prune_file` —
-        no chunk bytes are touched).  Degraded ``policy``: an unopenable
-        file is recorded in ``report`` and excluded."""
+        min/max statistics only — the planner's stage-1 cascade
+        (:func:`~parquet_tpu.io.search.prune_file`; no chunk bytes are
+        touched).  ``where`` takes a predicate tree
+        (:mod:`parquet_tpu.algebra.expr`) spanning any number of columns.
+        Degraded ``policy``: an unopenable file is recorded in ``report``
+        and excluded."""
         pol, report, skip = self._resolve(policy, report)
-        keep, _ = self._prune_indices(path, lo, hi, values, skip, report)
+        expr, _ = self._prepare_where(path, lo, hi, values, where)
+        keep, _ = self._prune_indices(expr, skip, report)
         return [self.paths[i] for i in keep]
 
-    def _prune_indices(self, path, lo, hi, values, skip, report):
+    def _prune_indices(self, expr, skip, report):
         def check(i):
             try:
                 pf = self.file(i)
                 self._check_schema(pf, self.paths[i])
-                return prune_file(pf, path, lo=lo, hi=hi, values=values)
+                return prune_file(pf, where=expr)
             except DeadlineError:
                 raise
             except NON_DATA_ERRORS:
@@ -389,31 +420,42 @@ class Dataset:
                     report.record_file_skip(self.paths[i], rows=0, error=r)
         return keep, skipped
 
-    def plan(self, path: str, lo=None, hi=None, use_bloom: bool = False,
-             values: Optional[Sequence] = None) -> Dict[str, List[PagePlan]]:
+    def plan(self, path: Optional[str] = None, lo=None, hi=None,
+             use_bloom: bool = False,
+             values: Optional[Sequence] = None, where=None):
         """Two-level pushdown plan: footer statistics prune whole files,
-        then :func:`~parquet_tpu.io.search.plan_scan` plans the surviving
-        pages per file.  Returns ``{path: [PagePlan, ...]}`` for files with
-        at least one surviving page."""
-        keep, _ = self._prune_indices(path, lo, hi, values, False, None)
-        out: Dict[str, List[PagePlan]] = {}
+        then the scan planner plans the surviving pages per file.  With
+        the single-column form, returns ``{path: [PagePlan, ...]}`` (the
+        historical shape); with ``where=`` (a predicate tree), returns
+        ``{path: ScanPlan}`` — each with per-row-group decisions, cascade
+        counters, and ``.explain()``."""
+        from .io.planner import ScanPlanner
+
+        expr, _ = self._prepare_where(path, lo, hi, values, where)
+        keep, _ = self._prune_indices(expr, False, None)
+        out = {}
         for i in keep:
-            plans = plan_scan(self.file(i), path, lo=lo, hi=hi,
-                              use_bloom=use_bloom, values=values)
-            if plans:
-                out[self.paths[i]] = plans
+            plan = ScanPlanner(self.file(i)).plan(expr, use_bloom=use_bloom)
+            if where is None:
+                plans = plan.page_plans()
+                if plans:
+                    out[self.paths[i]] = plans
+            elif plan.survivors:
+                out[self.paths[i]] = plan
         return out
 
-    def scan(self, path: str, lo=None, hi=None,
+    def scan(self, path: Optional[str] = None, lo=None, hi=None,
              columns: Optional[Sequence[str]] = None,
              use_bloom: bool = True,
              values: Optional[Sequence] = None,
              policy: Optional[FaultPolicy] = None,
-             report: Optional[ReadReport] = None) -> Dict[str, object]:
-        """Predicate-pushdown scan over the whole dataset: files are pruned
-        by footer statistics first, survivors scan in parallel on the
-        shared pool (each via
-        :func:`~parquet_tpu.parallel.host_scan.scan_filtered`), and results
+             report: Optional[ReadReport] = None,
+             where=None) -> Dict[str, object]:
+        """Predicate-pushdown scan over the whole dataset: the predicate —
+        single-column ``path``/``lo``/``hi``/``values`` or a ``where=``
+        tree — is prepared ONCE, files are pruned by footer statistics
+        first, survivors scan in parallel on the shared pool (each via
+        :func:`~parquet_tpu.parallel.host_scan.scan_expr`), and results
         merge in file order — same output forms as ``scan_filtered``, same
         deterministic order as a serial per-file loop.  Degraded
         ``policy``: unopenable files, files that fail mid-scan, and corrupt
@@ -424,12 +466,19 @@ class Dataset:
             raise ValueError("scan on an empty dataset shard (no schema to "
                              "type empty results by); check num_files first")
         pol, report, skip = self._resolve(policy, report)
-        keep, skipped = self._prune_indices(path, lo, hi, values, skip,
-                                            report)
+        expr, fcols = self._prepare_where(path, lo, hi, values, where)
+        keep, skipped = self._prune_indices(expr, skip, report)
         pfs = [self.file(i) for i in keep]
         if pfs:
-            got = scan_files(pfs, path, lo=lo, hi=hi, columns=columns,
-                             use_bloom=use_bloom, values=values, policy=pol,
+            # the default output selection is pinned here (not per file):
+            # a never-matching predicate folds to a constant and would
+            # otherwise change which columns the per-file scans return
+            flat0 = {l.dotted_path for l in pfs[0].schema.leaves
+                     if l.max_repetition_level == 0}
+            eff_cols = (list(columns) if columns is not None
+                        else sorted(flat0 - set(fcols)))
+            got = scan_files(pfs, where=expr, columns=eff_cols,
+                             use_bloom=use_bloom, policy=pol,
                              report=report, skip_files=skip)
             if got:
                 return got
@@ -449,7 +498,7 @@ class Dataset:
         flat = {l.dotted_path for l in pf0.schema.leaves
                 if l.max_repetition_level == 0}
         out_cols = (list(columns) if columns is not None
-                    else sorted(flat - {path}))
+                    else sorted(flat - set(fcols)))
         empty: Dict[str, object] = {}
         for c in out_cols:
             # same validation scan_filtered applies: a bad selection must

@@ -359,6 +359,18 @@ def build_split_block_filter(leaf: Leaf, data, dict_values, dict_offsets,
     return filt.to_bytes()
 
 
+def bloom_may_contain(bf: SplitBlockFilter, value, leaf: Leaf) -> bool:
+    """Conservative single-probe consult: False only when the filter
+    PROVES the value absent.  Probes not encodable in the column's domain
+    (wrong type, out of range) are inconclusive and answer True — the one
+    guard shared by row-group pruning (io/search.py) and the scan
+    planner's bloom stage (io/planner.py)."""
+    try:
+        return bool(bf.check(value, leaf))
+    except (TypeError, ValueError, OverflowError):
+        return True
+
+
 def read_bloom_filter(reader) -> Optional[SplitBlockFilter]:
     """Reader side: ``ColumnChunk.BloomFilter()`` analog (lazy, like the
     reference's SkipBloomFilters default here — loaded on first call)."""

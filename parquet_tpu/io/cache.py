@@ -239,6 +239,14 @@ class ChunkCache:
         self._bytes = 0
         self.stats = stats
 
+    def contains(self, key) -> bool:
+        """Membership probe that moves no counters and no LRU position —
+        the streamed read path asks "is this whole row group resident?"
+        before committing to serve it from the cache (a miss there must
+        not count: the group will stream and be counted on its own)."""
+        with self._lock:
+            return key in self._entries
+
     def get(self, key) -> Optional[Any]:
         with self._lock:
             got = self._entries.get(key)

@@ -829,26 +829,11 @@ class ParquetFile:
             from .stream import _iter_batches_impl
 
             paths = list(dict.fromkeys(leaf.dotted_path for leaf in leaves))
-            parts: Dict[str, List[Column]] = {p: [] for p in paths}
-            got_rows = 0
-            read_stats = None
-            for batch in _iter_batches_impl(self, paths, 1 << 20,
-                                            strict_batch_rows=False,
-                                            skip=False, report=None):
-                bp = batch._parts if batch._parts is not None else {
-                    p: [c] for p, c in batch._columns.items()}
-                for p in paths:
-                    parts[p].extend(bp[p])
-                got_rows += batch.num_rows
-                read_stats = batch.read_stats
-            if got_rows == total_rows:
-                t = Table(self.schema, None, total_rows, parts=parts,
-                          dict_fields=self.arrow_dictionary_fields)
-                t.read_stats = read_stats
-                return t
-            # row count surprise (footer vs row-group metadata): release
-            # the streamed copy, then let the chunk path report precisely
-            del parts
+            got = self._read_streamed(paths, total_rows)
+            if got is not None:
+                return got
+            # row count surprise (footer vs row-group metadata): fall
+            # through and let the chunk path report precisely
         # fan the (leaf, row-group) chunks across the shared pool — the
         # reference's read path is goroutine-parallel by design (SURVEY.md
         # §2.5a caller-driven fan-out); decompress/decode release the GIL in
@@ -887,6 +872,83 @@ class ParquetFile:
                      for leaf, per_leaf in zip(leaves, chunks)}
         return Table(self.schema, None, total_rows, parts=parts,
                      dict_fields=self.arrow_dictionary_fields)
+
+    def _read_streamed(self, paths, total_rows) -> Optional["Table"]:
+        """Whole-file read over the streaming cursors (the >256 MB route),
+        at per-ROW-GROUP decoded-chunk cache granularity: row groups whose
+        every selected column is resident in the shared LRU (io/cache.py)
+        are served from it without touching their bytes; only the rest
+        stream, and each streamed group's columns are offered back to the
+        cache (when they fit under the per-item cap) — a warm re-read of a
+        file too big to cache wholesale pays only for what the LRU
+        evicted.  When the file is cache-eligible, streamed pieces are
+        frozen like every other cached-path read result, so a mixed
+        cached/streamed table has one mutability contract.  Returns None
+        on a footer-vs-row-group row count mismatch (the caller's chunk
+        path reports precisely)."""
+        from .cache import (CHUNKS, chunk_cache_bytes, column_nbytes,
+                            freeze_column)
+        from .column import concat_columns
+        from .stream import _iter_batches_impl
+
+        n_rg = len(self.row_groups)
+        cap = chunk_cache_bytes()
+        cacheable = self._cache_key is not None and cap > 0
+
+        def ck(i, p):
+            return (self._cache_key, i, p, self.options.verify_crc)
+
+        parts_by_rg: Dict[int, Dict[str, List[Column]]] = {}
+        if cacheable:
+            for i in range(n_rg):
+                if not all(CHUNKS.contains(ck(i, p)) for p in paths):
+                    continue
+                got = {p: CHUNKS.get(ck(i, p)) for p in paths}
+                if all(c is not None for c in got.values()):  # eviction race
+                    parts_by_rg[i] = {p: [c] for p, c in got.items()}
+        served = set(parts_by_rg)
+        stream_rgs = [i for i in range(n_rg) if i not in served]
+
+        def rg_done(rg_index, cols):
+            parts_by_rg[rg_index] = {
+                p: ([freeze_column(c) for c in cs] if cacheable else list(cs))
+                for p, cs in cols.items()}
+            if not cacheable:
+                return
+            rg = self.row_group(rg_index)
+            for p, cs in cols.items():
+                if not cs:
+                    continue
+                est = rg.column(p).meta.total_uncompressed_size or 0
+                if est > cap // 2:
+                    continue  # the concat is a copy: only pay it for
+                    # chunks the cache would accept (put re-checks exactly)
+                try:
+                    whole = concat_columns(list(cs))
+                except Exception:
+                    continue  # exotic part mix: population is best-effort
+                if column_nbytes(whole) <= cap // 2:
+                    CHUNKS.put_and_freeze(ck(rg_index, p), whole)
+
+        got_rows = sum(self.row_groups[i].num_rows for i in served)
+        read_stats = None
+        for batch in _iter_batches_impl(self, paths, 1 << 20,
+                                        strict_batch_rows=False,
+                                        skip=False, report=None,
+                                        row_groups=stream_rgs,
+                                        rg_done=rg_done):
+            got_rows += batch.num_rows
+            read_stats = batch.read_stats
+        if got_rows != total_rows:
+            return None  # release the streamed copy; chunk path reports
+        parts: Dict[str, List[Column]] = {p: [] for p in paths}
+        for i in range(n_rg):
+            for p, cs in parts_by_rg.get(i, {}).items():
+                parts[p].extend(cs)
+        t = Table(self.schema, None, total_rows, parts=parts,
+                  dict_fields=self.arrow_dictionary_fields)
+        t.read_stats = read_stats
+        return t
 
     def _read_degraded(self, leaves, rg_sel, report: ReadReport) -> "Table":
         """``on_corrupt='skip_row_group'`` host read: decode row-group-major

@@ -99,60 +99,60 @@ def prune_row_group(rg: RowGroupReader, path, lo=None, hi=None,
 
     Chunk-level pruning: Statistics first, optionally the bloom filter for
     equality probes (SURVEY.md §3.3 last line)."""
+    from .bloom import bloom_may_contain
+    from .statistics import may_contain_range
+
     chunk = rg.column(path)
     lo, hi = normalize(chunk.leaf, lo), normalize(chunk.leaf, hi)
     equals = normalize(chunk.leaf, equals)
     st = chunk.statistics()
-    if st is not None and st.min_value is not None and st.max_value is not None:
-        try:
-            if lo is not None and st.max_value < lo:
-                return False
-            if hi is not None and st.min_value > hi:
-                return False
-            if equals is not None and not (st.min_value <= equals <= st.max_value):
-                return False
-        except TypeError:
-            # Probe not comparable with the decoded stats domain (e.g. raw
-            # bytes against a DECIMAL column): stats are inconclusive — fall
-            # through to the bloom filter, which hashes raw probe bytes.
-            pass
+    if not may_contain_range(st, lo, hi):
+        return False
+    if equals is not None and not may_contain_range(st, equals, equals):
+        return False
     if use_bloom and equals is not None:
         bf = chunk.bloom_filter()
-        if bf is not None:
-            try:
-                if not bf.check(equals, chunk.leaf):
-                    return False
-            except (TypeError, ValueError, OverflowError):
-                pass  # probe not encodable in the column's domain
+        if bf is not None and not bloom_may_contain(bf, equals, chunk.leaf):
+            return False
     return True
 
 
-def prune_file(pf: ParquetFile, path, lo=None, hi=None,
-               values: Optional[Sequence] = None) -> bool:
+def prune_file(pf: ParquetFile, path=None, lo=None, hi=None,
+               values: Optional[Sequence] = None, where=None) -> bool:
     """True if ANY row group of the file may contain matching rows —
     footer-level pruning for the dataset layer: chunk statistics live in
     the (already parsed, possibly footer-cached) metadata, so a whole file
     is ruled out without touching chunk bytes or issuing any IO.  Bloom
     filters are deliberately not consulted here (they cost preads; the
-    per-file :func:`plan_scan` probes them for survivors)."""
-    leaf = pf.schema.leaf(path) if not hasattr(path, "column_index") else path
-    sorted_vals = None
-    if values is not None:
-        if lo is not None or hi is not None:
-            raise ValueError("pass either a range (lo/hi) or values, not both")
-        from ..algebra.compare import normalize_probe
+    per-file :func:`plan_scan` probes them for survivors).
 
-        probes = {normalize_probe(leaf, v) for v in values}
-        sorted_vals = sorted(probes - {None})
-        if not sorted_vals:
-            return False
-    for rg in pf.row_groups:
-        if sorted_vals is not None:
-            if prune_row_group_values(rg, leaf.column_index, sorted_vals):
-                return True
-        elif prune_row_group(rg, leaf.column_index, lo, hi):
-            return True
-    return False
+    One implementation for every stats-level prune: this is the planner's
+    stage-1 cascade (``ScanPlanner.plan(..., stages=("stats",))``), the
+    same code ``Dataset.prune`` and the full scan plan run — file- and
+    row-group-level pruning cannot drift.  ``where`` takes a predicate
+    tree (:mod:`parquet_tpu.algebra.expr`) instead of the single-column
+    ``path``/``lo``/``hi``/``values`` form."""
+    from .planner import ScanPlanner
+
+    expr = _as_expr(path, lo, hi, values, where)
+    return ScanPlanner(pf).any_match_stats(expr)
+
+
+def _as_expr(path, lo, hi, values, where):
+    """One predicate-tree input from either calling convention."""
+    from ..algebra.expr import single_pred
+
+    if where is not None:
+        if path is not None or lo is not None or hi is not None \
+                or values is not None:
+            raise ValueError("pass either where= (a predicate tree) or the "
+                             "single-column path/lo/hi/values form, not both")
+        return where
+    if path is None:
+        raise ValueError("need a predicate: where= or path (+ lo/hi/values)")
+    if hasattr(path, "column_index"):  # a schema Leaf
+        path = path.dotted_path
+    return single_pred(path, lo=lo, hi=hi, values=values)
 
 
 def _any_in_range(sorted_vals: List, lo, hi) -> bool:
@@ -221,81 +221,21 @@ def plan_scan(pf: ParquetFile, path, lo=None, hi=None,
     ``use_bloom`` every chunk filter is probed with the whole hashed batch at
     once (the batched-probe path of io/bloom.py).
 
-    Planning itself does IO (column-index / offset-index / bloom preads),
-    so it participates in the resilience contract: failures carry
-    file/row-group/column context, and under
+    This is the legacy single-column face of the unified scan planner
+    (io/planner.py): the predicate becomes a one-leaf tree, the planner
+    runs its cheapest-first cascade (statistics → page index → bloom), and
+    the surviving covering spans come back in the historical
+    :class:`PagePlan` form.  Planning itself does IO (column-index /
+    offset-index / bloom preads), so it participates in the resilience
+    contract: failures carry file/row-group/column context, and under
     ``policy.on_corrupt='skip_row_group'`` a row group whose index
     structures are corrupt is skipped (recorded in ``report`` with its full
     row count as candidate rows) instead of failing the whole scan."""
-    from ..errors import CorruptedError, DeadlineError
-    from .faults import read_context
+    from .planner import ScanPlanner
 
-    leaf = pf.schema.leaf(path) if not hasattr(path, "column_index") else path
-    plans: List[PagePlan] = []
-    sorted_vals = hashes = None
-    if values is not None:
-        if lo is not None or hi is not None:
-            raise ValueError("pass either a range (lo/hi) or values, not both")
-        from ..algebra.compare import normalize_probe
-
-        # unmatchable probes (out of range, fractional on int) drop here
-        probes = {normalize_probe(leaf, v) for v in values}
-        sorted_vals = sorted(probes - {None})
-        if not sorted_vals:
-            return []
-        if use_bloom:
-            from .bloom import hash_probe_values
-
-            try:
-                hashes = hash_probe_values(leaf, sorted_vals)
-            except ValueError:
-                hashes = None  # type has no bloom encoding (e.g. BOOLEAN)
-    equals = lo if lo is not None and lo == hi else None
-
-    def plan_one(rg) -> Optional[PagePlan]:
-        if sorted_vals is not None:
-            if not prune_row_group_values(rg, leaf.column_index, sorted_vals,
-                                          hashes):
-                return None
-        elif not prune_row_group(rg, leaf.column_index, lo, hi, use_bloom,
-                                 equals):
-            return None
-        chunk = rg.column(leaf.column_index)
-        ci = chunk.column_index()
-        oi = chunk.offset_index()
-        if ci is None or oi is None:
-            return PagePlan(rg.index,
-                            list(range(_npages(oi))) if oi else [],
-                            0, rg.num_rows)
-        ords = (pages_overlapping_values(ci, leaf, sorted_vals)
-                if sorted_vals is not None
-                else pages_overlapping(ci, leaf, lo, hi))
-        if not ords:
-            return None
-        locs = oi.page_locations
-        first_row = locs[ords[0]].first_row_index
-        last = ords[-1]
-        end_row = (locs[last + 1].first_row_index if last + 1 < len(locs)
-                   else rg.num_rows)
-        return PagePlan(rg.index, ords, first_row, end_row - first_row)
-
-    for rg in pf.row_groups:
-        try:
-            with read_context(path=pf._path, row_group=rg.index,
-                              column=leaf.dotted_path,
-                              kinds=(CorruptedError, OSError)):
-                plan = plan_one(rg)
-        except DeadlineError:
-            raise
-        except CorruptedError as e:
-            if policy is not None and policy.skip_corrupt:
-                if report is not None:
-                    report.record_skip(rg.index, rows=rg.num_rows, error=e)
-                continue
-            raise
-        if plan is not None:
-            plans.append(plan)
-    return plans
+    expr = _as_expr(path, lo, hi, values, None)
+    planner = ScanPlanner(pf, policy=policy, report=report)
+    return planner.plan(expr, use_bloom=use_bloom).page_plans()
 
 
 def _npages(oi) -> int:

@@ -51,6 +51,27 @@ def encode_stat_value(value, physical: Type) -> bytes:
     return bytes(value)
 
 
+def may_contain_range(st: Optional[TypedStatistics], lo=None,
+                      hi=None) -> bool:
+    """Conservative order-domain zone-map check: False only when the
+    statistics PROVE no value in ``[lo, hi]`` exists.  Missing statistics
+    and probes not comparable with the decoded stats domain (e.g. raw
+    bytes against a DECIMAL column) are inconclusive and answer True —
+    the one interval rule shared by row-group pruning (io/search.py) and
+    the scan planner's stats stage (io/planner.py), so the two can't
+    drift."""
+    if st is None or st.min_value is None or st.max_value is None:
+        return True
+    try:
+        if lo is not None and st.max_value < lo:
+            return False
+        if hi is not None and st.min_value > hi:
+            return False
+    except TypeError:
+        return True
+    return True
+
+
 def decode_statistics(stats: Optional[md.Statistics], leaf: Leaf
                       ) -> Optional[TypedStatistics]:
     if stats is None:

@@ -234,11 +234,20 @@ def _take_contextual(pf, cursor, path, rg_index, take):
 
 
 def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
-                       report) -> Iterator[Table]:
+                       report, row_groups=None,
+                       rg_done=None) -> Iterator[Table]:
+    """``row_groups`` restricts the drain to those row-group indices (in
+    the given order); ``rg_done(rg_index, {path: [Column, ...]})`` fires
+    after each row group fully streams (never for a skipped group) with
+    the column pieces that went into the yielded batches — the whole-file
+    streamed read uses it to populate the decoded-chunk cache at
+    row-group granularity."""
     from ..utils.pool import available_cpus, in_shared_pool
     from .prefetch import make_prefetcher
 
-    n_rg = len(pf.row_groups)
+    rg_sel = list(row_groups) if row_groups is not None \
+        else list(range(len(pf.row_groups)))
+    n_rg = len(rg_sel)
     # ---- layer 1: prefetching IO (io/prefetch.py).  One per drain; plans
     # are registered per row group, double-buffered: when row group N's
     # cursors are built, N+1's chunk ranges are planned too, so page decode
@@ -247,13 +256,13 @@ def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
     stats = pre.stats if pre is not None else None
     planned = -1
 
-    def plan_rg(i: int) -> None:
+    def plan_rg(pos: int) -> None:
         nonlocal planned
-        if pre is None or i >= n_rg or i <= planned:
+        if pre is None or pos >= n_rg or pos <= planned:
             return
-        planned = i
+        planned = pos
         for p in paths:
-            pre.plan(*pf.row_group(i).column(p).byte_range)
+            pre.plan(*pf.row_group(rg_sel[pos]).column(p).byte_range)
 
     # ---- layer 2: parallel streamed decode.  Per batch step, the
     # per-column takes (pread + decompress + decode — all GIL-releasing in
@@ -267,11 +276,12 @@ def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
                 and os.environ.get("PARQUET_TPU_STREAM_PARALLEL", "1")
                 not in ("0",))
 
-    rg_iter = iter(range(n_rg))
+    pos_iter = iter(range(n_rg))
     cursors: Optional[Dict[str, _ChunkCursor]] = None
     rg_rows_left = 0
     pending: Dict[str, List[Column]] = {p: [] for p in paths}
     pending_rows = 0
+    rg_parts: Dict[str, List[Column]] = {p: [] for p in paths}
 
     def flush() -> Table:
         nonlocal pending, pending_rows
@@ -314,19 +324,24 @@ def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
                                            take) for p in paths}
         for p in paths:
             pending[p].extend(results[p])
+            if rg_done is not None:
+                rg_parts[p].extend(results[p])
 
     try:
         while True:
             if rg_rows_left == 0:
-                rg_index = next(rg_iter, None)
-                if rg_index is None:
+                pos = next(pos_iter, None)
+                if pos is None:
                     break
+                rg_index = rg_sel[pos]
                 rg = pf.row_group(rg_index)
-                plan_rg(rg_index)
-                plan_rg(rg_index + 1)  # double buffer: readahead of N+1
+                plan_rg(pos)
+                plan_rg(pos + 1)  # double buffer: readahead of N+1
                 cursors = {p: _ChunkCursor(chunk=rg.column(p), source=pre)
                            for p in paths}
                 rg_rows_left = rg.num_rows
+                if rg_done is not None:
+                    rg_parts = {p: [] for p in paths}
             take = min(batch_rows - pending_rows, rg_rows_left)
             # snapshot so a mid-take corruption can roll back this step's
             # partial, column-misaligned contributions
@@ -354,6 +369,8 @@ def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
                 continue
             pending_rows += take
             rg_rows_left -= take
+            if rg_rows_left == 0 and rg_done is not None:
+                rg_done(rg_index, rg_parts)
             # Flush at row-group boundaries too (batches are "at most
             # batch_rows" — a snapped batch is legal and value-identical in
             # concatenation): a batch spanning row groups would pay a full

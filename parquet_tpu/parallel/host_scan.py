@@ -24,7 +24,7 @@ from ..io.faults import (FaultPolicy, ReadReport, read_context,
 from ..io.reader import ParquetFile
 from ..io.search import BA_ARRAYS, plan_scan, read_row_range
 
-__all__ = ["scan", "scan_filtered", "scan_filtered_device",
+__all__ = ["scan", "scan_expr", "scan_filtered", "scan_filtered_device",
            "scan_filtered_sharded", "scan_files", "merge_scan_results"]
 
 from ..utils.pool import (in_shared_pool as _in_pool,
@@ -59,21 +59,45 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
                   report: Optional[ReadReport] = None) -> Dict[str, np.ndarray]:
     """Scan ``columns`` for rows where ``lo <= file[path] <= hi`` — or, with
     ``values``, where ``file[path] ∈ values`` (IN-list pushdown: statistics,
-    zone maps and bloom filters all prune against the probe set; bloom
-    probes batch, routing to the device prober for large IN-lists).
+    zone maps and bloom filters all prune against the probe set).
 
-    Pushdown happens at three levels: row groups are pruned by chunk
-    statistics (and optionally bloom filters for point lookups), pages by
-    column-index zone maps, and finally the decoded key column is compared
-    exactly.  Only pages covering candidate rows are ever decompressed.
+    This is the single-column face of :func:`scan_expr`: the predicate
+    becomes a one-leaf tree and the unified planner (io/planner.py) runs
+    the pushdown cascade.  Output forms, null semantics, and the
+    resilience contract are documented there; this signature is kept
+    stable for existing callers."""
+    from ..algebra.expr import single_pred
 
-    Returns ``{column: values}`` with the predicate applied.  Rows where the
-    key is NULL never match (SQL comparison semantics).  Nullable numeric
-    output columns come back as ``np.ma.MaskedArray`` (mask=True at nulls);
-    BYTE_ARRAY columns as lists with ``None`` entries.  Flat columns only
-    (nested columns have no single row-aligned array to mask; read them via
-    :func:`read_row_range` per surviving span instead) — the default
-    selection takes every flat column.
+    return scan_expr(pf, single_pred(path, lo=lo, hi=hi, values=values),
+                     columns=columns, num_threads=num_threads,
+                     use_bloom=use_bloom, policy=policy, report=report)
+
+
+def scan_expr(pf: ParquetFile, where, columns: Optional[Sequence[str]] = None,
+              num_threads: Optional[int] = None, use_bloom: bool = True,
+              policy: Optional[FaultPolicy] = None,
+              report: Optional[ReadReport] = None) -> Dict[str, object]:
+    """Scan ``columns`` for rows matching a predicate tree ``where``
+    (:mod:`parquet_tpu.algebra.expr`): ``And``/``Or``/``Not`` over range,
+    IN-list, equality, and null-ness leaves across any number of columns.
+
+    The unified planner prunes cheapest-first — chunk statistics, then
+    page-index zone maps (intersected/unioned through the tree), then
+    bloom filters for equality leaves — and the scan then **late-
+    materializes**: only the filter columns' candidate pages decode first;
+    output columns decode only the pages covering rows that survived the
+    exact predicate, so a selective scan never touches most of its output
+    bytes.
+
+    Returns ``{column: values}`` with the predicate applied.  Rows where
+    any compared column is NULL fail that leaf (SQL three-valued
+    semantics; ``col(x).is_null()`` selects them).  Nullable numeric
+    output columns come back as ``np.ma.MaskedArray`` (mask=True at
+    nulls); BYTE_ARRAY columns as lists with ``None`` entries.  Flat
+    columns only (nested columns have no single row-aligned array to
+    mask; read them via :func:`read_row_range` per surviving span
+    instead) — the default selection takes every flat column not used in
+    the predicate.
 
     ``policy`` (default: the file's open-time policy) applies the
     resilience layer (io/faults.py): span reads retry transient errors,
@@ -84,9 +108,9 @@ def scan_filtered(pf: ParquetFile, path: str, lo=None, hi=None,
     file/row-group/column.
     """
     pol, report = resolve_policy(pf, policy, report)
-    with pf._resilient_op(policy, report, "scan_filtered"):
-        return _scan_filtered_impl(pf, path, lo, hi, columns, num_threads,
-                                   use_bloom, values, pol, report)
+    with pf._resilient_op(policy, report, "scan_expr"):
+        return _scan_expr_impl(pf, where, columns, num_threads, use_bloom,
+                               pol, report)
 
 
 class _SpanFailure:
@@ -99,180 +123,289 @@ class _SpanFailure:
         self.error = error
 
 
-def _scan_filtered_impl(pf, path, lo, hi, columns, num_threads, use_bloom,
-                        values, pol, report) -> Dict[str, np.ndarray]:
+def _expr_mask(expr, env: Dict[str, tuple], n: int) -> np.ndarray:
+    """Exact row mask of a prepared tree over one span's aligned filter
+    columns (``env[path] -> (values, validity)``)."""
+    from ..algebra.expr import And as _And, Const as _Const, Pred as _Pred
+
+    if isinstance(expr, _Const):
+        return np.full(n, expr.value, bool)
+    if isinstance(expr, _Pred):
+        return _pred_mask(expr, env[expr.path], n)
+    masks = [_expr_mask(c, env, n) for c in expr.children]
+    out = masks[0].copy()
+    for m in masks[1:]:
+        if isinstance(expr, _And):
+            out &= m
+        else:
+            out |= m
+    return out
+
+
+def _pred_mask(pred, span_val: tuple, n: int) -> np.ndarray:
+    """One leaf's exact mask, in the leaf's order domain — the same
+    comparison semantics the pruning cascade used (str → bytes, decimals
+    by unscaled int, unsigned keys in the unsigned view; NULL never
+    matches a range/IN leaf, negated or not)."""
+    from ..algebra.compare import decode_order_value, is_unsigned
+
+    keys, key_valid = span_val
+    leaf = pred.leaf
+    if pred.kind == "null":
+        return (np.zeros(n, bool) if key_valid is None
+                else ~np.asarray(key_valid, bool))
+    if pred.kind == "notnull":
+        return (np.ones(n, bool) if key_valid is None
+                else np.asarray(key_valid, bool))
+    lo, hi = pred.lo, pred.hi
+    flba_rows = (not isinstance(keys, list)
+                 and getattr(keys, "ndim", 1) == 2
+                 and keys.dtype == np.uint8)
+    if isinstance(keys, list) or flba_rows:
+        # BYTE_ARRAY / FLBA keys: Python comparisons in the order domain
+        # (decode_order_value handles decimal two's-complement ordering)
+        if flba_rows:
+            keys = [bytes(r) for r in np.asarray(keys)]
+            if key_valid is not None:
+                keys = [k if v else None for k, v in zip(keys, key_valid)]
+        keys = [None if x is None else decode_order_value(bytes(x), leaf)
+                for x in keys]
+        if pred.kind == "in":
+            probe_set = set(pred.values)
+            base = np.fromiter((x is not None and x in probe_set
+                                for x in keys), bool, count=len(keys))
+        else:
+            base = np.fromiter(
+                ((x is not None
+                  and (lo is None or x >= lo) and (hi is None or x <= hi))
+                 for x in keys), bool, count=len(keys))
+        if pred.negated:
+            present = np.fromiter((x is not None for x in keys), bool,
+                                  count=len(keys))
+            return present & ~base
+        return base
+    if is_unsigned(leaf) and keys.dtype in (np.dtype(np.int32),
+                                            np.dtype(np.int64)):
+        keys = keys.view(np.uint32 if keys.dtype == np.dtype(np.int32)
+                         else np.uint64)
+    if pred.kind == "in":
+        probes = np.array(pred.values, dtype=keys.dtype)
+        base = np.isin(keys, probes)
+    else:
+        base = np.ones(len(keys), bool)
+        if lo is not None:
+            base &= keys >= lo
+        if hi is not None:
+            base &= keys <= hi
+    valid = None if key_valid is None else np.asarray(key_valid, bool)
+    if pred.negated:
+        return ~base if valid is None else valid & ~base
+    if valid is not None:
+        base &= valid  # SQL semantics: NULL fails the predicate
+    return base
+
+
+_NESTED_MSG = ("column {c!r} is nested; scan_filtered returns row-aligned "
+               "arrays — use read_row_range per plan for nested columns")
+
+
+def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
+                    report) -> Dict[str, object]:
+    from ..algebra.expr import Expr, prepare
+    from ..io.planner import ScanPlanner, _collect_preds
+
+    if not isinstance(where, Expr):
+        raise TypeError("where must be an Expr tree (build with col(); "
+                        f"got {type(where).__name__})")
     leaves = {leaf.dotted_path for leaf in pf.schema.leaves}
     flat = {leaf.dotted_path for leaf in pf.schema.leaves
             if leaf.max_repetition_level == 0}
-    if path not in leaves:
-        raise KeyError(f"unknown predicate column {path!r}")
-    # default selection: every flat column (nested ones have no single
-    # row-aligned array to mask — read them via read_row_range per plan)
-    out_cols = list(columns) if columns is not None else sorted(flat - {path})
-    for c in [path] + out_cols:
+    want = sorted(where.columns())
+    for c in want:
+        if c not in leaves:
+            raise KeyError(f"unknown predicate column {c!r}")
+        if c not in flat:
+            raise ValueError(_NESTED_MSG.format(c=c))
+    # default selection: every flat column not in the predicate (nested
+    # ones have no single row-aligned array to mask — read them via
+    # read_row_range per plan)
+    out_cols = list(columns) if columns is not None else sorted(flat
+                                                                - set(want))
+    for c in out_cols:
         if c not in leaves:
             raise KeyError(f"unknown column {c!r}")
         if c not in flat:
-            raise ValueError(
-                f"column {c!r} is nested; scan_filtered returns row-aligned "
-                "arrays — use read_row_range per plan for nested columns")
+            raise ValueError(_NESTED_MSG.format(c=c))
 
-    plans = plan_scan(pf, path, lo=lo, hi=hi, use_bloom=use_bloom,
-                      values=values, policy=pol, report=report)
+    expr = prepare(where, pf.schema)
+    plan = ScanPlanner(pf, policy=pol, report=report).plan(
+        expr, use_bloom=use_bloom)
+    fcols = sorted({p.path for p in _collect_preds(expr)})
+
     rg_base = np.zeros(len(pf.row_groups), np.int64)
     np.cumsum([rg.num_rows for rg in pf.row_groups[:-1]], out=rg_base[1:])
-
-    # exact compare happens in the leaf's order domain, like the pruning
-    # above (str → bytes, unsigned keys in the unsigned view)
-    from ..algebra.compare import is_unsigned, normalize
-
-    key_leaf = pf.schema.leaf(path)
-    lo, hi = normalize(key_leaf, lo), normalize(key_leaf, hi)
-    key_unsigned = is_unsigned(key_leaf)
-    probe_set = None
-    if values is not None:
-        from ..algebra.compare import normalize_probe
-
-        probe_set = {normalize_probe(key_leaf, v) for v in values} - {None}
-
-    read_cols = [path] + [c for c in out_cols if c != path]
+    # surviving (row group, global row range) spans, in row order
+    spans = [(d.rg_index, int(rg_base[d.rg_index]) + s, e - s)
+             for d in plan.survivors for (s, e) in d.ranges]
+    rg_cand = {}
+    for rg_i, _, count in spans:
+        rg_cand[rg_i] = rg_cand.get(rg_i, 0) + count
 
     skip = pol is not None and pol.skip_corrupt
 
     def read_one(task):
-        plan, c = task
-        start = int(rg_base[plan.rg_index]) + plan.first_row
-        # output columns stay columnar ("arrays"): python bytes objects are
-        # materialized only for rows that survive the predicate below —
-        # per-row materialization of the full span was the scan's dominant
-        # cost on string output columns.  The key column keeps the
-        # materialized form (order-domain compares are per-value).
+        rg_i, start, count, c, form = task
         try:
-            with read_context(path=pf._path, row_group=plan.rg_index,
-                              column=c):
-                return read_row_range(pf, c, start, plan.row_count,
-                                      aligned=True if c == path else "arrays")
+            with read_context(path=pf._path, row_group=rg_i, column=c):
+                return read_row_range(pf, c, start, count, aligned=form)
         except DeadlineError:
             raise
         except CorruptedError as e:
             # captured per task (pool map would otherwise drop sibling
             # results on the floor); re-raised or skipped below
-            return _SpanFailure(plan.rg_index, e)
+            return _SpanFailure(rg_i, e)
 
-    tasks = [(p, c) for p in plans for c in read_cols]
-    # thread-pool dispatch costs ~100us/task: serial decode wins for small
-    # plans (measured crossover around a few hundred thousand cells).
-    # Inside a pool worker (the dataset layer's per-FILE fan-out) the scan
-    # stays serial: a nested _pool().map blocking on futures no free worker
-    # can run would deadlock the shared pool.
-    cells = sum(p.row_count for p in plans) * len(read_cols)
-    if num_threads == 1 or len(tasks) <= 1 or (num_threads is None
-                                               and (cells < 2_000_000
-                                                    or _in_pool())):
-        results = [read_one(t) for t in tasks]
-    elif num_threads is None:
-        # fan out per (span, column): the decode work releases the GIL in
-        # numpy/C++/codec calls, so even a single surviving span uses all
-        # requested columns' worth of parallelism.  mark_pooled keeps the
-        # per-worker native decompress split at 1 (no pool x native
-        # oversubscription).
-        results = list(_pool().map(_mark_pooled(read_one), tasks))
-    else:  # explicit bound: a dedicated pool honors the caller's limit
+    def fan_out(tasks, cells):
+        # thread-pool dispatch costs ~100us/task: serial decode wins for
+        # small plans (measured crossover around a few hundred thousand
+        # cells).  Inside a pool worker (the dataset layer's per-FILE
+        # fan-out) the scan stays serial: a nested _pool().map blocking on
+        # futures no free worker can run would deadlock the shared pool.
+        if num_threads == 1 or len(tasks) <= 1 or (num_threads is None
+                                                   and (cells < 2_000_000
+                                                        or _in_pool())):
+            return [read_one(t) for t in tasks]
+        if num_threads is None:
+            # fan out per (span, column): the decode work releases the GIL
+            # in numpy/C++/codec calls.  mark_pooled keeps the per-worker
+            # native decompress split at 1 (no pool x native
+            # oversubscription).
+            return list(_pool().map(_mark_pooled(read_one), tasks))
         with ThreadPoolExecutor(max_workers=num_threads) as pool:
-            results = list(pool.map(_mark_pooled(read_one), tasks))
-    failures = [r for r in results if isinstance(r, _SpanFailure)]
-    if failures:
+            return list(pool.map(_mark_pooled(read_one), tasks))
+
+    def drop_bad_rgs(failures):
+        """Degraded scan: drop every span of each corrupt row group (spans
+        are sub-row-group; partial groups would misalign filter vs output
+        columns), account the loss, keep scanning the rest."""
+        bad = {}
+        for f in failures:
+            bad.setdefault(f.rg_index, f.error)
         if not skip:
             raise failures[0].error
-        # degraded scan: drop every span of each corrupt row group (spans
-        # are sub-row-group; partial groups would misalign key vs output
-        # columns), keep scanning the rest
-        bad = {f.rg_index for f in failures}
-        first_err = {f.rg_index: f.error for f in reversed(failures)}
         for rg_i in sorted(bad):
-            report.record_skip(
-                rg_i, rows=sum(p.row_count for p in plans
-                               if p.rg_index == rg_i),
-                error=first_err[rg_i])
-        keep = [i for i, p in enumerate(plans) if p.rg_index not in bad]
-        results = [results[i * len(read_cols) + j] for i in keep
-                   for j in range(len(read_cols))]
-        plans = [plans[i] for i in keep]
-    spans = [{c: results[i * len(read_cols) + j] for j, c in enumerate(read_cols)}
-             for i in range(len(plans))]
+            report.record_skip(rg_i, rows=rg_cand.get(rg_i, 0),
+                               error=bad[rg_i])
+        return set(bad)
 
+    # ---- phase 1: decode only the FILTER columns' candidate pages and
+    # evaluate the exact predicate (aligned=True: order-domain compares
+    # are per-value)
+    cand_rows = sum(count for _, _, count in spans)
+    tasks1 = [(rg_i, start, count, c, True)
+              for (rg_i, start, count) in spans for c in fcols]
+    res1 = fan_out(tasks1, cand_rows * max(len(fcols), 1))
+    failures = [r for r in res1 if isinstance(r, _SpanFailure)]
+    if failures:
+        bad = drop_bad_rgs(failures)
+        keep = [i for i, s in enumerate(spans) if s[0] not in bad]
+        res1 = [res1[i * len(fcols) + j] for i in keep
+                for j in range(len(fcols))]
+        spans = [spans[i] for i in keep]
+    k = len(fcols)
+    envs = [{c: res1[i * k + j] for j, c in enumerate(fcols)}
+            for i in range(len(spans))]
+    masks = [_expr_mask(expr, env, count)
+             for (rg_i, start, count), env in zip(spans, envs)]
+
+    # ---- phase 2: late materialization — output columns decode only the
+    # pages covering rows that SURVIVED the exact predicate (the span is
+    # trimmed to [first survivor, last survivor]; a span with no survivors
+    # is never read).  Columns that also filter reuse the phase-1 decode.
+    trims = []
+    for mask in masks:
+        idx = np.flatnonzero(mask)
+        trims.append((int(idx[0]), int(idx[-1]) + 1) if len(idx) else None)
+    # output columns stay columnar ("arrays"): python bytes objects are
+    # materialized only for surviving rows — per-row materialization of
+    # the full span was the scan's dominant cost on string output columns
+    read2_cols = [c for c in out_cols if c not in set(fcols)]
+    tasks2 = [(spans[si][0], spans[si][1] + t0, t1 - t0, c, "arrays")
+              for si, trim in enumerate(trims) if trim is not None
+              for t0, t1 in [trim] for c in read2_cols]
+    cells2 = sum(t1 - t0 for t in trims if t is not None
+                 for t0, t1 in [t]) * max(len(read2_cols), 1)
+    res2 = fan_out(tasks2, cells2)
+    failures = [r for r in res2 if isinstance(r, _SpanFailure)]
+    if failures:
+        bad = drop_bad_rgs(failures)
+        # remove the corrupt row groups' phase-1 contributions too
+        res2_by_span = {}
+        ti = 0
+        for si, trim in enumerate(trims):
+            if trim is None:
+                continue
+            res2_by_span[si] = res2[ti:ti + len(read2_cols)]
+            ti += len(read2_cols)
+        keep = [i for i, s in enumerate(spans) if s[0] not in bad]
+        spans = [spans[i] for i in keep]
+        envs = [envs[i] for i in keep]
+        masks = [masks[i] for i in keep]
+        trims = [trims[i] for i in keep]
+        res2 = [r for i in keep if i in res2_by_span
+                for r in res2_by_span[i]]
+
+    # ---- assembly: identical output forms to the historical scan
     parts: Dict[str, List] = {c: [] for c in out_cols}
     vparts: Dict[str, List] = {c: [] for c in out_cols}
-    from ..algebra.compare import decode_order_value
-
-    for span in spans:
-        keys, key_valid = span[path]
-        flba_rows = (not isinstance(keys, list)
-                     and getattr(keys, "ndim", 1) == 2
-                     and keys.dtype == np.uint8)
-        if isinstance(keys, list) or flba_rows:
-            # BYTE_ARRAY / FLBA keys: Python comparisons in the order domain
-            # (decode_order_value handles decimal two's-complement ordering)
-            if flba_rows:
-                keys = [bytes(r) for r in np.asarray(keys)]
-                if key_valid is not None:
-                    keys = [k if v else None
-                            for k, v in zip(keys, key_valid)]
-            keys = [None if x is None
-                    else decode_order_value(bytes(x), key_leaf)
-                    for x in keys]
-            if probe_set is not None:
-                mask = np.fromiter((x is not None and x in probe_set
-                                    for x in keys), bool, count=len(keys))
-            else:
-                mask = np.fromiter(
-                    ((x is not None
-                      and (lo is None or x >= lo) and (hi is None or x <= hi))
-                     for x in keys), bool, count=len(keys))
-        else:
-            if key_unsigned and keys.dtype in (np.dtype(np.int32),
-                                               np.dtype(np.int64)):
-                keys = keys.view(np.uint32 if keys.dtype == np.dtype(np.int32)
-                                 else np.uint64)
-            if probe_set is not None:
-                probes = np.array(sorted(probe_set), dtype=keys.dtype)
-                mask = np.isin(keys, probes)
-            else:
-                mask = np.ones(len(keys), bool)
-                if lo is not None:
-                    mask &= keys >= lo
-                if hi is not None:
-                    mask &= keys <= hi
-            if key_valid is not None:  # SQL semantics: NULL fails the predicate
-                mask &= key_valid
+    ti = 0
+    for si, ((rg_i, start, count), mask, trim) in enumerate(
+            zip(spans, masks, trims)):
+        if trim is None:
+            continue  # no survivors: output pages never decoded
+        t0, t1 = trim
+        span2 = {c: res2[ti + j] for j, c in enumerate(read2_cols)}
+        ti += len(read2_cols)
+        idx = np.flatnonzero(mask)
+        m_t = mask[t0:t1]
         for c in out_cols:
-            vals, valid = span[c]
+            if c in envs[si]:
+                vals, valid = envs[si][c]  # phase-1 aligned=True form
+                if isinstance(vals, list):
+                    parts[c].append([vals[i] for i in idx])
+                else:
+                    parts[c].append(np.asarray(vals)[mask])
+                    if valid is not None:
+                        vparts[c].append(np.asarray(valid, bool)[mask])
+                    elif vparts[c]:  # earlier span had nulls: keep aligned
+                        vparts[c].append(np.ones(int(mask.sum()), bool))
+                continue
+            vals, valid = span2[c]
             if isinstance(vals, tuple) and vals and vals[0] == BA_ARRAYS:
                 _, v_u8, offs = vals
-                idx = np.flatnonzero(mask)
+                idx_t = np.flatnonzero(m_t)
                 if valid is None:
-                    parts[c].append(_materialize_ba(v_u8, offs, idx))
+                    parts[c].append(_materialize_ba(v_u8, offs, idx_t))
                 else:
-                    ords = np.cumsum(valid) - 1  # row -> dense value ordinal
-                    tv = np.asarray(valid, bool)[idx]
-                    got = _materialize_ba(v_u8, offs, ords[idx][tv])
-                    woven = [None] * len(idx)
+                    ords = np.cumsum(valid) - 1  # row -> dense ordinal
+                    tv = np.asarray(valid, bool)[idx_t]
+                    got = _materialize_ba(v_u8, offs, ords[idx_t][tv])
+                    woven = [None] * len(idx_t)
                     for p, v in zip(np.flatnonzero(tv), got):
                         woven[p] = v
                     parts[c].append(woven)
             elif isinstance(vals, list):
-                idx = np.flatnonzero(mask)
-                parts[c].append([vals[i] for i in idx])
+                parts[c].append([vals[i] for i in np.flatnonzero(m_t)])
             else:
-                parts[c].append(np.asarray(vals)[mask])
+                parts[c].append(np.asarray(vals)[m_t])
                 if valid is not None:
-                    vparts[c].append(valid[mask])
+                    vparts[c].append(np.asarray(valid, bool)[m_t])
                 elif vparts[c]:  # earlier span had nulls: keep alignment
-                    vparts[c].append(np.ones(int(mask.sum()), bool))
+                    vparts[c].append(np.ones(int(m_t.sum()), bool))
 
     from ..format.enums import Type
 
-    out: Dict[str, np.ndarray] = {}
+    out: Dict[str, object] = {}
     for c in out_cols:
         if parts[c] and isinstance(parts[c][0], list):
             out[c] = [v for chunk in parts[c] for v in chunk]
@@ -330,23 +463,27 @@ def merge_scan_results(parts: List[Dict[str, object]],
     return out
 
 
-def scan_files(pfs: Sequence[ParquetFile], path: str, lo=None, hi=None,
+def scan_files(pfs: Sequence[ParquetFile], path: Optional[str] = None,
+               lo=None, hi=None,
                columns: Optional[Sequence[str]] = None,
                use_bloom: bool = True,
                values: Optional[Sequence] = None,
                policy: Optional[FaultPolicy] = None,
                report: Optional[ReadReport] = None,
-               skip_files: bool = False) -> Dict[str, object]:
+               skip_files: bool = False, where=None) -> Dict[str, object]:
     """:func:`scan_filtered` across many already-opened files, fanned out on
     the shared pool (each file's scan runs serial inside its worker — the
     pool parallelism moves up a level) with results merged in file order.
-    Per-file row-group skips under a degraded ``policy`` are folded into
-    ``report``.  ``skip_files=True`` extends the degraded contract to whole
-    files: one whose scan fails outright (deleted mid-scan, footer fine but
-    chunks unreadable) drops as a unit, recorded with its full row count as
-    candidate rows — its partial row-group accounting is discarded so the
-    loss is not double-counted.  Returns ``{}`` when nothing (or no file)
-    survived.  Deadline overruns and environment errors always propagate."""
+    ``where`` takes a predicate tree (each file then scans via
+    :func:`scan_expr`; pass a PREPARED tree to normalize probe values once
+    for the whole fleet).  Per-file row-group skips under a degraded
+    ``policy`` are folded into ``report``.  ``skip_files=True`` extends
+    the degraded contract to whole files: one whose scan fails outright
+    (deleted mid-scan, footer fine but chunks unreadable) drops as a unit,
+    recorded with its full row count as candidate rows — its partial
+    row-group accounting is discarded so the loss is not double-counted.
+    Returns ``{}`` when nothing (or no file) survived.  Deadline overruns
+    and environment errors always propagate."""
     from ..io.faults import NON_DATA_ERRORS
     from ..utils.pool import map_in_order
 
@@ -355,15 +492,23 @@ def scan_files(pfs: Sequence[ParquetFile], path: str, lo=None, hi=None,
         # silent, unaccounted data loss — refuse up front
         raise ValueError("skip_files=True requires a report to account "
                          "the dropped files")
+    if (where is None) == (path is None):
+        raise ValueError("pass exactly one of path (+ lo/hi/values) or "
+                         "where= (a predicate tree)")
     if not pfs:
         return {}
 
     def one(pf):
         sub = ReadReport() if report is not None else None
         try:
-            got = scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
-                                use_bloom=use_bloom, values=values,
-                                policy=policy, report=sub)
+            if where is not None:
+                got = scan_expr(pf, where, columns=columns,
+                                use_bloom=use_bloom, policy=policy,
+                                report=sub)
+            else:
+                got = scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
+                                    use_bloom=use_bloom, values=values,
+                                    policy=policy, report=sub)
         except DeadlineError:
             raise
         except NON_DATA_ERRORS:
@@ -964,25 +1109,37 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
          values: Optional[Sequence] = None,
          policy: Optional[FaultPolicy] = None,
          report: Optional[ReadReport] = None):
-    """Pushdown scan, auto-routed per backend: on an accelerator the device
-    route runs (results stay resident in HBM, the fused span filter
-    amortizes across repeated scans); on the cpu backend the threaded host
-    route wins (measured 1.8-2.7x pyarrow vs the device route's emulated
-    kernels) and materialized host arrays are what callers want there.
-    Column shapes the device route refuses (nested or plain-string KEYS,
-    decimal byte-array keys) fall back to the host route on any backend.
+    """Pushdown scan, host-vs-device routed by the planner's COST MODEL
+    (:func:`parquet_tpu.io.planner.choose_route`): backend, static shape
+    support (the footer-level mirror of the device route's documented
+    refusals — checked up front, not by throwing), estimated bytes to
+    decode and stats-level selectivity from a zero-IO plan, and the
+    process-wide :class:`~parquet_tpu.io.planner.RouteHistory` of measured
+    per-route throughput.  On the cpu backend the threaded host route
+    always wins (measured 1.8-2.7x pyarrow vs the device route's emulated
+    kernels); ``PARQUET_TPU_ROUTE=host|device`` pins the choice.  The
+    documented-refusal fallback (``ValueError: ... use the host scan``)
+    is retained as a safety net for shapes only visible at page level
+    (e.g. a dictionary chunk that fell back to plain mid-file), but it is
+    no longer the router.
     NOTE the two routes' output forms differ (decoded_scan device forms
-    vs scan_filtered host arrays / byte lists); plain-string OUTPUT
-    columns ride the device route as host (values, offsets) survivor
-    pairs."""
+    vs scan_filtered host arrays / byte lists), and on accelerator
+    backends the chosen route — hence the result form — can change with
+    the plan's size and the measured history.  Callers that need ONE
+    stable form should call :func:`scan_filtered` /
+    :func:`scan_filtered_device` directly, or pin
+    ``PARQUET_TPU_ROUTE=host|device``.  Plain-string OUTPUT columns ride
+    the device route as host (values, offsets) survivor pairs."""
     import dataclasses
     import time
 
-    import jax
+    from ..io.planner import route_history, route_scan
 
     pol = policy if policy is not None else pf.policy
-    if jax.default_backend() != "cpu":
-        t0 = time.monotonic()
+    decision = route_scan(pf, path, lo=lo, hi=hi, columns=columns,
+                          values=values)
+    t0 = time.monotonic()
+    if decision.route == "device":
         # the device attempt works on a scratch report: a refusal fallback
         # discards its staging-phase skips (the host scan re-plans and
         # re-records them — the same report twice would double-count every
@@ -993,6 +1150,8 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
                                        columns=columns, use_bloom=use_bloom,
                                        values=values, policy=policy,
                                        report=scratch)
+            route_history().observe("device", decision.est_bytes,
+                                    time.monotonic() - t0)
             if report is not None:
                 report.merge(scratch)
             return got
@@ -1014,9 +1173,13 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
                     "deadline exceeded during scan (device attempt spent "
                     "the budget before falling back to the host scan)")
             policy = dataclasses.replace(pol, deadline_s=remaining)
-    return scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
-                         use_bloom=use_bloom, values=values, policy=policy,
-                         report=report)
+    t0 = time.monotonic()
+    got = scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
+                        use_bloom=use_bloom, values=values, policy=policy,
+                        num_threads=decision.pool_width, report=report)
+    route_history().observe("host", decision.est_bytes,
+                            time.monotonic() - t0)
+    return got
 
 
 def scan_filtered_device(pf: ParquetFile, path: str, lo=None, hi=None,

@@ -289,6 +289,54 @@ assert sorted(p for s in shards for p in s.paths) == sorted(paths)
 ds.close()
 print("dataset smoke ok: parity, pruning, warm caches, shards")
 DSEOF
+echo "=== planner smoke (explain sanity + cascade short-circuit) ==="
+python - <<'PLEOF'
+# The unified scan planner (ISSUE 6): a two-column predicate tree must
+# prune in cost order (stats -> page index -> bloom), short-circuit —
+# row groups killed by statistics are never bloom-probed or decoded —
+# and produce results byte-identical to a naive decode-then-mask.
+import io
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import ParquetFile, ScanPlanner, col, scan_expr
+from parquet_tpu.io.writer import WriterOptions, write_table
+
+n = 80_000
+rng = np.random.default_rng(11)
+t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+              "u": pa.array(rng.permutation(n).astype(np.int64)),
+              "s": pa.array([f"v{i % 101}" for i in range(n)])})
+buf = io.BytesIO()
+write_table(t, buf, WriterOptions(row_group_size=n // 8,
+                                  data_page_size=8 * 1024,
+                                  bloom_filters={"u": 10}))
+pf = ParquetFile(buf.getvalue())
+rg0_u = int(t.column("u")[n // 16].as_py())  # a value rg0 really holds
+expr = col("k").between(100, n // 8 - 200) & col("u").isin([rg0_u])
+plan = ScanPlanner(pf).plan(expr, use_bloom=True)
+c = plan.counters
+assert c["rg_pruned_stats"] == 7, c   # k is sorted: stats kill 7/8
+assert c["rg_survivors"] <= 1, c
+# cascade short-circuit: probes beyond stats ran AT MOST on the survivor
+assert c["page_probes"] <= 2 and c["bloom_probes"] <= 1, c
+txt = plan.explain()
+assert "pruned by stats" in txt and "probes:" in txt, txt
+assert "stats -> pages -> bloom" in txt, txt
+# byte-identity vs naive decode-then-mask
+k = t.column("k").to_numpy(); u = t.column("u").to_numpy()
+m = (k >= 100) & (k <= n // 8 - 200) & (u == rg0_u)
+got = scan_expr(pf, expr, columns=["s"])
+want = [t.column("s")[i].as_py().encode() for i in np.flatnonzero(m)]
+assert got["s"] == want, (len(got["s"]), len(want))
+# the OR branch unions candidates instead of intersecting them
+both = scan_expr(pf, col("k").between(0, 49) | col("k").between(n - 50, n),
+                 columns=["s"])
+assert len(both["s"]) == 100, len(both["s"])
+print(f"planner smoke ok: 7/8 row groups stats-pruned, "
+      f"{c['bloom_probes']} bloom probe(s), explain + byte-identity hold")
+PLEOF
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys
@@ -319,6 +367,13 @@ for name, cfg in detail.get('configs', {}).items():
         assert cfg.get('byte_identical') is True, (name, cfg)
         assert cfg.get('cache', {}).get('footer_hits', 0) > 0, (name, cfg)
         assert cfg.get('cache', {}).get('chunk_hits', 0) > 0, (name, cfg)
+    if name.startswith('9_'):
+        sw = cfg.get('sweep', {})
+        assert sw and all(v.get('byte_identical') for v in sw.values()), \
+            (name, sw)
+        assert sw.get('0.1%', {}).get('speedup', 0) >= 1.2, (name, sw)
+        assert sw.get('0.1%', {}).get('candidate_rows', 1 << 60) \
+            < sw.get('0.1%', {}).get('candidate_rows_baseline', 0), sw
 print('bench smoke ok:', d['metric'], d['value'], d['unit'])
 "
 echo "ALL CHECKS PASSED"

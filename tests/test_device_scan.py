@@ -351,8 +351,10 @@ def test_fused_span_filter_activates_and_matches_eager():
 
 
 def test_scan_auto_routes_by_backend(monkeypatch):
-    """parquet_tpu.scan picks the host route on cpu, the device route on
-    accelerators, and falls back to host for shapes the device refuses."""
+    """parquet_tpu.scan routes by the planner's cost model: host on cpu,
+    host for plans too small to amortize staging even on accelerators,
+    device when pinned (or when the cost model picks it), and falls back
+    to host for shapes the device refuses at page level."""
     import jax
 
     import parquet_tpu
@@ -371,6 +373,15 @@ def test_scan_auto_routes_by_backend(monkeypatch):
         return {"l_extendedprice": "device-result"}
 
     monkeypatch.setattr(hs, "scan_filtered_device", fake_device)
+    # tiny selective plan on a tpu backend: the cost model keeps it on the
+    # host route (staging would dominate) — the device is never touched
+    out_small = parquet_tpu.scan(pf, "l_shipdate", lo=9000, hi=9200,
+                                 columns=["l_extendedprice"])
+    assert "device" not in calls
+    np.testing.assert_allclose(np.sort(out_small["l_extendedprice"]),
+                               np.sort(host["l_extendedprice"]))
+    # pinned: the decision is the operator's
+    monkeypatch.setenv("PARQUET_TPU_ROUTE", "device")
     out = parquet_tpu.scan(pf, "l_shipdate", lo=9000, hi=9200,
                            columns=["l_extendedprice"])
     assert calls.get("device") and out["l_extendedprice"] == "device-result"
@@ -514,6 +525,8 @@ def test_scan_fallback_only_for_documented_refusals(monkeypatch):
 
     pf = _lineitem(n=4000)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # pin the route: the cost model would keep this small plan on host
+    monkeypatch.setenv("PARQUET_TPU_ROUTE", "device")
 
     def broken_device(pf_, path, **kw):
         raise ValueError("some internal device-scan bug")
