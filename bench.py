@@ -944,6 +944,7 @@ def main():
     tpu_ok = probe["ok"]
     import jax
     from parquet_tpu import native as _native
+    from parquet_tpu.obs import metrics_delta, metrics_snapshot
     from parquet_tpu.parallel.device_reader import _dense_mode
     _native.get_lib()  # pre-build the C++ shim so g++ time stays out of host_s
 
@@ -968,6 +969,7 @@ def main():
     def _run(name, fn, *a):
         _SPREADS.clear()
         t0 = time.time()
+        m0 = metrics_snapshot()
         if tpu_ok and cfg_timeout > 0:
             result = {}
 
@@ -1006,6 +1008,14 @@ def main():
             configs[name]["cal_ms"] = _calibrate_ms()[0]
             if _SPREADS:
                 configs[name]["rep_spread"] = round(max(_SPREADS), 2)
+            # what the unified telemetry registry saw DURING this config
+            # (counter deltas, histogram count/sum deltas): the perf
+            # trajectory carries cache hits, rgs pruned, pool waits, and
+            # route choices alongside the wall-clock numbers, so a rate
+            # regression in a future BENCH_*.json comes with its own
+            # explanation (e.g. chunk_hits collapsed, or pool_wait_s grew)
+            configs[name]["metrics_delta"] = metrics_delta(
+                m0, metrics_snapshot())
         print(f"bench: {name} done in {time.time() - t0:.1f}s",
               file=sys.stderr, flush=True)
         if ckpt:

@@ -60,6 +60,16 @@ Durability & integrity
   chaos), crash_consistency_check (crash matrix harness),
   verify_file/IntegrityReport/IntegrityIssue (end-to-end verification;
   ``python -m parquet_tpu verify``)
+Observability
+  metrics_snapshot/metrics_delta/reset_metrics (process-wide registry of
+  counters, gauges, and latency histograms with p50/p95/p99 — every
+  layer's accounting in one nested dict), render_prometheus +
+  ``python -m parquet_tpu stats [--json|--prom]`` (machine-scrapeable
+  export), trace_span/enable_tracing/disable_tracing/flush_trace (span
+  tracing to Chrome trace-event JSON, Perfetto-loadable;
+  ``PARQUET_TPU_TRACE=/path.json`` per process), pool_wait_seconds (the
+  shared-pool saturation meter the scan router feeds back into
+  ``RouteHistory``)
 """
 
 from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
@@ -98,6 +108,10 @@ from .rows import (Row, RowBuilder, Value, copy_rows, deconstruct, read_rows,
                    reconstruct, write_rows)
 from .utils.printer import print_file, print_pages, print_schema
 from .utils.debug import counters
+from . import obs
+from .obs import (disable_tracing, enable_tracing, flush_trace,
+                  metrics_delta, metrics_snapshot, pool_wait_seconds,
+                  render_prometheus, reset_metrics, trace_span)
 
 __version__ = "0.1.0"
 

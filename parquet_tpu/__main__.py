@@ -1,5 +1,5 @@
 """parquet-tools-style CLI:
-``python -m parquet_tpu [meta|schema|pages|head|verify]``.
+``python -m parquet_tpu [meta|schema|pages|head|verify|stats]``.
 
 Reference parity: the reference ships ``print.go`` (PrintSchema) as a
 library; this front end makes the same dumps reachable from a shell.
@@ -8,6 +8,16 @@ when EVERY file is provably clean — the operational check after an ingest
 or before trusting a checkpoint.  It accepts multiple paths and shell-style
 globs, verifying files in parallel on the shared pool with a per-file
 report line; any corrupt or unreadable file makes the exit code 1.
+
+``stats`` dumps the process-wide telemetry registry (parquet_tpu/obs):
+every counter, gauge, and latency histogram (p50/p95/p99), human-readable
+by default, ``--json`` for the :func:`parquet_tpu.metrics_snapshot` dict,
+``--prom`` for Prometheus exposition text.  With file arguments, the files
+are read (decoded through the full pipeline, in parallel on the shared
+pool) first, so the dump meters that work — a one-shot way to see cache /
+prefetch / planner counters for a real workload; without files it renders
+whatever this process has already recorded (the pre-declared core families
+exist at 0, so scrapers can always tell "nothing ran" from "not wired").
 """
 
 import argparse
@@ -17,14 +27,18 @@ import sys
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet_tpu")
     p.add_argument("command",
-                   choices=["meta", "schema", "pages", "head", "verify"],
+                   choices=["meta", "schema", "pages", "head", "verify",
+                            "stats"],
                    help="meta: file summary; schema: schema tree; pages: "
                         "page-level dump; head: first rows as JSON lines; "
                         "verify: end-to-end integrity check (exit 0 = every "
-                        "file clean, 1 = any corrupt)")
-    p.add_argument("file", nargs="+",
+                        "file clean, 1 = any corrupt); stats: dump the "
+                        "process-wide metrics registry (reads any given "
+                        "files first so the counters meter that work)")
+    p.add_argument("file", nargs="*",
                    help="parquet file path(s); verify accepts several and "
-                        "shell-style globs, checked in parallel")
+                        "shell-style globs, checked in parallel; stats "
+                        "accepts zero or more (globs ok) to read first")
     p.add_argument("--row-group", type=int, default=0,
                    help="pages: which row group")
     p.add_argument("--column", type=int, default=0,
@@ -34,8 +48,64 @@ def main(argv=None) -> int:
                    help="verify: additionally decode every column chunk "
                         "(slowest, strongest check)")
     p.add_argument("--json", action="store_true",
-                   help="verify: emit one IntegrityReport JSON per line")
-    args = p.parse_args(argv)
+                   help="verify: emit one IntegrityReport JSON per line; "
+                        "stats: emit the metrics_snapshot() dict as JSON")
+    p.add_argument("--prom", action="store_true",
+                   help="stats: emit Prometheus exposition text format")
+    # intermixed: `verify --json a b` and `stats --prom` must both parse
+    # now that `file` is optional (plain parse_args cannot place
+    # positionals after an optional once nargs="*" matched zero)
+    args = p.parse_intermixed_args(argv)
+
+    if args.command == "stats":
+        import json
+
+        from .obs import metrics_snapshot, render_prometheus
+
+        if args.file:
+            from .dataset import expand_paths
+            from .errors import CorruptedError
+            from .io.reader import ParquetFile
+            from .utils.pool import map_in_order
+
+            missing: list = []
+            files = expand_paths(args.file, missing=missing)
+            for item in missing:
+                print(f"parquet_tpu: {item}: no files match",
+                      file=sys.stderr)
+            if missing:
+                return 1
+
+            def meter(path):
+                # only the metering side effect is wanted: returning the
+                # Table would hold every decoded file in memory at once
+                ParquetFile(path).read()
+                return None
+
+            try:
+                for _ in map_in_order(meter, files):
+                    pass
+            except (OSError, ValueError, KeyError, CorruptedError) as e:
+                print(f"parquet_tpu: {e}", file=sys.stderr)
+                return 1
+        if args.prom:
+            sys.stdout.write(render_prometheus())
+        elif args.json:
+            print(json.dumps(metrics_snapshot(), sort_keys=True))
+        else:
+            snap = metrics_snapshot()
+            for kind in ("counters", "gauges"):
+                for k, v in sorted(snap[kind].items()):
+                    print(f"{k} {v}")
+            for k, h in sorted(snap["histograms"].items()):
+                print(f"{k} count={h['count']} sum={h['sum']} "
+                      f"p50={h['p50']} p95={h['p95']} p99={h['p99']}")
+        return 0
+
+    if not args.file:
+        print(f"parquet_tpu: {args.command} requires a file",
+              file=sys.stderr)
+        return 1
 
     if args.command == "verify":
         # never opens ParquetFile up front: a corrupt footer must yield a

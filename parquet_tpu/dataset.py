@@ -38,6 +38,7 @@ from __future__ import annotations
 import glob as _glob
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -46,7 +47,13 @@ from .errors import CorruptedError, DeadlineError
 from .io.faults import NON_DATA_ERRORS, FaultPolicy, ReadReport
 from .io.reader import ParquetFile, ReadOptions, Table
 from .io.search import prune_file
+from .obs.metrics import histogram as _ohistogram
 from .utils.pool import map_in_order
+
+# resolved once: per-operation observation must not take the registry's
+# get-or-create lock (only the metric's own)
+_M_READ_S = _ohistogram("dataset.read_s")
+_M_SCAN_S = _ohistogram("dataset.scan_s")
 
 __all__ = ["Dataset", "expand_paths"]
 
@@ -247,6 +254,17 @@ class Dataset:
         if not self.paths:
             raise ValueError("read on an empty dataset shard (no schema to "
                              "type an empty table by); check num_files first")
+        t0 = time.perf_counter()
+        try:
+            return self._read_all(columns, policy, report)
+        finally:
+            # whole-operation latency (per-FILE latencies land in
+            # read.file_s inside ParquetFile.read): metrics_snapshot()
+            # answers dataset read p50/p99 with no caller-side timing,
+            # failures included — the retry storm that dies IS the tail
+            _M_READ_S.observe(time.perf_counter() - t0)
+
+    def _read_all(self, columns, policy, report) -> Table:
         pol, report, skip = self._resolve(policy, report)
 
         def read_one(i):
@@ -460,11 +478,23 @@ class Dataset:
         deterministic order as a serial per-file loop.  Degraded
         ``policy``: unopenable files, files that fail mid-scan, and corrupt
         row groups all drop with the loss accounted in ``report``."""
-        from .parallel.host_scan import scan_files
-
         if not self.paths:
             raise ValueError("scan on an empty dataset shard (no schema to "
                              "type empty results by); check num_files first")
+        t0 = time.perf_counter()
+        try:
+            return self._scan_all(path, lo, hi, columns, use_bloom, values,
+                                  policy, report, where)
+        finally:
+            # whole-operation latency (per-file in dataset.scan_file_s via
+            # scan_files): the ROADMAP lookup-meter pre-work — p50/p99 per
+            # operation straight out of metrics_snapshot()
+            _M_SCAN_S.observe(time.perf_counter() - t0)
+
+    def _scan_all(self, path, lo, hi, columns, use_bloom, values,
+                  policy, report, where) -> Dict[str, object]:
+        from .parallel.host_scan import scan_files
+
         pol, report, skip = self._resolve(policy, report)
         expr, fcols = self._prepare_where(path, lo, hi, values, where)
         keep, skipped = self._prune_indices(expr, skip, report)

@@ -42,6 +42,9 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import gauge as _gauge
+
 __all__ = ["CacheStats", "FooterCache", "ChunkCache", "cache_stats",
            "clear_caches", "chunk_cache_bytes", "footer_cache_entries",
            "column_nbytes", "freeze_column", "invalidate_path",
@@ -49,6 +52,23 @@ __all__ = ["CacheStats", "FooterCache", "ChunkCache", "cache_stats",
 
 DEFAULT_CHUNK_CACHE_BYTES = 256 << 20
 DEFAULT_FOOTER_CACHE_ENTRIES = 256
+
+# registry mirrors (parquet_tpu/obs): CacheStats stays the per-process
+# dataclass VIEW (its API is unchanged and clear_caches(reset_stats=True)
+# still zeroes it); the registry counters below are the unified-telemetry
+# home the same increments publish into, so `stats --prom` and
+# metrics_snapshot() answer cache hit rates without importing this module
+_M_FOOTER_HITS = _counter("cache.footer_hits")
+_M_FOOTER_MISSES = _counter("cache.footer_misses")
+_M_CHUNK_HITS = _counter("cache.chunk_hits")
+_M_CHUNK_MISSES = _counter("cache.chunk_misses")
+_M_CHUNK_EVICTIONS = _counter("cache.chunk_evictions")
+_M_FOOTER_ENTRIES = _gauge("cache.footer_entries",
+                           help="footers resident in the cache")
+_M_CHUNK_ENTRIES = _gauge("cache.chunk_entries",
+                          help="decoded chunks resident in the LRU")
+_M_CHUNK_BYTES = _gauge("cache.chunk_bytes",
+                        help="decoded bytes resident in the LRU")
 
 
 def _env_size(name: str, default: int) -> int:
@@ -143,9 +163,11 @@ class FooterCache:
             got = self._entries.get(key)
             if got is None:
                 self.stats.footer_misses += 1
+                _M_FOOTER_MISSES.inc()
                 return None
             self._entries.move_to_end(key)
             self.stats.footer_hits += 1
+            _M_FOOTER_HITS.inc()
             return got
 
     def put(self, key, value) -> None:
@@ -158,11 +180,13 @@ class FooterCache:
             while len(self._entries) > cap:
                 self._entries.popitem(last=False)
             self.stats.footer_entries = len(self._entries)
+            _M_FOOTER_ENTRIES.set(len(self._entries))
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self.stats.footer_entries = 0
+            _M_FOOTER_ENTRIES.set(0)
 
 
 def freeze_column(col):
@@ -252,9 +276,11 @@ class ChunkCache:
             got = self._entries.get(key)
             if got is None:
                 self.stats.chunk_misses += 1
+                _M_CHUNK_MISSES.inc()
                 return None
             self._entries.move_to_end(key)
             self.stats.chunk_hits += 1
+            _M_CHUNK_HITS.inc()
             return _private_copy(got[0])
 
     def put_and_freeze(self, key, col) -> Optional[Any]:
@@ -278,9 +304,12 @@ class ChunkCache:
                 _, (_, evicted_nb) = self._entries.popitem(last=False)
                 self._bytes -= evicted_nb
                 self.stats.chunk_evictions += 1
+                _M_CHUNK_EVICTIONS.inc()
             self.stats.chunk_entries = len(self._entries)
             self.stats.chunk_bytes = self._bytes
             self.stats.chunk_capacity = cap
+            _M_CHUNK_ENTRIES.set(len(self._entries))
+            _M_CHUNK_BYTES.set(self._bytes)
         return _private_copy(frozen)
 
     def clear(self) -> None:
@@ -289,6 +318,8 @@ class ChunkCache:
             self._bytes = 0
             self.stats.chunk_entries = 0
             self.stats.chunk_bytes = 0
+            _M_CHUNK_ENTRIES.set(0)
+            _M_CHUNK_BYTES.set(0)
 
 
 _STATS = CacheStats()
@@ -309,12 +340,15 @@ def invalidate_path(path: str) -> None:
         for key in [k for k in FOOTERS._entries if k[0] == ap]:
             del FOOTERS._entries[key]
         FOOTERS.stats.footer_entries = len(FOOTERS._entries)
+        _M_FOOTER_ENTRIES.set(len(FOOTERS._entries))
     with CHUNKS._lock:
         for key in [k for k in CHUNKS._entries if k[0][0] == ap]:
             _, nb = CHUNKS._entries.pop(key)
             CHUNKS._bytes -= nb
         CHUNKS.stats.chunk_entries = len(CHUNKS._entries)
         CHUNKS.stats.chunk_bytes = CHUNKS._bytes
+        _M_CHUNK_ENTRIES.set(len(CHUNKS._entries))
+        _M_CHUNK_BYTES.set(CHUNKS._bytes)
 
 
 def cache_stats() -> CacheStats:

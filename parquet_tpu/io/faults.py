@@ -30,7 +30,15 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import CorruptedError, DeadlineError, ReadError, ReadIOError
+from ..obs.metrics import counter as _counter
 from .source import Source
+
+# resolved once: record/retry sites must not take the registry's
+# get-or-create lock (only the metric's own)
+_M_RETRIES = _counter("read.retries")
+_M_ROWS_DROPPED = _counter("read.rows_dropped")
+_M_RG_SKIPPED = _counter("read.row_groups_skipped")
+_M_FILES_SKIPPED = _counter("read.files_skipped")
 
 __all__ = ["FaultPolicy", "ReadReport", "Deadline", "PolicySource",
            "FaultInjectingSource", "read_context", "resolve_policy",
@@ -121,6 +129,14 @@ class ReadReport:
             self.path = path
         return self
 
+    # registry publish happens at the RECORD sites only — merge() folds
+    # sub-reports without re-recording, so totals stay exact.  A routing
+    # attempt's SCRATCH report sets this False: its skips are either
+    # discarded on fallback (the host scan re-records them) or published
+    # in one shot via publish_skips() when the attempt's result is kept —
+    # record-time publishing there would double-count the fallback case.
+    _publish = True
+
     def record_skip(self, rg_index: int, rows: int, error) -> None:
         # no dedup: every call site aggregates to one call per row group
         # per operation, and a report reused across files/shards must
@@ -128,6 +144,9 @@ class ReadReport:
         self.row_groups_skipped.append(rg_index)
         self.errors.append(str(error))
         self.rows_dropped += rows
+        if self._publish:
+            _M_RG_SKIPPED.inc()
+            _M_ROWS_DROPPED.inc(rows)
 
     def record_file_skip(self, path: str, rows: int, error) -> None:
         """One whole file dropped from a dataset-level degraded read.
@@ -136,6 +155,18 @@ class ReadReport:
         self.files_skipped.append(str(path))
         self.errors.append(str(error))
         self.rows_dropped += rows
+        if self._publish:
+            _M_FILES_SKIPPED.inc()
+            _M_ROWS_DROPPED.inc(rows)
+
+    def publish_skips(self) -> None:
+        """Publish this report's accumulated skip totals to the registry in
+        one shot — the non-publishing scratch path's counterpart of the
+        record-site increments, called exactly once when the attempt that
+        produced this report is adopted rather than discarded."""
+        _M_RG_SKIPPED.inc(len(self.row_groups_skipped))
+        _M_FILES_SKIPPED.inc(len(self.files_skipped))
+        _M_ROWS_DROPPED.inc(self.rows_dropped)
 
     def merge(self, other: "ReadReport") -> "ReadReport":
         """Fold another report's accounting into this one (aggregating
@@ -330,6 +361,7 @@ class PolicySource(Source):
                     self.retries_performed += 1
                     if dl is not None and id(dl) in self._op_retries:
                         self._op_retries[id(dl)] += 1
+                _M_RETRIES.inc()
                 if delay > 0:
                     time.sleep(delay)
 

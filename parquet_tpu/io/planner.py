@@ -41,10 +41,19 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..algebra.expr import And, Const, Expr, Or, Pred, prepare, single_pred
 from ..format.enums import Type
+from ..obs import trace as _trace
+from ..obs.metrics import counter as _mcounter
+from ..obs.metrics import gauge as _mgauge
 
 __all__ = ["ScanPlanner", "ScanPlan", "RowGroupDecision",
            "CostInputs", "RouteDecision", "RouteHistory", "choose_route",
            "device_route_supported", "route_history"]
+
+# plan-counter key -> registry counter name where they differ (the
+# Prometheus renderer appends _total to counters; publishing rg_total
+# verbatim would make the family parquet_tpu_planner_rg_total_total)
+_REGISTRY_KEY = {"rg_total": "rg_considered",
+                 "pages_total": "pages_considered"}
 
 # local row intervals: half-open (start, end)
 _Intervals = List[Tuple[int, int]]
@@ -312,31 +321,43 @@ class ScanPlanner:
         decisions: List[RowGroupDecision] = []
         ctx_col = ",".join(sorted({p.path for p in preds})) or None
         skip = self.policy is not None and self.policy.skip_corrupt
-        for rg in self.pf.row_groups:
-            d = RowGroupDecision(rg.index, rg.num_rows)
-            try:
-                with read_context(path=self.pf._path, row_group=rg.index,
-                                  column=ctx_col,
-                                  kinds=(CorruptedError, OSError)):
-                    self._plan_rg(rg, expr, d, counters, stages, single)
-            except DeadlineError:
-                raise
-            except CorruptedError as e:
-                if not skip:
+        plan_span = (_trace.span("planner.plan", file=self.pf._path,
+                                 stages=",".join(stages))
+                     if _trace.TRACE_ENABLED else _trace.NULL_SPAN)
+        with plan_span:  # `with`: a probe raising must still close the span
+            for rg in self.pf.row_groups:
+                d = RowGroupDecision(rg.index, rg.num_rows)
+                try:
+                    with read_context(path=self.pf._path, row_group=rg.index,
+                                      column=ctx_col,
+                                      kinds=(CorruptedError, OSError)):
+                        self._plan_rg(rg, expr, d, counters, stages, single)
+                except DeadlineError:
                     raise
-                if self.report is not None:
-                    self.report.record_skip(rg.index, rows=rg.num_rows,
-                                            error=e)
-                d.pruned_by = "corrupt"
-                d.killer = None
-                d.ranges = []
-            if d.pruned_by is None:
-                counters["rg_survivors"] += 1
-            elif d.pruned_by == "corrupt":
-                counters["rg_skipped_corrupt"] += 1
-            else:
-                counters[f"rg_pruned_{d.pruned_by}"] += 1
-            decisions.append(d)
+                except CorruptedError as e:
+                    if not skip:
+                        raise
+                    if self.report is not None:
+                        self.report.record_skip(rg.index, rows=rg.num_rows,
+                                                error=e)
+                    d.pruned_by = "corrupt"
+                    d.killer = None
+                    d.ranges = []
+                if d.pruned_by is None:
+                    counters["rg_survivors"] += 1
+                elif d.pruned_by == "corrupt":
+                    counters["rg_skipped_corrupt"] += 1
+                else:
+                    counters[f"rg_pruned_{d.pruned_by}"] += 1
+                decisions.append(d)
+        # publish the cascade's counters into the unified registry — the
+        # ScanPlan.counters dict stays the per-plan view, the registry
+        # accumulates process totals under planner.*.  The *_total plan
+        # keys rename to *_considered: Prometheus appends _total to
+        # counters and rg_total_total would trap every dashboard
+        for k, v in counters.items():
+            if v:
+                _mcounter("planner." + _REGISTRY_KEY.get(k, k)).inc(v)
         return ScanPlan(self.pf, expr, decisions, counters, stages)
 
     # ------------------------------------------------------------------
@@ -656,9 +677,11 @@ class RouteHistory:
         self._lock = threading.Lock()
         self._alpha = alpha
         self._gbps: Dict[str, float] = {}
+        self._wait_frac: Dict[str, float] = {}
         self._n: Dict[str, int] = {}
 
-    def observe(self, route: str, nbytes: int, seconds: float) -> None:
+    def observe(self, route: str, nbytes: int, seconds: float,
+                pool_wait_s: float = 0.0) -> None:
         # tiny scans are dominated by fixed per-call cost, not transfer/
         # decode rate: folding them in would drag the EWMA toward a
         # meaningless rate and misroute the LARGE scans the model exists
@@ -666,15 +689,42 @@ class RouteHistory:
         if seconds <= 0 or nbytes < _DEVICE_MIN_BYTES:
             return
         gbps = nbytes / seconds / 1e9
+        # pool saturation discounts the route's EFFECTIVE rate beyond its
+        # wall clock: a scan that spent 40% of its time queued behind
+        # other work on the shared pool already paid that wait in wall
+        # clock, but the congestion it observed predicts the next scan's
+        # — so gbps() scales the measured rate down by the waited
+        # fraction.  ReadStats.pool_wait_s (prefetch window stalls) and
+        # the pool's queue-wait meter both feed this (the
+        # obs.metrics.pool_wait_seconds delta the scan router passes).
+        # The delta is PROCESS-wide by design: concurrent scans see each
+        # other's waits, i.e. the discount measures ambient saturation
+        # during the scan, not this scan's own queueing — the clamp below
+        # and the EWMA keep a burst of cross-attributed waits from
+        # pinning the route at the floor.
+        wf = min(max(pool_wait_s, 0.0) / seconds, 0.95)
         with self._lock:
             cur = self._gbps.get(route)
             self._gbps[route] = gbps if cur is None else \
                 (1 - self._alpha) * cur + self._alpha * gbps
+            curw = self._wait_frac.get(route)
+            self._wait_frac[route] = wf if curw is None else \
+                (1 - self._alpha) * curw + self._alpha * wf
             self._n[route] = self._n.get(route, 0) + 1
+            eff = self._gbps[route] * (1.0 - self._wait_frac[route])
+        _mgauge("route.gbps", labels={"route": route},
+                help="EWMA effective GB/s per route").set(round(eff, 4))
+        _mcounter("route.observations", labels={"route": route}).inc()
 
     def gbps(self, route: str) -> Optional[float]:
+        """Effective EWMA GB/s: the measured wall-clock rate discounted by
+        the EWMA pool-wait fraction (0 when no waits were reported — the
+        historical behavior, byte-for-byte)."""
         with self._lock:
-            return self._gbps.get(route)
+            g = self._gbps.get(route)
+            if g is None:
+                return None
+            return g * (1.0 - self._wait_frac.get(route, 0.0))
 
     def observations(self, route: str) -> int:
         with self._lock:
@@ -683,6 +733,7 @@ class RouteHistory:
     def reset(self) -> None:
         with self._lock:
             self._gbps.clear()
+            self._wait_frac.clear()
             self._n.clear()
 
 
@@ -790,6 +841,7 @@ def route_scan(pf, path: str, lo=None, hi=None,
         reason = (f"PARQUET_TPU_ROUTE={pin} pin" if pin == "host"
                   else "cpu backend: threaded host scan beats emulated "
                   "device kernels")
+        _mcounter("route.chosen", labels={"route": "host"}).inc()
         return RouteDecision("host", reason)
     supported, reason = True, ""
     try:
@@ -816,6 +868,7 @@ def route_scan(pf, path: str, lo=None, hi=None,
         pin=pin)
     decision = choose_route(inp)
     decision.est_bytes = est_bytes
+    _mcounter("route.chosen", labels={"route": decision.route}).inc()
     return decision
 
 

@@ -45,6 +45,9 @@ from typing import List, Optional
 import numpy as np
 
 from ..errors import DeadlineError
+from ..obs import trace as _trace
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import histogram as _histogram
 from .source import MmapSource, Source
 
 __all__ = ["ReadStats", "PrefetchSource", "prefetch_mode", "make_prefetcher",
@@ -143,6 +146,11 @@ class _AutoTuneState:
 
 _AUTOTUNE = _AutoTuneState()
 
+# per-wait latency distribution (the bubble meter's shape, not just its
+# sum): p99 here is "how long does a consumer stall when readahead loses"
+_WAIT_HIST = _histogram("prefetch.wait_s",
+                        help="per-wait stall on an unfinished window")
+
 
 def prefetch_autotune() -> _AutoTuneState:
     """The process-wide auto-tune state (tests reset it between cases)."""
@@ -178,6 +186,17 @@ class ReadStats:
                 "bytes_prefetched": self.bytes_prefetched,
                 "bytes_discarded": self.bytes_discarded,
                 "pool_wait_s": round(self.pool_wait_s, 4)}
+
+    def publish(self) -> None:
+        """Fold this drain's totals into the process-wide metrics registry
+        (parquet_tpu/obs) — called once when the drain's prefetcher
+        closes, so registry counters never double-count a live drain."""
+        _counter("prefetch.hits").inc(self.prefetch_hits)
+        _counter("prefetch.misses").inc(self.prefetch_misses)
+        _counter("prefetch.windows_issued").inc(self.windows_issued)
+        _counter("prefetch.bytes_prefetched").inc(self.bytes_prefetched)
+        _counter("prefetch.bytes_discarded").inc(self.bytes_discarded)
+        _counter("prefetch.pool_wait_s").inc(self.pool_wait_s)
 
 
 class _Window:
@@ -378,6 +397,15 @@ class PrefetchSource(Source):
         FILLED slice — a short inner read yields a short slice, which the
         serving path detects (the chain-covered fast path requires every
         window full) so uninitialized segment bytes are never served."""
+        if _trace.TRACE_ENABLED:
+            # window fills run on pool workers: the span's thread id is
+            # what makes IO/decode overlap visible on the Perfetto tracks
+            with _trace.span("prefetch.window", offset=offset, bytes=size):
+                return self._fill_window_impl(seg, rel, offset, size)
+        return self._fill_window_impl(seg, rel, offset, size)
+
+    def _fill_window_impl(self, seg: np.ndarray, rel: int, offset: int,
+                          size: int) -> np.ndarray:
         data = self.inner.pread_view(offset, size)
         a = _as_u8(data)
         n = min(len(a), size)
@@ -437,6 +465,9 @@ class PrefetchSource(Source):
         if fut.done():
             return fut.result()
         t0 = time.perf_counter()
+        wait_span = (_trace.span("prefetch.wait", offset=win.offset)
+                     if _trace.TRACE_ENABLED else _trace.NULL_SPAN)
+        wait_span.__enter__()
         try:
             while True:
                 dl = self._deadline()
@@ -454,7 +485,9 @@ class PrefetchSource(Source):
                 except (_FutTimeout, TimeoutError):
                     continue
         finally:
+            wait_span.__exit__(None, None, None)
             waited = time.perf_counter() - t0
+            _WAIT_HIST.observe(waited)
             with self._lock:
                 self.stats.pool_wait_s += waited
 
@@ -559,6 +592,7 @@ class PrefetchSource(Source):
 
     def close(self) -> None:
         with self._lock:
+            first_close = not self._closed
             self._closed = True
             self._plans.clear()
             for w in self._ring:
@@ -569,6 +603,10 @@ class PrefetchSource(Source):
                         pass
                 self.stats.bytes_discarded += w.end - w.offset
             self._ring.clear()
+        if first_close:
+            # one publish per drain: the registry gets this prefetcher's
+            # lifetime totals exactly once (close() may be called again)
+            self.stats.publish()
         if self.backend == "ring" and self._tunable:
             # feed the drain's bubble meter back into the next drain's
             # readahead defaults (no-op when env pins or opt-out disabled)

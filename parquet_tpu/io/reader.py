@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
@@ -26,6 +27,12 @@ from ..format import enums, metadata as md, thrift
 from ..format.enums import Encoding, PageType, Type
 from ..ops import levels as levels_ops, ref
 from ..schema.schema import Leaf, Schema
+from ..obs import trace as _otrace
+from ..obs.metrics import histogram as _ohistogram
+
+# resolved once: per-read observation must not take the registry's
+# get-or-create lock (only the metric's own)
+_M_READ_FILE_S = _ohistogram("read.file_s")
 from ..utils.debug import counters, trace
 from .column import Column, concat_columns
 from .source import Source, as_source
@@ -514,6 +521,13 @@ class ParquetFile:
         counters.inc("files_opened")
 
     def _open_footer(self) -> None:
+        if _otrace.TRACE_ENABLED:
+            with _otrace.span("open.footer", file=self._path):
+                self._open_footer_impl()
+            return
+        self._open_footer_impl()
+
+    def _open_footer_impl(self) -> None:
         from .cache import FOOTERS
 
         if self._cache_key is not None:
@@ -633,8 +647,12 @@ class ParquetFile:
         path-backed files go through the shared bounded decoded-chunk LRU
         (io/cache.py): a hot file re-read serves the Column without
         touching chunk bytes."""
-        with read_context(path=self._path, row_group=chunk.rg_index,
-                          column=chunk.leaf.dotted_path):
+        dec_span = (_otrace.span("decode.chunk", rg=chunk.rg_index,
+                                 col=chunk.leaf.dotted_path)
+                    if _otrace.TRACE_ENABLED else _otrace.NULL_SPAN)
+        with dec_span, \
+                read_context(path=self._path, row_group=chunk.rg_index,
+                             column=chunk.leaf.dotted_path):
             from .cache import CHUNKS, freeze_column
 
             key = self._cache_key
@@ -743,13 +761,21 @@ class ParquetFile:
         dropped, skipped row-group ordinals, and retry counts.
         """
         pol, report = resolve_policy(self, policy, report)
-        if pol is not None or report is not None:
-            with self._resilient_op(policy, report):
-                t = self._read_impl(columns, device, row_groups, pol, report)
-            report.rows_read += t.num_rows
-            t.report = report
-            return t
-        return self._read_impl(columns, device, row_groups, None, None)
+        t0 = time.perf_counter()
+        try:
+            if pol is not None or report is not None:
+                with self._resilient_op(policy, report):
+                    t = self._read_impl(columns, device, row_groups, pol,
+                                        report)
+                report.rows_read += t.num_rows
+                t.report = report
+                return t
+            return self._read_impl(columns, device, row_groups, None, None)
+        finally:
+            # per-operation latency: metrics_snapshot() answers read p50/
+            # p99 without any caller-side timing (failures count too — a
+            # retry storm that dies at the deadline IS the tail)
+            _M_READ_FILE_S.observe(time.perf_counter() - t0)
 
     def _read_impl(self, columns, device, row_groups,
                    pol: Optional[FaultPolicy],

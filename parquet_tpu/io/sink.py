@@ -32,6 +32,8 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from ..errors import WriteError
+from ..obs import trace as _trace
+from ..obs.metrics import counter as _counter
 
 __all__ = ["Sink", "FileSink", "AtomicFileSink", "BufferedSink", "WriteStats",
            "fsync_dir", "write_buffer_bytes", "write_autotune",
@@ -96,6 +98,20 @@ class WriteStats:
                 "bytes_flushed": self.bytes_flushed,
                 "sink_flushes": self.sink_flushes,
                 "writev_flushes": self.writev_flushes}
+
+    def publish(self) -> None:
+        """Fold this writer's totals into the process-wide metrics
+        registry (parquet_tpu/obs) — called once per writer at successful
+        close, so registry counters never double-count a live write."""
+        _counter("write.row_groups").inc(self.row_groups)
+        _counter("write.overlapped_groups").inc(self.overlapped_groups)
+        _counter("write.encode_s").inc(self.encode_s)
+        _counter("write.emit_s").inc(self.emit_s)
+        _counter("write.pool_wait_s").inc(self.pool_wait_s)
+        _counter("write.bytes_buffered").inc(self.bytes_buffered)
+        _counter("write.bytes_flushed").inc(self.bytes_flushed)
+        _counter("write.sink_flushes").inc(self.sink_flushes)
+        _counter("write.writev_flushes").inc(self.writev_flushes)
 
 
 # write-side auto-tuner (the mirror of io/prefetch.py's depth/window tuner):
@@ -492,6 +508,14 @@ class BufferedSink(Sink):
     def _flush_buffer(self) -> None:
         if not self._parts:
             return
+        if _trace.TRACE_ENABLED:
+            with _trace.span("sink.flush", bytes=self._buffered,
+                             parts=len(self._parts)):
+                self._flush_buffer_impl()
+            return
+        self._flush_buffer_impl()
+
+    def _flush_buffer_impl(self) -> None:
         # hand the parts over before writing: a failed flush must not be
         # replayed (bytes may be partially down — the writer aborts on any
         # write error, and a retry would double-write the prefix)

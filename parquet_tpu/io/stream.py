@@ -30,6 +30,7 @@ import numpy as np
 
 from ..errors import CorruptedError, DeadlineError
 from ..format.enums import PageType
+from ..obs import trace as _trace
 from ..ops import levels as levels_ops
 from .column import Column
 from .faults import FaultPolicy, ReadReport, read_context, resolve_policy
@@ -223,8 +224,15 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
 
 def _take_contextual(pf, cursor, path, rg_index, take):
     """One column's take, wrapped in read_context so failures — on this
-    thread or a pool worker — surface as located ReadErrors."""
-    with read_context(path=pf._path, row_group=rg_index, column=path):
+    thread or a pool worker — surface as located ReadErrors.  The
+    ``decode.stream`` span carries the thread it decoded on: with the
+    pooled fan-out active, columns of one batch step show as parallel
+    bars on different worker tracks."""
+    dec_span = (_trace.span("decode.stream", rg=rg_index, col=path,
+                            rows=take)
+                if _trace.TRACE_ENABLED else _trace.NULL_SPAN)
+    with dec_span, \
+            read_context(path=pf._path, row_group=rg_index, column=path):
         pieces, got = cursor.take(take)
         if got != take:
             raise CorruptedError(

@@ -50,6 +50,7 @@ from ..format.enums import (CompressionCodec, ConvertedType, Encoding,
 from ..ops import levels as levels_ops, ref
 from ..schema import schema as sch
 from ..schema.schema import Leaf, Schema
+from ..obs import trace as _otrace
 from ..schema.types import LogicalKind
 
 DEFAULT_CREATED_BY = "parquet-tpu version 0.1.0"
@@ -380,9 +381,16 @@ class ParquetWriter:
                              num_rows)
 
     def _timed_encode(self, leaf: Leaf, data: ColumnData, num_rows: int):
-        t0 = time.perf_counter()
-        enc = self._encode_chunk(leaf, data, num_rows)
-        return enc, time.perf_counter() - t0
+        # the write.encode span runs on whatever thread encodes — pool
+        # worker under the overlap pipeline, caller thread serially — so
+        # encode/emit overlap shows as parallel bars on two tracks
+        enc_span = (_otrace.span("write.encode", col=leaf.dotted_path,
+                                 rows=num_rows)
+                    if _otrace.TRACE_ENABLED else _otrace.NULL_SPAN)
+        with enc_span:
+            t0 = time.perf_counter()
+            enc = self._encode_chunk(leaf, data, num_rows)
+            return enc, time.perf_counter() - t0
 
     def _timed_encode_iter(self, leaves, datas, num_rows):
         """Serial path: lazy per-chunk encode (consumed interleaved with
@@ -432,16 +440,20 @@ class ParquetWriter:
         rg_start = self._pos
         total_bytes = 0
         total_comp = 0
-        for enc in encs:
-            t0 = time.perf_counter()
-            chunk, ci, oi, bloom, ubytes, cbytes = self._emit_chunk(enc)
-            self.write_stats.emit_s += time.perf_counter() - t0
-            chunks.append(chunk)
-            cis.append(ci)
-            ois.append(oi)
-            blooms.append(bloom)
-            total_bytes += ubytes
-            total_comp += cbytes
+        emit_span = (_otrace.span("write.emit",
+                                  rg=len(self._row_groups), rows=num_rows)
+                     if _otrace.TRACE_ENABLED else _otrace.NULL_SPAN)
+        with emit_span:  # `with`: a failed emit must still record the span
+            for enc in encs:
+                t0 = time.perf_counter()
+                chunk, ci, oi, bloom, ubytes, cbytes = self._emit_chunk(enc)
+                self.write_stats.emit_s += time.perf_counter() - t0
+                chunks.append(chunk)
+                cis.append(ci)
+                ois.append(oi)
+                blooms.append(bloom)
+                total_bytes += ubytes
+                total_comp += cbytes
         sorting = [
             md.SortingColumn(
                 column_idx=self.schema.leaf(p).column_index,
@@ -796,6 +808,9 @@ class ParquetWriter:
                 self._f.abort()
             raise
         self._closed = True
+        # one publish per writer: the unified registry gets this write's
+        # totals exactly once, at the moment the bytes are committed
+        self.write_stats.publish()
         if getattr(self._f, "_tunable", False):
             # feed the flush rate back to the process-wide buffer tuner
             # (sink.py): the NEXT writer's writeback buffer grows when this

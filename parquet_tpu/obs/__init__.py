@@ -1,0 +1,33 @@
+"""Unified telemetry: the observability subsystem every layer reports
+through.
+
+- :mod:`parquet_tpu.obs.metrics` — process-wide registry of counters,
+  gauges, and fixed-bucket latency histograms (p50/p95/p99); the six
+  legacy per-operation stats dataclasses (``ReadStats``, ``WriteStats``,
+  ``CacheStats``, ``ReadReport``, planner counters, ``RouteHistory``)
+  keep their APIs and publish here too.
+- :mod:`parquet_tpu.obs.trace` — span tracing with a module-level bool
+  gate (near-zero overhead off) writing Chrome trace-event JSON for
+  Perfetto; ``PARQUET_TPU_TRACE=/path.json`` enables per process.
+- :mod:`parquet_tpu.obs.export` — Prometheus text-format rendering
+  (``python -m parquet_tpu stats --prom``).
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+                      counter, gauge, histogram, metrics_delta,
+                      metrics_snapshot, pool_wait_seconds, reset_metrics)
+# NOTE: the live gate is ``trace.TRACE_ENABLED`` on the MODULE —
+# instrumentation sites import the module and read the attribute each
+# time (a re-exported copy of the bool would go stale on enable/disable)
+from . import trace
+from .trace import (NULL_SPAN, disable_tracing, enable_tracing, enabled,
+                    flush_trace, reset_trace, span, trace_events,
+                    trace_span)
+from .export import render_prometheus
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "metrics_delta",
+           "metrics_snapshot", "pool_wait_seconds", "reset_metrics",
+           "NULL_SPAN", "trace", "disable_tracing", "enable_tracing",
+           "enabled", "flush_trace", "reset_trace", "span", "trace_events",
+           "trace_span", "render_prometheus"]

@@ -1,0 +1,90 @@
+"""Prometheus text-format rendering of the metrics registry.
+
+``python -m parquet_tpu stats --prom`` (and any embedding application
+that wants to serve a ``/metrics`` endpoint) renders through here.  The
+output follows the Prometheus exposition format 0.0.4:
+
+- metric names are ``parquet_tpu_`` + the registry name with dots
+  mapped to underscores; counters get the ``_total`` suffix;
+- one ``# HELP`` / ``# TYPE`` pair per family (label variants share it);
+- histograms render the standard cumulative ``_bucket{le="..."}`` series
+  plus ``_sum`` and ``_count``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, REGISTRY
+
+__all__ = ["render_prometheus"]
+
+_PREFIX = "parquet_tpu_"
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _PREFIX + _BAD_CHARS.sub("_", name.replace(".", "_"))
+
+
+def _prom_value(v) -> str:
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if math.isnan(v):
+            return "NaN"
+        return repr(v)
+    return str(v)
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(labels, extra=None) -> str:
+    parts = [f'{k}="{_esc(str(v))}"' for k, v in labels]
+    if extra:
+        parts.extend(f'{k}="{_esc(str(v))}"' for k, v in extra)
+    if not parts:
+        return ""
+    return "{" + ",".join(parts) + "}"
+
+
+def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """The whole registry in Prometheus exposition text format."""
+    reg = registry if registry is not None else REGISTRY
+    lines = []
+    seen_headers = set()
+
+    def header(fam: str, help_text: str, typ: str) -> None:
+        if fam in seen_headers:
+            return
+        seen_headers.add(fam)
+        lines.append(f"# HELP {fam} {help_text or fam}")
+        lines.append(f"# TYPE {fam} {typ}")
+
+    for m in reg.collect():
+        if isinstance(m, Counter):
+            fam = _prom_name(m.name) + "_total"
+            header(fam, m.help, "counter")
+            lines.append(f"{fam}{_label_str(m.labels)} "
+                         f"{_prom_value(m.value)}")
+        elif isinstance(m, Gauge):
+            fam = _prom_name(m.name)
+            header(fam, m.help, "gauge")
+            lines.append(f"{fam}{_label_str(m.labels)} "
+                         f"{_prom_value(m.value)}")
+        elif isinstance(m, Histogram):
+            fam = _prom_name(m.name)
+            header(fam, m.help, "histogram")
+            for le, cum in m.bucket_counts():
+                lines.append(
+                    f"{fam}_bucket"
+                    f"{_label_str(m.labels, [('le', _prom_value(float(le)))])}"
+                    f" {cum}")
+            lines.append(f"{fam}_sum{_label_str(m.labels)} "
+                         f"{_prom_value(m.sum)}")
+            lines.append(f"{fam}_count{_label_str(m.labels)} {m.count}")
+    return "\n".join(lines) + "\n"

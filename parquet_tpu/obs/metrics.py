@@ -1,0 +1,426 @@
+"""Process-wide metrics registry: counters, gauges, and fixed-bucket latency
+histograms with p50/p95/p99 — the one place every layer's accounting lands.
+
+Six PRs each grew a blind-spot-shaped stats object — ``ReadStats``
+(io/prefetch.py), ``WriteStats`` (io/sink.py), ``CacheStats`` (io/cache.py),
+``ReadReport`` (io/faults.py), and the planner's cascade counters +
+``RouteHistory`` (io/planner.py).  Those dataclasses remain the
+*per-operation* views (their Python-facing APIs are unchanged), but every
+one of them now also publishes into this registry, so cache hit rates,
+prefetch bubbles, pool waits, retry/skip counts, planner prune counts,
+route choices, and bytes in/out are all answerable from one snapshot:
+
+- :func:`metrics_snapshot` — nested dict of every metric (the programmatic
+  API; :func:`metrics_delta` diffs two snapshots to meter one operation).
+- ``python -m parquet_tpu stats [--json|--prom]`` — the CLI front end;
+  ``--prom`` renders Prometheus text format (obs/export.py).
+
+Design constraints (this registry sits on hot paths — per pool task, per
+prefetch window, per chunk decode):
+
+- **lock-cheap**: one small ``threading.Lock`` per metric, held for a
+  couple of arithmetic ops.  No global lock on the increment path; the
+  registry-level lock guards only get-or-create.
+- **shared-pool-safe**: increments from any number of pool workers account
+  exactly (the concurrency tests hammer one counter from 8 workers and
+  assert the exact total).
+- **allocation-free increments**: ``inc``/``observe`` touch no containers
+  beyond the preallocated bucket list.
+
+Histograms use fixed bucket edges (default: a log-spaced latency ladder
+from 10 µs to 60 s) and estimate percentiles by linear interpolation inside
+the covering bucket, clamped to the observed min/max — the standard
+fixed-bucket tradeoff (error bounded by bucket width, memory bounded by
+bucket count), same contract as a Prometheus histogram.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "metrics_snapshot",
+           "metrics_delta", "reset_metrics", "pool_wait_seconds",
+           "DEFAULT_LATENCY_BUCKETS"]
+
+# log-spaced 10 µs → 60 s: wide enough for a warm footer-cache hit and a
+# remote-mount retry storm on one ladder; +Inf overflow is implicit
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_key(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter (int or float increments)."""
+
+    __slots__ = ("name", "labels", "help", "_lock", "_value")
+
+    def __init__(self, name: str, labels=(), help: str = ""):
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (n={n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Point-in-time value (cache residency, capacities, measured rates)."""
+
+    __slots__ = ("name", "labels", "help", "_lock", "_value")
+
+    def __init__(self, name: str, labels=(), help: str = ""):
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile estimation.
+
+    ``observe(v)`` is the hot path: one bisect over the (immutable) edge
+    tuple, five arithmetic ops, all under the metric's own lock.  Bucket
+    counts are NON-cumulative internally; snapshots and the Prometheus
+    renderer derive the cumulative form."""
+
+    __slots__ = ("name", "labels", "help", "buckets", "_lock", "_counts",
+                 "_sum", "_count", "_min", "_max")
+
+    def __init__(self, name: str, labels=(), help: str = "",
+                 buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS):
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket edge")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # last = overflow
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+
+    def observe(self, v: float) -> None:
+        i = bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (q in [0, 1]): linear interpolation inside
+        the covering bucket, clamped to the observed [min, max] so a
+        one-sample histogram answers its own value, not a bucket edge."""
+        with self._lock:
+            return self._percentile_locked(q)
+
+    def _percentile_locked(self, q: float) -> Optional[float]:
+        if self._count == 0:
+            return None
+        target = q * self._count
+        cum = 0
+        for i, n in enumerate(self._counts):
+            if n == 0:
+                continue
+            if cum + n >= target:
+                lo = self.buckets[i - 1] if i > 0 else self._min
+                hi = self.buckets[i] if i < len(self.buckets) else self._max
+                frac = (target - cum) / n
+                est = lo + frac * (hi - lo)
+                return min(max(est, self._min), self._max)
+            cum += n
+        return self._max
+
+    def summary(self) -> dict:
+        with self._lock:
+            out = {"count": self._count, "sum": round(self._sum, 6),
+                   "min": self._min, "max": self._max,
+                   "p50": self._percentile_locked(0.50),
+                   "p95": self._percentile_locked(0.95),
+                   "p99": self._percentile_locked(0.99)}
+            for k in ("p50", "p95", "p99"):
+                if out[k] is not None:
+                    out[k] = round(out[k], 6)
+            return out
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """CUMULATIVE (le, count) pairs, Prometheus-style, ending at
+        (inf, total)."""
+        with self._lock:
+            out = []
+            cum = 0
+            for edge, n in zip(self.buckets, self._counts):
+                cum += n
+                out.append((edge, cum))
+            out.append((float("inf"), cum + self._counts[-1]))
+            return out
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._min = None
+            self._max = None
+
+
+class MetricsRegistry:
+    """Get-or-create home of every metric, keyed by (name, sorted labels).
+    One name maps to one metric type — asking for the same name as a
+    different type raises (a silent shadow would split the accounting)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "Dict[Tuple[str, tuple], object]" = {}
+
+    def _get(self, cls, name: str, labels: Optional[Dict[str, str]],
+             help: str, **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            got = self._metrics.get(key)
+            if got is not None:
+                if not isinstance(got, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{type(got).__name__}, not {cls.__name__}")
+                return got
+            m = cls(name, labels=key[1], help=help, **kw)
+            self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None,
+                help: str = "") -> Counter:
+        return self._get(Counter, name, labels, help)
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None,
+              help: str = "") -> Gauge:
+        return self._get(Gauge, name, labels, help)
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
+                  help: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self._get(Histogram, name, labels, help, buckets=buckets)
+
+    def collect(self) -> List[object]:
+        """Every registered metric, name-sorted (stable render order)."""
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def snapshot(self) -> dict:
+        """Nested dict of everything: ``{"counters": {key: value},
+        "gauges": {key: value}, "histograms": {key: summary+buckets}}``
+        where ``key`` is ``name`` or ``name{label=value,...}``."""
+        counters: Dict[str, object] = {}
+        gauges: Dict[str, object] = {}
+        hists: Dict[str, dict] = {}
+        for m in self.collect():
+            key = _render_key(m.name, m.labels)
+            if isinstance(m, Counter):
+                counters[key] = m.value
+            elif isinstance(m, Gauge):
+                gauges[key] = m.value
+            else:
+                d = m.summary()
+                d["buckets"] = [[le, n] for le, n in m.bucket_counts()]
+                hists[key] = d
+        return {"counters": counters, "gauges": gauges,
+                "histograms": hists}
+
+    def reset(self) -> None:
+        """Zero every metric (tests and bench isolation).  Metrics stay
+        registered — pre-declared families keep rendering at 0."""
+        for m in self.collect():
+            m._reset()
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, labels: Optional[Dict[str, str]] = None,
+            help: str = "") -> Counter:
+    return REGISTRY.counter(name, labels, help)
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None,
+          help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, labels, help)
+
+
+def histogram(name: str, labels: Optional[Dict[str, str]] = None,
+              help: str = "",
+              buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
+              ) -> Histogram:
+    return REGISTRY.histogram(name, labels, help, buckets)
+
+
+def metrics_snapshot() -> dict:
+    """Process-wide nested dict of every counter, gauge, and histogram
+    (with p50/p95/p99).  Diff two snapshots with :func:`metrics_delta` to
+    meter one operation."""
+    return REGISTRY.snapshot()
+
+
+def metrics_delta(before: dict, after: dict) -> dict:
+    """What happened between two :func:`metrics_snapshot` calls: counter
+    differences (zero-change entries dropped), gauges at their ``after``
+    value, histogram count/sum deltas with the lifetime percentiles
+    attached (fixed-bucket histograms cannot rewind, so per-window
+    percentiles are approximated by the lifetime distribution)."""
+    out = {"counters": {}, "gauges": dict(after.get("gauges", {})),
+           "histograms": {}}
+    b_c = before.get("counters", {})
+    for k, v in after.get("counters", {}).items():
+        d = v - b_c.get(k, 0)
+        if d:
+            out["counters"][k] = round(d, 6) if isinstance(d, float) else d
+    b_h = before.get("histograms", {})
+    for k, h in after.get("histograms", {}).items():
+        dc = h["count"] - b_h.get(k, {}).get("count", 0)
+        if dc:
+            out["histograms"][k] = {
+                "count": dc,
+                "sum": round(h["sum"] - b_h.get(k, {}).get("sum", 0.0), 6),
+                "p50": h["p50"], "p95": h["p95"], "p99": h["p99"]}
+    return out
+
+
+def reset_metrics() -> None:
+    """Zero every registered metric (tests, bench per-config isolation)."""
+    REGISTRY.reset()
+
+
+def pool_wait_seconds() -> float:
+    """Cumulative seconds operations spent waiting on the shared pool:
+    task queue→run wait (utils/pool.py) plus prefetch-window waits
+    (io/prefetch.py).  The saturation signal — diff it across one
+    operation and hand the delta to ``RouteHistory.observe(...,
+    pool_wait_s=)`` so a saturated pool discounts the route's effective
+    GB/s, not just its wall clock.  Both components are LIVE (observed
+    as each wait ends, not published at drain close), so a delta window
+    sees only the waits that actually happened inside it — the
+    close-time ``prefetch.pool_wait_s`` counter would lump a whole
+    drain's lifetime stalls into whichever window straddled its close."""
+    return float(histogram("pool.queue_wait_s").sum
+                 + histogram("prefetch.wait_s").sum)
+
+
+# ---------------------------------------------------------------------------
+# Pre-declared core families: the operational contract of `stats --prom` is
+# that the cache/prefetch/planner/route/read/write families EXIST (at 0)
+# even before any operation ran — scrapers alert on absence, not on zero.
+# ---------------------------------------------------------------------------
+_CORE_COUNTERS = (
+    ("cache.footer_hits", "footer cache hits (open skipped parse)"),
+    ("cache.footer_misses", "footer cache misses"),
+    ("cache.chunk_hits", "decoded-chunk LRU hits"),
+    ("cache.chunk_misses", "decoded-chunk LRU misses"),
+    ("cache.chunk_evictions", "decoded-chunk LRU evictions"),
+    ("prefetch.hits", "preads served from readahead state"),
+    ("prefetch.misses", "preads read through around readahead"),
+    ("prefetch.windows_issued", "readahead windows issued/hinted"),
+    ("prefetch.bytes_prefetched", "bytes issued ahead of consumption"),
+    ("prefetch.bytes_discarded", "prefetched bytes dropped unconsumed"),
+    ("prefetch.pool_wait_s", "seconds blocked on unfinished windows"),
+    # "considered", not the plan-counter key "rg_total": the Prometheus
+    # renderer appends _total to counters, and rg_total_total is a trap
+    # for every dashboard written against the natural name
+    ("planner.rg_considered", "row groups considered by the scan planner"),
+    ("planner.rg_pruned_stats", "row groups pruned by footer stats"),
+    ("planner.rg_pruned_pages", "row groups pruned by the page index"),
+    ("planner.rg_pruned_bloom", "row groups pruned by bloom filters"),
+    ("planner.rg_survivors", "row groups that survived the cascade"),
+    ("planner.stats_probes", "stats-stage predicate probes"),
+    ("planner.page_probes", "page-index predicate probes"),
+    ("planner.bloom_probes", "bloom-filter predicate probes"),
+    ("planner.pages_considered", "pages considered by the page stage"),
+    ("planner.pages_selected", "pages selected by the page stage"),
+    ("read.retries", "transient pread retries performed"),
+    ("read.rows_dropped", "rows lost to degraded-mode skips"),
+    ("read.row_groups_skipped", "row groups dropped by degraded reads"),
+    ("read.files_skipped", "whole files dropped by degraded reads"),
+    ("write.row_groups", "row groups written"),
+    ("write.bytes_flushed", "bytes flushed toward the OS by writers"),
+    ("write.sink_flushes", "coalesced sink flushes"),
+    ("trace.events_dropped", "trace events dropped at the buffer cap"),
+)
+
+
+def _declare_core() -> None:
+    for name, hlp in _CORE_COUNTERS:
+        REGISTRY.counter(name, help=hlp)
+    for route in ("host", "device"):
+        REGISTRY.counter("route.chosen", labels={"route": route},
+                         help="scans routed by the cost model")
+    REGISTRY.histogram("pool.queue_wait_s",
+                       help="shared-pool task queue->run wait")
+
+
+_declare_core()

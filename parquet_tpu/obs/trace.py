@@ -1,0 +1,229 @@
+"""Span tracing: Chrome trace-event JSON you can drop into Perfetto.
+
+PR 3–6 shipped pipeline claims — encode/emit overlap ratios, prefetch
+bubbles, late-materialization skips — as *numbers* in stats dataclasses.
+This module makes them *visible*: every load-bearing stage (footer open,
+prefetch window issue/wait, per-column decode, planner cascade, host-scan
+phase 1/2, encode/emit, sink flush, H2D staging, pool task queue→run) is
+wrapped in a :func:`trace_span`, each completed span records its
+worker-thread id, and the buffer flushes to the Chrome ``traceEvents``
+JSON format (Perfetto / ``chrome://tracing`` load it directly) — so
+pipeline overlap shows up as literally overlapping bars on different
+thread tracks.
+
+Overhead contract — tracing OFF is the production default and must cost
+nothing measurable:
+
+- ``TRACE_ENABLED`` is a module-level bool.  The hottest sites read it
+  directly (``if trace.TRACE_ENABLED:``) and skip span construction
+  entirely.
+- :func:`trace_span` called while disabled returns one shared no-op
+  singleton — no object allocation, no timestamps, no lock.
+
+Enabling:
+
+- ``PARQUET_TPU_TRACE=/path/trace.json`` (env, read at import): tracing
+  on for the process, buffer flushed to that path at interpreter exit.
+- :func:`enable_tracing`/:func:`disable_tracing`/:func:`flush_trace` —
+  the programmatic controls (tests, notebooks).
+
+The event buffer is bounded (:data:`MAX_EVENTS`); overflow drops new
+events and counts them in the ``trace.events_dropped`` metric instead of
+growing without bound.  While tracing is on, each completed span also
+feeds a ``span.<name>_s`` latency histogram in the metrics registry, so
+stage p50/p99 come for free with a traced run.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["TRACE_ENABLED", "trace_span", "span", "enabled",
+           "enable_tracing", "disable_tracing", "flush_trace",
+           "trace_events", "reset_trace", "MAX_EVENTS"]
+
+TRACE_ENABLED = False
+MAX_EVENTS = 1_000_000
+
+_LOCK = threading.Lock()
+_EVENTS: List[dict] = []
+_SEEN_TIDS: set = set()
+_TRACE_PATH: Optional[str] = None
+_ATEXIT_REGISTERED = False
+# one epoch per process: span timestamps are µs since this mark, so every
+# thread's spans share one Perfetto timeline
+_EPOCH = time.perf_counter()
+
+
+class _NullSpan:
+    """The disabled-tracing singleton: a context manager that does nothing
+    and allocates nothing.  Identity-stable so tests can assert the
+    disabled path never constructs per-call objects."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+# span-name -> histogram, resolved once: per-span-exit observation must not
+# take the registry's get-or-create lock or rebuild the key string (the
+# registry's no-global-lock-on-increment contract; a lost race just
+# resolves the same get-or-create metric twice)
+_SPAN_HISTS: Dict[str, object] = {}
+
+
+def _span_hist(name: str):
+    h = _SPAN_HISTS.get(name)
+    if h is None:
+        h = _SPAN_HISTS[name] = _metrics.histogram("span." + name + "_s")
+    return h
+
+
+class _Span:
+    """One enabled span: perf_counter timestamps, the worker thread id it
+    ran on, and a Chrome complete ("X") event on exit."""
+
+    __slots__ = ("name", "attrs", "_t0", "_tid")
+
+    def __init__(self, name: str, attrs: Optional[Dict] = None):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._tid = threading.get_ident()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if not TRACE_ENABLED:  # disabled mid-span: nothing to record into
+            return False
+        dur = t1 - self._t0
+        _span_hist(self.name).observe(dur)
+        ev = {"name": self.name, "ph": "X", "pid": _PID, "tid": self._tid,
+              "ts": round((self._t0 - _EPOCH) * 1e6, 3),
+              "dur": round(dur * 1e6, 3),
+              "cat": self.name.split(".", 1)[0]}
+        if self.attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in self.attrs.items()}
+        with _LOCK:
+            if len(_EVENTS) >= MAX_EVENTS:
+                _metrics.counter("trace.events_dropped").inc()
+                return False
+            if self._tid not in _SEEN_TIDS:
+                # Perfetto names thread tracks from "M" metadata events —
+                # emitted once per thread so pool workers are labeled
+                _SEEN_TIDS.add(self._tid)
+                _EVENTS.append({
+                    "name": "thread_name", "ph": "M", "pid": _PID,
+                    "tid": self._tid,
+                    "args": {"name": threading.current_thread().name}})
+            _EVENTS.append(ev)
+        return False
+
+
+_PID = os.getpid()
+
+
+def enabled() -> bool:
+    return TRACE_ENABLED
+
+
+def trace_span(name: str, **attrs):
+    """Context manager for one traced stage: ``with trace_span("decode",
+    col="x"): ...``.  With tracing disabled this returns the shared no-op
+    singleton — the hottest call sites additionally guard with
+    ``if trace.TRACE_ENABLED:`` to skip even the call."""
+    if not TRACE_ENABLED:
+        return NULL_SPAN
+    return _Span(name, attrs or None)
+
+
+span = trace_span  # the short form instrumentation sites import
+
+
+def enable_tracing(path: Optional[str] = None) -> None:
+    """Turn span collection on.  ``path`` (optional) is where
+    :func:`flush_trace` and the interpreter-exit hook write the Chrome
+    trace JSON; without one, events stay in memory for
+    :func:`trace_events`/an explicit ``flush_trace(path)``."""
+    global TRACE_ENABLED, _TRACE_PATH, _ATEXIT_REGISTERED
+    with _LOCK:
+        _TRACE_PATH = os.fspath(path) if path is not None else _TRACE_PATH
+        TRACE_ENABLED = True
+        if _TRACE_PATH is not None and not _ATEXIT_REGISTERED:
+            _ATEXIT_REGISTERED = True
+            atexit.register(_flush_at_exit)
+
+
+def disable_tracing() -> None:
+    global TRACE_ENABLED
+    TRACE_ENABLED = False
+
+
+def reset_trace() -> None:
+    """Drop buffered events (tests; does not change the enabled state)."""
+    with _LOCK:
+        _EVENTS.clear()
+        _SEEN_TIDS.clear()
+
+
+def trace_events() -> List[dict]:
+    """Copy of the buffered events (tests and programmatic consumers)."""
+    with _LOCK:
+        return list(_EVENTS)
+
+
+def flush_trace(path: Optional[str] = None) -> Optional[str]:
+    """Write the buffered spans as Chrome trace-event JSON (the object
+    form: ``{"traceEvents": [...]}``) — loadable by Perfetto
+    (ui.perfetto.dev) and chrome://tracing.  Returns the path written, or
+    None when there is no path to write to.  The buffer is kept (a later
+    flush rewrites the file with the fuller trace)."""
+    p = os.fspath(path) if path is not None else _TRACE_PATH
+    if p is None:
+        return None
+    with _LOCK:
+        events = list(_EVENTS)
+    body = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(body, f)
+    os.replace(tmp, p)
+    return p
+
+
+def _flush_at_exit() -> None:
+    try:
+        # not gated on TRACE_ENABLED: disabling tracing after a traced
+        # workload must not discard the buffer the env var promised to
+        # a file
+        if _TRACE_PATH is not None and _EVENTS:
+            flush_trace()
+    except OSError:
+        pass  # exit-time flush is best-effort
+
+
+_env_path = os.environ.get("PARQUET_TPU_TRACE", "").strip()
+if _env_path:
+    enable_tracing(_env_path)

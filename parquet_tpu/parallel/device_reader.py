@@ -1028,6 +1028,20 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
     already resident in HBM.  ``stage_levels=False`` skips the level stream
     (nested columns assemble levels on host).
     """
+    from ..obs import trace as _otrace
+
+    if _otrace.TRACE_ENABLED:
+        # the H2D stage is the device pipeline's overlap partner: its span
+        # sitting beside a decode span on another track IS the double
+        # buffer working
+        with _otrace.span("device.h2d", col=plan.leaf.dotted_path
+                          if plan.leaf is not None else None,
+                          bytes=len(plan.values) + len(plan.levels)):
+            return _stage_plan_impl(plan, stage_levels)
+    return _stage_plan_impl(plan, stage_levels)
+
+
+def _stage_plan_impl(plan: _Plan, stage_levels: bool = True) -> tuple:
     # host value routes, decided BEFORE the device size guard (they read
     # the host accumulation directly — no 32-bit-lane constraint) and
     # recorded in the staged meta: decode must not re-derive routing from

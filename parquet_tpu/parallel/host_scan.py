@@ -13,12 +13,20 @@ predicate is applied to the decoded keys.
 
 from __future__ import annotations
 
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..errors import CorruptedError, DeadlineError
+from ..obs import trace as _otrace
+from ..obs.metrics import histogram as _ohistogram
+from ..obs.metrics import pool_wait_seconds as _pool_wait_seconds
+
+# resolved once: per-file observation must not take the registry's
+# get-or-create lock (only the metric's own)
+_M_SCAN_FILE_S = _ohistogram("dataset.scan_file_s")
 from ..io.faults import (FaultPolicy, ReadReport, read_context,
                          resolve_policy)
 from ..io.reader import ParquetFile
@@ -28,6 +36,7 @@ __all__ = ["scan", "scan_expr", "scan_filtered", "scan_filtered_device",
            "scan_filtered_sharded", "scan_files", "merge_scan_results"]
 
 from ..utils.pool import (in_shared_pool as _in_pool,
+                          instrument_task as _instrument_task,
                           mark_pooled as _mark_pooled, shared_pool as _pool)
 
 # decoded_scan: spans between survivor-count syncs (bounds device residency
@@ -280,7 +289,12 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
             # in numpy/C++/codec calls.  mark_pooled keeps the per-worker
             # native decompress split at 1 (no pool x native
             # oversubscription).
-            return list(_pool().map(_mark_pooled(read_one), tasks))
+            # instrument_task: this map's queue waits must reach
+            # pool.queue_wait_s — the scan router's saturation delta for
+            # the host route is measured from exactly these tasks
+            return list(_pool().map(
+                _instrument_task(_mark_pooled(read_one), name="scan_read"),
+                tasks))
         with ThreadPoolExecutor(max_workers=num_threads) as pool:
             return list(pool.map(_mark_pooled(read_one), tasks))
 
@@ -304,19 +318,25 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
     cand_rows = sum(count for _, _, count in spans)
     tasks1 = [(rg_i, start, count, c, True)
               for (rg_i, start, count) in spans for c in fcols]
-    res1 = fan_out(tasks1, cand_rows * max(len(fcols), 1))
-    failures = [r for r in res1 if isinstance(r, _SpanFailure)]
-    if failures:
-        bad = drop_bad_rgs(failures)
-        keep = [i for i, s in enumerate(spans) if s[0] not in bad]
-        res1 = [res1[i * len(fcols) + j] for i in keep
-                for j in range(len(fcols))]
-        spans = [spans[i] for i in keep]
-    k = len(fcols)
-    envs = [{c: res1[i * k + j] for j, c in enumerate(fcols)}
-            for i in range(len(spans))]
-    masks = [_expr_mask(expr, env, count)
-             for (rg_i, start, count), env in zip(spans, envs)]
+    p1_span = (_otrace.span("scan.phase1", file=pf._path,
+                            spans=len(spans), cand_rows=cand_rows)
+               if _otrace.TRACE_ENABLED else _otrace.NULL_SPAN)
+    # `with`: a failing fan-out (deadline, unskippable corruption) must
+    # still record the span — the failed run is the one worth tracing
+    with p1_span:
+        res1 = fan_out(tasks1, cand_rows * max(len(fcols), 1))
+        failures = [r for r in res1 if isinstance(r, _SpanFailure)]
+        if failures:
+            bad = drop_bad_rgs(failures)
+            keep = [i for i, s in enumerate(spans) if s[0] not in bad]
+            res1 = [res1[i * len(fcols) + j] for i in keep
+                    for j in range(len(fcols))]
+            spans = [spans[i] for i in keep]
+        k = len(fcols)
+        envs = [{c: res1[i * k + j] for j, c in enumerate(fcols)}
+                for i in range(len(spans))]
+        masks = [_expr_mask(expr, env, count)
+                 for (rg_i, start, count), env in zip(spans, envs)]
 
     # ---- phase 2: late materialization — output columns decode only the
     # pages covering rows that SURVIVED the exact predicate (the span is
@@ -335,7 +355,11 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
               for t0, t1 in [trim] for c in read2_cols]
     cells2 = sum(t1 - t0 for t in trims if t is not None
                  for t0, t1 in [t]) * max(len(read2_cols), 1)
-    res2 = fan_out(tasks2, cells2)
+    p2_span = (_otrace.span("scan.phase2", file=pf._path,
+                            tasks=len(tasks2), cells=cells2)
+               if _otrace.TRACE_ENABLED else _otrace.NULL_SPAN)
+    with p2_span:  # `with`: record the span even when the fan-out raises
+        res2 = fan_out(tasks2, cells2)
     failures = [r for r in res2 if isinstance(r, _SpanFailure)]
     if failures:
         bad = drop_bad_rgs(failures)
@@ -500,6 +524,7 @@ def scan_files(pfs: Sequence[ParquetFile], path: Optional[str] = None,
 
     def one(pf):
         sub = ReadReport() if report is not None else None
+        t0 = _time.perf_counter()
         try:
             if where is not None:
                 got = scan_expr(pf, where, columns=columns,
@@ -517,6 +542,10 @@ def scan_files(pfs: Sequence[ParquetFile], path: Optional[str] = None,
             if not skip_files:
                 raise
             return None, sub, e
+        finally:
+            # per-FILE scan latency: metrics_snapshot() answers the
+            # dataset scan's p50/p99 per file (ROADMAP lookup-meter prep)
+            _M_SCAN_FILE_S.observe(_time.perf_counter() - t0)
         return got, sub, None
 
     results = map_in_order(one, pfs)
@@ -1139,21 +1168,30 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
     decision = route_scan(pf, path, lo=lo, hi=hi, columns=columns,
                           values=values)
     t0 = time.monotonic()
+    w0 = _pool_wait_seconds()
     if decision.route == "device":
         # the device attempt works on a scratch report: a refusal fallback
         # discards its staging-phase skips (the host scan re-plans and
         # re-records them — the same report twice would double-count every
         # skipped row group) but keeps its retries, which really happened
         scratch = ReadReport() if report is not None else None
+        if scratch is not None:
+            # scratch skips don't publish to the metrics registry at
+            # record time: a refusal fallback discards them (the host scan
+            # re-records, which would double the registry totals); the
+            # success path below publishes them in one shot instead
+            scratch._publish = False
         try:
             got = scan_filtered_device(pf, path, lo=lo, hi=hi,
                                        columns=columns, use_bloom=use_bloom,
                                        values=values, policy=policy,
                                        report=scratch)
             route_history().observe("device", decision.est_bytes,
-                                    time.monotonic() - t0)
+                                    time.monotonic() - t0,
+                                    pool_wait_s=_pool_wait_seconds() - w0)
             if report is not None:
                 report.merge(scratch)
+                scratch.publish_skips()
             return got
         except ValueError as e:
             # only the DOCUMENTED device-route refusals fall back (their
@@ -1174,11 +1212,16 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
                     "the budget before falling back to the host scan)")
             policy = dataclasses.replace(pol, deadline_s=remaining)
     t0 = time.monotonic()
+    w0 = _pool_wait_seconds()
     got = scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
                         use_bloom=use_bloom, values=values, policy=policy,
                         num_threads=decision.pool_width, report=report)
+    # hand the router the measured pool saturation of THIS scan (queue
+    # waits + prefetch stalls, process-wide deltas): RouteHistory then
+    # discounts the host route's effective GB/s, not just its wall clock
     route_history().observe("host", decision.est_bytes,
-                            time.monotonic() - t0)
+                            time.monotonic() - t0,
+                            pool_wait_s=_pool_wait_seconds() - w0)
     return got
 
 

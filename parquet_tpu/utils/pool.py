@@ -13,12 +13,22 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
+
+from ..obs import trace as _trace
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import histogram as _histogram
 
 _POOL: Optional[ThreadPoolExecutor] = None
 _LOCK = threading.Lock()
 _IN_POOL = threading.local()
+
+# queue→run wait per task: the pool-saturation meter every operation's
+# dispatch feeds (obs.metrics.pool_wait_seconds sums it for the router)
+_QUEUE_WAIT = _histogram("pool.queue_wait_s")
+_TASKS = _counter("pool.tasks", help="tasks dispatched to the shared pool")
 
 
 def in_shared_pool() -> bool:
@@ -44,9 +54,40 @@ def mark_pooled(fn):
     return run
 
 
+def instrument_task(fn, name: "Optional[str]" = None):
+    """Wrap an about-to-be-dispatched pool task with the telemetry every
+    shared-pool entry point must apply: the task's queue→run wait lands in
+    the ``pool.queue_wait_s`` histogram (the saturation signal the scan
+    router discounts effective GB/s by — dispatch time is captured NOW, at
+    wrap), ``pool.tasks`` counts it, and with tracing on it runs inside a
+    ``pool.task`` span carrying its worker-thread id.  Used by
+    :func:`submit` and by direct ``shared_pool().map`` dispatchers
+    (host_scan's fan-out) — a map that skipped this would hide exactly the
+    queueing the router exists to observe."""
+    t_submit = time.perf_counter()
+
+    def run(*a, **k):
+        _QUEUE_WAIT.observe(time.perf_counter() - t_submit)
+        _TASKS.inc()
+        if _trace.TRACE_ENABLED:
+            with _trace.span("pool.task", fn=name):
+                return fn(*a, **k)
+        return fn(*a, **k)
+
+    return run
+
+
 def submit(fn, *args, **kwargs):
-    """Submit to the shared pool, marking the worker for in_shared_pool()."""
-    return shared_pool().submit(mark_pooled(fn), *args, **kwargs)
+    """Submit to the shared pool, marking the worker for in_shared_pool().
+
+    Every task's queue→run wait lands in the ``pool.queue_wait_s``
+    histogram (the saturation signal the scan router discounts effective
+    GB/s by), and with tracing on each task runs inside a ``pool.task``
+    span carrying its worker-thread id — pipeline overlap is visible as
+    overlapping bars on worker tracks."""
+    wrapped = instrument_task(mark_pooled(fn),
+                              name=getattr(fn, "__name__", None))
+    return shared_pool().submit(wrapped, *args, **kwargs)
 
 
 def cancel_futures(futures) -> None:

@@ -337,6 +337,112 @@ assert len(both["s"]) == 100, len(both["s"])
 print(f"planner smoke ok: 7/8 row groups stats-pruned, "
       f"{c['bloom_probes']} bloom probe(s), explain + byte-identity hold")
 PLEOF
+echo "=== telemetry smoke (Perfetto trace + Prometheus export + overhead) ==="
+TELEM_DIR=$(mktemp -d)
+# env-driven tracing, the production shape: PARQUET_TPU_TRACE is read at
+# import, the trace flushes at interpreter exit (plus an explicit flush
+# here); ring prefetch + a pinned 4-wide pool put spans on worker threads
+PARQUET_TPU_TRACE="$TELEM_DIR/trace.json" PARQUET_TPU_PREFETCH=ring \
+PARQUET_TPU_POOL_WORKERS=4 python - "$TELEM_DIR" <<'TELEOF'
+import json
+import sys
+
+import numpy as np
+import pyarrow as pa
+
+import parquet_tpu.utils.pool as pool_mod
+# the fan-out gates consult the core count; the CI box may have 1 — the
+# pinned 4-wide pool is real, only the gate is widened
+pool_mod.available_cpus = lambda: 8
+from parquet_tpu import Dataset, flush_trace, metrics_snapshot
+from parquet_tpu.io.writer import WriterOptions, write_table
+
+d = sys.argv[1]
+n = 200_000
+for i in range(2):
+    t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64)),
+                  "b": pa.array(np.random.default_rng(i).random(n))})
+    write_table(t, f"{d}/f{i}.parquet", WriterOptions(row_group_size=n // 4))
+with Dataset(f"{d}/*.parquet") as warm:
+    warm.read()            # populate the footer + chunk caches
+with Dataset(f"{d}/*.parquet") as ds:  # fresh opens: warm-path hits
+    ds.read()
+    for _ in ds.iter_batches(batch_rows=50_000):  # prefetching drain
+        pass
+    ds.scan("a", lo=100, hi=20_000, columns=["b"])
+path = flush_trace()
+evs = [e for e in json.load(open(path))["traceEvents"] if e["ph"] == "X"]
+cats = {e["name"].split(".", 1)[0] for e in evs}
+tids = {e["tid"] for e in evs}
+# acceptance shape: >= 4 distinct pipeline stages across >= 2 threads,
+# decode + prefetch both present
+assert {"decode", "prefetch", "scan", "open"} <= cats, cats
+assert len(cats) >= 4 and len(tids) >= 2, (cats, len(tids))
+snap = metrics_snapshot()
+assert snap["counters"]["cache.footer_hits"] > 0, "warm opens not metered"
+assert snap["counters"]["prefetch.windows_issued"] > 0
+assert snap["histograms"]["dataset.scan_s"]["count"] == 1
+print(f"telemetry trace ok: {len(evs)} spans, {sorted(cats)} on "
+      f"{len(tids)} threads")
+TELEOF
+python -m parquet_tpu stats --prom > "$TELEM_DIR/prom.txt"
+grep -q "^# TYPE parquet_tpu_cache_footer_hits_total counter" "$TELEM_DIR/prom.txt"
+grep -q "^# TYPE parquet_tpu_prefetch_hits_total counter" "$TELEM_DIR/prom.txt"
+grep -q "^# TYPE parquet_tpu_planner_rg_considered_total counter" "$TELEM_DIR/prom.txt"
+grep -q "^# TYPE parquet_tpu_route_chosen_total counter" "$TELEM_DIR/prom.txt"
+grep -q "_bucket{le=\"+Inf\"}" "$TELEM_DIR/prom.txt"
+echo "prometheus export ok: $(grep -c '^# TYPE' "$TELEM_DIR/prom.txt") families"
+python - <<'OVEOF'
+# tracing-off overhead must stay in the noise (<3% is the cfg7 acceptance
+# bar vs pre-PR, tracked by the BENCH trajectory).  The in-process proxies:
+# (1) the disabled gate allocates nothing and costs sub-µs per call site,
+# (2) a warm read with tracing DISABLED is not slower than the same read
+# with tracing ENABLED beyond 3% noise (off pays strictly less work).
+import io
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import ParquetFile, disable_tracing, enable_tracing
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.obs import reset_trace, trace_span
+from parquet_tpu.obs.trace import NULL_SPAN
+
+assert all(trace_span("decode") is NULL_SPAN for _ in range(4))
+t0 = time.perf_counter()
+for _ in range(200_000):
+    with trace_span("decode"):
+        pass
+per_call = (time.perf_counter() - t0) / 200_000
+assert per_call < 2e-6, f"disabled trace_span costs {per_call * 1e9:.0f}ns"
+
+t = pa.table({"x": pa.array(np.arange(1_000_000, dtype=np.int64))})
+buf = io.BytesIO()
+write_table(t, buf, WriterOptions(row_group_size=250_000))
+raw = buf.getvalue()
+ParquetFile(raw).read()  # warm one-time state
+
+
+def timed(reps=7):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ParquetFile(raw).read()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+off = timed()
+enable_tracing()
+on = timed()
+disable_tracing()
+reset_trace()
+assert off <= on * 1.03, f"tracing-off slower than tracing-on: {off:.4f}s vs {on:.4f}s"
+print(f"overhead ok: disabled gate {per_call * 1e9:.0f}ns/call, "
+      f"warm read off={off * 1e3:.1f}ms on={on * 1e3:.1f}ms")
+OVEOF
+rm -rf "$TELEM_DIR"
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys
