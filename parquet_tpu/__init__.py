@@ -69,7 +69,12 @@ Observability
   tracing to Chrome trace-event JSON, Perfetto-loadable;
   ``PARQUET_TPU_TRACE=/path.json`` per process), pool_wait_seconds (the
   shared-pool saturation meter the scan router feeds back into
-  ``RouteHistory``)
+  ``RouteHistory``), op_scope/OpScope (request-scoped telemetry: per-op
+  reports across pool workers, per-request Perfetto tracks, 1-in-N
+  sampling via ``PARQUET_TPU_TRACE_SAMPLE``, slow-op capture via
+  ``PARQUET_TPU_SLOW_OP_S``/``PARQUET_TPU_SLOW_LOG``),
+  start_metrics_server + ``python -m parquet_tpu stats --serve PORT``
+  (live /metrics + /metrics.json scrape endpoint)
 """
 
 from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
@@ -109,9 +114,10 @@ from .rows import (Row, RowBuilder, Value, copy_rows, deconstruct, read_rows,
 from .utils.printer import print_file, print_pages, print_schema
 from .utils.debug import counters
 from . import obs
-from .obs import (disable_tracing, enable_tracing, flush_trace,
-                  metrics_delta, metrics_snapshot, pool_wait_seconds,
-                  render_prometheus, reset_metrics, trace_span)
+from .obs import (OpScope, current_op, disable_tracing, enable_tracing,
+                  flush_trace, metrics_delta, metrics_snapshot, op_scope,
+                  pool_wait_seconds, render_prometheus, reset_metrics,
+                  start_metrics_server, trace_span)
 
 __version__ = "0.1.0"
 

@@ -52,6 +52,14 @@ def main(argv=None) -> int:
                         "stats: emit the metrics_snapshot() dict as JSON")
     p.add_argument("--prom", action="store_true",
                    help="stats: emit Prometheus exposition text format")
+    p.add_argument("--serve", type=int, metavar="PORT", default=None,
+                   help="stats: serve the registry over HTTP instead of "
+                        "dumping once — /metrics (Prometheus 0.0.4) and "
+                        "/metrics.json; 0 binds an ephemeral port; runs "
+                        "until interrupted")
+    p.add_argument("--host", default="127.0.0.1", metavar="ADDR",
+                   help="stats --serve: bind address (default loopback; "
+                        "0.0.0.0 to let a fleet Prometheus scrape it)")
     # intermixed: `verify --json a b` and `stats --prom` must both parse
     # now that `file` is optional (plain parse_args cannot place
     # positionals after an optional once nargs="*" matched zero)
@@ -88,6 +96,18 @@ def main(argv=None) -> int:
             except (OSError, ValueError, KeyError, CorruptedError) as e:
                 print(f"parquet_tpu: {e}", file=sys.stderr)
                 return 1
+        if args.serve is not None:
+            from .obs.export import start_metrics_server
+
+            srv = start_metrics_server(args.serve, host=args.host)
+            # line-buffered contract for scripts that scrape the port
+            print(f"serving metrics on {srv.url} "
+                  f"(and {srv.url}.json); Ctrl-C to stop", flush=True)
+            try:
+                srv.join()
+            except KeyboardInterrupt:
+                srv.close()
+            return 0
         if args.prom:
             sys.stdout.write(render_prometheus())
         elif args.json:

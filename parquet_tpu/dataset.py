@@ -47,6 +47,7 @@ from .errors import CorruptedError, DeadlineError
 from .io.faults import NON_DATA_ERRORS, FaultPolicy, ReadReport
 from .io.reader import ParquetFile, ReadOptions, Table
 from .io.search import prune_file
+from .obs import scope as _oscope
 from .obs.metrics import histogram as _ohistogram
 from .utils.pool import map_in_order
 
@@ -255,14 +256,18 @@ class Dataset:
             raise ValueError("read on an empty dataset shard (no schema to "
                              "type an empty table by); check num_files first")
         t0 = time.perf_counter()
-        try:
-            return self._read_all(columns, policy, report)
-        finally:
-            # whole-operation latency (per-FILE latencies land in
-            # read.file_s inside ParquetFile.read): metrics_snapshot()
-            # answers dataset read p50/p99 with no caller-side timing,
-            # failures included — the retry storm that dies IS the tail
-            _M_READ_S.observe(time.perf_counter() - t0)
+        # request scope (obs/scope.py): the whole multi-file fan-out —
+        # per-file reads on pool workers included — is one op
+        with _oscope.maybe_op_scope("dataset.read",
+                                    files=len(self.paths)):
+            try:
+                return self._read_all(columns, policy, report)
+            finally:
+                # whole-operation latency (per-FILE latencies land in
+                # read.file_s inside ParquetFile.read): metrics_snapshot()
+                # answers dataset read p50/p99 with no caller-side timing,
+                # failures included — the retry storm that dies IS the tail
+                _M_READ_S.observe(time.perf_counter() - t0)
 
     def _read_all(self, columns, policy, report) -> Table:
         pol, report, skip = self._resolve(policy, report)
@@ -334,6 +339,15 @@ class Dataset:
         fails to open (or dies mid-drain beyond row-group skipping) is
         dropped, already-yielded batches stay valid, and the loss is
         recorded in ``report``."""
+        gen = self._iter_batches_gen(columns, batch_rows,
+                                     strict_batch_rows, policy, report)
+        # request scope around each pull (obs/scope.py); the inner
+        # per-file drains join it instead of opening their own
+        return _oscope.scoped_iter("dataset.iter_batches", gen,
+                                   files=len(self.paths))
+
+    def _iter_batches_gen(self, columns, batch_rows, strict_batch_rows,
+                          policy, report):
         pol, report, skip = self._resolve(policy, report)
         for i in range(len(self.paths)):
             rows = 0
@@ -407,10 +421,12 @@ class Dataset:
         (:mod:`parquet_tpu.algebra.expr`) spanning any number of columns.
         Degraded ``policy``: an unopenable file is recorded in ``report``
         and excluded."""
-        pol, report, skip = self._resolve(policy, report)
-        expr, _ = self._prepare_where(path, lo, hi, values, where)
-        keep, _ = self._prune_indices(expr, skip, report)
-        return [self.paths[i] for i in keep]
+        with _oscope.maybe_op_scope("dataset.prune",
+                                    files=len(self.paths)):
+            pol, report, skip = self._resolve(policy, report)
+            expr, _ = self._prepare_where(path, lo, hi, values, where)
+            keep, _ = self._prune_indices(expr, skip, report)
+            return [self.paths[i] for i in keep]
 
     def _prune_indices(self, expr, skip, report):
         def check(i):
@@ -482,14 +498,16 @@ class Dataset:
             raise ValueError("scan on an empty dataset shard (no schema to "
                              "type empty results by); check num_files first")
         t0 = time.perf_counter()
-        try:
-            return self._scan_all(path, lo, hi, columns, use_bloom, values,
-                                  policy, report, where)
-        finally:
-            # whole-operation latency (per-file in dataset.scan_file_s via
-            # scan_files): the ROADMAP lookup-meter pre-work — p50/p99 per
-            # operation straight out of metrics_snapshot()
-            _M_SCAN_S.observe(time.perf_counter() - t0)
+        with _oscope.maybe_op_scope("dataset.scan",
+                                    files=len(self.paths)):
+            try:
+                return self._scan_all(path, lo, hi, columns, use_bloom,
+                                      values, policy, report, where)
+            finally:
+                # whole-operation latency (per-file in dataset.scan_file_s
+                # via scan_files): the ROADMAP lookup-meter pre-work —
+                # p50/p99 per operation straight out of metrics_snapshot()
+                _M_SCAN_S.observe(time.perf_counter() - t0)
 
     def _scan_all(self, path, lo, hi, columns, use_bloom, values,
                   policy, report, where) -> Dict[str, object]:

@@ -44,6 +44,7 @@ import numpy as np
 
 from ..obs.metrics import counter as _counter
 from ..obs.metrics import gauge as _gauge
+from ..obs.scope import account as _account
 
 __all__ = ["CacheStats", "FooterCache", "ChunkCache", "cache_stats",
            "clear_caches", "chunk_cache_bytes", "footer_cache_entries",
@@ -163,11 +164,11 @@ class FooterCache:
             got = self._entries.get(key)
             if got is None:
                 self.stats.footer_misses += 1
-                _M_FOOTER_MISSES.inc()
+                _account(_M_FOOTER_MISSES)
                 return None
             self._entries.move_to_end(key)
             self.stats.footer_hits += 1
-            _M_FOOTER_HITS.inc()
+            _account(_M_FOOTER_HITS)
             return got
 
     def put(self, key, value) -> None:
@@ -276,11 +277,11 @@ class ChunkCache:
             got = self._entries.get(key)
             if got is None:
                 self.stats.chunk_misses += 1
-                _M_CHUNK_MISSES.inc()
+                _account(_M_CHUNK_MISSES)
                 return None
             self._entries.move_to_end(key)
             self.stats.chunk_hits += 1
-            _M_CHUNK_HITS.inc()
+            _account(_M_CHUNK_HITS)
             return _private_copy(got[0])
 
     def put_and_freeze(self, key, col) -> Optional[Any]:
@@ -304,7 +305,7 @@ class ChunkCache:
                 _, (_, evicted_nb) = self._entries.popitem(last=False)
                 self._bytes -= evicted_nb
                 self.stats.chunk_evictions += 1
-                _M_CHUNK_EVICTIONS.inc()
+                _account(_M_CHUNK_EVICTIONS)
             self.stats.chunk_entries = len(self._entries)
             self.stats.chunk_bytes = self._bytes
             self.stats.chunk_capacity = cap

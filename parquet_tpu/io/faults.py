@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..errors import CorruptedError, DeadlineError, ReadError, ReadIOError
 from ..obs.metrics import counter as _counter
+from ..obs.scope import account as _account
 from .source import Source
 
 # resolved once: record/retry sites must not take the registry's
@@ -145,8 +146,8 @@ class ReadReport:
         self.errors.append(str(error))
         self.rows_dropped += rows
         if self._publish:
-            _M_RG_SKIPPED.inc()
-            _M_ROWS_DROPPED.inc(rows)
+            _account(_M_RG_SKIPPED)
+            _account(_M_ROWS_DROPPED, rows)
 
     def record_file_skip(self, path: str, rows: int, error) -> None:
         """One whole file dropped from a dataset-level degraded read.
@@ -156,17 +157,17 @@ class ReadReport:
         self.errors.append(str(error))
         self.rows_dropped += rows
         if self._publish:
-            _M_FILES_SKIPPED.inc()
-            _M_ROWS_DROPPED.inc(rows)
+            _account(_M_FILES_SKIPPED)
+            _account(_M_ROWS_DROPPED, rows)
 
     def publish_skips(self) -> None:
         """Publish this report's accumulated skip totals to the registry in
         one shot — the non-publishing scratch path's counterpart of the
         record-site increments, called exactly once when the attempt that
         produced this report is adopted rather than discarded."""
-        _M_RG_SKIPPED.inc(len(self.row_groups_skipped))
-        _M_FILES_SKIPPED.inc(len(self.files_skipped))
-        _M_ROWS_DROPPED.inc(self.rows_dropped)
+        _account(_M_RG_SKIPPED, len(self.row_groups_skipped))
+        _account(_M_FILES_SKIPPED, len(self.files_skipped))
+        _account(_M_ROWS_DROPPED, self.rows_dropped)
 
     def merge(self, other: "ReadReport") -> "ReadReport":
         """Fold another report's accounting into this one (aggregating
@@ -361,7 +362,7 @@ class PolicySource(Source):
                     self.retries_performed += 1
                     if dl is not None and id(dl) in self._op_retries:
                         self._op_retries[id(dl)] += 1
-                _M_RETRIES.inc()
+                _account(_M_RETRIES)
                 if delay > 0:
                     time.sleep(delay)
 

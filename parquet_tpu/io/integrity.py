@@ -46,6 +46,7 @@ from ..errors import (MAX_COLUMN_INDEX_SIZE, MAX_PAGE_HEADER_SIZE,
                       MAX_PAGE_SIZE, ReadError)
 from ..format import metadata as md, thrift
 from ..format.enums import Encoding, PageType
+from ..obs import scope as _oscope
 from .faults import NON_DATA_ERRORS
 from .source import as_source
 
@@ -139,20 +140,23 @@ def verify_file(source, crc: bool = True, indexes: bool = True,
     own = isinstance(source, (str, os.PathLike, bytes, bytearray,
                               memoryview))
     rep = IntegrityReport(path=getattr(src, "path", None))
-    try:
-        meta = _verify_envelope(src, rep)
-        if meta is not None:
-            _verify_body(src, meta, rep, crc=crc, indexes=indexes,
-                         blooms=blooms)
-            if decode:
-                _verify_decode(src, rep)
-    except NON_DATA_ERRORS:
-        raise
-    except Exception as e:  # a verifier must degrade to a report, not a crash
-        rep.add("io", f"verification aborted: {e}")
-    finally:
-        if own:
-            src.close()
+    # request scope (obs/scope.py): a verification walk is an op like any
+    # read — per-op bytes/retries attribution, sampling, slow-op capture
+    with _oscope.maybe_op_scope("verify.file", file=rep.path):
+        try:
+            meta = _verify_envelope(src, rep)
+            if meta is not None:
+                _verify_body(src, meta, rep, crc=crc, indexes=indexes,
+                             blooms=blooms)
+                if decode:
+                    _verify_decode(src, rep)
+        except NON_DATA_ERRORS:
+            raise
+        except Exception as e:  # a verifier must degrade to a report,
+            rep.add("io", f"verification aborted: {e}")  # not a crash
+        finally:
+            if own:
+                src.close()
     return rep
 
 

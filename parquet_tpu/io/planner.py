@@ -43,6 +43,7 @@ from ..algebra.expr import And, Const, Expr, Or, Pred, prepare, single_pred
 from ..format.enums import Type
 from ..obs import trace as _trace
 from ..obs.metrics import counter as _mcounter
+from ..obs.scope import account as _maccount
 from ..obs.metrics import gauge as _mgauge
 
 __all__ = ["ScanPlanner", "ScanPlan", "RowGroupDecision",
@@ -357,7 +358,8 @@ class ScanPlanner:
         # counters and rg_total_total would trap every dashboard
         for k, v in counters.items():
             if v:
-                _mcounter("planner." + _REGISTRY_KEY.get(k, k)).inc(v)
+                _maccount(_mcounter("planner." + _REGISTRY_KEY.get(k, k)),
+                          v)
         return ScanPlan(self.pf, expr, decisions, counters, stages)
 
     # ------------------------------------------------------------------
@@ -714,7 +716,7 @@ class RouteHistory:
             eff = self._gbps[route] * (1.0 - self._wait_frac[route])
         _mgauge("route.gbps", labels={"route": route},
                 help="EWMA effective GB/s per route").set(round(eff, 4))
-        _mcounter("route.observations", labels={"route": route}).inc()
+        _maccount(_mcounter("route.observations", labels={"route": route}))
 
     def gbps(self, route: str) -> Optional[float]:
         """Effective EWMA GB/s: the measured wall-clock rate discounted by
@@ -841,7 +843,7 @@ def route_scan(pf, path: str, lo=None, hi=None,
         reason = (f"PARQUET_TPU_ROUTE={pin} pin" if pin == "host"
                   else "cpu backend: threaded host scan beats emulated "
                   "device kernels")
-        _mcounter("route.chosen", labels={"route": "host"}).inc()
+        _maccount(_mcounter("route.chosen", labels={"route": "host"}))
         return RouteDecision("host", reason)
     supported, reason = True, ""
     try:
@@ -868,7 +870,7 @@ def route_scan(pf, path: str, lo=None, hi=None,
         pin=pin)
     decision = choose_route(inp)
     decision.est_bytes = est_bytes
-    _mcounter("route.chosen", labels={"route": decision.route}).inc()
+    _maccount(_mcounter("route.chosen", labels={"route": decision.route}))
     return decision
 
 

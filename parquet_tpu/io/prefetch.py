@@ -45,6 +45,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..errors import DeadlineError
+from ..obs import scope as _oscope
 from ..obs import trace as _trace
 from ..obs.metrics import counter as _counter
 from ..obs.metrics import histogram as _histogram
@@ -189,14 +190,22 @@ class ReadStats:
 
     def publish(self) -> None:
         """Fold this drain's totals into the process-wide metrics registry
-        (parquet_tpu/obs) — called once when the drain's prefetcher
-        closes, so registry counters never double-count a live drain."""
-        _counter("prefetch.hits").inc(self.prefetch_hits)
-        _counter("prefetch.misses").inc(self.prefetch_misses)
-        _counter("prefetch.windows_issued").inc(self.windows_issued)
-        _counter("prefetch.bytes_prefetched").inc(self.bytes_prefetched)
-        _counter("prefetch.bytes_discarded").inc(self.bytes_discarded)
-        _counter("prefetch.pool_wait_s").inc(self.pool_wait_s)
+        (parquet_tpu/obs) and the current op scope — called when the
+        drain's prefetcher closes.  Idempotent: a double-close (or a
+        direct second call) publishes exactly once, so registry totals
+        can never double."""
+        if getattr(self, "_published", False):
+            return
+        self._published = True
+        _oscope.account(_counter("prefetch.hits"), self.prefetch_hits)
+        _oscope.account(_counter("prefetch.misses"), self.prefetch_misses)
+        _oscope.account(_counter("prefetch.windows_issued"),
+                        self.windows_issued)
+        _oscope.account(_counter("prefetch.bytes_prefetched"),
+                        self.bytes_prefetched)
+        _oscope.account(_counter("prefetch.bytes_discarded"),
+                        self.bytes_discarded)
+        _oscope.account(_counter("prefetch.pool_wait_s"), self.pool_wait_s)
 
 
 class _Window:
@@ -488,6 +497,10 @@ class PrefetchSource(Source):
             wait_span.__exit__(None, None, None)
             waited = time.perf_counter() - t0
             _WAIT_HIST.observe(waited)
+            # per-op mirror of the live wait (the close-time
+            # prefetch.pool_wait_s counter lumps a drain's stalls into
+            # one moment; this one lands as each wait ends)
+            _oscope.add_to_current("prefetch.wait_s", waited)
             with self._lock:
                 self.stats.pool_wait_s += waited
 

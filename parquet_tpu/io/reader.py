@@ -27,6 +27,7 @@ from ..format import enums, metadata as md, thrift
 from ..format.enums import Encoding, PageType, Type
 from ..ops import levels as levels_ops, ref
 from ..schema.schema import Leaf, Schema
+from ..obs import scope as _oscope
 from ..obs import trace as _otrace
 from ..obs.metrics import histogram as _ohistogram
 
@@ -762,20 +763,25 @@ class ParquetFile:
         """
         pol, report = resolve_policy(self, policy, report)
         t0 = time.perf_counter()
-        try:
-            if pol is not None or report is not None:
-                with self._resilient_op(policy, report):
-                    t = self._read_impl(columns, device, row_groups, pol,
-                                        report)
-                report.rows_read += t.num_rows
-                t.report = report
-                return t
-            return self._read_impl(columns, device, row_groups, None, None)
-        finally:
-            # per-operation latency: metrics_snapshot() answers read p50/
-            # p99 without any caller-side timing (failures count too — a
-            # retry storm that dies at the deadline IS the tail)
-            _M_READ_FILE_S.observe(time.perf_counter() - t0)
+        # request scope (obs/scope.py): per-op attribution + sampling;
+        # joins the caller's op_scope (or the dataset layer's) if active
+        with _oscope.maybe_op_scope("file.read", file=self._path):
+            try:
+                if pol is not None or report is not None:
+                    with self._resilient_op(policy, report):
+                        t = self._read_impl(columns, device, row_groups,
+                                            pol, report)
+                    report.rows_read += t.num_rows
+                    t.report = report
+                    return t
+                return self._read_impl(columns, device, row_groups, None,
+                                       None)
+            finally:
+                # per-operation latency: metrics_snapshot() answers read
+                # p50/p99 without any caller-side timing (failures count
+                # too — a retry storm that dies at the deadline IS the
+                # tail)
+                _M_READ_FILE_S.observe(time.perf_counter() - t0)
 
     def _read_impl(self, columns, device, row_groups,
                    pol: Optional[FaultPolicy],

@@ -34,6 +34,7 @@ from typing import List, Optional
 from ..errors import WriteError
 from ..obs import trace as _trace
 from ..obs.metrics import counter as _counter
+from ..obs.scope import account as _account
 
 __all__ = ["Sink", "FileSink", "AtomicFileSink", "BufferedSink", "WriteStats",
            "fsync_dir", "write_buffer_bytes", "write_autotune",
@@ -101,17 +102,22 @@ class WriteStats:
 
     def publish(self) -> None:
         """Fold this writer's totals into the process-wide metrics
-        registry (parquet_tpu/obs) — called once per writer at successful
-        close, so registry counters never double-count a live write."""
-        _counter("write.row_groups").inc(self.row_groups)
-        _counter("write.overlapped_groups").inc(self.overlapped_groups)
-        _counter("write.encode_s").inc(self.encode_s)
-        _counter("write.emit_s").inc(self.emit_s)
-        _counter("write.pool_wait_s").inc(self.pool_wait_s)
-        _counter("write.bytes_buffered").inc(self.bytes_buffered)
-        _counter("write.bytes_flushed").inc(self.bytes_flushed)
-        _counter("write.sink_flushes").inc(self.sink_flushes)
-        _counter("write.writev_flushes").inc(self.writev_flushes)
+        registry (parquet_tpu/obs) and the current op scope — called at
+        successful close.  Idempotent: a double-close (or a direct second
+        call) publishes exactly once, so registry totals can never
+        double."""
+        if getattr(self, "_published", False):
+            return
+        self._published = True
+        _account(_counter("write.row_groups"), self.row_groups)
+        _account(_counter("write.overlapped_groups"), self.overlapped_groups)
+        _account(_counter("write.encode_s"), self.encode_s)
+        _account(_counter("write.emit_s"), self.emit_s)
+        _account(_counter("write.pool_wait_s"), self.pool_wait_s)
+        _account(_counter("write.bytes_buffered"), self.bytes_buffered)
+        _account(_counter("write.bytes_flushed"), self.bytes_flushed)
+        _account(_counter("write.sink_flushes"), self.sink_flushes)
+        _account(_counter("write.writev_flushes"), self.writev_flushes)
 
 
 # write-side auto-tuner (the mirror of io/prefetch.py's depth/window tuner):

@@ -16,6 +16,11 @@ from typing import Union
 
 import numpy as np
 
+# every terminal read accounts its bytes here (read.bytes_read + the
+# current op scope): wrappers (policy/retry/prefetch) delegate down to
+# exactly one of these classes, so bytes count once, at the bottom
+from ..obs.scope import account_bytes as _account_bytes
+
 
 class Source:
     def pread(self, offset: int, size: int) -> bytes:
@@ -69,6 +74,7 @@ class FileSource(Source):
                     f"short read at {offset}: wanted {size}, got {got}")
             parts.append(chunk)
             got += len(chunk)
+        _account_bytes(size)
         return parts[0] if len(parts) == 1 else b"".join(parts)
 
     def pread_view(self, offset: int, size: int) -> np.ndarray:
@@ -84,6 +90,7 @@ class FileSource(Source):
                 raise IOError(
                     f"short read at {offset}: wanted {size}, got {got}")
             got += n
+        _account_bytes(size)
         return buf
 
     def size(self) -> int:
@@ -143,6 +150,7 @@ class MmapSource(Source):
         if len(out) != size:
             raise IOError(f"short read at {offset}: wanted {size}, "
                           f"got {len(out)}")
+        _account_bytes(size)
         return bytes(out)
 
     def pread_view(self, offset: int, size: int) -> np.ndarray:
@@ -152,6 +160,7 @@ class MmapSource(Source):
         if len(out) != size:
             raise IOError(f"short read at {offset}: wanted {size}, "
                           f"got {len(out)}")
+        _account_bytes(size)
         return out
 
     def madvise_willneed(self, offset: int, size: int) -> None:
@@ -201,6 +210,7 @@ class BytesSource(Source):
         out = self._data[offset : offset + size]
         if len(out) != size:
             raise IOError(f"short read at {offset}")
+        _account_bytes(size)
         return bytes(out)
 
     def pread_view(self, offset: int, size: int):
@@ -208,6 +218,7 @@ class BytesSource(Source):
         out = self._data[offset : offset + size]
         if len(out) != size:
             raise IOError(f"short read at {offset}")
+        _account_bytes(size)
         if not self._data.readonly:
             # a bytearray-backed source: decoded columns may lazily reference
             # chunk bytes, and a caller mutating its buffer after read()
@@ -243,6 +254,7 @@ class FileLikeSource(Source):
             out = f.read(size)
         if len(out) != size:
             raise IOError(f"short read at {offset}")
+        _account_bytes(size)
         return out
 
     def size(self) -> int:

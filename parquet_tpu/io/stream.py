@@ -30,6 +30,7 @@ import numpy as np
 
 from ..errors import CorruptedError, DeadlineError
 from ..format.enums import PageType
+from ..obs import scope as _oscope
 from ..obs import trace as _trace
 from ..ops import levels as levels_ops
 from .column import Column
@@ -212,6 +213,15 @@ def iter_batches(pf: ParquetFile, columns: Optional[Sequence[str]] = None,
     """
     if batch_rows <= 0:
         raise ValueError("batch_rows must be positive")
+    gen = _iter_batches_gen(pf, columns, batch_rows, strict_batch_rows,
+                            policy, report)
+    # request scope around each pull (obs/scope.py): the drain gets its
+    # own op identity unless the caller already opened one
+    return _oscope.scoped_iter("file.iter_batches", gen, file=pf._path)
+
+
+def _iter_batches_gen(pf, columns, batch_rows, strict_batch_rows, policy,
+                      report) -> Iterator[Table]:
     pol, report = resolve_policy(pf, policy, report)
     skip = pol is not None and pol.skip_corrupt
     leaves = [pf.schema.leaf(c) for c in columns] if columns is not None \

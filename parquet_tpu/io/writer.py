@@ -33,6 +33,7 @@ the pipeline.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import struct
 import time
@@ -50,8 +51,13 @@ from ..format.enums import (CompressionCodec, ConvertedType, Encoding,
 from ..ops import levels as levels_ops, ref
 from ..schema import schema as sch
 from ..schema.schema import Leaf, Schema
+from ..obs import scope as _oscope
 from ..obs import trace as _otrace
 from ..schema.types import LogicalKind
+
+# shared stateless pass-through for writer methods running under a
+# caller's ambient op scope (nullcontext is safely re-enterable)
+_NULL_CM = contextlib.nullcontext()
 
 DEFAULT_CREATED_BY = "parquet-tpu version 0.1.0"
 
@@ -177,6 +183,15 @@ class ParquetWriter:
         self.options = options or WriterOptions()
         self.write_stats = WriteStats()
         self._own_sink = isinstance(sink, (str, os.PathLike))
+        # request scope for the writer LIFETIME (obs/scope.py): created
+        # here, activated around each public method body (a writer is a
+        # multi-call operation), finished at close/abort.  A caller's
+        # active op_scope wins — the writer then attributes ambiently.
+        self._op = (_oscope.OpScope(
+            "write.file",
+            {"sink": os.fspath(sink) if self._own_sink
+             else type(sink).__name__})
+            if _oscope.current_op() is None else None)
         if self._own_sink:
             from .sink import AtomicFileSink, BufferedSink, FileSink
 
@@ -242,9 +257,10 @@ class ParquetWriter:
     def flush(self) -> None:
         """Write everything buffered, including the sub-group tail and any
         row group whose background encode is still in flight."""
-        self._check_open()
-        self._drain(final=True)
-        self._drain_inflight()
+        with self._op_active():
+            self._check_open()
+            self._drain(final=True)
+            self._drain_inflight()
 
     def _check_open(self) -> None:
         # buffering rows into a finalized writer would drop them silently —
@@ -307,6 +323,21 @@ class ParquetWriter:
         reading them after this call returns — do not mutate arrays handed
         to the writer until it has flushed (rebinding fresh arrays per
         group, as every built-in front end does, is always safe)."""
+        with self._op_active():
+            self._write_row_group_impl(columns, num_rows)
+
+    def _op_active(self):
+        """Activation of this writer's own op scope — the encode pool
+        submissions inside inherit it.  Checked per CALL, not just at
+        construction: a caller's op_scope active right now always wins
+        (the documented precedence), even for a writer built outside
+        any scope."""
+        if self._op is None or _oscope.current_op() is not None:
+            return _NULL_CM
+        return self._op.active()
+
+    def _write_row_group_impl(self, columns: Dict[str, ColumnData],
+                              num_rows: int) -> None:
         self._check_open()
         if len(self._row_groups) + (1 if self._inflight is not None
                                     else 0) >= MAX_ROW_GROUPS:
@@ -800,17 +831,26 @@ class ParquetWriter:
             return
         if self._aborted:
             raise ValueError("cannot close an aborted writer")
-        try:
-            self._close_impl()
-        except BaseException:
-            self._aborted = True
-            if self._own_sink:
-                self._f.abort()
-            raise
-        self._closed = True
-        # one publish per writer: the unified registry gets this write's
-        # totals exactly once, at the moment the bytes are committed
-        self.write_stats.publish()
+        with self._op_active():
+            try:
+                self._close_impl()
+            except BaseException:
+                self._aborted = True
+                if self._own_sink:
+                    self._f.abort()
+                if self._op is not None:
+                    # abort() early-returns once _aborted — finalize the
+                    # op HERE or the failed write (exactly the op slow-op
+                    # capture exists for) never records
+                    self._op.finish()
+                raise
+            self._closed = True
+            # one publish per writer: the unified registry gets this
+            # write's totals exactly once, at the moment the bytes are
+            # committed (publish() itself is idempotent as a backstop)
+            self.write_stats.publish()
+        if self._op is not None:
+            self._op.finish()
         if getattr(self._f, "_tunable", False):
             # feed the flush rate back to the process-wide buffer tuner
             # (sink.py): the NEXT writer's writeback buffer grows when this
@@ -841,6 +881,8 @@ class ParquetWriter:
             cancel_futures(encs)
         if self._own_sink:
             self._f.abort()
+        if self._op is not None:
+            self._op.finish()
 
     def _close_impl(self) -> None:
         self.flush()

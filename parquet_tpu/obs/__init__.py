@@ -10,7 +10,14 @@ through.
   gate (near-zero overhead off) writing Chrome trace-event JSON for
   Perfetto; ``PARQUET_TPU_TRACE=/path.json`` enables per process.
 - :mod:`parquet_tpu.obs.export` — Prometheus text-format rendering
-  (``python -m parquet_tpu stats --prom``).
+  (``python -m parquet_tpu stats --prom``) and the live scrape endpoint
+  (``start_metrics_server`` / ``stats --serve PORT``).
+- :mod:`parquet_tpu.obs.scope` — request-scoped telemetry:
+  ``op_scope(name)`` gives every operation its own identity (per-op
+  ``OpReport`` attribution across shared-pool workers, per-request
+  Perfetto tracks), with 1-in-N head sampling
+  (``PARQUET_TPU_TRACE_SAMPLE``) and slow-op tail capture
+  (``PARQUET_TPU_SLOW_OP_S`` / ``PARQUET_TPU_SLOW_LOG``).
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
@@ -23,11 +30,16 @@ from . import trace
 from .trace import (NULL_SPAN, disable_tracing, enable_tracing, enabled,
                     flush_trace, reset_trace, span, trace_events,
                     trace_span)
-from .export import render_prometheus
+from .export import (MetricsServer, render_prometheus,
+                     start_metrics_server)
+from . import scope
+from .scope import OpScope, current_op, maybe_op_scope, op_scope
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "metrics_delta",
            "metrics_snapshot", "pool_wait_seconds", "reset_metrics",
            "NULL_SPAN", "trace", "disable_tracing", "enable_tracing",
            "enabled", "flush_trace", "reset_trace", "span", "trace_events",
-           "trace_span", "render_prometheus"]
+           "trace_span", "render_prometheus", "MetricsServer",
+           "start_metrics_server", "scope", "OpScope", "current_op",
+           "maybe_op_scope", "op_scope"]

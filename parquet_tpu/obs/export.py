@@ -1,4 +1,5 @@
-"""Prometheus text-format rendering of the metrics registry.
+"""Prometheus text-format rendering of the metrics registry, and the
+live scrape endpoint.
 
 ``python -m parquet_tpu stats --prom`` (and any embedding application
 that wants to serve a ``/metrics`` endpoint) renders through here.  The
@@ -9,17 +10,26 @@ output follows the Prometheus exposition format 0.0.4:
 - one ``# HELP`` / ``# TYPE`` pair per family (label variants share it);
 - histograms render the standard cumulative ``_bucket{le="..."}`` series
   plus ``_sum`` and ``_count``.
+
+:func:`start_metrics_server` makes the registry scrapeable without a CLI
+hop: a stdlib ``http.server`` daemon thread serving ``/metrics``
+(Prometheus 0.0.4) and ``/metrics.json`` (the ``metrics_snapshot()``
+dict) — also reachable as ``python -m parquet_tpu stats --serve PORT``.
 """
 
 from __future__ import annotations
 
+import json
 import math
 import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .metrics import Counter, Gauge, Histogram, MetricsRegistry, REGISTRY
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+                      metrics_snapshot)
 
-__all__ = ["render_prometheus"]
+__all__ = ["render_prometheus", "start_metrics_server", "MetricsServer"]
 
 _PREFIX = "parquet_tpu_"
 _BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
@@ -88,3 +98,86 @@ def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
                          f"{_prom_value(m.sum)}")
             lines.append(f"{fam}_count{_label_str(m.labels)} {m.count}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# live scrape endpoint
+# ---------------------------------------------------------------------------
+
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    """GET-only handler: ``/metrics`` (Prometheus 0.0.4), ``/metrics.json``
+    (the ``metrics_snapshot()`` dict), ``/healthz`` (liveness)."""
+
+    server_version = "parquet-tpu-metrics/1.0"
+
+    def do_GET(self):  # noqa: N802 (http.server naming)
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics.json", "/metrics/json"):
+            body = json.dumps(metrics_snapshot(), sort_keys=True) \
+                .encode("utf-8")
+            ctype = "application/json"
+        elif path in ("/metrics", "/"):
+            body = render_prometheus(self.server._registry).encode("utf-8")
+            ctype = _PROM_CONTENT_TYPE
+        elif path == "/healthz":
+            body, ctype = b"ok\n", "text/plain; charset=utf-8"
+        else:
+            self.send_error(404, "unknown path (try /metrics)")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    """A running scrape endpoint: ``.port``/``.url`` to reach it,
+    ``.close()`` to stop it.  Context-manager friendly."""
+
+    def __init__(self, httpd: ThreadingHTTPServer, thread: threading.Thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.host, self.port = httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def join(self) -> None:
+        """Block until the server stops (the CLI's --serve foreground)."""
+        self._thread.join()
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
+                         registry: Optional[MetricsRegistry] = None
+                         ) -> MetricsServer:
+    """Serve the metrics registry over HTTP on a daemon thread:
+    ``/metrics`` in Prometheus exposition 0.0.4 and ``/metrics.json`` as
+    the snapshot dict.  ``port=0`` binds an ephemeral port (read it back
+    from the returned server's ``.port``).  Also reachable as
+    ``python -m parquet_tpu stats --serve PORT``."""
+    httpd = ThreadingHTTPServer((host, port), _MetricsHandler)
+    httpd.daemon_threads = True
+    httpd._registry = registry if registry is not None else REGISTRY
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="pq-metrics-server", daemon=True)
+    thread.start()
+    return MetricsServer(httpd, thread)

@@ -403,6 +403,9 @@ _CORE_COUNTERS = (
     ("planner.pages_considered", "pages considered by the page stage"),
     ("planner.pages_selected", "pages selected by the page stage"),
     ("read.retries", "transient pread retries performed"),
+    ("read.bytes_read", "bytes fetched from byte sources"),
+    ("scan.rows_pruned", "candidate rows excluded before decode by pruning"),
+    ("scan.rows_decoded", "survivor rows materialized by filtered scans"),
     ("read.rows_dropped", "rows lost to degraded-mode skips"),
     ("read.row_groups_skipped", "row groups dropped by degraded reads"),
     ("read.files_skipped", "whole files dropped by degraded reads"),
@@ -410,6 +413,11 @@ _CORE_COUNTERS = (
     ("write.bytes_flushed", "bytes flushed toward the OS by writers"),
     ("write.sink_flushes", "coalesced sink flushes"),
     ("trace.events_dropped", "trace events dropped at the buffer cap"),
+    # sampling decisions (obs/scope.py): fleets alert on trace-buffer
+    # pressure and sampler behavior from these
+    ("trace.ops_sampled", "ops head-sampled into the trace"),
+    ("trace.ops_skipped", "ops skipped by head sampling"),
+    ("trace.ops_slow_kept", "slow ops kept by tail capture"),
 )
 
 

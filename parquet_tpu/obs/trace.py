@@ -32,34 +32,61 @@ events and counts them in the ``trace.events_dropped`` metric instead of
 growing without bound.  While tracing is on, each completed span also
 feeds a ``span.<name>_s`` latency histogram in the metrics registry, so
 stage p50/p99 come for free with a traced run.
+
+Request scopes (obs/scope.py) route spans through two context variables
+here: ``_TRACK`` gives every span of an operation the op's own Perfetto
+"process" track (pid = op id, named by a one-time ``process_name``
+metadata event), and ``_SINK`` — set for ops head-sampling decided NOT to
+trace — diverts completed spans into a per-op :class:`OpRing` that is
+promoted to the global buffer only if the op turns out slow (tail
+capture) and discarded allocation-cheap otherwise.  Both are
+``contextvars``, so pool workers running an op's tasks inherit them via
+the context propagation in ``utils/pool.instrument_task``.
 """
 
 from __future__ import annotations
 
 import atexit
+import contextvars
 import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
 
 __all__ = ["TRACE_ENABLED", "trace_span", "span", "enabled",
            "enable_tracing", "disable_tracing", "flush_trace",
-           "trace_events", "reset_trace", "MAX_EVENTS"]
+           "trace_events", "reset_trace", "MAX_EVENTS", "OpRing",
+           "promote_ring", "emit_op_event"]
 
 TRACE_ENABLED = False
 MAX_EVENTS = 1_000_000
+# per-op ring capacity: bounds the allocation a never-kept op can pin
+OP_RING_EVENTS = 4096
 
 _LOCK = threading.Lock()
 _EVENTS: List[dict] = []
-_SEEN_TIDS: set = set()
+_SEEN_TIDS: set = set()   # (pid, tid) pairs with thread_name metadata out
+_SEEN_PIDS: Dict[int, str] = {}  # op pid -> label, process_name emitted
 _TRACE_PATH: Optional[str] = None
 _ATEXIT_REGISTERED = False
 # one epoch per process: span timestamps are µs since this mark, so every
 # thread's spans share one Perfetto timeline
 _EPOCH = time.perf_counter()
+
+# set by an active op scope (obs/scope.py): (pid, label) giving spans a
+# per-request Perfetto track, and the per-op ring for unsampled ops.
+# Context variables — pool workers inherit them with the op's context.
+_TRACK: "contextvars.ContextVar[Optional[Tuple[int, str]]]" = \
+    contextvars.ContextVar("parquet_tpu_trace_track", default=None)
+_SINK: "contextvars.ContextVar[Optional[OpRing]]" = \
+    contextvars.ContextVar("parquet_tpu_trace_sink", default=None)
+# stage-breakdown hook, bound by obs/scope.py at import: called as
+# (span_name, duration_s) for every completed span while tracing is on
+_ON_SPAN = None
 
 
 class _NullSpan:
@@ -120,29 +147,118 @@ class _Span:
             return False
         dur = t1 - self._t0
         _span_hist(self.name).observe(dur)
-        ev = {"name": self.name, "ph": "X", "pid": _PID, "tid": self._tid,
+        cb = _ON_SPAN
+        if cb is not None:
+            # per-op stage breakdown (obs/scope.py): metrics are never
+            # sampled, so the op's stage seconds accumulate even for spans
+            # the sampler diverts or discards
+            cb(self.name, dur)
+        track = _TRACK.get()
+        ev = {"name": self.name, "ph": "X",
+              "pid": track[0] if track is not None else _PID,
+              "tid": self._tid,
               "ts": round((self._t0 - _EPOCH) * 1e6, 3),
               "dur": round(dur * 1e6, 3),
               "cat": self.name.split(".", 1)[0]}
         if self.attrs:
             ev["args"] = {k: _jsonable(v) for k, v in self.attrs.items()}
-        with _LOCK:
-            if len(_EVENTS) >= MAX_EVENTS:
-                _metrics.counter("trace.events_dropped").inc()
-                return False
-            if self._tid not in _SEEN_TIDS:
-                # Perfetto names thread tracks from "M" metadata events —
-                # emitted once per thread so pool workers are labeled
-                _SEEN_TIDS.add(self._tid)
-                _EVENTS.append({
-                    "name": "thread_name", "ph": "M", "pid": _PID,
-                    "tid": self._tid,
-                    "args": {"name": threading.current_thread().name}})
-            _EVENTS.append(ev)
+        sink = _SINK.get()
+        if sink is not None:
+            # unsampled op: park in the per-op ring — no global lock, no
+            # metadata bookkeeping; promote_ring pays those only on keep
+            sink.append(ev, threading.current_thread().name)
+            return False
+        _append_global(ev, track, threading.current_thread().name)
         return False
 
 
 _PID = os.getpid()
+
+
+def _append_global(ev: dict, track, thread_name: str) -> None:
+    with _LOCK:
+        if len(_EVENTS) >= MAX_EVENTS:
+            _metrics.counter("trace.events_dropped").inc()
+            return
+        _ensure_meta_locked(ev["pid"], ev["tid"], track, thread_name)
+        _EVENTS.append(ev)
+
+
+def _ensure_meta_locked(pid: int, tid: int, track, thread_name: str) -> None:
+    """Emit the one-time Perfetto metadata naming this event's tracks:
+    ``process_name`` labels an op's per-request track group (pid = op id),
+    ``thread_name`` labels the worker thread inside it."""
+    if track is not None and pid not in _SEEN_PIDS:
+        _SEEN_PIDS[pid] = track[1]
+        _EVENTS.append({"name": "process_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": track[1]}})
+    key = (pid, tid)
+    if key not in _SEEN_TIDS:
+        # Perfetto names thread tracks from "M" metadata events —
+        # emitted once per (track, thread) so pool workers are labeled
+        _SEEN_TIDS.add(key)
+        _EVENTS.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": thread_name}})
+
+
+class OpRing:
+    """Per-op span buffer for ops head sampling decided not to trace:
+    bounded (oldest events drop first — a slow op's recent stages matter
+    most), lock-cheap, discarded whole when the op finishes fast, and
+    promoted into the global buffer by :func:`promote_ring` when tail
+    capture keeps the op."""
+
+    __slots__ = ("events", "dropped", "cap", "_lock")
+
+    def __init__(self, cap: int = OP_RING_EVENTS):
+        self.cap = cap
+        self.events: deque = deque()
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    def append(self, ev: dict, thread_name: str) -> None:
+        with self._lock:
+            if len(self.events) >= self.cap:
+                self.events.popleft()
+                self.dropped += 1
+            self.events.append((ev, thread_name))
+
+
+def promote_ring(ring: OpRing, track) -> None:
+    """Move a kept op's ring events into the global trace buffer (with the
+    metadata naming its track), accounting ring overflow and buffer-cap
+    drops in ``trace.events_dropped``."""
+    with ring._lock:
+        items = list(ring.events)
+        dropped = ring.dropped
+        ring.events.clear()
+        ring.dropped = 0
+    with _LOCK:
+        for i, (ev, tname) in enumerate(items):
+            if len(_EVENTS) >= MAX_EVENTS:
+                dropped += len(items) - i
+                break
+            _ensure_meta_locked(ev["pid"], ev["tid"], track, tname)
+            _EVENTS.append(ev)
+    if dropped:
+        _metrics.counter("trace.events_dropped").inc(dropped)
+
+
+def emit_op_event(name: str, track, t0: float, dur_s: float,
+                  attrs: Optional[Dict] = None) -> None:
+    """Record one whole-operation "X" span (obs/scope.py emits this at op
+    finish, covering the op's first activation to its last)."""
+    if not TRACE_ENABLED:
+        return
+    ev = {"name": name, "ph": "X",
+          "pid": track[0] if track is not None else _PID,
+          "tid": threading.get_ident(),
+          "ts": round((t0 - _EPOCH) * 1e6, 3),
+          "dur": round(dur_s * 1e6, 3),
+          "cat": name.split(".", 1)[0]}
+    if attrs:
+        ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+    _append_global(ev, track, threading.current_thread().name)
 
 
 def enabled() -> bool:
@@ -186,6 +302,7 @@ def reset_trace() -> None:
     with _LOCK:
         _EVENTS.clear()
         _SEEN_TIDS.clear()
+        _SEEN_PIDS.clear()
 
 
 def trace_events() -> List[dict]:
@@ -199,17 +316,33 @@ def flush_trace(path: Optional[str] = None) -> Optional[str]:
     form: ``{"traceEvents": [...]}``) — loadable by Perfetto
     (ui.perfetto.dev) and chrome://tracing.  Returns the path written, or
     None when there is no path to write to.  The buffer is kept (a later
-    flush rewrites the file with the fuller trace)."""
+    flush rewrites the file with the fuller trace).
+
+    Atomic, same pattern as ``AtomicFileSink``: the JSON lands in a
+    unique temp file, is fsynced, then ``os.replace``d over the
+    destination — a crash mid-flush leaves the previous trace intact
+    (never a truncated file Perfetto rejects), and a failed flush removes
+    its temp."""
     p = os.fspath(path) if path is not None else _TRACE_PATH
     if p is None:
         return None
     with _LOCK:
         events = list(_EVENTS)
     body = {"traceEvents": events, "displayTimeUnit": "ms"}
-    tmp = p + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(body, f)
-    os.replace(tmp, p)
+    tmp = f"{p}.{os.getpid()}.tmp"  # unique per process: concurrent
+    # flushers to one path race at the replace, not inside the write
+    try:
+        with open(tmp, "w") as f:
+            json.dump(body, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return p
 
 

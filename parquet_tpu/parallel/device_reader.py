@@ -28,6 +28,7 @@ paths stay on device.
 
 from __future__ import annotations
 
+import contextvars
 import struct as _struct
 from dataclasses import dataclass, field
 from functools import partial
@@ -1261,8 +1262,14 @@ def decode_chunk_batched(reader: ColumnChunkReader,
     shared_dict_host = None
     shared_dict_staged = None
     kind0 = None
+    # the staging workers must run under the caller's op scope
+    # (obs/scope.py): their preads account to the operation, same as
+    # shared-pool tasks (one ctx copy per task — Contexts refuse
+    # concurrent re-entry)
+    ctx = contextvars.copy_context()
     with ThreadPoolExecutor(max_workers=max(workers, 1)) as pool:
-        futs = [pool.submit(plan_batch, i, b) for i, b in enumerate(batches)]
+        futs = [pool.submit(ctx.copy().run, plan_batch, i, b)
+                for i, b in enumerate(batches)]
         for i, fut in enumerate(futs):
             plan = fut.result()
             futs[i] = None  # release: bounds live plan memory to in-flight
@@ -1365,11 +1372,14 @@ def _decode_chunks_pipelined_impl(chunks, keep_dictionary: bool,
         finally:
             with lock:
                 active["n"] -= 1
+    # staging preads attribute to the caller's op scope (see
+    # decode_chunk_batched): fresh ctx copy per submitted task
+    ctx = contextvars.copy_context()
     with ThreadPoolExecutor(max_workers=max(workers, 1)) as pool:
         pending = []
         it = iter(chunks)
         for reader in it:
-            pending.append(pool.submit(prep, reader))
+            pending.append(pool.submit(ctx.copy().run, prep, reader))
             if len(pending) > workers:
                 break
         i = 0
@@ -1379,7 +1389,7 @@ def _decode_chunks_pipelined_impl(chunks, keep_dictionary: bool,
             i += 1             # bounded to the in-flight window
             nxt = next(it, None)
             if nxt is not None:
-                pending.append(pool.submit(prep, nxt))
+                pending.append(pool.submit(ctx.copy().run, prep, nxt))
             if err is not None:
                 counters.inc("chunks_host_fallback")
                 yield decode_chunk_host(reader)

@@ -20,13 +20,17 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..errors import CorruptedError, DeadlineError
+from ..obs import scope as _oscope
 from ..obs import trace as _otrace
+from ..obs.metrics import counter as _ocounter
 from ..obs.metrics import histogram as _ohistogram
 from ..obs.metrics import pool_wait_seconds as _pool_wait_seconds
 
 # resolved once: per-file observation must not take the registry's
 # get-or-create lock (only the metric's own)
 _M_SCAN_FILE_S = _ohistogram("dataset.scan_file_s")
+_M_ROWS_PRUNED = _ocounter("scan.rows_pruned")
+_M_ROWS_DECODED = _ocounter("scan.rows_decoded")
 from ..io.faults import (FaultPolicy, ReadReport, read_context,
                          resolve_policy)
 from ..io.reader import ParquetFile
@@ -117,9 +121,12 @@ def scan_expr(pf: ParquetFile, where, columns: Optional[Sequence[str]] = None,
     file/row-group/column.
     """
     pol, report = resolve_policy(pf, policy, report)
-    with pf._resilient_op(policy, report, "scan_expr"):
-        return _scan_expr_impl(pf, where, columns, num_threads, use_bloom,
-                               pol, report)
+    # request scope (obs/scope.py): joins the caller's (or the dataset
+    # layer's) op when one is active, else this scan is its own op
+    with _oscope.maybe_op_scope("file.scan", file=pf._path):
+        with pf._resilient_op(policy, report, "scan_expr"):
+            return _scan_expr_impl(pf, where, columns, num_threads,
+                                   use_bloom, pol, report)
 
 
 class _SpanFailure:
@@ -452,6 +459,11 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
             out[c] = np.empty(0, dt or np.uint8)
     if report is not None and out_cols:
         report.rows_read += len(out[out_cols[0]])
+    # OpReport attribution: rows the pushdown never decoded vs survivor
+    # rows materialized (masks are final here — degraded drops included)
+    _oscope.account(_M_ROWS_PRUNED, int(pf.num_rows) - cand_rows)
+    _oscope.account(_M_ROWS_DECODED,
+                    int(sum(int(m.sum()) for m in masks)))
     return out
 
 
@@ -1159,6 +1171,15 @@ def scan(pf: ParquetFile, path: str, lo=None, hi=None,
     :func:`scan_filtered_device` directly, or pin
     ``PARQUET_TPU_ROUTE=host|device``.  Plain-string OUTPUT columns ride
     the device route as host (values, offsets) survivor pairs."""
+    # request scope over route + attempt(s): the route decision and any
+    # device-attempt fallback all attribute to one op
+    with _oscope.maybe_op_scope("file.scan", file=pf._path):
+        return _scan_routed(pf, path, lo, hi, columns, use_bloom, values,
+                            policy, report)
+
+
+def _scan_routed(pf, path, lo, hi, columns, use_bloom, values, policy,
+                 report):
     import dataclasses
     import time
 

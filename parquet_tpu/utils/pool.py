@@ -11,12 +11,14 @@ smokes run width 1 vs N; results must be identical).
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+from ..obs import scope as _scope
 from ..obs import trace as _trace
 from ..obs.metrics import counter as _counter
 from ..obs.metrics import histogram as _histogram
@@ -63,18 +65,35 @@ def instrument_task(fn, name: "Optional[str]" = None):
     ``pool.task`` span carrying its worker-thread id.  Used by
     :func:`submit` and by direct ``shared_pool().map`` dispatchers
     (host_scan's fan-out) — a map that skipped this would hide exactly the
-    queueing the router exists to observe."""
+    queueing the router exists to observe.
+
+    The dispatcher's context is captured here too (``contextvars.
+    copy_context``) and each run executes inside a fresh copy of it, so
+    the active op scope (obs/scope.py) — its per-op accounting, trace
+    track, and sampling ring — follows the work onto the worker thread.
+    A fresh ``ctx.copy()`` per run, not one shared ctx: one wrapped fn is
+    mapped over many items concurrently (host_scan's fan-out), and a
+    Context object refuses concurrent re-entry."""
     t_submit = time.perf_counter()
+    ctx = contextvars.copy_context()
 
     def run(*a, **k):
-        _QUEUE_WAIT.observe(time.perf_counter() - t_submit)
-        _TASKS.inc()
-        if _trace.TRACE_ENABLED:
-            with _trace.span("pool.task", fn=name):
-                return fn(*a, **k)
-        return fn(*a, **k)
+        return ctx.copy().run(_run_instrumented, fn, name, t_submit, a, k)
 
     return run
+
+
+def _run_instrumented(fn, name, t_submit: float, a, k):
+    wait = time.perf_counter() - t_submit
+    _QUEUE_WAIT.observe(wait)
+    # per-op mirror of the queue wait: runs inside the propagated
+    # context, so the wait attributes to the op that dispatched the task
+    _scope.add_to_current("pool.queue_wait_s", wait)
+    _scope.account(_TASKS)
+    if _trace.TRACE_ENABLED:
+        with _trace.span("pool.task", fn=name):
+            return fn(*a, **k)
+    return fn(*a, **k)
 
 
 def submit(fn, *args, **kwargs):

@@ -442,6 +442,115 @@ assert off <= on * 1.03, f"tracing-off slower than tracing-on: {off:.4f}s vs {on
 print(f"overhead ok: disabled gate {per_call * 1e9:.0f}ns/call, "
       f"warm read off={off * 1e3:.1f}ms on={on * 1e3:.1f}ms")
 OVEOF
+echo "=== request-scope smoke (sampling + slow-log + scrape + overhead) ==="
+python - "$TELEM_DIR" <<'SCOPEOF'
+# ISSUE 8: request-scoped telemetry.  (1) 1-in-8 head sampling over 32
+# warm ops keeps >=1 and <all op traces; (2) slow threshold 0 captures
+# every op to the JSONL (tracing off — capture is independent); (3) the
+# scrape endpoint serves the pre-declared families; (4) always-on
+# sampled-mode overhead on a warm read stays <= 1.05x tracing-off.
+import io
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import (ParquetFile, disable_tracing, enable_tracing,
+                         start_metrics_server)
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.obs import reset_trace, trace_events
+from parquet_tpu.obs.metrics import REGISTRY
+
+d = sys.argv[1]
+t = pa.table({"x": pa.array(np.arange(1_000_000, dtype=np.int64))})
+buf = io.BytesIO()
+write_table(t, buf, WriterOptions(row_group_size=250_000))
+raw = buf.getvalue()
+ParquetFile(raw).read()  # warm one-time state
+
+os.environ["PARQUET_TPU_TRACE_SAMPLE"] = "8"
+enable_tracing()
+s0 = REGISTRY.counter("trace.ops_sampled").value
+k0 = REGISTRY.counter("trace.ops_skipped").value
+for _ in range(32):
+    ParquetFile(raw).read()
+disable_tracing()
+sampled = REGISTRY.counter("trace.ops_sampled").value - s0
+skipped = REGISTRY.counter("trace.ops_skipped").value - k0
+assert sampled + skipped == 32, (sampled, skipped)
+assert 1 <= sampled < 32, sampled
+ops_traced = {e["pid"] for e in trace_events()
+              if e["ph"] == "X" and e["name"] == "op.file.read"}
+assert len(ops_traced) == sampled, (len(ops_traced), sampled)
+reset_trace()
+
+slow = os.path.join(d, "slow.jsonl")
+os.environ["PARQUET_TPU_SLOW_OP_S"] = "0"
+os.environ["PARQUET_TPU_SLOW_LOG"] = slow
+for _ in range(5):
+    ParquetFile(raw).read()
+del os.environ["PARQUET_TPU_SLOW_OP_S"], os.environ["PARQUET_TPU_SLOW_LOG"]
+recs = [json.loads(ln) for ln in open(slow)]
+mine = [r for r in recs if r["name"] == "file.read"]
+assert len(mine) == 5, len(mine)
+assert all(r["report"].get("read.bytes_read", 0) > 0 for r in mine)
+
+srv = start_metrics_server(0)
+text = urllib.request.urlopen(srv.url, timeout=5).read().decode()
+for fam in ("parquet_tpu_cache_footer_hits_total",
+            "parquet_tpu_trace_events_dropped_total",
+            "parquet_tpu_trace_ops_sampled_total",
+            "parquet_tpu_trace_ops_skipped_total",
+            "parquet_tpu_trace_ops_slow_kept_total",
+            "parquet_tpu_read_bytes_read_total"):
+    assert fam in text, fam
+snap = json.loads(urllib.request.urlopen(srv.url + ".json",
+                                         timeout=5).read())
+assert "counters" in snap and "histograms" in snap
+srv.close()
+
+
+def timed(reps=7):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ParquetFile(raw).read()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+off = timed()
+enable_tracing()  # TRACE_SAMPLE=8 still set: the production sampled mode
+on = timed()
+disable_tracing()
+reset_trace()
+del os.environ["PARQUET_TPU_TRACE_SAMPLE"]
+assert on <= off * 1.05, \
+    f"sampled tracing costs >5% on a warm read: off={off:.4f}s on={on:.4f}s"
+print(f"request-scope smoke ok: {sampled}/32 ops sampled, 5 slow records, "
+      f"scrape families ok, warm read off={off * 1e3:.1f}ms "
+      f"sampled={on * 1e3:.1f}ms")
+SCOPEOF
+python -m parquet_tpu stats --serve 0 > "$TELEM_DIR/serve.log" 2>&1 &
+SRV_PID=$!
+for i in $(seq 1 50); do
+    grep -q "serving metrics on" "$TELEM_DIR/serve.log" && break
+    sleep 0.2
+done
+SRV_URL=$(sed -n 's/serving metrics on \(http[^ ]*\).*/\1/p' "$TELEM_DIR/serve.log")
+python -c "
+import sys, urllib.request
+t = urllib.request.urlopen(sys.argv[1], timeout=5).read().decode()
+assert 'parquet_tpu_trace_ops_sampled_total' in t
+assert 'parquet_tpu_cache_footer_hits_total' in t
+print('stats --serve ok:', sys.argv[1])
+" "$SRV_URL"
+kill $SRV_PID
+wait $SRV_PID 2>/dev/null || true
 rm -rf "$TELEM_DIR"
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "

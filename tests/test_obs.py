@@ -355,7 +355,14 @@ def test_prometheus_required_families_after_scan(tmp_path):
                 "parquet_tpu_cache_chunk_hits_total",
                 "parquet_tpu_prefetch_hits_total",
                 "parquet_tpu_planner_rg_considered_total",
-                "parquet_tpu_route_chosen_total"):
+                "parquet_tpu_route_chosen_total",
+                # trace-buffer pressure + sampling decisions (ISSUE 8):
+                # fleets alert on these, so they must render even at 0
+                "parquet_tpu_trace_events_dropped_total",
+                "parquet_tpu_trace_ops_sampled_total",
+                "parquet_tpu_trace_ops_skipped_total",
+                "parquet_tpu_trace_ops_slow_kept_total",
+                "parquet_tpu_read_bytes_read_total"):
         assert fam in text, fam
     # the planner cascade really ran: its registry counters moved
     m = re.search(r"parquet_tpu_planner_rg_considered_total (\d+)", text)

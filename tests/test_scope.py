@@ -1,0 +1,532 @@
+"""Request-scoped telemetry (obs/scope.py): per-op attribution across
+shared-pool workers (context propagation through submit/map_in_order/
+instrument_task), exact per-op vs process-global accounting under
+concurrency, head sampling + slow-op tail capture, slow-op JSONL records,
+per-request Perfetto tracks, publish idempotence, atomic trace flush, and
+the live metrics endpoint."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import parquet_tpu.utils.pool as pool_mod
+from parquet_tpu import Dataset, ParquetFile, obs, op_scope
+from parquet_tpu.io.prefetch import ReadStats
+from parquet_tpu.io.sink import WriteStats
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.obs import (metrics_delta, metrics_snapshot,
+                             start_metrics_server)
+from parquet_tpu.obs import scope as scope_mod
+from parquet_tpu.obs import trace as trace_mod
+from parquet_tpu.obs.metrics import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    """Tracing is process-global: every test starts and ends disabled with
+    an empty buffer so span assertions never see a neighbor's events."""
+    obs.disable_tracing()
+    obs.reset_trace()
+    yield
+    obs.disable_tracing()
+    obs.reset_trace()
+
+
+@pytest.fixture
+def wide_pool(monkeypatch):
+    """A real 8-wide shared pool with the fan-out gates opened (the CI box
+    may have 1 core), reset after the test."""
+    monkeypatch.setenv("PARQUET_TPU_POOL_WORKERS", "8")
+    monkeypatch.setattr(pool_mod, "_POOL", None)
+    monkeypatch.setattr(pool_mod, "available_cpus", lambda: 8)
+    yield
+    monkeypatch.setattr(pool_mod, "_POOL", None)
+
+
+def _write_file(path, n=100_000, row_groups=4, seed=0, **opts):
+    t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64)),
+                  "b": pa.array(np.random.default_rng(seed).random(n))})
+    write_table(t, path, WriterOptions(row_group_size=n // row_groups,
+                                       **opts))
+    return t
+
+
+# ------------------------------------------------------------- basic API
+
+def test_op_scope_report_and_delta_shape():
+    with op_scope("t.basic", user="u1") as op:
+        scope_mod.account_bytes(123)
+        scope_mod.add_to_current("pool.queue_wait_s", 0.25)
+    rep = op.report()
+    assert rep["name"] == "t.basic" and rep["attrs"] == {"user": "u1"}
+    assert rep["bytes_read"] == 123
+    assert rep["pool_wait_s"] == pytest.approx(0.25)
+    assert rep["duration_s"] is not None and rep["duration_s"] >= 0
+    d = op.metrics_delta()
+    assert d["counters"]["read.bytes_read"] == 123
+    # the scope is gone from the context after exit
+    assert scope_mod.current_op() is None
+
+
+def test_maybe_op_scope_joins_ambient():
+    with op_scope("t.outer") as outer:
+        with scope_mod.maybe_op_scope("t.inner") as got:
+            assert got is outer  # no new identity: attribution joins
+            scope_mod.account_bytes(7)
+    assert outer.report()["bytes_read"] == 7
+
+
+def test_public_surfaces_attribute_to_explicit_scope(tmp_path):
+    path = str(tmp_path / "f.parquet")
+    _write_file(path, n=50_000)
+    with op_scope("t.surface") as op:
+        pf = ParquetFile(path)
+        pf.read()
+        pf.close()
+    rep = op.report()
+    assert rep["bytes_read"] > 0  # the read's preads landed in THIS op
+
+
+# ---------------------------------------- exact accounting (acceptance)
+
+# the co-located keys the acceptance criterion sums (ints exact)
+_EXACT_KEYS = ("read.bytes_read", "cache.footer_hits", "cache.footer_misses",
+               "cache.chunk_hits", "cache.chunk_misses", "prefetch.hits",
+               "prefetch.misses", "prefetch.windows_issued",
+               "prefetch.bytes_prefetched", "prefetch.bytes_discarded",
+               "pool.tasks", "read.retries")
+
+
+def test_two_concurrent_scoped_scans_sum_to_global_delta(tmp_path,
+                                                         wide_pool):
+    """THE acceptance shape: two concurrent op_scope-wrapped Dataset.scans
+    on the shared pool yield per-op reports whose bytes/pool-wait/cache
+    counters sum EXACTLY to the process-global metrics_delta() for the
+    window — zero cross-op smearing."""
+    for i in range(4):
+        _write_file(str(tmp_path / f"f{i}.parquet"), n=120_000, seed=i)
+    ds = {t: Dataset(str(tmp_path / "*.parquet")) for t in ("x", "y")}
+    ops = {}
+    barrier = threading.Barrier(2)
+
+    def run(tag):
+        barrier.wait()  # really concurrent, not accidentally serial
+        with op_scope("serving.scan", tag=tag) as op:
+            got = ds[tag].scan("a", lo=100, hi=60_000, columns=["b"])
+        ops[tag] = op
+        assert len(got["b"]) == 4 * 59_901  # every file holds the range
+
+    before = metrics_snapshot()
+    threads = [threading.Thread(target=run, args=(t,)) for t in ("x", "y")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    delta = metrics_delta(before, metrics_snapshot())
+    cx, cy = ops["x"].counters(), ops["y"].counters()
+    for key in _EXACT_KEYS:
+        per_op = cx.get(key, 0) + cy.get(key, 0)
+        assert per_op == delta["counters"].get(key, 0), key
+    # pool-wait seconds: per-op float mirrors sum to the global histogram
+    # deltas (same observations; snapshot sums are rounded to 6 decimals)
+    for key in ("pool.queue_wait_s", "prefetch.wait_s"):
+        g = delta["histograms"].get(key, {}).get("sum", 0.0)
+        assert cx.get(key, 0.0) + cy.get(key, 0.0) == pytest.approx(
+            g, abs=5e-6), key
+    # no smearing, and both ops really did work
+    for c in (cx, cy):
+        assert c["read.bytes_read"] > 0
+        assert c["pool.tasks"] > 0
+    for t in ds.values():
+        t.close()
+
+
+def test_interleaved_scopes_8_worker_hammer(wide_pool):
+    """PR-7's 8-worker exact-accounting contract, extended to two
+    interleaved scopes: every pooled increment lands in its own scope's
+    mirror, totals exact on both sides."""
+    c = REGISTRY.counter("t.scope_hammer")
+    per_task, tasks = 2_000, 16
+    before = c.value
+    ops = {}
+    barrier = threading.Barrier(2)
+
+    def work(_i):
+        for _ in range(per_task):
+            scope_mod.account(c)
+
+    def run(tag):
+        barrier.wait()
+        with op_scope("t.hammer", tag=tag) as op:
+            futs = [pool_mod.submit(work, i) for i in range(tasks)]
+            for f in futs:
+                f.result()
+        ops[tag] = op
+
+    threads = [threading.Thread(target=run, args=(t,)) for t in ("x", "y")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value - before == 2 * per_task * tasks
+    for tag in ("x", "y"):
+        assert ops[tag].counters()["t.scope_hammer"] == per_task * tasks
+
+
+# -------------------------------------------------- context propagation
+
+def test_nested_pool_serial_fallback_keeps_scope(wide_pool):
+    """A pool worker spawning map_in_order falls back to serial (the
+    nested-pool deadlock guard) — the scope still follows into the
+    serial-inside-worker calls."""
+    c = REGISTRY.counter("t.nested_pool")
+
+    def leaf(_):
+        assert pool_mod.in_shared_pool()
+        scope_mod.account(c)
+        return scope_mod.current_op().name
+
+    def worker():
+        # inside a shared-pool worker: map_in_order must go serial
+        return pool_mod.map_in_order(leaf, range(4))
+
+    with op_scope("t.nested") as op:
+        got = pool_mod.submit(worker).result()
+    assert got == ["t.nested"] * 4
+    assert op.counters()["t.nested_pool"] == 4
+
+
+def test_map_in_order_serial_branch_keeps_scope():
+    c = REGISTRY.counter("t.serial_map")
+    with op_scope("t.serial") as op:
+        pool_mod.map_in_order(lambda i: scope_mod.account(c), range(3),
+                              parallel=False)
+    assert op.counters()["t.serial_map"] == 3
+
+
+def test_scope_survives_prefetch_ring_workers(tmp_path, monkeypatch,
+                                              wide_pool):
+    """The ring prefetcher's window fills run as pool callbacks — their
+    preads and the drain-close publish must attribute to the op that
+    planned them."""
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    path = str(tmp_path / "ring.parquet")
+    _write_file(path, n=200_000)
+    with op_scope("t.ringdrain") as op:
+        pf = ParquetFile(path)
+        for _ in pf.iter_batches(batch_rows=50_000):
+            pass
+        pf.close()
+    c = op.counters()
+    assert c["prefetch.windows_issued"] > 0  # publish landed in the op
+    assert c["read.bytes_read"] > 0          # worker preads followed it
+
+
+def test_early_terminated_drain_attributes_close_to_its_op(tmp_path,
+                                                           monkeypatch,
+                                                           wide_pool):
+    """Breaking out of a drain mid-way closes the prefetcher from the
+    consumer's frame — the close-time ReadStats.publish must still land
+    in the ITERATOR's op (scoped_iter closes inside an activation), so
+    per-op sums keep equaling the global delta."""
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    path = str(tmp_path / "early.parquet")
+    _write_file(path, n=200_000)
+    before = metrics_snapshot()
+    pf = ParquetFile(path)
+    it = pf.iter_batches(batch_rows=25_000)
+    next(it)
+    it.close()  # early termination, no scope active in the consumer
+    pf.close()
+    op = None  # the drain made its own op: recover its totals via delta
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d.get("prefetch.windows_issued", 0) > 0
+    # and inside an explicit scope, the op's mirror gets those counters
+    before = metrics_snapshot()
+    pf = ParquetFile(path)
+    with op_scope("t.early") as op:
+        it = pf.iter_batches(batch_rows=25_000)
+        next(it)
+        it.close()
+    pf.close()
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    c = op.counters()
+    assert c.get("prefetch.windows_issued", 0) == \
+        d.get("prefetch.windows_issued", 0) > 0
+
+
+def test_report_on_live_op_is_race_safe():
+    stop = threading.Event()
+    errs = []
+
+    def poll(op):
+        while not stop.is_set():
+            try:
+                op.report()
+            except Exception as e:  # pragma: no cover - the regression
+                errs.append(e)
+                return
+
+    with op_scope("t.live") as op:
+        th = threading.Thread(target=poll, args=(op,))
+        th.start()
+        for _ in range(200):
+            with op.active():
+                pass
+        stop.set()
+        th.join()
+    assert errs == []
+
+
+def test_failed_writer_close_finishes_op(tmp_path, monkeypatch):
+    from parquet_tpu.io.writer import ParquetWriter, schema_from_arrow
+    t = pa.table({"x": pa.array(np.arange(100))})
+    w = ParquetWriter(str(tmp_path / "boom.parquet"),
+                      schema_from_arrow(t.schema))
+    w.write({"x": _as_cd(t)}, 100)
+    monkeypatch.setattr(w, "_close_impl",
+                        lambda: (_ for _ in ()).throw(OSError("enospc")))
+    with pytest.raises(OSError):
+        w.close()
+    assert w._op is not None and w._op.duration_s is not None  # finalized
+
+
+def _as_cd(t):
+    from parquet_tpu.io.writer import ColumnData
+    return ColumnData(values=t.column("x").to_numpy())
+
+
+def test_scoped_iter_does_not_leak_between_pulls(tmp_path):
+    """PEP 567: generators don't isolate context — scoped_iter activates
+    per pull, so between batches the CONSUMER context carries no scope."""
+    path = str(tmp_path / "it.parquet")
+    _write_file(path, n=40_000)
+    pf = ParquetFile(path)
+    it = pf.iter_batches(batch_rows=10_000)
+    got = next(it)
+    assert got.num_rows > 0
+    assert scope_mod.current_op() is None  # no leak into the consumer
+    for _ in it:
+        pass
+    pf.close()
+
+
+# ------------------------------------------------ sampling + slow capture
+
+def test_head_sampling_traces_1_in_n(tmp_path, monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_TRACE_SAMPLE", "4")
+    # fresh sampling block: the random-phase state is process-global
+    monkeypatch.setattr(scope_mod, "_SAMPLE_N", None)
+    obs.enable_tracing()
+    sampled_before = REGISTRY.counter("trace.ops_sampled").value
+    skipped_before = REGISTRY.counter("trace.ops_skipped").value
+    kept_ids, all_ids = [], []
+    for i in range(8):
+        with op_scope("t.sampled", i=i) as op:
+            with obs.trace_span("t.inner", i=i):
+                pass
+        all_ids.append(op.op_id)
+        if op.sampled:
+            kept_ids.append(op.op_id)
+    obs.disable_tracing()
+    # 8 ops over two fresh blocks of 4: exactly one sampled per block
+    # (random phase inside the block — no stride bias across op classes)
+    assert len(kept_ids) == 2
+    assert REGISTRY.counter("trace.ops_sampled").value - sampled_before == 2
+    assert REGISTRY.counter("trace.ops_skipped").value - skipped_before == 6
+    evs = [e for e in obs.trace_events() if e["ph"] == "X"]
+    # spans recorded ONLY for the sampled ops, on per-op tracks
+    inner = [e for e in evs if e["name"] == "t.inner"]
+    assert {e["pid"] - 1_000_000 for e in inner} == set(kept_ids)
+    op_spans = [e for e in evs if e["name"] == "op.t.sampled"]
+    assert {e["pid"] - 1_000_000 for e in op_spans} == set(kept_ids)
+    # sampled ops' tracks are named by process_name metadata
+    metas = [e for e in obs.trace_events()
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert {e["pid"] - 1_000_000 for e in metas} == set(kept_ids)
+
+
+def test_tail_capture_promotes_slow_unsampled_ops(tmp_path, monkeypatch):
+    """With a 0-second slow threshold every unsampled op's ring promotes:
+    the trace holds spans for ALL ops despite 1-in-N head sampling."""
+    monkeypatch.setenv("PARQUET_TPU_TRACE_SAMPLE", "1000000")
+    monkeypatch.setenv("PARQUET_TPU_SLOW_OP_S", "0")
+    slow_before = REGISTRY.counter("trace.ops_slow_kept").value
+    obs.enable_tracing()
+    ids = []
+    for i in range(3):
+        with op_scope("t.tail", i=i) as op:
+            with obs.trace_span("t.tail_inner", i=i):
+                pass
+        ids.append(op.op_id)
+    obs.disable_tracing()
+    evs = [e for e in obs.trace_events() if e["ph"] == "X"]
+    inner = {e["pid"] - 1_000_000 for e in evs
+             if e["name"] == "t.tail_inner"}
+    assert inner == set(ids), "slow ops' rings were not promoted"
+    assert {e["pid"] - 1_000_000 for e in evs
+            if e["name"] == "op.t.tail"} == set(ids)
+    assert REGISTRY.counter("trace.ops_slow_kept").value - slow_before >= 3
+
+
+def test_fast_unsampled_ops_leave_no_spans(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_TRACE_SAMPLE", "1000000")
+    obs.enable_tracing()
+    with op_scope("t.fast") as op:
+        with obs.trace_span("t.fast_inner"):
+            pass
+    obs.disable_tracing()
+    assert op.sampled is False
+    names = {e["name"] for e in obs.trace_events()}
+    assert "t.fast_inner" not in names and "op.t.fast" not in names
+    # ...but metrics are never sampled: the span histogram still moved
+    assert REGISTRY.histogram("span.t.fast_inner_s").count >= 1
+
+
+def test_slow_log_jsonl_records(tmp_path, monkeypatch):
+    log = tmp_path / "slow.jsonl"
+    monkeypatch.setenv("PARQUET_TPU_SLOW_OP_S", "0")
+    monkeypatch.setenv("PARQUET_TPU_SLOW_LOG", str(log))
+    obs.enable_tracing()  # stages come from span exits
+    path = str(tmp_path / "s.parquet")
+    _write_file(path, n=30_000)
+    with op_scope("serving.read") as op:
+        ParquetFile(path).read()
+    obs.disable_tracing()
+    recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    mine = [r for r in recs if r["name"] == "serving.read"]
+    assert len(mine) == 1
+    r = mine[0]
+    assert r["op"] == op.op_id
+    assert r["duration_s"] >= 0
+    assert r["report"]["read.bytes_read"] > 0
+    assert any(k.startswith("decode.") or k.startswith("open.")
+               for k in r["stages"]), r["stages"]
+    # the write_table above was an op too (threshold 0 keeps every op)
+    assert any(rec["name"] == "write.file" for rec in recs)
+
+
+# ------------------------------------------------- publish idempotence
+
+def test_readstats_publish_idempotent():
+    before = metrics_snapshot()
+    rs = ReadStats(windows_issued=3, bytes_prefetched=100)
+    rs.publish()
+    rs.publish()  # double-close path: must not double the registry
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d["prefetch.windows_issued"] == 3
+    assert d["prefetch.bytes_prefetched"] == 100
+
+
+def test_writestats_publish_idempotent():
+    before = metrics_snapshot()
+    ws = WriteStats(row_groups=2, bytes_flushed=50)
+    ws.publish()
+    ws.publish()
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d["write.row_groups"] == 2
+    assert d["write.bytes_flushed"] == 50
+
+
+def test_prefetcher_double_close_publishes_once(tmp_path, monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    path = str(tmp_path / "dc.parquet")
+    _write_file(path, n=150_000)
+    before = metrics_snapshot()
+    pf = ParquetFile(path)
+    last = None
+    for last in pf.iter_batches(batch_rows=50_000):
+        pass
+    rs = last.read_stats
+    assert rs is not None and rs.windows_issued > 0
+    rs.publish()  # a second close/publish after the drain already did
+    pf.close()
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d["prefetch.windows_issued"] == rs.windows_issued
+
+
+def test_writer_double_close_publishes_once(tmp_path):
+    before = metrics_snapshot()
+    w = write_table(pa.table({"x": pa.array(np.arange(1000))}),
+                    str(tmp_path / "w.parquet"),
+                    WriterOptions(row_group_size=500))
+    w.close()  # second close: early-returns
+    w.write_stats.publish()  # and even a direct re-publish is a no-op
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d["write.row_groups"] == 2
+
+
+def test_writer_lifetime_is_one_op(tmp_path):
+    w = write_table(pa.table({"x": pa.array(np.arange(2000))}),
+                    str(tmp_path / "op.parquet"),
+                    WriterOptions(row_group_size=1000))
+    op = w._op
+    assert op is not None and op.duration_s is not None
+    assert op.counters()["write.row_groups"] == 2
+
+
+# ---------------------------------------------------- atomic trace flush
+
+def test_flush_trace_is_atomic_on_failure(tmp_path, monkeypatch):
+    path = tmp_path / "trace.json"
+    obs.enable_tracing(path)
+    with obs.trace_span("t.atomic"):
+        pass
+    obs.disable_tracing()
+    assert obs.flush_trace() == str(path)
+    good = path.read_text()
+    json.loads(good)  # valid
+
+    def boom(*a, **k):
+        raise OSError("disk died mid-serialize")
+
+    monkeypatch.setattr(trace_mod.json, "dump", boom)
+    with pytest.raises(OSError):
+        obs.flush_trace()
+    monkeypatch.undo()
+    # the previous trace is intact and no temp litter remains
+    assert path.read_text() == good
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+# ---------------------------------------------------- metrics endpoint
+
+def test_metrics_server_scrape_endpoints():
+    with start_metrics_server(0) as srv:
+        assert srv.port > 0
+        text = urllib.request.urlopen(srv.url, timeout=5).read().decode()
+        for fam in ("parquet_tpu_cache_footer_hits_total",
+                    "parquet_tpu_trace_events_dropped_total",
+                    "parquet_tpu_trace_ops_sampled_total",
+                    "parquet_tpu_trace_ops_skipped_total",
+                    "parquet_tpu_trace_ops_slow_kept_total",
+                    "parquet_tpu_read_bytes_read_total"):
+            assert fam in text, fam
+        snap = json.loads(urllib.request.urlopen(
+            srv.url + ".json", timeout=5).read().decode())
+        assert "counters" in snap and "histograms" in snap
+        assert "trace.ops_sampled" in snap["counters"]
+        ok = urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/healthz", timeout=5)
+        assert ok.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/nope", timeout=5)
+    # closed: the port no longer answers
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(srv.url, timeout=0.5)
+
+
+def test_metrics_server_sees_live_updates():
+    with start_metrics_server(0) as srv:
+        c = REGISTRY.counter("t.live_scrape")
+        base = c.value
+        c.inc(5)
+        text = urllib.request.urlopen(srv.url, timeout=5).read().decode()
+        assert f"parquet_tpu_t_live_scrape_total {base + 5}" in text
