@@ -21,6 +21,12 @@ Algebra
   merge_row_groups (MergeRowGroups), convert_table (Convert)
 Pushdown
   find (parquet.Find), plan_scan, prune_row_group, pages_overlapping
+Point lookups
+  find_rows / ParquetFile.find_rows / Dataset.find_rows (batched keyed
+  lookups: stats → batched bloom → page-index search → coalesced
+  single-page reads; page-granular cache tier, FIFO bytes-budget
+  admission control via ``PARQUET_TPU_LOOKUP_BUDGET``, ``lookup.*``
+  p50/p99 meters), KeyHits/LookupResult
 Scan planning
   col/And/Or/Not (predicate trees over range/IN/equality/null leaves),
   scan_expr (multi-column filtered reads with late materialization),
@@ -90,6 +96,7 @@ from .io.column import Column
 from .io.writer import (ColumnData, ParquetWriter, WriterOptions,
                         schema_from_arrow, write_table)
 from .io.search import find, pages_overlapping, plan_scan, prune_row_group, read_row_range
+from .io.lookup import KeyHits, LookupResult, find_rows
 from .io.stream import iter_batches
 from .ops.encodings import (DictIndices, EncodingSpec, register_encoding,
                             registered_encodings)

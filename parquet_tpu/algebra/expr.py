@@ -462,26 +462,68 @@ def _intersect_preds(path, leaf, preds: List[Pred]):
 
 
 def _union_preds(path, leaf, preds: List[Pred]):
-    """OR of positive same-column leaves: union the IN-lists; ranges pass
-    through (interval union rarely pays for its complexity here — the
-    planner unions their page intervals anyway)."""
+    """OR of positive same-column leaves → the minimal equivalent leaf
+    set: overlapping ranges MERGE into one interval (inclusive bounds, so
+    a shared endpoint overlaps; the union is exact in every order
+    domain), IN probes covered by a merged range are absorbed, leftover
+    probes union into one sorted IN leaf, and a union that covers the
+    whole domain folds to IS NOT NULL (a ``[-inf, +inf]`` range matches
+    exactly the non-null rows).  ``(x <= 5) | (x >= 3)`` becomes one
+    leaf the planner probes once; ``(x <= 5) | (x >= 100)`` stays two
+    DISJOINT ranges whose page intervals prune instead of degrading to
+    full-column candidates.  Bounds that don't compare within the
+    column's order domain skip the merge — correctness over minimality."""
+    ranges = [p for p in preds if p.kind == "range"]
     ins: List = []
-    passthrough: List[Pred] = []
     for p in preds:
         if p.kind == "in":
             ins.extend(p.values)
+    # comparability guard: every bound/probe must order against the others
+    bounds = [b for p in ranges for b in (p.lo, p.hi) if b is not None]
+    for a in bounds + ins[:1]:
+        for b in bounds:
+            if a is not b and not _cmp_ok(a, b):
+                return preds
+    # merge overlapping intervals (None = open end); sort finite-lo
+    # intervals by lo, with open-lo intervals folded into one seed first
+    open_lo = [p for p in ranges if p.lo is None]
+    finite = [p for p in ranges if p.lo is not None]
+    merged: List[list] = []  # [lo, hi] with None = open
+    if open_lo:
+        if any(p.hi is None for p in open_lo):
+            merged.append([None, None])
         else:
-            passthrough.append(p)
-    if not ins:
-        return passthrough
+            merged.append([None, max(p.hi for p in open_lo)])
+    for p in sorted(finite, key=lambda q: q.lo):
+        if merged and (merged[-1][1] is None or p.lo <= merged[-1][1]):
+            if merged[-1][1] is not None:
+                merged[-1][1] = (None if p.hi is None
+                                 else max(merged[-1][1], p.hi))
+        else:
+            merged.append([p.lo, p.hi])
+    if merged and merged[0] == [None, None]:
+        # the union admits every non-null value: IS NOT NULL, exactly
+        return [Pred(path, "notnull", leaf=leaf, prepared=True)]
+
+    def covered(v) -> bool:
+        try:
+            return any((lo is None or lo <= v) and (hi is None or v <= hi)
+                       for lo, hi in merged)
+        except TypeError:
+            return False  # incomparable probe: keep it, stays exact
+
     seen = set()
-    uniq = [v for v in ins if not (v in seen or seen.add(v))]
-    try:
-        uniq = sorted(uniq)
-    except TypeError:
-        uniq = sorted(uniq, key=repr)
-    return passthrough + [Pred(path, "in", values=uniq, leaf=leaf,
-                               prepared=True)]
+    uniq = [v for v in ins
+            if not (v in seen or seen.add(v)) and not covered(v)]
+    out: List[Pred] = [Pred(path, "range", lo=lo, hi=hi, leaf=leaf,
+                            prepared=True) for lo, hi in merged]
+    if uniq:
+        try:
+            uniq = sorted(uniq)
+        except TypeError:
+            uniq = sorted(uniq, key=repr)
+        out.append(Pred(path, "in", values=uniq, leaf=leaf, prepared=True))
+    return out
 
 
 def single_pred(path: str, lo=None, hi=None,

@@ -565,6 +565,29 @@ class Dataset:
                 empty[c] = np.empty(0, leaf.np_dtype() or np.uint8)
         return empty
 
+    # ------------------------------------------------------------- lookup
+    def find_rows(self, path, keys, columns: Optional[Sequence[str]] = None,
+                  policy: Optional[FaultPolicy] = None,
+                  report: Optional[ReadReport] = None):
+        """Batched point lookup across the whole dataset: the rows where
+        column ``path`` equals each of ``keys``, with GLOBAL row ordinals
+        (:meth:`row_offsets` indexing) and row-aligned output-column
+        values.  Keys normalize and bloom-hash once for the corpus,
+        per-file probing fans out on the shared pool, and each file runs
+        the cheapest-first cascade with coalesced page reads and the
+        shared page cache (:mod:`parquet_tpu.io.lookup`).  Degraded
+        ``policy``: an unreadable file drops as a unit
+        (``report.files_skipped``); corrupt row groups inside readable
+        files drop atomically."""
+        if not self.paths:
+            raise ValueError("find_rows on an empty dataset shard (no "
+                             "schema to probe keys against); check "
+                             "num_files first")
+        from .io.lookup import dataset_find_rows
+
+        return dataset_find_rows(self, path, keys, columns=columns,
+                                 policy=policy, report=report)
+
     # -------------------------------------------------------------- misc
     @staticmethod
     def cache_stats():

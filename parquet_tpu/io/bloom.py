@@ -333,6 +333,20 @@ def hash_probe_values(leaf: Leaf, values) -> np.ndarray:
     return hash_values(leaf, np.frombuffer(b"".join(bs), np.uint8), offs)
 
 
+def probe_hashes(leaf: Leaf, values) -> Optional[np.ndarray]:
+    """Batch-hash an already-normalized probe list for
+    :meth:`SplitBlockFilter.check_hashes_batch`, with the conservative
+    guard of :func:`bloom_may_contain`: probes whose type has no bloom
+    encoding (or that fail to encode) return ``None`` — "inconclusive,
+    skip the bloom stage" — instead of raising.  The batched-lookup path
+    (io/lookup.py) hashes its whole key set ONCE through this and probes
+    every chunk's filter with the same array."""
+    try:
+        return hash_probe_values(leaf, values)
+    except (TypeError, ValueError, OverflowError):
+        return None
+
+
 def hash_values_single(value, leaf: Leaf) -> np.ndarray:
     """Hash one probe value (the batch-of-one case of
     :func:`hash_probe_values`, which owns the writer-side PLAIN probe

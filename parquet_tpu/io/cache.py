@@ -23,6 +23,14 @@ old bytes with the new identity:
   poisoning later reads) and served as shallow dataclass copies (consumers
   that materialize a dictionary-encoded column reassign fields on their
   copy, never the cached master).
+- :class:`PageCache` — PAGE-granular decoded row-aligned spans, keyed by
+  ``(file key, row group, leaf path, page ordinal)`` — the serving tier of
+  the point-lookup path (io/lookup.py): a hot key's repeat lookup decodes
+  nothing and preads nothing.  Same contracts as the chunk LRU: bytes-
+  capped (``PARQUET_TPU_PAGE_CACHE`` bytes, default 64 MiB, ``0`` = off),
+  oversized items refused, entries FROZEN (numpy buffers are read-only
+  views that own their bytes; BYTE_ARRAY spans are immutable tuples of
+  ``bytes``), eviction global and size-aware.
 
 Only plain path-backed opens (``FileSource``/``MmapSource``, optionally under
 a ``PolicySource``) are cached — wrapped sources (fault injectors, arbitrary
@@ -46,13 +54,14 @@ from ..obs.metrics import counter as _counter
 from ..obs.metrics import gauge as _gauge
 from ..obs.scope import account as _account
 
-__all__ = ["CacheStats", "FooterCache", "ChunkCache", "cache_stats",
-           "clear_caches", "chunk_cache_bytes", "footer_cache_entries",
-           "column_nbytes", "freeze_column", "invalidate_path",
-           "FOOTERS", "CHUNKS"]
+__all__ = ["CacheStats", "FooterCache", "ChunkCache", "PageCache",
+           "PageEntry", "cache_stats", "clear_caches", "chunk_cache_bytes",
+           "footer_cache_entries", "page_cache_bytes", "column_nbytes",
+           "freeze_column", "invalidate_path", "FOOTERS", "CHUNKS", "PAGES"]
 
 DEFAULT_CHUNK_CACHE_BYTES = 256 << 20
 DEFAULT_FOOTER_CACHE_ENTRIES = 256
+DEFAULT_PAGE_CACHE_BYTES = 64 << 20
 
 # registry mirrors (parquet_tpu/obs): CacheStats stays the per-process
 # dataclass VIEW (its API is unchanged and clear_caches(reset_stats=True)
@@ -70,6 +79,13 @@ _M_CHUNK_ENTRIES = _gauge("cache.chunk_entries",
                           help="decoded chunks resident in the LRU")
 _M_CHUNK_BYTES = _gauge("cache.chunk_bytes",
                         help="decoded bytes resident in the LRU")
+_M_PAGE_HITS = _counter("cache.page_hits")
+_M_PAGE_MISSES = _counter("cache.page_misses")
+_M_PAGE_EVICTIONS = _counter("cache.page_evictions")
+_M_PAGE_ENTRIES = _gauge("cache.page_entries",
+                         help="decoded pages resident in the page LRU")
+_M_PAGE_BYTES = _gauge("cache.page_bytes",
+                       help="decoded bytes resident in the page LRU")
 
 
 def _env_size(name: str, default: int) -> int:
@@ -95,6 +111,12 @@ def footer_cache_entries() -> int:
     return _env_size("PARQUET_TPU_FOOTER_CACHE", DEFAULT_FOOTER_CACHE_ENTRIES)
 
 
+def page_cache_bytes() -> int:
+    """Decoded-page cache capacity: ``PARQUET_TPU_PAGE_CACHE`` (bytes;
+    ``0`` disables) or the 64 MiB default."""
+    return _env_size("PARQUET_TPU_PAGE_CACHE", DEFAULT_PAGE_CACHE_BYTES)
+
+
 @dataclass
 class CacheStats:
     """What the open-path caches actually did (observability; the cache-side
@@ -111,6 +133,12 @@ class CacheStats:
     chunk_entries: int = 0
     chunk_bytes: int = 0
     chunk_capacity: int = 0
+    page_hits: int = 0
+    page_misses: int = 0
+    page_evictions: int = 0
+    page_entries: int = 0
+    page_bytes: int = 0
+    page_capacity: int = 0
 
     def as_dict(self) -> dict:
         return {"footer_hits": self.footer_hits,
@@ -121,7 +149,13 @@ class CacheStats:
                 "chunk_evictions": self.chunk_evictions,
                 "chunk_entries": self.chunk_entries,
                 "chunk_bytes": self.chunk_bytes,
-                "chunk_capacity": self.chunk_capacity}
+                "chunk_capacity": self.chunk_capacity,
+                "page_hits": self.page_hits,
+                "page_misses": self.page_misses,
+                "page_evictions": self.page_evictions,
+                "page_entries": self.page_entries,
+                "page_bytes": self.page_bytes,
+                "page_capacity": self.page_capacity}
 
 
 def _buf_nbytes(a: Any) -> int:
@@ -323,9 +357,122 @@ class ChunkCache:
             _M_CHUNK_BYTES.set(0)
 
 
+@dataclass(frozen=True)
+class PageEntry:
+    """One cached decoded page of a flat column, row-aligned: ``values``
+    has exactly ``num_rows`` entries (numpy read-only view owning its
+    bytes, or an immutable tuple of ``bytes``/``None`` for BYTE_ARRAY),
+    ``validity`` is a read-only bool array (``None`` = no nulls), and
+    ``first_row`` is the page's first row ordinal within its row group.
+    Frozen dataclass + frozen buffers: an entry is served as-is (no
+    private copies needed — nothing about it is mutable)."""
+
+    values: Any
+    validity: Optional[Any]
+    first_row: int
+    num_rows: int
+
+    def nbytes(self) -> int:
+        if isinstance(self.values, tuple):
+            nv = sum(len(v) for v in self.values if v is not None)
+        else:
+            nv = _buf_nbytes(self.values)
+        return nv + _buf_nbytes(self.validity)
+
+
+def _freeze_page_buf(values):
+    """The page-cache form of a decoded aligned span: numpy buffers become
+    read-only views that OWN their bytes (a cached view of a whole-file
+    mmap would pin the mapping — same rule as the chunk LRU), python lists
+    become tuples (``bytes`` elements are already immutable)."""
+    if isinstance(values, np.ndarray):
+        return _readonly(values, own=True)
+    if isinstance(values, list):
+        return tuple(values)
+    return values
+
+
+def make_page_entry(values, validity, first_row: int,
+                    num_rows: int) -> PageEntry:
+    """A frozen :class:`PageEntry` OUTSIDE the cache — what the lookup
+    path hands out for non-cacheable sources (fault injectors, wrapped
+    sources), keeping the one mutability contract: page-lookup results
+    are read-only whether or not they were cached."""
+    return PageEntry(_freeze_page_buf(values), _readonly(validity, own=True),
+                     int(first_row), int(num_rows))
+
+
+class PageCache:
+    """Bytes-capped LRU of decoded pages (:class:`PageEntry`) — the
+    page-granular tier next to the whole-chunk LRU, fed by the point-
+    lookup path (io/lookup.py) where whole-chunk materialization is
+    exactly the cost the path exists to avoid.  Same contracts as
+    :class:`ChunkCache`: entries frozen, an item larger than half the cap
+    refused, eviction size-aware and global."""
+
+    def __init__(self, stats: CacheStats):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Tuple[PageEntry, int]]" = \
+            OrderedDict()
+        self._bytes = 0
+        self.stats = stats
+
+    def get(self, key) -> Optional[PageEntry]:
+        with self._lock:
+            got = self._entries.get(key)
+            if got is None:
+                self.stats.page_misses += 1
+                _account(_M_PAGE_MISSES)
+                return None
+            self._entries.move_to_end(key)
+            self.stats.page_hits += 1
+            _account(_M_PAGE_HITS)
+            return got[0]
+
+    def put(self, key, values, validity, first_row: int,
+            num_rows: int) -> Optional[PageEntry]:
+        """Freeze and store one decoded page span; returns the frozen
+        :class:`PageEntry` (what the caller should use and hand out), or
+        ``None`` when refused (cache off, oversized item)."""
+        cap = page_cache_bytes()
+        entry = make_page_entry(values, validity, first_row, num_rows)
+        if cap <= 0:
+            return entry  # frozen but uncached: one mutability contract
+        nb = entry.nbytes()
+        if nb > cap // 2:
+            return entry
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (entry, nb)
+            self._bytes += nb
+            while self._bytes > cap and self._entries:
+                _, (_, evicted_nb) = self._entries.popitem(last=False)
+                self._bytes -= evicted_nb
+                self.stats.page_evictions += 1
+                _account(_M_PAGE_EVICTIONS)
+            self.stats.page_entries = len(self._entries)
+            self.stats.page_bytes = self._bytes
+            self.stats.page_capacity = cap
+            _M_PAGE_ENTRIES.set(len(self._entries))
+            _M_PAGE_BYTES.set(self._bytes)
+        return entry
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self.stats.page_entries = 0
+            self.stats.page_bytes = 0
+            _M_PAGE_ENTRIES.set(0)
+            _M_PAGE_BYTES.set(0)
+
+
 _STATS = CacheStats()
 FOOTERS = FooterCache(_STATS)
 CHUNKS = ChunkCache(_STATS)
+PAGES = PageCache(_STATS)
 
 
 def invalidate_path(path: str) -> None:
@@ -350,6 +497,14 @@ def invalidate_path(path: str) -> None:
         CHUNKS.stats.chunk_bytes = CHUNKS._bytes
         _M_CHUNK_ENTRIES.set(len(CHUNKS._entries))
         _M_CHUNK_BYTES.set(CHUNKS._bytes)
+    with PAGES._lock:
+        for key in [k for k in PAGES._entries if k[0][0] == ap]:
+            _, nb = PAGES._entries.pop(key)
+            PAGES._bytes -= nb
+        PAGES.stats.page_entries = len(PAGES._entries)
+        PAGES.stats.page_bytes = PAGES._bytes
+        _M_PAGE_ENTRIES.set(len(PAGES._entries))
+        _M_PAGE_BYTES.set(PAGES._bytes)
 
 
 def cache_stats() -> CacheStats:
@@ -357,6 +512,7 @@ def cache_stats() -> CacheStats:
     snapshots to meter one operation)."""
     s = dataclasses.replace(_STATS)
     s.chunk_capacity = chunk_cache_bytes()
+    s.page_capacity = page_cache_bytes()
     return s
 
 
@@ -366,6 +522,7 @@ def clear_caches(reset_stats: bool = False) -> None:
     lifetime counters."""
     FOOTERS.clear()
     CHUNKS.clear()
+    PAGES.clear()
     if reset_stats:
         global _STATS
         fresh = CacheStats()

@@ -1,0 +1,784 @@
+"""Batched point lookups: the serving-shaped read path.
+
+A serving fleet's dominant workload (ROADMAP item 3) is millions of small
+keyed lookups — "the rows where ``user_id == k``" — not full scans.  The
+primitives have existed since the reference parity work (``find`` over the
+ColumnIndex, ``seek_pages``/``read_row_range`` for SeekToRow, chunk stats
+and bloom pruning), but one key at a time: each lookup paid a full planner
+walk, whole-chunk decodes, and an unmetered trip through the shared pool.
+This module is the batched form, built so the marginal cost of the k-th
+key in a batch approaches zero:
+
+- **Cheapest-first cascade per row group** (the probe order the scan
+  planner proved out): chunk min/max statistics (zero IO) → bloom filter,
+  probed with the WHOLE key set's hashes in one ``check_hashes_batch``
+  call → page-index binary search (:func:`~parquet_tpu.io.search.find`,
+  bounds decoded once per chunk via the memo on the parsed index) →
+  single-page reads.  A key a cheap stage kills never reaches a costlier
+  one, and no whole chunk is ever materialized on the indexed path.
+- **Request coalescing**: surviving (key, page) pairs are grouped per
+  chunk, and keys landing in the same or adjacent pages share ONE ranged
+  pread (``pages_at`` over the covering span — the same segment-shaped IO
+  the prefetch ring carves), so a batch of co-located keys costs one
+  storage round trip instead of k.
+- **Page-granular caching**: each decoded page lands in the process-wide
+  :class:`~parquet_tpu.io.cache.PageCache` (bytes-capped, frozen entries —
+  the page-sized tier next to the whole-chunk LRU), so hot keys repeat
+  with no IO and no decode at all.
+- **Admission control**: every IO+decode span passes through the FIFO
+  bytes-budget gate (:func:`~parquet_tpu.utils.pool.lookup_admission`), so
+  thousands of concurrent lookups can neither OOM the process nor starve
+  a scan sharing the pool.
+- **Observability**: the whole operation lands in the
+  ``lookup.find_rows_s`` latency histogram (p50/p99 straight out of
+  ``metrics_snapshot()``), per-stage key counters and coalescing meters
+  publish through :func:`~parquet_tpu.obs.scope.account` — so a
+  request-scoped ``op_scope`` sees exactly its own keys, preads, and
+  cache hits in its :class:`~parquet_tpu.obs.scope.OpScope` report.
+
+Key matching uses the scan path's order-domain comparison
+(:func:`~parquet_tpu.parallel.host_scan.aligned_key_mask`): results are
+byte-identical to a naive read-everything-then-mask, including NULL
+semantics (a NULL cell never matches any key).
+"""
+
+from __future__ import annotations
+
+import time
+from bisect import bisect_right
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_UNSET = object()  # lazy-memo sentinel (None is a valid decoded dictionary)
+
+from ..errors import CorruptedError, DeadlineError
+from ..format.enums import BoundaryOrder, Type
+from ..obs import scope as _oscope
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import histogram as _histogram
+from ..utils.pool import lookup_admission, map_in_order
+
+__all__ = ["KeyHits", "LookupResult", "find_rows", "dataset_find_rows"]
+
+# resolved once (hot-path rule: no registry get-or-create on increments)
+_M_FIND_S = _histogram("lookup.find_rows_s")
+_M_DS_FIND_S = _histogram("dataset.find_rows_s")
+_M_KEYS = _counter("lookup.keys")
+_M_PRUNED_STATS = _counter("lookup.keys_pruned_stats")
+_M_PRUNED_BLOOM = _counter("lookup.keys_pruned_bloom")
+_M_PRUNED_PAGES = _counter("lookup.keys_pruned_pages")
+_M_ROWS_MATCHED = _counter("lookup.rows_matched")
+_M_PREADS = _counter("lookup.preads")
+_M_PAGES_READ = _counter("lookup.pages_read")
+_M_PAGES_COALESCED = _counter("lookup.pages_coalesced")
+_M_CHUNK_FALLBACKS = _counter("lookup.chunk_fallbacks")
+
+_COUNTER_KEYS = ("keys", "keys_pruned_stats", "keys_pruned_bloom",
+                 "keys_pruned_pages", "rows_matched", "preads", "pages_read",
+                 "pages_coalesced", "page_cache_hits", "chunk_fallbacks")
+
+
+@dataclass
+class KeyHits:
+    """All matches of ONE key: ``rows`` are ascending row ordinals
+    (file-local from :func:`find_rows`, dataset-global from
+    :func:`dataset_find_rows`), ``values[col]`` / ``validity[col]`` are
+    row-aligned output-column values (numpy array, or list of
+    ``bytes``/``None`` for BYTE_ARRAY) for each requested column."""
+
+    key: object
+    rows: np.ndarray
+    values: Dict[str, object] = field(default_factory=dict)
+    validity: Dict[str, Optional[np.ndarray]] = field(default_factory=dict)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.rows)
+
+
+class LookupResult:
+    """Per-key hits aligned with the input key order, plus the batch's
+    probe-stage accounting (``counters``) and, under a degraded policy,
+    the :class:`~parquet_tpu.io.faults.ReadReport`."""
+
+    def __init__(self, hits: List[KeyHits], counters: Dict[str, int]):
+        self.hits = hits
+        self.counters = counters
+        self.report = None
+
+    def __len__(self) -> int:
+        return len(self.hits)
+
+    def __getitem__(self, i) -> KeyHits:
+        return self.hits[i]
+
+    def __iter__(self):
+        return iter(self.hits)
+
+    @property
+    def rows_total(self) -> int:
+        return sum(h.num_rows for h in self.hits)
+
+    def __repr__(self) -> str:
+        return (f"LookupResult({len(self.hits)} key(s), "
+                f"{self.rows_total} row(s))")
+
+
+# ---------------------------------------------------------------------------
+# key preparation (once per batch — and once per DATASET, not per file)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _PreparedKeys:
+    """Normalized batch state shared across every file of a dataset:
+    ``uniq`` is the deduplicated order-domain key list, ``key_map[i]`` the
+    uniq ordinal of input key i (None = unmatchable in this schema), and
+    ``hashes`` the xxh64 of every uniq key for the batched bloom probe
+    (None when the type has no bloom encoding)."""
+
+    uniq: List
+    key_map: List[Optional[int]]
+    hashes: Optional[np.ndarray]
+
+
+def _prepare_keys(leaf, keys: Sequence) -> _PreparedKeys:
+    from ..algebra.compare import normalize_probe
+    from .bloom import probe_hashes
+
+    uniq: List = []
+    seen: Dict = {}
+    key_map: List[Optional[int]] = []
+    for k in keys:
+        nk = normalize_probe(leaf, k)
+        if nk is None:
+            key_map.append(None)
+            continue
+        got = seen.get(nk)
+        if got is None:
+            got = seen[nk] = len(uniq)
+            uniq.append(nk)
+        key_map.append(got)
+    hashes = probe_hashes(leaf, uniq) if uniq else None
+    return _PreparedKeys(uniq, key_map, hashes)
+
+
+# ---------------------------------------------------------------------------
+# page-granular fetch with coalesced preads + the PageCache
+# ---------------------------------------------------------------------------
+
+
+class _PageFetcher:
+    """Fetch decoded row-aligned pages of ONE column chunk.
+
+    Requested page ordinals are served from the process-wide
+    :class:`~parquet_tpu.io.cache.PageCache` when resident; the misses
+    coalesce into runs of adjacent ordinals, each run costing one ranged
+    pread (+ one for the dictionary page, once per chunk) and one decode,
+    admitted through the lookup bytes-budget gate.  Decoded pages are
+    frozen and cached individually, so the NEXT batch touching any of
+    them pays nothing."""
+
+    def __init__(self, pf, rg, chunk, counters: Dict[str, int]):
+        self.pf = pf
+        self.rg = rg
+        self.chunk = chunk
+        self.counters = counters
+        oi = chunk.offset_index()
+        self.locs = oi.page_locations if oi is not None else None
+        self.firsts = ([pl.first_row_index for pl in self.locs]
+                       if self.locs else None)
+        self._firsts_arr = (np.asarray(self.firsts, np.int64)
+                            if self.firsts else None)
+        self._dict = _UNSET  # lazily decoded once per chunk
+        ck = pf._cache_key
+        self._key_base = ((ck, rg.index, chunk.leaf.dotted_path,
+                           pf.options.verify_crc)
+                          if ck is not None else None)
+
+    def page_rows(self, o: int) -> int:
+        nxt = (self.firsts[o + 1] if o + 1 < len(self.firsts)
+               else self.rg.num_rows)
+        return nxt - self.firsts[o]
+
+    def ord_of_row(self, row: int) -> int:
+        return max(bisect_right(self.firsts, row) - 1, 0)
+
+    def ords_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Vectorized :meth:`ord_of_row` — a key serving duplicate-heavy
+        data can match 100k rows; per-row python bisects would serialize
+        the hot path on interpreter overhead."""
+        return np.maximum(
+            np.searchsorted(self._firsts_arr, rows, side="right") - 1, 0)
+
+    def _cache_key(self, o: int):
+        b = self._key_base
+        return (b[0], b[1], b[2], o, b[3]) if b is not None else None
+
+    def _dictionary(self):
+        """The chunk's DECODED dictionary (or None) — pread AND decoded
+        once per chunk, not once per coalesced run: a multi-MB dictionary
+        on a high-cardinality column would otherwise dominate every run's
+        decode for scattered key batches."""
+        if self._dict is _UNSET:
+            from .reader import decode_dictionary_page
+            from .search import dictionary_pages
+
+            pages = list(dictionary_pages(self.chunk, self.locs[0].offset))
+            if pages:
+                _count(self.counters, "preads", _M_PREADS, 1)
+                self._dict = decode_dictionary_page(self.chunk, pages[0])
+            else:
+                self._dict = None
+        return self._dict
+
+    def fetch(self, ords: Sequence[int]) -> Dict[int, "object"]:
+        """``{ordinal: PageEntry}`` for the requested page ordinals."""
+        from .cache import PAGES, make_page_entry
+
+        out: Dict[int, object] = {}
+        missing: List[int] = []
+        for o in sorted(set(ords)):
+            key = self._cache_key(o)
+            entry = PAGES.get(key) if key is not None else None
+            if entry is not None:
+                self.counters["page_cache_hits"] += 1
+                out[o] = entry
+            else:
+                missing.append(o)
+        if not missing:
+            return out
+        from .reader import decode_chunk_host
+        from .search import _trim_flat_aligned
+
+        # coalesce adjacent missing ordinals: one ranged pread per run
+        runs: List[List[int]] = [[missing[0]]]
+        for o in missing[1:]:
+            if o == runs[-1][-1] + 1:
+                runs[-1].append(o)
+            else:
+                runs.append([o])
+        admission = lookup_admission()
+        for run in runs:
+            first, last = run[0], run[-1]
+            span_start = self.locs[first].offset
+            span_len = (self.locs[last].offset
+                        + self.locs[last].compressed_page_size - span_start)
+            with admission.admit(span_len):
+                dictionary = self._dictionary()
+                pages = self.chunk.pages_at(span_start, span_len,
+                                            num_pages=len(run))
+                col = decode_chunk_host(self.chunk, pages=pages,
+                                        dictionary=dictionary)
+                _count(self.counters, "preads", _M_PREADS, 1)
+                _count(self.counters, "pages_read", _M_PAGES_READ, len(run))
+                _count(self.counters, "pages_coalesced", _M_PAGES_COALESCED,
+                       len(run) - 1)
+                base = self.firsts[first]
+                for o in run:
+                    vals, valid = _trim_flat_aligned(
+                        col, self.firsts[o] - base, self.page_rows(o))
+                    key = self._cache_key(o)
+                    if key is not None:
+                        entry = PAGES.put(key, vals, valid, self.firsts[o],
+                                          self.page_rows(o))
+                    else:
+                        entry = make_page_entry(vals, valid, self.firsts[o],
+                                                self.page_rows(o))
+                    out[o] = entry
+        return out
+
+
+def _take_rows(vals, valid, idx: np.ndarray):
+    """Row-aligned (values, validity) gather at ``idx`` — the one gather
+    for every aligned-span form (numpy array, list, frozen tuple; a
+    naive ``np.asarray`` on a tuple of bytes would silently build an
+    'S'-dtype array and return ``np.bytes_`` values)."""
+    if isinstance(vals, (tuple, list)):
+        part = [vals[i] for i in idx]
+    else:
+        part = np.asarray(vals)[idx]
+    return part, (None if valid is None else np.asarray(valid)[idx])
+
+
+def _entry_take(entry, idx: np.ndarray):
+    """Row-aligned (values, validity) of ``entry`` at page-local ``idx``."""
+    return _take_rows(entry.values, entry.validity, idx)
+
+
+# ---------------------------------------------------------------------------
+# the probe cascade, one row group at a time
+# ---------------------------------------------------------------------------
+
+
+def _stats_alive_key(st, nv, key) -> bool:
+    """Chunk-statistics stage for one normalized key: the all-null
+    early-out plus the ONE shared interval rule
+    (:func:`~parquet_tpu.io.statistics.may_contain_range`) — the same
+    conservative zone-map check row-group pruning and the planner's
+    stats stage apply, so the three can't drift."""
+    from .statistics import may_contain_range
+
+    if st is not None and st.null_count is not None and nv is not None \
+            and st.null_count >= nv:
+        return False  # every value is null: no key can match
+    return may_contain_range(st, key, key)
+
+
+def _ordered_searchable(ci, leaf) -> bool:
+    """May the binary-search fast path run on this index?  Only when the
+    boundary order is declared AND no page is null-only or missing a
+    bound: parquet orders boundaries over the NON-NULL pages, so a null
+    page interleaved in the ladder breaks both ``find()``'s bisection
+    invariant and contiguous-run extension — silently skipping matching
+    pages.  Memoized on the parsed index beside the decoded bounds."""
+    got = getattr(ci, "_ordered_searchable", None)
+    if got is None:
+        from .search import decoded_bounds
+
+        order = BoundaryOrder(ci.boundary_order or 0)
+        if order not in (BoundaryOrder.ASCENDING, BoundaryOrder.DESCENDING):
+            got = False
+        else:
+            mins, maxs = decoded_bounds(ci, leaf)
+            got = (not any(ci.null_pages or [])
+                   and all(m is not None for m in mins)
+                   and all(m is not None for m in maxs))
+        ci._ordered_searchable = got
+    return got
+
+
+def _key_page_ords(ci, leaf, key) -> List[int]:
+    """Page ordinals that may hold ``key``: the reference's ``Find``
+    binary search on cleanly-ordered indexes (extended across the
+    contiguous run of may-contain pages — duplicates of one key can span
+    pages), the exact linear zone-map walk otherwise (unordered boundary,
+    null-only pages, or missing bounds).  Bounds decode once per chunk
+    (the memo on the parsed ColumnIndex)."""
+    from .search import decoded_bounds, find, pages_overlapping
+
+    if _ordered_searchable(ci, leaf):
+        i = find(ci, key, leaf)
+        n = len(ci.null_pages or [])
+        if i >= n:
+            return []
+        mins, maxs = decoded_bounds(ci, leaf)
+        out = [i]
+        j = i + 1
+        while j < n and mins[j] <= key <= maxs[j]:
+            out.append(j)
+            j += 1
+        return out
+    return pages_overlapping(ci, leaf, lo=key, hi=key)
+
+
+def _lookup_rg(pf, rg, leaf, prep: _PreparedKeys, out_leaves,
+               counters: Dict[str, int]):
+    """Probe + match + gather one row group.  Returns
+    ``(per_uniq_rows, per_uniq_cols)`` — local row ordinals and output
+    values per uniq key — or None when every key was pruned.  Raises on
+    corruption; the caller owns skip semantics (the whole row group drops
+    atomically, rows and values together)."""
+    from ..parallel.host_scan import aligned_key_mask
+    from .search import _trim_flat_aligned
+
+    chunk = rg.column(leaf.column_index)
+    alive = list(range(len(prep.uniq)))
+    # ---- stage 1: chunk statistics (zero IO)
+    st = chunk.statistics()
+    nv = chunk.meta.num_values
+    survivors = [u for u in alive if _stats_alive_key(st, nv, prep.uniq[u])]
+    _count(counters, "keys_pruned_stats", _M_PRUNED_STATS,
+           len(alive) - len(survivors))
+    alive = survivors
+    if not alive:
+        return None
+    # ---- stage 2: bloom filter, the WHOLE surviving set in one probe
+    if prep.hashes is not None:
+        bf = chunk.bloom_filter()
+        if bf is not None:
+            mask = bf.check_hashes_batch(prep.hashes[np.asarray(alive)])
+            _count(counters, "keys_pruned_bloom", _M_PRUNED_BLOOM,
+                   int((~mask).sum()))
+            alive = [u for u, ok in zip(alive, mask) if ok]
+            if not alive:
+                return None
+    # ---- stage 3: page-index binary search → single-page reads
+    ci = chunk.column_index()
+    oi = chunk.offset_index()
+    per_uniq_rows: Dict[int, np.ndarray] = {}
+    if ci is None or oi is None or not oi.page_locations:
+        # no usable page index: the documented fallback decodes the chunk
+        # once through the whole-chunk LRU (still no per-KEY decode)
+        _count(counters, "chunk_fallbacks", _M_CHUNK_FALLBACKS, 1)
+        admission = lookup_admission()
+        with admission.admit(chunk.meta.total_compressed_size or 0):
+            col = pf._decode_chunk_ctx(chunk)
+            vals, valid = _trim_flat_aligned(col, 0, rg.num_rows)
+        for u in alive:
+            m = aligned_key_mask(leaf, prep.uniq[u], vals, valid)
+            rows = np.flatnonzero(m)
+            if len(rows):
+                per_uniq_rows[u] = rows.astype(np.int64)
+    else:
+        key_pages: Dict[int, List[int]] = {}
+        needed: List[int] = []
+        for u in alive:
+            ords = _key_page_ords(ci, leaf, prep.uniq[u])
+            if not ords:
+                _count(counters, "keys_pruned_pages", _M_PRUNED_PAGES, 1)
+                continue
+            key_pages[u] = ords
+            needed.extend(ords)
+        if not key_pages:
+            return None
+        fetcher = _PageFetcher(pf, rg, chunk, counters)
+        entries = fetcher.fetch(needed)
+        for u, ords in key_pages.items():
+            parts = []
+            for o in ords:
+                e = entries[o]
+                m = aligned_key_mask(leaf, prep.uniq[u], e.values,
+                                     e.validity)
+                hit = np.flatnonzero(m)
+                if len(hit):
+                    parts.append(e.first_row + hit.astype(np.int64))
+            if parts:
+                per_uniq_rows[u] = (parts[0] if len(parts) == 1
+                                    else np.concatenate(parts))
+    if not per_uniq_rows:
+        return None
+    _count(counters, "rows_matched", _M_ROWS_MATCHED,
+           sum(len(r) for r in per_uniq_rows.values()))
+    # ---- output columns: the same page machinery, coalesced across keys
+    per_uniq_cols: Dict[int, Dict[str, tuple]] = {u: {}
+                                                  for u in per_uniq_rows}
+    for out_leaf in out_leaves:
+        c = out_leaf.dotted_path
+        chunk_c = rg.column(out_leaf.column_index)
+        oi_c = chunk_c.offset_index()
+        if oi_c is None or not oi_c.page_locations:
+            _count(counters, "chunk_fallbacks", _M_CHUNK_FALLBACKS, 1)
+            admission = lookup_admission()
+            with admission.admit(chunk_c.meta.total_compressed_size or 0):
+                col = pf._decode_chunk_ctx(chunk_c)
+                vals, valid = _trim_flat_aligned(col, 0, rg.num_rows)
+            for u, rows in per_uniq_rows.items():
+                per_uniq_cols[u][c] = _take_rows(vals, valid, rows)
+            continue
+        fetcher = _PageFetcher(pf, rg, chunk_c, counters)
+        row_ords: Dict[int, np.ndarray] = {
+            u: fetcher.ords_of_rows(rows)
+            for u, rows in per_uniq_rows.items()}
+        entries = fetcher.fetch(
+            sorted({int(o) for ords in row_ords.values() for o in ords}))
+        for u, rows in per_uniq_rows.items():
+            ords = row_ords[u]
+            vparts, valparts, has_valid = [], [], False
+            for o in sorted(set(int(x) for x in ords)):
+                sel = rows[ords == o]
+                e = entries[o]
+                part, pvalid = _entry_take(e, sel - e.first_row)
+                vparts.append(part)
+                valparts.append(pvalid)
+                has_valid = has_valid or pvalid is not None
+            per_uniq_cols[u][c] = _concat_parts(out_leaf, vparts, valparts,
+                                                has_valid)
+    return per_uniq_rows, per_uniq_cols
+
+
+def _concat_parts(leaf, vparts, valparts, has_valid):
+    if isinstance(vparts[0], list):
+        vals = [v for p in vparts for v in p]
+    elif len(vparts) == 1:
+        vals = vparts[0]
+    else:
+        vals = np.concatenate(vparts)
+    if not has_valid:
+        return vals, None
+    valid = np.concatenate(
+        [v if v is not None else np.ones(_part_rows(p), bool)
+         for v, p in zip(valparts, vparts)])
+    return vals, valid
+
+
+def _part_rows(p) -> int:
+    return len(p)
+
+
+def _count(counters: Dict[str, int], key: str, metric, n: int) -> None:
+    if n:
+        counters[key] += n
+        _oscope.account(metric, n)
+
+
+def _empty_values(leaf):
+    if leaf.physical_type == Type.BYTE_ARRAY:
+        return []
+    if leaf.physical_type == Type.FIXED_LEN_BYTE_ARRAY:
+        return np.empty((0, leaf.type_length or 0), np.uint8)
+    return np.empty(0, leaf.np_dtype() or np.uint8)
+
+
+def _validate_flat(pf, path):
+    leaf = pf.schema.leaf(path)  # KeyError on unknown, as everywhere
+    if leaf.max_repetition_level > 0:
+        raise ValueError(f"column {path!r} is nested; find_rows matches "
+                         "flat columns (the keyed-serving shape)")
+    return leaf
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def find_rows(pf, path, keys, columns: Optional[Sequence[str]] = None,
+              policy=None, report=None,
+              _prep: Optional[_PreparedKeys] = None) -> LookupResult:
+    """Find every row of ``pf`` where column ``path`` equals each key of
+    ``keys`` (batch of point lookups).  Returns a :class:`LookupResult`
+    whose ``hits[i]`` aligns with ``keys[i]``: ascending file-local row
+    ordinals, plus row-aligned values/validity for each of ``columns``.
+
+    Probing is the cheapest-first cascade (stats → batched bloom →
+    page-index search → coalesced single-page reads through the page
+    cache) — see the module docstring.  NULL cells never match (SQL
+    equality); a key outside the column's value domain simply returns no
+    rows.  ``policy``/``report`` thread the resilience contract: preads
+    retry per the policy, the call runs under its deadline, and with
+    ``on_corrupt='skip_row_group'`` a corrupt row group drops atomically
+    (rows and values together, recorded with its full row count)."""
+    from .faults import resolve_policy
+
+    t0 = time.perf_counter()
+    with _oscope.maybe_op_scope("lookup.find_rows", file=pf._path,
+                                keys=len(keys)):
+        try:
+            pol, report = resolve_policy(pf, policy, report)
+            if pol is not None or report is not None:
+                with pf._resilient_op(policy, report, "lookup"):
+                    res = _find_rows_impl(pf, path, keys, columns, pol,
+                                          report, _prep)
+                res.report = report
+                return res
+            return _find_rows_impl(pf, path, keys, columns, None, None,
+                                   _prep)
+        finally:
+            # the serving meter: lookup p50/p99 straight out of
+            # metrics_snapshot(), failures included
+            _M_FIND_S.observe(time.perf_counter() - t0)
+
+
+def _find_rows_impl(pf, path, keys, columns, pol, report,
+                    prep: Optional[_PreparedKeys]) -> LookupResult:
+    from .faults import read_context
+
+    leaf = _validate_flat(pf, path)
+    out_leaves = [_validate_flat(pf, c) for c in (columns or [])]
+    counters = {k: 0 for k in _COUNTER_KEYS}
+    if prep is None:
+        prep = _prepare_keys(leaf, keys)
+        # standalone call: the batch's keys count HERE.  A dataset-shared
+        # prep means the dataset entry point already counted them once —
+        # n files re-counting the same batch would inflate every
+        # keys-per-stage attrition ratio by the file count.
+        _count(counters, "keys", _M_KEYS, len(keys))
+    per_uniq: Dict[int, List[tuple]] = {}  # uniq → [(rows, cols), ...]
+    skip = pol is not None and pol.skip_corrupt
+    rg_base = 0
+    for rg in pf.row_groups:
+        if prep.uniq:
+            try:
+                with read_context(path=pf._path, row_group=rg.index,
+                                  column=leaf.dotted_path,
+                                  kinds=(CorruptedError, OSError)):
+                    got = _lookup_rg(pf, rg, leaf, prep, out_leaves,
+                                     counters)
+            except DeadlineError:
+                raise
+            except CorruptedError as e:
+                if not skip:
+                    raise
+                report.record_skip(rg.index, rows=rg.num_rows, error=e)
+                got = None
+            if got is not None:
+                rows_map, cols_map = got
+                for u, rows in rows_map.items():
+                    per_uniq.setdefault(u, []).append(
+                        (rows + rg_base, cols_map.get(u, {})))
+        rg_base += rg.num_rows
+    hits = _assemble_hits(keys, prep, per_uniq, out_leaves)
+    return LookupResult(hits, counters)
+
+
+def _assemble_hits(keys, prep: _PreparedKeys, per_uniq, out_leaves
+                   ) -> List[KeyHits]:
+    # build once per UNIQ key; duplicate input keys share the hit object
+    built: Dict[int, KeyHits] = {}
+
+    def build(u: int, key) -> KeyHits:
+        parts = per_uniq.get(u, [])
+        if parts:
+            rows = (parts[0][0] if len(parts) == 1
+                    else np.concatenate([p[0] for p in parts]))
+        else:
+            rows = np.empty(0, np.int64)
+        h = KeyHits(key, rows)
+        for leaf in out_leaves:
+            c = leaf.dotted_path
+            vparts = [p[1][c][0] for p in parts if c in p[1]]
+            valparts = [p[1][c][1] for p in parts if c in p[1]]
+            if not vparts:
+                h.values[c] = _empty_values(leaf)
+                h.validity[c] = None
+                continue
+            has_valid = any(v is not None for v in valparts)
+            h.values[c], h.validity[c] = _concat_parts(
+                leaf, vparts, valparts, has_valid)
+        return h
+
+    def empty(key) -> KeyHits:
+        h = KeyHits(key, np.empty(0, np.int64))
+        for leaf in out_leaves:
+            h.values[leaf.dotted_path] = _empty_values(leaf)
+            h.validity[leaf.dotted_path] = None
+        return h
+
+    hits: List[KeyHits] = []
+    for i, k in enumerate(keys):
+        u = prep.key_map[i]
+        if u is None:
+            hits.append(empty(k))  # unmatchable in this schema: no rows
+            continue
+        got = built.get(u)
+        if got is None:
+            got = built[u] = build(u, k)
+        hits.append(got)
+    return hits
+
+
+def dataset_find_rows(ds, path, keys, columns=None, policy=None,
+                      report=None) -> LookupResult:
+    """Batched point lookup across a whole :class:`~parquet_tpu.dataset.
+    Dataset`: keys normalize and hash ONCE for the corpus (schemas are
+    checked identical), per-file lookups fan out on the shared pool, and
+    hits merge in file order with GLOBAL row ordinals (``row_offsets``
+    indexing).  Degraded ``policy``: a file that cannot be opened or read
+    drops as a unit (``report.files_skipped``), keeping every other
+    file's hits."""
+    from ..io.faults import NON_DATA_ERRORS, ReadReport
+
+    t0 = time.perf_counter()
+    with _oscope.maybe_op_scope("dataset.find_rows", files=len(ds.paths),
+                                keys=len(keys)):
+        try:
+            return _dataset_find_rows_impl(ds, path, keys, columns, policy,
+                                           report, NON_DATA_ERRORS,
+                                           ReadReport)
+        finally:
+            _M_DS_FIND_S.observe(time.perf_counter() - t0)
+
+
+def _dataset_find_rows_impl(ds, path, keys, columns, policy, report,
+                            NON_DATA_ERRORS, ReadReport) -> LookupResult:
+    pol, report, skip = ds._resolve(policy, report)
+    # prepare once against the first openable footer (mirrors
+    # Dataset._prepare_where): probe normalization + bloom hashing are
+    # per-batch costs, not per-file costs
+    prep = leaf = None
+    for i in range(len(ds.paths)):
+        try:
+            pf0 = ds.file(i)
+        except DeadlineError:
+            raise
+        except NON_DATA_ERRORS:
+            raise
+        except (CorruptedError, OSError):
+            continue  # recorded by the per-file loop below
+        leaf = _validate_flat(pf0, path)
+        for c in (columns or []):
+            _validate_flat(pf0, c)
+        prep = _prepare_keys(leaf, keys)
+        break
+
+    counters = {k: 0 for k in _COUNTER_KEYS}
+    if prep is not None:
+        _count(counters, "keys", _M_KEYS, len(keys))  # once per batch
+
+    def one(i):
+        sub = ReadReport() if report is not None else None
+        rows = 0
+        try:
+            pf = ds.file(i)
+            ds._check_schema(pf, ds.paths[i])
+            rows = pf.num_rows
+            res = find_rows(pf, path, keys, columns=columns, policy=pol,
+                            report=sub, _prep=prep)
+            return res, sub, rows, None
+        except DeadlineError:
+            raise
+        except NON_DATA_ERRORS:
+            raise
+        except (CorruptedError, OSError) as e:
+            if not skip:
+                raise
+            return None, sub, rows, e
+
+    results = map_in_order(one, range(len(ds.paths)))
+    merged: Optional[List[KeyHits]] = None
+    out_leaves = []
+    base = 0
+    for i, (res, sub, rows, err) in enumerate(results):
+        if res is None:
+            if sub is not None:
+                report.retries += sub.retries
+            report.record_file_skip(ds.paths[i], rows=rows, error=err)
+            # a skipped file still occupies its span of the global row
+            # space when its footer parsed (rows known): later files'
+            # ordinals must keep matching row_offsets() indexing.  An
+            # unopenable file has no knowable row count (rows == 0).
+            base += rows
+            continue
+        if report is not None and sub is not None:
+            report.merge(sub)
+        for k in counters:
+            counters[k] += res.counters.get(k, 0)
+        if merged is None:
+            pf0 = ds.file(i)
+            out_leaves = [pf0.schema.leaf(c) for c in (columns or [])]
+            merged = [KeyHits(h.key, np.empty(0, np.int64)) for h in res]
+            for h in merged:
+                for leaf_c in out_leaves:
+                    h.values[leaf_c.dotted_path] = None
+                    h.validity[leaf_c.dotted_path] = None
+            parts = [[] for _ in res]
+        for j, h in enumerate(res):
+            if h.num_rows:
+                parts[j].append((h.rows + base, h.values, h.validity))
+        base += rows
+    if merged is None:
+        raise CorruptedError(
+            "dataset find_rows: every file failed "
+            f"({', '.join(report.files_skipped) if report else ''})")
+    for j, h in enumerate(merged):
+        ps = parts[j]
+        if ps:
+            h.rows = (ps[0][0] if len(ps) == 1
+                      else np.concatenate([p[0] for p in ps]))
+        for leaf_c in out_leaves:
+            c = leaf_c.dotted_path
+            vparts = [p[1][c] for p in ps]
+            valparts = [p[2][c] for p in ps]
+            if not vparts:
+                h.values[c] = _empty_values(leaf_c)
+                h.validity[c] = None
+                continue
+            has_valid = any(v is not None for v in valparts)
+            h.values[c], h.validity[c] = _concat_parts(
+                leaf_c, vparts, valparts, has_valid)
+    out = LookupResult(merged, counters)
+    out.report = report
+    return out

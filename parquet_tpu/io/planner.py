@@ -577,8 +577,8 @@ def _bloom_alive(pred: Pred, bf) -> bool:
 
 def _pred_page_ords(pred: Pred, ci) -> List[int]:
     """Page ordinals that may contain a matching row, per leaf kind."""
-    from .search import pages_overlapping, pages_overlapping_values
-    from .statistics import decode_stat_value
+    from .search import (decoded_bounds, pages_overlapping,
+                         pages_overlapping_values)
 
     if not pred.negated and pred.kind == "range":
         return pages_overlapping(ci, pred.leaf, pred.lo, pred.hi)
@@ -593,9 +593,9 @@ def _pred_page_ords(pred: Pred, ci) -> List[int]:
     if pred.kind == "notnull":
         return [i for i in range(n) if not nulls[i]]
     # negated range / in: a page is dead when provably all-inside (or all
-    # null — no non-null value to match the negation)
-    mins = [decode_stat_value(m, pred.leaf) for m in (ci.min_values or [])]
-    maxs = [decode_stat_value(m, pred.leaf) for m in (ci.max_values or [])]
+    # null — no non-null value to match the negation); bounds come decoded
+    # once per chunk from the memo on the parsed index (io/search.py)
+    mins, maxs = decoded_bounds(ci, pred.leaf)
     out = []
     probe_set = set(pred.values) if pred.kind == "in" else None
     for i in range(n):

@@ -177,6 +177,7 @@ class ReadStats:
     windows_issued: int = 0
     bytes_prefetched: int = 0
     bytes_discarded: int = 0
+    bytes_dropbehind: int = 0
     pool_wait_s: float = 0.0
 
     def as_dict(self) -> dict:
@@ -186,6 +187,7 @@ class ReadStats:
                 "windows_issued": self.windows_issued,
                 "bytes_prefetched": self.bytes_prefetched,
                 "bytes_discarded": self.bytes_discarded,
+                "bytes_dropbehind": self.bytes_dropbehind,
                 "pool_wait_s": round(self.pool_wait_s, 4)}
 
     def publish(self) -> None:
@@ -205,6 +207,8 @@ class ReadStats:
                         self.bytes_prefetched)
         _oscope.account(_counter("prefetch.bytes_discarded"),
                         self.bytes_discarded)
+        _oscope.account(_counter("prefetch.bytes_dropbehind"),
+                        self.bytes_dropbehind)
         _oscope.account(_counter("prefetch.pool_wait_s"), self.pool_wait_s)
 
 
@@ -241,7 +245,8 @@ class _Plan:
     (``seg_buf`` spanning [seg_start, seg_end)) so intra-segment window
     joins serve zero-copy."""
 
-    __slots__ = ("start", "issue", "end", "seg_buf", "seg_start", "seg_end")
+    __slots__ = ("start", "issue", "end", "seg_buf", "seg_start", "seg_end",
+                 "dropped")
 
     def __init__(self, start: int, end: int):
         self.start = start
@@ -250,6 +255,7 @@ class _Plan:
         self.seg_buf = None
         self.seg_start = 0
         self.seg_end = 0
+        self.dropped = start  # drop-behind frontier (advise backend)
 
 
 def _innermost(src: Source) -> Source:
@@ -311,6 +317,14 @@ class PrefetchSource(Source):
         self._mmap = _innermost(inner) if backend == "advise" else None
         if backend == "advise" and not isinstance(self._mmap, MmapSource):
             raise ValueError("advise backend needs an MmapSource-backed chain")
+        # drop-behind (PARQUET_TPU_MMAP_DROPBEHIND): one-shot streamed
+        # drains release consumed pages behind the frontier and drop the
+        # whole planned span at close, so a cold bulk scan can't evict
+        # the page cache the lookup serving path depends on
+        from .source import dropbehind_enabled
+
+        self._dropbehind = backend == "advise" and dropbehind_enabled()
+        self._advised_sequential = False
         self._closed = False
 
     @property
@@ -425,6 +439,9 @@ class PrefetchSource(Source):
         """Hint the kernel ``depth`` windows ahead of each plan's frontier.
         Exhausted plans stay registered (they cost nothing and keep the
         hit/miss classification of late re-reads honest)."""
+        if self._dropbehind and not self._advised_sequential:
+            self._advised_sequential = True
+            self._mmap.madvise_sequential()
         for plan in self._plans:
             ahead = min(plan.issue + self.depth * self.window_bytes,
                         plan.end)
@@ -434,9 +451,15 @@ class PrefetchSource(Source):
                 self.stats.bytes_prefetched += ahead - plan.issue
                 plan.issue = ahead
 
-    def _advance_advise(self, upto: int) -> None:
+    def _advance_advise(self, upto: int,
+                        drop_upto: Optional[int] = None) -> None:
         """Consumption reached ``upto``: keep the willneed horizon ``depth``
-        windows ahead of it for the plan covering it."""
+        windows ahead of it for the plan covering it.  ``drop_upto`` is
+        the drop-behind bound — the START of the read that just advanced
+        the frontier, NOT its end: the caller holds a zero-copy view of
+        [drop_upto, upto) it has not decoded yet, and dropping those
+        pages would force a disk refault of bytes readahead just paid
+        for.  Only the span strictly behind the current read drops."""
         with self._lock:
             for plan in self._plans:
                 if plan.start <= upto <= plan.end:
@@ -448,6 +471,14 @@ class PrefetchSource(Source):
                         self.stats.windows_issued += 1
                         self.stats.bytes_prefetched += ahead - plan.issue
                         plan.issue = ahead
+                    bound = upto if drop_upto is None else drop_upto
+                    if self._dropbehind and bound > plan.dropped:
+                        # release fully-consumed pages behind the frontier
+                        # (rounded inward — a partially-read page stays)
+                        self.stats.bytes_dropbehind += \
+                            self._mmap.madvise_dontneed(
+                                plan.dropped, bound - plan.dropped)
+                        plan.dropped = bound
                     break
 
     # ------------------------------------------------------------- serving
@@ -514,7 +545,9 @@ class PrefetchSource(Source):
                 self.stats.prefetch_misses += not covered
             out = (self.inner.pread_view(offset, size) if want_view
                    else self.inner.pread(offset, size))
-            self._advance_advise(end)
+            # drop-behind trails the read: [.., offset) is consumed, the
+            # [offset, end) view just handed out is not decoded yet
+            self._advance_advise(end, drop_upto=offset)
             return out
         # ring: find a covering chain of windows (cursor reads rarely align
         # with window boundaries, so a read often spans two)
@@ -607,6 +640,15 @@ class PrefetchSource(Source):
         with self._lock:
             first_close = not self._closed
             self._closed = True
+            if self._dropbehind and first_close:
+                # post-drain drop: the one-shot read is over — release
+                # each plan's REMAINING tail ([dropped, end); the span
+                # behind the frontier was already dropped and counted
+                # incrementally, re-dropping it would double the meter)
+                for plan in self._plans:
+                    self.stats.bytes_dropbehind += \
+                        self._mmap.madvise_dontneed(
+                            plan.dropped, plan.end - plan.dropped)
             self._plans.clear()
             for w in self._ring:
                 if not w.future.cancel() and w.future.done():

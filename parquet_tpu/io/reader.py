@@ -94,9 +94,11 @@ class PageInfo:
 
 
 def _checked_page_size(header: md.PageHeader, at: int) -> int:
-    """Shared page-size sanity check for the three page iterators."""
+    """Shared page-size sanity check for the three page iterators.  A
+    flipped header can still thrift-parse with the size field MISSING
+    (None) — that is corruption too, not a TypeError."""
     clen = header.compressed_page_size
-    if not 0 <= clen <= MAX_PAGE_SIZE:
+    if clen is None or not 0 <= clen <= MAX_PAGE_SIZE:
         raise _corrupt(
             f"page at {at}: compressed size {clen} out of range", at)
     return clen
@@ -115,7 +117,7 @@ class ColumnChunkReader:
         self.chunk = chunk
         self.leaf = leaf
         self.meta = chunk.meta_data
-        self._ci = self._oi = _UNSET
+        self._ci = self._oi = self._bf = _UNSET
 
     @property
     def codec(self) -> codecs.Codec:
@@ -423,9 +425,17 @@ class ColumnChunkReader:
         return oi
 
     def bloom_filter(self):
+        # memoized like the index structures: the file is immutable after
+        # open, and the batched-lookup path probes the same chunk's filter
+        # on every call — re-preading a multi-MB bitset per batch was pure
+        # waste.  (A filter pins host memory for the life of this reader,
+        # same as the parsed indexes; both live in file._chunk_cache.)
+        if self._bf is not _UNSET:
+            return self._bf
         from .bloom import read_bloom_filter
 
-        return read_bloom_filter(self)
+        self._bf = read_bloom_filter(self)
+        return self._bf
 
     def statistics(self):
         from .statistics import decode_statistics
@@ -739,6 +749,21 @@ class ParquetFile:
         return _iter(self, columns=columns, batch_rows=batch_rows,
                      strict_batch_rows=strict_batch_rows, policy=policy,
                      report=report)
+
+    def find_rows(self, path, keys, columns: Optional[Sequence[str]] = None,
+                  policy: Optional[FaultPolicy] = None,
+                  report: Optional[ReadReport] = None):
+        """Batched point lookup: the rows where column ``path`` equals each
+        of ``keys``, answered via the cheapest-first probe cascade (chunk
+        stats → batched bloom → page-index binary search → single-page
+        reads with coalesced preads and page-granular caching) without
+        materializing any whole chunk — see :mod:`parquet_tpu.io.lookup`.
+        Returns a :class:`~parquet_tpu.io.lookup.LookupResult` aligned
+        with ``keys``."""
+        from .lookup import find_rows as _find_rows
+
+        return _find_rows(self, path, keys, columns=columns, policy=policy,
+                          report=report)
 
     def read(self, columns: Optional[Sequence[str]] = None,
              device: bool = False,

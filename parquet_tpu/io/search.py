@@ -29,14 +29,31 @@ from .statistics import decode_stat_value
 _DICT_ENCODINGS = {Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY}
 
 
+def decoded_bounds(column_index: md.ColumnIndex, leaf: Leaf):
+    """Per-page ``(mins, maxs)`` of ``column_index`` decoded into the
+    leaf's order domain, memoized ON the ColumnIndex object (one index
+    belongs to one chunk/leaf, and chunk readers memoize their parsed
+    index, so the memo lives exactly as long as the file handle).  Every
+    page-stat consumer — ``find``, ``pages_overlapping*``, the planner's
+    page stage — decodes a chunk's bounds once per open file instead of
+    once per probe: a 1k-key batch against one chunk pays one decode."""
+    got = getattr(column_index, "_decoded_bounds", None)
+    if got is None:
+        got = ([decode_stat_value(m, leaf)
+                for m in (column_index.min_values or [])],
+               [decode_stat_value(m, leaf)
+                for m in (column_index.max_values or [])])
+        column_index._decoded_bounds = got
+    return got
+
+
 def find(column_index: md.ColumnIndex, value, leaf: Leaf) -> int:
     """First page ordinal whose [min,max] may contain ``value`` (== number of
     pages when none can).  Binary search when boundary_order allows, else
     linear scan — same contract as the reference's ``parquet.Find``."""
     value = normalize(leaf, value)
     n = len(column_index.null_pages or [])
-    mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
-    maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
+    mins, maxs = decoded_bounds(column_index, leaf)
     order = BoundaryOrder(column_index.boundary_order or 0)
     nulls = column_index.null_pages or [False] * n
 
@@ -75,8 +92,7 @@ def pages_overlapping(column_index: md.ColumnIndex, leaf: Leaf,
     """All page ordinals whose [min,max] intersects [lo, hi] (None = open)."""
     lo, hi = normalize(leaf, lo), normalize(leaf, hi)
     n = len(column_index.null_pages or [])
-    mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
-    maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
+    mins, maxs = decoded_bounds(column_index, leaf)
     nulls = column_index.null_pages or [False] * n
     out = []
     for i in range(n):
@@ -185,8 +201,7 @@ def pages_overlapping_values(column_index: md.ColumnIndex, leaf: Leaf,
                              sorted_vals: List) -> List[int]:
     """Page ordinals whose [min,max] contains at least one probe value."""
     n = len(column_index.null_pages or [])
-    mins = [decode_stat_value(m, leaf) for m in (column_index.min_values or [])]
-    maxs = [decode_stat_value(m, leaf) for m in (column_index.max_values or [])]
+    mins, maxs = decoded_bounds(column_index, leaf)
     nulls = column_index.null_pages or [False] * n
     out = []
     for i in range(n):
@@ -260,6 +275,26 @@ def pages_and_base(chunk: ColumnChunkReader, row_start: int, row_end: int):
     return pages, first
 
 
+def dictionary_pages(chunk: ColumnChunkReader, first_data_offset: int):
+    """Yield the chunk's dictionary page (if any) given the byte offset of
+    the first selected data page — the dictionary half of ``SeekToRow``,
+    shared by :func:`seek_pages` and the point-lookup page fetcher
+    (io/lookup.py), which both decode page subsets that may be
+    dictionary-encoded."""
+    m = chunk.meta
+    dict_off = m.dictionary_page_offset
+    if dict_off is not None and 0 < dict_off < first_data_offset:
+        yield from chunk.pages_at(dict_off, first_data_offset - dict_off)
+    elif dict_off is None and any(Encoding(e) in _DICT_ENCODINGS
+                                  for e in (m.encodings or [])):
+        # legacy writers may omit dictionary_page_offset: find the dictionary
+        # page the slow way (full header scan, old behavior)
+        for p in chunk.pages():
+            if p.page_type == PageType.DICTIONARY_PAGE:
+                yield p
+                break
+
+
 def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
     """Yield the dictionary page (if any) + the data pages covering
     [row_start, row_end) — reference's ``Pages.SeekToRow`` + read loop.
@@ -278,18 +313,7 @@ def seek_pages(chunk: ColumnChunkReader, row_start: int, row_end: int):
     i1 = min(bisect_left(firsts, row_end, lo=i0), len(locs))
     if i1 <= i0:
         return
-    m = chunk.meta
-    dict_off = m.dictionary_page_offset
-    if dict_off is not None and 0 < dict_off < locs[0].offset:
-        yield from chunk.pages_at(dict_off, locs[0].offset - dict_off)
-    elif dict_off is None and any(Encoding(e) in _DICT_ENCODINGS
-                                  for e in (m.encodings or [])):
-        # legacy writers may omit dictionary_page_offset: find the dictionary
-        # page the slow way (full header scan, old behavior)
-        for p in chunk.pages():
-            if p.page_type == PageType.DICTIONARY_PAGE:
-                yield p
-                break
+    yield from dictionary_pages(chunk, locs[0].offset)
     span_start = locs[i0].offset
     span_len = locs[i1 - 1].offset + locs[i1 - 1].compressed_page_size - span_start
     yield from chunk.pages_at(span_start, span_len, num_pages=i1 - i0)

@@ -134,9 +134,39 @@ class MmapSource(Source):
             self.stat_key = (os.path.abspath(path), st.st_ino,
                              st.st_mtime_ns, st.st_size)
             self._mm = _mmap.mmap(fd, self._size, prot=_mmap.PROT_READ)
-        finally:
+        except BaseException:
             os.close(fd)
+            raise
+        # drop-behind needs a file descriptor: releasing page-cache
+        # residency is posix_fadvise(DONTNEED) — madvise(MADV_DONTNEED)
+        # on a MAP_SHARED file mapping only drops this process's PTEs,
+        # the kernel page cache keeps the pages.  The fd is retained
+        # ONLY when the mode is on at open (mmap does not pin it):
+        # unconditional retention would double fd pressure for every
+        # serving fleet that never drops behind.  madvise_* can still
+        # re-open lazily if called with the mode off (tests, direct use).
+        if dropbehind_enabled():
+            self._fd = fd
+        else:
+            self._fd = None
+            os.close(fd)
+        self._fd_lock = threading.Lock()
         self._view = memoryview(self._mm)
+
+    def _fadvise_fd(self):
+        """The retained drop-behind fd, opened lazily (under a lock — a
+        check-then-assign race would leak the loser's fd for the process
+        lifetime) when the source was created with the mode off.  A
+        lazily-opened fd could name a file that REPLACED the mapped one
+        (rename-replace) — harmless here: fadvise is pure advice, and
+        the mapped bytes are untouched."""
+        with self._fd_lock:
+            if self._fd is None and self._view is not None:
+                try:
+                    self._fd = os.open(self.path, os.O_RDONLY)
+                except OSError:
+                    return None
+            return self._fd
 
     def _checked_view(self):
         v = self._view
@@ -178,6 +208,65 @@ class MmapSource(Source):
         except (OSError, ValueError, AttributeError):
             pass
 
+    def madvise_sequential(self) -> None:
+        """Declare the map sequentially-read (the kernel widens readahead
+        and recycles pages behind the reader more eagerly) — the
+        drop-behind mode's companion hint.  Both the mapping (madvise)
+        and the file descriptor (posix_fadvise) are hinted; best-effort."""
+        mm = self._mm
+        if mm is None:
+            return
+        try:
+            mm.madvise(_mmap.MADV_SEQUENTIAL)
+        except (OSError, ValueError, AttributeError):
+            pass
+        fd = self._fadvise_fd()
+        if fd is not None:
+            try:
+                os.posix_fadvise(fd, 0, self._size,
+                                 os.POSIX_FADV_SEQUENTIAL)
+            except (OSError, AttributeError):
+                pass
+
+    def madvise_dontneed(self, offset: int, size: int) -> int:
+        """Release the page-cache residency of the pages FULLY inside
+        [offset, offset+size) — the drop-behind half of a one-shot
+        streamed drain (a multi-GB cold scan must not evict the working
+        set the lookup serving path depends on).  The actual release is
+        ``posix_fadvise(fd, ..., POSIX_FADV_DONTNEED)`` on the retained
+        fd: ``madvise(MADV_DONTNEED)`` on a MAP_SHARED file mapping only
+        drops this process's page tables, not the kernel page cache — so
+        both are issued (fadvise frees the cache, madvise trims RSS).
+        The range rounds INWARD to page boundaries so a partially-
+        consumed page is never dropped; returns the bytes hinted (0 on
+        failure — best-effort).  Live ``pread_view`` views stay VALID
+        after a drop (pages refault from disk on next touch); dropping
+        merely forfeits cache residency."""
+        mm = self._mm
+        if mm is None or size <= 0:
+            return 0
+        page = _mmap.PAGESIZE
+        lo = ((offset + page - 1) // page) * page
+        hi = min(self._size, ((offset + size) // page) * page)
+        if hi <= lo:
+            return 0
+        # ORDER MATTERS: unmap the PTEs first — the kernel's fadvise
+        # eviction (invalidate_mapping_pages) skips pages still mapped
+        # into page tables, and a just-drained span was faulted in
+        # through this very mapping
+        try:
+            mm.madvise(_mmap.MADV_DONTNEED, lo, hi - lo)
+        except (OSError, ValueError, AttributeError):
+            pass
+        fd = self._fadvise_fd()
+        if fd is None:
+            return 0
+        try:
+            os.posix_fadvise(fd, lo, hi - lo, os.POSIX_FADV_DONTNEED)
+        except (OSError, AttributeError):
+            return 0
+        return hi - lo
+
     def size(self) -> int:
         return self._size
 
@@ -187,10 +276,27 @@ class MmapSource(Source):
         if self._view is not None:
             self._view = None
             mm, self._mm = self._mm, None
+            with self._fd_lock:  # pairs with _fadvise_fd's lazy open
+                fd, self._fd = self._fd, None
+            if fd is not None:
+                os.close(fd)
             try:
                 mm.close()
             except BufferError:
                 pass  # exported views still alive: unmapped when they die
+
+
+def dropbehind_enabled() -> bool:
+    """``PARQUET_TPU_MMAP_DROPBEHIND=1``: one-shot streamed drains over an
+    :class:`MmapSource` advise sequential access up front and RELEASE the
+    consumed span behind the read frontier (``posix_fadvise(DONTNEED)``
+    on the retained fd for the page cache + ``madvise`` for RSS), so a
+    cold multi-GB scan passes THROUGH the page cache instead of evicting
+    the hot footers/pages the serving paths live on.  Off by default:
+    dropping is wrong for re-read workloads (the warm-cache speedups the
+    bench measures) — it is the knob for known-one-shot bulk drains."""
+    return os.environ.get("PARQUET_TPU_MMAP_DROPBEHIND", "0") \
+        not in ("", "0")
 
 
 def _check_read_args(offset: int, size: int) -> None:

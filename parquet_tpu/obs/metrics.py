@@ -383,11 +383,16 @@ _CORE_COUNTERS = (
     ("cache.chunk_hits", "decoded-chunk LRU hits"),
     ("cache.chunk_misses", "decoded-chunk LRU misses"),
     ("cache.chunk_evictions", "decoded-chunk LRU evictions"),
+    ("cache.page_hits", "decoded-page LRU hits (lookup served with no IO)"),
+    ("cache.page_misses", "decoded-page LRU misses"),
+    ("cache.page_evictions", "decoded-page LRU evictions"),
     ("prefetch.hits", "preads served from readahead state"),
     ("prefetch.misses", "preads read through around readahead"),
     ("prefetch.windows_issued", "readahead windows issued/hinted"),
     ("prefetch.bytes_prefetched", "bytes issued ahead of consumption"),
     ("prefetch.bytes_discarded", "prefetched bytes dropped unconsumed"),
+    ("prefetch.bytes_dropbehind", "page-cache bytes released behind "
+     "one-shot drains (PARQUET_TPU_MMAP_DROPBEHIND)"),
     ("prefetch.pool_wait_s", "seconds blocked on unfinished windows"),
     # "considered", not the plan-counter key "rg_total": the Prometheus
     # renderer appends _total to counters, and rg_total_total is a trap
@@ -418,6 +423,18 @@ _CORE_COUNTERS = (
     ("trace.ops_sampled", "ops head-sampled into the trace"),
     ("trace.ops_skipped", "ops skipped by head sampling"),
     ("trace.ops_slow_kept", "slow ops kept by tail capture"),
+    # point-lookup serving path (io/lookup.py): per-stage key attrition,
+    # coalescing ratio (pages_read vs preads), and admission pressure
+    ("lookup.keys", "keys probed by batched find_rows"),
+    ("lookup.keys_pruned_stats", "lookup keys killed by chunk statistics"),
+    ("lookup.keys_pruned_bloom", "lookup keys killed by bloom filters"),
+    ("lookup.keys_pruned_pages", "lookup keys killed by the page index"),
+    ("lookup.rows_matched", "rows returned by batched lookups"),
+    ("lookup.preads", "ranged preads issued by the lookup page fetcher"),
+    ("lookup.pages_read", "pages decoded from storage by lookups"),
+    ("lookup.pages_coalesced", "extra pages riding an already-issued pread"),
+    ("lookup.chunk_fallbacks", "index-less chunks decoded whole by lookups"),
+    ("lookup.admission_waits", "lookup admissions that had to block"),
 )
 
 
@@ -429,6 +446,9 @@ def _declare_core() -> None:
                          help="scans routed by the cost model")
     REGISTRY.histogram("pool.queue_wait_s",
                        help="shared-pool task queue->run wait")
+    REGISTRY.histogram("lookup.find_rows_s",
+                       help="batched point-lookup latency (p50/p99 serving "
+                            "meter)")
 
 
 _declare_core()

@@ -327,9 +327,11 @@ class OpScope:
             "pool_wait_s": round(c.get("pool.queue_wait_s", 0.0)
                                  + c.get("prefetch.wait_s", 0.0), 6),
             "cache_hits": (c.get("cache.footer_hits", 0)
-                           + c.get("cache.chunk_hits", 0)),
+                           + c.get("cache.chunk_hits", 0)
+                           + c.get("cache.page_hits", 0)),
             "cache_misses": (c.get("cache.footer_misses", 0)
-                             + c.get("cache.chunk_misses", 0)),
+                             + c.get("cache.chunk_misses", 0)
+                             + c.get("cache.page_misses", 0)),
             "retries": c.get("read.retries", 0),
             "rows_pruned": c.get("scan.rows_pruned", 0),
             "rows_decoded": c.get("scan.rows_decoded", 0),

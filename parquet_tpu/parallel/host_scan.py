@@ -221,6 +221,26 @@ def _pred_mask(pred, span_val: tuple, n: int) -> np.ndarray:
     return base
 
 
+def aligned_key_mask(leaf, key, values, validity) -> np.ndarray:
+    """Exact equality mask of one NORMALIZED key over a row-aligned span —
+    the point-lookup face of the scan's :func:`_pred_mask`, so batched
+    ``find_rows`` (io/lookup.py) matches keys with byte-for-byte the same
+    order-domain comparison semantics every filtered scan uses (unsigned
+    views, decimal unscaled ints, NULL never matches)."""
+    from ..algebra.expr import Pred
+
+    if isinstance(values, list) or isinstance(values, tuple):
+        n = len(values)
+        values = list(values)
+    elif validity is not None:
+        n = len(validity)
+    else:
+        n = len(values)
+    pred = Pred(leaf.dotted_path, "range", lo=key, hi=key, leaf=leaf,
+                prepared=True)
+    return _pred_mask(pred, (values, validity), n)
+
+
 _NESTED_MSG = ("column {c!r} is nested; scan_filtered returns row-aligned "
                "arrays — use read_row_range per plan for nested columns")
 

@@ -15,12 +15,15 @@ import contextvars
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
 from typing import Optional
 
 from ..obs import scope as _scope
 from ..obs import trace as _trace
 from ..obs.metrics import counter as _counter
+from ..obs.metrics import gauge as _gauge
 from ..obs.metrics import histogram as _histogram
 
 _POOL: Optional[ThreadPoolExecutor] = None
@@ -31,6 +34,13 @@ _IN_POOL = threading.local()
 # dispatch feeds (obs.metrics.pool_wait_seconds sums it for the router)
 _QUEUE_WAIT = _histogram("pool.queue_wait_s")
 _TASKS = _counter("pool.tasks", help="tasks dispatched to the shared pool")
+
+# admission-control meters (the lookup serving path's fairness gate)
+_M_ADM_WAITS = _counter("lookup.admission_waits",
+                        help="lookup admissions that had to block")
+_ADM_WAIT_S = _histogram("lookup.admission_wait_s")
+_M_ADMITTED = _gauge("lookup.admitted_bytes",
+                     help="bytes currently admitted through the lookup gate")
 
 
 def in_shared_pool() -> bool:
@@ -157,6 +167,122 @@ def map_in_order(fn, items, parallel: "Optional[bool]" = None) -> list:
     if first_err is not None:
         raise first_err
     return out
+
+
+class AdmissionController:
+    """FIFO bytes-budget gate for the point-lookup serving path.
+
+    The shared pool bounds *width* (how many tasks run) but not *memory*
+    (how many bytes the running + queued tasks pin) or *order* (a flood of
+    late arrivals can starve an earlier waiter indefinitely under a plain
+    semaphore).  Serving workloads hit both: thousands of concurrent small
+    lookups would decode unbounded page bytes and leapfrog each other.
+    This controller fixes both at once:
+
+    - **bytes budget** — ``acquire(nbytes)`` blocks until the request fits
+      in the remaining budget (``PARQUET_TPU_LOOKUP_BUDGET`` bytes,
+      default 64 MiB, ``0`` disables admission), so total in-flight
+      lookup bytes never exceed the cap no matter the concurrency.  A
+      request larger than the whole budget is clamped and admits alone —
+      it must not deadlock, and alone it cannot compound.
+    - **FIFO fairness** — waiters are granted strictly in arrival order
+      (a ticket queue, not a herd on a semaphore), so a large early
+      request cannot be starved by a stream of later small ones, and
+      lookup bursts drain in bounded, predictable order instead of
+      whichever thread wins the race.
+
+    ``high_water`` records the max bytes ever admitted concurrently (the
+    budget-held proof the admission tests assert).  Waits are metered:
+    ``lookup.admission_waits`` counts blocked acquires and
+    ``lookup.admission_wait_s`` is the block-time histogram."""
+
+    def __init__(self, env_var: str = "PARQUET_TPU_LOOKUP_BUDGET",
+                 default_bytes: int = 64 << 20):
+        self._env_var = env_var
+        self._default = default_bytes
+        self._cv = threading.Condition(threading.Lock())
+        self._queue: "deque" = deque()
+        self._in_use = 0
+        self.high_water = 0
+        self.waits = 0
+
+    def budget_bytes(self) -> int:
+        """Budget read per acquire (tests repoint the env without
+        rebuilding the controller); ``0`` disables admission."""
+        v = os.environ.get(self._env_var, "").strip()
+        if v:
+            try:
+                return max(0, int(v))
+            except ValueError:
+                pass
+        return self._default
+
+    def acquire(self, nbytes: int) -> int:
+        """Block FIFO until ``nbytes`` fit; returns the granted amount to
+        hand back to :meth:`release` (0 when admission is disabled)."""
+        budget = self.budget_bytes()
+        if budget <= 0:
+            return 0
+        grant = min(max(int(nbytes), 0), budget)
+        ticket = object()
+        t0 = time.perf_counter()
+        waited = False
+        with self._cv:
+            self._queue.append(ticket)
+            while self._queue[0] is not ticket \
+                    or self._in_use + grant > budget:
+                waited = True
+                self._cv.wait()
+            self._queue.popleft()
+            self._in_use += grant
+            if self._in_use > self.high_water:
+                self.high_water = self._in_use
+            if waited:
+                self.waits += 1  # inside the lock: exact under herds
+            _M_ADMITTED.set(self._in_use)
+            # the next waiter may also fit (grants are not exclusive):
+            # wake the queue so admission drains as wide as the budget
+            self._cv.notify_all()
+        if waited:
+            wait_s = time.perf_counter() - t0
+            _ADM_WAIT_S.observe(wait_s)
+            _scope.account(_M_ADM_WAITS)
+            _scope.add_to_current("lookup.admission_wait_s", wait_s)
+        return grant
+
+    def release(self, grant: int) -> None:
+        if grant <= 0:
+            return
+        with self._cv:
+            self._in_use -= grant
+            _M_ADMITTED.set(self._in_use)
+            self._cv.notify_all()
+
+    @contextmanager
+    def admit(self, nbytes: int):
+        """``with admission.admit(span_bytes): pread + decode`` — the
+        shape every lookup IO/decode span wraps."""
+        grant = self.acquire(nbytes)
+        try:
+            yield grant
+        finally:
+            self.release(grant)
+
+    def _reset(self) -> None:
+        """Test isolation only: forget the high-water mark and wait count
+        (the budget itself is env-driven)."""
+        with self._cv:
+            self.high_water = self._in_use
+            self.waits = 0
+
+
+_ADMISSION = AdmissionController()
+
+
+def lookup_admission() -> AdmissionController:
+    """The process-wide admission gate the batched-lookup path shares —
+    one budget across every concurrent ``find_rows``, every file."""
+    return _ADMISSION
 
 
 def available_cpus() -> int:

@@ -552,6 +552,76 @@ print('stats --serve ok:', sys.argv[1])
 kill $SRV_PID
 wait $SRV_PID 2>/dev/null || true
 rm -rf "$TELEM_DIR"
+echo "=== point-lookup smoke (coalescing + page-cache hit ratio + p99 meter) ==="
+python - <<'LKEOF'
+# The batched lookup path (ISSUE 9): cold batch coalesces preads, the warm
+# repeat serves from the page cache with ZERO source reads, the hit ratio
+# and the lookup.find_rows_s p99 meter are answerable from `stats --json`,
+# and admission control + per-stage counters render in --prom.
+import contextlib
+import io as _io
+import json
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import ParquetFile
+from parquet_tpu.__main__ import main as cli_main
+from parquet_tpu.io.cache import cache_stats, clear_caches
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.obs import metrics_snapshot
+
+n = 60_000
+rng = np.random.default_rng(9)
+d = tempfile.mkdtemp(prefix="pq_lookup_smoke_")
+path = os.path.join(d, "serve.parquet")
+t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64) // 3),
+              "v": pa.array(rng.random(n)),
+              "s": pa.array([f"p{i % 389:03d}" for i in range(n)])})
+write_table(t, path, WriterOptions(row_group_size=n // 4,
+                                   data_page_size=8 * 1024,
+                                   bloom_filters={"k": 10}))
+clear_caches(reset_stats=True)
+pf = ParquetFile(path)
+keys = [int(x) for x in rng.integers(0, n // 3, 24)] + [10**9]
+cold = pf.find_rows("k", keys, columns=["v", "s"])
+assert cold.counters["pages_coalesced"] > 0, cold.counters
+m0 = metrics_snapshot()["counters"]
+warm = pf.find_rows("k", keys, columns=["v", "s"])
+m1 = metrics_snapshot()["counters"]
+assert m1.get("read.bytes_read", 0) == m0.get("read.bytes_read", 0), \
+    "warm lookup touched the source"
+for h1, h2 in zip(cold, warm):
+    assert list(h1.rows) == list(h2.rows) and h1.values["s"] == h2.values["s"]
+st = cache_stats()
+ratio = st.page_hits / max(st.page_hits + st.page_misses, 1)
+assert ratio >= 0.5, f"page-cache hit ratio {ratio:.2f} too low"
+# the serving meters, exactly as an operator would scrape them
+out = _io.StringIO()
+with contextlib.redirect_stdout(out):
+    rc = cli_main(["stats", "--json"])
+assert rc == 0
+snap = json.loads(out.getvalue())
+hist = snap["histograms"]["lookup.find_rows_s"]
+assert hist["count"] >= 2 and hist["p99"] is not None, hist
+assert snap["counters"]["cache.page_hits"] > 0
+assert snap["counters"]["lookup.pages_coalesced"] > 0
+out = _io.StringIO()
+with contextlib.redirect_stdout(out):
+    cli_main(["stats", "--prom"])
+prom = out.getvalue()
+for fam in ("parquet_tpu_lookup_keys_total",
+            "parquet_tpu_lookup_admission_waits_total",
+            "parquet_tpu_cache_page_hits_total",
+            "parquet_tpu_lookup_find_rows_s_bucket"):
+    assert fam in prom, fam
+pf.close()
+print(f"point-lookup smoke ok: {cold.counters['preads']} preads for "
+      f"{cold.counters['pages_read']} pages cold, hit ratio {ratio:.2f} "
+      f"warm, p99={hist['p99']}s")
+LKEOF
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_QUICK=1 python bench.py 2>&1 | python -c "
 import json, sys
@@ -589,6 +659,12 @@ for name, cfg in detail.get('configs', {}).items():
         assert sw.get('0.1%', {}).get('speedup', 0) >= 1.2, (name, sw)
         assert sw.get('0.1%', {}).get('candidate_rows', 1 << 60) \
             < sw.get('0.1%', {}).get('candidate_rows_baseline', 0), sw
+    if name.startswith('10_'):
+        assert cfg.get('byte_identical') is True, (name, cfg)
+        assert cfg.get('speedup_vs_naive', 0) >= 2.0, (name, cfg)
+        assert cfg.get('warm_source_bytes', 1) == 0, (name, cfg)
+        assert cfg.get('page_cache', {}).get('hits', 0) > 0, (name, cfg)
+        assert cfg.get('p99_s') is not None, (name, cfg)
 print('bench smoke ok:', d['metric'], d['value'], d['unit'])
 "
 echo "ALL CHECKS PASSED"

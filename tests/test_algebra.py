@@ -528,3 +528,103 @@ def test_streaming_merge_depth3(rng):
     want = sorted(rows, key=lambda r: r[0])
     assert got.column("k").to_pylist() == [r[0] for r in want]
     assert got.column("vvv").to_pylist() == [r[1] for r in want]
+
+
+# ---------------------------------------------------------------------------
+# Or-of-ranges interval union (prepare-time merging, ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _int_schema():
+    return sch.message("m", [sch.leaf("x", Type.INT64),
+                             sch.leaf("y", Type.INT64)])
+
+
+def test_or_union_overlapping_ranges_fold_to_notnull():
+    from parquet_tpu.algebra.expr import Pred, col, prepare
+
+    e = prepare((col("x") <= 5) | (col("x") >= 3), _int_schema())
+    assert isinstance(e, Pred) and e.kind == "notnull"
+    # shared endpoint overlaps too (inclusive bounds)
+    e2 = prepare((col("x") <= 5) | (col("x") >= 5), _int_schema())
+    assert isinstance(e2, Pred) and e2.kind == "notnull"
+
+
+def test_or_union_merges_overlapping_keeps_disjoint():
+    from parquet_tpu.algebra.expr import Or, Pred, col, prepare
+
+    e = prepare(col("x").between(0, 10) | col("x").between(5, 20)
+                | col("x").between(100, 200), _int_schema())
+    assert isinstance(e, Or) and len(e.children) == 2
+    ranges = sorted((p.lo, p.hi) for p in e.children)
+    assert ranges == [(0, 20), (100, 200)]
+
+
+def test_or_union_absorbs_covered_in_probes():
+    from parquet_tpu.algebra.expr import Or, Pred, col, prepare
+
+    e = prepare(col("x").between(10, 20) | col("x").isin([12, 15, 50]),
+                _int_schema())
+    assert isinstance(e, Or) and len(e.children) == 2
+    kinds = {p.kind: p for p in e.children}
+    assert kinds["range"].lo == 10 and kinds["range"].hi == 20
+    assert kinds["in"].values == [50]  # 12, 15 absorbed by the range
+    # fully covered probes: the Or collapses to the range alone
+    e2 = prepare(col("x").between(10, 20) | col("x").isin([12, 15]),
+                 _int_schema())
+    assert isinstance(e2, Pred) and e2.kind == "range"
+
+
+def test_or_union_open_ended_and_cross_column_untouched():
+    from parquet_tpu.algebra.expr import Or, Pred, col, prepare
+
+    e = prepare((col("x") <= 5) | (col("x") >= 100), _int_schema())
+    assert isinstance(e, Or) and len(e.children) == 2
+    assert sorted([(p.lo, p.hi) for p in e.children],
+                  key=lambda t: (t[0] is not None, t[0] or 0)) \
+        == [(None, 5), (100, None)]
+    # different columns never merge
+    e2 = prepare((col("x") <= 5) | (col("y") >= 3), _int_schema())
+    assert isinstance(e2, Or) and len(e2.children) == 2
+
+
+def test_or_union_scan_parity(rng):
+    """The merged tree returns byte-identical rows to the unmerged
+    semantics (oracle: numpy mask)."""
+    from parquet_tpu.algebra.expr import col
+    from parquet_tpu.parallel.host_scan import scan_expr
+
+    n = 20000
+    x = rng.permutation(n).astype(np.int64)
+    v = rng.random(n)
+    buf = io.BytesIO()
+    write_table(pa.table({"x": pa.array(x), "v": pa.array(v)}), buf,
+                WriterOptions(row_group_size=n // 8, data_page_size=4096,
+                              dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    expr = (col("x") <= 99) | (col("x") >= n - 100) \
+        | col("x").between(5000, 5050) | col("x").isin([5010, 7777])
+    got = scan_expr(pf, expr, columns=["v"])
+    m = (x <= 99) | (x >= n - 100) | ((x >= 5000) & (x <= 5050)) \
+        | np.isin(x, [5010, 7777])
+    np.testing.assert_array_equal(got["v"], v[m])
+    pf.close()
+
+
+def test_or_union_prunes_pages_for_disjoint_ranges(rng):
+    """Disjoint Or-of-ranges on a sorted column prunes row groups at the
+    stats stage instead of degrading to full-column candidates."""
+    from parquet_tpu.algebra.expr import col
+    from parquet_tpu.io.planner import ScanPlanner
+
+    n = 40000
+    buf = io.BytesIO()
+    write_table(pa.table({"x": pa.array(np.arange(n, dtype=np.int64)),
+                          "v": pa.array(rng.random(n))}), buf,
+                WriterOptions(row_group_size=n // 8, data_page_size=4096,
+                              dictionary=False))
+    pf = ParquetFile(buf.getvalue())
+    plan = ScanPlanner(pf).plan((col("x") <= 5) | (col("x") >= n - 10))
+    assert plan.counters["rg_pruned_stats"] == 6  # middle 6 of 8 rgs die
+    assert plan.candidate_rows < n // 8
+    pf.close()

@@ -16,6 +16,7 @@ EX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ("typed_round_trip.py", ["{tmp}/trades.parquet"]),
     ("pushdown_scan.py", []),
     ("dataset_scan.py", ["20000"]),
+    ("point_lookup.py", ["40000"]),
     ("sorted_merge.py", []),
     ("telemetry.py", ["20000"]),
     ("serving_telemetry.py", ["20000"]),

@@ -1,0 +1,676 @@
+"""Point-lookup serving path (io/lookup.py): batched find_rows parity vs a
+naive read+mask oracle, pread coalescing, the page-cache tier's
+hit/eviction/frozen contracts, lookup × faults, admission control, and
+per-op report exactness."""
+
+import io
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import parquet_tpu as pq
+from parquet_tpu import Dataset, ParquetFile
+from parquet_tpu.errors import CorruptedError
+from parquet_tpu.format.enums import Encoding
+from parquet_tpu.io.cache import PAGES, cache_stats, clear_caches
+from parquet_tpu.io.faults import FaultInjectingSource, FaultPolicy, ReadReport
+from parquet_tpu.io.lookup import find_rows
+from parquet_tpu.io.reader import ReadOptions
+from parquet_tpu.io.source import BytesSource, MmapSource
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.utils.pool import AdmissionController, lookup_admission
+
+N = 24_000
+RGS = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    clear_caches(reset_stats=True)
+    lookup_admission()._reset()
+    yield
+    clear_caches(reset_stats=True)
+
+
+def _opts(encoding="dict", bloom=True, page=4096):
+    kw = dict(row_group_size=N // RGS, data_page_size=page,
+              bloom_filters={"k": 10} if bloom else {})
+    if encoding == "dict":
+        kw["dictionary"] = True
+    elif encoding == "plain":
+        kw["dictionary"] = False
+    elif encoding == "delta":
+        kw["dictionary"] = False
+        kw["column_encoding"] = {"k": Encoding.DELTA_BINARY_PACKED}
+    return WriterOptions(**kw)
+
+
+def _corpus(tmp_path, encoding="dict", nulls=False, name="f.parquet",
+            sorted_keys=False, n=N, seed=5):
+    """On-disk file (page-cache eligible): int64 keys with duplicates,
+    float payload, string payload; optional nulls in all three."""
+    r = np.random.default_rng(seed)
+    # //7 so duplicate runs straddle page AND row-group boundaries
+    k = (np.arange(n) // 7 if sorted_keys
+         else r.integers(0, n // 4, n)).astype(np.int64)
+    v = r.random(n)
+    s = [f"pay_{i % 509:04d}" for i in range(n)]
+    if nulls:
+        km = r.random(n) < 0.05
+        vm = r.random(n) < 0.07
+        sm = r.random(n) < 0.06
+        karr = pa.array(k, mask=km)
+        varr = pa.array(v, mask=vm)
+        sarr = pa.array([None if m else x for x, m in zip(s, sm)])
+        key_list = [None if m else int(x) for x, m in zip(k, km)]
+        v_list = [None if m else float(x) for x, m in zip(v, vm)]
+        s_list = [None if m else x for x, m in zip(s, sm)]
+    else:
+        karr, varr, sarr = pa.array(k), pa.array(v), pa.array(s)
+        key_list = [int(x) for x in k]
+        v_list = [float(x) for x in v]
+        s_list = list(s)
+    t = pa.table({"k": karr, "v": varr, "s": sarr})
+    path = str(tmp_path / name)
+    write_table(t, path, _opts(encoding))
+    return path, key_list, v_list, s_list
+
+
+def _oracle(key_list, v_list, s_list, key):
+    rows = [i for i, x in enumerate(key_list)
+            if x is not None and x == key]
+    return (np.array(rows, np.int64),
+            [v_list[i] for i in rows],
+            [None if s_list[i] is None else s_list[i].encode()
+             for i in rows])
+
+
+def _assert_hit(h, key_list, v_list, s_list):
+    rows, vs, ss = _oracle(key_list, v_list, s_list, h.key)
+    np.testing.assert_array_equal(h.rows, rows, err_msg=repr(h.key))
+    got_v, valid_v = h.values["v"], h.validity["v"]
+    for j, want in enumerate(vs):
+        if want is None:
+            assert valid_v is not None and not valid_v[j]
+        else:
+            assert (valid_v is None or valid_v[j]) and got_v[j] == want
+    assert h.values["s"] == ss
+
+
+# ---------------------------------------------------------------------------
+# parity vs naive read+mask: encodings × nulls × multi-rg
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("encoding", ["dict", "plain", "delta"])
+@pytest.mark.parametrize("nulls", [False, True])
+def test_parity_vs_naive_mask(tmp_path, encoding, nulls):
+    path, kl, vl, sl = _corpus(tmp_path, encoding=encoding, nulls=nulls)
+    pf = ParquetFile(path)
+    from collections import Counter
+
+    freq = Counter(x for x in kl if x is not None)
+    present = next(x for x in kl if x is not None)
+    dup = freq.most_common(1)[0][0]
+    keys = [present, dup, present, 10**9, -1, None]
+    res = pf.find_rows("k", keys, columns=["v", "s"])
+    assert len(res) == len(keys)
+    for h, key in zip(res, keys):
+        assert h.key == key
+        if key is None:
+            assert h.num_rows == 0
+            continue
+        _assert_hit(h, kl, vl, sl)
+    # duplicates in the input share one probe: counters count 6 keys once
+    assert res.counters["keys"] == len(keys)
+    assert res[0].rows is res[2].rows  # same uniq key → same hit object
+    assert res.counters["rows_matched"] == res[0].num_rows + res[1].num_rows
+    pf.close()
+
+
+def test_rows_span_row_groups_and_pages(tmp_path):
+    path, kl, vl, sl = _corpus(tmp_path, sorted_keys=True)
+    pf = ParquetFile(path)
+    # key N//3//2 appears 3x contiguously; key at a rg boundary spans rgs
+    per_rg = N // RGS
+    boundary_key = kl[per_rg - 1]  # likely spans the rg boundary
+    res = pf.find_rows("k", [boundary_key, kl[100]], columns=["v"])
+    for h in res:
+        _assert_hit_v_only(h, kl, vl)
+    pf.close()
+
+
+def _assert_hit_v_only(h, kl, vl):
+    rows = [i for i, x in enumerate(kl) if x is not None and x == h.key]
+    np.testing.assert_array_equal(h.rows, np.array(rows, np.int64))
+    np.testing.assert_array_equal(h.values["v"], np.array([vl[i]
+                                                           for i in rows]))
+
+
+def test_string_keys(tmp_path):
+    path, kl, vl, sl = _corpus(tmp_path)
+    pf = ParquetFile(path)
+    res = pf.find_rows("s", ["pay_0100", "pay_9999"], columns=["k"])
+    want = [i for i, x in enumerate(sl) if x == "pay_0100"]
+    np.testing.assert_array_equal(res[0].rows, np.array(want, np.int64))
+    np.testing.assert_array_equal(res[0].values["k"],
+                                  np.array([kl[i] for i in want], np.int64))
+    assert res[1].num_rows == 0
+    pf.close()
+
+
+def test_nested_and_unknown_columns_raise(tmp_path):
+    path, *_ = _corpus(tmp_path)
+    pf = ParquetFile(path)
+    with pytest.raises(KeyError):
+        pf.find_rows("nope", [1])
+    with pytest.raises(KeyError):
+        pf.find_rows("k", [1], columns=["nope"])
+    pf.close()
+
+
+def test_in_memory_source_works_without_cache(tmp_path):
+    """BytesSource-backed files (no stat identity) still answer lookups —
+    they just never populate the page cache."""
+    path, kl, vl, sl = _corpus(tmp_path)
+    with open(path, "rb") as f:
+        raw = f.read()
+    pf = ParquetFile(raw)
+    key = next(x for x in kl if x is not None)
+    res = pf.find_rows("k", [key], columns=["v", "s"])
+    _assert_hit(res[0], kl, vl, sl)
+    assert cache_stats().page_entries == 0
+
+
+# ---------------------------------------------------------------------------
+# coalescing: a pread-count spy proves adjacent keys share ranged reads
+# ---------------------------------------------------------------------------
+
+
+def _pread_spy(monkeypatch):
+    calls = []
+    orig_p = MmapSource.pread
+    orig_v = MmapSource.pread_view
+
+    def spy_p(self, off, size):
+        calls.append((off, size))
+        return orig_p(self, off, size)
+
+    def spy_v(self, off, size):
+        calls.append((off, size))
+        return orig_v(self, off, size)
+
+    monkeypatch.setattr(MmapSource, "pread", spy_p)
+    monkeypatch.setattr(MmapSource, "pread_view", spy_v)
+    return calls
+
+
+def test_coalescing_adjacent_pages_one_pread(tmp_path, monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_PAGE_CACHE", "0")  # isolate coalescing
+    path, kl, vl, sl = _corpus(tmp_path, sorted_keys=True)
+    pf = ParquetFile(path)
+    keys = sorted({x for x in kl[2000:2400]})  # a run of adjacent pages
+    calls = _pread_spy(monkeypatch)
+    res = pf.find_rows("k", keys, columns=["v"])
+    batched = len(calls)
+    assert res.counters["pages_coalesced"] > 0
+    # naive: one find_rows per key — each pays its own preads
+    calls.clear()
+    naive_hits = []
+    for key in keys:
+        naive_hits.append(pf.find_rows("k", [key], columns=["v"])[0])
+    naive = len(calls)
+    assert batched * 2 <= naive, (batched, naive)
+    # byte-identical results
+    for h, nh in zip(res, naive_hits):
+        np.testing.assert_array_equal(h.rows, nh.rows)
+        np.testing.assert_array_equal(h.values["v"], nh.values["v"])
+    pf.close()
+
+
+# ---------------------------------------------------------------------------
+# page cache: hits, evictions, frozen entries
+# ---------------------------------------------------------------------------
+
+
+def test_warm_repeat_no_source_reads(tmp_path, monkeypatch):
+    path, kl, vl, sl = _corpus(tmp_path, sorted_keys=True)
+    pf = ParquetFile(path)
+    keys = [kl[10], kl[5000], kl[20000]]
+    res1 = pf.find_rows("k", keys, columns=["v", "s"])
+    calls = _pread_spy(monkeypatch)
+    res2 = pf.find_rows("k", keys, columns=["v", "s"])
+    assert calls == [], "warm lookup must not touch the source"
+    assert res2.counters["page_cache_hits"] > 0
+    assert res2.counters["preads"] == 0
+    st = cache_stats()
+    assert st.page_hits > 0 and st.page_entries > 0
+    for h1, h2 in zip(res1, res2):
+        np.testing.assert_array_equal(h1.rows, h2.rows)
+        assert h1.values["s"] == h2.values["s"]
+    pf.close()
+
+
+def test_page_cache_eviction_holds_cap(tmp_path, monkeypatch):
+    cap = 64 * 1024
+    monkeypatch.setenv("PARQUET_TPU_PAGE_CACHE", str(cap))
+    path, kl, *_ = _corpus(tmp_path, sorted_keys=True)
+    pf = ParquetFile(path)
+    keys = sorted({x for x in kl if x is not None})[::7]
+    pf.find_rows("k", keys, columns=["v", "s"])
+    st = cache_stats()
+    assert st.page_bytes <= cap
+    assert st.page_evictions > 0
+    pf.close()
+
+
+def test_page_cache_oversized_refused(tmp_path, monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_PAGE_CACHE", "64")  # < any page
+    path, kl, vl, sl = _corpus(tmp_path)
+    pf = ParquetFile(path)
+    key = next(x for x in kl if x is not None)
+    res = pf.find_rows("k", [key], columns=["v"])
+    _assert_hit_v_only(res[0], kl, vl)
+    assert cache_stats().page_entries == 0  # refused, still correct
+    pf.close()
+
+
+def test_frozen_entry_mutation_raises(tmp_path):
+    path, kl, *_ = _corpus(tmp_path)
+    pf = ParquetFile(path)
+    pf.find_rows("k", [next(x for x in kl if x is not None)],
+                 columns=["v"])
+    assert len(PAGES._entries) > 0
+    entry = next(iter(PAGES._entries.values()))[0]
+    vals = entry.values
+    if isinstance(vals, np.ndarray):
+        with pytest.raises(ValueError):
+            vals[0] = 0
+    if entry.validity is not None:
+        with pytest.raises(ValueError):
+            entry.validity[0] = False
+    import dataclasses
+
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        entry.values = None
+    pf.close()
+
+
+# ---------------------------------------------------------------------------
+# lookup × faults
+# ---------------------------------------------------------------------------
+
+
+def test_lookup_retries_accounted(tmp_path):
+    path, kl, vl, sl = _corpus(tmp_path)
+    with open(path, "rb") as f:
+        raw = f.read()
+    inj = FaultInjectingSource(BytesSource(raw), seed=3, error_rate=0.05,
+                               max_consecutive_errors=2)
+    pol = FaultPolicy(max_retries=5, backoff_s=0.0)
+    pf = ParquetFile(inj, policy=pol)
+    rep = ReadReport()
+    key = next(x for x in kl if x is not None)
+    res = pf.find_rows("k", [key], columns=["v", "s"], report=rep)
+    _assert_hit(res[0], kl, vl, sl)
+    assert rep.retries > 0
+    assert res.report is rep
+
+
+def test_lookup_corrupt_rg_skips_with_report(tmp_path):
+    path, kl, vl, sl = _corpus(tmp_path, sorted_keys=True)
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    pf0 = ParquetFile(bytes(raw))
+    # flip a byte every ~500 across rg1's whole key chunk: every page of
+    # it (headers or CRC'd payloads) reads corrupt, wherever the key lands
+    chunk1 = pf0.row_group(1).column("k")
+    start, size = chunk1.byte_range
+    flip = list(range(start, start + size, 503))
+    pf0.close()
+    inj = FaultInjectingSource(BytesSource(bytes(raw)), seed=0,
+                               flip_offsets=flip)
+    pol = FaultPolicy(on_corrupt="skip_row_group")
+    pf = ParquetFile(inj, policy=pol,
+                     options=ReadOptions(verify_crc=True))
+    per_rg = N // RGS
+    # one key per row group (sorted corpus: key k lives at rows 3k..3k+2)
+    keys = [kl[per_rg // 2], kl[per_rg + per_rg // 2],
+            kl[3 * per_rg + per_rg // 2]]
+    rep = ReadReport()
+    res = pf.find_rows("k", keys, columns=["v"], report=rep)
+    assert 1 in rep.row_groups_skipped
+    assert rep.rows_dropped >= per_rg
+    # rg0 and rg3 hits intact; the rg1 key dropped atomically (no rows)
+    _assert_hit_v_only(res[0], kl, vl)
+    _assert_hit_v_only(res[2], kl, vl)
+    assert res[1].num_rows == 0
+    # without the skip policy the same corruption raises
+    pf2 = ParquetFile(FaultInjectingSource(BytesSource(bytes(raw)), seed=0,
+                                           flip_offsets=flip),
+                      options=ReadOptions(verify_crc=True))
+    with pytest.raises(CorruptedError):
+        pf2.find_rows("k", keys, policy=FaultPolicy(max_retries=0))
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_fifo_order(monkeypatch):
+    monkeypatch.setenv("PQ_TEST_BUDGET", "1000")
+    ctl = AdmissionController(env_var="PQ_TEST_BUDGET")
+    g1 = ctl.acquire(800)
+    order = []
+    ev_b_queued = threading.Event()
+
+    def second():
+        ev_b_queued.set()
+        with ctl.admit(700):
+            order.append("b")
+
+    def third():
+        ev_b_queued.wait()
+        # give B time to enqueue first (FIFO position matters)
+        import time
+
+        time.sleep(0.05)
+        with ctl.admit(50):
+            order.append("c")
+
+    tb = threading.Thread(target=second)
+    tc = threading.Thread(target=third)
+    tb.start()
+    tc.start()
+    import time
+
+    time.sleep(0.2)
+    # C fits in the remaining budget but must NOT leapfrog B (FIFO)
+    assert order == []
+    ctl.release(g1)
+    tb.join(5)
+    tc.join(5)
+    assert order == ["b", "c"]
+    assert ctl.waits >= 1
+    assert ctl.high_water <= 1000
+
+
+def test_admission_oversized_clamps_and_admits_alone(monkeypatch):
+    monkeypatch.setenv("PQ_TEST_BUDGET", "100")
+    ctl = AdmissionController(env_var="PQ_TEST_BUDGET")
+    with ctl.admit(10_000) as g:
+        assert g == 100  # clamped to the whole budget, admits alone
+    assert ctl.high_water == 100
+
+
+def test_admission_disabled_no_blocking(monkeypatch):
+    monkeypatch.setenv("PQ_TEST_BUDGET", "0")
+    ctl = AdmissionController(env_var="PQ_TEST_BUDGET")
+    with ctl.admit(1 << 40) as g:
+        assert g == 0
+
+
+def test_admission_budget_held_under_hammer(monkeypatch):
+    budget = 10_000
+    monkeypatch.setenv("PQ_TEST_BUDGET", str(budget))
+    ctl = AdmissionController(env_var="PQ_TEST_BUDGET")
+    r = np.random.default_rng(0)
+    sizes = r.integers(1, 4000, 200)
+
+    def worker(sz):
+        with ctl.admit(int(sz)):
+            pass
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in sizes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert ctl.high_water <= budget
+
+
+def test_scan_and_thousand_lookups_share_pool(tmp_path, monkeypatch):
+    """The starvation test: one scan + 1k concurrent lookups on a small
+    bytes budget — both finish, the budget is never exceeded."""
+    monkeypatch.setenv("PARQUET_TPU_LOOKUP_BUDGET", str(256 * 1024))
+    path, kl, vl, sl = _corpus(tmp_path, sorted_keys=True)
+    ds = Dataset([path])
+    ctl = lookup_admission()
+    ctl._reset()
+    keys_pool = [x for x in kl if x is not None]
+    errors = []
+    done = []
+
+    def scan_side():
+        try:
+            got = ds.scan(where=pq.col("k") >= 0, columns=["v"])
+            done.append(len(got["v"]))
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    def lookup_side(seed):
+        try:
+            r = np.random.default_rng(seed)
+            pf = ds.file(0)
+            for _ in range(125):  # 8 threads × 125 = 1000 lookups
+                key = int(keys_pool[int(r.integers(0, len(keys_pool)))])
+                res = find_rows(pf, "k", [key])
+                assert res[0].num_rows > 0
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    threads = [threading.Thread(target=scan_side)]
+    threads += [threading.Thread(target=lookup_side, args=(i,))
+                for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    assert done and done[0] == sum(1 for x in kl if x is not None)
+    assert ctl.high_water <= 256 * 1024
+    ds.close()
+
+
+# ---------------------------------------------------------------------------
+# OpReport exactness for concurrent batched lookups
+# ---------------------------------------------------------------------------
+
+
+def test_opreport_exact_for_concurrent_lookups(tmp_path):
+    from parquet_tpu.obs import metrics_delta, metrics_snapshot, op_scope
+
+    pa_, kl_a, *_ = _corpus(tmp_path, name="a.parquet", seed=11)
+    pb_, kl_b, *_ = _corpus(tmp_path, name="b.parquet", seed=22)
+    pfa, pfb = ParquetFile(pa_), ParquetFile(pb_)
+    keys_a = sorted({x for x in kl_a if x is not None})[:64]
+    keys_b = sorted({x for x in kl_b if x is not None})[:64]
+    reports = {}
+    before = metrics_snapshot()
+
+    def one(tag, pf, keys):
+        with op_scope(f"serve.{tag}") as s:
+            find_rows(pf, "k", keys, columns=["v"])
+        reports[tag] = s.report()
+
+    ta = threading.Thread(target=one, args=("a", pfa, keys_a))
+    tb = threading.Thread(target=one, args=("b", pfb, keys_b))
+    ta.start()
+    tb.start()
+    ta.join(60)
+    tb.join(60)
+    after = metrics_snapshot()
+    delta = metrics_delta(before, after)["counters"]
+    for key in ("lookup.keys", "lookup.preads", "lookup.pages_read",
+                "lookup.rows_matched"):
+        per_op = sum(r["counters"].get(key, 0)
+                     for r in reports.values())
+        assert per_op == delta.get(key, 0), (key, per_op, delta.get(key))
+    assert reports["a"]["counters"]["lookup.keys"] == len(keys_a)
+    pfa.close()
+    pfb.close()
+
+
+# ---------------------------------------------------------------------------
+# Dataset.find_rows: global ordinals, per-dataset prep, skip-a-bad-file
+# ---------------------------------------------------------------------------
+
+
+def test_dataset_find_rows_global_rows(tmp_path):
+    paths, kls, vls = [], [], []
+    for i in range(3):
+        p, kl, vl, sl = _corpus(tmp_path, name=f"p{i}.parquet", n=6000,
+                                seed=i)
+        paths.append(p)
+        kls.append(kl)
+        vls.append(vl)
+    ds = Dataset(paths)
+    offs = ds.row_offsets()
+    all_k = [x for kl in kls for x in kl]
+    all_v = [x for vl in vls for x in vl]
+    keys = [kls[0][5], kls[1][7], kls[2][9], 10**9]
+    res = ds.find_rows("k", keys, columns=["v"])
+    for h in res:
+        if h.key == 10**9:
+            assert h.num_rows == 0
+            continue
+        want = [i for i, x in enumerate(all_k)
+                if x is not None and x == h.key]
+        np.testing.assert_array_equal(h.rows, np.array(want, np.int64))
+        np.testing.assert_array_equal(
+            h.values["v"], np.array([all_v[i] for i in want]))
+    assert int(offs[-1]) == len(all_k)
+    ds.close()
+
+
+def test_dataset_find_rows_skips_bad_file(tmp_path):
+    paths = []
+    kls, vls = [], []
+    for i in range(3):
+        p, kl, vl, sl = _corpus(tmp_path, name=f"q{i}.parquet", n=6000,
+                                seed=10 + i)
+        paths.append(p)
+        kls.append(kl)
+        vls.append(vl)
+    # truncate the middle file's footer
+    with open(paths[1], "r+b") as f:
+        f.truncate(100)
+    rep = ReadReport()
+    ds = Dataset(paths, policy=FaultPolicy(on_corrupt="skip_row_group"))
+    keys = [kls[0][3], kls[2][4]]
+    res = ds.find_rows("k", keys, report=rep)
+    assert paths[1] in rep.files_skipped
+    # the skipped file contributes no rows; file 2's ordinals base at 6000
+    for h, key in zip(res, keys):
+        want = [i for i, x in enumerate(kls[0])
+                if x is not None and x == key]
+        want += [6000 + i for i, x in enumerate(kls[2])
+                 if x is not None and x == key]
+        np.testing.assert_array_equal(h.rows, np.array(want, np.int64))
+        assert h.num_rows > 0
+    ds.close()
+
+
+def test_dataset_find_rows_all_failed_raises(tmp_path):
+    p = str(tmp_path / "dead.parquet")
+    with open(p, "wb") as f:
+        f.write(b"not parquet")
+    ds = Dataset([p], policy=FaultPolicy(on_corrupt="skip_row_group"))
+    with pytest.raises(CorruptedError):
+        ds.find_rows("k", [1])
+
+
+# ---------------------------------------------------------------------------
+# satellites riding along: find() bound memoization
+# ---------------------------------------------------------------------------
+
+
+def test_find_memoizes_decoded_bounds(tmp_path, monkeypatch):
+    import parquet_tpu.io.search as search
+
+    path, *_ = _corpus(tmp_path, sorted_keys=True)
+    pf = ParquetFile(path)
+    chunk = pf.row_group(0).column("k")
+    ci = chunk.column_index()
+    leaf = pf.schema.leaf("k")
+    calls = []
+    orig = search.decode_stat_value
+    monkeypatch.setattr(search, "decode_stat_value",
+                        lambda raw, lf: calls.append(1) or orig(raw, lf))
+    p1 = search.find(ci, 100, leaf)
+    first = len(calls)
+    assert first > 0  # decoded once
+    for _ in range(100):
+        assert search.find(ci, 100, leaf) == p1
+        search.pages_overlapping(ci, leaf, lo=5, hi=10)
+    assert len(calls) == first  # never re-decoded
+    pf.close()
+
+
+def test_bloom_filter_memoized_on_chunk(tmp_path, monkeypatch):
+    path, kl, *_ = _corpus(tmp_path)
+    pf = ParquetFile(path)
+    chunk = pf.row_group(0).column("k")
+    import parquet_tpu.io.bloom as bloom
+
+    calls = []
+    orig = bloom.read_bloom_filter
+    monkeypatch.setattr(bloom, "read_bloom_filter",
+                        lambda r: calls.append(1) or orig(r))
+    bf1 = chunk.bloom_filter()
+    bf2 = chunk.bloom_filter()
+    assert bf1 is bf2 and len(calls) == 1
+    pf.close()
+
+
+def test_dataset_find_rows_empty_shard_raises(tmp_path):
+    p, *_ = _corpus(tmp_path, n=6000)
+    ds = Dataset([p]).shard(1, 2)  # count > files: an empty shard
+    assert ds.num_files == 0
+    with pytest.raises(ValueError):
+        ds.find_rows("k", [1])
+
+
+def test_null_pages_interleaved_under_ordered_boundary(tmp_path):
+    """Regression: null-only pages interleaved in an ASCENDING ColumnIndex
+    break find()'s bisection invariant (parquet orders boundaries over
+    NON-NULL pages only) — the lookup must fall back to the exact zone-map
+    walk and return every matching row, not just the run past the nulls."""
+    k = [5] * 1000 + [None] * 1000 + [5] * 500 + [6] * 500
+    v = list(range(len(k)))
+    t = pa.table({"k": pa.array(k, type=pa.int64()),
+                  "v": pa.array(v, type=pa.int64())})
+    path = str(tmp_path / "nullpages.parquet")
+    write_table(t, path, WriterOptions(data_page_size=2048,
+                                       dictionary=False))
+    pf = ParquetFile(path)
+    ci = pf.row_group(0).column("k").column_index()
+    assert any(ci.null_pages or []), "corpus must interleave null pages"
+    res = pf.find_rows("k", [5, 6], columns=["v"])
+    want5 = [i for i, x in enumerate(k) if x == 5]
+    np.testing.assert_array_equal(res[0].rows, np.array(want5, np.int64))
+    np.testing.assert_array_equal(res[0].values["v"],
+                                  np.array(want5, np.int64))
+    np.testing.assert_array_equal(
+        res[1].rows, np.arange(2500, 3000, dtype=np.int64))
+    pf.close()
+
+
+def test_dataset_keys_counter_counts_batch_once(tmp_path):
+    from parquet_tpu.obs import metrics_delta, metrics_snapshot
+
+    paths = [
+        _corpus(tmp_path, name=f"c{i}.parquet", n=6000, seed=i)[0]
+        for i in range(3)]
+    ds = Dataset(paths)
+    before = metrics_snapshot()
+    res = ds.find_rows("k", [1, 2, 3, 4])
+    after = metrics_snapshot()
+    d = metrics_delta(before, after)["counters"]
+    assert res.counters["keys"] == 4
+    assert d.get("lookup.keys", 0) == 4  # once per batch, not per file
+    ds.close()

@@ -569,3 +569,112 @@ def test_device_pipeline_routes_through_chunk_prefetcher(monkeypatch,
         np.testing.assert_array_equal(g, w)
     # the override was popped: the file reads normally afterwards
     assert pf_path.read().to_arrow().equals(pf_mem.read().to_arrow())
+
+
+# ---------------------------------------------------------------------------
+# MmapSource drop-behind (PARQUET_TPU_MMAP_DROPBEHIND, ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _mmap_file(tmp_path, nbytes=256 * 1024):
+    p = tmp_path / "drop.bin"
+    data = np.arange(nbytes, dtype=np.uint8).tobytes()
+    p.write_bytes(data)
+    return str(p), data
+
+
+def test_madvise_dontneed_rounds_inward(tmp_path):
+    from parquet_tpu.io.source import MmapSource
+    import mmap as _mmap
+
+    path, data = _mmap_file(tmp_path)
+    src = MmapSource(path)
+    page = _mmap.PAGESIZE
+    # sub-page span: nothing fully covered, nothing dropped
+    assert src.madvise_dontneed(10, page // 2) == 0
+    # page-spanning span drops only the fully-covered pages
+    dropped = src.madvise_dontneed(1, 3 * page)
+    assert 0 < dropped <= 3 * page and dropped % page == 0
+    # data stays readable after a drop (kernel refaults from disk)
+    assert src.pread(0, 64) == data[:64]
+    src.close()
+
+
+def test_madvise_sequential_best_effort(tmp_path):
+    from parquet_tpu.io.source import MmapSource
+
+    path, data = _mmap_file(tmp_path)
+    src = MmapSource(path)
+    src.madvise_sequential()  # must never raise
+    assert src.pread(100, 16) == data[100:116]
+    src.close()
+    src.madvise_sequential()  # closed: silent no-op
+    assert src.madvise_dontneed(0, 1 << 20) == 0
+
+
+def test_dropbehind_env_gates(tmp_path, monkeypatch):
+    from parquet_tpu.io.prefetch import PrefetchSource
+    from parquet_tpu.io.source import MmapSource, dropbehind_enabled
+
+    monkeypatch.delenv("PARQUET_TPU_MMAP_DROPBEHIND", raising=False)
+    assert not dropbehind_enabled()
+    path, data = _mmap_file(tmp_path)
+    src = MmapSource(path)
+    pre = PrefetchSource(src, backend="advise")
+    pre.plan(0, len(data))
+    pre.pread(0, 4096)
+    pre.close()
+    assert pre.stats.bytes_dropbehind == 0  # off by default
+    src.close()
+
+
+def test_dropbehind_drain_identical_and_metered(tmp_path, monkeypatch):
+    """A streamed drain with drop-behind on yields byte-identical data and
+    meters the released span (MADV_SEQUENTIAL + post-drain DONTNEED)."""
+    from parquet_tpu import WriterOptions, write_table
+
+    monkeypatch.setenv("PARQUET_TPU_MMAP_DROPBEHIND", "1")
+    n = 120_000
+    t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64)),
+                  "b": pa.array(np.arange(n, dtype=np.float64))})
+    p = tmp_path / "drain.parquet"
+    write_table(t, str(p), WriterOptions(row_group_size=n // 4))
+    pf = ParquetFile(str(p))
+    last = None
+    parts = []
+    for b in pf.iter_batches(batch_rows=10_000):
+        parts.append(np.asarray(b["a"].values))
+        last = b
+    np.testing.assert_array_equal(np.concatenate(parts), np.arange(n))
+    rs = last.read_stats
+    assert rs.backend == "advise"
+    assert rs.bytes_dropbehind > 0
+    d = rs.as_dict()
+    assert d["bytes_dropbehind"] == rs.bytes_dropbehind
+    pf.close()
+
+
+def test_dropbehind_advance_drops_behind_frontier(tmp_path, monkeypatch):
+    from parquet_tpu.io.prefetch import PrefetchSource
+    from parquet_tpu.io.source import MmapSource
+
+    monkeypatch.setenv("PARQUET_TPU_MMAP_DROPBEHIND", "1")
+    path, data = _mmap_file(tmp_path, nbytes=1 << 20)
+    src = MmapSource(path)
+    pre = PrefetchSource(src, backend="advise", window_bytes=64 * 1024)
+    pre.plan(0, len(data))
+    got = pre.pread(0, 256 * 1024)
+    assert got == data[: 256 * 1024]
+    # the drop TRAILS the in-flight read: the first read's own span must
+    # not drop until a later read moves the frontier past it (the caller
+    # holds a zero-copy view it has not decoded yet)
+    assert pre.stats.bytes_dropbehind == 0
+    got2 = pre.pread(256 * 1024, 256 * 1024)
+    assert got2 == data[256 * 1024: 512 * 1024]
+    assert pre.stats.bytes_dropbehind > 0  # first span dropped mid-drain
+    mid = pre.stats.bytes_dropbehind
+    pre.close()
+    assert pre.stats.bytes_dropbehind >= mid  # post-drain full-span drop
+    # re-reads after the drop still serve correct bytes
+    assert src.pread(4096, 64) == data[4096:4160]
+    src.close()
