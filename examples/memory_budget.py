@@ -1,0 +1,134 @@
+"""Memory accounting & backpressure (ISSUE 10): the resource ledger,
+the unified read budget, pressure watermarks, and /debugz.
+
+A serving process holds bytes in many tiers at once — the decoded-chunk
+LRU, the page cache, parsed footers, readahead buffers, write buffers,
+admitted read spans.  This example shows the one balance sheet over all
+of them:
+
+1. the **ledger** — every tier's resident/capacity/high-water bytes from
+   ``ledger_snapshot()`` (also ``ledger.*`` gauges in ``stats --prom``);
+2. the **unified read budget** — ``PARQUET_TPU_READ_BUDGET`` bounds the
+   in-flight bytes of scans AND lookups through one FIFO gate, results
+   byte-identical to the unbudgeted run;
+3. **pressure watermarks** — crossing ``PARQUET_TPU_MEM_SOFT`` shrinks
+   the LRU tiers (metered evictions); ``PARQUET_TPU_MEM_HARD``
+   additionally blocks new admissions until memory drops;
+4. **/debugz** — live per-tier residency, top cache entries by bytes,
+   admission gate state, and the open-op table over HTTP (also
+   ``python -m parquet_tpu stats --debugz``).
+
+Run: python examples/memory_budget.py [rows]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from parquet_tpu import (ParquetFile, WriterOptions, find_rows,
+                         ledger_snapshot, start_metrics_server, write_table)
+from parquet_tpu.obs.ledger import LEDGER
+from parquet_tpu.obs.metrics import REGISTRY
+
+
+def _fmt(n):
+    return "-" if n is None else f"{n / 1024:.0f}K"
+
+
+def main() -> None:
+    import pyarrow as pa
+
+    import parquet_tpu as pq
+
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 120_000
+    rng = np.random.default_rng(0)
+    d = tempfile.mkdtemp(prefix="parquet_tpu_memory_")
+    path = os.path.join(d, "serve.parquet")
+    t = pa.table({
+        "k": pa.array(np.arange(rows, dtype=np.int64) // 3),
+        "v": pa.array(rng.random(rows)),
+    })
+    write_table(t, path, WriterOptions(row_group_size=max(rows // 4, 1),
+                                       data_page_size=8 * 1024,
+                                       bloom_filters={"k": 10}))
+    try:
+        _run(path, rows, pq)
+    finally:
+        # the test suite runs this in-process (runpy): the knobs must not
+        # leak into later tests even if a step above raises
+        for k in ("PARQUET_TPU_READ_BUDGET", "PARQUET_TPU_MEM_SOFT",
+                  "PARQUET_TPU_MEM_HARD"):
+            os.environ.pop(k, None)
+
+
+def _run(path, rows, pq) -> None:
+    pf = ParquetFile(path)
+
+    # ---- 1. populate the tiers and read the balance sheet
+    pf.read()  # chunk LRU + footer cache
+    keys = [int(x) for x in np.random.default_rng(1).integers(
+        0, rows // 3, 32)]
+    find_rows(pf, "k", keys, columns=["v"])  # page cache
+    snap = ledger_snapshot()
+    print("resource ledger (resident/capacity/high-water):")
+    for name, a in sorted(snap["accounts"].items()):
+        if a["resident_bytes"] or a["high_water_bytes"]:
+            print(f"  {name:<20} {_fmt(a['resident_bytes']):>8} "
+                  f"/ {_fmt(a['capacity_bytes']):>8} "
+                  f"/ {_fmt(a['high_water_bytes']):>8}")
+    print(f"  total: {_fmt(snap['total_bytes'])}  state: {snap['state']}")
+
+    # ---- 2. the unified read budget: scan + lookups through one gate
+    want = pf.read().to_arrow()
+    os.environ["PARQUET_TPU_READ_BUDGET"] = str(256 * 1024)
+    pq.clear_caches()
+    from parquet_tpu.utils.pool import read_admission
+
+    adm = read_admission()
+    adm._reset()
+    got = pf.read().to_arrow()
+    res = find_rows(pf, "k", keys)
+    assert got.equals(want), "budgeted read must be byte-identical"
+    print(f"\nread budget 256K: whole-file re-read + {len(keys)} lookups "
+          f"held <= {_fmt(adm.high_water)} in flight "
+          f"(waits: {adm.waits}), results identical")
+    assert res.rows_total > 0
+    os.environ.pop("PARQUET_TPU_READ_BUDGET")
+
+    # ---- 3. soft pressure: the LRU tiers shrink to fit
+    pf.read()  # re-warm the chunk LRU
+    resident = LEDGER.total()
+    os.environ["PARQUET_TPU_MEM_SOFT"] = str(max(resident // 4, 1))
+    ev0 = REGISTRY.counter("ledger.pressure_evictions").value
+    state = LEDGER.check_pressure()
+    ev = REGISTRY.counter("ledger.pressure_evictions").value - ev0
+    print(f"\nsoft watermark at 1/4 of {_fmt(resident)}: state={state}, "
+          f"{ev} entries evicted, total now {_fmt(LEDGER.total())}")
+    os.environ.pop("PARQUET_TPU_MEM_SOFT")
+
+    # ---- 4. /debugz: live residency over HTTP
+    with start_metrics_server(0) as srv:
+        base = f"http://{srv.host}:{srv.port}"
+        doc = json.loads(urllib.request.urlopen(base + "/debugz",
+                                                timeout=5).read())
+        health = urllib.request.urlopen(base + "/healthz",
+                                        timeout=5).read().decode().strip()
+        top = doc["caches"]["chunk"]["top"][:1]
+        print(f"\n/debugz (also: stats --debugz): state={health}, "
+              f"{len(doc['ledger']['accounts'])} accounts, "
+              f"pool width {doc['pool']['width']}, "
+              f"admission in flight {doc['admission']['in_flight_bytes']}")
+        if top:
+            print(f"  biggest cached chunk: {top[0]['bytes']} bytes "
+                  f"of {os.path.basename(top[0]['key'][0])}")
+    pf.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,11 @@ Observability
   sampling via ``PARQUET_TPU_TRACE_SAMPLE``, slow-op capture via
   ``PARQUET_TPU_SLOW_OP_S``/``PARQUET_TPU_SLOW_LOG``),
   start_metrics_server + ``python -m parquet_tpu stats --serve PORT``
-  (live /metrics + /metrics.json scrape endpoint)
+  (live /metrics + /metrics.json scrape endpoint),
+  ledger_snapshot/debugz_snapshot (process-wide resource ledger over
+  every buffer tier, ``PARQUET_TPU_READ_BUDGET`` unified read gate,
+  ``PARQUET_TPU_MEM_SOFT``/``HARD`` pressure watermarks, live /debugz +
+  ``stats --debugz`` introspection)
 """
 
 from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
@@ -121,8 +125,9 @@ from .rows import (Row, RowBuilder, Value, copy_rows, deconstruct, read_rows,
 from .utils.printer import print_file, print_pages, print_schema
 from .utils.debug import counters
 from . import obs
-from .obs import (OpScope, current_op, disable_tracing, enable_tracing,
-                  flush_trace, metrics_delta, metrics_snapshot, op_scope,
+from .obs import (OpScope, current_op, debugz_snapshot, disable_tracing,
+                  enable_tracing, flush_trace, ledger_snapshot,
+                  metrics_delta, metrics_snapshot, op_scope,
                   pool_wait_seconds, render_prometheus, reset_metrics,
                   start_metrics_server, trace_span)
 

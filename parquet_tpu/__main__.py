@@ -52,6 +52,10 @@ def main(argv=None) -> int:
                         "stats: emit the metrics_snapshot() dict as JSON")
     p.add_argument("--prom", action="store_true",
                    help="stats: emit Prometheus exposition text format")
+    p.add_argument("--debugz", action="store_true",
+                   help="stats: emit the live /debugz introspection JSON "
+                        "(resource-ledger accounts, per-cache top entries, "
+                        "admission gate, pool, open-op table)")
     p.add_argument("--serve", type=int, metavar="PORT", default=None,
                    help="stats: serve the registry over HTTP instead of "
                         "dumping once — /metrics (Prometheus 0.0.4) and "
@@ -108,7 +112,11 @@ def main(argv=None) -> int:
             except KeyboardInterrupt:
                 srv.close()
             return 0
-        if args.prom:
+        if args.debugz:
+            from .obs import debugz_snapshot
+
+            print(json.dumps(debugz_snapshot(), sort_keys=True))
+        elif args.prom:
             sys.stdout.write(render_prometheus())
         elif args.json:
             print(json.dumps(metrics_snapshot(), sort_keys=True))

@@ -32,11 +32,22 @@ old bytes with the new identity:
   views that own their bytes; BYTE_ARRAY spans are immutable tuples of
   ``bytes``), eviction global and size-aware.
 
+- :class:`NegLookupCache` — the negative side of the lookup path: per-chunk
+  sets of keys the probe cascade conclusively proved ABSENT, so a repeated
+  miss skips even the stats and bloom probes (``PARQUET_TPU_NEG_LOOKUP``
+  bytes, default 4 MiB, ``0`` = off; ``lookup.neg_hits``).
+
 Only plain path-backed opens (``FileSource``/``MmapSource``, optionally under
 a ``PolicySource``) are cached — wrapped sources (fault injectors, arbitrary
 ``Source`` subclasses) may transform bytes and get no entries.  Hit/miss/
 eviction counters surface through :class:`CacheStats` (``cache_stats()``),
 the cache-side mirror of :class:`~parquet_tpu.io.prefetch.ReadStats`.
+
+Every tier keeps a resource-ledger account (obs/ledger.py) current inside
+the same critical sections that move its bytes — ``ledger.*`` gauges answer
+"where is the memory" without importing this module — and registers a
+soft-pressure reclaimer: when the process crosses ``PARQUET_TPU_MEM_SOFT``
+the LRU tiers shrink evict-to-fraction until the total fits again.
 """
 
 from __future__ import annotations
@@ -50,18 +61,22 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from ..obs.ledger import (LEDGER, ledger_account,
+                          maybe_check_pressure as _maybe_pressure)
 from ..obs.metrics import counter as _counter
 from ..obs.metrics import gauge as _gauge
 from ..obs.scope import account as _account
 
 __all__ = ["CacheStats", "FooterCache", "ChunkCache", "PageCache",
-           "PageEntry", "cache_stats", "clear_caches", "chunk_cache_bytes",
-           "footer_cache_entries", "page_cache_bytes", "column_nbytes",
-           "freeze_column", "invalidate_path", "FOOTERS", "CHUNKS", "PAGES"]
+           "NegLookupCache", "PageEntry", "cache_stats", "clear_caches",
+           "chunk_cache_bytes", "footer_cache_entries", "page_cache_bytes",
+           "neg_lookup_cache_bytes", "column_nbytes", "freeze_column",
+           "invalidate_path", "FOOTERS", "CHUNKS", "PAGES", "NEGS"]
 
 DEFAULT_CHUNK_CACHE_BYTES = 256 << 20
 DEFAULT_FOOTER_CACHE_ENTRIES = 256
 DEFAULT_PAGE_CACHE_BYTES = 64 << 20
+DEFAULT_NEG_LOOKUP_BYTES = 4 << 20
 
 # registry mirrors (parquet_tpu/obs): CacheStats stays the per-process
 # dataclass VIEW (its API is unchanged and clear_caches(reset_stats=True)
@@ -115,6 +130,34 @@ def page_cache_bytes() -> int:
     """Decoded-page cache capacity: ``PARQUET_TPU_PAGE_CACHE`` (bytes;
     ``0`` disables) or the 64 MiB default."""
     return _env_size("PARQUET_TPU_PAGE_CACHE", DEFAULT_PAGE_CACHE_BYTES)
+
+
+def neg_lookup_cache_bytes() -> int:
+    """Negative-lookup memo capacity: ``PARQUET_TPU_NEG_LOOKUP`` (bytes;
+    ``0`` disables) or the 4 MiB default — a small tier: it holds keys,
+    not pages."""
+    return _env_size("PARQUET_TPU_NEG_LOOKUP", DEFAULT_NEG_LOOKUP_BYTES)
+
+
+def _top_entries(items, n: int) -> list:
+    """Largest ``(key, nbytes)`` pairs rendered for ``/debugz`` — the one
+    formatter every tier's ``top_entries`` shares (callers snapshot the
+    pairs under their own lock; sorting happens outside it)."""
+    items.sort(key=lambda kv: kv[1], reverse=True)
+    return [{"key": [str(p) for p in k], "bytes": nb}
+            for k, nb in items[:n]]
+
+
+# resource-ledger accounts (obs/ledger.py): updated INSIDE the same
+# critical sections that move each cache's own byte counters, so the
+# ledger can never drift from the tier — the hammer test asserts exact
+# equality under concurrent churn.  Capacities attach here so /debugz
+# and the capacity gauges track the live env knobs.
+_ACC_CHUNK = ledger_account("cache.chunk", capacity=chunk_cache_bytes)
+_ACC_PAGE = ledger_account("cache.page", capacity=page_cache_bytes)
+_ACC_FOOTER = ledger_account("cache.footer")
+_ACC_NEG = ledger_account("cache.neg_lookup",
+                          capacity=neg_lookup_cache_bytes)
 
 
 @dataclass
@@ -190,7 +233,11 @@ class FooterCache:
 
     def __init__(self, stats: CacheStats):
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, Any]" = OrderedDict()
+        # key → (value, nbytes): nbytes is the serialized footer length
+        # at parse time — the honest proxy for what the parsed structures
+        # pin (thrift expands, but proportionally)
+        self._entries: "OrderedDict[tuple, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
         self.stats = stats
 
     def get(self, key) -> Optional[Any]:
@@ -203,25 +250,55 @@ class FooterCache:
             self._entries.move_to_end(key)
             self.stats.footer_hits += 1
             _account(_M_FOOTER_HITS)
-            return got
+            return got[0]
 
-    def put(self, key, value) -> None:
+    def put(self, key, value, nbytes: int = 0) -> None:
         cap = footer_cache_entries()
         if cap <= 0:
             return
         with self._lock:
-            self._entries[key] = value
-            self._entries.move_to_end(key)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, int(nbytes))
+            self._bytes += int(nbytes)
             while len(self._entries) > cap:
-                self._entries.popitem(last=False)
+                _, (_, evicted_nb) = self._entries.popitem(last=False)
+                self._bytes -= evicted_nb
             self.stats.footer_entries = len(self._entries)
             _M_FOOTER_ENTRIES.set(len(self._entries))
+            _ACC_FOOTER.set(self._bytes)
+        _maybe_pressure()
+
+    def top_entries(self, n: int = 10) -> list:
+        """Largest cached footers by bytes — the /debugz residency view."""
+        with self._lock:
+            items = [(k, nb) for k, (_, nb) in self._entries.items()]
+        return _top_entries(items, n)
+
+    def shrink_to(self, target_entries: int) -> int:
+        """Evict LRU-first down to ``target_entries`` (pressure response);
+        returns the number of entries evicted."""
+        evicted = 0
+        with self._lock:
+            while len(self._entries) > max(0, target_entries):
+                _, (_, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+                evicted += 1
+            self.stats.footer_entries = len(self._entries)
+            _M_FOOTER_ENTRIES.set(len(self._entries))
+            _ACC_FOOTER.set(self._bytes)
+        return evicted
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._bytes = 0
             self.stats.footer_entries = 0
             _M_FOOTER_ENTRIES.set(0)
+            # same critical section: a scraper can never see an emptied
+            # cache with a stale nonzero ledger gauge
+            _ACC_FOOTER.set(0)
 
 
 def freeze_column(col):
@@ -345,7 +422,37 @@ class ChunkCache:
             self.stats.chunk_capacity = cap
             _M_CHUNK_ENTRIES.set(len(self._entries))
             _M_CHUNK_BYTES.set(self._bytes)
+            _ACC_CHUNK.set(self._bytes)
+        _maybe_pressure()
         return _private_copy(frozen)
+
+    def top_entries(self, n: int = 10) -> list:
+        """Largest resident chunks by bytes — the /debugz residency view
+        (keys are (file, row group, column, crc-flag) tuples)."""
+        with self._lock:
+            items = [(k, nb) for k, (_, nb) in self._entries.items()]
+        return _top_entries(items, n)
+
+    def shrink_to(self, target_bytes: int) -> int:
+        """Evict LRU-first until resident bytes <= ``target_bytes`` (the
+        soft-pressure response); returns entries evicted.  Counted in the
+        tier's own eviction meters too — a pressure eviction is still an
+        eviction to anyone watching hit rates."""
+        evicted = 0
+        with self._lock:
+            while self._bytes > max(0, target_bytes) and self._entries:
+                _, (_, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+                evicted += 1
+            if evicted:
+                self.stats.chunk_evictions += evicted
+                _account(_M_CHUNK_EVICTIONS, evicted)
+                self.stats.chunk_entries = len(self._entries)
+                self.stats.chunk_bytes = self._bytes
+                _M_CHUNK_ENTRIES.set(len(self._entries))
+                _M_CHUNK_BYTES.set(self._bytes)
+                _ACC_CHUNK.set(self._bytes)
+        return evicted
 
     def clear(self) -> None:
         with self._lock:
@@ -355,6 +462,9 @@ class ChunkCache:
             self.stats.chunk_bytes = 0
             _M_CHUNK_ENTRIES.set(0)
             _M_CHUNK_BYTES.set(0)
+            # same critical section as the residency zeroing: no window
+            # where the cache is empty but the ledger gauge is stale
+            _ACC_CHUNK.set(0)
 
 
 @dataclass(frozen=True)
@@ -457,7 +567,35 @@ class PageCache:
             self.stats.page_capacity = cap
             _M_PAGE_ENTRIES.set(len(self._entries))
             _M_PAGE_BYTES.set(self._bytes)
+            _ACC_PAGE.set(self._bytes)
+        _maybe_pressure()
         return entry
+
+    def top_entries(self, n: int = 10) -> list:
+        """Largest resident pages by bytes — the /debugz residency view
+        (keys are (file, row group, column, page ordinal, crc) tuples)."""
+        with self._lock:
+            items = [(k, nb) for k, (_, nb) in self._entries.items()]
+        return _top_entries(items, n)
+
+    def shrink_to(self, target_bytes: int) -> int:
+        """Evict LRU-first until resident bytes <= ``target_bytes`` (the
+        soft-pressure response); returns entries evicted."""
+        evicted = 0
+        with self._lock:
+            while self._bytes > max(0, target_bytes) and self._entries:
+                _, (_, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+                evicted += 1
+            if evicted:
+                self.stats.page_evictions += evicted
+                _account(_M_PAGE_EVICTIONS, evicted)
+                self.stats.page_entries = len(self._entries)
+                self.stats.page_bytes = self._bytes
+                _M_PAGE_ENTRIES.set(len(self._entries))
+                _M_PAGE_BYTES.set(self._bytes)
+                _ACC_PAGE.set(self._bytes)
+        return evicted
 
     def clear(self) -> None:
         with self._lock:
@@ -467,12 +605,139 @@ class PageCache:
             self.stats.page_bytes = 0
             _M_PAGE_ENTRIES.set(0)
             _M_PAGE_BYTES.set(0)
+            # same critical section: no stale-gauge window
+            _ACC_PAGE.set(0)
+
+
+def _key_nbytes(k) -> int:
+    """Approximate memo bytes of one normalized key: container overhead
+    plus payload for the variable-width kinds (the memo caps on BYTES, so
+    string keys must weigh their length)."""
+    if isinstance(k, (bytes, bytearray, str)):
+        return 64 + len(k)
+    return 64
+
+
+class NegLookupCache:
+    """Per-chunk "key definitely absent" memo — the negative side of the
+    point-lookup serving path (ROADMAP item 3 follow-on).
+
+    A repeated MISS costs the full cheapest-first cascade every time:
+    stats probe, one bloom probe for the batch, page-index search.  For
+    keys the cascade has already proven absent from a chunk, even that is
+    waste — serving fleets see hot *missing* keys (deleted users, bad
+    ids) at the same rates as hot present ones.  Entries are keyed like
+    the chunk LRU (``(file key, row group, leaf path)``) and hold the SET
+    of normalized keys proven absent; a later batch checks the memo
+    before the bloom probe and drops those keys outright, counted in
+    ``lookup.neg_hits``.
+
+    Only conclusive evidence enters: a key is recorded after its row
+    group's cascade completed without corruption and produced no rows.
+    Bytes-capped LRU at chunk granularity (``PARQUET_TPU_NEG_LOOKUP``,
+    default 4 MiB, ``0`` off); rewritten files can't serve stale entries
+    (fstat-keyed, same identity as every cache) and path sinks
+    invalidate on commit."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key → (set of normalized keys, nbytes)
+        self._entries: "OrderedDict[tuple, list]" = OrderedDict()
+        self._bytes = 0
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def absent(self, chunk_key, keys) -> set:
+        """Subset of ``keys`` known absent from the chunk (empty set when
+        the chunk has no memo)."""
+        with self._lock:
+            got = self._entries.get(chunk_key)
+            if got is None:
+                return set()
+            self._entries.move_to_end(chunk_key)
+            return {k for k in keys if k in got[0]}
+
+    def add(self, chunk_key, keys) -> None:
+        cap = neg_lookup_cache_bytes()
+        if cap <= 0 or not keys:
+            return
+        with self._lock:
+            got = self._entries.get(chunk_key)
+            if got is None:
+                got = self._entries[chunk_key] = [set(), 0]
+            self._entries.move_to_end(chunk_key)
+            for k in keys:
+                if k not in got[0]:
+                    got[0].add(k)
+                    got[1] += _key_nbytes(k)
+                    self._bytes += _key_nbytes(k)
+            while self._bytes > cap and self._entries:
+                _, e = self._entries.popitem(last=False)
+                self._bytes -= e[1]
+            _ACC_NEG.set(self._bytes)
+        _maybe_pressure()
+
+    def invalidate_path(self, ap: str) -> None:
+        with self._lock:
+            for key in [k for k in self._entries if k[0][0] == ap]:
+                e = self._entries.pop(key)
+                self._bytes -= e[1]
+            _ACC_NEG.set(self._bytes)
+
+    def shrink_to(self, target_bytes: int) -> int:
+        evicted = 0
+        with self._lock:
+            while self._bytes > max(0, target_bytes) and self._entries:
+                _, e = self._entries.popitem(last=False)
+                self._bytes -= e[1]
+                evicted += 1
+            _ACC_NEG.set(self._bytes)
+        return evicted
+
+    def top_entries(self, n: int = 10) -> list:
+        with self._lock:
+            items = [(k, e[1]) for k, e in self._entries.items()]
+        return _top_entries(items, n)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            _ACC_NEG.set(0)  # same critical section: no stale gauge
 
 
 _STATS = CacheStats()
 FOOTERS = FooterCache(_STATS)
 CHUNKS = ChunkCache(_STATS)
 PAGES = PageCache(_STATS)
+NEGS = NegLookupCache()
+
+
+def _reclaim_chunks(fraction: float) -> int:
+    return CHUNKS.shrink_to(int(CHUNKS.stats.chunk_bytes * fraction))
+
+
+def _reclaim_pages(fraction: float) -> int:
+    return PAGES.shrink_to(int(PAGES.stats.page_bytes * fraction))
+
+
+def _reclaim_negs(fraction: float) -> int:
+    return NEGS.shrink_to(int(NEGS.resident_bytes * fraction))
+
+
+def _reclaim_footers(fraction: float) -> int:
+    return FOOTERS.shrink_to(int(FOOTERS.stats.footer_entries * fraction))
+
+
+# soft-pressure response order: the big decoded tiers first, the cheap-
+# to-rebuild memo next, parsed footers last (they are small and the most
+# expensive per byte to recover)
+for _fn in (_reclaim_chunks, _reclaim_pages, _reclaim_negs,
+            _reclaim_footers):
+    LEDGER.register_reclaimer(_fn)
 
 
 def invalidate_path(path: str) -> None:
@@ -486,9 +751,11 @@ def invalidate_path(path: str) -> None:
     ap = os.path.abspath(path)
     with FOOTERS._lock:
         for key in [k for k in FOOTERS._entries if k[0] == ap]:
-            del FOOTERS._entries[key]
+            _, nb = FOOTERS._entries.pop(key)
+            FOOTERS._bytes -= nb
         FOOTERS.stats.footer_entries = len(FOOTERS._entries)
         _M_FOOTER_ENTRIES.set(len(FOOTERS._entries))
+        _ACC_FOOTER.set(FOOTERS._bytes)
     with CHUNKS._lock:
         for key in [k for k in CHUNKS._entries if k[0][0] == ap]:
             _, nb = CHUNKS._entries.pop(key)
@@ -497,6 +764,7 @@ def invalidate_path(path: str) -> None:
         CHUNKS.stats.chunk_bytes = CHUNKS._bytes
         _M_CHUNK_ENTRIES.set(len(CHUNKS._entries))
         _M_CHUNK_BYTES.set(CHUNKS._bytes)
+        _ACC_CHUNK.set(CHUNKS._bytes)
     with PAGES._lock:
         for key in [k for k in PAGES._entries if k[0][0] == ap]:
             _, nb = PAGES._entries.pop(key)
@@ -505,6 +773,8 @@ def invalidate_path(path: str) -> None:
         PAGES.stats.page_bytes = PAGES._bytes
         _M_PAGE_ENTRIES.set(len(PAGES._entries))
         _M_PAGE_BYTES.set(PAGES._bytes)
+        _ACC_PAGE.set(PAGES._bytes)
+    NEGS.invalidate_path(ap)
 
 
 def cache_stats() -> CacheStats:
@@ -517,12 +787,16 @@ def cache_stats() -> CacheStats:
 
 
 def clear_caches(reset_stats: bool = False) -> None:
-    """Drop every cached footer and decoded chunk (tests, benchmarks, and
-    memory-pressure escape hatch).  ``reset_stats=True`` also zeroes the
-    lifetime counters."""
+    """Drop every cached footer, decoded chunk/page, and negative-lookup
+    memo (tests, benchmarks, and memory-pressure escape hatch).  Each
+    tier zeroes its ledger account inside the SAME critical section that
+    empties it, so a concurrent scraper can never observe an emptied
+    cache against a stale nonzero gauge.  ``reset_stats=True`` also
+    zeroes the lifetime counters."""
     FOOTERS.clear()
     CHUNKS.clear()
     PAGES.clear()
+    NEGS.clear()
     if reset_stats:
         global _STATS
         fresh = CacheStats()

@@ -74,10 +74,12 @@ _M_PREADS = _counter("lookup.preads")
 _M_PAGES_READ = _counter("lookup.pages_read")
 _M_PAGES_COALESCED = _counter("lookup.pages_coalesced")
 _M_CHUNK_FALLBACKS = _counter("lookup.chunk_fallbacks")
+_M_NEG_HITS = _counter("lookup.neg_hits")
 
 _COUNTER_KEYS = ("keys", "keys_pruned_stats", "keys_pruned_bloom",
                  "keys_pruned_pages", "rows_matched", "preads", "pages_read",
-                 "pages_coalesced", "page_cache_hits", "chunk_fallbacks")
+                 "pages_coalesced", "page_cache_hits", "chunk_fallbacks",
+                 "neg_hits")
 
 
 @dataclass
@@ -380,12 +382,46 @@ def _lookup_rg(pf, rg, leaf, prep: _PreparedKeys, out_leaves,
     ``(per_uniq_rows, per_uniq_cols)`` — local row ordinals and output
     values per uniq key — or None when every key was pruned.  Raises on
     corruption; the caller owns skip semantics (the whole row group drops
-    atomically, rows and values together)."""
+    atomically, rows and values together).
+
+    Wraps the cascade with the negative-lookup memo (io/cache.py NEGS):
+    keys this chunk has already conclusively proven absent skip even the
+    stats probe (``lookup.neg_hits``), and keys this run proves absent —
+    pruned anywhere in the cascade, or page-read with zero matches — are
+    recorded for the next batch.  Only cache-eligible sources memoize
+    (same fstat identity rule as every cache tier), and only clean runs
+    do (an exception here propagates before the record)."""
+    from .cache import NEGS
+
+    alive = list(range(len(prep.uniq)))
+    neg_key = None
+    if pf._cache_key is not None:
+        # verify_crc is part of the identity, same as the chunk/page
+        # tiers: a no-CRC probe of corrupt pages can "prove" absence that
+        # a CRC-verifying reader must instead surface as corruption
+        neg_key = (pf._cache_key, rg.index, leaf.dotted_path,
+                   pf.options.verify_crc)
+        absent = NEGS.absent(neg_key, prep.uniq)
+        if absent:
+            known = [u for u in alive if prep.uniq[u] in absent]
+            _count(counters, "neg_hits", _M_NEG_HITS, len(known))
+            alive = [u for u in alive if prep.uniq[u] not in absent]
+            if not alive:
+                return None
+    got = _lookup_rg_probe(pf, rg, leaf, prep, alive, out_leaves, counters)
+    if neg_key is not None:
+        matched = set(got[0]) if got is not None else set()
+        NEGS.add(neg_key,
+                 [prep.uniq[u] for u in alive if u not in matched])
+    return got
+
+
+def _lookup_rg_probe(pf, rg, leaf, prep: _PreparedKeys, alive,
+                     out_leaves, counters: Dict[str, int]):
     from ..parallel.host_scan import aligned_key_mask
     from .search import _trim_flat_aligned
 
     chunk = rg.column(leaf.column_index)
-    alive = list(range(len(prep.uniq)))
     # ---- stage 1: chunk statistics (zero IO)
     st = chunk.statistics()
     nv = chunk.meta.num_values

@@ -47,9 +47,18 @@ import numpy as np
 from ..errors import DeadlineError
 from ..obs import scope as _oscope
 from ..obs import trace as _trace
+from ..obs.ledger import ledger_account
 from ..obs.metrics import counter as _counter
 from ..obs.metrics import histogram as _histogram
 from .source import MmapSource, Source
+
+# resource-ledger accounts (obs/ledger.py): ring = bytes of issued
+# windows not yet consumed/discarded, segments = the shared carve
+# buffers those windows fill slices of.  Updated inside the prefetcher's
+# own lock at every ring/plan mutation, summed across all live
+# prefetchers — both drain to 0 when the last drain closes.
+_ACC_RING = ledger_account("prefetch.ring")
+_ACC_SEG = ledger_account("prefetch.segments")
 
 __all__ = ["ReadStats", "PrefetchSource", "prefetch_mode", "make_prefetcher",
            "make_chunk_prefetcher", "autotune_enabled", "prefetch_autotune"]
@@ -314,6 +323,7 @@ class PrefetchSource(Source):
         self._lock = threading.Lock()
         self._plans: List[_Plan] = []
         self._ring: List[_Window] = []  # issue order (oldest first)
+        self._segs: dict = {}  # id(segment buffer) -> nbytes (ledger)
         self._mmap = _innermost(inner) if backend == "advise" else None
         if backend == "advise" and not isinstance(self._mmap, MmapSource):
             raise ValueError("advise backend needs an MmapSource-backed chain")
@@ -337,6 +347,12 @@ class PrefetchSource(Source):
         up to ``depth`` windows of each plan issued ahead of consumption."""
         if size <= 0 or self._closed:
             return
+        from ..obs.ledger import maybe_check_pressure
+
+        # readahead is a growth site too: let the ledger respond BEFORE
+        # staging more window buffers (outside our lock — the reclaimers
+        # take the cache locks)
+        maybe_check_pressure()
         with self._lock:
             self._plans.append(_Plan(offset, offset + size))
             self._pump_locked()
@@ -359,6 +375,8 @@ class PrefetchSource(Source):
                 w.future.cancel()
                 self._ring.remove(w)
                 self.stats.bytes_discarded += w.end - w.offset
+                _ACC_RING.sub(w.end - w.offset)
+            self._gc_segs_locked()
             if dropped:
                 self._pump_locked()
 
@@ -392,11 +410,15 @@ class PrefetchSource(Source):
                     # chunk-aligned carving: the next few windows share one
                     # contiguous segment buffer, so a cursor read spanning
                     # a window join inside it stays a zero-copy view
+                    self._gc_segs_locked()  # release dead segs first (and
+                    # retire their ids before a fresh buffer can reuse one)
                     seg_len = min(_SEG_WINDOWS * self.window_bytes,
                                   plan.end - plan.issue)
                     plan.seg_buf = np.empty(seg_len, np.uint8)
                     plan.seg_start = plan.issue
                     plan.seg_end = plan.issue + seg_len
+                    self._segs[id(plan.seg_buf)] = seg_len
+                    _ACC_SEG.add(seg_len)
                 end = min(end, plan.seg_end)
                 fut = pool_submit(self._fill_window, plan.seg_buf,
                                   plan.issue - plan.seg_start, plan.issue,
@@ -411,8 +433,22 @@ class PrefetchSource(Source):
                 self._ring.append(win)
                 self.stats.windows_issued += 1
                 self.stats.bytes_prefetched += end - plan.issue
+                _ACC_RING.add(end - plan.issue)
                 plan.issue = end
                 progressed = True
+
+    def _gc_segs_locked(self) -> None:
+        """Release the ledger's segment bytes for carve buffers no plan
+        or ring window references anymore (the buffers themselves free by
+        refcount; this keeps the ``prefetch.segments`` account matching
+        what is actually reachable)."""
+        if not self._segs:
+            return
+        live = {id(p.seg_buf) for p in self._plans
+                if p.seg_buf is not None}
+        live |= {id(w.seg) for w in self._ring if w.seg is not None}
+        for sid in [s for s in self._segs if s not in live]:
+            _ACC_SEG.sub(self._segs.pop(sid))
 
     def _fill_window(self, seg: np.ndarray, rel: int, offset: int,
                      size: int) -> np.ndarray:
@@ -578,7 +614,9 @@ class PrefetchSource(Source):
                     for w in cancelled:
                         if w in self._ring:
                             self._ring.remove(w)
+                            _ACC_RING.sub(w.end - w.offset)
                         self.stats.bytes_discarded += w.end - w.offset
+                    self._gc_segs_locked()
                 covered = False
         if not covered:
             with self._lock:
@@ -597,6 +635,8 @@ class PrefetchSource(Source):
                 with self._lock:
                     if w in self._ring:
                         self._ring.remove(w)
+                        _ACC_RING.sub(w.end - w.offset)
+                    self._gc_segs_locked()
                     self._pump_locked()
                 raise
         with self._lock:
@@ -621,7 +661,9 @@ class PrefetchSource(Source):
             for w in drop:
                 if w in self._ring:
                     self._ring.remove(w)
+                    _ACC_RING.sub(w.end - w.offset)
             if drop:
+                self._gc_segs_locked()
                 self._pump_locked()
         if want_view:
             return out
@@ -657,7 +699,10 @@ class PrefetchSource(Source):
                     except BaseException:
                         pass
                 self.stats.bytes_discarded += w.end - w.offset
+                if first_close:
+                    _ACC_RING.sub(w.end - w.offset)
             self._ring.clear()
+            self._gc_segs_locked()  # plans+ring empty: releases every seg
         if first_close:
             # one publish per drain: the registry gets this prefetcher's
             # lifetime totals exactly once (close() may be called again)

@@ -575,7 +575,10 @@ class ParquetFile:
             raise CorruptedError("footer has no schema")
         self.schema = Schema.from_elements(self.metadata.schema)
         if self._cache_key is not None:
-            FOOTERS.put(self._cache_key, (self.metadata, self.schema))
+            # nbytes = the serialized footer length: what the resource
+            # ledger's cache.footer account charges for the parsed entry
+            FOOTERS.put(self._cache_key, (self.metadata, self.schema),
+                        nbytes=footer_len)
 
     # ---------------------------------------------------------- resilience
     @property
@@ -664,6 +667,7 @@ class ParquetFile:
         with dec_span, \
                 read_context(path=self._path, row_group=chunk.rg_index,
                              column=chunk.leaf.dotted_path):
+            from ..utils.pool import read_admission
             from .cache import CHUNKS, freeze_column
 
             key = self._cache_key
@@ -671,13 +675,25 @@ class ParquetFile:
                 # uniform mutability contract: whole-chunk read results
                 # are read-only whether or not this source is cacheable —
                 # code must not validate against a writable result in one
-                # configuration and break in another
-                return freeze_column(decode_chunk_host(chunk))
+                # configuration and break in another.  The IO+decode span
+                # passes the unified read gate (scan tier) like every
+                # other in-flight read; nested admits pass through.
+                with read_admission().admit(
+                        chunk.meta.total_uncompressed_size or 0,
+                        tier="scan"):
+                    return freeze_column(decode_chunk_host(chunk))
             ck = (key, chunk.rg_index, chunk.leaf.dotted_path,
                   self.options.verify_crc)
             col = CHUNKS.get(ck)
             if col is None:
-                col = decode_chunk_host(chunk)
+                # miss: the whole-chunk IO+decode is an in-flight read
+                # span — admitted through the unified budget (the cache
+                # HIT path above stays gate-free: a warm read pins no
+                # new bytes, and must pay zero admission overhead)
+                with read_admission().admit(
+                        chunk.meta.total_uncompressed_size or 0,
+                        tier="scan"):
+                    col = decode_chunk_host(chunk)
                 # hand out the FROZEN instance (read-only buffers) so the
                 # miss caller cannot mutate what later hits will serve
                 frozen = CHUNKS.put_and_freeze(ck, col)

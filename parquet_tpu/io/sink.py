@@ -33,8 +33,16 @@ from typing import List, Optional
 
 from ..errors import WriteError
 from ..obs import trace as _trace
+from ..obs.ledger import ledger_account, maybe_check_pressure
 from ..obs.metrics import counter as _counter
 from ..obs.scope import account as _account
+
+# resource-ledger account (obs/ledger.py): bytes currently coalescing in
+# BufferedSinks process-wide — added as pages buffer, released as flushes
+# hand them to the OS (or abort drops them), capacity = the live
+# writeback knob
+_ACC_WBUF = ledger_account("write.buffer", capacity=lambda:
+                           write_buffer_bytes())
 
 __all__ = ["Sink", "FileSink", "AtomicFileSink", "BufferedSink", "WriteStats",
            "fsync_dir", "write_buffer_bytes", "write_autotune",
@@ -487,10 +495,15 @@ class BufferedSink(Sink):
             return n
         self._parts.append(data)
         self._buffered += n
+        _ACC_WBUF.add(n)
         if self.stats is not None:
             self.stats.bytes_buffered += n
         if self._buffered >= self.buffer_bytes:
             self._flush_buffer()
+        else:
+            # growth site: the write buffer can push the process over a
+            # watermark between flushes (two env reads when none is set)
+            maybe_check_pressure()
         return n
 
     def writelines(self, parts) -> None:
@@ -506,10 +519,13 @@ class BufferedSink(Sink):
         for p in parts:
             self._parts.append(p)
             self._buffered += len(p)
+            _ACC_WBUF.add(len(p))
             if self.stats is not None:
                 self.stats.bytes_buffered += len(p)
         if self._buffered >= self.buffer_bytes:
             self._flush_buffer()
+        else:
+            maybe_check_pressure()
 
     def _flush_buffer(self) -> None:
         if not self._parts:
@@ -527,6 +543,8 @@ class BufferedSink(Sink):
         # write error, and a retry would double-write the prefix)
         parts, self._parts = self._parts, []
         n, self._buffered = self._buffered, 0
+        _ACC_WBUF.sub(n)  # released at hand-over: a failed flush's bytes
+        # are dropped, not re-buffered, so the ledger must not hold them
         fd = None
         if _HAS_WRITEV:
             raw = getattr(self.inner, "raw_fd", None)
@@ -552,6 +570,7 @@ class BufferedSink(Sink):
 
     def abort(self) -> None:
         self._parts = []
+        _ACC_WBUF.sub(self._buffered)
         self._buffered = 0
         ab = getattr(self.inner, "abort", None)
         if ab is not None:

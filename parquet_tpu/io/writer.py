@@ -34,10 +34,13 @@ the pipeline.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import os
 import struct
+import threading
 import time
 import zlib
+from collections import deque
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -46,6 +49,8 @@ import numpy as np
 from .. import codecs
 from ..errors import MAX_ROW_GROUPS, TooManyRowGroupsError
 from ..format import enums, metadata as md, thrift
+from ..obs.ledger import (ledger_account as _ledger_account,
+                          maybe_check_pressure as _maybe_pressure)
 from ..format.enums import (CompressionCodec, ConvertedType, Encoding,
                             FieldRepetitionType as Rep, PageType, Type)
 from ..ops import levels as levels_ops, ref
@@ -65,6 +70,56 @@ DEFAULT_CREATED_BY = "parquet-tpu version 0.1.0"
 # bookkeeping of the overlap pipeline) costs more than it hides — the same
 # measured crossover as the parallel-encode gate
 _PARALLEL_ENCODE_BYTES = 8 << 20
+
+
+DEFAULT_WRITE_PENDED_BYTES = 256 << 20
+
+
+def write_depth() -> int:
+    """``PARQUET_TPU_WRITE_DEPTH``: how many fully-ENCODED row groups may
+    queue behind a slow sink before ``write_row_group`` blocks (≥1;
+    default 1 = today's behavior, emit inline on the caller thread).
+    Depth ≥ 2 moves emit onto a per-writer background thread: the caller
+    keeps encoding while earlier groups' pages flush — the carried-over
+    ROADMAP write-overlap-depth follow-on, with the memory it pins
+    bounded by the ledger's ``write.pended`` account."""
+    v = os.environ.get("PARQUET_TPU_WRITE_DEPTH", "").strip()
+    if v.isdigit() and int(v) >= 1:
+        return int(v)
+    return 1
+
+
+def write_pended_cap_bytes() -> int:
+    """``PARQUET_TPU_WRITE_PENDED``: byte cap on encoded groups queued
+    for emit (default 256 MiB; the depth bound still applies).  The cap
+    the ROADMAP item was waiting on — supplied by the ledger account."""
+    v = os.environ.get("PARQUET_TPU_WRITE_PENDED", "").strip()
+    if v:
+        try:
+            return max(0, int(v))
+        except ValueError:
+            pass
+    return DEFAULT_WRITE_PENDED_BYTES
+
+
+# resource-ledger account (obs/ledger.py): bytes of encoded row groups
+# queued for emit across every depth>1 writer in the process
+_ACC_PENDED = _ledger_account("write.pended",
+                              capacity=write_pended_cap_bytes)
+
+
+def _encs_nbytes(encs) -> int:
+    """Resident bytes of one collected encoded group: compressed page
+    bodies + dictionary pages + bloom blobs (headers are noise)."""
+    total = 0
+    for enc in encs:
+        if enc.dict_page is not None:
+            total += len(enc.dict_page[1])
+        for page in enc.pages:
+            total += len(page[1])
+        if enc.bloom_blob is not None:
+            total += len(enc.bloom_blob)
+    return total
 
 
 def _overlap_mode() -> str:
@@ -234,6 +289,21 @@ class ParquetWriter:
         # predecessor's pages flush — emitted by the next write_row_group,
         # flush(), or close()
         self._inflight: Optional[Tuple[list, int]] = None
+        # write-overlap depth > 1 (PARQUET_TPU_WRITE_DEPTH): a bounded
+        # queue of fully-ENCODED groups drained by a per-writer emitter
+        # thread, so a slow sink no longer stalls the caller between
+        # groups.  Emits stay strictly FIFO on ONE thread — offsets are
+        # assigned in queue order, so output bytes are identical to
+        # depth 1.  Memory pinned by the queue lives in the ledger's
+        # write.pended account, capped by PARQUET_TPU_WRITE_PENDED.
+        self._depth = write_depth()
+        self._pend_q: "deque" = deque()  # (ctx, encs, num_rows, nbytes)
+        self._pend_cv = threading.Condition()
+        self._pend_bytes = 0
+        self._emit_err: Optional[BaseException] = None
+        self._emitter: Optional[threading.Thread] = None
+        self._emitter_stop = False
+        self._discard_pended = False
 
     # ------------------------------------------------------------------
     def write(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
@@ -255,12 +325,14 @@ class ParquetWriter:
             self._drain(final=False)
 
     def flush(self) -> None:
-        """Write everything buffered, including the sub-group tail and any
-        row group whose background encode is still in flight."""
+        """Write everything buffered, including the sub-group tail, any
+        row group whose background encode is still in flight, and (depth
+        > 1) every encoded group queued for the background emitter."""
         with self._op_active():
             self._check_open()
             self._drain(final=True)
             self._drain_inflight()
+            self._drain_pended()
 
     def _check_open(self) -> None:
         # buffering rows into a finalized writer would drop them silently —
@@ -339,8 +411,10 @@ class ParquetWriter:
     def _write_row_group_impl(self, columns: Dict[str, ColumnData],
                               num_rows: int) -> None:
         self._check_open()
-        if len(self._row_groups) + (1 if self._inflight is not None
-                                    else 0) >= MAX_ROW_GROUPS:
+        if self._emit_err is not None:
+            self._raise_emit_err()
+        if len(self._row_groups) + len(self._pend_q) \
+                + (1 if self._inflight is not None else 0) >= MAX_ROW_GROUPS:
             raise TooManyRowGroupsError(
                 f"file would exceed {MAX_ROW_GROUPS} row groups "
                 "(RowGroup.ordinal is an i16); raise row_group_size")
@@ -394,7 +468,7 @@ class ParquetWriter:
             encs = self._timed_encode_iter(leaves, datas, num_rows)
         if prev is not None:
             try:
-                self._emit_group(*prev)
+                self._dispatch_emit(*prev)
             except BaseException:
                 # the previous group's emit failed with THIS group's encode
                 # already submitted: tear those futures down (abort() can't
@@ -408,8 +482,8 @@ class ParquetWriter:
             self._inflight = (encs, num_rows)
             self.write_stats.overlapped_groups += 1
         else:
-            self._emit_group(self._collect(encs) if pooled else encs,
-                             num_rows)
+            self._dispatch_emit(self._collect(encs) if pooled else encs,
+                                num_rows)
 
     def _timed_encode(self, leaf: Leaf, data: ColumnData, num_rows: int):
         # the write.encode span runs on whatever thread encodes — pool
@@ -457,7 +531,128 @@ class ParquetWriter:
             return
         encs, num_rows = self._inflight
         self._inflight = None
-        self._emit_group(self._collect(encs), num_rows)
+        self._dispatch_emit(self._collect(encs), num_rows)
+
+    # -------------------------------------------------- depth>1 emit queue
+    def _dispatch_emit(self, encs, num_rows: int) -> None:
+        """Route one encode-complete group to emit: inline at depth 1
+        (today's path, generator consumed lazily) — at depth ≥ 2, pend it
+        on the bounded queue for the emitter thread.  Pending blocks while
+        the queue holds ``depth`` groups or the ledger's ``write.pended``
+        account is over its cap (with at least one group pended — a
+        single giant group must admit alone, never deadlock)."""
+        if self._depth <= 1:
+            self._emit_group(encs, num_rows)
+            return
+        if not isinstance(encs, list):
+            # serial-encode generator: materialize on the CALLER thread —
+            # encode order (and the sticky dictionary-fallback state, and
+            # therefore the bytes) must not depend on emitter scheduling
+            encs = list(encs)
+        nb = _encs_nbytes(encs)
+        cap = write_pended_cap_bytes()
+        ctx = contextvars.copy_context()  # the op scope follows the emit
+        with self._pend_cv:
+            while self._emit_err is None and self._pend_q and (
+                    len(self._pend_q) >= self._depth
+                    or (cap > 0 and self._pend_bytes + nb > cap)):
+                self._pend_cv.wait()
+            if self._emit_err is not None:
+                self._raise_emit_err()
+            self._pend_q.append((ctx, encs, num_rows, nb))
+            self._pend_bytes += nb
+            _ACC_PENDED.add(nb)
+            self._ensure_emitter_locked()
+            self._pend_cv.notify_all()
+        _maybe_pressure()  # pended encodes are a growth site too
+
+    def _ensure_emitter_locked(self) -> None:
+        if self._emitter is None or not self._emitter.is_alive():
+            self._emitter_stop = False
+            self._emitter = threading.Thread(
+                target=self._emit_loop, name="pq-write-emit", daemon=True)
+            self._emitter.start()
+
+    def _emit_loop(self) -> None:
+        """The per-writer emitter: pops encoded groups strictly FIFO and
+        runs ``_emit_group`` — the ONE thread assigning offsets and
+        touching the sink while the queue drains, so output bytes are
+        identical to inline emit.  A group stays at the queue head while
+        it emits (its pages are still resident; the ledger must say so).
+        On error the queue drops (those groups can never emit over a
+        failed sink) and the error re-raises on the caller's next call."""
+        while True:
+            with self._pend_cv:
+                while not self._pend_q and not self._emitter_stop \
+                        and not self._discard_pended:
+                    self._pend_cv.wait()
+                if self._discard_pended or (self._emitter_stop
+                                            and not self._pend_q):
+                    self._drop_pended_locked()
+                    return
+                ctx, encs, num_rows, nb = self._pend_q[0]
+            err = None
+            try:
+                ctx.copy().run(self._emit_group, encs, num_rows)
+            except BaseException as e:  # InjectedWriterCrash included
+                err = e
+            with self._pend_cv:
+                self._pend_q.popleft()
+                self._pend_bytes -= nb
+                _ACC_PENDED.sub(nb)
+                if err is not None:
+                    self._emit_err = err
+                    self._drop_pended_locked()  # dead groups: the sink
+                    # failed; release their bytes, they can never emit
+                self._pend_cv.notify_all()
+                if err is not None or self._emitter_stop:
+                    return
+
+    def _drop_pended_locked(self) -> None:
+        while self._pend_q:
+            _, _, _, nb = self._pend_q.popleft()
+            self._pend_bytes -= nb
+            _ACC_PENDED.sub(nb)
+        self._pend_cv.notify_all()
+
+    def _drain_pended(self) -> None:
+        """Block until every pended group emitted (flush/close barrier);
+        re-raises a background emit failure on the caller thread."""
+        if self._depth <= 1:
+            return
+        with self._pend_cv:
+            while self._pend_q and self._emit_err is None:
+                self._pend_cv.wait()
+            if self._emit_err is not None:
+                self._raise_emit_err()
+
+    def _raise_emit_err(self):
+        # sticky: once the background emit failed, the file can never be
+        # completed — every later call surfaces the same root cause
+        raise self._emit_err
+
+    def _stop_emitter(self) -> None:
+        with self._pend_cv:
+            self._emitter_stop = True
+            self._pend_cv.notify_all()
+            t = self._emitter
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join()
+
+    def _teardown_pended(self) -> None:
+        """Failure-path teardown (abort, failed close): queued groups must
+        never emit over a sink that is about to be aborted, and their
+        ledger bytes must release — a leaked ``write.pended`` balance
+        would fake memory pressure for the rest of the process.  Joins
+        the emitter BEFORE the caller aborts the sink, so a mid-emit
+        write can't race the teardown."""
+        with self._pend_cv:
+            self._discard_pended = True
+            self._pend_cv.notify_all()
+        self._stop_emitter()
+        with self._pend_cv:  # emitter gone (or never started): sweep
+            self._drop_pended_locked()
 
     def _emit_group(self, encs, num_rows: int) -> None:
         """Serial emit of one fully-encoded row group: assign offsets,
@@ -836,6 +1031,8 @@ class ParquetWriter:
                 self._close_impl()
             except BaseException:
                 self._aborted = True
+                self._teardown_pended()  # discard queued groups + release
+                # their ledger bytes before the sink abort
                 if self._own_sink:
                     self._f.abort()
                 if self._op is not None:
@@ -845,6 +1042,7 @@ class ParquetWriter:
                     self._op.finish()
                 raise
             self._closed = True
+            self._stop_emitter()  # idle by now (_close_impl drained)
             # one publish per writer: the unified registry gets this
             # write's totals exactly once, at the moment the bytes are
             # committed (publish() itself is idempotent as a backstop)
@@ -879,6 +1077,10 @@ class ParquetWriter:
             encs, _ = self._inflight
             self._inflight = None
             cancel_futures(encs)
+        # depth>1: discard queued groups and join the emitter before the
+        # sink abort (the head group mid-emit finishes into the doomed
+        # temp file — harmless, the abort unlinks it)
+        self._teardown_pended()
         if self._own_sink:
             self._f.abort()
         if self._op is not None:

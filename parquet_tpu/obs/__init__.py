@@ -12,6 +12,12 @@ through.
 - :mod:`parquet_tpu.obs.export` — Prometheus text-format rendering
   (``python -m parquet_tpu stats --prom``) and the live scrape endpoint
   (``start_metrics_server`` / ``stats --serve PORT``).
+- :mod:`parquet_tpu.obs.ledger` — the process-wide resource ledger:
+  every byte-holding tier keeps a named account current at its own
+  mutation sites (``ledger.*`` gauges), with soft/hard memory-pressure
+  watermarks (``PARQUET_TPU_MEM_SOFT``/``HARD``) that shrink the LRU
+  tiers and gate admissions, and the ``/debugz`` live-residency
+  endpoint on the metrics server.
 - :mod:`parquet_tpu.obs.scope` — request-scoped telemetry:
   ``op_scope(name)`` gives every operation its own identity (per-op
   ``OpReport`` attribution across shared-pool workers, per-request
@@ -30,10 +36,13 @@ from . import trace
 from .trace import (NULL_SPAN, disable_tracing, enable_tracing, enabled,
                     flush_trace, reset_trace, span, trace_events,
                     trace_span)
-from .export import (MetricsServer, render_prometheus,
+from .export import (MetricsServer, debugz_snapshot, render_prometheus,
                      start_metrics_server)
+from . import ledger
+from .ledger import (LEDGER, ResourceLedger, ledger_account,
+                     ledger_snapshot)
 from . import scope
-from .scope import OpScope, current_op, maybe_op_scope, op_scope
+from .scope import OpScope, current_op, live_ops, maybe_op_scope, op_scope
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "metrics_delta",
@@ -41,5 +50,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "NULL_SPAN", "trace", "disable_tracing", "enable_tracing",
            "enabled", "flush_trace", "reset_trace", "span", "trace_events",
            "trace_span", "render_prometheus", "MetricsServer",
-           "start_metrics_server", "scope", "OpScope", "current_op",
-           "maybe_op_scope", "op_scope"]
+           "start_metrics_server", "debugz_snapshot", "ledger", "LEDGER",
+           "ResourceLedger", "ledger_account", "ledger_snapshot", "scope",
+           "OpScope", "current_op", "live_ops", "maybe_op_scope",
+           "op_scope"]

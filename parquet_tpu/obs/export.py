@@ -29,7 +29,8 @@ from typing import Optional
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       metrics_snapshot)
 
-__all__ = ["render_prometheus", "start_metrics_server", "MetricsServer"]
+__all__ = ["render_prometheus", "start_metrics_server", "MetricsServer",
+           "debugz_snapshot"]
 
 _PREFIX = "parquet_tpu_"
 _BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
@@ -101,6 +102,66 @@ def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
 
 
 # ---------------------------------------------------------------------------
+# live introspection
+# ---------------------------------------------------------------------------
+
+
+def debugz_snapshot(top_n: int = 10) -> dict:
+    """The ``/debugz`` payload: live residency of every buffer tier.
+
+    - ``ledger``: per-account resident/capacity/high-water bytes, the
+      process total, watermark state and thresholds (obs/ledger.py);
+    - ``caches``: per-cache entry/byte counts plus the top-N entries by
+      bytes WITH their keys — "which file's chunks are pinning memory"
+      answered from a running process;
+    - ``admission``: the unified read gate — bytes in flight, queue
+      depth (waiters), lifetime blocked-acquire count, high water, and
+      the effective budgets;
+    - ``pool``: shared-pool width, tasks running, dispatch queue depth;
+    - ``ops``: the op-scope table — every currently-open operation with
+      its age (a stuck op shows up here long before a timeout fires).
+
+    Imported lazily: the endpoint must answer even in a process that
+    never touched the IO layer (families just render empty)."""
+    from ..utils.pool import pool_debug, read_admission
+    from .ledger import ledger_snapshot
+    from .scope import live_ops
+
+    out = {"ledger": ledger_snapshot(), "pool": pool_debug(),
+           "ops": live_ops()}
+    adm = read_admission()
+    out["admission"] = {
+        "in_flight_bytes": adm.in_flight_bytes(),
+        "queue_depth": adm.queue_depth(),
+        "waits": adm.waits,
+        "high_water_bytes": adm.high_water,
+        "budget_bytes": {"global": adm.global_budget_bytes(),
+                         "lookup": adm.budget_bytes("lookup"),
+                         "scan": adm.budget_bytes("scan")},
+    }
+    try:
+        from ..io import cache as _cache
+
+        st = _cache.cache_stats()
+        out["caches"] = {
+            "chunk": {"entries": st.chunk_entries, "bytes": st.chunk_bytes,
+                      "capacity": st.chunk_capacity,
+                      "top": _cache.CHUNKS.top_entries(top_n)},
+            "page": {"entries": st.page_entries, "bytes": st.page_bytes,
+                     "capacity": st.page_capacity,
+                     "top": _cache.PAGES.top_entries(top_n)},
+            "footer": {"entries": st.footer_entries,
+                       "top": _cache.FOOTERS.top_entries(top_n)},
+            "neg_lookup": {"bytes": _cache.NEGS.resident_bytes,
+                           "capacity": _cache.neg_lookup_cache_bytes(),
+                           "top": _cache.NEGS.top_entries(top_n)},
+        }
+    except ImportError:  # pragma: no cover - the IO layer always imports
+        out["caches"] = {}
+    return out
+
+
+# ---------------------------------------------------------------------------
 # live scrape endpoint
 # ---------------------------------------------------------------------------
 
@@ -109,7 +170,9 @@ _PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     """GET-only handler: ``/metrics`` (Prometheus 0.0.4), ``/metrics.json``
-    (the ``metrics_snapshot()`` dict), ``/healthz`` (liveness)."""
+    (the ``metrics_snapshot()`` dict), ``/debugz`` (live buffer-tier
+    residency, :func:`debugz_snapshot`), ``/healthz`` (liveness + memory
+    pressure state: ``ok``/``soft``/``hard``)."""
 
     server_version = "parquet-tpu-metrics/1.0"
 
@@ -122,8 +185,19 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         elif path in ("/metrics", "/"):
             body = render_prometheus(self.server._registry).encode("utf-8")
             ctype = _PROM_CONTENT_TYPE
+        elif path == "/debugz":
+            body = json.dumps(debugz_snapshot(), sort_keys=True) \
+                .encode("utf-8")
+            ctype = "application/json"
         elif path == "/healthz":
-            body, ctype = b"ok\n", "text/plain; charset=utf-8"
+            from .ledger import LEDGER
+
+            # liveness + pressure: "ok\n" when under the watermarks (the
+            # PR-8 contract unchanged), "soft\n"/"hard\n" when degraded —
+            # a fleet health check learns of memory pressure from the
+            # same probe it already runs
+            body = (LEDGER.state() + "\n").encode("utf-8")
+            ctype = "text/plain; charset=utf-8"
         else:
             self.send_error(404, "unknown path (try /metrics)")
             return

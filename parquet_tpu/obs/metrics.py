@@ -435,6 +435,10 @@ _CORE_COUNTERS = (
     ("lookup.pages_coalesced", "extra pages riding an already-issued pread"),
     ("lookup.chunk_fallbacks", "index-less chunks decoded whole by lookups"),
     ("lookup.admission_waits", "lookup admissions that had to block"),
+    ("lookup.neg_hits", "lookup keys skipped by the negative-lookup memo"),
+    # the unified read gate (utils/pool.py): scan/stream-tier admissions
+    # through the same FIFO budget the lookup path pioneered
+    ("read.admission_waits", "scan/stream admissions that had to block"),
 )
 
 
@@ -449,6 +453,8 @@ def _declare_core() -> None:
     REGISTRY.histogram("lookup.find_rows_s",
                        help="batched point-lookup latency (p50/p99 serving "
                             "meter)")
+    REGISTRY.histogram("read.admission_wait_s",
+                       help="scan/stream block time on the read gate")
 
 
 _declare_core()

@@ -60,7 +60,7 @@ from .metrics import _render_key
 
 __all__ = ["OpScope", "op_scope", "maybe_op_scope", "current_op",
            "scoped_iter", "account", "add_to_current", "account_bytes",
-           "sample_n", "slow_op_threshold_s", "slow_log_path"]
+           "sample_n", "slow_op_threshold_s", "slow_log_path", "live_ops"]
 
 _CURRENT: "contextvars.ContextVar[Optional[OpScope]]" = \
     contextvars.ContextVar("parquet_tpu_op_scope", default=None)
@@ -77,6 +77,34 @@ _OPS_SLOW = _metrics.counter("trace.ops_slow_kept")
 _BYTES_READ = _metrics.counter("read.bytes_read")
 
 _SLOW_LOG_LOCK = threading.Lock()
+
+# currently-open operations, op_id → scope: the /debugz op table.  Every
+# scope registers at construction and leaves at finish(); an entry that
+# lingers IS the signal (a stuck or leaked op is exactly what a live
+# introspection endpoint exists to show).
+_LIVE_LOCK = threading.Lock()
+_LIVE_OPS: "Dict[int, OpScope]" = {}
+
+
+def live_ops() -> list:
+    """The currently-open ops, oldest first: op id, name, attrs, age in
+    seconds since first activation (0 for a scope built but never
+    entered), and the sampling decision.  Powers ``/debugz``."""
+    with _LIVE_LOCK:
+        scopes = list(_LIVE_OPS.values())
+    now = time.perf_counter()
+    out = []
+    for s in scopes:
+        with s._lock:
+            t_first = s._t_first
+        out.append({"op": s.op_id, "name": s.name,
+                    "attrs": {k: _trace._jsonable(v)
+                              for k, v in s.attrs.items()},
+                    "age_s": round(now - t_first, 6)
+                    if t_first is not None else 0.0,
+                    "sampled": s.sampled})
+    out.sort(key=lambda r: -r["age_s"])
+    return out
 
 # systematic head sampling with a random phase: exactly one sampled op
 # per block of N, but WHICH position is drawn fresh each block — a plain
@@ -231,6 +259,8 @@ class OpScope:
             else:
                 _OPS_SKIPPED.inc()
                 self._ring = _trace.OpRing()
+        with _LIVE_LOCK:
+            _LIVE_OPS[self.op_id] = self
 
     # ------------------------------------------------------- activation
     def _activate(self) -> None:
@@ -370,6 +400,8 @@ class OpScope:
             _OPS_SLOW.inc()
             self._write_slow_record(dur)
         self._ring = None  # drop the parked spans either way
+        with _LIVE_LOCK:
+            _LIVE_OPS.pop(self.op_id, None)
 
     def _write_slow_record(self, dur: float) -> None:
         path = slow_log_path()

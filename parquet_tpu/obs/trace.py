@@ -56,6 +56,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
+from .ledger import ledger_account as _ledger_account
 
 __all__ = ["TRACE_ENABLED", "trace_span", "span", "enabled",
            "enable_tracing", "disable_tracing", "flush_trace",
@@ -66,6 +67,12 @@ TRACE_ENABLED = False
 MAX_EVENTS = 1_000_000
 # per-op ring capacity: bounds the allocation a never-kept op can pin
 OP_RING_EVENTS = 4096
+# ledger accounting (obs/ledger.py): estimated bytes per buffered event —
+# a Chrome "X" dict with name/ts/dur/pid/tid/cat runs ~200 bytes of
+# python objects; exact sizing per event would cost more than the buffer
+_EVENT_EST_BYTES = 200
+_ACC_TRACE = _ledger_account("trace.buffer",
+                             capacity=lambda: MAX_EVENTS * _EVENT_EST_BYTES)
 
 _LOCK = threading.Lock()
 _EVENTS: List[dict] = []
@@ -182,6 +189,7 @@ def _append_global(ev: dict, track, thread_name: str) -> None:
             return
         _ensure_meta_locked(ev["pid"], ev["tid"], track, thread_name)
         _EVENTS.append(ev)
+        _ACC_TRACE.set(len(_EVENTS) * _EVENT_EST_BYTES)
 
 
 def _ensure_meta_locked(pid: int, tid: int, track, thread_name: str) -> None:
@@ -240,6 +248,7 @@ def promote_ring(ring: OpRing, track) -> None:
                 break
             _ensure_meta_locked(ev["pid"], ev["tid"], track, tname)
             _EVENTS.append(ev)
+        _ACC_TRACE.set(len(_EVENTS) * _EVENT_EST_BYTES)
     if dropped:
         _metrics.counter("trace.events_dropped").inc(dropped)
 
@@ -303,6 +312,7 @@ def reset_trace() -> None:
         _EVENTS.clear()
         _SEEN_TIDS.clear()
         _SEEN_PIDS.clear()
+        _ACC_TRACE.set(0)  # same critical section: no stale-gauge window
 
 
 def trace_events() -> List[dict]:

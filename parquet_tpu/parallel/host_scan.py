@@ -41,7 +41,9 @@ __all__ = ["scan", "scan_expr", "scan_filtered", "scan_filtered_device",
 
 from ..utils.pool import (in_shared_pool as _in_pool,
                           instrument_task as _instrument_task,
-                          mark_pooled as _mark_pooled, shared_pool as _pool)
+                          mark_pooled as _mark_pooled,
+                          read_admission as _read_admission,
+                          shared_pool as _pool)
 
 # decoded_scan: spans between survivor-count syncs (bounds device residency
 # at ~_SYNC_EVERY spans of uncompacted output while amortizing the RTT)
@@ -289,11 +291,34 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
 
     skip = pol is not None and pol.skip_corrupt
 
+    # unified read budget (utils/pool.py): every phase-1/2 decode span
+    # admits its estimated uncompressed bytes through the same FIFO gate
+    # the lookup path uses, so PARQUET_TPU_READ_BUDGET bounds scan +
+    # lookup in-flight bytes together.  Estimate = the chunk's footer
+    # uncompressed size prorated to the span's rows (zero IO; memoized
+    # per (row group, column)).  Default budget for the scan tier is off,
+    # so this costs one env read per task until an operator opts in.
+    admission = _read_admission()
+    bytes_per_row: Dict[tuple, float] = {}
+
+    def _span_bytes(rg_i: int, c: str, count: int) -> int:
+        got = bytes_per_row.get((rg_i, c))
+        if got is None:
+            rg_meta = pf.metadata.row_groups[rg_i]
+            col_i = pf.schema.leaf(c).column_index
+            tot = (rg_meta.columns[col_i].meta_data
+                   .total_uncompressed_size or 0)
+            got = tot / max(rg_meta.num_rows or 1, 1)
+            bytes_per_row[(rg_i, c)] = got
+        return int(got * count)
+
     def read_one(task):
         rg_i, start, count, c, form = task
         try:
             with read_context(path=pf._path, row_group=rg_i, column=c):
-                return read_row_range(pf, c, start, count, aligned=form)
+                with admission.admit(_span_bytes(rg_i, c, count),
+                                     tier="scan"):
+                    return read_row_range(pf, c, start, count, aligned=form)
         except DeadlineError:
             raise
         except CorruptedError as e:

@@ -20,6 +20,7 @@ from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
 from typing import Optional
 
+from ..obs import ledger as _ledger
 from ..obs import scope as _scope
 from ..obs import trace as _trace
 from ..obs.metrics import counter as _counter
@@ -34,13 +35,28 @@ _IN_POOL = threading.local()
 # dispatch feeds (obs.metrics.pool_wait_seconds sums it for the router)
 _QUEUE_WAIT = _histogram("pool.queue_wait_s")
 _TASKS = _counter("pool.tasks", help="tasks dispatched to the shared pool")
+_ACTIVE = _gauge("pool.active", help="pool tasks currently running")
 
-# admission-control meters (the lookup serving path's fairness gate)
+# admission-control meters: per-tier wait counters (the lookup family
+# keeps its PR-9 names; scan/stream waits land in the read.* family)
 _M_ADM_WAITS = _counter("lookup.admission_waits",
                         help="lookup admissions that had to block")
 _ADM_WAIT_S = _histogram("lookup.admission_wait_s")
+_M_READ_WAITS = _counter("read.admission_waits",
+                         help="scan/stream admissions that had to block")
+_READ_WAIT_S = _histogram("read.admission_wait_s")
 _M_ADMITTED = _gauge("lookup.admitted_bytes",
-                     help="bytes currently admitted through the lookup gate")
+                     help="bytes currently admitted through the read gate")
+_ACC_ADMITTED = _ledger.ledger_account("admission.in_flight")
+
+# re-entrancy guard: a decode span already running under an admission
+# grant must not acquire again (the lookup chunk-fallback admits, then
+# _decode_chunk_ctx would admit the same bytes — a nested FIFO wait
+# behind other tickets while holding budget is a self-deadlock).  A
+# context variable, so the flag follows an op onto pool workers exactly
+# like its scope does.
+_ADMISSION_HELD: "contextvars.ContextVar[bool]" = \
+    contextvars.ContextVar("parquet_tpu_admission_held", default=False)
 
 
 def in_shared_pool() -> bool:
@@ -100,10 +116,14 @@ def _run_instrumented(fn, name, t_submit: float, a, k):
     # context, so the wait attributes to the op that dispatched the task
     _scope.add_to_current("pool.queue_wait_s", wait)
     _scope.account(_TASKS)
-    if _trace.TRACE_ENABLED:
-        with _trace.span("pool.task", fn=name):
-            return fn(*a, **k)
-    return fn(*a, **k)
+    _ACTIVE.inc()  # the /debugz "running now" meter
+    try:
+        if _trace.TRACE_ENABLED:
+            with _trace.span("pool.task", fn=name):
+                return fn(*a, **k)
+        return fn(*a, **k)
+    finally:
+        _ACTIVE.dec()
 
 
 def submit(fn, *args, **kwargs):
@@ -169,104 +189,192 @@ def map_in_order(fn, items, parallel: "Optional[bool]" = None) -> list:
     return out
 
 
+def _env_opt_bytes(name: str) -> Optional[int]:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return max(0, int(v))
+    except ValueError:
+        return None
+
+
 class AdmissionController:
-    """FIFO bytes-budget gate for the point-lookup serving path.
+    """FIFO bytes-budget gate over EVERY in-flight read span — the
+    unified generalization of the PR-9 lookup-only gate (ROADMAP item 3's
+    "one budget governs all in-flight read bytes" follow-on).
 
     The shared pool bounds *width* (how many tasks run) but not *memory*
     (how many bytes the running + queued tasks pin) or *order* (a flood of
     late arrivals can starve an earlier waiter indefinitely under a plain
-    semaphore).  Serving workloads hit both: thousands of concurrent small
-    lookups would decode unbounded page bytes and leapfrog each other.
-    This controller fixes both at once:
+    semaphore).  This controller fixes both at once, for every read tier:
 
-    - **bytes budget** — ``acquire(nbytes)`` blocks until the request fits
-      in the remaining budget (``PARQUET_TPU_LOOKUP_BUDGET`` bytes,
-      default 64 MiB, ``0`` disables admission), so total in-flight
-      lookup bytes never exceed the cap no matter the concurrency.  A
-      request larger than the whole budget is clamped and admits alone —
-      it must not deadlock, and alone it cannot compound.
+    - **bytes budget** — ``acquire(nbytes, tier=...)`` blocks until the
+      request fits, so total in-flight read bytes never exceed the cap no
+      matter the concurrency.  ``PARQUET_TPU_READ_BUDGET`` is the one
+      global budget; the per-tier sub-budgets are optional clamps inside
+      it: ``PARQUET_TPU_LOOKUP_BUDGET`` (the PR-9 env, kept as an alias —
+      with no global budget it still defaults the lookup tier to 64 MiB,
+      exactly the old behavior) and ``PARQUET_TPU_SCAN_BUDGET`` for scan
+      phase-1/2 decode spans and streamed whole-chunk decodes (default
+      off: bulk reads are unbudgeted unless an operator opts in, so the
+      PR-3..9 throughput baselines are untouched).  A request larger
+      than the whole budget is clamped and admits alone — it must not
+      deadlock, and alone it cannot compound.
     - **FIFO fairness** — waiters are granted strictly in arrival order
-      (a ticket queue, not a herd on a semaphore), so a large early
-      request cannot be starved by a stream of later small ones, and
-      lookup bursts drain in bounded, predictable order instead of
-      whichever thread wins the race.
+      (a ticket queue, not a herd on a semaphore), across tiers: a scan's
+      large span cannot be starved by a stream of later small lookups,
+      and bursts drain in bounded, predictable order.
+    - **hard-pressure blocking** — while the resource ledger
+      (obs/ledger.py) is over ``PARQUET_TPU_MEM_HARD``, new admissions
+      block (after triggering the reclaim pass) until the total drops
+      below the watermark; releases never block, so held budget always
+      drains.
+
+    Nested acquires are re-entrant no-ops (a decode running under a
+    grant gets grant 0 from inner gates — the outer span already
+    reserved its bytes), tracked by a context variable so the guard
+    follows work onto pool workers.
 
     ``high_water`` records the max bytes ever admitted concurrently (the
-    budget-held proof the admission tests assert).  Waits are metered:
-    ``lookup.admission_waits`` counts blocked acquires and
-    ``lookup.admission_wait_s`` is the block-time histogram."""
+    budget-held proof the admission tests assert).  Waits are metered
+    per tier: ``lookup.admission_waits``/``lookup.admission_wait_s`` and
+    ``read.admission_waits``/``read.admission_wait_s``; the granted
+    bytes publish as the ``admission.in_flight`` ledger account."""
 
     def __init__(self, env_var: str = "PARQUET_TPU_LOOKUP_BUDGET",
                  default_bytes: int = 64 << 20):
-        self._env_var = env_var
-        self._default = default_bytes
+        # env_var: the lookup tier's sub-budget env (overridable so the
+        # PR-9 admission unit tests can pin an isolated controller)
+        self._tier_envs = {"lookup": env_var,
+                           "scan": "PARQUET_TPU_SCAN_BUDGET"}
+        self._default_lookup = default_bytes
         self._cv = threading.Condition(threading.Lock())
         self._queue: "deque" = deque()
         self._in_use = 0
+        self._tier_use: dict = {}
         self.high_water = 0
         self.waits = 0
 
-    def budget_bytes(self) -> int:
-        """Budget read per acquire (tests repoint the env without
-        rebuilding the controller); ``0`` disables admission."""
-        v = os.environ.get(self._env_var, "").strip()
-        if v:
-            try:
-                return max(0, int(v))
-            except ValueError:
-                pass
-        return self._default
+    def global_budget_bytes(self) -> Optional[int]:
+        """``PARQUET_TPU_READ_BUDGET`` — the unified cap (None = unset,
+        ``0`` = admission explicitly off for every tier)."""
+        return _env_opt_bytes("PARQUET_TPU_READ_BUDGET")
 
-    def acquire(self, nbytes: int) -> int:
-        """Block FIFO until ``nbytes`` fit; returns the granted amount to
-        hand back to :meth:`release` (0 when admission is disabled)."""
-        budget = self.budget_bytes()
-        if budget <= 0:
+    def budget_bytes(self, tier: str = "lookup") -> int:
+        """Effective budget for ``tier``, read per acquire (tests repoint
+        the env without rebuilding the controller); ``0`` disables
+        admission for the tier.  Sub-budget env wins, then the global
+        budget, then the tier default (64 MiB for lookups — the PR-9
+        contract — off for scans)."""
+        g = self.global_budget_bytes()
+        if g == 0:
             return 0
-        grant = min(max(int(nbytes), 0), budget)
+        t = _env_opt_bytes(self._tier_envs.get(tier, ""))
+        if t is not None:
+            return t
+        if g is not None:
+            return g
+        return self._default_lookup if tier == "lookup" else 0
+
+    def acquire(self, nbytes: int, tier: str = "lookup") -> int:
+        """Block FIFO until ``nbytes`` fit (and the ledger is below the
+        hard watermark); returns the granted amount to hand back to
+        :meth:`release` (0 when admission is disabled or the caller
+        already holds a grant)."""
+        if _ADMISSION_HELD.get():
+            return 0
+        budget = self.budget_bytes(tier)
+        g = self.global_budget_bytes()
+        hard_gate = _ledger.hard_watermark_bytes() > 0
+        if budget <= 0 and not hard_gate:
+            return 0
+        if budget <= 0:
+            # budget off but the hard watermark is live: the gate still
+            # blocks entry under hard pressure, granting 0 bytes
+            grant = 0
+        else:
+            grant = min(max(int(nbytes), 0), budget)
+            if g is not None and g > 0:
+                grant = min(grant, g)
         ticket = object()
         t0 = time.perf_counter()
         waited = False
+        if hard_gate and _ledger.LEDGER.check_pressure() == "hard":
+            # reclaim runs HERE, outside the gate's lock: a blocked
+            # admission drives the eviction it is waiting on without
+            # serializing every other acquire/release behind cache locks
+            waited = True
         with self._cv:
             self._queue.append(ticket)
-            while self._queue[0] is not ticket \
-                    or self._in_use + grant > budget:
+            while (self._queue[0] is not ticket
+                   or (budget > 0
+                       and self._tier_use.get(tier, 0) + grant > budget)
+                   or (g is not None and g > 0
+                       and self._in_use + grant > g)
+                   or (hard_gate
+                       and _ledger.LEDGER.state() == "hard")):
                 waited = True
-                self._cv.wait()
+                # bounded lap: hard-pressure state changes (env flips,
+                # cache evictions elsewhere) have no notifier of their
+                # own.  state() is the CHEAP refresh (account sum, no
+                # reclaim, no cache locks) — safe under the gate's lock.
+                self._cv.wait(timeout=0.05)
             self._queue.popleft()
             self._in_use += grant
+            self._tier_use[tier] = self._tier_use.get(tier, 0) + grant
             if self._in_use > self.high_water:
                 self.high_water = self._in_use
             if waited:
                 self.waits += 1  # inside the lock: exact under herds
             _M_ADMITTED.set(self._in_use)
+            _ACC_ADMITTED.set(self._in_use)
             # the next waiter may also fit (grants are not exclusive):
             # wake the queue so admission drains as wide as the budget
             self._cv.notify_all()
         if waited:
             wait_s = time.perf_counter() - t0
-            _ADM_WAIT_S.observe(wait_s)
-            _scope.account(_M_ADM_WAITS)
-            _scope.add_to_current("lookup.admission_wait_s", wait_s)
+            if tier == "lookup":
+                _ADM_WAIT_S.observe(wait_s)
+                _scope.account(_M_ADM_WAITS)
+                _scope.add_to_current("lookup.admission_wait_s", wait_s)
+            else:
+                _READ_WAIT_S.observe(wait_s)
+                _scope.account(_M_READ_WAITS)
+                _scope.add_to_current("read.admission_wait_s", wait_s)
         return grant
 
-    def release(self, grant: int) -> None:
+    def release(self, grant: int, tier: str = "lookup") -> None:
         if grant <= 0:
             return
         with self._cv:
             self._in_use -= grant
+            self._tier_use[tier] = self._tier_use.get(tier, 0) - grant
             _M_ADMITTED.set(self._in_use)
+            _ACC_ADMITTED.set(self._in_use)
             self._cv.notify_all()
 
+    def queue_depth(self) -> int:
+        """Waiters currently queued at the gate (the /debugz meter)."""
+        with self._cv:
+            return len(self._queue)
+
+    def in_flight_bytes(self) -> int:
+        with self._cv:
+            return self._in_use
+
     @contextmanager
-    def admit(self, nbytes: int):
+    def admit(self, nbytes: int, tier: str = "lookup"):
         """``with admission.admit(span_bytes): pread + decode`` — the
-        shape every lookup IO/decode span wraps."""
-        grant = self.acquire(nbytes)
+        shape every admitted IO/decode span wraps.  Marks the context as
+        holding a grant so nested gates pass through."""
+        grant = self.acquire(nbytes, tier=tier)
+        token = _ADMISSION_HELD.set(True)
         try:
             yield grant
         finally:
-            self.release(grant)
+            _ADMISSION_HELD.reset(token)
+            self.release(grant, tier=tier)
 
     def _reset(self) -> None:
         """Test isolation only: forget the high-water mark and wait count
@@ -281,8 +389,33 @@ _ADMISSION = AdmissionController()
 
 def lookup_admission() -> AdmissionController:
     """The process-wide admission gate the batched-lookup path shares —
-    one budget across every concurrent ``find_rows``, every file."""
+    one budget across every concurrent ``find_rows``, every file.
+    (Alias of :func:`read_admission`: since the unified budget there is
+    ONE gate for every read tier.)"""
     return _ADMISSION
+
+
+def read_admission() -> AdmissionController:
+    """The process-wide unified read gate: scan phase-1/2 decode spans,
+    streamed whole-chunk decodes, and batched lookups all admit through
+    this one FIFO bytes budget (``PARQUET_TPU_READ_BUDGET``)."""
+    return _ADMISSION
+
+
+def pool_debug() -> dict:
+    """Live shared-pool state for ``/debugz``: configured width, tasks
+    running now, and the dispatch queue depth (0s when the pool was
+    never built — nothing has fanned out yet)."""
+    with _LOCK:
+        pool = _POOL
+    queued = 0
+    if pool is not None:
+        try:
+            queued = pool._work_queue.qsize()
+        except (AttributeError, NotImplementedError):
+            queued = 0
+    return {"width": pool_width(), "built": pool is not None,
+            "active": _ACTIVE.value, "queued": queued}
 
 
 def available_cpus() -> int:

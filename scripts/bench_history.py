@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Bench trajectory tooling: aggregate the per-round ``BENCH_r*.json``
+artifacts into one ``BENCH_TRAJECTORY.json`` (config → ratio series), and
+guard the serving-path contract ratios against regression.
+
+Five rounds of bench artifacts sat side by side with no way to answer
+"did config 3's ratio move across rounds?" without opening every file.
+This script builds the series once and keeps it current:
+
+- ``python scripts/bench_history.py``                 — rebuild
+  ``BENCH_TRAJECTORY.json`` from every ``BENCH_r*.json`` in the repo
+  root: per config, the ``vs_pyarrow`` ratio and headline value by
+  round, plus first/last/best deltas.
+- ``--live detail.json``                              — additionally
+  fold one just-run bench detail doc (the stderr JSON ``bench.py``
+  prints, with per-config breakdowns) in as round ``"live"``.
+- ``--check``                                         — the regression
+  guard check.sh runs: fail (exit 1) if a contract ratio is below its
+  floor — cfg9's 0.1%-selectivity planner speedup (floor 1.2, the cfg9
+  contract since PR 6) or cfg10's lookup speedup-vs-naive (floor 2.0,
+  the cfg10 contract since PR 9).  Contract ratios come from the
+  ``--live`` detail when given, else from the trajectory's newest round
+  that carries them; a round with neither config passes vacuously
+  (nothing measured, nothing regressed).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# contract floors: (config, extractor over the detail doc's config dict,
+# floor).  These mirror the inline asserts in check.sh's bench smoke —
+# the trajectory guard makes them fail loudly on the AGGREGATE artifact
+# too, so a regression can't hide in a round that skipped the smoke.
+CONTRACTS = {
+    "9_planner": ("sweep 0.1% speedup",
+                  lambda cfg: cfg.get("sweep", {}).get("0.1%", {})
+                  .get("speedup"), 1.2),
+    "10_lookup": ("speedup_vs_naive",
+                  lambda cfg: cfg.get("speedup_vs_naive"), 2.0),
+}
+
+
+def load_rounds(root):
+    """{round_tag: {config: [value, ratio]}} from every BENCH_r*.json."""
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.match(r"BENCH_(r\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_history: skipping {path}: {e}", file=sys.stderr)
+            continue
+        parsed = doc.get("parsed", doc)
+        configs = parsed.get("configs")
+        if isinstance(configs, dict) and configs:
+            rounds[m.group(1)] = configs
+    return rounds
+
+
+def build_trajectory(rounds, live_detail=None):
+    tags = sorted(rounds)
+    if live_detail is not None:
+        tags = tags + ["live"]
+    configs = {}
+    for tag in sorted(rounds):
+        for name, pair in rounds[tag].items():
+            value, ratio = (pair + [None, None])[:2] \
+                if isinstance(pair, list) else (None, pair)
+            c = configs.setdefault(name, {"value": {}, "ratio": {}})
+            c["value"][tag] = value
+            c["ratio"][tag] = ratio
+    if live_detail is not None:
+        for name, cfg in live_detail.get("configs", {}).items():
+            if not isinstance(cfg, dict):
+                continue
+            c = configs.setdefault(name, {"value": {}, "ratio": {}})
+            c["value"]["live"] = cfg.get("GBps", cfg.get("read_GBps"))
+            c["ratio"]["live"] = cfg.get("vs_pyarrow")
+    contracts = {}
+    if live_detail is not None:
+        for name, (label, extract, floor) in CONTRACTS.items():
+            got = extract(live_detail.get("configs", {}).get(name, {}) or {})
+            if got is not None:
+                contracts[name] = {"metric": label,
+                                   "ratio": round(float(got), 3),
+                                   "floor": floor}
+    for name, c in configs.items():
+        series = [r for r in (c["ratio"].get(t) for t in tags)
+                  if r is not None]
+        if series:
+            c["first"] = series[0]
+            c["latest"] = series[-1]
+            c["best"] = max(series)
+    return {"rounds": tags, "configs": configs, "contracts": contracts,
+            "contract_floors": {k: v[2] for k, v in CONTRACTS.items()}}
+
+
+def check_floors(traj):
+    """The regression guard: every measured contract ratio >= its floor."""
+    failures = []
+    for name, rec in traj.get("contracts", {}).items():
+        if rec["ratio"] < rec["floor"]:
+            failures.append(f"{name} {rec['metric']} = {rec['ratio']} "
+                            f"< floor {rec['floor']}")
+    return failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="bench_history")
+    p.add_argument("--out", default=os.path.join(ROOT,
+                                                 "BENCH_TRAJECTORY.json"))
+    p.add_argument("--live", metavar="DETAIL_JSON", default=None,
+                   help="a bench.py stderr detail doc to fold in as the "
+                        "'live' round (and to source contract ratios)")
+    p.add_argument("--check", action="store_true",
+                   help="fail if a cfg9/cfg10 contract ratio is below its "
+                        "floor")
+    args = p.parse_args(argv)
+
+    live = None
+    if args.live:
+        with open(args.live) as f:
+            live = json.load(f)
+    rounds = load_rounds(ROOT)
+    traj = build_trajectory(rounds, live_detail=live)
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(traj, f, indent=1, sort_keys=True)
+    os.replace(args.out + ".tmp", args.out)
+    n_cfg = len(traj["configs"])
+    print(f"bench_history: {len(traj['rounds'])} round(s), {n_cfg} "
+          f"config(s) -> {os.path.basename(args.out)}")
+    for name, rec in sorted(traj.get("contracts", {}).items()):
+        print(f"  contract {name}: {rec['metric']} = {rec['ratio']} "
+              f"(floor {rec['floor']})")
+    if args.check:
+        failures = check_floors(traj)
+        if failures:
+            for msg in failures:
+                print(f"bench_history: REGRESSION: {msg}", file=sys.stderr)
+            return 1
+        print("bench_history: contract floors hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

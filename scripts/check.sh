@@ -622,8 +622,136 @@ print(f"point-lookup smoke ok: {cold.counters['preads']} preads for "
       f"{cold.counters['pages_read']} pages cold, hit ratio {ratio:.2f} "
       f"warm, p99={hist['p99']}s")
 LKEOF
+echo "=== resource-ledger smoke (accounts + /debugz + pressure + overhead) ==="
+python - <<'LEDGEREOF'
+# ISSUE 10: the resource ledger.  (1) every tier's account renders in
+# --prom and matches the caches' own residency; (2) /debugz serves the
+# per-account table + top cache entries + open-op table over HTTP and
+# via `stats --debugz`; (3) soft pressure deterministically shrinks the
+# LRU tiers and hard pressure flips /healthz; (4) warm-read overhead
+# with the ledger, budget, and watermarks all live stays <= 1.05x.
+import contextlib
+import io as _io
+import json
+import os
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import (ParquetFile, clear_caches, find_rows,
+                         ledger_snapshot, render_prometheus,
+                         start_metrics_server)
+from parquet_tpu.__main__ import main as cli_main
+from parquet_tpu.io.cache import FOOTERS, cache_stats
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.obs.ledger import LEDGER
+from parquet_tpu.obs.metrics import REGISTRY
+
+n = 60_000
+d = tempfile.mkdtemp(prefix="pq_ledger_smoke_")
+path = os.path.join(d, "ledger.parquet")
+rng = np.random.default_rng(4)
+t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64) // 3),
+              "v": pa.array(rng.random(n))})
+write_table(t, path, WriterOptions(row_group_size=n // 4,
+                                   data_page_size=8 * 1024,
+                                   bloom_filters={"k": 10}))
+clear_caches(reset_stats=True)
+pf = ParquetFile(path)
+pf.read()
+find_rows(pf, "k", [int(x) for x in rng.integers(0, n // 3, 16)] + [10**9],
+          columns=["v"])
+
+# (1) accounts == tier residency, and the gauge families render
+snap = ledger_snapshot()
+st = cache_stats()
+assert snap["accounts"]["cache.chunk"]["resident_bytes"] == st.chunk_bytes
+assert snap["accounts"]["cache.page"]["resident_bytes"] == st.page_bytes
+assert snap["accounts"]["cache.footer"]["resident_bytes"] == FOOTERS._bytes
+assert snap["total_bytes"] > 0 and snap["state"] == "ok"
+prom = render_prometheus()
+for fam in ('parquet_tpu_ledger_resident_bytes{account="cache.chunk"}',
+            'parquet_tpu_ledger_resident_bytes{account="cache.page"}',
+            'parquet_tpu_ledger_resident_bytes{account="write.pended"}',
+            "parquet_tpu_ledger_total_bytes",
+            "parquet_tpu_ledger_pressure_evictions_total",
+            "parquet_tpu_lookup_neg_hits_total",
+            "parquet_tpu_read_admission_waits_total"):
+    assert fam in prom, fam
+
+# (2) /debugz over HTTP + stats --debugz
+with start_metrics_server(0) as srv:
+    base = f"http://{srv.host}:{srv.port}"
+    doc = json.loads(urllib.request.urlopen(base + "/debugz",
+                                            timeout=5).read())
+    assert set(doc) == {"ledger", "caches", "admission", "pool", "ops"}
+    assert doc["caches"]["chunk"]["top"][0]["bytes"] > 0
+    assert doc["admission"]["budget_bytes"]["lookup"] == 64 << 20
+    assert urllib.request.urlopen(base + "/healthz",
+                                  timeout=5).read() == b"ok\n"
+out = _io.StringIO()
+with contextlib.redirect_stdout(out):
+    rc = cli_main(["stats", "--debugz"])
+assert rc == 0
+cli_doc = json.loads(out.getvalue())
+assert cli_doc["ledger"]["accounts"]["cache.chunk"]["resident_bytes"] > 0
+
+# (3) pressure determinism: soft shrinks, hard flips healthz
+resident = LEDGER.total()
+ev0 = REGISTRY.counter("ledger.pressure_evictions").value
+os.environ["PARQUET_TPU_MEM_SOFT"] = str(max(resident // 4, 1))
+LEDGER.check_pressure()
+evicted = REGISTRY.counter("ledger.pressure_evictions").value - ev0
+assert evicted > 0 and LEDGER.total() < resident, (evicted, resident)
+del os.environ["PARQUET_TPU_MEM_SOFT"]
+from parquet_tpu.obs.ledger import ledger_account
+
+ballast = ledger_account("write.pended")
+ballast.add(8 << 20)
+os.environ["PARQUET_TPU_MEM_HARD"] = str(1 << 20)
+with start_metrics_server(0) as srv:
+    got = urllib.request.urlopen(
+        f"http://{srv.host}:{srv.port}/healthz", timeout=5).read()
+assert got == b"hard\n", got
+ballast.sub(8 << 20)
+del os.environ["PARQUET_TPU_MEM_HARD"]
+
+# (4) overhead: warm read with ledger + budget + watermarks live
+pf.read()  # warm
+
+
+def timed(reps=7):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        pf.read()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+off = timed()
+os.environ["PARQUET_TPU_READ_BUDGET"] = str(1 << 30)
+os.environ["PARQUET_TPU_MEM_SOFT"] = str(1 << 40)
+os.environ["PARQUET_TPU_MEM_HARD"] = str(1 << 41)
+on = timed()
+for k in ("PARQUET_TPU_READ_BUDGET", "PARQUET_TPU_MEM_SOFT",
+          "PARQUET_TPU_MEM_HARD"):
+    del os.environ[k]
+assert on <= off * 1.05, \
+    f"ledger+budget+watermarks cost >5% on a warm read: " \
+    f"off={off:.4f}s on={on:.4f}s"
+pf.close()
+print(f"resource-ledger smoke ok: accounts exact, /debugz + --debugz, "
+      f"{evicted} pressure evictions, healthz hard, warm read "
+      f"off={off * 1e3:.1f}ms on={on * 1e3:.1f}ms")
+LEDGEREOF
+
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
-BENCH_QUICK=1 python bench.py 2>&1 | python -c "
+BENCH_OUT=$(mktemp -d)
+BENCH_QUICK=1 python bench.py 2>&1 | tee "$BENCH_OUT/raw.txt" | python -c "
 import json, sys
 # headline is stdout, the per-config detail JSON is stderr; stream merge
 # order is arbitrary, so select by content
@@ -667,4 +795,22 @@ for name, cfg in detail.get('configs', {}).items():
         assert cfg.get('p99_s') is not None, (name, cfg)
 print('bench smoke ok:', d['metric'], d['value'], d['unit'])
 "
+# bench trajectory: rebuild BENCH_TRAJECTORY.json from the per-round
+# artifacts + this quick run's detail doc, and fail if a cfg9/cfg10
+# contract ratio dropped below its floor (scripts/bench_history.py)
+python - "$BENCH_OUT/raw.txt" "$BENCH_OUT/detail.json" <<'TRAJEOF'
+import json, sys
+docs = []
+for ln in open(sys.argv[1]).read().splitlines():
+    if ln.strip().startswith("{"):
+        try:
+            docs.append(json.loads(ln))
+        except ValueError:
+            pass
+detail = next((x for x in docs if "detail" in x), None)
+assert detail is not None, "bench detail doc missing from output"
+json.dump(detail, open(sys.argv[2], "w"))
+TRAJEOF
+python scripts/bench_history.py --live "$BENCH_OUT/detail.json" --check
+rm -rf "$BENCH_OUT"
 echo "ALL CHECKS PASSED"

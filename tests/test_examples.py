@@ -20,6 +20,7 @@ EX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ("sorted_merge.py", []),
     ("telemetry.py", ["20000"]),
     ("serving_telemetry.py", ["20000"]),
+    ("memory_budget.py", ["20000"]),
     ("tpch_q1_tpu.py", ["50000"]),
 ])
 def test_example_runs(script, argv, tmp_path, monkeypatch, capsys):

@@ -1,0 +1,623 @@
+"""Resource ledger (obs/ledger.py) + unified read budget + /debugz:
+exact per-tier accounting under concurrent churn, pressure-watermark
+determinism (soft shrinks, hard blocks-then-unblocks), the unified
+scan+lookup bytes budget, write-overlap depth > 1, the negative-lookup
+memo, and the live-introspection endpoint schema."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import parquet_tpu as pq
+from parquet_tpu import Dataset, ParquetFile
+from parquet_tpu.io.cache import (CHUNKS, FOOTERS, NEGS, PAGES, cache_stats,
+                                  clear_caches)
+from parquet_tpu.io.lookup import find_rows
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.obs import start_metrics_server
+from parquet_tpu.obs.export import debugz_snapshot
+from parquet_tpu.obs.ledger import LEDGER, ledger_account, ledger_snapshot
+from parquet_tpu.obs.metrics import REGISTRY
+from parquet_tpu.utils.pool import read_admission
+
+N = 20_000
+RGS = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("PARQUET_TPU_MEM_SOFT", raising=False)
+    monkeypatch.delenv("PARQUET_TPU_MEM_HARD", raising=False)
+    monkeypatch.delenv("PARQUET_TPU_READ_BUDGET", raising=False)
+    clear_caches(reset_stats=True)
+    read_admission()._reset()
+    LEDGER.state()  # settle transitions before the case runs
+    yield
+    clear_caches(reset_stats=True)
+    read_admission()._reset()
+    LEDGER.state()
+
+
+def _write_corpus(tmp_path, name="ledger.parquet", n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    path = str(tmp_path / name)
+    t = pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64) // 3),
+        "v": pa.array(rng.random(n)),
+        "s": pa.array([f"s{i % 211:03d}" for i in range(n)]),
+    })
+    write_table(t, path, WriterOptions(row_group_size=n // RGS,
+                                       data_page_size=4096,
+                                       bloom_filters={"k": 10}))
+    return path
+
+
+def _tier_residency():
+    """The ground truth the ledger must match exactly: each tier's OWN
+    byte counters, read straight off the tier structures.  Includes the
+    trace buffer — earlier tests in a full-suite run may have left
+    traced events buffered, and the sum claim must stay exact."""
+    from parquet_tpu.obs import trace as _tr
+
+    st = cache_stats()
+    return {"cache.chunk": st.chunk_bytes,
+            "cache.page": st.page_bytes,
+            "cache.footer": FOOTERS._bytes,
+            "cache.neg_lookup": NEGS.resident_bytes,
+            "trace.buffer": len(_tr.trace_events()) * _tr._EVENT_EST_BYTES}
+
+
+def _accounts():
+    return ledger_snapshot()["accounts"]
+
+
+# ---------------------------------------------------------------------------
+# account exactness
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_predeclares_every_tier():
+    acc = _accounts()
+    for name in ("cache.chunk", "cache.page", "cache.footer",
+                 "cache.neg_lookup", "prefetch.ring", "prefetch.segments",
+                 "write.buffer", "write.pended", "admission.in_flight",
+                 "trace.buffer"):
+        assert name in acc, name
+        assert acc[name]["resident_bytes"] >= 0
+    # the gauge families exist in the registry (and therefore in --prom)
+    snap = pq.metrics_snapshot()["gauges"]
+    assert "ledger.resident_bytes{account=cache.chunk}" in snap
+    assert "ledger.total_bytes" in snap
+
+
+def test_cache_accounts_equal_tier_residency(tmp_path):
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    pf.read()  # chunk LRU + footer cache populate
+    find_rows(pf, "k", [3, 5, 10**9], columns=["v"])  # page cache + memo
+    acc = _accounts()
+    actual = _tier_residency()
+    for name, want in actual.items():
+        assert acc[name]["resident_bytes"] == want, (name, acc[name], want)
+    assert acc["cache.chunk"]["resident_bytes"] > 0
+    assert acc["cache.page"]["resident_bytes"] > 0
+    assert acc["cache.footer"]["resident_bytes"] > 0
+    # the snapshot total is the account sum
+    snap = ledger_snapshot()
+    assert snap["total_bytes"] == sum(a["resident_bytes"]
+                                      for a in snap["accounts"].values())
+    pf.close()
+
+
+def test_clear_caches_zeroes_ledger_accounts(tmp_path):
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    pf.read()
+    find_rows(pf, "k", [1, 10**9])
+    assert _accounts()["cache.chunk"]["resident_bytes"] > 0
+    clear_caches()
+    acc = _accounts()
+    for name in ("cache.chunk", "cache.page", "cache.footer",
+                 "cache.neg_lookup"):
+        assert acc[name]["resident_bytes"] == 0, (name, acc[name])
+    pf.close()
+
+
+def test_hammer_8_workers_exact_accounting(tmp_path, monkeypatch):
+    """The acceptance hammer: 8 workers churn read/scan/lookup/write
+    concurrently; afterward the ledger's account totals equal each
+    tier's actual residency EXACTLY, and every transient account
+    (prefetch, write buffers, admission) drained to zero."""
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")  # stage real ring
+    paths = [_write_corpus(tmp_path, f"h{i}.parquet", n=8000, seed=i)
+             for i in range(3)]
+    errors = []
+    stop = threading.Event()
+
+    def sampler():
+        # mid-flight: accounts never go negative and the snapshot stays
+        # internally consistent while 8 workers mutate every tier
+        while not stop.is_set():
+            snap = ledger_snapshot()
+            for name, a in snap["accounts"].items():
+                assert a["resident_bytes"] >= 0, (name, a)
+                assert a["high_water_bytes"] >= a["resident_bytes"]
+            time.sleep(0.005)
+
+    def churn(seed):
+        try:
+            r = np.random.default_rng(seed)
+            for i in range(12):
+                op = int(r.integers(0, 4))
+                path = paths[int(r.integers(0, len(paths)))]
+                if op == 0:
+                    ParquetFile(path).read()
+                elif op == 1:
+                    pf = ParquetFile(path)
+                    pq.scan_expr(pf, pq.col("k") <= int(r.integers(1, 900)),
+                                 columns=["v"])
+                elif op == 2:
+                    keys = [int(x) for x in r.integers(0, 3000, 16)]
+                    find_rows(ParquetFile(path), "k", keys, columns=["s"])
+                else:
+                    _write_corpus(tmp_path, f"w{seed}_{i}.parquet", n=2000,
+                                  seed=seed + i)
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    smp = threading.Thread(target=sampler)
+    smp.start()
+    workers = [threading.Thread(target=churn, args=(i,)) for i in range(8)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join(120)
+    stop.set()
+    smp.join(10)
+    assert not errors, errors
+    acc = _accounts()
+    actual = _tier_residency()
+    for name, want in actual.items():
+        assert acc[name]["resident_bytes"] == want, (name, acc[name], want)
+    # transient tiers drained: nothing is in flight once the ops returned
+    for name in ("prefetch.ring", "prefetch.segments", "write.buffer",
+                 "write.pended", "admission.in_flight"):
+        assert acc[name]["resident_bytes"] == 0, (name, acc[name])
+    # sum(ledger) == sum(actual tier residency): the transient tiers are
+    # 0 and every byte-holding tier matched exactly above
+    total = ledger_snapshot()["total_bytes"]
+    assert total == sum(actual.values()), (total, actual)
+
+
+def test_prefetch_ring_accounts_drain_after_streamed_read(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+    monkeypatch.setenv("PARQUET_TPU_CHUNK_CACHE", "0")  # force streaming IO
+    path = _write_corpus(tmp_path, n=40_000)
+    t = ParquetFile(path).read()
+    assert t.num_rows == 40_000
+    acc = _accounts()
+    assert acc["prefetch.ring"]["resident_bytes"] == 0
+    assert acc["prefetch.segments"]["resident_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# pressure watermarks
+# ---------------------------------------------------------------------------
+
+
+def test_soft_pressure_shrinks_lru_tiers(tmp_path, monkeypatch):
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    pf.read()
+    resident = LEDGER.total()
+    assert resident > 0
+    ev0 = REGISTRY.counter("ledger.pressure_evictions").value
+    soft0 = REGISTRY.counter("ledger.pressure_transitions",
+                             labels={"state": "soft"}).value
+    monkeypatch.setenv("PARQUET_TPU_MEM_SOFT", str(max(resident // 4, 1)))
+    state = LEDGER.check_pressure()
+    # deterministic: the reclaim loop evicted until under the watermark
+    assert LEDGER.total() < max(resident // 4, 1) or state == "ok"
+    assert REGISTRY.counter("ledger.pressure_evictions").value > ev0
+    assert REGISTRY.counter("ledger.pressure_transitions",
+                            labels={"state": "soft"}).value > soft0
+    assert cache_stats().chunk_bytes < resident
+    pf.close()
+
+
+def test_hard_pressure_blocks_admission_then_unblocks(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_MEM_HARD", str(1 << 20))
+    ballast = ledger_account("write.pended")
+    ballast.add(2 << 20)  # non-evictable: reclaim can't fix this
+    adm = read_admission()
+    admitted = threading.Event()
+
+    def try_admit():
+        with adm.admit(1024, tier="lookup"):
+            admitted.set()
+
+    t = threading.Thread(target=try_admit)
+    try:
+        assert LEDGER.state() == "hard"
+        t.start()
+        assert not admitted.wait(0.4), \
+            "admission proceeded while over the hard watermark"
+        ballast.sub(2 << 20)  # memory released -> below watermark
+        assert admitted.wait(5), "admission never unblocked"
+        t.join(5)
+        assert LEDGER.state() == "ok"
+    finally:
+        if not admitted.is_set():  # never leave the thread wedged
+            ballast.sub(2 << 20)
+            t.join(5)
+
+
+def test_healthz_reports_pressure_state(monkeypatch):
+    with start_metrics_server(0) as srv:
+        base = f"http://{srv.host}:{srv.port}"
+        assert urllib.request.urlopen(base + "/healthz",
+                                      timeout=5).read() == b"ok\n"
+        ballast = ledger_account("write.pended")
+        ballast.add(4 << 20)
+        try:
+            monkeypatch.setenv("PARQUET_TPU_MEM_HARD", str(1 << 20))
+            got = urllib.request.urlopen(base + "/healthz",
+                                         timeout=5).read()
+            assert got == b"hard\n", got
+            monkeypatch.setenv("PARQUET_TPU_MEM_HARD", str(1 << 30))
+            monkeypatch.setenv("PARQUET_TPU_MEM_SOFT", str(1 << 20))
+            got = urllib.request.urlopen(base + "/healthz",
+                                         timeout=5).read()
+            assert got == b"soft\n", got
+        finally:
+            ballast.sub(4 << 20)
+
+
+# ---------------------------------------------------------------------------
+# the unified read budget
+# ---------------------------------------------------------------------------
+
+
+def test_unified_budget_scan_plus_lookups_byte_identical(tmp_path,
+                                                         monkeypatch):
+    """Acceptance: a concurrent scan + lookup burst under
+    PARQUET_TPU_READ_BUDGET never exceeds the cap (high-water asserted)
+    and the results are byte-identical to the unbudgeted run."""
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    keys = [int(x) for x in np.random.default_rng(7).integers(0, 3000, 64)]
+    want_scan = pq.scan_expr(pf, pq.col("k") <= 1500, columns=["v", "s"])
+    want_rows = [list(h.rows) for h in find_rows(pf, "k", keys)]
+    clear_caches(reset_stats=True)
+
+    budget = 192 * 1024
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", str(budget))
+    adm = read_admission()
+    adm._reset()
+    results, errors = {}, []
+
+    def scan_side():
+        try:
+            results["scan"] = pq.scan_expr(pf, pq.col("k") <= 1500,
+                                           columns=["v", "s"])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def lookup_side(i):
+        try:
+            results[f"lk{i}"] = find_rows(pf, "k", keys)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=scan_side)]
+    threads += [threading.Thread(target=lookup_side, args=(i,))
+                for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    assert adm.high_water <= budget, (adm.high_water, budget)
+    assert adm.high_water > 0  # the gate actually saw the spans
+    got = results["scan"]
+    assert np.array_equal(np.asarray(got["v"]), np.asarray(want_scan["v"]))
+    assert list(got["s"]) == list(want_scan["s"])
+    for i in range(6):
+        assert [list(h.rows) for h in results[f"lk{i}"]] == want_rows
+    pf.close()
+
+
+def test_lookup_env_stays_an_alias(monkeypatch):
+    adm = read_admission()
+    # PR-9 default: lookups 64 MiB, scans off
+    assert adm.budget_bytes("lookup") == 64 << 20
+    assert adm.budget_bytes("scan") == 0
+    # the unified env governs every tier
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", "1000000")
+    assert adm.budget_bytes("lookup") == 1000000
+    assert adm.budget_bytes("scan") == 1000000
+    # sub-budgets clamp their tier inside it
+    monkeypatch.setenv("PARQUET_TPU_LOOKUP_BUDGET", "500")
+    monkeypatch.setenv("PARQUET_TPU_SCAN_BUDGET", "700")
+    assert adm.budget_bytes("lookup") == 500
+    assert adm.budget_bytes("scan") == 700
+    # READ_BUDGET=0 switches the whole gate off, aliases included
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", "0")
+    assert adm.budget_bytes("lookup") == 0
+    assert adm.budget_bytes("scan") == 0
+
+
+def test_nested_admission_passes_through(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", "1000")
+    adm = read_admission()
+    with adm.admit(800, tier="scan") as g1:
+        assert g1 == 800
+        # same context: the inner gate must not deadlock behind itself
+        with adm.admit(900, tier="scan") as g2:
+            assert g2 == 0
+
+
+def test_scan_admission_holds_tiny_budget(tmp_path, monkeypatch):
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    want = pq.scan_expr(pf, pq.col("k") <= 1500, columns=["v"])
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", "4096")  # tiny budget
+    clear_caches()  # cold decode spans through the gate
+    adm = read_admission()
+    adm._reset()
+    got = pq.scan_expr(pf, pq.col("k") <= 1500, columns=["v"])
+    # spans clamp to the whole 4 KiB budget and admit alone: the cap is
+    # never exceeded, and the result is identical to the unbudgeted run
+    assert 0 < adm.high_water <= 4096
+    assert np.array_equal(np.asarray(got["v"]), np.asarray(want["v"]))
+    pf.close()
+
+
+# ---------------------------------------------------------------------------
+# write-overlap depth > 1
+# ---------------------------------------------------------------------------
+
+
+class _ThrottledSink:
+    """File-like sink that sleeps per write — the slow-sink shape the
+    pended queue exists for."""
+
+    def __init__(self, delay=0.002):
+        import io as _io
+
+        self.buf = _io.BytesIO()
+        self.delay = delay
+
+    def write(self, b):
+        time.sleep(self.delay)
+        return self.buf.write(b)
+
+    def writelines(self, parts):
+        time.sleep(self.delay)
+        self.buf.writelines(parts)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _table(n=40_000):
+    rng = np.random.default_rng(5)
+    return pa.table({"x": pa.array(np.arange(n, dtype=np.int64)),
+                     "y": pa.array(rng.random(n)),
+                     "s": pa.array([f"r{i % 89}" for i in range(n)])})
+
+
+def test_write_depth_byte_identity(monkeypatch):
+    import io as _io
+
+    t = _table()
+    outs = {}
+    for depth, overlap in ((1, "0"), (1, "force"), (2, "0"), (2, "force"),
+                           (3, "force")):
+        monkeypatch.setenv("PARQUET_TPU_WRITE_DEPTH", str(depth))
+        monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", overlap)
+        buf = _io.BytesIO()
+        write_table(t, buf, WriterOptions(row_group_size=5000))
+        outs[(depth, overlap)] = buf.getvalue()
+    base = outs[(1, "0")]
+    for k, v in outs.items():
+        assert v == base, (k, len(v), len(base))
+    # and the pended account drained
+    assert _accounts()["write.pended"]["resident_bytes"] == 0
+
+
+def test_write_depth_pends_on_slow_sink(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_WRITE_DEPTH", "2")
+    monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", "force")
+    acct = ledger_account("write.pended")
+    acct._reset()  # high_water is process-lifetime; isolate this case
+    hw0 = acct.high_water
+    t = _table(20_000)
+    sink = _ThrottledSink()
+    write_table(t, sink, WriterOptions(row_group_size=2500))
+    raw = sink.buf.getvalue()
+    got = ParquetFile(raw).read()
+    assert got.num_rows == 20_000
+    assert acct.resident == 0
+    assert acct.high_water > hw0  # groups really queued behind the sink
+
+
+def test_write_depth_abort_releases_pended_bytes(monkeypatch):
+    """A writer torn down with groups still queued (abort, failed close)
+    must release every pended group's ledger bytes — a leaked
+    write.pended balance would fake memory pressure forever."""
+    from parquet_tpu.io.writer import (ParquetWriter, columns_from_arrow,
+                                       schema_from_arrow)
+
+    monkeypatch.setenv("PARQUET_TPU_WRITE_DEPTH", "3")
+    monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", "0")
+    t = _table(8000)
+    schema = schema_from_arrow(t.schema)
+    sink = _ThrottledSink(delay=0.05)  # slow enough that groups queue
+    w = ParquetWriter(sink, schema)
+    data = columns_from_arrow(t, schema)
+    for _ in range(3):
+        w.write_row_group(data, 8000)
+    w.abort()
+    assert _accounts()["write.pended"]["resident_bytes"] == 0
+    assert not w._pend_q  # queue fully swept
+
+
+def test_write_depth_crash_matrix(tmp_path, monkeypatch):
+    from parquet_tpu.io.faults import crash_consistency_check
+
+    monkeypatch.setenv("PARQUET_TPU_WRITE_DEPTH", "2")
+    monkeypatch.setenv("PARQUET_TPU_WRITE_OVERLAP", "force")
+    t = _table(8000)
+    dest = str(tmp_path / "crash_depth2.parquet")
+    opts = WriterOptions(row_group_size=1000)
+    res = crash_consistency_check(lambda sink: write_table(t, sink, opts),
+                                  dest, samples=8, seed=9, buffered=True)
+    assert [r["outcome"] for r in res[:-1]] == ["absent"] * (len(res) - 1)
+    assert res[-1]["outcome"] == "clean"
+    assert _accounts()["write.pended"]["resident_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# negative-lookup memo
+# ---------------------------------------------------------------------------
+
+
+def test_neg_lookup_memo_skips_repeat_misses(tmp_path):
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    missing = [10**9 + i for i in range(8)]
+    present = [3, 6, 9]
+    first = find_rows(pf, "k", missing + present, columns=["v"])
+    assert first.counters["neg_hits"] == 0
+    b0 = REGISTRY.counter("planner.bloom_probes").value  # unrelated; keep 0
+    again = find_rows(pf, "k", missing + present, columns=["v"])
+    # the repeat skips the whole cascade for every (key, row group) pair
+    # proven absent: the 8 missing keys in all 4 row groups, plus the 3
+    # present keys (k = 3/6/9 live only in row group 0 — keys are i//3,
+    # so each row group spans a disjoint key range) in the other 3
+    assert again.counters["neg_hits"] == \
+        len(missing) * RGS + len(present) * (RGS - 1)
+    assert REGISTRY.counter("lookup.neg_hits").value >= len(missing) * RGS
+    for h1, h2 in zip(first, again):
+        assert list(h1.rows) == list(h2.rows)
+    assert again[len(missing)].num_rows > 0
+    assert _accounts()["cache.neg_lookup"]["resident_bytes"] > 0
+    assert REGISTRY.counter("planner.bloom_probes").value == b0
+    pf.close()
+
+
+def test_neg_lookup_memo_present_keys_never_memoized(tmp_path):
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    present = [30, 60]
+    find_rows(pf, "k", present)
+    res = find_rows(pf, "k", present)
+    assert all(h.num_rows > 0 for h in res)
+    pf.close()
+
+
+def test_neg_lookup_memo_invalidated_on_rewrite(tmp_path):
+    path = _write_corpus(tmp_path, n=6000, seed=1)
+    pf = ParquetFile(path)
+    key = 5999  # absent: max k is 5999//3
+    assert find_rows(pf, "k", [key])[0].num_rows == 0
+    assert NEGS.resident_bytes > 0
+    pf.close()
+    # rewrite with the key present; the fresh fstat identity must miss
+    # the stale memo
+    t = pa.table({"k": pa.array(np.full(100, key, np.int64)),
+                  "v": pa.array(np.zeros(100)),
+                  "s": pa.array(["x"] * 100)})
+    write_table(t, path, WriterOptions(row_group_size=100,
+                                       bloom_filters={"k": 10}))
+    pf2 = ParquetFile(path)
+    assert find_rows(pf2, "k", [key])[0].num_rows == 100
+    pf2.close()
+
+
+def test_neg_lookup_cap_and_disable(tmp_path, monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_NEG_LOOKUP", "0")
+    path = _write_corpus(tmp_path, n=4000, seed=2)
+    pf = ParquetFile(path)
+    find_rows(pf, "k", [10**9])
+    assert NEGS.resident_bytes == 0  # disabled: nothing memoized
+    again = find_rows(pf, "k", [10**9])
+    assert again.counters["neg_hits"] == 0
+    pf.close()
+
+
+# ---------------------------------------------------------------------------
+# /debugz
+# ---------------------------------------------------------------------------
+
+
+def test_debugz_schema_and_endpoint(tmp_path):
+    path = _write_corpus(tmp_path)
+    pf = ParquetFile(path)
+    pf.read()
+    find_rows(pf, "k", [3, 10**9], columns=["v"])
+    snap = debugz_snapshot()
+    assert set(snap) == {"ledger", "caches", "admission", "pool", "ops"}
+    led = snap["ledger"]
+    assert led["state"] in ("ok", "soft", "hard")
+    assert led["total_bytes"] == sum(a["resident_bytes"]
+                                     for a in led["accounts"].values())
+    for tier in ("chunk", "page", "footer", "neg_lookup"):
+        assert tier in snap["caches"], tier
+    top = snap["caches"]["chunk"]["top"]
+    assert top and top[0]["bytes"] > 0 and path in top[0]["key"][0]
+    assert top == sorted(top, key=lambda e: -e["bytes"])
+    adm = snap["admission"]
+    assert {"in_flight_bytes", "queue_depth", "waits", "high_water_bytes",
+            "budget_bytes"} <= set(adm)
+    assert snap["pool"]["width"] >= 1
+    assert isinstance(snap["ops"], list)  # no op open right now
+    # over HTTP, with an op held open: the op table shows it with an age
+    with pq.op_scope("debugz.probe", test=1):
+        time.sleep(0.01)
+        with start_metrics_server(0) as srv:
+            url = f"http://{srv.host}:{srv.port}/debugz"
+            doc = json.loads(urllib.request.urlopen(url, timeout=5).read())
+            names = [o["name"] for o in doc["ops"]]
+            assert "debugz.probe" in names
+            mine = next(o for o in doc["ops"]
+                        if o["name"] == "debugz.probe")
+            assert mine["age_s"] > 0
+    assert all(o["name"] != "debugz.probe" for o in debugz_snapshot()["ops"])
+    pf.close()
+
+
+def test_stats_debugz_cli(tmp_path, capsys):
+    from parquet_tpu.__main__ import main as cli_main
+
+    path = _write_corpus(tmp_path, n=4000, seed=3)
+    ParquetFile(path).read()
+    rc = cli_main(["stats", "--debugz"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "ledger" in doc and "cache.chunk" in doc["ledger"]["accounts"]
+    assert doc["ledger"]["accounts"]["cache.chunk"]["resident_bytes"] > 0
+
+
+def test_prom_renders_ledger_families(tmp_path):
+    path = _write_corpus(tmp_path, n=4000, seed=4)
+    ParquetFile(path).read()
+    text = pq.render_prometheus()
+    assert 'parquet_tpu_ledger_resident_bytes{account="cache.chunk"}' in text
+    assert "parquet_tpu_ledger_total_bytes" in text
+    assert "parquet_tpu_ledger_pressure_evictions_total" in text
+    assert 'parquet_tpu_ledger_pressure_transitions_total{state="soft"}' \
+        in text
+    assert "parquet_tpu_lookup_neg_hits_total" in text
+    assert "parquet_tpu_read_admission_waits_total" in text
