@@ -1,0 +1,117 @@
+"""Remote sources with a full fault envelope (ISSUE 11): HttpSource over
+range requests, composed with the whole read stack.
+
+Every real serving fleet reads from an object store, not local disk.
+This example runs hermetically against the in-process range server
+(``LocalRangeServer`` — loopback only, no network) and shows:
+
+1. **URL opens** — ``ParquetFile("http://...")`` resolves to an
+   :class:`HttpSource` (persistent per-host connection pool, HEAD
+   validators as the cache identity) and reads byte-identically to the
+   local file; the warm re-open serves footers and chunks from the
+   shared caches with ZERO extra network requests.
+2. **the fault envelope** — a seeded chaos transport injects connection
+   refusals and 503 bursts; a :class:`FaultPolicy` recovers
+   byte-identically, with retries accounted in the :class:`ReadReport`.
+3. **hedged reads** — a stall-injecting transport stalls every range's
+   first attempt; the hedged second attempt wins the race and the read
+   comes back in a fraction of the stall.
+4. **the meters** — ``remote.*`` counters (preads, bytes, retries by
+   class, hedges issued/won, breaker transitions) straight out of
+   ``metrics_snapshot()``, same families ``stats --prom`` and
+   ``/debugz`` export.
+
+Run: python examples/remote_read.py [rows]
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from parquet_tpu import (FaultInjectingRemoteTransport, FaultPolicy,
+                         LocalRangeServer, ParquetFile, ReadReport,
+                         write_table)
+from parquet_tpu.io.remote import HttpSource, HttpTransport, remote_debug
+
+
+def main() -> None:
+    import pyarrow as pa
+
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    rng = np.random.default_rng(7)
+    table = pa.table({
+        "ts": pa.array(np.arange(rows, dtype=np.int64)),
+        "value": pa.array(rng.standard_normal(rows)),
+    })
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "events.parquet")
+        write_table(table, path)
+        raw = open(path, "rb").read()
+        local = ParquetFile(path).read()
+
+        with LocalRangeServer({"events.parquet": raw}) as srv:
+            url = srv.url("events.parquet")
+
+            # -- 1: cold URL read, byte-identical to the local file
+            t0 = time.perf_counter()
+            remote = ParquetFile(url).read()
+            cold_s = time.perf_counter() - t0
+            assert remote.to_arrow().equals(local.to_arrow())
+            cold_gets = srv.request_count(method="GET")
+            print(f"cold remote read: {rows} rows in {cold_s*1e3:.1f} ms "
+                  f"({cold_gets} range GETs), byte-identical to local")
+
+            # -- warm re-open: footer + chunks from the shared caches
+            t0 = time.perf_counter()
+            again = ParquetFile(url).read()
+            warm_s = time.perf_counter() - t0
+            assert again.to_arrow().equals(local.to_arrow())
+            warm_gets = srv.request_count(method="GET") - cold_gets
+            print(f"warm remote read: {warm_s*1e3:.1f} ms, "
+                  f"{warm_gets} extra GETs (caches keyed on ETag)")
+
+            # -- 2: chaos — refusals + 503 bursts recover byte-identically
+            chaos = FaultInjectingRemoteTransport(
+                HttpTransport(url), seed=3, refuse_rate=0.2,
+                status_rate=0.1, max_consecutive=2)
+            rep = ReadReport()
+            got = ParquetFile(
+                HttpSource(url, transport=chaos),
+                policy=FaultPolicy(max_retries=4, backoff_s=0.01),
+            ).read(report=rep)
+            assert got.to_arrow().equals(local.to_arrow())
+            print(f"chaos read: {chaos.stats.refused} refusals + "
+                  f"{chaos.stats.statuses} 503s injected, "
+                  f"{rep.retries} retries accounted, byte-identical")
+
+            # -- 3: hedged reads cut the stall tail
+            os.environ["PARQUET_TPU_REMOTE_HEDGE"] = "0.02"
+            try:
+                stall = FaultInjectingRemoteTransport(
+                    HttpTransport(url), stall_s=0.4, stall_attempts=1)
+                src = HttpSource(url, transport=stall)
+                t0 = time.perf_counter()
+                src.pread(0, 8192)
+                hedged_s = time.perf_counter() - t0
+                print(f"hedged pread under a 400 ms stall: "
+                      f"{hedged_s*1e3:.1f} ms (hedge won the race)")
+            finally:
+                os.environ.pop("PARQUET_TPU_REMOTE_HEDGE", None)
+
+        # -- 4: the meters
+        from parquet_tpu import metrics_snapshot
+
+        c = metrics_snapshot()["counters"]
+        print("remote meters:",
+              {k: v for k, v in sorted(c.items())
+               if k.startswith("remote.") and v})
+        print("remote debug:", remote_debug())
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,20 @@ Rows
   write_rows/read_rows — record-at-a-time nested transport
 Resilience
   FaultPolicy (retry/backoff+jitter, deadline, degraded-scan mode),
-  ReadReport, ReadError/ReadIOError/DeadlineError (located failures),
-  FaultInjectingSource (deterministic chaos wrapper), RetryingSource
+  ReadReport, ReadError/ReadIOError/DeadlineError/ShortReadError (located
+  failures), FaultInjectingSource (deterministic chaos wrapper),
+  RetryingSource
+Remote sources
+  HttpSource/ObjectStoreSource (``ParquetFile("https://...")`` — range
+  requests over a persistent per-host connection pool; composes with
+  prefetch/planner/lookup/caches/budgets), RemoteError hierarchy
+  (retryable vs terminal classification the shared retry loop consults),
+  hedged reads (adaptive p95 delay, ``PARQUET_TPU_REMOTE_HEDGE``,
+  budget-gated + ``remote.hedge_in_flight`` ledger account), per-host
+  CircuitBreaker (``PARQUET_TPU_REMOTE_BREAKER``[_COOLDOWN], metered
+  transitions, fail-fast into the retry/degrade path),
+  FaultInjectingRemoteTransport + LocalRangeServer (hermetic network
+  chaos harness)
 Read pipeline
   PrefetchSource (ring/advise readahead over any Source), ReadStats
   (prefetch hits/misses, bytes, pool wait — ``Table.read_stats``),
@@ -88,10 +100,15 @@ Observability
 """
 
 from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
-                     WriteError)
-from .io.faults import (FaultInjectingSink, FaultInjectingSource, FaultPolicy,
-                        InjectedWriterCrash, PolicySource, ReadReport,
-                        SinkFaultStats, crash_consistency_check)
+                     RemoteCircuitOpenError, RemoteError, RemoteTerminalError,
+                     RemoteThrottledError, RemoteTransientError,
+                     ShortReadError, WriteError)
+from .io.faults import (FaultInjectingRemoteTransport, FaultInjectingSink,
+                        FaultInjectingSource, FaultPolicy,
+                        InjectedWriterCrash, LocalRangeServer, PolicySource,
+                        ReadReport, SinkFaultStats, crash_consistency_check)
+from .io.remote import (CircuitBreaker, HttpSource, HttpTransport,
+                        ObjectStoreSource)
 from .io.integrity import IntegrityIssue, IntegrityReport, verify_file
 from .io.sink import (AtomicFileSink, BufferedSink, FileSink, Sink,
                       WriteStats)

@@ -77,6 +77,14 @@ def expand_paths(paths_or_glob, missing: Optional[list] = None) -> List[str]:
     out: List[str] = []
     seen = set()
     for item in items:
+        # remote URLs pass through literally: there is no filesystem to
+        # glob against, and lexists() on "http://..." is always False —
+        # an object-store listing layer can expand patterns upstream
+        if "://" in item:
+            if item not in seen:
+                seen.add(item)
+                out.append(item)
+            continue
         # a path that literally exists is never treated as a pattern, even
         # when its name contains glob metacharacters ("part[1].parquet")
         if _GLOB_CHARS & set(item) and not os.path.lexists(item):

@@ -63,6 +63,89 @@ class DeadlineError(ReadError, TimeoutError):
     retry sleep (a truly hung syscall cannot be interrupted from Python)."""
 
 
+class ShortReadError(ReadIOError):
+    """A byte source returned fewer bytes than asked — local truncation
+    (torn file, buggy FUSE layer) and remote truncation (partial object,
+    dropped connection mid-body) routed through ONE class, so
+    :class:`~parquet_tpu.io.faults.FaultPolicy` classification treats them
+    uniformly: a short read is corruption, never transience — it is raised
+    loud instead of retried (retrying truncated bytes just re-reads the
+    truncation).  Raised by every terminal :class:`~parquet_tpu.io.source.
+    Source` and by the fault injectors' truncation modes; location context
+    (file/row-group/column) is lifted on by ``read_context`` when the
+    source-level raise had none."""
+
+
+class RemoteError(ReadIOError):
+    """A remote byte-source failure with network context: host, HTTP
+    status, attempt ordinal, and the byte range being fetched — the remote
+    mirror of :class:`ReadError`'s locatability rule (an object-store
+    failure must be diagnosable from the message alone).  ``retryable``
+    is the classification every retry loop consults through
+    :func:`~parquet_tpu.io.faults.is_corrupt_oserror`: transient transport
+    failures (connect refused/reset, 5xx, 429, truncated body, stall) are
+    retried under :class:`~parquet_tpu.io.faults.FaultPolicy` backoff;
+    terminal responses (other 4xx, range-not-satisfiable, wrong-range /
+    length mismatches that persist) surface immediately and flow into the
+    ``on_corrupt='skip_row_group'`` degraded path like any corruption."""
+
+    retryable = False
+
+    def __init__(self, message: str, host=None, status=None, attempt=None,
+                 offset=None, size=None, path=None):
+        loc = []
+        if host is not None:
+            loc.append(f"host={host}")
+        if status is not None:
+            loc.append(f"status={status}")
+        if attempt is not None:
+            loc.append(f"attempt={attempt}")
+        if offset is not None and size is not None:
+            loc.append(f"range={offset}+{size}")
+        msg = f"{message} [{' '.join(loc)}]" if loc else message
+        ReadError.__init__(self, msg, path=path)
+        self.host = host
+        self.status = status
+        self.attempt = attempt
+        self.offset = offset
+        self.size = size
+
+
+class RemoteTransientError(RemoteError):
+    """Retryable remote failure: connect refused/reset, 5xx, a stalled or
+    truncated body, a transiently wrong range.  The retry loop backs off
+    and re-fetches; exhausted retries surface this error into the
+    degrade-or-raise path."""
+
+    retryable = True
+
+
+class RemoteThrottledError(RemoteTransientError):
+    """HTTP 429: retryable, and the server's ``Retry-After`` (seconds) is
+    honored — the shared retry loop sleeps at least this long before the
+    next attempt (still bounded by the operation deadline)."""
+
+    def __init__(self, message: str, retry_after=None, **kw):
+        super().__init__(message, **kw)
+        self.retry_after = retry_after
+
+
+class RemoteTerminalError(RemoteError):
+    """Non-retryable remote response: 4xx, range-not-satisfiable — a
+    stable condition a retry cannot fix.  Classified like corruption, so
+    degraded reads (``on_corrupt='skip_row_group'``) drop the affected
+    row group / file instead of dying."""
+
+
+class RemoteCircuitOpenError(RemoteTransientError):
+    """Fail-fast refusal from an OPEN per-host circuit breaker
+    (:class:`~parquet_tpu.io.remote.CircuitBreaker`): the host's recent
+    consecutive failures crossed the threshold, so requests are refused
+    without touching the network until the cooldown's half-open probe
+    succeeds.  Retryable by design — a policy retry's backoff is exactly
+    the pause the breaker wants, and the half-open probe rides it."""
+
+
 class WriteError(OSError):
     """A write-stack failure with destination context: the target path and,
     for atomic sinks, the temp file the bytes actually live in — the

@@ -747,8 +747,10 @@ def invalidate_path(path: str) -> None:
     IN-PLACE same-size rewrite (non-atomic ``FileSink``) on a coarse-mtime
     filesystem can land inside one clock tick with the same inode;
     explicit invalidation on commit closes that hole for writes made
-    through this library."""
-    ap = os.path.abspath(path)
+    through this library.  Remote URLs are their own identity — the
+    HEAD-validator bookkeeping (io/remote.py) calls here when an
+    object's ETag/Last-Modified moved; abspath would mangle them."""
+    ap = path if "://" in path else os.path.abspath(path)
     with FOOTERS._lock:
         for key in [k for k in FOOTERS._entries if k[0] == ap]:
             _, nb = FOOTERS._entries.pop(key)

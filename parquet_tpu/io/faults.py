@@ -21,6 +21,7 @@ Three pieces, threaded through the whole read stack
 
 from __future__ import annotations
 
+import contextvars
 import errno
 import random
 import threading
@@ -29,7 +30,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..errors import CorruptedError, DeadlineError, ReadError, ReadIOError
+from ..errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
+                      RemoteError, ShortReadError)
 from ..obs.metrics import counter as _counter
 from ..obs.scope import account as _account
 from .source import Source
@@ -44,7 +46,9 @@ _M_FILES_SKIPPED = _counter("read.files_skipped")
 __all__ = ["FaultPolicy", "ReadReport", "Deadline", "PolicySource",
            "FaultInjectingSource", "read_context", "resolve_policy",
            "FaultInjectingSink", "InjectedWriterCrash", "SinkFaultStats",
-           "crash_consistency_check"]
+           "crash_consistency_check", "retry_call", "active_deadline",
+           "FaultInjectingRemoteTransport", "RemoteFaultStats",
+           "LocalRangeServer"]
 
 
 # ---------------------------------------------------------------------------
@@ -239,11 +243,88 @@ NON_DATA_ERRORS: Tuple[type, ...] = (ImportError, MemoryError,
 
 
 def is_corrupt_oserror(e: OSError) -> bool:
-    """Short/invalid reads are corruption, not transience — the single
-    classifier both retry loops (PolicySource, RetryingSource) consult so
-    the decision can't drift between them."""
+    """Short/invalid reads and terminal remote responses are corruption,
+    not transience — the single classifier the one retry loop
+    (:func:`retry_call`, shared by PolicySource and RetryingSource)
+    consults so the decision can't drift between local and remote
+    sources.  Typed errors decide by class (:class:`ShortReadError`,
+    :class:`RemoteError`.retryable); the string match stays as the
+    fallback for bare ``IOError`` raisers outside this package."""
+    if isinstance(e, RemoteError):
+        return not e.retryable
+    if isinstance(e, ShortReadError):
+        return True
     s = str(e)
     return "short read" in s or "invalid read" in s
+
+
+# the deadline of the innermost active PolicySource operation, visible to
+# layers BELOW the policy wrapper (HttpSource's hedged-wait loop cannot
+# walk UP the source chain to find the clock the way PrefetchSource walks
+# down).  A context variable, so pool workers dispatched inside the
+# operation inherit it through instrument_task's context copy.
+_ACTIVE_DEADLINE: "contextvars.ContextVar[Optional[Deadline]]" = \
+    contextvars.ContextVar("parquet_tpu_active_deadline", default=None)
+
+
+def active_deadline() -> "Optional[Deadline]":
+    """The innermost active operation deadline in this context (None when
+    no policy operation is running, or its policy has no ``deadline_s``).
+    Consulted by waits that happen BELOW the policy wrapper — the hedged
+    remote read's first-wins loop — so a stalled primary attempt still
+    honors ``deadline_s`` promptly."""
+    dl = _ACTIVE_DEADLINE.get()
+    return dl if dl is not None and dl._expires is not None else None
+
+
+def retry_call(fn, offset: int, size: int, policy: "FaultPolicy",
+               deadline: "Optional[Deadline]" = None, on_retry=None):
+    """THE retry loop: transient ``OSError``\\ s re-attempt under the
+    policy's jittered backoff, corruption (short reads, terminal remote
+    responses — :func:`is_corrupt_oserror`) stays loud, a 429's
+    ``Retry-After`` stretches the next delay, and the deadline is checked
+    before each attempt and each sleep (a sleep the budget provably can't
+    cover fails now instead of burning the remainder first).  Shared by
+    :class:`PolicySource` (deadline + per-op accounting via ``on_retry``)
+    and :class:`~parquet_tpu.io.source.RetryingSource` (bare-source
+    callers) so local and remote retries classify and account
+    identically."""
+    delays = policy.delays()
+    while True:
+        if deadline is not None:
+            deadline.check(f"pread({offset}, {size})")
+        try:
+            return fn(offset, size)
+        except DeadlineError:
+            # a deadline that fired BELOW the policy (the hedged remote
+            # wait loop) is the operation's own clock, not transience —
+            # and TimeoutError is an OSError since 3.10, so without this
+            # guard it would be "retried" into a context-free re-raise
+            raise
+        except OSError as e:
+            if is_corrupt_oserror(e):
+                raise  # corruption stays loud, never retried
+            delay = next(delays, None)
+            if delay is None:
+                raise
+            ra = getattr(e, "retry_after", None)
+            if ra:
+                # the server named its own backoff: honor it (never
+                # shorter than it asked, still deadline-bounded below)
+                delay = max(delay, float(ra))
+            if deadline is not None:
+                rem = deadline.remaining()
+                if rem is not None and delay >= rem:
+                    # the budget can't cover the backoff: the retry is
+                    # provably never attempted — fail now, don't sleep
+                    # the remaining budget first
+                    raise DeadlineError(
+                        "deadline exceeded during retry backoff for "
+                        f"pread({offset}, {size})") from e
+            if on_retry is not None:
+                on_retry()
+            if delay > 0:
+                time.sleep(delay)
 
 
 @contextmanager
@@ -259,6 +340,17 @@ def read_context(path=None, row_group=None, column=None, page_offset=None,
     so its routing ``ValueError``\\ s stay catchable by type)."""
     try:
         yield
+    except ShortReadError as e:
+        # terminal sources raise ShortReadError with no location (they
+        # know offsets, not row groups): lift the read-site context on,
+        # same treatment the bare "short read" IOError used to get
+        if e.path is not None or path is None:
+            raise
+        raise ShortReadError(str(e), path=path, row_group=row_group,
+                             column=column,
+                             page_offset=(e.page_offset
+                                          if e.page_offset is not None
+                                          else page_offset)) from e
     except ReadError:
         raise
     except NON_DATA_ERRORS:
@@ -321,11 +413,20 @@ class PolicySource(Source):
         interleaved operations must not absorb each other's retries."""
         dl = Deadline(self.policy.deadline_s)
         self._deadline_stack.append(dl)
+        # publish the clock to layers BELOW the wrapper too (the hedged
+        # remote read's wait loop) — context-scoped, so pool workers
+        # dispatched inside this operation inherit it
+        tok = _ACTIVE_DEADLINE.set(dl)
         with self._lock:
             self._op_retries[id(dl)] = 0
         try:
             yield dl
         finally:
+            try:
+                _ACTIVE_DEADLINE.reset(tok)
+            except ValueError:
+                pass  # generator closed from another context: the var is
+                # context-local there, nothing to restore
             st = self._deadline_stack
             if dl in st:
                 st.remove(dl)
@@ -336,35 +437,16 @@ class PolicySource(Source):
 
     def _call(self, fn, offset: int, size: int):
         dl = self._deadline
-        pol = self.policy
-        delays = pol.delays()
-        while True:
-            if dl is not None:
-                dl.check(f"pread({offset}, {size})")
-            try:
-                return fn(offset, size)
-            except OSError as e:
-                if is_corrupt_oserror(e):
-                    raise  # corruption stays loud, never retried
-                delay = next(delays, None)
-                if delay is None:
-                    raise
-                if dl is not None:
-                    rem = dl.remaining()
-                    if rem is not None and delay >= rem:
-                        # the budget can't cover the backoff: the retry is
-                        # provably never attempted — fail now, don't sleep
-                        # the remaining budget first
-                        raise DeadlineError(
-                            "deadline exceeded during retry backoff for "
-                            f"pread({offset}, {size})") from e
-                with self._lock:
-                    self.retries_performed += 1
-                    if dl is not None and id(dl) in self._op_retries:
-                        self._op_retries[id(dl)] += 1
-                _account(_M_RETRIES)
-                if delay > 0:
-                    time.sleep(delay)
+
+        def on_retry():
+            with self._lock:
+                self.retries_performed += 1
+                if dl is not None and id(dl) in self._op_retries:
+                    self._op_retries[id(dl)] += 1
+            _account(_M_RETRIES)
+
+        return retry_call(fn, offset, size, self.policy, deadline=dl,
+                          on_retry=on_retry)
 
     def pread(self, offset: int, size: int) -> bytes:
         return self._call(self.inner.pread, offset, size)
@@ -382,6 +464,21 @@ class PolicySource(Source):
 # ---------------------------------------------------------------------------
 # Deterministic fault injection
 # ---------------------------------------------------------------------------
+def _mix_rng(seed: int, *parts: int) -> random.Random:
+    """Keyed RNG for deterministic injection draws, splitmix64-style
+    mixing: similar (offset, size) keys must land on uncorrelated Mersenne
+    states (tuple-hash seeding clusters badly — nearby seeds give nearby
+    first draws), and tuple seeds are gone in Python 3.11 anyway.  Shared
+    by the source injector and the remote-transport injector so their
+    reproducibility contract is one implementation."""
+    h = 0x9E3779B97F4A7C15
+    for p in (seed, *parts):
+        h ^= p & 0xFFFFFFFFFFFFFFFF
+        h = (h * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 31
+    return random.Random(h)
+
+
 @dataclass
 class FaultStats:
     """What the injector actually did (chaos-test assertions)."""
@@ -448,16 +545,7 @@ class FaultInjectingSource(Source):
         return getattr(self.inner, "path", None)
 
     def _rng(self, offset: int, size: int, attempt: int) -> random.Random:
-        # splitmix64-style mixing: similar (offset, size) keys must land on
-        # uncorrelated Mersenne states (tuple-hash seeding clusters badly —
-        # nearby seeds give nearby first draws), and tuple seeds are gone
-        # in Python 3.11 anyway
-        h = 0x9E3779B97F4A7C15
-        for p in (self.seed, offset, size, attempt):
-            h ^= p & 0xFFFFFFFFFFFFFFFF
-            h = (h * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
-            h ^= h >> 31
-        return random.Random(h)
+        return _mix_rng(self.seed, offset, size, attempt)
 
     def _read(self, fn, offset: int, size: int):
         with self._lock:
@@ -483,8 +571,9 @@ class FaultInjectingSource(Source):
             self._consecutive[key] = 0
         if self.truncate_at is not None and offset + size > self.truncate_at:
             got = max(0, self.truncate_at - offset)
-            raise IOError(f"short read at {offset}: wanted {size}, got {got} "
-                          "(injected truncation)")
+            raise ShortReadError(
+                f"short read at {offset}: wanted {size}, got {got} "
+                "(injected truncation)")
         data = fn(offset, size)
         flips = [o for o in self.flip_offsets if offset <= o < offset + size]
         # random per-read flips are keyed on attempt 0 so re-reads of the
@@ -525,6 +614,356 @@ class FaultInjectingSource(Source):
 
     def close(self) -> None:
         self.inner.close()
+
+
+# ---------------------------------------------------------------------------
+# Network chaos: remote-transport fault injection + hermetic range server
+# ---------------------------------------------------------------------------
+@dataclass
+class RemoteFaultStats:
+    """What the remote-transport injector actually did (chaos assertions:
+    every fault class the matrix claims to cover must show a nonzero
+    counter here, or the knob is broken)."""
+
+    requests: int = 0
+    refused: int = 0
+    resets: int = 0
+    stalls: int = 0
+    statuses: int = 0
+    throttles: int = 0
+    truncated: int = 0
+    flipped: int = 0
+    wrong_range: int = 0
+
+
+class FaultInjectingRemoteTransport:
+    """Deterministic, seedable chaos wrapper over a remote transport
+    (:class:`~parquet_tpu.io.remote.HttpTransport` or any object with its
+    ``head``/``get_range`` shape) — the network mirror of
+    :class:`FaultInjectingSource`.  Draws are keyed on ``(seed, offset,
+    size, attempt#)`` via the same splitmix mixing, so injection is
+    reproducible regardless of call order (hedge threads included) and
+    each retry of the same range re-draws deterministically.
+    ``max_consecutive`` bounds how many times in a row one range can fail
+    with an error-class fault, guaranteeing a retry policy with enough
+    attempts always recovers.
+
+    Modes (all composable):
+
+    - ``refuse_rate`` / ``reset_rate`` — the connection dies before any
+      response (``ConnectionRefusedError`` / ``ConnectionResetError``).
+    - ``stall_s`` + (``stall_rate`` or ``stall_attempts``) — the response
+      arrives, but only after ``stall_s`` seconds (drives hedging and
+      deadlines; ``stall_attempts=n`` stalls the first n attempts of each
+      range deterministically — the hedge-wins fixture: primary stalls,
+      the hedge re-attempt is fast).
+    - ``status_rate`` / ``status_code`` — an HTTP error status burst
+      (default 503) with an empty body.
+    - ``throttle_rate`` / ``retry_after`` — 429 with a ``Retry-After``
+      header the client must honor.
+    - ``truncate_rate`` — the body comes back shorter than the requested
+      range while the headers still claim the full range (torn body).
+    - ``flip_rate`` — one deterministic bit of the body flips,
+      PERSISTENTLY per range (keyed on attempt 0, like real rot): retries
+      see the same corruption, so recovery must come from the degrade
+      path, not a re-read.
+    - ``wrong_range_rate`` — the response claims (and serves) a range
+      starting at the wrong offset — a misbehaving proxy/cache.
+    - ``head_refuse`` — HEAD requests are refused too (open-time
+      failures: dataset skip-a-bad-file, breaker-on-open tests).
+    """
+
+    def __init__(self, inner, seed: int = 0, refuse_rate: float = 0.0,
+                 reset_rate: float = 0.0, stall_s: float = 0.0,
+                 stall_rate: float = 0.0,
+                 stall_attempts: Optional[int] = None,
+                 status_rate: float = 0.0, status_code: int = 503,
+                 throttle_rate: float = 0.0,
+                 retry_after: Optional[float] = None,
+                 truncate_rate: float = 0.0, flip_rate: float = 0.0,
+                 wrong_range_rate: float = 0.0,
+                 max_consecutive: Optional[int] = None,
+                 head_refuse: bool = False):
+        self.inner = inner
+        self.seed = seed
+        self.refuse_rate = refuse_rate
+        self.reset_rate = reset_rate
+        self.stall_s = stall_s
+        self.stall_rate = stall_rate
+        self.stall_attempts = stall_attempts
+        self.status_rate = status_rate
+        self.status_code = status_code
+        self.throttle_rate = throttle_rate
+        self.retry_after = retry_after
+        self.truncate_rate = truncate_rate
+        self.flip_rate = flip_rate
+        self.wrong_range_rate = wrong_range_rate
+        self.max_consecutive = max_consecutive
+        self.head_refuse = head_refuse
+        self.stats = RemoteFaultStats()
+        self._attempts: Dict[Tuple[int, int], int] = {}
+        self._consecutive: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def url(self):
+        return getattr(self.inner, "url", None)
+
+    @property
+    def host(self):
+        return getattr(self.inner, "host", None)
+
+    def head(self):
+        if self.head_refuse:
+            with self._lock:
+                self.stats.refused += 1
+            raise ConnectionRefusedError(
+                errno.ECONNREFUSED, "injected connect refused (HEAD)")
+        return self.inner.head()
+
+    def _error_injected(self, key, n: int = 1) -> None:
+        with self._lock:
+            self._consecutive[key] = self._consecutive.get(key, 0) + n
+
+    def get_range(self, offset: int, size: int):
+        key = (offset, size)
+        with self._lock:
+            self.stats.requests += 1
+            attempt = self._attempts.get(key, 0)
+            self._attempts[key] = attempt + 1
+            consecutive = self._consecutive.get(key, 0)
+        rng = _mix_rng(self.seed, offset, size, attempt)
+        can_inject = (self.max_consecutive is None
+                      or consecutive < self.max_consecutive)
+        if self.stall_s > 0 and (
+                attempt < self.stall_attempts
+                if self.stall_attempts is not None
+                else self.stall_rate and rng.random() < self.stall_rate):
+            with self._lock:
+                self.stats.stalls += 1
+            time.sleep(self.stall_s)
+        if can_inject and self.refuse_rate \
+                and rng.random() < self.refuse_rate:
+            self._error_injected(key)
+            with self._lock:
+                self.stats.refused += 1
+            raise ConnectionRefusedError(
+                errno.ECONNREFUSED, f"injected connect refused "
+                f"(attempt {attempt})")
+        if can_inject and self.reset_rate and rng.random() < self.reset_rate:
+            self._error_injected(key)
+            with self._lock:
+                self.stats.resets += 1
+            raise ConnectionResetError(
+                errno.ECONNRESET, f"injected connection reset "
+                f"(attempt {attempt})")
+        if can_inject and self.status_rate \
+                and rng.random() < self.status_rate:
+            self._error_injected(key)
+            with self._lock:
+                self.stats.statuses += 1
+            return self.status_code, {"content-length": "0"}, b""
+        if can_inject and self.throttle_rate \
+                and rng.random() < self.throttle_rate:
+            self._error_injected(key)
+            with self._lock:
+                self.stats.throttles += 1
+            hdrs = {"content-length": "0"}
+            if self.retry_after is not None:
+                hdrs["retry-after"] = str(self.retry_after)
+            return 429, hdrs, b""
+        status, headers, body = self.inner.get_range(offset, size)
+        injected_body_fault = False
+        if can_inject and self.wrong_range_rate \
+                and rng.random() < self.wrong_range_rate and status == 206:
+            # a misbehaving proxy: the response names (and serves) a
+            # shifted start — the client's Content-Range check must catch
+            # it before the wrong bytes reach a decoder
+            self._error_injected(key)
+            injected_body_fault = True
+            with self._lock:
+                self.stats.wrong_range += 1
+            headers = dict(headers)
+            headers["content-range"] = (
+                f"bytes {offset + 7}-{offset + 6 + size}/*")
+        elif can_inject and self.truncate_rate and len(body) > 1 \
+                and rng.random() < self.truncate_rate:
+            self._error_injected(key)
+            injected_body_fault = True
+            with self._lock:
+                self.stats.truncated += 1
+            body = body[:rng.randrange(1, len(body))]
+        if not injected_body_fault:
+            with self._lock:
+                self._consecutive[key] = 0
+        # persistent per-range flips are keyed on attempt 0, like real rot
+        rng0 = _mix_rng(self.seed, offset, size, 0)
+        if self.flip_rate and body and rng0.random() < self.flip_rate:
+            buf = bytearray(body)
+            buf[rng0.randrange(len(buf))] ^= 1 << rng0.randrange(8)
+            body = bytes(buf)
+            with self._lock:
+                self.stats.flipped += 1
+        return status, headers, body
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+
+class LocalRangeServer:
+    """In-process HTTP range-request server over an in-memory
+    ``{name: bytes}`` map — the hermetic fixture the whole remote test
+    matrix (and check.sh's remote smoke) runs against, no network needed.
+
+    Serves ``HEAD`` (Content-Length + ETag + Last-Modified validators)
+    and ``GET`` with single-range ``Range: bytes=a-b`` headers (206 +
+    Content-Range; 416 for unsatisfiable starts; 200 full body without a
+    Range header, or always when ``ignore_range=True`` — the
+    server-ignores-Range fallback path).  ``put()`` replaces a file's
+    bytes and moves its validators, so cache-invalidation-on-rewrite is
+    testable; ``requests`` logs every ``(method, name, range_header)``
+    so tests can assert "the warm read touched the network exactly
+    never"."""
+
+    def __init__(self, files: Optional[dict] = None,
+                 ignore_range: bool = False, send_validators: bool = True):
+        import hashlib
+        from email.utils import formatdate
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self._lock = threading.Lock()
+        self._files: Dict[str, bytes] = {}
+        self._etag: Dict[str, str] = {}
+        self._mtime: Dict[str, float] = {}
+        self.ignore_range = ignore_range
+        self.send_validators = send_validators
+        self.requests: List[Tuple[str, str, Optional[str]]] = []
+        self._hash = lambda b: hashlib.md5(b).hexdigest()
+        self._fmtdate = formatdate
+        for name, data in (files or {}).items():
+            self.put(name, data)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # persistent connections: the
+            # connection-pool reuse path is what production sees
+            disable_nagle_algorithm = True  # headers and body flush as
+            # separate writes; without TCP_NODELAY the body segment waits
+            # out the peer's delayed ACK (~40ms per response on loopback)
+
+            def log_message(self, fmt, *args):  # tests must not spam
+                pass
+
+            def _lookup(self):
+                name = self.path.lstrip("/")
+                with server._lock:
+                    data = server._files.get(name)
+                    meta = (server._etag.get(name),
+                            server._mtime.get(name))
+                return name, data, meta
+
+            def _common_headers(self, meta):
+                if server.send_validators:
+                    self.send_header("ETag", f'"{meta[0]}"')
+                    self.send_header(
+                        "Last-Modified",
+                        server._fmtdate(meta[1], usegmt=True))
+                self.send_header("Accept-Ranges",
+                                 "none" if server.ignore_range else "bytes")
+
+            def do_HEAD(self):  # noqa: N802 (http.server naming)
+                name, data, meta = self._lookup()
+                with server._lock:
+                    server.requests.append(("HEAD", name, None))
+                if data is None:
+                    self.send_error(404, "no such object")
+                    return
+                self.send_response(200)
+                self._common_headers(meta)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+
+            def do_GET(self):  # noqa: N802
+                name, data, meta = self._lookup()
+                rng = self.headers.get("Range")
+                with server._lock:
+                    server.requests.append(("GET", name, rng))
+                if data is None:
+                    self.send_error(404, "no such object")
+                    return
+                if rng and not server.ignore_range:
+                    try:
+                        spec = rng.split("=", 1)[1].split(",")[0]
+                        lo_s, hi_s = spec.split("-", 1)
+                        lo = int(lo_s)
+                        hi = int(hi_s) if hi_s else len(data) - 1
+                    except (IndexError, ValueError):
+                        self.send_error(400, "bad Range header")
+                        return
+                    if lo >= len(data):
+                        self.send_response(416)
+                        self.send_header("Content-Range",
+                                         f"bytes */{len(data)}")
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    hi = min(hi, len(data) - 1)
+                    body = data[lo : hi + 1]
+                    self.send_response(206)
+                    self._common_headers(meta)
+                    self.send_header("Content-Range",
+                                     f"bytes {lo}-{hi}/{len(data)}")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(200)
+                self._common_headers(meta)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="pq-range-server", daemon=True)
+        self._thread.start()
+        self.host, self.port = self._httpd.server_address[:2]
+
+    def put(self, name: str, data) -> None:
+        """Create or REPLACE an object: new bytes, new ETag, new
+        Last-Modified — the remote analog of a rename-replace rewrite."""
+        data = bytes(data)
+        with self._lock:
+            self._files[name] = data
+            self._etag[name] = self._hash(data)
+            # strictly-advancing mtime: same-tick rewrites must still
+            # move the validator (coarse HTTP dates alone would not)
+            prev = self._mtime.get(name, 0.0)
+            self._mtime[name] = max(time.time(), prev + 1.0)
+
+    def url(self, name: str) -> str:
+        return f"http://{self.host}:{self.port}/{name}"
+
+    def request_count(self, name: Optional[str] = None,
+                      method: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(1 for m, n, _ in self.requests
+                       if (name is None or n == name)
+                       and (method is None or m == method))
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "LocalRangeServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 # ---------------------------------------------------------------------------

@@ -108,6 +108,20 @@ _TUNE_DECAY_S_PER_WINDOW = 5e-4
 _MAX_DEPTH = 8
 _MAX_WINDOW_BYTES = 16 << 20
 
+# per-latency-class readahead baselines (depth, window): a source chain's
+# class comes from its innermost source (``latency_class`` attribute —
+# io/remote.py HttpSource reports "remote", or "remote_far" once its
+# observed pread EWMA crosses the far threshold; local chains have none).
+# High-latency sources START with deeper pipelines and bigger windows —
+# at network RTTs the two-window default leaves the pipe mostly idle —
+# and the auto-tuner's learned state is kept PER CLASS, so a remote
+# drain's feedback never bloats local readahead (or vice versa).
+_CLASS_DEFAULTS = {
+    "local": (DEFAULT_DEPTH, DEFAULT_WINDOW_BYTES),
+    "remote": (4, 4 << 20),
+    "remote_far": (6, 8 << 20),
+}
+
 
 class _AutoTuneState:
     """Process-wide feedback from observed :class:`ReadStats` to the next
@@ -116,42 +130,63 @@ class _AutoTuneState:
     of fixed constants).  A drain whose average wait PER ISSUED WINDOW
     exceeds :data:`_TUNE_RAISE_S_PER_WINDOW` deepens readahead — depth
     first, then window size; one under the decay threshold steps back
-    toward the defaults.  Explicit env pins and
-    ``PARQUET_TPU_PREFETCH_AUTOTUNE=0`` bypass the state entirely."""
+    toward the class baseline (:data:`_CLASS_DEFAULTS` — remote classes
+    floor higher than local).  State is kept per latency class.  Explicit
+    env pins and ``PARQUET_TPU_PREFETCH_AUTOTUNE=0`` bypass the state
+    entirely."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.depth: Optional[int] = None
-        self.window: Optional[int] = None
+        # class -> [depth override | None, window override | None]
+        self._state = {}
 
-    def suggest(self):
+    def _cls(self, cls: str):
+        st = self._state.get(cls)
+        if st is None:
+            st = self._state[cls] = [None, None]
+        return st
+
+    def suggest(self, cls: str = "local"):
         with self._lock:
-            return self.depth, self.window
+            return tuple(self._cls(cls))
 
-    def observe(self, stats: "ReadStats") -> None:
+    def observe(self, stats: "ReadStats", cls: str = "local") -> None:
         if stats.windows_issued <= 0:
             return
         wait_per_window = stats.pool_wait_s / stats.windows_issued
+        base_d, base_w = _CLASS_DEFAULTS.get(cls, _CLASS_DEFAULTS["local"])
         with self._lock:
-            d = self.depth or DEFAULT_DEPTH
-            w = self.window or DEFAULT_WINDOW_BYTES
+            st = self._cls(cls)
+            d = st[0] or base_d
+            w = st[1] or base_w
             if wait_per_window > _TUNE_RAISE_S_PER_WINDOW:
                 if d < _MAX_DEPTH:
-                    self.depth = d + 1
+                    st[0] = d + 1
                 elif w < _MAX_WINDOW_BYTES:
-                    self.window = w * 2
+                    st[1] = w * 2
             elif wait_per_window < _TUNE_DECAY_S_PER_WINDOW:
-                if w > DEFAULT_WINDOW_BYTES:
+                if w > base_w:
                     w //= 2
-                    self.window = None if w <= DEFAULT_WINDOW_BYTES else w
-                elif d > DEFAULT_DEPTH:
+                    st[1] = None if w <= base_w else w
+                elif d > base_d:
                     d -= 1
-                    self.depth = None if d <= DEFAULT_DEPTH else d
+                    st[0] = None if d <= base_d else d
+
+    # back-compat views of the default (local) class — the historical
+    # attribute shape (tests and any external pokers read these)
+    @property
+    def depth(self) -> Optional[int]:
+        with self._lock:
+            return self._cls("local")[0]
+
+    @property
+    def window(self) -> Optional[int]:
+        with self._lock:
+            return self._cls("local")[1]
 
     def reset(self) -> None:
         with self._lock:
-            self.depth = None
-            self.window = None
+            self._state = {}
 
 
 _AUTOTUNE = _AutoTuneState()
@@ -304,18 +339,26 @@ class PrefetchSource(Source):
         self.backend = backend
         env_window = _env_int("PARQUET_TPU_PREFETCH_WINDOW")
         env_depth = _env_int("PARQUET_TPU_PREFETCH_DEPTH")
+        # the chain's latency class (innermost source's declaration —
+        # remote sources report "remote"/"remote_far", everything else is
+        # local): picks the readahead baseline and keys the tuner state
+        self.latency_class = getattr(_innermost(inner), "latency_class",
+                                     "local")
+        base_depth, base_window = _CLASS_DEFAULTS.get(
+            self.latency_class, _CLASS_DEFAULTS["local"])
         # explicit args and env pins beat the auto-tuner; with neither, the
         # depth/window come from observed pool_wait_s of earlier drains
         tuned_depth, tuned_window = ((None, None) if not autotune_enabled()
-                                     else _AUTOTUNE.suggest())
+                                     else _AUTOTUNE.suggest(
+                                         self.latency_class))
         self._tunable = (autotune_enabled() and window_bytes is None
                          and depth is None and env_window is None
                          and env_depth is None)
         self.window_bytes = int(window_bytes or env_window or tuned_window
-                                or DEFAULT_WINDOW_BYTES)
+                                or base_window)
         if self.window_bytes <= 0:
             raise ValueError("window_bytes must be positive")
-        self.depth = int(depth or env_depth or tuned_depth or DEFAULT_DEPTH)
+        self.depth = int(depth or env_depth or tuned_depth or base_depth)
         self.max_windows = max(2, int(max_windows))
         self.stats = stats if stats is not None else ReadStats()
         self.stats.backend = backend
@@ -709,8 +752,9 @@ class PrefetchSource(Source):
             self.stats.publish()
         if self.backend == "ring" and self._tunable:
             # feed the drain's bubble meter back into the next drain's
-            # readahead defaults (no-op when env pins or opt-out disabled)
-            _AUTOTUNE.observe(self.stats)
+            # readahead defaults for THIS latency class (no-op when env
+            # pins or opt-out disabled)
+            _AUTOTUNE.observe(self.stats, self.latency_class)
         if self._owns_inner:
             self.inner.close()
 
@@ -740,14 +784,21 @@ def make_prefetcher(source: Source,
         return PrefetchSource(source, backend="advise", stats=stats)
     if mode == "mmap":
         return None
+    # remote chains ring REGARDLESS of core count (except inside pool
+    # workers — the nested-submitter deadlock guard): a network pread
+    # spends its time blocked in the socket with the GIL released, so
+    # background readahead hides real RTT latency even on one core —
+    # exactly the case where the local-ring "memcpy competes with
+    # decode" regression does not apply
+    remote = getattr(deepest, "latency_class", "local") != "local"
     # auto rings only chains that bottom out in real IO: an in-memory
     # BytesSource has no disk latency to hide, so background "reads" would
     # be pure pool-dispatch overhead.  Forced ring mode skips the gate
     # (chaos tests wrap BytesSource deliberately).
     real_io = isinstance(deepest, (FileSource, FileLikeSource))
-    if mode == "ring" or (mode == "auto" and real_io
-                          and available_cpus() > 1
-                          and not in_shared_pool()):
+    if mode == "ring" or (mode == "auto" and not in_shared_pool()
+                          and (remote or (real_io
+                                          and available_cpus() > 1))):
         return PrefetchSource(source, backend="ring", stats=stats,
                               max_windows=max(8, 2 * n_streams))
     return None

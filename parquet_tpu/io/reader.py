@@ -511,12 +511,18 @@ class ParquetFile:
         # bytes this fd/map actually serves — a path re-stat here could
         # race an atomic-rename replace and cache old bytes under the new
         # file's identity
+        from .remote import HttpSource
         from .source import FileSource, MmapSource
 
         inner = self.source.inner if isinstance(self.source, PolicySource) \
             else self.source
+        # remote opens key on the HEAD validators (url, ETag,
+        # Last-Modified, length) instead of fstat; an HttpSource whose
+        # server sends no validator (or whose transport is a chaos
+        # wrapper) carries stat_key=None and is never cached
         self._cache_key = (inner.stat_key
-                           if isinstance(inner, (FileSource, MmapSource))
+                           if isinstance(inner, (FileSource, MmapSource,
+                                                 HttpSource))
                            else None)
         try:
             with self._resilient_op(None, None, "open"), \

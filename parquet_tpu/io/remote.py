@@ -1,0 +1,912 @@
+"""Remote byte sources: HTTP(S) range requests as a first-class
+:class:`~parquet_tpu.io.source.Source` (ROADMAP item 1 — every real
+serving fleet reads from an object store, not local disk).
+
+``as_source`` resolves ``http(s)://`` URLs here, so ``ParquetFile(url)``
+and ``Dataset([url, ...])`` compose with the ENTIRE existing stack
+unchanged: :class:`~parquet_tpu.io.prefetch.PrefetchSource` coalesced
+readahead (the auto policy rings remote chains even on one core — network
+latency hides behind decode regardless of CPU count), the scan planner,
+the batched lookup path, the footer/chunk/page cache tiers (keyed on the
+object's HEAD validators instead of fstat), per-op scopes, and the
+resource ledger.  Around the transport sits the fault envelope that makes
+a network source trustworthy enough to serve from:
+
+- **Classification** — every failure surfaces as a
+  :class:`~parquet_tpu.errors.RemoteError` carrying host / status /
+  attempt / byte-range context, split retryable (connect refused/reset,
+  5xx, 429 with ``Retry-After`` honored, truncated body, stall) from
+  terminal (other 4xx, range-not-satisfiable).  The shared retry loop
+  (:func:`~parquet_tpu.io.faults.retry_call`) consults the class, so
+  :class:`~parquet_tpu.io.faults.FaultPolicy` retries/backoff/deadlines
+  and ``on_corrupt='skip_row_group'`` degraded reads work unchanged and
+  account in :class:`~parquet_tpu.io.faults.ReadReport`.
+- **Hedged reads** — after an adaptive percentile-based delay (p95 of the
+  observed ``remote.pread_s`` distribution; ``PARQUET_TPU_REMOTE_HEDGE``
+  pins seconds or disables), a second attempt races the first,
+  first-success-wins, the loser abandoned.  Hedge bytes are charged to
+  the ``remote.hedge_in_flight`` ledger account and admitted through the
+  unified ``PARQUET_TPU_READ_BUDGET`` gate like any other in-flight
+  bytes.  The hedged wait loop honors the active operation deadline
+  (:func:`~parquet_tpu.io.faults.active_deadline`), so a stalled primary
+  cannot run past ``deadline_s``.
+- **Per-host circuit breaker** — ``PARQUET_TPU_REMOTE_BREAKER``
+  consecutive failures open the host's circuit: requests fail fast
+  (:class:`~parquet_tpu.errors.RemoteCircuitOpenError`, retryable — the
+  policy's backoff is the pause the breaker wants) without touching the
+  network until the cooldown's half-open probe closes it again.  Breakers
+  are per host, so one dead endpoint never blocks the healthy-host files
+  of a multi-file ``Dataset``.  Transitions are metered
+  (``remote.breaker_transitions{state=...}``).
+
+The chaos side — :class:`~parquet_tpu.io.faults.
+FaultInjectingRemoteTransport` and the hermetic
+:class:`~parquet_tpu.io.faults.LocalRangeServer` — lives in io/faults.py
+with the rest of the injection machinery.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import threading
+import time
+from collections import OrderedDict
+from http.client import HTTPConnection, HTTPException, HTTPSConnection
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from ..errors import (DeadlineError, RemoteCircuitOpenError, RemoteError,
+                      RemoteTerminalError, RemoteThrottledError,
+                      RemoteTransientError)
+from ..obs.ledger import ledger_account
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import histogram as _histogram
+from ..obs.scope import account as _account
+from ..obs.scope import account_bytes as _account_bytes
+from ..utils.pool import read_admission
+from .source import Source, _check_read_args
+
+__all__ = ["HttpSource", "ObjectStoreSource", "HttpTransport",
+           "CircuitBreaker", "breaker_for", "breakers", "reset_breakers",
+           "remote_debug", "hedge_delay_s", "observed_pread_ewma",
+           "drain_connection_pools"]
+
+# resolved once: the pread hot path must not take the registry's
+# get-or-create lock (only each metric's own)
+_M_PREADS = _counter("remote.preads")
+_M_BYTES = _counter("remote.bytes")
+_M_HEDGES = _counter("remote.hedges_issued")
+_M_HEDGES_WON = _counter("remote.hedges_won")
+_M_FAIL_FAST = _counter("remote.breaker_fail_fast")
+_M_VALIDATOR_CHANGES = _counter("remote.validator_changes")
+_M_ERRORS = {c: _counter("remote.errors", labels={"class": c})
+             for c in ("retryable", "terminal", "throttled")}
+_M_TRANSITIONS = {s: _counter("remote.breaker_transitions",
+                              labels={"state": s})
+                  for s in ("open", "half_open", "closed")}
+_H_PREAD_S = _histogram("remote.pread_s")
+
+# hedge bytes in flight: the duplicate copy a hedged read stages while
+# both attempts race — added when a hedge attempt starts, released when
+# it finishes (win, lose, or abandoned), so the account provably drains
+# to 0 (the acceptance hammer asserts it)
+_ACC_HEDGE = ledger_account("remote.hedge_in_flight")
+
+_CONTENT_RANGE = re.compile(r"bytes\s+(\d+)-(\d+)/(\d+|\*)")
+
+DEFAULT_POOL_SIZE = 4
+DEFAULT_TIMEOUT_S = 30.0
+# hedging before the latency distribution has warmed: a flat default
+# (observed p95 takes over after _HEDGE_WARMUP_COUNT preads)
+DEFAULT_HEDGE_DELAY_S = 0.05
+_HEDGE_WARMUP_COUNT = 16
+_HEDGE_MIN_S = 0.002
+_HEDGE_MAX_S = 2.0
+# observed-EWMA boundary between the two remote latency classes the
+# prefetch auto-tuner keys on (io/prefetch.py _CLASS_DEFAULTS)
+_FAR_LATENCY_S = 0.03
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "").strip()
+    if v:
+        try:
+            return float(v)
+        except ValueError:
+            pass
+    return default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "").strip()
+    if v:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    return default
+
+
+# ---------------------------------------------------------------------------
+# Transport: persistent-connection range requests over http.client
+# ---------------------------------------------------------------------------
+class _HostPool:
+    """Idle persistent connections to ONE (scheme, host) — shared by
+    every transport to that host, so a ``Dataset`` over a thousand URLs
+    on one endpoint reuses a handful of sockets instead of paying a TCP
+    (+TLS) handshake per file.  Bounded: returns past ``cap`` close."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._lock = threading.Lock()
+        self._idle: List = []
+
+    def get(self):
+        with self._lock:
+            return self._idle.pop() if self._idle else None
+
+    def put(self, conn) -> None:
+        with self._lock:
+            if len(self._idle) < self.cap:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def drain(self) -> int:
+        with self._lock:
+            conns, self._idle = self._idle, []
+        for c in conns:
+            c.close()
+        return len(conns)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._idle)
+
+
+_POOLS: Dict[tuple, _HostPool] = {}
+_POOLS_LOCK = threading.Lock()
+
+
+def _host_pool(scheme: str, host: str, timeout_s: float,
+               cap: int) -> _HostPool:
+    key = (scheme, host, timeout_s)
+    with _POOLS_LOCK:
+        pool = _POOLS.get(key)
+        if pool is None:
+            pool = _POOLS[key] = _HostPool(cap)
+        return pool
+
+
+def drain_connection_pools() -> int:
+    """Close every idle pooled connection (tests, clean shutdown);
+    returns the number closed.  In-flight requests are unaffected."""
+    with _POOLS_LOCK:
+        pools = list(_POOLS.values())
+    return sum(p.drain() for p in pools)
+
+
+class HttpTransport:
+    """Raw ranged HTTP over stdlib ``http.client`` with a small per-host
+    pool of persistent connections (``PARQUET_TPU_REMOTE_POOL``, default
+    4; shared across every transport to the same scheme+host): concurrent
+    preads — pool workers, prefetch window fills, hedge threads — each
+    check out their own connection, and completed requests return it for
+    reuse instead of paying a TCP (+TLS) handshake per range.
+    ``timeout_s`` (``PARQUET_TPU_REMOTE_TIMEOUT``, default 30) bounds
+    every socket operation — the stall detector: a hung server surfaces
+    as ``socket.timeout``, classified retryable.  A POOLED connection the
+    server idled out (keep-alive timeout) fails its first reuse with a
+    reset/closed error — those retry transparently on a fresh connection
+    (bounded by the pool depth; timeouts are NOT stale-retried: a stall
+    is real signal and retrying would silently double it).
+
+    Beyond that one stale-reuse retry the transport is mechanism only: no
+    classification, no policy retries, no hedging — it returns
+    ``(status, lowercase-header dict, body)`` or raises the underlying
+    ``OSError``.  :class:`HttpSource` owns policy.  The chaos injector
+    (:class:`~parquet_tpu.io.faults.FaultInjectingRemoteTransport`)
+    wraps this interface."""
+
+    def __init__(self, url: str, pool_size: Optional[int] = None,
+                 timeout_s: Optional[float] = None):
+        parts = urlsplit(url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"HttpTransport needs an http(s) URL, "
+                             f"got {url!r}")
+        if not parts.netloc:
+            raise ValueError(f"URL {url!r} has no host")
+        self.url = url
+        self.host = parts.netloc
+        self._scheme = parts.scheme
+        self._request_path = parts.path or "/"
+        if parts.query:
+            self._request_path += "?" + parts.query
+        self.pool_size = (pool_size if pool_size is not None
+                          else _env_int("PARQUET_TPU_REMOTE_POOL",
+                                        DEFAULT_POOL_SIZE))
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else _env_float("PARQUET_TPU_REMOTE_TIMEOUT",
+                                          DEFAULT_TIMEOUT_S))
+        self._pool = _host_pool(parts.scheme, parts.netloc, self.timeout_s,
+                                self.pool_size)
+        self._closed = False
+
+    def _new_conn(self):
+        cls = HTTPSConnection if self._scheme == "https" else HTTPConnection
+        return cls(self.host, timeout=self.timeout_s)
+
+    def _checkout(self):
+        """-> (conn, reused): ``reused`` marks a pooled keep-alive
+        connection, eligible for the stale-reuse retry."""
+        if self._closed:
+            raise ValueError(f"request on closed transport {self.url!r}")
+        conn = self._pool.get()
+        if conn is not None:
+            return conn, True
+        return self._new_conn(), False
+
+    def _roundtrip(self, method: str,
+                   headers: Optional[dict] = None
+                   ) -> Tuple[int, Dict[str, str], bytes]:
+        while True:
+            conn, reused = self._checkout()
+            try:
+                conn.request(method, self._request_path,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                status = resp.status
+                hdrs = {k.lower(): v for k, v in resp.getheaders()}
+                body = resp.read()  # drain fully: a half-read response
+                # poisons the persistent connection for the next request
+                reusable = not resp.will_close
+            except (socket.timeout,):
+                conn.close()
+                raise  # a stall is signal, never a stale-conn artifact
+            except (HTTPException, OSError):
+                conn.close()
+                if reused:
+                    # the server idled this keep-alive connection out
+                    # between requests: not a host failure — retry once
+                    # per stale conn on a fresh (or next pooled) one
+                    continue
+                raise
+            except BaseException:
+                conn.close()
+                raise
+            if reusable:
+                self._pool.put(conn)
+            else:
+                conn.close()
+            return status, hdrs, body
+
+    def head(self) -> Tuple[int, Dict[str, str]]:
+        status, hdrs, _ = self._roundtrip("HEAD")
+        return status, hdrs
+
+    def get_range(self, offset: int,
+                  size: int) -> Tuple[int, Dict[str, str], bytes]:
+        return self._roundtrip(
+            "GET", {"Range": f"bytes={offset}-{offset + size - 1}"})
+
+    def idle_connections(self) -> int:
+        return len(self._pool)
+
+    def close(self) -> None:
+        # the idle pool is host-shared (other transports ride it); this
+        # transport just stops issuing — pooled sockets stay for others
+        self._closed = True
+
+
+# ---------------------------------------------------------------------------
+# Per-host circuit breaker
+# ---------------------------------------------------------------------------
+def breaker_threshold() -> int:
+    """``PARQUET_TPU_REMOTE_BREAKER``: consecutive failures that open a
+    host's circuit (default 5; ``0`` disables breaking).  Read per check
+    so tests and operators can repoint it live."""
+    return _env_int("PARQUET_TPU_REMOTE_BREAKER", 5)
+
+
+def breaker_cooldown_s() -> float:
+    """``PARQUET_TPU_REMOTE_BREAKER_COOLDOWN``: seconds an open circuit
+    waits before admitting one half-open probe (default 1.0)."""
+    return _env_float("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", 1.0)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for ONE remote host.
+
+    ``closed`` (healthy) → ``open`` after ``breaker_threshold()``
+    consecutive connection-class failures: requests fail fast with
+    :class:`~parquet_tpu.errors.RemoteCircuitOpenError`, touching no
+    network, until ``breaker_cooldown_s()`` elapses → ``half_open``: ONE
+    probe request goes through; success closes the circuit, failure
+    re-opens it (fresh cooldown).  Only connection-class failures count
+    (refused/reset/timeout/5xx): a 4xx or 429 — or a transient BODY
+    fault on an answering host (truncation, wrong range) — proves the
+    host is reachable, so those leave the streak alone.  Every
+    transition lands in ``remote.breaker_transitions{state=...}``."""
+
+    def __init__(self, host: str):
+        self.host = host
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._probe_started_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._failures
+
+    def _transition(self, new: str) -> None:
+        # under self._lock
+        self._state = new
+        _account(_M_TRANSITIONS[new])
+
+    def allow(self) -> bool:
+        """May a request proceed right now?  Open circuits refuse until
+        the cooldown, then admit exactly one half-open probe at a time."""
+        if breaker_threshold() <= 0:
+            return True
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if time.monotonic() - self._opened_at \
+                        < breaker_cooldown_s():
+                    return False
+                self._transition("half_open")
+                self._probe_in_flight = False
+            # half_open: one probe in flight at a time.  A probe whose
+            # outcome never reported (throttled, deadline-killed, caller
+            # died) must not wedge the host fail-fast forever: the probe
+            # LEASE expires after one cooldown and the next request may
+            # probe again.
+            if self._probe_in_flight and (time.monotonic()
+                                          - self._probe_started_at
+                                          < breaker_cooldown_s()):
+                return False
+            self._probe_in_flight = True
+            self._probe_started_at = time.monotonic()
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            if self._state != "closed":
+                self._transition("closed")
+
+    def record_inconclusive(self) -> None:
+        """The request finished with an outcome that proves nothing about
+        host health (429, a deadline that fired mid-race): release the
+        half-open probe slot without moving the failure streak or the
+        state — the next request may probe immediately."""
+        with self._lock:
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        threshold = breaker_threshold()
+        if threshold <= 0:
+            return
+        with self._lock:
+            self._failures += 1
+            self._probe_in_flight = False
+            if self._state == "half_open" or (
+                    self._state == "closed"
+                    and self._failures >= threshold):
+                self._transition("open")
+                self._opened_at = time.monotonic()
+
+
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def breaker_for(host: str) -> CircuitBreaker:
+    """The process-wide breaker for ``host`` (every HttpSource to the
+    same host shares one — host health is host-scoped, not per-file)."""
+    with _BREAKERS_LOCK:
+        b = _BREAKERS.get(host)
+        if b is None:
+            b = _BREAKERS[host] = CircuitBreaker(host)
+        return b
+
+
+def breakers() -> Dict[str, CircuitBreaker]:
+    """Snapshot of every known host breaker (the /debugz view)."""
+    with _BREAKERS_LOCK:
+        return dict(_BREAKERS)
+
+
+def reset_breakers() -> None:
+    """Forget every host breaker (test isolation)."""
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Observed latency (hedge-delay seeding + prefetch latency class)
+# ---------------------------------------------------------------------------
+_LAT_LOCK = threading.Lock()
+_LAT_EWMA: Dict[str, float] = {}  # host -> EWMA seconds
+
+
+def _observe_pread(seconds: float, host: str) -> None:
+    _H_PREAD_S.observe(seconds)
+    with _LAT_LOCK:
+        prev = _LAT_EWMA.get(host)
+        _LAT_EWMA[host] = (seconds if prev is None
+                           else 0.2 * seconds + 0.8 * prev)
+
+
+def observed_pread_ewma(host: str) -> Optional[float]:
+    """EWMA of successful pread seconds to ``host`` (None before the
+    first) — what the prefetch auto-tuner's latency-class split and
+    /debugz read.  Per HOST, not process-wide: one far bucket must not
+    reclassify a near cache's chains as ``remote_far``."""
+    with _LAT_LOCK:
+        return _LAT_EWMA.get(host)
+
+
+def _reset_latency() -> None:
+    """Test isolation: forget the observed latency state."""
+    with _LAT_LOCK:
+        _LAT_EWMA.clear()
+
+
+def hedge_delay_s() -> Optional[float]:
+    """Delay before a pread's second (hedged) attempt launches, or None
+    when hedging is off.  ``PARQUET_TPU_REMOTE_HEDGE``: ``0``/``off``
+    disables, a float pins the delay in seconds, unset/``auto`` adapts —
+    the p95 of the observed ``remote.pread_s`` distribution (clamped to
+    [2ms, 2s]; a flat 50ms until enough preads have been observed), so
+    hedges fire exactly at the measured tail, not on a guess."""
+    mode = os.environ.get("PARQUET_TPU_REMOTE_HEDGE", "auto").strip().lower()
+    if mode in ("0", "off", "false", "no"):
+        return None
+    if mode not in ("", "1", "auto"):
+        try:
+            return max(0.0, float(mode))
+        except ValueError:
+            pass
+    if _H_PREAD_S.count < _HEDGE_WARMUP_COUNT:
+        return DEFAULT_HEDGE_DELAY_S
+    p95 = _H_PREAD_S.percentile(0.95)
+    if p95 is None:
+        return DEFAULT_HEDGE_DELAY_S
+    return min(max(p95, _HEDGE_MIN_S), _HEDGE_MAX_S)
+
+
+# ---------------------------------------------------------------------------
+# Validator bookkeeping (remote cache identity)
+# ---------------------------------------------------------------------------
+_VALIDATOR_CAP = 4096  # tiny entries, but a rolling-partition fleet
+# opens ever-new URLs forever: the memo must be bounded, like any tier
+_VALIDATORS: "OrderedDict[str, tuple]" = OrderedDict()
+_VALIDATORS_LOCK = threading.Lock()
+
+
+def _note_validator(url: str, validator: tuple) -> None:
+    """Record the object's HEAD validator; a CHANGED validator means the
+    remote object was rewritten — every cached footer/chunk/page/memo
+    entry of the url drops through the existing invalidate machinery
+    (the remote analog of the path sinks' invalidate-on-commit).
+    LRU-bounded: an evicted url just loses change *detection* until its
+    next open — its cache entries are still guarded by the validator-
+    keyed ``stat_key``, so stale bytes can never serve, exactly like a
+    footer falling out of the footer LRU."""
+    with _VALIDATORS_LOCK:
+        old = _VALIDATORS.pop(url, None)
+        _VALIDATORS[url] = validator
+        while len(_VALIDATORS) > _VALIDATOR_CAP:
+            _VALIDATORS.popitem(last=False)
+    if old is not None and old != validator:
+        from .cache import invalidate_path  # deferred: cache is heavier
+
+        _account(_M_VALIDATOR_CHANGES)
+        invalidate_path(url)
+
+
+def _reset_validators() -> None:
+    """Test isolation: forget every remembered validator."""
+    with _VALIDATORS_LOCK:
+        _VALIDATORS.clear()
+
+
+# ---------------------------------------------------------------------------
+# The source
+# ---------------------------------------------------------------------------
+class HttpSource(Source):
+    """A remote object over HTTP range requests — ``as_source`` builds one
+    for every ``http(s)://`` open, so the whole read stack composes (see
+    module docstring).
+
+    Construction performs a HEAD (with a small internal transient-retry:
+    opens happen before any :class:`~parquet_tpu.io.faults.PolicySource`
+    wraps the source) to learn ``Content-Length`` and the cache
+    validators: ``stat_key`` is ``(url, etag, last_modified, size)`` —
+    the remote analog of the local fstat identity, so the shared
+    footer/chunk/page caches serve hot re-opens with zero network
+    requests beyond the per-open HEAD.  Objects whose server sends
+    neither validator get ``stat_key=None`` (never cached: identity
+    would be a guess), as does any source built over a non-plain
+    transport (chaos injectors may transform bytes — they must never
+    populate shared caches).
+
+    Every pread consults the host's :class:`CircuitBreaker`, races a
+    hedged second attempt after :func:`hedge_delay_s` (budget-gated and
+    ledger-charged), classifies failures into the
+    :class:`~parquet_tpu.errors.RemoteError` hierarchy, and accounts
+    ``remote.preads`` / ``remote.bytes`` / ``remote.pread_s`` plus the
+    terminal-source ``read.bytes_read``."""
+
+    def __init__(self, url: str, transport=None,
+                 pool_size: Optional[int] = None,
+                 timeout_s: Optional[float] = None):
+        self.url = url
+        self._transport = (transport if transport is not None
+                           else HttpTransport(url, pool_size=pool_size,
+                                              timeout_s=timeout_s))
+        self.host = (getattr(self._transport, "host", None)
+                     or urlsplit(url).netloc)
+        self._breaker = breaker_for(self.host)
+        self._closed = False
+        status, hdrs = self._head()
+        cl = hdrs.get("content-length")
+        if cl is None:
+            raise RemoteTerminalError(
+                "HEAD response has no Content-Length — cannot size the "
+                "remote object", host=self.host, status=status,
+                path=self.url)
+        self._size = int(cl)
+        etag = hdrs.get("etag")
+        last_modified = hdrs.get("last-modified")
+        # bytes-identity for the shared caches: only a PLAIN transport
+        # with at least one validator qualifies — without a validator a
+        # rewrite would be invisible, and a wrapped (chaos) transport may
+        # transform bytes
+        if isinstance(self._transport, HttpTransport) \
+                and (etag or last_modified):
+            self.stat_key = (url, etag, last_modified, self._size)
+            _note_validator(url, (etag, last_modified, self._size))
+        else:
+            self.stat_key = None
+
+    # ------------------------------------------------------------ metadata
+    @property
+    def path(self) -> str:
+        """Error-context identity (read_context / ReadError.path): the
+        URL plays the file-path role for remote sources."""
+        return self.url
+
+    @property
+    def latency_class(self) -> str:
+        """The prefetch auto-tuner's latency class for this chain
+        (io/prefetch.py): ``remote`` for ordinary network latency,
+        ``remote_far`` once the observed pread EWMA crosses
+        ``_FAR_LATENCY_S`` — far sources get deeper pipelines and bigger
+        windows by default."""
+        e = observed_pread_ewma(self.host)
+        return "remote_far" if e is not None and e > _FAR_LATENCY_S \
+            else "remote"
+
+    def _head(self) -> Tuple[int, Dict[str, str]]:
+        from .faults import FaultPolicy, retry_call
+
+        def once(_o, _s):
+            # breaker checked PER attempt (retries must not hammer a
+            # circuit their own failures just opened; the fail-fast
+            # error is retryable, so the loop's backoff rides it)
+            if not self._breaker.allow():
+                _account(_M_FAIL_FAST)
+                raise RemoteCircuitOpenError(
+                    f"circuit open for {self.host}", host=self.host,
+                    path=self.url)
+            try:
+                status, hdrs = self._transport.head()
+            except RemoteError:
+                raise
+            except (HTTPException, socket.timeout, TimeoutError,
+                    OSError) as e:
+                self._breaker.record_failure()
+                raise RemoteTransientError(
+                    f"HEAD failed: {e}", host=self.host,
+                    path=self.url) from e
+            if status == 429:
+                self._breaker.record_inconclusive()  # alive, just busy
+                raise RemoteThrottledError(
+                    "throttled on HEAD",
+                    retry_after=_retry_after(hdrs), host=self.host,
+                    status=status, path=self.url)
+            if 500 <= status < 600:
+                self._breaker.record_failure()
+                raise RemoteTransientError(
+                    "server error on HEAD", host=self.host, status=status,
+                    path=self.url)
+            if status != 200:
+                self._breaker.record_success()  # answering = alive
+                raise RemoteTerminalError(
+                    "HEAD failed", host=self.host, status=status,
+                    path=self.url)
+            self._breaker.record_success()
+            return status, hdrs
+
+        # opens run BEFORE any PolicySource wraps the source, so the
+        # HEAD carries its own small transient-retry (same shared loop)
+        return retry_call(once, 0, 0,
+                          FaultPolicy(max_retries=2, backoff_s=0.05))
+
+    # -------------------------------------------------------------- preads
+    def _fetch(self, offset: int, size: int,
+               attempt: int) -> bytes:
+        """One transport round trip, classified.  Raises RemoteError
+        subclasses; returns exactly ``size`` bytes."""
+        try:
+            status, hdrs, body = self._transport.get_range(offset, size)
+        except RemoteError:
+            raise
+        except (HTTPException, socket.timeout, TimeoutError,
+                ConnectionError) as e:
+            raise RemoteTransientError(
+                f"connection failure: {e}", host=self.host, attempt=attempt,
+                offset=offset, size=size, path=self.url) from e
+        except OSError as e:
+            raise RemoteTransientError(
+                f"transport failure: {e}", host=self.host, attempt=attempt,
+                offset=offset, size=size, path=self.url) from e
+        if status == 206:
+            cr = hdrs.get("content-range", "")
+            m = _CONTENT_RANGE.match(cr)
+            if m and int(m.group(1)) != offset:
+                # a misbehaving proxy/cache served the WRONG range:
+                # retryable — a fresh attempt usually lands on an honest
+                # path, and persistent wrong ranges exhaust retries into
+                # the degrade-or-raise path before any wrong byte is
+                # decoded
+                raise RemoteTransientError(
+                    f"wrong range: asked for {offset}, got {cr!r}",
+                    host=self.host, status=status, attempt=attempt,
+                    offset=offset, size=size, path=self.url)
+            data = body
+        elif status == 200:
+            # server ignored Range and sent the whole object: slice —
+            # correct, just wasteful (counted bytes are the USEFUL bytes)
+            data = body[offset : offset + size]
+        elif status == 416:
+            raise RemoteTerminalError(
+                "range not satisfiable", host=self.host, status=status,
+                attempt=attempt, offset=offset, size=size, path=self.url)
+        elif status == 429:
+            raise RemoteThrottledError(
+                "throttled", retry_after=_retry_after(hdrs),
+                host=self.host, status=status, attempt=attempt,
+                offset=offset, size=size, path=self.url)
+        elif 500 <= status < 600:
+            raise RemoteTransientError(
+                "server error", host=self.host, status=status,
+                attempt=attempt, offset=offset, size=size, path=self.url)
+        else:
+            raise RemoteTerminalError(
+                "request failed", host=self.host, status=status,
+                attempt=attempt, offset=offset, size=size, path=self.url)
+        if len(data) != size:
+            # truncated body: the headers promised the range, the socket
+            # delivered less — a torn connection, retryable
+            raise RemoteTransientError(
+                f"truncated body: wanted {size}, got {len(data)}",
+                host=self.host, status=status, attempt=attempt,
+                offset=offset, size=size, path=self.url)
+        return data
+
+    def _fetch_raced(self, offset: int, size: int) -> bytes:
+        """First-success-wins race between the primary attempt and (after
+        :func:`hedge_delay_s`) one hedged re-attempt.  The caller's wait
+        loop honors the active operation deadline — a stalled primary
+        cannot run past ``deadline_s``; abandoned attempts release their
+        budget grant and ledger bytes when their transport call returns."""
+        from .faults import active_deadline
+
+        delay = hedge_delay_s()
+        if delay is None:
+            return self._fetch(offset, size, 0)
+        cv = threading.Condition()
+        results: Dict[int, tuple] = {}
+        state = {"abandoned": False}
+
+        def abandoned() -> bool:
+            with cv:
+                return state["abandoned"]
+
+        def attempt(idx: int, charge: bool) -> None:
+            out = ("skip", None)
+            adm = None
+            grant = 0
+            charged = False
+            try:
+                if charge and not abandoned():
+                    # the hedge is an EXTRA in-flight copy of the bytes:
+                    # admitted through the unified read budget (its own
+                    # grant — the caller's covers only the primary) and
+                    # charged to the hedge ledger account.  give_up=
+                    # abandoned: once the primary wins, a still-QUEUED
+                    # hedge ticket withdraws instead of head-of-line-
+                    # blocking every other reader's admission behind a
+                    # grant nobody wants
+                    adm = read_admission()
+                    grant = adm.acquire(size, tier="hedge",
+                                        give_up=abandoned)
+                    _ACC_HEDGE.add(size)
+                    charged = True
+                if not abandoned():
+                    try:
+                        out = ("ok", self._fetch(offset, size, idx))
+                    except BaseException as e:
+                        out = ("err", e)
+            finally:
+                if charged:
+                    _ACC_HEDGE.sub(size)
+                if adm is not None:
+                    adm.release(grant, tier="hedge")
+                with cv:
+                    results[idx] = out
+                    cv.notify_all()
+
+        threading.Thread(target=attempt, args=(0, False), daemon=True,
+                         name="pq-remote-pread").start()
+        dl = active_deadline()
+        hedge_at = time.monotonic() + delay
+        launched = 1
+        while True:
+            with cv:
+                win = next((i for i in (0, 1)
+                            if results.get(i, ("",))[0] == "ok"), None)
+                if win is not None:
+                    state["abandoned"] = True  # loser skips its fetch
+                    if win == 1:
+                        _account(_M_HEDGES_WON)
+                    return results[win][1]
+                r0 = results.get(0)
+                if r0 is not None:
+                    # the primary finished without success: surface its
+                    # error NOW.  Hedges exist to cut tail latency, not
+                    # to mask failures — the retry policy owns recovery,
+                    # and waiting out a hedge that may be parked in the
+                    # admission queue (or a 30s socket timeout) would
+                    # turn a prompt failure into an unbounded hang.  An
+                    # abandoned hedge drains its budget grant and ledger
+                    # bytes in its own finally.
+                    state["abandoned"] = True
+                    if r0[0] == "err":
+                        raise r0[1]
+                    raise RemoteTransientError(
+                        "hedged read produced no result", host=self.host,
+                        offset=offset, size=size, path=self.url)
+                # a failed/skipped HEDGE keeps waiting on the primary.
+                # Sleep until the next event that needs action: the
+                # hedge launch, the deadline, or an attempt's notify —
+                # no polling when none is pending.
+                waits = []
+                if launched == 1:
+                    waits.append(hedge_at - time.monotonic())
+                if dl is not None:
+                    rem = dl.remaining()
+                    if rem is not None:
+                        waits.append(rem)
+                timeout = max(min(waits), 0.0) if waits else None
+                if timeout is None or timeout > 0:
+                    cv.wait(timeout=timeout)
+            if dl is not None and dl.expired():
+                with cv:
+                    state["abandoned"] = True
+                raise DeadlineError(
+                    f"deadline exceeded during hedged remote "
+                    f"pread({offset}, {size}) [host={self.host}]",
+                    path=self.url)
+            if launched == 1 and time.monotonic() >= hedge_at:
+                launched = 2
+                _account(_M_HEDGES)
+                threading.Thread(target=attempt, args=(1, True),
+                                 daemon=True,
+                                 name="pq-remote-hedge").start()
+
+    def pread(self, offset: int, size: int) -> bytes:
+        _check_read_args(offset, size)
+        if self._closed:
+            raise ValueError(f"read on closed source {self.url!r}")
+        if size == 0:
+            return b""
+        if not self._breaker.allow():
+            _account(_M_FAIL_FAST)
+            raise RemoteCircuitOpenError(
+                f"circuit open for {self.host}", host=self.host,
+                offset=offset, size=size, path=self.url)
+        t0 = time.perf_counter()
+        try:
+            data = self._fetch_raced(offset, size)
+        except RemoteThrottledError:
+            _account(_M_ERRORS["throttled"])
+            # a 429 proves the host alive: no streak movement, but a
+            # half-open probe slot must still release
+            self._breaker.record_inconclusive()
+            raise
+        except RemoteTransientError as e:
+            _account(_M_ERRORS["retryable"])
+            if e.status is not None and e.status < 500:
+                # the host ANSWERED (a 2xx whose body was torn or
+                # mis-ranged): retryable, but not a host-health failure —
+                # the breaker's contract is connection-class signals
+                # only, and tripping on body faults would fail-fast an
+                # answering host's every other file
+                self._breaker.record_inconclusive()
+            else:
+                self._breaker.record_failure()
+            raise
+        except RemoteTerminalError:
+            _account(_M_ERRORS["terminal"])
+            self._breaker.record_success()  # answering 4xx = alive host
+            raise
+        except BaseException:
+            # anything else (a deadline firing mid-race, caller
+            # teardown) says nothing about host health — but it must
+            # not strand the half-open probe slot
+            self._breaker.record_inconclusive()
+            raise
+        self._breaker.record_success()
+        _observe_pread(time.perf_counter() - t0, self.host)
+        _account(_M_PREADS)
+        _account(_M_BYTES, size)
+        _account_bytes(size)  # terminal source: read.bytes_read + op scope
+        return data
+
+    def size(self) -> int:
+        return self._size
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._transport.close()
+
+
+class ObjectStoreSource(HttpSource):
+    """Object-store reads ARE ranged HTTP: S3/GCS/R2-style endpoints
+    (presigned or public URLs) serve exactly the HEAD + ``Range`` GET
+    surface :class:`HttpSource` speaks, so this alias exists to name the
+    intent at call sites; behavior is identical."""
+
+
+def _retry_after(hdrs: Dict[str, str]) -> Optional[float]:
+    v = hdrs.get("retry-after", "").strip()
+    if not v:
+        return None
+    try:
+        return max(0.0, float(v))
+    except ValueError:
+        return None  # HTTP-date form: treat as unspecified
+
+
+def remote_debug() -> dict:
+    """Live remote-layer state for ``/debugz``: per-host breaker states
+    and failure streaks, hedge bytes in flight, and the observed pread
+    latency EWMA the hedge delay and prefetch latency class key on."""
+    with _LAT_LOCK:
+        ewmas = {h: round(v, 6) for h, v in sorted(_LAT_EWMA.items())}
+    return {
+        "breakers": {h: {"state": b.state,
+                         "consecutive_failures": b.consecutive_failures}
+                     for h, b in sorted(breakers().items())},
+        "hedge_in_flight_bytes": _ACC_HEDGE.resident,
+        "hedge_delay_s": hedge_delay_s(),
+        "pread_ewma_s": ewmas,
+    }

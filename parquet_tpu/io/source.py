@@ -11,10 +11,11 @@ import io
 import mmap as _mmap
 import os
 import threading
-import time
 from typing import Union
 
 import numpy as np
+
+from ..errors import ShortReadError
 
 # every terminal read accounts its bytes here (read.bytes_read + the
 # current op scope): wrappers (policy/retry/prefetch) delegate down to
@@ -70,7 +71,7 @@ class FileSource(Source):
         while got < size:
             chunk = os.pread(fd, size - got, offset + got)
             if not chunk:
-                raise IOError(
+                raise ShortReadError(
                     f"short read at {offset}: wanted {size}, got {got}")
             parts.append(chunk)
             got += len(chunk)
@@ -87,7 +88,7 @@ class FileSource(Source):
         while got < size:
             n = os.preadv(fd, [mv[got:]], offset + got)
             if n <= 0:
-                raise IOError(
+                raise ShortReadError(
                     f"short read at {offset}: wanted {size}, got {got}")
             got += n
         _account_bytes(size)
@@ -178,8 +179,8 @@ class MmapSource(Source):
         _check_read_args(offset, size)
         out = self._checked_view()[offset : offset + size]
         if len(out) != size:
-            raise IOError(f"short read at {offset}: wanted {size}, "
-                          f"got {len(out)}")
+            raise ShortReadError(f"short read at {offset}: wanted {size}, "
+                                 f"got {len(out)}")
         _account_bytes(size)
         return bytes(out)
 
@@ -188,8 +189,8 @@ class MmapSource(Source):
         out = np.frombuffer(self._checked_view()[offset : offset + size],
                             np.uint8)
         if len(out) != size:
-            raise IOError(f"short read at {offset}: wanted {size}, "
-                          f"got {len(out)}")
+            raise ShortReadError(f"short read at {offset}: wanted {size}, "
+                                 f"got {len(out)}")
         _account_bytes(size)
         return out
 
@@ -315,7 +316,7 @@ class BytesSource(Source):
         _check_read_args(offset, size)
         out = self._data[offset : offset + size]
         if len(out) != size:
-            raise IOError(f"short read at {offset}")
+            raise ShortReadError(f"short read at {offset}")
         _account_bytes(size)
         return bytes(out)
 
@@ -323,7 +324,7 @@ class BytesSource(Source):
         _check_read_args(offset, size)
         out = self._data[offset : offset + size]
         if len(out) != size:
-            raise IOError(f"short read at {offset}")
+            raise ShortReadError(f"short read at {offset}")
         _account_bytes(size)
         if not self._data.readonly:
             # a bytearray-backed source: decoded columns may lazily reference
@@ -359,7 +360,7 @@ class FileLikeSource(Source):
             f.seek(offset)
             out = f.read(size)
         if len(out) != size:
-            raise IOError(f"short read at {offset}")
+            raise ShortReadError(f"short read at {offset}")
         _account_bytes(size)
         return out
 
@@ -397,30 +398,28 @@ class RetryingSource(Source):
         self.retries = retries
         self.backoff_s = backoff_s
         self.jitter = jitter
+        self._policy = None  # built lazily: faults imports this module
 
     @property
     def path(self):
         return getattr(self.inner, "path", None)
 
     def _retry(self, fn, offset: int, size: int):
-        from .faults import FaultPolicy, is_corrupt_oserror  # deferred:
-        # faults imports source
+        # deferred: faults imports source
+        from .faults import _M_RETRIES, FaultPolicy, retry_call
+        from ..obs.scope import account as _saccount
 
-        delays = None  # built lazily: the happy path never constructs one
-        while True:
-            try:
-                return fn(offset, size)
-            except OSError as e:
-                if is_corrupt_oserror(e):
-                    raise  # corruption, not transience
-                if delays is None:
-                    delays = FaultPolicy(max_retries=self.retries,
-                                         backoff_s=self.backoff_s,
-                                         jitter=self.jitter).delays()
-                delay = next(delays, None)
-                if delay is None:
-                    raise
-                time.sleep(delay)
+        pol = self._policy
+        if pol is None:
+            pol = self._policy = FaultPolicy(max_retries=self.retries,
+                                             backoff_s=self.backoff_s,
+                                             jitter=self.jitter)
+        # one retry loop for the whole stack (retry_call): classification
+        # and backoff can't drift from PolicySource's, and these retries
+        # land in the same read.retries registry counter / op-scope
+        # mirror, so bare-source and policy retries account identically
+        return retry_call(fn, offset, size, pol,
+                          on_retry=lambda: _saccount(_M_RETRIES))
 
     def pread(self, offset: int, size: int) -> bytes:
         return self._retry(self.inner.pread, offset, size)
@@ -442,6 +441,13 @@ def as_source(obj) -> Source:
         return obj
     if isinstance(obj, (str, os.PathLike)):
         path = os.fspath(obj)
+        if path.startswith(("http://", "https://")):
+            # remote object over HTTP range requests: the whole read
+            # stack (prefetch, planner, lookup, caches, policies)
+            # composes over it unchanged — see io/remote.py
+            from .remote import HttpSource  # deferred: remote imports us
+
+            return HttpSource(path)
         # mmap by default: zero-copy page-cache views + madvise readahead
         # (see MmapSource).  PARQUET_TPU_MMAP=0 opts out; any mmap failure
         # (empty file, FIFO/device, exotic fs) falls back to pread

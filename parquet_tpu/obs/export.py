@@ -119,7 +119,9 @@ def debugz_snapshot(top_n: int = 10) -> dict:
       the effective budgets;
     - ``pool``: shared-pool width, tasks running, dispatch queue depth;
     - ``ops``: the op-scope table — every currently-open operation with
-      its age (a stuck op shows up here long before a timeout fires).
+      its age (a stuck op shows up here long before a timeout fires);
+    - ``remote``: per-host circuit-breaker states and failure streaks,
+      hedge bytes in flight, and the observed pread-latency EWMA.
 
     Imported lazily: the endpoint must answer even in a process that
     never touched the IO layer (families just render empty)."""
@@ -129,6 +131,12 @@ def debugz_snapshot(top_n: int = 10) -> dict:
 
     out = {"ledger": ledger_snapshot(), "pool": pool_debug(),
            "ops": live_ops()}
+    try:
+        from ..io.remote import remote_debug
+
+        out["remote"] = remote_debug()
+    except ImportError:  # pragma: no cover - the IO layer always imports
+        out["remote"] = {}
     adm = read_admission()
     out["admission"] = {
         "in_flight_bytes": adm.in_flight_bytes(),

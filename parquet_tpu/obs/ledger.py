@@ -61,6 +61,7 @@ CORE_ACCOUNTS = (
     ("write.pended", "encoded row groups queued behind slow sinks"),
     ("admission.in_flight", "bytes granted through the read gate"),
     ("trace.buffer", "buffered trace events (estimated bytes)"),
+    ("remote.hedge_in_flight", "bytes of in-flight hedged remote reads"),
 )
 
 # soft response: each reclaimer shrinks its tier to this fraction of its

@@ -439,6 +439,16 @@ _CORE_COUNTERS = (
     # the unified read gate (utils/pool.py): scan/stream-tier admissions
     # through the same FIFO budget the lookup path pioneered
     ("read.admission_waits", "scan/stream admissions that had to block"),
+    # remote sources (io/remote.py): request volume, hedging, breaker
+    # fail-fasts, and cache-identity movement — the serving fleet's
+    # object-store health dashboard families
+    ("remote.preads", "range requests served by remote sources"),
+    ("remote.bytes", "bytes fetched from remote sources"),
+    ("remote.hedges_issued", "hedged second attempts launched"),
+    ("remote.hedges_won", "preads whose hedge finished first"),
+    ("remote.breaker_fail_fast", "requests refused by an open circuit"),
+    ("remote.validator_changes", "remote rewrites detected by HEAD "
+     "validators (caches invalidated)"),
 )
 
 
@@ -448,6 +458,16 @@ def _declare_core() -> None:
     for route in ("host", "device"):
         REGISTRY.counter("route.chosen", labels={"route": route},
                          help="scans routed by the cost model")
+    for cls in ("retryable", "terminal", "throttled"):
+        REGISTRY.counter("remote.errors", labels={"class": cls},
+                         help="remote failures by retry class")
+    for state in ("open", "half_open", "closed"):
+        REGISTRY.counter("remote.breaker_transitions",
+                         labels={"state": state},
+                         help="per-host circuit-breaker transitions")
+    REGISTRY.histogram("remote.pread_s",
+                       help="remote range-request latency (seeds the "
+                            "adaptive hedge delay)")
     REGISTRY.histogram("pool.queue_wait_s",
                        help="shared-pool task queue->run wait")
     REGISTRY.histogram("lookup.find_rows_s",

@@ -277,11 +277,17 @@ class AdmissionController:
             return g
         return self._default_lookup if tier == "lookup" else 0
 
-    def acquire(self, nbytes: int, tier: str = "lookup") -> int:
+    def acquire(self, nbytes: int, tier: str = "lookup",
+                give_up=None) -> int:
         """Block FIFO until ``nbytes`` fit (and the ledger is below the
         hard watermark); returns the granted amount to hand back to
         :meth:`release` (0 when admission is disabled or the caller
-        already holds a grant)."""
+        already holds a grant).  ``give_up`` (a zero-arg predicate,
+        checked each wait lap) lets a waiter withdraw: its ticket leaves
+        the queue and 0 is granted — without it, an abandoned waiter
+        (a hedged read whose primary already won) would sit at the FIFO
+        head and head-of-line-block every other admission until
+        unrelated budget freed."""
         if _ADMISSION_HELD.get():
             return 0
         budget = self.budget_bytes(tier)
@@ -314,6 +320,12 @@ class AdmissionController:
                        and self._in_use + grant > g)
                    or (hard_gate
                        and _ledger.LEDGER.state() == "hard")):
+                if give_up is not None and give_up():
+                    # withdraw: the ticket must not keep later arrivals
+                    # waiting behind a grant nobody wants anymore
+                    self._queue.remove(ticket)
+                    self._cv.notify_all()
+                    return 0
                 waited = True
                 # bounded lap: hard-pressure state changes (env flips,
                 # cache evictions elsewhere) have no notifier of their

@@ -21,6 +21,7 @@ EX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ("telemetry.py", ["20000"]),
     ("serving_telemetry.py", ["20000"]),
     ("memory_budget.py", ["20000"]),
+    ("remote_read.py", ["20000"]),
     ("tpch_q1_tpu.py", ["50000"]),
 ])
 def test_example_runs(script, argv, tmp_path, monkeypatch, capsys):

@@ -568,7 +568,9 @@ def test_debugz_schema_and_endpoint(tmp_path):
     pf.read()
     find_rows(pf, "k", [3, 10**9], columns=["v"])
     snap = debugz_snapshot()
-    assert set(snap) == {"ledger", "caches", "admission", "pool", "ops"}
+    assert set(snap) == {"ledger", "caches", "admission", "pool", "ops",
+                         "remote"}
+    assert "breakers" in snap["remote"]
     led = snap["ledger"]
     assert led["state"] in ("ok", "soft", "hard")
     assert led["total_bytes"] == sum(a["resident_bytes"]

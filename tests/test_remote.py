@@ -1,0 +1,846 @@
+"""Remote-source suite: HttpSource/ObjectStoreSource over the hermetic
+in-process range server, with the full fault envelope — error
+classification, FaultPolicy retries/deadlines/degraded reads, hedged
+reads (budget- and ledger-accounted), the per-host circuit breaker, and
+cache identity keyed on HEAD validators.  Every network byte in this file
+stays on loopback (io/faults.py LocalRangeServer)."""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import (Dataset, DeadlineError, FaultInjectingRemoteTransport,
+                         FaultPolicy, LocalRangeServer, ParquetFile,
+                         ReadReport, RemoteCircuitOpenError, RemoteError,
+                         RemoteTerminalError, RemoteThrottledError,
+                         RemoteTransientError, ShortReadError)
+from parquet_tpu.errors import ReadIOError
+from parquet_tpu.io import cache as cache_mod
+from parquet_tpu.io import prefetch as pre_mod
+from parquet_tpu.io import remote as remote_mod
+from parquet_tpu.io.faults import active_deadline, is_corrupt_oserror
+from parquet_tpu.io.remote import (HttpSource, HttpTransport,
+                                   ObjectStoreSource, breaker_for,
+                                   reset_breakers)
+from parquet_tpu.io.source import BytesSource, FileLikeSource, as_source
+from parquet_tpu.obs.ledger import ledger_account
+from parquet_tpu.obs.metrics import metrics_snapshot
+
+N_ROWS = 10_000
+ROW_GROUP = 2_500  # 4 row groups
+
+FAST = FaultPolicy(max_retries=4, backoff_s=0.0)
+SKIP = FaultPolicy(max_retries=4, backoff_s=0.0, on_corrupt="skip_row_group")
+
+
+def _make_raw(offset: int = 0) -> bytes:
+    t = pa.table({
+        "x": pa.array(np.arange(offset, offset + N_ROWS, dtype=np.int64)),
+        "s": pa.array([f"v{i % 17}" for i in range(N_ROWS)]),
+    })
+    buf = io.BytesIO()
+    # gzip: zlib's checksum turns any payload bit flip into a loud decode
+    # error (deterministic corruption detection without page CRCs)
+    pq.write_table(t, buf, row_group_size=ROW_GROUP, compression="gzip")
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def raw() -> bytes:
+    return _make_raw()
+
+
+@pytest.fixture(scope="module")
+def clean(raw):
+    return ParquetFile(raw).read().to_arrow()
+
+
+@pytest.fixture()
+def server(raw):
+    with LocalRangeServer({"a.parquet": raw}) as srv:
+        yield srv
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch):
+    """Per-test isolation: fresh breakers/latency state, caches dropped,
+    hedging pinned OFF by default (hedge tests opt in explicitly — a
+    surprise hedge thread must not smear other assertions)."""
+    monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0")
+    reset_breakers()
+    remote_mod._reset_latency()
+    cache_mod.clear_caches()
+    yield
+    reset_breakers()
+    remote_mod._reset_latency()
+    remote_mod._reset_validators()
+    cache_mod.clear_caches()
+    remote_mod.drain_connection_pools()  # per-test servers die with
+    # their port: idle sockets to them are dead weight (and fds)
+
+
+def _chaos_source(url, **inject):
+    tr = FaultInjectingRemoteTransport(HttpTransport(url), **inject)
+    return HttpSource(url, transport=tr), tr
+
+
+# ---------------------------------------------------------------------------
+# plumbing: the source itself, as_source, Dataset composition
+# ---------------------------------------------------------------------------
+class TestHttpSource:
+    def test_pread_and_size(self, server, raw):
+        src = HttpSource(server.url("a.parquet"))
+        assert src.size() == len(raw)
+        assert src.pread(0, 4) == raw[:4]
+        assert src.pread(100, 999) == raw[100:1099]
+        assert bytes(src.pread_view(5, 17)) == raw[5:22]
+        src.close()
+        with pytest.raises(ValueError, match="closed"):
+            src.pread(0, 1)
+
+    def test_as_source_resolves_urls(self, server):
+        src = as_source(server.url("a.parquet"))
+        assert isinstance(src, HttpSource)
+        src.close()
+
+    def test_object_store_alias(self, server, raw):
+        src = ObjectStoreSource(server.url("a.parquet"))
+        assert isinstance(src, HttpSource)
+        assert src.pread(0, 8) == raw[:8]
+        src.close()
+
+    def test_stat_key_carries_validators(self, server, raw):
+        src = HttpSource(server.url("a.parquet"))
+        url, etag, last_modified, size = src.stat_key
+        assert url == server.url("a.parquet")
+        assert etag and last_modified and size == len(raw)
+        src.close()
+
+    def test_missing_object_is_terminal(self, server):
+        with pytest.raises(RemoteTerminalError) as ei:
+            HttpSource(server.url("nope.parquet"))
+        assert ei.value.status == 404
+        assert not ei.value.retryable
+
+    def test_range_ignoring_server_still_correct(self, raw):
+        # a server without Range support answers 200 + full body; the
+        # source slices — correct, just wasteful
+        with LocalRangeServer({"a.parquet": raw}, ignore_range=True) as srv:
+            src = HttpSource(srv.url("a.parquet"))
+            assert src.pread(100, 50) == raw[100:150]
+            got = ParquetFile(srv.url("a.parquet")).read()
+            assert got.to_arrow().equals(ParquetFile(raw).read().to_arrow())
+
+    def test_unsatisfiable_range_is_terminal(self, server, raw):
+        src = HttpSource(server.url("a.parquet"))
+        with pytest.raises(RemoteTerminalError) as ei:
+            src.pread(len(raw) + 10, 4)
+        assert ei.value.status == 416
+
+    def test_read_byte_identity(self, server, clean):
+        got = ParquetFile(server.url("a.parquet")).read().to_arrow()
+        assert got.equals(clean)
+
+    def test_iter_batches_byte_identity(self, server, clean):
+        pf = ParquetFile(server.url("a.parquet"))
+        got = pa.concat_tables(
+            b.to_arrow() for b in pf.iter_batches(batch_rows=1500))
+        assert got.equals(clean)
+
+    def test_dataset_over_urls(self, server, raw, clean):
+        server.put("b.parquet", _make_raw(offset=N_ROWS))
+        ds = Dataset([server.url("a.parquet"), server.url("b.parquet")])
+        assert ds.num_files == 2
+        assert ds.num_rows == 2 * N_ROWS
+        t = ds.read()
+        assert t.num_rows == 2 * N_ROWS
+        want = pa.concat_tables(
+            [clean, ParquetFile(_make_raw(offset=N_ROWS)).read().to_arrow()])
+        assert t.to_arrow().equals(want)
+
+    def test_expand_paths_passes_urls_through(self, tmp_path):
+        from parquet_tpu.dataset import expand_paths
+
+        url = "http://example.invalid/data/part-*.parquet"
+        assert expand_paths([url]) == [url]  # no glob, no lexists
+
+    def test_no_validator_means_no_cache_key(self, raw):
+        with LocalRangeServer({"a.parquet": raw},
+                              send_validators=False) as srv:
+            src = HttpSource(srv.url("a.parquet"))
+            assert src.stat_key is None
+            pf = ParquetFile(src)
+            assert pf._cache_key is None
+
+    def test_injected_transport_never_caches(self, server):
+        src, _tr = _chaos_source(server.url("a.parquet"))
+        assert src.stat_key is None
+        assert ParquetFile(src)._cache_key is None
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+class TestClassification:
+    @pytest.mark.parametrize("inject,cls", [
+        (dict(refuse_rate=1.0), RemoteTransientError),
+        (dict(reset_rate=1.0), RemoteTransientError),
+        (dict(status_rate=1.0, status_code=503), RemoteTransientError),
+        (dict(status_rate=1.0, status_code=500), RemoteTransientError),
+        (dict(status_rate=1.0, status_code=403), RemoteTerminalError),
+        (dict(status_rate=1.0, status_code=404), RemoteTerminalError),
+        (dict(throttle_rate=1.0), RemoteThrottledError),
+        (dict(truncate_rate=1.0), RemoteTransientError),
+        (dict(wrong_range_rate=1.0), RemoteTransientError),
+    ])
+    def test_fault_to_error_class(self, server, inject, cls):
+        src, _tr = _chaos_source(server.url("a.parquet"), **inject)
+        with pytest.raises(cls) as ei:
+            src.pread(0, 1024)
+        e = ei.value
+        assert isinstance(e, RemoteError) and isinstance(e, OSError)
+        assert e.host == server.url("a.parquet").split("/")[2]
+        # classification is what the one retry loop consults
+        assert is_corrupt_oserror(e) == (not e.retryable)
+
+    def test_error_message_names_range_and_host(self, server):
+        src, _tr = _chaos_source(server.url("a.parquet"), refuse_rate=1.0)
+        with pytest.raises(RemoteTransientError) as ei:
+            src.pread(128, 64)
+        msg = str(ei.value)
+        assert "range=128+64" in msg and "host=" in msg
+
+    def test_short_read_error_unifies_local_truncation(self):
+        with pytest.raises(ShortReadError) as ei:
+            BytesSource(b"abc").pread(0, 10)
+        assert isinstance(ei.value, ReadIOError)
+        assert isinstance(ei.value, IOError)  # legacy catchers keep working
+        assert is_corrupt_oserror(ei.value)
+        with pytest.raises(ShortReadError):
+            FileLikeSource(io.BytesIO(b"abc")).pread(1, 10)
+
+    def test_retrying_source_shares_the_loop(self, raw):
+        # the unified retry loop: RetryingSource retries now land in the
+        # same read.retries registry counter PolicySource feeds
+        from parquet_tpu import RetryingSource
+        from parquet_tpu.io.faults import FaultInjectingSource
+
+        inj = FaultInjectingSource(BytesSource(raw), seed=7, error_rate=0.5,
+                                   max_consecutive_errors=2)
+        before = metrics_snapshot()["counters"]["read.retries"]
+        rs = RetryingSource(inj, retries=4, backoff_s=0.0)
+        assert rs.pread(0, 4) == raw[:4]
+        for off in range(0, 4096, 512):
+            rs.pread(off, 256)
+        after = metrics_snapshot()["counters"]["read.retries"]
+        assert inj.stats.injected_errors > 0
+        assert after - before == inj.stats.injected_errors
+
+
+# ---------------------------------------------------------------------------
+# the chaos matrix: every fault class recovers or degrades per policy
+# ---------------------------------------------------------------------------
+class TestChaosMatrix:
+    @pytest.mark.parametrize("inject,stat", [
+        (dict(refuse_rate=0.3, max_consecutive=2), "refused"),
+        (dict(reset_rate=0.3, max_consecutive=2), "resets"),
+        (dict(status_rate=0.3, status_code=503, max_consecutive=2),
+         "statuses"),
+        (dict(throttle_rate=0.3, retry_after=0.0, max_consecutive=2),
+         "throttles"),
+        (dict(truncate_rate=0.3, max_consecutive=2), "truncated"),
+        (dict(wrong_range_rate=0.3, max_consecutive=2), "wrong_range"),
+        (dict(stall_s=0.02, stall_rate=0.3), "stalls"),
+    ])
+    def test_transient_class_recovers_byte_identical(self, server, clean,
+                                                     inject, stat):
+        src, tr = _chaos_source(server.url("a.parquet"), seed=11, **inject)
+        rep = ReadReport()
+        got = ParquetFile(src, policy=FAST).read(report=rep).to_arrow()
+        assert got.equals(clean)
+        assert getattr(tr.stats, stat) > 0, "chaos knob injected nothing"
+        if stat != "stalls":  # stalls are slow, not failed: no retries
+            assert rep.retries > 0
+
+    def test_flip_degrades_per_on_corrupt(self, server, clean):
+        # a bit-flipped body is persistent (attempt-0 keyed): recovery is
+        # impossible, so on_corrupt='raise' dies loud and
+        # 'skip_row_group' drops exactly the poisoned row groups
+        src, tr = _chaos_source(server.url("a.parquet"), seed=0,
+                                flip_rate=0.3)
+        with pytest.raises(Exception):
+            ParquetFile(src, policy=FAST).read()
+        src2, tr2 = _chaos_source(server.url("a.parquet"), seed=0,
+                                  flip_rate=0.3)
+        rep = ReadReport()
+        tab = ParquetFile(src2, policy=SKIP).read(report=rep)
+        assert tr2.stats.flipped > 0
+        assert rep.row_groups_skipped, "no row group hit despite flips"
+        assert rep.rows_dropped == ROW_GROUP * len(rep.row_groups_skipped)
+        assert tab.num_rows == N_ROWS - rep.rows_dropped
+
+    def test_persistent_terminal_skips_row_group(self, server):
+        # an unbounded wrong-range storm exhausts retries: under skip
+        # policy the read degrades instead of dying
+        src, tr = _chaos_source(server.url("a.parquet"), seed=2,
+                                wrong_range_rate=1.0)
+        rep = ReadReport()
+        # every data pread fails -> every row group drops -> the read
+        # raises only if NOTHING survived; footer preads happen at open
+        with pytest.raises(Exception):
+            ParquetFile(src, policy=SKIP).read(report=rep)
+
+    def test_seed_soak(self, server, clean):
+        injected = 0
+        for seed in range(6):
+            src, tr = _chaos_source(
+                server.url("a.parquet"), seed=seed, refuse_rate=0.15,
+                reset_rate=0.1, status_rate=0.1, truncate_rate=0.1,
+                max_consecutive=2)
+            got = ParquetFile(src, policy=FAST).read().to_arrow()
+            assert got.equals(clean), seed
+            injected += (tr.stats.refused + tr.stats.resets
+                         + tr.stats.statuses + tr.stats.truncated)
+        assert injected > 0
+
+    def test_retry_after_honored(self, server):
+        src, tr = _chaos_source(server.url("a.parquet"),
+                                throttle_rate=1.0, retry_after=0.15,
+                                max_consecutive=1)
+        t0 = time.perf_counter()
+        data = ParquetFile(src, policy=FaultPolicy(max_retries=2,
+                                                   backoff_s=0.0))
+        # opening alone performs preads; the 429s there must have slept
+        # at least one Retry-After
+        assert time.perf_counter() - t0 >= 0.15
+        assert tr.stats.throttles > 0
+
+    def test_remote_error_counters_by_class(self, server):
+        before = metrics_snapshot()["counters"]
+        src, _ = _chaos_source(server.url("a.parquet"), refuse_rate=1.0)
+        with pytest.raises(RemoteTransientError):
+            src.pread(0, 64)
+        src2, _ = _chaos_source(server.url("a.parquet"), status_rate=1.0,
+                                status_code=404)
+        with pytest.raises(RemoteTerminalError):
+            src2.pread(0, 64)
+        after = metrics_snapshot()["counters"]
+        assert after["remote.errors{class=retryable}"] \
+            > before.get("remote.errors{class=retryable}", 0)
+        assert after["remote.errors{class=terminal}"] \
+            > before.get("remote.errors{class=terminal}", 0)
+
+
+# ---------------------------------------------------------------------------
+# hedged reads
+# ---------------------------------------------------------------------------
+HEDGE_ACC = ledger_account("remote.hedge_in_flight")
+
+
+def _wait_drained(timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if HEDGE_ACC.resident == 0:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestHedgedReads:
+    def test_hedge_wins_on_stalled_primary(self, server, raw, monkeypatch):
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.02")
+        # first attempt of every range stalls; the hedged re-attempt is
+        # fast — first-wins must come back long before the stall ends
+        src, tr = _chaos_source(server.url("a.parquet"), stall_s=0.5,
+                                stall_attempts=1)
+        before = metrics_snapshot()["counters"]
+        t0 = time.perf_counter()
+        assert src.pread(0, 4096) == raw[:4096]
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.4, f"hedge did not cut the stall ({elapsed})"
+        after = metrics_snapshot()["counters"]
+        assert after["remote.hedges_issued"] > before["remote.hedges_issued"]
+        assert after["remote.hedges_won"] > before["remote.hedges_won"]
+        assert _wait_drained()
+
+    def test_hedging_cuts_tail_latency(self, server, raw, monkeypatch):
+        # the p99-cut acceptance proof, hermetic: a stall-injecting
+        # fixture where every range's FIRST attempt stalls.  With hedging
+        # off every pread eats the stall; with hedging on the worst pread
+        # is bounded by hedge-delay + a fast fetch.
+        stall = 0.25
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0")
+        src, _ = _chaos_source(server.url("a.parquet"), stall_s=stall,
+                               stall_attempts=1)
+        t0 = time.perf_counter()
+        src.pread(0, 1024)
+        unhedged = time.perf_counter() - t0
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.02")
+        src2, _ = _chaos_source(server.url("a.parquet"), stall_s=stall,
+                                stall_attempts=1)
+        worst = 0.0
+        for off in range(0, 8192, 1024):
+            t0 = time.perf_counter()
+            src2.pread(off, 1024)
+            worst = max(worst, time.perf_counter() - t0)
+        assert unhedged >= stall
+        assert worst < stall / 2, (worst, unhedged)
+        assert _wait_drained()
+
+    def test_adaptive_delay_seeds_from_observed_latency(self, server,
+                                                        monkeypatch):
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "auto")
+        remote_mod._H_PREAD_S._reset()  # isolate from earlier preads
+        # cold: the flat default
+        assert remote_mod.hedge_delay_s() == remote_mod.DEFAULT_HEDGE_DELAY_S
+        for _ in range(remote_mod._HEDGE_WARMUP_COUNT):
+            remote_mod._H_PREAD_S.observe(0.2)
+        d = remote_mod.hedge_delay_s()
+        assert 0.1 <= d <= 2.0  # p95 of the observed 0.2s distribution
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.123")
+        assert remote_mod.hedge_delay_s() == 0.123
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "off")
+        assert remote_mod.hedge_delay_s() is None
+
+    def test_hedge_budget_and_ledger_exact_under_hammer(self, server, raw,
+                                                        monkeypatch):
+        # 8 workers hammering hedged preads with the unified budget live:
+        # the hedge account must return to 0 and its high water stays
+        # under the budget (hedge grants are gated like any in-flight
+        # read bytes)
+        budget = 1 << 20
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.001")
+        monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", str(budget))
+        HEDGE_ACC._reset()
+        src, _ = _chaos_source(server.url("a.parquet"), stall_s=0.05,
+                               stall_rate=0.5, seed=3)
+        errs = []
+        span = 4096
+        top = len(raw) - span
+
+        def worker(widx):
+            try:
+                for j in range(8):
+                    off = ((widx * 8 + j) * 7919) % top  # in-bounds spans
+                    assert src.pread(off, span) == raw[off : off + span]
+            except Exception as e:  # pragma: no cover - assertion aid
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert _wait_drained(), "hedge_in_flight did not drain to 0"
+        assert HEDGE_ACC.high_water <= budget
+        assert metrics_snapshot()["counters"]["remote.hedges_issued"] > 0
+
+    def test_deadline_with_stalled_primary_and_hedge(self, server,
+                                                     monkeypatch):
+        # satellite: a hedged read whose primary AND hedge stall must
+        # still honor deadline_s promptly, raise with the remote context,
+        # and leak neither connections nor hedge ledger bytes
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.02")
+        src, _ = _chaos_source(server.url("a.parquet"), stall_s=0.6,
+                               stall_attempts=4)
+        pf = ParquetFile(HttpSource(server.url("a.parquet")))  # clean open
+        pf.close()
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineError) as ei:
+            ParquetFile(src, policy=FaultPolicy(deadline_s=0.15,
+                                                backoff_s=0.0)).read()
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.5, f"deadline not prompt ({elapsed})"
+        assert "host=" in str(ei.value) or "hedged" in str(ei.value)
+        assert _wait_drained(), "deadline leaked hedge_in_flight bytes"
+
+    def test_abandoned_hedge_withdraws_from_admission_queue(
+            self, server, raw, monkeypatch):
+        # a hedge parked in the admission FIFO whose primary already won
+        # must WITHDRAW its ticket — not head-of-line-block every other
+        # reader's admission until unrelated budget frees
+        from parquet_tpu.utils.pool import read_admission
+
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.01")
+        monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", str(1 << 20))
+        adm = read_admission()
+        # saturate the budget so the hedge's acquire must queue
+        held = adm.acquire(1 << 20, tier="scan")
+        try:
+            src, _ = _chaos_source(server.url("a.parquet"), stall_s=0.05,
+                                   stall_attempts=1)
+            assert src.pread(0, 4096) == raw[:4096]  # primary (slow) wins
+            # the abandoned hedge must clear the queue promptly even
+            # though the budget never freed
+            t0 = time.monotonic()
+            while adm.queue_depth() > 0 and time.monotonic() - t0 < 2.0:
+                time.sleep(0.01)
+            assert adm.queue_depth() == 0, \
+                "abandoned hedge ticket stuck at the admission head"
+            assert _wait_drained()
+        finally:
+            adm.release(held, tier="scan")
+
+    def test_deadline_mid_chaos_drains_ledger(self, server, monkeypatch):
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.01")
+        src, _ = _chaos_source(server.url("a.parquet"), seed=9,
+                               stall_s=0.3, stall_rate=0.5,
+                               refuse_rate=0.2, max_consecutive=2)
+        try:
+            ParquetFile(src, policy=FaultPolicy(
+                deadline_s=0.1, max_retries=4, backoff_s=0.0)).read()
+        except (DeadlineError, RemoteError, OSError):
+            pass
+        assert _wait_drained(), "chaos deadline leaked hedge bytes"
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_open_failfast_halfopen_close_cycle(self, server, raw,
+                                                monkeypatch):
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER", "3")
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", "0.1")
+        url = server.url("a.parquet")
+        tr = FaultInjectingRemoteTransport(HttpTransport(url),
+                                           refuse_rate=1.0)
+        src = HttpSource(url, transport=tr)
+        breaker = breaker_for(src.host)
+        before = metrics_snapshot()["counters"]
+        # three consecutive failures open the circuit
+        for _ in range(3):
+            with pytest.raises(RemoteTransientError):
+                src.pread(0, 64)
+        assert breaker.state == "open"
+        # open: fail fast WITHOUT touching the transport
+        n = tr.stats.requests
+        with pytest.raises(RemoteCircuitOpenError):
+            src.pread(0, 64)
+        assert tr.stats.requests == n, "open circuit touched the network"
+        # cooldown elapses; heal the transport; the half-open probe closes
+        time.sleep(0.12)
+        tr.refuse_rate = 0.0
+        assert src.pread(0, 64) == raw[:64]
+        assert breaker.state == "closed"
+        after = metrics_snapshot()["counters"]
+        for state in ("open", "half_open", "closed"):
+            key = f"remote.breaker_transitions{{state={state}}}"
+            assert after[key] > before.get(key, 0), state
+        assert after["remote.breaker_fail_fast"] \
+            > before.get("remote.breaker_fail_fast", 0)
+
+    def test_halfopen_failure_reopens(self, server, monkeypatch):
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER", "2")
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", "0.05")
+        url = server.url("a.parquet")
+        tr = FaultInjectingRemoteTransport(HttpTransport(url),
+                                           refuse_rate=1.0)
+        src = HttpSource(url, transport=tr)
+        breaker = breaker_for(src.host)
+        for _ in range(2):
+            with pytest.raises(RemoteTransientError):
+                src.pread(0, 64)
+        assert breaker.state == "open"
+        time.sleep(0.06)
+        with pytest.raises(RemoteTransientError):  # the probe fails
+            src.pread(0, 64)
+        assert breaker.state == "open"  # re-opened, fresh cooldown
+
+    def test_body_faults_on_answering_host_do_not_trip_breaker(
+            self, server, monkeypatch):
+        # truncation/wrong-range arrive WITH a response: the host is
+        # reachable, so these retryable body faults must not open the
+        # circuit and fail-fast the host's every other file
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER", "2")
+        src, _ = _chaos_source(server.url("a.parquet"),
+                               truncate_rate=1.0)
+        breaker = breaker_for(src.host)
+        for _ in range(5):
+            with pytest.raises(RemoteTransientError):
+                src.pread(0, 4096)
+        assert breaker.state == "closed"
+        src2, _ = _chaos_source(server.url("a.parquet"),
+                                wrong_range_rate=1.0)
+        for _ in range(5):
+            with pytest.raises(RemoteTransientError):
+                src2.pread(0, 4096)
+        assert breaker.state == "closed"
+
+    def test_terminal_responses_do_not_trip_breaker(self, server,
+                                                    monkeypatch):
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER", "2")
+        src, _ = _chaos_source(server.url("a.parquet"), status_rate=1.0,
+                               status_code=404)
+        breaker = breaker_for(src.host)
+        for _ in range(4):
+            with pytest.raises(RemoteTerminalError):
+                src.pread(0, 64)
+        assert breaker.state == "closed"  # a 404 proves the host alive
+
+    def test_throttled_halfopen_probe_does_not_wedge(self, server, raw,
+                                                     monkeypatch):
+        # a probe that ends 429 (or any inconclusive outcome) proves
+        # nothing about host health — it must release the probe slot, or
+        # the host stays fail-fast forever
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER", "2")
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", "0.05")
+        url = server.url("a.parquet")
+        tr = FaultInjectingRemoteTransport(HttpTransport(url),
+                                           refuse_rate=1.0)
+        src = HttpSource(url, transport=tr)
+        breaker = breaker_for(src.host)
+        for _ in range(2):
+            with pytest.raises(RemoteTransientError):
+                src.pread(0, 64)
+        assert breaker.state == "open"
+        time.sleep(0.06)
+        tr.refuse_rate = 0.0
+        tr.throttle_rate = 1.0  # the half-open probe gets a 429
+        with pytest.raises(RemoteThrottledError):
+            src.pread(0, 64)
+        assert breaker.state == "half_open"
+        tr.throttle_rate = 0.0  # healthy again: the NEXT probe closes
+        assert src.pread(0, 64) == raw[:64]
+        assert breaker.state == "closed"
+
+    def test_stale_pooled_connection_retried(self, raw):
+        # a keep-alive connection the server idled out fails its first
+        # reuse — the transport retries on a fresh one instead of
+        # surfacing a spurious failure from a healthy host
+        with LocalRangeServer({"a.parquet": raw}) as srv:
+            tr = HttpTransport(srv.url("a.parquet"))
+            assert tr.get_range(0, 16)[2] == raw[:16]
+            assert tr.idle_connections() == 1
+            # kill the pooled socket from our side: the next reuse hits
+            # a dead connection exactly like a server-side idle close
+            dead = tr._pool.get()
+            dead.sock.close()
+            tr._pool.put(dead)
+            status, _hdrs, body = tr.get_range(16, 16)
+            assert status == 206 and body == raw[16:32]
+
+    def test_pool_is_shared_per_host(self, server, raw):
+        t1 = HttpTransport(server.url("a.parquet"))
+        server.put("c.parquet", raw)
+        t2 = HttpTransport(server.url("c.parquet"))
+        t1.get_range(0, 8)
+        # the second transport reuses the first's pooled connection
+        gets = server.request_count(method="GET")
+        assert t2.idle_connections() == 1
+        assert t2.get_range(0, 8)[2] == raw[:8]
+        assert server.request_count(method="GET") == gets + 1
+
+    def test_primary_failure_surfaces_before_hedge_finishes(
+            self, server, monkeypatch):
+        # a failed primary must raise promptly even while the hedge is
+        # still stalled — hedges cut tail latency, they don't mask
+        # failures behind an unbounded wait
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", "0.01")
+        url = server.url("a.parquet")
+
+        class SplitTransport:
+            """attempt 0: slow failure; attempt 1 (the hedge): a long
+            stall — orderable because attempts key the behavior."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.host = inner.host
+                self._lock = threading.Lock()
+                self._attempts = {}
+
+            def head(self):
+                return self.inner.head()
+
+            def get_range(self, offset, size):
+                with self._lock:
+                    a = self._attempts.get((offset, size), 0)
+                    self._attempts[(offset, size)] = a + 1
+                if a == 0:
+                    time.sleep(0.05)  # outlive the hedge delay...
+                    raise ConnectionResetError("primary dies")
+                time.sleep(2.0)  # ...while the hedge stalls hard
+                return self.inner.get_range(offset, size)
+
+            def close(self):
+                self.inner.close()
+
+        src = HttpSource(url, transport=SplitTransport(HttpTransport(url)))
+        t0 = time.perf_counter()
+        with pytest.raises(RemoteTransientError, match="primary dies"):
+            src.pread(0, 256)
+        assert time.perf_counter() - t0 < 1.0, \
+            "primary failure waited out the stalled hedge"
+        assert _wait_drained(timeout=4.0)
+
+    def test_open_circuit_never_blocks_healthy_host(self, raw, clean,
+                                                    monkeypatch):
+        # acceptance: two hosts (two servers = two ports), one forced
+        # open — the healthy host's file reads fine, the dead one skips
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER", "1")
+        monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", "30")
+        with LocalRangeServer({"a.parquet": raw}) as healthy, \
+                LocalRangeServer({"a.parquet": raw}) as doomed:
+            bad_url = doomed.url("a.parquet")
+
+            def open_fn(path):
+                if path == bad_url:
+                    tr = FaultInjectingRemoteTransport(
+                        HttpTransport(path), refuse_rate=1.0)
+                    return ParquetFile(HttpSource(path, transport=tr),
+                                       policy=SKIP)
+                return ParquetFile(path, policy=SKIP)
+
+            # trip the doomed host's breaker open
+            with pytest.raises(RemoteTransientError):
+                HttpSource(bad_url,
+                           transport=FaultInjectingRemoteTransport(
+                               HttpTransport(bad_url),
+                               refuse_rate=1.0)).pread(0, 64)
+            assert breaker_for(
+                bad_url.split("/")[2]).state == "open"
+            ds = Dataset([healthy.url("a.parquet"), bad_url],
+                         policy=SKIP, open_fn=open_fn)
+            rep = ReadReport()
+            t = ds.read(report=rep)
+            assert t.to_arrow().equals(clean)
+            assert rep.files_skipped == [bad_url]
+            assert breaker_for(
+                healthy.url("a.parquet").split("/")[2]).state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# cache identity: HEAD validators play the fstat role
+# ---------------------------------------------------------------------------
+class TestRemoteCaching:
+    def test_warm_reopen_serves_from_caches(self, server, clean):
+        url = server.url("a.parquet")
+        ParquetFile(url).read()
+        gets = server.request_count(method="GET")
+        st0 = cache_mod.cache_stats()
+        got = ParquetFile(url).read().to_arrow()
+        assert got.equals(clean)
+        st1 = cache_mod.cache_stats()
+        assert server.request_count(method="GET") == gets, \
+            "warm re-read touched the network"
+        assert st1.footer_hits > st0.footer_hits
+        assert st1.chunk_hits > st0.chunk_hits
+
+    def test_changed_validator_invalidates(self, server):
+        url = server.url("a.parquet")
+        x1 = ParquetFile(url).read().to_arrow().column("x")[0].as_py()
+        before = metrics_snapshot()["counters"]
+        # REPLACE the object: new bytes, new ETag/Last-Modified
+        server.put("a.parquet", _make_raw(offset=777))
+        x2 = ParquetFile(url).read().to_arrow().column("x")[0].as_py()
+        assert x2 == 777 and x1 == 0, "stale cache served old bytes"
+        after = metrics_snapshot()["counters"]
+        assert after["remote.validator_changes"] \
+            > before.get("remote.validator_changes", 0)
+
+    def test_validator_memo_is_bounded(self, monkeypatch):
+        monkeypatch.setattr(remote_mod, "_VALIDATOR_CAP", 8)
+        for i in range(40):
+            remote_mod._note_validator(f"http://h/{i}", ("e", "m", i))
+        with remote_mod._VALIDATORS_LOCK:
+            assert len(remote_mod._VALIDATORS) == 8
+
+    def test_lookup_path_composes(self, server, raw):
+        from parquet_tpu import find_rows
+
+        pf = ParquetFile(server.url("a.parquet"))
+        res = find_rows(pf, "x", [0, 4242, 9999, 123456])
+        assert [h.rows.tolist() for h in res.hits] == \
+            [[0], [4242], [9999], []]
+
+    def test_scan_planner_composes(self, server, raw):
+        pf = ParquetFile(server.url("a.parquet"),
+                         options=__import__("parquet_tpu").ReadOptions(
+                             skip_page_index=False))
+        from parquet_tpu import scan_expr, col
+
+        got = scan_expr(pf, (col("x") >= 100) & (col("x") <= 110))
+        assert got["s"] == [f"v{i % 17}".encode()
+                            for i in range(100, 111)]
+
+
+# ---------------------------------------------------------------------------
+# prefetch latency classes
+# ---------------------------------------------------------------------------
+class TestRemotePrefetch:
+    def test_remote_chain_rings_even_on_one_core(self, server, monkeypatch):
+        src = HttpSource(server.url("a.parquet"))
+        monkeypatch.setattr(pre_mod, "available_cpus", lambda: 1,
+                            raising=False)
+        pre = pre_mod.make_prefetcher(src)
+        try:
+            assert pre is not None and pre.backend == "ring"
+            assert pre.latency_class in ("remote", "remote_far")
+            # remote baseline: deeper pipeline, bigger windows than local
+            assert pre.depth >= pre_mod._CLASS_DEFAULTS["remote"][0]
+            assert pre.window_bytes >= pre_mod._CLASS_DEFAULTS["remote"][1]
+        finally:
+            pre.close()
+            src.close()
+
+    def test_latency_class_follows_observed_ewma(self, server):
+        src = HttpSource(server.url("a.parquet"))
+        assert src.latency_class == "remote"  # loopback is near
+        for _ in range(50):
+            remote_mod._observe_pread(0.2, src.host)
+        assert src.latency_class == "remote_far"
+        # per HOST: a far bucket must not reclassify another host's chain
+        assert remote_mod.observed_pread_ewma("elsewhere:80") is None
+        src.close()
+
+    def test_autotune_state_is_per_class(self):
+        tuner = pre_mod.prefetch_autotune()
+        tuner.reset()
+        try:
+            stats = pre_mod.ReadStats(windows_issued=4, pool_wait_s=1.0)
+            tuner.observe(stats, "remote")
+            assert tuner.suggest("remote") == (
+                pre_mod._CLASS_DEFAULTS["remote"][0] + 1, None)
+            # the local class is untouched by remote feedback
+            assert tuner.suggest() == (None, None)
+            assert tuner.suggest("local") == (None, None)
+        finally:
+            tuner.reset()
+
+    def test_prefetched_remote_drain_byte_identical(self, server, clean,
+                                                    monkeypatch):
+        monkeypatch.setenv("PARQUET_TPU_PREFETCH", "ring")
+        pf = ParquetFile(server.url("a.parquet"))
+        got = pa.concat_tables(
+            b.to_arrow() for b in pf.iter_batches(batch_rows=1700))
+        assert got.equals(clean)
+
+
+# ---------------------------------------------------------------------------
+# deadline plumbing
+# ---------------------------------------------------------------------------
+class TestDeadlinePlumbing:
+    def test_active_deadline_visible_below_policy(self, raw):
+        from parquet_tpu.io.faults import PolicySource
+
+        seen = []
+
+        class Spy(BytesSource):
+            def pread(self, offset, size):
+                seen.append(active_deadline())
+                return super().pread(offset, size)
+
+        ps = PolicySource(Spy(raw), FaultPolicy(deadline_s=5.0))
+        with ps.operation():
+            ps.pread(0, 4)
+        assert seen and seen[0] is not None
+        assert seen[0].remaining() > 0
+        # and cleared outside the operation scope
+        assert active_deadline() is None
