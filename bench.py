@@ -1049,6 +1049,80 @@ def _cfg10(n):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _cfg11(n):
+    """Writable tables (ISSUE 12): ingestion + compaction A/B.  Batched
+    DatasetWriter ingest (4 sorted part-files, 4 atomic manifest commits)
+    then one compaction pass, vs a one-shot SortingWriter write of the
+    same rows — byte-identity of the compacted table (rows AND order)
+    asserted against the one-shot file.  Reports ingest throughput,
+    per-phase seconds, and the commit-latency meter."""
+    import shutil
+    import tempfile
+
+    from parquet_tpu import (DatasetWriter, ParquetFile, compact_table,
+                             open_table)
+    from parquet_tpu.algebra.buffer import SortingColumn
+    from parquet_tpu.algebra.sorting import SortingWriter
+    from parquet_tpu.io.manifest import read_manifest
+    from parquet_tpu.io.writer import (WriterOptions, columns_from_arrow,
+                                       schema_from_arrow)
+    from parquet_tpu.obs import metrics_snapshot
+
+    n = max(n, 40_000)
+    batches = 4
+    rng = np.random.default_rng(31)
+    k = rng.permutation(n).astype(np.int64)
+    t = pa.table({"k": pa.array(k),
+                  "v": pa.array(k.astype(np.float64) * 0.5),
+                  "s": pa.array([f"acct{int(x) % 997:04d}" for x in k])})
+    schema = schema_from_arrow(t.schema)
+    opts = WriterOptions(compression="snappy",
+                         row_group_size=max(n // 4, 1),
+                         data_page_size=8 * 1024)
+    d = tempfile.mkdtemp(prefix="parquet_tpu_bench_table_")
+    try:
+        tdir = os.path.join(d, "table")
+        step = (n + batches - 1) // batches
+        t0 = time.perf_counter()
+        w = DatasetWriter(tdir, schema, sorting=[SortingColumn("k")],
+                          options=opts, rows_per_file=step)
+        for start in range(0, n, step):
+            w.write_arrow(t.slice(start, min(step, n - start)))
+            w.commit()
+        w.close()
+        ingest_s = time.perf_counter() - t0
+        parts_before = len(read_manifest(tdir).files)
+        t0 = time.perf_counter()
+        compacted = compact_table(tdir)
+        compact_s = time.perf_counter() - t0
+        assert compacted is not None and len(compacted.files) == 1
+        one = os.path.join(d, "oneshot.parquet")
+        t0 = time.perf_counter()
+        sw = SortingWriter(one, schema, [SortingColumn("k")], opts)
+        sw.write(columns_from_arrow(t, schema), n)
+        sw.close()
+        oneshot_s = time.perf_counter() - t0
+        got = open_table(tdir).read().to_arrow()
+        want = ParquetFile(one).read().to_arrow()
+        assert got.equals(want), "compacted table != one-shot sorted write"
+        in_bytes = t.nbytes
+        hist = metrics_snapshot()["histograms"].get("table.commit_s", {})
+        return {
+            "rows": n, "batches": batches,
+            "parts_before_compact": parts_before,
+            "ingest_s": round(ingest_s, 4),
+            "compact_s": round(compact_s, 4),
+            "oneshot_s": round(oneshot_s, 4),
+            "byte_identical": True,
+            "GBps": round(in_bytes / ingest_s / 1e9, 4),
+            "compact_vs_oneshot": round(oneshot_s / compact_s, 2)
+            if compact_s > 0 else None,
+            "commit_p99_s": hist.get("p99"),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 _CAL0 = None
 
 
@@ -1156,6 +1230,7 @@ def main():
     _run("8_dataset", _cfg8, max(n_rows // 4, 64))
     _run("9_planner", _cfg9, max(n_rows // 4, 64))
     _run("10_lookup", _cfg10, max(n_rows // 4, 64))
+    _run("11_table", _cfg11, max(n_rows // 4, 64))
 
     head = configs["1_int64_plain"]
     print(json.dumps({

@@ -1,0 +1,106 @@
+"""Writable tables (ISSUE 12): continuous ingestion into a table
+directory with manifest-level atomic commit, snapshot-isolated readers,
+background compaction, and crash recovery.
+
+The flow: ingest batches through a DatasetWriter (sorted part-files,
+invisible until commit) -> query a snapshot-pinned open (manifest zone
+maps prune parts with zero footer reads) -> compact N parts into one
+sorted file through the same commit path -> simulate a mid-ingest crash
+and recover by sweeping orphans.
+
+Run: python examples/table_ingest.py [rows_per_batch]
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from parquet_tpu import (DatasetWriter, SortingColumn, WriterOptions, col,
+                         compact_table, open_table, recover_table)
+from parquet_tpu.io.faults import InjectedWriterCrash, SharedCrashState
+from parquet_tpu.io.manifest import read_manifest
+from parquet_tpu.io.writer import schema_from_arrow
+
+
+def make_batch(rows: int, start: int, rng) -> "object":
+    import pyarrow as pa
+
+    k = np.arange(start, start + rows, dtype=np.int64)
+    rng.shuffle(k)  # arrival order is not sorted; the table's sort spec is
+    return pa.table({"k": pa.array(k),
+                     "v": pa.array(k.astype(np.float64) * 0.5),
+                     "s": pa.array([f"acct{int(x) % 997:04d}" for x in k])})
+
+
+def main() -> None:
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    rng = np.random.default_rng(0)
+    d = tempfile.mkdtemp(prefix="parquet_tpu_table_")
+    schema = schema_from_arrow(make_batch(4, 0, rng).schema)
+    opts = WriterOptions(compression="snappy", data_page_size=8 * 1024,
+                        row_group_size=max(rows // 2, 1))
+
+    # --- ingest: 4 batches, 4 commits — each commit is ONE atomic
+    # manifest rename; nothing is visible until it lands
+    t0 = time.perf_counter()
+    w = DatasetWriter(d, schema, sorting=[SortingColumn("k")],
+                      options=opts, rows_per_file=rows)
+    for j in range(4):
+        w.write_arrow(make_batch(rows, j * rows, rng))
+        m = w.commit()
+        print(f"commit v{m.version}: {len(m.files)} part(s), "
+              f"{m.num_rows} rows")
+    w.close()
+    print(f"ingested {4 * rows} rows in {time.perf_counter() - t0:.2f}s")
+
+    # --- snapshot-pinned query: the manifest's zone maps prune parts
+    # WITHOUT opening them, and sorted parts answer lookups by in-page
+    # binary search
+    ds = open_table(d)
+    lo, hi = 2 * rows + 10, 2 * rows + 500
+    keep = ds.prune(where=col("k").between(lo, hi))
+    print(f"prune k in [{lo}, {hi}]: {len(keep)} of {ds.num_files} "
+          f"part(s) survive (zone maps; dropped parts never opened)")
+    res = ds.find_rows("k", [7, lo, 10 ** 12], columns=["v"])
+    print(f"lookup: {res.rows_total} row(s), "
+          f"{res.counters['binary_search_hits']} in-page binary searches")
+
+    # --- compaction: N sorted parts -> 1 sorted file, same commit path;
+    # the pinned reader above keeps draining ITS snapshot regardless
+    before = ds.read().to_arrow()
+    m = compact_table(d)
+    print(f"compacted to v{m.version}: {len(m.files)} part(s)")
+    assert ds.read().to_arrow().equals(before)  # snapshot isolation
+    ds2 = open_table(d)
+    assert ds2.read().to_arrow().num_rows == 4 * rows
+    print(f"pinned reader still sees v{ds.snapshot_version}; fresh open "
+          f"sees v{ds2.snapshot_version}")
+
+    # --- crash + recover: a writer dies mid-ingest (shared crash budget
+    # across part files AND the manifest); the table stays at the old
+    # snapshot and recovery sweeps the orphans
+    state = SharedCrashState(crash_at_byte=20_000)
+    wc = DatasetWriter(d, schema, sorting=[SortingColumn("k")],
+                       options=opts, rows_per_file=rows,
+                       _sink_wrap=state.wrap)
+    try:
+        wc.write_arrow(make_batch(rows, 4 * rows, rng))
+        wc.commit()
+        raise SystemExit("crash did not fire")
+    except InjectedWriterCrash:
+        pass
+    swept = recover_table(d)
+    live = read_manifest(d)
+    print(f"crashed at byte 20000 mid-ingest: table still v{live.version} "
+          f"({live.num_rows} rows), recovery swept "
+          f"{len(swept)} orphan(s)")
+    assert open_table(d).read().to_arrow().num_rows == 4 * rows
+
+
+if __name__ == "__main__":
+    main()

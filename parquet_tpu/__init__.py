@@ -72,6 +72,14 @@ Datasets & caching
   cache_stats/clear_caches (shared footer cache keyed by open-time fstat
   (path, inode, mtime_ns, size) + bounded decoded-chunk LRU,
   ``PARQUET_TPU_CHUNK_CACHE`` bytes)
+Writable tables
+  DatasetWriter (sharded sorted ingestion with manifest-level atomic
+  commit: part-files land under unique names, ONE manifest rename
+  publishes the snapshot), open_table (snapshot-pinned reads; manifest
+  zone maps prune parts with zero footer reads), compact_table/
+  BackgroundCompactor (N parts -> 1 sorted file via merge_files, same
+  commit path, conflict-safe), recover_table (crash recovery = orphan
+  sweep), Manifest/ManifestEntry/read_manifest (io/manifest.py)
 Durability & integrity
   AtomicFileSink (fsync + atomic rename commit; path sinks default),
   FileSink, WriteError, FaultInjectingSink/InjectedWriterCrash (write-side
@@ -106,7 +114,8 @@ from .errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
 from .io.faults import (FaultInjectingRemoteTransport, FaultInjectingSink,
                         FaultInjectingSource, FaultPolicy,
                         InjectedWriterCrash, LocalRangeServer, PolicySource,
-                        ReadReport, SinkFaultStats, crash_consistency_check)
+                        ReadReport, SharedCrashState, SinkFaultStats,
+                        crash_consistency_check, table_crash_check)
 from .io.remote import (CircuitBreaker, HttpSource, HttpTransport,
                         ObjectStoreSource)
 from .io.integrity import IntegrityIssue, IntegrityReport, verify_file
@@ -125,6 +134,9 @@ from .io.prefetch import PrefetchSource, ReadStats
 from .io.cache import CacheStats, cache_stats, clear_caches
 from .io.source import MmapSource, RetryingSource, Source
 from .dataset import Dataset
+from .dataset_writer import (BackgroundCompactor, DatasetWriter,
+                             compact_table, open_table, recover_table)
+from .io.manifest import Manifest, ManifestEntry, read_manifest
 from .io.planner import (CostInputs, RouteDecision, ScanPlan, ScanPlanner,
                          choose_route, route_history)
 from .algebra.expr import And, Col, Expr, Not, Or, col

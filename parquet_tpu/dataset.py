@@ -144,6 +144,11 @@ class Dataset:
         self._files: Dict[int, ParquetFile] = {}
         self._lock = threading.Lock()
         self._schema_sig = None
+        # manifest-backed datasets (dataset_writer.open_table): per-path
+        # zone-map entries for zero-IO pruning, and the pinned snapshot's
+        # version (None for plain path/glob datasets)
+        self._file_stats = None
+        self.snapshot_version = None
 
     # ------------------------------------------------------------- opening
     @classmethod
@@ -157,6 +162,8 @@ class Dataset:
         obj._files = {}
         obj._lock = threading.Lock()
         obj._schema_sig = None
+        obj._file_stats = None
+        obj.snapshot_version = None
         return obj
 
     def file(self, i: int) -> ParquetFile:
@@ -227,8 +234,13 @@ class Dataset:
         may be empty when ``count`` exceeds the file count."""
         if not 0 <= index < count:
             raise ValueError(f"shard index {index} out of range [0, {count})")
-        return Dataset._from_paths(self.paths[index::count], self.options,
-                                   self.policy, self._open_fn)
+        sub = Dataset._from_paths(self.paths[index::count], self.options,
+                                  self.policy, self._open_fn)
+        # a shard of a snapshot-pinned table keeps its zone maps and
+        # snapshot identity (the per-host mesh split must prune the same)
+        sub._file_stats = self._file_stats
+        sub.snapshot_version = self.snapshot_version
+        return sub
 
     # ---------------------------------------------------------- resilience
     def _resolve(self, policy, report):
@@ -437,8 +449,20 @@ class Dataset:
             return [self.paths[i] for i in keep]
 
     def _prune_indices(self, expr, skip, report):
+        stats = self._file_stats
+
         def check(i):
             try:
+                if stats is not None:
+                    ent = stats.get(self.paths[i])
+                    if ent is not None:
+                        from .io.manifest import manifest_may_match
+
+                        if not manifest_may_match(ent, expr):
+                            # manifest zone maps proved the whole part
+                            # dead: dropped with ZERO IO — the file is
+                            # never opened, its footer never read
+                            return False
                 pf = self.file(i)
                 self._check_schema(pf, self.paths[i])
                 return prune_file(pf, where=expr)

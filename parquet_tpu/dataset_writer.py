@@ -1,0 +1,632 @@
+"""Writable tables: multi-file ingestion with manifest-level atomic commit.
+
+The read side of the dataset layer (parquet_tpu/dataset.py) has been
+production-shaped since PR 5; this module is the write side — ROADMAP
+item 2, the step from "fast observable library" to "a table you
+continuously ingest into, query, and compact":
+
+- :class:`DatasetWriter` shards incoming rows across part-files (by size,
+  or by a hash of a key column), routes every part through
+  :class:`~parquet_tpu.algebra.sorting.SortingWriter` when the table has a
+  sort spec (committed files carry ``sorting_columns`` + ascending
+  ``boundary_order``, what makes zone-map pruning and the sorted-key
+  lookup fast path bite), and commits by atomically replacing the table's
+  manifest (io/manifest.py) — part-files land under unique names first,
+  so the manifest rename is the SINGLE commit point.  A crash at any byte
+  of an ingest leaves the table at the old snapshot or the new one, never
+  a mix; recovery (:func:`recover_table`) just sweeps orphans.
+- :func:`open_table` gives readers snapshot-pinned opens: the manifest is
+  resolved once, the named part-files are eagerly opened (fds pinned, so
+  a racing compaction's unlinks cannot pull bytes out from under a
+  drain), and ``Dataset.prune`` consults the manifest's persisted zone
+  maps — a non-matching part is dropped with ZERO footer reads.
+- :func:`compact_table` replaces N small parts with one sorted file via
+  :func:`~parquet_tpu.algebra.merge.merge_files` and the same commit
+  path, detecting conflicts with rival commits (inputs gone ⇒ abort and
+  sweep, never resurrect replaced data); :class:`BackgroundCompactor`
+  runs it on a daemon thread.  Committed replacements invalidate the
+  footer/chunk/page/neg-lookup caches for the removed paths through the
+  existing machinery, so post-commit opens can never serve dead bytes.
+- Observability: buffered-but-unflushed ingest bytes live in the
+  resource ledger's ``table.pending`` account (byte-exact, drained to 0
+  by every commit/abort), commits and compactions meter under
+  ``table.*``, commit latency lands in ``table.commit_s``, and open
+  writers render in ``/debugz``'s ``tables`` section.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .algebra.buffer import SortingColumn, TableBuffer, permute_column
+from .dataset import Dataset
+from .format.enums import Type
+from .io.manifest import (Manifest, collect_entry, commit_manifest,
+                          manifest_path, part_file_name, read_manifest,
+                          register_sweep_exempt, sweep_orphans)
+from .io.sink import AtomicFileSink
+from .io.writer import ColumnData, ParquetWriter, WriterOptions
+from .obs import scope as _oscope
+from .obs.ledger import ledger_account, maybe_check_pressure
+from .obs.metrics import counter as _counter
+from .obs.metrics import histogram as _histogram
+from .schema.schema import Schema
+
+__all__ = ["DatasetWriter", "open_table", "compact_table",
+           "BackgroundCompactor", "recover_table", "table_debug"]
+
+# resolved once (hot-path rule: no registry get-or-create on increments)
+_M_COMMITS = _counter("table.commits")
+_M_FILES_WRITTEN = _counter("table.files_written")
+_M_ROWS_INGESTED = _counter("table.rows_ingested")
+_M_BYTES_INGESTED = _counter("table.bytes_ingested")
+_M_COMPACTIONS = _counter("table.compactions")
+_M_FILES_COMPACTED = _counter("table.files_compacted")
+_M_CONFLICTS = _counter("table.commit_conflicts")
+_M_COMPACT_ERRORS = _counter("table.compaction_errors")
+_M_COMMIT_S = _histogram("table.commit_s")
+
+# resource-ledger account (obs/ledger.py): bytes buffered in open
+# DatasetWriters that no part-file holds yet — the ingest analog of
+# write.buffer, drained to 0 by every flush/commit/abort
+_ACC_PENDING = ledger_account("table.pending")
+
+# /debugz registry: open writers, weakly held so an abandoned writer
+# can never pin itself (or its buffers' ledger rows) alive
+_LIVE_WRITERS: "weakref.WeakSet[DatasetWriter]" = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+# compactions' in-flight merged parts, per abs table dir: between the
+# merged part's rename and its manifest commit it looks like an orphan —
+# the sweep exemption below shields it (and writers' uncommitted parts)
+_COMPACTING: Dict[str, set] = {}
+_COMPACTING_LOCK = threading.Lock()
+
+
+def _uncommitted_parts(table_dir_abs: str) -> set:
+    """Part names a concurrent orphan sweep must leave alone: live
+    writers' flushed-but-uncommitted parts plus compactions' in-flight
+    merged parts (io/manifest.py register_sweep_exempt).  A writer that
+    CRASHED drops out of the weak set with its last reference, so a
+    restarted-process-style recovery in the same interpreter still
+    sweeps its leavings."""
+    names: set = set()
+    with _LIVE_LOCK:
+        writers = [w for w in _LIVE_WRITERS if not w._closed]
+    for w in writers:
+        if os.path.abspath(w.table_dir) == table_dir_abs:
+            names.update(list(w._flushed))  # atomic snapshot under GIL
+    with _COMPACTING_LOCK:
+        names.update(_COMPACTING.get(table_dir_abs, ()))
+    return names
+
+
+register_sweep_exempt(_uncommitted_parts)
+
+
+def _cd_nbytes(cd: ColumnData) -> int:
+    total = 0
+    for a in (cd.values, cd.offsets, cd.validity, cd.list_offsets,
+              cd.list_validity, cd.def_levels, cd.rep_levels):
+        if a is None:
+            continue
+        nb = getattr(a, "nbytes", None)
+        total += int(nb) if nb is not None else len(a)
+    return total
+
+
+def _cols_nbytes(cols: Dict[str, ColumnData]) -> int:
+    return sum(_cd_nbytes(cd) for cd in cols.values())
+
+
+def _partition_ids(leaf, cd: ColumnData, n: int, k: int) -> np.ndarray:
+    """Per-row partition ordinal from a hash of the key column — the
+    key-partitioned sharding mode.  splitmix64 finalizer over the int
+    key, so adjacent keys spread across parts; NULL keys route to
+    partition 0 (they cannot hash)."""
+    if cd.def_levels is not None or cd.rep_levels is not None \
+            or cd.list_offsets is not None:
+        raise ValueError("partition_on must be a flat column")
+    if leaf.physical_type not in (Type.INT32, Type.INT64):
+        raise ValueError(
+            f"partition_on supports INT32/INT64 key columns, not "
+            f"{leaf.physical_type.name} ({leaf.dotted_path!r})")
+    vals = np.asarray(cd.values).astype(np.int64).view(np.uint64)
+    valid = None if cd.validity is None else np.asarray(cd.validity, bool)
+    if valid is not None:
+        aligned = np.zeros(n, np.uint64)
+        aligned[valid] = vals
+    else:
+        aligned = vals
+    x = aligned.copy()
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    ids = (x % np.uint64(k)).astype(np.int64)
+    if valid is not None:
+        ids[~valid] = 0
+    return ids
+
+
+class DatasetWriter:
+    """Continuous multi-file ingestion into a table directory.
+
+    Rows buffer columnar (``write``/``write_arrow``); every
+    ``rows_per_file`` buffered rows flush as one part-file under a unique
+    name (``part-<rand>.parquet``, written through an
+    :class:`~parquet_tpu.io.sink.AtomicFileSink`), sorted by ``sorting``
+    when given.  With ``partition_on`` (an INT32/INT64 column path), rows
+    route to ``num_partitions`` independent buffers by key hash instead —
+    co-keyed rows land in the same part, which is what makes zone maps
+    and bloom filters selective for keyed workloads.
+
+    NOTHING is visible to readers until :meth:`commit` atomically
+    replaces the table manifest (version +1, zone maps persisted).  A
+    writer that dies mid-ingest leaves only orphans a later
+    :func:`recover_table` sweeps; :meth:`abort` is the polite form
+    (drops buffers, removes uncommitted parts).  One writer instance is
+    single-threaded; concurrent writers on one table serialize their
+    commits through the manifest lock and merge additively.
+    """
+
+    def __init__(self, table_dir, schema: Schema,
+                 sorting: Optional[Sequence[SortingColumn]] = None,
+                 options: Optional[WriterOptions] = None,
+                 rows_per_file: int = 1 << 20,
+                 partition_on: Optional[str] = None,
+                 num_partitions: int = 8,
+                 _sink_wrap=None):
+        if rows_per_file < 1:
+            raise ValueError("rows_per_file must be >= 1")
+        self.table_dir = os.fspath(table_dir)
+        os.makedirs(self.table_dir, exist_ok=True)
+        self.schema = schema
+        self.sorting = list(sorting or [])
+        self.options = options or WriterOptions()
+        self.rows_per_file = rows_per_file
+        self.partition_on = partition_on
+        self.num_partitions = max(1, int(num_partitions))
+        self._part_leaf = (schema.leaf(partition_on)
+                           if partition_on is not None else None)
+        self._sink_wrap = _sink_wrap
+        self._buffers: Dict[int, TableBuffer] = {}
+        self._pending_bytes: Dict[int, int] = {}
+        self._pending_rows: Dict[int, int] = {}
+        self._flushed: List[str] = []  # committed-to-disk, not to manifest
+        self.version: Optional[int] = None  # last committed snapshot
+        self.commits = 0
+        self._closed = False
+        with _LIVE_LOCK:
+            _LIVE_WRITERS.add(self)
+
+    # ------------------------------------------------------------- ingest
+    def write(self, columns: Dict[str, ColumnData], num_rows: int) -> None:
+        """Buffer ``num_rows`` of columnar data (the
+        :class:`~parquet_tpu.io.writer.ColumnData` per-leaf form every
+        writer front end shares); flushes full part-files as thresholds
+        cross."""
+        if self._closed:
+            raise ValueError("write on a closed DatasetWriter")
+        if self._part_leaf is None:
+            self._append(0, columns, num_rows)
+        else:
+            ids = _partition_ids(self._part_leaf,
+                                 columns[self._part_leaf.dotted_path],
+                                 num_rows, self.num_partitions)
+            for pid in np.unique(ids):
+                idx = np.flatnonzero(ids == pid)
+                sel = {leaf.dotted_path: permute_column(
+                    columns[leaf.dotted_path], idx, leaf)
+                    for leaf in self.schema.leaves}
+                self._append(int(pid), sel, len(idx))
+        for pid in [p for p, b in self._buffers.items()
+                    if b.num_rows >= self.rows_per_file]:
+            self._flush_buffer(pid)
+
+    def write_arrow(self, table) -> None:
+        from .io.writer import columns_from_arrow
+
+        self.write(columns_from_arrow(table, self.schema), table.num_rows)
+
+    def _append(self, pid: int, cols: Dict[str, ColumnData],
+                n: int) -> None:
+        if n == 0:
+            return
+        buf = self._buffers.get(pid)
+        if buf is None:
+            buf = self._buffers[pid] = TableBuffer(self.schema, self.sorting)
+            self._pending_bytes[pid] = 0
+            self._pending_rows[pid] = 0
+        nb = _cols_nbytes(cols)
+        buf.write(cols, n)
+        self._pending_bytes[pid] += nb
+        self._pending_rows[pid] += n
+        _ACC_PENDING.add(nb)
+        # growth site: buffered ingest can push the process over a
+        # watermark between flushes (two env reads when none is set)
+        maybe_check_pressure()
+
+    # -------------------------------------------------------------- flush
+    def pending_rows(self) -> int:
+        return sum(self._pending_rows.values())
+
+    def pending_bytes(self) -> int:
+        return sum(self._pending_bytes.values())
+
+    def flush(self) -> None:
+        """Flush every buffer to part-files (still INVISIBLE to readers
+        until :meth:`commit` moves the manifest)."""
+        for pid in list(self._buffers):
+            self._flush_buffer(pid)
+
+    def _flush_buffer(self, pid: int) -> None:
+        buf = self._buffers.pop(pid)
+        nb = self._pending_bytes.pop(pid, 0)
+        self._pending_rows.pop(pid, None)
+        # hand-over semantics (BufferedSink rule): the bytes leave the
+        # pending account whether or not the part write succeeds — a
+        # crashed flush's rows are LOST to the table (recovery sweeps the
+        # torn part), so the ledger must not keep holding them
+        _ACC_PENDING.sub(nb)
+        if buf.num_rows == 0:
+            return
+        name = part_file_name(secrets.token_hex(8))
+        sink = AtomicFileSink(os.path.join(self.table_dir, name))
+        if self._sink_wrap is not None:
+            sink = self._sink_wrap(sink)
+        rows = buf.num_rows
+        try:
+            if self.sorting:
+                from .algebra.sorting import SortingWriter
+
+                # buffer_rows >= the buffered count: the no-spill path
+                # sorts in memory and writes one sorted file (spills only
+                # matter for parts larger than this writer ever buffers)
+                sw = SortingWriter(sink, self.schema, self.sorting,
+                                   self.options, buffer_rows=max(rows, 1))
+                sw.write(buf.columns, rows)
+                sw.close()
+            else:
+                w = ParquetWriter(sink, self.schema, self.options)
+                try:
+                    w.write(buf.columns, rows)
+                    w.close()
+                except BaseException:
+                    w.abort()
+                    raise
+            # the writer treats caller-owned sinks as the caller's to
+            # commit: this close IS the part-file's fsync+rename
+            sink.close()
+        except BaseException:
+            sink.abort()  # no-op past an injected crash (dead processes
+            # run no cleanup; recovery sweeps the stranded temp)
+            raise
+        self._flushed.append(name)
+
+    # ------------------------------------------------------------- commit
+    def commit(self) -> Optional[Manifest]:
+        """Flush, then atomically publish every part written since the
+        last commit: the new manifest (old files + new entries, zone maps
+        collected from the committed footers) replaces the live one in a
+        single rename.  Returns the committed :class:`Manifest`, or the
+        current live one when there was nothing to commit."""
+        if self._closed:
+            raise ValueError("commit on a closed DatasetWriter")
+        t0 = time.perf_counter()
+        with _oscope.maybe_op_scope("table.commit", dir=self.table_dir):
+            try:
+                return self._commit_impl()
+            finally:
+                _M_COMMIT_S.observe(time.perf_counter() - t0)
+
+    def _commit_impl(self) -> Optional[Manifest]:
+        self.flush()
+        if not self._flushed:
+            live = read_manifest(self.table_dir)
+            if live is not None:
+                self.version = live.version
+            return live
+        entries = [collect_entry(self.table_dir, name)
+                   for name in self._flushed]
+        spec = [(s.path, s.descending, s.nulls_first) for s in self.sorting]
+
+        def mutate(live: Manifest) -> Manifest:
+            return Manifest(files=list(live.files) + entries,
+                            sorting=spec or list(live.sorting))
+
+        new = commit_manifest(self.table_dir, mutate,
+                              sink_wrap=self._sink_wrap)
+        rows = sum(e.num_rows for e in entries)
+        nbytes = sum(e.file_size for e in entries)
+        _oscope.account(_M_COMMITS)
+        _oscope.account(_M_FILES_WRITTEN, len(entries))
+        _oscope.account(_M_ROWS_INGESTED, rows)
+        _oscope.account(_M_BYTES_INGESTED, nbytes)
+        self._flushed = []
+        self.commits += 1
+        self.version = new.version
+        return new
+
+    # ------------------------------------------------------------ cleanup
+    def abort(self) -> None:
+        """Drop buffered rows and remove flushed-but-uncommitted parts —
+        the polite death (a hard crash leaves the same logical state; the
+        difference is only who sweeps)."""
+        for pid in list(self._buffers):
+            self._buffers.pop(pid)
+            _ACC_PENDING.sub(self._pending_bytes.pop(pid, 0))
+            self._pending_rows.pop(pid, None)
+        for name in self._flushed:
+            try:
+                os.unlink(os.path.join(self.table_dir, name))
+            except OSError:
+                pass
+        self._flushed = []
+        self._closed = True
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        try:
+            self.commit()
+        finally:
+            self._closed = True
+
+    def __enter__(self) -> "DatasetWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()
+
+    def __repr__(self) -> str:
+        return (f"DatasetWriter({self.table_dir!r}, "
+                f"{self.pending_rows()} pending row(s), "
+                f"{len(self._flushed)} uncommitted part(s))")
+
+
+# ---------------------------------------------------------------------------
+# snapshot-pinned reads
+# ---------------------------------------------------------------------------
+
+def open_table(table_dir, options=None, policy=None,
+               pin: bool = True) -> Dataset:
+    """Open the table's CURRENT snapshot as a :class:`~parquet_tpu.
+    dataset.Dataset`: the manifest is resolved exactly once, and the
+    returned dataset reads that file set even while writers commit and
+    compactions replace files.  ``pin=True`` (default) eagerly opens
+    every named part — the open fds keep serving the snapshot's bytes
+    even after a compaction unlinks replaced parts (POSIX semantics), so
+    a long drain can never observe a torn table.  The dataset carries the
+    manifest's zone maps: ``Dataset.prune`` drops non-matching parts
+    without opening them (zero footer reads), and ``snapshot_version``
+    names the pinned snapshot."""
+    table_dir = os.fspath(table_dir)
+    last_err = None
+    for _ in range(8):
+        live = read_manifest(table_dir)
+        if live is None:
+            raise FileNotFoundError(
+                f"no table manifest at {manifest_path(table_dir)!r} "
+                "(never committed, or not a table directory)")
+        paths = [os.path.join(table_dir, n) for n in live.names()]
+        ds = Dataset._from_paths(paths, options, policy, None)
+        ds._file_stats = {p: e for p, e in zip(paths, live.files)}
+        ds.snapshot_version = live.version
+        if not (pin and paths):
+            return ds
+        try:
+            ds.files  # eager open: fds pinned to this snapshot's bytes
+            return ds
+        except FileNotFoundError as e:
+            # the resolve→open window raced a compaction's post-commit
+            # unlink: the manifest we read is already dead.  Re-resolve —
+            # the NEW manifest's parts are on disk (commit precedes every
+            # unlink), so this converges after at most one rival commit
+            # per lap.
+            last_err = e
+            ds.close()
+    raise last_err
+
+
+def recover_table(table_dir) -> List[str]:
+    """Crash recovery: sweep ``*.tmp`` files and parts the live manifest
+    does not name (:func:`~parquet_tpu.io.manifest.sweep_orphans`).  Safe
+    to run any time — committed data is never touched.  Returns the
+    removed names."""
+    return sweep_orphans(table_dir)
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+def compact_table(table_dir, max_files: Optional[int] = None,
+                  options: Optional[WriterOptions] = None,
+                  batch_rows: int = 1 << 16,
+                  _sink_wrap=None) -> Optional[Manifest]:
+    """Replace N parts with ONE sorted file through the same commit path
+    ingest uses.  The inputs stream-merge via
+    :func:`~parquet_tpu.algebra.merge.merge_files` (k-way ordered merge
+    by the table's sort spec; plain concatenation for unsorted tables)
+    into a new unique part; the commit swaps the manifest atomically.
+
+    Conflicts resolve safely: the merged part is built OUTSIDE the
+    manifest lock, and the commit re-checks that every input is still
+    live — a rival commit (another compactor, or a future delete) that
+    removed one aborts THIS compaction (merged part swept, manifest
+    untouched, ``table.commit_conflicts``), never resurrects replaced
+    data.  Concurrent ingest commits compose: their new files survive
+    the swap untouched.
+
+    ``max_files`` caps how many (smallest-first) parts one pass folds;
+    default all.  Returns the committed manifest, or ``None`` when there
+    was nothing to do or a conflict aborted."""
+    table_dir = os.fspath(table_dir)
+    live = read_manifest(table_dir)
+    if live is None or len(live.files) < 2:
+        return None
+    victims = list(live.files)
+    if max_files is not None and len(victims) > max_files:
+        victims = sorted(victims, key=lambda e: e.file_size)[:max_files]
+        if len(victims) < 2:
+            return None
+        # merge in SNAPSHOT order, not size order: equal-key rows must
+        # keep ingestion order so compaction output stays byte-identical
+        # to a one-shot sorted write of the same rows
+        order = {e.name: i for i, e in enumerate(live.files)}
+        victims.sort(key=lambda e: order[e.name])
+    victim_names = {e.name for e in victims}
+    sorting = [SortingColumn(p, d, nf) for p, d, nf in live.sorting]
+    name = part_file_name(secrets.token_hex(8))
+    merged_path = os.path.join(table_dir, name)
+    dir_abs = os.path.abspath(table_dir)
+    # sweep shield: until the commit lands (or aborts), the merged part
+    # is indistinguishable from an orphan on disk
+    with _COMPACTING_LOCK:
+        _COMPACTING.setdefault(dir_abs, set()).add(name)
+    try:
+        return _compact_run(table_dir, victims, victim_names, sorting,
+                            name, merged_path, options, batch_rows,
+                            _sink_wrap)
+    finally:
+        with _COMPACTING_LOCK:
+            got = _COMPACTING.get(dir_abs)
+            if got is not None:
+                got.discard(name)
+                if not got:
+                    del _COMPACTING[dir_abs]
+
+
+def _compact_run(table_dir, victims, victim_names, sorting, name,
+                 merged_path, options, batch_rows, _sink_wrap
+                 ) -> Optional[Manifest]:
+    from .algebra.merge import merge_files
+    from .io.cache import invalidate_path
+
+    sink = AtomicFileSink(merged_path)
+    if _sink_wrap is not None:
+        sink = _sink_wrap(sink)
+    with _oscope.maybe_op_scope("table.compact", dir=table_dir,
+                                inputs=len(victims)):
+        try:
+            merge_files([os.path.join(table_dir, e.name) for e in victims],
+                        sorting, sink, options, batch_rows=batch_rows)
+            # merge_files treats caller-owned sinks as the caller's to
+            # commit: this close is the merged part's fsync+rename
+            sink.close()
+        except BaseException:
+            sink.abort()
+            raise
+        entry = collect_entry(table_dir, name)
+
+        def mutate(cur: Manifest) -> Optional[Manifest]:
+            cur_names = set(cur.names())
+            if not victim_names <= cur_names:
+                return None  # an input is gone: a rival commit won
+            files = [entry] + [e for e in cur.files
+                               if e.name not in victim_names]
+            return Manifest(files=files, sorting=list(cur.sorting))
+
+        new = commit_manifest(table_dir, mutate, sink_wrap=_sink_wrap)
+        if new is None:
+            _oscope.account(_M_CONFLICTS)
+            try:
+                os.unlink(merged_path)
+            except OSError:
+                pass
+            return None
+        # post-commit: the replaced parts are garbage — unlink them (open
+        # snapshot readers keep their fds; POSIX keeps the bytes) and drop
+        # any cached footers/chunks/pages/neg-memos through the existing
+        # fstat-key machinery so a stale entry can never outlive its file
+        for e in victims:
+            p = os.path.join(table_dir, e.name)
+            invalidate_path(p)
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        _oscope.account(_M_COMPACTIONS)
+        _oscope.account(_M_FILES_COMPACTED, len(victims))
+        return new
+
+
+class BackgroundCompactor:
+    """Crash-safe background compaction: a daemon thread that folds the
+    table whenever the live part count reaches ``min_files``.  Errors
+    (including commit conflicts, which :func:`compact_table` already
+    absorbs) are metered (``table.compaction_errors``) and the loop keeps
+    going — a compactor can die at any byte and the table stays at a
+    valid snapshot, because it only ever moves through the same atomic
+    commit path.  ``close()`` stops and joins the thread."""
+
+    def __init__(self, table_dir, interval_s: float = 1.0,
+                 min_files: int = 4, max_files: Optional[int] = None,
+                 options: Optional[WriterOptions] = None):
+        self.table_dir = os.fspath(table_dir)
+        self.interval_s = interval_s
+        self.min_files = max(2, int(min_files))
+        self.max_files = max_files
+        self.options = options
+        self.passes = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="pq-table-compactor",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                live = read_manifest(self.table_dir)
+                if live is not None and len(live.files) >= self.min_files:
+                    if compact_table(self.table_dir,
+                                     max_files=self.max_files,
+                                     options=self.options) is not None:
+                        self.passes += 1
+            except Exception:
+                # one failed pass must not kill the compactor: the next
+                # tick retries against whatever snapshot is live then
+                _oscope.account(_M_COMPACT_ERRORS)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+    def __enter__(self) -> "BackgroundCompactor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# /debugz
+# ---------------------------------------------------------------------------
+
+def table_debug() -> dict:
+    """The ``/debugz`` ``tables`` section: every open
+    :class:`DatasetWriter` with its pending (buffered) rows/bytes,
+    uncommitted flushed parts, and last committed version."""
+    with _LIVE_LOCK:
+        writers = [w for w in _LIVE_WRITERS if not w._closed]
+    return {"writers": [
+        {"dir": w.table_dir,
+         "pending_rows": w.pending_rows(),
+         "pending_bytes": w.pending_bytes(),
+         "uncommitted_parts": len(w._flushed),
+         "commits": w.commits,
+         "version": w.version}
+        for w in writers]}

@@ -48,7 +48,7 @@ __all__ = ["FaultPolicy", "ReadReport", "Deadline", "PolicySource",
            "FaultInjectingSink", "InjectedWriterCrash", "SinkFaultStats",
            "crash_consistency_check", "retry_call", "active_deadline",
            "FaultInjectingRemoteTransport", "RemoteFaultStats",
-           "LocalRangeServer"]
+           "LocalRangeServer", "SharedCrashState", "table_crash_check"]
 
 
 # ---------------------------------------------------------------------------
@@ -1097,6 +1097,244 @@ class FaultInjectingSink:
                 self.inner.close()
             except OSError:
                 pass
+
+
+class SharedCrashState:
+    """ONE hard-crash byte budget shared across every sink of a
+    multi-file write — the table-level generalization of
+    :class:`FaultInjectingSink`'s ``crash_at_byte``.  A table commit
+    writes several part-files and then the manifest through SEPARATE
+    sinks; a real process death lands at one global byte offset of that
+    whole sequence, not per file.  ``wrap(sink)`` interposes the shared
+    countdown on each sink the writer opens (the ``_sink_wrap`` hook of
+    :class:`~parquet_tpu.dataset_writer.DatasetWriter` /
+    ``write_manifest``); the write that crosses ``crash_at_byte``
+    persists the prefix and raises :class:`InjectedWriterCrash`, and from
+    that instant EVERY sink is dead — writes, flushes, and commits all
+    raise, and ``abort()`` becomes a fd-releasing no-op (a dead process
+    runs no cleanup; its temp files stay stranded for recovery to sweep,
+    which is exactly what the manifest crash matrix must prove)."""
+
+    def __init__(self, crash_at_byte: Optional[int] = None):
+        self.crash_at_byte = crash_at_byte
+        self.total = 0  # bytes persisted across ALL wrapped sinks
+        self.crashed = False
+        self._lock = threading.Lock()
+
+    def wrap(self, sink):
+        return _SharedCrashSink(self, sink)
+
+    # the two decisions every wrapped sink routes through, under one lock
+    def _admit(self, n: int) -> int:
+        """How many of ``n`` bytes may persist (crossing the budget
+        marks the process dead); raises when already dead."""
+        with self._lock:
+            if self.crashed:
+                raise InjectedWriterCrash(
+                    f"write after shared crash at byte {self.crash_at_byte}")
+            if self.crash_at_byte is not None \
+                    and self.total + n > self.crash_at_byte:
+                keep = self.crash_at_byte - self.total
+                self.total += max(keep, 0)
+                self.crashed = True
+                return max(keep, 0)
+            self.total += n
+            return -1  # all of it
+
+    def _check_alive(self, what: str) -> None:
+        with self._lock:
+            dead = self.crashed or (
+                self.crash_at_byte is not None
+                and self.total >= self.crash_at_byte)
+            if dead:
+                self.crashed = True
+        if dead:
+            raise InjectedWriterCrash(
+                f"{what} after shared crash at byte {self.crash_at_byte}")
+
+
+class _SharedCrashSink:
+    """One sink's view of a :class:`SharedCrashState` (see there)."""
+
+    def __init__(self, state: SharedCrashState, inner):
+        self.state = state
+        self.inner = inner
+
+    def write(self, data) -> int:
+        data = bytes(data) if not isinstance(data, (bytes, bytearray)) \
+            else data
+        n = len(data)
+        keep = self.state._admit(n)
+        if keep >= 0:
+            if keep > 0:
+                self.inner.write(data[:keep])
+            raise InjectedWriterCrash(
+                f"injected shared crash at byte "
+                f"{self.state.crash_at_byte}")
+        self.inner.write(data)
+        return n
+
+    def writelines(self, parts) -> None:
+        for p in parts:
+            self.write(p)
+
+    def flush(self) -> None:
+        self.state._check_alive("flush")
+        self.inner.flush()
+
+    def close(self) -> None:
+        # close == commit (fsync + rename for atomic sinks): a process
+        # whose budget is exhausted died BEFORE the commit could run —
+        # the rename-boundary crash the manifest matrix samples as
+        # offset == total
+        self.state._check_alive("close/commit")
+        self.inner.close()
+
+    def abort(self) -> None:
+        if self.state.crashed:
+            # a dead process runs no cleanup: leave the temp file exactly
+            # where it fell (recovery owns the sweep) but release the fd
+            # so the replaying harness does not leak one per offset
+            f = getattr(self.inner, "_f", None)
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+                self.inner._f = None
+            return
+        ab = getattr(self.inner, "abort", None)
+        if ab is not None:
+            ab()
+        else:
+            try:
+                self.inner.close()
+            except OSError:
+                pass
+
+
+def table_crash_check(setup, ingest, workdir, samples: int = 10,
+                      seed: int = 0, offsets=None) -> List[dict]:
+    """Crash-consistency matrix at the MANIFEST level: the table-shaped
+    extension of :func:`crash_consistency_check`.
+
+    ``setup(table_dir)`` builds the base snapshot (ingest + commit — may
+    be empty).  ``ingest(table_dir, sink_wrap)`` performs ONE further
+    ingest-and-commit, threading ``sink_wrap`` into every sink it opens
+    (``DatasetWriter(..., _sink_wrap=sink_wrap)`` covers part-files and
+    the manifest commit alike).  The harness replays that ingest from a
+    pristine copy of the base state with a hard crash injected at sampled
+    global byte offsets — spanning part-file writes, manifest
+    serialization, and the pre-rename boundary (offset == total bytes) —
+    and after each crash runs recovery and asserts the invariant:
+
+    - the live snapshot is EXACTLY the base or EXACTLY the committed
+      result (manifest version and full table contents compared) —
+      never a mix;
+    - every file the live manifest names passes
+      :func:`~parquet_tpu.io.integrity.verify_file`;
+    - recovery swept every orphan: the directory holds nothing but the
+      manifest and its named parts.
+
+    Returns one ``{"offset", "outcome"}`` dict per run (outcome
+    ``"old"`` or ``"new"``); raises ``AssertionError`` on any violation.
+    """
+    import os
+    import shutil
+
+    from ..dataset_writer import open_table, recover_table
+    from .integrity import verify_file
+    from .manifest import MANIFEST_NAME, read_manifest
+
+    workdir = os.fspath(workdir)
+    base_dir = os.path.join(workdir, "base")
+    os.makedirs(base_dir, exist_ok=True)
+    setup(base_dir)
+    base_manifest = read_manifest(base_dir)
+    base_version = base_manifest.version if base_manifest is not None else 0
+
+    def fingerprint(d):
+        m = read_manifest(d)
+        if m is None or not m.files:
+            return (0 if m is None else m.version, None)
+        # pin=False + close: one fingerprint per sampled offset would
+        # otherwise leak every part's fd for the process lifetime
+        # (FileSource has no finalizer)
+        ds = open_table(d, pin=False)
+        try:
+            return m.version, ds.read().to_arrow()
+        finally:
+            ds.close()
+
+    base_fp = fingerprint(base_dir)
+
+    def run(tag, crash_at):
+        d = os.path.join(workdir, f"run_{tag}")
+        shutil.copytree(base_dir, d)
+        state = SharedCrashState(crash_at_byte=crash_at)
+        try:
+            ingest(d, state.wrap)
+        except InjectedWriterCrash:
+            pass
+        return d, state
+
+    # probe: the uncrashed replay learns the total byte count and the
+    # expected NEW snapshot's contents (part names are random per run,
+    # so equality is by version + table contents, not by file list)
+    probe_dir, probe_state = run("probe", None)
+    total = probe_state.total
+    new_fp = fingerprint(probe_dir)
+    assert new_fp[0] > base_version, \
+        "table_crash_check: ingest() did not commit a new snapshot"
+    shutil.rmtree(probe_dir)
+
+    if offsets is None:
+        rng = random.Random(seed)
+        pool = range(1, total)
+        picks = rng.sample(pool, min(max(samples - 2, 0), len(pool)))
+        # 0 = die before any byte; total = die after every byte but
+        # BEFORE the manifest rename (the commit-boundary crash);
+        # total + 1 = the budget never fires, i.e. the process survived
+        # the rename — the matrix must span both phases or the "old or
+        # new, never mixed" claim was only half-tested
+        offsets = sorted({0, *picks, total, total + 1})
+
+    def same_table(fp_a, fp_b) -> bool:
+        if fp_a[0] != fp_b[0]:
+            return False
+        a, b = fp_a[1], fp_b[1]
+        return (a is None and b is None) or (
+            a is not None and b is not None and a.equals(b))
+
+    results = []
+    for off in offsets:
+        d, _ = run(f"off{off}", off)
+        swept = recover_table(d)
+        got = fingerprint(d)
+        if same_table(got, base_fp):
+            outcome = "old"
+        else:
+            assert same_table(got, new_fp), (
+                f"crash at byte {off}: recovered snapshot is neither the "
+                f"old (v{base_fp[0]}) nor the new (v{new_fp[0]}) one: "
+                f"v{got[0]}")
+            outcome = "new"
+        live = read_manifest(d)
+        names = set(live.names()) if live is not None else set()
+        for name in sorted(names):
+            rep = verify_file(os.path.join(d, name))
+            assert rep.ok, (f"crash at byte {off}: live file {name} "
+                            f"corrupt: {rep.summary()}")
+        leftovers = sorted(set(os.listdir(d)) - names - {MANIFEST_NAME})
+        assert not leftovers, (f"crash at byte {off}: recovery left "
+                               f"orphans {leftovers} (swept {swept})")
+        results.append({"offset": off, "outcome": outcome})
+        shutil.rmtree(d)
+    outcomes = {r["outcome"] for r in results}
+    assert outcomes == {"old", "new"} or len(offsets) < 2, (
+        "crash matrix degenerate: every offset recovered to the same "
+        f"snapshot ({outcomes}) — the sampling missed a phase")
+    return results
 
 
 def crash_consistency_check(build, dest, samples: int = 12, seed: int = 0,

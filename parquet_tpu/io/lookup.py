@@ -75,11 +75,28 @@ _M_PAGES_READ = _counter("lookup.pages_read")
 _M_PAGES_COALESCED = _counter("lookup.pages_coalesced")
 _M_CHUNK_FALLBACKS = _counter("lookup.chunk_fallbacks")
 _M_NEG_HITS = _counter("lookup.neg_hits")
+_M_BSEARCH = _counter("lookup.binary_search_hits")
+_M_KEY_SHARDS = _counter("lookup.key_shards")
 
 _COUNTER_KEYS = ("keys", "keys_pruned_stats", "keys_pruned_bloom",
                  "keys_pruned_pages", "rows_matched", "preads", "pages_read",
                  "pages_coalesced", "page_cache_hits", "chunk_fallbacks",
-                 "neg_hits")
+                 "neg_hits", "binary_search_hits", "key_shards")
+
+
+def _key_shard_min() -> int:
+    """Minimum uniq keys per shard before a very large batch fans its
+    KEY SET across pool workers (``PARQUET_TPU_LOOKUP_KEY_SHARD``,
+    default 1024; ``0`` disables sharding)."""
+    import os
+
+    v = os.environ.get("PARQUET_TPU_LOOKUP_KEY_SHARD", "").strip()
+    if v:
+        try:
+            return max(0, int(v))
+        except ValueError:
+            pass
+    return 1024
 
 
 @dataclass
@@ -376,6 +393,92 @@ def _key_page_ords(ci, leaf, key) -> List[int]:
     return pages_overlapping(ci, leaf, lo=key, hi=key)
 
 
+def _rg_sorted_by(rg, leaf) -> Optional[bool]:
+    """``nulls_first`` when the row group declares its rows SORTED
+    ascending by ``leaf`` (footer ``sorting_columns``, primary column) —
+    the marker :class:`~parquet_tpu.algebra.sorting.SortingWriter` and
+    table compaction stamp on committed files — else ``None``.  Within-
+    page sortedness follows from row sortedness, which ``boundary_order``
+    alone does not imply (page MIN/MAX ladders can ascend over unsorted
+    rows), so the fast path keys on the row-level declaration only."""
+    scs = rg.sorting_columns or []
+    if not scs:
+        return None
+    sc = scs[0]
+    if sc.column_idx != leaf.column_index or sc.descending:
+        return None
+    return bool(sc.nulls_first)
+
+
+def _sorted_page_hits(leaf, key, entry, nulls_first: bool
+                      ) -> Optional[np.ndarray]:
+    """Page-local row ordinals equal to ``key`` by BINARY SEARCH within
+    the page — the sorted-ingestion payoff: O(log rows) per key instead
+    of a whole-page equality mask.  Returns ``None`` whenever the shape
+    is not provably safe (floats — NaN breaks searchsorted; FLBA rows;
+    decimal byte keys; a validity pattern that is not the contiguous
+    null run sorting produces), and the caller falls back to the exact
+    mask — the fast path can only ever accelerate, never change, the
+    answer."""
+    from bisect import bisect_left, bisect_right
+
+    from ..algebra.compare import is_unsigned
+
+    vals, valid = entry.values, entry.validity
+    a, b = 0, entry.num_rows
+    if valid is not None:
+        valid = np.asarray(valid, bool)
+        k = int(valid.sum())
+        if k == 0:
+            return np.empty(0, np.int64)
+        # sorted rows put nulls in one contiguous run at an end; anything
+        # else means the sort declaration does not cover this page shape
+        if nulls_first:
+            a = entry.num_rows - k
+            if not valid[a:].all():
+                return None
+        else:
+            b = k
+            if not valid[:b].all():
+                return None
+    if isinstance(vals, (tuple, list)):
+        from ..schema.types import LogicalKind
+
+        # BYTE_ARRAY page: the order domain is plain bytes order for
+        # everything except DECIMAL (two's-complement reordering)
+        if leaf.logical_kind == LogicalKind.DECIMAL:
+            return None
+        if not isinstance(key, (bytes, bytearray)):
+            return None
+        seg = list(vals[a:b])
+        lo, hi = bisect_left(seg, key), bisect_right(seg, key)
+        return a + np.arange(lo, hi, dtype=np.int64)
+    arr = np.asarray(vals)
+    if arr.ndim != 1 or arr.dtype.kind not in "iu":
+        return None  # FLBA rows / floats (NaN-unsafe) / bool
+    if is_unsigned(leaf) and arr.dtype in (np.dtype(np.int32),
+                                           np.dtype(np.int64)):
+        arr = arr.view(np.uint32 if arr.dtype == np.dtype(np.int32)
+                       else np.uint64)
+    if isinstance(key, bool) or not isinstance(key, (int, np.integer)):
+        return None
+    # type the needle EXACTLY as the array: a python-int needle against a
+    # uint64 array promotes both to float64, collapsing distinct keys
+    # above 2^53 into one bucket (searchsorted would then return a span
+    # of non-matching rows).  A key the dtype cannot represent exactly
+    # falls back to the mask.
+    try:
+        needle = arr.dtype.type(key)
+    except (OverflowError, ValueError):
+        return None
+    if int(needle) != int(key):
+        return None
+    seg = arr[a:b]
+    lo = int(np.searchsorted(seg, needle, side="left"))
+    hi = int(np.searchsorted(seg, needle, side="right"))
+    return a + np.arange(lo, hi, dtype=np.int64)
+
+
 def _lookup_rg(pf, rg, leaf, prep: _PreparedKeys, out_leaves,
                counters: Dict[str, int]):
     """Probe + match + gather one row group.  Returns
@@ -472,13 +575,24 @@ def _lookup_rg_probe(pf, rg, leaf, prep: _PreparedKeys, alive,
             return None
         fetcher = _PageFetcher(pf, rg, chunk, counters)
         entries = fetcher.fetch(needed)
+        # sorted-key fast path: a row group whose footer declares rows
+        # sorted by this column answers each (key, page) probe with an
+        # in-page binary search instead of a whole-page equality mask
+        nulls_first = _rg_sorted_by(rg, leaf)
         for u, ords in key_pages.items():
             parts = []
             for o in ords:
                 e = entries[o]
-                m = aligned_key_mask(leaf, prep.uniq[u], e.values,
-                                     e.validity)
-                hit = np.flatnonzero(m)
+                hit = None
+                if nulls_first is not None:
+                    hit = _sorted_page_hits(leaf, prep.uniq[u], e,
+                                            nulls_first)
+                if hit is None:
+                    m = aligned_key_mask(leaf, prep.uniq[u], e.values,
+                                         e.validity)
+                    hit = np.flatnonzero(m).astype(np.int64)
+                else:
+                    _count(counters, "binary_search_hits", _M_BSEARCH, 1)
                 if len(hit):
                     parts.append(e.first_row + hit.astype(np.int64))
             if parts:
@@ -622,8 +736,20 @@ def _find_rows_impl(pf, path, keys, columns, pol, report,
         # n files re-counting the same batch would inflate every
         # keys-per-stage attrition ratio by the file count.
         _count(counters, "keys", _M_KEYS, len(keys))
-    per_uniq: Dict[int, List[tuple]] = {}  # uniq → [(rows, cols), ...]
     skip = pol is not None and pol.skip_corrupt
+    per_uniq = _dispatch_probes(pf, leaf, prep, out_leaves, counters, pol,
+                                report, skip)
+    hits = _assemble_hits(keys, prep, per_uniq, out_leaves)
+    return LookupResult(hits, counters)
+
+
+def _probe_all_rgs(pf, leaf, prep: _PreparedKeys, out_leaves, counters,
+                   skip: bool, report) -> Dict[int, List[tuple]]:
+    """The serial probe core: every row group, one (sub)batch of uniq
+    keys.  Returns ``{uniq ordinal: [(file-local rows, cols), ...]}``."""
+    from .faults import read_context
+
+    per_uniq: Dict[int, List[tuple]] = {}
     rg_base = 0
     for rg in pf.row_groups:
         if prep.uniq:
@@ -646,8 +772,53 @@ def _find_rows_impl(pf, path, keys, columns, pol, report,
                     per_uniq.setdefault(u, []).append(
                         (rows + rg_base, cols_map.get(u, {})))
         rg_base += rg.num_rows
-    hits = _assemble_hits(keys, prep, per_uniq, out_leaves)
-    return LookupResult(hits, counters)
+    return per_uniq
+
+
+def _dispatch_probes(pf, leaf, prep: _PreparedKeys, out_leaves, counters,
+                     pol, report, skip: bool) -> Dict[int, List[tuple]]:
+    """Key-batch sharding for VERY large lookups: when the uniq key set
+    dwarfs the per-shard floor (``PARQUET_TPU_LOOKUP_KEY_SHARD``), split
+    it contiguously across shared-pool workers — each worker runs the
+    whole row-group cascade for its slice, so a 100k-key batch stops
+    probing row groups serially on one thread.  Results merge by uniq
+    ordinal (slices are disjoint, so the merge is a plain re-key);
+    metered ``lookup.key_shards``.  Degraded (skip) policies keep the
+    serial path: per-row-group skip accounting must stay exactly-once,
+    and a shard seeing corruption another shard's pages missed would
+    fork it."""
+    from ..utils.pool import in_shared_pool, map_in_order, pool_width
+
+    floor = _key_shard_min()
+    nuniq = len(prep.uniq)
+    nshards = 0
+    if floor and nuniq >= 2 * floor and not skip and not in_shared_pool():
+        nshards = min(pool_width(), nuniq // floor)
+    if nshards < 2:
+        return _probe_all_rgs(pf, leaf, prep, out_leaves, counters, skip,
+                              report)
+    bounds = np.linspace(0, nuniq, nshards + 1).astype(np.int64)
+    _count(counters, "key_shards", _M_KEY_SHARDS, nshards)
+    shard_counters = [{k: 0 for k in _COUNTER_KEYS} for _ in range(nshards)]
+
+    def one(si: int):
+        a, b = int(bounds[si]), int(bounds[si + 1])
+        sub = _PreparedKeys(
+            prep.uniq[a:b], [],
+            None if prep.hashes is None else prep.hashes[a:b])
+        return a, _probe_all_rgs(pf, leaf, sub, out_leaves,
+                                 shard_counters[si], False, None)
+
+    merged: Dict[int, List[tuple]] = {}
+    for a, sub in map_in_order(one, range(nshards)):
+        for u, v in sub.items():
+            merged[u + a] = v
+    for sc in shard_counters:
+        for k in _COUNTER_KEYS:
+            # plain merge into the batch's view: the registry already saw
+            # each shard's _count() increments exactly once
+            counters[k] += sc[k]
+    return merged
 
 
 def _assemble_hits(keys, prep: _PreparedKeys, per_uniq, out_leaves

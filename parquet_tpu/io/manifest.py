@@ -1,0 +1,444 @@
+"""Table manifests: the snapshot layer under writable datasets.
+
+A writable table (parquet_tpu/dataset_writer.py) is a directory of
+part-files plus ONE small manifest file naming the parts that make up the
+current snapshot.  The manifest is the table's single source of truth and
+its single commit point — the :class:`~parquet_tpu.io.sink.AtomicFileSink`
+pattern (temp write → fsync(file) → rename → fsync(dir)) lifted from one
+parquet file to the whole table:
+
+- **Part-files land under unique names first** (``part-<rand>.parquet``,
+  each itself written through an atomic sink), so nothing a writer does
+  before the manifest rename is visible to readers.  The rename IS the
+  commit: a crash at ANY byte of an ingest or compaction leaves the live
+  manifest at the old snapshot or the new one, never a mix.
+- **Recovery is a sweep, not a repair** (:func:`sweep_orphans`): delete
+  ``*.tmp`` files and part-files the live manifest does not name.  Live
+  data is never touched — an orphan can never be mistaken for data.
+- **Derived, not authoritative, zone maps**: each manifest entry persists
+  per-column min/max/null-count aggregated from the part's own footer
+  statistics at commit time (iceberg/delta style), so
+  ``Dataset.prune`` can drop whole files without opening them — zero
+  footer preads for a non-matching part.  The footer remains the
+  authority; the manifest only ever prunes conservatively
+  (:func:`manifest_may_match` answers True on any doubt).
+- **Optimistic concurrency**: in-process commits serialize on a
+  per-directory lock and re-read the live manifest under it, so
+  concurrent ingest commits merge (both file sets land) and a compaction
+  whose inputs were removed by a rival commit detects the conflict
+  instead of resurrecting replaced files.
+
+Versions are monotonic; readers pin a snapshot by resolving the manifest
+once (and eagerly opening the named files, so a later compaction's
+unlinks cannot pull bytes out from under a drain).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..errors import CorruptedError
+from .sink import AtomicFileSink
+
+__all__ = ["ManifestEntry", "Manifest", "MANIFEST_NAME", "PART_PREFIX",
+           "read_manifest", "write_manifest", "commit_manifest",
+           "collect_entry", "manifest_may_match", "sweep_orphans",
+           "part_file_name"]
+
+MANIFEST_NAME = "_table_manifest.json"
+PART_PREFIX = "part-"
+_FORMAT = 1
+
+
+# ---------------------------------------------------------------------------
+# order-domain value codec
+# ---------------------------------------------------------------------------
+# Zone-map bounds live in each column's ORDER domain (the decoded form
+# compare.py / statistics.py prune with): python int, float, bytes, or
+# bool.  JSON holds none of those losslessly, so values carry a one-letter
+# type tag; floats round-trip through repr (inf included), bytes through
+# hex.  A tag this codec does not know decodes to None — an UNKNOWN bound,
+# which every consumer treats as inconclusive (prune keeps the file) —
+# so a newer writer's manifest degrades a reader, never corrupts it.
+
+
+def _enc_value(v):
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return {"t": "b", "v": bool(v)}
+    # numpy scalars serialize as their python value
+    item = getattr(v, "item", None)
+    if item is not None and not isinstance(v, (bytes, bytearray)):
+        v = item()
+    if isinstance(v, bool):
+        return {"t": "b", "v": v}
+    if isinstance(v, int):
+        return {"t": "i", "v": v}
+    if isinstance(v, float):
+        return {"t": "f", "v": repr(v)}
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {"t": "x", "v": bytes(v).hex()}
+    return None  # unencodable domain: an unknown (inconclusive) bound
+
+
+def _dec_value(d):
+    if d is None or not isinstance(d, dict):
+        return None
+    t, v = d.get("t"), d.get("v")
+    try:
+        if t == "b":
+            return bool(v)
+        if t == "i":
+            return int(v)
+        if t == "f":
+            return float(v)
+        if t == "x":
+            return bytes.fromhex(v)
+    except (TypeError, ValueError):
+        return None
+    return None  # unknown tag: inconclusive
+
+
+@dataclass
+class ManifestEntry:
+    """One part-file of a snapshot.  ``zone_maps`` maps a flat column's
+    dotted path to ``(min, max, null_count, num_values)`` in the column's
+    order domain — any element ``None`` when the footer statistics were
+    missing or undecodable (inconclusive: pruning keeps the file)."""
+
+    name: str
+    num_rows: int
+    file_size: int
+    zone_maps: Dict[str, Tuple] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "num_rows": self.num_rows,
+                "file_size": self.file_size,
+                "zone_maps": {c: [_enc_value(mn), _enc_value(mx),
+                                  nulls, nv]
+                              for c, (mn, mx, nulls, nv)
+                              in sorted(self.zone_maps.items())}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ManifestEntry":
+        zm = {}
+        for c, rec in (d.get("zone_maps") or {}).items():
+            mn, mx, nulls, nv = (list(rec) + [None] * 4)[:4]
+            zm[c] = (_dec_value(mn), _dec_value(mx),
+                     None if nulls is None else int(nulls),
+                     None if nv is None else int(nv))
+        return cls(name=str(d["name"]), num_rows=int(d["num_rows"]),
+                   file_size=int(d["file_size"]), zone_maps=zm)
+
+
+@dataclass
+class Manifest:
+    """One snapshot of a table: the ordered part-file list plus the
+    table's sorting spec (``(path, descending, nulls_first)`` tuples —
+    what compaction merges by).  ``version`` is monotonic; ``created``
+    is integer unix seconds (an int so the serialized form is
+    byte-deterministic for the crash harness's offset sampling)."""
+
+    version: int = 0
+    files: List[ManifestEntry] = field(default_factory=list)
+    sorting: List[Tuple[str, bool, bool]] = field(default_factory=list)
+    created: int = 0
+
+    @property
+    def num_rows(self) -> int:
+        return sum(e.num_rows for e in self.files)
+
+    def names(self) -> List[str]:
+        return [e.name for e in self.files]
+
+    def serialize(self) -> bytes:
+        doc = {"format": _FORMAT, "version": self.version,
+               "created": int(self.created),
+               "sorting": [[p, bool(d), bool(nf)]
+                           for p, d, nf in self.sorting],
+               "files": [e.as_dict() for e in self.files]}
+        return (json.dumps(doc, sort_keys=True, separators=(",", ":"))
+                + "\n").encode("utf-8")
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "Manifest":
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            if not isinstance(doc, dict) or "version" not in doc:
+                raise ValueError("not a manifest document")
+            return cls(
+                version=int(doc["version"]),
+                created=int(doc.get("created", 0)),
+                sorting=[(str(p), bool(d), bool(nf))
+                         for p, d, nf in (doc.get("sorting") or [])],
+                files=[ManifestEntry.from_dict(e)
+                       for e in (doc.get("files") or [])])
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+            raise CorruptedError(f"bad table manifest: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# read / write / commit
+# ---------------------------------------------------------------------------
+
+def manifest_path(table_dir) -> str:
+    return os.path.join(os.fspath(table_dir), MANIFEST_NAME)
+
+
+def part_file_name(token: str) -> str:
+    return f"{PART_PREFIX}{token}.parquet"
+
+
+def read_manifest(table_dir) -> Optional[Manifest]:
+    """The live snapshot, or None when the table has never committed.
+    A manifest that exists but will not parse is corruption, loudly —
+    the atomic commit path can never produce one, so a torn manifest
+    means the storage (or an alien writer) broke the contract."""
+    try:
+        with open(manifest_path(table_dir), "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return None
+    return Manifest.deserialize(raw)
+
+
+def write_manifest(table_dir, manifest: Manifest,
+                   sink_wrap: Optional[Callable] = None) -> None:
+    """Atomically replace the live manifest: the table-level commit point.
+    ``sink_wrap`` lets the crash harness interpose its injector between
+    the serialized bytes and the atomic sink, so sampled crash offsets
+    cover manifest serialization AND the pre-rename boundary."""
+    sink = AtomicFileSink(manifest_path(table_dir))
+    wrapped = sink_wrap(sink) if sink_wrap is not None else sink
+    try:
+        wrapped.write(manifest.serialize())
+        wrapped.close()  # fsync(temp) -> rename -> fsync(dir)
+    except BaseException:
+        wrapped.abort()
+        raise
+
+
+# in-process commit serialization, one lock per table directory: two
+# writers in one process must not interleave read-modify-write cycles
+# (cross-process writers still converge through the version check their
+# coordinator applies; this library's own writers are the common case)
+_DIR_LOCKS: Dict[str, threading.Lock] = {}
+_DIR_LOCKS_GUARD = threading.Lock()
+
+
+def _dir_lock(table_dir) -> threading.Lock:
+    key = os.path.abspath(os.fspath(table_dir))
+    with _DIR_LOCKS_GUARD:
+        lock = _DIR_LOCKS.get(key)
+        if lock is None:
+            lock = _DIR_LOCKS[key] = threading.Lock()
+        return lock
+
+
+def commit_manifest(table_dir, mutate: Callable[[Manifest],
+                                                Optional[Manifest]],
+                    sink_wrap: Optional[Callable] = None
+                    ) -> Optional[Manifest]:
+    """One read-modify-write snapshot commit under the table's lock:
+    ``mutate(live)`` receives the CURRENT live manifest (an empty v0 one
+    for a fresh table) and returns the successor — or ``None`` to abort
+    (the optimistic-concurrency conflict path: a compaction whose inputs
+    a rival commit already removed).  The successor's version is stamped
+    ``live.version + 1`` here so no mutator can fork the history."""
+    with _dir_lock(table_dir):
+        live = read_manifest(table_dir)
+        if live is None:
+            live = Manifest(version=0)
+        new = mutate(live)
+        if new is None:
+            return None
+        new.version = live.version + 1
+        if not new.created:
+            new.created = int(time.time())
+        write_manifest(table_dir, new, sink_wrap=sink_wrap)
+        return new
+
+
+# ---------------------------------------------------------------------------
+# zone-map collection (footer -> manifest, at commit time)
+# ---------------------------------------------------------------------------
+
+def collect_entry(table_dir, name: str) -> ManifestEntry:
+    """Build a part-file's manifest entry from its committed footer: per
+    flat column, min over the row groups' decoded stat mins, max over
+    maxes, null/value counts summed — ``None`` wherever any row group's
+    statistics were missing (inconclusive beats wrong)."""
+    from .reader import ParquetFile
+
+    path = os.path.join(os.fspath(table_dir), name)
+    pf = ParquetFile(path)
+    try:
+        zm: Dict[str, Tuple] = {}
+        for leaf in pf.schema.leaves:
+            if leaf.max_repetition_level:
+                continue  # repeated columns have no row-aligned zone map
+            mins, maxs = [], []
+            nulls, nv = 0, 0
+            have_nulls = have_nv = True
+            for rg in pf.row_groups:
+                chunk = rg.column(leaf.column_index)
+                st = chunk.statistics()
+                mins.append(None if st is None else st.min_value)
+                maxs.append(None if st is None else st.max_value)
+                if st is None or st.null_count is None:
+                    have_nulls = False
+                else:
+                    nulls += st.null_count
+                if chunk.meta.num_values is None:
+                    have_nv = False
+                else:
+                    nv += chunk.meta.num_values
+            mn = None if (not mins or any(m is None for m in mins)) \
+                else min(mins)
+            mx = None if (not maxs or any(m is None for m in maxs)) \
+                else max(maxs)
+            zm[leaf.dotted_path] = (mn, mx, nulls if have_nulls else None,
+                                    nv if have_nv else None)
+        return ManifestEntry(name=name, num_rows=pf.num_rows,
+                             file_size=pf.source.size(), zone_maps=zm)
+    finally:
+        pf.close()
+
+
+# ---------------------------------------------------------------------------
+# manifest-level pruning (zero IO)
+# ---------------------------------------------------------------------------
+
+def _zone_alive(pred, entry: ManifestEntry) -> bool:
+    """May this part contain a row matching ``pred``?  The file-level
+    twin of the planner's ``_stats_alive``, answered from the persisted
+    zone map instead of the footer — same conservative semantics, so
+    manifest- and footer-level pruning cannot disagree on a kill."""
+    zm = entry.zone_maps.get(pred.path)
+    if zm is None:
+        return True  # no zone map for the column: inconclusive
+    mn, mx, nulls, nv = zm
+    if pred.kind == "null":
+        if pred.leaf is not None and pred.leaf.max_definition_level == 0:
+            return False  # required column: no null can exist
+        return nulls is None or nulls > 0
+    if pred.kind == "notnull":
+        return not (nulls is not None and nv is not None and nulls >= nv)
+    # range / in require a non-null value
+    if nulls is not None and nv is not None and nulls >= nv:
+        return False
+    if mn is None or mx is None:
+        return True
+    try:
+        if pred.kind == "range":
+            if not pred.negated:
+                return not ((pred.lo is not None and mx < pred.lo)
+                            or (pred.hi is not None and mn > pred.hi))
+            # negated: dead only when every value provably lies inside
+            return not ((pred.lo is None or pred.lo <= mn)
+                        and (pred.hi is None or mx <= pred.hi))
+        # in-list
+        from .search import _any_in_range
+
+        if not pred.negated:
+            return _any_in_range(pred.values, mn, mx)
+        from .planner import _not_in_covers
+
+        return not _not_in_covers(pred.values, mn, mx)
+    except TypeError:
+        return True  # probe not comparable with the stored domain
+
+
+def manifest_may_match(entry: ManifestEntry, expr) -> bool:
+    """May ``entry``'s part contain a matching row?  ``expr`` must be a
+    PREPARED tree (:func:`parquet_tpu.algebra.expr.prepare` — the dataset
+    layer prepares once per corpus); evaluation is pure zone-map math, no
+    IO of any kind."""
+    from ..algebra.expr import Const
+    from .planner import _eval_tree
+
+    if isinstance(expr, Const):
+        return expr.value
+    alive, _ = _eval_tree(expr, lambda p: _zone_alive(p, entry))
+    return alive
+
+
+# ---------------------------------------------------------------------------
+# recovery
+# ---------------------------------------------------------------------------
+
+# sweep-exemption providers: a live writer's flushed-but-uncommitted
+# parts (and a compaction's in-flight merged part) look exactly like
+# orphans to a concurrent sweep — between the part's rename and the
+# manifest commit NOTHING on disk distinguishes them.  In-process actors
+# register a provider (dataset_writer does at import) returning the part
+# names currently in that window for a directory, and the sweep skips
+# them (plus their ``<name>.<rand>.tmp`` temps).  Cross-PROCESS writers
+# have no such shield: run recovery only when no rival process is
+# mid-commit on the table.
+_SWEEP_EXEMPT_PROVIDERS: List[Callable[[str], set]] = []
+
+
+def register_sweep_exempt(fn: Callable[[str], set]) -> None:
+    """Register ``fn(abs_table_dir) -> set of part names`` the orphan
+    sweep must leave alone (in-flight, not-yet-committed work)."""
+    if fn not in _SWEEP_EXEMPT_PROVIDERS:
+        _SWEEP_EXEMPT_PROVIDERS.append(fn)
+
+
+def _sweep_exempt(table_dir_abs: str) -> set:
+    names: set = set()
+    for fn in list(_SWEEP_EXEMPT_PROVIDERS):
+        try:
+            names |= fn(table_dir_abs)
+        except Exception:
+            continue  # a broken provider must not block recovery
+    return names
+
+
+def sweep_orphans(table_dir) -> List[str]:
+    """Crash recovery: delete every ``*.tmp`` and every part-file the
+    live manifest does not name.  Files the manifest DOES name are never
+    touched (the invariant: recovery can only remove data that was never
+    committed), and neither is in-flight work of live IN-PROCESS writers
+    (the exemption registry above; the sweep also serializes with this
+    process's commits through the table lock).  Against writers in OTHER
+    processes there is no shield — run recovery when no rival process is
+    mid-commit.  Returns the removed names; metered as
+    ``table.orphans_swept``."""
+    from ..obs.metrics import counter as _counter
+    from ..obs.scope import account as _account
+
+    table_dir = os.fspath(table_dir)
+    removed: List[str] = []
+    with _dir_lock(table_dir):
+        live = read_manifest(table_dir)
+        keep = set(live.names()) if live is not None else set()
+        exempt = _sweep_exempt(os.path.abspath(table_dir))
+        try:
+            names = sorted(os.listdir(table_dir))
+        except FileNotFoundError:
+            return removed
+        for name in names:
+            orphan = (name.endswith(".tmp")
+                      or (name.startswith(PART_PREFIX)
+                          and name.endswith(".parquet")
+                          and name not in keep))
+            if not orphan or name in keep:
+                continue
+            if any(name == p or name.startswith(p + ".") for p in exempt):
+                continue  # in-flight: its commit may land after us
+            try:
+                os.unlink(os.path.join(table_dir, name))
+                removed.append(name)
+            except OSError:
+                pass  # best-effort: a sweep retries on the next recovery
+    if removed:
+        _account(_counter("table.orphans_swept"), len(removed))
+    return removed

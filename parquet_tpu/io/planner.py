@@ -39,6 +39,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..algebra.expr import And, Const, Expr, Or, Pred, prepare, single_pred
 from ..format.enums import Type
 from ..obs import trace as _trace
@@ -514,6 +516,44 @@ class ScanPlanner:
 # ---------------------------------------------------------------------------
 
 
+def _not_in_covers(sorted_vals, mn, mx) -> bool:
+    """Does the sorted unique probe list cover EVERY value in [mn, mx]?
+    Only provable for integer order domains: the span holds exactly
+    ``mx - mn + 1`` distinct values, so (vals strictly increasing) the
+    probes cover it iff ``vals[i0] == mn`` and ``vals[i0 + span] == mx``
+    — an O(log n) bisect, no enumeration.  This is the ``NOT IN`` page/
+    chunk probe beyond the old constant-page case (``mn == mx``): a page
+    of small-cardinality integer codes dies when the probe list blankets
+    its range.  Non-integer domains (floats, bytes — uncountable or
+    unbounded between any two points) answer False: inconclusive."""
+    from bisect import bisect_left
+
+    try:
+        if mn == mx:  # constant page/chunk: any domain, the legacy case
+            return _bisect_contains(sorted_vals, mn)
+        if isinstance(mn, bool) or isinstance(mx, bool) \
+                or not isinstance(mn, (int, np.integer)) \
+                or not isinstance(mx, (int, np.integer)):
+            return False
+        span = int(mx) - int(mn)
+        i0 = bisect_left(sorted_vals, mn)
+        if i0 + span >= len(sorted_vals):
+            return False
+        v0, v1 = sorted_vals[i0], sorted_vals[i0 + span]
+        return v0 == mn and v1 == mx \
+            and isinstance(v0, (int, np.integer)) \
+            and not isinstance(v0, bool)
+    except TypeError:
+        return False
+
+
+def _bisect_contains(sorted_vals, v) -> bool:
+    from bisect import bisect_left
+
+    i = bisect_left(sorted_vals, v)
+    return i < len(sorted_vals) and sorted_vals[i] == v
+
+
 def _stats_alive(pred: Pred, rg) -> bool:
     """May this row group contain a row matching ``pred``?  Conservative:
     inconclusive statistics answer True."""
@@ -549,7 +589,10 @@ def _stats_alive(pred: Pred, rg) -> bool:
 
         if not pred.negated:
             return _any_in_range(pred.values, mn, mx)
-        return not (mn == mx and mn in set(pred.values))
+        # negated IN: dead when the probe list provably covers EVERY
+        # value the chunk can hold — the constant chunk (mn == mx) or,
+        # for integer domains, a probe run blanketing [mn, mx]
+        return not _not_in_covers(pred.values, mn, mx)
     except TypeError:
         # probe not comparable with the decoded stats domain: inconclusive
         return True
@@ -606,7 +649,9 @@ def _pred_page_ords(pred: Pred, ci) -> List[int]:
             continue
         try:
             if probe_set is not None:
-                dead = mins[i] == maxs[i] and mins[i] in probe_set
+                # beyond the constant-page case: an integer page whose
+                # whole [min, max] span the probe list covers is dead too
+                dead = _not_in_covers(pred.values, mins[i], maxs[i])
             else:
                 dead = ((pred.lo is None or pred.lo <= mins[i])
                         and (pred.hi is None or maxs[i] <= pred.hi))

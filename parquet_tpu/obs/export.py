@@ -121,7 +121,10 @@ def debugz_snapshot(top_n: int = 10) -> dict:
     - ``ops``: the op-scope table — every currently-open operation with
       its age (a stuck op shows up here long before a timeout fires);
     - ``remote``: per-host circuit-breaker states and failure streaks,
-      hedge bytes in flight, and the observed pread-latency EWMA.
+      hedge bytes in flight, and the observed pread-latency EWMA;
+    - ``tables``: open :class:`~parquet_tpu.dataset_writer.DatasetWriter`
+      instances — pending (buffered) ingest rows/bytes, uncommitted
+      flushed parts, committed version.
 
     Imported lazily: the endpoint must answer even in a process that
     never touched the IO layer (families just render empty)."""
@@ -131,6 +134,12 @@ def debugz_snapshot(top_n: int = 10) -> dict:
 
     out = {"ledger": ledger_snapshot(), "pool": pool_debug(),
            "ops": live_ops()}
+    try:
+        from ..dataset_writer import table_debug
+
+        out["tables"] = table_debug()
+    except ImportError:  # pragma: no cover - the package always imports
+        out["tables"] = {"writers": []}
     try:
         from ..io.remote import remote_debug
 

@@ -62,6 +62,8 @@ CORE_ACCOUNTS = (
     ("admission.in_flight", "bytes granted through the read gate"),
     ("trace.buffer", "buffered trace events (estimated bytes)"),
     ("remote.hedge_in_flight", "bytes of in-flight hedged remote reads"),
+    ("table.pending", "ingest bytes buffered in DatasetWriters awaiting "
+     "a part-file flush"),
 )
 
 # soft response: each reclaimer shrinks its tier to this fraction of its

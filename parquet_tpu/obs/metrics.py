@@ -449,6 +449,24 @@ _CORE_COUNTERS = (
     ("remote.breaker_fail_fast", "requests refused by an open circuit"),
     ("remote.validator_changes", "remote rewrites detected by HEAD "
      "validators (caches invalidated)"),
+    # writable tables (dataset_writer.py + io/manifest.py): ingest and
+    # compaction volume, commit conflicts, and recovery sweeps — the
+    # continuous-ingest health dashboard families
+    ("table.commits", "manifest snapshots committed"),
+    ("table.files_written", "part-files committed by ingest"),
+    ("table.rows_ingested", "rows committed into tables"),
+    ("table.bytes_ingested", "part-file bytes committed into tables"),
+    ("table.compactions", "compaction passes committed"),
+    ("table.files_compacted", "part-files replaced by compaction"),
+    ("table.commit_conflicts", "optimistic commits aborted by a rival"),
+    ("table.compaction_errors", "background compaction passes that died"),
+    ("table.orphans_swept", "orphan files removed by table recovery"),
+    # point-lookup fast paths (io/lookup.py): sorted-page binary search
+    # and very-large-batch key sharding
+    ("lookup.binary_search_hits", "page probes answered by in-page "
+     "binary search on sorted files"),
+    ("lookup.key_shards", "key-shard tasks fanned out for very large "
+     "lookup batches"),
 )
 
 
@@ -475,6 +493,9 @@ def _declare_core() -> None:
                             "meter)")
     REGISTRY.histogram("read.admission_wait_s",
                        help="scan/stream block time on the read gate")
+    REGISTRY.histogram("table.commit_s",
+                       help="table commit latency (flush + zone-map "
+                            "collection + manifest rename)")
 
 
 _declare_core()

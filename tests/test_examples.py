@@ -22,6 +22,7 @@ EX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ("serving_telemetry.py", ["20000"]),
     ("memory_budget.py", ["20000"]),
     ("remote_read.py", ["20000"]),
+    ("table_ingest.py", ["5000"]),
     ("tpch_q1_tpu.py", ["50000"]),
 ])
 def test_example_runs(script, argv, tmp_path, monkeypatch, capsys):

