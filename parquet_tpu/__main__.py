@@ -28,13 +28,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet_tpu")
     p.add_argument("command",
                    choices=["meta", "schema", "pages", "head", "verify",
-                            "stats"],
+                            "stats", "analyze"],
                    help="meta: file summary; schema: schema tree; pages: "
                         "page-level dump; head: first rows as JSON lines; "
                         "verify: end-to-end integrity check (exit 0 = every "
                         "file clean, 1 = any corrupt); stats: dump the "
                         "process-wide metrics registry (reads any given "
-                        "files first so the counters meter that work)")
+                        "files first so the counters meter that work); "
+                        "analyze: invariant lint + lockcheck hammer over "
+                        "the package (exit 0 = clean, 1 = findings) — the "
+                        "pre-merge correctness gate")
     p.add_argument("file", nargs="*",
                    help="parquet file path(s); verify accepts several and "
                         "shell-style globs, checked in parallel; stats "
@@ -64,10 +67,19 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1", metavar="ADDR",
                    help="stats --serve: bind address (default loopback; "
                         "0.0.0.0 to let a fleet Prometheus scrape it)")
+    p.add_argument("--knobs-md", action="store_true",
+                   help="analyze: print the generated README "
+                        "'Environment knobs' table and exit")
+    p.add_argument("--no-hammer", action="store_true",
+                   help="analyze: skip the lockcheck hammer subprocess "
+                        "(lint + knob-table sync only)")
     # intermixed: `verify --json a b` and `stats --prom` must both parse
     # now that `file` is optional (plain parse_args cannot place
     # positionals after an optional once nargs="*" matched zero)
     args = p.parse_intermixed_args(argv)
+
+    if args.command == "analyze":
+        return _analyze(args)
 
     if args.command == "stats":
         import json
@@ -204,6 +216,118 @@ def main(argv=None) -> int:
         print(f"parquet_tpu: {e}", file=sys.stderr)
         return 1
     return 0
+
+
+def _knobs_readme_stale():
+    """Compare the committed README knob table against the registry's
+    generated one.  Returns (stale: bool, detail: str); a missing
+    README or markers means 'not applicable' (installed package)."""
+    import os
+
+    from .utils.env import knobs_markdown
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    readme = os.path.join(here, "README.md")
+    if not os.path.exists(readme):
+        return False, "no README.md (installed package?)"
+    text = open(readme).read()
+    begin, end = "<!-- knobs:begin -->", "<!-- knobs:end -->"
+    if begin not in text or end not in text:
+        return True, "README.md has no knobs:begin/knobs:end markers"
+    committed = text.split(begin, 1)[1].split(end, 1)[0].strip()
+    generated = knobs_markdown().strip()
+    if committed != generated:
+        return True, ("README knob table is stale — regenerate with "
+                      "`python -m parquet_tpu analyze --knobs-md`")
+    return False, "README knob table matches the registry"
+
+
+def _analyze(args) -> int:
+    """``python -m parquet_tpu analyze [--json] [--knobs-md]
+    [--no-hammer]``: the standing pre-merge correctness gate — static
+    invariant lint (PT001-PT006), README knob-table sync, and a
+    lockcheck-instrumented hammer pass in a subprocess (the env var must
+    be set before import so even import-time singleton locks are
+    wrapped)."""
+    import json
+    import os
+    import subprocess
+
+    from .analysis.lint import run_lint
+    from .utils.env import knobs_markdown
+
+    if args.knobs_md:
+        sys.stdout.write(knobs_markdown())
+        return 0
+
+    findings = run_lint()
+    stale, knob_detail = _knobs_readme_stale()
+    hammer: dict = {"skipped": True}
+    if not args.no_hammer:
+        # ptlint: disable=PT002 -- whole-environment copy handed to the
+        # hammer subprocess, not a knob read
+        env = dict(os.environ)
+        env["PARQUET_TPU_LOCKCHECK"] = "1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "parquet_tpu.analysis.lockcheck"],
+                capture_output=True, text=True, env=env, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            # a hammer that never returns is the strongest possible
+            # finding (an interleaving actually deadlocked) — report it
+            # as a failure, never as a crash of the gate itself
+            hammer = {"ok": False,
+                      "error": "lockcheck hammer timed out after 600s "
+                               "(likely a real deadlock)",
+                      "stdout": (e.stdout or "")[-2000:] if e.stdout
+                      else "",
+                      "stderr": (e.stderr or "")[-2000:] if e.stderr
+                      else ""}
+        else:
+            try:
+                hammer = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                hammer = {"ok": False,
+                          "error": "hammer produced no report",
+                          "stdout": proc.stdout[-2000:],
+                          "stderr": proc.stderr[-2000:]}
+    hammer_ok = bool(hammer.get("ok", True))
+    ok = not findings and not stale and hammer_ok
+
+    if args.json:
+        print(json.dumps({
+            "ok": ok,
+            "lint": [f.as_dict() for f in findings],
+            "knobs_md": {"stale": stale, "detail": knob_detail},
+            "lockcheck": hammer,
+        }, sort_keys=True))
+        return 0 if ok else 1
+
+    for f in findings:
+        print(f.render())
+    print(f"lint: {len(findings)} finding(s)")
+    print(f"knobs: {knob_detail}")
+    if hammer.get("skipped"):
+        print("lockcheck: skipped (--no-hammer)")
+    else:
+        cyc = hammer.get("cycles", [])
+        blk = [x for x in hammer.get("findings", [])
+               if x.get("kind") != "lock_order_cycle"]
+        print(f"lockcheck: {hammer.get('acquisitions', 0)} acquisitions, "
+              f"{len(hammer.get('edges', []))} lock-order edges, "
+              f"{len(cyc)} cycle(s), {len(blk)} other finding(s)")
+        for c in cyc:
+            print(f"  cycle: {' -> '.join(c + [c[0]])}")
+        for x in blk:
+            print(f"  {x.get('kind')}: {x.get('blocking', x.get('lock'))} "
+                  f"held={x.get('held')}")
+        if not hammer_ok and "error" in hammer:
+            print(f"  error: {hammer['error']}")
+            if hammer.get("stderr"):
+                print(hammer["stderr"])
+    print("analyze: " + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

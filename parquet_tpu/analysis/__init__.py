@@ -1,0 +1,20 @@
+"""Static analysis + runtime concurrency sanitation for parquet_tpu.
+
+Two halves, one CLI face (``python -m parquet_tpu analyze [--json]``):
+
+- ``analysis/lint.py`` — an AST-based invariant linter (rules PT001-
+  PT006) that machine-checks the conventions the engine's correctness
+  rests on: pre-declared metric families, registry-routed env knobs,
+  ledger-account ownership, monotonic-only deadline math, no swallowed
+  ``BaseException``, no direct lock construction.
+- ``analysis/lockcheck.py`` — reporting over the lockdep-style runtime
+  sanitizer in ``utils/locks.py``: the observed lock-order graph, cycle
+  (potential-deadlock) findings with both acquisition stacks, and
+  blocking-under-lock findings.
+- ``analysis/knobs.py`` — the central declaration of every
+  ``PARQUET_TPU_*`` env knob (read through ``utils/env.py``).
+
+Nothing here is imported by the engine at runtime except ``knobs.py``
+(lazily, by the env accessor); importing ``parquet_tpu`` never pays for
+the linter.
+"""

@@ -1,0 +1,175 @@
+"""Reporting over the runtime concurrency sanitizer (utils/locks.py):
+the observed lock-order graph, cycle findings with both acquisition
+stacks, blocking-under-lock findings, and the hammer harness the
+``analyze`` CLI runs to prove the shipped lock graph is cycle-free.
+
+``python -m parquet_tpu.analysis.lockcheck`` (run BY the analyze CLI in
+a subprocess with ``PARQUET_TPU_LOCKCHECK=1`` so even import-time
+singleton locks are instrumented) executes a small mixed workload —
+writes, budgeted parallel reads, scans, batched lookups, a table
+ingest+compact — across pool workers, then prints the JSON report and
+exits 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional
+
+from ..utils import locks as _locks
+
+__all__ = ["lockcheck_report", "find_cycles", "format_stack",
+           "hammer_main"]
+
+
+def format_stack(stack) -> List[str]:
+    """Render a raw (filename, lineno, funcname) frame walk (innermost
+    first) as ``file:line in func`` lines, source looked up lazily."""
+    import linecache
+
+    out = []
+    for filename, lineno, func in stack:
+        line = linecache.getline(filename, lineno).strip()
+        loc = f"{filename}:{lineno} in {func}"
+        out.append(f"{loc}\n    {line}" if line else loc)
+    return out
+
+
+def find_cycles(edges) -> List[List[str]]:
+    """Elementary cycles in the lock-order graph (names), smallest
+    first.  The graph is lock-class-sized; simple DFS per node with a
+    canonical-rotation dedup is plenty."""
+    adj: Dict[str, list] = {}
+    for e in edges:
+        adj.setdefault(e["from"], []).append(e["to"])
+    seen = set()
+    cycles: List[List[str]] = []
+    for start in sorted(adj):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    # canonical rotation: start at the min node
+                    i = path.index(min(path))
+                    canon = tuple(path[i:] + path[:i])
+                    if canon not in seen:
+                        seen.add(canon)
+                        cycles.append(list(canon))
+                elif nxt not in path and nxt > start:
+                    # only explore nodes > start: each cycle is found
+                    # exactly once, from its smallest member
+                    stack.append((nxt, path + [nxt]))
+    cycles.sort(key=len)
+    return cycles
+
+
+def _format_finding(f: dict) -> dict:
+    out = {k: v for k, v in f.items() if not k.startswith("_")}
+    for key in ("stack", "first_stack"):
+        if key in out:
+            out[key] = format_stack(out[key])
+    if "edges" in out:
+        out["edges"] = [dict(e, from_stack=format_stack(e["from_stack"]),
+                             to_stack=format_stack(e["to_stack"]))
+                        for e in out["edges"]]
+    return out
+
+
+def lockcheck_report() -> dict:
+    """The full sanitizer report: every observed edge (with both
+    acquisition stacks formatted), the cycle set recomputed over the
+    final graph, and every finding.  ``ok`` is True iff no findings and
+    no cycles."""
+    snap = _locks.lockcheck_state().snapshot()
+    edges = [dict(e, from_stack=format_stack(e["from_stack"]),
+                  to_stack=format_stack(e["to_stack"]))
+             for e in snap["edges"]]
+    cycles = find_cycles(snap["edges"])
+    findings = [_format_finding(f) for f in snap["findings"]]
+    return {
+        "enabled": _locks.LOCKCHECK_ENABLED,
+        "acquisitions": snap["acquisitions"],
+        "locks": sorted({e["from"] for e in snap["edges"]}
+                        | {e["to"] for e in snap["edges"]}),
+        "edges": sorted(edges, key=lambda e: (e["from"], e["to"])),
+        "cycles": cycles,
+        "findings": findings,
+        "ok": not findings and not cycles,
+    }
+
+
+def _hammer_workload(tmpdir: str) -> None:
+    """A deliberately mixed, concurrent workload touching every
+    converted lock family: writer (buffered, overlapped), footer/chunk/
+    page caches, prefetch ring, admission gate (budgeted), ledger,
+    metrics, scopes, batched lookups, and a table ingest + compact."""
+    import os
+
+    import numpy as np
+    import pyarrow as pa
+
+    import parquet_tpu as pq
+    from parquet_tpu.io.writer import WriterOptions, schema_from_arrow
+    from parquet_tpu.utils.pool import map_in_order
+
+    path = os.path.join(tmpdir, "hammer.parquet")
+    n = 20_000
+    rng = np.random.default_rng(7)
+    tab = pa.table({"k": np.arange(n, dtype=np.int64),
+                    "v": rng.integers(0, 1 << 30, n).astype(np.int64)})
+    opts = WriterOptions(row_group_size=2_000)
+    pq.write_table(tab, path, options=opts)
+
+    os.environ["PARQUET_TPU_READ_BUDGET"] = str(4 << 20)
+    os.environ["PARQUET_TPU_PREFETCH"] = "ring"
+    try:
+        def one(i: int):
+            pf = pq.ParquetFile(path)
+            if i % 3 == 0:
+                pf.read()
+            elif i % 3 == 1:
+                pq.scan_expr(pf, pq.col("v") >= (1 << 29))
+            else:
+                keys = np.arange(i * 7, i * 7 + 64, dtype=np.int64)
+                pq.find_rows(pf, "k", keys, columns=["v"])
+            return None
+
+        map_in_order(one, range(12))
+
+        tdir = os.path.join(tmpdir, "table")
+        w = pq.DatasetWriter(tdir, schema_from_arrow(tab.schema),
+                             sorting=[pq.SortingColumn("k")],
+                             options=opts, rows_per_file=5_000)
+        try:
+            w.write_arrow(tab)
+            w.commit()
+        finally:
+            w.close()
+        pq.compact_table(tdir)
+        ds = pq.open_table(tdir)
+        ds.read()
+    finally:
+        os.environ.pop("PARQUET_TPU_READ_BUDGET", None)
+        os.environ.pop("PARQUET_TPU_PREFETCH", None)
+
+
+def hammer_main(argv: Optional[list] = None) -> int:
+    """Entry point for ``python -m parquet_tpu.analysis.lockcheck``:
+    run the hammer workload under whatever lockcheck state the
+    environment configured, print the JSON report, exit 1 on findings
+    or cycles.  (The analyze CLI launches this in a subprocess with
+    ``PARQUET_TPU_LOCKCHECK=1``.)"""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="pq_lockcheck_") as td:
+        _hammer_workload(td)
+    rep = lockcheck_report()
+    json.dump(rep, sys.stdout, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(hammer_main(sys.argv[1:]))

@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import glob as _glob
 import os
-import threading
 import time
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -49,6 +48,7 @@ from .io.reader import ParquetFile, ReadOptions, Table
 from .io.search import prune_file
 from .obs import scope as _oscope
 from .obs.metrics import histogram as _ohistogram
+from .utils.locks import make_lock
 from .utils.pool import map_in_order
 
 # resolved once: per-operation observation must not take the registry's
@@ -142,7 +142,7 @@ class Dataset:
         self.policy = policy
         self._open_fn = open_fn
         self._files: Dict[int, ParquetFile] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("dataset.files")
         self._schema_sig = None
         # manifest-backed datasets (dataset_writer.open_table): per-path
         # zone-map entries for zero-IO pruning, and the pinned snapshot's
@@ -160,7 +160,7 @@ class Dataset:
         obj.policy = policy
         obj._open_fn = open_fn
         obj._files = {}
-        obj._lock = threading.Lock()
+        obj._lock = make_lock("dataset.files")
         obj._schema_sig = None
         obj._file_stats = None
         obj.snapshot_version = None

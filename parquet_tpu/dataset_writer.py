@@ -54,6 +54,7 @@ from .io.manifest import (Manifest, collect_entry, commit_manifest,
 from .io.sink import AtomicFileSink
 from .io.writer import ColumnData, ParquetWriter, WriterOptions
 from .obs import scope as _oscope
+from .utils.locks import make_lock
 from .obs.ledger import ledger_account, maybe_check_pressure
 from .obs.metrics import counter as _counter
 from .obs.metrics import histogram as _histogram
@@ -81,13 +82,13 @@ _ACC_PENDING = ledger_account("table.pending")
 # /debugz registry: open writers, weakly held so an abandoned writer
 # can never pin itself (or its buffers' ledger rows) alive
 _LIVE_WRITERS: "weakref.WeakSet[DatasetWriter]" = weakref.WeakSet()
-_LIVE_LOCK = threading.Lock()
+_LIVE_LOCK = make_lock("table.live_writers")
 
 # compactions' in-flight merged parts, per abs table dir: between the
 # merged part's rename and its manifest commit it looks like an orphan —
 # the sweep exemption below shields it (and writers' uncommitted parts)
 _COMPACTING: Dict[str, set] = {}
-_COMPACTING_LOCK = threading.Lock()
+_COMPACTING_LOCK = make_lock("table.compacting")
 
 
 def _uncommitted_parts(table_dir_abs: str) -> set:

@@ -54,7 +54,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Optional, Tuple
@@ -63,6 +62,8 @@ import numpy as np
 
 from ..obs.ledger import (LEDGER, ledger_account,
                           maybe_check_pressure as _maybe_pressure)
+from ..utils.env import env_bytes, env_int
+from ..utils.locks import make_lock
 from ..obs.metrics import counter as _counter
 from ..obs.metrics import gauge as _gauge
 from ..obs.scope import account as _account
@@ -73,10 +74,8 @@ __all__ = ["CacheStats", "FooterCache", "ChunkCache", "PageCache",
            "neg_lookup_cache_bytes", "column_nbytes", "freeze_column",
            "invalidate_path", "FOOTERS", "CHUNKS", "PAGES", "NEGS"]
 
-DEFAULT_CHUNK_CACHE_BYTES = 256 << 20
-DEFAULT_FOOTER_CACHE_ENTRIES = 256
-DEFAULT_PAGE_CACHE_BYTES = 64 << 20
-DEFAULT_NEG_LOOKUP_BYTES = 4 << 20
+# capacity defaults live in the knob registry (analysis/knobs.py) —
+# the accessor supplies them; a second copy here would drift
 
 # registry mirrors (parquet_tpu/obs): CacheStats stays the per-process
 # dataclass VIEW (its API is unchanged and clear_caches(reset_stats=True)
@@ -103,40 +102,30 @@ _M_PAGE_BYTES = _gauge("cache.page_bytes",
                        help="decoded bytes resident in the page LRU")
 
 
-def _env_size(name: str, default: int) -> int:
-    v = os.environ.get(name, "").strip()
-    if v:
-        try:
-            return max(0, int(v))
-        except ValueError:
-            pass
-    return default
-
-
 def chunk_cache_bytes() -> int:
     """Decoded-chunk cache capacity: ``PARQUET_TPU_CHUNK_CACHE`` (bytes;
     ``0`` disables) or the 256 MiB default.  Read per call so tests can
     repoint it without rebuilding the cache."""
-    return _env_size("PARQUET_TPU_CHUNK_CACHE", DEFAULT_CHUNK_CACHE_BYTES)
+    return env_bytes("PARQUET_TPU_CHUNK_CACHE")
 
 
 def footer_cache_entries() -> int:
     """Footer cache capacity: ``PARQUET_TPU_FOOTER_CACHE`` (entries; ``0``
     disables) or the 256-entry default."""
-    return _env_size("PARQUET_TPU_FOOTER_CACHE", DEFAULT_FOOTER_CACHE_ENTRIES)
+    return max(0, env_int("PARQUET_TPU_FOOTER_CACHE"))
 
 
 def page_cache_bytes() -> int:
     """Decoded-page cache capacity: ``PARQUET_TPU_PAGE_CACHE`` (bytes;
     ``0`` disables) or the 64 MiB default."""
-    return _env_size("PARQUET_TPU_PAGE_CACHE", DEFAULT_PAGE_CACHE_BYTES)
+    return env_bytes("PARQUET_TPU_PAGE_CACHE")
 
 
 def neg_lookup_cache_bytes() -> int:
     """Negative-lookup memo capacity: ``PARQUET_TPU_NEG_LOOKUP`` (bytes;
     ``0`` disables) or the 4 MiB default — a small tier: it holds keys,
     not pages."""
-    return _env_size("PARQUET_TPU_NEG_LOOKUP", DEFAULT_NEG_LOOKUP_BYTES)
+    return env_bytes("PARQUET_TPU_NEG_LOOKUP")
 
 
 def _top_entries(items, n: int) -> list:
@@ -232,7 +221,7 @@ class FooterCache:
     sharing them across ParquetFile instances is safe."""
 
     def __init__(self, stats: CacheStats):
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.footer")
         # key → (value, nbytes): nbytes is the serialized footer length
         # at parse time — the honest proxy for what the parsed structures
         # pin (thrift expands, but proportionally)
@@ -370,7 +359,7 @@ class ChunkCache:
     set for a single-use entry."""
 
     def __init__(self, stats: CacheStats):
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.chunk")
         self._entries: "OrderedDict[tuple, Tuple[Any, int]]" = OrderedDict()
         self._bytes = 0
         self.stats = stats
@@ -521,7 +510,7 @@ class PageCache:
     refused, eviction size-aware and global."""
 
     def __init__(self, stats: CacheStats):
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.page")
         self._entries: "OrderedDict[tuple, Tuple[PageEntry, int]]" = \
             OrderedDict()
         self._bytes = 0
@@ -640,7 +629,7 @@ class NegLookupCache:
     invalidate on commit."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.neg_lookup")
         # key → (set of normalized keys, nbytes)
         self._entries: "OrderedDict[tuple, list]" = OrderedDict()
         self._bytes = 0

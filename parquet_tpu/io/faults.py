@@ -30,6 +30,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.locks import make_lock
 from ..errors import (CorruptedError, DeadlineError, ReadError, ReadIOError,
                       RemoteError, ShortReadError)
 from ..obs.metrics import counter as _counter
@@ -390,7 +391,7 @@ class PolicySource(Source):
         # paused drain must not inherit the drain's part-spent deadline).
         self._deadline_stack: List[Deadline] = []
         self._op_retries: Dict[int, int] = {}  # id(Deadline) -> retries
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.policy")
         self.retries_performed = 0
 
     @property
@@ -538,7 +539,7 @@ class FaultInjectingSource(Source):
         self.stats = FaultStats()
         self._attempts: Dict[Tuple[int, int], int] = {}
         self._consecutive: Dict[Tuple[int, int], int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.injector")
 
     @property
     def path(self):
@@ -703,7 +704,7 @@ class FaultInjectingRemoteTransport:
         self.stats = RemoteFaultStats()
         self._attempts: Dict[Tuple[int, int], int] = {}
         self._consecutive: Dict[Tuple[int, int], int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.remote_injector")
 
     @property
     def url(self):
@@ -833,7 +834,7 @@ class LocalRangeServer:
         from email.utils import formatdate
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.range_server")
         self._files: Dict[str, bytes] = {}
         self._etag: Dict[str, str] = {}
         self._mtime: Dict[str, float] = {}
@@ -942,6 +943,8 @@ class LocalRangeServer:
             # strictly-advancing mtime: same-tick rewrites must still
             # move the validator (coarse HTTP dates alone would not)
             prev = self._mtime.get(name, 0.0)
+            # ptlint: disable=PT004 -- simulated HTTP Last-Modified wall
+            # time for validator fixtures, not deadline/backoff math
             self._mtime[name] = max(time.time(), prev + 1.0)
 
     def url(self, name: str) -> str:
@@ -1119,7 +1122,7 @@ class SharedCrashState:
         self.crash_at_byte = crash_at_byte
         self.total = 0  # bytes persisted across ALL wrapped sinks
         self.crashed = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.shared_crash")
 
     def wrap(self, sink):
         return _SharedCrashSink(self, sink)

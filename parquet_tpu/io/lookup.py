@@ -88,15 +88,9 @@ def _key_shard_min() -> int:
     """Minimum uniq keys per shard before a very large batch fans its
     KEY SET across pool workers (``PARQUET_TPU_LOOKUP_KEY_SHARD``,
     default 1024; ``0`` disables sharding)."""
-    import os
+    from ..utils.env import env_int
 
-    v = os.environ.get("PARQUET_TPU_LOOKUP_KEY_SHARD", "").strip()
-    if v:
-        try:
-            return max(0, int(v))
-        except ValueError:
-            pass
-    return 1024
+    return max(0, env_int("PARQUET_TPU_LOOKUP_KEY_SHARD"))
 
 
 @dataclass

@@ -37,12 +37,12 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..errors import CorruptedError
+from ..utils.locks import make_lock
 from .sink import AtomicFileSink
 
 __all__ = ["ManifestEntry", "Manifest", "MANIFEST_NAME", "PART_PREFIX",
@@ -228,16 +228,16 @@ def write_manifest(table_dir, manifest: Manifest,
 # writers in one process must not interleave read-modify-write cycles
 # (cross-process writers still converge through the version check their
 # coordinator applies; this library's own writers are the common case)
-_DIR_LOCKS: Dict[str, threading.Lock] = {}
-_DIR_LOCKS_GUARD = threading.Lock()
+_DIR_LOCKS: Dict[str, object] = {}
+_DIR_LOCKS_GUARD = make_lock("manifest.dir_registry")
 
 
-def _dir_lock(table_dir) -> threading.Lock:
+def _dir_lock(table_dir):
     key = os.path.abspath(os.fspath(table_dir))
     with _DIR_LOCKS_GUARD:
         lock = _DIR_LOCKS.get(key)
         if lock is None:
-            lock = _DIR_LOCKS[key] = threading.Lock()
+            lock = _DIR_LOCKS[key] = make_lock("manifest.dir")
         return lock
 
 
@@ -260,6 +260,8 @@ def commit_manifest(table_dir, mutate: Callable[[Manifest],
             return None
         new.version = live.version + 1
         if not new.created:
+            # ptlint: disable=PT004 -- manifest creation timestamp (a
+            # persisted record), not deadline/backoff arithmetic
             new.created = int(time.time())
         write_manifest(table_dir, new, sink_wrap=sink_wrap)
         return new

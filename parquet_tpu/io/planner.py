@@ -34,14 +34,14 @@ group whose index structures are corrupt is skipped and recorded in the
 
 from __future__ import annotations
 
-import os
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..algebra.expr import And, Const, Expr, Or, Pred, prepare, single_pred
+from ..utils.env import env_str
+from ..utils.locks import make_lock
 from ..format.enums import Type
 from ..obs import trace as _trace
 from ..obs.metrics import counter as _mcounter
@@ -721,7 +721,7 @@ class RouteHistory:
     measured device rate exists."""
 
     def __init__(self, alpha: float = 0.3):
-        self._lock = threading.Lock()
+        self._lock = make_lock("planner.route_history")
         self._alpha = alpha
         self._gbps: Dict[str, float] = {}
         self._wait_frac: Dict[str, float] = {}
@@ -920,7 +920,7 @@ def route_scan(pf, path: str, lo=None, hi=None,
 
 
 def _route_pin() -> Optional[str]:
-    v = os.environ.get("PARQUET_TPU_ROUTE", "").strip().lower()
+    v = env_str("PARQUET_TPU_ROUTE").lower()
     if v in ("host", "cpu"):
         return "host"
     if v in ("device", "tpu"):

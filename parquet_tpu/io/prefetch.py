@@ -35,8 +35,6 @@ Env knobs (documented in README "Read pipeline"):
 
 from __future__ import annotations
 
-import os
-import threading
 import time
 from concurrent.futures import TimeoutError as _FutTimeout
 from dataclasses import dataclass
@@ -45,6 +43,8 @@ from typing import List, Optional
 import numpy as np
 
 from ..errors import DeadlineError
+from ..utils.env import env_bool, env_opt_int, env_str
+from ..utils.locks import make_lock
 from ..obs import scope as _oscope
 from ..obs import trace as _trace
 from ..obs.ledger import ledger_account
@@ -73,7 +73,7 @@ _SEG_WINDOWS = 4
 
 def prefetch_mode() -> str:
     """Resolve ``PARQUET_TPU_PREFETCH`` to off | auto | ring | mmap."""
-    v = os.environ.get("PARQUET_TPU_PREFETCH", "1").strip().lower()
+    v = env_str("PARQUET_TPU_PREFETCH").lower()
     if v in ("0", "off", "false", "no"):
         return "off"
     if v in ("ring", "pool"):
@@ -85,18 +85,7 @@ def prefetch_mode() -> str:
 
 def autotune_enabled() -> bool:
     """``PARQUET_TPU_PREFETCH_AUTOTUNE`` opt-out (default on)."""
-    return os.environ.get("PARQUET_TPU_PREFETCH_AUTOTUNE", "1") \
-        .strip().lower() not in ("0", "off", "false", "no")
-
-
-def _env_int(name: str) -> Optional[int]:
-    v = os.environ.get(name, "").strip()
-    if not v:
-        return None
-    try:
-        return int(v)
-    except ValueError:
-        return None
+    return env_bool("PARQUET_TPU_PREFETCH_AUTOTUNE")
 
 
 # tuned knobs react to the bubble meter, normalized PER WINDOW so a long
@@ -136,7 +125,7 @@ class _AutoTuneState:
     entirely."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("prefetch.autotune")
         # class -> [depth override | None, window override | None]
         self._state = {}
 
@@ -290,7 +279,7 @@ class _Plan:
     joins serve zero-copy."""
 
     __slots__ = ("start", "issue", "end", "seg_buf", "seg_start", "seg_end",
-                 "dropped")
+                 "dropped", "pending", "dead")
 
     def __init__(self, start: int, end: int):
         self.start = start
@@ -300,6 +289,8 @@ class _Plan:
         self.seg_start = 0
         self.seg_end = 0
         self.dropped = start  # drop-behind frontier (advise backend)
+        self.pending = 0      # windows claimed but not yet in the ring
+        self.dead = False     # unplanned while a claim was in flight
 
 
 def _innermost(src: Source) -> Source:
@@ -337,8 +328,8 @@ class PrefetchSource(Source):
             raise ValueError(f"unknown prefetch backend {backend!r}")
         self.inner = inner
         self.backend = backend
-        env_window = _env_int("PARQUET_TPU_PREFETCH_WINDOW")
-        env_depth = _env_int("PARQUET_TPU_PREFETCH_DEPTH")
+        env_window = env_opt_int("PARQUET_TPU_PREFETCH_WINDOW")
+        env_depth = env_opt_int("PARQUET_TPU_PREFETCH_DEPTH")
         # the chain's latency class (innermost source's declaration —
         # remote sources report "remote"/"remote_far", everything else is
         # local): picks the readahead baseline and keys the tuner state
@@ -363,9 +354,11 @@ class PrefetchSource(Source):
         self.stats = stats if stats is not None else ReadStats()
         self.stats.backend = backend
         self._owns_inner = owns_inner
-        self._lock = threading.Lock()
+        self._lock = make_lock("prefetch.ring")
         self._plans: List[_Plan] = []
         self._ring: List[_Window] = []  # issue order (oldest first)
+        self._pending = 0   # windows claimed but not yet in the ring
+        self._pump_rr = 0   # round-robin cursor across plans
         self._segs: dict = {}  # id(segment buffer) -> nbytes (ledger)
         self._mmap = _innermost(inner) if backend == "advise" else None
         if backend == "advise" and not isinstance(self._mmap, MmapSource):
@@ -398,7 +391,7 @@ class PrefetchSource(Source):
         maybe_check_pressure()
         with self._lock:
             self._plans.append(_Plan(offset, offset + size))
-            self._pump_locked()
+        self._pump()
 
     def unplan(self, offset: int, size: int) -> None:
         """Cancel the plan registered as (offset, size) and drop its
@@ -412,6 +405,7 @@ class PrefetchSource(Source):
             dead = [p for p in self._plans
                     if p.start == offset and p.end == end]
             for p in dead:
+                p.dead = True
                 self._plans.remove(p)
             dropped = [w for w in self._ring if w.plan in dead]
             for w in dropped:
@@ -420,65 +414,115 @@ class PrefetchSource(Source):
                 self.stats.bytes_discarded += w.end - w.offset
                 _ACC_RING.sub(w.end - w.offset)
             self._gc_segs_locked()
-            if dropped:
-                self._pump_locked()
+        if dropped:
+            self._pump()
 
-    def _pump_locked(self) -> None:
-        """Keep windows issued ahead: round-robin across plans (consumption
-        interleaves across column chunks the same way), bounded by the ring
-        capacity and ``depth`` windows per plan beyond the oldest."""
+    def _claim_one_locked(self):
+        """Claim the next window to issue — round-robin across plans
+        (consumption interleaves across column chunks the same way),
+        bounded by ring capacity and ``depth`` windows per plan, both
+        counting claims still in flight (``_pending``).  Advances the
+        frontier and accounts the bytes INSIDE the ring lock (ledger
+        discipline); returns ``(plan, offset, end, seg, seg_start)`` or
+        None when nothing more can be issued."""
+        if self._closed:
+            return None
+        if len(self._ring) + self._pending >= self.max_windows:
+            return None
+        plans = list(self._plans)
+        if not plans:
+            return None
+        n = len(plans)
+        start = self._pump_rr % n
+        for k in range(n):
+            plan = plans[(start + k) % n]
+            if plan.issue >= plan.end:
+                if plan.pending == 0 and plan in self._plans:
+                    self._plans.remove(plan)
+                continue
+            # per-plan depth bound: at most `depth` un-consumed windows
+            # of this plan in the ring at a time (adjacent plans — the
+            # next chunk's byte range — must not absorb this plan's
+            # budget, so windows are tagged with their plan)
+            if (sum(1 for w in self._ring if w.plan is plan)
+                    + plan.pending >= self.depth):
+                continue
+            self._pump_rr = (start + k) % n + 1
+            end = min(plan.issue + self.window_bytes, plan.end)
+            if plan.seg_buf is None or plan.issue >= plan.seg_end:
+                # chunk-aligned carving: the next few windows share one
+                # contiguous segment buffer, so a cursor read spanning
+                # a window join inside it stays a zero-copy view
+                self._gc_segs_locked()  # release dead segs first (and
+                # retire their ids before a fresh buffer can reuse one)
+                seg_len = min(_SEG_WINDOWS * self.window_bytes,
+                              plan.end - plan.issue)
+                plan.seg_buf = np.empty(seg_len, np.uint8)
+                plan.seg_start = plan.issue
+                plan.seg_end = plan.issue + seg_len
+                self._segs[id(plan.seg_buf)] = seg_len
+                _ACC_SEG.add(seg_len)
+            end = min(end, plan.seg_end)
+            offset = plan.issue
+            self.stats.windows_issued += 1
+            self.stats.bytes_prefetched += end - offset
+            _ACC_RING.add(end - offset)
+            plan.issue = end
+            plan.pending += 1
+            self._pending += 1
+            return plan, offset, end, plan.seg_buf, plan.seg_start
+        return None
+
+    def _pump(self) -> None:
+        """Keep windows issued ahead.  Callers must NOT hold the ring
+        lock: claims and their accounting run inside it, but the
+        executor submission itself is a declared blocking site
+        (utils/locks.note_blocking flags submits under tier locks) and
+        runs between critical sections — in-flight claims are reserved
+        via the ``_pending`` counters so capacity and per-plan depth
+        stay exact."""
         if self.backend == "advise":
-            self._advise_locked()
+            with self._lock:
+                self._advise_locked()
             return
         from ..utils.pool import submit as pool_submit
 
-        progressed = True
-        while progressed and len(self._ring) < self.max_windows:
-            progressed = False
-            for plan in list(self._plans):
-                if plan.issue >= plan.end:
-                    self._plans.remove(plan)
-                    continue
-                # per-plan depth bound: at most `depth` un-consumed windows
-                # of this plan in the ring at a time (adjacent plans — the
-                # next chunk's byte range — must not absorb this plan's
-                # budget, so windows are tagged with their plan)
-                if sum(1 for w in self._ring
-                       if w.plan is plan) >= self.depth:
-                    continue
-                if len(self._ring) >= self.max_windows:
-                    break
-                end = min(plan.issue + self.window_bytes, plan.end)
-                if plan.seg_buf is None or plan.issue >= plan.seg_end:
-                    # chunk-aligned carving: the next few windows share one
-                    # contiguous segment buffer, so a cursor read spanning
-                    # a window join inside it stays a zero-copy view
-                    self._gc_segs_locked()  # release dead segs first (and
-                    # retire their ids before a fresh buffer can reuse one)
-                    seg_len = min(_SEG_WINDOWS * self.window_bytes,
-                                  plan.end - plan.issue)
-                    plan.seg_buf = np.empty(seg_len, np.uint8)
-                    plan.seg_start = plan.issue
-                    plan.seg_end = plan.issue + seg_len
-                    self._segs[id(plan.seg_buf)] = seg_len
-                    _ACC_SEG.add(seg_len)
-                end = min(end, plan.seg_end)
-                fut = pool_submit(self._fill_window, plan.seg_buf,
-                                  plan.issue - plan.seg_start, plan.issue,
-                                  end - plan.issue)
-                # retrieve abandoned errors so a window cancelled/failed
-                # after close never logs "exception was never retrieved";
-                # consumers still see the error through result()
-                fut.add_done_callback(
-                    lambda f: None if f.cancelled() else f.exception())
-                win = _Window(plan.issue, end, fut, plan,
-                              seg=plan.seg_buf, seg_start=plan.seg_start)
-                self._ring.append(win)
-                self.stats.windows_issued += 1
-                self.stats.bytes_prefetched += end - plan.issue
-                _ACC_RING.add(end - plan.issue)
-                plan.issue = end
-                progressed = True
+        while True:
+            with self._lock:
+                spec = self._claim_one_locked()
+            if spec is None:
+                return
+            plan, offset, end, seg, seg_start = spec
+            try:
+                fut = pool_submit(self._fill_window, seg,
+                                  offset - seg_start, offset, end - offset)
+            except BaseException:
+                # executor teardown: un-reserve; the range reads through
+                with self._lock:
+                    self._pending -= 1
+                    plan.pending -= 1
+                    self.stats.bytes_discarded += end - offset
+                    _ACC_RING.sub(end - offset)
+                    self._gc_segs_locked()
+                raise
+            # retrieve abandoned errors so a window cancelled/failed
+            # after close never logs "exception was never retrieved";
+            # consumers still see the error through result()
+            fut.add_done_callback(
+                lambda f: None if f.cancelled() else f.exception())
+            win = _Window(offset, end, fut, plan,
+                          seg=seg, seg_start=seg_start)
+            with self._lock:
+                self._pending -= 1
+                plan.pending -= 1
+                if self._closed or plan.dead:
+                    # closed/unplanned while submitting: never serve it
+                    fut.cancel()
+                    self.stats.bytes_discarded += end - offset
+                    _ACC_RING.sub(end - offset)
+                    self._gc_segs_locked()
+                else:
+                    self._ring.append(win)
 
     def _gc_segs_locked(self) -> None:
         """Release the ledger's segment bytes for carve buffers no plan
@@ -680,7 +724,7 @@ class PrefetchSource(Source):
                         self._ring.remove(w)
                         _ACC_RING.sub(w.end - w.offset)
                     self._gc_segs_locked()
-                    self._pump_locked()
+                self._pump()
                 raise
         with self._lock:
             self.stats.prefetch_hits += 1
@@ -707,7 +751,8 @@ class PrefetchSource(Source):
                     _ACC_RING.sub(w.end - w.offset)
             if drop:
                 self._gc_segs_locked()
-                self._pump_locked()
+        if drop:
+            self._pump()
         if want_view:
             return out
         return out.tobytes() if hasattr(out, "tobytes") else bytes(out)
@@ -739,6 +784,10 @@ class PrefetchSource(Source):
                 if not w.future.cancel() and w.future.done():
                     try:
                         w.future.result()
+                    # ptlint: disable=PT005 -- abandoned-window teardown:
+                    # retrieving the error is the point (suppresses the
+                    # "exception was never retrieved" warning); nobody is
+                    # left to deliver it to
                     except BaseException:
                         pass
                 self.stats.bytes_discarded += w.end - w.offset

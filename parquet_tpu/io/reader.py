@@ -12,9 +12,7 @@ the same rerouting point the north star names (``encoding.Encoding`` /
 
 from __future__ import annotations
 
-import os
 import struct
-import threading
 import time
 import zlib
 from dataclasses import dataclass
@@ -27,6 +25,7 @@ from ..format import enums, metadata as md, thrift
 from ..format.enums import Encoding, PageType, Type
 from ..ops import levels as levels_ops, ref
 from ..schema.schema import Leaf, Schema
+from ..utils.env import env_bool
 from ..obs import scope as _oscope
 from ..obs import trace as _otrace
 from ..obs.metrics import histogram as _ohistogram
@@ -898,8 +897,7 @@ class ParquetFile:
             for leaf in {l.dotted_path: l for l in leaves}.values()
             for i in rg_sel)
         if (row_groups is None and total_sel > _STREAMED_READ_BYTES
-                and os.environ.get("PARQUET_TPU_READ_STREAMED", "1")
-                not in ("0",)):
+                and env_bool("PARQUET_TPU_READ_STREAMED")):
             # policy reads keep this route (the flaky-mount + big-file case
             # is exactly what it exists for): the caller's operation scope
             # is already active, so drive the stream internals directly —

@@ -47,7 +47,6 @@ with the rest of the injection machinery.
 
 from __future__ import annotations
 
-import os
 import re
 import socket
 import threading
@@ -57,6 +56,9 @@ from http.client import HTTPConnection, HTTPException, HTTPSConnection
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
+from ..utils import locks as _locks
+from ..utils.env import env_float, env_int, env_str
+from ..utils.locks import make_condition, make_lock
 from ..errors import (DeadlineError, RemoteCircuitOpenError, RemoteError,
                       RemoteTerminalError, RemoteThrottledError,
                       RemoteTransientError)
@@ -96,8 +98,7 @@ _ACC_HEDGE = ledger_account("remote.hedge_in_flight")
 
 _CONTENT_RANGE = re.compile(r"bytes\s+(\d+)-(\d+)/(\d+|\*)")
 
-DEFAULT_POOL_SIZE = 4
-DEFAULT_TIMEOUT_S = 30.0
+# pool size / timeout defaults live in the knob registry
 # hedging before the latency distribution has warmed: a flat default
 # (observed p95 takes over after _HEDGE_WARMUP_COUNT preads)
 DEFAULT_HEDGE_DELAY_S = 0.05
@@ -107,26 +108,6 @@ _HEDGE_MAX_S = 2.0
 # observed-EWMA boundary between the two remote latency classes the
 # prefetch auto-tuner keys on (io/prefetch.py _CLASS_DEFAULTS)
 _FAR_LATENCY_S = 0.03
-
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name, "").strip()
-    if v:
-        try:
-            return float(v)
-        except ValueError:
-            pass
-    return default
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name, "").strip()
-    if v:
-        try:
-            return int(v)
-        except ValueError:
-            pass
-    return default
 
 
 # ---------------------------------------------------------------------------
@@ -140,7 +121,7 @@ class _HostPool:
 
     def __init__(self, cap: int):
         self.cap = cap
-        self._lock = threading.Lock()
+        self._lock = make_lock("remote.host_pool")
         self._idle: List = []
 
     def get(self):
@@ -167,7 +148,7 @@ class _HostPool:
 
 
 _POOLS: Dict[tuple, _HostPool] = {}
-_POOLS_LOCK = threading.Lock()
+_POOLS_LOCK = make_lock("remote.pools_registry")
 
 
 def _host_pool(scheme: str, host: str, timeout_s: float,
@@ -225,11 +206,9 @@ class HttpTransport:
         if parts.query:
             self._request_path += "?" + parts.query
         self.pool_size = (pool_size if pool_size is not None
-                          else _env_int("PARQUET_TPU_REMOTE_POOL",
-                                        DEFAULT_POOL_SIZE))
+                          else env_int("PARQUET_TPU_REMOTE_POOL"))
         self.timeout_s = (timeout_s if timeout_s is not None
-                          else _env_float("PARQUET_TPU_REMOTE_TIMEOUT",
-                                          DEFAULT_TIMEOUT_S))
+                          else env_float("PARQUET_TPU_REMOTE_TIMEOUT"))
         self._pool = _host_pool(parts.scheme, parts.netloc, self.timeout_s,
                                 self.pool_size)
         self._closed = False
@@ -307,13 +286,13 @@ def breaker_threshold() -> int:
     """``PARQUET_TPU_REMOTE_BREAKER``: consecutive failures that open a
     host's circuit (default 5; ``0`` disables breaking).  Read per check
     so tests and operators can repoint it live."""
-    return _env_int("PARQUET_TPU_REMOTE_BREAKER", 5)
+    return env_int("PARQUET_TPU_REMOTE_BREAKER")
 
 
 def breaker_cooldown_s() -> float:
     """``PARQUET_TPU_REMOTE_BREAKER_COOLDOWN``: seconds an open circuit
     waits before admitting one half-open probe (default 1.0)."""
-    return _env_float("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", 1.0)
+    return env_float("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN")
 
 
 class CircuitBreaker:
@@ -332,7 +311,7 @@ class CircuitBreaker:
 
     def __init__(self, host: str):
         self.host = host
-        self._lock = threading.Lock()
+        self._lock = make_lock("remote.breaker")
         self._state = "closed"
         self._failures = 0
         self._opened_at = 0.0
@@ -411,7 +390,7 @@ class CircuitBreaker:
 
 
 _BREAKERS: Dict[str, CircuitBreaker] = {}
-_BREAKERS_LOCK = threading.Lock()
+_BREAKERS_LOCK = make_lock("remote.breakers_registry")
 
 
 def breaker_for(host: str) -> CircuitBreaker:
@@ -439,7 +418,7 @@ def reset_breakers() -> None:
 # ---------------------------------------------------------------------------
 # Observed latency (hedge-delay seeding + prefetch latency class)
 # ---------------------------------------------------------------------------
-_LAT_LOCK = threading.Lock()
+_LAT_LOCK = make_lock("remote.latency_ewma")
 _LAT_EWMA: Dict[str, float] = {}  # host -> EWMA seconds
 
 
@@ -473,7 +452,7 @@ def hedge_delay_s() -> Optional[float]:
     the p95 of the observed ``remote.pread_s`` distribution (clamped to
     [2ms, 2s]; a flat 50ms until enough preads have been observed), so
     hedges fire exactly at the measured tail, not on a guess."""
-    mode = os.environ.get("PARQUET_TPU_REMOTE_HEDGE", "auto").strip().lower()
+    mode = env_str("PARQUET_TPU_REMOTE_HEDGE").lower()
     if mode in ("0", "off", "false", "no"):
         return None
     if mode not in ("", "1", "auto"):
@@ -495,7 +474,7 @@ def hedge_delay_s() -> Optional[float]:
 _VALIDATOR_CAP = 4096  # tiny entries, but a rolling-partition fleet
 # opens ever-new URLs forever: the memo must be bounded, like any tier
 _VALIDATORS: "OrderedDict[str, tuple]" = OrderedDict()
-_VALIDATORS_LOCK = threading.Lock()
+_VALIDATORS_LOCK = make_lock("remote.validators")
 
 
 def _note_validator(url: str, validator: tuple) -> None:
@@ -721,7 +700,7 @@ class HttpSource(Source):
         delay = hedge_delay_s()
         if delay is None:
             return self._fetch(offset, size, 0)
-        cv = threading.Condition()
+        cv = make_condition("remote.hedge_cv")
         results: Dict[int, tuple] = {}
         state = {"abandoned": False}
 
@@ -752,6 +731,9 @@ class HttpSource(Source):
                 if not abandoned():
                     try:
                         out = ("ok", self._fetch(offset, size, idx))
+                    # ptlint: disable=PT005 -- not swallowed: the error is
+                    # captured into the result slot and re-raised on the
+                    # hedged wait's consuming thread
                     except BaseException as e:
                         out = ("err", e)
             finally:
@@ -822,6 +804,8 @@ class HttpSource(Source):
                                  name="pq-remote-hedge").start()
 
     def pread(self, offset: int, size: int) -> bytes:
+        if _locks.LOCKCHECK_ENABLED:
+            _locks.note_blocking("remote.pread", detail=self.host)
         _check_read_args(offset, size)
         if self._closed:
             raise ValueError(f"read on closed source {self.url!r}")

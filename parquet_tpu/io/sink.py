@@ -27,11 +27,12 @@ from __future__ import annotations
 
 import os
 import secrets
-import threading
 from dataclasses import dataclass
 from typing import List, Optional
 
 from ..errors import WriteError
+from ..utils.env import env_bool, env_opt_bytes
+from ..utils.locks import make_lock
 from ..obs import trace as _trace
 from ..obs.ledger import ledger_account, maybe_check_pressure
 from ..obs.metrics import counter as _counter
@@ -139,8 +140,7 @@ _WRITE_TUNE_MAX_BUFFER = 64 << 20
 
 def write_autotune_enabled() -> bool:
     """``PARQUET_TPU_WRITE_AUTOTUNE`` opt-out (default on)."""
-    return os.environ.get("PARQUET_TPU_WRITE_AUTOTUNE", "1") \
-        .strip().lower() not in ("0", "off", "false", "no")
+    return env_bool("PARQUET_TPU_WRITE_AUTOTUNE")
 
 
 class _WriteAutoTuneState:
@@ -151,7 +151,7 @@ class _WriteAutoTuneState:
     bypasses the state entirely."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("sink.write_autotune")
         self.buffer = None  # None = default
 
     def suggest(self):
@@ -190,13 +190,7 @@ def _env_write_buffer() -> Optional[int]:
     unparseable — the single classifier both the size resolution and the
     autotune-eligibility gate consult, so a garbage value cannot count as
     "pinned" in one place while being ignored in the other."""
-    v = os.environ.get("PARQUET_TPU_WRITE_BUFFER", "").strip()
-    if not v:
-        return None
-    try:
-        return max(0, int(v))
-    except ValueError:
-        return None
+    return env_opt_bytes("PARQUET_TPU_WRITE_BUFFER")
 
 
 def write_buffer_bytes() -> int:

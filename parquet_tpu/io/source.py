@@ -10,12 +10,14 @@ from __future__ import annotations
 import io
 import mmap as _mmap
 import os
-import threading
 from typing import Union
 
 import numpy as np
 
 from ..errors import ShortReadError
+from ..utils import locks as _locks
+from ..utils.env import env_bool
+from ..utils.locks import make_lock
 
 # every terminal read accounts its bytes here (read.bytes_read + the
 # current op scope): wrappers (policy/retry/prefetch) delegate down to
@@ -63,6 +65,8 @@ class FileSource(Source):
         return fd
 
     def pread(self, offset: int, size: int) -> bytes:
+        if _locks.LOCKCHECK_ENABLED:
+            _locks.note_blocking("source.pread", detail=self.path)
         fd = self._checked_fd()
         # POSIX pread may return fewer bytes than requested without being at
         # EOF (signals, NFS): accumulate until full or truly short
@@ -81,6 +85,8 @@ class FileSource(Source):
     def pread_view(self, offset: int, size: int) -> np.ndarray:
         """Read straight into a numpy buffer — one copy (kernel→array)
         instead of pread's kernel→bytes→join."""
+        if _locks.LOCKCHECK_ENABLED:
+            _locks.note_blocking("source.pread", detail=self.path)
         fd = self._checked_fd()
         buf = np.empty(size, np.uint8)
         mv = memoryview(buf)
@@ -151,7 +157,8 @@ class MmapSource(Source):
         else:
             self._fd = None
             os.close(fd)
-        self._fd_lock = threading.Lock()
+        # tier=False: held across a lazy os.open by documented contract
+        self._fd_lock = make_lock("source.mmap_fd", tier=False)
         self._view = memoryview(self._mm)
 
     def _fadvise_fd(self):
@@ -176,6 +183,8 @@ class MmapSource(Source):
         return v
 
     def pread(self, offset: int, size: int) -> bytes:
+        if _locks.LOCKCHECK_ENABLED:
+            _locks.note_blocking("source.pread", detail=self.path)
         _check_read_args(offset, size)
         out = self._checked_view()[offset : offset + size]
         if len(out) != size:
@@ -296,8 +305,7 @@ def dropbehind_enabled() -> bool:
     the hot footers/pages the serving paths live on.  Off by default:
     dropping is wrong for re-read workloads (the warm-cache speedups the
     bench measures) — it is the knob for known-one-shot bulk drains."""
-    return os.environ.get("PARQUET_TPU_MMAP_DROPBEHIND", "0") \
-        not in ("", "0")
+    return env_bool("PARQUET_TPU_MMAP_DROPBEHIND")
 
 
 def _check_read_args(offset: int, size: int) -> None:
@@ -343,7 +351,8 @@ class FileLikeSource(Source):
 
     def __init__(self, f):
         self._f = f
-        self._lock = threading.Lock()
+        # tier=False: the lock IS the seek+read serialization contract
+        self._lock = make_lock("source.filelike_fd", tier=False)
         f.seek(0, io.SEEK_END)
         self._size = f.tell()
 
@@ -353,6 +362,8 @@ class FileLikeSource(Source):
         # "seek of closed file" instead of our contract error — and the
         # seek+read pair itself must stay atomic now that the prefetch
         # layer, host_scan, and mesh staging all pread concurrently
+        if _locks.LOCKCHECK_ENABLED:
+            _locks.note_blocking("source.pread", detail="file-like")
         with self._lock:
             f = self._f
             if f is None:
@@ -451,7 +462,7 @@ def as_source(obj) -> Source:
         # mmap by default: zero-copy page-cache views + madvise readahead
         # (see MmapSource).  PARQUET_TPU_MMAP=0 opts out; any mmap failure
         # (empty file, FIFO/device, exotic fs) falls back to pread
-        if os.environ.get("PARQUET_TPU_MMAP", "1") not in ("0",):
+        if env_bool("PARQUET_TPU_MMAP"):
             try:
                 return MmapSource(path)
             except (OSError, ValueError):

@@ -22,13 +22,13 @@ every mainstream writer guarantees and DataPageV2 requires.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from ..errors import CorruptedError, DeadlineError
+from ..utils.env import env_bool
 from ..format.enums import PageType
 from ..obs import scope as _oscope
 from ..obs import trace as _trace
@@ -291,8 +291,7 @@ def _iter_batches_impl(pf, paths, batch_rows, strict_batch_rows, skip,
     use_pool = (len(paths) > 1 and available_cpus() > 1
                 and not in_shared_pool()
                 and pf.num_rows * len(paths) >= _PARALLEL_MIN_CELLS
-                and os.environ.get("PARQUET_TPU_STREAM_PARALLEL", "1")
-                not in ("0",))
+                and env_bool("PARQUET_TPU_STREAM_PARALLEL"))
 
     pos_iter = iter(range(n_rg))
     cursors: Optional[Dict[str, _ChunkCursor]] = None

@@ -49,6 +49,8 @@ import numpy as np
 from .. import codecs
 from ..errors import MAX_ROW_GROUPS, TooManyRowGroupsError
 from ..format import enums, metadata as md, thrift
+from ..utils.env import env_bytes, env_int, env_str
+from ..utils.locks import make_condition
 from ..obs.ledger import (ledger_account as _ledger_account,
                           maybe_check_pressure as _maybe_pressure)
 from ..format.enums import (CompressionCodec, ConvertedType, Encoding,
@@ -72,7 +74,6 @@ DEFAULT_CREATED_BY = "parquet-tpu version 0.1.0"
 _PARALLEL_ENCODE_BYTES = 8 << 20
 
 
-DEFAULT_WRITE_PENDED_BYTES = 256 << 20
 
 
 def write_depth() -> int:
@@ -83,23 +84,15 @@ def write_depth() -> int:
     keeps encoding while earlier groups' pages flush — the carried-over
     ROADMAP write-overlap-depth follow-on, with the memory it pins
     bounded by the ledger's ``write.pended`` account."""
-    v = os.environ.get("PARQUET_TPU_WRITE_DEPTH", "").strip()
-    if v.isdigit() and int(v) >= 1:
-        return int(v)
-    return 1
+    d = env_int("PARQUET_TPU_WRITE_DEPTH")
+    return d if d >= 1 else 1
 
 
 def write_pended_cap_bytes() -> int:
     """``PARQUET_TPU_WRITE_PENDED``: byte cap on encoded groups queued
     for emit (default 256 MiB; the depth bound still applies).  The cap
     the ROADMAP item was waiting on — supplied by the ledger account."""
-    v = os.environ.get("PARQUET_TPU_WRITE_PENDED", "").strip()
-    if v:
-        try:
-            return max(0, int(v))
-        except ValueError:
-            pass
-    return DEFAULT_WRITE_PENDED_BYTES
+    return env_bytes("PARQUET_TPU_WRITE_PENDED")
 
 
 # resource-ledger account (obs/ledger.py): bytes of encoded row groups
@@ -130,7 +123,7 @@ def _overlap_mode() -> str:
     it pays: >1 CPU and ≥ :data:`_PARALLEL_ENCODE_BYTES` of input per
     group.  Inside a shared-pool worker the write always stays serial —
     collecting a future from within the pool can deadlock the pool."""
-    v = os.environ.get("PARQUET_TPU_WRITE_OVERLAP", "1").strip().lower()
+    v = env_str("PARQUET_TPU_WRITE_OVERLAP").lower()
     if v in ("0", "off", "false", "no"):
         return "off"
     if v == "force":
@@ -298,7 +291,7 @@ class ParquetWriter:
         # write.pended account, capped by PARQUET_TPU_WRITE_PENDED.
         self._depth = write_depth()
         self._pend_q: "deque" = deque()  # (ctx, encs, num_rows, nbytes)
-        self._pend_cv = threading.Condition()
+        self._pend_cv = make_condition("write.pended_cv")
         self._pend_bytes = 0
         self._emit_err: Optional[BaseException] = None
         self._emitter: Optional[threading.Thread] = None
@@ -594,6 +587,9 @@ class ParquetWriter:
             err = None
             try:
                 ctx.copy().run(self._emit_group, encs, num_rows)
+            # ptlint: disable=PT005 -- not swallowed: emitter-thread
+            # errors go sticky into _emit_err and re-raise on the
+            # caller's next write/flush/close
             except BaseException as e:  # InjectedWriterCrash included
                 err = e
             with self._pend_cv:

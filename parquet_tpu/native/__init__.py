@@ -11,7 +11,6 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
 
 import math
@@ -21,7 +20,9 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "native.cpp")
 _SO = os.path.join(_HERE, "_native.so")
-_lock = threading.Lock()
+from ..utils.locks import make_lock
+
+_lock = make_lock("native.build")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
@@ -66,7 +67,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("PARQUET_TPU_NO_NATIVE"):
+        from ..utils.env import env_bool
+
+        if env_bool("PARQUET_TPU_NO_NATIVE"):
             return None
         if not _build():
             return None

@@ -36,10 +36,11 @@ of every read path holds with watermarks and budgets enabled).
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..utils.env import env_bytes
+from ..utils.locks import make_lock
 from .metrics import counter as _counter
 from .metrics import gauge as _gauge
 
@@ -72,25 +73,15 @@ PRESSURE_EVICT_FRACTION = 0.5
 _MAX_RECLAIM_PASSES = 4
 
 
-def _env_bytes(name: str) -> int:
-    v = os.environ.get(name, "").strip()
-    if v:
-        try:
-            return max(0, int(v))
-        except ValueError:
-            pass
-    return 0
-
-
 def soft_watermark_bytes() -> int:
     """``PARQUET_TPU_MEM_SOFT`` (bytes; 0/unset = off).  Read per check so
     tests and long-lived servers can flip pressure live."""
-    return _env_bytes("PARQUET_TPU_MEM_SOFT")
+    return env_bytes("PARQUET_TPU_MEM_SOFT")
 
 
 def hard_watermark_bytes() -> int:
     """``PARQUET_TPU_MEM_HARD`` (bytes; 0/unset = off)."""
-    return _env_bytes("PARQUET_TPU_MEM_HARD")
+    return env_bytes("PARQUET_TPU_MEM_HARD")
 
 
 class Account:
@@ -105,7 +96,7 @@ class Account:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("ledger.account")
         self._resident = 0
         self.high_water = 0
         self._capacity: Optional[Callable[[], int]] = None
@@ -174,7 +165,7 @@ class ResourceLedger:
     (:data:`LEDGER`); tiers reach it through :func:`ledger_account`."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ledger.registry")
         self._accounts: "Dict[str, Account]" = {}
         self._reclaimers: "List[Callable[[float], int]]" = []
         self._state = "ok"

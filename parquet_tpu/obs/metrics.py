@@ -36,9 +36,10 @@ bucket count), same contract as a Prometheus histogram.
 
 from __future__ import annotations
 
-import threading
 from bisect import bisect_left
 from typing import Dict, List, Optional, Tuple
+
+from ..utils.locks import make_lock
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "metrics_snapshot",
@@ -74,7 +75,7 @@ class Counter:
         self.name = name
         self.labels = labels
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.counter")
         self._value = 0
 
     def inc(self, n=1) -> None:
@@ -102,7 +103,7 @@ class Gauge:
         self.name = name
         self.labels = labels
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.gauge")
         self._value = 0
 
     def set(self, v) -> None:
@@ -146,7 +147,7 @@ class Histogram:
         self.buckets = tuple(sorted(buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket edge")
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.histogram")
         self._counts = [0] * (len(self.buckets) + 1)  # last = overflow
         self._sum = 0.0
         self._count = 0
@@ -237,7 +238,7 @@ class MetricsRegistry:
     different type raises (a silent shadow would split the accounting)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
         self._metrics: "Dict[Tuple[str, tuple], object]" = {}
 
     def _get(self, cls, name: str, labels: Optional[Dict[str, str]],
@@ -417,6 +418,17 @@ _CORE_COUNTERS = (
     ("write.row_groups", "row groups written"),
     ("write.bytes_flushed", "bytes flushed toward the OS by writers"),
     ("write.sink_flushes", "coalesced sink flushes"),
+    # WriteStats publish families (io/sink.py): the encode/emit overlap
+    # meters — float-seconds totals land as counters so per-op deltas
+    # and rates stay derivable
+    ("write.overlapped_groups", "row groups whose encode overlapped the "
+     "previous group's emit"),
+    ("write.encode_s", "cumulative seconds in parallel/serial encode"),
+    ("write.emit_s", "cumulative seconds emitting pages to sinks"),
+    ("write.pool_wait_s", "seconds writers blocked on pended encodes"),
+    ("write.bytes_buffered", "bytes coalesced through BufferedSinks"),
+    ("write.writev_flushes", "vectored os.writev sink flushes"),
+    ("pool.tasks", "tasks dispatched to the shared pool"),
     ("trace.events_dropped", "trace events dropped at the buffer cap"),
     # sampling decisions (obs/scope.py): fleets alert on trace-buffer
     # pressure and sampler behavior from these
@@ -496,6 +508,45 @@ def _declare_core() -> None:
     REGISTRY.histogram("table.commit_s",
                        help="table commit latency (flush + zone-map "
                             "collection + manifest rename)")
+    # --- PT001 (analysis/lint.py) pass: every family any module
+    # get-or-creates must already exist here, or a process that never
+    # imported that module scrapes an incomplete /metrics.  The 22
+    # families below were declared only at their modules' import before
+    # this pass.
+    REGISTRY.histogram("prefetch.wait_s",
+                       help="per-wait seconds blocked on unfinished "
+                            "readahead windows (live)")
+    REGISTRY.histogram("lookup.admission_wait_s",
+                       help="lookup-tier block time on the read gate")
+    REGISTRY.histogram("dataset.find_rows_s",
+                       help="dataset-wide batched-lookup latency")
+    REGISTRY.histogram("dataset.read_s",
+                       help="whole-dataset read latency")
+    REGISTRY.histogram("dataset.scan_s",
+                       help="whole-dataset filtered-scan latency")
+    REGISTRY.histogram("dataset.scan_file_s",
+                       help="per-file filtered-scan latency")
+    REGISTRY.histogram("read.file_s",
+                       help="per-file whole-read latency")
+    REGISTRY.gauge("cache.footer_entries",
+                   help="footers resident in the cache")
+    REGISTRY.gauge("cache.chunk_entries",
+                   help="decoded chunks resident in the LRU")
+    REGISTRY.gauge("cache.chunk_bytes",
+                   help="decoded bytes resident in the LRU")
+    REGISTRY.gauge("cache.page_entries",
+                   help="decoded pages resident in the page LRU")
+    REGISTRY.gauge("cache.page_bytes",
+                   help="decoded bytes resident in the page LRU")
+    REGISTRY.gauge("pool.active", help="pool tasks currently running")
+    REGISTRY.gauge("lookup.admitted_bytes",
+                   help="bytes currently admitted through the read gate")
+    for route in ("host", "device"):
+        REGISTRY.gauge("route.gbps", labels={"route": route},
+                       help="EWMA effective GB/s per route")
+        REGISTRY.counter("route.observations", labels={"route": route},
+                         help="measured samples folded into the route "
+                              "EWMA")
 
 
 _declare_core()

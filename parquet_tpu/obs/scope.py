@@ -48,12 +48,12 @@ from __future__ import annotations
 import contextvars
 import itertools
 import json
-import os
 import random
-import threading
 import time
 from typing import Dict, Iterator, Optional
 
+from ..utils.env import env_int, env_opt_float, env_str
+from ..utils.locks import make_lock
 from . import metrics as _metrics
 from . import trace as _trace
 from .metrics import _render_key
@@ -76,13 +76,13 @@ _OPS_SKIPPED = _metrics.counter("trace.ops_skipped")
 _OPS_SLOW = _metrics.counter("trace.ops_slow_kept")
 _BYTES_READ = _metrics.counter("read.bytes_read")
 
-_SLOW_LOG_LOCK = threading.Lock()
+_SLOW_LOG_LOCK = make_lock("scope.slow_log")
 
 # currently-open operations, op_id → scope: the /debugz op table.  Every
 # scope registers at construction and leaves at finish(); an entry that
 # lingers IS the signal (a stuck or leaked op is exactly what a live
 # introspection endpoint exists to show).
-_LIVE_LOCK = threading.Lock()
+_LIVE_LOCK = make_lock("scope.live_ops")
 _LIVE_OPS: "Dict[int, OpScope]" = {}
 
 
@@ -110,7 +110,7 @@ def live_ops() -> list:
 # per block of N, but WHICH position is drawn fresh each block — a plain
 # `op_id % N` stride would lock onto periodic workloads (2 ops per
 # request + N=2 means one op class is sampled always, the other never)
-_SAMPLE_LOCK = threading.Lock()
+_SAMPLE_LOCK = make_lock("scope.sampler")
 _SAMPLE_I = 0
 _SAMPLE_N: Optional[int] = None
 _SAMPLE_TARGET = 0
@@ -130,30 +130,18 @@ def _head_sampled(n: int) -> bool:
 
 def sample_n() -> int:
     """``PARQUET_TPU_TRACE_SAMPLE`` as an int ≥ 1 (1 = trace every op)."""
-    v = os.environ.get("PARQUET_TPU_TRACE_SAMPLE", "").strip()
-    if not v:
-        return 1
-    try:
-        return max(1, int(v))
-    except ValueError:
-        return 1
+    return max(1, env_int("PARQUET_TPU_TRACE_SAMPLE"))
 
 
 def slow_op_threshold_s() -> Optional[float]:
     """``PARQUET_TPU_SLOW_OP_S`` as seconds, or None (tail capture off).
     0 keeps every op — the capture-everything debugging mode."""
-    v = os.environ.get("PARQUET_TPU_SLOW_OP_S", "").strip()
-    if not v:
-        return None
-    try:
-        return float(v)
-    except ValueError:
-        return None
+    return env_opt_float("PARQUET_TPU_SLOW_OP_S")
 
 
 def slow_log_path() -> Optional[str]:
     """``PARQUET_TPU_SLOW_LOG``: the JSON-lines slow-op record file."""
-    return os.environ.get("PARQUET_TPU_SLOW_LOG", "").strip() or None
+    return env_str("PARQUET_TPU_SLOW_LOG") or None
 
 
 def current_op() -> "Optional[OpScope]":
@@ -234,7 +222,7 @@ class OpScope:
         self.attrs = dict(attrs or {})
         self.op_id = next(_IDS)
         self.duration_s = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("scope.op")
         self._counters: Dict[str, float] = {}
         self._stages: Dict[str, list] = {}
         self._active = 0
@@ -407,6 +395,8 @@ class OpScope:
         path = slow_log_path()
         if not path:
             return
+        # ptlint: disable=PT004 -- wall-clock record timestamp for log
+        # correlation, not deadline/backoff arithmetic
         rec = {"ts": round(time.time(), 6), "op": self.op_id,
                "name": self.name,
                "attrs": {k: _trace._jsonable(v)

@@ -38,7 +38,9 @@ import numpy as np
 
 import jax
 
-if not os.environ.get("PARQUET_TPU_NO_X64"):
+from ..utils.env import env_bool
+
+if not env_bool("PARQUET_TPU_NO_X64"):
     jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp

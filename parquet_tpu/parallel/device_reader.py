@@ -575,9 +575,9 @@ def _dense_mode() -> str:
     straddle it used to opt into passed its on-chip trial and is now the
     built-in w ≥ 17 formulation — scripts/mosaic_repro.py).
     PARQUET_TPU_PALLAS=1 → pallas, =0 → jnp, =off → off."""
-    import os
+    from ..utils.env import env_str
 
-    v = os.environ.get("PARQUET_TPU_PALLAS", "")
+    v = env_str("PARQUET_TPU_PALLAS")
     if v == "1":
         return "pallas"
     if v == "0":
@@ -594,9 +594,9 @@ def _backend_route(env_var: str) -> str:
     else 'device' on a real TPU and 'host' on every other backend (where
     the XLA emulation of gather/bitcast-shaped kernels is the measured
     pathological case)."""
-    import os
+    from ..utils.env import env_str
 
-    v = os.environ.get(env_var, "").lower()
+    v = env_str(env_var).lower()
     if v in ("host", "device"):
         return v
     return "device" if jax.default_backend() == "tpu" else "host"
@@ -1136,9 +1136,9 @@ def stage_levels_on_device(leaf, plan: _Plan) -> bool:
         if plan.total_values == plan.total_slots:
             return False  # no nulls anywhere: validity is None, levels unused
         return leaf.max_definition_level <= 1
-    import os
+    from ..utils.env import env_str
 
-    flag = os.environ.get("PARQUET_TPU_DEVICE_ASM")
+    flag = env_str("PARQUET_TPU_DEVICE_ASM")
     if flag == "0":
         return False
     if flag != "1":
@@ -1334,7 +1334,6 @@ def decode_chunks_pipelined(chunks, keep_dictionary: bool = True,
 
 def _decode_chunks_pipelined_impl(chunks, keep_dictionary: bool,
                                   workers: int):
-    import threading
     from concurrent.futures import ThreadPoolExecutor
 
     from ..utils.pool import available_cpus
@@ -1357,8 +1356,10 @@ def _decode_chunks_pipelined_impl(chunks, keep_dictionary: bool,
             counters.inc("chunk_batched_fallback")
             # any decode error falls through to the single-plan path, which
             # owns error semantics (incl. host fallback)
+    from ..utils.locks import make_lock
+
     active = {"n": 0}
-    lock = threading.Lock()
+    lock = make_lock("device.stage_concurrency")
 
     def prep(reader):
         with lock:

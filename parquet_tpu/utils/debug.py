@@ -9,21 +9,22 @@ bytes H2D, kernel launches) behind ``PARQUET_TPU_DEBUG``.
 from __future__ import annotations
 
 import functools
-import os
 import sys
-import threading
 import time
 from collections import defaultdict
 from typing import Optional
 
-DEBUG = os.environ.get("PARQUET_TPU_DEBUG", "") not in ("", "0", "false")
+from .env import env_bool, env_str
+from .locks import make_lock
+
+DEBUG = env_bool("PARQUET_TPU_DEBUG")
 
 
 class Counters:
     """Thread-safe named counters; cheap when unused."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("debug.counters")
         self._counts = defaultdict(int)
 
     def inc(self, name: str, by: int = 1) -> None:
@@ -83,7 +84,7 @@ def profiler_trace(out_dir: Optional[str] = None):
     """
     import contextlib
 
-    out_dir = out_dir or os.environ.get("PARQUET_TPU_TRACE_DIR")
+    out_dir = out_dir or env_str("PARQUET_TPU_TRACE_DIR") or None
     if not out_dir:
         return contextlib.nullcontext()
     import jax

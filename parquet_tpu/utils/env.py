@@ -1,0 +1,256 @@
+"""Central accessor for every ``PARQUET_TPU_*`` environment knob.
+
+Twelve PRs grew ~45 knobs, each parsed ad hoc at its own ``os.environ``
+site — five private ``_env_int``/``_env_bytes`` helpers with subtly
+different unset/invalid semantics, three bool conventions, and a README
+table maintained by hand.  This module is the one funnel:
+
+- :class:`Knob` — name, type, default, and doc for one knob.  The full
+  registry lives in ``parquet_tpu/analysis/knobs.py`` (pure data, no
+  imports) and loads lazily on first access, so this module stays
+  import-cheap for the low-level callers (locks, metrics, sources).
+- Typed accessors (:func:`env_bool`, :func:`env_int`, :func:`env_bytes`,
+  :func:`env_opt_bytes`, ...) read the environment PER CALL — tests and
+  long-lived servers flip knobs live, exactly like the sites they
+  replaced — and take their default from the declaration.
+- :func:`knobs_markdown` renders the README "Environment knobs" table
+  from the registry, so the docs are generated, never hand-drifted
+  (``python -m parquet_tpu analyze --knobs-md``; a test asserts the
+  committed table matches).
+
+The invariant linter (``analysis/lint.py`` rule PT002) flags any
+``os.environ`` read outside this module and any literal ``PARQUET_TPU_*``
+name passed to an accessor that is not declared — an undeclared knob is
+an undocumented knob, and an accessor/type mismatch is a parsing bug.
+
+Parse semantics (uniform across every knob of a type):
+
+- ``bool`` — unset/empty → default; ``0``/``off``/``false``/``no``
+  (case-insensitive) → False; anything else → True.
+- ``int`` / ``float`` — unset/empty/unparseable → default.
+- ``bytes`` — like int, clamped non-negative (byte capacities).
+- ``opt_int`` / ``opt_float`` / ``opt_bytes`` — unset/empty/unparseable
+  → None ("no pin"), so autotuners can tell "operator pinned 0" from
+  "operator said nothing".
+- ``str`` — unset → default; otherwise the stripped raw value (sites
+  with richer vocabularies — ``auto``/``force``/mode strings — parse
+  the string themselves).
+
+Accessors accept undeclared names only when they do not start with
+``PARQUET_TPU_`` (test fixtures point ``AdmissionController`` at
+scratch env vars); an undeclared ``PARQUET_TPU_*`` name raises — the
+registry is the documentation, and reading around it is the bug this
+module exists to prevent.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+__all__ = ["Knob", "declare", "knobs", "knob", "knobs_markdown",
+           "env_str", "env_bool", "env_int", "env_float", "env_bytes",
+           "env_opt_int", "env_opt_float", "env_opt_bytes"]
+
+_FALSEY = ("0", "off", "false", "no")
+
+# accessor name → knob types it may legally read (lint rule PT002
+# cross-checks literal calls against the registry with this table)
+ACCESSOR_TYPES = {
+    "env_str": ("str",),
+    "env_bool": ("bool",),
+    "env_int": ("int",),
+    "env_float": ("float",),
+    "env_bytes": ("bytes",),
+    "env_opt_int": ("opt_int",),
+    "env_opt_float": ("opt_float",),
+    "env_opt_bytes": ("opt_bytes",),
+}
+
+_VALID_TYPES = frozenset(t for types in ACCESSOR_TYPES.values()
+                         for t in types)
+
+
+class Knob:
+    """One declared knob: ``name`` (the env var), ``type`` (one of the
+    accessor types above), ``default`` (returned when unset/invalid;
+    None for the ``opt_*`` types), ``doc`` (one line, rendered into the
+    README table)."""
+
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, type: str, default, doc: str):
+        if type not in _VALID_TYPES:
+            raise ValueError(f"knob {name}: unknown type {type!r}")
+        if not doc:
+            raise ValueError(f"knob {name}: doc is required")
+        self.name = name
+        self.type = type
+        self.default = default
+        self.doc = doc
+
+    def __repr__(self) -> str:
+        return (f"Knob({self.name!r}, {self.type!r}, "
+                f"default={self.default!r})")
+
+
+_KNOBS: "Dict[str, Knob]" = {}
+_LOADED = False
+
+
+def declare(name: str, type: str, default, doc: str) -> Knob:
+    """Register one knob (called by analysis/knobs.py at registry load).
+    Duplicate declarations raise — two defaults for one env var is a
+    documentation fork."""
+    if name in _KNOBS:
+        raise ValueError(f"knob {name} declared twice")
+    k = Knob(name, type, default, doc)
+    _KNOBS[name] = k
+    return k
+
+
+def _ensure_loaded() -> None:
+    global _LOADED
+    if not _LOADED:
+        _LOADED = True
+        # the registry is pure data; importing it here (not at module
+        # top) keeps utils/env import-free for the lowest layers
+        from ..analysis import knobs as _knobs  # noqa: F401
+
+
+def knobs() -> "Tuple[Knob, ...]":
+    """Every declared knob, name-sorted (the generated-docs order)."""
+    _ensure_loaded()
+    return tuple(_KNOBS[n] for n in sorted(_KNOBS))
+
+
+def knob(name: str) -> Optional[Knob]:
+    """The declaration for ``name``, or None when undeclared."""
+    _ensure_loaded()
+    return _KNOBS.get(name)
+
+
+def _resolve(name: str, want: str):
+    """The declared default for ``name`` (type-checked), or the ``opt``
+    None default for undeclared non-PARQUET names (test fixtures)."""
+    k = knob(name)
+    if k is None:
+        if name.startswith("PARQUET_TPU_"):
+            raise KeyError(
+                f"undeclared knob {name}: declare it in "
+                f"parquet_tpu/analysis/knobs.py (name/type/default/doc)")
+        return None
+    if want not in ACCESSOR_TYPES or k.type not in ACCESSOR_TYPES[want]:
+        raise TypeError(f"knob {name} is declared {k.type!r}; "
+                        f"read it with the matching accessor, not {want}")
+    return k.default
+
+
+def _raw(name: str) -> str:
+    return os.environ.get(name, "").strip()
+
+
+def env_str(name: str) -> str:
+    default = _resolve(name, "env_str")
+    v = _raw(name)
+    return v if v else (default or "")
+
+
+def env_bool(name: str) -> bool:
+    default = _resolve(name, "env_bool")
+    v = _raw(name)
+    if not v:
+        return bool(default)
+    return v.lower() not in _FALSEY
+
+
+def env_int(name: str) -> int:
+    default = _resolve(name, "env_int")
+    v = _raw(name)
+    if v:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    return int(default or 0)
+
+
+def env_float(name: str) -> float:
+    default = _resolve(name, "env_float")
+    v = _raw(name)
+    if v:
+        try:
+            return float(v)
+        except ValueError:
+            pass
+    return float(default or 0.0)
+
+
+def env_bytes(name: str) -> int:
+    default = _resolve(name, "env_bytes")
+    v = _raw(name)
+    if v:
+        try:
+            return max(0, int(v))
+        except ValueError:
+            pass
+    return int(default or 0)
+
+
+def env_opt_int(name: str) -> Optional[int]:
+    _resolve(name, "env_opt_int")
+    v = _raw(name)
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def env_opt_float(name: str) -> Optional[float]:
+    _resolve(name, "env_opt_float")
+    v = _raw(name)
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+def env_opt_bytes(name: str) -> Optional[int]:
+    _resolve(name, "env_opt_bytes")
+    v = _raw(name)
+    if not v:
+        return None
+    try:
+        return max(0, int(v))
+    except ValueError:
+        return None
+
+
+def _default_md(k: Knob) -> str:
+    if k.default is None:
+        return "unset"
+    if k.type == "bool":
+        return "on" if k.default else "off"
+    if k.type in ("bytes", "opt_bytes") and isinstance(k.default, int) \
+            and k.default and k.default % (1 << 20) == 0:
+        return f"{k.default >> 20} MiB"
+    if k.default == "":
+        return "unset"
+    return str(k.default)
+
+
+def knobs_markdown() -> str:
+    """The README "Environment knobs" table, generated from the registry
+    (``python -m parquet_tpu analyze --knobs-md``).  Committed output is
+    asserted in tests to match, so docs cannot drift from code."""
+    lines = ["| Knob | Type | Default | What it does |",
+             "| --- | --- | --- | --- |"]
+    for k in knobs():
+        doc = k.doc.replace("|", "\\|")  # literal pipes break the table
+        lines.append(f"| `{k.name}` | {k.type} | {_default_md(k)} "
+                     f"| {doc} |")
+    return "\n".join(lines) + "\n"

@@ -20,6 +20,9 @@ from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
 from typing import Optional
 
+from . import locks as _locks
+from .env import env_int, env_opt_bytes
+from .locks import make_condition, make_lock
 from ..obs import ledger as _ledger
 from ..obs import scope as _scope
 from ..obs import trace as _trace
@@ -28,7 +31,7 @@ from ..obs.metrics import gauge as _gauge
 from ..obs.metrics import histogram as _histogram
 
 _POOL: Optional[ThreadPoolExecutor] = None
-_LOCK = threading.Lock()
+_LOCK = make_lock("pool.build")
 _IN_POOL = threading.local()
 
 # queue→run wait per task: the pool-saturation meter every operation's
@@ -134,6 +137,9 @@ def submit(fn, *args, **kwargs):
     GB/s by), and with tracing on each task runs inside a ``pool.task``
     span carrying its worker-thread id — pipeline overlap is visible as
     overlapping bars on worker tracks."""
+    if _locks.LOCKCHECK_ENABLED:
+        _locks.note_blocking("pool.submit",
+                            detail=getattr(fn, "__name__", "") or "")
     wrapped = instrument_task(mark_pooled(fn),
                               name=getattr(fn, "__name__", None))
     return shared_pool().submit(wrapped, *args, **kwargs)
@@ -189,16 +195,6 @@ def map_in_order(fn, items, parallel: "Optional[bool]" = None) -> list:
     return out
 
 
-def _env_opt_bytes(name: str) -> Optional[int]:
-    v = os.environ.get(name, "").strip()
-    if not v:
-        return None
-    try:
-        return max(0, int(v))
-    except ValueError:
-        return None
-
-
 class AdmissionController:
     """FIFO bytes-budget gate over EVERY in-flight read span — the
     unified generalization of the PR-9 lookup-only gate (ROADMAP item 3's
@@ -249,7 +245,7 @@ class AdmissionController:
         self._tier_envs = {"lookup": env_var,
                            "scan": "PARQUET_TPU_SCAN_BUDGET"}
         self._default_lookup = default_bytes
-        self._cv = threading.Condition(threading.Lock())
+        self._cv = make_condition("pool.admission")
         self._queue: "deque" = deque()
         self._in_use = 0
         self._tier_use: dict = {}
@@ -259,7 +255,7 @@ class AdmissionController:
     def global_budget_bytes(self) -> Optional[int]:
         """``PARQUET_TPU_READ_BUDGET`` — the unified cap (None = unset,
         ``0`` = admission explicitly off for every tier)."""
-        return _env_opt_bytes("PARQUET_TPU_READ_BUDGET")
+        return env_opt_bytes("PARQUET_TPU_READ_BUDGET")
 
     def budget_bytes(self, tier: str = "lookup") -> int:
         """Effective budget for ``tier``, read per acquire (tests repoint
@@ -270,7 +266,7 @@ class AdmissionController:
         g = self.global_budget_bytes()
         if g == 0:
             return 0
-        t = _env_opt_bytes(self._tier_envs.get(tier, ""))
+        t = env_opt_bytes(self._tier_envs.get(tier, ""))
         if t is not None:
             return t
         if g is not None:
@@ -443,9 +439,9 @@ def available_cpus() -> int:
 def pool_width() -> int:
     """Worker count the shared pool is (or will be) built with.
     ``PARQUET_TPU_POOL_WORKERS`` overrides; read at first use."""
-    env = os.environ.get("PARQUET_TPU_POOL_WORKERS", "")
-    if env.isdigit() and int(env) > 0:
-        return int(env)
+    width = env_int("PARQUET_TPU_POOL_WORKERS")
+    if width > 0:
+        return width
     # size to the machine: far more workers than cores just thrashes the
     # GIL on the python slices between the GIL-releasing numpy/C++/codec
     # calls (measured ~1.6x slowdown at 16 workers on one core); 2 is the

@@ -1017,6 +1017,57 @@ print(f"table smoke ok: compaction byte-identical to one-shot, pinned "
       f"{sorted(outcomes)}, orphan sweep clean")
 TABLEEOF
 
+echo "=== analysis smoke (invariant lint + lockcheck gate) ==="
+# the standing pre-merge correctness gate: AST lint over the package
+# (PT001-PT006), README knob table generated-vs-committed, and a
+# lockcheck-instrumented mixed hammer in a subprocess — exit 0 required
+python -m parquet_tpu analyze
+# knob table regeneration is byte-stable (the analyze pass above already
+# compared it against README.md's committed block)
+python -m parquet_tpu analyze --knobs-md | head -3 | grep -q "| Knob |"
+# lockcheck-enabled rerun of the shipped concurrency hammers: ledger
+# 8-worker mixed-op, lookup admission hammer, table ingest/scan/compact —
+# the observed lock-order graph must be cycle-free with zero
+# blocking-under-lock findings
+LOCKREP="$(mktemp /tmp/pq_lockcheck.XXXXXX.json)"
+PARQUET_TPU_LOCKCHECK=1 PARQUET_TPU_LOCKCHECK_REPORT="$LOCKREP" \
+python -m pytest \
+  tests/test_ledger.py::test_hammer_8_workers_exact_accounting \
+  tests/test_lookup.py::test_admission_budget_held_under_hammer \
+  tests/test_table.py::test_concurrent_ingest_scan_lookup_compact_hammer \
+  -q -p no:cacheprovider
+python - "$LOCKREP" <<'LOCKEOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["cycles"] == [], f"lock-order cycles: {rep['cycles']}"
+assert rep["findings"] == [], rep["findings"][:3]
+assert rep["acquisitions"] > 10_000, rep["acquisitions"]
+print(f"lockcheck hammer rerun: {rep['acquisitions']} acquisitions, "
+      f"{len(rep['edges'])} edges, cycle-free, 0 findings")
+LOCKEOF
+rm -f "$LOCKREP"
+# pass-through proof: with PARQUET_TPU_LOCKCHECK unset the factories
+# hand back plain stdlib locks — acquire/release must time identically
+# (the warm-read perf floors in the bench smoke below guard the
+# end-to-end side)
+python - <<'PASSEOF'
+import threading, time
+from parquet_tpu.utils.locks import make_lock
+plain, made = threading.Lock(), make_lock("smoke.bench")
+assert type(made) is type(plain), type(made)
+def loop(lk, n=20000):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with lk:
+            pass
+    return time.perf_counter() - t0
+loop(plain); loop(made)
+tp = min(loop(plain) for _ in range(7))
+tm = min(loop(made) for _ in range(7))
+assert tm <= tp * 1.05, (tm, tp)
+print(f"lockcheck-off pass-through: {tm/tp:.3f}x plain lock time")
+PASSEOF
+
 echo "=== bench smoke (tiny sizes; asserts contract + physics) ==="
 BENCH_OUT=$(mktemp -d)
 BENCH_QUICK=1 python bench.py 2>&1 | tee "$BENCH_OUT/raw.txt" | python -c "

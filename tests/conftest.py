@@ -27,6 +27,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 `-m 'not "
+        "slow'` run (check.sh runs them)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -1,0 +1,5 @@
+"""PT001 fixture: get-or-creates a metric family that obs/metrics.py
+never pre-declared — the --prom scrape would silently miss it."""
+from parquet_tpu.obs.metrics import counter
+
+_M_BOGUS = counter("bogus.family_nobody_declared")

@@ -1,0 +1,6 @@
+"""PT002 fixture: reads a knob straight off os.environ instead of the
+utils/env.py accessor (and probes an undeclared knob name)."""
+import os
+
+RAW = os.environ.get("PARQUET_TPU_CHUNK_CACHE", "")
+ALSO = os.getenv("PARQUET_TPU_PAGE_CACHE")

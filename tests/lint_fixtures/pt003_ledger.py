@@ -1,0 +1,5 @@
+"""PT003 fixture: resolves a ledger account owned by io/cache.py from
+a foreign module — a second writer to a tier-exact account."""
+from parquet_tpu.obs.ledger import ledger_account
+
+ACC = ledger_account("cache.chunk")

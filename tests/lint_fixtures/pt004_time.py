@@ -1,0 +1,6 @@
+"""PT004 fixture: wall-clock arithmetic in deadline/backoff code."""
+import time
+
+
+def deadline_in(seconds):
+    return time.time() + seconds

@@ -1,0 +1,7 @@
+"""PT006 fixture: direct lock construction bypassing utils/locks.py —
+invisible to the lockcheck sanitizer."""
+import threading
+from threading import RLock
+
+LOCK = threading.Lock()
+RL = RLock()

@@ -1,0 +1,311 @@
+"""Invariant linter (analysis/lint.py), knob registry (analysis/knobs.py
++ utils/env.py), and the generated README knob table.
+
+Per-rule coverage: one fixture module per rule under
+``tests/lint_fixtures/`` that MUST flag, plus a no-false-positive run
+over the real ``parquet_tpu/`` tree — the same invocation the
+``python -m parquet_tpu analyze`` gate (scripts/check.sh) runs."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from parquet_tpu.analysis.lint import (Finding, declared_metric_families,
+                                       lint_file, lint_source, run_lint)
+from parquet_tpu.utils import env as envmod
+from parquet_tpu.utils.env import (env_bool, env_bytes, env_int,
+                                   env_opt_bytes, env_opt_float, env_str,
+                                   knob, knobs, knobs_markdown)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# one fixture module per rule: each must flag its rule (and, negative
+# control, nothing unrelated like PT000)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("fixture,rule,rel", [
+    ("pt001_metric.py", "PT001", "parquet_tpu/io/fixture.py"),
+    ("pt002_env.py", "PT002", "parquet_tpu/io/fixture.py"),
+    ("pt003_ledger.py", "PT003", "parquet_tpu/io/fixture.py"),
+    ("pt004_time.py", "PT004", "parquet_tpu/io/fixture.py"),
+    ("pt005_except.py", "PT005", "parquet_tpu/io/fixture.py"),
+    ("pt006_lock.py", "PT006", "parquet_tpu/io/fixture.py"),
+])
+def test_fixture_flags_its_rule(fixture, rule, rel):
+    findings = lint_file(os.path.join(FIXTURES, fixture), rel=rel)
+    assert rule in _rules(findings), findings
+    assert "PT000" not in _rules(findings)
+
+
+def test_pt002_flags_both_environ_and_getenv():
+    findings = lint_file(os.path.join(FIXTURES, "pt002_env.py"),
+                         rel="parquet_tpu/io/fixture.py")
+    assert sum(1 for f in findings if f.rule == "PT002") == 2
+
+
+def test_pt005_flags_bare_and_baseexception():
+    findings = lint_file(os.path.join(FIXTURES, "pt005_except.py"),
+                         rel="parquet_tpu/io/fixture.py")
+    assert sum(1 for f in findings if f.rule == "PT005") == 2
+
+
+def test_pt006_flags_attribute_and_from_import_forms():
+    findings = lint_file(os.path.join(FIXTURES, "pt006_lock.py"),
+                         rel="parquet_tpu/io/fixture.py")
+    assert sum(1 for f in findings if f.rule == "PT006") == 2
+
+
+# ---------------------------------------------------------------------------
+# rule semantics on synthetic sources
+# ---------------------------------------------------------------------------
+def test_pt001_declared_family_passes():
+    src = 'from parquet_tpu.obs.metrics import counter\n' \
+          'C = counter("cache.chunk_hits")\n'
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+def test_pt001_ignores_non_literal_names():
+    src = 'from parquet_tpu.obs.metrics import histogram\n' \
+          'def h(name):\n    return histogram("span." + name)\n'
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+def test_pt002_accessor_with_undeclared_knob_flags():
+    src = 'from parquet_tpu.utils.env import env_int\n' \
+          'V = env_int("PARQUET_TPU_NOT_A_KNOB")\n'
+    fs = lint_source(src, "parquet_tpu/io/x.py")
+    assert _rules(fs) == {"PT002"}
+
+
+def test_pt002_accessor_type_mismatch_flags():
+    # PARQUET_TPU_CHUNK_CACHE is declared "bytes": env_int is the wrong
+    # parser (no non-negative clamp)
+    src = 'from parquet_tpu.utils.env import env_int\n' \
+          'V = env_int("PARQUET_TPU_CHUNK_CACHE")\n'
+    fs = lint_source(src, "parquet_tpu/io/x.py")
+    assert _rules(fs) == {"PT002"}
+
+
+def test_pt002_environ_write_and_pop_are_legal():
+    src = ('import os\n'
+           'os.environ["PARQUET_TPU_CHUNK_CACHE"] = "1"\n'
+           'os.environ.pop("PARQUET_TPU_CHUNK_CACHE", None)\n'
+           'del os.environ["PARQUET_TPU_MMAP"]\n')
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+def test_pt003_owner_module_passes_foreign_flags():
+    src = 'from parquet_tpu.obs.ledger import ledger_account\n' \
+          'A = ledger_account("cache.chunk")\n'
+    assert lint_source(src, "parquet_tpu/io/cache.py") == []
+    assert _rules(lint_source(src, "parquet_tpu/io/lookup.py")) \
+        == {"PT003"}
+
+
+def test_pt003_unknown_account_flags_everywhere():
+    src = 'from parquet_tpu.obs.ledger import ledger_account\n' \
+          'A = ledger_account("mystery.tier")\n'
+    assert _rules(lint_source(src, "parquet_tpu/io/cache.py")) \
+        == {"PT003"}
+
+
+def test_pt004_monotonic_clocks_pass():
+    src = ('import time\n'
+           'A = time.monotonic()\nB = time.perf_counter()\n')
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+def test_pt005_reraise_passes():
+    src = ('def f(g):\n'
+           '    try:\n        return g()\n'
+           '    except BaseException:\n'
+           '        cleanup = 1\n        raise\n')
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+def test_pt006_factory_construction_passes():
+    src = ('from parquet_tpu.utils.locks import make_lock\n'
+           'L = make_lock("x.y")\n')
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+def test_suppression_with_justification_silences():
+    src = ('import time\n'
+           '# ptlint: disable=PT004 -- wall-clock record stamp\n'
+           'TS = time.time()\n')
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+def test_trailing_suppression_silences():
+    src = ('import time\n'
+           'TS = time.time()  # ptlint: disable=PT004 -- record stamp\n')
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+def test_suppression_without_justification_is_pt000():
+    src = ('import time\n'
+           '# ptlint: disable=PT004\n'
+           'TS = time.time()\n')
+    rules = _rules(lint_source(src, "parquet_tpu/io/x.py"))
+    # the malformed suppression does NOT silence, and is itself flagged
+    assert rules == {"PT000", "PT004"}
+
+
+def test_suppression_for_other_rule_does_not_silence():
+    src = ('import time\n'
+           '# ptlint: disable=PT005 -- wrong rule\n'
+           'TS = time.time()\n')
+    assert _rules(lint_source(src, "parquet_tpu/io/x.py")) == {"PT004"}
+
+
+def test_suppression_comment_block_skips_to_code_line():
+    src = ('import time\n'
+           '# ptlint: disable=PT004 -- record stamp, with a\n'
+           '# continuation comment line between it and the code\n'
+           'TS = time.time()\n')
+    assert lint_source(src, "parquet_tpu/io/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# the real tree: zero findings (the analyze gate's lint half)
+# ---------------------------------------------------------------------------
+def test_real_tree_has_no_findings():
+    findings = run_lint()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_declared_families_include_core_and_declare_core():
+    declared = declared_metric_families()
+    # spot-check all three declaration idioms: _CORE_COUNTERS tuple,
+    # explicit _declare_core calls, ledger gauge families
+    for name in ("cache.chunk_hits", "pool.queue_wait_s",
+                 "ledger.resident_bytes", "route.gbps",
+                 "lookup.admission_wait_s"):
+        assert name in declared, name
+
+
+# ---------------------------------------------------------------------------
+# knob registry + env accessor semantics
+# ---------------------------------------------------------------------------
+def test_every_knob_type_has_an_accessor():
+    for k in knobs():
+        assert any(k.type in types
+                   for types in envmod.ACCESSOR_TYPES.values()), k.name
+
+
+def test_undeclared_parquet_knob_raises():
+    with pytest.raises(KeyError):
+        env_str("PARQUET_TPU_DOES_NOT_EXIST")
+
+
+def test_wrong_accessor_for_declared_type_raises():
+    with pytest.raises(TypeError):
+        env_int("PARQUET_TPU_CHUNK_CACHE")  # declared "bytes"
+
+
+def test_non_parquet_names_stay_legal_for_test_fixtures(monkeypatch):
+    # AdmissionController unit tests pin scratch env vars; those must
+    # not require declaration
+    monkeypatch.setenv("SCRATCH_TEST_BUDGET", "123")
+    assert env_opt_bytes("SCRATCH_TEST_BUDGET") == 123
+    monkeypatch.delenv("SCRATCH_TEST_BUDGET")
+    assert env_opt_bytes("SCRATCH_TEST_BUDGET") is None
+
+
+def test_bool_parse_semantics(monkeypatch):
+    assert env_bool("PARQUET_TPU_MMAP") is True            # default on
+    assert env_bool("PARQUET_TPU_LOCKCHECK") is False      # default off
+    for off in ("0", "off", "false", "NO"):
+        monkeypatch.setenv("PARQUET_TPU_MMAP", off)
+        assert env_bool("PARQUET_TPU_MMAP") is False
+    monkeypatch.setenv("PARQUET_TPU_MMAP", "1")
+    assert env_bool("PARQUET_TPU_MMAP") is True
+
+
+def test_bytes_and_opt_parse_semantics(monkeypatch):
+    assert env_bytes("PARQUET_TPU_CHUNK_CACHE") == 256 << 20
+    monkeypatch.setenv("PARQUET_TPU_CHUNK_CACHE", "-5")
+    assert env_bytes("PARQUET_TPU_CHUNK_CACHE") == 0       # clamped
+    monkeypatch.setenv("PARQUET_TPU_CHUNK_CACHE", "garbage")
+    assert env_bytes("PARQUET_TPU_CHUNK_CACHE") == 256 << 20
+    assert env_opt_bytes("PARQUET_TPU_READ_BUDGET") is None
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", "1024")
+    assert env_opt_bytes("PARQUET_TPU_READ_BUDGET") == 1024
+    assert env_opt_float("PARQUET_TPU_SLOW_OP_S") is None
+
+
+def test_int_and_str_parse_semantics(monkeypatch):
+    assert env_int("PARQUET_TPU_REMOTE_BREAKER") == 5
+    monkeypatch.setenv("PARQUET_TPU_REMOTE_BREAKER", "9")
+    assert env_int("PARQUET_TPU_REMOTE_BREAKER") == 9
+    assert env_str("PARQUET_TPU_REMOTE_HEDGE") == "auto"
+    monkeypatch.setenv("PARQUET_TPU_REMOTE_HEDGE", " 0.25 ")
+    assert env_str("PARQUET_TPU_REMOTE_HEDGE") == "0.25"   # stripped
+
+
+def test_knob_lookup_and_docs():
+    k = knob("PARQUET_TPU_READ_BUDGET")
+    assert k is not None and k.type == "opt_bytes" and k.doc
+    assert knob("PARQUET_TPU_NOPE") is None
+    for each in knobs():
+        assert each.doc, each.name
+
+
+# ---------------------------------------------------------------------------
+# generated README knob table (the committed table must match the
+# registry — docs cannot drift from code)
+# ---------------------------------------------------------------------------
+def test_readme_knob_table_matches_registry():
+    readme = os.path.join(REPO, "README.md")
+    text = open(readme).read()
+    begin, end = "<!-- knobs:begin -->", "<!-- knobs:end -->"
+    assert begin in text and end in text
+    committed = text.split(begin, 1)[1].split(end, 1)[0].strip()
+    assert committed == knobs_markdown().strip(), \
+        "README knob table is stale: regenerate with " \
+        "`python -m parquet_tpu analyze --knobs-md`"
+
+
+def test_knobs_markdown_sorted_and_complete():
+    md = knobs_markdown()
+    names = [line.split("`")[1] for line in md.splitlines()[2:]]
+    assert names == sorted(names)
+    assert len(names) == len(knobs())
+    assert "PARQUET_TPU_LOCKCHECK" in names
+
+
+# ---------------------------------------------------------------------------
+# the analyze CLI (lint + knob sync; hammer covered in test_lockcheck)
+# ---------------------------------------------------------------------------
+def test_analyze_cli_no_hammer_json():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "parquet_tpu", "analyze", "--no-hammer",
+         "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["ok"] is True
+    assert rep["lint"] == []
+    assert rep["knobs_md"]["stale"] is False
+    assert rep["lockcheck"] == {"skipped": True}
+
+
+def test_finding_render_shape():
+    f = Finding("PT004", "parquet_tpu/x.py", 3, "msg")
+    assert f.render() == "parquet_tpu/x.py:3: PT004: msg"
+    assert f.as_dict()["rule"] == "PT004"

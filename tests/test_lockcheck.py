@@ -1,0 +1,389 @@
+"""Runtime concurrency sanitizer (utils/locks.py +
+analysis/lockcheck.py): synthetic ABBA detection with both stacks,
+blocking-under-lock, self-deadlock, pass-through overhead, and the
+lockcheck-enabled rerun of the shipped concurrency hammers proving the
+real lock graph is cycle-free."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from parquet_tpu.analysis.lockcheck import (find_cycles, format_stack,
+                                            lockcheck_report)
+from parquet_tpu.utils import locks as L
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture
+def lockcheck():
+    """Enable the sanitizer for locks created inside the test, with full
+    state isolation (other tests must keep their plain stdlib locks)."""
+    L.enable_lockcheck()
+    L.reset_lockcheck()
+    try:
+        yield L
+    finally:
+        L.disable_lockcheck()
+        L.reset_lockcheck()
+
+
+def _abba(lockcheck):
+    a = lockcheck.make_lock("fix.A")
+    b = lockcheck.make_lock("fix.B")
+
+    with a:
+        with b:
+            pass
+
+    def reversed_order():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=reversed_order)
+    t.start()
+    t.join()
+
+
+# ---------------------------------------------------------------------------
+# cycle (potential deadlock) detection
+# ---------------------------------------------------------------------------
+def test_abba_cycle_detected_with_both_stacks(lockcheck):
+    _abba(lockcheck)
+    rep = lockcheck_report()
+    assert not rep["ok"]
+    assert ["fix.A", "fix.B"] in rep["cycles"] \
+        or ["fix.B", "fix.A"] in rep["cycles"]
+    cyc = [f for f in rep["findings"]
+           if f["kind"] == "lock_order_cycle"]
+    assert len(cyc) == 1
+    # the finding's node list is the cycle EXACTLY once (no duplicated
+    # closing node) and agrees with the graph-recomputed cycle set
+    assert sorted(cyc[0]["cycle"]) == ["fix.A", "fix.B"]
+    edges = cyc[0]["edges"]
+    assert len(edges) == 2  # A->B and B->A, each with BOTH stacks
+    for e in edges:
+        assert e["from_stack"] and e["to_stack"]
+        # stacks point at THIS test module, not sanitizer internals
+        assert any("test_lockcheck.py" in line
+                   for line in e["from_stack"]), e["from_stack"]
+        assert any("test_lockcheck.py" in line
+                   for line in e["to_stack"])
+
+
+def test_cycle_never_needs_an_actual_deadlock(lockcheck):
+    # the two orders run SEQUENTIALLY (no real contention, no hang) and
+    # the cycle is still reported — lockdep semantics
+    _abba(lockcheck)
+    assert lockcheck_report()["cycles"]
+
+
+def test_consistent_order_is_clean(lockcheck):
+    a = lockcheck.make_lock("ord.A")
+    b = lockcheck.make_lock("ord.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = lockcheck_report()
+    assert rep["ok"] and rep["cycles"] == []
+    assert any(e["from"] == "ord.A" and e["to"] == "ord.B"
+               for e in rep["edges"])
+
+
+def test_three_lock_cycle_detected(lockcheck):
+    a = lockcheck.make_lock("tri.A")
+    b = lockcheck.make_lock("tri.B")
+    c = lockcheck.make_lock("tri.C")
+    for first, second in ((a, b), (b, c), (c, a)):
+        with first:
+            with second:
+                pass
+    rep = lockcheck_report()
+    assert not rep["ok"]
+    assert sorted(rep["cycles"][0]) == ["tri.A", "tri.B", "tri.C"]
+
+
+def test_find_cycles_unit():
+    edges = [{"from": "x", "to": "y"}, {"from": "y", "to": "z"},
+             {"from": "z", "to": "x"}, {"from": "x", "to": "w"}]
+    assert find_cycles(edges) == [["x", "y", "z"]]
+    assert find_cycles(edges[:2]) == []
+
+
+def test_same_name_edges_skipped(lockcheck):
+    # two instances of one lock class (per-instance locks): no self-edge
+    a1 = lockcheck.make_lock("inst.same")
+    a2 = lockcheck.make_lock("inst.same")
+    with a1:
+        with a2:
+            pass
+    rep = lockcheck_report()
+    assert rep["ok"] and rep["edges"] == []
+
+
+# ---------------------------------------------------------------------------
+# self-deadlock / reentrancy
+# ---------------------------------------------------------------------------
+def test_self_deadlock_raises_instead_of_hanging(lockcheck):
+    lk = lockcheck.make_lock("self.dead")
+    lk.acquire()
+    try:
+        with pytest.raises(RuntimeError, match="self-deadlock"):
+            lk.acquire()
+    finally:
+        lk.release()
+    rep = lockcheck_report()
+    assert any(f["kind"] == "self_deadlock" for f in rep["findings"])
+
+
+def test_try_lock_on_held_lock_returns_false_like_stdlib(lockcheck):
+    # threading.Lock contract: a non-blocking re-acquire by the holder
+    # returns False — a try-lock is not a self-deadlock
+    lk = lockcheck.make_lock("self.try")
+    lk.acquire()
+    try:
+        assert lk.acquire(blocking=False) is False
+        # a TIMED blocking re-acquire is certain failure: stdlib-shaped
+        # return (False at timeout) but the finding is recorded
+        assert lk.acquire(True, 0.01) is False
+    finally:
+        lk.release()
+    rep = lockcheck_report()
+    kinds = [f["kind"] for f in rep["findings"]]
+    assert kinds == ["self_deadlock"]  # timed case only, not try-lock
+
+
+def test_rlock_reentry_is_legal(lockcheck):
+    rl = lockcheck.make_rlock("re.lock")
+    with rl:
+        with rl:
+            pass
+    rep = lockcheck_report()
+    assert rep["ok"] and rep["edges"] == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+def test_blocking_under_tier_lock_flagged(lockcheck):
+    lk = lockcheck.make_lock("tier.cache")
+    with lk:
+        lockcheck.note_blocking("source.pread", detail="f.parquet")
+    rep = lockcheck_report()
+    blk = [f for f in rep["findings"]
+           if f["kind"] == "blocking_under_lock"]
+    assert len(blk) == 1
+    assert blk[0]["blocking"] == "source.pread"
+    assert blk[0]["held"] == ["tier.cache"]
+    assert any("test_lockcheck.py" in line for line in blk[0]["stack"])
+
+
+def test_blocking_with_nothing_held_is_clean(lockcheck):
+    lockcheck.note_blocking("pool.submit")
+    assert lockcheck_report()["ok"]
+
+
+def test_non_tier_lock_exempt_from_blocking_rule(lockcheck):
+    fd = lockcheck.make_lock("src.fd", tier=False)
+    with fd:
+        lockcheck.note_blocking("source.pread")
+    rep = lockcheck_report()
+    assert rep["ok"], rep["findings"]
+
+
+def test_condition_wait_exempts_its_own_lock_only(lockcheck):
+    cv = lockcheck.make_condition("cv.own")
+    with cv:
+        cv.wait(timeout=0.01)   # holding only the cv's lock: clean
+    assert lockcheck_report()["ok"]
+
+    outer = lockcheck.make_lock("cv.outer")
+    with outer:
+        with cv:
+            cv.wait(timeout=0.01)   # waiting while holding outer: flag
+    rep = lockcheck_report()
+    blk = [f for f in rep["findings"]
+           if f["kind"] == "blocking_under_lock"]
+    assert blk and blk[0]["held"] == ["cv.outer"]
+
+
+def test_condition_notify_and_wait_keep_held_set_exact(lockcheck):
+    cv = lockcheck.make_condition("cv.pair")
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=2)
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join()
+    assert hits == ["woke"]
+    # wait released and re-acquired through the checked lock: the held
+    # stacks drained on both threads (nothing left over to flag)
+    lk = lockcheck.make_lock("cv.after")
+    with lk:
+        pass
+    assert lockcheck_report()["ok"]
+
+
+def test_note_blocking_free_when_disabled():
+    assert not L.LOCKCHECK_ENABLED
+    L.reset_lockcheck()
+    L.note_blocking("source.pread")
+    assert L.lockcheck_state().snapshot()["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# pass-through: zero instrumentation when off
+# ---------------------------------------------------------------------------
+def test_factories_return_plain_stdlib_primitives_when_off():
+    assert not L.LOCKCHECK_ENABLED
+    assert type(L.make_lock("x")) is type(threading.Lock())
+    assert type(L.make_rlock("x")) is type(threading.RLock())
+    assert isinstance(L.make_condition("x"), threading.Condition)
+    assert not isinstance(L.make_condition("x"), L.CheckedCondition)
+
+
+def test_passthrough_overhead_within_5_percent():
+    """make_lock(off) IS a threading.Lock — acquire/release timing must
+    be statistically identical (min-of-runs beats noise)."""
+    plain = threading.Lock()
+    made = L.make_lock("bench.lock")
+
+    def loop(lk, n=20_000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with lk:
+                pass
+        return time.perf_counter() - t0
+
+    loop(plain), loop(made)  # warm
+    t_plain = min(loop(plain) for _ in range(7))
+    t_made = min(loop(made) for _ in range(7))
+    assert t_made <= t_plain * 1.05, (t_made, t_plain)
+
+
+# ---------------------------------------------------------------------------
+# report formatting
+# ---------------------------------------------------------------------------
+def test_format_stack_renders_file_line_func():
+    frames = ((__file__, 10, "some_func"),)
+    out = format_stack(frames)
+    assert len(out) == 1 and ":10 in some_func" in out[0]
+
+
+def test_report_json_serializable(lockcheck):
+    _abba(lockcheck)
+    rep = lockcheck_report()
+    json.dumps(rep)  # stacks formatted to strings, no raw frames
+
+
+# ---------------------------------------------------------------------------
+# seeded ABBA in a subprocess: the report path exits 1 with both stacks
+# ---------------------------------------------------------------------------
+_ABBA_SCRIPT = r"""
+import json, sys, threading
+from parquet_tpu.utils import locks as L
+from parquet_tpu.analysis.lockcheck import lockcheck_report
+
+a = L.make_lock("seed.A"); b = L.make_lock("seed.B")
+with a:
+    with b: pass
+def rev():
+    with b:
+        with a: pass
+t = threading.Thread(target=rev); t.start(); t.join()
+rep = lockcheck_report()
+json.dump(rep, sys.stdout)
+sys.exit(0 if rep["ok"] else 1)
+"""
+
+
+def test_seeded_abba_subprocess_exits_1_with_stacks(tmp_path):
+    env = dict(os.environ)
+    env["PARQUET_TPU_LOCKCHECK"] = "1"
+    env["PARQUET_TPU_LOCKCHECK_REPORT"] = str(tmp_path / "rep.json")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run([sys.executable, "-c", _ABBA_SCRIPT],
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=300)
+    assert proc.returncode == 1, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["cycles"]
+    edges = [f for f in rep["findings"]
+             if f["kind"] == "lock_order_cycle"][0]["edges"]
+    assert len(edges) == 2
+    assert all(e["from_stack"] and e["to_stack"] for e in edges)
+    # the atexit report (PARQUET_TPU_LOCKCHECK_REPORT) landed too
+    disk = json.loads((tmp_path / "rep.json").read_text())
+    assert disk["cycles"] == rep["cycles"]
+
+
+# ---------------------------------------------------------------------------
+# the shipped lock graph: lockcheck-enabled reruns of the existing
+# concurrency hammers must be cycle-free with zero blocking findings
+# ---------------------------------------------------------------------------
+def _run_with_lockcheck(args, report_path, timeout=540):
+    env = dict(os.environ)
+    env["PARQUET_TPU_LOCKCHECK"] = "1"
+    env["PARQUET_TPU_LOCKCHECK_REPORT"] = str(report_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(args, capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=timeout)
+
+
+def test_lockcheck_hammer_cli_clean(tmp_path):
+    """`python -m parquet_tpu.analysis.lockcheck` under the sanitizer:
+    the analyze gate's hammer — mixed budgeted reads/scans/lookups +
+    table ingest/compact — observes a cycle-free graph, no blocking
+    findings, and real coverage (edges across the converted tiers)."""
+    proc = _run_with_lockcheck(
+        [sys.executable, "-m", "parquet_tpu.analysis.lockcheck"],
+        tmp_path / "rep.json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rep["ok"] and rep["cycles"] == [] and rep["findings"] == []
+    assert rep["acquisitions"] > 1000
+    locks = set(rep["locks"])
+    # the conversion actually took: tier locks from every layer appear
+    for expected in ("prefetch.ring", "pool.admission", "cache.chunk",
+                     "ledger.account", "metrics.counter"):
+        assert expected in locks, (expected, sorted(locks))
+
+
+@pytest.mark.slow
+def test_existing_hammers_rerun_under_lockcheck(tmp_path):
+    """The ISSUE's acceptance rerun: ledger 8-worker mixed-op, lookup
+    admission hammer, and table ingest∥scan∥compact — with every lock
+    instrumented — report a cycle-free order graph and zero
+    blocking-under-lock findings."""
+    report = tmp_path / "rep.json"
+    proc = _run_with_lockcheck(
+        [sys.executable, "-m", "pytest",
+         "tests/test_ledger.py::test_hammer_8_workers_exact_accounting",
+         "tests/test_lookup.py::test_admission_budget_held_under_hammer",
+         "tests/test_table.py::"
+         "test_concurrent_ingest_scan_lookup_compact_hammer",
+         "-q", "-p", "no:cacheprovider"],
+        report)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["cycles"] == [], rep["cycles"]
+    blocking = [f for f in rep["findings"]
+                if f["kind"] == "blocking_under_lock"]
+    assert blocking == [], blocking
+    assert rep["findings"] == []
+    assert rep["acquisitions"] > 10_000  # the hammers really ran checked
