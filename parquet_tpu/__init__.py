@@ -27,6 +27,16 @@ Point lookups
   single-page reads; page-granular cache tier, FIFO bytes-budget
   admission control via ``PARQUET_TPU_LOOKUP_BUDGET``, ``lookup.*``
   p50/p99 meters), KeyHits/LookupResult
+Aggregation pushdown
+  count/count(col)/min_/max_/sum_/count_distinct/top_k (AggExpr nodes,
+  algebra/aggregate.py) + ParquetFile.aggregate / Dataset.aggregate
+  (io/aggregate.py): a cheapest-first ANSWER cascade — footer stats →
+  page-index zone maps → dictionary pages → exact decode — resolving
+  each (row group × aggregate) at the cheapest tier that proves the
+  result exactly; group-by over dict keys without materializing rows,
+  top-k decoding only pages contending with the running k-th bound,
+  manifest zone maps answering whole part-files with zero footer IO;
+  per-tier ``agg.rg_answered_*`` counters + ``AggregateResult.explain()``
 Scan planning
   col/And/Or/Not (predicate trees over range/IN/equality/null leaves),
   scan_expr (multi-column filtered reads with late materialization),
@@ -140,6 +150,9 @@ from .io.manifest import Manifest, ManifestEntry, read_manifest
 from .io.planner import (CostInputs, RouteDecision, ScanPlan, ScanPlanner,
                          choose_route, route_history)
 from .algebra.expr import And, Col, Expr, Not, Or, col
+from .algebra.aggregate import (AggExpr, count, count_distinct, max_, min_,
+                                sum_, top_k)
+from .io.aggregate import AggregateResult
 from .parallel.host_scan import (scan, scan_expr, scan_filtered,
                                  scan_filtered_device, scan_filtered_sharded)
 from .parallel.mesh import ShardedTable, default_mesh, read_table_sharded

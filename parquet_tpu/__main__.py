@@ -28,7 +28,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet_tpu")
     p.add_argument("command",
                    choices=["meta", "schema", "pages", "head", "verify",
-                            "stats", "analyze"],
+                            "stats", "analyze", "aggregate"],
                    help="meta: file summary; schema: schema tree; pages: "
                         "page-level dump; head: first rows as JSON lines; "
                         "verify: end-to-end integrity check (exit 0 = every "
@@ -37,7 +37,9 @@ def main(argv=None) -> int:
                         "files first so the counters meter that work); "
                         "analyze: invariant lint + lockcheck hammer over "
                         "the package (exit 0 = clean, 1 = findings) — the "
-                        "pre-merge correctness gate")
+                        "pre-merge correctness gate; aggregate: answer "
+                        "COUNT/MIN/MAX/SUM/DISTINCT/top-k from metadata "
+                        "without decoding where provable (io/aggregate.py)")
     p.add_argument("file", nargs="*",
                    help="parquet file path(s); verify accepts several and "
                         "shell-style globs, checked in parallel; stats "
@@ -67,6 +69,18 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1", metavar="ADDR",
                    help="stats --serve: bind address (default loopback; "
                         "0.0.0.0 to let a fleet Prometheus scrape it)")
+    p.add_argument("--agg", action="append", default=[], metavar="SPEC",
+                   help="aggregate: one aggregate per flag — count, "
+                        "count:COL, min:COL, max:COL, sum:COL, "
+                        "distinct:COL, top:COL:K (repeatable)")
+    p.add_argument("--where", default=None, metavar="COL:LO:HI",
+                   help="aggregate: inclusive range predicate (empty "
+                        "LO/HI = open bound; values parse as int, float, "
+                        "then string)")
+    p.add_argument("--group-by", default=None, metavar="COL",
+                   help="aggregate: group results by this flat column")
+    p.add_argument("--explain", action="store_true",
+                   help="aggregate: print the per-row-group tier trace")
     p.add_argument("--knobs-md", action="store_true",
                    help="analyze: print the generated README "
                         "'Environment knobs' table and exit")
@@ -80,6 +94,9 @@ def main(argv=None) -> int:
 
     if args.command == "analyze":
         return _analyze(args)
+
+    if args.command == "aggregate":
+        return _aggregate_cmd(args)
 
     if args.command == "stats":
         import json
@@ -216,6 +233,84 @@ def main(argv=None) -> int:
         print(f"parquet_tpu: {e}", file=sys.stderr)
         return 1
     return 0
+
+
+def _parse_value(tok: str):
+    """CLI predicate bound: int, then float, then the raw string (the
+    predicate normalizer maps str → utf-8 bytes); empty = open bound."""
+    if tok == "":
+        return None
+    for cast in (int, float):
+        try:
+            return cast(tok)
+        except ValueError:
+            continue
+    return tok
+
+
+def _aggregate_cmd(args) -> int:
+    """``python -m parquet_tpu aggregate FILE... --agg SPEC [--where
+    COL:LO:HI] [--group-by COL] [--explain] [--json]``."""
+    import json
+
+    from .algebra.aggregate import (count, count_distinct, max_, min_,
+                                    sum_, top_k)
+    from .algebra.expr import col
+    from .dataset import Dataset
+    from .errors import CorruptedError
+
+    if not args.file:
+        print("parquet_tpu: aggregate requires a file", file=sys.stderr)
+        return 1
+    try:
+        usage = ("count, count:COL, min:COL, max:COL, sum:COL, "
+                 "distinct:COL, top:COL:K")
+        aggs = []
+        for spec in (args.agg or ["count"]):
+            parts = spec.split(":")
+            kind = parts[0]
+            if kind == "count":
+                aggs.append(count(parts[1] if len(parts) > 1 else None))
+            elif kind in ("min", "max", "sum", "distinct"):
+                if len(parts) < 2 or not parts[1]:
+                    raise ValueError(f"--agg {spec!r} needs a column "
+                                     f"({usage})")
+                fn = {"min": min_, "max": max_, "sum": sum_,
+                      "distinct": count_distinct}[kind]
+                aggs.append(fn(parts[1]))
+            elif kind == "top":
+                if len(parts) < 3 or not parts[1]:
+                    raise ValueError(f"--agg {spec!r} needs top:COL:K "
+                                     f"({usage})")
+                aggs.append(top_k(parts[1], int(parts[2])))
+            else:
+                raise ValueError(f"unknown --agg spec {spec!r} ({usage})")
+        where = None
+        if args.where is not None:
+            path, lo, hi = (args.where.split(":", 2) + ["", ""])[:3]
+            where = col(path).between(_parse_value(lo), _parse_value(hi))
+        ds = Dataset(args.file)
+        res = ds.aggregate(aggs, where=where, group_by=args.group_by)
+        doc = {"aggregates": {k: _jsonable(v) for k, v in res.items()},
+               "tiers": {k: v for k, v in res.counters.items() if v}}
+        if res.groups is not None:
+            doc["groups"] = [_jsonable(k) for k in res.groups]
+        print(json.dumps(doc, sort_keys=True))
+        if args.explain:
+            print(res.explain(), file=sys.stderr)
+    except (OSError, ValueError, KeyError, CorruptedError) as e:
+        print(f"parquet_tpu: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _jsonable(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if isinstance(v, list):
+        return [_jsonable(x) for x in v]
+    item = getattr(v, "item", None)
+    return item() if item is not None else v
 
 
 def _knobs_readme_stale():

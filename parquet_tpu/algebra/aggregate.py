@@ -1,0 +1,107 @@
+"""Aggregate expression nodes: what ``Dataset.aggregate`` evaluates.
+
+The predicate algebra (algebra/expr.py) describes which rows a query
+wants; this module describes what it wants to KNOW about them.  Each
+node is pure data — one aggregate function over zero or one column —
+and the answer cascade (io/aggregate.py) resolves each (row group ×
+node) pair at the cheapest tier that can prove the result exactly:
+footer statistics, page-index zone maps, dictionary pages, or a decoded
+fallback.
+
+Semantics (the order-domain conventions the whole engine compares in —
+algebra/compare.py — so aggregation and pruning can never disagree):
+
+- ``count()`` counts matching rows; ``count(col)`` counts matching rows
+  whose ``col`` is non-null (SQL COUNT semantics).
+- ``min_``/``max_``/``top_k`` rank in the column's ORDER domain
+  (strings as utf-8 bytes, decimals as unscaled ints, unsigned logical
+  ints as non-negative ints) and skip NULLs; float NaN ranks with the
+  statistics convention — writers drop NaN from zone maps — so NaN is
+  skipped too, keeping every tier's answer identical.
+- ``sum_`` adds the order-domain numeric values (integers exactly, in
+  python ints — no 64-bit overflow; floats in numpy float64); NULLs
+  are skipped.  Non-decimal BYTE_ARRAY columns cannot sum.
+- ``count_distinct`` counts distinct non-null (non-NaN) order-domain
+  values.  It is exact — per-part value SETS merge across row groups
+  and files — so memory is O(distinct values).
+- ``top_k`` returns the k largest (``largest=False``: smallest) values,
+  sorted best-first, decoding only pages still contending with the
+  running k-th bound.
+
+Build with the module-level constructors (``count``, ``min_``, ``max_``,
+``sum_``, ``count_distinct``, ``top_k``); the trailing underscores dodge
+the python builtins without renaming the concepts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["AggExpr", "count", "min_", "max_", "sum_", "count_distinct",
+           "top_k"]
+
+_KINDS = ("count", "min", "max", "sum", "count_distinct", "top_k")
+
+
+class AggExpr:
+    """One aggregate function over zero (``count()``) or one column.
+    Pure data; ``name`` is the stable result key (``"sum(v)"``)."""
+
+    __slots__ = ("kind", "path", "k", "largest")
+
+    def __init__(self, kind: str, path: Optional[str] = None,
+                 k: Optional[int] = None, largest: bool = True):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown aggregate kind {kind!r}")
+        if kind != "count" and path is None:
+            raise ValueError(f"{kind} needs a column")
+        if kind == "top_k":
+            if k is None or k < 1:
+                raise ValueError("top_k needs k >= 1")
+        self.kind = kind
+        self.path = path
+        self.k = k
+        self.largest = largest
+
+    @property
+    def name(self) -> str:
+        """Stable result key: ``count(*)``, ``min(x)``, ``top_k(x,5)``…"""
+        if self.kind == "count":
+            return f"count({self.path})" if self.path else "count(*)"
+        if self.kind == "top_k":
+            tail = "" if self.largest else ",smallest"
+            return f"top_k({self.path},{self.k}{tail})"
+        return f"{self.kind}({self.path})"
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+def count(path: Optional[str] = None) -> AggExpr:
+    """``count()`` = matching rows; ``count(col)`` = matching non-null."""
+    return AggExpr("count", path)
+
+
+def min_(path: str) -> AggExpr:
+    """Smallest non-null value of ``path`` over the matching rows."""
+    return AggExpr("min", path)
+
+
+def max_(path: str) -> AggExpr:
+    """Largest non-null value of ``path`` over the matching rows."""
+    return AggExpr("max", path)
+
+
+def sum_(path: str) -> AggExpr:
+    """Sum of ``path`` over the matching rows (ints exact, floats f64)."""
+    return AggExpr("sum", path)
+
+
+def count_distinct(path: str) -> AggExpr:
+    """Exact distinct non-null value count of ``path``."""
+    return AggExpr("count_distinct", path)
+
+
+def top_k(path: str, k: int, largest: bool = True) -> AggExpr:
+    """The ``k`` largest (or smallest) values of ``path``, best-first."""
+    return AggExpr("top_k", path, k=k, largest=largest)

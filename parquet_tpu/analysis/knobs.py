@@ -102,7 +102,25 @@ declare("PARQUET_TPU_LOOKUP_KEY_SHARD", "int", 1024,
         "minimum unique keys per shard before a large lookup batch fans "
         "its key set across pool workers; 0 disables sharding")
 
+# -------------------------------------------------------------- aggregation
+declare("PARQUET_TPU_AGG_DICT", "bool", True,
+        "dictionary tier of the aggregation cascade: SUM/COUNT DISTINCT/"
+        "MIN/MAX/group-by over dict-encoded chunks aggregate the index "
+        "stream without expanding values; 0 falls back to exact decode")
+
+# -------------------------------------------------------------------- write
+declare("PARQUET_TPU_MMAP_SINK", "bool", False,
+        "opt-in mmap-backed atomic path sink experiment: writes copy "
+        "into a mapped temp file instead of buffered write() calls "
+        "(same fsync+rename commit; measured ~0.75x of the writev "
+        "path — kept opt-in for syscall-restricted regimes, see bench "
+        "cfg6 mmap_sink)")
+
 # ------------------------------------------------------------------- remote
+declare("PARQUET_TPU_REMOTE_PARALLEL", "int", 4,
+        "max concurrent range requests a multi-range read plan may "
+        "issue against one remote source (capped by the connection "
+        "pool); 0/1 disables parallel preads")
 declare("PARQUET_TPU_REMOTE_POOL", "int", 4,
         "persistent connections kept per remote host")
 declare("PARQUET_TPU_REMOTE_TIMEOUT", "float", 30.0,

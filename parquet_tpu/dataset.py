@@ -620,6 +620,28 @@ class Dataset:
         return dataset_find_rows(self, path, keys, columns=columns,
                                  policy=policy, report=report)
 
+    # ---------------------------------------------------------- aggregate
+    def aggregate(self, aggs, where=None, group_by=None,
+                  policy: Optional[FaultPolicy] = None,
+                  report: Optional[ReadReport] = None):
+        """Answer aggregate queries over the whole dataset WITHOUT
+        decoding wherever metadata can prove the result: manifest zone
+        maps answer or drop part-files with zero footer IO, footer
+        statistics and page-index zone maps answer per row group, the
+        dictionary tier aggregates dict-encoded columns over their index
+        stream, and only contended pages decode
+        (:mod:`parquet_tpu.io.aggregate`).  ``aggs`` is a list of
+        :mod:`parquet_tpu.algebra.aggregate` nodes; the predicate
+        prepares ONCE for the corpus and per-file resolution fans out on
+        the shared pool.  Degraded ``policy``: an unreadable file drops
+        as a unit (``report.files_skipped``); corrupt row groups inside
+        readable files drop their contribution atomically."""
+        from .io.aggregate import dataset_aggregate
+
+        return dataset_aggregate(self, aggs, where=where,
+                                 group_by=group_by, policy=policy,
+                                 report=report)
+
     # -------------------------------------------------------------- misc
     @staticmethod
     def cache_stats():

@@ -1,0 +1,1491 @@
+"""Aggregation pushdown: answer queries without decoding.
+
+The planner (io/planner.py) and lookup cascade (io/lookup.py) already
+*prove which pages can't match*; this module promotes the same footer /
+page-index / dictionary machinery from pruning to **answering**.  Each
+(row group × aggregate) pair resolves at the cheapest tier that can
+prove the result exactly:
+
+1. **Footer statistics** (zero IO, zero decode) — a row group the
+   prepared ``where`` tree can't intersect contributes nothing (the same
+   proof ``prune_file`` runs); one it provably COVERS (the new
+   ``_stats_covers`` dual) answers ``count(*)`` from ``num_rows``,
+   ``count(col)`` from value/null counts, and MIN/MAX straight from
+   stats on exact-stat types.
+2. **Page-index zone maps** — partially-covered groups split into
+   covered / contended row intervals per leaf and fold through the tree
+   (And intersects, Or unions).  Covered intervals count from page row
+   spans and bound MIN/MAX from page stats; ONLY contended pages
+   descend.
+3. **Dictionary pages** — SUM / COUNT DISTINCT / MIN / MAX / group-by
+   over dict-encoded columns aggregate over the index stream with the
+   dictionary decoded once; values are never expanded (group-by over
+   dict keys returns groups without materializing rows).
+4. **Exact decode fallback** — whatever survives decodes through the
+   same page-selected, row-aligned reads the filtered scan uses
+   (``read_row_range`` + the scan's ``expr_mask``), so every tier's
+   answer is value-identical to naive decode-then-aggregate.
+
+Resolution is metered per tier (``agg.rg_answered_stats/pages/dict/
+decoded`` + the ``agg.aggregate_s`` histogram), threaded through op
+scopes and the unified read budget, and composes with ``FaultPolicy``
+degraded reads: a corrupt row group under ``on_corrupt='skip_row_group'``
+drops its contribution atomically (accumulated into a per-group delta,
+merged only on success) with exact ``ReadReport`` accounting.
+``AggregateResult.explain()`` shows which tier answered what.
+
+Float SUM caveat: partial sums accumulate per resolution unit, so float
+addition order can differ from one whole-array ``np.sum`` by normal
+rounding; integer sums are exact python-int arithmetic at any scale.
+All other aggregates are bit-identical to the naive path.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..algebra.aggregate import AggExpr
+from ..algebra.expr import TRUE, Const, Expr, prepare
+from ..errors import CorruptedError, DeadlineError
+from ..format.enums import Type
+from ..obs import scope as _oscope
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import histogram as _histogram
+from ..utils.env import env_bool
+from ..utils.pool import read_admission
+from .planner import (_collect_preds, _eval_tree, _intersect_intervals,
+                      _merge_intervals, _pred_page_ords, _stats_alive,
+                      _stats_covers, _tree_covers)
+
+__all__ = ["AggregateResult", "aggregate_file", "dataset_aggregate"]
+
+# resolved once (hot-path rule: no registry get-or-create on increments)
+_M_AGG_S = _histogram("agg.aggregate_s")
+_M_DS_AGG_S = _histogram("dataset.aggregate_s")
+_M_RG_STATS = _counter("agg.rg_answered_stats")
+_M_RG_PAGES = _counter("agg.rg_answered_pages")
+_M_RG_DICT = _counter("agg.rg_answered_dict")
+_M_RG_DECODED = _counter("agg.rg_answered_decoded")
+_M_FILES_MANIFEST = _counter("agg.files_answered_manifest")
+
+_TIER_METRIC = {"stats": _M_RG_STATS, "pages": _M_RG_PAGES,
+                "dict": _M_RG_DICT, "decoded": _M_RG_DECODED}
+_TIER_RANK = {"stats": 0, "pages": 1, "dict": 2, "decoded": 3}
+
+_COUNTER_KEYS = ("rg_answered_stats", "rg_answered_pages",
+                 "rg_answered_dict", "rg_answered_decoded",
+                 "rg_skipped_corrupt", "files_answered_manifest",
+                 "files_skipped")
+
+# physical types whose footer/page statistics are stored EXACTLY (no
+# truncation, no NaN ambiguity that the skip-NaN convention doesn't
+# already absorb): only these may ANSWER MIN/MAX from stats; byte-array
+# bounds may be truncated (algebra/compare.py truncate_stat_*) and stay
+# usable for coverage proofs but never for answers
+_EXACT_STAT_TYPES = (Type.BOOLEAN, Type.INT32, Type.INT64, Type.FLOAT,
+                     Type.DOUBLE)
+
+_Intervals = List[Tuple[int, int]]
+
+# the ONE NaN group key: NaN != NaN, so per-row float('nan') objects
+# would each open their own group (and never merge across row groups,
+# files, or tiers).  Every group-key producer canonicalizes through
+# _canon_key, so all NaN rows share this singleton — dict identity
+# short-circuits the equality NaN refuses.
+_NAN_KEY = float("nan")
+
+
+def _canon_key(v):
+    if isinstance(v, float) and v != v:
+        return _NAN_KEY
+    return v
+
+
+def _subtract_intervals(a: _Intervals, b: _Intervals) -> _Intervals:
+    """``a - b`` over half-open merged interval lists."""
+    out: _Intervals = []
+    j = 0
+    for s, e in a:
+        cur = s
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < e:
+            bs, be = b[k]
+            if bs > cur:
+                out.append((cur, bs))
+            cur = max(cur, be)
+            if be >= e:
+                break
+            k += 1
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+def _iv_rows(iv: _Intervals) -> int:
+    return sum(e - s for s, e in iv)
+
+
+# ---------------------------------------------------------------------------
+# accumulators (the partial-aggregate states that merge across row
+# groups and files)
+# ---------------------------------------------------------------------------
+
+
+class _RevKey:
+    """Reversed-order heap key (``top_k(..., largest=False)`` keeps a
+    max-heap of the smallest k via inverted comparison)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other) -> bool:
+        return other.v < self.v
+
+
+class _Acc:
+    """One aggregate's partial state.  ``add_*`` fold contributions in;
+    ``merge`` combines two partials; ``result`` finalizes."""
+
+    def __init__(self, agg: AggExpr, leaf):
+        self.agg = agg
+        self.leaf = leaf
+        self.n = 0  # count kinds
+        self.cur = None  # min/max
+        self.total = None  # sum (python int, or float)
+        self.distinct = set() if agg.kind == "count_distinct" else None
+        self.heap: List = [] if agg.kind == "top_k" else None
+
+    # ------------------------------------------------------------- folds
+    def add_count(self, k: int) -> None:
+        self.n += int(k)
+
+    def add_bound(self, v) -> None:
+        """Fold one already-proven min/max bound (stats / page tiers)."""
+        if v is None:
+            return
+        if self.cur is None:
+            self.cur = v
+        elif self.agg.kind == "min":
+            self.cur = min(self.cur, v)
+        else:
+            self.cur = max(self.cur, v)
+
+    def add_sum(self, v) -> None:
+        if v is None:
+            return
+        self.total = v if self.total is None else self.total + v
+
+    def topk_bound(self):
+        """The running k-th value, or None while the heap is not full —
+        a page whose max (min, for smallest) cannot beat this bound is
+        skipped without decoding."""
+        if self.heap is None or len(self.heap) < self.agg.k:
+            return None
+        h = self.heap[0]
+        return h.v if isinstance(h, _RevKey) else h
+
+    def topk_contends(self, page_bound) -> bool:
+        b = self.topk_bound()
+        if b is None or page_bound is None:
+            return True
+        try:
+            return page_bound > b if self.agg.largest else page_bound < b
+        except TypeError:
+            return True
+
+    def _offer(self, v) -> None:
+        item = v if self.agg.largest else _RevKey(v)
+        if len(self.heap) < self.agg.k:
+            heapq.heappush(self.heap, item)
+        else:
+            heapq.heappushpop(self.heap, item)
+
+    def add_values(self, vals) -> None:
+        """Fold decoded order-domain values (numpy array of present
+        values, or a python list that may still hold ``None`` slots).
+        ``count`` never routes here — every caller answers it from
+        presence counts (:func:`_present_count`), where NaN correctly
+        counts as a present value."""
+        kind = self.agg.kind
+        isarr = isinstance(vals, np.ndarray)
+        if isarr and vals.dtype.kind == "f" and kind != "sum":
+            vals = vals[~np.isnan(vals)]  # NaN skipped (stats convention)
+        if not isarr:
+            vals = [v for v in vals if v is not None]
+        if len(vals) == 0:
+            return
+        if kind in ("min", "max"):
+            if isarr:
+                self.add_bound((vals.min() if kind == "min"
+                                else vals.max()).item())
+            else:
+                self.add_bound(min(vals) if kind == "min" else max(vals))
+        elif kind == "sum":
+            if isarr:
+                if vals.dtype.kind == "f":
+                    self.add_sum(float(np.sum(vals, dtype=np.float64)))
+                elif vals.dtype.kind == "b":
+                    self.add_sum(int(np.count_nonzero(vals)))
+                elif vals.dtype.itemsize < 8:
+                    # <=32-bit values: an int64 accumulator is exact by
+                    # construction (< 2^31 values × < 2^32 magnitude)
+                    self.add_sum(int(np.sum(vals, dtype=np.int64)))
+                else:
+                    # 64-bit values: python-int accumulation, exact at
+                    # any magnitude (np.sum could wrap silently)
+                    self.add_sum(sum(vals.tolist()))
+            else:
+                self.add_sum(sum(vals))  # decimal unscaled ints
+        elif kind == "count_distinct":
+            if isarr:
+                self.distinct.update(np.unique(vals).tolist())
+            else:
+                self.distinct.update(vals)
+        else:
+            assert kind == "top_k", kind
+            b = self.topk_bound()
+            if isarr and b is not None:
+                vals = vals[vals > b] if self.agg.largest else vals[vals < b]
+            for v in (vals.tolist() if isarr else vals):
+                if b is None or (v > b if self.agg.largest else v < b):
+                    self._offer(v)
+                    b = self.topk_bound()
+
+    # ------------------------------------------------------------- merge
+    def merge(self, other: "_Acc") -> None:
+        self.n += other.n
+        self.add_bound(other.cur)
+        self.add_sum(other.total)
+        if self.distinct is not None:
+            self.distinct |= other.distinct
+        if self.heap is not None:
+            for item in other.heap:
+                v = item.v if isinstance(item, _RevKey) else item
+                b = self.topk_bound()
+                if b is None or (v > b if self.agg.largest else v < b):
+                    self._offer(v)
+
+    def result(self):
+        kind = self.agg.kind
+        if kind == "count":
+            return self.n
+        if kind in ("min", "max"):
+            return self.cur
+        if kind == "sum":
+            return self.total
+        if kind == "count_distinct":
+            return len(self.distinct)
+        vals = [item.v if isinstance(item, _RevKey) else item
+                for item in self.heap]
+        return sorted(vals, reverse=self.agg.largest)
+
+
+# ---------------------------------------------------------------------------
+# order-domain value extraction from aligned (values, validity) spans
+# ---------------------------------------------------------------------------
+
+
+def _present_order_values(leaf, vals, valid, mask=None):
+    """Order-domain values of the PRESENT (non-null) rows of a
+    row-aligned span, optionally restricted to ``mask`` rows — numpy
+    array for fixed-width columns (unsigned logical ints in the unsigned
+    view), python list for BYTE_ARRAY / FLBA / decimal byte keys."""
+    from ..algebra.compare import decode_order_value, is_unsigned
+    from ..schema.types import LogicalKind
+
+    decimal = leaf.logical_kind == LogicalKind.DECIMAL
+    if isinstance(vals, list):
+        idx = range(len(vals)) if mask is None else np.flatnonzero(mask)
+        out = []
+        for i in idx:
+            v = vals[i]
+            if v is None:
+                continue
+            out.append(decode_order_value(bytes(v), leaf) if decimal
+                       else bytes(v))
+        return out
+    arr = np.asarray(vals)
+    if arr.ndim == 2 and arr.dtype == np.uint8:  # FLBA (n, width) rows
+        rows = range(len(arr)) if mask is None else np.flatnonzero(mask)
+        out = []
+        for i in rows:
+            if valid is not None and not valid[i]:
+                continue
+            out.append(decode_order_value(bytes(arr[i]), leaf))
+        return out
+    if mask is not None:
+        arr = arr[mask]
+        valid = None if valid is None else np.asarray(valid, bool)[mask]
+    if valid is not None:
+        arr = arr[np.asarray(valid, bool)]
+    if is_unsigned(leaf) and arr.dtype in (np.dtype(np.int32),
+                                           np.dtype(np.int64)):
+        arr = arr.view(np.uint32 if arr.dtype == np.dtype(np.int32)
+                       else np.uint64)
+    return arr
+
+
+def _present_count(vals, valid, mask=None) -> int:
+    """Non-null row count of an aligned span (optionally under mask)."""
+    if isinstance(vals, list):
+        idx = range(len(vals)) if mask is None else np.flatnonzero(mask)
+        return sum(1 for i in idx if vals[i] is not None)
+    if valid is None:
+        n = len(vals)
+        return int(mask.sum()) if mask is not None else n
+    v = np.asarray(valid, bool)
+    return int((v & mask).sum() if mask is not None else v.sum())
+
+
+def _dict_order_entries(leaf, host_dict):
+    """Dictionary entries decoded into the order domain: list for byte
+    forms, numpy array (unsigned view) for fixed-width."""
+    from ..algebra.compare import decode_order_value, is_unsigned
+    from ..schema.types import LogicalKind
+
+    decimal = leaf.logical_kind == LogicalKind.DECIMAL
+    if isinstance(host_dict, tuple):  # (uint8 values, offsets)
+        hv, ho = np.asarray(host_dict[0]), np.asarray(host_dict[1])
+        out = []
+        for i in range(len(ho) - 1):
+            raw = bytes(hv[ho[i]:ho[i + 1]])
+            out.append(decode_order_value(raw, leaf) if decimal else raw)
+        return out
+    arr = np.asarray(host_dict)
+    if arr.ndim == 2 and arr.dtype == np.uint8:  # FLBA entries
+        return [decode_order_value(bytes(r), leaf) for r in arr]
+    if is_unsigned(leaf) and arr.dtype in (np.dtype(np.int32),
+                                           np.dtype(np.int64)):
+        arr = arr.view(np.uint32 if arr.dtype == np.dtype(np.int32)
+                       else np.uint64)
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# per-row-group reader (admission-gated decode, memoized per span)
+# ---------------------------------------------------------------------------
+
+
+class _RgReader:
+    """Row-aligned decode access for ONE row group, with the unified read
+    budget applied per span and a ``decoded`` flag the tier accounting
+    reads (any values decoded → the row group counts as tier
+    ``decoded``)."""
+
+    def __init__(self, pf, rg):
+        self.pf = pf
+        self.rg = rg
+        self.decoded = False
+        self.dict_used = False
+        self._memo: Dict[tuple, tuple] = {}
+        self._whole: Dict[int, object] = {}  # column -> whole-chunk col
+        self._admission = read_admission()
+
+    def _span_bytes(self, leaf, count: int) -> int:
+        meta = self.pf.metadata.row_groups[self.rg.index]
+        tot = meta.columns[leaf.column_index].meta_data \
+            .total_uncompressed_size or 0
+        return int(tot * count / max(self.rg.num_rows, 1))
+
+    def aligned(self, leaf, start: int, count: int):
+        """(values, validity) for local rows [start, start+count)."""
+        from .search import _trim_flat_aligned, read_row_range
+
+        key = (leaf.column_index, start, count)
+        got = self._memo.get(key)
+        if got is None:
+            self.decoded = True
+            whole = self._whole.get(leaf.column_index)
+            if whole is not None:
+                # a failed dict-tier probe already decoded the whole
+                # chunk — trim it instead of decoding the rows again
+                got = _trim_flat_aligned(whole, start, count)
+            else:
+                base = self._rg_base()
+                with self._admission.admit(self._span_bytes(leaf, count),
+                                           tier="scan"):
+                    got = read_row_range(self.pf, leaf.dotted_path,
+                                         base + start, count, aligned=True)
+            self._memo[key] = got
+        return got
+
+    def _rg_base(self) -> int:
+        base = 0
+        for rg in self.pf.row_groups:
+            if rg.index == self.rg.index:
+                break
+            base += rg.num_rows
+        return base
+
+    def dict_column(self, leaf):
+        """The chunk in (dictionary, indices) form, or None when it is
+        not fully dict-encoded (the dictionary tier's gate).  Checked
+        against the FOOTER encodings first — a plain chunk must not pay
+        a full decode just to learn it has no dictionary (the exact
+        fallback would then decode it a second time)."""
+        from ..format.enums import Encoding
+        from .reader import decode_chunk_host
+
+        if not env_bool("PARQUET_TPU_AGG_DICT"):
+            return None
+        chunk = self.rg.column(leaf.column_index)
+        dict_encs = {Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY}
+        if not any(Encoding(e) in dict_encs
+                   for e in (chunk.meta.encodings or [])):
+            return None  # footer says no dictionary pages: zero IO spent
+        with self._admission.admit(
+                chunk.meta.total_uncompressed_size or 0, tier="scan"):
+            col = decode_chunk_host(chunk, keep_dictionary=True)
+        if not col.is_dictionary_encoded():
+            # mixed chunk (dict fell back to plain mid-file): keep the
+            # decode — the exact fallback trims it instead of paying a
+            # second decompression of the same rows
+            self._whole[leaf.column_index] = col
+            return None
+        self.dict_used = True
+        return col
+
+
+# ---------------------------------------------------------------------------
+# page-interval classification (tier 2)
+# ---------------------------------------------------------------------------
+
+
+def _pred_intervals(pf, rg, pred) -> Tuple[Optional[_Intervals], _Intervals]:
+    """One leaf's (may, covered) row intervals from its page index —
+    ``may`` is None when no index narrows it (whole group candidate);
+    ``covered`` holds rows the zone maps PROVE all-match."""
+    from .faults import read_context
+    from .search import page_row_spans, pred_cover_page_ords
+
+    if _stats_covers(pred, rg):
+        return None, [(0, rg.num_rows)]
+    chunk = rg.column(pred.leaf.column_index)
+    with read_context(path=pf._path, row_group=rg.index,
+                      column=pred.path, kinds=(CorruptedError, OSError)):
+        ci = chunk.column_index()
+        oi = chunk.offset_index()
+    if ci is None or oi is None or not oi.page_locations:
+        return None, []
+    spans = page_row_spans(oi, rg.num_rows)
+    may = _merge_intervals([spans[o] for o in _pred_page_ords(pred, ci)])
+    cov = _merge_intervals(
+        [spans[o] for o in pred_cover_page_ords(pred, ci, pred.leaf, spans)])
+    return may, cov
+
+
+def _tree_intervals2(pf, rg, expr) -> Tuple[Optional[_Intervals],
+                                            Optional[_Intervals]]:
+    """(may, covered) fold through the tree: And intersects both, Or
+    unions both.  ``None`` = the full row group (for ``covered`` that
+    means PROVEN full coverage — only Const TRUE and stats-covered
+    leaves produce it)."""
+    if isinstance(expr, Const):
+        full = None if expr.value else []
+        return full, full
+    from ..algebra.expr import And, Or, Pred
+
+    if isinstance(expr, Pred):
+        return _pred_intervals(pf, rg, expr)
+
+    def isect(a, b):  # None = the full row group
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return _intersect_intervals(a, b)
+
+    if isinstance(expr, And):
+        may: Optional[_Intervals] = None
+        cov: Optional[_Intervals] = None
+        first = True
+        for c in expr.children:
+            m, v = _tree_intervals2(pf, rg, c)
+            may = isect(may, m)
+            cov = v if first else isect(cov, v)
+            first = False
+        return may, cov
+    assert isinstance(expr, Or), expr
+    may_acc: _Intervals = []
+    cov_acc: _Intervals = []
+    may_full = cov_full = False
+    for c in expr.children:
+        m, v = _tree_intervals2(pf, rg, c)
+        if m is None:
+            may_full = True
+        else:
+            may_acc.extend(m)
+        if v is None:
+            cov_full = True
+        else:
+            cov_acc.extend(v)
+    return (None if may_full else _merge_intervals(may_acc),
+            None if cov_full else _merge_intervals(cov_acc))
+
+
+def _decompose_col(pf, rg, leaf, intervals: _Intervals):
+    """Split ``intervals`` along one column's page grid: returns
+    ``(full_page_ords, remainder_intervals, spans)`` — pages wholly
+    inside an interval (answerable from their zone-map bounds) versus
+    the boundary rows that must decode."""
+    from .faults import read_context
+    from .search import page_row_spans
+
+    chunk = rg.column(leaf.column_index)
+    with read_context(path=pf._path, row_group=rg.index,
+                      column=leaf.dotted_path,
+                      kinds=(CorruptedError, OSError)):
+        ci = chunk.column_index()
+        oi = chunk.offset_index()
+    if ci is None or oi is None or not oi.page_locations:
+        return [], list(intervals), None, None
+    spans = page_row_spans(oi, rg.num_rows)
+    full: List[int] = []
+    rem: _Intervals = []
+    for s, e in intervals:
+        for o, (ps, pe) in enumerate(spans):
+            if pe <= s or ps >= e:
+                continue
+            if ps >= s and pe <= e:
+                full.append(o)
+            else:
+                rem.append((max(ps, s), min(pe, e)))
+    return full, _merge_intervals(rem), spans, ci
+
+
+# ---------------------------------------------------------------------------
+# the per-row-group resolver
+# ---------------------------------------------------------------------------
+
+
+def _exact_stats(leaf) -> bool:
+    return leaf.physical_type in _EXACT_STAT_TYPES
+
+
+def _page_bounds(ci, leaf, ords):
+    """(mins, maxs, null_counts, null_pages) for the given ordinals."""
+    from .search import decoded_bounds
+
+    mins, maxs = decoded_bounds(ci, leaf)
+    nulls = list(ci.null_pages or [])
+    ncounts = ci.null_counts
+    return ([mins[o] if o < len(mins) else None for o in ords],
+            [maxs[o] if o < len(maxs) else None for o in ords],
+            [None if ncounts is None else ncounts[o] for o in ords],
+            [nulls[o] if o < len(nulls) else False for o in ords])
+
+
+def _resolve_rg(pf, rg, expr, aggs: Sequence[AggExpr], leaves, group_leaf,
+                pslots: int = 0):
+    """Resolve one row group into fresh accumulator deltas.  Returns
+    ``(tier, accs, groups, note)`` — ``accs`` None when the group
+    contributes nothing.  Raises CorruptedError/DeadlineError for the
+    caller's skip/propagate policy; nothing is merged on failure, so a
+    skipped group drops atomically.  With ``pslots`` >= 2 (a remote
+    source with connection-pool slots), the disjoint page ranges the
+    resolution will read are fetched concurrently first."""
+    import contextlib
+
+    alive, killer = _eval_tree(expr, lambda p: _stats_alive(p, rg))
+    if not alive:
+        note = f"pruned by stats ({killer!r})" if killer is not None \
+            else "pruned by stats"
+        return "stats", None, None, note
+    covered = _tree_covers(expr, lambda p: _stats_covers(p, rg))
+    reader = _RgReader(pf, rg)
+    accs = [_Acc(a, leaves[i]) for i, a in enumerate(aggs)]
+    groups: Optional[dict] = {} if group_leaf is not None else None
+    if covered:
+        ctx = contextlib.nullcontext()
+        if pslots >= 2:
+            ranges = _prewarm_ranges(pf, rg, expr, aggs, leaves,
+                                     group_leaf, True, None, None, None)
+            if len(ranges) >= 2:
+                ctx = _prewarmed(pf, ranges, pslots)
+        with ctx:
+            if group_leaf is not None:
+                _group_full(pf, rg, reader, aggs, leaves, group_leaf,
+                            groups)
+            else:
+                for acc in accs:
+                    _contrib_full(pf, rg, reader, acc)
+        tier = ("decoded" if reader.decoded
+                else "dict" if reader.dict_used else "stats")
+        return tier, accs, groups, f"covered, answered by {tier}"
+    # ---- tier 2: page-interval classification
+    may, cov = _tree_intervals2(pf, rg, expr)
+    may = may if may is not None else [(0, rg.num_rows)]
+    if not may:
+        return "pages", None, None, "pruned by pages"
+    # cov ⊆ may by construction; intersect defensively (a covered row is
+    # by definition a candidate row)
+    cov = may if cov is None else _intersect_intervals(cov, may)
+    contended = _subtract_intervals(may, cov)
+    ctx = contextlib.nullcontext()
+    if pslots >= 2:
+        ranges = _prewarm_ranges(pf, rg, expr, aggs, leaves, group_leaf,
+                                 False, may, cov, contended)
+        if len(ranges) >= 2:
+            ctx = _prewarmed(pf, ranges, pslots)
+    with ctx:
+        masks = _contended_masks(expr, reader, contended, leaves)
+        if group_leaf is not None:
+            _group_partial(pf, rg, reader, aggs, leaves, group_leaf,
+                           groups, cov, masks)
+        else:
+            for acc in accs:
+                _contrib_partial(pf, rg, reader, acc, cov, masks)
+    tier = "decoded" if reader.decoded else "pages"
+    note = (f"partial: {_iv_rows(cov)} covered + "
+            f"{_iv_rows(contended)} contended rows, answered by {tier}")
+    return tier, accs, groups, note
+
+
+def _contended_masks(expr, reader: _RgReader, contended: _Intervals,
+                     leaves) -> Dict[Tuple[int, int], np.ndarray]:
+    """Exact predicate mask per contended interval (filter columns
+    decode aligned; the scan's own ``expr_mask`` evaluates)."""
+    from ..parallel.host_scan import expr_mask
+
+    if not contended:
+        return {}
+    preds = _collect_preds(expr)
+    fleaves = {p.path: p.leaf for p in preds}
+    out = {}
+    for s, e in contended:
+        env = {path: reader.aligned(leaf, s, e - s)
+               for path, leaf in fleaves.items()}
+        out[(s, e)] = expr_mask(expr, env, e - s)
+    return out
+
+
+def _contrib_full(pf, rg, reader: _RgReader, acc: _Acc) -> None:
+    """One aggregate over a FULLY covered row group: stats first, the
+    dictionary tier next, decode last."""
+    agg, leaf = acc.agg, acc.leaf
+    if agg.kind == "count" and agg.path is None:
+        acc.add_count(rg.num_rows)
+        return
+    chunk = rg.column(leaf.column_index)
+    st = chunk.statistics()
+    nv = chunk.meta.num_values
+    nulls = st.null_count if st is not None else None
+    if agg.kind == "count":
+        if nv is not None and nulls is not None:
+            acc.add_count(nv - nulls)
+            return
+    elif agg.kind in ("min", "max") and _exact_stats(leaf) \
+            and st is not None:
+        v = st.min_value if agg.kind == "min" else st.max_value
+        if v is not None and v == v:  # NaN-stat guard: descend instead
+            acc.add_bound(v)
+            return
+        if nv is not None and nulls is not None and nulls >= nv:
+            return  # all-null chunk: nothing to contribute
+    # ---- dictionary tier
+    if agg.kind in ("min", "max", "sum", "count_distinct", "count"):
+        col = reader.dict_column(leaf)
+        if col is not None:
+            _dict_contrib(acc, leaf, col)
+            return
+    # ---- decode fallback (top_k lands here with page-bound pruning)
+    if agg.kind == "top_k":
+        _topk_intervals(pf, rg, reader, acc, [(0, rg.num_rows)])
+        return
+    vals, valid = reader.aligned(leaf, 0, rg.num_rows)
+    if agg.kind == "count":
+        acc.add_count(_present_count(vals, valid))
+    else:
+        acc.add_values(_present_order_values(leaf, vals, valid))
+
+
+def _dict_contrib(acc: _Acc, leaf, col) -> None:
+    """Aggregate over a dict-encoded chunk WITHOUT expanding values:
+    the dictionary decodes once, the index stream carries the rest."""
+    agg = acc.agg
+    idx = np.asarray(col.dict_indices)
+    if agg.kind == "count":
+        acc.add_count(len(idx))  # indices are dense over PRESENT slots
+        return
+    entries = _dict_order_entries(leaf, col._host_dictionary())
+    if len(idx) == 0:
+        return
+    if agg.kind == "sum":
+        counts = np.bincount(idx, minlength=len(entries))
+        if isinstance(entries, np.ndarray) and entries.dtype.kind == "f":
+            acc.add_sum(float(np.dot(counts.astype(np.float64),
+                                     np.asarray(entries, np.float64))))
+        else:
+            ent = entries.tolist() if isinstance(entries, np.ndarray) \
+                else entries
+            acc.add_sum(sum(int(c) * int(v)
+                            for c, v in zip(counts.tolist(), ent) if c))
+        return
+    used = np.unique(idx)
+    if isinstance(entries, np.ndarray):
+        used_vals = entries[used]
+    else:
+        used_vals = [entries[i] for i in used.tolist()]
+    acc.add_values(used_vals if not isinstance(used_vals, np.ndarray)
+                   else used_vals)
+
+
+def _contrib_partial(pf, rg, reader: _RgReader, acc: _Acc,
+                     cov: _Intervals, masks) -> None:
+    """One aggregate over a PARTIALLY covered row group: covered
+    intervals answer from page math/bounds where provable, contended
+    intervals decode under the exact mask."""
+    agg, leaf = acc.agg, acc.leaf
+    if agg.kind == "count" and agg.path is None:
+        acc.add_count(_iv_rows(cov))
+        for m in masks.values():
+            acc.add_count(int(m.sum()))
+        return
+    if agg.kind == "top_k":
+        _topk_intervals(pf, rg, reader, acc, cov)
+        for (s, e), m in masks.items():
+            vals, valid = reader.aligned(leaf, s, e - s)
+            acc.add_values(_present_order_values(leaf, vals, valid, m))
+        return
+    # ---- covered intervals
+    if cov:
+        if agg.kind in ("count", "min", "max"):
+            full, rem, spans, ci = _decompose_col(pf, rg, leaf, cov)
+            if full:
+                mins, maxs, ncounts, nullp = _page_bounds(ci, leaf, full)
+                for o, mn, mx, nc, npg in zip(full, mins, maxs, ncounts,
+                                              nullp):
+                    rows = spans[o][1] - spans[o][0]
+                    if agg.kind == "count":
+                        if nc is None and not npg:
+                            rem.append(spans[o])  # unknown nulls: decode
+                        else:
+                            acc.add_count(0 if npg else rows - (nc or 0))
+                    else:
+                        if npg:
+                            continue  # all-null page: no contribution
+                        v = mn if agg.kind == "min" else mx
+                        if v is None or not _exact_stats(leaf) or v != v:
+                            rem.append(spans[o])  # inexact bound: decode
+                        else:
+                            acc.add_bound(v)
+            rem = _merge_intervals(rem)
+        else:
+            rem = cov  # sum / distinct need the values
+        for s, e in rem:
+            vals, valid = reader.aligned(leaf, s, e - s)
+            if agg.kind == "count":
+                acc.add_count(_present_count(vals, valid))
+            else:
+                acc.add_values(_present_order_values(leaf, vals, valid))
+    # ---- contended intervals (exact mask)
+    for (s, e), m in masks.items():
+        vals, valid = reader.aligned(leaf, s, e - s)
+        if agg.kind == "count":
+            acc.add_count(_present_count(vals, valid, m))
+        else:
+            acc.add_values(_present_order_values(leaf, vals, valid, m))
+
+
+def _topk_intervals(pf, rg, reader: _RgReader, acc: _Acc,
+                    intervals: _Intervals) -> None:
+    """Top-k over unfiltered intervals: a heap over page max (min)
+    bounds — pages are visited best-bound-first and decode ONLY while
+    they still contend with the running k-th bound."""
+    leaf = acc.leaf
+    full, rem, spans, ci = _decompose_col(pf, rg, leaf, intervals)
+    # boundary rows always decode (their page bound covers alien rows)
+    for s, e in rem:
+        vals, valid = reader.aligned(leaf, s, e - s)
+        acc.add_values(_present_order_values(leaf, vals, valid))
+    if not full:
+        return
+    if ci is None:
+        return
+    mins, maxs, _nc, nullp = _page_bounds(ci, leaf, full)
+    order = []
+    for o, mn, mx, npg in zip(full, mins, maxs, nullp):
+        if npg:
+            continue
+        bound = mx if acc.agg.largest else mn
+        order.append((o, bound))
+    # best bound first, unknown bounds last (always decoded)
+    known = [(o, b) for o, b in order if b is not None]
+    unknown = [(o, b) for o, b in order if b is None]
+    try:
+        known.sort(key=lambda ob: ob[1], reverse=acc.agg.largest)
+    except TypeError:
+        pass  # incomparable bounds: visit in page order, still exact
+    for o, bound in known + unknown:
+        if not acc.topk_contends(bound):
+            continue  # page provably cannot improve the running top-k
+        s, e = spans[o]
+        vals, valid = reader.aligned(leaf, s, e - s)
+        acc.add_values(_present_order_values(leaf, vals, valid))
+
+
+# ---------------------------------------------------------------------------
+# group-by
+# ---------------------------------------------------------------------------
+
+
+def _group_accs(aggs, leaves):
+    return [_Acc(a, leaves[i]) for i, a in enumerate(aggs)]
+
+
+def _take_span(vals, valid, idx: np.ndarray):
+    """Row-aligned (values, validity) gathered at ``idx`` — the
+    per-group extraction (O(|group|), replacing the O(span) boolean
+    mask a group used to build)."""
+    if isinstance(vals, list):
+        return [vals[i] for i in idx], None  # lists carry None at nulls
+    sub = np.asarray(vals)[idx]
+    return sub, (None if valid is None else np.asarray(valid, bool)[idx])
+
+
+def _fold_group_sel(groups: dict, aggs, leaves, key, sel: np.ndarray,
+                    col_spans) -> None:
+    """Fold the selected rows of one GROUP into its accumulators."""
+    accs = groups.get(key)
+    if accs is None:
+        accs = groups[key] = _group_accs(aggs, leaves)
+    for ai, (agg, acc) in enumerate(zip(aggs, accs)):
+        if agg.kind == "count" and agg.path is None:
+            acc.add_count(len(sel))
+            continue
+        vals, valid = _take_span(*col_spans[ai], sel)
+        if agg.kind == "count":
+            acc.add_count(_present_count(vals, valid))
+        else:
+            acc.add_values(_present_order_values(leaves[ai], vals, valid))
+
+
+def _fold_group_rows(groups: dict, aggs, leaves, keys, row_sel,
+                     col_spans) -> None:
+    """Fold a batch of rows into the group dict: ``keys[i]`` is the
+    order-domain group key of selected row i (None = null group),
+    ``col_spans[agg ordinal]`` the aligned (vals, valid) span the
+    selected row indices index into."""
+    by_key: Dict = {}
+    for pos, k in enumerate(keys):
+        by_key.setdefault(k, []).append(pos)
+    for k, poss in by_key.items():
+        _fold_group_sel(groups, aggs, leaves, k,
+                        row_sel[np.asarray(poss, np.int64)], col_spans)
+
+
+def _group_keys_for_rows(group_leaf, vals, valid, rows) -> list:
+    """Order-domain group key per selected row (None = null)."""
+    from ..algebra.compare import decode_order_value, is_unsigned
+    from ..schema.types import LogicalKind
+
+    decimal = group_leaf.logical_kind == LogicalKind.DECIMAL
+    out = []
+    if isinstance(vals, list):
+        for r in rows:
+            v = vals[r]
+            out.append(None if v is None
+                       else (decode_order_value(bytes(v), group_leaf)
+                             if decimal else bytes(v)))
+        return out
+    arr = np.asarray(vals)
+    if arr.ndim == 2 and arr.dtype == np.uint8:  # FLBA rows
+        for r in rows:
+            if valid is not None and not valid[r]:
+                out.append(None)
+            else:
+                out.append(decode_order_value(bytes(arr[r]), group_leaf))
+        return out
+    if is_unsigned(group_leaf) and arr.dtype in (np.dtype(np.int32),
+                                                 np.dtype(np.int64)):
+        arr = arr.view(np.uint32 if arr.dtype == np.dtype(np.int32)
+                       else np.uint64)
+    for r in rows:
+        if valid is not None and not valid[r]:
+            out.append(None)
+        else:
+            out.append(_canon_key(arr[r].item()))
+    return out
+
+
+def _group_full(pf, rg, reader: _RgReader, aggs, leaves, group_leaf,
+                groups: dict) -> None:
+    """Group-by over a fully covered row group.  Dict-encoded group
+    columns take the dictionary tier: group ids come straight from the
+    index stream (rows never materialize); everything else decodes."""
+    col = reader.dict_column(group_leaf)
+    n = rg.num_rows
+    col_spans = [None if (a.kind == "count" and a.path is None)
+                 else reader.aligned(leaves[i], 0, n)
+                 for i, a in enumerate(aggs)]
+    if col is not None:
+        idx = np.asarray(col.dict_indices, np.int64)
+        if col.validity is not None:
+            v = np.asarray(col.validity, bool)
+            gid = np.full(n, -1, np.int64)
+            gid[v] = idx
+        else:
+            gid = idx
+        entries = _dict_order_entries(group_leaf, col._host_dictionary())
+        ent_list = entries.tolist() if isinstance(entries, np.ndarray) \
+            else entries
+        # one stable argsort, then contiguous runs per gid: O(n log n)
+        # total instead of an O(n) mask per group
+        if len(gid) == 0:
+            return
+        order = np.argsort(gid, kind="stable")
+        sorted_gid = gid[order]
+        cuts = np.flatnonzero(np.diff(sorted_gid)) + 1
+        for run in np.split(order, cuts):
+            g = int(gid[run[0]])
+            key = None if g < 0 else _canon_key(ent_list[g])
+            _fold_group_sel(groups, aggs, leaves, key, run, col_spans)
+        return
+    gvals, gvalid = reader.aligned(group_leaf, 0, n)
+    rows = np.arange(n, dtype=np.int64)
+    keys = _group_keys_for_rows(group_leaf, gvals, gvalid, rows)
+    _fold_group_rows(groups, aggs, leaves, keys, rows, col_spans)
+
+
+def _group_partial(pf, rg, reader: _RgReader, aggs, leaves, group_leaf,
+                   groups: dict, cov: _Intervals, masks) -> None:
+    """Group-by over a partially covered row group: per included
+    interval, decode the group column + agg columns and fold the
+    selected rows (covered rows unmasked, contended rows masked)."""
+    units = [((s, e), None) for s, e in cov] + \
+        [((s, e), m) for (s, e), m in masks.items()]
+    for (s, e), m in units:
+        n = e - s
+        sel = np.arange(n, dtype=np.int64) if m is None \
+            else np.flatnonzero(m)
+        if not len(sel):
+            continue
+        gvals, gvalid = reader.aligned(group_leaf, s, n)
+        keys = _group_keys_for_rows(group_leaf, gvals, gvalid, sel)
+        col_spans = [None if (a.kind == "count" and a.path is None)
+                     else reader.aligned(leaves[i], s, n)
+                     for i, a in enumerate(aggs)]
+        _fold_group_rows(groups, aggs, leaves, keys, sel, col_spans)
+
+
+# ---------------------------------------------------------------------------
+# result object
+# ---------------------------------------------------------------------------
+
+
+class AggregateResult:
+    """Mapping from aggregate name (``"sum(v)"``) to its value — or, for
+    group-by, ``res.groups`` (order-domain keys, null group last) with
+    each aggregate name mapping to a key-aligned list.  ``counters``
+    carries the per-tier resolution accounting and ``explain()`` the
+    per-row-group trace."""
+
+    def __init__(self, data: dict, groups_keys, counters: Dict[str, int],
+                 lines: List[str]):
+        self.data = data
+        self.groups = groups_keys  # None for ungrouped results
+        self.counters = counters
+        self.report = None
+        self._lines = lines
+
+    def __getitem__(self, name):
+        return self.data[name]
+
+    def __contains__(self, name) -> bool:
+        return name in self.data
+
+    def __iter__(self):
+        return iter(self.data)
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def keys(self):
+        return self.data.keys()
+
+    def items(self):
+        return self.data.items()
+
+    def explain(self) -> str:
+        c = self.counters
+        tail = (f"  tiers: stats={c['rg_answered_stats']} "
+                f"pages={c['rg_answered_pages']} "
+                f"dict={c['rg_answered_dict']} "
+                f"decoded={c['rg_answered_decoded']}"
+                + (f"; manifest-answered files="
+                   f"{c['files_answered_manifest']}"
+                   if c.get("files_answered_manifest") else "")
+                + (f"; skipped rgs={c['rg_skipped_corrupt']}"
+                   if c.get("rg_skipped_corrupt") else ""))
+        return "\n".join(self._lines + [tail])
+
+    def __repr__(self) -> str:
+        return f"AggregateResult({self.data!r})"
+
+
+# ---------------------------------------------------------------------------
+# validation + finalization
+# ---------------------------------------------------------------------------
+
+
+def _validate(pf_schema, aggs, group_by) -> Tuple[list, object]:
+    from ..schema.types import LogicalKind
+
+    if not aggs:
+        raise ValueError("aggregate needs at least one AggExpr "
+                         "(parquet_tpu.count/min_/max_/sum_/...)")
+    leaves = []
+    for a in aggs:
+        if not isinstance(a, AggExpr):
+            raise TypeError(f"expected an AggExpr, got {type(a).__name__} "
+                            "(build with count()/min_()/sum_()/...)")
+        if a.path is None:
+            leaves.append(None)
+            continue
+        leaf = pf_schema.leaf(a.path)  # KeyError on unknown
+        if leaf.max_repetition_level > 0:
+            raise ValueError(f"column {a.path!r} is nested; aggregate "
+                             "handles flat columns")
+        if a.kind == "sum":
+            numeric = leaf.physical_type in (
+                Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE,
+                Type.BOOLEAN)
+            if not numeric and leaf.logical_kind != LogicalKind.DECIMAL:
+                raise ValueError(
+                    f"sum({a.path}) is not defined for "
+                    f"{leaf.physical_type.name} (non-decimal)")
+        leaves.append(leaf)
+    gleaf = None
+    if group_by is not None:
+        gleaf = pf_schema.leaf(group_by)
+        if gleaf.max_repetition_level > 0:
+            raise ValueError(f"group_by column {group_by!r} is nested")
+        for a in aggs:
+            if a.kind in ("count_distinct", "top_k"):
+                raise ValueError(f"{a.name} is not supported with "
+                                 "group_by")
+    return leaves, gleaf
+
+
+def _sort_group_keys(keys) -> list:
+    """Deterministic group order: non-null keys ascending, then the NaN
+    group (NaN refuses ordering — pinning it keeps the sort stable),
+    then the null group last."""
+    nn = [k for k in keys
+          if k is not None and not (isinstance(k, float) and k != k)]
+    try:
+        nn.sort()
+    except TypeError:
+        nn.sort(key=repr)
+    has_nan = any(isinstance(k, float) and k != k for k in keys)
+    return nn + ([_NAN_KEY] if has_nan else []) \
+        + ([None] if any(k is None for k in keys) else [])
+
+
+def _finalize(aggs, accs, groups, counters, lines, report):
+    if groups is None:
+        data = {a.name: acc.result() for a, acc in zip(aggs, accs)}
+        out = AggregateResult(data, None, counters, lines)
+    else:
+        keys = _sort_group_keys(list(groups))
+        data = {a.name: [groups[k][i].result() for k in keys]
+                for i, a in enumerate(aggs)}
+        out = AggregateResult(data, keys, counters, lines)
+    out.report = report
+    return out
+
+
+def _publish(counters: Dict[str, int]) -> None:
+    for tier, metric in _TIER_METRIC.items():
+        n = counters.get(f"rg_answered_{tier}", 0)
+        if n:
+            _oscope.account(metric, n)
+    n = counters.get("files_answered_manifest", 0)
+    if n:
+        _oscope.account(_M_FILES_MANIFEST, n)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def _as_where(where) -> Expr:
+    if where is None:
+        return TRUE
+    if not isinstance(where, Expr):
+        raise TypeError("where must be an Expr tree (build with col(); "
+                        f"got {type(where).__name__})")
+    return where
+
+
+def aggregate_file(pf, aggs: Sequence[AggExpr], where=None, group_by=None,
+                   policy=None, report=None, _prepared=None,
+                   _state_only: bool = False):
+    """Answer ``aggs`` over the rows of ``pf`` matching ``where`` via the
+    cheapest-first answer cascade (module docstring).  ``policy``/
+    ``report`` thread the resilience contract: the operation runs under
+    the policy deadline, preads retry, and with
+    ``on_corrupt='skip_row_group'`` a corrupt row group's contribution
+    drops atomically, recorded with its full row count.
+    ``_state_only`` hands back the raw partial state (the dataset layer
+    merges accumulators — finalized results would lose the distinct
+    SETS a cross-file COUNT DISTINCT needs)."""
+    from .faults import resolve_policy
+
+    t0 = time.perf_counter()
+    with _oscope.maybe_op_scope("file.aggregate", file=pf._path,
+                                aggs=len(list(aggs))):
+        try:
+            pol, report = resolve_policy(pf, policy, report)
+            with pf._resilient_op(policy, report, "aggregate"):
+                state = _aggregate_impl(pf, aggs, where, group_by, pol,
+                                        report, _prepared)
+        finally:
+            _M_AGG_S.observe(time.perf_counter() - t0)
+    aggs_l, accs, groups, counters, lines = state
+    _publish(counters)
+    if _state_only:
+        return state
+    return _finalize(aggs_l, accs, groups, counters, lines, report)
+
+
+def _aggregate_impl(pf, aggs, where, group_by, pol, report, _prepared):
+    from .faults import read_context
+    from .remote import parallel_pread_slots
+
+    aggs = list(aggs)
+    leaves, gleaf = _validate(pf.schema, aggs, group_by)
+    expr = _prepared if _prepared is not None \
+        else prepare(_as_where(where), pf.schema)
+    for p in _collect_preds(expr):
+        if p.leaf.max_repetition_level > 0:
+            raise ValueError(f"predicate column {p.path!r} is nested; "
+                             "aggregate filters flat columns")
+    accs = [_Acc(a, leaves[i]) for i, a in enumerate(aggs)]
+    groups: Optional[dict] = {} if gleaf is not None else None
+    counters = {k: 0 for k in _COUNTER_KEYS}
+    lines = [f"aggregate: {pf._path or '<memory>'}",
+             f"  aggs: {', '.join(a.name for a in aggs)}"
+             + (f"; group_by: {group_by}" if group_by else ""),
+             f"  where: {expr!r}"]
+    skip = pol is not None and pol.skip_corrupt
+    pslots = parallel_pread_slots(pf.source)
+    for rg in pf.row_groups:
+        try:
+            with read_context(path=pf._path, row_group=rg.index,
+                              kinds=(CorruptedError, OSError)):
+                tier, delta, gdelta, note = _resolve_rg(
+                    pf, rg, expr, aggs, leaves, gleaf, pslots)
+        except DeadlineError:
+            raise
+        except CorruptedError as e:
+            if not skip:
+                raise
+            report.record_skip(rg.index, rows=rg.num_rows, error=e)
+            counters["rg_skipped_corrupt"] += 1
+            lines.append(f"  rg {rg.index} ({rg.num_rows} rows): "
+                         f"SKIPPED (corrupt: contribution dropped)")
+            continue
+        counters[f"rg_answered_{tier}"] += 1
+        lines.append(f"  rg {rg.index} ({rg.num_rows} rows): {note}")
+        if delta is not None:
+            for acc, d in zip(accs, delta):
+                acc.merge(d)
+        if gdelta:
+            for k, dacc in gdelta.items():
+                cur = groups.get(k)
+                if cur is None:
+                    groups[k] = dacc
+                else:
+                    for acc, d in zip(cur, dacc):
+                        acc.merge(d)
+    return aggs, accs, groups, counters, lines
+
+
+def _page_span_ranges(pf, rg, leaf, intervals: _Intervals, out: set) -> None:
+    """The byte ranges aligned reads of ``intervals`` will pread: one
+    covering span of pages per interval (exactly ``seek_pages``'s
+    arithmetic) plus the dictionary page — what the parallel prefetch
+    fetches so the serial page machinery then reads from memory."""
+    from bisect import bisect_left, bisect_right
+
+    if not intervals:
+        return
+    chunk = rg.column(leaf.column_index)
+    oi = chunk.offset_index()
+    if oi is None or not oi.page_locations:
+        out.add(chunk.byte_range)
+        return
+    locs = oi.page_locations
+    firsts = [pl.first_row_index for pl in locs]
+    added = False
+    for s, e in intervals:
+        i0 = max(bisect_right(firsts, s) - 1, 0)
+        i1 = min(bisect_left(firsts, e, lo=i0), len(locs))
+        if i1 <= i0:
+            continue
+        start = locs[i0].offset
+        end = locs[i1 - 1].offset + locs[i1 - 1].compressed_page_size
+        out.add((start, end - start))
+        added = True
+    dict_off = chunk.meta.dictionary_page_offset
+    if added and dict_off is not None and 0 < dict_off < locs[0].offset:
+        out.add((dict_off, locs[0].offset - dict_off))
+
+
+def _prewarm_ranges(pf, rg, expr, aggs, leaves, gleaf, covered: bool,
+                    may: Optional[_Intervals], cov: Optional[_Intervals],
+                    contended: Optional[_Intervals]) -> list:
+    """Disjoint byte ranges the resolution of this row group will read —
+    per column, the UNION of every role's intervals (a column can both
+    filter and aggregate), so overlapping spans are never fetched twice."""
+    full = [(0, rg.num_rows)]
+    per_col: Dict[int, list] = {}
+
+    def want(leaf, iv):
+        if leaf is not None and iv:
+            per_col.setdefault(leaf.column_index, []).extend(iv)
+
+    if not covered:
+        for p in _collect_preds(expr):
+            want(p.leaf, contended)
+    for a, leaf in zip(aggs, leaves):
+        if leaf is None:
+            continue
+        if covered:
+            if a.kind == "count":
+                chunk = rg.column(leaf.column_index)
+                st = chunk.statistics()
+                if st is not None and st.null_count is not None \
+                        and chunk.meta.num_values is not None:
+                    continue  # answered from stats
+                want(leaf, full)
+            elif a.kind in ("min", "max"):
+                st = rg.column(leaf.column_index).statistics()
+                v = None if st is None else (
+                    st.min_value if a.kind == "min" else st.max_value)
+                if _exact_stats(leaf) and v is not None and v == v:
+                    continue  # answered from stats
+                want(leaf, full)
+            elif a.kind in ("sum", "count_distinct"):
+                want(leaf, full)
+            # top_k: heap-gated page visits — leave to the serial path
+        else:
+            if a.kind in ("sum", "count_distinct"):
+                want(leaf, may)
+            elif a.kind in ("count", "min", "max"):
+                # covered intervals answer from page bounds; only the
+                # boundary remainders + contended rows decode
+                _f, rem, _s, _ci = _decompose_col(pf, rg, leaf, cov or [])
+                want(leaf, _merge_intervals(list(rem) + list(contended)))
+            # top_k: contended rows decode unconditionally
+            elif a.kind == "top_k":
+                want(leaf, contended)
+    if gleaf is not None:
+        want(gleaf, full if covered else may)
+    out: set = set()
+    for ci, ivs in per_col.items():
+        _page_span_ranges(pf, rg, pf.schema.leaves[ci],
+                          _merge_intervals(ivs), out)
+    # coalesce: two row intervals of ONE column can straddle the same
+    # boundary page, emitting overlapping byte spans — parallel_preads
+    # wants disjoint ranges, and a shared page must fetch once
+    merged: List[Tuple[int, int]] = []
+    for off, size in sorted(out):
+        if merged and off <= merged[-1][0] + merged[-1][1]:
+            end = max(merged[-1][0] + merged[-1][1], off + size)
+            merged[-1] = (merged[-1][0], end - merged[-1][0])
+        else:
+            merged.append((off, size))
+    return merged
+
+
+def _prewarmed(pf, ranges, pslots: int):
+    import contextlib
+
+    from .remote import parallel_preads
+    from .source import PreloadedSource
+
+    @contextlib.contextmanager
+    def scope():
+        total = sum(sz for _, sz in ranges)
+        adm = read_admission()
+        with adm.admit(total, tier="scan"):
+            blocks = parallel_preads(pf.source, ranges, pslots)
+            src = PreloadedSource(pf.source, blocks)
+            try:
+                with pf._source_override(src):
+                    yield
+            finally:
+                src.close()
+
+    return scope()
+
+
+def dataset_aggregate(ds, aggs: Sequence[AggExpr], where=None,
+                      group_by=None, policy=None,
+                      report=None) -> AggregateResult:
+    """Aggregate across a whole :class:`~parquet_tpu.dataset.Dataset`:
+    the predicate prepares ONCE for the corpus, manifest zone maps
+    answer or drop whole part-files with zero footer IO
+    (``agg.files_answered_manifest``), surviving files resolve in
+    parallel on the shared pool, and partial states merge
+    deterministically.  Degraded ``policy``: an unreadable file drops as
+    a unit (``report.files_skipped``)."""
+    t0 = time.perf_counter()
+    with _oscope.maybe_op_scope("dataset.aggregate", files=len(ds.paths),
+                                aggs=len(list(aggs))):
+        try:
+            return _dataset_aggregate_impl(ds, aggs, where, group_by,
+                                           policy, report)
+        finally:
+            _M_DS_AGG_S.observe(time.perf_counter() - t0)
+
+
+def _dataset_aggregate_impl(ds, aggs, where, group_by, policy, report):
+    from ..utils.pool import map_in_order
+    from .faults import NON_DATA_ERRORS
+    from .manifest import manifest_all_match, manifest_may_match
+
+    if not ds.paths:
+        raise ValueError("aggregate on an empty dataset shard; check "
+                         "num_files first")
+    aggs = list(aggs)
+    pol, report, skip = ds._resolve(policy, report)
+    expr = _as_where(where)
+    schema = ds.schema  # opens the first parsable footer
+    leaves, gleaf = _validate(schema, aggs, group_by)
+    expr = prepare(expr, schema)
+    counters = {k: 0 for k in _COUNTER_KEYS}
+    lines = [f"aggregate: dataset of {len(ds.paths)} file(s)",
+             f"  aggs: {', '.join(a.name for a in aggs)}"
+             + (f"; group_by: {group_by}" if group_by else ""),
+             f"  where: {expr!r}"]
+    accs = [_Acc(a, leaves[i]) for i, a in enumerate(aggs)]
+    groups: Optional[dict] = {} if gleaf is not None else None
+    stats = ds._file_stats
+    remaining: List[int] = []
+    for i, path in enumerate(ds.paths):
+        ent = stats.get(path) if stats is not None else None
+        if ent is None:
+            remaining.append(i)
+            continue
+        if not manifest_may_match(ent, expr):
+            counters["files_answered_manifest"] += 1
+            lines.append(f"  file {path}: pruned by manifest zone maps "
+                         "(zero IO)")
+            continue
+        if gleaf is None and manifest_all_match(ent, expr) \
+                and _manifest_answer(ent, aggs, leaves, accs):
+            counters["files_answered_manifest"] += 1
+            lines.append(f"  file {path}: answered from manifest zone "
+                         "maps (zero IO)")
+            continue
+        remaining.append(i)
+
+    def one(i):
+        sub = None
+        try:
+            pf = ds.file(i)
+            ds._check_schema(pf, ds.paths[i])
+            from .faults import ReadReport
+
+            sub = ReadReport() if report is not None else None
+            state = aggregate_file(pf, aggs, where=None,
+                                   group_by=group_by, policy=pol,
+                                   report=sub, _prepared=expr,
+                                   _state_only=True)
+            return state, sub, pf.num_rows, None
+        except DeadlineError:
+            raise
+        except NON_DATA_ERRORS:
+            raise
+        except (CorruptedError, OSError) as e:
+            if not skip:
+                raise
+            return None, sub, 0, e
+
+    results = map_in_order(one, remaining)
+    for i, (state, sub, rows, err) in zip(remaining, results):
+        if state is None:
+            if sub is not None:
+                report.retries += sub.retries
+            report.record_file_skip(ds.paths[i], rows=rows, error=err)
+            counters["files_skipped"] += 1
+            lines.append(f"  file {ds.paths[i]}: SKIPPED ({err})")
+            continue
+        if report is not None and sub is not None:
+            report.merge(sub)
+        _, faccs, fgroups, fcounters, _flines = state
+        for k in ("rg_answered_stats", "rg_answered_pages",
+                  "rg_answered_dict", "rg_answered_decoded",
+                  "rg_skipped_corrupt"):
+            counters[k] += fcounters.get(k, 0)
+        lines.append(f"  file {ds.paths[i]}: tiers "
+                     f"stats={fcounters['rg_answered_stats']} "
+                     f"pages={fcounters['rg_answered_pages']} "
+                     f"dict={fcounters['rg_answered_dict']} "
+                     f"decoded={fcounters['rg_answered_decoded']}")
+        for acc, d in zip(accs, faccs):
+            acc.merge(d)
+        if fgroups:
+            for k, daccs in fgroups.items():
+                cur = groups.get(k)
+                if cur is None:
+                    groups[k] = daccs
+                else:
+                    for acc, d in zip(cur, daccs):
+                        acc.merge(d)
+    if counters["files_answered_manifest"]:
+        _oscope.account(_M_FILES_MANIFEST,
+                        counters["files_answered_manifest"])
+    return _finalize(aggs, accs, groups, counters, lines, report)
+
+
+def _manifest_answer(ent, aggs, leaves, accs) -> bool:
+    """Try to answer EVERY agg from the part's zone maps alone (called
+    only under proven full coverage).  All-or-nothing: returns False —
+    folding nothing — unless each agg is provable, so a file is either
+    answered with zero IO or resolved normally."""
+    folds = []
+    for a, leaf in zip(aggs, leaves):
+        if a.kind == "count" and a.path is None:
+            folds.append(("count", ent.num_rows))
+            continue
+        if a.kind not in ("count", "min", "max"):
+            return False
+        zm = ent.zone_maps.get(a.path)
+        if zm is None:
+            return False
+        mn, mx, nulls, nv = zm
+        if a.kind == "count":
+            if nulls is None or nv is None:
+                return False
+            folds.append(("count", nv - nulls))
+            continue
+        if leaf is None or not _exact_stats(leaf):
+            return False
+        v = mn if a.kind == "min" else mx
+        if v is None:
+            if nulls is not None and nv is not None and nulls >= nv:
+                folds.append(("skip", None))  # all-null part: no value
+                continue
+            return False
+        if v != v:  # NaN zone bound: not answerable
+            return False
+        folds.append(("bound", v))
+    for (kind, v), acc in zip(folds, accs):
+        if kind == "count":
+            acc.add_count(v)
+        elif kind == "bound":
+            acc.add_bound(v)
+    return True

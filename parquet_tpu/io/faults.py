@@ -1367,13 +1367,17 @@ def crash_consistency_check(build, dest, samples: int = 12, seed: int = 0,
     import os
 
     from .integrity import verify_file  # deferred: integrity imports reader
-    from .sink import AtomicFileSink, BufferedSink
+    from .sink import BufferedSink, atomic_path_sink
 
     if os.path.exists(dest):
         raise FileExistsError(f"crash harness refuses to overwrite {dest!r}")
 
     def run(crash_at):
-        inj = FaultInjectingSink(AtomicFileSink(dest), crash_at_byte=crash_at)
+        # atomic_path_sink: the matrix covers whichever atomic variant
+        # production writes use (AtomicFileSink, or MmapFileSink under
+        # PARQUET_TPU_MMAP_SINK)
+        inj = FaultInjectingSink(atomic_path_sink(dest),
+                                 crash_at_byte=crash_at)
         sink = BufferedSink(inj) if buffered else inj
         try:
             build(sink)

@@ -47,7 +47,8 @@ from .sink import AtomicFileSink
 
 __all__ = ["ManifestEntry", "Manifest", "MANIFEST_NAME", "PART_PREFIX",
            "read_manifest", "write_manifest", "commit_manifest",
-           "collect_entry", "manifest_may_match", "sweep_orphans",
+           "collect_entry", "manifest_may_match", "manifest_all_match",
+           "sweep_orphans",
            "part_file_name"]
 
 MANIFEST_NAME = "_table_manifest.json"
@@ -355,6 +356,35 @@ def _zone_alive(pred, entry: ManifestEntry) -> bool:
         return not _not_in_covers(pred.values, mn, mx)
     except TypeError:
         return True  # probe not comparable with the stored domain
+
+
+def _zone_covers(pred, entry: ManifestEntry) -> bool:
+    """Does the part's persisted zone map PROVE that every row matches
+    ``pred``?  The file-level answering dual of :func:`_zone_alive` —
+    shares the one coverage rule (``planner._bounds_cover``) with the
+    footer-stats and page-index duals so no tier can prove more than a
+    deeper one would.  Missing zone maps answer False (not provable)."""
+    from .planner import _bounds_cover
+
+    zm = entry.zone_maps.get(pred.path)
+    if zm is None:
+        return False
+    mn, mx, nulls, nv = zm
+    return _bounds_cover(pred, mn, mx, nulls, nv)
+
+
+def manifest_all_match(entry: ManifestEntry, expr) -> bool:
+    """Does ``entry``'s part provably contain ONLY matching rows?
+    ``expr`` must be a PREPARED tree; evaluation is pure zone-map math —
+    the aggregation cascade answers ``count(*)`` (and, for exact-stat
+    column types, ``count(col)``/``min``/``max``) over such a part with
+    ZERO IO: the file is never opened, its footer never read."""
+    from ..algebra.expr import Const
+    from .planner import _tree_covers
+
+    if isinstance(expr, Const):
+        return expr.value
+    return _tree_covers(expr, lambda p: _zone_covers(p, entry))
 
 
 def manifest_may_match(entry: ManifestEntry, expr) -> bool:

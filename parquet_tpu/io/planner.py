@@ -598,6 +598,89 @@ def _stats_alive(pred: Pred, rg) -> bool:
         return True
 
 
+def _tree_covers(expr: Expr, leaf_fn) -> bool:
+    """Boolean fold of the COVERAGE dual: may ``expr`` provably match
+    EVERY row?  ``leaf_fn(pred) -> bool`` must answer True only on proof
+    (an And covers when all children cover; an Or when any child does —
+    sufficient, conservative).  The aggregation cascade promotes a row
+    group this returns True for from pruning to *answering*."""
+    if isinstance(expr, Const):
+        return expr.value
+    if isinstance(expr, Pred):
+        return leaf_fn(expr)
+    if isinstance(expr, And):
+        return all(_tree_covers(c, leaf_fn) for c in expr.children)
+    assert isinstance(expr, Or), expr
+    return any(_tree_covers(c, leaf_fn) for c in expr.children)
+
+
+def _bounds_cover(pred: Pred, mn, mx, nulls, nv, page_rows=None) -> bool:
+    """Do conservative [mn, mx] bounds + null accounting PROVE that every
+    row of the span matches ``pred``?  The exact dual of
+    :func:`_stats_alive`, shared by the footer-stats, page-index, and
+    manifest zone-map coverage probes so the three can never drift.
+
+    Soundness under stat truncation: stored bounds are conservative
+    (``mn`` <= true min, ``mx`` >= true max — algebra/compare.py's
+    ``truncate_stat_min``/``max`` guarantee exactly this), and every
+    proof below only widens with wider bounds, so a truncated bound can
+    only fail to prove coverage, never prove it wrongly.  Any missing
+    input answers False (not provable).
+
+    Soundness under NaN: float statistics DROP NaN, so bounds can never
+    prove a POSITIVE range/in leaf covers every row — a lurking NaN
+    fails the exact mask while the non-NaN bounds look covering.
+    Positive value proofs on float domains therefore answer False
+    outright.  Negated range/in leaves stay provable: a NaN row fails
+    the base comparison too, so it MATCHES the negation exactly like
+    the proof assumes.  (Pruning is unaffected either way: NaN rows
+    fail positive leaves, which only ever widens a may-match answer.)"""
+    if pred.kind == "null":
+        # every row null: all null_pages, or null_count == the span's rows
+        rows = page_rows if page_rows is not None else nv
+        return nulls is not None and rows is not None and nulls >= rows \
+            and rows > 0
+    if pred.kind == "notnull":
+        return nulls == 0
+    # range / in need every row non-null (NULL fails the leaf, negated or
+    # not) and provable value coverage
+    if nulls != 0 or mn is None or mx is None:
+        return False
+    if not pred.negated and (isinstance(mn, float) or isinstance(mx, float)):
+        return False  # float domain: a NaN row would fail the positive
+        # leaf, and NaN-dropping stats cannot rule one out
+    try:
+        if pred.kind == "range":
+            if not pred.negated:
+                return (pred.lo is None or pred.lo <= mn) and \
+                    (pred.hi is None or mx <= pred.hi)
+            # negated range: every value provably OUTSIDE [lo, hi]
+            return (pred.lo is not None and mx < pred.lo) or \
+                (pred.hi is not None and mn > pred.hi)
+        # in-list
+        if not pred.negated:
+            # every value in [mn, mx] is a probe: the constant span, or an
+            # integer span the sorted probe list blankets
+            return _not_in_covers(pred.values, mn, mx)
+        from .search import _any_in_range
+
+        return not _any_in_range(pred.values, mn, mx)
+    except TypeError:
+        return False  # probe not comparable with the bounds domain
+
+
+def _stats_covers(pred: Pred, rg) -> bool:
+    """Does the row group's footer chunk statistics PROVE that every row
+    matches ``pred``?  (The answering dual of :func:`_stats_alive`.)"""
+    chunk = rg.column(pred.leaf.column_index)
+    st = chunk.statistics()
+    if st is None:
+        return False
+    nv = chunk.meta.num_values
+    return _bounds_cover(pred, st.min_value, st.max_value, st.null_count,
+                         nv)
+
+
 def _bloom_alive(pred: Pred, bf) -> bool:
     """False only when the bloom filter proves the equality probe absent."""
     if pred.kind == "range":  # one-point range

@@ -786,6 +786,24 @@ class ParquetFile:
         return _find_rows(self, path, keys, columns=columns, policy=policy,
                           report=report)
 
+    def aggregate(self, aggs, where=None, group_by=None,
+                  policy: Optional[FaultPolicy] = None,
+                  report: Optional[ReadReport] = None):
+        """Answer aggregate queries — COUNT/MIN/MAX/SUM/COUNT DISTINCT/
+        top-k, optionally grouped — WITHOUT decoding wherever the footer
+        statistics, page-index zone maps, or dictionary pages can prove
+        the result exactly; only contended pages decode (see
+        :mod:`parquet_tpu.io.aggregate`).  ``aggs`` is a list of
+        :mod:`parquet_tpu.algebra.aggregate` nodes (``count()``,
+        ``min_("x")``, …); ``where`` a predicate tree; ``group_by`` a flat
+        column path.  Returns an
+        :class:`~parquet_tpu.io.aggregate.AggregateResult` (mapping-like,
+        with per-tier ``counters`` and ``explain()``)."""
+        from .aggregate import aggregate_file
+
+        return aggregate_file(self, aggs, where=where, group_by=group_by,
+                              policy=policy, report=report)
+
     def read(self, columns: Optional[Sequence[str]] = None,
              device: bool = False,
              row_groups: Optional[Sequence[int]] = None,
@@ -1812,11 +1830,17 @@ def _rle_dict_chunk_fast(reader: ColumnChunkReader, page_list, pre_dec,
 
 
 def decode_chunk_host(reader: ColumnChunkReader, pages=None,
-                      dictionary=None) -> Column:
+                      dictionary=None,
+                      keep_dictionary: bool = False) -> Column:
     """Decode a chunk (or, with ``pages``, a selected page subset — the
     SeekToRow / pushdown path of io/search.py).  ``dictionary`` injects an
     already-decoded dictionary so page-at-a-time streaming consumers don't
-    re-decode the dictionary page per batch."""
+    re-decode the dictionary page per batch.  ``keep_dictionary=True``
+    keeps a fully dict-encoded chunk of ANY physical type in
+    ``(dictionary, indices)`` form — BYTE_ARRAY chunks already stay
+    encoded by default; this extends the no-gather contract to
+    fixed-width columns for consumers that aggregate over indices
+    (io/aggregate.py's dictionary tier) instead of expanding values."""
     leaf = reader.leaf
     meta = reader.meta
     codec = reader.codec
@@ -1965,7 +1989,8 @@ def decode_chunk_host(reader: ColumnChunkReader, pages=None,
     if batched is not None:
         values = batched[0]
         offsets = _offsets_int32(batched[1])
-    elif (physical == Type.BYTE_ARRAY and dictionary is not None and part_order
+    elif ((physical == Type.BYTE_ARRAY or keep_dictionary)
+            and dictionary is not None and part_order
             and all(kind == "idx" for kind, _ in part_order)):
         values, offsets = None, None
         dict_host = dictionary

@@ -73,7 +73,8 @@ from .source import Source, _check_read_args
 __all__ = ["HttpSource", "ObjectStoreSource", "HttpTransport",
            "CircuitBreaker", "breaker_for", "breakers", "reset_breakers",
            "remote_debug", "hedge_delay_s", "observed_pread_ewma",
-           "drain_connection_pools"]
+           "drain_connection_pools", "parallel_preads",
+           "parallel_pread_slots"]
 
 # resolved once: the pread hot path must not take the registry's
 # get-or-create lock (only each metric's own)
@@ -83,6 +84,7 @@ _M_HEDGES = _counter("remote.hedges_issued")
 _M_HEDGES_WON = _counter("remote.hedges_won")
 _M_FAIL_FAST = _counter("remote.breaker_fail_fast")
 _M_VALIDATOR_CHANGES = _counter("remote.validator_changes")
+_M_PARALLEL_PREADS = _counter("remote.parallel_preads")
 _M_ERRORS = {c: _counter("remote.errors", labels={"class": c})
              for c in ("retryable", "terminal", "throttled")}
 _M_TRANSITIONS = {s: _counter("remote.breaker_transitions",
@@ -857,6 +859,19 @@ class HttpSource(Source):
     def size(self) -> int:
         return self._size
 
+    @property
+    def parallel_pread_slots(self) -> int:
+        """How many range requests this source can usefully issue at
+        once: the per-host connection-pool depth.  The multi-range read
+        planner (:func:`parallel_preads`) caps its fan-out here so
+        concurrent ranges ride pooled keep-alive sockets instead of
+        opening one TCP(+TLS) handshake per range.  Chaos-wrapped
+        transports fall back to the pool-depth knob."""
+        got = getattr(self._transport, "pool_size", None)
+        if got is None:
+            got = env_int("PARQUET_TPU_REMOTE_POOL")
+        return max(int(got or 1), 1)
+
     def close(self) -> None:
         if not self._closed:
             self._closed = True
@@ -878,6 +893,91 @@ def _retry_after(hdrs: Dict[str, str]) -> Optional[float]:
         return max(0.0, float(v))
     except ValueError:
         return None  # HTTP-date form: treat as unspecified
+
+
+# ---------------------------------------------------------------------------
+# Parallel multi-range preads (PR 11 follow-on, wired by the aggregation
+# cascade's decode stage)
+# ---------------------------------------------------------------------------
+
+
+def parallel_pread_slots(source) -> int:
+    """Concurrent range-request slots the chain under ``source`` supports,
+    capped by ``PARQUET_TPU_REMOTE_PARALLEL`` (0/1 disables).  Walks the
+    wrapper chain (PolicySource → PrefetchSource → HttpSource) for a
+    terminal source advertising ``parallel_pread_slots``; local sources
+    advertise nothing and answer 0 — one pread at a time is already
+    optimal against the page cache."""
+    cap = env_int("PARQUET_TPU_REMOTE_PARALLEL")
+    if cap <= 1:
+        return 0
+    s, hops = source, 0
+    while s is not None and hops < 8:  # defensive: wrapper cycles
+        got = getattr(s, "parallel_pread_slots", None)
+        if got:
+            return min(int(got), cap)
+        s = getattr(s, "inner", None)
+        hops += 1
+    return 0
+
+
+def parallel_preads(source, ranges, slots: int):
+    """Fetch several DISJOINT ``(offset, size)`` ranges from ``source``
+    concurrently — at most ``slots`` in flight, one per connection-pool
+    slot — and return ``[(offset, bytes), ...]`` in input order.
+
+    Issued against the TOP of the source chain, so per-range retries
+    (PolicySource), hedges, and breaker checks all apply per attempt;
+    the active operation deadline propagates onto the worker threads via
+    a copied context.  Any range's failure cancels nothing in flight but
+    surfaces after the join (DeadlineError first, else the first error)
+    — the caller's retry/degrade policy owns recovery.  Metered as
+    ``remote.parallel_preads`` (one count per range fetched through a
+    parallel batch)."""
+    import contextvars
+    import itertools
+
+    if _locks.LOCKCHECK_ENABLED:
+        _locks.note_blocking("remote.parallel_preads")
+    ranges = list(ranges)
+    results: List = [None] * len(ranges)
+    errors: List = [None] * len(ranges)
+    ctx = contextvars.copy_context()
+    counter = itertools.count()  # shared work queue: no lockstep batches
+
+    def worker() -> None:
+        # drain the shared index counter: a slow range stalls only its
+        # own slot, never a batch boundary — the other connection-pool
+        # slots keep pulling work
+        while True:
+            i = next(counter)
+            if i >= len(ranges):
+                return
+            off, size = ranges[i]
+            try:
+                # under a COPY of the caller's context, so
+                # active_deadline() keeps bounding every range
+                results[i] = ctx.copy().run(source.pread, off, size)
+            # ptlint: disable=PT005 -- not swallowed: captured into the
+            # per-range error slot and re-raised after the join below
+            except BaseException as e:
+                errors[i] = e
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name="pq-parallel-pread")
+               for _ in range(min(max(slots, 1), len(ranges)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dl = next((e for e in errors if isinstance(e, DeadlineError)), None)
+    if dl is not None:
+        raise dl
+    first = next((e for e in errors if e is not None), None)
+    if first is not None:
+        raise first
+    _account(_M_PARALLEL_PREADS, len(ranges))
+    return [(off, data) for (off, _), data in zip(ranges, results)]
 
 
 def remote_debug() -> dict:

@@ -45,7 +45,8 @@ from ..obs.scope import account as _account
 _ACC_WBUF = ledger_account("write.buffer", capacity=lambda:
                            write_buffer_bytes())
 
-__all__ = ["Sink", "FileSink", "AtomicFileSink", "BufferedSink", "WriteStats",
+__all__ = ["Sink", "FileSink", "AtomicFileSink", "MmapFileSink",
+           "BufferedSink", "WriteStats", "atomic_path_sink",
            "fsync_dir", "write_buffer_bytes", "write_autotune",
            "write_autotune_enabled"]
 
@@ -421,6 +422,138 @@ class AtomicFileSink(Sink):
                 # best-effort: abort usually runs inside an exception
                 # handler, and an unlink failure must not mask the original
                 pass
+
+
+class MmapFileSink(Sink):
+    """mmap-backed atomic path sink (the ``PARQUET_TPU_MMAP_SINK``
+    experiment): bytes copy into a memory-mapped temp file grown in
+    8 MiB steps instead of going through buffered ``write()`` calls;
+    ``close()`` = flush(map) → truncate-to-length → fsync → rename over
+    the destination → fsync(dir) — the exact commit contract of
+    :class:`AtomicFileSink`, so the crash matrix covers it unchanged.
+
+    Measured verdict (bench cfg6 ``mmap_sink`` A/B): ~0.75x of the
+    writev path — the map's fault+copy cost loses to vectored writes on
+    page-cache-backed filesystems.  KEPT strictly as an opt-in because
+    it removes syscall pressure under heavy seccomp/audit regimes; not
+    the default."""
+
+    _GROW = 8 << 20
+
+    def __init__(self, dest, fsync: bool = True):
+        import mmap
+
+        self.dest = os.fspath(dest)
+        self.fsync = fsync
+        self.committed = False
+        self.temp_path: Optional[str] = \
+            f"{self.dest}.{secrets.token_hex(6)}.tmp"
+        self._f = open(self.temp_path, "w+b")
+        self._f.truncate(self._GROW)
+        self._mm = mmap.mmap(self._f.fileno(), self._GROW)
+        self._len = 0
+
+    def _ensure(self, need: int) -> None:
+        if need <= len(self._mm):
+            return
+        size = len(self._mm)
+        while size < need:
+            size += self._GROW
+        self._f.truncate(size)
+        self._mm.resize(size)
+
+    def write(self, data) -> int:
+        if self._f is None:
+            raise ValueError(f"write on closed sink for {self.dest!r}")
+        # normalize to a byte view without copying (bytes(data) would
+        # memcpy every payload once more before the map copy)
+        mv = data if isinstance(data, (bytes, bytearray)) \
+            else memoryview(data).cast("B")
+        n = len(mv)
+        self._ensure(self._len + n)
+        self._mm[self._len : self._len + n] = mv
+        self._len += n
+        return n
+
+    def writelines(self, parts) -> None:
+        for p in parts:
+            self.write(p)
+
+    def flush(self) -> None:
+        if self._mm is not None:
+            self._mm.flush()
+
+    def close(self) -> None:
+        """Commit: flush the map, trim to the written length, fsync,
+        rename, fsync(dir) — failures abort (temp removed) and re-raise,
+        exactly like :class:`AtomicFileSink.close`."""
+        if self.committed:
+            return
+        if self._f is None:
+            raise ValueError(
+                f"commit after abort for {self.dest!r} (nothing to commit)")
+        tp = self.temp_path
+        f, self._f = self._f, None
+        mm, self._mm = self._mm, None
+        try:
+            mm.flush()
+            mm.close()
+            f.truncate(self._len)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+            f.close()
+            os.replace(tp, self.dest)
+        except BaseException as e:
+            try:
+                f.close()
+            except OSError:
+                pass
+            try:
+                os.unlink(tp)
+            except OSError:
+                pass
+            self.temp_path = None
+            if isinstance(e, OSError):
+                raise WriteError(f"mmap sink commit failed: {e}",
+                                 path=self.dest, temp_path=tp) from e
+            raise
+        self.temp_path = None
+        self.committed = True
+        if self.fsync:
+            fsync_dir(self.dest)
+        _account(_counter("write.mmap_commits"))
+        _invalidate_dest(self.dest)
+
+    def abort(self) -> None:
+        f, self._f = self._f, None
+        mm, self._mm = self._mm, None
+        if mm is not None:
+            try:
+                mm.close()
+            except (OSError, ValueError):
+                pass
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        tp, self.temp_path = self.temp_path, None
+        if tp is not None and not self.committed:
+            try:
+                os.unlink(tp)
+            except OSError:
+                pass
+
+
+def atomic_path_sink(dest, fsync: bool = True) -> Sink:
+    """The atomic path sink the writer (and the crash harness) commit
+    through: :class:`MmapFileSink` when ``PARQUET_TPU_MMAP_SINK`` opts
+    in, else :class:`AtomicFileSink` — one selector so the crash matrix
+    always covers whichever variant production writes use."""
+    if env_bool("PARQUET_TPU_MMAP_SINK"):
+        return MmapFileSink(dest, fsync=fsync)
+    return AtomicFileSink(dest, fsync=fsync)
 
 
 def _writev_all(fd, parts) -> None:

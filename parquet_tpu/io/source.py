@@ -390,6 +390,45 @@ class FileLikeSource(Source):
                 f.close()
 
 
+class PreloadedSource(Source):
+    """Serve preads from an in-memory set of already-fetched byte ranges,
+    falling through to the inner source for anything outside them.
+
+    The consumer of a multi-range read plan (the aggregation cascade's
+    decode stage) fetches its disjoint ranges CONCURRENTLY first
+    (:func:`parquet_tpu.io.remote.parallel_preads` — one connection-pool
+    slot per range on remote sources), then installs this wrapper so the
+    existing page machinery reads each range from memory instead of
+    re-issuing one serial pread per span.  Transient, caller-owned, and
+    never cached: ``stat_key`` is absent, so no shared tier can key on
+    the wrapper."""
+
+    def __init__(self, inner: Source, blocks):
+        self.inner = inner
+        # sorted (offset, bytes) pairs; containment lookups bisect
+        self._blocks = sorted(blocks, key=lambda b: b[0])
+        self._starts = [b[0] for b in self._blocks]
+
+    def pread(self, offset: int, size: int) -> bytes:
+        _check_read_args(offset, size)
+        from bisect import bisect_right
+
+        i = bisect_right(self._starts, offset) - 1
+        if i >= 0:
+            b0, data = self._blocks[i]
+            if offset + size <= b0 + len(data):
+                lo = offset - b0
+                return bytes(data[lo : lo + size])
+        return self.inner.pread(offset, size)
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def close(self) -> None:
+        self._blocks = []
+        self._starts = []
+
+
 class RetryingSource(Source):
     """Bounded-retry wrapper over any Source — the retryable-host-IO analog
     of SURVEY.md §5 (flaky network filesystems / object-store FUSE mounts).

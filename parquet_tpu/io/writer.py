@@ -241,9 +241,9 @@ class ParquetWriter:
              else type(sink).__name__})
             if _oscope.current_op() is None else None)
         if self._own_sink:
-            from .sink import AtomicFileSink, BufferedSink, FileSink
+            from .sink import BufferedSink, FileSink, atomic_path_sink
 
-            base = (AtomicFileSink(sink, fsync=self.options.fsync)
+            base = (atomic_path_sink(sink, fsync=self.options.fsync)
                     if self.options.atomic_commit
                     else FileSink(sink, fsync=self.options.fsync))
             try:

@@ -479,6 +479,27 @@ _CORE_COUNTERS = (
      "binary search on sorted files"),
     ("lookup.key_shards", "key-shard tasks fanned out for very large "
      "lookup batches"),
+    # aggregation pushdown (io/aggregate.py): per-tier resolution — how
+    # many row groups each cascade tier ANSWERED (stats = zero IO/decode,
+    # pages = zone-map math only, dict = dictionary + index stream,
+    # decoded = exact fallback), plus manifest-level file answers
+    ("agg.rg_answered_stats", "row groups answered by footer statistics "
+     "(zero IO, zero decode)"),
+    ("agg.rg_answered_pages", "row groups answered by page-index zone "
+     "maps (no value decode)"),
+    ("agg.rg_answered_dict", "row groups answered over dictionary pages "
+     "without expanding values"),
+    ("agg.rg_answered_decoded", "row groups resolved by the exact decode "
+     "fallback"),
+    ("agg.files_answered_manifest", "dataset part-files answered or "
+     "dropped from manifest zone maps alone (zero footer IO)"),
+    # multi-range remote reads (io/remote.py parallel_preads): ranges
+    # fetched concurrently across connection-pool slots
+    ("remote.parallel_preads", "disjoint ranges fetched concurrently "
+     "across connection-pool slots"),
+    # mmap write-sink experiment (io/sink.py MmapFileSink)
+    ("write.mmap_commits", "files committed through the mmap-backed "
+     "sink (PARQUET_TPU_MMAP_SINK)"),
 )
 
 
@@ -508,6 +529,10 @@ def _declare_core() -> None:
     REGISTRY.histogram("table.commit_s",
                        help="table commit latency (flush + zone-map "
                             "collection + manifest rename)")
+    REGISTRY.histogram("agg.aggregate_s",
+                       help="per-file aggregation-pushdown latency")
+    REGISTRY.histogram("dataset.aggregate_s",
+                       help="whole-dataset aggregation latency")
     # --- PT001 (analysis/lint.py) pass: every family any module
     # get-or-creates must already exist here, or a process that never
     # imported that module scrapes an incomplete /metrics.  The 22

@@ -37,7 +37,8 @@ from ..io.reader import ParquetFile
 from ..io.search import BA_ARRAYS, plan_scan, read_row_range
 
 __all__ = ["scan", "scan_expr", "scan_filtered", "scan_filtered_device",
-           "scan_filtered_sharded", "scan_files", "merge_scan_results"]
+           "scan_filtered_sharded", "scan_files", "merge_scan_results",
+           "expr_mask"]
 
 from ..utils.pool import (in_shared_pool as _in_pool,
                           instrument_task as _instrument_task,
@@ -158,6 +159,15 @@ def _expr_mask(expr, env: Dict[str, tuple], n: int) -> np.ndarray:
         else:
             out |= m
     return out
+
+
+def expr_mask(expr, env: Dict[str, tuple], n: int) -> np.ndarray:
+    """Public face of :func:`_expr_mask` for the aggregation cascade
+    (io/aggregate.py): the EXACT row mask of a prepared tree over
+    row-aligned ``(values, validity)`` spans — byte-for-byte the same
+    order-domain comparison semantics every filtered scan applies, so a
+    decoded aggregate and a scan-then-aggregate can never disagree."""
+    return _expr_mask(expr, env, n)
 
 
 def _pred_mask(pred, span_val: tuple, n: int) -> np.ndarray:

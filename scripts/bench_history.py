@@ -43,6 +43,11 @@ CONTRACTS = {
                   .get("speedup"), 1.2),
     "10_lookup": ("speedup_vs_naive",
                   lambda cfg: cfg.get("speedup_vs_naive"), 2.0),
+    # aggregation pushdown vs read-then-mask at 0.1% selectivity: the
+    # ISSUE 14 acceptance bar (stats-tier answers must carry it)
+    "12_aggregate": ("sweep 0.1% speedup",
+                     lambda cfg: cfg.get("sweep", {}).get("0.1%", {})
+                     .get("speedup"), 10.0),
 }
 
 

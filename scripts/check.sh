@@ -1017,6 +1017,85 @@ print(f"table smoke ok: compaction byte-identical to one-shot, pinned "
       f"{sorted(outcomes)}, orphan sweep clean")
 TABLEEOF
 
+echo "=== aggregate smoke (zero-pread COUNT proof + tier identity) ==="
+python - <<'AGGEOF'
+# Aggregation pushdown (ISSUE 14): (1) COUNT/MIN/MAX with a predicate
+# that intersects no row group — and full-coverage stats-answerable
+# aggregates — perform ZERO source preads beyond the footer (pread spy);
+# (2) a partially-covered query is value-identical to decode-then-
+# aggregate; (3) group-by over dict keys answers from the dictionary
+# tier; (4) the per-tier counters render in --prom.  Bounded to seconds.
+import io
+
+import numpy as np
+import pyarrow as pa
+
+from parquet_tpu import (ParquetFile, col, count, count_distinct, max_,
+                         min_, render_prometheus, sum_)
+from parquet_tpu.io.source import BytesSource
+from parquet_tpu.io.writer import WriterOptions, write_table
+
+n = 120_000
+rng = np.random.default_rng(5)
+t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+              "v": pa.array(rng.random(n)),
+              "s": pa.array([f"g{i % 97:02d}" for i in range(n)])})
+buf = io.BytesIO()
+write_table(t, buf, WriterOptions(compression="snappy",
+                                  row_group_size=n // 12,
+                                  data_page_size=8 * 1024))
+raw = buf.getvalue()
+
+
+class Spy(BytesSource):
+    preads = 0
+
+    def pread(self, offset, size):
+        Spy.preads += 1
+        return super().pread(offset, size)
+
+    def pread_view(self, offset, size):
+        Spy.preads += 1
+        return super().pread_view(offset, size)
+
+
+pf = ParquetFile(Spy(raw))
+at_open = Spy.preads
+res = pf.aggregate([count(), count("v"), min_("v"), max_("k")],
+                   where=col("k").between(10 ** 12, None))
+assert Spy.preads == at_open, "never-matching aggregate issued preads"
+assert res["count(*)"] == 0 and res.counters["rg_answered_stats"] == 12
+res = pf.aggregate([count(), min_("k"), max_("k")])
+assert Spy.preads == at_open, "covered stats aggregate issued preads"
+assert res["count(*)"] == n and res["max(k)"] == n - 1
+
+lo, hi = n // 3, n // 3 + n // 100
+res = pf.aggregate([count(), sum_("v"), min_("v"), max_("v"),
+                    count_distinct("s")], where=col("k").between(lo, hi))
+k = np.arange(n)
+m = (k >= lo) & (k <= hi)
+v = t.column("v").to_numpy()
+assert res["count(*)"] == int(m.sum())
+assert res["min(v)"] == float(v[m].min())
+assert res["max(v)"] == float(v[m].max())
+assert abs(res["sum(v)"] - float(v[m].sum())) < 1e-9 * n
+assert res["count_distinct(s)"] == len({f"g{i % 97:02d}"
+                                        for i in np.flatnonzero(m)})
+assert res.counters["rg_answered_stats"] >= 10, res.counters
+
+grp = pf.aggregate([count()], group_by="s")
+assert grp.counters["rg_answered_dict"] == 12, grp.counters
+assert sum(grp["count(*)"]) == n and len(grp.groups) == 97
+
+prom = render_prometheus()
+for fam in ("parquet_tpu_agg_rg_answered_stats_total",
+            "parquet_tpu_agg_rg_answered_dict_total",
+            "parquet_tpu_agg_aggregate_s_bucket"):
+    assert fam in prom, fam
+print(f"aggregate smoke ok: zero-pread stats answers, value identity at "
+      f"1% selectivity, dict-tier group-by over 97 keys")
+AGGEOF
+
 echo "=== analysis smoke (invariant lint + lockcheck gate) ==="
 # the standing pre-merge correctness gate: AST lint over the package
 # (PT001-PT006), README knob table generated-vs-committed, and a
@@ -1116,6 +1195,18 @@ for name, cfg in detail.get('configs', {}).items():
         assert cfg.get('byte_identical') is True, (name, cfg)
         assert cfg.get('parts_before_compact', 0) >= 2, (name, cfg)
         assert cfg.get('commit_p99_s') is not None, (name, cfg)
+    if name.startswith('6_'):
+        mm = cfg.get('pipeline', {}).get('mmap_sink', {})
+        assert mm.get('byte_identical') is True, (name, mm)
+    if name.startswith('12_'):
+        sw = cfg.get('sweep', {})
+        assert sw and all(v.get('byte_identical') for v in sw.values()), \
+            (name, sw)
+        assert sw.get('0.1%', {}).get('speedup', 0) >= 10.0, (name, sw)
+        t0 = sw.get('0.1%', {}).get('tiers', {})
+        assert t0.get('rg_answered_stats', 0) > \
+            t0.get('rg_answered_pages', 0) + t0.get('rg_answered_dict', 0) \
+            + t0.get('rg_answered_decoded', 0), (name, t0)
 print('bench smoke ok:', d['metric'], d['value'], d['unit'])
 "
 # bench trajectory: rebuild BENCH_TRAJECTORY.json from the per-round

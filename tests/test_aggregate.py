@@ -1,0 +1,807 @@
+"""Aggregation pushdown (ISSUE 14): the answer cascade must be
+value-identical to naive decode-then-aggregate across encodings × nulls
+× multi-row-group layouts, answer provable queries with ZERO source
+preads beyond the footer, compose with the fault envelope (atomic
+row-group drops, deadlines, remote chaos), and meter its per-tier
+resolution."""
+
+import io
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import (Dataset, FaultPolicy, ParquetFile, ReadReport, col,
+                         count, count_distinct, max_, min_, sum_, top_k)
+from parquet_tpu.io.source import BytesSource, PreloadedSource
+from parquet_tpu.io.writer import WriterOptions, write_table
+
+
+def _write_ours(table, **kw):
+    buf = io.BytesIO()
+    write_table(table, buf, WriterOptions(**kw))
+    return buf.getvalue()
+
+
+def _naive(table, where=None, group_by=None):
+    """Decode-then-aggregate oracle in the order domain: returns a dict
+    of helpers (mask + per-column order values) the tests aggregate
+    with plain python."""
+    cols = {}
+    for name in table.column_names:
+        vals = table.column(name).to_pylist()
+        cols[name] = [v.encode() if isinstance(v, str) else v
+                      for v in vals]
+    n = table.num_rows
+    if where is None:
+        mask = [True] * n
+    else:
+        path, lo, hi = where
+        src = cols[path]
+        mask = [v is not None
+                and (lo is None or v >= lo) and (hi is None or v <= hi)
+                for v in src]
+    return cols, mask
+
+
+def _present(vals, mask):
+    out = []
+    for v, m in zip(vals, mask):
+        if not m or v is None:
+            continue
+        if isinstance(v, float) and v != v:
+            continue  # NaN skipped (the stats convention)
+        out.append(v)
+    return out
+
+
+def _check_identity(raw, table, where_tuple, where_expr, sum_col, agg_col):
+    pf = ParquetFile(raw)
+    res = pf.aggregate(
+        [count(), count(agg_col), min_(agg_col), max_(agg_col),
+         sum_(sum_col), count_distinct(agg_col), top_k(agg_col, 7),
+         top_k(agg_col, 3, largest=False)],
+        where=where_expr)
+    cols, mask = _naive(table, where_tuple)
+    vals = _present(cols[agg_col], mask)
+    svals = _present(cols[sum_col], mask)
+    assert res["count(*)"] == sum(mask)
+    assert res["count(%s)" % agg_col] == sum(
+        1 for v, m in zip(cols[agg_col], mask) if m and v is not None)
+    assert res["min(%s)" % agg_col] == (min(vals) if vals else None)
+    assert res["max(%s)" % agg_col] == (max(vals) if vals else None)
+    want_sum = sum(svals) if svals else None
+    got_sum = res["sum(%s)" % sum_col]
+    if isinstance(want_sum, float):
+        assert got_sum == pytest.approx(want_sum, rel=1e-12)
+    else:
+        assert got_sum == want_sum
+    assert res["count_distinct(%s)" % agg_col] == len(set(vals))
+    assert res["top_k(%s,7)" % agg_col] == sorted(vals, reverse=True)[:7]
+    assert res["top_k(%s,3,smallest)" % agg_col] == sorted(vals)[:3]
+    pf.close()
+    return res
+
+
+# ---------------------------------------------------------------------------
+# value identity: encodings × nulls × multi-rg × selectivity
+# ---------------------------------------------------------------------------
+
+
+def _mixed_table(n, nulls=False, seed=0):
+    rng = np.random.default_rng(seed)
+    k = np.arange(n, dtype=np.int64)
+    v = rng.random(n)
+    s = [f"tag{i % 53:03d}" for i in range(n)]
+    if nulls:
+        v = [None if i % 11 == 0 else float(v[i]) for i in range(n)]
+        s = [None if i % 7 == 0 else s[i] for i in range(n)]
+    return pa.table({"k": pa.array(k), "v": pa.array(v, pa.float64()),
+                     "s": pa.array(s, pa.string())})
+
+
+@pytest.mark.parametrize("nulls", [False, True])
+@pytest.mark.parametrize("sel", ["none", "0.1%", "30%", "all"])
+def test_identity_ours_multi_rg(nulls, sel):
+    n = 40_000
+    t = _mixed_table(n, nulls=nulls)
+    raw = _write_ours(t, row_group_size=n // 8, data_page_size=4096)
+    spans = {"none": (10**9, None), "0.1%": (n // 3, n // 3 + n // 1000),
+             "30%": (n // 4, n // 4 + (3 * n) // 10), "all": (None, None)}
+    lo, hi = spans[sel]
+    where_expr = (col("k").between(lo, hi)
+                  if (lo, hi) != (None, None) else None)
+    res = _check_identity(raw, t, ("k", lo, hi) if where_expr is not None
+                          else None, where_expr, "v", "s")
+    if sel == "none":
+        c = res.counters
+        assert c["rg_answered_stats"] == 8 and \
+            c["rg_answered_decoded"] == 0, c
+
+
+@pytest.mark.parametrize("writer", ["pyarrow_dict", "pyarrow_plain",
+                                    "pyarrow_delta"])
+def test_identity_encodings(writer):
+    n = 30_000
+    t = _mixed_table(n, nulls=True, seed=3)
+    buf = io.BytesIO()
+    if writer == "pyarrow_dict":
+        pq.write_table(t, buf, row_group_size=n // 4, use_dictionary=True,
+                       write_page_index=True)
+    elif writer == "pyarrow_plain":
+        pq.write_table(t, buf, row_group_size=n // 4, use_dictionary=False,
+                       write_page_index=True)
+    else:
+        pq.write_table(t, buf, row_group_size=n // 4, use_dictionary=False,
+                       column_encoding={"k": "DELTA_BINARY_PACKED",
+                                        "v": "PLAIN",
+                                        "s": "DELTA_LENGTH_BYTE_ARRAY"},
+                       write_page_index=True)
+    _check_identity(buf.getvalue(), t, ("k", 5000, 22_000),
+                    col("k").between(5000, 22_000), "v", "s")
+
+
+def test_identity_int_sum_exact_and_unsigned():
+    n = 20_000
+    big = np.full(n, 2**62, dtype=np.int64)  # python-int sums must not wrap
+    u32 = np.arange(n, dtype=np.uint32)
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "big": pa.array(big), "u": pa.array(u32, pa.uint32())})
+    raw = _write_ours(t, row_group_size=n // 4)
+    res = ParquetFile(raw).aggregate([sum_("big"), min_("u"), max_("u"),
+                                      sum_("u")])
+    assert res["sum(big)"] == int(2**62) * n  # > 2**63: exact, no wrap
+    assert res["min(u)"] == 0 and res["max(u)"] == n - 1
+    assert res["sum(u)"] == int(u32.sum())
+
+
+def test_identity_decimal_and_flba():
+    import decimal
+
+    n = 8_000
+    dec = [decimal.Decimal(i) / 100 for i in range(n)]
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "d": pa.array(dec, pa.decimal128(12, 2))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 4, write_page_index=True)
+    pf = ParquetFile(buf.getvalue())
+    res = pf.aggregate([min_("d"), max_("d"), sum_("d"),
+                        count_distinct("d")],
+                       where=col("k").between(100, 5_500))
+    # decimals aggregate as unscaled ints (the order domain)
+    assert res["min(d)"] == 100 and res["max(d)"] == 5_500
+    assert res["sum(d)"] == sum(range(100, 5_501))
+    assert res["count_distinct(d)"] == 5_401
+
+
+def test_nan_rows_never_counted_by_coverage_proofs():
+    """Float statistics DROP NaN, so a wide range predicate on a float
+    column must not let any metadata tier claim full coverage: NaN rows
+    fail the exact mask and every tier's answer must agree with it."""
+    n = 20_000
+    v = np.arange(n, dtype=np.float64)
+    v[::7] = np.nan
+    t = pa.table({"v": pa.array(v), "k": pa.array(np.arange(n,
+                                                            dtype=np.int64))})
+    raw = _write_ours(t, row_group_size=n // 4, data_page_size=4096)
+    pf = ParquetFile(raw)
+    w = col("v").between(-1e18, 1e18)  # covers every non-NaN value
+    res = pf.aggregate([count(), count("v"), sum_("v"), min_("v"),
+                        max_("v")], where=w)
+    m = ~np.isnan(v)
+    assert res["count(*)"] == int(m.sum())  # NaN rows fail the predicate
+    assert res["count(v)"] == int(m.sum())
+    assert res["min(v)"] == 1.0 and res["max(v)"] == float(np.nanmax(v))
+    assert res["sum(v)"] == pytest.approx(float(v[m].sum()), rel=1e-12)
+    # the NEGATED form matches NaN rows exactly like the proof assumes
+    res2 = pf.aggregate([count()], where=~col("v").between(-1e18, 0.5))
+    base = (v >= -1e18) & (v <= 0.5)
+    assert res2["count(*)"] == int((~base).sum())  # NaN rows match NOT
+    # a manifest/stats tier must never have claimed coverage: integer
+    # predicates keep their zero-decode answers
+    res3 = pf.aggregate([count()], where=col("k").between(0, n - 1))
+    assert res3.counters["rg_answered_stats"] == 4
+
+
+def test_group_by_nan_keys_identical_across_tiers():
+    """NaN group keys must form ONE group on every tier (NaN != NaN
+    would otherwise open a group per row on the decode path while the
+    dict tier shares one dictionary entry)."""
+    n = 9_000
+    g = np.arange(n, dtype=np.float64) % 4
+    g[::10] = np.nan
+    t = pa.table({"g": pa.array(g), "k": pa.array(np.arange(n,
+                                                            dtype=np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n // 3, use_dictionary=True,
+                   write_page_index=True)
+    raw_dict = buf.getvalue()
+    raw_plain = _write_ours(t, row_group_size=n // 3)  # plain float col
+    want_nan = int(np.isnan(g).sum())
+    results = []
+    for raw in (raw_dict, raw_plain):
+        res = ParquetFile(raw).aggregate([count()], group_by="g")
+        assert len(res.groups) == 5, res.groups  # 0,1,2,3 + one NaN group
+        assert res.groups[:4] == [0.0, 1.0, 2.0, 3.0]
+        tail = res.groups[4]
+        assert isinstance(tail, float) and tail != tail
+        assert res["count(*)"][4] == want_nan
+        results.append(res["count(*)"])
+    assert results[0] == results[1]
+
+
+def test_mixed_dict_chunk_single_decode():
+    """A chunk whose footer lists dict encodings but whose pages fell
+    back to plain mid-chunk must decode ONCE (the failed dict probe's
+    decode is reused by the exact fallback)."""
+    n = 60_000
+    # high-cardinality strings overflow pyarrow's dictionary page and
+    # fall back to plain mid-chunk; footer still lists RLE_DICTIONARY
+    t = pa.table({"s": pa.array([f"key-{i:07d}" * 8 for i in range(n)])})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n, use_dictionary=True,
+                   dictionary_pagesize_limit=64 * 1024,
+                   write_page_index=True)
+    raw = buf.getvalue()
+    spy = _SpySource(raw)
+    pf = ParquetFile(spy)
+    b0 = spy.bytes
+    res = pf.aggregate([count_distinct("s")])
+    moved = spy.bytes - b0
+    chunk_bytes = pf.metadata.row_groups[0].columns[0] \
+        .meta_data.total_compressed_size
+    assert res["count_distinct(s)"] == n
+    assert moved < 1.5 * chunk_bytes, (moved, chunk_bytes)
+
+
+def test_dict_tier_skips_plain_chunks_without_decode():
+    """A plain-encoded chunk must not pay a decode just to learn it has
+    no dictionary (the footer already says so)."""
+    n = 30_000
+    t = pa.table({"v": pa.array(np.random.default_rng(0).random(n))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n, use_dictionary=False,
+                   write_page_index=True)
+    raw = buf.getvalue()
+    spy = _SpySource(raw)
+    pf = ParquetFile(spy)
+    b0 = spy.bytes
+    pf.aggregate([sum_("v")])
+    once = spy.bytes - b0
+    # the chunk's data moved once, not twice (dict probe + fallback)
+    chunk_bytes = pf.metadata.row_groups[0].columns[0] \
+        .meta_data.total_compressed_size
+    assert once < 1.5 * chunk_bytes, (once, chunk_bytes)
+
+
+def test_sum_rejects_plain_byte_array():
+    t = pa.table({"s": pa.array(["a", "b"])})
+    pf = ParquetFile(_write_ours(t))
+    with pytest.raises(ValueError, match="sum"):
+        pf.aggregate([sum_("s")])
+
+
+def test_validation_errors():
+    t = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+    pf = ParquetFile(_write_ours(t))
+    with pytest.raises(KeyError):
+        pf.aggregate([min_("nope")])
+    with pytest.raises(ValueError, match="at least one"):
+        pf.aggregate([])
+    with pytest.raises(ValueError, match="group_by"):
+        pf.aggregate([count_distinct("x")], group_by="x")
+    with pytest.raises(TypeError):
+        pf.aggregate(["count"])
+
+
+# ---------------------------------------------------------------------------
+# group-by
+# ---------------------------------------------------------------------------
+
+
+def test_group_by_dict_keys_without_materializing():
+    n = 30_000
+    t = _mixed_table(n, nulls=True, seed=5)
+    raw = _write_ours(t, row_group_size=n // 4, data_page_size=8192)
+    pf = ParquetFile(raw)
+    res = pf.aggregate([count(), count("v"), sum_("k"), min_("k")],
+                       group_by="s")
+    cols, mask = _naive(t, None)
+    want = {}
+    for i in range(n):
+        key = cols["s"][i]
+        g = want.setdefault(key, {"n": 0, "nv": 0, "sum": 0, "min": None})
+        g["n"] += 1
+        if cols["v"][i] is not None:
+            g["nv"] += 1
+        g["sum"] += cols["k"][i]
+        g["min"] = cols["k"][i] if g["min"] is None \
+            else min(g["min"], cols["k"][i])
+    keys = sorted(k for k in want if k is not None) + [None]
+    assert res.groups == keys
+    for i, k in enumerate(keys):
+        assert res["count(*)"][i] == want[k]["n"], k
+        assert res["count(v)"][i] == want[k]["nv"], k
+        assert res["sum(k)"][i] == want[k]["sum"], k
+        assert res["min(k)"][i] == want[k]["min"], k
+    # the dict tier carried the group column (strings never expanded)
+    assert res.counters["rg_answered_decoded"] >= 1  # agg cols decode
+
+
+def test_group_by_count_only_uses_dict_tier():
+    n = 27_000
+    t = pa.table({"s": pa.array([f"g{i % 9}" for i in range(n)])})
+    raw = _write_ours(t, row_group_size=n // 3)
+    res = ParquetFile(raw).aggregate([count()], group_by="s")
+    assert res.counters["rg_answered_dict"] == 3, res.counters
+    assert res["count(*)"] == [n // 9] * 9
+
+
+def test_group_by_with_predicate():
+    n = 20_000
+    t = _mixed_table(n, nulls=False, seed=8)
+    raw = _write_ours(t, row_group_size=n // 4, data_page_size=4096)
+    res = ParquetFile(raw).aggregate([count(), sum_("k")], group_by="s",
+                                     where=col("k").between(777, 9_999))
+    cols, mask = _naive(t, ("k", 777, 9_999))
+    want = {}
+    for i in range(n):
+        if not mask[i]:
+            continue
+        g = want.setdefault(cols["s"][i], [0, 0])
+        g[0] += 1
+        g[1] += cols["k"][i]
+    assert res.groups == sorted(want)
+    for i, k in enumerate(res.groups):
+        assert res["count(*)"][i] == want[k][0]
+        assert res["sum(k)"][i] == want[k][1]
+
+
+# ---------------------------------------------------------------------------
+# zero-IO proofs
+# ---------------------------------------------------------------------------
+
+
+class _SpySource(BytesSource):
+    """Counts every pread (and its bytes) the cascade issues."""
+
+    def __init__(self, raw):
+        super().__init__(raw)
+        self.preads = 0
+        self.bytes = 0
+
+    def pread(self, offset, size):
+        self.preads += 1
+        self.bytes += size
+        return super().pread(offset, size)
+
+    def pread_view(self, offset, size):
+        self.preads += 1
+        self.bytes += size
+        return super().pread_view(offset, size)
+
+
+def test_zero_pread_count_min_max():
+    n = 40_000
+    t = _mixed_table(n)
+    raw = _write_ours(t, row_group_size=n // 8)
+    spy = _SpySource(raw)
+    pf = ParquetFile(spy)
+    after_open = spy.preads
+    # predicate intersects no row group: COUNT + MIN/MAX answer from the
+    # already-parsed footer — 0 source preads beyond the footer
+    res = pf.aggregate([count(), count("v"), min_("v"), max_("k")],
+                       where=col("k").between(10**9, None))
+    assert spy.preads == after_open, "stats tier issued source preads"
+    assert res["count(*)"] == 0 and res["min(v)"] is None
+    assert res.counters["rg_answered_stats"] == 8
+    # full coverage, stats-answerable aggs: still zero preads
+    res = pf.aggregate([count(), count("v"), min_("k"), max_("k")])
+    assert spy.preads == after_open, "covered stats answers read bytes"
+    assert res["count(*)"] == n and res["max(k)"] == n - 1
+
+
+def test_topk_decodes_only_contending_pages():
+    n = 60_000
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "p": pa.array(np.arange(n, dtype=np.int64))})
+    raw = _write_ours(t, row_group_size=n // 4, data_page_size=4096)
+    spy = _SpySource(raw)
+    pf = ParquetFile(spy)
+    pf.aggregate([top_k("p", 5)])
+    few = spy.bytes
+    spy2 = _SpySource(raw)
+    pf2 = ParquetFile(spy2)
+    pf2.read(columns=["p"])
+    # only pages still contending with the running k-th bound decode:
+    # far fewer data bytes move than a full column read
+    assert few < spy2.bytes // 2, (few, spy2.bytes)
+
+
+# ---------------------------------------------------------------------------
+# faults: atomic drops, deadlines, remote chaos
+# ---------------------------------------------------------------------------
+
+
+def _fixture_with_offsets(n=24_000):
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "v": pa.array(np.random.default_rng(2).random(n))})
+    raw = _write_ours(t, row_group_size=n // 4, data_page_size=4096)
+    meta = pq.ParquetFile(io.BytesIO(raw)).metadata
+    return t, raw, meta
+
+
+def test_corrupt_rg_drops_contribution_atomically():
+    from parquet_tpu import FaultInjectingSource
+
+    t, raw, meta = _fixture_with_offsets()
+    n = t.num_rows
+    off = meta.row_group(1).column(1).data_page_offset  # v of rg 1
+    src = FaultInjectingSource(BytesSource(raw),
+                               flip_offsets=[off, off + 1, off + 2])
+    rep = ReadReport()
+    pf = ParquetFile(src, policy=FaultPolicy(
+        backoff_s=0.0, on_corrupt="skip_row_group"))
+    res = pf.aggregate([count(), sum_("v"), min_("k"), max_("k")],
+                       report=rep)
+    rg_rows = n // 4
+    assert rep.row_groups_skipped == [1] and rep.rows_dropped == rg_rows
+    assert res.counters["rg_skipped_corrupt"] == 1
+    # the WHOLE row group dropped atomically: count excludes its rows
+    # even though count alone never touches the corrupt column
+    assert res["count(*)"] == n - rg_rows
+    v = t.column("v").to_numpy()
+    keep = np.ones(n, bool)
+    keep[rg_rows: 2 * rg_rows] = False
+    assert res["sum(v)"] == pytest.approx(float(v[keep].sum()), rel=1e-12)
+    # min/max of k likewise exclude the dropped group's span
+    assert res["min(k)"] == 0 and res["max(k)"] == n - 1
+    assert "SKIPPED" in res.explain()
+
+
+def test_corrupt_rg_without_skip_raises():
+    from parquet_tpu import FaultInjectingSource
+    from parquet_tpu.errors import ReadError
+
+    _t, raw, meta = _fixture_with_offsets()
+    off = meta.row_group(1).column(1).data_page_offset
+    src = FaultInjectingSource(BytesSource(raw),
+                               flip_offsets=[off, off + 1, off + 2])
+    pf = ParquetFile(src, policy=FaultPolicy(backoff_s=0.0))
+    with pytest.raises(ReadError):
+        pf.aggregate([sum_("v")])
+
+
+def test_deadline_propagates():
+    from parquet_tpu import FaultInjectingSource
+    from parquet_tpu.errors import DeadlineError
+
+    _t, raw, _meta = _fixture_with_offsets()
+    src = FaultInjectingSource(BytesSource(raw), latency_s=0.05)
+    pf = ParquetFile(src)  # open without a deadline; the CALL carries it
+    with pytest.raises(DeadlineError):
+        pf.aggregate([sum_("v")],
+                     policy=FaultPolicy(deadline_s=0.01, backoff_s=0.0))
+
+
+def test_transient_faults_recover_identically():
+    from parquet_tpu import FaultInjectingSource
+
+    t, raw, _meta = _fixture_with_offsets()
+    clean = ParquetFile(raw).aggregate(
+        [count(), sum_("v"), min_("v"), max_("v")],
+        where=col("k").between(100, 20_000))
+    for seed in range(4):
+        src = FaultInjectingSource(BytesSource(raw), seed=seed,
+                                   error_rate=0.2,
+                                   max_consecutive_errors=2)
+        pf = ParquetFile(src, policy=FaultPolicy(max_retries=5,
+                                                 backoff_s=0.0))
+        got = pf.aggregate([count(), sum_("v"), min_("v"), max_("v")],
+                           where=col("k").between(100, 20_000))
+        assert dict(got.items()) == dict(clean.items()), seed
+
+
+def test_remote_chaos_value_identical():
+    from parquet_tpu import (FaultInjectingRemoteTransport,
+                             LocalRangeServer)
+    from parquet_tpu.io.remote import HttpSource, HttpTransport
+
+    t, raw, _meta = _fixture_with_offsets()
+    clean = ParquetFile(raw).aggregate(
+        [count(), sum_("v"), min_("v"), max_("v"), count_distinct("k")],
+        where=col("k").between(500, 21_000))
+    os.environ["PARQUET_TPU_REMOTE_HEDGE"] = "0"
+    try:
+        with LocalRangeServer({"f.parquet": raw}) as srv:
+            url = srv.url("f.parquet")
+            pol = FaultPolicy(max_retries=5, backoff_s=0.0)
+            for inject in (dict(refuse_rate=0.3, max_consecutive=2),
+                           dict(status_rate=0.3, status_code=503,
+                                max_consecutive=2),
+                           dict(truncate_rate=0.3, max_consecutive=2),
+                           dict(wrong_range_rate=0.3, max_consecutive=2)):
+                tr = FaultInjectingRemoteTransport(HttpTransport(url),
+                                                  seed=7, **inject)
+                pf = ParquetFile(HttpSource(url, transport=tr), policy=pol)
+                got = pf.aggregate(
+                    [count(), sum_("v"), min_("v"), max_("v"),
+                     count_distinct("k")],
+                    where=col("k").between(500, 21_000))
+                assert dict(got.items()) == dict(clean.items()), inject
+    finally:
+        del os.environ["PARQUET_TPU_REMOTE_HEDGE"]
+
+
+# ---------------------------------------------------------------------------
+# remote parallel multi-range preads (PR 11 follow-on)
+# ---------------------------------------------------------------------------
+
+
+def test_parallel_preads_helper_identity_and_meter():
+    from parquet_tpu import LocalRangeServer
+    from parquet_tpu.io.remote import (HttpSource, parallel_preads,
+                                       parallel_pread_slots)
+    from parquet_tpu.obs.metrics import REGISTRY
+
+    _t, raw, _meta = _fixture_with_offsets()
+    with LocalRangeServer({"f.parquet": raw}) as srv:
+        hs = HttpSource(srv.url("f.parquet"))
+        assert parallel_pread_slots(hs) >= 2
+        ranges = [(0, 128), (4096, 64), (len(raw) - 256, 256)]
+        c0 = REGISTRY.counter("remote.parallel_preads").value
+        blocks = parallel_preads(hs, ranges, 4)
+        assert REGISTRY.counter("remote.parallel_preads").value - c0 == 3
+        for (off, sz), (boff, data) in zip(ranges, blocks):
+            assert boff == off and data == raw[off:off + sz]
+        # local sources never fan out
+        assert parallel_pread_slots(BytesSource(raw)) == 0
+
+
+def test_parallel_preads_chaos_and_knob_off():
+    from parquet_tpu import (FaultInjectingRemoteTransport,
+                             LocalRangeServer)
+    from parquet_tpu.io.remote import (HttpSource, HttpTransport,
+                                       parallel_pread_slots)
+    from parquet_tpu.obs.metrics import REGISTRY
+
+    _t, raw, _meta = _fixture_with_offsets()
+    os.environ["PARQUET_TPU_REMOTE_HEDGE"] = "0"
+    try:
+        with LocalRangeServer({"f.parquet": raw}) as srv:
+            url = srv.url("f.parquet")
+            # chaos: concurrent ranges recover byte-identically through
+            # the per-attempt policy retries
+            tr = FaultInjectingRemoteTransport(
+                HttpTransport(url), seed=3, reset_rate=0.3,
+                max_consecutive=2)
+            pf = ParquetFile(HttpSource(url, transport=tr),
+                             policy=FaultPolicy(max_retries=6,
+                                                backoff_s=0.0))
+            want = ParquetFile(raw).aggregate([sum_("v"), min_("k")])
+            got = pf.aggregate([sum_("v"), min_("k")])
+            assert dict(got.items()) == dict(want.items())
+            # knob off: no parallel fan-out happens
+            os.environ["PARQUET_TPU_REMOTE_PARALLEL"] = "0"
+            try:
+                hs = HttpSource(url)
+                assert parallel_pread_slots(hs) == 0
+                c0 = REGISTRY.counter("remote.parallel_preads").value
+                ParquetFile(hs).aggregate([sum_("v")])
+                assert REGISTRY.counter(
+                    "remote.parallel_preads").value == c0
+            finally:
+                del os.environ["PARQUET_TPU_REMOTE_PARALLEL"]
+    finally:
+        del os.environ["PARQUET_TPU_REMOTE_HEDGE"]
+
+
+def test_preloaded_source_serves_and_falls_through():
+    raw = bytes(range(256)) * 16
+    inner = _SpySource(raw)
+    src = PreloadedSource(inner, [(100, raw[100:200]), (1000, raw[1000:1100])])
+    assert src.pread(100, 100) == raw[100:200]
+    assert src.pread(120, 50) == raw[120:170]
+    assert inner.preads == 0
+    assert src.pread(500, 10) == raw[500:510]  # outside: falls through
+    assert inner.preads == 1
+    assert src.pread(150, 100) == raw[150:250]  # straddles: falls through
+    assert inner.preads == 2
+
+
+# ---------------------------------------------------------------------------
+# dataset + manifest answering
+# ---------------------------------------------------------------------------
+
+
+def test_dataset_aggregate_matches_per_file(tmp_path):
+    n = 10_000
+    parts = []
+    for i in range(4):
+        t = _mixed_table(n, nulls=(i % 2 == 1), seed=i)
+        p = tmp_path / f"part-{i}.parquet"
+        write_table(t, str(p), WriterOptions(row_group_size=n // 4))
+        parts.append(t)
+    ds = Dataset(str(tmp_path / "part-*.parquet"))
+    res = ds.aggregate([count(), count("v"), min_("k"), max_("k"),
+                        sum_("k"), count_distinct("s"), top_k("k", 5)],
+                       where=col("k").between(100, 8_000))
+    whole = pa.concat_tables(parts)
+    cols, _ = _naive(whole, None)
+    m = [100 <= v <= 8_000 for v in cols["k"]]
+    vals = _present(cols["k"], m)
+    svals = _present(cols["s"], m)
+    assert res["count(*)"] == sum(m)
+    assert res["min(k)"] == 100 and res["max(k)"] == 8_000
+    assert res["sum(k)"] == sum(vals)
+    assert res["count_distinct(s)"] == len(set(svals))
+    assert res["top_k(k,5)"] == sorted(vals, reverse=True)[:5]
+    ds.close()
+
+
+def test_dataset_aggregate_group_by_merges(tmp_path):
+    n = 6_000
+    parts = []
+    for i in range(3):
+        t = _mixed_table(n, seed=10 + i)
+        p = tmp_path / f"part-{i}.parquet"
+        write_table(t, str(p), WriterOptions(row_group_size=n // 2))
+        parts.append(t)
+    ds = Dataset(str(tmp_path / "part-*.parquet"))
+    res = ds.aggregate([count(), sum_("k")], group_by="s")
+    whole = pa.concat_tables(parts)
+    cols, _ = _naive(whole, None)
+    want = {}
+    for key, kv in zip(cols["s"], cols["k"]):
+        g = want.setdefault(key, [0, 0])
+        g[0] += 1
+        g[1] += kv
+    assert res.groups == sorted(want)
+    for i, k in enumerate(res.groups):
+        assert res["count(*)"][i] == want[k][0]
+        assert res["sum(k)"][i] == want[k][1]
+    ds.close()
+
+
+def test_manifest_zone_map_answers_without_footers(tmp_path):
+    from parquet_tpu import DatasetWriter, open_table
+    from parquet_tpu.io.writer import schema_from_arrow
+
+    n = 20_000
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "v": pa.array(np.random.default_rng(4).random(n))})
+    td = tmp_path / "table"
+    w = DatasetWriter(str(td), schema_from_arrow(t.schema),
+                      options=WriterOptions(), rows_per_file=n // 4)
+    for j in range(4):
+        w.write_arrow(t.slice(j * (n // 4), n // 4))
+        w.commit()
+    w.close()
+    tab = open_table(str(td))
+    res = tab.aggregate([count(), count("k"), min_("k"), max_("k")])
+    assert res["count(*)"] == n and res["max(k)"] == n - 1
+    assert res.counters["files_answered_manifest"] == 4, res.counters
+    # no file was ever opened for this query beyond the schema anchor
+    assert res.counters["rg_answered_stats"] == 0
+    # a selective predicate prunes the other parts from the manifest
+    res2 = tab.aggregate([count()], where=col("k").between(0, n // 4 - 1))
+    assert res2["count(*)"] == n // 4
+    assert res2.counters["files_answered_manifest"] == 4
+    tab.close()
+
+
+def test_dataset_degraded_file_skip(tmp_path):
+    n = 4_000
+    good = _mixed_table(n, seed=1)
+    for i in range(3):
+        write_table(good, str(tmp_path / f"part-{i}.parquet"),
+                    WriterOptions(row_group_size=n // 2))
+    bad = tmp_path / "part-3.parquet"
+    bad.write_bytes(b"PAR1 this is not a parquet file")
+    ds = Dataset(str(tmp_path / "part-*.parquet"))
+    rep = ReadReport()
+    res = ds.aggregate([count(), sum_("k")],
+                       policy=FaultPolicy(backoff_s=0.0,
+                                          on_corrupt="skip_row_group"),
+                       report=rep)
+    assert res["count(*)"] == 3 * n
+    assert res.counters["files_skipped"] == 1
+    assert rep.files_skipped and rep.files_skipped[0].endswith(
+        "part-3.parquet")
+    ds.close()
+
+
+# ---------------------------------------------------------------------------
+# observability + explain
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_and_explain_surface():
+    from parquet_tpu.obs.metrics import REGISTRY, metrics_snapshot
+
+    n = 16_000
+    t = _mixed_table(n)
+    raw = _write_ours(t, row_group_size=n // 4)
+    c0 = REGISTRY.counter("agg.rg_answered_stats").value
+    h0 = REGISTRY.histogram("agg.aggregate_s").count
+    pf = ParquetFile(raw)
+    res = pf.aggregate([count()], where=col("k").between(10**9, None))
+    assert REGISTRY.counter("agg.rg_answered_stats").value - c0 == 4
+    assert REGISTRY.histogram("agg.aggregate_s").count == h0 + 1
+    txt = res.explain()
+    assert "pruned by stats" in txt and "tiers:" in txt
+    snap = metrics_snapshot()
+    for fam in ("agg.rg_answered_stats", "agg.rg_answered_pages",
+                "agg.rg_answered_dict", "agg.rg_answered_decoded",
+                "agg.files_answered_manifest", "remote.parallel_preads",
+                "write.mmap_commits"):
+        assert fam in snap["counters"], fam
+    assert "agg.aggregate_s" in snap["histograms"]
+
+
+def test_cli_aggregate(tmp_path, capsys):
+    import json
+
+    from parquet_tpu.__main__ import main as cli_main
+
+    n = 9_000
+    t = _mixed_table(n)
+    p = tmp_path / "f.parquet"
+    write_table(t, str(p), WriterOptions(row_group_size=n // 3))
+    rc = cli_main(["aggregate", str(p), "--agg", "count",
+                   "--agg", "min:v", "--agg", "top:k:3",
+                   "--where", "k:100:5000"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["aggregates"]["count(*)"] == 4_901
+    assert doc["aggregates"]["top_k(k,3)"] == [5000, 4999, 4998]
+    rc = cli_main(["aggregate", str(p), "--agg", "count", "--group-by",
+                   "s"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert sum(doc["aggregates"]["count(*)"]) == n
+
+
+# ---------------------------------------------------------------------------
+# mmap write sink (carried-over follow-on)
+# ---------------------------------------------------------------------------
+
+
+def test_mmap_sink_byte_identity_and_crash_matrix(tmp_path, monkeypatch):
+    from parquet_tpu import crash_consistency_check, verify_file
+    from parquet_tpu.obs.metrics import REGISTRY
+
+    n = 20_000
+    t = _mixed_table(n, nulls=True, seed=6)
+    opts = WriterOptions(row_group_size=n // 4, bloom_filters={"s": 10})
+    base = tmp_path / "base.parquet"
+    write_table(t, str(base), opts)
+    raw = base.read_bytes()
+    monkeypatch.setenv("PARQUET_TPU_MMAP_SINK", "1")
+    c0 = REGISTRY.counter("write.mmap_commits").value
+    mp = tmp_path / "mmap.parquet"
+    w = write_table(t, str(mp), opts)
+    assert mp.read_bytes() == raw, "mmap sink changed the bytes"
+    assert w.write_stats.bytes_flushed == os.path.getsize(mp)
+    assert REGISTRY.counter("write.mmap_commits").value > c0
+    assert verify_file(str(mp), decode=True).ok
+    res = crash_consistency_check(
+        lambda sink: write_table(t, sink, opts),
+        str(tmp_path / "crash.parquet"), samples=6, seed=2)
+    assert res[-1]["outcome"] == "clean"
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_mmap_sink_abort_leaves_nothing(tmp_path, monkeypatch):
+    from parquet_tpu.io.sink import MmapFileSink
+
+    dest = tmp_path / "x.bin"
+    s = MmapFileSink(str(dest))
+    s.write(b"abc" * 1000)
+    s.abort()
+    assert not dest.exists()
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    with pytest.raises(ValueError):
+        s.close()
