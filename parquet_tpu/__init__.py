@@ -150,8 +150,8 @@ from .io.manifest import Manifest, ManifestEntry, read_manifest
 from .io.planner import (CostInputs, RouteDecision, ScanPlan, ScanPlanner,
                          choose_route, route_history)
 from .algebra.expr import And, Col, Expr, Not, Or, col
-from .algebra.aggregate import (AggExpr, count, count_distinct, max_, min_,
-                                sum_, top_k)
+from .algebra.aggregate import (AggExpr, avg, count, count_distinct, max_,
+                                min_, sum_, sum_sq, top_k, variance)
 from .io.aggregate import AggregateResult
 from .parallel.host_scan import (scan, scan_expr, scan_filtered,
                                  scan_filtered_device, scan_filtered_sharded)
@@ -172,6 +172,8 @@ from .obs import (OpScope, current_op, debugz_snapshot, disable_tracing,
                   metrics_delta, metrics_snapshot, op_scope,
                   pool_wait_seconds, render_prometheus, reset_metrics,
                   start_metrics_server, trace_span)
+from .utils.pool import TenantSpec, tenant_context
+from .serve import ServeConfig, Server
 
 __version__ = "0.1.0"
 

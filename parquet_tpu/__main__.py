@@ -28,7 +28,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet_tpu")
     p.add_argument("command",
                    choices=["meta", "schema", "pages", "head", "verify",
-                            "stats", "analyze", "aggregate"],
+                            "stats", "analyze", "aggregate", "serve"],
                    help="meta: file summary; schema: schema tree; pages: "
                         "page-level dump; head: first rows as JSON lines; "
                         "verify: end-to-end integrity check (exit 0 = every "
@@ -38,8 +38,13 @@ def main(argv=None) -> int:
                         "analyze: invariant lint + lockcheck hammer over "
                         "the package (exit 0 = clean, 1 = findings) — the "
                         "pre-merge correctness gate; aggregate: answer "
-                        "COUNT/MIN/MAX/SUM/DISTINCT/top-k from metadata "
-                        "without decoding where provable (io/aggregate.py)")
+                        "COUNT/MIN/MAX/SUM/AVG/VAR/DISTINCT/top-k from "
+                        "metadata without decoding where provable "
+                        "(io/aggregate.py); serve: run the long-lived "
+                        "serving daemon (parquet_tpu/serve) hosting "
+                        "configured datasets behind /v1/lookup|scan|"
+                        "aggregate|write + /metrics /healthz /debugz "
+                        "with multi-tenant QoS")
     p.add_argument("file", nargs="*",
                    help="parquet file path(s); verify accepts several and "
                         "shell-style globs, checked in parallel; stats "
@@ -66,12 +71,15 @@ def main(argv=None) -> int:
                         "dumping once — /metrics (Prometheus 0.0.4) and "
                         "/metrics.json; 0 binds an ephemeral port; runs "
                         "until interrupted")
-    p.add_argument("--host", default="127.0.0.1", metavar="ADDR",
-                   help="stats --serve: bind address (default loopback; "
-                        "0.0.0.0 to let a fleet Prometheus scrape it)")
+    p.add_argument("--host", default=None, metavar="ADDR",
+                   help="stats --serve / serve: bind address (default "
+                        "loopback for stats, the config's host for "
+                        "serve; 0.0.0.0 to let a fleet Prometheus "
+                        "scrape it)")
     p.add_argument("--agg", action="append", default=[], metavar="SPEC",
                    help="aggregate: one aggregate per flag — count, "
                         "count:COL, min:COL, max:COL, sum:COL, "
+                        "sum_sq:COL, avg:COL, var:COL[:sample], "
                         "distinct:COL, top:COL:K (repeatable)")
     p.add_argument("--where", default=None, metavar="COL:LO:HI",
                    help="aggregate: inclusive range predicate (empty "
@@ -87,6 +95,12 @@ def main(argv=None) -> int:
     p.add_argument("--no-hammer", action="store_true",
                    help="analyze: skip the lockcheck hammer subprocess "
                         "(lint + knob-table sync only)")
+    p.add_argument("--config", default=None, metavar="PATH",
+                   help="serve: the serve.json configuration (datasets "
+                        "to host + tenant QoS contracts)")
+    p.add_argument("--port", type=int, default=None, metavar="PORT",
+                   help="serve: override the config's port (0 binds an "
+                        "ephemeral port, printed at startup)")
     # intermixed: `verify --json a b` and `stats --prom` must both parse
     # now that `file` is optional (plain parse_args cannot place
     # positionals after an optional once nargs="*" matched zero)
@@ -97,6 +111,9 @@ def main(argv=None) -> int:
 
     if args.command == "aggregate":
         return _aggregate_cmd(args)
+
+    if args.command == "serve":
+        return _serve_cmd(args)
 
     if args.command == "stats":
         import json
@@ -132,7 +149,8 @@ def main(argv=None) -> int:
         if args.serve is not None:
             from .obs.export import start_metrics_server
 
-            srv = start_metrics_server(args.serve, host=args.host)
+            srv = start_metrics_server(args.serve,
+                                       host=args.host or "127.0.0.1")
             # line-buffered contract for scripts that scrape the port
             print(f"serving metrics on {srv.url} "
                   f"(and {srv.url}.json); Ctrl-C to stop", flush=True)
@@ -235,6 +253,42 @@ def main(argv=None) -> int:
     return 0
 
 
+def _serve_cmd(args) -> int:
+    """``python -m parquet_tpu serve --config serve.json [--port N]
+    [--host ADDR]``: run the serving daemon in the foreground until
+    SIGTERM/SIGINT, then drain in-flight requests
+    (``PARQUET_TPU_SERVE_DRAIN_S``) and exit 0."""
+    import signal
+    import threading
+
+    from .serve import Server, load_config
+
+    if not args.config:
+        print("parquet_tpu: serve requires --config serve.json",
+              file=sys.stderr)
+        return 1
+    try:
+        config = load_config(args.config)
+        # None = not passed -> the config's host wins; an explicit
+        # --host (loopback included) always overrides
+        srv = Server(config, host=args.host, port=args.port)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"parquet_tpu: {e}", file=sys.stderr)
+        return 1
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    # line-buffered contract for scripts that scrape the port
+    print(f"serving {len(config.datasets)} dataset(s) on {srv.url} "
+          f"(tenants: {', '.join(sorted(config.tenants)) or 'default'}); "
+          f"SIGTERM drains and exits", flush=True)
+    stop.wait()
+    drained = srv.close(drain=True)
+    print("drained and stopped" if drained
+          else "stopped with requests still in flight", flush=True)
+    return 0 if drained else 1
+
+
 def _parse_value(tok: str):
     """CLI predicate bound: int, then float, then the raw string (the
     predicate normalizer maps str → utf-8 bytes); empty = open bound."""
@@ -253,38 +307,18 @@ def _aggregate_cmd(args) -> int:
     COL:LO:HI] [--group-by COL] [--explain] [--json]``."""
     import json
 
-    from .algebra.aggregate import (count, count_distinct, max_, min_,
-                                    sum_, top_k)
     from .algebra.expr import col
     from .dataset import Dataset
     from .errors import CorruptedError
+    from .serve.codecs import parse_agg_spec
 
     if not args.file:
         print("parquet_tpu: aggregate requires a file", file=sys.stderr)
         return 1
     try:
-        usage = ("count, count:COL, min:COL, max:COL, sum:COL, "
-                 "distinct:COL, top:COL:K")
-        aggs = []
-        for spec in (args.agg or ["count"]):
-            parts = spec.split(":")
-            kind = parts[0]
-            if kind == "count":
-                aggs.append(count(parts[1] if len(parts) > 1 else None))
-            elif kind in ("min", "max", "sum", "distinct"):
-                if len(parts) < 2 or not parts[1]:
-                    raise ValueError(f"--agg {spec!r} needs a column "
-                                     f"({usage})")
-                fn = {"min": min_, "max": max_, "sum": sum_,
-                      "distinct": count_distinct}[kind]
-                aggs.append(fn(parts[1]))
-            elif kind == "top":
-                if len(parts) < 3 or not parts[1]:
-                    raise ValueError(f"--agg {spec!r} needs top:COL:K "
-                                     f"({usage})")
-                aggs.append(top_k(parts[1], int(parts[2])))
-            else:
-                raise ValueError(f"unknown --agg spec {spec!r} ({usage})")
+        # one spec grammar shared with the daemon's /v1/aggregate
+        # (serve/codecs.py) — the two front ends can never drift
+        aggs = [parse_agg_spec(spec) for spec in (args.agg or ["count"])]
         where = None
         if args.where is not None:
             path, lo, hi = (args.where.split(":", 2) + ["", ""])[:3]

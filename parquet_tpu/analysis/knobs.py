@@ -134,6 +134,23 @@ declare("PARQUET_TPU_REMOTE_BREAKER", "int", 5,
 declare("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", "float", 1.0,
         "seconds an open circuit waits before its half-open probe")
 
+# ------------------------------------------------------------------- remote
+declare("PARQUET_TPU_REMOTE_AUTH_RETRY", "int", 1,
+        "credential refreshes attempted on a 401/403 remote response "
+        "before it surfaces (auth hook re-invoked with refresh=True); "
+        "0 disables the refresh path")
+
+# ------------------------------------------------------------------ serving
+declare("PARQUET_TPU_SERVE_DRAIN_S", "float", 10.0,
+        "seconds a graceful daemon shutdown (SIGTERM / Server.close) "
+        "waits for in-flight requests before giving up")
+declare("PARQUET_TPU_SERVE_RETRY_AFTER_S", "float", 1.0,
+        "Retry-After seconds a shed 429 advertises to bulk-class "
+        "requests under hard memory pressure")
+declare("PARQUET_TPU_SERVE_MAX_BODY", "bytes", 64 << 20,
+        "serving-daemon request-body cap in bytes (larger bodies are "
+        "refused 413 before buffering)")
+
 # ------------------------------------------------------------ observability
 declare("PARQUET_TPU_TRACE", "str", "",
         "enable span tracing and flush Chrome trace-event JSON to this "

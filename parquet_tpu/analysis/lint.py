@@ -66,6 +66,7 @@ RULES = {
 LEDGER_OWNERS = {
     "cache.chunk": "io/cache.py",
     "cache.page": "io/cache.py",
+    "cache.page_pinned": "io/cache.py",
     "cache.footer": "io/cache.py",
     "cache.neg_lookup": "io/cache.py",
     "prefetch.ring": "io/prefetch.py",

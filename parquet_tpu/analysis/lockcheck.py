@@ -104,7 +104,10 @@ def _hammer_workload(tmpdir: str) -> None:
     """A deliberately mixed, concurrent workload touching every
     converted lock family: writer (buffered, overlapped), footer/chunk/
     page caches, prefetch ring, admission gate (budgeted), ledger,
-    metrics, scopes, batched lookups, and a table ingest + compact."""
+    metrics, scopes, batched lookups, a table ingest + compact, and the
+    serving daemon under a mixed-tenant hammer (lookup ∥ scan ∥ write ∥
+    compaction through HTTP handler threads — the interleavings the
+    daemon's QoS scheduler, pin region, and drain machinery add)."""
     import os
 
     import numpy as np
@@ -150,9 +153,81 @@ def _hammer_workload(tmpdir: str) -> None:
         pq.compact_table(tdir)
         ds = pq.open_table(tdir)
         ds.read()
+        _serve_hammer(tmpdir, path, tdir)
     finally:
         os.environ.pop("PARQUET_TPU_READ_BUDGET", None)
         os.environ.pop("PARQUET_TPU_PREFETCH", None)
+
+
+def _serve_hammer(tmpdir: str, file_path: str, table_dir: str) -> None:
+    """Boot the daemon in-process with two tenants and fire a mixed
+    lookup ∥ scan ∥ aggregate ∥ write ∥ compaction load from concurrent
+    client threads, then drain — the daemon's thread interleavings
+    (handler threads × pool workers × compactor × QoS gate × pin
+    region) must keep the lock graph cycle-free."""
+    import json
+    import threading
+    import urllib.request
+
+    import parquet_tpu as pq
+    from parquet_tpu.serve import Server
+
+    cfg = {"datasets": {"events": {"paths": [file_path]},
+                        "tbl": {"table": table_dir, "writable": True,
+                                "sorting": "k"}},
+           "tenants": {"online": {"class": "latency", "weight": 2.0,
+                                  "budget_bytes": 4 << 20,
+                                  "pin_bytes": 1 << 20},
+                       "batch": {"class": "bulk",
+                                 "budget_bytes": 2 << 20}}}
+
+    def post(url, doc, tenant):
+        req = urllib.request.Request(
+            url, data=json.dumps(doc).encode(),
+            headers={"X-Tenant": tenant})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.read()
+
+    with Server(cfg, port=0) as srv:
+        u = srv.url
+        errors: list = []
+
+        def client(i: int) -> None:
+            try:
+                if i % 4 == 0:
+                    post(u + "/v1/lookup",
+                         {"dataset": "events", "column": "k",
+                          "keys": list(range(i * 5, i * 5 + 32)),
+                          "columns": ["v"]}, "online")
+                elif i % 4 == 1:
+                    post(u + "/v1/scan",
+                         {"dataset": "events",
+                          "where": {"col": "v", "ge": 1 << 29}},
+                         "batch")
+                elif i % 4 == 2:
+                    post(u + "/v1/aggregate",
+                         {"dataset": "events",
+                          "aggs": ["count", "avg:v"]}, "online")
+                else:
+                    post(u + "/v1/write",
+                         {"dataset": "tbl",
+                          "rows": {"k": [100_000 + i], "v": [i]}},
+                         "batch")
+            # ptlint: disable=PT005 -- not swallowed: collected into the
+            # errors list and re-raised after the join below
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        # compaction racing the serving traffic
+        pq.compact_table(table_dir)
+        for t in threads:
+            t.join(60)
+        if errors:
+            raise errors[0]
 
 
 def hammer_main(argv: Optional[list] = None) -> int:

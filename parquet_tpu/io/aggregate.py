@@ -48,7 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..algebra.aggregate import AggExpr
+from ..algebra.aggregate import DERIVED_KINDS, AggExpr
 from ..algebra.expr import TRUE, Const, Expr, prepare
 from ..errors import CorruptedError, DeadlineError
 from ..format.enums import Type
@@ -216,7 +216,8 @@ class _Acc:
         counts as a present value."""
         kind = self.agg.kind
         isarr = isinstance(vals, np.ndarray)
-        if isarr and vals.dtype.kind == "f" and kind != "sum":
+        if isarr and vals.dtype.kind == "f" \
+                and kind not in ("sum", "sum_sq"):
             vals = vals[~np.isnan(vals)]  # NaN skipped (stats convention)
         if not isarr:
             vals = [v for v in vals if v is not None]
@@ -244,6 +245,17 @@ class _Acc:
                     self.add_sum(sum(vals.tolist()))
             else:
                 self.add_sum(sum(vals))  # decimal unscaled ints
+        elif kind == "sum_sq":
+            if isarr and vals.dtype.kind == "f":
+                v = vals.astype(np.float64, copy=False)
+                self.add_sum(float(np.dot(v, v)))
+            elif isarr and vals.dtype.kind == "b":
+                self.add_sum(int(np.count_nonzero(vals)))  # 1² == 1
+            else:
+                # integer domains: python-int squares, exact at any
+                # magnitude (an int64 dot can wrap at uint16²×2^31)
+                vals = vals.tolist() if isarr else vals
+                self.add_sum(sum(int(x) * int(x) for x in vals))
         elif kind == "count_distinct":
             if isarr:
                 self.distinct.update(np.unique(vals).tolist())
@@ -279,7 +291,7 @@ class _Acc:
             return self.n
         if kind in ("min", "max"):
             return self.cur
-        if kind == "sum":
+        if kind in ("sum", "sum_sq"):
             return self.total
         if kind == "count_distinct":
             return len(self.distinct)
@@ -691,7 +703,8 @@ def _contrib_full(pf, rg, reader: _RgReader, acc: _Acc) -> None:
         if nv is not None and nulls is not None and nulls >= nv:
             return  # all-null chunk: nothing to contribute
     # ---- dictionary tier
-    if agg.kind in ("min", "max", "sum", "count_distinct", "count"):
+    if agg.kind in ("min", "max", "sum", "sum_sq", "count_distinct",
+                    "count"):
         col = reader.dict_column(leaf)
         if col is not None:
             _dict_contrib(acc, leaf, col)
@@ -718,15 +731,17 @@ def _dict_contrib(acc: _Acc, leaf, col) -> None:
     entries = _dict_order_entries(leaf, col._host_dictionary())
     if len(idx) == 0:
         return
-    if agg.kind == "sum":
+    if agg.kind in ("sum", "sum_sq"):
+        sq = agg.kind == "sum_sq"
         counts = np.bincount(idx, minlength=len(entries))
         if isinstance(entries, np.ndarray) and entries.dtype.kind == "f":
+            e = np.asarray(entries, np.float64)
             acc.add_sum(float(np.dot(counts.astype(np.float64),
-                                     np.asarray(entries, np.float64))))
+                                     e * e if sq else e)))
         else:
             ent = entries.tolist() if isinstance(entries, np.ndarray) \
                 else entries
-            acc.add_sum(sum(int(c) * int(v)
+            acc.add_sum(sum(int(c) * (int(v) * int(v) if sq else int(v))
                             for c, v in zip(counts.tolist(), ent) if c))
         return
     used = np.unique(idx)
@@ -1054,13 +1069,18 @@ def _validate(pf_schema, aggs, group_by) -> Tuple[list, object]:
         if leaf.max_repetition_level > 0:
             raise ValueError(f"column {a.path!r} is nested; aggregate "
                              "handles flat columns")
-        if a.kind == "sum":
+        if a.derived:  # expanded by the entry points before validation
+            raise ValueError(
+                f"{a.name} is a derived aggregate; evaluate it through "
+                "ParquetFile.aggregate/Dataset.aggregate (which expand "
+                "it over its base folds)")
+        if a.kind in ("sum", "sum_sq"):
             numeric = leaf.physical_type in (
                 Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE,
                 Type.BOOLEAN)
             if not numeric and leaf.logical_kind != LogicalKind.DECIMAL:
                 raise ValueError(
-                    f"sum({a.path}) is not defined for "
+                    f"{a.name} is not defined for "
                     f"{leaf.physical_type.name} (non-decimal)")
         leaves.append(leaf)
     gleaf = None
@@ -1090,15 +1110,85 @@ def _sort_group_keys(keys) -> list:
         + ([None] if any(k is None for k in keys) else [])
 
 
-def _finalize(aggs, accs, groups, counters, lines, report):
+def _expand_derived(aggs):
+    """Expand derived aggregates (avg/variance) into the deduplicated
+    BASE list the cascade evaluates, plus the fold plan mapping each
+    ORIGINAL agg to its base positions.  Returns ``(base_aggs, plan)``;
+    ``plan`` is None when nothing was derived (the zero-cost path)."""
+    aggs = list(aggs)
+    if not any(isinstance(a, AggExpr) and a.derived for a in aggs):
+        return aggs, None
+    base: list = []
+    index: dict = {}
+
+    def want(node: AggExpr) -> int:
+        got = index.get(node.name)
+        if got is None:
+            got = index[node.name] = len(base)
+            base.append(node)
+        return got
+
+    plan = []
+    for a in aggs:
+        if not a.derived:
+            plan.append(("base", want(a), None, a.name))
+        else:
+            parts = tuple(want(AggExpr(k, a.path))
+                          for k in DERIVED_KINDS[a.kind])
+            plan.append((a.kind, parts, a.ddof, a.name))
+    return base, plan
+
+
+def _derive_value(kind: str, vals, ddof):
+    """One derived fold: ``avg`` over (count, sum); ``variance`` over
+    (count, sum, sum-of-squares) — ``(Σx² − (Σx)²/n) / (n − ddof)``.
+    None over zero (or, with Bessel, one) matching non-null rows; NaN
+    sums propagate (matching the naive fold over values with NaN)."""
+    if kind == "avg":
+        n, s = vals
+        if not n or s is None:
+            return None
+        return s / n
+    n, s, sq = vals
+    if not n or n - (ddof or 0) <= 0 or s is None or sq is None:
+        return None
+    n, s, sq = float(n), float(s), float(sq)
+    v = (sq - s * s / n) / (n - (ddof or 0))
+    # float cancellation can leave a tiny negative on a constant
+    # column; true variance is never negative (NaN propagates)
+    return max(v, 0.0) if v == v else v
+
+
+def _apply_plan(plan, base_aggs, data: dict, grouped: bool) -> dict:
+    """Map base results into the ORIGINAL request's result keys,
+    computing the derived folds (element-wise over group lists)."""
+    if plan is None:
+        return data
+    out = {}
+    for kind, ref, ddof, name in plan:
+        if kind == "base":
+            out[name] = data[base_aggs[ref].name]
+            continue
+        cols = [data[base_aggs[i].name] for i in ref]
+        if grouped:
+            out[name] = [_derive_value(kind, vals, ddof)
+                         for vals in zip(*cols)]
+        else:
+            out[name] = _derive_value(kind, tuple(cols), ddof)
+    return out
+
+
+def _finalize(aggs, accs, groups, counters, lines, report, plan=None):
     if groups is None:
         data = {a.name: acc.result() for a, acc in zip(aggs, accs)}
-        out = AggregateResult(data, None, counters, lines)
+        out = AggregateResult(_apply_plan(plan, aggs, data, False),
+                              None, counters, lines)
     else:
         keys = _sort_group_keys(list(groups))
         data = {a.name: [groups[k][i].result() for k in keys]
                 for i, a in enumerate(aggs)}
-        out = AggregateResult(data, keys, counters, lines)
+        out = AggregateResult(_apply_plan(plan, aggs, data, True),
+                              keys, counters, lines)
     out.report = report
     return out
 
@@ -1141,9 +1231,14 @@ def aggregate_file(pf, aggs: Sequence[AggExpr], where=None, group_by=None,
     SETS a cross-file COUNT DISTINCT needs)."""
     from .faults import resolve_policy
 
+    # derived aggregates (avg/variance) expand into their base folds
+    # here, at the public face — the cascade itself only ever sees base
+    # kinds (a _state_only caller passes base aggs; re-expansion is a
+    # no-op returning plan=None)
+    aggs, plan = _expand_derived(aggs)
     t0 = time.perf_counter()
     with _oscope.maybe_op_scope("file.aggregate", file=pf._path,
-                                aggs=len(list(aggs))):
+                                aggs=len(aggs)):
         try:
             pol, report = resolve_policy(pf, policy, report)
             with pf._resilient_op(policy, report, "aggregate"):
@@ -1155,7 +1250,8 @@ def aggregate_file(pf, aggs: Sequence[AggExpr], where=None, group_by=None,
     _publish(counters)
     if _state_only:
         return state
-    return _finalize(aggs_l, accs, groups, counters, lines, report)
+    return _finalize(aggs_l, accs, groups, counters, lines, report,
+                     plan=plan)
 
 
 def _aggregate_impl(pf, aggs, where, group_by, pol, report, _prepared):
@@ -1276,11 +1372,11 @@ def _prewarm_ranges(pf, rg, expr, aggs, leaves, gleaf, covered: bool,
                 if _exact_stats(leaf) and v is not None and v == v:
                     continue  # answered from stats
                 want(leaf, full)
-            elif a.kind in ("sum", "count_distinct"):
+            elif a.kind in ("sum", "sum_sq", "count_distinct"):
                 want(leaf, full)
             # top_k: heap-gated page visits — leave to the serial path
         else:
-            if a.kind in ("sum", "count_distinct"):
+            if a.kind in ("sum", "sum_sq", "count_distinct"):
                 want(leaf, may)
             elif a.kind in ("count", "min", "max"):
                 # covered intervals answer from page bounds; only the
@@ -1359,7 +1455,7 @@ def _dataset_aggregate_impl(ds, aggs, where, group_by, policy, report):
     if not ds.paths:
         raise ValueError("aggregate on an empty dataset shard; check "
                          "num_files first")
-    aggs = list(aggs)
+    aggs, plan = _expand_derived(aggs)
     pol, report, skip = ds._resolve(policy, report)
     expr = _as_where(where)
     schema = ds.schema  # opens the first parsable footer
@@ -1448,7 +1544,8 @@ def _dataset_aggregate_impl(ds, aggs, where, group_by, policy, report):
     if counters["files_answered_manifest"]:
         _oscope.account(_M_FILES_MANIFEST,
                         counters["files_answered_manifest"])
-    return _finalize(aggs, accs, groups, counters, lines, report)
+    return _finalize(aggs, accs, groups, counters, lines, report,
+                     plan=plan)
 
 
 def _manifest_answer(ent, aggs, leaves, accs) -> bool:

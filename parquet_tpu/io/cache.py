@@ -52,11 +52,13 @@ the LRU tiers shrink evict-to-fraction until the total fits again.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import os
 from collections import OrderedDict
+from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -72,7 +74,8 @@ __all__ = ["CacheStats", "FooterCache", "ChunkCache", "PageCache",
            "NegLookupCache", "PageEntry", "cache_stats", "clear_caches",
            "chunk_cache_bytes", "footer_cache_entries", "page_cache_bytes",
            "neg_lookup_cache_bytes", "column_nbytes", "freeze_column",
-           "invalidate_path", "FOOTERS", "CHUNKS", "PAGES", "NEGS"]
+           "invalidate_path", "page_pin_scope", "current_pin",
+           "FOOTERS", "CHUNKS", "PAGES", "NEGS"]
 
 # capacity defaults live in the knob registry (analysis/knobs.py) —
 # the accessor supplies them; a second copy here would drift
@@ -100,6 +103,11 @@ _M_PAGE_ENTRIES = _gauge("cache.page_entries",
                          help="decoded pages resident in the page LRU")
 _M_PAGE_BYTES = _gauge("cache.page_bytes",
                        help="decoded bytes resident in the page LRU")
+_M_PAGE_PINS = _counter("cache.page_pins")
+_M_PAGE_PIN_REFUSALS = _counter("cache.page_pin_refusals")
+_M_PAGE_PINNED_BYTES = _gauge("cache.page_pinned_bytes",
+                              help="decoded bytes pinned by tenants "
+                                   "(eviction-exempt)")
 
 
 def chunk_cache_bytes() -> int:
@@ -144,9 +152,45 @@ def _top_entries(items, n: int) -> list:
 # and the capacity gauges track the live env knobs.
 _ACC_CHUNK = ledger_account("cache.chunk", capacity=chunk_cache_bytes)
 _ACC_PAGE = ledger_account("cache.page", capacity=page_cache_bytes)
+_ACC_PINNED = ledger_account("cache.page_pinned")
 _ACC_FOOTER = ledger_account("cache.footer")
 _ACC_NEG = ledger_account("cache.neg_lookup",
                           capacity=neg_lookup_cache_bytes)
+
+# ---------------------------------------------------------------------------
+# Tenant hot-key pinning (the serving daemon's page-residency contract)
+# ---------------------------------------------------------------------------
+
+# the active (tenant, pin-cap-bytes) — a context variable, so pins follow
+# a request's work onto pool workers exactly like its op scope does
+_PIN: "contextvars.ContextVar[Optional[Tuple[str, int]]]" = \
+    contextvars.ContextVar("parquet_tpu_page_pin", default=None)
+
+
+def current_pin() -> "Optional[Tuple[str, int]]":
+    """The active ``(tenant, cap_bytes)`` pin contract, or None."""
+    return _PIN.get()
+
+
+@contextmanager
+def page_pin_scope(tenant: str, cap_bytes: int):
+    """Run a block with page-cache pinning for ``tenant``: every decoded
+    page the block's lookups land in the page cache is PINNED — exempt
+    from LRU and soft-pressure eviction — until the tenant's pinned
+    bytes reach ``cap_bytes`` (further pages fall back to the normal
+    LRU, counted in ``cache.page_pin_refusals``).  The serving daemon
+    wraps latency-class tenants' lookups in one so their hot keys stay
+    resident no matter what a bulk scan pushes through the LRU; pinned
+    bytes are charged to the ``cache.page_pinned`` ledger account and
+    released by :meth:`PageCache.unpin_tenant`."""
+    if cap_bytes <= 0:
+        yield
+        return
+    token = _PIN.set((tenant, int(cap_bytes)))
+    try:
+        yield
+    finally:
+        _PIN.reset(token)
 
 
 @dataclass
@@ -171,6 +215,9 @@ class CacheStats:
     page_entries: int = 0
     page_bytes: int = 0
     page_capacity: int = 0
+    page_pins: int = 0
+    page_pin_refusals: int = 0
+    page_pinned_bytes: int = 0
 
     def as_dict(self) -> dict:
         return {"footer_hits": self.footer_hits,
@@ -187,7 +234,10 @@ class CacheStats:
                 "page_evictions": self.page_evictions,
                 "page_entries": self.page_entries,
                 "page_bytes": self.page_bytes,
-                "page_capacity": self.page_capacity}
+                "page_capacity": self.page_capacity,
+                "page_pins": self.page_pins,
+                "page_pin_refusals": self.page_pin_refusals,
+                "page_pinned_bytes": self.page_pinned_bytes}
 
 
 def _buf_nbytes(a: Any) -> int:
@@ -507,17 +557,35 @@ class PageCache:
     lookup path (io/lookup.py) where whole-chunk materialization is
     exactly the cost the path exists to avoid.  Same contracts as
     :class:`ChunkCache`: entries frozen, an item larger than half the cap
-    refused, eviction size-aware and global."""
+    refused, eviction size-aware and global.
+
+    **Tenant pinning** (:func:`page_pin_scope`): a second, eviction-
+    exempt region keyed like the LRU but charged to the pinning tenant.
+    Pinned entries serve ``get`` first, never move on pressure or cap
+    eviction, and count against the tenant's pin cap instead of the LRU
+    cap (``cache.page_pinned`` ledger account; refusals beyond the cap
+    land in the normal LRU and ``cache.page_pin_refusals``).
+    :meth:`unpin_tenant` demotes a tenant's pins back into the LRU at
+    MRU position."""
 
     def __init__(self, stats: CacheStats):
         self._lock = make_lock("cache.page")
         self._entries: "OrderedDict[tuple, Tuple[PageEntry, int]]" = \
             OrderedDict()
         self._bytes = 0
+        # pinned region: key -> (entry, nbytes, tenant); per-tenant byte
+        # totals enforce each pin cap exactly
+        self._pinned: "Dict[tuple, Tuple[PageEntry, int, str]]" = {}
+        self._pin_bytes: "Dict[str, int]" = {}
         self.stats = stats
 
     def get(self, key) -> Optional[PageEntry]:
         with self._lock:
+            pinned = self._pinned.get(key)
+            if pinned is not None:
+                self.stats.page_hits += 1
+                _account(_M_PAGE_HITS)
+                return pinned[0]
             got = self._entries.get(key)
             if got is None:
                 self.stats.page_misses += 1
@@ -528,19 +596,98 @@ class PageCache:
             _account(_M_PAGE_HITS)
             return got[0]
 
+    def pinned_bytes(self, tenant: Optional[str] = None) -> int:
+        """Bytes currently pinned — by ``tenant``, or in total."""
+        with self._lock:
+            if tenant is not None:
+                return self._pin_bytes.get(tenant, 0)
+            return sum(self._pin_bytes.values())
+
+    def unpin_tenant(self, tenant: str) -> int:
+        """Demote every page ``tenant`` pinned back into the normal LRU
+        (MRU position — they were hot) and release the tenant's pinned-
+        byte charge; returns the number of entries demoted.  The serving
+        daemon calls this when a tenant's pin contract ends."""
+        demoted = 0
+        cap = page_cache_bytes()
+        with self._lock:
+            for key in [k for k, v in self._pinned.items()
+                        if v[2] == tenant]:
+                entry, nb, _t = self._pinned.pop(key)
+                demoted += 1
+                if cap > 0 and nb <= cap // 2:
+                    old = self._entries.pop(key, None)
+                    if old is not None:
+                        self._bytes -= old[1]
+                    self._entries[key] = (entry, nb)
+                    self._bytes += nb
+            self._pin_bytes.pop(tenant, None)
+            while cap > 0 and self._bytes > cap and self._entries:
+                _, (_, evicted_nb) = self._entries.popitem(last=False)
+                self._bytes -= evicted_nb
+                self.stats.page_evictions += 1
+                _account(_M_PAGE_EVICTIONS)
+            self._publish_locked(cap)
+        return demoted
+
+    def _publish_locked(self, cap: int) -> None:
+        # under self._lock: the gauges + ledger accounts move inside the
+        # same critical section as the bytes (no stale-gauge window)
+        pinned_total = sum(self._pin_bytes.values())
+        self.stats.page_entries = len(self._entries) + len(self._pinned)
+        self.stats.page_bytes = self._bytes
+        self.stats.page_capacity = cap
+        self.stats.page_pinned_bytes = pinned_total
+        _M_PAGE_ENTRIES.set(len(self._entries) + len(self._pinned))
+        _M_PAGE_BYTES.set(self._bytes)
+        _M_PAGE_PINNED_BYTES.set(pinned_total)
+        _ACC_PAGE.set(self._bytes)
+        _ACC_PINNED.set(pinned_total)
+
     def put(self, key, values, validity, first_row: int,
             num_rows: int) -> Optional[PageEntry]:
         """Freeze and store one decoded page span; returns the frozen
         :class:`PageEntry` (what the caller should use and hand out), or
-        ``None`` when refused (cache off, oversized item)."""
+        ``None`` when refused (cache off, oversized item).  Inside an
+        active :func:`page_pin_scope` the entry lands PINNED when the
+        tenant's cap allows (eviction-exempt; refusals fall back to the
+        normal LRU)."""
         cap = page_cache_bytes()
         entry = make_page_entry(values, validity, first_row, num_rows)
+        nb = entry.nbytes()
+        pin = _PIN.get()
+        if pin is not None:
+            tenant, pin_cap = pin
+            pinned = False
+            with self._lock:
+                if key in self._pinned:
+                    return self._pinned[key][0]  # already pinned
+                if self._pin_bytes.get(tenant, 0) + nb <= pin_cap:
+                    old = self._entries.pop(key, None)
+                    if old is not None:
+                        self._bytes -= old[1]
+                    self._pinned[key] = (entry, nb, tenant)
+                    self._pin_bytes[tenant] = \
+                        self._pin_bytes.get(tenant, 0) + nb
+                    self.stats.page_pins += 1
+                    _account(_M_PAGE_PINS)
+                    self._publish_locked(cap)
+                    pinned = True
+                else:
+                    # over the tenant's pin cap: REFUSED as a pin (the
+                    # cap is the contract) — falls to the normal LRU
+                    self.stats.page_pin_refusals += 1
+                    _account(_M_PAGE_PIN_REFUSALS)
+            if pinned:
+                _maybe_pressure()  # pins grow the ledger like any tier
+                return entry
         if cap <= 0:
             return entry  # frozen but uncached: one mutability contract
-        nb = entry.nbytes()
         if nb > cap // 2:
             return entry
         with self._lock:
+            if key in self._pinned:
+                return self._pinned[key][0]  # pinned copy already serves
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
@@ -551,26 +698,26 @@ class PageCache:
                 self._bytes -= evicted_nb
                 self.stats.page_evictions += 1
                 _account(_M_PAGE_EVICTIONS)
-            self.stats.page_entries = len(self._entries)
-            self.stats.page_bytes = self._bytes
-            self.stats.page_capacity = cap
-            _M_PAGE_ENTRIES.set(len(self._entries))
-            _M_PAGE_BYTES.set(self._bytes)
-            _ACC_PAGE.set(self._bytes)
+            self._publish_locked(cap)
         _maybe_pressure()
         return entry
 
     def top_entries(self, n: int = 10) -> list:
         """Largest resident pages by bytes — the /debugz residency view
-        (keys are (file, row group, column, page ordinal, crc) tuples)."""
+        (keys are (file, row group, column, page ordinal, crc) tuples;
+        pinned entries included)."""
         with self._lock:
             items = [(k, nb) for k, (_, nb) in self._entries.items()]
+            items += [(k, nb) for k, (_, nb, _t) in self._pinned.items()]
         return _top_entries(items, n)
 
     def shrink_to(self, target_bytes: int) -> int:
-        """Evict LRU-first until resident bytes <= ``target_bytes`` (the
-        soft-pressure response); returns entries evicted."""
+        """Evict LRU-first until UNPINNED resident bytes <=
+        ``target_bytes`` (the soft-pressure response); returns entries
+        evicted.  Pinned entries are exempt — that is the pin contract
+        (their bytes answer to the tenant's cap, not the LRU's)."""
         evicted = 0
+        cap = page_cache_bytes()
         with self._lock:
             while self._bytes > max(0, target_bytes) and self._entries:
                 _, (_, nb) = self._entries.popitem(last=False)
@@ -579,23 +726,24 @@ class PageCache:
             if evicted:
                 self.stats.page_evictions += evicted
                 _account(_M_PAGE_EVICTIONS, evicted)
-                self.stats.page_entries = len(self._entries)
-                self.stats.page_bytes = self._bytes
-                _M_PAGE_ENTRIES.set(len(self._entries))
-                _M_PAGE_BYTES.set(self._bytes)
-                _ACC_PAGE.set(self._bytes)
+                self._publish_locked(cap)
         return evicted
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._pinned.clear()
+            self._pin_bytes.clear()
             self._bytes = 0
             self.stats.page_entries = 0
             self.stats.page_bytes = 0
+            self.stats.page_pinned_bytes = 0
             _M_PAGE_ENTRIES.set(0)
             _M_PAGE_BYTES.set(0)
+            _M_PAGE_PINNED_BYTES.set(0)
             # same critical section: no stale-gauge window
             _ACC_PAGE.set(0)
+            _ACC_PINNED.set(0)
 
 
 def _key_nbytes(k) -> int:
@@ -760,11 +908,13 @@ def invalidate_path(path: str) -> None:
         for key in [k for k in PAGES._entries if k[0][0] == ap]:
             _, nb = PAGES._entries.pop(key)
             PAGES._bytes -= nb
-        PAGES.stats.page_entries = len(PAGES._entries)
-        PAGES.stats.page_bytes = PAGES._bytes
-        _M_PAGE_ENTRIES.set(len(PAGES._entries))
-        _M_PAGE_BYTES.set(PAGES._bytes)
-        _ACC_PAGE.set(PAGES._bytes)
+        # pinned entries of a rewritten file are stale too: a pin holds
+        # residency, never correctness
+        for key in [k for k in PAGES._pinned if k[0][0] == ap]:
+            _, nb, tenant = PAGES._pinned.pop(key)
+            PAGES._pin_bytes[tenant] = \
+                PAGES._pin_bytes.get(tenant, 0) - nb
+        PAGES._publish_locked(page_cache_bytes())
     NEGS.invalidate_path(ap)
 
 

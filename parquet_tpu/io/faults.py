@@ -714,19 +714,21 @@ class FaultInjectingRemoteTransport:
     def host(self):
         return getattr(self.inner, "host", None)
 
-    def head(self):
+    def head(self, **kw):
         if self.head_refuse:
             with self._lock:
                 self.stats.refused += 1
             raise ConnectionRefusedError(
                 errno.ECONNREFUSED, "injected connect refused (HEAD)")
-        return self.inner.head()
+        # auth kwargs (extra_headers/path_override) pass through so the
+        # 401→refresh path is chaos-coverable like any other
+        return self.inner.head(**kw) if kw else self.inner.head()
 
     def _error_injected(self, key, n: int = 1) -> None:
         with self._lock:
             self._consecutive[key] = self._consecutive.get(key, 0) + n
 
-    def get_range(self, offset: int, size: int):
+    def get_range(self, offset: int, size: int, **kw):
         key = (offset, size)
         with self._lock:
             self.stats.requests += 1
@@ -773,7 +775,9 @@ class FaultInjectingRemoteTransport:
             if self.retry_after is not None:
                 hdrs["retry-after"] = str(self.retry_after)
             return 429, hdrs, b""
-        status, headers, body = self.inner.get_range(offset, size)
+        status, headers, body = (self.inner.get_range(offset, size, **kw)
+                                 if kw
+                                 else self.inner.get_range(offset, size))
         injected_body_fault = False
         if can_inject and self.wrong_range_rate \
                 and rng.random() < self.wrong_range_rate and status == 206:
@@ -829,7 +833,8 @@ class LocalRangeServer:
     never"."""
 
     def __init__(self, files: Optional[dict] = None,
-                 ignore_range: bool = False, send_validators: bool = True):
+                 ignore_range: bool = False, send_validators: bool = True,
+                 auth_token: Optional[str] = None):
         import hashlib
         from email.utils import formatdate
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -840,6 +845,10 @@ class LocalRangeServer:
         self._mtime: Dict[str, float] = {}
         self.ignore_range = ignore_range
         self.send_validators = send_validators
+        # auth_token: requests must carry "Authorization: Bearer <tok>"
+        # or get 401 — the private-bucket fixture; set_auth_token()
+        # rotates it (the stale-credential → 401 → refresh path)
+        self._auth_token = auth_token
         self.requests: List[Tuple[str, str, Optional[str]]] = []
         self._hash = lambda b: hashlib.md5(b).hexdigest()
         self._fmtdate = formatdate
@@ -858,7 +867,9 @@ class LocalRangeServer:
                 pass
 
             def _lookup(self):
-                name = self.path.lstrip("/")
+                # query strings (presigned-URL signatures) address the
+                # same object, like a real object store
+                name = self.path.split("?", 1)[0].lstrip("/")
                 with server._lock:
                     data = server._files.get(name)
                     meta = (server._etag.get(name),
@@ -874,10 +885,29 @@ class LocalRangeServer:
                 self.send_header("Accept-Ranges",
                                  "none" if server.ignore_range else "bytes")
 
+            def _authorized(self) -> bool:
+                with server._lock:
+                    tok = server._auth_token
+                if tok is None:
+                    return True
+                return self.headers.get("Authorization") == f"Bearer {tok}"
+
+            def _deny(self) -> None:
+                body = b"unauthorized"
+                self.send_response(401)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_HEAD(self):  # noqa: N802 (http.server naming)
                 name, data, meta = self._lookup()
                 with server._lock:
                     server.requests.append(("HEAD", name, None))
+                if not self._authorized():
+                    self.send_response(401)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 if data is None:
                     self.send_error(404, "no such object")
                     return
@@ -891,6 +921,9 @@ class LocalRangeServer:
                 rng = self.headers.get("Range")
                 with server._lock:
                     server.requests.append(("GET", name, rng))
+                if not self._authorized():
+                    self._deny()
+                    return
                 if data is None:
                     self.send_error(404, "no such object")
                     return
@@ -932,6 +965,13 @@ class LocalRangeServer:
                                         name="pq-range-server", daemon=True)
         self._thread.start()
         self.host, self.port = self._httpd.server_address[:2]
+
+    def set_auth_token(self, token: Optional[str]) -> None:
+        """Rotate (or clear) the required bearer token — in-flight
+        credentials built from the old token start getting 401, the
+        stale-credential fixture for the auth-refresh path."""
+        with self._lock:
+            self._auth_token = token
 
     def put(self, name: str, data) -> None:
         """Create or REPLACE an object: new bytes, new ETag, new

@@ -74,7 +74,8 @@ __all__ = ["HttpSource", "ObjectStoreSource", "HttpTransport",
            "CircuitBreaker", "breaker_for", "breakers", "reset_breakers",
            "remote_debug", "hedge_delay_s", "observed_pread_ewma",
            "drain_connection_pools", "parallel_preads",
-           "parallel_pread_slots"]
+           "parallel_pread_slots", "register_auth_hook",
+           "unregister_auth_hook"]
 
 # resolved once: the pread hot path must not take the registry's
 # get-or-create lock (only each metric's own)
@@ -85,6 +86,7 @@ _M_HEDGES_WON = _counter("remote.hedges_won")
 _M_FAIL_FAST = _counter("remote.breaker_fail_fast")
 _M_VALIDATOR_CHANGES = _counter("remote.validator_changes")
 _M_PARALLEL_PREADS = _counter("remote.parallel_preads")
+_M_AUTH_REFRESHES = _counter("remote.auth_refreshes")
 _M_ERRORS = {c: _counter("remote.errors", labels={"class": c})
              for c in ("retryable", "terminal", "throttled")}
 _M_TRANSITIONS = {s: _counter("remote.breaker_transitions",
@@ -230,13 +232,15 @@ class HttpTransport:
         return self._new_conn(), False
 
     def _roundtrip(self, method: str,
-                   headers: Optional[dict] = None
+                   headers: Optional[dict] = None,
+                   path_override: Optional[str] = None
                    ) -> Tuple[int, Dict[str, str], bytes]:
+        path = self._request_path if path_override is None \
+            else path_override
         while True:
             conn, reused = self._checkout()
             try:
-                conn.request(method, self._request_path,
-                             headers=headers or {})
+                conn.request(method, path, headers=headers or {})
                 resp = conn.getresponse()
                 status = resp.status
                 hdrs = {k.lower(): v for k, v in resp.getheaders()}
@@ -263,14 +267,20 @@ class HttpTransport:
                 conn.close()
             return status, hdrs, body
 
-    def head(self) -> Tuple[int, Dict[str, str]]:
-        status, hdrs, _ = self._roundtrip("HEAD")
+    def head(self, extra_headers: Optional[dict] = None,
+             path_override: Optional[str] = None
+             ) -> Tuple[int, Dict[str, str]]:
+        status, hdrs, _ = self._roundtrip("HEAD", dict(extra_headers or {}),
+                                          path_override)
         return status, hdrs
 
-    def get_range(self, offset: int,
-                  size: int) -> Tuple[int, Dict[str, str], bytes]:
-        return self._roundtrip(
-            "GET", {"Range": f"bytes={offset}-{offset + size - 1}"})
+    def get_range(self, offset: int, size: int,
+                  extra_headers: Optional[dict] = None,
+                  path_override: Optional[str] = None
+                  ) -> Tuple[int, Dict[str, str], bytes]:
+        headers = dict(extra_headers or {})
+        headers["Range"] = f"bytes={offset}-{offset + size - 1}"
+        return self._roundtrip("GET", headers, path_override)
 
     def idle_connections(self) -> int:
         return len(self._pool)
@@ -471,6 +481,60 @@ def hedge_delay_s() -> Optional[float]:
 
 
 # ---------------------------------------------------------------------------
+# Auth hooks (private buckets: per-host header callbacks / presign)
+# ---------------------------------------------------------------------------
+# prefix -> hook; longest matching prefix wins, so one registration can
+# cover a whole bucket ("https://bucket.example/") while a narrower one
+# overrides a path below it
+_AUTH_HOOKS: Dict[str, object] = {}
+_AUTH_HOOKS_LOCK = make_lock("remote.auth_hooks")
+
+
+def register_auth_hook(url_prefix: str, hook) -> None:
+    """Authenticate every :class:`HttpSource` whose URL starts with
+    ``url_prefix``: ``hook(url, refresh)`` is called before requests
+    (``refresh=False``, result cached per source) and again with
+    ``refresh=True`` when the server answers 401/403 — up to
+    ``PARQUET_TPU_REMOTE_AUTH_RETRY`` refreshes per request, metered as
+    ``remote.auth_refreshes``.  The hook returns a header dict (e.g.
+    ``{"Authorization": "Bearer ..."}``); a ``"url"`` key instead
+    re-targets the request to that (presigned) URL on the same host.
+    A per-source ``HttpSource(auth=...)`` callback overrides the
+    registry."""
+    if not callable(hook):
+        raise TypeError("auth hook must be callable(url, refresh)")
+    with _AUTH_HOOKS_LOCK:
+        _AUTH_HOOKS[url_prefix] = hook
+
+
+def unregister_auth_hook(url_prefix: str) -> None:
+    with _AUTH_HOOKS_LOCK:
+        _AUTH_HOOKS.pop(url_prefix, None)
+
+
+def _auth_hook_for(url: str):
+    with _AUTH_HOOKS_LOCK:
+        best = None
+        for prefix, hook in _AUTH_HOOKS.items():
+            if url.startswith(prefix) and (best is None
+                                           or len(prefix) > len(best[0])):
+                best = (prefix, hook)
+        return best[1] if best else None
+
+
+def _reset_auth_hooks() -> None:
+    """Test isolation: forget every registered auth hook."""
+    with _AUTH_HOOKS_LOCK:
+        _AUTH_HOOKS.clear()
+
+
+def auth_refresh_attempts() -> int:
+    """``PARQUET_TPU_REMOTE_AUTH_RETRY``: credential refreshes attempted
+    per request on 401/403 before the error surfaces (default 1)."""
+    return max(0, env_int("PARQUET_TPU_REMOTE_AUTH_RETRY"))
+
+
+# ---------------------------------------------------------------------------
 # Validator bookkeeping (remote cache identity)
 # ---------------------------------------------------------------------------
 _VALIDATOR_CAP = 4096  # tiny entries, but a rolling-partition fleet
@@ -535,7 +599,7 @@ class HttpSource(Source):
 
     def __init__(self, url: str, transport=None,
                  pool_size: Optional[int] = None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None, auth=None):
         self.url = url
         self._transport = (transport if transport is not None
                            else HttpTransport(url, pool_size=pool_size,
@@ -544,6 +608,12 @@ class HttpSource(Source):
                      or urlsplit(url).netloc)
         self._breaker = breaker_for(self.host)
         self._closed = False
+        # auth: per-source callback wins, else the longest-prefix
+        # registry hook (register_auth_hook); None = anonymous requests,
+        # the zero-cost default
+        self._auth_hook = auth if auth is not None else _auth_hook_for(url)
+        self._auth_lock = make_lock("remote.auth_state")
+        self._auth_cached: Optional[dict] = None
         status, hdrs = self._head()
         cl = hdrs.get("content-length")
         if cl is None:
@@ -583,6 +653,34 @@ class HttpSource(Source):
         return "remote_far" if e is not None and e > _FAR_LATENCY_S \
             else "remote"
 
+    def _auth(self, refresh: bool = False):
+        """-> (extra request headers or None, presigned path override or
+        None) from the auth hook; ``refresh=True`` re-invokes the hook
+        (the 401→refresh path, metered ``remote.auth_refreshes``)."""
+        if self._auth_hook is None:
+            return None, None
+        with self._auth_lock:
+            if refresh or self._auth_cached is None:
+                got = self._auth_hook(self.url, refresh)
+                if got is None:
+                    got = {}
+                if not isinstance(got, dict):
+                    raise RemoteTerminalError(
+                        "auth hook must return a header dict (or one "
+                        "with a 'url' presign key)", host=self.host,
+                        path=self.url)
+                self._auth_cached = dict(got)
+                if refresh:
+                    _account(_M_AUTH_REFRESHES)
+            hdrs = dict(self._auth_cached)
+        presigned = hdrs.pop("url", None)
+        path_override = None
+        if presigned:
+            parts = urlsplit(str(presigned))
+            path_override = (parts.path or "/") + \
+                (("?" + parts.query) if parts.query else "")
+        return (hdrs or None), path_override
+
     def _head(self) -> Tuple[int, Dict[str, str]]:
         from .faults import FaultPolicy, retry_call
 
@@ -595,16 +693,31 @@ class HttpSource(Source):
                 raise RemoteCircuitOpenError(
                     f"circuit open for {self.host}", host=self.host,
                     path=self.url)
-            try:
-                status, hdrs = self._transport.head()
-            except RemoteError:
-                raise
-            except (HTTPException, socket.timeout, TimeoutError,
-                    OSError) as e:
-                self._breaker.record_failure()
-                raise RemoteTransientError(
-                    f"HEAD failed: {e}", host=self.host,
-                    path=self.url) from e
+            refreshes = 0
+            while True:
+                try:
+                    if self._auth_hook is not None:
+                        ah, override = self._auth()
+                        status, hdrs = self._transport.head(
+                            extra_headers=ah, path_override=override)
+                    else:
+                        status, hdrs = self._transport.head()
+                except RemoteError:
+                    raise
+                except (HTTPException, socket.timeout, TimeoutError,
+                        OSError) as e:
+                    self._breaker.record_failure()
+                    raise RemoteTransientError(
+                        f"HEAD failed: {e}", host=self.host,
+                        path=self.url) from e
+                if status in (401, 403) and self._auth_hook is not None \
+                        and refreshes < auth_refresh_attempts():
+                    # stale credentials: refresh and retry in place —
+                    # the host answered, so no breaker movement
+                    refreshes += 1
+                    self._auth(refresh=True)
+                    continue
+                break
             if status == 429:
                 self._breaker.record_inconclusive()  # alive, just busy
                 raise RemoteThrottledError(
@@ -632,21 +745,40 @@ class HttpSource(Source):
     # -------------------------------------------------------------- preads
     def _fetch(self, offset: int, size: int,
                attempt: int) -> bytes:
-        """One transport round trip, classified.  Raises RemoteError
+        """One transport round trip, classified (401/403 re-invoke the
+        auth hook and retry in place, bounded by
+        ``PARQUET_TPU_REMOTE_AUTH_RETRY``).  Raises RemoteError
         subclasses; returns exactly ``size`` bytes."""
-        try:
-            status, hdrs, body = self._transport.get_range(offset, size)
-        except RemoteError:
-            raise
-        except (HTTPException, socket.timeout, TimeoutError,
-                ConnectionError) as e:
-            raise RemoteTransientError(
-                f"connection failure: {e}", host=self.host, attempt=attempt,
-                offset=offset, size=size, path=self.url) from e
-        except OSError as e:
-            raise RemoteTransientError(
-                f"transport failure: {e}", host=self.host, attempt=attempt,
-                offset=offset, size=size, path=self.url) from e
+        refreshes = 0
+        while True:
+            try:
+                if self._auth_hook is not None:
+                    ah, override = self._auth()
+                    status, hdrs, body = self._transport.get_range(
+                        offset, size, extra_headers=ah,
+                        path_override=override)
+                else:
+                    status, hdrs, body = self._transport.get_range(
+                        offset, size)
+            except RemoteError:
+                raise
+            except (HTTPException, socket.timeout, TimeoutError,
+                    ConnectionError) as e:
+                raise RemoteTransientError(
+                    f"connection failure: {e}", host=self.host,
+                    attempt=attempt, offset=offset, size=size,
+                    path=self.url) from e
+            except OSError as e:
+                raise RemoteTransientError(
+                    f"transport failure: {e}", host=self.host,
+                    attempt=attempt, offset=offset, size=size,
+                    path=self.url) from e
+            if status in (401, 403) and self._auth_hook is not None \
+                    and refreshes < auth_refresh_attempts():
+                refreshes += 1
+                self._auth(refresh=True)
+                continue
+            break
         if status == 206:
             cr = hdrs.get("content-range", "")
             m = _CONTENT_RANGE.match(cr)

@@ -30,7 +30,8 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       metrics_snapshot)
 
 __all__ = ["render_prometheus", "start_metrics_server", "MetricsServer",
-           "debugz_snapshot"]
+           "debugz_snapshot", "register_debugz_provider",
+           "unregister_debugz_provider"]
 
 _PREFIX = "parquet_tpu_"
 _BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
@@ -106,6 +107,24 @@ def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
 # ---------------------------------------------------------------------------
 
 
+# extension point: subsystems that only exist in SOME processes (the
+# serving daemon's tenant table) register a named section provider so
+# /debugz includes them without this module importing them — the same
+# lazy-answer contract as the tables/remote sections
+_DEBUGZ_PROVIDERS: dict = {}
+
+
+def register_debugz_provider(name: str, fn) -> None:
+    """Add section ``name`` (a zero-arg callable returning a JSONable
+    dict) to every future :func:`debugz_snapshot`.  A provider that
+    raises renders as an error string — introspection must answer."""
+    _DEBUGZ_PROVIDERS[name] = fn
+
+
+def unregister_debugz_provider(name: str) -> None:
+    _DEBUGZ_PROVIDERS.pop(name, None)
+
+
 def debugz_snapshot(top_n: int = 10) -> dict:
     """The ``/debugz`` payload: live residency of every buffer tier.
 
@@ -155,6 +174,7 @@ def debugz_snapshot(top_n: int = 10) -> dict:
         "budget_bytes": {"global": adm.global_budget_bytes(),
                          "lookup": adm.budget_bytes("lookup"),
                          "scan": adm.budget_bytes("scan")},
+        "tenants": adm.tenant_debug(),
     }
     try:
         from ..io import cache as _cache
@@ -175,6 +195,11 @@ def debugz_snapshot(top_n: int = 10) -> dict:
         }
     except ImportError:  # pragma: no cover - the IO layer always imports
         out["caches"] = {}
+    for name, fn in list(_DEBUGZ_PROVIDERS.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # introspection must answer regardless
+            out[name] = {"error": str(e)}
     return out
 
 

@@ -54,6 +54,8 @@ __all__ = ["Account", "ResourceLedger", "LEDGER", "ledger_account",
 CORE_ACCOUNTS = (
     ("cache.chunk", "decoded whole-chunk LRU (io/cache.py)"),
     ("cache.page", "decoded-page LRU, the lookup serving tier"),
+    ("cache.page_pinned", "tenant-pinned decoded pages (eviction-exempt "
+     "up to each tenant's pin cap)"),
     ("cache.footer", "parsed footers (thrift bytes at parse time)"),
     ("cache.neg_lookup", "negative-lookup memo (keys known absent)"),
     ("prefetch.ring", "in-flight/completed readahead window bytes"),

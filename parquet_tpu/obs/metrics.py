@@ -500,6 +500,21 @@ _CORE_COUNTERS = (
     # mmap write-sink experiment (io/sink.py MmapFileSink)
     ("write.mmap_commits", "files committed through the mmap-backed "
      "sink (PARQUET_TPU_MMAP_SINK)"),
+    # tenant hot-key pinning (io/cache.py page_pin_scope): pins granted
+    # vs refused at the per-tenant cap — the pin-contract health meters
+    ("cache.page_pins", "decoded pages pinned by tenants "
+     "(eviction-exempt)"),
+    ("cache.page_pin_refusals", "pin attempts refused at the tenant's "
+     "pin cap (entry fell back to the LRU)"),
+    # serving daemon (parquet_tpu/serve): per-endpoint error count; the
+    # per-class/per-tenant request+shed counters are label families
+    # declared below
+    ("serve.errors", "requests that failed with a 5xx"),
+    ("serve.writes_committed", "table commits performed by /v1/write"),
+    ("serve.rows_served", "rows returned across all serve endpoints"),
+    # remote auth hooks (io/remote.py): 401/403 -> refresh-and-retry
+    ("remote.auth_refreshes", "credential refreshes triggered by "
+     "401/403 responses (auth hook re-invoked)"),
 )
 
 
@@ -572,6 +587,21 @@ def _declare_core() -> None:
         REGISTRY.counter("route.observations", labels={"route": route},
                          help="measured samples folded into the route "
                               "EWMA")
+    REGISTRY.gauge("cache.page_pinned_bytes",
+                   help="decoded bytes pinned by tenants "
+                        "(eviction-exempt)")
+    # serving daemon per-class families (parquet_tpu/serve): the class
+    # axis is closed (latency/default/bulk) so every class series exists
+    # at 0; per-TENANT series (labels tenant+class) appear as tenants
+    # arrive — same family name, so PT001 and the scrape contract hold
+    for klass in ("latency", "default", "bulk"):
+        REGISTRY.counter("serve.requests", labels={"class": klass},
+                         help="requests served per priority class")
+        REGISTRY.counter("serve.shed", labels={"class": klass},
+                         help="requests shed 429 under hard pressure")
+        REGISTRY.histogram("serve.request_s", labels={"class": klass},
+                           help="end-to-end request latency per "
+                                "priority class")
 
 
 _declare_core()

@@ -1,0 +1,9 @@
+"""The serving daemon: ``python -m parquet_tpu serve --config serve.json``
+or the programmatic :class:`Server` — multi-tenant QoS over lookups,
+scans, aggregates, and writes (see serve/server.py for the full story).
+"""
+
+from .config import DatasetSpec, ServeConfig, load_config
+from .server import Server
+
+__all__ = ["Server", "ServeConfig", "DatasetSpec", "load_config"]

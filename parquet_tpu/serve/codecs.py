@@ -1,0 +1,221 @@
+"""Wire codecs for the serving daemon: JSON where-trees → prepared-able
+``Expr``, aggregate spec strings → ``AggExpr``, and result
+serialization (JSON values and Arrow IPC streams).
+
+Shared with the CLI — ``python -m parquet_tpu aggregate --agg sum:v``
+and ``POST /v1/aggregate {"aggs": ["sum:v"]}`` parse through the same
+:func:`parse_agg_spec`, so the two front ends can never drift.
+
+Where-tree wire format (one JSON object per node)::
+
+    {"and": [node, ...]}            {"or": [node, ...]}
+    {"not": node}
+    {"col": "x", "ge": 1, "le": 5}  # inclusive range (either side open)
+    {"col": "x", "eq": 7}           {"col": "s", "in": ["a", "b"]}
+    {"col": "x", "null": true}      # is-null (false = is-not-null)
+
+Values are JSON scalars; strings compare as utf-8 bytes (the predicate
+normalizer's existing contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..algebra.aggregate import (AggExpr, avg, count, count_distinct,
+                                 max_, min_, sum_, sum_sq, top_k,
+                                 variance)
+from ..algebra.expr import Expr, col
+
+__all__ = ["expr_from_wire", "parse_agg_spec", "parse_aggs", "jsonable",
+           "columns_to_jsonable", "lookup_to_jsonable",
+           "columns_to_arrow_batch", "columns_to_arrow_ipc"]
+
+_AGG_USAGE = ("count, count:COL, min:COL, max:COL, sum:COL, sum_sq:COL, "
+              "avg:COL, var:COL, var:COL:sample, distinct:COL, top:COL:K")
+
+
+def parse_agg_spec(spec: str) -> AggExpr:
+    """One aggregate from its wire/CLI spelling (``sum:v``, ``count``,
+    ``avg:v``, ``var:v[:sample]``, ``top:v:5``); clean ``ValueError`` on
+    malformed specs."""
+    parts = str(spec).split(":")
+    kind = parts[0]
+    if kind == "count":
+        return count(parts[1] if len(parts) > 1 and parts[1] else None)
+    if kind in ("min", "max", "sum", "sum_sq", "distinct", "avg", "var",
+                "variance"):
+        if len(parts) < 2 or not parts[1]:
+            raise ValueError(f"--agg {spec!r} needs a column "
+                             f"({_AGG_USAGE})")
+        if kind in ("var", "variance"):
+            sample = len(parts) > 2 and parts[2] == "sample"
+            return variance(parts[1], sample=sample)
+        fn = {"min": min_, "max": max_, "sum": sum_, "sum_sq": sum_sq,
+              "distinct": count_distinct, "avg": avg}[kind]
+        return fn(parts[1])
+    if kind == "top":
+        if len(parts) < 3 or not parts[1]:
+            raise ValueError(f"--agg {spec!r} needs top:COL:K "
+                             f"({_AGG_USAGE})")
+        try:
+            k = int(parts[2])
+        except ValueError:
+            raise ValueError(f"--agg {spec!r}: K must be an integer "
+                             f"({_AGG_USAGE})") from None
+        return top_k(parts[1], k)
+    raise ValueError(f"unknown --agg spec {spec!r} ({_AGG_USAGE})")
+
+
+def parse_aggs(specs: Sequence) -> List[AggExpr]:
+    """A request's aggregate list: spec strings (or already-built
+    ``AggExpr`` nodes, for programmatic callers)."""
+    out = []
+    for s in specs:
+        out.append(s if isinstance(s, AggExpr) else parse_agg_spec(s))
+    if not out:
+        raise ValueError("aggs must name at least one aggregate "
+                         f"({_AGG_USAGE})")
+    return out
+
+
+def expr_from_wire(node) -> Optional[Expr]:
+    """A predicate tree from its JSON form (module docstring); ``None``
+    stays None (no predicate)."""
+    if node is None:
+        return None
+    if not isinstance(node, dict):
+        raise ValueError(f"where node must be an object, got "
+                         f"{type(node).__name__}")
+    if "and" in node or "or" in node:
+        key = "and" if "and" in node else "or"
+        kids = node[key]
+        if not isinstance(kids, list) or not kids:
+            raise ValueError(f"'{key}' needs a non-empty list")
+        exprs = [expr_from_wire(k) for k in kids]
+        out = exprs[0]
+        for e in exprs[1:]:
+            out = (out & e) if key == "and" else (out | e)
+        return out
+    if "not" in node:
+        return ~expr_from_wire(node["not"])
+    path = node.get("col")
+    if not path:
+        raise ValueError(f"leaf node needs 'col': {node!r}")
+    ops = set(node) - {"col"}
+    if "null" in node:
+        if ops != {"null"}:
+            raise ValueError("'null' cannot combine with other ops")
+        leaf = col(path).is_null()
+        return leaf if node["null"] else ~leaf
+    if "in" in node:
+        if ops != {"in"}:
+            raise ValueError("'in' cannot combine with other ops")
+        vals = node["in"]
+        if not isinstance(vals, list) or not vals:
+            raise ValueError("'in' needs a non-empty value list")
+        return col(path).isin(vals)
+    if "eq" in node:
+        if ops != {"eq"}:
+            raise ValueError("'eq' cannot combine with other ops")
+        return col(path) == node["eq"]
+    if ops <= {"ge", "le"} and ops:
+        return col(path).between(node.get("ge"), node.get("le"))
+    raise ValueError(f"unknown predicate ops {sorted(ops)} on "
+                     f"{path!r} (ge/le, eq, in, null)")
+
+
+# ---------------------------------------------------------------------------
+# result serialization
+# ---------------------------------------------------------------------------
+
+
+def jsonable(v):
+    """One value as JSON: numpy scalars unwrap, bytes decode utf-8 with
+    replacement (the wire is JSON text; binary-exact consumers use the
+    Arrow IPC format instead), NaN/inf survive via python floats."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):
+        return v
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return bytes(v).decode("utf-8", "replace")
+    if isinstance(v, (list, tuple)):
+        return [jsonable(x) for x in v]
+    item = getattr(v, "item", None)
+    if item is not None:
+        return jsonable(item())
+    return str(v)
+
+
+def _column_to_list(vals) -> list:
+    if isinstance(vals, np.ma.MaskedArray):
+        data = vals.filled(0).tolist()
+        mask = np.ma.getmaskarray(vals)
+        return [None if m else jsonable(d)
+                for d, m in zip(data, mask.tolist())]
+    if isinstance(vals, np.ndarray):
+        return [jsonable(x) for x in vals.tolist()]
+    return [jsonable(x) for x in vals]
+
+
+def columns_to_jsonable(cols: Dict[str, object]) -> Dict[str, list]:
+    """A scan result (``{column: values}``) as JSON lists: masked rows
+    and BYTE_ARRAY ``None`` entries become JSON ``null``."""
+    return {name: _column_to_list(vals) for name, vals in cols.items()}
+
+
+def lookup_to_jsonable(res, keys) -> List[dict]:
+    """A :class:`~parquet_tpu.io.lookup.LookupResult` as one JSON object
+    per input key: ``{"key", "rows", "values": {col: [...]}}`` with
+    values row-aligned to ``rows`` and nulls as JSON ``null``."""
+    out = []
+    for key, h in zip(keys, res.hits):
+        values = {}
+        for name, vals in h.values.items():
+            valid = h.validity.get(name)
+            lst = _column_to_list(vals)
+            if valid is not None:
+                lst = [None if not ok else v
+                       for v, ok in zip(lst, np.asarray(valid, bool))]
+            values[name] = lst
+        out.append({"key": jsonable(key),
+                    "rows": np.asarray(h.rows).tolist(),
+                    "values": values})
+    return out
+
+
+def columns_to_arrow_batch(cols: Dict[str, object]):
+    """One Arrow record batch from a scan result dict: masked numpy
+    arrays carry their nulls, list-form columns (the scan's BYTE_ARRAY
+    carrier) map to nullable binary — ALWAYS, even when the batch is
+    empty or all-null, so every file of a multi-file stream produces
+    the same schema (an inferred null-typed empty column would poison
+    the IPC stream's locked schema for every later file)."""
+    import pyarrow as pa
+
+    arrays, names = [], []
+    for name, vals in cols.items():
+        names.append(name)
+        if isinstance(vals, np.ma.MaskedArray):
+            arrays.append(pa.array(vals.filled(0),
+                                   mask=np.ma.getmaskarray(vals)))
+        elif isinstance(vals, np.ndarray):
+            arrays.append(pa.array(vals))
+        else:
+            arrays.append(pa.array(list(vals), type=pa.binary()))
+    return pa.record_batch(arrays, names=names)
+
+
+def columns_to_arrow_ipc(cols: Dict[str, object], sink) -> int:
+    """Write one Arrow IPC stream containing a single record batch of
+    ``cols`` into file-like ``sink``; returns the row count."""
+    import pyarrow as pa
+    import pyarrow.ipc
+
+    batch = columns_to_arrow_batch(cols)
+    with pa.ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return batch.num_rows

@@ -1,0 +1,214 @@
+"""Serving-daemon configuration: which datasets to host, which tenants
+may query them, and each tenant's QoS contract.
+
+The daemon is configured by one JSON document (``python -m parquet_tpu
+serve --config serve.json``) or the equivalent dict handed to
+:class:`~parquet_tpu.serve.Server` programmatically::
+
+    {
+      "host": "127.0.0.1",
+      "port": 8818,
+      "datasets": {
+        "events":  {"paths": ["/data/events/*.parquet"]},
+        "users":   {"table": "/data/users", "writable": true}
+      },
+      "tenants": {
+        "online":  {"class": "latency", "weight": 2.0,
+                    "budget_bytes": "64MiB", "pin_bytes": "8MiB"},
+        "batch":   {"class": "bulk", "budget_bytes": "32MiB"}
+      }
+    }
+
+- ``datasets`` — name → either ``paths`` (files/globs served as a
+  read-only :class:`~parquet_tpu.dataset.Dataset`) or ``table`` (a
+  DatasetWriter table directory, snapshot-opened; ``writable: true``
+  additionally enables ``/v1/write`` ingest with manifest-atomic
+  commits).
+- ``tenants`` — name → QoS contract: priority ``class`` (``latency`` |
+  ``default`` | ``bulk``), weighted-fair ``weight``, per-tenant
+  ``budget_bytes`` clamp at the admission gate, and ``pin_bytes`` of
+  page-cache hot-key pinning.  Requests carry their tenant in the
+  ``X-Tenant`` header; unknown tenants ride the ``default`` contract
+  (override it with a tenant literally named ``"default"``).
+
+Byte sizes accept ints or the usual suffix strings (``"64MiB"``,
+``"1GB"``); knob-backed settings (drain timeout, shed Retry-After, max
+body) read their ``PARQUET_TPU_SERVE_*`` envs per call so operators can
+repoint them live.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.env import env_bytes, env_float
+from ..utils.pool import TenantSpec
+
+__all__ = ["DatasetSpec", "ServeConfig", "load_config", "parse_bytes",
+           "drain_timeout_s", "shed_retry_after_s", "max_body_bytes"]
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgt]?i?b?)\s*$", re.I)
+_MULT = {"": 1, "b": 1,
+         "k": 1000, "kb": 1000, "ki": 1024, "kib": 1024,
+         "m": 1000 ** 2, "mb": 1000 ** 2, "mi": 1 << 20, "mib": 1 << 20,
+         "g": 1000 ** 3, "gb": 1000 ** 3, "gi": 1 << 30, "gib": 1 << 30,
+         "t": 1000 ** 4, "tb": 1000 ** 4, "ti": 1 << 40, "tib": 1 << 40}
+
+
+def parse_bytes(v) -> Optional[int]:
+    """``64 << 20`` from ``"64MiB"`` / ``"64MB"`` / ``67108864`` / None."""
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        raise ValueError(f"byte size must be a number or string, got {v!r}")
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = _SIZE_RE.match(str(v))
+    if not m:
+        raise ValueError(f"unparseable byte size {v!r}")
+    return int(float(m.group(1)) * _MULT[m.group(2).lower()])
+
+
+def drain_timeout_s() -> float:
+    """``PARQUET_TPU_SERVE_DRAIN_S``: seconds a graceful shutdown waits
+    for in-flight requests before giving up (default 10)."""
+    return env_float("PARQUET_TPU_SERVE_DRAIN_S")
+
+
+def shed_retry_after_s() -> float:
+    """``PARQUET_TPU_SERVE_RETRY_AFTER_S``: the ``Retry-After`` a shed
+    429 advertises (default 1.0)."""
+    return env_float("PARQUET_TPU_SERVE_RETRY_AFTER_S")
+
+
+def max_body_bytes() -> int:
+    """``PARQUET_TPU_SERVE_MAX_BODY``: request-body cap (default 64 MiB;
+    a body over it is refused 413 before being read into memory)."""
+    return env_bytes("PARQUET_TPU_SERVE_MAX_BODY")
+
+
+@dataclass
+class DatasetSpec:
+    """One hosted dataset: ``paths`` (read-only file set) XOR ``table``
+    (a snapshot-opened DatasetWriter table directory; ``writable``
+    enables ``/v1/write``)."""
+
+    name: str
+    paths: Optional[List[str]] = None
+    table: Optional[str] = None
+    writable: bool = False
+    sorting: Optional[str] = None  # /v1/write ingest sort key
+    rows_per_file: int = 100_000
+
+    def __post_init__(self):
+        if (self.paths is None) == (self.table is None):
+            raise ValueError(f"dataset {self.name!r} needs exactly one "
+                             "of 'paths' or 'table'")
+        if self.writable and self.table is None:
+            raise ValueError(f"dataset {self.name!r}: only table-backed "
+                             "datasets are writable")
+
+
+# endpoint → the class a tenant without an explicit contract runs as:
+# lookups and aggregates are the p99-sensitive surface, scans and writes
+# the bulk one
+DEFAULT_ENDPOINT_CLASS = {"lookup": "latency", "aggregate": "latency",
+                          "scan": "bulk", "write": "bulk"}
+
+
+@dataclass
+class ServeConfig:
+    """The parsed daemon configuration (see module docstring)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8818
+    datasets: Dict[str, DatasetSpec] = field(default_factory=dict)
+    tenants: Dict[str, TenantSpec] = field(default_factory=dict)
+    pin_bytes: Dict[str, int] = field(default_factory=dict)
+    compact_interval_s: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ServeConfig":
+        if not isinstance(doc, dict):
+            raise ValueError("serve config must be a JSON object")
+        unknown = set(doc) - {"host", "port", "datasets", "tenants",
+                              "compact_interval_s"}
+        if unknown:
+            raise ValueError(f"unknown serve config keys: "
+                             f"{sorted(unknown)}")
+        datasets: Dict[str, DatasetSpec] = {}
+        for name, d in (doc.get("datasets") or {}).items():
+            if not isinstance(d, dict):
+                raise ValueError(f"dataset {name!r} must be an object")
+            bad = set(d) - {"paths", "table", "writable", "sorting",
+                            "rows_per_file"}
+            if bad:
+                raise ValueError(f"dataset {name!r}: unknown keys "
+                                 f"{sorted(bad)}")
+            paths = d.get("paths")
+            if isinstance(paths, str):
+                paths = [paths]
+            datasets[name] = DatasetSpec(
+                name=name, paths=paths, table=d.get("table"),
+                writable=bool(d.get("writable", False)),
+                sorting=d.get("sorting"),
+                rows_per_file=int(d.get("rows_per_file", 100_000)))
+        tenants: Dict[str, TenantSpec] = {}
+        pins: Dict[str, int] = {}
+        for name, t in (doc.get("tenants") or {}).items():
+            if not isinstance(t, dict):
+                raise ValueError(f"tenant {name!r} must be an object")
+            bad = set(t) - {"class", "weight", "budget_bytes",
+                            "pin_bytes"}
+            if bad:
+                # a typo'd QoS key silently dropping a tenant's budget
+                # would be the OPPOSITE of the operator's intent
+                raise ValueError(f"tenant {name!r}: unknown keys "
+                                 f"{sorted(bad)} (class, weight, "
+                                 f"budget_bytes, pin_bytes)")
+            klass = t.get("class", "default")
+            if klass not in ("latency", "default", "bulk"):
+                raise ValueError(f"tenant {name!r}: unknown class "
+                                 f"{klass!r} (latency|default|bulk)")
+            tenants[name] = TenantSpec(
+                name=name,
+                budget_bytes=parse_bytes(t.get("budget_bytes")),
+                weight=float(t.get("weight", 1.0)),
+                klass=klass)
+            pin = parse_bytes(t.get("pin_bytes"))
+            if pin:
+                pins[name] = pin
+        if not datasets:
+            raise ValueError("serve config hosts no datasets")
+        ci = doc.get("compact_interval_s")
+        return cls(host=str(doc.get("host", "127.0.0.1")),
+                   port=int(doc.get("port", 8818)),
+                   datasets=datasets, tenants=tenants, pin_bytes=pins,
+                   compact_interval_s=float(ci) if ci else None)
+
+    def tenant(self, name: str) -> Optional[TenantSpec]:
+        return self.tenants.get(name)
+
+    def klass_for(self, tenant: Optional[str], endpoint: str) -> str:
+        """The priority class a request runs as: the tenant's declared
+        class when it has a contract, else the endpoint's natural class
+        (lookup/aggregate → latency, scan/write → bulk)."""
+        spec = self.tenants.get(tenant) if tenant else None
+        if spec is not None:
+            return spec.klass
+        return DEFAULT_ENDPOINT_CLASS.get(endpoint, "default")
+
+
+def load_config(path: str) -> ServeConfig:
+    """Parse a ``serve.json`` into a :class:`ServeConfig` (clean
+    ``ValueError`` on malformed documents — the CLI renders it as a
+    one-line error, not a traceback)."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError as e:
+            raise ValueError(f"{path}: not valid JSON ({e})") from e
+    return ServeConfig.from_dict(doc)

@@ -1,0 +1,694 @@
+"""The serving daemon: one long-lived process hosting datasets behind
+HTTP endpoints with multi-tenant QoS — ROADMAP item 3, the thing the
+observability substrate was built for.
+
+``python -m parquet_tpu serve --config serve.json`` (or the
+programmatic :class:`Server`) mounts, on one port:
+
+- ``POST /v1/lookup`` — batched ``find_rows`` (latency class by
+  default): ``{"dataset", "column", "keys", "columns"?}`` →
+  per-key rows + row-aligned values.
+- ``POST /v1/scan`` — where-tree + column selection, streamed: one
+  chunk per file, as JSON lines (default) or one Arrow IPC stream
+  (``"format": "arrow"``).
+- ``POST /v1/aggregate`` — PR 14's pushdown cascade over the wire:
+  ``{"aggs": ["count", "sum:v", "avg:v", ...], "where"?, "group_by"?}``.
+- ``POST /v1/write`` — columnar ingest into a writable table dataset
+  with manifest-atomic commit; the served snapshot refreshes on commit.
+- ``GET /metrics`` / ``/metrics.json`` / ``/healthz`` / ``/debugz`` —
+  the existing scrape surface (obs/export.py), same port, plus a
+  ``tenants`` /debugz section with per-tenant accounting.
+
+Every request runs inside an ``op_scope`` (``serve.<endpoint>``) so the
+:class:`~parquet_tpu.obs.scope.OpScope` report IS the per-request
+accounting record — slow requests land in the slow-op JSONL
+(``PARQUET_TPU_SLOW_OP_S``/``SLOW_LOG``) with their per-stage breakdown,
+and the per-tenant aggregates in ``/debugz`` fold each request's report.
+
+**Tenant QoS**: requests carry ``X-Tenant``; the config's
+:class:`~parquet_tpu.utils.pool.TenantSpec` table installs per-tenant
+byte budgets and weighted-fair priority classes on the unified
+admission gate (bulk scans cannot starve latency lookups — the
+scheduler walk in utils/pool.py), ``pin_bytes`` tenants get page-cache
+hot-key pinning (io/cache.py), and under hard memory pressure the
+daemon degrades gracefully: bulk-class requests shed FIRST with
+``429 Retry-After`` (``serve.shed{class=...}``, per-tenant counts in
+``/debugz``) while latency-class requests keep flowing through the
+gate.  Graceful shutdown (SIGTERM in the CLI, :meth:`Server.close`)
+stops accepting, drains in-flight requests up to
+``PARQUET_TPU_SERVE_DRAIN_S``, then exits.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..dataset import Dataset
+from ..errors import CorruptedError
+from ..obs import export as _export
+from ..obs import scope as _oscope
+from ..obs.ledger import LEDGER
+from ..obs.metrics import REGISTRY, metrics_snapshot
+from ..io.cache import PAGES, page_pin_scope
+from ..utils.locks import make_condition, make_lock
+from ..utils.pool import read_admission, tenant_context
+from .codecs import (columns_to_arrow_batch, columns_to_jsonable,
+                     expr_from_wire, jsonable, lookup_to_jsonable,
+                     parse_aggs)
+from .config import (DatasetSpec, ServeConfig, drain_timeout_s,
+                     load_config, max_body_bytes, shed_retry_after_s)
+
+__all__ = ["Server"]
+
+# the one running daemon of this process (see Server.__init__)
+_ACTIVE: "Optional[Server]" = None
+_ACTIVE_LOCK = make_lock("serve.active")
+
+# resolved per class once (hot-path rule); tenant-labeled variants are
+# get-or-created per (tenant, class) pair on first use and memoized
+_CLASSES = ("latency", "default", "bulk")
+_M_REQS = {c: REGISTRY.counter("serve.requests", labels={"class": c})
+           for c in _CLASSES}
+_M_SHED = {c: REGISTRY.counter("serve.shed", labels={"class": c})
+           for c in _CLASSES}
+_H_REQ_S = {c: REGISTRY.histogram("serve.request_s", labels={"class": c})
+            for c in _CLASSES}
+_M_ERRORS = REGISTRY.counter("serve.errors")
+_M_COMMITS = REGISTRY.counter("serve.writes_committed")
+_M_ROWS = REGISTRY.counter("serve.rows_served")
+
+_JSON = "application/json"
+_ARROW = "application/vnd.apache.arrow.stream"
+
+
+class _HttpError(Exception):
+    """A clean client-visible failure: status + one-line message."""
+
+    def __init__(self, status: int, message: str, headers=None):
+        super().__init__(message)
+        self.status = status
+        self.headers = headers or {}
+
+
+class _ChunkedWriter:
+    """Minimal HTTP/1.1 chunked-transfer body writer (the handler sends
+    the ``Transfer-Encoding: chunked`` header first).  File-like enough
+    for the Arrow IPC stream writer."""
+
+    closed = False  # file-like surface the Arrow IPC writer probes
+    writable_flag = True
+
+    def __init__(self, wfile):
+        self._w = wfile
+
+    def writable(self) -> bool:
+        return True
+
+    def close(self) -> None:  # pa may close its sink; the chunk
+        pass  # terminator is ours (finish())
+
+    def write(self, data) -> int:
+        data = bytes(data)
+        if data:
+            self._w.write(f"{len(data):x}\r\n".encode("ascii"))
+            self._w.write(data)
+            self._w.write(b"\r\n")
+        return len(data)
+
+    def finish(self) -> None:
+        self._w.write(b"0\r\n\r\n")
+
+    def flush(self) -> None:
+        self._w.flush()
+
+
+class _TenantStats:
+    """Per-tenant request accounting folded from each request's
+    OpReport — the /debugz ``tenants`` section's data half."""
+
+    def __init__(self):
+        self._lock = make_lock("serve.tenant_stats")
+        self._by: Dict[str, dict] = {}
+
+    def _row(self, tenant: str) -> dict:
+        row = self._by.get(tenant)
+        if row is None:
+            row = self._by[tenant] = {
+                "requests": 0, "shed": 0, "errors": 0, "rows": 0,
+                "bytes_read": 0, "cache_hits": 0, "cache_misses": 0,
+                "seconds": 0.0}
+        return row
+
+    def shed(self, tenant: str) -> None:
+        with self._lock:
+            self._row(tenant)["shed"] += 1
+
+    def error(self, tenant: str) -> None:
+        with self._lock:
+            self._row(tenant)["errors"] += 1
+
+    def fold(self, tenant: str, report: dict, rows: int,
+             seconds: float) -> None:
+        with self._lock:
+            row = self._row(tenant)
+            row["requests"] += 1
+            row["rows"] += int(rows)
+            row["bytes_read"] += int(report.get("bytes_read", 0))
+            row["cache_hits"] += int(report.get("cache_hits", 0))
+            row["cache_misses"] += int(report.get("cache_misses", 0))
+            row["seconds"] = round(row["seconds"] + seconds, 6)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {t: dict(r) for t, r in self._by.items()}
+
+
+class Server:
+    """A running serving daemon (see module docstring).
+
+    ``config`` is a :class:`~parquet_tpu.serve.config.ServeConfig`, the
+    equivalent dict, or a path to a ``serve.json``.  ``port=0`` binds an
+    ephemeral port (read it back from ``.port``).  Context-manager
+    friendly; :meth:`close` performs the graceful drain."""
+
+    def __init__(self, config, host: Optional[str] = None,
+                 port: Optional[int] = None):
+        if isinstance(config, str):
+            config = load_config(config)
+        elif isinstance(config, dict):
+            config = ServeConfig.from_dict(config)
+        if not isinstance(config, ServeConfig):
+            raise TypeError(f"config must be a ServeConfig, dict, or "
+                            f"path, got {type(config).__name__}")
+        self.config = config
+        self._ds_lock = make_lock("serve.datasets")
+        self._datasets: Dict[str, Dataset] = {}
+        for name, spec in config.datasets.items():
+            self._datasets[name] = self._open_dataset(spec)
+        self.tenant_stats = _TenantStats()
+        self._inflight = 0
+        self._inflight_cv = make_condition("serve.inflight")
+        self._closed = False
+        self._compactors = []
+        # one daemon per process: the QoS state it installs (tenant
+        # table, page pins, /debugz provider) is process-global — a
+        # silent second instance would clobber the first's contracts
+        # out from under its running requests
+        with _ACTIVE_LOCK:
+            global _ACTIVE
+            if _ACTIVE is not None:
+                raise RuntimeError(
+                    "a Server is already running in this process "
+                    "(the tenant QoS state is process-global); close "
+                    "it before starting another")
+            _ACTIVE = self
+        try:
+            server = self
+
+            class Handler(_RequestHandler):
+                daemon = server
+
+            # bind FIRST: a port already in use must fail before any
+            # global state installs or background threads start
+            self._httpd = ThreadingHTTPServer(
+                (host if host is not None else config.host,
+                 port if port is not None else config.port), Handler)
+        except BaseException:
+            with _ACTIVE_LOCK:
+                _ACTIVE = None
+            raise
+        read_admission().configure_tenants(config.tenants)
+        if config.compact_interval_s:
+            from ..dataset_writer import BackgroundCompactor
+
+            for spec in config.datasets.values():
+                if spec.writable:
+                    self._compactors.append(BackgroundCompactor(
+                        spec.table,
+                        interval_s=config.compact_interval_s))
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="pq-serve", daemon=True)
+        self._thread.start()
+        self.host, self.port = self._httpd.server_address[:2]
+        _export.register_debugz_provider("tenants", self._tenants_debugz)
+
+    # ------------------------------------------------------------ datasets
+    @staticmethod
+    def _open_dataset(spec: DatasetSpec) -> Dataset:
+        if spec.table is not None:
+            from ..dataset_writer import open_table
+
+            return open_table(spec.table)
+        return Dataset(spec.paths)
+
+    def dataset(self, name: str) -> Dataset:
+        with self._ds_lock:
+            ds = self._datasets.get(name)
+        if ds is None:
+            raise _HttpError(404, f"unknown dataset {name!r}")
+        return ds
+
+    def _refresh_dataset(self, name: str) -> None:
+        """Swap in a fresh snapshot after a commit — readers in flight
+        keep their pinned snapshot (open_table semantics), new requests
+        see the new version."""
+        spec = self.config.datasets[name]
+        fresh = self._open_dataset(spec)
+        with self._ds_lock:
+            self._datasets[name] = fresh
+
+    # ------------------------------------------------------------- debugz
+    def _tenants_debugz(self) -> dict:
+        adm = read_admission()
+        gate = adm.tenant_debug()
+        stats = self.tenant_stats.snapshot()
+        out: Dict[str, dict] = {}
+        for t in sorted(set(gate) | set(stats)):
+            row = dict(gate.get(t, {}))
+            row.update(stats.get(t, {}))
+            row["pinned_bytes"] = PAGES.pinned_bytes(t)
+            out[t] = row
+        return out
+
+    # ------------------------------------------------------------ lifetime
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _enter_request(self) -> bool:
+        with self._inflight_cv:
+            if self._closed:
+                return False
+            self._inflight += 1
+            return True
+
+    def _exit_request(self) -> None:
+        with self._inflight_cv:
+            self._inflight -= 1
+            self._inflight_cv.notify_all()
+
+    def inflight(self) -> int:
+        with self._inflight_cv:
+            return self._inflight
+
+    def close(self, drain: bool = True) -> bool:
+        """Graceful shutdown: stop accepting, drain in-flight requests
+        (up to ``PARQUET_TPU_SERVE_DRAIN_S``), release tenant state.
+        Returns True when the drain completed (False = timed out with
+        requests still running).  Idempotent."""
+        with self._inflight_cv:
+            if self._closed:
+                return True
+            self._closed = True
+        _export.unregister_debugz_provider("tenants")
+        self._httpd.shutdown()  # stop accepting; in-flight continue
+        drained = True
+        if drain:
+            deadline = time.monotonic() + max(drain_timeout_s(), 0.0)
+            with self._inflight_cv:
+                while self._inflight > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        drained = False
+                        break
+                    self._inflight_cv.wait(timeout=min(remaining, 0.25))
+        for c in self._compactors:
+            c.close()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        adm = read_admission()
+        for t in self.config.tenants:
+            PAGES.unpin_tenant(t)
+        adm.clear_tenants()
+        with _ACTIVE_LOCK:
+            global _ACTIVE
+            if _ACTIVE is self:
+                _ACTIVE = None
+        return drained
+
+    def join(self) -> None:
+        """Block until the listener stops (the CLI foreground)."""
+        self._thread.join()
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _RequestHandler(BaseHTTPRequestHandler):
+    """One request: routing, tenant resolution, QoS entry, dispatch."""
+
+    daemon: Server  # bound by the per-Server subclass
+    protocol_version = "HTTP/1.1"
+    server_version = "parquet-tpu-serve/1.0"
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):  # the metrics ARE the log
+        pass
+
+    # ------------------------------------------------------------ plumbing
+    def _send(self, status: int, body: bytes, ctype: str = _JSON,
+              headers=None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        if status >= 400:
+            # error responses may leave an unread request body on the
+            # wire (413 refuses before reading; malformed JSON aborts
+            # mid-parse) — keep-alive would desync the next request
+            self.send_header("Connection", "close")
+            self.close_connection = True
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, doc: dict, headers=None) -> None:
+        self._send(status, json.dumps(doc, sort_keys=True,
+                                      allow_nan=True).encode("utf-8"),
+                   headers=headers)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        cap = max_body_bytes()
+        if length > cap:
+            raise _HttpError(413, f"request body {length} bytes exceeds "
+                                  f"the {cap}-byte cap "
+                                  f"(PARQUET_TPU_SERVE_MAX_BODY)")
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            doc = json.loads(raw or b"{}")
+        except ValueError as e:
+            raise _HttpError(400, f"request body is not valid JSON "
+                                  f"({e})") from e
+        if not isinstance(doc, dict):
+            raise _HttpError(400, "request body must be a JSON object")
+        return doc
+
+    # ---------------------------------------------------------------- GET
+    def do_GET(self):  # noqa: N802 (http.server naming)
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            from ..obs.export import render_prometheus
+
+            self._send(200, render_prometheus().encode("utf-8"),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path in ("/metrics.json", "/metrics/json"):
+            self._send(200, json.dumps(metrics_snapshot(),
+                                       sort_keys=True).encode("utf-8"))
+        elif path == "/debugz":
+            self._send(200, json.dumps(_export.debugz_snapshot(),
+                                       sort_keys=True).encode("utf-8"))
+        elif path == "/healthz":
+            self._send(200, (LEDGER.state() + "\n").encode("utf-8"),
+                       "text/plain; charset=utf-8")
+        else:
+            self._send_json(404, {"error": "unknown path (POST "
+                                           "/v1/lookup|scan|aggregate|"
+                                           "write; GET /metrics "
+                                           "/healthz /debugz)"})
+
+    # --------------------------------------------------------------- POST
+    _ENDPOINTS = {"/v1/lookup": "lookup", "/v1/scan": "scan",
+                  "/v1/aggregate": "aggregate", "/v1/write": "write"}
+
+    def do_POST(self):  # noqa: N802
+        daemon = self.daemon
+        endpoint = self._ENDPOINTS.get(self.path.split("?", 1)[0])
+        if endpoint is None:
+            self._send_json(404, {"error": f"unknown path {self.path!r}"})
+            return
+        if not daemon._enter_request():
+            self._send_json(503, {"error": "server is shutting down"},
+                            headers={"Connection": "close"})
+            return
+        try:
+            self._dispatch(daemon, endpoint)
+        finally:
+            daemon._exit_request()
+
+    def _dispatch(self, daemon: Server, endpoint: str) -> None:
+        tenant = (self.headers.get("X-Tenant") or "default").strip() \
+            or "default"
+        if tenant != "default" and tenant not in daemon.config.tenants:
+            # unknown tenants collapse onto the default identity: the
+            # header is client-controlled, and minting per-value metric
+            # series / gate lanes / stats rows would let any scanner
+            # grow process memory and /metrics cardinality forever
+            tenant = "default"
+        klass = daemon.config.klass_for(tenant, endpoint)
+        # graceful degradation: under HARD pressure the bulk tier sheds
+        # FIRST — a prompt 429 + Retry-After beats queueing a scan the
+        # gate would block anyway; latency-class requests keep flowing
+        if klass == "bulk" and LEDGER.state() == "hard":
+            _oscope.account(_M_SHED[klass])
+            _oscope.account(REGISTRY.counter(
+                "serve.shed", labels={"tenant": tenant, "class": klass}))
+            daemon.tenant_stats.shed(tenant)
+            self._send_json(
+                429, {"error": "shed: memory pressure (bulk tier)",
+                      "retry_after_s": shed_retry_after_s()},
+                headers={"Retry-After":
+                         str(max(int(shed_retry_after_s()), 1))})
+            return
+        t0 = time.perf_counter()
+        rows = 0
+        op_report = None
+        respond = None
+        self._streamed = False
+        try:
+            body = self._body()
+            pin_cap = daemon.config.pin_bytes.get(tenant, 0)
+            with tenant_context(tenant, klass):
+                with _oscope.op_scope(f"serve.{endpoint}", tenant=tenant,
+                                      klass=klass) as op:
+                    if endpoint == "lookup" and pin_cap > 0:
+                        with page_pin_scope(tenant, pin_cap):
+                            rows, respond = self._handle(daemon,
+                                                         endpoint, body)
+                    else:
+                        rows, respond = self._handle(daemon, endpoint,
+                                                     body)
+                op_report = op.report()
+        except _HttpError as e:
+            if e.status >= 500:
+                _oscope.account(_M_ERRORS)
+                daemon.tenant_stats.error(tenant)
+            if self._abort_stream():
+                return
+            self._send_json(e.status, {"error": str(e)},
+                            headers=e.headers)
+            return
+        except (ValueError, KeyError, TypeError) as e:
+            if self._abort_stream():
+                return
+            self._send_json(400, {"error": str(e)})
+            return
+        except BrokenPipeError:
+            self.close_connection = True
+            return  # client went away mid-stream: nothing to send
+        except (CorruptedError, OSError) as e:
+            _oscope.account(_M_ERRORS)
+            daemon.tenant_stats.error(tenant)
+            if self._abort_stream():
+                return
+            self._send_json(500, {"error": str(e)})
+            return
+        finally:
+            dur = time.perf_counter() - t0
+            _H_REQ_S[klass].observe(dur)
+            REGISTRY.histogram(
+                "serve.request_s",
+                labels={"tenant": tenant, "class": klass}).observe(dur)
+            _oscope.account(_M_REQS[klass])
+            _oscope.account(REGISTRY.counter(
+                "serve.requests",
+                labels={"tenant": tenant, "class": klass}))
+            if rows:
+                _oscope.account(_M_ROWS, rows)
+            if op_report is not None:
+                daemon.tenant_stats.fold(tenant, op_report, rows, dur)
+        # the response (or the stream's terminating chunk) goes out only
+        # AFTER the request was metered: a client that has the full
+        # response is guaranteed to see it in /metrics and /debugz
+        try:
+            respond()
+        except (BrokenPipeError, ConnectionResetError):
+            # client gone between finishing the work and the write: a
+            # routine event, not a traceback
+            self.close_connection = True
+
+    # ------------------------------------------------------------ handlers
+    def _handle(self, daemon: Server, endpoint: str, body: dict):
+        """-> (rows, responder): the work happens here (inside the op
+        scope); ``responder()`` writes the response — called by
+        ``_dispatch`` AFTER metering, so a delivered response is always
+        visible in the metrics."""
+        if endpoint == "lookup":
+            return self._lookup(daemon, body)
+        if endpoint == "scan":
+            return self._scan(daemon, body)
+        if endpoint == "aggregate":
+            return self._aggregate(daemon, body)
+        return self._write(daemon, body)
+
+    def _abort_stream(self) -> bool:
+        """True when the response headers already went out as a chunked
+        stream: the only honest failure signal left is an unterminated
+        stream + closed connection (the client sees IncompleteRead
+        instead of a silently-truncated 'success')."""
+        if self._streamed:
+            self.close_connection = True
+            return True
+        return False
+
+    @staticmethod
+    def _required(body: dict, key: str):
+        v = body.get(key)
+        if v is None:
+            raise _HttpError(400, f"request needs {key!r}")
+        return v
+
+    def _lookup(self, daemon: Server, body: dict) -> int:
+        ds = daemon.dataset(str(self._required(body, "dataset")))
+        column = str(self._required(body, "column"))
+        keys = self._required(body, "keys")
+        if not isinstance(keys, list) or not keys:
+            raise _HttpError(400, "'keys' must be a non-empty list")
+        columns = body.get("columns") or []
+        res = ds.find_rows(column, keys, columns=columns)
+        hits = lookup_to_jsonable(res, keys)
+        doc = {"hits": hits, "rows_total": res.rows_total}
+        return res.rows_total, lambda: self._send_json(200, doc)
+
+    def _scan(self, daemon: Server, body: dict) -> int:
+        ds = daemon.dataset(str(self._required(body, "dataset")))
+        expr = expr_from_wire(body.get("where"))
+        columns = body.get("columns")
+        fmt = body.get("format", "json")
+        if fmt not in ("json", "arrow"):
+            raise _HttpError(400, f"unknown format {fmt!r} (json|arrow)")
+        from ..parallel.host_scan import scan_expr
+
+        prepared = ds._prepare_where(None, None, None, None, expr)[0] \
+            if expr is not None else None
+        # streamed: one chunk per file, produced as each file scans —
+        # the response begins before the last file is touched
+        self._streamed = True
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         _ARROW if fmt == "arrow" else _JSON)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        out = _ChunkedWriter(self.wfile)
+        total = 0
+        if fmt == "arrow":
+            import pyarrow as pa
+
+            writer = None
+            for i in range(ds.num_files):
+                pf = ds.file(i)
+                if prepared is not None:
+                    batches = [columns_to_arrow_batch(
+                        scan_expr(pf, prepared, columns=columns))]
+                else:
+                    atab = pf.read(columns=columns).to_arrow() \
+                        .combine_chunks()
+                    batches = atab.to_batches()
+                    if not batches:
+                        # a 0-row file yields no batches, but the
+                        # stream still needs its schema (an empty body
+                        # is not a valid IPC stream)
+                        batches = [pa.record_batch(
+                            [pa.array([], type=f.type)
+                             for f in atab.schema],
+                            schema=atab.schema)]
+                for batch in batches:
+                    if writer is None:
+                        writer = pa.ipc.new_stream(out, batch.schema)
+                    writer.write_batch(batch)
+                    total += batch.num_rows
+            if writer is not None:
+                writer.close()
+        else:
+            for i in range(ds.num_files):
+                pf = ds.file(i)
+                if prepared is not None:
+                    doc = columns_to_jsonable(
+                        scan_expr(pf, prepared, columns=columns))
+                else:
+                    doc = {k: [jsonable(x) for x in v]
+                           for k, v in pf.read(columns=columns)
+                           .to_arrow().to_pydict().items()}
+                n = len(next(iter(doc.values()))) if doc else 0
+                out.write((json.dumps({"columns": doc, "num_rows": n},
+                                      sort_keys=True) + "\n")
+                          .encode("utf-8"))
+                total += n
+            out.write((json.dumps({"done": True, "num_rows": total})
+                       + "\n").encode("utf-8"))
+        return total, out.finish
+
+    def _aggregate(self, daemon: Server, body: dict) -> int:
+        ds = daemon.dataset(str(self._required(body, "dataset")))
+        aggs = parse_aggs(self._required(body, "aggs"))
+        expr = expr_from_wire(body.get("where"))
+        group_by = body.get("group_by")
+        res = ds.aggregate(aggs, where=expr, group_by=group_by)
+        doc = {"aggregates": {k: jsonable(v) for k, v in res.items()},
+               "tiers": {k: v for k, v in res.counters.items() if v}}
+        if res.groups is not None:
+            doc["groups"] = [jsonable(k) for k in res.groups]
+        return 0, lambda: self._send_json(200, doc)
+
+    def _write(self, daemon: Server, body: dict) -> int:
+        name = str(self._required(body, "dataset"))
+        spec = daemon.config.datasets.get(name)
+        if spec is None:
+            raise _HttpError(404, f"unknown dataset {name!r}")
+        if not spec.writable:
+            raise _HttpError(403, f"dataset {name!r} is not writable")
+        rows = self._required(body, "rows")
+        if not isinstance(rows, dict) or not rows:
+            raise _HttpError(400, "'rows' must be a non-empty object of "
+                                  "column -> value list")
+        lengths = {len(v) for v in rows.values()
+                   if isinstance(v, list)}
+        if len(lengths) != 1 or not all(isinstance(v, list)
+                                        for v in rows.values()):
+            raise _HttpError(400, "'rows' columns must be equal-length "
+                                  "lists")
+        n = lengths.pop()
+        import pyarrow as pa
+
+        from ..algebra import SortingColumn
+        from ..dataset_writer import DatasetWriter
+
+        ds = daemon.dataset(name)
+        tab = pa.table(rows)
+        sorting = [SortingColumn(spec.sorting)] if spec.sorting else None
+        # one writer per request: ingest is visible atomically at the
+        # manifest commit, or not at all — the crash-safety contract the
+        # table layer proves.  No serve-level write lock: concurrent
+        # commits serialize at the manifest's own dir-locked
+        # read-modify-write (holding a lock across this blocking IO
+        # would be exactly what the lockcheck sanitizer flags).
+        w = DatasetWriter(spec.table, ds.schema, sorting=sorting,
+                          rows_per_file=spec.rows_per_file)
+        try:
+            w.write_arrow(tab)
+            manifest = w.commit()
+        finally:
+            w.close()
+        daemon._refresh_dataset(name)
+        _oscope.account(_M_COMMITS)
+        doc = {"version": manifest.version if manifest else None,
+               "rows": n}
+        return n, lambda: self._send_json(200, doc)

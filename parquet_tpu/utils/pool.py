@@ -12,13 +12,14 @@ smokes run width 1 vs N; results must be identical).
 from __future__ import annotations
 
 import contextvars
+import itertools
 import os
 import threading
 import time
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
-from typing import Optional
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
 
 from . import locks as _locks
 from .env import env_int, env_opt_bytes
@@ -60,6 +61,72 @@ _ACC_ADMITTED = _ledger.ledger_account("admission.in_flight")
 # like its scope does.
 _ADMISSION_HELD: "contextvars.ContextVar[bool]" = \
     contextvars.ContextVar("parquet_tpu_admission_held", default=False)
+
+# ---------------------------------------------------------------------------
+# Tenant QoS (the serving daemon's multi-tenant layer over the one gate)
+# ---------------------------------------------------------------------------
+
+# priority classes, best first: a `latency` ticket is always considered
+# before a `bulk` one regardless of arrival order — the scheduling
+# property the serve starvation test asserts.  Untagged (library) traffic
+# rides the default rank, keeping its exact FIFO semantics.
+_CLASS_RANKS = {"latency": 0, "default": 1, "bulk": 2}
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's QoS contract at the admission gate: a byte budget
+    (its private clamp INSIDE the shared budgets — 0/None = unlimited),
+    a weighted-fair ``weight`` (2.0 drains twice the bytes of 1.0 under
+    contention within a class), and a priority ``klass`` (``latency`` |
+    ``default`` | ``bulk``) that orders it against other tenants."""
+
+    name: str
+    budget_bytes: Optional[int] = None
+    weight: float = 1.0
+    klass: str = "default"
+
+
+# the active (tenant, klass) of the current request — a context variable
+# so every nested admission a request performs (scan spans, lookup page
+# reads, chunk-fallback decodes, even work fanned onto pool workers via
+# instrument_task's context copy) attributes to the tenant that asked
+_TENANT: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("parquet_tpu_tenant", default=None)
+
+
+class _Ticket:
+    """One waiter at the admission gate.  ``key`` is the scheduling
+    order (class rank, tenant virtual time at enqueue, arrival seq);
+    untagged tickets share (1, 0.0, seq) — exact FIFO."""
+
+    __slots__ = ("key", "tenant", "tier", "grant")
+
+    def __init__(self, key, tenant, tier, grant):
+        self.key = key
+        self.tenant = tenant
+        self.tier = tier
+        self.grant = grant
+
+
+def current_tenant() -> "Optional[Tuple[str, str]]":
+    """The active ``(tenant, class)`` pair, or None outside a tenant
+    context (library use: exactly the pre-daemon behavior)."""
+    return _TENANT.get()
+
+
+@contextmanager
+def tenant_context(name: str, klass: str = "default"):
+    """Run a block as ``name`` in priority class ``klass``: every
+    admission inside it is scheduled and accounted against the tenant's
+    :class:`TenantSpec` (weighted-fair within the class, clamped by the
+    tenant's budget).  The serving daemon wraps each request in one."""
+    token = _TENANT.set((name, klass if klass in _CLASS_RANKS
+                         else "default"))
+    try:
+        yield
+    finally:
+        _TENANT.reset(token)
 
 
 def in_shared_pool() -> bool:
@@ -232,8 +299,33 @@ class AdmissionController:
     reserved its bytes), tracked by a context variable so the guard
     follows work onto pool workers.
 
+    **Tenant QoS** (the serving daemon's layer — :func:`tenant_context`
+    + :meth:`configure_tenants`): tickets carry the active tenant's
+    priority class and weighted-fair virtual time, and the FIFO queue
+    generalizes into a scheduler with three properties the plain queue
+    cannot give a multi-tenant daemon:
+
+    - **priority classes** — among waiting tickets, ``latency`` class is
+      considered before ``default`` before ``bulk``, regardless of
+      arrival order: a flood of bulk scans cannot starve a p99-sensitive
+      lookup (the starvation test holds both tenants' budgets and
+      asserts the lookup p99).
+    - **per-tenant budgets** — each tenant's in-flight bytes are clamped
+      by its own ``TenantSpec.budget_bytes``; a ticket blocked ONLY by
+      its own tenant's budget is skipped over (its lane waits; other
+      tenants proceed), while a ticket blocked on the SHARED tier/global
+      budget reserves it (no later-keyed ticket may leapfrog — exactly
+      the old FIFO anti-starvation guarantee, now per scheduling key).
+      Untagged (library) traffic has no tenant lane, so its semantics
+      are byte-for-byte the old strict FIFO.
+    - **weighted fairness** — within a class, tickets order by their
+      tenant's virtual time (cumulative granted bytes / weight), so a
+      weight-2 tenant drains twice the bytes of a weight-1 rival under
+      contention instead of splitting by arrival luck.
+
     ``high_water`` records the max bytes ever admitted concurrently (the
-    budget-held proof the admission tests assert).  Waits are metered
+    budget-held proof the admission tests assert), and
+    ``tenant_high_water[name]`` the same per tenant.  Waits are metered
     per tier: ``lookup.admission_waits``/``lookup.admission_wait_s`` and
     ``read.admission_waits``/``read.admission_wait_s``; the granted
     bytes publish as the ``admission.in_flight`` ledger account."""
@@ -246,11 +338,72 @@ class AdmissionController:
                            "scan": "PARQUET_TPU_SCAN_BUDGET"}
         self._default_lookup = default_bytes
         self._cv = make_condition("pool.admission")
-        self._queue: "deque" = deque()
+        self._queue: list = []  # _Ticket objects, arrival order
+        self._seq = itertools.count()
         self._in_use = 0
         self._tier_use: dict = {}
+        self._tenants: "Dict[str, TenantSpec]" = {}
+        self._tenant_use: "Dict[str, int]" = {}
+        self._vtime: "Dict[str, float]" = {}
+        self._vfloor = 0.0  # global virtual clock (see acquire)
+        self.tenant_high_water: "Dict[str, int]" = {}
+        self.tenant_waits: "Dict[str, int]" = {}
         self.high_water = 0
         self.waits = 0
+
+    # ------------------------------------------------------------ tenants
+    def configure_tenants(self, specs) -> None:
+        """Install the tenant table (``{name: TenantSpec}`` or an
+        iterable of specs) — the serving daemon calls this from its
+        config at boot.  Unknown tenants admit with no private budget at
+        the default class (the spec-less library behavior)."""
+        if isinstance(specs, dict):
+            specs = specs.values()
+        table = {}
+        for s in specs:
+            if not isinstance(s, TenantSpec):
+                raise TypeError(f"expected TenantSpec, got "
+                                f"{type(s).__name__}")
+            if s.weight <= 0:
+                raise ValueError(f"tenant {s.name!r} weight must be > 0")
+            table[s.name] = s
+        with self._cv:
+            self._tenants = table
+
+    def clear_tenants(self) -> None:
+        """Forget the tenant table and its accounting (test isolation;
+        in-flight grants release against the generic counters)."""
+        with self._cv:
+            self._tenants = {}
+            self._tenant_use = {}
+            self._vtime = {}
+            self._vfloor = 0.0
+            self.tenant_high_water = {}
+            self.tenant_waits = {}
+
+    def tenant_spec(self, name: str) -> "Optional[TenantSpec]":
+        with self._cv:
+            return self._tenants.get(name)
+
+    def tenant_debug(self) -> dict:
+        """Per-tenant live state for ``/debugz``: configured contract,
+        bytes in flight, lifetime high water, and blocked-acquire
+        count."""
+        with self._cv:
+            names = set(self._tenants) | set(self._tenant_use) \
+                | set(self.tenant_high_water)
+            out = {}
+            for n in sorted(names):
+                spec = self._tenants.get(n)
+                out[n] = {
+                    "class": spec.klass if spec else "default",
+                    "weight": spec.weight if spec else 1.0,
+                    "budget_bytes": spec.budget_bytes if spec else None,
+                    "in_flight_bytes": self._tenant_use.get(n, 0),
+                    "high_water_bytes": self.tenant_high_water.get(n, 0),
+                    "waits": self.tenant_waits.get(n, 0),
+                }
+            return out
 
     def global_budget_bytes(self) -> Optional[int]:
         """``PARQUET_TPU_READ_BUDGET`` — the unified cap (None = unset,
@@ -273,33 +426,95 @@ class AdmissionController:
             return g
         return self._default_lookup if tier == "lookup" else 0
 
+    def _tenant_budget(self, name: "Optional[str]") -> int:
+        # under self._cv; 0 = no private clamp
+        if name is None:
+            return 0
+        spec = self._tenants.get(name)
+        if spec is None or not spec.budget_bytes:
+            return 0
+        return int(spec.budget_bytes)
+
+    def _may_grant_locked(self, ticket, budget: int,
+                          g: "Optional[int]", hard: bool) -> bool:
+        """The scheduling decision, under the gate's lock: may ``ticket``
+        be granted NOW?  Walks the queue in scheduling-key order
+        (class rank, weighted virtual time, arrival): a ticket blocked
+        only by its OWN tenant budget blocks its whole LANE — later
+        tickets of the same tenant wait behind it (the intra-lane FIFO
+        anti-starvation guarantee: a stream of small same-tenant
+        requests cannot leapfrog a big one) while OTHER lanes pass; a
+        ticket that fits its lane but not the shared tier/global budget
+        RESERVES the shared capacity (no later key may leapfrog — the
+        old cross-queue FIFO guarantee); an earlier-keyed ticket that
+        fits outright wins first."""
+        if hard:
+            return False
+        # tier budgets resolved once per evaluation, not once per queued
+        # ticket (budget_bytes is an env parse)
+        tier_budgets = {ticket.tier: budget}
+        blocked_lanes = set()
+        for t in sorted(self._queue, key=lambda t: t.key):
+            tb = self._tenant_budget(t.tenant)
+            tier_b = tier_budgets.get(t.tier)
+            if tier_b is None:
+                tier_b = tier_budgets[t.tier] = self.budget_bytes(t.tier)
+            lane_blocked = t.tenant is not None \
+                and t.tenant in blocked_lanes
+            fits_tenant = tb <= 0 or (self._tenant_use.get(t.tenant, 0)
+                                      + t.grant <= tb)
+            fits_tier = tier_b <= 0 or (self._tier_use.get(t.tier, 0)
+                                        + t.grant <= tier_b)
+            fits_global = g is None or g <= 0 \
+                or self._in_use + t.grant <= g
+            if t is ticket:
+                return fits_tenant and fits_tier and fits_global \
+                    and not lane_blocked
+            if not fits_tenant or lane_blocked:
+                # its lane is full (or an earlier lane-mate is): the
+                # whole lane waits in key order; other lanes pass
+                if t.tenant is not None:
+                    blocked_lanes.add(t.tenant)
+                continue
+            # an earlier-keyed ticket either fits (its thread will take
+            # the grant first) or is blocked on SHARED capacity (which
+            # it reserves) — either way this ticket waits
+            return False
+        raise AssertionError("ticket not in queue")  # pragma: no cover
+
     def acquire(self, nbytes: int, tier: str = "lookup",
                 give_up=None) -> int:
-        """Block FIFO until ``nbytes`` fit (and the ledger is below the
-        hard watermark); returns the granted amount to hand back to
-        :meth:`release` (0 when admission is disabled or the caller
-        already holds a grant).  ``give_up`` (a zero-arg predicate,
-        checked each wait lap) lets a waiter withdraw: its ticket leaves
-        the queue and 0 is granted — without it, an abandoned waiter
-        (a hedged read whose primary already won) would sit at the FIFO
-        head and head-of-line-block every other admission until
-        unrelated budget freed."""
+        """Block until ``nbytes`` fit under the scheduler (and the ledger
+        is below the hard watermark); returns the granted amount to hand
+        back to :meth:`release` (0 when admission is disabled or the
+        caller already holds a grant).  Untagged callers get strict FIFO
+        (the PR-9/PR-10 contract); callers inside a
+        :func:`tenant_context` are scheduled weighted-fair by priority
+        class with their tenant's private budget applied (class
+        docstring).  ``give_up`` (a zero-arg predicate, checked each
+        wait lap) lets a waiter withdraw: its ticket leaves the queue
+        and 0 is granted — without it, an abandoned waiter (a hedged
+        read whose primary already won) would sit at the queue head and
+        head-of-line-block every other admission until unrelated budget
+        freed."""
         if _ADMISSION_HELD.get():
             return 0
         budget = self.budget_bytes(tier)
         g = self.global_budget_bytes()
         hard_gate = _ledger.hard_watermark_bytes() > 0
-        if budget <= 0 and not hard_gate:
+        tkt_tenant = _TENANT.get()
+        tenant = tkt_tenant[0] if tkt_tenant is not None else None
+        klass = tkt_tenant[1] if tkt_tenant is not None else "default"
+        with self._cv:
+            tenant_budget = self._tenant_budget(tenant)
+            spec = self._tenants.get(tenant) if tenant else None
+        if budget <= 0 and tenant_budget <= 0 and not hard_gate:
             return 0
-        if budget <= 0:
-            # budget off but the hard watermark is live: the gate still
-            # blocks entry under hard pressure, granting 0 bytes
-            grant = 0
-        else:
-            grant = min(max(int(nbytes), 0), budget)
-            if g is not None and g > 0:
-                grant = min(grant, g)
-        ticket = object()
+        grant = min(max(int(nbytes), 0),
+                    *(b for b in (budget, tenant_budget) if b > 0)) \
+            if (budget > 0 or tenant_budget > 0) else 0
+        if g is not None and g > 0:
+            grant = min(grant, g)
         t0 = time.perf_counter()
         waited = False
         if hard_gate and _ledger.LEDGER.check_pressure() == "hard":
@@ -308,14 +523,29 @@ class AdmissionController:
             # serializing every other acquire/release behind cache locks
             waited = True
         with self._cv:
+            # scheduling key: class rank first, then the tenant's
+            # weighted virtual time AT ENQUEUE (WFQ start time), then
+            # arrival — untagged tickets share rank 1 / vtime 0, which
+            # reduces to exact arrival order.  The start time is floored
+            # at the global virtual clock (_vfloor, advanced at every
+            # grant): a newly-added or long-idle tenant joins at NOW
+            # instead of replaying its lifetime deficit as absolute
+            # priority over tenants that kept working.
+            rank = _CLASS_RANKS.get(klass, 1)
+            # untagged tickets also join at the floor (still exact FIFO
+            # among themselves — the floor is monotone): pinning them at
+            # 0.0 would let sustained library traffic permanently
+            # outrank every default-class tenant's positive vtime.  With
+            # no tenants configured the floor never moves, so pure
+            # library use keeps the exact pre-daemon FIFO keys.
+            vt = max(self._vtime.get(tenant, 0.0), self._vfloor) \
+                if tenant else self._vfloor
+            ticket = _Ticket((rank, vt, next(self._seq)), tenant, tier,
+                             grant)
             self._queue.append(ticket)
-            while (self._queue[0] is not ticket
-                   or (budget > 0
-                       and self._tier_use.get(tier, 0) + grant > budget)
-                   or (g is not None and g > 0
-                       and self._in_use + grant > g)
-                   or (hard_gate
-                       and _ledger.LEDGER.state() == "hard")):
+            while not self._may_grant_locked(
+                    ticket, budget, g,
+                    hard_gate and _ledger.LEDGER.state() == "hard"):
                 if give_up is not None and give_up():
                     # withdraw: the ticket must not keep later arrivals
                     # waiting behind a grant nobody wants anymore
@@ -328,11 +558,27 @@ class AdmissionController:
                 # own.  state() is the CHEAP refresh (account sum, no
                 # reclaim, no cache locks) — safe under the gate's lock.
                 self._cv.wait(timeout=0.05)
-            self._queue.popleft()
+            self._queue.remove(ticket)
             self._in_use += grant
             self._tier_use[tier] = self._tier_use.get(tier, 0) + grant
             if self._in_use > self.high_water:
                 self.high_water = self._in_use
+            if tenant is not None:
+                use = self._tenant_use.get(tenant, 0) + grant
+                self._tenant_use[tenant] = use
+                if use > self.tenant_high_water.get(tenant, 0):
+                    self.tenant_high_water[tenant] = use
+                # weighted virtual time: the fairness clock — a tenant
+                # pays granted bytes / weight from its floored start
+                # time, so heavier weights drain proportionally more
+                # under contention; the global clock advances with every
+                # grant so idle lanes cannot bank priority
+                w = spec.weight if spec is not None else 1.0
+                self._vfloor = max(self._vfloor, vt)
+                self._vtime[tenant] = vt + grant / max(w, 1e-9)
+                if waited:
+                    self.tenant_waits[tenant] = \
+                        self.tenant_waits.get(tenant, 0) + 1
             if waited:
                 self.waits += 1  # inside the lock: exact under herds
             _M_ADMITTED.set(self._in_use)
@@ -352,12 +598,18 @@ class AdmissionController:
                 _scope.add_to_current("read.admission_wait_s", wait_s)
         return grant
 
-    def release(self, grant: int, tier: str = "lookup") -> None:
+    def release(self, grant: int, tier: str = "lookup",
+                tenant: "Optional[str]" = None) -> None:
         if grant <= 0:
             return
+        if tenant is None:
+            got = _TENANT.get()
+            tenant = got[0] if got is not None else None
         with self._cv:
             self._in_use -= grant
             self._tier_use[tier] = self._tier_use.get(tier, 0) - grant
+            if tenant is not None and tenant in self._tenant_use:
+                self._tenant_use[tenant] -= grant
             _M_ADMITTED.set(self._in_use)
             _ACC_ADMITTED.set(self._in_use)
             self._cv.notify_all()
@@ -376,20 +628,25 @@ class AdmissionController:
         """``with admission.admit(span_bytes): pread + decode`` — the
         shape every admitted IO/decode span wraps.  Marks the context as
         holding a grant so nested gates pass through."""
+        got = _TENANT.get()
+        tenant = got[0] if got is not None else None
         grant = self.acquire(nbytes, tier=tier)
         token = _ADMISSION_HELD.set(True)
         try:
             yield grant
         finally:
             _ADMISSION_HELD.reset(token)
-            self.release(grant, tier=tier)
+            self.release(grant, tier=tier, tenant=tenant)
 
     def _reset(self) -> None:
-        """Test isolation only: forget the high-water mark and wait count
-        (the budget itself is env-driven)."""
+        """Test isolation only: forget the high-water marks and wait
+        counts (the budget itself is env-driven)."""
         with self._cv:
             self.high_water = self._in_use
             self.waits = 0
+            self.tenant_high_water = {t: n for t, n
+                                      in self._tenant_use.items() if n}
+            self.tenant_waits = {}
 
 
 _ADMISSION = AdmissionController()

@@ -687,7 +687,10 @@ with start_metrics_server(0) as srv:
     base = f"http://{srv.host}:{srv.port}"
     doc = json.loads(urllib.request.urlopen(base + "/debugz",
                                             timeout=5).read())
-    assert set(doc) == {"ledger", "caches", "admission", "pool", "ops"}
+    # required sections (subset: PR 11/12 added remote/tables, PR 15's
+    # daemon registers a tenants provider when one is running)
+    assert {"ledger", "caches", "admission", "pool",
+            "ops", "remote", "tables"} <= set(doc), sorted(doc)
     assert doc["caches"]["chunk"]["top"][0]["bytes"] > 0
     assert doc["admission"]["budget_bytes"]["lookup"] == 64 << 20
     assert urllib.request.urlopen(base + "/healthz",
@@ -1095,6 +1098,214 @@ for fam in ("parquet_tpu_agg_rg_answered_stats_total",
 print(f"aggregate smoke ok: zero-pread stats answers, value identity at "
       f"1% selectivity, dict-tier group-by over 97 keys")
 AGGEOF
+
+echo "=== serve smoke (daemon boot + two-tenant load + pressure + SIGTERM drain) ==="
+# ISSUE 15: the serving daemon.  (1) boot `python -m parquet_tpu serve`
+# on an ephemeral port, (2) run a two-tenant mixed load (lookup/scan/
+# aggregate/write) and assert the per-tenant metric families + QoS
+# budgets held in /debugz, (3) SIGTERM with a request in flight drains
+# before exit 0, (4) in-process: /healthz flips under induced hard
+# pressure, bulk sheds 429 first while the pinned-warm latency tenant
+# keeps serving.
+SERVE_DIR=$(mktemp -d)
+python - "$SERVE_DIR" <<'SRVGENEOF'
+import json
+import os
+import sys
+
+import numpy as np
+import pyarrow as pa
+
+import parquet_tpu as pq
+
+d = sys.argv[1]
+paths = []
+for fi in range(2):
+    n = 4000
+    p = os.path.join(d, f"events{fi}.parquet")
+    pq.write_table(
+        pa.table({"k": np.arange(fi * 100_000, fi * 100_000 + n,
+                                 dtype=np.int64),
+                  "v": (np.arange(n, dtype=np.int64) * 3) % 1000}),
+        p, options=pq.WriterOptions(row_group_size=800))
+    paths.append(p)
+tdir = os.path.join(d, "tbl")
+seed = pa.table({"k": np.arange(10, dtype=np.int64),
+                 "v": np.arange(10, dtype=np.int64)})
+w = pq.DatasetWriter(tdir, pq.schema_from_arrow(seed.schema),
+                     sorting=[pq.SortingColumn("k")])
+w.write_arrow(seed)
+w.commit()
+w.close()
+cfg = {"datasets": {"events": {"paths": paths},
+                    "tbl": {"table": tdir, "writable": True,
+                            "sorting": "k"}},
+       "tenants": {"online": {"class": "latency", "weight": 2.0,
+                              "budget_bytes": "8MiB",
+                              "pin_bytes": "2MiB"},
+                   "batch": {"class": "bulk",
+                             "budget_bytes": "1MiB"}}}
+with open(os.path.join(d, "serve.json"), "w") as f:
+    json.dump(cfg, f)
+print("serve corpus ready")
+SRVGENEOF
+python -m parquet_tpu serve --config "$SERVE_DIR/serve.json" --port 0 \
+    > "$SERVE_DIR/daemon.log" 2>&1 &
+SERVE_PID=$!
+for i in $(seq 1 100); do
+    grep -q "SIGTERM drains" "$SERVE_DIR/daemon.log" && break
+    sleep 0.2
+done
+SERVE_URL=$(sed -n 's/.* on \(http[^ ]*\) .*/\1/p' "$SERVE_DIR/daemon.log")
+python - "$SERVE_URL" "$SERVE_PID" <<'SRVLOADEOF'
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.request
+
+url, pid = sys.argv[1], int(sys.argv[2])
+
+
+def post(path, doc, tenant):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(doc).encode(),
+        headers={"X-Tenant": tenant})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+
+# --- two-tenant mixed load
+for i in range(4):
+    doc = json.loads(post("/v1/lookup",
+                          {"dataset": "events", "column": "k",
+                           "keys": [i * 7, i * 7 + 1, 424242],
+                           "columns": ["v"]}, "online"))
+    assert doc["hits"][2]["rows"] == []
+lines = post("/v1/scan", {"dataset": "events",
+                          "where": {"col": "v", "le": 50}},
+             "batch").decode().splitlines()
+assert json.loads(lines[-1])["done"]
+agg = json.loads(post("/v1/aggregate",
+                      {"dataset": "events",
+                       "aggs": ["count", "avg:v", "var:v"]}, "online"))
+assert agg["aggregates"]["count(*)"] == 8000
+wr = json.loads(post("/v1/write", {"dataset": "tbl",
+                                   "rows": {"k": [777], "v": [9]}},
+                     "batch"))
+assert wr["rows"] == 1
+back = json.loads(post("/v1/lookup", {"dataset": "tbl", "column": "k",
+                                      "keys": [777], "columns": ["v"]},
+                       "online"))
+assert back["hits"][0]["values"]["v"] == [9]
+
+# --- per-tenant families in /metrics, budgets held in /debugz
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read() \
+    .decode()
+for fam in ('parquet_tpu_serve_requests_total{class="latency",'
+            'tenant="online"}',
+            'parquet_tpu_serve_requests_total{class="bulk",'
+            'tenant="batch"}',
+            'parquet_tpu_serve_request_s_bucket',
+            'parquet_tpu_cache_page_pinned_bytes'):
+    assert fam in prom, fam
+dz = json.loads(urllib.request.urlopen(url + "/debugz",
+                                       timeout=10).read())
+tn = dz["tenants"]
+assert tn["online"]["requests"] >= 5, tn
+assert tn["online"]["pinned_bytes"] > 0, tn
+assert tn["online"]["high_water_bytes"] <= 8 << 20
+assert tn["batch"]["high_water_bytes"] <= 1 << 20
+assert urllib.request.urlopen(url + "/healthz",
+                              timeout=10).read() == b"ok\n"
+
+# --- SIGTERM drains the in-flight request before exit
+results = []
+
+
+def inflight():
+    results.append(json.loads(post(
+        "/v1/aggregate", {"dataset": "events",
+                          "aggs": ["count", "distinct:v"]}, "online")))
+
+
+t = threading.Thread(target=inflight)
+t.start()
+time.sleep(0.03)
+os.kill(pid, signal.SIGTERM)
+t.join(30)
+assert results and results[0]["aggregates"]["count(*)"] == 8000, results
+print("serve load ok: mixed two-tenant load, per-tenant families, "
+      "budgets held, in-flight request survived SIGTERM")
+SRVLOADEOF
+SERVE_RC=0
+wait $SERVE_PID || SERVE_RC=$?
+test "$SERVE_RC" -eq 0 || { echo "daemon exit $SERVE_RC"; \
+    cat "$SERVE_DIR/daemon.log"; exit 1; }
+grep -q "drained and stopped" "$SERVE_DIR/daemon.log"
+python - "$SERVE_DIR" <<'SRVPRESSEOF'
+# hard-pressure degradation, in-process (the watermark env must flip
+# mid-run): pinned-warm latency lookups keep serving under hard
+# pressure, bulk sheds 429+Retry-After first, /healthz flips, per-tenant
+# shed counts land in /debugz.
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+from parquet_tpu.obs.ledger import LEDGER
+from parquet_tpu.serve import Server
+
+d = sys.argv[1]
+cfg = json.load(open(os.path.join(d, "serve.json")))
+cfg["tenants"]["online"]["pin_bytes"] = "4MiB"
+
+
+def post(url, doc, tenant):
+    req = urllib.request.Request(url, data=json.dumps(doc).encode(),
+                                 headers={"X-Tenant": tenant})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.read()
+
+
+with Server(cfg, port=0) as srv:
+    u = srv.url
+    for _ in range(2):  # warm + pin the latency tenant's pages
+        post(u + "/v1/lookup", {"dataset": "events", "column": "k",
+                                "keys": [1, 2, 3], "columns": ["v"]},
+             "online")
+    ballast = LEDGER.account("check.serve_ballast")
+    ballast.set(1 << 30)
+    os.environ["PARQUET_TPU_MEM_HARD"] = str(1 << 20)
+    try:
+        hz = urllib.request.urlopen(u + "/healthz", timeout=10).read()
+        assert hz == b"hard\n", hz
+        try:
+            post(u + "/v1/scan", {"dataset": "events"}, "batch")
+            raise AssertionError("bulk scan was not shed")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+            assert e.headers.get("Retry-After") is not None
+        warm = json.loads(post(u + "/v1/lookup",
+                               {"dataset": "events", "column": "k",
+                                "keys": [1, 2, 3], "columns": ["v"]},
+                               "online"))
+        assert warm["rows_total"] == 3
+        dz = json.loads(urllib.request.urlopen(u + "/debugz",
+                                               timeout=10).read())
+        assert dz["tenants"]["batch"]["shed"] >= 1
+    finally:
+        ballast.set(0)
+        del os.environ["PARQUET_TPU_MEM_HARD"]
+    hz = urllib.request.urlopen(u + "/healthz", timeout=10).read()
+    assert hz == b"ok\n", hz
+print("serve pressure ok: healthz flipped hard, bulk shed 429 first, "
+      "pinned-warm latency lookups served throughout")
+SRVPRESSEOF
+rm -rf "$SERVE_DIR"
 
 echo "=== analysis smoke (invariant lint + lockcheck gate) ==="
 # the standing pre-merge correctness gate: AST lint over the package

@@ -805,3 +805,150 @@ def test_mmap_sink_abort_leaves_nothing(tmp_path, monkeypatch):
     assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
     with pytest.raises(ValueError):
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# derived folds: AVG / VARIANCE over (count, sum) / (count, sum, sum_sq)
+# ---------------------------------------------------------------------------
+
+
+def test_avg_variance_identity_numeric():
+    from parquet_tpu import avg, sum_sq, variance
+
+    rng = np.random.default_rng(11)
+    iv = rng.integers(-10_000, 10_000, 4000).astype(np.int64)
+    fv = rng.normal(scale=100.0, size=4000)
+    raw = _write_ours(pa.table({"i": iv, "f": fv}), row_group_size=500)
+    res = ParquetFile(raw).aggregate(
+        [avg("i"), variance("i"), variance("i", sample=True),
+         avg("f"), variance("f"), sum_sq("i")])
+    assert abs(res["avg(i)"] - iv.mean()) < 1e-9
+    assert abs(res["variance(i)"] - iv.var()) < 1e-5
+    assert abs(res["variance(i,sample)"] - iv.var(ddof=1)) < 1e-5
+    assert abs(res["avg(f)"] - fv.mean()) < 1e-9
+    assert abs(res["variance(f)"] - fv.var()) < 1e-7
+    assert res["sum_sq(i)"] == int((iv.astype(object) ** 2).sum())
+
+
+def test_avg_variance_nulls_and_empty():
+    from parquet_tpu import avg, count, variance
+
+    vals = [1.0, None, 3.0, None, 8.0]
+    raw = _write_ours(pa.table({"v": pa.array(vals, pa.float64()),
+                                "k": np.arange(5, dtype=np.int64)}))
+    res = ParquetFile(raw).aggregate([avg("v"), variance("v"),
+                                      variance("v", sample=True),
+                                      count("v")])
+    present = np.array([1.0, 3.0, 8.0])
+    assert res["count(v)"] == 3
+    assert abs(res["avg(v)"] - present.mean()) < 1e-12
+    assert abs(res["variance(v)"] - present.var()) < 1e-12
+    assert abs(res["variance(v,sample)"] - present.var(ddof=1)) < 1e-12
+    # zero matching rows -> None, never a ZeroDivisionError
+    res = ParquetFile(raw).aggregate([avg("v"), variance("v")],
+                                     where=col("k") >= 100)
+    assert res["avg(v)"] is None
+    assert res["variance(v)"] is None
+    # one row: population variance 0.0, sample variance undefined
+    res = ParquetFile(raw).aggregate([variance("v"),
+                                      variance("v", sample=True)],
+                                     where=col("k") == 0)
+    assert res["variance(v)"] == 0.0
+    assert res["variance(v,sample)"] is None
+
+
+def test_avg_variance_nan_propagates():
+    from parquet_tpu import avg, variance
+
+    fv = np.array([1.0, float("nan"), 2.0])
+    raw = _write_ours(pa.table({"f": fv}))
+    res = ParquetFile(raw).aggregate([avg("f"), variance("f")])
+    # the naive fold (np.mean/var) is NaN too: sums propagate NaN
+    assert res["avg(f)"] != res["avg(f)"]
+    assert res["variance(f)"] != res["variance(f)"]
+
+
+def test_avg_variance_group_by_and_dedup():
+    from parquet_tpu import avg, count, sum_, variance
+
+    rng = np.random.default_rng(5)
+    v = rng.integers(0, 100, 3000).astype(np.int64)
+    g = (np.arange(3000) % 5).astype(np.int64)
+    raw = _write_ours(pa.table({"v": v, "g": g}), row_group_size=700)
+    # asking for overlapping base + derived aggs must not double-count
+    res = ParquetFile(raw).aggregate(
+        [count("v"), sum_("v"), avg("v"), variance("v")], group_by="g")
+    for i, k in enumerate(res.groups):
+        sel = v[g == k]
+        assert res["count(v)"][i] == len(sel)
+        assert res["sum(v)"][i] == int(sel.sum())
+        assert abs(res["avg(v)"][i] - sel.mean()) < 1e-9
+        assert abs(res["variance(v)"][i] - sel.var()) < 1e-6
+
+
+def test_avg_variance_constant_column_not_negative():
+    from parquet_tpu import variance
+
+    v = np.full(2000, 123456789, dtype=np.int64)
+    raw = _write_ours(pa.table({"v": v}))
+    res = ParquetFile(raw).aggregate([variance("v")])
+    assert res["variance(v)"] == 0.0  # cancellation clamped, never <0
+
+
+def test_sum_sq_dict_tier_no_value_expansion():
+    from parquet_tpu import sum_sq
+
+    # low-cardinality column -> dictionary-encoded; the dict tier must
+    # answer sum_sq from (counts x entries^2)
+    v = np.tile(np.array([3, 7, 11], dtype=np.int64), 1000)
+    raw = _write_ours(pa.table({"v": v}))
+    res = ParquetFile(raw).aggregate([sum_sq("v")])
+    assert res["sum_sq(v)"] == int((v.astype(object) ** 2).sum())
+    assert res.counters["rg_answered_dict"] >= 1, res.counters
+
+
+def test_avg_variance_dataset_merge(tmp_path):
+    from parquet_tpu import avg, variance
+
+    rng = np.random.default_rng(9)
+    parts = []
+    allv = []
+    for i in range(3):
+        v = rng.integers(-500, 500, 1000).astype(np.int64)
+        allv.append(v)
+        p = tmp_path / f"p{i}.parquet"
+        write_table(pa.table({"v": v}), str(p))
+        parts.append(str(p))
+    v = np.concatenate(allv)
+    res = Dataset(parts).aggregate([avg("v"), variance("v")])
+    assert abs(res["avg(v)"] - v.mean()) < 1e-9
+    assert abs(res["variance(v)"] - v.var()) < 1e-6
+
+
+def test_derived_validation_errors():
+    from parquet_tpu import avg, variance
+    from parquet_tpu.io.aggregate import _validate
+
+    raw = _write_ours(pa.table({"s": ["a", "b"],
+                                "v": np.arange(2, dtype=np.int64)}))
+    pf = ParquetFile(raw)
+    with pytest.raises(ValueError, match="not defined"):
+        pf.aggregate([avg("s")])  # expands to sum(s): non-numeric
+    with pytest.raises(ValueError, match="derived"):
+        _validate(pf.schema, [variance("v")], None)  # internal misuse
+    with pytest.raises(ValueError):
+        variance("v").__class__("variance", "v", ddof=2)
+
+
+def test_avg_cli_spec(tmp_path, capsys):
+    from parquet_tpu.__main__ import main
+
+    p = tmp_path / "t.parquet"
+    v = np.arange(100, dtype=np.int64)
+    write_table(pa.table({"v": v}), str(p))
+    assert main(["aggregate", str(p), "--agg", "avg:v",
+                 "--agg", "var:v"]) == 0
+    doc = __import__("json").loads(capsys.readouterr().out)
+    assert abs(doc["aggregates"]["avg(v)"] - v.mean()) < 1e-9
+    assert abs(doc["aggregates"]["variance(v)"] - v.var()) < 1e-6
+    assert main(["aggregate", str(p), "--agg", "avg:"]) == 1

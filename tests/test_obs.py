@@ -327,19 +327,34 @@ def test_prometheus_format_lint(tmp_path):
     hist_fams = [f for f, t in typed.items() if t == "histogram"]
     assert hist_fams
     for fam in hist_fams:
-        buckets = []
-        count = None
+        # group per SERIES: a labeled histogram family (e.g.
+        # serve.request_s{class=...}) renders one cumulative ladder per
+        # label set — cumulativeness holds within a series, not across
+        buckets = {}
+        count = {}
+
+        def series_of(ln):
+            if "{" not in ln:
+                return ""
+            inner = ln.split("{", 1)[1].rsplit("}", 1)[0]
+            return ",".join(p for p in inner.split(",")
+                            if not p.startswith('le="'))
+
         for ln in lines:
             if ln.startswith(fam + "_bucket") and 'le="' in ln:
-                buckets.append((ln.rsplit('le="', 1)[1].split('"')[0],
-                                int(ln.rsplit(" ", 1)[1])))
-            elif ln.startswith(fam + "_count "):
-                count = int(ln.rsplit(" ", 1)[1])
+                buckets.setdefault(series_of(ln), []).append(
+                    (ln.rsplit('le="', 1)[1].split('"')[0],
+                     int(ln.rsplit(" ", 1)[1])))
+            elif ln.startswith(fam + "_count"):
+                count[series_of(ln)] = int(ln.rsplit(" ", 1)[1])
         if not buckets:
             continue  # label-variant family rendered elsewhere
-        counts = [n for _, n in buckets]
-        assert counts == sorted(counts), f"{fam} buckets not cumulative"
-        assert buckets[-1][0] == "+Inf" and buckets[-1][1] == count
+        for series, bs in buckets.items():
+            counts = [n for _, n in bs]
+            assert counts == sorted(counts), \
+                f"{fam}{{{series}}} buckets not cumulative"
+            assert bs[-1][0] == "+Inf" and bs[-1][1] == count[series], \
+                (fam, series)
 
 
 def test_prometheus_required_families_after_scan(tmp_path):
