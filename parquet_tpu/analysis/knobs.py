@@ -151,6 +151,28 @@ declare("PARQUET_TPU_SERVE_MAX_BODY", "bytes", 64 << 20,
         "serving-daemon request-body cap in bytes (larger bodies are "
         "refused 413 before buffering)")
 
+# -------------------------------------------------------------------- fleet
+declare("PARQUET_TPU_FLEET_VNODES", "int", 64,
+        "virtual nodes per fleet member on the consistent-hash ring "
+        "(more = smoother key/file spread, slower ring build)")
+declare("PARQUET_TPU_FLEET_PEER_TIMEOUT_S", "float", 10.0,
+        "per-peer sub-request timeout in seconds for fleet "
+        "scatter-gather when the request carries no deadline")
+declare("PARQUET_TPU_FLEET_MARGIN_S", "float", 0.25,
+        "seconds the fleet gather reserves out of the request deadline "
+        "for merging peer results (per-peer deadline = remaining - "
+        "margin)")
+declare("PARQUET_TPU_FLEET_HEDGE_S", "opt_float", None,
+        "seconds before a slow peer sub-request is hedged with a local "
+        "execution of its shard; unset adapts to the observed peer "
+        "latency (remote hedge machinery), 0 disables hedging")
+declare("PARQUET_TPU_FLEET_CAS_TTL_S", "float", 30.0,
+        "age in seconds after which a manifest CAS claim file left by a "
+        "crashed committer may be broken (takeover)")
+declare("PARQUET_TPU_FLEET_CAS_RETRIES", "int", 8,
+        "optimistic-concurrency re-reads a manifest commit attempts "
+        "when CAS arbitration reports a conflicting writer")
+
 # ------------------------------------------------------------ observability
 declare("PARQUET_TPU_TRACE", "str", "",
         "enable span tracing and flush Chrome trace-event JSON to this "

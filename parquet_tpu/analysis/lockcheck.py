@@ -154,6 +154,7 @@ def _hammer_workload(tmpdir: str) -> None:
         ds = pq.open_table(tdir)
         ds.read()
         _serve_hammer(tmpdir, path, tdir)
+        _fleet_hammer(tmpdir, path, tdir)
     finally:
         os.environ.pop("PARQUET_TPU_READ_BUDGET", None)
         os.environ.pop("PARQUET_TPU_PREFETCH", None)
@@ -228,6 +229,87 @@ def _serve_hammer(tmpdir: str, file_path: str, table_dir: str) -> None:
             t.join(60)
         if errors:
             raise errors[0]
+
+
+def _fleet_hammer(tmpdir: str, file_path: str, table_dir: str) -> None:
+    """Boot a 3-member in-process fleet (shared tenant table, ephemeral
+    ports repointed via ``set_peers``) and fire scatter-gather scans and
+    aggregates, routed lookups, and CROSS-MEMBER writes to one table —
+    the commit-arbitration path (``manifest.arbiter`` → peer transport →
+    ``serve.fleet``) racing the gather path must keep the combined lock
+    graph cycle-free."""
+    import json
+    import threading
+    import urllib.request
+
+    from parquet_tpu.serve import Server
+
+    names = ["n1", "n2", "n3"]
+    base = {"datasets": {"events": {"paths": [file_path]},
+                         "tbl": {"table": table_dir, "writable": True,
+                                 "sorting": "k"}},
+            "tenants": {"online": {"class": "latency", "weight": 2.0,
+                                   "budget_bytes": 4 << 20},
+                        "batch": {"class": "bulk",
+                                  "budget_bytes": 2 << 20}}}
+
+    def post(url, doc, tenant):
+        req = urllib.request.Request(
+            url, data=json.dumps(doc).encode(),
+            headers={"X-Tenant": tenant})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.read()
+
+    servers = []
+    try:
+        for name in names:
+            cfg = dict(base,
+                       cluster={"self": name,
+                                "peers": {n: None for n in names}})
+            servers.append(Server(cfg, port=0))
+        urls = {n: s.url for n, s in zip(names, servers)}
+        for s in servers:
+            s.set_peers(urls)
+        errors: list = []
+
+        def client(i: int) -> None:
+            u = servers[i % 3].url
+            try:
+                if i % 4 == 0:
+                    post(u + "/v1/scan",
+                         {"dataset": "tbl",
+                          "where": {"col": "v", "ge": 1 << 29}},
+                         "batch")
+                elif i % 4 == 1:
+                    post(u + "/v1/aggregate",
+                         {"dataset": "tbl",
+                          "aggs": ["count", "avg:v"]}, "online")
+                elif i % 4 == 2:
+                    post(u + "/v1/lookup",
+                         {"dataset": "tbl", "column": "k",
+                          "keys": list(range(i * 5, i * 5 + 32)),
+                          "columns": ["v"]}, "online")
+                else:
+                    post(u + "/v1/write",
+                         {"dataset": "tbl",
+                          "rows": {"k": [200_000 + i], "v": [i]}},
+                         "batch")
+            # ptlint: disable=PT005 -- not swallowed: collected into the
+            # errors list and re-raised after the join below
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(9)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        if errors:
+            raise errors[0]
+    finally:
+        for s in reversed(servers):
+            s.close()
 
 
 def hammer_main(argv: Optional[list] = None) -> int:

@@ -77,10 +77,27 @@ def expand_paths(paths_or_glob, missing: Optional[list] = None) -> List[str]:
     out: List[str] = []
     seen = set()
     for item in items:
-        # remote URLs pass through literally: there is no filesystem to
-        # glob against, and lexists() on "http://..." is always False —
-        # an object-store listing layer can expand patterns upstream
+        # remote URLs pass through literally — EXCEPT an http(s) prefix
+        # URL (trailing "/"), which expands through the store's listing
+        # endpoint the way a local glob expands (sorted, retried via the
+        # shared retry loop): fleet configs name table roots by URL
         if "://" in item:
+            if item.startswith(("http://", "https://")) \
+                    and item.endswith("/"):
+                from .io.remote import list_prefix
+
+                try:
+                    got = list_prefix(item)
+                except FileNotFoundError:
+                    if missing is None:
+                        raise
+                    missing.append(item)
+                    continue
+                for p in got:
+                    if p not in seen:
+                        seen.add(p)
+                        out.append(p)
+                continue
             if item not in seen:
                 seen.add(item)
                 out.append(item)

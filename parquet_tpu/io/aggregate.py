@@ -61,7 +61,8 @@ from .planner import (_collect_preds, _eval_tree, _intersect_intervals,
                       _merge_intervals, _pred_page_ords, _stats_alive,
                       _stats_covers, _tree_covers)
 
-__all__ = ["AggregateResult", "aggregate_file", "dataset_aggregate"]
+__all__ = ["AggregateResult", "aggregate_file", "dataset_aggregate",
+           "encode_agg_state", "decode_agg_state"]
 
 # resolved once (hot-path rule: no registry get-or-create on increments)
 _M_AGG_S = _histogram("agg.aggregate_s")
@@ -1193,6 +1194,125 @@ def _finalize(aggs, accs, groups, counters, lines, report, plan=None):
     return out
 
 
+# ---------------------------------------------------------------------------
+# partial-state wire codec (fleet scatter-gather)
+# ---------------------------------------------------------------------------
+# A fleet peer answers its shard with RAW partial state (the same
+# _state_only form the dataset layer merges), serialized losslessly to
+# JSON: the coordinator rebuilds _Acc objects and merges them exactly as
+# if the files were local, so a scattered aggregate is bit-identical to
+# a single-node one.  Values carry a type tag because JSON alone cannot
+# round-trip int64 magnitudes (precision), bytes, or NaN: ``None`` stays
+# None; else ``[tag, payload]`` with b=bool, i=int-as-string (exact at
+# any magnitude), f=float-as-repr (NaN/inf round-trip), x=bytes-as-hex,
+# s=str.
+
+
+def _enc_wire(v):
+    if v is None:
+        return None
+    if isinstance(v, bool) or (isinstance(v, np.bool_)):
+        return ["b", 1 if v else 0]
+    if isinstance(v, (int, np.integer)):
+        return ["i", str(int(v))]
+    if isinstance(v, (float, np.floating)):
+        return ["f", repr(float(v))]
+    if isinstance(v, (bytes, bytearray)):
+        return ["x", bytes(v).hex()]
+    if isinstance(v, str):
+        return ["s", v]
+    raise TypeError(f"unencodable aggregate-state value {v!r} "
+                    f"({type(v).__name__})")
+
+
+def _dec_wire(d):
+    if d is None:
+        return None
+    try:
+        tag, payload = d
+        if tag == "b":
+            return bool(payload)
+        if tag == "i":
+            return int(payload)
+        if tag == "f":
+            return float(payload)
+        if tag == "x":
+            return bytes.fromhex(payload)
+        if tag == "s":
+            return str(payload)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"bad aggregate-state wire value {d!r}: "
+                         f"{e}") from e
+    raise ValueError(f"bad aggregate-state wire tag {d!r}")
+
+
+def _enc_acc(acc: _Acc) -> dict:
+    doc: dict = {"n": int(acc.n)}
+    if acc.cur is not None:
+        doc["cur"] = _enc_wire(acc.cur)
+    if acc.total is not None:
+        doc["total"] = _enc_wire(acc.total)
+    if acc.distinct is not None:
+        doc["distinct"] = [_enc_wire(v) for v in acc.distinct]
+    if acc.heap is not None:
+        doc["heap"] = [_enc_wire(it.v if isinstance(it, _RevKey) else it)
+                       for it in acc.heap]
+    return doc
+
+
+def _dec_acc(doc: dict, agg: AggExpr, leaf) -> _Acc:
+    acc = _Acc(agg, leaf)
+    acc.add_count(int(doc.get("n", 0)))
+    acc.add_bound(_dec_wire(doc.get("cur")))
+    acc.add_sum(_dec_wire(doc.get("total")))
+    if acc.distinct is not None:
+        acc.distinct.update(_dec_wire(v) for v in doc.get("distinct", []))
+    if acc.heap is not None:
+        for v in doc.get("heap", []):
+            acc._offer(_dec_wire(v))
+    return acc
+
+
+def encode_agg_state(state) -> dict:
+    """JSON-safe document from one ``_state_only`` aggregate state."""
+    _aggs_l, accs, groups, counters, _lines = state
+    doc: dict = {"counters": {k: int(v) for k, v in counters.items()
+                              if v},
+                 "accs": [_enc_acc(a) for a in accs]}
+    if groups is not None:
+        doc["groups"] = [[_enc_wire(k), [_enc_acc(a) for a in gaccs]]
+                         for k, gaccs in groups.items()]
+    return doc
+
+
+def decode_agg_state(doc: dict, aggs, leaves):
+    """Rebuild ``(accs, groups, counters)`` from
+    :func:`encode_agg_state`'s document, against the coordinator's OWN
+    validated ``aggs``/``leaves`` (the wire doc is positional — it never
+    carries schema authority)."""
+    accs_doc = doc.get("accs")
+    if not isinstance(accs_doc, list) or len(accs_doc) != len(aggs):
+        raise ValueError(
+            f"aggregate-state doc has {len(accs_doc or [])} acc(s), "
+            f"expected {len(aggs)}")
+    accs = [_dec_acc(d, a, leaf)
+            for d, a, leaf in zip(accs_doc, aggs, leaves)]
+    groups = None
+    if "groups" in doc:
+        groups = {}
+        for key_doc, gdocs in doc["groups"]:
+            if len(gdocs) != len(aggs):
+                raise ValueError("aggregate-state group arity mismatch")
+            groups[_canon_key(_dec_wire(key_doc))] = [
+                _dec_acc(d, a, leaf)
+                for d, a, leaf in zip(gdocs, aggs, leaves)]
+    counters = {k: 0 for k in _COUNTER_KEYS}
+    for k, v in (doc.get("counters") or {}).items():
+        if k in counters:
+            counters[k] = int(v)
+    return accs, groups, counters
+
+
 def _publish(counters: Dict[str, int]) -> None:
     for tier, metric in _TIER_METRIC.items():
         n = counters.get(f"rg_answered_{tier}", 0)
@@ -1429,25 +1549,30 @@ def _prewarmed(pf, ranges, pslots: int):
 
 def dataset_aggregate(ds, aggs: Sequence[AggExpr], where=None,
                       group_by=None, policy=None,
-                      report=None) -> AggregateResult:
+                      report=None, _state_only: bool = False):
     """Aggregate across a whole :class:`~parquet_tpu.dataset.Dataset`:
     the predicate prepares ONCE for the corpus, manifest zone maps
     answer or drop whole part-files with zero footer IO
     (``agg.files_answered_manifest``), surviving files resolve in
     parallel on the shared pool, and partial states merge
     deterministically.  Degraded ``policy``: an unreadable file drops as
-    a unit (``report.files_skipped``)."""
+    a unit (``report.files_skipped``).  ``_state_only`` returns the raw
+    merged partial state instead of finalizing — the fleet peer path
+    (a shard's state crosses the wire via :func:`encode_agg_state` and
+    merges at the coordinator exactly like a local file's)."""
     t0 = time.perf_counter()
     with _oscope.maybe_op_scope("dataset.aggregate", files=len(ds.paths),
                                 aggs=len(list(aggs))):
         try:
             return _dataset_aggregate_impl(ds, aggs, where, group_by,
-                                           policy, report)
+                                           policy, report,
+                                           _state_only=_state_only)
         finally:
             _M_DS_AGG_S.observe(time.perf_counter() - t0)
 
 
-def _dataset_aggregate_impl(ds, aggs, where, group_by, policy, report):
+def _dataset_aggregate_impl(ds, aggs, where, group_by, policy, report,
+                            _state_only: bool = False):
     from ..utils.pool import map_in_order
     from .faults import NON_DATA_ERRORS
     from .manifest import manifest_all_match, manifest_may_match
@@ -1544,6 +1669,8 @@ def _dataset_aggregate_impl(ds, aggs, where, group_by, policy, report):
     if counters["files_answered_manifest"]:
         _oscope.account(_M_FILES_MANIFEST,
                         counters["files_answered_manifest"])
+    if _state_only:
+        return aggs, accs, groups, counters, lines
     return _finalize(aggs, accs, groups, counters, lines, report,
                      plan=plan)
 

@@ -49,7 +49,8 @@ __all__ = ["FaultPolicy", "ReadReport", "Deadline", "PolicySource",
            "FaultInjectingSink", "InjectedWriterCrash", "SinkFaultStats",
            "crash_consistency_check", "retry_call", "active_deadline",
            "FaultInjectingRemoteTransport", "RemoteFaultStats",
-           "LocalRangeServer", "SharedCrashState", "table_crash_check"]
+           "LocalRangeServer", "SharedCrashState", "table_crash_check",
+           "PeerChaos", "set_peer_chaos", "peer_chaos"]
 
 
 # ---------------------------------------------------------------------------
@@ -924,6 +925,25 @@ class LocalRangeServer:
                 if not self._authorized():
                     self._deny()
                     return
+                if data is None and (name == "" or name.endswith("/")):
+                    # prefix listing: GET on a "directory" URL returns a
+                    # JSON array of the object names under it — the
+                    # fixture behind Dataset's remote prefix expansion
+                    import json as _json
+
+                    with server._lock:
+                        kids = sorted(
+                            n[len(name):] for n in server._files
+                            if n.startswith(name) and n != name
+                            and "/" not in n[len(name):])  # one level,
+                        # like a local glob — nested "dirs" are elided
+                    body = _json.dumps(kids).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if data is None:
                     self.send_error(404, "no such object")
                     return
@@ -1007,6 +1027,94 @@ class LocalRangeServer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet peer chaos: deterministic failure injection on the peer protocol
+# ---------------------------------------------------------------------------
+class PeerChaos:
+    """Deterministic chaos on fleet peer sub-requests.  Installed via
+    :func:`set_peer_chaos`; the fleet peer client consults
+    :meth:`check` with the target peer's name before touching the
+    network, so tests can make a peer unreachable (``partition``),
+    slow (``stall``), or dead-after-N-requests (``kill_after``)
+    without owning the peer's socket.  (An ABRUPT socket-level death
+    is :meth:`~parquet_tpu.serve.Server.chaos_kill` on the peer
+    itself; this hook models the network between daemons.)"""
+
+    def __init__(self):
+        self._lock = make_lock("faults.peer_chaos")
+        self._mode: Dict[str, str] = {}       # name -> partition|stall
+        self._kill_after: Dict[str, int] = {}  # name -> requests left
+        self._stall_s = 0.05
+        self.trips: List[Tuple[str, str]] = []  # (peer, action) log
+
+    def partition(self, peer: str) -> None:
+        """Every sub-request to ``peer`` fails with a connection
+        error (retryable — the breaker sees a dead host)."""
+        with self._lock:
+            self._mode[peer] = "partition"
+
+    def stall(self, peer: str, seconds: float = 0.05) -> None:
+        """Sub-requests to ``peer`` sleep ``seconds`` before going
+        out — the slow-peer fixture the hedging path fires on."""
+        with self._lock:
+            self._mode[peer] = "stall"
+            self._stall_s = float(seconds)
+
+    def kill_after(self, peer: str, n: int) -> None:
+        """Allow ``n`` more sub-requests to ``peer``, then partition
+        it — the mid-scan chaos-kill trigger."""
+        with self._lock:
+            self._kill_after[peer] = int(n)
+
+    def heal(self, peer: Optional[str] = None) -> None:
+        with self._lock:
+            if peer is None:
+                self._mode.clear()
+                self._kill_after.clear()
+            else:
+                self._mode.pop(peer, None)
+                self._kill_after.pop(peer, None)
+
+    def check(self, peer: str) -> None:
+        """Called by the peer client before each sub-request; raises
+        ``ConnectionRefusedError`` (classified transient, breaker-
+        counted, like a real refused connect) when the peer is
+        chaos-dead."""
+        with self._lock:
+            left = self._kill_after.get(peer)
+            if left is not None:
+                if left <= 0:
+                    self._mode[peer] = "partition"
+                else:
+                    self._kill_after[peer] = left - 1
+            mode = self._mode.get(peer)
+            stall_s = self._stall_s
+            if mode is not None:
+                self.trips.append((peer, mode))
+        if mode == "partition":
+            raise ConnectionRefusedError(
+                f"peer {peer!r} chaos-partitioned")
+        if mode == "stall":
+            time.sleep(stall_s)
+
+
+_PEER_CHAOS_LOCK = make_lock("faults.peer_chaos_registry")
+_PEER_CHAOS: Optional[PeerChaos] = None
+
+
+def set_peer_chaos(chaos: Optional[PeerChaos]) -> None:
+    """Install (or with ``None`` clear) the process-wide peer-chaos
+    hook consulted by the fleet peer client."""
+    global _PEER_CHAOS
+    with _PEER_CHAOS_LOCK:
+        _PEER_CHAOS = chaos
+
+
+def peer_chaos() -> Optional[PeerChaos]:
+    with _PEER_CHAOS_LOCK:
+        return _PEER_CHAOS
 
 
 # ---------------------------------------------------------------------------

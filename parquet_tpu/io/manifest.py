@@ -42,18 +42,32 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..errors import CorruptedError
+from ..obs.scope import account as _account
+from ..obs.metrics import counter as _counter
+from ..utils.env import env_float, env_int
 from ..utils.locks import make_lock
 from .sink import AtomicFileSink
 
 __all__ = ["ManifestEntry", "Manifest", "MANIFEST_NAME", "PART_PREFIX",
+           "CLAIM_NAME",
            "read_manifest", "write_manifest", "commit_manifest",
            "collect_entry", "manifest_may_match", "manifest_all_match",
-           "sweep_orphans",
+           "sweep_orphans", "cas_commit_local", "set_commit_arbiter",
            "part_file_name"]
 
 MANIFEST_NAME = "_table_manifest.json"
 PART_PREFIX = "part-"
+# the cross-process CAS claim file (commit arbitration below).  The
+# ``.tmp`` suffix is load-bearing: a claim left by a crashed committer
+# is an orphan by definition, and recovery's sweep_orphans already
+# removes ``*.tmp`` — so the crash matrix's "zero leftovers" assertion
+# covers the claim with no new sweep rule.
+CLAIM_NAME = "_manifest_claim.tmp"
 _FORMAT = 1
+
+# commit-arbitration counters (resolved once; hot-path rule)
+_M_CAS_COMMITS = _counter("fleet.cas_commits")
+_M_CAS_CONFLICTS = _counter("fleet.cas_conflicts")
 
 
 # ---------------------------------------------------------------------------
@@ -242,30 +256,162 @@ def _dir_lock(table_dir):
         return lock
 
 
+# ---------------------------------------------------------------------------
+# cross-process commit arbitration (compare-and-swap on manifest version)
+# ---------------------------------------------------------------------------
+# The in-process dir lock serializes THIS process's writers; two daemons
+# ingesting the same table from different processes used to be an
+# acknowledged open edge ("cross-process writers still converge through
+# the version check their coordinator applies").  The arbiter closes it:
+# every commit_manifest read-modify-write now publishes through a
+# conditional write — commit the successor ONLY IF the live version
+# still equals the one the mutation was computed against — and a losing
+# writer re-reads and re-mutates (optimistic-concurrency abort/retry)
+# instead of silently forking history.
+#
+# An arbiter is ``fn(table_dir, expected_version, manifest, sink_wrap)
+# -> (committed, live_version)``.  The default, cas_commit_local,
+# implements the conditional write on shared storage with an O_EXCL
+# claim file; a fleet coordinator (serve/cluster.py) registers a
+# resolver that routes the conditional write to the table's ring-owner
+# daemon instead, making arbitration authoritative across nodes.
+
+_ARBITER_GUARD = make_lock("manifest.arbiter")
+_ARBITER_RESOLVER: Optional[Callable] = None
+
+
+def set_commit_arbiter(resolver: Optional[Callable]) -> None:
+    """Install (or, with None, remove) the commit-arbiter resolver:
+    ``resolver(table_dir) -> arbiter | None`` — None falls back to the
+    local CAS claim.  One resolver process-wide (the fleet layer owns
+    it); installing over a live one replaces it."""
+    global _ARBITER_RESOLVER
+    with _ARBITER_GUARD:
+        _ARBITER_RESOLVER = resolver
+
+
+def _resolve_arbiter(table_dir) -> Callable:
+    with _ARBITER_GUARD:
+        resolver = _ARBITER_RESOLVER
+    if resolver is not None:
+        arb = resolver(table_dir)
+        if arb is not None:
+            return arb
+    return cas_commit_local
+
+
+def _claim_path(table_dir) -> str:
+    return os.path.join(os.fspath(table_dir), CLAIM_NAME)
+
+
+def _try_claim(claim: str) -> bool:
+    try:
+        fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        return False
+    os.close(fd)
+    return True
+
+
+def _live_version(table_dir) -> int:
+    live = read_manifest(table_dir)
+    return live.version if live is not None else 0
+
+
+def cas_commit_local(table_dir, expected_version: int,
+                     manifest: Manifest,
+                     sink_wrap: Optional[Callable] = None
+                     ) -> Tuple[bool, int]:
+    """The default conditional write: an ``O_EXCL`` claim file is the
+    cross-process mutex, and the live version is re-read INSIDE the
+    claim — commit iff it still equals ``expected_version``.  A claim
+    older than ``PARQUET_TPU_FLEET_CAS_TTL_S`` belongs to a crashed
+    committer and is broken (takeover); a fresh claim held by a rival
+    reports a conflict so the caller re-reads and re-mutates.  Returns
+    ``(committed, live_version_seen)``."""
+    claim = _claim_path(table_dir)
+    if not _try_claim(claim):
+        try:
+            # ptlint: disable=PT004 -- claim-file AGE against its wall-
+            # clock mtime (file timestamps are wall time), not deadline
+            # or backoff arithmetic
+            age = time.time() - os.path.getmtime(claim)
+        except OSError:
+            age = None  # released between open and stat: plain conflict
+        if age is None or age <= max(
+                env_float("PARQUET_TPU_FLEET_CAS_TTL_S"), 0.0):
+            return False, _live_version(table_dir)
+        # expired: the holder died between part rename and manifest
+        # commit (the crash-matrix boundary) — break the claim and
+        # race for it fairly
+        try:
+            os.unlink(claim)
+        except OSError:
+            pass
+        if not _try_claim(claim):
+            return False, _live_version(table_dir)
+    try:
+        cur = _live_version(table_dir)
+        if cur != expected_version:
+            return False, cur
+        write_manifest(table_dir, manifest, sink_wrap=sink_wrap)
+        return True, manifest.version
+    finally:
+        try:
+            os.unlink(claim)
+        except OSError:
+            pass
+
+
 def commit_manifest(table_dir, mutate: Callable[[Manifest],
                                                 Optional[Manifest]],
                     sink_wrap: Optional[Callable] = None
                     ) -> Optional[Manifest]:
-    """One read-modify-write snapshot commit under the table's lock:
-    ``mutate(live)`` receives the CURRENT live manifest (an empty v0 one
-    for a fresh table) and returns the successor — or ``None`` to abort
-    (the optimistic-concurrency conflict path: a compaction whose inputs
-    a rival commit already removed).  The successor's version is stamped
-    ``live.version + 1`` here so no mutator can fork the history."""
+    """One read-modify-write snapshot commit: ``mutate(live)`` receives
+    the CURRENT live manifest (an empty v0 one for a fresh table) and
+    returns the successor — or ``None`` to abort (the optimistic-
+    concurrency conflict path: a compaction whose inputs a rival commit
+    already removed).  The successor's version is stamped
+    ``live.version + 1`` here so no mutator can fork the history.
+
+    Publication goes through the commit arbiter (module comment above):
+    a conditional write on the version the mutation was computed
+    against.  On conflict the loop re-reads and re-mutates — up to
+    ``PARQUET_TPU_FLEET_CAS_RETRIES`` times, then raises ``OSError``
+    (transient: a retry loop above may re-attempt the whole commit)."""
+    arbiter = _resolve_arbiter(table_dir)
+    attempts = max(env_int("PARQUET_TPU_FLEET_CAS_RETRIES"), 0) + 1
     with _dir_lock(table_dir):
-        live = read_manifest(table_dir)
-        if live is None:
-            live = Manifest(version=0)
-        new = mutate(live)
-        if new is None:
-            return None
-        new.version = live.version + 1
-        if not new.created:
-            # ptlint: disable=PT004 -- manifest creation timestamp (a
-            # persisted record), not deadline/backoff arithmetic
-            new.created = int(time.time())
-        write_manifest(table_dir, new, sink_wrap=sink_wrap)
-        return new
+        for attempt in range(attempts):
+            live = read_manifest(table_dir)
+            if live is None:
+                live = Manifest(version=0)
+            # capture BEFORE stamping: mutate() may return the live
+            # object itself, and the CAS must compare against the
+            # version the mutation was computed from
+            expected = live.version
+            new = mutate(live)
+            if new is None:
+                return None
+            new.version = expected + 1
+            if not new.created:
+                # ptlint: disable=PT004 -- manifest creation timestamp
+                # (a persisted record), not deadline/backoff arithmetic
+                new.created = int(time.time())
+            ok, _seen = arbiter(table_dir, expected, new, sink_wrap)
+            if ok:
+                _account(_M_CAS_COMMITS)
+                return new
+            _account(_M_CAS_CONFLICTS)
+            if attempt + 1 < attempts:
+                # a rival holds the claim or already advanced the
+                # version: back off briefly, then re-read + re-mutate
+                time.sleep(min(0.01 * (attempt + 1), 0.2))
+        raise OSError(
+            f"manifest commit for {os.fspath(table_dir)!r} lost the CAS "
+            f"race {attempts} time(s) (PARQUET_TPU_FLEET_CAS_RETRIES); "
+            f"a rival committer holds the claim or keeps advancing the "
+            f"version")
 
 
 # ---------------------------------------------------------------------------

@@ -75,7 +75,8 @@ __all__ = ["HttpSource", "ObjectStoreSource", "HttpTransport",
            "remote_debug", "hedge_delay_s", "observed_pread_ewma",
            "drain_connection_pools", "parallel_preads",
            "parallel_pread_slots", "register_auth_hook",
-           "unregister_auth_hook"]
+           "unregister_auth_hook", "list_prefix", "classify_status",
+           "gunzip_body"]
 
 # resolved once: the pread hot path must not take the registry's
 # get-or-create lock (only each metric's own)
@@ -233,14 +234,16 @@ class HttpTransport:
 
     def _roundtrip(self, method: str,
                    headers: Optional[dict] = None,
-                   path_override: Optional[str] = None
+                   path_override: Optional[str] = None,
+                   body: Optional[bytes] = None
                    ) -> Tuple[int, Dict[str, str], bytes]:
         path = self._request_path if path_override is None \
             else path_override
         while True:
             conn, reused = self._checkout()
             try:
-                conn.request(method, path, headers=headers or {})
+                conn.request(method, path, body=body,
+                             headers=headers or {})
                 resp = conn.getresponse()
                 status = resp.status
                 hdrs = {k.lower(): v for k, v in resp.getheaders()}
@@ -281,6 +284,19 @@ class HttpTransport:
         headers = dict(extra_headers or {})
         headers["Range"] = f"bytes={offset}-{offset + size - 1}"
         return self._roundtrip("GET", headers, path_override)
+
+    def post(self, path: str, body: bytes,
+             extra_headers: Optional[dict] = None
+             ) -> Tuple[int, Dict[str, str], bytes]:
+        """POST ``body`` to ``path`` on this transport's host (the fleet
+        peer-protocol verb).  Same pooling/stale-reuse mechanics as the
+        range GETs; safe here because every peer sub-request is
+        idempotent (reads, or version-conditional commits)."""
+        headers = dict(extra_headers or {})
+        headers.setdefault("Content-Type", "application/json")
+        headers["Content-Length"] = str(len(body))
+        return self._roundtrip("POST", headers, path_override=path,
+                               body=body)
 
     def idle_connections(self) -> int:
         return len(self._pool)
@@ -1025,6 +1041,128 @@ def _retry_after(hdrs: Dict[str, str]) -> Optional[float]:
         return max(0.0, float(v))
     except ValueError:
         return None  # HTTP-date form: treat as unspecified
+
+
+def classify_status(status: int, hdrs: Dict[str, str], host: str,
+                    path: str, what: str = "request") -> None:
+    """Raise the :class:`~parquet_tpu.errors.RemoteError` subclass a
+    non-2xx ``status`` classifies as (429 → throttled with its
+    Retry-After, 5xx → transient, other 4xx → terminal); 2xx returns.
+    The one classification table the prefix-listing fetch and the fleet
+    peer protocol share with the pread path — the decision must not
+    drift between surfaces."""
+    if 200 <= status < 300:
+        return
+    if status == 429:
+        raise RemoteThrottledError(
+            f"throttled on {what}", retry_after=_retry_after(hdrs),
+            host=host, status=status, path=path)
+    if 500 <= status < 600:
+        raise RemoteTransientError(
+            f"server error on {what}", host=host, status=status,
+            path=path)
+    raise RemoteTerminalError(
+        f"{what} failed", host=host, status=status, path=path)
+
+
+def gunzip_body(data: bytes, host: str = "", path: str = "") -> bytes:
+    """Decompress a ``Content-Encoding: gzip`` response body.  A
+    TRUNCATED or torn stream (EOFError / zlib error mid-member) is a
+    connection artifact, not data corruption — classified
+    :class:`~parquet_tpu.errors.RemoteTransientError` so the shared
+    retry loop re-fetches instead of surfacing a parse error."""
+    import gzip as _gzip
+    import zlib as _zlib
+
+    try:
+        return _gzip.decompress(data)
+    except (EOFError, _zlib.error, OSError) as e:
+        raise RemoteTransientError(
+            f"truncated/torn gzip body: {e}", host=host,
+            path=path) from e
+
+
+def _parse_listing(body: bytes, base_url: str) -> List[str]:
+    """File URLs from a prefix-listing response: a JSON array of names/
+    URLs, a JSON object with a ``files``/``keys``/``entries`` list, or
+    (fallback) HTML ``href`` attributes.  Relative names resolve against
+    the listing URL; nested "directories" (trailing ``/``) and parent
+    links are dropped — listings are one level, like a local glob."""
+    import json as _json
+    from urllib.parse import urljoin
+
+    names: List[str] = []
+    try:
+        doc = _json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        doc = None
+    if isinstance(doc, list):
+        names = [str(n) for n in doc]
+    elif isinstance(doc, dict):
+        for key in ("files", "keys", "entries"):
+            if isinstance(doc.get(key), list):
+                names = [str(n) for n in doc[key]]
+                break
+    else:
+        names = re.findall(r'href="([^"?#]+)"',
+                           body.decode("utf-8", "replace"))
+    out: List[str] = []
+    for n in names:
+        if not n or n.endswith("/") or n.startswith((".", "..")):
+            continue
+        out.append(urljoin(base_url, n))
+    return sorted(set(out))
+
+
+def list_prefix(url: str, policy=None) -> List[str]:
+    """Expand an ``http(s)://.../prefix/`` listing URL into the sorted
+    file URLs under it — the remote analog of a local glob, used by
+    ``Dataset`` path expansion (and fleet configs naming table roots by
+    URL).  The listing GET runs through the shared
+    :func:`~parquet_tpu.io.faults.retry_call` loop (transient/throttled
+    responses re-attempt under jittered backoff) and the host's circuit
+    breaker.  An empty listing raises ``FileNotFoundError`` to match an
+    unmatched glob."""
+    from .faults import FaultPolicy, retry_call
+
+    transport = HttpTransport(url)
+    host = transport.host
+    breaker = breaker_for(host)
+
+    def once(_o, _s):
+        if not breaker.allow():
+            _account(_M_FAIL_FAST)
+            raise RemoteCircuitOpenError(f"circuit open for {host}",
+                                         host=host, path=url)
+        try:
+            status, hdrs, body = transport._roundtrip(
+                "GET", {"Accept": "application/json"})
+        except (HTTPException, socket.timeout, TimeoutError, OSError) as e:
+            breaker.record_failure()
+            raise RemoteTransientError(f"listing failed: {e}", host=host,
+                                       path=url) from e
+        if status == 429:
+            breaker.record_inconclusive()
+        elif 500 <= status < 600:
+            breaker.record_failure()
+        else:
+            breaker.record_success()
+        classify_status(status, hdrs, host, url, what="prefix listing")
+        if hdrs.get("content-encoding", "").lower() == "gzip":
+            body = gunzip_body(body, host=host, path=url)
+        return _parse_listing(body, url)
+
+    try:
+        files = retry_call(once, 0, 0,
+                           policy if policy is not None
+                           else FaultPolicy(max_retries=2,
+                                            backoff_s=0.05))
+    finally:
+        transport.close()
+    if not files:
+        raise FileNotFoundError(f"prefix listing {url!r} matched no "
+                                f"files")
+    return files
 
 
 # ---------------------------------------------------------------------------

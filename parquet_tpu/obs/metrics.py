@@ -515,6 +515,32 @@ _CORE_COUNTERS = (
     # remote auth hooks (io/remote.py): 401/403 -> refresh-and-retry
     ("remote.auth_refreshes", "credential refreshes triggered by "
      "401/403 responses (auth hook re-invoked)"),
+    # serving-daemon request-rate + auth gates (satellites of the fleet
+    # PR): per-tenant token buckets and bearer-token checks
+    ("serve.qps_rejections", "requests refused 429 by a tenant's "
+     "token-bucket QPS limit"),
+    ("serve.auth_failures", "requests refused 401 by the per-tenant "
+     "bearer-token check"),
+    # fleet mode (serve/cluster.py): consistent-hash routing,
+    # scatter-gather, peer hedging, and cross-node commit arbitration
+    ("fleet.forwards", "lookup key subsets / sub-requests forwarded to "
+     "ring-owner peers"),
+    ("fleet.gathers", "scatter-gather requests coordinated across the "
+     "fleet"),
+    ("fleet.peer_errors", "peer sub-requests that failed (before any "
+     "local fallback)"),
+    ("fleet.local_fallbacks", "peer shards recomputed locally after a "
+     "peer failure or hedge win"),
+    ("fleet.hedges_issued", "local hedge executions launched against "
+     "slow peer sub-requests"),
+    ("fleet.hedges_won", "peer sub-requests whose local hedge finished "
+     "first"),
+    ("fleet.peer_skips", "peer shards dropped from a degraded gather "
+     "(skip accounting in the response)"),
+    ("fleet.cas_commits", "manifest commits arbitrated through the CAS "
+     "hook"),
+    ("fleet.cas_conflicts", "CAS commit attempts aborted by a rival "
+     "version (re-read and re-mutated)"),
 )
 
 

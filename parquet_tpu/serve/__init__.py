@@ -1,9 +1,15 @@
 """The serving daemon: ``python -m parquet_tpu serve --config serve.json``
 or the programmatic :class:`Server` — multi-tenant QoS over lookups,
 scans, aggregates, and writes (see serve/server.py for the full story).
+A ``cluster`` config turns N daemons into a shard-aware fleet
+(consistent-hash routing, scatter-gather, commit arbitration — see
+serve/cluster.py).
 """
 
-from .config import DatasetSpec, ServeConfig, load_config
+from .cluster import FleetRouter, HashRing, shard_key, splitmix64
+from .config import ClusterSpec, DatasetSpec, ServeConfig, load_config
 from .server import Server
 
-__all__ = ["Server", "ServeConfig", "DatasetSpec", "load_config"]
+__all__ = ["Server", "ServeConfig", "DatasetSpec", "ClusterSpec",
+           "load_config", "FleetRouter", "HashRing", "shard_key",
+           "splitmix64"]

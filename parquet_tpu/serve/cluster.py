@@ -1,0 +1,485 @@
+"""Fleet layer for the serving daemon: consistent-hash routing,
+scatter-gather with peer hedging, and cross-node commit arbitration.
+
+A fleet is N daemons over shared storage, each booted with the SAME
+``cluster`` config block (peer name → base URL).  Three mechanisms turn
+them into one logical server:
+
+- **Consistent-hash routing** — :class:`HashRing` places
+  ``PARQUET_TPU_FLEET_VNODES`` virtual nodes per peer on a 64-bit ring.
+  Point lookups route each key by the SAME splitmix64 finalizer
+  ``dataset_writer._partition_ids`` shards part-files with, so a
+  key-partitioned table's keys and its files hash consistently; scans
+  and aggregates shard by file path.  Adding/removing a peer moves only
+  the ring arcs it owned.
+- **Scatter-gather** — :meth:`FleetRouter.gather` fans sub-requests to
+  shard owners with a per-peer deadline carved from the request
+  deadline (minus ``PARQUET_TPU_FLEET_MARGIN_S`` for the merge), hedges
+  slow peers with a LOCAL execution of the shard after
+  ``PARQUET_TPU_FLEET_HEDGE_S`` (unset → the adaptive p95 delay from
+  :func:`~parquet_tpu.io.remote.hedge_delay_s`; storage is shared, so
+  the local replica is always a valid hedge target), falls back to
+  local execution when a peer fails outright, and — only when even the
+  fallback fails — either skips the shard with accounting
+  (``fleet.peer_skips``, surfaced in the response's fleet report) or
+  fails fast when the caller demanded exactness.
+- **Commit arbitration** — :meth:`FleetRouter.arbiter_resolver` routes
+  each table's conditional manifest write (compare-and-swap on the
+  manifest version) to the table's ring owner over ``/v1/fleet/commit``,
+  making cross-node commit arbitration authoritative: two daemons
+  ingesting one table converge through optimistic-concurrency abort at
+  a single arbiter instead of racing the shared filesystem.
+
+The peer transport is :class:`~parquet_tpu.io.remote.HttpTransport`
+POSTs under the SAME per-host circuit breakers and failure
+classification as remote preads (``breaker_for``/``classify_status``),
+so a dead peer fails fast after ``PARQUET_TPU_REMOTE_BREAKER``
+consecutive errors and heals through the half-open probe.  The chaos
+hook (:func:`~parquet_tpu.io.faults.peer_chaos`) is consulted before
+every sub-request.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.client import HTTPException
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..errors import (RemoteCircuitOpenError, RemoteError,
+                      RemoteTransientError)
+from ..io.faults import active_deadline, peer_chaos
+from ..io.remote import (HttpTransport, breaker_for, classify_status,
+                         gunzip_body, hedge_delay_s)
+from ..obs.metrics import counter as _counter
+from ..obs.scope import account as _account
+from ..utils.env import env_float, env_int, env_opt_float
+from ..utils.locks import make_lock
+from .config import ClusterSpec
+
+__all__ = ["splitmix64", "shard_key", "HashRing", "FleetRouter"]
+
+_M_FORWARDS = _counter("fleet.forwards")
+_M_GATHERS = _counter("fleet.gathers")
+_M_PEER_ERRORS = _counter("fleet.peer_errors")
+_M_LOCAL_FALLBACKS = _counter("fleet.local_fallbacks")
+_M_HEDGES_ISSUED = _counter("fleet.hedges_issued")
+_M_HEDGES_WON = _counter("fleet.hedges_won")
+_M_PEER_SKIPS = _counter("fleet.peer_skips")
+
+_MASK64 = (1 << 64) - 1
+
+
+def splitmix64(x: int) -> int:
+    """Scalar splitmix64 finalizer — bit-identical to the vectorized
+    ``dataset_writer._partition_ids`` hash, so a key routes to the same
+    ring arc the writer's key-partitioning spread it by."""
+    x &= _MASK64
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & _MASK64
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & _MASK64
+    x ^= x >> 31
+    return x
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & _MASK64
+    return h
+
+
+def shard_key(value) -> int:
+    """64-bit ring position for a routing key: ints go straight through
+    splitmix64 (matching the writer's partitioner; NULL → 0 like
+    ``_partition_ids``); strings/bytes (file paths, vnode labels) fold
+    through FNV-1a first so text keys get avalanche too."""
+    if value is None:
+        value = 0
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, int):
+        return splitmix64(value)
+    if isinstance(value, float):
+        # float keys route by their exact repr (NaN included) — the
+        # same text a JSON round-trip preserves
+        value = repr(value)
+    if isinstance(value, str):
+        value = value.encode("utf-8")
+    if isinstance(value, (bytes, bytearray)):
+        return splitmix64(_fnv1a64(bytes(value)))
+    raise TypeError(f"unroutable shard key {value!r}")
+
+
+class HashRing:
+    """Consistent-hash ring over the fleet's peer names.  IMMUTABLE
+    once built (membership is config; repointing a peer's URL does not
+    move the ring), so lookups are lock-free."""
+
+    def __init__(self, nodes, vnodes: Optional[int] = None):
+        self.nodes: Tuple[str, ...] = tuple(sorted(set(nodes)))
+        if not self.nodes:
+            raise ValueError("hash ring needs at least one node")
+        self.vnodes = (int(vnodes) if vnodes is not None
+                       else max(env_int("PARQUET_TPU_FLEET_VNODES"), 1))
+        points: List[Tuple[int, str]] = []
+        for name in self.nodes:
+            for v in range(self.vnodes):
+                points.append((shard_key(f"{name}#{v}"), name))
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+
+    def owner(self, h: int) -> str:
+        """The peer owning ring position ``h`` (first vnode clockwise)."""
+        import bisect
+
+        i = bisect.bisect_right(self._hashes, h & _MASK64)
+        if i == len(self._points):
+            i = 0
+        return self._points[i][1]
+
+    def owner_of_key(self, key) -> str:
+        return self.owner(shard_key(key))
+
+    def owner_of_path(self, path: str) -> str:
+        return self.owner(shard_key(str(path)))
+
+    def spread(self, items) -> Dict[str, list]:
+        """Partition ``items`` (strings hashed as paths) by owner."""
+        out: Dict[str, list] = {}
+        for it in items:
+            out.setdefault(self.owner_of_path(str(it)), []).append(it)
+        return out
+
+
+class _PeerDeadError(RemoteTransientError):
+    """A peer sub-request that produced no result inside its carved
+    deadline — same retryability class as a connection failure."""
+
+
+class FleetRouter:
+    """One daemon's view of the fleet: the ring, the peer transports,
+    the gather engine, and the commit-arbiter resolver.  Owned by
+    :class:`~parquet_tpu.serve.Server` when its config carries a
+    ``cluster`` block."""
+
+    def __init__(self, cluster: ClusterSpec,
+                 tokens: Optional[Dict[str, str]] = None):
+        self.spec = cluster
+        self.self_name = cluster.self_name
+        self.ring = HashRing(cluster.peers)
+        self._lock = make_lock("serve.fleet")
+        self._urls: Dict[str, Optional[str]] = dict(cluster.peers)
+        self._transports: Dict[str, HttpTransport] = {}
+        self._tokens = dict(tokens or {})
+
+    # -- membership -------------------------------------------------------
+    def set_peers(self, urls: Dict[str, str]) -> None:
+        """Repoint peer base URLs (ephemeral-port boot: daemons bind
+        first, then every member learns the realized addresses).  Only
+        URLs move; ring membership is fixed by the config."""
+        with self._lock:
+            for name, url in urls.items():
+                if name not in self._urls:
+                    raise ValueError(f"unknown fleet peer {name!r}")
+                old = self._transports.pop(name, None)
+                if old is not None:
+                    old.close()
+                self._urls[name] = url or None
+
+    def peer_url(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._urls.get(name)
+
+    def is_self(self, name: str) -> bool:
+        return name == self.self_name
+
+    def peers(self) -> List[str]:
+        return list(self.ring.nodes)
+
+    def _transport(self, name: str, url: str) -> HttpTransport:
+        with self._lock:
+            t = self._transports.get(name)
+            if t is None:
+                t = self._transports[name] = HttpTransport(url)
+            return t
+
+    # -- peer protocol ----------------------------------------------------
+    def post(self, peer: str, path: str, doc: dict,
+             tenant: Optional[str] = None) -> dict:
+        """One JSON sub-request to ``peer``: chaos hook → circuit
+        breaker → POST with the fleet-internal marker (the receiver
+        serves locally, and meters under the ORIGINAL tenant without
+        re-charging its QPS bucket) → shared failure classification.
+        Raises a :class:`~parquet_tpu.errors.RemoteError` subclass on
+        any failure; the gather layer owns fallback policy."""
+        url = self.peer_url(peer)
+        if url is None:
+            raise RemoteTransientError(
+                f"fleet peer {peer!r} has no URL yet", host=peer,
+                path=path)
+        transport = self._transport(peer, url)
+        host = transport.host
+        breaker = breaker_for(host)
+        if not breaker.allow():
+            raise RemoteCircuitOpenError(
+                f"circuit open for fleet peer {peer!r}", host=host,
+                path=path)
+        body = json.dumps(doc, sort_keys=True).encode("utf-8")
+        headers = {"X-Fleet-Internal": "1"}
+        if tenant:
+            headers["X-Tenant"] = tenant
+            tok = self._tokens.get(tenant)
+            if tok:
+                headers["Authorization"] = f"Bearer {tok}"
+        _account(_M_FORWARDS)
+        try:
+            # the chaos hook raises ConnectionRefusedError inside the
+            # breaker-counted window — a chaos-killed peer trips the
+            # breaker exactly like a real refused connect
+            chaos = peer_chaos()
+            if chaos is not None:
+                chaos.check(peer)
+            status, hdrs, resp = transport.post(path, body, headers)
+        except (HTTPException, socket.timeout, TimeoutError,
+                OSError) as e:
+            breaker.record_failure()
+            raise RemoteTransientError(
+                f"fleet peer {peer!r} unreachable: {e}", host=host,
+                path=path) from e
+        if status == 429:
+            breaker.record_inconclusive()
+        elif 500 <= status < 600:
+            breaker.record_failure()
+        else:
+            breaker.record_success()
+        classify_status(status, hdrs, host, path,
+                        what=f"fleet sub-request to {peer!r}")
+        if hdrs.get("content-encoding", "").lower() == "gzip":
+            resp = gunzip_body(resp, host=host, path=path)
+        try:
+            return json.loads(resp.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            # a torn/garbled body is a connection artifact — retryable,
+            # like a truncated gzip member
+            raise RemoteTransientError(
+                f"fleet peer {peer!r} sent an unparseable body: {e}",
+                host=host, path=path) from e
+
+    # -- scatter-gather ---------------------------------------------------
+    def _per_peer_budget_s(self) -> float:
+        dl = active_deadline()
+        budget = env_float("PARQUET_TPU_FLEET_PEER_TIMEOUT_S")
+        if dl is not None:
+            left = dl.remaining()
+            if left is not None:
+                margin = env_float("PARQUET_TPU_FLEET_MARGIN_S")
+                budget = min(budget, max(left - margin, 0.05))
+        return budget
+
+    def _hedge_delay_s(self, per_peer_s: float) -> Optional[float]:
+        pinned = env_opt_float("PARQUET_TPU_FLEET_HEDGE_S")
+        if pinned is not None:
+            return pinned if pinned > 0 else None  # 0 disables
+        adaptive = hedge_delay_s()  # p95-adaptive, shared with preads
+        if adaptive is not None and adaptive > 0:
+            return min(adaptive, per_peer_s * 0.5)
+        return per_peer_s * 0.5
+
+    def _run_one(self, peer: str, payload,
+                 remote_call: Callable[[str, Any], Any],
+                 local_call: Callable[[str, Any], Any],
+                 per_peer_s: float) -> Tuple[str, Any, str]:
+        """One shard: -> ("ok", result, via) or ("err", error, peer).
+        ``via`` ∈ {"local", "peer", "hedge", "fallback"}."""
+        if self.is_self(peer) or self.peer_url(peer) is None:
+            try:
+                return "ok", local_call(peer, payload), "local"
+            except Exception as e:
+                return "err", e, peer
+        slot: List[Tuple[str, Any]] = []
+        done = threading.Event()
+
+        def _primary():
+            try:
+                slot.append(("ok", remote_call(peer, payload)))
+            except Exception as e:
+                slot.append(("err", e))
+            finally:
+                done.set()
+
+        threading.Thread(target=_primary, name=f"pq-fleet-{peer}",
+                         daemon=True).start()
+        t0 = time.monotonic()
+        hedge_slot: List[Tuple[str, Any]] = []
+        hedge_done: Optional[threading.Event] = None
+        hedge_s = self._hedge_delay_s(per_peer_s)
+        if hedge_s is not None and hedge_s < per_peer_s:
+            if not done.wait(hedge_s):
+                # slow peer: race a local execution of its shard
+                # (shared storage — the local replica is authoritative)
+                _account(_M_HEDGES_ISSUED)
+                hedge_done = threading.Event()
+
+                def _hedge():
+                    try:
+                        hedge_slot.append(
+                            ("ok", local_call(peer, payload)))
+                    except Exception as e:
+                        hedge_slot.append(("err", e))
+                    finally:
+                        hedge_done.set()
+
+                threading.Thread(target=_hedge,
+                                 name=f"pq-fleet-hedge-{peer}",
+                                 daemon=True).start()
+        while True:
+            left = per_peer_s - (time.monotonic() - t0)
+            if done.is_set() or left <= 0:
+                break
+            if hedge_done is not None and hedge_done.is_set() \
+                    and hedge_slot and hedge_slot[0][0] == "ok":
+                _account(_M_HEDGES_WON)
+                return "ok", hedge_slot[0][1], "hedge"
+            done.wait(min(left, 0.005))
+        if done.is_set() and slot and slot[0][0] == "ok":
+            return "ok", slot[0][1], "peer"
+        # the peer failed or timed out
+        _account(_M_PEER_ERRORS)
+        if hedge_done is not None:
+            left = per_peer_s - (time.monotonic() - t0)
+            hedge_done.wait(max(left, 0.0) + 0.05)
+            if hedge_slot and hedge_slot[0][0] == "ok":
+                _account(_M_HEDGES_WON)
+                return "ok", hedge_slot[0][1], "hedge"
+        err = (slot[0][1] if slot and slot[0][0] == "err"
+               else _PeerDeadError(
+                   f"fleet peer {peer!r} produced no result in "
+                   f"{per_peer_s:.3f}s", host=peer))
+        try:
+            result = local_call(peer, payload)
+        except Exception:
+            return "err", err, peer
+        _account(_M_LOCAL_FALLBACKS)
+        return "ok", result, "fallback"
+
+    def gather(self, shards: Dict[str, Any],
+               remote_call: Callable[[str, Any], Any],
+               local_call: Callable[[str, Any], Any],
+               exact: bool = False
+               ) -> Tuple[Dict[str, Any], List[dict]]:
+        """Scatter ``shards`` (peer → payload) and gather results:
+        returns ``(results: peer → result, skips)``.  Each shard runs
+        remote with hedged-local racing and local fallback
+        (:meth:`_run_one`); a shard that still produced nothing is
+        SKIPPED with accounting — unless ``exact``, where the first
+        unservable shard raises (fail-fast, no partial answer)."""
+        _account(_M_GATHERS)
+        per_peer_s = self._per_peer_budget_s()
+        order = sorted(shards)
+        outs: Dict[str, Tuple[str, Any, str]] = {}
+        threads = []
+
+        def _drive(name, payload):
+            outs[name] = self._run_one(name, payload, remote_call,
+                                       local_call, per_peer_s)
+
+        for name in order:
+            t = threading.Thread(target=_drive,
+                                 args=(name, shards[name]),
+                                 name=f"pq-gather-{name}", daemon=True)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(per_peer_s + 1.0)
+        results: Dict[str, Any] = {}
+        skips: List[dict] = []
+        for name in order:
+            got = outs.get(name)
+            if got is None:
+                got = ("err", _PeerDeadError(
+                    f"gather thread for {name!r} never finished",
+                    host=name), name)
+            kind, value, via = got
+            if kind == "ok":
+                results[name] = value
+                continue
+            if exact:
+                if isinstance(value, RemoteError):
+                    raise value
+                raise RemoteTransientError(
+                    f"fleet shard {name!r} unservable: {value}",
+                    host=name) from value
+            _account(_M_PEER_SKIPS)
+            skips.append({"peer": name, "error": f"{value}"})
+        return results, skips
+
+    # -- commit arbitration ----------------------------------------------
+    def arbiter_resolver(self) -> Callable:
+        """The resolver :func:`~parquet_tpu.io.manifest.
+        set_commit_arbiter` installs: each table directory's conditional
+        manifest write routes to its ring owner's ``/v1/fleet/commit``.
+        Self-owned tables (and crash-harness commits carrying a
+        ``sink_wrap``, which cannot cross a process) resolve to None —
+        the local O_EXCL CAS."""
+        import os
+
+        def resolver(table_dir) -> Optional[Callable]:
+            owner = self.ring.owner_of_path(
+                os.path.abspath(os.fspath(table_dir)))
+            if self.is_self(owner) or self.peer_url(owner) is None:
+                return None
+
+            def arbiter(td, expected_version, manifest, sink_wrap=None):
+                from ..io.manifest import cas_commit_local
+
+                if sink_wrap is not None:
+                    return cas_commit_local(td, expected_version,
+                                            manifest, sink_wrap)
+                doc = {"table_dir": os.path.abspath(os.fspath(td)),
+                       "expected_version": int(expected_version),
+                       "manifest": manifest.serialize().decode("utf-8")}
+                try:
+                    got = self.post(owner, "/v1/fleet/commit", doc)
+                except RemoteError:
+                    # the arbiter is DEAD — shared storage is still
+                    # there, and the O_EXCL claim file keeps the
+                    # conditional write exclusive across processes
+                    return cas_commit_local(td, expected_version,
+                                            manifest, None)
+                return bool(got.get("committed")), int(
+                    got.get("version", 0))
+
+            return arbiter
+
+        return resolver
+
+    # -- observability ----------------------------------------------------
+    def debug(self) -> dict:
+        with self._lock:
+            urls = dict(self._urls)
+        doc = {"self": self.self_name, "vnodes": self.ring.vnodes,
+               "peers": {}}
+        for name in self.ring.nodes:
+            url = urls.get(name)
+            entry: Dict[str, Any] = {"url": url,
+                                     "self": self.is_self(name)}
+            if url:
+                from urllib.parse import urlsplit
+
+                host = urlsplit(url).netloc
+                if host:
+                    entry["breaker"] = breaker_for(host).state
+            doc["peers"][name] = entry
+        return doc
+
+    def close(self) -> None:
+        with self._lock:
+            transports = list(self._transports.values())
+            self._transports.clear()
+        for t in transports:
+            t.close()

@@ -47,8 +47,9 @@ from typing import Dict, List, Optional
 from ..utils.env import env_bytes, env_float
 from ..utils.pool import TenantSpec
 
-__all__ = ["DatasetSpec", "ServeConfig", "load_config", "parse_bytes",
-           "drain_timeout_s", "shed_retry_after_s", "max_body_bytes"]
+__all__ = ["DatasetSpec", "ServeConfig", "ClusterSpec", "load_config",
+           "parse_bytes", "drain_timeout_s", "shed_retry_after_s",
+           "max_body_bytes"]
 
 _SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgt]?i?b?)\s*$", re.I)
 _MULT = {"": 1, "b": 1,
@@ -112,6 +113,44 @@ class DatasetSpec:
                              "datasets are writable")
 
 
+@dataclass
+class ClusterSpec:
+    """Fleet membership: ``self_name`` (this daemon's entry in
+    ``peers``) and ``peers`` (name → base URL, e.g. ``http://h1:8818``;
+    an empty/None URL is a placeholder repointed later via
+    :meth:`~parquet_tpu.serve.Server.set_peers` — the ephemeral-port
+    boot sequence tests and check.sh use)."""
+
+    self_name: str
+    peers: Dict[str, Optional[str]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.self_name not in self.peers:
+            raise ValueError(f"cluster 'self' {self.self_name!r} is not "
+                             f"in peers {sorted(self.peers)}")
+        if len(self.peers) < 1:
+            raise ValueError("cluster needs at least one peer")
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ClusterSpec":
+        if not isinstance(doc, dict):
+            raise ValueError("'cluster' must be an object")
+        bad = set(doc) - {"self", "peers"}
+        if bad:
+            raise ValueError(f"cluster: unknown keys {sorted(bad)} "
+                             f"(self, peers)")
+        peers = doc.get("peers")
+        if not isinstance(peers, dict) or not peers:
+            raise ValueError("cluster 'peers' must be a non-empty "
+                             "object of name -> base URL")
+        for name, url in peers.items():
+            if url is not None and not isinstance(url, str):
+                raise ValueError(f"cluster peer {name!r}: URL must be a "
+                                 f"string or null, got {url!r}")
+        return cls(self_name=str(doc.get("self", "")),
+                   peers={str(n): (u or None) for n, u in peers.items()})
+
+
 # endpoint → the class a tenant without an explicit contract runs as:
 # lookups and aggregates are the p99-sensitive surface, scans and writes
 # the bulk one
@@ -128,14 +167,16 @@ class ServeConfig:
     datasets: Dict[str, DatasetSpec] = field(default_factory=dict)
     tenants: Dict[str, TenantSpec] = field(default_factory=dict)
     pin_bytes: Dict[str, int] = field(default_factory=dict)
+    tokens: Dict[str, str] = field(default_factory=dict)
     compact_interval_s: Optional[float] = None
+    cluster: Optional[ClusterSpec] = None
 
     @classmethod
     def from_dict(cls, doc: dict) -> "ServeConfig":
         if not isinstance(doc, dict):
             raise ValueError("serve config must be a JSON object")
         unknown = set(doc) - {"host", "port", "datasets", "tenants",
-                              "compact_interval_s"}
+                              "compact_interval_s", "cluster"}
         if unknown:
             raise ValueError(f"unknown serve config keys: "
                              f"{sorted(unknown)}")
@@ -158,36 +199,52 @@ class ServeConfig:
                 rows_per_file=int(d.get("rows_per_file", 100_000)))
         tenants: Dict[str, TenantSpec] = {}
         pins: Dict[str, int] = {}
+        tokens: Dict[str, str] = {}
         for name, t in (doc.get("tenants") or {}).items():
             if not isinstance(t, dict):
                 raise ValueError(f"tenant {name!r} must be an object")
             bad = set(t) - {"class", "weight", "budget_bytes",
-                            "pin_bytes"}
+                            "pin_bytes", "token", "qps", "burst"}
             if bad:
                 # a typo'd QoS key silently dropping a tenant's budget
                 # would be the OPPOSITE of the operator's intent
                 raise ValueError(f"tenant {name!r}: unknown keys "
                                  f"{sorted(bad)} (class, weight, "
-                                 f"budget_bytes, pin_bytes)")
+                                 f"budget_bytes, pin_bytes, token, "
+                                 f"qps, burst)")
             klass = t.get("class", "default")
             if klass not in ("latency", "default", "bulk"):
                 raise ValueError(f"tenant {name!r}: unknown class "
                                  f"{klass!r} (latency|default|bulk)")
+            qps = t.get("qps")
+            burst = t.get("burst")
             tenants[name] = TenantSpec(
                 name=name,
                 budget_bytes=parse_bytes(t.get("budget_bytes")),
                 weight=float(t.get("weight", 1.0)),
-                klass=klass)
+                klass=klass,
+                qps=float(qps) if qps is not None else None,
+                burst=float(burst) if burst is not None else None)
             pin = parse_bytes(t.get("pin_bytes"))
             if pin:
                 pins[name] = pin
+            tok = t.get("token")
+            if tok is not None:
+                if not isinstance(tok, str) or not tok:
+                    raise ValueError(f"tenant {name!r}: token must be a "
+                                     f"non-empty string")
+                tokens[name] = tok
         if not datasets:
             raise ValueError("serve config hosts no datasets")
         ci = doc.get("compact_interval_s")
+        cluster = doc.get("cluster")
         return cls(host=str(doc.get("host", "127.0.0.1")),
                    port=int(doc.get("port", 8818)),
                    datasets=datasets, tenants=tenants, pin_bytes=pins,
-                   compact_interval_s=float(ci) if ci else None)
+                   tokens=tokens,
+                   compact_interval_s=float(ci) if ci else None,
+                   cluster=(ClusterSpec.from_dict(cluster)
+                            if cluster is not None else None))
 
     def tenant(self, name: str) -> Optional[TenantSpec]:
         return self.tenants.get(name)

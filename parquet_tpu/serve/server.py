@@ -41,14 +41,17 @@ stops accepting, drains in-flight requests up to
 
 from __future__ import annotations
 
+import base64
+import hmac
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..dataset import Dataset
-from ..errors import CorruptedError
+from ..errors import CorruptedError, RemoteError
 from ..obs import export as _export
 from ..obs import scope as _oscope
 from ..obs.ledger import LEDGER
@@ -64,8 +67,11 @@ from .config import (DatasetSpec, ServeConfig, drain_timeout_s,
 
 __all__ = ["Server"]
 
-# the one running daemon of this process (see Server.__init__)
+# the one QoS-owning daemon of this process (see Server.__init__) plus
+# every open Server — more than one is legal ONLY for fleet members
+# sharing a tenant table (the in-process fleet test topology)
 _ACTIVE: "Optional[Server]" = None
+_SERVERS: "List[Server]" = []
 _ACTIVE_LOCK = make_lock("serve.active")
 
 # resolved per class once (hot-path rule); tenant-labeled variants are
@@ -80,6 +86,8 @@ _H_REQ_S = {c: REGISTRY.histogram("serve.request_s", labels={"class": c})
 _M_ERRORS = REGISTRY.counter("serve.errors")
 _M_COMMITS = REGISTRY.counter("serve.writes_committed")
 _M_ROWS = REGISTRY.counter("serve.rows_served")
+_M_AUTH_FAIL = REGISTRY.counter("serve.auth_failures")
+_M_QPS_REJ = REGISTRY.counter("serve.qps_rejections")
 
 _JSON = "application/json"
 _ARROW = "application/vnd.apache.arrow.stream"
@@ -194,18 +202,36 @@ class Server:
         self._inflight_cv = make_condition("serve.inflight")
         self._closed = False
         self._compactors = []
-        # one daemon per process: the QoS state it installs (tenant
-        # table, page pins, /debugz provider) is process-global — a
-        # silent second instance would clobber the first's contracts
-        # out from under its running requests
+        self._tokens_lock = make_lock("serve.tokens")
+        self._tokens: Dict[str, str] = dict(config.tokens)
+        self.fleet = None
+        # one QoS OWNER per process: the state a daemon installs (tenant
+        # table, page pins, /debugz providers, commit arbiter) is
+        # process-global — a silent second instance would clobber the
+        # first's contracts out from under its running requests.  Fleet
+        # members are the one exception: N daemons with IDENTICAL
+        # tenant tables may share a process (the in-process fleet
+        # topology tests and check.sh boot); the first is the owner and
+        # ownership hands off on close.
         with _ACTIVE_LOCK:
             global _ACTIVE
             if _ACTIVE is not None:
-                raise RuntimeError(
-                    "a Server is already running in this process "
-                    "(the tenant QoS state is process-global); close "
-                    "it before starting another")
-            _ACTIVE = self
+                if config.cluster is None \
+                        or _ACTIVE.config.cluster is None:
+                    raise RuntimeError(
+                        "a Server is already running in this process "
+                        "(the tenant QoS state is process-global); "
+                        "close it before starting another")
+                if config.tenants != _ACTIVE.config.tenants:
+                    raise RuntimeError(
+                        "fleet members sharing a process must share "
+                        "one tenant QoS table (the admission gate is "
+                        "process-global)")
+                self._qos_owner = False
+            else:
+                _ACTIVE = self
+                self._qos_owner = True
+            _SERVERS.append(self)
         try:
             server = self
 
@@ -219,9 +245,13 @@ class Server:
                  port if port is not None else config.port), Handler)
         except BaseException:
             with _ACTIVE_LOCK:
-                _ACTIVE = None
+                if _ACTIVE is self:
+                    _ACTIVE = None
+                if self in _SERVERS:
+                    _SERVERS.remove(self)
             raise
-        read_admission().configure_tenants(config.tenants)
+        if self._qos_owner:
+            read_admission().configure_tenants(config.tenants)
         if config.compact_interval_s:
             from ..dataset_writer import BackgroundCompactor
 
@@ -235,7 +265,23 @@ class Server:
                                         name="pq-serve", daemon=True)
         self._thread.start()
         self.host, self.port = self._httpd.server_address[:2]
-        _export.register_debugz_provider("tenants", self._tenants_debugz)
+        if config.cluster is not None:
+            from ..io.manifest import set_commit_arbiter
+            from .cluster import FleetRouter
+
+            self.fleet = FleetRouter(config.cluster,
+                                     tokens=config.tokens)
+            # commit arbitration is process-global; any fleet member's
+            # resolver computes the same ring owner, and the local CAS
+            # claim stays correct whichever resolver is installed —
+            # latest-booted wins, close() hands back (see close)
+            set_commit_arbiter(self.fleet.arbiter_resolver())
+        if self._qos_owner:
+            _export.register_debugz_provider("tenants",
+                                             self._tenants_debugz)
+            if self.fleet is not None:
+                _export.register_debugz_provider("fleet",
+                                                 self.fleet.debug)
 
     # ------------------------------------------------------------ datasets
     @staticmethod
@@ -305,7 +351,6 @@ class Server:
             if self._closed:
                 return True
             self._closed = True
-        _export.unregister_debugz_provider("tenants")
         self._httpd.shutdown()  # stop accepting; in-flight continue
         drained = True
         if drain:
@@ -321,15 +366,78 @@ class Server:
             c.close()
         self._httpd.server_close()
         self._thread.join(timeout=5)
-        adm = read_admission()
-        for t in self.config.tenants:
-            PAGES.unpin_tenant(t)
-        adm.clear_tenants()
+        if self.fleet is not None:
+            self.fleet.close()
+        # global-state release/handoff: the LAST member out clears the
+        # tenant table and the commit arbiter; otherwise ownership (and
+        # the /debugz providers) hand to a surviving fleet member
         with _ACTIVE_LOCK:
             global _ACTIVE
+            if self in _SERVERS:
+                _SERVERS.remove(self)
+            survivor = _SERVERS[0] if _SERVERS else None
+            was_owner = self._qos_owner
+            if was_owner and survivor is not None:
+                survivor._qos_owner = True
             if _ACTIVE is self:
-                _ACTIVE = None
+                _ACTIVE = survivor
+        if was_owner:
+            _export.unregister_debugz_provider("tenants")
+            if self.fleet is not None:
+                _export.unregister_debugz_provider("fleet")
+        if survivor is None:
+            if self.fleet is not None:
+                from ..io.manifest import set_commit_arbiter
+
+                set_commit_arbiter(None)
+            adm = read_admission()
+            for t in self.config.tenants:
+                PAGES.unpin_tenant(t)
+            adm.clear_tenants()
+        else:
+            if self.fleet is not None and survivor.fleet is not None:
+                from ..io.manifest import set_commit_arbiter
+
+                set_commit_arbiter(survivor.fleet.arbiter_resolver())
+            if was_owner:
+                _export.register_debugz_provider(
+                    "tenants", survivor._tenants_debugz)
+                if survivor.fleet is not None:
+                    _export.register_debugz_provider(
+                        "fleet", survivor.fleet.debug)
         return drained
+
+    def chaos_kill(self) -> None:
+        """ABRUPT death for chaos tests: the listener closes NOW, no
+        drain — in-flight requests are abandoned mid-stream and peers
+        see connection failures, exactly like a killed process (minus
+        the process exit).  Global tenant/arbiter state still hands
+        off; the storage-level crash matrix covers the no-handoff
+        case."""
+        self.close(drain=False)
+
+    # ------------------------------------------------------------- fleet
+    def set_peers(self, urls: Dict[str, str]) -> None:
+        """Repoint fleet peer base URLs after an ephemeral-port boot
+        (bind first, then tell every member where its peers landed)."""
+        if self.fleet is None:
+            raise RuntimeError("this daemon has no cluster config")
+        self.fleet.set_peers(urls)
+
+    # -------------------------------------------------------------- auth
+    def rotate_token(self, tenant: str, token: Optional[str]) -> None:
+        """Install (or with ``None`` clear) ``tenant``'s bearer token —
+        takes effect on the next request; in-flight requests finish
+        under the credential they presented."""
+        with self._tokens_lock:
+            if token is None:
+                self._tokens.pop(tenant, None)
+            else:
+                self._tokens[tenant] = str(token)
+
+    def _token_for(self, tenant: str) -> Optional[str]:
+        with self._tokens_lock:
+            return self._tokens.get(tenant)
 
     def join(self) -> None:
         """Block until the listener stops (the CLI foreground)."""
@@ -417,7 +525,8 @@ class _RequestHandler(BaseHTTPRequestHandler):
 
     # --------------------------------------------------------------- POST
     _ENDPOINTS = {"/v1/lookup": "lookup", "/v1/scan": "scan",
-                  "/v1/aggregate": "aggregate", "/v1/write": "write"}
+                  "/v1/aggregate": "aggregate", "/v1/write": "write",
+                  "/v1/fleet/commit": "fleet_commit"}
 
     def do_POST(self):  # noqa: N802
         daemon = self.daemon
@@ -437,6 +546,24 @@ class _RequestHandler(BaseHTTPRequestHandler):
     def _dispatch(self, daemon: Server, endpoint: str) -> None:
         tenant = (self.headers.get("X-Tenant") or "default").strip() \
             or "default"
+        # bearer-token auth runs against the REQUESTED identity, before
+        # the unknown-tenant collapse: a wrong token must 401, never
+        # silently downgrade to the default tenant's contract
+        expected = daemon._token_for(tenant)
+        if expected is not None:
+            presented = (self.headers.get("Authorization") or "")
+            ok = presented.startswith("Bearer ") and hmac.compare_digest(
+                presented[len("Bearer "):].encode("utf-8"),
+                expected.encode("utf-8"))
+            if not ok:
+                _oscope.account(_M_AUTH_FAIL)
+                _oscope.account(REGISTRY.counter(
+                    "serve.auth_failures", labels={"tenant": tenant}))
+                self._send_json(
+                    401, {"error": f"tenant {tenant!r} requires a "
+                                   f"valid bearer token"},
+                    headers={"WWW-Authenticate": "Bearer"})
+                return
         if tenant != "default" and tenant not in daemon.config.tenants:
             # unknown tenants collapse onto the default identity: the
             # header is client-controlled, and minting per-value metric
@@ -444,6 +571,26 @@ class _RequestHandler(BaseHTTPRequestHandler):
             # grow process memory and /metrics cardinality forever
             tenant = "default"
         klass = daemon.config.klass_for(tenant, endpoint)
+        # fleet-internal sub-requests (scatter legs, commit arbitration)
+        # bypass the QPS bucket: the ORIGINATING request already paid
+        # its token, and a fan-out of N legs must not charge N times
+        internal = self.headers.get("X-Fleet-Internal") == "1"
+        if not internal:
+            retry_in = read_admission().try_request(tenant)
+            if retry_in is not None:
+                _oscope.account(_M_QPS_REJ)
+                _oscope.account(REGISTRY.counter(
+                    "serve.qps_rejections", labels={"tenant": tenant}))
+                daemon.tenant_stats.shed(tenant)
+                self._send_json(
+                    429, {"error": f"tenant {tenant!r} over its QPS "
+                                   f"contract",
+                          "retry_after_s": retry_in},
+                    headers={"Retry-After":
+                             str(max(int(math.ceil(retry_in)), 1))})
+                return
+        self._internal = internal
+        self._tenant = tenant
         # graceful degradation: under HARD pressure the bulk tier sheds
         # FIRST — a prompt 429 + Retry-After beats queueing a scan the
         # gate would block anyway; latency-class requests keep flowing
@@ -537,6 +684,8 @@ class _RequestHandler(BaseHTTPRequestHandler):
             return self._scan(daemon, body)
         if endpoint == "aggregate":
             return self._aggregate(daemon, body)
+        if endpoint == "fleet_commit":
+            return self._fleet_commit(daemon, body)
         return self._write(daemon, body)
 
     def _abort_stream(self) -> bool:
@@ -556,6 +705,47 @@ class _RequestHandler(BaseHTTPRequestHandler):
             raise _HttpError(400, f"request needs {key!r}")
         return v
 
+    # ------------------------------------------------------ response helpers
+    def _accepts_gzip(self) -> bool:
+        accept = (self.headers.get("Accept-Encoding") or "").lower()
+        return "gzip" in accept
+
+    def _maybe_gzip(self, body: bytes, headers: dict):
+        """Compress a buffered response body when the client asked for
+        it (``Accept-Encoding: gzip``).  mtime pinned to 0 so the bytes
+        are deterministic — the identity-after-decompress tests diff
+        raw payloads."""
+        if not self._accepts_gzip() or not body:
+            return body, headers
+        import gzip as _gzip
+        import io as _io
+
+        buf = _io.BytesIO()
+        with _gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as gz:
+            gz.write(body)
+        headers = dict(headers)
+        headers["Content-Encoding"] = "gzip"
+        return buf.getvalue(), headers
+
+    def _respond_json(self, doc: dict, headers=None):
+        """Buffered JSON responder with optional gzip (the scan and
+        aggregate response surfaces honor Accept-Encoding)."""
+        data = json.dumps(doc, sort_keys=True,
+                          allow_nan=True).encode("utf-8")
+        data, headers = self._maybe_gzip(data, dict(headers or {}))
+        return lambda: self._send(200, data, _JSON, headers=headers)
+
+    def _fleet_for(self, daemon: Server, ds: Dataset):
+        """The router, when THIS request should scatter: fleet
+        configured, not already a fleet-internal leg, more than one
+        member, and a non-empty corpus."""
+        if self._internal or daemon.fleet is None:
+            return None
+        if len(daemon.fleet.ring.nodes) < 2 or not ds.paths:
+            return None
+        return daemon.fleet
+
+    # ------------------------------------------------------------- lookup
     def _lookup(self, daemon: Server, body: dict) -> int:
         ds = daemon.dataset(str(self._required(body, "dataset")))
         column = str(self._required(body, "column"))
@@ -563,10 +753,134 @@ class _RequestHandler(BaseHTTPRequestHandler):
         if not isinstance(keys, list) or not keys:
             raise _HttpError(400, "'keys' must be a non-empty list")
         columns = body.get("columns") or []
+        fleet = self._fleet_for(daemon, ds)
+        if fleet is not None:
+            return self._fleet_lookup(fleet, body, ds, column, keys,
+                                      columns)
         res = ds.find_rows(column, keys, columns=columns)
         hits = lookup_to_jsonable(res, keys)
         doc = {"hits": hits, "rows_total": res.rows_total}
         return res.rows_total, lambda: self._send_json(200, doc)
+
+    def _fleet_lookup(self, fleet, body: dict, ds: Dataset, column: str,
+                      keys: list, columns: list):
+        """Scatter keys to their ring owners (splitmix64 over the key,
+        the writer's partition hash), gather per-key hits, merge in
+        the ORIGINAL key order.  Each owner answers its keys over the
+        full corpus, so global row ordinals come back unchanged."""
+        shards: Dict[str, list] = {}
+        for k in keys:
+            shards.setdefault(fleet.ring.owner_of_key(k), []).append(k)
+        sub_base = {k: v for k, v in body.items()
+                    if not str(k).startswith("_")}
+
+        def remote(peer, subkeys):
+            doc = dict(sub_base)
+            doc["keys"] = subkeys
+            return fleet.post(peer, "/v1/lookup", doc,
+                              tenant=self._tenant)
+
+        def local(peer, subkeys):
+            res = ds.find_rows(column, subkeys, columns=columns)
+            return {"hits": lookup_to_jsonable(res, subkeys),
+                    "rows_total": res.rows_total}
+
+        results, skips = fleet.gather(shards, remote, local,
+                                      exact=bool(body.get("exact")))
+        by_key: Dict = {}
+        total = 0
+        for peer, doc in results.items():
+            total += int(doc.get("rows_total", 0))
+            for k, hit in zip(shards[peer], doc.get("hits", [])):
+                by_key[self._key_id(k)] = hit
+        hits = []
+        for k in keys:
+            hit = by_key.get(self._key_id(k))
+            if hit is None:  # its shard was skipped (degraded mode)
+                hit = {"key": jsonable(k), "rows": [], "values": {},
+                       "skipped": True}
+            hits.append(hit)
+        doc = {"hits": hits, "rows_total": total}
+        if skips:
+            doc["fleet"] = {"skipped": skips}
+        return total, lambda: self._send_json(200, doc)
+
+    @staticmethod
+    def _key_id(k):
+        # dict-key identity for merge: floats keep their repr (NaN !=
+        # NaN would lose the hit otherwise), everything else is itself
+        if isinstance(k, float):
+            return ("f", repr(k))
+        return k
+
+    # --------------------------------------------------------------- scan
+    @staticmethod
+    def _file_batches(pf, prepared, columns):
+        """The Arrow batches one file contributes to a scan stream
+        (shared by the single-node stream and the fleet shard path)."""
+        import pyarrow as pa
+
+        from ..parallel.host_scan import scan_expr
+
+        if prepared is not None:
+            return [columns_to_arrow_batch(
+                scan_expr(pf, prepared, columns=columns))]
+        atab = pf.read(columns=columns).to_arrow().combine_chunks()
+        batches = atab.to_batches()
+        if not batches:
+            # a 0-row file yields no batches, but the stream still
+            # needs its schema (an empty body is not a valid IPC
+            # stream)
+            batches = [pa.record_batch(
+                [pa.array([], type=f.type) for f in atab.schema],
+                schema=atab.schema)]
+        return batches
+
+    @staticmethod
+    def _file_json_line(pf, prepared, columns):
+        """One file's JSON scan line (bytes) + its row count — THE
+        byte-level unit of the scan protocol: the single-node stream,
+        the paginated pages, and the fleet gather all emit these
+        identical bytes, which is what makes the byte-identity
+        obligations hold."""
+        from ..parallel.host_scan import scan_expr
+
+        if prepared is not None:
+            doc = columns_to_jsonable(
+                scan_expr(pf, prepared, columns=columns))
+        else:
+            doc = {k: [jsonable(x) for x in v]
+                   for k, v in pf.read(columns=columns)
+                   .to_arrow().to_pydict().items()}
+        n = len(next(iter(doc.values()))) if doc else 0
+        line = (json.dumps({"columns": doc, "num_rows": n},
+                           sort_keys=True) + "\n").encode("utf-8")
+        return line, n
+
+    @staticmethod
+    def _done_line(total: int) -> bytes:
+        return (json.dumps({"done": True, "num_rows": total})
+                + "\n").encode("utf-8")
+
+    def _file_arrow_stream(self, pf, prepared, columns):
+        """One file's scan result as a COMPLETE Arrow IPC stream (the
+        fleet shard wire unit; the coordinator re-batches them into one
+        stream in global file order)."""
+        import io as _io
+
+        import pyarrow as pa
+
+        sink = _io.BytesIO()
+        writer = None
+        rows = 0
+        for batch in self._file_batches(pf, prepared, columns):
+            if writer is None:
+                writer = pa.ipc.new_stream(sink, batch.schema)
+            writer.write_batch(batch)
+            rows += batch.num_rows
+        if writer is not None:
+            writer.close()
+        return sink.getvalue(), rows
 
     def _scan(self, daemon: Server, body: dict) -> int:
         ds = daemon.dataset(str(self._required(body, "dataset")))
@@ -575,42 +889,50 @@ class _RequestHandler(BaseHTTPRequestHandler):
         fmt = body.get("format", "json")
         if fmt not in ("json", "arrow"):
             raise _HttpError(400, f"unknown format {fmt!r} (json|arrow)")
-        from ..parallel.host_scan import scan_expr
-
+        files = body.get("_files")
+        if files is not None:
+            if not self._internal:
+                raise _HttpError(400, "'_files' is fleet-internal")
+            return self._scan_shard(ds, body, expr, columns, fmt, files)
+        if body.get("limit") is not None \
+                or body.get("page_token") is not None:
+            if fmt != "json":
+                raise _HttpError(400, "pagination supports the json "
+                                      "format")
+            return self._scan_page(ds, expr, columns,
+                                   body.get("limit"),
+                                   body.get("page_token"))
+        fleet = self._fleet_for(daemon, ds)
+        if fleet is not None:
+            return self._fleet_scan(fleet, body, ds, expr, columns, fmt)
         prepared = ds._prepare_where(None, None, None, None, expr)[0] \
             if expr is not None else None
         # streamed: one chunk per file, produced as each file scans —
         # the response begins before the last file is touched
+        gz = self._accepts_gzip()
         self._streamed = True
         self.send_response(200)
         self.send_header("Content-Type",
                          _ARROW if fmt == "arrow" else _JSON)
+        if gz:
+            self.send_header("Content-Encoding", "gzip")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
-        out = _ChunkedWriter(self.wfile)
+        chunks = _ChunkedWriter(self.wfile)
+        if gz:
+            import gzip as _gzip
+
+            out = _gzip.GzipFile(fileobj=chunks, mode="wb", mtime=0)
+        else:
+            out = chunks
         total = 0
         if fmt == "arrow":
             import pyarrow as pa
 
             writer = None
             for i in range(ds.num_files):
-                pf = ds.file(i)
-                if prepared is not None:
-                    batches = [columns_to_arrow_batch(
-                        scan_expr(pf, prepared, columns=columns))]
-                else:
-                    atab = pf.read(columns=columns).to_arrow() \
-                        .combine_chunks()
-                    batches = atab.to_batches()
-                    if not batches:
-                        # a 0-row file yields no batches, but the
-                        # stream still needs its schema (an empty body
-                        # is not a valid IPC stream)
-                        batches = [pa.record_batch(
-                            [pa.array([], type=f.type)
-                             for f in atab.schema],
-                            schema=atab.schema)]
-                for batch in batches:
+                for batch in self._file_batches(ds.file(i), prepared,
+                                                columns):
                     if writer is None:
                         writer = pa.ipc.new_stream(out, batch.schema)
                     writer.write_batch(batch)
@@ -619,33 +941,380 @@ class _RequestHandler(BaseHTTPRequestHandler):
                 writer.close()
         else:
             for i in range(ds.num_files):
-                pf = ds.file(i)
-                if prepared is not None:
-                    doc = columns_to_jsonable(
-                        scan_expr(pf, prepared, columns=columns))
-                else:
-                    doc = {k: [jsonable(x) for x in v]
-                           for k, v in pf.read(columns=columns)
-                           .to_arrow().to_pydict().items()}
-                n = len(next(iter(doc.values()))) if doc else 0
-                out.write((json.dumps({"columns": doc, "num_rows": n},
-                                      sort_keys=True) + "\n")
-                          .encode("utf-8"))
+                line, n = self._file_json_line(ds.file(i), prepared,
+                                               columns)
+                out.write(line)
                 total += n
-            out.write((json.dumps({"done": True, "num_rows": total})
-                       + "\n").encode("utf-8"))
-        return total, out.finish
+            out.write(self._done_line(total))
+        if gz:
+            out.close()  # flush the gzip trailer into the chunk stream
+        return total, chunks.finish
+
+    def _scan_page(self, ds: Dataset, expr, columns, limit, token):
+        """Paginated scan (json): whole-file granularity — emit file
+        lines from the token's cursor until ``limit`` rows accumulate.
+        Pages CONCATENATE byte-identically to the unbounded stream:
+        intermediate pages are pure file lines, the final page appends
+        the done line carrying the cumulative total the token threaded
+        through."""
+        lim = None
+        if limit is not None:
+            try:
+                lim = int(limit)
+            except (TypeError, ValueError) as e:
+                raise _HttpError(400, f"bad limit: {e}") from e
+            if lim <= 0:
+                raise _HttpError(400, "'limit' must be a positive "
+                                      "integer")
+        start, prior = 0, 0
+        if token is not None:
+            try:
+                tdoc = json.loads(base64.urlsafe_b64decode(
+                    str(token).encode("ascii")))
+                start, prior = int(tdoc["f"]), int(tdoc["n"])
+            except (ValueError, KeyError, TypeError) as e:
+                raise _HttpError(400, f"bad page_token: {e}") from e
+            if not (0 <= start <= ds.num_files) or prior < 0:
+                raise _HttpError(400, "page_token does not match this "
+                                      "dataset")
+        prepared = ds._prepare_where(None, None, None, None, expr)[0] \
+            if expr is not None else None
+        parts = []
+        page_rows = 0
+        i = start
+        while i < ds.num_files:
+            line, n = self._file_json_line(ds.file(i), prepared,
+                                           columns)
+            parts.append(line)
+            page_rows += n
+            i += 1
+            if lim is not None and page_rows >= lim:
+                break
+        headers = {}
+        if i >= ds.num_files:
+            parts.append(self._done_line(prior + page_rows))
+        else:
+            headers["X-Next-Page-Token"] = base64.urlsafe_b64encode(
+                json.dumps({"f": i, "n": prior + page_rows},
+                           sort_keys=True).encode("ascii")
+            ).decode("ascii")
+        data = b"".join(parts)
+        data, headers = self._maybe_gzip(data, headers)
+        return page_rows, lambda: self._send(200, data, _JSON,
+                                             headers=headers)
+
+    def _scan_shard(self, ds: Dataset, body: dict, expr, columns, fmt,
+                    files):
+        """Fleet-internal scan leg: ``_files`` is a list of
+        ``[global_index, path]`` pairs — the COORDINATOR's snapshot
+        names the exact part files, so a peer whose own snapshot lags a
+        commit still scans the same bytes (shared storage).  Responds
+        with one buffered JSON doc of per-file units the coordinator
+        splices in global order."""
+        pairs = self._shard_pairs(files)
+        sub = Dataset([p for _, p in pairs])
+        try:
+            prepared = sub._prepare_where(None, None, None, None,
+                                          expr)[0] \
+                if expr is not None else None
+            out = []
+            total = 0
+            for j, (gi, _path) in enumerate(pairs):
+                pf = sub.file(j)
+                if fmt == "json":
+                    line, n = self._file_json_line(pf, prepared,
+                                                   columns)
+                    ent = {"file": gi, "rows": n,
+                           "line": line.decode("utf-8")}
+                else:
+                    data, n = self._file_arrow_stream(pf, prepared,
+                                                      columns)
+                    ent = {"file": gi, "rows": n,
+                           "arrow": base64.b64encode(data)
+                           .decode("ascii")}
+                out.append(ent)
+                total += n
+        finally:
+            sub.close()
+        doc = {"files": out}
+        return total, lambda: self._send_json(200, doc)
+
+    @staticmethod
+    def _shard_pairs(files):
+        if not isinstance(files, list) or not files:
+            raise _HttpError(400, "'_files' must be a non-empty list "
+                                  "of [index, path] pairs")
+        pairs = []
+        for ent in files:
+            if not (isinstance(ent, list) and len(ent) == 2
+                    and isinstance(ent[0], int)
+                    and isinstance(ent[1], str)):
+                raise _HttpError(400, "'_files' entries must be "
+                                      "[index, path] pairs")
+            pairs.append((ent[0], ent[1]))
+        return pairs
+
+    def _fleet_scan(self, fleet, body: dict, ds: Dataset, expr, columns,
+                    fmt):
+        """Scatter the corpus to its file-path ring owners, gather the
+        per-file units, splice in GLOBAL file order — byte-identical
+        (json) to the single-node stream when nothing skipped; under
+        partial failure the response degrades to the served files with
+        the skips accounted (``fleet.peer_skips``, ``X-Fleet-Skipped``,
+        read.files_skipped via ReadReport) unless ``"exact": true``
+        demanded fail-fast."""
+        shards: Dict[str, list] = {}
+        for i, path in enumerate(ds.paths):
+            shards.setdefault(fleet.ring.owner_of_path(path),
+                              []).append([i, path])
+        sub_base = {k: v for k, v in body.items()
+                    if not str(k).startswith("_")}
+
+        def remote(peer, pairs):
+            doc = dict(sub_base)
+            doc["_files"] = pairs
+            return fleet.post(peer, "/v1/scan", doc,
+                              tenant=self._tenant)
+
+        # local execution must not write a response — build the doc
+        # shape directly instead of going through a responder
+        def local_doc(peer, pairs):
+            shard_pairs = self._shard_pairs([list(p) for p in pairs])
+            sub = Dataset([p for _, p in shard_pairs])
+            try:
+                prepared = sub._prepare_where(
+                    None, None, None, None, expr)[0] \
+                    if expr is not None else None
+                out = []
+                for j, (gi, _path) in enumerate(shard_pairs):
+                    pf = sub.file(j)
+                    if fmt == "json":
+                        line, n = self._file_json_line(pf, prepared,
+                                                       columns)
+                        out.append({"file": gi, "rows": n,
+                                    "line": line.decode("utf-8")})
+                    else:
+                        data, n = self._file_arrow_stream(pf, prepared,
+                                                          columns)
+                        out.append({"file": gi, "rows": n,
+                                    "arrow": base64.b64encode(data)
+                                    .decode("ascii")})
+            finally:
+                sub.close()
+            return {"files": out}
+
+        results, skips = fleet.gather(shards, remote, local_doc,
+                                      exact=bool(body.get("exact")))
+        entries: Dict[int, dict] = {}
+        for _peer, doc in results.items():
+            for ent in doc.get("files", []):
+                entries[int(ent["file"])] = ent
+        ordered = [entries[i] for i in sorted(entries)]
+        total = sum(int(e["rows"]) for e in ordered)
+        headers: Dict[str, str] = {}
+        if skips:
+            from ..io.faults import ReadReport
+
+            # a default ReadReport publishes at record time — each
+            # dropped shard file lands in read.files_skipped once
+            rep = ReadReport()
+            for s in skips:
+                for _gi, path in shards.get(s["peer"], []):
+                    rep.record_file_skip(path, rows=0,
+                                         error=s["error"])
+            headers["X-Fleet-Skipped"] = json.dumps(
+                sorted(s["peer"] for s in skips))
+        if fmt == "json":
+            data = b"".join([e["line"].encode("utf-8")
+                             for e in ordered]
+                            + [self._done_line(total)])
+            ctype = _JSON
+        else:
+            import io as _io
+
+            import pyarrow as pa
+
+            sink = _io.BytesIO()
+            writer = None
+            for e in ordered:
+                reader = pa.ipc.open_stream(
+                    base64.b64decode(e["arrow"]))
+                for batch in reader:
+                    if writer is None:
+                        writer = pa.ipc.new_stream(sink, batch.schema)
+                    writer.write_batch(batch)
+            if writer is not None:
+                writer.close()
+            data = sink.getvalue()
+            ctype = _ARROW
+        data, headers = self._maybe_gzip(data, headers)
+        return total, lambda: self._send(200, data, ctype,
+                                         headers=headers)
 
     def _aggregate(self, daemon: Server, body: dict) -> int:
         ds = daemon.dataset(str(self._required(body, "dataset")))
         aggs = parse_aggs(self._required(body, "aggs"))
         expr = expr_from_wire(body.get("where"))
         group_by = body.get("group_by")
+        files = body.get("_files")
+        if files is not None:
+            if not self._internal:
+                raise _HttpError(400, "'_files' is fleet-internal")
+            return self._aggregate_shard(body, aggs, expr, group_by,
+                                         files)
+        fleet = self._fleet_for(daemon, ds)
+        if fleet is not None:
+            return self._fleet_aggregate(fleet, body, ds, aggs, expr,
+                                         group_by)
         res = ds.aggregate(aggs, where=expr, group_by=group_by)
         doc = {"aggregates": {k: jsonable(v) for k, v in res.items()},
                "tiers": {k: v for k, v in res.counters.items() if v}}
         if res.groups is not None:
             doc["groups"] = [jsonable(k) for k in res.groups]
+        return 0, self._respond_json(doc)
+
+    def _aggregate_shard(self, body: dict, aggs, expr, group_by, files):
+        """Fleet-internal aggregate leg: resolve the named part files to
+        a PARTIAL state and ship the accumulators — not finalized
+        results, which would lose the distinct sets a cross-shard COUNT
+        DISTINCT needs — via the lossless agg-state codec."""
+        from ..io.aggregate import dataset_aggregate, encode_agg_state
+
+        pairs = self._shard_pairs(files)
+        sub = Dataset([p for _, p in pairs])
+        try:
+            state = dataset_aggregate(sub, aggs, where=expr,
+                                      group_by=group_by,
+                                      _state_only=True)
+        finally:
+            sub.close()
+        doc = {"state": encode_agg_state(state)}
+        return 0, lambda: self._send_json(200, doc)
+
+    def _fleet_aggregate(self, fleet, body: dict, ds: Dataset, aggs,
+                         expr, group_by):
+        """Scatter an aggregate to the file ring owners and merge the
+        returned partial states EXACTLY as the dataset layer merges
+        per-file states — the scattered result is bit-identical to the
+        single-node one.  Sub-requests forward the ORIGINAL agg wire
+        strings: ``_expand_derived`` is deterministic, so every member
+        derives the same positional base list and the state docs align.
+        """
+        from ..io.aggregate import (_Acc, _COUNTER_KEYS, _expand_derived,
+                                    _finalize, _validate,
+                                    dataset_aggregate, decode_agg_state,
+                                    encode_agg_state)
+
+        base, plan = _expand_derived(aggs)
+        leaves, _gleaf = _validate(ds.schema, base, group_by)
+        shards: Dict[str, list] = {}
+        for i, path in enumerate(ds.paths):
+            shards.setdefault(fleet.ring.owner_of_path(path),
+                              []).append([i, path])
+        sub_base = {k: v for k, v in body.items()
+                    if not str(k).startswith("_")}
+
+        def remote(peer, pairs):
+            doc = dict(sub_base)
+            doc["_files"] = pairs
+            return fleet.post(peer, "/v1/aggregate", doc,
+                              tenant=self._tenant)
+
+        def local_doc(peer, pairs):
+            shard_pairs = self._shard_pairs([list(p) for p in pairs])
+            sub = Dataset([p for _, p in shard_pairs])
+            try:
+                state = dataset_aggregate(sub, aggs, where=expr,
+                                          group_by=group_by,
+                                          _state_only=True)
+            finally:
+                sub.close()
+            return {"state": encode_agg_state(state)}
+
+        results, skips = fleet.gather(shards, remote, local_doc,
+                                      exact=bool(body.get("exact")))
+        counters = {k: 0 for k in _COUNTER_KEYS}
+        lines = [f"aggregate: fleet of {len(shards)} shard(s), "
+                 f"{len(ds.paths)} file(s)"]
+        accs = [_Acc(a, leaves[i]) for i, a in enumerate(base)]
+        groups: Optional[dict] = {} if group_by is not None else None
+        for peer in sorted(results):
+            doc = results[peer]
+            if not isinstance(doc.get("state"), dict):
+                raise _HttpError(502, f"peer {peer!r} returned no "
+                                      "aggregate state")
+            paccs, pgroups, pcounters = decode_agg_state(
+                doc["state"], base, leaves)
+            for k in _COUNTER_KEYS:
+                counters[k] += pcounters.get(k, 0)
+            for acc, d in zip(accs, paccs):
+                acc.merge(d)
+            if pgroups:
+                for k, daccs in pgroups.items():
+                    cur = groups.get(k)
+                    if cur is None:
+                        groups[k] = daccs
+                    else:
+                        for acc, d in zip(cur, daccs):
+                            acc.merge(d)
+        headers: Dict[str, str] = {}
+        if skips:
+            from ..io.faults import ReadReport
+
+            rep = ReadReport()
+            for s in skips:
+                for _gi, path in shards.get(s["peer"], []):
+                    rep.record_file_skip(path, rows=0,
+                                         error=s["error"])
+                    counters["files_skipped"] += 1
+            headers["X-Fleet-Skipped"] = json.dumps(
+                sorted(s["peer"] for s in skips))
+        res = _finalize(base, accs, groups, counters, lines, None,
+                        plan=plan)
+        doc = {"aggregates": {k: jsonable(v) for k, v in res.items()},
+               "tiers": {k: v for k, v in res.counters.items() if v}}
+        if res.groups is not None:
+            doc["groups"] = [jsonable(k) for k in res.groups]
+        if skips:
+            doc["fleet"] = {"skipped": skips}
+        return 0, self._respond_json(doc, headers=headers)
+
+    def _fleet_commit(self, daemon: Server, body: dict) -> int:
+        """Authoritative commit arbitration: the table's RING OWNER
+        serializes every manifest commit through its local CAS, so two
+        daemons ingesting the same table converge on one linear version
+        history — old-or-new, never mixed.  Only arrives on the
+        fleet-internal surface (peers call via
+        ``FleetRouter.arbiter_resolver``)."""
+        if daemon.fleet is None:
+            raise _HttpError(404, "not a fleet member")
+        if not self._internal:
+            raise _HttpError(400, "/v1/fleet/commit is fleet-internal")
+        import os
+
+        from ..io.manifest import Manifest, cas_commit_local
+
+        table_dir = str(self._required(body, "table_dir"))
+        hosted = {os.path.abspath(spec.table): name
+                  for name, spec in daemon.config.datasets.items()
+                  if spec.table}
+        name = hosted.get(os.path.abspath(table_dir))
+        if name is None:
+            # refuse to arbitrate for directories this daemon does not
+            # host — the caller's local-CAS fallback (shared storage,
+            # O_EXCL claim) still serializes correctly
+            raise _HttpError(403, f"table {table_dir!r} is not hosted "
+                                  "here")
+        try:
+            expected = int(self._required(body, "expected_version"))
+            man = Manifest.deserialize(
+                str(self._required(body, "manifest")).encode("utf-8"))
+        except (ValueError, KeyError, TypeError) as e:
+            raise _HttpError(400, f"bad commit request: {e}") from e
+        committed, version = cas_commit_local(table_dir, expected, man)
+        if committed:
+            daemon._refresh_dataset(name)
+        doc = {"committed": bool(committed), "version": int(version)}
         return 0, lambda: self._send_json(200, doc)
 
     def _write(self, daemon: Server, body: dict) -> int:

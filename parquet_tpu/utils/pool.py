@@ -78,13 +78,19 @@ class TenantSpec:
     """One tenant's QoS contract at the admission gate: a byte budget
     (its private clamp INSIDE the shared budgets — 0/None = unlimited),
     a weighted-fair ``weight`` (2.0 drains twice the bytes of 1.0 under
-    contention within a class), and a priority ``klass`` (``latency`` |
-    ``default`` | ``bulk``) that orders it against other tenants."""
+    contention within a class), a priority ``klass`` (``latency`` |
+    ``default`` | ``bulk``) that orders it against other tenants, and an
+    optional request-RATE limit: ``qps`` tokens/second with up to
+    ``burst`` banked (None/0 qps = unlimited; burst defaults to
+    ``max(qps, 1)``) — enforced by :meth:`AdmissionController.
+    try_request`, the serving daemon's 429 gate."""
 
     name: str
     budget_bytes: Optional[int] = None
     weight: float = 1.0
     klass: str = "default"
+    qps: Optional[float] = None
+    burst: Optional[float] = None
 
 
 # the active (tenant, klass) of the current request — a context variable
@@ -338,6 +344,11 @@ class AdmissionController:
                            "scan": "PARQUET_TPU_SCAN_BUDGET"}
         self._default_lookup = default_bytes
         self._cv = make_condition("pool.admission")
+        # request-rate token buckets, separate lock: try_request is a
+        # pre-admission fast path and must not contend with the byte
+        # gate's scheduler walk
+        self._qps_lock = make_lock("pool.qps")
+        self._qps_state: "Dict[str, list]" = {}  # name -> [tokens, t_last]
         self._queue: list = []  # _Ticket objects, arrival order
         self._seq = itertools.count()
         self._in_use = 0
@@ -366,9 +377,17 @@ class AdmissionController:
                                 f"{type(s).__name__}")
             if s.weight <= 0:
                 raise ValueError(f"tenant {s.name!r} weight must be > 0")
+            if s.qps is not None and s.qps < 0:
+                raise ValueError(f"tenant {s.name!r} qps must be >= 0")
+            if s.burst is not None and s.burst < 1:
+                raise ValueError(f"tenant {s.name!r} burst must be >= 1")
             table[s.name] = s
         with self._cv:
             self._tenants = table
+        with self._qps_lock:
+            # stale buckets from a previous config must not carry debt
+            # (or banked burst) into the new contracts
+            self._qps_state = {}
 
     def clear_tenants(self) -> None:
         """Forget the tenant table and its accounting (test isolation;
@@ -380,6 +399,38 @@ class AdmissionController:
             self._vfloor = 0.0
             self.tenant_high_water = {}
             self.tenant_waits = {}
+        with self._qps_lock:
+            self._qps_state = {}
+
+    def try_request(self, name: str) -> "Optional[float]":
+        """Token-bucket request-rate gate for ONE arriving request of
+        tenant ``name``: returns None when admitted (one token consumed)
+        or the seconds until a token will exist — the ``Retry-After`` a
+        429 should advertise.  Tenants without a ``qps`` contract (and
+        unknown tenants) always admit; the bucket banks up to ``burst``
+        tokens (default ``max(qps, 1)``) so idle tenants absorb bursts
+        without paying steady-state latency."""
+        with self._cv:
+            spec = self._tenants.get(name)
+        if spec is None or not spec.qps:
+            return None
+        rate = float(spec.qps)
+        cap = float(spec.burst) if spec.burst is not None \
+            else max(rate, 1.0)
+        now = time.monotonic()
+        with self._qps_lock:
+            state = self._qps_state.get(name)
+            if state is None:
+                state = self._qps_state[name] = [cap, now]
+            tokens, t_last = state
+            tokens = min(cap, tokens + (now - t_last) * rate)
+            if tokens >= 1.0:
+                state[0] = tokens - 1.0
+                state[1] = now
+                return None
+            state[0] = tokens
+            state[1] = now
+            return (1.0 - tokens) / rate
 
     def tenant_spec(self, name: str) -> "Optional[TenantSpec]":
         with self._cv:

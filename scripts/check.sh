@@ -1307,6 +1307,111 @@ print("serve pressure ok: healthz flipped hard, bulk shed 429 first, "
 SRVPRESSEOF
 rm -rf "$SERVE_DIR"
 
+echo "=== fleet smoke (3-daemon scatter-gather + chaos kill mid-scan) ==="
+# ISSUE 16: the daemon fleet.  Boot three ephemeral-port daemons
+# sharing one key-partitioned table, scatter-gather a scan through one
+# member and assert the bytes match a single-node run; then chaos-kill
+# a shard owner mid-scan and assert the degraded gather (local
+# fallback over shared storage) is STILL byte-identical, with the
+# peer's circuit breaker observed tripping
+# (remote.breaker_transitions).
+FLEET_DIR=$(mktemp -d)
+PARQUET_TPU_REMOTE_BREAKER=2 PARQUET_TPU_FLEET_HEDGE_S=0 \
+python - "$FLEET_DIR" <<'FLEETEOF'
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+
+import parquet_tpu as pq
+from parquet_tpu.io.faults import PeerChaos, set_peer_chaos
+from parquet_tpu.obs.metrics import metrics_snapshot
+from parquet_tpu.serve import Server
+
+d = sys.argv[1]
+tdir = os.path.join(d, "tbl")
+n = 6000
+tab = pa.table({"k": np.arange(n, dtype=np.int64),
+                "v": (np.arange(n, dtype=np.int64) * 7) % 1000})
+w = pq.DatasetWriter(tdir, pq.schema_from_arrow(tab.schema),
+                     partition_on="k", num_partitions=4,
+                     rows_per_file=1000)
+w.write_arrow(tab)
+w.commit()
+w.close()
+
+SCAN = {"dataset": "tbl", "where": {"col": "v", "le": 500},
+        "columns": ["k", "v"]}
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url + "/v1/scan", data=json.dumps(doc).encode(),
+        headers={"X-Tenant": "default"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+
+def counters():
+    return metrics_snapshot()["counters"]
+
+
+base = {"datasets": {"tbl": {"table": tdir, "writable": True}},
+        "tenants": {}}
+with Server(base, port=0) as solo:
+    solo_bytes = post(solo.url, SCAN)
+
+names = ["n1", "n2", "n3"]
+servers = {}
+try:
+    for nm in names:
+        cfg = dict(base, cluster={"self": nm,
+                                  "peers": {x: None for x in names}})
+        servers[nm] = Server(cfg, port=0)
+    urls = {nm: s.url for nm, s in servers.items()}
+    for s in servers.values():
+        s.set_peers(urls)
+
+    before = counters()
+    fleet_bytes = post(servers["n1"].url, SCAN)
+    assert fleet_bytes == solo_bytes, "scatter-gather not byte-identical"
+    after = counters()
+    assert after.get("fleet.gathers", 0) > before.get("fleet.gathers", 0)
+
+    # chaos-kill a shard owner mid-scan: one more sub-request allowed
+    # (it hits the abruptly-closed socket), then the chaos hook
+    # partitions the peer outright
+    owners = servers["n1"].fleet.ring.spread(
+        list(servers["n1"].dataset("tbl").paths))
+    victim = next(nm for nm in names if nm != "n1" and owners.get(nm))
+    chaos = PeerChaos()
+    set_peer_chaos(chaos)
+    chaos.kill_after(victim, 1)
+    servers[victim].chaos_kill()
+    before = counters()
+    degraded = post(servers["n1"].url, SCAN)
+    degraded2 = post(servers["n1"].url, SCAN)
+    assert degraded == solo_bytes and degraded2 == solo_bytes, \
+        "degraded gather not byte-identical"
+    after = counters()
+    assert after.get("fleet.local_fallbacks", 0) > \
+        before.get("fleet.local_fallbacks", 0)
+    trans = sum(v for k, v in after.items()
+                if k.startswith("remote.breaker_transitions"))
+    assert trans > 0, "peer breaker never transitioned"
+    print("fleet smoke ok: scatter-gather byte-identical, chaos kill "
+          "mid-scan degraded byte-identically "
+          f"(breaker transitions: {trans})")
+finally:
+    set_peer_chaos(None)
+    for s in reversed(list(servers.values())):
+        s.close()
+FLEETEOF
+rm -rf "$FLEET_DIR"
+
 echo "=== analysis smoke (invariant lint + lockcheck gate) ==="
 # the standing pre-merge correctness gate: AST lint over the package
 # (PT001-PT006), README knob table generated-vs-committed, and a

@@ -980,3 +980,47 @@ class TestAuthHooks:
                            auth=lambda u, r: "Bearer x")
         with pytest.raises(TypeError):
             remote_mod.register_auth_hook("http://x/", "not-callable")
+
+
+# ---------------------------------------------------------------------------
+# prefix listing (ISSUE 16 satellite): Dataset expands http(s) prefixes
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixListing:
+    def test_list_prefix_sorted_one_level(self, raw):
+        files = {"data/b.parquet": raw, "data/a.parquet": raw,
+                 "data/nested/c.parquet": raw, "other.parquet": raw}
+        with LocalRangeServer(files) as srv:
+            got = remote_mod.list_prefix(srv.url("data/"))
+        assert [u.rsplit("/", 1)[1] for u in got] == \
+            ["a.parquet", "b.parquet"]  # sorted, nested elided
+
+    def test_dataset_expands_prefix(self, raw, clean):
+        files = {"data/a.parquet": raw, "data/b.parquet": _make_raw(N_ROWS)}
+        with LocalRangeServer(files) as srv:
+            ds = Dataset([srv.url("data/")])
+            try:
+                assert ds.num_files == 2
+                tab = ds.read(columns=["x"]).to_arrow()
+                assert tab.num_rows == 2 * N_ROWS
+                assert tab["x"].to_pylist() == list(range(2 * N_ROWS))
+            finally:
+                ds.close()
+
+    def test_empty_prefix_is_file_not_found(self, raw):
+        with LocalRangeServer({"data/a.parquet": raw}) as srv:
+            with pytest.raises(FileNotFoundError):
+                remote_mod.list_prefix(srv.url("void/"))
+            with pytest.raises(FileNotFoundError):
+                Dataset([srv.url("void/")])
+
+    def test_listing_requires_credentials(self, raw):
+        """A private store's listing endpoint 401s without the bearer
+        token — terminal, not silently empty."""
+        from parquet_tpu.errors import RemoteTerminalError
+
+        with LocalRangeServer({"data/a.parquet": raw},
+                              auth_token="sekrit") as srv:
+            with pytest.raises(RemoteTerminalError):
+                remote_mod.list_prefix(srv.url("data/"))

@@ -789,3 +789,227 @@ def test_untagged_traffic_joins_fairness_floor():
     # the tenant's vtime is ~1e6; the floor advanced with its grants —
     # an untagged ticket enqueued now keys at the floor, not 0.0
     assert adm._vfloor > 0
+
+
+# ---------------------------------------------------------------------------
+# bearer tokens (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _post_h(url, doc, tenant="default", headers=None, timeout=60):
+    hdrs = {"X-Tenant": tenant, "Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(doc).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+LOOK = {"dataset": "events", "column": "k", "keys": [5]}
+
+
+def test_bearer_token_auth(corpus):
+    cfg = _config(corpus,
+                  secure={"class": "latency", "token": "s3cret"},
+                  open_={"class": "bulk"})
+    with Server(cfg, port=0) as srv:
+        u = srv.url + "/v1/lookup"
+        # no credential → 401 with a challenge, nothing leaks
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_h(u, LOOK, tenant="secure")
+        assert ei.value.code == 401
+        assert "Bearer" in ei.value.headers.get("WWW-Authenticate", "")
+        # wrong credential → 401
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_h(u, LOOK, tenant="secure",
+                    headers={"Authorization": "Bearer nope"})
+        assert ei.value.code == 401
+        # right credential → 200
+        st, body, _ = _post_h(u, LOOK, tenant="secure",
+                              headers={"Authorization": "Bearer s3cret"})
+        assert st == 200 and json.loads(body)["rows_total"] == 1
+        # tokenless tenants are unaffected
+        assert _post_h(u, LOOK, tenant="open_")[0] == 200
+        # the failure counter is live
+        assert REGISTRY.counter("serve.auth_failures").value >= 2
+
+
+def test_token_rotation_under_chaos(corpus):
+    """Rotation races in-flight requests: every response is a clean 200
+    or 401 (never a 5xx, never a hang), old token dies, new token
+    works — even while a chaos hook partitions fleet peers (rotation
+    must not depend on fleet health)."""
+    from parquet_tpu.io.faults import PeerChaos, set_peer_chaos
+
+    cfg = _config(corpus, secure={"token": "old"})
+    with Server(cfg, port=0) as srv:
+        u = srv.url + "/v1/lookup"
+        chaos = PeerChaos()
+        set_peer_chaos(chaos)
+        chaos.partition("nobody")  # armed hook, daemon has no fleet
+        try:
+            codes = []
+            stop = threading.Event()
+
+            def hammer(tok):
+                while not stop.is_set():
+                    try:
+                        st, _, _ = _post_h(
+                            u, LOOK, tenant="secure",
+                            headers={"Authorization": f"Bearer {tok}"})
+                        codes.append(st)
+                    except urllib.error.HTTPError as e:
+                        codes.append(e.code)
+
+            threads = [threading.Thread(target=hammer, args=(t,))
+                       for t in ("old", "new")]
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+            srv.rotate_token("secure", "new")
+            time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join(30)
+            assert set(codes) <= {200, 401} and 200 in codes
+            # post-rotation: old dead, new live
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_h(u, LOOK, tenant="secure",
+                        headers={"Authorization": "Bearer old"})
+            assert ei.value.code == 401
+            assert _post_h(u, LOOK, tenant="secure",
+                           headers={"Authorization": "Bearer new"})[0] \
+                == 200
+        finally:
+            set_peer_chaos(None)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant QPS (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_qps_limit_429_retry_after(corpus):
+    cfg = _config(corpus, limited={"qps": 0.5, "burst": 1},
+                  free={"class": "latency"})
+    with Server(cfg, port=0) as srv:
+        u = srv.url + "/v1/lookup"
+        before = REGISTRY.counter("serve.qps_rejections").value
+        assert _post_h(u, LOOK, tenant="limited")[0] == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_h(u, LOOK, tenant="limited")
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        doc = json.loads(ei.value.read())
+        assert doc["retry_after_s"] > 0
+        assert REGISTRY.counter("serve.qps_rejections").value > before
+        # other tenants are not collateral
+        for _ in range(3):
+            assert _post_h(u, LOOK, tenant="free")[0] == 200
+        # the metric is pre-declared per tenant label too
+        prom = _get(srv.url + "/metrics")[1].decode()
+        assert "parquet_tpu_serve_qps_rejections_total" in prom
+
+
+# ---------------------------------------------------------------------------
+# scan pagination (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_scan_pagination_concatenates_byte_identically(corpus):
+    scan = {"dataset": "events", "where": {"col": "v", "le": 500},
+            "columns": ["k", "v"]}
+    with Server(_config(corpus), port=0) as srv:
+        u = srv.url + "/v1/scan"
+        _, unbounded, _ = _post_h(u, scan)
+        pages, token = [], None
+        for _ in range(50):
+            doc = dict(scan, limit=700)
+            if token:
+                doc["page_token"] = token
+            _, body, hdrs = _post_h(u, doc)
+            pages.append(body)
+            token = hdrs.get("X-Next-Page-Token")
+            if not token:
+                break
+        assert len(pages) > 1  # it actually paginated
+        assert b"".join(pages) == unbounded
+        # last page carries the cumulative done line
+        last = json.loads(pages[-1].splitlines()[-1])
+        unb = json.loads(unbounded.splitlines()[-1])
+        assert last == unb and last["done"]
+        # malformed inputs are clean 400s
+        for doc in [dict(scan, limit=0), dict(scan, limit="x"),
+                    dict(scan, page_token="@@@"),
+                    dict(scan, limit=10, format="arrow")]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_h(u, doc)
+            assert ei.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# gzip response encoding (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_gzip_scan_and_aggregate_identity(corpus):
+    import gzip as _gz
+
+    scan = {"dataset": "events", "where": {"col": "v", "le": 200}}
+    agg = {"dataset": "events", "aggs": ["count", "sum:v"]}
+    with Server(_config(corpus), port=0) as srv:
+        plain_scan = _post_h(srv.url + "/v1/scan", scan)[1]
+        st, gz_scan, hdrs = _post_h(srv.url + "/v1/scan", scan,
+                                    headers={"Accept-Encoding": "gzip"})
+        assert hdrs.get("Content-Encoding") == "gzip"
+        assert _gz.decompress(gz_scan) == plain_scan  # identity
+        plain_agg = _post_h(srv.url + "/v1/aggregate", agg)[1]
+        st, gz_agg, hdrs = _post_h(srv.url + "/v1/aggregate", agg,
+                                   headers={"Accept-Encoding": "gzip"})
+        assert hdrs.get("Content-Encoding") == "gzip"
+        assert _gz.decompress(gz_agg) == plain_agg
+        # lookups/writes stay plain regardless
+        _, _, hdrs = _post_h(srv.url + "/v1/lookup", LOOK,
+                             headers={"Accept-Encoding": "gzip"})
+        assert "Content-Encoding" not in hdrs
+
+
+def test_truncated_gzip_is_retryable():
+    import gzip as _gz
+
+    from parquet_tpu.errors import RemoteTransientError
+    from parquet_tpu.io.remote import gunzip_body
+
+    whole = _gz.compress(b"x" * 4096)
+    assert gunzip_body(whole, host="h", path="/p") == b"x" * 4096
+    with pytest.raises(RemoteTransientError):
+        gunzip_body(whole[:-6], host="h", path="/p")  # torn member
+
+
+# ---------------------------------------------------------------------------
+# fleet config validation (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_config_validation(corpus):
+    good = _config(corpus)
+    good["cluster"] = {"self": "a", "peers": {"a": None,
+                                              "b": "http://h:1"}}
+    cfg = ServeConfig.from_dict(good)
+    assert cfg.cluster.self_name == "a"
+    assert cfg.cluster.peers["b"] == "http://h:1"
+    for cluster in [{"peers": {"a": None}},           # self missing
+                    {"self": "x", "peers": {"a": None}},  # not a member
+                    {"self": "a", "peers": {}},       # empty
+                    {"self": "a", "peers": {"a": None}, "ring": 3}]:
+        bad = _config(corpus)
+        bad["cluster"] = cluster
+        with pytest.raises(ValueError):
+            ServeConfig.from_dict(bad)
+    # token/qps tenant knobs parse and validate
+    cfg = ServeConfig.from_dict(_config(
+        corpus, t={"token": "x", "qps": 2, "burst": 4}))
+    assert cfg.tokens["t"] == "x"
+    assert cfg.tenants["t"].qps == 2.0
+    with pytest.raises(ValueError):
+        ServeConfig.from_dict(_config(corpus, t={"token": 42}))
