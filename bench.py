@@ -1208,6 +1208,113 @@ def _cfg12(n):
     return {"rows": n, "sweep": results}
 
 
+def _cfg13(n):
+    """Fused single-pass execution (ISSUE 18): the exact-decode tier with
+    ``PARQUET_TPU_FUSED`` on vs off, at 0.1% / 1% / 50% selectivity on a
+    RANDOM key (stats/page pruning can't help — every row group is
+    contended, so the decode tier itself is what's measured).  Value
+    columns are dictionary-encoded (masked-emit's best case) and
+    value-identity is asserted at every point.  A second sub-benchmark
+    replays the memory-contract shape (sorted key, plain high-cardinality
+    payload, 8 KiB pages, ~99.5% selective) under a read budget and
+    records the admission high-water both sides: the fused fold must
+    hold peak ledger bytes >= 4x below the unfused decode."""
+    import io as _io
+
+    from parquet_tpu import ParquetFile, clear_caches, col, count, \
+        count_distinct, max_, min_, sum_
+    from parquet_tpu.io.writer import WriterOptions, write_table
+    from parquet_tpu.utils.pool import read_admission
+
+    n = max(n, 1_000_000)
+    rng = np.random.default_rng(23)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 10_000_000, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 201, n).astype(np.int64)),
+        "s": pa.array([f"cat{j % 64:02d}".encode() for j in range(n)],
+                      type=pa.binary()),
+    })
+    buf = _io.BytesIO()
+    write_table(t, buf, WriterOptions(compression="snappy",
+                                      row_group_size=n // 2,
+                                      data_page_size=1 << 16))
+    pf = ParquetFile(buf.getvalue())
+    aggs = [count(), sum_("v"), min_("v"), max_("v"), count_distinct("s")]
+    adm = read_admission()
+    saved = {k: os.environ.get(k)
+             for k in ("PARQUET_TPU_FUSED", "PARQUET_TPU_READ_BUDGET")}
+
+    def _setenv(key, val):
+        if val is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = val
+
+    results = {}
+    try:
+        for tag, frac in [("0.1%", 0.001), ("1%", 0.01), ("50%", 0.5)]:
+            where = col("k").between(0, int(10_000_000 * frac) - 1)
+
+            def run(mode):
+                _setenv("PARQUET_TPU_FUSED", mode)
+                clear_caches()
+                r = pf.aggregate(aggs, where=where)
+                return tuple(r[a.name] for a in aggs)
+
+            want, got = run("off"), run("on")
+            assert want == got, (tag, want, got)
+            base_s = _time_best(lambda: run("off"), reps=3)
+            fused_s = _time_best(lambda: run("on"), reps=3)
+            results[tag] = {
+                "rows_matched": got[0],
+                "unfused_s": round(base_s, 4),
+                "fused_s": round(fused_s, 4),
+                "speedup": round(base_s / fused_s, 2),
+                "byte_identical": True,
+            }
+        pf.close()
+
+        # memory contract: page-scale peak admission vs chunk-scale
+        m = 400_000
+        t2 = pa.table({
+            "k": pa.array(np.arange(m, dtype=np.int64)),
+            "v": pa.array(rng.integers(0, 1 << 40, m, dtype=np.int64)),
+        })
+        buf2 = _io.BytesIO()
+        write_table(t2, buf2, WriterOptions(row_group_size=m // 2,
+                                            data_page_size=8192))
+        pf2 = ParquetFile(buf2.getvalue())
+        where2 = col("k").between(1000, m - 1001)
+        _setenv("PARQUET_TPU_READ_BUDGET", str(1 << 30))
+
+        def hw(mode):
+            _setenv("PARQUET_TPU_FUSED", mode)
+            clear_caches()
+            adm._reset()
+            r = pf2.aggregate([count(), sum_("v")], where=where2)
+            return r["sum(v)"], adm.high_water
+
+        sum_off, hw_off = hw("off")
+        sum_on, hw_on = hw("on")
+        pf2.close()
+        assert sum_off == sum_on, (sum_off, sum_on)
+        assert hw_on > 0 and hw_off >= 4 * hw_on, (hw_off, hw_on)
+        results["ledger"] = {
+            "hw_unfused_bytes": int(hw_off),
+            "hw_fused_bytes": int(hw_on),
+            "ratio": round(hw_off / hw_on, 1),
+            "byte_identical": True,
+        }
+    finally:
+        for key, val in saved.items():
+            _setenv(key, val)
+        clear_caches()
+        adm._reset()
+    return {"rows": n, "sweep": {k: v for k, v in results.items()
+                                 if k != "ledger"},
+            "ledger": results["ledger"]}
+
+
 _CAL0 = None
 
 
@@ -1317,6 +1424,7 @@ def main():
     _run("10_lookup", _cfg10, max(n_rows // 4, 64))
     _run("11_table", _cfg11, max(n_rows // 4, 64))
     _run("12_aggregate", _cfg12, max(n_rows // 4, 64))
+    _run("13_fused", _cfg13, max(n_rows // 4, 64))
 
     head = configs["1_int64_plain"]
     print(json.dumps({

@@ -107,6 +107,11 @@ declare("PARQUET_TPU_AGG_DICT", "bool", True,
         "dictionary tier of the aggregation cascade: SUM/COUNT DISTINCT/"
         "MIN/MAX/group-by over dict-encoded chunks aggregate the index "
         "stream without expanding values; 0 falls back to exact decode")
+declare("PARQUET_TPU_FUSED", "str", "auto",
+        "fused single-pass execution (decode+mask+fold page streaming, "
+        "no whole-column intermediates): on|off|auto — auto lets the "
+        "cost model fuse once the estimated decode bytes clear the "
+        "threshold (io/planner.py choose_fused)")
 
 # -------------------------------------------------------------------- write
 declare("PARQUET_TPU_MMAP_SINK", "bool", False,
@@ -133,6 +138,11 @@ declare("PARQUET_TPU_REMOTE_BREAKER", "int", 5,
         "opens (fail-fast)")
 declare("PARQUET_TPU_REMOTE_BREAKER_COOLDOWN", "float", 1.0,
         "seconds an open circuit waits before its half-open probe")
+declare("PARQUET_TPU_S3_ENDPOINT", "str", "",
+        "HTTP(S) endpoint s3:// URLs resolve against (path-style: "
+        "{endpoint}/{bucket}/{key}); required for s3:// sources and "
+        "ListObjectsV2 prefix expansion — unset makes s3:// paths an "
+        "error")
 
 # ------------------------------------------------------------------- remote
 declare("PARQUET_TPU_REMOTE_AUTH_RETRY", "int", 1,

@@ -77,17 +77,20 @@ def expand_paths(paths_or_glob, missing: Optional[list] = None) -> List[str]:
     out: List[str] = []
     seen = set()
     for item in items:
-        # remote URLs pass through literally — EXCEPT an http(s) prefix
-        # URL (trailing "/"), which expands through the store's listing
-        # endpoint the way a local glob expands (sorted, retried via the
-        # shared retry loop): fleet configs name table roots by URL
+        # remote URLs pass through literally — EXCEPT an http(s) or s3
+        # prefix URL (trailing "/"), which expands through the store's
+        # listing endpoint (JSON/HTML for http(s), ListObjectsV2 for s3)
+        # the way a local glob expands (sorted, retried via the shared
+        # retry loop): fleet configs name table roots by URL
         if "://" in item:
-            if item.startswith(("http://", "https://")) \
+            if item.startswith(("http://", "https://", "s3://")) \
                     and item.endswith("/"):
-                from .io.remote import list_prefix
+                from .io.remote import list_prefix, list_prefix_s3
 
+                expand = list_prefix_s3 if item.startswith("s3://") \
+                    else list_prefix
                 try:
-                    got = list_prefix(item)
+                    got = expand(item)
                 except FileNotFoundError:
                     if missing is None:
                         raise

@@ -70,15 +70,19 @@ _M_DS_AGG_S = _histogram("dataset.aggregate_s")
 _M_RG_STATS = _counter("agg.rg_answered_stats")
 _M_RG_PAGES = _counter("agg.rg_answered_pages")
 _M_RG_DICT = _counter("agg.rg_answered_dict")
+_M_RG_DICT_PARTIAL = _counter("agg.rg_answered_dict_partial")
 _M_RG_DECODED = _counter("agg.rg_answered_decoded")
 _M_FILES_MANIFEST = _counter("agg.files_answered_manifest")
 
 _TIER_METRIC = {"stats": _M_RG_STATS, "pages": _M_RG_PAGES,
-                "dict": _M_RG_DICT, "decoded": _M_RG_DECODED}
-_TIER_RANK = {"stats": 0, "pages": 1, "dict": 2, "decoded": 3}
+                "dict": _M_RG_DICT, "dict_partial": _M_RG_DICT_PARTIAL,
+                "decoded": _M_RG_DECODED}
+_TIER_RANK = {"stats": 0, "pages": 1, "dict": 2, "dict_partial": 3,
+              "decoded": 4}
 
 _COUNTER_KEYS = ("rg_answered_stats", "rg_answered_pages",
-                 "rg_answered_dict", "rg_answered_decoded",
+                 "rg_answered_dict", "rg_answered_dict_partial",
+                 "rg_answered_decoded",
                  "rg_skipped_corrupt", "files_answered_manifest",
                  "files_skipped")
 
@@ -398,8 +402,11 @@ class _RgReader:
         self.rg = rg
         self.decoded = False
         self.dict_used = False
+        self.dict_partial_used = False
         self._memo: Dict[tuple, tuple] = {}
         self._whole: Dict[int, object] = {}  # column -> whole-chunk col
+        self._dictcol: Dict[int, object] = {}  # column -> dict col / None
+        self._entries: Dict[int, object] = {}  # column -> order entries
         self._admission = read_admission()
 
     def _span_bytes(self, leaf, count: int) -> int:
@@ -449,10 +456,16 @@ class _RgReader:
 
         if not env_bool("PARQUET_TPU_AGG_DICT"):
             return None
+        if leaf.column_index in self._dictcol:
+            col = self._dictcol[leaf.column_index]
+            if col is not None:
+                self.dict_used = True
+            return col
         chunk = self.rg.column(leaf.column_index)
         dict_encs = {Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY}
         if not any(Encoding(e) in dict_encs
                    for e in (chunk.meta.encodings or [])):
+            self._dictcol[leaf.column_index] = None
             return None  # footer says no dictionary pages: zero IO spent
         with self._admission.admit(
                 chunk.meta.total_uncompressed_size or 0, tier="scan"):
@@ -462,9 +475,20 @@ class _RgReader:
             # decode — the exact fallback trims it instead of paying a
             # second decompression of the same rows
             self._whole[leaf.column_index] = col
+            self._dictcol[leaf.column_index] = None
             return None
         self.dict_used = True
+        self._dictcol[leaf.column_index] = col
         return col
+
+    def dict_entries(self, leaf, col):
+        """Order-domain dictionary entries, memoized per column (the
+        dict-partial tier folds many intervals off one dictionary)."""
+        got = self._entries.get(leaf.column_index)
+        if got is None:
+            got = _dict_order_entries(leaf, col._host_dictionary())
+            self._entries[leaf.column_index] = got
+        return got
 
 
 # ---------------------------------------------------------------------------
@@ -648,18 +672,95 @@ def _resolve_rg(pf, rg, expr, aggs: Sequence[AggExpr], leaves, group_leaf,
                                  False, may, cov, contended)
         if len(ranges) >= 2:
             ctx = _prewarmed(pf, ranges, pslots)
+    cursors = None
     with ctx:
-        masks = _contended_masks(expr, reader, contended, leaves)
-        if group_leaf is not None:
-            _group_partial(pf, rg, reader, aggs, leaves, group_leaf,
-                           groups, cov, masks)
-        else:
+        if group_leaf is None and not any(a.kind == "top_k" for a in aggs):
+            cursors = _fused_cursors(pf, rg, reader, expr, accs, cov,
+                                     contended)
+        if cursors is not None:
+            from .fused import _H_FOLD_S, _M_RG_FOLDS
+
+            t0 = time.perf_counter()
+            masks = _contended_masks_fused(expr, cursors, contended)
             for acc in accs:
-                _contrib_partial(pf, rg, reader, acc, cov, masks)
-    tier = "decoded" if reader.decoded else "pages"
+                _contrib_partial(pf, rg, reader, acc, cov, masks,
+                                 cursors=cursors)
+            if any(c.touched for c in cursors.values()):
+                reader.decoded = True
+            _oscope.account(_M_RG_FOLDS)
+            _H_FOLD_S.observe(time.perf_counter() - t0)
+        else:
+            masks = _contended_masks(expr, reader, contended, leaves)
+            if group_leaf is not None:
+                _group_partial(pf, rg, reader, aggs, leaves, group_leaf,
+                               groups, cov, masks)
+            else:
+                for acc in accs:
+                    _contrib_partial(pf, rg, reader, acc, cov, masks)
+    tier = ("dict_partial" if reader.dict_partial_used
+            else "decoded" if reader.decoded else "pages")
     note = (f"partial: {_iv_rows(cov)} covered + "
-            f"{_iv_rows(contended)} contended rows, answered by {tier}")
+            f"{_iv_rows(contended)} contended rows, answered by {tier}"
+            + (" (fused)" if cursors is not None else ""))
     return tier, accs, groups, note
+
+
+def _fused_cursors(pf, rg, reader: _RgReader, expr, accs, cov: _Intervals,
+                   contended: _Intervals):
+    """A :class:`~parquet_tpu.io.fused.PageCursor` per needed leaf when
+    the fused streaming tier applies, else None (materializing path).
+    Gates: contended rows exist (otherwise nothing is masked), every
+    filter and aggregate leaf is flat with an offset index, and
+    ``choose_fused`` elects fusion on the bytes the exact tier would
+    otherwise materialize (``PARQUET_TPU_FUSED`` on/off overrides)."""
+    from .fused import _M_FALLBACKS, FusedUnsupported, PageCursor
+    from .planner import choose_fused
+
+    if not contended:
+        return None
+    need = {p.leaf.column_index: p.leaf for p in _collect_preds(expr)}
+    crows = _iv_rows(contended)
+    est = sum(reader._span_bytes(leaf, crows) for leaf in need.values())
+    vrows = crows + _iv_rows(cov)
+    for acc in accs:
+        leaf = acc.leaf
+        if acc.agg.path is None or leaf is None:
+            continue
+        if leaf.column_index not in need:
+            est += reader._span_bytes(leaf, vrows)
+            need[leaf.column_index] = leaf
+    if not choose_fused(est):
+        return None
+    try:
+        return {ci: PageCursor(rg, leaf) for ci, leaf in need.items()}
+    except FusedUnsupported:
+        _oscope.account(_M_FALLBACKS)
+        return None
+
+
+def _contended_masks_fused(expr, cursors, contended: _Intervals
+                           ) -> Dict[Tuple[int, int], np.ndarray]:
+    """Exact predicate masks per contended interval, filter pages
+    evaluated span-by-span on the union page grid: each sub-block lies
+    inside ONE page per filter column, so a page's decoded form releases
+    as its cursor advances — phase 1 never holds a whole filter span."""
+    from ..parallel.host_scan import expr_mask
+
+    if not contended:
+        return {}
+    fleaves = {p.path: p.leaf for p in _collect_preds(expr)}
+    out = {}
+    for s, e in contended:
+        mask = np.empty(e - s, bool)
+        cuts = sorted({c for leaf in fleaves.values()
+                       for c in cursors[leaf.column_index].grid(s, e)})
+        bounds = [s] + cuts + [e]
+        for bs, be in zip(bounds, bounds[1:]):
+            env = {path: cursors[leaf.column_index].aligned(bs, be)
+                   for path, leaf in fleaves.items()}
+            mask[bs - s:be - s] = expr_mask(expr, env, be - bs)
+        out[(s, e)] = mask
+    return out
 
 
 def _contended_masks(expr, reader: _RgReader, contended: _Intervals,
@@ -724,12 +825,20 @@ def _contrib_full(pf, rg, reader: _RgReader, acc: _Acc) -> None:
 def _dict_contrib(acc: _Acc, leaf, col) -> None:
     """Aggregate over a dict-encoded chunk WITHOUT expanding values:
     the dictionary decodes once, the index stream carries the rest."""
-    agg = acc.agg
     idx = np.asarray(col.dict_indices)
+    entries = None if acc.agg.kind == "count" \
+        else _dict_order_entries(leaf, col._host_dictionary())
+    _dict_fold(acc, entries, idx)
+
+
+def _dict_fold(acc: _Acc, entries, idx: np.ndarray) -> None:
+    """Fold a dictionary-index slice (dense over PRESENT slots) into an
+    accumulator — shared by the full dict tier and the partial-coverage
+    dict tier, which feeds per-interval sub-slices."""
+    agg = acc.agg
     if agg.kind == "count":
         acc.add_count(len(idx))  # indices are dense over PRESENT slots
         return
-    entries = _dict_order_entries(leaf, col._host_dictionary())
     if len(idx) == 0:
         return
     if agg.kind in ("sum", "sum_sq"):
@@ -755,16 +864,22 @@ def _dict_contrib(acc: _Acc, leaf, col) -> None:
 
 
 def _contrib_partial(pf, rg, reader: _RgReader, acc: _Acc,
-                     cov: _Intervals, masks) -> None:
+                     cov: _Intervals, masks, cursors=None) -> None:
     """One aggregate over a PARTIALLY covered row group: covered
-    intervals answer from page math/bounds where provable, contended
-    intervals decode under the exact mask."""
+    intervals answer from page math/bounds where provable (or from the
+    dictionary index stream on fully dict-encoded chunks), contended
+    intervals decode under the exact mask.  With ``cursors`` (the fused
+    tier), every remaining decode streams page-at-a-time through the
+    column's :class:`~parquet_tpu.io.fused.PageCursor` — masks apply
+    inside the decode and no whole-span buffer is ever built."""
     agg, leaf = acc.agg, acc.leaf
     if agg.kind == "count" and agg.path is None:
         acc.add_count(_iv_rows(cov))
         for m in masks.values():
             acc.add_count(int(m.sum()))
         return
+    cur = None if cursors is None or leaf is None \
+        else cursors.get(leaf.column_index)
     if agg.kind == "top_k":
         _topk_intervals(pf, rg, reader, acc, cov)
         for (s, e), m in masks.items():
@@ -796,7 +911,17 @@ def _contrib_partial(pf, rg, reader: _RgReader, acc: _Acc,
             rem = _merge_intervals(rem)
         else:
             rem = cov  # sum / distinct need the values
+        if rem:
+            rem = _dict_partial_fold(reader, acc, rem)
         for s, e in rem:
+            if cur is not None:
+                for _o, _bs, _be, vals, valid in cur.blocks(s, e):
+                    if agg.kind == "count":
+                        acc.add_count(_present_count(vals, valid))
+                    else:
+                        acc.add_values(
+                            _present_order_values(leaf, vals, valid))
+                continue
             vals, valid = reader.aligned(leaf, s, e - s)
             if agg.kind == "count":
                 acc.add_count(_present_count(vals, valid))
@@ -804,11 +929,103 @@ def _contrib_partial(pf, rg, reader: _RgReader, acc: _Acc,
                 acc.add_values(_present_order_values(leaf, vals, valid))
     # ---- contended intervals (exact mask)
     for (s, e), m in masks.items():
+        if cur is not None:
+            _fold_masked_interval(cur, acc, s, e, m)
+            continue
         vals, valid = reader.aligned(leaf, s, e - s)
         if agg.kind == "count":
             acc.add_count(_present_count(vals, valid, m))
         else:
             acc.add_values(_present_order_values(leaf, vals, valid, m))
+
+
+def _dict_partial_fold(reader: _RgReader, acc: _Acc,
+                       rem: _Intervals) -> _Intervals:
+    """Partial-coverage dictionary tier: covered intervals of a fully
+    dict-encoded chunk fold straight off the index stream (validity
+    prefix-sums map row intervals to index positions; values never
+    expand) while contended rows keep the exact path.  Returns the
+    intervals still needing a value decode — [] when the dictionary
+    answered."""
+    leaf = acc.leaf
+    if acc.agg.kind not in ("count", "min", "max", "sum", "sum_sq",
+                            "count_distinct"):
+        return rem
+    col = reader.dict_column(leaf)
+    if col is None:
+        return rem
+    idx = np.asarray(col.dict_indices)
+    va = None if col.validity is None else np.asarray(col.validity, bool)
+    entries = None if acc.agg.kind == "count" \
+        else reader.dict_entries(leaf, col)
+    for s, e in rem:
+        if va is None:
+            sub = idx[s:e]
+        else:
+            st = int(np.count_nonzero(va[:s]))
+            sub = idx[st:st + int(np.count_nonzero(va[s:e]))]
+        _dict_fold(acc, entries, sub)
+    reader.dict_partial_used = True
+    return []
+
+
+def _masked_order_values(leaf, dec, cursor):
+    """A masked-emit decode result → the order-domain form
+    ``_present_order_values`` produces, so fused folds stay
+    value-identical to the materializing path.  ``dec`` is dense over
+    the SELECTED PRESENT rows (nulls already dropped by the kernel)."""
+    from ..ops.encodings import DictIndices
+
+    if isinstance(dec, DictIndices):
+        entries = getattr(cursor, "_agg_entries", None)
+        if entries is None:
+            entries = _dict_order_entries(leaf, cursor.dictionary())
+            cursor._agg_entries = entries
+        idx = np.asarray(dec.indices)
+        if isinstance(entries, np.ndarray):
+            return entries[idx]
+        return [entries[i] for i in idx.tolist()]
+    if isinstance(dec, tuple):  # (uint8 values, offsets) byte arrays
+        hv, ho = np.asarray(dec[0]), np.asarray(dec[1])
+        out = [bytes(hv[ho[i]:ho[i + 1]]) for i in range(len(ho) - 1)]
+        return _present_order_values(leaf, out, None)
+    return _present_order_values(leaf, np.asarray(dec), None)
+
+
+def _fold_masked_interval(cursor, acc: _Acc, s: int, e: int,
+                          m: np.ndarray) -> None:
+    """Fold one contended interval [s, e) through the fused masked-emit
+    path, page by page: pages the mask never selects are NOT decoded,
+    masked-capable encodings emit only the selected present values, and
+    anything else full-decodes ONE page and masks after — never a
+    whole-span buffer."""
+    agg, leaf = acc.agg, acc.leaf
+    for o in cursor.ordinals(s, e):
+        ps, pe = cursor.spans[o]
+        bs, be = max(ps, s), min(pe, e)
+        sub = m[bs - s:be - s]
+        if not sub.any():
+            continue  # the fused win: this page never decodes
+        sel = np.zeros(pe - ps, bool)
+        sel[bs - ps:be - ps] = sub
+        dec, present = cursor.masked_values(o, sel)
+        if dec is None and present == 0:
+            continue  # every selected row is null
+        if dec is None:  # page can't masked-decode: one-page fallback
+            from .search import _trim_flat_aligned
+
+            vals, valid = _trim_flat_aligned(cursor.page(o), bs - ps,
+                                             be - bs)
+            if agg.kind == "count":
+                acc.add_count(_present_count(vals, valid, sub))
+            else:
+                acc.add_values(
+                    _present_order_values(leaf, vals, valid, sub))
+            continue
+        if agg.kind == "count":
+            acc.add_count(present)
+        else:
+            acc.add_values(_masked_order_values(leaf, dec, cursor))
 
 
 def _topk_intervals(pf, rg, reader: _RgReader, acc: _Acc,
@@ -1035,6 +1252,7 @@ class AggregateResult:
         tail = (f"  tiers: stats={c['rg_answered_stats']} "
                 f"pages={c['rg_answered_pages']} "
                 f"dict={c['rg_answered_dict']} "
+                f"dict_partial={c['rg_answered_dict_partial']} "
                 f"decoded={c['rg_answered_decoded']}"
                 + (f"; manifest-answered files="
                    f"{c['files_answered_manifest']}"
@@ -1648,13 +1866,14 @@ def _dataset_aggregate_impl(ds, aggs, where, group_by, policy, report,
             report.merge(sub)
         _, faccs, fgroups, fcounters, _flines = state
         for k in ("rg_answered_stats", "rg_answered_pages",
-                  "rg_answered_dict", "rg_answered_decoded",
-                  "rg_skipped_corrupt"):
+                  "rg_answered_dict", "rg_answered_dict_partial",
+                  "rg_answered_decoded", "rg_skipped_corrupt"):
             counters[k] += fcounters.get(k, 0)
         lines.append(f"  file {ds.paths[i]}: tiers "
                      f"stats={fcounters['rg_answered_stats']} "
                      f"pages={fcounters['rg_answered_pages']} "
                      f"dict={fcounters['rg_answered_dict']} "
+                     f"dict_partial={fcounters['rg_answered_dict_partial']} "
                      f"decoded={fcounters['rg_answered_decoded']}")
         for acc, d in zip(accs, faccs):
             acc.merge(d)

@@ -831,11 +831,14 @@ class LocalRangeServer:
     bytes and moves its validators, so cache-invalidation-on-rewrite is
     testable; ``requests`` logs every ``(method, name, range_header)``
     so tests can assert "the warm read touched the network exactly
-    never"."""
+    never".  With ``s3_dialect=True`` the server additionally answers
+    ``?list-type=2`` GETs with paginated ListObjectsV2 XML — the
+    fixture behind ``s3://`` prefix expansion (``list_prefix_s3``)."""
 
     def __init__(self, files: Optional[dict] = None,
                  ignore_range: bool = False, send_validators: bool = True,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 s3_dialect: bool = False, s3_max_keys: int = 1000):
         import hashlib
         from email.utils import formatdate
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -846,6 +849,12 @@ class LocalRangeServer:
         self._mtime: Dict[str, float] = {}
         self.ignore_range = ignore_range
         self.send_validators = send_validators
+        # s3_dialect: answer ?list-type=2 GETs with paginated
+        # ListObjectsV2 XML (the s3:// prefix-expansion fixture);
+        # s3_max_keys is the page size, small values exercise the
+        # continuation-token loop
+        self.s3_dialect = s3_dialect
+        self.s3_max_keys = max(int(s3_max_keys), 1)
         # auth_token: requests must carry "Authorization: Bearer <tok>"
         # or get 401 — the private-bucket fixture; set_auth_token()
         # rotates it (the stale-credential → 401 → refresh path)
@@ -917,6 +926,58 @@ class LocalRangeServer:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
 
+            def _s3_listing(self):
+                # ListObjectsV2 over the path-style bucket in self.path:
+                # keys are object names relative to the bucket segment,
+                # paginated at s3_max_keys with an integer-offset
+                # continuation token (opaque to the client, like S3's)
+                from urllib.parse import parse_qs, urlsplit
+                from xml.sax.saxutils import escape as _xesc
+
+                parts = urlsplit(self.path)
+                bucket = parts.path.lstrip("/").rstrip("/")
+                q = parse_qs(parts.query)
+                prefix = (q.get("prefix") or [""])[0]
+                token = (q.get("continuation-token") or [None])[0]
+                delim = (q.get("delimiter") or [None])[0]
+                full = (bucket + "/" if bucket else "") + prefix
+                with server._lock:
+                    names = sorted(server._files)
+                keys = [n[len(bucket) + 1 if bucket else 0:]
+                        for n in names if n.startswith(full) and n != full]
+                if delim:
+                    keys = [k for k in keys
+                            if delim not in k[len(prefix):]]
+                start = 0
+                if token:
+                    try:
+                        start = max(int(token), 0)
+                    except ValueError:
+                        start = 0
+                page = keys[start:start + server.s3_max_keys]
+                truncated = start + len(page) < len(keys)
+                xml = ['<?xml version="1.0" encoding="UTF-8"?>',
+                       '<ListBucketResult xmlns="http://s3.amazonaws.com'
+                       '/doc/2006-03-01/">',
+                       f"<Prefix>{_xesc(prefix)}</Prefix>",
+                       f"<KeyCount>{len(page)}</KeyCount>",
+                       f"<IsTruncated>{'true' if truncated else 'false'}"
+                       f"</IsTruncated>"]
+                if truncated:
+                    xml.append(f"<NextContinuationToken>"
+                               f"{start + len(page)}"
+                               f"</NextContinuationToken>")
+                for k in page:
+                    xml.append(f"<Contents><Key>{_xesc(k)}</Key>"
+                               f"<Size>0</Size></Contents>")
+                xml.append("</ListBucketResult>")
+                body = "".join(xml).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/xml")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802
                 name, data, meta = self._lookup()
                 rng = self.headers.get("Range")
@@ -924,6 +985,10 @@ class LocalRangeServer:
                     server.requests.append(("GET", name, rng))
                 if not self._authorized():
                     self._deny()
+                    return
+                if server.s3_dialect and "list-type=2" in \
+                        (self.path.split("?", 1) + [""])[1]:
+                    self._s3_listing()
                     return
                 if data is None and (name == "" or name.endswith("/")):
                     # prefix listing: GET on a "directory" URL returns a

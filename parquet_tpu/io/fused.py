@@ -1,0 +1,260 @@
+"""Fused single-pass page streaming: decode → filter → aggregate.
+
+The aggregation cascade (io/aggregate.py) and filtered scan
+(parallel/host_scan.py) decide WHAT must decode; before this module their
+exact tier still materialized whole column spans, masked them, and folded —
+the last big memory-bandwidth tax on the hot analytics path.  Here contended
+pages stream through a :class:`PageCursor` instead: at most ONE decoded page
+is alive per column at any moment (its ``ledger`` bytes release when the next
+page replaces it), filter masks apply INSIDE the decode via the registered
+``decode_masked`` kernels (ops/ref.py — RLE runs the mask never touches are
+not even expanded), and per-page partial results fold into the same ``_Acc``
+states as the tiered cascade, so answers stay value-identical.
+
+Reference parity: the segmentio/parquet-go lineage's ``column.Pages`` /
+``page.Data`` iteration wins precisely because pages die immediately after
+use instead of accumulating into column buffers (PAPER.md); this is that
+page-at-a-time discipline grafted onto the pushdown cascade.
+
+Selection is behind ``PARQUET_TPU_FUSED`` (auto/on/off) with
+:func:`parquet_tpu.io.planner.choose_fused` as the cost gate.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..format.enums import Encoding, PageType, Type
+from ..obs import scope as _oscope
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import histogram as _histogram
+from ..ops import ref
+from ..ops.encodings import lookup as _lookup_encoding
+from ..utils.pool import read_admission
+
+__all__ = ["FusedUnsupported", "PageCursor"]
+
+# resolved once (hot-path rule: no registry get-or-create on increments)
+_M_RG_FOLDS = _counter("fused.rg_folds")
+_M_PAGES_FOLDED = _counter("fused.pages_folded")
+_M_PAGES_MASKED = _counter("fused.pages_masked_emit")
+_M_FALLBACKS = _counter("fused.fallbacks")
+_M_SCAN_SPANS = _counter("fused.scan_spans")
+_H_FOLD_S = _histogram("fused.fold_s")
+
+_UNSET = object()
+
+
+class FusedUnsupported(Exception):
+    """This chunk can't stream page-at-a-time (nested column, no offset
+    index) — callers fall back to the materializing path."""
+
+
+class PageCursor:
+    """Row-aligned access to ONE flat column chunk, one page at a time.
+
+    The cursor memoizes only the CURRENT page's decoded form: asking for a
+    different page drops the previous one, so its buffers (and ledger bytes)
+    release immediately — peak memory is one page, not one column.  Each
+    page decode runs under a short-lived admission grant sized to the page's
+    uncompressed bytes (the grant covers the decode window; the trimmed
+    result is what outlives it), so ``AdmissionController.high_water`` during
+    a fused fold tracks page-sized peaks instead of span-sized ones.
+    """
+
+    def __init__(self, rg, leaf):
+        if leaf.max_repetition_level > 0:
+            raise FusedUnsupported(f"nested column {leaf.dotted_path!r}")
+        self.leaf = leaf
+        self.rg = rg
+        self.chunk = rg.column(leaf.column_index)
+        oi = self.chunk.offset_index()
+        if oi is None or not oi.page_locations:
+            raise FusedUnsupported(
+                f"no offset index for {leaf.dotted_path!r}")
+        from .search import page_row_spans
+
+        self.locs = oi.page_locations
+        self.spans: List[Tuple[int, int]] = page_row_spans(oi, rg.num_rows)
+        self._dict = _UNSET
+        self._cur: Tuple[Optional[int], object] = (None, None)
+        self._adm = read_admission()
+        self.pages_decoded = 0
+        self.pages_masked = 0
+
+    # ------------------------------------------------------------------ pages
+    def dictionary(self):
+        """The chunk's decoded dictionary (memoized; None when absent)."""
+        if self._dict is _UNSET:
+            from .reader import decode_dictionary_page
+            from .search import dictionary_pages
+
+            d = None
+            for pg in dictionary_pages(self.chunk, self.locs[0].offset):
+                d = decode_dictionary_page(self.chunk, pg)
+                break
+            self._dict = d
+        return self._dict
+
+    def _page_info(self, o: int):
+        loc = self.locs[o]
+        return next(self.chunk.pages_at(loc.offset, loc.compressed_page_size,
+                                        num_pages=1))
+
+    def page(self, o: int):
+        """Decode page ``o`` fully (memoized for the CURRENT ordinal only —
+        a different ordinal releases the previous page)."""
+        cur_o, col = self._cur
+        if cur_o == o:
+            return col
+        from .reader import decode_chunk_host
+
+        pg = self._page_info(o)
+        with self._adm.admit(pg.header.uncompressed_page_size or 0,
+                             tier="scan"):
+            col = decode_chunk_host(self.chunk, pages=iter([pg]),
+                                    dictionary=self.dictionary())
+        self.pages_decoded += 1
+        _oscope.account(_M_PAGES_FOLDED)
+        self._cur = (o, col)
+        return col
+
+    # ---------------------------------------------------------------- aligned
+    def ordinals(self, s: int, e: int) -> Iterator[int]:
+        """Page ordinals overlapping local rows [s, e)."""
+        for o, (ps, pe) in enumerate(self.spans):
+            if pe <= s:
+                continue
+            if ps >= e:
+                break
+            yield o
+
+    def grid(self, s: int, e: int) -> List[int]:
+        """Interior page-start boundaries of [s, e) — cut points callers
+        union across cursors so every sub-block lies inside one page per
+        column."""
+        return [ps for ps, _ in self.spans if s < ps < e]
+
+    def blocks(self, s: int, e: int):
+        """Yield ``(ordinal, bs, be, vals, valid)`` row-aligned pieces of
+        [s, e), one per overlapping page, decoded one at a time."""
+        from .search import _trim_flat_aligned
+
+        for o in self.ordinals(s, e):
+            ps, pe = self.spans[o]
+            bs, be = max(ps, s), min(pe, e)
+            col = self.page(o)
+            vals, valid = _trim_flat_aligned(col, bs - ps, be - bs)
+            yield o, bs, be, vals, valid
+
+    def aligned(self, s: int, e: int):
+        """(values, validity) for local rows [s, e).  An interval spanning
+        pages concatenates the trimmed pieces — still never more than one
+        DECODED page alive at a time."""
+        parts = list(self.blocks(s, e))
+        if len(parts) == 1:
+            return parts[0][3], parts[0][4]
+        vals_parts = [p[3] for p in parts]
+        valid_parts = [p[4] for p in parts]
+        if isinstance(vals_parts[0], list):
+            vals = [v for part in vals_parts for v in part]
+        else:
+            vals = np.concatenate(vals_parts)
+        if all(v is None for v in valid_parts):
+            return vals, None
+        valid = np.concatenate(
+            [v if v is not None else np.ones(p[2] - p[1], bool)
+             for v, p in zip(valid_parts, parts)])
+        return vals, valid
+
+    # ----------------------------------------------------------- masked emit
+    def masked_values(self, o: int, sel: np.ndarray):
+        """Fused decode+mask of page ``o``: ``sel`` is a bool mask over the
+        page's LOCAL rows.  Returns ``(values, present)`` — ``values`` the
+        dense selected present values in row order (array, ``(vals, offs)``
+        pair, or :class:`DictIndices`) and ``present`` their count — or
+        ``(None, 0)`` when every selected row is null (success, nothing to
+        fold), or ``(None, -1)`` when this page can't masked-decode (the
+        caller full-decodes via :meth:`page`)."""
+        from .reader import _bit_width, verify_page_crc
+
+        leaf, chunk = self.leaf, self.chunk
+        max_def = leaf.max_definition_level
+        physical = Type(chunk.meta.type)
+        pg = self._page_info(o)
+        h = pg.header
+        with self._adm.admit(h.uncompressed_page_size or 0, tier="scan"):
+            verify_page_crc(chunk, pg)
+            codec = chunk.codec
+            if pg.page_type == PageType.DATA_PAGE:
+                dph = h.data_page_header
+                n = dph.num_values
+                raw = np.frombuffer(
+                    codec.decode(pg.payload, h.uncompressed_page_size),
+                    np.uint8)
+                pos = 0
+                defs = None
+                if max_def > 0:
+                    if Encoding(dph.definition_level_encoding) != Encoding.RLE:
+                        return None, -1  # legacy BIT_PACKED levels
+                    pv, end = ref.rle_len_prefixed_single_value(raw, n, pos)
+                    if pv == 1 and max_def == 1:
+                        defs, pos = None, end
+                    else:
+                        defs, pos = ref.decode_rle_len_prefixed(
+                            raw, n, _bit_width(max_def), pos)
+                nvals = (n if defs is None
+                         else int(np.count_nonzero(defs == max_def)))
+                encoding = Encoding(dph.encoding)
+            elif pg.page_type == PageType.DATA_PAGE_V2:
+                dph2 = h.data_page_header_v2
+                n = dph2.num_values
+                rl = dph2.repetition_levels_byte_length or 0
+                dl = dph2.definition_levels_byte_length or 0
+                defs = None
+                if max_def > 0 and not (max_def == 1
+                                        and dph2.num_nulls == 0):
+                    raw_levels = np.frombuffer(pg.payload[: rl + dl],
+                                               np.uint8)
+                    defs = ref.decode_rle(raw_levels[rl:], n,
+                                          _bit_width(max_def), 0)
+                body = pg.payload[rl + dl:]
+                if dph2.is_compressed is not False:
+                    body = codec.decode(body,
+                                        h.uncompressed_page_size - rl - dl)
+                raw = np.frombuffer(body, np.uint8)
+                pos = 0
+                nvals = n - (dph2.num_nulls or 0)
+                encoding = Encoding(dph2.encoding)
+            else:
+                return None, -1  # index pages etc.
+            spec = _lookup_encoding(encoding)
+            if spec is None or spec.decode_masked is None:
+                return None, -1
+            sel = np.asarray(sel, bool)
+            if defs is None:
+                take = np.flatnonzero(sel).astype(np.int64)
+            else:
+                valid = defs == max_def
+                take = (np.cumsum(valid) - 1)[sel & valid].astype(np.int64)
+            present = len(take)
+            if present == 0:
+                self.pages_masked += 1
+                _oscope.account(_M_PAGES_MASKED)
+                return None, 0
+            dec = spec.decode_masked(raw, pos, nvals, take, leaf, physical,
+                                     self.dictionary())
+        if dec is None:
+            return None, -1
+        self.pages_masked += 1
+        _oscope.account(_M_PAGES_MASKED)
+        _oscope.account(_M_PAGES_FOLDED)
+        return dec, present
+
+    @property
+    def touched(self) -> bool:
+        """True when any page decoded or masked-emitted (exact-decode work
+        happened — tier accounting reads this)."""
+        return self.pages_decoded > 0 or self.pages_masked > 0

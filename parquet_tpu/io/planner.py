@@ -210,6 +210,26 @@ class ScanPlan:
         return "\n".join(lines)
 
 
+# fused streaming pays per-page header parses + mask bookkeeping; under
+# this many estimated decode bytes the materializing exact tier's single
+# big span read wins (auto mode only — on/off pin the choice)
+FUSED_AUTO_MIN_BYTES = 8 << 20
+
+
+def choose_fused(est_bytes: int) -> bool:
+    """Cost gate for the fused decode+mask+fold path (``PARQUET_TPU_FUSED``):
+    ``on``/``off`` pin it; ``auto`` (default) fuses once ``est_bytes`` —
+    the bytes the exact tier would otherwise materialize — clears
+    :data:`FUSED_AUTO_MIN_BYTES` (peak-memory and bandwidth savings then
+    dominate the per-page overhead)."""
+    mode = (env_str("PARQUET_TPU_FUSED") or "").strip().lower() or "auto"
+    if mode in ("on", "1", "true", "always"):
+        return True
+    if mode in ("off", "0", "false", "never"):
+        return False
+    return int(est_bytes) >= FUSED_AUTO_MIN_BYTES
+
+
 def _collect_preds(expr: Expr) -> List[Pred]:
     if isinstance(expr, Pred):
         return [expr]

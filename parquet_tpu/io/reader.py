@@ -2101,12 +2101,34 @@ def _dec_rle_bool(raw, pos, nvals, leaf, physical, dictionary):
     return vals.astype(np.bool_)
 
 
+# Masked-emit twins (fused decode+filter path, io/fused.py): same dispatch
+# arguments plus the sorted ``take`` ordinal array after nvals.
+
+
+def _dec_dict_masked(raw, pos, nvals, take, leaf, physical, dictionary):
+    if dictionary is None:
+        raise CorruptedError("dictionary-encoded page before dictionary page")
+    return _DictIndices(ref.decode_rle_dict_indices_masked(raw, nvals, take, pos))
+
+
+def _dec_plain_masked(raw, pos, nvals, take, leaf, physical, dictionary):
+    return ref.decode_plain_masked(raw[pos:], nvals, take, physical,
+                                   leaf.type_length)
+
+
+def _dec_delta_masked(raw, pos, nvals, take, leaf, physical, dictionary):
+    vals = ref.decode_delta_binary_packed_masked(raw, nvals, take, pos)
+    return vals.astype(np.int32) if physical == Type.INT32 else vals
+
+
 for _spec in (
-        EncodingSpec(Encoding.PLAIN, "PLAIN", _dec_plain),
-        EncodingSpec(Encoding.PLAIN_DICTIONARY, "PLAIN_DICTIONARY", _dec_dict),
-        EncodingSpec(Encoding.RLE_DICTIONARY, "RLE_DICTIONARY", _dec_dict),
+        EncodingSpec(Encoding.PLAIN, "PLAIN", _dec_plain, _dec_plain_masked),
+        EncodingSpec(Encoding.PLAIN_DICTIONARY, "PLAIN_DICTIONARY", _dec_dict,
+                     _dec_dict_masked),
+        EncodingSpec(Encoding.RLE_DICTIONARY, "RLE_DICTIONARY", _dec_dict,
+                     _dec_dict_masked),
         EncodingSpec(Encoding.DELTA_BINARY_PACKED, "DELTA_BINARY_PACKED",
-                     _dec_delta),
+                     _dec_delta, _dec_delta_masked),
         EncodingSpec(Encoding.DELTA_LENGTH_BYTE_ARRAY,
                      "DELTA_LENGTH_BYTE_ARRAY", _dec_delta_len_ba),
         EncodingSpec(Encoding.DELTA_BYTE_ARRAY, "DELTA_BYTE_ARRAY",

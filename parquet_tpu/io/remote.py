@@ -75,7 +75,8 @@ __all__ = ["HttpSource", "ObjectStoreSource", "HttpTransport",
            "remote_debug", "hedge_delay_s", "observed_pread_ewma",
            "drain_connection_pools", "parallel_preads",
            "parallel_pread_slots", "register_auth_hook",
-           "unregister_auth_hook", "list_prefix", "classify_status",
+           "unregister_auth_hook", "list_prefix", "list_prefix_s3",
+           "resolve_s3_url", "s3_endpoint", "classify_status",
            "gunzip_body"]
 
 # resolved once: the pread hot path must not take the registry's
@@ -1163,6 +1164,135 @@ def list_prefix(url: str, policy=None) -> List[str]:
         raise FileNotFoundError(f"prefix listing {url!r} matched no "
                                 f"files")
     return files
+
+
+# ---------------------------------------------------------------------------
+# s3:// — path-style object-store URLs over the same ranged-HTTP stack
+# ---------------------------------------------------------------------------
+
+
+def s3_endpoint() -> str:
+    """``PARQUET_TPU_S3_ENDPOINT`` — the HTTP(S) endpoint ``s3://`` URLs
+    resolve against, path-style (``{endpoint}/{bucket}/{key}``); empty
+    when unset (``s3://`` paths are then an error)."""
+    return (env_str("PARQUET_TPU_S3_ENDPOINT") or "").strip().rstrip("/")
+
+
+def resolve_s3_url(url: str) -> str:
+    """``s3://bucket/key`` → the path-style ``http(s)://`` URL it reads
+    from.  Object-store reads ARE ranged HTTP (:class:`ObjectStoreSource`
+    docstring), so resolution is pure URL rewriting — auth rides the
+    endpoint's auth hook / presigning, never an SDK."""
+    ep = s3_endpoint()
+    if not ep:
+        raise ValueError(
+            f"{url!r} needs PARQUET_TPU_S3_ENDPOINT (the HTTP(S) endpoint "
+            "serving path-style bucket requests); for presigned or public "
+            "objects use the http(s):// URL directly")
+    rest = url[len("s3://"):]
+    if not rest or rest.startswith("/"):
+        raise ValueError(f"bad s3 url {url!r} (want s3://bucket/key)")
+    return f"{ep}/{rest}"
+
+
+def _parse_s3_listing(body: bytes, host: str = "",
+                      path: str = "") -> Tuple[List[str], Optional[str]]:
+    """``(keys, continuation_token)`` from one ListObjectsV2 XML page
+    (namespace-agnostic; token is None on the last page).  A torn or
+    non-XML body is a connection artifact → transient, retried."""
+    import xml.etree.ElementTree as _ET
+
+    try:
+        root = _ET.fromstring(body)
+    except _ET.ParseError as e:
+        raise RemoteTransientError(
+            f"torn ListObjectsV2 body: {e}", host=host, path=path) from e
+    keys: List[str] = []
+    token: Optional[str] = None
+    truncated = False
+    for el in root.iter():
+        tag = el.tag.rsplit("}", 1)[-1]
+        if tag == "Key":
+            keys.append(el.text or "")
+        elif tag == "IsTruncated":
+            truncated = (el.text or "").strip().lower() == "true"
+        elif tag == "NextContinuationToken":
+            token = (el.text or "").strip() or None
+    return keys, (token if truncated else None)
+
+
+def list_prefix_s3(url: str, policy=None) -> List[str]:
+    """Expand an ``s3://bucket/prefix/`` URL into the sorted ``s3://``
+    object URLs under it — the object-store dialect of
+    :func:`list_prefix`.  Speaks ListObjectsV2 (``?list-type=2``)
+    path-style against ``PARQUET_TPU_S3_ENDPOINT`` with ``delimiter=/``
+    (one level, like a local glob) and follows continuation tokens;
+    every page GET rides the same :func:`~parquet_tpu.io.faults
+    .retry_call` loop and host circuit breaker as the pread path.  An
+    empty listing raises ``FileNotFoundError`` to match an unmatched
+    glob."""
+    from urllib.parse import urlencode, urlsplit
+
+    from .faults import FaultPolicy, retry_call
+
+    rest = url[len("s3://"):]
+    bucket, _, prefix = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"bad s3 url {url!r} (want s3://bucket/prefix/)")
+    base = resolve_s3_url(f"s3://{bucket}")
+    transport = HttpTransport(base)
+    host = transport.host
+    breaker = breaker_for(host)
+    base_path = urlsplit(base).path or "/"
+    pol = policy if policy is not None \
+        else FaultPolicy(max_retries=2, backoff_s=0.05)
+    keys: List[str] = []
+    token: Optional[str] = None
+    try:
+        while True:
+            q = {"list-type": "2", "prefix": prefix, "delimiter": "/"}
+            if token:
+                q["continuation-token"] = token
+            page_path = f"{base_path}?{urlencode(q)}"
+
+            def once(_o, _s, page_path=page_path):
+                if not breaker.allow():
+                    _account(_M_FAIL_FAST)
+                    raise RemoteCircuitOpenError(
+                        f"circuit open for {host}", host=host, path=url)
+                try:
+                    status, hdrs, body = transport._roundtrip(
+                        "GET", {"Accept": "application/xml"},
+                        path_override=page_path)
+                except (HTTPException, socket.timeout, TimeoutError,
+                        OSError) as e:
+                    breaker.record_failure()
+                    raise RemoteTransientError(
+                        f"listing failed: {e}", host=host, path=url) from e
+                if status == 429:
+                    breaker.record_inconclusive()
+                elif 500 <= status < 600:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+                classify_status(status, hdrs, host, url,
+                                what="ListObjectsV2")
+                if hdrs.get("content-encoding", "").lower() == "gzip":
+                    body = gunzip_body(body, host=host, path=url)
+                return _parse_s3_listing(body, host=host, path=url)
+
+            page_keys, token = retry_call(once, 0, 0, pol)
+            keys.extend(page_keys)
+            if not token:
+                break
+    finally:
+        transport.close()
+    out = sorted({f"s3://{bucket}/{k}" for k in keys
+                  if k and not k.endswith("/")})
+    if not out:
+        raise FileNotFoundError(f"prefix listing {url!r} matched no "
+                                f"files")
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -498,6 +498,13 @@ def as_source(obj) -> Source:
             from .remote import HttpSource  # deferred: remote imports us
 
             return HttpSource(path)
+        if path.startswith("s3://"):
+            # object-store path: rewritten path-style against
+            # PARQUET_TPU_S3_ENDPOINT — object-store reads ARE ranged
+            # HTTP, so the same remote stack serves it unchanged
+            from .remote import ObjectStoreSource, resolve_s3_url
+
+            return ObjectStoreSource(resolve_s3_url(path))
         # mmap by default: zero-copy page-cache views + madvise readahead
         # (see MmapSource).  PARQUET_TPU_MMAP=0 opts out; any mmap failure
         # (empty file, FIFO/device, exotic fs) falls back to pread

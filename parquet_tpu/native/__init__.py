@@ -555,6 +555,38 @@ def expand_runs(buf: np.ndarray, ends: np.ndarray, kinds: np.ndarray,
     return out[:wrote]
 
 
+def select_runs(buf: np.ndarray, kinds, counts, payloads, offsets,
+                bit_width: int, take: np.ndarray):
+    """Point-select from an RLE/bit-packed run table (the masked-emit hot
+    loop, io/fused.py): expand ONLY the runs the sorted ``take`` ordinals
+    touch — one native expand pass over the touched subset — then gather.
+    Beats per-value bit gathers when takes cluster densely inside runs.
+    Returns int64 values, or None when the lib is unavailable / the width is
+    out of the int32 expansion range (caller uses the bit-gather oracle)."""
+    lib = get_lib()
+    if lib is None or bit_width > 31 or len(take) == 0:
+        return None
+    counts = np.asarray(counts, np.int64)
+    take = np.asarray(take, np.int64)
+    ends = np.cumsum(counts)
+    run = np.searchsorted(ends, take, side="right")
+    starts = ends - counts
+    touched = np.unique(run)
+    t_counts = counts[touched]
+    sub_ends = np.cumsum(t_counts)
+    total = int(sub_ends[-1])
+    expanded = expand_runs(
+        buf, sub_ends, np.asarray(kinds, np.uint8)[touched],
+        np.asarray(payloads, np.int64)[touched],
+        np.asarray(offsets, np.int64)[touched] * 8,
+        np.full(len(touched), bit_width, np.int32), total)
+    if expanded is None:
+        return None
+    sub_base = sub_ends - t_counts
+    rank = np.searchsorted(touched, run)
+    return expanded[sub_base[rank] + (take - starts[run])].astype(np.int64)
+
+
 def delta_decode(buf: np.ndarray, mb_bitoffs, mb_widths, mb_mins,
                  page_mb_start, page_first, page_count, page_vpm,
                  nthreads: int = 0):

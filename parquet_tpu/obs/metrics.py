@@ -541,6 +541,20 @@ _CORE_COUNTERS = (
      "hook"),
     ("fleet.cas_conflicts", "CAS commit attempts aborted by a rival "
      "version (re-read and re-mutated)"),
+    # fused single-pass execution (io/fused.py): page-at-a-time
+    # decode+mask+fold streaming with no whole-column intermediates
+    ("fused.rg_folds", "row groups resolved by the fused streaming fold"),
+    ("fused.pages_folded", "pages decoded or masked-emitted through the "
+     "fused fold (at most one alive per column at a time)"),
+    ("fused.pages_masked_emit", "pages whose filter mask applied INSIDE "
+     "the decode loop (masked-emit kernels)"),
+    ("fused.fallbacks", "fused-path attempts that fell back to the "
+     "materializing exact tier (unsupported layout/encoding)"),
+    ("fused.scan_spans", "scan filter spans evaluated page-by-page "
+     "through the fused phase-1 path"),
+    ("agg.rg_answered_dict_partial", "partially-covered row groups whose "
+     "covered rows answered from the dictionary while only contended "
+     "pages took the exact path"),
 )
 
 
@@ -574,6 +588,8 @@ def _declare_core() -> None:
                        help="per-file aggregation-pushdown latency")
     REGISTRY.histogram("dataset.aggregate_s",
                        help="whole-dataset aggregation latency")
+    REGISTRY.histogram("fused.fold_s",
+                       help="per-row-group fused decode+mask+fold latency")
     # --- PT001 (analysis/lint.py) pass: every family any module
     # get-or-creates must already exist here, or a process that never
     # imported that module scrapes an incomplete /metrics.  The 22

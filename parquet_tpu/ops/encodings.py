@@ -22,6 +22,15 @@ and returns the decoded value form the assembler understands: a typed numpy
 array, a ``(values, offsets)`` pair for byte arrays, or a
 ``DictIndices(indices)`` wrapper for dictionary index streams.
 
+An encoding may additionally carry a ``decode_masked`` callable — the
+masked-emit variant the fused single-pass engine (io/fused.py) dispatches
+through: same arguments plus ``take``, a sorted int64 array of PHYSICAL value
+ordinals to emit, inserted after ``nvals`` — ``(raw, pos, nvals, take, leaf,
+physical, dictionary)``.  It returns only the selected values (same forms as
+``decode``), or None when this page can't be masked-decoded (the caller then
+falls back to the full ``decode``).  ``decode_masked`` is optional; encodings
+without one simply never take the fused masked path.
+
 The accelerated device path (parallel/device_reader.py) plans only the
 built-in encodings; a registered third-party encoding decodes on host and
 flows into the same Column/Table machinery (identical behavior to the
@@ -48,12 +57,14 @@ class DictIndices:
 
 @dataclass(frozen=True)
 class EncodingSpec:
-    """One registered encoding: its wire id, a name for messages, and the
-    decode callable (see module docstring for the signature)."""
+    """One registered encoding: its wire id, a name for messages, the decode
+    callable, and (optionally) the masked-emit ``decode_masked`` twin (see
+    module docstring for both signatures)."""
 
     id: int
     name: str
     decode: Callable[..., Any]
+    decode_masked: Optional[Callable[..., Any]] = None
 
 
 _REGISTRY: Dict[int, EncodingSpec] = {}

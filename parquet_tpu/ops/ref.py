@@ -366,6 +366,124 @@ def decode_rle_dict_indices(data, num_values: int, pos: int = 0) -> np.ndarray:
     return decode_rle(data, num_values, bit_width, pos + 1)
 
 
+# ---------------------------------------------------------------------------
+# Masked-emit variants (fused decode+filter, io/fused.py)
+#
+# Each takes ``take`` — a sorted int64 array of physical value ordinals — and
+# emits only those values, never materializing the full page.  For the hybrid
+# stream this is a true skip: runs the mask never touches are not expanded
+# (gather_bits reads single values at arbitrary bit offsets).
+# ---------------------------------------------------------------------------
+
+
+def gather_bits(data, starts_bits: np.ndarray, bit_width: int) -> np.ndarray:
+    """Read one LSB-first ``bit_width``-bit integer at each bit offset in
+    ``starts_bits`` (int64, need not be uniform).  Generalizes
+    :func:`unpack_bits` to arbitrary per-value positions; returns uint64."""
+    n = len(starts_bits)
+    if bit_width == 0 or n == 0:
+        return np.zeros(n, dtype=np.uint64)
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    starts = np.asarray(starts_bits, dtype=np.int64)
+    byte0 = starts >> 3
+    shift = (starts & 7).astype(np.uint64)
+    nbytes = min((bit_width + 7 + 7) // 8, 9)
+    end = int(byte0.max()) + nbytes
+    if end > len(buf):
+        buf = np.concatenate([buf, np.zeros(end - len(buf), dtype=np.uint8)])
+    acc = np.zeros(n, dtype=np.uint64)
+    for k in range(min(nbytes, 8)):
+        acc |= buf[byte0 + k].astype(np.uint64) << np.uint64(8 * k)
+    vals = acc >> shift
+    if bit_width + 7 > 64 and nbytes == 9:
+        hi = buf[byte0 + 8].astype(np.uint64)
+        vals |= np.where(shift > 0, hi << (np.uint64(64) - shift), 0)
+    if bit_width < 64:
+        vals &= (np.uint64(1) << np.uint64(bit_width)) - np.uint64(1)
+    return vals
+
+
+def select_rle(data, num_values: int, bit_width: int, take: np.ndarray,
+               pos: int = 0) -> np.ndarray:
+    """Hybrid-stream point lookup: value at each ordinal in ``take`` (sorted
+    int64) without expanding the stream.  RLE runs answer from their payload;
+    bit-packed runs via :func:`gather_bits` at the exact bit position.
+    Returns int64[len(take)]."""
+    take = np.asarray(take, dtype=np.int64)
+    if bit_width == 0 or len(take) == 0:
+        return np.zeros(len(take), dtype=np.int64)
+    kinds, counts, payloads, offsets, _ = scan_rle_runs(data, num_values, bit_width, pos)
+    ends = np.cumsum(counts)
+    run = np.searchsorted(ends, take, side="right")
+    starts = ends - counts
+    if len(take) * 8 >= int(counts[np.unique(run)].sum()):
+        # dense takes: expanding just the touched runs (one native pass)
+        # beats len(take) scattered bit reads
+        from .. import native
+
+        buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+        nat = native.select_runs(buf, kinds, counts, payloads, offsets,
+                                 bit_width, take)
+        if nat is not None:
+            return nat
+    out = payloads[run].astype(np.int64)
+    bp = kinds[run] == 1
+    if bp.any():
+        r = run[bp]
+        bits = offsets[r] * 8 + (take[bp] - starts[r]) * bit_width
+        out[bp] = gather_bits(data, bits, bit_width).astype(np.int64)
+    return out
+
+
+def decode_rle_dict_indices_masked(data, num_values: int, take: np.ndarray,
+                                   pos: int = 0) -> np.ndarray:
+    """Masked-emit twin of :func:`decode_rle_dict_indices`: only the indices
+    at the ``take`` ordinals, via :func:`select_rle` (no full expansion)."""
+    bit_width = int(data[pos])
+    if bit_width == 0:
+        return np.zeros(len(take), dtype=np.int64)
+    return select_rle(data, num_values, bit_width, take, pos + 1)
+
+
+def decode_plain_masked(data, num_values: int, take: np.ndarray, physical: Type,
+                        type_length: Optional[int] = None):
+    """Masked-emit twin of :func:`decode_plain` for fixed-width physicals: the
+    selected rows come straight out of a zero-copy view of the page body (the
+    fancy index is the only allocation).  BYTE_ARRAY returns None — its length
+    prefixes force a sequential scan, so the caller full-decodes instead."""
+    take = np.asarray(take, dtype=np.int64)
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    if physical == Type.BOOLEAN:
+        bits = np.unpackbits(buf[: (num_values + 7) // 8], bitorder="little")
+        return bits[:num_values][take].astype(np.bool_)
+    if physical == Type.INT32:
+        return buf[: 4 * num_values].view(np.int32)[take]
+    if physical == Type.INT64:
+        return buf[: 8 * num_values].view(np.int64)[take]
+    if physical == Type.FLOAT:
+        return buf[: 4 * num_values].view(np.float32)[take]
+    if physical == Type.DOUBLE:
+        return buf[: 8 * num_values].view(np.float64)[take]
+    if physical == Type.INT96:
+        return buf[: 12 * num_values].view(np.int32).reshape(num_values, 3)[take]
+    if physical == Type.FIXED_LEN_BYTE_ARRAY:
+        w = type_length
+        return buf[: w * num_values].reshape(num_values, w)[take]
+    if physical == Type.BYTE_ARRAY:
+        return None
+    raise ValueError(f"unsupported physical type {physical}")
+
+
+def decode_delta_binary_packed_masked(data, num_values: int, take: np.ndarray,
+                                      pos: int = 0) -> np.ndarray:
+    """Masked-emit twin for DELTA_BINARY_PACKED.  The prefix-sum chain makes a
+    true skip impossible (every delta feeds the running value), so this decodes
+    the stream and selects — the saving is the downstream materialization, not
+    the unpack."""
+    vals, _ = decode_delta_binary_packed(data, pos)
+    return vals[:num_values][np.asarray(take, dtype=np.int64)]
+
+
 def encode_rle(values: np.ndarray, bit_width: int, min_repeat: int = 8,
                _native: bool = True) -> bytes:
     """Encode the hybrid stream (no prefix).

@@ -170,6 +170,30 @@ def expr_mask(expr, env: Dict[str, tuple], n: int) -> np.ndarray:
     return _expr_mask(expr, env, n)
 
 
+def _fused_span_mask(pf, rg_i: int, s: int, count: int,
+                     fcols: Sequence[str], expr) -> np.ndarray:
+    """Phase 1, fused: the span's filter pages are decoded, evaluated,
+    and DISCARDED one block at a time on the union page grid (each block
+    lies inside one page per filter column; a cursor's previous page —
+    and its ledger bytes — release as it advances).  The full predicate
+    mask comes back without a whole filter span ever being alive.
+    Raises :class:`~parquet_tpu.io.fused.FusedUnsupported` when any
+    filter column lacks an offset index (caller falls back)."""
+    from ..io.fused import _M_SCAN_SPANS, PageCursor
+
+    rg = pf.row_groups[rg_i]
+    cursors = {c: PageCursor(rg, pf.schema.leaf(c)) for c in fcols}
+    e = s + count
+    mask = np.empty(count, bool)
+    cuts = sorted({cc for cur in cursors.values() for cc in cur.grid(s, e)})
+    bounds = [s] + cuts + [e]
+    for bs, be in zip(bounds, bounds[1:]):
+        env = {c: cursors[c].aligned(bs, be) for c in fcols}
+        mask[bs - s:be - s] = _expr_mask(expr, env, be - bs)
+    _oscope.account(_M_SCAN_SPANS)
+    return mask
+
+
 def _pred_mask(pred, span_val: tuple, n: int) -> np.ndarray:
     """One leaf's exact mask, in the leaf's order domain — the same
     comparison semantics the pruning cascade used (str → bytes, decimals
@@ -336,7 +360,7 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
             # results on the floor); re-raised or skipped below
             return _SpanFailure(rg_i, e)
 
-    def fan_out(tasks, cells):
+    def fan_out(fn, tasks, cells):
         # thread-pool dispatch costs ~100us/task: serial decode wins for
         # small plans (measured crossover around a few hundred thousand
         # cells).  Inside a pool worker (the dataset layer's per-FILE
@@ -345,7 +369,7 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
         if num_threads == 1 or len(tasks) <= 1 or (num_threads is None
                                                    and (cells < 2_000_000
                                                         or _in_pool())):
-            return [read_one(t) for t in tasks]
+            return [fn(t) for t in tasks]
         if num_threads is None:
             # fan out per (span, column): the decode work releases the GIL
             # in numpy/C++/codec calls.  mark_pooled keeps the per-worker
@@ -355,10 +379,10 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
             # pool.queue_wait_s — the scan router's saturation delta for
             # the host route is measured from exactly these tasks
             return list(_pool().map(
-                _instrument_task(_mark_pooled(read_one), name="scan_read"),
+                _instrument_task(_mark_pooled(fn), name="scan_read"),
                 tasks))
         with ThreadPoolExecutor(max_workers=num_threads) as pool:
-            return list(pool.map(_mark_pooled(read_one), tasks))
+            return list(pool.map(_mark_pooled(fn), tasks))
 
     def drop_bad_rgs(failures):
         """Degraded scan: drop every span of each corrupt row group (spans
@@ -376,29 +400,76 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
 
     # ---- phase 1: decode only the FILTER columns' candidate pages and
     # evaluate the exact predicate (aligned=True: order-domain compares
-    # are per-value)
+    # are per-value).  Fused variant (PARQUET_TPU_FUSED / choose_fused on
+    # the plan's filter-column byte estimate): each span's filter pages
+    # are evaluated and DISCARDED page-by-page on the union page grid —
+    # phase 1 never holds a whole filter span, at the cost of re-reading
+    # filter columns that are also output columns in phase 2.
+    from ..io.planner import choose_fused
+    use_fused = bool(fcols) and bool(spans) \
+        and choose_fused(plan.est_bytes([]))
     cand_rows = sum(count for _, _, count in spans)
-    tasks1 = [(rg_i, start, count, c, True)
-              for (rg_i, start, count) in spans for c in fcols]
+
+    from ..io.fused import FusedUnsupported
+
+    def mask_one(si):
+        rg_i, gstart, count = spans[si]
+        s = int(gstart - rg_base[rg_i])
+        try:
+            with read_context(path=pf._path, row_group=rg_i):
+                try:
+                    return _fused_span_mask(pf, rg_i, s, count, fcols,
+                                            expr)
+                except FusedUnsupported:
+                    from ..io.fused import _M_FALLBACKS
+                    _oscope.account(_M_FALLBACKS)
+                    env = {}
+                    for c in fcols:
+                        with admission.admit(_span_bytes(rg_i, c, count),
+                                             tier="scan"):
+                            env[c] = read_row_range(pf, c, gstart, count,
+                                                    aligned=True)
+                    return _expr_mask(expr, env, count)
+        except DeadlineError:
+            raise
+        except CorruptedError as e:
+            return _SpanFailure(rg_i, e)
+
     p1_span = (_otrace.span("scan.phase1", file=pf._path,
                             spans=len(spans), cand_rows=cand_rows)
                if _otrace.TRACE_ENABLED else _otrace.NULL_SPAN)
     # `with`: a failing fan-out (deadline, unskippable corruption) must
     # still record the span — the failed run is the one worth tracing
     with p1_span:
-        res1 = fan_out(tasks1, cand_rows * max(len(fcols), 1))
-        failures = [r for r in res1 if isinstance(r, _SpanFailure)]
-        if failures:
-            bad = drop_bad_rgs(failures)
-            keep = [i for i, s in enumerate(spans) if s[0] not in bad]
-            res1 = [res1[i * len(fcols) + j] for i in keep
-                    for j in range(len(fcols))]
-            spans = [spans[i] for i in keep]
-        k = len(fcols)
-        envs = [{c: res1[i * k + j] for j, c in enumerate(fcols)}
-                for i in range(len(spans))]
-        masks = [_expr_mask(expr, env, count)
-                 for (rg_i, start, count), env in zip(spans, envs)]
+        if use_fused:
+            res1 = fan_out(mask_one, list(range(len(spans))),
+                           cand_rows * max(len(fcols), 1))
+            failures = [r for r in res1 if isinstance(r, _SpanFailure)]
+            if failures:
+                bad = drop_bad_rgs(failures)
+                keep = [i for i, s in enumerate(spans) if s[0] not in bad]
+                res1 = [res1[i] for i in keep]
+                spans = [spans[i] for i in keep]
+            # filter pages were folded and dropped: nothing to reuse
+            envs = [{} for _ in spans]
+            masks = res1
+        else:
+            tasks1 = [(rg_i, start, count, c, True)
+                      for (rg_i, start, count) in spans for c in fcols]
+            res1 = fan_out(read_one, tasks1,
+                           cand_rows * max(len(fcols), 1))
+            failures = [r for r in res1 if isinstance(r, _SpanFailure)]
+            if failures:
+                bad = drop_bad_rgs(failures)
+                keep = [i for i, s in enumerate(spans) if s[0] not in bad]
+                res1 = [res1[i * len(fcols) + j] for i in keep
+                        for j in range(len(fcols))]
+                spans = [spans[i] for i in keep]
+            k = len(fcols)
+            envs = [{c: res1[i * k + j] for j, c in enumerate(fcols)}
+                    for i in range(len(spans))]
+            masks = [_expr_mask(expr, env, count)
+                     for (rg_i, start, count), env in zip(spans, envs)]
 
     # ---- phase 2: late materialization — output columns decode only the
     # pages covering rows that SURVIVED the exact predicate (the span is
@@ -411,7 +482,10 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
     # output columns stay columnar ("arrays"): python bytes objects are
     # materialized only for surviving rows — per-row materialization of
     # the full span was the scan's dominant cost on string output columns
-    read2_cols = [c for c in out_cols if c not in set(fcols)]
+    # fused phase 1 discards filter pages as it folds them, so filter
+    # columns that are also output re-read (survivor-trimmed) in phase 2
+    fset = set() if use_fused else set(fcols)
+    read2_cols = [c for c in out_cols if c not in fset]
     tasks2 = [(spans[si][0], spans[si][1] + t0, t1 - t0, c, "arrays")
               for si, trim in enumerate(trims) if trim is not None
               for t0, t1 in [trim] for c in read2_cols]
@@ -421,7 +495,7 @@ def _scan_expr_impl(pf, where, columns, num_threads, use_bloom, pol,
                             tasks=len(tasks2), cells=cells2)
                if _otrace.TRACE_ENABLED else _otrace.NULL_SPAN)
     with p2_span:  # `with`: record the span even when the fan-out raises
-        res2 = fan_out(tasks2, cells2)
+        res2 = fan_out(read_one, tasks2, cells2)
     failures = [r for r in res2 if isinstance(r, _SpanFailure)]
     if failures:
         bad = drop_bad_rgs(failures)

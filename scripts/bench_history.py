@@ -48,6 +48,11 @@ CONTRACTS = {
     "12_aggregate": ("sweep 0.1% speedup",
                      lambda cfg: cfg.get("sweep", {}).get("0.1%", {})
                      .get("speedup"), 10.0),
+    # fused decode->mask->fold vs the unfused exact-decode tier at 1%
+    # selectivity on an unprunable key: the ISSUE 18 acceptance bar
+    "13_fused": ("sweep 1% speedup",
+                 lambda cfg: cfg.get("sweep", {}).get("1%", {})
+                 .get("speedup"), 1.5),
 }
 
 

@@ -1,0 +1,350 @@
+"""Fused single-pass execution (ISSUE 18): masked-emit decode + per-page
+partial-aggregate folds must be value-identical to the unfused cascade
+across encodings × nulls × multi-row-group layouts × selectivities, bound
+peak ledger bytes to page scale (no whole-column intermediates), drop a
+corrupt row group atomically under ``skip_row_group`` with fused on, fall
+back loudly (``fused.fallbacks``) when a file has no offset index, and
+survive a concurrent scan+aggregate hammer (check.sh reruns it under
+lockcheck)."""
+
+import io
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import (FaultPolicy, ParquetFile, ReadReport, col, count,
+                         count_distinct, max_, min_, sum_)
+from parquet_tpu.io.cache import clear_caches
+from parquet_tpu.io.planner import FUSED_AUTO_MIN_BYTES, choose_fused
+from parquet_tpu.io.source import BytesSource
+from parquet_tpu.io.writer import WriterOptions, write_table
+from parquet_tpu.obs import metrics_delta, metrics_snapshot
+from parquet_tpu.parallel.host_scan import scan_expr
+from parquet_tpu.utils.pool import read_admission
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for k in ("PARQUET_TPU_FUSED", "PARQUET_TPU_READ_BUDGET",
+              "PARQUET_TPU_SCAN_BUDGET"):
+        monkeypatch.delenv(k, raising=False)
+    clear_caches(reset_stats=True)
+    read_admission()._reset()
+    yield
+    clear_caches(reset_stats=True)
+    read_admission()._reset()
+
+
+def _write_ours(table, **kw):
+    buf = io.BytesIO()
+    write_table(table, buf, WriterOptions(**kw))
+    return buf.getvalue()
+
+
+def _maybe_null(vals, nulls, period=13):
+    if not nulls:
+        return list(vals)
+    return [None if i % period == 0 else v for i, v in enumerate(vals)]
+
+
+def _fixture(n=4000, nulls=False, rgs=4):
+    """k: sorted int64 filter column; v: low-cardinality ints (dict/RLE);
+    s: 64 binary categories (dict BYTE_ARRAY); f: exactly-representable
+    floats (fold order cannot perturb the sum); d: DELTA_BINARY_PACKED."""
+    k = np.arange(n, dtype=np.int64)
+    v = _maybe_null((np.arange(n) % 201).astype(np.int64).tolist(), nulls)
+    s = _maybe_null([f"cat{i % 64:02d}" for i in range(n)], nulls, period=7)
+    f = _maybe_null([float((i % 9) * 0.5) for i in range(n)], nulls)
+    d = (np.arange(n, dtype=np.int64) * 3) % 1000
+    t = pa.table({"k": pa.array(k), "v": pa.array(v, type=pa.int64()),
+                  "s": pa.array(s), "f": pa.array(f, type=pa.float64()),
+                  "d": pa.array(d)})
+    from parquet_tpu.format.enums import Encoding
+    raw = _write_ours(t, row_group_size=max(n // rgs, 1),
+                      data_page_size=2048,
+                      column_encoding={"d": Encoding.DELTA_BINARY_PACKED})
+    return t, raw
+
+
+_AGGS = [count(), count("v"), sum_("v"), min_("v"), max_("v"),
+         count_distinct("s"), min_("s"), max_("s"), sum_("f"),
+         sum_("d"), min_("d"), max_("d")]
+
+
+def _agg_both(raw, aggs, where, monkeypatch, **kw):
+    """Run the same aggregate with PARQUET_TPU_FUSED=off then =on (cold
+    caches both sides); return both result objects."""
+    out = []
+    for mode in ("off", "on"):
+        monkeypatch.setenv("PARQUET_TPU_FUSED", mode)
+        clear_caches(reset_stats=True)
+        out.append(ParquetFile(raw).aggregate(aggs, where=where, **kw))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# parity matrix: encodings × nulls × row groups × selectivities
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("nulls", [False, True])
+@pytest.mark.parametrize("rgs", [1, 4])
+@pytest.mark.parametrize("lo,hi", [(101, 140),        # sub-page sliver
+                                   (101, 800),        # partial coverage
+                                   (50, 3885)])       # nearly everything
+def test_fused_parity_matrix(monkeypatch, nulls, rgs, lo, hi):
+    _t, raw = _fixture(nulls=nulls, rgs=rgs)
+    off, on = _agg_both(raw, _AGGS, col("k").between(lo, hi), monkeypatch)
+    for a in _AGGS:
+        assert on[a.name] == off[a.name], (a.name, nulls, rgs, lo, hi)
+    # same per-tier resolution: fused changes the execution, not the plan
+    for key, val in off.counters.items():
+        assert on.counters.get(key) == val, (key, off.counters, on.counters)
+
+
+def test_fused_engages_and_meters(monkeypatch):
+    """Forced on, a contended aggregate must actually take the fused
+    path: rg folds + page folds metered, explain() labels the tier."""
+    _t, raw = _fixture()
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "on")
+    before = metrics_snapshot()
+    res = ParquetFile(raw).aggregate(
+        [count(), sum_("v"), count_distinct("s")],
+        where=col("k").between(101, 800))
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d.get("fused.rg_folds", 0) >= 1, d
+    assert d.get("fused.pages_folded", 0) >= 1, d
+    assert "(fused)" in res.explain()
+    # the fold-latency histogram observed at least one rg fold
+    h = metrics_snapshot()["histograms"].get("fused.fold_s", {})
+    assert h.get("count", 0) >= 1, h
+
+
+def test_fused_masked_emit_fires_on_contended_pages(monkeypatch):
+    """A filter boundary inside a page forces masked-emit decode of the
+    straddled page (fused.pages_masked_emit) rather than a full decode."""
+    _t, raw = _fixture()
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "on")
+    before = metrics_snapshot()
+    ParquetFile(raw).aggregate([sum_("v")], where=col("k").between(101, 903))
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d.get("fused.pages_masked_emit", 0) >= 1, d
+
+
+def test_fused_dict_partial_tier(monkeypatch):
+    """Partially-covered row groups whose uncontended remainder folds
+    straight from dictionary indices resolve at the dict_partial tier —
+    metered, shown in explain(), identical fused and unfused."""
+    n = 8000
+    t = pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array((np.arange(n) % 97).astype(np.int64)),
+        "s": pa.array([f"g{i % 31:02d}" for i in range(n)]),
+    })
+    raw = _write_ours(t, row_group_size=n // 2, data_page_size=1024)
+    aggs = [count(), sum_("v"), min_("v"), max_("v"), count_distinct("s")]
+    where = col("k").between(500, n - 501)  # partial in both rgs
+    off, on = _agg_both(raw, aggs, where, monkeypatch)
+    for a in aggs:
+        assert on[a.name] == off[a.name], a.name
+    for res in (off, on):
+        assert res.counters["rg_answered_dict_partial"] >= 1, res.counters
+        assert "dict_partial" in res.explain()
+    m = (np.arange(n) >= 500) & (np.arange(n) <= n - 501)
+    assert on["count(*)"] == int(m.sum())
+    assert on["sum(v)"] == int((np.arange(n) % 97)[m].sum())
+
+
+# ---------------------------------------------------------------------------
+# streaming scan: span-by-span filter evaluation
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("nulls", [False, True])
+def test_fused_scan_expr_parity(monkeypatch, nulls):
+    _t, raw = _fixture(n=6000, nulls=nulls)
+    where = col("k").between(333, 4777) & ~col("v").between(190, 200)
+    cols = ["k", "v", "s", "f"]
+    got = {}
+    for mode in ("off", "on"):
+        monkeypatch.setenv("PARQUET_TPU_FUSED", mode)
+        clear_caches(reset_stats=True)
+        got[mode] = scan_expr(ParquetFile(raw), where, columns=cols)
+    for c in cols:
+        a, b = got["off"][c], got["on"][c]
+        if isinstance(a, list):
+            assert a == b, c
+        elif isinstance(a, np.ma.MaskedArray):
+            assert np.array_equal(np.ma.getmaskarray(a),
+                                  np.ma.getmaskarray(b)), c
+            assert np.array_equal(a.filled(0), b.filled(0)), c
+        else:
+            assert np.array_equal(np.asarray(a), np.asarray(b)), c
+
+
+def test_fused_scan_meters_spans(monkeypatch):
+    _t, raw = _fixture(n=6000)
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "on")
+    before = metrics_snapshot()
+    scan_expr(ParquetFile(raw), col("k").between(333, 4777), columns=["v"])
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d.get("fused.scan_spans", 0) >= 1, d
+
+
+# ---------------------------------------------------------------------------
+# no whole-column materialization: the ledger is the witness
+# ---------------------------------------------------------------------------
+def test_fused_bounds_peak_ledger_to_page_scale(monkeypatch):
+    """The ISSUE 18 memory contract: on a low-selectivity filtered
+    aggregate over a plain-encoded column, fused folding's peak admitted
+    bytes must be >= 4x lower than the unfused decode — and absolutely
+    page-scale, proving no whole-column buffer ever existed."""
+    n = 400_000
+    page = 8192
+    rng = np.random.default_rng(11)
+    t = pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        # high-cardinality int64: dictionary falls back to PLAIN
+        "v": pa.array(rng.integers(0, 1 << 40, n, dtype=np.int64)),
+    })
+    raw = _write_ours(t, row_group_size=n // 2, data_page_size=page)
+    where = col("k").between(1000, n - 1001)  # ~99.5% selective
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", str(1 << 30))
+    adm = read_admission()
+
+    def run(mode):
+        monkeypatch.setenv("PARQUET_TPU_FUSED", mode)
+        clear_caches(reset_stats=True)
+        adm._reset()
+        res = ParquetFile(raw).aggregate([count(), sum_("v")], where=where)
+        return res, adm.high_water
+
+    r_off, hw_off = run("off")
+    r_on, hw_on = run("on")
+    assert r_on["count(*)"] == r_off["count(*)"] == n - 2000
+    assert r_on["sum(v)"] == r_off["sum(v)"]
+    assert hw_on > 0 and hw_off > 0
+    assert hw_off >= 4 * hw_on, (hw_off, hw_on)   # the >=4x contract
+    # absolute bound: a handful of pages, never a column chunk (~1.6 MB)
+    assert hw_on <= 8 * page, (hw_on, page)
+
+
+# ---------------------------------------------------------------------------
+# fault envelope: atomic drops with fused on; loud fallbacks
+# ---------------------------------------------------------------------------
+def test_fused_corrupt_rg_drops_atomically(monkeypatch):
+    from parquet_tpu import FaultInjectingSource
+
+    n = 24_000
+    rg_rows = n // 4
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "v": pa.array(rng.integers(0, 1 << 40, n,
+                                             dtype=np.int64))})
+    raw = _write_ours(t, row_group_size=rg_rows, data_page_size=4096)
+    meta = pq.ParquetFile(io.BytesIO(raw)).metadata
+    off = meta.row_group(1).column(1).data_page_offset  # v of rg 1
+    where = col("k").between(3000, 9000)  # rg0 + rg1 partially covered
+    aggs = [count(), sum_("v"), min_("v"), max_("v")]
+
+    def run(mode):
+        monkeypatch.setenv("PARQUET_TPU_FUSED", mode)
+        clear_caches(reset_stats=True)
+        src = FaultInjectingSource(BytesSource(raw),
+                                   flip_offsets=[off, off + 1, off + 2])
+        rep = ReadReport()
+        pf = ParquetFile(src, policy=FaultPolicy(
+            backoff_s=0.0, on_corrupt="skip_row_group"))
+        res = pf.aggregate(aggs, where=where, report=rep)
+        return res, rep
+
+    res_on, rep_on = run("on")
+    assert rep_on.row_groups_skipped == [1]
+    assert res_on.counters["rg_skipped_corrupt"] == 1
+    # rg1's contribution dropped as a unit: only rg0's covered rows count
+    v = t.column("v").to_numpy()
+    m = (np.arange(n) >= 3000) & (np.arange(n) < rg_rows)
+    assert res_on["count(*)"] == int(m.sum())
+    assert res_on["sum(v)"] == int(v[m].sum())
+    # the degraded answer is identical to the unfused degraded answer
+    res_off, rep_off = run("off")
+    assert rep_off.row_groups_skipped == [1]
+    for a in aggs:
+        assert res_on[a.name] == res_off[a.name], a.name
+
+
+def test_fused_falls_back_without_offset_index(monkeypatch):
+    """pyarrow (no page index) can't host PageCursor: forced-on fused
+    must fall back to the unfused path, meter it, and stay correct."""
+    n = 8000
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "v": pa.array((np.arange(n) % 7).astype(np.int64))})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, row_group_size=2000)
+    raw = buf.getvalue()
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "on")
+    before = metrics_snapshot()
+    res = ParquetFile(raw).aggregate([count(), sum_("v")],
+                                     where=col("k").between(100, 7000))
+    d = metrics_delta(before, metrics_snapshot())["counters"]
+    assert d.get("fused.fallbacks", 0) >= 1, d
+    m = (np.arange(n) >= 100) & (np.arange(n) <= 7000)
+    assert res["count(*)"] == int(m.sum())
+    assert res["sum(v)"] == int((np.arange(n) % 7)[m].sum())
+    # streaming scan falls back the same way, also correct
+    got = scan_expr(ParquetFile(raw), col("k").between(100, 7000),
+                    columns=["v"])
+    assert len(np.asarray(got["v"])) == int(m.sum())
+
+
+# ---------------------------------------------------------------------------
+# cost model / knob
+# ---------------------------------------------------------------------------
+def test_choose_fused_modes(monkeypatch):
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "on")
+    assert choose_fused(0) is True
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "off")
+    assert choose_fused(1 << 40) is False
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "auto")
+    assert choose_fused(FUSED_AUTO_MIN_BYTES) is True
+    assert choose_fused(FUSED_AUTO_MIN_BYTES - 1) is False
+    monkeypatch.delenv("PARQUET_TPU_FUSED")
+    assert choose_fused(FUSED_AUTO_MIN_BYTES) is True  # unset == auto
+
+
+# ---------------------------------------------------------------------------
+# concurrency: the lockcheck hammer (check.sh reruns under the sanitizer)
+# ---------------------------------------------------------------------------
+def test_fused_hammer_concurrent_scan_aggregate(monkeypatch):
+    """8 workers churn fused aggregates and fused scans over one shared
+    file: every result must match the single-threaded reference (no
+    cursor/ledger state bleeds across threads)."""
+    _t, raw = _fixture(n=6000)
+    monkeypatch.setenv("PARQUET_TPU_FUSED", "on")
+    monkeypatch.setenv("PARQUET_TPU_READ_BUDGET", str(1 << 30))
+    pf = ParquetFile(raw)
+    aggs = [count(), sum_("v"), count_distinct("s"), sum_("f")]
+    where = col("k").between(333, 4777)
+    ref_agg = pf.aggregate(aggs, where=where)
+    ref_scan = np.asarray(scan_expr(pf, where, columns=["k"])["k"])
+    errors = []
+
+    def worker(i):
+        try:
+            for r in range(4):
+                if (i + r) % 2:
+                    res = pf.aggregate(aggs, where=where)
+                    for a in aggs:
+                        assert res[a.name] == ref_agg[a.name], a.name
+                else:
+                    got = np.asarray(scan_expr(pf, where,
+                                               columns=["k"])["k"])
+                    assert np.array_equal(got, ref_scan)
+        except Exception as e:  # surfaced below; threads must not die mute
+            errors.append((i, e))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert not errors, errors[:2]
