@@ -223,6 +223,12 @@ declare("PARQUET_TPU_DELTA_RUNS", "str", "",
         "pin DELTA_BINARY_PACKED decode: host|device")
 declare("PARQUET_TPU_BSS_RUNS", "str", "",
         "pin BYTE_STREAM_SPLIT decode: host|device")
+declare("PARQUET_TPU_DBA_RUNS", "str", "",
+        "pin DELTA_BYTE_ARRAY decode: host|device")
+declare("PARQUET_TPU_DEVICE_OVERLAP", "str", "auto",
+        "mesh-read stage/decode pipelining: 0/off=stage then decode "
+        "sequentially, auto=overlap when the shard has >1 file, "
+        "force=always submit stage N+1 before decode N")
 declare("PARQUET_TPU_DEVICE_ASM", "str", "",
         "nested-column device assembly: 1 forces device, 0 forces host; "
         "unset routes per backend")

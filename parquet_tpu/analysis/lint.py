@@ -77,6 +77,7 @@ LEDGER_OWNERS = {
     "trace.buffer": "obs/trace.py",
     "remote.hedge_in_flight": "io/remote.py",
     "table.pending": "dataset_writer.py",
+    "device.staging": "parallel/mesh.py",
 }
 
 _METRIC_KINDS = ("counter", "gauge", "histogram")

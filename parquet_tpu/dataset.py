@@ -285,13 +285,24 @@ class Dataset:
     # --------------------------------------------------------------- read
     def read(self, columns: Optional[Sequence[str]] = None,
              policy: Optional[FaultPolicy] = None,
-             report: Optional[ReadReport] = None) -> Table:
+             report: Optional[ReadReport] = None,
+             device: bool = False) -> Table:
         """Read and decode every file into one :class:`Table` — per-file
         reads fan out on the shared pool, parts land in file order (byte-
         identical to a serial per-file loop), and global row ordinals follow
         :meth:`row_offsets`.  Under a degraded ``policy`` a file that cannot
         be opened/read drops as a unit (``report.files_skipped``); row-group
-        skips inside readable files keep their per-file semantics."""
+        skips inside readable files keep their per-file semantics.
+
+        ``device=True`` routes files round-robin over the local mesh
+        devices instead: each file's page payloads stage H2D (through the
+        chunk prefetcher, under the unified read budget and the
+        ``device.staging`` ledger account) while the previous file's pages
+        decode on-chip (``PARQUET_TPU_DEVICE_OVERLAP``), via
+        :func:`~parquet_tpu.parallel.mesh.read_dataset_device`.  Output is
+        byte-identical to the host path; files the device route refuses
+        fall back to a plain host read per file, and degraded-``policy``
+        semantics are unchanged."""
         if not self.paths:
             raise ValueError("read on an empty dataset shard (no schema to "
                              "type an empty table by); check num_files first")
@@ -301,7 +312,8 @@ class Dataset:
         with _oscope.maybe_op_scope("dataset.read",
                                     files=len(self.paths)):
             try:
-                return self._read_all(columns, policy, report)
+                return self._read_all(columns, policy, report,
+                                      device=device)
             finally:
                 # whole-operation latency (per-FILE latencies land in
                 # read.file_s inside ParquetFile.read): metrics_snapshot()
@@ -309,7 +321,8 @@ class Dataset:
                 # failures included — the retry storm that dies IS the tail
                 _M_READ_S.observe(time.perf_counter() - t0)
 
-    def _read_all(self, columns, policy, report) -> Table:
+    def _read_all(self, columns, policy, report,
+                  device: bool = False) -> Table:
         pol, report, skip = self._resolve(policy, report)
 
         def read_one(i):
@@ -333,7 +346,19 @@ class Dataset:
                 # iter_batches), even though its row accounting is moot
                 return None, sub, rows, e
 
-        results = map_in_order(read_one, range(len(self.paths)))
+        if device:
+            # mesh-sharded device pipeline: same (table, sub, rows, err)
+            # tuples in the same file order, so the merge below — skip
+            # accounting included — is shared verbatim with the host path.
+            # read_one doubles as the per-file fallback for files the
+            # device route refuses (policy semantics live there).
+            from .parallel.mesh import read_dataset_device
+
+            results = list(read_dataset_device(
+                self, columns=columns, with_reports=report is not None,
+                host_read=read_one))
+        else:
+            results = map_in_order(read_one, range(len(self.paths)))
         parts: Optional[Dict[str, List]] = None
         total = 0
         first_pf = None
@@ -536,7 +561,7 @@ class Dataset:
              values: Optional[Sequence] = None,
              policy: Optional[FaultPolicy] = None,
              report: Optional[ReadReport] = None,
-             where=None) -> Dict[str, object]:
+             where=None, device: bool = False) -> Dict[str, object]:
         """Predicate-pushdown scan over the whole dataset: the predicate —
         single-column ``path``/``lo``/``hi``/``values`` or a ``where=``
         tree — is prepared ONCE, files are pruned by footer statistics
@@ -545,7 +570,10 @@ class Dataset:
         merge in file order — same output forms as ``scan_filtered``, same
         deterministic order as a serial per-file loop.  Degraded
         ``policy``: unopenable files, files that fail mid-scan, and corrupt
-        row groups all drop with the loss accounted in ``report``."""
+        row groups all drop with the loss accounted in ``report``.
+        ``device=True`` round-robins the surviving files' scans over the
+        local mesh devices (each file's device-eligible decode lands on its
+        assigned chip); results are identical either way."""
         if not self.paths:
             raise ValueError("scan on an empty dataset shard (no schema to "
                              "type empty results by); check num_files first")
@@ -554,7 +582,8 @@ class Dataset:
                                     files=len(self.paths)):
             try:
                 return self._scan_all(path, lo, hi, columns, use_bloom,
-                                      values, policy, report, where)
+                                      values, policy, report, where,
+                                      device=device)
             finally:
                 # whole-operation latency (per-file in dataset.scan_file_s
                 # via scan_files): the ROADMAP lookup-meter pre-work —
@@ -562,13 +591,18 @@ class Dataset:
                 _M_SCAN_S.observe(time.perf_counter() - t0)
 
     def _scan_all(self, path, lo, hi, columns, use_bloom, values,
-                  policy, report, where) -> Dict[str, object]:
+                  policy, report, where, device=False) -> Dict[str, object]:
         from .parallel.host_scan import scan_files
 
         pol, report, skip = self._resolve(policy, report)
         expr, fcols = self._prepare_where(path, lo, hi, values, where)
         keep, skipped = self._prune_indices(expr, skip, report)
         pfs = [self.file(i) for i in keep]
+        devices = None
+        if device and pfs:
+            from .parallel.mesh import default_mesh
+
+            devices = list(default_mesh().devices.reshape(-1))
         if pfs:
             # the default output selection is pinned here (not per file):
             # a never-matching predicate folds to a constant and would
@@ -579,7 +613,8 @@ class Dataset:
                         else sorted(flat0 - set(fcols)))
             got = scan_files(pfs, where=expr, columns=eff_cols,
                              use_bloom=use_bloom, policy=pol,
-                             report=report, skip_files=skip)
+                             report=report, skip_files=skip,
+                             devices=devices)
             if got:
                 return got
         # nothing survived pruning (or every survivor was skipped): typed

@@ -44,13 +44,15 @@ from ..utils.env import env_str
 from ..utils.locks import make_lock
 from ..format.enums import Type
 from ..obs import trace as _trace
+from ..obs.export import register_debugz_provider as _register_debugz
 from ..obs.metrics import counter as _mcounter
 from ..obs.scope import account as _maccount
 from ..obs.metrics import gauge as _mgauge
 
 __all__ = ["ScanPlanner", "ScanPlan", "RowGroupDecision",
            "CostInputs", "RouteDecision", "RouteHistory", "choose_route",
-           "device_route_supported", "route_history"]
+           "device_route_supported", "route_history",
+           "count_device_refusal", "device_encoding_supported"]
 
 # plan-counter key -> registry counter name where they differ (the
 # Prometheus renderer appends _total to counters; publishing rg_total
@@ -830,8 +832,16 @@ class RouteHistory:
         self._wait_frac: Dict[str, float] = {}
         self._n: Dict[str, int] = {}
 
+    @staticmethod
+    def _key(route: str, mesh_size: int) -> str:
+        """EWMA bucket per (route, mesh size): a 1-chip observation must
+        not misprice the 8-chip path.  Mesh size 1 keeps the bare route
+        name, so histories recorded before the split read back
+        unchanged (old keys ARE mesh-size-1 keys)."""
+        return route if mesh_size <= 1 else f"{route}@{mesh_size}"
+
     def observe(self, route: str, nbytes: int, seconds: float,
-                pool_wait_s: float = 0.0) -> None:
+                pool_wait_s: float = 0.0, mesh_size: int = 1) -> None:
         # tiny scans are dominated by fixed per-call cost, not transfer/
         # decode rate: folding them in would drag the EWMA toward a
         # meaningless rate and misroute the LARGE scans the model exists
@@ -853,32 +863,46 @@ class RouteHistory:
         # and the EWMA keep a burst of cross-attributed waits from
         # pinning the route at the floor.
         wf = min(max(pool_wait_s, 0.0) / seconds, 0.95)
+        key = self._key(route, mesh_size)
         with self._lock:
-            cur = self._gbps.get(route)
-            self._gbps[route] = gbps if cur is None else \
+            cur = self._gbps.get(key)
+            self._gbps[key] = gbps if cur is None else \
                 (1 - self._alpha) * cur + self._alpha * gbps
-            curw = self._wait_frac.get(route)
-            self._wait_frac[route] = wf if curw is None else \
+            curw = self._wait_frac.get(key)
+            self._wait_frac[key] = wf if curw is None else \
                 (1 - self._alpha) * curw + self._alpha * wf
-            self._n[route] = self._n.get(route, 0) + 1
-            eff = self._gbps[route] * (1.0 - self._wait_frac[route])
-        _mgauge("route.gbps", labels={"route": route},
+            self._n[key] = self._n.get(key, 0) + 1
+            eff = self._gbps[key] * (1.0 - self._wait_frac[key])
+        # the gauge label carries the full bucket key: per-mesh-size
+        # series stay distinguishable on a scrape (PT001 holds — the
+        # family is pre-declared; label VALUES are runtime data)
+        _mgauge("route.gbps", labels={"route": key},
                 help="EWMA effective GB/s per route").set(round(eff, 4))
-        _maccount(_mcounter("route.observations", labels={"route": route}))
+        _maccount(_mcounter("route.observations", labels={"route": key}))
 
-    def gbps(self, route: str) -> Optional[float]:
+    def gbps(self, route: str, mesh_size: int = 1) -> Optional[float]:
         """Effective EWMA GB/s: the measured wall-clock rate discounted by
         the EWMA pool-wait fraction (0 when no waits were reported — the
         historical behavior, byte-for-byte)."""
+        key = self._key(route, mesh_size)
         with self._lock:
-            g = self._gbps.get(route)
+            g = self._gbps.get(key)
             if g is None:
                 return None
-            return g * (1.0 - self._wait_frac.get(route, 0.0))
+            return g * (1.0 - self._wait_frac.get(key, 0.0))
 
-    def observations(self, route: str) -> int:
+    def observations(self, route: str, mesh_size: int = 1) -> int:
         with self._lock:
-            return self._n.get(route, 0)
+            return self._n.get(self._key(route, mesh_size), 0)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-bucket effective rates and sample counts — the /debugz
+        routes section's data."""
+        with self._lock:
+            return {k: {"gbps": round(
+                self._gbps[k] * (1.0 - self._wait_frac.get(k, 0.0)), 4),
+                "observations": self._n.get(k, 0)}
+                for k in sorted(self._gbps)}
 
     def reset(self) -> None:
         with self._lock:
@@ -971,6 +995,41 @@ def device_route_supported(pf, path: str, columns: Optional[Sequence[str]],
     return True, ""
 
 
+def device_encoding_supported(pf, columns: Optional[Sequence[str]] = None
+                              ) -> Tuple[bool, str]:
+    """Static per-ENCODING mirror of ``parallel/device_reader``'s stage
+    dispatch, answered from the footer alone: True when every chunk of
+    the selected leaves carries an encoding the device decode plan can
+    place on chip (PLAIN / RLE / dictionary / DELTA_BINARY_PACKED /
+    DELTA_LENGTH_BYTE_ARRAY / DELTA_BYTE_ARRAY / BYTE_STREAM_SPLIT).
+    The dynamic ``_Unsupported`` → host fallback remains the safety net
+    for shapes only visible at page level; this mirror lets the mesh
+    router refuse a file BEFORE staging any of its bytes."""
+    from ..format.enums import Encoding
+
+    ok = {Encoding.PLAIN, Encoding.RLE, Encoding.PLAIN_DICTIONARY,
+          Encoding.RLE_DICTIONARY, Encoding.DELTA_BINARY_PACKED,
+          Encoding.DELTA_LENGTH_BYTE_ARRAY, Encoding.DELTA_BYTE_ARRAY,
+          Encoding.BYTE_STREAM_SPLIT}
+    want = set(columns) if columns is not None else None
+    for leaf in pf.schema.leaves:
+        if want is not None and leaf.dotted_path not in want:
+            continue
+        for rg in pf.metadata.row_groups or []:
+            encs = rg.columns[leaf.column_index].meta_data.encodings or []
+            for e in encs:
+                try:
+                    enc = Encoding(e)
+                except ValueError:
+                    return False, (f"column {leaf.dotted_path!r} carries "
+                                   f"unknown encoding {e}")
+                if enc not in ok:
+                    return False, (f"column {leaf.dotted_path!r} carries "
+                                   f"encoding {enc.name} with no device "
+                                   "kernel")
+    return True, ""
+
+
 def route_scan(pf, path: str, lo=None, hi=None,
                columns: Optional[Sequence[str]] = None,
                values: Optional[Sequence] = None,
@@ -1029,3 +1088,40 @@ def _route_pin() -> Optional[str]:
     if v in ("device", "tpu"):
         return "device"
     return None
+
+
+# ---------------------------------------------------------------------------
+# device-route refusal accounting + /debugz routes section
+# ---------------------------------------------------------------------------
+
+# the closed label set device.route_refusals is declared with; anything
+# else folds into "other" so a novel refusal can't mint an unscraped
+# series mid-flight
+_REFUSAL_REASONS = ("unsupported", "policy", "budget", "error", "other")
+_REFUSAL_KEEP = 16  # most-recent refusal details kept for /debugz
+_refusal_lock = make_lock("planner.refusals")
+_refusal_recent: List[Tuple[str, str]] = []
+
+
+def count_device_refusal(reason: str, detail: str = "") -> None:
+    """Meter one device-route refusal (the mesh/scan paths call this at
+    every host fallback) and remember its detail for the /debugz routes
+    section — counters say HOW OFTEN the device route is refused,
+    the detail ring says WHY, next to the throughput history that says
+    what the refusals cost."""
+    label = reason if reason in _REFUSAL_REASONS else "other"
+    _maccount(_mcounter("device.route_refusals", labels={"reason": label}))
+    with _refusal_lock:
+        _refusal_recent.append((label, detail or reason))
+        del _refusal_recent[:-_REFUSAL_KEEP]
+
+
+def _routes_debugz() -> Dict[str, object]:
+    """/debugz "routes" section: the measured per-(route, mesh-size)
+    throughput history beside the recent device-route refusals."""
+    with _refusal_lock:
+        recent = [{"reason": r, "detail": d} for r, d in _refusal_recent]
+    return {"history": _HISTORY.snapshot(), "refusals_recent": recent}
+
+
+_register_debugz("routes", _routes_debugz)

@@ -393,6 +393,23 @@ class PrefetchSource(Source):
             self._plans.append(_Plan(offset, offset + size))
         self._pump()
 
+    def plan_many(self, ranges) -> None:
+        """Declare a batch of (offset, size) ranges in one call: one ledger
+        pressure check and one pump for the whole batch.  The mesh staging
+        path plans every chunk of a file at once — per-range plan() would
+        pay a pressure check and a pump lap per chunk for ranges that were
+        all known up front."""
+        batch = [(off, size) for off, size in ranges if size > 0]
+        if not batch or self._closed:
+            return
+        from ..obs.ledger import maybe_check_pressure
+
+        maybe_check_pressure()
+        with self._lock:
+            for off, size in batch:
+                self._plans.append(_Plan(off, off + size))
+        self._pump()
+
     def unplan(self, offset: int, size: int) -> None:
         """Cancel the plan registered as (offset, size) and drop its
         windows.  The stream layer calls this for every chunk of a row

@@ -67,6 +67,8 @@ CORE_ACCOUNTS = (
     ("remote.hedge_in_flight", "bytes of in-flight hedged remote reads"),
     ("table.pending", "ingest bytes buffered in DatasetWriters awaiting "
      "a part-file flush"),
+    ("device.staging", "raw page payloads staged (or queued for staging) "
+     "H2D by mesh-sharded device reads"),
 )
 
 # soft response: each reclaimer shrinks its tier to this fraction of its

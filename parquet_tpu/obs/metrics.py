@@ -555,13 +555,19 @@ _CORE_COUNTERS = (
     ("agg.rg_answered_dict_partial", "partially-covered row groups whose "
      "covered rows answered from the dictionary while only contended "
      "pages took the exact path"),
+    # device-scale dataset reads (parallel/mesh.py read_dataset_sharded):
+    # files round-robined over the mesh with double-buffered H2D staging
+    ("device.files_sharded", "dataset files round-robined over mesh "
+     "devices by device-scale reads"),
+    ("device.stage_overlapped", "files whose H2D staging overlapped the "
+     "previous file's on-chip decode"),
 )
 
 
 def _declare_core() -> None:
     for name, hlp in _CORE_COUNTERS:
         REGISTRY.counter(name, help=hlp)
-    for route in ("host", "device"):
+    for route in ("host", "device", "device_mesh"):
         REGISTRY.counter("route.chosen", labels={"route": route},
                          help="scans routed by the cost model")
     for cls in ("retryable", "terminal", "throttled"):
@@ -590,6 +596,20 @@ def _declare_core() -> None:
                        help="whole-dataset aggregation latency")
     REGISTRY.histogram("fused.fold_s",
                        help="per-row-group fused decode+mask+fold latency")
+    # device-scale dataset reads: stage/decode split so the overlap win
+    # (h2d hidden under decode) is measurable from a scrape alone
+    REGISTRY.histogram("device.h2d_s",
+                       help="per-file H2D staging latency on the "
+                            "mesh-sharded device read path")
+    REGISTRY.histogram("device.decode_s",
+                       help="per-file on-chip decode latency on the "
+                            "mesh-sharded device read path")
+    # the reason axis is closed; runtime refusals outside it fold into
+    # "other" (device_refusal_reason) so every series exists at 0
+    for reason in ("unsupported", "policy", "budget", "error", "other"):
+        REGISTRY.counter("device.route_refusals", labels={"reason": reason},
+                         help="device-route refusals that fell back to "
+                              "the host path, by reason")
     # --- PT001 (analysis/lint.py) pass: every family any module
     # get-or-creates must already exist here, or a process that never
     # imported that module scrapes an incomplete /metrics.  The 22
@@ -623,7 +643,7 @@ def _declare_core() -> None:
     REGISTRY.gauge("pool.active", help="pool tasks currently running")
     REGISTRY.gauge("lookup.admitted_bytes",
                    help="bytes currently admitted through the read gate")
-    for route in ("host", "device"):
+    for route in ("host", "device", "device_mesh"):
         REGISTRY.gauge("route.gbps", labels={"route": route},
                        help="EWMA effective GB/s per route")
         REGISTRY.counter("route.observations", labels={"route": route},

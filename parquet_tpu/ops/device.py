@@ -324,6 +324,67 @@ def byte_stream_split(buf: jax.Array, n: int, width: int,
 
 
 # ---------------------------------------------------------------------------
+# DELTA_BYTE_ARRAY (front coding: host prefix-length prescan, suffix
+# gather + prefix resolution by pointer jumping on chip)
+# ---------------------------------------------------------------------------
+
+
+def delta_byte_array_prescan(data: np.ndarray, pos: int = 0):
+    """Host pre-scan of one DELTA_BYTE_ARRAY page → device-kernel inputs.
+
+    Returns ``(prefix_lens int64, suffix bytes, suffix_offs int32, end)``.
+    O(values) in the length METADATA only — no output byte is expanded on
+    host; the suffix stream ships to HBM raw and
+    :func:`delta_byte_array_expand` materializes the front-coded output
+    there."""
+    from . import ref
+
+    return ref.decode_delta_byte_array_parts(data, pos)
+
+
+def delta_byte_array_iters(prefix_lens: np.ndarray) -> int:
+    """Pointer-jumping rounds :func:`delta_byte_array_expand` needs: a
+    prefix byte chases parents through at most the longest consecutive
+    run of entries with a nonzero prefix (the entry before any run starts
+    from scratch, so its bytes all resolve to suffix bytes), and each
+    round squares the resolved distance."""
+    nz = np.asarray(prefix_lens) > 0
+    if not nz.size or not nz.any():
+        return 0
+    edges = np.flatnonzero(np.diff(
+        np.concatenate(([False], nz, [False])).astype(np.int8)))
+    depth = int((edges[1::2] - edges[0::2]).max())
+    return max(int(np.ceil(np.log2(depth + 1))), 1)
+
+
+@partial(jax.jit, static_argnames=("total", "iters"))
+def delta_byte_array_expand(suffix_buf: jax.Array, prefix_lens: jax.Array,
+                            suffix_offs: jax.Array, entry_offs: jax.Array,
+                            total: int, iters: int) -> jax.Array:
+    """Expand a front-coded byte-array stream on chip.
+
+    Every output byte either lives in the suffix stream (position ≥ the
+    entry's prefix length — a direct gather) or repeats the byte at the
+    same offset of the PREVIOUS entry's output.  Prefix bytes start as
+    pointers into the previous entry and resolve by pointer jumping
+    (``ptr = ptr[ptr]``, ``iters`` rounds — log of the deepest prefix
+    chain, computed exactly on host); suffix bytes are fixed points.  One
+    final gather materializes the output with no sequential dependency —
+    the host oracle's entry-by-entry loop does not vectorize."""
+    if total == 0:
+        return jnp.zeros(0, jnp.uint8)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    e = jnp.searchsorted(entry_offs, pos, side="right").astype(jnp.int32) - 1
+    j = pos - entry_offs[e]
+    in_suffix = j >= prefix_lens[e]
+    direct = suffix_offs[e] + jnp.where(in_suffix, j - prefix_lens[e], 0)
+    prev_start = entry_offs[jnp.maximum(e - 1, 0)]
+    ptr = jnp.where(in_suffix, pos, prev_start + j)
+    ptr = jax.lax.fori_loop(0, iters, lambda _, p: p[p], ptr)
+    return suffix_buf[direct[ptr]]
+
+
+# ---------------------------------------------------------------------------
 # Dictionary gather + level math (trivial but central)
 # ---------------------------------------------------------------------------
 

@@ -757,11 +757,23 @@ def encode_delta_length_byte_array(values: np.ndarray, offsets: np.ndarray) -> b
 # ---------------------------------------------------------------------------
 
 
+def decode_delta_byte_array_parts(data, pos: int = 0):
+    """Front-coding prescan: the two delta-packed streams of a
+    DELTA_BYTE_ARRAY page WITHOUT expanding any prefix — returns
+    ``(prefix_lens int64, suffix bytes, suffix offsets int32, end)``.
+    The device route (ops/device.py delta_byte_array_expand) stages the
+    raw suffix stream and resolves prefixes on chip; the host decoder
+    below expands from the same parts."""
+    prefix_lens, pos = decode_delta_binary_packed(data, pos)
+    suffixes, soffs, pos = decode_delta_length_byte_array(data, pos)
+    return prefix_lens, suffixes, soffs, pos
+
+
 def decode_delta_byte_array(data, pos: int = 0):
     from .. import native as _native
 
-    prefix_lens, pos = decode_delta_binary_packed(data, pos)
-    suffixes, soffs, pos = decode_delta_length_byte_array(data, pos)
+    prefix_lens, suffixes, soffs, pos = decode_delta_byte_array_parts(
+        data, pos)
     n = len(prefix_lens)
     suffix_lens = (soffs[1:] - soffs[:-1]).astype(np.int64)
     lens = prefix_lens + suffix_lens

@@ -28,7 +28,6 @@ paths stay on device.
 
 from __future__ import annotations
 
-import contextvars
 import struct as _struct
 from dataclasses import dataclass, field
 from functools import partial
@@ -320,7 +319,7 @@ class _Plan:
     def_runs: _RunTable = field(default_factory=_RunTable)
     rep_runs: _RunTable = field(default_factory=_RunTable)
     host_def: List[np.ndarray] = field(default_factory=list)
-    value_kind: Optional[str] = None  # 'plain_fixed'|'plain_flba'|'bool'|'dict'|'delta'|'bss'|'host_ba'
+    value_kind: Optional[str] = None  # 'plain_fixed'|'plain_flba'|'bool'|'dict'|'delta'|'bss'|'dba'|'host_ba'
     # plain
     plain_total: int = 0
     # dict / bool runs
@@ -349,6 +348,11 @@ class _Plan:
     d_vpm: int = 32
     # bss
     bss_pages: List[Tuple[int, int]] = field(default_factory=list)  # (base, n)
+    # dba (front-coded byte arrays; suffix bytes live in `values`, the
+    # per-page length tables stay host-side until stage time)
+    dba_plens: List[np.ndarray] = field(default_factory=list)
+    dba_soffs: List[np.ndarray] = field(default_factory=list)
+    dba_pages: List[Tuple[int, int]] = field(default_factory=list)  # (base, n)
     # host byte arrays
     host_parts: List = field(default_factory=list)
     total_slots: int = 0
@@ -626,6 +630,14 @@ def _bss_run_route() -> str:
     return _backend_route("PARQUET_TPU_BSS_RUNS")
 
 
+def _dba_run_route() -> str:
+    """Where DELTA_BYTE_ARRAY chunks decode: 'device' (host prefix-length
+    prescan, suffix gather + pointer-jumping prefix resolution on chip —
+    only length metadata is touched on host) or 'host' (the sequential
+    front-coding expand).  PARQUET_TPU_DBA_RUNS overrides."""
+    return _backend_route("PARQUET_TPU_DBA_RUNS")
+
+
 def _delta_run_route() -> str:
     """Where DELTA_BINARY_PACKED chunks decode: 'device' (dense unpack +
     segmented cumsum kernels) or 'host' (C++ fused unpack + prefix sum from
@@ -793,6 +805,22 @@ def _stage_values(plan: _Plan, raw: np.ndarray, pos: int, nvals: int,
         plan.host_parts.append((v, o))
         return
     if encoding == Encoding.DELTA_BYTE_ARRAY:
+        if _dba_run_route() == "device":
+            plan.set_kind("dba")
+            plens, suffixes, soffs, _ = dev.delta_byte_array_prescan(raw, pos)
+            if len(plens) and int(plens[0]) != 0:
+                # front coding is per-page (first entry stores its full
+                # value); a nonzero leading prefix would chase a parent
+                # in another page — malformed, let the host path raise
+                # its precise error
+                raise _Unsupported(
+                    "delta byte array page with nonzero leading prefix")
+            base = len(plan.values)
+            plan.values.extend(suffixes)
+            plan.dba_plens.append(plens)
+            plan.dba_soffs.append(soffs.astype(np.int64))
+            plan.dba_pages.append((base, len(plens)))
+            return
         plan.set_kind("host_ba")
         v, o, _ = ref.decode_delta_byte_array(raw, pos)
         if physical == Type.FIXED_LEN_BYTE_ARRAY:
@@ -831,7 +859,7 @@ def _delta_gather_tables(plan: _Plan) -> tuple:
     return page_ends, firsts, mb_base, mb_offs, mb_widths, mb_mins
 
 
-def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
+def _stage_delta_dense(plan: _Plan, meta: dict, put=None) -> bool:
     """Host half of the gather-free delta decode (the TPU-first path).
 
     Compacts all miniblock payloads into per-width contiguous streams with
@@ -841,6 +869,8 @@ def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
     (mixed vpm, >32-bit delta widths, >8 distinct widths) — those use the
     gather kernel.
     """
+    if put is None:
+        put = jax.device_put
     if not plan.d_counts:
         return False
     vpm = plan.d_vpm
@@ -868,7 +898,7 @@ def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
         # the writer may truncate the final miniblock's payload: clip (the
         # garbage lands in delta slots past the page's value count)
         np.minimum(idx, np.int32(len(vals_np) - 1), out=idx)
-        streams.append(jax.device_put(dev.pad_to_bucket(
+        streams.append(put(dev.pad_to_bucket(
             vals_np[idx].reshape(-1), extra=4)))
         counters.inc("bytes_h2d", idx.size)
     if len(uw) == 1:
@@ -877,9 +907,9 @@ def _stage_delta_dense(plan: _Plan, meta: dict) -> bool:
         # d2 row j holds original miniblock concat_order[j]; restore original
         # order with the inverse permutation
         concat_order = np.concatenate(groups)
-        perm = jax.device_put(np.argsort(concat_order).astype(np.int32))
-    mins = jax.device_put(np.concatenate(plan.d_mb_mins).astype(np.int64))
-    firsts = jax.device_put(np.asarray(plan.d_firsts, np.int64))
+        perm = put(np.argsort(concat_order).astype(np.int32))
+    mins = put(np.concatenate(plan.d_mb_mins).astype(np.int64))
+    firsts = put(np.asarray(plan.d_firsts, np.int64))
     meta["delta_dense"] = (tuple(streams), perm, mins, firsts)
     plan.d_dense_static = (vpm, tuple(int(w) for w in uw),
                            tuple(len(g) for g in groups),
@@ -1016,18 +1046,49 @@ def _bss_decode_multi(buf, n, pages: tuple, width: int,
         bytes_.reshape(n, 2, 4), jnp.uint32).reshape(n, 2)
 
 
+def _dba_tables(plan: _Plan):
+    """Concatenate the per-page DELTA_BYTE_ARRAY prescan tables into
+    chunk-global int32 tables for the expand kernel.  Prefix chains never
+    cross pages (enforced at plan time: every page's first entry has
+    prefix 0), so per-page entry streams concatenate freely with suffix
+    offsets rebased by each page's base in the staged suffix stream.
+    Returns ``((prefix_lens, suffix_offs, entry_offs), entry_offs_host,
+    iters)`` — the host copy of the entry offsets doubles as the output
+    Column's int32 offsets."""
+    if not plan.dba_pages:
+        empty = np.zeros(0, np.int32)
+        zero = np.zeros(1, np.int32)
+        return (empty, empty, zero), zero, 0
+    plens = np.concatenate(plan.dba_plens)
+    soffs = np.concatenate([so[:-1] + base
+                            for (base, _), so in zip(plan.dba_pages,
+                                                     plan.dba_soffs)])
+    slens = np.concatenate([so[1:] - so[:-1] for so in plan.dba_soffs])
+    entry_offs = np.zeros(len(plens) + 1, np.int64)
+    np.cumsum(plens + slens, out=entry_offs[1:])
+    if int(entry_offs[-1]) > np.iinfo(np.int32).max:
+        # the pointer-jumping kernel indexes output positions in 32-bit
+        # lanes; a >2 GiB expansion decodes on host
+        raise _Unsupported("front-coded output exceeds 32-bit addressing")
+    eoffs32 = entry_offs.astype(np.int32)
+    return (plens.astype(np.int32), soffs.astype(np.int32), eoffs32), \
+        eoffs32, dev.delta_byte_array_iters(plens)
+
+
 # ---------------------------------------------------------------------------
 # Chunk decode driver
 # ---------------------------------------------------------------------------
 
 
-def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
+def stage_plan(plan: _Plan, stage_levels: bool = True, put=None) -> tuple:
     """H2D: put the plan's concatenated level/value byte streams into HBM.
 
     Split out of :func:`decode_chunk_device` so callers (and the benchmark)
     can overlap staging with decode, or re-run the decode phase on buffers
     already resident in HBM.  ``stage_levels=False`` skips the level stream
-    (nested columns assemble levels on host).
+    (nested columns assemble levels on host).  ``put`` substitutes for
+    ``jax.device_put`` — :func:`prepare_chunks_batched` passes a recorder so
+    many chunks' streams ride one batched transfer.
     """
     from ..obs import trace as _otrace
 
@@ -1038,11 +1099,14 @@ def stage_plan(plan: _Plan, stage_levels: bool = True) -> tuple:
         with _otrace.span("device.h2d", col=plan.leaf.dotted_path
                           if plan.leaf is not None else None,
                           bytes=len(plan.values) + len(plan.levels)):
-            return _stage_plan_impl(plan, stage_levels)
-    return _stage_plan_impl(plan, stage_levels)
+            return _stage_plan_impl(plan, stage_levels, put=put)
+    return _stage_plan_impl(plan, stage_levels, put=put)
 
 
-def _stage_plan_impl(plan: _Plan, stage_levels: bool = True) -> tuple:
+def _stage_plan_impl(plan: _Plan, stage_levels: bool = True,
+                     put=None) -> tuple:
+    if put is None:
+        put = jax.device_put
     # host value routes, decided BEFORE the device size guard (they read
     # the host accumulation directly — no 32-bit-lane constraint) and
     # recorded in the staged meta: decode must not re-derive routing from
@@ -1069,7 +1133,7 @@ def _stage_plan_impl(plan: _Plan, stage_levels: bool = True) -> tuple:
         raise _Unsupported("chunk stream exceeds 32-bit-lane bit addressing")
     lev_dbuf = None
     if stage_levels and len(plan.levels):
-        lev_dbuf = jax.device_put(plan.levels.padded_array())
+        lev_dbuf = put(plan.levels.padded_array())
         counters.inc("bytes_h2d", len(plan.levels))
     meta = {}
     if dict_host:
@@ -1081,18 +1145,18 @@ def _stage_plan_impl(plan: _Plan, stage_levels: bool = True) -> tuple:
     if bss_host:
         meta["bss_host"] = True
     delta_dense = (plan.value_kind == "delta" and not delta_host
-                   and _stage_delta_dense(plan, meta))
+                   and _stage_delta_dense(plan, meta, put=put))
     val_dbuf = None
     if not dense_route and not delta_dense and not dict_host and \
             not plain_host and not delta_host and not bss_host and \
             plan.value_kind not in (None, "host_ba"):
         # staged even when empty (all-null chunks have no value bytes): the
         # kernels need a real buffer operand to slice [:0] from
-        val_dbuf = jax.device_put(plan.values.padded_array())
+        val_dbuf = put(plan.values.padded_array())
         counters.inc("bytes_h2d", len(plan.values))
     if dense_route:
         # compacted single-width index stream replaces the raw bodies
-        meta["dense"] = jax.device_put(plan.dense.padded_array(extra=4))
+        meta["dense"] = put(plan.dense.padded_array(extra=4))
         counters.inc("bytes_h2d", len(plan.dense))
     if plan.value_kind == "delta" and not delta_host:
         if not delta_dense:
@@ -1100,17 +1164,24 @@ def _stage_plan_impl(plan: _Plan, stage_levels: bool = True) -> tuple:
                 # the gather kernel assumes one values-per-miniblock across
                 # all pages; reject before paying any H2D
                 raise _Unsupported("mixed delta miniblock sizes across pages")
-            meta["delta"] = jax.device_put(_delta_gather_tables(plan))
+            meta["delta"] = put(_delta_gather_tables(plan))
+    if plan.value_kind == "dba":
+        # per-entry length tables ride to HBM with the suffix stream so
+        # the decode phase is pure on-chip work
+        tabs, eoffs_host, iters = _dba_tables(plan)
+        meta["dba"] = (put(tabs), eoffs_host, iters)
+        counters.inc("bytes_h2d", sum(int(a.nbytes) for a in tabs))
     if plan.value_kind == "dict" and plan.dictionary_host is not None:
         # dictionary pages stage with the chunk, not inside the decode phase
         meta["dictionary"] = _stage_dictionary(plan.dictionary_host,
-                                               plan.physical, plan.leaf)
+                                               plan.physical, plan.leaf,
+                                               put=put)
     if plan.vruns.total and not dict_host:
-        meta["vruns"] = jax.device_put(plan.vruns.run_arrays())
+        meta["vruns"] = put(plan.vruns.run_arrays())
     if stage_levels and plan.def_runs.total:
-        meta["def_runs"] = jax.device_put(plan.def_runs.run_arrays())
+        meta["def_runs"] = put(plan.def_runs.run_arrays())
     if stage_levels and plan.rep_runs.total:
-        meta["rep_runs"] = jax.device_put(plan.rep_runs.run_arrays())
+        meta["rep_runs"] = put(plan.rep_runs.run_arrays())
     return lev_dbuf, val_dbuf, meta
 
 
@@ -1171,6 +1242,75 @@ def prepare_chunk(reader: ColumnChunkReader, device=None):
             staged = stage_plan(
                 plan, stage_levels=stage_levels_on_device(reader.leaf, plan))
     return plan, staged
+
+
+class _DeferredPut:
+    """Placeholder a recording ``put`` returns during batched staging: an
+    index into the flat list of host pytrees awaiting the one real
+    transfer."""
+
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+
+def _subst_deferred(obj, outs):
+    """Rebuild a staged structure with every :class:`_DeferredPut` replaced
+    by its transferred device pytree (containers rebuilt, leaves shared)."""
+    if isinstance(obj, _DeferredPut):
+        return outs[obj.idx]
+    if isinstance(obj, tuple):
+        return tuple(_subst_deferred(v, outs) for v in obj)
+    if isinstance(obj, list):
+        return [_subst_deferred(v, outs) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _subst_deferred(v, outs) for k, v in obj.items()}
+    return obj
+
+
+def prepare_chunks_batched(readers, device=None):
+    """Host phase of MANY chunks' device decode with ONE H2D dispatch.
+
+    Each chunk prescans and routes exactly as :func:`prepare_chunk` (the
+    staged structures are interchangeable), but every ``device_put`` a
+    chunk's stage would issue is recorded against host arrays instead, and
+    the whole collection rides a single batched ``jax.device_put`` at the
+    end — a few hundred per-stream dispatches collapse into one.  That is
+    the dataset mesh route's per-file staging call: per-chunk dispatch
+    overhead is what's left once prescan work is pipelined, and it scales
+    with row-group count, not bytes.
+
+    Returns ``[(reader, (plan, staged) | None, error)]`` in input order —
+    the per-chunk triple ``decode``-side consumers already handle, with
+    ``_Unsupported`` chunks carried as errors rather than raised."""
+    from ..utils.debug import annotate
+
+    calls: list = []
+
+    def put(x):
+        calls.append(x)
+        return _DeferredPut(len(calls) - 1)
+
+    entries = []
+    with annotate("pq.prepare_chunks_batched"):
+        for reader in readers:
+            try:
+                plan = build_plan(reader)
+                staged = stage_plan(
+                    plan, stage_levels=stage_levels_on_device(reader.leaf,
+                                                              plan),
+                    put=put)
+                entries.append((reader, plan, staged, None))
+            except _Unsupported as e:
+                entries.append((reader, None, None, e))
+        outs = jax.device_put(calls, device) if device is not None \
+            else jax.device_put(calls)
+    return [(reader,
+             None if err is not None else (plan, _subst_deferred(staged,
+                                                                 outs)),
+             err)
+            for reader, plan, staged, err in entries]
 
 
 def _concat_batch_columns(leaf, cols: List[Column]) -> Column:
@@ -1258,17 +1398,20 @@ def decode_chunk_batched(reader: ColumnChunkReader,
         return build_plan(reader,
                           pages=iter(dict_pages + subset if i == 0 else subset))
 
+    from ..utils.pool import instrument_task, mark_pooled
+
     cols: List[Column] = []
     shared_dict_host = None
     shared_dict_staged = None
     kind0 = None
-    # the staging workers must run under the caller's op scope
-    # (obs/scope.py): their preads account to the operation, same as
-    # shared-pool tasks (one ctx copy per task — Contexts refuse
-    # concurrent re-entry)
-    ctx = contextvars.copy_context()
+    # shared-pool idioms on a caller-bounded executor: instrument_task
+    # propagates the caller's op scope onto the workers (fresh ctx copy per
+    # run — Contexts refuse concurrent re-entry) and lands each batch's
+    # queue→run wait in pool.queue_wait_s / pool.tasks; mark_pooled keeps
+    # the workers' native thread splits at 1 (utils/pool contract)
     with ThreadPoolExecutor(max_workers=max(workers, 1)) as pool:
-        futs = [pool.submit(ctx.copy().run, plan_batch, i, b)
+        futs = [pool.submit(instrument_task(mark_pooled(plan_batch),
+                                            "device.plan_batch"), i, b)
                 for i, b in enumerate(batches)]
         for i, fut in enumerate(futs):
             plan = fut.result()
@@ -1373,14 +1516,20 @@ def _decode_chunks_pipelined_impl(chunks, keep_dictionary: bool,
         finally:
             with lock:
                 active["n"] -= 1
-    # staging preads attribute to the caller's op scope (see
-    # decode_chunk_batched): fresh ctx copy per submitted task
-    ctx = contextvars.copy_context()
+    from ..utils.pool import instrument_task, mark_pooled
+
+    # shared-pool idioms on the bounded stage executor (see
+    # decode_chunk_batched): op-scope propagation, queue-wait accounting,
+    # and in_shared_pool() marking for every staging task
+    def _submit(pool, reader):
+        return pool.submit(instrument_task(mark_pooled(prep),
+                                           "device.stage"), reader)
+
     with ThreadPoolExecutor(max_workers=max(workers, 1)) as pool:
         pending = []
         it = iter(chunks)
         for reader in it:
-            pending.append(pool.submit(ctx.copy().run, prep, reader))
+            pending.append(_submit(pool, reader))
             if len(pending) > workers:
                 break
         i = 0
@@ -1390,7 +1539,7 @@ def _decode_chunks_pipelined_impl(chunks, keep_dictionary: bool,
             i += 1             # bounded to the in-flight window
             nxt = next(it, None)
             if nxt is not None:
-                pending.append(pool.submit(ctx.copy().run, prep, nxt))
+                pending.append(_submit(pool, nxt))
             if err is not None:
                 counters.inc("chunks_host_fallback")
                 yield decode_chunk_host(reader)
@@ -1676,14 +1825,43 @@ def _decode_staged(leaf, physical: Type, plan: _Plan, staged: tuple,
                 # static per-page slicing unrolls O(pages) into the graph
                 raise _Unsupported(
                     "byte-stream-split chunk with huge page count")
-            values = _bss_decode_multi(
-                val_dbuf, nvals,
-                tuple((int(b), int(n)) for b, n in plan.bss_pages),
-                w, flba,
-                # 4-byte output dtype follows the PHYSICAL type (an INT32
-                # BSS column is not a float32 — bug caught by the
-                # route-equality test)
-                dtype4="int32" if physical == Type.INT32 else "float32")
+            if len(plan.bss_pages) == 1 and int(plan.bss_pages[0][0]) == 0:
+                # single-page chunk (the common writer layout): the
+                # canonical ops/device.py plane-transpose kernel — same
+                # math as the multi-page twin without its per-page
+                # static-slice unrolling
+                values = dev.byte_stream_split(
+                    val_dbuf, nvals, w,
+                    out_dtype=None if flba else
+                    ("int32" if physical == Type.INT32 else "float32")
+                    if w == 4 else "uint32")
+            else:
+                values = _bss_decode_multi(
+                    val_dbuf, nvals,
+                    tuple((int(b), int(n)) for b, n in plan.bss_pages),
+                    w, flba,
+                    # 4-byte output dtype follows the PHYSICAL type (an
+                    # INT32 BSS column is not a float32 — bug caught by
+                    # the route-equality test)
+                    dtype4="int32" if physical == Type.INT32 else "float32")
+    elif kind == "dba":
+        staged_dba = staged_meta.get("dba")
+        if staged_dba is None:
+            tabs_host, eoffs_host, iters = _dba_tables(plan)
+            tabs = jax.device_put(tabs_host)
+        else:
+            tabs, eoffs_host, iters = staged_dba
+        plens_d, soffs_d, eoffs_d = tabs
+        out = dev.delta_byte_array_expand(val_dbuf, plens_d, soffs_d,
+                                          eoffs_d, int(eoffs_host[-1]),
+                                          iters)
+        if physical == Type.FIXED_LEN_BYTE_ARRAY:
+            values = out.reshape(-1, leaf.type_length)
+        else:
+            # same Column form as host_ba: device value bytes, host int32
+            # offsets — every byte-array consumer already speaks it
+            values = out
+            offsets = eoffs_host
     elif kind == "host_ba":
         if plan.host_parts and isinstance(plan.host_parts[0], tuple):
             vals = np.concatenate([p[0] for p in plan.host_parts])
@@ -1795,13 +1973,15 @@ def _dense_unpack_pages(dense_buf, nbytes: int, total: int, w: int,
             else jnp.concatenate(parts)).astype(jnp.int32)
 
 
-def _stage_dictionary(dict_host, physical, leaf):
+def _stage_dictionary(dict_host, physical, leaf, put=None):
+    if put is None:
+        put = jax.device_put
     if dict_host is None:
         raise _Unsupported("dictionary-encoded page without dictionary page")
     if physical == Type.BYTE_ARRAY:
         vals, offs = dict_host
-        return (jax.device_put(vals), jax.device_put(offs.astype(np.int32)))
+        return (put(vals), put(offs.astype(np.int32)))
     if physical in _IS_PAIR:
         arr = np.ascontiguousarray(dict_host)
-        return jax.device_put(arr.view(np.uint32).reshape(-1, 2))
-    return jax.device_put(np.asarray(dict_host))
+        return put(arr.view(np.uint32).reshape(-1, 2))
+    return put(np.asarray(dict_host))

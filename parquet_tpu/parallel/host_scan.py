@@ -635,7 +635,8 @@ def scan_files(pfs: Sequence[ParquetFile], path: Optional[str] = None,
                values: Optional[Sequence] = None,
                policy: Optional[FaultPolicy] = None,
                report: Optional[ReadReport] = None,
-               skip_files: bool = False, where=None) -> Dict[str, object]:
+               skip_files: bool = False, where=None,
+               devices: Optional[Sequence] = None) -> Dict[str, object]:
     """:func:`scan_filtered` across many already-opened files, fanned out on
     the shared pool (each file's scan runs serial inside its worker — the
     pool parallelism moves up a level) with results merged in file order.
@@ -648,7 +649,10 @@ def scan_files(pfs: Sequence[ParquetFile], path: Optional[str] = None,
     recorded with its full row count as candidate rows — its partial
     row-group accounting is discarded so the loss is not double-counted.
     Returns ``{}`` when nothing (or no file) survived.  Deadline overruns
-    and environment errors always propagate."""
+    and environment errors always propagate.  ``devices`` (a sequence of
+    jax devices) round-robins each file's scan under
+    ``jax.default_device(devices[i % n])`` — the Dataset device-scan
+    route's per-chip assignment; results are unchanged."""
     from ..io.faults import NON_DATA_ERRORS
     from ..utils.pool import map_in_order
 
@@ -663,18 +667,29 @@ def scan_files(pfs: Sequence[ParquetFile], path: Optional[str] = None,
     if not pfs:
         return {}
 
-    def one(pf):
+    def one(item):
+        import contextlib
+
+        idx, pf = item
         sub = ReadReport() if report is not None else None
+        if devices:
+            import jax
+
+            dev_ctx = jax.default_device(devices[idx % len(devices)])
+        else:
+            dev_ctx = contextlib.nullcontext()
         t0 = _time.perf_counter()
         try:
-            if where is not None:
-                got = scan_expr(pf, where, columns=columns,
-                                use_bloom=use_bloom, policy=policy,
-                                report=sub)
-            else:
-                got = scan_filtered(pf, path, lo=lo, hi=hi, columns=columns,
-                                    use_bloom=use_bloom, values=values,
-                                    policy=policy, report=sub)
+            with dev_ctx:
+                if where is not None:
+                    got = scan_expr(pf, where, columns=columns,
+                                    use_bloom=use_bloom, policy=policy,
+                                    report=sub)
+                else:
+                    got = scan_filtered(pf, path, lo=lo, hi=hi,
+                                        columns=columns,
+                                        use_bloom=use_bloom, values=values,
+                                        policy=policy, report=sub)
         except DeadlineError:
             raise
         except NON_DATA_ERRORS:
@@ -689,7 +704,7 @@ def scan_files(pfs: Sequence[ParquetFile], path: Optional[str] = None,
             _M_SCAN_FILE_S.observe(_time.perf_counter() - t0)
         return got, sub, None
 
-    results = map_in_order(one, pfs)
+    results = map_in_order(one, list(enumerate(pfs)))
     oks = []
     for pf, (got, sub, err) in zip(pfs, results):
         if got is None:

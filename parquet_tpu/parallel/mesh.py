@@ -16,6 +16,7 @@ step over a mesh (the "training step" analog, exercised by the driver's
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence
@@ -29,8 +30,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..format.enums import Encoding
 from ..io.column import Column
 from ..io.reader import ParquetFile
+from ..obs.ledger import ledger_account
+from ..obs.metrics import counter as _ocounter, histogram as _ohistogram
+from ..obs.scope import account as _oaccount
 from ..ops import device as dev
+from ..utils import pool as _pool
 from ..utils.debug import counters
+from ..utils.env import env_str
+
+# resolved once at import (hot-path rule: no registry get-or-create per
+# file); the ledger account is owned HERE (analysis/lint.py PT003)
+_M_H2D_S = _ohistogram("device.h2d_s")
+_M_DECODE_S = _ohistogram("device.decode_s")
+_M_FILES_SHARDED = _ocounter("device.files_sharded")
+_M_STAGE_OVERLAPPED = _ocounter("device.stage_overlapped")
+_ACC_STAGING = ledger_account("device.staging")
 
 
 def default_mesh(n: Optional[int] = None, axis: str = "data") -> Mesh:
@@ -581,3 +595,272 @@ def decode_step_sharded(mesh: Mesh, n_per_shard: int, axis: str = "data"):
         out_specs=(spec, spec, spec, rep),
         check_rep=False)
     return jax.jit(sharded)
+
+
+# ---------------------------------------------------------------------------
+# Device-scale dataset reads — files round-robined over the mesh
+# ---------------------------------------------------------------------------
+
+
+def _overlap_enabled(n_files: int) -> bool:
+    """PARQUET_TPU_DEVICE_OVERLAP: 0/off = stage then decode sequentially,
+    auto = overlap when the shard has more than one file (a single file has
+    no next stage to hide), force = always submit stage N+1 before decode
+    N (chaos/identity tests pin both paths)."""
+    mode = (env_str("PARQUET_TPU_DEVICE_OVERLAP") or "auto").strip().lower()
+    if mode in ("0", "off", "false", "no"):
+        return False
+    if mode == "force":
+        return True
+    return n_files > 1
+
+
+class _HostRoute(Exception):
+    """Stage-phase verdict: this file must take the host path.  Carries the
+    refusal reason/detail for ``device.route_refusals`` accounting."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+        self.detail = detail
+
+
+@dataclass
+class _FileStage:
+    """One file's staged device state: every (leaf, row-group) chunk
+    prepared (prescan + H2D put targeted at ``device``), admission grant
+    and ``device.staging`` ledger residency held until :meth:`release`."""
+
+    index: int
+    pf: ParquetFile
+    leaves: list
+    rg_sel: list
+    device: object
+    est_bytes: int
+    grant: int
+    preps: list = field(default_factory=list)
+    _released: bool = False
+
+    def release(self) -> None:
+        from ..utils.pool import read_admission
+
+        if self._released:
+            return
+        self._released = True
+        _ACC_STAGING.sub(self.est_bytes)
+        read_admission().release(self.grant, tier="scan")
+
+
+def _stage_dataset_file(dataset, i: int, columns, device) -> _FileStage:
+    """Host phase of one dataset file's device read, run on a shared-pool
+    worker: admission under the unified read budget, chunk-range prefetch
+    (advise-backed readahead under the prescan + H2D), and a batched
+    ``prepare_chunks_batched`` over every (leaf, row-group) targeted at
+    ``device`` — one H2D dispatch per file.  Raises
+    ``_HostRoute`` when the static encoding scan refuses the file; a chunk
+    the stage plan refuses individually records its error and decodes on
+    host at decode time (parity with ``decode_chunks_pipelined``)."""
+    import contextlib
+
+    from ..io.planner import device_encoding_supported
+    from ..io.prefetch import make_chunk_prefetcher
+    from ..io.reader import _select_leaves
+    from ..utils.pool import read_admission
+    from .device_reader import prepare_chunks_batched
+
+    pf = dataset.file(i)
+    dataset._check_schema(pf, dataset.paths[i])
+    ok, why = device_encoding_supported(pf, columns)
+    if not ok:
+        raise _HostRoute("unsupported", why)
+    leaves = _select_leaves(pf.schema, columns)
+    rg_sel = list(range(len(pf.metadata.row_groups or [])))
+    chunks = [pf.row_group(g).column(leaf.column_index)
+              for leaf in leaves for g in rg_sel]
+    est = sum(int(r.byte_range[1]) for r in chunks)
+    # raw page payloads queue under the unified read budget and sit in the
+    # device.staging account until the decode phase consumed them
+    grant = read_admission().acquire(est, tier="scan")
+    _ACC_STAGING.add(est)
+    st = _FileStage(index=i, pf=pf, leaves=leaves, rg_sel=rg_sel,
+                    device=device, est_bytes=est, grant=grant)
+    try:
+        t0 = time.perf_counter()
+        with contextlib.ExitStack() as stack:
+            pre = make_chunk_prefetcher(pf.source,
+                                        n_streams=min(len(chunks), 4) or 1)
+            if pre is not None:
+                stack.enter_context(pf._source_override(pre))
+                stack.callback(pre.close)
+                pre.plan_many(r.byte_range for r in chunks)
+            # every chunk's streams ride ONE batched device_put at the
+            # file's chip — per-chunk H2D dispatch overhead scales with
+            # row-group count, and the mesh route amortizes it per file
+            st.preps.extend(prepare_chunks_batched(chunks, device=device))
+        _M_H2D_S.observe(time.perf_counter() - t0)
+    except BaseException:
+        st.release()
+        raise
+    return st
+
+
+def _decode_dataset_file(st: _FileStage):
+    """Device phase: decode every staged chunk of one file (host fallback
+    per refused chunk) and assemble the same per-file Table
+    ``ParquetFile.read(device=True)`` returns."""
+    from ..io.column import empty_column
+    from ..io.faults import read_context
+    from ..io.planner import count_device_refusal
+    from ..io.reader import Table, decode_chunk_host
+
+    pf = st.pf
+    if not st.rg_sel:
+        return Table(pf.schema, {leaf.dotted_path: empty_column(leaf)
+                                 for leaf in st.leaves}, 0)
+    t0 = time.perf_counter()
+    n_rg = len(st.rg_sel)
+    it = iter(st.preps)
+    parts: Dict[str, list] = {}
+    with jax.default_device(st.device):
+        for leaf in st.leaves:
+            cols = []
+            for _ in range(n_rg):
+                reader, prep, err = next(it)
+                with read_context(path=pf._path, row_group=reader.rg_index,
+                                  column=reader.leaf.dotted_path):
+                    if err is not None:
+                        count_device_refusal("unsupported", str(err))
+                        counters.inc("chunks_host_fallback")
+                        col = decode_chunk_host(reader)
+                    else:
+                        col, _nn = _decode_prepped(reader, prep)
+                cols.append(col)
+            parts[leaf.dotted_path] = cols
+    tbl = Table(pf.schema, None, pf.num_rows, parts=parts)
+    _M_DECODE_S.observe(time.perf_counter() - t0)
+    return tbl
+
+
+def read_dataset_device(dataset, columns=None, with_reports: bool = False,
+                        host_read=None, mesh: Optional[Mesh] = None,
+                        axis: str = "data"):
+    """Per-file results for ``Dataset.read(device=True)``, yielded in file
+    order as the same ``(table, sub_report, rows, error)`` tuples the host
+    fan-out produces — ``Dataset._read_all`` merges both identically, so
+    byte identity with the host path is structural, per-file host fallback
+    included.
+
+    Files round-robin over the mesh devices: file i's chunks stage H2D at
+    ``devices[i % n]`` — the ``Dataset.shard(i, n)`` split a multi-host
+    fleet applies per process (:func:`dataset_process_shard`) applied once
+    more, per chip, inside the process.  Each file's stage→decode chain
+    runs as one shared-pool task pinned to its chip and, when
+    :func:`_overlap_enabled` allows, up to a window of later files run
+    ahead of the consume frontier — file i+1 stages (and its chip decodes)
+    while file i's decode completes, the write path's encode/emit
+    double-buffering applied at the device boundary.  A file the static
+    encoding scan refuses, or whose
+    stage/decode dies on corrupt data, reroutes to ``host_read`` (the
+    caller's plain per-file host read — fault policy, retries, and
+    row-group skip semantics all apply there), with the refusal counted in
+    ``device.route_refusals``.  Measured mesh throughput feeds
+    ``RouteHistory`` under the ``"device_mesh"`` route, bucketed by mesh
+    size."""
+    from ..errors import CorruptedError, DeadlineError
+    from ..io.faults import NON_DATA_ERRORS, ReadReport
+    from ..io.planner import count_device_refusal, route_history
+    from ..obs.metrics import pool_wait_seconds
+
+    from concurrent.futures import Future
+
+    mesh = mesh or default_mesh(axis=axis)
+    devs = list(mesh.devices.reshape(-1))
+    n = len(dataset.paths)
+    overlap = _overlap_enabled(n)
+    # nested inside a shared-pool worker: stage inline — blocking on
+    # fut.result() from one of the pool's own workers while the pool is
+    # saturated is the deadlock map_in_order's nested-submit guard exists
+    # for (overlap degrades to sequential; correctness is unchanged)
+    inline = _pool.in_shared_pool()
+
+    def _stage_decode(i, device):
+        # one file's full device chain on a pool worker: stage (prefetch +
+        # prescan + H2D put) then decode on the file's chip.  Running the
+        # decode here too is what lets files on DIFFERENT chips decode
+        # concurrently instead of serializing on the consumer thread.
+        st = _stage_dataset_file(dataset, i, columns, device)
+        try:
+            return st, _decode_dataset_file(st)
+        except BaseException:
+            st.release()
+            raise
+
+    def _submit(i):
+        if inline:
+            f = Future()
+            try:
+                f.set_result(_stage_decode(i, devs[i % len(devs)]))
+            # ptlint: disable=PT005 -- capture-and-forward: the error
+            # resurfaces at the driver's futs.pop(i).result() call below
+            except BaseException as e:
+                f.set_exception(e)
+            return f
+        return _pool.submit(_stage_decode, i, devs[i % len(devs)])
+
+    def _host_one(i, reason, detail):
+        count_device_refusal(reason, detail)
+        return host_read(i)
+
+    device_bytes = 0
+    t_start = time.perf_counter()
+    w0 = pool_wait_seconds()
+    # overlap keeps up to min(mesh, 4) files in flight ahead of the
+    # consume frontier — one per chip up to a memory-bounding cap; results
+    # are still consumed strictly in file order, and the admission gate
+    # (not the window) is what bounds resident staged bytes under a budget
+    window = min(len(devs), 4) if overlap else 1
+    futs: Dict[int, object] = {}
+    try:
+        for i in range(n):
+            for j in range(i, min(i + window, n)):
+                if j not in futs:
+                    futs[j] = _submit(j)
+                    if j > i:
+                        # file j runs ahead while file i is still in
+                        # flight / being consumed: the overlap the knob
+                        # turns off
+                        _oaccount(_M_STAGE_OVERLAPPED)
+            res = None
+            refusal = None
+            try:
+                res = futs.pop(i).result()
+            except _HostRoute as e:
+                refusal = (e.reason, e.detail)
+            except DeadlineError:
+                raise
+            except NON_DATA_ERRORS:
+                raise
+            except (CorruptedError, OSError) as e:
+                refusal = ("error", str(e))
+            if res is None:
+                yield _host_one(i, *refusal)
+            else:
+                st, tbl = res
+                st.release()
+                _oaccount(_M_FILES_SHARDED)
+                device_bytes += st.est_bytes
+                sub = ReadReport() if with_reports else None
+                yield tbl, sub, st.pf.num_rows, None
+    finally:
+        for f in futs.values():
+            # abandoned in-flight files (consumer stopped early, or an
+            # exception above): wait them out and hand back their grants
+            try:
+                f.result()[0].release()
+            except Exception:
+                pass
+        elapsed = time.perf_counter() - t_start
+        if device_bytes:
+            route_history().observe("device_mesh", device_bytes, elapsed,
+                                    pool_wait_s=pool_wait_seconds() - w0,
+                                    mesh_size=len(devs))

@@ -1487,6 +1487,80 @@ print(f"fused smoke ok: parity held, ledger {hw_off}/{hw_on} "
       f"(>=4x), s3 listing paginated over {len(listings)} pages")
 FUSEDEOF
 
+echo "=== device smoke (mesh-sharded dataset read on an emulated 4-chip mesh) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+python - <<'DEVEOF'
+# ISSUE 19: the device-mesh dataset route.  On an emulated 4-chip CPU
+# mesh, Dataset.read(device=True) must round-robin files over the mesh
+# byte-identically to the host route, the overlap knob must hold
+# identity both off and forced (with exact stage_overlapped counts),
+# the device.staging ledger must pass the admission gate and drain to
+# zero, and the mesh throughput must land in the route history under
+# the device_mesh@4 bucket.  Bounded to a few seconds.
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import jax
+
+from parquet_tpu import Dataset, clear_caches
+from parquet_tpu.io.planner import route_history
+from parquet_tpu.obs.ledger import ledger_snapshot
+from parquet_tpu.obs.metrics import metrics_delta, metrics_snapshot
+from parquet_tpu.utils.pool import read_admission
+
+assert len(jax.devices()) == 4, jax.devices()
+
+# uncompressed so the staged (compressed) byte estimate ~= raw: ~7MB
+# total, clearing the route-history small-scan floor (4MiB)
+n_files, rows = 5, 60_000
+d = tempfile.mkdtemp(prefix="pq_device_smoke_")
+for i in range(n_files):
+    base = i * rows
+    t = pa.table({
+        "k": pa.array(np.arange(base, base + rows, dtype=np.int64)),
+        "s": pa.array([f"f{i}_tag{j % 41}" for j in range(rows)]),
+        "v": pa.array(np.random.default_rng(i).random(rows)),
+        "nul": pa.array([None if j % 7 == 0 else float(base + j)
+                         for j in range(rows)]),
+    })
+    pq.write_table(t, os.path.join(d, f"part-{i}.parquet"),
+                   row_group_size=rows // 4, use_dictionary=["s"],
+                   compression="none",
+                   column_encoding={"v": "BYTE_STREAM_SPLIT",
+                                    "k": "PLAIN", "nul": "PLAIN"})
+clear_caches(reset_stats=True)
+os.environ["PARQUET_TPU_READ_BUDGET"] = str(64 << 20)
+adm = read_admission()
+adm._reset()
+ds = Dataset(os.path.join(d, "part-*.parquet"))
+want = ds.read().to_arrow()
+before = metrics_snapshot()
+got = ds.read(device=True).to_arrow()
+delta = metrics_delta(before, metrics_snapshot())
+assert got.equals(want), "device route changed the bytes"
+assert delta["counters"].get("device.files_sharded", 0) == n_files
+assert delta["counters"].get("device.stage_overlapped", 0) == n_files - 1
+for mode, expect in (("0", 0), ("force", n_files - 1)):
+    os.environ["PARQUET_TPU_DEVICE_OVERLAP"] = mode
+    before = metrics_snapshot()
+    assert ds.read(device=True).to_arrow().equals(want), mode
+    delta = metrics_delta(before, metrics_snapshot())
+    assert delta["counters"].get("device.stage_overlapped", 0) == expect, mode
+del os.environ["PARQUET_TPU_DEVICE_OVERLAP"]
+acct = ledger_snapshot()["accounts"].get("device.staging", {})
+assert int(acct.get("resident_bytes", 0)) == 0, acct
+assert adm.high_water > 0  # staging really passed the admission gate
+del os.environ["PARQUET_TPU_READ_BUDGET"]
+assert route_history().gbps("device_mesh", mesh_size=4) is not None
+ds.close()
+print(f"device smoke ok: {n_files} files sharded over 4 chips, overlap "
+      f"on/off byte-identical, staging drained, device_mesh@4 observed")
+DEVEOF
+
 echo "=== analysis smoke (invariant lint + lockcheck gate) ==="
 # the standing pre-merge correctness gate: AST lint over the package
 # (PT001-PT006), README knob table generated-vs-committed, and a
@@ -1612,6 +1686,12 @@ for name, cfg in detail.get('configs', {}).items():
         assert led.get('byte_identical') is True, (name, led)
         # the ISSUE 18 memory contract: peak admitted bytes >= 4x lower
         assert led.get('ratio', 0) >= 4.0, (name, led)
+    if name.startswith('14_'):
+        # the ISSUE 19 identity contract; the >= 1.5x mesh speedup floor
+        # is asserted by bench_history --check below from this detail doc
+        assert cfg.get('byte_identical') is True, (name, cfg)
+        assert cfg.get('overlap_off_identical') is True, (name, cfg)
+        assert cfg.get('devices', 0) >= 2, (name, cfg)
 print('bench smoke ok:', d['metric'], d['value'], d['unit'])
 "
 # bench trajectory: rebuild BENCH_TRAJECTORY.json from the per-round

@@ -25,6 +25,7 @@ EX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ("table_ingest.py", ["5000"]),
     ("tpch_q1_tpu.py", ["50000"]),
     ("aggregate.py", ["40000"]),
+    ("device_dataset.py", ["20000"]),
 ])
 def test_example_runs(script, argv, tmp_path, monkeypatch, capsys):
     argv = [a.format(tmp=tmp_path) for a in argv]

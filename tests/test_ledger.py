@@ -569,7 +569,7 @@ def test_debugz_schema_and_endpoint(tmp_path):
     find_rows(pf, "k", [3, 10**9], columns=["v"])
     snap = debugz_snapshot()
     assert set(snap) == {"ledger", "caches", "admission", "pool", "ops",
-                         "remote", "tables"}
+                         "remote", "tables", "routes"}
     assert "breakers" in snap["remote"]
     led = snap["ledger"]
     assert led["state"] in ("ok", "soft", "hard")
